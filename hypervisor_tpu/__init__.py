@@ -1,0 +1,251 @@
+"""hypervisor_tpu — TPU-native multi-agent governance runtime.
+
+A ground-up re-design of the Agent Hypervisor capability set
+(reference: imran-siddique/agent-hypervisor) for TPU hardware: agent /
+session / vouch state lives in HBM-resident structure-of-arrays tables,
+the per-agent hot loops (sigma_eff + ring math, slash cascades, SHA-256
+Merkle audit chains, saga transitions) run as batched JAX/XLA ops and
+Pallas kernels, and multi-chip scale comes from sharding the agent axis
+over a `jax.sharding.Mesh` with psum/ICI collectives implementing STRONG
+consistency.
+
+Public API parity: the 58 exports of the reference's
+`hypervisor/__init__.py:40-96` are all available here under the same names.
+"""
+
+from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
+from hypervisor_tpu.core import Hypervisor, ManagedSession
+from hypervisor_tpu.models import (
+    ActionDescriptor,
+    ConsistencyMode,
+    ExecutionRing,
+    ReversibilityLevel,
+    SessionConfig,
+    SessionParticipant,
+    SessionState,
+)
+from hypervisor_tpu.session import (
+    CausalViolationError,
+    DeadlockError,
+    IntentLock,
+    IntentLockManager,
+    IsolationLevel,
+    LockContentionError,
+    LockIntent,
+    SessionLifecycleError,
+    SessionParticipantError,
+    SessionVFS,
+    SharedSessionObject,
+    VectorClock,
+    VectorClockManager,
+    VFSEdit,
+    VFSPermissionError,
+)
+from hypervisor_tpu.rings import (
+    ActionClassifier,
+    AgentCallProfile,
+    BreachEvent,
+    BreachSeverity,
+    ClassificationResult,
+    RingBreachDetector,
+    RingCheckResult,
+    RingElevation,
+    RingElevationError,
+    RingElevationManager,
+    RingEnforcer,
+)
+from hypervisor_tpu.liability import (
+    AgentRiskProfile,
+    AttributionResult,
+    CausalAttributor,
+    CausalNode,
+    FaultAttribution,
+    LedgerEntry,
+    LedgerEntryType,
+    LiabilityEdge,
+    LiabilityLedger,
+    LiabilityMatrix,
+    QuarantineManager,
+    QuarantineReason,
+    QuarantineRecord,
+    SlashingEngine,
+    SlashResult,
+    VoucherClip,
+    VouchingEngine,
+    VouchingError,
+    VouchRecord,
+)
+from hypervisor_tpu.reversibility import ReversibilityEntry, ReversibilityRegistry
+from hypervisor_tpu.saga import (
+    CheckpointManager,
+    FanOutBranch,
+    FanOutGroup,
+    FanOutOrchestrator,
+    FanOutPolicy,
+    Saga,
+    SagaDefinition,
+    SagaDSLError,
+    SagaDSLFanOut,
+    SagaDSLParser,
+    SagaDSLStep,
+    SagaOrchestrator,
+    SagaState,
+    SagaStateError,
+    SagaStep,
+    SagaTimeoutError,
+    SemanticCheckpoint,
+    StepState,
+)
+from hypervisor_tpu.audit import (
+    CommitmentEngine,
+    CommitmentRecord,
+    DeltaEngine,
+    EphemeralGC,
+    GCResult,
+    RetentionPolicy,
+    SemanticDelta,
+    VFSChange,
+)
+from hypervisor_tpu.verification import (
+    TransactionHistoryVerifier,
+    TransactionRecord,
+    VerificationResult,
+    VerificationStatus,
+)
+from hypervisor_tpu.observability import (
+    CausalTraceId,
+    EventHandler,
+    EventType,
+    HypervisorEvent,
+    HypervisorEventBus,
+)
+from hypervisor_tpu.security import (
+    AgentRateLimiter,
+    HandoffStatus,
+    KillReason,
+    KillResult,
+    KillSwitch,
+    RateLimitExceeded,
+    RateLimitStats,
+    StepHandoff,
+    TokenBucket,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "__version__",
+    "DEFAULT_CONFIG",
+    "HypervisorConfig",
+    # Facade
+    "Hypervisor",
+    "ManagedSession",
+    # Models
+    "ActionDescriptor",
+    "ConsistencyMode",
+    "ExecutionRing",
+    "ReversibilityLevel",
+    "SessionConfig",
+    "SessionParticipant",
+    "SessionState",
+    # Session
+    "SharedSessionObject",
+    "SessionLifecycleError",
+    "SessionParticipantError",
+    "SessionVFS",
+    "VFSEdit",
+    "VFSPermissionError",
+    "VectorClock",
+    "VectorClockManager",
+    "CausalViolationError",
+    "IntentLock",
+    "IntentLockManager",
+    "LockIntent",
+    "LockContentionError",
+    "DeadlockError",
+    "IsolationLevel",
+    # Rings
+    "RingEnforcer",
+    "RingCheckResult",
+    "ActionClassifier",
+    "ClassificationResult",
+    "RingElevation",
+    "RingElevationError",
+    "RingElevationManager",
+    "RingBreachDetector",
+    "BreachEvent",
+    "BreachSeverity",
+    "AgentCallProfile",
+    # Liability
+    "VouchingEngine",
+    "VouchingError",
+    "VouchRecord",
+    "SlashingEngine",
+    "SlashResult",
+    "VoucherClip",
+    "LiabilityMatrix",
+    "LiabilityEdge",
+    "CausalAttributor",
+    "CausalNode",
+    "FaultAttribution",
+    "AttributionResult",
+    "QuarantineManager",
+    "QuarantineReason",
+    "QuarantineRecord",
+    "LiabilityLedger",
+    "LedgerEntry",
+    "LedgerEntryType",
+    "AgentRiskProfile",
+    # Reversibility
+    "ReversibilityRegistry",
+    "ReversibilityEntry",
+    # Saga
+    "Saga",
+    "SagaState",
+    "SagaStateError",
+    "SagaStep",
+    "StepState",
+    "SagaOrchestrator",
+    "SagaTimeoutError",
+    "FanOutOrchestrator",
+    "FanOutPolicy",
+    "FanOutGroup",
+    "FanOutBranch",
+    "CheckpointManager",
+    "SemanticCheckpoint",
+    "SagaDSLParser",
+    "SagaDSLError",
+    "SagaDefinition",
+    "SagaDSLStep",
+    "SagaDSLFanOut",
+    # Audit
+    "DeltaEngine",
+    "SemanticDelta",
+    "VFSChange",
+    "CommitmentEngine",
+    "CommitmentRecord",
+    "EphemeralGC",
+    "GCResult",
+    "RetentionPolicy",
+    # Verification
+    "TransactionHistoryVerifier",
+    "TransactionRecord",
+    "VerificationResult",
+    "VerificationStatus",
+    # Observability
+    "HypervisorEventBus",
+    "HypervisorEvent",
+    "EventType",
+    "EventHandler",
+    "CausalTraceId",
+    # Security
+    "AgentRateLimiter",
+    "RateLimitExceeded",
+    "RateLimitStats",
+    "TokenBucket",
+    "KillSwitch",
+    "KillReason",
+    "KillResult",
+    "HandoffStatus",
+    "StepHandoff",
+]

@@ -1,0 +1,24 @@
+"""Audit subsystem: Merkle-chained deltas, commitments, ephemeral GC."""
+
+from hypervisor_tpu.audit.delta import (
+    DeltaEngine,
+    SemanticDelta,
+    VFSChange,
+    merkle_root_device,
+    merkle_root_host,
+)
+from hypervisor_tpu.audit.commitment import CommitmentEngine, CommitmentRecord
+from hypervisor_tpu.audit.gc import EphemeralGC, GCResult, RetentionPolicy
+
+__all__ = [
+    "DeltaEngine",
+    "SemanticDelta",
+    "VFSChange",
+    "merkle_root_host",
+    "merkle_root_device",
+    "CommitmentEngine",
+    "CommitmentRecord",
+    "EphemeralGC",
+    "GCResult",
+    "RetentionPolicy",
+]

@@ -1,0 +1,65 @@
+"""Summary-hash commitment: anchor each session's Merkle root at termination.
+
+Capability parity with reference `audit/commitment.py:28-77`: per-session
+CommitmentRecord store, root-equality verification, and a batch queue/flush
+for external anchoring (committed_to stays "local"; a real chain writer is
+an integration concern).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Optional
+
+from hypervisor_tpu.utils.clock import utc_now
+
+
+@dataclass
+class CommitmentRecord:
+    session_id: str
+    merkle_root: str
+    participant_dids: list[str]
+    delta_count: int
+    committed_at: datetime = field(default_factory=utc_now)
+    blockchain_tx_id: Optional[str] = None
+    committed_to: str = "local"  # "local" | "ethereum" | "ipfs"
+
+
+class CommitmentEngine:
+    """Stores and verifies per-session summary-hash commitments."""
+
+    def __init__(self) -> None:
+        self._by_session: dict[str, CommitmentRecord] = {}
+        self._batch: list[CommitmentRecord] = []
+
+    def commit(
+        self,
+        session_id: str,
+        merkle_root: str,
+        participant_dids: list[str],
+        delta_count: int,
+    ) -> CommitmentRecord:
+        record = CommitmentRecord(
+            session_id=session_id,
+            merkle_root=merkle_root,
+            participant_dids=participant_dids,
+            delta_count=delta_count,
+        )
+        self._by_session[session_id] = record
+        return record
+
+    def verify(self, session_id: str, expected_root: str) -> bool:
+        record = self._by_session.get(session_id)
+        return record is not None and record.merkle_root == expected_root
+
+    def queue_for_batch(self, record: CommitmentRecord) -> None:
+        self._batch.append(record)
+
+    def flush_batch(self) -> list[CommitmentRecord]:
+        batch = list(self._batch)
+        self._batch.clear()
+        return batch
+
+    def get_commitment(self, session_id: str) -> Optional[CommitmentRecord]:
+        return self._by_session.get(session_id)

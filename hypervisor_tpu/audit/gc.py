@@ -1,0 +1,133 @@
+"""Ephemeral-data garbage collection after session archival.
+
+Capability parity with reference `audit/gc.py:48-141`: retention policy
+(90-day deltas, permanent summary hash), best-effort VFS purge via
+duck-typed list/delete, delta expiry via the engine's prune hook, storage
+accounting, purged-session tracking. Unlike the reference (whose per-file
+delete call signature never matches SessionVFS and silently no-ops), the
+purge here actually removes files, attributed to a system DID.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime, timedelta
+from typing import Any, Optional
+
+from hypervisor_tpu.utils.clock import Clock, utc_now
+
+GC_AGENT_DID = "did:hypervisor:gc"
+
+
+@dataclass
+class RetentionPolicy:
+    """What survives GC (mirrors reference `gc.py:39-45` shape)."""
+
+    delta_retention_days: int = 90
+    hash_retention: str = "permanent"
+    liability_snapshot: bool = True
+
+
+@dataclass
+class GCResult:
+    session_id: str
+    retained_deltas: int
+    retained_hash: bool
+    purged_vfs_files: int
+    purged_caches: int
+    storage_before_bytes: int
+    storage_after_bytes: int
+    gc_at: datetime = field(default_factory=utc_now)
+
+    @property
+    def storage_saved_bytes(self) -> int:
+        return self.storage_before_bytes - self.storage_after_bytes
+
+    @property
+    def savings_pct(self) -> float:
+        if self.storage_before_bytes == 0:
+            return 0.0
+        return (self.storage_saved_bytes / self.storage_before_bytes) * 100
+
+
+class EphemeralGC:
+    """Post-archive collector: purge VFS + caches, expire deltas, keep the hash."""
+
+    def __init__(
+        self, policy: Optional[RetentionPolicy] = None, clock: Clock = utc_now
+    ) -> None:
+        self.policy = policy or RetentionPolicy()
+        self._clock = clock
+        self._history: list[GCResult] = []
+        self._purged: set[str] = set()
+
+    def collect(
+        self,
+        session_id: str,
+        vfs: Any = None,
+        delta_engine: Any = None,
+        vfs_file_count: int = 0,
+        cache_count: int = 0,
+        delta_count: int = 0,
+        estimated_vfs_bytes: int = 0,
+        estimated_cache_bytes: int = 0,
+        estimated_delta_bytes: int = 0,
+    ) -> GCResult:
+        """Purge a terminated session's ephemeral state (best-effort)."""
+        purged_vfs = vfs_file_count
+        if vfs is not None:
+            try:
+                files = list(vfs.list_files()) if hasattr(vfs, "list_files") else []
+                purged_vfs = len(files)
+                for f in files:
+                    try:
+                        vfs.delete(f, GC_AGENT_DID)
+                    except TypeError:
+                        vfs.delete(f)
+                    except Exception:
+                        pass  # best-effort
+            except Exception:
+                purged_vfs = vfs_file_count
+
+        retained_deltas = delta_count
+        if delta_engine is not None and hasattr(delta_engine, "deltas"):
+            expired = sum(
+                1
+                for d in delta_engine.deltas
+                if self.should_expire_deltas(d.timestamp)
+            )
+            retained_deltas = delta_count - expired
+            if hasattr(delta_engine, "prune_expired"):
+                delta_engine.prune_expired(self.policy.delta_retention_days)
+
+        before = estimated_vfs_bytes + estimated_cache_bytes + estimated_delta_bytes
+        after = estimated_delta_bytes if delta_count > 0 else 0
+
+        result = GCResult(
+            session_id=session_id,
+            retained_deltas=max(retained_deltas, 0),
+            retained_hash=True,
+            purged_vfs_files=purged_vfs,
+            purged_caches=cache_count,
+            storage_before_bytes=before,
+            storage_after_bytes=after,
+            gc_at=self._clock(),
+        )
+        self._history.append(result)
+        self._purged.add(session_id)
+        return result
+
+    def is_purged(self, session_id: str) -> bool:
+        return session_id in self._purged
+
+    def should_expire_deltas(self, delta_timestamp: datetime) -> bool:
+        cutoff = self._clock() - timedelta(days=self.policy.delta_retention_days)
+        return delta_timestamp < cutoff
+
+    @property
+    def history(self) -> list[GCResult]:
+        return list(self._history)
+
+    @property
+    def purged_session_count(self) -> int:
+        return len(self._purged)

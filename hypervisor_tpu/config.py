@@ -1,0 +1,146 @@
+"""Centralized typed configuration for the TPU-native hypervisor.
+
+The reference scatters its knobs across engine-level class constants
+(ring thresholds `rings/enforcer.py:38-39`, bond/exposure limits
+`liability/vouching.py:52-55`, cascade depth + sigma floor
+`liability/slashing.py:54-55`, breach thresholds
+`rings/breach_detector.py:67-72`, per-ring rate limits
+`security/rate_limiter.py:52-57`, GC retention `audit/gc.py:39-45`).
+Here every knob lives in one frozen dataclass so the device ops can bake
+them as compile-time constants (hashable static args to `jax.jit`) or
+receive them as scalars inside kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class TrustConfig:
+    """Trust-score (sigma) and ring-threshold knobs.
+
+    Parity: thresholds match reference `models.py:34-42`,
+    `rings/enforcer.py:38-39`, `liability/vouching.py:52-55`,
+    `liability/slashing.py:54-55`.
+    """
+
+    ring1_threshold: float = 0.95
+    ring2_threshold: float = 0.60
+    score_scale: float = 1000.0          # Nexus 0-1000 -> 0.0-1.0
+    min_voucher_sigma: float = 0.50
+    default_bond_pct: float = 0.20
+    max_exposure: float = 0.80           # of voucher sigma, across vouchees
+    max_cascade_depth: int = 2
+    sigma_floor: float = 0.05
+    cascade_wipe_epsilon: float = 0.01   # sigma_after < floor+eps => cascade
+
+
+@dataclasses.dataclass(frozen=True)
+class BreachConfig:
+    """Sliding-window ring-breach detection (reference `rings/breach_detector.py:45-77`)."""
+
+    window_seconds: float = 60.0
+    window_capacity: int = 1000
+    min_calls_for_analysis: int = 5
+    low_threshold: float = 0.3
+    medium_threshold: float = 0.5
+    high_threshold: float = 0.7
+    critical_threshold: float = 0.9
+    circuit_breaker_cooldown_seconds: float = 30.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ElevationConfig:
+    """Sudo-with-TTL ring elevation (reference `rings/elevation.py:53-54`)."""
+
+    default_ttl_seconds: float = 300.0
+    max_ttl_seconds: float = 3600.0
+
+
+@dataclasses.dataclass(frozen=True)
+class RateLimitConfig:
+    """Per-ring token-bucket defaults (reference `security/rate_limiter.py:52-57`).
+
+    Index by ring number 0..3: (rate_per_second, burst).
+    """
+
+    ring_rates: tuple[float, float, float, float] = (100.0, 50.0, 20.0, 5.0)
+    ring_bursts: tuple[float, float, float, float] = (200.0, 100.0, 40.0, 10.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class LedgerConfig:
+    """Liability-ledger risk scoring (reference `liability/ledger.py:69-71,103-157`)."""
+
+    slash_weight: float = 0.15
+    quarantine_weight: float = 0.10
+    fault_weight: float = 0.05
+    clean_session_credit: float = 0.05
+    probation_threshold: float = 0.3
+    deny_threshold: float = 0.6
+
+
+@dataclasses.dataclass(frozen=True)
+class QuarantineConfig:
+    """Quarantine manager defaults (reference `liability/quarantine.py:68`)."""
+
+    default_duration_seconds: float = 300.0
+
+
+@dataclasses.dataclass(frozen=True)
+class RetentionPolicy:
+    """Ephemeral-GC retention (reference `audit/gc.py:39-45`)."""
+
+    delta_retention_days: int = 90
+    keep_summary_hash_permanently: bool = True
+    purge_vfs_on_terminate: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class VerifierConfig:
+    """Transaction-history verification (reference `verification/history.py:61`)."""
+
+    min_history_depth: int = 5
+    min_hash_length: int = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class TableCapacity:
+    """Static capacities for the HBM-resident tables.
+
+    Dynamic membership (joins/leaves/vouches) lives inside
+    capacity-preallocated arrays with active-masks; these set the
+    preallocation. Compile-time constants for the device ops.
+    """
+
+    max_agents: int = 16_384
+    max_sessions: int = 4_096
+    max_vouch_edges: int = 65_536
+    max_sagas: int = 8_192
+    max_steps_per_saga: int = 16
+    delta_log_capacity: int = 65_536
+    event_log_capacity: int = 65_536
+    max_participants_per_session: int = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class HypervisorConfig:
+    """Top-level config composing every subsystem's knobs."""
+
+    trust: TrustConfig = TrustConfig()
+    breach: BreachConfig = BreachConfig()
+    elevation: ElevationConfig = ElevationConfig()
+    rate_limit: RateLimitConfig = RateLimitConfig()
+    ledger: LedgerConfig = LedgerConfig()
+    quarantine: QuarantineConfig = QuarantineConfig()
+    retention: RetentionPolicy = RetentionPolicy()
+    verifier: VerifierConfig = VerifierConfig()
+    capacity: TableCapacity = TableCapacity()
+
+    def replace(self, **kw) -> "HypervisorConfig":
+        return dataclasses.replace(self, **kw)
+
+
+DEFAULT_CONFIG = HypervisorConfig()

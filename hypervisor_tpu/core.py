@@ -1,0 +1,314 @@
+"""Hypervisor facade: the composition root for multi-agent Shared Sessions.
+
+Capability parity with reference `core.py:37-298`: `create_session`,
+`join_session` (IATP enrichment -> reversibility registration -> STRONG
+forcing -> history verification -> sigma resolution -> ring assignment ->
+sandbox for untrustworthy agents), `activate_session`, `terminate_session`
+(Merkle root -> commitment -> bond release -> GC -> archive),
+`verify_behavior` (CMVK drift -> slash -> Nexus report), `get_session`,
+`active_sessions`.
+
+Like the reference, each ManagedSession owns its ReversibilityRegistry,
+DeltaEngine, and SagaOrchestrator while the Hypervisor holds the shared
+cross-session engines. Beyond the reference, the facade emits structured
+events to an (optional) event bus — the reference exports a bus but never
+wires it (`api/server.py:101` instantiates its own) — and exposes
+`batch`/device entry points for the vectorized hot path
+(`ops.pipeline`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from hypervisor_tpu.audit import CommitmentEngine, DeltaEngine, EphemeralGC
+from hypervisor_tpu.audit.gc import RetentionPolicy
+from hypervisor_tpu.liability import SlashingEngine, VouchingEngine
+from hypervisor_tpu.models import (
+    ActionDescriptor,
+    ConsistencyMode,
+    ExecutionRing,
+    SessionConfig,
+)
+from hypervisor_tpu.observability import EventType, HypervisorEvent, HypervisorEventBus
+from hypervisor_tpu.reversibility import ReversibilityRegistry
+from hypervisor_tpu.rings import ActionClassifier, RingEnforcer
+from hypervisor_tpu.saga import SagaOrchestrator
+from hypervisor_tpu.session import SharedSessionObject
+from hypervisor_tpu.verification import TransactionHistoryVerifier
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Hypervisor", "ManagedSession"]
+
+
+class ManagedSession:
+    """One session plus its session-scoped engines."""
+
+    def __init__(self, sso: SharedSessionObject) -> None:
+        self.sso = sso
+        self.reversibility = ReversibilityRegistry(sso.session_id)
+        self.delta_engine = DeltaEngine(sso.session_id)
+        self.saga = SagaOrchestrator()
+
+
+class Hypervisor:
+    """Top-level governance runtime.
+
+    Basic usage (sigma passed directly)::
+
+        hv = Hypervisor()
+        session = await hv.create_session(config, creator_did="did:mesh:admin")
+        await hv.join_session(session.sso.session_id, "did:mesh:a", sigma_raw=0.85)
+
+    Enriched usage wires NexusAdapter / CMVKAdapter / IATPAdapter so
+    join_session resolves sigma and parses manifests automatically.
+    """
+
+    def __init__(
+        self,
+        retention_policy: Optional[RetentionPolicy] = None,
+        max_exposure: Optional[float] = None,
+        nexus: Optional[Any] = None,
+        cmvk: Optional[Any] = None,
+        iatp: Optional[Any] = None,
+        event_bus: Optional[HypervisorEventBus] = None,
+    ) -> None:
+        # Shared cross-session engines.
+        self.vouching = VouchingEngine(max_exposure=max_exposure)
+        self.slashing = SlashingEngine(self.vouching)
+        self.ring_enforcer = RingEnforcer()
+        self.classifier = ActionClassifier()
+        self.verifier = TransactionHistoryVerifier()
+        self.commitment = CommitmentEngine()
+        self.gc = EphemeralGC(retention_policy)
+
+        # Optional integration adapters.
+        self.nexus = nexus
+        self.cmvk = cmvk
+        self.iatp = iatp
+
+        # Optional structured event emission (facade-wired, unlike reference).
+        self.event_bus = event_bus
+
+        self._sessions: dict[str, ManagedSession] = {}
+
+    # ── lifecycle ────────────────────────────────────────────────────
+
+    async def create_session(
+        self, config: SessionConfig, creator_did: str
+    ) -> ManagedSession:
+        """Create a Shared Session and advance it into HANDSHAKING."""
+        sso = SharedSessionObject(config=config, creator_did=creator_did)
+        sso.begin_handshake()
+        managed = ManagedSession(sso)
+        self._sessions[sso.session_id] = managed
+        self._emit(
+            EventType.SESSION_CREATED, session_id=sso.session_id, agent_did=creator_did
+        )
+        return managed
+
+    async def join_session(
+        self,
+        session_id: str,
+        agent_did: str,
+        actions: Optional[list[ActionDescriptor]] = None,
+        sigma_raw: float = 0.0,
+        manifest: Optional[Any] = None,
+        agent_history: Optional[Any] = None,
+    ) -> ExecutionRing:
+        """Admit an agent via the extended IATP handshake pipeline.
+
+        1. Parse IATP manifest (adapter + manifest provided)
+        2. Register declared actions in the Reversibility Registry
+        3. Force STRONG consistency if any action is non-reversible
+        4. Verify DID transaction history
+        5. Resolve sigma (Nexus or raw) and assign the ring
+        """
+        managed = self._require(session_id)
+
+        if self.iatp and manifest:
+            if isinstance(manifest, dict):
+                analysis = self.iatp.analyze_manifest_dict(manifest)
+            else:
+                analysis = self.iatp.analyze_manifest(manifest)
+            if not actions:
+                actions = analysis.actions
+            if sigma_raw == 0.0:
+                sigma_raw = analysis.sigma_hint
+            logger.debug(
+                "IATP manifest parsed for %s: ring_hint=%s", agent_did, analysis.ring_hint
+            )
+
+        if actions:
+            managed.reversibility.register_from_manifest(actions)
+
+        if managed.reversibility.has_non_reversible_actions():
+            managed.sso.force_consistency_mode(ConsistencyMode.STRONG)
+
+        verification = self.verifier.verify(agent_did)
+
+        sigma_eff = sigma_raw
+        if self.nexus and sigma_raw == 0.0:
+            sigma_eff = self.nexus.resolve_sigma(agent_did, history=agent_history)
+            logger.debug("Nexus resolved sigma=%.3f for %s", sigma_eff, agent_did)
+        elif self.nexus and agent_history:
+            # Conservative: explicit sigma is cross-checked against Nexus.
+            sigma_eff = min(
+                sigma_raw, self.nexus.resolve_sigma(agent_did, history=agent_history)
+            )
+
+        ring = self.ring_enforcer.compute_ring(sigma_eff)
+        if not verification.is_trustworthy:
+            ring = ExecutionRing.RING_3_SANDBOX
+
+        managed.sso.join(
+            agent_did=agent_did, sigma_raw=sigma_raw, sigma_eff=sigma_eff, ring=ring
+        )
+        self._emit(
+            EventType.SESSION_JOINED,
+            session_id=session_id,
+            agent_did=agent_did,
+            payload={"ring": ring.value, "sigma_eff": sigma_eff},
+        )
+        return ring
+
+    async def activate_session(self, session_id: str) -> None:
+        managed = self._require(session_id)
+        managed.sso.activate()
+        self._emit(EventType.SESSION_ACTIVATED, session_id=session_id)
+
+    async def terminate_session(self, session_id: str) -> Optional[str]:
+        """Terminate, commit the audit trail, release bonds, GC, archive.
+
+        Returns the Merkle-root summary hash (None when audit is disabled).
+        """
+        managed = self._require(session_id)
+        managed.sso.terminate()
+
+        merkle_root = None
+        if managed.sso.config.enable_audit:
+            merkle_root = managed.delta_engine.compute_merkle_root()
+            if merkle_root:
+                self.commitment.commit(
+                    session_id=session_id,
+                    merkle_root=merkle_root,
+                    participant_dids=[p.agent_did for p in managed.sso.participants],
+                    delta_count=managed.delta_engine.turn_count,
+                )
+                self._emit(
+                    EventType.AUDIT_COMMITTED,
+                    session_id=session_id,
+                    payload={"merkle_root": merkle_root},
+                )
+
+        self.vouching.release_session_bonds(session_id)
+
+        self.gc.collect(
+            session_id=session_id,
+            vfs=managed.sso.vfs,
+            delta_engine=managed.delta_engine,
+            delta_count=managed.delta_engine.turn_count,
+        )
+
+        managed.sso.archive()
+        self._emit(
+            EventType.SESSION_TERMINATED,
+            session_id=session_id,
+            payload={"merkle_root": merkle_root},
+        )
+        return merkle_root
+
+    # ── behavior verification ────────────────────────────────────────
+
+    async def verify_behavior(
+        self,
+        session_id: str,
+        agent_did: str,
+        claimed_embedding: Any,
+        observed_embedding: Any,
+        action_id: Optional[str] = None,
+    ) -> Optional[Any]:
+        """CMVK drift check; drift above threshold slashes + reports to Nexus."""
+        if not self.cmvk:
+            return None
+
+        result = self.cmvk.check_behavioral_drift(
+            agent_did=agent_did,
+            session_id=session_id,
+            claimed_embedding=claimed_embedding,
+            observed_embedding=observed_embedding,
+            action_id=action_id,
+        )
+
+        if result.should_slash:
+            managed = self._require(session_id)
+            participant = managed.sso.get_participant(agent_did)
+            agent_scores = {
+                p.agent_did: p.sigma_eff for p in managed.sso.participants
+            }
+            self.slashing.slash(
+                vouchee_did=agent_did,
+                session_id=session_id,
+                vouchee_sigma=participant.sigma_eff,
+                risk_weight=0.95,
+                reason=f"CMVK drift: {result.drift_score:.3f} ({result.severity.value})",
+                agent_scores=agent_scores,
+            )
+            self._emit(
+                EventType.SLASH_EXECUTED,
+                session_id=session_id,
+                agent_did=agent_did,
+                payload={"drift_score": result.drift_score},
+            )
+            if self.nexus:
+                severity = "critical" if result.drift_score >= 0.75 else "high"
+                self.nexus.report_slash(
+                    agent_did=agent_did,
+                    reason=f"Behavioral drift: {result.drift_score:.3f}",
+                    severity=severity,
+                )
+            logger.warning(
+                "Agent %s slashed: drift=%.3f", agent_did, result.drift_score
+            )
+
+        return result
+
+    # ── queries ──────────────────────────────────────────────────────
+
+    def get_session(self, session_id: str) -> Optional[ManagedSession]:
+        return self._sessions.get(session_id)
+
+    @property
+    def active_sessions(self) -> list[ManagedSession]:
+        return [
+            m
+            for m in self._sessions.values()
+            if m.sso.state.value not in ("archived", "terminating")
+        ]
+
+    # ── internals ────────────────────────────────────────────────────
+
+    def _require(self, session_id: str) -> ManagedSession:
+        managed = self._sessions.get(session_id)
+        if managed is None:
+            raise ValueError(f"Session {session_id} not found")
+        return managed
+
+    def _emit(
+        self,
+        event_type: EventType,
+        session_id: Optional[str] = None,
+        agent_did: Optional[str] = None,
+        payload: Optional[dict] = None,
+    ) -> None:
+        if self.event_bus is not None:
+            self.event_bus.emit(
+                HypervisorEvent(
+                    event_type=event_type,
+                    session_id=session_id,
+                    agent_did=agent_did,
+                    payload=payload or {},
+                )
+            )

@@ -1,0 +1,185 @@
+"""CMVK adapter: behavioral drift detection -> slash/demote decisions.
+
+Capability parity with reference `integrations/cmvk_adapter.py:91-250`:
+Protocol-typed verifier, severity ladder 0.15/0.30/0.50/0.75 (injectable
+`DriftThresholds`), should_slash = HIGH|CRITICAL, should_demote = MEDIUM,
+no-verifier pass-through, per-agent drift history / rate / mean, and an
+on-drift callback.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Callable, Optional, Protocol
+
+from hypervisor_tpu.utils.clock import Clock, utc_now
+
+
+class CMVKVerifier(Protocol):
+    """Contract of the external CMVK verify_embeddings."""
+
+    def verify_embeddings(
+        self,
+        embedding_a: Any,
+        embedding_b: Any,
+        metric: str = "cosine",
+        weights: Any = None,
+        threshold_profile: Optional[str] = None,
+        explain: bool = False,
+    ) -> Any: ...
+
+
+class DriftSeverity(str, enum.Enum):
+    NONE = "none"
+    LOW = "low"
+    MEDIUM = "medium"
+    HIGH = "high"
+    CRITICAL = "critical"
+
+
+@dataclass
+class DriftThresholds:
+    """Severity cut points (reference `cmvk_adapter.py:77-83`)."""
+
+    low: float = 0.15
+    medium: float = 0.30
+    high: float = 0.50
+    critical: float = 0.75
+
+
+@dataclass
+class DriftCheckResult:
+    agent_did: str
+    session_id: str
+    drift_score: float
+    severity: DriftSeverity
+    passed: bool
+    explanation: Optional[str] = None
+    action_id: Optional[str] = None
+    checked_at: datetime = field(default_factory=utc_now)
+
+    @property
+    def should_slash(self) -> bool:
+        return self.severity in (DriftSeverity.HIGH, DriftSeverity.CRITICAL)
+
+    @property
+    def should_demote(self) -> bool:
+        return self.severity is DriftSeverity.MEDIUM
+
+
+class CMVKAdapter:
+    """Drift checks with severity classification and history tracking."""
+
+    def __init__(
+        self,
+        verifier: Optional[CMVKVerifier] = None,
+        thresholds: Optional[DriftThresholds] = None,
+        on_drift_detected: Optional[Callable[[DriftCheckResult], None]] = None,
+        clock: Clock = utc_now,
+    ) -> None:
+        self._verifier = verifier
+        self.thresholds = thresholds or DriftThresholds()
+        self._on_drift = on_drift_detected
+        self._clock = clock
+        self._history: list[DriftCheckResult] = []
+
+    def check_behavioral_drift(
+        self,
+        agent_did: str,
+        session_id: str,
+        claimed_embedding: Any,
+        observed_embedding: Any,
+        action_id: Optional[str] = None,
+        metric: str = "cosine",
+        threshold_profile: Optional[str] = None,
+    ) -> DriftCheckResult:
+        """Compare claimed vs observed behavior; classify the drift."""
+        if self._verifier is None:
+            result = DriftCheckResult(
+                agent_did=agent_did,
+                session_id=session_id,
+                drift_score=0.0,
+                severity=DriftSeverity.NONE,
+                passed=True,
+                action_id=action_id,
+                checked_at=self._clock(),
+            )
+            self._history.append(result)
+            return result
+
+        verdict = self._verifier.verify_embeddings(
+            embedding_a=claimed_embedding,
+            embedding_b=observed_embedding,
+            metric=metric,
+            threshold_profile=threshold_profile,
+            explain=True,
+        )
+        drift_score = getattr(verdict, "drift_score", 0.0)
+        explanation = None
+        if getattr(verdict, "explanation", None):
+            explanation = str(verdict.explanation)
+
+        severity = self._classify(drift_score)
+        passed = severity in (DriftSeverity.NONE, DriftSeverity.LOW)
+        result = DriftCheckResult(
+            agent_did=agent_did,
+            session_id=session_id,
+            drift_score=drift_score,
+            severity=severity,
+            passed=passed,
+            explanation=explanation,
+            action_id=action_id,
+            checked_at=self._clock(),
+        )
+        self._history.append(result)
+        if not passed and self._on_drift is not None:
+            self._on_drift(result)
+        return result
+
+    def get_agent_drift_history(
+        self, agent_did: str, session_id: Optional[str] = None
+    ) -> list[DriftCheckResult]:
+        return [
+            r
+            for r in self._history
+            if r.agent_did == agent_did
+            and (session_id is None or r.session_id == session_id)
+        ]
+
+    def get_drift_rate(
+        self, agent_did: str, session_id: Optional[str] = None
+    ) -> float:
+        history = self.get_agent_drift_history(agent_did, session_id)
+        if not history:
+            return 0.0
+        return sum(1 for r in history if not r.passed) / len(history)
+
+    def get_mean_drift_score(
+        self, agent_did: str, session_id: Optional[str] = None
+    ) -> float:
+        history = self.get_agent_drift_history(agent_did, session_id)
+        if not history:
+            return 0.0
+        return sum(r.drift_score for r in history) / len(history)
+
+    @property
+    def total_checks(self) -> int:
+        return len(self._history)
+
+    @property
+    def total_violations(self) -> int:
+        return sum(1 for r in self._history if not r.passed)
+
+    def _classify(self, drift_score: float) -> DriftSeverity:
+        t = self.thresholds
+        if drift_score >= t.critical:
+            return DriftSeverity.CRITICAL
+        if drift_score >= t.high:
+            return DriftSeverity.HIGH
+        if drift_score >= t.medium:
+            return DriftSeverity.MEDIUM
+        if drift_score >= t.low:
+            return DriftSeverity.LOW
+        return DriftSeverity.NONE

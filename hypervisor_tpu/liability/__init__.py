@@ -1,0 +1,44 @@
+"""Joint Liability subsystem: vouching, slashing, attribution, quarantine, ledger."""
+
+from hypervisor_tpu.liability.matrix import LiabilityEdge, LiabilityMatrix
+from hypervisor_tpu.liability.vouching import VouchingEngine, VouchingError, VouchRecord
+from hypervisor_tpu.liability.slashing import SlashingEngine, SlashResult, VoucherClip
+from hypervisor_tpu.liability.attribution import (
+    AttributionResult,
+    CausalAttributor,
+    CausalNode,
+    FaultAttribution,
+)
+from hypervisor_tpu.liability.quarantine import (
+    QuarantineManager,
+    QuarantineReason,
+    QuarantineRecord,
+)
+from hypervisor_tpu.liability.ledger import (
+    AgentRiskProfile,
+    LedgerEntry,
+    LedgerEntryType,
+    LiabilityLedger,
+)
+
+__all__ = [
+    "LiabilityEdge",
+    "LiabilityMatrix",
+    "VouchingEngine",
+    "VouchingError",
+    "VouchRecord",
+    "SlashingEngine",
+    "SlashResult",
+    "VoucherClip",
+    "AttributionResult",
+    "CausalAttributor",
+    "CausalNode",
+    "FaultAttribution",
+    "QuarantineManager",
+    "QuarantineReason",
+    "QuarantineRecord",
+    "AgentRiskProfile",
+    "LedgerEntry",
+    "LedgerEntryType",
+    "LiabilityLedger",
+]

@@ -1,0 +1,156 @@
+"""Quarantine: read-only isolation with forensic preservation.
+
+Capability parity with reference `liability/quarantine.py:56-177`: reasons
+enum, default 300s duration, escalation merging into an existing record,
+tick() auto-release sweeps, forensic data retention, filtered history.
+Quarantined agents keep read access for forensic replay but cannot write,
+execute saga steps, or elevate (enforced by callers via `is_quarantined` —
+device plane: the FLAG_QUARANTINED bit in the agent table).
+"""
+
+from __future__ import annotations
+
+import enum
+import uuid
+from dataclasses import dataclass, field
+from datetime import datetime, timedelta
+from typing import Optional
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.utils.clock import Clock, utc_now
+
+
+class QuarantineReason(str, enum.Enum):
+    BEHAVIORAL_DRIFT = "behavioral_drift"
+    LIABILITY_VIOLATION = "liability_violation"
+    RING_BREACH = "ring_breach"
+    RATE_LIMIT_EXCEEDED = "rate_limit_exceeded"
+    MANUAL = "manual"
+    CASCADE_SLASH = "cascade_slash"
+
+
+@dataclass
+class QuarantineRecord:
+    quarantine_id: str = field(default_factory=lambda: f"quar:{uuid.uuid4().hex[:8]}")
+    agent_did: str = ""
+    session_id: str = ""
+    reason: QuarantineReason = QuarantineReason.MANUAL
+    details: str = ""
+    entered_at: datetime = field(default_factory=utc_now)
+    expires_at: Optional[datetime] = None
+    released_at: Optional[datetime] = None
+    is_active: bool = True
+    forensic_data: dict = field(default_factory=dict)
+
+    @property
+    def is_expired(self) -> bool:
+        if self.expires_at is None:
+            return False
+        return utc_now() > self.expires_at
+
+    def expired_at(self, now: datetime) -> bool:
+        return self.expires_at is not None and now > self.expires_at
+
+    @property
+    def duration_seconds(self) -> float:
+        end = self.released_at or utc_now()
+        return (end - self.entered_at).total_seconds()
+
+
+class QuarantineManager:
+    """Quarantine table with escalation-merge and expiry sweeps."""
+
+    DEFAULT_QUARANTINE_SECONDS = int(
+        DEFAULT_CONFIG.quarantine.default_duration_seconds
+    )
+
+    def __init__(self, clock: Clock = utc_now) -> None:
+        self._clock = clock
+        self._records: dict[str, QuarantineRecord] = {}
+
+    def quarantine(
+        self,
+        agent_did: str,
+        session_id: str,
+        reason: QuarantineReason,
+        details: str = "",
+        duration_seconds: Optional[int] = None,
+        forensic_data: Optional[dict] = None,
+    ) -> QuarantineRecord:
+        """Isolate an agent; re-quarantining escalates the existing record."""
+        existing = self.get_active_quarantine(agent_did, session_id)
+        if existing is not None:
+            existing.details += f"; escalated: {details}"
+            if forensic_data:
+                existing.forensic_data.update(forensic_data)
+            return existing
+
+        duration = duration_seconds or self.DEFAULT_QUARANTINE_SECONDS
+        now = self._clock()
+        record = QuarantineRecord(
+            agent_did=agent_did,
+            session_id=session_id,
+            reason=reason,
+            details=details,
+            entered_at=now,
+            expires_at=now + timedelta(seconds=duration) if duration else None,
+            forensic_data=forensic_data or {},
+        )
+        self._records[record.quarantine_id] = record
+        return record
+
+    def release(self, agent_did: str, session_id: str) -> Optional[QuarantineRecord]:
+        record = self.get_active_quarantine(agent_did, session_id)
+        if record is not None:
+            record.is_active = False
+            record.released_at = self._clock()
+        return record
+
+    def is_quarantined(self, agent_did: str, session_id: str) -> bool:
+        return self.get_active_quarantine(agent_did, session_id) is not None
+
+    def get_active_quarantine(
+        self, agent_did: str, session_id: str
+    ) -> Optional[QuarantineRecord]:
+        now = self._clock()
+        for r in self._records.values():
+            if (
+                r.agent_did == agent_did
+                and r.session_id == session_id
+                and r.is_active
+                and not r.expired_at(now)
+            ):
+                return r
+        return None
+
+    def tick(self) -> list[QuarantineRecord]:
+        """Release every expired quarantine; returns the newly released."""
+        now = self._clock()
+        released = []
+        for r in self._records.values():
+            if r.is_active and r.expired_at(now):
+                r.is_active = False
+                r.released_at = now
+                released.append(r)
+        return released
+
+    def get_history(
+        self, agent_did: Optional[str] = None, session_id: Optional[str] = None
+    ) -> list[QuarantineRecord]:
+        records = list(self._records.values())
+        if agent_did:
+            records = [r for r in records if r.agent_did == agent_did]
+        if session_id:
+            records = [r for r in records if r.session_id == session_id]
+        return records
+
+    @property
+    def active_quarantines(self) -> list[QuarantineRecord]:
+        now = self._clock()
+        return [
+            r for r in self._records.values() if r.is_active and not r.expired_at(now)
+        ]
+
+    @property
+    def quarantine_count(self) -> int:
+        return len(self.active_quarantines)

@@ -1,0 +1,129 @@
+"""Collateral slashing: blacklist the vouchee, clip the vouchers, cascade.
+
+Capability parity with reference `liability/slashing.py:43-147`: vouchee
+sigma -> 0, each voucher clipped to sigma*(1-omega) with floor 0.05, bonds
+released, recursive cascade to wiped vouchers bounded at depth 2, full slash
+history retained.
+
+This host engine is the exception-faithful scalar path; the batched
+equivalent over the whole agent table is `ops.liability.slash_cascade`
+(waves of masked edge passes — see that module for the equivalence
+argument).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Optional
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.liability.vouching import VouchingEngine
+from hypervisor_tpu.models import new_id
+from hypervisor_tpu.utils.clock import Clock, utc_now
+
+
+@dataclass
+class VoucherClip:
+    """One collateral clip applied to a voucher."""
+
+    voucher_did: str
+    sigma_before: float
+    sigma_after: float
+    risk_weight: float
+    vouch_id: str
+
+
+@dataclass
+class SlashResult:
+    """Outcome of one slashing event (and its direct clips)."""
+
+    slash_id: str
+    vouchee_did: str
+    vouchee_sigma_before: float
+    vouchee_sigma_after: float  # always 0.0
+    voucher_clips: list[VoucherClip]
+    reason: str
+    session_id: str
+    timestamp: datetime = field(default_factory=utc_now)
+    cascade_depth: int = 0
+
+
+class SlashingEngine:
+    """Joint-liability penalty enforcement over the vouch edge table."""
+
+    MAX_CASCADE_DEPTH = DEFAULT_CONFIG.trust.max_cascade_depth
+    SIGMA_FLOOR = DEFAULT_CONFIG.trust.sigma_floor
+
+    def __init__(self, vouching_engine: VouchingEngine, clock: Clock = utc_now) -> None:
+        self._vouching = vouching_engine
+        self._clock = clock
+        self._history: list[SlashResult] = []
+
+    def slash(
+        self,
+        vouchee_did: str,
+        session_id: str,
+        vouchee_sigma: float,
+        risk_weight: float,
+        reason: str,
+        agent_scores: dict[str, float],
+        cascade_depth: int = 0,
+    ) -> SlashResult:
+        """Blacklist `vouchee_did`, clip its vouchers, cascade to wiped ones.
+
+        `agent_scores` (did -> sigma) is mutated in place, mirroring the
+        reference contract.
+        """
+        agent_scores[vouchee_did] = 0.0
+
+        clips: list[VoucherClip] = []
+        for vouch in self._vouching.get_vouchers_for(vouchee_did, session_id):
+            before = agent_scores.get(vouch.voucher_did, 0.0)
+            after = max(before * (1.0 - risk_weight), self.SIGMA_FLOOR)
+            agent_scores[vouch.voucher_did] = after
+            clips.append(
+                VoucherClip(
+                    voucher_did=vouch.voucher_did,
+                    sigma_before=before,
+                    sigma_after=after,
+                    risk_weight=risk_weight,
+                    vouch_id=vouch.vouch_id,
+                )
+            )
+            self._vouching.release_bond(vouch.vouch_id)
+
+        result = SlashResult(
+            slash_id=new_id("slash"),
+            vouchee_did=vouchee_did,
+            vouchee_sigma_before=vouchee_sigma,
+            vouchee_sigma_after=0.0,
+            voucher_clips=clips,
+            reason=reason,
+            session_id=session_id,
+            timestamp=self._clock(),
+            cascade_depth=cascade_depth,
+        )
+        self._history.append(result)
+
+        if cascade_depth < self.MAX_CASCADE_DEPTH:
+            wipe_line = self.SIGMA_FLOOR + DEFAULT_CONFIG.trust.cascade_wipe_epsilon
+            for clip in clips:
+                if clip.sigma_after < wipe_line and self._vouching.get_vouchers_for(
+                    clip.voucher_did, session_id
+                ):
+                    self.slash(
+                        vouchee_did=clip.voucher_did,
+                        session_id=session_id,
+                        vouchee_sigma=clip.sigma_after,
+                        risk_weight=risk_weight,
+                        reason=f"Cascade from {vouchee_did}: {reason}",
+                        agent_scores=agent_scores,
+                        cascade_depth=cascade_depth + 1,
+                    )
+
+        return result
+
+    @property
+    def history(self) -> list[SlashResult]:
+        return list(self._history)

@@ -1,0 +1,17 @@
+"""Observability: structured event bus + causal trace ids."""
+
+from hypervisor_tpu.observability.event_bus import (
+    EventHandler,
+    EventType,
+    HypervisorEvent,
+    HypervisorEventBus,
+)
+from hypervisor_tpu.observability.causal_trace import CausalTraceId
+
+__all__ = [
+    "EventHandler",
+    "EventType",
+    "HypervisorEvent",
+    "HypervisorEventBus",
+    "CausalTraceId",
+]

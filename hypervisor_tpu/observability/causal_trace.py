@@ -1,0 +1,65 @@
+"""Causal trace ids: spans that encode the agent spawn/delegation tree.
+
+Capability parity with reference `observability/causal_trace.py:16-68`:
+frozen ids formatted `trace_id/span_id[/parent_span_id]` with depth,
+child/sibling derivation, parsing, and ancestor checks. The device event
+log stores these as paired int64 columns (hash of trace id, hash of span)
+so trace joins stay on-device; this class is the host-readable form.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class CausalTraceId:
+    """One span in a causal trace tree."""
+
+    trace_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
+    span_id: str = field(default_factory=lambda: uuid.uuid4().hex[:8])
+    parent_span_id: str | None = None
+    depth: int = 0
+
+    def child(self) -> "CausalTraceId":
+        """Span for a spawned sub-agent / delegated operation."""
+        return CausalTraceId(
+            trace_id=self.trace_id,
+            span_id=uuid.uuid4().hex[:8],
+            parent_span_id=self.span_id,
+            depth=self.depth + 1,
+        )
+
+    def sibling(self) -> "CausalTraceId":
+        """Span at the same level (same parent, new operation)."""
+        return CausalTraceId(
+            trace_id=self.trace_id,
+            span_id=uuid.uuid4().hex[:8],
+            parent_span_id=self.parent_span_id,
+            depth=self.depth,
+        )
+
+    @property
+    def full_id(self) -> str:
+        parts = [self.trace_id, self.span_id]
+        if self.parent_span_id:
+            parts.append(self.parent_span_id)
+        return "/".join(parts)
+
+    @classmethod
+    def from_string(cls, s: str) -> "CausalTraceId":
+        parts = s.split("/")
+        if len(parts) < 2:
+            raise ValueError(f"Invalid causal trace ID: {s}")
+        return cls(
+            trace_id=parts[0],
+            span_id=parts[1],
+            parent_span_id=parts[2] if len(parts) > 2 else None,
+        )
+
+    def is_ancestor_of(self, other: "CausalTraceId") -> bool:
+        return self.trace_id == other.trace_id and other.depth > self.depth
+
+    def __str__(self) -> str:
+        return self.full_id

@@ -1,0 +1,202 @@
+"""Structured event bus: append-only typed event log with pub/sub.
+
+Capability parity with reference `observability/event_bus.py:108-219`:
+38 typed events across 8 categories, frozen event records carrying causal
+trace + parent ids, three secondary indices (type / session / agent),
+type-specific and wildcard subscription, flexible filtered queries with
+limit, and per-type counts.
+
+TPU mapping: the event log's device twin is `tables.logs.EventLog` — a ring
+buffer of int32 columns (type code, session slot, agent slot, trace id) so
+high-rate device-side emissions (admission waves, slash cascades) batch
+into one append; this host bus is the queryable string-keyed view.
+"""
+
+from __future__ import annotations
+
+import enum
+import uuid
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Callable, Optional
+
+from hypervisor_tpu.utils.clock import utc_now
+
+
+class EventType(str, enum.Enum):
+    # Session lifecycle
+    SESSION_CREATED = "session.created"
+    SESSION_JOINED = "session.joined"
+    SESSION_ACTIVATED = "session.activated"
+    SESSION_TERMINATED = "session.terminated"
+    SESSION_ARCHIVED = "session.archived"
+    # Ring transitions
+    RING_ASSIGNED = "ring.assigned"
+    RING_ELEVATED = "ring.elevated"
+    RING_DEMOTED = "ring.demoted"
+    RING_ELEVATION_EXPIRED = "ring.elevation_expired"
+    RING_BREACH_DETECTED = "ring.breach_detected"
+    # Liability
+    VOUCH_CREATED = "liability.vouch_created"
+    VOUCH_RELEASED = "liability.vouch_released"
+    SLASH_EXECUTED = "liability.slash_executed"
+    FAULT_ATTRIBUTED = "liability.fault_attributed"
+    QUARANTINE_ENTERED = "liability.quarantine_entered"
+    QUARANTINE_RELEASED = "liability.quarantine_released"
+    # Saga
+    SAGA_CREATED = "saga.created"
+    SAGA_STEP_STARTED = "saga.step_started"
+    SAGA_STEP_COMMITTED = "saga.step_committed"
+    SAGA_STEP_FAILED = "saga.step_failed"
+    SAGA_COMPENSATING = "saga.compensating"
+    SAGA_COMPLETED = "saga.completed"
+    SAGA_ESCALATED = "saga.escalated"
+    SAGA_FANOUT_STARTED = "saga.fanout_started"
+    SAGA_FANOUT_RESOLVED = "saga.fanout_resolved"
+    SAGA_CHECKPOINT_SAVED = "saga.checkpoint_saved"
+    # VFS / session writes
+    VFS_WRITE = "vfs.write"
+    VFS_DELETE = "vfs.delete"
+    VFS_SNAPSHOT = "vfs.snapshot"
+    VFS_RESTORE = "vfs.restore"
+    VFS_CONFLICT = "vfs.conflict"
+    # Security
+    RATE_LIMITED = "security.rate_limited"
+    AGENT_KILLED = "security.agent_killed"
+    SAGA_HANDOFF = "security.saga_handoff"
+    IDENTITY_VERIFIED = "security.identity_verified"
+    # Audit
+    AUDIT_DELTA_CAPTURED = "audit.delta_captured"
+    AUDIT_COMMITTED = "audit.committed"
+    AUDIT_GC_COLLECTED = "audit.gc_collected"
+    # Verification
+    BEHAVIOR_DRIFT = "verification.behavior_drift"
+    HISTORY_VERIFIED = "verification.history_verified"
+
+    @property
+    def code(self) -> int:
+        """int32 column code for the device event log."""
+        return _EVENT_CODES[self]
+
+
+_EVENT_CODES = {t: i for i, t in enumerate(EventType)}
+
+
+@dataclass(frozen=True)
+class HypervisorEvent:
+    """Immutable structured event."""
+
+    event_id: str = field(default_factory=lambda: uuid.uuid4().hex[:16])
+    event_type: EventType = EventType.SESSION_CREATED
+    timestamp: datetime = field(default_factory=utc_now)
+    session_id: Optional[str] = None
+    agent_did: Optional[str] = None
+    causal_trace_id: Optional[str] = None
+    parent_event_id: Optional[str] = None
+    payload: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "event_id": self.event_id,
+            "event_type": self.event_type.value,
+            "timestamp": self.timestamp.isoformat(),
+            "session_id": self.session_id,
+            "agent_did": self.agent_did,
+            "causal_trace_id": self.causal_trace_id,
+            "parent_event_id": self.parent_event_id,
+            "payload": self.payload,
+        }
+
+
+EventHandler = Callable[[HypervisorEvent], None]
+
+
+class HypervisorEventBus:
+    """Append-only event store with secondary indices and pub/sub."""
+
+    def __init__(self) -> None:
+        self._events: list[HypervisorEvent] = []
+        self._subs: dict[Optional[EventType], list[EventHandler]] = {}
+        self._by_type: dict[EventType, list[HypervisorEvent]] = {}
+        self._by_session: dict[str, list[HypervisorEvent]] = {}
+        self._by_agent: dict[str, list[HypervisorEvent]] = {}
+
+    def emit(self, event: HypervisorEvent) -> None:
+        """Append, index, and fan out to subscribers."""
+        self._events.append(event)
+        self._by_type.setdefault(event.event_type, []).append(event)
+        if event.session_id:
+            self._by_session.setdefault(event.session_id, []).append(event)
+        if event.agent_did:
+            self._by_agent.setdefault(event.agent_did, []).append(event)
+        for handler in self._subs.get(event.event_type, ()):
+            handler(event)
+        for handler in self._subs.get(None, ()):
+            handler(event)
+
+    def subscribe(
+        self,
+        event_type: Optional[EventType] = None,
+        handler: Optional[EventHandler] = None,
+    ) -> None:
+        """Register a handler; event_type=None means wildcard."""
+        if handler:
+            self._subs.setdefault(event_type, []).append(handler)
+
+    # ── queries ──────────────────────────────────────────────────────
+
+    def query_by_type(self, event_type: EventType) -> list[HypervisorEvent]:
+        return list(self._by_type.get(event_type, ()))
+
+    def query_by_session(self, session_id: str) -> list[HypervisorEvent]:
+        return list(self._by_session.get(session_id, ()))
+
+    def query_by_agent(self, agent_did: str) -> list[HypervisorEvent]:
+        return list(self._by_agent.get(agent_did, ()))
+
+    def query_by_time_range(
+        self, start: datetime, end: Optional[datetime] = None
+    ) -> list[HypervisorEvent]:
+        end = end or utc_now()
+        return [e for e in self._events if start <= e.timestamp <= end]
+
+    def query(
+        self,
+        event_type: Optional[EventType] = None,
+        session_id: Optional[str] = None,
+        agent_did: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> list[HypervisorEvent]:
+        """Multi-filter query; starts from the narrowest index available."""
+        if event_type is not None:
+            results = self._by_type.get(event_type, [])
+        elif session_id is not None:
+            results = self._by_session.get(session_id, [])
+        elif agent_did is not None:
+            results = self._by_agent.get(agent_did, [])
+        else:
+            results = self._events
+        if session_id is not None:
+            results = [e for e in results if e.session_id == session_id]
+        if agent_did is not None:
+            results = [e for e in results if e.agent_did == agent_did]
+        if limit is not None:
+            results = results[-limit:]
+        return list(results)
+
+    @property
+    def event_count(self) -> int:
+        return len(self._events)
+
+    @property
+    def all_events(self) -> list[HypervisorEvent]:
+        return list(self._events)
+
+    def type_counts(self) -> dict[str, int]:
+        return {t.value: len(evts) for t, evts in self._by_type.items()}
+
+    def clear(self) -> None:
+        self._events.clear()
+        self._by_type.clear()
+        self._by_session.clear()
+        self._by_agent.clear()

@@ -1,0 +1,5 @@
+"""Device-plane batched ops (JAX/XLA): the vectorized hot loops."""
+
+from hypervisor_tpu.ops import liability, merkle, rings, sha256
+
+__all__ = ["liability", "merkle", "rings", "sha256"]

@@ -1,0 +1,190 @@
+"""Vectorized joint-liability math: sigma_eff, exposure, batched slash cascades.
+
+The reference walks the vouch dict per call (`liability/vouching.py:146-166`)
+and recurses per-voucher on slash (`liability/slashing.py:63-143`). Here the
+liability graph is the `VouchTable` edge list and:
+
+ - voucher contributions / exposure are masked segment-sums over edges,
+ - the depth-bounded slash cascade is unrolled into `max_depth+1` masked
+   edge passes (wave w blacklists its seeds, clips their vouchers with
+   (1-omega)^k for k simultaneous vouchees, releases bonds, and seeds wave
+   w+1 with wiped vouchers that themselves have vouchers).
+
+Equivalence note: the reference clips a voucher once per slashed vouchee
+sequentially with a floor between clips; max(sigma*(1-omega)^k, floor) is
+identical because the floor is absorbing under further clips.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG, TrustConfig
+from hypervisor_tpu.tables.state import VouchTable
+
+
+def edge_live(v: VouchTable, now: jnp.ndarray | float) -> jnp.ndarray:
+    """bool[E]: active, unexpired edges (`vouching.py:186-197` filter)."""
+    return v.active & (jnp.asarray(now, jnp.float32) <= v.expiry)
+
+
+def voucher_contribution(
+    v: VouchTable,
+    vouchee_slots: jnp.ndarray,
+    session_slots: jnp.ndarray,
+    now: jnp.ndarray | float,
+    n_agents: int | None = None,
+) -> jnp.ndarray:
+    """Sum of active bonded sigma toward each queried vouchee (`vouching.py:146-148`).
+
+    Args:
+      vouchee_slots / session_slots: i32[B] query batch.
+
+    Returns:
+      f32[B] total bonded contributions.
+    """
+    live = edge_live(v, now)
+    # [B, E] mask — fine for B*E up to ~1e8; segment-sum formulation used in
+    # the fused pipeline where B == n_agents.
+    m = (
+        live[None, :]
+        & (v.vouchee[None, :] == vouchee_slots[:, None])
+        & (v.session[None, :] == session_slots[:, None])
+    )
+    return jnp.sum(jnp.where(m, v.bond[None, :], 0.0), axis=1)
+
+
+def contribution_by_agent(
+    v: VouchTable, session_of_agent: jnp.ndarray, now: jnp.ndarray | float
+) -> jnp.ndarray:
+    """f32[N] bonded contribution per agent slot via segment-sum (scales to 10k+).
+
+    Only counts edges whose session matches the agent's current session.
+    """
+    n = session_of_agent.shape[0]
+    live = edge_live(v, now)
+    sess_match = v.session == jnp.where(
+        v.vouchee >= 0, session_of_agent[jnp.clip(v.vouchee, 0)], -2
+    )
+    w = jnp.where(live & sess_match, v.bond, 0.0)
+    idx = jnp.clip(v.vouchee, 0)
+    return jnp.zeros((n,), jnp.float32).at[idx].add(
+        jnp.where(v.vouchee >= 0, w, 0.0)
+    )
+
+
+def sigma_eff(
+    vouchee_sigma: jnp.ndarray,
+    risk_weight: jnp.ndarray,
+    contribution: jnp.ndarray,
+) -> jnp.ndarray:
+    """sigma_eff = sigma_L + omega * sum(bonded), capped at 1.0 (`vouching.py:128-151`)."""
+    return jnp.minimum(vouchee_sigma + risk_weight * contribution, 1.0)
+
+
+def exposure_by_voucher(
+    v: VouchTable,
+    voucher_slots: jnp.ndarray,
+    session_slots: jnp.ndarray,
+    now: jnp.ndarray | float,
+) -> jnp.ndarray:
+    """f32[B] total sigma bonded by each (voucher, session) pair (`vouching.py:157-166`)."""
+    live = edge_live(v, now)
+    m = (
+        live[None, :]
+        & (v.voucher[None, :] == voucher_slots[:, None])
+        & (v.session[None, :] == session_slots[:, None])
+    )
+    return jnp.sum(jnp.where(m, v.bond[None, :], 0.0), axis=1)
+
+
+class SlashWaveResult(NamedTuple):
+    sigma: jnp.ndarray        # f32[N] updated scores
+    vouch: VouchTable         # bonds released for consumed edges
+    slashed: jnp.ndarray      # bool[N] all agents blacklisted in any wave
+    clipped: jnp.ndarray      # bool[N] all agents clipped in any wave
+    wave_of: jnp.ndarray      # i8[N] cascade depth an agent was slashed at (-1 none)
+
+
+def slash_cascade(
+    vouch: VouchTable,
+    sigma: jnp.ndarray,
+    seeds: jnp.ndarray,
+    session_slot: jnp.ndarray | int,
+    risk_weight: jnp.ndarray | float,
+    now: jnp.ndarray | float,
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+) -> SlashWaveResult:
+    """Batched slash with depth-bounded cascade (`slashing.py:63-143`).
+
+    Args:
+      sigma: f32[N] agent scores (full table).
+      seeds: bool[N] initial vouchees to blacklist.
+      session_slot: session scope of the violation.
+      risk_weight: omega of the violated action.
+
+    Semantics mirrored from the reference:
+      * every slashed vouchee's sigma -> 0 (`slashing.py:89`)
+      * vouchers clipped to max(sigma*(1-omega)^k, floor) (`:95-99`)
+      * consumed bonds released (`:110`)
+      * a clipped voucher cascades iff its new sigma < floor+eps AND it has
+        its own vouchers, at depth <= max_cascade_depth (`:124-141`).
+    """
+    omega = jnp.asarray(risk_weight, jnp.float32)
+    sess = jnp.asarray(session_slot, jnp.int32)
+    n = sigma.shape[0]
+    slashed = jnp.zeros((n,), bool)
+    clipped_any = jnp.zeros((n,), bool)
+    wave_of = jnp.full((n,), -1, jnp.int8)
+    wave = jnp.asarray(seeds, bool)
+    active = vouch.active
+
+    for depth in range(trust.max_cascade_depth + 1):
+        # Blacklist current wave.
+        sigma = jnp.where(wave, 0.0, sigma)
+        slashed = slashed | wave
+        wave_of = jnp.where(wave & (wave_of < 0), jnp.int8(depth), wave_of)
+
+        # Edges feeding the wave: live, in-session, vouchee in wave.
+        live = active & (jnp.asarray(now, jnp.float32) <= vouch.expiry)
+        hit = (
+            live
+            & (vouch.session == sess)
+            & jnp.where(vouch.vouchee >= 0, wave[jnp.clip(vouch.vouchee, 0)], False)
+        )
+        # k = simultaneous slashed vouchees per voucher.
+        k = jnp.zeros((n,), jnp.int32).at[jnp.clip(vouch.voucher, 0)].add(
+            jnp.where(hit & (vouch.voucher >= 0), 1, 0)
+        )
+        was_clipped = k > 0
+        clip_sigma = jnp.maximum(
+            sigma * jnp.power(1.0 - omega, k.astype(jnp.float32)),
+            trust.sigma_floor,
+        )
+        sigma = jnp.where(was_clipped, clip_sigma, sigma)
+        clipped_any = clipped_any | was_clipped
+        # Release consumed bonds.
+        active = active & ~hit
+
+        if depth == trust.max_cascade_depth:
+            break
+        # Next wave: wiped vouchers (sigma < floor+eps) that have their own
+        # vouchers in this session — and weren't already slashed.
+        wiped = was_clipped & (sigma < trust.sigma_floor + trust.cascade_wipe_epsilon)
+        live2 = active & (jnp.asarray(now, jnp.float32) <= vouch.expiry)
+        has_vouchers = jnp.zeros((n,), bool).at[jnp.clip(vouch.vouchee, 0)].max(
+            live2 & (vouch.session == sess) & (vouch.vouchee >= 0)
+        )
+        wave = wiped & has_vouchers & ~slashed
+
+    from hypervisor_tpu.tables.struct import replace
+
+    return SlashWaveResult(
+        sigma=sigma,
+        vouch=replace(vouch, active=active),
+        slashed=slashed,
+        clipped=clipped_any,
+        wave_of=wave_of,
+    )

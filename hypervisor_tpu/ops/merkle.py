@@ -1,0 +1,140 @@
+"""Merkle-chain audit ops: tree roots and sequential chain carries on device.
+
+Reference semantics (`audit/delta.py`):
+ - interior combine = sha256(ascii_hex(left) + ascii_hex(right)) (`:127-131`)
+ - odd node duplicated at each level (`:129`)
+ - each delta's hash covers its parent's hash (chain, `:102,111-113`)
+
+Device design: leaves live as u32[P,8] digest words (P = static pow2
+capacity, count dynamic). The tree is an unrolled log2(P) sequence of
+batched hex-pair hashes; per-level odd-duplication is a masked select, so a
+root over `count` leaves is bit-identical to the reference's Python loop.
+The chain is the one genuinely sequential structure: a `lax.scan` whose
+carry is the parent digest, hashing fixed-width binary delta bodies — bodies
+are hashed with their parent folded in, batched across independent session
+lanes so the VPU stays full.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from hypervisor_tpu.ops.sha256 import (
+    pad_tail_words,
+    sha256_blocks,
+    sha256_hex_pair,
+)
+
+# Binary delta record: 16 u32 body words (64 B) + 8 u32 parent digest words
+# = 96-byte message -> 2 SHA-256 blocks.
+BODY_WORDS = 16
+_CHAIN_MSG_BYTES = (BODY_WORDS + 8) * 4
+_CHAIN_TAIL = pad_tail_words(_CHAIN_MSG_BYTES, 2)
+
+
+def merkle_root(digests: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
+    """Merkle root over the first `count` of P leaf digests.
+
+    Args:
+      digests: u32[P, 8] leaf digests, P a static power of two.
+      count: dynamic i32 scalar, 1 <= count <= P.
+
+    Returns:
+      u32[8] root digest. For count == 1 the root is the single leaf
+      (matching the reference's while-loop which never combines a lone node).
+    """
+    p = digests.shape[0]
+    assert p & (p - 1) == 0, "leaf capacity must be a power of two"
+    arr = digests
+    cnt = jnp.asarray(count, jnp.int32)
+    while arr.shape[0] > 1:
+        half = arr.shape[0] // 2
+        left = arr[0::2]
+        right = arr[1::2]
+        j = jnp.arange(half, dtype=jnp.int32)
+        dup = (2 * j + 1) >= cnt  # odd tail: right := left
+        right = jnp.where(dup[:, None], left, right)
+        combined = sha256_hex_pair(left, right)
+        descend = cnt > 1
+        arr = jnp.where(descend, combined, left)
+        cnt = jnp.where(descend, (cnt + 1) // 2, cnt)
+    return arr[0]
+
+
+def chain_digests(
+    bodies: jnp.ndarray, seed: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """Sequentially chain-hash binary delta bodies.
+
+    digest_n = sha256(body_n_bytes || digest_{n-1}_bytes); digest_{-1} = seed
+    (zeros by default). This is the device-native chain format — the
+    JSON-compatible host format lives in `audit.delta`.
+
+    Args:
+      bodies: u32[N, L, BODY_WORDS] — N sequential turns over L parallel
+        session lanes.
+      seed: u32[L, 8] optional chain seed per lane.
+
+    Returns:
+      u32[N, L, 8] per-turn digests (the chain per lane).
+    """
+    n, lanes, _ = bodies.shape
+    if seed is None:
+        seed = jnp.zeros((lanes, 8), jnp.uint32)
+    tail = jnp.broadcast_to(
+        jnp.asarray(_CHAIN_TAIL, jnp.uint32), (lanes, _CHAIN_TAIL.shape[0])
+    )
+
+    def step(parent, body):
+        msg = jnp.concatenate([body, parent, tail], axis=1)  # [L, 32] = 2 blocks
+        digest = sha256_blocks(msg, 2)
+        return digest, digest
+
+    _, digests = lax.scan(step, seed, bodies)
+    return digests
+
+
+def verify_chain_digests(
+    bodies: jnp.ndarray,
+    recorded: jnp.ndarray,
+    count: jnp.ndarray,
+    seed: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Tamper check: recompute the chain and compare to recorded digests.
+
+    Args:
+      bodies: u32[N, L, BODY_WORDS]; recorded: u32[N, L, 8];
+      count: i32[L] valid turns per lane.
+
+    Returns:
+      bool[L] — True where the first `count` digests all match.
+    """
+    recomputed = chain_digests(bodies, seed)
+    eq = jnp.all(recomputed == recorded, axis=-1)  # [N, L]
+    turn = jnp.arange(bodies.shape[0], dtype=jnp.int32)[:, None]
+    in_range = turn < count[None, :]
+    return jnp.all(eq | ~in_range, axis=0)
+
+
+def pack_delta_bodies(
+    session: np.ndarray,
+    turn: np.ndarray,
+    agent: np.ndarray,
+    change_digest: np.ndarray,
+    timestamp: np.ndarray,
+) -> np.ndarray:
+    """Host-side packing of delta metadata into BODY_WORDS-u32 records.
+
+    Layout (u32 words): [session, turn, agent, ts_bits, change_digest[8],
+    zeros[4]]. `change_digest` is the sha256 of the turn's VFS change set.
+    """
+    n = session.shape[0]
+    body = np.zeros((n, BODY_WORDS), np.uint32)
+    body[:, 0] = session.astype(np.uint32)
+    body[:, 1] = turn.astype(np.uint32)
+    body[:, 2] = agent.astype(np.uint32)
+    body[:, 3] = np.asarray(timestamp, np.float32).view(np.uint32)
+    body[:, 4:12] = change_digest.astype(np.uint32)
+    return body

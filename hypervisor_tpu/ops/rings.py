@@ -1,0 +1,115 @@
+"""Vectorized execution-ring math.
+
+The reference computes rings one agent at a time (`models.py:34-42`,
+`rings/enforcer.py:44-137`). Here every check is a batched op over int8/f32
+columns so a 10k-agent admission wave is one XLA kernel. Denials are status
+codes (host facade maps them back to the reference's exception messages —
+see `hypervisor_tpu.utils.status`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG, TrustConfig
+
+# Ring-check status codes (ordered by check precedence in the reference
+# `rings/enforcer.py:60-120`).
+CHECK_OK = 0
+CHECK_NEEDS_SRE_WITNESS = 1
+CHECK_SIGMA_BELOW_RING1 = 2
+CHECK_NEEDS_CONSENSUS = 3
+CHECK_SIGMA_BELOW_RING2 = 4
+CHECK_RING_INSUFFICIENT = 5
+
+
+def compute_rings(
+    sigma_eff: jnp.ndarray,
+    has_consensus: jnp.ndarray | bool = False,
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+) -> jnp.ndarray:
+    """Batched ring derivation from sigma_eff (thresholds `models.py:34-42`).
+
+    Returns int8 rings: 1 if sigma>0.95 and consensus, 2 if sigma>0.60, else 3.
+    """
+    sigma_eff = jnp.asarray(sigma_eff)
+    consensus = jnp.broadcast_to(jnp.asarray(has_consensus), sigma_eff.shape)
+    ring = jnp.where(
+        (sigma_eff > trust.ring1_threshold) & consensus,
+        jnp.int8(1),
+        jnp.where(sigma_eff > trust.ring2_threshold, jnp.int8(2), jnp.int8(3)),
+    )
+    return ring
+
+
+def required_rings(
+    is_admin: jnp.ndarray,
+    reversibility_code: jnp.ndarray,
+    is_read_only: jnp.ndarray,
+) -> jnp.ndarray:
+    """Batched `ActionDescriptor.required_ring` (`models.py:122-132`).
+
+    reversibility_code: 0=FULL 1=PARTIAL 2=NONE.
+    """
+    nonrev = (reversibility_code == 2) & ~is_read_only
+    return jnp.where(
+        is_admin,
+        jnp.int8(0),
+        jnp.where(nonrev, jnp.int8(1), jnp.where(is_read_only, jnp.int8(3), jnp.int8(2))),
+    ).astype(jnp.int8)
+
+
+def ring_check(
+    agent_ring: jnp.ndarray,
+    required_ring: jnp.ndarray,
+    sigma_eff: jnp.ndarray,
+    has_consensus: jnp.ndarray | bool = False,
+    has_sre_witness: jnp.ndarray | bool = False,
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+) -> jnp.ndarray:
+    """Batched privilege-gate check (`rings/enforcer.py:44-128`).
+
+    Returns int8 status codes (CHECK_OK == allowed). Check precedence matches
+    the reference: SRE witness, ring-1 sigma, ring-1 consensus, ring-2 sigma,
+    then agent-ring sufficiency.
+    """
+    agent_ring = jnp.asarray(agent_ring)
+    shape = jnp.broadcast_shapes(
+        agent_ring.shape, jnp.asarray(required_ring).shape, jnp.asarray(sigma_eff).shape
+    )
+    required_ring = jnp.broadcast_to(jnp.asarray(required_ring), shape)
+    sigma_eff = jnp.broadcast_to(jnp.asarray(sigma_eff), shape)
+    consensus = jnp.broadcast_to(jnp.asarray(has_consensus), shape)
+    witness = jnp.broadcast_to(jnp.asarray(has_sre_witness), shape)
+
+    status = jnp.full(shape, CHECK_OK, jnp.int8)
+
+    def claim(status, cond, code):
+        return jnp.where((status == CHECK_OK) & cond, jnp.int8(code), status)
+
+    status = claim(status, (required_ring == 0) & ~witness, CHECK_NEEDS_SRE_WITNESS)
+    status = claim(
+        status,
+        (required_ring == 1) & (sigma_eff < trust.ring1_threshold),
+        CHECK_SIGMA_BELOW_RING1,
+    )
+    status = claim(status, (required_ring == 1) & ~consensus, CHECK_NEEDS_CONSENSUS)
+    status = claim(
+        status,
+        (required_ring == 2) & (sigma_eff < trust.ring2_threshold),
+        CHECK_SIGMA_BELOW_RING2,
+    )
+    status = claim(
+        status, jnp.broadcast_to(agent_ring, shape) > required_ring, CHECK_RING_INSUFFICIENT
+    )
+    return status
+
+
+def should_demote(
+    current_ring: jnp.ndarray,
+    sigma_eff: jnp.ndarray,
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+) -> jnp.ndarray:
+    """Batched demotion scan (`rings/enforcer.py:134-137`): appropriate > current."""
+    appropriate = compute_rings(sigma_eff, False, trust)
+    return appropriate > jnp.asarray(current_ring).astype(jnp.int8)

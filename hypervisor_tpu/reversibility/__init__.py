@@ -1,0 +1,98 @@
+"""Reversibility registry: action -> (Execute_API, Undo_API, omega).
+
+Capability parity with reference `reversibility/registry.py:31-107`:
+session-scoped entries populated from IATP manifests, undo lookup for saga
+rollback, non-reversible detection (drives STRONG-mode forcing in the
+facade), and undo-API health marking.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from hypervisor_tpu.models import ActionDescriptor, ReversibilityLevel
+
+__all__ = ["ReversibilityEntry", "ReversibilityRegistry"]
+
+
+@dataclass
+class ReversibilityEntry:
+    action_id: str
+    execute_api: str
+    undo_api: Optional[str]
+    reversibility: ReversibilityLevel
+    undo_window_seconds: int
+    compensation_method: Optional[str]
+    risk_weight: float
+    undo_api_healthy: bool = True
+    last_health_check: Optional[str] = None
+
+
+class ReversibilityRegistry:
+    """Session-scoped action reversibility map."""
+
+    def __init__(self, session_id: str) -> None:
+        self.session_id = session_id
+        self._entries: dict[str, ReversibilityEntry] = {}
+        self._non_reversible = 0  # running count: O(1) has_non_reversible
+
+    def register(self, action: ActionDescriptor) -> ReversibilityEntry:
+        prior = self._entries.get(action.action_id)
+        if prior is not None and prior.reversibility is ReversibilityLevel.NONE:
+            self._non_reversible -= 1
+        entry = ReversibilityEntry(
+            action_id=action.action_id,
+            execute_api=action.execute_api,
+            undo_api=action.undo_api,
+            reversibility=action.reversibility,
+            undo_window_seconds=action.undo_window_seconds,
+            compensation_method=action.compensation_method,
+            risk_weight=action.risk_weight,
+        )
+        self._entries[action.action_id] = entry
+        if entry.reversibility is ReversibilityLevel.NONE:
+            self._non_reversible += 1
+        return entry
+
+    def register_from_manifest(self, actions: list[ActionDescriptor]) -> int:
+        for action in actions:
+            self.register(action)
+        return len(actions)
+
+    def get(self, action_id: str) -> Optional[ReversibilityEntry]:
+        return self._entries.get(action_id)
+
+    def get_undo_api(self, action_id: str) -> Optional[str]:
+        entry = self._entries.get(action_id)
+        return entry.undo_api if entry else None
+
+    def is_reversible(self, action_id: str) -> bool:
+        entry = self._entries.get(action_id)
+        return entry is not None and entry.reversibility is not ReversibilityLevel.NONE
+
+    def get_risk_weight(self, action_id: str) -> float:
+        entry = self._entries.get(action_id)
+        if entry is None:
+            return ReversibilityLevel.NONE.default_risk_weight
+        return entry.risk_weight
+
+    def has_non_reversible_actions(self) -> bool:
+        return self._non_reversible > 0
+
+    def mark_undo_unhealthy(self, action_id: str) -> None:
+        entry = self._entries.get(action_id)
+        if entry is not None:
+            entry.undo_api_healthy = False
+
+    @property
+    def entries(self) -> list[ReversibilityEntry]:
+        return list(self._entries.values())
+
+    @property
+    def non_reversible_actions(self) -> list[str]:
+        return [
+            e.action_id
+            for e in self._entries.values()
+            if e.reversibility is ReversibilityLevel.NONE
+        ]

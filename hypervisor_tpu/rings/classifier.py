@@ -1,0 +1,70 @@
+"""Action risk classifier: manifest actions -> (ring, omega, reversibility).
+
+Capability parity with reference `rings/classifier.py:27-77`: derivation from
+the ActionDescriptor, per-action caching, and session-level overrides at
+confidence 0.9. The batched derivation for manifest tables is
+`ops.rings.required_rings`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from hypervisor_tpu.models import ActionDescriptor, ExecutionRing, ReversibilityLevel
+
+
+@dataclass
+class ClassificationResult:
+    action_id: str
+    ring: ExecutionRing
+    risk_weight: float
+    reversibility: ReversibilityLevel
+    confidence: float = 1.0
+
+
+class ActionClassifier:
+    """Caches classifications; overrides win over cache."""
+
+    OVERRIDE_CONFIDENCE = 0.9
+
+    def __init__(self) -> None:
+        self._cache: dict[str, ClassificationResult] = {}
+        self._overrides: dict[str, ClassificationResult] = {}
+
+    def classify(self, action: ActionDescriptor) -> ClassificationResult:
+        override = self._overrides.get(action.action_id)
+        if override is not None:
+            return override
+        cached = self._cache.get(action.action_id)
+        if cached is not None:
+            return cached
+        result = ClassificationResult(
+            action_id=action.action_id,
+            ring=action.required_ring,
+            risk_weight=action.risk_weight,
+            reversibility=action.reversibility,
+        )
+        self._cache[action.action_id] = result
+        return result
+
+    def set_override(
+        self,
+        action_id: str,
+        ring: Optional[ExecutionRing] = None,
+        risk_weight: Optional[float] = None,
+    ) -> None:
+        """Install a session-level override (confidence 0.9)."""
+        prior = self._cache.get(action_id)
+        self._overrides[action_id] = ClassificationResult(
+            action_id=action_id,
+            ring=ring or (prior.ring if prior else ExecutionRing.RING_3_SANDBOX),
+            risk_weight=risk_weight
+            if risk_weight is not None
+            else (prior.risk_weight if prior else 0.5),
+            reversibility=prior.reversibility if prior else ReversibilityLevel.NONE,
+            confidence=self.OVERRIDE_CONFIDENCE,
+        )
+
+    def clear_cache(self) -> None:
+        self._cache.clear()

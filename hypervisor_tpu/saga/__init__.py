@@ -1,0 +1,49 @@
+"""Saga subsystem: state machines, orchestration, fan-out, checkpoints, DSL."""
+
+from hypervisor_tpu.saga.state_machine import (
+    Saga,
+    SagaState,
+    SagaStateError,
+    SagaStep,
+    StepState,
+    STEP_TRANSITION_MATRIX,
+    SAGA_TRANSITION_MATRIX,
+)
+from hypervisor_tpu.saga.orchestrator import SagaOrchestrator, SagaTimeoutError
+from hypervisor_tpu.saga.fan_out import (
+    FanOutBranch,
+    FanOutGroup,
+    FanOutOrchestrator,
+    FanOutPolicy,
+)
+from hypervisor_tpu.saga.checkpoint import CheckpointManager, SemanticCheckpoint
+from hypervisor_tpu.saga.dsl import (
+    SagaDefinition,
+    SagaDSLError,
+    SagaDSLFanOut,
+    SagaDSLParser,
+    SagaDSLStep,
+)
+
+__all__ = [
+    "Saga",
+    "SagaState",
+    "SagaStateError",
+    "SagaStep",
+    "StepState",
+    "STEP_TRANSITION_MATRIX",
+    "SAGA_TRANSITION_MATRIX",
+    "SagaOrchestrator",
+    "SagaTimeoutError",
+    "FanOutBranch",
+    "FanOutGroup",
+    "FanOutOrchestrator",
+    "FanOutPolicy",
+    "CheckpointManager",
+    "SemanticCheckpoint",
+    "SagaDefinition",
+    "SagaDSLError",
+    "SagaDSLFanOut",
+    "SagaDSLParser",
+    "SagaDSLStep",
+]

@@ -1,0 +1,185 @@
+"""Declarative saga DSL: dict/YAML definitions -> executable saga topology.
+
+Capability parity with reference `saga/dsl.py:99-238`: required name /
+session_id / non-empty steps, unique step ids, step field validation,
+fan-out groups needing >=2 branches referencing declared steps, conversion
+to SagaStep objects, and a non-raising `validate()` collecting errors.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from hypervisor_tpu.saga.fan_out import FanOutPolicy
+from hypervisor_tpu.saga.state_machine import SagaStep
+
+
+class SagaDSLError(Exception):
+    """Invalid saga DSL definition."""
+
+
+@dataclass
+class SagaDSLStep:
+    id: str = ""
+    action_id: str = ""
+    agent: str = ""
+    execute_api: str = ""
+    undo_api: Optional[str] = None
+    timeout: int = 300
+    retries: int = 0
+    checkpoint_goal: Optional[str] = None
+
+
+@dataclass
+class SagaDSLFanOut:
+    policy: FanOutPolicy = FanOutPolicy.ALL_MUST_SUCCEED
+    branch_step_ids: list[str] = field(default_factory=list)
+
+
+@dataclass
+class SagaDefinition:
+    name: str = ""
+    session_id: str = ""
+    saga_id: str = field(default_factory=lambda: f"saga:{uuid.uuid4().hex[:8]}")
+    steps: list[SagaDSLStep] = field(default_factory=list)
+    fan_outs: list[SagaDSLFanOut] = field(default_factory=list)
+    metadata: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def step_ids(self) -> list[str]:
+        return [s.id for s in self.steps]
+
+    @property
+    def fan_out_step_ids(self) -> set[str]:
+        ids: set[str] = set()
+        for fo in self.fan_outs:
+            ids.update(fo.branch_step_ids)
+        return ids
+
+    @property
+    def sequential_steps(self) -> list[SagaDSLStep]:
+        """Steps outside every fan-out group (run in declaration order)."""
+        fo = self.fan_out_step_ids
+        return [s for s in self.steps if s.id not in fo]
+
+
+class SagaDSLParser:
+    """Validating parser from plain dicts (YAML-loaded or literal)."""
+
+    def parse(self, definition: dict[str, Any]) -> SagaDefinition:
+        """Parse or raise SagaDSLError on the first structural problem."""
+        name = definition.get("name", "")
+        if not name:
+            raise SagaDSLError("Saga definition must have a 'name'")
+        session_id = definition.get("session_id", "")
+        if not session_id:
+            raise SagaDSLError("Saga definition must have a 'session_id'")
+
+        raw_steps = definition.get("steps", [])
+        if not raw_steps:
+            raise SagaDSLError("Saga must have at least one step")
+
+        steps: list[SagaDSLStep] = []
+        seen: set[str] = set()
+        for raw in raw_steps:
+            step = self._parse_step(raw)
+            if step.id in seen:
+                raise SagaDSLError(f"Duplicate step ID: {step.id}")
+            seen.add(step.id)
+            steps.append(step)
+
+        fan_outs = [
+            self._parse_fan_out(raw, seen) for raw in definition.get("fan_out", [])
+        ]
+
+        return SagaDefinition(
+            name=name,
+            session_id=session_id,
+            saga_id=definition.get("saga_id", f"saga:{uuid.uuid4().hex[:8]}"),
+            steps=steps,
+            fan_outs=fan_outs,
+            metadata=definition.get("metadata", {}),
+        )
+
+    @staticmethod
+    def _parse_step(raw: dict) -> SagaDSLStep:
+        step_id = raw.get("id", "")
+        if not step_id:
+            raise SagaDSLError("Each step must have an 'id'")
+        action_id = raw.get("action_id", "")
+        if not action_id:
+            raise SagaDSLError(f"Step {step_id} must have an 'action_id'")
+        agent = raw.get("agent", "")
+        if not agent:
+            raise SagaDSLError(f"Step {step_id} must have an 'agent'")
+        return SagaDSLStep(
+            id=step_id,
+            action_id=action_id,
+            agent=agent,
+            execute_api=raw.get("execute_api", ""),
+            undo_api=raw.get("undo_api"),
+            timeout=raw.get("timeout", 300),
+            retries=raw.get("retries", 0),
+            checkpoint_goal=raw.get("checkpoint_goal"),
+        )
+
+    @staticmethod
+    def _parse_fan_out(raw: dict, valid_step_ids: set[str]) -> SagaDSLFanOut:
+        policy_str = raw.get("policy", "all_must_succeed")
+        try:
+            policy = FanOutPolicy(policy_str)
+        except ValueError as e:
+            raise SagaDSLError(
+                f"Invalid fan-out policy: {policy_str}. "
+                f"Valid: {[p.value for p in FanOutPolicy]}"
+            ) from e
+        branches = raw.get("branches", [])
+        if len(branches) < 2:
+            raise SagaDSLError("Fan-out must have at least 2 branches")
+        for bid in branches:
+            if bid not in valid_step_ids:
+                raise SagaDSLError(f"Fan-out branch '{bid}' is not a valid step ID")
+        return SagaDSLFanOut(policy=policy, branch_step_ids=branches)
+
+    @staticmethod
+    def to_saga_steps(definition: SagaDefinition) -> list[SagaStep]:
+        return [
+            SagaStep(
+                step_id=s.id,
+                action_id=s.action_id,
+                agent_did=s.agent,
+                execute_api=s.execute_api,
+                undo_api=s.undo_api,
+                timeout_seconds=s.timeout,
+                max_retries=s.retries,
+            )
+            for s in definition.steps
+        ]
+
+    @staticmethod
+    def validate(definition: dict[str, Any]) -> list[str]:
+        """Collect every structural error without raising (empty = valid)."""
+        errors: list[str] = []
+        if not definition.get("name"):
+            errors.append("Missing 'name'")
+        if not definition.get("session_id"):
+            errors.append("Missing 'session_id'")
+        if not definition.get("steps"):
+            errors.append("Missing 'steps'")
+            return errors
+        seen: set[str] = set()
+        for i, step in enumerate(definition["steps"]):
+            sid = step.get("id")
+            if not sid:
+                errors.append(f"Step {i} missing 'id'")
+            elif sid in seen:
+                errors.append(f"Duplicate step ID: {sid}")
+            else:
+                seen.add(sid)
+            if not step.get("action_id"):
+                errors.append(f"Step {sid or i} missing 'action_id'")
+            if not step.get("agent"):
+                errors.append(f"Step {sid or i} missing 'agent'")
+        return errors

@@ -1,0 +1,154 @@
+"""Parallel saga fan-out with ALL / MAJORITY / ANY failure policies.
+
+Capability parity with reference `saga/fan_out.py:73-192`: branches execute
+concurrently (asyncio.gather), the policy is evaluated over the success
+counts, and on policy failure every succeeded branch is routed to
+compensation. The policy evaluation itself is a pure reduction exported for
+the device plane (`evaluate_policy`), where a [groups, branches] success
+mask resolves all groups in one masked-sum op.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from hypervisor_tpu.saga.state_machine import SagaStep, StepState
+
+
+class FanOutPolicy(str, enum.Enum):
+    ALL_MUST_SUCCEED = "all_must_succeed"
+    MAJORITY_MUST_SUCCEED = "majority_must_succeed"
+    ANY_MUST_SUCCEED = "any_must_succeed"
+
+    @property
+    def code(self) -> int:
+        return {"all_must_succeed": 0, "majority_must_succeed": 1, "any_must_succeed": 2}[
+            self.value
+        ]
+
+
+def evaluate_policy(policy: FanOutPolicy, successes: int, total: int) -> bool:
+    """Pure policy reduction shared by host and device paths."""
+    if policy is FanOutPolicy.ALL_MUST_SUCCEED:
+        return successes == total
+    if policy is FanOutPolicy.MAJORITY_MUST_SUCCEED:
+        return successes > total / 2
+    return successes >= 1
+
+
+@dataclass
+class FanOutBranch:
+    branch_id: str = field(default_factory=lambda: f"branch:{uuid.uuid4().hex[:8]}")
+    step: Optional[SagaStep] = None
+    result: Any = None
+    error: Optional[str] = None
+    succeeded: bool = False
+
+
+@dataclass
+class FanOutGroup:
+    group_id: str = field(default_factory=lambda: f"fanout:{uuid.uuid4().hex[:8]}")
+    saga_id: str = ""
+    policy: FanOutPolicy = FanOutPolicy.ALL_MUST_SUCCEED
+    branches: list[FanOutBranch] = field(default_factory=list)
+    resolved: bool = False
+    policy_satisfied: bool = False
+    compensation_needed: list[str] = field(default_factory=list)
+
+    @property
+    def success_count(self) -> int:
+        return sum(1 for b in self.branches if b.succeeded)
+
+    @property
+    def failure_count(self) -> int:
+        return sum(1 for b in self.branches if not b.succeeded and b.error)
+
+    @property
+    def total_branches(self) -> int:
+        return len(self.branches)
+
+    def check_policy(self) -> bool:
+        return evaluate_policy(self.policy, self.success_count, self.total_branches)
+
+
+class FanOutOrchestrator:
+    """Runs fan-out groups and routes failed policies to compensation."""
+
+    def __init__(self) -> None:
+        self._groups: dict[str, FanOutGroup] = {}
+
+    def create_group(
+        self, saga_id: str, policy: FanOutPolicy = FanOutPolicy.ALL_MUST_SUCCEED
+    ) -> FanOutGroup:
+        group = FanOutGroup(saga_id=saga_id, policy=policy)
+        self._groups[group.group_id] = group
+        return group
+
+    def add_branch(self, group_id: str, step: SagaStep) -> FanOutBranch:
+        group = self._require_group(group_id)
+        branch = FanOutBranch(step=step)
+        group.branches.append(branch)
+        return branch
+
+    async def execute(
+        self,
+        group_id: str,
+        executors: dict[str, Callable[..., Any]],
+        timeout_seconds: int = 300,
+    ) -> FanOutGroup:
+        """Execute all branches concurrently, then settle the policy."""
+        group = self._require_group(group_id)
+
+        async def run(branch: FanOutBranch) -> None:
+            if branch.step is None:
+                branch.error = "No step assigned"
+                return
+            executor = executors.get(branch.step.step_id)
+            if executor is None:
+                branch.error = f"No executor for step {branch.step.step_id}"
+                return
+            try:
+                branch.step.transition(StepState.EXECUTING)
+                result = await asyncio.wait_for(
+                    executor(), timeout=branch.step.timeout_seconds
+                )
+                branch.result = result
+                branch.succeeded = True
+                branch.step.execute_result = result
+                branch.step.transition(StepState.COMMITTED)
+            except Exception as e:  # noqa: BLE001 — branch failures are data
+                branch.error = str(e)
+                branch.succeeded = False
+                branch.step.error = str(e)
+                branch.step.transition(StepState.FAILED)
+
+        await asyncio.wait_for(
+            asyncio.gather(*(run(b) for b in group.branches), return_exceptions=True),
+            timeout=timeout_seconds,
+        )
+
+        group.policy_satisfied = group.check_policy()
+        group.resolved = True
+        if not group.policy_satisfied:
+            # Winners must be rolled back when the group loses.
+            group.compensation_needed = [
+                b.step.step_id for b in group.branches if b.succeeded and b.step
+            ]
+        return group
+
+    def get_group(self, group_id: str) -> Optional[FanOutGroup]:
+        return self._groups.get(group_id)
+
+    def _require_group(self, group_id: str) -> FanOutGroup:
+        group = self._groups.get(group_id)
+        if group is None:
+            raise ValueError(f"Fan-out group {group_id} not found")
+        return group
+
+    @property
+    def active_groups(self) -> list[FanOutGroup]:
+        return [g for g in self._groups.values() if not g.resolved]

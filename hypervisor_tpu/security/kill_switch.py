@@ -1,0 +1,131 @@
+"""Kill switch: graceful agent termination with saga-step handoff.
+
+Capability parity with reference `security/kill_switch.py:64-180`: per-session
+substitute pools, each in-flight step handed to a substitute or marked
+COMPENSATED, killed agents removed from the pool, kill history retained.
+"""
+
+from __future__ import annotations
+
+import enum
+import uuid
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Optional
+
+from hypervisor_tpu.utils.clock import Clock, utc_now
+
+
+class KillReason(str, enum.Enum):
+    BEHAVIORAL_DRIFT = "behavioral_drift"
+    RATE_LIMIT = "rate_limit"
+    RING_BREACH = "ring_breach"
+    MANUAL = "manual"
+    QUARANTINE_TIMEOUT = "quarantine_timeout"
+    SESSION_TIMEOUT = "session_timeout"
+
+
+class HandoffStatus(str, enum.Enum):
+    PENDING = "pending"
+    HANDED_OFF = "handed_off"
+    FAILED = "failed"
+    COMPENSATED = "compensated"
+
+
+@dataclass
+class StepHandoff:
+    step_id: str
+    saga_id: str
+    from_agent: str
+    to_agent: Optional[str] = None
+    status: HandoffStatus = HandoffStatus.PENDING
+
+
+@dataclass
+class KillResult:
+    kill_id: str = field(default_factory=lambda: f"kill:{uuid.uuid4().hex[:8]}")
+    agent_did: str = ""
+    session_id: str = ""
+    reason: KillReason = KillReason.MANUAL
+    timestamp: datetime = field(default_factory=utc_now)
+    handoffs: list[StepHandoff] = field(default_factory=list)
+    handoff_success_count: int = 0
+    compensation_triggered: bool = False
+    details: str = ""
+
+
+class KillSwitch:
+    """Terminate an agent, rehoming its in-flight saga steps first."""
+
+    def __init__(self, clock: Clock = utc_now) -> None:
+        self._clock = clock
+        self._history: list[KillResult] = []
+        self._substitutes: dict[str, list[str]] = {}
+
+    def register_substitute(self, session_id: str, agent_did: str) -> None:
+        self._substitutes.setdefault(session_id, []).append(agent_did)
+
+    def unregister_substitute(self, session_id: str, agent_did: str) -> None:
+        pool = self._substitutes.get(session_id, [])
+        if agent_did in pool:
+            pool.remove(agent_did)
+
+    def kill(
+        self,
+        agent_did: str,
+        session_id: str,
+        reason: KillReason,
+        in_flight_steps: Optional[list[dict]] = None,
+        details: str = "",
+    ) -> KillResult:
+        """Kill with handoff: substitute per step, else route to compensation."""
+        handoffs: list[StepHandoff] = []
+        handed = 0
+        for info in in_flight_steps or ():
+            handoff = StepHandoff(
+                step_id=info.get("step_id", ""),
+                saga_id=info.get("saga_id", ""),
+                from_agent=agent_did,
+            )
+            substitute = self._find_substitute(session_id, agent_did)
+            if substitute is not None:
+                handoff.to_agent = substitute
+                handoff.status = HandoffStatus.HANDED_OFF
+                handed += 1
+            else:
+                handoff.status = HandoffStatus.COMPENSATED
+            handoffs.append(handoff)
+
+        result = KillResult(
+            agent_did=agent_did,
+            session_id=session_id,
+            reason=reason,
+            timestamp=self._clock(),
+            handoffs=handoffs,
+            handoff_success_count=handed,
+            compensation_triggered=any(
+                h.status is HandoffStatus.COMPENSATED for h in handoffs
+            ),
+            details=details,
+        )
+        self._history.append(result)
+        self.unregister_substitute(session_id, agent_did)
+        return result
+
+    def _find_substitute(self, session_id: str, exclude_did: str) -> Optional[str]:
+        for agent in self._substitutes.get(session_id, ()):
+            if agent != exclude_did:
+                return agent
+        return None
+
+    @property
+    def kill_history(self) -> list[KillResult]:
+        return list(self._history)
+
+    @property
+    def total_kills(self) -> int:
+        return len(self._history)
+
+    @property
+    def total_handoffs(self) -> int:
+        return sum(r.handoff_success_count for r in self._history)

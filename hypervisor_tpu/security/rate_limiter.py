@@ -1,0 +1,166 @@
+"""Per-agent per-ring token-bucket rate limiting.
+
+Capability parity with reference `security/rate_limiter.py:72-176`: per-ring
+defaults (Ring0 100rps/200 burst ... Ring3 5/10), raising `check` plus
+boolean `try_check`, bucket recreated full on ring change, per-agent stats.
+
+Array-native re-design: all buckets for a session wave live as two f32
+columns (tokens, last-refill) in the agent table; refill+consume is the
+branch-free update in `ops.rate_limit.consume` and this host class keeps
+per-(agent, session) scalar state with identical arithmetic for the
+single-call API.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Optional
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.models import ExecutionRing
+from hypervisor_tpu.utils.clock import Clock, utc_now
+
+
+class RateLimitExceeded(Exception):
+    """An agent exceeded its ring's request budget."""
+
+
+_cfg = DEFAULT_CONFIG.rate_limit
+DEFAULT_RING_LIMITS: dict[ExecutionRing, tuple[float, float]] = {
+    ring: (_cfg.ring_rates[ring.value], _cfg.ring_bursts[ring.value])
+    for ring in ExecutionRing
+}
+_FALLBACK_LIMIT = (20.0, 40.0)
+
+
+@dataclass
+class TokenBucket:
+    """Scalar token bucket (device twin: tokens/stamp columns + `ops.rate_limit`)."""
+
+    capacity: float
+    tokens: float
+    refill_rate: float
+    last_refill: datetime = field(default_factory=utc_now)
+    _clock: Clock = utc_now
+
+    def consume(self, tokens: float = 1.0) -> bool:
+        self._refill()
+        if self.tokens >= tokens:
+            self.tokens -= tokens
+            return True
+        return False
+
+    def _refill(self) -> None:
+        now = self._clock()
+        elapsed = (now - self.last_refill).total_seconds()
+        self.tokens = min(self.capacity, self.tokens + elapsed * self.refill_rate)
+        self.last_refill = now
+
+    @property
+    def available(self) -> float:
+        self._refill()
+        return self.tokens
+
+
+@dataclass
+class RateLimitStats:
+    agent_did: str
+    ring: ExecutionRing
+    total_requests: int = 0
+    rejected_requests: int = 0
+    tokens_available: float = 0.0
+    capacity: float = 0.0
+
+
+class AgentRateLimiter:
+    """Token buckets keyed by (agent, session), parameterized by ring."""
+
+    def __init__(
+        self,
+        ring_limits: Optional[dict[ExecutionRing, tuple[float, float]]] = None,
+        clock: Clock = utc_now,
+    ) -> None:
+        self._limits = ring_limits or dict(DEFAULT_RING_LIMITS)
+        self._clock = clock
+        self._buckets: dict[tuple[str, str], TokenBucket] = {}
+        self._stats: dict[tuple[str, str], RateLimitStats] = {}
+
+    def check(
+        self,
+        agent_did: str,
+        session_id: str,
+        ring: ExecutionRing,
+        cost: float = 1.0,
+    ) -> bool:
+        """Consume or raise RateLimitExceeded."""
+        key = (agent_did, session_id)
+        bucket = self._bucket(key, ring)
+        stats = self._stats.setdefault(
+            key, RateLimitStats(agent_did=agent_did, ring=ring)
+        )
+        stats.total_requests += 1
+        if not bucket.consume(cost):
+            stats.rejected_requests += 1
+            raise RateLimitExceeded(
+                f"Agent {agent_did} exceeded rate limit for ring "
+                f"{ring.value} ({stats.rejected_requests} rejections)"
+            )
+        return True
+
+    def try_check(
+        self,
+        agent_did: str,
+        session_id: str,
+        ring: ExecutionRing,
+        cost: float = 1.0,
+    ) -> bool:
+        """Non-raising variant."""
+        try:
+            return self.check(agent_did, session_id, ring, cost)
+        except RateLimitExceeded:
+            return False
+
+    def update_ring(
+        self, agent_did: str, session_id: str, new_ring: ExecutionRing
+    ) -> None:
+        """Ring change: bucket recreated at full burst for the new ring."""
+        key = (agent_did, session_id)
+        rate, capacity = self._limits.get(new_ring, _FALLBACK_LIMIT)
+        self._buckets[key] = TokenBucket(
+            capacity=capacity,
+            tokens=capacity,
+            refill_rate=rate,
+            last_refill=self._clock(),
+            _clock=self._clock,
+        )
+        if key in self._stats:
+            self._stats[key].ring = new_ring
+
+    def get_stats(self, agent_did: str, session_id: str) -> Optional[RateLimitStats]:
+        key = (agent_did, session_id)
+        stats = self._stats.get(key)
+        if stats is not None:
+            bucket = self._buckets.get(key)
+            if bucket is not None:
+                stats.tokens_available = bucket.available
+                stats.capacity = bucket.capacity
+        return stats
+
+    def _bucket(self, key: tuple[str, str], ring: ExecutionRing) -> TokenBucket:
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            rate, capacity = self._limits.get(ring, _FALLBACK_LIMIT)
+            bucket = TokenBucket(
+                capacity=capacity,
+                tokens=capacity,
+                refill_rate=rate,
+                last_refill=self._clock(),
+                _clock=self._clock,
+            )
+            self._buckets[key] = bucket
+        return bucket
+
+    @property
+    def tracked_agents(self) -> int:
+        return len(self._buckets)

@@ -1,0 +1,28 @@
+"""HBM-resident state tables: the SoA substrate of the TPU-native runtime."""
+
+from hypervisor_tpu.tables.intern import InternTable
+from hypervisor_tpu.tables.struct import replace, table
+from hypervisor_tpu.tables.state import (
+    AgentTable,
+    SessionTable,
+    VouchTable,
+    FLAG_ACTIVE,
+    FLAG_BLACKLISTED,
+    FLAG_BREAKER_TRIPPED,
+    FLAG_PROBATIONARY,
+    FLAG_QUARANTINED,
+)
+
+__all__ = [
+    "InternTable",
+    "replace",
+    "table",
+    "AgentTable",
+    "SessionTable",
+    "VouchTable",
+    "FLAG_ACTIVE",
+    "FLAG_BLACKLISTED",
+    "FLAG_BREAKER_TRIPPED",
+    "FLAG_PROBATIONARY",
+    "FLAG_QUARANTINED",
+]

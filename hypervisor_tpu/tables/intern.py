@@ -1,0 +1,50 @@
+"""Host-boundary string interning: DIDs / session ids / paths -> int32 handles.
+
+The device plane never sees strings. Every externally-visible identifier
+(agent DID, session id, vouch id, action id, VFS path) is interned to a dense
+int32 handle at the host boundary; device tables index by handle. This is the
+TPU-native replacement for the reference's string-keyed dicts (e.g.
+`session/__init__.py:46`, `liability/vouching.py:58`).
+"""
+
+from __future__ import annotations
+
+
+class InternTable:
+    """Bidirectional string <-> dense int32 handle registry (host side).
+
+    Handles are never reused; freeing is a mask-flip in the owning table,
+    not an intern-table operation, so handle -> string lookups stay valid
+    for audit/event queries after an entity dies.
+    """
+
+    __slots__ = ("_to_handle", "_to_string")
+
+    def __init__(self) -> None:
+        self._to_handle: dict[str, int] = {}
+        self._to_string: list[str] = []
+
+    def intern(self, s: str) -> int:
+        """Return the handle for `s`, allocating one if new."""
+        h = self._to_handle.get(s)
+        if h is None:
+            h = len(self._to_string)
+            self._to_handle[s] = h
+            self._to_string.append(s)
+        return h
+
+    def lookup(self, s: str) -> int:
+        """Return the handle for `s`, or -1 if never interned."""
+        return self._to_handle.get(s, -1)
+
+    def string(self, handle: int) -> str:
+        """Reverse lookup; raises IndexError on unknown handle."""
+        if handle < 0:
+            raise IndexError(f"invalid handle {handle}")
+        return self._to_string[handle]
+
+    def __len__(self) -> int:
+        return len(self._to_string)
+
+    def __contains__(self, s: str) -> bool:
+        return s in self._to_handle

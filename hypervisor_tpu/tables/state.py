@@ -1,0 +1,116 @@
+"""The governance state tables: agents, sessions, vouch edges.
+
+Replaces the reference's object graphs with fixed-capacity SoA arrays:
+ - participants dict        (`session/__init__.py:46`)   -> AgentTable rows
+ - session objects          (`core.py:92`)               -> SessionTable rows
+ - vouch records dict       (`liability/vouching.py:58`) -> VouchTable edge list
+
+All tables are jit-traceable pytrees; the agent and vouch axes are the
+sharding axes for multi-chip (see `hypervisor_tpu.parallel.sharding`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hypervisor_tpu.tables.struct import table
+
+# Agent-table flag bits (int32 bitmask column).
+FLAG_ACTIVE = 1 << 0
+FLAG_QUARANTINED = 1 << 1
+FLAG_BREAKER_TRIPPED = 1 << 2
+FLAG_BLACKLISTED = 1 << 3
+FLAG_PROBATIONARY = 1 << 4
+
+
+@table
+class AgentTable:
+    """[N_agents] columns. Row index == agent slot; `did` maps slot -> intern handle."""
+
+    did: jnp.ndarray          # i32[N]  intern handle of agent DID (-1 = free slot)
+    session: jnp.ndarray      # i32[N]  session slot the agent sits in (-1 = none)
+    sigma_raw: jnp.ndarray    # f32[N]
+    sigma_eff: jnp.ndarray    # f32[N]
+    ring: jnp.ndarray         # i8[N]   0..3
+    flags: jnp.ndarray        # i32[N]  FLAG_* bitmask
+    joined_at: jnp.ndarray    # f32[N]  unix seconds rel. to epoch_base (host-supplied)
+    risk_score: jnp.ndarray   # f32[N]  liability-ledger accumulator
+    rl_tokens: jnp.ndarray    # f32[N]  rate-limiter token bucket level
+    rl_stamp: jnp.ndarray     # f32[N]  last refill time
+
+    @staticmethod
+    def create(capacity: int) -> "AgentTable":
+        return AgentTable(
+            did=jnp.full((capacity,), -1, jnp.int32),
+            session=jnp.full((capacity,), -1, jnp.int32),
+            sigma_raw=jnp.zeros((capacity,), jnp.float32),
+            sigma_eff=jnp.zeros((capacity,), jnp.float32),
+            ring=jnp.full((capacity,), 3, jnp.int8),
+            flags=jnp.zeros((capacity,), jnp.int32),
+            joined_at=jnp.zeros((capacity,), jnp.float32),
+            risk_score=jnp.zeros((capacity,), jnp.float32),
+            rl_tokens=jnp.zeros((capacity,), jnp.float32),
+            rl_stamp=jnp.zeros((capacity,), jnp.float32),
+        )
+
+
+@table
+class SessionTable:
+    """[S_sessions] columns mirroring SessionConfig + lifecycle state."""
+
+    sid: jnp.ndarray              # i32[S] intern handle of session id (-1 = free)
+    state: jnp.ndarray            # i8[S]  SessionState.code
+    mode: jnp.ndarray             # i8[S]  ConsistencyMode.code
+    max_participants: jnp.ndarray # i32[S]
+    min_sigma_eff: jnp.ndarray    # f32[S]
+    enable_audit: jnp.ndarray     # bool[S]
+    n_participants: jnp.ndarray   # i32[S] active-participant count
+    created_at: jnp.ndarray       # f32[S]
+    terminated_at: jnp.ndarray    # f32[S]
+    has_nonreversible: jnp.ndarray  # bool[S] drives STRONG forcing
+
+    @staticmethod
+    def create(capacity: int) -> "SessionTable":
+        z32 = jnp.zeros((capacity,), jnp.float32)
+        return SessionTable(
+            sid=jnp.full((capacity,), -1, jnp.int32),
+            state=jnp.zeros((capacity,), jnp.int8),
+            mode=jnp.ones((capacity,), jnp.int8),  # EVENTUAL
+            max_participants=jnp.full((capacity,), 10, jnp.int32),
+            min_sigma_eff=jnp.full((capacity,), 0.60, jnp.float32),
+            enable_audit=jnp.ones((capacity,), bool),
+            n_participants=jnp.zeros((capacity,), jnp.int32),
+            created_at=z32,
+            terminated_at=z32,
+            has_nonreversible=jnp.zeros((capacity,), bool),
+        )
+
+
+@table
+class VouchTable:
+    """[E] vouch edges: the liability graph as an edge list.
+
+    Exposure queries are `segment_sum` over `voucher`; sigma_eff voucher
+    contributions are `segment_sum` over `vouchee`; cascade slashing is a
+    bounded sequence of masked edge passes (`ops.liability`).
+    """
+
+    voucher: jnp.ndarray   # i32[E] agent slot (-1 = free edge)
+    vouchee: jnp.ndarray   # i32[E] agent slot
+    session: jnp.ndarray   # i32[E] session slot
+    bond_pct: jnp.ndarray  # f32[E]
+    bond: jnp.ndarray      # f32[E] absolute sigma locked
+    active: jnp.ndarray    # bool[E]
+    expiry: jnp.ndarray    # f32[E] unix seconds; +inf = never
+
+    @staticmethod
+    def create(capacity: int) -> "VouchTable":
+        return VouchTable(
+            voucher=jnp.full((capacity,), -1, jnp.int32),
+            vouchee=jnp.full((capacity,), -1, jnp.int32),
+            session=jnp.full((capacity,), -1, jnp.int32),
+            bond_pct=jnp.zeros((capacity,), jnp.float32),
+            bond=jnp.zeros((capacity,), jnp.float32),
+            active=jnp.zeros((capacity,), bool),
+            expiry=jnp.full((capacity,), jnp.inf, jnp.float32),
+        )

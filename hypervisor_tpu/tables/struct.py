@@ -1,0 +1,34 @@
+"""Pytree table plumbing for the structure-of-arrays substrate.
+
+The reference keeps object-per-entity dicts (`dict[str, VouchRecord]` etc.);
+the TPU design inverts that into fixed-capacity arrays with active-masks so
+every per-agent / per-edge computation is one batched XLA op. Each table is a
+frozen dataclass registered as a JAX pytree: jit-traceable, shardable with
+`NamedSharding`, donat-able.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TypeVar
+
+import jax
+
+T = TypeVar("T")
+
+
+def table(cls: type[T]) -> type[T]:
+    """Decorator: frozen dataclass registered as a JAX pytree node.
+
+    All fields are data (leaves). Use plain Python ints/floats only through
+    `static` metadata if ever needed — tables here are pure array bundles.
+    """
+    cls = dataclasses.dataclass(frozen=True)(cls)
+    fields = [f.name for f in dataclasses.fields(cls)]
+    jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=[])
+    return cls
+
+
+def replace(obj: T, **changes) -> T:
+    """dataclasses.replace for table instances."""
+    return dataclasses.replace(obj, **changes)

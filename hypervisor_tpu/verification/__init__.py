@@ -1,0 +1,157 @@
+"""DID transaction-history verification for the admission handshake.
+
+Capability parity with reference `verification/history.py:53-161`: no/short
+history -> PROBATIONARY (depth threshold 5), declared-history consistency
+checks (duplicate summary hashes, non-monotonic timestamps, hashes shorter
+than 16 chars -> SUSPICIOUS), per-DID result caching, and
+`is_trustworthy` = VERIFIED or PROBATIONARY (untrustworthy agents get
+forced to Ring 3 at join in the facade).
+
+The consistency pass is vectorized over the declared history columns so a
+batch of admission handshakes can be verified in one sweep.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Optional
+
+import numpy as np
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.utils.clock import utc_now
+
+__all__ = [
+    "VerificationStatus",
+    "TransactionRecord",
+    "VerificationResult",
+    "TransactionHistoryVerifier",
+]
+
+
+class VerificationStatus(str, enum.Enum):
+    VERIFIED = "verified"
+    PROBATIONARY = "probationary"
+    SUSPICIOUS = "suspicious"
+    UNREACHABLE = "unreachable"
+    UNKNOWN = "unknown"
+
+
+@dataclass
+class TransactionRecord:
+    session_id: str
+    summary_hash: str
+    timestamp: datetime
+    participant_count: int = 0
+
+
+@dataclass
+class VerificationResult:
+    agent_did: str
+    status: VerificationStatus
+    transactions_checked: int
+    transactions_found: int
+    inconsistencies: list[str] = field(default_factory=list)
+    verified_at: datetime = field(default_factory=utc_now)
+    cached: bool = False
+
+    @property
+    def is_trustworthy(self) -> bool:
+        return self.status in (
+            VerificationStatus.VERIFIED,
+            VerificationStatus.PROBATIONARY,
+        )
+
+
+class TransactionHistoryVerifier:
+    """Handshake-time history checker with per-DID caching."""
+
+    REQUIRED_HISTORY_DEPTH = DEFAULT_CONFIG.verifier.min_history_depth
+    MIN_HASH_LENGTH = DEFAULT_CONFIG.verifier.min_hash_length
+
+    def __init__(self) -> None:
+        self._cache: dict[str, VerificationResult] = {}
+
+    def verify(
+        self,
+        agent_did: str,
+        declared_history: Optional[list[TransactionRecord]] = None,
+    ) -> VerificationResult:
+        """Verify a DID's declared history (cached per DID)."""
+        cached = self._cache.get(agent_did)
+        if cached is not None:
+            cached.cached = True
+            return cached
+
+        n = len(declared_history) if declared_history else 0
+        if n == 0:
+            result = VerificationResult(
+                agent_did=agent_did,
+                status=VerificationStatus.PROBATIONARY,
+                transactions_checked=0,
+                transactions_found=0,
+                inconsistencies=["No transaction history available"],
+            )
+        elif n < self.REQUIRED_HISTORY_DEPTH:
+            result = VerificationResult(
+                agent_did=agent_did,
+                status=VerificationStatus.PROBATIONARY,
+                transactions_checked=n,
+                transactions_found=n,
+                inconsistencies=[
+                    f"Only {n} transactions (need {self.REQUIRED_HISTORY_DEPTH})"
+                ],
+            )
+        else:
+            issues = self._consistency_issues(declared_history)
+            result = VerificationResult(
+                agent_did=agent_did,
+                status=(
+                    VerificationStatus.SUSPICIOUS
+                    if issues
+                    else VerificationStatus.VERIFIED
+                ),
+                transactions_checked=n,
+                transactions_found=n,
+                inconsistencies=issues,
+            )
+
+        self._cache[agent_did] = result
+        return result
+
+    def clear_cache(self, agent_did: Optional[str] = None) -> None:
+        if agent_did:
+            self._cache.pop(agent_did, None)
+        else:
+            self._cache.clear()
+
+    def _consistency_issues(self, history: list[TransactionRecord]) -> list[str]:
+        """Vectorized consistency sweep over the declared history."""
+        issues: list[str] = []
+
+        # Duplicate summary hashes across sessions.
+        seen: dict[str, str] = {}
+        for tx in history:
+            if tx.summary_hash in seen:
+                issues.append(
+                    f"Duplicate hash in sessions {seen[tx.summary_hash]} "
+                    f"and {tx.session_id}"
+                )
+            seen[tx.summary_hash] = tx.session_id
+
+        # Temporal ordering: one vector compare over the timestamp column.
+        ts = np.array([tx.timestamp.timestamp() for tx in history])
+        for i in np.nonzero(ts[1:] < ts[:-1])[0]:
+            issues.append(
+                f"Non-monotonic timestamps: {history[i + 1].session_id} "
+                f"predates {history[i].session_id}"
+            )
+
+        # Malformed hashes.
+        for tx in history:
+            if not tx.summary_hash or len(tx.summary_hash) < self.MIN_HASH_LENGTH:
+                issues.append(f"Invalid hash in session {tx.session_id}")
+
+        return issues

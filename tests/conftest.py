@@ -1,0 +1,42 @@
+"""Test harness config: force CPU with an 8-device virtual mesh.
+
+Per the test strategy (SURVEY §4): kernels parity-test against scalar
+reference semantics on CPU; multi-chip sharding tests run against
+xla_force_host_platform_device_count=8 without hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio
+import inspect
+
+import pytest
+
+# Persistent XLA compilation cache: first run pays compile, reruns are fast.
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal asyncio_mode=auto: run bare async test functions."""
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        sig = inspect.signature(func)
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in sig.parameters
+            if name in pyfuncitem.funcargs
+        }
+        asyncio.run(func(**kwargs))
+        return True
+    return None

@@ -1,0 +1,217 @@
+"""End-to-end lifecycle through the Hypervisor facade.
+
+Mirrors the reference's e2e coverage (`tests/integration/
+test_hypervisor_e2e.py`): create -> join -> activate -> capture ->
+terminate with a 64-char Merkle root; saga timeout/retry/compensation;
+tamper detection; GC purge; admission edge cases.
+"""
+
+import asyncio
+
+import pytest
+
+from hypervisor_tpu import (
+    ActionDescriptor,
+    ConsistencyMode,
+    ExecutionRing,
+    Hypervisor,
+    ReversibilityLevel,
+    SessionConfig,
+    SessionParticipantError,
+    VFSChange,
+)
+from hypervisor_tpu.saga import SagaState, SagaTimeoutError, StepState
+
+
+@pytest.fixture
+def hv():
+    return Hypervisor()
+
+
+async def make_active_session(hv, n_agents=1, sigma=0.8, **config_kw):
+    session = await hv.create_session(
+        config=SessionConfig(**config_kw), creator_did="did:mesh:admin"
+    )
+    sid = session.sso.session_id
+    for i in range(n_agents):
+        await hv.join_session(sid, f"did:mesh:agent-{i}", sigma_raw=sigma)
+    await hv.activate_session(sid)
+    return session, sid
+
+
+class TestLifecycle:
+    async def test_full_lifecycle_with_merkle_root(self, hv):
+        session, sid = await make_active_session(hv)
+        for turn in range(3):
+            session.delta_engine.capture(
+                "did:mesh:agent-0",
+                [VFSChange(path=f"/f{turn}.md", operation="add", content_hash="a" * 64)],
+            )
+        root = await hv.terminate_session(sid)
+        assert root is not None and len(root) == 64
+        assert hv.commitment.verify(sid, root)
+        assert session.sso.state.value == "archived"
+
+    async def test_audit_disabled_returns_none(self, hv):
+        session, sid = await make_active_session(hv, enable_audit=False)
+        session.delta_engine.capture("did:mesh:agent-0", [])
+        root = await hv.terminate_session(sid)
+        assert root is None
+
+    async def test_join_assigns_ring_from_sigma(self, hv):
+        session = await hv.create_session(SessionConfig(), "did:mesh:admin")
+        sid = session.sso.session_id
+        ring = await hv.join_session(sid, "did:mesh:good", sigma_raw=0.85)
+        assert ring == ExecutionRing.RING_2_STANDARD
+        ring = await hv.join_session(sid, "did:mesh:weak", sigma_raw=0.30)
+        assert ring == ExecutionRing.RING_3_SANDBOX
+
+    async def test_duplicate_join_rejected(self, hv):
+        session, sid = await make_active_session(hv)
+        with pytest.raises(SessionParticipantError):
+            await hv.join_session(sid, "did:mesh:agent-0", sigma_raw=0.8)
+
+    async def test_max_participants(self, hv):
+        session = await hv.create_session(
+            SessionConfig(max_participants=2), "did:mesh:admin"
+        )
+        sid = session.sso.session_id
+        await hv.join_session(sid, "did:mesh:a", sigma_raw=0.8)
+        await hv.join_session(sid, "did:mesh:b", sigma_raw=0.8)
+        with pytest.raises(SessionParticipantError):
+            await hv.join_session(sid, "did:mesh:c", sigma_raw=0.8)
+
+    async def test_nonreversible_actions_force_strong_mode(self, hv):
+        session = await hv.create_session(SessionConfig(), "did:mesh:admin")
+        sid = session.sso.session_id
+        actions = [
+            ActionDescriptor(
+                action_id="deploy",
+                name="Deploy",
+                execute_api="/api/deploy",
+                reversibility=ReversibilityLevel.NONE,
+            )
+        ]
+        await hv.join_session(sid, "did:mesh:a", actions=actions, sigma_raw=0.8)
+        assert session.sso.consistency_mode == ConsistencyMode.STRONG
+
+
+class TestSagaE2E:
+    async def test_step_timeout(self, hv):
+        session, sid = await make_active_session(hv)
+        saga = session.saga.create_saga(sid)
+        step = session.saga.add_step(
+            saga.saga_id, "slow", "did:mesh:agent-0", "/api/slow", timeout_seconds=1
+        )
+
+        async def slow():
+            await asyncio.sleep(10)
+
+        with pytest.raises(SagaTimeoutError):
+            await session.saga.execute_step(saga.saga_id, step.step_id, slow)
+        assert step.state == StepState.FAILED
+
+    async def test_retry_succeeds_on_third_attempt(self, hv):
+        session, sid = await make_active_session(hv)
+        saga = session.saga.create_saga(sid)
+        step = session.saga.add_step(
+            saga.saga_id, "flaky", "did:mesh:agent-0", "/api/flaky", max_retries=2
+        )
+        calls = {"n": 0}
+
+        async def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("boom")
+            return "ok"
+
+        # Shrink backoff so the test runs fast.
+        session.saga.DEFAULT_RETRY_DELAY_SECONDS = 0.01
+        result = await session.saga.execute_step(saga.saga_id, step.step_id, flaky)
+        assert result == "ok" and calls["n"] == 3
+        assert step.state == StepState.COMMITTED
+
+    async def test_reverse_order_compensation(self, hv):
+        session, sid = await make_active_session(hv)
+        saga = session.saga.create_saga(sid)
+        s1 = session.saga.add_step(
+            saga.saga_id, "step1", "did:mesh:agent-0", "/api/1", undo_api="/undo/1"
+        )
+        s2 = session.saga.add_step(
+            saga.saga_id, "step2", "did:mesh:agent-0", "/api/2", undo_api="/undo/2"
+        )
+        for s in (s1, s2):
+            async def ok():
+                return "done"
+            await session.saga.execute_step(saga.saga_id, s.step_id, ok)
+
+        order = []
+
+        async def compensator(step):
+            order.append(step.action_id)
+            return "undone"
+
+        failed = await session.saga.compensate(saga.saga_id, compensator)
+        assert failed == []
+        assert order == ["step2", "step1"]
+        assert saga.state == SagaState.COMPLETED
+
+    async def test_escalation_on_missing_undo(self, hv):
+        session, sid = await make_active_session(hv)
+        saga = session.saga.create_saga(sid)
+        step = session.saga.add_step(
+            saga.saga_id, "noundo", "did:mesh:agent-0", "/api/x"
+        )
+
+        async def ok():
+            return "done"
+
+        await session.saga.execute_step(saga.saga_id, step.step_id, ok)
+
+        async def compensator(step):
+            return "undone"
+
+        failed = await session.saga.compensate(saga.saga_id, compensator)
+        assert len(failed) == 1
+        assert saga.state == SagaState.ESCALATED
+        assert "Joint Liability slashing triggered" in saga.error
+
+
+class TestTamperDetection:
+    async def test_verify_chain_detects_mutation(self, hv):
+        session, sid = await make_active_session(hv)
+        for i in range(4):
+            session.delta_engine.capture(
+                "did:mesh:agent-0",
+                [VFSChange(path=f"/f{i}", operation="add", content_hash="c" * 64)],
+            )
+        assert session.delta_engine.verify_chain()
+        # Mutate a stored delta's content.
+        session.delta_engine.deltas  # view copy
+        session.delta_engine._deltas[1].agent_did = "did:mesh:attacker"
+        assert not session.delta_engine.verify_chain()
+
+    async def test_verify_chain_detects_tail_mutation(self, hv):
+        session, sid = await make_active_session(hv)
+        for i in range(3):
+            session.delta_engine.capture("did:mesh:agent-0", [])
+        session.delta_engine._deltas[-1].agent_did = "did:mesh:attacker"
+        assert not session.delta_engine.verify_chain()
+
+
+class TestGCIntegration:
+    async def test_gc_purges_vfs_on_terminate(self, hv):
+        session, sid = await make_active_session(hv)
+        session.sso.vfs.write("/report.md", "data", agent_did="did:mesh:agent-0")
+        session.sso.vfs.write("/notes.md", "more", agent_did="did:mesh:agent-0")
+        assert session.sso.vfs.file_count == 2
+        await hv.terminate_session(sid)
+        assert hv.gc.is_purged(sid)
+        assert session.sso.vfs.file_count == 0  # actually purged
+
+    async def test_cross_session_exposure_isolated(self, hv):
+        s1, sid1 = await make_active_session(hv)
+        s2, sid2 = await make_active_session(hv)
+        hv.vouching.vouch("did:mesh:v", "did:mesh:agent-0", sid1, 0.9, bond_pct=0.5)
+        assert hv.vouching.get_total_exposure("did:mesh:v", sid1) > 0
+        assert hv.vouching.get_total_exposure("did:mesh:v", sid2) == 0.0

@@ -1,0 +1,91 @@
+"""Parity: batched ring ops vs the scalar facade vs reference semantics."""
+
+import itertools
+
+import numpy as np
+
+from hypervisor_tpu.models import ActionDescriptor, ExecutionRing, ReversibilityLevel
+from hypervisor_tpu.ops import rings as ring_ops
+from hypervisor_tpu.rings import RingEnforcer
+
+
+class TestComputeRings:
+    def test_thresholds_match_reference(self):
+        # Boundary semantics per reference models.py:34-42 (strict >).
+        sigmas = np.array([0.0, 0.3, 0.60, 0.601, 0.95, 0.951, 1.0], np.float32)
+        rings = np.asarray(ring_ops.compute_rings(sigmas, False))
+        assert rings.tolist() == [3, 3, 3, 2, 2, 2, 2]
+        rings_c = np.asarray(ring_ops.compute_rings(sigmas, True))
+        assert rings_c.tolist() == [3, 3, 3, 2, 2, 1, 1]
+
+    def test_scalar_enum_agrees_with_batch(self):
+        # Feed identical float32 values to both paths (the device columns
+        # are f32; comparing a raw float64 would differ at the thresholds).
+        for sigma in np.linspace(0, 1, 21).astype(np.float32):
+            for consensus in (False, True):
+                scalar = ExecutionRing.from_sigma_eff(float(sigma), consensus).value
+                batch = int(
+                    np.asarray(ring_ops.compute_rings(np.float32(sigma), consensus))
+                )
+                assert scalar == batch, (sigma, consensus)
+
+
+class TestRingCheckParity:
+    def test_batch_matches_scalar_facade(self):
+        """Exhaustive sweep: the device op and the host scalar path agree."""
+        enforcer = RingEnforcer()
+        combos = list(
+            itertools.product(
+                range(4),                      # agent ring
+                [True, False],                 # is_admin
+                list(ReversibilityLevel),      # reversibility
+                [True, False],                 # is_read_only
+                [0.3, 0.7, 0.96],              # sigma
+                [True, False],                 # consensus
+                [True, False],                 # witness
+            )
+        )
+        agent_rings, requireds, sigmas, cons, wits, scalar_codes = [], [], [], [], [], []
+        for ar, admin, rev, ro, sigma, consensus, witness in combos:
+            action = ActionDescriptor(
+                action_id="a",
+                name="a",
+                execute_api="/x",
+                reversibility=rev,
+                is_read_only=ro,
+                is_admin=admin,
+            )
+            result = enforcer.check(
+                ExecutionRing(ar), action, sigma, consensus, witness
+            )
+            scalar_codes.append(
+                enforcer._check_code(ar, action.required_ring.value, sigma, consensus, witness)
+            )
+            assert result.allowed == (scalar_codes[-1] == ring_ops.CHECK_OK)
+            agent_rings.append(ar)
+            requireds.append(action.required_ring.value)
+            sigmas.append(sigma)
+            cons.append(consensus)
+            wits.append(witness)
+
+        batch_codes = np.asarray(
+            ring_ops.ring_check(
+                np.array(agent_rings, np.int8),
+                np.array(requireds, np.int8),
+                np.array(sigmas, np.float32),
+                np.array(cons),
+                np.array(wits),
+            )
+        )
+        assert batch_codes.tolist() == scalar_codes
+
+    def test_should_demote_parity(self):
+        enforcer = RingEnforcer()
+        rings = np.array([1, 1, 2, 2, 3, 3], np.int8)
+        sigmas = np.array([0.99, 0.5, 0.7, 0.3, 0.1, 0.9], np.float32)
+        batch = np.asarray(ring_ops.should_demote(rings, sigmas))
+        scalar = [
+            enforcer.should_demote(ExecutionRing(int(r)), float(s))
+            for r, s in zip(rings, sigmas)
+        ]
+        assert batch.tolist() == scalar

@@ -63,6 +63,33 @@ def merkle_root(digests: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
     return arr[0]
 
 
+def merkle_root_lanes(digests: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
+    """Per-lane Merkle roots: u32[S, P, 8] leaves -> u32[S, 8] roots.
+
+    Same odd-duplication semantics as `merkle_root`, with the S session
+    lanes flattened into the hash batch at every level so the VPU sees one
+    [S * P/2] wave per level instead of S tiny trees.
+    """
+    s, p, _ = digests.shape
+    assert p & (p - 1) == 0
+    arr = digests
+    cnt = jnp.broadcast_to(jnp.asarray(count, jnp.int32), (s,))
+    while arr.shape[1] > 1:
+        half = arr.shape[1] // 2
+        left = arr[:, 0::2]
+        right = arr[:, 1::2]
+        j = jnp.arange(half, dtype=jnp.int32)
+        dup = (2 * j[None, :] + 1) >= cnt[:, None]
+        right = jnp.where(dup[:, :, None], left, right)
+        combined = sha256_hex_pair(
+            left.reshape(s * half, 8), right.reshape(s * half, 8)
+        ).reshape(s, half, 8)
+        descend = (cnt > 1)[:, None, None]
+        arr = jnp.where(descend, combined, left)
+        cnt = jnp.where(cnt > 1, (cnt + 1) // 2, cnt)
+    return arr[:, 0]
+
+
 def chain_digests(
     bodies: jnp.ndarray, seed: jnp.ndarray | None = None
 ) -> jnp.ndarray:
@@ -82,7 +109,9 @@ def chain_digests(
     """
     n, lanes, _ = bodies.shape
     if seed is None:
-        seed = jnp.zeros((lanes, 8), jnp.uint32)
+        # Varying zeros (derived from bodies) so the scan carry type is
+        # consistent under shard_map.
+        seed = bodies[0, :, :8] & jnp.uint32(0)
     tail = jnp.broadcast_to(
         jnp.asarray(_CHAIN_TAIL, jnp.uint32), (lanes, _CHAIN_TAIL.shape[0])
     )

@@ -1,0 +1,126 @@
+"""The fused full-governance-pipeline op: 10k sessions per device tick.
+
+Reference benchmark semantics (`benchmarks/bench_hypervisor.py:217-239`):
+one pipeline = session create + 1 agent join + activate + 3 audit delta
+captures + 1-step saga execute + terminate with Merkle root. The reference
+runs this one session at a time in Python at 267.5 µs p50; here S
+independent session lanes run the whole pipeline as ONE jitted XLA program
+with no host work in the loop:
+
+  1. admission — history-verified trust gate, sigma -> ring (f32 columns)
+  2. session FSM — CREATED -> HANDSHAKING -> ACTIVE -> TERMINATING ->
+     ARCHIVED as masked int8 column updates (illegal transitions surface
+     as per-lane status codes, never Python exceptions)
+  3. audit — T binary delta bodies per lane, chain-hashed with a
+     `lax.scan` carry (SHA-256 on u32 lanes), then per-lane Merkle roots
+  4. saga — one-step execute through the transition-matrix gather
+  5. STRONG-mode consensus — a `psum` over the mesh agent axis
+     (cross-chip allreduce on ICI) of the session aggregates, applied
+     under `shard_map` in `parallel.collectives`
+
+All shapes static; lanes that represent "no session" are masked out by
+`active`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG, TrustConfig
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.ops import rings as ring_ops
+from hypervisor_tpu.ops import saga_ops
+
+# Per-lane status codes for the batched pipeline (host may re-raise).
+PIPE_OK = 0
+PIPE_SIGMA_BELOW_MIN = 1
+PIPE_INACTIVE = 2
+
+
+class PipelineResult(NamedTuple):
+    """One governance tick's outputs, all [S]-shaped (roots [S, 8])."""
+
+    ring: jnp.ndarray           # i8[S]  ring assigned at join
+    sigma_eff: jnp.ndarray      # f32[S]
+    session_state: jnp.ndarray  # i8[S]  == ARCHIVED for successful lanes
+    saga_step_state: jnp.ndarray  # i8[S] == COMMITTED
+    merkle_root: jnp.ndarray    # u32[S, 8]
+    status: jnp.ndarray         # i8[S]  PIPE_* codes
+    consensus: jnp.ndarray      # f32[4] global aggregates (see below)
+
+
+# Session FSM codes (models.SessionState order).
+S_CREATED, S_HANDSHAKING, S_ACTIVE, S_TERMINATING, S_ARCHIVED = range(5)
+
+
+def governance_pipeline(
+    sigma_raw: jnp.ndarray,       # f32[S] joining agent's raw sigma
+    trustworthy: jnp.ndarray,     # bool[S] history-verification outcome
+    min_sigma_eff: jnp.ndarray,   # f32[S] per-session admission floor
+    delta_bodies: jnp.ndarray,    # u32[T, S, BODY_WORDS] binary delta records
+    active: jnp.ndarray,          # bool[S] lane mask
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+) -> PipelineResult:
+    """Run the full governance pipeline for S session lanes on device."""
+    s = sigma_raw.shape[0]
+    t = delta_bodies.shape[0]
+
+    # ── 1. admission: sigma -> ring; untrustworthy agents sandboxed ──
+    sigma_eff = sigma_raw
+    ring = ring_ops.compute_rings(sigma_eff, False, trust)
+    ring = jnp.where(trustworthy, ring, jnp.int8(3))
+    # Non-sandbox joins must clear the session sigma floor
+    # (`session/__init__.py:101-104`).
+    sigma_bad = (sigma_eff < min_sigma_eff) & (ring != 3)
+    status = jnp.where(
+        ~active,
+        jnp.int8(PIPE_INACTIVE),
+        jnp.where(sigma_bad, jnp.int8(PIPE_SIGMA_BELOW_MIN), jnp.int8(PIPE_OK)),
+    )
+    ok = status == PIPE_OK
+
+    # ── 2. session FSM forward walk (masked column updates) ─────────
+    state = jnp.full((s,), S_CREATED, jnp.int8)
+    state = jnp.where(ok, S_HANDSHAKING, state).astype(jnp.int8)  # begin_handshake
+    state = jnp.where(ok, S_ACTIVE, state).astype(jnp.int8)       # activate (1 participant)
+
+    # ── 3. audit: chain-hash T deltas per lane, then Merkle root ─────
+    digests = merkle_ops.chain_digests(delta_bodies)              # u32[T, S, 8]
+    p = 1 << max(0, (t - 1).bit_length())
+    leaves = jnp.zeros((s, p, 8), jnp.uint32)
+    leaves = leaves.at[:, :t].set(jnp.transpose(digests, (1, 0, 2)))
+    roots = merkle_ops.merkle_root_lanes(leaves, jnp.int32(t))    # u32[S, 8]
+
+    # ── 4. saga: one noop step through the retry ladder ──────────────
+    step_state = jnp.full((s,), saga_ops.STEP_PENDING, jnp.int8)
+    step_state, _ = saga_ops.execute_attempt(
+        step_state, success=ok, retries_left=jnp.zeros((s,), jnp.int8)
+    )
+
+    # ── 5. terminate + archive ───────────────────────────────────────
+    state = jnp.where(ok, S_TERMINATING, state).astype(jnp.int8)
+    state = jnp.where(ok, S_ARCHIVED, state).astype(jnp.int8)
+
+    # ── consensus aggregates (STRONG mode: psum'd over the mesh in
+    #    parallel.collectives.strong_tick) ─────────────────────────────
+    okf = ok.astype(jnp.float32)
+    consensus = jnp.stack(
+        [
+            jnp.sum(okf),                                   # sessions completed
+            jnp.sum(sigma_eff * okf),                       # total sigma admitted
+            jnp.sum((ring.astype(jnp.float32)) * okf),      # ring mass
+            jnp.sum(roots[:, 0].astype(jnp.float32) * okf), # root checksum word
+        ]
+    )
+
+    return PipelineResult(
+        ring=ring,
+        sigma_eff=sigma_eff,
+        session_state=state,
+        saga_step_state=step_state,
+        merkle_root=roots,
+        status=status,
+        consensus=consensus,
+    )

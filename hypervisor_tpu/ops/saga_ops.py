@@ -1,0 +1,141 @@
+"""Batched saga state-machine ops.
+
+The reference validates transitions one step at a time via dict lookups
+(`saga/state_machine.py:78-96`); here a whole saga table advances in one
+gather: `STEP_TRANSITION_MATRIX[from, to]` over int8 state columns. Retry
+ladders and fan-out policies are masked arithmetic — no Python in the loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hypervisor_tpu.saga.state_machine import (
+    SAGA_TRANSITION_MATRIX,
+    STEP_TRANSITION_MATRIX,
+)
+
+# Step-state codes (order of saga.state_machine.StepState).
+STEP_PENDING = 0
+STEP_EXECUTING = 1
+STEP_COMMITTED = 2
+STEP_COMPENSATING = 3
+STEP_COMPENSATED = 4
+STEP_COMPENSATION_FAILED = 5
+STEP_FAILED = 6
+
+SAGA_RUNNING = 0
+SAGA_COMPENSATING = 1
+SAGA_COMPLETED = 2
+SAGA_FAILED = 3
+SAGA_ESCALATED = 4
+
+
+def step_transition_valid(frm: jnp.ndarray, to: jnp.ndarray) -> jnp.ndarray:
+    """bool[...]: legality of each step transition (matrix gather)."""
+    m = jnp.asarray(STEP_TRANSITION_MATRIX)
+    return m[frm.astype(jnp.int32), to.astype(jnp.int32)] == 1
+
+
+def saga_transition_valid(frm: jnp.ndarray, to: jnp.ndarray) -> jnp.ndarray:
+    m = jnp.asarray(SAGA_TRANSITION_MATRIX)
+    return m[frm.astype(jnp.int32), to.astype(jnp.int32)] == 1
+
+
+def apply_step_transitions(
+    state: jnp.ndarray, target: jnp.ndarray, select: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Advance selected steps to `target` where legal.
+
+    Returns (new_state, error_mask) — error_mask flags selected steps whose
+    transition was illegal (host raises SagaStateError for those).
+    """
+    ok = step_transition_valid(state, target)
+    apply = select & ok
+    new_state = jnp.where(apply, target, state).astype(state.dtype)
+    return new_state, select & ~ok
+
+
+def execute_attempt(
+    state: jnp.ndarray,
+    success: jnp.ndarray,
+    retries_left: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One retry-ladder attempt over a step batch.
+
+    PENDING steps move to COMMITTED on success; on failure they return to
+    PENDING while retries remain, else FAILED (mirrors the reference's
+    reset-to-PENDING retry loop, `saga/orchestrator.py:104-138`).
+
+    Returns (new_state, new_retries_left).
+    """
+    pending = state == STEP_PENDING
+    committed = pending & success
+    failed_final = pending & ~success & (retries_left <= 0)
+    retrying = pending & ~success & (retries_left > 0)
+    new_state = jnp.where(
+        committed,
+        STEP_COMMITTED,
+        jnp.where(failed_final, STEP_FAILED, state),
+    ).astype(state.dtype)
+    new_retries = jnp.where(retrying, retries_left - 1, retries_left)
+    return new_state, new_retries
+
+
+def compensation_pass(
+    state: jnp.ndarray, has_undo: jnp.ndarray, undo_success: jnp.ndarray
+) -> jnp.ndarray:
+    """Batched compensation outcome for COMMITTED steps.
+
+    COMMITTED -> COMPENSATED when an undo exists and succeeds, else
+    COMPENSATION_FAILED (no Undo_API or failed undo), matching
+    `saga/orchestrator.py:165-187`.
+    """
+    committed = state == STEP_COMMITTED
+    ok = committed & has_undo & undo_success
+    bad = committed & ~(has_undo & undo_success)
+    return jnp.where(
+        ok, STEP_COMPENSATED, jnp.where(bad, STEP_COMPENSATION_FAILED, state)
+    ).astype(state.dtype)
+
+
+def settle_sagas(step_state: jnp.ndarray, saga_state: jnp.ndarray) -> jnp.ndarray:
+    """[G, max_steps] step states -> final saga states.
+
+    A compensating saga ESCALATES if any step failed compensation, else
+    COMPLETES (reference `saga/orchestrator.py:189-197`). Running sagas with
+    all steps committed COMPLETE.
+    """
+    any_comp_failed = jnp.any(step_state == STEP_COMPENSATION_FAILED, axis=-1)
+    all_committed = jnp.all(
+        (step_state == STEP_COMMITTED) | (step_state == STEP_PENDING), axis=-1
+    ) & jnp.any(step_state == STEP_COMMITTED, axis=-1)
+
+    compensating = saga_state == SAGA_COMPENSATING
+    running = saga_state == SAGA_RUNNING
+    out = jnp.where(
+        compensating & any_comp_failed,
+        SAGA_ESCALATED,
+        jnp.where(
+            compensating & ~any_comp_failed,
+            SAGA_COMPLETED,
+            jnp.where(running & all_committed, SAGA_COMPLETED, saga_state),
+        ),
+    )
+    return out.astype(saga_state.dtype)
+
+
+def fanout_policy_check(
+    success: jnp.ndarray, valid: jnp.ndarray, policy: jnp.ndarray
+) -> jnp.ndarray:
+    """[G, B] branch outcomes -> bool[G] policy satisfaction.
+
+    policy codes: 0=ALL, 1=MAJORITY, 2=ANY (`saga/fan_out.py:62-70`).
+    """
+    wins = jnp.sum(success & valid, axis=-1)
+    total = jnp.sum(valid, axis=-1)
+    return jnp.where(
+        policy == 0,
+        wins == total,
+        jnp.where(policy == 1, wins * 2 > total, wins >= 1),
+    )

@@ -63,9 +63,10 @@ def _compress_block(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
         wi = w[:, i - 16] + s0 + w[:, i - 7] + s1
         return w.at[:, i].set(wi)
 
-    w = jnp.concatenate(
-        [block, jnp.zeros((block.shape[0], 48), jnp.uint32)], axis=1
-    )
+    # Zero-extend via the block itself so the array keeps the same
+    # varying-axis type under shard_map (a fresh jnp.zeros would not).
+    zeros48 = jnp.broadcast_to(block[:, :1] & jnp.uint32(0), (block.shape[0], 48))
+    w = jnp.concatenate([block, zeros48], axis=1)
     w = lax.fori_loop(16, 64, expand, w)
 
     def round_fn(i, vars8):
@@ -92,7 +93,9 @@ def sha256_blocks(words: jnp.ndarray, n_blocks: int) -> jnp.ndarray:
     Returns:
       u32[B, 8] digests.
     """
-    state = jnp.broadcast_to(jnp.asarray(_H0), (words.shape[0], 8)).astype(jnp.uint32)
+    # IV broadcast, xor'd with varying zeros so the fori_loop carry type
+    # matches under shard_map manual axes.
+    state = jnp.asarray(_H0)[None, :] ^ (words[:, :8] & jnp.uint32(0))
 
     def body(i, st):
         block = lax.dynamic_slice_in_dim(words, i * 16, 16, axis=1)

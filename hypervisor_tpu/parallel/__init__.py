@@ -1,0 +1,23 @@
+"""Multi-chip layer: mesh, shardings, ICI collectives (the distributed backend)."""
+
+from hypervisor_tpu.parallel.mesh import (
+    AGENT_AXIS,
+    DCN_AXIS,
+    make_mesh,
+    make_multislice_mesh,
+)
+from hypervisor_tpu.parallel.sharding import lane_sharding, replicated, shard_table
+from hypervisor_tpu.parallel.collectives import eventual_tick, reconcile, strong_tick
+
+__all__ = [
+    "AGENT_AXIS",
+    "DCN_AXIS",
+    "make_mesh",
+    "make_multislice_mesh",
+    "lane_sharding",
+    "replicated",
+    "shard_table",
+    "strong_tick",
+    "eventual_tick",
+    "reconcile",
+]

@@ -1,0 +1,112 @@
+"""Multi-chip governance ticks: shard_map + psum over ICI.
+
+This is the framework's distributed communication backend (the reference
+has none — SURVEY §5 maps its STRONG/EVENTUAL consistency enum to actual
+collectives here):
+
+ - STRONG mode: every batched tick ends in a `psum` of the session
+   aggregates over the mesh agent axis — a real cross-chip consensus
+   barrier on ICI. All chips observe identical global state before the
+   tick commits.
+ - EVENTUAL mode: chips update their shard locally; `reconcile` runs the
+   same allreduce *between* ticks (host-driven cadence), trading
+   freshness for zero in-tick communication.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from hypervisor_tpu.ops.pipeline import PipelineResult, governance_pipeline
+from hypervisor_tpu.parallel.mesh import AGENT_AXIS
+
+
+def strong_tick(mesh: Mesh):
+    """Build the jitted multi-chip governance tick (STRONG consistency).
+
+    Returns fn(sigma_raw, trustworthy, min_sigma_eff, delta_bodies, active)
+    with every [S]-leading input sharded over the agent axis; the returned
+    `consensus` vector is psum'd over ICI so all chips agree.
+    """
+    lane = P(AGENT_AXIS)
+
+    def tick(sigma_raw, trustworthy, min_sigma_eff, delta_bodies, active):
+        result = governance_pipeline(
+            sigma_raw, trustworthy, min_sigma_eff, delta_bodies, active
+        )
+        # Cross-chip consensus barrier: allreduce the session aggregates.
+        consensus = jax.lax.psum(result.consensus, AGENT_AXIS)
+        return result._replace(consensus=consensus)
+
+    mapped = shard_map(
+        tick,
+        mesh=mesh,
+        in_specs=(lane, lane, lane, P(None, AGENT_AXIS), lane),
+        out_specs=PipelineResult(
+            ring=lane,
+            sigma_eff=lane,
+            session_state=lane,
+            saga_step_state=lane,
+            merkle_root=lane,
+            status=lane,
+            consensus=P(),  # replicated after psum
+        ),
+        
+    )
+    return jax.jit(mapped)
+
+
+def eventual_tick(mesh: Mesh):
+    """EVENTUAL mode: local-only tick; no in-tick collective."""
+    lane = P(AGENT_AXIS)
+
+    def tick(sigma_raw, trustworthy, min_sigma_eff, delta_bodies, active):
+        return governance_pipeline(
+            sigma_raw, trustworthy, min_sigma_eff, delta_bodies, active
+        )
+
+    mapped = shard_map(
+        tick,
+        mesh=mesh,
+        in_specs=(lane, lane, lane, P(None, AGENT_AXIS), lane),
+        out_specs=PipelineResult(
+            ring=lane,
+            sigma_eff=lane,
+            session_state=lane,
+            saga_step_state=lane,
+            merkle_root=lane,
+            status=lane,
+            consensus=lane,  # per-shard partial aggregates
+        ),
+        
+    )
+    return jax.jit(mapped)
+
+
+def reconcile(mesh: Mesh):
+    """Between-tick reconciliation for EVENTUAL mode: allreduce partials."""
+
+    def _sum(partials):
+        return jax.lax.psum(partials, AGENT_AXIS)
+
+    return jax.jit(
+        shard_map(
+            _sum, mesh=mesh, in_specs=P(AGENT_AXIS), out_specs=P()
+        )
+    )
+
+
+@partial(jax.jit, static_argnames=("n_agents",))
+def sigma_allreduce_stats(sigma_eff: jnp.ndarray, n_agents: int) -> jnp.ndarray:
+    """Single-device helper: [sum, mean, max] of sigma for stats endpoints."""
+    return jnp.stack(
+        [jnp.sum(sigma_eff), jnp.sum(sigma_eff) / n_agents, jnp.max(sigma_eff)]
+    )

@@ -1,0 +1,44 @@
+"""Device mesh construction for the agent-sharded runtime.
+
+The scaling axis of this framework is the number of concurrent agents /
+sessions (SURVEY §5: there is no sequence dimension — "long context" here
+means large N with O(1) per-chip memory). The canonical mesh is therefore
+1-D over the `agents` axis: every table column [N, ...] shards along it,
+STRONG-mode consensus is a psum over it (ICI within a slice), and
+multi-slice deployments add a `dcn` outer axis for cross-slice
+reconciliation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AGENT_AXIS = "agents"
+DCN_AXIS = "dcn"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """1-D mesh over the agent axis (ICI collectives within the slice)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (AGENT_AXIS,))
+
+
+def make_multislice_mesh(n_slices: int, per_slice: int) -> Mesh:
+    """2-D mesh (dcn, agents): outer axis across slices (DCN), inner over ICI.
+
+    Collectives over AGENT_AXIS ride ICI; EVENTUAL-mode cross-slice
+    reconciliation reduces over DCN_AXIS between batched ticks.
+    """
+    devices = np.asarray(jax.devices()[: n_slices * per_slice]).reshape(
+        n_slices, per_slice
+    )
+    return Mesh(devices, (DCN_AXIS, AGENT_AXIS))

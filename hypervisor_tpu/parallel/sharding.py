@@ -1,0 +1,28 @@
+"""Sharding specs for the governance tables.
+
+Every table's leading axis is the entity axis (agents / sessions / edges /
+lanes); all shard 1-D over the mesh agent axis. Scalars and small
+aggregates replicate.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hypervisor_tpu.parallel.mesh import AGENT_AXIS
+
+
+def lane_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (entity) axis over the agent mesh axis."""
+    return NamedSharding(mesh, P(AGENT_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_table(table, mesh: Mesh):
+    """Place every leaf of a table pytree with its leading axis sharded."""
+    lane = lane_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, lane), table)

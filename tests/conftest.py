@@ -7,7 +7,10 @@ xla_force_host_platform_device_count=8 without hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the environment ships JAX_PLATFORMS=axon (real TPU via a
+# single-claim tunnel); tests must never claim it. Assignment, not
+# setdefault.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -76,8 +76,8 @@ class VouchingEngine:
         self._voucher = np.empty(_GROW, np.int32)
         self._vouchee = np.empty(_GROW, np.int32)
         self._session = np.empty(_GROW, np.int32)
-        self._pct = np.empty(_GROW, np.float32)
-        self._bond = np.empty(_GROW, np.float32)
+        self._pct = np.empty(_GROW, np.float64)
+        self._bond = np.empty(_GROW, np.float64)
         self._active = np.empty(_GROW, bool)
         self._expiry = np.empty(_GROW, np.float64)
         # row metadata kept host-side only
@@ -144,7 +144,7 @@ class VouchingEngine:
     ) -> float:
         """sigma_eff = sigma_L + omega * sum(active bonds), capped at 1.0."""
         contribution = float(
-            self._bond[self._mask_vouchee(vouchee_did, session_id)].sum()
+            self._bond[: self._n][self._mask_vouchee(vouchee_did, session_id)].sum()
         )
         return min(vouchee_sigma + risk_weight * contribution, 1.0)
 

@@ -1,0 +1,298 @@
+"""Joint liability: vouching, slashing cascades, matrix, attribution,
+quarantine, ledger.
+
+Mirrors reference `test_liability.py` / `test_slashing.py` /
+`test_liability_improvements.py`: sigma_eff formula + cap, circular
+vouching, exposure limits, clip/floor, attribution weights, quarantine
+tick-expiry, ledger risk profiles.
+"""
+
+import pytest
+
+from hypervisor_tpu.liability import (
+    CausalAttributor,
+    LedgerEntryType,
+    LiabilityLedger,
+    LiabilityMatrix,
+    QuarantineManager,
+    QuarantineReason,
+    SlashingEngine,
+    VouchingEngine,
+    VouchingError,
+)
+from hypervisor_tpu.utils.clock import ManualClock
+
+S = "session:test-1"
+
+
+class TestVouching:
+    def setup_method(self):
+        self.engine = VouchingEngine()
+
+    def test_vouch_creates_bond(self):
+        rec = self.engine.vouch("did:h", "did:l", S, voucher_sigma=0.9)
+        assert rec.bonded_sigma_pct == 0.20
+        assert abs(rec.bonded_amount - 0.18) < 1e-9
+        assert rec.is_active
+
+    def test_self_vouch_rejected(self):
+        with pytest.raises(VouchingError, match="yourself"):
+            self.engine.vouch("did:a", "did:a", S, 0.9)
+
+    def test_low_sigma_voucher_rejected(self):
+        with pytest.raises(VouchingError, match="below minimum"):
+            self.engine.vouch("did:weak", "did:l", S, 0.49)
+
+    def test_direct_cycle_rejected(self):
+        self.engine.vouch("did:a", "did:b", S, 0.9)
+        with pytest.raises(VouchingError, match="Circular"):
+            self.engine.vouch("did:b", "did:a", S, 0.9)
+
+    def test_indirect_cycle_rejected(self):
+        self.engine.vouch("did:a", "did:b", S, 0.9)
+        self.engine.vouch("did:b", "did:c", S, 0.9)
+        with pytest.raises(VouchingError, match="Circular"):
+            self.engine.vouch("did:c", "did:a", S, 0.9)
+
+    def test_cycle_scoped_to_session(self):
+        self.engine.vouch("did:a", "did:b", S, 0.9)
+        # reverse edge in a different session is fine
+        self.engine.vouch("did:b", "did:a", "session:other", 0.9)
+
+    def test_exposure_limit(self):
+        # 80% of 0.8 = 0.64 limit; each bond at 30% = 0.24
+        self.engine.vouch("did:a", "did:b", S, 0.8, bond_pct=0.3)
+        self.engine.vouch("did:a", "did:c", S, 0.8, bond_pct=0.3)
+        with pytest.raises(VouchingError, match="exposure"):
+            self.engine.vouch("did:a", "did:d", S, 0.8, bond_pct=0.3)
+
+    def test_total_exposure(self):
+        self.engine.vouch("did:a", "did:b", S, 0.8, bond_pct=0.3)
+        self.engine.vouch("did:a", "did:c", S, 0.8, bond_pct=0.2)
+        assert abs(self.engine.get_total_exposure("did:a", S) - 0.40) < 1e-6
+
+    def test_sigma_eff_formula_and_cap(self):
+        self.engine.vouch("did:h", "did:l", S, 0.9)  # bond 0.18
+        sigma = self.engine.compute_sigma_eff("did:l", S, 0.40, risk_weight=0.5)
+        assert abs(sigma - (0.40 + 0.5 * 0.18)) < 1e-6
+        capped = self.engine.compute_sigma_eff("did:l", S, 0.99, risk_weight=1.0)
+        assert capped == 1.0
+
+    def test_release_bond(self):
+        rec = self.engine.vouch("did:h", "did:l", S, 0.9)
+        self.engine.release_bond(rec.vouch_id)
+        assert self.engine.get_vouchers_for("did:l", S) == []
+        with pytest.raises(VouchingError):
+            self.engine.release_bond("vouch:ghost")
+
+    def test_release_session_bonds(self):
+        self.engine.vouch("did:a", "did:b", S, 0.9)
+        self.engine.vouch("did:c", "did:d", S, 0.9)
+        self.engine.vouch("did:a", "did:x", "session:other", 0.9)
+        assert self.engine.release_session_bonds(S) == 2
+        assert self.engine.get_vouchers_for("did:x", "session:other")
+
+    def test_to_device_roundtrip(self):
+        import numpy as np
+
+        self.engine.vouch("did:a", "did:b", S, 0.9)
+        table = self.engine.to_device(capacity=4)
+        assert np.asarray(table.active).tolist() == [True, False, False, False]
+        assert abs(float(np.asarray(table.bond)[0]) - 0.18) < 1e-6
+
+
+class TestSlashing:
+    def setup_method(self):
+        self.vouching = VouchingEngine()
+        self.slashing = SlashingEngine(self.vouching)
+
+    def test_vouchee_blacklisted_voucher_clipped(self):
+        self.vouching.vouch("did:h", "did:l", S, 0.9)
+        scores = {"did:h": 0.9, "did:l": 0.4}
+        result = self.slashing.slash("did:l", S, 0.4, 0.5, "violation", scores)
+        assert scores["did:l"] == 0.0
+        assert abs(scores["did:h"] - 0.45) < 1e-9
+        assert len(result.voucher_clips) == 1
+        # bond released
+        assert self.vouching.get_vouchers_for("did:l", S) == []
+
+    def test_sigma_floor(self):
+        self.vouching.vouch("did:h", "did:l", S, 0.9)
+        scores = {"did:h": 0.9, "did:l": 0.4}
+        self.slashing.slash("did:l", S, 0.4, 0.99, "bad", scores)
+        assert scores["did:h"] == pytest.approx(0.05)
+
+    def test_cascade_to_wiped_voucher(self):
+        # g vouches for h, h vouches for l. Slashing l with omega=0.99 wipes
+        # h (floor), and h has its own voucher -> cascade slashes h, clips g.
+        self.vouching.vouch("did:g", "did:h", S, 0.9)
+        self.vouching.vouch("did:h", "did:l", S, 0.9)
+        scores = {"did:g": 0.9, "did:h": 0.9, "did:l": 0.4}
+        self.slashing.slash("did:l", S, 0.4, 0.99, "bad", scores)
+        assert scores["did:l"] == 0.0
+        assert scores["did:h"] == 0.0  # cascaded blacklist
+        assert scores["did:g"] == pytest.approx(0.05)  # clipped in cascade
+        assert len(self.slashing.history) == 2
+        assert self.slashing.history[1].cascade_depth == 1
+
+    def test_no_cascade_when_voucher_survives(self):
+        self.vouching.vouch("did:g", "did:h", S, 0.9)
+        self.vouching.vouch("did:h", "did:l", S, 0.9)
+        scores = {"did:g": 0.9, "did:h": 0.9, "did:l": 0.4}
+        self.slashing.slash("did:l", S, 0.4, 0.5, "bad", scores)
+        assert scores["did:h"] == pytest.approx(0.45)  # clipped, not wiped
+        assert scores["did:g"] == 0.9
+        assert len(self.slashing.history) == 1
+
+
+class TestLiabilityMatrix:
+    def setup_method(self):
+        self.matrix = LiabilityMatrix(S)
+
+    def test_add_and_query(self):
+        self.matrix.add_edge("did:a", "did:b", 0.2, "v1")
+        assert len(self.matrix.who_vouches_for("did:b")) == 1
+        assert len(self.matrix.who_is_vouched_by("did:a")) == 1
+
+    def test_total_exposure(self):
+        self.matrix.add_edge("did:a", "did:b", 0.2, "v1")
+        self.matrix.add_edge("did:a", "did:c", 0.3, "v2")
+        assert abs(self.matrix.total_exposure("did:a") - 0.5) < 1e-9
+
+    def test_cycle_detection(self):
+        self.matrix.add_edge("did:a", "did:b", 0.2, "v1")
+        self.matrix.add_edge("did:b", "did:a", 0.2, "v2")
+        assert self.matrix.has_cycle()
+
+    def test_no_cycle(self):
+        self.matrix.add_edge("did:a", "did:b", 0.2, "v1")
+        self.matrix.add_edge("did:b", "did:c", 0.2, "v2")
+        assert not self.matrix.has_cycle()
+
+    def test_cascade_paths(self):
+        self.matrix.add_edge("did:a", "did:b", 0.2, "v1")
+        self.matrix.add_edge("did:b", "did:c", 0.2, "v2")
+        paths = self.matrix.cascade_path("did:a", max_depth=2)
+        assert ["did:a", "did:b", "did:c"] in paths
+
+    def test_remove_edge_and_clear(self):
+        self.matrix.add_edge("did:a", "did:b", 0.2, "v1")
+        self.matrix.remove_edge("v1")
+        assert self.matrix.edges == []
+        self.matrix.add_edge("did:a", "did:b", 0.2, "v2")
+        self.matrix.clear()
+        assert len(self.matrix.edges) == 0
+
+
+class TestAttribution:
+    def test_direct_cause_gets_most_liability(self):
+        attr = CausalAttributor()
+        result = attr.attribute(
+            saga_id="sg",
+            session_id=S,
+            agent_actions={
+                "did:failer": [{"action_id": "x", "step_id": "s2", "success": False}],
+                "did:helper": [{"action_id": "y", "step_id": "s1", "success": True}],
+            },
+            failure_step_id="s2",
+            failure_agent_did="did:failer",
+        )
+        assert result.root_cause_agent == "did:failer"
+        assert result.attributions[0].agent_did == "did:failer"
+        assert result.get_liability("did:failer") > result.get_liability("did:helper")
+
+    def test_scores_normalized_to_one(self):
+        attr = CausalAttributor()
+        result = attr.attribute(
+            "sg",
+            S,
+            {
+                "a": [{"action_id": "x", "step_id": "s1", "success": False}],
+                "b": [{"action_id": "y", "step_id": "s2", "success": False}],
+                "c": [{"action_id": "z", "step_id": "s3", "success": True}],
+            },
+            failure_step_id="s1",
+            failure_agent_did="a",
+        )
+        assert abs(sum(a.liability_score for a in result.attributions) - 1.0) < 1e-3
+        assert attr.attribution_history
+
+
+class TestQuarantine:
+    def setup_method(self):
+        self.clock = ManualClock()
+        self.mgr = QuarantineManager(clock=self.clock)
+
+    def test_quarantine_and_release(self):
+        self.mgr.quarantine("did:a", S, QuarantineReason.BEHAVIORAL_DRIFT)
+        assert self.mgr.is_quarantined("did:a", S)
+        self.mgr.release("did:a", S)
+        assert not self.mgr.is_quarantined("did:a", S)
+
+    def test_escalation_merges(self):
+        r1 = self.mgr.quarantine("did:a", S, QuarantineReason.MANUAL, details="first")
+        r2 = self.mgr.quarantine(
+            "did:a", S, QuarantineReason.RING_BREACH, details="second",
+            forensic_data={"k": 1},
+        )
+        assert r1 is r2
+        assert "escalated: second" in r1.details
+        assert r1.forensic_data == {"k": 1}
+
+    def test_tick_auto_release(self):
+        self.mgr.quarantine("did:a", S, QuarantineReason.MANUAL, duration_seconds=300)
+        self.clock.advance(301)
+        released = self.mgr.tick()
+        assert len(released) == 1
+        assert not self.mgr.is_quarantined("did:a", S)
+
+    def test_history_filters(self):
+        self.mgr.quarantine("did:a", S, QuarantineReason.MANUAL)
+        self.mgr.quarantine("did:b", "session:2", QuarantineReason.MANUAL)
+        assert len(self.mgr.get_history(agent_did="did:a")) == 1
+        assert len(self.mgr.get_history(session_id="session:2")) == 1
+        assert len(self.mgr.get_history()) == 2
+
+
+class TestLedger:
+    def test_clean_agent_admitted(self):
+        ledger = LiabilityLedger()
+        profile = ledger.compute_risk_profile("did:new")
+        assert profile.recommendation == "admit" and profile.risk_score == 0.0
+
+    def test_slashes_raise_risk(self):
+        ledger = LiabilityLedger()
+        for _ in range(3):
+            ledger.record("did:bad", LedgerEntryType.SLASH_RECEIVED, S, severity=1.0)
+        profile = ledger.compute_risk_profile("did:bad")
+        assert profile.risk_score == pytest.approx(0.45)
+        assert profile.recommendation == "probation"
+        ledger.record("did:bad", LedgerEntryType.SLASH_RECEIVED, S, severity=1.0)
+        assert ledger.compute_risk_profile("did:bad").recommendation == "deny"
+        ok, reason = ledger.should_admit("did:bad")
+        assert not ok and "Risk score" in reason
+
+    def test_clean_sessions_reduce_risk(self):
+        ledger = LiabilityLedger()
+        ledger.record("did:a", LedgerEntryType.SLASH_RECEIVED, S, severity=1.0)
+        for _ in range(3):
+            ledger.record("did:a", LedgerEntryType.CLEAN_SESSION, S)
+        assert ledger.compute_risk_profile("did:a").risk_score == pytest.approx(0.0)
+
+    def test_severity_floors(self):
+        # slash severity floored at 0.5, quarantine at 0.3
+        ledger = LiabilityLedger()
+        ledger.record("did:a", LedgerEntryType.SLASH_RECEIVED, S, severity=0.0)
+        ledger.record("did:a", LedgerEntryType.QUARANTINE_ENTERED, S, severity=0.0)
+        profile = ledger.compute_risk_profile("did:a")
+        assert profile.risk_score == pytest.approx(0.15 * 0.5 + 0.10 * 0.3)
+
+    def test_counts_and_tracking(self):
+        ledger = LiabilityLedger()
+        ledger.record("did:a", LedgerEntryType.FAULT_ATTRIBUTED, S, severity=0.6)
+        ledger.record("did:a", LedgerEntryType.VOUCH_GIVEN, S)
+        profile = ledger.compute_risk_profile("did:a")
+        assert profile.total_entries == 2
+        assert profile.fault_score_avg == pytest.approx(0.6)
+        assert ledger.tracked_agents == ["did:a"]
+        assert ledger.total_entries == 2

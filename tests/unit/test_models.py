@@ -1,0 +1,82 @@
+"""Core model semantics (mirrors reference `tests/unit/test_models.py` coverage)."""
+
+from hypervisor_tpu.models import (
+    ActionDescriptor,
+    ConsistencyMode,
+    ExecutionRing,
+    ReversibilityLevel,
+    SessionConfig,
+    SessionState,
+)
+
+
+class TestExecutionRing:
+    def test_ring_from_sigma_boundaries(self):
+        # Strict > at both thresholds (reference boundary test: 0.60 vs 0.601).
+        assert ExecutionRing.from_sigma_eff(0.60) == ExecutionRing.RING_3_SANDBOX
+        assert ExecutionRing.from_sigma_eff(0.601) == ExecutionRing.RING_2_STANDARD
+        assert ExecutionRing.from_sigma_eff(0.95, True) == ExecutionRing.RING_2_STANDARD
+        assert ExecutionRing.from_sigma_eff(0.951, True) == ExecutionRing.RING_1_PRIVILEGED
+
+    def test_ring1_requires_consensus(self):
+        assert ExecutionRing.from_sigma_eff(0.99, False) == ExecutionRing.RING_2_STANDARD
+        assert ExecutionRing.from_sigma_eff(0.99, True) == ExecutionRing.RING_1_PRIVILEGED
+
+    def test_ordering(self):
+        assert ExecutionRing.RING_0_ROOT < ExecutionRing.RING_3_SANDBOX
+
+
+class TestReversibility:
+    def test_risk_weight_ranges(self):
+        assert ReversibilityLevel.FULL.risk_weight_range == (0.1, 0.3)
+        assert ReversibilityLevel.PARTIAL.risk_weight_range == (0.5, 0.8)
+        assert ReversibilityLevel.NONE.risk_weight_range == (0.9, 1.0)
+
+    def test_default_risk_weight_is_midpoint(self):
+        assert abs(ReversibilityLevel.FULL.default_risk_weight - 0.2) < 1e-9
+        assert abs(ReversibilityLevel.PARTIAL.default_risk_weight - 0.65) < 1e-9
+        assert abs(ReversibilityLevel.NONE.default_risk_weight - 0.95) < 1e-9
+
+
+class TestActionDescriptor:
+    def _action(self, **kw):
+        return ActionDescriptor(
+            action_id="a", name="a", execute_api="/x", **kw
+        )
+
+    def test_required_ring_admin(self):
+        assert self._action(is_admin=True).required_ring == ExecutionRing.RING_0_ROOT
+
+    def test_required_ring_nonreversible(self):
+        a = self._action(reversibility=ReversibilityLevel.NONE)
+        assert a.required_ring == ExecutionRing.RING_1_PRIVILEGED
+
+    def test_required_ring_read_only(self):
+        a = self._action(is_read_only=True, reversibility=ReversibilityLevel.NONE)
+        assert a.required_ring == ExecutionRing.RING_3_SANDBOX
+
+    def test_required_ring_reversible(self):
+        a = self._action(reversibility=ReversibilityLevel.FULL)
+        assert a.required_ring == ExecutionRing.RING_2_STANDARD
+
+    def test_risk_weight_follows_reversibility(self):
+        assert self._action(reversibility=ReversibilityLevel.PARTIAL).risk_weight == 0.65
+
+
+class TestSessionConfig:
+    def test_defaults(self):
+        c = SessionConfig()
+        assert c.consistency_mode == ConsistencyMode.EVENTUAL
+        assert c.max_participants == 10
+        assert c.min_sigma_eff == 0.60
+        assert c.enable_audit is True
+
+
+class TestStateCodes:
+    def test_session_state_roundtrip(self):
+        for s in SessionState:
+            assert SessionState.from_code(s.code) == s
+
+    def test_consistency_mode_codes(self):
+        assert ConsistencyMode.STRONG.code == 0
+        assert ConsistencyMode.from_code(1) == ConsistencyMode.EVENTUAL

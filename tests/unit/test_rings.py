@@ -1,0 +1,210 @@
+"""Ring enforcement, classification, elevation, breach detection.
+
+Mirrors reference `test_rings.py` + `test_ring_improvements.py` coverage:
+enforcer checks, elevation TTL/expiry/revoke (via injected clock, not
+sleeping), inheritance, breach severities + circuit breaker.
+"""
+
+import pytest
+
+from hypervisor_tpu.models import ActionDescriptor, ExecutionRing, ReversibilityLevel
+from hypervisor_tpu.rings import (
+    BreachSeverity,
+    RingBreachDetector,
+    RingElevationError,
+    RingElevationManager,
+    RingEnforcer,
+)
+from hypervisor_tpu.utils.clock import ManualClock
+
+
+def _action(**kw):
+    return ActionDescriptor(action_id="a", name="a", execute_api="/x", **kw)
+
+
+class TestRingEnforcer:
+    def setup_method(self):
+        self.enforcer = RingEnforcer()
+
+    def test_ring0_needs_witness(self):
+        result = self.enforcer.check(
+            ExecutionRing.RING_0_ROOT, _action(is_admin=True), 0.99
+        )
+        assert not result.allowed and result.requires_sre_witness
+        result = self.enforcer.check(
+            ExecutionRing.RING_0_ROOT, _action(is_admin=True), 0.99, has_sre_witness=True
+        )
+        assert result.allowed
+
+    def test_ring1_needs_sigma_and_consensus(self):
+        act = _action(reversibility=ReversibilityLevel.NONE)
+        r = self.enforcer.check(ExecutionRing.RING_1_PRIVILEGED, act, 0.90, True)
+        assert not r.allowed and "0.95" in r.reason
+        r = self.enforcer.check(ExecutionRing.RING_1_PRIVILEGED, act, 0.97, False)
+        assert not r.allowed and r.requires_consensus
+        r = self.enforcer.check(ExecutionRing.RING_1_PRIVILEGED, act, 0.97, True)
+        assert r.allowed
+
+    def test_ring2_sigma_gate(self):
+        act = _action(reversibility=ReversibilityLevel.FULL)
+        assert not self.enforcer.check(ExecutionRing.RING_2_STANDARD, act, 0.50).allowed
+        assert self.enforcer.check(ExecutionRing.RING_2_STANDARD, act, 0.70).allowed
+
+    def test_outer_ring_cannot_do_inner_action(self):
+        act = _action(reversibility=ReversibilityLevel.FULL)  # needs ring 2
+        r = self.enforcer.check(ExecutionRing.RING_3_SANDBOX, act, 0.90)
+        assert not r.allowed and "insufficient" in r.reason
+
+    def test_should_demote(self):
+        assert self.enforcer.should_demote(ExecutionRing.RING_2_STANDARD, 0.40)
+        assert not self.enforcer.should_demote(ExecutionRing.RING_2_STANDARD, 0.80)
+
+
+class TestClassifier:
+    def test_classify_and_cache(self):
+        from hypervisor_tpu.rings import ActionClassifier
+
+        c = ActionClassifier()
+        act = _action(reversibility=ReversibilityLevel.FULL)
+        r1 = c.classify(act)
+        assert r1.ring == ExecutionRing.RING_2_STANDARD and r1.confidence == 1.0
+        assert c.classify(act) is r1  # cached
+
+    def test_override_wins_with_lower_confidence(self):
+        from hypervisor_tpu.rings import ActionClassifier
+
+        c = ActionClassifier()
+        act = _action(reversibility=ReversibilityLevel.FULL)
+        c.classify(act)
+        c.set_override("a", ring=ExecutionRing.RING_3_SANDBOX)
+        r = c.classify(act)
+        assert r.ring == ExecutionRing.RING_3_SANDBOX and r.confidence == 0.9
+
+
+class TestElevation:
+    def setup_method(self):
+        self.clock = ManualClock()
+        self.mgr = RingElevationManager(clock=self.clock)
+
+    def test_grant_and_effective_ring(self):
+        self.mgr.request_elevation(
+            "a", "s", ExecutionRing.RING_3_SANDBOX, ExecutionRing.RING_2_STANDARD
+        )
+        assert (
+            self.mgr.get_effective_ring("a", "s", ExecutionRing.RING_3_SANDBOX)
+            == ExecutionRing.RING_2_STANDARD
+        )
+
+    def test_must_be_more_privileged(self):
+        with pytest.raises(RingElevationError):
+            self.mgr.request_elevation(
+                "a", "s", ExecutionRing.RING_2_STANDARD, ExecutionRing.RING_2_STANDARD
+            )
+
+    def test_ring0_forbidden(self):
+        with pytest.raises(RingElevationError):
+            self.mgr.request_elevation(
+                "a", "s", ExecutionRing.RING_1_PRIVILEGED, ExecutionRing.RING_0_ROOT
+            )
+
+    def test_no_duplicate_active_grant(self):
+        self.mgr.request_elevation(
+            "a", "s", ExecutionRing.RING_3_SANDBOX, ExecutionRing.RING_2_STANDARD
+        )
+        with pytest.raises(RingElevationError):
+            self.mgr.request_elevation(
+                "a", "s", ExecutionRing.RING_3_SANDBOX, ExecutionRing.RING_2_STANDARD
+            )
+
+    def test_ttl_capped_and_expiry_via_clock(self):
+        grant = self.mgr.request_elevation(
+            "a",
+            "s",
+            ExecutionRing.RING_3_SANDBOX,
+            ExecutionRing.RING_2_STANDARD,
+            ttl_seconds=999_999,
+        )
+        assert (grant.expires_at - grant.granted_at).total_seconds() == 3600
+        self.clock.advance(3601)
+        expired = self.mgr.tick()
+        assert [e.elevation_id for e in expired] == [grant.elevation_id]
+        assert (
+            self.mgr.get_effective_ring("a", "s", ExecutionRing.RING_3_SANDBOX)
+            == ExecutionRing.RING_3_SANDBOX
+        )
+
+    def test_revoke(self):
+        grant = self.mgr.request_elevation(
+            "a", "s", ExecutionRing.RING_3_SANDBOX, ExecutionRing.RING_2_STANDARD
+        )
+        self.mgr.revoke_elevation(grant.elevation_id)
+        assert self.mgr.get_active_elevation("a", "s") is None
+        with pytest.raises(RingElevationError):
+            self.mgr.revoke_elevation("elev:ghost")
+
+    def test_child_inheritance(self):
+        ring = self.mgr.register_child("p", "c", ExecutionRing.RING_1_PRIVILEGED)
+        assert ring == ExecutionRing.RING_2_STANDARD
+        assert self.mgr.get_parent("c") == "p"
+        assert self.mgr.get_children("p") == ["c"]
+        # Ring 3 parent's child stays Ring 3 (capped).
+        assert (
+            self.mgr.get_max_child_ring(ExecutionRing.RING_3_SANDBOX)
+            == ExecutionRing.RING_3_SANDBOX
+        )
+
+
+class TestBreachDetector:
+    def setup_method(self):
+        self.clock = ManualClock()
+        self.det = RingBreachDetector(clock=self.clock)
+
+    def _spam_privileged_calls(self, n, agent_ring=ExecutionRing.RING_3_SANDBOX):
+        # Return the first breach event (later calls fall inside the
+        # breaker cooldown and report None, matching the reference).
+        event = None
+        for _ in range(n):
+            e = self.det.record_call("a", "s", agent_ring, ExecutionRing.RING_0_ROOT)
+            event = event or e
+        return event
+
+    def test_below_min_calls_no_event(self):
+        assert self._spam_privileged_calls(4) is None
+
+    def test_critical_severity_and_breaker(self):
+        event = self._spam_privileged_calls(6)
+        assert event is not None and event.severity == BreachSeverity.CRITICAL
+        assert self.det.is_breaker_tripped("a", "s")
+
+    def test_breaker_cooldown_release(self):
+        self._spam_privileged_calls(6)
+        self.clock.advance(31)  # cooldown 30s
+        assert not self.det.is_breaker_tripped("a", "s")
+
+    def test_low_severity(self):
+        # 2/6 anomalous ≈ 0.33 -> LOW
+        for _ in range(4):
+            self.det.record_call(
+                "a", "s", ExecutionRing.RING_2_STANDARD, ExecutionRing.RING_2_STANDARD
+            )
+        for _ in range(2):
+            event = self.det.record_call(
+                "a", "s", ExecutionRing.RING_2_STANDARD, ExecutionRing.RING_0_ROOT
+            )
+        assert event.severity == BreachSeverity.LOW
+
+    def test_window_prunes_old_calls(self):
+        self._spam_privileged_calls(6)
+        self.clock.advance(61)  # everything outside 60s window
+        stats = self.det.get_agent_stats("a", "s")
+        assert stats["window_calls"] == 0
+        assert stats["total_calls"] == 6
+
+    def test_reset_breaker(self):
+        self._spam_privileged_calls(6)
+        self.det.reset_breaker("a", "s")
+        assert not self.det.is_breaker_tripped("a", "s")
+
+    def test_breach_history(self):
+        self._spam_privileged_calls(6)
+        assert self.det.breach_count >= 1

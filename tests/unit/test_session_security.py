@@ -1,0 +1,261 @@
+"""Vector clocks, intent locks, isolation levels, rate limiter, kill switch.
+
+Mirrors reference `tests/unit/test_session_security.py` (42 tests): clock
+conflicts, lock contention + deadlock, isolation flags, token-bucket
+manipulation, kill-switch handoff.
+"""
+
+import pytest
+
+from hypervisor_tpu.models import ExecutionRing
+from hypervisor_tpu.session.vector_clock import (
+    CausalViolationError,
+    VectorClock,
+    VectorClockManager,
+)
+from hypervisor_tpu.session.intent_locks import (
+    DeadlockError,
+    IntentLockManager,
+    LockContentionError,
+    LockIntent,
+)
+from hypervisor_tpu.session.isolation import IsolationLevel
+from hypervisor_tpu.security import (
+    AgentRateLimiter,
+    HandoffStatus,
+    KillReason,
+    KillSwitch,
+    RateLimitExceeded,
+)
+from hypervisor_tpu.utils.clock import ManualClock
+
+
+class TestVectorClock:
+    def test_tick_and_get(self):
+        c = VectorClock()
+        c.tick("a")
+        c.tick("a")
+        c.tick("b")
+        assert c.get("a") == 2 and c.get("b") == 1 and c.get("zzz") == 0
+
+    def test_merge_componentwise_max(self):
+        x = VectorClock()
+        x.tick("a")
+        y = VectorClock()
+        y.tick("b")
+        y.tick("b")
+        m = x.merge(y)
+        assert m.get("a") == 1 and m.get("b") == 2
+
+    def test_happens_before(self):
+        x = VectorClock()
+        x.tick("a")
+        y = x.copy()
+        y.tick("a")
+        assert x.happens_before(y)
+        assert not y.happens_before(x)
+
+    def test_concurrent(self):
+        x = VectorClock()
+        x.tick("a")
+        y = VectorClock()
+        y.tick("b")
+        assert x.is_concurrent(y)
+
+    def test_equality(self):
+        x = VectorClock()
+        x.tick("a")
+        y = VectorClock()
+        y.tick("a")
+        assert x == y
+
+    def test_clocks_dict_view(self):
+        c = VectorClock()
+        c.tick("a")
+        assert c.clocks == {"a": 1}
+
+
+class TestVectorClockManager:
+    def test_write_after_read_allowed(self):
+        m = VectorClockManager()
+        m.write("/f", "a")
+        m.read("/f", "b")
+        m.write("/f", "b")  # b has seen latest
+
+    def test_stale_write_rejected_strict(self):
+        m = VectorClockManager()
+        m.write("/f", "a")
+        with pytest.raises(CausalViolationError):
+            m.write("/f", "b", strict=True)  # b never read
+        assert m.conflict_count == 1
+
+    def test_stale_write_allowed_nonstrict(self):
+        m = VectorClockManager()
+        m.write("/f", "a")
+        m.write("/f", "b", strict=False)
+        assert m.conflict_count == 0
+
+    def test_tracked_paths(self):
+        m = VectorClockManager()
+        m.write("/x", "a")
+        m.write("/y", "a")
+        assert m.tracked_paths == 2
+
+    def test_path_matrix_export(self):
+        m = VectorClockManager()
+        m.write("/x", "a")
+        m.read("/x", "b")
+        m.write("/x", "b")
+        paths, matrix = m.path_matrix()
+        assert paths == ["/x"]
+        assert matrix.sum() == 2  # a:1, b:1
+
+
+class TestIntentLocks:
+    def test_read_read_shared(self):
+        m = IntentLockManager()
+        m.acquire("a", "s", "/r", LockIntent.READ)
+        m.acquire("b", "s", "/r", LockIntent.READ)
+        assert m.active_lock_count == 2
+
+    @pytest.mark.parametrize(
+        "first,second",
+        [
+            (LockIntent.READ, LockIntent.WRITE),
+            (LockIntent.WRITE, LockIntent.WRITE),
+            (LockIntent.WRITE, LockIntent.EXCLUSIVE),
+            (LockIntent.EXCLUSIVE, LockIntent.READ),
+        ],
+    )
+    def test_contention(self, first, second):
+        m = IntentLockManager()
+        m.acquire("a", "s", "/r", first)
+        with pytest.raises(LockContentionError):
+            m.acquire("b", "s", "/r", second)
+
+    def test_same_agent_no_conflict(self):
+        m = IntentLockManager()
+        m.acquire("a", "s", "/r", LockIntent.WRITE)
+        m.acquire("a", "s", "/r", LockIntent.EXCLUSIVE)
+
+    def test_release_frees_resource(self):
+        m = IntentLockManager()
+        lock = m.acquire("a", "s", "/r", LockIntent.WRITE)
+        m.release(lock.lock_id)
+        m.acquire("b", "s", "/r", LockIntent.WRITE)
+
+    def test_release_agent_locks(self):
+        m = IntentLockManager()
+        m.acquire("a", "s", "/r1", LockIntent.READ)
+        m.acquire("a", "s", "/r2", LockIntent.READ)
+        assert m.release_agent_locks("a", "s") == 2
+        assert m.active_lock_count == 0
+
+    def test_deadlock_detection(self):
+        m = IntentLockManager()
+        m.acquire("a", "s", "/r1", LockIntent.WRITE)
+        m.acquire("b", "s", "/r2", LockIntent.WRITE)
+        # b waits on a (wants r1); a then tries r2 -> cycle
+        m.declare_wait("b", {"a"})
+        with pytest.raises(DeadlockError):
+            m.acquire("a", "s", "/r2", LockIntent.WRITE)
+
+    def test_contention_points(self):
+        m = IntentLockManager()
+        m.acquire("a", "s", "/hot", LockIntent.READ)
+        m.acquire("b", "s", "/hot", LockIntent.READ)
+        m.acquire("a", "s", "/cold", LockIntent.WRITE)
+        assert m.contention_points == ["/hot"]
+
+
+class TestIsolationLevels:
+    def test_flags(self):
+        assert not IsolationLevel.SNAPSHOT.requires_vector_clocks
+        assert IsolationLevel.READ_COMMITTED.requires_vector_clocks
+        assert IsolationLevel.SERIALIZABLE.requires_intent_locks
+        assert not IsolationLevel.READ_COMMITTED.requires_intent_locks
+        assert IsolationLevel.SNAPSHOT.allows_concurrent_writes
+        assert not IsolationLevel.SERIALIZABLE.allows_concurrent_writes
+
+    def test_costs(self):
+        assert IsolationLevel.SNAPSHOT.coordination_cost == "low"
+        assert IsolationLevel.SERIALIZABLE.coordination_cost == "high"
+
+
+class TestRateLimiter:
+    def test_sandbox_burst_exhausts(self):
+        clock = ManualClock()
+        rl = AgentRateLimiter(clock=clock)
+        for _ in range(10):  # Ring 3 burst = 10
+            rl.check("a", "s", ExecutionRing.RING_3_SANDBOX)
+        with pytest.raises(RateLimitExceeded):
+            rl.check("a", "s", ExecutionRing.RING_3_SANDBOX)
+
+    def test_refill_restores_tokens(self):
+        clock = ManualClock()
+        rl = AgentRateLimiter(clock=clock)
+        for _ in range(10):
+            rl.check("a", "s", ExecutionRing.RING_3_SANDBOX)
+        clock.advance(1.0)  # +5 tokens at 5 rps
+        for _ in range(5):
+            rl.check("a", "s", ExecutionRing.RING_3_SANDBOX)
+        assert not rl.try_check("a", "s", ExecutionRing.RING_3_SANDBOX)
+
+    def test_ring_change_recreates_full_bucket(self):
+        clock = ManualClock()
+        rl = AgentRateLimiter(clock=clock)
+        for _ in range(10):
+            rl.check("a", "s", ExecutionRing.RING_3_SANDBOX)
+        rl.update_ring("a", "s", ExecutionRing.RING_1_PRIVILEGED)
+        for _ in range(100):  # Ring 1 burst = 100
+            rl.check("a", "s", ExecutionRing.RING_1_PRIVILEGED)
+
+    def test_stats(self):
+        clock = ManualClock()
+        rl = AgentRateLimiter(clock=clock)
+        rl.check("a", "s", ExecutionRing.RING_2_STANDARD)
+        assert not rl.try_check("a", "s", ExecutionRing.RING_2_STANDARD, cost=1000)
+        stats = rl.get_stats("a", "s")
+        assert stats.total_requests == 2
+        assert stats.rejected_requests == 1
+        assert stats.capacity == 40.0
+
+
+class TestKillSwitch:
+    def test_handoff_to_substitute(self):
+        ks = KillSwitch()
+        ks.register_substitute("s", "did:sub")
+        result = ks.kill(
+            "did:victim",
+            "s",
+            KillReason.BEHAVIORAL_DRIFT,
+            in_flight_steps=[{"step_id": "st1", "saga_id": "sg1"}],
+        )
+        assert result.handoff_success_count == 1
+        assert result.handoffs[0].to_agent == "did:sub"
+        assert result.handoffs[0].status is HandoffStatus.HANDED_OFF
+        assert not result.compensation_triggered
+
+    def test_no_substitute_triggers_compensation(self):
+        ks = KillSwitch()
+        result = ks.kill(
+            "did:victim",
+            "s",
+            KillReason.MANUAL,
+            in_flight_steps=[{"step_id": "st1", "saga_id": "sg1"}],
+        )
+        assert result.compensation_triggered
+        assert result.handoffs[0].status is HandoffStatus.COMPENSATED
+
+    def test_killed_agent_removed_from_pool(self):
+        ks = KillSwitch()
+        ks.register_substitute("s", "did:a")
+        ks.register_substitute("s", "did:b")
+        ks.kill("did:a", "s", KillReason.MANUAL)
+        assert ks._substitutes["s"] == ["did:b"]
+
+    def test_kill_history(self):
+        ks = KillSwitch()
+        ks.kill("did:a", "s", KillReason.RATE_LIMIT)
+        ks.kill("did:b", "s", KillReason.RING_BREACH)
+        assert ks.total_kills == 2

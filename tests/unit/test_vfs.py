@@ -1,0 +1,159 @@
+"""Session VFS: files, attribution, permissions, snapshots, namespacing.
+
+Mirrors the reference's largest unit suite (`test_vfs_substrate.py`, 56
+tests): namespace isolation, attribution log, snapshot capture incl.
+permissions, permission enforcement, SSO integration.
+"""
+
+import pytest
+
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.session import SharedSessionObject, SessionLifecycleError
+from hypervisor_tpu.session.vfs import SessionVFS, VFSPermissionError, content_hash
+
+
+@pytest.fixture
+def vfs():
+    return SessionVFS("session:test-1")
+
+
+class TestFileOps:
+    def test_write_and_read(self, vfs):
+        vfs.write("/doc.md", "hello", agent_did="did:a")
+        assert vfs.read("/doc.md") == "hello"
+
+    def test_read_missing_returns_none(self, vfs):
+        assert vfs.read("/nope") is None
+
+    def test_create_then_update_operations(self, vfs):
+        e1 = vfs.write("/f", "v1", agent_did="did:a")
+        e2 = vfs.write("/f", "v2", agent_did="did:b")
+        assert e1.operation == "create" and e1.previous_hash is None
+        assert e2.operation == "update"
+        assert e2.previous_hash == content_hash("v1")
+        assert e2.content_hash == content_hash("v2")
+
+    def test_delete(self, vfs):
+        vfs.write("/f", "x", agent_did="did:a")
+        edit = vfs.delete("/f", agent_did="did:a")
+        assert edit.operation == "delete"
+        assert vfs.read("/f") is None
+
+    def test_delete_missing_raises(self, vfs):
+        with pytest.raises(FileNotFoundError):
+            vfs.delete("/ghost", agent_did="did:a")
+
+    def test_namespace_isolation_between_sessions(self):
+        a = SessionVFS("session:a")
+        b = SessionVFS("session:b")
+        a.write("/shared.md", "a-data", agent_did="did:x")
+        assert b.read("/shared.md") is None
+        assert a.list_files() == ["/shared.md"]
+        assert b.list_files() == []
+
+    def test_content_addressing_dedupes_blobs(self, vfs):
+        vfs.write("/a", "same", agent_did="did:a")
+        vfs.write("/b", "same", agent_did="did:a")
+        assert len(vfs._blobs) == 1
+        assert vfs.file_count == 2
+
+
+class TestAttribution:
+    def test_edit_log_tracks_agents(self, vfs):
+        vfs.write("/a", "1", agent_did="did:alice")
+        vfs.write("/b", "2", agent_did="did:bob")
+        vfs.write("/a", "3", agent_did="did:alice")
+        assert len(vfs.edit_log) == 3
+        assert len(vfs.edits_by_agent("did:alice")) == 2
+        assert len(vfs.edits_by_agent("did:bob")) == 1
+        assert vfs.edits_by_agent("did:nobody") == []
+
+
+class TestPermissions:
+    def test_open_by_default(self, vfs):
+        vfs.write("/open", "x", agent_did="did:anyone")
+        assert vfs.read("/open", agent_did="did:other") == "x"
+
+    def test_restricted_path_blocks_other_agents(self, vfs):
+        vfs.write("/secret", "x", agent_did="did:owner")
+        vfs.set_permissions("/secret", {"did:owner"}, agent_did="did:owner")
+        with pytest.raises(VFSPermissionError):
+            vfs.read("/secret", agent_did="did:intruder")
+        with pytest.raises(VFSPermissionError):
+            vfs.write("/secret", "y", agent_did="did:intruder")
+        assert vfs.read("/secret", agent_did="did:owner") == "x"
+
+    def test_clear_permissions_reopens(self, vfs):
+        vfs.set_permissions("/p", {"did:a"}, agent_did="did:a")
+        vfs.clear_permissions("/p")
+        assert vfs.get_permissions("/p") is None
+
+    def test_delete_clears_permissions(self, vfs):
+        vfs.write("/p", "x", agent_did="did:a")
+        vfs.set_permissions("/p", {"did:a"}, agent_did="did:a")
+        vfs.delete("/p", agent_did="did:a")
+        assert vfs.get_permissions("/p") is None
+
+
+class TestSnapshots:
+    def test_snapshot_restore_files(self, vfs):
+        vfs.write("/f", "v1", agent_did="did:a")
+        snap = vfs.create_snapshot()
+        vfs.write("/f", "v2", agent_did="did:a")
+        vfs.write("/new", "x", agent_did="did:a")
+        vfs.restore_snapshot(snap, agent_did="did:a")
+        assert vfs.read("/f") == "v1"
+        assert vfs.read("/new") is None
+
+    def test_snapshot_captures_permissions(self, vfs):
+        vfs.write("/f", "x", agent_did="did:a")
+        vfs.set_permissions("/f", {"did:a"}, agent_did="did:a")
+        snap = vfs.create_snapshot()
+        vfs.clear_permissions("/f")
+        vfs.restore_snapshot(snap, agent_did="did:a")
+        assert vfs.get_permissions("/f") == {"did:a"}
+
+    def test_restore_unknown_snapshot_raises(self, vfs):
+        with pytest.raises(KeyError):
+            vfs.restore_snapshot("snap:ghost", agent_did="did:a")
+
+    def test_snapshot_is_isolated_from_later_writes(self, vfs):
+        vfs.write("/f", "v1", agent_did="did:a")
+        snap = vfs.create_snapshot()
+        vfs.write("/f", "v2", agent_did="did:a")
+        # the snapshot still maps to v1's blob
+        tree, _ = vfs._snapshots[snap]
+        assert vfs._blobs[tree[vfs._resolve("/f")]] == "v1"
+
+    def test_delete_snapshot(self, vfs):
+        snap = vfs.create_snapshot()
+        vfs.delete_snapshot(snap)
+        assert vfs.snapshot_count == 0
+        with pytest.raises(KeyError):
+            vfs.delete_snapshot(snap)
+
+    def test_restore_logged_in_edit_log(self, vfs):
+        snap = vfs.create_snapshot()
+        vfs.restore_snapshot(snap, agent_did="did:a")
+        assert vfs.edit_log[-1].operation == "restore"
+
+
+class TestSSOIntegration:
+    def _active_sso(self):
+        sso = SharedSessionObject(SessionConfig(), "did:admin")
+        sso.begin_handshake()
+        sso.join("did:a", sigma_raw=0.8, sigma_eff=0.8)
+        sso.activate()
+        return sso
+
+    def test_snapshot_only_when_active(self):
+        sso = SharedSessionObject(SessionConfig(), "did:admin")
+        with pytest.raises(SessionLifecycleError):
+            sso.create_vfs_snapshot()
+
+    def test_snapshot_captures_participant_metadata(self):
+        sso = self._active_sso()
+        sid = sso.create_vfs_snapshot()
+        meta = sso._meta_snapshots[sid]
+        assert "did:a" in meta["participant_states"]
+        assert meta["participant_states"]["did:a"]["sigma_eff"] == 0.8

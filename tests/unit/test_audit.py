@@ -1,0 +1,137 @@
+"""Audit: delta chains, Merkle roots, commitments, GC.
+
+Mirrors reference `test_audit.py` coverage plus device-root parity.
+"""
+
+from datetime import timedelta
+
+import pytest
+
+from hypervisor_tpu.audit import (
+    CommitmentEngine,
+    DeltaEngine,
+    EphemeralGC,
+    RetentionPolicy,
+    VFSChange,
+    merkle_root_host,
+)
+from hypervisor_tpu.session.vfs import SessionVFS
+from hypervisor_tpu.utils.clock import ManualClock
+
+S = "session:test-1"
+
+
+class TestDeltaEngine:
+    def test_capture_chains_parent_hashes(self):
+        eng = DeltaEngine(S)
+        d1 = eng.capture("did:a", [VFSChange(path="/f", operation="add")])
+        d2 = eng.capture("did:a", [VFSChange(path="/f", operation="modify")])
+        assert d1.parent_hash is None
+        assert d2.parent_hash == d1.delta_hash
+        assert len(d1.delta_hash) == 64
+        assert eng.turn_count == 2
+
+    def test_verify_chain_ok_and_tamper(self):
+        eng = DeltaEngine(S)
+        for i in range(5):
+            eng.capture("did:a", [VFSChange(path=f"/f{i}", operation="add")])
+        assert eng.verify_chain()
+        eng._deltas[2].changes.append(VFSChange(path="/evil", operation="add"))
+        assert not eng.verify_chain()
+
+    def test_merkle_root_empty_is_none(self):
+        assert DeltaEngine(S).compute_merkle_root() is None
+
+    def test_merkle_root_host_device_agree(self):
+        eng = DeltaEngine(S)
+        for i in range(7):
+            eng.capture("did:a", [VFSChange(path=f"/f{i}", operation="add")])
+        host = eng.compute_merkle_root(device=False)
+        dev = eng.compute_merkle_root(device=True)
+        assert host == dev and len(host) == 64
+
+    def test_prune_expired(self):
+        clock = ManualClock()
+        eng = DeltaEngine(S, clock=clock)
+        eng.capture("did:a", [])
+        clock.advance(91 * 86400)
+        eng.capture("did:a", [])
+        assert eng.prune_expired(90) == 1
+        assert len(eng.deltas) == 1
+
+
+class TestCommitment:
+    def test_commit_and_verify(self):
+        eng = CommitmentEngine()
+        eng.commit(S, "ab" * 32, ["did:a"], 3)
+        assert eng.verify(S, "ab" * 32)
+        assert not eng.verify(S, "cd" * 32)
+        assert not eng.verify("session:ghost", "ab" * 32)
+        rec = eng.get_commitment(S)
+        assert rec.delta_count == 3 and rec.committed_to == "local"
+
+    def test_batch_queue(self):
+        eng = CommitmentEngine()
+        rec = eng.commit(S, "ab" * 32, [], 1)
+        eng.queue_for_batch(rec)
+        flushed = eng.flush_batch()
+        assert flushed == [rec]
+        assert eng.flush_batch() == []
+
+
+class TestGC:
+    def test_purges_vfs_files(self):
+        gc = EphemeralGC()
+        vfs = SessionVFS(S)
+        vfs.write("/a", "1", agent_did="did:x")
+        vfs.write("/b", "2", agent_did="did:x")
+        result = gc.collect(session_id=S, vfs=vfs)
+        assert result.purged_vfs_files == 2
+        assert vfs.file_count == 0
+        assert gc.is_purged(S)
+
+    def test_respects_locked_paths_best_effort(self):
+        gc = EphemeralGC()
+        vfs = SessionVFS(S)
+        vfs.write("/a", "1", agent_did="did:x")
+        vfs.set_permissions("/a", {"did:x"}, agent_did="did:x")
+        # GC agent lacks permission -> best-effort skip, no crash.
+        gc.collect(session_id=S, vfs=vfs)
+        assert gc.is_purged(S)
+
+    def test_delta_expiry_accounting(self):
+        clock = ManualClock()
+        gc = EphemeralGC(RetentionPolicy(delta_retention_days=90), clock=clock)
+        eng = DeltaEngine(S, clock=clock)
+        eng.capture("did:a", [])
+        clock.advance(91 * 86400)
+        eng.capture("did:a", [])
+        result = gc.collect(session_id=S, delta_engine=eng, delta_count=2)
+        assert result.retained_deltas == 1
+        assert len(eng.deltas) == 1
+
+    def test_storage_accounting(self):
+        gc = EphemeralGC()
+        result = gc.collect(
+            session_id=S,
+            estimated_vfs_bytes=1000,
+            estimated_cache_bytes=500,
+            estimated_delta_bytes=200,
+            delta_count=2,
+        )
+        assert result.storage_before_bytes == 1700
+        assert result.storage_after_bytes == 200
+        assert result.storage_saved_bytes == 1500
+        assert result.savings_pct == pytest.approx(88.235, abs=0.01)
+
+    def test_history(self):
+        gc = EphemeralGC()
+        gc.collect(session_id="s1")
+        gc.collect(session_id="s2")
+        assert gc.purged_session_count == 2
+        assert len(gc.history) == 2
+
+
+class TestMerkleRootHost:
+    def test_single_leaf_is_identity(self):
+        assert merkle_root_host(["aa" * 32]) == "aa" * 32

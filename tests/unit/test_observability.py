@@ -1,0 +1,106 @@
+"""Event bus + causal traces (mirrors reference `test_observability.py`)."""
+
+from hypervisor_tpu.observability import (
+    CausalTraceId,
+    EventType,
+    HypervisorEvent,
+    HypervisorEventBus,
+)
+
+
+class TestEventBus:
+    def setup_method(self):
+        self.bus = HypervisorEventBus()
+
+    def _emit(self, event_type, session=None, agent=None):
+        event = HypervisorEvent(
+            event_type=event_type, session_id=session, agent_did=agent
+        )
+        self.bus.emit(event)
+        return event
+
+    def test_append_and_count(self):
+        self._emit(EventType.SESSION_CREATED, "s1")
+        self._emit(EventType.SESSION_JOINED, "s1", "did:a")
+        assert self.bus.event_count == 2
+        assert len(self.bus.all_events) == 2
+
+    def test_indices(self):
+        self._emit(EventType.SESSION_CREATED, "s1")
+        self._emit(EventType.SESSION_CREATED, "s2")
+        self._emit(EventType.VOUCH_CREATED, "s1", "did:a")
+        assert len(self.bus.query_by_type(EventType.SESSION_CREATED)) == 2
+        assert len(self.bus.query_by_session("s1")) == 2
+        assert len(self.bus.query_by_agent("did:a")) == 1
+
+    def test_flexible_query_with_limit(self):
+        for i in range(5):
+            self._emit(EventType.VFS_WRITE, "s1", "did:a")
+        self._emit(EventType.VFS_WRITE, "s2", "did:a")
+        out = self.bus.query(event_type=EventType.VFS_WRITE, session_id="s1", limit=3)
+        assert len(out) == 3
+        assert all(e.session_id == "s1" for e in out)
+
+    def test_subscribers(self):
+        seen, wildcard = [], []
+        self.bus.subscribe(EventType.SLASH_EXECUTED, seen.append)
+        self.bus.subscribe(None, wildcard.append)
+        self._emit(EventType.SLASH_EXECUTED, "s1")
+        self._emit(EventType.SESSION_CREATED, "s1")
+        assert len(seen) == 1
+        assert len(wildcard) == 2
+
+    def test_type_counts(self):
+        self._emit(EventType.SESSION_CREATED)
+        self._emit(EventType.SESSION_CREATED)
+        self._emit(EventType.SAGA_CREATED)
+        counts = self.bus.type_counts()
+        assert counts["session.created"] == 2 and counts["saga.created"] == 1
+
+    def test_clear(self):
+        self._emit(EventType.SESSION_CREATED, "s1")
+        self.bus.clear()
+        assert self.bus.event_count == 0
+        assert self.bus.query_by_session("s1") == []
+
+    def test_event_type_codes_stable(self):
+        # 40 typed events across 8 categories (the reference README says 38
+        # but its enum defines 40 — we match the enum).
+        assert len({t.code for t in EventType}) == len(EventType) == 40
+
+    def test_to_dict(self):
+        event = self._emit(EventType.RING_ASSIGNED, "s1", "did:a")
+        d = event.to_dict()
+        assert d["event_type"] == "ring.assigned"
+        assert d["session_id"] == "s1"
+
+
+class TestCausalTrace:
+    def test_child_extends_tree(self):
+        root = CausalTraceId()
+        child = root.child()
+        assert child.trace_id == root.trace_id
+        assert child.parent_span_id == root.span_id
+        assert child.depth == root.depth + 1
+        assert root.is_ancestor_of(child)
+        assert not child.is_ancestor_of(root)
+
+    def test_sibling_same_level(self):
+        root = CausalTraceId()
+        a = root.child()
+        b = a.sibling()
+        assert b.depth == a.depth and b.parent_span_id == a.parent_span_id
+        assert b.span_id != a.span_id
+
+    def test_string_roundtrip(self):
+        child = CausalTraceId().child()
+        parsed = CausalTraceId.from_string(str(child))
+        assert parsed.trace_id == child.trace_id
+        assert parsed.span_id == child.span_id
+        assert parsed.parent_span_id == child.parent_span_id
+
+    def test_invalid_string(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            CausalTraceId.from_string("garbage")

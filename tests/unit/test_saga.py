@@ -1,0 +1,298 @@
+"""Saga state machines, fan-out policies, checkpoints, DSL.
+
+Mirrors reference `test_saga.py` + `test_saga_improvements.py`: transition
+table violations, fan-out policies, checkpoint replay plans, DSL errors.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu.saga import (
+    CheckpointManager,
+    FanOutOrchestrator,
+    FanOutPolicy,
+    Saga,
+    SagaDSLError,
+    SagaDSLParser,
+    SagaOrchestrator,
+    SagaState,
+    SagaStateError,
+    SagaStep,
+    StepState,
+    STEP_TRANSITION_MATRIX,
+)
+
+S = "session:test-1"
+
+
+class TestStateMachine:
+    def _step(self):
+        return SagaStep(step_id="st", action_id="a", agent_did="d", execute_api="/x")
+
+    def test_valid_forward_path(self):
+        step = self._step()
+        step.transition(StepState.EXECUTING)
+        step.transition(StepState.COMMITTED)
+        step.transition(StepState.COMPENSATING)
+        step.transition(StepState.COMPENSATED)
+        assert step.completed_at is not None
+
+    def test_illegal_transition_raises(self):
+        step = self._step()
+        with pytest.raises(SagaStateError, match="Invalid step transition"):
+            step.transition(StepState.COMMITTED)  # PENDING -> COMMITTED
+
+    def test_terminal_states_frozen(self):
+        step = self._step()
+        step.transition(StepState.EXECUTING)
+        step.transition(StepState.FAILED)
+        with pytest.raises(SagaStateError):
+            step.transition(StepState.EXECUTING)
+
+    def test_saga_transitions(self):
+        saga = Saga(saga_id="sg", session_id=S)
+        saga.transition(SagaState.COMPENSATING)
+        saga.transition(SagaState.ESCALATED)
+        with pytest.raises(SagaStateError):
+            saga.transition(SagaState.RUNNING)
+
+    def test_transition_matrix_shape(self):
+        assert STEP_TRANSITION_MATRIX.shape == (7, 7)
+        assert STEP_TRANSITION_MATRIX.sum() == 6  # exactly 6 legal moves
+
+    def test_committed_steps_reversed(self):
+        saga = Saga(saga_id="sg", session_id=S)
+        for i in range(3):
+            step = SagaStep(
+                step_id=f"st{i}", action_id=f"a{i}", agent_did="d", execute_api="/x"
+            )
+            step.transition(StepState.EXECUTING)
+            step.transition(StepState.COMMITTED)
+            saga.steps.append(step)
+        assert [s.step_id for s in saga.committed_steps_reversed] == [
+            "st2", "st1", "st0",
+        ]
+
+    def test_to_dict_from_dict_roundtrip(self):
+        saga = Saga(saga_id="sg", session_id=S)
+        saga.steps.append(self._step())
+        data = saga.to_dict()
+        back = Saga.from_dict(data)
+        assert back.saga_id == "sg" and back.steps[0].step_id == "st"
+        assert back.state == SagaState.RUNNING
+
+
+class TestFanOut:
+    async def _run_group(self, policy, outcomes):
+        fan = FanOutOrchestrator()
+        orch = SagaOrchestrator()
+        saga = orch.create_saga(S)
+        group = fan.create_group(saga.saga_id, policy)
+        executors = {}
+        for i, ok in enumerate(outcomes):
+            step = orch.add_step(saga.saga_id, f"a{i}", "did:x", "/x")
+            fan.add_branch(group.group_id, step)
+
+            async def run(ok=ok):
+                if not ok:
+                    raise RuntimeError("branch failed")
+                return "ok"
+
+            executors[step.step_id] = run
+        return await fan.execute(group.group_id, executors)
+
+    async def test_all_must_succeed(self):
+        group = await self._run_group(FanOutPolicy.ALL_MUST_SUCCEED, [True, True])
+        assert group.policy_satisfied and group.compensation_needed == []
+        group = await self._run_group(FanOutPolicy.ALL_MUST_SUCCEED, [True, False])
+        assert not group.policy_satisfied
+        assert len(group.compensation_needed) == 1  # the winner rolls back
+
+    async def test_majority(self):
+        group = await self._run_group(
+            FanOutPolicy.MAJORITY_MUST_SUCCEED, [True, True, False]
+        )
+        assert group.policy_satisfied
+        group = await self._run_group(
+            FanOutPolicy.MAJORITY_MUST_SUCCEED, [True, False, False]
+        )
+        assert not group.policy_satisfied
+
+    async def test_any(self):
+        group = await self._run_group(
+            FanOutPolicy.ANY_MUST_SUCCEED, [False, False, True]
+        )
+        assert group.policy_satisfied
+        group = await self._run_group(FanOutPolicy.ANY_MUST_SUCCEED, [False, False])
+        assert not group.policy_satisfied
+
+    async def test_missing_executor_is_failure(self):
+        fan = FanOutOrchestrator()
+        orch = SagaOrchestrator()
+        saga = orch.create_saga(S)
+        group = fan.create_group(saga.saga_id)
+        step = orch.add_step(saga.saga_id, "a", "did:x", "/x")
+        fan.add_branch(group.group_id, step)
+        result = await fan.execute(group.group_id, executors={})
+        assert not result.policy_satisfied
+        assert "No executor" in result.branches[0].error
+
+
+class TestCheckpoints:
+    def test_save_and_skip_on_replay(self):
+        mgr = CheckpointManager()
+        mgr.save("sg", "st1", "Schema migrated", {"version": 5})
+        assert mgr.is_achieved("sg", "Schema migrated", "st1")
+        assert not mgr.is_achieved("sg", "Schema migrated", "st2")
+        assert not mgr.is_achieved("other", "Schema migrated", "st1")
+
+    def test_invalidate(self):
+        mgr = CheckpointManager()
+        mgr.save("sg", "st1", "Goal A")
+        assert mgr.invalidate("sg", "st1", reason="state changed") == 1
+        assert not mgr.is_achieved("sg", "Goal A", "st1")
+        assert mgr.valid_checkpoints == 0 and mgr.total_checkpoints == 1
+
+    def test_replay_plan(self):
+        mgr = CheckpointManager()
+        mgr.save("sg", "st1", "A")
+        mgr.save("sg", "st3", "C")
+        plan = mgr.get_replay_plan("sg", ["st1", "st2", "st3", "st4"])
+        assert plan == ["st2", "st4"]
+
+    def test_state_snapshot_preserved(self):
+        mgr = CheckpointManager()
+        mgr.save("sg", "st1", "A", {"rows": 42})
+        ckpt = mgr.get_checkpoint("sg", "A", "st1")
+        assert ckpt.state_snapshot == {"rows": 42}
+
+
+class TestDSL:
+    def _definition(self, **overrides):
+        d = {
+            "name": "deploy",
+            "session_id": S,
+            "steps": [
+                {"id": "validate", "action_id": "m.validate", "agent": "did:v",
+                 "execute_api": "/v", "undo_api": "/uv"},
+                {"id": "deploy", "action_id": "m.deploy", "agent": "did:d",
+                 "timeout": 600, "retries": 2},
+            ],
+        }
+        d.update(overrides)
+        return d
+
+    def test_parse_valid(self):
+        parsed = SagaDSLParser().parse(self._definition())
+        assert parsed.name == "deploy"
+        assert [s.id for s in parsed.steps] == ["validate", "deploy"]
+        assert parsed.steps[1].timeout == 600 and parsed.steps[1].retries == 2
+
+    def test_missing_name_session_steps(self):
+        parser = SagaDSLParser()
+        with pytest.raises(SagaDSLError, match="name"):
+            parser.parse(self._definition(name=""))
+        with pytest.raises(SagaDSLError, match="session_id"):
+            parser.parse(self._definition(session_id=""))
+        with pytest.raises(SagaDSLError, match="at least one step"):
+            parser.parse(self._definition(steps=[]))
+
+    def test_duplicate_step_ids(self):
+        d = self._definition()
+        d["steps"].append(dict(d["steps"][0]))
+        with pytest.raises(SagaDSLError, match="Duplicate"):
+            SagaDSLParser().parse(d)
+
+    def test_fanout_validation(self):
+        d = self._definition(
+            fan_out=[{"policy": "majority_must_succeed", "branches": ["validate"]}]
+        )
+        with pytest.raises(SagaDSLError, match="at least 2"):
+            SagaDSLParser().parse(d)
+        d = self._definition(
+            fan_out=[{"policy": "bogus", "branches": ["validate", "deploy"]}]
+        )
+        with pytest.raises(SagaDSLError, match="Invalid fan-out policy"):
+            SagaDSLParser().parse(d)
+        d = self._definition(
+            fan_out=[{"policy": "any_must_succeed", "branches": ["validate", "ghost"]}]
+        )
+        with pytest.raises(SagaDSLError, match="not a valid step"):
+            SagaDSLParser().parse(d)
+
+    def test_to_saga_steps(self):
+        parsed = SagaDSLParser().parse(self._definition())
+        steps = SagaDSLParser.to_saga_steps(parsed)
+        assert all(isinstance(s, SagaStep) for s in steps)
+        assert steps[0].undo_api == "/uv"
+
+    def test_validate_collects_errors(self):
+        errors = SagaDSLParser.validate({"steps": [{"id": "a"}, {"id": "a"}]})
+        assert "Missing 'name'" in errors
+        assert any("Duplicate" in e for e in errors)
+        assert any("action_id" in e for e in errors)
+
+    def test_sequential_vs_fanout_steps(self):
+        d = self._definition(
+            fan_out=[{"policy": "any_must_succeed", "branches": ["validate", "deploy"]}]
+        )
+        parsed = SagaDSLParser().parse(d)
+        assert parsed.sequential_steps == []
+        assert parsed.fan_out_step_ids == {"validate", "deploy"}
+
+
+class TestBatchedSagaOps:
+    def test_transition_matrix_gather(self):
+        from hypervisor_tpu.ops import saga_ops
+
+        frm = np.array([0, 1, 1, 2, 6], np.int8)  # P, E, E, C, F
+        to = np.array([1, 2, 6, 3, 1], np.int8)   # E, C, F, CP, E
+        valid = np.asarray(saga_ops.step_transition_valid(frm, to))
+        assert valid.tolist() == [True, True, True, True, False]
+
+    def test_execute_attempt_retry_ladder(self):
+        from hypervisor_tpu.ops import saga_ops
+
+        state = np.zeros(3, np.int8)  # all PENDING
+        success = np.array([True, False, False])
+        retries = np.array([0, 1, 0], np.int32)
+        new_state, new_retries = saga_ops.execute_attempt(state, success, retries)
+        assert np.asarray(new_state).tolist() == [
+            saga_ops.STEP_COMMITTED,
+            saga_ops.STEP_PENDING,   # retrying
+            saga_ops.STEP_FAILED,
+        ]
+        assert np.asarray(new_retries).tolist() == [0, 0, 0]
+
+    def test_fanout_policy_check_batch(self):
+        from hypervisor_tpu.ops import saga_ops
+
+        success = np.array([[1, 1, 1], [1, 0, 0], [0, 0, 1]], bool)
+        valid = np.ones((3, 3), bool)
+        policy = np.array([0, 1, 2], np.int8)  # ALL, MAJORITY, ANY
+        out = np.asarray(saga_ops.fanout_policy_check(success, valid, policy))
+        assert out.tolist() == [True, False, True]
+
+    def test_settle_sagas(self):
+        from hypervisor_tpu.ops import saga_ops
+
+        step_state = np.array(
+            [
+                [2, 2, 0],  # committed + pending -> completed
+                [4, 5, 4],  # compensation failed -> escalated
+                [4, 4, 4],  # all compensated -> completed
+            ],
+            np.int8,
+        )
+        saga_state = np.array(
+            [saga_ops.SAGA_RUNNING, saga_ops.SAGA_COMPENSATING, saga_ops.SAGA_COMPENSATING],
+            np.int8,
+        )
+        out = np.asarray(saga_ops.settle_sagas(step_state, saga_state))
+        assert out.tolist() == [
+            saga_ops.SAGA_COMPLETED,
+            saga_ops.SAGA_ESCALATED,
+            saga_ops.SAGA_COMPLETED,
+        ]

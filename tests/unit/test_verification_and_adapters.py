@@ -1,0 +1,265 @@
+"""History verification + the three integration adapters with mocks.
+
+Mirrors the reference's verification unit coverage and the adapter mock
+seams from `tests/integration/test_scenarios.py:49-143`.
+"""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from hypervisor_tpu.verification import (
+    TransactionHistoryVerifier,
+    TransactionRecord,
+    VerificationStatus,
+)
+from hypervisor_tpu.integrations import (
+    CMVKAdapter,
+    DriftSeverity,
+    DriftThresholds,
+    IATPAdapter,
+    NexusAdapter,
+)
+from hypervisor_tpu.models import ExecutionRing, ReversibilityLevel
+from hypervisor_tpu.utils.clock import ManualClock
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+def _history(n, start=T0, hash_fn=lambda i: f"{i:064d}"):
+    return [
+        TransactionRecord(
+            session_id=f"s{i}",
+            summary_hash=hash_fn(i),
+            timestamp=start + timedelta(hours=i),
+        )
+        for i in range(n)
+    ]
+
+
+class TestVerifier:
+    def setup_method(self):
+        self.v = TransactionHistoryVerifier()
+
+    def test_no_history_probationary(self):
+        result = self.v.verify("did:new")
+        assert result.status == VerificationStatus.PROBATIONARY
+        assert result.is_trustworthy
+
+    def test_short_history_probationary(self):
+        result = self.v.verify("did:young", _history(3))
+        assert result.status == VerificationStatus.PROBATIONARY
+        assert "need 5" in result.inconsistencies[0]
+
+    def test_clean_history_verified(self):
+        result = self.v.verify("did:old", _history(6))
+        assert result.status == VerificationStatus.VERIFIED
+
+    def test_duplicate_hashes_suspicious(self):
+        result = self.v.verify("did:dup", _history(6, hash_fn=lambda i: "x" * 64))
+        assert result.status == VerificationStatus.SUSPICIOUS
+        assert not result.is_trustworthy
+
+    def test_nonmonotonic_timestamps_suspicious(self):
+        history = _history(6)
+        history[3].timestamp = T0 - timedelta(days=1)
+        result = self.v.verify("did:warp", history)
+        assert result.status == VerificationStatus.SUSPICIOUS
+        assert any("Non-monotonic" in i for i in result.inconsistencies)
+
+    def test_short_hash_suspicious(self):
+        result = self.v.verify("did:shorthash", _history(6, hash_fn=lambda i: f"h{i}"))
+        assert result.status == VerificationStatus.SUSPICIOUS
+        assert any("Invalid hash" in i for i in result.inconsistencies)
+
+    def test_cache(self):
+        self.v.verify("did:a", _history(6))
+        again = self.v.verify("did:a")
+        assert again.cached
+        self.v.clear_cache("did:a")
+        assert not self.v.verify("did:a").cached
+
+
+class MockScore:
+    def __init__(self, total):
+        self.total_score = total
+        self.successful_tasks = 10
+        self.failed_tasks = 1
+
+
+class MockScorer:
+    def __init__(self, table):
+        self.table = table
+        self.slashes = []
+        self.outcomes = []
+
+    def calculate_trust_score(self, verification_level, history, capabilities=None,
+                              privacy=None):
+        return MockScore(self.table.get("current", 500))
+
+    def slash_reputation(self, agent_did, reason, severity,
+                         evidence_hash=None, trace_id=None, broadcast=True):
+        self.slashes.append((agent_did, severity))
+
+    def record_task_outcome(self, agent_did, outcome):
+        self.outcomes.append((agent_did, outcome))
+
+
+class TestNexusAdapter:
+    def test_default_without_scorer(self):
+        assert NexusAdapter().resolve_sigma("did:a") == 0.50
+
+    def test_score_normalization_and_tier(self):
+        adapter = NexusAdapter(scorer=MockScorer({"current": 920}))
+        assert adapter.resolve_sigma("did:a") == pytest.approx(0.92)
+        assert adapter.get_cached_result("did:a").tier == "verified_partner"
+
+    def test_cache_ttl(self):
+        clock = ManualClock()
+        scorer = MockScorer({"current": 700})
+        adapter = NexusAdapter(scorer=scorer, cache_ttl_seconds=300, clock=clock)
+        adapter.resolve_sigma("did:a")
+        scorer.table["current"] = 100
+        assert adapter.resolve_sigma("did:a") == pytest.approx(0.70)  # cached
+        clock.advance(301)
+        assert adapter.resolve_sigma("did:a") == pytest.approx(0.10)  # refreshed
+
+    def test_report_slash_invalidates_cache(self):
+        scorer = MockScorer({"current": 800})
+        adapter = NexusAdapter(scorer=scorer)
+        adapter.resolve_sigma("did:a")
+        adapter.report_slash("did:a", "drift", severity="high")
+        assert scorer.slashes == [("did:a", "high")]
+        assert adapter.get_cached_result("did:a") is None
+
+    def test_tier_ladder(self):
+        adapter = NexusAdapter()
+        assert adapter._tier(950) == "verified_partner"
+        assert adapter._tier(750) == "trusted"
+        assert adapter._tier(550) == "standard"
+        assert adapter._tier(350) == "probationary"
+        assert adapter._tier(100) == "untrusted"
+
+    def test_batch_resolution(self):
+        adapter = NexusAdapter(scorer=MockScorer({"current": 600}))
+        sigmas = adapter.resolve_sigma_batch(["did:a", "did:b"])
+        assert sigmas.tolist() == pytest.approx([0.6, 0.6])
+
+
+class MockVerdict:
+    def __init__(self, drift):
+        self.drift_score = drift
+        self.explanation = f"drift {drift}"
+
+
+class MockCMVK:
+    def __init__(self, drift):
+        self.drift = drift
+
+    def verify_embeddings(self, embedding_a, embedding_b, metric="cosine",
+                          weights=None, threshold_profile=None, explain=False):
+        return MockVerdict(self.drift)
+
+
+class TestCMVKAdapter:
+    def test_no_verifier_passes(self):
+        result = CMVKAdapter().check_behavioral_drift("did:a", "s", [1], [1])
+        assert result.passed and result.severity == DriftSeverity.NONE
+
+    @pytest.mark.parametrize(
+        "drift,severity,slash,demote",
+        [
+            (0.05, DriftSeverity.NONE, False, False),
+            (0.20, DriftSeverity.LOW, False, False),
+            (0.40, DriftSeverity.MEDIUM, False, True),
+            (0.60, DriftSeverity.HIGH, True, False),
+            (0.90, DriftSeverity.CRITICAL, True, False),
+        ],
+    )
+    def test_severity_ladder(self, drift, severity, slash, demote):
+        adapter = CMVKAdapter(verifier=MockCMVK(drift))
+        result = adapter.check_behavioral_drift("did:a", "s", [1], [0])
+        assert result.severity == severity
+        assert result.should_slash == slash
+        assert result.should_demote == demote
+
+    def test_custom_thresholds(self):
+        adapter = CMVKAdapter(
+            verifier=MockCMVK(0.40), thresholds=DriftThresholds(high=0.35)
+        )
+        assert adapter.check_behavioral_drift("did:a", "s", [1], [0]).should_slash
+
+    def test_on_drift_callback_and_history(self):
+        detected = []
+        adapter = CMVKAdapter(verifier=MockCMVK(0.6), on_drift_detected=detected.append)
+        adapter.check_behavioral_drift("did:a", "s1", [1], [0])
+        adapter.check_behavioral_drift("did:a", "s2", [1], [0])
+        assert len(detected) == 2
+        assert len(adapter.get_agent_drift_history("did:a")) == 2
+        assert len(adapter.get_agent_drift_history("did:a", "s1")) == 1
+        assert adapter.get_drift_rate("did:a") == 1.0
+        assert adapter.get_mean_drift_score("did:a") == pytest.approx(0.6)
+        assert adapter.total_checks == 2 and adapter.total_violations == 2
+
+
+class TestIATPAdapter:
+    def _manifest(self, **overrides):
+        d = {
+            "agent_id": "did:worker",
+            "trust_level": "trusted",
+            "trust_score": 8,
+            "scopes": ["read", "write"],
+            "actions": [
+                {"action_id": "db.write", "reversibility": "full",
+                 "undo_api": "/undo"},
+                {"action_id": "email.send", "reversibility": "none"},
+            ],
+        }
+        d.update(overrides)
+        return d
+
+    def test_dict_analysis(self):
+        analysis = IATPAdapter().analyze_manifest_dict(self._manifest())
+        assert analysis.ring_hint == ExecutionRing.RING_2_STANDARD
+        assert analysis.sigma_hint == pytest.approx(0.8)
+        assert analysis.has_reversible_actions
+        assert analysis.has_non_reversible_actions
+        assert len(analysis.actions) == 2
+        assert analysis.actions[0].reversibility == ReversibilityLevel.FULL
+
+    def test_unknown_trust_level_sandboxed(self):
+        analysis = IATPAdapter().analyze_manifest_dict(
+            self._manifest(trust_level="weird")
+        )
+        assert analysis.ring_hint == ExecutionRing.RING_3_SANDBOX
+
+    def test_verified_partner_ring1_hint(self):
+        analysis = IATPAdapter().analyze_manifest_dict(
+            self._manifest(trust_level="verified_partner")
+        )
+        assert analysis.ring_hint == ExecutionRing.RING_1_PRIVILEGED
+
+    def test_object_manifest(self):
+        class Caps:
+            reversibility = "partial"
+            undo_window = "300s"
+
+        class Manifest:
+            agent_id = "did:obj"
+            trust_level = "standard"
+            capabilities = Caps()
+            scopes = ["x"]
+
+            def calculate_trust_score(self):
+                return 6
+
+        analysis = IATPAdapter().analyze_manifest(Manifest())
+        assert analysis.sigma_hint == pytest.approx(0.6)
+        assert analysis.actions[0].reversibility == ReversibilityLevel.PARTIAL
+        assert analysis.actions[0].undo_window_seconds == 300
+        assert IATPAdapter().analyze_manifest(Manifest()).agent_did == "did:obj"
+
+    def test_cache(self):
+        adapter = IATPAdapter()
+        adapter.analyze_manifest_dict(self._manifest())
+        assert adapter.get_cached_analysis("did:worker") is not None

@@ -1,0 +1,251 @@
+"""Request/response schemas for the Hypervisor REST API.
+
+Capability parity with reference `api/models.py` (24 models, same field
+sets). Schemas are pydantic models when pydantic is installed; otherwise
+they degrade to lightweight dataclass-like records with `model_dump()` —
+the service layer (`api.service`) only relies on that method, so the API
+works in the bare image.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from hypervisor_tpu.models import ConsistencyMode
+
+try:
+    from pydantic import BaseModel, Field
+
+    _HAVE_PYDANTIC = True
+except ImportError:  # pragma: no cover - pydantic is present in CI
+    _HAVE_PYDANTIC = False
+
+    def Field(default=..., description: str = ""):  # type: ignore[no-redef]
+        return default
+
+    class BaseModel:  # type: ignore[no-redef]
+        """Minimal stand-in: kwargs -> attributes, model_dump()."""
+
+        def __init__(self, **kw):
+            ann = {}
+            for klass in reversed(type(self).__mro__):
+                ann.update(getattr(klass, "__annotations__", {}))
+            for name in ann:
+                if name in kw:
+                    setattr(self, name, kw.pop(name))
+                elif hasattr(type(self), name):
+                    setattr(self, name, getattr(type(self), name))
+                else:
+                    raise TypeError(f"missing required field {name!r}")
+            if kw:
+                raise TypeError(f"unexpected fields {sorted(kw)}")
+
+        def model_dump(self) -> dict:
+            out = {}
+            ann = {}
+            for klass in reversed(type(self).__mro__):
+                ann.update(getattr(klass, "__annotations__", {}))
+            for name in ann:
+                value = getattr(self, name)
+                if isinstance(value, BaseModel):
+                    value = value.model_dump()
+                elif isinstance(value, list):
+                    value = [
+                        v.model_dump() if isinstance(v, BaseModel) else v for v in value
+                    ]
+                out[name] = value
+            return out
+
+
+# ── Sessions ─────────────────────────────────────────────────────────
+
+
+class CreateSessionRequest(BaseModel):
+    creator_did: str
+    consistency_mode: ConsistencyMode = ConsistencyMode.EVENTUAL
+    max_participants: int = 10
+    max_duration_seconds: int = 3600
+    min_sigma_eff: float = 0.60
+    enable_audit: bool = True
+    enable_blockchain_commitment: bool = False
+
+
+class ParticipantInfo(BaseModel):
+    agent_did: str
+    ring: int
+    sigma_raw: float
+    sigma_eff: float
+    joined_at: str
+    is_active: bool
+
+
+class CreateSessionResponse(BaseModel):
+    session_id: str
+    state: str
+    consistency_mode: str
+    created_at: str
+
+
+class SessionListItem(BaseModel):
+    session_id: str
+    state: str
+    consistency_mode: str
+    participant_count: int
+    created_at: str
+
+
+class SessionDetailResponse(BaseModel):
+    session_id: str
+    state: str
+    consistency_mode: str
+    creator_did: str
+    participant_count: int
+    participants: list[ParticipantInfo]
+    created_at: str
+    terminated_at: Optional[str] = None
+    sagas: list[dict] = []
+
+
+class JoinSessionRequest(BaseModel):
+    agent_did: str
+    actions: Optional[list[dict]] = None
+    sigma_raw: float = 0.0
+
+
+class JoinSessionResponse(BaseModel):
+    agent_did: str
+    session_id: str
+    assigned_ring: int
+    ring_name: str
+
+
+# ── Rings ────────────────────────────────────────────────────────────
+
+
+class RingDistributionResponse(BaseModel):
+    session_id: str
+    distribution: dict[str, list[str]]
+
+
+class AgentRingResponse(BaseModel):
+    agent_did: str
+    ring: int
+    ring_name: str
+    session_id: str
+
+
+class RingCheckRequest(BaseModel):
+    agent_ring: int
+    action: dict
+    sigma_eff: float
+    has_consensus: bool = False
+    has_sre_witness: bool = False
+
+
+class RingCheckResponse(BaseModel):
+    allowed: bool
+    required_ring: int
+    agent_ring: int
+    sigma_eff: float
+    reason: str
+    requires_consensus: bool = False
+    requires_sre_witness: bool = False
+
+
+# ── Sagas ────────────────────────────────────────────────────────────
+
+
+class CreateSagaResponse(BaseModel):
+    saga_id: str
+    session_id: str
+    state: str
+    created_at: str
+
+
+class SagaDetailResponse(BaseModel):
+    saga_id: str
+    session_id: str
+    state: str
+    created_at: str
+    completed_at: Optional[str] = None
+    error: Optional[str] = None
+    steps: list[dict] = []
+
+
+class AddStepRequest(BaseModel):
+    action_id: str
+    agent_did: str
+    execute_api: str
+    undo_api: Optional[str] = None
+    timeout_seconds: int = 300
+    max_retries: int = 0
+
+
+class AddStepResponse(BaseModel):
+    step_id: str
+    saga_id: str
+    action_id: str
+    state: str
+
+
+class ExecuteStepResponse(BaseModel):
+    step_id: str
+    saga_id: str
+    state: str
+    error: Optional[str] = None
+
+
+# ── Liability ────────────────────────────────────────────────────────
+
+
+class CreateVouchRequest(BaseModel):
+    voucher_did: str
+    vouchee_did: str
+    voucher_sigma: float
+    bond_pct: Optional[float] = None
+    expiry: Optional[str] = None
+
+
+class VouchResponse(BaseModel):
+    vouch_id: str
+    voucher_did: str
+    vouchee_did: str
+    session_id: str
+    bonded_amount: float
+    bonded_sigma_pct: float
+    is_active: bool
+
+
+class LiabilityExposureResponse(BaseModel):
+    agent_did: str
+    vouches_given: list[VouchResponse]
+    vouches_received: list[VouchResponse]
+    total_exposure: float
+
+
+# ── Events / stats ───────────────────────────────────────────────────
+
+
+class EventResponse(BaseModel):
+    event_id: str
+    event_type: str
+    timestamp: str
+    session_id: Optional[str] = None
+    agent_did: Optional[str] = None
+    causal_trace_id: Optional[str] = None
+    payload: dict = {}
+
+
+class EventStatsResponse(BaseModel):
+    total_events: int
+    by_type: dict[str, int]
+
+
+class StatsResponse(BaseModel):
+    version: str
+    total_sessions: int
+    active_sessions: int
+    total_participants: int
+    active_sagas: int
+    total_vouches: int
+    event_count: int

@@ -1,0 +1,236 @@
+"""REST transports for the Hypervisor API.
+
+Two transports over the same `HypervisorService` (21 endpoints, matching
+reference `api/server.py`):
+
+ - `create_app()` — a FastAPI application with CORS-open middleware and
+   OpenAPI docs, when fastapi is installed.
+ - `serve()` / `HypervisorHTTPServer` — a dependency-free stdlib
+   `http.server` JSON transport for the bare image (same routes, same
+   status codes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+from typing import Any, Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from hypervisor_tpu import __version__
+from hypervisor_tpu.api import models as M
+from hypervisor_tpu.api.service import ApiError, HypervisorService
+
+# ── Route table: (method, pattern, handler_name, request_model) ──────
+# {name} segments become handler kwargs; query params pass through for GET.
+
+ROUTES: list[tuple[str, str, str, Optional[type]]] = [
+    ("GET", "/health", "health", None),
+    ("GET", "/api/v1/stats", "stats", None),
+    ("POST", "/api/v1/sessions", "create_session", M.CreateSessionRequest),
+    ("GET", "/api/v1/sessions", "list_sessions", None),
+    ("GET", "/api/v1/sessions/{session_id}", "get_session", None),
+    ("POST", "/api/v1/sessions/{session_id}/join", "join_session", M.JoinSessionRequest),
+    ("POST", "/api/v1/sessions/{session_id}/activate", "activate_session", None),
+    ("POST", "/api/v1/sessions/{session_id}/terminate", "terminate_session", None),
+    ("GET", "/api/v1/sessions/{session_id}/rings", "ring_distribution", None),
+    ("GET", "/api/v1/agents/{agent_did}/ring", "agent_ring", None),
+    ("POST", "/api/v1/rings/check", "ring_check", M.RingCheckRequest),
+    ("POST", "/api/v1/sessions/{session_id}/sagas", "create_saga", None),
+    ("GET", "/api/v1/sessions/{session_id}/sagas", "list_sagas", None),
+    ("GET", "/api/v1/sagas/{saga_id}", "get_saga", None),
+    ("POST", "/api/v1/sagas/{saga_id}/steps", "add_saga_step", M.AddStepRequest),
+    (
+        "POST",
+        "/api/v1/sagas/{saga_id}/steps/{step_id}/execute",
+        "execute_saga_step",
+        None,
+    ),
+    ("POST", "/api/v1/sessions/{session_id}/vouch", "create_vouch", M.CreateVouchRequest),
+    ("GET", "/api/v1/sessions/{session_id}/vouches", "list_vouches", None),
+    ("GET", "/api/v1/agents/{agent_did}/liability", "agent_liability", None),
+    ("GET", "/api/v1/events", "query_events", None),
+    ("GET", "/api/v1/events/stats", "event_stats", None),
+]
+
+_QUERY_PARAMS = {
+    "list_sessions": ("state",),
+    "query_events": ("event_type", "session_id", "agent_did", "limit"),
+}
+
+
+def _to_jsonable(result: Any) -> Any:
+    if hasattr(result, "model_dump"):
+        return result.model_dump()
+    if isinstance(result, list):
+        return [_to_jsonable(r) for r in result]
+    return result
+
+
+# ── FastAPI transport (optional dependency) ──────────────────────────
+
+
+def create_app(service: Optional[HypervisorService] = None):
+    """Build the FastAPI app; raises ImportError when fastapi is absent."""
+    from fastapi import FastAPI, HTTPException, Request
+    from fastapi.middleware.cors import CORSMiddleware
+
+    svc = service or HypervisorService()
+    app = FastAPI(
+        title="Hypervisor-TPU API",
+        description=(
+            "REST API for the TPU-native Agent Hypervisor — multi-agent "
+            "Shared Sessions with Execution Rings, Joint Liability, Saga "
+            "orchestration, and Merkle audit trails."
+        ),
+        version=__version__,
+    )
+    app.add_middleware(
+        CORSMiddleware,
+        allow_origins=["*"],
+        allow_credentials=True,
+        allow_methods=["*"],
+        allow_headers=["*"],
+    )
+    app.state.service = svc
+
+    for method, pattern, name, request_model in ROUTES:
+        def make_endpoint(name=name, request_model=request_model):
+            async def endpoint(request: Request):
+                path_kwargs = dict(request.path_params)
+                if request_model is not None:
+                    body = await request.json()
+                    path_kwargs["req"] = request_model(**body)
+                for q in _QUERY_PARAMS.get(name, ()):
+                    if q in request.query_params:
+                        value = request.query_params[q]
+                        path_kwargs[q] = int(value) if q == "limit" else value
+                try:
+                    result = await getattr(svc, name)(**path_kwargs)
+                except ApiError as e:
+                    raise HTTPException(status_code=e.status, detail=e.detail)
+                return _to_jsonable(result)
+
+            return endpoint
+
+        app.add_api_route(
+            pattern,
+            make_endpoint(),
+            methods=[method],
+            status_code=201 if (method, name) in _CREATED else 200,
+        )
+    return app
+
+
+_CREATED = {
+    ("POST", "create_session"),
+    ("POST", "create_saga"),
+    ("POST", "add_saga_step"),
+    ("POST", "create_vouch"),
+}
+
+
+# ── stdlib transport ─────────────────────────────────────────────────
+
+
+class _Router:
+    def __init__(self) -> None:
+        self._routes: list[tuple[str, re.Pattern, str, Optional[type]]] = []
+        for method, pattern, name, request_model in ROUTES:
+            regex = re.compile(
+                "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$"
+            )
+            self._routes.append((method, regex, name, request_model))
+
+    def match(self, method: str, path: str):
+        for m, regex, name, request_model in self._routes:
+            if m != method:
+                continue
+            hit = regex.match(path)
+            if hit:
+                return name, hit.groupdict(), request_model
+        return None
+
+
+class HypervisorHTTPServer:
+    """JSON-over-stdlib-http transport for the service layer."""
+
+    def __init__(self, service: Optional[HypervisorService] = None, port: int = 0):
+        import http.server
+        import threading
+
+        self.service = service or HypervisorService()
+        router = _Router()
+        svc = self.service
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self, method: str) -> None:
+                parsed = urlparse(self.path)
+                match = router.match(method, parsed.path)
+                if match is None:
+                    self._send(404, {"detail": "Not found"})
+                    return
+                name, kwargs, request_model = match
+                if request_model is not None:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    try:
+                        kwargs["req"] = request_model(**body)
+                    except Exception as e:  # noqa: BLE001 — validation error
+                        self._send(422, {"detail": str(e)})
+                        return
+                query = parse_qs(parsed.query)
+                for q in _QUERY_PARAMS.get(name, ()):
+                    if q in query:
+                        value = query[q][0]
+                        kwargs[q] = int(value) if q == "limit" else value
+                try:
+                    result = asyncio.run(getattr(svc, name)(**kwargs))
+                except ApiError as e:
+                    self._send(e.status, {"detail": e.detail})
+                    return
+                status = 201 if ("POST", name) in _CREATED else 200
+                self._send(status, _to_jsonable(result))
+
+            def _send(self, status: int, payload: Any) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    def start(self) -> "HypervisorHTTPServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def serve(port: int = 8000, service: Optional[HypervisorService] = None) -> None:
+    """Blocking server entry point: FastAPI+uvicorn if present, else stdlib."""
+    try:
+        import uvicorn  # noqa: F401
+
+        uvicorn.run(create_app(service), host="0.0.0.0", port=port)
+    except ImportError:
+        server = HypervisorHTTPServer(service, port=port)
+        print(f"hypervisor-tpu API (stdlib transport) on :{server.port}")
+        server._httpd.serve_forever()

@@ -1,0 +1,386 @@
+"""Framework-agnostic API service: the 21 endpoints as plain async methods.
+
+Capability parity with reference `api/server.py` (21 endpoints in 6 tag
+groups). The reference binds handlers directly to FastAPI; here the
+handlers live in one `HypervisorService` so the same logic serves FastAPI
+(when installed), the stdlib HTTP fallback (`api.server.serve`), and
+direct in-process calls in tests. Errors raise `ApiError(status, detail)`
+which each transport maps to its error shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from hypervisor_tpu import __version__
+from hypervisor_tpu.core import Hypervisor, ManagedSession
+from hypervisor_tpu.models import ActionDescriptor, ExecutionRing, SessionConfig
+from hypervisor_tpu.observability import EventType, HypervisorEventBus
+
+from hypervisor_tpu.api import models as M
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, detail: str) -> None:
+        super().__init__(detail)
+        self.status = status
+        self.detail = detail
+
+
+class HypervisorService:
+    """All endpoint handlers over one Hypervisor + event bus pair."""
+
+    def __init__(
+        self,
+        hypervisor: Optional[Hypervisor] = None,
+        event_bus: Optional[HypervisorEventBus] = None,
+    ) -> None:
+        self.bus = event_bus or HypervisorEventBus()
+        self.hv = hypervisor or Hypervisor(event_bus=self.bus)
+
+    # ── Health ───────────────────────────────────────────────────────
+
+    async def health(self) -> dict[str, str]:
+        return {"status": "ok", "version": __version__}
+
+    async def stats(self) -> M.StatsResponse:
+        sessions = self.hv._sessions.values()
+        return M.StatsResponse(
+            version=__version__,
+            total_sessions=len(self.hv._sessions),
+            active_sessions=len(self.hv.active_sessions),
+            total_participants=sum(m.sso.participant_count for m in sessions),
+            active_sagas=sum(len(m.saga.active_sagas) for m in sessions),
+            total_vouches=self.hv.vouching.vouch_count,
+            event_count=self.bus.event_count,
+        )
+
+    # ── Sessions ─────────────────────────────────────────────────────
+
+    async def create_session(self, req: M.CreateSessionRequest) -> M.CreateSessionResponse:
+        config = SessionConfig(
+            consistency_mode=req.consistency_mode,
+            max_participants=req.max_participants,
+            max_duration_seconds=req.max_duration_seconds,
+            min_sigma_eff=req.min_sigma_eff,
+            enable_audit=req.enable_audit,
+            enable_blockchain_commitment=req.enable_blockchain_commitment,
+        )
+        managed = await self.hv.create_session(config=config, creator_did=req.creator_did)
+        sso = managed.sso
+        return M.CreateSessionResponse(
+            session_id=sso.session_id,
+            state=sso.state.value,
+            consistency_mode=sso.consistency_mode.value,
+            created_at=sso.created_at.isoformat(),
+        )
+
+    async def list_sessions(self, state: Optional[str] = None) -> list[M.SessionListItem]:
+        sessions = list(self.hv._sessions.values())
+        if state:
+            sessions = [m for m in sessions if m.sso.state.value == state]
+        return [
+            M.SessionListItem(
+                session_id=m.sso.session_id,
+                state=m.sso.state.value,
+                consistency_mode=m.sso.consistency_mode.value,
+                participant_count=m.sso.participant_count,
+                created_at=m.sso.created_at.isoformat(),
+            )
+            for m in sessions
+        ]
+
+    async def get_session(self, session_id: str) -> M.SessionDetailResponse:
+        managed = self._managed(session_id)
+        sso = managed.sso
+        return M.SessionDetailResponse(
+            session_id=sso.session_id,
+            state=sso.state.value,
+            consistency_mode=sso.consistency_mode.value,
+            creator_did=sso.creator_did,
+            participant_count=sso.participant_count,
+            participants=[
+                M.ParticipantInfo(
+                    agent_did=p.agent_did,
+                    ring=p.ring.value,
+                    sigma_raw=p.sigma_raw,
+                    sigma_eff=p.sigma_eff,
+                    joined_at=p.joined_at.isoformat(),
+                    is_active=p.is_active,
+                )
+                for p in sso.participants
+            ],
+            created_at=sso.created_at.isoformat(),
+            terminated_at=sso.terminated_at.isoformat() if sso.terminated_at else None,
+            sagas=[s.to_dict() for s in managed.saga._sagas.values()],
+        )
+
+    async def join_session(
+        self, session_id: str, req: M.JoinSessionRequest
+    ) -> M.JoinSessionResponse:
+        actions = [ActionDescriptor(**a) for a in req.actions] if req.actions else None
+        try:
+            ring = await self.hv.join_session(
+                session_id=session_id,
+                agent_did=req.agent_did,
+                actions=actions,
+                sigma_raw=req.sigma_raw,
+            )
+        except ValueError as e:
+            raise ApiError(404, str(e)) from e
+        except Exception as e:
+            raise ApiError(400, str(e)) from e
+        return M.JoinSessionResponse(
+            agent_did=req.agent_did,
+            session_id=session_id,
+            assigned_ring=ring.value,
+            ring_name=ring.name,
+        )
+
+    async def activate_session(self, session_id: str) -> dict[str, str]:
+        try:
+            await self.hv.activate_session(session_id)
+        except ValueError as e:
+            raise ApiError(404, str(e)) from e
+        except Exception as e:
+            raise ApiError(400, str(e)) from e
+        return {"session_id": session_id, "state": "active"}
+
+    async def terminate_session(self, session_id: str) -> dict[str, Any]:
+        try:
+            merkle_root = await self.hv.terminate_session(session_id)
+        except ValueError as e:
+            raise ApiError(404, str(e)) from e
+        except Exception as e:
+            raise ApiError(400, str(e)) from e
+        return {
+            "session_id": session_id,
+            "state": "archived",
+            "merkle_root": merkle_root,
+        }
+
+    # ── Rings ────────────────────────────────────────────────────────
+
+    async def ring_distribution(self, session_id: str) -> M.RingDistributionResponse:
+        managed = self._managed(session_id)
+        distribution: dict[str, list[str]] = {}
+        for p in managed.sso.participants:
+            distribution.setdefault(p.ring.name, []).append(p.agent_did)
+        return M.RingDistributionResponse(
+            session_id=session_id, distribution=distribution
+        )
+
+    async def agent_ring(self, agent_did: str) -> M.AgentRingResponse:
+        for managed in self.hv._sessions.values():
+            for p in managed.sso.participants:
+                if p.agent_did == agent_did and p.is_active:
+                    return M.AgentRingResponse(
+                        agent_did=agent_did,
+                        ring=p.ring.value,
+                        ring_name=p.ring.name,
+                        session_id=managed.sso.session_id,
+                    )
+        raise ApiError(404, f"Agent {agent_did} not found in any session")
+
+    async def ring_check(self, req: M.RingCheckRequest) -> M.RingCheckResponse:
+        result = self.hv.ring_enforcer.check(
+            agent_ring=ExecutionRing(req.agent_ring),
+            action=ActionDescriptor(**req.action),
+            sigma_eff=req.sigma_eff,
+            has_consensus=req.has_consensus,
+            has_sre_witness=req.has_sre_witness,
+        )
+        return M.RingCheckResponse(
+            allowed=result.allowed,
+            required_ring=result.required_ring.value,
+            agent_ring=result.agent_ring.value,
+            sigma_eff=result.sigma_eff,
+            reason=result.reason,
+            requires_consensus=result.requires_consensus,
+            requires_sre_witness=result.requires_sre_witness,
+        )
+
+    # ── Sagas ────────────────────────────────────────────────────────
+
+    async def create_saga(self, session_id: str) -> M.CreateSagaResponse:
+        managed = self._managed(session_id)
+        saga = managed.saga.create_saga(session_id)
+        return M.CreateSagaResponse(
+            saga_id=saga.saga_id,
+            session_id=saga.session_id,
+            state=saga.state.value,
+            created_at=saga.created_at.isoformat(),
+        )
+
+    async def list_sagas(self, session_id: str) -> list[M.SagaDetailResponse]:
+        managed = self._managed(session_id)
+        return [self._saga_detail(s) for s in managed.saga._sagas.values()]
+
+    async def get_saga(self, saga_id: str) -> M.SagaDetailResponse:
+        _, saga = self._find_saga(saga_id)
+        return self._saga_detail(saga)
+
+    async def add_saga_step(self, saga_id: str, req: M.AddStepRequest) -> M.AddStepResponse:
+        managed, _ = self._find_saga(saga_id)
+        try:
+            step = managed.saga.add_step(
+                saga_id=saga_id,
+                action_id=req.action_id,
+                agent_did=req.agent_did,
+                execute_api=req.execute_api,
+                undo_api=req.undo_api,
+                timeout_seconds=req.timeout_seconds,
+                max_retries=req.max_retries,
+            )
+        except Exception as e:
+            raise ApiError(400, str(e)) from e
+        return M.AddStepResponse(
+            step_id=step.step_id,
+            saga_id=saga_id,
+            action_id=step.action_id,
+            state=step.state.value,
+        )
+
+    async def execute_saga_step(self, saga_id: str, step_id: str) -> M.ExecuteStepResponse:
+        managed, saga = self._find_saga(saga_id)
+
+        async def noop_executor() -> dict[str, str]:
+            return {"status": "executed_via_api"}
+
+        try:
+            await managed.saga.execute_step(saga_id, step_id, noop_executor)
+        except Exception as e:
+            raise ApiError(400, str(e)) from e
+        for step in saga.steps:
+            if step.step_id == step_id:
+                return M.ExecuteStepResponse(
+                    step_id=step_id,
+                    saga_id=saga_id,
+                    state=step.state.value,
+                    error=step.error,
+                )
+        raise ApiError(404, f"Step {step_id} not found")
+
+    # ── Liability ────────────────────────────────────────────────────
+
+    async def create_vouch(self, session_id: str, req: M.CreateVouchRequest) -> M.VouchResponse:
+        self._managed(session_id)
+        try:
+            record = self.hv.vouching.vouch(
+                voucher_did=req.voucher_did,
+                vouchee_did=req.vouchee_did,
+                session_id=session_id,
+                voucher_sigma=req.voucher_sigma,
+                bond_pct=req.bond_pct,
+            )
+        except Exception as e:
+            raise ApiError(400, str(e)) from e
+        return self._vouch_response(record)
+
+    async def list_vouches(self, session_id: str) -> list[M.VouchResponse]:
+        self._managed(session_id)
+        return [
+            self._vouch_response(v)
+            for v in self.hv.vouching.session_records(session_id)
+        ]
+
+    async def agent_liability(self, agent_did: str) -> M.LiabilityExposureResponse:
+        given, received, exposure = [], [], 0.0
+        for v in self.hv.vouching.agent_records(agent_did):
+            vr = self._vouch_response(v)
+            if v.voucher_did == agent_did:
+                given.append(vr)
+                if v.is_active and not v.is_expired:
+                    exposure += v.bonded_amount
+            if v.vouchee_did == agent_did:
+                received.append(vr)
+        return M.LiabilityExposureResponse(
+            agent_did=agent_did,
+            vouches_given=given,
+            vouches_received=received,
+            total_exposure=exposure,
+        )
+
+    # ── Events ───────────────────────────────────────────────────────
+
+    async def query_events(
+        self,
+        event_type: Optional[str] = None,
+        session_id: Optional[str] = None,
+        agent_did: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> list[M.EventResponse]:
+        et = None
+        if event_type:
+            try:
+                et = EventType(event_type)
+            except ValueError as e:
+                raise ApiError(400, f"Unknown event type: {event_type}") from e
+        events = self.bus.query(
+            event_type=et, session_id=session_id, agent_did=agent_did, limit=limit
+        )
+        return [
+            M.EventResponse(
+                event_id=e.event_id,
+                event_type=e.event_type.value,
+                timestamp=e.timestamp.isoformat(),
+                session_id=e.session_id,
+                agent_did=e.agent_did,
+                causal_trace_id=e.causal_trace_id,
+                payload=e.payload,
+            )
+            for e in events
+        ]
+
+    async def event_stats(self) -> M.EventStatsResponse:
+        return M.EventStatsResponse(
+            total_events=self.bus.event_count, by_type=self.bus.type_counts()
+        )
+
+    # ── internals ────────────────────────────────────────────────────
+
+    def _managed(self, session_id: str) -> ManagedSession:
+        managed = self.hv.get_session(session_id)
+        if managed is None:
+            raise ApiError(404, f"Session {session_id} not found")
+        return managed
+
+    def _find_saga(self, saga_id: str):
+        for managed in self.hv._sessions.values():
+            saga = managed.saga.get_saga(saga_id)
+            if saga is not None:
+                return managed, saga
+        raise ApiError(404, f"Saga {saga_id} not found")
+
+    @staticmethod
+    def _saga_detail(saga) -> M.SagaDetailResponse:
+        return M.SagaDetailResponse(
+            saga_id=saga.saga_id,
+            session_id=saga.session_id,
+            state=saga.state.value,
+            created_at=saga.created_at.isoformat(),
+            completed_at=saga.completed_at.isoformat() if saga.completed_at else None,
+            error=saga.error,
+            steps=[
+                {
+                    "step_id": s.step_id,
+                    "action_id": s.action_id,
+                    "agent_did": s.agent_did,
+                    "state": s.state.value,
+                    "error": s.error,
+                }
+                for s in saga.steps
+            ],
+        )
+
+    @staticmethod
+    def _vouch_response(v) -> M.VouchResponse:
+        return M.VouchResponse(
+            vouch_id=v.vouch_id,
+            voucher_did=v.voucher_did,
+            vouchee_did=v.vouchee_did,
+            session_id=v.session_id,
+            bonded_amount=v.bonded_amount,
+            bonded_sigma_pct=v.bonded_sigma_pct,
+            is_active=v.is_active,
+        )

@@ -188,6 +188,32 @@ class VouchingEngine:
             self._released[int(r)] = now
         return int(len(rows))
 
+    # ── record iteration (API/stats surface) ─────────────────────────
+
+    @property
+    def vouch_count(self) -> int:
+        """Total edges ever created (active or released)."""
+        return self._n
+
+    def all_records(self) -> list[VouchRecord]:
+        return [self._view(r) for r in range(self._n)]
+
+    def session_records(self, session_id: str) -> list[VouchRecord]:
+        hs = self.sessions.lookup(session_id)
+        if hs < 0:
+            return []
+        rows = np.nonzero(self._session[: self._n] == hs)[0]
+        return [self._view(int(r)) for r in rows]
+
+    def agent_records(self, agent_did: str) -> list[VouchRecord]:
+        """Every edge where the agent is voucher or vouchee."""
+        h = self.agents.lookup(agent_did)
+        if h < 0:
+            return []
+        n = self._n
+        rows = np.nonzero((self._voucher[:n] == h) | (self._vouchee[:n] == h))[0]
+        return [self._view(int(r)) for r in rows]
+
     # ── device export ────────────────────────────────────────────────
 
     def to_device(self, capacity: Optional[int] = None):

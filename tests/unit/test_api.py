@@ -1,0 +1,227 @@
+"""API service layer + stdlib HTTP transport (21 endpoints).
+
+Mirrors the reference's API surface (`api/server.py`): sessions, rings,
+sagas, liability, events, health — exercised both in-process and over HTTP.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from hypervisor_tpu.api import ApiError, HypervisorService, HypervisorHTTPServer, ROUTES
+from hypervisor_tpu.api import models as M
+from hypervisor_tpu.observability import EventType
+
+
+@pytest.fixture
+def svc():
+    return HypervisorService()
+
+
+async def _make_session(svc, **kw):
+    resp = await svc.create_session(
+        M.CreateSessionRequest(creator_did="did:admin", **kw)
+    )
+    return resp.session_id
+
+
+class TestHealthAndStats:
+    async def test_health(self, svc):
+        out = await svc.health()
+        assert out["status"] == "ok"
+
+    async def test_stats_counts(self, svc):
+        sid = await _make_session(svc)
+        await svc.join_session(sid, M.JoinSessionRequest(agent_did="did:a", sigma_raw=0.8))
+        stats = await svc.stats()
+        assert stats.total_sessions == 1
+        assert stats.total_participants == 1
+        assert stats.event_count >= 2  # created + joined
+
+
+class TestSessionEndpoints:
+    async def test_create_list_get(self, svc):
+        sid = await _make_session(svc, max_participants=5)
+        items = await svc.list_sessions()
+        assert [i.session_id for i in items] == [sid]
+        assert (await svc.list_sessions(state="archived")) == []
+        detail = await svc.get_session(sid)
+        assert detail.state == "handshaking"
+        assert detail.creator_did == "did:admin"
+
+    async def test_join_activate_terminate(self, svc):
+        sid = await _make_session(svc)
+        join = await svc.join_session(
+            sid, M.JoinSessionRequest(agent_did="did:a", sigma_raw=0.8)
+        )
+        assert join.assigned_ring == 2 and join.ring_name == "RING_2_STANDARD"
+        out = await svc.activate_session(sid)
+        assert out["state"] == "active"
+        out = await svc.terminate_session(sid)
+        assert out["state"] == "archived"
+
+    async def test_join_missing_session_404(self, svc):
+        with pytest.raises(ApiError) as e:
+            await svc.join_session(
+                "session:ghost", M.JoinSessionRequest(agent_did="did:a")
+            )
+        assert e.value.status == 404
+
+    async def test_duplicate_join_400(self, svc):
+        sid = await _make_session(svc)
+        await svc.join_session(sid, M.JoinSessionRequest(agent_did="did:a", sigma_raw=0.8))
+        with pytest.raises(ApiError) as e:
+            await svc.join_session(
+                sid, M.JoinSessionRequest(agent_did="did:a", sigma_raw=0.8)
+            )
+        assert e.value.status == 400
+
+
+class TestRingEndpoints:
+    async def test_distribution_and_agent_ring(self, svc):
+        sid = await _make_session(svc)
+        await svc.join_session(sid, M.JoinSessionRequest(agent_did="did:hi", sigma_raw=0.9))
+        await svc.join_session(sid, M.JoinSessionRequest(agent_did="did:lo", sigma_raw=0.1))
+        dist = await svc.ring_distribution(sid)
+        assert dist.distribution["RING_2_STANDARD"] == ["did:hi"]
+        assert dist.distribution["RING_3_SANDBOX"] == ["did:lo"]
+        ring = await svc.agent_ring("did:hi")
+        assert ring.ring == 2 and ring.session_id == sid
+        with pytest.raises(ApiError):
+            await svc.agent_ring("did:ghost")
+
+    async def test_ring_check(self, svc):
+        resp = await svc.ring_check(
+            M.RingCheckRequest(
+                agent_ring=2,
+                action={"action_id": "a", "name": "a", "execute_api": "/x",
+                        "reversibility": "full"},
+                sigma_eff=0.8,
+            )
+        )
+        assert resp.allowed
+        resp = await svc.ring_check(
+            M.RingCheckRequest(
+                agent_ring=3,
+                action={"action_id": "a", "name": "a", "execute_api": "/x",
+                        "reversibility": "full"},
+                sigma_eff=0.8,
+            )
+        )
+        assert not resp.allowed and "insufficient" in resp.reason
+
+
+class TestSagaEndpoints:
+    async def test_full_saga_flow(self, svc):
+        sid = await _make_session(svc)
+        saga = await svc.create_saga(sid)
+        step = await svc.add_saga_step(
+            saga.saga_id,
+            M.AddStepRequest(action_id="a", agent_did="did:x", execute_api="/x"),
+        )
+        assert step.state == "pending"
+        out = await svc.execute_saga_step(saga.saga_id, step.step_id)
+        assert out.state == "committed"
+        detail = await svc.get_saga(saga.saga_id)
+        assert detail.steps[0]["state"] == "committed"
+        listing = await svc.list_sagas(sid)
+        assert len(listing) == 1
+
+    async def test_missing_saga_404(self, svc):
+        with pytest.raises(ApiError) as e:
+            await svc.get_saga("saga:ghost")
+        assert e.value.status == 404
+
+
+class TestLiabilityEndpoints:
+    async def test_vouch_flow(self, svc):
+        sid = await _make_session(svc)
+        vouch = await svc.create_vouch(
+            sid,
+            M.CreateVouchRequest(
+                voucher_did="did:h", vouchee_did="did:l", voucher_sigma=0.9
+            ),
+        )
+        assert vouch.bonded_amount == pytest.approx(0.18)
+        vouches = await svc.list_vouches(sid)
+        assert len(vouches) == 1
+        exposure = await svc.agent_liability("did:h")
+        assert exposure.total_exposure == pytest.approx(0.18)
+        assert len(exposure.vouches_given) == 1
+        exposure = await svc.agent_liability("did:l")
+        assert len(exposure.vouches_received) == 1
+
+    async def test_bad_vouch_400(self, svc):
+        sid = await _make_session(svc)
+        with pytest.raises(ApiError) as e:
+            await svc.create_vouch(
+                sid,
+                M.CreateVouchRequest(
+                    voucher_did="did:a", vouchee_did="did:a", voucher_sigma=0.9
+                ),
+            )
+        assert e.value.status == 400
+
+
+class TestEventEndpoints:
+    async def test_query_and_stats(self, svc):
+        sid = await _make_session(svc)
+        events = await svc.query_events(event_type="session.created")
+        assert len(events) == 1 and events[0].session_id == sid
+        with pytest.raises(ApiError):
+            await svc.query_events(event_type="bogus.type")
+        stats = await svc.event_stats()
+        assert stats.total_events >= 1
+        assert stats.by_type[EventType.SESSION_CREATED.value] == 1
+
+
+class TestHTTPTransport:
+    def test_routes_count_matches_reference(self):
+        assert len(ROUTES) == 21
+
+    def test_end_to_end_over_http(self):
+        server = HypervisorHTTPServer().start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            def call(method, path, body=None):
+                data = json.dumps(body).encode() if body is not None else None
+                req = urllib.request.Request(
+                    base + path, data=data, method=method,
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req) as resp:
+                        return resp.status, json.loads(resp.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            status, health = call("GET", "/health")
+            assert status == 200 and health["status"] == "ok"
+
+            status, created = call(
+                "POST", "/api/v1/sessions", {"creator_did": "did:admin"}
+            )
+            assert status == 201
+            sid = created["session_id"]
+
+            status, joined = call(
+                "POST",
+                f"/api/v1/sessions/{sid}/join",
+                {"agent_did": "did:a", "sigma_raw": 0.8},
+            )
+            assert status == 200 and joined["assigned_ring"] == 2
+
+            status, _ = call("POST", f"/api/v1/sessions/{sid}/activate")
+            assert status == 200
+
+            status, terminated = call("POST", f"/api/v1/sessions/{sid}/terminate")
+            assert status == 200 and terminated["state"] == "archived"
+
+            status, err = call("GET", "/api/v1/sessions/session:ghost")
+            assert status == 404
+
+            status, events = call("GET", "/api/v1/events?limit=2")
+            assert status == 200 and len(events) == 2
+        finally:
+            server.stop()

@@ -1,0 +1,82 @@
+"""Vectorized vector-clock math: happens-before over dense clock matrices.
+
+The reference compares clocks dict-by-dict (`session/vector_clock.py:40-56`);
+here a batch of pending writes validates against the path-clock matrix in
+two vector comparisons. Used by the device-plane batched write prepass;
+`session.vector_clock` is the string-keyed host view of the same columns.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+def happens_before(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """bool[...]: a < b component-wise over the trailing clock axis.
+
+    a, b: i32[..., A] clock vectors.
+    """
+    return jnp.all(a <= b, axis=-1) & jnp.any(a < b, axis=-1)
+
+
+def is_concurrent(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return ~happens_before(a, b) & ~happens_before(b, a)
+
+
+def merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Component-wise max (clock join)."""
+    return jnp.maximum(a, b)
+
+
+class WritePrepass(NamedTuple):
+    allowed: jnp.ndarray      # bool[W] write admitted
+    path_clocks: jnp.ndarray  # i32[P, A] updated path clocks
+    agent_clocks: jnp.ndarray # i32[N, A] updated agent clocks
+    conflicts: jnp.ndarray    # i32 scalar count of rejected writes
+
+
+def batched_write_prepass(
+    path_clocks: jnp.ndarray,   # i32[P, A]
+    agent_clocks: jnp.ndarray,  # i32[N, A]
+    write_path: jnp.ndarray,    # i32[W] path row per pending write
+    write_agent: jnp.ndarray,   # i32[W] agent row per pending write
+    strict: jnp.ndarray | bool = True,
+) -> WritePrepass:
+    """Resolve a batch of independent writes (distinct paths) in one pass.
+
+    Semantics per write match `vector_clock.py:104-149`: under strict mode a
+    writer whose clock happens-before the path's clock is rejected (stale);
+    admitted writes tick the agent component and join into the path clock.
+
+    Writes in one batch must target distinct paths (the scheduler groups
+    same-path writes into successive batches).
+    """
+    pc = path_clocks[write_path]          # i32[W, A]
+    ac = agent_clocks[write_agent]        # i32[W, A]
+    path_nonempty = jnp.any(pc > 0, axis=-1)
+    stale = happens_before(ac, pc)
+    strict = jnp.broadcast_to(jnp.asarray(strict), stale.shape)
+    rejected = strict & path_nonempty & stale
+    allowed = ~rejected
+
+    # Tick admitted writers' own component.
+    w = write_agent.shape[0]
+    onehot = (
+        jnp.arange(agent_clocks.shape[1], dtype=jnp.int32)[None, :]
+        == write_agent[:, None]
+    )
+    ac_new = ac + jnp.where(allowed[:, None] & onehot, 1, 0)
+    pc_new = jnp.where(allowed[:, None], merge(pc, ac_new), pc)
+
+    path_clocks = path_clocks.at[write_path].set(pc_new)
+    agent_clocks = agent_clocks.at[write_agent].set(
+        jnp.where(allowed[:, None], ac_new, ac)
+    )
+    return WritePrepass(
+        allowed=allowed,
+        path_clocks=path_clocks,
+        agent_clocks=agent_clocks,
+        conflicts=jnp.sum(rejected.astype(jnp.int32)),
+    )

@@ -1,0 +1,63 @@
+"""Batched token-bucket rate limiting over agent-table columns.
+
+The reference keeps one TokenBucket object per (agent, session)
+(`security/rate_limiter.py:21-48`); here refill+consume for the whole agent
+table is one branch-free update over the `rl_tokens` / `rl_stamp` f32
+columns, with per-ring rates/bursts gathered from config vectors.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG, RateLimitConfig
+
+
+class RateDecision(NamedTuple):
+    allowed: jnp.ndarray   # bool[N]
+    tokens: jnp.ndarray    # f32[N] post-decision bucket levels
+    stamp: jnp.ndarray     # f32[N] updated refill stamps
+
+
+def consume(
+    tokens: jnp.ndarray,
+    stamp: jnp.ndarray,
+    ring: jnp.ndarray,
+    now: jnp.ndarray | float,
+    cost: jnp.ndarray | float = 1.0,
+    config: RateLimitConfig = DEFAULT_CONFIG.rate_limit,
+) -> RateDecision:
+    """Refill-then-consume for every agent at once.
+
+    tokens/stamp are the agent table's bucket columns; ring selects the
+    per-ring (rate, burst) pair. Rejected rows keep their refilled level.
+    """
+    rates = jnp.asarray(np.asarray(config.ring_rates, np.float32))
+    bursts = jnp.asarray(np.asarray(config.ring_bursts, np.float32))
+    ring = jnp.clip(ring.astype(jnp.int32), 0, 3)
+    rate = rates[ring]
+    burst = bursts[ring]
+
+    now = jnp.asarray(now, jnp.float32)
+    elapsed = jnp.maximum(now - stamp, 0.0)
+    refilled = jnp.minimum(burst, tokens + elapsed * rate)
+    allowed = refilled >= cost
+    new_tokens = jnp.where(allowed, refilled - cost, refilled)
+    new_stamp = jnp.broadcast_to(now, stamp.shape)
+    return RateDecision(allowed=allowed, tokens=new_tokens, stamp=new_stamp)
+
+
+def reset_on_ring_change(
+    tokens: jnp.ndarray,
+    ring_changed: jnp.ndarray,
+    new_ring: jnp.ndarray,
+    config: RateLimitConfig = DEFAULT_CONFIG.rate_limit,
+) -> jnp.ndarray:
+    """Recreate buckets at full burst where the ring changed
+    (`rate_limiter.py:132-149` semantics)."""
+    bursts = jnp.asarray(np.asarray(config.ring_bursts, np.float32))
+    full = bursts[jnp.clip(new_ring.astype(jnp.int32), 0, 3)]
+    return jnp.where(ring_changed, full, tokens)

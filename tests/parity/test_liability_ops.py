@@ -1,0 +1,238 @@
+"""Batched liability ops vs the host engines (same inputs, same outcomes)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from hypervisor_tpu.liability import SlashingEngine, VouchingEngine
+from hypervisor_tpu.ops import liability as lops
+from hypervisor_tpu.ops import rate_limit as rlops
+from hypervisor_tpu.ops import clock_ops
+from hypervisor_tpu.models import ExecutionRing
+from hypervisor_tpu.security import AgentRateLimiter
+from hypervisor_tpu.session.vector_clock import VectorClockManager, CausalViolationError
+from hypervisor_tpu.utils.clock import ManualClock
+
+S = "session:par"
+
+
+def build_engine(edges):
+    """edges: list of (voucher, vouchee, sigma, pct)."""
+    eng = VouchingEngine()
+    for voucher, vouchee, sigma, pct in edges:
+        eng.vouch(voucher, vouchee, S, sigma, bond_pct=pct)
+    return eng
+
+
+class TestSigmaEffParity:
+    def test_contribution_matches_host(self):
+        eng = build_engine(
+            [("h1", "l", 0.9, 0.2), ("h2", "l", 0.8, 0.3), ("h1", "m", 0.9, 0.1)]
+        )
+        table = eng.to_device(capacity=8)
+        sess = eng.sessions.lookup(S)
+        for vouchee, sigma in [("l", 0.4), ("m", 0.3), ("nobody", 0.5)]:
+            slot = eng.agents.lookup(vouchee)
+            batch = lops.voucher_contribution(
+                table,
+                jnp.asarray([max(slot, 0)], jnp.int32)
+                if slot >= 0
+                else jnp.asarray([99], jnp.int32),
+                jnp.asarray([sess], jnp.int32),
+                now=0.0,
+            )
+            host = eng.compute_sigma_eff(vouchee, S, sigma, risk_weight=1.0) - sigma
+            assert float(batch[0]) == pytest.approx(host, abs=1e-6)
+
+    def test_exposure_matches_host(self):
+        eng = build_engine([("h", "a", 0.8, 0.3), ("h", "b", 0.8, 0.2)])
+        table = eng.to_device(capacity=8)
+        out = lops.exposure_by_voucher(
+            table,
+            jnp.asarray([eng.agents.lookup("h")], jnp.int32),
+            jnp.asarray([eng.sessions.lookup(S)], jnp.int32),
+            now=0.0,
+        )
+        assert float(out[0]) == pytest.approx(eng.get_total_exposure("h", S), abs=1e-6)
+
+
+class TestSlashCascadeParity:
+    def _run_both(self, edges, seed, sigma0, omega):
+        """Run host SlashingEngine and device slash_cascade on the same graph."""
+        host_eng = build_engine(edges)
+        slasher = SlashingEngine(host_eng)
+        scores = dict(sigma0)
+        slasher.slash(seed, S, sigma0[seed], omega, "parity", scores)
+
+        dev_eng = build_engine(edges)
+        table = dev_eng.to_device(capacity=16)
+        n = len(dev_eng.agents)
+        sigma = np.zeros(n, np.float32)
+        for name, v in sigma0.items():
+            slot = dev_eng.agents.lookup(name)
+            if slot >= 0:
+                sigma[slot] = v
+        seeds = np.zeros(n, bool)
+        seeds[dev_eng.agents.lookup(seed)] = True
+        result = lops.slash_cascade(
+            table,
+            jnp.asarray(sigma),
+            jnp.asarray(seeds),
+            dev_eng.sessions.lookup(S),
+            omega,
+            now=0.0,
+        )
+        dev_scores = {
+            name: float(np.asarray(result.sigma)[dev_eng.agents.lookup(name)])
+            for name in sigma0
+        }
+        return scores, dev_scores, result
+
+    def test_simple_slash(self):
+        host, dev, _ = self._run_both(
+            [("h", "l", 0.9, 0.2)], "l", {"h": 0.9, "l": 0.4}, omega=0.5
+        )
+        for k in host:
+            assert dev[k] == pytest.approx(host[k], abs=1e-6), k
+
+    def test_cascade_depth_1(self):
+        host, dev, result = self._run_both(
+            [("g", "h", 0.9, 0.2), ("h", "l", 0.9, 0.2)],
+            "l",
+            {"g": 0.9, "h": 0.9, "l": 0.4},
+            omega=0.99,
+        )
+        for k in host:
+            assert dev[k] == pytest.approx(host[k], abs=1e-5), k
+        assert int(np.asarray(result.slashed).sum()) >= 2
+
+    def test_no_cascade_when_survives(self):
+        host, dev, _ = self._run_both(
+            [("g", "h", 0.9, 0.2), ("h", "l", 0.9, 0.2)],
+            "l",
+            {"g": 0.9, "h": 0.9, "l": 0.4},
+            omega=0.5,
+        )
+        for k in host:
+            assert dev[k] == pytest.approx(host[k], abs=1e-6), k
+
+    def test_multi_vouchee_simultaneous_clip(self):
+        # One voucher backing two seeds slashed in the same wave: the
+        # (1-omega)^k formula must match sequential clipping.
+        host_eng = build_engine([("h", "a", 0.9, 0.2), ("h", "b", 0.9, 0.2)])
+        slasher = SlashingEngine(host_eng)
+        scores = {"h": 0.9, "a": 0.4, "b": 0.4}
+        slasher.slash("a", S, 0.4, 0.5, "x", scores)
+        slasher.slash("b", S, 0.4, 0.5, "x", scores)
+
+        dev_eng = build_engine([("h", "a", 0.9, 0.2), ("h", "b", 0.9, 0.2)])
+        table = dev_eng.to_device(capacity=8)
+        n = len(dev_eng.agents)
+        sigma = np.zeros(n, np.float32)
+        for name, v in {"h": 0.9, "a": 0.4, "b": 0.4}.items():
+            sigma[dev_eng.agents.lookup(name)] = v
+        seeds = np.zeros(n, bool)
+        seeds[dev_eng.agents.lookup("a")] = True
+        seeds[dev_eng.agents.lookup("b")] = True
+        result = lops.slash_cascade(
+            table, jnp.asarray(sigma), jnp.asarray(seeds),
+            dev_eng.sessions.lookup(S), 0.5, now=0.0,
+        )
+        got = float(np.asarray(result.sigma)[dev_eng.agents.lookup("h")])
+        assert got == pytest.approx(scores["h"], abs=1e-6)
+
+
+class TestRateLimitParity:
+    def test_batch_matches_scalar_buckets(self):
+        clock = ManualClock()
+        host = AgentRateLimiter(clock=clock)
+        t0 = clock().timestamp()
+
+        n = 4
+        rings = np.array([0, 1, 2, 3], np.int8)
+        tokens = np.asarray(
+            [200.0, 100.0, 40.0, 10.0], np.float32
+        )  # full buckets
+        stamp = np.full(n, t0, np.float32)
+
+        # Consume 12 sequentially; compare allowed counts per ring.
+        batch_allowed = np.zeros(n, np.int32)
+        tok, stp = jnp.asarray(tokens), jnp.asarray(stamp)
+        for _ in range(12):
+            decision = rlops.consume(tok, stp, jnp.asarray(rings), now=t0)
+            tok, stp = decision.tokens, decision.stamp
+            batch_allowed += np.asarray(decision.allowed)
+
+        host_allowed = np.zeros(n, np.int32)
+        for i, ring in enumerate(
+            [ExecutionRing.RING_0_ROOT, ExecutionRing.RING_1_PRIVILEGED,
+             ExecutionRing.RING_2_STANDARD, ExecutionRing.RING_3_SANDBOX]
+        ):
+            for _ in range(12):
+                if host.try_check(f"a{i}", "s", ring):
+                    host_allowed[i] += 1
+        assert batch_allowed.tolist() == host_allowed.tolist()
+
+    def test_refill_after_elapsed(self):
+        decision = rlops.consume(
+            jnp.asarray([0.0], jnp.float32),
+            jnp.asarray([0.0], jnp.float32),
+            jnp.asarray([3], jnp.int8),
+            now=1.0,  # 1s at 5 rps -> 5 tokens
+        )
+        assert bool(decision.allowed[0])
+        assert float(decision.tokens[0]) == pytest.approx(4.0)
+
+
+class TestClockOpsParity:
+    def test_write_prepass_matches_manager(self):
+        mgr = VectorClockManager()
+        mgr.write("/p0", "a0")          # a0 owns p0
+        mgr.read("/p0", "a1")           # a1 catches up
+        mgr.write("/p0", "a1")          # ok
+        # a2 stale write -> conflict
+        try:
+            mgr.write("/p0", "a2")
+        except CausalViolationError:
+            pass
+        assert mgr.conflict_count == 1
+
+        # Device mirror of the same scenario.
+        path_clocks = jnp.zeros((1, 3), jnp.int32)
+        agent_clocks = jnp.zeros((3, 3), jnp.int32)
+        # a0 writes p0
+        out = clock_ops.batched_write_prepass(
+            path_clocks, agent_clocks,
+            jnp.asarray([0], jnp.int32), jnp.asarray([0], jnp.int32),
+        )
+        assert bool(out.allowed[0])
+        # a1 reads (merge path into agent clock), then writes
+        agent_clocks = out.agent_clocks.at[1].set(
+            clock_ops.merge(out.agent_clocks[1], out.path_clocks[0])
+        )
+        out2 = clock_ops.batched_write_prepass(
+            out.path_clocks, agent_clocks,
+            jnp.asarray([0], jnp.int32), jnp.asarray([1], jnp.int32),
+        )
+        assert bool(out2.allowed[0])
+        # a2 never read -> stale, rejected
+        out3 = clock_ops.batched_write_prepass(
+            out2.path_clocks, out2.agent_clocks,
+            jnp.asarray([0], jnp.int32), jnp.asarray([2], jnp.int32),
+        )
+        assert not bool(out3.allowed[0])
+        assert int(out3.conflicts) == 1
+        # Final path clock matches the host manager's.
+        host_clock = mgr.get_path_clock("/p0").clocks
+        dev_clock = np.asarray(out3.path_clocks[0])
+        assert dev_clock.tolist() == [host_clock.get("a0", 0), host_clock.get("a1", 0), 0]
+
+    def test_happens_before_matrix(self):
+        a = jnp.asarray([[1, 0], [1, 1], [2, 0]], jnp.int32)
+        b = jnp.broadcast_to(jnp.asarray([1, 1], jnp.int32), (3, 2))
+        hb = np.asarray(clock_ops.happens_before(a, b))
+        assert hb.tolist() == [True, False, False]
+        conc = np.asarray(clock_ops.is_concurrent(a, b))
+        # Equal clocks count as concurrent (neither happens-before), matching
+        # the reference's is_concurrent definition.
+        assert conc.tolist() == [False, True, True]

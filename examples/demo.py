@@ -1,0 +1,226 @@
+"""Demo: the five headline capabilities, end to end.
+
+Mirrors the reference demo (`examples/demo.py`): session lifecycle,
+saga + compensation, vouch/slash, Merkle audit, adapters with inline mocks —
+plus a sixth, TPU-specific demo running the fused batched governance
+pipeline on whatever accelerator JAX sees.
+
+Run: python examples/demo.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from hypervisor_tpu import (
+    ActionDescriptor,
+    Hypervisor,
+    HypervisorEventBus,
+    ReversibilityLevel,
+    SessionConfig,
+    VFSChange,
+)
+from hypervisor_tpu.integrations import CMVKAdapter, IATPAdapter, NexusAdapter
+
+
+def banner(title: str) -> None:
+    print(f"\n{'=' * 64}\n  {title}\n{'=' * 64}")
+
+
+async def demo_lifecycle(hv: Hypervisor) -> None:
+    banner("1. Session lifecycle: create → join → activate → terminate")
+    session = await hv.create_session(SessionConfig(), creator_did="did:mesh:admin")
+    sid = session.sso.session_id
+    print(f"created {sid} (state={session.sso.state.value})")
+    for agent, sigma in [("did:mesh:alice", 0.85), ("did:mesh:bob", 0.45)]:
+        ring = await hv.join_session(sid, agent, sigma_raw=sigma)
+        print(f"  {agent}: σ={sigma} → Ring {ring.value} ({ring.name})")
+    await hv.activate_session(sid)
+    print(f"active with {session.sso.participant_count} participants")
+    root = await hv.terminate_session(sid)
+    print(f"terminated; merkle root = {root}")
+
+
+async def demo_saga(hv: Hypervisor) -> None:
+    banner("2. Saga: forward execution + reverse-order compensation")
+    session = await hv.create_session(SessionConfig(), creator_did="did:mesh:admin")
+    sid = session.sso.session_id
+    await hv.join_session(sid, "did:mesh:worker", sigma_raw=0.8)
+    await hv.activate_session(sid)
+
+    saga = session.saga.create_saga(sid)
+    steps = [
+        session.saga.add_step(saga.saga_id, f"deploy.step{i}", "did:mesh:worker",
+                              f"/api/step{i}", undo_api=f"/api/undo{i}")
+        for i in range(3)
+    ]
+    for step in steps:
+        async def execute():
+            return f"done:{step.action_id}"
+        await session.saga.execute_step(saga.saga_id, step.step_id, execute)
+    print(f"executed {len(steps)} steps: "
+          f"{[s.state.value for s in saga.steps]}")
+
+    undone = []
+
+    async def compensator(step):
+        undone.append(step.action_id)
+        return "rolled back"
+
+    await session.saga.compensate(saga.saga_id, compensator)
+    print(f"compensated in reverse order: {undone}")
+    print(f"saga final state: {saga.state.value}")
+
+
+async def demo_liability(hv: Hypervisor) -> None:
+    banner("3. Joint liability: vouch → violation → slash cascade")
+    session = await hv.create_session(SessionConfig(), creator_did="did:mesh:admin")
+    sid = session.sso.session_id
+    scores = {"did:mesh:mentor": 0.90, "did:mesh:novice": 0.40}
+    rec = hv.vouching.vouch("did:mesh:mentor", "did:mesh:novice", sid, 0.90)
+    print(f"mentor bonded {rec.bonded_amount:.3f}σ for novice")
+    sigma_eff = hv.vouching.compute_sigma_eff("did:mesh:novice", sid, 0.40, 0.65)
+    print(f"novice σ_eff = 0.40 + 0.65×{rec.bonded_amount:.3f} = {sigma_eff:.3f}")
+    result = hv.slashing.slash(
+        "did:mesh:novice", sid, 0.40, 0.65, "intent violation", scores
+    )
+    print(f"slash: novice σ → {scores['did:mesh:novice']}, "
+          f"mentor clipped to {scores['did:mesh:mentor']:.3f} "
+          f"({len(result.voucher_clips)} clip)")
+
+
+async def demo_audit(hv: Hypervisor) -> None:
+    banner("4. Merkle audit: delta chain → root → tamper detection")
+    session = await hv.create_session(SessionConfig(), creator_did="did:mesh:admin")
+    sid = session.sso.session_id
+    await hv.join_session(sid, "did:mesh:writer", sigma_raw=0.8)
+    await hv.activate_session(sid)
+    for i in range(4):
+        session.sso.vfs.write(f"/report{i}.md", f"content {i}", "did:mesh:writer")
+        session.delta_engine.capture(
+            "did:mesh:writer",
+            [VFSChange(path=f"/report{i}.md", operation="add")],
+        )
+    print(f"captured {session.delta_engine.turn_count} deltas")
+    print(f"chain verifies: {session.delta_engine.verify_chain()}")
+    root = session.delta_engine.compute_merkle_root()
+    print(f"merkle root: {root[:32]}…")
+    session.delta_engine._deltas[1].agent_did = "did:mesh:attacker"
+    print(f"after tampering delta 1: chain verifies = "
+          f"{session.delta_engine.verify_chain()}")
+
+
+async def demo_adapters() -> None:
+    banner("5. Adapters: Nexus trust + CMVK drift + IATP manifests")
+
+    class MockScore:
+        total_score = 820
+        successful_tasks = 42
+        failed_tasks = 2
+
+    class MockScorer:
+        def calculate_trust_score(self, **kw):
+            return MockScore()
+
+        def slash_reputation(self, **kw):
+            print(f"  nexus: slash reported for {kw['agent_did']} ({kw['severity']})")
+
+        def record_task_outcome(self, agent_did, outcome):
+            pass
+
+    class MockVerdict:
+        drift_score = 0.62
+        explanation = "output diverges from claimed capability manifold"
+
+    class MockCMVK:
+        def verify_embeddings(self, **kw):
+            return MockVerdict()
+
+    bus = HypervisorEventBus()
+    hv = Hypervisor(
+        nexus=NexusAdapter(scorer=MockScorer()),
+        cmvk=CMVKAdapter(verifier=MockCMVK()),
+        iatp=IATPAdapter(),
+        event_bus=bus,
+    )
+    session = await hv.create_session(SessionConfig(), creator_did="did:mesh:admin")
+    sid = session.sso.session_id
+    manifest = {
+        "agent_id": "did:mesh:contractor",
+        "trust_level": "trusted",
+        "trust_score": 8,
+        "actions": [
+            {"action_id": "db.migrate", "reversibility": "partial",
+             "undo_api": "/undo/migrate"},
+        ],
+    }
+    ring = await hv.join_session(sid, "did:mesh:contractor", manifest=manifest)
+    print(f"IATP manifest → σ hint 0.8 → Ring {ring.value}")
+    await hv.activate_session(sid)
+    result = await hv.verify_behavior(
+        sid, "did:mesh:contractor", claimed_embedding=[1, 0], observed_embedding=[0, 1]
+    )
+    print(f"CMVK drift {result.drift_score} ({result.severity.value}) "
+          f"→ slashed: {result.should_slash}")
+    print(f"event bus recorded {bus.event_count} events: "
+          f"{sorted(bus.type_counts())}")
+
+
+def demo_batched_pipeline() -> None:
+    banner("6. TPU path: 4096 governance pipelines in one jitted tick")
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from hypervisor_tpu.ops import merkle as merkle_ops
+    from hypervisor_tpu.ops.pipeline import governance_pipeline
+
+    s, t = 4096, 3
+    rng = np.random.RandomState(0)
+    bodies = rng.randint(
+        0, 2**32, size=(t, s, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    tick = jax.jit(governance_pipeline)
+    result = tick(
+        jnp.full((s,), 0.8, jnp.float32),
+        jnp.ones((s,), bool),
+        jnp.full((s,), 0.60, jnp.float32),
+        jnp.asarray(bodies),
+        jnp.ones((s,), bool),
+    )
+    jax.block_until_ready(result)
+    import time
+
+    t0 = time.perf_counter()
+    result = tick(
+        jnp.full((s,), 0.8, jnp.float32),
+        jnp.ones((s,), bool),
+        jnp.full((s,), 0.60, jnp.float32),
+        jnp.asarray(bodies),
+        jnp.ones((s,), bool),
+    )
+    jax.block_until_ready(result)
+    dt = time.perf_counter() - t0
+    ok = int(np.asarray(result.status == 0).sum())
+    print(f"device: {jax.devices()[0]}")
+    print(f"{ok}/{s} sessions completed the full pipeline in {dt * 1e3:.2f} ms "
+          f"({dt / s * 1e6:.2f} µs/session)")
+
+
+async def main() -> None:
+    hv = Hypervisor()
+    await demo_lifecycle(hv)
+    await demo_saga(hv)
+    await demo_liability(hv)
+    await demo_audit(hv)
+    await demo_adapters()
+    demo_batched_pipeline()
+    print("\nAll demos complete.")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,19 @@
+"""Native host runtime: C++ audit verifier + lock-free staging queue."""
+
+from hypervisor_tpu.runtime.native import (
+    HAVE_NATIVE,
+    StagingQueue,
+    chain_digests_host,
+    merkle_root_hex_host,
+    sha256_batch_host,
+    verify_chain_host,
+)
+
+__all__ = [
+    "HAVE_NATIVE",
+    "StagingQueue",
+    "chain_digests_host",
+    "merkle_root_hex_host",
+    "sha256_batch_host",
+    "verify_chain_host",
+]

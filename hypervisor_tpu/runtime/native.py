@@ -1,0 +1,227 @@
+"""ctypes bindings for the native host runtime (native/hv_runtime.cpp).
+
+Builds the shared library on first import (g++, cached by source mtime) and
+exposes:
+
+ - `chain_digests_host` / `verify_chain_host` — binary delta chains
+   (device format) computed on the host, for audit verification without a
+   device round-trip.
+ - `merkle_root_hex_host` — reference-semantics Merkle root.
+ - `StagingQueue` — the lock-free admission queue feeding the batched tick.
+
+Every entry point has a pure-Python fallback so the package works where no
+compiler exists; `HAVE_NATIVE` reports which path is live.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "hv_runtime.cpp"
+_LIB_DIR = Path(tempfile.gettempdir()) / "hv_runtime_build"
+
+_lib: Optional[ctypes.CDLL] = None
+HAVE_NATIVE = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    if not _SRC.exists():
+        return None
+    _LIB_DIR.mkdir(exist_ok=True)
+    out = _LIB_DIR / f"libhv_runtime_{int(_SRC.stat().st_mtime)}.so"
+    if not out.exists():
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            str(_SRC), "-o", str(out),
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, FileNotFoundError):
+            return None
+    try:
+        return ctypes.CDLL(str(out))
+    except OSError:
+        return None
+
+
+def _init() -> None:
+    global _lib, HAVE_NATIVE
+    if _lib is not None:
+        return
+    _lib = _build()
+    if _lib is None:
+        return
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    _lib.hv_sha256_batch.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64, u8p]
+    _lib.hv_chain_digests.argtypes = [u8p, ctypes.c_uint64, u8p]
+    _lib.hv_verify_chain.argtypes = [u8p, u8p, ctypes.c_uint64]
+    _lib.hv_verify_chain.restype = ctypes.c_int64
+    _lib.hv_merkle_root_hex.argtypes = [u8p, ctypes.c_uint64, u8p, u8p]
+    _lib.hv_stage_init.argtypes = [
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        u8p,
+    ]
+    _lib.hv_stage_push.argtypes = [
+        ctypes.c_float, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint8,
+    ]
+    _lib.hv_stage_push.restype = ctypes.c_int64
+    _lib.hv_stage_swap.restype = ctypes.c_uint64
+    HAVE_NATIVE = True
+
+
+_init()
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+# ── audit chain (device binary format, ops/merkle.py) ────────────────
+
+
+def _bodies_to_bytes(bodies_u32: np.ndarray) -> np.ndarray:
+    """u32[N, 16] big-endian words -> u8[N, 64]."""
+    return np.ascontiguousarray(bodies_u32.astype(">u4")).view(np.uint8).reshape(
+        bodies_u32.shape[0], -1
+    )
+
+
+def chain_digests_host(bodies_u32: np.ndarray) -> np.ndarray:
+    """u32[N, 16] records -> u8[N, 32] chained digests (host path)."""
+    raw = _bodies_to_bytes(bodies_u32)
+    n = raw.shape[0]
+    out = np.empty((n, 32), np.uint8)
+    if HAVE_NATIVE:
+        _lib.hv_chain_digests(_u8(raw), n, _u8(out))
+        return out
+    parent = b"\x00" * 32
+    for i in range(n):
+        parent = hashlib.sha256(raw[i].tobytes() + parent).digest()
+        out[i] = np.frombuffer(parent, np.uint8)
+    return out
+
+
+def verify_chain_host(bodies_u32: np.ndarray, recorded: np.ndarray) -> int:
+    """Return index of first tampered record, or -1 when intact."""
+    raw = _bodies_to_bytes(bodies_u32)
+    rec = np.ascontiguousarray(recorded.astype(np.uint8))
+    n = raw.shape[0]
+    if HAVE_NATIVE:
+        return int(_lib.hv_verify_chain(_u8(raw), _u8(rec), n))
+    parent = b"\x00" * 32
+    for i in range(n):
+        digest = hashlib.sha256(raw[i].tobytes() + parent).digest()
+        if digest != rec[i].tobytes():
+            return i
+        parent = digest
+    return -1
+
+
+def merkle_root_hex_host(leaf_digests: np.ndarray) -> str:
+    """u8[N, 32] leaves -> hex root (reference hex-pair semantics)."""
+    n = leaf_digests.shape[0]
+    if n == 0:
+        raise ValueError("no leaves")
+    leaves = np.ascontiguousarray(leaf_digests.astype(np.uint8))
+    if HAVE_NATIVE:
+        scratch = np.empty((n, 32), np.uint8)
+        out = np.empty(32, np.uint8)
+        _lib.hv_merkle_root_hex(_u8(leaves), n, _u8(scratch), _u8(out))
+        return out.tobytes().hex()
+    level = [leaves[i].tobytes().hex() for i in range(n)]
+    while len(level) > 1:
+        nxt = []
+        for i in range(0, len(level), 2):
+            left = level[i]
+            right = level[i + 1] if i + 1 < len(level) else left
+            nxt.append(hashlib.sha256((left + right).encode()).hexdigest())
+        level = nxt
+    return level[0]
+
+
+def sha256_batch_host(msgs: np.ndarray) -> np.ndarray:
+    """u8[N, L] equal-length messages -> u8[N, 32] digests."""
+    msgs = np.ascontiguousarray(msgs)
+    n, length = msgs.shape
+    out = np.empty((n, 32), np.uint8)
+    if HAVE_NATIVE:
+        _lib.hv_sha256_batch(_u8(msgs), n, length, _u8(out))
+        return out
+    for i in range(n):
+        out[i] = np.frombuffer(hashlib.sha256(msgs[i].tobytes()).digest(), np.uint8)
+    return out
+
+
+# ── staging queue ────────────────────────────────────────────────────
+
+
+class StagingQueue:
+    """Lock-free SoA admission queue feeding the batched governance tick.
+
+    Producers (any thread) call `push`; the tick driver calls `harvest`
+    to get the filled column views and reset the epoch. Columns are numpy
+    arrays written directly by the native side — they hand straight to
+    `jnp.asarray` with no packing step.
+
+    Python fallback: plain list appends under the GIL (same API).
+    """
+
+    def __init__(self, capacity: int = 16_384) -> None:
+        self.capacity = capacity
+        self.sigma = np.zeros(capacity, np.float32)
+        self.agent = np.zeros(capacity, np.int32)
+        self.session = np.zeros(capacity, np.int32)
+        self.trustworthy = np.zeros(capacity, np.uint8)
+        self._py_cursor = 0
+        if HAVE_NATIVE:
+            _lib.hv_stage_init(
+                capacity,
+                self.sigma.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self.agent.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                self.session.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                _u8(self.trustworthy),
+            )
+
+    def push(
+        self, sigma: float, agent: int, session: int, trustworthy: bool = True
+    ) -> int:
+        """Claim a slot; returns the slot index or -1 when the epoch is full."""
+        if HAVE_NATIVE:
+            return int(
+                _lib.hv_stage_push(sigma, agent, session, 1 if trustworthy else 0)
+            )
+        if self._py_cursor >= self.capacity:
+            return -1
+        slot = self._py_cursor
+        self._py_cursor += 1
+        self.sigma[slot] = sigma
+        self.agent[slot] = agent
+        self.session[slot] = session
+        self.trustworthy[slot] = trustworthy
+        return slot
+
+    def harvest(self) -> tuple[int, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """(count, sigma, agent, session, trustworthy) views for the tick."""
+        if HAVE_NATIVE:
+            n = int(_lib.hv_stage_swap())
+        else:
+            n = self._py_cursor
+            self._py_cursor = 0
+        return (
+            n,
+            self.sigma[:n].copy(),
+            self.agent[:n].copy(),
+            self.session[:n].copy(),
+            self.trustworthy[:n].copy(),
+        )

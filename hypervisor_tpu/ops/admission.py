@@ -1,0 +1,155 @@
+"""Batched admission: the join_session pipeline over whole agent waves.
+
+The reference admits one agent per call through Python checks
+(`core.py:106-185`, `session/__init__.py:85-113`); here a wave of B joins
+lands on the agent/session tables in one jitted op:
+
+  * per-session capacity accounting within the wave (rank-within-group via
+    argsort, no quadratic masks),
+  * uniqueness handled at the host boundary (the interning dict already
+    knows membership — the flag rides in as `duplicate`),
+  * sigma -> ring derivation, sandboxing untrustworthy agents,
+  * min-sigma floor with the sandbox exemption,
+  * masked column writes + participant-count segment add.
+
+Exceptions become per-element status codes; the facade re-raises them
+faithfully for the single-call API (`utils.status`).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG, TrustConfig
+from hypervisor_tpu.ops import rings as ring_ops
+from hypervisor_tpu.tables.state import AgentTable, SessionTable, FLAG_ACTIVE
+from hypervisor_tpu.tables.struct import replace
+
+# Admission status codes (host maps to SessionParticipantError /
+# SessionLifecycleError messages).
+ADMIT_OK = 0
+ADMIT_BAD_STATE = 1     # session not HANDSHAKING|ACTIVE
+ADMIT_DUPLICATE = 2     # agent already in session
+ADMIT_CAPACITY = 3      # session at max_participants
+ADMIT_SIGMA_LOW = 4     # sigma_eff below session floor (non-sandbox)
+
+_S_HANDSHAKING = 1
+_S_ACTIVE = 2
+
+
+def _rank_within_session(session_slot: jnp.ndarray) -> jnp.ndarray:
+    """i32[B]: how many earlier wave elements target the same session.
+
+    Stable argsort groups equal sessions; rank = index - group start.
+    """
+    from jax import lax
+
+    b = session_slot.shape[0]
+    order = jnp.argsort(session_slot, stable=True)
+    sorted_sess = session_slot[order]
+    idx = jnp.arange(b, dtype=jnp.int32)
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_sess[1:] != sorted_sess[:-1]]
+    )
+    group_start = lax.cummax(jnp.where(is_new, idx, 0))
+    rank_sorted = idx - group_start
+    return jnp.zeros((b,), jnp.int32).at[order].set(rank_sorted)
+
+
+class AdmissionResult(NamedTuple):
+    agents: AgentTable
+    sessions: SessionTable
+    status: jnp.ndarray     # i8[B]
+    ring: jnp.ndarray       # i8[B]
+    sigma_eff: jnp.ndarray  # f32[B]
+
+
+def admit_batch(
+    agents: AgentTable,
+    sessions: SessionTable,
+    slot: jnp.ndarray,          # i32[B] preallocated agent-table rows
+    did: jnp.ndarray,           # i32[B] intern handles
+    session_slot: jnp.ndarray,  # i32[B]
+    sigma_raw: jnp.ndarray,     # f32[B]
+    trustworthy: jnp.ndarray,   # bool[B]
+    duplicate: jnp.ndarray,     # bool[B] host-known membership clash
+    now: jnp.ndarray | float,
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+) -> AdmissionResult:
+    """Admit a wave of B agents; rejected elements leave no trace."""
+    sess_state = sessions.state[session_slot]
+    sess_count = sessions.n_participants[session_slot]
+    sess_max = sessions.max_participants[session_slot]
+    sess_min_sigma = sessions.min_sigma_eff[session_slot]
+
+    sigma_eff = sigma_raw
+    ring = ring_ops.compute_rings(sigma_eff, False, trust)
+    ring = jnp.where(trustworthy, ring, jnp.int8(3))
+
+    bad_state = (sess_state != _S_HANDSHAKING) & (sess_state != _S_ACTIVE)
+    sigma_low = (sigma_eff < sess_min_sigma) & (ring != 3)
+
+    status = jnp.full(slot.shape, ADMIT_OK, jnp.int8)
+
+    def claim(status, cond, code):
+        return jnp.where((status == ADMIT_OK) & cond, jnp.int8(code), status)
+
+    status = claim(status, bad_state, ADMIT_BAD_STATE)
+    status = claim(status, duplicate, ADMIT_DUPLICATE)
+    status = claim(status, sigma_low, ADMIT_SIGMA_LOW)
+
+    # Capacity: rank only among elements that pass every other check (a
+    # rejected element must not consume a seat). Rejected elements get a
+    # unique negative session key so they never share a rank group.
+    passed_other = status == ADMIT_OK
+    rank = _rank_within_session(
+        jnp.where(
+            passed_other,
+            session_slot,
+            -1 - jnp.arange(slot.shape[0], dtype=jnp.int32),
+        )
+    )
+    over_capacity = passed_other & ((sess_count + rank) >= sess_max)
+    status = claim(status, over_capacity, ADMIT_CAPACITY)
+    ok = status == ADMIT_OK
+
+    write_slot = jnp.where(ok, slot, agents.did.shape[0] - 1)  # park rejects
+    now_f = jnp.asarray(now, jnp.float32)
+
+    new_agents = replace(
+        agents,
+        did=agents.did.at[write_slot].set(jnp.where(ok, did, agents.did[write_slot])),
+        session=agents.session.at[write_slot].set(
+            jnp.where(ok, session_slot, agents.session[write_slot])
+        ),
+        sigma_raw=agents.sigma_raw.at[write_slot].set(
+            jnp.where(ok, sigma_raw, agents.sigma_raw[write_slot])
+        ),
+        sigma_eff=agents.sigma_eff.at[write_slot].set(
+            jnp.where(ok, sigma_eff, agents.sigma_eff[write_slot])
+        ),
+        ring=agents.ring.at[write_slot].set(
+            jnp.where(ok, ring, agents.ring[write_slot])
+        ),
+        flags=agents.flags.at[write_slot].set(
+            jnp.where(ok, FLAG_ACTIVE, agents.flags[write_slot])
+        ),
+        joined_at=agents.joined_at.at[write_slot].set(
+            jnp.where(ok, now_f, agents.joined_at[write_slot])
+        ),
+    )
+    new_sessions = replace(
+        sessions,
+        n_participants=sessions.n_participants.at[
+            jnp.where(ok, session_slot, sessions.sid.shape[0] - 1)
+        ].add(jnp.where(ok, 1, 0)),
+    )
+    return AdmissionResult(
+        agents=new_agents,
+        sessions=new_sessions,
+        status=status,
+        ring=ring,
+        sigma_eff=sigma_eff,
+    )

@@ -1,0 +1,149 @@
+"""HypervisorState: the host↔device bridge for the batched runtime.
+
+Host side: interning, membership dicts, free-slot allocation, the native
+staging queue. Device side: the AgentTable / SessionTable / VouchTable /
+logs as jit-carried pytrees. Single calls enqueue; `flush()` runs the
+jitted admission wave. This is the 10k-concurrent-agent execution path the
+facade (`core.Hypervisor`) mirrors one call at a time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
+from hypervisor_tpu.models import SessionConfig, SessionState
+from hypervisor_tpu.ops import admission
+from hypervisor_tpu.tables.intern import InternTable
+from hypervisor_tpu.tables.logs import DeltaLog, EventLog
+from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.struct import replace
+from hypervisor_tpu.runtime import StagingQueue
+
+
+class HypervisorState:
+    """Authoritative batched state: device tables + host boundary indices."""
+
+    def __init__(self, config: HypervisorConfig = DEFAULT_CONFIG) -> None:
+        cap = config.capacity
+        self.config = config
+        self.agents = AgentTable.create(cap.max_agents)
+        self.sessions = SessionTable.create(cap.max_sessions)
+        self.vouches = VouchTable.create(cap.max_vouch_edges)
+        self.delta_log = DeltaLog.create(cap.delta_log_capacity)
+        self.event_log = EventLog.create(cap.event_log_capacity)
+
+        self.agent_ids = InternTable()
+        self.session_ids = InternTable()
+        self._next_agent_slot = 0
+        self._next_session_slot = 0
+        self._members: dict[tuple[int, int], bool] = {}  # (session, did) -> True
+
+        # Pending join wave (native lock-free queue + parallel slot/did rows).
+        self._queue = StagingQueue(capacity=cap.max_agents)
+        self._pending: list[tuple[int, int, int, bool]] = []  # slot, did, sess, dup
+
+        self._admit = jax.jit(admission.admit_batch)
+
+    # ── sessions ─────────────────────────────────────────────────────
+
+    def create_session(self, session_id: str, config: SessionConfig) -> int:
+        """Allocate a session row in HANDSHAKING state; returns the slot."""
+        slot = self._next_session_slot
+        self._next_session_slot += 1
+        sid = self.session_ids.intern(session_id)
+        self.sessions = replace(
+            self.sessions,
+            sid=self.sessions.sid.at[slot].set(sid),
+            state=self.sessions.state.at[slot].set(
+                SessionState.HANDSHAKING.code
+            ),
+            mode=self.sessions.mode.at[slot].set(config.consistency_mode.code),
+            max_participants=self.sessions.max_participants.at[slot].set(
+                config.max_participants
+            ),
+            min_sigma_eff=self.sessions.min_sigma_eff.at[slot].set(
+                config.min_sigma_eff
+            ),
+            enable_audit=self.sessions.enable_audit.at[slot].set(config.enable_audit),
+        )
+        return slot
+
+    def set_session_state(self, slot: int, state: SessionState) -> None:
+        self.sessions = replace(
+            self.sessions, state=self.sessions.state.at[slot].set(state.code)
+        )
+
+    # ── join waves ───────────────────────────────────────────────────
+
+    def enqueue_join(
+        self,
+        session_slot: int,
+        agent_did: str,
+        sigma_raw: float,
+        trustworthy: bool = True,
+    ) -> int:
+        """Stage one join; returns the queue slot (-1 when the wave is full)."""
+        did = self.agent_ids.intern(agent_did)
+        agent_slot = self._next_agent_slot
+        duplicate = (session_slot, did) in self._members
+        q = self._queue.push(sigma_raw, agent_slot, session_slot, trustworthy)
+        if q < 0:
+            return -1
+        self._next_agent_slot += 1
+        self._pending.append((agent_slot, did, session_slot, duplicate))
+        return q
+
+    def flush_joins(self, now: float = 0.0) -> np.ndarray:
+        """Run the jitted admission wave; returns i8[B] status codes."""
+        n, sigma, agent_slots, session_slots, trustworthy = self._queue.harvest()
+        if n == 0:
+            return np.zeros(0, np.int8)
+        rows = self._pending[:n]
+        self._pending = self._pending[n:]
+        dids = np.array([r[1] for r in rows], np.int32)
+        duplicate = np.array([r[3] for r in rows], bool)
+
+        result = self._admit(
+            self.agents,
+            self.sessions,
+            jnp.asarray(agent_slots),
+            jnp.asarray(dids),
+            jnp.asarray(session_slots),
+            jnp.asarray(sigma),
+            jnp.asarray(trustworthy.astype(bool)),
+            jnp.asarray(duplicate),
+            now,
+        )
+        self.agents = result.agents
+        self.sessions = result.sessions
+        status = np.asarray(result.status)
+        for (slot, did, sess, _), st in zip(rows, status):
+            if st == admission.ADMIT_OK:
+                self._members[(sess, did)] = True
+        return status
+
+    # ── views ────────────────────────────────────────────────────────
+
+    def participant_count(self, session_slot: int) -> int:
+        return int(np.asarray(self.sessions.n_participants)[session_slot])
+
+    def agent_row(self, agent_did: str) -> Optional[dict]:
+        did = self.agent_ids.lookup(agent_did)
+        if did < 0:
+            return None
+        dids = np.asarray(self.agents.did)
+        hits = np.nonzero(dids == did)[0]
+        if len(hits) == 0:
+            return None
+        i = int(hits[-1])
+        return {
+            "slot": i,
+            "session": int(np.asarray(self.agents.session)[i]),
+            "sigma_eff": float(np.asarray(self.agents.sigma_eff)[i]),
+            "ring": int(np.asarray(self.agents.ring)[i]),
+        }

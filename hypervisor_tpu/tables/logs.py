@@ -1,0 +1,106 @@
+"""Append-only device logs: delta records and typed events as ring buffers.
+
+The reference's audit log is a Python list of dataclasses
+(`audit/delta.py:82`) and its event store three dict indices
+(`observability/event_bus.py:119-124`). The device twins are fixed-capacity
+ring buffers of int/u32 columns: appends are `dynamic_update_slice` at a
+monotonic cursor (mod capacity), so a whole batch of per-lane emissions
+lands in one op, and queries are masked scans the host can pull lazily.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hypervisor_tpu.tables.struct import table
+from hypervisor_tpu.ops.merkle import BODY_WORDS
+
+
+@table
+class DeltaLog:
+    """[C] ring buffer of binary delta records + their chain digests."""
+
+    body: jnp.ndarray      # u32[C, BODY_WORDS]
+    digest: jnp.ndarray    # u32[C, 8]
+    session: jnp.ndarray   # i32[C]
+    turn: jnp.ndarray      # i32[C]
+    cursor: jnp.ndarray    # i32[] next write position (monotonic)
+
+    @staticmethod
+    def create(capacity: int) -> "DeltaLog":
+        return DeltaLog(
+            body=jnp.zeros((capacity, BODY_WORDS), jnp.uint32),
+            digest=jnp.zeros((capacity, 8), jnp.uint32),
+            session=jnp.full((capacity,), -1, jnp.int32),
+            turn=jnp.zeros((capacity,), jnp.int32),
+            cursor=jnp.zeros((), jnp.int32),
+        )
+
+    def append_batch(
+        self,
+        bodies: jnp.ndarray,    # u32[B, BODY_WORDS]
+        digests: jnp.ndarray,   # u32[B, 8]
+        sessions: jnp.ndarray,  # i32[B]
+        turns: jnp.ndarray,     # i32[B]
+    ) -> "DeltaLog":
+        """Append B records at the cursor (wrapping)."""
+        capacity = self.body.shape[0]
+        b = bodies.shape[0]
+        idx = (self.cursor + jnp.arange(b, dtype=jnp.int32)) % capacity
+        return DeltaLog(
+            body=self.body.at[idx].set(bodies),
+            digest=self.digest.at[idx].set(digests),
+            session=self.session.at[idx].set(sessions),
+            turn=self.turn.at[idx].set(turns),
+            cursor=self.cursor + b,
+        )
+
+
+@table
+class EventLog:
+    """[C] ring buffer of typed events (EventType.code / slots / trace ids)."""
+
+    event_type: jnp.ndarray  # i32[C] EventType.code (-1 = empty)
+    session: jnp.ndarray     # i32[C] session slot
+    agent: jnp.ndarray       # i32[C] agent slot
+    trace: jnp.ndarray       # u32[C] causal trace hash
+    timestamp: jnp.ndarray   # f32[C]
+    cursor: jnp.ndarray      # i32[]
+
+    @staticmethod
+    def create(capacity: int) -> "EventLog":
+        return EventLog(
+            event_type=jnp.full((capacity,), -1, jnp.int32),
+            session=jnp.full((capacity,), -1, jnp.int32),
+            agent=jnp.full((capacity,), -1, jnp.int32),
+            trace=jnp.zeros((capacity,), jnp.uint32),
+            timestamp=jnp.zeros((capacity,), jnp.float32),
+            cursor=jnp.zeros((), jnp.int32),
+        )
+
+    def append_batch(
+        self,
+        event_types: jnp.ndarray,
+        sessions: jnp.ndarray,
+        agents: jnp.ndarray,
+        traces: jnp.ndarray,
+        timestamps: jnp.ndarray,
+    ) -> "EventLog":
+        capacity = self.event_type.shape[0]
+        b = event_types.shape[0]
+        idx = (self.cursor + jnp.arange(b, dtype=jnp.int32)) % capacity
+        return EventLog(
+            event_type=self.event_type.at[idx].set(event_types),
+            session=self.session.at[idx].set(sessions),
+            agent=self.agent.at[idx].set(agents),
+            trace=self.trace.at[idx].set(traces),
+            timestamp=self.timestamp.at[idx].set(timestamps),
+            cursor=self.cursor + b,
+        )
+
+    def count_by_type(self, n_types: int) -> jnp.ndarray:
+        """i32[n_types] histogram over live entries (type_counts twin)."""
+        live = self.event_type >= 0
+        return jnp.zeros((n_types,), jnp.int32).at[
+            jnp.clip(self.event_type, 0)
+        ].add(jnp.where(live, 1, 0))

@@ -1,0 +1,96 @@
+"""Batched admission vs the reference join semantics."""
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.models import SessionConfig, SessionState
+from hypervisor_tpu.ops import admission
+from hypervisor_tpu.state import HypervisorState
+
+
+@pytest.fixture
+def state():
+    return HypervisorState(DEFAULT_CONFIG)
+
+
+class TestBatchAdmission:
+    def test_wave_of_joins(self, state):
+        s = state.create_session("session:a", SessionConfig())
+        state.enqueue_join(s, "did:hi", 0.9)
+        state.enqueue_join(s, "did:mid", 0.7)
+        state.enqueue_join(s, "did:lo", 0.2)
+        status = state.flush_joins()
+        assert status.tolist() == [admission.ADMIT_OK] * 3
+        assert state.participant_count(s) == 3
+        assert state.agent_row("did:hi")["ring"] == 2
+        assert state.agent_row("did:lo")["ring"] == 3  # sandbox, floor-exempt
+
+    def test_untrustworthy_sandboxed(self, state):
+        s = state.create_session("session:a", SessionConfig())
+        state.enqueue_join(s, "did:sus", 0.9, trustworthy=False)
+        state.flush_joins()
+        assert state.agent_row("did:sus")["ring"] == 3
+
+    def test_duplicate_rejected_across_waves(self, state):
+        s = state.create_session("session:a", SessionConfig())
+        state.enqueue_join(s, "did:a", 0.8)
+        assert state.flush_joins().tolist() == [admission.ADMIT_OK]
+        state.enqueue_join(s, "did:a", 0.8)
+        assert state.flush_joins().tolist() == [admission.ADMIT_DUPLICATE]
+        assert state.participant_count(s) == 1
+
+    def test_capacity_within_one_wave(self, state):
+        s = state.create_session("session:a", SessionConfig(max_participants=2))
+        for i in range(4):
+            state.enqueue_join(s, f"did:a{i}", 0.8)
+        status = state.flush_joins()
+        assert status.tolist().count(admission.ADMIT_OK) == 2
+        assert status.tolist().count(admission.ADMIT_CAPACITY) == 2
+        assert state.participant_count(s) == 2
+
+    def test_capacity_rank_skips_rejected(self, state):
+        # 3 slots; one mid-wave reject (low sigma non-sandbox is impossible —
+        # use duplicate) must not consume capacity.
+        s = state.create_session("session:a", SessionConfig(max_participants=2))
+        state.enqueue_join(s, "did:a", 0.8)
+        state.flush_joins()
+        state.enqueue_join(s, "did:a", 0.8)   # duplicate -> rejected
+        state.enqueue_join(s, "did:b", 0.8)   # must still fit
+        status = state.flush_joins()
+        assert status.tolist() == [admission.ADMIT_DUPLICATE, admission.ADMIT_OK]
+        assert state.participant_count(s) == 2
+
+    def test_bad_session_state(self, state):
+        s = state.create_session("session:a", SessionConfig())
+        state.set_session_state(s, SessionState.ARCHIVED)
+        state.enqueue_join(s, "did:a", 0.8)
+        assert state.flush_joins().tolist() == [admission.ADMIT_BAD_STATE]
+
+    def test_multi_session_wave(self, state):
+        s1 = state.create_session("session:1", SessionConfig(max_participants=1))
+        s2 = state.create_session("session:2", SessionConfig())
+        state.enqueue_join(s1, "did:a", 0.8)
+        state.enqueue_join(s2, "did:b", 0.8)
+        state.enqueue_join(s1, "did:c", 0.8)  # over s1 capacity
+        status = state.flush_joins()
+        assert status.tolist() == [
+            admission.ADMIT_OK,
+            admission.ADMIT_OK,
+            admission.ADMIT_CAPACITY,
+        ]
+        assert state.participant_count(s1) == 1
+        assert state.participant_count(s2) == 1
+
+    def test_10k_wave(self, state):
+        sessions = [
+            state.create_session(f"session:{i}", SessionConfig(max_participants=64))
+            for i in range(256)
+        ]
+        n = 8192
+        for i in range(n):
+            state.enqueue_join(sessions[i % 256], f"did:bulk{i}", 0.8)
+        status = state.flush_joins()
+        assert len(status) == n
+        assert (status == admission.ADMIT_OK).all()
+        assert state.participant_count(sessions[0]) == 32

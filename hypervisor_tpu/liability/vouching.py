@@ -238,7 +238,7 @@ class VouchingEngine:
             bond_pct=col(self._pct, 0, np.float32),
             bond=col(self._bond, 0, np.float32),
             active=col(self._active, False, bool),
-            expiry=col(self._expiry.astype(np.float32), np.inf, np.float32),
+            expiry=col(self._expiry[:n].astype(np.float32), np.inf, np.float32),
         )
 
     # ── internals ────────────────────────────────────────────────────

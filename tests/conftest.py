@@ -25,6 +25,12 @@ import pytest
 # Persistent XLA compilation cache: first run pays compile, reruns are fast.
 import jax
 
+# The jaxtyping pytest plugin imports jax before this conftest runs, so
+# jax.config captured JAX_PLATFORMS from the shell env (possibly "axon", the
+# real-TPU tunnel). Override the live config too, not just the env var — this
+# is safe as long as no backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 

@@ -1,0 +1,27 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The compute path of the framework is pure JAX/XLA (`hypervisor_tpu.ops`);
+these kernels are hand-scheduled Mosaic/Pallas implementations of the
+hash-heavy inner loops — the one place XLA's auto-fusion leaves VPU cycles
+on the table. Each kernel has a bit-identical `ops/` fallback used on CPU
+and in interpret-mode tests.
+
+Kernels:
+ - `sha256_pallas.sha256_words`: batched FIPS 180-4 digests, fully unrolled
+   64-round compression on [8, 128] u32 register tiles (1024 messages per
+   grid step).
+"""
+
+from hypervisor_tpu.kernels.sha256_pallas import (
+    pallas_available,
+    sha256_words,
+    sha256_words_reference,
+    sha256_words_unrolled_np,
+)
+
+__all__ = [
+    "pallas_available",
+    "sha256_words",
+    "sha256_words_reference",
+    "sha256_words_unrolled_np",
+]

@@ -23,7 +23,7 @@ from jax import lax
 
 from hypervisor_tpu.ops.sha256 import (
     pad_tail_words,
-    sha256_blocks,
+    sha256_blocks_dispatch,
     sha256_hex_pair,
 )
 
@@ -34,7 +34,11 @@ _CHAIN_MSG_BYTES = (BODY_WORDS + 8) * 4
 _CHAIN_TAIL = pad_tail_words(_CHAIN_MSG_BYTES, 2)
 
 
-def merkle_root(digests: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
+def merkle_root(
+    digests: jnp.ndarray,
+    count: jnp.ndarray,
+    use_pallas: bool | None = None,
+) -> jnp.ndarray:
     """Merkle root over the first `count` of P leaf digests.
 
     Args:
@@ -56,14 +60,18 @@ def merkle_root(digests: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
         j = jnp.arange(half, dtype=jnp.int32)
         dup = (2 * j + 1) >= cnt  # odd tail: right := left
         right = jnp.where(dup[:, None], left, right)
-        combined = sha256_hex_pair(left, right)
+        combined = sha256_hex_pair(left, right, use_pallas)
         descend = cnt > 1
         arr = jnp.where(descend, combined, left)
         cnt = jnp.where(descend, (cnt + 1) // 2, cnt)
     return arr[0]
 
 
-def merkle_root_lanes(digests: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
+def merkle_root_lanes(
+    digests: jnp.ndarray,
+    count: jnp.ndarray,
+    use_pallas: bool | None = None,
+) -> jnp.ndarray:
     """Per-lane Merkle roots: u32[S, P, 8] leaves -> u32[S, 8] roots.
 
     Same odd-duplication semantics as `merkle_root`, with the S session
@@ -82,7 +90,7 @@ def merkle_root_lanes(digests: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
         dup = (2 * j[None, :] + 1) >= cnt[:, None]
         right = jnp.where(dup[:, :, None], left, right)
         combined = sha256_hex_pair(
-            left.reshape(s * half, 8), right.reshape(s * half, 8)
+            left.reshape(s * half, 8), right.reshape(s * half, 8), use_pallas
         ).reshape(s, half, 8)
         descend = (cnt > 1)[:, None, None]
         arr = jnp.where(descend, combined, left)
@@ -91,7 +99,9 @@ def merkle_root_lanes(digests: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
 
 
 def chain_digests(
-    bodies: jnp.ndarray, seed: jnp.ndarray | None = None
+    bodies: jnp.ndarray,
+    seed: jnp.ndarray | None = None,
+    use_pallas: bool | None = None,
 ) -> jnp.ndarray:
     """Sequentially chain-hash binary delta bodies.
 
@@ -118,7 +128,7 @@ def chain_digests(
 
     def step(parent, body):
         msg = jnp.concatenate([body, parent, tail], axis=1)  # [L, 32] = 2 blocks
-        digest = sha256_blocks(msg, 2)
+        digest = sha256_blocks_dispatch(msg, 2, use_pallas)
         return digest, digest
 
     _, digests = lax.scan(step, seed, bodies)
@@ -130,6 +140,7 @@ def verify_chain_digests(
     recorded: jnp.ndarray,
     count: jnp.ndarray,
     seed: jnp.ndarray | None = None,
+    use_pallas: bool | None = None,
 ) -> jnp.ndarray:
     """Tamper check: recompute the chain and compare to recorded digests.
 
@@ -140,7 +151,7 @@ def verify_chain_digests(
     Returns:
       bool[L] — True where the first `count` digests all match.
     """
-    recomputed = chain_digests(bodies, seed)
+    recomputed = chain_digests(bodies, seed, use_pallas)
     eq = jnp.all(recomputed == recorded, axis=-1)  # [N, L]
     turn = jnp.arange(bodies.shape[0], dtype=jnp.int32)[:, None]
     in_range = turn < count[None, :]

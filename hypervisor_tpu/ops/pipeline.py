@@ -62,8 +62,14 @@ def governance_pipeline(
     delta_bodies: jnp.ndarray,    # u32[T, S, BODY_WORDS] binary delta records
     active: jnp.ndarray,          # bool[S] lane mask
     trust: TrustConfig = DEFAULT_CONFIG.trust,
+    use_pallas: bool | None = None,
 ) -> PipelineResult:
-    """Run the full governance pipeline for S session lanes on device."""
+    """Run the full governance pipeline for S session lanes on device.
+
+    `use_pallas` routes the SHA-256 hot loops through the Mosaic kernel;
+    None = auto by backend, False forced by `parallel.collectives` when the
+    mesh is CPU (virtual-device dry runs).
+    """
     s = sigma_raw.shape[0]
     t = delta_bodies.shape[0]
 
@@ -87,11 +93,15 @@ def governance_pipeline(
     state = jnp.where(ok, S_ACTIVE, state).astype(jnp.int8)       # activate (1 participant)
 
     # ── 3. audit: chain-hash T deltas per lane, then Merkle root ─────
-    digests = merkle_ops.chain_digests(delta_bodies)              # u32[T, S, 8]
+    digests = merkle_ops.chain_digests(
+        delta_bodies, use_pallas=use_pallas
+    )                                                             # u32[T, S, 8]
     p = 1 << max(0, (t - 1).bit_length())
     leaves = jnp.zeros((s, p, 8), jnp.uint32)
     leaves = leaves.at[:, :t].set(jnp.transpose(digests, (1, 0, 2)))
-    roots = merkle_ops.merkle_root_lanes(leaves, jnp.int32(t))    # u32[S, 8]
+    roots = merkle_ops.merkle_root_lanes(
+        leaves, jnp.int32(t), use_pallas=use_pallas
+    )                                                             # u32[S, 8]
 
     # ── 4. saga: one noop step through the retry ladder ──────────────
     step_state = jnp.full((s,), saga_ops.STEP_PENDING, jnp.int8)

@@ -83,6 +83,60 @@ def _compress_block(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
     return state + out
 
 
+# Pallas dispatch: None = auto (Mosaic kernel on TPU backends, XLA loop
+# elsewhere); True/False force. The kernel is bit-identical (see
+# tests/parity/test_pallas_sha256.py) so dispatch never changes results.
+_USE_PALLAS: bool | None = None
+
+
+def set_pallas(enabled: bool | None) -> None:
+    """Force (True/False) or restore auto (None) Pallas hash dispatch.
+
+    Dispatch is baked in at trace time, so already-compiled jitted callers
+    would ignore a later override; clear jax's compilation caches to make
+    the new setting take effect everywhere.
+    """
+    global _USE_PALLAS
+    if enabled != _USE_PALLAS:
+        _USE_PALLAS = enabled
+        import jax
+
+        jax.clear_caches()
+
+
+def _pallas_enabled() -> bool:
+    if _USE_PALLAS is not None:
+        return _USE_PALLAS
+    from hypervisor_tpu.kernels.sha256_pallas import pallas_available
+
+    return pallas_available()
+
+
+def sha256_blocks_dispatch(
+    words: jnp.ndarray, n_blocks: int, use_pallas: bool | None = None
+) -> jnp.ndarray:
+    """`sha256_blocks` routed through the Pallas kernel when available.
+
+    Dispatch is decided at trace time (backend is static per compile), so
+    jitted callers bake in the right implementation.
+
+    Args:
+      use_pallas: explicit override threaded from callers that know where
+        the program will run (e.g. `parallel.collectives` checks the mesh's
+        device platform — `jax.default_backend()` is unreliable there: the
+        environment's TPU plugin prepends itself to jax_platforms, so the
+        default backend reports "tpu" even for programs built for a CPU
+        mesh). None = module-level setting / backend auto-detect.
+    """
+    if use_pallas is None:
+        use_pallas = _pallas_enabled()
+    if use_pallas:
+        from hypervisor_tpu.kernels.sha256_pallas import sha256_words
+
+        return sha256_words(words, n_blocks)
+    return sha256_blocks(words, n_blocks)
+
+
 def sha256_blocks(words: jnp.ndarray, n_blocks: int) -> jnp.ndarray:
     """Digest pre-padded messages.
 
@@ -202,7 +256,9 @@ def _pair_tail_words() -> np.ndarray:
     return _PAIR_TAIL
 
 
-def sha256_hex_pair(left: jnp.ndarray, right: jnp.ndarray) -> jnp.ndarray:
+def sha256_hex_pair(
+    left: jnp.ndarray, right: jnp.ndarray, use_pallas: bool | None = None
+) -> jnp.ndarray:
     """Batched sha256(hex(left)+hex(right)) on u32[B,8] digests -> u32[B,8].
 
     Bit-compatible with the reference's Merkle interior node combine
@@ -215,4 +271,4 @@ def sha256_hex_pair(left: jnp.ndarray, right: jnp.ndarray) -> jnp.ndarray:
         (left.shape[0], 48 - 32),
     )
     msg = jnp.concatenate([lw, rw, tail], axis=1)  # [B, 48] = 3 blocks
-    return sha256_blocks(msg, 3)
+    return sha256_blocks_dispatch(msg, 3, use_pallas)

@@ -29,6 +29,17 @@ from hypervisor_tpu.ops.pipeline import PipelineResult, governance_pipeline
 from hypervisor_tpu.parallel.mesh import AGENT_AXIS
 
 
+def _mesh_uses_pallas(mesh: Mesh) -> bool:
+    """Pallas hash kernels only when every mesh device is a TPU.
+
+    `jax.default_backend()` cannot be trusted here: the environment's TPU
+    plugin prepends itself to jax_platforms, so the default backend says
+    "tpu" even when the program is built for a virtual CPU mesh
+    (xla_force_host_platform_device_count dry runs).
+    """
+    return all(d.platform == "tpu" for d in mesh.devices.flat)
+
+
 def strong_tick(mesh: Mesh):
     """Build the jitted multi-chip governance tick (STRONG consistency).
 
@@ -37,10 +48,16 @@ def strong_tick(mesh: Mesh):
     `consensus` vector is psum'd over ICI so all chips agree.
     """
     lane = P(AGENT_AXIS)
+    use_pallas = _mesh_uses_pallas(mesh)
 
     def tick(sigma_raw, trustworthy, min_sigma_eff, delta_bodies, active):
         result = governance_pipeline(
-            sigma_raw, trustworthy, min_sigma_eff, delta_bodies, active
+            sigma_raw,
+            trustworthy,
+            min_sigma_eff,
+            delta_bodies,
+            active,
+            use_pallas=use_pallas,
         )
         # Cross-chip consensus barrier: allreduce the session aggregates.
         consensus = jax.lax.psum(result.consensus, AGENT_AXIS)
@@ -67,10 +84,16 @@ def strong_tick(mesh: Mesh):
 def eventual_tick(mesh: Mesh):
     """EVENTUAL mode: local-only tick; no in-tick collective."""
     lane = P(AGENT_AXIS)
+    use_pallas = _mesh_uses_pallas(mesh)
 
     def tick(sigma_raw, trustworthy, min_sigma_eff, delta_bodies, active):
         return governance_pipeline(
-            sigma_raw, trustworthy, min_sigma_eff, delta_bodies, active
+            sigma_raw,
+            trustworthy,
+            min_sigma_eff,
+            delta_bodies,
+            active,
+            use_pallas=use_pallas,
         )
 
     mapped = shard_map(

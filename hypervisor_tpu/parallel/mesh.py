@@ -11,6 +11,7 @@ reconciliation.
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional, Sequence
 
 import jax
@@ -21,14 +22,44 @@ AGENT_AXIS = "agents"
 DCN_AXIS = "dcn"
 
 
+def _device_pool(need: int) -> list:
+    """First `need` devices, falling back to the CPU platform when the
+    default platform is underprovisioned.
+
+    The CPU platform honours xla_force_host_platform_device_count, which is
+    how virtual-mesh validation gets its 8 devices. The fallback is loud:
+    an accelerator job quietly landing on host CPUs would be a silent
+    orders-of-magnitude slowdown.
+    """
+    pool = jax.devices()
+    if len(pool) < need:
+        fallback = jax.devices("cpu")
+        if len(fallback) >= need:
+            warnings.warn(
+                f"default platform has {len(pool)} device(s) but a "
+                f"{need}-device mesh was requested; falling back to "
+                f"{need} host-CPU devices (virtual-mesh mode)",
+                stacklevel=3,
+            )
+            pool = fallback
+        else:
+            raise ValueError(
+                f"requested {need}-device mesh but only {len(pool)} "
+                f"default-platform / {len(fallback)} cpu devices available "
+                f"(set --xla_force_host_platform_device_count)"
+            )
+    return pool[:need]
+
+
 def make_mesh(
     n_devices: Optional[int] = None, devices: Optional[Sequence] = None
 ) -> Mesh:
     """1-D mesh over the agent axis (ICI collectives within the slice)."""
     if devices is None:
-        devices = jax.devices()
-        if n_devices is not None:
-            devices = devices[:n_devices]
+        if n_devices is None:
+            devices = jax.devices()
+        else:
+            devices = _device_pool(n_devices)
     return Mesh(np.asarray(devices), (AGENT_AXIS,))
 
 
@@ -38,7 +69,7 @@ def make_multislice_mesh(n_slices: int, per_slice: int) -> Mesh:
     Collectives over AGENT_AXIS ride ICI; EVENTUAL-mode cross-slice
     reconciliation reduces over DCN_AXIS between batched ticks.
     """
-    devices = np.asarray(jax.devices()[: n_slices * per_slice]).reshape(
+    devices = np.asarray(_device_pool(n_slices * per_slice)).reshape(
         n_slices, per_slice
     )
     return Mesh(devices, (DCN_AXIS, AGENT_AXIS))

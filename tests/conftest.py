@@ -9,8 +9,11 @@ import os
 
 # Hard override: the environment ships JAX_PLATFORMS=axon (real TPU via a
 # single-claim tunnel); tests must never claim it. Assignment, not
-# setdefault.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# setdefault. Opt out with HV_TPU_TESTS=1 to run the TPU-gated tests
+# (e.g. the compiled Pallas kernel parity test) against the real chip:
+#   HV_TPU_TESTS=1 python -m pytest tests/parity/test_pallas_sha256.py
+if os.environ.get("HV_TPU_TESTS") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -22,14 +25,25 @@ import inspect
 
 import pytest
 
+
 # Persistent XLA compilation cache: first run pays compile, reruns are fast.
 import jax
 
-# The jaxtyping pytest plugin imports jax before this conftest runs, so
-# jax.config captured JAX_PLATFORMS from the shell env (possibly "axon", the
-# real-TPU tunnel). Override the live config too, not just the env var — this
-# is safe as long as no backend has been initialized yet.
-jax.config.update("jax_platforms", "cpu")
+# Entry-point plugins that import jax before this conftest would make jax
+# capture JAX_PLATFORMS from the shell env (possibly "axon", the real-TPU
+# tunnel). pyproject addopts disables the one known offender (jaxtyping) so
+# the env assignment above is authoritative; if some new plugin re-introduces
+# an early import, jax.config will have captured "axon" — fall back to a
+# live override. The override is a last resort only: an explicit
+# jax_platforms setting (even the same value the env would give) switches
+# XLA:CPU client creation onto a path whose compilation is drastically
+# slower for large programs (observed: 11 s -> stuck >9 min for a ~6k-op
+# unrolled SHA-256 program).
+if (
+    os.environ.get("HV_TPU_TESTS") != "1"
+    and jax.config.jax_platforms != "cpu"
+):  # pragma: no cover
+    jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

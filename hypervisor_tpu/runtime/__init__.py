@@ -1,4 +1,5 @@
-"""Native host runtime: C++ audit verifier + lock-free staging queue."""
+"""Native host runtime: C++ audit verifier, lock-free staging queue, and
+device-table checkpointing."""
 
 from hypervisor_tpu.runtime.native import (
     HAVE_NATIVE,
@@ -16,4 +17,16 @@ __all__ = [
     "merkle_root_hex_host",
     "sha256_batch_host",
     "verify_chain_host",
+    "restore_state",
+    "save_state",
 ]
+
+
+def __getattr__(name):
+    # checkpoint helpers import HypervisorState (which imports this module);
+    # resolve lazily to avoid the cycle.
+    if name in ("save_state", "restore_state", "wait_durable", "state_arrays"):
+        from hypervisor_tpu.runtime import checkpoint
+
+        return getattr(checkpoint, name)
+    raise AttributeError(name)

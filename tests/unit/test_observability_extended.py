@@ -1,0 +1,124 @@
+"""Extended observability coverage: wildcard pub/sub, time-range and
+multi-filter queries, causal-trace ancestry.
+
+Complements tests/unit/test_observability.py toward the reference's depth
+(`tests/unit/test_observability.py`, 22 tests in /root/reference).
+"""
+
+from __future__ import annotations
+
+from datetime import timedelta
+
+from hypervisor_tpu import (
+    CausalTraceId,
+    EventType,
+    HypervisorEvent,
+    HypervisorEventBus,
+)
+from hypervisor_tpu.utils.clock import utc_now
+
+
+def _ev(etype=EventType.SESSION_CREATED, sid=None, did=None, **payload):
+    return HypervisorEvent(
+        event_type=etype, session_id=sid, agent_did=did, payload=payload
+    )
+
+
+class TestPubSub:
+    def test_wildcard_subscriber_sees_every_type(self):
+        bus = HypervisorEventBus()
+        seen = []
+        bus.subscribe(handler=seen.append)
+        bus.emit(_ev(EventType.SESSION_CREATED))
+        bus.emit(_ev(EventType.SLASH_EXECUTED))
+        assert [e.event_type for e in seen] == [
+            EventType.SESSION_CREATED,
+            EventType.SLASH_EXECUTED,
+        ]
+
+    def test_typed_subscriber_filters(self):
+        bus = HypervisorEventBus()
+        slashes = []
+        bus.subscribe(EventType.SLASH_EXECUTED, slashes.append)
+        bus.emit(_ev(EventType.SESSION_CREATED))
+        bus.emit(_ev(EventType.SLASH_EXECUTED))
+        assert len(slashes) == 1
+
+    def test_typed_and_wildcard_both_fire(self):
+        bus = HypervisorEventBus()
+        hits = []
+        bus.subscribe(EventType.SESSION_CREATED, lambda e: hits.append("typed"))
+        bus.subscribe(handler=lambda e: hits.append("wild"))
+        bus.emit(_ev(EventType.SESSION_CREATED))
+        assert sorted(hits) == ["typed", "wild"]
+
+
+class TestQueries:
+    def test_time_range_query(self):
+        bus = HypervisorEventBus()
+        start = utc_now() - timedelta(seconds=1)
+        bus.emit(_ev())
+        bus.emit(_ev())
+        assert len(bus.query_by_time_range(start)) == 2
+        future = utc_now() + timedelta(seconds=5)
+        assert bus.query_by_time_range(future) == []
+
+    def test_query_combines_type_and_session(self):
+        bus = HypervisorEventBus()
+        bus.emit(_ev(EventType.SESSION_JOINED, sid="s1", did="a"))
+        bus.emit(_ev(EventType.SESSION_JOINED, sid="s2", did="a"))
+        bus.emit(_ev(EventType.SLASH_EXECUTED, sid="s1", did="a"))
+        got = bus.query(event_type=EventType.SESSION_JOINED, session_id="s1")
+        assert len(got) == 1 and got[0].session_id == "s1"
+
+    def test_query_combines_session_and_agent(self):
+        bus = HypervisorEventBus()
+        bus.emit(_ev(sid="s1", did="a"))
+        bus.emit(_ev(sid="s1", did="b"))
+        got = bus.query(session_id="s1", agent_did="b")
+        assert len(got) == 1 and got[0].agent_did == "b"
+
+    def test_query_limit_returns_most_recent(self):
+        bus = HypervisorEventBus()
+        for i in range(5):
+            bus.emit(_ev(payload_idx=i))
+        got = bus.query(limit=2)
+        assert [e.payload["payload_idx"] for e in got] == [3, 4]
+
+    def test_payload_round_trips_through_to_dict(self):
+        ev = _ev(EventType.VOUCH_CREATED, sid="s", did="a", bond=0.16)
+        d = ev.to_dict()
+        assert d["event_type"] == EventType.VOUCH_CREATED.value
+        assert d["payload"] == {"bond": 0.16}
+
+
+class TestCausalTrace:
+    def test_is_ancestor_of_descendant(self):
+        root = CausalTraceId.new_root() if hasattr(CausalTraceId, "new_root") else CausalTraceId(trace_id="t", span_id="s0")
+        child = root.child()
+        grandchild = child.child()
+        assert root.is_ancestor_of(child)
+        assert root.is_ancestor_of(grandchild)
+        assert not child.is_ancestor_of(root)
+
+    def test_sibling_not_ancestor(self):
+        root = CausalTraceId(trace_id="t", span_id="s0")
+        a = root.child()
+        b = a.sibling()
+        assert not a.is_ancestor_of(b)
+        assert a.depth == b.depth
+
+    def test_different_traces_unrelated(self):
+        a = CausalTraceId(trace_id="t1", span_id="s")
+        b = CausalTraceId(trace_id="t2", span_id="s").child()
+        assert not a.is_ancestor_of(b)
+
+    def test_event_carries_causal_ids(self):
+        trace = CausalTraceId(trace_id="t", span_id="s0")
+        ev = HypervisorEvent(
+            event_type=EventType.SESSION_CREATED,
+            causal_trace_id=str(trace),
+            parent_event_id="parent123",
+        )
+        assert ev.to_dict()["causal_trace_id"] == str(trace)
+        assert ev.to_dict()["parent_event_id"] == "parent123"

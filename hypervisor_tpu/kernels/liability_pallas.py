@@ -1,0 +1,261 @@
+"""Pallas TPU kernel: vouch/bond/slash batch accounting on the MXU.
+
+The XLA implementation (`ops.liability.slash_cascade`) expresses the
+cascade with scatters (`.at[].add` / `.at[].max`) and gathers — memory-
+bound shuffles on TPU. This kernel reformulates every scatter/gather as a
+dense masked matmul so the whole cascade runs on the MXU:
+
+  wave_hit[e] = Σ_n wave[n]·(vouchee[e]==n)      (gather -> matvec)
+  k[n]        = Σ_e hit[e]·(voucher[e]==n)       (scatter-add -> matvec)
+  has_vchr[n] = Σ_e live[e]·(vouchee[e]==n) > 0  (scatter-max -> matvec)
+
+Equality one-hot tiles are built on the fly from `broadcasted_iota` per
+512-edge chunk (never materialised in HBM), and the depth-bounded wave
+loop (`slashing.py:124-141` semantics in /root/reference) is unrolled.
+
+Capacity: one agent tile — N ≤ 1024 agents per call (the BASELINE batch
+config is 1k DIDs); E is unbounded (chunked). Larger agent tables fall
+back to the XLA path (`ops.liability.slash_cascade`).
+
+`slash_cascade_dense` is the identical matmul formulation as plain jnp —
+the CPU-testable twin used for parity (Mosaic interpret mode is unusable
+in the CPU test env; see kernels/sha256_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG, TrustConfig
+from hypervisor_tpu.tables.state import VouchTable
+from hypervisor_tpu.tables.struct import replace
+
+try:  # pragma: no cover - import guard
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_IMPORTED = True
+except Exception:  # pragma: no cover
+    _PALLAS_IMPORTED = False
+
+N_TILE = 1024   # one agent tile: 8 sublanes x 128 lanes
+E_CHUNK = 256   # edges per matmul chunk (keeps one-hot tiles inside VMEM)
+
+
+def _dot(a, b, dims):
+    # bf16 inputs (exact for 0/1 masks), f32 MXU accumulation
+    return jax.lax.dot_general(
+        a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+        dimension_numbers=(dims, ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _wave_pass(n, iota_n, vchr, vee, sess_ok, live_f, bond, wave, sigma,
+               omega, floor):
+    """One cascade wave in dense-matmul form. All agent vectors [1, n],
+    all edge vectors [1, e]; returns updated (sigma, k, hit, has_vchr)."""
+    e = vchr.shape[1]
+    hit_parts = []
+    k = jnp.zeros((1, n), jnp.float32)
+    hv = jnp.zeros((1, n), jnp.float32)
+    for c in range(0, e, E_CHUNK):
+        # static offsets: plain slices (Mosaic has no dynamic_slice)
+        vchr_c = vchr[:, c:c + E_CHUNK]
+        vee_c = vee[:, c:c + E_CHUNK]
+        live_c = live_f[:, c:c + E_CHUNK]
+        sess_c = sess_ok[:, c:c + E_CHUNK]
+
+        # [E_CHUNK, n] one-hot equality tiles. bf16 halves VMEM: 0/1 are
+        # exact in bf16 and the MXU accumulates in f32.
+        eq_vee = (vee_c.reshape(E_CHUNK, 1) == iota_n).astype(jnp.bfloat16)
+        eq_vchr = (vchr_c.reshape(E_CHUNK, 1) == iota_n).astype(jnp.bfloat16)
+
+        # gather wave[vouchee[e]] -> matvec over the agent axis
+        wave_hit = _dot(wave, eq_vee, ((1,), (1,)))          # [1, E_CHUNK]
+        hit_c = wave_hit * live_c * sess_c                   # f32 0/1
+        hit_parts.append(hit_c)
+
+        # scatter-add k[voucher[e]] -> matvec over the edge axis
+        k = k + _dot(hit_c, eq_vchr, ((1,), (0,)))           # [1, n]
+        # scatter-max has_vouchers[vouchee[e]] (live post-release edges
+        # handled by caller passing updated live_f on the next wave)
+        hv = hv + _dot(live_c * sess_c * (1.0 - hit_c), eq_vee, ((1,), (0,)))
+
+    hit = jnp.concatenate(hit_parts, axis=1)                 # [1, e]
+    was_clipped = k > 0.0
+    clip_sigma = jnp.maximum(sigma * jnp.power(1.0 - omega, k), floor)
+    sigma = jnp.where(was_clipped, clip_sigma, sigma)
+    return sigma, was_clipped, hit, hv > 0.0
+
+
+def _cascade_math(vchr, vee, session, bond, active_f, expiry, sigma, seeds,
+                  omega, sess, now, trust: TrustConfig):
+    """Shared wave-loop body (identical under Pallas and plain XLA).
+
+    All inputs 2D rows: agent vectors [1, n], edge vectors [1, e].
+    """
+    n = sigma.shape[1]
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)  # [1, n]
+    slashed = jnp.zeros((1, n), bool)
+    clipped_any = jnp.zeros((1, n), bool)
+    wave_of = jnp.full((1, n), -1, jnp.int32)
+    wave_b = seeds != 0.0
+    live_base = active_f * (now <= expiry).astype(jnp.float32)
+    hit_any = jnp.zeros_like(live_base)  # edges whose bond was consumed
+
+    for depth in range(trust.max_cascade_depth + 1):
+        sigma = jnp.where(wave_b, 0.0, sigma)
+        slashed = slashed | wave_b
+        wave_of = jnp.where(wave_b & (wave_of < 0), depth, wave_of)
+
+        sess_ok = (session == sess).astype(jnp.float32)
+        sigma, was_clipped, hit, has_vchr = _wave_pass(
+            n, iota_n, vchr, vee, sess_ok, live_base, bond,
+            wave_b.astype(jnp.float32), sigma, omega, trust.sigma_floor,
+        )
+        clipped_any = clipped_any | was_clipped
+        live_base = live_base * (1.0 - hit)  # release consumed bonds
+        hit_any = jnp.maximum(hit_any, hit)
+
+        if depth == trust.max_cascade_depth:
+            break
+        wiped = was_clipped & (
+            sigma < trust.sigma_floor + trust.cascade_wipe_epsilon
+        )
+        wave_b = wiped & has_vchr & ~slashed
+
+    return sigma, hit_any, slashed, clipped_any, wave_of
+
+
+def _kernel(trust, vchr_ref, vee_ref, sess_ref, bond_ref, act_ref, exp_ref,
+            sigma_ref, seeds_ref, scal_ref,
+            sigma_out, live_out, slashed_out, clipped_out, wave_out):
+    omega = scal_ref[0, 0]
+    sess = scal_ref[0, 1].astype(jnp.int32)
+    now = scal_ref[0, 2]
+    sigma, consumed, slashed, clipped, wave_of = _cascade_math(
+        vchr_ref[:], vee_ref[:], sess_ref[:], bond_ref[:], act_ref[:],
+        exp_ref[:], sigma_ref[:], seeds_ref[:], omega, sess, now, trust,
+    )
+    sigma_out[:] = sigma
+    live_out[:] = consumed
+    slashed_out[:] = slashed.astype(jnp.int32)
+    clipped_out[:] = clipped.astype(jnp.int32)
+    wave_out[:] = wave_of
+
+
+def _prep(vouch: VouchTable, sigma, seeds):
+    """Pad/reshape to kernel layout. Returns (rows dict, n, e)."""
+    n = sigma.shape[0]
+    if n > N_TILE:
+        raise ValueError(f"pallas cascade supports N <= {N_TILE}, got {n}")
+    e = vouch.voucher.shape[0]
+    ep = -(-e // E_CHUNK) * E_CHUNK
+    pad_e = ep - e
+
+    def erow(x, fill):
+        return jnp.pad(x, (0, pad_e), constant_values=fill)[None, :]
+
+    def arow(x, fill):
+        return jnp.pad(x, (0, N_TILE - n), constant_values=fill)[None, :]
+
+    return {
+        "vchr": erow(vouch.voucher, -1),
+        "vee": erow(vouch.vouchee, -1),
+        "sess": erow(vouch.session, -2),
+        "bond": erow(vouch.bond, 0.0),
+        "act": erow(vouch.active.astype(jnp.float32), 0.0),
+        "exp": erow(vouch.expiry, -jnp.inf),
+        "sigma": arow(sigma, 0.0),
+        "seeds": arow(jnp.asarray(seeds, bool).astype(jnp.float32), 0.0),
+    }, n, e
+
+
+@functools.partial(jax.jit, static_argnames=("trust",))
+def _run_pallas(rows, scalars, trust):
+    e = rows["vchr"].shape[1]
+    spec = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        functools.partial(_kernel, trust),
+        in_specs=[spec() for _ in range(8)]
+        + [pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=tuple(spec() for _ in range(5)),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, N_TILE), jnp.float32),   # sigma
+            jax.ShapeDtypeStruct((1, e), jnp.float32),        # consumed
+            jax.ShapeDtypeStruct((1, N_TILE), jnp.int32),     # slashed
+            jax.ShapeDtypeStruct((1, N_TILE), jnp.int32),     # clipped
+            jax.ShapeDtypeStruct((1, N_TILE), jnp.int32),     # wave_of
+        ),
+    )(
+        rows["vchr"], rows["vee"], rows["sess"], rows["bond"], rows["act"],
+        rows["exp"], rows["sigma"], rows["seeds"], scalars,
+    )
+    return outs
+
+
+def slash_cascade_pallas(
+    vouch: VouchTable,
+    sigma: jnp.ndarray,
+    seeds: jnp.ndarray,
+    session_slot,
+    risk_weight,
+    now,
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+):
+    """MXU-formulated slash cascade; result-compatible with
+    `ops.liability.slash_cascade` (returns the same SlashWaveResult)."""
+    from hypervisor_tpu.ops.liability import SlashWaveResult
+
+    rows, n, e = _prep(vouch, sigma, seeds)
+    scalars = jnp.array(
+        [[float(risk_weight), float(session_slot), float(now)]], jnp.float32
+    )
+    out_sigma, consumed, slashed, clipped, wave_of = _run_pallas(
+        rows, scalars, trust
+    )
+    new_active = vouch.active & ~(consumed[0, :e] > 0.0)
+    return SlashWaveResult(
+        sigma=out_sigma[0, :n],
+        vouch=replace(vouch, active=new_active),
+        slashed=slashed[0, :n] != 0,
+        clipped=clipped[0, :n] != 0,
+        wave_of=wave_of[0, :n].astype(jnp.int8),
+    )
+
+
+def slash_cascade_dense(
+    vouch: VouchTable,
+    sigma: jnp.ndarray,
+    seeds: jnp.ndarray,
+    session_slot,
+    risk_weight,
+    now,
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+):
+    """The kernel's exact matmul math as plain XLA (CPU parity twin)."""
+    from hypervisor_tpu.ops.liability import SlashWaveResult
+
+    rows, n, e = _prep(vouch, sigma, seeds)
+    out_sigma, consumed, slashed, clipped, wave_of = _cascade_math(
+        rows["vchr"], rows["vee"], rows["sess"], rows["bond"], rows["act"],
+        rows["exp"], rows["sigma"], rows["seeds"],
+        jnp.float32(risk_weight), jnp.int32(session_slot), jnp.float32(now),
+        trust,
+    )
+    new_active = vouch.active & ~(consumed[0, :e] > 0.0)
+    return SlashWaveResult(
+        sigma=out_sigma[0, :n],
+        vouch=replace(vouch, active=new_active),
+        slashed=slashed[0, :n],
+        clipped=clipped[0, :n],
+        wave_of=wave_of[0, :n].astype(jnp.int8),
+    )

@@ -1,0 +1,91 @@
+"""MXU slash-cascade kernel parity vs the scatter/gather XLA op.
+
+`slash_cascade_dense` runs the kernel's exact matmul math as plain XLA on
+CPU; the compiled Pallas kernel itself is TPU-gated (HV_TPU_TESTS=1).
+Reference semantics: `slashing.py:63-143` in /root/reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from hypervisor_tpu.kernels.liability_pallas import (
+    slash_cascade_dense,
+    slash_cascade_pallas,
+)
+from hypervisor_tpu.kernels.sha256_pallas import pallas_available
+from hypervisor_tpu.ops.liability import slash_cascade
+from hypervisor_tpu.tables.state import VouchTable
+
+
+def random_graph(n_agents=257, n_edges=1500, seed=0, sessions=2):
+    rng = np.random.RandomState(seed)
+    v = VouchTable.create(n_edges)
+    v = dataclasses.replace(
+        v,
+        voucher=jnp.asarray(rng.randint(0, n_agents, n_edges, dtype=np.int64), jnp.int32),
+        vouchee=jnp.asarray(rng.randint(0, n_agents, n_edges, dtype=np.int64), jnp.int32),
+        session=jnp.asarray(rng.randint(0, sessions, n_edges, dtype=np.int64), jnp.int32),
+        bond=jnp.asarray(rng.uniform(0.05, 0.2, n_edges).astype(np.float32)),
+        active=jnp.asarray(rng.uniform(0, 1, n_edges) > 0.2),
+        expiry=jnp.where(
+            jnp.asarray(rng.uniform(0, 1, n_edges) > 0.1),
+            jnp.inf,
+            -1.0,  # a few expired edges
+        ).astype(jnp.float32),
+    )
+    sigma = jnp.asarray(rng.uniform(0.05, 1.0, n_agents).astype(np.float32))
+    seeds = jnp.asarray(rng.uniform(0, 1, n_agents) > 0.97)
+    return v, sigma, seeds
+
+
+def _assert_matches(got, want):
+    np.testing.assert_allclose(
+        np.asarray(got.sigma), np.asarray(want.sigma), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(got.slashed), np.asarray(want.slashed))
+    np.testing.assert_array_equal(np.asarray(got.clipped), np.asarray(want.clipped))
+    np.testing.assert_array_equal(np.asarray(got.wave_of), np.asarray(want.wave_of))
+    np.testing.assert_array_equal(
+        np.asarray(got.vouch.active), np.asarray(want.vouch.active)
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_dense_math_matches_scatter_op(seed):
+    v, sigma, seeds = random_graph(seed=seed)
+    want = slash_cascade(v, sigma, seeds, 0, 0.95, 0.0)
+    got = slash_cascade_dense(v, sigma, seeds, 0, 0.95, 0.0)
+    _assert_matches(got, want)
+
+
+def test_dense_math_partial_omega_cascades():
+    # omega < 1 exercises the (1-omega)^k clip exponents across waves
+    v, sigma, seeds = random_graph(seed=3, n_agents=64, n_edges=256)
+    want = slash_cascade(v, sigma, seeds, 1, 0.6, 0.0)
+    got = slash_cascade_dense(v, sigma, seeds, 1, 0.6, 0.0)
+    _assert_matches(got, want)
+
+
+def test_dense_math_session_scoping():
+    v, sigma, seeds = random_graph(seed=4, sessions=3)
+    for sess in range(3):
+        want = slash_cascade(v, sigma, seeds, sess, 0.95, 0.0)
+        got = slash_cascade_dense(v, sigma, seeds, sess, 0.95, 0.0)
+        _assert_matches(got, want)
+
+
+@pytest.mark.skipif(
+    not pallas_available(),
+    reason="compiled Mosaic kernel needs a TPU backend "
+    "(opt in with HV_TPU_TESTS=1)",
+)
+def test_compiled_pallas_cascade_matches_on_tpu():
+    v, sigma, seeds = random_graph(seed=5, n_agents=1000, n_edges=4096)
+    want = slash_cascade(v, sigma, seeds, 0, 0.95, 0.0)
+    got = slash_cascade_pallas(v, sigma, seeds, 0, 0.95, 0.0)
+    _assert_matches(got, want)

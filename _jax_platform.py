@@ -1,0 +1,57 @@
+"""Force-CPU JAX bootstrap shared by tests/conftest.py and __graft_entry__.py.
+
+Hermetic virtual-mesh runs (sharding validation on N virtual CPU devices)
+must never initialize the default backend: the shell environment routes it
+at a real-accelerator tunnel (JAX_PLATFORMS=axon) whose plugin may be
+broken or version-mismatched. This module deliberately does NOT import jax
+at module level so it can run before the first jax import — the env-var
+route is the only one that both (a) stops the default-platform plugin from
+loading and (b) keeps XLA:CPU on its fast compile path (an explicit
+jax.config.update("jax_platforms", ...) switches XLA:CPU client creation
+onto a path observed to take >9 min instead of 11 s for a ~6k-op unrolled
+SHA-256 program).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def force_cpu_platform(n_devices: int = 8) -> None:
+    """Pin JAX to the CPU platform with >= n_devices virtual devices.
+
+    Call before the first jax import for the fast, fully-hermetic path.
+    If jax was already imported (e.g. by an entry-point plugin or the
+    calling driver) but the CPU backend has not been created yet, the
+    XLA_FLAGS edit below still takes effect (flags are read at backend
+    init) and an explicit jax.devices("cpu") request bypasses a captured
+    non-cpu JAX_PLATFORMS. The one unrecoverable case is a CPU backend
+    already initialized with fewer than n_devices — that surfaces later
+    as mesh._device_pool's ValueError naming this flag.
+    """
+    jax_loaded = "jax" in sys.modules
+    if not jax_loaded:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"--{_COUNT_FLAG}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = f"{flags} --{_COUNT_FLAG}={n_devices}".strip()
+    elif int(m.group(1)) < n_devices:
+        # No-op if the CPU backend already consumed the old value.
+        os.environ["XLA_FLAGS"] = re.sub(
+            rf"--{_COUNT_FLAG}=\d+", f"--{_COUNT_FLAG}={n_devices}", flags
+        )
+
+    if jax_loaded:
+        import jax
+
+        if "cpu" not in str(jax.config.jax_platforms or ""):
+            try:
+                jax.devices("cpu")  # explicit-platform request usually works
+            except RuntimeError:  # pragma: no cover - jax-version dependent
+                jax.config.update("jax_platforms", "cpu")

@@ -22,15 +22,27 @@ AGENT_AXIS = "agents"
 DCN_AXIS = "dcn"
 
 
-def _device_pool(need: int) -> list:
+def _device_pool(need: int, platform: Optional[str] = None) -> list:
     """First `need` devices, falling back to the CPU platform when the
     default platform is underprovisioned.
 
+    When `platform` is given, only that backend is ever initialized — a
+    virtual-mesh dry run (`platform="cpu"`) must stay hermetic and never
+    touch the default backend, which may be a real-accelerator tunnel.
     The CPU platform honours xla_force_host_platform_device_count, which is
-    how virtual-mesh validation gets its 8 devices. The fallback is loud:
-    an accelerator job quietly landing on host CPUs would be a silent
-    orders-of-magnitude slowdown.
+    how virtual-mesh validation gets its 8 devices. The implicit fallback
+    is loud: an accelerator job quietly landing on host CPUs would be a
+    silent orders-of-magnitude slowdown.
     """
+    if platform is not None:
+        pool = jax.devices(platform)
+        if len(pool) < need:
+            raise ValueError(
+                f"requested {need}-device {platform} mesh but only "
+                f"{len(pool)} {platform} devices available "
+                f"(set --xla_force_host_platform_device_count)"
+            )
+        return pool[:need]
     pool = jax.devices()
     if len(pool) < need:
         fallback = jax.devices("cpu")
@@ -52,14 +64,20 @@ def _device_pool(need: int) -> list:
 
 
 def make_mesh(
-    n_devices: Optional[int] = None, devices: Optional[Sequence] = None
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    platform: Optional[str] = None,
 ) -> Mesh:
-    """1-D mesh over the agent axis (ICI collectives within the slice)."""
+    """1-D mesh over the agent axis (ICI collectives within the slice).
+
+    Pass `platform="cpu"` for a hermetic virtual mesh that never
+    initializes the default backend.
+    """
     if devices is None:
         if n_devices is None:
-            devices = jax.devices()
+            devices = jax.devices(platform) if platform else jax.devices()
         else:
-            devices = _device_pool(n_devices)
+            devices = _device_pool(n_devices, platform)
     return Mesh(np.asarray(devices), (AGENT_AXIS,))
 
 

@@ -6,6 +6,11 @@ xla_force_host_platform_device_count=8 without hardware.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _jax_platform import force_cpu_platform
 
 # Hard override: the environment ships JAX_PLATFORMS=axon (real TPU via a
 # single-claim tunnel); tests must never claim it. Assignment, not
@@ -13,12 +18,16 @@ import os
 # (e.g. the compiled Pallas kernel parity test) against the real chip:
 #   HV_TPU_TESTS=1 python -m pytest tests/parity/test_pallas_sha256.py
 if os.environ.get("HV_TPU_TESTS") != "1":
-    os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    force_cpu_platform(8)
+else:
+    # TPU-gated run: keep the default (real-TPU) platform, but the
+    # virtual-CPU device count must still be available for the non-gated
+    # multi-chip tests that fall back to jax.devices("cpu").
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import asyncio
 import inspect

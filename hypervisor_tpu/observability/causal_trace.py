@@ -1,65 +1,158 @@
-"""Causal trace ids: spans that encode the agent spawn/delegation tree.
+"""Causal trace spans encoding the agent spawn/delegation tree.
 
-Capability parity with reference `observability/causal_trace.py:16-68`:
-frozen ids formatted `trace_id/span_id[/parent_span_id]` with depth,
-child/sibling derivation, parsing, and ancestor checks. The device event
-log stores these as paired int64 columns (hash of trace id, hash of span)
-so trace joins stay on-device; this class is the host-readable form.
+Capability parity with reference `observability/causal_trace.py:16-68`
+(span ids formatted `trace_id/span_id[/parent_span_id]`, child/sibling
+derivation, parsing, ancestor checks), re-built around an explicit
+*lineage path*: each span carries the tuple of span ids it knows between
+the oldest recorded ancestor and itself, so depth and parentage fall out
+of the path instead of being four independent fields. `device_key()`
+folds the span into the pair of u32 words the device `EventLog` stores
+(`tables/logs.py`), keeping trace joins on-device.
 """
 
 from __future__ import annotations
 
-import uuid
-from dataclasses import dataclass, field
+import secrets
+
+_TRACE_HEX = 12  # 48-bit trace ids
+_SPAN_HEX = 8    # 32-bit span ids
+
+_FNV32_SEED = 0x811C9DC5
+_FNV32_PRIME = 0x01000193
 
 
-@dataclass(frozen=True)
+def _fresh(width: int) -> str:
+    return secrets.token_hex(width // 2)
+
+
+def fnv1a32(text: str) -> int:
+    """32-bit FNV-1a of a string — the device-column hash for trace ids."""
+    acc = _FNV32_SEED
+    for byte in text.encode():
+        acc = ((acc ^ byte) * _FNV32_PRIME) & 0xFFFFFFFF
+    return acc
+
+
 class CausalTraceId:
-    """One span in a causal trace tree."""
+    """One span in a causal trace tree, backed by its known lineage path.
 
-    trace_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
-    span_id: str = field(default_factory=lambda: uuid.uuid4().hex[:8])
-    parent_span_id: str | None = None
-    depth: int = 0
+    `_path` holds span ids oldest-first ending at this span; `_above`
+    counts ancestors older than the path records (so depth survives
+    constructing a span from its flat string form, where grandparents are
+    unknown). Immutable by convention: every derivation returns a new span.
+    """
+
+    __slots__ = ("_trace", "_path", "_above")
+
+    def __init__(
+        self,
+        trace_id: str | None = None,
+        span_id: str | None = None,
+        parent_span_id: str | None = None,
+        depth: int = 0,
+        *,
+        _path: tuple[str, ...] | None = None,
+        _above: int = 0,
+    ) -> None:
+        self._trace = trace_id if trace_id is not None else _fresh(_TRACE_HEX)
+        if _path is not None:
+            self._path = _path
+            self._above = _above
+        else:
+            tail = span_id if span_id is not None else _fresh(_SPAN_HEX)
+            if parent_span_id is None:
+                self._path = (tail,)
+                self._above = depth
+            else:
+                self._path = (parent_span_id, tail)
+                self._above = max(depth - 1, 0)
+
+    # ── identity views ──────────────────────────────────────────────────
+
+    @property
+    def trace_id(self) -> str:
+        return self._trace
+
+    @property
+    def span_id(self) -> str:
+        return self._path[-1]
+
+    @property
+    def parent_span_id(self) -> str | None:
+        return self._path[-2] if len(self._path) > 1 else None
+
+    @property
+    def depth(self) -> int:
+        return self._above + len(self._path) - 1
+
+    @property
+    def full_id(self) -> str:
+        head = f"{self._trace}/{self.span_id}"
+        parent = self.parent_span_id
+        return f"{head}/{parent}" if parent else head
+
+    # ── derivations ─────────────────────────────────────────────────────
 
     def child(self) -> "CausalTraceId":
         """Span for a spawned sub-agent / delegated operation."""
         return CausalTraceId(
-            trace_id=self.trace_id,
-            span_id=uuid.uuid4().hex[:8],
-            parent_span_id=self.span_id,
-            depth=self.depth + 1,
+            self._trace, _path=self._path + (_fresh(_SPAN_HEX),), _above=self._above
         )
 
     def sibling(self) -> "CausalTraceId":
-        """Span at the same level (same parent, new operation)."""
+        """Span at the same level: same parent, new operation."""
         return CausalTraceId(
-            trace_id=self.trace_id,
-            span_id=uuid.uuid4().hex[:8],
-            parent_span_id=self.parent_span_id,
-            depth=self.depth,
+            self._trace,
+            _path=self._path[:-1] + (_fresh(_SPAN_HEX),),
+            _above=self._above,
         )
-
-    @property
-    def full_id(self) -> str:
-        parts = [self.trace_id, self.span_id]
-        if self.parent_span_id:
-            parts.append(self.parent_span_id)
-        return "/".join(parts)
 
     @classmethod
     def from_string(cls, s: str) -> "CausalTraceId":
-        parts = s.split("/")
-        if len(parts) < 2:
-            raise ValueError(f"Invalid causal trace ID: {s}")
+        pieces = s.split("/")
+        if len(pieces) < 2 or not all(pieces[:2]):
+            raise ValueError(f"Invalid causal trace ID: {s!r}")
         return cls(
-            trace_id=parts[0],
-            span_id=parts[1],
-            parent_span_id=parts[2] if len(parts) > 2 else None,
+            trace_id=pieces[0],
+            span_id=pieces[1],
+            parent_span_id=pieces[2] if len(pieces) > 2 else None,
         )
 
+    # ── relations ───────────────────────────────────────────────────────
+
     def is_ancestor_of(self, other: "CausalTraceId") -> bool:
-        return self.trace_id == other.trace_id and other.depth > self.depth
+        """Same trace, strictly shallower (reference semantics)."""
+        return self._trace == other._trace and other.depth > self.depth
+
+    def is_lineal_ancestor_of(self, other: "CausalTraceId") -> bool:
+        """Stricter check: this span id appears in `other`'s known lineage."""
+        return (
+            self._trace == other._trace
+            and self.span_id in other._path[:-1]
+        )
+
+    # ── device bridge ───────────────────────────────────────────────────
+
+    def device_key(self) -> tuple[int, int]:
+        """(u32 trace hash, u32 span hash) for the device event log."""
+        return fnv1a32(self._trace), fnv1a32(self.span_id)
+
+    # ── value semantics ─────────────────────────────────────────────────
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, CausalTraceId):
+            return NotImplemented
+        return (
+            self._trace == other._trace
+            and self.span_id == other.span_id
+            and self.parent_span_id == other.parent_span_id
+        )
+
+    def __hash__(self) -> int:
+        return hash((self._trace, self.span_id, self.parent_span_id))
 
     def __str__(self) -> str:
         return self.full_id
+
+    def __repr__(self) -> str:
+        return f"CausalTraceId({self.full_id!r}, depth={self.depth})"

@@ -1,25 +1,29 @@
-"""Structured event bus: append-only typed event log with pub/sub.
+"""Structured event plane: columnar host store + typed pub/sub taps.
 
-Capability parity with reference `observability/event_bus.py:108-219`:
-38 typed events across 8 categories, frozen event records carrying causal
-trace + parent ids, three secondary indices (type / session / agent),
-type-specific and wildcard subscription, flexible filtered queries with
-limit, and per-type counts.
-
-TPU mapping: the event log's device twin is `tables.logs.EventLog` — a ring
-buffer of int32 columns (type code, session slot, agent slot, trace id) so
-high-rate device-side emissions (admission waves, slash cascades) batch
-into one append; this host bus is the queryable string-keyed view.
+Capability parity with reference `observability/event_bus.py:108-219`
+(40 typed events across 8 categories, frozen records carrying causal
+trace + parent ids, indexed queries, wildcard subscription, per-type
+counts) — but the store is *columnar*, matching the device `EventLog`
+ring buffer (`tables/logs.py`) it feeds: every emit interns the session
+and agent strings to dense handles and appends one row of int codes to
+parallel arrays. Indices are posting lists of row numbers per (axis,
+handle) key; queries intersect row sets with integer compares and only
+materialize `HypervisorEvent` values for surviving rows. `device_rows()`
+hands the int columns straight to `EventLog.append_batch`, so a host bus
+and a device log fed from the same traffic agree row-for-row.
 """
 
 from __future__ import annotations
 
 import enum
 import uuid
+from array import array
 from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Any, Callable, Optional
 
+from hypervisor_tpu.observability.causal_trace import fnv1a32
+from hypervisor_tpu.tables.intern import InternTable
 from hypervisor_tpu.utils.clock import utc_now
 
 
@@ -79,12 +83,16 @@ class EventType(str, enum.Enum):
         return _EVENT_CODES[self]
 
 
-_EVENT_CODES = {t: i for i, t in enumerate(EventType)}
+_EVENT_CODES: dict[EventType, int] = {t: i for i, t in enumerate(EventType)}
+_CODE_TO_TYPE: tuple[EventType, ...] = tuple(EventType)
+
+#: Tap-table key meaning "every event type".
+_ANY = -1
 
 
 @dataclass(frozen=True)
 class HypervisorEvent:
-    """Immutable structured event."""
+    """Immutable structured event (field set is the wire contract)."""
 
     event_id: str = field(default_factory=lambda: uuid.uuid4().hex[:16])
     event_type: EventType = EventType.SESSION_CREATED
@@ -112,53 +120,108 @@ EventHandler = Callable[[HypervisorEvent], None]
 
 
 class HypervisorEventBus:
-    """Append-only event store with secondary indices and pub/sub."""
+    """Columnar append-only event store with posting-list indices.
+
+    Row r of the store is described by `_codes[r]` (EventType code),
+    `_sessions[r]` / `_agents[r]` (interned handles, -1 = absent),
+    `_traces[r]` (u32 hash of the causal trace id), `_stamps[r]` (epoch
+    seconds) — plus `_rows[r]`, the materialized event value owning the
+    payload. This is deliberately the same row shape as the device
+    `EventLog`, which `device_rows()` feeds.
+    """
 
     def __init__(self) -> None:
-        self._events: list[HypervisorEvent] = []
-        self._subs: dict[Optional[EventType], list[EventHandler]] = {}
-        self._by_type: dict[EventType, list[HypervisorEvent]] = {}
-        self._by_session: dict[str, list[HypervisorEvent]] = {}
-        self._by_agent: dict[str, list[HypervisorEvent]] = {}
+        self._codes = array("i")
+        self._sessions = array("i")
+        self._agents = array("i")
+        self._traces = array("L")
+        self._stamps = array("d")
+        self._rows: list[HypervisorEvent] = []
+        self._session_ids = InternTable()
+        self._agent_ids = InternTable()
+        # (axis, handle) -> sorted row numbers; axes: "t" type, "s" session,
+        # "a" agent.  Posting lists hold ints, never event objects.
+        self._postings: dict[tuple[str, int], array] = {}
+        # EventType code (or _ANY) -> handlers.
+        self._taps: dict[int, list[EventHandler]] = {}
+
+    # ── ingest ───────────────────────────────────────────────────────────
 
     def emit(self, event: HypervisorEvent) -> None:
-        """Append, index, and fan out to subscribers."""
-        self._events.append(event)
-        self._by_type.setdefault(event.event_type, []).append(event)
-        if event.session_id:
-            self._by_session.setdefault(event.session_id, []).append(event)
-        if event.agent_did:
-            self._by_agent.setdefault(event.agent_did, []).append(event)
-        for handler in self._subs.get(event.event_type, ()):
-            handler(event)
-        for handler in self._subs.get(None, ()):
-            handler(event)
+        """Intern, append one row to every column, then fire taps."""
+        row = len(self._rows)
+        code = event.event_type.code
+        session = (
+            self._session_ids.intern(event.session_id) if event.session_id else -1
+        )
+        agent = self._agent_ids.intern(event.agent_did) if event.agent_did else -1
+
+        self._codes.append(code)
+        self._sessions.append(session)
+        self._agents.append(agent)
+        self._traces.append(
+            fnv1a32(event.causal_trace_id) if event.causal_trace_id else 0
+        )
+        self._stamps.append(event.timestamp.timestamp())
+        self._rows.append(event)
+
+        self._post("t", code, row)
+        if session >= 0:
+            self._post("s", session, row)
+        if agent >= 0:
+            self._post("a", agent, row)
+
+        for tap in self._taps.get(code, ()):
+            tap(event)
+        for tap in self._taps.get(_ANY, ()):
+            tap(event)
+
+    def _post(self, axis: str, handle: int, row: int) -> None:
+        key = (axis, handle)
+        rows = self._postings.get(key)
+        if rows is None:
+            self._postings[key] = rows = array("i")
+        rows.append(row)
+
+    # ── pub/sub ──────────────────────────────────────────────────────────
 
     def subscribe(
         self,
         event_type: Optional[EventType] = None,
         handler: Optional[EventHandler] = None,
     ) -> None:
-        """Register a handler; event_type=None means wildcard."""
-        if handler:
-            self._subs.setdefault(event_type, []).append(handler)
+        """Register a tap; event_type=None taps every event."""
+        if handler is None:
+            return
+        key = _ANY if event_type is None else event_type.code
+        self._taps.setdefault(key, []).append(handler)
 
-    # ── queries ──────────────────────────────────────────────────────
+    # ── queries (posting-list driven) ────────────────────────────────────
+
+    def _rows_for(self, axis: str, handle: int) -> array:
+        return self._postings.get((axis, handle), array("i"))
 
     def query_by_type(self, event_type: EventType) -> list[HypervisorEvent]:
-        return list(self._by_type.get(event_type, ()))
+        return [self._rows[r] for r in self._rows_for("t", event_type.code)]
 
     def query_by_session(self, session_id: str) -> list[HypervisorEvent]:
-        return list(self._by_session.get(session_id, ()))
+        handle = self._session_ids.lookup(session_id)
+        return [self._rows[r] for r in self._rows_for("s", handle)]
 
     def query_by_agent(self, agent_did: str) -> list[HypervisorEvent]:
-        return list(self._by_agent.get(agent_did, ()))
+        handle = self._agent_ids.lookup(agent_did)
+        return [self._rows[r] for r in self._rows_for("a", handle)]
 
     def query_by_time_range(
         self, start: datetime, end: Optional[datetime] = None
     ) -> list[HypervisorEvent]:
-        end = end or utc_now()
-        return [e for e in self._events if start <= e.timestamp <= end]
+        lo = start.timestamp()
+        hi = (end or utc_now()).timestamp()
+        return [
+            self._rows[r]
+            for r, t in enumerate(self._stamps)
+            if lo <= t <= hi
+        ]
 
     def query(
         self,
@@ -167,36 +230,70 @@ class HypervisorEventBus:
         agent_did: Optional[str] = None,
         limit: Optional[int] = None,
     ) -> list[HypervisorEvent]:
-        """Multi-filter query; starts from the narrowest index available."""
+        """Multi-filter query: narrowest posting list, then column compares."""
+        candidates: list[array] = []
+        want_session = want_agent = -2  # -2 = unconstrained; -1 = never matches
         if event_type is not None:
-            results = self._by_type.get(event_type, [])
-        elif session_id is not None:
-            results = self._by_session.get(session_id, [])
-        elif agent_did is not None:
-            results = self._by_agent.get(agent_did, [])
-        else:
-            results = self._events
+            candidates.append(self._rows_for("t", event_type.code))
         if session_id is not None:
-            results = [e for e in results if e.session_id == session_id]
+            want_session = self._session_ids.lookup(session_id)
+            candidates.append(self._rows_for("s", want_session))
         if agent_did is not None:
-            results = [e for e in results if e.agent_did == agent_did]
-        if limit is not None:
-            results = results[-limit:]
-        return list(results)
+            want_agent = self._agent_ids.lookup(agent_did)
+            candidates.append(self._rows_for("a", want_agent))
+
+        if candidates:
+            seed = min(candidates, key=len)
+            rows = (
+                r
+                for r in seed
+                if (want_session == -2 or self._sessions[r] == want_session)
+                and (want_agent == -2 or self._agents[r] == want_agent)
+                and (event_type is None or self._codes[r] == event_type.code)
+            )
+        else:
+            rows = iter(range(len(self._rows)))
+
+        matched = [self._rows[r] for r in rows]
+        return matched[-limit:] if limit is not None else matched
+
+    # ── aggregates ───────────────────────────────────────────────────────
 
     @property
     def event_count(self) -> int:
-        return len(self._events)
+        return len(self._rows)
 
     @property
     def all_events(self) -> list[HypervisorEvent]:
-        return list(self._events)
+        return list(self._rows)
 
     def type_counts(self) -> dict[str, int]:
-        return {t.value: len(evts) for t, evts in self._by_type.items()}
+        return {
+            _CODE_TO_TYPE[handle].value: len(rows)
+            for (axis, handle), rows in self._postings.items()
+            if axis == "t"
+        }
 
     def clear(self) -> None:
-        self._events.clear()
-        self._by_type.clear()
-        self._by_session.clear()
-        self._by_agent.clear()
+        fresh = HypervisorEventBus()
+        self.__dict__.update(fresh.__dict__)
+
+    # ── device bridge ────────────────────────────────────────────────────
+
+    def device_rows(self, since_row: int = 0):
+        """Int columns for rows >= since_row, shaped for EventLog.append_batch.
+
+        Returns (codes i32[B], sessions i32[B], agents i32[B], traces u32[B],
+        stamps f32[B]) as numpy arrays; pass them straight to
+        `tables.logs.EventLog.append_batch` to mirror host traffic on device.
+        """
+        import numpy as np
+
+        sl = slice(since_row, len(self._rows))
+        return (
+            np.asarray(self._codes[sl], np.int32),
+            np.asarray(self._sessions[sl], np.int32),
+            np.asarray(self._agents[sl], np.int32),
+            np.asarray(self._traces[sl], np.uint32),
+            np.asarray(self._stamps[sl], np.float32),
+        )

@@ -1,14 +1,19 @@
 """Declarative saga DSL: dict/YAML definitions -> executable saga topology.
 
-Capability parity with reference `saga/dsl.py:99-238`: required name /
-session_id / non-empty steps, unique step ids, step field validation,
-fan-out groups needing >=2 branches referencing declared steps, conversion
-to SagaStep objects, and a non-raising `validate()` collecting errors.
+Capability parity with reference `saga/dsl.py:99-238` (required name /
+session_id / non-empty steps, unique step ids, per-step required fields,
+fan-out groups needing >=2 branches that reference declared steps,
+conversion to SagaStep objects, and a non-raising error collector) —
+re-built around a single schema-driven validation core: one `_distill`
+pass walks the definition against small spec tables and either raises at
+the first problem (`parse`) or accumulates every problem (`validate`),
+so the two entry points can never drift apart the way hand-duplicated
+checks do.
 """
 
 from __future__ import annotations
 
-import uuid
+import secrets
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -18,6 +23,13 @@ from hypervisor_tpu.saga.state_machine import SagaStep
 
 class SagaDSLError(Exception):
     """Invalid saga DSL definition."""
+
+
+def _fresh_saga_id() -> str:
+    return f"saga:{secrets.token_hex(5)}"
+
+
+# ── value types ─────────────────────────────────────────────────────────
 
 
 @dataclass
@@ -42,7 +54,7 @@ class SagaDSLFanOut:
 class SagaDefinition:
     name: str = ""
     session_id: str = ""
-    saga_id: str = field(default_factory=lambda: f"saga:{uuid.uuid4().hex[:8]}")
+    saga_id: str = field(default_factory=_fresh_saga_id)
     steps: list[SagaDSLStep] = field(default_factory=list)
     fan_outs: list[SagaDSLFanOut] = field(default_factory=list)
     metadata: dict[str, Any] = field(default_factory=dict)
@@ -53,95 +65,135 @@ class SagaDefinition:
 
     @property
     def fan_out_step_ids(self) -> set[str]:
-        ids: set[str] = set()
-        for fo in self.fan_outs:
-            ids.update(fo.branch_step_ids)
-        return ids
+        return {sid for fo in self.fan_outs for sid in fo.branch_step_ids}
 
     @property
     def sequential_steps(self) -> list[SagaDSLStep]:
         """Steps outside every fan-out group (run in declaration order)."""
-        fo = self.fan_out_step_ids
-        return [s for s in self.steps if s.id not in fo]
+        grouped = self.fan_out_step_ids
+        return [s for s in self.steps if s.id not in grouped]
+
+
+# ── schema tables ───────────────────────────────────────────────────────
+
+#: Required string fields of the top-level definition.
+_ROOT_REQUIRED = ("name", "session_id")
+
+#: Required string fields of each step entry.
+_STEP_REQUIRED = ("id", "action_id", "agent")
+
+#: Optional step fields with their defaults (copied into SagaDSLStep).
+_STEP_DEFAULTS: dict[str, Any] = {
+    "execute_api": "",
+    "undo_api": None,
+    "timeout": 300,
+    "retries": 0,
+    "checkpoint_goal": None,
+}
+
+
+class _Problems:
+    """Either raises at the first problem or accumulates all of them."""
+
+    def __init__(self, accumulate: bool) -> None:
+        self.accumulate = accumulate
+        self.found: list[str] = []
+
+    def report(self, message: str) -> None:
+        if not self.accumulate:
+            raise SagaDSLError(message)
+        self.found.append(message)
+
+
+def _distill(
+    definition: dict[str, Any], problems: _Problems
+) -> Optional[SagaDefinition]:
+    """Single validation+construction pass shared by parse and validate."""
+    for key in _ROOT_REQUIRED:
+        if not definition.get(key):
+            problems.report(f"Missing '{key}'")
+
+    raw_steps = definition.get("steps") or []
+    if not raw_steps:
+        problems.report("Saga needs at least one step")
+        return None  # nothing below is checkable
+
+    steps: list[SagaDSLStep] = []
+    declared: set[str] = set()
+    for position, raw in enumerate(raw_steps):
+        label = raw.get("id") or f"step[{position}]"
+        ok = True
+        for key in _STEP_REQUIRED:
+            if not raw.get(key):
+                problems.report(f"{label}: missing '{key}'")
+                ok = False
+        sid = raw.get("id")
+        if sid:
+            if sid in declared:
+                problems.report(f"Duplicate step ID: {sid}")
+                ok = False
+            declared.add(sid)
+        if ok:
+            fields = {k: raw.get(k, dflt) for k, dflt in _STEP_DEFAULTS.items()}
+            steps.append(
+                SagaDSLStep(
+                    id=raw["id"],
+                    action_id=raw["action_id"],
+                    agent=raw["agent"],
+                    **fields,
+                )
+            )
+
+    fan_outs: list[SagaDSLFanOut] = []
+    for raw in definition.get("fan_out") or []:
+        wanted = raw.get("policy", FanOutPolicy.ALL_MUST_SUCCEED.value)
+        policy = next((p for p in FanOutPolicy if p.value == wanted), None)
+        if policy is None:
+            problems.report(
+                f"Invalid fan-out policy: {wanted} "
+                f"(one of {[p.value for p in FanOutPolicy]})"
+            )
+            continue
+        branches = list(raw.get("branches") or ())
+        if len(branches) < 2:
+            problems.report("Fan-out needs at least 2 branches")
+            continue
+        unknown = [b for b in branches if b not in declared]
+        for bad in unknown:
+            problems.report(f"Fan-out branch '{bad}' is not a valid step ID")
+        if not unknown:
+            fan_outs.append(SagaDSLFanOut(policy=policy, branch_step_ids=branches))
+
+    if problems.found:
+        return None
+    return SagaDefinition(
+        name=definition["name"],
+        session_id=definition["session_id"],
+        saga_id=definition.get("saga_id") or _fresh_saga_id(),
+        steps=steps,
+        fan_outs=fan_outs,
+        metadata=definition.get("metadata") or {},
+    )
+
+
+# ── entry points ────────────────────────────────────────────────────────
 
 
 class SagaDSLParser:
     """Validating parser from plain dicts (YAML-loaded or literal)."""
 
     def parse(self, definition: dict[str, Any]) -> SagaDefinition:
-        """Parse or raise SagaDSLError on the first structural problem."""
-        name = definition.get("name", "")
-        if not name:
-            raise SagaDSLError("Saga definition must have a 'name'")
-        session_id = definition.get("session_id", "")
-        if not session_id:
-            raise SagaDSLError("Saga definition must have a 'session_id'")
-
-        raw_steps = definition.get("steps", [])
-        if not raw_steps:
-            raise SagaDSLError("Saga must have at least one step")
-
-        steps: list[SagaDSLStep] = []
-        seen: set[str] = set()
-        for raw in raw_steps:
-            step = self._parse_step(raw)
-            if step.id in seen:
-                raise SagaDSLError(f"Duplicate step ID: {step.id}")
-            seen.add(step.id)
-            steps.append(step)
-
-        fan_outs = [
-            self._parse_fan_out(raw, seen) for raw in definition.get("fan_out", [])
-        ]
-
-        return SagaDefinition(
-            name=name,
-            session_id=session_id,
-            saga_id=definition.get("saga_id", f"saga:{uuid.uuid4().hex[:8]}"),
-            steps=steps,
-            fan_outs=fan_outs,
-            metadata=definition.get("metadata", {}),
-        )
+        """Parse, raising SagaDSLError at the first structural problem."""
+        spec = _distill(definition, _Problems(accumulate=False))
+        assert spec is not None  # _Problems raised on any problem
+        return spec
 
     @staticmethod
-    def _parse_step(raw: dict) -> SagaDSLStep:
-        step_id = raw.get("id", "")
-        if not step_id:
-            raise SagaDSLError("Each step must have an 'id'")
-        action_id = raw.get("action_id", "")
-        if not action_id:
-            raise SagaDSLError(f"Step {step_id} must have an 'action_id'")
-        agent = raw.get("agent", "")
-        if not agent:
-            raise SagaDSLError(f"Step {step_id} must have an 'agent'")
-        return SagaDSLStep(
-            id=step_id,
-            action_id=action_id,
-            agent=agent,
-            execute_api=raw.get("execute_api", ""),
-            undo_api=raw.get("undo_api"),
-            timeout=raw.get("timeout", 300),
-            retries=raw.get("retries", 0),
-            checkpoint_goal=raw.get("checkpoint_goal"),
-        )
-
-    @staticmethod
-    def _parse_fan_out(raw: dict, valid_step_ids: set[str]) -> SagaDSLFanOut:
-        policy_str = raw.get("policy", "all_must_succeed")
-        try:
-            policy = FanOutPolicy(policy_str)
-        except ValueError as e:
-            raise SagaDSLError(
-                f"Invalid fan-out policy: {policy_str}. "
-                f"Valid: {[p.value for p in FanOutPolicy]}"
-            ) from e
-        branches = raw.get("branches", [])
-        if len(branches) < 2:
-            raise SagaDSLError("Fan-out must have at least 2 branches")
-        for bid in branches:
-            if bid not in valid_step_ids:
-                raise SagaDSLError(f"Fan-out branch '{bid}' is not a valid step ID")
-        return SagaDSLFanOut(policy=policy, branch_step_ids=branches)
+    def validate(definition: dict[str, Any]) -> list[str]:
+        """Collect every structural problem without raising (empty = valid)."""
+        problems = _Problems(accumulate=True)
+        _distill(definition, problems)
+        return problems.found
 
     @staticmethod
     def to_saga_steps(definition: SagaDefinition) -> list[SagaStep]:
@@ -157,29 +209,3 @@ class SagaDSLParser:
             )
             for s in definition.steps
         ]
-
-    @staticmethod
-    def validate(definition: dict[str, Any]) -> list[str]:
-        """Collect every structural error without raising (empty = valid)."""
-        errors: list[str] = []
-        if not definition.get("name"):
-            errors.append("Missing 'name'")
-        if not definition.get("session_id"):
-            errors.append("Missing 'session_id'")
-        if not definition.get("steps"):
-            errors.append("Missing 'steps'")
-            return errors
-        seen: set[str] = set()
-        for i, step in enumerate(definition["steps"]):
-            sid = step.get("id")
-            if not sid:
-                errors.append(f"Step {i} missing 'id'")
-            elif sid in seen:
-                errors.append(f"Duplicate step ID: {sid}")
-            else:
-                seen.add(sid)
-            if not step.get("action_id"):
-                errors.append(f"Step {sid or i} missing 'action_id'")
-            if not step.get("agent"):
-                errors.append(f"Step {sid or i} missing 'agent'")
-        return errors

@@ -1,18 +1,32 @@
 """Summary-hash commitment: anchor each session's Merkle root at termination.
 
-Capability parity with reference `audit/commitment.py:28-77`: per-session
-CommitmentRecord store, root-equality verification, and a batch queue/flush
-for external anchoring (committed_to stays "local"; a real chain writer is
-an integration concern).
+Capability parity with reference `audit/commitment.py:28-77` (per-session
+commitment records, root-equality verification, batch queue/flush for
+external anchoring; committed_to stays "local" — a real chain writer is
+an integration concern). Extended for the device plane: each session
+keeps a commitment *history* (re-commits after replay are first-class),
+and roots may arrive as the u32[8] word vectors the Pallas SHA-256
+kernel emits (`ops/merkle.py`) — `commit_device_root` folds them to the
+canonical hex form so host- and device-computed roots verify through
+one path.
 """
 
 from __future__ import annotations
 
+import secrets
+from collections import deque
 from dataclasses import dataclass, field
 from datetime import datetime
-from typing import Optional
+from typing import Iterable, Optional
 
 from hypervisor_tpu.utils.clock import utc_now
+
+
+def words_to_hex(root_words: Iterable[int]) -> str:
+    """u32[8] device Merkle root -> 64-char hex digest string."""
+    from hypervisor_tpu.ops.sha256 import digests_to_hex
+
+    return digests_to_hex([[int(w) & 0xFFFFFFFF for w in root_words]])[0]
 
 
 @dataclass
@@ -24,14 +38,17 @@ class CommitmentRecord:
     committed_at: datetime = field(default_factory=utc_now)
     blockchain_tx_id: Optional[str] = None
     committed_to: str = "local"  # "local" | "ethereum" | "ipfs"
+    commitment_id: str = field(
+        default_factory=lambda: f"commit:{secrets.token_hex(4)}"
+    )
 
 
 class CommitmentEngine:
-    """Stores and verifies per-session summary-hash commitments."""
+    """Per-session commitment histories + an anchoring queue."""
 
     def __init__(self) -> None:
-        self._by_session: dict[str, CommitmentRecord] = {}
-        self._batch: list[CommitmentRecord] = []
+        self._ledger: dict[str, list[CommitmentRecord]] = {}
+        self._anchor_queue: deque[CommitmentRecord] = deque()
 
     def commit(
         self,
@@ -43,23 +60,45 @@ class CommitmentEngine:
         record = CommitmentRecord(
             session_id=session_id,
             merkle_root=merkle_root,
-            participant_dids=participant_dids,
+            participant_dids=list(participant_dids),
             delta_count=delta_count,
         )
-        self._by_session[session_id] = record
+        self._ledger.setdefault(session_id, []).append(record)
         return record
 
+    def commit_device_root(
+        self,
+        session_id: str,
+        root_words: Iterable[int],
+        participant_dids: list[str],
+        delta_count: int,
+    ) -> CommitmentRecord:
+        """Commit a root produced on device as u32[8] words."""
+        return self.commit(
+            session_id, words_to_hex(root_words), participant_dids, delta_count
+        )
+
     def verify(self, session_id: str, expected_root: str) -> bool:
-        record = self._by_session.get(session_id)
-        return record is not None and record.merkle_root == expected_root
+        """Does the latest commitment for the session carry this root?"""
+        latest = self.get_commitment(session_id)
+        return latest is not None and latest.merkle_root == expected_root
 
-    def queue_for_batch(self, record: CommitmentRecord) -> None:
-        self._batch.append(record)
-
-    def flush_batch(self) -> list[CommitmentRecord]:
-        batch = list(self._batch)
-        self._batch.clear()
-        return batch
+    def verify_device_root(self, session_id: str, root_words: Iterable[int]) -> bool:
+        return self.verify(session_id, words_to_hex(root_words))
 
     def get_commitment(self, session_id: str) -> Optional[CommitmentRecord]:
-        return self._by_session.get(session_id)
+        history = self._ledger.get(session_id)
+        return history[-1] if history else None
+
+    def get_history(self, session_id: str) -> list[CommitmentRecord]:
+        return list(self._ledger.get(session_id, ()))
+
+    # ── external anchoring queue ────────────────────────────────────────
+
+    def queue_for_batch(self, record: CommitmentRecord) -> None:
+        self._anchor_queue.append(record)
+
+    def flush_batch(self) -> list[CommitmentRecord]:
+        drained = list(self._anchor_queue)
+        self._anchor_queue.clear()
+        return drained

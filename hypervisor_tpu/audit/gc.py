@@ -1,11 +1,15 @@
 """Ephemeral-data garbage collection after session archival.
 
-Capability parity with reference `audit/gc.py:48-141`: retention policy
-(90-day deltas, permanent summary hash), best-effort VFS purge via
-duck-typed list/delete, delta expiry via the engine's prune hook, storage
-accounting, purged-session tracking. Unlike the reference (whose per-file
-delete call signature never matches SessionVFS and silently no-ops), the
-purge here actually removes files, attributed to a system DID.
+Capability parity with reference `audit/gc.py:48-141` (retention policy —
+90-day deltas, permanent summary hash; best-effort VFS purge via
+duck-typed list/delete; delta expiry via the engine's prune hook; storage
+accounting; purged-session tracking) — organized as a plan/execute
+pipeline: `collect` builds a `_Sweep` from the three purge phases (VFS
+files, caches, aged deltas), each phase reporting its own counts, and the
+accounting step folds the phase reports into the `GCResult`. Unlike the
+reference (whose per-file delete call signature never matches SessionVFS
+and silently no-ops), the VFS phase actually removes files, attributed to
+a system DID.
 """
 
 from __future__ import annotations
@@ -50,6 +54,14 @@ class GCResult:
         return (self.storage_saved_bytes / self.storage_before_bytes) * 100
 
 
+@dataclass
+class _Sweep:
+    """Phase reports folded into the final GCResult."""
+
+    vfs_purged: int = 0
+    deltas_retained: int = 0
+
+
 class EphemeralGC:
     """Post-archive collector: purge VFS + caches, expire deltas, keep the hash."""
 
@@ -58,8 +70,7 @@ class EphemeralGC:
     ) -> None:
         self.policy = policy or RetentionPolicy()
         self._clock = clock
-        self._history: list[GCResult] = []
-        self._purged: set[str] = set()
+        self._results_by_session: dict[str, list[GCResult]] = {}
 
     def collect(
         self,
@@ -74,51 +85,63 @@ class EphemeralGC:
         estimated_delta_bytes: int = 0,
     ) -> GCResult:
         """Purge a terminated session's ephemeral state (best-effort)."""
-        purged_vfs = vfs_file_count
-        if vfs is not None:
-            try:
-                files = list(vfs.list_files()) if hasattr(vfs, "list_files") else []
-                purged_vfs = len(files)
-                for f in files:
-                    try:
-                        vfs.delete(f, GC_AGENT_DID)
-                    except TypeError:
-                        vfs.delete(f)
-                    except Exception:
-                        pass  # best-effort
-            except Exception:
-                purged_vfs = vfs_file_count
+        sweep = _Sweep(vfs_purged=vfs_file_count, deltas_retained=delta_count)
+        self._sweep_vfs(vfs, sweep)
+        self._sweep_deltas(delta_engine, delta_count, sweep)
 
-        retained_deltas = delta_count
-        if delta_engine is not None and hasattr(delta_engine, "deltas"):
-            expired = sum(
-                1
-                for d in delta_engine.deltas
-                if self.should_expire_deltas(d.timestamp)
-            )
-            retained_deltas = delta_count - expired
-            if hasattr(delta_engine, "prune_expired"):
-                delta_engine.prune_expired(self.policy.delta_retention_days)
-
-        before = estimated_vfs_bytes + estimated_cache_bytes + estimated_delta_bytes
-        after = estimated_delta_bytes if delta_count > 0 else 0
-
+        ephemeral = estimated_vfs_bytes + estimated_cache_bytes
+        surviving = estimated_delta_bytes if delta_count > 0 else 0
         result = GCResult(
             session_id=session_id,
-            retained_deltas=max(retained_deltas, 0),
-            retained_hash=True,
-            purged_vfs_files=purged_vfs,
+            retained_deltas=max(sweep.deltas_retained, 0),
+            retained_hash=True,  # policy.hash_retention is "permanent"
+            purged_vfs_files=sweep.vfs_purged,
             purged_caches=cache_count,
-            storage_before_bytes=before,
-            storage_after_bytes=after,
+            storage_before_bytes=ephemeral + surviving,
+            storage_after_bytes=surviving,
             gc_at=self._clock(),
         )
-        self._history.append(result)
-        self._purged.add(session_id)
+        self._results_by_session.setdefault(session_id, []).append(result)
         return result
 
+    # ── purge phases ────────────────────────────────────────────────────
+
+    @staticmethod
+    def _sweep_vfs(vfs: Any, sweep: _Sweep) -> None:
+        if vfs is None or not hasattr(vfs, "list_files"):
+            return
+        try:
+            doomed = list(vfs.list_files())
+        except Exception:
+            return
+        sweep.vfs_purged = len(doomed)
+        for path in doomed:
+            try:
+                vfs.delete(path, GC_AGENT_DID)
+            except TypeError:
+                try:
+                    vfs.delete(path)
+                except Exception:
+                    pass  # best-effort
+            except Exception:
+                pass  # best-effort
+
+    def _sweep_deltas(self, delta_engine: Any, delta_count: int, sweep: _Sweep) -> None:
+        if delta_engine is None or not hasattr(delta_engine, "deltas"):
+            return
+        aged = sum(
+            1
+            for d in delta_engine.deltas
+            if self.should_expire_deltas(d.timestamp)
+        )
+        sweep.deltas_retained = delta_count - aged
+        if hasattr(delta_engine, "prune_expired"):
+            delta_engine.prune_expired(self.policy.delta_retention_days)
+
+    # ── queries ─────────────────────────────────────────────────────────
+
     def is_purged(self, session_id: str) -> bool:
-        return session_id in self._purged
+        return session_id in self._results_by_session
 
     def should_expire_deltas(self, delta_timestamp: datetime) -> bool:
         cutoff = self._clock() - timedelta(days=self.policy.delta_retention_days)
@@ -126,8 +149,8 @@ class EphemeralGC:
 
     @property
     def history(self) -> list[GCResult]:
-        return list(self._history)
+        return [r for runs in self._results_by_session.values() for r in runs]
 
     @property
     def purged_session_count(self) -> int:
-        return len(self._purged)
+        return len(self._results_by_session)

@@ -5,6 +5,12 @@ Protocol-typed verifier, severity ladder 0.15/0.30/0.50/0.75 (injectable
 `DriftThresholds`), should_slash = HIGH|CRITICAL, should_demote = MEDIUM,
 no-verifier pass-through, per-agent drift history / rate / mean, and an
 on-drift callback.
+
+Organized as score -> ladder -> book: one `_score` helper normalizes the
+verifier (or its absence) to a (score, explanation) pair, the severity
+ladder is data (walked, not if-chained), and results are booked into
+per-agent accounts that carry running violation/score sums so the rate
+and mean queries are O(1) instead of history scans.
 """
 
 from __future__ import annotations
@@ -48,6 +54,15 @@ class DriftThresholds:
     high: float = 0.50
     critical: float = 0.75
 
+    def ladder(self) -> tuple[tuple[float, DriftSeverity], ...]:
+        """Cut points walked top-down; first match wins."""
+        return (
+            (self.critical, DriftSeverity.CRITICAL),
+            (self.high, DriftSeverity.HIGH),
+            (self.medium, DriftSeverity.MEDIUM),
+            (self.low, DriftSeverity.LOW),
+        )
+
 
 @dataclass
 class DriftCheckResult:
@@ -69,8 +84,23 @@ class DriftCheckResult:
         return self.severity is DriftSeverity.MEDIUM
 
 
+@dataclass
+class _AgentAccount:
+    """Per-agent drift bookkeeping with running aggregates."""
+
+    checks: list[DriftCheckResult] = field(default_factory=list)
+    violations: int = 0
+    score_sum: float = 0.0
+
+    def book(self, result: DriftCheckResult) -> None:
+        self.checks.append(result)
+        self.score_sum += result.drift_score
+        if not result.passed:
+            self.violations += 1
+
+
 class CMVKAdapter:
-    """Drift checks with severity classification and history tracking."""
+    """Drift checks with severity classification and per-agent accounts."""
 
     def __init__(
         self,
@@ -83,7 +113,11 @@ class CMVKAdapter:
         self.thresholds = thresholds or DriftThresholds()
         self._on_drift = on_drift_detected
         self._clock = clock
-        self._history: list[DriftCheckResult] = []
+        self._accounts: dict[str, _AgentAccount] = {}
+        self._check_count = 0
+        self._violation_count = 0
+
+    # ── the check ───────────────────────────────────────────────────────
 
     def check_behavioral_drift(
         self,
@@ -96,90 +130,102 @@ class CMVKAdapter:
         threshold_profile: Optional[str] = None,
     ) -> DriftCheckResult:
         """Compare claimed vs observed behavior; classify the drift."""
-        if self._verifier is None:
-            result = DriftCheckResult(
-                agent_did=agent_did,
-                session_id=session_id,
-                drift_score=0.0,
-                severity=DriftSeverity.NONE,
-                passed=True,
-                action_id=action_id,
-                checked_at=self._clock(),
-            )
-            self._history.append(result)
-            return result
-
-        verdict = self._verifier.verify_embeddings(
-            embedding_a=claimed_embedding,
-            embedding_b=observed_embedding,
-            metric=metric,
-            threshold_profile=threshold_profile,
-            explain=True,
+        score, explanation = self._score(
+            claimed_embedding, observed_embedding, metric, threshold_profile
         )
-        drift_score = getattr(verdict, "drift_score", 0.0)
-        explanation = None
-        if getattr(verdict, "explanation", None):
-            explanation = str(verdict.explanation)
-
-        severity = self._classify(drift_score)
-        passed = severity in (DriftSeverity.NONE, DriftSeverity.LOW)
+        severity = self._classify(score)
         result = DriftCheckResult(
             agent_did=agent_did,
             session_id=session_id,
-            drift_score=drift_score,
+            drift_score=score,
             severity=severity,
-            passed=passed,
+            passed=severity in (DriftSeverity.NONE, DriftSeverity.LOW),
             explanation=explanation,
             action_id=action_id,
             checked_at=self._clock(),
         )
-        self._history.append(result)
-        if not passed and self._on_drift is not None:
+        self._book(result)
+        if not result.passed and self._on_drift is not None:
             self._on_drift(result)
         return result
+
+    def _score(
+        self,
+        claimed: Any,
+        observed: Any,
+        metric: str,
+        threshold_profile: Optional[str],
+    ) -> tuple[float, Optional[str]]:
+        """Normalize the verifier (or its absence) to (score, explanation)."""
+        if self._verifier is None:
+            return 0.0, None  # pass-through: no backing service
+        verdict = self._verifier.verify_embeddings(
+            embedding_a=claimed,
+            embedding_b=observed,
+            metric=metric,
+            threshold_profile=threshold_profile,
+            explain=True,
+        )
+        explanation = getattr(verdict, "explanation", None)
+        return (
+            getattr(verdict, "drift_score", 0.0),
+            str(explanation) if explanation else None,
+        )
+
+    def _classify(self, score: float) -> DriftSeverity:
+        for cut, severity in self.thresholds.ladder():
+            if score >= cut:
+                return severity
+        return DriftSeverity.NONE
+
+    def _book(self, result: DriftCheckResult) -> None:
+        self._accounts.setdefault(result.agent_did, _AgentAccount()).book(result)
+        self._check_count += 1
+        if not result.passed:
+            self._violation_count += 1
+
+    # ── per-agent queries ───────────────────────────────────────────────
 
     def get_agent_drift_history(
         self, agent_did: str, session_id: Optional[str] = None
     ) -> list[DriftCheckResult]:
-        return [
-            r
-            for r in self._history
-            if r.agent_did == agent_did
-            and (session_id is None or r.session_id == session_id)
-        ]
+        account = self._accounts.get(agent_did)
+        if account is None:
+            return []
+        if session_id is None:
+            return list(account.checks)
+        return [r for r in account.checks if r.session_id == session_id]
 
     def get_drift_rate(
         self, agent_did: str, session_id: Optional[str] = None
     ) -> float:
-        history = self.get_agent_drift_history(agent_did, session_id)
-        if not history:
+        account = self._accounts.get(agent_did)
+        if account is None or not account.checks:
             return 0.0
-        return sum(1 for r in history if not r.passed) / len(history)
+        if session_id is None:  # O(1) from the running aggregates
+            return account.violations / len(account.checks)
+        scoped = self.get_agent_drift_history(agent_did, session_id)
+        if not scoped:
+            return 0.0
+        return sum(1 for r in scoped if not r.passed) / len(scoped)
 
     def get_mean_drift_score(
         self, agent_did: str, session_id: Optional[str] = None
     ) -> float:
-        history = self.get_agent_drift_history(agent_did, session_id)
-        if not history:
+        account = self._accounts.get(agent_did)
+        if account is None or not account.checks:
             return 0.0
-        return sum(r.drift_score for r in history) / len(history)
+        if session_id is None:
+            return account.score_sum / len(account.checks)
+        scoped = self.get_agent_drift_history(agent_did, session_id)
+        if not scoped:
+            return 0.0
+        return sum(r.drift_score for r in scoped) / len(scoped)
 
     @property
     def total_checks(self) -> int:
-        return len(self._history)
+        return self._check_count
 
     @property
     def total_violations(self) -> int:
-        return sum(1 for r in self._history if not r.passed)
-
-    def _classify(self, drift_score: float) -> DriftSeverity:
-        t = self.thresholds
-        if drift_score >= t.critical:
-            return DriftSeverity.CRITICAL
-        if drift_score >= t.high:
-            return DriftSeverity.HIGH
-        if drift_score >= t.medium:
-            return DriftSeverity.MEDIUM
-        if drift_score >= t.low:
-            return DriftSeverity.LOW
-        return DriftSeverity.NONE
+        return self._violation_count

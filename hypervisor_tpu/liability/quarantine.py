@@ -1,17 +1,22 @@
 """Quarantine: read-only isolation with forensic preservation.
 
-Capability parity with reference `liability/quarantine.py:56-177`: reasons
-enum, default 300s duration, escalation merging into an existing record,
-tick() auto-release sweeps, forensic data retention, filtered history.
-Quarantined agents keep read access for forensic replay but cannot write,
-execute saga steps, or elevate (enforced by callers via `is_quarantined` —
-device plane: the FLAG_QUARANTINED bit in the agent table).
+Capability parity with reference `liability/quarantine.py:56-177`
+(reasons enum, default 300s duration, escalation merging into an
+existing record, tick() auto-release sweeps, forensic data retention,
+filtered history) — re-built around a two-tier store: live records are
+keyed by (agent, session) for O(1) membership checks on the hot path,
+and every record that leaves the live tier (release, expiry) moves to
+an append-only archive. The reference instead linearly scans one flat
+dict on every lookup. Quarantined agents keep read access for forensic
+replay but cannot write, execute saga steps, or elevate (enforced by
+callers via `is_quarantined` — device plane: the FLAG_QUARANTINED bit
+in the agent table).
 """
 
 from __future__ import annotations
 
 import enum
-import uuid
+import secrets
 from dataclasses import dataclass, field
 from datetime import datetime, timedelta
 from typing import Optional
@@ -31,7 +36,9 @@ class QuarantineReason(str, enum.Enum):
 
 @dataclass
 class QuarantineRecord:
-    quarantine_id: str = field(default_factory=lambda: f"quar:{uuid.uuid4().hex[:8]}")
+    quarantine_id: str = field(
+        default_factory=lambda: f"quar:{secrets.token_hex(4)}"
+    )
     agent_did: str = ""
     session_id: str = ""
     reason: QuarantineReason = QuarantineReason.MANUAL
@@ -44,9 +51,7 @@ class QuarantineRecord:
 
     @property
     def is_expired(self) -> bool:
-        if self.expires_at is None:
-            return False
-        return utc_now() > self.expires_at
+        return self.expired_at(utc_now())
 
     def expired_at(self, now: datetime) -> bool:
         return self.expires_at is not None and now > self.expires_at
@@ -58,7 +63,7 @@ class QuarantineRecord:
 
 
 class QuarantineManager:
-    """Quarantine table with escalation-merge and expiry sweeps."""
+    """Two-tier quarantine store: live keyed map + append-only archive."""
 
     DEFAULT_QUARANTINE_SECONDS = int(
         DEFAULT_CONFIG.quarantine.default_duration_seconds
@@ -66,7 +71,8 @@ class QuarantineManager:
 
     def __init__(self, clock: Clock = utc_now) -> None:
         self._clock = clock
-        self._records: dict[str, QuarantineRecord] = {}
+        self._live: dict[tuple[str, str], QuarantineRecord] = {}
+        self._archive: list[QuarantineRecord] = []
 
     def quarantine(
         self,
@@ -78,32 +84,31 @@ class QuarantineManager:
         forensic_data: Optional[dict] = None,
     ) -> QuarantineRecord:
         """Isolate an agent; re-quarantining escalates the existing record."""
-        existing = self.get_active_quarantine(agent_did, session_id)
-        if existing is not None:
-            existing.details += f"; escalated: {details}"
+        live = self.get_active_quarantine(agent_did, session_id)
+        if live is not None:
+            live.details += f"; escalated: {details}"
             if forensic_data:
-                existing.forensic_data.update(forensic_data)
-            return existing
+                live.forensic_data.update(forensic_data)
+            return live
 
-        duration = duration_seconds or self.DEFAULT_QUARANTINE_SECONDS
         now = self._clock()
+        window = duration_seconds or self.DEFAULT_QUARANTINE_SECONDS
         record = QuarantineRecord(
             agent_did=agent_did,
             session_id=session_id,
             reason=reason,
             details=details,
             entered_at=now,
-            expires_at=now + timedelta(seconds=duration) if duration else None,
-            forensic_data=forensic_data or {},
+            expires_at=now + timedelta(seconds=window) if window else None,
+            forensic_data=dict(forensic_data or {}),
         )
-        self._records[record.quarantine_id] = record
+        self._live[(agent_did, session_id)] = record
         return record
 
     def release(self, agent_did: str, session_id: str) -> Optional[QuarantineRecord]:
         record = self.get_active_quarantine(agent_did, session_id)
         if record is not None:
-            record.is_active = False
-            record.released_at = self._clock()
+            self._retire(record, self._clock())
         return record
 
     def is_quarantined(self, agent_did: str, session_id: str) -> bool:
@@ -112,45 +117,47 @@ class QuarantineManager:
     def get_active_quarantine(
         self, agent_did: str, session_id: str
     ) -> Optional[QuarantineRecord]:
+        """O(1) live lookup; an expired record is lazily retired."""
+        record = self._live.get((agent_did, session_id))
+        if record is None:
+            return None
         now = self._clock()
-        for r in self._records.values():
-            if (
-                r.agent_did == agent_did
-                and r.session_id == session_id
-                and r.is_active
-                and not r.expired_at(now)
-            ):
-                return r
-        return None
+        if record.expired_at(now):
+            self._retire(record, now)
+            return None
+        return record
 
     def tick(self) -> list[QuarantineRecord]:
         """Release every expired quarantine; returns the newly released."""
         now = self._clock()
-        released = []
-        for r in self._records.values():
-            if r.is_active and r.expired_at(now):
-                r.is_active = False
-                r.released_at = now
-                released.append(r)
-        return released
+        expired = [r for r in self._live.values() if r.expired_at(now)]
+        for record in expired:
+            self._retire(record, now)
+        return expired
 
     def get_history(
         self, agent_did: Optional[str] = None, session_id: Optional[str] = None
     ) -> list[QuarantineRecord]:
-        records = list(self._records.values())
-        if agent_did:
-            records = [r for r in records if r.agent_did == agent_did]
-        if session_id:
-            records = [r for r in records if r.session_id == session_id]
-        return records
+        match = [
+            r
+            for r in (*self._archive, *self._live.values())
+            if (agent_did is None or r.agent_did == agent_did)
+            and (session_id is None or r.session_id == session_id)
+        ]
+        match.sort(key=lambda r: r.entered_at)
+        return match
 
     @property
     def active_quarantines(self) -> list[QuarantineRecord]:
         now = self._clock()
-        return [
-            r for r in self._records.values() if r.is_active and not r.expired_at(now)
-        ]
+        return [r for r in self._live.values() if not r.expired_at(now)]
 
     @property
     def quarantine_count(self) -> int:
         return len(self.active_quarantines)
+
+    def _retire(self, record: QuarantineRecord, now: datetime) -> None:
+        record.is_active = False
+        record.released_at = now
+        self._live.pop((record.agent_did, record.session_id), None)
+        self._archive.append(record)

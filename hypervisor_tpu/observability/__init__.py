@@ -1,17 +1,22 @@
-"""Observability: structured event bus + causal trace ids."""
+"""Observability: columnar event store, pub/sub taps, causal trace spans.
 
+Host-side views of the device EventLog ring buffer (`tables/logs.py`);
+`fnv1a32` is the shared string->u32 fold both planes use for trace ids.
+"""
+
+from hypervisor_tpu.observability.causal_trace import CausalTraceId, fnv1a32
 from hypervisor_tpu.observability.event_bus import (
     EventHandler,
     EventType,
     HypervisorEvent,
     HypervisorEventBus,
 )
-from hypervisor_tpu.observability.causal_trace import CausalTraceId
 
 __all__ = [
+    "CausalTraceId",
     "EventHandler",
     "EventType",
     "HypervisorEvent",
     "HypervisorEventBus",
-    "CausalTraceId",
+    "fnv1a32",
 ]

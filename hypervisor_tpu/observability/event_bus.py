@@ -275,8 +275,10 @@ class HypervisorEventBus:
         }
 
     def clear(self) -> None:
-        fresh = HypervisorEventBus()
-        self.__dict__.update(fresh.__dict__)
+        """Empty the store and indices; subscriptions stay wired."""
+        taps = self._taps
+        self.__dict__.update(HypervisorEventBus().__dict__)
+        self._taps = taps
 
     # ── device bridge ────────────────────────────────────────────────────
 

@@ -1,9 +1,13 @@
 """Reversibility registry: action -> (Execute_API, Undo_API, omega).
 
-Capability parity with reference `reversibility/registry.py:31-107`:
-session-scoped entries populated from IATP manifests, undo lookup for saga
-rollback, non-reversible detection (drives STRONG-mode forcing in the
-facade), and undo-API health marking.
+Capability parity with reference `reversibility/registry.py:31-107`
+(session-scoped entries populated from IATP manifests, undo lookup for
+saga rollback, non-reversible detection driving STRONG-mode forcing in
+the facade, undo-API health marking) — stored columnar: action ids are
+interned to dense rows and every per-action attribute lives in a
+parallel column, so the facade's hot checks (`has_non_reversible_actions`
+at join time) and the device plane's omega/ring gathers read vectors,
+not object graphs.
 """
 
 from __future__ import annotations
@@ -11,9 +15,16 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+import numpy as np
+
 from hypervisor_tpu.models import ActionDescriptor, ReversibilityLevel
+from hypervisor_tpu.tables.intern import InternTable
 
 __all__ = ["ReversibilityEntry", "ReversibilityRegistry"]
+
+_LEVELS = (ReversibilityLevel.FULL, ReversibilityLevel.PARTIAL, ReversibilityLevel.NONE)
+_LEVEL_CODE = {lvl: i for i, lvl in enumerate(_LEVELS)}
+_NONE_CODE = _LEVEL_CODE[ReversibilityLevel.NONE]
 
 
 @dataclass
@@ -30,69 +41,109 @@ class ReversibilityEntry:
 
 
 class ReversibilityRegistry:
-    """Session-scoped action reversibility map."""
+    """Session-scoped reversibility table (interned rows, parallel columns)."""
+
+    _GROW = 16
 
     def __init__(self, session_id: str) -> None:
         self.session_id = session_id
-        self._entries: dict[str, ReversibilityEntry] = {}
-        self._non_reversible = 0  # running count: O(1) has_non_reversible
+        self._ids = InternTable()
+        self._filled = 0
+        self._non_reversible = 0  # running count: O(1) hot-path check
+        self._rev = np.zeros(0, np.int8)
+        self._omega = np.zeros(0, np.float32)
+        self._window = np.zeros(0, np.int32)
+        self._healthy = np.zeros(0, np.bool_)
+        self._execute: list[str] = []
+        self._undo: list[Optional[str]] = []
+        self._comp: list[Optional[str]] = []
+
+    # ── registration ────────────────────────────────────────────────────
 
     def register(self, action: ActionDescriptor) -> ReversibilityEntry:
-        prior = self._entries.get(action.action_id)
-        if prior is not None and prior.reversibility is ReversibilityLevel.NONE:
-            self._non_reversible -= 1
-        entry = ReversibilityEntry(
-            action_id=action.action_id,
-            execute_api=action.execute_api,
-            undo_api=action.undo_api,
-            reversibility=action.reversibility,
-            undo_window_seconds=action.undo_window_seconds,
-            compensation_method=action.compensation_method,
-            risk_weight=action.risk_weight,
-        )
-        self._entries[action.action_id] = entry
-        if entry.reversibility is ReversibilityLevel.NONE:
+        row = self._ids.intern(action.action_id)
+        if row >= len(self._rev):
+            extra = max(self._GROW, row + 1 - len(self._rev))
+            self._rev = np.concatenate([self._rev, np.zeros(extra, np.int8)])
+            self._omega = np.concatenate([self._omega, np.zeros(extra, np.float32)])
+            self._window = np.concatenate([self._window, np.zeros(extra, np.int32)])
+            self._healthy = np.concatenate(
+                [self._healthy, np.zeros(extra, np.bool_)]
+            )
+        while len(self._execute) <= row:
+            self._execute.append("")
+            self._undo.append(None)
+            self._comp.append(None)
+        if row < self._filled and int(self._rev[row]) == _NONE_CODE:
+            self._non_reversible -= 1  # re-registering an existing action
+        self._rev[row] = _LEVEL_CODE[action.reversibility]
+        if _LEVEL_CODE[action.reversibility] == _NONE_CODE:
             self._non_reversible += 1
-        return entry
+        self._omega[row] = action.risk_weight
+        self._window[row] = action.undo_window_seconds
+        self._healthy[row] = True
+        self._execute[row] = action.execute_api
+        self._undo[row] = action.undo_api
+        self._comp[row] = action.compensation_method
+        self._filled = max(self._filled, row + 1)
+        return self._view(row)
 
     def register_from_manifest(self, actions: list[ActionDescriptor]) -> int:
         for action in actions:
             self.register(action)
         return len(actions)
 
+    # ── lookups ─────────────────────────────────────────────────────────
+
     def get(self, action_id: str) -> Optional[ReversibilityEntry]:
-        return self._entries.get(action_id)
+        row = self._ids.lookup(action_id)
+        return self._view(row) if row >= 0 else None
 
     def get_undo_api(self, action_id: str) -> Optional[str]:
-        entry = self._entries.get(action_id)
-        return entry.undo_api if entry else None
+        row = self._ids.lookup(action_id)
+        return self._undo[row] if row >= 0 else None
 
     def is_reversible(self, action_id: str) -> bool:
-        entry = self._entries.get(action_id)
-        return entry is not None and entry.reversibility is not ReversibilityLevel.NONE
+        row = self._ids.lookup(action_id)
+        return row >= 0 and int(self._rev[row]) != _NONE_CODE
 
     def get_risk_weight(self, action_id: str) -> float:
-        entry = self._entries.get(action_id)
-        if entry is None:
+        row = self._ids.lookup(action_id)
+        if row < 0:
             return ReversibilityLevel.NONE.default_risk_weight
-        return entry.risk_weight
+        return float(self._omega[row])
 
     def has_non_reversible_actions(self) -> bool:
         return self._non_reversible > 0
 
     def mark_undo_unhealthy(self, action_id: str) -> None:
-        entry = self._entries.get(action_id)
-        if entry is not None:
-            entry.undo_api_healthy = False
+        row = self._ids.lookup(action_id)
+        if row >= 0:
+            self._healthy[row] = False
+
+    # ── bulk views ──────────────────────────────────────────────────────
 
     @property
     def entries(self) -> list[ReversibilityEntry]:
-        return list(self._entries.values())
+        return [self._view(row) for row in range(self._filled)]
 
     @property
     def non_reversible_actions(self) -> list[str]:
-        return [
-            e.action_id
-            for e in self._entries.values()
-            if e.reversibility is ReversibilityLevel.NONE
-        ]
+        rows = np.nonzero(self._rev[: self._filled] == _NONE_CODE)[0]
+        return [self._ids.string(int(row)) for row in rows]
+
+    def omega_column(self) -> np.ndarray:
+        """f32[N] risk weights in row order — the device gather source."""
+        return self._omega[: self._filled].copy()
+
+    def _view(self, row: int) -> ReversibilityEntry:
+        return ReversibilityEntry(
+            action_id=self._ids.string(row),
+            execute_api=self._execute[row],
+            undo_api=self._undo[row],
+            reversibility=_LEVELS[int(self._rev[row])],
+            undo_window_seconds=int(self._window[row]),
+            compensation_method=self._comp[row],
+            risk_weight=float(self._omega[row]),
+            undo_api_healthy=bool(self._healthy[row]),
+        )

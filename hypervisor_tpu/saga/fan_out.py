@@ -1,20 +1,25 @@
 """Parallel saga fan-out with ALL / MAJORITY / ANY failure policies.
 
-Capability parity with reference `saga/fan_out.py:73-192`: branches execute
-concurrently (asyncio.gather), the policy is evaluated over the success
-counts, and on policy failure every succeeded branch is routed to
-compensation. The policy evaluation itself is a pure reduction exported for
-the device plane (`evaluate_policy`), where a [groups, branches] success
-mask resolves all groups in one masked-sum op.
+Capability parity with reference `saga/fan_out.py:73-192` (branches
+execute concurrently, the policy is evaluated over success counts, and
+on policy failure every succeeded branch is routed to compensation) —
+structured as a gather-then-settle pipeline: branch coroutines return
+pure outcome tuples, and a single settle pass applies outcomes to the
+group, evaluates the policy, and derives the compensation set. The
+policy reduction is shared with the device plane both as the scalar
+`evaluate_policy` and as `resolve_policy_mask`, which settles a whole
+[groups, branches] success matrix in one masked reduction.
 """
 
 from __future__ import annotations
 
 import asyncio
 import enum
-import uuid
+import secrets
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
+
+import numpy as np
 
 from hypervisor_tpu.saga.state_machine import SagaStep, StepState
 
@@ -26,13 +31,18 @@ class FanOutPolicy(str, enum.Enum):
 
     @property
     def code(self) -> int:
-        return {"all_must_succeed": 0, "majority_must_succeed": 1, "any_must_succeed": 2}[
-            self.value
-        ]
+        return _POLICY_CODES[self]
+
+
+_POLICY_CODES: dict[FanOutPolicy, int] = {
+    FanOutPolicy.ALL_MUST_SUCCEED: 0,
+    FanOutPolicy.MAJORITY_MUST_SUCCEED: 1,
+    FanOutPolicy.ANY_MUST_SUCCEED: 2,
+}
 
 
 def evaluate_policy(policy: FanOutPolicy, successes: int, total: int) -> bool:
-    """Pure policy reduction shared by host and device paths."""
+    """Scalar policy reduction shared by host and device paths."""
     if policy is FanOutPolicy.ALL_MUST_SUCCEED:
         return successes == total
     if policy is FanOutPolicy.MAJORITY_MUST_SUCCEED:
@@ -40,9 +50,26 @@ def evaluate_policy(policy: FanOutPolicy, successes: int, total: int) -> bool:
     return successes >= 1
 
 
+def resolve_policy_mask(
+    policy_codes: np.ndarray, success: np.ndarray, branch_mask: np.ndarray
+) -> np.ndarray:
+    """Settle every fan-out group at once from a [G, B] success matrix.
+
+    policy_codes i8[G], success bool[G, B], branch_mask bool[G, B] (padding
+    rows off). Returns bool[G] policy_satisfied — the same reduction
+    `evaluate_policy` performs per group, vectorized for the saga table.
+    """
+    wins = (success & branch_mask).sum(axis=1)
+    total = branch_mask.sum(axis=1)
+    verdicts = np.stack(
+        [wins == total, wins * 2 > total, wins >= 1], axis=0
+    )
+    return verdicts[np.clip(policy_codes, 0, 2), np.arange(len(policy_codes))]
+
+
 @dataclass
 class FanOutBranch:
-    branch_id: str = field(default_factory=lambda: f"branch:{uuid.uuid4().hex[:8]}")
+    branch_id: str = field(default_factory=lambda: f"branch:{secrets.token_hex(4)}")
     step: Optional[SagaStep] = None
     result: Any = None
     error: Optional[str] = None
@@ -51,7 +78,7 @@ class FanOutBranch:
 
 @dataclass
 class FanOutGroup:
-    group_id: str = field(default_factory=lambda: f"fanout:{uuid.uuid4().hex[:8]}")
+    group_id: str = field(default_factory=lambda: f"fanout:{secrets.token_hex(4)}")
     saga_id: str = ""
     policy: FanOutPolicy = FanOutPolicy.ALL_MUST_SUCCEED
     branches: list[FanOutBranch] = field(default_factory=list)
@@ -75,8 +102,13 @@ class FanOutGroup:
         return evaluate_policy(self.policy, self.success_count, self.total_branches)
 
 
+# One branch's execution outcome: (ok, value) where value is the result on
+# success or the error string on failure.
+_Outcome = tuple[bool, Any]
+
+
 class FanOutOrchestrator:
-    """Runs fan-out groups and routes failed policies to compensation."""
+    """Gather-then-settle fan-out runner."""
 
     def __init__(self) -> None:
         self._groups: dict[str, FanOutGroup] = {}
@@ -100,37 +132,58 @@ class FanOutOrchestrator:
         executors: dict[str, Callable[..., Any]],
         timeout_seconds: int = 300,
     ) -> FanOutGroup:
-        """Execute all branches concurrently, then settle the policy."""
+        """Run every branch concurrently, then settle the group once."""
         group = self._require_group(group_id)
-
-        async def run(branch: FanOutBranch) -> None:
-            if branch.step is None:
-                branch.error = "No step assigned"
-                return
-            executor = executors.get(branch.step.step_id)
-            if executor is None:
-                branch.error = f"No executor for step {branch.step.step_id}"
-                return
-            try:
-                branch.step.transition(StepState.EXECUTING)
-                result = await asyncio.wait_for(
-                    executor(), timeout=branch.step.timeout_seconds
-                )
-                branch.result = result
-                branch.succeeded = True
-                branch.step.execute_result = result
-                branch.step.transition(StepState.COMMITTED)
-            except Exception as e:  # noqa: BLE001 — branch failures are data
-                branch.error = str(e)
-                branch.succeeded = False
-                branch.step.error = str(e)
-                branch.step.transition(StepState.FAILED)
-
-        await asyncio.wait_for(
-            asyncio.gather(*(run(b) for b in group.branches), return_exceptions=True),
-            timeout=timeout_seconds,
+        work = (self._run_branch(b, executors) for b in group.branches)
+        outcomes = await asyncio.wait_for(
+            asyncio.gather(*work, return_exceptions=True), timeout=timeout_seconds
         )
+        self._settle(
+            group,
+            [
+                o if isinstance(o, tuple) else (False, str(o))
+                for o in outcomes
+            ],
+        )
+        return group
 
+    @staticmethod
+    async def _run_branch(
+        branch: FanOutBranch, executors: dict[str, Callable[..., Any]]
+    ) -> _Outcome:
+        """Execute one branch; never raises — outcomes are data."""
+        step = branch.step
+        if step is None:
+            return False, "No step assigned"
+        executor = executors.get(step.step_id)
+        if executor is None:
+            return False, f"No executor for step {step.step_id}"
+        try:
+            step.transition(StepState.EXECUTING)
+            result = await asyncio.wait_for(executor(), timeout=step.timeout_seconds)
+        except Exception as exc:  # noqa: BLE001 — branch failures are data
+            return False, str(exc)
+        return True, result
+
+    @staticmethod
+    def _apply_outcome(branch: FanOutBranch, outcome: _Outcome) -> None:
+        ok, value = outcome
+        branch.succeeded = ok
+        step = branch.step
+        if ok:
+            branch.result = value
+            if step is not None:
+                step.execute_result = value
+                step.transition(StepState.COMMITTED)
+        else:
+            branch.error = str(value)
+            if step is not None and step.state is StepState.EXECUTING:
+                step.error = str(value)
+                step.transition(StepState.FAILED)
+
+    def _settle(self, group: FanOutGroup, outcomes: list[_Outcome]) -> None:
+        for branch, outcome in zip(group.branches, outcomes):
+            self._apply_outcome(branch, outcome)
         group.policy_satisfied = group.check_policy()
         group.resolved = True
         if not group.policy_satisfied:
@@ -138,7 +191,6 @@ class FanOutOrchestrator:
             group.compensation_needed = [
                 b.step.step_id for b in group.branches if b.succeeded and b.step
             ]
-        return group
 
     def get_group(self, group_id: str) -> Optional[FanOutGroup]:
         return self._groups.get(group_id)

@@ -1,14 +1,18 @@
 """Kill switch: graceful agent termination with saga-step handoff.
 
-Capability parity with reference `security/kill_switch.py:64-180`: per-session
-substitute pools, each in-flight step handed to a substitute or marked
-COMPENSATED, killed agents removed from the pool, kill history retained.
+Capability parity with reference `security/kill_switch.py:64-180`
+(per-session substitute pools, each in-flight step handed to a
+substitute or marked COMPENSATED, killed agents removed from the pool,
+kill history retained) — with the pool kept as a rotating deque so
+consecutive handoffs round-robin across the available substitutes
+instead of piling onto the first one.
 """
 
 from __future__ import annotations
 
 import enum
-import uuid
+import secrets
+from collections import deque
 from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Optional
@@ -43,7 +47,7 @@ class StepHandoff:
 
 @dataclass
 class KillResult:
-    kill_id: str = field(default_factory=lambda: f"kill:{uuid.uuid4().hex[:8]}")
+    kill_id: str = field(default_factory=lambda: f"kill:{secrets.token_hex(4)}")
     agent_did: str = ""
     session_id: str = ""
     reason: KillReason = KillReason.MANUAL
@@ -59,16 +63,32 @@ class KillSwitch:
 
     def __init__(self, clock: Clock = utc_now) -> None:
         self._clock = clock
-        self._history: list[KillResult] = []
-        self._substitutes: dict[str, list[str]] = {}
+        self._log: list[KillResult] = []
+        self._pools: dict[str, deque[str]] = {}
+
+    # ── substitute pools ────────────────────────────────────────────────
 
     def register_substitute(self, session_id: str, agent_did: str) -> None:
-        self._substitutes.setdefault(session_id, []).append(agent_did)
+        self._pools.setdefault(session_id, deque()).append(agent_did)
 
     def unregister_substitute(self, session_id: str, agent_did: str) -> None:
-        pool = self._substitutes.get(session_id, [])
-        if agent_did in pool:
+        pool = self._pools.get(session_id)
+        if pool and agent_did in pool:
             pool.remove(agent_did)
+
+    def substitutes(self, session_id: str) -> list[str]:
+        """Current substitute pool for a session (registration order)."""
+        return list(self._pools.get(session_id, ()))
+
+    def _next_substitute(self, session_id: str) -> Optional[str]:
+        """Rotate the session pool; returns None when it is empty."""
+        pool = self._pools.get(session_id)
+        if not pool:
+            return None
+        pool.rotate(-1)
+        return pool[-1]
+
+    # ── the switch ──────────────────────────────────────────────────────
 
     def kill(
         self,
@@ -78,54 +98,57 @@ class KillSwitch:
         in_flight_steps: Optional[list[dict]] = None,
         details: str = "",
     ) -> KillResult:
-        """Kill with handoff: substitute per step, else route to compensation."""
-        handoffs: list[StepHandoff] = []
-        handed = 0
-        for info in in_flight_steps or ():
-            handoff = StepHandoff(
-                step_id=info.get("step_id", ""),
-                saga_id=info.get("saga_id", ""),
-                from_agent=agent_did,
-            )
-            substitute = self._find_substitute(session_id, agent_did)
-            if substitute is not None:
-                handoff.to_agent = substitute
-                handoff.status = HandoffStatus.HANDED_OFF
-                handed += 1
-            else:
-                handoff.status = HandoffStatus.COMPENSATED
-            handoffs.append(handoff)
+        """Kill with handoff: substitute per step, else route to compensation.
 
+        The victim leaves the substitute pool before rehoming starts, so
+        it can never be chosen as its own substitute.
+        """
+        self.unregister_substitute(session_id, agent_did)
+        handoffs = [
+            self._rehome(info, agent_did, session_id)
+            for info in in_flight_steps or ()
+        ]
         result = KillResult(
             agent_did=agent_did,
             session_id=session_id,
             reason=reason,
             timestamp=self._clock(),
             handoffs=handoffs,
-            handoff_success_count=handed,
+            handoff_success_count=sum(
+                h.status is HandoffStatus.HANDED_OFF for h in handoffs
+            ),
             compensation_triggered=any(
                 h.status is HandoffStatus.COMPENSATED for h in handoffs
             ),
             details=details,
         )
-        self._history.append(result)
-        self.unregister_substitute(session_id, agent_did)
+        self._log.append(result)
         return result
 
-    def _find_substitute(self, session_id: str, exclude_did: str) -> Optional[str]:
-        for agent in self._substitutes.get(session_id, ()):
-            if agent != exclude_did:
-                return agent
-        return None
+    def _rehome(self, info: dict, victim: str, session_id: str) -> StepHandoff:
+        handoff = StepHandoff(
+            step_id=info.get("step_id", ""),
+            saga_id=info.get("saga_id", ""),
+            from_agent=victim,
+        )
+        substitute = self._next_substitute(session_id)
+        if substitute is None:
+            handoff.status = HandoffStatus.COMPENSATED
+        else:
+            handoff.to_agent = substitute
+            handoff.status = HandoffStatus.HANDED_OFF
+        return handoff
+
+    # ── history ─────────────────────────────────────────────────────────
 
     @property
     def kill_history(self) -> list[KillResult]:
-        return list(self._history)
+        return list(self._log)
 
     @property
     def total_kills(self) -> int:
-        return len(self._history)
+        return len(self._log)
 
     @property
     def total_handoffs(self) -> int:
-        return sum(r.handoff_success_count for r in self._history)
+        return sum(r.handoff_success_count for r in self._log)

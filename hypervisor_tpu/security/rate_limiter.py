@@ -1,24 +1,31 @@
-"""Per-agent per-ring token-bucket rate limiting.
+"""Per-agent per-ring token-bucket rate limiting, array-native.
 
-Capability parity with reference `security/rate_limiter.py:72-176`: per-ring
-defaults (Ring0 100rps/200 burst ... Ring3 5/10), raising `check` plus
-boolean `try_check`, bucket recreated full on ring change, per-agent stats.
+Capability parity with reference `security/rate_limiter.py:72-176`:
+per-ring defaults (Ring0 100rps/200 burst ... Ring3 5/10), raising
+`check` plus boolean `try_check`, bucket recreated full on ring change,
+per-agent stats.
 
-Array-native re-design: all buckets for a session wave live as two f32
-columns (tokens, last-refill) in the agent table; refill+consume is the
-branch-free update in `ops.rate_limit.consume` and this host class keeps
-per-(agent, session) scalar state with identical arithmetic for the
-single-call API.
+Unlike the reference (one TokenBucket object per key), ALL buckets here
+live in parallel numpy columns — tokens, refill stamp, ring, request and
+rejection counters — indexed by interning the (agent, session) pair.
+Refill-then-consume is the same branch-free arithmetic as the device op
+(`ops.rate_limit.consume`), applied to one row for the scalar API or to
+a whole row batch via `check_many`, so host and device decisions agree
+bit-for-bit. The scalar `TokenBucket` remains as the standalone twin for
+callers that want an unkeyed bucket.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 from datetime import datetime
-from typing import Optional
+from typing import Optional, Sequence
+
+import numpy as np
 
 from hypervisor_tpu.config import DEFAULT_CONFIG
 from hypervisor_tpu.models import ExecutionRing
+from hypervisor_tpu.tables.intern import InternTable
 from hypervisor_tpu.utils.clock import Clock, utc_now
 
 
@@ -36,7 +43,7 @@ _FALLBACK_LIMIT = (20.0, 40.0)
 
 @dataclass
 class TokenBucket:
-    """Scalar token bucket (device twin: tokens/stamp columns + `ops.rate_limit`)."""
+    """Scalar token bucket (standalone twin of one limiter row)."""
 
     capacity: float
     tokens: float
@@ -74,17 +81,37 @@ class RateLimitStats:
 
 
 class AgentRateLimiter:
-    """Token buckets keyed by (agent, session), parameterized by ring."""
+    """All (agent, session) buckets as parallel columns over interned rows."""
+
+    _GROW = 64
 
     def __init__(
         self,
         ring_limits: Optional[dict[ExecutionRing, tuple[float, float]]] = None,
         clock: Clock = utc_now,
     ) -> None:
-        self._limits = ring_limits or dict(DEFAULT_RING_LIMITS)
+        limits = ring_limits or DEFAULT_RING_LIMITS
+        # Ring-indexed parameter vectors (the device op's rates/bursts).
+        self._rates = np.array(
+            [limits.get(ExecutionRing(r), _FALLBACK_LIMIT)[0] for r in range(4)],
+            np.float64,
+        )
+        self._bursts = np.array(
+            [limits.get(ExecutionRing(r), _FALLBACK_LIMIT)[1] for r in range(4)],
+            np.float64,
+        )
         self._clock = clock
-        self._buckets: dict[tuple[str, str], TokenBucket] = {}
-        self._stats: dict[tuple[str, str], RateLimitStats] = {}
+        self._epoch = clock()
+        self._keys = InternTable()
+        self._agent_of: list[str] = []
+        n = 0
+        self._tokens = np.zeros(n, np.float64)
+        self._stamp = np.zeros(n, np.float64)
+        self._ring = np.zeros(n, np.int8)
+        self._total = np.zeros(n, np.int64)
+        self._rejected = np.zeros(n, np.int64)
+
+    # ── scalar API ──────────────────────────────────────────────────────
 
     def check(
         self,
@@ -94,17 +121,13 @@ class AgentRateLimiter:
         cost: float = 1.0,
     ) -> bool:
         """Consume or raise RateLimitExceeded."""
-        key = (agent_did, session_id)
-        bucket = self._bucket(key, ring)
-        stats = self._stats.setdefault(
-            key, RateLimitStats(agent_did=agent_did, ring=ring)
-        )
-        stats.total_requests += 1
-        if not bucket.consume(cost):
-            stats.rejected_requests += 1
+        row = self._row(agent_did, session_id, ring)
+        allowed = self._decide(np.array([row]), cost)[0]
+        if not allowed:
             raise RateLimitExceeded(
                 f"Agent {agent_did} exceeded rate limit for ring "
-                f"{ring.value} ({stats.rejected_requests} rejections)"
+                f"{int(self._ring[row])} "
+                f"({int(self._rejected[row])} rejections)"
             )
         return True
 
@@ -116,51 +139,104 @@ class AgentRateLimiter:
         cost: float = 1.0,
     ) -> bool:
         """Non-raising variant."""
-        try:
-            return self.check(agent_did, session_id, ring, cost)
-        except RateLimitExceeded:
-            return False
+        row = self._row(agent_did, session_id, ring)
+        return bool(self._decide(np.array([row]), cost)[0])
+
+    # ── batch API (admission/step waves) ────────────────────────────────
+
+    def check_many(
+        self,
+        agent_dids: Sequence[str],
+        session_ids: Sequence[str],
+        rings: Sequence[ExecutionRing],
+        cost: float = 1.0,
+    ) -> np.ndarray:
+        """Decide a whole wave at once; returns bool[N] (no exceptions)."""
+        rows = np.array(
+            [
+                self._row(a, s, r)
+                for a, s, r in zip(agent_dids, session_ids, rings)
+            ],
+            np.int64,
+        )
+        if len(np.unique(rows)) == len(rows):
+            return self._decide(rows, cost)
+        # Duplicate keys in one wave must settle sequentially so each
+        # request sees the balance its predecessors left behind.
+        return np.array(
+            [self._decide(rows[i : i + 1], cost)[0] for i in range(len(rows))]
+        )
+
+    # ── ring changes & stats ────────────────────────────────────────────
 
     def update_ring(
         self, agent_did: str, session_id: str, new_ring: ExecutionRing
     ) -> None:
         """Ring change: bucket recreated at full burst for the new ring."""
-        key = (agent_did, session_id)
-        rate, capacity = self._limits.get(new_ring, _FALLBACK_LIMIT)
-        self._buckets[key] = TokenBucket(
-            capacity=capacity,
-            tokens=capacity,
-            refill_rate=rate,
-            last_refill=self._clock(),
-            _clock=self._clock,
-        )
-        if key in self._stats:
-            self._stats[key].ring = new_ring
+        row = self._row(agent_did, session_id, new_ring)
+        self._ring[row] = new_ring.value
+        self._tokens[row] = self._bursts[new_ring.value]
+        self._stamp[row] = self._now()
 
     def get_stats(self, agent_did: str, session_id: str) -> Optional[RateLimitStats]:
-        key = (agent_did, session_id)
-        stats = self._stats.get(key)
-        if stats is not None:
-            bucket = self._buckets.get(key)
-            if bucket is not None:
-                stats.tokens_available = bucket.available
-                stats.capacity = bucket.capacity
-        return stats
-
-    def _bucket(self, key: tuple[str, str], ring: ExecutionRing) -> TokenBucket:
-        bucket = self._buckets.get(key)
-        if bucket is None:
-            rate, capacity = self._limits.get(ring, _FALLBACK_LIMIT)
-            bucket = TokenBucket(
-                capacity=capacity,
-                tokens=capacity,
-                refill_rate=rate,
-                last_refill=self._clock(),
-                _clock=self._clock,
-            )
-            self._buckets[key] = bucket
-        return bucket
+        handle = self._keys.lookup(f"{agent_did}\x00{session_id}")
+        if handle < 0:
+            return None
+        self._refill(np.array([handle]))
+        ring = ExecutionRing(int(self._ring[handle]))
+        return RateLimitStats(
+            agent_did=agent_did,
+            ring=ring,
+            total_requests=int(self._total[handle]),
+            rejected_requests=int(self._rejected[handle]),
+            tokens_available=float(self._tokens[handle]),
+            capacity=float(self._bursts[ring.value]),
+        )
 
     @property
     def tracked_agents(self) -> int:
-        return len(self._buckets)
+        return len(self._keys)
+
+    # ── column mechanics ────────────────────────────────────────────────
+
+    def _now(self) -> float:
+        return (self._clock() - self._epoch).total_seconds()
+
+    def _row(self, agent_did: str, session_id: str, ring: ExecutionRing) -> int:
+        row = self._keys.intern(f"{agent_did}\x00{session_id}")
+        if row >= len(self._tokens):
+            extra = max(self._GROW, row + 1 - len(self._tokens))
+            self._tokens = np.concatenate([self._tokens, np.zeros(extra)])
+            self._stamp = np.concatenate([self._stamp, np.zeros(extra)])
+            self._ring = np.concatenate([self._ring, np.zeros(extra, np.int8)])
+            self._total = np.concatenate([self._total, np.zeros(extra, np.int64)])
+            self._rejected = np.concatenate(
+                [self._rejected, np.zeros(extra, np.int64)]
+            )
+        if len(self._agent_of) <= row:
+            # New row: a fresh bucket starts at full burst for its ring.
+            self._agent_of.append(agent_did)
+            self._ring[row] = ring.value
+            self._tokens[row] = self._bursts[ring.value]
+            self._stamp[row] = self._now()
+        return row
+
+    def _refill(self, rows: np.ndarray) -> None:
+        now = self._now()
+        ring = np.clip(self._ring[rows].astype(np.int64), 0, 3)
+        elapsed = np.maximum(now - self._stamp[rows], 0.0)
+        self._tokens[rows] = np.minimum(
+            self._bursts[ring], self._tokens[rows] + elapsed * self._rates[ring]
+        )
+        self._stamp[rows] = now
+
+    def _decide(self, rows: np.ndarray, cost: float) -> np.ndarray:
+        """Refill-then-consume over a row batch (ops.rate_limit.consume twin)."""
+        self._refill(rows)
+        allowed = self._tokens[rows] >= cost
+        self._tokens[rows] = np.where(
+            allowed, self._tokens[rows] - cost, self._tokens[rows]
+        )
+        np.add.at(self._total, rows, 1)
+        np.add.at(self._rejected, rows, (~allowed).astype(np.int64))
+        return allowed

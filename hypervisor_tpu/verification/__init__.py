@@ -1,14 +1,17 @@
 """DID transaction-history verification for the admission handshake.
 
-Capability parity with reference `verification/history.py:53-161`: no/short
-history -> PROBATIONARY (depth threshold 5), declared-history consistency
-checks (duplicate summary hashes, non-monotonic timestamps, hashes shorter
-than 16 chars -> SUSPICIOUS), per-DID result caching, and
+Capability parity with reference `verification/history.py:53-161`:
+no/short history -> PROBATIONARY (depth threshold 5), declared-history
+consistency checks (duplicate summary hashes, non-monotonic timestamps,
+hashes shorter than 16 chars -> SUSPICIOUS), per-DID result caching, and
 `is_trustworthy` = VERIFIED or PROBATIONARY (untrustworthy agents get
 forced to Ring 3 at join in the facade).
 
-The consistency pass is vectorized over the declared history columns so a
-batch of admission handshakes can be verified in one sweep.
+Structured as a rule pipeline: each consistency rule is a standalone
+generator over the history columns, and the assessor folds whatever the
+rules yield into the verdict — adding a rule never touches the verdict
+logic. The temporal rule is one vector compare over the timestamp
+column, so a batch of admission handshakes verifies in one sweep.
 """
 
 from __future__ import annotations
@@ -16,7 +19,7 @@ from __future__ import annotations
 import enum
 from dataclasses import dataclass, field
 from datetime import datetime
-from typing import Optional
+from typing import Iterator, Optional
 
 import numpy as np
 
@@ -65,6 +68,42 @@ class VerificationResult:
         )
 
 
+# ── consistency rules (each yields issue strings) ───────────────────────
+
+
+def _rule_unique_hashes(
+    history: list[TransactionRecord], min_hash_length: int
+) -> Iterator[str]:
+    owners: dict[str, str] = {}
+    for tx in history:
+        prior = owners.get(tx.summary_hash)
+        if prior is not None:
+            yield f"Duplicate hash in sessions {prior} and {tx.session_id}"
+        owners[tx.summary_hash] = tx.session_id
+
+
+def _rule_monotonic_time(
+    history: list[TransactionRecord], min_hash_length: int
+) -> Iterator[str]:
+    stamps = np.array([tx.timestamp.timestamp() for tx in history])
+    for i in np.nonzero(stamps[1:] < stamps[:-1])[0]:
+        yield (
+            f"Non-monotonic timestamps: {history[i + 1].session_id} "
+            f"predates {history[i].session_id}"
+        )
+
+
+def _rule_wellformed_hashes(
+    history: list[TransactionRecord], min_hash_length: int
+) -> Iterator[str]:
+    for tx in history:
+        if len(tx.summary_hash or "") < min_hash_length:
+            yield f"Invalid hash in session {tx.session_id}"
+
+
+_RULES = (_rule_unique_hashes, _rule_monotonic_time, _rule_wellformed_hashes)
+
+
 class TransactionHistoryVerifier:
     """Handshake-time history checker with per-DID caching."""
 
@@ -72,7 +111,7 @@ class TransactionHistoryVerifier:
     MIN_HASH_LENGTH = DEFAULT_CONFIG.verifier.min_hash_length
 
     def __init__(self) -> None:
-        self._cache: dict[str, VerificationResult] = {}
+        self._verdicts: dict[str, VerificationResult] = {}
 
     def verify(
         self,
@@ -80,78 +119,50 @@ class TransactionHistoryVerifier:
         declared_history: Optional[list[TransactionRecord]] = None,
     ) -> VerificationResult:
         """Verify a DID's declared history (cached per DID)."""
-        cached = self._cache.get(agent_did)
-        if cached is not None:
-            cached.cached = True
-            return cached
+        prior = self._verdicts.get(agent_did)
+        if prior is not None:
+            prior.cached = True
+            return prior
 
-        n = len(declared_history) if declared_history else 0
-        if n == 0:
-            result = VerificationResult(
-                agent_did=agent_did,
-                status=VerificationStatus.PROBATIONARY,
-                transactions_checked=0,
-                transactions_found=0,
-                inconsistencies=["No transaction history available"],
+        status, issues = self._assess(declared_history or [])
+        verdict = VerificationResult(
+            agent_did=agent_did,
+            status=status,
+            transactions_checked=len(declared_history or []),
+            transactions_found=len(declared_history or []),
+            inconsistencies=issues,
+        )
+        self._verdicts[agent_did] = verdict
+        return verdict
+
+    def _assess(
+        self, history: list[TransactionRecord]
+    ) -> tuple[VerificationStatus, list[str]]:
+        if not history:
+            return (
+                VerificationStatus.PROBATIONARY,
+                ["No transaction history available"],
             )
-        elif n < self.REQUIRED_HISTORY_DEPTH:
-            result = VerificationResult(
-                agent_did=agent_did,
-                status=VerificationStatus.PROBATIONARY,
-                transactions_checked=n,
-                transactions_found=n,
-                inconsistencies=[
-                    f"Only {n} transactions (need {self.REQUIRED_HISTORY_DEPTH})"
+        if len(history) < self.REQUIRED_HISTORY_DEPTH:
+            return (
+                VerificationStatus.PROBATIONARY,
+                [
+                    f"Only {len(history)} transactions "
+                    f"(need {self.REQUIRED_HISTORY_DEPTH})"
                 ],
             )
-        else:
-            issues = self._consistency_issues(declared_history)
-            result = VerificationResult(
-                agent_did=agent_did,
-                status=(
-                    VerificationStatus.SUSPICIOUS
-                    if issues
-                    else VerificationStatus.VERIFIED
-                ),
-                transactions_checked=n,
-                transactions_found=n,
-                inconsistencies=issues,
-            )
-
-        self._cache[agent_did] = result
-        return result
+        issues = [
+            issue
+            for rule in _RULES
+            for issue in rule(history, self.MIN_HASH_LENGTH)
+        ]
+        status = (
+            VerificationStatus.SUSPICIOUS if issues else VerificationStatus.VERIFIED
+        )
+        return status, issues
 
     def clear_cache(self, agent_did: Optional[str] = None) -> None:
         if agent_did:
-            self._cache.pop(agent_did, None)
+            self._verdicts.pop(agent_did, None)
         else:
-            self._cache.clear()
-
-    def _consistency_issues(self, history: list[TransactionRecord]) -> list[str]:
-        """Vectorized consistency sweep over the declared history."""
-        issues: list[str] = []
-
-        # Duplicate summary hashes across sessions.
-        seen: dict[str, str] = {}
-        for tx in history:
-            if tx.summary_hash in seen:
-                issues.append(
-                    f"Duplicate hash in sessions {seen[tx.summary_hash]} "
-                    f"and {tx.session_id}"
-                )
-            seen[tx.summary_hash] = tx.session_id
-
-        # Temporal ordering: one vector compare over the timestamp column.
-        ts = np.array([tx.timestamp.timestamp() for tx in history])
-        for i in np.nonzero(ts[1:] < ts[:-1])[0]:
-            issues.append(
-                f"Non-monotonic timestamps: {history[i + 1].session_id} "
-                f"predates {history[i].session_id}"
-            )
-
-        # Malformed hashes.
-        for tx in history:
-            if not tx.summary_hash or len(tx.summary_hash) < self.MIN_HASH_LENGTH:
-                issues.append(f"Invalid hash in session {tx.session_id}")
-
-        return issues
+            self._verdicts.clear()

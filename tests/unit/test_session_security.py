@@ -252,7 +252,7 @@ class TestKillSwitch:
         ks.register_substitute("s", "did:a")
         ks.register_substitute("s", "did:b")
         ks.kill("did:a", "s", KillReason.MANUAL)
-        assert ks._substitutes["s"] == ["did:b"]
+        assert ks.substitutes("s") == ["did:b"]
 
     def test_kill_history(self):
         ks = KillSwitch()

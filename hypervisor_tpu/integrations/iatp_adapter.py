@@ -105,11 +105,19 @@ class IATPAdapter:
                 is_read_only=cap.get("is_read_only", False),
                 is_admin=cap.get("is_admin", False),
             )
-            # Reference manifests use "capabilities" (`iatp_adapter.py:183-193`);
-            # "actions" is accepted as a synonym for hand-rolled dicts.
+            # "actions" is the primary key (`iatp_adapter.py:183`); a
+            # "capabilities" list may also appear but can hold bare strings
+            # (`examples/demo.py:340` in the reference), so only dict
+            # entries there describe actions.
             for cap in (
-                manifest_dict.get("capabilities") or manifest_dict.get("actions") or []
+                manifest_dict.get("actions")
+                or [
+                    c
+                    for c in manifest_dict.get("capabilities") or []
+                    if isinstance(c, dict)
+                ]
             )
+            if isinstance(cap, dict)
         ]
         return self._finish(
             agent_did=manifest_dict.get("agent_id", "unknown"),

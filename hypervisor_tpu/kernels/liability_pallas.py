@@ -55,7 +55,7 @@ def _dot(a, b, dims):
     )
 
 
-def _wave_pass(n, iota_n, vchr, vee, sess_ok, live_f, bond, wave, sigma,
+def _wave_pass(n, iota_n, vchr, vee, sess_ok, live_f, wave, sigma,
                omega, floor):
     """One cascade wave in dense-matmul form. All agent vectors [1, n],
     all edge vectors [1, e]; returns updated (sigma, k, hit, has_vchr)."""
@@ -93,7 +93,7 @@ def _wave_pass(n, iota_n, vchr, vee, sess_ok, live_f, bond, wave, sigma,
     return sigma, was_clipped, hit, hv > 0.0
 
 
-def _cascade_math(vchr, vee, session, bond, active_f, expiry, sigma, seeds,
+def _cascade_math(vchr, vee, session, active_f, expiry, sigma, seeds,
                   omega, sess, now, trust: TrustConfig):
     """Shared wave-loop body (identical under Pallas and plain XLA).
 
@@ -115,7 +115,7 @@ def _cascade_math(vchr, vee, session, bond, active_f, expiry, sigma, seeds,
 
         sess_ok = (session == sess).astype(jnp.float32)
         sigma, was_clipped, hit, has_vchr = _wave_pass(
-            n, iota_n, vchr, vee, sess_ok, live_base, bond,
+            n, iota_n, vchr, vee, sess_ok, live_base,
             wave_b.astype(jnp.float32), sigma, omega, trust.sigma_floor,
         )
         clipped_any = clipped_any | was_clipped
@@ -132,14 +132,14 @@ def _cascade_math(vchr, vee, session, bond, active_f, expiry, sigma, seeds,
     return sigma, hit_any, slashed, clipped_any, wave_of
 
 
-def _kernel(trust, vchr_ref, vee_ref, sess_ref, bond_ref, act_ref, exp_ref,
+def _kernel(trust, vchr_ref, vee_ref, sess_ref, act_ref, exp_ref,
             sigma_ref, seeds_ref, scal_ref,
             sigma_out, live_out, slashed_out, clipped_out, wave_out):
     omega = scal_ref[0, 0]
     sess = scal_ref[0, 1].astype(jnp.int32)
     now = scal_ref[0, 2]
     sigma, consumed, slashed, clipped, wave_of = _cascade_math(
-        vchr_ref[:], vee_ref[:], sess_ref[:], bond_ref[:], act_ref[:],
+        vchr_ref[:], vee_ref[:], sess_ref[:], act_ref[:],
         exp_ref[:], sigma_ref[:], seeds_ref[:], omega, sess, now, trust,
     )
     sigma_out[:] = sigma
@@ -155,7 +155,9 @@ def _prep(vouch: VouchTable, sigma, seeds):
     if n > N_TILE:
         raise ValueError(f"pallas cascade supports N <= {N_TILE}, got {n}")
     e = vouch.voucher.shape[0]
-    ep = -(-e // E_CHUNK) * E_CHUNK
+    # At least one (inert, fully padded) chunk so the wave loop and the
+    # final concatenate are well-formed when the edge table is empty.
+    ep = max(E_CHUNK, -(-e // E_CHUNK) * E_CHUNK)
     pad_e = ep - e
 
     def erow(x, fill):
@@ -168,7 +170,6 @@ def _prep(vouch: VouchTable, sigma, seeds):
         "vchr": erow(vouch.voucher, -1),
         "vee": erow(vouch.vouchee, -1),
         "sess": erow(vouch.session, -2),
-        "bond": erow(vouch.bond, 0.0),
         "act": erow(vouch.active.astype(jnp.float32), 0.0),
         "exp": erow(vouch.expiry, -jnp.inf),
         "sigma": arow(sigma, 0.0),
@@ -182,7 +183,7 @@ def _run_pallas(rows, scalars, trust):
     spec = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
     outs = pl.pallas_call(
         functools.partial(_kernel, trust),
-        in_specs=[spec() for _ in range(8)]
+        in_specs=[spec() for _ in range(7)]
         + [pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=tuple(spec() for _ in range(5)),
         compiler_params=pltpu.CompilerParams(
@@ -196,7 +197,7 @@ def _run_pallas(rows, scalars, trust):
             jax.ShapeDtypeStruct((1, N_TILE), jnp.int32),     # wave_of
         ),
     )(
-        rows["vchr"], rows["vee"], rows["sess"], rows["bond"], rows["act"],
+        rows["vchr"], rows["vee"], rows["sess"], rows["act"],
         rows["exp"], rows["sigma"], rows["seeds"], scalars,
     )
     return outs
@@ -246,7 +247,7 @@ def slash_cascade_dense(
 
     rows, n, e = _prep(vouch, sigma, seeds)
     out_sigma, consumed, slashed, clipped, wave_of = _cascade_math(
-        rows["vchr"], rows["vee"], rows["sess"], rows["bond"], rows["act"],
+        rows["vchr"], rows["vee"], rows["sess"], rows["act"],
         rows["exp"], rows["sigma"], rows["seeds"],
         jnp.float32(risk_weight), jnp.int32(session_slot), jnp.float32(now),
         trust,

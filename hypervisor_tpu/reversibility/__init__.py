@@ -18,7 +18,7 @@ from typing import Optional
 import numpy as np
 
 from hypervisor_tpu.models import ActionDescriptor, ReversibilityLevel
-from hypervisor_tpu.tables.intern import InternTable
+from hypervisor_tpu.tables.intern import ColumnStore
 
 __all__ = ["ReversibilityEntry", "ReversibilityRegistry"]
 
@@ -43,17 +43,16 @@ class ReversibilityEntry:
 class ReversibilityRegistry:
     """Session-scoped reversibility table (interned rows, parallel columns)."""
 
-    _GROW = 16
-
     def __init__(self, session_id: str) -> None:
         self.session_id = session_id
-        self._ids = InternTable()
-        self._filled = 0
         self._non_reversible = 0  # running count: O(1) hot-path check
-        self._rev = np.zeros(0, np.int8)
-        self._omega = np.zeros(0, np.float32)
-        self._window = np.zeros(0, np.int32)
-        self._healthy = np.zeros(0, np.bool_)
+        self._t = ColumnStore(
+            grow=16,
+            rev=np.int8,
+            omega=np.float32,
+            window=np.int32,
+            healthy=np.bool_,
+        )
         self._execute: list[str] = []
         self._undo: list[Optional[str]] = []
         self._comp: list[Optional[str]] = []
@@ -61,31 +60,22 @@ class ReversibilityRegistry:
     # ── registration ────────────────────────────────────────────────────
 
     def register(self, action: ActionDescriptor) -> ReversibilityEntry:
-        row = self._ids.intern(action.action_id)
-        if row >= len(self._rev):
-            extra = max(self._GROW, row + 1 - len(self._rev))
-            self._rev = np.concatenate([self._rev, np.zeros(extra, np.int8)])
-            self._omega = np.concatenate([self._omega, np.zeros(extra, np.float32)])
-            self._window = np.concatenate([self._window, np.zeros(extra, np.int32)])
-            self._healthy = np.concatenate(
-                [self._healthy, np.zeros(extra, np.bool_)]
-            )
+        row, is_new = self._t.row_for(action.action_id)
         while len(self._execute) <= row:
             self._execute.append("")
             self._undo.append(None)
             self._comp.append(None)
-        if row < self._filled and int(self._rev[row]) == _NONE_CODE:
+        if not is_new and int(self._t.rev[row]) == _NONE_CODE:
             self._non_reversible -= 1  # re-registering an existing action
-        self._rev[row] = _LEVEL_CODE[action.reversibility]
+        self._t.rev[row] = _LEVEL_CODE[action.reversibility]
         if _LEVEL_CODE[action.reversibility] == _NONE_CODE:
             self._non_reversible += 1
-        self._omega[row] = action.risk_weight
-        self._window[row] = action.undo_window_seconds
-        self._healthy[row] = True
+        self._t.omega[row] = action.risk_weight
+        self._t.window[row] = action.undo_window_seconds
+        self._t.healthy[row] = True
         self._execute[row] = action.execute_api
         self._undo[row] = action.undo_api
         self._comp[row] = action.compensation_method
-        self._filled = max(self._filled, row + 1)
         return self._view(row)
 
     def register_from_manifest(self, actions: list[ActionDescriptor]) -> int:
@@ -96,54 +86,54 @@ class ReversibilityRegistry:
     # ── lookups ─────────────────────────────────────────────────────────
 
     def get(self, action_id: str) -> Optional[ReversibilityEntry]:
-        row = self._ids.lookup(action_id)
+        row = self._t.lookup(action_id)
         return self._view(row) if row >= 0 else None
 
     def get_undo_api(self, action_id: str) -> Optional[str]:
-        row = self._ids.lookup(action_id)
+        row = self._t.lookup(action_id)
         return self._undo[row] if row >= 0 else None
 
     def is_reversible(self, action_id: str) -> bool:
-        row = self._ids.lookup(action_id)
-        return row >= 0 and int(self._rev[row]) != _NONE_CODE
+        row = self._t.lookup(action_id)
+        return row >= 0 and int(self._t.rev[row]) != _NONE_CODE
 
     def get_risk_weight(self, action_id: str) -> float:
-        row = self._ids.lookup(action_id)
+        row = self._t.lookup(action_id)
         if row < 0:
             return ReversibilityLevel.NONE.default_risk_weight
-        return float(self._omega[row])
+        return float(self._t.omega[row])
 
     def has_non_reversible_actions(self) -> bool:
         return self._non_reversible > 0
 
     def mark_undo_unhealthy(self, action_id: str) -> None:
-        row = self._ids.lookup(action_id)
+        row = self._t.lookup(action_id)
         if row >= 0:
-            self._healthy[row] = False
+            self._t.healthy[row] = False
 
     # ── bulk views ──────────────────────────────────────────────────────
 
     @property
     def entries(self) -> list[ReversibilityEntry]:
-        return [self._view(row) for row in range(self._filled)]
+        return [self._view(row) for row in range(len(self._t))]
 
     @property
     def non_reversible_actions(self) -> list[str]:
-        rows = np.nonzero(self._rev[: self._filled] == _NONE_CODE)[0]
-        return [self._ids.string(int(row)) for row in rows]
+        rows = np.nonzero(self._t.filled("rev") == _NONE_CODE)[0]
+        return [self._t.key_of(int(row)) for row in rows]
 
     def omega_column(self) -> np.ndarray:
         """f32[N] risk weights in row order — the device gather source."""
-        return self._omega[: self._filled].copy()
+        return self._t.filled("omega").copy()
 
     def _view(self, row: int) -> ReversibilityEntry:
         return ReversibilityEntry(
-            action_id=self._ids.string(row),
+            action_id=self._t.key_of(row),
             execute_api=self._execute[row],
             undo_api=self._undo[row],
-            reversibility=_LEVELS[int(self._rev[row])],
-            undo_window_seconds=int(self._window[row]),
+            reversibility=_LEVELS[int(self._t.rev[row])],
+            undo_window_seconds=int(self._t.window[row]),
             compensation_method=self._comp[row],
-            risk_weight=float(self._omega[row]),
-            undo_api_healthy=bool(self._healthy[row]),
+            risk_weight=float(self._t.omega[row]),
+            undo_api_healthy=bool(self._t.healthy[row]),
         )

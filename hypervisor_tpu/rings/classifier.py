@@ -2,12 +2,12 @@
 
 Capability parity with reference `rings/classifier.py:27-77` (derivation
 from the ActionDescriptor, per-action caching, session-level overrides at
-confidence 0.9), re-built as a columnar table: action ids are interned to
-dense rows and the classification lives in parallel ring/omega/
-reversibility/confidence columns, with override rows shadowing derived
-rows via a source mark. `classify_batch` classifies a whole manifest in
-one pass over the columns — the host-side twin of the vectorized
-`ops.rings.required_rings`.
+confidence 0.9), re-built on the shared `ColumnStore`: action ids are
+interned to dense rows and the classification lives in parallel ring/
+omega/reversibility/confidence columns, with override rows shadowing
+derived rows via a source mark. `classify_batch` classifies a whole
+manifest in one pass over the columns — the host-side twin of the
+vectorized `ops.rings.required_rings`.
 """
 
 from __future__ import annotations
@@ -18,7 +18,7 @@ from typing import Iterable, Optional
 import numpy as np
 
 from hypervisor_tpu.models import ActionDescriptor, ExecutionRing, ReversibilityLevel
-from hypervisor_tpu.tables.intern import InternTable
+from hypervisor_tpu.tables.intern import ColumnStore
 
 _REV_BY_CODE = (
     ReversibilityLevel.FULL,
@@ -44,15 +44,15 @@ class ActionClassifier:
     """Columnar classification table; override rows shadow derived rows."""
 
     OVERRIDE_CONFIDENCE = 0.9
-    _GROW = 32
 
     def __init__(self) -> None:
-        self._ids = InternTable()
-        self._ring = np.zeros(0, np.int8)
-        self._omega = np.zeros(0, np.float32)
-        self._rev = np.zeros(0, np.int8)
-        self._conf = np.zeros(0, np.float64)
-        self._source = np.zeros(0, np.int8)  # _EMPTY/_DERIVED/_OVERRIDE
+        self._t = ColumnStore(
+            ring=np.int8,
+            omega=np.float32,
+            rev=np.int8,
+            conf=np.float64,
+            source=np.int8,  # _EMPTY/_DERIVED/_OVERRIDE
+        )
         # Materialized result per row, dropped whenever the row is refilled,
         # so repeat classify() calls return the identical object.
         self._views: dict[int, ClassificationResult] = {}
@@ -60,8 +60,8 @@ class ActionClassifier:
     # ── single-action path ──────────────────────────────────────────────
 
     def classify(self, action: ActionDescriptor) -> ClassificationResult:
-        row = self._row_for(action.action_id)
-        if self._source[row] == _EMPTY:
+        row, _ = self._t.row_for(action.action_id)
+        if self._t.source[row] == _EMPTY:
             self._fill(row, _DERIVED, action.required_ring.value,
                        action.risk_weight, _CODE_BY_REV[action.reversibility], 1.0)
         return self._materialize(row, action.action_id)
@@ -77,23 +77,25 @@ class ActionClassifier:
         Unset fields inherit the current row (or sandbox/0.5/NONE when the
         action was never classified).
         """
-        row = self._row_for(action_id)
-        known = self._source[row] != _EMPTY
+        row, _ = self._t.row_for(action_id)
+        known = self._t.source[row] != _EMPTY
         self._fill(
             row,
             _OVERRIDE,
             ring.value if ring is not None
-            else (int(self._ring[row]) if known else ExecutionRing.RING_3_SANDBOX.value),
+            else (int(self._t.ring[row]) if known else ExecutionRing.RING_3_SANDBOX.value),
             risk_weight if risk_weight is not None
-            else (float(self._omega[row]) if known else 0.5),
-            int(self._rev[row]) if known else _CODE_BY_REV[ReversibilityLevel.NONE],
+            else (float(self._t.omega[row]) if known else 0.5),
+            int(self._t.rev[row]) if known else _CODE_BY_REV[ReversibilityLevel.NONE],
             self.OVERRIDE_CONFIDENCE,
         )
 
     def clear_cache(self) -> None:
         """Drop derived rows; override rows survive (they are policy)."""
-        derived = self._source == _DERIVED
-        self._source[derived] = _EMPTY
+        live = self._t.filled("source")
+        for row in np.nonzero(live == _DERIVED)[0]:
+            self._views.pop(int(row), None)
+        live[live == _DERIVED] = _EMPTY
 
     # ── batch path (manifest tables) ────────────────────────────────────
 
@@ -102,41 +104,37 @@ class ActionClassifier:
     ) -> list[ClassificationResult]:
         """Classify a manifest in one column pass (fills empty rows first)."""
         actions = list(actions)
-        rows = np.array([self._row_for(a.action_id) for a in actions], np.int32)
+        rows = [self._t.row_for(a.action_id)[0] for a in actions]
         for a, row in zip(actions, rows):
-            if self._source[row] == _EMPTY:
+            if self._t.source[row] == _EMPTY:
                 self._fill(row, _DERIVED, a.required_ring.value,
                            a.risk_weight, _CODE_BY_REV[a.reversibility], 1.0)
         return [
-            self._materialize(int(row), a.action_id)
+            self._materialize(row, a.action_id)
             for a, row in zip(actions, rows)
         ]
 
     def columns(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """(ring i8[N], omega f32[N], reversibility i8[N]) device-ready views."""
-        return self._ring.copy(), self._omega.copy(), self._rev.copy()
+        """(ring i8[N], omega f32[N], reversibility i8[N]) device-ready views.
+
+        N is the interned row count — grow padding never leaks out.
+        """
+        return (
+            self._t.filled("ring").copy(),
+            self._t.filled("omega").copy(),
+            self._t.filled("rev").copy(),
+        )
 
     # ── row plumbing ────────────────────────────────────────────────────
-
-    def _row_for(self, action_id: str) -> int:
-        row = self._ids.intern(action_id)
-        if row >= len(self._source):
-            extra = max(self._GROW, row + 1 - len(self._source))
-            self._ring = np.concatenate([self._ring, np.zeros(extra, np.int8)])
-            self._omega = np.concatenate([self._omega, np.zeros(extra, np.float32)])
-            self._rev = np.concatenate([self._rev, np.zeros(extra, np.int8)])
-            self._conf = np.concatenate([self._conf, np.zeros(extra, np.float32)])
-            self._source = np.concatenate([self._source, np.zeros(extra, np.int8)])
-        return row
 
     def _fill(
         self, row: int, source: int, ring: int, omega: float, rev: int, conf: float
     ) -> None:
-        self._ring[row] = ring
-        self._omega[row] = omega
-        self._rev[row] = rev
-        self._conf[row] = conf
-        self._source[row] = source
+        self._t.ring[row] = ring
+        self._t.omega[row] = omega
+        self._t.rev[row] = rev
+        self._t.conf[row] = conf
+        self._t.source[row] = source
         self._views.pop(row, None)
 
     def _materialize(self, row: int, action_id: str) -> ClassificationResult:
@@ -144,9 +142,9 @@ class ActionClassifier:
         if view is None:
             view = self._views[row] = ClassificationResult(
                 action_id=action_id,
-                ring=ExecutionRing(int(self._ring[row])),
-                risk_weight=float(self._omega[row]),
-                reversibility=_REV_BY_CODE[int(self._rev[row])],
-                confidence=float(self._conf[row]),
+                ring=ExecutionRing(int(self._t.ring[row])),
+                risk_weight=float(self._t.omega[row]),
+                reversibility=_REV_BY_CODE[int(self._t.rev[row])],
+                confidence=float(self._t.conf[row]),
             )
         return view

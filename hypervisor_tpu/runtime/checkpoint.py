@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import threading
 from pathlib import Path
 from typing import Optional
@@ -40,6 +41,18 @@ _TABLE_TYPES = {
     "delta_log": DeltaLog,
     "event_log": EventLog,
 }
+
+# One writer at a time per checkpoint target: overlapping background saves
+# to e.g. "latest" must serialize or they race on the tmp files and the
+# .done marker.
+_writer_locks: dict[str, threading.Lock] = {}
+_writer_locks_guard = threading.Lock()
+
+
+def _writer_lock(target: Path) -> threading.Lock:
+    key = str(target.resolve())
+    with _writer_locks_guard:
+        return _writer_locks.setdefault(key, threading.Lock())
 
 
 def _intern_dump(t: InternTable) -> list[str]:
@@ -70,6 +83,10 @@ def host_metadata(state: HypervisorState) -> dict:
         "next_agent_slot": state._next_agent_slot,
         "next_session_slot": state._next_session_slot,
         "members": sorted([list(k) for k in state._members]),
+        # Capacity fields are validated at restore: array shapes come from
+        # the npz while slot allocation uses the live config, so a
+        # capacity mismatch must fail loudly, not corrupt silently.
+        "capacity": dataclasses.asdict(state.config.capacity),
     }
 
 
@@ -85,18 +102,43 @@ def save_state(
     column); with `background=True` the disk write happens on a daemon
     thread and the returned path's `.done` marker appears when durable —
     the orbax-style async split that keeps ticks running during the write.
+
+    The state must be flushed first: joins staged with `enqueue_join` but
+    not yet admitted by `flush_joins` live only in the staging queue and
+    would be silently lost, so saving with a non-empty queue is an error.
+
+    Overwriting a prior checkpoint at the same target is crash-consistent:
+    the stale `.done` marker is removed synchronously before the writer
+    starts, files are written to temp names and `os.replace`d into place,
+    and `.done` appears only after both files are in place.
     """
+    if state._pending:
+        raise RuntimeError(
+            f"cannot checkpoint with {len(state._pending)} staged joins; "
+            "call flush_joins() first"
+        )
     directory = Path(directory)
     target = directory / (f"step_{step}" if step is not None else "latest")
     target.mkdir(parents=True, exist_ok=True)
+    done = target / ".done"
+    done.unlink(missing_ok=True)  # readers must not trust a torn overwrite
 
     arrays = state_arrays(state)          # device -> host happens here
     meta = host_metadata(state)
 
     def write():
-        np.savez(target / "tables.npz", **arrays)
-        (target / "host.json").write_text(json.dumps(meta))
-        (target / ".done").touch()
+        with _writer_lock(target):
+            # A writer queued behind an older save must drop the marker the
+            # older writer just published: only the newest data earns .done.
+            done.unlink(missing_ok=True)
+            tmp_npz = target / "tables.npz.tmp"
+            with open(tmp_npz, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp_npz, target / "tables.npz")
+            tmp_json = target / "host.json.tmp"
+            tmp_json.write_text(json.dumps(meta))
+            os.replace(tmp_json, target / "host.json")
+            done.touch()
 
     if background:
         threading.Thread(target=write, daemon=True).start()
@@ -112,6 +154,19 @@ def restore_state(
     checkpoint = Path(checkpoint)
     data = np.load(checkpoint / "tables.npz")
     meta = json.loads((checkpoint / "host.json").read_text())
+
+    saved_capacity = meta.get("capacity")
+    if saved_capacity is not None:
+        live_capacity = dataclasses.asdict(config.capacity)
+        if saved_capacity != live_capacity:
+            diff = {
+                k: (saved_capacity[k], live_capacity.get(k))
+                for k in saved_capacity
+                if saved_capacity[k] != live_capacity.get(k)
+            }
+            raise ValueError(
+                f"checkpoint capacity mismatch (saved, restore): {diff}"
+            )
 
     state = HypervisorState(config)
     for tname, ttype in _TABLE_TYPES.items():

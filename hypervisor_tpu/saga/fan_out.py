@@ -132,41 +132,44 @@ class FanOutOrchestrator:
         executors: dict[str, Callable[..., Any]],
         timeout_seconds: int = 300,
     ) -> FanOutGroup:
-        """Run every branch concurrently, then settle the group once."""
+        """Run every branch concurrently, then settle the group once.
+
+        Branch state is applied as each branch finishes (not deferred to
+        the settle pass), so a group-level timeout still leaves the
+        already-completed branches COMMITTED/FAILED for compensation or
+        handoff to act on.
+        """
         group = self._require_group(group_id)
         work = (self._run_branch(b, executors) for b in group.branches)
-        outcomes = await asyncio.wait_for(
+        await asyncio.wait_for(
             asyncio.gather(*work, return_exceptions=True), timeout=timeout_seconds
         )
-        self._settle(
-            group,
-            [
-                o if isinstance(o, tuple) else (False, str(o))
-                for o in outcomes
-            ],
-        )
+        self._settle(group)
         return group
 
-    @staticmethod
+    @classmethod
     async def _run_branch(
-        branch: FanOutBranch, executors: dict[str, Callable[..., Any]]
-    ) -> _Outcome:
-        """Execute one branch; never raises — outcomes are data."""
+        cls, branch: FanOutBranch, executors: dict[str, Callable[..., Any]]
+    ) -> None:
+        """Execute one branch and book its outcome; never raises."""
         step = branch.step
         if step is None:
-            return False, "No step assigned"
+            cls._book(branch, (False, "No step assigned"))
+            return
         executor = executors.get(step.step_id)
         if executor is None:
-            return False, f"No executor for step {step.step_id}"
+            cls._book(branch, (False, f"No executor for step {step.step_id}"))
+            return
         try:
             step.transition(StepState.EXECUTING)
             result = await asyncio.wait_for(executor(), timeout=step.timeout_seconds)
         except Exception as exc:  # noqa: BLE001 — branch failures are data
-            return False, str(exc)
-        return True, result
+            cls._book(branch, (False, str(exc)))
+            return
+        cls._book(branch, (True, result))
 
     @staticmethod
-    def _apply_outcome(branch: FanOutBranch, outcome: _Outcome) -> None:
+    def _book(branch: FanOutBranch, outcome: _Outcome) -> None:
         ok, value = outcome
         branch.succeeded = ok
         step = branch.step
@@ -181,9 +184,7 @@ class FanOutOrchestrator:
                 step.error = str(value)
                 step.transition(StepState.FAILED)
 
-    def _settle(self, group: FanOutGroup, outcomes: list[_Outcome]) -> None:
-        for branch, outcome in zip(group.branches, outcomes):
-            self._apply_outcome(branch, outcome)
+    def _settle(self, group: FanOutGroup) -> None:
         group.policy_satisfied = group.check_policy()
         group.resolved = True
         if not group.policy_satisfied:

@@ -25,7 +25,7 @@ import numpy as np
 
 from hypervisor_tpu.config import DEFAULT_CONFIG
 from hypervisor_tpu.models import ExecutionRing
-from hypervisor_tpu.tables.intern import InternTable
+from hypervisor_tpu.tables.intern import ColumnStore
 from hypervisor_tpu.utils.clock import Clock, utc_now
 
 
@@ -83,8 +83,6 @@ class RateLimitStats:
 class AgentRateLimiter:
     """All (agent, session) buckets as parallel columns over interned rows."""
 
-    _GROW = 64
-
     def __init__(
         self,
         ring_limits: Optional[dict[ExecutionRing, tuple[float, float]]] = None,
@@ -102,14 +100,14 @@ class AgentRateLimiter:
         )
         self._clock = clock
         self._epoch = clock()
-        self._keys = InternTable()
-        self._agent_of: list[str] = []
-        n = 0
-        self._tokens = np.zeros(n, np.float64)
-        self._stamp = np.zeros(n, np.float64)
-        self._ring = np.zeros(n, np.int8)
-        self._total = np.zeros(n, np.int64)
-        self._rejected = np.zeros(n, np.int64)
+        self._t = ColumnStore(
+            grow=64,
+            tokens=np.float64,
+            stamp=np.float64,
+            ring=np.int8,
+            total=np.int64,
+            rejected=np.int64,
+        )
 
     # ── scalar API ──────────────────────────────────────────────────────
 
@@ -126,8 +124,8 @@ class AgentRateLimiter:
         if not allowed:
             raise RateLimitExceeded(
                 f"Agent {agent_did} exceeded rate limit for ring "
-                f"{int(self._ring[row])} "
-                f"({int(self._rejected[row])} rejections)"
+                f"{int(self._t.ring[row])} "
+                f"({int(self._t.rejected[row])} rejections)"
             )
         return True
 
@@ -174,28 +172,28 @@ class AgentRateLimiter:
     ) -> None:
         """Ring change: bucket recreated at full burst for the new ring."""
         row = self._row(agent_did, session_id, new_ring)
-        self._ring[row] = new_ring.value
-        self._tokens[row] = self._bursts[new_ring.value]
-        self._stamp[row] = self._now()
+        self._t.ring[row] = new_ring.value
+        self._t.tokens[row] = self._bursts[new_ring.value]
+        self._t.stamp[row] = self._now()
 
     def get_stats(self, agent_did: str, session_id: str) -> Optional[RateLimitStats]:
-        handle = self._keys.lookup(f"{agent_did}\x00{session_id}")
-        if handle < 0:
+        row = self._t.lookup(f"{agent_did}\x00{session_id}")
+        if row < 0:
             return None
-        self._refill(np.array([handle]))
-        ring = ExecutionRing(int(self._ring[handle]))
+        self._refill(np.array([row]))
+        ring = ExecutionRing(int(self._t.ring[row]))
         return RateLimitStats(
             agent_did=agent_did,
             ring=ring,
-            total_requests=int(self._total[handle]),
-            rejected_requests=int(self._rejected[handle]),
-            tokens_available=float(self._tokens[handle]),
+            total_requests=int(self._t.total[row]),
+            rejected_requests=int(self._t.rejected[row]),
+            tokens_available=float(self._t.tokens[row]),
             capacity=float(self._bursts[ring.value]),
         )
 
     @property
     def tracked_agents(self) -> int:
-        return len(self._keys)
+        return len(self._t)
 
     # ── column mechanics ────────────────────────────────────────────────
 
@@ -203,40 +201,30 @@ class AgentRateLimiter:
         return (self._clock() - self._epoch).total_seconds()
 
     def _row(self, agent_did: str, session_id: str, ring: ExecutionRing) -> int:
-        row = self._keys.intern(f"{agent_did}\x00{session_id}")
-        if row >= len(self._tokens):
-            extra = max(self._GROW, row + 1 - len(self._tokens))
-            self._tokens = np.concatenate([self._tokens, np.zeros(extra)])
-            self._stamp = np.concatenate([self._stamp, np.zeros(extra)])
-            self._ring = np.concatenate([self._ring, np.zeros(extra, np.int8)])
-            self._total = np.concatenate([self._total, np.zeros(extra, np.int64)])
-            self._rejected = np.concatenate(
-                [self._rejected, np.zeros(extra, np.int64)]
-            )
-        if len(self._agent_of) <= row:
-            # New row: a fresh bucket starts at full burst for its ring.
-            self._agent_of.append(agent_did)
-            self._ring[row] = ring.value
-            self._tokens[row] = self._bursts[ring.value]
-            self._stamp[row] = self._now()
+        row, is_new = self._t.row_for(f"{agent_did}\x00{session_id}")
+        if is_new:
+            # A fresh bucket starts at full burst for its ring.
+            self._t.ring[row] = ring.value
+            self._t.tokens[row] = self._bursts[ring.value]
+            self._t.stamp[row] = self._now()
         return row
 
     def _refill(self, rows: np.ndarray) -> None:
         now = self._now()
-        ring = np.clip(self._ring[rows].astype(np.int64), 0, 3)
-        elapsed = np.maximum(now - self._stamp[rows], 0.0)
-        self._tokens[rows] = np.minimum(
-            self._bursts[ring], self._tokens[rows] + elapsed * self._rates[ring]
+        ring = np.clip(self._t.ring[rows].astype(np.int64), 0, 3)
+        elapsed = np.maximum(now - self._t.stamp[rows], 0.0)
+        self._t.tokens[rows] = np.minimum(
+            self._bursts[ring], self._t.tokens[rows] + elapsed * self._rates[ring]
         )
-        self._stamp[rows] = now
+        self._t.stamp[rows] = now
 
     def _decide(self, rows: np.ndarray, cost: float) -> np.ndarray:
         """Refill-then-consume over a row batch (ops.rate_limit.consume twin)."""
         self._refill(rows)
-        allowed = self._tokens[rows] >= cost
-        self._tokens[rows] = np.where(
-            allowed, self._tokens[rows] - cost, self._tokens[rows]
+        allowed = self._t.tokens[rows] >= cost
+        self._t.tokens[rows] = np.where(
+            allowed, self._t.tokens[rows] - cost, self._t.tokens[rows]
         )
-        np.add.at(self._total, rows, 1)
-        np.add.at(self._rejected, rows, (~allowed).astype(np.int64))
+        np.add.at(self._t.total, rows, 1)
+        np.add.at(self._t.rejected, rows, (~allowed).astype(np.int64))
         return allowed

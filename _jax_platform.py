@@ -36,6 +36,7 @@ def force_cpu_platform(n_devices: int = 8) -> None:
     jax_loaded = "jax" in sys.modules
     if not jax_loaded:
         os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 
     flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(rf"--{_COUNT_FLAG}=(\d+)", flags)
@@ -50,8 +51,18 @@ def force_cpu_platform(n_devices: int = 8) -> None:
     if jax_loaded:
         import jax
 
-        if "cpu" not in str(jax.config.jax_platforms or ""):
-            try:
-                jax.devices("cpu")  # explicit-platform request usually works
-            except RuntimeError:  # pragma: no cover - jax-version dependent
-                jax.config.update("jax_platforms", "cpu")
+        # Exact match required: a captured "axon,cpu" still initializes
+        # the axon plugin on the first backend query.
+        if str(jax.config.jax_platforms or "") != "cpu":
+            # MUST be the config route: jax.devices("cpu") would initialize
+            # every registered plugin (including the real-accelerator
+            # tunnel, which hangs this process when the tunnel is down —
+            # observed live). Restricting jax_platforms to "cpu" keeps all
+            # other plugins untouched. The slow-compile cliff previously
+            # attributed to this route does not reproduce with the
+            # persistent compilation cache configured (1.5 s for the
+            # unrolled SHA-256 program).
+            jax.config.update("jax_platforms", "cpu")
+        if not jax.config.jax_compilation_cache_dir:
+            jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -6,12 +6,21 @@ at a time, pure Python on CPU (`benchmarks/bench_hypervisor.py:217-239`,
 1 join + activate + 3 audit deltas + 1-step saga + terminate with Merkle
 root.
 
-Here the same pipeline runs for 10,000 independent session lanes as ONE
-jitted XLA program (`hypervisor_tpu.ops.pipeline.governance_pipeline`):
-admission/ring math, FSM walk, SHA-256 delta chains, per-lane Merkle
-roots, saga transition — no host work in the loop. Reported value is the
-p50 wall-clock of a batched tick divided by the lane count: the per-session
-pipeline latency at 10k concurrency.
+Here the same pipeline runs for 10,000 session lanes as ONE jitted wave
+over the REAL `HypervisorState` tables (`ops.pipeline.governance_wave`):
+vouched-sigma admission against the Agent/Session/Vouch tables, a
+legality-gated session FSM walk, chained SHA-256 delta digests +
+per-session Merkle roots, a saga step through the retry ladder, and
+termination with session-scoped bond release — no host work in the
+device loop. A 1k-lane vouch preload exercises the joint-liability path
+(vouched agents clear higher rings than raw sigma allows).
+
+Correctness gates before timing counts:
+  * every lane's admission status asserted OK,
+  * one lane's chain digests AND Merkle root recomputed with hashlib on
+    host and compared bit-for-bit (Pallas SHA-256 is hardware-verified
+    in the driver loop, not just nonzero),
+  * vouched lanes asserted to out-rank their raw sigma.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "us", "vs_baseline": N}
@@ -20,6 +29,7 @@ vs_baseline > 1 means faster than the reference's 267.5 µs p50.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import sys
 import time
@@ -28,51 +38,140 @@ import numpy as np
 
 N_SESSIONS = 10_000
 N_DELTAS = 3
+N_VOUCHED = 1_000
 WARMUP = 3
 ITERS = 30
 BASELINE_P50_US = 267.5
+OMEGA = 0.5
+
+
+def _host_chain_and_root(bodies_lane: np.ndarray) -> tuple[list[str], str]:
+    """hashlib recomputation of one lane's chain digests + Merkle root."""
+    from hypervisor_tpu.audit.delta import merkle_root_host
+
+    parent = b"\x00" * 32
+    hex_digests = []
+    for body in bodies_lane:  # [T, BODY_WORDS]
+        digest = hashlib.sha256(
+            body.astype(">u4").tobytes() + parent
+        ).digest()
+        parent = digest
+        hex_digests.append(digest.hex())
+    return hex_digests, merkle_root_host(hex_digests)
 
 
 def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from hypervisor_tpu.models import SessionConfig
     from hypervisor_tpu.ops import merkle as merkle_ops
-    from hypervisor_tpu.ops.pipeline import governance_pipeline
+    from hypervisor_tpu.ops.sha256 import digests_to_hex
+    from hypervisor_tpu.state import HypervisorState, _WAVE
+    from hypervisor_tpu.tables.struct import replace as t_replace
 
     dev = jax.devices()[0]
     rng = np.random.RandomState(42)
+
+    # ── host staging: sessions, agents, vouch preload ────────────────
+    import dataclasses
+
+    from hypervisor_tpu.config import DEFAULT_CONFIG
+
+    config = dataclasses.replace(
+        DEFAULT_CONFIG,
+        capacity=dataclasses.replace(
+            DEFAULT_CONFIG.capacity, max_sessions=16_384
+        ),
+    )
+    state = HypervisorState(config)
+    session_slots = state.create_sessions_batch(
+        [f"bench:s{i}" for i in range(N_SESSIONS)],
+        SessionConfig(min_sigma_eff=0.0),
+    )
+    dids = [f"did:bench:{i}" for i in range(N_SESSIONS)]
+    agent_sessions = session_slots.copy()
+    # Vouched lanes join with LOW raw sigma; their bonded contributions
+    # must lift them over the Ring-2 threshold (sigma > 0.60).
+    sigma = np.full(N_SESSIONS, 0.8, np.float32)
+    sigma[:N_VOUCHED] = 0.50
+    voucher_slots = np.arange(
+        N_SESSIONS, N_SESSIONS + N_VOUCHED, dtype=np.int32
+    )  # phantom high-trust vouchers parked outside the wave
+    vouchee_slots = np.arange(N_VOUCHED, dtype=np.int32)
+    state.vouches = t_replace(
+        state.vouches,
+        voucher=state.vouches.voucher.at[:N_VOUCHED].set(jnp.asarray(voucher_slots)),
+        vouchee=state.vouches.vouchee.at[:N_VOUCHED].set(jnp.asarray(vouchee_slots)),
+        session=state.vouches.session.at[:N_VOUCHED].set(
+            jnp.asarray(session_slots[:N_VOUCHED])
+        ),
+        bond=state.vouches.bond.at[:N_VOUCHED].set(0.30),
+        active=state.vouches.active.at[:N_VOUCHED].set(True),
+    )
+
     bodies = rng.randint(
         0, 2**32, size=(N_DELTAS, N_SESSIONS, merkle_ops.BODY_WORDS), dtype=np.uint64
     ).astype(np.uint32)
 
-    args = (
-        jax.device_put(jnp.full((N_SESSIONS,), 0.8, jnp.float32), dev),
-        jax.device_put(jnp.ones((N_SESSIONS,), bool), dev),
-        jax.device_put(jnp.full((N_SESSIONS,), 0.60, jnp.float32), dev),
+    # Stage the wave once; the timed loop re-executes the pure jitted
+    # program on the same staged inputs (the op reads+writes the tables
+    # functionally, so each execution is the identical full pipeline).
+    b = len(dids)
+    agent_slots = np.arange(b, dtype=np.int32)
+    handles = np.array([state.agent_ids.intern(d) for d in dids], np.int32)
+    wave_args = (
+        state.agents,
+        state.sessions,
+        state.vouches,
+        jax.device_put(jnp.asarray(agent_slots), dev),
+        jax.device_put(jnp.asarray(handles), dev),
+        jax.device_put(jnp.asarray(agent_sessions), dev),
+        jax.device_put(jnp.asarray(sigma), dev),
+        jax.device_put(jnp.ones(b, bool), dev),
+        jax.device_put(jnp.zeros(b, bool), dev),
+        jax.device_put(jnp.asarray(session_slots), dev),
         jax.device_put(jnp.asarray(bodies), dev),
-        jax.device_put(jnp.ones((N_SESSIONS,), bool), dev),
+        0.0,
+        OMEGA,
     )
-
-    tick = jax.jit(governance_pipeline)
 
     # Warmup (compile + cache).
     for _ in range(WARMUP):
-        result = tick(*args)
+        result = _WAVE(*wave_args)
         jax.block_until_ready(result)
 
     samples = []
     for _ in range(ITERS):
         t0 = time.perf_counter_ns()
-        result = tick(*args)
+        result = _WAVE(*wave_args)
         jax.block_until_ready(result)
         samples.append(time.perf_counter_ns() - t0)
 
-    # Sanity: every lane completed the pipeline.
+    # ── correctness gates ────────────────────────────────────────────
     status = np.asarray(result.status)
-    assert (status == 0).all(), f"pipeline lanes failed: {np.unique(status)}"
-    roots = np.asarray(result.merkle_root)
-    assert roots.any(), "empty merkle roots"
+    assert (status == 0).all(), f"wave lanes failed: {np.unique(status)}"
+    assert not np.asarray(result.fsm_error).any(), "illegal session FSM walk"
+
+    rings = np.asarray(result.ring)
+    sig_eff = np.asarray(result.sigma_eff)
+    # Vouched lanes: sigma_eff = 0.50 + 0.5*0.30 = 0.65 -> Ring 2;
+    # raw 0.50 alone would be Ring 3.
+    assert (rings[:N_VOUCHED] == 2).all(), "vouched lanes not lifted"
+    assert np.allclose(sig_eff[:N_VOUCHED], 0.65, atol=1e-6)
+    assert (rings[N_VOUCHED:] == 2).all()
+    assert int(np.asarray(result.released)) == N_VOUCHED, "bonds not released"
+
+    # Bit-verify the device hash chain + Merkle root against hashlib for
+    # one vouched and one plain lane.
+    chain = np.asarray(result.chain)          # [T, K, 8]
+    roots = np.asarray(result.merkle_root)    # [K, 8]
+    for lane in (0, N_SESSIONS - 1):
+        host_chain, host_root = _host_chain_and_root(bodies[:, lane])
+        device_chain = digests_to_hex(chain[:, lane])
+        assert device_chain == host_chain, f"chain mismatch on lane {lane}"
+        device_root = digests_to_hex(roots[lane][None])[0]
+        assert device_root == host_root, f"root mismatch on lane {lane}"
 
     batch_p50_ns = float(np.percentile(samples, 50))
     per_session_us = batch_p50_ns / 1e3 / N_SESSIONS
@@ -80,9 +179,10 @@ def main() -> None:
         json.dumps(
             {
                 "metric": (
-                    "full_governance_pipeline p50 latency per session "
-                    f"at {N_SESSIONS} concurrent (create+join+activate+"
-                    "3 deltas+saga step+terminate w/ merkle root)"
+                    "full_governance_pipeline p50 latency per session at "
+                    f"{N_SESSIONS} concurrent, on the HypervisorState tables "
+                    "(create+vouched join+activate+3 deltas+saga step+"
+                    "terminate w/ bond release & hashlib-verified merkle root)"
                 ),
                 "value": round(per_session_us, 4),
                 "unit": "us",
@@ -91,6 +191,7 @@ def main() -> None:
                 "throughput_pipelines_per_s": round(
                     N_SESSIONS / (batch_p50_ns / 1e9)
                 ),
+                "vouched_lanes": N_VOUCHED,
                 "device": str(dev),
             }
         )

@@ -195,20 +195,26 @@ def demo_batched_pipeline() -> None:
     jax.block_until_ready(result)
     import time
 
-    t0 = time.perf_counter()
-    result = tick(
+    args = (
         jnp.full((s,), 0.8, jnp.float32),
         jnp.ones((s,), bool),
         jnp.full((s,), 0.60, jnp.float32),
         jnp.asarray(bodies),
         jnp.ones((s,), bool),
     )
-    jax.block_until_ready(result)
-    dt = time.perf_counter() - t0
+    # p50 over a few ticks: a single dispatch over a remote device tunnel
+    # can be dominated by transport jitter.
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        result = tick(*args)
+        jax.block_until_ready(result)
+        samples.append(time.perf_counter() - t0)
+    dt = sorted(samples)[len(samples) // 2]
     ok = int(np.asarray(result.status == 0).sum())
     print(f"device: {jax.devices()[0]}")
     print(f"{ok}/{s} sessions completed the full pipeline in {dt * 1e3:.2f} ms "
-          f"({dt / s * 1e6:.2f} µs/session)")
+          f"p50 ({dt / s * 1e6:.2f} µs/session)")
 
 
 async def main() -> None:

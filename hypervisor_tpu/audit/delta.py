@@ -19,7 +19,7 @@ import hashlib
 import json
 from dataclasses import dataclass, field
 from datetime import datetime, timedelta, timezone
-from typing import Optional
+from typing import Callable, Optional
 
 from hypervisor_tpu.utils.clock import Clock, utc_now
 
@@ -108,11 +108,22 @@ def merkle_root_device(hashes: list[str]) -> str:
 
 
 class DeltaEngine:
-    """Session-scoped Merkle-chained delta log."""
+    """Session-scoped Merkle-chained delta log.
 
-    def __init__(self, session_id: str, clock: Clock = utc_now) -> None:
+    `sink`, when given, receives every captured delta — the facade wires
+    it to `HypervisorState.stage_delta` so the device DeltaLog records
+    the same leaves as this host chain (shared Merkle trees).
+    """
+
+    def __init__(
+        self,
+        session_id: str,
+        clock: Clock = utc_now,
+        sink: Optional[Callable[["SemanticDelta"], None]] = None,
+    ) -> None:
         self.session_id = session_id
         self._clock = clock
+        self._sink = sink
         self._deltas: list[SemanticDelta] = []
         self._turns = 0
 
@@ -135,6 +146,8 @@ class DeltaEngine:
         )
         delta.compute_hash()
         self._deltas.append(delta)
+        if self._sink is not None:
+            self._sink(delta)
         return delta
 
     def compute_merkle_root(self, device: Optional[bool] = None) -> Optional[str]:

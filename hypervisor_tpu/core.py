@@ -10,16 +10,20 @@ sandbox for untrustworthy agents), `activate_session`, `terminate_session`
 
 Like the reference, each ManagedSession owns its ReversibilityRegistry,
 DeltaEngine, and SagaOrchestrator while the Hypervisor holds the shared
-cross-session engines. Beyond the reference, the facade emits structured
-events to an (optional) event bus — the reference exports a bus but never
-wires it (`api/server.py:101` instantiates its own) — and exposes
-`batch`/device entry points for the vectorized hot path
-(`ops.pipeline`).
+cross-session engines. Beyond the reference, the facade is backed by the
+batched device plane (`HypervisorState`): every join routes through the
+jitted admission wave, every captured delta lands in the device DeltaLog
+with the same leaf digest as the host chain, and termination runs the
+device wave (Merkle root + bond release + archive) — host engines and
+device tables share one source of truth. The facade also emits
+structured events to an (optional) event bus, which the reference
+exports but never wires (`api/server.py:101` instantiates its own).
 """
 
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Optional
 
 from hypervisor_tpu.audit import CommitmentEngine, DeltaEngine, EphemeralGC
@@ -32,10 +36,13 @@ from hypervisor_tpu.models import (
     SessionConfig,
 )
 from hypervisor_tpu.observability import EventType, HypervisorEvent, HypervisorEventBus
+from hypervisor_tpu.ops import admission
+from hypervisor_tpu.ops.sha256 import digests_to_hex, hex_to_words
 from hypervisor_tpu.reversibility import ReversibilityRegistry
 from hypervisor_tpu.rings import ActionClassifier, RingEnforcer
 from hypervisor_tpu.saga import SagaOrchestrator
 from hypervisor_tpu.session import SharedSessionObject
+from hypervisor_tpu.state import HypervisorState
 from hypervisor_tpu.verification import TransactionHistoryVerifier
 
 logger = logging.getLogger(__name__)
@@ -44,13 +51,38 @@ __all__ = ["Hypervisor", "ManagedSession"]
 
 
 class ManagedSession:
-    """One session plus its session-scoped engines."""
+    """One session plus its session-scoped engines.
 
-    def __init__(self, sso: SharedSessionObject) -> None:
+    `slot` is the session's row in the device SessionTable; the delta
+    engine's sink stages every captured delta into the device DeltaLog
+    with the host hash as its leaf digest, so both planes build the same
+    Merkle tree.
+    """
+
+    def __init__(
+        self,
+        sso: SharedSessionObject,
+        slot: int = -1,
+        state: Optional[HypervisorState] = None,
+    ) -> None:
         self.sso = sso
+        self.slot = slot
         self.reversibility = ReversibilityRegistry(sso.session_id)
-        self.delta_engine = DeltaEngine(sso.session_id)
+        self.delta_engine = DeltaEngine(
+            sso.session_id,
+            sink=self._stage_delta if state is not None and slot >= 0 else None,
+        )
         self.saga = SagaOrchestrator()
+        self._state = state
+
+    def _stage_delta(self, delta) -> None:
+        row = self._state.agent_row(delta.agent_did)
+        self._state.stage_delta(
+            self.slot,
+            row["slot"] if row else -1,
+            ts=delta.timestamp.timestamp() % 2**31,
+            digest_words=hex_to_words([delta.delta_hash])[0],
+        )
 
 
 class Hypervisor:
@@ -74,7 +106,11 @@ class Hypervisor:
         cmvk: Optional[Any] = None,
         iatp: Optional[Any] = None,
         event_bus: Optional[HypervisorEventBus] = None,
+        state: Optional[HypervisorState] = None,
     ) -> None:
+        # The batched device plane every lifecycle call routes through.
+        self.state = state if state is not None else HypervisorState()
+
         # Shared cross-session engines.
         self.vouching = VouchingEngine(max_exposure=max_exposure)
         self.slashing = SlashingEngine(self.vouching)
@@ -102,7 +138,8 @@ class Hypervisor:
         """Create a Shared Session and advance it into HANDSHAKING."""
         sso = SharedSessionObject(config=config, creator_did=creator_did)
         sso.begin_handshake()
-        managed = ManagedSession(sso)
+        slot = self.state.create_session(sso.session_id, config)
+        managed = ManagedSession(sso, slot=slot, state=self.state)
         self._sessions[sso.session_id] = managed
         self._emit(
             EventType.SESSION_CREATED, session_id=sso.session_id, agent_did=creator_did
@@ -163,6 +200,40 @@ class Hypervisor:
         if not verification.is_trustworthy:
             ring = ExecutionRing.RING_3_SANDBOX
 
+        # The jitted admission wave is authoritative: it applies the same
+        # state/duplicate/capacity/sigma-floor rules as the host SSO over
+        # the device tables. On rejection, the host join reproduces the
+        # exact reference exception for the single-call API. The flush
+        # drains the whole staging queue; OUR lane is the one at the
+        # pre-enqueue pending depth (earlier stagings flush alongside).
+        lane = len(self.state._pending)
+        queued = self.state.enqueue_join(
+            managed.slot,
+            agent_did,
+            sigma_eff,
+            trustworthy=verification.is_trustworthy,
+        )
+        if queued < 0:
+            raise RuntimeError("admission staging queue full; flush pending joins")
+        status = self.state.flush_joins(now=time.time() % 2**31)
+        if int(status[lane]) != admission.ADMIT_OK:
+            managed.sso.join(
+                agent_did=agent_did,
+                sigma_raw=sigma_raw,
+                sigma_eff=sigma_eff,
+                ring=ring,
+            )
+            raise RuntimeError(
+                f"device admission rejected ({int(status[0])}) what the host "
+                f"session accepted — table/SSO divergence for {agent_did}"
+            )
+        device_ring = self.state.agent_row(agent_did)
+        if device_ring is not None and device_ring["ring"] != ring.value:
+            raise RuntimeError(
+                f"ring divergence for {agent_did}: host {ring.value}, "
+                f"device {device_ring['ring']}"
+            )
+
         managed.sso.join(
             agent_did=agent_did, sigma_raw=sigma_raw, sigma_eff=sigma_eff, ring=ring
         )
@@ -177,31 +248,49 @@ class Hypervisor:
     async def activate_session(self, session_id: str) -> None:
         managed = self._require(session_id)
         managed.sso.activate()
+        from hypervisor_tpu.models import SessionState
+
+        self.state.set_session_state(managed.slot, SessionState.ACTIVE)
         self._emit(EventType.SESSION_ACTIVATED, session_id=session_id)
 
     async def terminate_session(self, session_id: str) -> Optional[str]:
         """Terminate, commit the audit trail, release bonds, GC, archive.
 
-        Returns the Merkle-root summary hash (None when audit is disabled).
+        The device wave is authoritative: staged deltas flush to the
+        DeltaLog and `terminate_sessions` computes the Merkle root on
+        device (bit-identical leaves to the host chain), releases
+        session-scoped bonds in the VouchTable, deactivates participants,
+        and archives the session row. Returns the Merkle-root summary
+        hash (None when audit is disabled).
         """
         managed = self._require(session_id)
         managed.sso.terminate()
 
+        self.state.flush_deltas()
+        roots = self.state.terminate_sessions(
+            [managed.slot], now=time.time() % 2**31
+        )
+
         merkle_root = None
-        if managed.sso.config.enable_audit:
-            merkle_root = managed.delta_engine.compute_merkle_root()
-            if merkle_root:
-                self.commitment.commit(
-                    session_id=session_id,
-                    merkle_root=merkle_root,
-                    participant_dids=[p.agent_did for p in managed.sso.participants],
-                    delta_count=managed.delta_engine.turn_count,
+        if managed.sso.config.enable_audit and managed.delta_engine.turn_count:
+            merkle_root = digests_to_hex(roots[:1])[0]
+            host_root = managed.delta_engine.compute_merkle_root()
+            if host_root != merkle_root:
+                raise RuntimeError(
+                    f"audit divergence for {session_id}: device root "
+                    f"{merkle_root} != host root {host_root}"
                 )
-                self._emit(
-                    EventType.AUDIT_COMMITTED,
-                    session_id=session_id,
-                    payload={"merkle_root": merkle_root},
-                )
+            self.commitment.commit_device_root(
+                session_id=session_id,
+                root_words=roots[0],
+                participant_dids=[p.agent_did for p in managed.sso.participants],
+                delta_count=managed.delta_engine.turn_count,
+            )
+            self._emit(
+                EventType.AUDIT_COMMITTED,
+                session_id=session_id,
+                payload={"merkle_root": merkle_root},
+            )
 
         self.vouching.release_session_bonds(session_id)
 

@@ -77,14 +77,27 @@ def admit_batch(
     duplicate: jnp.ndarray,     # bool[B] host-known membership clash
     now: jnp.ndarray | float,
     trust: TrustConfig = DEFAULT_CONFIG.trust,
+    contribution: jnp.ndarray | None = None,  # f32[B] bonded sigma toward each agent
+    omega: jnp.ndarray | float = 0.0,
 ) -> AdmissionResult:
-    """Admit a wave of B agents; rejected elements leave no trace."""
+    """Admit a wave of B agents; rejected elements leave no trace.
+
+    With `contribution` (vouched sigma toward each joining agent, from
+    `ops.liability.voucher_contribution`), sigma_eff = min(sigma_raw +
+    omega * contribution, 1.0) — the joint-liability formula
+    (`liability/vouching.py:128-151`) applied in the admission wave.
+    """
     sess_state = sessions.state[session_slot]
     sess_count = sessions.n_participants[session_slot]
     sess_max = sessions.max_participants[session_slot]
     sess_min_sigma = sessions.min_sigma_eff[session_slot]
 
-    sigma_eff = sigma_raw
+    if contribution is None:
+        sigma_eff = sigma_raw
+    else:
+        sigma_eff = jnp.minimum(
+            sigma_raw + jnp.asarray(omega, jnp.float32) * contribution, 1.0
+        )
     ring = ring_ops.compute_rings(sigma_eff, False, trust)
     ring = jnp.where(trustworthy, ring, jnp.int8(3))
 

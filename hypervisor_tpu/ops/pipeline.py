@@ -29,9 +29,14 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from hypervisor_tpu.config import DEFAULT_CONFIG, TrustConfig
+from hypervisor_tpu.models import SessionState
+from hypervisor_tpu.ops import admission as admission_ops
 from hypervisor_tpu.ops import merkle as merkle_ops
 from hypervisor_tpu.ops import rings as ring_ops
 from hypervisor_tpu.ops import saga_ops
+from hypervisor_tpu.ops import session_fsm
+from hypervisor_tpu.tables.state import AgentTable, FLAG_ACTIVE, SessionTable, VouchTable
+from hypervisor_tpu.tables.struct import replace
 
 # Per-lane status codes for the batched pipeline (host may re-raise).
 PIPE_OK = 0
@@ -87,10 +92,14 @@ def governance_pipeline(
     )
     ok = status == PIPE_OK
 
-    # ── 2. session FSM forward walk (masked column updates) ─────────
+    # ── 2. session FSM forward walk, legality-gated per step ─────────
     state = jnp.full((s,), S_CREATED, jnp.int8)
-    state = jnp.where(ok, S_HANDSHAKING, state).astype(jnp.int8)  # begin_handshake
-    state = jnp.where(ok, S_ACTIVE, state).astype(jnp.int8)       # activate (1 participant)
+    state, _ = session_fsm.apply_session_transitions(
+        state, jnp.int8(S_HANDSHAKING), ok
+    )  # begin_handshake
+    state, _ = session_fsm.apply_session_transitions(
+        state, jnp.int8(S_ACTIVE), ok
+    )  # activate (1 participant admitted)
 
     # ── 3. audit: chain-hash T deltas per lane, then Merkle root ─────
     digests = merkle_ops.chain_digests(
@@ -109,9 +118,13 @@ def governance_pipeline(
         step_state, success=ok, retries_left=jnp.zeros((s,), jnp.int8)
     )
 
-    # ── 5. terminate + archive ───────────────────────────────────────
-    state = jnp.where(ok, S_TERMINATING, state).astype(jnp.int8)
-    state = jnp.where(ok, S_ARCHIVED, state).astype(jnp.int8)
+    # ── 5. terminate + archive (legality-gated) ──────────────────────
+    state, _ = session_fsm.apply_session_transitions(
+        state, jnp.int8(S_TERMINATING), ok
+    )
+    state, _ = session_fsm.apply_session_transitions(
+        state, jnp.int8(S_ARCHIVED), ok
+    )
 
     # ── consensus aggregates (STRONG mode: psum'd over the mesh in
     #    parallel.collectives.strong_tick) ─────────────────────────────
@@ -133,4 +146,164 @@ def governance_pipeline(
         merkle_root=roots,
         status=status,
         consensus=consensus,
+    )
+
+
+class WaveResult(NamedTuple):
+    """One full-pipeline wave over the REAL state tables."""
+
+    agents: AgentTable
+    sessions: SessionTable
+    vouches: VouchTable
+    status: jnp.ndarray         # i8[B] admission status per joining agent
+    ring: jnp.ndarray           # i8[B]
+    sigma_eff: jnp.ndarray      # f32[B] (includes vouched contributions)
+    saga_step_state: jnp.ndarray  # i8[B]
+    merkle_root: jnp.ndarray    # u32[K, 8] per wave session
+    chain: jnp.ndarray          # u32[T, K, 8] the delta chain digests
+    fsm_error: jnp.ndarray      # bool[K] illegal session walks (none expected)
+    released: jnp.ndarray       # i32 bonds released at terminate
+
+
+def governance_wave(
+    agents: AgentTable,
+    sessions: SessionTable,
+    vouches: VouchTable,
+    slot: jnp.ndarray,          # i32[B] preallocated agent rows
+    did: jnp.ndarray,           # i32[B]
+    session_slot: jnp.ndarray,  # i32[B] target session per joining agent
+    sigma_raw: jnp.ndarray,     # f32[B]
+    trustworthy: jnp.ndarray,   # bool[B]
+    duplicate: jnp.ndarray,     # bool[B]
+    wave_sessions: jnp.ndarray, # i32[K] sessions that live+die this wave
+    delta_bodies: jnp.ndarray,  # u32[T, K, BODY_WORDS]
+    now: jnp.ndarray | float,
+    omega: jnp.ndarray | float = 0.5,
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+    use_pallas: bool | None = None,
+) -> WaveResult:
+    """The full governance pipeline AS ONE PROGRAM over the state tables.
+
+    Unlike `governance_pipeline` (loose arrays, bench-shaped), every
+    phase here reads and writes the authoritative tables:
+
+      1. vouched sigma_eff — bonded contributions gathered from the
+         VouchTable (`liability/vouching.py:128-151`), so vouched agents
+         can clear higher rings than their raw sigma allows,
+      2. the admission wave (`ops.admission.admit_batch`) onto the
+         Agent/Session tables,
+      3. session FSM walk HANDSHAKING -> ACTIVE, legality-gated by the
+         transition matrix,
+      4. audit: chained SHA-256 delta digests + per-session Merkle roots,
+      5. one saga step through the retry ladder,
+      6. terminate: session-scoped bond release, participant
+         deactivation, ACTIVE -> TERMINATING -> ARCHIVED walk.
+    """
+    n_cap = agents.did.shape[0]
+    now_f = jnp.asarray(now, jnp.float32)
+
+    # ── 1. vouched contributions toward each joining agent ───────────
+    # Wave agents are not in the tables yet: scope each live edge to the
+    # session its vouchee is joining in THIS wave.
+    target_session = jnp.full((n_cap,), -2, jnp.int32).at[slot].set(session_slot)
+    live = vouches.active & (now_f <= vouches.expiry)
+    vee = jnp.clip(vouches.vouchee, 0)
+    edge_scoped = (
+        live
+        & (vouches.vouchee >= 0)
+        & (vouches.session == target_session[vee])
+    )
+    contrib_by_slot = jnp.zeros((n_cap,), jnp.float32).at[vee].add(
+        jnp.where(edge_scoped, vouches.bond, 0.0)
+    )
+    contribution = contrib_by_slot[slot]
+
+    # ── 2. admission onto the tables ─────────────────────────────────
+    admitted = admission_ops.admit_batch(
+        agents,
+        sessions,
+        slot,
+        did,
+        session_slot,
+        sigma_raw,
+        trustworthy,
+        duplicate,
+        now_f,
+        trust,
+        contribution=contribution,
+        omega=omega,
+    )
+    agents, sessions = admitted.agents, admitted.sessions
+    ok = admitted.status == admission_ops.ADMIT_OK
+
+    # ── 3. session FSM: HANDSHAKING -> ACTIVE where populated ────────
+    k_sessions = wave_sessions
+    wave_state = sessions.state[k_sessions]
+    has_members = sessions.n_participants[k_sessions] > 0
+    wave_state, err_a = session_fsm.apply_session_transitions(
+        wave_state, jnp.int8(SessionState.ACTIVE.code), has_members
+    )
+
+    # ── 4. audit: chain + per-session Merkle roots ───────────────────
+    t = delta_bodies.shape[0]
+    chain = merkle_ops.chain_digests(delta_bodies, use_pallas=use_pallas)
+    p = 1 << max(0, (t - 1).bit_length())
+    k = k_sessions.shape[0]
+    leaves = jnp.zeros((k, p, 8), jnp.uint32)
+    leaves = leaves.at[:, :t].set(jnp.transpose(chain, (1, 0, 2)))
+    roots = merkle_ops.merkle_root_lanes(
+        leaves, jnp.int32(t), use_pallas=use_pallas
+    )
+
+    # ── 5. one saga step per joining agent ───────────────────────────
+    step_state = jnp.full(slot.shape, saga_ops.STEP_PENDING, jnp.int8)
+    step_state, _ = saga_ops.execute_attempt(
+        step_state, success=ok, retries_left=jnp.zeros(slot.shape, jnp.int8)
+    )
+
+    # ── 6. terminate: bonds, participants, FSM walk ──────────────────
+    in_wave = jnp.zeros((sessions.sid.shape[0],), bool).at[
+        jnp.clip(k_sessions, 0)
+    ].set(True)
+    edge_hit = vouches.active & jnp.where(
+        vouches.session >= 0, in_wave[jnp.clip(vouches.session, 0)], False
+    )
+    vouches = replace(vouches, active=vouches.active & ~edge_hit)
+
+    agent_hit = jnp.where(
+        agents.session >= 0, in_wave[jnp.clip(agents.session, 0)], False
+    )
+    agents = replace(
+        agents,
+        flags=jnp.where(
+            agent_hit, agents.flags & ~FLAG_ACTIVE, agents.flags
+        ).astype(agents.flags.dtype),
+    )
+
+    wave_state, err_t = session_fsm.apply_session_transitions(
+        wave_state, jnp.int8(SessionState.TERMINATING.code), has_members
+    )
+    wave_state, err_z = session_fsm.apply_session_transitions(
+        wave_state, jnp.int8(SessionState.ARCHIVED.code), has_members
+    )
+    sessions = replace(
+        sessions,
+        state=sessions.state.at[k_sessions].set(wave_state),
+        terminated_at=sessions.terminated_at.at[k_sessions].set(
+            jnp.where(has_members, now_f, sessions.terminated_at[k_sessions])
+        ),
+    )
+
+    return WaveResult(
+        agents=agents,
+        sessions=sessions,
+        vouches=vouches,
+        status=admitted.status,
+        ring=admitted.ring,
+        sigma_eff=admitted.sigma_eff,
+        saga_step_state=step_state,
+        merkle_root=roots,
+        chain=chain,
+        fsm_error=err_a | err_t | err_z,
+        released=jnp.sum(edge_hit.astype(jnp.int32)),
     )

@@ -125,6 +125,109 @@ def settle_sagas(step_state: jnp.ndarray, saga_state: jnp.ndarray) -> jnp.ndarra
     return out.astype(saga_state.dtype)
 
 
+def saga_table_tick(
+    step_state: jnp.ndarray,    # i8[G, M]
+    retries_left: jnp.ndarray,  # i8[G, M]
+    has_undo: jnp.ndarray,      # bool[G, M]
+    saga_state: jnp.ndarray,    # i8[G]
+    n_steps: jnp.ndarray,       # i32[G]
+    cursor: jnp.ndarray,        # i32[G]
+    exec_success: jnp.ndarray,  # bool[G] outcome for each saga's cursor step
+    undo_success: jnp.ndarray,  # bool[G] outcome for the compensation target
+):
+    """Advance EVERY saga in the table by one scheduling round.
+
+    Forward phase (RUNNING sagas, reference `saga/orchestrator.py:104-138`):
+    the cursor step books its executor outcome — COMMITTED on success
+    (cursor advances), retry (stay PENDING, retries_left-1) while retries
+    remain, else FAILED and the saga flips to COMPENSATING.
+
+    Compensation phase (COMPENSATING sagas, `orchestrator.py:145-198`):
+    the highest-index COMMITTED step is the target — reverse commit
+    order. No undo API => COMPENSATION_FAILED immediately; with an undo,
+    the outcome decides COMPENSATED / COMPENSATION_FAILED. When no
+    COMMITTED steps remain the saga settles: ESCALATED if any step
+    failed compensation ("Joint Liability slashing triggered"), else
+    COMPLETED. RUNNING sagas whose cursor passed the last step COMPLETE.
+
+    Returns (step_state, retries_left, saga_state, cursor) updated.
+    """
+    g, m = step_state.shape
+    rows = jnp.arange(g, dtype=jnp.int32)
+    cols = jnp.arange(m, dtype=jnp.int32)[None, :]
+
+    running = saga_state == SAGA_RUNNING
+    # Compensation acts only on sagas that entered this round already
+    # COMPENSATING: the host ran undo executors for exactly those, so a
+    # saga that flips mid-round waits for outcomes until the next round.
+    compensating = saga_state == SAGA_COMPENSATING
+    in_range = cursor < n_steps
+
+    # ── forward: book the cursor step's outcome ──────────────────────────
+    cur = jnp.clip(cursor, 0, m - 1)
+    cur_state = step_state[rows, cur]
+    attempt = running & in_range & (cur_state == STEP_PENDING)
+    committed = attempt & exec_success
+    exhausted = attempt & ~exec_success & (retries_left[rows, cur] <= 0)
+    retrying = attempt & ~exec_success & (retries_left[rows, cur] > 0)
+
+    new_cur_state = jnp.where(
+        committed,
+        STEP_COMMITTED,
+        jnp.where(exhausted, STEP_FAILED, cur_state),
+    ).astype(step_state.dtype)
+    step_state = step_state.at[rows, cur].set(new_cur_state)
+    retries_left = retries_left.at[rows, cur].add(
+        jnp.where(retrying, -1, 0).astype(retries_left.dtype)
+    )
+    cursor = jnp.where(committed, cursor + 1, cursor)
+
+    # Saga-level consequences of the forward phase.
+    finished = running & (cursor >= n_steps) & (n_steps > 0)
+    saga_state = jnp.where(
+        exhausted,
+        SAGA_COMPENSATING,
+        jnp.where(finished, SAGA_COMPLETED, saga_state),
+    ).astype(saga_state.dtype)
+
+    # ── compensation: undo the highest-index COMMITTED step ──────────────
+    is_committed = step_state == STEP_COMMITTED
+    # Highest committed column per saga (-1 when none remain).
+    target = jnp.max(jnp.where(is_committed, cols, -1), axis=1)
+    has_target = compensating & (target >= 0)
+    tcol = jnp.clip(target, 0, m - 1)
+    undo_ok = has_target & has_undo[rows, tcol] & undo_success
+    step_state = step_state.at[rows, tcol].set(
+        jnp.where(
+            undo_ok,
+            STEP_COMPENSATED,
+            jnp.where(has_target, STEP_COMPENSATION_FAILED, step_state[rows, tcol]),
+        ).astype(step_state.dtype)
+    )
+
+    # Settle compensating sagas once nothing is left to undo.
+    still_committed = jnp.any(step_state == STEP_COMMITTED, axis=1)
+    any_comp_failed = jnp.any(step_state == STEP_COMPENSATION_FAILED, axis=1)
+    settled = compensating & ~still_committed
+    saga_state = jnp.where(
+        settled & any_comp_failed,
+        SAGA_ESCALATED,
+        jnp.where(settled, SAGA_COMPLETED, saga_state),
+    ).astype(saga_state.dtype)
+
+    return step_state, retries_left, saga_state, cursor
+
+
+def saga_table_done(saga_state: jnp.ndarray, session: jnp.ndarray) -> jnp.ndarray:
+    """bool[G]: sagas in a terminal state (free rows count as done)."""
+    terminal = (
+        (saga_state == SAGA_COMPLETED)
+        | (saga_state == SAGA_FAILED)
+        | (saga_state == SAGA_ESCALATED)
+    )
+    return terminal | (session < 0)
+
+
 def fanout_policy_check(
     success: jnp.ndarray, valid: jnp.ndarray, policy: jnp.ndarray
 ) -> jnp.ndarray:

@@ -1,0 +1,52 @@
+"""Batched session lifecycle FSM: matrix-validated state walks.
+
+The reference guards session transitions with an in-method state check
+(`session/__init__.py:66-71` `_assert_state`); here legality is a
+boolean matrix gather so a whole wave of sessions advances in one op,
+with illegal transitions surfacing as an error mask instead of
+exceptions (the facade re-raises for the single-call API).
+
+Legal walk (reference `session/__init__.py:73-145`):
+CREATED -> HANDSHAKING -> ACTIVE -> TERMINATING -> ARCHIVED, with
+termination allowed straight from HANDSHAKING too.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from hypervisor_tpu.models import SessionState
+
+_CODES = {s: s.code for s in SessionState}
+
+# matrix[from, to] == 1 iff legal.
+SESSION_TRANSITION_MATRIX = np.zeros((5, 5), np.uint8)
+for _frm, _tos in {
+    SessionState.CREATED: (SessionState.HANDSHAKING,),
+    SessionState.HANDSHAKING: (SessionState.ACTIVE, SessionState.TERMINATING),
+    SessionState.ACTIVE: (SessionState.TERMINATING,),
+    SessionState.TERMINATING: (SessionState.ARCHIVED,),
+}.items():
+    for _to in _tos:
+        SESSION_TRANSITION_MATRIX[_CODES[_frm], _CODES[_to]] = 1
+
+
+def session_transition_valid(frm: jnp.ndarray, to: jnp.ndarray) -> jnp.ndarray:
+    """bool[...]: legality of each session transition (matrix gather)."""
+    m = jnp.asarray(SESSION_TRANSITION_MATRIX)
+    return m[frm.astype(jnp.int32), to.astype(jnp.int32)] == 1
+
+
+def apply_session_transitions(
+    state: jnp.ndarray, target: jnp.ndarray, select: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Advance selected sessions to `target` where legal.
+
+    Returns (new_state, error_mask); error_mask flags selected sessions
+    whose walk was illegal — those keep their state.
+    """
+    ok = session_transition_valid(state, target)
+    apply = select & ok
+    new_state = jnp.where(apply, target, state).astype(state.dtype)
+    return new_state, select & ~ok

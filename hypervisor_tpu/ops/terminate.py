@@ -1,0 +1,97 @@
+"""Batched session termination: Merkle commit + bond release + archive.
+
+The reference terminates one session at a time through Python
+(`core.py:192-227`: terminate -> Merkle root -> commitment -> bond
+release -> GC -> archive). Here a wave of K sessions terminates in one
+jitted op over the device tables:
+
+  * per-session Merkle roots over the sessions' audit leaves
+    (`ops.merkle.merkle_root_lanes` — bit-identical to the host tree),
+  * vouch bonds scoped to the wave's sessions released in one mask
+    (`liability/vouching.py:176-184` semantics),
+  * participants deactivated and session rows walked
+    TERMINATING -> ARCHIVED as masked column updates.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from hypervisor_tpu.models import SessionState
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.tables.state import (
+    AgentTable,
+    FLAG_ACTIVE,
+    SessionTable,
+    VouchTable,
+)
+from hypervisor_tpu.tables.struct import replace
+
+
+class TerminateResult(NamedTuple):
+    agents: AgentTable
+    sessions: SessionTable
+    vouches: VouchTable
+    roots: jnp.ndarray       # u32[K, 8] per-session Merkle roots
+    released: jnp.ndarray    # i32 number of bonds released
+
+
+def terminate_batch(
+    agents: AgentTable,
+    sessions: SessionTable,
+    vouches: VouchTable,
+    session_slots: jnp.ndarray,  # i32[K] wave of sessions to terminate
+    leaves: jnp.ndarray,         # u32[K, P, 8] audit leaf digests per session
+    leaf_counts: jnp.ndarray,    # i32[K] valid leaves per session
+    now: jnp.ndarray | float,
+    use_pallas: bool | None = None,
+) -> TerminateResult:
+    """Terminate a wave of K sessions in one device program."""
+    s_cap = sessions.sid.shape[0]
+    now_f = jnp.asarray(now, jnp.float32)
+
+    # ── audit: per-session Merkle roots (zeros where no deltas) ─────────
+    roots = merkle_ops.merkle_root_lanes(leaves, leaf_counts, use_pallas)
+    roots = jnp.where((leaf_counts > 0)[:, None], roots, jnp.uint32(0))
+
+    # ── wave membership mask over the session axis ──────────────────────
+    in_wave = (
+        jnp.zeros((s_cap,), bool).at[jnp.clip(session_slots, 0)].set(True)
+    )
+
+    # ── bond release: every edge scoped to a wave session goes inactive ──
+    edge_hit = vouches.active & jnp.where(
+        vouches.session >= 0, in_wave[jnp.clip(vouches.session, 0)], False
+    )
+    new_vouches = replace(vouches, active=vouches.active & ~edge_hit)
+
+    # ── participants deactivate ──────────────────────────────────────────
+    agent_hit = jnp.where(
+        agents.session >= 0, in_wave[jnp.clip(agents.session, 0)], False
+    )
+    new_agents = replace(
+        agents,
+        flags=jnp.where(
+            agent_hit, agents.flags & ~FLAG_ACTIVE, agents.flags
+        ).astype(agents.flags.dtype),
+    )
+
+    # ── session FSM: TERMINATING then ARCHIVED, stamped ──────────────────
+    archived = jnp.int8(SessionState.ARCHIVED.code)
+    new_sessions = replace(
+        sessions,
+        state=jnp.where(in_wave, archived, sessions.state).astype(jnp.int8),
+        terminated_at=jnp.where(
+            in_wave, now_f, sessions.terminated_at
+        ).astype(jnp.float32),
+    )
+
+    return TerminateResult(
+        agents=new_agents,
+        sessions=new_sessions,
+        vouches=new_vouches,
+        roots=roots,
+        released=jnp.sum(edge_hit.astype(jnp.int32)),
+    )

@@ -32,12 +32,18 @@ from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.state import HypervisorState
 from hypervisor_tpu.tables.intern import InternTable
 from hypervisor_tpu.tables.logs import DeltaLog, EventLog
-from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.state import (
+    AgentTable,
+    SagaTable,
+    SessionTable,
+    VouchTable,
+)
 
 _TABLE_TYPES = {
     "agents": AgentTable,
     "sessions": SessionTable,
     "vouches": VouchTable,
+    "sagas": SagaTable,
     "delta_log": DeltaLog,
     "event_log": EventLog,
 }
@@ -80,9 +86,17 @@ def host_metadata(state: HypervisorState) -> dict:
     return {
         "agent_ids": _intern_dump(state.agent_ids),
         "session_ids": _intern_dump(state.session_ids),
+        "saga_ids": _intern_dump(state.saga_ids),
         "next_agent_slot": state._next_agent_slot,
         "next_session_slot": state._next_session_slot,
+        "next_saga_slot": state._next_saga_slot,
+        "next_edge_slot": state._next_edge_slot,
         "members": sorted([list(k) for k in state._members]),
+        "audit_rows": {str(k): v for k, v in state._audit_rows.items()},
+        "chain_seed": {
+            str(k): [int(w) for w in v] for k, v in state._chain_seed.items()
+        },
+        "turns": {str(k): v for k, v in state._turns.items()},
         # Capacity fields are validated at restore: array shapes come from
         # the npz while slot allocation uses the live config, so a
         # capacity mismatch must fail loudly, not corrupt silently.
@@ -116,6 +130,11 @@ def save_state(
         raise RuntimeError(
             f"cannot checkpoint with {len(state._pending)} staged joins; "
             "call flush_joins() first"
+        )
+    if state._pending_deltas:
+        raise RuntimeError(
+            f"cannot checkpoint with {len(state._pending_deltas)} staged "
+            "deltas; call flush_deltas() first"
         )
     directory = Path(directory)
     target = directory / (f"step_{step}" if step is not None else "latest")
@@ -178,9 +197,20 @@ def restore_state(
 
     state.agent_ids = _intern_load(meta["agent_ids"])
     state.session_ids = _intern_load(meta["session_ids"])
+    state.saga_ids = _intern_load(meta.get("saga_ids", []))
     state._next_agent_slot = int(meta["next_agent_slot"])
     state._next_session_slot = int(meta["next_session_slot"])
+    state._next_saga_slot = int(meta.get("next_saga_slot", 0))
+    state._next_edge_slot = int(meta.get("next_edge_slot", 0))
     state._members = {(int(a), int(b)): True for a, b in meta["members"]}
+    state._audit_rows = {
+        int(k): [int(r) for r in v] for k, v in meta.get("audit_rows", {}).items()
+    }
+    state._chain_seed = {
+        int(k): np.array(v, np.uint32)
+        for k, v in meta.get("chain_seed", {}).items()
+    }
+    state._turns = {int(k): int(v) for k, v in meta.get("turns", {}).items()}
     return state
 
 

@@ -1,0 +1,116 @@
+"""Host asyncio shim driving real executors against the device SagaTable.
+
+The reference awaits one step at a time inside its orchestrator
+(`saga/orchestrator.py:104-143`); here the device table is the state
+machine and the host only supplies executor outcomes: each round,
+`HypervisorState.saga_work()` names the cursor steps (forward) and
+reverse-order compensation targets, this scheduler awaits ALL of their
+executors concurrently under their per-step timeouts, and one jitted
+`saga_round` books every outcome at once. Stub-executor benchmarks have
+no Python in the device loop; real deployments get genuine asyncio
+timeouts and linear retry backoff.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable, Optional
+
+import numpy as np
+
+from hypervisor_tpu.state import HypervisorState
+
+Executor = Callable[[], Awaitable[Any]]
+
+
+class SagaScheduler:
+    """Batched saga driver: executors keyed by (saga_slot, step_idx)."""
+
+    def __init__(
+        self,
+        state: HypervisorState,
+        retry_backoff_seconds: float = 1.0,
+    ) -> None:
+        self._state = state
+        self._backoff = retry_backoff_seconds
+        self._execute: dict[tuple[int, int], Executor] = {}
+        self._undo: dict[tuple[int, int], Executor] = {}
+        self._attempts: dict[tuple[int, int], int] = {}
+        self.results: dict[tuple[int, int], Any] = {}
+        self.errors: dict[tuple[int, int], str] = {}
+
+    def register(
+        self,
+        saga_slot: int,
+        step_idx: int,
+        execute: Executor,
+        undo: Optional[Executor] = None,
+    ) -> None:
+        self._execute[(saga_slot, step_idx)] = execute
+        if undo is not None:
+            self._undo[(saga_slot, step_idx)] = undo
+
+    async def run_until_settled(self, max_rounds: int = 1000) -> None:
+        """Round-run the table until every saga reaches a terminal state."""
+        state = self._state
+        for _ in range(max_rounds):
+            if state.sagas_settled():
+                return
+            execute, compensate = state.saga_work()
+            timeouts = np.asarray(state.sagas.timeout)
+
+            exec_out = dict(
+                zip(
+                    (slot for slot, _ in execute),
+                    await asyncio.gather(
+                        *(
+                            self._attempt(self._execute.get((slot, idx)), slot, idx, timeouts)
+                            for slot, idx in execute
+                        )
+                    ),
+                )
+            )
+            undo_out = dict(
+                zip(
+                    (slot for slot, _ in compensate),
+                    await asyncio.gather(
+                        *(
+                            self._attempt(self._undo.get((slot, idx)), slot, idx, timeouts, undo=True)
+                            for slot, idx in compensate
+                        )
+                    ),
+                )
+            )
+            state.saga_round(exec_out, undo_out)
+        raise RuntimeError(f"sagas not settled after {max_rounds} rounds")
+
+    async def _attempt(
+        self,
+        executor: Optional[Executor],
+        slot: int,
+        idx: int,
+        timeouts,
+        undo: bool = False,
+    ) -> bool:
+        """Run one executor under its timeout; outcomes are data."""
+        key = (slot, idx)
+        if executor is None:
+            # A compensation target with no undo API must fail
+            # (reference `orchestrator.py:166-170`); a forward step with
+            # no registered executor is a wiring error surfaced as failure.
+            self.errors[key] = "No undo API" if undo else "No executor"
+            return False
+        attempt = self._attempts.get(key, 0)
+        if attempt and not undo:
+            # Linear backoff between retries (`orchestrator.py:135-137`).
+            await asyncio.sleep(self._backoff * attempt)
+        self._attempts[key] = attempt + 1
+        try:
+            timeout = float(timeouts[slot, idx])
+            result = await asyncio.wait_for(executor(), timeout=timeout)
+        except Exception as exc:  # noqa: BLE001 — outcomes are data
+            self.errors[key] = str(exc)
+            return False
+        if not undo:
+            self.results[key] = result
+        return True

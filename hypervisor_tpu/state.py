@@ -2,14 +2,25 @@
 
 Host side: interning, membership dicts, free-slot allocation, the native
 staging queue. Device side: the AgentTable / SessionTable / VouchTable /
-logs as jit-carried pytrees. Single calls enqueue; `flush()` runs the
-jitted admission wave. This is the 10k-concurrent-agent execution path the
-facade (`core.Hypervisor`) mirrors one call at a time.
+SagaTable / logs as jit-carried pytrees. Single calls enqueue; the flush
+methods run the jitted waves:
+
+  * `flush_joins`        — the admission wave (`ops.admission`)
+  * `flush_deltas`       — delta capture into the DeltaLog ring buffer
+                           (`ops.merkle.pack_delta_bodies` + chain scan)
+  * `saga_round`         — one scheduling round over the whole SagaTable
+                           (`ops.saga_ops.saga_table_tick`)
+  * `terminate_sessions` — Merkle commit + bond release + archive wave
+                           (`ops.terminate.terminate_batch`)
+
+This is the 10k-concurrent-agent execution path; the facade
+(`core.Hypervisor`) routes through it so host engines and device tables
+share one source of truth.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence
 
 import numpy as np
 import jax
@@ -17,12 +28,26 @@ import jax.numpy as jnp
 
 from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.models import SessionConfig, SessionState
-from hypervisor_tpu.ops import admission
+from hypervisor_tpu.ops import admission, saga_ops
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.ops import pipeline as pipeline_ops
+from hypervisor_tpu.ops import terminate as terminate_ops
 from hypervisor_tpu.tables.intern import InternTable
 from hypervisor_tpu.tables.logs import DeltaLog, EventLog
-from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.state import (
+    AgentTable,
+    SagaTable,
+    SessionTable,
+    VouchTable,
+)
 from hypervisor_tpu.tables.struct import replace
 from hypervisor_tpu.runtime import StagingQueue
+
+
+_ADMIT = jax.jit(admission.admit_batch)
+_SAGA_TICK = jax.jit(saga_ops.saga_table_tick)
+_TERMINATE = jax.jit(terminate_ops.terminate_batch, static_argnames=("use_pallas",))
+_WAVE = jax.jit(pipeline_ops.governance_wave, static_argnames=("use_pallas",))
 
 
 class HypervisorState:
@@ -34,25 +59,49 @@ class HypervisorState:
         self.agents = AgentTable.create(cap.max_agents)
         self.sessions = SessionTable.create(cap.max_sessions)
         self.vouches = VouchTable.create(cap.max_vouch_edges)
+        self.sagas = SagaTable.create(cap.max_sagas, cap.max_steps_per_saga)
         self.delta_log = DeltaLog.create(cap.delta_log_capacity)
         self.event_log = EventLog.create(cap.event_log_capacity)
 
         self.agent_ids = InternTable()
         self.session_ids = InternTable()
+        self.saga_ids = InternTable()
         self._next_agent_slot = 0
         self._next_session_slot = 0
+        self._next_saga_slot = 0
+        self._next_edge_slot = 0
         self._members: dict[tuple[int, int], bool] = {}  # (session, did) -> True
+        self._slot_of_did: dict[int, int] = {}           # did handle -> agent slot
 
         # Pending join wave (native lock-free queue + parallel slot/did rows).
         self._queue = StagingQueue(capacity=cap.max_agents)
         self._pending: list[tuple[int, int, int, bool]] = []  # slot, did, sess, dup
 
-        self._admit = jax.jit(admission.admit_batch)
+        # Pending delta wave + per-session audit index into the DeltaLog.
+        # sess -> list of log rows; chain seed u32[8]; turn counter.
+        self._pending_deltas: list[tuple[int, int, np.ndarray, float, np.ndarray | None]] = []
+        self._audit_rows: dict[int, list[int]] = {}
+        self._chain_seed: dict[int, np.ndarray] = {}
+        self._turns: dict[int, int] = {}
+        # Ring-buffer row ownership: when the DeltaLog wraps, the sessions
+        # whose rows get recycled must drop them from their audit index.
+        self._row_session = np.full(cap.delta_log_capacity, -1, np.int32)
+
+        # Module-level jit wrappers: every HypervisorState shares one trace
+        # cache instead of recompiling per instance.
+        self._admit = _ADMIT
+        self._saga_tick = _SAGA_TICK
+        self._terminate = _TERMINATE
 
     # ── sessions ─────────────────────────────────────────────────────
 
     def create_session(self, session_id: str, config: SessionConfig) -> int:
         """Allocate a session row in HANDSHAKING state; returns the slot."""
+        if self._next_session_slot >= self.sessions.sid.shape[0]:
+            raise RuntimeError(
+                f"session table full ({self.sessions.sid.shape[0]}); "
+                "raise config.capacity.max_sessions"
+            )
         slot = self._next_session_slot
         self._next_session_slot += 1
         sid = self.session_ids.intern(session_id)
@@ -73,6 +122,141 @@ class HypervisorState:
         )
         return slot
 
+    def create_sessions_batch(
+        self, session_ids: Sequence[str], config: SessionConfig
+    ) -> np.ndarray:
+        """Allocate K session rows in HANDSHAKING in one device op."""
+        k = len(session_ids)
+        base = self._next_session_slot
+        if base + k > self.sessions.sid.shape[0]:
+            raise RuntimeError(
+                f"session table full: {base} + {k} > "
+                f"{self.sessions.sid.shape[0]}; raise "
+                "config.capacity.max_sessions"
+            )
+        self._next_session_slot += k
+        slots = np.arange(base, base + k, dtype=np.int32)
+        sids = np.array(
+            [self.session_ids.intern(s) for s in session_ids], np.int32
+        )
+        sl = jnp.asarray(slots)
+        self.sessions = replace(
+            self.sessions,
+            sid=self.sessions.sid.at[sl].set(jnp.asarray(sids)),
+            state=self.sessions.state.at[sl].set(
+                jnp.int8(SessionState.HANDSHAKING.code)
+            ),
+            mode=self.sessions.mode.at[sl].set(
+                jnp.int8(config.consistency_mode.code)
+            ),
+            max_participants=self.sessions.max_participants.at[sl].set(
+                config.max_participants
+            ),
+            min_sigma_eff=self.sessions.min_sigma_eff.at[sl].set(
+                config.min_sigma_eff
+            ),
+            enable_audit=self.sessions.enable_audit.at[sl].set(
+                config.enable_audit
+            ),
+        )
+        return slots
+
+    def run_governance_wave(
+        self,
+        session_slots: np.ndarray,     # i32[K] freshly created sessions
+        dids: Sequence[str],           # B joining agents
+        agent_sessions: np.ndarray,    # i32[B] target session per agent
+        sigma_raw: np.ndarray,         # f32[B]
+        delta_bodies: np.ndarray,      # u32[T, K, BODY_WORDS]
+        now: float = 0.0,
+        omega: float = 0.5,
+        trustworthy: Optional[np.ndarray] = None,
+        use_pallas: bool | None = None,
+    ):
+        """Run the fused full-pipeline wave ON the state tables.
+
+        Stages B joins (interning + slot allocation on host), then ONE
+        jitted program does vouched admission, FSM walk, audit chains +
+        Merkle roots, a saga step, and termination with bond release —
+        reading and writing this state's actual tables. Returns the
+        WaveResult; tables, membership, and the DeltaLog are updated.
+        """
+        b = len(dids)
+        if self._next_agent_slot + b > self.agents.did.shape[0]:
+            raise RuntimeError(
+                f"agent table full: {self._next_agent_slot} + {b} > "
+                f"{self.agents.did.shape[0]}; raise config.capacity.max_agents"
+            )
+        agent_slots = np.arange(
+            self._next_agent_slot, self._next_agent_slot + b, dtype=np.int32
+        )
+        self._next_agent_slot += b
+        handles = np.array([self.agent_ids.intern(d) for d in dids], np.int32)
+        duplicate = np.array(
+            [
+                (int(s), int(h)) in self._members
+                for s, h in zip(agent_sessions, handles)
+            ],
+            bool,
+        )
+        if trustworthy is None:
+            trustworthy = np.ones(b, bool)
+
+        result = _WAVE(
+            self.agents,
+            self.sessions,
+            self.vouches,
+            jnp.asarray(agent_slots),
+            jnp.asarray(handles),
+            jnp.asarray(np.asarray(agent_sessions, np.int32)),
+            jnp.asarray(np.asarray(sigma_raw, np.float32)),
+            jnp.asarray(trustworthy),
+            jnp.asarray(duplicate),
+            jnp.asarray(np.asarray(session_slots, np.int32)),
+            jnp.asarray(delta_bodies),
+            now,
+            omega,
+            use_pallas=use_pallas,
+        )
+        self.agents = result.agents
+        self.sessions = result.sessions
+        self.vouches = result.vouches
+
+        ok = np.asarray(result.status) == admission.ADMIT_OK
+        for s, h, slot, is_ok in zip(agent_sessions, handles, agent_slots, ok):
+            if is_ok:
+                self._members[(int(s), int(h))] = True
+                self._slot_of_did[int(h)] = int(slot)
+
+        # Record the wave's audit chain in the DeltaLog (lane-major).
+        chain = np.asarray(result.chain)  # [T, K, 8]
+        t, k = chain.shape[:2]
+        if t:
+            sess_rep = np.repeat(np.asarray(session_slots, np.int32), t)
+            turns_rep = np.tile(np.arange(t, dtype=np.int32), k)
+            bodies_flat = np.transpose(delta_bodies, (1, 0, 2)).reshape(
+                k * t, -1
+            )
+            digests_flat = np.transpose(chain, (1, 0, 2)).reshape(k * t, 8)
+            base_row = int(np.asarray(self.delta_log.cursor))
+            capacity = self.delta_log.body.shape[0]
+            self.delta_log = self.delta_log.append_batch(
+                jnp.asarray(bodies_flat),
+                jnp.asarray(digests_flat),
+                jnp.asarray(sess_rep),
+                jnp.asarray(turns_rep),
+            )
+            rows = (base_row + np.arange(k * t)) % capacity
+            self._claim_rows(rows, sess_rep)
+            for i, s in enumerate(np.asarray(session_slots)):
+                s = int(s)
+                self._audit_rows.setdefault(s, []).extend(
+                    rows[i * t : (i + 1) * t].tolist()
+                )
+                self._turns[s] = self._turns.get(s, 0) + t
+                self._chain_seed[s] = chain[t - 1, i]
+        return result
+
     def set_session_state(self, slot: int, state: SessionState) -> None:
         self.sessions = replace(
             self.sessions, state=self.sessions.state.at[slot].set(state.code)
@@ -88,6 +272,11 @@ class HypervisorState:
         trustworthy: bool = True,
     ) -> int:
         """Stage one join; returns the queue slot (-1 when the wave is full)."""
+        if self._next_agent_slot >= self.agents.did.shape[0]:
+            raise RuntimeError(
+                f"agent table full ({self.agents.did.shape[0]}); "
+                "raise config.capacity.max_agents"
+            )
         did = self.agent_ids.intern(agent_did)
         agent_slot = self._next_agent_slot
         duplicate = (session_slot, did) in self._members
@@ -125,7 +314,349 @@ class HypervisorState:
         for (slot, did, sess, _), st in zip(rows, status):
             if st == admission.ADMIT_OK:
                 self._members[(sess, did)] = True
+                self._slot_of_did[did] = slot
         return status
+
+    # ── vouch edges ──────────────────────────────────────────────────
+
+    def add_vouch(
+        self,
+        voucher_slot: int,
+        vouchee_slot: int,
+        session_slot: int,
+        bond: float,
+        bond_pct: float = 0.20,
+        expiry: float = np.inf,
+    ) -> int:
+        """Insert one liability edge; returns the edge row."""
+        if self._next_edge_slot >= self.vouches.voucher.shape[0]:
+            raise RuntimeError(
+                f"vouch table full ({self.vouches.voucher.shape[0]}); "
+                "raise config.capacity.max_vouch_edges"
+            )
+        row = self._next_edge_slot
+        self._next_edge_slot += 1
+        self.vouches = replace(
+            self.vouches,
+            voucher=self.vouches.voucher.at[row].set(voucher_slot),
+            vouchee=self.vouches.vouchee.at[row].set(vouchee_slot),
+            session=self.vouches.session.at[row].set(session_slot),
+            bond=self.vouches.bond.at[row].set(bond),
+            bond_pct=self.vouches.bond_pct.at[row].set(bond_pct),
+            active=self.vouches.active.at[row].set(True),
+            expiry=self.vouches.expiry.at[row].set(expiry),
+        )
+        return row
+
+    # ── sagas ────────────────────────────────────────────────────────
+
+    def create_saga(
+        self,
+        saga_id: str,
+        session_slot: int,
+        steps: Sequence[dict],
+    ) -> int:
+        """Allocate a saga row; steps = [{has_undo, retries, timeout}, ...]."""
+        max_steps = self.sagas.step_state.shape[1]
+        if not steps:
+            raise ValueError("saga needs at least one step")
+        if len(steps) > max_steps:
+            raise ValueError(
+                f"saga has {len(steps)} steps; table holds {max_steps}"
+            )
+        if self._next_saga_slot >= self.sagas.saga_state.shape[0]:
+            raise RuntimeError(
+                f"saga table full ({self.sagas.saga_state.shape[0]}); "
+                "raise config.capacity.max_sagas"
+            )
+        slot = self._next_saga_slot
+        self._next_saga_slot += 1
+        self.saga_ids.intern(saga_id)
+        n = len(steps)
+        retries = np.zeros(max_steps, np.int8)
+        has_undo = np.zeros(max_steps, bool)
+        timeout = np.full(max_steps, 300.0, np.float32)
+        for i, st in enumerate(steps):
+            retries[i] = st.get("retries", 0)
+            has_undo[i] = st.get("has_undo", False)
+            timeout[i] = st.get("timeout", 300.0)
+        self.sagas = replace(
+            self.sagas,
+            step_state=self.sagas.step_state.at[slot].set(
+                jnp.zeros(max_steps, jnp.int8)
+            ),
+            retries_left=self.sagas.retries_left.at[slot].set(jnp.asarray(retries)),
+            has_undo=self.sagas.has_undo.at[slot].set(jnp.asarray(has_undo)),
+            timeout=self.sagas.timeout.at[slot].set(jnp.asarray(timeout)),
+            saga_state=self.sagas.saga_state.at[slot].set(saga_ops.SAGA_RUNNING),
+            session=self.sagas.session.at[slot].set(session_slot),
+            n_steps=self.sagas.n_steps.at[slot].set(n),
+            cursor=self.sagas.cursor.at[slot].set(0),
+        )
+        return slot
+
+    def saga_work(self) -> tuple[list[tuple[int, int]], list[tuple[int, int]]]:
+        """(execute, compensate) work lists for the host executor shim.
+
+        execute: (saga_slot, step_idx) cursor steps of RUNNING sagas.
+        compensate: (saga_slot, step_idx) reverse-order targets of
+        COMPENSATING sagas.
+        """
+        g = self._next_saga_slot
+        if g == 0:
+            return [], []
+        saga_state = np.asarray(self.sagas.saga_state)[:g]
+        step_state = np.asarray(self.sagas.step_state)[:g]
+        cursor = np.asarray(self.sagas.cursor)[:g]
+        n_steps = np.asarray(self.sagas.n_steps)[:g]
+
+        execute = [
+            (int(s), int(cursor[s]))
+            for s in np.nonzero(
+                (saga_state == saga_ops.SAGA_RUNNING) & (cursor < n_steps)
+            )[0]
+            if step_state[s, cursor[s]] == saga_ops.STEP_PENDING
+        ]
+        compensate = []
+        for s in np.nonzero(saga_state == saga_ops.SAGA_COMPENSATING)[0]:
+            committed = np.nonzero(
+                step_state[s] == saga_ops.STEP_COMMITTED
+            )[0]
+            if len(committed):
+                compensate.append((int(s), int(committed[-1])))
+        return execute, compensate
+
+    def saga_round(
+        self,
+        exec_outcomes: Optional[dict[int, bool]] = None,
+        undo_outcomes: Optional[dict[int, bool]] = None,
+    ) -> None:
+        """One jitted scheduling round over the whole saga table."""
+        g_cap = self.sagas.saga_state.shape[0]
+        exec_success = np.zeros(g_cap, bool)
+        undo_success = np.zeros(g_cap, bool)
+        for slot, ok in (exec_outcomes or {}).items():
+            exec_success[slot] = ok
+        for slot, ok in (undo_outcomes or {}).items():
+            undo_success[slot] = ok
+        step_state, retries_left, saga_state, cursor = self._saga_tick(
+            self.sagas.step_state,
+            self.sagas.retries_left,
+            self.sagas.has_undo,
+            self.sagas.saga_state,
+            self.sagas.n_steps,
+            self.sagas.cursor,
+            jnp.asarray(exec_success),
+            jnp.asarray(undo_success),
+        )
+        self.sagas = replace(
+            self.sagas,
+            step_state=step_state,
+            retries_left=retries_left,
+            saga_state=saga_state,
+            cursor=cursor,
+        )
+
+    def sagas_settled(self) -> bool:
+        g = self._next_saga_slot
+        if g == 0:
+            return True
+        done = np.asarray(
+            saga_ops.saga_table_done(self.sagas.saga_state, self.sagas.session)
+        )[:g]
+        return bool(done.all())
+
+    # ── audit deltas ─────────────────────────────────────────────────
+
+    def stage_delta(
+        self,
+        session_slot: int,
+        agent_slot: int,
+        ts: float = 0.0,
+        change_words: Optional[np.ndarray] = None,
+        digest_words: Optional[np.ndarray] = None,
+    ) -> int:
+        """Stage one audit delta; returns its turn number within the session.
+
+        `change_words` (u32[<=8]) go into the packed body; the recorded
+        leaf digest is the device chain digest computed at flush — unless
+        `digest_words` (u32[8]) pins an explicit leaf (the facade passes
+        the host DeltaEngine's canonical-JSON hash so device and host
+        Merkle trees share leaves bit-for-bit).
+        """
+        turn = self._turns.get(session_slot, 0)
+        self._turns[session_slot] = turn + 1
+        change = np.zeros(8, np.uint32)
+        if change_words is not None:
+            w = np.asarray(change_words, np.uint32).ravel()[:8]
+            change[: len(w)] = w
+        self._pending_deltas.append(
+            (
+                session_slot,
+                agent_slot,
+                change,
+                float(ts),
+                None if digest_words is None else np.asarray(digest_words, np.uint32),
+            )
+        )
+        return turn
+
+    def flush_deltas(self, use_pallas: bool | None = None) -> int:
+        """Chain-hash and append every staged delta to the DeltaLog.
+
+        Lanes = sessions present in the wave; each lane's bodies are
+        chained from the session's running seed so consecutive flushes
+        form one unbroken chain per session. Host staging is vectorized:
+        one `pack_delta_bodies` call for the whole wave. Returns the
+        record count.
+        """
+        staged = self._pending_deltas
+        if not staged:
+            return 0
+        self._pending_deltas = []
+
+        b = len(staged)
+        sess_arr = np.array([r[0] for r in staged], np.int32)
+        agent_arr = np.array([r[1] for r in staged], np.int32)
+        change_arr = np.stack([r[2] for r in staged])
+        ts_arr = np.array([r[3] for r in staged], np.float32)
+
+        # Lane assignment (first-appearance order) + within-lane position.
+        lane_of: dict[int, int] = {}
+        lane_idx = np.zeros(b, np.int32)
+        for i, sess in enumerate(sess_arr):
+            sess = int(sess)
+            if sess not in lane_of:
+                lane_of[sess] = len(lane_of)
+            lane_idx[i] = lane_of[sess]
+        lanes = len(lane_of)
+        n_per_lane = np.bincount(lane_idx, minlength=lanes)
+        t_max = int(n_per_lane.max())
+        # Stable within-lane rank (staging order preserved).
+        order = np.argsort(lane_idx, kind="stable")
+        rank_sorted = np.arange(b) - np.repeat(
+            np.concatenate([[0], np.cumsum(n_per_lane)[:-1]]), n_per_lane
+        )
+        t_pos = np.zeros(b, np.int32)
+        t_pos[order] = rank_sorted.astype(np.int32)
+
+        base_turn_of_lane = np.zeros(lanes, np.int64)
+        seeds = np.zeros((lanes, 8), np.uint32)
+        sess_of_lane = np.zeros(lanes, np.int32)
+        for sess, lane in lane_of.items():
+            sess_of_lane[lane] = sess
+            base_turn_of_lane[lane] = self._turns[sess] - int(n_per_lane[lane])
+            seeds[lane] = self._chain_seed.get(sess, np.zeros(8, np.uint32))
+        turn_arr = (base_turn_of_lane[lane_idx] + t_pos).astype(np.int32)
+
+        packed = merkle_ops.pack_delta_bodies(
+            sess_arr, turn_arr, agent_arr, change_arr, ts_arr
+        )  # [B, BODY_WORDS]
+        bodies = np.zeros((t_max, lanes, merkle_ops.BODY_WORDS), np.uint32)
+        bodies[t_pos, lane_idx] = packed
+
+        digests = np.array(
+            merkle_ops.chain_digests(
+                jnp.asarray(bodies), jnp.asarray(seeds), use_pallas
+            )
+        )  # [T, L, 8] (copy: explicit leaves overwrite below)
+
+        # Explicit leaf digests (facade mode) override the chain digest.
+        for i, (_s, _a, _c, _t, digest) in enumerate(staged):
+            if digest is not None:
+                digests[t_pos[i], lane_idx[i]] = digest
+
+        # Flatten valid records lane-major and append in one op.
+        flat = np.argsort(lane_idx * (t_max + 1) + t_pos, kind="stable")
+        flat_digests = digests[t_pos[flat], lane_idx[flat]]
+        base_row = int(np.asarray(self.delta_log.cursor))
+        capacity = self.delta_log.body.shape[0]
+        rows = ((base_row + np.arange(b)) % capacity).astype(np.int64)
+        self._claim_rows(rows, sess_arr[flat])
+        offset = 0
+        for lane in range(lanes):
+            sess = int(sess_of_lane[lane])
+            n_rows = int(n_per_lane[lane])
+            self._audit_rows.setdefault(sess, []).extend(
+                rows[offset : offset + n_rows].tolist()
+            )
+            offset += n_rows
+            self._chain_seed[sess] = digests[n_rows - 1, lane]
+
+        self.delta_log = self.delta_log.append_batch(
+            jnp.asarray(packed[flat]),
+            jnp.asarray(flat_digests),
+            jnp.asarray(sess_arr[flat]),
+            jnp.asarray(turn_arr[flat]),
+        )
+        return b
+
+    def _claim_rows(self, rows: np.ndarray, owners: np.ndarray) -> None:
+        """Transfer DeltaLog row ownership; evict recycled rows from the
+        audit index of whichever sessions owned them before the wrap."""
+        prior = self._row_session[rows]
+        recycled = np.unique(prior[prior >= 0])
+        if len(recycled):
+            doomed = set(rows.tolist())
+            for sess in recycled:
+                kept = self._audit_rows.get(int(sess))
+                if kept:
+                    self._audit_rows[int(sess)] = [
+                        r for r in kept if r not in doomed
+                    ]
+        self._row_session[rows] = owners
+
+    def session_leaf_digests(self, session_slot: int) -> np.ndarray:
+        """u32[T, 8] recorded leaf digests for a session, in turn order."""
+        rows = self._audit_rows.get(session_slot, [])
+        if not rows:
+            return np.zeros((0, 8), np.uint32)
+        return np.asarray(self.delta_log.digest)[np.array(rows)]
+
+    # ── termination wave ─────────────────────────────────────────────
+
+    def terminate_sessions(
+        self,
+        session_slots: Sequence[int],
+        now: float = 0.0,
+        use_pallas: bool | None = None,
+    ) -> np.ndarray:
+        """Terminate a wave of sessions; returns u32[K, 8] Merkle roots.
+
+        One jitted program: per-session Merkle roots over the recorded
+        leaf digests, session-scoped bond release, participant
+        deactivation, and the TERMINATING -> ARCHIVED walk.
+        """
+        slots = list(session_slots)
+        k = len(slots)
+        if k == 0:
+            return np.zeros((0, 8), np.uint32)
+        counts = np.array(
+            [len(self._audit_rows.get(s, ())) for s in slots], np.int32
+        )
+        p = 1 << max(0, int(counts.max()) - 1).bit_length() if counts.max() else 1
+        p = max(p, 1)
+        leaves = np.zeros((k, p, 8), np.uint32)
+        digest_host = np.asarray(self.delta_log.digest)
+        for i, s in enumerate(slots):
+            rows = self._audit_rows.get(s, [])
+            if rows:
+                leaves[i, : len(rows)] = digest_host[np.array(rows)]
+
+        result = self._terminate(
+            self.agents,
+            self.sessions,
+            self.vouches,
+            jnp.asarray(np.array(slots, np.int32)),
+            jnp.asarray(leaves),
+            jnp.asarray(counts),
+            now,
+            use_pallas=use_pallas,
+        )
+        self.agents = result.agents
+        self.sessions = result.sessions
+        self.vouches = result.vouches
+        return np.asarray(result.roots)
 
     # ── views ────────────────────────────────────────────────────────
 
@@ -136,11 +667,15 @@ class HypervisorState:
         did = self.agent_ids.lookup(agent_did)
         if did < 0:
             return None
-        dids = np.asarray(self.agents.did)
-        hits = np.nonzero(dids == did)[0]
-        if len(hits) == 0:
-            return None
-        i = int(hits[-1])
+        i = self._slot_of_did.get(did)
+        if i is None:
+            # Slow path (e.g. state restored from a checkpoint): scan the
+            # table once and cache the mapping.
+            hits = np.nonzero(np.asarray(self.agents.did) == did)[0]
+            if len(hits) == 0:
+                return None
+            i = int(hits[-1])
+            self._slot_of_did[did] = i
         return {
             "slot": i,
             "session": int(np.asarray(self.agents.session)[i]),

@@ -87,6 +87,40 @@ class SessionTable:
 
 
 @table
+class SagaTable:
+    """[G, max_steps] saga step-state matrix + per-saga control columns.
+
+    The reference walks one saga object at a time through dict-validated
+    transitions (`saga/orchestrator.py:77-198`); here every saga in the
+    table advances in one `ops.saga_ops.saga_table_tick`: the retry
+    ladder, sequential cursor, reverse-order compensation, and
+    escalation are masked column arithmetic over the whole [G, M] matrix.
+    """
+
+    step_state: jnp.ndarray    # i8[G, M]  StepState codes (PENDING rows beyond n_steps)
+    retries_left: jnp.ndarray  # i8[G, M]
+    has_undo: jnp.ndarray      # bool[G, M]
+    timeout: jnp.ndarray       # f32[G, M] seconds (host shim enforces)
+    saga_state: jnp.ndarray    # i8[G]  SagaState codes
+    session: jnp.ndarray       # i32[G] session slot (-1 = free saga row)
+    n_steps: jnp.ndarray       # i32[G]
+    cursor: jnp.ndarray        # i32[G] next step to execute (forward order)
+
+    @staticmethod
+    def create(capacity: int, max_steps: int = 8) -> "SagaTable":
+        return SagaTable(
+            step_state=jnp.zeros((capacity, max_steps), jnp.int8),
+            retries_left=jnp.zeros((capacity, max_steps), jnp.int8),
+            has_undo=jnp.zeros((capacity, max_steps), bool),
+            timeout=jnp.full((capacity, max_steps), 300.0, jnp.float32),
+            saga_state=jnp.zeros((capacity,), jnp.int8),
+            session=jnp.full((capacity,), -1, jnp.int32),
+            n_steps=jnp.zeros((capacity,), jnp.int32),
+            cursor=jnp.zeros((capacity,), jnp.int32),
+        )
+
+
+@table
 class VouchTable:
     """[E] vouch edges: the liability graph as an edge list.
 

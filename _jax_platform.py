@@ -17,8 +17,21 @@ from __future__ import annotations
 import os
 import re
 import sys
+import tempfile
 
 _COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def cache_dir() -> str:
+    """Per-user persistent compilation cache path.
+
+    A fixed world-readable path would let one local user's cache entries
+    be deserialized by another (cache poisoning) or block writes when the
+    directory is owned by someone else.
+    """
+    return os.path.join(
+        tempfile.gettempdir(), f"jax_cache_{os.getuid()}"
+    )
 
 
 def force_cpu_platform(n_devices: int = 8) -> None:
@@ -36,7 +49,7 @@ def force_cpu_platform(n_devices: int = 8) -> None:
     jax_loaded = "jax" in sys.modules
     if not jax_loaded:
         os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir())
 
     flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(rf"--{_COUNT_FLAG}=(\d+)", flags)
@@ -64,5 +77,5 @@ def force_cpu_platform(n_devices: int = 8) -> None:
             # unrolled SHA-256 program).
             jax.config.update("jax_platforms", "cpu")
         if not jax.config.jax_compilation_cache_dir:
-            jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+            jax.config.update("jax_compilation_cache_dir", cache_dir())
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -23,7 +23,6 @@ exports but never wires (`api/server.py:101` instantiates its own).
 from __future__ import annotations
 
 import logging
-import time
 from typing import Any, Optional
 
 from hypervisor_tpu.audit import CommitmentEngine, DeltaEngine, EphemeralGC
@@ -80,7 +79,7 @@ class ManagedSession:
         self._state.stage_delta(
             self.slot,
             row["slot"] if row else -1,
-            ts=delta.timestamp.timestamp() % 2**31,
+            ts=self._state.now(),
             digest_words=hex_to_words([delta.delta_hash])[0],
         )
 
@@ -215,7 +214,7 @@ class Hypervisor:
         )
         if queued < 0:
             raise RuntimeError("admission staging queue full; flush pending joins")
-        status = self.state.flush_joins(now=time.time() % 2**31)
+        status = self.state.flush_joins(now=self.state.now())
         if int(status[lane]) != admission.ADMIT_OK:
             managed.sso.join(
                 agent_did=agent_did,
@@ -224,7 +223,7 @@ class Hypervisor:
                 ring=ring,
             )
             raise RuntimeError(
-                f"device admission rejected ({int(status[0])}) what the host "
+                f"device admission rejected ({int(status[lane])}) what the host "
                 f"session accepted — table/SSO divergence for {agent_did}"
             )
         device_ring = self.state.agent_row(agent_did)
@@ -268,7 +267,7 @@ class Hypervisor:
 
         self.state.flush_deltas()
         roots = self.state.terminate_sessions(
-            [managed.slot], now=time.time() % 2**31
+            [managed.slot], now=self.state.now()
         )
 
         merkle_root = None

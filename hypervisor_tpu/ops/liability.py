@@ -75,6 +75,24 @@ def contribution_by_agent(
     )
 
 
+def contribution_toward(
+    v: VouchTable,
+    target_session_of_slot: jnp.ndarray,  # i32[N] session each slot is joining
+    now: jnp.ndarray | float,
+) -> jnp.ndarray:
+    """f32[N] bonded sigma toward each agent slot, scoped to the session
+    that slot is joining (the admission-wave form of the joint-liability
+    contribution, `vouching.py:146-148`). Shared by the fused wave and
+    the sharded wave (which psums per-shard partials of this)."""
+    n = target_session_of_slot.shape[0]
+    live = edge_live(v, now)
+    vee = jnp.clip(v.vouchee, 0)
+    scoped = live & (v.vouchee >= 0) & (v.session == target_session_of_slot[vee])
+    return jnp.zeros((n,), jnp.float32).at[vee].add(
+        jnp.where(scoped, v.bond, 0.0)
+    )
+
+
 def sigma_eff(
     vouchee_sigma: jnp.ndarray,
     risk_weight: jnp.ndarray,

@@ -35,7 +35,7 @@ from hypervisor_tpu.ops import merkle as merkle_ops
 from hypervisor_tpu.ops import rings as ring_ops
 from hypervisor_tpu.ops import saga_ops
 from hypervisor_tpu.ops import session_fsm
-from hypervisor_tpu.tables.state import AgentTable, FLAG_ACTIVE, SessionTable, VouchTable
+from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
 from hypervisor_tpu.tables.struct import replace
 
 # Per-lane status codes for the batched pipeline (host may re-raise).
@@ -199,6 +199,9 @@ def governance_wave(
       6. terminate: session-scoped bond release, participant
          deactivation, ACTIVE -> TERMINATING -> ARCHIVED walk.
     """
+    from hypervisor_tpu.ops import liability as liability_ops
+    from hypervisor_tpu.ops import terminate as terminate_ops
+
     n_cap = agents.did.shape[0]
     now_f = jnp.asarray(now, jnp.float32)
 
@@ -206,17 +209,9 @@ def governance_wave(
     # Wave agents are not in the tables yet: scope each live edge to the
     # session its vouchee is joining in THIS wave.
     target_session = jnp.full((n_cap,), -2, jnp.int32).at[slot].set(session_slot)
-    live = vouches.active & (now_f <= vouches.expiry)
-    vee = jnp.clip(vouches.vouchee, 0)
-    edge_scoped = (
-        live
-        & (vouches.vouchee >= 0)
-        & (vouches.session == target_session[vee])
-    )
-    contrib_by_slot = jnp.zeros((n_cap,), jnp.float32).at[vee].add(
-        jnp.where(edge_scoped, vouches.bond, 0.0)
-    )
-    contribution = contrib_by_slot[slot]
+    contribution = liability_ops.contribution_toward(
+        vouches, target_session, now_f
+    )[slot]
 
     # ── 2. admission onto the tables ─────────────────────────────────
     admitted = admission_ops.admit_batch(
@@ -265,19 +260,8 @@ def governance_wave(
     in_wave = jnp.zeros((sessions.sid.shape[0],), bool).at[
         jnp.clip(k_sessions, 0)
     ].set(True)
-    edge_hit = vouches.active & jnp.where(
-        vouches.session >= 0, in_wave[jnp.clip(vouches.session, 0)], False
-    )
-    vouches = replace(vouches, active=vouches.active & ~edge_hit)
-
-    agent_hit = jnp.where(
-        agents.session >= 0, in_wave[jnp.clip(agents.session, 0)], False
-    )
-    agents = replace(
-        agents,
-        flags=jnp.where(
-            agent_hit, agents.flags & ~FLAG_ACTIVE, agents.flags
-        ).astype(agents.flags.dtype),
+    agents, vouches, released = terminate_ops.release_session_scope(
+        agents, vouches, in_wave
     )
 
     wave_state, err_t = session_fsm.apply_session_transitions(
@@ -305,5 +289,5 @@ def governance_wave(
         merkle_root=roots,
         chain=chain,
         fsm_error=err_a | err_t | err_z,
-        released=jnp.sum(edge_hit.astype(jnp.int32)),
+        released=released,
     )

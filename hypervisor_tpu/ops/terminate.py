@@ -30,6 +30,32 @@ from hypervisor_tpu.tables.state import (
 from hypervisor_tpu.tables.struct import replace
 
 
+def release_session_scope(
+    agents: AgentTable, vouches: VouchTable, in_wave: jnp.ndarray
+) -> tuple[AgentTable, VouchTable, jnp.ndarray]:
+    """Release bonds and deactivate participants for the wave's sessions.
+
+    in_wave: bool[S_cap] mask over session slots. Returns (agents,
+    vouches, released_count). Shared by the terminate wave and the fused
+    governance wave so bond-release semantics cannot drift.
+    """
+    edge_hit = vouches.active & jnp.where(
+        vouches.session >= 0, in_wave[jnp.clip(vouches.session, 0)], False
+    )
+    vouches = replace(vouches, active=vouches.active & ~edge_hit)
+
+    agent_hit = jnp.where(
+        agents.session >= 0, in_wave[jnp.clip(agents.session, 0)], False
+    )
+    agents = replace(
+        agents,
+        flags=jnp.where(
+            agent_hit, agents.flags & ~FLAG_ACTIVE, agents.flags
+        ).astype(agents.flags.dtype),
+    )
+    return agents, vouches, jnp.sum(edge_hit.astype(jnp.int32))
+
+
 class TerminateResult(NamedTuple):
     agents: AgentTable
     sessions: SessionTable
@@ -61,21 +87,9 @@ def terminate_batch(
         jnp.zeros((s_cap,), bool).at[jnp.clip(session_slots, 0)].set(True)
     )
 
-    # ── bond release: every edge scoped to a wave session goes inactive ──
-    edge_hit = vouches.active & jnp.where(
-        vouches.session >= 0, in_wave[jnp.clip(vouches.session, 0)], False
-    )
-    new_vouches = replace(vouches, active=vouches.active & ~edge_hit)
-
-    # ── participants deactivate ──────────────────────────────────────────
-    agent_hit = jnp.where(
-        agents.session >= 0, in_wave[jnp.clip(agents.session, 0)], False
-    )
-    new_agents = replace(
-        agents,
-        flags=jnp.where(
-            agent_hit, agents.flags & ~FLAG_ACTIVE, agents.flags
-        ).astype(agents.flags.dtype),
+    # ── bonds + participants (shared semantics) ─────────────────────────
+    new_agents, new_vouches, released = release_session_scope(
+        agents, vouches, in_wave
     )
 
     # ── session FSM: TERMINATING then ARCHIVED, stamped ──────────────────
@@ -93,5 +107,5 @@ def terminate_batch(
         sessions=new_sessions,
         vouches=new_vouches,
         roots=roots,
-        released=jnp.sum(edge_hit.astype(jnp.int32)),
+        released=released,
     )

@@ -7,7 +7,12 @@ from hypervisor_tpu.parallel.mesh import (
     make_multislice_mesh,
 )
 from hypervisor_tpu.parallel.sharding import lane_sharding, replicated, shard_table
-from hypervisor_tpu.parallel.collectives import eventual_tick, reconcile, strong_tick
+from hypervisor_tpu.parallel.collectives import (
+    eventual_tick,
+    reconcile,
+    sharded_admission,
+    strong_tick,
+)
 
 __all__ = [
     "AGENT_AXIS",
@@ -17,6 +22,7 @@ __all__ = [
     "lane_sharding",
     "replicated",
     "shard_table",
+    "sharded_admission",
     "strong_tick",
     "eventual_tick",
     "reconcile",

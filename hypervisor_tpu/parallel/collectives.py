@@ -25,8 +25,15 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
+from hypervisor_tpu.config import DEFAULT_CONFIG, TrustConfig
+from hypervisor_tpu.models import SessionState
+from hypervisor_tpu.ops import admission as admission_ops
+from hypervisor_tpu.ops import liability as liability_ops
+from hypervisor_tpu.ops import rings as ring_ops
 from hypervisor_tpu.ops.pipeline import PipelineResult, governance_pipeline
 from hypervisor_tpu.parallel.mesh import AGENT_AXIS
+from hypervisor_tpu.tables.state import FLAG_ACTIVE
+from hypervisor_tpu.tables.struct import replace as t_replace
 
 
 def _mesh_uses_pallas(mesh: Mesh) -> bool:
@@ -77,6 +84,161 @@ def strong_tick(mesh: Mesh):
             consensus=P(),  # replicated after psum
         ),
         
+    )
+    return jax.jit(mapped)
+
+
+def sharded_admission(mesh: Mesh, trust: TrustConfig = DEFAULT_CONFIG.trust):
+    """Cross-shard STRONG-mode admission: correct when a session spans chips.
+
+    The agent table and the wave are sharded over the mesh agent axis;
+    the session table is replicated. Capacity and sigma_eff checks that
+    the single-device wave resolves locally become collectives here:
+
+      * vouched sigma_eff — every shard segment-sums its OWN vouch-edge
+        shard's bonded contributions into an [N]-vector, then a `psum`
+        over ICI yields each joining agent's global contribution,
+      * capacity — session ids + pass masks are `all_gather`ed so every
+        shard computes the same global admission ranking (wave order =
+        shard-major), making the seat budget exact across chips,
+      * the session-table update is an allreduce of the ACTUAL table
+        delta: per-session admit-count vectors are psum'd and applied
+        identically on every shard, so the replicated SessionTable stays
+        bit-identical everywhere.
+
+    Slot contract: wave element i carries a GLOBAL agent-table row that
+    lives on i's shard (host allocates from per-shard free lists).
+
+    Returns fn(agents, sessions, vouches, slot, did, session_slot,
+    sigma_raw, trustworthy, duplicate, now, omega) ->
+    (agents, sessions, status, ring, sigma_eff).
+    """
+    n_shards = mesh.devices.size
+
+    def step(
+        agents,
+        sessions,
+        vouches,
+        slot,
+        did,
+        session_slot,
+        sigma_raw,
+        trustworthy,
+        duplicate,
+        now,
+        omega,
+    ):
+        b_local = slot.shape[0]
+        rows_per_shard = agents.did.shape[0]
+        my_shard = jax.lax.axis_index(AGENT_AXIS)
+        local_slot = slot - my_shard * rows_per_shard
+
+        # ── vouched contributions: segmented psum over edge shards ────
+        n_global = rows_per_shard * n_shards
+        # Each shard marks only its own wave elements; psum merges the
+        # shards' sparse marks into the full slot -> session map (+2 bias
+        # makes unset rows contribute zero).
+        target_session = (
+            jnp.full((n_global,), -2, jnp.int32).at[slot].set(session_slot)
+        )
+        target_session = jax.lax.psum(target_session + 2, AGENT_AXIS) - 2
+        local_contrib = liability_ops.contribution_toward(
+            vouches, target_session, now
+        )
+        contribution = jax.lax.psum(local_contrib, AGENT_AXIS)[slot]
+        sigma_eff = jnp.minimum(
+            sigma_raw + jnp.asarray(omega, jnp.float32) * contribution, 1.0
+        )
+
+        # ── globally consistent pre-checks ────────────────────────────
+        sess_state = sessions.state[session_slot]
+        sess_count = sessions.n_participants[session_slot]
+        sess_max = sessions.max_participants[session_slot]
+        sess_min = sessions.min_sigma_eff[session_slot]
+        ring = ring_ops.compute_rings(sigma_eff, False, trust)
+        ring = jnp.where(trustworthy, ring, jnp.int8(3))
+        bad_state = (sess_state != SessionState.HANDSHAKING.code) & (
+            sess_state != SessionState.ACTIVE.code
+        )
+        sigma_low = (sigma_eff < sess_min) & (ring != 3)
+
+        status = jnp.full((b_local,), admission_ops.ADMIT_OK, jnp.int8)
+
+        def claim(status, cond, code):
+            return jnp.where(
+                (status == admission_ops.ADMIT_OK) & cond, jnp.int8(code), status
+            )
+
+        status = claim(status, bad_state, admission_ops.ADMIT_BAD_STATE)
+        status = claim(status, duplicate, admission_ops.ADMIT_DUPLICATE)
+        status = claim(status, sigma_low, admission_ops.ADMIT_SIGMA_LOW)
+        passed_other = status == admission_ops.ADMIT_OK
+
+        # ── global capacity ranking (all_gather over ICI) ─────────────
+        gsess = jax.lax.all_gather(session_slot, AGENT_AXIS, tiled=True)
+        gpass = jax.lax.all_gather(passed_other, AGENT_AXIS, tiled=True)
+        mine = my_shard * b_local + jnp.arange(b_local, dtype=jnp.int32)
+        j = jnp.arange(gsess.shape[0], dtype=jnp.int32)
+        rank = jnp.sum(
+            (j[None, :] < mine[:, None])
+            & (gsess[None, :] == session_slot[:, None])
+            & gpass[None, :],
+            axis=1,
+        )
+        over = passed_other & ((sess_count + rank) >= sess_max)
+        status = claim(status, over, admission_ops.ADMIT_CAPACITY)
+        ok = status == admission_ops.ADMIT_OK
+
+        # ── local agent-shard writes ──────────────────────────────────
+        write = jnp.where(ok, local_slot, rows_per_shard - 1)
+        now_f = jnp.asarray(now, jnp.float32)
+        agents = t_replace(
+            agents,
+            did=agents.did.at[write].set(jnp.where(ok, did, agents.did[write])),
+            session=agents.session.at[write].set(
+                jnp.where(ok, session_slot, agents.session[write])
+            ),
+            sigma_raw=agents.sigma_raw.at[write].set(
+                jnp.where(ok, sigma_raw, agents.sigma_raw[write])
+            ),
+            sigma_eff=agents.sigma_eff.at[write].set(
+                jnp.where(ok, sigma_eff, agents.sigma_eff[write])
+            ),
+            ring=agents.ring.at[write].set(
+                jnp.where(ok, ring, agents.ring[write])
+            ),
+            flags=agents.flags.at[write].set(
+                jnp.where(ok, FLAG_ACTIVE, agents.flags[write])
+            ),
+            joined_at=agents.joined_at.at[write].set(
+                jnp.where(ok, now_f, agents.joined_at[write])
+            ),
+        )
+
+        # ── replicated session table: allreduce the ACTUAL delta ──────
+        s_cap = sessions.sid.shape[0]
+        local_add = jnp.zeros((s_cap,), jnp.int32).at[
+            jnp.clip(session_slot, 0)
+        ].add(jnp.where(ok, 1, 0))
+        global_add = jax.lax.psum(local_add, AGENT_AXIS)
+        sessions = t_replace(
+            sessions, n_participants=sessions.n_participants + global_add
+        )
+        return agents, sessions, status, ring, sigma_eff
+
+    lane = P(AGENT_AXIS)
+    rep = P()
+    # Pytree-prefix specs: one spec covers a whole table's columns.
+    mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(
+            lane,  # agents: every column sharded by row
+            rep,   # sessions: replicated
+            lane,  # vouches: edges sharded
+            lane, lane, lane, lane, lane, lane, rep, rep,
+        ),
+        out_specs=(lane, rep, lane, lane, lane),
     )
     return jax.jit(mapped)
 

@@ -92,6 +92,8 @@ def host_metadata(state: HypervisorState) -> dict:
         "next_saga_slot": state._next_saga_slot,
         "next_edge_slot": state._next_edge_slot,
         "members": sorted([list(k) for k in state._members]),
+        "free_agent_slots": list(state._free_agent_slots),
+        "epoch_base": state._epoch_base,
         "audit_rows": {str(k): v for k, v in state._audit_rows.items()},
         "chain_seed": {
             str(k): [int(w) for w in v] for k, v in state._chain_seed.items()
@@ -211,6 +213,14 @@ def restore_state(
         for k, v in meta.get("chain_seed", {}).items()
     }
     state._turns = {int(k): int(v) for k, v in meta.get("turns", {}).items()}
+    state._free_agent_slots = [
+        int(r) for r in meta.get("free_agent_slots", [])
+    ]
+    state._epoch_base = float(meta.get("epoch_base", state._epoch_base))
+    # Ring-buffer row ownership comes straight from the saved session
+    # column — without it a post-restore wrap would skip eviction and
+    # leave stale audit rows pointing at recycled digests.
+    state._row_session = np.array(data["delta_log.session"], np.int32)
     return state
 
 

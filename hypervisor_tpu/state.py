@@ -20,6 +20,7 @@ share one source of truth.
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -72,6 +73,12 @@ class HypervisorState:
         self._next_edge_slot = 0
         self._members: dict[tuple[int, int], bool] = {}  # (session, did) -> True
         self._slot_of_did: dict[int, int] = {}           # did handle -> agent slot
+        self._free_agent_slots: list[int] = []           # reclaimed from rejects
+
+        # Timestamps are stored in f32 columns: keep them SMALL (relative
+        # to this epoch) so sub-second resolution survives the 24-bit
+        # mantissa. time.time() itself near 2^31 quantizes to ~128 s.
+        self._epoch_base = time.time()
 
         # Pending join wave (native lock-free queue + parallel slot/did rows).
         self._queue = StagingQueue(capacity=cap.max_agents)
@@ -92,6 +99,10 @@ class HypervisorState:
         self._admit = _ADMIT
         self._saga_tick = _SAGA_TICK
         self._terminate = _TERMINATE
+
+    def now(self) -> float:
+        """Seconds since this state's epoch — the f32-safe device time."""
+        return time.time() - self._epoch_base
 
     # ── sessions ─────────────────────────────────────────────────────
 
@@ -227,6 +238,8 @@ class HypervisorState:
             if is_ok:
                 self._members[(int(s), int(h))] = True
                 self._slot_of_did[int(h)] = int(slot)
+            else:
+                self._free_agent_slots.append(int(slot))
 
         # Record the wave's audit chain in the DeltaLog (lane-major).
         chain = np.asarray(result.chain)  # [T, K, 8]
@@ -272,18 +285,24 @@ class HypervisorState:
         trustworthy: bool = True,
     ) -> int:
         """Stage one join; returns the queue slot (-1 when the wave is full)."""
-        if self._next_agent_slot >= self.agents.did.shape[0]:
+        if self._free_agent_slots:
+            agent_slot = self._free_agent_slots[-1]
+        elif self._next_agent_slot < self.agents.did.shape[0]:
+            agent_slot = self._next_agent_slot
+        else:
             raise RuntimeError(
                 f"agent table full ({self.agents.did.shape[0]}); "
                 "raise config.capacity.max_agents"
             )
         did = self.agent_ids.intern(agent_did)
-        agent_slot = self._next_agent_slot
         duplicate = (session_slot, did) in self._members
         q = self._queue.push(sigma_raw, agent_slot, session_slot, trustworthy)
         if q < 0:
             return -1
-        self._next_agent_slot += 1
+        if self._free_agent_slots:
+            self._free_agent_slots.pop()
+        else:
+            self._next_agent_slot += 1
         self._pending.append((agent_slot, did, session_slot, duplicate))
         return q
 
@@ -315,6 +334,9 @@ class HypervisorState:
             if st == admission.ADMIT_OK:
                 self._members[(sess, did)] = True
                 self._slot_of_did[did] = slot
+            else:
+                # A rejected join leaves no trace; its row is reusable.
+                self._free_agent_slots.append(slot)
         return status
 
     # ── vouch edges ──────────────────────────────────────────────────

@@ -54,7 +54,9 @@ if (
 ):  # pragma: no cover
     jax.config.update("jax_platforms", "cpu")
 
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+from _jax_platform import cache_dir
+
+jax.config.update("jax_compilation_cache_dir", cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 

@@ -1,0 +1,206 @@
+"""Cross-shard STRONG-mode admission vs the host engine (VERDICT #5).
+
+A session whose joining participants land on DIFFERENT shards of an
+8-device mesh must admit exactly the agents the sequential host engine
+admits: the seat budget, sigma floor, and vouched sigma_eff must be
+computed globally (psum/all_gather over the mesh), not per shard.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from hypervisor_tpu.models import ExecutionRing, SessionConfig
+from hypervisor_tpu.ops import admission
+from hypervisor_tpu.parallel import make_mesh
+from hypervisor_tpu.parallel.collectives import sharded_admission
+from hypervisor_tpu.session import (
+    SessionParticipantError,
+    SharedSessionObject,
+)
+from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+
+N_DEV = 8
+ROWS_PER_SHARD = 8
+N_CAP = N_DEV * ROWS_PER_SHARD
+E_CAP = N_DEV * 4
+S_CAP = 8
+
+
+def _mesh():
+    return make_mesh(N_DEV, platform="cpu")
+
+
+def _session_table(max_participants: int, min_sigma: float) -> SessionTable:
+    t = SessionTable.create(S_CAP)
+    return type(t)(
+        **{
+            **{f: getattr(t, f) for f in t.__dataclass_fields__},  # type: ignore[attr-defined]
+            "state": t.state.at[0].set(1),  # HANDSHAKING
+            "max_participants": t.max_participants.at[0].set(max_participants),
+            "min_sigma_eff": t.min_sigma_eff.at[0].set(min_sigma),
+        }
+    )
+
+
+def _host_expected(sigmas, trusts, contribs, omega, capacity, min_sigma):
+    """Drive the reference-parity host SSO in global wave order."""
+    sso = SharedSessionObject(
+        config=SessionConfig(
+            max_participants=capacity, min_sigma_eff=min_sigma
+        ),
+        creator_did="did:creator",
+    )
+    sso.begin_handshake()
+    statuses, rings = [], []
+    for i, (s, tr, c) in enumerate(zip(sigmas, trusts, contribs)):
+        sigma_eff = min(s + omega * c, 1.0)
+        ring = ExecutionRing.from_sigma_eff(sigma_eff, has_consensus=False)
+        if not tr:
+            ring = ExecutionRing.RING_3_SANDBOX
+        try:
+            sso.join(f"did:{i}", sigma_raw=s, sigma_eff=sigma_eff, ring=ring)
+            statuses.append(admission.ADMIT_OK)
+        except SessionParticipantError as e:
+            if "capacity" in str(e):
+                statuses.append(admission.ADMIT_CAPACITY)
+            else:
+                statuses.append(admission.ADMIT_SIGMA_LOW)
+        rings.append(ring.value)
+    return np.array(statuses, np.int8), np.array(rings, np.int8)
+
+
+class TestShardedAdmission:
+    def _run(self, sigmas, trusts, capacity, min_sigma, vouch_rows=(), omega=0.5):
+        mesh = _mesh()
+        admit = sharded_admission(mesh)
+        b = len(sigmas)
+        assert b % N_DEV == 0
+        b_local = b // N_DEV
+
+        agents = AgentTable.create(N_CAP)
+        sessions = _session_table(capacity, min_sigma)
+        vouches = VouchTable.create(E_CAP)
+        for row, (vouchee_slot, bond) in enumerate(vouch_rows):
+            vouches = type(vouches)(
+                **{
+                    **{f: getattr(vouches, f) for f in vouches.__dataclass_fields__},  # type: ignore[attr-defined]
+                    "voucher": vouches.voucher.at[row].set(N_CAP - 1),
+                    "vouchee": vouches.vouchee.at[row].set(vouchee_slot),
+                    "session": vouches.session.at[row].set(0),
+                    "bond": vouches.bond.at[row].set(bond),
+                    "active": vouches.active.at[row].set(True),
+                }
+            )
+
+        # Slot contract: element i lives on shard i // b_local; its agent
+        # row must belong to that shard.
+        slots = np.array(
+            [
+                (i // b_local) * ROWS_PER_SHARD + (i % b_local)
+                for i in range(b)
+            ],
+            np.int32,
+        )
+        out = admit(
+            agents,
+            sessions,
+            vouches,
+            jnp.asarray(slots),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.zeros(b, jnp.int32),           # everyone joins session 0
+            jnp.asarray(np.asarray(sigmas, np.float32)),
+            jnp.asarray(np.asarray(trusts, bool)),
+            jnp.zeros(b, bool),
+            0.0,
+            omega,
+        )
+        new_agents, new_sessions, status, ring, sigma_eff = out
+        contribs = np.zeros(b, np.float32)
+        for vouchee_slot, bond in vouch_rows:
+            contribs[list(slots).index(vouchee_slot)] += bond
+        want_status, want_ring = _host_expected(
+            sigmas, trusts, contribs, omega, capacity, min_sigma
+        )
+        return (
+            np.asarray(status),
+            np.asarray(ring),
+            np.asarray(sigma_eff),
+            new_agents,
+            new_sessions,
+            want_status,
+            want_ring,
+        )
+
+    def test_session_spanning_all_shards_respects_capacity(self):
+        # 16 joiners across 8 shards, 5 seats: exactly the first 5 in
+        # global wave order get in — same as the sequential host engine.
+        sigmas = [0.8] * 16
+        trusts = [True] * 16
+        status, ring, sig, agents, sessions, want_status, want_ring = self._run(
+            sigmas, trusts, capacity=5, min_sigma=0.6
+        )
+        np.testing.assert_array_equal(status, want_status)
+        np.testing.assert_array_equal(ring, want_ring)
+        assert int(np.asarray(sessions.n_participants)[0]) == 5
+
+    def test_mixed_rejections_match_host_engine(self):
+        # Low-sigma (rejected), untrustworthy (sandboxed, floor-exempt),
+        # and normal joiners interleaved across shards.
+        sigmas = [0.8, 0.4, 0.9, 0.3, 0.7, 0.95, 0.2, 0.8] * 2
+        trusts = [True, True, True, False, True, True, True, True] * 2
+        status, ring, sig, agents, sessions, want_status, want_ring = self._run(
+            sigmas, trusts, capacity=16, min_sigma=0.6
+        )
+        np.testing.assert_array_equal(status, want_status)
+        np.testing.assert_array_equal(ring, want_ring)
+
+    def test_vouched_sigma_crosses_shards(self):
+        # The vouchee sits on shard 3; its vouch edge lives in an edge
+        # shard owned by a different device. The psum'd contribution must
+        # still lift it over the floor.
+        b = 16
+        b_local = b // N_DEV
+        sigmas = [0.8] * b
+        lifted = 13  # wave position on shard 6
+        sigmas[lifted] = 0.45
+        slot_of_lifted = (lifted // b_local) * ROWS_PER_SHARD + (
+            lifted % b_local
+        )
+        trusts = [True] * b
+        status, ring, sig, agents, sessions, want_status, want_ring = self._run(
+            sigmas,
+            trusts,
+            capacity=16,
+            min_sigma=0.6,
+            vouch_rows=[(slot_of_lifted, 0.40)],
+            omega=0.5,
+        )
+        np.testing.assert_array_equal(status, want_status)
+        assert status[lifted] == admission.ADMIT_OK
+        assert sig[lifted] == pytest.approx(0.45 + 0.5 * 0.40)
+        assert ring[lifted] == 2
+        # Without the vouch the same agent lands in the sandbox ring
+        # (sigma 0.45 -> Ring 3, floor-exempt) instead of Ring 2.
+        status2, ring2, *_ = self._run(
+            list(sigmas), trusts, capacity=16, min_sigma=0.6
+        )
+        assert status2[lifted] == admission.ADMIT_OK
+        assert ring2[lifted] == 3
+
+    def test_replicated_session_table_identical_on_all_shards(self):
+        sigmas = [0.8] * 16
+        trusts = [True] * 16
+        *_, agents, sessions, _ws, _wr = self._run(
+            sigmas, trusts, capacity=7, min_sigma=0.6
+        )
+        # The replicated table must hold ONE consistent value (a psum'd
+        # actual delta), observable identically from host.
+        assert int(np.asarray(sessions.n_participants)[0]) == 7
+        # Admitted agents landed on their owning shards.
+        dids = np.asarray(agents.did)
+        assert (dids >= 0).sum() == 7

@@ -120,6 +120,7 @@ class TableCapacity:
     max_vouch_edges: int = 65_536
     max_sagas: int = 8_192
     max_steps_per_saga: int = 16
+    max_elevations: int = 4_096
     delta_log_capacity: int = 65_536
     event_log_capacity: int = 65_536
     max_participants_per_session: int = 64

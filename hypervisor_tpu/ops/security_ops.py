@@ -1,0 +1,146 @@
+"""Batched security sweeps: breach detection + elevation expiry on device.
+
+The reference analyzes one agent profile at a time with deque scans
+(`rings/breach_detector.py:79-186`) and ticks elevation records in a
+Python loop (`rings/elevation.py:154-165`). Here the whole agent table
+sweeps in one op:
+
+  * per-agent call counters (total / privileged) live as AgentTable
+    columns, bumped by a scatter-add per action wave,
+  * the breach sweep derives the anomaly rate and severity ladder for
+    every agent at once, trips circuit breakers (FLAG_BREAKER_TRIPPED +
+    cooldown deadline) on HIGH/CRITICAL, un-trips expired breakers, and
+    rolls the window (tumbling-window approximation of the reference's
+    sliding deque — each sweep closes one window),
+  * elevation expiry is a single vector compare over the ElevationTable,
+    and effective rings resolve via a scatter-min of active grants.
+
+Severity codes: 0 NONE, 1 LOW, 2 MEDIUM, 3 HIGH, 4 CRITICAL
+(reference thresholds 0.3/0.5/0.7/0.9, `breach_detector.py:67-72`).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import BreachConfig, DEFAULT_CONFIG
+from hypervisor_tpu.tables.state import (
+    AgentTable,
+    ElevationTable,
+    FLAG_BREAKER_TRIPPED,
+)
+from hypervisor_tpu.tables.struct import replace
+
+SEV_NONE, SEV_LOW, SEV_MEDIUM, SEV_HIGH, SEV_CRITICAL = range(5)
+
+
+def record_calls(
+    agents: AgentTable,
+    slots: jnp.ndarray,       # i32[B] acting agents
+    called_ring: jnp.ndarray, # i8[B] ring each call targeted
+) -> AgentTable:
+    """Bump the breach-window counters for one action wave.
+
+    A call is privileged when it targets a MORE privileged ring than the
+    caller holds (`breach_detector.py:128-135`: lower number = more
+    privileged).
+    """
+    own_ring = agents.ring[slots]
+    privileged = called_ring.astype(jnp.int8) < own_ring
+    return replace(
+        agents,
+        bd_calls=agents.bd_calls.at[slots].add(1),
+        bd_privileged=agents.bd_privileged.at[slots].add(
+            privileged.astype(jnp.int32)
+        ),
+    )
+
+
+class BreachSweep(NamedTuple):
+    agents: AgentTable
+    severity: jnp.ndarray   # i8[N]
+    tripped: jnp.ndarray    # bool[N] breakers tripped THIS sweep
+
+
+def breach_sweep(
+    agents: AgentTable,
+    now: jnp.ndarray | float,
+    config: BreachConfig = DEFAULT_CONFIG.breach,
+) -> BreachSweep:
+    """Analyze every agent's window and run the circuit-breaker ladder."""
+    now_f = jnp.asarray(now, jnp.float32)
+    calls = agents.bd_calls
+    rate = jnp.where(
+        calls >= config.min_calls_for_analysis,
+        agents.bd_privileged.astype(jnp.float32)
+        / jnp.maximum(calls, 1).astype(jnp.float32),
+        0.0,
+    )
+    severity = (
+        (rate >= config.low_threshold).astype(jnp.int8)
+        + (rate >= config.medium_threshold).astype(jnp.int8)
+        + (rate >= config.high_threshold).astype(jnp.int8)
+        + (rate >= config.critical_threshold).astype(jnp.int8)
+    )
+
+    # Trip on HIGH/CRITICAL; un-trip breakers whose cooldown elapsed.
+    trip = severity >= SEV_HIGH
+    expired = ((agents.flags & FLAG_BREAKER_TRIPPED) != 0) & (
+        now_f > agents.bd_breaker_until
+    )
+    flags = agents.flags
+    flags = jnp.where(expired & ~trip, flags & ~FLAG_BREAKER_TRIPPED, flags)
+    flags = jnp.where(trip, flags | FLAG_BREAKER_TRIPPED, flags)
+    breaker_until = jnp.where(
+        trip,
+        now_f + config.circuit_breaker_cooldown_seconds,
+        agents.bd_breaker_until,
+    )
+
+    new_agents = replace(
+        agents,
+        flags=flags.astype(agents.flags.dtype),
+        bd_breaker_until=breaker_until.astype(jnp.float32),
+        # Roll the window: each sweep closes one tumbling window.
+        bd_calls=jnp.zeros_like(agents.bd_calls),
+        bd_privileged=jnp.zeros_like(agents.bd_privileged),
+    )
+    return BreachSweep(agents=new_agents, severity=severity, tripped=trip)
+
+
+def elevation_expiry(
+    elevations: ElevationTable, now: jnp.ndarray | float
+) -> tuple[ElevationTable, jnp.ndarray]:
+    """Deactivate every expired grant; returns (table, expired_mask)."""
+    now_f = jnp.asarray(now, jnp.float32)
+    expired = elevations.active & (now_f > elevations.expires_at)
+    return (
+        replace(elevations, active=elevations.active & ~expired),
+        expired,
+    )
+
+
+def effective_rings(
+    base_ring: jnp.ndarray,        # i8[N] agents' assigned rings
+    elevations: ElevationTable,
+    now: jnp.ndarray | float,
+) -> jnp.ndarray:
+    """i8[N]: each agent's ring with active unexpired grants applied.
+
+    A grant only ever elevates (min with the base ring — lower number =
+    more privileged), matching `elevation.py:138-145`.
+    """
+    now_f = jnp.asarray(now, jnp.float32)
+    live = elevations.active & (now_f <= elevations.expires_at)
+    idx = jnp.clip(elevations.agent, 0)
+    granted = jnp.where(
+        live & (elevations.agent >= 0),
+        elevations.granted_ring,
+        jnp.int8(3),
+    )
+    best_grant = (
+        jnp.full(base_ring.shape, 3, jnp.int8).at[idx].min(granted)
+    )
+    return jnp.minimum(base_ring, best_grant).astype(jnp.int8)

@@ -190,7 +190,11 @@ def sharded_admission(mesh: Mesh, trust: TrustConfig = DEFAULT_CONFIG.trust):
         ok = status == admission_ops.ADMIT_OK
 
         # ── local agent-shard writes ──────────────────────────────────
-        write = jnp.where(ok, local_slot, rows_per_shard - 1)
+        # Scatter at each element's REAL row (distinct by the slot
+        # contract), keeping the old value where rejected — a shared
+        # park row would give rejected lanes a duplicate index that can
+        # clobber an admitted agent landing on that row.
+        write = local_slot
         now_f = jnp.asarray(now, jnp.float32)
         agents = t_replace(
             agents,

@@ -34,6 +34,7 @@ from hypervisor_tpu.tables.intern import InternTable
 from hypervisor_tpu.tables.logs import DeltaLog, EventLog
 from hypervisor_tpu.tables.state import (
     AgentTable,
+    ElevationTable,
     SagaTable,
     SessionTable,
     VouchTable,
@@ -44,6 +45,7 @@ _TABLE_TYPES = {
     "sessions": SessionTable,
     "vouches": VouchTable,
     "sagas": SagaTable,
+    "elevations": ElevationTable,
     "delta_log": DeltaLog,
     "event_log": EventLog,
 }
@@ -91,6 +93,7 @@ def host_metadata(state: HypervisorState) -> dict:
         "next_session_slot": state._next_session_slot,
         "next_saga_slot": state._next_saga_slot,
         "next_edge_slot": state._next_edge_slot,
+        "next_elev_slot": state._next_elev_slot,
         "members": sorted([list(k) for k in state._members]),
         "free_agent_slots": list(state._free_agent_slots),
         "epoch_base": state._epoch_base,
@@ -204,6 +207,7 @@ def restore_state(
     state._next_session_slot = int(meta["next_session_slot"])
     state._next_saga_slot = int(meta.get("next_saga_slot", 0))
     state._next_edge_slot = int(meta.get("next_edge_slot", 0))
+    state._next_elev_slot = int(meta.get("next_elev_slot", 0))
     state._members = {(int(a), int(b)): True for a, b in meta["members"]}
     state._audit_rows = {
         int(k): [int(r) for r in v] for k, v in meta.get("audit_rows", {}).items()

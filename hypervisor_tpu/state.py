@@ -29,7 +29,7 @@ import jax.numpy as jnp
 
 from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.models import SessionConfig, SessionState
-from hypervisor_tpu.ops import admission, saga_ops
+from hypervisor_tpu.ops import admission, saga_ops, security_ops
 from hypervisor_tpu.ops import merkle as merkle_ops
 from hypervisor_tpu.ops import pipeline as pipeline_ops
 from hypervisor_tpu.ops import terminate as terminate_ops
@@ -37,6 +37,7 @@ from hypervisor_tpu.tables.intern import InternTable
 from hypervisor_tpu.tables.logs import DeltaLog, EventLog
 from hypervisor_tpu.tables.state import (
     AgentTable,
+    ElevationTable,
     SagaTable,
     SessionTable,
     VouchTable,
@@ -49,6 +50,10 @@ _ADMIT = jax.jit(admission.admit_batch)
 _SAGA_TICK = jax.jit(saga_ops.saga_table_tick)
 _TERMINATE = jax.jit(terminate_ops.terminate_batch, static_argnames=("use_pallas",))
 _WAVE = jax.jit(pipeline_ops.governance_wave, static_argnames=("use_pallas",))
+_RECORD_CALLS = jax.jit(security_ops.record_calls)
+_BREACH_SWEEP = jax.jit(security_ops.breach_sweep)
+_ELEV_EXPIRY = jax.jit(security_ops.elevation_expiry)
+_EFF_RINGS = jax.jit(security_ops.effective_rings)
 
 
 class HypervisorState:
@@ -61,6 +66,7 @@ class HypervisorState:
         self.sessions = SessionTable.create(cap.max_sessions)
         self.vouches = VouchTable.create(cap.max_vouch_edges)
         self.sagas = SagaTable.create(cap.max_sagas, cap.max_steps_per_saga)
+        self.elevations = ElevationTable.create(cap.max_elevations)
         self.delta_log = DeltaLog.create(cap.delta_log_capacity)
         self.event_log = EventLog.create(cap.event_log_capacity)
 
@@ -71,6 +77,8 @@ class HypervisorState:
         self._next_session_slot = 0
         self._next_saga_slot = 0
         self._next_edge_slot = 0
+        self._next_elev_slot = 0
+        self._free_elev_slots: list[int] = []
         self._members: dict[tuple[int, int], bool] = {}  # (session, did) -> True
         self._slot_of_did: dict[int, int] = {}           # did handle -> agent slot
         self._free_agent_slots: list[int] = []           # reclaimed from rejects
@@ -487,6 +495,86 @@ class HypervisorState:
             saga_ops.saga_table_done(self.sagas.saga_state, self.sagas.session)
         )[:g]
         return bool(done.all())
+
+    # ── security sweeps ──────────────────────────────────────────────
+
+    def record_calls(
+        self, agent_slots: Sequence[int], called_rings: Sequence[int]
+    ) -> None:
+        """Bump breach-window counters for one action wave."""
+        self.agents = _RECORD_CALLS(
+            self.agents,
+            jnp.asarray(np.asarray(agent_slots, np.int32)),
+            jnp.asarray(np.asarray(called_rings, np.int8)),
+        )
+
+    def breach_sweep_tick(self, now: float) -> tuple[np.ndarray, np.ndarray]:
+        """Run the batched breach analysis; returns (severity, tripped)."""
+        result = _BREACH_SWEEP(self.agents, now)
+        self.agents = result.agents
+        return np.asarray(result.severity), np.asarray(result.tripped)
+
+    def grant_elevation(
+        self,
+        agent_slot: int,
+        granted_ring: int,
+        now: float,
+        ttl_seconds: Optional[float] = None,
+    ) -> int:
+        """Grant a sudo-with-TTL elevation; returns the elevation row.
+
+        Reference rules (`rings/elevation.py:87-108`): the grant must be
+        MORE privileged than the agent's ring, Ring 0 is never grantable,
+        and the TTL is capped.
+        """
+        cfg = self.config.elevation
+        if granted_ring == 0:
+            raise ValueError("Ring 0 cannot be granted by elevation")
+        current = int(np.asarray(self.agents.ring)[agent_slot])
+        if granted_ring >= current:
+            raise ValueError(
+                f"elevation must be more privileged: agent holds ring "
+                f"{current}, requested {granted_ring}"
+            )
+        ttl = min(
+            ttl_seconds if ttl_seconds is not None else cfg.default_ttl_seconds,
+            cfg.max_ttl_seconds,
+        )
+        if self._free_elev_slots:
+            row = self._free_elev_slots.pop()
+        elif self._next_elev_slot < self.elevations.agent.shape[0]:
+            row = self._next_elev_slot
+            self._next_elev_slot += 1
+        else:
+            raise RuntimeError("elevation table full")
+        self.elevations = replace(
+            self.elevations,
+            agent=self.elevations.agent.at[row].set(agent_slot),
+            granted_ring=self.elevations.granted_ring.at[row].set(granted_ring),
+            expires_at=self.elevations.expires_at.at[row].set(now + ttl),
+            active=self.elevations.active.at[row].set(True),
+        )
+        return row
+
+    def elevation_tick(self, now: float) -> int:
+        """Expire every lapsed grant; returns how many expired.
+
+        Expired rows are freed (agent = -1) and reclaimed by later
+        grants, so the table never fills with dead grants.
+        """
+        self.elevations, expired = _ELEV_EXPIRY(self.elevations, now)
+        rows = np.nonzero(np.asarray(expired))[0]
+        if len(rows):
+            self.elevations = replace(
+                self.elevations,
+                agent=self.elevations.agent.at[jnp.asarray(rows)].set(-1),
+            )
+            self._free_elev_slots.extend(int(r) for r in rows)
+        return len(rows)
+
+    def effective_rings(self, now: float) -> np.ndarray:
+        """i8[N] assigned rings with active elevations applied."""
+        return np.asarray(_EFF_RINGS(self.agents.ring, self.elevations, now))
 
     # ── audit deltas ─────────────────────────────────────────────────
 

@@ -37,6 +37,9 @@ class AgentTable:
     risk_score: jnp.ndarray   # f32[N]  liability-ledger accumulator
     rl_tokens: jnp.ndarray    # f32[N]  rate-limiter token bucket level
     rl_stamp: jnp.ndarray     # f32[N]  last refill time
+    bd_calls: jnp.ndarray       # i32[N] breach window: total calls
+    bd_privileged: jnp.ndarray  # i32[N] breach window: calls above own ring
+    bd_breaker_until: jnp.ndarray  # f32[N] circuit breaker cooldown deadline
 
     @staticmethod
     def create(capacity: int) -> "AgentTable":
@@ -51,6 +54,9 @@ class AgentTable:
             risk_score=jnp.zeros((capacity,), jnp.float32),
             rl_tokens=jnp.zeros((capacity,), jnp.float32),
             rl_stamp=jnp.zeros((capacity,), jnp.float32),
+            bd_calls=jnp.zeros((capacity,), jnp.int32),
+            bd_privileged=jnp.zeros((capacity,), jnp.int32),
+            bd_breaker_until=jnp.zeros((capacity,), jnp.float32),
         )
 
 
@@ -83,6 +89,31 @@ class SessionTable:
             created_at=z32,
             terminated_at=z32,
             has_nonreversible=jnp.zeros((capacity,), bool),
+        )
+
+
+@table
+class ElevationTable:
+    """[M] sudo-with-TTL ring elevations (reference `rings/elevation.py`).
+
+    Expiry sweeps and effective-ring resolution are vectorized over these
+    columns (`ops.security_ops.elevation_expiry` / `effective_rings`)
+    instead of the reference's per-record tick loop
+    (`elevation.py:154-165`).
+    """
+
+    agent: jnp.ndarray         # i32[M] agent slot (-1 = free)
+    granted_ring: jnp.ndarray  # i8[M]  temporary (more privileged) ring
+    expires_at: jnp.ndarray    # f32[M]
+    active: jnp.ndarray        # bool[M]
+
+    @staticmethod
+    def create(capacity: int) -> "ElevationTable":
+        return ElevationTable(
+            agent=jnp.full((capacity,), -1, jnp.int32),
+            granted_ring=jnp.full((capacity,), 3, jnp.int8),
+            expires_at=jnp.zeros((capacity,), jnp.float32),
+            active=jnp.zeros((capacity,), bool),
         )
 
 

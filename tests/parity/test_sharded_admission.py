@@ -23,6 +23,7 @@ from hypervisor_tpu.session import (
     SharedSessionObject,
 )
 from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.struct import replace as t_replace
 
 N_DEV = 8
 ROWS_PER_SHARD = 8
@@ -37,13 +38,11 @@ def _mesh():
 
 def _session_table(max_participants: int, min_sigma: float) -> SessionTable:
     t = SessionTable.create(S_CAP)
-    return type(t)(
-        **{
-            **{f: getattr(t, f) for f in t.__dataclass_fields__},  # type: ignore[attr-defined]
-            "state": t.state.at[0].set(1),  # HANDSHAKING
-            "max_participants": t.max_participants.at[0].set(max_participants),
-            "min_sigma_eff": t.min_sigma_eff.at[0].set(min_sigma),
-        }
+    return t_replace(
+        t,
+        state=t.state.at[0].set(1),  # HANDSHAKING
+        max_participants=t.max_participants.at[0].set(max_participants),
+        min_sigma_eff=t.min_sigma_eff.at[0].set(min_sigma),
     )
 
 
@@ -86,15 +85,13 @@ class TestShardedAdmission:
         sessions = _session_table(capacity, min_sigma)
         vouches = VouchTable.create(E_CAP)
         for row, (vouchee_slot, bond) in enumerate(vouch_rows):
-            vouches = type(vouches)(
-                **{
-                    **{f: getattr(vouches, f) for f in vouches.__dataclass_fields__},  # type: ignore[attr-defined]
-                    "voucher": vouches.voucher.at[row].set(N_CAP - 1),
-                    "vouchee": vouches.vouchee.at[row].set(vouchee_slot),
-                    "session": vouches.session.at[row].set(0),
-                    "bond": vouches.bond.at[row].set(bond),
-                    "active": vouches.active.at[row].set(True),
-                }
+            vouches = t_replace(
+                vouches,
+                voucher=vouches.voucher.at[row].set(N_CAP - 1),
+                vouchee=vouches.vouchee.at[row].set(vouchee_slot),
+                session=vouches.session.at[row].set(0),
+                bond=vouches.bond.at[row].set(bond),
+                active=vouches.active.at[row].set(True),
             )
 
         # Slot contract: element i lives on shard i // b_local; its agent

@@ -254,6 +254,38 @@ def build_benchmarks(quick: bool):
         vouch, base_sigma, seeds,
     ), n_agents
 
+    # ── vouch_bond_slash_10k: north-star scale on the MXU path ─────────
+    # Multi-tile matmul cascade (kernels/liability_pallas) — the Pallas
+    # kernel on TPU, its bit-identical dense twin elsewhere.
+    n10 = 2_048 if quick else 10_240
+    e10 = 8_192
+    vouch10 = dataclasses.replace(
+        VouchTable.create(e10),
+        voucher=jnp.asarray(rng.randint(0, n10, e10, dtype=np.int64), jnp.int32),
+        vouchee=jnp.asarray(rng.randint(0, n10, e10, dtype=np.int64), jnp.int32),
+        session=jnp.zeros((e10,), jnp.int32),
+        bond=jnp.asarray(rng.uniform(0.05, 0.2, e10).astype(np.float32)),
+        active=jnp.ones((e10,), bool),
+        expiry=jnp.full((e10,), np.inf, jnp.float32),
+    )
+    sigma10 = jnp.asarray(rng.uniform(0.4, 0.9, n10).astype(np.float32))
+    seeds10 = jnp.zeros((n10,), bool).at[jnp.asarray(
+        rng.choice(n10, 128, replace=False))].set(True)
+    from hypervisor_tpu.kernels.liability_pallas import (
+        slash_cascade_dense,
+        slash_cascade_pallas,
+    )
+    from hypervisor_tpu.kernels.sha256_pallas import pallas_available
+
+    mxu_slash = slash_cascade_pallas if pallas_available() else slash_cascade_dense
+
+    def slash10k(v, sig, seeds):
+        return mxu_slash(v, sig, seeds, 0, 0.95, 0.0).sigma
+
+    yield "vouch_bond_slash_10k_mxu", slash10k, (
+        vouch10, sigma10, seeds10,
+    ), n10
+
     # ── full_governance_pipeline (headline) ────────────────────────────
     t = 3
     bodies3 = jnp.asarray(

@@ -1,32 +1,34 @@
-"""Pallas TPU kernel: vouch/bond/slash batch accounting on the MXU.
+"""Pallas TPU kernels: vouch/bond/slash batch accounting on the MXU.
 
 The XLA implementation (`ops.liability.slash_cascade`) expresses the
 cascade with scatters (`.at[].add` / `.at[].max`) and gathers — memory-
-bound shuffles on TPU. This kernel reformulates every scatter/gather as a
-dense masked matmul so the whole cascade runs on the MXU:
+bound shuffles on TPU. Here every scatter/gather is a dense masked
+matmul so the cascade's heavy passes run on the MXU:
 
   wave_hit[e] = Σ_n wave[n]·(vouchee[e]==n)      (gather -> matvec)
   k[n]        = Σ_e hit[e]·(voucher[e]==n)       (scatter-add -> matvec)
   has_vchr[n] = Σ_e live[e]·(vouchee[e]==n) > 0  (scatter-max -> matvec)
 
 Equality one-hot tiles are built on the fly from `broadcasted_iota` per
-512-edge chunk (never materialised in HBM), and the depth-bounded wave
-loop (`slashing.py:124-141` semantics in /root/reference) is unrolled.
+(agent-tile, edge-chunk) grid cell — never materialized in HBM — and
+the agent axis is MULTI-TILE: a grid dimension walks 1024-agent tiles
+with revisited-output accumulation, so 10k+ agents stay on the MXU path
+(round-1 capped at one tile). The depth-bounded wave loop
+(`slashing.py:124-141` semantics in /root/reference) runs as XLA
+elementwise glue BETWEEN kernel passes:
 
-Capacity: one agent tile — N ≤ 1024 agents per call (the BASELINE batch
-config is 1k DIDs); E is unbounded (chunked). Larger agent tables fall
-back to the XLA path (`ops.liability.slash_cascade`).
+  per wave:  [gather kernel] -> hit -> [scatter kernel] -> k, has_vchr
+             -> clip sigma / seed next wave (elementwise, XLA-fused)
 
-`slash_cascade_dense` is the identical matmul formulation as plain jnp —
-the CPU-testable twin used for parity (Mosaic interpret mode is unusable
-in the CPU test env; see kernels/sha256_pallas.py).
+`slash_cascade_dense` is the identical math as plain jnp — the
+CPU-testable twin used for parity (Mosaic interpret mode is unusable in
+the CPU test env; see kernels/sha256_pallas.py).
 """
 
 from __future__ import annotations
 
 import functools
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -42,8 +44,8 @@ try:  # pragma: no cover - import guard
 except Exception:  # pragma: no cover
     _PALLAS_IMPORTED = False
 
-N_TILE = 1024   # one agent tile: 8 sublanes x 128 lanes
-E_CHUNK = 256   # edges per matmul chunk (keeps one-hot tiles inside VMEM)
+N_TILE = 1024   # agents per tile: 8 sublanes x 128 lanes
+E_CHUNK = 256   # edges per chunk (keeps one-hot tiles inside VMEM)
 
 
 def _dot(a, b, dims):
@@ -55,71 +57,160 @@ def _dot(a, b, dims):
     )
 
 
-def _wave_pass(n, iota_n, vchr, vee, sess_ok, live_f, wave, sigma,
-               omega, floor):
-    """One cascade wave in dense-matmul form. All agent vectors [1, n],
-    all edge vectors [1, e]; returns updated (sigma, k, hit, has_vchr)."""
-    e = vchr.shape[1]
-    hit_parts = []
+# ── Pallas kernels (multi-tile agent axis) ──────────────────────────────
+
+
+def _gather_kernel(vee_ref, wave_ref, hit_ref):
+    """hit[e] += Σ_{n in tile} wave[n]·(vouchee[e]==n); grid (te, ta)."""
+    ta = pl.program_id(1)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (E_CHUNK, N_TILE), 1)
+    eq = (vee_ref[0, :].reshape(E_CHUNK, 1) == iota + ta * N_TILE).astype(
+        jnp.bfloat16
+    )
+    part = _dot(wave_ref[:], eq, ((1,), (1,)))  # [1, E_CHUNK]
+
+    @pl.when(ta == 0)
+    def _init():
+        hit_ref[:] = part
+
+    @pl.when(ta != 0)
+    def _acc():
+        hit_ref[:] = hit_ref[:] + part
+
+
+def _scatter_kernel(vchr_ref, vee_ref, hit_ref, nothit_ref, k_ref, hv_ref):
+    """k[n] += Σ_e hit[e]·(voucher[e]==n); hv likewise; grid (ta, te)."""
+    ta = pl.program_id(0)
+    te = pl.program_id(1)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (E_CHUNK, N_TILE), 1)
+    eq_vchr = (
+        vchr_ref[0, :].reshape(E_CHUNK, 1) == iota + ta * N_TILE
+    ).astype(jnp.bfloat16)
+    eq_vee = (
+        vee_ref[0, :].reshape(E_CHUNK, 1) == iota + ta * N_TILE
+    ).astype(jnp.bfloat16)
+    k_part = _dot(hit_ref[:], eq_vchr, ((1,), (0,)))       # [1, N_TILE]
+    hv_part = _dot(nothit_ref[:], eq_vee, ((1,), (0,)))    # [1, N_TILE]
+
+    @pl.when(te == 0)
+    def _init():
+        k_ref[:] = k_part
+        hv_ref[:] = hv_part
+
+    @pl.when(te != 0)
+    def _acc():
+        k_ref[:] = k_ref[:] + k_part
+        hv_ref[:] = hv_ref[:] + hv_part
+
+
+def _gather_pallas(wave, vee, e, n):
+    t_e, t_a = e // E_CHUNK, n // N_TILE
+    return pl.pallas_call(
+        _gather_kernel,
+        grid=(t_e, t_a),
+        in_specs=[
+            pl.BlockSpec((1, E_CHUNK), lambda te, ta: (0, te)),
+            pl.BlockSpec((1, N_TILE), lambda te, ta: (0, ta)),
+        ],
+        out_specs=pl.BlockSpec((1, E_CHUNK), lambda te, ta: (0, te)),
+        out_shape=jax.ShapeDtypeStruct((1, e), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+    )(vee, wave)
+
+
+def _scatter_pallas(vchr, vee, hit, nothit, e, n):
+    t_e, t_a = e // E_CHUNK, n // N_TILE
+    return pl.pallas_call(
+        _scatter_kernel,
+        grid=(t_a, t_e),
+        in_specs=[
+            pl.BlockSpec((1, E_CHUNK), lambda ta, te: (0, te)),
+            pl.BlockSpec((1, E_CHUNK), lambda ta, te: (0, te)),
+            pl.BlockSpec((1, E_CHUNK), lambda ta, te: (0, te)),
+            pl.BlockSpec((1, E_CHUNK), lambda ta, te: (0, te)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, N_TILE), lambda ta, te: (0, ta)),
+            pl.BlockSpec((1, N_TILE), lambda ta, te: (0, ta)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+    )(vchr, vee, hit, nothit)
+
+
+# ── dense twins (plain XLA; same math, any backend) ─────────────────────
+
+
+def _gather_dense(wave, vee, e, n):
+    iota = jnp.arange(n, dtype=jnp.int32)
+    parts = []
+    for c in range(0, e, E_CHUNK):
+        eq = (vee[0, c:c + E_CHUNK, None] == iota[None, :]).astype(jnp.bfloat16)
+        parts.append(_dot(wave, eq, ((1,), (1,))))
+    return jnp.concatenate(parts, axis=1)
+
+
+def _scatter_dense(vchr, vee, hit, nothit, e, n):
+    iota = jnp.arange(n, dtype=jnp.int32)
     k = jnp.zeros((1, n), jnp.float32)
     hv = jnp.zeros((1, n), jnp.float32)
     for c in range(0, e, E_CHUNK):
-        # static offsets: plain slices (Mosaic has no dynamic_slice)
-        vchr_c = vchr[:, c:c + E_CHUNK]
-        vee_c = vee[:, c:c + E_CHUNK]
-        live_c = live_f[:, c:c + E_CHUNK]
-        sess_c = sess_ok[:, c:c + E_CHUNK]
-
-        # [E_CHUNK, n] one-hot equality tiles. bf16 halves VMEM: 0/1 are
-        # exact in bf16 and the MXU accumulates in f32.
-        eq_vee = (vee_c.reshape(E_CHUNK, 1) == iota_n).astype(jnp.bfloat16)
-        eq_vchr = (vchr_c.reshape(E_CHUNK, 1) == iota_n).astype(jnp.bfloat16)
-
-        # gather wave[vouchee[e]] -> matvec over the agent axis
-        wave_hit = _dot(wave, eq_vee, ((1,), (1,)))          # [1, E_CHUNK]
-        hit_c = wave_hit * live_c * sess_c                   # f32 0/1
-        hit_parts.append(hit_c)
-
-        # scatter-add k[voucher[e]] -> matvec over the edge axis
-        k = k + _dot(hit_c, eq_vchr, ((1,), (0,)))           # [1, n]
-        # scatter-max has_vouchers[vouchee[e]] (live post-release edges
-        # handled by caller passing updated live_f on the next wave)
-        hv = hv + _dot(live_c * sess_c * (1.0 - hit_c), eq_vee, ((1,), (0,)))
-
-    hit = jnp.concatenate(hit_parts, axis=1)                 # [1, e]
-    was_clipped = k > 0.0
-    clip_sigma = jnp.maximum(sigma * jnp.power(1.0 - omega, k), floor)
-    sigma = jnp.where(was_clipped, clip_sigma, sigma)
-    return sigma, was_clipped, hit, hv > 0.0
+        eq_vchr = (vchr[0, c:c + E_CHUNK, None] == iota[None, :]).astype(
+            jnp.bfloat16
+        )
+        eq_vee = (vee[0, c:c + E_CHUNK, None] == iota[None, :]).astype(
+            jnp.bfloat16
+        )
+        k = k + _dot(hit[:, c:c + E_CHUNK], eq_vchr, ((1,), (0,)))
+        hv = hv + _dot(nothit[:, c:c + E_CHUNK], eq_vee, ((1,), (0,)))
+    return k, hv
 
 
-def _cascade_math(vchr, vee, session, active_f, expiry, sigma, seeds,
-                  omega, sess, now, trust: TrustConfig):
-    """Shared wave-loop body (identical under Pallas and plain XLA).
+# ── wave loop (XLA glue around either pass implementation) ──────────────
 
-    All inputs 2D rows: agent vectors [1, n], edge vectors [1, e].
-    """
+
+def _cascade(rows, omega, sess, now, trust: TrustConfig, use_pallas: bool):
+    """Depth-bounded cascade; heavy passes via Pallas or dense twins."""
+    vchr, vee, session = rows["vchr"], rows["vee"], rows["sess"]
+    sigma, seeds = rows["sigma"], rows["seeds"]
+    e = vchr.shape[1]
     n = sigma.shape[1]
-    iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)  # [1, n]
+
     slashed = jnp.zeros((1, n), bool)
     clipped_any = jnp.zeros((1, n), bool)
     wave_of = jnp.full((1, n), -1, jnp.int32)
     wave_b = seeds != 0.0
-    live_base = active_f * (now <= expiry).astype(jnp.float32)
-    hit_any = jnp.zeros_like(live_base)  # edges whose bond was consumed
+    live = rows["act"] * (now <= rows["exp"]).astype(jnp.float32)
+    sess_ok = (session == sess).astype(jnp.float32)
+    hit_any = jnp.zeros((1, e), jnp.float32)
+
+    gather = _gather_pallas if use_pallas else _gather_dense
+    scatter = _scatter_pallas if use_pallas else _scatter_dense
 
     for depth in range(trust.max_cascade_depth + 1):
         sigma = jnp.where(wave_b, 0.0, sigma)
         slashed = slashed | wave_b
         wave_of = jnp.where(wave_b & (wave_of < 0), depth, wave_of)
 
-        sess_ok = (session == sess).astype(jnp.float32)
-        sigma, was_clipped, hit, has_vchr = _wave_pass(
-            n, iota_n, vchr, vee, sess_ok, live_base,
-            wave_b.astype(jnp.float32), sigma, omega, trust.sigma_floor,
+        wave_hit = gather(wave_b.astype(jnp.float32), vee, e, n)
+        hit = wave_hit * live * sess_ok                       # [1, e]
+        nothit = live * sess_ok * (1.0 - hit)
+        k, hv = scatter(vchr, vee, hit, nothit, e, n)
+
+        was_clipped = k > 0.0
+        clip_sigma = jnp.maximum(
+            sigma * jnp.power(1.0 - omega, k), trust.sigma_floor
         )
+        sigma = jnp.where(was_clipped, clip_sigma, sigma)
         clipped_any = clipped_any | was_clipped
-        live_base = live_base * (1.0 - hit)  # release consumed bonds
+        live = live * (1.0 - hit)  # release consumed bonds
         hit_any = jnp.maximum(hit_any, hit)
 
         if depth == trust.max_cascade_depth:
@@ -127,36 +218,18 @@ def _cascade_math(vchr, vee, session, active_f, expiry, sigma, seeds,
         wiped = was_clipped & (
             sigma < trust.sigma_floor + trust.cascade_wipe_epsilon
         )
-        wave_b = wiped & has_vchr & ~slashed
+        wave_b = wiped & (hv > 0.0) & ~slashed
 
     return sigma, hit_any, slashed, clipped_any, wave_of
-
-
-def _kernel(trust, vchr_ref, vee_ref, sess_ref, act_ref, exp_ref,
-            sigma_ref, seeds_ref, scal_ref,
-            sigma_out, live_out, slashed_out, clipped_out, wave_out):
-    omega = scal_ref[0, 0]
-    sess = scal_ref[0, 1].astype(jnp.int32)
-    now = scal_ref[0, 2]
-    sigma, consumed, slashed, clipped, wave_of = _cascade_math(
-        vchr_ref[:], vee_ref[:], sess_ref[:], act_ref[:],
-        exp_ref[:], sigma_ref[:], seeds_ref[:], omega, sess, now, trust,
-    )
-    sigma_out[:] = sigma
-    live_out[:] = consumed
-    slashed_out[:] = slashed.astype(jnp.int32)
-    clipped_out[:] = clipped.astype(jnp.int32)
-    wave_out[:] = wave_of
 
 
 def _prep(vouch: VouchTable, sigma, seeds):
     """Pad/reshape to kernel layout. Returns (rows dict, n, e)."""
     n = sigma.shape[0]
-    if n > N_TILE:
-        raise ValueError(f"pallas cascade supports N <= {N_TILE}, got {n}")
+    n_pad = -(-max(n, 1) // N_TILE) * N_TILE
     e = vouch.voucher.shape[0]
-    # At least one (inert, fully padded) chunk so the wave loop and the
-    # final concatenate are well-formed when the edge table is empty.
+    # At least one (inert, fully padded) chunk so the wave loop is
+    # well-formed when the edge table is empty.
     ep = max(E_CHUNK, -(-e // E_CHUNK) * E_CHUNK)
     pad_e = ep - e
 
@@ -164,7 +237,7 @@ def _prep(vouch: VouchTable, sigma, seeds):
         return jnp.pad(x, (0, pad_e), constant_values=fill)[None, :]
 
     def arow(x, fill):
-        return jnp.pad(x, (0, N_TILE - n), constant_values=fill)[None, :]
+        return jnp.pad(x, (0, n_pad - n), constant_values=fill)[None, :]
 
     return {
         "vchr": erow(vouch.voucher, -1),
@@ -177,30 +250,26 @@ def _prep(vouch: VouchTable, sigma, seeds):
     }, n, e
 
 
-@functools.partial(jax.jit, static_argnames=("trust",))
-def _run_pallas(rows, scalars, trust):
-    e = rows["vchr"].shape[1]
-    spec = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
-    outs = pl.pallas_call(
-        functools.partial(_kernel, trust),
-        in_specs=[spec() for _ in range(7)]
-        + [pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_specs=tuple(spec() for _ in range(5)),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=64 * 1024 * 1024,
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((1, N_TILE), jnp.float32),   # sigma
-            jax.ShapeDtypeStruct((1, e), jnp.float32),        # consumed
-            jax.ShapeDtypeStruct((1, N_TILE), jnp.int32),     # slashed
-            jax.ShapeDtypeStruct((1, N_TILE), jnp.int32),     # clipped
-            jax.ShapeDtypeStruct((1, N_TILE), jnp.int32),     # wave_of
-        ),
-    )(
-        rows["vchr"], rows["vee"], rows["sess"], rows["act"],
-        rows["exp"], rows["sigma"], rows["seeds"], scalars,
+@functools.partial(jax.jit, static_argnames=("trust", "use_pallas"))
+def _run(rows, scalars, trust, use_pallas):
+    omega = scalars[0]
+    sess = scalars[1].astype(jnp.int32)
+    now = scalars[2]
+    return _cascade(rows, omega, sess, now, trust, use_pallas)
+
+
+def _finish(vouch, outs, n, e):
+    from hypervisor_tpu.ops.liability import SlashWaveResult
+
+    sigma, consumed, slashed, clipped, wave_of = outs
+    new_active = vouch.active & ~(consumed[0, :e] > 0.0)
+    return SlashWaveResult(
+        sigma=sigma[0, :n],
+        vouch=replace(vouch, active=new_active),
+        slashed=slashed[0, :n],
+        clipped=clipped[0, :n],
+        wave_of=wave_of[0, :n].astype(jnp.int8),
     )
-    return outs
 
 
 def slash_cascade_pallas(
@@ -212,25 +281,13 @@ def slash_cascade_pallas(
     now,
     trust: TrustConfig = DEFAULT_CONFIG.trust,
 ):
-    """MXU-formulated slash cascade; result-compatible with
-    `ops.liability.slash_cascade` (returns the same SlashWaveResult)."""
-    from hypervisor_tpu.ops.liability import SlashWaveResult
-
+    """MXU-formulated slash cascade, any N (multi-tile agent axis);
+    result-compatible with `ops.liability.slash_cascade`."""
     rows, n, e = _prep(vouch, sigma, seeds)
     scalars = jnp.array(
-        [[float(risk_weight), float(session_slot), float(now)]], jnp.float32
+        [float(risk_weight), float(session_slot), float(now)], jnp.float32
     )
-    out_sigma, consumed, slashed, clipped, wave_of = _run_pallas(
-        rows, scalars, trust
-    )
-    new_active = vouch.active & ~(consumed[0, :e] > 0.0)
-    return SlashWaveResult(
-        sigma=out_sigma[0, :n],
-        vouch=replace(vouch, active=new_active),
-        slashed=slashed[0, :n] != 0,
-        clipped=clipped[0, :n] != 0,
-        wave_of=wave_of[0, :n].astype(jnp.int8),
-    )
+    return _finish(vouch, _run(rows, scalars, trust, True), n, e)
 
 
 def slash_cascade_dense(
@@ -242,21 +299,9 @@ def slash_cascade_dense(
     now,
     trust: TrustConfig = DEFAULT_CONFIG.trust,
 ):
-    """The kernel's exact matmul math as plain XLA (CPU parity twin)."""
-    from hypervisor_tpu.ops.liability import SlashWaveResult
-
+    """The kernels' exact matmul math as plain XLA (CPU parity twin)."""
     rows, n, e = _prep(vouch, sigma, seeds)
-    out_sigma, consumed, slashed, clipped, wave_of = _cascade_math(
-        rows["vchr"], rows["vee"], rows["sess"], rows["act"],
-        rows["exp"], rows["sigma"], rows["seeds"],
-        jnp.float32(risk_weight), jnp.int32(session_slot), jnp.float32(now),
-        trust,
+    scalars = jnp.array(
+        [float(risk_weight), float(session_slot), float(now)], jnp.float32
     )
-    new_active = vouch.active & ~(consumed[0, :e] > 0.0)
-    return SlashWaveResult(
-        sigma=out_sigma[0, :n],
-        vouch=replace(vouch, active=new_active),
-        slashed=slashed[0, :n],
-        clipped=clipped[0, :n],
-        wave_of=wave_of[0, :n].astype(jnp.int8),
-    )
+    return _finish(vouch, _run(rows, scalars, trust, False), n, e)

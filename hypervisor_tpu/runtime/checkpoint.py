@@ -96,6 +96,7 @@ def host_metadata(state: HypervisorState) -> dict:
         "next_elev_slot": state._next_elev_slot,
         "members": sorted([list(k) for k in state._members]),
         "free_agent_slots": list(state._free_agent_slots),
+        "free_elev_slots": list(state._free_elev_slots),
         "epoch_base": state._epoch_base,
         "audit_rows": {str(k): v for k, v in state._audit_rows.items()},
         "chain_seed": {
@@ -182,22 +183,36 @@ def restore_state(
     saved_capacity = meta.get("capacity")
     if saved_capacity is not None:
         live_capacity = dataclasses.asdict(config.capacity)
-        if saved_capacity != live_capacity:
-            diff = {
-                k: (saved_capacity[k], live_capacity.get(k))
-                for k in saved_capacity
-                if saved_capacity[k] != live_capacity.get(k)
-            }
+        # Compare only the keys the checkpoint recorded: capacity fields
+        # added in later versions (e.g. max_elevations) must not brick
+        # older checkpoints.
+        diff = {
+            k: (saved_capacity[k], live_capacity.get(k))
+            for k in saved_capacity
+            if k in live_capacity and saved_capacity[k] != live_capacity[k]
+        }
+        if diff:
             raise ValueError(
                 f"checkpoint capacity mismatch (saved, restore): {diff}"
             )
 
     state = HypervisorState(config)
     for tname, ttype in _TABLE_TYPES.items():
+        fields = dataclasses.fields(ttype)
+        if f"{tname}.{fields[0].name}" not in data:
+            continue  # table added after this checkpoint was written
         cols = {
             f.name: jnp.asarray(data[f"{tname}.{f.name}"])
-            for f in dataclasses.fields(ttype)
+            for f in fields
+            if f"{tname}.{f.name}" in data
         }
+        missing = [f.name for f in fields if f.name not in cols]
+        if missing:
+            # Columns added after the save keep their freshly-created
+            # defaults (shape-compatible by the capacity check above).
+            fresh = getattr(state, tname)
+            for name in missing:
+                cols[name] = getattr(fresh, name)
         setattr(state, tname, ttype(**cols))
 
     state.agent_ids = _intern_load(meta["agent_ids"])
@@ -219,6 +234,9 @@ def restore_state(
     state._turns = {int(k): int(v) for k, v in meta.get("turns", {}).items()}
     state._free_agent_slots = [
         int(r) for r in meta.get("free_agent_slots", [])
+    ]
+    state._free_elev_slots = [
+        int(r) for r in meta.get("free_elev_slots", [])
     ]
     state._epoch_base = float(meta.get("epoch_base", state._epoch_base))
     # Ring-buffer row ownership comes straight from the saved session

@@ -39,7 +39,7 @@ WRITE_RATE_LIMITED = 1
 WRITE_CONFLICT = 2
 
 _PREPASS = jax.jit(clock_ops.batched_write_prepass)
-_CONSUME = jax.jit(rate_limit.consume)
+_CONSUME = jax.jit(rate_limit.consume, static_argnames=("config",))
 
 
 def _occurrence_order(rows: np.ndarray) -> np.ndarray:
@@ -115,8 +115,10 @@ class WriteWave:
 
         # ── gate 1: token buckets, one consume per writer occurrence ───
         for row, (_, _, _, ring) in zip(writer_rows, staged):
-            if not self._rl_primed[row]:
-                # Fresh bucket: full burst for the writer's ring.
+            if not self._rl_primed[row] or self._rl_ring[row] != ring:
+                # Fresh bucket — or a ring change, which recreates the
+                # bucket at the new ring's full burst
+                # (`rate_limiter.py:132-149` semantics).
                 self._rl_primed[row] = True
                 self._rl_ring[row] = ring
                 self._rl_tokens = self._rl_tokens.at[row].set(
@@ -135,6 +137,7 @@ class WriteWave:
                 jnp.asarray(self._rl_ring),
                 now,
                 jnp.asarray(cost),
+                config=self._rate_config,
             )
             self._rl_tokens = decision.tokens
             self._rl_stamp = decision.stamp

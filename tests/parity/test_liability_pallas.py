@@ -89,3 +89,24 @@ def test_compiled_pallas_cascade_matches_on_tpu():
     want = slash_cascade(v, sigma, seeds, 0, 0.95, 0.0)
     got = slash_cascade_pallas(v, sigma, seeds, 0, 0.95, 0.0)
     _assert_matches(got, want)
+
+
+def test_dense_math_matches_at_10k_agents():
+    """The 10k north-star config runs the multi-tile matmul formulation
+    (round 1 capped the kernel at one 1024-agent tile)."""
+    v, sigma, seeds = random_graph(seed=6, n_agents=10_000, n_edges=8192)
+    want = slash_cascade(v, sigma, seeds, 0, 0.95, 0.0)
+    got = slash_cascade_dense(v, sigma, seeds, 0, 0.95, 0.0)
+    _assert_matches(got, want)
+
+
+@pytest.mark.skipif(
+    not pallas_available(),
+    reason="compiled Mosaic kernel needs a TPU backend "
+    "(opt in with HV_TPU_TESTS=1)",
+)
+def test_compiled_pallas_cascade_matches_at_10k_agents():
+    v, sigma, seeds = random_graph(seed=7, n_agents=10_000, n_edges=8192)
+    want = slash_cascade(v, sigma, seeds, 0, 0.95, 0.0)
+    got = slash_cascade_pallas(v, sigma, seeds, 0, 0.95, 0.0)
+    _assert_matches(got, want)

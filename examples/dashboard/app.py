@@ -57,13 +57,18 @@ class DashboardState:
     saga_rows: list = field(default_factory=list)      # (name, state, steps)
     events: list = field(default_factory=list)         # (ts, type, agent)
     stats: dict = field(default_factory=dict)
+    risk_rows: list = field(default_factory=list)      # (did, risk, recommendation)
+    quarantine_rows: list = field(default_factory=list)  # (did, reason, active)
+    security_rows: list = field(default_factory=list)  # (did, severity, tripped)
+    elevation_rows: list = field(default_factory=list)  # (did, ring, remaining_s)
+    device_stats: dict = field(default_factory=dict)   # device-plane occupancy
 
 
 async def simulate(n_sessions: int = 4, agents_per: int = 5, seed: int = 7) -> DashboardState:
     """Run a governance scenario through the real engines and snapshot it."""
     rng = random.Random(seed)
-    hv = Hypervisor()
     bus = HypervisorEventBus()
+    hv = Hypervisor(event_bus=bus)
     vouching = hv.vouching
     slashing = hv.slashing
     state = DashboardState()
@@ -75,8 +80,7 @@ async def simulate(n_sessions: int = 4, agents_per: int = 5, seed: int = 7) -> D
         ms = await hv.create_session(
             SessionConfig(max_participants=agents_per + 2), creator_did=f"did:sim:lead{s}"
         )
-        sid = ms.sso.session_id
-        publish(EventType.SESSION_CREATED, sid, f"did:sim:lead{s}")
+        sid = ms.sso.session_id  # facade emitted SESSION_CREATED
         members = []
         for a in range(agents_per):
             did = f"did:sim:s{s}a{a}"
@@ -84,12 +88,10 @@ async def simulate(n_sessions: int = 4, agents_per: int = 5, seed: int = 7) -> D
             try:
                 await hv.join_session(sid, did, sigma_raw=sigma)
                 members.append((did, sigma))
-                state.sigma_by_agent[did] = sigma
-                publish(EventType.SESSION_JOINED, sid, did)
+                state.sigma_by_agent[did] = sigma  # facade emitted JOINED
             except Exception:
                 continue
-        ms.sso.activate()
-        publish(EventType.SESSION_ACTIVATED, sid)
+        await hv.activate_session(sid)  # facade emits ACTIVATED
 
         # vouching: the strongest member vouches for the weakest two
         members.sort(key=lambda kv: -kv[1])
@@ -149,6 +151,77 @@ async def simulate(n_sessions: int = 4, agents_per: int = 5, seed: int = 7) -> D
             )
             publish(EventType.SLASH_EXECUTED, sid, rogue)
 
+    # governance aftermath: ledger entries, quarantine, breach sweep,
+    # elevation grants — driving the same engines the reference charts.
+    from hypervisor_tpu import (
+        LedgerEntryType,
+        LiabilityLedger,
+        QuarantineManager,
+        QuarantineReason,
+    )
+
+    ledger = LiabilityLedger()
+    quarantine = QuarantineManager()
+    for rogue, clipped in state.slash_events:
+        ledger.record(rogue, LedgerEntryType.SLASH_RECEIVED, severity=0.95)
+        ledger.record(rogue, LedgerEntryType.QUARANTINE_ENTERED, severity=0.95)
+        quarantine.quarantine(
+            rogue, "session:sim", QuarantineReason.BEHAVIORAL_DRIFT,
+            details="post-slash isolation", forensic_data={"drift": 0.95},
+        )
+        for v in clipped:
+            ledger.record(v, LedgerEntryType.SLASH_CASCADED, severity=0.5)
+    for did in list(state.sigma_by_agent)[:6]:
+        ledger.record(did, LedgerEntryType.CLEAN_SESSION)
+    for did in sorted(state.sigma_by_agent):
+        prof = ledger.compute_risk_profile(did)
+        if prof.total_entries:
+            state.risk_rows.append(
+                (did, prof.risk_score, prof.recommendation))
+    state.quarantine_rows = [
+        (r.agent_did, r.reason.value, r.is_active)
+        for r in quarantine.get_history()
+    ]
+
+    # breach sweep + an elevation grant on the device tables
+    dev = hv.state
+    active_slots = [
+        dev.agent_row(d)["slot"]
+        for d in list(state.sigma_by_agent)[:4]
+        if dev.agent_row(d)
+    ]
+    if active_slots:
+        # six privileged calls per agent clears the min-call analysis bar
+        dev.record_calls(active_slots * 6, [0] * (len(active_slots) * 6))
+        severity, tripped = dev.breach_sweep_tick(now=dev.now())
+        for did in list(state.sigma_by_agent)[:4]:
+            row = dev.agent_row(did)
+            if row:
+                state.security_rows.append(
+                    (did, int(severity[row["slot"]]), bool(tripped[row["slot"]]))
+                )
+        for did in list(state.sigma_by_agent)[:2]:
+            row = dev.agent_row(did)
+            if row and row["ring"] > 1:
+                slot_row = dev.grant_elevation(
+                    row["slot"], granted_ring=row["ring"] - 1,
+                    now=dev.now(), ttl_seconds=120.0,
+                )
+                state.elevation_rows.append(
+                    (did, row["ring"] - 1, 120.0))
+
+    # device-plane occupancy (the HBM tables behind the facade)
+    import numpy as np
+    hv.sync_events_to_device()
+    state.device_stats = {
+        "agent rows": int((np.asarray(dev.agents.did) >= 0).sum()),
+        "session rows": dev._next_session_slot,
+        "vouch edges": int(np.asarray(dev.vouches.active).sum()),
+        "delta log records": int(np.asarray(dev.delta_log.cursor)),
+        "device events": int(np.asarray(dev.event_log.cursor)),
+        "elevations": int(np.asarray(dev.elevations.active).sum()),
+    }
+
     # snapshot rings/sessions
     for ms in hv.active_sessions:
         sso = ms.sso
@@ -189,6 +262,27 @@ async def simulate(n_sessions: int = 4, agents_per: int = 5, seed: int = 7) -> D
 PANELS = ("overview", "rings", "sagas", "liability", "events")
 
 
+def vouch_graph_lines(edges, slashed=()):
+    """ASCII rendering of the liability graph: vouchers with their
+    bonded vouchees as a tree, slashed agents flagged."""
+    by_voucher = {}
+    for a, b, bond in edges:
+        by_voucher.setdefault(a, []).append((b, bond))
+    slashed_set = {r for r, _ in slashed}
+    lines = []
+    for voucher in sorted(by_voucher):
+        mark = " [SLASHED]" if voucher in slashed_set else ""
+        lines.append(f"{voucher.split(':')[-1]}{mark}")
+        fan = by_voucher[voucher]
+        for i, (vouchee, bond) in enumerate(fan):
+            elbow = "\u2514\u2500" if i == len(fan) - 1 else "\u251c\u2500"
+            vm = " [SLASHED]" if vouchee in slashed_set else ""
+            lines.append(
+                f"  {elbow} {vouchee.split(':')[-1]}  (bond \u03c3 {bond:.3f}){vm}"
+            )
+    return lines or ["(no vouch edges)"]
+
+
 def render_terminal(st: DashboardState) -> None:
     try:
         from rich.console import Console
@@ -227,13 +321,36 @@ def render_terminal(st: DashboardState) -> None:
         t.add_row(*[str(x) for x in row])
     con.print(t)
 
-    t = Table(title="liability graph (voucher → vouchee)")
-    t.add_column("voucher"); t.add_column("vouchee"); t.add_column("bond σ")
-    for a, b, bond in st.vouch_edges:
-        t.add_row(a, b, f"{bond:.3f}")
-    con.print(t)
+    con.print(Panel("\n".join(vouch_graph_lines(st.vouch_edges, st.slash_events)),
+                    title="liability graph (voucher \u2192 bonded vouchees)"))
     for rogue, clipped in st.slash_events:
         con.print(f"[red]slashed[/red] {rogue}; clipped vouchers: {clipped}")
+
+    if st.risk_rows:
+        t = Table(title="ledger risk profiles")
+        for col in ("agent", "risk", "recommendation"):
+            t.add_column(col)
+        for did, risk, rec in st.risk_rows:
+            style = {"deny": "red", "probation": "yellow"}.get(rec, "green")
+            t.add_row(did, f"{risk:.2f}", f"[{style}]{rec}[/{style}]")
+        con.print(t)
+
+    if st.quarantine_rows or st.security_rows or st.elevation_rows:
+        t = Table(title="security: quarantine / breach / elevation")
+        for col in ("agent", "kind", "detail"):
+            t.add_column(col)
+        for did, reason, active in st.quarantine_rows:
+            t.add_row(did, "quarantine", f"{reason} ({'active' if active else 'released'})")
+        for did, severity, tripped in st.security_rows:
+            t.add_row(did, "breach sweep",
+                      f"severity {severity}" + (" BREAKER TRIPPED" if tripped else ""))
+        for did, ring, ttl in st.elevation_rows:
+            t.add_row(did, "elevation", f"\u2192 Ring {ring} (ttl {ttl:.0f}s)")
+        con.print(t)
+
+    if st.device_stats:
+        con.print(Panel(" \u00b7 ".join(f"{k}: {v}" for k, v in st.device_stats.items()),
+                        title="device plane (HBM tables)"))
 
     t = Table(title=f"events (last {min(len(st.events), 15)})")
     t.add_column("type"); t.add_column("agent")
@@ -248,7 +365,7 @@ def render_png(st: DashboardState, path: str) -> None:
     import matplotlib.pyplot as plt
     import networkx as nx
 
-    fig, axes = plt.subplots(2, 2, figsize=(12, 9))
+    fig, axes = plt.subplots(2, 3, figsize=(16, 9))
     fig.suptitle("hypervisor_tpu governance dashboard", fontsize=14)
 
     ax = axes[0][0]
@@ -263,12 +380,18 @@ def render_png(st: DashboardState, path: str) -> None:
 
     ax = axes[1][0]
     g = nx.DiGraph()
+    slashed = {r.split(":")[-1] for r, _ in st.slash_events}
     for a, b, bond in st.vouch_edges:
         g.add_edge(a.split(":")[-1], b.split(":")[-1], weight=bond)
     if g.number_of_nodes():
         pos = nx.spring_layout(g, seed=3)
-        nx.draw_networkx(g, pos=pos, ax=ax, node_size=450, font_size=7)
-    ax.set_title("liability graph")
+        colors = ["#d62728" if n in slashed else "#1f77b4" for n in g.nodes]
+        nx.draw_networkx(g, pos=pos, ax=ax, node_size=450, font_size=7,
+                         node_color=colors)
+        labels = {(u, v): f"{d['weight']:.2f}" for u, v, d in g.edges(data=True)}
+        nx.draw_networkx_edge_labels(g, pos=pos, ax=ax, edge_labels=labels,
+                                     font_size=6)
+    ax.set_title("liability graph (red = slashed)")
     ax.axis("off")
 
     ax = axes[1][1]
@@ -276,6 +399,24 @@ def render_png(st: DashboardState, path: str) -> None:
     names = list(counts)[:8]
     ax.barh(names, [counts[n] for n in names])
     ax.set_title("event counts")
+
+    ax = axes[0][2]
+    if st.risk_rows:
+        dids = [d.split(":")[-1] for d, _, _ in st.risk_rows]
+        risks = [r for _, r, _ in st.risk_rows]
+        recs = [rec for _, _, rec in st.risk_rows]
+        bar_colors = ["#d62728" if rec == "deny" else
+                      "#ff7f0e" if rec == "probation" else "#2ca02c"
+                      for rec in recs]
+        ax.barh(dids, risks, color=bar_colors)
+        ax.set_xlim(0, 1)
+    ax.set_title("ledger risk scores")
+
+    ax = axes[1][2]
+    if st.device_stats:
+        ks = list(st.device_stats)
+        ax.barh(ks, [st.device_stats[k] for k in ks])
+    ax.set_title("device plane occupancy")
 
     fig.tight_layout()
     fig.savefig(path, dpi=110)
@@ -305,8 +446,20 @@ def render_streamlit(st: DashboardState) -> None:  # pragma: no cover
     with tabs[3]:
         stl.dataframe(pd.DataFrame(
             st.vouch_edges, columns=["voucher", "vouchee", "bond"]))
+        stl.code("\n".join(vouch_graph_lines(st.vouch_edges, st.slash_events)))
         for rogue, clipped in st.slash_events:
             stl.error(f"slashed {rogue}; clipped: {clipped}")
+        if st.risk_rows:
+            stl.dataframe(pd.DataFrame(
+                st.risk_rows, columns=["agent", "risk", "recommendation"]))
+        if st.quarantine_rows:
+            stl.dataframe(pd.DataFrame(
+                st.quarantine_rows, columns=["agent", "reason", "active"]))
+        if st.security_rows:
+            stl.dataframe(pd.DataFrame(
+                st.security_rows, columns=["agent", "severity", "breaker"]))
+        with stl.expander("device plane (HBM tables)"):
+            stl.json(st.device_stats)
     with tabs[4]:
         stl.dataframe(pd.DataFrame(st.events, columns=["ts", "type", "agent"]))
 

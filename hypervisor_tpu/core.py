@@ -126,6 +126,7 @@ class Hypervisor:
 
         # Optional structured event emission (facade-wired, unlike reference).
         self.event_bus = event_bus
+        self._events_mirrored = 0
 
         self._sessions: dict[str, ManagedSession] = {}
 
@@ -362,6 +363,32 @@ class Hypervisor:
             )
 
         return result
+
+    def sync_events_to_device(self) -> int:
+        """Mirror new bus events into the device EventLog ring buffer.
+
+        The columnar host bus and the device EventLog share a row shape
+        (`event_bus.device_rows` -> `EventLog.append_batch`); this drains
+        everything emitted since the last sync. Returns rows appended.
+        """
+        if self.event_bus is None:
+            return 0
+        codes, sess, agents, traces, stamps = self.event_bus.device_rows(
+            self._events_mirrored
+        )
+        if not len(codes):
+            return 0
+        import jax.numpy as jnp
+
+        self.state.event_log = self.state.event_log.append_batch(
+            jnp.asarray(codes),
+            jnp.asarray(sess),
+            jnp.asarray(agents),
+            jnp.asarray(traces),
+            jnp.asarray(stamps),
+        )
+        self._events_mirrored += len(codes)
+        return len(codes)
 
     # ── queries ──────────────────────────────────────────────────────
 

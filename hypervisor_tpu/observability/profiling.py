@@ -1,0 +1,84 @@
+"""Runtime profiling: jax.profiler traces + named spans over device waves.
+
+SURVEY §5 maps the reference's profiling story (a benchmark harness
+only, `bench_hypervisor.py:40-114`) to `jax.profiler` for the kernels.
+This module is that hook: a process-wide toggle that captures XLA/TPU
+traces viewable in TensorBoard/Perfetto, plus `span()` annotations the
+runtime waves wrap themselves in (`TraceAnnotation` shows up on the
+device timeline, `StepTraceAnnotation` groups a whole governance tick).
+
+Usage::
+
+    from hypervisor_tpu.observability import profiling
+
+    with profiling.capture("/tmp/hv_trace"):
+        state.run_governance_wave(...)      # traced
+
+    # or manual start/stop around a longer window
+    profiling.start("/tmp/hv_trace")
+    ...
+    profiling.stop()
+
+Spans are no-ops when no capture is active, so the runtime annotates
+unconditionally at negligible cost.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, Optional
+
+import jax
+
+_lock = threading.Lock()
+_active_dir: Optional[str] = None
+
+
+def start(log_dir: str) -> None:
+    """Begin a profiler capture writing to `log_dir` (idempotent)."""
+    global _active_dir
+    with _lock:
+        if _active_dir is not None:
+            return
+        jax.profiler.start_trace(log_dir)
+        _active_dir = log_dir
+
+
+def stop() -> Optional[str]:
+    """End the active capture; returns the trace directory (or None)."""
+    global _active_dir
+    with _lock:
+        if _active_dir is None:
+            return None
+        jax.profiler.stop_trace()
+        out, _active_dir = _active_dir, None
+        return out
+
+
+def is_active() -> bool:
+    return _active_dir is not None
+
+
+@contextlib.contextmanager
+def capture(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace for the enclosed block."""
+    start(log_dir)
+    try:
+        yield
+    finally:
+        stop()
+
+
+def span(name: str):
+    """Named device-timeline annotation for one wave/op.
+
+    Shows as `name` in the captured trace; safe (near-zero cost) when no
+    capture is running.
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step_span(name: str, step: int):
+    """Annotation grouping one full governance tick as a profiler step."""
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)

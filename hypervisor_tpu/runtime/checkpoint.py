@@ -199,13 +199,13 @@ def restore_state(
     state = HypervisorState(config)
     for tname, ttype in _TABLE_TYPES.items():
         fields = dataclasses.fields(ttype)
-        if f"{tname}.{fields[0].name}" not in data:
-            continue  # table added after this checkpoint was written
         cols = {
             f.name: jnp.asarray(data[f"{tname}.{f.name}"])
             for f in fields
             if f"{tname}.{f.name}" in data
         }
+        if not cols:
+            continue  # table added after this checkpoint was written
         missing = [f.name for f in fields if f.name not in cols]
         if missing:
             # Columns added after the save keep their freshly-created

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.models import SessionConfig, SessionState
+from hypervisor_tpu.observability import profiling
 from hypervisor_tpu.ops import admission, saga_ops, security_ops
 from hypervisor_tpu.ops import merkle as merkle_ops
 from hypervisor_tpu.ops import pipeline as pipeline_ops
@@ -221,22 +222,23 @@ class HypervisorState:
         if trustworthy is None:
             trustworthy = np.ones(b, bool)
 
-        result = _WAVE(
-            self.agents,
-            self.sessions,
-            self.vouches,
-            jnp.asarray(agent_slots),
-            jnp.asarray(handles),
-            jnp.asarray(np.asarray(agent_sessions, np.int32)),
-            jnp.asarray(np.asarray(sigma_raw, np.float32)),
-            jnp.asarray(trustworthy),
-            jnp.asarray(duplicate),
-            jnp.asarray(np.asarray(session_slots, np.int32)),
-            jnp.asarray(delta_bodies),
-            now,
-            omega,
-            use_pallas=use_pallas,
-        )
+        with profiling.span("hv.governance_wave"):
+            result = _WAVE(
+                self.agents,
+                self.sessions,
+                self.vouches,
+                jnp.asarray(agent_slots),
+                jnp.asarray(handles),
+                jnp.asarray(np.asarray(agent_sessions, np.int32)),
+                jnp.asarray(np.asarray(sigma_raw, np.float32)),
+                jnp.asarray(trustworthy),
+                jnp.asarray(duplicate),
+                jnp.asarray(np.asarray(session_slots, np.int32)),
+                jnp.asarray(delta_bodies),
+                now,
+                omega,
+                use_pallas=use_pallas,
+            )
         self.agents = result.agents
         self.sessions = result.sessions
         self.vouches = result.vouches
@@ -324,17 +326,18 @@ class HypervisorState:
         dids = np.array([r[1] for r in rows], np.int32)
         duplicate = np.array([r[3] for r in rows], bool)
 
-        result = self._admit(
-            self.agents,
-            self.sessions,
-            jnp.asarray(agent_slots),
-            jnp.asarray(dids),
-            jnp.asarray(session_slots),
-            jnp.asarray(sigma),
-            jnp.asarray(trustworthy.astype(bool)),
-            jnp.asarray(duplicate),
-            now,
-        )
+        with profiling.span("hv.admission_wave"):
+            result = self._admit(
+                self.agents,
+                self.sessions,
+                jnp.asarray(agent_slots),
+                jnp.asarray(dids),
+                jnp.asarray(session_slots),
+                jnp.asarray(sigma),
+                jnp.asarray(trustworthy.astype(bool)),
+                jnp.asarray(duplicate),
+                now,
+            )
         self.agents = result.agents
         self.sessions = result.sessions
         status = np.asarray(result.status)
@@ -469,16 +472,17 @@ class HypervisorState:
             exec_success[slot] = ok
         for slot, ok in (undo_outcomes or {}).items():
             undo_success[slot] = ok
-        step_state, retries_left, saga_state, cursor = self._saga_tick(
-            self.sagas.step_state,
-            self.sagas.retries_left,
-            self.sagas.has_undo,
-            self.sagas.saga_state,
-            self.sagas.n_steps,
-            self.sagas.cursor,
-            jnp.asarray(exec_success),
-            jnp.asarray(undo_success),
-        )
+        with profiling.span("hv.saga_round"):
+            step_state, retries_left, saga_state, cursor = self._saga_tick(
+                self.sagas.step_state,
+                self.sagas.retries_left,
+                self.sagas.has_undo,
+                self.sagas.saga_state,
+                self.sagas.n_steps,
+                self.sagas.cursor,
+                jnp.asarray(exec_success),
+                jnp.asarray(undo_success),
+            )
         self.sagas = replace(
             self.sagas,
             step_state=step_state,
@@ -510,7 +514,8 @@ class HypervisorState:
 
     def breach_sweep_tick(self, now: float) -> tuple[np.ndarray, np.ndarray]:
         """Run the batched breach analysis; returns (severity, tripped)."""
-        result = _BREACH_SWEEP(self.agents, now)
+        with profiling.span("hv.breach_sweep"):
+            result = _BREACH_SWEEP(self.agents, now)
         self.agents = result.agents
         return np.asarray(result.severity), np.asarray(result.tripped)
 
@@ -665,11 +670,12 @@ class HypervisorState:
         bodies = np.zeros((t_max, lanes, merkle_ops.BODY_WORDS), np.uint32)
         bodies[t_pos, lane_idx] = packed
 
-        digests = np.array(
-            merkle_ops.chain_digests(
-                jnp.asarray(bodies), jnp.asarray(seeds), use_pallas
-            )
-        )  # [T, L, 8] (copy: explicit leaves overwrite below)
+        with profiling.span("hv.delta_chain"):
+            digests = np.array(
+                merkle_ops.chain_digests(
+                    jnp.asarray(bodies), jnp.asarray(seeds), use_pallas
+                )
+            )  # [T, L, 8] (copy: explicit leaves overwrite below)
 
         # Explicit leaf digests (facade mode) override the chain digest.
         for i, (_s, _a, _c, _t, digest) in enumerate(staged):
@@ -753,16 +759,17 @@ class HypervisorState:
             if rows:
                 leaves[i, : len(rows)] = digest_host[np.array(rows)]
 
-        result = self._terminate(
-            self.agents,
-            self.sessions,
-            self.vouches,
-            jnp.asarray(np.array(slots, np.int32)),
-            jnp.asarray(leaves),
-            jnp.asarray(counts),
-            now,
-            use_pallas=use_pallas,
-        )
+        with profiling.span("hv.terminate_wave"):
+            result = self._terminate(
+                self.agents,
+                self.sessions,
+                self.vouches,
+                jnp.asarray(np.array(slots, np.int32)),
+                jnp.asarray(leaves),
+                jnp.asarray(counts),
+                now,
+                use_pallas=use_pallas,
+            )
         self.agents = result.agents
         self.sessions = result.sessions
         self.vouches = result.vouches

@@ -47,3 +47,34 @@ async def test_renderers_consume_state(tmp_path, capsys):
     dashboard_app.render_png(st, str(png))
     capsys.readouterr()
     assert png.stat().st_size > 10_000
+
+
+async def test_security_and_device_panels_populated():
+    st = await dashboard_app.simulate(n_sessions=3, agents_per=4, seed=3)
+    # ledger risk profiles exist for slash participants + clean sessions
+    assert st.risk_rows, "no risk profiles"
+    # the slashed+quarantined rogue carries the highest risk score
+    # (0.15*0.95 + 0.10*0.95 per the reference weighted formula)
+    rogue = st.slash_events[0][0]
+    rogue_risk = dict((d, r) for d, r, _ in st.risk_rows)[rogue]
+    assert rogue_risk >= 0.2
+    assert rogue_risk == max(r for _, r, _ in st.risk_rows)
+    # quarantine recorded the rogue
+    assert any(active for _, _, active in st.quarantine_rows)
+    # breach sweep ran over the device table
+    assert st.security_rows and any(t for _, _, t in st.security_rows)
+    # device plane occupancy reflects the facade traffic incl. the
+    # bus -> EventLog mirror
+    assert st.device_stats["agent rows"] >= 12
+    assert st.device_stats["device events"] >= st.stats["events"] // 2
+    assert st.device_stats["elevations"] >= 1
+
+
+def test_vouch_graph_ascii_rendering():
+    lines = dashboard_app.vouch_graph_lines(
+        [("did:a", "did:b", 0.16), ("did:a", "did:c", 0.12)],
+        slashed=[("did:c", [])],
+    )
+    joined = "\n".join(lines)
+    assert "a" in joined and "bond" in joined
+    assert "[SLASHED]" in joined

@@ -35,7 +35,6 @@ from hypervisor_tpu.models import (
     SessionConfig,
 )
 from hypervisor_tpu.observability import EventType, HypervisorEvent, HypervisorEventBus
-from hypervisor_tpu.ops import admission
 from hypervisor_tpu.ops.sha256 import digests_to_hex, hex_to_words
 from hypervisor_tpu.reversibility import ReversibilityRegistry
 from hypervisor_tpu.rings import ActionClassifier, RingEnforcer
@@ -203,10 +202,22 @@ class Hypervisor:
         # The jitted admission wave is authoritative: it applies the same
         # state/duplicate/capacity/sigma-floor rules as the host SSO over
         # the device tables. On rejection, the host join reproduces the
-        # exact reference exception for the single-call API. The flush
-        # drains the whole staging queue; OUR lane is the one at the
-        # pre-enqueue pending depth (earlier stagings flush alongside).
-        lane = len(self.state._pending)
+        # exact reference exception for the single-call API. Outcome is
+        # correlated by MEMBERSHIP, not flush-status position — a
+        # concurrent flusher may legally drain our staged join before our
+        # own flush, so status indices are not ours to trust.
+        if self.state.is_member(managed.slot, agent_did):
+            # Faithful duplicate rejection before staging a doomed join.
+            managed.sso.join(
+                agent_did=agent_did,
+                sigma_raw=sigma_raw,
+                sigma_eff=sigma_eff,
+                ring=ring,
+            )
+            raise RuntimeError(
+                f"device/SSO divergence: {agent_did} is a device member "
+                "but joined the host session"
+            )
         queued = self.state.enqueue_join(
             managed.slot,
             agent_did,
@@ -215,8 +226,8 @@ class Hypervisor:
         )
         if queued < 0:
             raise RuntimeError("admission staging queue full; flush pending joins")
-        status = self.state.flush_joins(now=self.state.now())
-        if int(status[lane]) != admission.ADMIT_OK:
+        self.state.flush_joins(now=self.state.now())
+        if not self.state.is_member(managed.slot, agent_did):
             managed.sso.join(
                 agent_did=agent_did,
                 sigma_raw=sigma_raw,
@@ -224,8 +235,8 @@ class Hypervisor:
                 ring=ring,
             )
             raise RuntimeError(
-                f"device admission rejected ({int(status[lane])}) what the host "
-                f"session accepted — table/SSO divergence for {agent_did}"
+                f"device admission rejected what the host session accepted "
+                f"— table/SSO divergence for {agent_did}"
             )
         device_ring = self.state.agent_row(agent_did)
         if device_ring is not None and device_ring["ring"] != ring.value:

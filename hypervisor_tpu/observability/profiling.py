@@ -35,14 +35,19 @@ _lock = threading.Lock()
 _active_dir: Optional[str] = None
 
 
-def start(log_dir: str) -> None:
-    """Begin a profiler capture writing to `log_dir` (idempotent)."""
+def start(log_dir: str) -> bool:
+    """Begin a profiler capture writing to `log_dir`.
+
+    Idempotent: returns True only when THIS call started the trace —
+    callers that did not acquire must not stop it.
+    """
     global _active_dir
     with _lock:
         if _active_dir is not None:
-            return
+            return False
         jax.profiler.start_trace(log_dir)
         _active_dir = log_dir
+        return True
 
 
 def stop() -> Optional[str]:
@@ -62,12 +67,17 @@ def is_active() -> bool:
 
 @contextlib.contextmanager
 def capture(log_dir: str) -> Iterator[None]:
-    """Capture a jax.profiler trace for the enclosed block."""
-    start(log_dir)
+    """Capture a jax.profiler trace for the enclosed block.
+
+    Re-entrancy-safe: a capture nested inside another becomes a no-op
+    instead of truncating the outer trace.
+    """
+    acquired = start(log_dir)
     try:
         yield
     finally:
-        stop()
+        if acquired:
+            stop()
 
 
 def span(name: str):

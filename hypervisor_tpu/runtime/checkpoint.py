@@ -132,9 +132,9 @@ def save_state(
     starts, files are written to temp names and `os.replace`d into place,
     and `.done` appears only after both files are in place.
     """
-    if state._pending:
+    if state._pending_rows:
         raise RuntimeError(
-            f"cannot checkpoint with {len(state._pending)} staged joins; "
+            f"cannot checkpoint with {len(state._pending_rows)} staged joins; "
             "call flush_joins() first"
         )
     if state._pending_deltas:

@@ -20,6 +20,7 @@ share one source of truth.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -89,9 +90,15 @@ class HypervisorState:
         # mantissa. time.time() itself near 2^31 quantizes to ~128 s.
         self._epoch_base = time.time()
 
-        # Pending join wave (native lock-free queue + parallel slot/did rows).
+        # Pending join wave. The native queue is lock-free for concurrent
+        # producers; the host-side indices (interning, slot allocation,
+        # per-slot bookkeeping) mutate under this short lock. Bookkeeping
+        # is keyed by agent slot — NOT staging order — because concurrent
+        # pushes may claim queue slots in a different order than Python
+        # observes.
         self._queue = StagingQueue(capacity=cap.max_agents)
-        self._pending: list[tuple[int, int, int, bool]] = []  # slot, did, sess, dup
+        self._enqueue_lock = threading.Lock()
+        self._pending_rows: dict[int, tuple[int, int, bool]] = {}  # slot -> did, sess, dup
 
         # Pending delta wave + per-session audit index into the DeltaLog.
         # sess -> list of log rows; chain seed u32[8]; turn counter.
@@ -294,35 +301,54 @@ class HypervisorState:
         sigma_raw: float,
         trustworthy: bool = True,
     ) -> int:
-        """Stage one join; returns the queue slot (-1 when the wave is full)."""
-        if self._free_agent_slots:
-            agent_slot = self._free_agent_slots[-1]
-        elif self._next_agent_slot < self.agents.did.shape[0]:
-            agent_slot = self._next_agent_slot
-        else:
-            raise RuntimeError(
-                f"agent table full ({self.agents.did.shape[0]}); "
-                "raise config.capacity.max_agents"
-            )
-        did = self.agent_ids.intern(agent_did)
-        duplicate = (session_slot, did) in self._members
-        q = self._queue.push(sigma_raw, agent_slot, session_slot, trustworthy)
-        if q < 0:
-            return -1
-        if self._free_agent_slots:
-            self._free_agent_slots.pop()
-        else:
-            self._next_agent_slot += 1
-        self._pending.append((agent_slot, did, session_slot, duplicate))
+        """Stage one join; returns the queue slot (-1 when the wave is full).
+
+        Thread-safe: any number of producer threads may stage joins
+        concurrently (the native queue claims slots atomically; the host
+        indices mutate under a short lock) while the tick driver flushes.
+        """
+        with self._enqueue_lock:
+            if self._free_agent_slots:
+                agent_slot = self._free_agent_slots[-1]
+            elif self._next_agent_slot < self.agents.did.shape[0]:
+                agent_slot = self._next_agent_slot
+            else:
+                raise RuntimeError(
+                    f"agent table full ({self.agents.did.shape[0]}); "
+                    "raise config.capacity.max_agents"
+                )
+            did = self.agent_ids.intern(agent_did)
+            duplicate = (session_slot, did) in self._members
+            q = self._queue.push(sigma_raw, agent_slot, session_slot, trustworthy)
+            if q < 0:
+                return -1
+            if self._free_agent_slots:
+                self._free_agent_slots.pop()
+            else:
+                self._next_agent_slot += 1
+            self._pending_rows[agent_slot] = (did, session_slot, duplicate)
         return q
 
     def flush_joins(self, now: float = 0.0) -> np.ndarray:
-        """Run the jitted admission wave; returns i8[B] status codes."""
-        n, sigma, agent_slots, session_slots, trustworthy = self._queue.harvest()
-        if n == 0:
-            return np.zeros(0, np.int8)
-        rows = self._pending[:n]
-        self._pending = self._pending[n:]
+        """Run the jitted admission wave; returns i8[B] status codes.
+
+        Statuses are in HARVEST order (the queue's atomic claim order),
+        which under concurrent staging may differ from call order; callers
+        correlate by agent slot or by their enqueue_join queue index.
+        """
+        # The lock covers the harvest too: a producer holding the lock may
+        # have claimed a queue slot whose column writes are not yet
+        # visible; swapping the epoch mid-push would harvest garbage.
+        with self._enqueue_lock:
+            n, sigma, agent_slots, session_slots, trustworthy = (
+                self._queue.harvest()
+            )
+            if n == 0:
+                return np.zeros(0, np.int8)
+            rows = [
+                (int(slot),) + self._pending_rows.pop(int(slot))
+                for slot in agent_slots
+            ]
         dids = np.array([r[1] for r in rows], np.int32)
         duplicate = np.array([r[3] for r in rows], bool)
 
@@ -776,6 +802,11 @@ class HypervisorState:
         return np.asarray(result.roots)
 
     # ── views ────────────────────────────────────────────────────────
+
+    def is_member(self, session_slot: int, agent_did: str) -> bool:
+        """Was this agent admitted into the session (by ANY flush)?"""
+        did = self.agent_ids.lookup(agent_did)
+        return did >= 0 and (session_slot, did) in self._members
 
     def participant_count(self, session_slot: int) -> int:
         return int(np.asarray(self.sessions.n_participants)[session_slot])

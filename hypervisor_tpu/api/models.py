@@ -249,3 +249,18 @@ class StatsResponse(BaseModel):
     active_sagas: int
     total_vouches: int
     event_count: int
+
+
+class DeviceStatsResponse(BaseModel):
+    """Occupancy of the HBM-resident device tables behind the facade."""
+
+    backend: str
+    agent_rows_active: int
+    agent_capacity: int
+    session_rows: int
+    session_capacity: int
+    vouch_edges_active: int
+    saga_rows: int
+    delta_log_records: int
+    device_events: int
+    elevations_active: int

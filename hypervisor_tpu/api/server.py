@@ -28,6 +28,7 @@ from hypervisor_tpu.api.service import ApiError, HypervisorService
 ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/health", "health", None),
     ("GET", "/api/v1/stats", "stats", None),
+    ("GET", "/api/v1/device/stats", "device_stats", None),
     ("POST", "/api/v1/sessions", "create_session", M.CreateSessionRequest),
     ("GET", "/api/v1/sessions", "list_sessions", None),
     ("GET", "/api/v1/sessions/{session_id}", "get_session", None),

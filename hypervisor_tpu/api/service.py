@@ -55,6 +55,26 @@ class HypervisorService:
             event_count=self.bus.event_count,
         )
 
+    async def device_stats(self) -> M.DeviceStatsResponse:
+        """Device-plane occupancy: the tables every facade call updates."""
+        import jax
+        import numpy as np
+
+        dev = self.hv.state
+        self.hv.sync_events_to_device()
+        return M.DeviceStatsResponse(
+            backend=jax.devices()[0].platform,
+            agent_rows_active=int((np.asarray(dev.agents.did) >= 0).sum()),
+            agent_capacity=int(dev.agents.did.shape[0]),
+            session_rows=dev._next_session_slot,
+            session_capacity=int(dev.sessions.sid.shape[0]),
+            vouch_edges_active=int(np.asarray(dev.vouches.active).sum()),
+            saga_rows=dev._next_saga_slot,
+            delta_log_records=int(np.asarray(dev.delta_log.cursor)),
+            device_events=int(np.asarray(dev.event_log.cursor)),
+            elevations_active=int(np.asarray(dev.elevations.active).sum()),
+        )
+
     # ── Sessions ─────────────────────────────────────────────────────
 
     async def create_session(self, req: M.CreateSessionRequest) -> M.CreateSessionResponse:

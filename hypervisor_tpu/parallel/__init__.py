@@ -10,6 +10,7 @@ from hypervisor_tpu.parallel.sharding import lane_sharding, replicated, shard_ta
 from hypervisor_tpu.parallel.collectives import (
     eventual_tick,
     reconcile,
+    reconcile_sessions,
     sharded_admission,
     strong_tick,
 )
@@ -26,4 +27,5 @@ __all__ = [
     "strong_tick",
     "eventual_tick",
     "reconcile",
+    "reconcile_sessions",
 ]

@@ -293,6 +293,49 @@ def reconcile(mesh: Mesh):
     )
 
 
+def reconcile_sessions(mesh: Mesh):
+    """EVENTUAL-mode reconciliation of the ACTUAL session-table deltas.
+
+    Each shard ticks locally against its replica and accumulates a
+    per-session delta vector (participant-count and sigma-mass changes
+    it applied); between batched ticks this allreduces the [S] delta
+    vectors over ICI and folds them into the replicated table, so every
+    shard converges to the same SessionTable without an in-tick barrier
+    — the EVENTUAL counterpart of `sharded_admission`'s in-wave psum.
+
+    Returns fn(sessions, count_deltas [D, S], sigma_deltas [D, S]) ->
+    (sessions, total_counts [S], total_sigma [S]); delta rows are sharded
+    over the mesh (a multiple of the mesh size: several ticks of deltas
+    may stack). Participant counts fold into the table; the sigma mass is
+    returned for the caller's trust accounting (the SessionTable carries
+    no sigma-mass column).
+    """
+
+    def merge(sessions, count_deltas, sigma_deltas):
+        # Sum the local block first: each shard may hold several ticks'
+        # delta rows, and [0] would silently drop the rest.
+        total_counts = jax.lax.psum(
+            jnp.sum(count_deltas, axis=0), AGENT_AXIS
+        )
+        total_sigma = jax.lax.psum(
+            jnp.sum(sigma_deltas, axis=0), AGENT_AXIS
+        )
+        sessions = t_replace(
+            sessions,
+            n_participants=sessions.n_participants + total_counts,
+        )
+        return sessions, total_counts, total_sigma
+
+    return jax.jit(
+        shard_map(
+            merge,
+            mesh=mesh,
+            in_specs=(P(), P(AGENT_AXIS, None), P(AGENT_AXIS, None)),
+            out_specs=(P(), P(), P()),
+        )
+    )
+
+
 @partial(jax.jit, static_argnames=("n_agents",))
 def sigma_allreduce_stats(sigma_eff: jnp.ndarray, n_agents: int) -> jnp.ndarray:
     """Single-device helper: [sum, mean, max] of sigma for stats endpoints."""

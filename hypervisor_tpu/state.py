@@ -99,6 +99,7 @@ class HypervisorState:
         self._queue = StagingQueue(capacity=cap.max_agents)
         self._enqueue_lock = threading.Lock()
         self._pending_rows: dict[int, tuple[int, int, bool]] = {}  # slot -> did, sess, dup
+        self._staged_members: set[tuple[int, int]] = set()  # in-wave dedup
 
         # Pending delta wave + per-session audit index into the DeltaLog.
         # sess -> list of log rows; chain seed u32[8]; turn counter.
@@ -318,7 +319,11 @@ class HypervisorState:
                     "raise config.capacity.max_agents"
                 )
             did = self.agent_ids.intern(agent_did)
-            duplicate = (session_slot, did) in self._members
+            # Duplicate against admitted members AND same-wave stagings:
+            # two concurrent joins of one (session, did) must not both
+            # admit when the wave flushes.
+            key = (session_slot, did)
+            duplicate = key in self._members or key in self._staged_members
             q = self._queue.push(sigma_raw, agent_slot, session_slot, trustworthy)
             if q < 0:
                 return -1
@@ -326,6 +331,8 @@ class HypervisorState:
                 self._free_agent_slots.pop()
             else:
                 self._next_agent_slot += 1
+            if not duplicate:
+                self._staged_members.add(key)
             self._pending_rows[agent_slot] = (did, session_slot, duplicate)
         return q
 
@@ -334,11 +341,14 @@ class HypervisorState:
 
         Statuses are in HARVEST order (the queue's atomic claim order),
         which under concurrent staging may differ from call order; callers
-        correlate by agent slot or by their enqueue_join queue index.
+        correlate by agent slot or by membership (`is_member`).
+
+        The whole flush holds the staging lock: the harvest must not swap
+        the epoch under a mid-push producer, and the table
+        read-modify-write plus the membership/free-list bookkeeping must
+        not interleave with another flusher (a lost update there would
+        diverge host bookkeeping from the device tables).
         """
-        # The lock covers the harvest too: a producer holding the lock may
-        # have claimed a queue slot whose column writes are not yet
-        # visible; swapping the epoch mid-push would harvest garbage.
         with self._enqueue_lock:
             n, sigma, agent_slots, session_slots, trustworthy = (
                 self._queue.harvest()
@@ -349,31 +359,33 @@ class HypervisorState:
                 (int(slot),) + self._pending_rows.pop(int(slot))
                 for slot in agent_slots
             ]
-        dids = np.array([r[1] for r in rows], np.int32)
-        duplicate = np.array([r[3] for r in rows], bool)
+            dids = np.array([r[1] for r in rows], np.int32)
+            duplicate = np.array([r[3] for r in rows], bool)
 
-        with profiling.span("hv.admission_wave"):
-            result = self._admit(
-                self.agents,
-                self.sessions,
-                jnp.asarray(agent_slots),
-                jnp.asarray(dids),
-                jnp.asarray(session_slots),
-                jnp.asarray(sigma),
-                jnp.asarray(trustworthy.astype(bool)),
-                jnp.asarray(duplicate),
-                now,
-            )
-        self.agents = result.agents
-        self.sessions = result.sessions
-        status = np.asarray(result.status)
-        for (slot, did, sess, _), st in zip(rows, status):
-            if st == admission.ADMIT_OK:
-                self._members[(sess, did)] = True
-                self._slot_of_did[did] = slot
-            else:
-                # A rejected join leaves no trace; its row is reusable.
-                self._free_agent_slots.append(slot)
+            with profiling.span("hv.admission_wave"):
+                result = self._admit(
+                    self.agents,
+                    self.sessions,
+                    jnp.asarray(agent_slots),
+                    jnp.asarray(dids),
+                    jnp.asarray(session_slots),
+                    jnp.asarray(sigma),
+                    jnp.asarray(trustworthy.astype(bool)),
+                    jnp.asarray(duplicate),
+                    now,
+                )
+            self.agents = result.agents
+            self.sessions = result.sessions
+            status = np.asarray(result.status)
+            for (slot, did, sess, dup), st in zip(rows, status):
+                if not dup:
+                    self._staged_members.discard((sess, did))
+                if st == admission.ADMIT_OK:
+                    self._members[(sess, did)] = True
+                    self._slot_of_did[did] = slot
+                else:
+                    # A rejected join leaves no trace; its row is reusable.
+                    self._free_agent_slots.append(slot)
         return status
 
     # ── vouch edges ──────────────────────────────────────────────────

@@ -99,3 +99,26 @@ class TestConcurrentIngest:
         status = st.flush_joins()
         assert int((status == 0).sum()) == 17
         assert st.participant_count(slot) == 17
+
+    def test_same_agent_raced_from_many_threads_admits_once(self):
+        """Concurrent joins of ONE (session, did) must admit exactly once:
+        the staged-membership dedup closes the window between the
+        membership check and the wave flush."""
+        st = HypervisorState()
+        slot = st.create_session("s:dupe", SessionConfig(max_participants=100))
+        barrier = threading.Barrier(6)
+
+        def racer():
+            barrier.wait()
+            st.enqueue_join(slot, "did:same", 0.9)
+
+        threads = [threading.Thread(target=racer) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        status = st.flush_joins()
+        assert int((status == 0).sum()) == 1, status
+        assert st.participant_count(slot) == 1
+        did = st.agent_ids.lookup("did:same")
+        assert int((np.asarray(st.agents.did) == did).sum()) == 1

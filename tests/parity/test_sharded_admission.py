@@ -201,3 +201,36 @@ class TestShardedAdmission:
         # Admitted agents landed on their owning shards.
         dids = np.asarray(agents.did)
         assert (dids >= 0).sum() == 7
+
+class TestEventualReconcile:
+    def test_session_table_deltas_merge_across_shards(self):
+        """EVENTUAL mode: shards tick locally, reconcile folds the ACTUAL
+        per-session deltas (not a 4-float aggregate) into the replica."""
+        from hypervisor_tpu.parallel.collectives import reconcile_sessions
+
+        mesh = _mesh()
+        merge = reconcile_sessions(mesh)
+        sessions = _session_table(max_participants=64, min_sigma=0.0)
+
+        # Each shard admitted a different number of agents into sessions
+        # 0 and 1 during its local (EVENTUAL) ticks.
+        count_deltas = np.zeros((N_DEV, S_CAP), np.int32)
+        sigma_deltas = np.zeros((N_DEV, S_CAP), np.float32)
+        for d in range(N_DEV):
+            count_deltas[d, 0] = d % 3
+            count_deltas[d, 1] = 1
+            sigma_deltas[d, 0] = 0.1 * (d % 3)
+
+        out_sessions, total_counts, total_sigma = merge(
+            sessions, jnp.asarray(count_deltas), jnp.asarray(sigma_deltas)
+        )
+        want0 = sum(d % 3 for d in range(N_DEV))
+        assert int(np.asarray(total_counts)[0]) == want0
+        assert int(np.asarray(total_counts)[1]) == N_DEV
+        assert int(np.asarray(out_sessions.n_participants)[0]) == want0
+        assert int(np.asarray(out_sessions.n_participants)[1]) == N_DEV
+        np.testing.assert_allclose(
+            float(np.asarray(total_sigma)[0]),
+            sum(0.1 * (d % 3) for d in range(N_DEV)),
+            rtol=1e-6,
+        )

@@ -177,8 +177,11 @@ class TestEventEndpoints:
 
 
 class TestHTTPTransport:
-    def test_routes_count_matches_reference(self):
-        assert len(ROUTES) == 21
+    def test_routes_cover_reference_plus_device_stats(self):
+        # The reference's 21 endpoints plus /api/v1/device/stats (the
+        # device-plane occupancy view the reference has no analog for).
+        assert len(ROUTES) == 22
+        assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
 
     def test_end_to_end_over_http(self):
         server = HypervisorHTTPServer().start()
@@ -225,3 +228,16 @@ class TestHTTPTransport:
             assert status == 200 and len(events) == 2
         finally:
             server.stop()
+
+
+async def test_device_stats_endpoint():
+    svc = HypervisorService()
+    m = await svc.create_session(M.CreateSessionRequest(creator_did="did:c"))
+    await svc.join_session(
+        m.session_id, M.JoinSessionRequest(agent_did="did:a", sigma_raw=0.9)
+    )
+    stats = await svc.device_stats()
+    assert stats.agent_rows_active >= 1
+    assert stats.session_rows >= 1
+    assert stats.agent_capacity > 0 and stats.session_capacity > 0
+    assert stats.backend

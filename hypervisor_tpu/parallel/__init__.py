@@ -12,6 +12,7 @@ from hypervisor_tpu.parallel.collectives import (
     reconcile,
     reconcile_sessions,
     sharded_admission,
+    sharded_chain,
     strong_tick,
 )
 
@@ -28,4 +29,5 @@ __all__ = [
     "eventual_tick",
     "reconcile",
     "reconcile_sessions",
+    "sharded_chain",
 ]

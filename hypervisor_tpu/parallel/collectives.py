@@ -293,6 +293,67 @@ def reconcile(mesh: Mesh):
     )
 
 
+def sharded_chain(mesh: Mesh):
+    """Sequence-parallel Merkle chaining: a delta chain longer than one
+    chip's memory, pipelined across the mesh.
+
+    SURVEY §5 maps "long context" to this framework's one genuinely
+    sequential structure — the audit chain (delta_n hashes delta_{n-1}'s
+    digest). Here the TURN axis is sharded: shard d holds turns
+    [d*T/D, (d+1)*T/D) for every lane, chains its block locally
+    (`ops.merkle.chain_digests`, a lax.scan), and hands its final
+    digests to shard d+1 over ICI with `ppermute` — the ring-pipeline
+    pattern sequence parallelism uses for attention carries, applied to
+    the hash carry. Wall-clock stays O(T) (the chain is inherently
+    sequential) but per-chip memory is O(T/D): chains that cannot fit
+    one chip stream through the mesh.
+
+    Returns fn(bodies [T, L, BODY_WORDS], seed [L, 8]) -> digests
+    [T, L, 8], with T sharded over the mesh on axis 0.
+    """
+    n_shards = mesh.devices.size
+    use_pallas = _mesh_uses_pallas(mesh)
+
+    def run(bodies, seed):
+        from hypervisor_tpu.ops import merkle as merkle_ops
+
+        my = jax.lax.axis_index(AGENT_AXIS)
+        # The replicated seed must become device-varying before it feeds
+        # loop carries that mix with ppermute outputs (shard_map tracks
+        # varying-axes in carry types).
+        seed = jnp.where(my >= 0, seed, jnp.uint32(0))
+
+        # Stage my's incoming carry: shards process in ring order; the
+        # carry visits shard d at step d.
+        def step(d, carry):
+            digests = merkle_ops.chain_digests(
+                bodies, carry, use_pallas=use_pallas
+            )
+            take = my == d
+            sent = jnp.where(take, digests[-1], jnp.zeros_like(carry))
+            # Deliver shard d's final digest to shard d+1.
+            moved = jax.lax.ppermute(
+                sent,
+                AGENT_AXIS,
+                [(i, (i + 1) % n_shards) for i in range(n_shards)],
+            )
+            # Shard d+1 adopts the delivered carry; everyone else keeps.
+            adopt = my == (d + 1)
+            return jnp.where(adopt, moved, carry)
+
+        carry = jax.lax.fori_loop(0, n_shards - 1, step, seed)
+        return merkle_ops.chain_digests(bodies, carry, use_pallas=use_pallas)
+
+    return jax.jit(
+        shard_map(
+            run,
+            mesh=mesh,
+            in_specs=(P(AGENT_AXIS, None, None), P()),
+            out_specs=P(AGENT_AXIS, None, None),
+        )
+    )
+
+
 def reconcile_sessions(mesh: Mesh):
     """EVENTUAL-mode reconciliation of the ACTUAL session-table deltas.
 

@@ -321,7 +321,7 @@ def sharded_chain(mesh: Mesh):
         # The replicated seed must become device-varying before it feeds
         # loop carries that mix with ppermute outputs (shard_map tracks
         # varying-axes in carry types).
-        seed = jnp.where(my >= 0, seed, jnp.uint32(0))
+        seed = jax.lax.pvary(seed, AGENT_AXIS)
 
         # Stage my's incoming carry: shards process in ring order; the
         # carry visits shard d at step d.
@@ -329,15 +329,14 @@ def sharded_chain(mesh: Mesh):
             digests = merkle_ops.chain_digests(
                 bodies, carry, use_pallas=use_pallas
             )
-            take = my == d
-            sent = jnp.where(take, digests[-1], jnp.zeros_like(carry))
-            # Deliver shard d's final digest to shard d+1.
+            # Every shard's final digest rides one hop down the ring;
+            # only shard d+1 (whose sender just held the true carry)
+            # adopts what arrived.
             moved = jax.lax.ppermute(
-                sent,
+                digests[-1],
                 AGENT_AXIS,
                 [(i, (i + 1) % n_shards) for i in range(n_shards)],
             )
-            # Shard d+1 adopts the delivered carry; everyone else keeps.
             adopt = my == (d + 1)
             return jnp.where(adopt, moved, carry)
 

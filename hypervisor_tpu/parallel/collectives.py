@@ -321,7 +321,7 @@ def sharded_chain(mesh: Mesh):
         # The replicated seed must become device-varying before it feeds
         # loop carries that mix with ppermute outputs (shard_map tracks
         # varying-axes in carry types).
-        seed = jax.lax.pvary(seed, AGENT_AXIS)
+        seed = jax.lax.pcast(seed, AGENT_AXIS, to="varying")
 
         # Stage my's incoming carry: shards process in ring order; the
         # carry visits shard d at step d.

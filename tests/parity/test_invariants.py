@@ -1,0 +1,171 @@
+"""Property-style invariant sweeps over the governance math.
+
+The reference lists hypothesis as a dev dependency but ships no property
+tests (SURVEY §4); these seeded random sweeps cover the same ground:
+formula invariants that must hold for ANY input, checked across many
+random draws rather than a few hand-picked examples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.models import ExecutionRing
+from hypervisor_tpu.ops import liability as liab_ops
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.ops import rings as ring_ops
+from hypervisor_tpu.tables.state import VouchTable
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_vectorized_rings_match_scalar_enum_everywhere(seed):
+    """compute_rings == ExecutionRing.from_sigma_eff for any sigma,
+    including values straddling the thresholds."""
+    rng = np.random.RandomState(seed)
+    # Boundary probes sit clearly on one side of the threshold in BOTH
+    # precisions: exactly-at-threshold f32 values tie differently under
+    # f32 (device) vs f64 (host enum) comparison — an inherent float
+    # artifact, not a semantics difference.
+    sigma = np.concatenate(
+        [
+            rng.uniform(0, 1, 500).astype(np.float32),
+            np.array([0.6000005, 0.5999995, 0.9500005,
+                      0.9499995, 0.0, 1.0], np.float32),
+        ]
+    )
+    for consensus in (False, True):
+        got = np.asarray(ring_ops.compute_rings(jnp.asarray(sigma), consensus))
+        want = np.array(
+            [
+                ExecutionRing.from_sigma_eff(float(s), has_consensus=consensus).value
+                for s in sigma
+            ],
+            np.int8,
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_sigma_eff_always_capped_and_monotone(seed):
+    """sigma_eff = min(sigma + omega*contribution, 1): in [sigma, 1],
+    monotone in the contribution."""
+    rng = np.random.RandomState(seed)
+    sigma = jnp.asarray(rng.uniform(0, 1, 300).astype(np.float32))
+    omega = jnp.asarray(rng.uniform(0, 1, 300).astype(np.float32))
+    contrib = jnp.asarray(rng.uniform(0, 5, 300).astype(np.float32))
+    eff = np.asarray(liab_ops.sigma_eff(sigma, omega, contrib))
+    assert (eff <= 1.0 + 1e-6).all()
+    assert (eff >= np.asarray(sigma) - 1e-6).all()
+    more = np.asarray(liab_ops.sigma_eff(sigma, omega, contrib + 1.0))
+    assert (more >= eff - 1e-6).all()
+
+
+def _random_vouch_graph(rng, n_agents, n_edges):
+    v = VouchTable.create(n_edges)
+    return dataclasses.replace(
+        v,
+        voucher=jnp.asarray(rng.randint(0, n_agents, n_edges, dtype=np.int64), jnp.int32),
+        vouchee=jnp.asarray(rng.randint(0, n_agents, n_edges, dtype=np.int64), jnp.int32),
+        session=jnp.asarray(rng.randint(0, 3, n_edges, dtype=np.int64), jnp.int32),
+        bond=jnp.asarray(rng.uniform(0.01, 0.3, n_edges).astype(np.float32)),
+        active=jnp.asarray(rng.uniform(0, 1, n_edges) > 0.3),
+        expiry=jnp.full((n_edges,), np.inf, jnp.float32),
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_slash_cascade_invariants(seed):
+    """For any random graph and seeds: sigma stays in [0, 1], every
+    slashed agent ends at exactly 0, every surviving clipped agent
+    respects the floor, and released bonds are exactly the in-session
+    edges feeding slashed vouchees."""
+    rng = np.random.RandomState(seed)
+    n = 128
+    vouch = _random_vouch_graph(rng, n, 512)
+    sigma = jnp.asarray(rng.uniform(0.05, 1.0, n).astype(np.float32))
+    seeds = jnp.asarray(rng.uniform(0, 1, n) > 0.9)
+    trust = DEFAULT_CONFIG.trust
+
+    out = liab_ops.slash_cascade(vouch, sigma, seeds, 1, 0.95, 0.0)
+    s = np.asarray(out.sigma)
+    slashed = np.asarray(out.slashed)
+    clipped = np.asarray(out.clipped)
+
+    assert (s >= -1e-7).all() and (s <= 1.0 + 1e-6).all()
+    # A purely-slashed agent is blacklisted to exactly 0. One that ALSO
+    # vouched for another slashed agent gets the clip floor afterwards —
+    # the reference's sequential slash produces the same 0.05
+    # (`slashing.py:89` then `:95-99` with sigma=0 input).
+    assert (s[slashed & ~clipped] == 0.0).all()
+    assert (s[slashed] <= trust.sigma_floor + 1e-6).all()
+    survivors = clipped & ~slashed
+    assert (s[survivors] >= trust.sigma_floor - 1e-6).all()
+    # Released edges: active before, inactive after, and each fed a
+    # slashed vouchee in the slashed session.
+    before = np.asarray(vouch.active)
+    after = np.asarray(out.vouch.active)
+    released = before & ~after
+    vee = np.asarray(vouch.vouchee)
+    sess = np.asarray(vouch.session)
+    assert (slashed[vee[released]]).all()
+    assert (sess[released] == 1).all()
+    # No edge became active out of nowhere.
+    assert not (~before & after).any()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_chain_verify_catches_any_single_bit_tamper(seed):
+    """Flipping ANY single bit of any body must fail verification for
+    that lane and leave the other lanes verified."""
+    rng = np.random.RandomState(seed)
+    t, lanes = 6, 4
+    bodies = rng.randint(
+        0, 2**32, size=(t, lanes, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    recorded = merkle_ops.chain_digests(jnp.asarray(bodies))
+    counts = jnp.full((lanes,), t, jnp.int32)
+
+    ok = np.asarray(
+        merkle_ops.verify_chain_digests(jnp.asarray(bodies), recorded, counts)
+    )
+    assert ok.all()
+
+    tampered = bodies.copy()
+    turn = rng.randint(t)
+    lane = rng.randint(lanes)
+    word = rng.randint(merkle_ops.BODY_WORDS)
+    bit = np.uint32(1 << rng.randint(32))
+    tampered[turn, lane, word] ^= bit
+    ok2 = np.asarray(
+        merkle_ops.verify_chain_digests(jnp.asarray(tampered), recorded, counts)
+    )
+    assert not ok2[lane]
+    mask = np.ones(lanes, bool)
+    mask[lane] = False
+    assert ok2[mask].all()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_contribution_toward_equals_bruteforce(seed):
+    """The segment-sum joint-liability contribution equals a per-edge
+    Python brute force for any random graph and target map."""
+    rng = np.random.RandomState(seed)
+    n = 64
+    vouch = _random_vouch_graph(rng, n, 256)
+    target = rng.randint(-2, 3, n).astype(np.int32)  # incl. "not joining"
+    got = np.asarray(
+        liab_ops.contribution_toward(vouch, jnp.asarray(target), 0.0)
+    )
+    want = np.zeros(n, np.float32)
+    for e in range(256):
+        vee = int(np.asarray(vouch.vouchee)[e])
+        if vee < 0 or not bool(np.asarray(vouch.active)[e]):
+            continue
+        if int(np.asarray(vouch.session)[e]) == int(target[vee]):
+            want[vee] += float(np.asarray(vouch.bond)[e])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

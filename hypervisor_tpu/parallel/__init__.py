@@ -9,6 +9,7 @@ from hypervisor_tpu.parallel.mesh import (
 from hypervisor_tpu.parallel.sharding import lane_sharding, replicated, shard_table
 from hypervisor_tpu.parallel.collectives import (
     eventual_tick,
+    multislice_reconcile,
     reconcile,
     reconcile_sessions,
     sharded_admission,
@@ -29,5 +30,6 @@ __all__ = [
     "eventual_tick",
     "reconcile",
     "reconcile_sessions",
+    "multislice_reconcile",
     "sharded_chain",
 ]

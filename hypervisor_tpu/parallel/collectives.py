@@ -31,7 +31,7 @@ from hypervisor_tpu.ops import admission as admission_ops
 from hypervisor_tpu.ops import liability as liability_ops
 from hypervisor_tpu.ops import rings as ring_ops
 from hypervisor_tpu.ops.pipeline import PipelineResult, governance_pipeline
-from hypervisor_tpu.parallel.mesh import AGENT_AXIS
+from hypervisor_tpu.parallel.mesh import AGENT_AXIS, DCN_AXIS
 from hypervisor_tpu.tables.state import FLAG_ACTIVE
 from hypervisor_tpu.tables.struct import replace as t_replace
 
@@ -392,6 +392,42 @@ def reconcile_sessions(mesh: Mesh):
             mesh=mesh,
             in_specs=(P(), P(AGENT_AXIS, None), P(AGENT_AXIS, None)),
             out_specs=(P(), P(), P()),
+        )
+    )
+
+
+def multislice_reconcile(mesh: Mesh):
+    """Cross-slice EVENTUAL reconciliation over a 2-D (dcn, agents) mesh.
+
+    Within a slice, STRONG-mode ticks psum over the agent axis on ICI;
+    ACROSS slices (pods connected by data-center network), consistency is
+    always EVENTUAL: each slice accumulates its session-table deltas
+    locally and this collective folds them over the DCN axis between
+    batched ticks — one inter-slice allreduce amortized over a whole
+    tick, never inside one (SURVEY §5's ICI-vs-DCN split).
+
+    Mesh from `make_multislice_mesh(n_slices, per_slice)`. Returns
+    fn(sessions, count_deltas [n_slices, per_slice, S]) ->
+    (sessions, total_counts [S]): deltas reduce over BOTH axes (the
+    intra-slice partials on ICI, then slices over DCN) and fold into the
+    replicated table.
+    """
+
+    def merge(sessions, count_deltas):
+        local = jnp.sum(count_deltas, axis=(0, 1))
+        within = jax.lax.psum(local, AGENT_AXIS)     # ICI first
+        total = jax.lax.psum(within, DCN_AXIS)       # then DCN
+        sessions = t_replace(
+            sessions, n_participants=sessions.n_participants + total
+        )
+        return sessions, total
+
+    return jax.jit(
+        shard_map(
+            merge,
+            mesh=mesh,
+            in_specs=(P(), P(DCN_AXIS, AGENT_AXIS, None)),
+            out_specs=(P(), P()),
         )
     )
 

@@ -278,3 +278,28 @@ class TestShardedChain:
         want = np.asarray(merkle_ops.chain_digests(jnp.asarray(bodies)))
         got = np.asarray(chain(jnp.asarray(bodies), jnp.asarray(seed)))
         np.testing.assert_array_equal(got, want)
+
+
+class TestMultisliceReconcile:
+    def test_dcn_axis_folds_slice_deltas(self):
+        """2-D (dcn, agents) mesh: per-device deltas reduce over ICI then
+        DCN and fold into the replicated session table."""
+        from hypervisor_tpu.parallel import make_multislice_mesh
+        from hypervisor_tpu.parallel.collectives import multislice_reconcile
+
+        n_slices, per_slice = 2, 4
+        mesh = make_multislice_mesh(n_slices, per_slice)
+        merge = multislice_reconcile(mesh)
+        sessions = _session_table(max_participants=64, min_sigma=0.0)
+
+        deltas = np.zeros((n_slices, per_slice, S_CAP), np.int32)
+        for sl in range(n_slices):
+            for d in range(per_slice):
+                deltas[sl, d, 0] = sl + 1   # slice 0 adds 1/dev, slice 1 adds 2/dev
+                deltas[sl, d, 2] = d % 2
+        out_sessions, total = merge(sessions, jnp.asarray(deltas))
+        want0 = per_slice * (1 + 2)
+        want2 = n_slices * sum(d % 2 for d in range(per_slice))
+        assert int(np.asarray(total)[0]) == want0
+        assert int(np.asarray(total)[2]) == want2
+        assert int(np.asarray(out_sessions.n_participants)[0]) == want0

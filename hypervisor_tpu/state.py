@@ -779,12 +779,24 @@ class HypervisorState:
 
         One jitted program: per-session Merkle roots over the recorded
         leaf digests, session-scoped bond release, participant
-        deactivation, and the TERMINATING -> ARCHIVED walk.
+        deactivation, and the TERMINATING -> ARCHIVED walk. Deactivated
+        participants' agent rows return to the free list (device-table
+        GC) so a long-running state never exhausts the agent table; the
+        rows' final values stay readable until reused (forensics), and
+        the audit index keeps the sessions' Merkle leaves.
         """
         slots = list(session_slots)
         k = len(slots)
         if k == 0:
             return np.zeros((0, 8), np.uint32)
+        # Participants to reclaim, captured before the wave deactivates.
+        # The active-flag guard prevents double-freeing rows that were
+        # already reclaimed (their session column keeps its last value).
+        from hypervisor_tpu.tables.state import FLAG_ACTIVE
+
+        in_wave = np.isin(np.asarray(self.agents.session), np.array(slots))
+        live = (np.asarray(self.agents.flags) & FLAG_ACTIVE) != 0
+        reclaim = np.nonzero(in_wave & live)[0]
         counts = np.array(
             [len(self._audit_rows.get(s, ())) for s in slots], np.int32
         )
@@ -811,6 +823,16 @@ class HypervisorState:
         self.agents = result.agents
         self.sessions = result.sessions
         self.vouches = result.vouches
+
+        if len(reclaim):
+            did_host = np.asarray(self.agents.did)
+            with self._enqueue_lock:
+                for row in reclaim:
+                    row = int(row)
+                    did = int(did_host[row])
+                    if self._slot_of_did.get(did) == row:
+                        del self._slot_of_did[did]
+                    self._free_agent_slots.append(row)
         return np.asarray(result.roots)
 
     # ── views ────────────────────────────────────────────────────────

@@ -247,3 +247,30 @@ class TestSagaTable:
         st.saga_round({g: True for g in slots})
         states = np.asarray(st.sagas.saga_state)[slots]
         assert (states == saga_ops.SAGA_COMPLETED).all()
+
+
+class TestAgentRowGC:
+    def test_terminated_sessions_reclaim_agent_rows(self):
+        """A long-running state must not exhaust the agent table: rows of
+        terminated sessions return to the free list and get reused."""
+        st = HypervisorState()
+        for round_no in range(3):
+            slot = st.create_session(f"s:gc{round_no}", SessionConfig())
+            for a in range(4):
+                st.enqueue_join(slot, f"did:gc{round_no}:{a}", 0.8)
+            assert (st.flush_joins() == 0).all()
+            st.terminate_sessions([slot])
+        # 12 joins total, but rows recycled: the high-water mark stays
+        # at one round's worth.
+        assert st._next_agent_slot == 4
+        assert len(st._free_agent_slots) == 4
+
+    def test_no_double_free_on_repeat_terminate(self):
+        st = HypervisorState()
+        slot = st.create_session("s:dup", SessionConfig())
+        st.enqueue_join(slot, "did:x", 0.8)
+        assert (st.flush_joins() == 0).all()
+        st.terminate_sessions([slot])
+        first = list(st._free_agent_slots)
+        st.terminate_sessions([slot])  # idempotent re-terminate
+        assert st._free_agent_slots == first

@@ -40,6 +40,7 @@ from hypervisor_tpu.tables.logs import DeltaLog, EventLog
 from hypervisor_tpu.tables.state import (
     AgentTable,
     ElevationTable,
+    FLAG_ACTIVE,
     SagaTable,
     SessionTable,
     VouchTable,
@@ -255,9 +256,11 @@ class HypervisorState:
         for s, h, slot, is_ok in zip(agent_sessions, handles, agent_slots, ok):
             if is_ok:
                 self._members[(int(s), int(h))] = True
-                self._slot_of_did[int(h)] = int(slot)
-            else:
-                self._free_agent_slots.append(int(slot))
+            # Every wave row is dead after the wave: rejected rows were
+            # never admitted, admitted rows belong to sessions this same
+            # program terminated — all reclaim (device-table GC), and
+            # none are cached in _slot_of_did.
+            self._free_agent_slots.append(int(slot))
 
         # Record the wave's audit chain in the DeltaLog (lane-major).
         chain = np.asarray(result.chain)  # [T, K, 8]
@@ -792,8 +795,6 @@ class HypervisorState:
         # Participants to reclaim, captured before the wave deactivates.
         # The active-flag guard prevents double-freeing rows that were
         # already reclaimed (their session column keeps its last value).
-        from hypervisor_tpu.tables.state import FLAG_ACTIVE
-
         in_wave = np.isin(np.asarray(self.agents.session), np.array(slots))
         live = (np.asarray(self.agents.flags) & FLAG_ACTIVE) != 0
         reclaim = np.nonzero(in_wave & live)[0]
@@ -852,8 +853,12 @@ class HypervisorState:
         i = self._slot_of_did.get(did)
         if i is None:
             # Slow path (e.g. state restored from a checkpoint): scan the
-            # table once and cache the mapping.
-            hits = np.nonzero(np.asarray(self.agents.did) == did)[0]
+            # table once and cache the mapping. Only LIVE rows match — a
+            # reclaimed row still carries its last did until reuse, and
+            # resurrecting it would later serve another agent's data
+            # under this did once the row is recycled.
+            live = (np.asarray(self.agents.flags) & FLAG_ACTIVE) != 0
+            hits = np.nonzero((np.asarray(self.agents.did) == did) & live)[0]
             if len(hits) == 0:
                 return None
             i = int(hits[-1])

@@ -47,6 +47,10 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["Hypervisor", "ManagedSession"]
 
+# Omega applied when a drift violation slashes an agent — ONE constant so
+# the host SlashingEngine and the device cascade can never diverge.
+DRIFT_SLASH_RISK_WEIGHT = 0.95
+
 
 class ManagedSession:
     """One session plus its session-scoped engines.
@@ -109,8 +113,16 @@ class Hypervisor:
         # The batched device plane every lifecycle call routes through.
         self.state = state if state is not None else HypervisorState()
 
-        # Shared cross-session engines.
-        self.vouching = VouchingEngine(max_exposure=max_exposure)
+        # Shared cross-session engines. Vouches mirror into the device
+        # VouchTable (the liability analog of the delta sink): bonds the
+        # host engine creates/releases appear as device edges, so slash
+        # cascades and sigma_eff contributions run on the same graph.
+        self._edge_of_vouch: dict[str, int] = {}
+        self.vouching = VouchingEngine(
+            max_exposure=max_exposure,
+            on_vouch=self._mirror_vouch,
+            on_release=self._mirror_release,
+        )
         self.slashing = SlashingEngine(self.vouching)
         self.ring_enforcer = RingEnforcer()
         self.classifier = ActionClassifier()
@@ -303,6 +315,16 @@ class Hypervisor:
                 payload={"merkle_root": merkle_root},
             )
 
+        # The device wave above already released the session's edges in
+        # one masked update; recycle their rows host-side and detach the
+        # mirror so the host engine's per-bond releases below don't issue
+        # one redundant device write each.
+        session_rows = [
+            self._edge_of_vouch.pop(rec.vouch_id)
+            for rec in self.vouching.session_records(session_id)
+            if rec.vouch_id in self._edge_of_vouch
+        ]
+        self.state.free_edge_rows(session_rows)
         self.vouching.release_session_bonds(session_id)
 
         self.gc.collect(
@@ -348,11 +370,23 @@ class Hypervisor:
             agent_scores = {
                 p.agent_did: p.sigma_eff for p in managed.sso.participants
             }
+            # Device plane FIRST: the cascade over the mirrored VouchTable
+            # blacklists the row, clips vouchers, and releases consumed
+            # edges. It must see the pre-slash graph — the host slash
+            # below releases bonds through the mirror as it clips.
+            rogue = self.state.agent_row(agent_did)
+            if rogue is not None:
+                self.state.apply_slash(
+                    managed.slot,
+                    rogue["slot"],
+                    risk_weight=DRIFT_SLASH_RISK_WEIGHT,
+                    now=self.state.now(),
+                )
             self.slashing.slash(
                 vouchee_did=agent_did,
                 session_id=session_id,
                 vouchee_sigma=participant.sigma_eff,
-                risk_weight=0.95,
+                risk_weight=DRIFT_SLASH_RISK_WEIGHT,
                 reason=f"CMVK drift: {result.drift_score:.3f} ({result.severity.value})",
                 agent_scores=agent_scores,
             )
@@ -374,6 +408,39 @@ class Hypervisor:
             )
 
         return result
+
+    def _mirror_vouch(self, record) -> None:
+        """Host bond -> device VouchTable edge (when both agents and the
+        session are resident in the device tables)."""
+        managed = self._sessions.get(record.session_id)
+        voucher = self.state.agent_row(record.voucher_did)
+        vouchee = self.state.agent_row(record.vouchee_did)
+        if managed is None or voucher is None or vouchee is None:
+            return
+        try:
+            edge = self.state.add_vouch(
+                voucher["slot"],
+                vouchee["slot"],
+                managed.slot,
+                bond=record.bonded_amount,
+                bond_pct=record.bonded_sigma_pct,
+                expiry=(
+                    # Device columns hold epoch-RELATIVE f32 time.
+                    self.state.to_device_time(record.expiry.timestamp())
+                    if record.expiry
+                    else float("inf")
+                ),
+            )
+        except RuntimeError as exc:
+            # Mirror degradation must not corrupt the committed host bond.
+            logger.warning("vouch mirror skipped for %s: %s", record.vouch_id, exc)
+            return
+        self._edge_of_vouch[record.vouch_id] = edge
+
+    def _mirror_release(self, vouch_id: str) -> None:
+        edge = self._edge_of_vouch.pop(vouch_id, None)
+        if edge is not None:
+            self.state.release_vouch(edge)
 
     def sync_events_to_device(self) -> int:
         """Mirror new bus events into the device EventLog ring buffer.

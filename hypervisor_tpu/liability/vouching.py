@@ -65,10 +65,19 @@ class VouchingEngine:
     DEFAULT_MAX_EXPOSURE = DEFAULT_CONFIG.trust.max_exposure
 
     def __init__(
-        self, max_exposure: Optional[float] = None, clock: Clock = utc_now
+        self,
+        max_exposure: Optional[float] = None,
+        clock: Clock = utc_now,
+        on_vouch=None,
+        on_release=None,
     ) -> None:
         self.max_exposure = max_exposure or self.DEFAULT_MAX_EXPOSURE
         self._clock = clock
+        # Optional mirrors: the facade wires these so every bond created
+        # or released here lands in the device VouchTable too (the
+        # liability analog of the DeltaEngine sink).
+        self._on_vouch = on_vouch
+        self._on_release = on_release
         self.agents = InternTable()
         self.sessions = InternTable()
         # SoA edge columns (host mirror of tables.state.VouchTable)
@@ -133,7 +142,10 @@ class VouchingEngine:
             hr, he, hs, pct, bonded,
             np.inf if expiry is None else expiry.timestamp(),
         )
-        return self._view(row, expiry)
+        record = self._view(row, expiry)
+        if self._on_vouch is not None:
+            self._on_vouch(record)
+        return record
 
     def compute_sigma_eff(
         self,
@@ -173,6 +185,8 @@ class VouchingEngine:
             raise VouchingError(f"Vouch {vouch_id} not found")
         self._active[row] = False
         self._released[row] = self._clock()
+        if self._on_release is not None:
+            self._on_release(vouch_id)
 
     def release_session_bonds(self, session_id: str) -> int:
         """Release every live bond in the session; returns the count."""
@@ -186,6 +200,8 @@ class VouchingEngine:
         self._active[rows] = False
         for r in rows:
             self._released[int(r)] = now
+            if self._on_release is not None:
+                self._on_release(self._ids[int(r)])
         return int(len(rows))
 
     # ── record iteration (API/stats surface) ─────────────────────────

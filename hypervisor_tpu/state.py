@@ -32,6 +32,7 @@ from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.models import SessionConfig, SessionState
 from hypervisor_tpu.observability import profiling
 from hypervisor_tpu.ops import admission, saga_ops, security_ops
+from hypervisor_tpu.ops import liability as liability_ops
 from hypervisor_tpu.ops import merkle as merkle_ops
 from hypervisor_tpu.ops import pipeline as pipeline_ops
 from hypervisor_tpu.ops import terminate as terminate_ops
@@ -54,6 +55,7 @@ _SAGA_TICK = jax.jit(saga_ops.saga_table_tick)
 _TERMINATE = jax.jit(terminate_ops.terminate_batch, static_argnames=("use_pallas",))
 _WAVE = jax.jit(pipeline_ops.governance_wave, static_argnames=("use_pallas",))
 _RECORD_CALLS = jax.jit(security_ops.record_calls)
+_SLASH = jax.jit(liability_ops.slash_cascade)
 _BREACH_SWEEP = jax.jit(security_ops.breach_sweep)
 _ELEV_EXPIRY = jax.jit(security_ops.elevation_expiry)
 _EFF_RINGS = jax.jit(security_ops.effective_rings)
@@ -80,6 +82,7 @@ class HypervisorState:
         self._next_session_slot = 0
         self._next_saga_slot = 0
         self._next_edge_slot = 0
+        self._free_edge_slots: list[int] = []
         self._next_elev_slot = 0
         self._free_elev_slots: list[int] = []
         self._members: dict[tuple[int, int], bool] = {}  # (session, did) -> True
@@ -402,14 +405,18 @@ class HypervisorState:
         bond_pct: float = 0.20,
         expiry: float = np.inf,
     ) -> int:
-        """Insert one liability edge; returns the edge row."""
-        if self._next_edge_slot >= self.vouches.voucher.shape[0]:
+        """Insert one liability edge; returns the edge row (rows released
+        via release_vouch / free_edge_rows are recycled)."""
+        if self._free_edge_slots:
+            row = self._free_edge_slots.pop()
+        elif self._next_edge_slot < self.vouches.voucher.shape[0]:
+            row = self._next_edge_slot
+            self._next_edge_slot += 1
+        else:
             raise RuntimeError(
                 f"vouch table full ({self.vouches.voucher.shape[0]}); "
                 "raise config.capacity.max_vouch_edges"
             )
-        row = self._next_edge_slot
-        self._next_edge_slot += 1
         self.vouches = replace(
             self.vouches,
             voucher=self.vouches.voucher.at[row].set(voucher_slot),
@@ -421,6 +428,69 @@ class HypervisorState:
             expiry=self.vouches.expiry.at[row].set(expiry),
         )
         return row
+
+    def release_vouch(self, edge_row: int) -> None:
+        """Deactivate one liability edge and recycle its row."""
+        self.vouches = replace(
+            self.vouches, active=self.vouches.active.at[edge_row].set(False)
+        )
+        self._free_edge_slots.append(edge_row)
+
+    def free_edge_rows(self, edge_rows) -> None:
+        """Recycle rows a device wave already deactivated (host-only
+        bookkeeping — no device write)."""
+        self._free_edge_slots.extend(int(r) for r in edge_rows)
+
+    def to_device_time(self, absolute_ts: float) -> float:
+        """Absolute unix seconds -> this state's epoch-relative f32 time."""
+        return absolute_ts - self._epoch_base
+
+    def apply_slash(
+        self,
+        session_slot: int,
+        vouchee_slot: int,
+        risk_weight: float,
+        now: float = 0.0,
+    ) -> dict:
+        """Run the batched slash cascade ON the device tables.
+
+        Blacklists the vouchee (sigma_eff -> 0, FLAG_BLACKLISTED), clips
+        its vouchers with the joint-liability formula (depth-bounded
+        cascade, `ops.liability.slash_cascade`), releases consumed bonds
+        in the VouchTable, and recomputes rings from the post-slash
+        sigma. Returns {"slashed": [...], "clipped": [...]} agent slots.
+        """
+        from hypervisor_tpu.ops import rings as ring_ops
+        from hypervisor_tpu.tables.state import FLAG_BLACKLISTED
+
+        n = self.agents.sigma_eff.shape[0]
+        seeds = jnp.zeros((n,), bool).at[vouchee_slot].set(True)
+        with profiling.span("hv.slash_cascade"):
+            result = _SLASH(
+                self.vouches,
+                self.agents.sigma_eff,
+                seeds,
+                session_slot,
+                risk_weight,
+                now,
+            )
+        touched = result.slashed | result.clipped
+        new_rings = ring_ops.compute_rings(result.sigma, False)
+        self.agents = replace(
+            self.agents,
+            sigma_eff=result.sigma,
+            ring=jnp.where(touched, new_rings, self.agents.ring).astype(jnp.int8),
+            flags=jnp.where(
+                result.slashed,
+                self.agents.flags | FLAG_BLACKLISTED,
+                self.agents.flags,
+            ).astype(self.agents.flags.dtype),
+        )
+        self.vouches = result.vouch
+        return {
+            "slashed": np.nonzero(np.asarray(result.slashed))[0].tolist(),
+            "clipped": np.nonzero(np.asarray(result.clipped))[0].tolist(),
+        }
 
     # ── sagas ────────────────────────────────────────────────────────
 

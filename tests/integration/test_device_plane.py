@@ -274,3 +274,68 @@ class TestAgentRowGC:
         first = list(st._free_agent_slots)
         st.terminate_sessions([slot])  # idempotent re-terminate
         assert st._free_agent_slots == first
+
+
+class TestLiabilityMirror:
+    def test_host_vouch_appears_as_device_edge(self):
+        hv = Hypervisor()
+
+        async def flow():
+            managed = await hv.create_session(SessionConfig(), "did:c")
+            sid = managed.sso.session_id
+            await hv.join_session(sid, "did:strong", sigma_raw=0.9)
+            await hv.join_session(sid, "did:weak", sigma_raw=0.5)
+            rec = hv.vouching.vouch("did:strong", "did:weak", sid, voucher_sigma=0.9)
+            return managed, sid, rec
+
+        managed, sid, rec = _run(flow())
+        st = hv.state
+        edge = hv._edge_of_vouch[rec.vouch_id]
+        assert bool(np.asarray(st.vouches.active)[edge])
+        assert float(np.asarray(st.vouches.bond)[edge]) == pytest.approx(
+            rec.bonded_amount
+        )
+        assert int(np.asarray(st.vouches.session)[edge]) == managed.slot
+        # host release mirrors too
+        hv.vouching.release_bond(rec.vouch_id)
+        assert not bool(np.asarray(st.vouches.active)[edge])
+
+    def test_drift_slash_cascades_on_device(self):
+        class Verdict:
+            drift_score = 0.8
+            explanation = None
+
+        class Verifier:
+            def verify_embeddings(self, **kw):
+                return Verdict()
+
+        from hypervisor_tpu.integrations import CMVKAdapter
+        from hypervisor_tpu.tables.state import FLAG_BLACKLISTED
+
+        hv = Hypervisor(cmvk=CMVKAdapter(verifier=Verifier()))
+
+        async def flow():
+            managed = await hv.create_session(SessionConfig(), "did:c")
+            sid = managed.sso.session_id
+            await hv.join_session(sid, "did:voucher", sigma_raw=0.9)
+            await hv.join_session(sid, "did:rogue", sigma_raw=0.62)
+            rec = hv.vouching.vouch("did:voucher", "did:rogue", sid, voucher_sigma=0.9)
+            drift = await hv.verify_behavior(sid, "did:rogue", "claimed", "observed")
+            return managed, rec, drift
+
+        managed, rec, drift = _run(flow())
+        assert drift.should_slash
+        st = hv.state
+        rogue = st.agent_row("did:rogue")
+        voucher = st.agent_row("did:voucher")
+        # device blacklisted the rogue and clipped its voucher
+        assert rogue["sigma_eff"] == 0.0
+        assert int(np.asarray(st.agents.flags)[rogue["slot"]]) & FLAG_BLACKLISTED
+        assert voucher["sigma_eff"] == pytest.approx(
+            max(0.9 * (1 - 0.95), 0.05), abs=1e-6
+        )
+        assert rogue["ring"] == 3  # demoted by the post-slash ring recompute
+        # the consumed edge released on device
+        edge = hv._edge_of_vouch.get(rec.vouch_id)
+        if edge is not None:
+            assert not bool(np.asarray(st.vouches.active)[edge])

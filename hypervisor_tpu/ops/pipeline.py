@@ -68,8 +68,16 @@ def governance_pipeline(
     active: jnp.ndarray,          # bool[S] lane mask
     trust: TrustConfig = DEFAULT_CONFIG.trust,
     use_pallas: bool | None = None,
+    contribution: jnp.ndarray | None = None,  # f32[S] bonded sigma per lane
+    omega: jnp.ndarray | float = 0.5,
 ) -> PipelineResult:
     """Run the full governance pipeline for S session lanes on device.
+
+    With `contribution` (each lane's bonded sigma from its vouchers, e.g.
+    `ops.liability.voucher_contribution` over a VouchTable), admission
+    applies the joint-liability formula sigma_eff = min(sigma_raw +
+    omega * contribution, 1.0) — vouched agents clear higher rings than
+    their raw sigma allows (`liability/vouching.py:128-151`).
 
     `use_pallas` routes the SHA-256 hot loops through the Mosaic kernel;
     None = auto by backend, False forced by `parallel.collectives` when the
@@ -78,8 +86,13 @@ def governance_pipeline(
     s = sigma_raw.shape[0]
     t = delta_bodies.shape[0]
 
-    # ── 1. admission: sigma -> ring; untrustworthy agents sandboxed ──
-    sigma_eff = sigma_raw
+    # ── 1. admission: vouched sigma -> ring; untrustworthy sandboxed ──
+    if contribution is None:
+        sigma_eff = sigma_raw
+    else:
+        sigma_eff = jnp.minimum(
+            sigma_raw + jnp.asarray(omega, jnp.float32) * contribution, 1.0
+        )
     ring = ring_ops.compute_rings(sigma_eff, False, trust)
     ring = jnp.where(trustworthy, ring, jnp.int8(3))
     # Non-sandbox joins must clear the session sigma floor

@@ -47,17 +47,20 @@ def _mesh_uses_pallas(mesh: Mesh) -> bool:
     return all(d.platform == "tpu" for d in mesh.devices.flat)
 
 
-def strong_tick(mesh: Mesh):
+def strong_tick(mesh: Mesh, with_vouching: bool = False):
     """Build the jitted multi-chip governance tick (STRONG consistency).
 
-    Returns fn(sigma_raw, trustworthy, min_sigma_eff, delta_bodies, active)
-    with every [S]-leading input sharded over the agent axis; the returned
+    Returns fn(sigma_raw, trustworthy, min_sigma_eff, delta_bodies,
+    active[, contribution]) with every [S]-leading input sharded over the
+    agent axis; with_vouching adds the per-lane bonded-sigma input so
+    admission applies the joint-liability formula. The returned
     `consensus` vector is psum'd over ICI so all chips agree.
     """
     lane = P(AGENT_AXIS)
     use_pallas = _mesh_uses_pallas(mesh)
 
-    def tick(sigma_raw, trustworthy, min_sigma_eff, delta_bodies, active):
+    def tick(sigma_raw, trustworthy, min_sigma_eff, delta_bodies, active,
+             *contribution):
         result = governance_pipeline(
             sigma_raw,
             trustworthy,
@@ -65,15 +68,19 @@ def strong_tick(mesh: Mesh):
             delta_bodies,
             active,
             use_pallas=use_pallas,
+            contribution=contribution[0] if contribution else None,
         )
         # Cross-chip consensus barrier: allreduce the session aggregates.
         consensus = jax.lax.psum(result.consensus, AGENT_AXIS)
         return result._replace(consensus=consensus)
 
+    in_specs = (lane, lane, lane, P(None, AGENT_AXIS), lane)
+    if with_vouching:
+        in_specs = in_specs + (lane,)
     mapped = shard_map(
         tick,
         mesh=mesh,
-        in_specs=(lane, lane, lane, P(None, AGENT_AXIS), lane),
+        in_specs=in_specs,
         out_specs=PipelineResult(
             ring=lane,
             sigma_eff=lane,
@@ -83,7 +90,7 @@ def strong_tick(mesh: Mesh):
             status=lane,
             consensus=P(),  # replicated after psum
         ),
-        
+
     )
     return jax.jit(mapped)
 

@@ -50,6 +50,26 @@ class SagaScheduler:
         if undo is not None:
             self._undo[(saga_slot, step_idx)] = undo
 
+    def register_definition(
+        self,
+        saga_slot: int,
+        definition,
+        executors: dict[str, Executor],
+        undos: Optional[dict[str, Executor]] = None,
+    ) -> None:
+        """Wire a parsed SagaDefinition's steps to executors by step id.
+
+        Pairs with `HypervisorState.create_saga_from_dsl`: the DSL
+        declares the topology, the caller supplies callables keyed by the
+        DSL step ids.
+        """
+        undos = undos or {}
+        for idx, step in enumerate(definition.steps):
+            execute = executors.get(step.id)
+            if execute is None:
+                raise KeyError(f"no executor for DSL step '{step.id}'")
+            self.register(saga_slot, idx, execute, undo=undos.get(step.id))
+
     async def run_until_settled(self, max_rounds: int = 1000) -> None:
         """Round-run the table until every saga reaches a terminal state."""
         state = self._state

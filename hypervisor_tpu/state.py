@@ -539,6 +539,26 @@ class HypervisorState:
         )
         return slot
 
+    def create_saga_from_dsl(self, definition, session_slot: int) -> int:
+        """Materialize a parsed SagaDefinition as a SagaTable row.
+
+        Bridges the declarative DSL (`saga/dsl.py`) to the device
+        scheduler: step order, retry budgets, undo availability, and
+        timeouts come straight from the definition.
+        """
+        return self.create_saga(
+            definition.saga_id,
+            session_slot,
+            [
+                {
+                    "retries": step.retries,
+                    "has_undo": step.undo_api is not None,
+                    "timeout": float(step.timeout),
+                }
+                for step in definition.steps
+            ],
+        )
+
     def saga_work(self) -> tuple[list[tuple[int, int]], list[tuple[int, int]]]:
         """(execute, compensate) work lists for the host executor shim.
 

@@ -339,3 +339,74 @@ class TestLiabilityMirror:
         edge = hv._edge_of_vouch.get(rec.vouch_id)
         if edge is not None:
             assert not bool(np.asarray(st.vouches.active)[edge])
+
+
+class TestDslToDevice:
+    def test_dsl_definition_runs_on_saga_table(self):
+        """DSL -> SagaTable -> scheduler: the declarative topology drives
+        the device scheduling rounds end-to-end."""
+        from hypervisor_tpu.saga import SagaDSLParser
+
+        st = HypervisorState()
+        slot = st.create_session("s:dsl", SessionConfig())
+        definition = SagaDSLParser().parse(
+            {
+                "name": "deploy",
+                "session_id": "s:dsl",
+                "steps": [
+                    {"id": "validate", "action_id": "m.v", "agent": "did:v",
+                     "undo_api": "/undo-v", "retries": 1},
+                    {"id": "deploy", "action_id": "m.d", "agent": "did:d",
+                     "undo_api": "/undo-d"},
+                    {"id": "announce", "action_id": "m.a", "agent": "did:a"},
+                ],
+            }
+        )
+        g = st.create_saga_from_dsl(definition, slot)
+        retries = np.asarray(st.sagas.retries_left)[g]
+        has_undo = np.asarray(st.sagas.has_undo)[g]
+        assert retries[0] == 1 and retries[1] == 0
+        assert list(has_undo[:3]) == [True, True, False]
+
+        sched = SagaScheduler(st, retry_backoff_seconds=0.0)
+        calls = []
+
+        async def ok_factory(name):
+            async def run():
+                calls.append(name)
+                return name
+            return run
+
+        async def wire():
+            sched.register_definition(
+                g,
+                definition,
+                executors={
+                    "validate": await ok_factory("validate"),
+                    "deploy": await ok_factory("deploy"),
+                    "announce": await ok_factory("announce"),
+                },
+            )
+            await sched.run_until_settled()
+
+        asyncio.run(wire())
+        assert calls == ["validate", "deploy", "announce"]
+        assert (
+            int(np.asarray(st.sagas.saga_state)[g]) == saga_ops.SAGA_COMPLETED
+        )
+
+    def test_missing_executor_is_a_wiring_error(self):
+        from hypervisor_tpu.saga import SagaDSLParser
+
+        st = HypervisorState()
+        slot = st.create_session("s:dsl2", SessionConfig())
+        definition = SagaDSLParser().parse(
+            {
+                "name": "x", "session_id": "s",
+                "steps": [{"id": "only", "action_id": "m", "agent": "d"}],
+            }
+        )
+        g = st.create_saga_from_dsl(definition, slot)
+        sched = SagaScheduler(st)
+        with pytest.raises(KeyError, match="only"):
+            sched.register_definition(g, definition, executors={})

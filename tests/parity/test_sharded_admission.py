@@ -303,3 +303,35 @@ class TestMultisliceReconcile:
         assert int(np.asarray(total)[0]) == want0
         assert int(np.asarray(total)[2]) == want2
         assert int(np.asarray(out_sessions.n_participants)[0]) == want0
+
+
+class TestVouchedStrongTick:
+    def test_contribution_lifts_rings_across_mesh(self):
+        """strong_tick(with_vouching=True): bonded contributions lift
+        vouched lanes over the ring threshold on every shard."""
+        from hypervisor_tpu.ops import merkle as merkle_ops
+        from hypervisor_tpu.parallel import strong_tick
+
+        mesh = _mesh()
+        tick = strong_tick(mesh, with_vouching=True)
+        s, t = N_DEV * 4, 2
+        rng = np.random.RandomState(0)
+        bodies = rng.randint(
+            0, 2**32, size=(t, s, merkle_ops.BODY_WORDS), dtype=np.uint64
+        ).astype(np.uint32)
+        sigma = np.full(s, 0.5, np.float32)
+        contribution = np.zeros(s, np.float32)
+        contribution[:: N_DEV] = 0.4  # one vouched lane per shard
+        out = tick(
+            jnp.asarray(sigma),
+            jnp.ones(s, bool),
+            jnp.zeros(s, jnp.float32),
+            jnp.asarray(bodies),
+            jnp.ones(s, bool),
+            jnp.asarray(contribution),
+        )
+        rings = np.asarray(out.ring)
+        sig = np.asarray(out.sigma_eff)
+        assert (rings[:: N_DEV] == 2).all()          # lifted: 0.5+0.5*0.4=0.7
+        assert (np.delete(rings, slice(None, None, N_DEV)) == 3).all()
+        np.testing.assert_allclose(sig[:: N_DEV], 0.7, rtol=1e-6)

@@ -96,6 +96,7 @@ def host_metadata(state: HypervisorState) -> dict:
         "next_elev_slot": state._next_elev_slot,
         "members": sorted([list(k) for k in state._members]),
         "free_agent_slots": list(state._free_agent_slots),
+        "free_edge_slots": list(state._free_edge_slots),
         "free_elev_slots": list(state._free_elev_slots),
         "epoch_base": state._epoch_base,
         "audit_rows": {str(k): v for k, v in state._audit_rows.items()},
@@ -234,6 +235,9 @@ def restore_state(
     state._turns = {int(k): int(v) for k, v in meta.get("turns", {}).items()}
     state._free_agent_slots = [
         int(r) for r in meta.get("free_agent_slots", [])
+    ]
+    state._free_edge_slots = [
+        int(r) for r in meta.get("free_edge_slots", [])
     ]
     state._free_elev_slots = [
         int(r) for r in meta.get("free_elev_slots", [])

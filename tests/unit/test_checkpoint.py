@@ -66,3 +66,82 @@ def test_background_save_is_durable(tmp_path):
     assert wait_durable(target, timeout=30.0)
     back = restore_state(target)
     assert back.participant_count(0) == st.participant_count(0)
+
+
+class TestMidSagaResume:
+    def test_saga_resumes_across_checkpoint_restore(self, tmp_path):
+        """Crash-recovery: a saga checkpointed mid-flight finishes after
+        restore — cursor, retry budgets, and step states all survive."""
+        import asyncio
+
+        from hypervisor_tpu.models import SessionConfig
+        from hypervisor_tpu.ops import saga_ops
+        from hypervisor_tpu.runtime.saga_scheduler import SagaScheduler
+
+        st = HypervisorState()
+        slot = st.create_session("s:resume", SessionConfig())
+        g = st.create_saga(
+            "saga:resume", slot, [{"retries": 1}, {}, {"has_undo": True}]
+        )
+        # Advance one round: step 0 commits.
+        st.saga_round({g: True})
+        assert int(np.asarray(st.sagas.cursor)[g]) == 1
+
+        target = save_state(st, tmp_path / "mid")
+        restored = restore_state(target)
+        assert int(np.asarray(restored.sagas.cursor)[g]) == 1
+        assert (
+            int(np.asarray(restored.sagas.step_state)[g, 0])
+            == saga_ops.STEP_COMMITTED
+        )
+
+        # Finish on the RESTORED state with real executors.
+        sched = SagaScheduler(restored, retry_backoff_seconds=0.0)
+
+        async def ok():
+            return "ok"
+
+        sched.register(g, 1, ok)
+        sched.register(g, 2, ok, undo=ok)
+        asyncio.run(sched.run_until_settled())
+        assert (
+            int(np.asarray(restored.sagas.saga_state)[g])
+            == saga_ops.SAGA_COMPLETED
+        )
+
+    def test_vouch_and_elevation_state_survive(self, tmp_path):
+        from hypervisor_tpu.models import SessionConfig
+
+        st = HypervisorState()
+        slot = st.create_session("s:ve", SessionConfig())
+        st.enqueue_join(slot, "did:a", 0.9)
+        st.enqueue_join(slot, "did:b", 0.5)
+        assert (st.flush_joins() == 0).all()
+        a = st.agent_row("did:a")
+        b = st.agent_row("did:b")
+        edge = st.add_vouch(a["slot"], b["slot"], slot, bond=0.18)
+        st.grant_elevation(b["slot"], granted_ring=1, now=0.0, ttl_seconds=50.0)
+
+        restored = restore_state(save_state(st, tmp_path / "ve"))
+        assert bool(np.asarray(restored.vouches.active)[edge])
+        assert restored.effective_rings(now=10.0)[b["slot"]] == 1
+        assert restored.effective_rings(now=60.0)[b["slot"]] == b["ring"]
+        # edge recycling state survives: release + re-add reuses the row
+        restored.release_vouch(edge)
+        edge2 = restored.add_vouch(a["slot"], b["slot"], slot, bond=0.10)
+        assert edge2 == edge
+
+    def test_free_edge_rows_survive_restore(self, tmp_path):
+        from hypervisor_tpu.models import SessionConfig
+
+        st = HypervisorState()
+        slot = st.create_session("s:fe", SessionConfig())
+        st.enqueue_join(slot, "did:x", 0.9)
+        st.enqueue_join(slot, "did:y", 0.5)
+        assert (st.flush_joins() == 0).all()
+        x = st.agent_row("did:x")["slot"]
+        y = st.agent_row("did:y")["slot"]
+        edge = st.add_vouch(x, y, slot, bond=0.1)
+        st.release_vouch(edge)  # row on the free list at save time
+        restored = restore_state(save_state(st, tmp_path / "fe"))
+        assert restored.add_vouch(x, y, slot, bond=0.2) == edge  # recycled

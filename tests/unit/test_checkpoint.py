@@ -74,7 +74,6 @@ class TestMidSagaResume:
         restore — cursor, retry budgets, and step states all survive."""
         import asyncio
 
-        from hypervisor_tpu.models import SessionConfig
         from hypervisor_tpu.ops import saga_ops
         from hypervisor_tpu.runtime.saga_scheduler import SagaScheduler
 
@@ -110,8 +109,6 @@ class TestMidSagaResume:
         )
 
     def test_vouch_and_elevation_state_survive(self, tmp_path):
-        from hypervisor_tpu.models import SessionConfig
-
         st = HypervisorState()
         slot = st.create_session("s:ve", SessionConfig())
         st.enqueue_join(slot, "did:a", 0.9)
@@ -132,8 +129,6 @@ class TestMidSagaResume:
         assert edge2 == edge
 
     def test_free_edge_rows_survive_restore(self, tmp_path):
-        from hypervisor_tpu.models import SessionConfig
-
         st = HypervisorState()
         slot = st.create_session("s:fe", SessionConfig())
         st.enqueue_join(slot, "did:x", 0.9)

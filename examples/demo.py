@@ -217,6 +217,101 @@ def demo_batched_pipeline() -> None:
           f"p50 ({dt / s * 1e6:.2f} µs/session)")
 
 
+async def demo_device_plane() -> None:
+    banner("7. Device plane: the real-table wave, saga table, write wave")
+    import numpy as np
+    import jax.numpy as jnp
+
+    from hypervisor_tpu.models import SessionConfig
+    from hypervisor_tpu.ops import merkle as merkle_ops
+    from hypervisor_tpu.runtime.saga_scheduler import SagaScheduler
+    from hypervisor_tpu.runtime.write_wave import WriteWave
+    from hypervisor_tpu.session.vfs import SessionVFS
+    from hypervisor_tpu.state import HypervisorState
+    from hypervisor_tpu.tables.struct import replace as t_replace
+
+    # One fused governance wave over the REAL HBM tables, with vouched
+    # lanes: create K sessions, admit K agents (joint-liability sigma),
+    # chain 3 audit deltas each, run a saga step, terminate with bond
+    # release — one jitted program.
+    k = 2048
+    st = HypervisorState()
+    slots = st.create_sessions_batch(
+        [f"demo:s{i}" for i in range(k)], SessionConfig(min_sigma_eff=0.0)
+    )
+    sigma = np.full(k, 0.8, np.float32)
+    sigma[:256] = 0.5  # vouched lanes: raw 0.5 + bond 0.3 * omega 0.5 = 0.65
+    vt = st.vouches
+    st.vouches = t_replace(
+        vt,
+        voucher=vt.voucher.at[:256].set(jnp.arange(k, k + 256, dtype=jnp.int32)),
+        vouchee=vt.vouchee.at[:256].set(jnp.arange(256, dtype=jnp.int32)),
+        session=vt.session.at[:256].set(jnp.asarray(slots[:256])),
+        bond=vt.bond.at[:256].set(0.3),
+        active=vt.active.at[:256].set(True),
+    )
+    rng = np.random.RandomState(1)
+    bodies = rng.randint(
+        0, 2**32, size=(3, k, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    result = st.run_governance_wave(
+        slots, [f"did:wave:{i}" for i in range(k)], slots.copy(), sigma, bodies
+    )
+    rings = np.asarray(result.ring)
+    print(f"wave: {int((np.asarray(result.status) == 0).sum())}/{k} lanes OK, "
+          f"{int((rings[:256] == 2).sum())}/256 vouched lanes lifted to Ring 2, "
+          f"{int(np.asarray(result.released))} bonds released at terminate")
+
+    # SagaTable: a declarative DSL saga scheduled in batched device rounds.
+    from hypervisor_tpu.saga import SagaDSLParser
+
+    st2 = HypervisorState()
+    sslot = st2.create_session("demo:saga", SessionConfig())
+    definition = SagaDSLParser().parse({
+        "name": "deploy", "session_id": "demo:saga",
+        "steps": [
+            {"id": "build", "action_id": "m.b", "agent": "did:b", "retries": 1},
+            {"id": "push", "action_id": "m.p", "agent": "did:p",
+             "undo_api": "/unpush"},
+            {"id": "announce", "action_id": "m.a", "agent": "did:a"},
+        ],
+    })
+    g = st2.create_saga_from_dsl(definition, sslot)
+    sched = SagaScheduler(st2, retry_backoff_seconds=0.0)
+    flaky = {"n": 0}
+
+    async def build():
+        flaky["n"] += 1
+        if flaky["n"] == 1:
+            raise RuntimeError("transient build flake")
+        return "built"
+
+    async def ok():
+        return "ok"
+
+    async def run_saga():
+        sched.register_definition(
+            g, definition,
+            executors={"build": build, "push": ok, "announce": ok},
+            undos={"push": ok},
+        )
+        await sched.run_until_settled()
+
+    await run_saga()
+    state_name = int(np.asarray(st2.sagas.saga_state)[g])
+    print(f"saga table: 3 DSL steps, 1 retry absorbed, final state code "
+          f"{state_name} (2 = COMPLETED)")
+
+    # Write wave: rate limit + vector-clock causal gate before the VFS.
+    wave = WriteWave(SessionVFS("demo:wr"))
+    wave.submit("did:w1", "/plan.md", "v1", ring=2)
+    wave.submit("did:w2", "/plan.md", "v2-blind", ring=2)  # causally stale
+    wave.submit("did:w1", "/notes.md", "n1", ring=2)
+    report = wave.flush(now=0.0)
+    print(f"write wave: {report.applied} applied, {report.conflicts} causal "
+          f"conflict(s) rejected (stale writer), {report.rate_limited} rate-limited")
+
+
 async def main() -> None:
     hv = Hypervisor()
     await demo_lifecycle(hv)
@@ -225,6 +320,7 @@ async def main() -> None:
     await demo_audit(hv)
     await demo_adapters()
     demo_batched_pipeline()
+    await demo_device_plane()
     print("\nAll demos complete.")
 
 

@@ -410,3 +410,119 @@ class TestDslToDevice:
         sched = SagaScheduler(st)
         with pytest.raises(KeyError, match="only"):
             sched.register_definition(g, definition, executors={})
+
+
+class TestFullGovernanceCrossPlane:
+    def test_adapters_vouch_drift_terminate_planes_agree(self):
+        """The capstone scenario: IATP manifest -> Nexus sigma -> device
+        admission -> mirrored vouch -> CMVK drift -> dual-plane slash ->
+        device-root termination. At every stage the device tables must
+        agree with the host engines."""
+        from hypervisor_tpu.integrations import (
+            CMVKAdapter,
+            IATPAdapter,
+            NexusAdapter,
+        )
+        from hypervisor_tpu.observability import HypervisorEventBus
+        from hypervisor_tpu.tables.state import FLAG_BLACKLISTED
+
+        class Score:
+            total_score = 820
+            successful_tasks = 10
+            failed_tasks = 0
+
+        class Scorer:
+            slashes: list = []
+
+            def calculate_trust_score(self, **kw):
+                return Score()
+
+            def slash_reputation(self, **kw):
+                self.slashes.append((kw["agent_did"], kw["severity"]))
+
+            def record_task_outcome(self, agent_did, outcome):
+                pass
+
+        class Verdict:
+            drift_score = 0.8
+            explanation = None
+
+        class Verifier:
+            def verify_embeddings(self, **kw):
+                return Verdict()
+
+        bus = HypervisorEventBus()
+        hv = Hypervisor(
+            nexus=NexusAdapter(scorer=Scorer()),
+            cmvk=CMVKAdapter(verifier=Verifier()),
+            iatp=IATPAdapter(),
+            event_bus=bus,
+        )
+
+        async def flow():
+            managed = await hv.create_session(SessionConfig(), "did:admin")
+            sid = managed.sso.session_id
+            # Manifest-driven join: sigma hint from IATP (trust_score 8).
+            await hv.join_session(
+                sid,
+                "did:contractor",
+                manifest={
+                    "agent_id": "did:contractor",
+                    "trust_level": "trusted",
+                    "trust_score": 8,
+                    "actions": [
+                        {"action_id": "db.migrate", "reversibility": "partial",
+                         "undo_api": "/undo"},
+                    ],
+                },
+            )
+            await hv.join_session(sid, "did:mentor", sigma_raw=0.9)
+            hv.vouching.vouch("did:mentor", "did:contractor", sid, 0.9)
+            await hv.activate_session(sid)
+            managed.delta_engine.capture(
+                "did:contractor",
+                [VFSChange(path="/migration.sql", operation="add")],
+            )
+            drift = await hv.verify_behavior(
+                sid, "did:contractor", [1, 0], [0, 1]
+            )
+            # Device rows are GC'd at terminate: capture the post-slash
+            # device view first.
+            contractor = hv.state.agent_row("did:contractor")
+            mentor = hv.state.agent_row("did:mentor")
+            root = await hv.terminate_session(sid)
+            return managed, sid, drift, root, contractor, mentor
+
+        managed, sid, drift, root, contractor, mentor = _run(flow())
+        st = hv.state
+
+        # Admission happened on device: both agents were resident.
+        assert contractor is not None and mentor is not None
+
+        # Drift slash hit both planes: device blacklist + host history.
+        assert drift.should_slash
+        assert contractor["sigma_eff"] == 0.0
+        assert (
+            int(np.asarray(st.agents.flags)[contractor["slot"]])
+            & FLAG_BLACKLISTED
+        )
+        assert hv.slashing.history[-1].vouchee_did == "did:contractor"
+        # Mentor clipped on device exactly as the host formula dictates.
+        assert mentor["sigma_eff"] == pytest.approx(
+            max(0.9 * (1 - 0.95), 0.05), abs=1e-6
+        )
+        assert ("did:contractor", "critical") in type(hv.nexus._scorer).slashes
+
+        # Termination: device root committed + verified; host chain agrees.
+        assert root == managed.delta_engine.compute_merkle_root()
+        assert hv.commitment.verify(sid, root)
+        assert (
+            int(np.asarray(st.sessions.state)[managed.slot])
+            == SessionState.ARCHIVED.code
+        )
+        # Device edges all released; GC recorded the purge.
+        assert not np.asarray(st.vouches.active)[: st._next_edge_slot].any()
+        assert hv.gc.is_purged(sid)
+        # The event bus mirror lands the trail in the device EventLog.
+        assert hv.sync_events_to_device() >= 0
+        assert int(np.asarray(st.event_log.cursor)) >= bus.event_count

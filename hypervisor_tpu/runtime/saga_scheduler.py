@@ -70,6 +70,57 @@ class SagaScheduler:
                 raise KeyError(f"no executor for DSL step '{step.id}'")
             self.register(saga_slot, idx, execute, undo=undos.get(step.id))
 
+    def reassign(
+        self,
+        saga_slot: int,
+        step_idx: int,
+        execute: Executor,
+        undo: Optional[Executor] = None,
+    ) -> None:
+        """Hand a step to a substitute executor (kill-switch handoff).
+
+        The retry/attempt bookkeeping resets so the substitute gets a
+        fresh backoff ladder, matching the reference's handoff-then-
+        continue semantics (`security/kill_switch.py:95-158`).
+        """
+        key = (saga_slot, step_idx)
+        self._execute[key] = execute
+        if undo is not None:
+            self._undo[key] = undo
+        self._attempts.pop(key, None)
+        self.errors.pop(key, None)
+
+    def apply_handoffs(
+        self,
+        kill_result,
+        step_index: dict[str, tuple[int, int]],
+        substitute_executors: dict[str, Executor],
+        substitute_undos: Optional[dict[str, Executor]] = None,
+    ) -> int:
+        """Rewire a KillSwitch result onto the device saga table.
+
+        kill_result: `security.kill_switch.KillResult` — each HANDED_OFF
+        step moves to its substitute's executor; COMPENSATED steps keep
+        their (dead) executor and fail into the compensation path.
+        step_index maps the kill switch's step_id strings to
+        (saga_slot, step_idx); substitute_executors/undos are keyed by
+        substitute DID. Returns how many steps were rewired.
+        """
+        undos = substitute_undos or {}
+        rewired = 0
+        for handoff in kill_result.handoffs:
+            if handoff.to_agent is None:
+                continue
+            slot_idx = step_index.get(handoff.step_id)
+            execute = substitute_executors.get(handoff.to_agent)
+            if slot_idx is None or execute is None:
+                continue
+            self.reassign(
+                *slot_idx, execute, undo=undos.get(handoff.to_agent)
+            )
+            rewired += 1
+        return rewired
+
     async def run_until_settled(self, max_rounds: int = 1000) -> None:
         """Round-run the table until every saga reaches a terminal state."""
         state = self._state

@@ -526,3 +526,92 @@ class TestFullGovernanceCrossPlane:
         # The event bus mirror lands the trail in the device EventLog.
         assert hv.sync_events_to_device() >= 0
         assert int(np.asarray(st.event_log.cursor)) >= bus.event_count
+
+
+class TestKillSwitchHandoff:
+    def test_killed_agents_steps_hand_off_and_saga_completes(self):
+        """Elastic recovery on the device plane: a victim's in-flight
+        steps hand off to a substitute through the kill switch, the
+        scheduler rewires the executors, and the saga COMPLETES."""
+        from hypervisor_tpu.security import KillReason, KillSwitch
+
+        st = HypervisorState()
+        slot = st.create_session("s:kill", SessionConfig())
+        g = st.create_saga(
+            "saga:kill", slot, [{"retries": 0}, {"retries": 0}, {}]
+        )
+        sched = SagaScheduler(st, retry_backoff_seconds=0.0)
+        log = []
+
+        async def victim_exec():
+            raise RuntimeError("victim agent is dead")
+
+        async def healthy():
+            log.append("step0")
+            return "ok"
+
+        def sub_factory(name):
+            async def run():
+                log.append(name)
+                return f"done by {name}"
+            return run
+
+        sched.register(g, 0, healthy)
+        sched.register(g, 1, victim_exec)   # owned by the victim
+        sched.register(g, 2, victim_exec)   # owned by the victim
+
+        ks = KillSwitch()
+        ks.register_substitute("s:kill", "did:sub")
+        result = ks.kill(
+            "did:victim",
+            "s:kill",
+            KillReason.BEHAVIORAL_DRIFT,
+            in_flight_steps=[
+                {"step_id": "step1", "saga_id": "saga:kill"},
+                {"step_id": "step2", "saga_id": "saga:kill"},
+            ],
+        )
+        assert result.handoff_success_count == 2
+
+        rewired = sched.apply_handoffs(
+            result,
+            step_index={"step1": (g, 1), "step2": (g, 2)},
+            substitute_executors={"did:sub": sub_factory("substitute")},
+        )
+        assert rewired == 2
+        asyncio.run(sched.run_until_settled())
+        assert (
+            int(np.asarray(st.sagas.saga_state)[g]) == saga_ops.SAGA_COMPLETED
+        )
+        assert log == ["step0", "substitute", "substitute"]
+
+    def test_no_substitute_routes_to_compensation(self):
+        from hypervisor_tpu.security import KillReason, KillSwitch
+
+        st = HypervisorState()
+        slot = st.create_session("s:nokill", SessionConfig())
+        g = st.create_saga("saga:nk", slot, [{"has_undo": True}, {}])
+        sched = SagaScheduler(st, retry_backoff_seconds=0.0)
+
+        async def ok():
+            return "ok"
+
+        async def dead():
+            raise RuntimeError("victim gone")
+
+        sched.register(g, 0, ok, undo=ok)
+        sched.register(g, 1, dead)
+
+        ks = KillSwitch()  # empty substitute pool
+        result = ks.kill(
+            "did:victim", "s:nokill", KillReason.MANUAL,
+            in_flight_steps=[{"step_id": "s1", "saga_id": "saga:nk"}],
+        )
+        assert result.compensation_triggered
+        # No substitute: the dead executor stays; the saga fails forward
+        # into compensation and settles cleanly (step 0 undone).
+        sched.apply_handoffs(result, {"s1": (g, 1)}, {})
+        asyncio.run(sched.run_until_settled())
+        states = np.asarray(st.sagas.step_state)[g]
+        assert states[0] == saga_ops.STEP_COMPENSATED
+        assert states[1] == saga_ops.STEP_FAILED

@@ -76,47 +76,85 @@ class SagaScheduler:
         step_idx: int,
         execute: Executor,
         undo: Optional[Executor] = None,
+        retries: Optional[int] = None,
     ) -> None:
         """Hand a step to a substitute executor (kill-switch handoff).
 
-        The retry/attempt bookkeeping resets so the substitute gets a
-        fresh backoff ladder, matching the reference's handoff-then-
-        continue semantics (`security/kill_switch.py:95-158`).
+        The substitute takes FULL ownership: the victim's undo is dropped
+        when no substitute undo is given (compensation then fails
+        honestly as unownable instead of calling a dead agent), the
+        host backoff bookkeeping resets, the device retry budget resets
+        to `retries` when given, and a step the victim already drove to
+        FAILED is rearmed to PENDING while its saga still runs — the
+        handoff-then-continue semantics of `security/kill_switch.py`.
         """
+        import jax.numpy as jnp
+
+        from hypervisor_tpu.ops import saga_ops
+        from hypervisor_tpu.tables.struct import replace
+
         key = (saga_slot, step_idx)
-        self._execute[key] = execute
-        if undo is not None:
-            self._undo[key] = undo
+        self.register(saga_slot, step_idx, execute, undo=undo)
+        if undo is None:
+            self._undo.pop(key, None)
         self._attempts.pop(key, None)
         self.errors.pop(key, None)
+
+        state = self._state
+        sagas = state.sagas
+        if retries is not None:
+            sagas = replace(
+                sagas,
+                retries_left=sagas.retries_left.at[saga_slot, step_idx].set(
+                    retries
+                ),
+            )
+        step_val = int(np.asarray(sagas.step_state)[saga_slot, step_idx])
+        saga_val = int(np.asarray(sagas.saga_state)[saga_slot])
+        if (
+            step_val == saga_ops.STEP_FAILED
+            and saga_val == saga_ops.SAGA_RUNNING
+        ):
+            sagas = replace(
+                sagas,
+                step_state=sagas.step_state.at[saga_slot, step_idx].set(
+                    jnp.int8(saga_ops.STEP_PENDING)
+                ),
+            )
+        state.sagas = sagas
 
     def apply_handoffs(
         self,
         kill_result,
-        step_index: dict[str, tuple[int, int]],
+        step_index: dict[tuple[str, str], tuple[int, int]],
         substitute_executors: dict[str, Executor],
         substitute_undos: Optional[dict[str, Executor]] = None,
+        retries: Optional[int] = None,
     ) -> int:
         """Rewire a KillSwitch result onto the device saga table.
 
         kill_result: `security.kill_switch.KillResult` — each HANDED_OFF
         step moves to its substitute's executor; COMPENSATED steps keep
         their (dead) executor and fail into the compensation path.
-        step_index maps the kill switch's step_id strings to
-        (saga_slot, step_idx); substitute_executors/undos are keyed by
-        substitute DID. Returns how many steps were rewired.
+        step_index maps (saga_id, step_id) PAIRS to (saga_slot,
+        step_idx) — step ids alone recur across sagas;
+        substitute_executors/undos are keyed by substitute DID. Returns
+        how many steps were actually rewired.
         """
         undos = substitute_undos or {}
         rewired = 0
         for handoff in kill_result.handoffs:
             if handoff.to_agent is None:
                 continue
-            slot_idx = step_index.get(handoff.step_id)
+            slot_idx = step_index.get((handoff.saga_id, handoff.step_id))
             execute = substitute_executors.get(handoff.to_agent)
             if slot_idx is None or execute is None:
                 continue
             self.reassign(
-                *slot_idx, execute, undo=undos.get(handoff.to_agent)
+                *slot_idx,
+                execute,
+                undo=undos.get(handoff.to_agent),
+                retries=retries,
             )
             rewired += 1
         return rewired

@@ -575,7 +575,10 @@ class TestKillSwitchHandoff:
 
         rewired = sched.apply_handoffs(
             result,
-            step_index={"step1": (g, 1), "step2": (g, 2)},
+            step_index={
+                ("saga:kill", "step1"): (g, 1),
+                ("saga:kill", "step2"): (g, 2),
+            },
             substitute_executors={"did:sub": sub_factory("substitute")},
         )
         assert rewired == 2
@@ -610,8 +613,54 @@ class TestKillSwitchHandoff:
         assert result.compensation_triggered
         # No substitute: the dead executor stays; the saga fails forward
         # into compensation and settles cleanly (step 0 undone).
-        sched.apply_handoffs(result, {"s1": (g, 1)}, {})
+        sched.apply_handoffs(result, {("saga:nk", "s1"): (g, 1)}, {})
         asyncio.run(sched.run_until_settled())
         states = np.asarray(st.sagas.step_state)[g]
         assert states[0] == saga_ops.STEP_COMPENSATED
         assert states[1] == saga_ops.STEP_FAILED
+
+    def test_handoff_restores_retry_budget_and_rearm(self):
+        """A substitute inherits a FRESH retry ladder, and a step the
+        victim already drove to FAILED is rearmed while the saga runs."""
+        from hypervisor_tpu.security import KillReason, KillSwitch
+
+        st = HypervisorState()
+        slot = st.create_session("s:rearm", SessionConfig())
+        g = st.create_saga("saga:rearm", slot, [{"retries": 1}])
+        sched = SagaScheduler(st, retry_backoff_seconds=0.0)
+
+        async def dead():
+            raise RuntimeError("victim gone")
+
+        sched.register(g, 0, dead)
+        # Victim burns the retry budget (but saga not yet settled: the
+        # second round would fail it, so only run one round).
+        st.saga_round({g: False})
+        assert int(np.asarray(st.sagas.retries_left)[g, 0]) == 0
+
+        ks = KillSwitch()
+        ks.register_substitute("s:rearm", "did:sub")
+        result = ks.kill(
+            "did:victim", "s:rearm", KillReason.MANUAL,
+            in_flight_steps=[{"step_id": "s0", "saga_id": "saga:rearm"}],
+        )
+        calls = {"n": 0}
+
+        async def flaky_sub():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("substitute warm-up flake")
+            return "ok"
+
+        sched.apply_handoffs(
+            result,
+            {("saga:rearm", "s0"): (g, 0)},
+            {"did:sub": flaky_sub},
+            retries=1,
+        )
+        assert int(np.asarray(st.sagas.retries_left)[g, 0]) == 1
+        asyncio.run(sched.run_until_settled())
+        assert calls["n"] == 2  # substitute retried on its fresh budget
+        assert (
+            int(np.asarray(st.sagas.saga_state)[g]) == saga_ops.SAGA_COMPLETED
+        )

@@ -128,36 +128,30 @@ def admit_batch(
     status = claim(status, over_capacity, ADMIT_CAPACITY)
     ok = status == ADMIT_OK
 
-    write_slot = jnp.where(ok, slot, agents.did.shape[0] - 1)  # park rejects
+    # Rejected elements scatter out-of-bounds and are dropped by XLA —
+    # no masked read-back of the old column values, and `slot` rows are
+    # preallocated-unique so the scatter takes the fast unique path.
+    write_slot = jnp.where(ok, slot, agents.did.shape[0])
     now_f = jnp.asarray(now, jnp.float32)
+    drop = dict(mode="drop", unique_indices=True)
 
     new_agents = replace(
         agents,
-        did=agents.did.at[write_slot].set(jnp.where(ok, did, agents.did[write_slot])),
-        session=agents.session.at[write_slot].set(
-            jnp.where(ok, session_slot, agents.session[write_slot])
-        ),
-        sigma_raw=agents.sigma_raw.at[write_slot].set(
-            jnp.where(ok, sigma_raw, agents.sigma_raw[write_slot])
-        ),
-        sigma_eff=agents.sigma_eff.at[write_slot].set(
-            jnp.where(ok, sigma_eff, agents.sigma_eff[write_slot])
-        ),
-        ring=agents.ring.at[write_slot].set(
-            jnp.where(ok, ring, agents.ring[write_slot])
-        ),
+        did=agents.did.at[write_slot].set(did, **drop),
+        session=agents.session.at[write_slot].set(session_slot, **drop),
+        sigma_raw=agents.sigma_raw.at[write_slot].set(sigma_raw, **drop),
+        sigma_eff=agents.sigma_eff.at[write_slot].set(sigma_eff, **drop),
+        ring=agents.ring.at[write_slot].set(ring, **drop),
         flags=agents.flags.at[write_slot].set(
-            jnp.where(ok, FLAG_ACTIVE, agents.flags[write_slot])
+            jnp.asarray(FLAG_ACTIVE, agents.flags.dtype), **drop
         ),
-        joined_at=agents.joined_at.at[write_slot].set(
-            jnp.where(ok, now_f, agents.joined_at[write_slot])
-        ),
+        joined_at=agents.joined_at.at[write_slot].set(now_f, **drop),
     )
     new_sessions = replace(
         sessions,
         n_participants=sessions.n_participants.at[
-            jnp.where(ok, session_slot, sessions.sid.shape[0] - 1)
-        ].add(jnp.where(ok, 1, 0)),
+            jnp.where(ok, session_slot, sessions.sid.shape[0])
+        ].add(1, mode="drop"),
     )
     return AdmissionResult(
         agents=new_agents,

@@ -129,9 +129,14 @@ def admit_batch(
     ok = status == ADMIT_OK
 
     # Rejected elements scatter out-of-bounds and are dropped by XLA —
-    # no masked read-back of the old column values, and `slot` rows are
-    # preallocated-unique so the scatter takes the fast unique path.
-    write_slot = jnp.where(ok, slot, agents.did.shape[0])
+    # no masked read-back of the old column values. Accepted `slot` rows
+    # are preallocated-unique, and each reject gets its own distinct OOB
+    # index, so the unique-indices fast path's contract holds for the
+    # whole wave.
+    b = slot.shape[0]
+    write_slot = jnp.where(
+        ok, slot, agents.did.shape[0] + jnp.arange(b, dtype=slot.dtype)
+    )
     now_f = jnp.asarray(now, jnp.float32)
     drop = dict(mode="drop", unique_indices=True)
 
@@ -150,7 +155,11 @@ def admit_batch(
     new_sessions = replace(
         sessions,
         n_participants=sessions.n_participants.at[
-            jnp.where(ok, session_slot, sessions.sid.shape[0])
+            jnp.where(
+                ok,
+                session_slot,
+                sessions.sid.shape[0] + jnp.arange(b, dtype=session_slot.dtype),
+            )
         ].add(1, mode="drop"),
     )
     return AdmissionResult(

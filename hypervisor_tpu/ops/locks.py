@@ -1,0 +1,141 @@
+"""Batched intent-lock ops: conflict gate, wait-for closure, deadlock sweep.
+
+The reference checks one lock request at a time — a Python scan of the
+resource's holders plus a DFS over the wait-for graph
+(`session/intent_locks.py:151-197`). Here a whole wave of requests is
+vetted in one program:
+
+  * conflicts — a dense [B, L] compare of the wave against the held-lock
+    table through the 3x3 compatibility matrix (only READ+READ coexist),
+  * deadlock — the wait-for graph's transitive closure by log2(N)
+    boolean matrix squarings (each one a masked matmul, so the sweep
+    rides the MXU instead of a pointer-chasing DFS),
+  * victim selection — agents on a closure cycle ranked so the kill
+    switch can break the deadlock by terminating the lowest-trust member.
+
+All inputs are fixed-capacity arrays with active masks; hosts intern
+agent DIDs / resource paths to rows (`tables.intern.InternTable`).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from hypervisor_tpu.session.intent_locks import COMPAT_MATRIX
+
+# compat[held, requested] — True only for READ+READ. The table is shared
+# with the host manager (`session/intent_locks.py`) so the wave driver
+# and the single-call API can never disagree about lock compatibility.
+INTENT_READ, INTENT_WRITE, INTENT_EXCLUSIVE = 0, 1, 2
+COMPAT = np.asarray(COMPAT_MATRIX)
+
+
+class ConflictResult(NamedTuple):
+    blocked: jnp.ndarray         # bool[B] request conflicts with ≥1 held lock
+    blockers: jnp.ndarray        # bool[B, A] which agents block each request
+    n_conflicts: jnp.ndarray     # i32[B]
+
+
+def conflict_gate(
+    held_path: jnp.ndarray,      # i32[L] resource row of each held lock
+    held_agent: jnp.ndarray,     # i32[L] holder agent row
+    held_intent: jnp.ndarray,    # i8[L]
+    held_active: jnp.ndarray,    # bool[L]
+    req_path: jnp.ndarray,       # i32[B]
+    req_agent: jnp.ndarray,      # i32[B]
+    req_intent: jnp.ndarray,     # i8[B]
+    n_agents: int,
+) -> ConflictResult:
+    """Vet B lock requests against L held locks in one dense pass."""
+    same_path = req_path[:, None] == held_path[None, :]          # [B, L]
+    other_agent = req_agent[:, None] != held_agent[None, :]
+    incompatible = ~jnp.asarray(COMPAT)[
+        held_intent.astype(jnp.int32)[None, :],
+        req_intent.astype(jnp.int32)[:, None],
+    ]
+    hit = same_path & other_agent & incompatible & held_active[None, :]
+
+    # Project the [B, L] hit matrix onto agent rows: blockers[b, a] iff
+    # some lock held by agent a blocks request b.
+    holder_onehot = (
+        held_agent[:, None] == jnp.arange(n_agents, dtype=held_agent.dtype)[None, :]
+    )                                                            # [L, A]
+    blockers = (hit.astype(jnp.float32) @ holder_onehot.astype(jnp.float32)) > 0
+
+    return ConflictResult(
+        blocked=hit.any(axis=1),
+        blockers=blockers,
+        n_conflicts=hit.sum(axis=1).astype(jnp.int32),
+    )
+
+
+def transitive_closure(wait_for: jnp.ndarray) -> jnp.ndarray:
+    """bool[N, N] -> bool[N, N]: reachability over ≥1 wait-for edges.
+
+    log2(N) squarings; each squaring is one [N, N] boolean matmul, the
+    MXU-native form of the reference's DFS (`intent_locks.py:180-197`).
+    """
+    n = wait_for.shape[0]
+    reach = wait_for.astype(jnp.float32)
+    for _ in range(max(1, int(np.ceil(np.log2(max(n, 2)))))):
+        reach = jnp.minimum(reach + reach @ reach, 1.0)
+    return reach > 0
+
+
+class DeadlockSweep(NamedTuple):
+    on_cycle: jnp.ndarray        # bool[N] agent participates in a wait cycle
+    would_deadlock: jnp.ndarray  # bool[B] granting request closes a cycle
+    victim: jnp.ndarray          # i32 lowest-sigma agent on a cycle (-1: none)
+
+
+def deadlock_sweep(
+    wait_for: jnp.ndarray,       # bool[N, N] edge a-waits-on-b
+    req_agent: jnp.ndarray,      # i32[B] requesting agent rows
+    req_blockers: jnp.ndarray,   # bool[B, N] blockers per request (conflict_gate)
+    sigma: jnp.ndarray,          # f32[N] trust, for victim ranking
+) -> DeadlockSweep:
+    """Cycle detection for the standing graph plus a request wave.
+
+    `would_deadlock[b]` mirrors the reference's precheck: the request
+    deadlocks iff some blocker already (transitively) waits on the
+    requester — or IS the requester (`intent_locks.py:180-197`).
+    """
+    n = wait_for.shape[0]
+    reach = transitive_closure(wait_for)
+    on_cycle = jnp.diagonal(reach)
+
+    # [B, N]: does agent a transitively reach requester b over wait edges?
+    reaches_requester = reach[:, req_agent.astype(jnp.int32)].T
+    self_block = (
+        jnp.arange(n, dtype=jnp.int32)[None, :] == req_agent[:, None]
+    )
+    would = (req_blockers & (reaches_requester | self_block)).any(axis=1)
+
+    sigma_masked = jnp.where(on_cycle, sigma, jnp.inf)
+    victim = jnp.where(
+        on_cycle.any(), jnp.argmin(sigma_masked).astype(jnp.int32), jnp.int32(-1)
+    )
+    return DeadlockSweep(on_cycle=on_cycle, would_deadlock=would, victim=victim)
+
+
+def contention_counts(
+    held_path: jnp.ndarray,      # i32[L]
+    held_agent: jnp.ndarray,     # i32[L]
+    held_active: jnp.ndarray,    # bool[L]
+    n_paths: int,
+    n_agents: int,
+) -> jnp.ndarray:
+    """i32[P]: distinct agents holding locks per resource.
+
+    Resources with counts > 1 are the reference's `contention_points`
+    (`intent_locks.py:203-215`).
+    """
+    path_rows = jnp.where(held_active, held_path, n_paths)
+    holder = jnp.zeros((n_paths + 1, n_agents), bool)
+    holder = holder.at[path_rows, jnp.clip(held_agent, 0, n_agents - 1)].set(
+        True, mode="drop"
+    )
+    return holder[:n_paths].sum(axis=1).astype(jnp.int32)

@@ -1,0 +1,228 @@
+"""Batched intent-lock ops + LockWave vs the sequential manager.
+
+The dense conflict gate, the matmul transitive-closure deadlock sweep,
+and the wave driver must reproduce the per-call semantics of
+`session.intent_locks.IntentLockManager` (reference
+`session/intent_locks.py:151-197`).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from hypervisor_tpu.ops import locks as lock_ops
+from hypervisor_tpu.runtime.lock_wave import (
+    LOCK_CONTENTION,
+    LOCK_DEADLOCK,
+    LOCK_GRANTED,
+    LockWave,
+)
+from hypervisor_tpu.session.intent_locks import (
+    IntentLockManager,
+    LockIntent,
+)
+
+S = "session:lk"
+
+
+class TestConflictGate:
+    def test_read_read_coexists_write_conflicts(self):
+        # held: agent 0 READ on path 0, agent 1 WRITE on path 1
+        res = lock_ops.conflict_gate(
+            held_path=jnp.array([0, 1], jnp.int32),
+            held_agent=jnp.array([0, 1], jnp.int32),
+            held_intent=jnp.array([0, 1], jnp.int8),
+            held_active=jnp.array([True, True]),
+            req_path=jnp.array([0, 0, 1], jnp.int32),
+            req_agent=jnp.array([2, 2, 2], jnp.int32),
+            req_intent=jnp.array([0, 1, 0], jnp.int8),  # READ, WRITE, READ
+            n_agents=4,
+        )
+        blocked = np.asarray(res.blocked)
+        assert blocked.tolist() == [False, True, True]
+        # the WRITE against path 0 is blocked by agent 0 specifically
+        assert np.asarray(res.blockers)[1].tolist() == [True, False, False, False]
+
+    def test_own_locks_never_conflict(self):
+        res = lock_ops.conflict_gate(
+            held_path=jnp.array([0], jnp.int32),
+            held_agent=jnp.array([2], jnp.int32),
+            held_intent=jnp.array([2], jnp.int8),  # EXCLUSIVE
+            held_active=jnp.array([True]),
+            req_path=jnp.array([0], jnp.int32),
+            req_agent=jnp.array([2], jnp.int32),
+            req_intent=jnp.array([1], jnp.int8),
+            n_agents=4,
+        )
+        assert not bool(np.asarray(res.blocked)[0])
+
+    def test_inactive_locks_ignored(self):
+        res = lock_ops.conflict_gate(
+            held_path=jnp.array([0], jnp.int32),
+            held_agent=jnp.array([0], jnp.int32),
+            held_intent=jnp.array([2], jnp.int8),
+            held_active=jnp.array([False]),
+            req_path=jnp.array([0], jnp.int32),
+            req_agent=jnp.array([1], jnp.int32),
+            req_intent=jnp.array([2], jnp.int8),
+            n_agents=2,
+        )
+        assert not bool(np.asarray(res.blocked)[0])
+
+
+class TestDeadlockSweep:
+    def _closure_members(self, edges, n=4):
+        wait = np.zeros((n, n), bool)
+        for a, b in edges:
+            wait[a, b] = True
+        sweep = lock_ops.deadlock_sweep(
+            jnp.asarray(wait),
+            jnp.zeros((1,), jnp.int32),
+            jnp.zeros((1, n), bool),
+            jnp.asarray(np.linspace(0.9, 0.3, n).astype(np.float32)),
+        )
+        return np.asarray(sweep.on_cycle), int(np.asarray(sweep.victim))
+
+    def test_two_cycle_detected(self):
+        on, victim = self._closure_members([(0, 1), (1, 0)])
+        assert on.tolist() == [True, True, False, False]
+        assert victim == 1  # lower sigma of the two members
+
+    def test_long_cycle_detected(self):
+        on, _ = self._closure_members([(0, 1), (1, 2), (2, 3), (3, 0)])
+        assert on.all()
+
+    def test_chain_without_cycle_clean(self):
+        on, victim = self._closure_members([(0, 1), (1, 2)])
+        assert not on.any() and victim == -1
+
+    def test_request_closing_cycle_flagged(self):
+        # 1 already waits on 0; a request by 0 blocked by 1 would deadlock.
+        wait = np.zeros((3, 3), bool)
+        wait[1, 0] = True
+        blockers = np.zeros((2, 3), bool)
+        blockers[0, 1] = True   # request 0 (agent 0) blocked by agent 1
+        blockers[1, 2] = True   # request 1 (agent 0) blocked by agent 2
+        sweep = lock_ops.deadlock_sweep(
+            jnp.asarray(wait),
+            jnp.array([0, 0], jnp.int32),
+            jnp.asarray(blockers),
+            jnp.full((3,), 0.5, jnp.float32),
+        )
+        assert np.asarray(sweep.would_deadlock).tolist() == [True, False]
+
+
+class TestContentionCounts:
+    def test_distinct_holders_per_path(self):
+        counts = lock_ops.contention_counts(
+            held_path=jnp.array([0, 0, 0, 1], jnp.int32),
+            held_agent=jnp.array([0, 1, 0, 2], jnp.int32),  # path0: 2 distinct
+            held_active=jnp.array([True, True, True, True]),
+            n_paths=3,
+            n_agents=4,
+        )
+        assert np.asarray(counts).tolist() == [2, 1, 0]
+
+
+class TestLockWave:
+    def test_wave_matches_sequential_manager(self):
+        requests = [
+            ("did:a", "/x", LockIntent.READ),
+            ("did:b", "/x", LockIntent.READ),     # READ+READ coexists
+            ("did:c", "/x", LockIntent.WRITE),    # contends
+            ("did:a", "/y", LockIntent.EXCLUSIVE),
+            ("did:b", "/y", LockIntent.READ),     # contends
+        ]
+        seq = IntentLockManager()
+        seq_out = []
+        for did, path, intent in requests:
+            try:
+                seq.acquire(did, S, path, intent)
+                seq_out.append(LOCK_GRANTED)
+            except Exception:
+                seq_out.append(LOCK_CONTENTION)
+
+        wave = LockWave()
+        for did, path, intent in requests:
+            wave.submit(did, S, path, intent)
+        report = wave.flush()
+        assert report.status.tolist() == seq_out
+        assert report.blockers[2] == {"did:a", "did:b"}
+        assert wave.manager.active_lock_count == seq.active_lock_count
+
+    def test_wave_deadlock_refusal(self):
+        wave = LockWave()
+        wave.manager.declare_wait("did:b", {"did:a"})
+        # did:a holds /r via did:b's blocker; a request by did:a blocked
+        # by did:b would close the cycle.
+        wave.manager.acquire("did:b", S, "/r", LockIntent.EXCLUSIVE)
+        wave.submit("did:a", S, "/r", LockIntent.WRITE)
+        report = wave.flush()
+        assert report.status.tolist() == [LOCK_DEADLOCK]
+
+    def test_cross_path_deadlock_inside_one_batch(self):
+        # Y holds /p1, X holds /p2; one wave stages X->/p1 and Y->/p2.
+        # Sequentially the first is CONTENTION (X waits on Y) and the
+        # second closes the cycle -> DEADLOCK. The wave must match.
+        wave = LockWave()
+        wave.manager.acquire("did:y", S, "/p1", LockIntent.EXCLUSIVE)
+        wave.manager.acquire("did:x", S, "/p2", LockIntent.EXCLUSIVE)
+        wave.submit("did:x", S, "/p1", LockIntent.WRITE)
+        wave.submit("did:y", S, "/p2", LockIntent.WRITE)
+        report = wave.flush()
+        assert report.status.tolist() == [LOCK_CONTENTION, LOCK_DEADLOCK]
+        # No standing cycle was silently recorded.
+        assert wave.deadlock_report().on_cycle == []
+
+    def test_deadlock_report_names_lowest_sigma_victim(self):
+        wave = LockWave()
+        wave.observe_sigma("did:hi", 0.9)
+        wave.observe_sigma("did:lo", 0.4)
+        wave.manager.declare_wait("did:hi", {"did:lo"})
+        wave.manager.declare_wait("did:lo", {"did:hi"})
+        report = wave.deadlock_report()
+        assert set(report.on_cycle) == {"did:hi", "did:lo"}
+        assert report.victim == "did:lo"
+
+    def test_contention_counts_roundtrip(self):
+        wave = LockWave()
+        wave.submit("did:a", S, "/shared", LockIntent.READ)
+        wave.submit("did:b", S, "/shared", LockIntent.READ)
+        wave.submit("did:c", S, "/solo", LockIntent.WRITE)
+        wave.flush()
+        counts = wave.contention_counts()
+        assert counts["/shared"] == 2 and counts["/solo"] == 1
+        assert wave.manager.contention_points == ["/shared"]
+
+    def test_empty_flush(self):
+        report = LockWave().flush()
+        assert len(report.status) == 0
+
+    def test_capacity_guard(self):
+        wave = LockWave(max_agents=1)
+        wave.submit("did:a", S, "/x", LockIntent.READ)
+        wave.submit("did:b", S, "/x", LockIntent.READ)
+        with pytest.raises(RuntimeError, match="agent capacity"):
+            wave.flush()
+
+
+class TestKillSwitchBreaksDeadlock:
+    def test_victim_feeds_kill_switch(self):
+        from hypervisor_tpu.security.kill_switch import KillReason, KillSwitch
+
+        wave = LockWave()
+        wave.observe_sigma("did:loop1", 0.8)
+        wave.observe_sigma("did:loop2", 0.5)
+        wave.manager.declare_wait("did:loop1", {"did:loop2"})
+        wave.manager.declare_wait("did:loop2", {"did:loop1"})
+        victim = wave.deadlock_report().victim
+        assert victim == "did:loop2"
+
+        ks = KillSwitch()
+        record = ks.kill(victim, S, KillReason.MANUAL)
+        assert record.agent_did == "did:loop2"
+        # The victim's locks release, clearing its wait edges.
+        released = wave.manager.release_agent_locks(victim, S)
+        assert released == 0  # held no locks, only wait edges
+        wave.manager._wait_for.pop(victim, None)
+        assert wave.deadlock_report().victim is None

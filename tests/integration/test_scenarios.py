@@ -351,3 +351,133 @@ async def test_fully_wired_pipeline_emits_events():
     } <= types
     by_agent = bus.query_by_agent("did:worker")
     assert len(by_agent) >= 2
+
+
+# ── 8. sigma resolution + adapter edge behaviors (reference
+#      test_scenarios.py:765-819,936-1051 equivalents) ─────────────────
+
+
+async def test_nexus_auto_resolves_sigma_when_zero():
+    scorer = MockNexusScorer({"did:known": 900})  # 900/1000 -> sigma 0.9
+    hv = Hypervisor(nexus=NexusAdapter(scorer=scorer))
+    ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+    ring = await hv.join_session(
+        ms.sso.session_id, "did:known", sigma_raw=0.0, agent_history="did:known"
+    )
+    p = ms.sso.get_participant("did:known")
+    assert p.sigma_eff == pytest.approx(0.9)
+    assert ring == ExecutionRing.RING_2_STANDARD  # 0.9 w/o consensus -> Ring 2
+
+
+async def test_nexus_conservative_merge_takes_minimum():
+    # Agent claims 0.95 but Nexus only backs 600/1000 = 0.6: the join
+    # must trust the lower number.
+    scorer = MockNexusScorer({"did:boastful": 600})
+    hv = Hypervisor(nexus=NexusAdapter(scorer=scorer))
+    ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+    await hv.join_session(
+        ms.sso.session_id, "did:boastful", sigma_raw=0.95,
+        agent_history="did:boastful",
+    )
+    p = ms.sso.get_participant("did:boastful")
+    assert p.sigma_eff == pytest.approx(0.6)
+
+
+async def test_verify_behavior_none_without_cmvk():
+    hv = Hypervisor()
+    ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+    sid = ms.sso.session_id
+    await hv.join_session(sid, "did:a", sigma_raw=0.8)
+    await hv.activate_session(sid)
+    assert await hv.verify_behavior(sid, "did:a", "x", "y") is None
+    assert hv.slashing.history == []
+
+
+async def test_backward_compat_no_adapters_full_lifecycle():
+    """The facade works with zero adapters, exactly like the reference's
+    bare Hypervisor (`core.py:69-89` with all-None integrations)."""
+    hv = Hypervisor()
+    ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+    sid = ms.sso.session_id
+    ring = await hv.join_session(sid, "did:solo", sigma_raw=0.7)
+    assert ring == ExecutionRing.RING_2_STANDARD
+    await hv.activate_session(sid)
+    ms.delta_engine.capture("did:solo", [])
+    root = await hv.terminate_session(sid)
+    assert root and len(root) == 64
+    assert hv.get_session(sid) is not None
+    assert sid not in [m.sso.session_id for m in hv.active_sessions]
+
+
+async def test_nexus_cache_invalidated_by_slash_report():
+    scorer = MockNexusScorer({"did:x": 800})
+    adapter = NexusAdapter(scorer=scorer)
+    first = adapter.resolve_sigma("did:x", history="did:x")
+    assert first == pytest.approx(0.8)
+    assert adapter.get_cached_result("did:x") is not None
+    adapter.report_slash("did:x", reason="drift", severity="high")
+    # Cache dropped; next resolve sees the penalized score.
+    assert adapter.get_cached_result("did:x") is None
+    again = adapter.resolve_sigma("did:x", history="did:x")
+    assert again == pytest.approx((800 - 250) / 1000)
+
+
+async def test_critical_drift_slashes_and_reports_critical():
+    scorer = MockNexusScorer({"did:evil": 950})
+    hv = Hypervisor(
+        nexus=NexusAdapter(scorer=scorer),
+        cmvk=CMVKAdapter(verifier=MockCMVKVerifier({"claimed": 0.9})),
+    )
+    ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+    sid = ms.sso.session_id
+    await hv.join_session(sid, "did:evil", sigma_raw=0.9)
+    await hv.activate_session(sid)
+    result = await hv.verify_behavior(sid, "did:evil", "claimed", "observed")
+    assert result.severity.value == "critical" and result.should_slash
+    assert ("did:evil", "critical") in scorer.slashes
+    # Slashed to zero (blacklisted).
+    assert hv.slashing.history[-1].vouchee_sigma_after == 0.0
+
+
+async def test_repeated_medium_drift_tracks_rate_and_demotes():
+    """Medium drift demotes without slashing; repeated offenses are
+    visible in the adapter's history/rate for escalation decisions
+    (reference `test_scenarios.py:421-449`)."""
+    cmvk = CMVKAdapter(
+        verifier=MockCMVKVerifier({"c1": 0.35, "c2": 0.4, "c3": 0.42})
+    )
+    hv = Hypervisor(cmvk=cmvk)
+    ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+    sid = ms.sso.session_id
+    await hv.join_session(sid, "did:wobbly", sigma_raw=0.8)
+    await hv.activate_session(sid)
+    for key in ("c1", "c2", "c3"):
+        result = await hv.verify_behavior(sid, "did:wobbly", key, "obs")
+        assert result.should_demote and not result.should_slash
+    assert hv.slashing.history == []
+    assert cmvk.get_drift_rate("did:wobbly") == pytest.approx(1.0)
+    assert len(cmvk.get_agent_drift_history("did:wobbly")) == 3
+
+
+async def test_iatp_verified_partner_reaches_privileged_ring():
+    """A verified-partner manifest with a top IATP score hints sigma high
+    enough for Ring 1 eligibility checks (with consensus)."""
+    hv = Hypervisor(iatp=IATPAdapter())
+    ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+    await hv.join_session(
+        ms.sso.session_id, "did:partner",
+        manifest=manifest_dict("did:partner", trust="verified_partner", score=10),
+    )
+    p = ms.sso.get_participant("did:partner")
+    assert p.sigma_eff > 0.95
+    from hypervisor_tpu.models import ActionDescriptor, ReversibilityLevel
+
+    deploy = ActionDescriptor(
+        action_id="m.deploy", name="deploy", execute_api="/d",
+        reversibility=ReversibilityLevel.NONE,  # requires Ring 1
+    )
+    check = hv.ring_enforcer.check(
+        ExecutionRing.RING_1_PRIVILEGED, deploy,
+        sigma_eff=p.sigma_eff, has_consensus=True,
+    )
+    assert check.allowed

@@ -140,3 +140,58 @@ class TestMidSagaResume:
         st.release_vouch(edge)  # row on the free list at save time
         restored = restore_state(save_state(st, tmp_path / "fe"))
         assert restored.add_vouch(x, y, slot, bond=0.2) == edge  # recycled
+
+
+class TestOrbaxBackend:
+    def _roundtrip(self, tmp_path, steps=(1,)):
+        import pytest
+
+        pytest.importorskip("orbax.checkpoint")
+        from hypervisor_tpu.runtime.checkpoint import (
+            open_checkpoint_manager,
+            restore_state_orbax,
+            save_state_orbax,
+        )
+
+        st = _populated_state()
+        mgr = open_checkpoint_manager(tmp_path / "orbax", max_to_keep=2)
+        for s in steps:
+            save_state_orbax(st, mgr, step=s)
+        mgr.wait_until_finished()
+        back = restore_state_orbax(mgr)
+        mgr.close()
+        return st, back
+
+    def test_round_trip_latest_step(self, tmp_path):
+        st, back = self._roundtrip(tmp_path, steps=(1, 2))
+        np.testing.assert_array_equal(
+            np.asarray(back.agents.sigma_eff), np.asarray(st.agents.sigma_eff)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(back.delta_log.session), np.asarray(st.delta_log.session)
+        )
+        assert back.agent_ids.lookup("did:ck2") == st.agent_ids.lookup("did:ck2")
+        assert back._members == st._members
+
+    def test_restored_state_continues(self, tmp_path):
+        _, back = self._roundtrip(tmp_path)
+        slot = int(np.asarray(back.agents.session)[0])
+        back.enqueue_join(slot, "did:orbax-new", sigma_raw=0.8)
+        assert back.flush_joins()[0] == 0
+
+    def test_staged_work_refuses_checkpoint(self, tmp_path):
+        import pytest
+
+        pytest.importorskip("orbax.checkpoint")
+        from hypervisor_tpu.runtime.checkpoint import (
+            open_checkpoint_manager,
+            save_state_orbax,
+        )
+
+        st = _populated_state()
+        slot = int(np.asarray(st.agents.session)[0])
+        st.enqueue_join(slot, "did:staged", sigma_raw=0.9)
+        mgr = open_checkpoint_manager(tmp_path / "orbax2")
+        with pytest.raises(RuntimeError, match="staged"):
+            save_state_orbax(st, mgr, step=1)
+        mgr.close()

@@ -28,6 +28,7 @@ from typing import Any, Optional
 from hypervisor_tpu.audit import CommitmentEngine, DeltaEngine, EphemeralGC
 from hypervisor_tpu.audit.gc import RetentionPolicy
 from hypervisor_tpu.liability import SlashingEngine, VouchingEngine
+from hypervisor_tpu.liability.quarantine import QuarantineManager, QuarantineReason
 from hypervisor_tpu.models import (
     ActionDescriptor,
     ConsistencyMode,
@@ -129,6 +130,7 @@ class Hypervisor:
         self.verifier = TransactionHistoryVerifier()
         self.commitment = CommitmentEngine()
         self.gc = EphemeralGC(retention_policy)
+        self.quarantine = QuarantineManager()
 
         # Optional integration adapters.
         self.nexus = nexus
@@ -382,6 +384,27 @@ class Hypervisor:
                     risk_weight=DRIFT_SLASH_RISK_WEIGHT,
                     now=self.state.now(),
                 )
+                # Read-only isolation before termination (SURVEY §5
+                # recovery): the device row carries FLAG_QUARANTINED;
+                # `state.quarantined_mask()` is the predicate write
+                # waves consult to refuse the row while forensics run.
+                self.state.quarantine_rows(
+                    [rogue["slot"]], now=self.state.now()
+                )
+            self.quarantine.quarantine(
+                agent_did,
+                session_id,
+                QuarantineReason.BEHAVIORAL_DRIFT,
+                details=f"drift {result.drift_score:.3f}",
+                # One duration source for both planes: the device config.
+                duration_seconds=int(
+                    self.state.config.quarantine.default_duration_seconds
+                ),
+                forensic_data={
+                    "drift_score": result.drift_score,
+                    "severity": result.severity.value,
+                },
+            )
             self.slashing.slash(
                 vouchee_did=agent_did,
                 session_id=session_id,

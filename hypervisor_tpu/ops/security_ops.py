@@ -30,6 +30,7 @@ from hypervisor_tpu.tables.state import (
     AgentTable,
     ElevationTable,
     FLAG_BREAKER_TRIPPED,
+    FLAG_QUARANTINED,
 )
 from hypervisor_tpu.tables.struct import replace
 
@@ -144,3 +145,56 @@ def effective_rings(
         jnp.full(base_ring.shape, 3, jnp.int8).at[idx].min(granted)
     )
     return jnp.minimum(base_ring, best_grant).astype(jnp.int8)
+
+
+# ── quarantine: read-only isolation before termination ───────────────
+#
+# Device twin of `liability.quarantine.QuarantineManager` (reference
+# `liability/quarantine.py:96-103`): enter sets FLAG_QUARANTINED with a
+# release deadline; re-quarantining an already-held row escalates the
+# record WITHOUT moving its deadline (the reference merges details into
+# the existing record and keeps expires_at), so host and device release
+# at the same instant. The sweep auto-releases every lapsed row in one
+# pass (`tick()` semantics). Forensic details stay host-side on the
+# manager; the columns are what waves consult.
+
+
+def quarantine_enter(
+    agents: AgentTable,
+    enter: jnp.ndarray,            # bool[N] rows to (re-)quarantine
+    now: jnp.ndarray | float,
+    duration: jnp.ndarray | float,
+) -> AgentTable:
+    """Quarantine the masked rows until now+duration; escalation of an
+    already-held row keeps its existing deadline (reference parity)."""
+    now_f = jnp.asarray(now, jnp.float32)
+    deadline = now_f + jnp.asarray(duration, jnp.float32)
+    already = (agents.flags & FLAG_QUARANTINED) != 0
+    until = jnp.where(enter & ~already, deadline, agents.quarantine_until)
+    flags = jnp.where(enter, agents.flags | FLAG_QUARANTINED, agents.flags)
+    return replace(
+        agents,
+        flags=flags.astype(agents.flags.dtype),
+        quarantine_until=until.astype(jnp.float32),
+    )
+
+
+class QuarantineSweep(NamedTuple):
+    agents: AgentTable
+    released: jnp.ndarray          # bool[N] rows released this sweep
+    still_held: jnp.ndarray        # bool[N] rows still quarantined
+
+
+def quarantine_sweep(
+    agents: AgentTable, now: jnp.ndarray | float
+) -> QuarantineSweep:
+    """Auto-release every row whose deadline has passed (batched tick)."""
+    now_f = jnp.asarray(now, jnp.float32)
+    held = (agents.flags & FLAG_QUARANTINED) != 0
+    release = held & (agents.quarantine_until <= now_f)
+    flags = jnp.where(release, agents.flags & ~FLAG_QUARANTINED, agents.flags)
+    return QuarantineSweep(
+        agents=replace(agents, flags=flags.astype(agents.flags.dtype)),
+        released=release,
+        still_held=held & ~release,
+    )

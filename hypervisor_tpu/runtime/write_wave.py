@@ -23,6 +23,7 @@ This is the runtime caller for both device ops (VERDICT round-1 #8).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Callable, Optional
 
 import numpy as np
 import jax
@@ -37,6 +38,7 @@ from hypervisor_tpu.tables.intern import InternTable
 WRITE_OK = 0
 WRITE_RATE_LIMITED = 1
 WRITE_CONFLICT = 2
+WRITE_QUARANTINED = 3
 
 _PREPASS = jax.jit(clock_ops.batched_write_prepass)
 _CONSUME = jax.jit(rate_limit.consume, static_argnames=("config",))
@@ -58,6 +60,7 @@ class WriteReport:
     applied: int
     rate_limited: int
     conflicts: int
+    quarantined: int = 0
 
 
 class WriteWave:
@@ -70,9 +73,15 @@ class WriteWave:
         max_writers: int = 64,
         rate_config: RateLimitConfig = DEFAULT_CONFIG.rate_limit,
         strict: bool = True,
+        is_quarantined: Optional[Callable[[str], bool]] = None,
     ) -> None:
         self.vfs = vfs
         self.strict = strict
+        # Optional read-only-isolation predicate (did -> bool), e.g.
+        # lambda did: state.quarantined_mask()[state.agent_row(did)["slot"]].
+        # Quarantined writers are refused before any gate runs
+        # (reference `liability/quarantine.py` read-only semantics).
+        self.is_quarantined = is_quarantined
         self._rate_config = rate_config
         self._paths = InternTable()
         self._writers = InternTable()
@@ -113,6 +122,16 @@ class WriteWave:
         self._staged = []
         status = np.zeros(w, np.int8)
 
+        # ── gate 0: read-only isolation ────────────────────────────────
+        if self.is_quarantined is not None:
+            held = {
+                did: bool(self.is_quarantined(did))
+                for did in {s[0] for s in staged}
+            }
+            for i, (did, *_rest) in enumerate(staged):
+                if held[did]:
+                    status[i] = WRITE_QUARANTINED
+
         # ── gate 1: token buckets, one consume per writer occurrence ───
         for row, (_, _, _, ring) in zip(writer_rows, staged):
             if not self._rl_primed[row] or self._rl_ring[row] != ring:
@@ -128,7 +147,10 @@ class WriteWave:
         n_rows = self._rl_tokens.shape[0]
         writer_occ = _occurrence_order(writer_rows)
         for batch_no in range(int(writer_occ.max()) + 1):
-            sel = np.nonzero(writer_occ == batch_no)[0]
+            # Quarantined writers never reach the buckets (no token burn).
+            sel = np.nonzero((writer_occ == batch_no) & (status == WRITE_OK))[0]
+            if not len(sel):
+                continue
             cost = np.zeros(n_rows, np.float32)
             cost[writer_rows[sel]] = 1.0
             decision = _CONSUME(
@@ -186,6 +208,7 @@ class WriteWave:
             applied=applied,
             rate_limited=int((status == WRITE_RATE_LIMITED).sum()),
             conflicts=int((status == WRITE_CONFLICT).sum()),
+            quarantined=int((status == WRITE_QUARANTINED).sum()),
         )
 
     def observe(self, agent_did: str, path: str) -> None:

@@ -42,6 +42,7 @@ from hypervisor_tpu.tables.state import (
     AgentTable,
     ElevationTable,
     FLAG_ACTIVE,
+    FLAG_QUARANTINED,
     SagaTable,
     SessionTable,
     VouchTable,
@@ -58,6 +59,8 @@ _RECORD_CALLS = jax.jit(security_ops.record_calls)
 _SLASH = jax.jit(liability_ops.slash_cascade)
 _BREACH_SWEEP = jax.jit(security_ops.breach_sweep)
 _ELEV_EXPIRY = jax.jit(security_ops.elevation_expiry)
+_QUAR_ENTER = jax.jit(security_ops.quarantine_enter)
+_QUAR_SWEEP = jax.jit(security_ops.quarantine_sweep)
 _EFF_RINGS = jax.jit(security_ops.effective_rings)
 
 
@@ -711,6 +714,36 @@ class HypervisorState:
     def effective_rings(self, now: float) -> np.ndarray:
         """i8[N] assigned rings with active elevations applied."""
         return np.asarray(_EFF_RINGS(self.agents.ring, self.elevations, now))
+
+    def quarantine_rows(
+        self,
+        rows: list[int] | np.ndarray,
+        now: float,
+        duration: Optional[float] = None,
+    ) -> None:
+        """Place agent rows into read-only isolation (extend-only deadline).
+
+        Reference semantics (`liability/quarantine.py:73-118`): default
+        300s, escalation merges into the existing record — here the
+        deadline extends, never shortens. Forensic data lives on the
+        host `QuarantineManager`; the device columns are what waves see.
+        """
+        if duration is None:
+            duration = self.config.quarantine.default_duration_seconds
+        enter = jnp.zeros((self.agents.did.shape[0],), bool).at[
+            jnp.asarray(np.asarray(rows, np.int32))
+        ].set(True)
+        self.agents = _QUAR_ENTER(self.agents, enter, now, float(duration))
+
+    def quarantine_tick(self, now: float) -> list[int]:
+        """Auto-release lapsed quarantines; returns released rows."""
+        sweep = _QUAR_SWEEP(self.agents, now)
+        self.agents = sweep.agents
+        return [int(r) for r in np.nonzero(np.asarray(sweep.released))[0]]
+
+    def quarantined_mask(self) -> np.ndarray:
+        """bool[N]: rows currently in read-only isolation."""
+        return (np.asarray(self.agents.flags) & FLAG_QUARANTINED) != 0
 
     # ── audit deltas ─────────────────────────────────────────────────
 

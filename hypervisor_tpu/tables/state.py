@@ -40,6 +40,7 @@ class AgentTable:
     bd_calls: jnp.ndarray       # i32[N] breach window: total calls
     bd_privileged: jnp.ndarray  # i32[N] breach window: calls above own ring
     bd_breaker_until: jnp.ndarray  # f32[N] circuit breaker cooldown deadline
+    quarantine_until: jnp.ndarray  # f32[N] read-only isolation deadline
 
     @staticmethod
     def create(capacity: int) -> "AgentTable":
@@ -57,6 +58,7 @@ class AgentTable:
             bd_calls=jnp.zeros((capacity,), jnp.int32),
             bd_privileged=jnp.zeros((capacity,), jnp.int32),
             bd_breaker_until=jnp.zeros((capacity,), jnp.float32),
+            quarantine_until=jnp.zeros((capacity,), jnp.float32),
         )
 
 

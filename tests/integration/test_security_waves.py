@@ -149,3 +149,86 @@ class TestElevation:
         assert float(np.asarray(st.elevations.expires_at)[0]) == pytest.approx(
             cfg.max_ttl_seconds
         )
+
+
+class TestQuarantinePlane:
+    def test_enter_extend_and_sweep(self):
+        from hypervisor_tpu.models import SessionConfig
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        slot = st.create_session("session:q", SessionConfig())
+        for i in range(3):
+            st.enqueue_join(slot, f"did:q{i}", sigma_raw=0.8)
+        assert (st.flush_joins() == 0).all()
+
+        st.quarantine_rows([0, 1], now=100.0)          # default 300s
+        mask = st.quarantined_mask()
+        assert mask[0] and mask[1] and not mask[2]
+
+        # Escalation keeps the original deadline (reference
+        # `quarantine.py:96-103`: merge, expires_at unchanged).
+        st.quarantine_rows([0], now=150.0, duration=500.0)
+        import numpy as np
+        until = np.asarray(st.agents.quarantine_until)
+        assert until[0] == 400.0 and until[1] == 400.0
+
+        # Sweep before deadline: nothing released.
+        assert st.quarantine_tick(now=399.0) == []
+        assert st.quarantine_tick(now=400.0) == [0, 1]
+        assert not st.quarantined_mask().any()
+
+        # A fresh quarantine after release gets its own window.
+        st.quarantine_rows([0], now=500.0, duration=100.0)
+        assert np.asarray(st.agents.quarantine_until)[0] == 600.0
+        assert st.quarantine_tick(now=601.0) == [0]
+
+    def test_write_wave_refuses_quarantined_writer(self):
+        import numpy as np
+        from hypervisor_tpu.runtime.write_wave import (
+            WRITE_OK,
+            WRITE_QUARANTINED,
+            WriteWave,
+        )
+        from hypervisor_tpu.session.vfs import SessionVFS
+
+        vfs = SessionVFS("session:qw")
+        held = {"did:frozen"}
+        wave = WriteWave(vfs, is_quarantined=lambda did: did in held)
+        wave.submit("did:frozen", "/a", "x", ring=2)
+        wave.submit("did:free", "/b", "y", ring=2)
+        report = wave.flush(now=0.0)
+        assert report.status.tolist() == [WRITE_QUARANTINED, WRITE_OK]
+        assert report.quarantined == 1 and report.applied == 1
+        assert vfs.read("/b") == "y"
+        assert vfs.read("/a") is None  # never written
+
+    async def test_drift_slash_quarantines_device_row(self):
+        from hypervisor_tpu import Hypervisor, SessionConfig
+        from hypervisor_tpu.integrations.cmvk_adapter import CMVKAdapter
+        from hypervisor_tpu.liability.quarantine import QuarantineReason
+
+        class Verifier:
+            def verify_embeddings(self, embedding_a, embedding_b,
+                                  metric="cosine", threshold_profile=None,
+                                  explain=False):
+                class V:
+                    drift_score = 0.8
+                    explanation = "test"
+                return V()
+
+        hv = Hypervisor(cmvk=CMVKAdapter(verifier=Verifier()))
+        ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+        sid = ms.sso.session_id
+        await hv.join_session(sid, "did:bad", sigma_raw=0.9)
+        await hv.activate_session(sid)
+        await hv.verify_behavior(sid, "did:bad", "c", "o")
+
+        # Host record with forensic data...
+        rec = hv.quarantine.get_active_quarantine("did:bad", sid)
+        assert rec is not None
+        assert rec.reason is QuarantineReason.BEHAVIORAL_DRIFT
+        assert rec.forensic_data["drift_score"] == 0.8
+        # ...and the device row flagged read-only.
+        row = hv.state.agent_row("did:bad")
+        assert hv.state.quarantined_mask()[row["slot"]]

@@ -419,6 +419,12 @@ class Hypervisor:
                 agent_did=agent_did,
                 payload={"drift_score": result.drift_score},
             )
+            self._emit(
+                EventType.QUARANTINE_ENTERED,
+                session_id=session_id,
+                agent_did=agent_did,
+                payload={"reason": QuarantineReason.BEHAVIORAL_DRIFT.value},
+            )
             if self.nexus:
                 severity = "critical" if result.drift_score >= 0.75 else "high"
                 self.nexus.report_slash(

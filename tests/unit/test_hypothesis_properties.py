@@ -1,0 +1,215 @@
+"""Property-based tests (hypothesis) over the host governance engines.
+
+The reference lists hypothesis as a dev dependency but ships no property
+tests (SURVEY §4). `tests/parity/test_invariants.py` sweeps the device-op
+formulas with seeded randoms; this module covers the *stateful host
+engines* with real hypothesis strategies and shrinking: arbitrary
+operation sequences must preserve each engine's invariants.
+
+Pure-host (no jax), so examples run fast.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+hyp = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from hypervisor_tpu.liability.ledger import LedgerEntryType, LiabilityLedger
+from hypervisor_tpu.liability.slashing import SlashingEngine
+from hypervisor_tpu.liability.vouching import VouchingEngine, VouchingError
+from hypervisor_tpu.saga.state_machine import (
+    STEP_TRANSITION_MATRIX,
+    SagaStateError,
+    SagaStep,
+    StepState,
+)
+from hypervisor_tpu.session.vfs import SessionVFS
+from hypervisor_tpu.tables.intern import InternTable
+
+S = "session:prop"
+
+dids = st.sampled_from([f"did:p{i}" for i in range(6)])
+sigmas = st.floats(min_value=0.5, max_value=1.0, width=32)
+
+
+class TestVouchingProperties:
+    @settings(max_examples=60, deadline=None)
+    @given(
+        st.lists(st.tuples(dids, dids, sigmas, sigmas), min_size=1, max_size=12)
+    )
+    def test_sigma_eff_capped_and_no_cycles(self, ops):
+        eng = VouchingEngine()
+        edges: set[tuple[str, str]] = set()
+        for voucher, vouchee, v_sigma, e_sigma in ops:
+            if voucher == vouchee:
+                continue
+            try:
+                eng.vouch(voucher, vouchee, S, voucher_sigma=v_sigma)
+                edges.add((voucher, vouchee))
+            except VouchingError:
+                pass
+            # Invariant: the vouch graph never contains a 2-cycle.
+            assert not any((b, a) in edges for a, b in edges)
+            # Invariant: sigma_eff is capped at 1.0 for any bond set.
+            eff = eng.compute_sigma_eff(vouchee, S, e_sigma, risk_weight=0.9)
+            assert 0.0 <= eff <= 1.0
+            assert eff >= min(e_sigma, 1.0) - 1e-6  # vouching never hurts
+
+    @settings(max_examples=40, deadline=None)
+    @given(st.lists(st.tuples(dids, sigmas), min_size=1, max_size=8, unique_by=lambda t: t[0]))
+    def test_exposure_never_exceeds_limit(self, vouchers):
+        eng = VouchingEngine()
+        limit = eng.max_exposure
+        for i, (voucher, v_sigma) in enumerate(vouchers):
+            # One voucher fanning out to many vouchees until refused.
+            for j in range(6):
+                try:
+                    eng.vouch(voucher, f"did:sink{i}-{j}", S, voucher_sigma=v_sigma)
+                except VouchingError:
+                    break
+            assert (
+                eng.get_total_exposure(voucher, S) <= limit * v_sigma + 1e-6
+            )
+
+
+class TestSlashingProperties:
+    @settings(max_examples=40, deadline=None)
+    @given(
+        st.lists(st.tuples(dids, dids, sigmas), min_size=1, max_size=10),
+        st.floats(min_value=0.0, max_value=1.0, width=32),
+    )
+    def test_clip_floor_and_blacklist(self, ops, omega):
+        vouching = VouchingEngine()
+        scores = {}
+        for voucher, vouchee, v_sigma in ops:
+            if voucher == vouchee:
+                continue
+            scores.setdefault(voucher, v_sigma)
+            scores.setdefault(vouchee, 0.8)
+            try:
+                vouching.vouch(voucher, vouchee, S, voucher_sigma=v_sigma)
+            except VouchingError:
+                pass
+        slashing = SlashingEngine(vouching)
+        target = ops[0][1] if ops[0][1] != ops[0][0] else ops[0][0]
+        scores.setdefault(target, 0.8)
+        result = slashing.slash(
+            vouchee_did=target,
+            session_id=S,
+            vouchee_sigma=scores[target],
+            risk_weight=omega,
+            reason="prop",
+            agent_scores=scores,
+        )
+        # Invariants: vouchee dies at exactly 0; every clipped voucher
+        # lands at sigma*(1-omega) floored at 0.05.
+        assert result.vouchee_sigma_after == 0.0
+        for clip in result.voucher_clips:
+            assert clip.sigma_after >= 0.05 - 1e-9
+            expected = max(clip.sigma_before * (1.0 - omega), 0.05)
+            assert clip.sigma_after == pytest.approx(expected, abs=1e-6)
+
+
+class TestLedgerProperties:
+    entry_types = st.sampled_from(list(LedgerEntryType))
+
+    @settings(max_examples=60, deadline=None)
+    @given(
+        st.lists(
+            st.tuples(entry_types, st.floats(0.0, 1.0, width=32)),
+            min_size=1,
+            max_size=30,
+        )
+    )
+    def test_risk_always_clamped_and_ladder_consistent(self, events):
+        led = LiabilityLedger()
+        for etype, sev in events:
+            led.record("did:prop", etype, S, severity=float(sev))
+        profile = led.compute_risk_profile("did:prop")
+        assert 0.0 <= profile.risk_score <= 1.0
+        if profile.risk_score >= led.DENY_THRESHOLD:
+            assert profile.recommendation == "deny"
+        elif profile.risk_score >= led.PROBATION_THRESHOLD:
+            assert profile.recommendation == "probation"
+        else:
+            assert profile.recommendation == "admit"
+        ok, why = led.should_admit("did:prop")
+        assert ok == (profile.recommendation != "deny")
+        assert profile.total_entries == len(events)
+
+
+class TestSagaMachineProperties:
+    @settings(max_examples=80, deadline=None)
+    @given(st.lists(st.sampled_from(list(StepState)), min_size=1, max_size=12))
+    def test_transitions_follow_matrix_or_raise(self, targets):
+        step = SagaStep(step_id="s", action_id="a", agent_did="d", execute_api="/x")
+        for target in targets:
+            legal = bool(STEP_TRANSITION_MATRIX[step.state.code, target.code])
+            if legal:
+                before = step.state
+                step.transition(target)
+                assert step.state is target and step.state is not before or target is before
+            else:
+                with pytest.raises(SagaStateError):
+                    step.transition(target)
+
+    @settings(max_examples=40, deadline=None)
+    @given(st.lists(st.sampled_from(list(StepState)), min_size=1, max_size=12))
+    def test_terminal_timestamping(self, targets):
+        step = SagaStep(step_id="s", action_id="a", agent_did="d", execute_api="/x")
+        for target in targets:
+            try:
+                step.transition(target)
+            except SagaStateError:
+                continue
+            if target in (
+                StepState.COMMITTED,
+                StepState.COMPENSATED,
+                StepState.COMPENSATION_FAILED,
+                StepState.FAILED,
+            ):
+                assert step.completed_at is not None
+
+
+class TestVFSProperties:
+    paths = st.sampled_from([f"/f{i}" for i in range(5)])
+    contents = st.text(min_size=0, max_size=20)
+
+    @settings(max_examples=40, deadline=None)
+    @given(st.lists(st.tuples(paths, contents), min_size=1, max_size=15))
+    def test_snapshot_restore_roundtrip(self, writes):
+        vfs = SessionVFS("session:propvfs")
+        mid = len(writes) // 2
+        for path, content in writes[:mid]:
+            vfs.write(path, content, "did:w")
+        snap = vfs.create_snapshot()
+        frozen = {p: vfs.read(p) for p, _ in writes[:mid]}
+        for path, content in writes[mid:]:
+            vfs.write(path, content + "-post", "did:w")
+        vfs.restore_snapshot(snap, "did:w")
+        for path, content in frozen.items():
+            assert vfs.read(path) == content
+
+    @settings(max_examples=40, deadline=None)
+    @given(st.lists(st.tuples(paths, contents), min_size=1, max_size=15))
+    def test_attribution_log_grows_monotonically(self, writes):
+        vfs = SessionVFS("session:proplog")
+        for i, (path, content) in enumerate(writes):
+            vfs.write(path, content, f"did:w{i % 3}")
+            assert len(vfs.edit_log) == i + 1
+
+
+class TestInternProperties:
+    @settings(max_examples=60, deadline=None)
+    @given(st.lists(st.text(min_size=1, max_size=10), min_size=1, max_size=30))
+    def test_intern_is_idempotent_bijection(self, names):
+        t = InternTable()
+        handles = [t.intern(n) for n in names]
+        # Idempotent: re-interning returns the same handle.
+        assert [t.intern(n) for n in names] == handles
+        # Bijective over distinct names, and reverse lookup inverts.
+        assert len(t) == len(set(names))
+        for n, h in zip(names, handles):
+            assert t.string(h) == n

@@ -2,8 +2,9 @@
 
 Mirrors the reference demo (`examples/demo.py`): session lifecycle,
 saga + compensation, vouch/slash, Merkle audit, adapters with inline mocks —
-plus a sixth, TPU-specific demo running the fused batched governance
-pipeline on whatever accelerator JAX sees.
+plus TPU-specific demos: the fused batched governance pipeline on
+whatever accelerator JAX sees, the real-table device plane, and the
+security plane (quarantine, lock waves, deadlock victims).
 
 Run: python examples/demo.py
 """
@@ -312,6 +313,55 @@ async def demo_device_plane() -> None:
           f"conflict(s) rejected (stale writer), {report.rate_limited} rate-limited")
 
 
+def demo_security_plane() -> None:
+    """Quarantine isolation + batched lock waves with deadlock breaking."""
+    banner("8. Security plane: quarantine, lock waves, deadlock victims")
+
+    from hypervisor_tpu.models import SessionConfig as SC
+    from hypervisor_tpu.runtime.lock_wave import LockWave
+    from hypervisor_tpu.runtime.write_wave import WriteWave
+    from hypervisor_tpu.session.intent_locks import LockIntent
+    from hypervisor_tpu.session.vfs import SessionVFS
+    from hypervisor_tpu.state import HypervisorState
+
+    # Quarantine: device rows go read-only; write waves refuse them.
+    st = HypervisorState()
+    slot = st.create_session("demo:sec", SC())
+    for i in range(3):
+        st.enqueue_join(slot, f"did:s{i}", sigma_raw=0.8)
+    st.flush_joins()
+    st.quarantine_rows([0], now=0.0)
+    frozen = {f"did:s0"}
+    wave = WriteWave(
+        SessionVFS("demo:sec"), is_quarantined=lambda d: d in frozen
+    )
+    wave.submit("did:s0", "/x", "blocked", ring=2)
+    wave.submit("did:s1", "/x", "ok", ring=2)
+    report = wave.flush(now=0.0)
+    released = st.quarantine_tick(now=301.0)
+    print(
+        f"quarantine: {report.quarantined} write(s) refused read-only, "
+        f"{report.applied} applied; sweep at t+301s released rows {released}"
+    )
+
+    # Lock wave: dense conflict gate + matmul deadlock closure.
+    locks = LockWave()
+    locks.observe_sigma("did:s1", 0.9)
+    locks.observe_sigma("did:s2", 0.6)
+    locks.manager.declare_wait("did:s1", {"did:s2"})
+    locks.manager.declare_wait("did:s2", {"did:s1"})
+    locks.submit("did:s1", "demo:sec", "/r1", LockIntent.READ)
+    locks.submit("did:s2", "demo:sec", "/r1", LockIntent.READ)
+    locks.submit("did:s1", "demo:sec", "/r1", LockIntent.EXCLUSIVE)
+    lr = locks.flush()
+    dr = locks.deadlock_report()
+    print(
+        f"lock wave: statuses {lr.status.tolist()} "
+        f"(0 granted / 1 contention / 2 deadlock); standing cycle "
+        f"{dr.on_cycle} -> kill-switch victim {dr.victim} (lowest sigma)"
+    )
+
+
 async def main() -> None:
     hv = Hypervisor()
     await demo_lifecycle(hv)
@@ -321,6 +371,7 @@ async def main() -> None:
     await demo_adapters()
     demo_batched_pipeline()
     await demo_device_plane()
+    demo_security_plane()
     print("\nAll demos complete.")
 
 

@@ -264,3 +264,23 @@ class DeviceStatsResponse(BaseModel):
     delta_log_records: int
     device_events: int
     elevations_active: int
+
+
+class QuarantineStatusResponse(BaseModel):
+    """One agent's read-only-isolation status across both planes."""
+
+    agent_did: str
+    session_id: Optional[str] = None
+    quarantined: bool = False
+    reason: Optional[str] = None
+    details: str = ""
+    remaining_seconds: float = 0.0
+    device_flagged: bool = False
+    forensic_keys: list = []
+
+
+class QuarantineListItem(BaseModel):
+    agent_did: str
+    session_id: str
+    reason: str
+    remaining_seconds: float

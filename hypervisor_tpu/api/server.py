@@ -53,6 +53,8 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/api/v1/agents/{agent_did}/liability", "agent_liability", None),
     ("GET", "/api/v1/events", "query_events", None),
     ("GET", "/api/v1/events/stats", "event_stats", None),
+    ("GET", "/api/v1/agents/{agent_did}/quarantine", "agent_quarantine", None),
+    ("GET", "/api/v1/security/quarantines", "list_quarantines", None),
 ]
 
 _QUERY_PARAMS = {

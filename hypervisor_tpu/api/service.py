@@ -357,6 +357,50 @@ class HypervisorService:
             total_events=self.bus.event_count, by_type=self.bus.type_counts()
         )
 
+    # ── security: quarantine (both planes) ───────────────────────────
+
+    async def agent_quarantine(self, agent_did: str) -> M.QuarantineStatusResponse:
+        """Read-only-isolation status: host record + device flag."""
+        record = next(
+            (
+                r
+                for r in self.hv.quarantine.active_quarantines
+                if r.agent_did == agent_did
+            ),
+            None,
+        )
+        row = self.hv.state.agent_row(agent_did)
+        device_flagged = bool(
+            row is not None and self.hv.state.quarantined_mask()[row["slot"]]
+        )
+        if record is None:
+            return M.QuarantineStatusResponse(
+                agent_did=agent_did,
+                quarantined=device_flagged,
+                device_flagged=device_flagged,
+            )
+        return M.QuarantineStatusResponse(
+            agent_did=agent_did,
+            session_id=record.session_id,
+            quarantined=True,
+            reason=record.reason.value,
+            details=record.details,
+            remaining_seconds=record.remaining_seconds,
+            device_flagged=device_flagged,
+            forensic_keys=sorted(record.forensic_data),
+        )
+
+    async def list_quarantines(self) -> list[M.QuarantineListItem]:
+        return [
+            M.QuarantineListItem(
+                agent_did=r.agent_did,
+                session_id=r.session_id,
+                reason=r.reason.value,
+                remaining_seconds=r.remaining_seconds,
+            )
+            for r in self.hv.quarantine.active_quarantines
+        ]
+
     # ── internals ────────────────────────────────────────────────────
 
     def _managed(self, session_id: str) -> ManagedSession:

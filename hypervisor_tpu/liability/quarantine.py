@@ -61,6 +61,13 @@ class QuarantineRecord:
         end = self.released_at or utc_now()
         return (end - self.entered_at).total_seconds()
 
+    @property
+    def remaining_seconds(self) -> float:
+        """Seconds until auto-release (0 when lapsed; inf if indefinite)."""
+        if self.expires_at is None:
+            return float("inf")
+        return max(0.0, (self.expires_at - utc_now()).total_seconds())
+
 
 class QuarantineManager:
     """Two-tier quarantine store: live keyed map + append-only archive."""

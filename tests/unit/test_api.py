@@ -179,9 +179,13 @@ class TestEventEndpoints:
 class TestHTTPTransport:
     def test_routes_cover_reference_plus_device_stats(self):
         # The reference's 21 endpoints plus /api/v1/device/stats (the
-        # device-plane occupancy view the reference has no analog for).
-        assert len(ROUTES) == 22
+        # device-plane occupancy view the reference has no analog for)
+        # and the two quarantine views.
+        assert len(ROUTES) == 24
         assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
+        assert any(
+            path == "/api/v1/security/quarantines" for _, path, _, _ in ROUTES
+        )
 
     def test_end_to_end_over_http(self):
         server = HypervisorHTTPServer().start()
@@ -241,3 +245,34 @@ async def test_device_stats_endpoint():
     assert stats.session_rows >= 1
     assert stats.agent_capacity > 0 and stats.session_capacity > 0
     assert stats.backend
+
+
+async def test_quarantine_endpoints():
+    from hypervisor_tpu.liability.quarantine import QuarantineReason
+
+    svc = HypervisorService()
+    m = await svc.create_session(M.CreateSessionRequest(creator_did="did:c"))
+    await svc.join_session(
+        m.session_id, M.JoinSessionRequest(agent_did="did:frozen", sigma_raw=0.9)
+    )
+
+    # Nobody quarantined yet.
+    status = await svc.agent_quarantine("did:frozen")
+    assert not status.quarantined and not status.device_flagged
+    assert await svc.list_quarantines() == []
+
+    # Quarantine through both planes, as the facade drift path does.
+    svc.hv.quarantine.quarantine(
+        "did:frozen", m.session_id, QuarantineReason.MANUAL,
+        details="ops hold", forensic_data={"k": 1},
+    )
+    row = svc.hv.state.agent_row("did:frozen")
+    svc.hv.state.quarantine_rows([row["slot"]], now=svc.hv.state.now())
+
+    status = await svc.agent_quarantine("did:frozen")
+    assert status.quarantined and status.device_flagged
+    assert status.reason == "manual" and status.forensic_keys == ["k"]
+    assert 0 < status.remaining_seconds <= 300
+
+    items = await svc.list_quarantines()
+    assert len(items) == 1 and items[0].agent_did == "did:frozen"

@@ -79,3 +79,31 @@ def force_cpu_platform(n_devices: int = 8) -> None:
         if not jax.config.jax_compilation_cache_dir:
             jax.config.update("jax_compilation_cache_dir", cache_dir())
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def arm_device_watchdog(seconds: float = 600.0, what: str = "device discovery"):
+    """Bounded guard against a wedged accelerator tunnel.
+
+    The environment's real-TPU plugin connects through a tunnel that can
+    hang indefinitely (observed live: `jax.devices()` never returns).
+    Arm this before the first backend query; call the returned disarm()
+    once devices respond. If the deadline passes first, the process
+    prints a diagnostic and exits nonzero — a recorded failure instead
+    of an unbounded hang.
+    """
+    import threading
+
+    done = threading.Event()
+
+    def tripwire():
+        if not done.wait(seconds):
+            sys.stderr.write(
+                f"FATAL: {what} did not complete within {seconds:.0f}s — "
+                "accelerator tunnel appears wedged; aborting instead of "
+                "hanging.\n"
+            )
+            sys.stderr.flush()
+            os._exit(17)
+
+    threading.Thread(target=tripwire, daemon=True).start()
+    return done.set

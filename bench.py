@@ -61,6 +61,12 @@ def _host_chain_and_root(bodies_lane: np.ndarray) -> tuple[list[str], str]:
 
 
 def main() -> None:
+    # Fail fast (rc=17 + diagnostic) if the TPU tunnel is wedged instead
+    # of hanging the driver; generous deadline covers a cold first compile.
+    from _jax_platform import arm_device_watchdog
+
+    disarm = arm_device_watchdog(600.0, "TPU device discovery")
+
     import jax
     import jax.numpy as jnp
 
@@ -71,6 +77,7 @@ def main() -> None:
     from hypervisor_tpu.tables.struct import replace as t_replace
 
     dev = jax.devices()[0]
+    disarm()
     rng = np.random.RandomState(42)
 
     # ── host staging: sessions, agents, vouch preload ────────────────

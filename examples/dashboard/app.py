@@ -61,6 +61,8 @@ class DashboardState:
     quarantine_rows: list = field(default_factory=list)  # (did, reason, active)
     security_rows: list = field(default_factory=list)  # (did, severity, tripped)
     elevation_rows: list = field(default_factory=list)  # (did, ring, remaining_s)
+    lock_rows: list = field(default_factory=list)      # (resource, holders)
+    deadlock_info: dict = field(default_factory=dict)  # {cycle: [...], victim: str}
     device_stats: dict = field(default_factory=dict)   # device-plane occupancy
 
 
@@ -210,6 +212,29 @@ async def simulate(n_sessions: int = 4, agents_per: int = 5, seed: int = 7) -> D
                 state.elevation_rows.append(
                     (did, row["ring"] - 1, 120.0))
 
+    # lock waves: contention points + a standing deadlock with its victim
+    from hypervisor_tpu.runtime.lock_wave import LockWave
+    from hypervisor_tpu.session.intent_locks import LockIntent
+
+    locks = LockWave()
+    contenders = sorted(state.sigma_by_agent)[:3]
+    if len(contenders) >= 2:
+        for did in contenders:
+            locks.observe_sigma(did, state.sigma_by_agent[did])
+            locks.submit(did, "session:sim", "/shared/plan.md", LockIntent.READ)
+        locks.submit(
+            contenders[0], "session:sim", "/shared/state.db", LockIntent.EXCLUSIVE
+        )
+        locks.flush()
+        locks.manager.declare_wait(contenders[0], {contenders[1]})
+        locks.manager.declare_wait(contenders[1], {contenders[0]})
+        state.lock_rows = sorted(locks.contention_counts().items())
+        report = locks.deadlock_report()
+        state.deadlock_info = {
+            "cycle": report.on_cycle,
+            "victim": report.victim,
+        }
+
     # device-plane occupancy (the HBM tables behind the facade)
     import numpy as np
     hv.sync_events_to_device()
@@ -346,6 +371,14 @@ def render_terminal(st: DashboardState) -> None:
                       f"severity {severity}" + (" BREAKER TRIPPED" if tripped else ""))
         for did, ring, ttl in st.elevation_rows:
             t.add_row(did, "elevation", f"\u2192 Ring {ring} (ttl {ttl:.0f}s)")
+        for resource, holders in st.lock_rows:
+            t.add_row(resource, "lock contention", f"{holders} distinct holders")
+        if st.deadlock_info.get("cycle"):
+            t.add_row(
+                " \u2194 ".join(st.deadlock_info["cycle"]),
+                "[red]deadlock[/red]",
+                f"victim \u2192 {st.deadlock_info['victim']} (lowest \u03c3)",
+            )
         con.print(t)
 
     if st.device_stats:
@@ -458,6 +491,14 @@ def render_streamlit(st: DashboardState) -> None:  # pragma: no cover
         if st.security_rows:
             stl.dataframe(pd.DataFrame(
                 st.security_rows, columns=["agent", "severity", "breaker"]))
+        if st.lock_rows:
+            stl.dataframe(pd.DataFrame(
+                st.lock_rows, columns=["resource", "distinct holders"]))
+        if st.deadlock_info.get("cycle"):
+            stl.error(
+                f"deadlock: {' ↔ '.join(st.deadlock_info['cycle'])} — "
+                f"kill-switch victim {st.deadlock_info['victim']}"
+            )
         with stl.expander("device plane (HBM tables)"):
             stl.json(st.device_stats)
     with tabs[4]:

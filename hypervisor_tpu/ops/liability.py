@@ -134,6 +134,7 @@ def slash_cascade(
     risk_weight: jnp.ndarray | float,
     now: jnp.ndarray | float,
     trust: TrustConfig = DEFAULT_CONFIG.trust,
+    allreduce=None,
 ) -> SlashWaveResult:
     """Batched slash with depth-bounded cascade (`slashing.py:63-143`).
 
@@ -142,6 +143,13 @@ def slash_cascade(
       seeds: bool[N] initial vouchees to blacklist.
       session_slot: session scope of the violation.
       risk_weight: omega of the violated action.
+      allreduce: optional i32[N] -> i32[N] reduction combining per-shard
+        partials. None (single device) is identity; under `shard_map`
+        with the edge axis sharded, pass a `psum` over the mesh axis
+        (`parallel.collectives.sharded_slash`) — the per-voucher
+        simultaneous-vouchee counts and the has-own-vouchers seeding
+        then see the WHOLE liability graph even though each chip holds
+        only its edge block.
 
     Semantics mirrored from the reference:
       * every slashed vouchee's sigma -> 0 (`slashing.py:89`)
@@ -150,6 +158,10 @@ def slash_cascade(
       * a clipped voucher cascades iff its new sigma < floor+eps AND it has
         its own vouchers, at depth <= max_cascade_depth (`:124-141`).
     """
+    if allreduce is None:
+        def allreduce(x):
+            return x
+
     omega = jnp.asarray(risk_weight, jnp.float32)
     sess = jnp.asarray(session_slot, jnp.int32)
     n = sigma.shape[0]
@@ -172,9 +184,12 @@ def slash_cascade(
             & (vouch.session == sess)
             & jnp.where(vouch.vouchee >= 0, wave[jnp.clip(vouch.vouchee, 0)], False)
         )
-        # k = simultaneous slashed vouchees per voucher.
-        k = jnp.zeros((n,), jnp.int32).at[jnp.clip(vouch.voucher, 0)].add(
-            jnp.where(hit & (vouch.voucher >= 0), 1, 0)
+        # k = simultaneous slashed vouchees per voucher (global across
+        # edge shards when an allreduce is supplied).
+        k = allreduce(
+            jnp.zeros((n,), jnp.int32).at[jnp.clip(vouch.voucher, 0)].add(
+                jnp.where(hit & (vouch.voucher >= 0), 1, 0)
+            )
         )
         was_clipped = k > 0
         clip_sigma = jnp.maximum(
@@ -192,8 +207,15 @@ def slash_cascade(
         # vouchers in this session — and weren't already slashed.
         wiped = was_clipped & (sigma < trust.sigma_floor + trust.cascade_wipe_epsilon)
         live2 = active & (jnp.asarray(now, jnp.float32) <= vouch.expiry)
-        has_vouchers = jnp.zeros((n,), bool).at[jnp.clip(vouch.vouchee, 0)].max(
-            live2 & (vouch.session == sess) & (vouch.vouchee >= 0)
+        has_vouchers = (
+            allreduce(
+                jnp.zeros((n,), jnp.int32).at[jnp.clip(vouch.vouchee, 0)].add(
+                    (live2 & (vouch.session == sess) & (vouch.vouchee >= 0)).astype(
+                        jnp.int32
+                    )
+                )
+            )
+            > 0
         )
         wave = wiped & has_vouchers & ~slashed
 

@@ -445,3 +445,51 @@ def sigma_allreduce_stats(sigma_eff: jnp.ndarray, n_agents: int) -> jnp.ndarray:
     return jnp.stack(
         [jnp.sum(sigma_eff), jnp.sum(sigma_eff) / n_agents, jnp.max(sigma_eff)]
     )
+
+
+def sharded_slash(mesh: Mesh, trust: TrustConfig = DEFAULT_CONFIG.trust):
+    """Cross-shard slash cascade: the liability graph sharded over ICI.
+
+    The VouchTable's edge axis shards over the mesh (each chip holds its
+    block of the edge list); agent sigma and the seed mask are
+    replicated. The cascade body is the SAME `ops.liability.slash_cascade`
+    the single-device path runs — here its per-voucher counts and
+    next-wave seeding combine per-shard partials with a `psum`, so a
+    voucher whose slashed vouchees' edges live on DIFFERENT chips is
+    clipped once with the correct global k, and a wiped voucher seeds the
+    next wave even when its own vouchers' edges sit on another shard.
+
+    Returns fn(vouch, sigma, seeds, session_slot, risk_weight, now) ->
+    SlashWaveResult with `vouch` sharded as input and everything else
+    replicated (bit-identical on every chip).
+    """
+
+    def step(vouch, sigma, seeds, session_slot, risk_weight, now):
+        return liability_ops.slash_cascade(
+            vouch,
+            sigma,
+            seeds,
+            session_slot,
+            risk_weight,
+            now,
+            trust=trust,
+            allreduce=lambda x: jax.lax.psum(x, AGENT_AXIS),
+        )
+
+    from hypervisor_tpu.tables.state import VouchTable
+
+    vouch_specs = jax.tree.map(lambda _: P(AGENT_AXIS), VouchTable.create(1))
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(vouch_specs, P(), P(), P(), P(), P()),
+            out_specs=liability_ops.SlashWaveResult(
+                sigma=P(),
+                vouch=vouch_specs,
+                slashed=P(),
+                clipped=P(),
+                wave_of=P(),
+            ),
+        )
+    )

@@ -87,6 +87,23 @@ class ManagedSession:
             digest_words=hex_to_words([delta.delta_hash])[0],
         )
 
+    def write_wave(self, **kwargs):
+        """A batched write path over this session's VFS, pre-wired to the
+        device plane: writers whose agent rows carry FLAG_QUARANTINED are
+        refused before any rate-limit token burns (read-only isolation,
+        reference `liability/quarantine.py` semantics)."""
+        from hypervisor_tpu.runtime.write_wave import WriteWave
+
+        state = self._state
+
+        def quarantined(did: str) -> bool:
+            if state is None:
+                return False
+            row = state.agent_row(did)
+            return bool(row is not None and state.quarantined_mask()[row["slot"]])
+
+        return WriteWave(self.sso.vfs, is_quarantined=quarantined, **kwargs)
+
 
 class Hypervisor:
     """Top-level governance runtime.

@@ -232,3 +232,32 @@ class TestQuarantinePlane:
         # ...and the device row flagged read-only.
         row = hv.state.agent_row("did:bad")
         assert hv.state.quarantined_mask()[row["slot"]]
+
+    async def test_managed_session_write_wave_prewired(self):
+        """ManagedSession.write_wave() refuses device-quarantined writers
+        without any manual predicate assembly."""
+        from hypervisor_tpu import Hypervisor, SessionConfig
+        from hypervisor_tpu.runtime.write_wave import WRITE_OK, WRITE_QUARANTINED
+
+        hv = Hypervisor()
+        ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+        sid = ms.sso.session_id
+        await hv.join_session(sid, "did:iso", sigma_raw=0.8)
+        await hv.join_session(sid, "did:ok", sigma_raw=0.8)
+        await hv.activate_session(sid)
+
+        row = hv.state.agent_row("did:iso")
+        hv.state.quarantine_rows([row["slot"]], now=hv.state.now())
+
+        wave = ms.write_wave()
+        wave.submit("did:iso", "/doc.md", "nope", ring=2)
+        wave.submit("did:ok", "/doc.md", "yes", ring=2)
+        report = wave.flush(now=hv.state.now())
+        assert report.status.tolist() == [WRITE_QUARANTINED, WRITE_OK]
+        assert ms.sso.vfs.read("/doc.md") == "yes"
+
+        # Sweep past the deadline: the writer is readmitted.
+        hv.state.quarantine_tick(now=hv.state.now() + 301.0)
+        wave2 = ms.write_wave()
+        wave2.submit("did:iso", "/doc2.md", "back", ring=2)
+        assert wave2.flush(now=hv.state.now()).status.tolist() == [WRITE_OK]

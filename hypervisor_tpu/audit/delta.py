@@ -25,6 +25,9 @@ from hypervisor_tpu.utils.clock import Clock, utc_now
 
 # Below this many deltas the host loop beats device dispatch latency.
 _DEVICE_ROOT_THRESHOLD = 64
+# From this many deltas the C++ tree builder beats the Python loop
+# (one ctypes call vs 2N hashlib calls + string concats).
+_NATIVE_ROOT_THRESHOLD = 8
 
 
 @dataclass
@@ -90,6 +93,24 @@ def merkle_root_host(hashes: list[str]) -> str:
             nxt.append(hashlib.sha256((left + right).encode()).hexdigest())
         level = nxt
     return level[0]
+
+
+def merkle_root_native(hashes: list[str]) -> str:
+    """C++ tree builder (`native/hv_runtime.cpp`), Python-loop fallback.
+
+    Same hex-pair semantics as `merkle_root_host`; parity pinned by
+    `tests/unit/test_native_runtime.py`.
+    """
+    from hypervisor_tpu.runtime import native
+
+    if not native.HAVE_NATIVE:
+        return merkle_root_host(hashes)
+    import numpy as np
+
+    leaves = np.frombuffer(
+        bytes.fromhex("".join(hashes)), np.uint8
+    ).reshape(-1, 32)
+    return native.merkle_root_hex_host(leaves)
 
 
 def merkle_root_device(hashes: list[str]) -> str:
@@ -161,7 +182,11 @@ class DeltaEngine:
         hashes = [d.delta_hash for d in self._deltas]
         if device is None:
             device = len(hashes) >= _DEVICE_ROOT_THRESHOLD
-        return merkle_root_device(hashes) if device else merkle_root_host(hashes)
+        if device:
+            return merkle_root_device(hashes)
+        if len(hashes) >= _NATIVE_ROOT_THRESHOLD:
+            return merkle_root_native(hashes)
+        return merkle_root_host(hashes)
 
     def verify_chain(self) -> bool:
         """Recompute every hash and parent link; False on any tamper.

@@ -135,3 +135,21 @@ class TestGC:
 class TestMerkleRootHost:
     def test_single_leaf_is_identity(self):
         assert merkle_root_host(["aa" * 32]) == "aa" * 32
+
+
+def test_native_root_tier_matches_host_loop():
+    """The C++ mid-tier (>=8 deltas, < device threshold) must agree with
+    the Python loop exactly."""
+    from hypervisor_tpu.audit.delta import (
+        DeltaEngine,
+        merkle_root_host,
+        merkle_root_native,
+    )
+
+    eng = DeltaEngine("session:ntier")
+    for i in range(12):
+        eng.capture(f"did:n{i}", [])
+    hashes = [d.delta_hash for d in eng.deltas]
+    assert merkle_root_native(hashes) == merkle_root_host(hashes)
+    # compute_merkle_root picks the native tier at this size.
+    assert eng.compute_merkle_root(device=False) == merkle_root_host(hashes)

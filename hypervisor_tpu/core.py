@@ -279,6 +279,9 @@ class Hypervisor:
         managed.sso.join(
             agent_did=agent_did, sigma_raw=sigma_raw, sigma_eff=sigma_eff, ring=ring
         )
+        # Bonds recorded before this agent was device-resident gain their
+        # VouchTable edges now that it has a row.
+        self._backfill_vouch_mirror(agent_did)
         self._emit(
             EventType.SESSION_JOINED,
             session_id=session_id,
@@ -487,6 +490,19 @@ class Hypervisor:
         edge = self._edge_of_vouch.pop(vouch_id, None)
         if edge is not None:
             self.state.release_vouch(edge)
+
+    def _backfill_vouch_mirror(self, agent_did: str) -> None:
+        """Mirror host bonds that predate an endpoint's device residency.
+
+        A vouch recorded before its voucher (or vouchee) joined has no
+        device edge — `_mirror_vouch` skips when an endpoint has no agent
+        row. Once the missing endpoint joins, those bonds must appear in
+        the VouchTable or device sigma_eff contributions and slash
+        cascades silently under-count them (coherence gap surfaced by the
+        stateful property suite)."""
+        for record in self.vouching.agent_records(agent_did):
+            if record.is_active and record.vouch_id not in self._edge_of_vouch:
+                self._mirror_vouch(record)
 
     def sync_events_to_device(self) -> int:
         """Mirror new bus events into the device EventLog ring buffer.

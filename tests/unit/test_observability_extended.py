@@ -122,3 +122,48 @@ class TestCausalTrace:
         )
         assert ev.to_dict()["causal_trace_id"] == str(trace)
         assert ev.to_dict()["parent_event_id"] == "parent123"
+
+
+class TestProfilingHooks:
+    def test_capture_writes_a_trace(self, tmp_path):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from hypervisor_tpu.observability import profiling
+
+        log_dir = str(tmp_path / "trace")
+        assert not profiling.is_active()
+        with profiling.capture(log_dir):
+            assert profiling.is_active()
+            with profiling.span("test.wave"):
+                jnp.asarray(np.arange(8)).sum().block_until_ready()
+        assert not profiling.is_active()
+        # A trace directory with at least one event file appeared.
+        import os
+
+        found = [
+            os.path.join(dp, f)
+            for dp, _, fns in os.walk(log_dir)
+            for f in fns
+        ]
+        assert found, "no trace files written"
+
+    def test_nested_capture_is_noop(self, tmp_path):
+        from hypervisor_tpu.observability import profiling
+
+        outer = str(tmp_path / "outer")
+        with profiling.capture(outer):
+            # Inner capture must not truncate the outer trace.
+            with profiling.capture(str(tmp_path / "inner")):
+                assert profiling.is_active()
+            assert profiling.is_active()
+        assert not profiling.is_active()
+        assert profiling.stop() is None  # nothing left to stop
+
+    def test_span_without_capture_is_safe(self):
+        from hypervisor_tpu.observability import profiling
+
+        with profiling.span("idle"):
+            pass
+        with profiling.step_span("tick", step=3):
+            pass

@@ -23,6 +23,8 @@ exports but never wires (`api/server.py:101` instantiates its own).
 from __future__ import annotations
 
 import logging
+
+import numpy as np
 from typing import Any, Optional
 
 from hypervisor_tpu.audit import CommitmentEngine, DeltaEngine, EphemeralGC
@@ -348,6 +350,16 @@ class Hypervisor:
         ]
         self.state.free_edge_rows(session_rows)
         self.vouching.release_session_bonds(session_id)
+
+        # Cross-session edges referencing this session's reclaimed agent
+        # rows were scrubbed by the device GC (their bonds survive
+        # host-side); detach exactly those mirror entries so a later
+        # join's backfill can re-mirror them.
+        scrubbed = set(self.state.pop_scrubbed_edges())
+        if scrubbed:
+            for vouch_id, edge in list(self._edge_of_vouch.items()):
+                if edge in scrubbed:
+                    del self._edge_of_vouch[vouch_id]
 
         self.gc.collect(
             session_id=session_id,

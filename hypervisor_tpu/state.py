@@ -86,6 +86,10 @@ class HypervisorState:
         self._next_saga_slot = 0
         self._next_edge_slot = 0
         self._free_edge_slots: list[int] = []
+        # Edge rows the device GC deactivated because an endpoint's agent
+        # row was reclaimed; the facade drains this to detach exactly
+        # those mirror entries (pop_scrubbed_edges).
+        self._scrubbed_edges: list[int] = []
         self._next_elev_slot = 0
         self._free_elev_slots: list[int] = []
         self._members: dict[tuple[int, int], bool] = {}  # (session, did) -> True
@@ -443,6 +447,11 @@ class HypervisorState:
         """Recycle rows a device wave already deactivated (host-only
         bookkeeping — no device write)."""
         self._free_edge_slots.extend(int(r) for r in edge_rows)
+
+    def pop_scrubbed_edges(self) -> list[int]:
+        """Drain the edge rows the GC scrubbed for lost endpoints."""
+        out, self._scrubbed_edges = self._scrubbed_edges, []
+        return out
 
     def to_device_time(self, absolute_ts: float) -> float:
         """Absolute unix seconds -> this state's epoch-relative f32 time."""
@@ -957,6 +966,28 @@ class HypervisorState:
                     if self._slot_of_did.get(did) == row:
                         del self._slot_of_did[did]
                     self._free_agent_slots.append(row)
+            # Scrub dangling liability edges: a reclaimed agent row may
+            # still be referenced by edges in OTHER sessions (a voucher
+            # need not be a participant of the session it bonds in).
+            # Leaving them active would hand the bond to whatever agent
+            # later reuses the slot. They deactivate here and re-mirror
+            # through the facade's join backfill if the agent returns.
+            gone = np.zeros((self.agents.did.shape[0],), bool)
+            gone[reclaim] = True
+            voucher = np.asarray(self.vouches.voucher)
+            vouchee = np.asarray(self.vouches.vouchee)
+            dangling = np.asarray(self.vouches.active) & (
+                ((voucher >= 0) & gone[np.clip(voucher, 0, None)])
+                | ((vouchee >= 0) & gone[np.clip(vouchee, 0, None)])
+            )
+            rows = np.nonzero(dangling)[0]
+            if len(rows):
+                self.vouches = replace(
+                    self.vouches,
+                    active=self.vouches.active.at[jnp.asarray(rows)].set(False),
+                )
+                self.free_edge_rows(rows)
+                self._scrubbed_edges.extend(int(r) for r in rows)
         return np.asarray(result.roots)
 
     # ── views ────────────────────────────────────────────────────────

@@ -1,0 +1,24 @@
+"""Smoke tests for the example scripts."""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+_EXAMPLES = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "examples"
+)
+
+
+def test_multichip_example_runs():
+    """examples/multichip.py completes on the virtual mesh."""
+    argv, sys.argv = sys.argv, ["multichip"]
+    path_snapshot = list(sys.path)
+    try:
+        runpy.run_path(
+            os.path.join(_EXAMPLES, "multichip.py"), run_name="__main__"
+        )
+    finally:
+        sys.argv = argv
+        sys.path[:] = path_snapshot

@@ -195,3 +195,30 @@ class TestOrbaxBackend:
         with pytest.raises(RuntimeError, match="staged"):
             save_state_orbax(st, mgr, step=1)
         mgr.close()
+
+
+def test_restore_checkpoint_missing_new_columns(tmp_path):
+    """A checkpoint written before a column existed (e.g. pre-quarantine
+    `agents.quarantine_until`) restores with fresh defaults for the
+    missing column and intact data for the rest."""
+    import numpy as np
+
+    st = _populated_state()
+    target = save_state(st, tmp_path, step=7)
+
+    # Rewrite tables.npz without the new column, simulating an old save.
+    path = target / "tables.npz"
+    data = dict(np.load(path))
+    removed = data.pop("agents.quarantine_until")
+    assert removed is not None
+    with open(path, "wb") as f:
+        np.savez(f, **data)
+
+    back = restore_state(target)
+    np.testing.assert_array_equal(
+        np.asarray(back.agents.sigma_eff), np.asarray(st.agents.sigma_eff)
+    )
+    # Missing column came back as its freshly-created default (zeros).
+    assert not np.asarray(back.agents.quarantine_until).any()
+    # And the restored state still ticks.
+    assert back.quarantine_tick(now=1.0) == []

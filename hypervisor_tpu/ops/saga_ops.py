@@ -134,8 +134,15 @@ def saga_table_tick(
     cursor: jnp.ndarray,        # i32[G]
     exec_success: jnp.ndarray,  # bool[G] outcome for each saga's cursor step
     undo_success: jnp.ndarray,  # bool[G] outcome for the compensation target
+    exec_attempted: jnp.ndarray | None = None,  # bool[G] cursor step dispatched
+    undo_attempted: jnp.ndarray | None = None,  # bool[G] undo target dispatched
 ):
     """Advance EVERY saga in the table by one scheduling round.
+
+    The `*_attempted` masks name the sagas the host actually dispatched
+    this round; undispatched sagas are left untouched (e.g. a fan-out
+    group front handled by `fanout_round` in the same round). None means
+    "every eligible saga was dispatched" — the pre-fan-out contract.
 
     Forward phase (RUNNING sagas, reference `saga/orchestrator.py:104-138`):
     the cursor step books its executor outcome — COMMITTED on success
@@ -156,6 +163,11 @@ def saga_table_tick(
     rows = jnp.arange(g, dtype=jnp.int32)
     cols = jnp.arange(m, dtype=jnp.int32)[None, :]
 
+    if exec_attempted is None:
+        exec_attempted = jnp.ones((g,), bool)
+    if undo_attempted is None:
+        undo_attempted = jnp.ones((g,), bool)
+
     running = saga_state == SAGA_RUNNING
     # Compensation acts only on sagas that entered this round already
     # COMPENSATING: the host ran undo executors for exactly those, so a
@@ -166,7 +178,7 @@ def saga_table_tick(
     # ── forward: book the cursor step's outcome ──────────────────────────
     cur = jnp.clip(cursor, 0, m - 1)
     cur_state = step_state[rows, cur]
-    attempt = running & in_range & (cur_state == STEP_PENDING)
+    attempt = running & in_range & (cur_state == STEP_PENDING) & exec_attempted
     committed = attempt & exec_success
     exhausted = attempt & ~exec_success & (retries_left[rows, cur] <= 0)
     retrying = attempt & ~exec_success & (retries_left[rows, cur] > 0)
@@ -194,7 +206,7 @@ def saga_table_tick(
     is_committed = step_state == STEP_COMMITTED
     # Highest committed column per saga (-1 when none remain).
     target = jnp.max(jnp.where(is_committed, cols, -1), axis=1)
-    has_target = compensating & (target >= 0)
+    has_target = compensating & (target >= 0) & undo_attempted
     tcol = jnp.clip(target, 0, m - 1)
     undo_ok = has_target & has_undo[rows, tcol] & undo_success
     step_state = step_state.at[rows, tcol].set(
@@ -242,3 +254,41 @@ def fanout_policy_check(
         wins == total,
         jnp.where(policy == 1, wins * 2 > total, wins >= 1),
     )
+
+
+def fanout_round(
+    step_state: jnp.ndarray,    # i8[G, M]
+    saga_state: jnp.ndarray,    # i8[G]
+    cursor: jnp.ndarray,        # i32[G]
+    group: jnp.ndarray,         # bool[G, M] branch membership of the active group
+    active: jnp.ndarray,        # bool[G] sagas settling a fan-out group now
+    exec_success: jnp.ndarray,  # bool[G, M] branch outcomes
+    policy: jnp.ndarray,        # i8[G] 0=ALL 1=MAJORITY 2=ANY
+):
+    """Settle one fan-out group per active saga in a single program.
+
+    Branch semantics mirror `saga/fan_out.py:110-179`: every branch ran
+    concurrently exactly once (no per-branch retries), successes commit,
+    failures fail. Policy satisfied -> the cursor jumps past the group
+    and the saga keeps RUNNING (minority failures stay FAILED behind the
+    cursor). Policy violated -> the saga flips to COMPENSATING; the
+    committed branches are exactly the reference's `compensation_needed`
+    set and unwind through the normal reverse walk.
+    """
+    in_group = active[:, None] & group
+    new_step = jnp.where(
+        in_group & exec_success,
+        STEP_COMMITTED,
+        jnp.where(in_group & ~exec_success, STEP_FAILED, step_state),
+    ).astype(step_state.dtype)
+
+    ok = fanout_policy_check(exec_success, in_group, policy)
+
+    m = step_state.shape[1]
+    cols = jnp.arange(m, dtype=jnp.int32)[None, :]
+    group_end = jnp.max(jnp.where(group, cols, -1), axis=1)  # i32[G]
+    new_cursor = jnp.where(active & ok, group_end + 1, cursor).astype(cursor.dtype)
+    new_saga = jnp.where(
+        active & ~ok, jnp.int8(SAGA_COMPENSATING), saga_state
+    ).astype(saga_state.dtype)
+    return new_step, new_saga, new_cursor

@@ -104,6 +104,10 @@ def host_metadata(state: HypervisorState) -> dict:
             str(k): [int(w) for w in v] for k, v in state._chain_seed.items()
         },
         "turns": {str(k): v for k, v in state._turns.items()},
+        "fanout_groups": {
+            str(slot): [[policy, idxs] for policy, idxs in groups]
+            for slot, groups in state._fanout_groups.items()
+        },
         # Capacity fields are validated at restore: array shapes come from
         # the npz while slot allocation uses the live config, so a
         # capacity mismatch must fail loudly, not corrupt silently.
@@ -241,6 +245,10 @@ def _rebuild(data, meta: dict, config: HypervisorConfig) -> HypervisorState:
         for k, v in meta.get("chain_seed", {}).items()
     }
     state._turns = {int(k): int(v) for k, v in meta.get("turns", {}).items()}
+    state._fanout_groups = {
+        int(slot): [(int(policy), [int(i) for i in idxs]) for policy, idxs in groups]
+        for slot, groups in meta.get("fanout_groups", {}).items()
+    }
     state._free_agent_slots = [
         int(r) for r in meta.get("free_agent_slots", [])
     ]

@@ -111,9 +111,15 @@ class SagaScheduler:
             )
         step_val = int(np.asarray(sagas.step_state)[saga_slot, step_idx])
         saga_val = int(np.asarray(sagas.saga_state)[saga_slot])
+        cursor_val = int(np.asarray(sagas.cursor)[saga_slot])
         if (
             step_val == saga_ops.STEP_FAILED
             and saga_val == saga_ops.SAGA_RUNNING
+            # Only a step the cursor walk can still reach is rearmable. A
+            # FAILED fan-out minority branch BEHIND the cursor (policy
+            # passed without it) stays FAILED: rearming it would promise a
+            # substitute execution that no dispatcher ever issues.
+            and step_idx >= cursor_val
         ):
             sagas = replace(
                 sagas,
@@ -160,35 +166,47 @@ class SagaScheduler:
         return rewired
 
     async def run_until_settled(self, max_rounds: int = 1000) -> None:
-        """Round-run the table until every saga reaches a terminal state."""
+        """Round-run the table until every saga reaches a terminal state.
+
+        Each round dispatches, CONCURRENTLY: the cursor step of every
+        sequential RUNNING saga, every branch of every fan-out group
+        front (`HypervisorState.fanout_dispatch`), and every
+        compensation target. Sequential/compensation outcomes book via
+        `saga_round`; fan-out branches settle as whole groups in one
+        `fanout_settle` program (policy check on device).
+        """
         state = self._state
         for _ in range(max_rounds):
             if state.sagas_settled():
                 return
             execute, compensate = state.saga_work()
+            branches = state.fanout_dispatch()
             timeouts = np.asarray(state.sagas.timeout)
 
-            exec_out = dict(
-                zip(
-                    (slot for slot, _ in execute),
-                    await asyncio.gather(
-                        *(
-                            self._attempt(self._execute.get((slot, idx)), slot, idx, timeouts)
-                            for slot, idx in execute
-                        )
-                    ),
-                )
+            exec_res, branch_res, undo_res = await asyncio.gather(
+                asyncio.gather(
+                    *(
+                        self._attempt(self._execute.get((slot, idx)), slot, idx, timeouts)
+                        for slot, idx in execute
+                    )
+                ),
+                asyncio.gather(
+                    *(
+                        self._attempt(self._execute.get((slot, idx)), slot, idx, timeouts)
+                        for slot, idx in branches
+                    )
+                ),
+                asyncio.gather(
+                    *(
+                        self._attempt(self._undo.get((slot, idx)), slot, idx, timeouts, undo=True)
+                        for slot, idx in compensate
+                    )
+                ),
             )
-            undo_out = dict(
-                zip(
-                    (slot for slot, _ in compensate),
-                    await asyncio.gather(
-                        *(
-                            self._attempt(self._undo.get((slot, idx)), slot, idx, timeouts, undo=True)
-                            for slot, idx in compensate
-                        )
-                    ),
-                )
+            exec_out = {slot: ok for (slot, _), ok in zip(execute, exec_res)}
+            undo_out = {slot: ok for (slot, _), ok in zip(compensate, undo_res)}
+            state.fanout_settle(
+                {pair: ok for pair, ok in zip(branches, branch_res)}
             )
             state.saga_round(exec_out, undo_out)
         raise RuntimeError(f"sagas not settled after {max_rounds} rounds")

@@ -61,6 +61,7 @@ _BREACH_SWEEP = jax.jit(security_ops.breach_sweep)
 _ELEV_EXPIRY = jax.jit(security_ops.elevation_expiry)
 _QUAR_ENTER = jax.jit(security_ops.quarantine_enter)
 _QUAR_SWEEP = jax.jit(security_ops.quarantine_sweep)
+_FANOUT_ROUND = jax.jit(saga_ops.fanout_round)
 _EFF_RINGS = jax.jit(security_ops.effective_rings)
 
 
@@ -90,6 +91,9 @@ class HypervisorState:
         # row was reclaimed; the facade drains this to detach exactly
         # those mirror entries (pop_scrubbed_edges).
         self._scrubbed_edges: list[int] = []
+        # Fan-out groups per saga slot: [(policy_code, [branch idxs])],
+        # ordered by first branch index (from create_saga_from_dsl).
+        self._fanout_groups: dict[int, list[tuple[int, list[int]]]] = {}
         self._next_elev_slot = 0
         self._free_elev_slots: list[int] = []
         self._members: dict[tuple[int, int], bool] = {}  # (session, did) -> True
@@ -556,9 +560,13 @@ class HypervisorState:
 
         Bridges the declarative DSL (`saga/dsl.py`) to the device
         scheduler: step order, retry budgets, undo availability, and
-        timeouts come straight from the definition.
+        timeouts come straight from the definition. Fan-out groups
+        register their branch indices + policy so the scheduler
+        dispatches the whole group concurrently and settles it with one
+        `ops.saga_ops.fanout_round` (reference `saga/fan_out.py`
+        semantics; branches do not retry).
         """
-        return self.create_saga(
+        slot = self.create_saga(
             definition.saga_id,
             session_slot,
             [
@@ -569,6 +577,113 @@ class HypervisorState:
                 }
                 for step in definition.steps
             ],
+        )
+        idx_of = {step.id: i for i, step in enumerate(definition.steps)}
+        groups = [
+            (fo.policy.code, sorted(idx_of[sid] for sid in fo.branch_step_ids))
+            for fo in getattr(definition, "fan_outs", ())
+        ]
+        for _, idxs in groups:
+            # The device schedule is cursor-ordered: a group's branches
+            # must be consecutive steps, or the cursor jump past the
+            # group would silently skip interleaved sequential steps.
+            if idxs != list(range(idxs[0], idxs[0] + len(idxs))):
+                raise ValueError(
+                    "fan-out branches must be consecutive steps in the "
+                    f"definition for device scheduling; got indices {idxs}. "
+                    "Reorder the steps so each group's branches are "
+                    "adjacent (host FanOutOrchestrator has no such "
+                    "constraint)."
+                )
+        if groups:
+            self._fanout_groups[slot] = sorted(groups, key=lambda g: g[1][0])
+        return slot
+
+    # ── fan-out groups (device-scheduled) ────────────────────────────
+
+    def _active_group(
+        self,
+        slot: int,
+        cursor_host: Optional[np.ndarray] = None,
+        state_host: Optional[np.ndarray] = None,
+    ) -> Optional[tuple[int, list[int]]]:
+        """The fan-out group whose first branch is this saga's cursor, if
+        the saga is RUNNING and the group hasn't been dispatched yet.
+
+        Callers in the scheduling loop pass prefetched host copies of the
+        cursor/state columns (one device sync per round, not per slot).
+        """
+        groups = self._fanout_groups.get(slot)
+        if not groups:
+            return None
+        if cursor_host is None:
+            cursor_host = np.asarray(self.sagas.cursor)
+        if state_host is None:
+            state_host = np.asarray(self.sagas.saga_state)
+        if int(state_host[slot]) != saga_ops.SAGA_RUNNING:
+            return None
+        cursor = int(cursor_host[slot])
+        for policy, idxs in groups:
+            if idxs[0] == cursor:
+                return policy, idxs
+        return None
+
+    def fanout_dispatch(self) -> list[tuple[int, int]]:
+        """(saga_slot, step_idx) pairs for every group front: the whole
+        group's PENDING branches dispatch concurrently."""
+        if not self._fanout_groups:
+            return []
+        out = []
+        step_state = np.asarray(self.sagas.step_state)
+        cursor_host = np.asarray(self.sagas.cursor)
+        state_host = np.asarray(self.sagas.saga_state)
+        for slot in self._fanout_groups:
+            front = self._active_group(slot, cursor_host, state_host)
+            if front is None:
+                continue
+            _, idxs = front
+            out.extend(
+                (slot, i)
+                for i in idxs
+                if step_state[slot, i] == saga_ops.STEP_PENDING
+            )
+        return out
+
+    def fanout_settle(self, outcomes: dict[tuple[int, int], bool]) -> None:
+        """Book a round of fan-out branch outcomes in one jitted program."""
+        if not outcomes:
+            return
+        g_cap, m = self.sagas.step_state.shape
+        group = np.zeros((g_cap, m), bool)
+        active = np.zeros(g_cap, bool)
+        success = np.zeros((g_cap, m), bool)
+        policy = np.zeros(g_cap, np.int8)
+        cursor_host = np.asarray(self.sagas.cursor)
+        state_host = np.asarray(self.sagas.saga_state)
+        for slot in {s for s, _ in outcomes}:
+            front = self._active_group(slot, cursor_host, state_host)
+            if front is None:
+                continue
+            pol, idxs = front
+            active[slot] = True
+            policy[slot] = pol
+            group[slot, idxs] = True
+        for (slot, idx), ok in outcomes.items():
+            success[slot, idx] = ok
+        step_state, saga_state, cursor = _FANOUT_ROUND(
+            self.sagas.step_state,
+            self.sagas.saga_state,
+            self.sagas.cursor,
+            jnp.asarray(group),
+            jnp.asarray(active),
+            jnp.asarray(success),
+            jnp.asarray(policy),
+        )
+        self.sagas = replace(
+            self.sagas,
+            step_state=step_state,
+            saga_state=saga_state,
+            cursor=cursor,
         )
 
     def saga_work(self) -> tuple[list[tuple[int, int]], list[tuple[int, int]]]:
@@ -592,6 +707,9 @@ class HypervisorState:
                 (saga_state == saga_ops.SAGA_RUNNING) & (cursor < n_steps)
             )[0]
             if step_state[s, cursor[s]] == saga_ops.STEP_PENDING
+            # Group fronts dispatch through fanout_dispatch, all branches
+            # at once, and settle via fanout_settle — not the cursor walk.
+            and self._active_group(int(s), cursor, saga_state) is None
         ]
         compensate = []
         for s in np.nonzero(saga_state == saga_ops.SAGA_COMPENSATING)[0]:
@@ -607,14 +725,23 @@ class HypervisorState:
         exec_outcomes: Optional[dict[int, bool]] = None,
         undo_outcomes: Optional[dict[int, bool]] = None,
     ) -> None:
-        """One jitted scheduling round over the whole saga table."""
+        """One jitted scheduling round over the whole saga table.
+
+        Only sagas present in the outcome dicts are booked — others
+        (e.g. fan-out group fronts settled by `fanout_settle` in the
+        same round) are left untouched by the tick.
+        """
         g_cap = self.sagas.saga_state.shape[0]
         exec_success = np.zeros(g_cap, bool)
         undo_success = np.zeros(g_cap, bool)
+        exec_attempted = np.zeros(g_cap, bool)
+        undo_attempted = np.zeros(g_cap, bool)
         for slot, ok in (exec_outcomes or {}).items():
             exec_success[slot] = ok
+            exec_attempted[slot] = True
         for slot, ok in (undo_outcomes or {}).items():
             undo_success[slot] = ok
+            undo_attempted[slot] = True
         with profiling.span("hv.saga_round"):
             step_state, retries_left, saga_state, cursor = self._saga_tick(
                 self.sagas.step_state,
@@ -625,6 +752,8 @@ class HypervisorState:
                 self.sagas.cursor,
                 jnp.asarray(exec_success),
                 jnp.asarray(undo_success),
+                jnp.asarray(exec_attempted),
+                jnp.asarray(undo_attempted),
             )
         self.sagas = replace(
             self.sagas,

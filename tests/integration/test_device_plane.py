@@ -664,3 +664,137 @@ class TestKillSwitchHandoff:
         assert (
             int(np.asarray(st.sagas.saga_state)[g]) == saga_ops.SAGA_COMPLETED
         )
+
+
+class TestDeviceFanOut:
+    """DSL fan-out groups scheduled on the device SagaTable: branches
+    dispatch concurrently, settle via ops.saga_ops.fanout_round, and
+    policy failures unwind committed branches through the reverse walk
+    (reference `saga/fan_out.py:110-179`)."""
+
+    def _definition(self, policy: str, n_branches: int = 3, tail: bool = True):
+        from hypervisor_tpu.saga.dsl import SagaDSLParser
+
+        steps = [
+            {"id": f"b{i}", "action_id": f"m.b{i}", "agent": "did:f",
+             "execute_api": f"/b{i}", "undo_api": f"/ub{i}"}
+            for i in range(n_branches)
+        ]
+        if tail:
+            steps.append(
+                {"id": "finish", "action_id": "m.finish", "agent": "did:f",
+                 "execute_api": "/fin"}
+            )
+        return SagaDSLParser().parse({
+            "name": "fan",
+            "session_id": "session:fan",
+            "steps": steps,
+            "fan_out": [{
+                "policy": policy,
+                "branches": [f"b{i}" for i in range(n_branches)],
+            }],
+        })
+
+    def _run(self, policy, branch_ok, tail=True):
+        import asyncio
+        import numpy as np
+
+        from hypervisor_tpu.models import SessionConfig
+        from hypervisor_tpu.ops import saga_ops
+        from hypervisor_tpu.runtime.saga_scheduler import SagaScheduler
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        sess = st.create_session("session:fan", SessionConfig())
+        definition = self._definition(policy, len(branch_ok), tail)
+        slot = st.create_saga_from_dsl(definition, sess)
+        sched = SagaScheduler(st, retry_backoff_seconds=0.0)
+        ran: list[str] = []
+
+        def mk(i, ok):
+            async def run():
+                ran.append(f"b{i}")
+                if not ok:
+                    raise RuntimeError("branch down")
+                return f"ok{i}"
+            return run
+
+        async def undo(i):
+            ran.append(f"undo-b{i}")
+            return "undone"
+
+        executors = {f"b{i}": mk(i, ok) for i, ok in enumerate(branch_ok)}
+        undos = {f"b{i}": (lambda i=i: undo(i)) for i in range(len(branch_ok))}
+        if tail:
+            async def fin():
+                ran.append("finish")
+                return "done"
+            executors["finish"] = fin
+        sched.register_definition(slot, definition, executors, undos=undos)
+        asyncio.run(sched.run_until_settled())
+        return st, slot, ran, saga_ops, np
+
+    def test_all_policy_success_runs_tail(self):
+        st, slot, ran, ops, np = self._run("all_must_succeed", [True, True, True])
+        assert int(np.asarray(st.sagas.saga_state)[slot]) == ops.SAGA_COMPLETED
+        # branches dispatched before the tail; all three ran
+        assert set(ran[:3]) == {"b0", "b1", "b2"} and ran[3] == "finish"
+
+    def test_all_policy_failure_compensates_winners(self):
+        st, slot, ran, ops, np = self._run("all_must_succeed", [True, False, True])
+        states = np.asarray(st.sagas.step_state)[slot]
+        # winners compensated in reverse order, loser stays FAILED,
+        # tail never ran, saga COMPLETED after clean compensation.
+        assert int(np.asarray(st.sagas.saga_state)[slot]) == ops.SAGA_COMPLETED
+        assert states[0] == ops.STEP_COMPENSATED
+        assert states[1] == ops.STEP_FAILED
+        assert states[2] == ops.STEP_COMPENSATED
+        assert "finish" not in ran
+        assert ran.index("undo-b2") < ran.index("undo-b0")  # reverse order
+
+    def test_majority_policy_tolerates_minority_failure(self):
+        st, slot, ran, ops, np = self._run(
+            "majority_must_succeed", [True, True, False]
+        )
+        assert int(np.asarray(st.sagas.saga_state)[slot]) == ops.SAGA_COMPLETED
+        states = np.asarray(st.sagas.step_state)[slot]
+        assert states[2] == ops.STEP_FAILED       # minority loss tolerated
+        assert "finish" in ran                    # saga continued past group
+
+    def test_any_policy_single_survivor(self):
+        st, slot, ran, ops, np = self._run(
+            "any_must_succeed", [False, False, True]
+        )
+        assert int(np.asarray(st.sagas.saga_state)[slot]) == ops.SAGA_COMPLETED
+        assert "finish" in ran
+
+    def test_any_policy_total_failure_compensates(self):
+        st, slot, ran, ops, np = self._run(
+            "any_must_succeed", [False, False], tail=False
+        )
+        # Nothing committed; saga settles without escalation.
+        assert int(np.asarray(st.sagas.saga_state)[slot]) == ops.SAGA_COMPLETED
+        assert "finish" not in ran
+
+    def test_non_contiguous_branches_rejected(self):
+        from hypervisor_tpu.models import SessionConfig
+        from hypervisor_tpu.saga.dsl import SagaDSLParser
+        from hypervisor_tpu.state import HypervisorState
+        import pytest
+
+        definition = SagaDSLParser().parse({
+            "name": "bad",
+            "session_id": "session:bad",
+            "steps": [
+                {"id": "b0", "action_id": "m.b0", "agent": "d", "execute_api": "/0"},
+                {"id": "mid", "action_id": "m.mid", "agent": "d", "execute_api": "/m"},
+                {"id": "b2", "action_id": "m.b2", "agent": "d", "execute_api": "/2"},
+            ],
+            "fan_out": [
+                {"policy": "all_must_succeed", "branches": ["b0", "b2"]}
+            ],
+        })
+        st = HypervisorState()
+        sess = st.create_session("session:bad", SessionConfig())
+        with pytest.raises(ValueError, match="consecutive"):
+            st.create_saga_from_dsl(definition, sess)

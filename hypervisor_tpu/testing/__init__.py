@@ -1,0 +1,5 @@
+"""Test utilities shipped with the framework (chaos injection)."""
+
+from hypervisor_tpu.testing.chaos import ChaosExecutorFactory, ChaosPlan
+
+__all__ = ["ChaosExecutorFactory", "ChaosPlan"]

@@ -1,0 +1,90 @@
+"""Chaos injection for saga executors: seeded, reproducible fault plans.
+
+The reference's fault injection is ad-hoc per test (flaky lambdas,
+injected drift scores — SURVEY §5 "no chaos framework"). This module is
+the framework-level version: a deterministic fault plan derived from a
+seed, wrapping any executor with configurable failure, timeout-hang, and
+latency behavior. Because the plan is seeded, a chaos run that surfaces
+a bug replays exactly.
+
+Usage::
+
+    chaos = ChaosExecutorFactory(ChaosPlan(seed=7, fail_rate=0.3))
+    sched.register(slot, idx, chaos.wrap(real_executor, key="step-3"))
+    ...
+    chaos.report()   # {'calls': N, 'failures': k, 'hangs': h}
+
+Faults are injected per CALL (retries roll fresh outcomes), so retry
+ladders and compensation paths genuinely exercise.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable
+
+Executor = Callable[[], Awaitable[Any]]
+
+
+class ChaosFailure(RuntimeError):
+    """Injected executor failure."""
+
+
+@dataclass(frozen=True)
+class ChaosPlan:
+    """Fault mix; rates are per-call probabilities in [0, 1]."""
+
+    seed: int = 0
+    fail_rate: float = 0.2
+    hang_rate: float = 0.0        # sleep far past the step timeout
+    latency_seconds: float = 0.0  # added to every surviving call
+    hang_seconds: float = 3600.0
+
+
+@dataclass
+class ChaosStats:
+    calls: int = 0
+    failures: int = 0
+    hangs: int = 0
+    by_key: dict = field(default_factory=dict)
+
+
+class ChaosExecutorFactory:
+    """Wraps executors with a shared, seeded fault stream."""
+
+    def __init__(self, plan: ChaosPlan) -> None:
+        self.plan = plan
+        self._rng = random.Random(plan.seed)
+        self.stats = ChaosStats()
+
+    def wrap(self, executor: Executor, key: str = "?") -> Executor:
+        async def chaotic() -> Any:
+            self.stats.calls += 1
+            per = self.stats.by_key.setdefault(
+                key, {"calls": 0, "failures": 0, "hangs": 0}
+            )
+            per["calls"] += 1
+            roll = self._rng.random()
+            if roll < self.plan.fail_rate:
+                self.stats.failures += 1
+                per["failures"] += 1
+                raise ChaosFailure(f"injected failure for {key}")
+            if roll < self.plan.fail_rate + self.plan.hang_rate:
+                self.stats.hangs += 1
+                per["hangs"] += 1
+                await asyncio.sleep(self.plan.hang_seconds)
+            if self.plan.latency_seconds:
+                await asyncio.sleep(self.plan.latency_seconds)
+            return await executor()
+
+        return chaotic
+
+    def report(self) -> dict:
+        return {
+            "calls": self.stats.calls,
+            "failures": self.stats.failures,
+            "hangs": self.stats.hangs,
+            "by_key": dict(self.stats.by_key),
+        }

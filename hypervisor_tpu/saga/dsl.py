@@ -188,6 +188,26 @@ class SagaDSLParser:
         assert spec is not None  # _Problems raised on any problem
         return spec
 
+    def parse_yaml(self, text: str) -> SagaDefinition:
+        """Parse a YAML document (the reference advertises dict/YAML but
+        ships dict-only; this is the YAML half). Uses yaml.safe_load —
+        definitions are data, never code."""
+        try:
+            import yaml
+        except ImportError as e:  # pragma: no cover - pyyaml in our images
+            raise SagaDSLError(
+                "YAML definitions need pyyaml; pass a dict to parse() instead"
+            ) from e
+        try:
+            loaded = yaml.safe_load(text)
+        except yaml.YAMLError as e:
+            raise SagaDSLError(f"Invalid YAML: {e}") from e
+        if not isinstance(loaded, dict):
+            raise SagaDSLError(
+                f"YAML document must be a mapping, got {type(loaded).__name__}"
+            )
+        return self.parse(loaded)
+
     @staticmethod
     def validate(definition: dict[str, Any]) -> list[str]:
         """Collect every structural problem without raising (empty = valid)."""

@@ -296,3 +296,46 @@ class TestBatchedSagaOps:
             saga_ops.SAGA_ESCALATED,
             saga_ops.SAGA_COMPLETED,
         ]
+
+
+class TestYAMLDSL:
+    YAML = """
+name: deploy
+session_id: session:test-1
+steps:
+  - id: validate
+    action_id: m.validate
+    agent: did:v
+    execute_api: /v
+    undo_api: /uv
+  - id: ship-a
+    action_id: m.ship
+    agent: did:a
+    execute_api: /a
+  - id: ship-b
+    action_id: m.ship
+    agent: did:b
+    execute_api: /b
+fan_out:
+  - policy: majority_must_succeed
+    branches: [ship-a, ship-b]
+"""
+
+    def test_parse_yaml_roundtrip(self):
+        parsed = SagaDSLParser().parse_yaml(self.YAML)
+        assert parsed.name == "deploy"
+        assert [s.id for s in parsed.steps] == ["validate", "ship-a", "ship-b"]
+        assert parsed.fan_outs[0].policy is FanOutPolicy.MAJORITY_MUST_SUCCEED
+        assert parsed.fan_out_step_ids == {"ship-a", "ship-b"}
+
+    def test_parse_yaml_rejects_non_mapping(self):
+        with pytest.raises(SagaDSLError, match="mapping"):
+            SagaDSLParser().parse_yaml("- just\n- a list\n")
+
+    def test_parse_yaml_rejects_bad_yaml(self):
+        with pytest.raises(SagaDSLError, match="Invalid YAML"):
+            SagaDSLParser().parse_yaml("name: [unclosed\n  - x:")
+
+    def test_yaml_validation_errors_surface(self):
+        with pytest.raises(SagaDSLError, match="at least one step"):
+            SagaDSLParser().parse_yaml("name: x\nsession_id: s\nsteps: []\n")

@@ -303,6 +303,47 @@ async def demo_device_plane() -> None:
     print(f"saga table: 3 DSL steps, 1 retry absorbed, final state code "
           f"{state_name} (2 = COMPLETED)")
 
+    # Fan-out on device: concurrent branches, MAJORITY policy settled by
+    # one fanout_round program; the minority loss stays behind the cursor.
+    fan_def = SagaDSLParser().parse_yaml("""
+name: canary
+session_id: demo:saga
+steps:
+  - {id: region-a, action_id: m.c, agent: did:a, execute_api: /a}
+  - {id: region-b, action_id: m.c, agent: did:b, execute_api: /b}
+  - {id: region-c, action_id: m.c, agent: did:c, execute_api: /c}
+  - {id: promote, action_id: m.p, agent: did:p, execute_api: /p}
+fan_out:
+  - {policy: majority_must_succeed, branches: [region-a, region-b, region-c]}
+""")
+    fg = st2.create_saga_from_dsl(fan_def, sslot)
+    ran: list[str] = []
+
+    def region(name, ok_flag):
+        async def run():
+            ran.append(name)
+            if not ok_flag:
+                raise RuntimeError(f"{name} down")
+            return name
+        return run
+
+    async def run_fan():
+        sched.register_definition(
+            fg, fan_def,
+            executors={
+                "region-a": region("region-a", True),
+                "region-b": region("region-b", True),
+                "region-c": region("region-c", False),
+                "promote": region("promote", True),
+            },
+        )
+        await sched.run_until_settled()
+
+    await run_fan()
+    fan_state = int(np.asarray(st2.sagas.saga_state)[fg])
+    print(f"fan-out: 3 branches concurrent, 1 region down, MAJORITY passed "
+          f"-> promote ran ({'promote' in ran}), saga state {fan_state}")
+
     # Write wave: rate limit + vector-clock causal gate before the VFS.
     wave = WriteWave(SessionVFS("demo:wr"))
     wave.submit("did:w1", "/plan.md", "v1", ring=2)

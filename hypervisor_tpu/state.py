@@ -1011,10 +1011,31 @@ class HypervisorState:
 
     def _claim_rows(self, rows: np.ndarray, owners: np.ndarray) -> None:
         """Transfer DeltaLog row ownership; evict recycled rows from the
-        audit index of whichever sessions owned them before the wrap."""
+        audit index of whichever sessions owned them before the wrap.
+
+        Recycling a LIVE session's rows is refused loudly: silently
+        dropping its earliest leaves would shrink its Merkle tree and
+        surface much later as an inscrutable device/host root divergence
+        at terminate. Archived sessions' rows recycle freely.
+        """
         prior = self._row_session[rows]
         recycled = np.unique(prior[prior >= 0])
         if len(recycled):
+            sess_state = np.asarray(self.sessions.state)
+            archived = SessionState.ARCHIVED.code
+            live = [
+                int(s)
+                for s in recycled
+                if self._audit_rows.get(int(s))
+                and sess_state[int(s)] != archived
+            ]
+            if live:
+                raise RuntimeError(
+                    f"delta log wrapped into live session slot(s) {live}; "
+                    "their audit trails would lose leaves. Raise "
+                    "config.capacity.delta_log_capacity or terminate "
+                    "sessions before their logs are overwritten."
+                )
             doomed = set(rows.tolist())
             for sess in recycled:
                 kept = self._audit_rows.get(int(sess))

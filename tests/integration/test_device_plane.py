@@ -798,3 +798,63 @@ class TestDeviceFanOut:
         sess = st.create_session("session:bad", SessionConfig())
         with pytest.raises(ValueError, match="consecutive"):
             st.create_saga_from_dsl(definition, sess)
+
+
+class TestDeltaLogWrapGuard:
+    def _small_log_state(self, capacity=8):
+        import dataclasses
+
+        from hypervisor_tpu.config import DEFAULT_CONFIG
+        from hypervisor_tpu.state import HypervisorState
+
+        config = dataclasses.replace(
+            DEFAULT_CONFIG,
+            capacity=dataclasses.replace(
+                DEFAULT_CONFIG.capacity, delta_log_capacity=capacity
+            ),
+        )
+        return HypervisorState(config)
+
+    def test_wrap_into_live_session_refused(self):
+        import numpy as np
+        import pytest
+
+        from hypervisor_tpu.models import SessionConfig
+        from hypervisor_tpu.ops.sha256 import hex_to_words
+
+        st = self._small_log_state(capacity=8)
+        slot = st.create_session("session:wrapA", SessionConfig())
+        st.enqueue_join(slot, "did:w", sigma_raw=0.8)
+        assert (st.flush_joins() == 0).all()
+        digest = hex_to_words(["ab" * 32])[0]
+        for _ in range(8):
+            st.stage_delta(slot, 0, ts=0.0, digest_words=digest)
+        st.flush_deltas()
+        # The 9th delta would recycle the live session's own first row.
+        st.stage_delta(slot, 0, ts=0.0, digest_words=digest)
+        with pytest.raises(RuntimeError, match="delta log wrapped into live"):
+            st.flush_deltas()
+
+    def test_archived_rows_recycle_silently(self):
+        import numpy as np
+
+        from hypervisor_tpu.models import SessionConfig
+        from hypervisor_tpu.ops.sha256 import hex_to_words
+
+        st = self._small_log_state(capacity=8)
+        digest = hex_to_words(["cd" * 32])[0]
+        a = st.create_session("session:wrapB", SessionConfig())
+        st.enqueue_join(a, "did:a", sigma_raw=0.8)
+        assert (st.flush_joins() == 0).all()
+        for _ in range(8):
+            st.stage_delta(a, 0, ts=0.0, digest_words=digest)
+        st.flush_deltas()
+        st.terminate_sessions([a], now=1.0)   # archived -> rows reusable
+
+        b = st.create_session("session:wrapC", SessionConfig())
+        st.enqueue_join(b, "did:b", sigma_raw=0.8)
+        assert (st.flush_joins() == 0).all()
+        for _ in range(6):
+            st.stage_delta(b, 1, ts=2.0, digest_words=digest)
+        st.flush_deltas()                      # wraps over A's rows: fine
+        assert len(st._audit_rows.get(b, [])) == 6

@@ -171,3 +171,70 @@ class TestSSOVFSIntegration:
         a, b = self._active_sso(), self._active_sso()
         a.vfs.write("/only-in-a", "1", "did:a")
         assert b.vfs.read("/only-in-a") is None
+
+
+class TestNamespaceAndInventory:
+    """Discrete reference behaviors (`test_vfs_substrate.py`) not covered
+    by the merged scenarios above."""
+
+    def test_list_files_and_count(self, vfs):
+        assert vfs.list_files() == [] and vfs.file_count == 0
+        vfs.write("/a.md", "1", "did:w")
+        vfs.write("/b/c.md", "2", "did:w")
+        assert sorted(vfs.list_files()) == ["/a.md", "/b/c.md"]
+        assert vfs.file_count == 2
+        vfs.delete("/a.md", "did:w")
+        assert vfs.list_files() == ["/b/c.md"] and vfs.file_count == 1
+
+    def test_custom_namespace(self):
+        from hypervisor_tpu.session.vfs import SessionVFS
+
+        vfs = SessionVFS("session:x", namespace="/tenants/acme")
+        vfs.write("/doc", "hi", "did:w")
+        assert vfs.namespace == "/tenants/acme"
+        assert vfs.read("/doc") == "hi"
+        assert vfs.list_files() == ["/doc"]
+
+    def test_absolute_path_within_namespace_resolves(self, vfs):
+        vfs.write("/plan.md", "v1", "did:w")
+        absolute = f"{vfs.namespace}/plan.md"
+        assert vfs.read(absolute) == "v1"
+
+    def test_edits_by_agent_empty(self, vfs):
+        vfs.write("/x", "1", "did:w")
+        assert vfs.edits_by_agent("did:ghost") == []
+
+    def test_snapshot_of_empty_vfs_restores_empty(self, vfs):
+        snap = vfs.create_snapshot()
+        vfs.write("/later", "x", "did:w")
+        vfs.restore_snapshot(snap, "did:w")
+        assert vfs.file_count == 0
+
+    def test_multiple_snapshots_restore_independently(self, vfs):
+        vfs.write("/f", "one", "did:w")
+        s1 = vfs.create_snapshot()
+        vfs.write("/f", "two", "did:w")
+        s2 = vfs.create_snapshot()
+        vfs.write("/f", "three", "did:w")
+        vfs.restore_snapshot(s1, "did:w")
+        assert vfs.read("/f") == "one"
+        vfs.restore_snapshot(s2, "did:w")
+        assert vfs.read("/f") == "two"
+
+    def test_restore_through_sso_requires_active(self):
+        import pytest
+
+        from hypervisor_tpu.models import SessionConfig
+        from hypervisor_tpu.session import (
+            SessionLifecycleError,
+            SharedSessionObject,
+        )
+
+        sso = SharedSessionObject(SessionConfig(), creator_did="did:c")
+        sso.begin_handshake()
+        sso.join("did:a", sigma_raw=0.8, sigma_eff=0.8)
+        sso.activate()
+        snap = sso.create_vfs_snapshot()
+        sso.terminate()
+        with pytest.raises(SessionLifecycleError):
+            sso.restore_vfs_snapshot(snap, "did:a")

@@ -292,6 +292,41 @@ class Hypervisor:
         )
         return ring
 
+    async def leave_session(self, session_id: str, agent_did: str) -> None:
+        """Remove a participant from both planes.
+
+        The reference exposes leave only on the SSO (`session/__init__.py
+        leave`); here the facade keeps the device tables coherent: the
+        host participant deactivates, the agent's device row frees, the
+        session count drops, and the leaver's mirrored vouch edges scrub
+        (bonds survive host-side and re-mirror on a later join).
+        """
+        from hypervisor_tpu.session import SessionParticipantError
+
+        managed = self._require(session_id)
+        # Validate BOTH planes before mutating either: a refusal after
+        # sso.leave would leave the host saying "gone" while the device
+        # still counts the agent — an unrepairable divergence.
+        participant = managed.sso.get_participant(agent_did)  # raises ghost
+        if not participant.is_active:
+            raise SessionParticipantError(
+                f"Agent {agent_did} already left session"
+            )
+        row = self.state.agent_row(agent_did)
+        if row is None or row["session"] != managed.slot:
+            raise RuntimeError(
+                f"{agent_did}'s device row belongs to a later join in "
+                "another session; leave that session first (one device "
+                "row per agent — its most recent join)"
+            )
+        managed.sso.leave(agent_did)
+        self.state.leave_agent(managed.slot, agent_did)
+        scrubbed = set(self.state.pop_scrubbed_edges())
+        if scrubbed:
+            for vouch_id, edge in list(self._edge_of_vouch.items()):
+                if edge in scrubbed:
+                    del self._edge_of_vouch[vouch_id]
+
     async def activate_session(self, session_id: str) -> None:
         managed = self._require(session_id)
         managed.sso.activate()

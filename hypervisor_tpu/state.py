@@ -457,6 +457,58 @@ class HypervisorState:
         out, self._scrubbed_edges = self._scrubbed_edges, []
         return out
 
+    def leave_agent(self, session_slot: int, agent_did: str) -> None:
+        """Remove one agent from its session on the device plane.
+
+        Mirrors `SharedSessionObject.leave` (participant deactivates,
+        count drops; membership stays recorded so a rejoin is still a
+        duplicate). The agent row returns to the free list and any vouch
+        edges referencing it are scrubbed (same slot-reuse hazard as
+        terminate-time reclamation; bonds survive host-side and
+        re-mirror if the agent joins again).
+        """
+        # The whole mutation holds the staging lock, matching flush_joins:
+        # an interleaved table read-modify-write from a concurrent flusher
+        # would lose the deactivation while the slot is already freed.
+        with self._enqueue_lock:
+            row = self.agent_row(agent_did)
+            if row is None or row["session"] != session_slot:
+                raise ValueError(
+                    f"{agent_did} holds no active device row in session slot "
+                    f"{session_slot}"
+                )
+            slot = row["slot"]
+            self.agents = replace(
+                self.agents,
+                flags=self.agents.flags.at[slot].set(
+                    self.agents.flags[slot] & ~FLAG_ACTIVE
+                ),
+            )
+            self.sessions = replace(
+                self.sessions,
+                n_participants=self.sessions.n_participants.at[
+                    session_slot
+                ].add(-1),
+            )
+            did = int(np.asarray(self.agents.did)[slot])
+            if self._slot_of_did.get(did) == slot:
+                del self._slot_of_did[did]
+            self._free_agent_slots.append(slot)
+
+            voucher = np.asarray(self.vouches.voucher)
+            vouchee = np.asarray(self.vouches.vouchee)
+            dangling = np.asarray(self.vouches.active) & (
+                (voucher == slot) | (vouchee == slot)
+            )
+            rows = np.nonzero(dangling)[0]
+            if len(rows):
+                self.vouches = replace(
+                    self.vouches,
+                    active=self.vouches.active.at[jnp.asarray(rows)].set(False),
+                )
+                self.free_edge_rows(rows)
+                self._scrubbed_edges.extend(int(r) for r in rows)
+
     def to_device_time(self, absolute_ts: float) -> float:
         """Absolute unix seconds -> this state's epoch-relative f32 time."""
         return absolute_ts - self._epoch_base

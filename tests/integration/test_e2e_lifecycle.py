@@ -215,3 +215,85 @@ class TestGCIntegration:
         hv.vouching.vouch("did:mesh:v", "did:mesh:agent-0", sid1, 0.9, bond_pct=0.5)
         assert hv.vouching.get_total_exposure("did:mesh:v", sid1) > 0
         assert hv.vouching.get_total_exposure("did:mesh:v", sid2) == 0.0
+
+
+class TestLeaveSession:
+    async def test_leave_updates_both_planes(self):
+        import numpy as np
+
+        from hypervisor_tpu import Hypervisor, SessionConfig
+        from hypervisor_tpu.session import SessionParticipantError
+        import pytest
+
+        hv = Hypervisor()
+        ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+        sid = ms.sso.session_id
+        await hv.join_session(sid, "did:stay", sigma_raw=0.8)
+        await hv.join_session(sid, "did:go", sigma_raw=0.8)
+        going = hv.state.agent_row("did:go")
+
+        await hv.leave_session(sid, "did:go")
+
+        # Host: participant inactive, count dropped.
+        assert ms.sso.participant_count == 1
+        assert not ms.sso._participants["did:go"].is_active
+        # Device: row freed, count matches.
+        assert hv.state.agent_row("did:go") is None
+        assert (
+            int(np.asarray(hv.state.sessions.n_participants)[ms.slot]) == 1
+        )
+        assert going["slot"] in hv.state._free_agent_slots
+        # Leave is terminal for the session: rejoin is a duplicate.
+        with pytest.raises(SessionParticipantError):
+            await hv.join_session(sid, "did:go", sigma_raw=0.9)
+        # Unknown agent refuses with the reference error.
+        with pytest.raises(SessionParticipantError):
+            await hv.leave_session(sid, "did:ghost")
+
+    async def test_leaver_edges_scrub_and_remirror(self):
+        import numpy as np
+
+        from hypervisor_tpu import Hypervisor, SessionConfig
+
+        hv = Hypervisor()
+        ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+        sid = ms.sso.session_id
+        await hv.join_session(sid, "did:voucher", sigma_raw=0.9)
+        await hv.join_session(sid, "did:vouchee", sigma_raw=0.7)
+        hv.vouching.vouch("did:voucher", "did:vouchee", sid, voucher_sigma=0.9)
+        assert int(np.asarray(hv.state.vouches.active).sum()) == 1
+
+        await hv.leave_session(sid, "did:voucher")
+        # Device edge scrubbed (its voucher row was freed)...
+        assert int(np.asarray(hv.state.vouches.active).sum()) == 0
+        # ...host bond survives...
+        assert len(hv.vouching.get_vouchers_for("did:vouchee", sid)) == 1
+        # ...and re-mirrors when the voucher becomes resident again.
+        ms2 = await hv.create_session(SessionConfig(), creator_did="did:lead")
+        await hv.join_session(ms2.sso.session_id, "did:voucher", sigma_raw=0.9)
+        assert int(np.asarray(hv.state.vouches.active).sum()) == 1
+
+    async def test_double_leave_and_cross_session_refusals_mutate_nothing(self):
+        import numpy as np
+        import pytest
+
+        from hypervisor_tpu import Hypervisor, SessionConfig
+        from hypervisor_tpu.session import SessionParticipantError
+
+        hv = Hypervisor()
+        a = await hv.create_session(SessionConfig(), creator_did="did:lead")
+        b = await hv.create_session(SessionConfig(), creator_did="did:lead")
+        await hv.join_session(a.sso.session_id, "did:x", sigma_raw=0.8)
+        await hv.join_session(b.sso.session_id, "did:x", sigma_raw=0.8)
+
+        # The device row belongs to the LATER join (session b): leaving a
+        # must refuse BEFORE mutating the host plane.
+        with pytest.raises(RuntimeError, match="later join"):
+            await hv.leave_session(a.sso.session_id, "did:x")
+        assert a.sso.get_participant("did:x").is_active
+        assert int(np.asarray(hv.state.sessions.n_participants)[a.slot]) == 1
+
+        # Leave b, then a (row now gone; a-leave refuses cleanly too).
+        await hv.leave_session(b.sso.session_id, "did:x")
+        with pytest.raises(SessionParticipantError):
+            await hv.leave_session(b.sso.session_id, "did:x")  # double leave

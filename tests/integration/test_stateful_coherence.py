@@ -99,6 +99,22 @@ class PlaneCoherence(RuleBasedStateMachine):
         except Exception:
             pass  # cycle/exposure refusals are fine
 
+    @precondition(lambda self: any(self.joined.values()))
+    @rule(pick=st.integers(0, 3))
+    def leave(self, pick):
+        sids = [s for s in self.sessions if self.joined[s]]
+        if not sids:
+            return
+        sid = sids[pick % len(sids)]
+        agent = sorted(self.joined[sid])[0]
+        row = self.hv.state.agent_row(agent)
+        if row is None or row["session"] != self.hv.get_session(sid).slot:
+            # The agent's single device row belongs to a later join in
+            # another session; facade leave would refuse. Skip.
+            return
+        self.go(self.hv.leave_session(sid, agent))
+        self.joined[sid].discard(agent)
+
     @precondition(lambda self: self.sessions)
     @rule(pick=st.integers(0, 3))
     def terminate(self, pick):

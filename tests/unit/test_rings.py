@@ -238,7 +238,6 @@ class TestRingGapParity:
         # Back-date expiry (reference tests expire without sleeping).
         from datetime import timedelta
 
-        object.__setattr__  # dataclass not frozen; direct assignment works
         g.expires_at = g.granted_at - timedelta(seconds=1)
         expired = mgr.tick()
         assert [e.elevation_id for e in expired] == [g.elevation_id]

@@ -327,6 +327,43 @@ class Hypervisor:
                 if edge in scrubbed:
                     del self._edge_of_vouch[vouch_id]
 
+    async def update_agent_ring(
+        self,
+        session_id: str,
+        agent_did: str,
+        new_ring: ExecutionRing,
+        reason: str = "",
+    ) -> None:
+        """Reassign a participant's ring on BOTH planes.
+
+        The reference exposes ring updates only on the SSO
+        (`session/__init__.py update_ring`); the facade version also
+        rewrites the device row (ring column + rate-limit bucket
+        recreated at the new ring's burst) and emits RING_DEMOTED /
+        RING_ELEVATED.
+        """
+        managed = self._require(session_id)
+        before = managed.sso.get_participant(agent_did).ring
+        managed.sso.update_ring(agent_did, new_ring)
+        row = self.state.agent_row(agent_did)
+        if row is not None and row["session"] == managed.slot:
+            self.state.set_agent_ring(
+                row["slot"], new_ring.value, now=self.state.now()
+            )
+        if new_ring.value != before.value:
+            self._emit(
+                EventType.RING_DEMOTED
+                if new_ring.value > before.value
+                else EventType.RING_ELEVATED,
+                session_id=session_id,
+                agent_did=agent_did,
+                payload={
+                    "from": before.value,
+                    "to": new_ring.value,
+                    "reason": reason,
+                },
+            )
+
     async def activate_session(self, session_id: str) -> None:
         managed = self._require(session_id)
         managed.sso.activate()

@@ -935,6 +935,23 @@ class HypervisorState:
         """bool[N]: rows currently in read-only isolation."""
         return (np.asarray(self.agents.flags) & FLAG_QUARANTINED) != 0
 
+    def set_agent_ring(self, slot: int, ring: int, now: float) -> None:
+        """Reassign a device row's ring (demotion/promotion).
+
+        The rate-limit bucket recreates FULL at the new ring's burst —
+        the reference recreates the bucket on ring change
+        (`security/rate_limiter.py:132-149`), so a demoted agent starts
+        with the smaller ring's budget rather than its old surplus.
+        """
+        burst = float(self.config.rate_limit.ring_bursts[int(ring)])
+        with self._enqueue_lock:
+            self.agents = replace(
+                self.agents,
+                ring=self.agents.ring.at[slot].set(jnp.int8(ring)),
+                rl_tokens=self.agents.rl_tokens.at[slot].set(burst),
+                rl_stamp=self.agents.rl_stamp.at[slot].set(now),
+            )
+
     # ── audit deltas ─────────────────────────────────────────────────
 
     def stage_delta(

@@ -297,3 +297,64 @@ class TestLeaveSession:
         await hv.leave_session(b.sso.session_id, "did:x")
         with pytest.raises(SessionParticipantError):
             await hv.leave_session(b.sso.session_id, "did:x")  # double leave
+
+
+class TestUpdateAgentRing:
+    async def test_demotion_syncs_device_and_resets_bucket(self):
+        import numpy as np
+
+        from hypervisor_tpu import (
+            EventType,
+            ExecutionRing,
+            Hypervisor,
+            HypervisorEventBus,
+            SessionConfig,
+        )
+        from hypervisor_tpu.config import DEFAULT_CONFIG
+
+        bus = HypervisorEventBus()
+        hv = Hypervisor(event_bus=bus)
+        ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+        sid = ms.sso.session_id
+        await hv.join_session(sid, "did:d", sigma_raw=0.8)  # Ring 2
+        row = hv.state.agent_row("did:d")
+        # Drain some of the ring-2 bucket so the reset is observable.
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        hv.state.agents = t_replace(
+            hv.state.agents,
+            rl_tokens=hv.state.agents.rl_tokens.at[row["slot"]].set(1.0),
+        )
+
+        await hv.update_agent_ring(
+            sid, "did:d", ExecutionRing.RING_3_SANDBOX, reason="drift"
+        )
+
+        assert ms.sso.get_participant("did:d").ring is ExecutionRing.RING_3_SANDBOX
+        assert int(np.asarray(hv.state.agents.ring)[row["slot"]]) == 3
+        # Bucket recreated FULL at ring 3's burst (rate_limiter.py:132-149).
+        assert float(np.asarray(hv.state.agents.rl_tokens)[row["slot"]]) == (
+            DEFAULT_CONFIG.rate_limit.ring_bursts[3]
+        )
+        events = [e for e in bus.all_events
+                  if e.event_type is EventType.RING_DEMOTED]
+        assert len(events) == 1 and events[0].payload["reason"] == "drift"
+
+    async def test_promotion_emits_elevated(self):
+        from hypervisor_tpu import (
+            EventType,
+            ExecutionRing,
+            Hypervisor,
+            HypervisorEventBus,
+            SessionConfig,
+        )
+
+        bus = HypervisorEventBus()
+        hv = Hypervisor(event_bus=bus)
+        ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+        sid = ms.sso.session_id
+        await hv.join_session(sid, "did:u", sigma_raw=0.5)  # Ring 3
+        await hv.update_agent_ring(sid, "did:u", ExecutionRing.RING_2_STANDARD)
+        assert any(
+            e.event_type is EventType.RING_ELEVATED for e in bus.all_events
+        )

@@ -196,7 +196,7 @@ async def test_medium_drift_demotes_without_slashing():
     # host applies the demotion through the SSO ring update
     p = ms.sso.get_participant("did:wobbly")
     demoted = ExecutionRing(min(p.ring.value + 1, 3))
-    ms.sso.update_ring("did:wobbly", demoted)
+    await hv.update_agent_ring(sid, "did:wobbly", demoted, reason="drift")
     assert ms.sso.get_participant("did:wobbly").ring == demoted
 
 

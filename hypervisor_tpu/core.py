@@ -215,6 +215,9 @@ class Hypervisor:
 
         if managed.reversibility.has_non_reversible_actions():
             managed.sso.force_consistency_mode(ConsistencyMode.STRONG)
+            # The device row's mode column drives STRONG/EVENTUAL tick
+            # dispatch; both planes must agree.
+            self.state.force_session_mode(managed.slot, ConsistencyMode.STRONG)
 
         verification = self.verifier.verify(agent_did)
 

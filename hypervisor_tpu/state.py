@@ -310,6 +310,20 @@ class HypervisorState:
             self.sessions, state=self.sessions.state.at[slot].set(state.code)
         )
 
+    def force_session_mode(
+        self, slot: int, mode, has_nonreversible: bool = True
+    ) -> None:
+        """Rewrite a session row's consistency mode (STRONG forcing when
+        non-reversible actions register, `core.py` join pipeline). The
+        mode column is what `strong_tick`/`eventual_tick` dispatch on."""
+        self.sessions = replace(
+            self.sessions,
+            mode=self.sessions.mode.at[slot].set(jnp.int8(mode.code)),
+            has_nonreversible=self.sessions.has_nonreversible.at[slot].set(
+                has_nonreversible
+            ),
+        )
+
     # ── join waves ───────────────────────────────────────────────────
 
     def enqueue_join(

@@ -481,3 +481,35 @@ async def test_iatp_verified_partner_reaches_privileged_ring():
         sigma_eff=p.sigma_eff, has_consensus=True,
     )
     assert check.allowed
+
+
+async def test_strong_forcing_reaches_device_mode_column():
+    """Non-reversible actions force STRONG on BOTH planes: the host SSO
+    flag and the device session row's mode/has_nonreversible columns
+    (which STRONG/EVENTUAL tick dispatch reads)."""
+    import numpy as np
+
+    from hypervisor_tpu.models import (
+        ActionDescriptor,
+        ConsistencyMode,
+        ReversibilityLevel,
+    )
+
+    hv = Hypervisor()
+    ms = await hv.create_session(SessionConfig(), creator_did="did:lead")
+    assert int(np.asarray(hv.state.sessions.mode)[ms.slot]) == (
+        ConsistencyMode.EVENTUAL.code
+    )
+
+    irreversible = ActionDescriptor(
+        action_id="m.nuke", name="nuke", execute_api="/n",
+        reversibility=ReversibilityLevel.NONE,
+    )
+    await hv.join_session(
+        ms.sso.session_id, "did:ops", sigma_raw=0.9, actions=[irreversible]
+    )
+    assert ms.sso.consistency_mode is ConsistencyMode.STRONG
+    assert int(np.asarray(hv.state.sessions.mode)[ms.slot]) == (
+        ConsistencyMode.STRONG.code
+    )
+    assert bool(np.asarray(hv.state.sessions.has_nonreversible)[ms.slot])

@@ -9,7 +9,7 @@ works in the bare image.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 from hypervisor_tpu.models import ConsistencyMode
 

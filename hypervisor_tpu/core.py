@@ -295,6 +295,26 @@ class Hypervisor:
         )
         return ring
 
+    async def sweep_expired_sessions(self) -> list[str]:
+        """Terminate every live session past its `max_duration_seconds`.
+
+        The reference stores the limit but never enforces it; this runs
+        overdue sessions through the FULL termination path (Merkle root,
+        commitment, bond release, GC, archive) and returns their ids.
+        Call it on the same cadence as the other sweeps
+        (`docs/OPERATIONS.md` "Ticks the operator owns").
+        """
+        overdue = self.state.session_expiry_sweep(self.state.now())
+        slot_to_id = {m.slot: sid for sid, m in self._sessions.items()}
+        expired = []
+        for slot in overdue:
+            sid = slot_to_id.get(slot)
+            if sid is None:
+                continue
+            await self.terminate_session(sid)
+            expired.append(sid)
+        return expired
+
     async def leave_session(self, session_id: str, agent_did: str) -> None:
         """Remove a participant from both planes.
 

@@ -162,6 +162,10 @@ class HypervisorState:
                 config.min_sigma_eff
             ),
             enable_audit=self.sessions.enable_audit.at[slot].set(config.enable_audit),
+            created_at=self.sessions.created_at.at[slot].set(self.now()),
+            max_duration=self.sessions.max_duration.at[slot].set(
+                float(config.max_duration_seconds or 0)
+            ),
         )
         return slot
 
@@ -309,6 +313,23 @@ class HypervisorState:
         self.sessions = replace(
             self.sessions, state=self.sessions.state.at[slot].set(state.code)
         )
+
+    def session_expiry_sweep(self, now: float) -> list[int]:
+        """Live session slots past their max duration (vector compare).
+
+        The reference carries `max_duration_seconds` in SessionConfig but
+        never enforces it; here the sweep names overdue sessions so the
+        operator (or `Hypervisor.sweep_expired_sessions`) can terminate
+        them through the full audit path. 0 = unlimited.
+        """
+        state = np.asarray(self.sessions.state)
+        live = (state == SessionState.HANDSHAKING.code) | (
+            state == SessionState.ACTIVE.code
+        )
+        created = np.asarray(self.sessions.created_at)
+        limit = np.asarray(self.sessions.max_duration)
+        overdue = live & (limit > 0) & ((now - created) > limit)
+        return [int(s) for s in np.nonzero(overdue)[0]]
 
     def force_session_mode(
         self, slot: int, mode, has_nonreversible: bool = True

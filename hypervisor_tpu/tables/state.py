@@ -76,6 +76,7 @@ class SessionTable:
     created_at: jnp.ndarray       # f32[S]
     terminated_at: jnp.ndarray    # f32[S]
     has_nonreversible: jnp.ndarray  # bool[S] drives STRONG forcing
+    max_duration: jnp.ndarray     # f32[S] seconds; 0 = unlimited
 
     @staticmethod
     def create(capacity: int) -> "SessionTable":
@@ -91,6 +92,7 @@ class SessionTable:
             created_at=z32,
             terminated_at=z32,
             has_nonreversible=jnp.zeros((capacity,), bool),
+            max_duration=z32,
         )
 
 

@@ -358,3 +358,54 @@ class TestUpdateAgentRing:
         assert any(
             e.event_type is EventType.RING_ELEVATED for e in bus.all_events
         )
+
+
+class TestSessionExpiry:
+    async def test_overdue_sessions_terminate_through_audit_path(self):
+        import numpy as np
+
+        from hypervisor_tpu import Hypervisor, SessionConfig
+        from hypervisor_tpu.models import SessionState
+
+        hv = Hypervisor()
+        short = await hv.create_session(
+            SessionConfig(max_duration_seconds=1), creator_did="did:lead"
+        )
+        lasting = await hv.create_session(
+            SessionConfig(max_duration_seconds=3600), creator_did="did:lead"
+        )
+        sid = short.sso.session_id
+        await hv.join_session(sid, "did:a", sigma_raw=0.8)
+        await hv.activate_session(sid)
+        short.delta_engine.capture("did:a", [])
+
+        # Not yet overdue.
+        assert hv.state.session_expiry_sweep(hv.state.now()) == []
+        # Push the clock past the short session's budget.
+        overdue = hv.state.session_expiry_sweep(hv.state.now() + 2.0)
+        assert overdue == [short.slot]
+
+        # Facade sweep needs real elapsed time; emulate by back-dating.
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        hv.state.sessions = t_replace(
+            hv.state.sessions,
+            created_at=hv.state.sessions.created_at.at[short.slot].set(
+                hv.state.now() - 5.0
+            ),
+        )
+        expired = await hv.sweep_expired_sessions()
+        assert expired == [sid]
+        assert short.sso.state is SessionState.ARCHIVED
+        assert lasting.sso.state.value != "archived"
+        # Audit ran: a commitment exists for the expired session.
+        assert hv.commitment.get_commitment(sid) is not None
+
+    async def test_unlimited_sessions_never_expire(self):
+        from hypervisor_tpu import Hypervisor, SessionConfig
+
+        hv = Hypervisor()
+        await hv.create_session(
+            SessionConfig(max_duration_seconds=0), creator_did="did:lead"
+        )
+        assert hv.state.session_expiry_sweep(hv.state.now() + 1e9) == []

@@ -284,3 +284,16 @@ class QuarantineListItem(BaseModel):
     session_id: str
     reason: str
     remaining_seconds: float
+
+
+class LeaveSessionRequest(BaseModel):
+    agent_did: str
+
+
+class SweepResponse(BaseModel):
+    """One operator tick's outcomes across every sweep."""
+
+    breakers_tripped: int = 0
+    elevations_expired: int = 0
+    quarantines_released: int = 0
+    sessions_expired: list = []

@@ -55,6 +55,9 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/api/v1/events/stats", "event_stats", None),
     ("GET", "/api/v1/agents/{agent_did}/quarantine", "agent_quarantine", None),
     ("GET", "/api/v1/security/quarantines", "list_quarantines", None),
+    ("POST", "/api/v1/sessions/{session_id}/leave", "leave_session",
+     M.LeaveSessionRequest),
+    ("POST", "/api/v1/security/sweep", "run_sweeps", None),
 ]
 
 _QUERY_PARAMS = {

@@ -357,6 +357,35 @@ class HypervisorService:
             total_events=self.bus.event_count, by_type=self.bus.type_counts()
         )
 
+    async def leave_session(
+        self, session_id: str, req: M.LeaveSessionRequest
+    ) -> dict[str, Any]:
+        """Remove a participant from both planes (facade leave)."""
+        try:
+            await self.hv.leave_session(session_id, req.agent_did)
+        except KeyError:
+            raise ApiError(404, f"Session {session_id} not found")
+        except Exception as e:
+            raise ApiError(409, str(e))
+        return {"session_id": session_id, "agent_did": req.agent_did,
+                "status": "left"}
+
+    async def run_sweeps(self) -> M.SweepResponse:
+        """One operator tick: breach, elevation, quarantine, expiry sweeps
+        (docs/OPERATIONS.md 'Ticks the operator owns')."""
+        state = self.hv.state
+        now = state.now()
+        severity, tripped = state.breach_sweep_tick(now)
+        elevations_expired = state.elevation_tick(now)
+        quarantine_released = state.quarantine_tick(now)
+        sessions_expired = await self.hv.sweep_expired_sessions()
+        return M.SweepResponse(
+            breakers_tripped=int(tripped.sum()),
+            elevations_expired=elevations_expired,
+            quarantines_released=len(quarantine_released),
+            sessions_expired=sessions_expired,
+        )
+
     # ── security: quarantine (both planes) ───────────────────────────
 
     async def agent_quarantine(self, agent_did: str) -> M.QuarantineStatusResponse:

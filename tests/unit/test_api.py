@@ -181,7 +181,7 @@ class TestHTTPTransport:
         # The reference's 21 endpoints plus /api/v1/device/stats (the
         # device-plane occupancy view the reference has no analog for)
         # and the two quarantine views.
-        assert len(ROUTES) == 24
+        assert len(ROUTES) == 26
         assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
         assert any(
             path == "/api/v1/security/quarantines" for _, path, _, _ in ROUTES
@@ -276,3 +276,27 @@ async def test_quarantine_endpoints():
 
     items = await svc.list_quarantines()
     assert len(items) == 1 and items[0].agent_did == "did:frozen"
+
+
+async def test_leave_and_sweep_endpoints():
+    svc = HypervisorService()
+    m = await svc.create_session(M.CreateSessionRequest(creator_did="did:c"))
+    await svc.join_session(
+        m.session_id, M.JoinSessionRequest(agent_did="did:l", sigma_raw=0.9)
+    )
+    out = await svc.leave_session(
+        m.session_id, M.LeaveSessionRequest(agent_did="did:l")
+    )
+    assert out["status"] == "left"
+    # Double leave surfaces as a 409.
+    import pytest
+
+    with pytest.raises(ApiError) as e:
+        await svc.leave_session(
+            m.session_id, M.LeaveSessionRequest(agent_did="did:l")
+        )
+    assert e.value.status == 409
+
+    sweep = await svc.run_sweeps()
+    assert sweep.breakers_tripped == 0
+    assert sweep.sessions_expired == []

@@ -1,7 +1,8 @@
 """REST transports for the Hypervisor API.
 
-Two transports over the same `HypervisorService` (21 endpoints, matching
-reference `api/server.py`):
+Two transports over the same `HypervisorService` (26 routes: the
+reference's 21, `api/server.py`, plus device stats, quarantine views,
+leave, and the operator sweep):
 
  - `create_app()` — a FastAPI application with CORS-open middleware and
    OpenAPI docs, when fastapi is installed.

@@ -1,4 +1,4 @@
-"""Framework-agnostic API service: the 21 endpoints as plain async methods.
+"""Framework-agnostic API service: every endpoint as a plain async method.
 
 Capability parity with reference `api/server.py` (21 endpoints in 6 tag
 groups). The reference binds handlers directly to FastAPI; here the

@@ -1,4 +1,4 @@
-"""API service layer + stdlib HTTP transport (21 endpoints).
+"""API service layer + stdlib HTTP transport (26 routes).
 
 Mirrors the reference's API surface (`api/server.py`): sessions, rings,
 sagas, liability, events, health — exercised both in-process and over HTTP.

@@ -307,6 +307,15 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=30)
     ap.add_argument("--quick", action="store_true", help="smaller batches")
     ap.add_argument("--json-only", action="store_true")
+    ap.add_argument(
+        "--write-results",
+        action="store_true",
+        help=(
+            "overwrite benchmarks/results/ even off-TPU (the committed "
+            "artifacts are TPU numbers; a CPU smoke run must not clobber "
+            "them by accident)"
+        ),
+    )
     args = ap.parse_args()
 
     import jax
@@ -333,6 +342,13 @@ def main() -> None:
         "quick": args.quick,
         "benchmarks": results,
     }
+    if jax.default_backend() not in ("tpu",) and not args.write_results:
+        print(
+            f"\n[{jax.default_backend()} backend] results NOT written — the "
+            "committed artifacts are TPU numbers. Pass --write-results to "
+            "overwrite anyway."
+        )
+        return
     results_dir = Path(__file__).parent / "results"
     results_dir.mkdir(exist_ok=True)
     (results_dir / "benchmarks.json").write_text(json.dumps(out, indent=2))

@@ -213,3 +213,74 @@ class TestInternProperties:
         assert len(t) == len(set(names))
         for n, h in zip(names, handles):
             assert t.string(h) == n
+
+
+class TestQuarantineDualPlaneProperties:
+    """The host QuarantineManager and the device quarantine columns must
+    agree for ANY interleaving of enter/advance/sweep, when driven by
+    the same clock."""
+
+    ops = st.lists(
+        st.one_of(
+            st.tuples(st.just("enter"), st.integers(0, 3),
+                      st.floats(1.0, 50.0)),
+            st.tuples(st.just("advance"), st.just(0),
+                      st.floats(1.0, 120.0)),
+        ),
+        min_size=1,
+        max_size=20,
+    )
+
+    @settings(max_examples=40, deadline=None)
+    @given(ops)
+    def test_masks_match_manager(self, ops):
+        from datetime import datetime, timezone
+
+        import numpy as np
+
+        from hypervisor_tpu.liability.quarantine import (
+            QuarantineManager,
+            QuarantineReason,
+        )
+        from hypervisor_tpu.models import SessionConfig
+        from hypervisor_tpu.state import HypervisorState
+        from hypervisor_tpu.utils.clock import ManualClock
+
+        clock = ManualClock(datetime(2026, 1, 1, tzinfo=timezone.utc))
+        epoch = clock().timestamp()
+        mgr = QuarantineManager(clock=clock)
+
+        st_dev = HypervisorState()
+        sess = st_dev.create_session("session:qprop", SessionConfig())
+        for i in range(4):
+            st_dev.enqueue_join(sess, f"did:q{i}", sigma_raw=0.8)
+        assert (st_dev.flush_joins() == 0).all()
+
+        def dev_now():
+            return clock().timestamp() - epoch
+
+        for op, row, amount in ops:
+            if op == "enter":
+                mgr.quarantine(
+                    f"did:q{row}", "session:qprop", QuarantineReason.MANUAL,
+                    duration_seconds=int(amount),
+                )
+                # Both planes apply the SAME (enter, duration)
+                # independently: escalation must keep the original
+                # window on each, or the held-sets drift apart.
+                st_dev.quarantine_rows(
+                    [row], now=dev_now(), duration=float(int(amount))
+                )
+            else:
+                clock.advance(amount)
+            # Sweep both planes and compare.
+            mgr.tick()
+            st_dev.quarantine_tick(now=dev_now())
+            host_held = {
+                r.agent_did
+                for r in mgr.active_quarantines
+                if not r.expired_at(clock())
+            }
+            dev_mask = st_dev.quarantined_mask()
+            dev_held = {f"did:q{i}" for i in range(4) if dev_mask[i]}
+            assert dev_held == host_held, (dev_held, host_held, ops)

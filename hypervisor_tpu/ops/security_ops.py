@@ -191,7 +191,10 @@ def quarantine_sweep(
     """Auto-release every row whose deadline has passed (batched tick)."""
     now_f = jnp.asarray(now, jnp.float32)
     held = (agents.flags & FLAG_QUARANTINED) != 0
-    release = held & (agents.quarantine_until <= now_f)
+    # Strictly past the deadline, matching the host record's boundary
+    # (`quarantine.py expired_at`: now > expires_at — at the exact
+    # instant the hold is still active on both planes).
+    release = held & (agents.quarantine_until < now_f)
     flags = jnp.where(release, agents.flags & ~FLAG_QUARANTINED, agents.flags)
     return QuarantineSweep(
         agents=replace(agents, flags=flags.astype(agents.flags.dtype)),

@@ -920,6 +920,32 @@ class HypervisorState:
         )
         return row
 
+    def revoke_elevation(
+        self, row: int, expected_agent: Optional[int] = None
+    ) -> None:
+        """Manually revoke a grant before expiry (host manager parity:
+        `rings/elevation.py revoke_elevation`); the row recycles.
+
+        Row handles invalidate once a grant expires (expiry recycles
+        rows); pass `expected_agent` when the grant may have lapsed so a
+        stale handle raises instead of revoking the row's new tenant.
+        """
+        holder = int(np.asarray(self.elevations.agent)[row])
+        if expected_agent is not None and holder != expected_agent:
+            raise ValueError(
+                f"elevation row {row} now belongs to agent {holder}, not "
+                f"{expected_agent} — the grant already expired and the row "
+                "was recycled"
+            )
+        if not bool(np.asarray(self.elevations.active)[row]):
+            return  # already expired/revoked: idempotent like the host tick
+        self.elevations = replace(
+            self.elevations,
+            active=self.elevations.active.at[row].set(False),
+            agent=self.elevations.agent.at[row].set(-1),
+        )
+        self._free_elev_slots.append(int(row))
+
     def elevation_tick(self, now: float) -> int:
         """Expire every lapsed grant; returns how many expired.
 

@@ -173,9 +173,11 @@ class TestQuarantinePlane:
         until = np.asarray(st.agents.quarantine_until)
         assert until[0] == 400.0 and until[1] == 400.0
 
-        # Sweep before deadline: nothing released.
+        # Sweep at/below the deadline: nothing released (the host
+        # boundary is strictly-past: now > expires_at).
         assert st.quarantine_tick(now=399.0) == []
-        assert st.quarantine_tick(now=400.0) == [0, 1]
+        assert st.quarantine_tick(now=400.0) == []
+        assert st.quarantine_tick(now=400.5) == [0, 1]
         assert not st.quarantined_mask().any()
 
         # A fresh quarantine after release gets its own window.

@@ -284,3 +284,86 @@ class TestQuarantineDualPlaneProperties:
             dev_mask = st_dev.quarantined_mask()
             dev_held = {f"did:q{i}" for i in range(4) if dev_mask[i]}
             assert dev_held == host_held, (dev_held, host_held, ops)
+
+
+class TestElevationDualPlaneProperties:
+    """Host RingElevationManager vs device ElevationTable: effective
+    rings must agree for any grant/advance/revoke interleaving."""
+
+    ops = st.lists(
+        st.one_of(
+            st.tuples(st.just("grant"), st.integers(0, 2),
+                      st.floats(5.0, 60.0)),
+            st.tuples(st.just("advance"), st.just(0), st.floats(1.0, 90.0)),
+            st.tuples(st.just("revoke"), st.integers(0, 2), st.just(0.0)),
+        ),
+        min_size=1,
+        max_size=16,
+    )
+
+    @settings(max_examples=40, deadline=None)
+    @given(ops)
+    def test_effective_rings_match(self, ops):
+        from datetime import datetime, timezone
+
+        from hypervisor_tpu.models import ExecutionRing, SessionConfig
+        from hypervisor_tpu.rings import RingElevationError, RingElevationManager
+        from hypervisor_tpu.state import HypervisorState
+        from hypervisor_tpu.utils.clock import ManualClock
+
+        clock = ManualClock(datetime(2026, 1, 1, tzinfo=timezone.utc))
+        epoch = clock().timestamp()
+        mgr = RingElevationManager(clock=clock)
+
+        st_dev = HypervisorState()
+        sess = st_dev.create_session("session:eprop", SessionConfig())
+        for i in range(3):
+            st_dev.enqueue_join(sess, f"did:e{i}", sigma_raw=0.8)  # ring 2
+        assert (st_dev.flush_joins() == 0).all()
+
+        def dev_now():
+            return clock().timestamp() - epoch
+
+        grants: dict[int, tuple[str, int]] = {}  # agent -> (host id, dev row)
+        for op, agent, amount in ops:
+            if op == "grant":
+                try:
+                    g = mgr.request_elevation(
+                        f"did:e{agent}", "session:eprop",
+                        ExecutionRing.RING_2_STANDARD,
+                        ExecutionRing.RING_1_PRIVILEGED,
+                        ttl_seconds=int(amount),
+                    )
+                except RingElevationError:
+                    continue  # duplicate live grant — device skips too
+                row = st_dev.grant_elevation(
+                    agent, granted_ring=1, now=dev_now(),
+                    ttl_seconds=float(int(amount)),
+                )
+                grants[agent] = (g.elevation_id, row)
+            elif op == "advance":
+                clock.advance(amount)
+                mgr.tick()
+                st_dev.elevation_tick(now=dev_now())
+            else:
+                held = grants.pop(agent, None)
+                if held is not None:
+                    try:
+                        mgr.revoke_elevation(held[0])
+                    except RingElevationError:
+                        pass
+                    try:
+                        # Stale handles (grant lapsed, row recycled) raise
+                        # instead of revoking the new tenant.
+                        st_dev.revoke_elevation(held[1], expected_agent=agent)
+                    except ValueError:
+                        pass
+
+            dev_rings = st_dev.effective_rings(now=dev_now())
+            for i in range(3):
+                host_ring = mgr.get_effective_ring(
+                    f"did:e{i}", "session:eprop", ExecutionRing.RING_2_STANDARD
+                )
+                assert int(dev_rings[i]) == host_ring.value, (
+                    i, ops, int(dev_rings[i]), host_ring,
+                )

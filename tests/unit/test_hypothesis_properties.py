@@ -367,3 +367,65 @@ class TestElevationDualPlaneProperties:
                 assert int(dev_rings[i]) == host_ring.value, (
                     i, ops, int(dev_rings[i]), host_ring,
                 )
+
+
+class TestRateLimitDualPlaneProperties:
+    """Host AgentRateLimiter vs ops.rate_limit.consume: identical
+    (consume, advance) sequences must produce identical allow/deny
+    streams and (near-)identical token levels."""
+
+    ops = st.lists(
+        st.one_of(
+            st.tuples(st.just("consume"), st.integers(0, 2),
+                      st.floats(0.5, 3.0)),
+            st.tuples(st.just("advance"), st.just(0), st.floats(0.01, 2.0)),
+        ),
+        min_size=1,
+        max_size=25,
+    )
+
+    @settings(max_examples=50, deadline=None)
+    @given(ops)
+    def test_decisions_match(self, ops):
+        from datetime import datetime, timezone
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from hypervisor_tpu.config import DEFAULT_CONFIG
+        from hypervisor_tpu.models import ExecutionRing
+        from hypervisor_tpu.ops import rate_limit as rl_ops
+        from hypervisor_tpu.security.rate_limiter import AgentRateLimiter
+        from hypervisor_tpu.utils.clock import ManualClock
+
+        clock = ManualClock(datetime(2026, 1, 1, tzinfo=timezone.utc))
+        host = AgentRateLimiter(clock=clock)
+        cfg = DEFAULT_CONFIG.rate_limit
+
+        n = 3
+        rings = np.array([3, 2, 1], np.int8)  # one agent per ring tier
+        tokens = jnp.asarray(
+            np.array([cfg.ring_bursts[r] for r in rings], np.float32)
+        )
+        stamp = jnp.zeros((n,), jnp.float32)
+        t = 0.0
+
+        for op, agent, amount in ops:
+            if op == "advance":
+                clock.advance(amount)
+                t += amount
+                continue
+            cost = float(round(amount, 2))
+            host_ok = host.try_check(
+                f"did:r{agent}", "s", ExecutionRing(int(rings[agent])),
+                cost=cost,
+            )
+            costs = np.zeros(n, np.float32)
+            costs[agent] = cost
+            decision = rl_ops.consume(
+                tokens, stamp, jnp.asarray(rings), t,
+                jnp.asarray(costs), config=cfg,
+            )
+            tokens, stamp = decision.tokens, decision.stamp
+            dev_ok = bool(np.asarray(decision.allowed)[agent])
+            assert dev_ok == host_ok, (ops, op, agent, cost, t)

@@ -429,3 +429,51 @@ class TestRateLimitDualPlaneProperties:
             tokens, stamp = decision.tokens, decision.stamp
             dev_ok = bool(np.asarray(decision.allowed)[agent])
             assert dev_ok == host_ok, (ops, op, agent, cost, t)
+
+
+class TestClockDualPlaneProperties:
+    """Host VectorClockManager vs the WriteWave clock gate: for any
+    sequence of reads and strict writes, the accept/reject stream must
+    match (stale writers rejected identically on both planes)."""
+
+    ops = st.lists(
+        st.tuples(
+            st.sampled_from(["read", "write"]),
+            st.integers(0, 2),   # writer
+            st.integers(0, 2),   # path
+        ),
+        min_size=1,
+        max_size=24,
+    )
+
+    @settings(max_examples=50, deadline=None)
+    @given(ops)
+    def test_conflict_streams_match(self, ops):
+        from hypervisor_tpu.runtime.write_wave import WRITE_OK, WriteWave
+        from hypervisor_tpu.session.vector_clock import (
+            CausalViolationError,
+            VectorClockManager,
+        )
+        from hypervisor_tpu.session.vfs import SessionVFS
+
+        host = VectorClockManager()
+        wave = WriteWave(SessionVFS("session:ck"), strict=True)
+        agents = [f"did:c{i}" for i in range(3)]
+        paths = [f"/p{i}" for i in range(3)]
+
+        n_write = 0
+        for op, who, where in ops:
+            agent, path = agents[who], paths[where]
+            if op == "read":
+                host.read(path, agent)
+                wave.observe(agent, path)
+                continue
+            n_write += 1
+            try:
+                host.write(path, agent, strict=True)
+                host_ok = True
+            except CausalViolationError:
+                host_ok = False
+            wave.submit(agent, path, f"v{n_write}", ring=0)  # huge budget
+            dev_ok = wave.flush(now=float(n_write)).status[0] == WRITE_OK
+            assert bool(dev_ok) == host_ok, (ops, op, who, where)

@@ -477,3 +477,133 @@ class TestClockDualPlaneProperties:
             wave.submit(agent, path, f"v{n_write}", ring=0)  # huge budget
             dev_ok = wave.flush(now=float(n_write)).status[0] == WRITE_OK
             assert bool(dev_ok) == host_ok, (ops, op, who, where)
+
+
+class TestSagaDualPlaneProperties:
+    """Host SagaOrchestrator vs the device SagaTable scheduler: the same
+    saga (steps, retry budgets, undo availability) driven by the same
+    scripted executor outcomes must settle identically — step states,
+    saga state, and compensation behavior."""
+
+    scripts = st.lists(
+        st.tuples(
+            st.integers(0, 2),            # retries for this step
+            st.booleans(),                # has undo api
+            st.lists(st.booleans(), min_size=1, max_size=4),  # outcomes
+            st.booleans(),                # undo outcome (if compensated)
+        ),
+        min_size=1,
+        max_size=4,
+    )
+
+    @settings(max_examples=30, deadline=None)
+    @given(scripts)
+    def test_settlement_matches(self, script):
+        import asyncio
+
+        import numpy as np
+
+        from hypervisor_tpu.models import SessionConfig
+        from hypervisor_tpu.ops import saga_ops
+        from hypervisor_tpu.runtime.saga_scheduler import SagaScheduler
+        from hypervisor_tpu.saga import (
+            SagaOrchestrator,
+            SagaState,
+            StepState,
+        )
+        from hypervisor_tpu.state import HypervisorState
+
+        async def drive_host():
+            orch = SagaOrchestrator()
+            orch.DEFAULT_RETRY_DELAY_SECONDS = 0.0
+            saga = orch.create_saga("session:sp")
+            steps = []
+            for i, (retries, has_undo, outcomes, _) in enumerate(script):
+                steps.append(orch.add_step(
+                    saga.saga_id, f"a{i}", "did:s", f"/x{i}",
+                    undo_api=f"/u{i}" if has_undo else None,
+                    max_retries=retries, timeout_seconds=30,
+                ))
+            failed_forward = False
+            for i, (retries, _, outcomes, _) in enumerate(script):
+                calls = {"n": 0}
+
+                async def run(i=i, outcomes=outcomes, calls=calls):
+                    k = min(calls["n"], len(outcomes) - 1)
+                    calls["n"] += 1
+                    if not outcomes[k]:
+                        raise RuntimeError("scripted failure")
+                    return "ok"
+
+                try:
+                    await orch.execute_step(saga.saga_id, steps[i].step_id, run)
+                except Exception:
+                    failed_forward = True
+                    break
+            if failed_forward:
+                async def undo(step):
+                    idx = int(step.action_id[1:])
+                    if not script[idx][3]:
+                        raise RuntimeError("scripted undo failure")
+                    return "undone"
+
+                await orch.compensate(saga.saga_id, undo)
+            else:
+                saga.transition(SagaState.COMPLETED)
+            return saga, steps
+
+        saga, host_steps = asyncio.run(drive_host())
+
+        st_dev = HypervisorState()
+        sess = st_dev.create_session("session:sp", SessionConfig())
+        slot = st_dev.create_saga(
+            "saga:sp", sess,
+            [
+                {"retries": r, "has_undo": h, "timeout": 30.0}
+                for r, h, _, _ in script
+            ],
+        )
+        sched = SagaScheduler(st_dev, retry_backoff_seconds=0.0)
+        for i, (_, _, outcomes, undo_ok) in enumerate(script):
+            calls = {"n": 0}
+
+            async def run(i=i, outcomes=outcomes, calls=calls):
+                k = min(calls["n"], len(outcomes) - 1)
+                calls["n"] += 1
+                if not outcomes[k]:
+                    raise RuntimeError("scripted failure")
+                return "ok"
+
+            async def undo(i=i, undo_ok=undo_ok):
+                if not undo_ok:
+                    raise RuntimeError("scripted undo failure")
+                return "undone"
+
+            sched.register(
+                slot, i, run,
+                undo=(undo if script[i][1] else None),
+            )
+        asyncio.run(sched.run_until_settled())
+
+        host_code = {
+            SagaState.COMPLETED: saga_ops.SAGA_COMPLETED,
+            SagaState.ESCALATED: saga_ops.SAGA_ESCALATED,
+            SagaState.FAILED: saga_ops.SAGA_FAILED,
+        }[saga.state]
+        dev_saga = int(np.asarray(st_dev.sagas.saga_state)[slot])
+        assert dev_saga == host_code, (script, saga.state, dev_saga)
+
+        step_codes = {
+            StepState.PENDING: saga_ops.STEP_PENDING,
+            StepState.EXECUTING: saga_ops.STEP_EXECUTING,
+            StepState.COMMITTED: saga_ops.STEP_COMMITTED,
+            StepState.COMPENSATING: saga_ops.STEP_COMPENSATING,
+            StepState.COMPENSATED: saga_ops.STEP_COMPENSATED,
+            StepState.COMPENSATION_FAILED: saga_ops.STEP_COMPENSATION_FAILED,
+            StepState.FAILED: saga_ops.STEP_FAILED,
+        }
+        dev_steps = np.asarray(st_dev.sagas.step_state)[slot]
+        for i, hs in enumerate(host_steps):
+            assert int(dev_steps[i]) == step_codes[hs.state], (
+                script, i, hs.state, int(dev_steps[i]),
+            )

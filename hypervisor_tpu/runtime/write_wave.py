@@ -39,6 +39,7 @@ WRITE_OK = 0
 WRITE_RATE_LIMITED = 1
 WRITE_CONFLICT = 2
 WRITE_QUARANTINED = 3
+WRITE_LOCK_REQUIRED = 4
 
 _PREPASS = jax.jit(clock_ops.batched_write_prepass)
 _CONSUME = jax.jit(rate_limit.consume, static_argnames=("config",))
@@ -61,6 +62,7 @@ class WriteReport:
     rate_limited: int
     conflicts: int
     quarantined: int = 0
+    lock_required: int = 0
 
 
 class WriteWave:
@@ -74,6 +76,8 @@ class WriteWave:
         rate_config: RateLimitConfig = DEFAULT_CONFIG.rate_limit,
         strict: bool = True,
         is_quarantined: Optional[Callable[[str], bool]] = None,
+        isolation=None,
+        lock_manager=None,
     ) -> None:
         self.vfs = vfs
         self.strict = strict
@@ -82,6 +86,26 @@ class WriteWave:
         # Quarantined writers are refused before any gate runs
         # (reference `liability/quarantine.py` read-only semantics).
         self.is_quarantined = is_quarantined
+        # Isolation level decides which gates engage
+        # (`session/isolation.py` flags):
+        #   SNAPSHOT        — no causal prepass (buffered-write semantics),
+        #   READ_COMMITTED  — causal prepass (the default `strict` path),
+        #   SERIALIZABLE    — causal prepass AND the writer must hold a
+        #                     write-capable intent lock on the path
+        #                     (supply `lock_manager`).
+        self.isolation = isolation
+        self.lock_manager = lock_manager
+        if isolation is not None:
+            self._clock_gate = isolation.requires_vector_clocks
+            self._lock_gate = isolation.requires_intent_locks
+        else:
+            self._clock_gate = True
+            self._lock_gate = False
+        if self._lock_gate and lock_manager is None:
+            raise ValueError(
+                "SERIALIZABLE isolation needs a lock_manager to verify "
+                "write locks"
+            )
         self._rate_config = rate_config
         self._paths = InternTable()
         self._writers = InternTable()
@@ -132,6 +156,25 @@ class WriteWave:
                 if held[did]:
                     status[i] = WRITE_QUARANTINED
 
+        # ── gate 0b: SERIALIZABLE writers must hold a write lock ───────
+        if self._lock_gate:
+            from hypervisor_tpu.session.intent_locks import LockIntent
+
+            writable = (LockIntent.WRITE, LockIntent.EXCLUSIVE)
+            for i, (did, path, *_rest) in enumerate(staged):
+                if status[i] != WRITE_OK:
+                    continue
+                # Locks are session-scoped: one held in another session
+                # must not satisfy THIS session's serializability gate.
+                holds = any(
+                    lock.agent_did == did
+                    and lock.intent in writable
+                    and lock.session_id == self.vfs.session_id
+                    for lock in self.lock_manager.get_resource_locks(path)
+                )
+                if not holds:
+                    status[i] = WRITE_LOCK_REQUIRED
+
         # ── gate 1: token buckets, one consume per writer occurrence ───
         for row, (_, _, _, ring) in zip(writer_rows, staged):
             if not self._rl_primed[row] or self._rl_ring[row] != ring:
@@ -170,31 +213,35 @@ class WriteWave:
         # A prepass batch needs DISTINCT paths (the op's contract) and
         # DISTINCT writers (duplicate scatter rows would drop clock
         # ticks): greedy per-resource scheduling preserves order.
-        path_occ = np.zeros(w, np.int64)
-        busy_until: dict[tuple[str, int], int] = {}
-        for i in range(w):
-            b = max(
-                busy_until.get(("p", int(path_rows[i])), 0),
-                busy_until.get(("w", int(writer_rows[i])), 0),
-            )
-            path_occ[i] = b
-            busy_until[("p", int(path_rows[i]))] = b + 1
-            busy_until[("w", int(writer_rows[i]))] = b + 1
-        for batch_no in range(int(path_occ.max()) + 1):
-            sel = np.nonzero((path_occ == batch_no) & (status == WRITE_OK))[0]
-            if not len(sel):
-                continue
-            out = _PREPASS(
-                self._path_clocks,
-                self._agent_clocks,
-                jnp.asarray(path_rows[sel]),
-                jnp.asarray(writer_rows[sel]),
-                self.strict,
-            )
-            self._path_clocks = out.path_clocks
-            self._agent_clocks = out.agent_clocks
-            rejected = ~np.asarray(out.allowed)
-            status[sel[rejected]] = WRITE_CONFLICT
+        # SNAPSHOT isolation skips the gate (and its scheduling) whole.
+        if self._clock_gate:
+            path_occ = np.zeros(w, np.int64)
+            busy_until: dict[tuple[str, int], int] = {}
+            for i in range(w):
+                b = max(
+                    busy_until.get(("p", int(path_rows[i])), 0),
+                    busy_until.get(("w", int(writer_rows[i])), 0),
+                )
+                path_occ[i] = b
+                busy_until[("p", int(path_rows[i]))] = b + 1
+                busy_until[("w", int(writer_rows[i]))] = b + 1
+            for batch_no in range(int(path_occ.max()) + 1):
+                sel = np.nonzero(
+                    (path_occ == batch_no) & (status == WRITE_OK)
+                )[0]
+                if not len(sel):
+                    continue
+                out = _PREPASS(
+                    self._path_clocks,
+                    self._agent_clocks,
+                    jnp.asarray(path_rows[sel]),
+                    jnp.asarray(writer_rows[sel]),
+                    self.strict,
+                )
+                self._path_clocks = out.path_clocks
+                self._agent_clocks = out.agent_clocks
+                rejected = ~np.asarray(out.allowed)
+                status[sel[rejected]] = WRITE_CONFLICT
 
         # ── apply survivors to the VFS in submission order ─────────────
         applied = 0
@@ -209,6 +256,7 @@ class WriteWave:
             rate_limited=int((status == WRITE_RATE_LIMITED).sum()),
             conflicts=int((status == WRITE_CONFLICT).sum()),
             quarantined=int((status == WRITE_QUARANTINED).sum()),
+            lock_required=int((status == WRITE_LOCK_REQUIRED).sum()),
         )
 
     def observe(self, agent_did: str, path: str) -> None:

@@ -61,6 +61,9 @@ def _write_errors() -> dict[int, tuple[type, str]]:
         ww.WRITE_CONFLICT: (CausalViolationError, "Causally stale write by {who}"),
         ww.WRITE_QUARANTINED: (
             QuarantinedError, "Writer {who} is quarantined (read-only)"),
+        ww.WRITE_LOCK_REQUIRED: (
+            LockContentionError,
+            "SERIALIZABLE isolation: {who} holds no write lock on the path"),
     }
 
 

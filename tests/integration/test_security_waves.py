@@ -263,3 +263,84 @@ class TestQuarantinePlane:
         wave2 = ms.write_wave()
         wave2.submit("did:iso", "/doc2.md", "back", ring=2)
         assert wave2.flush(now=hv.state.now()).status.tolist() == [WRITE_OK]
+
+
+class TestIsolationLevels:
+    """IsolationLevel flags gate the batched write path
+    (`session/isolation.py`): SNAPSHOT skips the causal prepass,
+    READ_COMMITTED is the default clock-gated path, SERIALIZABLE
+    additionally demands a write-capable intent lock."""
+
+    def test_snapshot_tolerates_causally_stale_writers(self):
+        from hypervisor_tpu.runtime.write_wave import WRITE_OK, WriteWave
+        from hypervisor_tpu.session.isolation import IsolationLevel
+        from hypervisor_tpu.session.vfs import SessionVFS
+
+        vfs = SessionVFS("session:iso-snap")
+        wave = WriteWave(vfs, isolation=IsolationLevel.SNAPSHOT)
+        wave.submit("did:w1", "/doc", "v1")
+        assert wave.flush(now=0.0).applied == 1
+        # A blind write that READ_COMMITTED would reject as stale lands.
+        wave.submit("did:w2", "/doc", "v2-blind")
+        report = wave.flush(now=1.0)
+        assert report.status.tolist() == [WRITE_OK] and report.conflicts == 0
+        assert vfs.read("/doc") == "v2-blind"
+
+    def test_read_committed_still_rejects_stale(self):
+        from hypervisor_tpu.runtime.write_wave import WRITE_CONFLICT, WriteWave
+        from hypervisor_tpu.session.isolation import IsolationLevel
+        from hypervisor_tpu.session.vfs import SessionVFS
+
+        vfs = SessionVFS("session:iso-rc")
+        wave = WriteWave(vfs, isolation=IsolationLevel.READ_COMMITTED)
+        wave.submit("did:w1", "/doc", "v1")
+        wave.flush(now=0.0)
+        wave.submit("did:w2", "/doc", "v2-blind")
+        assert wave.flush(now=1.0).status.tolist() == [WRITE_CONFLICT]
+
+    def test_serializable_requires_write_lock(self):
+        import pytest
+
+        from hypervisor_tpu.runtime.write_wave import (
+            WRITE_LOCK_REQUIRED,
+            WRITE_OK,
+            WriteWave,
+        )
+        from hypervisor_tpu.session.intent_locks import (
+            IntentLockManager,
+            LockIntent,
+        )
+        from hypervisor_tpu.session.isolation import IsolationLevel
+        from hypervisor_tpu.session.vfs import SessionVFS
+
+        with pytest.raises(ValueError, match="lock_manager"):
+            WriteWave(
+                SessionVFS("x"), isolation=IsolationLevel.SERIALIZABLE
+            )
+
+        locks = IntentLockManager()
+        vfs = SessionVFS("session:iso-ser")
+        sid = vfs.session_id
+        wave = WriteWave(
+            vfs, isolation=IsolationLevel.SERIALIZABLE, lock_manager=locks
+        )
+        # No lock: refused before any clock tick, and counted.
+        wave.submit("did:w1", "/doc", "v1")
+        report = wave.flush(now=0.0)
+        assert report.status.tolist() == [WRITE_LOCK_REQUIRED]
+        assert report.lock_required == 1
+        # READ lock is not write-capable.
+        locks.acquire("did:w1", sid, "/doc", LockIntent.READ)
+        wave.submit("did:w1", "/doc", "v1")
+        assert wave.flush(now=1.0).status.tolist() == [WRITE_LOCK_REQUIRED]
+        # A WRITE lock held in a DIFFERENT session does not satisfy the
+        # gate (locks are session-scoped).
+        locks.release_agent_locks("did:w1", sid)
+        locks.acquire("did:w1", "session:other", "/doc", LockIntent.WRITE)
+        wave.submit("did:w1", "/doc", "v1")
+        assert wave.flush(now=2.0).status.tolist() == [WRITE_LOCK_REQUIRED]
+        # A WRITE lock in THIS session admits.
+        locks.acquire("did:w1", sid, "/doc", LockIntent.WRITE)
+        wave.submit("did:w1", "/doc", "v1")
+        assert wave.flush(now=3.0).status.tolist() == [WRITE_OK]
+        assert vfs.read("/doc") == "v1"

@@ -607,3 +607,78 @@ class TestSagaDualPlaneProperties:
             assert int(dev_steps[i]) == step_codes[hs.state], (
                 script, i, hs.state, int(dev_steps[i]),
             )
+
+
+class TestBreachDualPlaneProperties:
+    """Host sliding-window detector vs the device tumbling-window sweep:
+    the two observe different windows BY DESIGN (per-call analysis with
+    breaker suppression vs one analysis per closed window), but both
+    must apply the same severity ladder to whatever counts they see."""
+
+    calls = st.lists(st.booleans(), min_size=1, max_size=30)  # privileged?
+
+    @settings(max_examples=50, deadline=None)
+    @given(calls)
+    def test_both_planes_apply_the_same_ladder(self, calls):
+        from datetime import datetime, timezone
+
+        from hypervisor_tpu.config import DEFAULT_CONFIG
+        from hypervisor_tpu.models import ExecutionRing, SessionConfig
+        from hypervisor_tpu.rings import BreachSeverity, RingBreachDetector
+        from hypervisor_tpu.state import HypervisorState
+        from hypervisor_tpu.utils.clock import ManualClock
+
+        cfg = DEFAULT_CONFIG.breach
+
+        def ladder(anom: int, total: int) -> int:
+            if total < cfg.min_calls_for_analysis:
+                return 0
+            rate = anom / total
+            return (
+                (rate >= cfg.low_threshold)
+                + (rate >= cfg.medium_threshold)
+                + (rate >= cfg.high_threshold)
+                + (rate >= cfg.critical_threshold)
+            )
+
+        sev_code = {
+            BreachSeverity.NONE: 0, BreachSeverity.LOW: 1,
+            BreachSeverity.MEDIUM: 2, BreachSeverity.HIGH: 3,
+            BreachSeverity.CRITICAL: 4,
+        }
+
+        # Host: every non-suppressed per-call event must equal the ladder
+        # applied to its prefix counts.
+        clock = ManualClock(datetime(2026, 1, 1, tzinfo=timezone.utc))
+        host = RingBreachDetector(clock=clock)
+        anom = 0
+        suppressed = False
+        for k, privileged in enumerate(calls, start=1):
+            anom += privileged
+            event = host.record_call(
+                "did:b", "s", ExecutionRing.RING_2_STANDARD,
+                ExecutionRing.RING_0_ROOT if privileged
+                else ExecutionRing.RING_2_STANDARD,
+            )
+            expected = ladder(anom, k)
+            if suppressed:
+                assert event is None  # breaker cooldown swallows analysis
+                continue
+            got = sev_code[event.severity] if event else 0
+            assert got == expected, (calls[:k], got, expected)
+            if event and got >= 3:
+                suppressed = True  # breaker trips on HIGH/CRITICAL
+
+        # Device: one sweep closes the whole window; severity must equal
+        # the ladder applied to the final counts.
+        st_dev = HypervisorState()
+        sess = st_dev.create_session("session:bprop", SessionConfig())
+        st_dev.enqueue_join(sess, "did:b", sigma_raw=0.8)  # ring 2
+        assert (st_dev.flush_joins() == 0).all()
+        st_dev.record_calls(
+            [0] * len(calls), [0 if p else 2 for p in calls]
+        )
+        severity, _ = st_dev.breach_sweep_tick(now=1.0)
+        assert int(severity[0]) == ladder(anom, len(calls)), (
+            calls, int(severity[0]),
+        )

@@ -96,7 +96,6 @@ def build_benchmarks(quick: bool):
     import jax
     import jax.numpy as jnp
 
-    from hypervisor_tpu.config import DEFAULT_CONFIG
     from hypervisor_tpu.ops import liability as liab_ops
     from hypervisor_tpu.ops import merkle as merkle_ops
     from hypervisor_tpu.ops import rings as ring_ops

@@ -18,10 +18,8 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from hypervisor_tpu import (
-    ActionDescriptor,
     Hypervisor,
     HypervisorEventBus,
-    ReversibilityLevel,
     SessionConfig,
     VFSChange,
 )

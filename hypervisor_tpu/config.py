@@ -14,7 +14,6 @@ receive them as scalars inside kernels.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 
 @dataclasses.dataclass(frozen=True)

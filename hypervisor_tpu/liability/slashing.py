@@ -15,7 +15,6 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from datetime import datetime
-from typing import Optional
 
 from hypervisor_tpu.config import DEFAULT_CONFIG
 from hypervisor_tpu.liability.vouching import VouchingEngine

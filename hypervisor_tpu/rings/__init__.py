@@ -10,9 +10,7 @@ calls the op directly on the agent table columns.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
 
-import numpy as np
 
 from hypervisor_tpu.config import DEFAULT_CONFIG
 from hypervisor_tpu.models import ActionDescriptor, ExecutionRing

@@ -10,7 +10,6 @@ table the device-plane batched conflict prepass uses.
 from __future__ import annotations
 
 import enum
-import itertools
 import uuid
 from dataclasses import dataclass, field
 from datetime import datetime, timezone

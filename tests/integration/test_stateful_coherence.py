@@ -131,6 +131,47 @@ class PlaneCoherence(RuleBasedStateMachine):
         self.joined.pop(sid)
 
     @precondition(lambda self: any(self.joined.values()))
+    @rule(pick=st.integers(0, 3), new_ring=st.integers(1, 3))
+    def update_ring(self, pick, new_ring):
+        from hypervisor_tpu.models import ExecutionRing
+
+        sids = [s for s in self.sessions if self.joined[s]]
+        if not sids:
+            return
+        sid = sids[pick % len(sids)]
+        agent = sorted(self.joined[sid])[0]
+        self.go(
+            self.hv.update_agent_ring(
+                sid, agent, ExecutionRing(new_ring), reason="prop"
+            )
+        )
+
+    @precondition(lambda self: any(self.joined.values()))
+    @rule(pick=st.integers(0, 3))
+    def quarantine_agent(self, pick):
+        from hypervisor_tpu.liability.quarantine import QuarantineReason
+
+        sids = [s for s in self.sessions if self.joined[s]]
+        if not sids:
+            return
+        sid = sids[pick % len(sids)]
+        agent = sorted(self.joined[sid])[0]
+        row = self.hv.state.agent_row(agent)
+        if row is None:
+            return
+        self.hv.quarantine.quarantine(
+            agent, sid, QuarantineReason.MANUAL, details="prop"
+        )
+        self.hv.state.quarantine_rows([row["slot"]], now=self.hv.state.now())
+
+    @rule()
+    def sweeps(self):
+        now = self.hv.state.now()
+        self.hv.state.breach_sweep_tick(now)
+        self.hv.state.elevation_tick(now)
+        self.hv.state.quarantine_tick(now)
+
+    @precondition(lambda self: any(self.joined.values()))
     @rule(pick=st.integers(0, 3))
     def capture_delta(self, pick):
         sids = [s for s in self.sessions if self.joined[s]]
@@ -192,6 +233,26 @@ class PlaneCoherence(RuleBasedStateMachine):
         assert dev_live == host_mirrorable, (
             f"vouch mirror drift: host {host_mirrorable} device {dev_live}"
         )
+
+    @invariant()
+    def quarantine_planes_agree(self):
+        # Every device-flagged CURRENT-session participant must have a
+        # live host record (the converse can lag when the agent's device
+        # row moved to a later session — host records outlive rows).
+        mask = self.hv.state.quarantined_mask()
+        for sid in self.sessions:
+            managed = self.hv.get_session(sid)
+            for p in managed.sso.participants:
+                row = self.hv.state.agent_row(p.agent_did)
+                if row is None or row["session"] != managed.slot:
+                    continue
+                if mask[row["slot"]]:
+                    assert (
+                        self.hv.quarantine.get_active_quarantine(
+                            p.agent_did, sid
+                        )
+                        is not None
+                    ), f"device-only quarantine for {p.agent_did}"
 
     @invariant()
     def delta_log_covers_every_capture(self):

@@ -402,6 +402,16 @@ def demo_security_plane() -> None:
 
 
 async def main() -> None:
+    # Fail fast if the accelerator tunnel is wedged (rc=17 + diagnostic)
+    # instead of hanging on the first backend query.
+    from _jax_platform import arm_device_watchdog
+
+    disarm = arm_device_watchdog(600.0, "demo device discovery")
+    import jax
+
+    jax.devices()
+    disarm()
+
     hv = Hypervisor()
     await demo_lifecycle(hv)
     await demo_saga(hv)

@@ -31,6 +31,10 @@ import numpy as np
 
 
 def main() -> None:
+    from _jax_platform import arm_device_watchdog
+
+    disarm = arm_device_watchdog(600.0, "multichip device discovery")
+
     import jax
     import jax.numpy as jnp
 
@@ -46,6 +50,7 @@ def main() -> None:
     from hypervisor_tpu.tables.struct import replace as t_replace
 
     n_dev = len(jax.devices())
+    disarm()
     # Largest power of two the device pool supports (1 on a single-device
     # backend — the walkthrough still runs, degenerately unsharded).
     n = 1 << (n_dev.bit_length() - 1)

@@ -259,3 +259,29 @@ class TestKillSwitch:
         ks.kill("did:a", "s", KillReason.RATE_LIMIT)
         ks.kill("did:b", "s", KillReason.RING_BREACH)
         assert ks.total_kills == 2
+
+
+class TestRateLimiterBatchAPI:
+    def test_check_many_decides_whole_wave(self):
+        from hypervisor_tpu.models import ExecutionRing
+
+        rl = AgentRateLimiter()
+        agents = [f"did:cm{i}" for i in range(4)]
+        out = rl.check_many(
+            agents, ["s"] * 4, [ExecutionRing.RING_3_SANDBOX] * 4
+        )
+        assert out.tolist() == [True] * 4
+
+    def test_check_many_duplicates_settle_sequentially(self):
+        from hypervisor_tpu.config import DEFAULT_CONFIG
+        from hypervisor_tpu.models import ExecutionRing
+
+        rl = AgentRateLimiter()
+        burst = int(DEFAULT_CONFIG.rate_limit.ring_bursts[3])  # ring 3 = 10
+        n = burst + 3
+        out = rl.check_many(
+            ["did:dup"] * n, ["s"] * n, [ExecutionRing.RING_3_SANDBOX] * n
+        )
+        # The first `burst` requests drain the bucket; the rest refuse —
+        # each duplicate saw the balance its predecessors left.
+        assert out.tolist() == [True] * burst + [False] * 3

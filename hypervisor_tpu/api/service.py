@@ -398,9 +398,10 @@ class HypervisorService:
             ),
             None,
         )
-        row = self.hv.state.agent_row(agent_did)
-        device_flagged = bool(
-            row is not None and self.hv.state.quarantined_mask()[row["slot"]]
+        # One row per (agent, session): flagged if ANY membership is.
+        mask = self.hv.state.quarantined_mask()
+        device_flagged = any(
+            mask[r["slot"]] for r in self.hv.state.agent_rows(agent_did)
         )
         if record is None:
             return M.QuarantineStatusResponse(

@@ -81,7 +81,7 @@ class ManagedSession:
         self._state = state
 
     def _stage_delta(self, delta) -> None:
-        row = self._state.agent_row(delta.agent_did)
+        row = self._state.agent_row(delta.agent_did, self.slot)
         self._state.stage_delta(
             self.slot,
             row["slot"] if row else -1,
@@ -98,10 +98,12 @@ class ManagedSession:
 
         state = self._state
 
+        slot = self.slot
+
         def quarantined(did: str) -> bool:
             if state is None:
                 return False
-            row = state.agent_row(did)
+            row = state.agent_row(did, slot)
             return bool(row is not None and state.quarantined_mask()[row["slot"]])
 
         return WriteWave(self.sso.vfs, is_quarantined=quarantined, **kwargs)
@@ -274,7 +276,7 @@ class Hypervisor:
                 f"device admission rejected what the host session accepted "
                 f"— table/SSO divergence for {agent_did}"
             )
-        device_ring = self.state.agent_row(agent_did)
+        device_ring = self.state.agent_row(agent_did, managed.slot)
         if device_ring is not None and device_ring["ring"] != ring.value:
             raise RuntimeError(
                 f"ring divergence for {agent_did}: host {ring.value}, "
@@ -320,9 +322,11 @@ class Hypervisor:
 
         The reference exposes leave only on the SSO (`session/__init__.py
         leave`); here the facade keeps the device tables coherent: the
-        host participant deactivates, the agent's device row frees, the
-        session count drops, and the leaver's mirrored vouch edges scrub
-        (bonds survive host-side and re-mirror on a later join).
+        host participant deactivates, the membership's device row frees,
+        the session count drops, and the leaver's mirrored vouch edges
+        scrub (bonds survive host-side and re-mirror on a later join).
+        The agent's rows in other sessions are untouched — one device
+        row per (agent, session).
         """
         from hypervisor_tpu.session import SessionParticipantError
 
@@ -335,12 +339,11 @@ class Hypervisor:
             raise SessionParticipantError(
                 f"Agent {agent_did} already left session"
             )
-        row = self.state.agent_row(agent_did)
-        if row is None or row["session"] != managed.slot:
+        row = self.state.agent_row(agent_did, managed.slot)
+        if row is None:
             raise RuntimeError(
-                f"{agent_did}'s device row belongs to a later join in "
-                "another session; leave that session first (one device "
-                "row per agent — its most recent join)"
+                f"{agent_did} has no live device row in {session_id} — "
+                "plane divergence"
             )
         managed.sso.leave(agent_did)
         self.state.leave_agent(managed.slot, agent_did)
@@ -368,8 +371,8 @@ class Hypervisor:
         managed = self._require(session_id)
         before = managed.sso.get_participant(agent_did).ring
         managed.sso.update_ring(agent_did, new_ring)
-        row = self.state.agent_row(agent_did)
-        if row is not None and row["session"] == managed.slot:
+        row = self.state.agent_row(agent_did, managed.slot)
+        if row is not None:
             self.state.set_agent_ring(
                 row["slot"], new_ring.value, now=self.state.now()
             )
@@ -503,13 +506,26 @@ class Hypervisor:
             # blacklists the row, clips vouchers, and releases consumed
             # edges. It must see the pre-slash graph — the host slash
             # below releases bonds through the mirror as it clips.
-            rogue = self.state.agent_row(agent_did)
+            # Scoping matches the reference: the slash is AGENT-GLOBAL
+            # (`liability/slashing.py:88-89` zeroes the vouchee's sigma
+            # everywhere — its other session rows blacklist too), while
+            # quarantine is SESSION-scoped (`liability/quarantine.py:
+            # 73-118` isolates one (agent, session) membership) — only
+            # THIS session's row gets FLAG_QUARANTINED.
+            rogue = self.state.agent_row(agent_did, managed.slot)
             if rogue is not None:
                 self.state.apply_slash(
                     managed.slot,
                     rogue["slot"],
                     risk_weight=DRIFT_SLASH_RISK_WEIGHT,
                     now=self.state.now(),
+                )
+                self.state.blacklist_rows(
+                    [
+                        r["slot"]
+                        for r in self.state.agent_rows(agent_did)
+                        if r["slot"] != rogue["slot"]
+                    ]
                 )
                 # Read-only isolation before termination (SURVEY §5
                 # recovery): the device row carries FLAG_QUARANTINED;
@@ -567,11 +583,23 @@ class Hypervisor:
 
     def _mirror_vouch(self, record) -> None:
         """Host bond -> device VouchTable edge (when both agents and the
-        session are resident in the device tables)."""
+        session are resident in the device tables).
+
+        Endpoints resolve to their row IN the bond's session when they
+        are participants there; a voucher bonding into a session it
+        never joined (legal in the reference engine) hangs the edge on
+        its most recent row elsewhere.
+        """
         managed = self._sessions.get(record.session_id)
-        voucher = self.state.agent_row(record.voucher_did)
-        vouchee = self.state.agent_row(record.vouchee_did)
-        if managed is None or voucher is None or vouchee is None:
+        if managed is None:
+            return
+        voucher = self.state.agent_row(
+            record.voucher_did, managed.slot
+        ) or self.state.agent_row(record.voucher_did)
+        vouchee = self.state.agent_row(
+            record.vouchee_did, managed.slot
+        ) or self.state.agent_row(record.vouchee_did)
+        if voucher is None or vouchee is None:
             return
         try:
             edge = self.state.add_vouch(
@@ -610,6 +638,22 @@ class Hypervisor:
         for record in self.vouching.agent_records(agent_did):
             if record.is_active and record.vouch_id not in self._edge_of_vouch:
                 self._mirror_vouch(record)
+
+    def consistency_runtime(self, mesh):
+        """Bind a mixed-mode distributed tick driver to this facade's
+        device state (`runtime.consistency.ConsistencyRuntime`).
+
+        The session `mode` column — set from `SessionConfig.
+        consistency_mode` at create and force-flipped to STRONG when
+        non-reversible actions register (`force_session_mode`) — decides
+        each lane's path: STRONG rides the in-tick psum barrier,
+        EVENTUAL accumulates partials until `reconcile()`. This makes
+        the reference's stored-but-never-executed ConsistencyMode
+        (`models.py:12-16`) an actual execution property.
+        """
+        from hypervisor_tpu.runtime.consistency import ConsistencyRuntime
+
+        return ConsistencyRuntime(self.state, mesh)
 
     def sync_events_to_device(self) -> int:
         """Mirror new bus events into the device EventLog ring buffer.

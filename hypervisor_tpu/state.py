@@ -97,7 +97,12 @@ class HypervisorState:
         self._next_elev_slot = 0
         self._free_elev_slots: list[int] = []
         self._members: dict[tuple[int, int], bool] = {}  # (session, did) -> True
-        self._slot_of_did: dict[int, int] = {}           # did handle -> agent slot
+        # One device row per MEMBERSHIP — (did, session) -> agent slot.
+        # An agent live in several sessions holds several rows, each with
+        # its own ring/sigma/quarantine columns, so session-scoped actions
+        # (quarantine, demotion) in one session never poison the agent's
+        # standing in another (the round-2 plane-coherence bug).
+        self._slot_of_member: dict[tuple[int, int], int] = {}
         self._free_agent_slots: list[int] = []           # reclaimed from rejects
 
         # Timestamps are stored in f32 columns: keep them SMALL (relative
@@ -277,7 +282,7 @@ class HypervisorState:
             # Every wave row is dead after the wave: rejected rows were
             # never admitted, admitted rows belong to sessions this same
             # program terminated — all reclaim (device-table GC), and
-            # none are cached in _slot_of_did.
+            # none are cached in _slot_of_member.
             self._free_agent_slots.append(int(slot))
 
         # Record the wave's audit chain in the DeltaLog (lane-major).
@@ -434,7 +439,7 @@ class HypervisorState:
                     self._staged_members.discard((sess, did))
                 if st == admission.ADMIT_OK:
                     self._members[(sess, did)] = True
-                    self._slot_of_did[did] = slot
+                    self._slot_of_member[(did, sess)] = slot
                 else:
                     # A rejected join leaves no trace; its row is reusable.
                     self._free_agent_slots.append(slot)
@@ -497,17 +502,18 @@ class HypervisorState:
 
         Mirrors `SharedSessionObject.leave` (participant deactivates,
         count drops; membership stays recorded so a rejoin is still a
-        duplicate). The agent row returns to the free list and any vouch
-        edges referencing it are scrubbed (same slot-reuse hazard as
-        terminate-time reclamation; bonds survive host-side and
-        re-mirror if the agent joins again).
+        duplicate). The membership's row returns to the free list and any
+        vouch edges referencing it are scrubbed (same slot-reuse hazard
+        as terminate-time reclamation; bonds survive host-side and
+        re-mirror if the agent joins again). The agent's rows in OTHER
+        sessions are untouched.
         """
         # The whole mutation holds the staging lock, matching flush_joins:
         # an interleaved table read-modify-write from a concurrent flusher
         # would lose the deactivation while the slot is already freed.
         with self._enqueue_lock:
-            row = self.agent_row(agent_did)
-            if row is None or row["session"] != session_slot:
+            row = self.agent_row(agent_did, session_slot)
+            if row is None:
                 raise ValueError(
                     f"{agent_did} holds no active device row in session slot "
                     f"{session_slot}"
@@ -526,8 +532,8 @@ class HypervisorState:
                 ].add(-1),
             )
             did = int(np.asarray(self.agents.did)[slot])
-            if self._slot_of_did.get(did) == slot:
-                del self._slot_of_did[did]
+            if self._slot_of_member.get((did, session_slot)) == slot:
+                del self._slot_of_member[(did, session_slot)]
             self._free_agent_slots.append(slot)
 
             voucher = np.asarray(self.vouches.voucher)
@@ -594,6 +600,39 @@ class HypervisorState:
             "slashed": np.nonzero(np.asarray(result.slashed))[0].tolist(),
             "clipped": np.nonzero(np.asarray(result.clipped))[0].tolist(),
         }
+
+    def blacklist_rows(self, rows: Sequence[int]) -> None:
+        """Agent-global blacklist: sigma_eff -> 0, FLAG_BLACKLISTED, ring
+        recomputed (sandbox) on the given rows.
+
+        The reference slash zeroes the vouchee EVERYWHERE
+        (`liability/slashing.py:88-89` — sigma is agent-global), while
+        its cascade clips vouchers through the session's vouch graph.
+        `apply_slash` runs the session cascade on one row; the facade
+        passes the rogue agent's OTHER session rows here so the
+        blacklist follows the agent across sessions.
+        """
+        if not len(rows):
+            return
+        from hypervisor_tpu.ops import rings as ring_ops
+        from hypervisor_tpu.tables.state import FLAG_BLACKLISTED
+
+        idx = jnp.asarray(np.asarray(rows, np.int32))
+        sigma = self.agents.sigma_eff.at[idx].set(0.0)
+        rings = ring_ops.compute_rings(sigma, False)
+        touched = jnp.zeros(
+            (self.agents.did.shape[0],), bool
+        ).at[idx].set(True)
+        self.agents = replace(
+            self.agents,
+            sigma_eff=sigma,
+            ring=jnp.where(touched, rings, self.agents.ring).astype(jnp.int8),
+            flags=jnp.where(
+                touched,
+                self.agents.flags | FLAG_BLACKLISTED,
+                self.agents.flags,
+            ).astype(self.agents.flags.dtype),
+        )
 
     # ── sagas ────────────────────────────────────────────────────────
 
@@ -1239,12 +1278,13 @@ class HypervisorState:
 
         if len(reclaim):
             did_host = np.asarray(self.agents.did)
+            sess_host = np.asarray(self.agents.session)
             with self._enqueue_lock:
                 for row in reclaim:
                     row = int(row)
-                    did = int(did_host[row])
-                    if self._slot_of_did.get(did) == row:
-                        del self._slot_of_did[did]
+                    key = (int(did_host[row]), int(sess_host[row]))
+                    if self._slot_of_member.get(key) == row:
+                        del self._slot_of_member[key]
                     self._free_agent_slots.append(row)
             # Scrub dangling liability edges: a reclaimed agent row may
             # still be referenced by edges in OTHER sessions (a voucher
@@ -1280,26 +1320,72 @@ class HypervisorState:
     def participant_count(self, session_slot: int) -> int:
         return int(np.asarray(self.sessions.n_participants)[session_slot])
 
-    def agent_row(self, agent_did: str) -> Optional[dict]:
+    def agent_row(
+        self, agent_did: str, session_slot: Optional[int] = None
+    ) -> Optional[dict]:
+        """The agent's live device row — one per (agent, session).
+
+        With `session_slot`, the row of that specific membership (None if
+        the agent is not live there) — the cached hot path every facade
+        call uses. Without, the agent's MOST RECENT live row across
+        sessions (by joined_at — slot order lies once the free list
+        recycles rows): an O(N) numpy scan, acceptable for the
+        dashboard/API convenience calls that use it.
+        """
         did = self.agent_ids.lookup(agent_did)
         if did < 0:
             return None
-        i = self._slot_of_did.get(did)
-        if i is None:
-            # Slow path (e.g. state restored from a checkpoint): scan the
-            # table once and cache the mapping. Only LIVE rows match — a
-            # reclaimed row still carries its last did until reuse, and
-            # resurrecting it would later serve another agent's data
-            # under this did once the row is recycled.
+        if session_slot is not None:
+            i = self._slot_of_member.get((did, session_slot))
+            if i is None:
+                # Slow path (e.g. state restored from a checkpoint): scan
+                # and cache. Only LIVE rows match — a reclaimed row keeps
+                # its last did/session until reuse, and resurrecting it
+                # would later serve another agent's data under this did.
+                live = (np.asarray(self.agents.flags) & FLAG_ACTIVE) != 0
+                hits = np.nonzero(
+                    (np.asarray(self.agents.did) == did)
+                    & (np.asarray(self.agents.session) == session_slot)
+                    & live
+                )[0]
+                if len(hits) == 0:
+                    return None
+                i = int(hits[-1])
+                self._slot_of_member[(did, session_slot)] = i
+        else:
             live = (np.asarray(self.agents.flags) & FLAG_ACTIVE) != 0
             hits = np.nonzero((np.asarray(self.agents.did) == did) & live)[0]
             if len(hits) == 0:
                 return None
-            i = int(hits[-1])
-            self._slot_of_did[did] = i
+            joined = np.asarray(self.agents.joined_at)[hits]
+            i = int(hits[np.argmax(joined)])
         return {
             "slot": i,
             "session": int(np.asarray(self.agents.session)[i]),
             "sigma_eff": float(np.asarray(self.agents.sigma_eff)[i]),
             "ring": int(np.asarray(self.agents.ring)[i]),
         }
+
+    def agent_rows(self, agent_did: str) -> list[dict]:
+        """ALL live device rows of an agent, one per session membership,
+        in join order (by joined_at — slot order lies under row
+        recycling). Agent-global actions — the reference's slash
+        blacklists the agent everywhere — iterate these."""
+        did = self.agent_ids.lookup(agent_did)
+        if did < 0:
+            return []
+        live = (np.asarray(self.agents.flags) & FLAG_ACTIVE) != 0
+        hits = np.nonzero((np.asarray(self.agents.did) == did) & live)[0]
+        hits = hits[np.argsort(np.asarray(self.agents.joined_at)[hits], kind="stable")]
+        sess = np.asarray(self.agents.session)
+        sigma = np.asarray(self.agents.sigma_eff)
+        ring = np.asarray(self.agents.ring)
+        return [
+            {
+                "slot": int(i),
+                "session": int(sess[i]),
+                "sigma_eff": float(sigma[i]),
+                "ring": int(ring[i]),
+            }
+            for i in hits
+        ]

@@ -107,11 +107,6 @@ class PlaneCoherence(RuleBasedStateMachine):
             return
         sid = sids[pick % len(sids)]
         agent = sorted(self.joined[sid])[0]
-        row = self.hv.state.agent_row(agent)
-        if row is None or row["session"] != self.hv.get_session(sid).slot:
-            # The agent's single device row belongs to a later join in
-            # another session; facade leave would refuse. Skip.
-            return
         self.go(self.hv.leave_session(sid, agent))
         self.joined[sid].discard(agent)
 
@@ -156,7 +151,10 @@ class PlaneCoherence(RuleBasedStateMachine):
             return
         sid = sids[pick % len(sids)]
         agent = sorted(self.joined[sid])[0]
-        row = self.hv.state.agent_row(agent)
+        # Session-scoped on BOTH planes: flag the membership's row in
+        # THIS session (the round-2 bug flagged "the agent's row", which
+        # could belong to a later join in another session).
+        row = self.hv.state.agent_row(agent, self.hv.get_session(sid).slot)
         if row is None:
             return
         self.hv.quarantine.quarantine(
@@ -189,14 +187,14 @@ class PlaneCoherence(RuleBasedStateMachine):
         for sid in self.sessions:
             managed = self.hv.get_session(sid)
             for p in managed.sso.participants:
-                row = self.hv.state.agent_row(p.agent_did)
-                assert row is not None, f"{p.agent_did} missing from device"
+                # One device row per (agent, session): EVERY membership
+                # has its own row in its own session — no carve-outs.
+                row = self.hv.state.agent_row(p.agent_did, managed.slot)
+                assert row is not None, (
+                    f"{p.agent_did} missing from device in {sid}"
+                )
                 assert row["slot"] >= 0
-                # An agent in several sessions keeps one device row (its
-                # most recent join); ring parity is asserted against the
-                # session that row currently belongs to.
-                if row["session"] != managed.slot:
-                    continue
+                assert row["session"] == managed.slot
                 dev_ring = int(np.asarray(self.hv.state.agents.ring)[row["slot"]])
                 assert dev_ring == p.ring.value, (
                     f"ring mismatch for {p.agent_did}: host {p.ring.value} "
@@ -236,15 +234,16 @@ class PlaneCoherence(RuleBasedStateMachine):
 
     @invariant()
     def quarantine_planes_agree(self):
-        # Every device-flagged CURRENT-session participant must have a
-        # live host record (the converse can lag when the agent's device
-        # row moved to a later session — host records outlive rows).
+        # Quarantine is session-scoped on both planes: a flagged device
+        # row implies a live host record for THAT (agent, session) — and
+        # an agent flagged in one session is never flagged in another
+        # unless that other session quarantined it too.
         mask = self.hv.state.quarantined_mask()
         for sid in self.sessions:
             managed = self.hv.get_session(sid)
             for p in managed.sso.participants:
-                row = self.hv.state.agent_row(p.agent_did)
-                if row is None or row["session"] != managed.slot:
+                row = self.hv.state.agent_row(p.agent_did, managed.slot)
+                if row is None:
                     continue
                 if mask[row["slot"]]:
                     assert (
@@ -252,7 +251,7 @@ class PlaneCoherence(RuleBasedStateMachine):
                             p.agent_did, sid
                         )
                         is not None
-                    ), f"device-only quarantine for {p.agent_did}"
+                    ), f"device-only quarantine for {p.agent_did} in {sid}"
 
     @invariant()
     def delta_log_covers_every_capture(self):
@@ -267,7 +266,67 @@ class PlaneCoherence(RuleBasedStateMachine):
         )
 
 
+import os  # noqa: E402
+
+_DEEP = os.environ.get("HV_DEEP_STATEFUL", "") == "1"
 PlaneCoherence.TestCase.settings = settings(
-    max_examples=15, stateful_step_count=25, deadline=None
+    max_examples=60 if _DEEP else 20,
+    stateful_step_count=60 if _DEEP else 30,
+    deadline=None,
 )
 TestPlaneCoherence = PlaneCoherence.TestCase
+
+
+class TestCrossSessionQuarantineRegression:
+    """Pins the round-2 plane-coherence bug: agent joins session A, then
+    session B; quarantined in A. With one-row-per-agent the device flag
+    landed on the row belonging to B, which B's host QuarantineManager
+    knew nothing about — B's write waves refused the agent with no
+    explanation. Per-(agent, session) rows keep the planes coherent."""
+
+    def test_quarantine_in_a_does_not_poison_b(self):
+        from hypervisor_tpu.liability.quarantine import QuarantineReason
+
+        async def run():
+            hv = Hypervisor()
+            a = await hv.create_session(
+                SessionConfig(min_sigma_eff=0.0), creator_did="did:creator"
+            )
+            b = await hv.create_session(
+                SessionConfig(min_sigma_eff=0.0), creator_did="did:creator"
+            )
+            sid_a, sid_b = a.sso.session_id, b.sso.session_id
+            await hv.join_session(sid_a, "did:x", sigma_raw=0.8)
+            await hv.join_session(sid_b, "did:x", sigma_raw=0.8)
+
+            # Both memberships hold live device rows in their sessions.
+            row_a = hv.state.agent_row("did:x", a.slot)
+            row_b = hv.state.agent_row("did:x", b.slot)
+            assert row_a is not None and row_b is not None
+            assert row_a["slot"] != row_b["slot"]
+            assert row_a["session"] == a.slot
+            assert row_b["session"] == b.slot
+
+            # Quarantine in A (host record + device flag on A's row).
+            hv.quarantine.quarantine(
+                "did:x", sid_a, QuarantineReason.MANUAL, details="repro"
+            )
+            hv.state.quarantine_rows([row_a["slot"]], now=hv.state.now())
+
+            mask = hv.state.quarantined_mask()
+            assert mask[row_a["slot"]], "A's membership row must be flagged"
+            assert not mask[row_b["slot"]], (
+                "B's membership row must NOT be flagged — the round-2 bug"
+            )
+            # B's write path still serves the agent.
+            assert (
+                hv.quarantine.get_active_quarantine("did:x", sid_b) is None
+            )
+
+            # And the agent can still leave A (the old one-row constraint
+            # refused when a later join owned 'the' row).
+            await hv.leave_session(sid_a, "did:x")
+            assert hv.state.agent_row("did:x", a.slot) is None
+            assert hv.state.agent_row("did:x", b.slot) is not None
+
+        asyncio.run(run())

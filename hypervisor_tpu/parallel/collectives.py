@@ -135,107 +135,10 @@ def sharded_admission(mesh: Mesh, trust: TrustConfig = DEFAULT_CONFIG.trust):
         now,
         omega,
     ):
-        b_local = slot.shape[0]
-        rows_per_shard = agents.did.shape[0]
-        my_shard = jax.lax.axis_index(AGENT_AXIS)
-        local_slot = slot - my_shard * rows_per_shard
-
-        # ── vouched contributions: segmented psum over edge shards ────
-        n_global = rows_per_shard * n_shards
-        # Each shard marks only its own wave elements; psum merges the
-        # shards' sparse marks into the full slot -> session map (+2 bias
-        # makes unset rows contribute zero).
-        target_session = (
-            jnp.full((n_global,), -2, jnp.int32).at[slot].set(session_slot)
+        return _wave_admission(
+            agents, sessions, vouches, slot, did, session_slot,
+            sigma_raw, trustworthy, duplicate, now, omega, n_shards, trust,
         )
-        target_session = jax.lax.psum(target_session + 2, AGENT_AXIS) - 2
-        local_contrib = liability_ops.contribution_toward(
-            vouches, target_session, now
-        )
-        contribution = jax.lax.psum(local_contrib, AGENT_AXIS)[slot]
-        sigma_eff = jnp.minimum(
-            sigma_raw + jnp.asarray(omega, jnp.float32) * contribution, 1.0
-        )
-
-        # ── globally consistent pre-checks ────────────────────────────
-        sess_state = sessions.state[session_slot]
-        sess_count = sessions.n_participants[session_slot]
-        sess_max = sessions.max_participants[session_slot]
-        sess_min = sessions.min_sigma_eff[session_slot]
-        ring = ring_ops.compute_rings(sigma_eff, False, trust)
-        ring = jnp.where(trustworthy, ring, jnp.int8(3))
-        bad_state = (sess_state != SessionState.HANDSHAKING.code) & (
-            sess_state != SessionState.ACTIVE.code
-        )
-        sigma_low = (sigma_eff < sess_min) & (ring != 3)
-
-        status = jnp.full((b_local,), admission_ops.ADMIT_OK, jnp.int8)
-
-        def claim(status, cond, code):
-            return jnp.where(
-                (status == admission_ops.ADMIT_OK) & cond, jnp.int8(code), status
-            )
-
-        status = claim(status, bad_state, admission_ops.ADMIT_BAD_STATE)
-        status = claim(status, duplicate, admission_ops.ADMIT_DUPLICATE)
-        status = claim(status, sigma_low, admission_ops.ADMIT_SIGMA_LOW)
-        passed_other = status == admission_ops.ADMIT_OK
-
-        # ── global capacity ranking (all_gather over ICI) ─────────────
-        gsess = jax.lax.all_gather(session_slot, AGENT_AXIS, tiled=True)
-        gpass = jax.lax.all_gather(passed_other, AGENT_AXIS, tiled=True)
-        mine = my_shard * b_local + jnp.arange(b_local, dtype=jnp.int32)
-        j = jnp.arange(gsess.shape[0], dtype=jnp.int32)
-        rank = jnp.sum(
-            (j[None, :] < mine[:, None])
-            & (gsess[None, :] == session_slot[:, None])
-            & gpass[None, :],
-            axis=1,
-        )
-        over = passed_other & ((sess_count + rank) >= sess_max)
-        status = claim(status, over, admission_ops.ADMIT_CAPACITY)
-        ok = status == admission_ops.ADMIT_OK
-
-        # ── local agent-shard writes ──────────────────────────────────
-        # Scatter at each element's REAL row (distinct by the slot
-        # contract), keeping the old value where rejected — a shared
-        # park row would give rejected lanes a duplicate index that can
-        # clobber an admitted agent landing on that row.
-        write = local_slot
-        now_f = jnp.asarray(now, jnp.float32)
-        agents = t_replace(
-            agents,
-            did=agents.did.at[write].set(jnp.where(ok, did, agents.did[write])),
-            session=agents.session.at[write].set(
-                jnp.where(ok, session_slot, agents.session[write])
-            ),
-            sigma_raw=agents.sigma_raw.at[write].set(
-                jnp.where(ok, sigma_raw, agents.sigma_raw[write])
-            ),
-            sigma_eff=agents.sigma_eff.at[write].set(
-                jnp.where(ok, sigma_eff, agents.sigma_eff[write])
-            ),
-            ring=agents.ring.at[write].set(
-                jnp.where(ok, ring, agents.ring[write])
-            ),
-            flags=agents.flags.at[write].set(
-                jnp.where(ok, FLAG_ACTIVE, agents.flags[write])
-            ),
-            joined_at=agents.joined_at.at[write].set(
-                jnp.where(ok, now_f, agents.joined_at[write])
-            ),
-        )
-
-        # ── replicated session table: allreduce the ACTUAL delta ──────
-        s_cap = sessions.sid.shape[0]
-        local_add = jnp.zeros((s_cap,), jnp.int32).at[
-            jnp.clip(session_slot, 0)
-        ].add(jnp.where(ok, 1, 0))
-        global_add = jax.lax.psum(local_add, AGENT_AXIS)
-        sessions = t_replace(
-            sessions, n_participants=sessions.n_participants + global_add
-        )
-        return agents, sessions, status, ring, sigma_eff
 
     lane = P(AGENT_AXIS)
     rep = P()
@@ -252,6 +155,128 @@ def sharded_admission(mesh: Mesh, trust: TrustConfig = DEFAULT_CONFIG.trust):
         out_specs=(lane, rep, lane, lane, lane),
     )
     return jax.jit(mapped)
+
+
+def _wave_admission(
+    agents,
+    sessions,
+    vouches,
+    slot,
+    did,
+    session_slot,
+    sigma_raw,
+    trustworthy,
+    duplicate,
+    now,
+    omega,
+    n_shards,
+    trust,
+):
+    """The cross-shard admission body (inside shard_map) shared by
+    `sharded_admission` and `sharded_governance_wave` so the two can
+    never drift. See `sharded_admission` for the collective design."""
+    b_local = slot.shape[0]
+    rows_per_shard = agents.did.shape[0]
+    my_shard = jax.lax.axis_index(AGENT_AXIS)
+    local_slot = slot - my_shard * rows_per_shard
+
+    # ── vouched contributions: segmented psum over edge shards ────
+    n_global = rows_per_shard * n_shards
+    # Each shard marks only its own wave elements; psum merges the
+    # shards' sparse marks into the full slot -> session map (+2 bias
+    # makes unset rows contribute zero).
+    target_session = (
+        jnp.full((n_global,), -2, jnp.int32).at[slot].set(session_slot)
+    )
+    target_session = jax.lax.psum(target_session + 2, AGENT_AXIS) - 2
+    local_contrib = liability_ops.contribution_toward(
+        vouches, target_session, now
+    )
+    contribution = jax.lax.psum(local_contrib, AGENT_AXIS)[slot]
+    sigma_eff = jnp.minimum(
+        sigma_raw + jnp.asarray(omega, jnp.float32) * contribution, 1.0
+    )
+
+    # ── globally consistent pre-checks ────────────────────────────
+    sess_state = sessions.state[session_slot]
+    sess_count = sessions.n_participants[session_slot]
+    sess_max = sessions.max_participants[session_slot]
+    sess_min = sessions.min_sigma_eff[session_slot]
+    ring = ring_ops.compute_rings(sigma_eff, False, trust)
+    ring = jnp.where(trustworthy, ring, jnp.int8(3))
+    bad_state = (sess_state != SessionState.HANDSHAKING.code) & (
+        sess_state != SessionState.ACTIVE.code
+    )
+    sigma_low = (sigma_eff < sess_min) & (ring != 3)
+
+    status = jnp.full((b_local,), admission_ops.ADMIT_OK, jnp.int8)
+
+    def claim(status, cond, code):
+        return jnp.where(
+            (status == admission_ops.ADMIT_OK) & cond, jnp.int8(code), status
+        )
+
+    status = claim(status, bad_state, admission_ops.ADMIT_BAD_STATE)
+    status = claim(status, duplicate, admission_ops.ADMIT_DUPLICATE)
+    status = claim(status, sigma_low, admission_ops.ADMIT_SIGMA_LOW)
+    passed_other = status == admission_ops.ADMIT_OK
+
+    # ── global capacity ranking (all_gather over ICI) ─────────────
+    gsess = jax.lax.all_gather(session_slot, AGENT_AXIS, tiled=True)
+    gpass = jax.lax.all_gather(passed_other, AGENT_AXIS, tiled=True)
+    mine = my_shard * b_local + jnp.arange(b_local, dtype=jnp.int32)
+    j = jnp.arange(gsess.shape[0], dtype=jnp.int32)
+    rank = jnp.sum(
+        (j[None, :] < mine[:, None])
+        & (gsess[None, :] == session_slot[:, None])
+        & gpass[None, :],
+        axis=1,
+    )
+    over = passed_other & ((sess_count + rank) >= sess_max)
+    status = claim(status, over, admission_ops.ADMIT_CAPACITY)
+    ok = status == admission_ops.ADMIT_OK
+
+    # ── local agent-shard writes ──────────────────────────────────
+    # Scatter at each element's REAL row (distinct by the slot
+    # contract), keeping the old value where rejected — a shared
+    # park row would give rejected lanes a duplicate index that can
+    # clobber an admitted agent landing on that row.
+    write = local_slot
+    now_f = jnp.asarray(now, jnp.float32)
+    agents = t_replace(
+        agents,
+        did=agents.did.at[write].set(jnp.where(ok, did, agents.did[write])),
+        session=agents.session.at[write].set(
+            jnp.where(ok, session_slot, agents.session[write])
+        ),
+        sigma_raw=agents.sigma_raw.at[write].set(
+            jnp.where(ok, sigma_raw, agents.sigma_raw[write])
+        ),
+        sigma_eff=agents.sigma_eff.at[write].set(
+            jnp.where(ok, sigma_eff, agents.sigma_eff[write])
+        ),
+        ring=agents.ring.at[write].set(
+            jnp.where(ok, ring, agents.ring[write])
+        ),
+        flags=agents.flags.at[write].set(
+            jnp.where(ok, FLAG_ACTIVE, agents.flags[write])
+        ),
+        joined_at=agents.joined_at.at[write].set(
+            jnp.where(ok, now_f, agents.joined_at[write])
+        ),
+    )
+
+    # ── replicated session table: allreduce the ACTUAL delta ──────
+    s_cap = sessions.sid.shape[0]
+    local_add = jnp.zeros((s_cap,), jnp.int32).at[
+        jnp.clip(session_slot, 0)
+    ].add(jnp.where(ok, 1, 0))
+    global_add = jax.lax.psum(local_add, AGENT_AXIS)
+    sessions = t_replace(
+        sessions, n_participants=sessions.n_participants + global_add
+    )
+    return agents, sessions, status, ring, sigma_eff
+
 
 
 def eventual_tick(mesh: Mesh):
@@ -358,6 +383,116 @@ def sharded_chain(mesh: Mesh):
             out_specs=P(AGENT_AXIS, None, None),
         )
     )
+
+
+def mode_tick(mesh: Mesh):
+    """One governance tick over MIXED-consistency lanes: the session
+    `mode` column decides which barrier each lane's table delta rides.
+
+    STRONG lanes' per-session participant deltas are psum'd over ICI and
+    folded into the replicated SessionTable IN-tick (the consensus
+    barrier); EVENTUAL lanes' deltas come back as per-shard partials
+    with ZERO in-tick communication — the caller accumulates them and
+    folds between batched ticks via `reconcile_sessions` (the facade's
+    `ConsistencyRuntime.reconcile`). This is the device-plane meaning of
+    the reference's `ConsistencyMode` flag (`models.py:12-16`), which
+    the reference stores but never executes on.
+
+    Returns fn(sessions, lane_session, strong_mask, sigma_raw,
+    trustworthy, min_sigma_eff, delta_bodies, active) ->
+    (PipelineResult, sessions', eventual_count_partials [D, S_cap],
+    eventual_sigma_partials [D, S_cap]) with every [S]-leading lane
+    input sharded and `sessions` replicated.
+    """
+    lane = P(AGENT_AXIS)
+    use_pallas = _mesh_uses_pallas(mesh)
+
+    def tick(
+        sessions,
+        lane_session,
+        strong_mask,
+        sigma_raw,
+        trustworthy,
+        min_sigma_eff,
+        delta_bodies,
+        active,
+    ):
+        result = governance_pipeline(
+            sigma_raw,
+            trustworthy,
+            min_sigma_eff,
+            delta_bodies,
+            active,
+            use_pallas=use_pallas,
+        )
+        ok = (result.status == 0) & active
+        s_cap = sessions.sid.shape[0]
+        okc = jnp.where(ok, 1, 0)
+        oks = jnp.where(ok, result.sigma_eff, 0.0)
+
+        # STRONG lanes: in-tick consensus fold (psum over ICI).
+        strong_counts = (
+            jnp.zeros((s_cap,), jnp.int32)
+            .at[jnp.clip(lane_session, 0)]
+            .add(jnp.where(strong_mask, okc, 0))
+        )
+        strong_counts = jax.lax.psum(strong_counts, AGENT_AXIS)
+        sessions = t_replace(
+            sessions, n_participants=sessions.n_participants + strong_counts
+        )
+        # The consensus vector rides the in-tick barrier for STRONG
+        # lanes only (EVENTUAL lanes must cost zero in-tick traffic).
+        okf = (ok & strong_mask).astype(jnp.float32)
+        strong_consensus = jnp.stack(
+            [
+                jnp.sum(okf),
+                jnp.sum(result.sigma_eff * okf),
+                jnp.sum(result.ring.astype(jnp.float32) * okf),
+                jnp.sum(result.merkle_root[:, 0].astype(jnp.float32) * okf),
+            ]
+        )
+        result = result._replace(
+            consensus=jax.lax.psum(strong_consensus, AGENT_AXIS)
+        )
+
+        # EVENTUAL lanes: local partials only — no collective touches
+        # them until the caller's between-tick reconcile.
+        ev_counts = (
+            jnp.zeros((s_cap,), jnp.int32)
+            .at[jnp.clip(lane_session, 0)]
+            .add(jnp.where(strong_mask, 0, okc))
+        )
+        ev_sigma = (
+            jnp.zeros((s_cap,), jnp.float32)
+            .at[jnp.clip(lane_session, 0)]
+            .add(jnp.where(strong_mask, 0.0, oks))
+        )
+        return result, sessions, ev_counts[None], ev_sigma[None]
+
+    mapped = shard_map(
+        tick,
+        mesh=mesh,
+        in_specs=(
+            P(),                        # sessions replicated
+            lane, lane, lane, lane, lane,
+            P(None, AGENT_AXIS), lane,
+        ),
+        out_specs=(
+            PipelineResult(
+                ring=lane,
+                sigma_eff=lane,
+                session_state=lane,
+                saga_step_state=lane,
+                merkle_root=lane,
+                status=lane,
+                consensus=P(),
+            ),
+            P(),
+            P(AGENT_AXIS, None),        # [D, S_cap] eventual partials
+            P(AGENT_AXIS, None),
+        ),
+    )
+    return jax.jit(mapped)
 
 
 def reconcile_sessions(mesh: Mesh):
@@ -493,3 +628,186 @@ def sharded_slash(mesh: Mesh, trust: TrustConfig = DEFAULT_CONFIG.trust):
             ),
         )
     )
+
+
+def sharded_governance_wave(
+    mesh: Mesh, trust: TrustConfig = DEFAULT_CONFIG.trust
+):
+    """The FUSED full-governance wave, end-to-end sharded (round-3 item).
+
+    One shard_map program over the REAL state tables — the multi-chip
+    twin of `ops.pipeline.governance_wave` (reference semantics anchor:
+    `benchmarks/bench_hypervisor.py:217-239`): AgentTable rows and
+    VouchTable edges shard over the mesh agent axis, the SessionTable is
+    replicated and updated only through psum'd deltas so every chip's
+    replica stays bit-identical. Phases and their collectives:
+
+      1-2. vouched admission — `_wave_admission` (the exact body
+           `sharded_admission` runs): contribution psum, all_gather
+           capacity ranking, psum'd session-count delta,
+      3.   session FSM HANDSHAKING -> ACTIVE on each shard's K/D wave
+           lanes, folded into the replica via a psum'd state delta
+           (each wave session lives on exactly one shard),
+      4.   audit — chained SHA-256 + Merkle roots on the local lanes
+           (lane-parallel; no collective needed),
+      5.   one saga step per joining agent (lane-parallel),
+      6.   terminate — the in_wave mask is psum-merged so EVERY shard
+           releases its own edge/agent blocks for ALL wave sessions;
+           released counts psum to the global total; the ARCHIVED walk
+           folds in like phase 3.
+
+    Contracts: wave length B and session count K divisible by the mesh
+    size; wave element i's agent slot lives on shard i // (B/D)
+    (`sharded_admission`'s slot contract); wave session j is hashed on
+    shard j // (K/D). Returns the same `WaveResult` as the single-device
+    wave — `tests/parity/test_sharded_wave.py` pins bit-parity.
+    """
+    from hypervisor_tpu.ops import saga_ops, session_fsm
+    from hypervisor_tpu.ops import merkle as merkle_ops
+    from hypervisor_tpu.ops import terminate as terminate_ops
+    from hypervisor_tpu.ops.pipeline import WaveResult
+
+    n_shards = mesh.devices.size
+    use_pallas = _mesh_uses_pallas(mesh)
+
+    def step(
+        agents,
+        sessions,
+        vouches,
+        slot,
+        did,
+        session_slot,
+        sigma_raw,
+        trustworthy,
+        duplicate,
+        wave_sessions,
+        delta_bodies,
+        now,
+        omega,
+    ):
+        now_f = jnp.asarray(now, jnp.float32)
+        s_cap = sessions.sid.shape[0]
+
+        # ── 1-2. cross-shard vouched admission ────────────────────────
+        agents, sessions, status, ring, sigma_eff = _wave_admission(
+            agents, sessions, vouches, slot, did, session_slot,
+            sigma_raw, trustworthy, duplicate, now, omega, n_shards, trust,
+        )
+        ok = status == admission_ops.ADMIT_OK
+
+        # ── 3. FSM walk on this shard's wave lanes ────────────────────
+        ws = wave_sessions                       # i32[K/D] local lanes
+        state_before = sessions.state[ws]
+        has_members = sessions.n_participants[ws] > 0
+        wave_state, err_a = session_fsm.apply_session_transitions(
+            state_before, jnp.int8(SessionState.ACTIVE.code), has_members
+        )
+
+        # ── 4. audit: chain + Merkle roots, lane-parallel ─────────────
+        t = delta_bodies.shape[0]
+        chain = merkle_ops.chain_digests(delta_bodies, use_pallas=use_pallas)
+        p = 1 << max(0, (t - 1).bit_length())
+        k_local = ws.shape[0]
+        leaves = jnp.zeros((k_local, p, 8), jnp.uint32)
+        leaves = leaves.at[:, :t].set(jnp.transpose(chain, (1, 0, 2)))
+        roots = merkle_ops.merkle_root_lanes(
+            leaves, jnp.int32(t), use_pallas=use_pallas
+        )
+
+        # ── 5. one saga step per joining agent ────────────────────────
+        step_state = jnp.full(slot.shape, saga_ops.STEP_PENDING, jnp.int8)
+        step_state, _ = saga_ops.execute_attempt(
+            step_state, success=ok, retries_left=jnp.zeros(slot.shape, jnp.int8)
+        )
+
+        # ── 6. terminate: global wave mask, local block release ───────
+        local_mask = (
+            jnp.zeros((s_cap,), jnp.int32).at[jnp.clip(ws, 0)].set(1)
+        )
+        in_wave = jax.lax.psum(local_mask, AGENT_AXIS) > 0
+        agents, vouches, released_local = terminate_ops.release_session_scope(
+            agents, vouches, in_wave
+        )
+        released = jax.lax.psum(released_local, AGENT_AXIS)
+
+        wave_state, err_t = session_fsm.apply_session_transitions(
+            wave_state, jnp.int8(SessionState.TERMINATING.code), has_members
+        )
+        wave_state, err_z = session_fsm.apply_session_transitions(
+            wave_state, jnp.int8(SessionState.ARCHIVED.code), has_members
+        )
+
+        # Fold the lanes' FSM outcomes into the replicated table: each
+        # wave session lives on exactly ONE shard, so a psum of masked
+        # scatters reconstructs the full update bit-exactly on every
+        # replica (a delta-sum would drift in f32 when old values are
+        # nonzero; the mask keeps it an exact overwrite).
+        owned = jnp.zeros((s_cap,), jnp.int32).at[jnp.clip(ws, 0)].add(1)
+        owned = jax.lax.psum(owned, AGENT_AXIS) > 0
+        state_val = (
+            jnp.zeros((s_cap,), jnp.int32)
+            .at[jnp.clip(ws, 0)]
+            .add(wave_state.astype(jnp.int32))
+        )
+        state_val = jax.lax.psum(state_val, AGENT_AXIS)
+        term_val = (
+            jnp.zeros((s_cap,), jnp.float32)
+            .at[jnp.clip(ws, 0)]
+            .add(jnp.where(has_members, now_f, sessions.terminated_at[ws]))
+        )
+        term_val = jax.lax.psum(term_val, AGENT_AXIS)
+        sessions = t_replace(
+            sessions,
+            state=jnp.where(
+                owned, state_val, sessions.state.astype(jnp.int32)
+            ).astype(jnp.int8),
+            terminated_at=jnp.where(
+                owned, term_val, sessions.terminated_at
+            ),
+        )
+
+        return WaveResult(
+            agents=agents,
+            sessions=sessions,
+            vouches=vouches,
+            status=status,
+            ring=ring,
+            sigma_eff=sigma_eff,
+            saga_step_state=step_state,
+            merkle_root=roots,
+            chain=chain,
+            fsm_error=err_a | err_t | err_z,
+            released=released,
+        )
+
+    lane = P(AGENT_AXIS)
+    rep = P()
+    # Pytree-prefix specs: one spec covers a whole table's columns (same
+    # convention as sharded_admission above).
+    mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(
+            lane,                   # agents: rows sharded
+            rep,                    # sessions: replicated
+            lane,                   # vouches: edges sharded
+            lane, lane, lane, lane, lane, lane,   # wave columns [B]
+            lane,                   # wave_sessions [K]
+            P(None, AGENT_AXIS, None),            # delta_bodies [T, K, W]
+            rep, rep,               # now, omega
+        ),
+        out_specs=WaveResult(
+            agents=lane,
+            sessions=rep,
+            vouches=lane,
+            status=lane,
+            ring=lane,
+            sigma_eff=lane,
+            saga_step_state=lane,
+            merkle_root=lane,
+            chain=P(None, AGENT_AXIS, None),
+            fsm_error=lane,
+            released=rep,
+        ),
+    )
+    return jax.jit(mapped)

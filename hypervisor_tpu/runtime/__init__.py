@@ -12,6 +12,7 @@ from hypervisor_tpu.runtime.native import (
 
 __all__ = [
     "HAVE_NATIVE",
+    "ConsistencyRuntime",
     "StagingQueue",
     "chain_digests_host",
     "merkle_root_hex_host",
@@ -29,4 +30,8 @@ def __getattr__(name):
         from hypervisor_tpu.runtime import checkpoint
 
         return getattr(checkpoint, name)
+    if name == "ConsistencyRuntime":
+        from hypervisor_tpu.runtime.consistency import ConsistencyRuntime
+
+        return ConsistencyRuntime
     raise AttributeError(name)

@@ -163,6 +163,8 @@ class Hypervisor:
         self._events_mirrored = 0
 
         self._sessions: dict[str, ManagedSession] = {}
+        # Keyed by Mesh (hashable): same mesh -> same runtime instance.
+        self._consistency_runtimes: dict[Any, Any] = {}
 
     # ── lifecycle ────────────────────────────────────────────────────
 
@@ -640,7 +642,7 @@ class Hypervisor:
                 self._mirror_vouch(record)
 
     def consistency_runtime(self, mesh):
-        """Bind a mixed-mode distributed tick driver to this facade's
+        """The mixed-mode distributed tick driver bound to this facade's
         device state (`runtime.consistency.ConsistencyRuntime`).
 
         The session `mode` column — set from `SessionConfig.
@@ -650,10 +652,19 @@ class Hypervisor:
         EVENTUAL accumulates partials until `reconcile()`. This makes
         the reference's stored-but-never-executed ConsistencyMode
         (`models.py:12-16`) an actual execution property.
+
+        Cached per mesh: pending EVENTUAL partials live on the runtime,
+        so repeated calls MUST return the same instance (a fresh one
+        would strand deltas already ticked), and the compiled
+        tick/reconcile programs are reused.
         """
         from hypervisor_tpu.runtime.consistency import ConsistencyRuntime
 
-        return ConsistencyRuntime(self.state, mesh)
+        cached = self._consistency_runtimes.get(mesh)
+        if cached is None:
+            cached = ConsistencyRuntime(self.state, mesh)
+            self._consistency_runtimes[mesh] = cached
+        return cached
 
     def sync_events_to_device(self) -> int:
         """Mirror new bus events into the device EventLog ring buffer.

@@ -39,6 +39,42 @@ _S_HANDSHAKING = 1
 _S_ACTIVE = 2
 
 
+def admit_row_blocks(
+    did: jnp.ndarray,           # i32[B]
+    session_slot: jnp.ndarray,  # i32[B]
+    sigma_raw: jnp.ndarray,     # f32[B]
+    sigma_eff: jnp.ndarray,     # f32[B]
+    now: jnp.ndarray | float,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """([B, 8] f32, [B, 5] i32) freshly-admitted row blocks.
+
+    The ONE place the packed column order is spelled out for admission
+    writes (by the AF32_*/AI32_* index constants) — `admit_batch` and the
+    sharded `_wave_admission` both scatter these, so the layouts cannot
+    drift. A row write covers EVERY column: per-membership accumulators
+    (risk, rate-limit bucket, breach window, quarantine deadline) reset
+    to their create() defaults, so a recycled slot never leaks the
+    previous tenant's budgets into a new membership.
+    """
+    from hypervisor_tpu.tables import state as tables_state
+
+    b = did.shape[0]
+    now_f = jnp.broadcast_to(jnp.asarray(now, jnp.float32), (b,))
+    f32_rows = jnp.zeros((b, 8), jnp.float32)
+    f32_rows = (
+        f32_rows.at[:, tables_state.AF32_SIGMA_RAW].set(sigma_raw)
+        .at[:, tables_state.AF32_SIGMA_EFF].set(sigma_eff)
+        .at[:, tables_state.AF32_JOINED_AT].set(now_f)
+    )
+    i32_rows = jnp.zeros((b, 5), jnp.int32)
+    i32_rows = (
+        i32_rows.at[:, tables_state.AI32_DID].set(did)
+        .at[:, tables_state.AI32_SESSION].set(session_slot)
+        .at[:, tables_state.AI32_FLAGS].set(FLAG_ACTIVE)
+    )
+    return f32_rows, i32_rows
+
+
 def _rank_within_session(session_slot: jnp.ndarray) -> jnp.ndarray:
     """i32[B]: how many earlier wave elements target the same session.
 
@@ -133,24 +169,22 @@ def admit_batch(
     # are preallocated-unique, and each reject gets its own distinct OOB
     # index, so the unique-indices fast path's contract holds for the
     # whole wave.
+    #
+    # Packed layout: the old 7 per-column scatters are now 3 (one [B, 8]
+    # f32 row block, one [B, 5] i32 row block, the i8 ring column).
     b = slot.shape[0]
     write_slot = jnp.where(
         ok, slot, agents.did.shape[0] + jnp.arange(b, dtype=slot.dtype)
     )
-    now_f = jnp.asarray(now, jnp.float32)
     drop = dict(mode="drop", unique_indices=True)
-
+    f32_rows, i32_rows = admit_row_blocks(
+        did, session_slot, sigma_raw, sigma_eff, now
+    )
     new_agents = replace(
         agents,
-        did=agents.did.at[write_slot].set(did, **drop),
-        session=agents.session.at[write_slot].set(session_slot, **drop),
-        sigma_raw=agents.sigma_raw.at[write_slot].set(sigma_raw, **drop),
-        sigma_eff=agents.sigma_eff.at[write_slot].set(sigma_eff, **drop),
+        f32=agents.f32.at[write_slot].set(f32_rows, **drop),
+        i32=agents.i32.at[write_slot].set(i32_rows, **drop),
         ring=agents.ring.at[write_slot].set(ring, **drop),
-        flags=agents.flags.at[write_slot].set(
-            jnp.asarray(FLAG_ACTIVE, agents.flags.dtype), **drop
-        ),
-        joined_at=agents.joined_at.at[write_slot].set(now_f, **drop),
     )
     new_sessions = replace(
         sessions,

@@ -240,29 +240,24 @@ def _wave_admission(
     # Scatter at each element's REAL row (distinct by the slot
     # contract), keeping the old value where rejected — a shared
     # park row would give rejected lanes a duplicate index that can
-    # clobber an admitted agent landing on that row.
+    # clobber an admitted agent landing on that row. Packed blocks:
+    # one [B, 8] f32 row scatter + one [B, 5] i32 + the ring column
+    # (`admission.admit_row_blocks` is the single source of the
+    # layout + accumulator-reset semantics, shared with admit_batch).
     write = local_slot
-    now_f = jnp.asarray(now, jnp.float32)
+    f32_rows, i32_rows = admission_ops.admit_row_blocks(
+        did, session_slot, sigma_raw, sigma_eff, now
+    )
     agents = t_replace(
         agents,
-        did=agents.did.at[write].set(jnp.where(ok, did, agents.did[write])),
-        session=agents.session.at[write].set(
-            jnp.where(ok, session_slot, agents.session[write])
+        f32=agents.f32.at[write].set(
+            jnp.where(ok[:, None], f32_rows, agents.f32[write])
         ),
-        sigma_raw=agents.sigma_raw.at[write].set(
-            jnp.where(ok, sigma_raw, agents.sigma_raw[write])
-        ),
-        sigma_eff=agents.sigma_eff.at[write].set(
-            jnp.where(ok, sigma_eff, agents.sigma_eff[write])
+        i32=agents.i32.at[write].set(
+            jnp.where(ok[:, None], i32_rows, agents.i32[write])
         ),
         ring=agents.ring.at[write].set(
             jnp.where(ok, ring, agents.ring[write])
-        ),
-        flags=agents.flags.at[write].set(
-            jnp.where(ok, FLAG_ACTIVE, agents.flags[write])
-        ),
-        joined_at=agents.joined_at.at[write].set(
-            jnp.where(ok, now_f, agents.joined_at[write])
         ),
     )
 

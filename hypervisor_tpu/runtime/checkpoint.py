@@ -177,6 +177,47 @@ def save_state(
     return target
 
 
+def _repack_legacy_agent_columns(data) -> dict:
+    """Checkpoints written before the AgentTable column packing saved one
+    array per column (`agents.sigma_raw`, ...); stack them into the
+    packed `agents.f32` / `agents.i32` blocks so old checkpoints restore
+    losslessly. No-op for current-format checkpoints."""
+    if "agents.f32" in data or "agents.sigma_raw" not in data:
+        return data if isinstance(data, dict) else {k: data[k] for k in data.files}
+    out = {k: data[k] for k in (data.files if hasattr(data, "files") else data)}
+    n = len(np.asarray(out["agents.sigma_raw"]))
+    # Derive the block layouts from the live schema (AgentTable._PACKED:
+    # name -> (block, idx)) so this repack can never drift from it.
+    from hypervisor_tpu.tables.state import AgentTable
+
+    by_block: dict[str, list[str]] = {}
+    for name, (block, idx) in AgentTable._PACKED.items():
+        cols = by_block.setdefault(block, [])
+        while len(cols) <= idx:
+            cols.append("")
+        cols[idx] = name
+
+    def col(name, dtype, default=0):
+        # A column the legacy save predates restores as its default
+        # (same forward-compat rule the per-column loader had).
+        arr = out.pop(f"agents.{name}", None)
+        if arr is None:
+            return np.full((n,), default, dtype)
+        return np.asarray(arr, dtype)
+
+    out["agents.f32"] = np.stack(
+        [col(name, np.float32) for name in by_block["f32"]], axis=1
+    )
+    out["agents.i32"] = np.stack(
+        [
+            col(name, np.int32, default=-1 if name in ("did", "session") else 0)
+            for name in by_block["i32"]
+        ],
+        axis=1,
+    )
+    return out
+
+
 def restore_state(
     checkpoint: str | Path, config: HypervisorConfig = DEFAULT_CONFIG
 ) -> HypervisorState:
@@ -210,6 +251,7 @@ def _rebuild(data, meta: dict, config: HypervisorConfig) -> HypervisorState:
             )
 
     state = HypervisorState(config)
+    data = _repack_legacy_agent_columns(data)
     for tname, ttype in _TABLE_TYPES.items():
         fields = dataclasses.fields(ttype)
         cols = {

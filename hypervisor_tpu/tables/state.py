@@ -23,42 +23,68 @@ FLAG_BLACKLISTED = 1 << 3
 FLAG_PROBATIONARY = 1 << 4
 
 
-@table
-class AgentTable:
-    """[N_agents] columns. Row index == agent slot; `did` maps slot -> intern handle."""
+# AgentTable packed-block column indices (see struct.table "packed").
+AF32_SIGMA_RAW = 0
+AF32_SIGMA_EFF = 1
+AF32_JOINED_AT = 2
+AF32_RISK = 3
+AF32_RL_TOKENS = 4
+AF32_RL_STAMP = 5
+AF32_BD_BREAKER_UNTIL = 6
+AF32_QUARANTINE_UNTIL = 7
+AI32_DID = 0
+AI32_SESSION = 1
+AI32_FLAGS = 2
+AI32_BD_CALLS = 3
+AI32_BD_PRIVILEGED = 4
 
-    did: jnp.ndarray          # i32[N]  intern handle of agent DID (-1 = free slot)
-    session: jnp.ndarray      # i32[N]  session slot the agent sits in (-1 = none)
-    sigma_raw: jnp.ndarray    # f32[N]
-    sigma_eff: jnp.ndarray    # f32[N]
-    ring: jnp.ndarray         # i8[N]   0..3
-    flags: jnp.ndarray        # i32[N]  FLAG_* bitmask
-    joined_at: jnp.ndarray    # f32[N]  unix seconds rel. to epoch_base (host-supplied)
-    risk_score: jnp.ndarray   # f32[N]  liability-ledger accumulator
-    rl_tokens: jnp.ndarray    # f32[N]  rate-limiter token bucket level
-    rl_stamp: jnp.ndarray     # f32[N]  last refill time
-    bd_calls: jnp.ndarray       # i32[N] breach window: total calls
-    bd_privileged: jnp.ndarray  # i32[N] breach window: calls above own ring
-    bd_breaker_until: jnp.ndarray  # f32[N] circuit breaker cooldown deadline
-    quarantine_until: jnp.ndarray  # f32[N] read-only isolation deadline
+
+@table(
+    packed={
+        "sigma_raw": ("f32", AF32_SIGMA_RAW),
+        "sigma_eff": ("f32", AF32_SIGMA_EFF),
+        "joined_at": ("f32", AF32_JOINED_AT),
+        "risk_score": ("f32", AF32_RISK),
+        "rl_tokens": ("f32", AF32_RL_TOKENS),
+        "rl_stamp": ("f32", AF32_RL_STAMP),
+        "bd_breaker_until": ("f32", AF32_BD_BREAKER_UNTIL),
+        "quarantine_until": ("f32", AF32_QUARANTINE_UNTIL),
+        "did": ("i32", AI32_DID),
+        "session": ("i32", AI32_SESSION),
+        "flags": ("i32", AI32_FLAGS),
+        "bd_calls": ("i32", AI32_BD_CALLS),
+        "bd_privileged": ("i32", AI32_BD_PRIVILEGED),
+    }
+)
+class AgentTable:
+    """[N_agents] columns, packed by dtype. Row index == agent slot.
+
+    Two blocks + the i8 ring column (measured-scatter layout, ROADMAP
+    "same-dtype column packing": the admission wave's per-column
+    scatters collapse to one per block):
+
+      f32[N, 8]: sigma_raw, sigma_eff, joined_at, risk_score, rl_tokens,
+                 rl_stamp, bd_breaker_until, quarantine_until
+      i32[N, 5]: did (-1 = free slot), session (-1 = none), flags
+                 (FLAG_* bitmask), bd_calls, bd_privileged
+
+    Every legacy column name stays readable (`agents.sigma_eff`) and
+    writable through `tables.struct.replace`; hot waves write whole
+    [B, W] rows instead.
+    """
+
+    f32: jnp.ndarray   # f32[N, 8] packed float columns (AF32_* indices)
+    i32: jnp.ndarray   # i32[N, 5] packed int columns (AI32_* indices)
+    ring: jnp.ndarray  # i8[N] 0..3
 
     @staticmethod
     def create(capacity: int) -> "AgentTable":
+        i32 = jnp.zeros((capacity, 5), jnp.int32)
+        i32 = i32.at[:, AI32_DID].set(-1).at[:, AI32_SESSION].set(-1)
         return AgentTable(
-            did=jnp.full((capacity,), -1, jnp.int32),
-            session=jnp.full((capacity,), -1, jnp.int32),
-            sigma_raw=jnp.zeros((capacity,), jnp.float32),
-            sigma_eff=jnp.zeros((capacity,), jnp.float32),
+            f32=jnp.zeros((capacity, 8), jnp.float32),
+            i32=i32,
             ring=jnp.full((capacity,), 3, jnp.int8),
-            flags=jnp.zeros((capacity,), jnp.int32),
-            joined_at=jnp.zeros((capacity,), jnp.float32),
-            risk_score=jnp.zeros((capacity,), jnp.float32),
-            rl_tokens=jnp.zeros((capacity,), jnp.float32),
-            rl_stamp=jnp.zeros((capacity,), jnp.float32),
-            bd_calls=jnp.zeros((capacity,), jnp.int32),
-            bd_privileged=jnp.zeros((capacity,), jnp.int32),
-            bd_breaker_until=jnp.zeros((capacity,), jnp.float32),
-            quarantine_until=jnp.zeros((capacity,), jnp.float32),
         )
 
 

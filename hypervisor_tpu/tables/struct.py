@@ -5,6 +5,24 @@ the TPU design inverts that into fixed-capacity arrays with active-masks so
 every per-agent / per-edge computation is one batched XLA op. Each table is a
 frozen dataclass registered as a JAX pytree: jit-traceable, shardable with
 `NamedSharding`, donat-able.
+
+## Packed column blocks
+
+Hot tables may pack same-dtype columns into one [N, W] block so a wave's
+row writes collapse into one scatter per dtype instead of one per column
+(measured on TPU v5e: the admission wave's 7 column scatters dominate its
+0.13 ms — see docs/ROADMAP.md "Same-dtype column packing"). `@table(
+packed={"sigma_eff": ("f32", 1), ...})` generates:
+
+  * a read property per virtual column (`t.sigma_eff` == `t.f32[:, 1]`),
+    so every existing read site keeps working, and
+  * `replace()` support: `replace(t, sigma_eff=col)` folds the column
+    back into the block (`f32.at[:, 1].set(col)`), chaining multiple
+    virtual updates to the same block into one expression XLA fuses.
+
+Hot paths that write whole rows should compose [B, W] row blocks and
+scatter the block directly (see `ops.admission.admit_batch`) — that is
+where the packed layout pays.
 """
 
 from __future__ import annotations
@@ -17,18 +35,59 @@ import jax
 T = TypeVar("T")
 
 
-def table(cls: type[T]) -> type[T]:
+def _install_virtual_columns(cls, packed: dict[str, tuple[str, int]]):
+    cls._PACKED = dict(packed)
+    for name, (block, idx) in packed.items():
+
+        def read(self, _b=block, _i=idx):
+            return getattr(self, _b)[:, _i]
+
+        read.__name__ = name
+        read.__doc__ = f"virtual column: {block}[:, {idx}]"
+        setattr(cls, name, property(read))
+
+
+def table(cls: type[T] | None = None, *, packed=None):
     """Decorator: frozen dataclass registered as a JAX pytree node.
 
-    All fields are data (leaves). Use plain Python ints/floats only through
-    `static` metadata if ever needed — tables here are pure array bundles.
+    All fields are data (leaves). With `packed`, virtual column names map
+    to (block_field, column_index) — readable as properties, writable
+    through `replace`.
     """
-    cls = dataclasses.dataclass(frozen=True)(cls)
-    fields = [f.name for f in dataclasses.fields(cls)]
-    jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=[])
-    return cls
+
+    def wrap(c: type[T]) -> type[T]:
+        c = dataclasses.dataclass(frozen=True)(c)
+        fields = [f.name for f in dataclasses.fields(c)]
+        jax.tree_util.register_dataclass(c, data_fields=fields, meta_fields=[])
+        if packed:
+            clash = set(packed) & set(fields)
+            if clash:
+                raise ValueError(f"packed names shadow real fields: {clash}")
+            _install_virtual_columns(c, packed)
+        return c
+
+    return wrap if cls is None else wrap(cls)
 
 
 def replace(obj: T, **changes) -> T:
-    """dataclasses.replace for table instances."""
+    """dataclasses.replace for table instances, understanding packed
+    virtual columns: a virtual kwarg folds into its block's column."""
+    packed = getattr(type(obj), "_PACKED", None)
+    if packed and any(name in packed for name in changes):
+        real = {k: v for k, v in changes.items() if k not in packed}
+        blocks: dict[str, object] = {}
+        for name, value in changes.items():
+            hit = packed.get(name)
+            if hit is None:
+                continue
+            block_name, idx = hit
+            if block_name not in blocks:
+                # A caller may pass the block itself alongside virtual
+                # columns; virtual updates stack on top of it.
+                blocks[block_name] = real.pop(
+                    block_name, getattr(obj, block_name)
+                )
+            blocks[block_name] = blocks[block_name].at[:, idx].set(value)
+        real.update(blocks)
+        changes = real
     return dataclasses.replace(obj, **changes)

@@ -129,6 +129,14 @@ class TestConsistencyDispatch:
         )
         assert n_s == n_e == LANES
 
+    async def test_runtime_cached_per_mesh(self):
+        # Pending EVENTUAL partials live on the runtime: repeated facade
+        # calls must return the SAME instance or deltas already ticked
+        # would be stranded on a discarded one.
+        hv, _, _ = await _facade_with_modes()
+        mesh = make_mesh(N_DEV, platform="cpu")
+        assert hv.consistency_runtime(mesh) is hv.consistency_runtime(mesh)
+
     async def test_nonreversible_manifest_forces_strong_dispatch(self):
         # The reference forces STRONG when non-reversible actions register
         # (`core.py:146-147`); the forced mode must change DISPATCH, not

@@ -197,26 +197,41 @@ class TestOrbaxBackend:
         mgr.close()
 
 
-def test_restore_checkpoint_missing_new_columns(tmp_path):
-    """A checkpoint written before a column existed (e.g. pre-quarantine
-    `agents.quarantine_until`) restores with fresh defaults for the
-    missing column and intact data for the rest."""
+def test_restore_legacy_percolumn_checkpoint(tmp_path):
+    """A checkpoint from before the AgentTable column packing (one array
+    per column, possibly missing columns that postdate the save, e.g.
+    `agents.quarantine_until`) restores losslessly into the packed
+    blocks, with defaults for the columns the save predates."""
     import numpy as np
 
     st = _populated_state()
     target = save_state(st, tmp_path, step=7)
 
-    # Rewrite tables.npz without the new column, simulating an old save.
+    # Rewrite tables.npz in the LEGACY format: unpack the blocks into
+    # per-column arrays, and drop one column to simulate an old save.
     path = target / "tables.npz"
     data = dict(np.load(path))
-    removed = data.pop("agents.quarantine_until")
-    assert removed is not None
+    f32 = data.pop("agents.f32")
+    i32 = data.pop("agents.i32")
+    f32_names = (
+        "sigma_raw", "sigma_eff", "joined_at", "risk_score",
+        "rl_tokens", "rl_stamp", "bd_breaker_until", "quarantine_until",
+    )
+    i32_names = ("did", "session", "flags", "bd_calls", "bd_privileged")
+    for i, name in enumerate(f32_names):
+        data[f"agents.{name}"] = f32[:, i]
+    for i, name in enumerate(i32_names):
+        data[f"agents.{name}"] = i32[:, i]
+    del data["agents.quarantine_until"]
     with open(path, "wb") as f:
         np.savez(f, **data)
 
     back = restore_state(target)
     np.testing.assert_array_equal(
         np.asarray(back.agents.sigma_eff), np.asarray(st.agents.sigma_eff)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(back.agents.did), np.asarray(st.agents.did)
     )
     # Missing column came back as its freshly-created default (zeros).
     assert not np.asarray(back.agents.quarantine_until).any()

@@ -349,11 +349,7 @@ class Hypervisor:
             )
         managed.sso.leave(agent_did)
         self.state.leave_agent(managed.slot, agent_did)
-        scrubbed = set(self.state.pop_scrubbed_edges())
-        if scrubbed:
-            for vouch_id, edge in list(self._edge_of_vouch.items()):
-                if edge in scrubbed:
-                    del self._edge_of_vouch[vouch_id]
+        self._detach_and_remirror(self.state.pop_scrubbed_edges())
 
     async def update_agent_ring(
         self,
@@ -453,13 +449,9 @@ class Hypervisor:
 
         # Cross-session edges referencing this session's reclaimed agent
         # rows were scrubbed by the device GC (their bonds survive
-        # host-side); detach exactly those mirror entries so a later
-        # join's backfill can re-mirror them.
-        scrubbed = set(self.state.pop_scrubbed_edges())
-        if scrubbed:
-            for vouch_id, edge in list(self._edge_of_vouch.items()):
-                if edge in scrubbed:
-                    del self._edge_of_vouch[vouch_id]
+        # host-side); detach those mirror entries and re-attach wherever
+        # the endpoints are still resident.
+        self._detach_and_remirror(self.state.pop_scrubbed_edges())
 
         self.gc.collect(
             session_id=session_id,
@@ -501,6 +493,11 @@ class Hypervisor:
         if result.should_slash:
             managed = self._require(session_id)
             participant = managed.sso.get_participant(agent_did)
+            # Snapshot BEFORE the device cascade: _sync_rows_to_host
+            # zeroes the live participant, and the slash history must
+            # record the pre-slash sigma (`SlashResult.vouchee_sigma_
+            # before`, reference `liability/slashing.py`).
+            vouchee_sigma_before = participant.sigma_eff
             agent_scores = {
                 p.agent_did: p.sigma_eff for p in managed.sso.participants
             }
@@ -516,18 +513,24 @@ class Hypervisor:
             # THIS session's row gets FLAG_QUARANTINED.
             rogue = self.state.agent_row(agent_did, managed.slot)
             if rogue is not None:
-                self.state.apply_slash(
+                cascade = self.state.apply_slash(
                     managed.slot,
                     rogue["slot"],
                     risk_weight=DRIFT_SLASH_RISK_WEIGHT,
                     now=self.state.now(),
                 )
-                self.state.blacklist_rows(
-                    [
-                        r["slot"]
-                        for r in self.state.agent_rows(agent_did)
-                        if r["slot"] != rogue["slot"]
-                    ]
+                other_rows = [
+                    r["slot"]
+                    for r in self.state.agent_rows(agent_did)
+                    if r["slot"] != rogue["slot"]
+                ]
+                self.state.blacklist_rows(other_rows)
+                # Host plane follows the cascade: every participant whose
+                # device row the slash touched (the rogue everywhere, and
+                # clipped vouchers) takes the recomputed sigma/ring, so
+                # the planes cannot diverge on post-slash standing.
+                self._sync_rows_to_host(
+                    cascade["slashed"] + cascade["clipped"] + other_rows
                 )
                 # Read-only isolation before termination (SURVEY §5
                 # recovery): the device row carries FLAG_QUARANTINED;
@@ -553,7 +556,7 @@ class Hypervisor:
             self.slashing.slash(
                 vouchee_did=agent_did,
                 session_id=session_id,
-                vouchee_sigma=participant.sigma_eff,
+                vouchee_sigma=vouchee_sigma_before,
                 risk_weight=DRIFT_SLASH_RISK_WEIGHT,
                 reason=f"CMVK drift: {result.drift_score:.3f} ({result.severity.value})",
                 agent_scores=agent_scores,
@@ -582,6 +585,58 @@ class Hypervisor:
             )
 
         return result
+
+    def _sync_rows_to_host(self, slots) -> None:
+        """Copy device rows' sigma_eff/ring onto their host participants.
+
+        Used after a device-side cascade (slash/blacklist) rewrites rows:
+        the SSO participant mirrors of exactly those (agent, session)
+        memberships take the device values. Rows without a managed host
+        session (e.g. phantom vouchers) are skipped.
+        """
+        if not slots:
+            return
+        did_col = np.asarray(self.state.agents.did)
+        sess_col = np.asarray(self.state.agents.session)
+        sigma_col = np.asarray(self.state.agents.sigma_eff)
+        ring_col = np.asarray(self.state.agents.ring)
+        by_slot = {m.slot: m for m in self._sessions.values()}
+        for slot in slots:
+            slot = int(slot)
+            managed = by_slot.get(int(sess_col[slot]))
+            if managed is None or int(did_col[slot]) < 0:
+                continue
+            did_str = self.state.agent_ids.string(int(did_col[slot]))
+            participant = managed.sso._participants.get(did_str)
+            if participant is None or not participant.is_active:
+                continue
+            participant.sigma_eff = float(sigma_col[slot])
+            participant.ring = ExecutionRing(int(ring_col[slot]))
+
+    def _detach_and_remirror(self, scrubbed_edges) -> None:
+        """Detach mirror entries whose device edges were scrubbed, then
+        re-mirror the surviving host bonds immediately.
+
+        With one row per (agent, session), an endpoint losing ONE row
+        (leave, terminate-reclaim) may still be resident through another
+        membership — the bond's edge re-attaches to that row now rather
+        than waiting for a future join's backfill (which would leave the
+        device graph under-counting live host bonds in the meantime).
+        Bonds whose endpoints are fully gone re-mirror on a later join.
+        """
+        scrubbed = set(scrubbed_edges)
+        if not scrubbed:
+            return
+        detached = {
+            vouch_id
+            for vouch_id, edge in self._edge_of_vouch.items()
+            if edge in scrubbed
+        }
+        for vouch_id in detached:
+            del self._edge_of_vouch[vouch_id]
+            record = self.vouching.record(vouch_id)
+            if record is not None and record.is_active:
+                self._mirror_vouch(record)
 
     def _mirror_vouch(self, record) -> None:
         """Host bond -> device VouchTable edge (when both agents and the

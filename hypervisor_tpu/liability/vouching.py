@@ -214,6 +214,11 @@ class VouchingEngine:
     def all_records(self) -> list[VouchRecord]:
         return [self._view(r) for r in range(self._n)]
 
+    def record(self, vouch_id: str):
+        """The record for one vouch id, or None (O(1) row lookup)."""
+        row = self._row_of.get(vouch_id)
+        return None if row is None else self._view(row)
+
     def session_records(self, session_id: str) -> list[VouchRecord]:
         hs = self.sessions.lookup(session_id)
         if hs < 0:

@@ -274,7 +274,7 @@ def governance_wave(
         jnp.clip(k_sessions, 0)
     ].set(True)
     agents, vouches, released = terminate_ops.release_session_scope(
-        agents, vouches, in_wave
+        agents, vouches, in_wave, wave_sessions=k_sessions
     )
 
     wave_state, err_t = session_fsm.apply_session_transitions(

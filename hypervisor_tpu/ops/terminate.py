@@ -30,23 +30,45 @@ from hypervisor_tpu.tables.state import (
 from hypervisor_tpu.tables.struct import replace
 
 
+# Below this static wave size, session membership tests use a broadcast
+# compare against the wave's session list instead of gathering from the
+# [S_cap] mask: the terminate wave's two [E]/[N] gathers were measured
+# at ~0.19 ms of the TPU wave p50 (docs/ROADMAP.md), and for the facade's
+# K=1 terminates a [E, K] compare is pure vector ALU with no gather.
+_BROADCAST_K_MAX = 32
+
+
 def release_session_scope(
-    agents: AgentTable, vouches: VouchTable, in_wave: jnp.ndarray
+    agents: AgentTable,
+    vouches: VouchTable,
+    in_wave: jnp.ndarray,
+    wave_sessions: jnp.ndarray | None = None,
 ) -> tuple[AgentTable, VouchTable, jnp.ndarray]:
     """Release bonds and deactivate participants for the wave's sessions.
 
-    in_wave: bool[S_cap] mask over session slots. Returns (agents,
-    vouches, released_count). Shared by the terminate wave and the fused
-    governance wave so bond-release semantics cannot drift.
+    in_wave: bool[S_cap] mask over session slots. `wave_sessions`
+    (i32[K], the same wave as the mask) enables the small-K broadcast-
+    compare path; without it — or for large K — the mask gathers are
+    used. Shared by the terminate wave and the fused governance wave so
+    bond-release semantics cannot drift.
     """
-    edge_hit = vouches.active & jnp.where(
-        vouches.session >= 0, in_wave[jnp.clip(vouches.session, 0)], False
-    )
+    if wave_sessions is not None and wave_sessions.shape[0] <= _BROADCAST_K_MAX:
+        # Real slots are >= 0, so free rows (session == -1) match nothing.
+        edge_in = (
+            vouches.session[:, None] == wave_sessions[None, :]
+        ).any(axis=1)
+        agent_hit = (
+            agents.session[:, None] == wave_sessions[None, :]
+        ).any(axis=1)
+    else:
+        edge_in = jnp.where(
+            vouches.session >= 0, in_wave[jnp.clip(vouches.session, 0)], False
+        )
+        agent_hit = jnp.where(
+            agents.session >= 0, in_wave[jnp.clip(agents.session, 0)], False
+        )
+    edge_hit = vouches.active & edge_in
     vouches = replace(vouches, active=vouches.active & ~edge_hit)
-
-    agent_hit = jnp.where(
-        agents.session >= 0, in_wave[jnp.clip(agents.session, 0)], False
-    )
     agents = replace(
         agents,
         flags=jnp.where(
@@ -89,7 +111,7 @@ def terminate_batch(
 
     # ── bonds + participants (shared semantics) ─────────────────────────
     new_agents, new_vouches, released = release_session_scope(
-        agents, vouches, in_wave
+        agents, vouches, in_wave, wave_sessions=session_slots
     )
 
     # ── session FSM: TERMINATING then ARCHIVED, stamped ──────────────────

@@ -720,6 +720,9 @@ def sharded_governance_wave(
             jnp.zeros((s_cap,), jnp.int32).at[jnp.clip(ws, 0)].set(1)
         )
         in_wave = jax.lax.psum(local_mask, AGENT_AXIS) > 0
+        # Mask path on purpose (no wave_sessions): each shard only holds
+        # its K/D wave lanes, but its edge/agent blocks must release for
+        # EVERY shard's sessions — only the psum'd global mask knows them.
         agents, vouches, released_local = terminate_ops.release_session_scope(
             agents, vouches, in_wave
         )

@@ -35,10 +35,23 @@ from hypervisor_tpu.session import (  # noqa: E402
 AGENTS = [f"did:st{i}" for i in range(8)]
 
 
+class _InjectableDrift:
+    """CMVK verifier stub: the claimed embedding IS the drift score."""
+
+    def verify_embeddings(self, embedding_a, embedding_b, **_):
+        class V:
+            drift_score = float(embedding_a)
+            explanation = None
+
+        return V()
+
+
 class PlaneCoherence(RuleBasedStateMachine):
     def __init__(self):
         super().__init__()
-        self.hv = Hypervisor()
+        from hypervisor_tpu.integrations.cmvk_adapter import CMVKAdapter
+
+        self.hv = Hypervisor(cmvk=CMVKAdapter(verifier=_InjectableDrift()))
         self.sessions: list[str] = []          # live (not terminated)
         self.joined: dict[str, set[str]] = {}  # sid -> dids
         self.loop = asyncio.new_event_loop()
@@ -161,6 +174,39 @@ class PlaneCoherence(RuleBasedStateMachine):
             agent, sid, QuarantineReason.MANUAL, details="prop"
         )
         self.hv.state.quarantine_rows([row["slot"]], now=self.hv.state.now())
+
+    @precondition(lambda self: any(self.joined.values()))
+    @rule(pick=st.integers(0, 3))
+    def drift_slash(self, pick):
+        """HIGH drift through the facade: agent-global slash + session-
+        scoped quarantine, host participants synced to the cascade."""
+        from hypervisor_tpu.tables.state import FLAG_BLACKLISTED
+
+        sids = [s for s in self.sessions if self.joined[s]]
+        if not sids:
+            return
+        sid = sids[pick % len(sids)]
+        agent = sorted(self.joined[sid])[0]
+        mask_before = self.hv.state.quarantined_mask().copy()
+        self.go(
+            self.hv.verify_behavior(
+                sid, agent, claimed_embedding=0.6, observed_embedding=0.0
+            )
+        )
+        # Post-conditions: every live row of the agent is blacklisted
+        # with sigma 0 (reference slash is agent-global), but THIS slash
+        # quarantines only the slashing session's row — rows in other
+        # sessions keep whatever quarantine state they already had.
+        flags = np.asarray(self.hv.state.agents.flags)
+        mask = self.hv.state.quarantined_mask()
+        slot_here = self.hv.get_session(sid).slot
+        for row in self.hv.state.agent_rows(agent):
+            assert flags[row["slot"]] & FLAG_BLACKLISTED
+            assert row["sigma_eff"] == 0.0
+            if row["session"] != slot_here:
+                assert mask[row["slot"]] == mask_before[row["slot"]], (
+                    "quarantine leaked into another session's row"
+                )
 
     @rule()
     def sweeps(self):
@@ -328,5 +374,28 @@ class TestCrossSessionQuarantineRegression:
             await hv.leave_session(sid_a, "did:x")
             assert hv.state.agent_row("did:x", a.slot) is None
             assert hv.state.agent_row("did:x", b.slot) is not None
+
+        asyncio.run(run())
+
+    def test_slash_history_records_pre_slash_sigma(self):
+        # The host sync zeroes the live participant during the device
+        # cascade; the forensic slash history must still record the
+        # PRE-slash sigma (regression: it briefly recorded 0.0).
+        from hypervisor_tpu.integrations.cmvk_adapter import CMVKAdapter
+
+        async def run():
+            hv = Hypervisor(cmvk=CMVKAdapter(verifier=_InjectableDrift()))
+            ms = await hv.create_session(
+                SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+            )
+            sid = ms.sso.session_id
+            await hv.join_session(sid, "did:r", sigma_raw=0.8)
+            await hv.verify_behavior(
+                sid, "did:r", claimed_embedding=0.6, observed_embedding=0.0
+            )
+            record = hv.slashing.history[-1]
+            assert record.vouchee_sigma_before == pytest.approx(0.8)
+            # ...and the live participant mirrors the post-slash device row.
+            assert ms.sso.get_participant("did:r").sigma_eff == 0.0
 
         asyncio.run(run())

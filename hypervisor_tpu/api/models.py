@@ -279,6 +279,19 @@ class QuarantineStatusResponse(BaseModel):
     forensic_keys: list = []
 
 
+class AgentMembershipsResponse(BaseModel):
+    """Every session an agent is live in — one device row per
+    membership (round-3 model: session-scoped standing).
+
+    Each membership is a dict {session_id: str, ring: int,
+    sigma_eff: float, quarantined: bool} (kept untyped so the
+    pydantic-free fallback transport serializes it unchanged).
+    """
+
+    agent_did: str
+    memberships: list = []
+
+
 class QuarantineListItem(BaseModel):
     agent_did: str
     session_id: str

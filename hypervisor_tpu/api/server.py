@@ -38,6 +38,7 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("POST", "/api/v1/sessions/{session_id}/terminate", "terminate_session", None),
     ("GET", "/api/v1/sessions/{session_id}/rings", "ring_distribution", None),
     ("GET", "/api/v1/agents/{agent_did}/ring", "agent_ring", None),
+    ("GET", "/api/v1/agents/{agent_did}/memberships", "agent_memberships", None),
     ("POST", "/api/v1/rings/check", "ring_check", M.RingCheckRequest),
     ("POST", "/api/v1/sessions/{session_id}/sagas", "create_saga", None),
     ("GET", "/api/v1/sessions/{session_id}/sagas", "list_sagas", None),

@@ -202,6 +202,32 @@ class HypervisorService:
                     )
         raise ApiError(404, f"Agent {agent_did} not found in any session")
 
+    async def agent_memberships(
+        self, agent_did: str
+    ) -> M.AgentMembershipsResponse:
+        """Every session the agent is live in — one device row per
+        (agent, session) membership, with that membership's ring/sigma
+        and quarantine flag (session-scoped standing, round 3)."""
+        rows = self.hv.state.agent_rows(agent_did)
+        mask = self.hv.state.quarantined_mask()
+        slot_to_id = {
+            m.slot: sid for sid, m in self.hv._sessions.items()
+        }
+        memberships = [
+            {
+                "session_id": slot_to_id.get(
+                    row["session"], f"slot:{row['session']}"
+                ),
+                "ring": row["ring"],
+                "sigma_eff": row["sigma_eff"],
+                "quarantined": bool(mask[row["slot"]]),
+            }
+            for row in rows
+        ]
+        return M.AgentMembershipsResponse(
+            agent_did=agent_did, memberships=memberships
+        )
+
     async def ring_check(self, req: M.RingCheckRequest) -> M.RingCheckResponse:
         result = self.hv.ring_enforcer.check(
             agent_ring=ExecutionRing(req.agent_ring),

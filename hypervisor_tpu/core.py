@@ -638,24 +638,34 @@ class Hypervisor:
             if record is not None and record.is_active:
                 self._mirror_vouch(record)
 
-    def _mirror_vouch(self, record) -> None:
-        """Host bond -> device VouchTable edge (when both agents and the
-        session are resident in the device tables).
-
-        Endpoints resolve to their row IN the bond's session when they
-        are participants there; a voucher bonding into a session it
-        never joined (legal in the reference engine) hangs the edge on
-        its most recent row elsewhere.
+    def _resolve_endpoints(self, record):
+        """THE edge-resolution rule, in one place: each endpoint resolves
+        to its row IN the bond's session when resident there, else its
+        most recent live row (a voucher bonding into a session it never
+        joined is legal in the reference engine). Returns (voucher_row,
+        vouchee_row) — either may be None. `_mirror_vouch`, the backfill
+        re-point check, and the stateful edge invariant all share this
+        contract.
         """
         managed = self._sessions.get(record.session_id)
         if managed is None:
-            return
+            return None, None
         voucher = self.state.agent_row(
             record.voucher_did, managed.slot
         ) or self.state.agent_row(record.voucher_did)
         vouchee = self.state.agent_row(
             record.vouchee_did, managed.slot
         ) or self.state.agent_row(record.vouchee_did)
+        return voucher, vouchee
+
+    def _mirror_vouch(self, record) -> None:
+        """Host bond -> device VouchTable edge (when both agents and the
+        session are resident in the device tables), endpoints resolved
+        by `_resolve_endpoints`."""
+        managed = self._sessions.get(record.session_id)
+        if managed is None:
+            return
+        voucher, vouchee = self._resolve_endpoints(record)
         if voucher is None or vouchee is None:
             return
         try:
@@ -684,17 +694,46 @@ class Hypervisor:
             self.state.release_vouch(edge)
 
     def _backfill_vouch_mirror(self, agent_did: str) -> None:
-        """Mirror host bonds that predate an endpoint's device residency.
+        """Mirror host bonds that predate an endpoint's device residency,
+        and RE-POINT existing edges the join just made stale.
 
         A vouch recorded before its voucher (or vouchee) joined has no
         device edge — `_mirror_vouch` skips when an endpoint has no agent
         row. Once the missing endpoint joins, those bonds must appear in
         the VouchTable or device sigma_eff contributions and slash
         cascades silently under-count them (coherence gap surfaced by the
-        stateful property suite)."""
+        stateful property suite).
+
+        Re-pointing: an edge may be hanging on an endpoint's FALLBACK
+        row in another session (attached by `_detach_and_remirror` after
+        a leave/terminate scrubbed the original). When this join creates
+        the endpoint's row IN the bond's session, the edge must move
+        there — otherwise a later slash cascade in that session matches
+        the bond against the wrong row forever (the rejoin would skip
+        already-mirrored records).
+        """
+        voucher_col = vouchee_col = None
         for record in self.vouching.agent_records(agent_did):
-            if record.is_active and record.vouch_id not in self._edge_of_vouch:
+            if not record.is_active:
+                continue
+            existing = self._edge_of_vouch.get(record.vouch_id)
+            if existing is None:
                 self._mirror_vouch(record)
+                continue
+            voucher, vouchee = self._resolve_endpoints(record)
+            if voucher is None or vouchee is None:
+                continue
+            if voucher_col is None:
+                voucher_col = np.asarray(self.state.vouches.voucher)
+                vouchee_col = np.asarray(self.state.vouches.vouchee)
+            if (voucher["slot"], vouchee["slot"]) != (
+                int(voucher_col[existing]),
+                int(vouchee_col[existing]),
+            ):
+                self.state.release_vouch(existing)
+                del self._edge_of_vouch[record.vouch_id]
+                self._mirror_vouch(record)
+                voucher_col = vouchee_col = None  # columns changed
 
     def consistency_runtime(self, mesh):
         """The mixed-mode distributed tick driver bound to this facade's

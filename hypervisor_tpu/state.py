@@ -136,6 +136,8 @@ class HypervisorState:
         self._admit = _ADMIT
         self._saga_tick = _SAGA_TICK
         self._terminate = _TERMINATE
+        # Compiled sharded governance waves, keyed by Mesh.
+        self._sharded_waves: dict = {}
 
     def now(self) -> float:
         """Seconds since this state's epoch — the f32-safe device time."""
@@ -213,6 +215,43 @@ class HypervisorState:
         )
         return slots
 
+    def _mesh_wave_slots(self, b: int, n_shards: int) -> np.ndarray:
+        """Deterministic agent rows for a sharded wave: the TOP `b/D`
+        rows of each shard's region (the sharded wave's slot contract —
+        element i's row must live on shard i // (B/D)).
+
+        The bump allocator grows globally from row 0 (all of shard 0's
+        region first), so mesh-wave rows come from the other end of each
+        region and never enter the general free list: wave rows are dead
+        after the wave (their sessions terminate in-wave) and the SAME
+        deterministic rows recycle on the next mesh wave.
+        """
+        cap = self.agents.did.shape[0]
+        if cap % n_shards:
+            raise ValueError(
+                f"agent capacity {cap} not divisible by mesh size {n_shards}"
+            )
+        if b % n_shards:
+            raise ValueError(
+                f"wave size {b} not divisible by mesh size {n_shards}"
+            )
+        rows_per_shard = cap // n_shards
+        per = b // n_shards
+        if self._next_agent_slot > rows_per_shard - per:
+            raise RuntimeError(
+                f"bump allocator at {self._next_agent_slot} overlaps the "
+                f"mesh-wave region (top {per} rows of each "
+                f"{rows_per_shard}-row shard); raise "
+                "config.capacity.max_agents"
+            )
+        return np.array(
+            [
+                (i // per) * rows_per_shard + (rows_per_shard - per) + (i % per)
+                for i in range(b)
+            ],
+            np.int32,
+        )
+
     def run_governance_wave(
         self,
         session_slots: np.ndarray,     # i32[K] freshly created sessions
@@ -224,6 +263,7 @@ class HypervisorState:
         omega: float = 0.5,
         trustworthy: Optional[np.ndarray] = None,
         use_pallas: bool | None = None,
+        mesh=None,
     ):
         """Run the fused full-pipeline wave ON the state tables.
 
@@ -232,17 +272,40 @@ class HypervisorState:
         Merkle roots, a saga step, and termination with bond release —
         reading and writing this state's actual tables. Returns the
         WaveResult; tables, membership, and the DeltaLog are updated.
+
+        With `mesh` (a jax Mesh over the agent axis), the SAME wave runs
+        as ONE shard_map program with Agent rows + Vouch edges sharded
+        and the SessionTable replicated (`parallel.collectives.
+        sharded_governance_wave`) — BASELINE's "10k concurrent sessions
+        multi-chip" config on the real tables. B, K, and the agent
+        capacity must divide the mesh size; sigma contributions,
+        capacity ranking, and session folds ride ICI collectives.
         """
         b = len(dids)
-        if self._next_agent_slot + b > self.agents.did.shape[0]:
-            raise RuntimeError(
-                f"agent table full: {self._next_agent_slot} + {b} > "
-                f"{self.agents.did.shape[0]}; raise config.capacity.max_agents"
+        if mesh is not None:
+            d = mesh.devices.size
+            k = len(session_slots)
+            e_cap = self.vouches.voucher.shape[0]
+            if k % d:
+                raise ValueError(
+                    f"wave session count {k} not divisible by mesh size {d}"
+                )
+            if e_cap % d:
+                raise ValueError(
+                    f"vouch-edge capacity {e_cap} not divisible by mesh "
+                    f"size {d}; adjust config.capacity.max_vouch_edges"
+                )
+            agent_slots = self._mesh_wave_slots(b, d)
+        else:
+            if self._next_agent_slot + b > self.agents.did.shape[0]:
+                raise RuntimeError(
+                    f"agent table full: {self._next_agent_slot} + {b} > "
+                    f"{self.agents.did.shape[0]}; raise config.capacity.max_agents"
+                )
+            agent_slots = np.arange(
+                self._next_agent_slot, self._next_agent_slot + b, dtype=np.int32
             )
-        agent_slots = np.arange(
-            self._next_agent_slot, self._next_agent_slot + b, dtype=np.int32
-        )
-        self._next_agent_slot += b
+            self._next_agent_slot += b
         handles = np.array([self.agent_ids.intern(d) for d in dids], np.int32)
         duplicate = np.array(
             [
@@ -254,23 +317,35 @@ class HypervisorState:
         if trustworthy is None:
             trustworthy = np.ones(b, bool)
 
-        with profiling.span("hv.governance_wave"):
-            result = _WAVE(
-                self.agents,
-                self.sessions,
-                self.vouches,
-                jnp.asarray(agent_slots),
-                jnp.asarray(handles),
-                jnp.asarray(np.asarray(agent_sessions, np.int32)),
-                jnp.asarray(np.asarray(sigma_raw, np.float32)),
-                jnp.asarray(trustworthy),
-                jnp.asarray(duplicate),
-                jnp.asarray(np.asarray(session_slots, np.int32)),
-                jnp.asarray(delta_bodies),
-                now,
-                omega,
-                use_pallas=use_pallas,
-            )
+        wave_args = (
+            self.agents,
+            self.sessions,
+            self.vouches,
+            jnp.asarray(agent_slots),
+            jnp.asarray(handles),
+            jnp.asarray(np.asarray(agent_sessions, np.int32)),
+            jnp.asarray(np.asarray(sigma_raw, np.float32)),
+            jnp.asarray(trustworthy),
+            jnp.asarray(duplicate),
+            jnp.asarray(np.asarray(session_slots, np.int32)),
+            jnp.asarray(delta_bodies),
+            now,
+            omega,
+        )
+        if mesh is not None:
+            wave_fn = self._sharded_waves.get(mesh)
+            if wave_fn is None:
+                from hypervisor_tpu.parallel.collectives import (
+                    sharded_governance_wave,
+                )
+
+                wave_fn = sharded_governance_wave(mesh)
+                self._sharded_waves[mesh] = wave_fn
+            with profiling.span("hv.governance_wave_sharded"):
+                result = wave_fn(*wave_args)
+        else:
+            with profiling.span("hv.governance_wave"):
+                result = _WAVE(*wave_args, use_pallas=use_pallas)
         self.agents = result.agents
         self.sessions = result.sessions
         self.vouches = result.vouches
@@ -282,8 +357,11 @@ class HypervisorState:
             # Every wave row is dead after the wave: rejected rows were
             # never admitted, admitted rows belong to sessions this same
             # program terminated — all reclaim (device-table GC), and
-            # none are cached in _slot_of_member.
-            self._free_agent_slots.append(int(slot))
+            # none are cached in _slot_of_member. Mesh-wave rows recycle
+            # through their own deterministic top-region layout instead
+            # of the general free list (see _mesh_wave_slots).
+            if mesh is None:
+                self._free_agent_slots.append(int(slot))
 
         # Record the wave's audit chain in the DeltaLog (lane-major).
         chain = np.asarray(result.chain)  # [T, K, 8]

@@ -279,6 +279,36 @@ class PlaneCoherence(RuleBasedStateMachine):
         )
 
     @invariant()
+    def mirrored_edges_point_at_best_rows(self):
+        # Edge-resolution contract: every mirrored edge hangs on its
+        # endpoint's row IN the bond's session when resident there, else
+        # the endpoint's most recent live row (fallback). Leaves,
+        # terminates, and rejoins must maintain this (re-mirror +
+        # re-point), or slash cascades match the wrong rows.
+        voucher_col = np.asarray(self.hv.state.vouches.voucher)
+        vouchee_col = np.asarray(self.hv.state.vouches.vouchee)
+        for vouch_id, edge in self.hv._edge_of_vouch.items():
+            record = self.hv.vouching.record(vouch_id)
+            if record is None or not record.is_active:
+                continue
+            managed = self.hv.get_session(record.session_id)
+            if managed is None or record.session_id not in self.sessions:
+                continue
+            for did, col in (
+                (record.voucher_did, voucher_col),
+                (record.vouchee_did, vouchee_col),
+            ):
+                best = self.hv.state.agent_row(
+                    did, managed.slot
+                ) or self.hv.state.agent_row(did)
+                assert best is not None, f"mirrored edge for absent {did}"
+                assert col[edge] == best["slot"], (
+                    f"edge {edge} for {vouch_id} points at row "
+                    f"{col[edge]}, best resolution for {did} is "
+                    f"{best['slot']}"
+                )
+
+    @invariant()
     def quarantine_planes_agree(self):
         # Quarantine is session-scoped on both planes: a flagged device
         # row implies a live host record for THAT (agent, session) — and
@@ -397,5 +427,59 @@ class TestCrossSessionQuarantineRegression:
             assert record.vouchee_sigma_before == pytest.approx(0.8)
             # ...and the live participant mirrors the post-slash device row.
             assert ms.sso.get_participant("did:r").sigma_eff == 0.0
+
+        asyncio.run(run())
+
+    def test_join_repoints_fallback_edge_to_session_row(self):
+        # Edge-resolution maintenance across leaves and joins. Phase 1:
+        # B vouches for A in X; A leaves X and the edge re-attaches to
+        # A's surviving Z row (fallback). Phase 2: B vouches for A in a
+        # fresh session Y BEFORE A joins Y (the edge hangs on the Z
+        # fallback row); when A then joins Y, the backfill must MOVE the
+        # edge onto A's new Y row — without the re-point, a later slash
+        # cascade in Y would match the wrong row forever.
+        import numpy as np
+
+        async def run():
+            hv = Hypervisor()
+            x = await hv.create_session(
+                SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+            )
+            z = await hv.create_session(
+                SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+            )
+            sx, sz = x.sso.session_id, z.sso.session_id
+            await hv.join_session(sx, "did:A", sigma_raw=0.8)
+            await hv.join_session(sz, "did:A", sigma_raw=0.8)
+            await hv.join_session(sx, "did:B", sigma_raw=0.9)
+            rec = hv.vouching.vouch("did:B", "did:A", sx, voucher_sigma=0.9)
+            edge = hv._edge_of_vouch[rec.vouch_id]
+            a_x = hv.state.agent_row("did:A", x.slot)["slot"]
+            assert int(np.asarray(hv.state.vouches.vouchee)[edge]) == a_x
+
+            await hv.leave_session(sx, "did:A")
+            # Edge re-attached to A's Z row (endpoint still resident).
+            edge2 = hv._edge_of_vouch[rec.vouch_id]
+            a_z = hv.state.agent_row("did:A", z.slot)["slot"]
+            assert int(np.asarray(hv.state.vouches.vouchee)[edge2]) == a_z
+
+            # Phase 2 (rejoining X itself is a duplicate — membership
+            # is terminal — so the vouch-before-join shape plays out in
+            # a fresh session Y).
+            y = await hv.create_session(
+                SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+            )
+            sy = y.sso.session_id
+            rec2 = hv.vouching.vouch("did:B", "did:A", sy, voucher_sigma=0.9)
+            edge3 = hv._edge_of_vouch[rec2.vouch_id]
+            # A is not in Y yet: the edge hangs on A's fallback (Z) row.
+            assert int(np.asarray(hv.state.vouches.vouchee)[edge3]) == a_z
+            await hv.join_session(sy, "did:A", sigma_raw=0.8)
+            # The join re-points the Y bond onto A's NEW Y row.
+            edge4 = hv._edge_of_vouch[rec2.vouch_id]
+            a_y = hv.state.agent_row("did:A", y.slot)["slot"]
+            assert int(np.asarray(hv.state.vouches.vouchee)[edge4]) == a_y
+            # The X bond (still on Z fallback) is untouched and active.
+            assert bool(np.asarray(hv.state.vouches.active)[edge2])
 
         asyncio.run(run())

@@ -1,0 +1,170 @@
+"""HypervisorState.run_governance_wave(mesh=...) — the sharded fused
+wave on the REAL state tables vs the single-device state wave.
+
+BASELINE's "10k concurrent sessions multi-chip" config, scaled down to
+the virtual 8-device CPU mesh: the state-bridge path must produce the
+same semantic outcome (admissions, chains/Merkle roots, bond releases,
+archival, membership, DeltaLog audit index) whether the wave runs as one
+single-device program or one shard_map program with sharded tables.
+Agent row PLACEMENT legitimately differs (bump region vs the mesh slot
+contract's top-of-shard regions), so the comparison is semantic, not
+row-for-row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.models import SessionConfig, SessionState
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.parallel import make_mesh
+from hypervisor_tpu.state import HypervisorState
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+N_DEV = 8
+B = 32          # joining agents (4 per shard)
+K = 8           # wave sessions
+T = 3
+
+
+def _config():
+    return dataclasses.replace(
+        DEFAULT_CONFIG,
+        capacity=dataclasses.replace(
+            DEFAULT_CONFIG.capacity, max_agents=N_DEV * 16
+        ),
+    )
+
+
+def _staged(state):
+    session_slots = state.create_sessions_batch(
+        [f"mw:s{i}" for i in range(K)], SessionConfig(min_sigma_eff=0.0)
+    )
+    dids = [f"did:mw:{i}" for i in range(B)]
+    agent_sessions = np.array([i % K for i in range(B)], np.int32)
+    sigma = np.linspace(0.62, 0.95, B).astype(np.float32)
+    # A vouch preload: phantom voucher lifts element 0's low sigma.
+    sigma[0] = 0.45
+    state.vouches = t_replace(
+        state.vouches,
+        voucher=state.vouches.voucher.at[0].set(state.agents.did.shape[0] - 1),
+        vouchee=state.vouches.vouchee.at[0].set(-7),  # patched per path
+        session=state.vouches.session.at[0].set(0),
+        bond=state.vouches.bond.at[0].set(0.40),
+        active=state.vouches.active.at[0].set(True),
+    )
+    rng = np.random.RandomState(5)
+    bodies = rng.randint(
+        0, 2**32, size=(T, K, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    return session_slots, dids, agent_sessions, sigma, bodies
+
+
+def _patch_vouchee(state, slot):
+    state.vouches = t_replace(
+        state.vouches, vouchee=state.vouches.vouchee.at[0].set(int(slot))
+    )
+
+
+class TestStateMeshWave:
+    def test_mesh_wave_matches_single_device_semantics(self):
+        mesh = make_mesh(N_DEV, platform="cpu")
+
+        st_single = HypervisorState(_config())
+        args_s = _staged(st_single)
+        _patch_vouchee(st_single, st_single._next_agent_slot)  # element 0's row
+        res_s = st_single.run_governance_wave(
+            args_s[0], args_s[1], args_s[2], args_s[3], args_s[4],
+            now=2.0, use_pallas=False,
+        )
+
+        st_mesh = HypervisorState(_config())
+        args_m = _staged(st_mesh)
+        _patch_vouchee(st_mesh, st_mesh._mesh_wave_slots(B, N_DEV)[0])
+        res_m = st_mesh.run_governance_wave(
+            args_m[0], args_m[1], args_m[2], args_m[3], args_m[4],
+            now=2.0, mesh=mesh,
+        )
+
+        np.testing.assert_array_equal(
+            np.asarray(res_m.status), np.asarray(res_s.status)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_m.ring), np.asarray(res_s.ring)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_m.sigma_eff), np.asarray(res_s.sigma_eff)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_m.chain), np.asarray(res_s.chain)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_m.merkle_root), np.asarray(res_s.merkle_root)
+        )
+        assert int(np.asarray(res_m.released)) == int(
+            np.asarray(res_s.released)
+        )
+        # Vouched element 0 lifted identically on both paths.
+        assert float(np.asarray(res_m.sigma_eff)[0]) == pytest.approx(
+            0.45 + 0.5 * 0.40
+        )
+
+        # Both states agree on the world afterwards.
+        for st in (st_single, st_mesh):
+            state_col = np.asarray(st.sessions.state)[:K]
+            assert (state_col == SessionState.ARCHIVED.code).all()
+            for i in range(B):
+                assert st.is_member(i % K, f"did:mw:{i}")
+            # Audit index carries T leaves per wave session.
+            for s in range(K):
+                assert len(st._audit_rows[s]) == T
+        np.testing.assert_array_equal(
+            np.asarray(st_mesh.sessions.n_participants),
+            np.asarray(st_single.sessions.n_participants),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(st_mesh.delta_log.digest),
+            np.asarray(st_single.delta_log.digest),
+        )
+
+    def test_mesh_wave_rows_recycle_without_free_list(self):
+        mesh = make_mesh(N_DEV, platform="cpu")
+        st = HypervisorState(_config())
+        for round_i in range(2):
+            session_slots = st.create_sessions_batch(
+                [f"mw2:r{round_i}:s{i}" for i in range(K)],
+                SessionConfig(min_sigma_eff=0.0),
+            )
+            dids = [f"did:mw2:r{round_i}:{i}" for i in range(B)]
+            rng = np.random.RandomState(round_i)
+            bodies = rng.randint(
+                0, 2**32, size=(T, K, merkle_ops.BODY_WORDS), dtype=np.uint64
+            ).astype(np.uint32)
+            res = st.run_governance_wave(
+                session_slots,
+                dids,
+                np.asarray(session_slots, np.int32)[
+                    np.arange(B) % K
+                ],
+                np.full(B, 0.8, np.float32),
+                bodies,
+                now=1.0 + round_i,
+                mesh=mesh,
+            )
+            assert (np.asarray(res.status) == 0).all()
+        # Mesh rows never leaked into the general free list.
+        assert not st._free_agent_slots
+
+    def test_bump_overlap_refuses_loudly(self):
+        st = HypervisorState(_config())
+        # Push the bump allocator into the mesh-wave region of shard 0.
+        st._next_agent_slot = st.agents.did.shape[0] // N_DEV
+        with pytest.raises(RuntimeError, match="mesh-wave region"):
+            st._mesh_wave_slots(B, N_DEV)

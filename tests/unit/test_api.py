@@ -179,9 +179,9 @@ class TestEventEndpoints:
 class TestHTTPTransport:
     def test_routes_cover_reference_plus_device_stats(self):
         # The reference's 21 endpoints plus /api/v1/device/stats (the
-        # device-plane occupancy view the reference has no analog for)
-        # and the two quarantine views.
-        assert len(ROUTES) == 26
+        # device-plane occupancy view the reference has no analog for),
+        # the two quarantine views, and the per-membership agent view.
+        assert len(ROUTES) == 27
         assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
         assert any(
             path == "/api/v1/security/quarantines" for _, path, _, _ in ROUTES
@@ -300,3 +300,47 @@ async def test_leave_and_sweep_endpoints():
     sweep = await svc.run_sweeps()
     assert sweep.breakers_tripped == 0
     assert sweep.sessions_expired == []
+
+
+async def test_agent_memberships_lists_per_session_rows(svc):
+    """One membership entry per live (agent, session) device row, each
+    with its own ring/sigma/quarantine flag (round-3 model)."""
+    from hypervisor_tpu.liability.quarantine import QuarantineReason
+
+    a = await svc.create_session(
+        M.CreateSessionRequest(creator_did="did:lead", min_sigma_eff=0.0)
+    )
+    b = await svc.create_session(
+        M.CreateSessionRequest(creator_did="did:lead", min_sigma_eff=0.0)
+    )
+    await svc.join_session(
+        a.session_id, M.JoinSessionRequest(agent_did="did:multi", sigma_raw=0.9)
+    )
+    await svc.join_session(
+        b.session_id, M.JoinSessionRequest(agent_did="did:multi", sigma_raw=0.7)
+    )
+
+    out = await svc.agent_memberships("did:multi")
+    assert out.agent_did == "did:multi"
+    by_sid = {m["session_id"]: m for m in out.memberships}
+    assert set(by_sid) == {a.session_id, b.session_id}
+    assert by_sid[a.session_id]["sigma_eff"] == pytest.approx(0.9)
+    assert by_sid[b.session_id]["sigma_eff"] == pytest.approx(0.7)
+    assert not any(m["quarantined"] for m in out.memberships)
+
+    # Quarantine in A only: exactly that membership flags.
+    svc.hv.quarantine.quarantine(
+        "did:multi", a.session_id, QuarantineReason.MANUAL, details="hold"
+    )
+    row = svc.hv.state.agent_row(
+        "did:multi", svc.hv.get_session(a.session_id).slot
+    )
+    svc.hv.state.quarantine_rows([row["slot"]], now=svc.hv.state.now())
+    out = await svc.agent_memberships("did:multi")
+    by_sid = {m["session_id"]: m for m in out.memberships}
+    assert by_sid[a.session_id]["quarantined"]
+    assert not by_sid[b.session_id]["quarantined"]
+
+    # Unknown agent: empty memberships, not an error.
+    empty = await svc.agent_memberships("did:ghost")
+    assert empty.memberships == []

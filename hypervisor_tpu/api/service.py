@@ -402,7 +402,8 @@ class HypervisorService:
         state = self.hv.state
         now = state.now()
         severity, tripped = state.breach_sweep_tick(now)
-        elevations_expired = state.elevation_tick(now)
+        # Both elevation planes tick together (facade-wired grants).
+        elevations_expired = self.hv.sweep_elevations()
         quarantine_released = state.quarantine_tick(now)
         sessions_expired = await self.hv.sweep_expired_sessions()
         return M.SweepResponse(

@@ -152,6 +152,15 @@ class Hypervisor:
         self.commitment = CommitmentEngine()
         self.gc = EphemeralGC(retention_policy)
         self.quarantine = QuarantineManager()
+        # Sudo-with-TTL elevations, facade-wired across BOTH planes
+        # (the reference exports its manager but never wires it,
+        # SURVEY §1 "exported but not wired"): grants land in the host
+        # manager AND the device ElevationTable so `effective_rings`
+        # waves and host queries agree.
+        from hypervisor_tpu.rings.elevation import RingElevationManager
+
+        self.elevation = RingElevationManager()
+        self._elev_row_of: dict[str, int] = {}  # elevation_id -> device row
 
         # Optional integration adapters.
         self.nexus = nexus
@@ -350,6 +359,12 @@ class Hypervisor:
         managed.sso.leave(agent_did)
         self.state.leave_agent(managed.slot, agent_did)
         self._detach_and_remirror(self.state.pop_scrubbed_edges())
+        # A membership's elevation dies with it on BOTH planes (the
+        # device row scrub happened inside leave_agent).
+        held = self.elevation.get_active_elevation(agent_did, session_id)
+        if held is not None:
+            self.elevation.revoke_elevation(held.elevation_id)
+            self._elev_row_of.pop(held.elevation_id, None)
 
     async def update_agent_ring(
         self,
@@ -374,6 +389,18 @@ class Hypervisor:
             self.state.set_agent_ring(
                 row["slot"], new_ring.value, now=self.state.now()
             )
+        # A base-ring promotion at or beyond a live grant makes the
+        # grant moot — retire it on both planes. (The reference's host
+        # manager returns the grant ring blindly, `elevation.py:138-145`;
+        # the device resolves min(base, grant) since grants only
+        # elevate. Revoking the superseded grant keeps the planes'
+        # answers identical without changing either semantic.)
+        held = self.elevation.get_active_elevation(agent_did, session_id)
+        if held is not None and new_ring.value <= held.elevated_ring.value:
+            self.elevation.revoke_elevation(held.elevation_id)
+            dev_row = self._elev_row_of.pop(held.elevation_id, None)
+            if dev_row is not None:
+                self._revoke_device_grant(held, dev_row)
         if new_ring.value != before.value:
             self._emit(
                 EventType.RING_DEMOTED
@@ -453,6 +480,13 @@ class Hypervisor:
         # the endpoints are still resident.
         self._detach_and_remirror(self.state.pop_scrubbed_edges())
 
+        # The session's elevations die with it on both planes (device
+        # rows were scrubbed with the participant reclaim).
+        for grant in self.elevation.active_elevations:
+            if grant.session_id == session_id:
+                self.elevation.revoke_elevation(grant.elevation_id)
+                self._elev_row_of.pop(grant.elevation_id, None)
+
         self.gc.collect(
             session_id=session_id,
             vfs=managed.sso.vfs,
@@ -467,6 +501,116 @@ class Hypervisor:
             payload={"merkle_root": merkle_root},
         )
         return merkle_root
+
+    # ── ring elevation (both planes) ─────────────────────────────────
+
+    async def grant_elevation(
+        self,
+        session_id: str,
+        agent_did: str,
+        target_ring: ExecutionRing,
+        ttl_seconds: int = 0,
+        attestation: Optional[str] = None,
+        reason: str = "",
+    ):
+        """Grant a TTL-bounded ring elevation on BOTH planes.
+
+        Host refusal rules apply first (`rings/elevation.py:87-108`:
+        strictly more privileged, Ring 0 unreachable, one live grant per
+        (agent, session)); on success the device ElevationTable gets the
+        matching row so `HypervisorState.effective_rings` resolves the
+        elevated ring for write/lock waves. Returns the RingElevation.
+        """
+        managed = self._require(session_id)
+        participant = managed.sso.get_participant(agent_did)
+        grant = self.elevation.request_elevation(
+            agent_did=agent_did,
+            session_id=session_id,
+            current_ring=participant.ring,
+            target_ring=target_ring,
+            ttl_seconds=ttl_seconds,
+            attestation=attestation,
+            reason=reason,
+        )
+        row = self.state.agent_row(agent_did, managed.slot)
+        if row is not None:
+            try:
+                dev_row = self.state.grant_elevation(
+                    row["slot"],
+                    target_ring.value,
+                    now=self.state.now(),
+                    ttl_seconds=grant.remaining_seconds,
+                )
+            except (ValueError, RuntimeError):
+                # Device refusal after host grant would strand the grant
+                # host-only; roll the host grant back and re-raise.
+                self.elevation.revoke_elevation(grant.elevation_id)
+                raise
+            self._elev_row_of[grant.elevation_id] = dev_row
+        self._emit(
+            EventType.RING_ELEVATED,
+            session_id=session_id,
+            agent_did=agent_did,
+            payload={
+                "to": target_ring.value,
+                "ttl": grant.remaining_seconds,
+                "reason": reason,
+            },
+        )
+        return grant
+
+    def _revoke_device_grant(self, grant, dev_row: int) -> None:
+        """Deactivate a grant's device row, guarded against recycling.
+
+        The row may have been freed (leave/terminate scrub, device-side
+        expiry) and recycled to ANOTHER grant since the mapping was
+        recorded; `expected_agent` makes a stale handle a no-op instead
+        of deactivating the new tenant's elevation.
+        """
+        managed = self._sessions.get(grant.session_id)
+        row = (
+            self.state.agent_row(grant.agent_did, managed.slot)
+            if managed is not None
+            else None
+        )
+        if row is None:
+            # Membership gone: its device grant was scrubbed with the row.
+            return
+        try:
+            self.state.revoke_elevation(dev_row, expected_agent=row["slot"])
+        except ValueError:
+            pass  # recycled to another agent's grant — leave it alone
+
+    async def revoke_elevation(self, elevation_id: str) -> None:
+        """Revoke a grant before expiry on BOTH planes."""
+        grant = self.elevation.get(elevation_id)
+        self.elevation.revoke_elevation(elevation_id)
+        dev_row = self._elev_row_of.pop(elevation_id, None)
+        if dev_row is not None and grant is not None:
+            self._revoke_device_grant(grant, dev_row)
+
+    def sweep_elevations(self) -> int:
+        """Expire lapsed grants on BOTH planes; returns how many expired.
+
+        Host-expired grants revoke their device rows EXPLICITLY (guarded
+        by expected_agent): the device's f32 TTL compare may lapse a
+        sweep earlier or later than the host's datetime, and relying on
+        coincident expiry would leave one plane serving a grant the
+        other retired (`docs/OPERATIONS.md` "Ticks the operator owns").
+        """
+        expired = self.elevation.tick()
+        for grant in expired:
+            dev_row = self._elev_row_of.pop(grant.elevation_id, None)
+            if dev_row is not None:
+                self._revoke_device_grant(grant, dev_row)
+            self._emit(
+                EventType.RING_ELEVATION_EXPIRED,
+                session_id=grant.session_id,
+                agent_did=grant.agent_did,
+                payload={"was": grant.elevated_ring.value},
+            )
+        device_expired = self.state.elevation_tick(self.state.now())
+        return max(len(expired), device_expired)
 
     # ── behavior verification ────────────────────────────────────────
 

@@ -627,6 +627,29 @@ class HypervisorState:
                 )
                 self.free_edge_rows(rows)
                 self._scrubbed_edges.extend(int(r) for r in rows)
+            self._scrub_elevations_for_rows([slot])
+
+    def _scrub_elevations_for_rows(self, agent_rows) -> None:
+        """Deactivate device elevation grants held by freed agent rows.
+
+        A freed row's grant must die with the membership — left active it
+        would elevate whatever agent the recycled slot serves next (the
+        same slot-reuse hazard as dangling vouch edges).
+        """
+        if not len(agent_rows):
+            return
+        holder = np.asarray(self.elevations.agent)
+        active = np.asarray(self.elevations.active)
+        hit = active & np.isin(holder, np.asarray(agent_rows))
+        rows = np.nonzero(hit)[0]
+        if len(rows):
+            idx = jnp.asarray(rows)
+            self.elevations = replace(
+                self.elevations,
+                active=self.elevations.active.at[idx].set(False),
+                agent=self.elevations.agent.at[idx].set(-1),
+            )
+            self._free_elev_slots.extend(int(r) for r in rows)
 
     def to_device_time(self, absolute_ts: float) -> float:
         """Absolute unix seconds -> this state's epoch-relative f32 time."""
@@ -1386,6 +1409,7 @@ class HypervisorState:
                 )
                 self.free_edge_rows(rows)
                 self._scrubbed_edges.extend(int(r) for r in rows)
+            self._scrub_elevations_for_rows(reclaim)
         return np.asarray(result.roots)
 
     # ── views ────────────────────────────────────────────────────────

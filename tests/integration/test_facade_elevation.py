@@ -1,0 +1,198 @@
+"""Facade-wired ring elevation: one grant, both planes.
+
+The reference exports RingElevationManager but never wires it into the
+Hypervisor (`SURVEY §1 "exported but not wired"`); here
+`Hypervisor.grant_elevation` lands the grant in the host manager AND
+the device ElevationTable, so host queries and device
+`effective_rings` waves agree, revocation and expiry retire it on both
+planes together, and a device refusal rolls the host grant back.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu import Hypervisor, SessionConfig
+from hypervisor_tpu.models import ExecutionRing
+from hypervisor_tpu.rings.elevation import RingElevationError
+
+
+async def _session_with(hv, *joins):
+    ms = await hv.create_session(
+        SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+    )
+    for did, sigma in joins:
+        await hv.join_session(ms.sso.session_id, did, sigma_raw=sigma)
+    return ms
+
+
+class TestFacadeElevation:
+    async def test_grant_lands_on_both_planes(self):
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:e", 0.8))  # Ring 2
+        sid = ms.sso.session_id
+        grant = await hv.grant_elevation(
+            sid, "did:e", ExecutionRing.RING_1_PRIVILEGED, ttl_seconds=120
+        )
+        # Host plane.
+        assert hv.elevation.get_effective_ring(
+            "did:e", sid, ExecutionRing.RING_2_STANDARD
+        ) is ExecutionRing.RING_1_PRIVILEGED
+        # Device plane: effective_rings resolves the elevated ring.
+        row = hv.state.agent_row("did:e", ms.slot)
+        eff = hv.state.effective_rings(hv.state.now())
+        assert eff[row["slot"]] == 1
+        assert np.asarray(hv.state.agents.ring)[row["slot"]] == 2  # base kept
+        assert grant.remaining_seconds > 0
+
+    async def test_refusals_leave_device_untouched(self):
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:e", 0.8))
+        sid = ms.sso.session_id
+        with pytest.raises(RingElevationError):  # not more privileged
+            await hv.grant_elevation(
+                sid, "did:e", ExecutionRing.RING_2_STANDARD
+            )
+        with pytest.raises(RingElevationError):  # Ring 0 unreachable
+            await hv.grant_elevation(sid, "did:e", ExecutionRing.RING_0_ROOT)
+        assert not np.asarray(hv.state.elevations.active).any()
+
+        await hv.grant_elevation(sid, "did:e", ExecutionRing.RING_1_PRIVILEGED)
+        with pytest.raises(RingElevationError):  # one live grant
+            await hv.grant_elevation(
+                sid, "did:e", ExecutionRing.RING_1_PRIVILEGED
+            )
+        assert int(np.asarray(hv.state.elevations.active).sum()) == 1
+
+    async def test_revoke_retires_both_planes(self):
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:e", 0.8))
+        sid = ms.sso.session_id
+        grant = await hv.grant_elevation(
+            sid, "did:e", ExecutionRing.RING_1_PRIVILEGED
+        )
+        await hv.revoke_elevation(grant.elevation_id)
+        assert (
+            hv.elevation.get_active_elevation("did:e", sid) is None
+        )
+        row = hv.state.agent_row("did:e", ms.slot)
+        eff = hv.state.effective_rings(hv.state.now())
+        assert eff[row["slot"]] == 2  # back to base
+        assert not np.asarray(hv.state.elevations.active).any()
+
+    async def test_expiry_sweep_retires_both_planes(self):
+        from datetime import datetime, timedelta, timezone
+
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:e", 0.8))
+        sid = ms.sso.session_id
+        grant = await hv.grant_elevation(
+            sid, "did:e", ExecutionRing.RING_1_PRIVILEGED, ttl_seconds=60
+        )
+        # Back-date the host grant (the repo's standard expiry-test
+        # pattern) and push the device clock past the TTL.
+        grant.expires_at = datetime.now(timezone.utc) - timedelta(seconds=1)
+        row_slot = hv.state.agent_row("did:e", ms.slot)["slot"]
+        dev_row = hv._elev_row_of[grant.elevation_id]
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        hv.state.elevations = t_replace(
+            hv.state.elevations,
+            expires_at=hv.state.elevations.expires_at.at[dev_row].set(
+                hv.state.now() - 1.0
+            ),
+        )
+        expired = hv.sweep_elevations()
+        assert expired == 1
+        assert hv.elevation.get_active_elevation("did:e", sid) is None
+        eff = hv.state.effective_rings(hv.state.now())
+        assert eff[row_slot] == 2
+        assert not np.asarray(hv.state.elevations.active).any()
+
+    async def test_elevation_event_emitted(self):
+        from hypervisor_tpu import EventType, HypervisorEventBus
+
+        bus = HypervisorEventBus()
+        hv = Hypervisor(event_bus=bus)
+        ms = await _session_with(hv, ("did:e", 0.8))
+        await hv.grant_elevation(
+            ms.sso.session_id, "did:e", ExecutionRing.RING_1_PRIVILEGED,
+            reason="oncall",
+        )
+        events = bus.query(event_type=EventType.RING_ELEVATED)
+        assert len(events) == 1
+        assert events[0].payload["to"] == 1
+
+
+class TestElevationLifecycleScrub:
+    async def test_leave_retires_the_membership_grant(self):
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:e", 0.8), ("did:f", 0.8))
+        sid = ms.sso.session_id
+        grant = await hv.grant_elevation(
+            sid, "did:e", ExecutionRing.RING_1_PRIVILEGED
+        )
+        slot = hv.state.agent_row("did:e", ms.slot)["slot"]
+        await hv.leave_session(sid, "did:e")
+        # Host grant revoked; device grant row deactivated — the freed
+        # agent row's next tenant must NOT inherit Ring 1.
+        assert hv.elevation.get_active_elevation("did:e", sid) is None
+        assert not np.asarray(hv.state.elevations.active).any()
+        assert grant.elevation_id not in hv._elev_row_of
+        eff = hv.state.effective_rings(hv.state.now())
+        assert eff[slot] >= 2
+
+    async def test_terminate_retires_session_grants(self):
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:e", 0.8))
+        sid = ms.sso.session_id
+        await hv.activate_session(sid)
+        await hv.grant_elevation(sid, "did:e", ExecutionRing.RING_1_PRIVILEGED)
+        await hv.terminate_session(sid)
+        assert hv.elevation.get_active_elevation("did:e", sid) is None
+        assert not np.asarray(hv.state.elevations.active).any()
+        assert hv._elev_row_of == {}
+
+    async def test_stale_handle_never_clobbers_recycled_row(self):
+        # Reviewer-found hazard: grant G's device row is freed (leave
+        # scrub) and recycled to ANOTHER agent's grant; a later revoke
+        # of G must not deactivate the new tenant's elevation.
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:e", 0.8), ("did:f", 0.8))
+        sid = ms.sso.session_id
+        g1 = await hv.grant_elevation(sid, "did:e", ExecutionRing.RING_1_PRIVILEGED)
+        dev_row_1 = hv._elev_row_of[g1.elevation_id]
+        # Simulate a stale mapping surviving a scrub (the facade normally
+        # pops it on leave; force the hazard window explicitly).
+        await hv.leave_session(sid, "did:e")
+        hv._elev_row_of[g1.elevation_id] = dev_row_1  # stale handle
+        # The freed elevation row recycles to did:f's new grant.
+        g2 = await hv.grant_elevation(sid, "did:f", ExecutionRing.RING_1_PRIVILEGED)
+        assert hv._elev_row_of[g2.elevation_id] == dev_row_1
+        await hv.revoke_elevation(g1.elevation_id)
+        # did:f's grant survives on both planes.
+        assert hv.elevation.get_active_elevation("did:f", sid) is not None
+        row_f = hv.state.agent_row("did:f", ms.slot)
+        eff = hv.state.effective_rings(hv.state.now())
+        assert eff[row_f["slot"]] == 1
+
+    async def test_host_expiry_revokes_device_row_explicitly(self):
+        # Host grant lapses while the device f32 TTL has NOT (clock
+        # skew): the sweep must retire the device row explicitly rather
+        # than waiting for coincident device expiry.
+        from datetime import datetime, timedelta, timezone
+
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:e", 0.8))
+        sid = ms.sso.session_id
+        grant = await hv.grant_elevation(
+            sid, "did:e", ExecutionRing.RING_1_PRIVILEGED, ttl_seconds=300
+        )
+        grant.expires_at = datetime.now(timezone.utc) - timedelta(seconds=1)
+        # Device row still far from its TTL — no device-side expiry.
+        assert hv.sweep_elevations() == 1
+        row = hv.state.agent_row("did:e", ms.slot)
+        eff = hv.state.effective_rings(hv.state.now())
+        assert eff[row["slot"]] == 2, "device kept serving a retired grant"
+        assert not np.asarray(hv.state.elevations.active).any()

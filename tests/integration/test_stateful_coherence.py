@@ -208,11 +208,35 @@ class PlaneCoherence(RuleBasedStateMachine):
                     "quarantine leaked into another session's row"
                 )
 
+    @precondition(lambda self: any(self.joined.values()))
+    @rule(pick=st.integers(0, 3))
+    def elevate(self, pick):
+        """Facade elevation: one grant, both planes."""
+        from hypervisor_tpu.models import ExecutionRing
+        from hypervisor_tpu.rings.elevation import RingElevationError
+
+        sids = [s for s in self.sessions if self.joined[s]]
+        if not sids:
+            return
+        sid = sids[pick % len(sids)]
+        agent = sorted(self.joined[sid])[0]
+        ring = self.hv.get_session(sid).sso.get_participant(agent).ring
+        if ring.value <= 1:
+            return
+        try:
+            self.go(
+                self.hv.grant_elevation(
+                    sid, agent, ExecutionRing(ring.value - 1), ttl_seconds=120
+                )
+            )
+        except RingElevationError:
+            pass  # one live grant per (agent, session) — legal refusal
+
     @rule()
     def sweeps(self):
         now = self.hv.state.now()
         self.hv.state.breach_sweep_tick(now)
-        self.hv.state.elevation_tick(now)
+        self.hv.sweep_elevations()
         self.hv.state.quarantine_tick(now)
 
     @precondition(lambda self: any(self.joined.values()))
@@ -277,6 +301,26 @@ class PlaneCoherence(RuleBasedStateMachine):
         assert dev_live == host_mirrorable, (
             f"vouch mirror drift: host {host_mirrorable} device {dev_live}"
         )
+
+    @invariant()
+    def effective_rings_agree(self):
+        # Facade-wired elevation: for every live membership, the device
+        # effective ring (base min active grants on the row) equals the
+        # host manager's resolution for that (agent, session).
+        eff = self.hv.state.effective_rings(self.hv.state.now())
+        for sid in self.sessions:
+            managed = self.hv.get_session(sid)
+            for p in managed.sso.participants:
+                row = self.hv.state.agent_row(p.agent_did, managed.slot)
+                if row is None:
+                    continue
+                host_eff = self.hv.elevation.get_effective_ring(
+                    p.agent_did, sid, p.ring
+                )
+                assert eff[row["slot"]] == host_eff.value, (
+                    f"effective ring drift for {p.agent_did} in {sid}: "
+                    f"device {eff[row['slot']]} host {host_eff.value}"
+                )
 
     @invariant()
     def mirrored_edges_point_at_best_rows(self):

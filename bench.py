@@ -129,6 +129,11 @@ def main() -> int:
 def run_bench() -> None:
     # Fail fast (rc=17 + diagnostic) if the TPU tunnel is wedged instead
     # of hanging this attempt; the wrapper in main() retries with backoff.
+    # HV_BENCH_MESH=N runs the SAME staged wave through the fully-sharded
+    # fused program (`sharded_governance_wave`) over an N-device mesh —
+    # BASELINE's "10k concurrent sessions multi-chip" config; with one
+    # real chip this exercises the virtual CPU mesh instead (loud
+    # fallback in make_mesh).
     from _jax_platform import arm_device_watchdog
 
     disarm = arm_device_watchdog(DISCOVERY_TIMEOUT_S, "TPU device discovery")
@@ -164,6 +169,18 @@ def run_bench() -> None:
     )
     dids = [f"did:bench:{i}" for i in range(N_SESSIONS)]
     agent_sessions = session_slots.copy()
+    b = len(dids)
+    mesh_n = int(os.environ.get("HV_BENCH_MESH", "0"))
+    if mesh_n:
+        from hypervisor_tpu.parallel import make_mesh
+        from hypervisor_tpu.parallel.collectives import sharded_governance_wave
+
+        mesh = make_mesh(mesh_n)
+        agent_slots = state._mesh_wave_slots(b, mesh_n)
+        wave_fn = sharded_governance_wave(mesh)
+    else:
+        agent_slots = np.arange(b, dtype=np.int32)
+        wave_fn = None
     # Vouched lanes join with LOW raw sigma; their bonded contributions
     # must lift them over the Ring-2 threshold (sigma > 0.60).
     sigma = np.full(N_SESSIONS, 0.8, np.float32)
@@ -171,7 +188,7 @@ def run_bench() -> None:
     voucher_slots = np.arange(
         N_SESSIONS, N_SESSIONS + N_VOUCHED, dtype=np.int32
     )  # phantom high-trust vouchers parked outside the wave
-    vouchee_slots = np.arange(N_VOUCHED, dtype=np.int32)
+    vouchee_slots = agent_slots[:N_VOUCHED]  # the wave's actual rows
     state.vouches = t_replace(
         state.vouches,
         voucher=state.vouches.voucher.at[:N_VOUCHED].set(jnp.asarray(voucher_slots)),
@@ -190,34 +207,63 @@ def run_bench() -> None:
     # Stage the wave once; the timed loop re-executes the pure jitted
     # program on the same staged inputs (the op reads+writes the tables
     # functionally, so each execution is the identical full pipeline).
-    b = len(dids)
-    agent_slots = np.arange(b, dtype=np.int32)
+    # Mesh mode lays every input out across the mesh up front (tables:
+    # agent rows + vouch edges sharded, sessions replicated) so the
+    # timed loop measures the wave, not host->mesh transfers.
+    if mesh_n:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        lane_s = NamedSharding(mesh, P("agents"))
+        rep_s = NamedSharding(mesh, P())
+
+        def put(x):
+            return jax.device_put(x, lane_s)
+
+        tables_in = (
+            jax.device_put(state.agents, lane_s),
+            jax.device_put(state.sessions, rep_s),
+            jax.device_put(state.vouches, lane_s),
+        )
+        bodies_in = jax.device_put(
+            jnp.asarray(bodies), NamedSharding(mesh, P(None, "agents"))
+        )
+    else:
+
+        def put(x):
+            return jax.device_put(x, dev)
+
+        tables_in = (state.agents, state.sessions, state.vouches)
+        bodies_in = jax.device_put(jnp.asarray(bodies), dev)
+
     handles = np.array([state.agent_ids.intern(d) for d in dids], np.int32)
     wave_args = (
-        state.agents,
-        state.sessions,
-        state.vouches,
-        jax.device_put(jnp.asarray(agent_slots), dev),
-        jax.device_put(jnp.asarray(handles), dev),
-        jax.device_put(jnp.asarray(agent_sessions), dev),
-        jax.device_put(jnp.asarray(sigma), dev),
-        jax.device_put(jnp.ones(b, bool), dev),
-        jax.device_put(jnp.zeros(b, bool), dev),
-        jax.device_put(jnp.asarray(session_slots), dev),
-        jax.device_put(jnp.asarray(bodies), dev),
+        *tables_in,
+        put(jnp.asarray(agent_slots)),
+        put(jnp.asarray(handles)),
+        put(jnp.asarray(agent_sessions)),
+        put(jnp.asarray(sigma)),
+        put(jnp.ones(b, bool)),
+        put(jnp.zeros(b, bool)),
+        put(jnp.asarray(session_slots)),
+        bodies_in,
         0.0,
         OMEGA,
     )
 
+    def execute():
+        if wave_fn is not None:
+            return wave_fn(*wave_args)
+        return _WAVE(*wave_args)
+
     # Warmup (compile + cache).
     for _ in range(WARMUP):
-        result = _WAVE(*wave_args)
+        result = execute()
         jax.block_until_ready(result)
 
     samples = []
     for _ in range(ITERS):
         t0 = time.perf_counter_ns()
-        result = _WAVE(*wave_args)
+        result = execute()
         jax.block_until_ready(result)
         samples.append(time.perf_counter_ns() - t0)
 
@@ -266,6 +312,7 @@ def run_bench() -> None:
                 ),
                 "vouched_lanes": N_VOUCHED,
                 "device": str(dev),
+                "mesh_devices": mesh_n or 1,
             }
         )
     )

@@ -360,11 +360,16 @@ class Hypervisor:
         self.state.leave_agent(managed.slot, agent_did)
         self._detach_and_remirror(self.state.pop_scrubbed_edges())
         # A membership's elevation dies with it on BOTH planes (the
-        # device row scrub happened inside leave_agent).
+        # device row scrub happened inside leave_agent). Mapping entries
+        # purge for EVERY grant of the membership — including lapsed
+        # unswept ones, whose stale row handles could otherwise target a
+        # recycled row the same agent's NEXT grant occupies.
         held = self.elevation.get_active_elevation(agent_did, session_id)
         if held is not None:
             self.elevation.revoke_elevation(held.elevation_id)
-            self._elev_row_of.pop(held.elevation_id, None)
+        self._purge_grant_mappings(
+            lambda g: g.agent_did == agent_did and g.session_id == session_id
+        )
 
     async def update_agent_ring(
         self,
@@ -389,14 +394,21 @@ class Hypervisor:
             self.state.set_agent_ring(
                 row["slot"], new_ring.value, now=self.state.now()
             )
-        # A base-ring promotion at or beyond a live grant makes the
-        # grant moot — retire it on both planes. (The reference's host
-        # manager returns the grant ring blindly, `elevation.py:138-145`;
-        # the device resolves min(base, grant) since grants only
-        # elevate. Revoking the superseded grant keeps the planes'
-        # answers identical without changing either semantic.)
+        # An explicit ring update retires a live grant that no longer
+        # fits: a promotion at or beyond the grant makes it moot, and a
+        # DEMOTION must not leave the agent holding sudo privileges the
+        # operator just revoked at the base (a Ring-3 demotion with a
+        # surviving Ring-1 grant would keep resolving Ring 1 for the
+        # grant's whole TTL on both planes). The reference's host
+        # manager returns the grant ring blindly (`elevation.py:138-
+        # 145`); the device resolves min(base, grant) — retiring the
+        # superseded grant keeps the planes' answers identical without
+        # changing either semantic.
         held = self.elevation.get_active_elevation(agent_did, session_id)
-        if held is not None and new_ring.value <= held.elevated_ring.value:
+        if held is not None and (
+            new_ring.value <= held.elevated_ring.value
+            or new_ring.value > before.value
+        ):
             self.elevation.revoke_elevation(held.elevation_id)
             dev_row = self._elev_row_of.pop(held.elevation_id, None)
             if dev_row is not None:
@@ -481,11 +493,12 @@ class Hypervisor:
         self._detach_and_remirror(self.state.pop_scrubbed_edges())
 
         # The session's elevations die with it on both planes (device
-        # rows were scrubbed with the participant reclaim).
+        # rows were scrubbed with the participant reclaim); mapping
+        # entries purge for lapsed unswept grants too (stale handles).
         for grant in self.elevation.active_elevations:
             if grant.session_id == session_id:
                 self.elevation.revoke_elevation(grant.elevation_id)
-                self._elev_row_of.pop(grant.elevation_id, None)
+        self._purge_grant_mappings(lambda g: g.session_id == session_id)
 
         self.gc.collect(
             session_id=session_id,
@@ -559,6 +572,17 @@ class Hypervisor:
         )
         return grant
 
+    def _purge_grant_mappings(self, predicate) -> None:
+        """Drop _elev_row_of entries whose grant matches `predicate` —
+        regardless of grant liveness (a lapsed-but-unswept grant's stale
+        handle is exactly the recycled-row hazard)."""
+        for eid in [
+            eid
+            for eid in self._elev_row_of
+            if (g := self.elevation.get(eid)) is not None and predicate(g)
+        ]:
+            del self._elev_row_of[eid]
+
     def _revoke_device_grant(self, grant, dev_row: int) -> None:
         """Deactivate a grant's device row, guarded against recycling.
 
@@ -590,13 +614,18 @@ class Hypervisor:
             self._revoke_device_grant(grant, dev_row)
 
     def sweep_elevations(self) -> int:
-        """Expire lapsed grants on BOTH planes; returns how many expired.
+        """Expire lapsed grants on BOTH planes; returns how many GRANTS
+        retired this sweep (facade grants count once, ever).
 
         Host-expired grants revoke their device rows EXPLICITLY (guarded
         by expected_agent): the device's f32 TTL compare may lapse a
         sweep earlier or later than the host's datetime, and relying on
         coincident expiry would leave one plane serving a grant the
         other retired (`docs/OPERATIONS.md` "Ticks the operator owns").
+        Device-only grants (placed via `state.grant_elevation` directly)
+        count from the device tick, EXCLUDING rows still mapped to
+        facade grants — a facade row that device-expires a sweep before
+        its host datetime must not count now and again at host expiry.
         """
         expired = self.elevation.tick()
         for grant in expired:
@@ -609,8 +638,16 @@ class Hypervisor:
                 agent_did=grant.agent_did,
                 payload={"was": grant.elevated_ring.value},
             )
-        device_expired = self.state.elevation_tick(self.state.now())
-        return max(len(expired), device_expired)
+        mapped_rows = set(self._elev_row_of.values())
+        before_active = np.asarray(self.state.elevations.active).copy()
+        self.state.elevation_tick(self.state.now())
+        after_active = np.asarray(self.state.elevations.active)
+        device_only = sum(
+            1
+            for r in np.nonzero(before_active & ~after_active)[0]
+            if int(r) not in mapped_rows
+        )
+        return len(expired) + device_only
 
     # ── behavior verification ────────────────────────────────────────
 

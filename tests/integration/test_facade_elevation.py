@@ -196,3 +196,69 @@ class TestElevationLifecycleScrub:
         eff = hv.state.effective_rings(hv.state.now())
         assert eff[row["slot"]] == 2, "device kept serving a retired grant"
         assert not np.asarray(hv.state.elevations.active).any()
+
+    async def test_lapsed_unswept_grant_leaves_no_stale_handle(self):
+        # Grant lapses host-side with NO sweep; agent leaves, rejoins,
+        # and gets a new grant that recycles the old device row. The
+        # later sweep must not deactivate the new grant (same agent =>
+        # expected_agent alone cannot catch this; the mapping purge on
+        # leave must).
+        from datetime import datetime, timedelta, timezone
+
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:e", 0.8))
+        sid = ms.sso.session_id
+        g1 = await hv.grant_elevation(sid, "did:e", ExecutionRing.RING_1_PRIVILEGED)
+        old_row = hv._elev_row_of[g1.elevation_id]
+        g1.expires_at = datetime.now(timezone.utc) - timedelta(seconds=1)
+        await hv.leave_session(sid, "did:e")
+        assert g1.elevation_id not in hv._elev_row_of  # purged though lapsed
+
+        ms2 = await _session_with(hv, ("did:e", 0.8))
+        g2 = await hv.grant_elevation(
+            ms2.sso.session_id, "did:e", ExecutionRing.RING_1_PRIVILEGED
+        )
+        assert hv._elev_row_of[g2.elevation_id] == old_row  # recycled
+        hv.sweep_elevations()  # host-expires g1
+        # g2 survives on both planes.
+        assert (
+            hv.elevation.get_active_elevation("did:e", ms2.sso.session_id)
+            is not None
+        )
+        row = hv.state.agent_row("did:e", ms2.slot)
+        eff = hv.state.effective_rings(hv.state.now())
+        assert eff[row["slot"]] == 1
+
+    async def test_demotion_retires_live_grant(self):
+        # An operator demotion must not leave the agent holding sudo for
+        # the grant's remaining TTL.
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:e", 0.8))  # Ring 2
+        sid = ms.sso.session_id
+        await hv.grant_elevation(sid, "did:e", ExecutionRing.RING_1_PRIVILEGED)
+        await hv.update_agent_ring(
+            sid, "did:e", ExecutionRing.RING_3_SANDBOX, reason="suspicious"
+        )
+        assert hv.elevation.get_active_elevation("did:e", sid) is None
+        row = hv.state.agent_row("did:e", ms.slot)
+        eff = hv.state.effective_rings(hv.state.now())
+        assert eff[row["slot"]] == 3, "demoted agent kept sudo ring"
+
+    async def test_sweep_counts_facade_and_device_grants_additively(self):
+        from datetime import datetime, timedelta, timezone
+
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:e", 0.8), ("did:f", 0.8))
+        sid = ms.sso.session_id
+        g = await hv.grant_elevation(sid, "did:e", ExecutionRing.RING_1_PRIVILEGED)
+        g.expires_at = datetime.now(timezone.utc) - timedelta(seconds=1)
+        # A device-only grant for did:f, already past its device TTL.
+        row_f = hv.state.agent_row("did:f", ms.slot)
+        dev_row = hv.state.grant_elevation(
+            row_f["slot"], granted_ring=1, now=hv.state.now() - 100.0,
+            ttl_seconds=10.0,
+        )
+        assert dev_row is not None
+        assert hv.sweep_elevations() == 2  # one facade + one device-only

@@ -185,9 +185,23 @@ def run_bench() -> None:
     # must lift them over the Ring-2 threshold (sigma > 0.60).
     sigma = np.full(N_SESSIONS, 0.8, np.float32)
     sigma[:N_VOUCHED] = 0.50
-    voucher_slots = np.arange(
-        N_SESSIONS, N_SESSIONS + N_VOUCHED, dtype=np.int32
-    )  # phantom high-trust vouchers parked outside the wave
+    if mesh_n:
+        # Phantom vouchers must sit OUTSIDE every shard's mesh-wave
+        # region (the top b/D rows of each shard) — park them at the
+        # BOTTOM of the shard regions, which the wave never writes.
+        rows_per_shard = state.agents.did.shape[0] // mesh_n
+        voucher_slots = np.array(
+            [
+                (i % mesh_n) * rows_per_shard + (i // mesh_n)
+                for i in range(N_VOUCHED)
+            ],
+            np.int32,
+        )
+        assert N_VOUCHED // mesh_n < rows_per_shard - N_SESSIONS // mesh_n
+    else:
+        voucher_slots = np.arange(
+            N_SESSIONS, N_SESSIONS + N_VOUCHED, dtype=np.int32
+        )  # parked above the wave's arange(B) rows
     vouchee_slots = agent_slots[:N_VOUCHED]  # the wave's actual rows
     state.vouches = t_replace(
         state.vouches,

@@ -303,6 +303,32 @@ class LeaveSessionRequest(BaseModel):
     agent_did: str
 
 
+class KillAgentRequest(BaseModel):
+    agent_did: str
+    reason: str = "manual"
+    details: str = ""
+    # In-flight step descriptors to rehome: [{step_id, saga_id}, ...].
+    in_flight_steps: list = []
+
+
+class KillAgentResponse(BaseModel):
+    """One graceful termination's outcome.
+
+    Substitute routing here is the RECORDED handoff decision; rewiring
+    the steps onto the device saga table needs host executor callables
+    (`runtime.saga_scheduler.apply_handoffs`), which HTTP clients cannot
+    ship — programmatic callers pass scheduler/executors to
+    `Hypervisor.kill_agent` directly.
+    """
+
+    agent_did: str
+    session_id: str
+    reason: str
+    handoffs: int = 0
+    handed_off: int = 0
+    compensation_triggered: bool = False
+
+
 class SweepResponse(BaseModel):
     """One operator tick's outcomes across every sweep."""
 

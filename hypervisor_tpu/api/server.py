@@ -59,6 +59,8 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/api/v1/security/quarantines", "list_quarantines", None),
     ("POST", "/api/v1/sessions/{session_id}/leave", "leave_session",
      M.LeaveSessionRequest),
+    ("POST", "/api/v1/sessions/{session_id}/kill", "kill_agent",
+     M.KillAgentRequest),
     ("POST", "/api/v1/security/sweep", "run_sweeps", None),
 ]
 

@@ -387,14 +387,50 @@ class HypervisorService:
         self, session_id: str, req: M.LeaveSessionRequest
     ) -> dict[str, Any]:
         """Remove a participant from both planes (facade leave)."""
+        if self.hv.get_session(session_id) is None:
+            raise ApiError(404, f"Session {session_id} not found")
         try:
             await self.hv.leave_session(session_id, req.agent_did)
-        except KeyError:
-            raise ApiError(404, f"Session {session_id} not found")
         except Exception as e:
             raise ApiError(409, str(e))
         return {"session_id": session_id, "agent_did": req.agent_did,
                 "status": "left"}
+
+    async def kill_agent(
+        self, session_id: str, req: M.KillAgentRequest
+    ) -> M.KillAgentResponse:
+        """Graceful termination: saga-step handoff, then both-plane
+        removal (`Hypervisor.kill_agent`)."""
+        from hypervisor_tpu.security.kill_switch import KillReason
+
+        try:
+            reason = KillReason(req.reason)
+        except ValueError:
+            raise ApiError(
+                422,
+                f"unknown kill reason {req.reason!r}; one of "
+                f"{[r.value for r in KillReason]}",
+            )
+        if self.hv.get_session(session_id) is None:
+            raise ApiError(404, f"Session {session_id} not found")
+        try:
+            result = await self.hv.kill_agent(
+                session_id,
+                req.agent_did,
+                reason=reason,
+                details=req.details,
+                in_flight_steps=list(req.in_flight_steps or ()),
+            )
+        except Exception as e:
+            raise ApiError(409, str(e))
+        return M.KillAgentResponse(
+            agent_did=req.agent_did,
+            session_id=session_id,
+            reason=result.reason.value,
+            handoffs=len(result.handoffs),
+            handed_off=result.handoff_success_count,
+            compensation_triggered=result.compensation_triggered,
+        )
 
     async def run_sweeps(self) -> M.SweepResponse:
         """One operator tick: breach, elevation, quarantine, expiry sweeps

@@ -152,6 +152,12 @@ class Hypervisor:
         self.commitment = CommitmentEngine()
         self.gc = EphemeralGC(retention_policy)
         self.quarantine = QuarantineManager()
+        # Graceful termination with saga-step handoff, facade-wired
+        # (the reference exports KillSwitch but never wires it).
+        from hypervisor_tpu.security.kill_switch import KillSwitch
+
+        self.kill_switch = KillSwitch()
+
         # Sudo-with-TTL elevations, facade-wired across BOTH planes
         # (the reference exports its manager but never wires it,
         # SURVEY §1 "exported but not wired"): grants land in the host
@@ -359,6 +365,8 @@ class Hypervisor:
         managed.sso.leave(agent_did)
         self.state.leave_agent(managed.slot, agent_did)
         self._detach_and_remirror(self.state.pop_scrubbed_edges())
+        # A departed agent can no longer substitute for killed peers.
+        self.kill_switch.unregister_substitute(session_id, agent_did)
         # A membership's elevation dies with it on BOTH planes (the
         # device row scrub happened inside leave_agent). Mapping entries
         # purge for EVERY grant of the membership — including lapsed
@@ -499,6 +507,7 @@ class Hypervisor:
             if grant.session_id == session_id:
                 self.elevation.revoke_elevation(grant.elevation_id)
         self._purge_grant_mappings(lambda g: g.session_id == session_id)
+        self.kill_switch.drop_session(session_id)
 
         self.gc.collect(
             session_id=session_id,
@@ -514,6 +523,79 @@ class Hypervisor:
             payload={"merkle_root": merkle_root},
         )
         return merkle_root
+
+    # ── kill switch (graceful termination, both planes) ──────────────
+
+    async def kill_agent(
+        self,
+        session_id: str,
+        agent_did: str,
+        reason=None,
+        in_flight_steps: Optional[list] = None,
+        details: str = "",
+        scheduler=None,
+        step_index: Optional[dict] = None,
+        substitute_executors: Optional[dict] = None,
+    ):
+        """Gracefully terminate one agent: hand its in-flight saga steps
+        to substitutes (or route them to compensation), then remove the
+        membership from BOTH planes.
+
+        The reference exports KillSwitch but never wires it into the
+        Hypervisor (`security/kill_switch.py:64-180`); here the victim
+        is validated as an ACTIVE participant before any side effect
+        (a failed kill must not log a phantom KillResult or rotate the
+        substitute pool), then the handoff runs (the victim leaves the
+        pool before rehoming, so it can never rescue itself), then the
+        full leave_session path retires the device row, scrubs its
+        vouch edges, and kills the membership's elevations.
+
+        Substitute routing in the KillResult is BOOKKEEPING until the
+        steps are rewired onto the device saga table: pass `scheduler`
+        (a `runtime.saga_scheduler.SagaScheduler`) plus its
+        `step_index` and `substitute_executors` to run
+        `scheduler.apply_handoffs` here — executors are host callables,
+        so callers that only know DIDs (e.g. the REST endpoint) get the
+        routing decision recorded but must rewire separately. Returns
+        the KillResult.
+        """
+        from hypervisor_tpu.security.kill_switch import KillReason
+        from hypervisor_tpu.session import SessionParticipantError
+
+        if reason is None:
+            reason = KillReason.MANUAL
+        managed = self._require(session_id)
+        participant = managed.sso.get_participant(agent_did)  # raises ghost
+        if not participant.is_active:
+            raise SessionParticipantError(
+                f"Agent {agent_did} already left session"
+            )
+        result = self.kill_switch.kill(
+            agent_did,
+            session_id,
+            reason=reason,
+            in_flight_steps=in_flight_steps,
+            details=details,
+        )
+        if scheduler is not None:
+            scheduler.apply_handoffs(
+                result,
+                step_index or {},
+                substitute_executors or {},
+            )
+        await self.leave_session(session_id, agent_did)
+        self._emit(
+            EventType.AGENT_KILLED,
+            session_id=session_id,
+            agent_did=agent_did,
+            payload={
+                "reason": result.reason.value,
+                "handoffs": len(result.handoffs),
+                "handed_off": result.handoff_success_count,
+                "compensation_triggered": result.compensation_triggered,
+            },
+        )
+        return result
 
     # ── ring elevation (both planes) ─────────────────────────────────
 

@@ -76,6 +76,11 @@ class KillSwitch:
         if pool and agent_did in pool:
             pool.remove(agent_did)
 
+    def drop_session(self, session_id: str) -> None:
+        """Retire a terminated session's whole substitute pool (pools
+        would otherwise accumulate across session lifetimes forever)."""
+        self._pools.pop(session_id, None)
+
     def substitutes(self, session_id: str) -> list[str]:
         """Current substitute pool for a session (registration order)."""
         return list(self._pools.get(session_id, ()))

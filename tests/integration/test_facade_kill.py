@@ -1,0 +1,181 @@
+"""Facade-wired kill switch: handoff, then both-plane removal.
+
+The reference exports KillSwitch but never wires it into the Hypervisor
+(`security/kill_switch.py:64-180`); `Hypervisor.kill_agent` runs the
+substitute handoff and then the full leave path — device row freed,
+vouch edges scrubbed/re-pointed, membership elevations retired — with
+an AGENT_KILLED event carrying the handoff outcome.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hypervisor_tpu import EventType, Hypervisor, HypervisorEventBus, SessionConfig
+from hypervisor_tpu.security.kill_switch import HandoffStatus, KillReason
+
+
+async def _session_with(hv, *joins):
+    ms = await hv.create_session(
+        SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+    )
+    for did, sigma in joins:
+        await hv.join_session(ms.sso.session_id, did, sigma_raw=sigma)
+    return ms
+
+
+class TestFacadeKill:
+    async def test_kill_hands_off_and_removes_membership(self):
+        bus = HypervisorEventBus()
+        hv = Hypervisor(event_bus=bus)
+        ms = await _session_with(hv, ("did:victim", 0.8), ("did:sub", 0.9))
+        sid = ms.sso.session_id
+        hv.kill_switch.register_substitute(sid, "did:sub")
+
+        result = await hv.kill_agent(
+            sid,
+            "did:victim",
+            reason=KillReason.RING_BREACH,
+            in_flight_steps=[
+                {"step_id": "s1", "saga_id": "g1"},
+                {"step_id": "s2", "saga_id": "g1"},
+            ],
+        )
+        # Handoff: both steps rehomed to the substitute.
+        assert result.handoff_success_count == 2
+        assert all(
+            h.status is HandoffStatus.HANDED_OFF and h.to_agent == "did:sub"
+            for h in result.handoffs
+        )
+        # Membership removed on both planes.
+        assert not ms.sso.get_participant("did:victim").is_active
+        assert hv.state.agent_row("did:victim", ms.slot) is None
+        assert int(np.asarray(hv.state.sessions.n_participants)[ms.slot]) == 1
+        # Event carries the outcome.
+        ev = bus.query(event_type=EventType.AGENT_KILLED)
+        assert len(ev) == 1 and ev[0].payload["handed_off"] == 2
+
+    async def test_kill_without_substitutes_compensates(self):
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:victim", 0.8))
+        result = await hv.kill_agent(
+            ms.sso.session_id,
+            "did:victim",
+            in_flight_steps=[{"step_id": "s1", "saga_id": "g1"}],
+        )
+        assert result.compensation_triggered
+        assert result.handoffs[0].status is HandoffStatus.COMPENSATED
+        assert result.reason is KillReason.MANUAL
+
+    async def test_victim_never_rescues_itself(self):
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:victim", 0.8))
+        sid = ms.sso.session_id
+        hv.kill_switch.register_substitute(sid, "did:victim")
+        result = await hv.kill_agent(
+            sid, "did:victim",
+            in_flight_steps=[{"step_id": "s1", "saga_id": "g1"}],
+        )
+        assert result.handoffs[0].status is HandoffStatus.COMPENSATED
+
+    async def test_kill_retires_vouch_edges_and_elevations(self):
+        from hypervisor_tpu.models import ExecutionRing
+
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:victim", 0.8), ("did:other", 0.9))
+        sid = ms.sso.session_id
+        hv.vouching.vouch("did:other", "did:victim", sid, voucher_sigma=0.9)
+        await hv.grant_elevation(
+            sid, "did:victim", ExecutionRing.RING_1_PRIVILEGED
+        )
+        assert int(np.asarray(hv.state.vouches.active).sum()) == 1
+        assert int(np.asarray(hv.state.elevations.active).sum()) == 1
+
+        await hv.kill_agent(sid, "did:victim")
+        assert int(np.asarray(hv.state.vouches.active).sum()) == 0
+        assert int(np.asarray(hv.state.elevations.active).sum()) == 0
+        assert (
+            hv.elevation.get_active_elevation("did:victim", sid) is None
+        )
+
+    async def test_kill_validation_precedes_side_effects(self):
+        # A failed kill must not log a phantom KillResult nor rotate the
+        # substitute pool (reviewer-found ordering hazard).
+        import pytest
+
+        from hypervisor_tpu.session import SessionParticipantError
+
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:a", 0.8), ("did:s", 0.9))
+        sid = ms.sso.session_id
+        hv.kill_switch.register_substitute(sid, "did:s")
+        with pytest.raises(SessionParticipantError):
+            await hv.kill_agent(sid, "did:ghost")
+        assert hv.kill_switch.total_kills == 0
+        assert hv.kill_switch.substitutes(sid) == ["did:s"]
+        # Double-kill refuses too (the victim already left).
+        await hv.kill_agent(sid, "did:a")
+        with pytest.raises(SessionParticipantError):
+            await hv.kill_agent(sid, "did:a")
+        assert hv.kill_switch.total_kills == 1
+
+    async def test_leave_and_terminate_clean_substitute_pools(self):
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:s", 0.9), ("did:v", 0.8))
+        sid = ms.sso.session_id
+        await hv.activate_session(sid)
+        hv.kill_switch.register_substitute(sid, "did:s")
+        # A departed agent can no longer substitute.
+        await hv.leave_session(sid, "did:s")
+        assert hv.kill_switch.substitutes(sid) == []
+        result = await hv.kill_agent(
+            sid, "did:v", in_flight_steps=[{"step_id": "s1", "saga_id": "g"}]
+        )
+        assert result.handoffs[0].status is HandoffStatus.COMPENSATED
+        # Termination drops the whole pool.
+        hv.kill_switch.register_substitute(sid, "did:late")
+        await hv.terminate_session(sid)
+        assert sid not in hv.kill_switch._pools
+
+    async def test_kill_with_scheduler_rewires_device_steps(self):
+        # End-to-end: the facade kill rewires the victim's steps onto
+        # the device saga table when given the scheduler + executors.
+        import asyncio as aio
+
+        from hypervisor_tpu.ops import saga_ops
+        from hypervisor_tpu.runtime.saga_scheduler import SagaScheduler
+
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:victim", 0.8), ("did:sub", 0.9))
+        sid = ms.sso.session_id
+        hv.kill_switch.register_substitute(sid, "did:sub")
+        g = hv.state.create_saga(
+            "saga:fk", ms.slot, [{"retries": 0}, {"retries": 0}]
+        )
+        sched = SagaScheduler(hv.state, retry_backoff_seconds=0.0)
+        log = []
+
+        async def dead():
+            raise RuntimeError("victim is dead")
+
+        async def sub_exec():
+            log.append("sub")
+            return "ok"
+
+        sched.register(g, 0, sub_exec)   # healthy first step
+        sched.register(g, 1, dead)       # victim-owned step
+
+        await hv.kill_agent(
+            sid,
+            "did:victim",
+            in_flight_steps=[{"step_id": "s1", "saga_id": "saga:fk"}],
+            scheduler=sched,
+            step_index={("saga:fk", "s1"): (g, 1)},
+            substitute_executors={"did:sub": sub_exec},
+        )
+        await sched.run_until_settled()
+        assert (
+            int(np.asarray(hv.state.sagas.saga_state)[g])
+            == saga_ops.SAGA_COMPLETED
+        )
+        assert "sub" in log

@@ -181,7 +181,7 @@ class TestHTTPTransport:
         # The reference's 21 endpoints plus /api/v1/device/stats (the
         # device-plane occupancy view the reference has no analog for),
         # the two quarantine views, and the per-membership agent view.
-        assert len(ROUTES) == 27
+        assert len(ROUTES) == 28
         assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
         assert any(
             path == "/api/v1/security/quarantines" for _, path, _, _ in ROUTES
@@ -344,3 +344,32 @@ async def test_agent_memberships_lists_per_session_rows(svc):
     # Unknown agent: empty memberships, not an error.
     empty = await svc.agent_memberships("did:ghost")
     assert empty.memberships == []
+
+
+async def test_kill_endpoint_hands_off_and_removes(svc):
+    a = await svc.create_session(
+        M.CreateSessionRequest(creator_did="did:lead", min_sigma_eff=0.0)
+    )
+    await svc.join_session(
+        a.session_id, M.JoinSessionRequest(agent_did="did:v", sigma_raw=0.8)
+    )
+    await svc.join_session(
+        a.session_id, M.JoinSessionRequest(agent_did="did:s", sigma_raw=0.9)
+    )
+    svc.hv.kill_switch.register_substitute(a.session_id, "did:s")
+
+    out = await svc.kill_agent(
+        a.session_id,
+        M.KillAgentRequest(agent_did="did:v", reason="ring_breach"),
+    )
+    assert out.reason == "ring_breach"
+    assert not out.compensation_triggered
+    assert svc.hv.state.agent_row(
+        "did:v", svc.hv.get_session(a.session_id).slot
+    ) is None
+
+    with pytest.raises(ApiError) as exc:
+        await svc.kill_agent(
+            a.session_id, M.KillAgentRequest(agent_did="did:v", reason="bogus")
+        )
+    assert exc.value.status == 422

@@ -570,6 +570,13 @@ class Hypervisor:
             raise SessionParticipantError(
                 f"Agent {agent_did} already left session"
             )
+        # Mirror leave_session's device-plane guard too: a missing row
+        # would make the leave below raise AFTER the kill was logged.
+        if self.state.agent_row(agent_did, managed.slot) is None:
+            raise RuntimeError(
+                f"{agent_did} has no live device row in {session_id} — "
+                "plane divergence"
+            )
         result = self.kill_switch.kill(
             agent_did,
             session_id,
@@ -752,6 +759,36 @@ class Hypervisor:
             observed_embedding=observed_embedding,
             action_id=action_id,
         )
+
+        if result.should_demote and not result.should_slash:
+            # MEDIUM drift: demote one ring on both planes (the drift
+            # ladder the reference's adapter defines, `cmvk_adapter.py:
+            # 67-73`, which its core never wires — its scenario tests
+            # demote by hand). Demotion also retires any live elevation
+            # (update_agent_ring's supersede rule).
+            managed = self._require(session_id)
+            participant = managed.sso.get_participant(agent_did)
+            demoted = ExecutionRing(min(participant.ring.value + 1, 3))
+            if demoted.value != participant.ring.value:
+                await self.update_agent_ring(
+                    session_id,
+                    agent_did,
+                    demoted,
+                    reason=f"CMVK drift {result.drift_score:.3f} (medium)",
+                )
+            else:
+                # Already at the floor ring: there is no ring left to
+                # take, but a drifting agent must not keep sudo — retire
+                # any live grant directly (update_agent_ring's supersede
+                # rule would have done it on a real demotion).
+                held = self.elevation.get_active_elevation(
+                    agent_did, session_id
+                )
+                if held is not None:
+                    self.elevation.revoke_elevation(held.elevation_id)
+                    dev_row = self._elev_row_of.pop(held.elevation_id, None)
+                    if dev_row is not None:
+                        self._revoke_device_grant(held, dev_row)
 
         if result.should_slash:
             managed = self._require(session_id)

@@ -106,8 +106,17 @@ class KillSwitch:
         """Kill with handoff: substitute per step, else route to compensation.
 
         The victim leaves the substitute pool before rehoming starts, so
-        it can never be chosen as its own substitute.
+        it can never be chosen as its own substitute. Step descriptors
+        validate BEFORE any pool mutation: a malformed entry must not
+        leave the pool rotated (or the victim unregistered) for a kill
+        that then fails.
         """
+        for info in in_flight_steps or ():
+            if not isinstance(info, dict):
+                raise TypeError(
+                    f"in_flight_steps entries must be dicts "
+                    f"({{'step_id', 'saga_id'}}), got {type(info).__name__}"
+                )
         self.unregister_substitute(session_id, agent_did)
         handoffs = [
             self._rehome(info, agent_did, session_id)

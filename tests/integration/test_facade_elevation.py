@@ -262,3 +262,22 @@ class TestElevationLifecycleScrub:
         )
         assert dev_row is not None
         assert hv.sweep_elevations() == 2  # one facade + one device-only
+
+    async def test_floor_ring_drift_still_retires_sudo(self):
+        # A Ring-3 agent with a live grant drifts MEDIUM: no ring left
+        # to take, but the sudo grant must still die on both planes.
+        from hypervisor_tpu.integrations.cmvk_adapter import CMVKAdapter
+        from tests.integration.test_stateful_coherence import _InjectableDrift
+
+        hv = Hypervisor(cmvk=CMVKAdapter(verifier=_InjectableDrift()))
+        ms = await _session_with(hv, ("did:low", 0.4))  # Ring 3
+        sid = ms.sso.session_id
+        await hv.grant_elevation(sid, "did:low", ExecutionRing.RING_1_PRIVILEGED)
+        result = await hv.verify_behavior(
+            sid, "did:low", claimed_embedding=0.35, observed_embedding=0.0
+        )
+        assert result.should_demote
+        assert hv.elevation.get_active_elevation("did:low", sid) is None
+        row = hv.state.agent_row("did:low", ms.slot)
+        eff = hv.state.effective_rings(hv.state.now())
+        assert eff[row["slot"]] == 3, "drifting floor-ring agent kept sudo"

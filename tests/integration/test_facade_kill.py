@@ -179,3 +179,20 @@ class TestFacadeKill:
             == saga_ops.SAGA_COMPLETED
         )
         assert "sub" in log
+
+    async def test_malformed_steps_leave_pool_untouched(self):
+        import pytest
+
+        hv = Hypervisor()
+        ms = await _session_with(hv, ("did:v", 0.8), ("did:s", 0.9))
+        sid = ms.sso.session_id
+        hv.kill_switch.register_substitute(sid, "did:s")
+        with pytest.raises(TypeError):
+            await hv.kill_agent(
+                sid, "did:v",
+                in_flight_steps=[{"step_id": "ok", "saga_id": "g"}, "oops"],
+            )
+        # Neither the pool nor the kill log mutated; the victim is alive.
+        assert hv.kill_switch.substitutes(sid) == ["did:s"]
+        assert hv.kill_switch.total_kills == 0
+        assert ms.sso.get_participant("did:v").is_active

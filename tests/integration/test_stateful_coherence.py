@@ -210,6 +210,21 @@ class PlaneCoherence(RuleBasedStateMachine):
 
     @precondition(lambda self: any(self.joined.values()))
     @rule(pick=st.integers(0, 3))
+    def drift_demote(self, pick):
+        """MEDIUM drift: one-ring demotion on both planes, no slash."""
+        sids = [s for s in self.sessions if self.joined[s]]
+        if not sids:
+            return
+        sid = sids[pick % len(sids)]
+        agent = sorted(self.joined[sid])[0]
+        self.go(
+            self.hv.verify_behavior(
+                sid, agent, claimed_embedding=0.35, observed_embedding=0.0
+            )
+        )
+
+    @precondition(lambda self: any(self.joined.values()))
+    @rule(pick=st.integers(0, 3))
     def elevate(self, pick):
         """Facade elevation: one grant, both planes."""
         from hypervisor_tpu.models import ExecutionRing
@@ -525,5 +540,70 @@ class TestCrossSessionQuarantineRegression:
             assert int(np.asarray(hv.state.vouches.vouchee)[edge4]) == a_y
             # The X bond (still on Z fallback) is untouched and active.
             assert bool(np.asarray(hv.state.vouches.active)[edge2])
+
+        asyncio.run(run())
+
+
+class TestDriftDemotionLadder:
+    """MEDIUM drift demotes one ring on both planes (the adapter's
+    should_demote rung, which the reference defines but never wires —
+    its scenario tests demote by hand)."""
+
+    def test_medium_drift_demotes_both_planes(self):
+        from hypervisor_tpu.integrations.cmvk_adapter import CMVKAdapter
+
+        async def run():
+            hv = Hypervisor(cmvk=CMVKAdapter(verifier=_InjectableDrift()))
+            ms = await hv.create_session(
+                SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+            )
+            sid = ms.sso.session_id
+            await hv.join_session(sid, "did:m", sigma_raw=0.8)  # Ring 2
+            result = await hv.verify_behavior(
+                sid, "did:m", claimed_embedding=0.35, observed_embedding=0.0
+            )
+            assert result.should_demote and not result.should_slash
+            assert ms.sso.get_participant("did:m").ring.value == 3
+            row = hv.state.agent_row("did:m", ms.slot)
+            assert row["ring"] == 3
+            # No slash: sigma untouched, no quarantine, no blacklist.
+            from hypervisor_tpu.tables.state import FLAG_BLACKLISTED
+
+            assert row["sigma_eff"] == pytest.approx(0.8)
+            flags = np.asarray(hv.state.agents.flags)
+            assert not flags[row["slot"]] & FLAG_BLACKLISTED
+            assert not hv.state.quarantined_mask()[row["slot"]]
+
+            # Already-sandboxed agents stay at Ring 3 (no-op, no event).
+            result2 = await hv.verify_behavior(
+                sid, "did:m", claimed_embedding=0.35, observed_embedding=0.0
+            )
+            assert result2.should_demote
+            assert ms.sso.get_participant("did:m").ring.value == 3
+
+        asyncio.run(run())
+
+    def test_medium_drift_retires_live_elevation(self):
+        from hypervisor_tpu.integrations.cmvk_adapter import CMVKAdapter
+        from hypervisor_tpu.models import ExecutionRing
+
+        async def run():
+            hv = Hypervisor(cmvk=CMVKAdapter(verifier=_InjectableDrift()))
+            ms = await hv.create_session(
+                SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+            )
+            sid = ms.sso.session_id
+            await hv.join_session(sid, "did:m", sigma_raw=0.8)
+            await hv.grant_elevation(
+                sid, "did:m", ExecutionRing.RING_1_PRIVILEGED
+            )
+            await hv.verify_behavior(
+                sid, "did:m", claimed_embedding=0.35, observed_embedding=0.0
+            )
+            # The demotion superseded the sudo grant on both planes.
+            assert hv.elevation.get_active_elevation("did:m", sid) is None
+            row = hv.state.agent_row("did:m", ms.slot)
+            eff = hv.state.effective_rings(hv.state.now())
+            assert eff[row["slot"]] == 3
 
         asyncio.run(run())

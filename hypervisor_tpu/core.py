@@ -30,6 +30,7 @@ from typing import Any, Optional
 from hypervisor_tpu.audit import CommitmentEngine, DeltaEngine, EphemeralGC
 from hypervisor_tpu.audit.gc import RetentionPolicy
 from hypervisor_tpu.liability import SlashingEngine, VouchingEngine
+from hypervisor_tpu.liability.ledger import LedgerEntryType, LiabilityLedger
 from hypervisor_tpu.liability.quarantine import QuarantineManager, QuarantineReason
 from hypervisor_tpu.models import (
     ActionDescriptor,
@@ -146,6 +147,18 @@ class Hypervisor:
             on_release=self._mirror_release,
         )
         self.slashing = SlashingEngine(self.vouching)
+        # Persistent cross-session risk accounting, facade-wired as an
+        # ADMISSION GATE (the reference exports the ledger but never
+        # consults it): slashes/quarantines recorded by verify_behavior
+        # charge risk, clean terminations credit it, and join_session
+        # applies the recommendation — deny refuses, probation sandboxes
+        # (`liability/ledger.py` thresholds 0.3/0.6).
+        self.ledger = LiabilityLedger()
+        # DIDs penalized per LIVE session (rogues, cascade-clipped
+        # vouchers, quarantined agents): consulted at terminate so a
+        # penalized participant never also earns the clean-session
+        # credit; O(session), dropped at terminate.
+        self._penalized_in: dict[str, set[str]] = {}
         self.ring_enforcer = RingEnforcer()
         self.classifier = ActionClassifier()
         self.verifier = TransactionHistoryVerifier()
@@ -240,6 +253,19 @@ class Hypervisor:
 
         verification = self.verifier.verify(agent_did)
 
+        # Liability-ledger gate: persistent risk follows the DID across
+        # sessions. Deny refuses outright; probation joins sandboxed.
+        admit_ok, recommendation = self.ledger.should_admit(agent_did)
+        if not admit_ok:
+            from hypervisor_tpu.session import SessionParticipantError
+
+            profile = self.ledger.compute_risk_profile(agent_did)
+            raise SessionParticipantError(
+                f"Agent {agent_did} denied by liability ledger "
+                f"(risk {profile.risk_score:.2f} >= "
+                f"{self.ledger.DENY_THRESHOLD})"
+            )
+
         sigma_eff = sigma_raw
         if self.nexus and sigma_raw == 0.0:
             sigma_eff = self.nexus.resolve_sigma(agent_did, history=agent_history)
@@ -251,7 +277,7 @@ class Hypervisor:
             )
 
         ring = self.ring_enforcer.compute_ring(sigma_eff)
-        if not verification.is_trustworthy:
+        if not verification.is_trustworthy or recommendation == "probation":
             ring = ExecutionRing.RING_3_SANDBOX
 
         # The jitted admission wave is authoritative: it applies the same
@@ -277,7 +303,11 @@ class Hypervisor:
             managed.slot,
             agent_did,
             sigma_eff,
-            trustworthy=verification.is_trustworthy,
+            # Ledger probation sandboxes on the device plane through the
+            # same untrustworthy path, so host and device rings agree.
+            trustworthy=(
+                verification.is_trustworthy and recommendation != "probation"
+            ),
         )
         if queued < 0:
             raise RuntimeError("admission staging queue full; flush pending joins")
@@ -303,6 +333,13 @@ class Hypervisor:
         managed.sso.join(
             agent_did=agent_did, sigma_raw=sigma_raw, sigma_eff=sigma_eff, ring=ring
         )
+        # The membership row carries the agent's ledger risk (the
+        # risk_score column admission resets to 0).
+        risk = self.ledger.compute_risk_profile(agent_did).risk_score
+        if risk > 0.0:
+            row = self.state.agent_row(agent_did, managed.slot)
+            if row is not None:
+                self.state.set_agent_risk(row["slot"], risk)
         # Bonds recorded before this agent was device-resident gain their
         # VouchTable edges now that it has a row.
         self._backfill_vouch_mirror(agent_did)
@@ -417,10 +454,7 @@ class Hypervisor:
             new_ring.value <= held.elevated_ring.value
             or new_ring.value > before.value
         ):
-            self.elevation.revoke_elevation(held.elevation_id)
-            dev_row = self._elev_row_of.pop(held.elevation_id, None)
-            if dev_row is not None:
-                self._revoke_device_grant(held, dev_row)
+            self._retire_grant(held)
         if new_ring.value != before.value:
             self._emit(
                 EventType.RING_DEMOTED
@@ -499,6 +533,26 @@ class Hypervisor:
         # host-side); detach those mirror entries and re-attach wherever
         # the endpoints are still resident.
         self._detach_and_remirror(self.state.pop_scrubbed_edges())
+
+        # Clean terminations credit the ledger: active participants who
+        # were not penalized in THIS session (slashed as rogue, clipped
+        # as a cascade voucher, or quarantined) earn the clean-session
+        # credit (risk decays toward admission).
+        penalized = self._penalized_in.pop(session_id, set())
+        for p in managed.sso.participants:
+            if (
+                p.is_active
+                and p.agent_did not in penalized
+                and self.quarantine.get_active_quarantine(
+                    p.agent_did, session_id
+                )
+                is None
+            ):
+                self.ledger.record(
+                    p.agent_did,
+                    LedgerEntryType.CLEAN_SESSION,
+                    session_id=session_id,
+                )
 
         # The session's elevations die with it on both planes (device
         # rows were scrubbed with the participant reclaim); mapping
@@ -694,13 +748,24 @@ class Hypervisor:
         except ValueError:
             pass  # recycled to another agent's grant — leave it alone
 
+    def _retire_grant(self, grant) -> None:
+        """THE both-plane grant-retirement sequence, in one place: host
+        revoke + mapping pop + guarded device-row revoke. Used by the
+        explicit revoke path, ring-update supersession, and the drift
+        ladder's floor-ring case."""
+        self.elevation.revoke_elevation(grant.elevation_id)
+        dev_row = self._elev_row_of.pop(grant.elevation_id, None)
+        if dev_row is not None:
+            self._revoke_device_grant(grant, dev_row)
+
     async def revoke_elevation(self, elevation_id: str) -> None:
         """Revoke a grant before expiry on BOTH planes."""
         grant = self.elevation.get(elevation_id)
-        self.elevation.revoke_elevation(elevation_id)
-        dev_row = self._elev_row_of.pop(elevation_id, None)
-        if dev_row is not None and grant is not None:
-            self._revoke_device_grant(grant, dev_row)
+        if grant is None:
+            # Preserve the manager's not-found error.
+            self.elevation.revoke_elevation(elevation_id)
+            return
+        self._retire_grant(grant)
 
     def sweep_elevations(self) -> int:
         """Expire lapsed grants on BOTH planes; returns how many GRANTS
@@ -785,10 +850,7 @@ class Hypervisor:
                     agent_did, session_id
                 )
                 if held is not None:
-                    self.elevation.revoke_elevation(held.elevation_id)
-                    dev_row = self._elev_row_of.pop(held.elevation_id, None)
-                    if dev_row is not None:
-                        self._revoke_device_grant(held, dev_row)
+                    self._retire_grant(held)
 
         if result.should_slash:
             managed = self._require(session_id)
@@ -853,7 +915,7 @@ class Hypervisor:
                     "severity": result.severity.value,
                 },
             )
-            self.slashing.slash(
+            slash_result = self.slashing.slash(
                 vouchee_did=agent_did,
                 session_id=session_id,
                 vouchee_sigma=vouchee_sigma_before,
@@ -861,6 +923,33 @@ class Hypervisor:
                 reason=f"CMVK drift: {result.drift_score:.3f} ({result.severity.value})",
                 agent_scores=agent_scores,
             )
+            # Persistent risk accounting (facade-wired ledger): the
+            # rogue is charged for the slash AND the quarantine; every
+            # clipped voucher is charged the cascade. All of them are
+            # marked penalized so terminate's clean-session credit
+            # skips them.
+            penalized = self._penalized_in.setdefault(session_id, set())
+            penalized.add(agent_did)
+            self.ledger.record(
+                agent_did,
+                LedgerEntryType.SLASH_RECEIVED,
+                session_id=session_id,
+                severity=result.drift_score,
+            )
+            self.ledger.record(
+                agent_did,
+                LedgerEntryType.QUARANTINE_ENTERED,
+                session_id=session_id,
+                severity=result.drift_score,
+            )
+            for clip in slash_result.voucher_clips:
+                penalized.add(clip.voucher_did)
+                self.ledger.record(
+                    clip.voucher_did,
+                    LedgerEntryType.SLASH_CASCADED,
+                    session_id=session_id,
+                    severity=0.5,
+                )
             self._emit(
                 EventType.SLASH_EXECUTED,
                 session_id=session_id,

@@ -1136,6 +1136,14 @@ class HypervisorState:
         """bool[N]: rows currently in read-only isolation."""
         return (np.asarray(self.agents.flags) & FLAG_QUARANTINED) != 0
 
+    def set_agent_risk(self, slot: int, risk: float) -> None:
+        """Write a membership row's liability-ledger risk score (the
+        facade stamps it at join; admission resets the column to 0)."""
+        self.agents = replace(
+            self.agents,
+            risk_score=self.agents.risk_score.at[slot].set(float(risk)),
+        )
+
     def set_agent_ring(self, slot: int, ring: int, now: float) -> None:
         """Reassign a device row's ring (demotion/promotion).
 

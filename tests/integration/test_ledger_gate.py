@@ -1,0 +1,141 @@
+"""Facade-wired liability ledger: persistent risk gates admission.
+
+The reference exports the LiabilityLedger but never consults it
+(`SURVEY §1 "exported but not wired"`); here verify_behavior slashes
+charge the ledger (rogue + cascaded vouchers + quarantine), clean
+terminations credit it, and join_session applies the recommendation —
+deny refuses, probation joins sandboxed at Ring 3 on BOTH planes, and
+the membership row carries the risk score.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu import Hypervisor, SessionConfig
+from hypervisor_tpu.integrations.cmvk_adapter import CMVKAdapter
+from hypervisor_tpu.session import SessionParticipantError
+from tests.integration.test_stateful_coherence import _InjectableDrift
+
+
+def _hv():
+    return Hypervisor(cmvk=CMVKAdapter(verifier=_InjectableDrift()))
+
+
+async def _slash_in_fresh_session(hv, did, drift=0.95):
+    ms = await hv.create_session(
+        SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+    )
+    await hv.join_session(ms.sso.session_id, did, sigma_raw=0.8)
+    await hv.verify_behavior(
+        ms.sso.session_id, did, claimed_embedding=drift, observed_embedding=0.0
+    )
+    return ms
+
+
+class TestLedgerGate:
+    async def test_slash_charges_and_probation_sandboxes(self):
+        hv = _hv()
+        # One slash charges ~0.24 (slash 0.15x0.95 + quarantine
+        # 0.10x0.95) — still "admit" per the reference thresholds; a
+        # second pushes past the 0.3 probation line.
+        await _slash_in_fresh_session(hv, "did:r")
+        assert hv.ledger.compute_risk_profile("did:r").recommendation == "admit"
+        await _slash_in_fresh_session(hv, "did:r")
+        profile = hv.ledger.compute_risk_profile("did:r")
+        assert profile.recommendation == "probation"
+
+        ms2 = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        ring = await hv.join_session(ms2.sso.session_id, "did:r", sigma_raw=0.9)
+        assert ring.value == 3, "probation must sandbox"
+        row = hv.state.agent_row("did:r", ms2.slot)
+        assert row["ring"] == 3
+        # The membership row carries the ledger risk.
+        risk_col = np.asarray(hv.state.agents.risk_score)
+        assert risk_col[row["slot"]] == pytest.approx(
+            profile.risk_score, rel=1e-5
+        )
+
+    async def test_repeat_offender_denied(self):
+        hv = _hv()
+        for _ in range(3):
+            await _slash_in_fresh_session(hv, "did:rogue")
+        assert hv.ledger.compute_risk_profile("did:rogue").recommendation == "deny"
+        ms = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        with pytest.raises(SessionParticipantError, match="liability ledger"):
+            await hv.join_session(ms.sso.session_id, "did:rogue", sigma_raw=0.9)
+        # Refusal leaves no trace on either plane.
+        assert hv.state.agent_row("did:rogue", ms.slot) is None
+        assert (
+            int(np.asarray(hv.state.sessions.n_participants)[ms.slot]) == 0
+        )
+
+    async def test_cascaded_vouchers_charged(self):
+        hv = _hv()
+        ms = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        sid = ms.sso.session_id
+        await hv.join_session(sid, "did:rogue", sigma_raw=0.6)
+        await hv.join_session(sid, "did:backer", sigma_raw=0.9)
+        hv.vouching.vouch("did:backer", "did:rogue", sid, voucher_sigma=0.9)
+        await hv.verify_behavior(
+            sid, "did:rogue", claimed_embedding=0.95, observed_embedding=0.0
+        )
+        backer = hv.ledger.compute_risk_profile("did:backer")
+        assert backer.risk_score > 0.0, "clipped voucher must be charged"
+
+    async def test_clean_sessions_credit_risk_down(self):
+        hv = _hv()
+        await _slash_in_fresh_session(hv, "did:redeemed")
+        risk_after_slash = hv.ledger.compute_risk_profile(
+            "did:redeemed"
+        ).risk_score
+        # Serve several clean sessions (probation: sandboxed but admitted).
+        for i in range(4):
+            ms = await hv.create_session(
+                SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+            )
+            await hv.join_session(
+                ms.sso.session_id, "did:redeemed", sigma_raw=0.8
+            )
+            await hv.activate_session(ms.sso.session_id)
+            await hv.terminate_session(ms.sso.session_id)
+        profile = hv.ledger.compute_risk_profile("did:redeemed")
+        assert profile.risk_score < risk_after_slash
+
+    async def test_cascaded_voucher_earns_no_clean_credit(self):
+        # Reviewer-found: the clipped backer must NOT also collect the
+        # clean-session credit for the session that penalized it.
+        hv = _hv()
+        ms = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        sid = ms.sso.session_id
+        await hv.join_session(sid, "did:rogue", sigma_raw=0.6)
+        await hv.join_session(sid, "did:backer", sigma_raw=0.9)
+        hv.vouching.vouch("did:backer", "did:rogue", sid, voucher_sigma=0.9)
+        await hv.activate_session(sid)
+        await hv.verify_behavior(
+            sid, "did:rogue", claimed_embedding=0.95, observed_embedding=0.0
+        )
+        risk_before_term = hv.ledger.compute_risk_profile(
+            "did:backer"
+        ).risk_score
+        await hv.terminate_session(sid)
+        after = hv.ledger.compute_risk_profile("did:backer")
+        assert after.risk_score == pytest.approx(risk_before_term), (
+            "penalized backer collected a clean-session credit"
+        )
+        kinds = [
+            e.entry_type.value
+            for e in hv.ledger.get_agent_history("did:backer")
+        ]
+        assert "clean_session" not in kinds
+        # ...and the session's penalty index does not leak.
+        assert sid not in hv._penalized_in

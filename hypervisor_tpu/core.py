@@ -154,6 +154,11 @@ class Hypervisor:
         # applies the recommendation — deny refuses, probation sandboxes
         # (`liability/ledger.py` thresholds 0.3/0.6).
         self.ledger = LiabilityLedger()
+        # Shapley-style fault attribution feeding the ledger
+        # (attribute_fault).
+        from hypervisor_tpu.liability.attribution import CausalAttributor
+
+        self.attributor = CausalAttributor()
         # DIDs penalized per LIVE session (rogues, cascade-clipped
         # vouchers, quarantined agents): consulted at terminate so a
         # penalized participant never also earns the clean-session
@@ -229,6 +234,21 @@ class Hypervisor:
         """
         managed = self._require(session_id)
 
+        # Liability-ledger gate FIRST: a denied agent must not mutate
+        # the session on its way out (manifest registration would force
+        # STRONG consistency with no un-force path). Deny refuses;
+        # probation joins sandboxed.
+        admit_ok, recommendation = self.ledger.should_admit(agent_did)
+        if not admit_ok:
+            from hypervisor_tpu.session import SessionParticipantError
+
+            profile = self.ledger.compute_risk_profile(agent_did)
+            raise SessionParticipantError(
+                f"Agent {agent_did} denied by liability ledger "
+                f"(risk {profile.risk_score:.2f} >= "
+                f"{self.ledger.DENY_THRESHOLD})"
+            )
+
         if self.iatp and manifest:
             if isinstance(manifest, dict):
                 analysis = self.iatp.analyze_manifest_dict(manifest)
@@ -252,19 +272,6 @@ class Hypervisor:
             self.state.force_session_mode(managed.slot, ConsistencyMode.STRONG)
 
         verification = self.verifier.verify(agent_did)
-
-        # Liability-ledger gate: persistent risk follows the DID across
-        # sessions. Deny refuses outright; probation joins sandboxed.
-        admit_ok, recommendation = self.ledger.should_admit(agent_did)
-        if not admit_ok:
-            from hypervisor_tpu.session import SessionParticipantError
-
-            profile = self.ledger.compute_risk_profile(agent_did)
-            raise SessionParticipantError(
-                f"Agent {agent_did} denied by liability ledger "
-                f"(risk {profile.risk_score:.2f} >= "
-                f"{self.ledger.DENY_THRESHOLD})"
-            )
 
         sigma_eff = sigma_raw
         if self.nexus and sigma_raw == 0.0:
@@ -577,6 +584,72 @@ class Hypervisor:
             payload={"merkle_root": merkle_root},
         )
         return merkle_root
+
+    # ── causal fault attribution -> ledger ───────────────────────────
+
+    def attribute_fault(
+        self,
+        saga_id: str,
+        session_id: str,
+        agent_actions: dict,
+        failure_step_id: str,
+        failure_agent_did: str,
+        risk_weights: Optional[dict] = None,
+    ):
+        """Run Shapley-style fault attribution for a failed saga and
+        charge every involved agent's ledger share.
+
+        The reference exports CausalAttributor but never wires it
+        (`liability/attribution.py:66-207`); here each agent's
+        liability share lands as a FAULT_ATTRIBUTED ledger charge
+        (severity = its normalized share), feeding the same persistent
+        risk the admission gate consults — and, for a LIVE session,
+        attributed agents are marked penalized so the session's
+        clean-credit skips them (post-mortem attribution of an already
+        archived session charges the ledger only — its clean credits
+        were settled at terminate). Returns the AttributionResult.
+        """
+        managed = self._require(session_id)  # unknown sessions refuse
+        result = self.attributor.attribute(
+            saga_id=saga_id,
+            session_id=session_id,
+            agent_actions=agent_actions,
+            failure_step_id=failure_step_id,
+            failure_agent_did=failure_agent_did,
+            risk_weights=risk_weights,
+        )
+        session_live = managed.sso.state.value not in (
+            "archived", "terminating"
+        )
+        for fault in result.attributions:
+            if fault.liability_score <= 0.0:
+                continue
+            if session_live:
+                # Never re-create a penalty set for a dead session key
+                # (terminate already popped it — the entry would leak).
+                self._penalized_in.setdefault(session_id, set()).add(
+                    fault.agent_did
+                )
+            self.ledger.record(
+                fault.agent_did,
+                LedgerEntryType.FAULT_ATTRIBUTED,
+                session_id=session_id,
+                severity=fault.liability_score,
+                details=f"saga {saga_id} step {failure_step_id}",
+            )
+        self._emit(
+            EventType.FAULT_ATTRIBUTED,
+            session_id=session_id,
+            agent_did=failure_agent_did,
+            payload={
+                "saga_id": saga_id,
+                "shares": {
+                    f.agent_did: round(f.liability_score, 4)
+                    for f in result.attributions
+                },
+            },
+        )
+        return result
 
     # ── kill switch (graceful termination, both planes) ──────────────
 
@@ -930,6 +1003,19 @@ class Hypervisor:
             # skips them.
             penalized = self._penalized_in.setdefault(session_id, set())
             penalized.add(agent_did)
+            # The slash is AGENT-GLOBAL (every row blacklists), so the
+            # penalty is too: the rogue forfeits the clean credit in
+            # EVERY session it is currently live in — otherwise its
+            # other sessions' credits would offset the slash charge and
+            # defeat the admission gate.
+            for other_sid, other in self._sessions.items():
+                if other_sid == session_id:
+                    continue
+                p = other.sso._participants.get(agent_did)
+                if p is not None and p.is_active:
+                    self._penalized_in.setdefault(other_sid, set()).add(
+                        agent_did
+                    )
             self.ledger.record(
                 agent_did,
                 LedgerEntryType.SLASH_RECEIVED,

@@ -139,3 +139,141 @@ class TestLedgerGate:
         assert "clean_session" not in kinds
         # ...and the session's penalty index does not leak.
         assert sid not in hv._penalized_in
+
+
+class TestAttributionWiring:
+    async def test_attribution_charges_ledger_shares(self):
+        from hypervisor_tpu import EventType, HypervisorEventBus
+
+        bus = HypervisorEventBus()
+        hv = Hypervisor(event_bus=bus)
+        ms = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        sid = ms.sso.session_id
+        for did in ("did:root", "did:enabler"):
+            await hv.join_session(sid, did, sigma_raw=0.8)
+        await hv.activate_session(sid)
+
+        result = hv.attribute_fault(
+            saga_id="saga:f",
+            session_id=sid,
+            agent_actions={
+                "did:root": [{"action_id": "a1", "step_id": "s2",
+                              "success": False}],
+                "did:enabler": [{"action_id": "a0", "step_id": "s1",
+                                 "success": True,
+                                 "dependencies": []}],
+            },
+            failure_step_id="s2",
+            failure_agent_did="did:root",
+        )
+        shares = {f.agent_did: f.liability_score for f in result.attributions}
+        assert sum(shares.values()) == pytest.approx(1.0)
+        assert shares["did:root"] > shares.get("did:enabler", 0.0)
+        # Ledger charged proportionally; both marked penalized.
+        assert hv.ledger.compute_risk_profile("did:root").risk_score > 0
+        kinds = [
+            e.entry_type.value for e in hv.ledger.get_agent_history("did:root")
+        ]
+        assert "fault_attributed" in kinds
+        ev = bus.query(event_type=EventType.FAULT_ATTRIBUTED)
+        assert len(ev) == 1 and "did:root" in ev[0].payload["shares"]
+
+        # Clean-credit skips the attributed agents at terminate.
+        await hv.terminate_session(sid)
+        kinds = [
+            e.entry_type.value for e in hv.ledger.get_agent_history("did:root")
+        ]
+        assert "clean_session" not in kinds
+
+    async def test_global_slash_forfeits_clean_credit_everywhere(self):
+        # Reviewer-found: a rogue slashed in A is blacklisted in B too
+        # (agent-global); B's termination must NOT hand it a clean
+        # credit that offsets the slash charge.
+        hv = _hv()
+        a = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        b = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        await hv.join_session(a.sso.session_id, "did:r", sigma_raw=0.8)
+        await hv.join_session(b.sso.session_id, "did:r", sigma_raw=0.8)
+        await hv.activate_session(b.sso.session_id)
+        await hv.verify_behavior(
+            a.sso.session_id, "did:r",
+            claimed_embedding=0.95, observed_embedding=0.0,
+        )
+        risk = hv.ledger.compute_risk_profile("did:r").risk_score
+        await hv.terminate_session(b.sso.session_id)
+        assert hv.ledger.compute_risk_profile("did:r").risk_score == (
+            pytest.approx(risk)
+        ), "other-session clean credit offset the slash"
+
+    async def test_denied_join_does_not_mutate_session(self):
+        # Reviewer-found: the deny gate must fire BEFORE manifest
+        # processing — a refused rogue's non-reversible manifest must
+        # not force the session into STRONG or register actions.
+        from hypervisor_tpu.models import (
+            ActionDescriptor,
+            ConsistencyMode,
+            ReversibilityLevel,
+        )
+
+        hv = _hv()
+        for _ in range(3):
+            await _slash_in_fresh_session(hv, "did:rogue")
+        ms = await hv.create_session(
+            SessionConfig(
+                consistency_mode=ConsistencyMode.EVENTUAL, min_sigma_eff=0.0
+            ),
+            creator_did="did:lead",
+        )
+        with pytest.raises(SessionParticipantError, match="liability ledger"):
+            await hv.join_session(
+                ms.sso.session_id,
+                "did:rogue",
+                sigma_raw=0.9,
+                actions=[
+                    ActionDescriptor(
+                        action_id="nuke",
+                        name="nuke",
+                        execute_api="/x",
+                        undo_api=None,
+                        reversibility=ReversibilityLevel.NONE,
+                    )
+                ],
+            )
+        assert ms.sso.config.consistency_mode is ConsistencyMode.EVENTUAL
+        assert not ms.reversibility.has_non_reversible_actions()
+        modes = np.asarray(hv.state.sessions.mode)
+        assert modes[ms.slot] == ConsistencyMode.EVENTUAL.code
+
+    async def test_post_mortem_attribution_charges_without_leak(self):
+        hv = _hv()
+        ms = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        sid = ms.sso.session_id
+        await hv.join_session(sid, "did:x", sigma_raw=0.8)
+        await hv.activate_session(sid)
+        await hv.terminate_session(sid)
+        hv.attribute_fault(
+            saga_id="saga:pm",
+            session_id=sid,
+            agent_actions={"did:x": [{"step_id": "s1", "success": False}]},
+            failure_step_id="s1",
+            failure_agent_did="did:x",
+        )
+        kinds = [
+            e.entry_type.value for e in hv.ledger.get_agent_history("did:x")
+        ]
+        assert "fault_attributed" in kinds  # charge landed post-mortem
+        assert sid not in hv._penalized_in  # no dead-key leak
+        with pytest.raises(ValueError):
+            hv.attribute_fault(
+                saga_id="s", session_id="session:ghost",
+                agent_actions={}, failure_step_id="s",
+                failure_agent_did="did:x",
+            )

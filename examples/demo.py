@@ -401,6 +401,86 @@ def demo_security_plane() -> None:
     )
 
 
+async def demo_governance_loop() -> None:
+    """Round-3 feedback loop: drift ladder -> ledger -> admission gate,
+    elevation and kill-switch facade wiring across both planes."""
+    banner("9. Governance loop: drift ladder → ledger gate → kill switch")
+    from hypervisor_tpu import EventType, HypervisorEventBus
+    from hypervisor_tpu.integrations.cmvk_adapter import CMVKAdapter
+    from hypervisor_tpu.models import ExecutionRing
+
+    class ScriptedDrift:
+        """Claimed embedding IS the drift score (demo injection)."""
+
+        def verify_embeddings(self, embedding_a, embedding_b, **_):
+            class V:
+                drift_score = float(embedding_a)
+                explanation = None
+
+            return V()
+
+    bus = HypervisorEventBus()
+    hv = Hypervisor(cmvk=CMVKAdapter(verifier=ScriptedDrift()), event_bus=bus)
+    ms = await hv.create_session(
+        SessionConfig(min_sigma_eff=0.0), creator_did="did:mesh:admin"
+    )
+    sid = ms.sso.session_id
+    for did, sigma in (("did:mesh:suspect", 0.8), ("did:mesh:sub", 0.9)):
+        await hv.join_session(sid, did, sigma_raw=sigma)
+    await hv.activate_session(sid)
+
+    # Sudo grant on both planes, then MEDIUM drift: demotion retires it.
+    grant = await hv.grant_elevation(
+        sid, "did:mesh:suspect", ExecutionRing.RING_1_PRIVILEGED,
+        ttl_seconds=120, reason="oncall",
+    )
+    row = hv.state.agent_row("did:mesh:suspect", ms.slot)
+    eff = hv.state.effective_rings(hv.state.now())
+    print(
+        f"elevation: Ring 2 -> sudo Ring {int(eff[row['slot']])} "
+        f"(ttl {grant.remaining_seconds:.0f}s, both planes)"
+    )
+    await hv.verify_behavior(
+        sid, "did:mesh:suspect", claimed_embedding=0.35, observed_embedding=0.0
+    )
+    row = hv.state.agent_row("did:mesh:suspect", ms.slot)
+    print(
+        f"MEDIUM drift 0.35: demoted to Ring {row['ring']} on both planes; "
+        f"sudo grant retired: "
+        f"{hv.elevation.get_active_elevation('did:mesh:suspect', sid) is None}"
+    )
+
+    # HIGH drift: agent-global slash + session-scoped quarantine + ledger.
+    await hv.verify_behavior(
+        sid, "did:mesh:suspect", claimed_embedding=0.95, observed_embedding=0.0
+    )
+    profile = hv.ledger.compute_risk_profile("did:mesh:suspect")
+    print(
+        f"HIGH drift 0.95: slashed (sigma -> 0), quarantined, ledger risk "
+        f"{profile.risk_score:.2f} -> recommendation '{profile.recommendation}'"
+    )
+
+    # Kill switch: graceful removal with substitute handoff.
+    hv.kill_switch.register_substitute(sid, "did:mesh:sub")
+    result = await hv.kill_agent(
+        sid, "did:mesh:suspect",
+        in_flight_steps=[{"step_id": "deploy", "saga_id": "saga:demo"}],
+    )
+    print(
+        f"kill switch: {result.handoff_success_count}/"
+        f"{len(result.handoffs)} steps handed to "
+        f"{result.handoffs[0].to_agent}; membership removed from both planes "
+        f"(device row gone: "
+        f"{hv.state.agent_row('did:mesh:suspect', ms.slot) is None})"
+    )
+    ms.delta_engine.capture("did:mesh:sub", [])  # one audit delta
+    root = await hv.terminate_session(sid)
+    print(
+        f"terminated with audit root {root[:16]}…; "
+        f"{len(bus.query(session_id=sid))} events recorded"
+    )
+
+
 async def main() -> None:
     # Fail fast if the accelerator tunnel is wedged (rc=17 + diagnostic)
     # instead of hanging on the first backend query.
@@ -421,6 +501,7 @@ async def main() -> None:
     demo_batched_pipeline()
     await demo_device_plane()
     demo_security_plane()
+    await demo_governance_loop()
     print("\nAll demos complete.")
 
 

@@ -1011,6 +1011,12 @@ class Hypervisor:
             for other_sid, other in self._sessions.items():
                 if other_sid == session_id:
                     continue
+                # LIVE sessions only: archived ones settled their clean
+                # credits at terminate, and re-creating their popped
+                # penalty keys would leak forever (archive() never
+                # clears participants' is_active).
+                if other.sso.state.value in ("archived", "terminating"):
+                    continue
                 p = other.sso._participants.get(agent_did)
                 if p is not None and p.is_active:
                     self._penalized_in.setdefault(other_sid, set()).add(

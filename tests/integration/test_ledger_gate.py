@@ -277,3 +277,24 @@ class TestAttributionWiring:
                 agent_actions={}, failure_step_id="s",
                 failure_agent_did="did:x",
             )
+
+    async def test_global_slash_skips_archived_sessions(self):
+        # Reviewer-found leak: a slash must not re-create the popped
+        # penalty key of an ARCHIVED session the rogue once sat in.
+        hv = _hv()
+        a = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        b = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        await hv.join_session(a.sso.session_id, "did:r", sigma_raw=0.8)
+        await hv.join_session(b.sso.session_id, "did:r", sigma_raw=0.8)
+        await hv.activate_session(b.sso.session_id)
+        await hv.terminate_session(b.sso.session_id)  # pops B's key
+        await hv.verify_behavior(
+            a.sso.session_id, "did:r",
+            claimed_embedding=0.95, observed_embedding=0.0,
+        )
+        assert b.sso.session_id not in hv._penalized_in
+        assert "did:r" in hv._penalized_in[a.sso.session_id]

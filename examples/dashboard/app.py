@@ -155,14 +155,12 @@ async def simulate(n_sessions: int = 4, agents_per: int = 5, seed: int = 7) -> D
 
     # governance aftermath: ledger entries, quarantine, breach sweep,
     # elevation grants — driving the same engines the reference charts.
-    from hypervisor_tpu import (
-        LedgerEntryType,
-        LiabilityLedger,
-        QuarantineManager,
-        QuarantineReason,
-    )
+    # The ledger is the FACADE's own (round 3 wires it as the admission
+    # gate); charging it here means the risk panel shows exactly what a
+    # future join of these DIDs would be gated on.
+    from hypervisor_tpu import LedgerEntryType, QuarantineManager, QuarantineReason
 
-    ledger = LiabilityLedger()
+    ledger = hv.ledger
     quarantine = QuarantineManager()
     for rogue, clipped in state.slash_events:
         ledger.record(rogue, LedgerEntryType.SLASH_RECEIVED, severity=0.95)

@@ -405,7 +405,7 @@ async def demo_governance_loop() -> None:
     """Round-3 feedback loop: drift ladder -> ledger -> admission gate,
     elevation and kill-switch facade wiring across both planes."""
     banner("9. Governance loop: drift ladder → ledger gate → kill switch")
-    from hypervisor_tpu import EventType, HypervisorEventBus
+    from hypervisor_tpu import HypervisorEventBus
     from hypervisor_tpu.integrations.cmvk_adapter import CMVKAdapter
     from hypervisor_tpu.models import ExecutionRing
 

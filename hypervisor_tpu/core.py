@@ -1001,8 +1001,16 @@ class Hypervisor:
             # clipped voucher is charged the cascade. All of them are
             # marked penalized so terminate's clean-session credit
             # skips them.
-            penalized = self._penalized_in.setdefault(session_id, set())
-            penalized.add(agent_did)
+            # Penalty index entries only for LIVE sessions (same rule as
+            # attribute_fault and the cross-session loop below): a
+            # post-mortem slash of an archived session must not
+            # re-create its popped key — terminate never pops it again.
+            session_live = managed.sso.state.value not in (
+                "archived", "terminating"
+            )
+            if session_live:
+                penalized = self._penalized_in.setdefault(session_id, set())
+                penalized.add(agent_did)
             # The slash is AGENT-GLOBAL (every row blacklists), so the
             # penalty is too: the rogue forfeits the clean credit in
             # EVERY session it is currently live in — otherwise its
@@ -1035,7 +1043,8 @@ class Hypervisor:
                 severity=result.drift_score,
             )
             for clip in slash_result.voucher_clips:
-                penalized.add(clip.voucher_did)
+                if session_live:
+                    penalized.add(clip.voucher_did)
                 self.ledger.record(
                     clip.voucher_did,
                     LedgerEntryType.SLASH_CASCADED,

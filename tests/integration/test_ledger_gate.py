@@ -298,3 +298,20 @@ class TestAttributionWiring:
         )
         assert b.sso.session_id not in hv._penalized_in
         assert "did:r" in hv._penalized_in[a.sso.session_id]
+
+    async def test_post_mortem_slash_leaves_no_penalty_key(self):
+        # Reviewer-found: slashing via a session that ALREADY archived
+        # must charge the ledger but not resurrect the popped key.
+        hv = _hv()
+        ms = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        sid = ms.sso.session_id
+        await hv.join_session(sid, "did:late", sigma_raw=0.8)
+        await hv.activate_session(sid)
+        await hv.terminate_session(sid)
+        await hv.verify_behavior(
+            sid, "did:late", claimed_embedding=0.95, observed_embedding=0.0
+        )
+        assert sid not in hv._penalized_in
+        assert hv.ledger.compute_risk_profile("did:late").risk_score > 0

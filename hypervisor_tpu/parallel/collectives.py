@@ -32,7 +32,6 @@ from hypervisor_tpu.ops import liability as liability_ops
 from hypervisor_tpu.ops import rings as ring_ops
 from hypervisor_tpu.ops.pipeline import PipelineResult, governance_pipeline
 from hypervisor_tpu.parallel.mesh import AGENT_AXIS, DCN_AXIS
-from hypervisor_tpu.tables.state import FLAG_ACTIVE
 from hypervisor_tpu.tables.struct import replace as t_replace
 
 

@@ -1,0 +1,95 @@
+"""Property: mixed STRONG/EVENTUAL ticks converge to the all-STRONG
+result for ARBITRARY mode assignments and lane targets.
+
+`mode_tick` routes each lane's session delta by the session's mode
+column — STRONG in-tick psum, EVENTUAL deferred to reconcile. After the
+reconcile, no interleaving of modes may change the final SessionTable:
+consistency modes trade freshness, never outcomes (SURVEY §5 mapping of
+the reference's ConsistencyMode flag).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+hyp = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st
+
+from hypervisor_tpu.models import ConsistencyMode, SessionConfig
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.parallel import make_mesh
+from hypervisor_tpu.state import HypervisorState
+
+N_DEV = 8
+LANES = 16
+S = 6  # sessions
+T = 2
+
+_mesh = None
+
+
+def mesh():
+    global _mesh
+    if _mesh is None:
+        _mesh = make_mesh(N_DEV, platform="cpu")
+    return _mesh
+
+
+def _run(modes: list[int], lane_sessions: list[int], sigma: list[float]):
+    """One mixed-mode tick + reconcile on a fresh facade; returns the
+    final participant counts."""
+    from hypervisor_tpu import Hypervisor
+
+    hv = Hypervisor(state=HypervisorState())
+    import asyncio
+
+    async def build():
+        slots = []
+        for i in range(S):
+            ms = await hv.create_session(
+                SessionConfig(
+                    consistency_mode=(
+                        ConsistencyMode.STRONG
+                        if modes[i]
+                        else ConsistencyMode.EVENTUAL
+                    ),
+                    min_sigma_eff=0.0,
+                    max_participants=64,
+                ),
+                creator_did="did:lead",
+            )
+            slots.append(ms.slot)
+        return slots
+
+    slots = asyncio.run(build())
+    rt = hv.consistency_runtime(mesh())
+    rng = np.random.RandomState(0)
+    bodies = rng.randint(
+        0, 2**32, size=(T, LANES, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    rt.tick(
+        np.array([slots[s] for s in lane_sessions], np.int32),
+        np.asarray(sigma, np.float32),
+        np.ones(LANES, bool),
+        bodies,
+    )
+    rt.reconcile()
+    return np.asarray(hv.state.sessions.n_participants)[: S + 1].copy()
+
+
+@settings(max_examples=10, deadline=None)
+@given(
+    modes=st.lists(st.integers(0, 1), min_size=S, max_size=S),
+    lane_sessions=st.lists(
+        st.integers(0, S - 1), min_size=LANES, max_size=LANES
+    ),
+    sigma=st.lists(
+        st.floats(0.3, 1.0), min_size=LANES, max_size=LANES
+    ),
+)
+def test_mixed_modes_converge_to_all_strong(modes, lane_sessions, sigma):
+    mixed = _run(modes, lane_sessions, sigma)
+    all_strong = _run([1] * S, lane_sessions, sigma)
+    np.testing.assert_array_equal(mixed, all_strong)

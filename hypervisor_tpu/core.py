@@ -175,6 +175,11 @@ class Hypervisor:
         from hypervisor_tpu.security.kill_switch import KillSwitch
 
         self.kill_switch = KillSwitch()
+        # Host breach windows for the action gateway (`check_action`);
+        # the device twin is the breach columns swept by run_sweeps.
+        from hypervisor_tpu.rings import RingBreachDetector
+
+        self.breach_detector = RingBreachDetector()
 
         # Sudo-with-TTL elevations, facade-wired across BOTH planes
         # (the reference exports its manager but never wires it,
@@ -584,6 +589,151 @@ class Hypervisor:
             payload={"merkle_root": merkle_root},
         )
         return merkle_root
+
+    # ── the action gateway: every per-action gate, composed ──────────
+
+    async def check_action(
+        self,
+        session_id: str,
+        agent_did: str,
+        action: ActionDescriptor,
+        has_consensus: bool = False,
+        has_sre_witness: bool = False,
+    ):
+        """Run one action through EVERY per-action gate, in order:
+
+          1. circuit breaker — an agent whose breach window already
+             tripped the breaker is refused for the cooldown
+             (`rings/breach_detector.py:149-186`),
+          2. quarantine — a quarantined membership is read-only
+             (`liability/quarantine.py` isolation semantics): non-read-
+             only actions refuse before any token burns,
+          3. ring enforcement at the EFFECTIVE ring — the membership's
+             base ring with live sudo grants applied
+             (`RingEnforcer.check`, reference precedence
+             `rings/enforcer.py:61-120`),
+          4. rate limit — one token from the membership row's device
+             bucket, rated at the effective ring's budget (per-ring
+             rates, `security/rate_limiter.py:52-57`),
+          5. breach recording — the call lands in BOTH planes' breach
+             windows regardless of outcome (refused probes count), and
+             an anomalous pattern may trip the circuit breaker.
+
+        The reference ships every gate but leaves composing them to the
+        caller; this is the wired pipeline. Returns an ActionCheckResult.
+        """
+        from hypervisor_tpu.security.action_gateway import ActionCheckResult
+
+        managed = self._require(session_id)
+        participant = managed.sso.get_participant(agent_did)
+        row = self.state.agent_row(agent_did, managed.slot)
+        if row is None:
+            raise RuntimeError(
+                f"{agent_did} has no live device row in {session_id} — "
+                "plane divergence"
+            )
+        slot = row["slot"]
+        now = self.state.now()
+        # Sudo grants apply to EVERY gate's view of the agent: the
+        # breach window must not count a legitimately-elevated call as
+        # privileged probing, and the rate bucket charges the elevated
+        # ring's budget.
+        eff_ring = self.elevation.get_effective_ring(
+            agent_did, session_id, participant.ring
+        )
+
+        def record_call():
+            # Both planes see the call — including refused ones (probing
+            # a privileged ring repeatedly IS the anomaly signal).
+            breach = self.breach_detector.record_call(
+                agent_did, session_id, eff_ring, action.required_ring
+            )
+            self.state.record_calls([slot], [action.required_ring.value])
+            if breach is not None:
+                self._emit(
+                    EventType.RING_BREACH_DETECTED,
+                    session_id=session_id,
+                    agent_did=agent_did,
+                    payload={
+                        "severity": breach.severity.value,
+                        "anomaly_rate": round(breach.actual_rate, 4),
+                    },
+                )
+            return breach
+
+        # 1. circuit breaker: tripped agents wait out the cooldown.
+        if self.breach_detector.is_breaker_tripped(agent_did, session_id):
+            return ActionCheckResult(
+                allowed=False,
+                reason="circuit breaker tripped (breach cooldown)",
+                effective_ring=eff_ring,
+                required_ring=action.required_ring,
+                breaker_tripped=True,
+            )
+
+        # 2. read-only isolation.
+        if self.state.quarantined_mask()[slot] and not action.is_read_only:
+            breach = record_call()
+            return ActionCheckResult(
+                allowed=False,
+                reason="agent is quarantined (read-only isolation)",
+                effective_ring=eff_ring,
+                required_ring=action.required_ring,
+                quarantined=True,
+                breach_event=breach,
+            )
+
+        # 3. ring enforcement at the effective ring.
+        ring_result = self.ring_enforcer.check(
+            agent_ring=eff_ring,
+            action=action,
+            sigma_eff=participant.sigma_eff,
+            has_consensus=has_consensus,
+            has_sre_witness=has_sre_witness,
+        )
+        if not ring_result.allowed:
+            breach = record_call()
+            return ActionCheckResult(
+                allowed=False,
+                reason=ring_result.reason,
+                effective_ring=eff_ring,
+                required_ring=ring_result.required_ring,
+                ring_check=ring_result,
+                breach_event=breach,
+            )
+
+        # 4. rate limit at the effective ring's budget.
+        allowed = bool(
+            self.state.consume_rate([slot], now, rings=[eff_ring.value])[0]
+        )
+        if not allowed:
+            breach = record_call()
+            self._emit(
+                EventType.RATE_LIMITED,
+                session_id=session_id,
+                agent_did=agent_did,
+                payload={"action_id": action.action_id},
+            )
+            return ActionCheckResult(
+                allowed=False,
+                reason=f"rate limit exceeded for ring {eff_ring.value}",
+                effective_ring=eff_ring,
+                required_ring=ring_result.required_ring,
+                rate_limited=True,
+                ring_check=ring_result,
+                breach_event=breach,
+            )
+
+        # 5. breach window records the granted call too.
+        breach = record_call()
+        return ActionCheckResult(
+            allowed=True,
+            reason="allowed",
+            effective_ring=eff_ring,
+            required_ring=ring_result.required_ring,
+            ring_check=ring_result,
+            breach_event=breach,
+        )
 
     # ── causal fault attribution -> ledger ───────────────────────────
 

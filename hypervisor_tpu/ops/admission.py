@@ -45,6 +45,7 @@ def admit_row_blocks(
     sigma_raw: jnp.ndarray,     # f32[B]
     sigma_eff: jnp.ndarray,     # f32[B]
     now: jnp.ndarray | float,
+    ring: jnp.ndarray | None = None,  # i8[B] assigned rings
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """([B, 8] f32, [B, 5] i32) freshly-admitted row blocks.
 
@@ -52,19 +53,30 @@ def admit_row_blocks(
     writes (by the AF32_*/AI32_* index constants) — `admit_batch` and the
     sharded `_wave_admission` both scatter these, so the layouts cannot
     drift. A row write covers EVERY column: per-membership accumulators
-    (risk, rate-limit bucket, breach window, quarantine deadline) reset
-    to their create() defaults, so a recycled slot never leaks the
-    previous tenant's budgets into a new membership.
+    (risk, breach window, quarantine deadline) reset to their create()
+    defaults, so a recycled slot never leaks the previous tenant's
+    budgets into a new membership. The rate bucket starts FULL at the
+    assigned ring's burst with the stamp at `now` (the reference
+    creates buckets full, `security/rate_limiter.py:21-48` — a
+    zero-token start near device epoch 0 would refuse a fresh member's
+    first calls).
     """
     from hypervisor_tpu.tables import state as tables_state
 
     b = did.shape[0]
     now_f = jnp.broadcast_to(jnp.asarray(now, jnp.float32), (b,))
+    if ring is None:
+        ring = jnp.full((b,), 3, jnp.int8)
+    bursts = jnp.asarray(DEFAULT_CONFIG.rate_limit.ring_bursts, jnp.float32)
     f32_rows = jnp.zeros((b, 8), jnp.float32)
     f32_rows = (
         f32_rows.at[:, tables_state.AF32_SIGMA_RAW].set(sigma_raw)
         .at[:, tables_state.AF32_SIGMA_EFF].set(sigma_eff)
         .at[:, tables_state.AF32_JOINED_AT].set(now_f)
+        .at[:, tables_state.AF32_RL_TOKENS].set(
+            bursts[jnp.clip(ring.astype(jnp.int32), 0, 3)]
+        )
+        .at[:, tables_state.AF32_RL_STAMP].set(now_f)
     )
     i32_rows = jnp.zeros((b, 5), jnp.int32)
     i32_rows = (
@@ -178,7 +190,7 @@ def admit_batch(
     )
     drop = dict(mode="drop", unique_indices=True)
     f32_rows, i32_rows = admit_row_blocks(
-        did, session_slot, sigma_raw, sigma_eff, now
+        did, session_slot, sigma_raw, sigma_eff, now, ring=ring
     )
     new_agents = replace(
         agents,

@@ -245,7 +245,7 @@ def _wave_admission(
     # layout + accumulator-reset semantics, shared with admit_batch).
     write = local_slot
     f32_rows, i32_rows = admission_ops.admit_row_blocks(
-        did, session_slot, sigma_raw, sigma_eff, now
+        did, session_slot, sigma_raw, sigma_eff, now, ring=ring
     )
     agents = t_replace(
         agents,

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.models import SessionConfig, SessionState
 from hypervisor_tpu.observability import profiling
-from hypervisor_tpu.ops import admission, saga_ops, security_ops
+from hypervisor_tpu.ops import admission, rate_limit, saga_ops, security_ops
 from hypervisor_tpu.ops import liability as liability_ops
 from hypervisor_tpu.ops import merkle as merkle_ops
 from hypervisor_tpu.ops import pipeline as pipeline_ops
@@ -60,6 +60,7 @@ _SLASH = jax.jit(liability_ops.slash_cascade)
 _BREACH_SWEEP = jax.jit(security_ops.breach_sweep)
 _ELEV_EXPIRY = jax.jit(security_ops.elevation_expiry)
 _QUAR_ENTER = jax.jit(security_ops.quarantine_enter)
+_RATE_CONSUME = jax.jit(rate_limit.consume)
 _QUAR_SWEEP = jax.jit(security_ops.quarantine_sweep)
 _FANOUT_ROUND = jax.jit(saga_ops.fanout_round)
 _EFF_RINGS = jax.jit(security_ops.effective_rings)
@@ -1010,6 +1011,57 @@ class HypervisorState:
             jnp.asarray(np.asarray(agent_slots, np.int32)),
             jnp.asarray(np.asarray(called_rings, np.int8)),
         )
+
+    def consume_rate(
+        self,
+        slots: Sequence[int],
+        now: float,
+        rings: Optional[Sequence[int]] = None,
+    ) -> np.ndarray:
+        """Refill-and-consume one token PER ELEMENT; returns
+        bool[len(slots)] decisions — the device twin of the reference's
+        per-call token bucket (`security/rate_limiter.py:89-130`).
+
+        Duplicate slots settle SEQUENTIALLY, like the host limiter's
+        `check_many` (`rate_limiter.py:160-166`): the k-th call against
+        one bucket is allowed iff the refilled level covers k tokens, so
+        a wave can never admit two calls on one token's budget. `rings`
+        overrides the rows' base rings (e.g. a live sudo grant rates the
+        call at the ELEVATED ring's budget).
+        """
+        slots_arr = np.asarray(slots, np.int32)
+        ring_vec = self.agents.ring
+        if rings is not None:
+            ring_vec = ring_vec.at[jnp.asarray(slots_arr)].set(
+                jnp.asarray(np.asarray(rings, np.int8))
+            )
+        # Pass 1: pure refill (cost 0) to learn each bucket's level.
+        probe = _RATE_CONSUME(
+            self.agents.rl_tokens, self.agents.rl_stamp, ring_vec, now, 0.0
+        )
+        refilled = np.asarray(probe.tokens)
+        # Sequential settlement: 1-based ordinal of each element within
+        # its slot's group, in call order.
+        ordinal = np.zeros(len(slots_arr), np.int64)
+        seen: dict[int, int] = {}
+        for i, s in enumerate(slots_arr):
+            seen[int(s)] = seen.get(int(s), 0) + 1
+            ordinal[i] = seen[int(s)]
+        allowed = ordinal <= refilled[slots_arr]
+        # Pass 2: consume exactly the granted tokens per row.
+        grants = np.zeros(self.agents.did.shape[0], np.float32)
+        np.add.at(grants, slots_arr, allowed.astype(np.float32))
+        decision = _RATE_CONSUME(
+            self.agents.rl_tokens,
+            self.agents.rl_stamp,
+            ring_vec,
+            now,
+            jnp.asarray(grants),
+        )
+        self.agents = replace(
+            self.agents, rl_tokens=decision.tokens, rl_stamp=decision.stamp
+        )
+        return allowed
 
     def breach_sweep_tick(self, now: float) -> tuple[np.ndarray, np.ndarray]:
         """Run the batched breach analysis; returns (severity, tripped)."""

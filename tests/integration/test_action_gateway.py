@@ -1,0 +1,198 @@
+"""Hypervisor.check_action: every per-action gate, composed and ordered.
+
+The reference ships quarantine isolation, the ring enforcer, the rate
+limiter, and the breach detector as separate engines and leaves the
+composition to callers; `check_action` is the wired pipeline —
+quarantine (read-only isolation) -> effective ring (sudo grants) ->
+ring enforcement -> device rate bucket -> breach recording on BOTH
+planes (refused probes count).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu import Hypervisor, SessionConfig
+from hypervisor_tpu.models import (
+    ActionDescriptor,
+    ExecutionRing,
+    ReversibilityLevel,
+)
+
+
+def _action(ring3=False, **kw):
+    base = dict(
+        action_id="a1",
+        name="write file",
+        execute_api="/x",
+        undo_api="/undo",
+        reversibility=ReversibilityLevel.FULL,
+    )
+    if ring3:
+        base.update(is_read_only=True)
+    base.update(kw)
+    return ActionDescriptor(**base)
+
+
+async def _session(hv, *joins):
+    ms = await hv.create_session(
+        SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+    )
+    for did, sigma in joins:
+        await hv.join_session(ms.sso.session_id, did, sigma_raw=sigma)
+    return ms
+
+
+class TestActionGateway:
+    async def test_allowed_action_burns_a_token_and_records(self):
+        hv = Hypervisor()
+        ms = await _session(hv, ("did:a", 0.8))  # Ring 2
+        sid = ms.sso.session_id
+        result = await hv.check_action(sid, "did:a", _action())
+        assert result.allowed and result.reason == "allowed"
+        assert result.effective_ring is ExecutionRing.RING_2_STANDARD
+        # Both planes recorded the call.
+        row = hv.state.agent_row("did:a", ms.slot)
+        assert int(np.asarray(hv.state.agents.bd_calls)[row["slot"]]) == 1
+        assert hv.breach_detector.get_agent_stats("did:a", sid)["total_calls"] == 1
+
+    async def test_quarantined_membership_is_read_only(self):
+        from hypervisor_tpu.liability.quarantine import QuarantineReason
+
+        hv = Hypervisor()
+        ms = await _session(hv, ("did:q", 0.8))
+        sid = ms.sso.session_id
+        row = hv.state.agent_row("did:q", ms.slot)
+        hv.quarantine.quarantine(
+            "did:q", sid, QuarantineReason.MANUAL, details="hold"
+        )
+        hv.state.quarantine_rows([row["slot"]], now=hv.state.now())
+
+        denied = await hv.check_action(sid, "did:q", _action())
+        assert not denied.allowed and denied.quarantined
+        # Read-only actions still serve (isolation, not a ban).
+        reads = await hv.check_action(sid, "did:q", _action(ring3=True))
+        assert reads.allowed
+
+    async def test_sudo_grant_clears_a_privileged_action(self):
+        hv = Hypervisor()
+        ms = await _session(hv, ("did:s", 0.97))  # Ring 2 (no consensus)
+        sid = ms.sso.session_id
+        privileged = _action(
+            undo_api=None, reversibility=ReversibilityLevel.NONE
+        )  # required ring 1
+        refused = await hv.check_action(
+            sid, "did:s", privileged, has_consensus=True
+        )
+        assert not refused.allowed  # base Ring 2 < required Ring 1
+
+        await hv.grant_elevation(sid, "did:s", ExecutionRing.RING_1_PRIVILEGED)
+        granted = await hv.check_action(
+            sid, "did:s", privileged, has_consensus=True
+        )
+        assert granted.allowed
+        assert granted.effective_ring is ExecutionRing.RING_1_PRIVILEGED
+
+    async def test_rate_limit_exhausts_and_emits(self):
+        from hypervisor_tpu import EventType, HypervisorEventBus
+
+        bus = HypervisorEventBus()
+        hv = Hypervisor(event_bus=bus)
+        # Ring 3 sandbox (5 rps / 10 burst): drains faster than the
+        # real-time refill between calls can restore.
+        ms = await _session(hv, ("did:r", 0.4))
+        sid = ms.sso.session_id
+        burst = hv.state.config.rate_limit.ring_bursts[3]
+        outcomes = []
+        for _ in range(int(burst) * 3):
+            outcomes.append(
+                (
+                    await hv.check_action(sid, "did:r", _action(ring3=True))
+                ).allowed
+            )
+        assert outcomes[0] and not outcomes[-1]
+        refused = [r for r in outcomes if not r]
+        assert len(refused) >= 1
+        assert len(bus.query(event_type=EventType.RATE_LIMITED)) >= 1
+
+    async def test_refused_probes_count_toward_breach(self):
+        hv = Hypervisor()
+        ms = await _session(hv, ("did:p", 0.7))  # Ring 2
+        sid = ms.sso.session_id
+        admin = _action(
+            is_admin=True, undo_api=None,
+            reversibility=ReversibilityLevel.NONE,
+        )  # required ring 0
+        breach = None
+        for _ in range(8):
+            result = await hv.check_action(sid, "did:p", admin)
+            assert not result.allowed
+            breach = result.breach_event or breach
+            if result.breaker_tripped:
+                break  # probing tripped the breaker mid-loop — the point
+        # Repeated privileged probing crossed an anomaly threshold.
+        assert breach is not None
+        row = hv.state.agent_row("did:p", ms.slot)
+        # Every PRE-trip probe was recorded on the device plane too
+        # (min_calls_for_analysis probes are needed before the ladder).
+        assert int(np.asarray(hv.state.agents.bd_privileged)[row["slot"]]) >= 5
+
+    async def test_tripped_breaker_refuses_until_cooldown(self):
+        hv = Hypervisor()
+        ms = await _session(hv, ("did:b", 0.7))
+        sid = ms.sso.session_id
+        admin = _action(
+            is_admin=True, undo_api=None,
+            reversibility=ReversibilityLevel.NONE,
+        )
+        # Probe until the breaker trips...
+        for _ in range(12):
+            await hv.check_action(sid, "did:b", admin)
+        assert hv.breach_detector.is_breaker_tripped("did:b", sid)
+        # ...after which even benign read-only actions refuse (gate 1).
+        result = await hv.check_action(sid, "did:b", _action(ring3=True))
+        assert not result.allowed and result.breaker_tripped
+
+    async def test_duplicate_slots_settle_sequentially(self):
+        # Device twin of the host limiter's check_many duplicate rule
+        # (`security/rate_limiter.py:160-166`): k-th call on one bucket
+        # allowed iff the refilled level covers k tokens.
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        hv = Hypervisor()
+        ms = await _session(hv, ("did:d", 0.8))
+        slot = hv.state.agent_row("did:d", ms.slot)["slot"]
+        now = hv.state.now()
+        hv.state.agents = t_replace(
+            hv.state.agents,
+            rl_tokens=hv.state.agents.rl_tokens.at[slot].set(1.4),
+            rl_stamp=hv.state.agents.rl_stamp.at[slot].set(now),
+        )
+        allowed = hv.state.consume_rate([slot, slot, slot], now)
+        assert allowed.tolist() == [True, False, False]
+        assert float(np.asarray(hv.state.agents.rl_tokens)[slot]) == (
+            pytest.approx(0.4, abs=1e-3)
+        )
+
+    async def test_sudo_grant_rates_at_elevated_budget(self):
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        hv = Hypervisor()
+        ms = await _session(hv, ("did:v", 0.4))  # Ring 3: burst 10
+        sid = ms.sso.session_id
+        await hv.grant_elevation(sid, "did:v", ExecutionRing.RING_2_STANDARD)
+        slot = hv.state.agent_row("did:v", ms.slot)["slot"]
+        now = hv.state.now()
+        # 15 tokens would exceed Ring 3's burst cap but fits Ring 2's 40;
+        # rated at the ELEVATED ring, all 12 calls clear.
+        hv.state.agents = t_replace(
+            hv.state.agents,
+            rl_tokens=hv.state.agents.rl_tokens.at[slot].set(15.0),
+            rl_stamp=hv.state.agents.rl_stamp.at[slot].set(now),
+        )
+        outcomes = [
+            (await hv.check_action(sid, "did:v", _action(ring3=True))).allowed
+            for _ in range(12)
+        ]
+        assert all(outcomes), "elevated budget should cover all 12 calls"

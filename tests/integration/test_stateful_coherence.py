@@ -210,6 +210,23 @@ class PlaneCoherence(RuleBasedStateMachine):
 
     @precondition(lambda self: any(self.joined.values()))
     @rule(pick=st.integers(0, 3))
+    def kill(self, pick):
+        """Facade kill: handoff bookkeeping then both-plane removal."""
+        sids = [s for s in self.sessions if self.joined[s]]
+        if not sids:
+            return
+        sid = sids[pick % len(sids)]
+        agent = sorted(self.joined[sid])[0]
+        self.go(
+            self.hv.kill_agent(
+                sid, agent,
+                in_flight_steps=[{"step_id": "s", "saga_id": "g"}],
+            )
+        )
+        self.joined[sid].discard(agent)
+
+    @precondition(lambda self: any(self.joined.values()))
+    @rule(pick=st.integers(0, 3))
     def drift_demote(self, pick):
         """MEDIUM drift: one-ring demotion on both planes, no slash."""
         sids = [s for s in self.sessions if self.joined[s]]

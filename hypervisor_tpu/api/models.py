@@ -303,6 +303,27 @@ class LeaveSessionRequest(BaseModel):
     agent_did: str
 
 
+class ActionCheckRequest(BaseModel):
+    """One action through the full gateway (quarantine -> sudo ring ->
+    enforcement -> rate bucket -> breach recording)."""
+
+    agent_did: str
+    action: dict  # ActionDescriptor fields
+    has_consensus: bool = False
+    has_sre_witness: bool = False
+
+
+class ActionCheckResponse(BaseModel):
+    allowed: bool
+    reason: str
+    effective_ring: int
+    required_ring: int
+    quarantined: bool = False
+    rate_limited: bool = False
+    breaker_tripped: bool = False
+    breach_severity: Optional[str] = None
+
+
 class KillAgentRequest(BaseModel):
     agent_did: str
     reason: str = "manual"

@@ -40,6 +40,8 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/api/v1/agents/{agent_did}/ring", "agent_ring", None),
     ("GET", "/api/v1/agents/{agent_did}/memberships", "agent_memberships", None),
     ("POST", "/api/v1/rings/check", "ring_check", M.RingCheckRequest),
+    ("POST", "/api/v1/sessions/{session_id}/actions/check", "action_check",
+     M.ActionCheckRequest),
     ("POST", "/api/v1/sessions/{session_id}/sagas", "create_saga", None),
     ("GET", "/api/v1/sessions/{session_id}/sagas", "list_sagas", None),
     ("GET", "/api/v1/sagas/{saga_id}", "get_saga", None),

@@ -202,6 +202,40 @@ class HypervisorService:
                     )
         raise ApiError(404, f"Agent {agent_did} not found in any session")
 
+    async def action_check(
+        self, session_id: str, req: M.ActionCheckRequest
+    ) -> M.ActionCheckResponse:
+        """The full per-action gateway (`Hypervisor.check_action`) —
+        the stateful sibling of the stateless /rings/check."""
+        if self.hv.get_session(session_id) is None:
+            raise ApiError(404, f"Session {session_id} not found")
+        try:
+            result = await self.hv.check_action(
+                session_id,
+                req.agent_did,
+                ActionDescriptor(**req.action),
+                has_consensus=req.has_consensus,
+                has_sre_witness=req.has_sre_witness,
+            )
+        except TypeError as e:
+            raise ApiError(422, f"bad action descriptor: {e}")
+        except Exception as e:
+            raise ApiError(409, str(e))
+        return M.ActionCheckResponse(
+            allowed=result.allowed,
+            reason=result.reason,
+            effective_ring=result.effective_ring.value,
+            required_ring=result.required_ring.value,
+            quarantined=result.quarantined,
+            rate_limited=result.rate_limited,
+            breaker_tripped=result.breaker_tripped,
+            breach_severity=(
+                result.breach_event.severity.value
+                if result.breach_event is not None
+                else None
+            ),
+        )
+
     async def agent_memberships(
         self, agent_did: str
     ) -> M.AgentMembershipsResponse:

@@ -661,14 +661,19 @@ class Hypervisor:
                 )
             return breach
 
-        # 1. circuit breaker: tripped agents wait out the cooldown.
+        # 1. circuit breaker: tripped agents wait out the cooldown. The
+        # refused probe still records on both planes — sustained probing
+        # through a cooldown must not decay the anomaly window to a
+        # clean-looking profile.
         if self.breach_detector.is_breaker_tripped(agent_did, session_id):
+            breach = record_call()
             return ActionCheckResult(
                 allowed=False,
                 reason="circuit breaker tripped (breach cooldown)",
                 effective_ring=eff_ring,
                 required_ring=action.required_ring,
                 breaker_tripped=True,
+                breach_event=breach,
             )
 
         # 2. read-only isolation.

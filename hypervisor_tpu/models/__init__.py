@@ -176,6 +176,15 @@ class ActionDescriptor:
     is_read_only: bool = False
     is_admin: bool = False
 
+    def __post_init__(self) -> None:
+        # API callers ship the enum's VALUE ("none"/"partial"/"full");
+        # required_ring gates with identity checks, so a raw string
+        # would silently demote an irreversible action's required ring
+        # from 1 to 2 — coerce here, once, for every construction path
+        # (gateway, /rings/check, join manifests).
+        if not isinstance(self.reversibility, ReversibilityLevel):
+            self.reversibility = ReversibilityLevel(self.reversibility)
+
     @property
     def risk_weight(self) -> float:
         """omega, derived from the reversibility level's default."""

@@ -46,6 +46,7 @@ def admit_row_blocks(
     sigma_eff: jnp.ndarray,     # f32[B]
     now: jnp.ndarray | float,
     ring: jnp.ndarray | None = None,  # i8[B] assigned rings
+    ring_bursts: jnp.ndarray | None = None,  # f32[4] per-ring bucket bursts
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """([B, 8] f32, [B, 5] i32) freshly-admitted row blocks.
 
@@ -67,7 +68,11 @@ def admit_row_blocks(
     now_f = jnp.broadcast_to(jnp.asarray(now, jnp.float32), (b,))
     if ring is None:
         ring = jnp.full((b,), 3, jnp.int8)
-    bursts = jnp.asarray(DEFAULT_CONFIG.rate_limit.ring_bursts, jnp.float32)
+    bursts = (
+        jnp.asarray(DEFAULT_CONFIG.rate_limit.ring_bursts, jnp.float32)
+        if ring_bursts is None
+        else jnp.asarray(ring_bursts, jnp.float32)
+    )
     f32_rows = jnp.zeros((b, 8), jnp.float32)
     f32_rows = (
         f32_rows.at[:, tables_state.AF32_SIGMA_RAW].set(sigma_raw)
@@ -127,6 +132,7 @@ def admit_batch(
     trust: TrustConfig = DEFAULT_CONFIG.trust,
     contribution: jnp.ndarray | None = None,  # f32[B] bonded sigma toward each agent
     omega: jnp.ndarray | float = 0.0,
+    ring_bursts: jnp.ndarray | None = None,   # f32[4] configured bucket bursts
 ) -> AdmissionResult:
     """Admit a wave of B agents; rejected elements leave no trace.
 
@@ -190,7 +196,8 @@ def admit_batch(
     )
     drop = dict(mode="drop", unique_indices=True)
     f32_rows, i32_rows = admit_row_blocks(
-        did, session_slot, sigma_raw, sigma_eff, now, ring=ring
+        did, session_slot, sigma_raw, sigma_eff, now, ring=ring,
+        ring_bursts=ring_bursts,
     )
     new_agents = replace(
         agents,

@@ -194,6 +194,7 @@ def governance_wave(
     omega: jnp.ndarray | float = 0.5,
     trust: TrustConfig = DEFAULT_CONFIG.trust,
     use_pallas: bool | None = None,
+    ring_bursts: jnp.ndarray | None = None,
 ) -> WaveResult:
     """The full governance pipeline AS ONE PROGRAM over the state tables.
 
@@ -240,6 +241,7 @@ def governance_wave(
         trust,
         contribution=contribution,
         omega=omega,
+        ring_bursts=ring_bursts,
     )
     agents, sessions = admitted.agents, admitted.sessions
     ok = admitted.status == admission_ops.ADMIT_OK

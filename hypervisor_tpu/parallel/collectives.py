@@ -94,7 +94,11 @@ def strong_tick(mesh: Mesh, with_vouching: bool = False):
     return jax.jit(mapped)
 
 
-def sharded_admission(mesh: Mesh, trust: TrustConfig = DEFAULT_CONFIG.trust):
+def sharded_admission(
+    mesh: Mesh,
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+    rate=DEFAULT_CONFIG.rate_limit,
+):
     """Cross-shard STRONG-mode admission: correct when a session spans chips.
 
     The agent table and the wave are sharded over the mesh agent axis;
@@ -137,6 +141,7 @@ def sharded_admission(mesh: Mesh, trust: TrustConfig = DEFAULT_CONFIG.trust):
         return _wave_admission(
             agents, sessions, vouches, slot, did, session_slot,
             sigma_raw, trustworthy, duplicate, now, omega, n_shards, trust,
+            rate,
         )
 
     lane = P(AGENT_AXIS)
@@ -170,6 +175,7 @@ def _wave_admission(
     omega,
     n_shards,
     trust,
+    rate=DEFAULT_CONFIG.rate_limit,
 ):
     """The cross-shard admission body (inside shard_map) shared by
     `sharded_admission` and `sharded_governance_wave` so the two can
@@ -245,7 +251,8 @@ def _wave_admission(
     # layout + accumulator-reset semantics, shared with admit_batch).
     write = local_slot
     f32_rows, i32_rows = admission_ops.admit_row_blocks(
-        did, session_slot, sigma_raw, sigma_eff, now, ring=ring
+        did, session_slot, sigma_raw, sigma_eff, now, ring=ring,
+        ring_bursts=jnp.asarray(rate.ring_bursts, jnp.float32),
     )
     agents = t_replace(
         agents,
@@ -625,7 +632,9 @@ def sharded_slash(mesh: Mesh, trust: TrustConfig = DEFAULT_CONFIG.trust):
 
 
 def sharded_governance_wave(
-    mesh: Mesh, trust: TrustConfig = DEFAULT_CONFIG.trust
+    mesh: Mesh,
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+    rate=DEFAULT_CONFIG.rate_limit,
 ):
     """The FUSED full-governance wave, end-to-end sharded (round-3 item).
 
@@ -686,6 +695,7 @@ def sharded_governance_wave(
         agents, sessions, status, ring, sigma_eff = _wave_admission(
             agents, sessions, vouches, slot, did, session_slot,
             sigma_raw, trustworthy, duplicate, now, omega, n_shards, trust,
+            rate,
         )
         ok = status == admission_ops.ADMIT_OK
 

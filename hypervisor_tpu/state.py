@@ -60,7 +60,7 @@ _SLASH = jax.jit(liability_ops.slash_cascade)
 _BREACH_SWEEP = jax.jit(security_ops.breach_sweep)
 _ELEV_EXPIRY = jax.jit(security_ops.elevation_expiry)
 _QUAR_ENTER = jax.jit(security_ops.quarantine_enter)
-_RATE_CONSUME = jax.jit(rate_limit.consume)
+_RATE_CONSUME = jax.jit(rate_limit.consume, static_argnames=("config",))
 _QUAR_SWEEP = jax.jit(security_ops.quarantine_sweep)
 _FANOUT_ROUND = jax.jit(saga_ops.fanout_round)
 _EFF_RINGS = jax.jit(security_ops.effective_rings)
@@ -131,6 +131,12 @@ class HypervisorState:
         # Ring-buffer row ownership: when the DeltaLog wraps, the sessions
         # whose rows get recycled must drop them from their audit index.
         self._row_session = np.full(cap.delta_log_capacity, -1, np.int32)
+
+        # Configured per-ring bucket bursts, shipped into every
+        # admission wave so custom configs are honoured on device.
+        self._ring_bursts = jnp.asarray(
+            config.rate_limit.ring_bursts, jnp.float32
+        )
 
         # Module-level jit wrappers: every HypervisorState shares one trace
         # cache instead of recompiling per instance.
@@ -346,7 +352,11 @@ class HypervisorState:
                 result = wave_fn(*wave_args)
         else:
             with profiling.span("hv.governance_wave"):
-                result = _WAVE(*wave_args, use_pallas=use_pallas)
+                result = _WAVE(
+                    *wave_args,
+                    use_pallas=use_pallas,
+                    ring_bursts=self._ring_bursts,
+                )
         self.agents = result.agents
         self.sessions = result.sessions
         self.vouches = result.vouches
@@ -509,6 +519,7 @@ class HypervisorState:
                     jnp.asarray(trustworthy.astype(bool)),
                     jnp.asarray(duplicate),
                     now,
+                    ring_bursts=self._ring_bursts,
                 )
             self.agents = result.agents
             self.sessions = result.sessions
@@ -1030,14 +1041,35 @@ class HypervisorState:
         call at the ELEVATED ring's budget).
         """
         slots_arr = np.asarray(slots, np.int32)
+        cfg = self.config.rate_limit
         ring_vec = self.agents.ring
         if rings is not None:
             ring_vec = ring_vec.at[jnp.asarray(slots_arr)].set(
                 jnp.asarray(np.asarray(rings, np.int8))
             )
+        if len(set(slots_arr.tolist())) == len(slots_arr):
+            # Unique slots (the per-action hot path): one consume pass.
+            cost = jnp.zeros(
+                (self.agents.did.shape[0],), jnp.float32
+            ).at[jnp.asarray(slots_arr)].set(1.0)
+            decision = _RATE_CONSUME(
+                self.agents.rl_tokens,
+                self.agents.rl_stamp,
+                ring_vec,
+                now,
+                cost,
+                config=cfg,
+            )
+            self.agents = replace(
+                self.agents,
+                rl_tokens=decision.tokens,
+                rl_stamp=decision.stamp,
+            )
+            return np.asarray(decision.allowed)[slots_arr]
         # Pass 1: pure refill (cost 0) to learn each bucket's level.
         probe = _RATE_CONSUME(
-            self.agents.rl_tokens, self.agents.rl_stamp, ring_vec, now, 0.0
+            self.agents.rl_tokens, self.agents.rl_stamp, ring_vec, now, 0.0,
+            config=cfg,
         )
         refilled = np.asarray(probe.tokens)
         # Sequential settlement: 1-based ordinal of each element within
@@ -1057,6 +1089,7 @@ class HypervisorState:
             ring_vec,
             now,
             jnp.asarray(grants),
+            config=cfg,
         )
         self.agents = replace(
             self.agents, rl_tokens=decision.tokens, rl_stamp=decision.stamp

@@ -99,19 +99,29 @@ class TestActionGateway:
 
         bus = HypervisorEventBus()
         hv = Hypervisor(event_bus=bus)
-        # Ring 3 sandbox (5 rps / 10 burst): drains faster than the
-        # real-time refill between calls can restore.
-        ms = await _session(hv, ("did:r", 0.4))
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        ms = await _session(hv, ("did:r", 0.4))  # Ring 3 sandbox
         sid = ms.sso.session_id
-        burst = hv.state.config.rate_limit.ring_bursts[3]
+        slot = hv.state.agent_row("did:r", ms.slot)["slot"]
+        # Deterministic drain: 3 tokens in the bucket and a FAR-FUTURE
+        # stamp, so wall-clock refill between calls is exactly zero
+        # (consume clamps elapsed at >= 0).
+        hv.state.agents = t_replace(
+            hv.state.agents,
+            rl_tokens=hv.state.agents.rl_tokens.at[slot].set(3.0),
+            rl_stamp=hv.state.agents.rl_stamp.at[slot].set(
+                hv.state.now() + 3600.0
+            ),
+        )
         outcomes = []
-        for _ in range(int(burst) * 3):
+        for _ in range(5):
             outcomes.append(
                 (
                     await hv.check_action(sid, "did:r", _action(ring3=True))
                 ).allowed
             )
-        assert outcomes[0] and not outcomes[-1]
+        assert outcomes == [True, True, True, False, False]
         refused = [r for r in outcomes if not r]
         assert len(refused) >= 1
         assert len(bus.query(event_type=EventType.RATE_LIMITED)) >= 1

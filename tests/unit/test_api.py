@@ -181,7 +181,7 @@ class TestHTTPTransport:
         # The reference's 21 endpoints plus /api/v1/device/stats (the
         # device-plane occupancy view the reference has no analog for),
         # the two quarantine views, and the per-membership agent view.
-        assert len(ROUTES) == 28
+        assert len(ROUTES) == 29
         assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
         assert any(
             path == "/api/v1/security/quarantines" for _, path, _, _ in ROUTES
@@ -373,3 +373,33 @@ async def test_kill_endpoint_hands_off_and_removes(svc):
             a.session_id, M.KillAgentRequest(agent_did="did:v", reason="bogus")
         )
     assert exc.value.status == 422
+
+
+async def test_action_check_endpoint_runs_the_gateway(svc):
+    a = await svc.create_session(
+        M.CreateSessionRequest(creator_did="did:lead", min_sigma_eff=0.0)
+    )
+    await svc.join_session(
+        a.session_id, M.JoinSessionRequest(agent_did="did:g", sigma_raw=0.8)
+    )
+    out = await svc.action_check(
+        a.session_id,
+        M.ActionCheckRequest(
+            agent_did="did:g",
+            action={
+                "action_id": "w1",
+                "name": "write",
+                "execute_api": "/x",
+                "undo_api": "/u",
+                "reversibility": "full",
+            },
+        ),
+    )
+    assert out.allowed and out.effective_ring == 2
+
+    with pytest.raises(ApiError) as e:
+        await svc.action_check(
+            a.session_id,
+            M.ActionCheckRequest(agent_did="did:g", action={"bogus": 1}),
+        )
+    assert e.value.status == 422

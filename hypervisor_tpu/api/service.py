@@ -217,7 +217,10 @@ class HypervisorService:
                 has_consensus=req.has_consensus,
                 has_sre_witness=req.has_sre_witness,
             )
-        except TypeError as e:
+        except (TypeError, ValueError) as e:
+            # TypeError: unknown/missing fields; ValueError: the
+            # __post_init__ reversibility coercion rejecting a bogus
+            # enum value — both are caller errors, not conflicts.
             raise ApiError(422, f"bad action descriptor: {e}")
         except Exception as e:
             raise ApiError(409, str(e))

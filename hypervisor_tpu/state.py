@@ -346,7 +346,15 @@ class HypervisorState:
                     sharded_governance_wave,
                 )
 
-                wave_fn = sharded_governance_wave(mesh)
+                # Build with THIS state's configs, not module defaults:
+                # the sharded path must admit with the same bursts as
+                # the single-device path or rate decisions diverge by
+                # deployment mode.
+                wave_fn = sharded_governance_wave(
+                    mesh,
+                    trust=self.config.trust,
+                    rate=self.config.rate_limit,
+                )
                 self._sharded_waves[mesh] = wave_fn
             with profiling.span("hv.governance_wave_sharded"):
                 result = wave_fn(*wave_args)

@@ -121,6 +121,14 @@ class TestActionGateway:
                     await hv.check_action(sid, "did:r", _action(ring3=True))
                 ).allowed
             )
+            # consume resets the stamp to `now`; re-pin it so the NEXT
+            # call also sees zero wall-clock refill (deterministic).
+            hv.state.agents = t_replace(
+                hv.state.agents,
+                rl_stamp=hv.state.agents.rl_stamp.at[slot].set(
+                    hv.state.now() + 3600.0
+                ),
+            )
         assert outcomes == [True, True, True, False, False]
         refused = [r for r in outcomes if not r]
         assert len(refused) >= 1

@@ -264,6 +264,47 @@ class PlaneCoherence(RuleBasedStateMachine):
         except RingElevationError:
             pass  # one live grant per (agent, session) — legal refusal
 
+    @precondition(lambda self: any(self.joined.values()))
+    @rule(pick=st.integers(0, 3), kind=st.integers(0, 2))
+    def gateway(self, pick, kind):
+        """check_action under arbitrary interleavings: a quarantined
+        writer must refuse, a tripped breaker must refuse, and the
+        verdict must never crash whatever the planes hold."""
+        from hypervisor_tpu.models import ActionDescriptor, ReversibilityLevel
+
+        sids = [s for s in self.sessions if self.joined[s]]
+        if not sids:
+            return
+        sid = sids[pick % len(sids)]
+        agent = sorted(self.joined[sid])[0]
+        action = ActionDescriptor(
+            action_id=f"act{kind}",
+            name="probe",
+            execute_api="/x",
+            undo_api="/u" if kind == 0 else None,
+            reversibility=[
+                ReversibilityLevel.FULL,
+                ReversibilityLevel.NONE,
+                ReversibilityLevel.FULL,
+            ][kind],
+            is_read_only=(kind == 2),
+        )
+        result = self.go(self.hv.check_action(sid, agent, action))
+        row = self.hv.state.agent_row(agent, self.hv.get_session(sid).slot)
+        if (
+            row is not None
+            and self.hv.state.quarantined_mask()[row["slot"]]
+            and not action.is_read_only
+        ):
+            assert not result.allowed and (
+                result.quarantined or result.breaker_tripped
+            )
+        if self.hv.breach_detector.is_breaker_tripped(agent, sid):
+            # The trip may have happened on THIS call's recording; the
+            # next call must refuse at gate 1.
+            again = self.go(self.hv.check_action(sid, agent, action))
+            assert not again.allowed and again.breaker_tripped
+
     @rule()
     def sweeps(self):
         now = self.hv.state.now()

@@ -164,7 +164,7 @@ class Hypervisor:
         # penalized participant never also earns the clean-session
         # credit; O(session), dropped at terminate.
         self._penalized_in: dict[str, set[str]] = {}
-        self.ring_enforcer = RingEnforcer()
+        self.ring_enforcer = RingEnforcer(trust=self.state.config.trust)
         self.classifier = ActionClassifier()
         self.verifier = TransactionHistoryVerifier()
         self.commitment = CommitmentEngine()
@@ -620,125 +620,199 @@ class Hypervisor:
              an anomalous pattern may trip the circuit breaker.
 
         The reference ships every gate but leaves composing them to the
-        caller; this is the wired pipeline. Returns an ActionCheckResult.
+        caller; this is the wired pipeline — the N=1 case of the
+        batched `check_actions` wave (`ops.gateway.check_actions`).
+        Returns an ActionCheckResult.
         """
+        results = await self.check_actions(
+            session_id,
+            [(agent_did, action, has_consensus, has_sre_witness)],
+        )
+        return results[0]
+
+    async def check_actions(
+        self,
+        session_id: str,
+        requests: list,
+    ):
+        """Run a WAVE of actions through every per-action gate as ONE
+        fused device program (`ops.gateway.check_actions`).
+
+        `requests` is a list of `(agent_did, action)` or
+        `(agent_did, action, has_consensus, has_sre_witness)` tuples,
+        settled in wave order: an early action's recording can trip the
+        circuit breaker that refuses a later action, and two actions on
+        one membership's bucket consume sequentially — bit-compatible
+        with running `check_action` per element (pinned by
+        `tests/parity/test_gateway_wave.py`). One deliberate divergence
+        under ERROR: membership is validated for the whole wave before
+        anything records, so a request naming an unknown agent raises
+        with NO state change on either plane (the sequential loop would
+        have committed the actions before the bad one).
+
+        Host-plane mirror: the sliding-window breach detector records
+        every call in order BEFORE the wave (its trips feed gate 1 via
+        the `host_tripped` column — EITHER plane's breaker refuses), so
+        forensic events and device verdicts stay coherent. Returns a
+        list of ActionCheckResult in request order.
+        """
+        from hypervisor_tpu.ops import gateway as gateway_ops
+        from hypervisor_tpu.ops import rings as ring_ops_mod
+        from hypervisor_tpu.rings import RingCheckResult, _render_reason
         from hypervisor_tpu.security.action_gateway import ActionCheckResult
 
         managed = self._require(session_id)
-        participant = managed.sso.get_participant(agent_did)
-        row = self.state.agent_row(agent_did, managed.slot)
-        if row is None:
-            raise RuntimeError(
-                f"{agent_did} has no live device row in {session_id} — "
-                "plane divergence"
-            )
-        slot = row["slot"]
-        now = self.state.now()
-        # Sudo grants apply to EVERY gate's view of the agent: the
-        # breach window must not count a legitimately-elevated call as
-        # privileged probing, and the rate bucket charges the elevated
-        # ring's budget.
-        eff_ring = self.elevation.get_effective_ring(
-            agent_did, session_id, participant.ring
-        )
+        if not requests:
+            return []
+        norm = []
+        for req in requests:
+            agent_did, action = req[0], req[1]
+            has_consensus = bool(req[2]) if len(req) > 2 else False
+            has_sre_witness = bool(req[3]) if len(req) > 3 else False
+            norm.append((agent_did, action, has_consensus, has_sre_witness))
 
-        def record_call():
-            # Both planes see the call — including refused ones (probing
-            # a privileged ring repeatedly IS the anomaly signal).
-            breach = self.breach_detector.record_call(
-                agent_did, session_id, eff_ring, action.required_ring
+        slots, req_rings, read_only, consensus, witness = [], [], [], [], []
+        participants = []
+        for agent_did, action, has_consensus, has_sre_witness in norm:
+            participant = managed.sso.get_participant(agent_did)
+            row = self.state.agent_row(agent_did, managed.slot)
+            if row is None:
+                raise RuntimeError(
+                    f"{agent_did} has no live device row in {session_id} — "
+                    "plane divergence"
+                )
+            participants.append(participant)
+            slots.append(row["slot"])
+            req_rings.append(action.required_ring.value)
+            read_only.append(bool(action.is_read_only))
+            consensus.append(has_consensus)
+            witness.append(has_sre_witness)
+
+        # Host-plane mirror, in wave order: the sliding window sees every
+        # call — including ones the wave will refuse (probing a
+        # privileged ring repeatedly IS the anomaly signal). Sudo grants
+        # apply to the window's view: a legitimately-elevated call is not
+        # privileged probing. Each action's host breaker state is read
+        # AFTER the mirror recorded everything before it, so a host-plane
+        # trip mid-wave refuses later actions exactly like the sequential
+        # pipeline would.
+        breach_events, host_tripped = [], []
+        for (agent_did, action, _, _), participant in zip(norm, participants):
+            host_tripped.append(
+                self.breach_detector.is_breaker_tripped(agent_did, session_id)
             )
-            self.state.record_calls([slot], [action.required_ring.value])
-            if breach is not None:
+            eff_host = self.elevation.get_effective_ring(
+                agent_did, session_id, participant.ring
+            )
+            breach_events.append(
+                self.breach_detector.record_call(
+                    agent_did, session_id, eff_host, action.required_ring
+                )
+            )
+
+        wave = self.state.check_actions_wave(
+            slots, req_rings, read_only, consensus, witness, host_tripped,
+            now=self.state.now(),
+        )
+        verdict = np.asarray(wave.verdict)
+        ring_status = np.asarray(wave.ring_status)
+        eff_rings = np.asarray(wave.eff_ring)
+        # The sigma the device ring gate actually decided on — reported
+        # verbatim so a plane desync can't yield a reason that
+        # contradicts the verdict.
+        sigmas = np.asarray(wave.sigma_eff)
+
+        results = []
+        for i, (agent_did, action, _, _) in enumerate(norm):
+            # Events publish here — per action, AFTER the wave committed,
+            # in the sequential pipeline's order (an action's breach
+            # event precedes its rate refusal event).
+            if breach_events[i] is not None:
                 self._emit(
                     EventType.RING_BREACH_DETECTED,
                     session_id=session_id,
                     agent_did=agent_did,
                     payload={
-                        "severity": breach.severity.value,
-                        "anomaly_rate": round(breach.actual_rate, 4),
+                        "severity": breach_events[i].severity.value,
+                        "anomaly_rate": round(breach_events[i].actual_rate, 4),
                     },
                 )
-            return breach
-
-        # 1. circuit breaker: tripped agents wait out the cooldown. The
-        # refused probe still records on both planes — sustained probing
-        # through a cooldown must not decay the anomaly window to a
-        # clean-looking profile.
-        if self.breach_detector.is_breaker_tripped(agent_did, session_id):
-            breach = record_call()
-            return ActionCheckResult(
-                allowed=False,
-                reason="circuit breaker tripped (breach cooldown)",
-                effective_ring=eff_ring,
-                required_ring=action.required_ring,
-                breaker_tripped=True,
-                breach_event=breach,
-            )
-
-        # 2. read-only isolation.
-        if self.state.quarantined_mask()[slot] and not action.is_read_only:
-            breach = record_call()
-            return ActionCheckResult(
-                allowed=False,
-                reason="agent is quarantined (read-only isolation)",
-                effective_ring=eff_ring,
-                required_ring=action.required_ring,
-                quarantined=True,
-                breach_event=breach,
-            )
-
-        # 3. ring enforcement at the effective ring.
-        ring_result = self.ring_enforcer.check(
-            agent_ring=eff_ring,
-            action=action,
-            sigma_eff=participant.sigma_eff,
-            has_consensus=has_consensus,
-            has_sre_witness=has_sre_witness,
-        )
-        if not ring_result.allowed:
-            breach = record_call()
-            return ActionCheckResult(
-                allowed=False,
-                reason=ring_result.reason,
-                effective_ring=eff_ring,
-                required_ring=ring_result.required_ring,
-                ring_check=ring_result,
-                breach_event=breach,
-            )
-
-        # 4. rate limit at the effective ring's budget.
-        allowed = bool(
-            self.state.consume_rate([slot], now, rings=[eff_ring.value])[0]
-        )
-        if not allowed:
-            breach = record_call()
-            self._emit(
-                EventType.RATE_LIMITED,
-                session_id=session_id,
-                agent_did=agent_did,
-                payload={"action_id": action.action_id},
-            )
-            return ActionCheckResult(
-                allowed=False,
-                reason=f"rate limit exceeded for ring {eff_ring.value}",
-                effective_ring=eff_ring,
-                required_ring=ring_result.required_ring,
-                rate_limited=True,
-                ring_check=ring_result,
-                breach_event=breach,
-            )
-
-        # 5. breach window records the granted call too.
-        breach = record_call()
-        return ActionCheckResult(
-            allowed=True,
-            reason="allowed",
-            effective_ring=eff_ring,
-            required_ring=ring_result.required_ring,
-            ring_check=ring_result,
-            breach_event=breach,
-        )
+            eff_ring = ExecutionRing(int(eff_rings[i]))
+            code = int(ring_status[i])
+            v = int(verdict[i])
+            ring_check = None
+            if v not in (gateway_ops.GATE_BREAKER, gateway_ops.GATE_QUARANTINED):
+                # Gates 1–2 refuse before the ring gate evaluates.
+                ring_check = RingCheckResult(
+                    allowed=code == ring_ops_mod.CHECK_OK,
+                    required_ring=action.required_ring,
+                    agent_ring=eff_ring,
+                    sigma_eff=float(sigmas[i]),
+                    reason=_render_reason(
+                        code,
+                        float(sigmas[i]),
+                        int(eff_rings[i]),
+                        action.required_ring.value,
+                        trust=self.state.config.trust,
+                    ),
+                    requires_consensus=code == ring_ops_mod.CHECK_NEEDS_CONSENSUS,
+                    requires_sre_witness=code
+                    == ring_ops_mod.CHECK_NEEDS_SRE_WITNESS,
+                )
+            if v == gateway_ops.GATE_BREAKER:
+                result = ActionCheckResult(
+                    allowed=False,
+                    reason="circuit breaker tripped (breach cooldown)",
+                    effective_ring=eff_ring,
+                    required_ring=action.required_ring,
+                    breaker_tripped=True,
+                    breach_event=breach_events[i],
+                )
+            elif v == gateway_ops.GATE_QUARANTINED:
+                result = ActionCheckResult(
+                    allowed=False,
+                    reason="agent is quarantined (read-only isolation)",
+                    effective_ring=eff_ring,
+                    required_ring=action.required_ring,
+                    quarantined=True,
+                    breach_event=breach_events[i],
+                )
+            elif v == gateway_ops.GATE_RING:
+                result = ActionCheckResult(
+                    allowed=False,
+                    reason=ring_check.reason,
+                    effective_ring=eff_ring,
+                    required_ring=action.required_ring,
+                    ring_check=ring_check,
+                    breach_event=breach_events[i],
+                )
+            elif v == gateway_ops.GATE_RATE:
+                self._emit(
+                    EventType.RATE_LIMITED,
+                    session_id=session_id,
+                    agent_did=agent_did,
+                    payload={"action_id": action.action_id},
+                )
+                result = ActionCheckResult(
+                    allowed=False,
+                    reason=f"rate limit exceeded for ring {eff_ring.value}",
+                    effective_ring=eff_ring,
+                    required_ring=action.required_ring,
+                    rate_limited=True,
+                    ring_check=ring_check,
+                    breach_event=breach_events[i],
+                )
+            else:
+                result = ActionCheckResult(
+                    allowed=True,
+                    reason="allowed",
+                    effective_ring=eff_ring,
+                    required_ring=action.required_ring,
+                    ring_check=ring_check,
+                    breach_event=breach_events[i],
+                )
+            results.append(result)
+        return results
 
     # ── causal fault attribution -> ledger ───────────────────────────
 

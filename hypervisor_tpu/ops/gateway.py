@@ -1,0 +1,295 @@
+"""The batched action gateway: every per-action gate as ONE device wave.
+
+`Hypervisor.check_action` composes the gates the reference ships but
+never wires together (circuit breaker `rings/breach_detector.py:128-186`,
+quarantine isolation `liability/quarantine.py:96-103`, sudo-aware ring
+enforcement `rings/enforcer.py:61-120`, per-ring token buckets
+`security/rate_limiter.py:52-57,89-130`, breach-window recording). The
+scalar path ran one host→device round-trip per gate per action; this op
+runs N actions through ALL gates in one fused XLA program — the scalar
+facade path is the N=1 case of the same op.
+
+In-wave sequencing without a scan: the scalar pipeline is order-
+dependent (an action's record can trip the breaker that refuses the
+NEXT action; two actions on one bucket settle sequentially), but both
+dependences are prefix-monotone within a wave, so they vectorize as
+segment prefix sums over a stable sort by agent slot:
+
+  * breaker: once live, live for the rest of the wave (the cooldown
+    outlasts the wave's single `now`), so action i is gated by
+    pre-wave state OR any-earlier-trip — a prefix-OR of the per-action
+    trip condition,
+  * rate: denials don't consume, so the k-th gate-passing action on a
+    bucket is allowed iff the refilled level covers k tokens — the
+    same ordinal rule as `HypervisorState.consume_rate`'s sequential
+    settle (`security/rate_limiter.py:160-166`).
+
+The breach window here is the device plane's tumbling-counter model
+(`ops.security_ops`): counters accumulate since the last sweep and the
+per-action analysis applies the reference severity ladder to the
+running totals — equal to the host detector's sliding window whenever
+no sweep has rolled the counters mid-window (the parity tests pin that
+regime). Privileged-call accounting compares against the EFFECTIVE
+ring, so a legitimately-elevated call never counts as probing (the
+documented `check_action` contract).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import (
+    BreachConfig,
+    DEFAULT_CONFIG,
+    RateLimitConfig,
+    TrustConfig,
+)
+from hypervisor_tpu.ops import rings as ring_ops
+from hypervisor_tpu.tables.state import (
+    AgentTable,
+    ElevationTable,
+    FLAG_BREAKER_TRIPPED,
+    FLAG_QUARANTINED,
+)
+from hypervisor_tpu.ops import security_ops
+from hypervisor_tpu.tables.struct import replace
+
+# Gateway verdict codes, in gate order (precedence == scalar pipeline).
+GATE_ALLOWED = 0
+GATE_BREAKER = 1
+GATE_QUARANTINED = 2
+GATE_RING = 3
+GATE_RATE = 4
+GATE_INVALID = 5   # masked-out lane (ragged wave padding)
+
+
+def _segment_prefix(
+    slot: jnp.ndarray, vals: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(inclusive, exclusive) prefix sums of `vals` within equal-slot
+    groups, respecting wave order.
+
+    One stable sort by slot (ties keep wave order), one cumsum, and a
+    segment-base subtraction — O(B log B), no host loop, no [B, B] mask.
+    """
+    b = slot.shape[0]
+    order = jnp.argsort(slot, stable=True)
+    s_sorted = slot[order]
+    v_sorted = vals[order]
+    c = jnp.cumsum(v_sorted)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), s_sorted[1:] != s_sorted[:-1]]
+    )
+    start_pos = jax.lax.cummax(
+        jnp.where(is_start, jnp.arange(b, dtype=jnp.int32), 0)
+    )
+    c_before = jnp.concatenate([jnp.zeros((1,), c.dtype), c[:-1]])
+    base = c_before[start_pos]
+    incl_sorted = c - base
+    excl_sorted = incl_sorted - v_sorted
+    inv = jnp.zeros((b,), jnp.int32).at[order].set(
+        jnp.arange(b, dtype=jnp.int32)
+    )
+    return incl_sorted[inv], excl_sorted[inv]
+
+
+class GatewayResult(NamedTuple):
+    """One gateway wave's outputs (all action axes are [B])."""
+
+    agents: AgentTable
+    verdict: jnp.ndarray       # i8[B]  GATE_* codes; GATE_ALLOWED == allowed
+    ring_status: jnp.ndarray   # i8[B]  ring_ops.CHECK_* codes
+    eff_ring: jnp.ndarray      # i8[B]  elevation-effective ring per action
+    sigma_eff: jnp.ndarray     # f32[B] device sigma the ring gate decided on
+    severity: jnp.ndarray      # i8[B]  anomaly ladder at this record (0=none)
+    anomaly_rate: jnp.ndarray  # f32[B] window anomaly rate at this record
+    window_calls: jnp.ndarray  # i32[B] window total at this record
+    tripped: jnp.ndarray       # bool[B] records that tripped the breaker
+
+
+def check_actions(
+    agents: AgentTable,
+    elevations: ElevationTable,
+    slot: jnp.ndarray,           # i32[B] acting membership rows
+    required_ring: jnp.ndarray,  # i8[B]  ActionDescriptor.required_ring
+    is_read_only: jnp.ndarray,   # bool[B]
+    has_consensus: jnp.ndarray,  # bool[B]
+    has_sre_witness: jnp.ndarray,  # bool[B]
+    host_tripped: jnp.ndarray,   # bool[B] host-plane breaker pre-states
+    now: jnp.ndarray | float,
+    valid: jnp.ndarray | None = None,  # bool[B] lane mask (ragged waves)
+    breach: BreachConfig = DEFAULT_CONFIG.breach,
+    rate_limit: RateLimitConfig = DEFAULT_CONFIG.rate_limit,
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+) -> GatewayResult:
+    """Run B actions through every per-action gate in one program.
+
+    Gate order matches the scalar pipeline exactly: breaker →
+    quarantine (read-only isolation) → ring enforcement at the
+    effective ring → rate consume at the effective ring's budget →
+    breach-window recording (refused probes record too). `host_tripped`
+    folds the host detector's sliding-window breaker verdict into gate
+    1 so EITHER plane's breaker refuses (the stateful-coherence
+    contract); in-wave trips come from the device tumbling counters.
+    """
+    b = slot.shape[0]
+    now_f = jnp.asarray(now, jnp.float32)
+    if valid is None:
+        valid = jnp.ones((b,), bool)
+    slot = jnp.clip(slot.astype(jnp.int32), 0)
+
+    # ── per-action gathers ───────────────────────────────────────────
+    eff_all = security_ops.effective_rings(agents.ring, elevations, now_f)
+    eff = eff_all[slot]
+    sigma = agents.sigma_eff[slot]
+    flags_at = agents.flags[slot]
+    required_ring = required_ring.astype(jnp.int8)
+
+    # ── gate 1: circuit breaker (both planes + in-wave trips) ────────
+    pre_dev_live = ((flags_at & FLAG_BREAKER_TRIPPED) != 0) & (
+        now_f < agents.bd_breaker_until[slot]
+    )
+    # Per-action analysis condition, computed AS IF every record ran the
+    # reference analysis (`breach_detector.py:141-186`) on the running
+    # tumbling totals. Ordinals are per-slot prefix counts in wave order.
+    ones = valid.astype(jnp.int32)
+    k_incl, _ = _segment_prefix(slot, ones)
+    privileged = (required_ring < eff) & valid
+    p_incl, _ = _segment_prefix(slot, privileged.astype(jnp.int32))
+    total_i = agents.bd_calls[slot] + k_incl
+    priv_i = agents.bd_privileged[slot] + p_incl
+    analyzable = total_i >= breach.min_calls_for_analysis
+    rate_i = jnp.where(
+        analyzable,
+        priv_i.astype(jnp.float32)
+        / jnp.maximum(total_i, 1).astype(jnp.float32),
+        0.0,
+    )
+    cond = (analyzable & (rate_i >= breach.high_threshold) & valid).astype(
+        jnp.int32
+    )
+    _, cond_before = _segment_prefix(slot, cond)
+    live = (pre_dev_live | host_tripped | (cond_before > 0)) & valid
+
+    # The record that trips is the FIRST condition-true record of an
+    # un-tripped agent; everything after it is refused at gate 1 (the
+    # reference suppresses analysis through the cooldown,
+    # `breach_detector.py:123-127` — severity masks to NONE there).
+    trip_action = (cond != 0) & ~live & valid
+    severity = (
+        (rate_i >= breach.low_threshold).astype(jnp.int8)
+        + (rate_i >= breach.medium_threshold).astype(jnp.int8)
+        + (rate_i >= breach.high_threshold).astype(jnp.int8)
+        + (rate_i >= breach.critical_threshold).astype(jnp.int8)
+    )
+    severity = jnp.where(analyzable & ~live & valid, severity, 0).astype(
+        jnp.int8
+    )
+    anomaly_rate = jnp.where(severity > 0, rate_i, 0.0)
+
+    # ── gate 2: quarantine = read-only isolation ─────────────────────
+    quarantined = (flags_at & FLAG_QUARANTINED) != 0
+    refused_quar = ~live & quarantined & ~is_read_only & valid
+
+    # ── gate 3: ring enforcement at the effective ring ───────────────
+    ring_status = ring_ops.ring_check(
+        eff, required_ring, sigma, has_consensus, has_sre_witness, trust
+    )
+    refused_ring = (
+        ~live & ~refused_quar & (ring_status != ring_ops.CHECK_OK) & valid
+    )
+
+    # ── gate 4: rate consume, sequential settle among gate-passers ───
+    reaching = valid & ~(live | refused_quar | refused_ring)
+    n = agents.did.shape[0]
+    # Elevated budget: acting rows refill at the effective ring. Invalid
+    # lanes scatter out-of-bounds and drop (ragged-wave padding must not
+    # touch row 0).
+    ring_for_rate = agents.ring.at[jnp.where(valid, slot, n)].set(
+        eff, mode="drop"
+    )
+    rates = jnp.asarray(rate_limit.ring_rates, jnp.float32)
+    bursts = jnp.asarray(rate_limit.ring_bursts, jnp.float32)
+    row_ring = jnp.clip(ring_for_rate.astype(jnp.int32), 0, 3)
+    elapsed = jnp.maximum(now_f - agents.rl_stamp, 0.0)
+    refilled = jnp.minimum(
+        bursts[row_ring], agents.rl_tokens + elapsed * rates[row_ring]
+    )
+    r_incl, _ = _segment_prefix(slot, reaching.astype(jnp.int32))
+    rate_ok = r_incl.astype(jnp.float32) <= refilled[slot]
+    allowed = reaching & rate_ok
+
+    verdict = jnp.where(
+        ~valid,
+        jnp.int8(GATE_INVALID),
+        jnp.where(
+            live,
+            jnp.int8(GATE_BREAKER),
+            jnp.where(
+                refused_quar,
+                jnp.int8(GATE_QUARANTINED),
+                jnp.where(
+                    refused_ring,
+                    jnp.int8(GATE_RING),
+                    jnp.where(
+                        allowed, jnp.int8(GATE_ALLOWED), jnp.int8(GATE_RATE)
+                    ),
+                ),
+            ),
+        ),
+    )
+
+    # ── post-state: counters, breaker flags, buckets ─────────────────
+    calls_add = jnp.zeros((n,), jnp.int32).at[slot].add(ones)
+    priv_add = jnp.zeros((n,), jnp.int32).at[slot].add(
+        privileged.astype(jnp.int32)
+    )
+    tripped_rows = jnp.zeros((n,), bool).at[slot].max(trip_action)
+    # Release breakers whose cooldown lapsed (host boundary: released at
+    # now >= cooldown end, `breach_detector.py:171-178`), unless this
+    # very wave re-tripped them.
+    expired = (
+        ((agents.flags & FLAG_BREAKER_TRIPPED) != 0)
+        & (now_f >= agents.bd_breaker_until)
+        & ~tripped_rows
+    )
+    flags = jnp.where(
+        expired, agents.flags & ~FLAG_BREAKER_TRIPPED, agents.flags
+    )
+    flags = jnp.where(tripped_rows, flags | FLAG_BREAKER_TRIPPED, flags)
+    breaker_until = jnp.where(
+        tripped_rows,
+        now_f + breach.circuit_breaker_cooldown_seconds,
+        agents.bd_breaker_until,
+    )
+    # Whole-table refill + restamp, exactly like `consume_rate` (refill
+    # is time-shift idempotent, so rolling every bucket forward is
+    # semantics-preserving); only granted tokens leave buckets.
+    grants = jnp.zeros((n,), jnp.float32).at[slot].add(
+        allowed.astype(jnp.float32)
+    )
+    new_agents = replace(
+        agents,
+        bd_calls=agents.bd_calls + calls_add,
+        bd_privileged=agents.bd_privileged + priv_add,
+        flags=flags.astype(agents.flags.dtype),
+        bd_breaker_until=breaker_until.astype(jnp.float32),
+        rl_tokens=refilled - grants,
+        rl_stamp=jnp.broadcast_to(now_f, agents.rl_stamp.shape).astype(
+            jnp.float32
+        ),
+    )
+    return GatewayResult(
+        agents=new_agents,
+        verdict=verdict,
+        ring_status=ring_status.astype(jnp.int8),
+        eff_ring=eff.astype(jnp.int8),
+        sigma_eff=sigma.astype(jnp.float32),
+        severity=severity,
+        anomaly_rate=anomaly_rate.astype(jnp.float32),
+        window_calls=total_i.astype(jnp.int32),
+        tripped=trip_action,
+    )

@@ -88,8 +88,11 @@ def breach_sweep(
 
     # Trip on HIGH/CRITICAL; un-trip breakers whose cooldown elapsed.
     trip = severity >= SEV_HIGH
+    # Release boundary matches the host detector and the gateway wave:
+    # at the exact cooldown end the breaker is already released
+    # (`breach_detector.py` is_breaker_tripped: now >= cooldown_end).
     expired = ((agents.flags & FLAG_BREAKER_TRIPPED) != 0) & (
-        now_f > agents.bd_breaker_until
+        now_f >= agents.bd_breaker_until
     )
     flags = agents.flags
     flags = jnp.where(expired & ~trip, flags & ~FLAG_BREAKER_TRIPPED, flags)

@@ -56,8 +56,14 @@ class RingCheckResult:
     requires_sre_witness: bool = False
 
 
-def _render_reason(code: int, sigma_eff: float, agent_ring: int, required: int) -> str:
-    t = DEFAULT_CONFIG.trust
+def _render_reason(
+    code: int,
+    sigma_eff: float,
+    agent_ring: int,
+    required: int,
+    trust=None,
+) -> str:
+    t = trust if trust is not None else DEFAULT_CONFIG.trust
     if code == ring_ops.CHECK_OK:
         return "Access granted"
     if code == ring_ops.CHECK_NEEDS_SRE_WITNESS:
@@ -72,10 +78,18 @@ def _render_reason(code: int, sigma_eff: float, agent_ring: int, required: int) 
 
 
 class RingEnforcer:
-    """Privilege gate over the 4-ring model (thresholds in `config.TrustConfig`)."""
+    """Privilege gate over the 4-ring model (thresholds in `config.TrustConfig`).
 
-    RING_1_THRESHOLD = DEFAULT_CONFIG.trust.ring1_threshold
-    RING_2_THRESHOLD = DEFAULT_CONFIG.trust.ring2_threshold
+    `trust` injects a non-default TrustConfig so host verdicts and
+    reasons agree with the device gateway wave, which evaluates at the
+    session state's live config (`ops.gateway.check_actions`).
+    """
+
+    def __init__(self, trust=None) -> None:
+        self.trust = trust if trust is not None else DEFAULT_CONFIG.trust
+        # Published threshold attributes follow the injected config.
+        self.RING_1_THRESHOLD = self.trust.ring1_threshold
+        self.RING_2_THRESHOLD = self.trust.ring2_threshold
 
     def check(
         self,
@@ -93,14 +107,18 @@ class RingEnforcer:
         """
         required = action.required_ring
         code = self._check_code(
-            agent_ring.value, required.value, sigma_eff, has_consensus, has_sre_witness
+            agent_ring.value, required.value, sigma_eff, has_consensus,
+            has_sre_witness, self.trust,
         )
         return RingCheckResult(
             allowed=code == ring_ops.CHECK_OK,
             required_ring=required,
             agent_ring=agent_ring,
             sigma_eff=sigma_eff,
-            reason=_render_reason(code, sigma_eff, agent_ring.value, required.value),
+            reason=_render_reason(
+                code, sigma_eff, agent_ring.value, required.value,
+                trust=self.trust,
+            ),
             requires_consensus=code == ring_ops.CHECK_NEEDS_CONSENSUS,
             requires_sre_witness=code == ring_ops.CHECK_NEEDS_SRE_WITNESS,
         )
@@ -112,8 +130,9 @@ class RingEnforcer:
         sigma_eff: float,
         has_consensus: bool,
         has_sre_witness: bool,
+        trust=None,
     ) -> int:
-        t = DEFAULT_CONFIG.trust
+        t = trust if trust is not None else DEFAULT_CONFIG.trust
         if required == 0 and not has_sre_witness:
             return ring_ops.CHECK_NEEDS_SRE_WITNESS
         if required == 1 and sigma_eff < t.ring1_threshold:

@@ -32,6 +32,7 @@ from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.models import SessionConfig, SessionState
 from hypervisor_tpu.observability import profiling
 from hypervisor_tpu.ops import admission, rate_limit, saga_ops, security_ops
+from hypervisor_tpu.ops import gateway as gateway_ops
 from hypervisor_tpu.ops import liability as liability_ops
 from hypervisor_tpu.ops import merkle as merkle_ops
 from hypervisor_tpu.ops import pipeline as pipeline_ops
@@ -64,6 +65,10 @@ _RATE_CONSUME = jax.jit(rate_limit.consume, static_argnames=("config",))
 _QUAR_SWEEP = jax.jit(security_ops.quarantine_sweep)
 _FANOUT_ROUND = jax.jit(saga_ops.fanout_round)
 _EFF_RINGS = jax.jit(security_ops.effective_rings)
+_GATEWAY = jax.jit(
+    gateway_ops.check_actions,
+    static_argnames=("breach", "rate_limit", "trust"),
+)
 
 
 class HypervisorState:
@@ -1103,6 +1108,69 @@ class HypervisorState:
             self.agents, rl_tokens=decision.tokens, rl_stamp=decision.stamp
         )
         return allowed
+
+    def check_actions_wave(
+        self,
+        slots: Sequence[int] | np.ndarray,
+        required_rings: Sequence[int] | np.ndarray,
+        is_read_only: Sequence[bool] | np.ndarray,
+        has_consensus: Sequence[bool] | np.ndarray,
+        has_sre_witness: Sequence[bool] | np.ndarray,
+        host_tripped: Sequence[bool] | np.ndarray,
+        now: float,
+    ) -> gateway_ops.GatewayResult:
+        """Run B actions through the fused per-action gateway
+        (`ops.gateway.check_actions`) and commit the post-state.
+
+        ONE device program for the whole wave — breaker, quarantine,
+        sudo-aware ring enforcement, sequential rate settle, and breach
+        recording — where the scalar path paid a host→device round-trip
+        per gate per action. Returns the full GatewayResult (the
+        committed table plus per-action verdict columns).
+
+        Wave lengths are padded to the next power of two with
+        `valid=False` lanes (masked lanes touch nothing — pinned by
+        `tests/parity/test_gateway_wave.py`), so XLA traces O(log max_B)
+        programs instead of one per distinct batch size.
+        """
+        b = len(np.asarray(slots, np.int32))
+        padded = max(1, 1 << max(0, (b - 1).bit_length()))
+
+        def pad(seq, dtype, fill=0):
+            arr = np.full((padded,), fill, dtype)
+            arr[:b] = np.asarray(seq, dtype)
+            return jnp.asarray(arr)
+
+        valid = np.zeros((padded,), bool)
+        valid[:b] = True
+        with profiling.span("hv.gateway_wave"):
+            result = _GATEWAY(
+                self.agents,
+                self.elevations,
+                pad(slots, np.int32),
+                pad(required_rings, np.int8),
+                pad(is_read_only, bool),
+                pad(has_consensus, bool),
+                pad(has_sre_witness, bool),
+                pad(host_tripped, bool),
+                now,
+                valid=jnp.asarray(valid),
+                breach=self.config.breach,
+                rate_limit=self.config.rate_limit,
+                trust=self.config.trust,
+            )
+        self.agents = result.agents
+        return gateway_ops.GatewayResult(
+            agents=result.agents,
+            verdict=result.verdict[:b],
+            ring_status=result.ring_status[:b],
+            eff_ring=result.eff_ring[:b],
+            sigma_eff=result.sigma_eff[:b],
+            severity=result.severity[:b],
+            anomaly_rate=result.anomaly_rate[:b],
+            window_calls=result.window_calls[:b],
+            tripped=result.tripped[:b],
+        )
 
     def breach_sweep_tick(self, now: float) -> tuple[np.ndarray, np.ndarray]:
         """Run the batched breach analysis; returns (severity, tripped)."""

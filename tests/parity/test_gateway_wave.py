@@ -1,0 +1,327 @@
+"""Batched gateway wave ≡ sequential check_action, pinned.
+
+The fused `ops.gateway.check_actions` program settles a whole action
+wave in one device dispatch; these tests run the SAME action sequence
+(a) as one `Hypervisor.check_actions` wave and (b) as per-element
+`check_action` calls against an identical twin world, and require
+identical verdicts, reasons, flags, breach counters, breaker trips,
+and token levels — including the order-dependent cases the scalar
+pipeline defines: an early probe tripping the breaker that refuses a
+later action, and duplicate slots draining one bucket sequentially
+(`security/rate_limiter.py:160-166` semantics).
+
+Refill rates are zeroed so wall-clock drift between the scalar calls
+cannot move a bucket across a verdict boundary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu import Hypervisor, SessionConfig
+from hypervisor_tpu.config import DEFAULT_CONFIG, RateLimitConfig
+from hypervisor_tpu.models import (
+    ActionDescriptor,
+    ExecutionRing,
+    ReversibilityLevel,
+)
+from hypervisor_tpu.state import HypervisorState
+from hypervisor_tpu.tables.state import (
+    FLAG_BREAKER_TRIPPED,
+    FLAG_QUARANTINED,
+)
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+NO_REFILL = DEFAULT_CONFIG.replace(
+    rate_limit=RateLimitConfig(ring_rates=(0.0, 0.0, 0.0, 0.0))
+)
+
+AGENTS = [
+    ("did:ok", 0.8),      # Ring 2, plenty of budget
+    ("did:probe", 0.7),   # Ring 2, will probe admin actions
+    ("did:quar", 0.8),    # Ring 2, quarantined
+    ("did:low", 0.4),     # Ring 3 sandbox
+    ("did:drain", 0.8),   # Ring 2, bucket pinned to 2.4 tokens
+    ("did:sudo", 0.97),   # Ring 2 (no consensus), sudo-grant candidate
+]
+
+
+def _write(**kw):
+    base = dict(
+        action_id="w",
+        name="write",
+        execute_api="/x",
+        undo_api="/u",
+        reversibility=ReversibilityLevel.FULL,
+    )
+    base.update(kw)
+    return ActionDescriptor(**base)
+
+
+def _read():
+    return _write(action_id="r", is_read_only=True)
+
+
+def _admin():
+    return _write(
+        action_id="adm", is_admin=True, undo_api=None,
+        reversibility=ReversibilityLevel.NONE,
+    )
+
+
+async def _world():
+    """One deterministic world: a session, five members, one quarantine,
+    one drained bucket."""
+    from hypervisor_tpu.liability.quarantine import QuarantineReason
+
+    hv = Hypervisor(state=HypervisorState(NO_REFILL))
+    ms = await hv.create_session(
+        SessionConfig(min_sigma_eff=0.0, max_participants=10),
+        creator_did="did:lead",
+    )
+    sid = ms.sso.session_id
+    for did, sigma in AGENTS:
+        await hv.join_session(sid, did, sigma_raw=sigma)
+
+    q_slot = hv.state.agent_row("did:quar", ms.slot)["slot"]
+    hv.quarantine.quarantine("did:quar", sid, QuarantineReason.MANUAL)
+    hv.state.quarantine_rows([q_slot], now=hv.state.now())
+
+    d_slot = hv.state.agent_row("did:drain", ms.slot)["slot"]
+    hv.state.agents = t_replace(
+        hv.state.agents,
+        rl_tokens=hv.state.agents.rl_tokens.at[d_slot].set(2.4),
+    )
+    return hv, ms, sid
+
+
+# The wave: interleaved so the probe agent's breaker trips MID-wave
+# (min_calls_for_analysis=5 → probes 6+ refuse at gate 1), with drain
+# calls woven between them and an allowed/quarantined/ring mix around.
+SEQUENCE = [
+    ("did:ok", _write(), False, False),
+    ("did:quar", _write(), False, False),     # quarantined (write)
+    ("did:quar", _read(), False, False),      # allowed (read-only isolation)
+    ("did:probe", _admin(), False, False),    # ring-refused, privileged probe 1
+    ("did:drain", _read(), False, False),     # token 1 of 2.4
+    ("did:probe", _admin(), False, False),    # probe 2
+    ("did:probe", _admin(), False, False),    # probe 3
+    ("did:low", _write(), False, False),      # ring insufficient (3 > 2)
+    ("did:probe", _admin(), False, False),    # probe 4
+    ("did:drain", _read(), False, False),     # token 2 of 2.4
+    ("did:probe", _admin(), False, False),    # probe 5 → trips breaker
+    ("did:probe", _admin(), False, False),    # breaker-refused (gate 1)
+    ("did:drain", _read(), False, False),     # bucket empty → rate-refused
+    ("did:probe", _read(), False, False),     # breaker refuses benign reads too
+    ("did:drain", _read(), False, False),     # still empty → rate-refused
+    ("did:ok", _write(), False, False),
+]
+
+
+def _snapshot(hv, ms, dids):
+    ag = hv.state.agents
+    out = {}
+    for did in dids:
+        slot = hv.state.agent_row(did, ms.slot)["slot"]
+        out[did] = dict(
+            calls=int(np.asarray(ag.bd_calls)[slot]),
+            privileged=int(np.asarray(ag.bd_privileged)[slot]),
+            tripped=bool(np.asarray(ag.flags)[slot] & FLAG_BREAKER_TRIPPED),
+            quarantined=bool(np.asarray(ag.flags)[slot] & FLAG_QUARANTINED),
+            tokens=float(np.asarray(ag.rl_tokens)[slot]),
+        )
+    return out
+
+
+class TestGatewayWaveParity:
+    async def test_wave_matches_sequential(self):
+        hv_w, ms_w, sid_w = await _world()
+        hv_s, ms_s, sid_s = await _world()
+
+        wave = await hv_w.check_actions(sid_w, SEQUENCE)
+        seq = [
+            await hv_s.check_action(sid_s, did, action, c, w)
+            for did, action, c, w in SEQUENCE
+        ]
+
+        assert len(wave) == len(seq) == len(SEQUENCE)
+        for i, (rw, rs) in enumerate(zip(wave, seq)):
+            assert rw.allowed == rs.allowed, (i, rw.reason, rs.reason)
+            assert rw.reason == rs.reason, i
+            assert rw.quarantined == rs.quarantined, i
+            assert rw.rate_limited == rs.rate_limited, i
+            assert rw.breaker_tripped == rs.breaker_tripped, i
+            assert rw.effective_ring is rs.effective_ring, i
+            assert (rw.ring_check is None) == (rs.ring_check is None), i
+            if rw.ring_check is not None:
+                assert rw.ring_check.reason == rs.ring_check.reason, i
+
+        # The exact refusal story the sequence was built to exercise.
+        kinds = [
+            "allowed" if r.allowed
+            else "breaker" if r.breaker_tripped
+            else "quar" if r.quarantined
+            else "rate" if r.rate_limited
+            else "ring"
+            for r in wave
+        ]
+        assert kinds == [
+            "allowed", "quar", "allowed", "ring", "allowed", "ring",
+            "ring", "ring", "ring", "allowed", "ring", "breaker",
+            "rate", "breaker", "rate", "allowed",
+        ]
+
+        # Post-state parity on the device columns (stamps/deadlines are
+        # wall-clock and excluded; rates are zeroed so tokens are exact).
+        dids = [d for d, _ in AGENTS]
+        snap_w = _snapshot(hv_w, ms_w, dids)
+        snap_s = _snapshot(hv_s, ms_s, dids)
+        for did in dids:
+            for key in ("calls", "privileged", "tripped", "quarantined"):
+                assert snap_w[did][key] == snap_s[did][key], (did, key)
+            assert snap_w[did]["tokens"] == pytest.approx(
+                snap_s[did]["tokens"], abs=1e-4
+            ), did
+
+        # Both planes agree the probe agent's breaker is live.
+        assert snap_w["did:probe"]["tripped"]
+        assert hv_w.breach_detector.is_breaker_tripped("did:probe", sid_w)
+
+    async def test_elevated_calls_are_not_privileged_probes(self):
+        """A live sudo grant applies to the wave's window accounting:
+        calls at the granted ring don't count as privileged probing
+        (the documented check_action contract), and the bucket charges
+        the ELEVATED ring's budget."""
+        hv_w, ms_w, sid_w = await _world()
+        hv_s, ms_s, sid_s = await _world()
+
+        # NONE-reversibility write → required ring 1; with σ=0.97 and
+        # consensus, the only blocker is the agent's base ring 2 — the
+        # sudo grant clears it.
+        ring1_action = _write(undo_api=None, reversibility=ReversibilityLevel.NONE)
+        seq2 = [("did:sudo", ring1_action, True, False)] * 6
+        for hv, sid in ((hv_w, sid_w), (hv_s, sid_s)):
+            await hv.grant_elevation(
+                sid, "did:sudo", ExecutionRing.RING_1_PRIVILEGED
+            )
+
+        wave = await hv_w.check_actions(sid_w, seq2)
+        seq = [
+            await hv_s.check_action(sid_s, did, action, c, w)
+            for did, action, c, w in seq2
+        ]
+        for i, (rw, rs) in enumerate(zip(wave, seq)):
+            assert rw.allowed == rs.allowed, i
+            assert rw.effective_ring is rs.effective_ring, i
+            assert rw.effective_ring is ExecutionRing.RING_1_PRIVILEGED, i
+
+        slot = hv_w.state.agent_row("did:sudo", ms_w.slot)["slot"]
+        ag = hv_w.state.agents
+        assert int(np.asarray(ag.bd_calls)[slot]) == 6
+        # required ring 1 == effective ring 1 → never a privileged probe
+        # (against the BASE ring 2 every one of these would have counted,
+        # 6 > min_calls and the breaker would already be live).
+        assert int(np.asarray(ag.bd_privileged)[slot]) == 0
+        assert not bool(np.asarray(ag.flags)[slot] & FLAG_BREAKER_TRIPPED)
+        assert all(r.allowed for r in wave)
+
+    async def test_host_only_trip_mid_wave_gates_later_actions(self):
+        """When the planes' windows disagree (device counters diluted by
+        stale clean calls the host window has already slid past), a
+        HOST-plane trip during the wave must still refuse later actions
+        — each action's host breaker state is read after the mirror
+        recorded everything before it, like the sequential pipeline."""
+        hv_w, ms_w, sid_w = await _world()
+        hv_s, ms_s, sid_s = await _world()
+
+        # Dilute the DEVICE window only: 200 stale clean calls mean 7
+        # privileged probes stay under the 0.7 trip threshold on device,
+        # while the host's fresh sliding window trips at probe 5.
+        for hv, ms in ((hv_w, ms_w), (hv_s, ms_s)):
+            slot = hv.state.agent_row("did:probe", ms.slot)["slot"]
+            hv.state.agents = t_replace(
+                hv.state.agents,
+                bd_calls=hv.state.agents.bd_calls.at[slot].set(200),
+            )
+
+        probes = [("did:probe", _admin(), False, False)] * 7
+        wave = await hv_w.check_actions(sid_w, probes)
+        seq = [
+            await hv_s.check_action(sid_s, did, action, c, w)
+            for did, action, c, w in probes
+        ]
+        kinds_w = [
+            "breaker" if r.breaker_tripped else "ring" for r in wave
+        ]
+        kinds_s = [
+            "breaker" if r.breaker_tripped else "ring" for r in seq
+        ]
+        assert kinds_w == kinds_s
+        assert kinds_w == ["ring"] * 5 + ["breaker"] * 2
+
+    async def test_empty_wave_is_a_noop(self):
+        hv, ms, sid = await _world()
+        before = _snapshot(hv, ms, [d for d, _ in AGENTS])
+        assert await hv.check_actions(sid, []) == []
+        after = _snapshot(hv, ms, [d for d, _ in AGENTS])
+        for did in before:
+            for key in ("calls", "privileged", "tripped", "quarantined"):
+                assert before[did][key] == after[did][key]
+
+
+class TestGatewayOpMasking:
+    def test_padded_lanes_change_nothing(self):
+        """valid=False lanes (ragged-wave padding) must not touch any
+        row — verdicts on real lanes and the post-state table are
+        bit-identical to the unpadded wave."""
+        import jax.numpy as jnp
+
+        from hypervisor_tpu.ops import gateway as gw
+        from hypervisor_tpu.tables.state import AgentTable, ElevationTable
+
+        agents = AgentTable.create(8)
+        agents = t_replace(
+            agents,
+            did=agents.did.at[:4].set(jnp.arange(4)),
+            sigma_eff=agents.sigma_eff.at[:4].set(0.8),
+            ring=agents.ring.at[:4].set(2),
+            rl_tokens=agents.rl_tokens.at[:4].set(5.0),
+        )
+        elevs = ElevationTable.create(4)
+        slot = jnp.asarray([0, 1, 0, 2], jnp.int32)
+        req = jnp.asarray([2, 2, 2, 2], jnp.int8)
+        ro = jnp.zeros((4,), bool)
+        cw = jnp.zeros((4,), bool)
+        ht = jnp.zeros((4,), bool)
+
+        bare = gw.check_actions(
+            agents, elevs, slot, req, ro, cw, cw, ht, now=100.0
+        )
+
+        def pad4(x, fill=0):
+            return jnp.concatenate([x, jnp.full((4,), fill, x.dtype)])
+
+        padded = gw.check_actions(
+            agents,
+            elevs,
+            pad4(slot),
+            pad4(req),
+            pad4(ro),
+            pad4(cw),
+            pad4(cw),
+            pad4(ht),
+            now=100.0,
+            valid=jnp.asarray([1, 1, 1, 1, 0, 0, 0, 0], bool),
+        )
+        assert np.array_equal(
+            np.asarray(bare.verdict), np.asarray(padded.verdict[:4])
+        )
+        assert np.all(
+            np.asarray(padded.verdict[4:]) == gw.GATE_INVALID
+        )
+        for name in ("f32", "i32", "ring"):
+            assert np.array_equal(
+                np.asarray(getattr(bare.agents, name)),
+                np.asarray(getattr(padded.agents, name)),
+            ), name

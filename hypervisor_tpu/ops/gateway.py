@@ -47,14 +47,15 @@ from hypervisor_tpu.config import (
     RateLimitConfig,
     TrustConfig,
 )
+from hypervisor_tpu.ops import rate_limit as rate_ops
 from hypervisor_tpu.ops import rings as ring_ops
+from hypervisor_tpu.ops import security_ops
 from hypervisor_tpu.tables.state import (
     AgentTable,
     ElevationTable,
     FLAG_BREAKER_TRIPPED,
     FLAG_QUARANTINED,
 )
-from hypervisor_tpu.ops import security_ops
 from hypervisor_tpu.tables.struct import replace
 
 # Gateway verdict codes, in gate order (precedence == scalar pipeline).
@@ -121,6 +122,7 @@ def check_actions(
     host_tripped: jnp.ndarray,   # bool[B] host-plane breaker pre-states
     now: jnp.ndarray | float,
     valid: jnp.ndarray | None = None,  # bool[B] lane mask (ragged waves)
+    agent_base: jnp.ndarray | int = 0,  # global row of agents[0] (shard_map)
     breach: BreachConfig = DEFAULT_CONFIG.breach,
     rate_limit: RateLimitConfig = DEFAULT_CONFIG.rate_limit,
     trust: TrustConfig = DEFAULT_CONFIG.trust,
@@ -134,15 +136,25 @@ def check_actions(
     folds the host detector's sliding-window breaker verdict into gate
     1 so EITHER plane's breaker refuses (the stateful-coherence
     contract); in-wave trips come from the device tumbling counters.
+
+    `agent_base` supports running the SAME body inside `shard_map` on a
+    table shard (`parallel.collectives.sharded_gateway`): `slot` stays
+    GLOBAL, the body subtracts the shard's base row for every gather
+    and scatter, and sudo grants whose agent lives on another shard
+    drop out of the elevation scatter. Lanes whose slot falls outside
+    this shard must arrive with `valid=False` (the placement contract).
     """
     b = slot.shape[0]
+    n = agents.did.shape[0]
     now_f = jnp.asarray(now, jnp.float32)
     if valid is None:
         valid = jnp.ones((b,), bool)
-    slot = jnp.clip(slot.astype(jnp.int32), 0)
+    slot = jnp.clip(slot.astype(jnp.int32) - agent_base, 0, n - 1)
 
     # ── per-action gathers ───────────────────────────────────────────
-    eff_all = security_ops.effective_rings(agents.ring, elevations, now_f)
+    eff_all = security_ops.effective_rings(
+        agents.ring, elevations, now_f, agent_base=agent_base
+    )
     eff = eff_all[slot]
     sigma = agents.sigma_eff[slot]
     flags_at = agents.flags[slot]
@@ -204,19 +216,15 @@ def check_actions(
 
     # ── gate 4: rate consume, sequential settle among gate-passers ───
     reaching = valid & ~(live | refused_quar | refused_ring)
-    n = agents.did.shape[0]
     # Elevated budget: acting rows refill at the effective ring. Invalid
     # lanes scatter out-of-bounds and drop (ragged-wave padding must not
     # touch row 0).
     ring_for_rate = agents.ring.at[jnp.where(valid, slot, n)].set(
         eff, mode="drop"
     )
-    rates = jnp.asarray(rate_limit.ring_rates, jnp.float32)
-    bursts = jnp.asarray(rate_limit.ring_bursts, jnp.float32)
-    row_ring = jnp.clip(ring_for_rate.astype(jnp.int32), 0, 3)
-    elapsed = jnp.maximum(now_f - agents.rl_stamp, 0.0)
-    refilled = jnp.minimum(
-        bursts[row_ring], agents.rl_tokens + elapsed * rates[row_ring]
+    refilled = rate_ops.refill(
+        agents.rl_tokens, agents.rl_stamp, ring_for_rate, now_f,
+        config=rate_limit,
     )
     r_incl, _ = _segment_prefix(slot, reaching.astype(jnp.int32))
     rate_ok = r_incl.astype(jnp.float32) <= refilled[slot]

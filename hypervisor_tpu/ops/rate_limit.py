@@ -22,6 +22,23 @@ class RateDecision(NamedTuple):
     stamp: jnp.ndarray     # f32[N] updated refill stamps
 
 
+def refill(
+    tokens: jnp.ndarray,
+    stamp: jnp.ndarray,
+    ring: jnp.ndarray,
+    now: jnp.ndarray | float,
+    config: RateLimitConfig = DEFAULT_CONFIG.rate_limit,
+) -> jnp.ndarray:
+    """f32[N]: every bucket's level rolled forward to `now` (burst-capped
+    per-ring refill) — the shared refill half of `consume`, also the
+    pre-settle pass of the gateway wave (`ops.gateway.check_actions`)."""
+    rates = jnp.asarray(np.asarray(config.ring_rates, np.float32))
+    bursts = jnp.asarray(np.asarray(config.ring_bursts, np.float32))
+    ring = jnp.clip(ring.astype(jnp.int32), 0, 3)
+    elapsed = jnp.maximum(jnp.asarray(now, jnp.float32) - stamp, 0.0)
+    return jnp.minimum(bursts[ring], tokens + elapsed * rates[ring])
+
+
 def consume(
     tokens: jnp.ndarray,
     stamp: jnp.ndarray,
@@ -35,15 +52,8 @@ def consume(
     tokens/stamp are the agent table's bucket columns; ring selects the
     per-ring (rate, burst) pair. Rejected rows keep their refilled level.
     """
-    rates = jnp.asarray(np.asarray(config.ring_rates, np.float32))
-    bursts = jnp.asarray(np.asarray(config.ring_bursts, np.float32))
-    ring = jnp.clip(ring.astype(jnp.int32), 0, 3)
-    rate = rates[ring]
-    burst = bursts[ring]
-
     now = jnp.asarray(now, jnp.float32)
-    elapsed = jnp.maximum(now - stamp, 0.0)
-    refilled = jnp.minimum(burst, tokens + elapsed * rate)
+    refilled = refill(tokens, stamp, ring, now, config)
     allowed = refilled >= cost
     new_tokens = jnp.where(allowed, refilled - cost, refilled)
     new_stamp = jnp.broadcast_to(now, stamp.shape)

@@ -130,22 +130,30 @@ def effective_rings(
     base_ring: jnp.ndarray,        # i8[N] agents' assigned rings
     elevations: ElevationTable,
     now: jnp.ndarray | float,
+    agent_base: jnp.ndarray | int = 0,
 ) -> jnp.ndarray:
     """i8[N]: each agent's ring with active unexpired grants applied.
 
     A grant only ever elevates (min with the base ring — lower number =
     more privileged), matching `elevation.py:138-145`.
+
+    `agent_base` localizes GLOBAL grant slots onto a table shard whose
+    rows start at that global row (shard_map callers: `ops.gateway`,
+    `parallel.collectives.sharded_gateway`); grants landing on other
+    shards drop out of the scatter.
     """
     now_f = jnp.asarray(now, jnp.float32)
+    n = base_ring.shape[0]
     live = elevations.active & (now_f <= elevations.expires_at)
-    idx = jnp.clip(elevations.agent, 0)
+    idx = elevations.agent - agent_base
+    on_shard = (elevations.agent >= 0) & (idx >= 0) & (idx < n)
     granted = jnp.where(
-        live & (elevations.agent >= 0),
-        elevations.granted_ring,
-        jnp.int8(3),
+        live & on_shard, elevations.granted_ring, jnp.int8(3)
     )
     best_grant = (
-        jnp.full(base_ring.shape, 3, jnp.int8).at[idx].min(granted)
+        jnp.full((n,), 3, jnp.int8)
+        .at[jnp.where(on_shard, idx, n)]
+        .min(granted, mode="drop")
     )
     return jnp.minimum(base_ring, best_grant).astype(jnp.int8)
 

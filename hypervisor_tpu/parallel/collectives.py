@@ -16,6 +16,7 @@ collectives here):
 from __future__ import annotations
 
 from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -635,6 +636,8 @@ def sharded_governance_wave(
     mesh: Mesh,
     trust: TrustConfig = DEFAULT_CONFIG.trust,
     rate=DEFAULT_CONFIG.rate_limit,
+    with_gateway: bool = False,
+    breach=DEFAULT_CONFIG.breach,
 ):
     """The FUSED full-governance wave, end-to-end sharded (round-3 item).
 
@@ -664,8 +667,18 @@ def sharded_governance_wave(
     (`sharded_admission`'s slot contract); wave session j is hashed on
     shard j // (K/D). Returns the same `WaveResult` as the single-device
     wave — `tests/parity/test_sharded_wave.py` pins bit-parity.
+
+    `with_gateway=True` appends phase 7: a per-action gateway wave
+    (`ops.gateway.check_actions` under the `sharded_gateway` placement
+    contract) over STANDING memberships — rows admitted by EARLIER
+    waves, not this wave's cohort — so admissions and action
+    enforcement ride one fused program. The step then takes
+    (..., elevations, act_slot, act_required, act_read_only,
+    act_consensus, act_witness, act_host_tripped, act_valid) and
+    returns (WaveResult, GatewayLanes).
     """
     from hypervisor_tpu.ops import saga_ops, session_fsm
+    from hypervisor_tpu.ops import gateway as gateway_ops
     from hypervisor_tpu.ops import merkle as merkle_ops
     from hypervisor_tpu.ops import terminate as terminate_ops
     from hypervisor_tpu.ops.pipeline import WaveResult
@@ -687,6 +700,7 @@ def sharded_governance_wave(
         delta_bodies,
         now,
         omega,
+        *gw_args,
     ):
         now_f = jnp.asarray(now, jnp.float32)
         s_cap = sessions.sid.shape[0]
@@ -773,7 +787,7 @@ def sharded_governance_wave(
             ),
         )
 
-        return WaveResult(
+        wave_result = WaveResult(
             agents=agents,
             sessions=sessions,
             vouches=vouches,
@@ -786,35 +800,196 @@ def sharded_governance_wave(
             fsm_error=err_a | err_t | err_z,
             released=released,
         )
+        if not with_gateway:
+            return wave_result
+
+        # ── 7. action gateway over standing memberships ───────────────
+        # Runs on the POST-terminate table, exactly like composing
+        # `run_governance_wave` then `check_actions_wave` on one device
+        # — but as phases of the same fused program. Shard-local under
+        # the gateway placement contract (no collective).
+        (elevations, act_slot, act_required, act_ro, act_cons, act_wit,
+         act_host, act_valid) = gw_args
+        rows_per_shard = agents.did.shape[0]
+        base = jax.lax.axis_index(AGENT_AXIS) * rows_per_shard
+        gw = gateway_ops.check_actions(
+            agents,
+            elevations,
+            act_slot,
+            act_required,
+            act_ro,
+            act_cons,
+            act_wit,
+            act_host,
+            now,
+            valid=act_valid,
+            agent_base=base,
+            breach=breach,
+            rate_limit=rate,
+            trust=trust,
+        )
+        return wave_result._replace(agents=gw.agents), _gateway_lanes(gw)
 
     lane = P(AGENT_AXIS)
     rep = P()
     # Pytree-prefix specs: one spec covers a whole table's columns (same
     # convention as sharded_admission above).
+    in_specs = (
+        lane,                   # agents: rows sharded
+        rep,                    # sessions: replicated
+        lane,                   # vouches: edges sharded
+        lane, lane, lane, lane, lane, lane,   # wave columns [B]
+        lane,                   # wave_sessions [K]
+        P(None, AGENT_AXIS, None),            # delta_bodies [T, K, W]
+        rep, rep,               # now, omega
+    )
+    wave_out = WaveResult(
+        agents=lane,
+        sessions=rep,
+        vouches=lane,
+        status=lane,
+        ring=lane,
+        sigma_eff=lane,
+        saga_step_state=lane,
+        merkle_root=lane,
+        chain=P(None, AGENT_AXIS, None),
+        fsm_error=lane,
+        released=rep,
+    )
+    if with_gateway:
+        in_specs = in_specs + (
+            rep,                               # elevations: replicated
+            lane, lane, lane, lane, lane, lane, lane,  # action columns
+        )
+        out_specs = (
+            wave_out,
+            GatewayLanes(
+                verdict=lane,
+                ring_status=lane,
+                eff_ring=lane,
+                sigma_eff=lane,
+                severity=lane,
+                anomaly_rate=lane,
+                window_calls=lane,
+                tripped=lane,
+            ),
+        )
+    else:
+        out_specs = wave_out
+    mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    return jax.jit(mapped)
+
+
+# ── sharded action gateway ───────────────────────────────────────────
+
+
+class GatewayLanes(NamedTuple):
+    """Per-action outputs of a sharded gateway wave ([B] lanes, sharded).
+
+    `ops.gateway.GatewayResult` minus the table (the table flows back
+    through the wave's own agents output)."""
+
+    verdict: jnp.ndarray       # i8[B]
+    ring_status: jnp.ndarray   # i8[B]
+    eff_ring: jnp.ndarray      # i8[B]
+    sigma_eff: jnp.ndarray     # f32[B]
+    severity: jnp.ndarray      # i8[B]
+    anomaly_rate: jnp.ndarray  # f32[B]
+    window_calls: jnp.ndarray  # i32[B]
+    tripped: jnp.ndarray       # bool[B]
+
+
+def _gateway_lanes(result) -> "GatewayLanes":
+    return GatewayLanes(
+        verdict=result.verdict,
+        ring_status=result.ring_status,
+        eff_ring=result.eff_ring,
+        sigma_eff=result.sigma_eff,
+        severity=result.severity,
+        anomaly_rate=result.anomaly_rate,
+        window_calls=result.window_calls,
+        tripped=result.tripped,
+    )
+
+
+def sharded_gateway(
+    mesh: Mesh,
+    breach=DEFAULT_CONFIG.breach,
+    rate=DEFAULT_CONFIG.rate_limit,
+    trust: TrustConfig = DEFAULT_CONFIG.trust,
+):
+    """The fused per-action gateway (`ops.gateway.check_actions`) as one
+    shard_map program: agent rows shard over the mesh agent axis, the
+    ElevationTable is replicated (each shard keeps the grants landing on
+    its rows; off-shard grants drop out of the scatter), and the action
+    wave shards over its own length.
+
+    Placement contract (same family as `sharded_admission`): action
+    element i's GLOBAL agent slot must live on shard i // (B/D). Because
+    the slot determines the shard, every action of one membership lands
+    on ONE shard, so the in-wave sequential dependences (breaker prefix,
+    rate ordinal settle) stay shard-local — the gateway needs NO
+    collective. Lanes that pad a ragged wave arrive `valid=False`
+    (`HypervisorState.check_actions_wave(mesh=...)` builds the layout).
+
+    Returns fn(agents, elevations, slot, required_ring, is_read_only,
+    has_consensus, has_sre_witness, host_tripped, valid, now) ->
+    (AgentTable, GatewayLanes).
+    """
+    from hypervisor_tpu.ops import gateway as gateway_ops
+
+    def step(
+        agents, elevations, slot, required_ring, is_read_only,
+        has_consensus, has_sre_witness, host_tripped, valid, now,
+    ):
+        rows_per_shard = agents.did.shape[0]
+        base = jax.lax.axis_index(AGENT_AXIS) * rows_per_shard
+        result = gateway_ops.check_actions(
+            agents,
+            elevations,
+            slot,
+            required_ring,
+            is_read_only,
+            has_consensus,
+            has_sre_witness,
+            host_tripped,
+            now,
+            valid=valid,
+            agent_base=base,
+            breach=breach,
+            rate_limit=rate,
+            trust=trust,
+        )
+        return result.agents, _gateway_lanes(result)
+
+    lane = P(AGENT_AXIS)
+    rep = P()
     mapped = shard_map(
         step,
         mesh=mesh,
         in_specs=(
             lane,                   # agents: rows sharded
-            rep,                    # sessions: replicated
-            lane,                   # vouches: edges sharded
-            lane, lane, lane, lane, lane, lane,   # wave columns [B]
-            lane,                   # wave_sessions [K]
-            P(None, AGENT_AXIS, None),            # delta_bodies [T, K, W]
-            rep, rep,               # now, omega
+            rep,                    # elevations: replicated
+            lane, lane, lane, lane, lane, lane, lane,  # action columns [B]
+            rep,                    # now
         ),
-        out_specs=WaveResult(
-            agents=lane,
-            sessions=rep,
-            vouches=lane,
-            status=lane,
-            ring=lane,
-            sigma_eff=lane,
-            saga_step_state=lane,
-            merkle_root=lane,
-            chain=P(None, AGENT_AXIS, None),
-            fsm_error=lane,
-            released=rep,
+        out_specs=(
+            lane,
+            GatewayLanes(
+                verdict=lane,
+                ring_status=lane,
+                eff_ring=lane,
+                sigma_eff=lane,
+                severity=lane,
+                anomaly_rate=lane,
+                window_calls=lane,
+                tripped=lane,
+            ),
         ),
     )
     return jax.jit(mapped)

@@ -276,6 +276,7 @@ class HypervisorState:
         trustworthy: Optional[np.ndarray] = None,
         use_pallas: bool | None = None,
         mesh=None,
+        actions: Optional[dict] = None,
     ):
         """Run the fused full-pipeline wave ON the state tables.
 
@@ -292,6 +293,15 @@ class HypervisorState:
         multi-chip" config on the real tables. B, K, and the agent
         capacity must divide the mesh size; sigma contributions,
         capacity ranking, and session folds ride ICI collectives.
+
+        `actions` appends the per-action gateway as one more phase: a
+        dict with `slots` (STANDING membership rows — not this wave's
+        cohort) plus optional `required_rings` / `is_read_only` /
+        `has_consensus` / `has_sre_witness` / `host_tripped` columns.
+        On a mesh the gateway fuses INTO the wave program
+        (`with_gateway`); single-device it composes behind it — both
+        orders identical (the gateway runs on the post-terminate
+        table). Returns (WaveResult, GatewayResult) instead.
         """
         b = len(dids)
         if mesh is not None:
@@ -344,8 +354,10 @@ class HypervisorState:
             now,
             omega,
         )
+        gw_result = None
         if mesh is not None:
-            wave_fn = self._sharded_waves.get(mesh)
+            with_gateway = actions is not None
+            wave_fn = self._sharded_waves.get((mesh, with_gateway))
             if wave_fn is None:
                 from hypervisor_tpu.parallel.collectives import (
                     sharded_governance_wave,
@@ -359,10 +371,25 @@ class HypervisorState:
                     mesh,
                     trust=self.config.trust,
                     rate=self.config.rate_limit,
+                    with_gateway=with_gateway,
+                    breach=self.config.breach,
                 )
-                self._sharded_waves[mesh] = wave_fn
-            with profiling.span("hv.governance_wave_sharded"):
-                result = wave_fn(*wave_args)
+                self._sharded_waves[(mesh, with_gateway)] = wave_fn
+            if with_gateway:
+                act = self._normalize_actions(actions)
+                flat, valid, device_args = self._gateway_shard_args(
+                    act, mesh.devices.size
+                )
+                with profiling.span("hv.governance_wave_sharded"):
+                    result, lanes = wave_fn(
+                        *wave_args, self.elevations, *device_args
+                    )
+                gw_result = self._scatter_gateway_lanes(
+                    lanes, flat, valid, len(act["slots"]), result.agents
+                )
+            else:
+                with profiling.span("hv.governance_wave_sharded"):
+                    result = wave_fn(*wave_args)
         else:
             with profiling.span("hv.governance_wave"):
                 result = _WAVE(
@@ -414,6 +441,19 @@ class HypervisorState:
                 )
                 self._turns[s] = self._turns.get(s, 0) + t
                 self._chain_seed[s] = chain[t - 1, i]
+        if actions is not None:
+            if gw_result is None:
+                # Single device: compose the gateway wave behind the
+                # committed governance wave (same order as the fused
+                # mesh program — gateway sees the post-terminate table).
+                act = self._normalize_actions(actions)
+                gw_result = self.check_actions_wave(
+                    act["slots"], act["required_rings"],
+                    act["is_read_only"], act["has_consensus"],
+                    act["has_sre_witness"], act["host_tripped"],
+                    now=now,
+                )
+            return result, gw_result
         return result
 
     def set_session_state(self, slot: int, state: SessionState) -> None:
@@ -1118,6 +1158,7 @@ class HypervisorState:
         has_sre_witness: Sequence[bool] | np.ndarray,
         host_tripped: Sequence[bool] | np.ndarray,
         now: float,
+        mesh=None,
     ) -> gateway_ops.GatewayResult:
         """Run B actions through the fused per-action gateway
         (`ops.gateway.check_actions`) and commit the post-state.
@@ -1132,7 +1173,22 @@ class HypervisorState:
         `valid=False` lanes (masked lanes touch nothing — pinned by
         `tests/parity/test_gateway_wave.py`), so XLA traces O(log max_B)
         programs instead of one per distinct batch size.
+
+        With `mesh`, the wave runs as ONE shard_map program with agent
+        rows sharded (`parallel.collectives.sharded_gateway`). The
+        caller's wave is RAGGED by nature — any slots, any order — so
+        this bridge builds the placement itself: actions group by
+        owning shard (slot // rows_per_shard), keep wave order inside
+        each group (all of one membership's actions share a shard, so
+        the sequential-settle semantics survive the shuffle), pad every
+        group to one power-of-two block length with `valid=False`
+        lanes, and scatter the lanes back to request order.
         """
+        if mesh is not None:
+            return self._check_actions_wave_sharded(
+                slots, required_rings, is_read_only, has_consensus,
+                has_sre_witness, host_tripped, now, mesh,
+            )
         b = len(np.asarray(slots, np.int32))
         padded = max(1, 1 << max(0, (b - 1).bit_length()))
 
@@ -1171,6 +1227,146 @@ class HypervisorState:
             window_calls=result.window_calls[:b],
             tripped=result.tripped[:b],
         )
+
+    @staticmethod
+    def _normalize_actions(actions: dict) -> dict:
+        """Fill a `run_governance_wave(actions=...)` dict's optional
+        columns: everything but `slots` defaults (required ring 2
+        standard writes, nothing read-only, no consensus/witness, no
+        host-plane breaker trips)."""
+        slots = np.asarray(actions["slots"], np.int32)
+        b = len(slots)
+
+        def col(key, dtype, default):
+            if key in actions and actions[key] is not None:
+                return np.asarray(actions[key], dtype)
+            return np.full((b,), default, dtype)
+
+        return {
+            "slots": slots,
+            "required_rings": col("required_rings", np.int8, 2),
+            "is_read_only": col("is_read_only", bool, False),
+            "has_consensus": col("has_consensus", bool, False),
+            "has_sre_witness": col("has_sre_witness", bool, False),
+            "host_tripped": col("host_tripped", bool, False),
+        }
+
+    def _scatter_gateway_lanes(
+        self, lanes, flat, valid, b, agents
+    ) -> gateway_ops.GatewayResult:
+        """Map sharded gateway lanes back to request order."""
+
+        def scatter(col):
+            arr = np.asarray(col)
+            out = np.zeros((b,), arr.dtype)
+            out[flat[valid]] = arr[valid]
+            return out
+
+        return gateway_ops.GatewayResult(
+            agents=agents,
+            verdict=scatter(lanes.verdict),
+            ring_status=scatter(lanes.ring_status),
+            eff_ring=scatter(lanes.eff_ring),
+            sigma_eff=scatter(lanes.sigma_eff),
+            severity=scatter(lanes.severity),
+            anomaly_rate=scatter(lanes.anomaly_rate),
+            window_calls=scatter(lanes.window_calls),
+            tripped=scatter(lanes.tripped),
+        )
+
+    def _gateway_shard_args(
+        self, act: dict, d: int
+    ) -> tuple[np.ndarray, np.ndarray, tuple]:
+        """The one host→device bridge for a sharded gateway wave: checks
+        the capacity contract, computes the shard layout, and gathers
+        every action column into its padded mesh lane. Returns
+        (flat_index, valid, device_args) where device_args are the 7
+        padded columns + the valid mask, in `sharded_gateway` order.
+        Shared by `check_actions_wave(mesh=...)` and
+        `run_governance_wave(actions=..., mesh=...)` so the two paths
+        cannot drift. Safe at B=0 (an all-padding wave is a no-op)."""
+        cap = self.agents.did.shape[0]
+        if cap % d:
+            raise ValueError(
+                f"agent capacity {cap} not divisible by mesh size {d}; "
+                "adjust config.capacity.max_agents"
+            )
+        flat, valid, safe = self._gateway_layout(act["slots"], d)
+
+        def gather(key, dtype):
+            arr = np.asarray(act[key], dtype)
+            vals = arr[safe] if len(arr) else np.zeros(len(safe), dtype)
+            return jnp.asarray(np.where(valid, vals, 0).astype(dtype))
+
+        device_args = (
+            gather("slots", np.int32),
+            gather("required_rings", np.int8),
+            gather("is_read_only", bool),
+            gather("has_consensus", bool),
+            gather("has_sre_witness", bool),
+            gather("host_tripped", bool),
+            jnp.asarray(valid),
+        )
+        return flat, valid, device_args
+
+    def _gateway_layout(
+        self, slots_arr: np.ndarray, d: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Shard placement for a ragged action wave: group by owning
+        shard (slot // rows_per_shard), wave order inside each group,
+        every group padded to one power-of-two block. Returns
+        (flat_index, valid, safe_index) where flat_index[j] is the
+        request position riding mesh lane j (-1 = padding)."""
+        rows_per_shard = self.agents.did.shape[0] // d
+        shard_of = slots_arr // rows_per_shard
+        groups: list[list[int]] = [[] for _ in range(d)]
+        for i, s in enumerate(shard_of):
+            groups[int(s)].append(i)
+        longest = max((len(g) for g in groups), default=0)
+        block = max(1, 1 << max(0, (max(1, longest) - 1).bit_length()))
+        idx = np.full((d, block), -1, np.int64)
+        for s, g in enumerate(groups):
+            idx[s, : len(g)] = g
+        flat = idx.reshape(-1)
+        valid = flat >= 0
+        return flat, valid, np.where(valid, flat, 0)
+
+    def _check_actions_wave_sharded(
+        self, slots, required_rings, is_read_only, has_consensus,
+        has_sre_witness, host_tripped, now, mesh,
+    ) -> gateway_ops.GatewayResult:
+        """Sharded gateway path: host-side layout, then one shard_map
+        program (see `check_actions_wave` docstring)."""
+        slots_arr = np.asarray(slots, np.int32)
+        b = len(slots_arr)
+        flat, valid, device_args = self._gateway_shard_args(
+            {
+                "slots": slots_arr,
+                "required_rings": required_rings,
+                "is_read_only": is_read_only,
+                "has_consensus": has_consensus,
+                "has_sre_witness": has_sre_witness,
+                "host_tripped": host_tripped,
+            },
+            mesh.devices.size,
+        )
+        fn = self._sharded_waves.get(("gateway", mesh))
+        if fn is None:
+            from hypervisor_tpu.parallel.collectives import sharded_gateway
+
+            fn = sharded_gateway(
+                mesh,
+                breach=self.config.breach,
+                rate=self.config.rate_limit,
+                trust=self.config.trust,
+            )
+            self._sharded_waves[("gateway", mesh)] = fn
+        with profiling.span("hv.gateway_wave_sharded"):
+            agents_out, lanes = fn(
+                self.agents, self.elevations, *device_args, now
+            )
+        self.agents = agents_out
+        return self._scatter_gateway_lanes(lanes, flat, valid, b, agents_out)
 
     def breach_sweep_tick(self, now: float) -> tuple[np.ndarray, np.ndarray]:
         """Run the batched breach analysis; returns (severity, tripped)."""

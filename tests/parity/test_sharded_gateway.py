@@ -1,0 +1,263 @@
+"""Sharded action-gateway wave ≡ single-device gateway wave, pinned.
+
+`parallel.collectives.sharded_gateway` runs `ops.gateway.check_actions`
+under shard_map with agent rows sharded and elevations replicated; the
+state bridge (`check_actions_wave(mesh=...)`) builds the shard layout
+itself from an arbitrary RAGGED request list — any slots, any counts,
+no caller-side padding. These tests pin the sharded path bit-for-bit
+against the single-device wave on identical tables, and the fused
+governance-wave-with-gateway program against the composed two-call
+sequence.
+
+Runs on the virtual 8-device CPU mesh (conftest forces the platform).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from hypervisor_tpu.config import DEFAULT_CONFIG, RateLimitConfig
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.ops import gateway as gw
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.parallel import make_mesh
+from hypervisor_tpu.state import HypervisorState
+from hypervisor_tpu.tables.state import FLAG_BREAKER_TRIPPED
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+N_DEV = 8
+N_AGENTS = 40    # rows 0..39 — shards 0..4 populated, 5..7 empty
+
+
+def _config(max_agents: int = 64):
+    return dataclasses.replace(
+        DEFAULT_CONFIG,
+        rate_limit=RateLimitConfig(ring_rates=(0.0, 0.0, 0.0, 0.0)),
+        capacity=dataclasses.replace(
+            DEFAULT_CONFIG.capacity, max_agents=max_agents
+        ),
+    )
+
+
+def _sigma(i: int) -> float:
+    if i in (7, 33):
+        return 0.97    # sudo-grant candidates (rings 1 need σ > 0.95)
+    if i == 13:
+        return 0.40    # Ring 3 sandbox
+    return 0.80        # Ring 2
+
+
+def _state(max_agents: int = 64) -> tuple[HypervisorState, int]:
+    """Deterministic world: 40 members across 5 shard regions (under
+    the default 64-row capacity), one quarantined row, one sudo grant,
+    one drained bucket. Every `now` is explicit so twin builds are
+    bit-identical."""
+    st = HypervisorState(_config(max_agents))
+    sess = st.create_session(
+        "sg:s0", SessionConfig(min_sigma_eff=0.0, max_participants=64)
+    )
+    for i in range(N_AGENTS):
+        st.enqueue_join(sess, f"did:g{i}", sigma_raw=_sigma(i))
+    assert (st.flush_joins(now=10.0) == 0).all()
+    st.quarantine_rows([21], now=10.0)          # shard 2
+    st.grant_elevation(7, granted_ring=1, now=10.0, ttl_seconds=900.0)
+    st.agents = t_replace(
+        st.agents, rl_tokens=st.agents.rl_tokens.at[30].set(1.4)  # shard 3
+    )
+    return st, sess
+
+
+# A ragged 15-action wave touching 4 shards: duplicate slots on the
+# drained bucket (sequential settle), privileged probes that trip row
+# 33's breaker mid-wave, a quarantined write + read, an elevated ring-1
+# action, and a sandboxed agent's refused write.
+#   columns: (slot, required_ring, read_only, consensus, witness)
+ACTIONS = [
+    (2, 2, False, False, False),    # shard 0: allowed write
+    (21, 2, False, False, False),   # shard 2: quarantined write -> refused
+    (21, 3, True, False, False),    # shard 2: quarantined read -> allowed
+    (33, 0, False, False, False),   # shard 4: privileged probe 1
+    (30, 3, True, False, False),    # shard 3: drain token 1 (of 1.4)
+    (33, 0, False, False, False),   # probe 2
+    (33, 0, False, False, False),   # probe 3
+    (13, 2, False, False, False),   # shard 1: ring 3 sandbox -> refused
+    (33, 0, False, False, False),   # probe 4
+    (30, 3, True, False, False),    # drain token 2 -> rate-refused (1.4)
+    (33, 0, False, False, False),   # probe 5 -> trips breaker
+    (33, 0, False, False, False),   # probe 6 -> breaker-refused
+    (7, 1, False, True, False),     # shard 0: sudo ring-1 action, allowed
+    (33, 3, True, False, False),    # breaker refuses benign reads
+    (2, 2, False, False, False),    # allowed write
+]
+
+
+def _cols():
+    a = np.asarray(ACTIONS, object)
+    return (
+        np.asarray([r[0] for r in ACTIONS], np.int32),
+        np.asarray([r[1] for r in ACTIONS], np.int8),
+        np.asarray([r[2] for r in ACTIONS], bool),
+        np.asarray([r[3] for r in ACTIONS], bool),
+        np.asarray([r[4] for r in ACTIONS], bool),
+        np.zeros(len(ACTIONS), bool),
+    )
+
+
+class TestShardedGateway:
+    def test_ragged_wave_matches_single_device_bitwise(self):
+        mesh = make_mesh(N_DEV, platform="cpu")
+        st1, _ = _state()
+        st2, _ = _state()
+        # Twin builds must start bit-identical (all nows explicit).
+        np.testing.assert_array_equal(
+            np.asarray(st1.agents.f32), np.asarray(st2.agents.f32)
+        )
+
+        slots, req, ro, cons, wit, ht = _cols()
+        r1 = st1.check_actions_wave(slots, req, ro, cons, wit, ht, now=20.0)
+        r2 = st2.check_actions_wave(
+            slots, req, ro, cons, wit, ht, now=20.0, mesh=mesh
+        )
+
+        for name in (
+            "verdict", "ring_status", "eff_ring", "sigma_eff",
+            "severity", "anomaly_rate", "window_calls", "tripped",
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(r1, name)),
+                np.asarray(getattr(r2, name)),
+                err_msg=name,
+            )
+
+        # The exact refusal story the wave was built to exercise.
+        kinds = [int(v) for v in np.asarray(r1.verdict)]
+        assert kinds == [
+            gw.GATE_ALLOWED, gw.GATE_QUARANTINED, gw.GATE_ALLOWED,
+            gw.GATE_RING, gw.GATE_ALLOWED, gw.GATE_RING, gw.GATE_RING,
+            gw.GATE_RING, gw.GATE_RING, gw.GATE_RATE, gw.GATE_RING,
+            gw.GATE_BREAKER, gw.GATE_ALLOWED, gw.GATE_BREAKER,
+            gw.GATE_ALLOWED,
+        ]
+
+        # Post-state tables agree bit-for-bit (one shared `now`, so
+        # even the restamped bucket columns match).
+        np.testing.assert_array_equal(
+            np.asarray(st1.agents.f32), np.asarray(st2.agents.f32)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(st1.agents.i32), np.asarray(st2.agents.i32)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(st1.agents.ring), np.asarray(st2.agents.ring)
+        )
+        # Row 33's breaker tripped on both planes' tables.
+        assert np.asarray(st2.agents.flags)[33] & FLAG_BREAKER_TRIPPED
+
+    def test_single_action_and_cross_shard_elevation(self):
+        """N=1 sharded waves work, and a grant whose agent lives on a
+        non-zero shard applies (the replicated ElevationTable localizes
+        by shard base row)."""
+        mesh = make_mesh(N_DEV, platform="cpu")
+        st1, _ = _state()
+        st2, _ = _state()
+        # Row 7's grant lives on shard 0; add one for row 33 (shard 4).
+        for st in (st1, st2):
+            st.grant_elevation(33, granted_ring=1, now=10.0,
+                               ttl_seconds=900.0)
+        one = (
+            np.asarray([33], np.int32), np.asarray([1], np.int8),
+            np.asarray([False]), np.asarray([True]), np.asarray([False]),
+            np.asarray([False]),
+        )
+        r1 = st1.check_actions_wave(*one, now=20.0)
+        r2 = st2.check_actions_wave(*one, now=20.0, mesh=mesh)
+        assert int(r1.verdict[0]) == int(r2.verdict[0]) == gw.GATE_ALLOWED
+        assert int(r1.eff_ring[0]) == int(r2.eff_ring[0]) == 1
+
+
+class TestShardedGatewayEdges:
+    def test_empty_wave_is_a_noop(self):
+        mesh = make_mesh(N_DEV, platform="cpu")
+        st, _ = _state()
+        before = np.asarray(st.agents.i32).copy()
+        empty = np.asarray([], np.int32)
+        r = st.check_actions_wave(
+            empty, empty, empty.astype(bool), empty.astype(bool),
+            empty.astype(bool), empty.astype(bool), now=20.0, mesh=mesh,
+        )
+        assert len(np.asarray(r.verdict)) == 0
+        np.testing.assert_array_equal(np.asarray(st.agents.i32), before)
+
+    def test_indivisible_capacity_refuses_clearly(self):
+        mesh = make_mesh(N_DEV, platform="cpu")
+        st, _ = _state(max_agents=60)  # 60 % 8 != 0
+        with pytest.raises(ValueError, match="not divisible"):
+            st.check_actions_wave(
+                [0], [2], [False], [False], [False], [False],
+                now=20.0, mesh=mesh,
+            )
+
+
+class TestFusedWaveWithGateway:
+    def test_fused_gateway_phase_matches_composed_calls(self):
+        """run_governance_wave(mesh=..., actions=...) — admissions,
+        terminations, AND standing-membership action checks as ONE
+        shard_map program — matches the composed wave-then-gateway
+        sequence on a single device."""
+        T, K, B = 2, 8, 16
+        mesh = make_mesh(N_DEV, platform="cpu")
+
+        def staged(st):
+            session_slots = st.create_sessions_batch(
+                [f"fw:s{i}" for i in range(K)],
+                SessionConfig(min_sigma_eff=0.0),
+            )
+            dids = [f"did:fw:{i}" for i in range(B)]
+            agent_sessions = np.array([i % K for i in range(B)], np.int32)
+            sigma = np.linspace(0.62, 0.95, B).astype(np.float32)
+            rng = np.random.RandomState(7)
+            bodies = rng.randint(
+                0, 2**32, size=(T, K, merkle_ops.BODY_WORDS), dtype=np.uint64
+            ).astype(np.uint32)
+            return session_slots, dids, agent_sessions, sigma, bodies
+
+        slots, req, ro, cons, wit, ht = _cols()
+        actions = dict(
+            slots=slots, required_rings=req, is_read_only=ro,
+            has_consensus=cons, has_sre_witness=wit, host_tripped=ht,
+        )
+
+        # Wave rows live at the top of each shard region; 512 rows keep
+        # the 40 standing members clear of them (they land on shard 0 —
+        # cross-shard action placement is the standalone test's job).
+        st1, _ = _state(max_agents=512)
+        res1, gw1 = st1.run_governance_wave(
+            *staged(st1), now=20.0, use_pallas=False, actions=actions
+        )
+        st2, _ = _state(max_agents=512)
+        res2, gw2 = st2.run_governance_wave(
+            *staged(st2), now=20.0, mesh=mesh, actions=actions
+        )
+
+        np.testing.assert_array_equal(
+            np.asarray(res1.status), np.asarray(res2.status)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res1.merkle_root), np.asarray(res2.merkle_root)
+        )
+        for name in ("verdict", "ring_status", "eff_ring", "tripped"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(gw1, name)),
+                np.asarray(getattr(gw2, name)),
+                err_msg=name,
+            )
+        # Standing rows live at the same slots on both paths, so their
+        # gateway columns agree bit-for-bit.
+        for st in (st1, st2):
+            assert np.asarray(st.agents.flags)[33] & FLAG_BREAKER_TRIPPED
+            assert int(np.asarray(st.agents.bd_calls)[33]) == 7

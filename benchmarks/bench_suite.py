@@ -285,6 +285,43 @@ def build_benchmarks(quick: bool):
         vouch10, sigma10, seeds10,
     ), n10
 
+    # ── action_gateway_10k: every per-action gate, one fused wave ──────
+    # 10k actions by 10k standing agents through breaker → quarantine →
+    # ring → rate → breach recording (`ops.gateway.check_actions`) —
+    # the wave the scalar reference path walks one gate-per-round-trip
+    # at a time. Duplicate slots (~spread 2x) exercise the sequential
+    # rate settle; a privileged-probe stripe exercises the in-wave
+    # breaker prefix.
+    from hypervisor_tpu.ops import gateway as gateway_ops
+    from hypervisor_tpu.tables.state import ElevationTable
+
+    n_gw = S
+    ag = AgentTable.create(n_gw)
+    ag = dataclasses.replace(
+        ag,
+        f32=ag.f32.at[:, 1].set(0.8).at[:, 4].set(40.0),  # sigma_eff, tokens
+        i32=ag.i32.at[:, 0].set(jnp.arange(n_gw, dtype=jnp.int32))
+        .at[:, 1].set(0),                                  # did, session
+        ring=jnp.full((n_gw,), 2, jnp.int8),
+    )
+    gw_slots = jnp.asarray(
+        rng.randint(0, n_gw, n_gw, dtype=np.int64), jnp.int32
+    )
+    gw_required = jnp.asarray(
+        np.where(rng.uniform(size=n_gw) < 0.1, 0, 2).astype(np.int8)
+    )
+    gw_false = jnp.zeros((n_gw,), bool)
+
+    def gateway_wave(a, elevs, slots, required, ro, cons, wit, ht):
+        return gateway_ops.check_actions(
+            a, elevs, slots, required, ro, cons, wit, ht, 1.0
+        ).verdict
+
+    yield "action_gateway_10k", jax.jit(gateway_wave), (
+        ag, ElevationTable.create(64), gw_slots, gw_required,
+        gw_false, gw_false, gw_false, gw_false,
+    ), n_gw
+
     # ── full_governance_pipeline (headline) ────────────────────────────
     t = 3
     bodies3 = jnp.asarray(

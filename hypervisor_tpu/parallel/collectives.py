@@ -177,10 +177,21 @@ def _wave_admission(
     n_shards,
     trust,
     rate=DEFAULT_CONFIG.rate_limit,
+    mode_dispatch: bool = False,
 ):
     """The cross-shard admission body (inside shard_map) shared by
     `sharded_admission` and `sharded_governance_wave` so the two can
-    never drift. See `sharded_admission` for the collective design."""
+    never drift. See `sharded_admission` for the collective design.
+
+    With `mode_dispatch`, the session `mode` column decides which
+    commit each admit delta rides: STRONG sessions' participant counts
+    fold into the replicated table IN-wave (psum barrier); EVENTUAL
+    sessions' counts return as per-shard partials for the caller's
+    between-wave `reconcile_wave_sessions` fold. The wave's own
+    dataflow (capacity ranks, activation checks) always sees the exact
+    global view — eventual consistency relaxes WHEN the replica
+    commits, never the transaction's internal arithmetic. Returns an
+    extra (view_counts [S_cap], ev_counts_local [S_cap]) pair."""
     b_local = slot.shape[0]
     rows_per_shard = agents.did.shape[0]
     my_shard = jax.lax.axis_index(AGENT_AXIS)
@@ -273,11 +284,31 @@ def _wave_admission(
     local_add = jnp.zeros((s_cap,), jnp.int32).at[
         jnp.clip(session_slot, 0)
     ].add(jnp.where(ok, 1, 0))
-    global_add = jax.lax.psum(local_add, AGENT_AXIS)
+    if not mode_dispatch:
+        global_add = jax.lax.psum(local_add, AGENT_AXIS)
+        sessions = t_replace(
+            sessions, n_participants=sessions.n_participants + global_add
+        )
+        return agents, sessions, status, ring, sigma_eff
+    # Mode-dispatched commit: one psum carries both the full view (the
+    # wave's internal arithmetic) and the STRONG-only slice (the replica
+    # commit); the difference is the EVENTUAL partial this shard hands
+    # back for the between-wave reconcile.
+    strong_elem = sessions.mode[jnp.clip(session_slot, 0)] == 0  # STRONG
+    local_strong = jnp.zeros((s_cap,), jnp.int32).at[
+        jnp.clip(session_slot, 0)
+    ].add(jnp.where(ok & strong_elem, 1, 0))
+    both = jax.lax.psum(jnp.stack([local_add, local_strong]), AGENT_AXIS)
+    view_add, strong_add = both[0], both[1]
+    view_counts = sessions.n_participants + view_add
     sessions = t_replace(
-        sessions, n_participants=sessions.n_participants + global_add
+        sessions, n_participants=sessions.n_participants + strong_add
     )
-    return agents, sessions, status, ring, sigma_eff
+    ev_counts_local = local_add - local_strong
+    return (
+        agents, sessions, status, ring, sigma_eff,
+        view_counts, ev_counts_local,
+    )
 
 
 
@@ -638,6 +669,7 @@ def sharded_governance_wave(
     rate=DEFAULT_CONFIG.rate_limit,
     with_gateway: bool = False,
     breach=DEFAULT_CONFIG.breach,
+    mode_dispatch: bool = False,
 ):
     """The FUSED full-governance wave, end-to-end sharded (round-3 item).
 
@@ -676,6 +708,19 @@ def sharded_governance_wave(
     (..., elevations, act_slot, act_required, act_read_only,
     act_consensus, act_witness, act_host_tripped, act_valid) and
     returns (WaveResult, GatewayLanes).
+
+    `mode_dispatch=True` EXECUTES the session `mode` column
+    (`models.py:12-16` — the flag the reference stores but never acts
+    on): STRONG sessions' replica updates (participant counts, FSM
+    state, terminated_at) fold in-wave over the psum barrier as before;
+    EVENTUAL sessions' updates come back as per-shard partials in an
+    `EventualPartials`, folded between waves by
+    `reconcile_wave_sessions` — after which the table is bit-identical
+    to the all-STRONG wave (pinned by `tests/parity/test_mode_wave.py`).
+    The wave's internal dataflow (capacity ranks, has-members checks)
+    always sees the exact global view; eventual consistency defers the
+    replica COMMIT, not the transaction's arithmetic. Appended LAST in
+    the return tuple when enabled.
     """
     from hypervisor_tpu.ops import saga_ops, session_fsm
     from hypervisor_tpu.ops import gateway as gateway_ops
@@ -706,17 +751,22 @@ def sharded_governance_wave(
         s_cap = sessions.sid.shape[0]
 
         # ── 1-2. cross-shard vouched admission ────────────────────────
-        agents, sessions, status, ring, sigma_eff = _wave_admission(
+        admitted = _wave_admission(
             agents, sessions, vouches, slot, did, session_slot,
             sigma_raw, trustworthy, duplicate, now, omega, n_shards, trust,
-            rate,
+            rate, mode_dispatch=mode_dispatch,
         )
+        agents, sessions, status, ring, sigma_eff = admitted[:5]
+        if mode_dispatch:
+            view_counts, ev_counts_local = admitted[5:]
+        else:
+            view_counts = sessions.n_participants
         ok = status == admission_ops.ADMIT_OK
 
         # ── 3. FSM walk on this shard's wave lanes ────────────────────
         ws = wave_sessions                       # i32[K/D] local lanes
         state_before = sessions.state[ws]
-        has_members = sessions.n_participants[ws] > 0
+        has_members = view_counts[ws] > 0
         wave_state, err_a = session_fsm.apply_session_transitions(
             state_before, jnp.int8(SessionState.ACTIVE.code), has_members
         )
@@ -762,21 +812,37 @@ def sharded_governance_wave(
         # wave session lives on exactly ONE shard, so a psum of masked
         # scatters reconstructs the full update bit-exactly on every
         # replica (a delta-sum would drift in f32 when old values are
-        # nonzero; the mask keeps it an exact overwrite).
-        owned = jnp.zeros((s_cap,), jnp.int32).at[jnp.clip(ws, 0)].add(1)
-        owned = jax.lax.psum(owned, AGENT_AXIS) > 0
-        state_val = (
-            jnp.zeros((s_cap,), jnp.int32)
-            .at[jnp.clip(ws, 0)]
-            .add(wave_state.astype(jnp.int32))
-        )
-        state_val = jax.lax.psum(state_val, AGENT_AXIS)
-        term_val = (
-            jnp.zeros((s_cap,), jnp.float32)
-            .at[jnp.clip(ws, 0)]
-            .add(jnp.where(has_members, now_f, sessions.terminated_at[ws]))
-        )
-        term_val = jax.lax.psum(term_val, AGENT_AXIS)
+        # nonzero; the mask keeps it an exact overwrite). Under mode
+        # dispatch only STRONG lanes ride the in-wave fold; EVENTUAL
+        # lanes' overwrites return as per-shard partials.
+        if mode_dispatch:
+            strong_lane = sessions.mode[jnp.clip(ws, 0)] == 0
+        else:
+            strong_lane = jnp.ones(ws.shape, bool)
+        lane_term = jnp.where(has_members, now_f, sessions.terminated_at[ws])
+
+        def lane_fold(mask):
+            owned_m = (
+                jnp.zeros((s_cap,), jnp.int32)
+                .at[jnp.clip(ws, 0)]
+                .add(jnp.where(mask, 1, 0))
+            )
+            state_m = (
+                jnp.zeros((s_cap,), jnp.int32)
+                .at[jnp.clip(ws, 0)]
+                .add(jnp.where(mask, wave_state.astype(jnp.int32), 0))
+            )
+            term_m = (
+                jnp.zeros((s_cap,), jnp.float32)
+                .at[jnp.clip(ws, 0)]
+                .add(jnp.where(mask, lane_term, 0.0))
+            )
+            return owned_m, state_m, term_m
+
+        owned_s, state_s, term_s = lane_fold(strong_lane)
+        owned = jax.lax.psum(owned_s, AGENT_AXIS) > 0
+        state_val = jax.lax.psum(state_s, AGENT_AXIS)
+        term_val = jax.lax.psum(term_s, AGENT_AXIS)
         sessions = t_replace(
             sessions,
             state=jnp.where(
@@ -786,6 +852,14 @@ def sharded_governance_wave(
                 owned, term_val, sessions.terminated_at
             ),
         )
+        if mode_dispatch:
+            owned_e, state_e, term_e = lane_fold(~strong_lane)
+            partials = EventualPartials(
+                counts=ev_counts_local[None],
+                owned=owned_e[None],
+                state=state_e[None],
+                terminated=term_e[None],
+            )
 
         wave_result = WaveResult(
             agents=agents,
@@ -800,35 +874,39 @@ def sharded_governance_wave(
             fsm_error=err_a | err_t | err_z,
             released=released,
         )
-        if not with_gateway:
-            return wave_result
-
-        # ── 7. action gateway over standing memberships ───────────────
-        # Runs on the POST-terminate table, exactly like composing
-        # `run_governance_wave` then `check_actions_wave` on one device
-        # — but as phases of the same fused program. Shard-local under
-        # the gateway placement contract (no collective).
-        (elevations, act_slot, act_required, act_ro, act_cons, act_wit,
-         act_host, act_valid) = gw_args
-        rows_per_shard = agents.did.shape[0]
-        base = jax.lax.axis_index(AGENT_AXIS) * rows_per_shard
-        gw = gateway_ops.check_actions(
-            agents,
-            elevations,
-            act_slot,
-            act_required,
-            act_ro,
-            act_cons,
-            act_wit,
-            act_host,
-            now,
-            valid=act_valid,
-            agent_base=base,
-            breach=breach,
-            rate_limit=rate,
-            trust=trust,
-        )
-        return wave_result._replace(agents=gw.agents), _gateway_lanes(gw)
+        if with_gateway:
+            # ── 7. action gateway over standing memberships ───────────
+            # Runs on the POST-terminate table, exactly like composing
+            # `run_governance_wave` then `check_actions_wave` on one
+            # device — but as phases of the same fused program. Shard-
+            # local under the gateway placement contract (no collective).
+            (elevations, act_slot, act_required, act_ro, act_cons,
+             act_wit, act_host, act_valid) = gw_args
+            rows_per_shard = agents.did.shape[0]
+            base = jax.lax.axis_index(AGENT_AXIS) * rows_per_shard
+            gw = gateway_ops.check_actions(
+                agents,
+                elevations,
+                act_slot,
+                act_required,
+                act_ro,
+                act_cons,
+                act_wit,
+                act_host,
+                now,
+                valid=act_valid,
+                agent_base=base,
+                breach=breach,
+                rate_limit=rate,
+                trust=trust,
+            )
+            wave_result = wave_result._replace(agents=gw.agents)
+            if mode_dispatch:
+                return wave_result, _gateway_lanes(gw), partials
+            return wave_result, _gateway_lanes(gw)
+        if mode_dispatch:
+            return wave_result, partials
+        return wave_result
 
     lane = P(AGENT_AXIS)
     rep = P()
@@ -856,24 +934,35 @@ def sharded_governance_wave(
         fsm_error=lane,
         released=rep,
     )
+    partial_rows = P(AGENT_AXIS, None)         # [D, S_cap] shard partials
+    partials_spec = EventualPartials(
+        counts=partial_rows,
+        owned=partial_rows,
+        state=partial_rows,
+        terminated=partial_rows,
+    )
     if with_gateway:
         in_specs = in_specs + (
             rep,                               # elevations: replicated
             lane, lane, lane, lane, lane, lane, lane,  # action columns
         )
-        out_specs = (
-            wave_out,
-            GatewayLanes(
-                verdict=lane,
-                ring_status=lane,
-                eff_ring=lane,
-                sigma_eff=lane,
-                severity=lane,
-                anomaly_rate=lane,
-                window_calls=lane,
-                tripped=lane,
-            ),
+        gw_spec = GatewayLanes(
+            verdict=lane,
+            ring_status=lane,
+            eff_ring=lane,
+            sigma_eff=lane,
+            severity=lane,
+            anomaly_rate=lane,
+            window_calls=lane,
+            tripped=lane,
         )
+        out_specs = (
+            (wave_out, gw_spec, partials_spec)
+            if mode_dispatch
+            else (wave_out, gw_spec)
+        )
+    elif mode_dispatch:
+        out_specs = (wave_out, partials_spec)
     else:
         out_specs = wave_out
     mapped = shard_map(
@@ -883,6 +972,65 @@ def sharded_governance_wave(
         out_specs=out_specs,
     )
     return jax.jit(mapped)
+
+
+# ── eventual-mode wave partials ──────────────────────────────────────
+
+
+class EventualPartials(NamedTuple):
+    """EVENTUAL sessions' deferred replica updates from one mode-
+    dispatched governance wave: per-shard [D, S_cap] partials, folded
+    between waves by `reconcile_wave_sessions`. Each wave session lives
+    on exactly one shard, so the cross-shard sum of masked overwrites
+    reconstructs the exact update (same trick as the in-wave STRONG
+    fold)."""
+
+    counts: jnp.ndarray      # i32[D, S_cap] participant-count deltas
+    owned: jnp.ndarray       # i32[D, S_cap] >0 where this shard owns the lane
+    state: jnp.ndarray       # i32[D, S_cap] masked FSM-state overwrites
+    terminated: jnp.ndarray  # f32[D, S_cap] masked terminated_at overwrites
+
+
+def reconcile_wave_sessions(mesh: Mesh):
+    """Fold accumulated `EventualPartials` into the replicated
+    SessionTable — the between-wave EVENTUAL commit. After this fold the
+    table is bit-identical to what the all-STRONG wave would have
+    committed in-wave (`tests/parity/test_mode_wave.py`).
+
+    Returns fn(sessions, counts [D, S], owned [D, S], state [D, S],
+    terminated [D, S]) -> sessions; partial rows are sharded over the
+    mesh. Fold ONE wave's partials per call: `state`/`terminated` are
+    masked OVERWRITES, and summing two waves that own the same recycled
+    session lane would corrupt both (only `counts` is delta-summable
+    across waves the way `reconcile_sessions` rows are) — the state
+    bridge loops pending waves in order (`reconcile_session_partials`).
+    """
+
+    def merge(sessions, counts, owned, state, terminated):
+        total_counts = jax.lax.psum(jnp.sum(counts, axis=0), AGENT_AXIS)
+        owned_g = jax.lax.psum(jnp.sum(owned, axis=0), AGENT_AXIS) > 0
+        state_g = jax.lax.psum(jnp.sum(state, axis=0), AGENT_AXIS)
+        term_g = jax.lax.psum(jnp.sum(terminated, axis=0), AGENT_AXIS)
+        return t_replace(
+            sessions,
+            n_participants=sessions.n_participants + total_counts,
+            state=jnp.where(
+                owned_g, state_g, sessions.state.astype(jnp.int32)
+            ).astype(jnp.int8),
+            terminated_at=jnp.where(
+                owned_g, term_g, sessions.terminated_at
+            ),
+        )
+
+    rows = P(AGENT_AXIS, None)
+    return jax.jit(
+        shard_map(
+            merge,
+            mesh=mesh,
+            in_specs=(P(), rows, rows, rows, rows),
+            out_specs=P(),
+        )
+    )
 
 
 # ── sharded action gateway ───────────────────────────────────────────

@@ -150,6 +150,9 @@ class HypervisorState:
         self._terminate = _TERMINATE
         # Compiled sharded governance waves, keyed by Mesh.
         self._sharded_waves: dict = {}
+        # Accumulated EVENTUAL-mode wave partials awaiting reconcile
+        # (list of EventualPartials, D rows per wave).
+        self._pending_partials: list = []
 
     def now(self) -> float:
         """Seconds since this state's epoch — the f32-safe device time."""
@@ -277,6 +280,7 @@ class HypervisorState:
         use_pallas: bool | None = None,
         mesh=None,
         actions: Optional[dict] = None,
+        defer_reconcile: bool = False,
     ):
         """Run the fused full-pipeline wave ON the state tables.
 
@@ -302,6 +306,15 @@ class HypervisorState:
         (`with_gateway`); single-device it composes behind it — both
         orders identical (the gateway runs on the post-terminate
         table). Returns (WaveResult, GatewayResult) instead.
+
+        The mesh wave EXECUTES each session's consistency mode
+        (`mode_dispatch`): STRONG sessions' replica updates commit
+        in-wave over the psum barrier; EVENTUAL sessions' updates come
+        back as per-shard partials. By default the bridge folds them
+        immediately after the wave (`reconcile_wave_sessions` — a
+        separate between-tick program, so the deferred-commit path is
+        what always runs); `defer_reconcile=True` accumulates them on
+        the state instead, until `reconcile_session_partials(mesh)`.
         """
         b = len(dids)
         if mesh is not None:
@@ -366,13 +379,15 @@ class HypervisorState:
                 # Build with THIS state's configs, not module defaults:
                 # the sharded path must admit with the same bursts as
                 # the single-device path or rate decisions diverge by
-                # deployment mode.
+                # deployment mode. The bridge always mode-dispatches —
+                # the session mode column EXECUTES here.
                 wave_fn = sharded_governance_wave(
                     mesh,
                     trust=self.config.trust,
                     rate=self.config.rate_limit,
                     with_gateway=with_gateway,
                     breach=self.config.breach,
+                    mode_dispatch=True,
                 )
                 self._sharded_waves[(mesh, with_gateway)] = wave_fn
             if with_gateway:
@@ -381,7 +396,7 @@ class HypervisorState:
                     act, mesh.devices.size
                 )
                 with profiling.span("hv.governance_wave_sharded"):
-                    result, lanes = wave_fn(
+                    result, lanes, partials = wave_fn(
                         *wave_args, self.elevations, *device_args
                     )
                 gw_result = self._scatter_gateway_lanes(
@@ -389,7 +404,7 @@ class HypervisorState:
                 )
             else:
                 with profiling.span("hv.governance_wave_sharded"):
-                    result = wave_fn(*wave_args)
+                    result, partials = wave_fn(*wave_args)
         else:
             with profiling.span("hv.governance_wave"):
                 result = _WAVE(
@@ -400,6 +415,20 @@ class HypervisorState:
         self.agents = result.agents
         self.sessions = result.sessions
         self.vouches = result.vouches
+        if mesh is not None:
+            if defer_reconcile:
+                self._stash_session_partials(partials)
+            else:
+                # Fold the EVENTUAL commits right behind the wave (the
+                # reconcile is its own program — the deferred path is
+                # exercised on every wave, not just mixed-mode runs).
+                # The partials stay on device: no host round-trip on
+                # the hot bridge path.
+                with profiling.span("hv.reconcile_wave_sessions"):
+                    self.sessions = self._reconcile_fn(mesh)(
+                        self.sessions, partials.counts, partials.owned,
+                        partials.state, partials.terminated,
+                    )
 
         ok = np.asarray(result.status) == admission.ADMIT_OK
         for s, h, slot, is_ok in zip(agent_sessions, handles, agent_slots, ok):
@@ -1227,6 +1256,45 @@ class HypervisorState:
             window_calls=result.window_calls[:b],
             tripped=result.tripped[:b],
         )
+
+    def _reconcile_fn(self, mesh):
+        fn = self._sharded_waves.get(("reconcile", mesh))
+        if fn is None:
+            from hypervisor_tpu.parallel.collectives import (
+                reconcile_wave_sessions,
+            )
+
+            fn = reconcile_wave_sessions(mesh)
+            self._sharded_waves[("reconcile", mesh)] = fn
+        return fn
+
+    def _stash_session_partials(self, partials) -> None:
+        """Queue one wave's EVENTUAL partials for the between-wave fold
+        (host copies: deferred partials may outlive many device steps)."""
+        self._pending_partials.append(
+            jax.tree.map(np.asarray, partials)
+        )
+
+    def reconcile_session_partials(self, mesh) -> int:
+        """Fold every pending wave's EVENTUAL session updates into the
+        replicated SessionTable (`collectives.reconcile_wave_sessions`)
+        — the between-wave commit that makes a mixed-mode history
+        bit-identical to the all-STRONG one. Returns the number of wave
+        partial-sets folded (0 = nothing pending, no dispatch)."""
+        if not self._pending_partials:
+            return 0
+        n = len(self._pending_partials)
+        fn = self._reconcile_fn(mesh)
+        pending, self._pending_partials = self._pending_partials, []
+        with profiling.span("hv.reconcile_wave_sessions"):
+            # One fold per wave, in wave order: masked overwrites from
+            # different waves may target the SAME recycled session lane,
+            # and summing two overwrites would corrupt both.
+            for p in pending:
+                self.sessions = fn(
+                    self.sessions, p.counts, p.owned, p.state, p.terminated
+                )
+        return n
 
     @staticmethod
     def _normalize_actions(actions: dict) -> dict:

@@ -1,0 +1,202 @@
+"""Mode-dispatched governance wave: mixed STRONG/EVENTUAL + reconcile
+≡ the all-STRONG wave, on the REAL tables.
+
+Round-3 executed the consistency mode in the lane-level `mode_tick`;
+this pins the same convergence property on the fused sharded wave
+(`sharded_governance_wave(mode_dispatch=True)`): EVENTUAL sessions'
+replica updates (participant counts, FSM state, terminated_at) come
+back as per-shard `EventualPartials` and the replicated SessionTable
+does NOT see them in-wave; after `reconcile_wave_sessions` folds them,
+the table is bit-identical to the wave that committed everything under
+the STRONG psum barrier. Reference anchor: the `ConsistencyMode` flag
+the reference stores but never executes (`models.py:12-16`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.models import ConsistencyMode, SessionConfig, SessionState
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.parallel import make_mesh
+from hypervisor_tpu.parallel.collectives import (
+    reconcile_wave_sessions,
+    sharded_governance_wave,
+)
+from hypervisor_tpu.state import HypervisorState
+from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+N_DEV = 8
+B = 16          # joining agents (2 per shard)
+K = 8           # wave sessions (1 per shard); odd lanes EVENTUAL
+T = 2
+ROWS = 8        # agent rows per shard
+
+
+def _tables(modes: np.ndarray):
+    agents = AgentTable.create(N_DEV * ROWS)
+    sessions = SessionTable.create(2 * K)
+    ws = jnp.arange(K)
+    sessions = t_replace(
+        sessions,
+        state=sessions.state.at[ws].set(
+            jnp.int8(SessionState.HANDSHAKING.code)
+        ),
+        mode=sessions.mode.at[: 2 * K].set(jnp.asarray(modes, jnp.int8)),
+        max_participants=sessions.max_participants.at[ws].set(10),
+        min_sigma_eff=sessions.min_sigma_eff.at[ws].set(0.6),
+    )
+    return agents, sessions, VouchTable.create(N_DEV * 4)
+
+
+def _wave_args(rng):
+    slots = np.array(
+        [(i // 2) * ROWS + (i % 2) for i in range(B)], np.int32
+    )
+    sess = np.array([i // 2 for i in range(B)], np.int32)
+    bodies = rng.randint(
+        0, 2**32, size=(T, K, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    return (
+        jnp.asarray(slots),
+        jnp.arange(B, dtype=jnp.int32),
+        jnp.asarray(sess),
+        jnp.full((B,), 0.8, jnp.float32),
+        jnp.ones((B,), bool),
+        jnp.zeros((B,), bool),
+        jnp.asarray(np.arange(K, dtype=np.int32)),
+        jnp.asarray(bodies),
+        7.5,
+        0.5,
+    )
+
+
+class TestModeDispatchedWave:
+    def test_mixed_plus_reconcile_equals_all_strong(self):
+        mesh = make_mesh(N_DEV, platform="cpu")
+        rng = np.random.RandomState(11)
+        args = _wave_args(rng)
+
+        mixed_modes = np.array(
+            [i % 2 for i in range(2 * K)], np.int8  # odd lanes EVENTUAL
+        )
+        strong_modes = np.zeros(2 * K, np.int8)
+
+        wave = sharded_governance_wave(mesh, mode_dispatch=True)
+
+        res_s, part_s = wave(*_tables(strong_modes), *args)
+        res_m, part_m = wave(*_tables(mixed_modes), *args)
+
+        # The per-lane outcomes (admission, audit, archive walk) are
+        # mode-independent — consistency changes WHEN the replica
+        # commits, never the transaction's arithmetic.
+        np.testing.assert_array_equal(
+            np.asarray(res_m.status), np.asarray(res_s.status)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_m.merkle_root), np.asarray(res_s.merkle_root)
+        )
+        assert int(np.asarray(res_m.released)) == int(
+            np.asarray(res_s.released)
+        )
+
+        # All-STRONG: no partials, table fully committed in-wave.
+        assert (np.asarray(part_s.counts) == 0).all()
+        assert (np.asarray(part_s.owned) == 0).all()
+        arch = np.asarray(res_s.sessions.state)[:K]
+        assert (arch == SessionState.ARCHIVED.code).all()
+
+        # Mixed, PRE-reconcile: EVENTUAL lanes' replica rows are stale —
+        # still HANDSHAKING, zero participants, no terminated_at.
+        m_state = np.asarray(res_m.sessions.state)[:K]
+        m_counts = np.asarray(res_m.sessions.n_participants)[:K]
+        ev = mixed_modes[:K] == 1
+        assert (m_state[~ev] == SessionState.ARCHIVED.code).all()
+        assert (m_state[ev] == SessionState.HANDSHAKING.code).all()
+        assert (m_counts[ev] == 0).all()
+        assert (np.asarray(part_m.counts).sum(axis=0)[:K][ev] > 0).all()
+
+        # Mixed + reconcile == all-STRONG, bit for bit, every column.
+        folded = reconcile_wave_sessions(mesh)(
+            res_m.sessions, part_m.counts, part_m.owned, part_m.state,
+            part_m.terminated,
+        )
+        for col in (
+            "sid", "state", "mode", "n_participants", "terminated_at",
+            "created_at", "max_participants", "min_sigma_eff",
+        ):
+            got = np.asarray(getattr(folded, col))
+            want = np.asarray(getattr(res_s.sessions, col))
+            if col == "mode":
+                # The mode column itself legitimately differs (it IS the
+                # experiment variable); everything else must match.
+                continue
+            np.testing.assert_array_equal(got, want, err_msg=col)
+
+    def test_bridge_defers_and_folds_on_demand(self):
+        """`run_governance_wave(mesh=..., defer_reconcile=True)` leaves
+        EVENTUAL sessions' replica rows stale until
+        `reconcile_session_partials` folds them — and the default path
+        (auto-reconcile) lands the identical end state."""
+        mesh = make_mesh(N_DEV, platform="cpu")
+        cfg = dataclasses.replace(
+            DEFAULT_CONFIG,
+            capacity=dataclasses.replace(
+                DEFAULT_CONFIG.capacity, max_agents=N_DEV * 16
+            ),
+        )
+
+        def staged(st):
+            session_slots = st.create_sessions_batch(
+                [f"md:s{i}" for i in range(K)],
+                SessionConfig(
+                    min_sigma_eff=0.0,
+                    consistency_mode=ConsistencyMode.EVENTUAL,
+                ),
+            )
+            # Even lanes forced STRONG: a genuinely mixed wave.
+            for s in session_slots[::2]:
+                st.force_session_mode(
+                    int(s), ConsistencyMode.STRONG, has_nonreversible=False
+                )
+            dids = [f"did:md:{i}" for i in range(B)]
+            agent_sessions = np.array([i % K for i in range(B)], np.int32)
+            sigma = np.linspace(0.62, 0.95, B).astype(np.float32)
+            rng = np.random.RandomState(3)
+            bodies = rng.randint(
+                0, 2**32, size=(T, K, merkle_ops.BODY_WORDS),
+                dtype=np.uint64,
+            ).astype(np.uint32)
+            return session_slots, dids, agent_sessions, sigma, bodies
+
+        st_defer = HypervisorState(cfg)
+        slots_d = staged(st_defer)
+        st_defer.run_governance_wave(
+            *slots_d, now=2.0, mesh=mesh, defer_reconcile=True
+        )
+        ev_lanes = np.asarray(slots_d[0])[1::2]
+        stale = np.asarray(st_defer.sessions.state)[ev_lanes]
+        assert (stale == SessionState.HANDSHAKING.code).all()
+        assert st_defer.reconcile_session_partials(mesh) == 1
+        fresh = np.asarray(st_defer.sessions.state)[ev_lanes]
+        assert (fresh == SessionState.ARCHIVED.code).all()
+
+        st_auto = HypervisorState(cfg)
+        st_auto.run_governance_wave(*staged(st_auto), now=2.0, mesh=mesh)
+        np.testing.assert_array_equal(
+            np.asarray(st_auto.sessions.state),
+            np.asarray(st_defer.sessions.state),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(st_auto.sessions.n_participants),
+            np.asarray(st_defer.sessions.n_participants),
+        )
+        assert st_auto.reconcile_session_partials(mesh) == 0

@@ -294,9 +294,12 @@ class HypervisorState:
         as ONE shard_map program with Agent rows + Vouch edges sharded
         and the SessionTable replicated (`parallel.collectives.
         sharded_governance_wave`) — BASELINE's "10k concurrent sessions
-        multi-chip" config on the real tables. B, K, and the agent
-        capacity must divide the mesh size; sigma contributions,
-        capacity ranking, and session folds ride ICI collectives.
+        multi-chip" config on the real tables. Waves are RAGGED: any B
+        and K round up to the mesh size internally (refused join lanes /
+        parked session lanes), so callers never pad or place; only the
+        table capacities (agents, vouch edges) must divide the mesh
+        size. Sigma contributions, capacity ranking, and session folds
+        ride ICI collectives.
 
         `actions` appends the per-action gateway as one more phase: a
         dict with `slots` (STANDING membership rows — not this wave's
@@ -317,20 +320,40 @@ class HypervisorState:
         the state instead, until `reconcile_session_partials(mesh)`.
         """
         b = len(dids)
+        k = len(session_slots)
+        b_wave, k_wave = b, k
+        parked_sessions = np.zeros((0,), np.int32)
         if mesh is not None:
             d = mesh.devices.size
-            k = len(session_slots)
             e_cap = self.vouches.voucher.shape[0]
-            if k % d:
-                raise ValueError(
-                    f"wave session count {k} not divisible by mesh size {d}"
-                )
             if e_cap % d:
                 raise ValueError(
                     f"vouch-edge capacity {e_cap} not divisible by mesh "
                     f"size {d}; adjust config.capacity.max_vouch_edges"
                 )
-            agent_slots = self._mesh_wave_slots(b, d)
+            # Ragged waves pad INTERNALLY (round-4 item): B and K round
+            # up to the mesh size; padded join lanes carry duplicate=True
+            # so admission refuses them without touching their parked
+            # rows, and padded session lanes point at unallocated rows
+            # whose no-member walk is a masked no-op. The caller never
+            # pads or places.
+            b_wave = -(-b // d) * d
+            k_wave = -(-k // d) * d
+            agent_slots = self._mesh_wave_slots(b_wave, d)
+            if k_wave != k:
+                s_cap = self.sessions.sid.shape[0]
+                n_parked = k_wave - k
+                if self._next_session_slot + n_parked > s_cap:
+                    raise RuntimeError(
+                        f"no spare session rows to park {n_parked} ragged "
+                        f"wave lanes ({self._next_session_slot}+{n_parked} "
+                        f"> {s_cap}); raise config.capacity.max_sessions"
+                    )
+                parked_sessions = np.arange(
+                    self._next_session_slot,
+                    self._next_session_slot + n_parked,
+                    dtype=np.int32,
+                )
         else:
             if self._next_agent_slot + b > self.agents.did.shape[0]:
                 raise RuntimeError(
@@ -352,18 +375,34 @@ class HypervisorState:
         if trustworthy is None:
             trustworthy = np.ones(b, bool)
 
+        def pad_b(arr, dtype, fill):
+            out = np.full((b_wave,), fill, dtype)
+            out[:b] = np.asarray(arr, dtype)
+            return out
+
+        wave_sessions = np.concatenate(
+            [np.asarray(session_slots, np.int32), parked_sessions]
+        )
+        bodies = np.asarray(delta_bodies)
+        if k_wave != k:
+            padded_bodies = np.zeros(
+                (bodies.shape[0], k_wave) + bodies.shape[2:], bodies.dtype
+            )
+            padded_bodies[:, :k] = bodies
+            bodies = padded_bodies
+
         wave_args = (
             self.agents,
             self.sessions,
             self.vouches,
             jnp.asarray(agent_slots),
-            jnp.asarray(handles),
-            jnp.asarray(np.asarray(agent_sessions, np.int32)),
-            jnp.asarray(np.asarray(sigma_raw, np.float32)),
-            jnp.asarray(trustworthy),
-            jnp.asarray(duplicate),
-            jnp.asarray(np.asarray(session_slots, np.int32)),
-            jnp.asarray(delta_bodies),
+            jnp.asarray(pad_b(handles, np.int32, -1)),
+            jnp.asarray(pad_b(agent_sessions, np.int32, 0)),
+            jnp.asarray(pad_b(sigma_raw, np.float32, 0.0)),
+            jnp.asarray(pad_b(trustworthy, bool, True)),
+            jnp.asarray(pad_b(duplicate, bool, True)),
+            jnp.asarray(wave_sessions),
+            jnp.asarray(bodies),
             now,
             omega,
         )
@@ -405,6 +444,18 @@ class HypervisorState:
             else:
                 with profiling.span("hv.governance_wave_sharded"):
                     result, partials = wave_fn(*wave_args)
+            if b_wave != b or k_wave != k:
+                # Drop the internal padding lanes before any host
+                # bookkeeping: callers see exactly their request shape.
+                result = result._replace(
+                    status=result.status[:b],
+                    ring=result.ring[:b],
+                    sigma_eff=result.sigma_eff[:b],
+                    saga_step_state=result.saga_step_state[:b],
+                    merkle_root=result.merkle_root[:k],
+                    chain=result.chain[:, :k],
+                    fsm_error=result.fsm_error[:k],
+                )
         else:
             with profiling.span("hv.governance_wave"):
                 result = _WAVE(
@@ -1213,6 +1264,7 @@ class HypervisorState:
         group to one power-of-two block length with `valid=False`
         lanes, and scatter the lanes back to request order.
         """
+        self._check_action_slots(slots)
         if mesh is not None:
             return self._check_actions_wave_sharded(
                 slots, required_rings, is_read_only, has_consensus,
@@ -1256,6 +1308,21 @@ class HypervisorState:
             window_calls=result.window_calls[:b],
             tripped=result.tripped[:b],
         )
+
+    def _check_action_slots(self, slots) -> None:
+        """Refuse out-of-range action slots LOUDLY on every path: the
+        device program would otherwise clamp them onto an unrelated
+        agent's row — recording calls, draining its bucket, maybe
+        tripping its breaker — and the mesh layout would place the lane
+        on a different wrong shard (-1 is the codebase's free-slot
+        sentinel, so it must never reach a wave silently)."""
+        arr = np.asarray(slots, np.int32)
+        cap = self.agents.did.shape[0]
+        if len(arr) and (arr.min() < 0 or arr.max() >= cap):
+            bad = arr[(arr < 0) | (arr >= cap)]
+            raise ValueError(
+                f"action slots out of range [0, {cap}): {bad[:8].tolist()}"
+            )
 
     def _reconcile_fn(self, mesh):
         fn = self._sharded_waves.get(("reconcile", mesh))
@@ -1353,6 +1420,7 @@ class HypervisorState:
         Shared by `check_actions_wave(mesh=...)` and
         `run_governance_wave(actions=..., mesh=...)` so the two paths
         cannot drift. Safe at B=0 (an all-padding wave is a no-op)."""
+        self._check_action_slots(act["slots"])
         cap = self.agents.did.shape[0]
         if cap % d:
             raise ValueError(

@@ -1,0 +1,133 @@
+"""Ragged sharded governance waves: no divisibility, no caller padding.
+
+Round-3's sharded wave demanded B % D == 0, K % D == 0 and caller-side
+slot placement; the bridge now pads internally — refused join lanes
+(duplicate=True touches nothing) and parked session lanes (unallocated
+rows whose no-member walk is a masked no-op) round any request up to
+the mesh size. These tests run the VERDICT-prescribed shape (13 joins,
+5 sessions on 8 shards) and pin the mesh path against single-device
+semantics, plus the parked rows staying untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.models import SessionConfig, SessionState
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.parallel import make_mesh
+from hypervisor_tpu.state import HypervisorState
+
+N_DEV = 8
+B = 13          # not divisible by 8
+K = 5           # not divisible by 8
+T = 3
+
+
+def _config():
+    return dataclasses.replace(
+        DEFAULT_CONFIG,
+        capacity=dataclasses.replace(
+            DEFAULT_CONFIG.capacity, max_agents=N_DEV * 16
+        ),
+    )
+
+
+def _staged(st):
+    session_slots = st.create_sessions_batch(
+        [f"rg:s{i}" for i in range(K)], SessionConfig(min_sigma_eff=0.0)
+    )
+    dids = [f"did:rg:{i}" for i in range(B)]
+    agent_sessions = np.array([i % K for i in range(B)], np.int32)
+    sigma = np.linspace(0.58, 0.95, B).astype(np.float32)
+    rng = np.random.RandomState(9)
+    bodies = rng.randint(
+        0, 2**32, size=(T, K, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    return session_slots, dids, agent_sessions, sigma, bodies
+
+
+class TestRaggedWave:
+    def test_13_joins_5_sessions_on_8_shards(self):
+        mesh = make_mesh(N_DEV, platform="cpu")
+
+        st_single = HypervisorState(_config())
+        res_s = st_single.run_governance_wave(
+            *_staged(st_single), now=2.0, use_pallas=False
+        )
+        st_mesh = HypervisorState(_config())
+        res_m = st_mesh.run_governance_wave(
+            *_staged(st_mesh), now=2.0, mesh=mesh
+        )
+
+        # Caller-shaped outputs, identical semantics on both paths.
+        assert np.asarray(res_m.status).shape == (B,)
+        assert np.asarray(res_m.merkle_root).shape[0] == K
+        np.testing.assert_array_equal(
+            np.asarray(res_m.status), np.asarray(res_s.status)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_m.ring), np.asarray(res_s.ring)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_m.chain), np.asarray(res_s.chain)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_m.merkle_root), np.asarray(res_s.merkle_root)
+        )
+
+        # Both worlds agree afterwards: archived sessions, memberships,
+        # participant counts, audit index.
+        for st in (st_single, st_mesh):
+            state_col = np.asarray(st.sessions.state)[:K]
+            assert (state_col == SessionState.ARCHIVED.code).all()
+            for i in range(B):
+                assert st.is_member(i % K, f"did:rg:{i}")
+            for s in range(K):
+                assert len(st._audit_rows[s]) == T
+        np.testing.assert_array_equal(
+            np.asarray(st_mesh.sessions.n_participants),
+            np.asarray(st_single.sessions.n_participants),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(st_mesh.delta_log.digest),
+            np.asarray(st_single.delta_log.digest),
+        )
+
+        # Parked session rows (the K..K_pad internal lanes) stayed
+        # untouched: still unallocated, zero participants, CREATED.
+        parked = np.arange(K, -(-K // N_DEV) * N_DEV)
+        assert (np.asarray(st_mesh.sessions.sid)[parked] == -1).all()
+        assert (
+            np.asarray(st_mesh.sessions.n_participants)[parked] == 0
+        ).all()
+        assert (np.asarray(st_mesh.sessions.state)[parked] == 0).all()
+        # Padded join lanes' parked agent rows stayed free.
+        assert (np.asarray(st_mesh.agents.did) >= 0).sum() == B
+
+    def test_single_join_single_session(self):
+        """The extreme ragged case: B=1, K=1 on 8 shards."""
+        mesh = make_mesh(N_DEV, platform="cpu")
+        st = HypervisorState(_config())
+        slots = st.create_sessions_batch(
+            ["rg1:s"], SessionConfig(min_sigma_eff=0.0)
+        )
+        rng = np.random.RandomState(2)
+        bodies = rng.randint(
+            0, 2**32, size=(T, 1, merkle_ops.BODY_WORDS), dtype=np.uint64
+        ).astype(np.uint32)
+        res = st.run_governance_wave(
+            slots, ["did:rg1"], np.zeros(1, np.int32),
+            np.asarray([0.8], np.float32), bodies, now=2.0, mesh=mesh,
+        )
+        assert np.asarray(res.status).tolist() == [0]
+        assert int(np.asarray(st.sessions.state)[0]) == (
+            SessionState.ARCHIVED.code
+        )
+        assert st.is_member(0, "did:rg1")

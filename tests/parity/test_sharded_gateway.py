@@ -97,7 +97,6 @@ ACTIONS = [
 
 
 def _cols():
-    a = np.asarray(ACTIONS, object)
     return (
         np.asarray([r[0] for r in ACTIONS], np.int32),
         np.asarray([r[1] for r in ACTIONS], np.int8),

@@ -214,6 +214,11 @@ class Hypervisor:
         sso.begin_handshake()
         slot = self.state.create_session(sso.session_id, config)
         managed = ManagedSession(sso, slot=slot, state=self.state)
+        # Saga steps pass the live isolation gates before executing: a
+        # mid-saga quarantine or breaker trip refuses the NEXT step on
+        # both planes (the reference exports the gates but never
+        # consults them on the saga path).
+        managed.saga.gate = self._saga_gate(managed)
         self._sessions[sso.session_id] = managed
         self._emit(
             EventType.SESSION_CREATED, session_id=sso.session_id, agent_did=creator_did
@@ -813,6 +818,33 @@ class Hypervisor:
                 )
             results.append(result)
         return results
+
+    def _saga_gate(self, managed):
+        """Build the per-step isolation gate for a session's saga
+        orchestrator: quarantine (read-only isolation) and the circuit
+        breaker, consulted on BOTH planes before each step executes.
+
+        Scope is deliberately gates 1–2 of `check_action`: the saga's
+        steps were ring-authorized when the saga was defined; quarantine
+        and breaker trips are the LIVE state changes that must interrupt
+        an in-flight saga. Action-classified steps can still route
+        through the full gateway via `check_action` explicitly.
+        """
+        session_id = managed.sso.session_id
+
+        async def gate(step):
+            if self.breach_detector.is_breaker_tripped(
+                step.agent_did, session_id
+            ):
+                return "circuit breaker tripped (breach cooldown)"
+            row = self.state.agent_row(step.agent_did, managed.slot)
+            if row is None:
+                # No device row (e.g. a step assigned to an external
+                # agent): nothing to gate, matching reference behavior.
+                return None
+            return self.state.isolation_refusal(row["slot"])
+
+        return gate
 
     # ── causal fault attribution -> ledger ───────────────────────────
 

@@ -36,6 +36,7 @@ class SagaScheduler:
         self._execute: dict[tuple[int, int], Executor] = {}
         self._undo: dict[tuple[int, int], Executor] = {}
         self._attempts: dict[tuple[int, int], int] = {}
+        self._agent_of: dict[tuple[int, int], int] = {}
         self.results: dict[tuple[int, int], Any] = {}
         self.errors: dict[tuple[int, int], str] = {}
 
@@ -45,10 +46,21 @@ class SagaScheduler:
         step_idx: int,
         execute: Executor,
         undo: Optional[Executor] = None,
+        agent_slot: Optional[int] = None,
     ) -> None:
+        """Wire one step's executors; `agent_slot` names the acting
+        membership's device row and arms the isolation gate: before
+        each FORWARD dispatch the scheduler consults
+        `HypervisorState.isolation_refusal` — a quarantined or
+        breaker-tripped agent's step fails without its executor ever
+        running (compensations still run: an isolated agent's committed
+        side effects must remain undoable). Steps registered without an
+        agent row run ungated, like the reference's orchestrator."""
         self._execute[(saga_slot, step_idx)] = execute
         if undo is not None:
             self._undo[(saga_slot, step_idx)] = undo
+        if agent_slot is not None:
+            self._agent_of[(saga_slot, step_idx)] = agent_slot
 
     def register_definition(
         self,
@@ -56,19 +68,25 @@ class SagaScheduler:
         definition,
         executors: dict[str, Executor],
         undos: Optional[dict[str, Executor]] = None,
+        agent_slots: Optional[dict[str, int]] = None,
     ) -> None:
         """Wire a parsed SagaDefinition's steps to executors by step id.
 
         Pairs with `HypervisorState.create_saga_from_dsl`: the DSL
         declares the topology, the caller supplies callables keyed by the
-        DSL step ids.
+        DSL step ids (`agent_slots` optionally maps each step's declared
+        agent to its device row, arming the isolation gate).
         """
         undos = undos or {}
+        agent_slots = agent_slots or {}
         for idx, step in enumerate(definition.steps):
             execute = executors.get(step.id)
             if execute is None:
                 raise KeyError(f"no executor for DSL step '{step.id}'")
-            self.register(saga_slot, idx, execute, undo=undos.get(step.id))
+            self.register(
+                saga_slot, idx, execute, undo=undos.get(step.id),
+                agent_slot=agent_slots.get(step.id),
+            )
 
     def reassign(
         self,
@@ -77,6 +95,7 @@ class SagaScheduler:
         execute: Executor,
         undo: Optional[Executor] = None,
         retries: Optional[int] = None,
+        agent_slot: Optional[int] = None,
     ) -> None:
         """Hand a step to a substitute executor (kill-switch handoff).
 
@@ -87,6 +106,9 @@ class SagaScheduler:
         to `retries` when given, and a step the victim already drove to
         FAILED is rearmed to PENDING while its saga still runs — the
         handoff-then-continue semantics of `security/kill_switch.py`.
+        The VICTIM's isolation-gate binding is dropped too (its
+        quarantine/breaker state must not gate the substitute); pass
+        `agent_slot` to arm the gate on the substitute's own row.
         """
         import jax.numpy as jnp
 
@@ -94,7 +116,10 @@ class SagaScheduler:
         from hypervisor_tpu.tables.struct import replace
 
         key = (saga_slot, step_idx)
-        self.register(saga_slot, step_idx, execute, undo=undo)
+        self._agent_of.pop(key, None)
+        self.register(
+            saga_slot, step_idx, execute, undo=undo, agent_slot=agent_slot
+        )
         if undo is None:
             self._undo.pop(key, None)
         self._attempts.pop(key, None)
@@ -182,17 +207,20 @@ class SagaScheduler:
             execute, compensate = state.saga_work()
             branches = state.fanout_dispatch()
             timeouts = np.asarray(state.sagas.timeout)
+            # One isolation snapshot per round (columns only change
+            # between rounds via saga_round): no per-step device sync.
+            gate = state.isolation_gate() if self._agent_of else None
 
             exec_res, branch_res, undo_res = await asyncio.gather(
                 asyncio.gather(
                     *(
-                        self._attempt(self._execute.get((slot, idx)), slot, idx, timeouts)
+                        self._attempt(self._execute.get((slot, idx)), slot, idx, timeouts, gate=gate)
                         for slot, idx in execute
                     )
                 ),
                 asyncio.gather(
                     *(
-                        self._attempt(self._execute.get((slot, idx)), slot, idx, timeouts)
+                        self._attempt(self._execute.get((slot, idx)), slot, idx, timeouts, gate=gate)
                         for slot, idx in branches
                     )
                 ),
@@ -218,6 +246,7 @@ class SagaScheduler:
         idx: int,
         timeouts,
         undo: bool = False,
+        gate=None,
     ) -> bool:
         """Run one executor under its timeout; outcomes are data."""
         key = (slot, idx)
@@ -227,6 +256,15 @@ class SagaScheduler:
             # no registered executor is a wiring error surfaced as failure.
             self.errors[key] = "No undo API" if undo else "No executor"
             return False
+        if gate is not None and key in self._agent_of:
+            # Isolation gate: a mid-saga quarantine or breaker trip
+            # refuses the step before its executor runs — the refusal
+            # is a step failure the device retry ladder and
+            # compensation path then handle normally.
+            refusal = gate(self._agent_of[key])
+            if refusal is not None:
+                self.errors[key] = refusal
+                return False
         attempt = self._attempts.get(key, 0)
         if attempt and not undo:
             # Linear backoff between retries (`orchestrator.py:135-137`).

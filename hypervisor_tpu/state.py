@@ -43,6 +43,7 @@ from hypervisor_tpu.tables.state import (
     AgentTable,
     ElevationTable,
     FLAG_ACTIVE,
+    FLAG_BREAKER_TRIPPED,
     FLAG_QUARANTINED,
     SagaTable,
     SessionTable,
@@ -69,6 +70,21 @@ _GATEWAY = jax.jit(
     gateway_ops.check_actions,
     static_argnames=("breach", "rate_limit", "trust"),
 )
+
+
+def _isolation_refusal_from(
+    flags: int, breaker_until: float, now: float
+) -> Optional[str]:
+    """The isolation-gate rule on scalar column values (shared by the
+    per-slot and snapshot forms): only LIVE rows gate; quarantine wins
+    over the breaker, mirroring the gateway's gate order."""
+    if not flags & FLAG_ACTIVE:
+        return None
+    if flags & FLAG_QUARANTINED:
+        return "agent is quarantined (read-only isolation)"
+    if flags & FLAG_BREAKER_TRIPPED and now < breaker_until:
+        return "circuit breaker tripped (breach cooldown)"
+    return None
 
 
 class HypervisorState:
@@ -1624,6 +1640,40 @@ class HypervisorState:
         sweep = _QUAR_SWEEP(self.agents, now)
         self.agents = sweep.agents
         return [int(r) for r in np.nonzero(np.asarray(sweep.released))[0]]
+
+    def isolation_refusal(
+        self, agent_slot: int, now: Optional[float] = None
+    ) -> Optional[str]:
+        """Device-plane isolation gates for one agent row: a refusal
+        reason when the LIVE row is quarantined or its circuit breaker
+        is holding, else None. A retired row (FLAG_ACTIVE clear — the
+        agent left or was killed; terminate keeps its forensic flags)
+        gates nothing, matching the host plane's departed-agent
+        behavior; otherwise a recycled slot would gate steps on the
+        wrong agent's history."""
+        return _isolation_refusal_from(
+            int(np.asarray(self.agents.flags)[agent_slot]),
+            float(np.asarray(self.agents.bd_breaker_until)[agent_slot]),
+            self.now() if now is None else now,
+        )
+
+    def isolation_gate(self):
+        """One-snapshot bulk form of `isolation_refusal`: reads the flag
+        and breaker columns ONCE and returns a per-slot callable — the
+        saga scheduler gates every step of a dispatch round against it
+        instead of paying a device→host sync per step
+        (`runtime.saga_scheduler.run_until_settled`). Valid for one
+        round: state only changes between rounds via `saga_round`."""
+        flags = np.asarray(self.agents.flags)
+        until = np.asarray(self.agents.bd_breaker_until)
+        now = self.now()
+
+        def refusal(agent_slot: int) -> Optional[str]:
+            return _isolation_refusal_from(
+                int(flags[agent_slot]), float(until[agent_slot]), now
+            )
+
+        return refusal
 
     def quarantined_mask(self) -> np.ndarray:
         """bool[N]: rows currently in read-only isolation."""

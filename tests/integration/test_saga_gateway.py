@@ -1,0 +1,209 @@
+"""Saga steps pass the isolation gates: mid-saga quarantine/breaker
+refuses the NEXT step, on both planes.
+
+The reference ships quarantine isolation and the circuit breaker but
+never consults them on the saga path — a quarantined agent's in-flight
+saga keeps executing (`saga/orchestrator.py:104-143` has no gate). Here
+the facade wires every ManagedSession's orchestrator with the live
+gates (`Hypervisor._saga_gate`), and the device scheduler consults
+`HypervisorState.isolation_refusal` for steps registered with their
+acting agent's row.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu import Hypervisor, SessionConfig
+from hypervisor_tpu.saga.orchestrator import SagaGateRefused
+from hypervisor_tpu.saga.state_machine import StepState
+
+
+class TestHostPlaneSagaGate:
+    async def test_mid_saga_quarantine_refuses_next_step(self):
+        from hypervisor_tpu.liability.quarantine import QuarantineReason
+
+        hv = Hypervisor()
+        ms = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        sid = ms.sso.session_id
+        await hv.join_session(sid, "did:worker", sigma_raw=0.8)
+
+        saga = ms.saga.create_saga(sid)
+        s1 = ms.saga.add_step(
+            saga.saga_id, action_id="a1", agent_did="did:worker",
+            execute_api="/x", undo_api="/u",
+        )
+        s2 = ms.saga.add_step(
+            saga.saga_id, action_id="a2", agent_did="did:worker",
+            execute_api="/x", undo_api="/u",
+        )
+
+        ran = []
+
+        async def ok():
+            ran.append("ran")
+            return "ok"
+
+        await ms.saga.execute_step(saga.saga_id, s1.step_id, ok)
+        assert ran == ["ran"]
+
+        # Quarantine mid-saga, both planes (the facade's quarantine path).
+        row = hv.state.agent_row("did:worker", ms.slot)
+        hv.quarantine.quarantine(
+            "did:worker", sid, QuarantineReason.MANUAL, details="hold"
+        )
+        hv.state.quarantine_rows([row["slot"]], now=hv.state.now())
+
+        with pytest.raises(SagaGateRefused, match="quarantined"):
+            await ms.saga.execute_step(saga.saga_id, s2.step_id, ok)
+        assert ran == ["ran"], "refused step's executor must never run"
+        assert s2.state is StepState.FAILED
+        assert "quarantined" in s2.error
+
+    async def test_tripped_breaker_refuses_step(self):
+        from hypervisor_tpu.models import ActionDescriptor, ReversibilityLevel
+
+        hv = Hypervisor()
+        ms = await hv.create_session(
+            SessionConfig(min_sigma_eff=0.0), creator_did="did:lead"
+        )
+        sid = ms.sso.session_id
+        await hv.join_session(sid, "did:prober", sigma_raw=0.7)
+
+        # Trip the breaker by privileged probing through the gateway.
+        admin = ActionDescriptor(
+            action_id="adm", name="a", execute_api="/x", undo_api=None,
+            is_admin=True, reversibility=ReversibilityLevel.NONE,
+        )
+        for _ in range(8):
+            await hv.check_action(sid, "did:prober", admin)
+        assert hv.breach_detector.is_breaker_tripped("did:prober", sid)
+
+        saga = ms.saga.create_saga(sid)
+        s1 = ms.saga.add_step(
+            saga.saga_id, action_id="a1", agent_did="did:prober",
+            execute_api="/x", undo_api="/u",
+        )
+
+        async def ok():
+            return "ok"
+
+        with pytest.raises(SagaGateRefused, match="breaker"):
+            await ms.saga.execute_step(saga.saga_id, s1.step_id, ok)
+
+
+class TestDevicePlaneSagaGate:
+    def test_mid_saga_quarantine_fails_step_and_compensates(self):
+        from hypervisor_tpu.ops import saga_ops
+        from hypervisor_tpu.runtime.saga_scheduler import SagaScheduler
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        sess = st.create_session("sg:dev", SessionConfig(min_sigma_eff=0.0))
+        st.enqueue_join(sess, "did:dev", sigma_raw=0.8)
+        assert (st.flush_joins(now=1.0) == 0).all()
+        agent_slot = 0
+
+        g = st.create_saga(
+            "saga:gated", sess,
+            [{"has_undo": True}, {"has_undo": True}],
+        )
+        sched = SagaScheduler(st, retry_backoff_seconds=0.0)
+        ran = []
+
+        async def step0():
+            # Quarantine the acting agent DURING step 0: step 1 must
+            # refuse at dispatch, executor never running.
+            ran.append(0)
+            st.quarantine_rows([agent_slot], now=st.now())
+            return "ok"
+
+        async def step1():
+            ran.append(1)
+            return "ok"
+
+        async def undo():
+            return "undone"
+
+        sched.register(g, 0, step0, undo=undo, agent_slot=agent_slot)
+        sched.register(g, 1, step1, undo=undo, agent_slot=agent_slot)
+        asyncio.run(sched.run_until_settled())
+
+        assert ran == [0], "quarantined step's executor must never run"
+        assert "quarantined" in sched.errors[(g, 1)]
+        states = np.asarray(st.sagas.step_state)[g]
+        # Step 1 failed at the gate; step 0's committed work compensated
+        # (the undo RUNS for the isolated agent — its side effects must
+        # remain undoable).
+        assert states[1] == saga_ops.STEP_FAILED
+        assert states[0] == saga_ops.STEP_COMPENSATED
+        # Clean compensation settles the saga (the device plane's
+        # terminal for a fully-compensated run).
+        assert int(np.asarray(st.sagas.saga_state)[g]) == (
+            saga_ops.SAGA_COMPLETED
+        )
+
+    def test_handoff_drops_victim_gate_binding(self):
+        """A kill-switch style reassign must not gate the substitute on
+        the VICTIM's quarantine: the binding clears on reassign (and can
+        re-arm on the substitute's own row)."""
+        from hypervisor_tpu.ops import saga_ops
+        from hypervisor_tpu.runtime.saga_scheduler import SagaScheduler
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        sess = st.create_session("sg:ho", SessionConfig(min_sigma_eff=0.0))
+        st.enqueue_join(sess, "did:victim", sigma_raw=0.8)
+        st.enqueue_join(sess, "did:sub", sigma_raw=0.8)
+        assert (st.flush_joins(now=1.0) == 0).all()
+        victim_slot, sub_slot = 0, 1
+
+        g = st.create_saga("saga:handoff", sess, [{"has_undo": True}])
+        sched = SagaScheduler(st, retry_backoff_seconds=0.0)
+        ran = []
+
+        async def victim_exec():
+            ran.append("victim")
+            return "ok"
+
+        async def sub_exec():
+            ran.append("sub")
+            return "ok"
+
+        sched.register(g, 0, victim_exec, agent_slot=victim_slot)
+        # Victim quarantined BEFORE the saga runs; its step hands off.
+        st.quarantine_rows([victim_slot], now=st.now())
+        sched.reassign(g, 0, sub_exec, agent_slot=sub_slot)
+        asyncio.run(sched.run_until_settled())
+
+        assert ran == ["sub"], ran
+        states = np.asarray(st.sagas.step_state)[g]
+        assert states[0] == saga_ops.STEP_COMMITTED
+
+    def test_ungated_registration_unchanged(self):
+        from hypervisor_tpu.ops import saga_ops
+        from hypervisor_tpu.runtime.saga_scheduler import SagaScheduler
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        sess = st.create_session("sg:un", SessionConfig(min_sigma_eff=0.0))
+        st.enqueue_join(sess, "did:un", sigma_raw=0.8)
+        assert (st.flush_joins(now=1.0) == 0).all()
+        st.quarantine_rows([0], now=st.now())
+
+        g = st.create_saga("saga:ungated", sess, [{"has_undo": False}])
+        sched = SagaScheduler(st, retry_backoff_seconds=0.0)
+
+        async def ok():
+            return "ok"
+
+        # No agent_slot: runs ungated (reference behavior preserved).
+        sched.register(g, 0, ok)
+        asyncio.run(sched.run_until_settled())
+        states = np.asarray(st.sagas.step_state)[g]
+        assert states[0] == saga_ops.STEP_COMMITTED

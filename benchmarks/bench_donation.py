@@ -1,0 +1,127 @@
+"""Buffer donation for the wave's table arguments: before/after.
+
+The fused governance wave reads AND rewrites the whole Agent/Session/
+Vouch tables each dispatch; without donation XLA materialises a second
+copy of every column per wave. `donate_argnums=(0, 1, 2)` lets the
+outputs alias the input buffers (in-place HBM update) under the
+re-staging contract documented at `state._WAVE_DONATED`.
+
+Both loops CHAIN the tables through iterations (each wave's outputs are
+the next wave's inputs) — exactly the state bridge's usage, and the
+only legal usage once buffers are donated.
+
+Run on the real chip for the committed number; the CPU run is the
+methodology check (CPU donation support varies by jax version, so a
+null CPU result does not reject the optimisation).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--agents", type=int, default=10_000)
+    ap.add_argument(
+        "--cpu", action="store_true",
+        help="force the hermetic CPU platform (skip the accelerator)",
+    )
+    args = ap.parse_args()
+    if args.cpu:
+        from _jax_platform import force_cpu_platform
+
+        force_cpu_platform(1)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hypervisor_tpu.models import SessionState
+    from hypervisor_tpu.ops import merkle as merkle_ops
+    from hypervisor_tpu.ops.pipeline import governance_wave
+    from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+    from hypervisor_tpu.tables.struct import replace as t_replace
+
+    n = args.agents
+    b = k = 1024 if n >= 10_000 else max(8, n // 8)
+    t = 3
+    use_pallas = jax.default_backend() == "tpu"
+    rng = np.random.RandomState(0)
+
+    def fresh_tables():
+        sessions = SessionTable.create(2 * k)
+        ws = jnp.arange(k)
+        sessions = t_replace(
+            sessions,
+            state=sessions.state.at[ws].set(
+                jnp.int8(SessionState.HANDSHAKING.code)
+            ),
+            max_participants=sessions.max_participants.at[ws].set(8),
+            min_sigma_eff=sessions.min_sigma_eff.at[ws].set(0.0),
+        )
+        return AgentTable.create(n), sessions, VouchTable.create(4096)
+
+    bodies = jnp.asarray(
+        rng.randint(0, 2**32, (t, k, merkle_ops.BODY_WORDS), dtype=np.uint64
+                    ).astype(np.uint32)
+    )
+    cols = (
+        jnp.arange(b, dtype=jnp.int32),             # slot
+        jnp.arange(b, dtype=jnp.int32),             # did
+        jnp.arange(b, dtype=jnp.int32) % k,         # session_slot
+        jnp.full((b,), 0.8, jnp.float32),
+        jnp.ones((b,), bool),
+        jnp.zeros((b,), bool),
+        jnp.arange(k, dtype=jnp.int32),             # wave_sessions
+        bodies,
+        0.0,
+    )
+
+    def run(donate: bool) -> float:
+        fn = jax.jit(
+            governance_wave,
+            static_argnames=("use_pallas",),
+            donate_argnums=(0, 1, 2) if donate else (),
+        )
+        agents, sessions, vouches = fresh_tables()
+        out = fn(agents, sessions, vouches, *cols, use_pallas=use_pallas)
+        jax.block_until_ready(out.status)
+        agents, sessions, vouches = out.agents, out.sessions, out.vouches
+        times = []
+        for _ in range(args.iters):
+            t0 = time.perf_counter_ns()
+            out = fn(agents, sessions, vouches, *cols, use_pallas=use_pallas)
+            jax.block_until_ready(out.status)
+            times.append(time.perf_counter_ns() - t0)
+            agents, sessions, vouches = out.agents, out.sessions, out.vouches
+        times.sort()
+        return times[len(times) // 2] / 1e6
+
+    base = run(donate=False)
+    donated = run(donate=True)
+    backend = jax.default_backend()
+    print(
+        f"governance_wave {n} agents / {b} joins ({backend}): "
+        f"p50 no-donate={base:.3f} ms, donate={donated:.3f} ms, "
+        f"delta={100 * (base - donated) / base:+.1f}%"
+    )
+    import json
+
+    print(json.dumps({
+        "metric": "wave_table_donation",
+        "backend": backend,
+        "p50_ms_no_donate": round(base, 4),
+        "p50_ms_donate": round(donated, 4),
+        "delta_pct": round(100 * (base - donated) / base, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -397,6 +397,10 @@ def main() -> None:
         "p50 of batched device ticks (compile excluded). Reference numbers: "
         "single-op CPU Python p50s from BASELINE.md.",
         "",
+        "Multi-shard structure: see [SCALING.md](SCALING.md) — per-phase "
+        "collective census from the compiled HLO plus the weak-scaling "
+        "table (`benchmarks/bench_scaling.py`).",
+        "",
         "| metric | batch | batch p50 (ms) | per-op (µs) | throughput (ops/s) | ref p50 (µs) | speedup |",
         "|---|---|---|---|---|---|---|",
     ]

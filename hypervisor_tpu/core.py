@@ -973,10 +973,23 @@ class Hypervisor:
             details=details,
         )
         if scheduler is not None:
+            # Re-arm the isolation gate on each SUBSTITUTE's own row —
+            # a handed-off step must stay gated on its new owner, not
+            # run ungated (nor gated on the dead victim).
+            sub_slots = {}
+            for handoff in result.handoffs:
+                if handoff.to_agent is None:
+                    continue
+                sub_row = self.state.agent_row(
+                    handoff.to_agent, managed.slot
+                )
+                if sub_row is not None:
+                    sub_slots[handoff.to_agent] = sub_row["slot"]
             scheduler.apply_handoffs(
                 result,
                 step_index or {},
                 substitute_executors or {},
+                substitute_slots=sub_slots,
             )
         await self.leave_session(session_id, agent_did)
         self._emit(

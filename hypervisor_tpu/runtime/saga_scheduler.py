@@ -161,6 +161,7 @@ class SagaScheduler:
         substitute_executors: dict[str, Executor],
         substitute_undos: Optional[dict[str, Executor]] = None,
         retries: Optional[int] = None,
+        substitute_slots: Optional[dict[str, int]] = None,
     ) -> int:
         """Rewire a KillSwitch result onto the device saga table.
 
@@ -169,10 +170,14 @@ class SagaScheduler:
         their (dead) executor and fail into the compensation path.
         step_index maps (saga_id, step_id) PAIRS to (saga_slot,
         step_idx) — step ids alone recur across sagas;
-        substitute_executors/undos are keyed by substitute DID. Returns
-        how many steps were actually rewired.
+        substitute_executors/undos are keyed by substitute DID, and
+        `substitute_slots` maps each substitute DID to its agent row so
+        the isolation gate re-arms on the SUBSTITUTE (the victim's
+        binding always drops; without a row the handed-off step runs
+        ungated). Returns how many steps were actually rewired.
         """
         undos = substitute_undos or {}
+        sub_slots = substitute_slots or {}
         rewired = 0
         for handoff in kill_result.handoffs:
             if handoff.to_agent is None:
@@ -186,6 +191,7 @@ class SagaScheduler:
                 execute,
                 undo=undos.get(handoff.to_agent),
                 retries=retries,
+                agent_slot=sub_slots.get(handoff.to_agent),
             )
             rewired += 1
         return rewired

@@ -20,6 +20,7 @@ share one source of truth.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Optional, Sequence
@@ -57,6 +58,22 @@ _ADMIT = jax.jit(admission.admit_batch)
 _SAGA_TICK = jax.jit(saga_ops.saga_table_tick)
 _TERMINATE = jax.jit(terminate_ops.terminate_batch, static_argnames=("use_pallas",))
 _WAVE = jax.jit(pipeline_ops.governance_wave, static_argnames=("use_pallas",))
+# Donated twin: the three table arguments alias into the outputs, so
+# XLA updates them in place instead of materialising a second copy of
+# every column in HBM. RE-STAGING CONTRACT: after a donated wave the
+# PRE-wave table pytrees are dead buffers — HypervisorState holds the
+# only live reference (it immediately rebinds self.agents/... to the
+# results), and callers must never retain raw table aliases across a
+# wave (snapshot with `np.array(..., copy=True)` — np.asarray on a CPU
+# jax.Array is a zero-copy VIEW of the very buffer donation lets the
+# next wave overwrite). Opt-in via
+# HV_DONATE_TABLES=1 until the on-chip before/after is captured
+# (benchmarks/bench_donation.py).
+_WAVE_DONATED = jax.jit(
+    pipeline_ops.governance_wave,
+    static_argnames=("use_pallas",),
+    donate_argnums=(0, 1, 2),
+)
 _RECORD_CALLS = jax.jit(security_ops.record_calls)
 _SLASH = jax.jit(liability_ops.slash_cascade)
 _BREACH_SWEEP = jax.jit(security_ops.breach_sweep)
@@ -76,14 +93,17 @@ def _isolation_refusal_from(
     flags: int, breaker_until: float, now: float
 ) -> Optional[str]:
     """The isolation-gate rule on scalar column values (shared by the
-    per-slot and snapshot forms): only LIVE rows gate; quarantine wins
-    over the breaker, mirroring the gateway's gate order."""
+    per-slot and snapshot forms): only LIVE rows gate; the breaker is
+    consulted first, matching the gateway's gate order
+    (`ops.gateway.check_actions` gate 1 = breaker, gate 2 =
+    quarantine), so a dual-flagged agent refuses with the same reason
+    on every path."""
     if not flags & FLAG_ACTIVE:
         return None
-    if flags & FLAG_QUARANTINED:
-        return "agent is quarantined (read-only isolation)"
     if flags & FLAG_BREAKER_TRIPPED and now < breaker_until:
         return "circuit breaker tripped (breach cooldown)"
+    if flags & FLAG_QUARANTINED:
+        return "agent is quarantined (read-only isolation)"
     return None
 
 
@@ -473,8 +493,13 @@ class HypervisorState:
                     fsm_error=result.fsm_error[:k],
                 )
         else:
+            wave = (
+                _WAVE_DONATED
+                if os.environ.get("HV_DONATE_TABLES") == "1"
+                else _WAVE
+            )
             with profiling.span("hv.governance_wave"):
-                result = _WAVE(
+                result = wave(
                     *wave_args,
                     use_pallas=use_pallas,
                     ring_bursts=self._ring_bursts,
@@ -1663,9 +1688,12 @@ class HypervisorState:
         saga scheduler gates every step of a dispatch round against it
         instead of paying a device→host sync per step
         (`runtime.saga_scheduler.run_until_settled`). Valid for one
-        round: state only changes between rounds via `saga_round`."""
-        flags = np.asarray(self.agents.flags)
-        until = np.asarray(self.agents.bd_breaker_until)
+        round: state only changes between rounds via `saga_round`.
+        COPIES, not views: a zero-copy np.asarray would alias device
+        buffers that a donated wave (`_WAVE_DONATED`) may overwrite in
+        place mid-round."""
+        flags = np.array(self.agents.flags, copy=True)
+        until = np.array(self.agents.bd_breaker_until, copy=True)
         now = self.now()
 
         def refusal(agent_slot: int) -> Optional[str]:

@@ -106,7 +106,9 @@ class SessionTable:
 
     @staticmethod
     def create(capacity: int) -> "SessionTable":
-        z32 = jnp.zeros((capacity,), jnp.float32)
+        # Every column gets its OWN buffer: aliasing one zeros array
+        # across columns breaks buffer donation (XLA refuses to donate
+        # the same buffer twice in one call).
         return SessionTable(
             sid=jnp.full((capacity,), -1, jnp.int32),
             state=jnp.zeros((capacity,), jnp.int8),
@@ -115,10 +117,10 @@ class SessionTable:
             min_sigma_eff=jnp.full((capacity,), 0.60, jnp.float32),
             enable_audit=jnp.ones((capacity,), bool),
             n_participants=jnp.zeros((capacity,), jnp.int32),
-            created_at=z32,
-            terminated_at=z32,
+            created_at=jnp.zeros((capacity,), jnp.float32),
+            terminated_at=jnp.zeros((capacity,), jnp.float32),
             has_nonreversible=jnp.zeros((capacity,), bool),
-            max_duration=z32,
+            max_duration=jnp.zeros((capacity,), jnp.float32),
         )
 
 

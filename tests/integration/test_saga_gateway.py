@@ -62,8 +62,32 @@ class TestHostPlaneSagaGate:
         with pytest.raises(SagaGateRefused, match="quarantined"):
             await ms.saga.execute_step(saga.saga_id, s2.step_id, ok)
         assert ran == ["ran"], "refused step's executor must never run"
-        assert s2.state is StepState.FAILED
+        # The refusal is a gate outcome, not an execution outcome: the
+        # step stays PENDING (re-refusable now, executable once the
+        # hold clears) with the reason recorded.
+        assert s2.state is StepState.PENDING
         assert "quarantined" in s2.error
+
+        # Second attempt while still held: refuses again, no crash.
+        with pytest.raises(SagaGateRefused, match="quarantined"):
+            await ms.saga.execute_step(saga.saga_id, s2.step_id, ok)
+
+        # Release the quarantine on both planes: the step now executes.
+        hv.quarantine.release("did:worker", sid)
+        import numpy as np
+        from hypervisor_tpu.tables.state import FLAG_QUARANTINED
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        slot = row["slot"]
+        hv.state.agents = t_replace(
+            hv.state.agents,
+            flags=hv.state.agents.flags.at[slot].set(
+                int(np.asarray(hv.state.agents.flags)[slot])
+                & ~FLAG_QUARANTINED
+            ),
+        )
+        assert (await ms.saga.execute_step(saga.saga_id, s2.step_id, ok)) == "ok"
+        assert ran == ["ran", "ran"]
 
     async def test_tripped_breaker_refuses_step(self):
         from hypervisor_tpu.models import ActionDescriptor, ReversibilityLevel

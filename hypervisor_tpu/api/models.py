@@ -324,6 +324,20 @@ class ActionCheckResponse(BaseModel):
     breach_severity: Optional[str] = None
 
 
+class ActionWaveRequest(BaseModel):
+    """A WAVE of actions through the fused gateway program
+    (`Hypervisor.check_actions`): settled in request order in ONE
+    device dispatch — an early action's recording can trip the breaker
+    that refuses a later one, and duplicate agents' bucket tokens
+    settle sequentially."""
+
+    requests: list[ActionCheckRequest]
+
+
+class ActionWaveResponse(BaseModel):
+    results: list[ActionCheckResponse]
+
+
 class KillAgentRequest(BaseModel):
     agent_did: str
     reason: str = "manual"

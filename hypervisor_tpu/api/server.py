@@ -1,8 +1,9 @@
 """REST transports for the Hypervisor API.
 
-Two transports over the same `HypervisorService` (26 routes: the
+Two transports over the same `HypervisorService` (30 routes: the
 reference's 21, `api/server.py`, plus device stats, quarantine views,
-leave, and the operator sweep):
+the per-membership agent view, leave, the operator sweep, and the
+per-action gateway with its wave sibling):
 
  - `create_app()` — a FastAPI application with CORS-open middleware and
    OpenAPI docs, when fastapi is installed.
@@ -42,6 +43,8 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("POST", "/api/v1/rings/check", "ring_check", M.RingCheckRequest),
     ("POST", "/api/v1/sessions/{session_id}/actions/check", "action_check",
      M.ActionCheckRequest),
+    ("POST", "/api/v1/sessions/{session_id}/actions/check-wave",
+     "action_check_wave", M.ActionWaveRequest),
     ("POST", "/api/v1/sessions/{session_id}/sagas", "create_saga", None),
     ("GET", "/api/v1/sessions/{session_id}/sagas", "list_sagas", None),
     ("GET", "/api/v1/sagas/{saga_id}", "get_saga", None),

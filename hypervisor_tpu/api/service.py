@@ -206,24 +206,46 @@ class HypervisorService:
         self, session_id: str, req: M.ActionCheckRequest
     ) -> M.ActionCheckResponse:
         """The full per-action gateway (`Hypervisor.check_action`) —
-        the stateful sibling of the stateless /rings/check."""
+        the stateful sibling of the stateless /rings/check, served as
+        the N=1 case of the wave endpoint (same mapping everywhere)."""
+        wave = await self.action_check_wave(
+            session_id, M.ActionWaveRequest(requests=[req])
+        )
+        return wave.results[0]
+
+    async def action_check_wave(
+        self, session_id: str, req: M.ActionWaveRequest
+    ) -> M.ActionWaveResponse:
+        """A whole action wave through the fused gateway program
+        (`Hypervisor.check_actions`): one device dispatch for N
+        actions, verdicts in request order."""
         if self.hv.get_session(session_id) is None:
             raise ApiError(404, f"Session {session_id} not found")
         try:
-            result = await self.hv.check_action(
-                session_id,
-                req.agent_did,
-                ActionDescriptor(**req.action),
-                has_consensus=req.has_consensus,
-                has_sre_witness=req.has_sre_witness,
-            )
+            wave = [
+                (
+                    r.agent_did,
+                    ActionDescriptor(**r.action),
+                    r.has_consensus,
+                    r.has_sre_witness,
+                )
+                for r in req.requests
+            ]
         except (TypeError, ValueError) as e:
             # TypeError: unknown/missing fields; ValueError: the
             # __post_init__ reversibility coercion rejecting a bogus
             # enum value — both are caller errors, not conflicts.
             raise ApiError(422, f"bad action descriptor: {e}")
+        try:
+            results = await self.hv.check_actions(session_id, wave)
         except Exception as e:
             raise ApiError(409, str(e))
+        return M.ActionWaveResponse(
+            results=[self._action_response(r) for r in results]
+        )
+
+    @staticmethod
+    def _action_response(result) -> M.ActionCheckResponse:
         return M.ActionCheckResponse(
             allowed=result.allowed,
             reason=result.reason,

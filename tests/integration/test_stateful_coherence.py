@@ -305,6 +305,54 @@ class PlaneCoherence(RuleBasedStateMachine):
             again = self.go(self.hv.check_action(sid, agent, action))
             assert not again.allowed and again.breaker_tripped
 
+    @precondition(lambda self: any(self.joined.values()))
+    @rule(pick=st.integers(0, 3), kinds=st.lists(st.integers(0, 2),
+                                                 min_size=1, max_size=6))
+    def gateway_wave(self, pick, kinds):
+        """check_actions: a whole wave through the fused gateway must
+        agree with the planes — every wave verdict for a quarantined
+        writer refuses, and a wave never crashes whatever the planes
+        hold (duplicate agents settle sequentially inside it)."""
+        from hypervisor_tpu.models import ActionDescriptor, ReversibilityLevel
+
+        sids = [s for s in self.sessions if self.joined[s]]
+        if not sids:
+            return
+        sid = sids[pick % len(sids)]
+        agents = sorted(self.joined[sid])
+        reqs = []
+        for i, kind in enumerate(kinds):
+            agent = agents[i % len(agents)]
+            reqs.append((
+                agent,
+                ActionDescriptor(
+                    action_id=f"wv{kind}",
+                    name="probe",
+                    execute_api="/x",
+                    undo_api="/u" if kind == 0 else None,
+                    reversibility=[
+                        ReversibilityLevel.FULL,
+                        ReversibilityLevel.NONE,
+                        ReversibilityLevel.FULL,
+                    ][kind],
+                    is_read_only=(kind == 2),
+                ),
+            ))
+        results = self.go(self.hv.check_actions(sid, reqs))
+        assert len(results) == len(reqs)
+        for (agent, action), result in zip(reqs, results):
+            row = self.hv.state.agent_row(
+                agent, self.hv.get_session(sid).slot
+            )
+            if (
+                row is not None
+                and self.hv.state.quarantined_mask()[row["slot"]]
+                and not action.is_read_only
+            ):
+                assert not result.allowed and (
+                    result.quarantined or result.breaker_tripped
+                )
+
     @rule()
     def sweeps(self):
         now = self.hv.state.now()

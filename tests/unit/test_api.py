@@ -1,4 +1,4 @@
-"""API service layer + stdlib HTTP transport (26 routes).
+"""API service layer + stdlib HTTP transport (30 routes).
 
 Mirrors the reference's API surface (`api/server.py`): sessions, rings,
 sagas, liability, events, health — exercised both in-process and over HTTP.
@@ -180,8 +180,10 @@ class TestHTTPTransport:
     def test_routes_cover_reference_plus_device_stats(self):
         # The reference's 21 endpoints plus /api/v1/device/stats (the
         # device-plane occupancy view the reference has no analog for),
-        # the two quarantine views, and the per-membership agent view.
-        assert len(ROUTES) == 29
+        # the two quarantine views, the per-membership agent view, the
+        # leave/sweep pair, the per-action gateway, and its wave
+        # sibling (/actions/check-wave): 30 routes.
+        assert len(ROUTES) == 30
         assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
         assert any(
             path == "/api/v1/security/quarantines" for _, path, _, _ in ROUTES
@@ -403,3 +405,43 @@ async def test_action_check_endpoint_runs_the_gateway(svc):
             M.ActionCheckRequest(agent_did="did:g", action={"bogus": 1}),
         )
     assert e.value.status == 422
+
+
+async def test_action_wave_endpoint_settles_in_order(svc):
+    """One POST, one fused device dispatch: an early probe's recording
+    trips the breaker that refuses a later action in the SAME wave."""
+    a = await svc.create_session(
+        M.CreateSessionRequest(creator_did="did:lead", min_sigma_eff=0.0)
+    )
+    await svc.join_session(
+        a.session_id, M.JoinSessionRequest(agent_did="did:w", sigma_raw=0.8)
+    )
+    write = {
+        "action_id": "w", "name": "write", "execute_api": "/x",
+        "undo_api": "/u", "reversibility": "full",
+    }
+    admin = {
+        "action_id": "adm", "name": "admin", "execute_api": "/x",
+        "undo_api": None, "is_admin": True, "reversibility": "none",
+    }
+    reqs = [M.ActionCheckRequest(agent_did="did:w", action=write)] + [
+        M.ActionCheckRequest(agent_did="did:w", action=admin)
+        for _ in range(7)
+    ]
+    out = await svc.action_check_wave(
+        a.session_id, M.ActionWaveRequest(requests=reqs)
+    )
+    kinds = [
+        "allowed" if r.allowed
+        else "breaker" if r.breaker_tripped
+        else "ring"
+        for r in out.results
+    ]
+    assert kinds[0] == "allowed"
+    assert "ring" in kinds and kinds[-1] == "breaker"
+
+    with pytest.raises(ApiError) as e:
+        await svc.action_check_wave(
+            "nope", M.ActionWaveRequest(requests=[])
+        )
+    assert e.value.status == 404

@@ -131,7 +131,7 @@ from hypervisor_tpu.security import (
     TokenBucket,
 )
 
-__version__ = "0.2.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "__version__",

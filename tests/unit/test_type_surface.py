@@ -1,0 +1,63 @@
+"""Typed-surface guard: every annotation in the package must resolve.
+
+The committed type-check policy (`pyproject [tool.mypy]`, CI `type-check`
+job) cannot be exercised in the development environment (no mypy wheel
+offline, zero egress), so this test enforces the subset of it that pure
+runtime can: `typing.get_type_hints` over every module-level class,
+function, and method in `hypervisor_tpu`. That catches the failure class
+mypy reports as `name-defined`/`valid-type` inside annotations — undefined
+names, unimported symbols, malformed forward references — which is also
+the class most likely to rot silently under `from __future__ import
+annotations` (annotations become lazy strings that nothing else ever
+evaluates).
+
+Reference anchor: the reference gates merges on its mypy job
+(/root/reference/.github/workflows/ci.yml:39-48); ours blocks in CI with
+the lenient committed policy, and this test keeps the annotation surface
+resolvable from an environment where mypy itself cannot run.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import pkgutil
+import typing
+
+import hypervisor_tpu
+
+
+def _iter_module_names() -> list[str]:
+    return [
+        m.name
+        for m in pkgutil.walk_packages(
+            hypervisor_tpu.__path__, prefix="hypervisor_tpu."
+        )
+    ]
+
+
+def test_package_walks_everything() -> None:
+    names = _iter_module_names()
+    # Guard against the walk silently shrinking (e.g. an __init__ raising
+    # under a refactor would drop its whole subtree from the sweep).
+    assert len(names) >= 80, names
+
+
+def test_all_annotations_resolve() -> None:
+    failures: list[tuple[str, str, str]] = []
+    for name in _iter_module_names():
+        mod = importlib.import_module(name)
+        for attr, obj in vars(mod).items():
+            if getattr(obj, "__module__", None) != name:
+                continue  # re-export; checked where it is defined
+            try:
+                if inspect.isclass(obj):
+                    typing.get_type_hints(obj)
+                    for meth in vars(obj).values():
+                        if inspect.isfunction(meth):
+                            typing.get_type_hints(meth)
+                elif inspect.isfunction(obj):
+                    typing.get_type_hints(obj)
+            except Exception as exc:  # noqa: BLE001 - collected for report
+                failures.append((name, attr, repr(exc)))
+    assert not failures, failures

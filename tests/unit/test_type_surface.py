@@ -27,11 +27,17 @@ import typing
 import hypervisor_tpu
 
 
+def _raise(name: str) -> None:
+    # walk_packages swallows failing subpackage imports by default,
+    # silently shrinking the sweep; make them loud instead.
+    raise RuntimeError(f"failed to import {name} during package walk")
+
+
 def _iter_module_names() -> list[str]:
     return [
         m.name
         for m in pkgutil.walk_packages(
-            hypervisor_tpu.__path__, prefix="hypervisor_tpu."
+            hypervisor_tpu.__path__, prefix="hypervisor_tpu.", onerror=_raise
         )
     ]
 
@@ -54,6 +60,17 @@ def test_all_annotations_resolve() -> None:
                 if inspect.isclass(obj):
                     typing.get_type_hints(obj)
                     for meth in vars(obj).values():
+                        # Unwrap descriptors: staticmethod/classmethod
+                        # hide their function behind __func__, properties
+                        # behind fget/fset — plain isfunction() would
+                        # silently skip all of them.
+                        if isinstance(meth, (staticmethod, classmethod)):
+                            meth = meth.__func__
+                        elif isinstance(meth, property):
+                            for acc in (meth.fget, meth.fset, meth.fdel):
+                                if acc is not None:
+                                    typing.get_type_hints(acc)
+                            continue
                         if inspect.isfunction(meth):
                             typing.get_type_hints(meth)
                 elif inspect.isfunction(obj):

@@ -48,6 +48,7 @@ DOMINANT = {
     "slash_cascade": "all-reduce (per-round exposure psum)",
     "action_gateway": "none (shard-local by placement contract)",
     "fused_wave": "all-reduce (admission + session folds)",
+    "fused_wave_contiguous": "all-reduce (terminate mask psum removed)",
     "fused_wave_gw_modes": "all-reduce (admission + session folds)",
 }
 
@@ -177,6 +178,17 @@ def build_phase_programs(n_dev: int, rows_per_shard: int = 16):
         0.0, 0.5,
     )
     yield "fused_wave", sharded_governance_wave(mesh), wave_args
+
+    # The contiguous-wave variant: terminate's [S_cap] membership-mask
+    # psum is replaced by range compares against the replicated (lo, hi)
+    # scalars — one fewer all-reduce in the census, zero gathers in the
+    # phase (ops/terminate.py wave_range path).
+    yield "fused_wave_contiguous", sharded_governance_wave(
+        mesh, contiguous_waves=True
+    ), (
+        *wave_args,
+        jnp.asarray(0, jnp.int32), jnp.asarray(k, jnp.int32),
+    )
 
     yield "fused_wave_gw_modes", sharded_governance_wave(
         mesh, with_gateway=True, mode_dispatch=True

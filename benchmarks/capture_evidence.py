@@ -15,11 +15,19 @@ to benchmarks/results/capture_r04.json so a restarted daemon resumes
 where it left off; all output streams to capture_r04.log.
 
 Steps, in order (each skipped once recorded as ok):
-  parity    HV_TPU_TESTS=1 pytest of the compiled-Mosaic parity tests
-  bench     python bench.py (the driver's headline JSON line)
-  suite     python benchmarks/bench_suite.py --write-results
-  scaling   python benchmarks/bench_scaling.py --write
-  donation  python benchmarks/bench_donation.py
+  parity      HV_TPU_TESTS=1 pytest of the compiled-Mosaic parity tests
+  bench       python bench.py (the driver's headline JSON line)
+  suite       python benchmarks/bench_suite.py --write-results
+  scaling     python benchmarks/bench_scaling.py --write
+  donation    python benchmarks/bench_donation.py
+  pack_before bench.py in the .beforeafter/prepack worktree (1efd237,
+              the commit before column packing landed)
+  pack_after  bench.py in .beforeafter/postpack (0b029bf, packing)
+  fuse_after  bench.py in .beforeafter/postfuse (50805e5, terminate
+              gather fusion)
+The last three give the TPU before/after that ROADMAP promises for the
+round-3 packing and terminate-fusion changes; HEAD's own number comes
+from the `bench` step.
 
 Run: nohup python benchmarks/capture_evidence.py >/dev/null 2>&1 &
 """
@@ -47,7 +55,8 @@ STEP_COOLDOWN_S = 20  # claim-release settle between steps
 # tunnel for up to its full timeout).
 MAX_ATTEMPTS = 3
 
-STEPS: list[tuple[str, list[str], dict[str, str], float]] = [
+# (name, argv, extra env, timeout seconds, cwd relative to REPO)
+STEPS: list[tuple[str, list[str], dict[str, str], float, str]] = [
     (
         "parity",
         [
@@ -60,21 +69,27 @@ STEPS: list[tuple[str, list[str], dict[str, str], float]] = [
         ],
         {"HV_TPU_TESTS": "1"},
         2400.0,
+        ".",
     ),
-    ("bench", [sys.executable, "bench.py"], {}, 3000.0),
+    ("bench", [sys.executable, "bench.py"], {}, 3000.0, "."),
     (
         "suite",
         [sys.executable, "benchmarks/bench_suite.py", "--write-results"],
         {},
         3000.0,
+        ".",
     ),
     (
         "scaling",
         [sys.executable, "benchmarks/bench_scaling.py", "--write"],
         {},
         2400.0,
+        ".",
     ),
-    ("donation", [sys.executable, "benchmarks/bench_donation.py"], {}, 2400.0),
+    ("donation", [sys.executable, "benchmarks/bench_donation.py"], {}, 2400.0, "."),
+    ("pack_before", [sys.executable, "bench.py"], {}, 3000.0, ".beforeafter/prepack"),
+    ("pack_after", [sys.executable, "bench.py"], {}, 3000.0, ".beforeafter/postpack"),
+    ("fuse_after", [sys.executable, "bench.py"], {}, 3000.0, ".beforeafter/postfuse"),
 ]
 
 
@@ -114,16 +129,19 @@ def probe() -> bool:
     return r.returncode == 0 and "TPU" in (r.stdout or "")
 
 
-def run_step(name: str, cmd: list[str], env_extra: dict, timeout: float) -> dict:
+def run_step(
+    name: str, cmd: list[str], env_extra: dict, timeout: float, cwd: str
+) -> dict:
     env = dict(os.environ)
     env.update(env_extra)
+    workdir = (REPO / cwd).resolve()
     start = time.time()
     try:
         with LOG.open("a") as f:
-            f.write(f"\n===== step {name}: {' '.join(cmd)} =====\n")
+            f.write(f"\n===== step {name} in {cwd}: {' '.join(cmd)} =====\n")
             f.flush()
             r = subprocess.run(
-                cmd, cwd=REPO, env=env, timeout=timeout, stdout=f, stderr=f
+                cmd, cwd=workdir, env=env, timeout=timeout, stdout=f, stderr=f
             )
         rc: int | None = r.returncode
     except subprocess.TimeoutExpired:
@@ -142,15 +160,27 @@ def main() -> None:
     while True:
         runnable = []
         parked = []
+        waiting = []
         for s in STEPS:
             rec = journal["steps"].get(s[0], {})
             if rec.get("ok"):
                 continue
-            if rec.get("attempts", 0) >= MAX_ATTEMPTS:
+            if not (REPO / s[4]).resolve().is_dir():
+                # Worktree not set up (yet): skip WITHOUT burning the
+                # attempt budget — re-evaluated every loop, so creating
+                # the worktree and restarting (or just waiting) resumes
+                # the step.
+                waiting.append(s[0])
+            elif rec.get("attempts", 0) >= MAX_ATTEMPTS:
                 parked.append(s[0])
             else:
                 runnable.append(s)
         if not runnable:
+            if waiting:
+                log(f"no runnable step (waiting on workdirs: {waiting}, "
+                    f"parked: {parked or 'none'}); sleeping {PROBE_INTERVAL_S}s")
+                time.sleep(PROBE_INTERVAL_S)
+                continue
             journal["done"] = not parked
             journal["parked"] = parked
             save_journal(journal)
@@ -162,11 +192,11 @@ def main() -> None:
                 f"(pending: {[s[0] for s in pending]})")
             time.sleep(PROBE_INTERVAL_S)
             continue
-        name, cmd, env_extra, timeout = pending[0]
+        name, cmd, env_extra, timeout, cwd = pending[0]
         log(f"tunnel healthy — running step '{name}' (timeout {timeout}s)")
-        res = run_step(name, cmd, env_extra, timeout)
+        res = run_step(name, cmd, env_extra, timeout, cwd)
         attempts = journal["steps"].get(name, {}).get("attempts", 0) + 1
-        res["attempts"] = attempts
+        res["attempts"] = max(attempts, res.get("attempts", 0))
         journal["steps"][name] = res
         save_journal(journal)
         log(f"step '{name}' -> {res}")

@@ -41,8 +41,9 @@ _BROADCAST_K_MAX = 32
 def release_session_scope(
     agents: AgentTable,
     vouches: VouchTable,
-    in_wave: jnp.ndarray,
+    in_wave: jnp.ndarray | None,
     wave_sessions: jnp.ndarray | None = None,
+    wave_range: tuple[jnp.ndarray, jnp.ndarray] | None = None,
 ) -> tuple[AgentTable, VouchTable, jnp.ndarray]:
     """Release bonds and deactivate participants for the wave's sessions.
 
@@ -51,8 +52,23 @@ def release_session_scope(
     compare path; without it — or for large K — the mask gathers are
     used. Shared by the terminate wave and the fused governance wave so
     bond-release semantics cannot drift.
+
+    wave_range: (lo, hi) traced i32 scalars asserting the wave's
+    sessions are EXACTLY the contiguous slot block [lo, hi) — the
+    layout `create_sessions_batch` + ragged parking always produce.
+    Membership then costs two range compares fused into the following
+    masks: no [E]/[N] gathers, no [S_cap] mask at all (the gathers were
+    ~0.19 ms of the 0.43 ms TPU wave p50, docs/ROADMAP.md). Callers
+    must verify contiguity on host (`state.py` does); a non-contiguous
+    wave passed as a range would release the gap slots' bonds too.
     """
-    if wave_sessions is not None and wave_sessions.shape[0] <= _BROADCAST_K_MAX:
+    if wave_range is not None:
+        lo, hi = wave_range
+        # Free rows carry session == -1 and lo >= 0, so they match
+        # nothing, same as the mask paths.
+        edge_in = (vouches.session >= lo) & (vouches.session < hi)
+        agent_hit = (agents.session >= lo) & (agents.session < hi)
+    elif wave_sessions is not None and wave_sessions.shape[0] <= _BROADCAST_K_MAX:
         # Real slots are >= 0, so free rows (session == -1) match nothing.
         edge_in = (
             vouches.session[:, None] == wave_sessions[None, :]
@@ -95,8 +111,14 @@ def terminate_batch(
     leaf_counts: jnp.ndarray,    # i32[K] valid leaves per session
     now: jnp.ndarray | float,
     use_pallas: bool | None = None,
+    wave_range: tuple[jnp.ndarray, jnp.ndarray] | None = None,
 ) -> TerminateResult:
-    """Terminate a wave of K sessions in one device program."""
+    """Terminate a wave of K sessions in one device program.
+
+    wave_range: optional (lo, hi) contiguity assertion for
+    `session_slots` (see `release_session_scope`); turns the session
+    mask into iota compares and drops the bond-release gathers.
+    """
     s_cap = sessions.sid.shape[0]
     now_f = jnp.asarray(now, jnp.float32)
 
@@ -105,13 +127,18 @@ def terminate_batch(
     roots = jnp.where((leaf_counts > 0)[:, None], roots, jnp.uint32(0))
 
     # ── wave membership mask over the session axis ──────────────────────
-    in_wave = (
-        jnp.zeros((s_cap,), bool).at[jnp.clip(session_slots, 0)].set(True)
-    )
+    if wave_range is not None:
+        iota = jnp.arange(s_cap, dtype=jnp.int32)
+        in_wave = (iota >= wave_range[0]) & (iota < wave_range[1])
+    else:
+        in_wave = (
+            jnp.zeros((s_cap,), bool).at[jnp.clip(session_slots, 0)].set(True)
+        )
 
     # ── bonds + participants (shared semantics) ─────────────────────────
     new_agents, new_vouches, released = release_session_scope(
-        agents, vouches, in_wave, wave_sessions=session_slots
+        agents, vouches, in_wave, wave_sessions=session_slots,
+        wave_range=wave_range,
     )
 
     # ── session FSM: TERMINATING then ARCHIVED, stamped ──────────────────

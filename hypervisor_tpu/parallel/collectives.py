@@ -670,6 +670,7 @@ def sharded_governance_wave(
     with_gateway: bool = False,
     breach=DEFAULT_CONFIG.breach,
     mode_dispatch: bool = False,
+    contiguous_waves: bool = False,
 ):
     """The FUSED full-governance wave, end-to-end sharded (round-3 item).
 
@@ -692,7 +693,13 @@ def sharded_governance_wave(
       6.   terminate — the in_wave mask is psum-merged so EVERY shard
            releases its own edge/agent blocks for ALL wave sessions;
            released counts psum to the global total; the ARCHIVED walk
-           folds in like phase 3.
+           folds in like phase 3. With `contiguous_waves` the mask AND
+           its psum disappear: the step takes two replicated scalars
+           (wave_lo, wave_hi) right after `omega`, asserting the
+           GLOBAL wave is the contiguous slot block [lo, hi) — every
+           shard then range-compares its own edge/agent blocks with no
+           collective at all (`ops.terminate.release_session_scope`
+           wave_range path; the bridge verifies contiguity on host).
 
     Contracts: wave length B and session count K divisible by the mesh
     size; wave element i's agent slot lives on shard i // (B/D)
@@ -745,8 +752,13 @@ def sharded_governance_wave(
         delta_bodies,
         now,
         omega,
-        *gw_args,
+        *rest,
     ):
+        if contiguous_waves:
+            wave_lo, wave_hi = rest[0], rest[1]
+            gw_args = rest[2:]
+        else:
+            gw_args = rest
         now_f = jnp.asarray(now, jnp.float32)
         s_cap = sessions.sid.shape[0]
 
@@ -789,16 +801,27 @@ def sharded_governance_wave(
         )
 
         # ── 6. terminate: global wave mask, local block release ───────
-        local_mask = (
-            jnp.zeros((s_cap,), jnp.int32).at[jnp.clip(ws, 0)].set(1)
-        )
-        in_wave = jax.lax.psum(local_mask, AGENT_AXIS) > 0
-        # Mask path on purpose (no wave_sessions): each shard only holds
-        # its K/D wave lanes, but its edge/agent blocks must release for
-        # EVERY shard's sessions — only the psum'd global mask knows them.
-        agents, vouches, released_local = terminate_ops.release_session_scope(
-            agents, vouches, in_wave
-        )
+        if contiguous_waves:
+            # Every shard knows the global block [lo, hi) from the two
+            # replicated scalars: local range compares, zero collectives
+            # (the [S_cap] mask psum below is gone entirely).
+            agents, vouches, released_local = (
+                terminate_ops.release_session_scope(
+                    agents, vouches, None, wave_range=(wave_lo, wave_hi)
+                )
+            )
+        else:
+            local_mask = (
+                jnp.zeros((s_cap,), jnp.int32).at[jnp.clip(ws, 0)].set(1)
+            )
+            in_wave = jax.lax.psum(local_mask, AGENT_AXIS) > 0
+            # Mask path on purpose (no wave_sessions): each shard only
+            # holds its K/D wave lanes, but its edge/agent blocks must
+            # release for EVERY shard's sessions — only the psum'd
+            # global mask knows them.
+            agents, vouches, released_local = (
+                terminate_ops.release_session_scope(agents, vouches, in_wave)
+            )
         released = jax.lax.psum(released_local, AGENT_AXIS)
 
         wave_state, err_t = session_fsm.apply_session_transitions(
@@ -921,6 +944,8 @@ def sharded_governance_wave(
         P(None, AGENT_AXIS, None),            # delta_bodies [T, K, W]
         rep, rep,               # now, omega
     )
+    if contiguous_waves:
+        in_specs = in_specs + (rep, rep)       # wave_lo, wave_hi scalars
     wave_out = WaveResult(
         agents=lane,
         sessions=rep,

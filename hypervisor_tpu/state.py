@@ -419,6 +419,33 @@ class HypervisorState:
         wave_sessions = np.concatenate(
             [np.asarray(session_slots, np.int32), parked_sessions]
         )
+        # Contiguity check (host, cheap): fresh waves allocate
+        # arange(base, base+k) and ragged parking extends the same
+        # block, so the common layout qualifies for terminate's
+        # range-compare fast path (no [E]/[N] membership gathers).
+        # Arbitrary caller-supplied slots fall back to the mask path.
+        wave_contiguous = bool(
+            wave_sessions.size > 0
+            and int(wave_sessions[0]) >= 0
+            and np.array_equal(
+                wave_sessions,
+                np.arange(
+                    int(wave_sessions[0]),
+                    int(wave_sessions[0]) + wave_sessions.size,
+                    dtype=wave_sessions.dtype,
+                ),
+            )
+        )
+        wave_range = (
+            (
+                jnp.asarray(int(wave_sessions[0]), jnp.int32),
+                jnp.asarray(
+                    int(wave_sessions[0]) + wave_sessions.size, jnp.int32
+                ),
+            )
+            if wave_contiguous
+            else None
+        )
         bodies = np.asarray(delta_bodies)
         if k_wave != k:
             padded_bodies = np.zeros(
@@ -445,7 +472,9 @@ class HypervisorState:
         gw_result = None
         if mesh is not None:
             with_gateway = actions is not None
-            wave_fn = self._sharded_waves.get((mesh, with_gateway))
+            wave_fn = self._sharded_waves.get(
+                (mesh, with_gateway, wave_contiguous)
+            )
             if wave_fn is None:
                 from hypervisor_tpu.parallel.collectives import (
                     sharded_governance_wave,
@@ -463,8 +492,14 @@ class HypervisorState:
                     with_gateway=with_gateway,
                     breach=self.config.breach,
                     mode_dispatch=True,
+                    contiguous_waves=wave_contiguous,
                 )
-                self._sharded_waves[(mesh, with_gateway)] = wave_fn
+                self._sharded_waves[
+                    (mesh, with_gateway, wave_contiguous)
+                ] = wave_fn
+            # Contiguous waves append the (lo, hi) replicated scalars —
+            # the sharded terminate then needs no mask psum at all.
+            range_args = wave_range if wave_contiguous else ()
             if with_gateway:
                 act = self._normalize_actions(actions)
                 flat, valid, device_args = self._gateway_shard_args(
@@ -472,14 +507,14 @@ class HypervisorState:
                 )
                 with profiling.span("hv.governance_wave_sharded"):
                     result, lanes, partials = wave_fn(
-                        *wave_args, self.elevations, *device_args
+                        *wave_args, *range_args, self.elevations, *device_args
                     )
                 gw_result = self._scatter_gateway_lanes(
                     lanes, flat, valid, len(act["slots"]), result.agents
                 )
             else:
                 with profiling.span("hv.governance_wave_sharded"):
-                    result, partials = wave_fn(*wave_args)
+                    result, partials = wave_fn(*wave_args, *range_args)
             if b_wave != b or k_wave != k:
                 # Drop the internal padding lanes before any host
                 # bookkeeping: callers see exactly their request shape.
@@ -503,6 +538,7 @@ class HypervisorState:
                     *wave_args,
                     use_pallas=use_pallas,
                     ring_bursts=self._ring_bursts,
+                    wave_range=wave_range,
                 )
         self.agents = result.agents
         self.sessions = result.sessions
@@ -1941,16 +1977,36 @@ class HypervisorState:
             if rows:
                 leaves[i, : len(rows)] = digest_host[np.array(rows)]
 
+        # Contiguous terminate waves (the create_sessions_batch layout)
+        # take the range-compare fast path: no [E]/[N] membership
+        # gathers, no [S_cap] mask scatter (ops/terminate.py wave_range).
+        slot_arr = np.array(slots, np.int32)
+        contiguous = bool(
+            k > 0
+            and slot_arr[0] >= 0
+            and np.array_equal(
+                slot_arr, np.arange(slot_arr[0], slot_arr[0] + k, dtype=np.int32)
+            )
+        )
+        wave_range = (
+            (
+                jnp.asarray(int(slot_arr[0]), jnp.int32),
+                jnp.asarray(int(slot_arr[0]) + k, jnp.int32),
+            )
+            if contiguous
+            else None
+        )
         with profiling.span("hv.terminate_wave"):
             result = self._terminate(
                 self.agents,
                 self.sessions,
                 self.vouches,
-                jnp.asarray(np.array(slots, np.int32)),
+                jnp.asarray(slot_arr),
                 jnp.asarray(leaves),
                 jnp.asarray(counts),
                 now,
                 use_pallas=use_pallas,
+                wave_range=wave_range,
             )
         self.agents = result.agents
         self.sessions = result.sessions

@@ -176,6 +176,63 @@ class TestShardedGovernanceWave:
             np.asarray(single.vouches.active),
         )
 
+    def test_contiguous_variant_bit_parity(self):
+        """contiguous_waves=True (range compares, no terminate mask psum)
+        must equal the mask-psum variant on every output."""
+        slots, dids, sess, sigma, trust, dup, bodies = _wave_inputs()
+        wave_sessions = np.arange(K, dtype=np.int32)
+        args = (
+            jnp.asarray(slots),
+            jnp.asarray(dids),
+            jnp.asarray(sess),
+            jnp.asarray(sigma),
+            jnp.asarray(trust),
+            jnp.asarray(dup),
+            jnp.asarray(wave_sessions),
+            jnp.asarray(bodies),
+            NOW,
+            OMEGA,
+        )
+        mesh = make_mesh(N_DEV, platform="cpu")
+
+        agents, sessions, vouches = _tables()
+        vouches = _add_vouches(vouches, slots, sess)
+        masked = sharded_governance_wave(mesh)(agents, sessions, vouches, *args)
+
+        agents2, sessions2, vouches2 = _tables()
+        vouches2 = _add_vouches(vouches2, slots, sess)
+        ranged = sharded_governance_wave(mesh, contiguous_waves=True)(
+            agents2, sessions2, vouches2, *args,
+            jnp.asarray(0, jnp.int32), jnp.asarray(K, jnp.int32),
+        )
+
+        for field in ("status", "ring", "sigma_eff", "saga_step_state",
+                      "chain", "merkle_root", "fsm_error"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ranged, field)),
+                np.asarray(getattr(masked, field)),
+                err_msg=f"{field} diverged",
+            )
+        assert int(np.asarray(ranged.released)) == int(
+            np.asarray(masked.released)
+        )
+        for col in ("did", "session", "sigma_eff", "ring", "flags"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ranged.agents, col)),
+                np.asarray(getattr(masked.agents, col)),
+                err_msg=f"agents.{col} diverged",
+            )
+        for col in ("state", "n_participants", "terminated_at"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ranged.sessions, col)),
+                np.asarray(getattr(masked.sessions, col)),
+                err_msg=f"sessions.{col} diverged",
+            )
+        np.testing.assert_array_equal(
+            np.asarray(ranged.vouches.active),
+            np.asarray(masked.vouches.active),
+        )
+
     def test_wave_semantics(self):
         """Sanity on the shared outcome (not just parity): vouched lifts,
         sandbox, archives, bond release."""

@@ -83,6 +83,108 @@ def test_broadcast_and_mask_paths_agree(k):
     assert int(np.asarray(rel_bc)) == expected
 
 
+@pytest.mark.parametrize("lo,k", [(0, 1), (0, 5), (3, 9), (7, S_CAP - 7)])
+def test_range_path_matches_mask_path(lo, k):
+    # wave_range's contract: the wave IS the contiguous block [lo, lo+k).
+    rng = np.random.RandomState(100 + lo * 31 + k)
+    agents, vouches = _tables(rng)
+    wave = jnp.asarray(np.arange(lo, lo + k, dtype=np.int32))
+    in_wave = jnp.zeros((S_CAP,), bool).at[wave].set(True)
+
+    a_mask, v_mask, rel_mask = release_session_scope(
+        agents, vouches, in_wave, wave_sessions=None  # force gather path
+    )
+    a_rng, v_rng, rel_rng = release_session_scope(
+        agents,
+        vouches,
+        None,  # the range path needs no mask at all
+        wave_sessions=wave,
+        wave_range=(jnp.asarray(lo, jnp.int32), jnp.asarray(lo + k, jnp.int32)),
+    )
+
+    np.testing.assert_array_equal(np.asarray(a_rng.flags), np.asarray(a_mask.flags))
+    np.testing.assert_array_equal(
+        np.asarray(v_rng.active), np.asarray(v_mask.active)
+    )
+    assert int(np.asarray(rel_rng)) == int(np.asarray(rel_mask))
+
+
+def test_range_path_excludes_free_rows_at_lo_zero():
+    # session == -1 (free/unattached rows) must not match even when
+    # lo == 0 — the `session >= lo` guard is what excludes them. Plant
+    # OBSERVABLE sentinels on both tables: an ACTIVE vouch edge and a
+    # FLAG_ACTIVE agent row, each with session == -1.
+    agents, vouches = _tables(np.random.RandomState(1))
+    vouches = t_replace(
+        vouches,
+        session=vouches.session.at[40].set(-1),
+        bond=vouches.bond.at[40].set(0.5),
+        active=vouches.active.at[40].set(True),
+    )
+    agents = t_replace(
+        agents,
+        session=agents.session.at[N - 1].set(-1),
+        flags=agents.flags.at[N - 1].set(FLAG_ACTIVE),
+    )
+    a_out, v_out, released = release_session_scope(
+        agents,
+        vouches,
+        None,
+        wave_range=(jnp.asarray(0, jnp.int32), jnp.asarray(S_CAP, jnp.int32)),
+    )
+    # The sentinel edge stays active; only the 32 real edges released.
+    assert bool(np.asarray(v_out.active)[40])
+    assert int(np.asarray(released)) == 32
+    # The sentinel agent keeps FLAG_ACTIVE.
+    assert int(np.asarray(a_out.flags)[N - 1]) & FLAG_ACTIVE
+
+
+def test_terminate_batch_range_matches_mask():
+    # The full terminate wave (Merkle + bonds + FSM stamps) with
+    # wave_range must equal the default path on a contiguous wave.
+    from hypervisor_tpu.ops.terminate import terminate_batch
+    from hypervisor_tpu.tables.state import SessionTable
+
+    rng = np.random.RandomState(5)
+    agents, vouches = _tables(rng)
+    sessions = SessionTable.create(S_CAP)
+    lo, k = 2, 6
+    slots = jnp.asarray(np.arange(lo, lo + k, dtype=np.int32))
+    leaves = jnp.asarray(
+        rng.randint(0, 2**32, size=(k, 4, 8), dtype=np.uint64).astype(
+            np.uint32
+        )
+    )
+    counts = jnp.asarray(np.array([3, 4, 0, 1, 2, 4], np.int32))
+
+    plain = terminate_batch(
+        agents, sessions, vouches, slots, leaves, counts, 9.0,
+        use_pallas=False,
+    )
+    ranged = terminate_batch(
+        agents, sessions, vouches, slots, leaves, counts, 9.0,
+        use_pallas=False,
+        wave_range=(jnp.asarray(lo, jnp.int32), jnp.asarray(lo + k, jnp.int32)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ranged.roots), np.asarray(plain.roots)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ranged.agents.flags), np.asarray(plain.agents.flags)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ranged.vouches.active), np.asarray(plain.vouches.active)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ranged.sessions.state), np.asarray(plain.sessions.state)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ranged.sessions.terminated_at),
+        np.asarray(plain.sessions.terminated_at),
+    )
+    assert int(np.asarray(ranged.released)) == int(np.asarray(plain.released))
+
+
 def test_free_rows_never_match_broadcast():
     # Free edge rows carry session == -1; the broadcast compare must not
     # release them (real slots are >= 0).

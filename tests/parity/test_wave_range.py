@@ -1,0 +1,160 @@
+"""governance_wave with wave_range vs without: bit parity.
+
+The range-compare fast path (wave_sessions == arange(lo, hi), the slot
+allocator's layout) replaces terminate's [E]/[N] membership gathers and
+the [S_cap] mask scatter. Every WaveResult field and every output table
+column must be bit-identical to the mask path — the fast path changes
+the program, never the answer. Reference semantics anchor:
+`/root/reference/src/hypervisor/core.py:192-227` (terminate: bond
+release + archive) via `ops.terminate.release_session_scope`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from hypervisor_tpu.models import SessionState
+from hypervisor_tpu.ops import admission
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.ops.pipeline import governance_wave
+from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+N_CAP, E_CAP, S_CAP = 64, 32, 16
+T = 3
+NOW = 12.5
+OMEGA = 0.5
+
+_WAVE = jax.jit(governance_wave, static_argnames=("use_pallas",))
+
+
+def _build(lo: int, k: int, b: int):
+    """b joiners spread over the k wave sessions [lo, lo+k); some vouch
+    edges; a few STRAGGLER edges/agents in sessions OUTSIDE the range
+    that must survive the terminate untouched."""
+    rng = np.random.RandomState(lo * 101 + k)
+    agents = AgentTable.create(N_CAP)
+    sessions = SessionTable.create(S_CAP)
+    ws = jnp.arange(lo, lo + k)
+    sessions = t_replace(
+        sessions,
+        state=sessions.state.at[ws].set(jnp.int8(SessionState.HANDSHAKING.code)),
+        max_participants=sessions.max_participants.at[ws].set(10),
+        min_sigma_eff=sessions.min_sigma_eff.at[ws].set(0.6),
+    )
+    vouches = VouchTable.create(E_CAP)
+
+    slots = np.arange(b, dtype=np.int32)
+    dids = np.arange(b, dtype=np.int32)
+    agent_sessions = (lo + (np.arange(b) % k)).astype(np.int32)
+    sigma = np.full(b, 0.8, np.float32)
+    sigma[0] = 0.45  # vouched below
+
+    # One live vouch edge toward joiner 0's session; one edge scoped to a
+    # session OUTSIDE the wave range (must stay active through terminate).
+    outside = (lo + k) % S_CAP if (lo + k) < S_CAP else (lo - 1 if lo else 0)
+    vouches = t_replace(
+        vouches,
+        voucher=vouches.voucher.at[0].set(N_CAP - 1),
+        vouchee=vouches.vouchee.at[0].set(0),
+        session=vouches.session.at[0].set(int(agent_sessions[0])),
+        bond=vouches.bond.at[0].set(0.40),
+        active=vouches.active.at[0].set(True),
+    )
+    vouches = t_replace(
+        vouches,
+        voucher=vouches.voucher.at[1].set(N_CAP - 2),
+        vouchee=vouches.vouchee.at[1].set(N_CAP - 3),
+        session=vouches.session.at[1].set(int(outside)),
+        bond=vouches.bond.at[1].set(0.10),
+        active=vouches.active.at[1].set(True),
+    )
+    # A standing agent in the outside session: must stay FLAG_ACTIVE.
+    from hypervisor_tpu.tables.state import FLAG_ACTIVE
+
+    agents = t_replace(
+        agents,
+        session=agents.session.at[N_CAP - 3].set(int(outside)),
+        flags=agents.flags.at[N_CAP - 3].set(FLAG_ACTIVE),
+    )
+
+    bodies = rng.randint(
+        0, 2**32, size=(T, k, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    args = (
+        jnp.asarray(slots),
+        jnp.asarray(dids),
+        jnp.asarray(agent_sessions),
+        jnp.asarray(sigma),
+        jnp.ones(b, bool),
+        jnp.zeros(b, bool),
+        jnp.asarray(np.arange(lo, lo + k, dtype=np.int32)),
+        jnp.asarray(bodies),
+        NOW,
+        OMEGA,
+    )
+    return agents, sessions, vouches, args
+
+
+AGENT_COLS = ("did", "session", "sigma_raw", "sigma_eff", "ring", "flags",
+              "joined_at")
+SESSION_COLS = ("state", "n_participants", "terminated_at")
+
+
+@pytest.mark.parametrize("lo,k,b", [(0, 4, 8), (3, 5, 10), (0, S_CAP, 16)])
+def test_wave_range_bit_parity(lo, k, b):
+    agents, sessions, vouches, args = _build(lo, k, b)
+    plain = _WAVE(agents, sessions, vouches, *args, use_pallas=False)
+    ranged = _WAVE(
+        agents,
+        sessions,
+        vouches,
+        *args,
+        use_pallas=False,
+        wave_range=(jnp.asarray(lo, jnp.int32), jnp.asarray(lo + k, jnp.int32)),
+    )
+    for field in ("status", "ring", "sigma_eff", "saga_step_state", "chain",
+                  "merkle_root", "fsm_error"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ranged, field)),
+            np.asarray(getattr(plain, field)),
+            err_msg=f"{field} diverged",
+        )
+    assert int(np.asarray(ranged.released)) == int(np.asarray(plain.released))
+    for col in AGENT_COLS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ranged.agents, col)),
+            np.asarray(getattr(plain.agents, col)),
+            err_msg=f"agents.{col} diverged",
+        )
+    for col in SESSION_COLS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ranged.sessions, col)),
+            np.asarray(getattr(plain.sessions, col)),
+            err_msg=f"sessions.{col} diverged",
+        )
+    np.testing.assert_array_equal(
+        np.asarray(ranged.vouches.active), np.asarray(plain.vouches.active)
+    )
+
+
+def test_outside_scope_survives_ranged_terminate():
+    lo, k, b = 2, 4, 8
+    agents, sessions, vouches, args = _build(lo, k, b)
+    ranged = _WAVE(
+        agents,
+        sessions,
+        vouches,
+        *args,
+        use_pallas=False,
+        wave_range=(jnp.asarray(lo, jnp.int32), jnp.asarray(lo + k, jnp.int32)),
+    )
+    # The out-of-range vouch edge and standing agent are untouched.
+    assert bool(np.asarray(ranged.vouches.active)[1])
+    from hypervisor_tpu.tables.state import FLAG_ACTIVE
+
+    assert int(np.asarray(ranged.agents.flags)[N_CAP - 3]) & FLAG_ACTIVE

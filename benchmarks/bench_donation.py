@@ -84,6 +84,10 @@ def main() -> None:
         0.0,
     )
 
+    # wave_sessions is arange(k): measure the same range-compare fast
+    # path the bridge/bench take in production, in BOTH arms.
+    wave_range = (jnp.asarray(0, jnp.int32), jnp.asarray(k, jnp.int32))
+
     def run(donate: bool) -> float:
         fn = jax.jit(
             governance_wave,
@@ -91,13 +95,15 @@ def main() -> None:
             donate_argnums=(0, 1, 2) if donate else (),
         )
         agents, sessions, vouches = fresh_tables()
-        out = fn(agents, sessions, vouches, *cols, use_pallas=use_pallas)
+        out = fn(agents, sessions, vouches, *cols, use_pallas=use_pallas,
+                 wave_range=wave_range)
         jax.block_until_ready(out.status)
         agents, sessions, vouches = out.agents, out.sessions, out.vouches
         times = []
         for _ in range(args.iters):
             t0 = time.perf_counter_ns()
-            out = fn(agents, sessions, vouches, *cols, use_pallas=use_pallas)
+            out = fn(agents, sessions, vouches, *cols, use_pallas=use_pallas,
+                     wave_range=wave_range)
             jax.block_until_ready(out.status)
             times.append(time.perf_counter_ns() - t0)
             agents, sessions, vouches = out.agents, out.sessions, out.vouches

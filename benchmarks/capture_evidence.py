@@ -196,7 +196,7 @@ def main() -> None:
         log(f"tunnel healthy — running step '{name}' (timeout {timeout}s)")
         res = run_step(name, cmd, env_extra, timeout, cwd)
         attempts = journal["steps"].get(name, {}).get("attempts", 0) + 1
-        res["attempts"] = max(attempts, res.get("attempts", 0))
+        res["attempts"] = attempts
         journal["steps"][name] = res
         save_journal(journal)
         log(f"step '{name}' -> {res}")

@@ -107,6 +107,27 @@ def _isolation_refusal_from(
     return None
 
 
+def _contiguous_range(slots: np.ndarray) -> tuple | None:
+    """(lo, hi) i32 scalars if `slots` is exactly arange(lo, lo+len).
+
+    The qualification gate for terminate's range-compare fast path
+    (`ops.terminate.release_session_scope` wave_range): the ONE place
+    the invariant is spelled out, shared by governance-wave staging and
+    `terminate_sessions`. Returns None for anything else — empty,
+    negative first slot, gaps, duplicates, or non-ascending order —
+    which keeps callers on the mask path.
+    """
+    slots = np.asarray(slots)
+    if slots.size == 0 or int(slots[0]) < 0:
+        return None
+    lo = int(slots[0])
+    if not np.array_equal(
+        slots, np.arange(lo, lo + slots.size, dtype=slots.dtype)
+    ):
+        return None
+    return (jnp.asarray(lo, jnp.int32), jnp.asarray(lo + slots.size, jnp.int32))
+
+
 class HypervisorState:
     """Authoritative batched state: device tables + host boundary indices."""
 
@@ -424,28 +445,8 @@ class HypervisorState:
         # block, so the common layout qualifies for terminate's
         # range-compare fast path (no [E]/[N] membership gathers).
         # Arbitrary caller-supplied slots fall back to the mask path.
-        wave_contiguous = bool(
-            wave_sessions.size > 0
-            and int(wave_sessions[0]) >= 0
-            and np.array_equal(
-                wave_sessions,
-                np.arange(
-                    int(wave_sessions[0]),
-                    int(wave_sessions[0]) + wave_sessions.size,
-                    dtype=wave_sessions.dtype,
-                ),
-            )
-        )
-        wave_range = (
-            (
-                jnp.asarray(int(wave_sessions[0]), jnp.int32),
-                jnp.asarray(
-                    int(wave_sessions[0]) + wave_sessions.size, jnp.int32
-                ),
-            )
-            if wave_contiguous
-            else None
-        )
+        wave_range = _contiguous_range(wave_sessions)
+        wave_contiguous = wave_range is not None
         bodies = np.asarray(delta_bodies)
         if k_wave != k:
             padded_bodies = np.zeros(
@@ -1981,21 +1982,7 @@ class HypervisorState:
         # take the range-compare fast path: no [E]/[N] membership
         # gathers, no [S_cap] mask scatter (ops/terminate.py wave_range).
         slot_arr = np.array(slots, np.int32)
-        contiguous = bool(
-            k > 0
-            and slot_arr[0] >= 0
-            and np.array_equal(
-                slot_arr, np.arange(slot_arr[0], slot_arr[0] + k, dtype=np.int32)
-            )
-        )
-        wave_range = (
-            (
-                jnp.asarray(int(slot_arr[0]), jnp.int32),
-                jnp.asarray(int(slot_arr[0]) + k, jnp.int32),
-            )
-            if contiguous
-            else None
-        )
+        wave_range = _contiguous_range(slot_arr)
         with profiling.span("hv.terminate_wave"):
             result = self._terminate(
                 self.agents,

@@ -134,6 +134,66 @@ class TestStateMeshWave:
             np.asarray(st_single.delta_log.digest),
         )
 
+    def test_non_contiguous_wave_takes_mask_fallback(self):
+        """A caller-supplied NON-contiguous session wave (every other
+        slot) must refuse the range fast path on host and still match
+        the single-device outcome through the mask-variant program."""
+        mesh = make_mesh(N_DEV, platform="cpu")
+
+        def staged(st):
+            all_slots = st.create_sessions_batch(
+                [f"nc:s{i}" for i in range(2 * K)],
+                SessionConfig(min_sigma_eff=0.0),
+            )
+            wave_slots = all_slots[::2]  # 0, 2, 4, ... — gaps on purpose
+            dids = [f"did:nc:{i}" for i in range(B)]
+            agent_sessions = np.asarray(wave_slots, np.int32)[
+                np.arange(B) % K
+            ]
+            rng = np.random.RandomState(9)
+            bodies = rng.randint(
+                0, 2**32, size=(T, K, merkle_ops.BODY_WORDS), dtype=np.uint64
+            ).astype(np.uint32)
+            return wave_slots, dids, agent_sessions, bodies
+
+        st_single = HypervisorState(_config())
+        ws_s, dids_s, asess_s, bodies_s = staged(st_single)
+        res_s = st_single.run_governance_wave(
+            ws_s, dids_s, asess_s, np.full(B, 0.8, np.float32), bodies_s,
+            now=3.0, use_pallas=False,
+        )
+
+        st_mesh = HypervisorState(_config())
+        ws_m, dids_m, asess_m, bodies_m = staged(st_mesh)
+        res_m = st_mesh.run_governance_wave(
+            ws_m, dids_m, asess_m, np.full(B, 0.8, np.float32), bodies_m,
+            now=3.0, mesh=mesh,
+        )
+
+        np.testing.assert_array_equal(
+            np.asarray(res_m.status), np.asarray(res_s.status)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_m.merkle_root), np.asarray(res_s.merkle_root)
+        )
+        assert int(np.asarray(res_m.released)) == int(
+            np.asarray(res_s.released)
+        )
+        for st, ws in ((st_single, ws_s), (st_mesh, ws_m)):
+            state_col = np.asarray(st.sessions.state)
+            # Wave sessions archived; the SKIPPED odd slots are untouched
+            # (still HANDSHAKING) — the exact hazard a wrongly-applied
+            # range path would create.
+            assert (
+                state_col[np.asarray(ws)] == SessionState.ARCHIVED.code
+            ).all()
+            skipped = np.setdiff1d(
+                np.arange(2 * K, dtype=np.int32), np.asarray(ws)
+            )
+            assert (
+                state_col[skipped] == SessionState.HANDSHAKING.code
+            ).all()
+
     def test_mesh_wave_rows_recycle_without_free_list(self):
         mesh = make_mesh(N_DEV, platform="cpu")
         st = HypervisorState(_config())

@@ -1,0 +1,54 @@
+"""Bit-packed boolean transition matrices.
+
+Legality tests over whole state columns (`matrix[from, to]` for 10k+
+lanes per wave) used to compile as LUT gathers — one non-fusable kernel
+per FSM walk. Packing the static matrix into u32 bit words turns the
+test into shift-and-mask arithmetic the VPU fuses into the callers'
+masks. TPU has no u64, so matrices up to 64 bits split across two words
+selected by a compare (for idx in [32, 64), `idx & 31 == idx - 32`, so
+one masked shift serves both words).
+
+Out-of-range codes (corrupted or uninitialized rows) are explicitly
+ILLEGAL: the old gather clamped them onto an arbitrary matrix entry,
+and an unmasked shift would be XLA-undefined — both replaced by a
+deterministic bounds test folded into the result.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+PackedBits = tuple[np.uint32, np.uint32, int, int]
+
+
+def pack_matrix_bits(matrix: np.ndarray) -> PackedBits:
+    """Row-major boolean matrix -> (lo, hi, n_rows, n_cols)."""
+    n = matrix.size
+    assert n <= 64, "transition matrix too large for two u32 words"
+    bits = sum(
+        int(v) << i for i, v in enumerate(matrix.reshape(-1).astype(np.uint8))
+    )
+    return (
+        np.uint32(bits & 0xFFFFFFFF),
+        np.uint32(bits >> 32),
+        matrix.shape[0],
+        matrix.shape[1],
+    )
+
+
+def matrix_bits_valid(
+    packed: PackedBits, frm: jnp.ndarray, to: jnp.ndarray
+) -> jnp.ndarray:
+    """bool[...]: packed[frm, to], False for any out-of-range code."""
+    lo, hi, n_rows, n_cols = packed
+    f = frm.astype(jnp.int32)
+    t = to.astype(jnp.int32)
+    in_range = (f >= 0) & (f < n_rows) & (t >= 0) & (t < n_cols)
+    idx = (
+        jnp.clip(f, 0, n_rows - 1).astype(jnp.uint32) * jnp.uint32(n_cols)
+        + jnp.clip(t, 0, n_cols - 1).astype(jnp.uint32)
+    )
+    word = jnp.where(idx < 32, jnp.uint32(lo), jnp.uint32(hi))
+    bit = (word >> (idx & jnp.uint32(31))) & 1 == 1
+    return in_range & bit

@@ -2,18 +2,23 @@
 
 The reference validates transitions one step at a time via dict lookups
 (`saga/state_machine.py:78-96`); here a whole saga table advances in one
-gather: `STEP_TRANSITION_MATRIX[from, to]` over int8 state columns. Retry
-ladders and fan-out policies are masked arithmetic — no Python in the loop.
+vectorized legality test: `STEP_TRANSITION_MATRIX` packed into u32 bit
+words, tested with shift-and-mask over int8 state columns. Retry ladders
+and fan-out policies are masked arithmetic — no Python in the loop.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
+from hypervisor_tpu.ops.bits import matrix_bits_valid, pack_matrix_bits
 from hypervisor_tpu.saga.state_machine import (
     SAGA_TRANSITION_MATRIX,
     STEP_TRANSITION_MATRIX,
 )
+
+_STEP_BITS = pack_matrix_bits(STEP_TRANSITION_MATRIX)
+_SAGA_BITS = pack_matrix_bits(SAGA_TRANSITION_MATRIX)
 
 # Step-state codes (order of saga.state_machine.StepState).
 STEP_PENDING = 0
@@ -32,14 +37,12 @@ SAGA_ESCALATED = 4
 
 
 def step_transition_valid(frm: jnp.ndarray, to: jnp.ndarray) -> jnp.ndarray:
-    """bool[...]: legality of each step transition (matrix gather)."""
-    m = jnp.asarray(STEP_TRANSITION_MATRIX)
-    return m[frm.astype(jnp.int32), to.astype(jnp.int32)] == 1
+    """bool[...]: legality of each step transition (bitmask test)."""
+    return matrix_bits_valid(_STEP_BITS, frm, to)
 
 
 def saga_transition_valid(frm: jnp.ndarray, to: jnp.ndarray) -> jnp.ndarray:
-    m = jnp.asarray(SAGA_TRANSITION_MATRIX)
-    return m[frm.astype(jnp.int32), to.astype(jnp.int32)] == 1
+    return matrix_bits_valid(_SAGA_BITS, frm, to)
 
 
 def apply_step_transitions(

@@ -2,7 +2,7 @@
 
 The reference guards session transitions with an in-method state check
 (`session/__init__.py:66-71` `_assert_state`); here legality is a
-boolean matrix gather so a whole wave of sessions advances in one op,
+bit-packed matrix test so a whole wave of sessions advances in one op,
 with illegal transitions surfacing as an error mask instead of
 exceptions (the facade re-raises for the single-call API).
 
@@ -17,6 +17,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from hypervisor_tpu.models import SessionState
+from hypervisor_tpu.ops.bits import matrix_bits_valid, pack_matrix_bits
 
 _CODES = {s: s.code for s in SessionState}
 
@@ -32,10 +33,16 @@ for _frm, _tos in {
         SESSION_TRANSITION_MATRIX[_CODES[_frm], _CODES[_to]] = 1
 
 
+# Packed legality bits (`ops.bits`): shift-and-mask instead of a LUT
+# gather — the wave runs three FSM walks over 10k lanes, and each gather
+# was a separate non-fusable kernel where the bit test fuses into the
+# callers' masks. Out-of-range codes test ILLEGAL deterministically.
+_TRANSITION_BITS = pack_matrix_bits(SESSION_TRANSITION_MATRIX)
+
+
 def session_transition_valid(frm: jnp.ndarray, to: jnp.ndarray) -> jnp.ndarray:
-    """bool[...]: legality of each session transition (matrix gather)."""
-    m = jnp.asarray(SESSION_TRANSITION_MATRIX)
-    return m[frm.astype(jnp.int32), to.astype(jnp.int32)] == 1
+    """bool[...]: legality of each session transition (bitmask test)."""
+    return matrix_bits_valid(_TRANSITION_BITS, frm, to)
 
 
 def apply_session_transitions(

@@ -222,20 +222,23 @@ def hex_to_words(hexes: list[str]) -> np.ndarray:
 # blocks. To stay bit-compatible on device we expand u32 digest words to
 # ASCII-hex bytes entirely with integer ops.
 
-_HEXCHARS = np.frombuffer(b"0123456789abcdef", dtype=np.uint8)
-
-
 def _words_to_hex_words(d: jnp.ndarray) -> jnp.ndarray:
     """u32[B,8] digest -> u32[B,16] big-endian words of its 64-char ASCII hex.
 
     Each u32 word w yields 8 hex chars; packed back as two u32 message words.
     """
-    hexchars = jnp.asarray(_HEXCHARS, dtype=jnp.uint32)
     b = d.shape[0]
     # nibbles: [B, 8 words, 8 nibbles] high-to-low
     shifts = np.arange(28, -4, -4, dtype=np.uint32)  # 28,24,...,0
     nibbles = (d[:, :, None] >> shifts[None, None, :]) & jnp.uint32(0xF)
-    chars = hexchars[nibbles]  # u32 ascii codes [B,8,8]
+    # nibble -> ASCII arithmetically ('0'..'9' = 0x30+n, 'a'..'f' =
+    # 0x61+n-10): branch-free adds/selects the VPU fuses into the
+    # neighboring shifts, where a 16-entry LUT would compile to a
+    # [B*64]-index gather (measured: 4 of the wave program's biggest
+    # gathers were exactly these lookups).
+    chars = nibbles + jnp.uint32(0x30) + jnp.where(
+        nibbles > 9, jnp.uint32(0x27), jnp.uint32(0)
+    )
     chars = chars.reshape(b, 16, 4)  # 4 ascii bytes per output word
     word = (
         chars[:, :, 0] << jnp.uint32(24)

@@ -169,3 +169,41 @@ def test_contribution_toward_equals_bruteforce(seed):
         if int(np.asarray(vouch.session)[e]) == int(target[vee]):
             want[vee] += float(np.asarray(vouch.bond)[e])
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_packed_transition_bits_match_matrices_exhaustively():
+    """The u32-bitmask legality tests equal the source boolean matrices
+    for EVERY (from, to) pair — the session 5x5, saga 5x5, and the
+    49-bit step 7x7 that spans two words (TPU has no u64)."""
+    import jax.numpy as jnp
+
+    from hypervisor_tpu.ops import saga_ops, session_fsm
+    from hypervisor_tpu.saga.state_machine import (
+        SAGA_TRANSITION_MATRIX,
+        STEP_TRANSITION_MATRIX,
+    )
+
+    cases = (
+        (session_fsm.session_transition_valid,
+         session_fsm.SESSION_TRANSITION_MATRIX),
+        (saga_ops.saga_transition_valid, SAGA_TRANSITION_MATRIX),
+        (saga_ops.step_transition_valid, STEP_TRANSITION_MATRIX),
+    )
+    for fn, matrix in cases:
+        n = matrix.shape[0]
+        frm, to = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+        got = np.asarray(
+            fn(jnp.asarray(frm.ravel(), jnp.int8),
+               jnp.asarray(to.ravel(), jnp.int8))
+        ).reshape(n, n)
+        np.testing.assert_array_equal(got, matrix.astype(bool))
+        # Out-of-range codes (corrupted/uninitialized rows) are ILLEGAL,
+        # deterministically — not clamped onto an arbitrary entry, not
+        # an undefined oversize shift.
+        bad = np.array([n, 7, 100, -1, 127], np.int8)
+        assert not np.asarray(
+            fn(jnp.asarray(bad), jnp.zeros(bad.shape, jnp.int8))
+        ).any()
+        assert not np.asarray(
+            fn(jnp.zeros(bad.shape, jnp.int8), jnp.asarray(bad))
+        ).any()

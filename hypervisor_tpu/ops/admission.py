@@ -141,10 +141,17 @@ def admit_batch(
     omega * contribution, 1.0) — the joint-liability formula
     (`liability/vouching.py:128-151`) applied in the admission wave.
     """
-    sess_state = sessions.state[session_slot]
-    sess_count = sessions.n_participants[session_slot]
-    sess_max = sessions.max_participants[session_slot]
-    sess_min_sigma = sessions.min_sigma_eff[session_slot]
+    # One row gather per packed block instead of one per column
+    # (tables/state.py SessionTable packing): [B, 3] i32 rows carry
+    # count+capacity, the i8 rows carry state, min-sigma rides the f32
+    # rows. Three gathers where the unpacked layout took four.
+    from hypervisor_tpu.tables import state as tables_state
+
+    sess_i32 = sessions.i32[session_slot]      # [B, 3]
+    sess_state = sessions.i8[session_slot][:, tables_state.SI8_STATE]
+    sess_count = sess_i32[:, tables_state.SI32_NPART]
+    sess_max = sess_i32[:, tables_state.SI32_MAX_PARTICIPANTS]
+    sess_min_sigma = sessions.f32[session_slot][:, tables_state.SF32_MIN_SIGMA]
 
     if contribution is None:
         sigma_eff = sigma_raw

@@ -177,44 +177,51 @@ def save_state(
     return target
 
 
-def _repack_legacy_agent_columns(data) -> dict:
-    """Checkpoints written before the AgentTable column packing saved one
-    array per column (`agents.sigma_raw`, ...); stack them into the
-    packed `agents.f32` / `agents.i32` blocks so old checkpoints restore
-    losslessly. No-op for current-format checkpoints."""
-    if "agents.f32" in data or "agents.sigma_raw" not in data:
-        return data if isinstance(data, dict) else {k: data[k] for k in data.files}
-    out = {k: data[k] for k in (data.files if hasattr(data, "files") else data)}
-    n = len(np.asarray(out["agents.sigma_raw"]))
-    # Derive the block layouts from the live schema (AgentTable._PACKED:
-    # name -> (block, idx)) so this repack can never drift from it.
-    from hypervisor_tpu.tables.state import AgentTable
+def _repack_legacy_packed_columns(data, tname: str, ttype) -> dict:
+    """Checkpoints written before a table's column packing saved one
+    array per column (`agents.sigma_raw`, `sessions.state`, ...); stack
+    them into the packed blocks so old checkpoints restore losslessly.
+
+    Fully schema-derived: the block layout comes from `ttype._PACKED`
+    and every default (a column the legacy save predates, e.g. a knob
+    added later) comes from `ttype.create(1)`'s value for that virtual
+    column — this helper can never drift from the live table
+    definition. No-op for current-format checkpoints and for tables
+    absent from the save entirely.
+    """
+    packed = getattr(ttype, "_PACKED", None)
+    if not packed:
+        return data
+    out = (
+        data
+        if isinstance(data, dict)
+        else {k: data[k] for k in data.files}
+    )
+    blocks = {block for block, _ in packed.values()}
+    if any(f"{tname}.{block}" in out for block in blocks):
+        return out  # current (packed) format
+    legacy = [name for name in packed if f"{tname}.{name}" in out]
+    if not legacy:
+        return out  # table not in this checkpoint at all
+    n = len(np.asarray(out[f"{tname}.{legacy[0]}"]))
+    fresh = ttype.create(1)
 
     by_block: dict[str, list[str]] = {}
-    for name, (block, idx) in AgentTable._PACKED.items():
+    for name, (block, idx) in packed.items():
         cols = by_block.setdefault(block, [])
         while len(cols) <= idx:
             cols.append("")
         cols[idx] = name
 
-    def col(name, dtype, default=0):
-        # A column the legacy save predates restores as its default
-        # (same forward-compat rule the per-column loader had).
-        arr = out.pop(f"agents.{name}", None)
-        if arr is None:
-            return np.full((n,), default, dtype)
-        return np.asarray(arr, dtype)
-
-    out["agents.f32"] = np.stack(
-        [col(name, np.float32) for name in by_block["f32"]], axis=1
-    )
-    out["agents.i32"] = np.stack(
-        [
-            col(name, np.int32, default=-1 if name in ("did", "session") else 0)
-            for name in by_block["i32"]
-        ],
-        axis=1,
-    )
+    for block, names in by_block.items():
+        dtype = np.asarray(getattr(fresh, block)).dtype
+        stacked = []
+        for name in names:
+            arr = out.pop(f"{tname}.{name}", None)
+            if arr is None:
+                arr = np.full((n,), np.asarray(getattr(fresh, name))[0])
+            stacked.append(np.asarray(arr, dtype))
+        out[f"{tname}.{block}"] = np.stack(stacked, axis=1)
     return out
 
 
@@ -251,7 +258,8 @@ def _rebuild(data, meta: dict, config: HypervisorConfig) -> HypervisorState:
             )
 
     state = HypervisorState(config)
-    data = _repack_legacy_agent_columns(data)
+    for tname, ttype in _TABLE_TYPES.items():
+        data = _repack_legacy_packed_columns(data, tname, ttype)
     for tname, ttype in _TABLE_TYPES.items():
         fields = dataclasses.fields(ttype)
         cols = {

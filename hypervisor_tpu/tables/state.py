@@ -88,39 +88,73 @@ class AgentTable:
         )
 
 
-@table
-class SessionTable:
-    """[S_sessions] columns mirroring SessionConfig + lifecycle state."""
+# SessionTable packed-block column indices (see struct.table "packed").
+SI32_SID = 0
+SI32_MAX_PARTICIPANTS = 1
+SI32_NPART = 2
+SF32_MIN_SIGMA = 0
+SF32_CREATED_AT = 1
+SF32_TERMINATED_AT = 2
+SF32_MAX_DURATION = 3
+SI8_STATE = 0
+SI8_MODE = 1
 
-    sid: jnp.ndarray              # i32[S] intern handle of session id (-1 = free)
-    state: jnp.ndarray            # i8[S]  SessionState.code
-    mode: jnp.ndarray             # i8[S]  ConsistencyMode.code
-    max_participants: jnp.ndarray # i32[S]
-    min_sigma_eff: jnp.ndarray    # f32[S]
+
+@table(
+    packed={
+        "sid": ("i32", SI32_SID),
+        "max_participants": ("i32", SI32_MAX_PARTICIPANTS),
+        "n_participants": ("i32", SI32_NPART),
+        "min_sigma_eff": ("f32", SF32_MIN_SIGMA),
+        "created_at": ("f32", SF32_CREATED_AT),
+        "terminated_at": ("f32", SF32_TERMINATED_AT),
+        "max_duration": ("f32", SF32_MAX_DURATION),
+        "state": ("i8", SI8_STATE),
+        "mode": ("i8", SI8_MODE),
+    }
+)
+class SessionTable:
+    """[S_sessions] columns mirroring SessionConfig + lifecycle state.
+
+    Packed by dtype like AgentTable: the wave's per-lane session reads
+    (admission's state/capacity/count/min-sigma, the FSM walk, the
+    terminate stamps) collapse from one gather per column to one per
+    block. Legacy column names stay readable (`sessions.state`) and
+    writable through `tables.struct.replace`.
+
+      i32[S, 3]: sid (-1 = free), max_participants, n_participants
+      f32[S, 4]: min_sigma_eff, created_at, terminated_at, max_duration
+      i8[S, 2]:  state (SessionState.code), mode (ConsistencyMode.code)
+
+    The two rarely-read bools stay standalone columns.
+    """
+
+    i32: jnp.ndarray              # i32[S, 3] packed int columns (SI32_*)
+    f32: jnp.ndarray              # f32[S, 4] packed float columns (SF32_*)
+    i8: jnp.ndarray               # i8[S, 2] packed code columns (SI8_*)
     enable_audit: jnp.ndarray     # bool[S]
-    n_participants: jnp.ndarray   # i32[S] active-participant count
-    created_at: jnp.ndarray       # f32[S]
-    terminated_at: jnp.ndarray    # f32[S]
     has_nonreversible: jnp.ndarray  # bool[S] drives STRONG forcing
-    max_duration: jnp.ndarray     # f32[S] seconds; 0 = unlimited
 
     @staticmethod
     def create(capacity: int) -> "SessionTable":
-        # Every column gets its OWN buffer: aliasing one zeros array
-        # across columns breaks buffer donation (XLA refuses to donate
-        # the same buffer twice in one call).
+        # Every block/column gets its OWN buffer: aliasing one zeros
+        # array across fields breaks buffer donation (XLA refuses to
+        # donate the same buffer twice in one call).
+        i32 = jnp.zeros((capacity, 3), jnp.int32)
+        i32 = (
+            i32.at[:, SI32_SID].set(-1)
+            .at[:, SI32_MAX_PARTICIPANTS].set(10)
+        )
+        f32 = jnp.zeros((capacity, 4), jnp.float32)
+        f32 = f32.at[:, SF32_MIN_SIGMA].set(0.60)
+        i8 = jnp.zeros((capacity, 2), jnp.int8)
+        i8 = i8.at[:, SI8_MODE].set(1)  # EVENTUAL
         return SessionTable(
-            sid=jnp.full((capacity,), -1, jnp.int32),
-            state=jnp.zeros((capacity,), jnp.int8),
-            mode=jnp.ones((capacity,), jnp.int8),  # EVENTUAL
-            max_participants=jnp.full((capacity,), 10, jnp.int32),
-            min_sigma_eff=jnp.full((capacity,), 0.60, jnp.float32),
+            i32=i32,
+            f32=f32,
+            i8=i8,
             enable_audit=jnp.ones((capacity,), bool),
-            n_participants=jnp.zeros((capacity,), jnp.int32),
-            created_at=jnp.zeros((capacity,), jnp.float32),
-            terminated_at=jnp.zeros((capacity,), jnp.float32),
             has_nonreversible=jnp.zeros((capacity,), bool),
-            max_duration=jnp.zeros((capacity,), jnp.float32),
         )
 
 

@@ -56,7 +56,15 @@ def _build(lo: int, k: int, b: int):
 
     # One live vouch edge toward joiner 0's session; one edge scoped to a
     # session OUTSIDE the wave range (must stay active through terminate).
-    outside = (lo + k) % S_CAP if (lo + k) < S_CAP else (lo - 1 if lo else 0)
+    # When the range covers the whole table no real slot is outside —
+    # fall back to an unattached sentinel (-5), which every membership
+    # path must treat as matching nothing.
+    if (lo + k) < S_CAP:
+        outside = lo + k
+    elif lo > 0:
+        outside = lo - 1
+    else:
+        outside = -5
     vouches = t_replace(
         vouches,
         voucher=vouches.voucher.at[0].set(N_CAP - 1),

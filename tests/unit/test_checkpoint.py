@@ -207,21 +207,22 @@ def test_restore_legacy_percolumn_checkpoint(tmp_path):
     st = _populated_state()
     target = save_state(st, tmp_path, step=7)
 
-    # Rewrite tables.npz in the LEGACY format: unpack the blocks into
-    # per-column arrays, and drop one column to simulate an old save.
+    # Rewrite tables.npz in the LEGACY format: unpack EVERY packed
+    # table's blocks into per-column arrays (schema-derived, so this
+    # test keeps covering any table packed later), and drop one column
+    # to simulate an old save.
+    from hypervisor_tpu.tables.state import AgentTable, SessionTable
+
     path = target / "tables.npz"
     data = dict(np.load(path))
-    f32 = data.pop("agents.f32")
-    i32 = data.pop("agents.i32")
-    f32_names = (
-        "sigma_raw", "sigma_eff", "joined_at", "risk_score",
-        "rl_tokens", "rl_stamp", "bd_breaker_until", "quarantine_until",
-    )
-    i32_names = ("did", "session", "flags", "bd_calls", "bd_privileged")
-    for i, name in enumerate(f32_names):
-        data[f"agents.{name}"] = f32[:, i]
-    for i, name in enumerate(i32_names):
-        data[f"agents.{name}"] = i32[:, i]
+    for tname, ttype in (("agents", AgentTable), ("sessions", SessionTable)):
+        blocks = {}
+        for name, (block, idx) in ttype._PACKED.items():
+            blocks.setdefault(block, []).append((idx, name))
+        for block, cols in blocks.items():
+            arr = data.pop(f"{tname}.{block}")
+            for idx, name in cols:
+                data[f"{tname}.{name}"] = arr[:, idx]
     del data["agents.quarantine_until"]
     with open(path, "wb") as f:
         np.savez(f, **data)
@@ -233,6 +234,16 @@ def test_restore_legacy_percolumn_checkpoint(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(back.agents.did), np.asarray(st.agents.did)
     )
+    # Session columns restore losslessly through the repack too (this
+    # exact path silently wiped sessions when only agents were
+    # repacked: sid=-1/state=0 rows under intact host metadata).
+    for col in ("sid", "state", "mode", "n_participants",
+                "max_participants", "min_sigma_eff"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(back.sessions, col)),
+            np.asarray(getattr(st.sessions, col)),
+            err_msg=f"sessions.{col} diverged",
+        )
     # Missing column came back as its freshly-created default (zeros).
     assert not np.asarray(back.agents.quarantine_until).any()
     # And the restored state still ticks.

@@ -178,9 +178,13 @@ def run_bench() -> None:
         mesh = make_mesh(mesh_n)
         agent_slots = state._mesh_wave_slots(b, mesh_n)
         # The wave's sessions are arange(base, base+K) by construction
-        # (create_sessions_batch), so the contiguous variant applies:
-        # terminate rides range compares, no mask psum.
-        wave_fn = sharded_governance_wave(mesh, contiguous_waves=True)
+        # (create_sessions_batch) and one join targets each session, so
+        # both layout contracts apply: terminate rides range compares
+        # (no mask psum) and admission skips the capacity-rank
+        # all_gathers (every rank is 0).
+        wave_fn = sharded_governance_wave(
+            mesh, contiguous_waves=True, unique_sessions=True
+        )
     else:
         agent_slots = np.arange(b, dtype=np.int32)
         wave_fn = None
@@ -279,7 +283,7 @@ def run_bench() -> None:
     def execute():
         if wave_fn is not None:
             return wave_fn(*wave_args, *wave_range)
-        return _WAVE(*wave_args, wave_range=wave_range)
+        return _WAVE(*wave_args, wave_range=wave_range, unique_sessions=True)
 
     # Warmup (compile + cache).
     for _ in range(WARMUP):

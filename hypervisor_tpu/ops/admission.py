@@ -24,7 +24,15 @@ import jax.numpy as jnp
 
 from hypervisor_tpu.config import DEFAULT_CONFIG, TrustConfig
 from hypervisor_tpu.ops import rings as ring_ops
-from hypervisor_tpu.tables.state import AgentTable, SessionTable, FLAG_ACTIVE
+from hypervisor_tpu.tables.state import (
+    AgentTable,
+    FLAG_ACTIVE,
+    SF32_MIN_SIGMA,
+    SI8_STATE,
+    SI32_MAX_PARTICIPANTS,
+    SI32_NPART,
+    SessionTable,
+)
 from hypervisor_tpu.tables.struct import replace
 
 # Admission status codes (host maps to SessionParticipantError /
@@ -133,6 +141,7 @@ def admit_batch(
     contribution: jnp.ndarray | None = None,  # f32[B] bonded sigma toward each agent
     omega: jnp.ndarray | float = 0.0,
     ring_bursts: jnp.ndarray | None = None,   # f32[4] configured bucket bursts
+    unique_sessions: bool = False,
 ) -> AdmissionResult:
     """Admit a wave of B agents; rejected elements leave no trace.
 
@@ -140,18 +149,23 @@ def admit_batch(
     `ops.liability.voucher_contribution`), sigma_eff = min(sigma_raw +
     omega * contribution, 1.0) — the joint-liability formula
     (`liability/vouching.py:128-151`) applied in the admission wave.
+
+    unique_sessions (static): host-verified assertion that no two lanes
+    that can consume a seat target the same session — then every rank
+    is 0 and the capacity check needs no argsort (the bench's
+    one-join-per-session wave qualifies; `state.py` verifies among
+    non-duplicate lanes). A violating wave would over-admit: callers
+    must gate on the host check, like `wave_range`.
     """
     # One row gather per packed block instead of one per column
     # (tables/state.py SessionTable packing): [B, 3] i32 rows carry
     # count+capacity, the i8 rows carry state, min-sigma rides the f32
     # rows. Three gathers where the unpacked layout took four.
-    from hypervisor_tpu.tables import state as tables_state
-
     sess_i32 = sessions.i32[session_slot]      # [B, 3]
-    sess_state = sessions.i8[session_slot][:, tables_state.SI8_STATE]
-    sess_count = sess_i32[:, tables_state.SI32_NPART]
-    sess_max = sess_i32[:, tables_state.SI32_MAX_PARTICIPANTS]
-    sess_min_sigma = sessions.f32[session_slot][:, tables_state.SF32_MIN_SIGMA]
+    sess_state = sessions.i8[session_slot][:, SI8_STATE]
+    sess_count = sess_i32[:, SI32_NPART]
+    sess_max = sess_i32[:, SI32_MAX_PARTICIPANTS]
+    sess_min_sigma = sessions.f32[session_slot][:, SF32_MIN_SIGMA]
 
     if contribution is None:
         sigma_eff = sigma_raw
@@ -178,13 +192,16 @@ def admit_batch(
     # rejected element must not consume a seat). Rejected elements get a
     # unique negative session key so they never share a rank group.
     passed_other = status == ADMIT_OK
-    rank = _rank_within_session(
-        jnp.where(
-            passed_other,
-            session_slot,
-            -1 - jnp.arange(slot.shape[0], dtype=jnp.int32),
+    if unique_sessions:
+        rank = jnp.zeros(slot.shape, jnp.int32)
+    else:
+        rank = _rank_within_session(
+            jnp.where(
+                passed_other,
+                session_slot,
+                -1 - jnp.arange(slot.shape[0], dtype=jnp.int32),
+            )
         )
-    )
     over_capacity = passed_other & ((sess_count + rank) >= sess_max)
     status = claim(status, over_capacity, ADMIT_CAPACITY)
     ok = status == ADMIT_OK

@@ -196,6 +196,7 @@ def governance_wave(
     use_pallas: bool | None = None,
     ring_bursts: jnp.ndarray | None = None,
     wave_range: tuple[jnp.ndarray, jnp.ndarray] | None = None,
+    unique_sessions: bool = False,
 ) -> WaveResult:
     """The full governance pipeline AS ONE PROGRAM over the state tables.
 
@@ -251,6 +252,7 @@ def governance_wave(
         contribution=contribution,
         omega=omega,
         ring_bursts=ring_bursts,
+        unique_sessions=unique_sessions,
     )
     agents, sessions = admitted.agents, admitted.sessions
     ok = admitted.status == admission_ops.ADMIT_OK

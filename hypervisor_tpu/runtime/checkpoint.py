@@ -94,7 +94,11 @@ def host_metadata(state: HypervisorState) -> dict:
         "next_saga_slot": state._next_saga_slot,
         "next_edge_slot": state._next_edge_slot,
         "next_elev_slot": state._next_elev_slot,
-        "members": sorted([list(k) for k in state._members]),
+        # On-disk format stays [session, did] pairs (stable across the
+        # in-memory move to packed int keys).
+        "members": sorted(
+            [[k >> 32, k & 0xFFFFFFFF] for k in state._members]
+        ),
         "free_agent_slots": list(state._free_agent_slots),
         "free_edge_slots": list(state._free_edge_slots),
         "free_elev_slots": list(state._free_elev_slots),
@@ -286,7 +290,9 @@ def _rebuild(data, meta: dict, config: HypervisorConfig) -> HypervisorState:
     state._next_saga_slot = int(meta.get("next_saga_slot", 0))
     state._next_edge_slot = int(meta.get("next_edge_slot", 0))
     state._next_elev_slot = int(meta.get("next_elev_slot", 0))
-    state._members = {(int(a), int(b)): True for a, b in meta["members"]}
+    state._members = {
+        (int(a) << 32) | (int(b) & 0xFFFFFFFF) for a, b in meta["members"]
+    }
     state._audit_rows = {
         int(k): [int(r) for r in v] for k, v in meta.get("audit_rows", {}).items()
     }

@@ -110,6 +110,18 @@ def _isolation_refusal_from(
     return None
 
 
+def _mkey(session: int, did: int) -> int:
+    """(session, did) membership packed into one int set key."""
+    return (int(session) << 32) | (int(did) & 0xFFFFFFFF)
+
+
+def _mkeys(sessions: np.ndarray, dids: np.ndarray) -> np.ndarray:
+    """Vectorized `_mkey` over whole waves -> int64[B]."""
+    return (
+        np.asarray(sessions, np.int64) << 32
+    ) | (np.asarray(dids, np.int64) & 0xFFFFFFFF)
+
+
 def _contiguous_range(slots: np.ndarray) -> tuple | None:
     """(lo, hi) i32 scalars if `slots` is exactly arange(lo, lo+len).
 
@@ -162,7 +174,11 @@ class HypervisorState:
         self._fanout_groups: dict[int, list[tuple[int, list[int]]]] = {}
         self._next_elev_slot = 0
         self._free_elev_slots: list[int] = []
-        self._members: dict[tuple[int, int], bool] = {}  # (session, did) -> True
+        # Membership keys are (session << 32) | did packed ints (see
+        # `_mkey`): a 10k-lane wave does one set lookup + insert per
+        # lane on host, and tuple keys made that a measurable slice of
+        # staging (tuple allocation + two int() casts per element).
+        self._members: set[int] = set()
         # One device row per MEMBERSHIP — (did, session) -> agent slot.
         # An agent live in several sessions holds several rows, each with
         # its own ring/sigma/quarantine columns, so session-scoped actions
@@ -185,7 +201,7 @@ class HypervisorState:
         self._queue = StagingQueue(capacity=cap.max_agents)
         self._enqueue_lock = threading.Lock()
         self._pending_rows: dict[int, tuple[int, int, bool]] = {}  # slot -> did, sess, dup
-        self._staged_members: set[tuple[int, int]] = set()  # in-wave dedup
+        self._staged_members: set[int] = set()  # in-wave dedup (_mkey keys)
 
         # Pending delta wave + per-session audit index into the DeltaLog.
         # sess -> list of log rows; chain seed u32[8]; turn counter.
@@ -425,12 +441,12 @@ class HypervisorState:
             )
             self._next_agent_slot += b
         handles = np.array([self.agent_ids.intern(d) for d in dids], np.int32)
-        duplicate = np.array(
-            [
-                (int(s), int(h)) in self._members
-                for s, h in zip(agent_sessions, handles)
-            ],
+        wave_keys = _mkeys(agent_sessions, handles)
+        members = self._members
+        duplicate = np.fromiter(
+            (k in members for k in wave_keys.tolist()),
             bool,
+            count=len(handles),
         )
         if trustworthy is None:
             trustworthy = np.ones(b, bool)
@@ -576,17 +592,17 @@ class HypervisorState:
                     )
 
         ok = np.asarray(result.status) == admission.ADMIT_OK
-        for s, h, slot, is_ok in zip(agent_sessions, handles, agent_slots, ok):
-            if is_ok:
-                self._members[(int(s), int(h))] = True
-            # Every wave row is dead after the wave: rejected rows were
-            # never admitted, admitted rows belong to sessions this same
-            # program terminated — all reclaim (device-table GC), and
-            # none are cached in _slot_of_member. Mesh-wave rows recycle
-            # through their own deterministic top-region layout instead
-            # of the general free list (see _mesh_wave_slots).
-            if mesh is None:
-                self._free_agent_slots.append(int(slot))
+        self._members.update(wave_keys[ok[: len(wave_keys)]].tolist())
+        # Every wave row is dead after the wave: rejected rows were
+        # never admitted, admitted rows belong to sessions this same
+        # program terminated — all reclaim (device-table GC), and
+        # none are cached in _slot_of_member. Mesh-wave rows recycle
+        # through their own deterministic top-region layout instead
+        # of the general free list (see _mesh_wave_slots).
+        if mesh is None:
+            self._free_agent_slots.extend(
+                np.asarray(agent_slots)[: len(wave_keys)].tolist()
+            )
 
         # Record the wave's audit chain in the DeltaLog (lane-major).
         chain = np.asarray(result.chain)  # [T, K, 8]
@@ -695,7 +711,7 @@ class HypervisorState:
             # Duplicate against admitted members AND same-wave stagings:
             # two concurrent joins of one (session, did) must not both
             # admit when the wave flushes.
-            key = (session_slot, did)
+            key = _mkey(session_slot, did)
             duplicate = key in self._members or key in self._staged_members
             q = self._queue.push(sigma_raw, agent_slot, session_slot, trustworthy)
             if q < 0:
@@ -753,9 +769,9 @@ class HypervisorState:
             status = np.asarray(result.status)
             for (slot, did, sess, dup), st in zip(rows, status):
                 if not dup:
-                    self._staged_members.discard((sess, did))
+                    self._staged_members.discard(_mkey(sess, did))
                 if st == admission.ADMIT_OK:
-                    self._members[(sess, did)] = True
+                    self._members.add(_mkey(sess, did))
                     self._slot_of_member[(did, sess)] = slot
                 else:
                     # A rejected join leaves no trace; its row is reusable.
@@ -2055,7 +2071,7 @@ class HypervisorState:
     def is_member(self, session_slot: int, agent_did: str) -> bool:
         """Was this agent admitted into the session (by ANY flush)?"""
         did = self.agent_ids.lookup(agent_did)
-        return did >= 0 and (session_slot, did) in self._members
+        return did >= 0 and _mkey(session_slot, did) in self._members
 
     def participant_count(self, session_slot: int) -> int:
         return int(np.asarray(self.sessions.n_participants)[session_slot])

@@ -49,6 +49,7 @@ DOMINANT = {
     "action_gateway": "none (shard-local by placement contract)",
     "fused_wave": "all-reduce (admission + session folds)",
     "fused_wave_contiguous": "all-reduce (terminate mask psum removed)",
+    "fused_wave_fastpaths": "all-reduce (rank all_gathers removed too)",
     "fused_wave_gw_modes": "all-reduce (admission + session folds)",
 }
 
@@ -188,6 +189,43 @@ def build_phase_programs(n_dev: int, rows_per_shard: int = 16):
     ), (
         *wave_args,
         jnp.asarray(0, jnp.int32), jnp.asarray(k, jnp.int32),
+    )
+
+    # Both host-verified layout contracts at once (the bench's shape:
+    # ONE join per session). SAME join count b as the other fused
+    # phases so the p50 column stays comparable on the load driver —
+    # which forces b wave sessions (the contract's price, also the
+    # 10k-session bench's own shape): terminate mask psum gone AND the
+    # admission capacity-rank all_gathers gone; the fused wave's only
+    # remaining collectives are the admission psums and session folds.
+    sessions_u = SessionTable.create(2 * b)
+    wsu = jnp.arange(b)
+    sessions_u = t_replace(
+        sessions_u,
+        state=sessions_u.state.at[wsu].set(
+            jnp.int8(SessionState.HANDSHAKING.code)
+        ),
+        max_participants=sessions_u.max_participants.at[wsu].set(32),
+        min_sigma_eff=sessions_u.min_sigma_eff.at[wsu].set(0.0),
+    )
+    bodies_u = rng.randint(
+        0, 2**32, size=(t, b, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    join_cols_u = (
+        jnp.asarray(slots),
+        jnp.arange(b, dtype=jnp.int32),
+        jnp.arange(b, dtype=jnp.int32),      # one join per session
+        jnp.full((b,), 0.8, jnp.float32),
+        jnp.ones((b,), bool),
+        jnp.zeros((b,), bool),
+    )
+    yield "fused_wave_fastpaths", sharded_governance_wave(
+        mesh, contiguous_waves=True, unique_sessions=True
+    ), (
+        agents, sessions_u, vouches, *join_cols_u,
+        jnp.asarray(np.arange(b, dtype=np.int32)), jnp.asarray(bodies_u),
+        0.0, 0.5,
+        jnp.asarray(0, jnp.int32), jnp.asarray(b, jnp.int32),
     )
 
     yield "fused_wave_gw_modes", sharded_governance_wave(

@@ -592,7 +592,9 @@ class HypervisorState:
                     )
 
         ok = np.asarray(result.status) == admission.ADMIT_OK
-        self._members.update(wave_keys[ok[: len(wave_keys)]].tolist())
+        # result.status was trimmed to [:b] above on the padded mesh
+        # branch, so ok is exactly wave_keys-length on every path.
+        self._members.update(wave_keys[ok].tolist())
         # Every wave row is dead after the wave: rejected rows were
         # never admitted, admitted rows belong to sessions this same
         # program terminated — all reclaim (device-table GC), and
@@ -600,9 +602,7 @@ class HypervisorState:
         # through their own deterministic top-region layout instead
         # of the general free list (see _mesh_wave_slots).
         if mesh is None:
-            self._free_agent_slots.extend(
-                np.asarray(agent_slots)[: len(wave_keys)].tolist()
-            )
+            self._free_agent_slots.extend(np.asarray(agent_slots).tolist())
 
         # Record the wave's audit chain in the DeltaLog (lane-major).
         chain = np.asarray(result.chain)  # [T, K, 8]

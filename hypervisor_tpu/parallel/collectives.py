@@ -692,6 +692,7 @@ def sharded_governance_wave(
     mode_dispatch: bool = False,
     contiguous_waves: bool = False,
     unique_sessions: bool = False,
+    use_pallas: bool | None = None,
 ):
     """The FUSED full-governance wave, end-to-end sharded (round-3 item).
 
@@ -757,7 +758,8 @@ def sharded_governance_wave(
     from hypervisor_tpu.ops.pipeline import WaveResult
 
     n_shards = mesh.devices.size
-    use_pallas = _mesh_uses_pallas(mesh)
+    if use_pallas is None:
+        use_pallas = _mesh_uses_pallas(mesh)
 
     def step(
         agents,

@@ -1,0 +1,156 @@
+"""admit_batch vs an independent scalar Python oracle.
+
+The oracle re-implements the reference's join semantics directly from
+the reference's rules (NOT by calling any hypervisor_tpu op):
+per-agent, in wave order — state guard, duplicate, sigma floor with
+the sandbox exemption, then capacity as seats fill
+(`/root/reference/src/hypervisor/session/__init__.py:85-113`,
+`core.py:153-175`; ring thresholds `models.py:34-42`; vouched
+sigma_eff `liability/vouching.py:128-151`). Randomized waves with
+mixed duplicates, tight capacities, low sigmas, and untrustworthy
+agents must produce identical statuses, rings, sigma_eff, and
+participant counts on both the ranked and unique-free paths where they
+apply.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.models import SessionState
+from hypervisor_tpu.ops import admission
+from hypervisor_tpu.tables.state import AgentTable, SessionTable
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+B, S_CAP, N_CAP = 48, 12, 96
+OMEGA = 0.5
+
+
+def _oracle(wave, sessions_init, trust):
+    """Reference-semantics scalar walk. Returns (status, ring,
+    sigma_eff, counts)."""
+    state = dict(sessions_init["state"])
+    counts = dict(sessions_init["counts"])
+    cap = sessions_init["max_participants"]
+    min_sig = sessions_init["min_sigma_eff"]
+    out_status, out_ring, out_sig = [], [], []
+    for lane in wave:
+        s = lane["session"]
+        sigma_eff = min(lane["sigma_raw"] + OMEGA * lane["contribution"], 1.0)
+        # Ring from sigma (no consensus in this wave), sandbox override.
+        if lane["trustworthy"]:
+            if sigma_eff > trust.ring1_threshold:  # needs consensus -> never 1
+                ring = 2 if sigma_eff > trust.ring2_threshold else 3
+            elif sigma_eff > trust.ring2_threshold:
+                ring = 2
+            else:
+                ring = 3
+        else:
+            ring = 3
+        status = 0
+        if state[s] not in (
+            SessionState.HANDSHAKING.code,
+            SessionState.ACTIVE.code,
+        ):
+            status = admission.ADMIT_BAD_STATE
+        elif lane["duplicate"]:
+            status = admission.ADMIT_DUPLICATE
+        elif sigma_eff < min_sig[s] and ring != 3:
+            status = admission.ADMIT_SIGMA_LOW
+        elif counts[s] >= cap[s]:
+            status = admission.ADMIT_CAPACITY
+        if status == 0:
+            counts[s] += 1
+        out_status.append(status)
+        out_ring.append(ring)
+        out_sig.append(sigma_eff)
+    return out_status, out_ring, out_sig, counts
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_admit_batch_matches_scalar_oracle(seed):
+    rng = np.random.RandomState(100 + seed)
+    trust = DEFAULT_CONFIG.trust
+
+    # Sessions: random states (mostly joinable), tight capacities,
+    # random floors, some pre-filled counts.
+    states = rng.choice(
+        [
+            SessionState.CREATED.code,
+            SessionState.HANDSHAKING.code,
+            SessionState.ACTIVE.code,
+            SessionState.ARCHIVED.code,
+        ],
+        size=S_CAP,
+        p=[0.1, 0.5, 0.3, 0.1],
+    ).astype(np.int8)
+    caps = rng.randint(1, 5, S_CAP)
+    floors = rng.choice([0.0, 0.6, 0.8], size=S_CAP)
+    pre_counts = rng.randint(0, 2, S_CAP)
+
+    sessions = SessionTable.create(S_CAP)
+    sessions = t_replace(
+        sessions,
+        state=jnp.asarray(states),
+        max_participants=jnp.asarray(caps, jnp.int32),
+        min_sigma_eff=jnp.asarray(floors, jnp.float32),
+        n_participants=jnp.asarray(pre_counts, jnp.int32),
+    )
+    agents = AgentTable.create(N_CAP)
+
+    session_slot = rng.randint(0, S_CAP, B).astype(np.int32)
+    sigma_raw = rng.choice([0.3, 0.55, 0.7, 0.9, 0.99], size=B).astype(
+        np.float32
+    )
+    contribution = rng.choice([0.0, 0.0, 0.2, 0.5], size=B).astype(np.float32)
+    trustworthy = rng.rand(B) > 0.15
+    duplicate = rng.rand(B) < 0.1
+
+    wave = [
+        dict(
+            session=int(session_slot[i]),
+            sigma_raw=float(sigma_raw[i]),
+            contribution=float(contribution[i]),
+            trustworthy=bool(trustworthy[i]),
+            duplicate=bool(duplicate[i]),
+        )
+        for i in range(B)
+    ]
+    want_status, want_ring, want_sig, want_counts = _oracle(
+        wave,
+        dict(
+            state={i: int(states[i]) for i in range(S_CAP)},
+            counts={i: int(pre_counts[i]) for i in range(S_CAP)},
+            max_participants={i: int(caps[i]) for i in range(S_CAP)},
+            min_sigma_eff={i: float(floors[i]) for i in range(S_CAP)},
+        ),
+        trust,
+    )
+
+    got = admission.admit_batch(
+        agents,
+        sessions,
+        slot=jnp.arange(B, dtype=jnp.int32),
+        did=jnp.arange(B, dtype=jnp.int32),
+        session_slot=jnp.asarray(session_slot),
+        sigma_raw=jnp.asarray(sigma_raw),
+        trustworthy=jnp.asarray(trustworthy),
+        duplicate=jnp.asarray(duplicate),
+        now=1.0,
+        contribution=jnp.asarray(contribution),
+        omega=OMEGA,
+    )
+    np.testing.assert_array_equal(np.asarray(got.status), want_status)
+    np.testing.assert_array_equal(np.asarray(got.ring), want_ring)
+    np.testing.assert_allclose(
+        np.asarray(got.sigma_eff), np.asarray(want_sig, np.float32),
+        rtol=0, atol=1e-6,
+    )
+    got_counts = np.asarray(got.sessions.n_participants)
+    for s in range(S_CAP):
+        assert int(got_counts[s]) == want_counts[s], (s, seed)

@@ -34,6 +34,7 @@ Run: nohup python benchmarks/capture_evidence.py >/dev/null 2>&1 &
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
@@ -155,9 +156,24 @@ def run_step(
 
 
 def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--deadline",
+        type=float,
+        default=0.0,
+        help="unix timestamp after which no NEW step starts (the round "
+        "driver runs its own bench at round end — two claimants on the "
+        "single-claim tunnel wedge each other; stop before it starts)",
+    )
+    args = ap.parse_args()
     journal = load_journal()
-    log(f"daemon start, pid={os.getpid()}")
+    log(f"daemon start, pid={os.getpid()}, deadline={args.deadline or 'none'}")
     while True:
+        if args.deadline and time.time() >= args.deadline:
+            save_journal(journal)
+            log("deadline reached — daemon exits (tunnel freed for the "
+                "round driver)")
+            return
         runnable = []
         parked = []
         waiting = []

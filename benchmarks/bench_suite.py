@@ -173,9 +173,11 @@ def build_benchmarks(quick: bool):
     # ── session_lifecycle: admit a wave of S agents into S sessions ────
     agents = AgentTable.create(1 << (S - 1).bit_length())
     sessions = SessionTable.create(1 << (S - 1).bit_length())
-    import dataclasses as dc
+    from hypervisor_tpu.tables.struct import replace as t_replace
 
-    sessions = dc.replace(
+    # struct.replace, not dataclasses.replace: state/max_participants/
+    # min_sigma_eff are packed virtual columns now.
+    sessions = t_replace(
         sessions,
         state=sessions.state.at[:S].set(1),  # HANDSHAKING
         max_participants=sessions.max_participants.at[:].set(10),
@@ -336,6 +338,55 @@ def build_benchmarks(quick: bool):
         jnp.ones((S,), bool),
     )
     yield "full_governance_pipeline", jax.jit(governance_pipeline), pipe_args, S
+
+    # ── state-table wave, general vs fast-path (round-4 delta) ─────────
+    # The SAME staged wave through ops.pipeline.governance_wave twice:
+    # once on the general program (mask terminate, ranked capacity) and
+    # once with the host-verified layout contracts (wave_range +
+    # unique_sessions). The pair quantifies the round-4 program
+    # reductions on whatever backend runs this suite.
+    from hypervisor_tpu.ops.pipeline import governance_wave
+
+    wv_agents = AgentTable.create(2 * S)
+    wv_sessions = SessionTable.create(2 * S)
+    wvs = jnp.arange(S)
+    wv_sessions = t_replace(
+        wv_sessions,
+        state=wv_sessions.state.at[wvs].set(1),  # HANDSHAKING
+        max_participants=wv_sessions.max_participants.at[wvs].set(10),
+        min_sigma_eff=wv_sessions.min_sigma_eff.at[wvs].set(0.0),
+    )
+    wv_vouches = VouchTable.create(4096)
+    wave_cols = (
+        jnp.arange(S, dtype=jnp.int32),
+        jnp.arange(S, dtype=jnp.int32),
+        jnp.arange(S, dtype=jnp.int32),
+        jnp.full((S,), 0.8, jnp.float32),
+        jnp.ones((S,), bool),
+        jnp.zeros((S,), bool),
+        jnp.arange(S, dtype=jnp.int32),
+        bodies3,
+        0.0,
+        0.5,
+    )
+    wave_jit = jax.jit(
+        governance_wave, static_argnames=("use_pallas", "unique_sessions")
+    )
+    # Staged OUTSIDE the timed callables: the fast path must not be
+    # charged per-iteration device_puts the general path never pays.
+    wave_range = (jnp.asarray(0, jnp.int32), jnp.asarray(S, jnp.int32))
+
+    def wave_general(*args):
+        return wave_jit(*args).status
+
+    def wave_fastpath(*args):
+        return wave_jit(
+            *args, wave_range=wave_range, unique_sessions=True
+        ).status
+
+    wave_args = (wv_agents, wv_sessions, wv_vouches, *wave_cols)
+    yield "state_wave_general", wave_general, wave_args, S
+    yield "state_wave_fastpath", wave_fastpath, wave_args, S
 
 
 def main() -> None:

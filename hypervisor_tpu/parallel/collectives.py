@@ -185,10 +185,21 @@ def _wave_admission(
     rate=DEFAULT_CONFIG.rate_limit,
     mode_dispatch: bool = False,
     unique_sessions: bool = False,
+    row_axes=AGENT_AXIS,
+    force_eventual: bool = False,
 ):
     """The cross-shard admission body (inside shard_map) shared by
     `sharded_admission` and `sharded_governance_wave` so the two can
     never drift. See `sharded_admission` for the collective design.
+
+    `row_axes` names the mesh axes agent/vouch ROWS shard over:
+    AGENT_AXIS on a 1-D mesh; (DCN_AXIS, AGENT_AXIS) on a multislice
+    mesh, where the row-map/contribution psums must reduce over BOTH
+    axes (edges may live on any slice) while view arithmetic stays
+    slice-local. `force_eventual` defers EVERY replica commit to the
+    between-tick reconcile regardless of the session mode column — the
+    multislice contract, where cross-slice consensus inside a tick is
+    exactly what the design forbids.
 
     `unique_sessions` (static, host-verified like the single-device
     op): no two seat-consuming lanes share a session, so every rank is
@@ -207,7 +218,15 @@ def _wave_admission(
     extra (view_counts [S_cap], ev_counts_local [S_cap]) pair."""
     b_local = slot.shape[0]
     rows_per_shard = agents.did.shape[0]
-    my_shard = jax.lax.axis_index(AGENT_AXIS)
+    if row_axes == AGENT_AXIS:
+        my_shard = jax.lax.axis_index(AGENT_AXIS)
+    else:
+        # Linear shard index over the (dcn, agents) grid: global row
+        # blocks are laid out slice-major.
+        my_shard = (
+            jax.lax.axis_index(DCN_AXIS) * jax.lax.axis_size(AGENT_AXIS)
+            + jax.lax.axis_index(AGENT_AXIS)
+        )
     local_slot = slot - my_shard * rows_per_shard
 
     # ── vouched contributions: segmented psum over edge shards ────
@@ -218,11 +237,11 @@ def _wave_admission(
     target_session = (
         jnp.full((n_global,), -2, jnp.int32).at[slot].set(session_slot)
     )
-    target_session = jax.lax.psum(target_session + 2, AGENT_AXIS) - 2
+    target_session = jax.lax.psum(target_session + 2, row_axes) - 2
     local_contrib = liability_ops.contribution_toward(
         vouches, target_session, now
     )
-    contribution = jax.lax.psum(local_contrib, AGENT_AXIS)[slot]
+    contribution = jax.lax.psum(local_contrib, row_axes)[slot]
     sigma_eff = jnp.minimum(
         sigma_raw + jnp.asarray(omega, jnp.float32) * contribution, 1.0
     )
@@ -315,9 +334,27 @@ def _wave_admission(
     # commit); the difference is the EVENTUAL partial this shard hands
     # back for the between-wave reconcile.
     strong_elem = sessions.mode[jnp.clip(session_slot, 0)] == 0  # STRONG
+    if force_eventual:
+        strong_elem = jnp.zeros_like(strong_elem)
     local_strong = jnp.zeros((s_cap,), jnp.int32).at[
         jnp.clip(session_slot, 0)
     ].add(jnp.where(ok & strong_elem, 1, 0))
+    if force_eventual:
+        # The VIEW must still be global: a session's FSM lane may live
+        # on a different slice than its joiner (any permuted-but-
+        # contiguous assignment), so has_members would silently miss
+        # cross-slice joins under a slice-local psum. A read-only
+        # reduction crossing DCN is within the in-tick budget; the
+        # COMMIT still defers (no table write — shard_map's replication
+        # checker also cannot infer replica invariance through an
+        # agent-axis-only psum).
+        view_add = jax.lax.psum(local_add, row_axes)
+        view_counts = sessions.n_participants + view_add
+        ev_counts_local = local_add
+        return (
+            agents, sessions, status, ring, sigma_eff,
+            view_counts, ev_counts_local,
+        )
     both = jax.lax.psum(jnp.stack([local_add, local_strong]), AGENT_AXIS)
     view_add, strong_add = both[0], both[1]
     view_counts = sessions.n_participants + view_add
@@ -693,6 +730,7 @@ def sharded_governance_wave(
     contiguous_waves: bool = False,
     unique_sessions: bool = False,
     use_pallas: bool | None = None,
+    multislice: bool = False,
 ):
     """The FUSED full-governance wave, end-to-end sharded (round-3 item).
 
@@ -757,6 +795,29 @@ def sharded_governance_wave(
     from hypervisor_tpu.ops import terminate as terminate_ops
     from hypervisor_tpu.ops.pipeline import WaveResult
 
+    if multislice:
+        # SURVEY §5's ICI-vs-DCN split, executed: within a slice the
+        # wave's arithmetic rides ICI psums as usual; ACROSS slices the
+        # only in-tick DCN traffic is the two read-only reductions the
+        # design budgets (the vouch row-map/contribution psums — edges
+        # may live on any slice — and the released-bond total). Every
+        # replica COMMIT defers to the between-tick
+        # `multislice_reconcile_wave` fold over DCN. v1 contracts: the
+        # fast-path layouts are required (contiguous session block,
+        # unique sessions — so no rank all_gathers and no mask psum
+        # cross slices), mode dispatch is forced (all commits are
+        # partials), the gateway phase is not fused, and each wave
+        # session must be joined from ONE slice in a given tick (the
+        # slice-affinity contract; counts merge across ticks, FSM
+        # overwrites do not).
+        if not (mode_dispatch and contiguous_waves and unique_sessions):
+            raise ValueError(
+                "multislice wave requires mode_dispatch=True, "
+                "contiguous_waves=True, unique_sessions=True"
+            )
+        if with_gateway:
+            raise ValueError("multislice wave does not fuse the gateway")
+    row_axes = (DCN_AXIS, AGENT_AXIS) if multislice else AGENT_AXIS
     n_shards = mesh.devices.size
     if use_pallas is None:
         use_pallas = _mesh_uses_pallas(mesh)
@@ -791,6 +852,8 @@ def sharded_governance_wave(
             sigma_raw, trustworthy, duplicate, now, omega, n_shards, trust,
             rate, mode_dispatch=mode_dispatch,
             unique_sessions=unique_sessions,
+            row_axes=row_axes,
+            force_eventual=multislice,
         )
         agents, sessions, status, ring, sigma_eff = admitted[:5]
         if mode_dispatch:
@@ -846,7 +909,7 @@ def sharded_governance_wave(
             agents, vouches, released_local = (
                 terminate_ops.release_session_scope(agents, vouches, in_wave)
             )
-        released = jax.lax.psum(released_local, AGENT_AXIS)
+        released = jax.lax.psum(released_local, row_axes)
 
         wave_state, err_t = session_fsm.apply_session_transitions(
             wave_state, jnp.int8(SessionState.TERMINATING.code), has_members
@@ -862,7 +925,11 @@ def sharded_governance_wave(
         # nonzero; the mask keeps it an exact overwrite). Under mode
         # dispatch only STRONG lanes ride the in-wave fold; EVENTUAL
         # lanes' overwrites return as per-shard partials.
-        if mode_dispatch:
+        if multislice:
+            # Cross-slice commits always defer (slice replicas must not
+            # diverge mid-tick); the DCN reconcile folds them.
+            strong_lane = jnp.zeros(ws.shape, bool)
+        elif mode_dispatch:
             strong_lane = sessions.mode[jnp.clip(ws, 0)] == 0
         else:
             strong_lane = jnp.ones(ws.shape, bool)
@@ -886,19 +953,24 @@ def sharded_governance_wave(
             )
             return owned_m, state_m, term_m
 
-        owned_s, state_s, term_s = lane_fold(strong_lane)
-        owned = jax.lax.psum(owned_s, AGENT_AXIS) > 0
-        state_val = jax.lax.psum(state_s, AGENT_AXIS)
-        term_val = jax.lax.psum(term_s, AGENT_AXIS)
-        sessions = t_replace(
-            sessions,
-            state=jnp.where(
-                owned, state_val, sessions.state.astype(jnp.int32)
-            ).astype(jnp.int8),
-            terminated_at=jnp.where(
-                owned, term_val, sessions.terminated_at
-            ),
-        )
+        if not multislice:
+            owned_s, state_s, term_s = lane_fold(strong_lane)
+            owned = jax.lax.psum(owned_s, AGENT_AXIS) > 0
+            state_val = jax.lax.psum(state_s, AGENT_AXIS)
+            term_val = jax.lax.psum(term_s, AGENT_AXIS)
+            sessions = t_replace(
+                sessions,
+                state=jnp.where(
+                    owned, state_val, sessions.state.astype(jnp.int32)
+                ).astype(jnp.int8),
+                terminated_at=jnp.where(
+                    owned, term_val, sessions.terminated_at
+                ),
+            )
+        # multislice: strong_lane is identically False — skip the
+        # (no-op) fold so the returned replica stays the trivially
+        # DCN-replicated input; the checker cannot infer replication
+        # through an agent-axis-only psum.
         if mode_dispatch:
             owned_e, state_e, term_e = lane_fold(~strong_lane)
             partials = EventualPartials(
@@ -955,17 +1027,18 @@ def sharded_governance_wave(
             return wave_result, partials
         return wave_result
 
-    lane = P(AGENT_AXIS)
+    lane = P(row_axes)
     rep = P()
     # Pytree-prefix specs: one spec covers a whole table's columns (same
-    # convention as sharded_admission above).
+    # convention as sharded_admission above). On a multislice mesh the
+    # row axes are the flattened (dcn, agents) grid.
     in_specs = (
         lane,                   # agents: rows sharded
         rep,                    # sessions: replicated
         lane,                   # vouches: edges sharded
         lane, lane, lane, lane, lane, lane,   # wave columns [B]
         lane,                   # wave_sessions [K]
-        P(None, AGENT_AXIS, None),            # delta_bodies [T, K, W]
+        P(None, row_axes, None),              # delta_bodies [T, K, W]
         rep, rep,               # now, omega
     )
     if contiguous_waves:
@@ -979,11 +1052,11 @@ def sharded_governance_wave(
         sigma_eff=lane,
         saga_step_state=lane,
         merkle_root=lane,
-        chain=P(None, AGENT_AXIS, None),
+        chain=P(None, row_axes, None),
         fsm_error=lane,
         released=rep,
     )
-    partial_rows = P(AGENT_AXIS, None)         # [D, S_cap] shard partials
+    partial_rows = P(row_axes, None)           # [D, S_cap] shard partials
     partials_spec = EventualPartials(
         counts=partial_rows,
         owned=partial_rows,
@@ -1040,26 +1113,27 @@ class EventualPartials(NamedTuple):
     terminated: jnp.ndarray  # f32[D, S_cap] masked terminated_at overwrites
 
 
-def reconcile_wave_sessions(mesh: Mesh):
+def reconcile_wave_sessions(mesh: Mesh, row_axes=AGENT_AXIS):
     """Fold accumulated `EventualPartials` into the replicated
     SessionTable — the between-wave EVENTUAL commit. After this fold the
     table is bit-identical to what the all-STRONG wave would have
     committed in-wave (`tests/parity/test_mode_wave.py`).
 
     Returns fn(sessions, counts [D, S], owned [D, S], state [D, S],
-    terminated [D, S]) -> sessions; partial rows are sharded over the
-    mesh. Fold ONE wave's partials per call: `state`/`terminated` are
-    masked OVERWRITES, and summing two waves that own the same recycled
-    session lane would corrupt both (only `counts` is delta-summable
-    across waves the way `reconcile_sessions` rows are) — the state
-    bridge loops pending waves in order (`reconcile_session_partials`).
+    terminated [D, S]) -> sessions; partial rows are sharded over
+    `row_axes` (AGENT_AXIS on a 1-D mesh). Fold ONE wave's partials per
+    call: `state`/`terminated` are masked OVERWRITES, and summing two
+    waves that own the same recycled session lane would corrupt both
+    (only `counts` is delta-summable across waves the way
+    `reconcile_sessions` rows are) — the state bridge loops pending
+    waves in order (`reconcile_session_partials`).
     """
 
     def merge(sessions, counts, owned, state, terminated):
-        total_counts = jax.lax.psum(jnp.sum(counts, axis=0), AGENT_AXIS)
-        owned_g = jax.lax.psum(jnp.sum(owned, axis=0), AGENT_AXIS) > 0
-        state_g = jax.lax.psum(jnp.sum(state, axis=0), AGENT_AXIS)
-        term_g = jax.lax.psum(jnp.sum(terminated, axis=0), AGENT_AXIS)
+        total_counts = jax.lax.psum(jnp.sum(counts, axis=0), row_axes)
+        owned_g = jax.lax.psum(jnp.sum(owned, axis=0), row_axes) > 0
+        state_g = jax.lax.psum(jnp.sum(state, axis=0), row_axes)
+        term_g = jax.lax.psum(jnp.sum(terminated, axis=0), row_axes)
         return t_replace(
             sessions,
             n_participants=sessions.n_participants + total_counts,
@@ -1071,7 +1145,7 @@ def reconcile_wave_sessions(mesh: Mesh):
             ),
         )
 
-    rows = P(AGENT_AXIS, None)
+    rows = P(row_axes, None)
     return jax.jit(
         shard_map(
             merge,
@@ -1080,6 +1154,15 @@ def reconcile_wave_sessions(mesh: Mesh):
             out_specs=P(),
         )
     )
+
+
+def multislice_reconcile_wave(mesh: Mesh):
+    """`reconcile_wave_sessions` over a 2-D (dcn, agents) mesh: fold one
+    multislice wave's `EventualPartials` over BOTH axes — the one
+    inter-slice commit per tick that SURVEY §5's ICI-vs-DCN split
+    budgets. Same masked-overwrite semantics and same one-wave-per-call
+    rule as the 1-D fold (shared body)."""
+    return reconcile_wave_sessions(mesh, row_axes=(DCN_AXIS, AGENT_AXIS))
 
 
 # ── sharded action gateway ───────────────────────────────────────────

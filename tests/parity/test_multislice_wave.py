@@ -1,0 +1,237 @@
+"""Multislice (dcn × agents) governance wave + DCN reconcile ≡ the
+single-device wave.
+
+SURVEY §5's ICI-vs-DCN split, executed end to end: agent rows and vouch
+edges shard over the flattened 2-D grid, each slice's wave arithmetic
+rides slice-local psums, the only in-tick DCN reductions are the vouch
+row-map/contribution psums and the released total, and EVERY session
+commit comes back as per-shard partials folded once over DCN by
+`multislice_reconcile_wave`. After the fold, tables and outputs must be
+bit-identical to one single-device wave over the combined load.
+Contracts: the fast-path layouts (contiguous session block, unique
+sessions) plus slice affinity (each wave session joined from one
+slice). Runs on the virtual 8-CPU mesh reshaped 2×4.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from hypervisor_tpu.models import SessionState
+from hypervisor_tpu.ops import admission
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.ops.pipeline import governance_wave
+from hypervisor_tpu.parallel import make_multislice_mesh
+from hypervisor_tpu.parallel.collectives import (
+    multislice_reconcile_wave,
+    sharded_governance_wave,
+)
+from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+N_SLICES, PER_SLICE = 2, 4
+D = N_SLICES * PER_SLICE
+ROWS_PER_SHARD = 8
+N_CAP = D * ROWS_PER_SHARD
+E_CAP = D * 4
+S_CAP = 32
+B = D          # one join per shard; one session per join (unique)
+K = B
+T = 3
+NOW = 4.5
+OMEGA = 0.5
+
+
+def _tables():
+    agents = AgentTable.create(N_CAP)
+    sessions = SessionTable.create(S_CAP)
+    ws = jnp.arange(K)
+    sessions = t_replace(
+        sessions,
+        state=sessions.state.at[ws].set(
+            jnp.int8(SessionState.HANDSHAKING.code)
+        ),
+        max_participants=sessions.max_participants.at[ws].set(10),
+        min_sigma_eff=sessions.min_sigma_eff.at[ws].set(0.6),
+    )
+    vouches = VouchTable.create(E_CAP)
+    # A vouch edge on the LAST shard of slice 1 lifting the low-sigma
+    # joiner whose agent row lives on slice 0 — the contribution psum
+    # must cross the DCN axis.
+    vouches = t_replace(
+        vouches,
+        voucher=vouches.voucher.at[E_CAP - 1].set(N_CAP - 1),
+        vouchee=vouches.vouchee.at[E_CAP - 1].set(0),  # slot of joiner 0
+        session=vouches.session.at[E_CAP - 1].set(0),
+        bond=vouches.bond.at[E_CAP - 1].set(0.40),
+        active=vouches.active.at[E_CAP - 1].set(True),
+    )
+    return agents, sessions, vouches
+
+
+def _wave_args():
+    slots = np.array([i * ROWS_PER_SHARD for i in range(B)], np.int32)
+    sigma = np.full(B, 0.8, np.float32)
+    sigma[0] = 0.45  # vouched across the DCN axis
+    rng = np.random.RandomState(13)
+    bodies = rng.randint(
+        0, 2**32, size=(T, K, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    return (
+        jnp.asarray(slots),
+        jnp.arange(B, dtype=jnp.int32),
+        jnp.arange(B, dtype=jnp.int32),   # unique session per join
+        jnp.asarray(sigma),
+        jnp.ones(B, bool),
+        jnp.zeros(B, bool),
+        jnp.asarray(np.arange(K, dtype=np.int32)),
+        jnp.asarray(bodies),
+        NOW,
+        OMEGA,
+    )
+
+
+def test_multislice_wave_plus_dcn_reconcile_matches_single_device():
+    mesh = make_multislice_mesh(N_SLICES, PER_SLICE)
+    args = _wave_args()
+    wave_range = (jnp.asarray(0, jnp.int32), jnp.asarray(K, jnp.int32))
+
+    agents, sessions, vouches = _tables()
+    ms = sharded_governance_wave(
+        mesh,
+        mode_dispatch=True,
+        contiguous_waves=True,
+        unique_sessions=True,
+        multislice=True,
+    )
+    res, partials = ms(agents, sessions, vouches, *args, *wave_range)
+    folded = multislice_reconcile_wave(mesh)(
+        res.sessions, partials.counts, partials.owned, partials.state,
+        partials.terminated,
+    )
+
+    agents2, sessions2, vouches2 = _tables()
+    single = jax.jit(
+        governance_wave,
+        static_argnames=("use_pallas", "unique_sessions"),
+    )(
+        agents2, sessions2, vouches2, *args,
+        use_pallas=False, wave_range=wave_range, unique_sessions=True,
+    )
+
+    for field in ("status", "ring", "sigma_eff", "saga_step_state",
+                  "chain", "merkle_root", "fsm_error"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(res, field)),
+            np.asarray(getattr(single, field)),
+            err_msg=f"{field} diverged",
+        )
+    assert int(np.asarray(res.released)) == int(np.asarray(single.released))
+    # The DCN-crossing vouch lifted joiner 0 identically.
+    assert float(np.asarray(res.sigma_eff)[0]) == pytest.approx(0.65)
+    assert (np.asarray(res.status) == admission.ADMIT_OK).all()
+    # Post-reconcile replica == the single-device committed table.
+    for col in ("state", "n_participants", "terminated_at"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(folded, col)),
+            np.asarray(getattr(single.sessions, col)),
+            err_msg=f"sessions.{col} diverged after DCN fold",
+        )
+    # Agent/vouch tables match too (terminate ran on every shard).
+    np.testing.assert_array_equal(
+        np.asarray(res.agents.flags), np.asarray(single.agents.flags)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.vouches.active), np.asarray(single.vouches.active)
+    )
+
+
+def test_permuted_assignment_crosses_slices():
+    """Element i joins session B-1-i: still contiguous + unique, but
+    every session's FSM lane lives on a different shard (often a
+    different SLICE) than its joiner — the view psum must be global or
+    has_members silently misses cross-slice joins and the FSM walk is
+    skipped."""
+    mesh = make_multislice_mesh(N_SLICES, PER_SLICE)
+    slots = np.array([i * ROWS_PER_SHARD for i in range(B)], np.int32)
+    rng = np.random.RandomState(21)
+    bodies = rng.randint(
+        0, 2**32, size=(T, K, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    args = (
+        jnp.asarray(slots),
+        jnp.arange(B, dtype=jnp.int32),
+        jnp.asarray(np.arange(B - 1, -1, -1, dtype=np.int32)),  # reversed
+        jnp.full((B,), 0.8, jnp.float32),
+        jnp.ones(B, bool),
+        jnp.zeros(B, bool),
+        jnp.asarray(np.arange(K, dtype=np.int32)),
+        jnp.asarray(bodies),
+        NOW,
+        OMEGA,
+    )
+    wave_range = (jnp.asarray(0, jnp.int32), jnp.asarray(K, jnp.int32))
+
+    agents, sessions, vouches = _tables()
+    ms = sharded_governance_wave(
+        mesh, mode_dispatch=True, contiguous_waves=True,
+        unique_sessions=True, multislice=True,
+    )
+    res, partials = ms(agents, sessions, vouches, *args, *wave_range)
+    folded = multislice_reconcile_wave(mesh)(
+        res.sessions, partials.counts, partials.owned, partials.state,
+        partials.terminated,
+    )
+
+    agents2, sessions2, vouches2 = _tables()
+    single = jax.jit(
+        governance_wave,
+        static_argnames=("use_pallas", "unique_sessions"),
+    )(
+        agents2, sessions2, vouches2, *args,
+        use_pallas=False, wave_range=wave_range, unique_sessions=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.status), np.asarray(single.status)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.fsm_error), np.asarray(single.fsm_error)
+    )
+    for col in ("state", "n_participants", "terminated_at"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(folded, col)),
+            np.asarray(getattr(single.sessions, col)),
+            err_msg=f"sessions.{col} diverged after DCN fold",
+        )
+    # Every session with members walked to ARCHIVED.
+    assert (
+        np.asarray(folded.state)[:K] == SessionState.ARCHIVED.code
+    ).all()
+
+
+def test_pre_reconcile_replica_is_unchanged():
+    """Before the DCN fold, every slice's session replica equals the
+    tick-start table — no cross-slice divergence mid-tick."""
+    mesh = make_multislice_mesh(N_SLICES, PER_SLICE)
+    args = _wave_args()
+    wave_range = (jnp.asarray(0, jnp.int32), jnp.asarray(K, jnp.int32))
+    agents, sessions, vouches = _tables()
+    ms = sharded_governance_wave(
+        mesh,
+        mode_dispatch=True,
+        contiguous_waves=True,
+        unique_sessions=True,
+        multislice=True,
+    )
+    res, _ = ms(agents, sessions, vouches, *args, *wave_range)
+    np.testing.assert_array_equal(
+        np.asarray(res.sessions.n_participants),
+        np.asarray(sessions.n_participants),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.sessions.state), np.asarray(sessions.state)
+    )

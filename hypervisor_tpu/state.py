@@ -110,6 +110,13 @@ def _isolation_refusal_from(
     return None
 
 
+def _is_multislice(mesh) -> bool:
+    """True for a 2-D (dcn, agents) mesh (`make_multislice_mesh`)."""
+    from hypervisor_tpu.parallel.mesh import AGENT_AXIS, DCN_AXIS
+
+    return tuple(getattr(mesh, "axis_names", ())) == (DCN_AXIS, AGENT_AXIS)
+
+
 def _mkey(session: int, did: int) -> int:
     """(session, did) membership packed into one int set key."""
     return (int(session) << 32) | (int(did) & 0xFFFFFFFF)
@@ -503,6 +510,23 @@ class HypervisorState:
         gw_result = None
         if mesh is not None:
             with_gateway = actions is not None
+            multislice = _is_multislice(mesh)
+            if multislice:
+                # The multislice wave's v1 contracts (see
+                # `collectives.sharded_governance_wave`): fast-path
+                # layouts are REQUIRED (they hold for every fresh wave
+                # this bridge stages); the gateway phase is not fused
+                # across slices — it composes behind the committed wave
+                # instead (the tail below), same order as the fused
+                # variant (gateway sees the post-terminate table).
+                if not (wave_contiguous and unique_sessions):
+                    raise ValueError(
+                        "multislice wave requires a contiguous session "
+                        "block and one seat-consuming join per session "
+                        f"(got contiguous={wave_contiguous}, "
+                        f"unique={unique_sessions})"
+                    )
+                with_gateway = False
             wave_fn = self._sharded_waves.get(
                 (mesh, with_gateway, wave_contiguous, unique_sessions)
             )
@@ -525,6 +549,7 @@ class HypervisorState:
                     mode_dispatch=True,
                     contiguous_waves=wave_contiguous,
                     unique_sessions=unique_sessions,
+                    multislice=multislice,
                 )
                 self._sharded_waves[
                     (mesh, with_gateway, wave_contiguous, unique_sessions)
@@ -1438,10 +1463,15 @@ class HypervisorState:
         fn = self._sharded_waves.get(("reconcile", mesh))
         if fn is None:
             from hypervisor_tpu.parallel.collectives import (
+                multislice_reconcile_wave,
                 reconcile_wave_sessions,
             )
 
-            fn = reconcile_wave_sessions(mesh)
+            fn = (
+                multislice_reconcile_wave(mesh)
+                if _is_multislice(mesh)
+                else reconcile_wave_sessions(mesh)
+            )
             self._sharded_waves[("reconcile", mesh)] = fn
         return fn
 

@@ -213,6 +213,81 @@ def test_permuted_assignment_crosses_slices():
     ).all()
 
 
+def test_bridge_runs_multislice_wave():
+    """HypervisorState.run_governance_wave(mesh=<2-D mesh>) builds the
+    multislice variant, folds the DCN partials behind the wave, and
+    lands the same world as the single-device bridge."""
+    import dataclasses
+
+    from hypervisor_tpu.config import DEFAULT_CONFIG
+    from hypervisor_tpu.models import SessionConfig
+    from hypervisor_tpu.state import HypervisorState
+
+    cfg = dataclasses.replace(
+        DEFAULT_CONFIG,
+        capacity=dataclasses.replace(
+            DEFAULT_CONFIG.capacity, max_agents=N_CAP
+        ),
+    )
+    mesh = make_multislice_mesh(N_SLICES, PER_SLICE)
+
+    def run(use_mesh):
+        st = HypervisorState(cfg)
+        slots = st.create_sessions_batch(
+            [f"ms:s{i}" for i in range(K)], SessionConfig(min_sigma_eff=0.0)
+        )
+        dids = [f"did:ms:{i}" for i in range(K)]
+        rng = np.random.RandomState(3)
+        bodies = rng.randint(
+            0, 2**32, size=(T, K, merkle_ops.BODY_WORDS), dtype=np.uint64
+        ).astype(np.uint32)
+        res = st.run_governance_wave(
+            slots, dids, np.asarray(slots, np.int32),
+            np.full(K, 0.8, np.float32), bodies,
+            now=2.0, mesh=mesh if use_mesh else None,
+            **({} if use_mesh else {"use_pallas": False}),
+        )
+        return st, res
+
+    st_ms, res_ms = run(True)
+    st_sd, res_sd = run(False)
+    # Actions compose behind the multislice wave (not fused): a second
+    # wave on each state with a standing check against a fresh member.
+    for st, mesh_arg in ((st_ms, mesh), (st_sd, None)):
+        slots2 = st.create_sessions_batch(
+            ["ms:extra"], SessionConfig(min_sigma_eff=0.0)
+        )
+        # K joins keep the mesh-divisibility contract; only lane 0's
+        # session hosts the standing member we probe.
+        extra = st.run_governance_wave(
+            list(slots2) * 1, ["did:ms:probe"],
+            np.asarray(slots2, np.int32),
+            np.full(1, 0.9, np.float32),
+            np.zeros((1, 1, merkle_ops.BODY_WORDS), np.uint32),
+            now=3.0,
+            mesh=mesh_arg,
+            actions=dict(slots=np.zeros(1, np.int32)),
+            **({} if mesh_arg is not None else {"use_pallas": False}),
+        )
+        assert isinstance(extra, tuple) and extra[1] is not None
+    np.testing.assert_array_equal(
+        np.asarray(res_ms.status), np.asarray(res_sd.status)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res_ms.merkle_root), np.asarray(res_sd.merkle_root)
+    )
+    # The bridge folded the DCN partials: the committed tables agree.
+    np.testing.assert_array_equal(
+        np.asarray(st_ms.sessions.state), np.asarray(st_sd.sessions.state)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_ms.sessions.n_participants),
+        np.asarray(st_sd.sessions.n_participants),
+    )
+    for i in range(K):
+        assert st_ms.is_member(i, f"did:ms:{i}")
+
+
 def test_pre_reconcile_replica_is_unchanged():
     """Before the DCN fold, every slice's session replica equals the
     tick-start table — no cross-slice divergence mid-tick."""

@@ -166,6 +166,24 @@ def sha256_batch_host(msgs: np.ndarray) -> np.ndarray:
 # ── staging queue ────────────────────────────────────────────────────
 
 
+# The C++ staging buffer is a PROCESS-GLOBAL registration
+# (hv_stage_init binds the column pointers the lock-free push writes
+# through). Two live StagingQueues would silently write into whichever
+# instance registered last — observed as garbage session slots in the
+# first state's harvest. Each queue therefore re-binds the native side
+# on ownership change; concurrent PUSHES stay lock-free within the
+# owning queue, but only ONE queue can be actively staging at a time:
+# a handoff with entries still staged raises, and a foreign bind that
+# races an in-flight push is detected right after the push. The one
+# foreign-bind source is StagingQueue construction (a new
+# HypervisorState) — do not construct one while another state's
+# producers are mid-push.
+import threading as _threading
+
+_NATIVE_OWNER: "StagingQueue | None" = None
+_OWNER_LOCK = _threading.Lock()
+
+
 class StagingQueue:
     """Lock-free SoA admission queue feeding the batched governance tick.
 
@@ -184,23 +202,61 @@ class StagingQueue:
         self.session = np.zeros(capacity, np.int32)
         self.trustworthy = np.zeros(capacity, np.uint8)
         self._py_cursor = 0
+        self._staged_since_harvest = 0  # best-effort loss detector
         if HAVE_NATIVE:
+            self._bind()
+
+    def _bind(self) -> None:
+        """Register THIS queue's buffers as the native staging target."""
+        global _NATIVE_OWNER
+        with _OWNER_LOCK:
             _lib.hv_stage_init(
-                capacity,
+                self.capacity,
                 self.sigma.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
                 self.agent.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                 self.session.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                 _u8(self.trustworthy),
             )
+            _NATIVE_OWNER = self
+
+    def _ensure_bound(self) -> None:
+        if _NATIVE_OWNER is not self:
+            # Another queue (another HypervisorState) bound since we
+            # did. If WE still hold staged-but-unharvested entries,
+            # their native count is already gone — rebinding here would
+            # silently drop them from our next harvest, so fail loudly
+            # instead (same contract as the harvest-side guard).
+            if self._staged_since_harvest > 0:
+                raise RuntimeError(
+                    f"{self._staged_since_harvest} staged join(s) lost: "
+                    "another StagingQueue re-bound the native staging "
+                    "buffer mid-epoch (interleaved staging across "
+                    "HypervisorState instances is not supported)"
+                )
+            self._bind()
 
     def push(
         self, sigma: float, agent: int, session: int, trustworthy: bool = True
     ) -> int:
         """Claim a slot; returns the slot index or -1 when the epoch is full."""
         if HAVE_NATIVE:
-            return int(
+            self._ensure_bound()
+            slot = int(
                 _lib.hv_stage_push(sigma, agent, session, 1 if trustworthy else 0)
             )
+            if _NATIVE_OWNER is not self:
+                # A foreign bind raced this push: the payload may have
+                # landed in the OTHER queue's freshly-registered
+                # buffers. Unrecoverable from this side — fail loudly
+                # (see the module comment's construction rule).
+                raise RuntimeError(
+                    "staging push raced a foreign StagingQueue bind; "
+                    "constructing a HypervisorState while another "
+                    "state's producers are mid-push is not supported"
+                )
+            if slot >= 0:
+                self._staged_since_harvest += 1
+            return slot
         if self._py_cursor >= self.capacity:
             return -1
         slot = self._py_cursor
@@ -214,7 +270,23 @@ class StagingQueue:
     def harvest(self) -> tuple[int, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """(count, sigma, agent, session, trustworthy) views for the tick."""
         if HAVE_NATIVE:
+            if (
+                _NATIVE_OWNER is not self
+                and self._staged_since_harvest > 0
+            ):
+                # A foreign bind reset the native epoch while we held
+                # staged-but-unharvested entries: their count is gone.
+                # Loud beats a silent partial harvest — one actively
+                # staging state per process.
+                raise RuntimeError(
+                    f"{self._staged_since_harvest} staged join(s) lost: "
+                    "another StagingQueue re-bound the native staging "
+                    "buffer mid-epoch (interleaved staging across "
+                    "HypervisorState instances is not supported)"
+                )
+            self._ensure_bound()
             n = int(_lib.hv_stage_swap())
+            self._staged_since_harvest = 0
         else:
             n = self._py_cursor
             self._py_cursor = 0

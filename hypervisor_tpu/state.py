@@ -388,10 +388,18 @@ class HypervisorState:
         dict with `slots` (STANDING membership rows — not this wave's
         cohort) plus optional `required_rings` / `is_read_only` /
         `has_consensus` / `has_sre_witness` / `host_tripped` columns.
-        On a mesh the gateway fuses INTO the wave program
-        (`with_gateway`); single-device it composes behind it — both
-        orders identical (the gateway runs on the post-terminate
-        table). Returns (WaveResult, GatewayResult) instead.
+        On a 1-D mesh the gateway fuses INTO the wave program
+        (`with_gateway`); single-device AND on a multislice mesh it
+        composes behind it — both orders identical (the gateway runs
+        on the post-terminate table). Returns
+        (WaveResult, GatewayResult) instead.
+
+        A 2-D (dcn, agents) mesh from `make_multislice_mesh` builds
+        the MULTISLICE wave variant: slice-local consensus arithmetic,
+        read-only DCN reductions only, every session commit folded
+        once over DCN behind the wave (the fast-path layout contracts
+        are required and host-verified; fresh bridge-staged waves
+        always satisfy them).
 
         The mesh wave EXECUTES each session's consistency mode
         (`mode_dispatch`): STRONG sessions' replica updates commit
@@ -658,9 +666,14 @@ class HypervisorState:
                 self._chain_seed[s] = chain[t - 1, i]
         if actions is not None:
             if gw_result is None:
-                # Single device: compose the gateway wave behind the
-                # committed governance wave (same order as the fused
-                # mesh program — gateway sees the post-terminate table).
+                # Single device AND multislice meshes: compose the
+                # gateway wave behind the committed governance wave
+                # (same order as the fused 1-D mesh program — the
+                # gateway sees the post-terminate table). On a real
+                # multislice deployment this runs the gateway as one
+                # single-device program over the whole agent table —
+                # correct but unsharded; a per-shard multislice gateway
+                # is a known follow-up (docs/ROADMAP.md).
                 act = self._normalize_actions(actions)
                 gw_result = self.check_actions_wave(
                     act["slots"], act["required_rings"],

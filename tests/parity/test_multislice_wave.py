@@ -251,25 +251,41 @@ def test_bridge_runs_multislice_wave():
 
     st_ms, res_ms = run(True)
     st_sd, res_sd = run(False)
-    # Actions compose behind the multislice wave (not fused): a second
-    # wave on each state with a standing check against a fresh member.
+    # Actions compose behind the multislice wave (not fused). Probe a
+    # genuinely STANDING member (admitted via the staging path, so it
+    # survives the wave) with identical state on both paths — the
+    # composed gateway's verdicts must MATCH, not merely exist.
+    gw_verdicts = []
     for st, mesh_arg in ((st_ms, mesh), (st_sd, None)):
+        standing_sess = st.create_session(
+            "ms:standing", SessionConfig(min_sigma_eff=0.0)
+        )
+        assert st.enqueue_join(
+            standing_sess, "did:ms:standing", sigma_raw=0.8
+        ) >= 0
+        assert (st.flush_joins(now=2.5) == 0).all()
+        probe_slot = st._slot_of_member[
+            (st.agent_ids.lookup("did:ms:standing"), standing_sess)
+        ]
+
         slots2 = st.create_sessions_batch(
             ["ms:extra"], SessionConfig(min_sigma_eff=0.0)
         )
-        # K joins keep the mesh-divisibility contract; only lane 0's
-        # session hosts the standing member we probe.
         extra = st.run_governance_wave(
-            list(slots2) * 1, ["did:ms:probe"],
+            slots2, ["did:ms:probe"],
             np.asarray(slots2, np.int32),
             np.full(1, 0.9, np.float32),
             np.zeros((1, 1, merkle_ops.BODY_WORDS), np.uint32),
             now=3.0,
             mesh=mesh_arg,
-            actions=dict(slots=np.zeros(1, np.int32)),
+            actions=dict(slots=np.array([probe_slot], np.int32)),
             **({} if mesh_arg is not None else {"use_pallas": False}),
         )
         assert isinstance(extra, tuple) and extra[1] is not None
+        gw_verdicts.append(np.asarray(extra[1].verdict))
+    np.testing.assert_array_equal(gw_verdicts[0], gw_verdicts[1])
+    # The standing member's write is GRANTED on both paths.
+    assert int(gw_verdicts[0][0]) == 0
     np.testing.assert_array_equal(
         np.asarray(res_ms.status), np.asarray(res_sd.status)
     )

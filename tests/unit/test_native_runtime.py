@@ -104,3 +104,49 @@ class TestStagingQueue:
         assert len(valid) == 4000
         assert len(set(valid)) == 4000  # no slot claimed twice
         assert len(set(agent.tolist())) == 4000  # every payload distinct
+
+
+class TestCrossStateStaging:
+    def test_second_queue_does_not_corrupt_first(self):
+        """The native staging buffer is a process-global registration:
+        creating a second queue used to hijack it, so the first queue's
+        pushes landed in the second's arrays (observed as garbage
+        session slots admitting BAD_STATE). Each queue now re-binds on
+        ownership change."""
+        import pytest as _pytest
+
+        if not HAVE_NATIVE:
+            _pytest.skip("native queue not built (rebind path untestable)")
+        q1 = StagingQueue(capacity=8)
+        q2 = StagingQueue(capacity=8)  # binds the native side to q2
+        assert q1.push(0.5, 3, 7) >= 0  # must re-bind to q1 first
+        n, sigma, agent, session, trust = q1.harvest()
+        assert n == 1
+        assert agent[0] == 3 and session[0] == 7
+        assert abs(float(sigma[0]) - 0.5) < 1e-6
+        # q2 still works after the handoff back.
+        assert q2.push(0.9, 1, 2) >= 0
+        n2, _, agent2, session2, _ = q2.harvest()
+        assert n2 == 1 and agent2[0] == 1 and session2[0] == 2
+
+    def test_interleaved_staging_fails_loudly(self):
+        """Entries staged before a foreign re-bind cannot be counted by
+        the native epoch swap — the harvest must raise, not silently
+        return a partial wave."""
+        import pytest as _pytest
+
+        from hypervisor_tpu.runtime import HAVE_NATIVE as _HN
+
+        if not _HN:
+            _pytest.skip("native queue not built")
+        qa = StagingQueue(capacity=8)
+        assert qa.push(0.5, 1, 1) >= 0
+        qb = StagingQueue(capacity=8)  # foreign bind resets the epoch
+        with _pytest.raises(RuntimeError, match="staged join"):
+            qa.harvest()
+        # qa recovers after the failed harvest is acknowledged: its
+        # counter survives, so a fresh push-then-harvest works.
+        qa._staged_since_harvest = 0
+        assert qa.push(0.7, 2, 3) >= 0
+        n, _, agent, session, _ = qa.harvest()
+        assert n == 1 and agent[0] == 2 and session[0] == 3

@@ -1220,15 +1220,31 @@ def sharded_gateway(
     Returns fn(agents, elevations, slot, required_ring, is_read_only,
     has_consensus, has_sre_witness, host_tripped, valid, now) ->
     (AgentTable, GatewayLanes).
+
+    On a 2-D (dcn, agents) multislice mesh the rows shard over the
+    flattened grid and the program stays collective-free — the
+    placement contract already keeps each membership's actions on one
+    shard, which is on one slice.
     """
     from hypervisor_tpu.ops import gateway as gateway_ops
+
+    multislice = tuple(mesh.axis_names) == (DCN_AXIS, AGENT_AXIS)
+    row_axes = (DCN_AXIS, AGENT_AXIS) if multislice else AGENT_AXIS
 
     def step(
         agents, elevations, slot, required_ring, is_read_only,
         has_consensus, has_sre_witness, host_tripped, valid, now,
     ):
         rows_per_shard = agents.did.shape[0]
-        base = jax.lax.axis_index(AGENT_AXIS) * rows_per_shard
+        if multislice:
+            lin = (
+                jax.lax.axis_index(DCN_AXIS)
+                * jax.lax.axis_size(AGENT_AXIS)
+                + jax.lax.axis_index(AGENT_AXIS)
+            )
+        else:
+            lin = jax.lax.axis_index(AGENT_AXIS)
+        base = lin * rows_per_shard
         result = gateway_ops.check_actions(
             agents,
             elevations,
@@ -1247,7 +1263,7 @@ def sharded_gateway(
         )
         return result.agents, _gateway_lanes(result)
 
-    lane = P(AGENT_AXIS)
+    lane = P(row_axes)
     rep = P()
     mapped = shard_map(
         step,

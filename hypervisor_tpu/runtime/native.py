@@ -202,7 +202,13 @@ class StagingQueue:
         self.session = np.zeros(capacity, np.int32)
         self.trustworthy = np.zeros(capacity, np.uint8)
         self._py_cursor = 0
-        self._staged_since_harvest = 0  # best-effort loss detector
+        # Loss detector: entries staged into the CURRENT native epoch.
+        # Guarded by _count_lock so a push landing concurrently with a
+        # flush (the supported producer/driver overlap) is never lost
+        # from the count (the Python-side ctypes calls serialize on the
+        # GIL anyway, so the lock costs nothing on the hot path).
+        self._staged_since_harvest = 0
+        self._count_lock = _threading.Lock()
         if HAVE_NATIVE:
             self._bind()
 
@@ -219,21 +225,32 @@ class StagingQueue:
             )
             _NATIVE_OWNER = self
 
+    def _lost_error(self) -> RuntimeError:
+        return RuntimeError(
+            f"{self._staged_since_harvest} staged join(s) lost: another "
+            "StagingQueue re-bound the native staging buffer mid-epoch "
+            "(interleaved staging across HypervisorState instances is "
+            "not supported; acknowledge_lost_epoch() to continue)"
+        )
+
     def _ensure_bound(self) -> None:
         if _NATIVE_OWNER is not self:
             # Another queue (another HypervisorState) bound since we
             # did. If WE still hold staged-but-unharvested entries,
             # their native count is already gone — rebinding here would
-            # silently drop them from our next harvest, so fail loudly
-            # instead (same contract as the harvest-side guard).
+            # silently drop them from our next harvest, so fail loudly.
             if self._staged_since_harvest > 0:
-                raise RuntimeError(
-                    f"{self._staged_since_harvest} staged join(s) lost: "
-                    "another StagingQueue re-bound the native staging "
-                    "buffer mid-epoch (interleaved staging across "
-                    "HypervisorState instances is not supported)"
-                )
+                raise self._lost_error()
             self._bind()
+
+    def acknowledge_lost_epoch(self) -> int:
+        """Discard the lost-entry count after a 'staged join(s) lost'
+        error; returns how many entries were written off. The caller
+        owns re-staging them (the bridge keys bookkeeping by agent
+        slot, so a re-push is idempotent there)."""
+        with self._count_lock:
+            lost, self._staged_since_harvest = self._staged_since_harvest, 0
+        return lost
 
     def push(
         self, sigma: float, agent: int, session: int, trustworthy: bool = True
@@ -255,7 +272,8 @@ class StagingQueue:
                     "state's producers are mid-push is not supported"
                 )
             if slot >= 0:
-                self._staged_since_harvest += 1
+                with self._count_lock:
+                    self._staged_since_harvest += 1
             return slot
         if self._py_cursor >= self.capacity:
             return -1
@@ -270,23 +288,20 @@ class StagingQueue:
     def harvest(self) -> tuple[int, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """(count, sigma, agent, session, trustworthy) views for the tick."""
         if HAVE_NATIVE:
-            if (
-                _NATIVE_OWNER is not self
-                and self._staged_since_harvest > 0
-            ):
-                # A foreign bind reset the native epoch while we held
-                # staged-but-unharvested entries: their count is gone.
-                # Loud beats a silent partial harvest — one actively
-                # staging state per process.
-                raise RuntimeError(
-                    f"{self._staged_since_harvest} staged join(s) lost: "
-                    "another StagingQueue re-bound the native staging "
-                    "buffer mid-epoch (interleaved staging across "
-                    "HypervisorState instances is not supported)"
-                )
             self._ensure_bound()
             n = int(_lib.hv_stage_swap())
-            self._staged_since_harvest = 0
+            if _NATIVE_OWNER is not self:
+                # Symmetric with push: a foreign bind racing the swap
+                # means n came from the OTHER queue's fresh cursor and
+                # our staged entries are uncounted — loud, not partial.
+                raise self._lost_error()
+            with self._count_lock:
+                # Subtract what this swap harvested; pushes that landed
+                # AFTER the swap (supported producer/driver overlap)
+                # belong to the new epoch and keep their count.
+                self._staged_since_harvest = max(
+                    0, self._staged_since_harvest - n
+                )
         else:
             n = self._py_cursor
             self._py_cursor = 0

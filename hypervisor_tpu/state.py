@@ -669,17 +669,17 @@ class HypervisorState:
                 # Single device AND multislice meshes: compose the
                 # gateway wave behind the committed governance wave
                 # (same order as the fused 1-D mesh program — the
-                # gateway sees the post-terminate table). On a real
-                # multislice deployment this runs the gateway as one
-                # single-device program over the whole agent table —
-                # correct but unsharded; a per-shard multislice gateway
-                # is a known follow-up (docs/ROADMAP.md).
+                # gateway sees the post-terminate table). On a
+                # multislice mesh the composed gateway is itself the
+                # SHARDED program over the flattened (dcn, agents)
+                # grid (zero collectives by the placement contract).
                 act = self._normalize_actions(actions)
                 gw_result = self.check_actions_wave(
                     act["slots"], act["required_rings"],
                     act["is_read_only"], act["has_consensus"],
                     act["has_sre_witness"], act["host_tripped"],
                     now=now,
+                    mesh=mesh,
                 )
             return result, gw_result
         return result

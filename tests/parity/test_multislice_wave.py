@@ -304,6 +304,60 @@ def test_bridge_runs_multislice_wave():
         assert st_ms.is_member(i, f"did:ms:{i}")
 
 
+def test_multislice_sharded_gateway_matches_single_device():
+    """check_actions_wave(mesh=<2-D mesh>) — the zero-collective
+    gateway over the flattened (dcn, agents) grid — must produce the
+    single-device verdict columns bit-for-bit on a ragged request."""
+    import dataclasses
+
+    from hypervisor_tpu.config import DEFAULT_CONFIG
+    from hypervisor_tpu.models import SessionConfig
+    from hypervisor_tpu.state import HypervisorState
+
+    cfg = dataclasses.replace(
+        DEFAULT_CONFIG,
+        capacity=dataclasses.replace(
+            DEFAULT_CONFIG.capacity, max_agents=N_CAP
+        ),
+    )
+    mesh = make_multislice_mesh(N_SLICES, PER_SLICE)
+
+    def staged():
+        st = HypervisorState(cfg)
+        sess = st.create_session("gw:s", SessionConfig(min_sigma_eff=0.0))
+        for i in range(5):
+            assert st.enqueue_join(sess, f"did:gw:{i}", sigma_raw=0.8) >= 0
+        assert (st.flush_joins(now=1.0) == 0).all()
+        slots = [
+            st._slot_of_member[(st.agent_ids.lookup(f"did:gw:{i}"), sess)]
+            for i in range(5)
+        ]
+        # Ragged, duplicate-slot request (same membership twice keeps
+        # the sequential settle on one shard).
+        req = np.array(slots + [slots[0]], np.int32)
+        return st, req
+
+    st_ms, req_ms = staged()
+    st_sd, req_sd = staged()
+    np.testing.assert_array_equal(req_ms, req_sd)
+    n_req = len(req_ms)
+    cols = dict(
+        required_rings=np.full(n_req, 2, np.int8),
+        is_read_only=np.zeros(n_req, bool),
+        has_consensus=np.zeros(n_req, bool),
+        has_sre_witness=np.zeros(n_req, bool),
+        host_tripped=np.zeros(n_req, bool),
+    )
+    gw_ms = st_ms.check_actions_wave(req_ms, now=2.0, mesh=mesh, **cols)
+    gw_sd = st_sd.check_actions_wave(req_sd, now=2.0, **cols)
+    for field in ("verdict", "ring_status", "eff_ring", "tripped"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(gw_ms, field)),
+            np.asarray(getattr(gw_sd, field)),
+            err_msg=field,
+        )
+
+
 def test_pre_reconcile_replica_is_unchanged():
     """Before the DCN fold, every slice's session replica equals the
     tick-start table — no cross-slice divergence mid-tick."""

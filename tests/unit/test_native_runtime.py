@@ -144,9 +144,8 @@ class TestCrossStateStaging:
         qb = StagingQueue(capacity=8)  # foreign bind resets the epoch
         with _pytest.raises(RuntimeError, match="staged join"):
             qa.harvest()
-        # qa recovers after the failed harvest is acknowledged: its
-        # counter survives, so a fresh push-then-harvest works.
-        qa._staged_since_harvest = 0
+        # qa recovers through the PUBLIC acknowledgement API.
+        assert qa.acknowledge_lost_epoch() == 1
         assert qa.push(0.7, 2, 3) >= 0
         n, _, agent, session, _ = qa.harvest()
         assert n == 1 and agent[0] == 2 and session[0] == 3

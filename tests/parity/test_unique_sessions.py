@@ -23,7 +23,7 @@ from hypervisor_tpu.models import SessionConfig, SessionState
 from hypervisor_tpu.ops import admission
 from hypervisor_tpu.parallel import make_mesh
 from hypervisor_tpu.state import HypervisorState
-from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.state import AgentTable, SessionTable
 from hypervisor_tpu.tables.struct import replace as t_replace
 
 B = 16

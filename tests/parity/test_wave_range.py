@@ -18,7 +18,6 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp
 
 from hypervisor_tpu.models import SessionState
-from hypervisor_tpu.ops import admission
 from hypervisor_tpu.ops import merkle as merkle_ops
 from hypervisor_tpu.ops.pipeline import governance_wave
 from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable

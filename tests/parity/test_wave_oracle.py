@@ -1,0 +1,183 @@
+"""The FULL governance wave vs a pure-Python reference oracle.
+
+`test_admission_oracle` pins the admission phase; this test pins the
+whole fused program — admission statuses/rings/sigma (vouched), hashlib
+chain digests, the reference Merkle-root combine
+(`audit.delta.merkle_root_host`, itself pinned bit-for-bit against
+/root/reference's tree semantics), per-session participant accounting,
+the session FSM end states, bond release counts, and participant
+deactivation — against plain Python loops that never touch a device op.
+If this passes, the one-program wave IS the reference pipeline.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from hypervisor_tpu.audit.delta import merkle_root_host
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.models import SessionState
+from hypervisor_tpu.ops import admission
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.ops.pipeline import governance_wave
+from hypervisor_tpu.tables.state import (
+    AgentTable,
+    FLAG_ACTIVE,
+    SessionTable,
+    VouchTable,
+)
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+B, K, S_CAP, N_CAP, E_CAP, T = 24, 8, 16, 64, 32, 3
+NOW = 6.0
+OMEGA = 0.5
+
+_WAVE = jax.jit(governance_wave, static_argnames=("use_pallas",))
+
+
+def _host_chain(bodies_lane: np.ndarray) -> list[str]:
+    """Reference chain semantics: digest_n = sha256(body_n || parent)."""
+    parent = b"\x00" * 32
+    out = []
+    for body in bodies_lane:  # [T, BODY_WORDS]
+        digest = hashlib.sha256(body.astype(">u4").tobytes() + parent).digest()
+        parent = digest
+        out.append(digest.hex())
+    return out
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_wave_matches_python_oracle(seed):
+    rng = np.random.RandomState(500 + seed)
+    trust = DEFAULT_CONFIG.trust
+
+    # Sessions 0..K-1 joinable with tight capacity; the rest untouched.
+    caps = rng.randint(2, 5, K)
+    agents = AgentTable.create(N_CAP)
+    sessions = SessionTable.create(S_CAP)
+    ws = jnp.arange(K)
+    sessions = t_replace(
+        sessions,
+        state=sessions.state.at[ws].set(jnp.int8(SessionState.HANDSHAKING.code)),
+        max_participants=sessions.max_participants.at[ws].set(
+            jnp.asarray(caps, jnp.int32)
+        ),
+        min_sigma_eff=sessions.min_sigma_eff.at[ws].set(0.6),
+    )
+
+    # Vouch edges toward a few joiners; one edge scoped elsewhere.
+    vouches = VouchTable.create(E_CAP)
+    session_slot = rng.randint(0, K, B).astype(np.int32)
+    sigma_raw = rng.choice([0.45, 0.55, 0.8, 0.95], size=B).astype(np.float32)
+    vouched_lanes = [0, 3]
+    contribution = np.zeros(B, np.float32)
+    for row, lane in enumerate(vouched_lanes):
+        bond = 0.3 + 0.1 * row
+        contribution[lane] = bond
+        vouches = t_replace(
+            vouches,
+            voucher=vouches.voucher.at[row].set(N_CAP - 1 - row),
+            vouchee=vouches.vouchee.at[row].set(lane),  # slot == lane below
+            session=vouches.session.at[row].set(int(session_slot[lane])),
+            bond=vouches.bond.at[row].set(bond),
+            active=vouches.active.at[row].set(True),
+        )
+    trustworthy = rng.rand(B) > 0.1
+    duplicate = rng.rand(B) < 0.1
+
+    bodies = rng.randint(
+        0, 2**32, size=(T, K, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+
+    res = _WAVE(
+        agents,
+        sessions,
+        vouches,
+        jnp.arange(B, dtype=jnp.int32),
+        jnp.arange(B, dtype=jnp.int32),
+        jnp.asarray(session_slot),
+        jnp.asarray(sigma_raw),
+        jnp.asarray(trustworthy),
+        jnp.asarray(duplicate),
+        jnp.asarray(np.arange(K, dtype=np.int32)),
+        jnp.asarray(bodies),
+        NOW,
+        OMEGA,
+        use_pallas=False,
+    )
+
+    # ── oracle: admission (reference join walk, seats fill in order) ──
+    counts = {s: 0 for s in range(K)}
+    want_status, want_ring, want_sig = [], [], []
+    for i in range(B):
+        s = int(session_slot[i])
+        sig = min(float(sigma_raw[i]) + OMEGA * float(contribution[i]), 1.0)
+        if trustworthy[i]:
+            ring = 2 if sig > trust.ring2_threshold else 3
+        else:
+            ring = 3
+        status = 0
+        if duplicate[i]:
+            status = admission.ADMIT_DUPLICATE
+        elif sig < 0.6 and ring != 3:
+            status = admission.ADMIT_SIGMA_LOW
+        elif counts[s] >= int(caps[s]):
+            status = admission.ADMIT_CAPACITY
+        if status == 0:
+            counts[s] += 1
+        want_status.append(status)
+        want_ring.append(ring)
+        want_sig.append(sig)
+    np.testing.assert_array_equal(np.asarray(res.status), want_status)
+    np.testing.assert_array_equal(np.asarray(res.ring), want_ring)
+    np.testing.assert_allclose(
+        np.asarray(res.sigma_eff), np.asarray(want_sig, np.float32), atol=1e-6
+    )
+
+    # ── oracle: audit chain + Merkle root per session lane ───────────
+    chain = np.asarray(res.chain)          # [T, K, 8]
+    roots = np.asarray(res.merkle_root)    # [K, 8]
+    for lane in range(K):
+        want_hex = _host_chain(bodies[:, lane])
+        got_hex = [
+            np.ascontiguousarray(chain[t, lane].astype(">u4"))
+            .tobytes()
+            .hex()
+            for t in range(T)
+        ]
+        assert got_hex == want_hex, f"lane {lane} chain diverged"
+        want_root = merkle_root_host(want_hex)
+        got_root = (
+            np.ascontiguousarray(roots[lane].astype(">u4")).tobytes().hex()
+        )
+        assert got_root == want_root, f"lane {lane} Merkle root diverged"
+
+    # ── oracle: terminate — bonds released, members deactivated, FSM ──
+    # Live edges scoped to wave sessions release; all wave sessions with
+    # members archive.
+    want_released = sum(
+        1
+        for row, lane in enumerate(vouched_lanes)
+        # every wave session terminates, so every planted edge releases
+    )
+    assert int(np.asarray(res.released)) == want_released
+    assert not np.asarray(res.vouches.active)[: len(vouched_lanes)].any()
+    state_after = np.asarray(res.sessions.state)
+    for s in range(K):
+        if counts[s] > 0:
+            assert state_after[s] == SessionState.ARCHIVED.code, s
+        else:
+            # No members ever joined: the walk never leaves HANDSHAKING.
+            assert state_after[s] == SessionState.HANDSHAKING.code, s
+    assert (state_after[K:] == SessionState.CREATED.code).all()
+    # Admitted rows were deactivated by the in-wave terminate.
+    flags = np.asarray(res.agents.flags)
+    for i in range(B):
+        assert not (flags[i] & FLAG_ACTIVE), i
+    assert not np.asarray(res.fsm_error).any()

@@ -149,3 +149,35 @@ class TestCrossStateStaging:
         assert qa.push(0.7, 2, 3) >= 0
         n, _, agent, session, _ = qa.harvest()
         assert n == 1 and agent[0] == 2 and session[0] == 3
+
+
+class TestHostHelpers:
+    def test_contiguous_range_gate(self):
+        """The range fast-path gate accepts exactly arange blocks."""
+        from hypervisor_tpu.state import _contiguous_range
+
+        ok = _contiguous_range(np.arange(5, 12, dtype=np.int32))
+        assert ok is not None
+        lo, hi = int(ok[0]), int(ok[1])
+        assert (lo, hi) == (5, 12)
+        assert _contiguous_range(np.zeros(0, np.int32)) is None
+        assert _contiguous_range(np.array([-1, 0, 1], np.int32)) is None
+        assert _contiguous_range(np.array([3, 5, 6], np.int32)) is None   # gap
+        assert _contiguous_range(np.array([3, 3, 4], np.int32)) is None   # dup
+        assert _contiguous_range(np.array([4, 3, 2], np.int32)) is None   # desc
+
+    def test_membership_keys_roundtrip(self):
+        from hypervisor_tpu.state import _mkey, _mkeys
+
+        rng = np.random.RandomState(7)
+        sessions = rng.randint(0, 2**20, 256).astype(np.int32)
+        dids = rng.randint(0, 2**20, 256).astype(np.int32)
+        keys = _mkeys(sessions, dids)
+        for i in range(256):
+            k = int(keys[i])
+            assert k == _mkey(int(sessions[i]), int(dids[i]))
+            assert (k >> 32, k & 0xFFFFFFFF) == (sessions[i], dids[i])
+        # Distinct pairs -> distinct keys.
+        assert len(set(keys.tolist())) == len(
+            {(int(s), int(d)) for s, d in zip(sessions, dids)}
+        )

@@ -1,0 +1,39 @@
+"""The bench suite must STAGE cleanly on every table-schema change.
+
+The on-chip capture runs `benchmarks/bench_suite.py` unattended in rare
+healthy-tunnel windows; a staging bug (e.g. `dataclasses.replace` on a
+column that became a packed virtual column — which happened, and would
+have crashed the first capture in weeks) must surface in the CPU suite
+instead. Constructing every config exercises all the table staging
+without paying for compilation or timing.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+
+def test_bench_suite_configs_stage():
+    from benchmarks.bench_suite import build_benchmarks
+
+    names = [name for name, _fn, _args, _batch in build_benchmarks(quick=True)]
+    assert len(names) == len(set(names))
+    # The headline + the round-4 fast-path pair must be present.
+    for required in (
+        "full_governance_pipeline",
+        "state_wave_general",
+        "state_wave_fastpath",
+        "action_gateway_10k",
+    ):
+        assert required in names, names
+
+
+def test_scaling_phase_programs_stage():
+    from benchmarks.bench_scaling import build_phase_programs
+
+    names = [name for name, _fn, _args in build_phase_programs(2)]
+    for required in ("admission", "fused_wave", "fused_wave_fastpaths"):
+        assert required in names, names

@@ -11,8 +11,8 @@ on the chip" into a crash-only loop:
 Every step runs in its own subprocess with a hard timeout (a wedge
 mid-step is unrecoverable in-process — the PJRT plugin never returns),
 so one wedge costs one step attempt, not the run. Progress is journaled
-to benchmarks/results/capture_r04.json so a restarted daemon resumes
-where it left off; all output streams to capture_r04.log.
+to benchmarks/results/capture_r05.json so a restarted daemon resumes
+where it left off; all output streams to capture_r05.log.
 
 Steps, in order (each skipped once recorded as ok):
   parity      HV_TPU_TESTS=1 pytest of the compiled-Mosaic parity tests
@@ -44,8 +44,8 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 RESULTS = REPO / "benchmarks" / "results"
-JOURNAL = RESULTS / "capture_r04.json"
-LOG = RESULTS / "capture_r04.log"
+JOURNAL = RESULTS / "capture_r05.json"
+LOG = RESULTS / "capture_r05.log"
 
 PROBE_TIMEOUT_S = 90
 PROBE_INTERVAL_S = 300  # between failed probes

@@ -56,7 +56,7 @@ def admit_row_blocks(
     ring: jnp.ndarray | None = None,  # i8[B] assigned rings
     ring_bursts: jnp.ndarray | None = None,  # f32[4] per-ring bucket bursts
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """([B, 8] f32, [B, 5] i32) freshly-admitted row blocks.
+    """([B, 8] f32, [B, 3] i32) freshly-admitted row blocks.
 
     The ONE place the packed column order is spelled out for admission
     writes (by the AF32_*/AI32_* index constants) — `admit_batch` and the
@@ -91,7 +91,7 @@ def admit_row_blocks(
         )
         .at[:, tables_state.AF32_RL_STAMP].set(now_f)
     )
-    i32_rows = jnp.zeros((b, 5), jnp.int32)
+    i32_rows = jnp.zeros((b, 3), jnp.int32)
     i32_rows = (
         i32_rows.at[:, tables_state.AI32_DID].set(did)
         .at[:, tables_state.AI32_SESSION].set(session_slot)
@@ -212,8 +212,10 @@ def admit_batch(
     # index, so the unique-indices fast path's contract holds for the
     # whole wave.
     #
-    # Packed layout: the old 7 per-column scatters are now 3 (one [B, 8]
-    # f32 row block, one [B, 5] i32 row block, the i8 ring column).
+    # Packed layout: the old 7 per-column scatters are now 4 (one [B, 8]
+    # f32 row block, one [B, 3] i32 row block, the i8 ring column, and
+    # the breach-window rows — a recycled slot must not inherit the
+    # previous tenant's sliding window).
     b = slot.shape[0]
     write_slot = jnp.where(
         ok, slot, agents.did.shape[0] + jnp.arange(b, dtype=slot.dtype)
@@ -228,6 +230,9 @@ def admit_batch(
         f32=agents.f32.at[write_slot].set(f32_rows, **drop),
         i32=agents.i32.at[write_slot].set(i32_rows, **drop),
         ring=agents.ring.at[write_slot].set(ring, **drop),
+        bd_window=agents.bd_window.at[write_slot].set(
+            jnp.zeros((b, agents.bd_window.shape[1]), jnp.int32), **drop
+        ),
     )
     new_sessions = replace(
         sessions,

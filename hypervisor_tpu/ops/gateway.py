@@ -24,14 +24,16 @@ segment prefix sums over a stable sort by agent slot:
     same ordinal rule as `HypervisorState.consume_rate`'s sequential
     settle (`security/rate_limiter.py:160-166`).
 
-The breach window here is the device plane's tumbling-counter model
-(`ops.security_ops`): counters accumulate since the last sweep and the
-per-action analysis applies the reference severity ladder to the
-running totals — equal to the host detector's sliding window whenever
-no sweep has rolled the counters mid-window (the parity tests pin that
-regime). Privileged-call accounting compares against the EFFECTIVE
-ring, so a legitimately-elevated call never counts as probing (the
-documented `check_action` contract).
+The breach window here is the device plane's bucketed sliding window
+(`ops.security_ops.window_totals`): BD_BUCKETS sub-windows rolled by
+absolute epoch stamps, so expiry is pure timestamp math, a security
+sweep never resets window state, and the wave's running totals equal
+the host detector's sliding window to sub-window precision (exactly,
+whenever no call's age falls in the oldest partial sub-window — the
+parity tests pin both that regime and a sweep firing mid-window).
+Privileged-call accounting compares against the EFFECTIVE ring, so a
+legitimately-elevated call never counts as probing (the documented
+`check_action` contract).
 """
 
 from __future__ import annotations
@@ -166,13 +168,19 @@ def check_actions(
     )
     # Per-action analysis condition, computed AS IF every record ran the
     # reference analysis (`breach_detector.py:141-186`) on the running
-    # tumbling totals. Ordinals are per-slot prefix counts in wave order.
+    # sliding-window totals. The wave shares one `now`, so the pre-wave
+    # windowed base per row is a constant and in-wave calls (all landing
+    # at `now`, never expiring mid-wave) stack as per-slot prefix counts
+    # in wave order.
+    base_calls, base_priv = security_ops.window_totals(
+        agents.bd_window, now_f, breach
+    )
     ones = valid.astype(jnp.int32)
     k_incl, _ = _segment_prefix(slot, ones)
     privileged = (required_ring < eff) & valid
     p_incl, _ = _segment_prefix(slot, privileged.astype(jnp.int32))
-    total_i = agents.bd_calls[slot] + k_incl
-    priv_i = agents.bd_privileged[slot] + p_incl
+    total_i = base_calls[slot] + k_incl
+    priv_i = base_priv[slot] + p_incl
     analyzable = total_i >= breach.min_calls_for_analysis
     rate_i = jnp.where(
         analyzable,
@@ -281,8 +289,9 @@ def check_actions(
     )
     new_agents = replace(
         agents,
-        bd_calls=agents.bd_calls + calls_add,
-        bd_privileged=agents.bd_privileged + priv_add,
+        bd_window=security_ops.window_commit(
+            agents.bd_window, calls_add, priv_add, now_f, breach
+        ),
         flags=flags.astype(agents.flags.dtype),
         bd_breaker_until=breaker_until.astype(jnp.float32),
         rl_tokens=refilled - grants,

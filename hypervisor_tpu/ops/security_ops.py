@@ -5,15 +5,28 @@ The reference analyzes one agent profile at a time with deque scans
 Python loop (`rings/elevation.py:154-165`). Here the whole agent table
 sweeps in one op:
 
-  * per-agent call counters (total / privileged) live as AgentTable
-    columns, bumped by a scatter-add per action wave,
+  * per-agent breach windows live as a bucketed sliding window in the
+    AgentTable (`bd_window` i32[N, 3K]): K = BD_BUCKETS sub-windows of
+    window_seconds/K each, each holding (calls, privileged, absolute
+    epoch stamp). Expiry is pure timestamp math — a bucket counts iff
+    its epoch is within the last K epochs — so a sweep NEVER resets
+    window state and the device window tracks the host detector's
+    sliding deque to sub-window precision (the round-4 tumbling model
+    diverged whenever a sweep rolled the counters mid-window),
   * the breach sweep derives the anomaly rate and severity ladder for
     every agent at once, trips circuit breakers (FLAG_BREAKER_TRIPPED +
-    cooldown deadline) on HIGH/CRITICAL, un-trips expired breakers, and
-    rolls the window (tumbling-window approximation of the reference's
-    sliding deque — each sweep closes one window),
+    cooldown deadline) on HIGH/CRITICAL, and un-trips expired breakers,
   * elevation expiry is a single vector compare over the ElevationTable,
     and effective rings resolve via a scatter-min of active grants.
+
+Sliding-window precision contract: writes at time t land in the bucket
+of epoch floor(t/sub); the window at `now` covers buckets of the last K
+epochs, i.e. wall-clock (now - W, now] shortened at the old edge by up
+to one sub-window (sub - now%sub seconds). Host and device agree
+EXACTLY whenever no call's age falls inside that oldest partial
+sub-window band (the parity tests construct that regime); otherwise
+they differ by at most the calls in one sub-window — bounded, unlike
+the old sweep-reset divergence which was unbounded.
 
 Severity codes: 0 NONE, 1 LOW, 2 MEDIUM, 3 HIGH, 4 CRITICAL
 (reference thresholds 0.3/0.5/0.7/0.9, `breach_detector.py:67-72`).
@@ -28,6 +41,7 @@ import jax.numpy as jnp
 from hypervisor_tpu.config import BreachConfig, DEFAULT_CONFIG
 from hypervisor_tpu.tables.state import (
     AgentTable,
+    BD_BUCKETS,
     ElevationTable,
     FLAG_BREAKER_TRIPPED,
     FLAG_QUARANTINED,
@@ -37,24 +51,106 @@ from hypervisor_tpu.tables.struct import replace
 SEV_NONE, SEV_LOW, SEV_MEDIUM, SEV_HIGH, SEV_CRITICAL = range(5)
 
 
+# ── bucketed sliding window primitives ───────────────────────────────
+
+
+def window_epoch(
+    now: jnp.ndarray | float, config: BreachConfig = DEFAULT_CONFIG.breach
+) -> jnp.ndarray:
+    """i32 absolute sub-window epoch of `now` (floor(now / sub_width))."""
+    sub = config.window_seconds / BD_BUCKETS
+    return jnp.floor(jnp.asarray(now, jnp.float32) / sub).astype(jnp.int32)
+
+
+def window_totals(
+    bd_window: jnp.ndarray,  # i32[N, 3K]
+    now: jnp.ndarray | float,
+    config: BreachConfig = DEFAULT_CONFIG.breach,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(calls i32[N], privileged i32[N]) inside the sliding window at
+    `now`: the sum of every bucket whose epoch is within the last
+    BD_BUCKETS epochs. No state is mutated — expiry is implicit."""
+    k = BD_BUCKETS
+    cur = window_epoch(now, config)
+    live = bd_window[:, 2 * k :] > cur - k  # i32[N, K] epoch stamps
+    calls = jnp.sum(jnp.where(live, bd_window[:, :k], 0), axis=1)
+    priv = jnp.sum(jnp.where(live, bd_window[:, k : 2 * k], 0), axis=1)
+    return calls, priv
+
+
+def window_commit(
+    bd_window: jnp.ndarray,  # i32[N, 3K]
+    calls_add: jnp.ndarray,  # i32[N] calls landing at `now` per row
+    priv_add: jnp.ndarray,   # i32[N] privileged subset
+    now: jnp.ndarray | float,
+    config: BreachConfig = DEFAULT_CONFIG.breach,
+) -> jnp.ndarray:
+    """Fold one wave's per-row call counts into the current sub-window.
+
+    Buckets are addressed epoch-mod-K, so the current bucket either
+    already carries this epoch's stamp (accumulate) or a stamp at least
+    K epochs old (expired: reset, then accumulate). Rows without new
+    calls still get the roll applied to the current bucket — zeroing an
+    expired bucket is semantics-free (it was already outside every
+    window) and keeps the update one dynamic-column write per block.
+    """
+    k = BD_BUCKETS
+    cur = window_epoch(now, config)
+    j0 = jnp.mod(cur, k)
+    fresh = bd_window[:, 2 * k + j0] == cur
+    new_calls = jnp.where(fresh, bd_window[:, j0], 0) + calls_add
+    new_priv = jnp.where(fresh, bd_window[:, k + j0], 0) + priv_add
+    return (
+        bd_window.at[:, j0]
+        .set(new_calls.astype(jnp.int32))
+        .at[:, k + j0]
+        .set(new_priv.astype(jnp.int32))
+        .at[:, 2 * k + j0]
+        .set(cur)
+    )
+
+
+def window_latest_epoch(
+    bd_window: jnp.ndarray,  # i32[N, 3K]
+    now: jnp.ndarray | float,
+    config: BreachConfig = DEFAULT_CONFIG.breach,
+) -> jnp.ndarray:
+    """i32[N]: newest in-window epoch holding at least one call, or
+    INT32_MIN for rows with no in-window activity. `epoch * sub` lower-
+    bounds the row's most recent call time to sub-window precision."""
+    k = BD_BUCKETS
+    cur = window_epoch(now, config)
+    epochs = bd_window[:, 2 * k :]
+    live = (epochs > cur - k) & (bd_window[:, :k] > 0)
+    return jnp.max(
+        jnp.where(live, epochs, jnp.iinfo(jnp.int32).min), axis=1
+    )
+
+
 def record_calls(
     agents: AgentTable,
     slots: jnp.ndarray,       # i32[B] acting agents
     called_ring: jnp.ndarray, # i8[B] ring each call targeted
+    now: jnp.ndarray | float,
+    config: BreachConfig = DEFAULT_CONFIG.breach,
 ) -> AgentTable:
-    """Bump the breach-window counters for one action wave.
+    """Record one action wave into the breach sliding window at `now`.
 
     A call is privileged when it targets a MORE privileged ring than the
     caller holds (`breach_detector.py:128-135`: lower number = more
     privileged).
     """
+    n = agents.did.shape[0]
     own_ring = agents.ring[slots]
     privileged = called_ring.astype(jnp.int8) < own_ring
+    calls_add = jnp.zeros((n,), jnp.int32).at[slots].add(1)
+    priv_add = (
+        jnp.zeros((n,), jnp.int32).at[slots].add(privileged.astype(jnp.int32))
+    )
     return replace(
         agents,
-        bd_calls=agents.bd_calls.at[slots].add(1),
-        bd_privileged=agents.bd_privileged.at[slots].add(
-            privileged.astype(jnp.int32)
+        bd_window=window_commit(
+            agents.bd_window, calls_add, priv_add, now, config
         ),
     )
 
@@ -70,13 +166,29 @@ def breach_sweep(
     now: jnp.ndarray | float,
     config: BreachConfig = DEFAULT_CONFIG.breach,
 ) -> BreachSweep:
-    """Analyze every agent's window and run the circuit-breaker ladder."""
+    """Analyze every agent's sliding window and run the breaker ladder.
+
+    Window state is untouched (expiry is implicit in the bucket epochs),
+    so sweeping mid-window no longer diverges from the host detector.
+    Reference fidelity for re-trips: the host analyzes only on
+    record_call, and during a cooldown record_call suppresses analysis
+    (`breach_detector.py:123-127`) — so an agent idle since its breaker
+    released must NOT re-trip on stale in-window calls. The sweep
+    reproduces that with bucket-precision: a row is analyzable only if
+    it has in-window activity in a sub-window starting at/after its
+    last breaker release (`bd_breaker_until`; 0 for never-tripped rows).
+    """
     now_f = jnp.asarray(now, jnp.float32)
-    calls = agents.bd_calls
+    calls, priv = window_totals(agents.bd_window, now_f, config)
+    sub = config.window_seconds / BD_BUCKETS
+    latest = window_latest_epoch(agents.bd_window, now_f, config)
+    active_since_release = (
+        latest.astype(jnp.float32) * sub >= agents.bd_breaker_until
+    )
+    analyzable = (calls >= config.min_calls_for_analysis) & active_since_release
     rate = jnp.where(
-        calls >= config.min_calls_for_analysis,
-        agents.bd_privileged.astype(jnp.float32)
-        / jnp.maximum(calls, 1).astype(jnp.float32),
+        analyzable,
+        priv.astype(jnp.float32) / jnp.maximum(calls, 1).astype(jnp.float32),
         0.0,
     )
     severity = (
@@ -85,6 +197,7 @@ def breach_sweep(
         + (rate >= config.high_threshold).astype(jnp.int8)
         + (rate >= config.critical_threshold).astype(jnp.int8)
     )
+    severity = jnp.where(analyzable, severity, 0).astype(jnp.int8)
 
     # Trip on HIGH/CRITICAL; un-trip breakers whose cooldown elapsed.
     trip = severity >= SEV_HIGH
@@ -107,9 +220,6 @@ def breach_sweep(
         agents,
         flags=flags.astype(agents.flags.dtype),
         bd_breaker_until=breaker_until.astype(jnp.float32),
-        # Roll the window: each sweep closes one tumbling window.
-        bd_calls=jnp.zeros_like(agents.bd_calls),
-        bd_privileged=jnp.zeros_like(agents.bd_privileged),
     )
     return BreachSweep(agents=new_agents, severity=severity, tripped=trip)
 

@@ -187,10 +187,19 @@ def _wave_admission(
     unique_sessions: bool = False,
     row_axes=AGENT_AXIS,
     force_eventual: bool = False,
+    fold_extra=None,
 ):
     """The cross-shard admission body (inside shard_map) shared by
     `sharded_admission` and `sharded_governance_wave` so the two can
     never drift. See `sharded_admission` for the collective design.
+
+    `fold_extra` (i32[S_cap] or None): an unrelated per-shard vector the
+    caller wants allreduced ANYWAY (the fused wave's terminate mask) —
+    it rides the session-count psum as one more stacked row instead of
+    costing its own collective, and its reduction comes back appended
+    to the return tuple. Not supported on the force_eventual path (the
+    multislice contract requires the contiguous fast path, which needs
+    no mask).
 
     `row_axes` names the mesh axes agent/vouch ROWS shard over:
     AGENT_AXIS on a 1-D mesh; (DCN_AXIS, AGENT_AXIS) on a multislice
@@ -297,7 +306,9 @@ def _wave_admission(
     # contract), keeping the old value where rejected — a shared
     # park row would give rejected lanes a duplicate index that can
     # clobber an admitted agent landing on that row. Packed blocks:
-    # one [B, 8] f32 row scatter + one [B, 5] i32 + the ring column
+    # one [B, 8] f32 row scatter + one [B, 3] i32 + the ring column +
+    # the breach-window rows (a recycled slot must not inherit the
+    # previous tenant's sliding window)
     # (`admission.admit_row_blocks` is the single source of the
     # layout + accumulator-reset semantics, shared with admit_batch).
     write = local_slot
@@ -316,6 +327,9 @@ def _wave_admission(
         ring=agents.ring.at[write].set(
             jnp.where(ok, ring, agents.ring[write])
         ),
+        bd_window=agents.bd_window.at[write].set(
+            jnp.where(ok[:, None], 0, agents.bd_window[write])
+        ),
     )
 
     # ── replicated session table: allreduce the ACTUAL delta ──────
@@ -323,12 +337,22 @@ def _wave_admission(
     local_add = jnp.zeros((s_cap,), jnp.int32).at[
         jnp.clip(session_slot, 0)
     ].add(jnp.where(ok, 1, 0))
+    if fold_extra is not None and force_eventual:
+        raise ValueError("fold_extra is not supported with force_eventual")
     if not mode_dispatch:
-        global_add = jax.lax.psum(local_add, AGENT_AXIS)
+        if fold_extra is None:
+            global_add = jax.lax.psum(local_add, AGENT_AXIS)
+            extra_out = ()
+        else:
+            folded = jax.lax.psum(
+                jnp.stack([local_add, fold_extra]), AGENT_AXIS
+            )
+            global_add = folded[0]
+            extra_out = (folded[1],)
         sessions = t_replace(
             sessions, n_participants=sessions.n_participants + global_add
         )
-        return agents, sessions, status, ring, sigma_eff
+        return (agents, sessions, status, ring, sigma_eff) + extra_out
     # Mode-dispatched commit: one psum carries both the full view (the
     # wave's internal arithmetic) and the STRONG-only slice (the replica
     # commit); the difference is the EVENTUAL partial this shard hands
@@ -355,8 +379,12 @@ def _wave_admission(
             agents, sessions, status, ring, sigma_eff,
             view_counts, ev_counts_local,
         )
-    both = jax.lax.psum(jnp.stack([local_add, local_strong]), AGENT_AXIS)
+    rows = [local_add, local_strong]
+    if fold_extra is not None:
+        rows.append(fold_extra)
+    both = jax.lax.psum(jnp.stack(rows), AGENT_AXIS)
     view_add, strong_add = both[0], both[1]
+    extra_out = (both[2],) if fold_extra is not None else ()
     view_counts = sessions.n_participants + view_add
     sessions = t_replace(
         sessions, n_participants=sessions.n_participants + strong_add
@@ -365,7 +393,7 @@ def _wave_admission(
     return (
         agents, sessions, status, ring, sigma_eff,
         view_counts, ev_counts_local,
-    )
+    ) + extra_out
 
 
 
@@ -847,6 +875,17 @@ def sharded_governance_wave(
         s_cap = sessions.sid.shape[0]
 
         # ── 1-2. cross-shard vouched admission ────────────────────────
+        # On the mask-terminate path the wave-session mask needs an
+        # allreduce of its own input-derived vector; it rides the
+        # admission count psum as a stacked row (fold_extra) instead of
+        # a separate collective.
+        ws = wave_sessions                       # i32[K/D] local lanes
+        if contiguous_waves:
+            fold_extra = None
+        else:
+            fold_extra = (
+                jnp.zeros((s_cap,), jnp.int32).at[jnp.clip(ws, 0)].set(1)
+            )
         admitted = _wave_admission(
             agents, sessions, vouches, slot, did, session_slot,
             sigma_raw, trustworthy, duplicate, now, omega, n_shards, trust,
@@ -854,16 +893,19 @@ def sharded_governance_wave(
             unique_sessions=unique_sessions,
             row_axes=row_axes,
             force_eventual=multislice,
+            fold_extra=fold_extra,
         )
         agents, sessions, status, ring, sigma_eff = admitted[:5]
+        rest_out = admitted[5:]
         if mode_dispatch:
-            view_counts, ev_counts_local = admitted[5:]
+            view_counts, ev_counts_local = rest_out[:2]
+            rest_out = rest_out[2:]
         else:
             view_counts = sessions.n_participants
+        in_wave = (rest_out[0] > 0) if fold_extra is not None else None
         ok = status == admission_ops.ADMIT_OK
 
         # ── 3. FSM walk on this shard's wave lanes ────────────────────
-        ws = wave_sessions                       # i32[K/D] local lanes
         state_before = sessions.state[ws]
         has_members = view_counts[ws] > 0
         wave_state, err_a = session_fsm.apply_session_transitions(
@@ -898,18 +940,19 @@ def sharded_governance_wave(
                 )
             )
         else:
-            local_mask = (
-                jnp.zeros((s_cap,), jnp.int32).at[jnp.clip(ws, 0)].set(1)
-            )
-            in_wave = jax.lax.psum(local_mask, AGENT_AXIS) > 0
             # Mask path on purpose (no wave_sessions): each shard only
             # holds its K/D wave lanes, but its edge/agent blocks must
-            # release for EVERY shard's sessions — only the psum'd
-            # global mask knows them.
+            # release for EVERY shard's sessions — only the global mask
+            # (allreduced on the admission count psum, fold_extra)
+            # knows them.
             agents, vouches, released_local = (
                 terminate_ops.release_session_scope(agents, vouches, in_wave)
             )
-        released = jax.lax.psum(released_local, row_axes)
+        if multislice:
+            # The FSM fold below is skipped on this path (all commits
+            # defer to the DCN reconcile), so the released total rides
+            # its own cross-slice reduction.
+            released = jax.lax.psum(released_local, row_axes)
 
         wave_state, err_t = session_fsm.apply_session_transitions(
             wave_state, jnp.int8(SessionState.TERMINATING.code), has_members
@@ -955,9 +998,27 @@ def sharded_governance_wave(
 
         if not multislice:
             owned_s, state_s, term_s = lane_fold(strong_lane)
-            owned = jax.lax.psum(owned_s, AGENT_AXIS) > 0
-            state_val = jax.lax.psum(state_s, AGENT_AXIS)
-            term_val = jax.lax.psum(term_s, AGENT_AXIS)
+            # ONE psum carries the whole post-terminate fold: the three
+            # FSM replica rows AND the released-bond total (stacked as
+            # f32 [4, S] — counts and state codes are tiny integers,
+            # exact in f32 far past 2^24; term values are per-session
+            # single-owner sums, exact under zero-padding). Round-4
+            # shipped these as four separate all-reduces.
+            payload = jnp.stack(
+                [
+                    owned_s.astype(jnp.float32),
+                    state_s.astype(jnp.float32),
+                    term_s,
+                    jnp.zeros((s_cap,), jnp.float32)
+                    .at[0]
+                    .set(released_local.astype(jnp.float32)),
+                ]
+            )
+            folded = jax.lax.psum(payload, AGENT_AXIS)
+            owned = folded[0] > 0
+            state_val = folded[1].astype(jnp.int32)
+            term_val = folded[2]
+            released = folded[3, 0].astype(jnp.int32)
             sessions = t_replace(
                 sessions,
                 state=jnp.where(

@@ -264,6 +264,18 @@ def _rebuild(data, meta: dict, config: HypervisorConfig) -> HypervisorState:
     state = HypervisorState(config)
     for tname, ttype in _TABLE_TYPES.items():
         data = _repack_legacy_packed_columns(data, tname, ttype)
+    # Saves written before the breach sliding window carried the breach
+    # tumbling counters as agents.i32 columns 3-4 (did/session/flags/
+    # bd_calls/bd_privileged, width 5). The breach window is 60 s of
+    # transient state — any realistic save->restore gap outlives it — so
+    # the legacy counters are dropped and `bd_window` (absent from such
+    # saves) starts fresh via the missing-column default below.
+    # (`data` is always a plain dict here: the repack loop above
+    # converts NpzFile inputs for every table.)
+    if "agents.i32" in data:
+        legacy_i32 = np.asarray(data["agents.i32"])
+        if legacy_i32.ndim == 2 and legacy_i32.shape[1] == 5:
+            data["agents.i32"] = legacy_i32[:, :3]
     for tname, ttype in _TABLE_TYPES.items():
         fields = dataclasses.fields(ttype)
         cols = {

@@ -258,6 +258,17 @@ class StagingQueue:
         """Claim a slot; returns the slot index or -1 when the epoch is full."""
         if HAVE_NATIVE:
             self._ensure_bound()
+            # Count BEFORE the native push: a concurrent harvest
+            # (supported producer/driver overlap) may swap between the
+            # push and any post-hoc increment, and its subtraction must
+            # already see this entry counted — otherwise the clamped
+            # subtraction leaves a phantom count that later raises a
+            # spurious "staged join(s) lost" or skews a real one.
+            # Whether the entry lands pre- or post-swap, pre-counting
+            # keeps the detector exact; a full epoch (slot < 0) undoes
+            # the provisional count below.
+            with self._count_lock:
+                self._staged_since_harvest += 1
             slot = int(
                 _lib.hv_stage_push(sigma, agent, session, 1 if trustworthy else 0)
             )
@@ -271,9 +282,9 @@ class StagingQueue:
                     "constructing a HypervisorState while another "
                     "state's producers are mid-push is not supported"
                 )
-            if slot >= 0:
+            if slot < 0:
                 with self._count_lock:
-                    self._staged_since_harvest += 1
+                    self._staged_since_harvest -= 1
             return slot
         if self._py_cursor >= self.capacity:
             return -1
@@ -298,10 +309,10 @@ class StagingQueue:
             with self._count_lock:
                 # Subtract what this swap harvested; pushes that landed
                 # AFTER the swap (supported producer/driver overlap)
-                # belong to the new epoch and keep their count.
-                self._staged_since_harvest = max(
-                    0, self._staged_since_harvest - n
-                )
+                # belong to the new epoch and keep their count. Every
+                # entry in n was counted BEFORE its push (see push()),
+                # so the subtraction is exact — no clamp needed.
+                self._staged_since_harvest -= n
         else:
             n = self._py_cursor
             self._py_cursor = 0

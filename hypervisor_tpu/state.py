@@ -77,9 +77,13 @@ _WAVE_DONATED = jax.jit(
     static_argnames=("use_pallas", "unique_sessions"),
     donate_argnums=(0, 1, 2),
 )
-_RECORD_CALLS = jax.jit(security_ops.record_calls)
+_RECORD_CALLS = jax.jit(
+    security_ops.record_calls, static_argnames=("config",)
+)
 _SLASH = jax.jit(liability_ops.slash_cascade)
-_BREACH_SWEEP = jax.jit(security_ops.breach_sweep)
+_BREACH_SWEEP = jax.jit(
+    security_ops.breach_sweep, static_argnames=("config",)
+)
 _ELEV_EXPIRY = jax.jit(security_ops.elevation_expiry)
 _QUAR_ENTER = jax.jit(security_ops.quarantine_enter)
 _RATE_CONSUME = jax.jit(rate_limit.consume, static_argnames=("config",))
@@ -1295,13 +1299,18 @@ class HypervisorState:
     # ── security sweeps ──────────────────────────────────────────────
 
     def record_calls(
-        self, agent_slots: Sequence[int], called_rings: Sequence[int]
+        self,
+        agent_slots: Sequence[int],
+        called_rings: Sequence[int],
+        now: Optional[float] = None,
     ) -> None:
-        """Bump breach-window counters for one action wave."""
+        """Record one action wave into the breach sliding window."""
         self.agents = _RECORD_CALLS(
             self.agents,
             jnp.asarray(np.asarray(agent_slots, np.int32)),
             jnp.asarray(np.asarray(called_rings, np.int8)),
+            self.now() if now is None else now,
+            config=self.config.breach,
         )
 
     def consume_rate(
@@ -1660,7 +1669,7 @@ class HypervisorState:
     def breach_sweep_tick(self, now: float) -> tuple[np.ndarray, np.ndarray]:
         """Run the batched breach analysis; returns (severity, tripped)."""
         with profiling.span("hv.breach_sweep"):
-            result = _BREACH_SWEEP(self.agents, now)
+            result = _BREACH_SWEEP(self.agents, now, config=self.config.breach)
         self.agents = result.agents
         return np.asarray(result.severity), np.asarray(result.tripped)
 

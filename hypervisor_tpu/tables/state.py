@@ -35,8 +35,14 @@ AF32_QUARANTINE_UNTIL = 7
 AI32_DID = 0
 AI32_SESSION = 1
 AI32_FLAGS = 2
-AI32_BD_CALLS = 3
-AI32_BD_PRIVILEGED = 4
+
+# Breach-window sub-bucket count: the device plane's sliding window is
+# BD_BUCKETS tumbling sub-windows of window_seconds/BD_BUCKETS each,
+# rolled by timestamp math (absolute epoch stamps) so expiry is implicit
+# and a security sweep never resets window state — the device window
+# tracks the host detector's sliding deque to sub-window precision
+# instead of diverging across sweeps (`ops.security_ops` for the math).
+BD_BUCKETS = 6
 
 
 @table(
@@ -52,8 +58,6 @@ AI32_BD_PRIVILEGED = 4
         "did": ("i32", AI32_DID),
         "session": ("i32", AI32_SESSION),
         "flags": ("i32", AI32_FLAGS),
-        "bd_calls": ("i32", AI32_BD_CALLS),
-        "bd_privileged": ("i32", AI32_BD_PRIVILEGED),
     }
 )
 class AgentTable:
@@ -65,26 +69,35 @@ class AgentTable:
 
       f32[N, 8]: sigma_raw, sigma_eff, joined_at, risk_score, rl_tokens,
                  rl_stamp, bd_breaker_until, quarantine_until
-      i32[N, 5]: did (-1 = free slot), session (-1 = none), flags
-                 (FLAG_* bitmask), bd_calls, bd_privileged
+      i32[N, 3]: did (-1 = free slot), session (-1 = none), flags
+                 (FLAG_* bitmask)
+
+    plus the breach-window block `bd_window` i32[N, 3*BD_BUCKETS]:
+    per-sub-window call counts [:, :K], privileged-call counts
+    [:, K:2K], and absolute sub-window epoch stamps [:, 2K:3K]
+    (K = BD_BUCKETS). A bucket is in-window iff its epoch is within the
+    last K epochs of `now` — sliding-window semantics with no
+    sweep-driven reset (`ops.security_ops.window_totals`).
 
     Every legacy column name stays readable (`agents.sigma_eff`) and
     writable through `tables.struct.replace`; hot waves write whole
     [B, W] rows instead.
     """
 
-    f32: jnp.ndarray   # f32[N, 8] packed float columns (AF32_* indices)
-    i32: jnp.ndarray   # i32[N, 5] packed int columns (AI32_* indices)
-    ring: jnp.ndarray  # i8[N] 0..3
+    f32: jnp.ndarray        # f32[N, 8] packed float columns (AF32_* indices)
+    i32: jnp.ndarray        # i32[N, 3] packed int columns (AI32_* indices)
+    ring: jnp.ndarray       # i8[N] 0..3
+    bd_window: jnp.ndarray  # i32[N, 3*BD_BUCKETS] breach sliding window
 
     @staticmethod
     def create(capacity: int) -> "AgentTable":
-        i32 = jnp.zeros((capacity, 5), jnp.int32)
+        i32 = jnp.zeros((capacity, 3), jnp.int32)
         i32 = i32.at[:, AI32_DID].set(-1).at[:, AI32_SESSION].set(-1)
         return AgentTable(
             f32=jnp.zeros((capacity, 8), jnp.float32),
             i32=i32,
             ring=jnp.full((capacity,), 3, jnp.int8),
+            bd_window=jnp.zeros((capacity, 3 * BD_BUCKETS), jnp.int32),
         )
 
 

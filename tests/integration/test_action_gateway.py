@@ -53,8 +53,13 @@ class TestActionGateway:
         assert result.allowed and result.reason == "allowed"
         assert result.effective_ring is ExecutionRing.RING_2_STANDARD
         # Both planes recorded the call.
+        from hypervisor_tpu.ops import security_ops
+
         row = hv.state.agent_row("did:a", ms.slot)
-        assert int(np.asarray(hv.state.agents.bd_calls)[row["slot"]]) == 1
+        calls, _ = security_ops.window_totals(
+            hv.state.agents.bd_window, hv.state.now(), hv.state.config.breach
+        )
+        assert int(np.asarray(calls)[row["slot"]]) == 1
         assert hv.breach_detector.get_agent_stats("did:a", sid)["total_calls"] == 1
 
     async def test_quarantined_membership_is_read_only(self):
@@ -151,10 +156,15 @@ class TestActionGateway:
                 break  # probing tripped the breaker mid-loop — the point
         # Repeated privileged probing crossed an anomaly threshold.
         assert breach is not None
+        from hypervisor_tpu.ops import security_ops
+
         row = hv.state.agent_row("did:p", ms.slot)
         # Every PRE-trip probe was recorded on the device plane too
         # (min_calls_for_analysis probes are needed before the ladder).
-        assert int(np.asarray(hv.state.agents.bd_privileged)[row["slot"]]) >= 5
+        _, priv = security_ops.window_totals(
+            hv.state.agents.bd_window, hv.state.now(), hv.state.config.breach
+        )
+        assert int(np.asarray(priv)[row["slot"]]) >= 5
 
     async def test_tripped_breaker_refuses_until_cooldown(self):
         hv = Hypervisor()

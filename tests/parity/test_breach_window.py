@@ -1,0 +1,251 @@
+"""Device sliding-window breach model ≡ host detector, across sweeps.
+
+The round-4 device plane kept tumbling counters that a security sweep
+reset, diverging from the host detector's sliding window whenever a
+sweep fired mid-window (VERDICT r4 weak #5). The bucketed sliding
+window (`tables.state.BD_BUCKETS` sub-windows rolled by absolute epoch
+stamps, `ops.security_ops`) removes that regime: sweeps never touch
+window state, expiry is timestamp math. These tests pin
+
+  * the headline criterion: a sweep fires MID-WINDOW and both planes
+    still agree exactly on the anomaly analysis afterwards,
+  * sliding expiry: calls leave the device window after window_seconds
+    without any sweep,
+  * bucket-wrap correctness: a bucket reused K epochs later evicts the
+    stale counts first,
+  * the precision contract: host and device agree exactly while every
+    call's age stays clear of the oldest partial sub-window,
+  * checkpoint migration: legacy width-5 agents.i32 blocks restore.
+
+Host semantics anchor: reference `rings/breach_detector.py:120-186`
+(60 s sliding window, severity ladder on the privileged-call rate).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.models import ExecutionRing, SessionConfig
+from hypervisor_tpu.ops import security_ops
+from hypervisor_tpu.rings.breach_detector import RingBreachDetector
+from hypervisor_tpu.state import HypervisorState
+from hypervisor_tpu.tables.state import BD_BUCKETS, FLAG_BREAKER_TRIPPED
+
+CFG = DEFAULT_CONFIG.breach
+SUB = CFG.window_seconds / BD_BUCKETS
+EPOCH0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+class FakeClock:
+    """Host-detector clock pinned to the device plane's relative time."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> datetime:
+        return EPOCH0 + timedelta(seconds=self.t)
+
+
+def _admitted_state(n: int = 2, sigma: float = 0.8) -> HypervisorState:
+    st = HypervisorState()
+    slot = st.create_session("s:bw", SessionConfig(max_participants=32))
+    for i in range(n):
+        st.enqueue_join(slot, f"did:bw{i}", sigma)
+    assert (st.flush_joins() == 0).all()
+    return st
+
+
+def _totals(st: HypervisorState, now: float) -> tuple[np.ndarray, np.ndarray]:
+    calls, priv = security_ops.window_totals(
+        st.agents.bd_window, now, st.config.breach
+    )
+    return np.asarray(calls), np.asarray(priv)
+
+
+class TestSweepMidWindow:
+    def test_sweep_mid_window_both_planes_agree(self):
+        """THE r4 divergence regime: record → sweep mid-window → record
+        → analyze. The old tumbling model forgot the pre-sweep calls;
+        the sliding window must keep them, matching the host detector
+        call for call."""
+        st = _admitted_state()
+        clock = FakeClock()
+        host = RingBreachDetector(clock=clock)
+
+        # 4 privileged probes at t=1 (ring-2 agent calling ring 0).
+        clock.t = 1.0
+        st.record_calls([0] * 4, [0] * 4, now=1.0)
+        host_events = [
+            host.record_call(
+                "did:bw0", "s:bw", ExecutionRing.RING_2_STANDARD,
+                ExecutionRing.RING_0_ROOT,
+            )
+            for _ in range(4)
+        ]
+        assert all(e is None for e in host_events)  # < min_calls (5)
+
+        # A sweep fires MID-WINDOW. Old model: counters reset to 0 here.
+        severity, tripped = st.breach_sweep_tick(now=2.0)
+        assert int(severity[0]) == 0 and not tripped[0]  # < min_calls
+        calls, priv = _totals(st, 2.0)
+        assert int(calls[0]) == 4 and int(priv[0]) == 4  # window SURVIVED
+
+        # 2 more probes at t=3: analysis must see 6/6 privileged — the
+        # host trips CRITICAL at call 5; the device sweep agrees.
+        clock.t = 3.0
+        st.record_calls([0] * 2, [0] * 2, now=3.0)
+        ev5 = host.record_call(
+            "did:bw0", "s:bw", ExecutionRing.RING_2_STANDARD,
+            ExecutionRing.RING_0_ROOT,
+        )
+        assert ev5 is not None and ev5.actual_rate == 1.0
+        assert host.is_breaker_tripped("did:bw0", "s:bw")
+
+        severity, tripped = st.breach_sweep_tick(now=3.0)
+        assert int(severity[0]) == 4 and bool(tripped[0])  # CRITICAL
+        calls, priv = _totals(st, 3.0)
+        assert int(calls[0]) == 6 and int(priv[0]) == 6
+        assert int(np.asarray(st.agents.flags)[0]) & FLAG_BREAKER_TRIPPED
+
+    def test_agreement_through_many_sweeps(self):
+        """Rate parity host-vs-device after every record wave, with a
+        sweep between each wave — mixed privileged/clean traffic."""
+        st = _admitted_state()
+        clock = FakeClock()
+        host = RingBreachDetector(clock=clock)
+        pattern = [1, 0, 1, 1, 0, 1, 1, 1, 0, 1]  # 1 = privileged probe
+
+        anom = total = 0
+        for k, p in enumerate(pattern):
+            t = 1.0 + k  # all well inside one window
+            clock.t = t
+            st.record_calls([0], [0 if p else 2], now=t)
+            host.record_call(
+                "did:bw0", "s:bw", ExecutionRing.RING_2_STANDARD,
+                ExecutionRing.RING_0_ROOT if p
+                else ExecutionRing.RING_2_STANDARD,
+            )
+            total += 1
+            anom += p
+            st.breach_sweep_tick(now=t)  # a sweep after EVERY wave
+            calls, priv = _totals(st, t)
+            assert int(calls[0]) == total
+            assert int(priv[0]) == anom
+            hs = host.get_agent_stats("did:bw0", "s:bw")
+            assert hs["window_calls"] == total
+
+
+class TestSlidingExpiry:
+    def test_calls_expire_without_any_sweep(self):
+        st = _admitted_state()
+        st.record_calls([0] * 6, [0] * 6, now=5.0)
+        calls, priv = _totals(st, 5.0)
+        assert int(calls[0]) == 6 and int(priv[0]) == 6
+        # Still in-window just before expiry...
+        calls, _ = _totals(st, 5.0 + CFG.window_seconds - SUB - 1.0)
+        assert int(calls[0]) == 6
+        # ...gone after the window has slid past (no sweep ever ran).
+        calls, priv = _totals(st, 5.0 + CFG.window_seconds + SUB)
+        assert int(calls[0]) == 0 and int(priv[0]) == 0
+
+    def test_expired_window_does_not_trip(self):
+        st = _admitted_state()
+        st.record_calls([0] * 8, [0] * 8, now=1.0)
+        late = 1.0 + 2 * CFG.window_seconds
+        severity, tripped = st.breach_sweep_tick(now=late)
+        assert int(severity[0]) == 0 and not tripped[0]
+
+    def test_partial_expiry_slides_not_tumbles(self):
+        """Calls in two different sub-windows expire independently."""
+        st = _admitted_state()
+        st.record_calls([0] * 4, [0] * 4, now=0.5 * SUB)       # bucket e0
+        st.record_calls([0] * 3, [2] * 3, now=3.5 * SUB)       # bucket e3
+        t1 = 0.5 * SUB + CFG.window_seconds + SUB  # first batch aged out
+        calls, priv = _totals(st, t1)
+        assert int(calls[0]) == 3 and int(priv[0]) == 0
+        t2 = 3.5 * SUB + CFG.window_seconds + SUB  # second batch too
+        calls, _ = _totals(st, t2)
+        assert int(calls[0]) == 0
+
+    def test_bucket_wrap_evicts_stale_counts(self):
+        """A write K epochs later reuses the same bucket index and must
+        evict the stale counts, not accumulate into them."""
+        st = _admitted_state()
+        t0 = 2.5 * SUB
+        st.record_calls([0] * 5, [0] * 5, now=t0)
+        t1 = t0 + BD_BUCKETS * SUB  # same bucket index, next wrap
+        st.record_calls([0] * 2, [2] * 2, now=t1)
+        calls, priv = _totals(st, t1)
+        assert int(calls[0]) == 2 and int(priv[0]) == 0
+
+    def test_idle_agent_releases_after_cooldown_despite_inwindow_calls(self):
+        """Reference: analysis only runs on record_call, so an agent
+        idle since its breaker released stays released even while the
+        old anomalous calls are technically still in-window
+        (`breach_detector.py:123-127` suppression + lazy release)."""
+        st = _admitted_state()
+        st.record_calls([0] * 6, [0] * 6, now=0.0)
+        _, tripped = st.breach_sweep_tick(now=0.0)
+        assert tripped[0]
+        cooldown = CFG.circuit_breaker_cooldown_seconds
+        # Past cooldown, still inside the 60 s window: the calls are
+        # in-window but predate the release → no re-analysis, released.
+        st.breach_sweep_tick(now=cooldown + 1.0)
+        assert not (
+            int(np.asarray(st.agents.flags)[0]) & FLAG_BREAKER_TRIPPED
+        )
+
+    def test_fresh_probes_after_release_retrip(self):
+        """New probes after release re-arm analysis (reference: the next
+        record_call after cooldown re-runs the ladder on the window)."""
+        st = _admitted_state()
+        st.record_calls([0] * 6, [0] * 6, now=0.0)
+        _, tripped = st.breach_sweep_tick(now=0.0)
+        assert tripped[0]
+        cooldown = CFG.circuit_breaker_cooldown_seconds
+        # Fresh probes land AFTER the release instant, in a sub-window
+        # starting at/after it (cooldown=30 is sub-window aligned).
+        t = cooldown + SUB
+        st.record_calls([0] * 2, [0] * 2, now=t)
+        severity, tripped = st.breach_sweep_tick(now=t)
+        assert bool(tripped[0]) and int(severity[0]) == 4
+
+
+class TestCheckpointMigration:
+    def test_legacy_width5_i32_block_restores(self, tmp_path):
+        """A checkpoint whose agents.i32 still carries the r4 tumbling
+        counters (width 5) restores: identity columns survive, the
+        transient breach window starts fresh."""
+        from hypervisor_tpu.runtime import checkpoint as ckpt
+
+        st = _admitted_state()
+        st.record_calls([0] * 6, [0] * 6, now=1.0)
+        target = ckpt.save_state(st, tmp_path, step=1)
+
+        # Rewrite the save in the legacy layout: i32 widened to 5 with
+        # tumbling counters in cols 3-4, no bd_window key.
+        data = dict(np.load(target / "tables.npz"))
+        i32 = data.pop("agents.i32")
+        bdw = data.pop("agents.bd_window")
+        n = i32.shape[0]
+        legacy = np.zeros((n, 5), np.int32)
+        legacy[:, :3] = i32
+        legacy[:, 3] = bdw[:, :BD_BUCKETS].sum(1)
+        legacy[:, 4] = bdw[:, BD_BUCKETS : 2 * BD_BUCKETS].sum(1)
+        data["agents.i32"] = legacy
+        np.savez(target / "tables.npz", **data)
+
+        restored = ckpt.restore_state(target)
+        np.testing.assert_array_equal(
+            np.asarray(restored.agents.did), np.asarray(st.agents.did)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored.agents.flags), np.asarray(st.agents.flags)
+        )
+        assert not np.asarray(restored.agents.bd_window).any()

@@ -119,14 +119,44 @@ SEQUENCE = [
 ]
 
 
+def _window_totals(hv):
+    """(calls[N], privileged[N]) of the device sliding window at now."""
+    from hypervisor_tpu.ops import security_ops
+
+    calls, priv = security_ops.window_totals(
+        hv.state.agents.bd_window, hv.state.now(), hv.state.config.breach
+    )
+    return np.asarray(calls), np.asarray(priv)
+
+
+def _plant_window(hv, slot, calls, privileged=0):
+    """Inject device-only window counts into the CURRENT sub-window
+    bucket (host detector never sees them — deliberate divergence)."""
+    import jax.numpy as jnp
+
+    from hypervisor_tpu.ops.security_ops import window_epoch
+    from hypervisor_tpu.tables.state import BD_BUCKETS
+
+    cur = int(window_epoch(hv.state.now(), hv.state.config.breach))
+    j0 = cur % BD_BUCKETS
+    w = hv.state.agents.bd_window
+    w = (
+        w.at[slot, j0].set(calls)
+        .at[slot, BD_BUCKETS + j0].set(privileged)
+        .at[slot, 2 * BD_BUCKETS + j0].set(cur)
+    )
+    hv.state.agents = t_replace(hv.state.agents, bd_window=jnp.asarray(w))
+
+
 def _snapshot(hv, ms, dids):
     ag = hv.state.agents
+    calls_all, priv_all = _window_totals(hv)
     out = {}
     for did in dids:
         slot = hv.state.agent_row(did, ms.slot)["slot"]
         out[did] = dict(
-            calls=int(np.asarray(ag.bd_calls)[slot]),
-            privileged=int(np.asarray(ag.bd_privileged)[slot]),
+            calls=int(calls_all[slot]),
+            privileged=int(priv_all[slot]),
             tripped=bool(np.asarray(ag.flags)[slot] & FLAG_BREAKER_TRIPPED),
             quarantined=bool(np.asarray(ag.flags)[slot] & FLAG_QUARANTINED),
             tokens=float(np.asarray(ag.rl_tokens)[slot]),
@@ -218,32 +248,30 @@ class TestGatewayWaveParity:
 
         slot = hv_w.state.agent_row("did:sudo", ms_w.slot)["slot"]
         ag = hv_w.state.agents
-        assert int(np.asarray(ag.bd_calls)[slot]) == 6
+        calls_all, priv_all = _window_totals(hv_w)
+        assert int(calls_all[slot]) == 6
         # required ring 1 == effective ring 1 → never a privileged probe
         # (against the BASE ring 2 every one of these would have counted,
         # 6 > min_calls and the breaker would already be live).
-        assert int(np.asarray(ag.bd_privileged)[slot]) == 0
+        assert int(priv_all[slot]) == 0
         assert not bool(np.asarray(ag.flags)[slot] & FLAG_BREAKER_TRIPPED)
         assert all(r.allowed for r in wave)
 
     async def test_host_only_trip_mid_wave_gates_later_actions(self):
-        """When the planes' windows disagree (device counters diluted by
-        stale clean calls the host window has already slid past), a
-        HOST-plane trip during the wave must still refuse later actions
-        — each action's host breaker state is read after the mirror
-        recorded everything before it, like the sequential pipeline."""
+        """When the planes' windows disagree (device window diluted by
+        planted clean calls the host detector never saw), a HOST-plane
+        trip during the wave must still refuse later actions — each
+        action's host breaker state is read after the mirror recorded
+        everything before it, like the sequential pipeline."""
         hv_w, ms_w, sid_w = await _world()
         hv_s, ms_s, sid_s = await _world()
 
-        # Dilute the DEVICE window only: 200 stale clean calls mean 7
-        # privileged probes stay under the 0.7 trip threshold on device,
-        # while the host's fresh sliding window trips at probe 5.
+        # Dilute the DEVICE window only: 200 clean in-window calls mean
+        # 7 privileged probes stay under the 0.7 trip threshold on
+        # device, while the host's undiluted window trips at probe 5.
         for hv, ms in ((hv_w, ms_w), (hv_s, ms_s)):
             slot = hv.state.agent_row("did:probe", ms.slot)["slot"]
-            hv.state.agents = t_replace(
-                hv.state.agents,
-                bd_calls=hv.state.agents.bd_calls.at[slot].set(200),
-            )
+            _plant_window(hv, slot, calls=200)
 
         probes = [("did:probe", _admin(), False, False)] * 7
         wave = await hv_w.check_actions(sid_w, probes)

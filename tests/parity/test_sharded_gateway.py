@@ -25,6 +25,7 @@ from hypervisor_tpu.config import DEFAULT_CONFIG, RateLimitConfig
 from hypervisor_tpu.models import SessionConfig
 from hypervisor_tpu.ops import gateway as gw
 from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.ops import security_ops
 from hypervisor_tpu.parallel import make_mesh
 from hypervisor_tpu.state import HypervisorState
 from hypervisor_tpu.tables.state import FLAG_BREAKER_TRIPPED
@@ -259,4 +260,7 @@ class TestFusedWaveWithGateway:
         # gateway columns agree bit-for-bit.
         for st in (st1, st2):
             assert np.asarray(st.agents.flags)[33] & FLAG_BREAKER_TRIPPED
-            assert int(np.asarray(st.agents.bd_calls)[33]) == 7
+            calls, _ = security_ops.window_totals(
+                st.agents.bd_window, st.now(), st.config.breach
+            )
+            assert int(np.asarray(calls)[33]) == 7

@@ -1,0 +1,106 @@
+"""Structural collective census of the fused governance wave, pinned.
+
+The census is environment-independent: the same shard_map program
+lowers to the same collective structure on any backend — only link
+bandwidth changes. Round 4 shipped the fused wave at 9-12 all-reduces;
+round 5 fused the payloads down to 4, which is the structural floor
+given the data dependencies:
+
+  1. the slot→session wave map psum (edges on any shard need the full
+     map before contributions can be scored),
+  2. the vouched-contribution psum (depends on 1),
+  3. the admission session-count psum (depends on 2 via sigma_eff; the
+     terminate membership mask rides this one as a stacked row on the
+     non-contiguous path),
+  4. the post-terminate fold (FSM owned/state/terminated rows + the
+     released-bond total, stacked [4, S] — depends on the terminate
+     release which depends on 3).
+
+A regression here means someone added a collective without folding it
+into an existing payload — wall-clock on ICI is latency-bound at wave
+sizes, so every extra all-reduce is a full link round-trip.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from hypervisor_tpu.models import SessionState
+from hypervisor_tpu.parallel import make_mesh
+from hypervisor_tpu.parallel.collectives import sharded_governance_wave
+from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+N_DEV = 4
+ROWS = 8  # agent rows per shard
+
+
+def _census(compiled, op: str) -> int:
+    txt = compiled.as_text()
+    return len(re.findall(re.escape(op) + r"[-.\"( ]", txt))
+
+
+def _wave_world(one_join_per_session: bool):
+    b = 2 * N_DEV
+    k = b if one_join_per_session else N_DEV
+    agents = AgentTable.create(ROWS * N_DEV)
+    sessions = SessionTable.create(2 * k)
+    ws = jnp.arange(k)
+    sessions = t_replace(
+        sessions,
+        state=sessions.state.at[ws].set(
+            jnp.int8(SessionState.HANDSHAKING.code)
+        ),
+        max_participants=sessions.max_participants.at[ws].set(32),
+        min_sigma_eff=sessions.min_sigma_eff.at[ws].set(0.0),
+    )
+    vouches = VouchTable.create(4 * N_DEV)
+    per = b // N_DEV
+    slots = jnp.asarray(
+        [(i // per) * ROWS + (i % per) for i in range(b)], jnp.int32
+    )
+    sess_of = (
+        jnp.arange(b, dtype=jnp.int32)
+        if one_join_per_session
+        else jnp.arange(b, dtype=jnp.int32) % k
+    )
+    bodies = jnp.asarray(
+        np.random.RandomState(0).randint(
+            0, 2**32, size=(2, k, 12), dtype=np.uint64
+        ).astype(np.uint32)
+    )
+    return (
+        agents, sessions, vouches, slots,
+        jnp.arange(b, dtype=jnp.int32), sess_of,
+        jnp.full((b,), 0.8, jnp.float32), jnp.ones((b,), bool),
+        jnp.zeros((b,), bool), ws, bodies, 0.0, 0.5,
+    ), b, k
+
+
+class TestFusedWaveCensus:
+    def test_fastpath_wave_is_four_allreduces_zero_gathers(self):
+        mesh = make_mesh(N_DEV, platform="cpu")
+        args, b, k = _wave_world(one_join_per_session=True)
+        fn = sharded_governance_wave(
+            mesh, contiguous_waves=True, unique_sessions=True
+        )
+        compiled = fn.lower(
+            *args, jnp.asarray(0, jnp.int32), jnp.asarray(k, jnp.int32)
+        ).compile()
+        assert _census(compiled, "all-reduce") <= 4
+        assert _census(compiled, "all-gather") == 0
+        assert _census(compiled, "all-to-all") == 0
+
+    def test_mask_terminate_wave_adds_no_extra_allreduce(self):
+        """The non-contiguous path's terminate membership mask must ride
+        the admission count psum (fold_extra), not its own collective."""
+        mesh = make_mesh(N_DEV, platform="cpu")
+        args, b, k = _wave_world(one_join_per_session=False)
+        compiled = sharded_governance_wave(mesh).lower(*args).compile()
+        assert _census(compiled, "all-reduce") <= 4

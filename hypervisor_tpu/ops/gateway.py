@@ -137,7 +137,8 @@ def check_actions(
     breach-window recording (refused probes record too). `host_tripped`
     folds the host detector's sliding-window breaker verdict into gate
     1 so EITHER plane's breaker refuses (the stateful-coherence
-    contract); in-wave trips come from the device tumbling counters.
+    contract); in-wave trips come from the device bucketed sliding
+    window (`security_ops.window_totals` + in-wave prefix counts).
 
     `agent_base` supports running the SAME body inside `shard_map` on a
     table shard (`parallel.collectives.sharded_gateway`): `slot` stays

@@ -276,7 +276,12 @@ class StagingQueue:
                 # A foreign bind raced this push: the payload may have
                 # landed in the OTHER queue's freshly-registered
                 # buffers. Unrecoverable from this side — fail loudly
-                # (see the module comment's construction rule).
+                # (see the module comment's construction rule). The
+                # entry is NOT in this queue's buffers, so undo the
+                # provisional count: a caller who keeps using this
+                # queue after catching must not inherit a phantom.
+                with self._count_lock:
+                    self._staged_since_harvest -= 1
                 raise RuntimeError(
                     "staging push raced a foreign StagingQueue bind; "
                     "constructing a HypervisorState while another "

@@ -10,7 +10,12 @@ commit comes back as per-shard partials folded once over DCN by
 bit-identical to one single-device wave over the combined load.
 Contracts: the fast-path layouts (contiguous session block, unique
 sessions) plus slice affinity (each wave session joined from one
-slice). Runs on the virtual 8-CPU mesh reshaped 2×4.
+slice). Runs on the virtual 8-CPU mesh reshaped 2×4 AND 4×2 (round-5:
+the grid aspect must not change the math), with an asymmetric-load leg
+(ragged lanes concentrated on one slice) and the refusal path for a
+wave session joined from two slices (the bridge's host-verified
+unique-seat contract is exactly what makes cross-slice double-joins
+impossible to stage — test_bridge_refuses_cross_slice_double_join).
 """
 
 from __future__ import annotations
@@ -35,6 +40,9 @@ from hypervisor_tpu.tables.struct import replace as t_replace
 
 N_SLICES, PER_SLICE = 2, 4
 D = N_SLICES * PER_SLICE
+# Grid aspects for the parametrized legs: same 8 shards, both carvings.
+GRIDS = [(2, 4), (4, 2)]
+GRID_IDS = ["2x4", "4x2"]
 ROWS_PER_SHARD = 8
 N_CAP = D * ROWS_PER_SHARD
 E_CAP = D * 4
@@ -95,8 +103,9 @@ def _wave_args():
     )
 
 
-def test_multislice_wave_plus_dcn_reconcile_matches_single_device():
-    mesh = make_multislice_mesh(N_SLICES, PER_SLICE)
+@pytest.mark.parametrize("grid", GRIDS, ids=GRID_IDS)
+def test_multislice_wave_plus_dcn_reconcile_matches_single_device(grid):
+    mesh = make_multislice_mesh(*grid)
     args = _wave_args()
     wave_range = (jnp.asarray(0, jnp.int32), jnp.asarray(K, jnp.int32))
 
@@ -150,13 +159,14 @@ def test_multislice_wave_plus_dcn_reconcile_matches_single_device():
     )
 
 
-def test_permuted_assignment_crosses_slices():
+@pytest.mark.parametrize("grid", GRIDS, ids=GRID_IDS)
+def test_permuted_assignment_crosses_slices(grid):
     """Element i joins session B-1-i: still contiguous + unique, but
     every session's FSM lane lives on a different shard (often a
     different SLICE) than its joiner — the view psum must be global or
     has_members silently misses cross-slice joins and the FSM walk is
     skipped."""
-    mesh = make_multislice_mesh(N_SLICES, PER_SLICE)
+    mesh = make_multislice_mesh(*grid)
     slots = np.array([i * ROWS_PER_SHARD for i in range(B)], np.int32)
     rng = np.random.RandomState(21)
     bodies = rng.randint(
@@ -355,6 +365,141 @@ def test_multislice_sharded_gateway_matches_single_device():
             np.asarray(getattr(gw_ms, field)),
             np.asarray(getattr(gw_sd, field)),
             err_msg=field,
+        )
+
+
+@pytest.mark.parametrize("grid", GRIDS, ids=GRID_IDS)
+def test_asymmetric_slice_load_ragged_across_slices(grid):
+    """Ragged ACROSS slices: the real lanes concentrate on slice 0 and
+    the tail shards (all of the last slice) carry only duplicate-masked
+    padding lanes whose sessions are parked. The asymmetric load must
+    not disturb the DCN fold — padding admits nothing, parked sessions
+    keep HANDSHAKING with no members, and the fold still matches the
+    single-device wave bit-for-bit."""
+    n_slices, per_slice = grid
+    mesh = make_multislice_mesh(n_slices, per_slice)
+    slots = np.array([i * ROWS_PER_SHARD for i in range(B)], np.int32)
+    rng = np.random.RandomState(34)
+    bodies = rng.randint(
+        0, 2**32, size=(T, K, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    # The whole LAST slice's lanes are padding (duplicate => refused
+    # before the seat check; their sessions stay parked).
+    pad_lanes = per_slice  # lanes per slice == shards per slice here
+    duplicate = np.zeros(B, bool)
+    duplicate[B - pad_lanes :] = True
+    args = (
+        jnp.asarray(slots),
+        jnp.arange(B, dtype=jnp.int32),
+        jnp.arange(B, dtype=jnp.int32),
+        jnp.full((B,), 0.8, jnp.float32),
+        jnp.ones(B, bool),
+        jnp.asarray(duplicate),
+        jnp.asarray(np.arange(K, dtype=np.int32)),
+        jnp.asarray(bodies),
+        NOW,
+        OMEGA,
+    )
+    wave_range = (jnp.asarray(0, jnp.int32), jnp.asarray(K, jnp.int32))
+
+    agents, sessions, vouches = _tables()
+    ms = sharded_governance_wave(
+        mesh, mode_dispatch=True, contiguous_waves=True,
+        unique_sessions=True, multislice=True,
+    )
+    res, partials = ms(agents, sessions, vouches, *args, *wave_range)
+    folded = multislice_reconcile_wave(mesh)(
+        res.sessions, partials.counts, partials.owned, partials.state,
+        partials.terminated,
+    )
+
+    agents2, sessions2, vouches2 = _tables()
+    single = jax.jit(
+        governance_wave,
+        static_argnames=("use_pallas", "unique_sessions"),
+    )(
+        agents2, sessions2, vouches2, *args,
+        use_pallas=False, wave_range=wave_range, unique_sessions=True,
+    )
+    for field in ("status", "ring", "sigma_eff", "saga_step_state",
+                  "chain", "merkle_root", "fsm_error"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(res, field)),
+            np.asarray(getattr(single, field)),
+            err_msg=f"{field} diverged",
+        )
+    assert int(np.asarray(res.released)) == int(np.asarray(single.released))
+    np.testing.assert_array_equal(
+        np.asarray(res.agents.flags), np.asarray(single.agents.flags)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.vouches.active), np.asarray(single.vouches.active)
+    )
+    # Padding lanes refused as duplicates; real lanes admitted.
+    assert (
+        np.asarray(res.status)[B - pad_lanes :] == admission.ADMIT_DUPLICATE
+    ).all()
+    assert (
+        np.asarray(res.status)[: B - pad_lanes] == admission.ADMIT_OK
+    ).all()
+    for col in ("state", "n_participants", "terminated_at"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(folded, col)),
+            np.asarray(getattr(single.sessions, col)),
+            err_msg=f"sessions.{col} diverged after DCN fold",
+        )
+    # Parked sessions (the padding lanes' targets) never left
+    # HANDSHAKING: no members, so the FSM walk skipped them.
+    assert (
+        np.asarray(folded.state)[B - pad_lanes : K]
+        == SessionState.HANDSHAKING.code
+    ).all()
+    assert (
+        np.asarray(folded.state)[: B - pad_lanes]
+        == SessionState.ARCHIVED.code
+    ).all()
+
+
+def test_bridge_refuses_cross_slice_double_join():
+    """The slice-affinity contract's failure mode: a wave session
+    joined from TWO slices. The bridge's host-verified unique-seat
+    check is what forbids it — two seat-consuming joins to one session
+    make unique_sessions False, and the multislice path REFUSES the
+    wave instead of staging a cross-slice commit that the one-DCN-fold
+    design cannot merge (FSM overwrites from two slices would collide
+    in the masked-sum fold)."""
+    import dataclasses
+
+    from hypervisor_tpu.config import DEFAULT_CONFIG
+    from hypervisor_tpu.models import SessionConfig
+    from hypervisor_tpu.state import HypervisorState
+
+    cfg = dataclasses.replace(
+        DEFAULT_CONFIG,
+        capacity=dataclasses.replace(
+            DEFAULT_CONFIG.capacity, max_agents=N_CAP
+        ),
+    )
+    mesh = make_multislice_mesh(N_SLICES, PER_SLICE)
+    st = HypervisorState(cfg)
+    slots = st.create_sessions_batch(
+        [f"xs:s{i}" for i in range(K)], SessionConfig(min_sigma_eff=0.0)
+    )
+    # K joins, but joins 0 and K-1 BOTH target session 0: with one join
+    # per shard, those two seats live on different slices of the 2-D
+    # grid.
+    sess_of = np.asarray(slots, np.int32)
+    sess_of[K - 1] = sess_of[0]
+    bodies = np.zeros((T, K, merkle_ops.BODY_WORDS), np.uint32)
+    with pytest.raises(ValueError, match="one seat-consuming join"):
+        st.run_governance_wave(
+            slots,
+            [f"did:xs:{i}" for i in range(K)],
+            sess_of,
+            np.full(K, 0.8, np.float32),
+            bodies,
+            now=2.0,
+            mesh=mesh,
         )
 
 

@@ -858,3 +858,109 @@ class TestDeltaLogWrapGuard:
             st.stage_delta(b, 1, ts=2.0, digest_words=digest)
         st.flush_deltas()                      # wraps over A's rows: fine
         assert len(st._audit_rows.get(b, [])) == 6
+
+
+# ── moved from tests/unit (round-5): these touch the device plane
+# (ops constants / batched saga ops execute XLA), which the unit
+# modules must stay free of — they are the blocking Windows CI
+# subset (tests/conftest.py _HOST_PLANE_FILES).
+
+
+class TestBatchedSagaOps:
+    def test_transition_matrix_gather(self):
+        from hypervisor_tpu.ops import saga_ops
+
+        frm = np.array([0, 1, 1, 2, 6], np.int8)  # P, E, E, C, F
+        to = np.array([1, 2, 6, 3, 1], np.int8)   # E, C, F, CP, E
+        valid = np.asarray(saga_ops.step_transition_valid(frm, to))
+        assert valid.tolist() == [True, True, True, True, False]
+
+    def test_execute_attempt_retry_ladder(self):
+        from hypervisor_tpu.ops import saga_ops
+
+        state = np.zeros(3, np.int8)  # all PENDING
+        success = np.array([True, False, False])
+        retries = np.array([0, 1, 0], np.int32)
+        new_state, new_retries = saga_ops.execute_attempt(state, success, retries)
+        assert np.asarray(new_state).tolist() == [
+            saga_ops.STEP_COMMITTED,
+            saga_ops.STEP_PENDING,   # retrying
+            saga_ops.STEP_FAILED,
+        ]
+        assert np.asarray(new_retries).tolist() == [0, 0, 0]
+
+    def test_fanout_policy_check_batch(self):
+        from hypervisor_tpu.ops import saga_ops
+
+        success = np.array([[1, 1, 1], [1, 0, 0], [0, 0, 1]], bool)
+        valid = np.ones((3, 3), bool)
+        policy = np.array([0, 1, 2], np.int8)  # ALL, MAJORITY, ANY
+        out = np.asarray(saga_ops.fanout_policy_check(success, valid, policy))
+        assert out.tolist() == [True, False, True]
+
+    def test_settle_sagas(self):
+        from hypervisor_tpu.ops import saga_ops
+
+        step_state = np.array(
+            [
+                [2, 2, 0],  # committed + pending -> completed
+                [4, 5, 4],  # compensation failed -> escalated
+                [4, 4, 4],  # all compensated -> completed
+            ],
+            np.int8,
+        )
+        saga_state = np.array(
+            [saga_ops.SAGA_RUNNING, saga_ops.SAGA_COMPENSATING, saga_ops.SAGA_COMPENSATING],
+            np.int8,
+        )
+        out = np.asarray(saga_ops.settle_sagas(step_state, saga_state))
+        assert out.tolist() == [
+            saga_ops.SAGA_COMPLETED,
+            saga_ops.SAGA_ESCALATED,
+            saga_ops.SAGA_COMPLETED,
+        ]
+
+
+class TestStatusMapping:
+    """utils.status: batched codes -> the reference's exception types."""
+
+    def test_admission_codes_raise_reference_exceptions(self):
+        import pytest
+
+        from hypervisor_tpu.ops import admission
+        from hypervisor_tpu.session import (
+            SessionLifecycleError,
+            SessionParticipantError,
+        )
+        from hypervisor_tpu.utils import status as S
+
+        S.raise_for_status([0, 0, 0])  # all ok: no raise
+        with pytest.raises(SessionParticipantError, match="did:dup already"):
+            S.raise_for_status(
+                [0, admission.ADMIT_DUPLICATE],
+                who=["did:a", "did:dup"],
+            )
+        with pytest.raises(SessionLifecycleError):
+            S.raise_for_status([admission.ADMIT_BAD_STATE])
+        with pytest.raises(RuntimeError, match="unknown status"):
+            S.raise_for_status([99])
+
+    def test_write_and_lock_tables(self):
+        import pytest
+
+        from hypervisor_tpu.runtime.lock_wave import LOCK_DEADLOCK
+        from hypervisor_tpu.runtime.write_wave import WRITE_QUARANTINED
+        from hypervisor_tpu.session.intent_locks import DeadlockError
+        from hypervisor_tpu.utils import status as S
+
+        with pytest.raises(S.QuarantinedError):
+            S.raise_for_status([WRITE_QUARANTINED], table=S.WRITE_ERRORS)
+        with pytest.raises(DeadlockError):
+            S.raise_for_status([LOCK_DEADLOCK], table=S.LOCK_ERRORS)
+
+    def test_describe_labels(self):
+        from hypervisor_tpu.ops import admission
+        from hypervisor_tpu.utils import status as S
+
+        labels = S.describe([0, admission.ADMIT_CAPACITY, 42])
+        assert labels == ["ok", "SessionParticipantError", "unknown(42)"]

@@ -1,0 +1,65 @@
+"""The host-plane CI subset stays device-free — pinned, not promised.
+
+`tests/conftest.py`'s `_HOST_PLANE_FILES` is the BLOCKING Windows CI
+subset; its contract is that no curated module imports jax anywhere in
+its source (that is what keeps the leg free of the Windows-flaky
+XLA:CPU programs). A comment can drift — this scan cannot: adding a
+jax import to a curated file (exactly what once happened to
+`test_observability_extended.py`, which is why it is excluded) fails
+here on every platform, not just on Windows CI.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from tests.conftest import _HOST_PLANE_FILES
+
+UNIT_DIR = Path(__file__).resolve().parent
+_JAX_IMPORT = re.compile(
+    r"^\s*(import\s+jax\b|from\s+jax\b)", re.MULTILINE
+)
+
+
+def test_curated_files_exist():
+    missing = [f for f in _HOST_PLANE_FILES if not (UNIT_DIR / f).exists()]
+    assert not missing, (
+        f"_HOST_PLANE_FILES names files that do not exist: {missing}"
+    )
+
+
+def test_host_plane_files_never_import_jax():
+    offenders = {}
+    for fname in sorted(_HOST_PLANE_FILES):
+        src = (UNIT_DIR / fname).read_text()
+        hits = _JAX_IMPORT.findall(src)
+        if hits:
+            offenders[fname] = hits
+    assert not offenders, (
+        "host-plane (blocking Windows CI) test modules import jax — "
+        "either remove the import or remove the module from "
+        f"tests/conftest.py _HOST_PLANE_FILES: {offenders}"
+    )
+
+
+def test_host_plane_files_avoid_device_plane_modules():
+    """The device plane's entry modules (state bridge, ops, parallel,
+    tables, kernels, runtime.native) execute XLA or load the native
+    lib; a curated file must not import them."""
+    pattern = re.compile(
+        r"^\s*from\s+hypervisor_tpu\.(state|ops|parallel|tables|kernels|"
+        r"runtime)\b|^\s*import\s+hypervisor_tpu\.(state|ops|parallel|"
+        r"tables|kernels|runtime)\b",
+        re.MULTILINE,
+    )
+    offenders = {}
+    for fname in sorted(_HOST_PLANE_FILES):
+        src = (UNIT_DIR / fname).read_text()
+        hits = pattern.findall(src)
+        if hits:
+            offenders[fname] = hits
+    assert not offenders, (
+        "host-plane test modules import device-plane packages: "
+        f"{offenders}"
+    )

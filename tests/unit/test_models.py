@@ -80,48 +80,3 @@ class TestStateCodes:
     def test_consistency_mode_codes(self):
         assert ConsistencyMode.STRONG.code == 0
         assert ConsistencyMode.from_code(1) == ConsistencyMode.EVENTUAL
-
-
-class TestStatusMapping:
-    """utils.status: batched codes -> the reference's exception types."""
-
-    def test_admission_codes_raise_reference_exceptions(self):
-        import pytest
-
-        from hypervisor_tpu.ops import admission
-        from hypervisor_tpu.session import (
-            SessionLifecycleError,
-            SessionParticipantError,
-        )
-        from hypervisor_tpu.utils import status as S
-
-        S.raise_for_status([0, 0, 0])  # all ok: no raise
-        with pytest.raises(SessionParticipantError, match="did:dup already"):
-            S.raise_for_status(
-                [0, admission.ADMIT_DUPLICATE],
-                who=["did:a", "did:dup"],
-            )
-        with pytest.raises(SessionLifecycleError):
-            S.raise_for_status([admission.ADMIT_BAD_STATE])
-        with pytest.raises(RuntimeError, match="unknown status"):
-            S.raise_for_status([99])
-
-    def test_write_and_lock_tables(self):
-        import pytest
-
-        from hypervisor_tpu.runtime.lock_wave import LOCK_DEADLOCK
-        from hypervisor_tpu.runtime.write_wave import WRITE_QUARANTINED
-        from hypervisor_tpu.session.intent_locks import DeadlockError
-        from hypervisor_tpu.utils import status as S
-
-        with pytest.raises(S.QuarantinedError):
-            S.raise_for_status([WRITE_QUARANTINED], table=S.WRITE_ERRORS)
-        with pytest.raises(DeadlockError):
-            S.raise_for_status([LOCK_DEADLOCK], table=S.LOCK_ERRORS)
-
-    def test_describe_labels(self):
-        from hypervisor_tpu.ops import admission
-        from hypervisor_tpu.utils import status as S
-
-        labels = S.describe([0, admission.ADMIT_CAPACITY, 42])
-        assert labels == ["ok", "SessionParticipantError", "unknown(42)"]

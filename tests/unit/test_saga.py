@@ -243,61 +243,6 @@ class TestDSL:
         assert parsed.fan_out_step_ids == {"validate", "deploy"}
 
 
-class TestBatchedSagaOps:
-    def test_transition_matrix_gather(self):
-        from hypervisor_tpu.ops import saga_ops
-
-        frm = np.array([0, 1, 1, 2, 6], np.int8)  # P, E, E, C, F
-        to = np.array([1, 2, 6, 3, 1], np.int8)   # E, C, F, CP, E
-        valid = np.asarray(saga_ops.step_transition_valid(frm, to))
-        assert valid.tolist() == [True, True, True, True, False]
-
-    def test_execute_attempt_retry_ladder(self):
-        from hypervisor_tpu.ops import saga_ops
-
-        state = np.zeros(3, np.int8)  # all PENDING
-        success = np.array([True, False, False])
-        retries = np.array([0, 1, 0], np.int32)
-        new_state, new_retries = saga_ops.execute_attempt(state, success, retries)
-        assert np.asarray(new_state).tolist() == [
-            saga_ops.STEP_COMMITTED,
-            saga_ops.STEP_PENDING,   # retrying
-            saga_ops.STEP_FAILED,
-        ]
-        assert np.asarray(new_retries).tolist() == [0, 0, 0]
-
-    def test_fanout_policy_check_batch(self):
-        from hypervisor_tpu.ops import saga_ops
-
-        success = np.array([[1, 1, 1], [1, 0, 0], [0, 0, 1]], bool)
-        valid = np.ones((3, 3), bool)
-        policy = np.array([0, 1, 2], np.int8)  # ALL, MAJORITY, ANY
-        out = np.asarray(saga_ops.fanout_policy_check(success, valid, policy))
-        assert out.tolist() == [True, False, True]
-
-    def test_settle_sagas(self):
-        from hypervisor_tpu.ops import saga_ops
-
-        step_state = np.array(
-            [
-                [2, 2, 0],  # committed + pending -> completed
-                [4, 5, 4],  # compensation failed -> escalated
-                [4, 4, 4],  # all compensated -> completed
-            ],
-            np.int8,
-        )
-        saga_state = np.array(
-            [saga_ops.SAGA_RUNNING, saga_ops.SAGA_COMPENSATING, saga_ops.SAGA_COMPENSATING],
-            np.int8,
-        )
-        out = np.asarray(saga_ops.settle_sagas(step_state, saga_state))
-        assert out.tolist() == [
-            saga_ops.SAGA_COMPLETED,
-            saga_ops.SAGA_ESCALATED,
-            saga_ops.SAGA_COMPLETED,
-        ]
-
-
 class TestYAMLDSL:
     YAML = """
 name: deploy

@@ -8,6 +8,9 @@ whichever frontend the environment has:
 
   * streamlit  — `streamlit run examples/dashboard/app.py` (five tabs,
     auto-refresh), when streamlit is installed.
+  * browser    — `python examples/dashboard/app.py --serve 8400` serves a
+    self-contained live HTML dashboard over stdlib http (`web.py`) — no
+    extra dependencies, the web-UI parity surface.
   * terminal   — `python examples/dashboard/app.py` renders the panels with
     rich (falls back to plain text without rich).
   * png report — `python examples/dashboard/app.py --png out.png` writes a
@@ -507,7 +510,25 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--png", metavar="PATH", help="write a matplotlib snapshot")
     ap.add_argument("--sessions", type=int, default=4)
-    args, _ = ap.parse_known_args()
+    ap.add_argument(
+        "--serve", metavar="PORT", type=int,
+        help="serve the browser dashboard on this port (stdlib http)",
+    )
+    args, rest = ap.parse_known_args()
+
+    if args.serve is not None:
+        from web import main as web_main  # type: ignore[import-not-found]
+
+        # Forward unrecognized flags (e.g. web.py's --cpu) instead of
+        # dropping them. Note --cpu through THIS entry is best-effort:
+        # app.py already imported the engines (and therefore jax) at
+        # module scope, so force_cpu_platform runs its degraded
+        # already-imported path; `python examples/dashboard/web.py
+        # --cpu` pins the platform before any jax import.
+        sys.argv = [sys.argv[0], "--port", str(args.serve),
+                    "--sessions", str(args.sessions), *rest]
+        web_main()
+        return
 
     st = asyncio.run(simulate(n_sessions=args.sessions))
     try:

@@ -78,3 +78,64 @@ def test_vouch_graph_ascii_rendering():
     joined = "\n".join(lines)
     assert "a" in joined and "bond" in joined
     assert "[SLASHED]" in joined
+
+
+class TestWebDashboard:
+    """The stdlib-HTTP browser dashboard (examples/dashboard/web.py)."""
+
+    def _web(self):
+        import importlib.util
+
+        web_path = _APP.parent / "web.py"
+        spec = importlib.util.spec_from_file_location("dashboard_web", web_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_state_to_json_roundtrips(self):
+        import asyncio
+        import json
+
+        web = self._web()
+        st = asyncio.run(dashboard_app.simulate(n_sessions=3, seed=11))
+        payload = json.loads(json.dumps(web.state_to_json(st)))
+        assert payload["stats"]["sessions"] == 3
+        assert sum(payload["ring_counts"].values()) == payload["stats"][
+            "participants"
+        ]
+        assert payload["saga_rows"] and payload["vouch_edges"]
+        assert payload["device_stats"]["agent rows"] > 0
+
+    def test_server_serves_page_and_data(self):
+        import json
+        import urllib.request
+
+        web = self._web()
+        srv = web.DashboardServer(port=0, n_sessions=2, refresh_s=60).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            page = urllib.request.urlopen(base + "/").read().decode()
+            assert "hypervisor_tpu" in page
+            for panel in ("Overview", "Ring distribution", "Sagas",
+                          "Liability", "Security", "Events"):
+                assert panel in page, panel
+            data = json.loads(
+                urllib.request.urlopen(base + "/data.json").read()
+            )
+            assert data["stats"]["sessions"] == 2
+            assert data["events"]
+            # refresh_s=60: the second poll reuses the cached world.
+            data2 = json.loads(
+                urllib.request.urlopen(base + "/data.json").read()
+            )
+            assert data2 == data
+            # Unknown path -> 404, server stays up.
+            import urllib.error
+
+            try:
+                urllib.request.urlopen(base + "/nope")
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            srv.stop()

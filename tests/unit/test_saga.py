@@ -4,9 +4,7 @@ Mirrors reference `test_saga.py` + `test_saga_improvements.py`: transition
 table violations, fan-out policies, checkpoint replay plans, DSL errors.
 """
 
-import asyncio
 
-import numpy as np
 import pytest
 
 from hypervisor_tpu.saga import (

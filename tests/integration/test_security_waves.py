@@ -101,6 +101,33 @@ class TestBreachSweep:
         severity, tripped = st.breach_sweep_tick(now=1.0)
         assert severity[0] == 0 and not tripped[0]
 
+    def test_sweep_honors_custom_breach_config(self):
+        """The sweep analyzes with the STATE's BreachConfig, not the
+        module default (round-5 fix: _BREACH_SWEEP/_RECORD_CALLS were
+        silently defaulting, so custom thresholds never reached the
+        device plane)."""
+        import dataclasses
+
+        from hypervisor_tpu.config import BreachConfig
+
+        cfg = DEFAULT_CONFIG.replace(
+            breach=dataclasses.replace(
+                DEFAULT_CONFIG.breach,
+                min_calls_for_analysis=3,   # default: 5
+                high_threshold=0.5,         # default: 0.7
+            )
+        )
+        st = HypervisorState(cfg)
+        slot = st.create_session("s:cfg", SessionConfig(max_participants=8))
+        st.enqueue_join(slot, "did:cfg", 0.8)  # ring 2
+        assert (st.flush_joins() == 0).all()
+        # 4 calls, 2 privileged: rate 0.5. Default config: below
+        # min_calls (5) -> no analysis. Custom config: analyzable (>=3)
+        # and at the lowered high threshold -> trips.
+        st.record_calls([0] * 4, [0, 0, 2, 2], now=1.0)
+        severity, tripped = st.breach_sweep_tick(now=1.0)
+        assert int(severity[0]) >= 3 and bool(tripped[0])
+
     def test_breaker_cooldown_expires(self):
         st = self._admitted_state()
         st.record_calls([0] * 6, [0] * 6)

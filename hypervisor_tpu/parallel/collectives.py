@@ -42,6 +42,24 @@ from hypervisor_tpu.tables.state import (
 from hypervisor_tpu.tables.struct import replace as t_replace
 
 
+def _linear_shard_index(multislice: bool):
+    """This shard's index into the GLOBAL slice-major row layout.
+
+    Inside shard_map only. Global agent/vouch row blocks are laid out
+    slice-major over a (dcn, agents) grid; on a 1-D mesh the agent axis
+    index IS the layout index. Every body that localizes global slots
+    (`_wave_admission`, the fused wave's gateway phase,
+    `sharded_gateway`) MUST use this one helper — a mesh-layout change
+    updated in some copies but not others would silently misroute row
+    writes."""
+    if multislice:
+        return (
+            jax.lax.axis_index(DCN_AXIS) * jax.lax.axis_size(AGENT_AXIS)
+            + jax.lax.axis_index(AGENT_AXIS)
+        )
+    return jax.lax.axis_index(AGENT_AXIS)
+
+
 def _mesh_uses_pallas(mesh: Mesh) -> bool:
     """Pallas hash kernels only when every mesh device is a TPU.
 
@@ -227,15 +245,7 @@ def _wave_admission(
     extra (view_counts [S_cap], ev_counts_local [S_cap]) pair."""
     b_local = slot.shape[0]
     rows_per_shard = agents.did.shape[0]
-    if row_axes == AGENT_AXIS:
-        my_shard = jax.lax.axis_index(AGENT_AXIS)
-    else:
-        # Linear shard index over the (dcn, agents) grid: global row
-        # blocks are laid out slice-major.
-        my_shard = (
-            jax.lax.axis_index(DCN_AXIS) * jax.lax.axis_size(AGENT_AXIS)
-            + jax.lax.axis_index(AGENT_AXIS)
-        )
+    my_shard = _linear_shard_index(multislice=row_axes != AGENT_AXIS)
     local_slot = slot - my_shard * rows_per_shard
 
     # ── vouched contributions: segmented psum over edge shards ────
@@ -834,17 +844,18 @@ def sharded_governance_wave(
         # fast-path layouts are required (contiguous session block,
         # unique sessions — so no rank all_gathers and no mask psum
         # cross slices), mode dispatch is forced (all commits are
-        # partials), the gateway phase is not fused, and each wave
-        # session must be joined from ONE slice in a given tick (the
-        # slice-affinity contract; counts merge across ticks, FSM
-        # overwrites do not).
+        # partials), and each wave session must be joined from ONE
+        # slice in a given tick (the slice-affinity contract; counts
+        # merge across ticks, FSM overwrites do not). The gateway phase
+        # DOES fuse (round 5): it is shard-local by the placement
+        # contract — agent-row writes only, elevations replicated, zero
+        # collectives — so slicing changes nothing but the linear base
+        # row of each shard.
         if not (mode_dispatch and contiguous_waves and unique_sessions):
             raise ValueError(
                 "multislice wave requires mode_dispatch=True, "
                 "contiguous_waves=True, unique_sessions=True"
             )
-        if with_gateway:
-            raise ValueError("multislice wave does not fuse the gateway")
     row_axes = (DCN_AXIS, AGENT_AXIS) if multislice else AGENT_AXIS
     n_shards = mesh.devices.size
     if use_pallas is None:
@@ -1063,7 +1074,7 @@ def sharded_governance_wave(
             (elevations, act_slot, act_required, act_ro, act_cons,
              act_wit, act_host, act_valid) = gw_args
             rows_per_shard = agents.did.shape[0]
-            base = jax.lax.axis_index(AGENT_AXIS) * rows_per_shard
+            base = _linear_shard_index(multislice) * rows_per_shard
             gw = gateway_ops.check_actions(
                 agents,
                 elevations,
@@ -1297,15 +1308,7 @@ def sharded_gateway(
         has_consensus, has_sre_witness, host_tripped, valid, now,
     ):
         rows_per_shard = agents.did.shape[0]
-        if multislice:
-            lin = (
-                jax.lax.axis_index(DCN_AXIS)
-                * jax.lax.axis_size(AGENT_AXIS)
-                + jax.lax.axis_index(AGENT_AXIS)
-            )
-        else:
-            lin = jax.lax.axis_index(AGENT_AXIS)
-        base = lin * rows_per_shard
+        base = _linear_shard_index(multislice) * rows_per_shard
         result = gateway_ops.check_actions(
             agents,
             elevations,

@@ -392,10 +392,11 @@ class HypervisorState:
         dict with `slots` (STANDING membership rows — not this wave's
         cohort) plus optional `required_rings` / `is_read_only` /
         `has_consensus` / `has_sre_witness` / `host_tripped` columns.
-        On a 1-D mesh the gateway fuses INTO the wave program
-        (`with_gateway`); single-device AND on a multislice mesh it
-        composes behind it — both orders identical (the gateway runs
-        on the post-terminate table). Returns
+        On any mesh — 1-D or multislice — the gateway fuses INTO the
+        wave program (`with_gateway`; shard-local by the placement
+        contract, so the 2-D grid only changes each shard's base row);
+        single-device it composes behind it — both orders identical
+        (the gateway runs on the post-terminate table). Returns
         (WaveResult, GatewayResult) instead.
 
         A 2-D (dcn, agents) mesh from `make_multislice_mesh` builds
@@ -524,13 +525,13 @@ class HypervisorState:
             with_gateway = actions is not None
             multislice = _is_multislice(mesh)
             if multislice:
-                # The multislice wave's v1 contracts (see
+                # The multislice wave's contracts (see
                 # `collectives.sharded_governance_wave`): fast-path
                 # layouts are REQUIRED (they hold for every fresh wave
-                # this bridge stages); the gateway phase is not fused
-                # across slices — it composes behind the committed wave
-                # instead (the tail below), same order as the fused
-                # variant (gateway sees the post-terminate table).
+                # this bridge stages). The gateway phase fuses across
+                # slices like any other mesh (round 5): it is
+                # shard-local by the placement contract, so the 2-D
+                # grid only changes each shard's linear base row.
                 if not (wave_contiguous and unique_sessions):
                     raise ValueError(
                         "multislice wave requires a contiguous session "
@@ -538,7 +539,6 @@ class HypervisorState:
                         f"(got contiguous={wave_contiguous}, "
                         f"unique={unique_sessions})"
                     )
-                with_gateway = False
             wave_fn = self._sharded_waves.get(
                 (mesh, with_gateway, wave_contiguous, unique_sessions)
             )
@@ -670,13 +670,11 @@ class HypervisorState:
                 self._chain_seed[s] = chain[t - 1, i]
         if actions is not None:
             if gw_result is None:
-                # Single device AND multislice meshes: compose the
-                # gateway wave behind the committed governance wave
-                # (same order as the fused 1-D mesh program — the
-                # gateway sees the post-terminate table). On a
-                # multislice mesh the composed gateway is itself the
-                # SHARDED program over the flattened (dcn, agents)
-                # grid (zero collectives by the placement contract).
+                # Single device: compose the gateway wave behind the
+                # committed governance wave (same order as the fused
+                # mesh programs — the gateway sees the post-terminate
+                # table). Every mesh path, 1-D and multislice alike,
+                # fuses the gateway INTO the wave above (round 5).
                 act = self._normalize_actions(actions)
                 gw_result = self.check_actions_wave(
                     act["slots"], act["required_rings"],

@@ -217,6 +217,92 @@ class TestSlidingExpiry:
         assert bool(tripped[0]) and int(severity[0]) == 4
 
 
+class TestWindowProperty:
+    """Random call schedules: both planes match their own oracle
+    exactly, and their divergence is the documented bound.
+
+    The precision contract (`ops/security_ops.py` module docstring):
+    the device window at `now` covers bucket epochs in
+    (cur - K, cur], i.e. wall-clock (now - W + sub - now%sub, now] —
+    the host window [now - W, now] shortened at the OLD edge by up to
+    one sub-window. So for every schedule:
+
+      * device totals == the epoch-rule oracle, exactly, always
+        (including expiry and bucket-index wraps),
+      * host window count == the age-rule oracle, exactly, always,
+      * host - device == the calls inside the oldest partial band —
+        never negative (device ⊆ host), never more than one
+        sub-window's worth, and ZERO whenever the band is empty.
+    """
+
+    def test_random_schedules_match_oracles_and_bound(self):
+        hypothesis = pytest.importorskip("hypothesis")
+        given, settings = hypothesis.given, hypothesis.settings
+        hst = hypothesis.strategies
+
+        events = hst.lists(
+            hst.tuples(
+                hst.integers(min_value=0, max_value=3 * BD_BUCKETS),  # gap
+                hst.booleans(),  # privileged?
+            ),
+            min_size=1,
+            max_size=25,
+        )
+        k = BD_BUCKETS
+        w = CFG.window_seconds
+
+        @settings(max_examples=40, deadline=None)
+        @given(events=events)
+        def run(events):
+            st = _admitted_state(n=1)
+            clock = FakeClock()
+            host = RingBreachDetector(clock=clock)
+            calls: list[tuple[float, int, bool]] = []  # (ts, epoch, priv)
+            t_units = 0
+            for gap, privileged in events:
+                t_units += gap
+                ts = (t_units + 0.5) * SUB
+                clock.t = ts
+                st.record_calls([0], [0 if privileged else 2], now=ts)
+                host.record_call(
+                    "did:bw0", "s:bw", ExecutionRing.RING_2_STANDARD,
+                    ExecutionRing.RING_0_ROOT if privileged
+                    else ExecutionRing.RING_2_STANDARD,
+                )
+                calls.append((ts, t_units, privileged))
+
+                a = (t_units + 1) * SUB  # analysis on the sub grid
+                cur = t_units + 1
+                clock.t = a
+                dev_calls, dev_priv = _totals(st, a)
+                dev_oracle = [
+                    (ts_j, p_j) for ts_j, e_j, p_j in calls if e_j > cur - k
+                ]
+                host_oracle = [
+                    (ts_j, p_j) for ts_j, e_j, p_j in calls if a - ts_j <= w
+                ]
+                band = [
+                    ts_j
+                    for ts_j, e_j, p_j in calls
+                    if a - ts_j <= w and not e_j > cur - k
+                ]
+                # Device == its oracle, exactly.
+                assert int(dev_calls[0]) == len(dev_oracle), (events, a)
+                assert int(dev_priv[0]) == sum(p for _, p in dev_oracle)
+                # Host == its oracle, exactly.
+                hs = host.get_agent_stats("did:bw0", "s:bw")
+                assert hs["window_calls"] == len(host_oracle), (events, a)
+                # The divergence IS the oldest-partial-band content:
+                # never negative, gone whenever the band is empty, and
+                # every band call's age is within one sub-window of W.
+                diff = len(host_oracle) - len(dev_oracle)
+                assert diff == len(band) >= 0, (events, a)
+                for ts_j in band:
+                    assert w - SUB < a - ts_j <= w, (events, a, ts_j)
+
+        run()
+
+
 class TestCheckpointMigration:
     def test_legacy_width5_i32_block_restores(self, tmp_path):
         """A checkpoint whose agents.i32 still carries the r4 tumbling

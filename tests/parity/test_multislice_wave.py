@@ -261,10 +261,11 @@ def test_bridge_runs_multislice_wave():
 
     st_ms, res_ms = run(True)
     st_sd, res_sd = run(False)
-    # Actions compose behind the multislice wave (not fused). Probe a
-    # genuinely STANDING member (admitted via the staging path, so it
-    # survives the wave) with identical state on both paths — the
-    # composed gateway's verdicts must MATCH, not merely exist.
+    # Actions FUSE into the multislice wave (round 5; the single-device
+    # path composes behind its wave). Probe a genuinely STANDING member
+    # (admitted via the staging path, so it survives the wave) with
+    # identical state on both paths — the fused gateway's verdicts must
+    # MATCH the composed single-device ones, not merely exist.
     gw_verdicts = []
     for st, mesh_arg in ((st_ms, mesh), (st_sd, None)):
         standing_sess = st.create_session(
@@ -458,6 +459,92 @@ def test_asymmetric_slice_load_ragged_across_slices(grid):
         np.asarray(folded.state)[: B - pad_lanes]
         == SessionState.ARCHIVED.code
     ).all()
+
+
+@pytest.mark.parametrize("grid", GRIDS, ids=GRID_IDS)
+def test_fused_multislice_gateway_matches_single_device(grid):
+    """with_gateway=True on a 2-D mesh (round 5): the gateway phase
+    fuses into the multislice wave — shard-local by the placement
+    contract, the grid only changes each shard's linear base row. One
+    standing member per shard acts after the wave; verdicts and the
+    post-gateway agent table must match the single-device fused
+    composition bit-for-bit."""
+    from hypervisor_tpu.ops import gateway as gateway_ops
+    from hypervisor_tpu.tables.state import ElevationTable
+
+    n_slices, per_slice = grid
+    mesh = make_multislice_mesh(n_slices, per_slice)
+    args = _wave_args()
+    wave_range = (jnp.asarray(0, jnp.int32), jnp.asarray(K, jnp.int32))
+    elevs = ElevationTable.create(8)
+
+    def standing(agents):
+        # Pre-existing members OUTSIDE the wave cohort: the last row of
+        # each shard's block, admitted before the wave.
+        slots = jnp.asarray(
+            [(i + 1) * ROWS_PER_SHARD - 1 for i in range(D)], jnp.int32
+        )
+        return t_replace(
+            agents,
+            did=agents.did.at[slots].set(1000 + jnp.arange(D)),
+            sigma_eff=agents.sigma_eff.at[slots].set(0.8),
+            ring=agents.ring.at[slots].set(2),
+            rl_tokens=agents.rl_tokens.at[slots].set(5.0),
+        ), slots
+
+    act_cols = lambda slots: (  # noqa: E731
+        slots,
+        jnp.full((D,), 2, jnp.int8),
+        jnp.zeros((D,), bool),
+        jnp.zeros((D,), bool),
+        jnp.zeros((D,), bool),
+        jnp.zeros((D,), bool),
+    )
+    act_valid = jnp.ones((D,), bool)
+
+    agents, sessions, vouches = _tables()
+    agents, act_slots = standing(agents)
+    ms = sharded_governance_wave(
+        mesh, mode_dispatch=True, contiguous_waves=True,
+        unique_sessions=True, multislice=True, with_gateway=True,
+    )
+    res, lanes, partials = ms(
+        agents, sessions, vouches, *args, *wave_range,
+        elevs, *act_cols(act_slots), act_valid,
+    )
+
+    agents2, sessions2, vouches2 = _tables()
+    agents2, act_slots2 = standing(agents2)
+    single = jax.jit(
+        governance_wave, static_argnames=("use_pallas", "unique_sessions")
+    )(
+        agents2, sessions2, vouches2, *args,
+        use_pallas=False, wave_range=wave_range, unique_sessions=True,
+    )
+    gw = gateway_ops.check_actions(
+        single.agents, elevs, *act_cols(act_slots2), NOW, valid=act_valid,
+    )
+
+    np.testing.assert_array_equal(
+        np.asarray(lanes.verdict), np.asarray(gw.verdict)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(lanes.eff_ring), np.asarray(gw.eff_ring)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(lanes.window_calls), np.asarray(gw.window_calls)
+    )
+    # Standing members' actions were all granted (the point of the
+    # placement: each lane's row lives on its own shard).
+    assert (np.asarray(lanes.verdict) == gateway_ops.GATE_ALLOWED).all()
+    # Post-gateway agent table (incl. breach windows and token burns)
+    # matches the composed single-device path.
+    for name in ("f32", "i32", "ring", "bd_window"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(res.agents, name)),
+            np.asarray(getattr(gw.agents, name)),
+            err_msg=name,
+        )
 
 
 def test_bridge_refuses_cross_slice_double_join():

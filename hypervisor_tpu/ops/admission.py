@@ -28,7 +28,7 @@ from hypervisor_tpu.tables.state import (
     AgentTable,
     FLAG_ACTIVE,
     SF32_MIN_SIGMA,
-    SI8_STATE,
+    SI32_STATE,
     SI32_MAX_PARTICIPANTS,
     SI32_NPART,
     SessionTable,
@@ -56,7 +56,12 @@ def admit_row_blocks(
     ring: jnp.ndarray | None = None,  # i8[B] assigned rings
     ring_bursts: jnp.ndarray | None = None,  # f32[4] per-ring bucket bursts
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """([B, 8] f32, [B, 3] i32) freshly-admitted row blocks.
+    """([B, 8] f32, [B, 21] i32) freshly-admitted row blocks.
+
+    The i32 rows carry the breach-window columns as zeros (the window
+    rides the i32 block — tables/state.py AI32_BD_WIN_*), so one row
+    scatter both installs the identity columns and resets the previous
+    tenant's sliding window.
 
     The ONE place the packed column order is spelled out for admission
     writes (by the AF32_*/AI32_* index constants) — `admit_batch` and the
@@ -91,7 +96,7 @@ def admit_row_blocks(
         )
         .at[:, tables_state.AF32_RL_STAMP].set(now_f)
     )
-    i32_rows = jnp.zeros((b, 3), jnp.int32)
+    i32_rows = jnp.zeros((b, tables_state.AI32_WIDTH), jnp.int32)
     i32_rows = (
         i32_rows.at[:, tables_state.AI32_DID].set(did)
         .at[:, tables_state.AI32_SESSION].set(session_slot)
@@ -158,11 +163,12 @@ def admit_batch(
     must gate on the host check, like `wave_range`.
     """
     # One row gather per packed block instead of one per column
-    # (tables/state.py SessionTable packing): [B, 3] i32 rows carry
-    # count+capacity, the i8 rows carry state, min-sigma rides the f32
-    # rows. Three gathers where the unpacked layout took four.
-    sess_i32 = sessions.i32[session_slot]      # [B, 3]
-    sess_state = sessions.i8[session_slot][:, SI8_STATE]
+    # (tables/state.py SessionTable packing): the [B, 5] i32 rows carry
+    # state+count+capacity (state merged into the i32 block in round 5
+    # — one fewer gather), min-sigma rides the f32 rows. Two gathers
+    # where the unpacked layout took four.
+    sess_i32 = sessions.i32[session_slot]      # [B, 5]
+    sess_state = sess_i32[:, SI32_STATE]
     sess_count = sess_i32[:, SI32_NPART]
     sess_max = sess_i32[:, SI32_MAX_PARTICIPANTS]
     sess_min_sigma = sessions.f32[session_slot][:, SF32_MIN_SIGMA]
@@ -212,10 +218,9 @@ def admit_batch(
     # index, so the unique-indices fast path's contract holds for the
     # whole wave.
     #
-    # Packed layout: the old 7 per-column scatters are now 4 (one [B, 8]
-    # f32 row block, one [B, 3] i32 row block, the i8 ring column, and
-    # the breach-window rows — a recycled slot must not inherit the
-    # previous tenant's sliding window).
+    # Packed layout: the old 7 per-column scatters are now 3 (one [B, 8]
+    # f32 row block, one [B, 21] i32 row block whose zeros ALSO reset
+    # the previous tenant's breach sliding window, the i8 ring column).
     b = slot.shape[0]
     write_slot = jnp.where(
         ok, slot, agents.did.shape[0] + jnp.arange(b, dtype=slot.dtype)
@@ -230,9 +235,6 @@ def admit_batch(
         f32=agents.f32.at[write_slot].set(f32_rows, **drop),
         i32=agents.i32.at[write_slot].set(i32_rows, **drop),
         ring=agents.ring.at[write_slot].set(ring, **drop),
-        bd_window=agents.bd_window.at[write_slot].set(
-            jnp.zeros((b, agents.bd_window.shape[1]), jnp.int32), **drop
-        ),
     )
     new_sessions = replace(
         sessions,

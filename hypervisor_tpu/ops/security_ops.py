@@ -89,24 +89,30 @@ def window_commit(
 
     Buckets are addressed epoch-mod-K, so the current bucket either
     already carries this epoch's stamp (accumulate) or a stamp at least
-    K epochs old (expired: reset, then accumulate). Rows without new
-    calls still get the roll applied to the current bucket — zeroing an
-    expired bucket is semantics-free (it was already outside every
-    window) and keeps the update one dynamic-column write per block.
+    K epochs old (expired: reset, then accumulate). Rows WITHOUT new
+    calls are left bit-identical — their stale buckets are already
+    outside every window, and keeping them untouched makes a
+    zero-activity wave a true no-op on the table (pinned by the
+    empty-wave tests).
     """
     k = BD_BUCKETS
     cur = window_epoch(now, config)
     j0 = jnp.mod(cur, k)
+    touched = calls_add > 0
     fresh = bd_window[:, 2 * k + j0] == cur
     new_calls = jnp.where(fresh, bd_window[:, j0], 0) + calls_add
     new_priv = jnp.where(fresh, bd_window[:, k + j0], 0) + priv_add
     return (
         bd_window.at[:, j0]
-        .set(new_calls.astype(jnp.int32))
+        .set(jnp.where(touched, new_calls, bd_window[:, j0]).astype(jnp.int32))
         .at[:, k + j0]
-        .set(new_priv.astype(jnp.int32))
+        .set(
+            jnp.where(touched, new_priv, bd_window[:, k + j0]).astype(
+                jnp.int32
+            )
+        )
         .at[:, 2 * k + j0]
-        .set(cur)
+        .set(jnp.where(touched, cur, bd_window[:, 2 * k + j0]))
     )
 
 

@@ -35,7 +35,7 @@ from hypervisor_tpu.ops.pipeline import PipelineResult, governance_pipeline
 from hypervisor_tpu.parallel.mesh import AGENT_AXIS, DCN_AXIS
 from hypervisor_tpu.tables.state import (
     SF32_MIN_SIGMA,
-    SI8_STATE,
+    SI32_STATE,
     SI32_MAX_PARTICIPANTS,
     SI32_NPART,
 )
@@ -269,8 +269,8 @@ def _wave_admission(
     # Same packed block gathers as admit_batch (one per dtype block,
     # not one per column) so the two admission bodies cannot drift in
     # memory-access pattern either.
-    sess_i32 = sessions.i32[session_slot]      # [B, 3]
-    sess_state = sessions.i8[session_slot][:, SI8_STATE]
+    sess_i32 = sessions.i32[session_slot]      # [B, 5]
+    sess_state = sess_i32[:, SI32_STATE]
     sess_count = sess_i32[:, SI32_NPART]
     sess_max = sess_i32[:, SI32_MAX_PARTICIPANTS]
     sess_min = sessions.f32[session_slot][:, SF32_MIN_SIGMA]
@@ -316,10 +316,9 @@ def _wave_admission(
     # contract), keeping the old value where rejected — a shared
     # park row would give rejected lanes a duplicate index that can
     # clobber an admitted agent landing on that row. Packed blocks:
-    # one [B, 8] f32 row scatter + one [B, 3] i32 + the ring column +
-    # the breach-window rows (a recycled slot must not inherit the
-    # previous tenant's sliding window)
-    # (`admission.admit_row_blocks` is the single source of the
+    # one [B, 8] f32 row scatter + one [B, 21] i32 (whose zeros ALSO
+    # reset the previous tenant's breach sliding window) + the ring
+    # column (`admission.admit_row_blocks` is the single source of the
     # layout + accumulator-reset semantics, shared with admit_batch).
     write = local_slot
     f32_rows, i32_rows = admission_ops.admit_row_blocks(
@@ -336,9 +335,6 @@ def _wave_admission(
         ),
         ring=agents.ring.at[write].set(
             jnp.where(ok, ring, agents.ring[write])
-        ),
-        bd_window=agents.bd_window.at[write].set(
-            jnp.where(ok[:, None], 0, agents.bd_window[write])
         ),
     )
 

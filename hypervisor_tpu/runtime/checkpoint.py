@@ -33,8 +33,15 @@ from hypervisor_tpu.state import HypervisorState
 from hypervisor_tpu.tables.intern import InternTable
 from hypervisor_tpu.tables.logs import DeltaLog, EventLog
 from hypervisor_tpu.tables.state import (
+    AI32_BD_WIN_START,
+    AI32_WIDTH,
     AgentTable,
     ElevationTable,
+    LEGACY_SI8_MODE,
+    LEGACY_SI8_STATE,
+    SI32_MODE,
+    SI32_STATE,
+    SI32_WIDTH,
     SagaTable,
     SessionTable,
     VouchTable,
@@ -218,14 +225,25 @@ def _repack_legacy_packed_columns(data, tname: str, ttype) -> dict:
         cols[idx] = name
 
     for block, names in by_block.items():
-        dtype = np.asarray(getattr(fresh, block)).dtype
+        fresh_block = np.asarray(getattr(fresh, block))
+        dtype = fresh_block.dtype
         stacked = []
         for name in names:
             arr = out.pop(f"{tname}.{name}", None)
             if arr is None:
                 arr = np.full((n,), np.asarray(getattr(fresh, name))[0])
             stacked.append(np.asarray(arr, dtype))
-        out[f"{tname}.{block}"] = np.stack(stacked, axis=1)
+        built = np.stack(stacked, axis=1)
+        # Blocks may be wider than their NAMED columns (the agent i32
+        # block carries the breach window as an unnamed slice): pad to
+        # the live width with the freshly-created defaults.
+        width = fresh_block.shape[1]
+        if built.shape[1] < width:
+            tail = np.broadcast_to(
+                fresh_block[0, built.shape[1]:], (n, width - built.shape[1])
+            ).astype(dtype)
+            built = np.concatenate([built, tail], axis=1)
+        out[f"{tname}.{block}"] = built
     return out
 
 
@@ -264,18 +282,46 @@ def _rebuild(data, meta: dict, config: HypervisorConfig) -> HypervisorState:
     state = HypervisorState(config)
     for tname, ttype in _TABLE_TYPES.items():
         data = _repack_legacy_packed_columns(data, tname, ttype)
-    # Saves written before the breach sliding window carried the breach
-    # tumbling counters as agents.i32 columns 3-4 (did/session/flags/
-    # bd_calls/bd_privileged, width 5). The breach window is 60 s of
-    # transient state — any realistic save->restore gap outlives it — so
-    # the legacy counters are dropped and `bd_window` (absent from such
-    # saves) starts fresh via the missing-column default below.
+    # Agent i32 block width ladder (newest last):
+    #   width 5  — round-4 tumbling counters (did/session/flags/
+    #              bd_calls/bd_privileged). The breach window is 60 s of
+    #              transient state — any realistic save->restore gap
+    #              outlives it — so the legacy counters are dropped and
+    #              the window starts fresh (zeros).
+    #   width 3  — early round-5: identity columns only, the sliding
+    #              window in its own `agents.bd_window` array. Fold it
+    #              back in.
+    #   width 21 — current: identity + the window as block columns.
     # (`data` is always a plain dict here: the repack loop above
     # converts NpzFile inputs for every table.)
+    legacy_window = data.pop("agents.bd_window", None)
     if "agents.i32" in data:
         legacy_i32 = np.asarray(data["agents.i32"])
-        if legacy_i32.ndim == 2 and legacy_i32.shape[1] == 5:
-            data["agents.i32"] = legacy_i32[:, :3]
+        if legacy_i32.ndim == 2 and legacy_i32.shape[1] != AI32_WIDTH:
+            n_rows = legacy_i32.shape[0]
+            window = (
+                np.asarray(legacy_window, np.int32)
+                if legacy_window is not None
+                else np.zeros(
+                    (n_rows, AI32_WIDTH - AI32_BD_WIN_START), np.int32
+                )
+            )
+            data["agents.i32"] = np.concatenate(
+                [legacy_i32[:, :AI32_BD_WIN_START].astype(np.int32), window],
+                axis=1,
+            )
+    # Saves written before the SessionTable state/mode merge (round 5)
+    # carried the codes in their own i8[S, 2] block beside a width-3
+    # i32 block; widen the i32 block and fold the codes in losslessly.
+    if "sessions.i8" in data:
+        legacy_i8 = np.asarray(data.pop("sessions.i8"))
+        sess_i32 = np.asarray(data["sessions.i32"])
+        if sess_i32.ndim == 2 and sess_i32.shape[1] < SI32_WIDTH:
+            widened = np.zeros((sess_i32.shape[0], SI32_WIDTH), np.int32)
+            widened[:, : sess_i32.shape[1]] = sess_i32
+            widened[:, SI32_STATE] = legacy_i8[:, LEGACY_SI8_STATE]
+            widened[:, SI32_MODE] = legacy_i8[:, LEGACY_SI8_MODE]
+            data["sessions.i32"] = widened
     for tname, ttype in _TABLE_TYPES.items():
         fields = dataclasses.fields(ttype)
         cols = {

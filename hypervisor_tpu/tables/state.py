@@ -43,6 +43,13 @@ AI32_FLAGS = 2
 # tracks the host detector's sliding deque to sub-window precision
 # instead of diverging across sweeps (`ops.security_ops` for the math).
 BD_BUCKETS = 6
+# The window rides the i32 block as columns [AI32_BD_WIN_START,
+# AI32_BD_WIN_STOP): an admission row write then resets it for free (one
+# i32 scatter covers identity columns AND the window — no separate
+# [B, 3K] scatter, no separate copy-on-write output buffer).
+AI32_BD_WIN_START = 3
+AI32_BD_WIN_STOP = AI32_BD_WIN_START + 3 * BD_BUCKETS
+AI32_WIDTH = AI32_BD_WIN_STOP
 
 
 @table(
@@ -58,7 +65,10 @@ BD_BUCKETS = 6
         "did": ("i32", AI32_DID),
         "session": ("i32", AI32_SESSION),
         "flags": ("i32", AI32_FLAGS),
-    }
+    },
+    slices={
+        "bd_window": ("i32", AI32_BD_WIN_START, AI32_BD_WIN_STOP),
+    },
 )
 class AgentTable:
     """[N_agents] columns, packed by dtype. Row index == agent slot.
@@ -67,37 +77,35 @@ class AgentTable:
     "same-dtype column packing": the admission wave's per-column
     scatters collapse to one per block):
 
-      f32[N, 8]: sigma_raw, sigma_eff, joined_at, risk_score, rl_tokens,
-                 rl_stamp, bd_breaker_until, quarantine_until
-      i32[N, 3]: did (-1 = free slot), session (-1 = none), flags
-                 (FLAG_* bitmask)
+      f32[N, 8]:  sigma_raw, sigma_eff, joined_at, risk_score,
+                  rl_tokens, rl_stamp, bd_breaker_until,
+                  quarantine_until
+      i32[N, 21]: did (-1 = free slot), session (-1 = none), flags
+                  (FLAG_* bitmask), then the breach sliding window
+                  `bd_window` (virtual slice, [:, 3:21]): per-sub-window
+                  call counts, privileged counts, and absolute
+                  sub-window epoch stamps — K = BD_BUCKETS of each. A
+                  bucket is in-window iff its epoch is within the last
+                  K epochs of `now` — sliding-window semantics with no
+                  sweep-driven reset (`ops.security_ops.window_totals`).
 
-    plus the breach-window block `bd_window` i32[N, 3*BD_BUCKETS]:
-    per-sub-window call counts [:, :K], privileged-call counts
-    [:, K:2K], and absolute sub-window epoch stamps [:, 2K:3K]
-    (K = BD_BUCKETS). A bucket is in-window iff its epoch is within the
-    last K epochs of `now` — sliding-window semantics with no
-    sweep-driven reset (`ops.security_ops.window_totals`).
-
-    Every legacy column name stays readable (`agents.sigma_eff`) and
-    writable through `tables.struct.replace`; hot waves write whole
-    [B, W] rows instead.
+    Every legacy column name stays readable (`agents.sigma_eff`,
+    `agents.bd_window`) and writable through `tables.struct.replace`;
+    hot waves write whole [B, W] rows instead.
     """
 
-    f32: jnp.ndarray        # f32[N, 8] packed float columns (AF32_* indices)
-    i32: jnp.ndarray        # i32[N, 3] packed int columns (AI32_* indices)
-    ring: jnp.ndarray       # i8[N] 0..3
-    bd_window: jnp.ndarray  # i32[N, 3*BD_BUCKETS] breach sliding window
+    f32: jnp.ndarray   # f32[N, 8] packed float columns (AF32_* indices)
+    i32: jnp.ndarray   # i32[N, 21] packed int columns + breach window
+    ring: jnp.ndarray  # i8[N] 0..3
 
     @staticmethod
     def create(capacity: int) -> "AgentTable":
-        i32 = jnp.zeros((capacity, 3), jnp.int32)
+        i32 = jnp.zeros((capacity, AI32_WIDTH), jnp.int32)
         i32 = i32.at[:, AI32_DID].set(-1).at[:, AI32_SESSION].set(-1)
         return AgentTable(
             f32=jnp.zeros((capacity, 8), jnp.float32),
             i32=i32,
             ring=jnp.full((capacity,), 3, jnp.int8),
-            bd_window=jnp.zeros((capacity, 3 * BD_BUCKETS), jnp.int32),
         )
 
 
@@ -109,8 +117,13 @@ SF32_MIN_SIGMA = 0
 SF32_CREATED_AT = 1
 SF32_TERMINATED_AT = 2
 SF32_MAX_DURATION = 3
-SI8_STATE = 0
-SI8_MODE = 1
+SI32_STATE = 3
+SI32_MODE = 4
+SI32_WIDTH = 5
+# Legacy i8-block layout (pre round-5 merge) — referenced only by the
+# checkpoint migration (`runtime/checkpoint.py`).
+LEGACY_SI8_STATE = 0
+LEGACY_SI8_MODE = 1
 
 
 @table(
@@ -118,12 +131,12 @@ SI8_MODE = 1
         "sid": ("i32", SI32_SID),
         "max_participants": ("i32", SI32_MAX_PARTICIPANTS),
         "n_participants": ("i32", SI32_NPART),
+        "state": ("i32", SI32_STATE),
+        "mode": ("i32", SI32_MODE),
         "min_sigma_eff": ("f32", SF32_MIN_SIGMA),
         "created_at": ("f32", SF32_CREATED_AT),
         "terminated_at": ("f32", SF32_TERMINATED_AT),
         "max_duration": ("f32", SF32_MAX_DURATION),
-        "state": ("i8", SI8_STATE),
-        "mode": ("i8", SI8_MODE),
     }
 )
 class SessionTable:
@@ -135,16 +148,20 @@ class SessionTable:
     block. Legacy column names stay readable (`sessions.state`) and
     writable through `tables.struct.replace`.
 
-      i32[S, 3]: sid (-1 = free), max_participants, n_participants
+      i32[S, 5]: sid (-1 = free), max_participants, n_participants,
+                 state (SessionState.code), mode (ConsistencyMode.code)
       f32[S, 4]: min_sigma_eff, created_at, terminated_at, max_duration
-      i8[S, 2]:  state (SessionState.code), mode (ConsistencyMode.code)
 
-    The two rarely-read bools stay standalone columns.
+    The state/mode codes rode their own i8[S, 2] block until round 5;
+    widening them into the i32 block costs 8 bytes/row on a small table
+    and removes one gather from every wave's admission pre-checks (the
+    [B]-lane state read now rides the same [B, 5] row gather as the
+    capacity/count columns). The two rarely-read bools stay standalone
+    columns.
     """
 
-    i32: jnp.ndarray              # i32[S, 3] packed int columns (SI32_*)
+    i32: jnp.ndarray              # i32[S, 5] packed int columns (SI32_*)
     f32: jnp.ndarray              # f32[S, 4] packed float columns (SF32_*)
-    i8: jnp.ndarray               # i8[S, 2] packed code columns (SI8_*)
     enable_audit: jnp.ndarray     # bool[S]
     has_nonreversible: jnp.ndarray  # bool[S] drives STRONG forcing
 
@@ -153,19 +170,17 @@ class SessionTable:
         # Every block/column gets its OWN buffer: aliasing one zeros
         # array across fields breaks buffer donation (XLA refuses to
         # donate the same buffer twice in one call).
-        i32 = jnp.zeros((capacity, 3), jnp.int32)
+        i32 = jnp.zeros((capacity, SI32_WIDTH), jnp.int32)
         i32 = (
             i32.at[:, SI32_SID].set(-1)
             .at[:, SI32_MAX_PARTICIPANTS].set(10)
+            .at[:, SI32_MODE].set(1)  # EVENTUAL
         )
         f32 = jnp.zeros((capacity, 4), jnp.float32)
         f32 = f32.at[:, SF32_MIN_SIGMA].set(0.60)
-        i8 = jnp.zeros((capacity, 2), jnp.int8)
-        i8 = i8.at[:, SI8_MODE].set(1)  # EVENTUAL
         return SessionTable(
             i32=i32,
             f32=f32,
-            i8=i8,
             enable_audit=jnp.ones((capacity,), bool),
             has_nonreversible=jnp.zeros((capacity,), bool),
         )

@@ -47,23 +47,43 @@ def _install_virtual_columns(cls, packed: dict[str, tuple[str, int]]):
         setattr(cls, name, property(read))
 
 
-def table(cls: type[T] | None = None, *, packed=None):
+def _install_virtual_slices(cls, slices: dict[str, tuple[str, int, int]]):
+    cls._SLICES = dict(slices)
+    for name, (block, start, stop) in slices.items():
+
+        def read(self, _b=block, _s=start, _e=stop):
+            return getattr(self, _b)[:, _s:_e]
+
+        read.__name__ = name
+        read.__doc__ = f"virtual slice: {block}[:, {start}:{stop}]"
+        setattr(cls, name, property(read))
+
+
+def table(cls: type[T] | None = None, *, packed=None, slices=None):
     """Decorator: frozen dataclass registered as a JAX pytree node.
 
     All fields are data (leaves). With `packed`, virtual column names map
     to (block_field, column_index) — readable as properties, writable
-    through `replace`.
+    through `replace`. With `slices`, virtual MULTI-column names map to
+    (block_field, start, stop) ranges of the same blocks — same
+    read/replace contract, for sub-arrays like the breach window that
+    ride a block so row writes stay one scatter per dtype.
     """
 
     def wrap(c: type[T]) -> type[T]:
         c = dataclasses.dataclass(frozen=True)(c)
         fields = [f.name for f in dataclasses.fields(c)]
         jax.tree_util.register_dataclass(c, data_fields=fields, meta_fields=[])
+        virtual = dict(packed or {})
+        clash = set(virtual) & set(fields)
+        if slices:
+            clash |= set(slices) & (set(fields) | set(virtual))
+        if clash:
+            raise ValueError(f"virtual names shadow real fields: {clash}")
         if packed:
-            clash = set(packed) & set(fields)
-            if clash:
-                raise ValueError(f"packed names shadow real fields: {clash}")
             _install_virtual_columns(c, packed)
+        if slices:
+            _install_virtual_slices(c, slices)
         return c
 
     return wrap if cls is None else wrap(cls)
@@ -71,23 +91,37 @@ def table(cls: type[T] | None = None, *, packed=None):
 
 def replace(obj: T, **changes) -> T:
     """dataclasses.replace for table instances, understanding packed
-    virtual columns: a virtual kwarg folds into its block's column."""
-    packed = getattr(type(obj), "_PACKED", None)
-    if packed and any(name in packed for name in changes):
-        real = {k: v for k, v in changes.items() if k not in packed}
+    virtual columns and slices: a virtual kwarg folds into its block."""
+    packed = getattr(type(obj), "_PACKED", None) or {}
+    sliced = getattr(type(obj), "_SLICES", None) or {}
+    if any(name in packed or name in sliced for name in changes):
+        real = {
+            k: v
+            for k, v in changes.items()
+            if k not in packed and k not in sliced
+        }
         blocks: dict[str, object] = {}
-        for name, value in changes.items():
-            hit = packed.get(name)
-            if hit is None:
-                continue
-            block_name, idx = hit
+
+        def block_buf(block_name):
             if block_name not in blocks:
                 # A caller may pass the block itself alongside virtual
                 # columns; virtual updates stack on top of it.
                 blocks[block_name] = real.pop(
                     block_name, getattr(obj, block_name)
                 )
-            blocks[block_name] = blocks[block_name].at[:, idx].set(value)
+            return blocks[block_name]
+
+        for name, value in changes.items():
+            if name in packed:
+                block_name, idx = packed[name]
+                blocks[block_name] = (
+                    block_buf(block_name).at[:, idx].set(value)
+                )
+            elif name in sliced:
+                block_name, start, stop = sliced[name]
+                blocks[block_name] = (
+                    block_buf(block_name).at[:, start:stop].set(value)
+                )
         real.update(blocks)
         changes = real
     return dataclasses.replace(obj, **changes)

@@ -314,14 +314,14 @@ class TestCheckpointMigration:
         st.record_calls([0] * 6, [0] * 6, now=1.0)
         target = ckpt.save_state(st, tmp_path, step=1)
 
-        # Rewrite the save in the legacy layout: i32 widened to 5 with
-        # tumbling counters in cols 3-4, no bd_window key.
+        # Rewrite the save in the round-4 layout: i32 narrowed to 5 with
+        # tumbling counters in cols 3-4 (no window columns).
         data = dict(np.load(target / "tables.npz"))
         i32 = data.pop("agents.i32")
-        bdw = data.pop("agents.bd_window")
         n = i32.shape[0]
+        bdw = i32[:, 3:]  # the live window slice
         legacy = np.zeros((n, 5), np.int32)
-        legacy[:, :3] = i32
+        legacy[:, :3] = i32[:, :3]
         legacy[:, 3] = bdw[:, :BD_BUCKETS].sum(1)
         legacy[:, 4] = bdw[:, BD_BUCKETS : 2 * BD_BUCKETS].sum(1)
         data["agents.i32"] = legacy
@@ -335,3 +335,56 @@ class TestCheckpointMigration:
             np.asarray(restored.agents.flags), np.asarray(st.agents.flags)
         )
         assert not np.asarray(restored.agents.bd_window).any()
+
+    def test_midround5_separate_bd_window_restores(self, tmp_path):
+        """An early-round-5 save (width-3 i32 + its own agents.bd_window
+        array) folds the window back into the block losslessly."""
+        from hypervisor_tpu.runtime import checkpoint as ckpt
+
+        st = _admitted_state()
+        st.record_calls([0] * 6, [1] * 6, now=2.0)
+        target = ckpt.save_state(st, tmp_path, step=3)
+
+        data = dict(np.load(target / "tables.npz"))
+        i32 = data.pop("agents.i32")
+        data["agents.i32"] = i32[:, :3]
+        data["agents.bd_window"] = i32[:, 3:]
+        np.savez(target / "tables.npz", **data)
+
+        restored = ckpt.restore_state(target)
+        np.testing.assert_array_equal(
+            np.asarray(restored.agents.i32), np.asarray(st.agents.i32)
+        )
+        calls, priv = _totals(restored, 2.0)
+        assert int(calls[0]) == 6 and int(priv[0]) == 6
+
+    def test_legacy_session_i8_block_restores(self, tmp_path):
+        """A checkpoint from before the SessionTable state/mode merge
+        (separate i8[S,2] block, width-3 i32) restores losslessly."""
+        from hypervisor_tpu.runtime import checkpoint as ckpt
+        from hypervisor_tpu.tables.state import SI32_MODE, SI32_STATE
+
+        st = _admitted_state()
+        target = ckpt.save_state(st, tmp_path, step=2)
+
+        data = dict(np.load(target / "tables.npz"))
+        i32 = data["sessions.i32"]
+        assert i32.shape[1] == 5
+        data["sessions.i8"] = np.stack(
+            [i32[:, SI32_STATE], i32[:, SI32_MODE]], axis=1
+        ).astype(np.int8)
+        data["sessions.i32"] = i32[:, :3]
+        np.savez(target / "tables.npz", **data)
+
+        restored = ckpt.restore_state(target)
+        np.testing.assert_array_equal(
+            np.asarray(restored.sessions.state),
+            np.asarray(st.sessions.state),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored.sessions.mode), np.asarray(st.sessions.mode)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored.sessions.n_participants),
+            np.asarray(st.sessions.n_participants),
+        )

@@ -108,8 +108,6 @@ class TestBreachSweep:
         device plane)."""
         import dataclasses
 
-        from hypervisor_tpu.config import BreachConfig
-
         cfg = DEFAULT_CONFIG.replace(
             breach=dataclasses.replace(
                 DEFAULT_CONFIG.breach,

@@ -35,7 +35,14 @@ from hypervisor_tpu.ops import merkle as merkle_ops
 from hypervisor_tpu.ops import rings as ring_ops
 from hypervisor_tpu.ops import saga_ops
 from hypervisor_tpu.ops import session_fsm
-from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.state import (
+    AgentTable,
+    SessionTable,
+    SF32_TERMINATED_AT,
+    SI32_NPART,
+    SI32_STATE,
+    VouchTable,
+)
 from hypervisor_tpu.tables.struct import replace
 
 # Per-lane status codes for the batched pipeline (host may re-raise).
@@ -258,9 +265,16 @@ def governance_wave(
     ok = admitted.status == admission_ops.ADMIT_OK
 
     # ── 3. session FSM: HANDSHAKING -> ACTIVE where populated ────────
+    # One post-admission row gather per block serves state + counts
+    # (i32) and terminated_at (f32, phase 6) — three single-column
+    # gathers collapse to two row gathers (tables/state.py packing).
+    # Safe because nothing between here and the phase-6 write-back
+    # mutates the session table.
     k_sessions = wave_sessions
-    wave_state = sessions.state[k_sessions]
-    has_members = sessions.n_participants[k_sessions] > 0
+    sess_rows_i32 = sessions.i32[k_sessions]       # [K, 5]
+    sess_rows_f32 = sessions.f32[k_sessions]       # [K, 4]
+    wave_state = sess_rows_i32[:, SI32_STATE].astype(jnp.int8)
+    has_members = sess_rows_i32[:, SI32_NPART] > 0
     wave_state, err_a = session_fsm.apply_session_transitions(
         wave_state, jnp.int8(SessionState.ACTIVE.code), has_members
     )
@@ -304,7 +318,9 @@ def governance_wave(
         sessions,
         state=sessions.state.at[k_sessions].set(wave_state),
         terminated_at=sessions.terminated_at.at[k_sessions].set(
-            jnp.where(has_members, now_f, sessions.terminated_at[k_sessions])
+            jnp.where(
+                has_members, now_f, sess_rows_f32[:, SF32_TERMINATED_AT]
+            )
         ),
     )
 

@@ -374,6 +374,34 @@ class PlaneCoherence(RuleBasedStateMachine):
     # ── invariants: both planes describe the same world ──────────────
 
     @invariant()
+    def breach_windows_agree_across_planes(self):
+        """Round-5 sliding window: after ANY interleaving of actions,
+        gateway waves, sweeps, quarantines, handoffs, and elevations,
+        every live membership's device window total equals the host
+        detector's window — a sweep can no longer diverge the planes
+        (the old tumbling counters reset on every sweep rule here).
+        Machine runs finish far inside one sub-window, so the
+        oldest-partial-band imprecision cannot engage."""
+        from hypervisor_tpu.ops import security_ops
+
+        st = self.hv.state
+        calls, _ = security_ops.window_totals(
+            st.agents.bd_window, st.now(), st.config.breach
+        )
+        calls = np.asarray(calls)
+        for sid in self.sessions:
+            managed = self.hv.get_session(sid)
+            for did in sorted(self.joined[sid]):
+                row = st.agent_row(did, managed.slot)
+                if row is None:
+                    continue
+                hs = self.hv.breach_detector.get_agent_stats(did, sid)
+                assert hs["window_calls"] == int(calls[row["slot"]]), (
+                    f"window divergence for {did} in {sid}: host "
+                    f"{hs['window_calls']} device {int(calls[row['slot']])}"
+                )
+
+    @invariant()
     def participants_match_device_rows(self):
         for sid in self.sessions:
             managed = self.hv.get_session(sid)

@@ -1,0 +1,178 @@
+"""Randomized-shape soak: sharded + multislice waves ≡ single device.
+
+Opt-in (HV_SOAK=1): every distinct (B, K) shape compiles its own
+programs (~10-30 s each on the virtual CPU mesh), so this is a soak
+harness rather than a default-suite test. It randomizes the wave
+geometry the deterministic parity tests keep fixed — join counts,
+session counts, shard-local load balance, duplicate-lane placement,
+sigma mixes, vouch edges — and pins the sharded and multislice waves
+bit-par with the single-device wave on every draw.
+
+Run: HV_SOAK=1 python -m pytest tests/parity/test_wave_shape_fuzz.py -q
+(optionally HV_SOAK_ITERS=N, default 6).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from hypervisor_tpu.models import SessionState
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.ops.pipeline import governance_wave
+from hypervisor_tpu.parallel import make_mesh, make_multislice_mesh
+from hypervisor_tpu.parallel.collectives import (
+    multislice_reconcile_wave,
+    sharded_governance_wave,
+)
+from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("HV_SOAK") != "1",
+    reason="shape-fuzz soak is opt-in (HV_SOAK=1): each draw compiles "
+    "its own programs",
+)
+
+D = 8
+ROWS = 16
+T = 2
+
+
+def _world(rng, b, k, s_cap):
+    agents = AgentTable.create(ROWS * D)
+    sessions = SessionTable.create(s_cap)
+    ws = jnp.arange(k)
+    sessions = t_replace(
+        sessions,
+        state=sessions.state.at[ws].set(
+            jnp.int8(SessionState.HANDSHAKING.code)
+        ),
+        max_participants=sessions.max_participants.at[ws].set(
+            int(rng.integers(2, 8))
+        ),
+        min_sigma_eff=sessions.min_sigma_eff.at[ws].set(0.3),
+    )
+    vouches = VouchTable.create(4 * D)
+    # A few random active edges vouching for wave joiners.
+    n_edges = int(rng.integers(0, 4))
+    for e in range(n_edges):
+        vouches = t_replace(
+            vouches,
+            voucher=vouches.voucher.at[e].set(int(rng.integers(0, ROWS * D))),
+            vouchee=vouches.vouchee.at[e].set(
+                int(rng.integers(0, b)) * (ROWS * D // max(b, 1))
+                % (ROWS * D)
+            ),
+            session=vouches.session.at[e].set(int(rng.integers(0, k))),
+            bond=vouches.bond.at[e].set(float(rng.uniform(0.05, 0.4))),
+            active=vouches.active.at[e].set(True),
+            expiry=vouches.expiry.at[e].set(1e9),
+        )
+    return agents, sessions, vouches
+
+
+def _draw(rng):
+    """One random wave geometry honoring the shard contracts."""
+    per_shard = int(rng.integers(1, 4))         # joins per shard
+    b = per_shard * D
+    k = b                                        # unique: 1 session/join
+    s_cap = 1 << int(np.ceil(np.log2(max(2 * k, 4))))
+    slots = np.array(
+        [(i // per_shard) * ROWS + (i % per_shard) for i in range(b)],
+        np.int32,
+    )
+    sigma = rng.uniform(0.2, 1.0, b).astype(np.float32)
+    trust = rng.random(b) > 0.1
+    dup = rng.random(b) < 0.2                    # ragged padding lanes
+    bodies = rng.integers(
+        0, 2**32, size=(T, k, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    return b, k, s_cap, slots, sigma, trust, dup, bodies
+
+
+def test_random_shapes_sharded_and_multislice_match_single_device():
+    iters = int(os.environ.get("HV_SOAK_ITERS", "6"))
+    rng = np.random.default_rng(int(os.environ.get("HV_SOAK_SEED", "7")))
+    mesh1 = make_mesh(D, platform="cpu")
+    mesh2 = make_multislice_mesh(2, D // 2)
+
+    for it in range(iters):
+        b, k, s_cap, slots, sigma, trust, dup, bodies = _draw(rng)
+        args = (
+            jnp.asarray(slots),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.asarray(sigma),
+            jnp.asarray(trust),
+            jnp.asarray(dup),
+            jnp.asarray(np.arange(k, dtype=np.int32)),
+            jnp.asarray(bodies),
+            float(it + 1),
+            0.5,
+        )
+        wave_range = (jnp.asarray(0, jnp.int32), jnp.asarray(k, jnp.int32))
+
+        agents0, sessions0, vouches0 = _world(
+            np.random.default_rng(1000 + it), b, k, s_cap
+        )
+        single = jax.jit(
+            governance_wave,
+            static_argnames=("use_pallas", "unique_sessions"),
+        )(
+            agents0, sessions0, vouches0, *args,
+            use_pallas=False, wave_range=wave_range, unique_sessions=True,
+        )
+
+        agents1, sessions1, vouches1 = _world(
+            np.random.default_rng(1000 + it), b, k, s_cap
+        )
+        shard = sharded_governance_wave(
+            mesh1, contiguous_waves=True, unique_sessions=True
+        )(agents1, sessions1, vouches1, *args, *wave_range)
+
+        agents2, sessions2, vouches2 = _world(
+            np.random.default_rng(1000 + it), b, k, s_cap
+        )
+        ms_res, ms_part = sharded_governance_wave(
+            mesh2, mode_dispatch=True, contiguous_waves=True,
+            unique_sessions=True, multislice=True,
+        )(agents2, sessions2, vouches2, *args, *wave_range)
+        folded = multislice_reconcile_wave(mesh2)(
+            ms_res.sessions, ms_part.counts, ms_part.owned,
+            ms_part.state, ms_part.terminated,
+        )
+
+        for name in ("status", "ring", "sigma_eff", "merkle_root",
+                     "chain", "fsm_error"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(shard, name)),
+                np.asarray(getattr(single, name)),
+                err_msg=f"[{it}] sharded {name} (b={b}, k={k})",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ms_res, name)),
+                np.asarray(getattr(single, name)),
+                err_msg=f"[{it}] multislice {name} (b={b}, k={k})",
+            )
+        for col in ("state", "n_participants", "terminated_at"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(shard.sessions, col)),
+                np.asarray(getattr(single.sessions, col)),
+                err_msg=f"[{it}] sharded sessions.{col}",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(getattr(folded, col)),
+                np.asarray(getattr(single.sessions, col)),
+                err_msg=f"[{it}] multislice sessions.{col}",
+            )
+        np.testing.assert_array_equal(
+            np.asarray(shard.agents.i32), np.asarray(single.agents.i32),
+            err_msg=f"[{it}] sharded agents.i32",
+        )
+        print(f"draw {it}: b={b} k={k} dup={int(dup.sum())} OK", flush=True)

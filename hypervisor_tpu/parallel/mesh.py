@@ -81,13 +81,19 @@ def make_mesh(
     return Mesh(np.asarray(devices), (AGENT_AXIS,))
 
 
-def make_multislice_mesh(n_slices: int, per_slice: int) -> Mesh:
+def make_multislice_mesh(
+    n_slices: int, per_slice: int, platform: Optional[str] = None
+) -> Mesh:
     """2-D mesh (dcn, agents): outer axis across slices (DCN), inner over ICI.
 
     Collectives over AGENT_AXIS ride ICI; EVENTUAL-mode cross-slice
     reconciliation reduces over DCN_AXIS between batched ticks.
+
+    `platform` pins the device pool like `make_mesh`'s — pass "cpu" for
+    hermetic virtual-mesh runs that must never initialize the default
+    backend (which may be a real-accelerator tunnel).
     """
-    devices = np.asarray(_device_pool(n_slices * per_slice)).reshape(
-        n_slices, per_slice
-    )
+    devices = np.asarray(
+        _device_pool(n_slices * per_slice, platform)
+    ).reshape(n_slices, per_slice)
     return Mesh(devices, (DCN_AXIS, AGENT_AXIS))

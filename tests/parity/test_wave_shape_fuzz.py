@@ -100,7 +100,10 @@ def test_random_shapes_sharded_and_multislice_match_single_device():
     iters = int(os.environ.get("HV_SOAK_ITERS", "6"))
     rng = np.random.default_rng(int(os.environ.get("HV_SOAK_SEED", "7")))
     mesh1 = make_mesh(D, platform="cpu")
-    mesh2 = make_multislice_mesh(2, D // 2)
+    # platform="cpu": hermetic like mesh1 — the soak must never
+    # initialize the default backend (a real-accelerator tunnel under
+    # HV_TPU_TESTS=1).
+    mesh2 = make_multislice_mesh(2, D // 2, platform="cpu")
 
     for it in range(iters):
         b, k, s_cap, slots, sigma, trust, dup, bodies = _draw(rng)

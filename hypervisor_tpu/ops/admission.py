@@ -86,22 +86,33 @@ def admit_row_blocks(
         if ring_bursts is None
         else jnp.asarray(ring_bursts, jnp.float32)
     )
-    f32_rows = jnp.zeros((b, 8), jnp.float32)
-    f32_rows = (
-        f32_rows.at[:, tables_state.AF32_SIGMA_RAW].set(sigma_raw)
-        .at[:, tables_state.AF32_SIGMA_EFF].set(sigma_eff)
-        .at[:, tables_state.AF32_JOINED_AT].set(now_f)
-        .at[:, tables_state.AF32_RL_TOKENS].set(
-            bursts[jnp.clip(ring.astype(jnp.int32), 0, 3)]
-        )
-        .at[:, tables_state.AF32_RL_STAMP].set(now_f)
+    # Build the blocks as ONE stack per dtype instead of chained
+    # `.at[:, idx].set` updates: each chained set lowers to its own
+    # dynamic-update-slice dispatch on TPU (7 of admission's ~47
+    # dispatch steps in the v5e census were exactly these), while a
+    # stack fuses into a single kernel. Each column is PLACED at its
+    # AF32_*/AI32_* index, so a schema reorder cannot silently corrupt
+    # rows (immune by construction, like the old per-index sets).
+    zeros_f = jnp.zeros((b,), jnp.float32)
+    f32_cols: list = [zeros_f] * 8  # risk/breaker/quarantine stay 0
+    f32_cols[tables_state.AF32_SIGMA_RAW] = sigma_raw
+    f32_cols[tables_state.AF32_SIGMA_EFF] = sigma_eff
+    f32_cols[tables_state.AF32_JOINED_AT] = now_f
+    f32_cols[tables_state.AF32_RL_TOKENS] = bursts[
+        jnp.clip(ring.astype(jnp.int32), 0, 3)
+    ]
+    f32_cols[tables_state.AF32_RL_STAMP] = now_f
+    f32_rows = jnp.stack(f32_cols, axis=1)
+
+    zeros_i = jnp.zeros((b,), jnp.int32)
+    # Breach-window columns start zeroed (fresh sliding window).
+    i32_cols: list = [zeros_i] * tables_state.AI32_WIDTH
+    i32_cols[tables_state.AI32_DID] = did.astype(jnp.int32)
+    i32_cols[tables_state.AI32_SESSION] = session_slot.astype(jnp.int32)
+    i32_cols[tables_state.AI32_FLAGS] = jnp.full(
+        (b,), FLAG_ACTIVE, jnp.int32
     )
-    i32_rows = jnp.zeros((b, tables_state.AI32_WIDTH), jnp.int32)
-    i32_rows = (
-        i32_rows.at[:, tables_state.AI32_DID].set(did)
-        .at[:, tables_state.AI32_SESSION].set(session_slot)
-        .at[:, tables_state.AI32_FLAGS].set(FLAG_ACTIVE)
-    )
+    i32_rows = jnp.stack(i32_cols, axis=1)
     return f32_rows, i32_rows
 
 

@@ -31,6 +31,7 @@ import dataclasses
 from typing import TypeVar
 
 import jax
+import jax.numpy as jnp
 
 T = TypeVar("T")
 
@@ -91,7 +92,17 @@ def table(cls: type[T] | None = None, *, packed=None, slices=None):
 
 def replace(obj: T, **changes) -> T:
     """dataclasses.replace for table instances, understanding packed
-    virtual columns and slices: a virtual kwarg folds into its block."""
+    virtual columns and slices: a virtual kwarg folds into its block.
+
+    Multi-column updates to one block materialize as ONE
+    column-keyed `jnp.stack` instead of chained `.at[:, idx].set`
+    writes — each chained set lowers to its own dynamic-update-slice
+    dispatch on TPU, while the stack (reading unchanged columns from
+    the base block) fuses into a single kernel (see the round-5
+    admission census in benchmarks/results/ROOFLINE.md). A
+    single-column update keeps the one-DUS form, which is cheaper than
+    re-materializing a wide block.
+    """
     packed = getattr(type(obj), "_PACKED", None) or {}
     sliced = getattr(type(obj), "_SLICES", None) or {}
     if any(name in packed or name in sliced for name in changes):
@@ -100,28 +111,61 @@ def replace(obj: T, **changes) -> T:
             for k, v in changes.items()
             if k not in packed and k not in sliced
         }
-        blocks: dict[str, object] = {}
-
-        def block_buf(block_name):
-            if block_name not in blocks:
-                # A caller may pass the block itself alongside virtual
-                # columns; virtual updates stack on top of it.
-                blocks[block_name] = real.pop(
-                    block_name, getattr(obj, block_name)
-                )
-            return blocks[block_name]
-
+        # Per block: the ordered updates, each ("col", idx, value) or
+        # ("slice", start, stop, value).
+        per_block: dict[str, list[tuple]] = {}
         for name, value in changes.items():
             if name in packed:
                 block_name, idx = packed[name]
-                blocks[block_name] = (
-                    block_buf(block_name).at[:, idx].set(value)
+                per_block.setdefault(block_name, []).append(
+                    ("col", idx, value)
                 )
             elif name in sliced:
                 block_name, start, stop = sliced[name]
-                blocks[block_name] = (
-                    block_buf(block_name).at[:, start:stop].set(value)
+                per_block.setdefault(block_name, []).append(
+                    ("slice", start, stop, value)
                 )
+
+        blocks: dict[str, object] = {}
+        for block_name, updates in per_block.items():
+            # A caller may pass the block itself alongside virtual
+            # columns; virtual updates stack on top of it.
+            base = real.pop(block_name, getattr(obj, block_name))
+            n = base.shape[0]
+            if len(updates) == 1:
+                # A lone update keeps its single (contiguous)
+                # dynamic-update-slice — already one dispatch, and
+                # cheaper than rematerializing a wide block.
+                u = updates[0]
+                if u[0] == "col":
+                    blocks[block_name] = base.at[:, u[1]].set(u[2])
+                else:
+                    blocks[block_name] = base.at[:, u[1]:u[2]].set(u[3])
+                continue
+            # Multi-update: materialize as ONE column-keyed stack.
+            # Values are normalized with `.set()` broadcast semantics
+            # first (scalars fill; wrong widths raise, not truncate).
+            cols: dict[int, object] = {}
+            for u in updates:
+                if u[0] == "col":
+                    cols[u[1]] = jnp.broadcast_to(
+                        jnp.asarray(u[2]).astype(base.dtype), (n,)
+                    )
+                else:
+                    _, start, stop, value = u
+                    v = jnp.broadcast_to(
+                        jnp.asarray(value).astype(base.dtype),
+                        (n, stop - start),
+                    )
+                    for j in range(start, stop):
+                        cols[j] = v[:, j - start]
+            blocks[block_name] = jnp.stack(
+                [
+                    cols.get(i, base[:, i])
+                    for i in range(base.shape[1])
+                ],
+                axis=1,
+            )
         real.update(blocks)
         changes = real
     return dataclasses.replace(obj, **changes)

@@ -1,0 +1,91 @@
+"""tables.struct.replace: virtual column/slice folding semantics.
+
+The round-5 rewrite materializes multi-column block updates as one
+column-keyed stack (each chained `.at[:, i].set` was its own TPU
+dispatch); these tests pin the contract the rewrite must preserve:
+`.set()` broadcast semantics (scalars fill, wrong widths raise, not
+truncate), last-write-wins with a caller-passed block, and value
+equality between the single-update DUS fast path and the stack path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from hypervisor_tpu.tables.state import (
+    AgentTable,
+    AI32_BD_WIN_START,
+    AI32_FLAGS,
+    BD_BUCKETS,
+)
+from hypervisor_tpu.tables.struct import replace
+
+
+def _agents(n=4):
+    return AgentTable.create(n)
+
+
+class TestColumnFolding:
+    def test_single_column_update(self):
+        a = replace(_agents(), sigma_eff=jnp.arange(4, dtype=jnp.float32))
+        np.testing.assert_array_equal(
+            np.asarray(a.sigma_eff), [0.0, 1.0, 2.0, 3.0]
+        )
+
+    def test_multi_column_stack_matches_values(self):
+        a = replace(
+            _agents(),
+            sigma_raw=jnp.full((4,), 0.25, jnp.float32),
+            sigma_eff=jnp.full((4,), 0.5, jnp.float32),
+            rl_tokens=jnp.full((4,), 7.0, jnp.float32),
+        )
+        np.testing.assert_array_equal(np.asarray(a.sigma_raw), [0.25] * 4)
+        np.testing.assert_array_equal(np.asarray(a.sigma_eff), [0.5] * 4)
+        np.testing.assert_array_equal(np.asarray(a.rl_tokens), [7.0] * 4)
+        # Untouched columns keep their create() defaults.
+        np.testing.assert_array_equal(np.asarray(a.risk_score), [0.0] * 4)
+
+    def test_scalar_broadcast_fills_column(self):
+        a = replace(_agents(), sigma_eff=0.75, joined_at=2.0)
+        np.testing.assert_array_equal(np.asarray(a.sigma_eff), [0.75] * 4)
+        np.testing.assert_array_equal(np.asarray(a.joined_at), [2.0] * 4)
+
+    def test_block_passed_alongside_virtuals(self):
+        base = _agents()
+        new_block = jnp.asarray(np.full((4, 8), 3.0, np.float32))
+        a = replace(base, f32=new_block, sigma_eff=jnp.zeros((4,)))
+        np.testing.assert_array_equal(np.asarray(a.sigma_eff), [0.0] * 4)
+        np.testing.assert_array_equal(np.asarray(a.sigma_raw), [3.0] * 4)
+
+
+class TestSliceFolding:
+    def test_slice_update_roundtrips(self):
+        w = np.arange(4 * 3 * BD_BUCKETS, dtype=np.int32).reshape(4, -1)
+        a = replace(_agents(), bd_window=jnp.asarray(w))
+        np.testing.assert_array_equal(np.asarray(a.bd_window), w)
+        # Identity columns untouched.
+        np.testing.assert_array_equal(np.asarray(a.did), [-1] * 4)
+
+    def test_scalar_slice_broadcast(self):
+        a = replace(_agents(), bd_window=1)
+        np.testing.assert_array_equal(
+            np.asarray(a.bd_window), np.ones((4, 3 * BD_BUCKETS), np.int32)
+        )
+
+    def test_slice_plus_column_same_block(self):
+        w = np.full((4, 3 * BD_BUCKETS), 9, np.int32)
+        a = replace(_agents(), bd_window=jnp.asarray(w), flags=5)
+        np.testing.assert_array_equal(np.asarray(a.bd_window), w)
+        np.testing.assert_array_equal(np.asarray(a.flags), [5] * 4)
+        np.testing.assert_array_equal(np.asarray(a.did), [-1] * 4)
+        assert AI32_FLAGS < AI32_BD_WIN_START  # layout sanity
+
+    def test_wrong_width_slice_raises(self):
+        bad = jnp.zeros((4, 3 * BD_BUCKETS + 1), jnp.int32)
+        with pytest.raises(Exception):
+            replace(_agents(), bd_window=bad, flags=1)  # stack path
+        with pytest.raises(Exception):
+            replace(_agents(), bd_window=bad)           # DUS fast path

@@ -1,0 +1,191 @@
+"""Deviceless-AOT census of the real v5e executables (no chip needed).
+
+The round-5 discovery that powers ROOFLINE.md's TPU-true numbers:
+`jax.experimental.topologies.get_topology_desc("tpu", "v5e:2x4")`
+builds a PJRT topology for the BASELINE target with no device attached
+— even while the accelerator tunnel is wedged — and compiling against
+it runs the real XLA:TPU + Mosaic compiler. This script extracts, from
+the actual v5e executables:
+
+  * the bench-shaped 10k wave's ENTRY instruction census (the dispatch
+    structure that dominates wave latency — ROOFLINE.md §4),
+  * the donated-wave diff (how many copy steps donation removes),
+  * a per-phase dispatch attribution (the mega-fusion priority list),
+  * live HBM buffer sizes (temp/args/outputs).
+
+Run: python benchmarks/tpu_aot_census.py   (requires the TPU PJRT
+plugin; skips with a message where it is absent, e.g. GitHub CI).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from collections import Counter
+from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from _jax_platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(8)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+S, T, N, SC, E = 10_000, 3, 16_384, 16_384, 65_536
+TOPOLOGY = "v5e:2x4"
+
+# Dispatch-bearing instruction kinds (parameters/bitcasts/tuples are
+# metadata; copy-done is the completion half of an async copy).
+DISPATCH_OPS = (
+    "fusion", "custom-call", "copy", "dynamic-update-slice", "sort",
+    "reduce-window", "gather", "scatter",
+)
+
+
+def entry_census(compiled) -> tuple[int, int, dict]:
+    txt = compiled.as_text()
+    entry = txt[txt.index("ENTRY "):]
+    body = entry[entry.index("{") + 1:]
+    depth, end = 1, 0
+    for i, ch in enumerate(body):
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                end = i
+                break
+    insts = re.findall(
+        r"^\s*(?:ROOT\s+)?[%\w.-]+ = \S+ ([a-z-]+)\(", body[:end], re.M
+    )
+    c = Counter(insts)
+    return sum(c.values()), sum(c[k] for k in DISPATCH_OPS), dict(
+        c.most_common(10)
+    )
+
+
+def main() -> int:
+    try:
+        from jax.experimental import topologies
+
+        td = topologies.get_topology_desc(
+            platform="tpu", topology_name=TOPOLOGY
+        )
+    except Exception as exc:
+        print(f"TPU PJRT topology unavailable ({exc!r}); nothing to census.")
+        return 0
+    from jax.sharding import SingleDeviceSharding
+
+    dev = td.devices[0]
+    print(f"target: {dev.device_kind} x{len(td.devices)} ({TOPOLOGY})")
+    s = SingleDeviceSharding(dev)
+    jax.config.update("jax_compilation_cache_dir", None)
+
+    from hypervisor_tpu.config import DEFAULT_CONFIG
+    from hypervisor_tpu.ops import admission as admission_ops
+    from hypervisor_tpu.ops import gateway as gateway_ops
+    from hypervisor_tpu.ops import liability as liability_ops
+    from hypervisor_tpu.ops import merkle as merkle_ops
+    from hypervisor_tpu.ops import saga_ops, terminate as terminate_ops
+    from hypervisor_tpu.ops.pipeline import governance_wave
+    from hypervisor_tpu.tables.state import (
+        AgentTable,
+        ElevationTable,
+        SessionTable,
+        VouchTable,
+    )
+
+    def sds(tree):
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree
+        )
+
+    at, st, vt, et = (
+        sds(AgentTable.create(N)),
+        sds(SessionTable.create(SC)),
+        sds(VouchTable.create(E)),
+        sds(ElevationTable.create(4096)),
+    )
+    li = jax.ShapeDtypeStruct((S,), jnp.int32)
+    lb = jax.ShapeDtypeStruct((S,), jnp.bool_)
+    lf = jax.ShapeDtypeStruct((S,), jnp.float32)
+    li8 = jax.ShapeDtypeStruct((S,), jnp.int8)
+    sf = jax.ShapeDtypeStruct((), jnp.float32)
+    si = jax.ShapeDtypeStruct((), jnp.int32)
+    bodies = jax.ShapeDtypeStruct((T, S, merkle_ops.BODY_WORDS), jnp.uint32)
+    wave_args = (at, st, vt, li, li, li, lf, lb, lb, li, bodies, sf, sf)
+
+    def wave_fastpath(*a):
+        *w, lo, hi = a
+        return governance_wave(
+            *w, use_pallas=True, unique_sessions=True, wave_range=(lo, hi)
+        )
+
+    # ── the bench wave, plain and donated ────────────────────────────
+    for label, extra in (("wave", {}), ("wave+donate",
+                                       {"donate_argnums": (0, 1, 2)})):
+        compiled = (
+            jax.jit(wave_fastpath, in_shardings=s, out_shardings=s, **extra)
+            .lower(*wave_args, si, si)
+            .compile()
+        )
+        total, heavy, top = entry_census(compiled)
+        print(f"{label:14s} entry={total:4d} dispatch-ish={heavy:4d}  {top}")
+        if not extra:
+            mm = compiled.memory_analysis()
+            print(
+                "               HBM MB: temp"
+                f" {mm.temp_size_in_bytes / 1e6:.2f} args"
+                f" {mm.argument_size_in_bytes / 1e6:.2f} out"
+                f" {mm.output_size_in_bytes / 1e6:.2f}"
+            )
+
+    # ── per-phase attribution ────────────────────────────────────────
+    def audit(b):
+        chain = merkle_ops.chain_digests(b, use_pallas=True)
+        p = 1 << max(0, (T - 1).bit_length())
+        leaves = jnp.zeros((S, p, 8), jnp.uint32)
+        leaves = leaves.at[:, :T].set(jnp.transpose(chain, (1, 0, 2)))
+        return merkle_ops.merkle_root_lanes(
+            leaves, jnp.int32(T), use_pallas=True
+        )
+
+    phases = [
+        ("contribution",
+         lambda v, ts, now: liability_ops.contribution_toward(v, ts, now),
+         (vt, jax.ShapeDtypeStruct((N,), jnp.int32), sf)),
+        ("admission",
+         partial(admission_ops.admit_batch, trust=DEFAULT_CONFIG.trust,
+                 unique_sessions=True),
+         (at, st, li, li, li, lf, lb, lb, sf)),
+        ("audit(hash)", audit, (bodies,)),
+        ("saga step",
+         lambda q, ok: saga_ops.execute_attempt(
+             q, success=ok, retries_left=jnp.zeros((S,), jnp.int8)),
+         (li8, lb)),
+        ("terminate",
+         lambda a, v, lo, hi: terminate_ops.release_session_scope(
+             a, v, None, wave_range=(lo, hi)),
+         (at, vt, si, si)),
+        ("gateway",
+         partial(gateway_ops.check_actions, breach=DEFAULT_CONFIG.breach,
+                 rate_limit=DEFAULT_CONFIG.rate_limit,
+                 trust=DEFAULT_CONFIG.trust),
+         (at, et, li, li8, lb, lb, lb, lb, sf)),
+    ]
+    for name, fn, args in phases:
+        compiled = (
+            jax.jit(fn, in_shardings=s, out_shardings=s)
+            .lower(*args)
+            .compile()
+        )
+        total, heavy, top = entry_census(compiled)
+        print(f"{name:14s} entry={total:4d} dispatch-ish={heavy:4d}  {top}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

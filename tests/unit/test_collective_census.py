@@ -104,3 +104,27 @@ class TestFusedWaveCensus:
         args, b, k = _wave_world(one_join_per_session=False)
         compiled = sharded_governance_wave(mesh).lower(*args).compile()
         assert _census(compiled, "all-reduce") <= 4
+
+
+class TestDispatchStructure:
+    def test_admit_row_blocks_lower_without_per_column_updates(self):
+        """Round-5 dispatch fusion: the admission row blocks build as
+        one stack per dtype. A regression to chained `.at[:, i].set`
+        column writes shows up as dynamic-update-slice ops in the
+        lowered HLO (each was its own TPU dispatch — admission carried
+        7 of them before the fix)."""
+        from hypervisor_tpu.ops.admission import admit_row_blocks
+
+        b = 64
+        compiled = (
+            jax.jit(admit_row_blocks)
+            .lower(
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b,), jnp.float32),
+                jnp.zeros((b,), jnp.float32),
+                jnp.float32(1.0),
+            )
+            .compile()
+        )
+        assert _census(compiled, "dynamic-update-slice") == 0

@@ -83,6 +83,7 @@ def bench(fn, args, iters: int, batch: int, name: str) -> dict:
         "batch_p99_ms": stats["p99_ns"] / 1e6,
         "per_op_us": per_op_us,
         "throughput_ops_s": batch / (stats["p50_ns"] / 1e9),
+        "_samples_ns": samples,  # stripped before writing results
     }
     base = BASELINE_P50_US.get(name)
     if base is not None:
@@ -389,11 +390,55 @@ def build_benchmarks(quick: bool):
     yield "state_wave_fastpath", wave_fastpath, wave_args, S
 
 
+def metrics_plane_report(results: list[dict]) -> dict:
+    """Feed every benchmark's samples through the metrics plane and
+    draw p50/p95 FROM its histograms (not the raw sample lists) — the
+    suite reports through the same bucket math production scrapes use.
+    Quantiles are therefore bucket-resolved (log-2 bounds), alongside
+    the exact percentiles the suite already prints.
+    """
+    from hypervisor_tpu.observability.metrics import Metrics, MetricsRegistry
+
+    reg = MetricsRegistry()
+    handles = {
+        r["name"]: reg.histogram(
+            "bench_batch_latency_us", "timed batch wall clock",
+            bench=r["name"],
+        )
+        for r in results
+    }
+    metrics = Metrics(reg)
+    for r in results:
+        for ns in r["_samples_ns"]:
+            metrics.observe_us(handles[r["name"]], ns / 1e3)
+    snap = metrics.snapshot()
+    report = {}
+    for r in results:
+        h = handles[r["name"]]
+        report[r["name"]] = {
+            "samples": snap.hist_count(h),
+            "batch_p50_us": round(snap.quantile(h, 0.5), 1),
+            "batch_p95_us": round(snap.quantile(h, 0.95), 1),
+            "per_op_p50_us": round(snap.quantile(h, 0.5) / r["batch"], 4),
+            "per_op_p95_us": round(snap.quantile(h, 0.95) / r["batch"], 4),
+        }
+    return report
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=30)
     ap.add_argument("--quick", action="store_true", help="smaller batches")
     ap.add_argument("--json-only", action="store_true")
+    ap.add_argument(
+        "--metrics-out",
+        type=str,
+        default=None,
+        help=(
+            "write a metrics-plane report (p50/p95 drawn from the "
+            "plane's histograms) to this path, e.g. BENCH_r06.json"
+        ),
+    )
     ap.add_argument(
         "--write-results",
         action="store_true",
@@ -421,6 +466,25 @@ def main() -> None:
                 flush=True,
             )
 
+    if args.metrics_out:
+        plane = metrics_plane_report(results)
+        report = {
+            "source": "benchmarks/bench_suite.py metrics plane",
+            "device": str(device.device_kind),
+            "backend": jax.default_backend(),
+            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "iterations": args.iters,
+            "quick": args.quick,
+            "pipeline_latency_us": plane.get("full_governance_pipeline"),
+            "benchmarks": plane,
+        }
+        Path(args.metrics_out).write_text(json.dumps(report, indent=2) + "\n")
+        if not args.json_only:
+            print(f"wrote metrics-plane report to {args.metrics_out}")
+
+    results = [
+        {k: v for k, v in r.items() if k != "_samples_ns"} for r in results
+    ]
     out = {
         "device": str(device.device_kind),
         "backend": jax.default_backend(),

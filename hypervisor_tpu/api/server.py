@@ -22,13 +22,15 @@ from urllib.parse import parse_qs, urlparse
 
 from hypervisor_tpu import __version__
 from hypervisor_tpu.api import models as M
-from hypervisor_tpu.api.service import ApiError, HypervisorService
+from hypervisor_tpu.api.service import ApiError, HypervisorService, PrometheusText
+from hypervisor_tpu.observability.metrics import PROMETHEUS_CONTENT_TYPE
 
 # ── Route table: (method, pattern, handler_name, request_model) ──────
 # {name} segments become handler kwargs; query params pass through for GET.
 
 ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/health", "health", None),
+    ("GET", "/metrics", "metrics", None),
     ("GET", "/api/v1/stats", "stats", None),
     ("GET", "/api/v1/device/stats", "device_stats", None),
     ("POST", "/api/v1/sessions", "create_session", M.CreateSessionRequest),
@@ -125,6 +127,12 @@ def create_app(service: Optional[HypervisorService] = None):
                     result = await getattr(svc, name)(**path_kwargs)
                 except ApiError as e:
                     raise HTTPException(status_code=e.status, detail=e.detail)
+                if isinstance(result, PrometheusText):
+                    from fastapi.responses import PlainTextResponse
+
+                    return PlainTextResponse(
+                        str(result), media_type=PROMETHEUS_CONTENT_TYPE
+                    )
                 return _to_jsonable(result)
 
             return endpoint
@@ -209,12 +217,23 @@ class HypervisorHTTPServer:
                     self._send(e.status, {"detail": e.detail})
                     return
                 status = 201 if ("POST", name) in _CREATED else 200
+                if isinstance(result, PrometheusText):
+                    self._send_raw(
+                        status, str(result).encode(), PROMETHEUS_CONTENT_TYPE
+                    )
+                    return
                 self._send(status, _to_jsonable(result))
 
             def _send(self, status: int, payload: Any) -> None:
-                data = json.dumps(payload).encode()
+                self._send_raw(
+                    status, json.dumps(payload).encode(), "application/json"
+                )
+
+            def _send_raw(
+                self, status: int, data: bytes, content_type: str
+            ) -> None:
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("Access-Control-Allow-Origin", "*")
                 self.end_headers()

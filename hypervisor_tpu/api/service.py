@@ -27,6 +27,12 @@ class ApiError(Exception):
         self.detail = detail
 
 
+class PrometheusText(str):
+    """Marker type: serve this handler result as Prometheus text
+    exposition (`observability.metrics.PROMETHEUS_CONTENT_TYPE`), not
+    JSON. Both transports special-case it."""
+
+
 class HypervisorService:
     """All endpoint handlers over one Hypervisor + event bus pair."""
 
@@ -54,6 +60,15 @@ class HypervisorService:
             total_vouches=self.hv.vouching.vouch_count,
             event_count=self.bus.event_count,
         )
+
+    async def metrics(self) -> PrometheusText:
+        """`GET /metrics`: Prometheus scrape of the device metrics plane.
+
+        Refreshes the occupancy gauges on device, drains the plane with
+        its single `device_get`, and renders text exposition — all
+        outside any wave (`HypervisorState.metrics_snapshot`).
+        """
+        return PrometheusText(self.hv.state.metrics_prometheus())
 
     async def device_stats(self) -> M.DeviceStatsResponse:
         """Device-plane occupancy: the tables every facade call updates."""

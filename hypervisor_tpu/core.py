@@ -1547,6 +1547,14 @@ class Hypervisor:
             jnp.asarray(traces),
             jnp.asarray(stamps),
         )
+        # The metrics-plane twin of the EventLog cursor: every mirrored
+        # row counts once, so the two planes can be cross-checked
+        # (tests/unit/test_metrics.py event-parity guard). Host-plane
+        # inc — this path already synced to host, and a device dispatch
+        # here would buy nothing the snapshot merge doesn't provide.
+        from hypervisor_tpu.observability import metrics as metrics_plane
+
+        self.state.metrics.inc(metrics_plane.EVENTS_MIRRORED, len(codes))
         self._events_mirrored += len(codes)
         return len(codes)
 

@@ -4,7 +4,7 @@ Host-side views of the device EventLog ring buffer (`tables/logs.py`);
 `fnv1a32` is the shared string->u32 fold both planes use for trace ids.
 """
 
-from hypervisor_tpu.observability import profiling
+from hypervisor_tpu.observability import metrics, profiling
 from hypervisor_tpu.observability.causal_trace import CausalTraceId, fnv1a32
 from hypervisor_tpu.observability.event_bus import (
     EventHandler,
@@ -20,5 +20,6 @@ __all__ = [
     "HypervisorEvent",
     "HypervisorEventBus",
     "fnv1a32",
+    "metrics",
     "profiling",
 ]

@@ -1,0 +1,625 @@
+"""Host registry + drain for the device-resident metrics plane.
+
+`tables.metrics.MetricsTable` is the HBM side: counters/gauges/histogram
+buckets the jitted waves scatter into as pure array arithmetic. This
+module is everything around it:
+
+  * a typed registry mapping metric NAMES (+ Prometheus labels) to row
+    handles, frozen into a table layout,
+  * the shared log-spaced bucket layout (powers of two, 1 µs .. ~16.8 s,
+    then +Inf) used by every latency histogram on both planes,
+  * the `Metrics` host object: owns one device table, a host-plane
+    mirror for samples that only exist on host (wall-clock stage
+    latencies, sharded-wave tallies), and the asynchronous drain —
+    `snapshot()` does ONE `jax.device_get` outside the waves, merges
+    both planes, and handles u32 counter wrap so exposition stays
+    monotonic,
+  * Prometheus text exposition (`to_prometheus`) and bucket-quantile
+    math (`MetricsSnapshot.quantile`).
+
+Stage names here are the SAME names the profiler spans use
+(`hv.<stage>` in `observability.profiling`), so a TensorBoard/Perfetto
+capture and the latency histograms can be correlated line-for-line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Iterator, Mapping
+
+import numpy as np
+
+from hypervisor_tpu.tables.metrics import MetricsTable
+
+#: Shared histogram upper bounds, in microseconds: 2^0 .. 2^24 µs
+#: (1 µs .. ~16.8 s), +Inf implied as the final overflow bucket.
+#: Log-spaced so one layout covers a 0.13 ms admission wave and a
+#: multi-second sharded compile-miss with ~7% worst-case quantile error
+#: per octave interpolation.
+DEFAULT_BUCKET_BOUNDS_US: tuple[float, ...] = tuple(
+    float(1 << k) for k in range(25)
+)
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+COUNTER, GAUGE, HISTOGRAM = "counter", "gauge", "histogram"
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricHandle:
+    """One registered metric: its table row + exposition metadata."""
+
+    name: str
+    kind: str
+    index: int
+    help: str = ""
+    labels: tuple[tuple[str, str], ...] = ()
+
+    def label_str(self) -> str:
+        if not self.labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in self.labels)
+        return "{" + inner + "}"
+
+
+class MetricsRegistry:
+    """Name -> handle registry; freezes into a MetricsTable layout.
+
+    Handles are dense row indices per kind, so the device table is
+    exactly [C]/[G]/[H, NB] with no holes. Registration order is
+    exposition order. A (name, labels) pair registers once; metrics
+    sharing a name must share a kind (Prometheus series semantics).
+    """
+
+    def __init__(
+        self, bounds: tuple[float, ...] = DEFAULT_BUCKET_BOUNDS_US
+    ) -> None:
+        self.bounds = tuple(float(b) for b in bounds)
+        self._handles: list[MetricHandle] = []
+        self._by_key: dict[tuple, MetricHandle] = {}
+        self._kind_of_name: dict[str, str] = {}
+        self._next = {COUNTER: 0, GAUGE: 0, HISTOGRAM: 0}
+
+    def _register(
+        self, kind: str, name: str, help: str, labels: Mapping[str, str]
+    ) -> MetricHandle:
+        label_items = tuple(sorted((labels or {}).items()))
+        key = (name, label_items)
+        if key in self._by_key:
+            existing = self._by_key[key]
+            if existing.kind != kind:
+                raise ValueError(
+                    f"{name} already registered as {existing.kind}"
+                )
+            return existing
+        if self._kind_of_name.setdefault(name, kind) != kind:
+            raise ValueError(
+                f"{name} series already registered as "
+                f"{self._kind_of_name[name]}"
+            )
+        handle = MetricHandle(
+            name=name,
+            kind=kind,
+            index=self._next[kind],
+            help=help,
+            labels=label_items,
+        )
+        self._next[kind] += 1
+        self._handles.append(handle)
+        self._by_key[key] = handle
+        return handle
+
+    def counter(self, name: str, help: str = "", **labels) -> MetricHandle:
+        return self._register(COUNTER, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", **labels) -> MetricHandle:
+        return self._register(GAUGE, name, help, labels)
+
+    def histogram(self, name: str, help: str = "", **labels) -> MetricHandle:
+        return self._register(HISTOGRAM, name, help, labels)
+
+    @property
+    def handles(self) -> tuple[MetricHandle, ...]:
+        return tuple(self._handles)
+
+    def counts(self) -> tuple[int, int, int]:
+        return (
+            self._next[COUNTER],
+            self._next[GAUGE],
+            self._next[HISTOGRAM],
+        )
+
+    def create_table(self) -> MetricsTable:
+        c, g, h = self.counts()
+        return MetricsTable.create(c, g, h, np.asarray(self.bounds))
+
+
+# ── the hypervisor schema ────────────────────────────────────────────
+# One module-level registry: handle indices are compile-time constants
+# inside the jitted waves (ops reference `HANDLE.index` directly), and
+# every HypervisorState's table shares this layout.
+
+REGISTRY = MetricsRegistry()
+
+# Wave/tick counters (device-written inside the jitted programs).
+WAVE_TICKS = REGISTRY.counter(
+    "hv_governance_wave_ticks_total", "full-pipeline waves dispatched"
+)
+ADMITTED = REGISTRY.counter(
+    "hv_admission_admitted_total", "join lanes admitted (ADMIT_OK)"
+)
+REFUSED = REGISTRY.counter(
+    "hv_admission_refused_total", "join lanes refused (any ADMIT_* error)"
+)
+SESSIONS_ARCHIVED = REGISTRY.counter(
+    "hv_sessions_archived_total", "sessions archived by terminate waves"
+)
+BONDS_RELEASED = REGISTRY.counter(
+    "hv_bonds_released_total", "vouch bonds released at terminate"
+)
+SAGA_STEPS_COMMITTED = REGISTRY.counter(
+    "hv_saga_steps_committed_total", "saga step executions committed"
+)
+SAGA_STEPS_FAILED = REGISTRY.counter(
+    "hv_saga_steps_failed_total", "saga step executions failed (post-retry)"
+)
+GATEWAY_ALLOWED = REGISTRY.counter(
+    "hv_gateway_actions_allowed_total", "per-action gateway verdicts: allowed"
+)
+GATEWAY_DENIED = REGISTRY.counter(
+    "hv_gateway_actions_denied_total", "per-action gateway verdicts: denied"
+)
+SLASHED = REGISTRY.counter(
+    "hv_liability_slashed_total", "agents blacklisted by slash cascades"
+)
+CLIPPED = REGISTRY.counter(
+    "hv_liability_clipped_total", "vouchers clipped by slash cascades"
+)
+EVENTS_MIRRORED = REGISTRY.counter(
+    "hv_events_mirrored_total",
+    "host bus events mirrored into the device EventLog",
+)
+
+# Occupancy gauges (device-computed at snapshot, `update_gauges`).
+RING_AGENTS = tuple(
+    REGISTRY.gauge(
+        "hv_agents_in_ring", "active agent rows per execution ring",
+        ring=str(r),
+    )
+    for r in range(4)
+)
+AGENTS_ACTIVE = REGISTRY.gauge(
+    "hv_agent_rows_active", "live agent rows (FLAG_ACTIVE)"
+)
+QUARANTINED = REGISTRY.gauge(
+    "hv_agents_quarantined", "agent rows in read-only isolation"
+)
+BREAKER_TRIPPED = REGISTRY.gauge(
+    "hv_agents_breaker_tripped", "agent rows with a tripped circuit breaker"
+)
+SESSIONS_LIVE = REGISTRY.gauge(
+    "hv_sessions_live", "sessions in HANDSHAKING or ACTIVE"
+)
+VOUCH_EDGES_ACTIVE = REGISTRY.gauge(
+    "hv_vouch_edges_active", "live liability edges"
+)
+
+#: Stage names (shared with the `hv.<stage>` profiler spans): each gets
+#: a latency histogram, host-bracketed around the dispatched wave.
+STAGES: tuple[str, ...] = (
+    "governance_wave",
+    "governance_wave_sharded",
+    "admission_wave",
+    "saga_round",
+    "slash_cascade",
+    "gateway_wave",
+    "gateway_wave_sharded",
+    "breach_sweep",
+    "delta_chain",
+    "terminate_wave",
+    "reconcile_wave_sessions",
+)
+STAGE_LATENCY: dict[str, MetricHandle] = {
+    stage: REGISTRY.histogram(
+        "hv_stage_latency_us",
+        "host wall-clock of one dispatched device wave, microseconds",
+        stage=stage,
+    )
+    for stage in STAGES
+}
+#: Device-written size histogram: lanes per governance/admission wave.
+WAVE_LANES = REGISTRY.histogram(
+    "hv_wave_lanes", "join lanes per dispatched admission/governance wave"
+)
+
+
+# ── host object: device table + host mirror + drain ──────────────────
+
+
+class Metrics:
+    """One deployment's metrics plane.
+
+    Owns the device `MetricsTable` (pass `.table` into waves, rebind via
+    `.commit(...)`) and a host-plane mirror with the SAME row layout for
+    samples that never touch the device: wall-clock stage latencies
+    (there is no device clock to read inside a wave) and tallies from
+    paths that already sync to host. `snapshot()` merges both planes.
+
+    Thread-safety: host-plane mutations and table rebinds take the
+    lock; device-side accumulation is functional (the wave returns a
+    new table) so it needs none.
+    """
+
+    def __init__(self, registry: MetricsRegistry = REGISTRY) -> None:
+        self.registry = registry
+        c, g, h = registry.counts()
+        nb = len(registry.bounds) + 1
+        self._lock = threading.Lock()
+        # Serializes whole drains (device_get + wrap accounting): two
+        # racing snapshots could otherwise account a STALE raw read
+        # after a fresher one, producing a bogus mod-2^32 delta.
+        self._drain_lock = threading.Lock()
+        self.table = registry.create_table()
+        self._bounds = np.asarray(registry.bounds, np.float64)
+        # Host plane (int64: no wrap handling needed here). Gauges have
+        # no host plane: every registered gauge is device-recomputed by
+        # `update_gauges` at snapshot, and a summed merge would double-
+        # count a level value (unlike the disjoint counter/histogram
+        # sources).
+        self._h_counters = np.zeros(max(c, 1), np.int64)
+        self._h_hist = np.zeros((max(h, 1), nb), np.int64)
+        self._h_sum = np.zeros(max(h, 1), np.float64)
+        # Device-plane wrap accounting: last raw u32 seen + cumulative.
+        self._d_counters_raw = np.zeros(max(c, 1), np.uint32)
+        self._d_counters_cum = np.zeros(max(c, 1), np.int64)
+        self._d_hist_raw = np.zeros((max(h, 1), nb), np.uint32)
+        self._d_hist_cum = np.zeros((max(h, 1), nb), np.int64)
+
+    # ── device side ──────────────────────────────────────────────────
+
+    def commit(self, table: MetricsTable) -> None:
+        """Rebind the device table after a wave returned the update."""
+        with self._lock:
+            self.table = table
+
+    # ── host side ────────────────────────────────────────────────────
+
+    def inc(self, handle: MetricHandle, n: int = 1) -> None:
+        with self._lock:
+            self._h_counters[handle.index] += n
+
+    def observe_us(self, handle: MetricHandle, us: float) -> None:
+        """Record one host-plane histogram sample (microseconds)."""
+        b = int(np.searchsorted(self._bounds, us, side="left"))
+        with self._lock:
+            self._h_hist[handle.index, b] += 1
+            self._h_sum[handle.index] += us
+
+    def stage(self, name: str) -> "_StageTimer":
+        """Bracket one dispatched wave: profiler span + latency sample.
+
+        The span and the histogram share the stage name (`hv.<name>` on
+        the device timeline), so captures and scrapes correlate. The
+        sample measures dispatch-to-return wall clock — device latency
+        when the caller blocks inside the bracket (bench harnesses do),
+        dispatch+queue cost on the async runtime paths.
+        """
+        return _StageTimer(self, STAGE_LATENCY[name], name)
+
+    # ── drain ────────────────────────────────────────────────────────
+
+    def snapshot(self, refresh=None) -> "MetricsSnapshot":
+        """Merge both planes into an immutable snapshot.
+
+        ONE `jax.device_get` of the whole table — the only device
+        round-trip in the metrics plane, and it happens here, outside
+        every wave. Idempotent: draining twice without traffic yields
+        identical values (u32 wrap deltas are accumulated into host
+        int64 cumulatives keyed on the last raw value seen).
+
+        `refresh` (MetricsTable -> MetricsTable) lets the caller drain
+        a derived view — e.g. a gauge recompute — WITHOUT committing
+        it: the snapshot path never writes `self.table`, or a scrape
+        racing a wave's read-dispatch-commit would clobber the wave's
+        counts with a stale table. NOTE: the occupancy gauges are ONLY
+        populated through such a refresh (`update_gauges` needs the
+        state tables this object doesn't hold) — drain through
+        `HypervisorState.metrics_snapshot()` / `.metrics_prometheus()`
+        for live gauge values; a bare `snapshot()` exposes whatever the
+        last refreshless commit left, typically 0. The capture AND the drain both
+        happen under `_drain_lock`, so concurrent scrapes account
+        device raws in the order they were captured — an out-of-order
+        stale read would otherwise turn the mod-2^32 wrap delta into a
+        ~4.29e9 permanent jump on every counter.
+        """
+        import jax
+
+        with self._drain_lock:
+            with self._lock:
+                table = self.table
+                h_counters = self._h_counters.copy()
+                h_hist = self._h_hist.copy()
+                h_sum = self._h_sum.copy()
+            if refresh is not None:
+                table = refresh(table)
+            host = jax.device_get(table)
+            raw_c = np.asarray(host.counters, np.uint32)
+            raw_h = np.asarray(host.hist, np.uint32)
+            with self._lock:
+                # delta = (raw - last) mod 2^32: monotonic past u32 wrap.
+                self._d_counters_cum += (
+                    raw_c - self._d_counters_raw
+                ).astype(np.uint32)
+                self._d_counters_raw = raw_c
+                self._d_hist_cum += (raw_h - self._d_hist_raw).astype(np.uint32)
+                self._d_hist_raw = raw_h
+                counters = self._d_counters_cum + h_counters
+                hist = self._d_hist_cum + h_hist
+        gauges = np.asarray(host.gauges, np.float64)
+        hist_sum = np.asarray(host.hist_sum, np.float64) + h_sum
+        return MetricsSnapshot(
+            registry=self.registry,
+            counters=counters,
+            gauges=gauges,
+            hist=hist,
+            hist_sum=hist_sum,
+            bounds=self._bounds.copy(),
+            taken_at=time.time(),
+        )
+
+    def to_prometheus(self) -> str:
+        return self.snapshot().to_prometheus()
+
+
+class _StageTimer:
+    """Context manager: profiling span + wall-clock histogram sample."""
+
+    def __init__(self, metrics: Metrics, handle: MetricHandle, name: str):
+        self._metrics = metrics
+        self._handle = handle
+        self._name = name
+        self._span = None
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_StageTimer":
+        from hypervisor_tpu.observability import profiling
+
+        self._span = profiling.span(f"hv.{self._name}")
+        self._span.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dt_us = (time.perf_counter() - self._t0) * 1e6
+        self._span.__exit__(exc_type, exc, tb)
+        # A raising wave never completed: recording its partial elapsed
+        # time would pollute the latency quantiles operators alert on.
+        if exc_type is None:
+            self._metrics.observe_us(self._handle, dt_us)
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricsSnapshot:
+    """Immutable merged view of both planes at one drain."""
+
+    registry: MetricsRegistry
+    counters: np.ndarray  # i64[C]
+    gauges: np.ndarray    # f64[G]
+    hist: np.ndarray      # i64[H, NB]
+    hist_sum: np.ndarray  # f64[H]
+    bounds: np.ndarray    # f64[NB-1]
+    taken_at: float
+
+    def counter(self, handle: MetricHandle) -> int:
+        return int(self.counters[handle.index])
+
+    def gauge(self, handle: MetricHandle) -> float:
+        return float(self.gauges[handle.index])
+
+    def hist_count(self, handle: MetricHandle) -> int:
+        return int(self.hist[handle.index].sum())
+
+    def quantile(self, handle: MetricHandle, q: float) -> float:
+        """Prometheus-style bucket quantile (linear within the bucket).
+
+        Returns 0.0 for an empty histogram; samples in the +Inf
+        overflow bucket resolve to the highest finite bound (the same
+        clamp `histogram_quantile` applies).
+        """
+        counts = self.hist[handle.index]
+        total = counts.sum()
+        if total == 0:
+            return 0.0
+        target = q * total
+        cum = np.cumsum(counts)
+        b = int(np.searchsorted(cum, target, side="left"))
+        if b >= len(self.bounds):
+            return float(self.bounds[-1])
+        lo = 0.0 if b == 0 else float(self.bounds[b - 1])
+        hi = float(self.bounds[b])
+        prev = 0 if b == 0 else int(cum[b - 1])
+        frac = (target - prev) / max(int(counts[b]), 1)
+        return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+
+    def to_prometheus(self) -> str:
+        """Prometheus/OpenMetrics text exposition (version 0.0.4)."""
+        lines: list[str] = []
+        seen_header: set[str] = set()
+
+        def header(name: str, kind: str, help: str) -> None:
+            if name in seen_header:
+                return
+            seen_header.add(name)
+            if help:
+                lines.append(f"# HELP {name} {help}")
+            lines.append(f"# TYPE {name} {kind}")
+
+        for h in self.registry.handles:
+            if h.kind == COUNTER:
+                header(h.name, COUNTER, h.help)
+                lines.append(
+                    f"{h.name}{h.label_str()} {int(self.counters[h.index])}"
+                )
+            elif h.kind == GAUGE:
+                header(h.name, GAUGE, h.help)
+                lines.append(
+                    f"{h.name}{h.label_str()} {_fmt(self.gauges[h.index])}"
+                )
+            else:
+                header(h.name, HISTOGRAM, h.help)
+                base = dict(h.labels)
+                cum = 0
+                for b, bound in enumerate(self.bounds):
+                    cum += int(self.hist[h.index, b])
+                    lines.append(
+                        f"{h.name}_bucket{_labels(base, le=_fmt(bound))} {cum}"
+                    )
+                cum += int(self.hist[h.index, -1])
+                lines.append(
+                    f"{h.name}_bucket{_labels(base, le='+Inf')} {cum}"
+                )
+                lines.append(
+                    f"{h.name}_sum{_labels(base)} "
+                    f"{_fmt(self.hist_sum[h.index])}"
+                )
+                lines.append(f"{h.name}_count{_labels(base)} {cum}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def _labels(base: Mapping[str, str], **extra: str) -> str:
+    items = list(base.items()) + list(extra.items())
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+
+
+def tally_wave_host(
+    m: Metrics,
+    *,
+    status: np.ndarray,
+    step_state: np.ndarray,
+    fsm_err: np.ndarray,
+    sess_state: np.ndarray,
+    released: int,
+    lane_width: float,
+    n_waves: int = 1,
+) -> None:
+    """Mirror one dispatched wave's in-wave tallies on the host plane.
+
+    The sharded wave programs don't carry the metrics table (their
+    shard layout is unresolved), so the state bridge and the bench
+    mirror the SAME series here from synced wave outputs — one rule in
+    one place, or the bench's metrics report drifts from production
+    scrapes. `sess_state` is the post-wave state of the k real wave
+    sessions (the caller merges EVENTUAL partials when reconcile is
+    deferred); archived matches the in-wave count exactly: reached
+    ARCHIVED with no FSM error — memberless sessions never leave
+    CREATED (their FSM walk is masked, no error raised) and must not
+    count. `n_waves` scales identical repeated waves (bench loops).
+    """
+    from hypervisor_tpu.models import SessionState
+    from hypervisor_tpu.ops import admission, saga_ops
+
+    status = np.asarray(status)
+    step_state = np.asarray(step_state)
+    ok = int((status == admission.ADMIT_OK).sum())
+    committed = int((step_state == saga_ops.STEP_COMMITTED).sum())
+    failed = int((step_state == saga_ops.STEP_FAILED).sum())
+    archived = int(
+        (
+            (np.asarray(sess_state) == SessionState.ARCHIVED.code)
+            & ~np.asarray(fsm_err)
+        ).sum()
+    )
+    m.inc(WAVE_TICKS, n_waves)
+    m.inc(ADMITTED, ok * n_waves)
+    m.inc(REFUSED, (status.shape[0] - ok) * n_waves)
+    m.inc(SAGA_STEPS_COMMITTED, committed * n_waves)
+    m.inc(SAGA_STEPS_FAILED, failed * n_waves)
+    m.inc(SESSIONS_ARCHIVED, archived * n_waves)
+    m.inc(BONDS_RELEASED, int(released) * n_waves)
+    for _ in range(n_waves):
+        m.observe_us(WAVE_LANES, float(lane_width))
+
+
+def tally_gateway_host(m: Metrics, verdict, n_lanes: int) -> None:
+    """Mirror one sharded gateway dispatch's verdict counters on the
+    host plane — same series the single-device path counts in-wave,
+    shared by the standalone sharded gateway and the fused mesh wave."""
+    from hypervisor_tpu.ops import gateway as gateway_ops
+
+    n_allowed = int(
+        (np.asarray(verdict) == gateway_ops.GATE_ALLOWED).sum()
+    )
+    m.inc(GATEWAY_ALLOWED, n_allowed)
+    m.inc(GATEWAY_DENIED, n_lanes - n_allowed)
+
+
+# ── device-side gauge refresh (dispatched by the drain path) ─────────
+
+
+def update_gauges(metrics: MetricsTable, agents, sessions, vouches):
+    """Recompute occupancy gauges from the state tables, on device.
+
+    One jitted program over whole columns — dispatched by
+    `HypervisorState.metrics_snapshot()` right before the drain, never
+    inside a wave.
+    """
+    import jax.numpy as jnp
+
+    from hypervisor_tpu.models import SessionState
+    from hypervisor_tpu.tables.metrics import gauge_set
+    from hypervisor_tpu.tables.state import (
+        FLAG_ACTIVE,
+        FLAG_BREAKER_TRIPPED,
+        FLAG_QUARANTINED,
+    )
+
+    flags = agents.flags
+    active = (flags & FLAG_ACTIVE) != 0
+    m = metrics
+    for r, handle in enumerate(RING_AGENTS):
+        m = gauge_set(
+            m, handle.index,
+            jnp.sum((active & (agents.ring == r)).astype(jnp.int32)),
+        )
+    m = gauge_set(
+        m, AGENTS_ACTIVE.index, jnp.sum(active.astype(jnp.int32))
+    )
+    m = gauge_set(
+        m, QUARANTINED.index,
+        jnp.sum((active & ((flags & FLAG_QUARANTINED) != 0)).astype(jnp.int32)),
+    )
+    m = gauge_set(
+        m, BREAKER_TRIPPED.index,
+        jnp.sum(
+            (active & ((flags & FLAG_BREAKER_TRIPPED) != 0)).astype(jnp.int32)
+        ),
+    )
+    live = (sessions.sid >= 0) & (
+        (sessions.state == SessionState.HANDSHAKING.code)
+        | (sessions.state == SessionState.ACTIVE.code)
+    )
+    m = gauge_set(m, SESSIONS_LIVE.index, jnp.sum(live.astype(jnp.int32)))
+    m = gauge_set(
+        m, VOUCH_EDGES_ACTIVE.index,
+        jnp.sum(vouches.active.astype(jnp.int32)),
+    )
+    return m
+
+
+def iter_stage_quantiles(
+    snap: MetricsSnapshot, qs: tuple[float, ...] = (0.5, 0.95)
+) -> Iterator[tuple[str, int, tuple[float, ...]]]:
+    """(stage, sample_count, quantiles_us) per stage with samples."""
+    for stage, handle in STAGE_LATENCY.items():
+        n = snap.hist_count(handle)
+        if n:
+            yield stage, n, tuple(snap.quantile(handle, q) for q in qs)

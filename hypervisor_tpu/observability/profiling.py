@@ -92,3 +92,17 @@ def span(name: str):
 def step_span(name: str, step: int):
     """Annotation grouping one full governance tick as a profiler step."""
     return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+def stage_scope(name: str):
+    """In-trace twin of `span`: names a region INSIDE a jitted program.
+
+    `span`/`step_span` are host-side brackets around a dispatch;
+    `stage_scope` is `jax.named_scope`, so the ops inside carry
+    `hv.<name>` through lowering and show under that name in captured
+    XLA/TPU traces. Waves use the SAME stage names as their latency
+    histograms (`observability.metrics.STAGE_LATENCY`), so a Perfetto
+    capture, a `/metrics` scrape, and a span log all correlate on one
+    vocabulary. Free at runtime — names exist only in program metadata.
+    """
+    return jax.named_scope(f"hv.{name}")

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from hypervisor_tpu.config import DEFAULT_CONFIG, TrustConfig
 from hypervisor_tpu.ops import rings as ring_ops
+from hypervisor_tpu.tables.metrics import MetricsTable
 from hypervisor_tpu.tables.state import (
     AgentTable,
     FLAG_ACTIVE,
@@ -141,6 +142,7 @@ class AdmissionResult(NamedTuple):
     status: jnp.ndarray     # i8[B]
     ring: jnp.ndarray       # i8[B]
     sigma_eff: jnp.ndarray  # f32[B]
+    metrics: MetricsTable | None = None  # updated when a table rode in
 
 
 def admit_batch(
@@ -158,6 +160,7 @@ def admit_batch(
     omega: jnp.ndarray | float = 0.0,
     ring_bursts: jnp.ndarray | None = None,   # f32[4] configured bucket bursts
     unique_sessions: bool = False,
+    metrics: MetricsTable | None = None,
 ) -> AdmissionResult:
     """Admit a wave of B agents; rejected elements leave no trace.
 
@@ -172,6 +175,11 @@ def admit_batch(
     one-join-per-session wave qualifies; `state.py` verifies among
     non-duplicate lanes). A violating wave would over-admit: callers
     must gate on the host check, like `wave_range`.
+
+    With `metrics` (a MetricsTable riding the wave), the admitted and
+    refused lane counts plus the wave-size histogram accumulate
+    in-wave — pure scatter adds on the metrics columns, no host
+    transfer — and the updated table returns on the result.
     """
     # One row gather per packed block instead of one per column
     # (tables/state.py SessionTable packing): the [B, 5] i32 rows carry
@@ -257,10 +265,27 @@ def admit_batch(
             )
         ].add(1, mode="drop"),
     )
+    if metrics is not None:
+        from hypervisor_tpu.observability import metrics as metrics_schema
+        from hypervisor_tpu.tables import metrics as metrics_ops
+
+        n_ok = jnp.sum(ok.astype(jnp.int32))
+        metrics = metrics_ops.counter_inc(
+            metrics, metrics_schema.ADMITTED.index, n_ok
+        )
+        metrics = metrics_ops.counter_inc(
+            metrics, metrics_schema.REFUSED.index, b - n_ok
+        )
+        metrics = metrics_ops.observe(
+            metrics,
+            metrics_schema.WAVE_LANES.index,
+            jnp.full((1,), b, jnp.float32),
+        )
     return AdmissionResult(
         agents=new_agents,
         sessions=new_sessions,
         status=status,
         ring=ring,
         sigma_eff=sigma_eff,
+        metrics=metrics,
     )

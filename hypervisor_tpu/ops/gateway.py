@@ -52,6 +52,7 @@ from hypervisor_tpu.config import (
 from hypervisor_tpu.ops import rate_limit as rate_ops
 from hypervisor_tpu.ops import rings as ring_ops
 from hypervisor_tpu.ops import security_ops
+from hypervisor_tpu.tables.metrics import MetricsTable
 from hypervisor_tpu.tables.state import (
     AgentTable,
     ElevationTable,
@@ -111,6 +112,7 @@ class GatewayResult(NamedTuple):
     anomaly_rate: jnp.ndarray  # f32[B] window anomaly rate at this record
     window_calls: jnp.ndarray  # i32[B] window total at this record
     tripped: jnp.ndarray       # bool[B] records that tripped the breaker
+    metrics: "MetricsTable | None" = None  # updated when a table rode in
 
 
 def check_actions(
@@ -128,6 +130,7 @@ def check_actions(
     breach: BreachConfig = DEFAULT_CONFIG.breach,
     rate_limit: RateLimitConfig = DEFAULT_CONFIG.rate_limit,
     trust: TrustConfig = DEFAULT_CONFIG.trust,
+    metrics: MetricsTable | None = None,
 ) -> GatewayResult:
     """Run B actions through every per-action gate in one program.
 
@@ -300,6 +303,19 @@ def check_actions(
             jnp.float32
         ),
     )
+    if metrics is not None:
+        from hypervisor_tpu.observability import metrics as metrics_schema
+        from hypervisor_tpu.tables import metrics as metrics_ops
+
+        n_allowed = jnp.sum(allowed.astype(jnp.int32))
+        metrics = metrics_ops.counter_inc(
+            metrics, metrics_schema.GATEWAY_ALLOWED.index, n_allowed
+        )
+        metrics = metrics_ops.counter_inc(
+            metrics,
+            metrics_schema.GATEWAY_DENIED.index,
+            jnp.sum(valid.astype(jnp.int32)) - n_allowed,
+        )
     return GatewayResult(
         agents=new_agents,
         verdict=verdict,
@@ -310,4 +326,5 @@ def check_actions(
         anomaly_rate=anomaly_rate.astype(jnp.float32),
         window_calls=total_i.astype(jnp.int32),
         tripped=trip_action,
+        metrics=metrics,
     )

@@ -22,6 +22,8 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from hypervisor_tpu.config import DEFAULT_CONFIG, TrustConfig
+from hypervisor_tpu.observability.profiling import stage_scope
+from hypervisor_tpu.tables.metrics import MetricsTable
 from hypervisor_tpu.tables.state import VouchTable
 
 
@@ -124,8 +126,10 @@ class SlashWaveResult(NamedTuple):
     slashed: jnp.ndarray      # bool[N] all agents blacklisted in any wave
     clipped: jnp.ndarray      # bool[N] all agents clipped in any wave
     wave_of: jnp.ndarray      # i8[N] cascade depth an agent was slashed at (-1 none)
+    metrics: "MetricsTable | None" = None  # updated when a table rode in
 
 
+@stage_scope("slash_cascade")
 def slash_cascade(
     vouch: VouchTable,
     sigma: jnp.ndarray,
@@ -135,6 +139,7 @@ def slash_cascade(
     now: jnp.ndarray | float,
     trust: TrustConfig = DEFAULT_CONFIG.trust,
     allreduce=None,
+    metrics: "MetricsTable | None" = None,
 ) -> SlashWaveResult:
     """Batched slash with depth-bounded cascade (`slashing.py:63-143`).
 
@@ -221,10 +226,27 @@ def slash_cascade(
 
     from hypervisor_tpu.tables.struct import replace
 
+    if metrics is not None:
+        # In-wave tallies (pure scatter adds, like the governance wave):
+        # agents blacklisted / vouchers clipped by THIS cascade.
+        from hypervisor_tpu.observability import metrics as metrics_schema
+        from hypervisor_tpu.tables import metrics as metrics_ops
+
+        metrics = metrics_ops.counter_inc(
+            metrics,
+            metrics_schema.SLASHED.index,
+            jnp.sum(slashed.astype(jnp.int32)),
+        )
+        metrics = metrics_ops.counter_inc(
+            metrics,
+            metrics_schema.CLIPPED.index,
+            jnp.sum(clipped_any.astype(jnp.int32)),
+        )
     return SlashWaveResult(
         sigma=sigma,
         vouch=replace(vouch, active=active),
         slashed=slashed,
         clipped=clipped_any,
         wave_of=wave_of,
+        metrics=metrics,
     )

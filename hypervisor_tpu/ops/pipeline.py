@@ -35,6 +35,7 @@ from hypervisor_tpu.ops import merkle as merkle_ops
 from hypervisor_tpu.ops import rings as ring_ops
 from hypervisor_tpu.ops import saga_ops
 from hypervisor_tpu.ops import session_fsm
+from hypervisor_tpu.tables.metrics import MetricsTable
 from hypervisor_tpu.tables.state import (
     AgentTable,
     SessionTable,
@@ -183,6 +184,7 @@ class WaveResult(NamedTuple):
     chain: jnp.ndarray          # u32[T, K, 8] the delta chain digests
     fsm_error: jnp.ndarray      # bool[K] illegal session walks (none expected)
     released: jnp.ndarray       # i32 bonds released at terminate
+    metrics: MetricsTable | None = None  # updated when a table rode in
 
 
 def governance_wave(
@@ -204,6 +206,7 @@ def governance_wave(
     ring_bursts: jnp.ndarray | None = None,
     wave_range: tuple[jnp.ndarray, jnp.ndarray] | None = None,
     unique_sessions: bool = False,
+    metrics: MetricsTable | None = None,
 ) -> WaveResult:
     """The full governance pipeline AS ONE PROGRAM over the state tables.
 
@@ -229,6 +232,14 @@ def governance_wave(
     dominant terminate cost at large K; see `ops.terminate`). The
     caller is responsible for the contiguity check (`state.py`
     verifies on host; bench.py's slots are arange by construction).
+
+    With `metrics` (a MetricsTable riding the wave), every phase tallies
+    itself in-wave — wave ticks, admitted/refused lanes, saga step
+    outcomes, sessions archived, bonds released — as pure scatter adds
+    on the metrics columns. No host transfer enters the program
+    (pinned by `tests/unit/test_metrics.py`); the updated table returns
+    on the result and is donated alongside the state tables in the
+    donated wave variant.
     """
     from hypervisor_tpu.ops import liability as liability_ops
     from hypervisor_tpu.ops import terminate as terminate_ops
@@ -260,8 +271,10 @@ def governance_wave(
         omega=omega,
         ring_bursts=ring_bursts,
         unique_sessions=unique_sessions,
+        metrics=metrics,
     )
     agents, sessions = admitted.agents, admitted.sessions
+    metrics = admitted.metrics
     ok = admitted.status == admission_ops.ADMIT_OK
 
     # ── 3. session FSM: HANDSHAKING -> ACTIVE where populated ────────
@@ -324,6 +337,36 @@ def governance_wave(
         ),
     )
 
+    fsm_err = err_a | err_t | err_z
+    if metrics is not None:
+        from hypervisor_tpu.observability import metrics as metrics_schema
+        from hypervisor_tpu.tables import metrics as metrics_ops
+
+        metrics = metrics_ops.counter_inc(
+            metrics, metrics_schema.WAVE_TICKS.index
+        )
+        metrics = metrics_ops.counter_inc(
+            metrics,
+            metrics_schema.SAGA_STEPS_COMMITTED.index,
+            jnp.sum((step_state == saga_ops.STEP_COMMITTED).astype(jnp.int32)),
+        )
+        metrics = metrics_ops.counter_inc(
+            metrics,
+            metrics_schema.SAGA_STEPS_FAILED.index,
+            jnp.sum((step_state == saga_ops.STEP_FAILED).astype(jnp.int32)),
+        )
+        metrics = metrics_ops.counter_inc(
+            metrics,
+            metrics_schema.SESSIONS_ARCHIVED.index,
+            jnp.sum(
+                (
+                    (wave_state == SessionState.ARCHIVED.code) & ~fsm_err
+                ).astype(jnp.int32)
+            ),
+        )
+        metrics = metrics_ops.counter_inc(
+            metrics, metrics_schema.BONDS_RELEASED.index, released
+        )
     return WaveResult(
         agents=agents,
         sessions=sessions,
@@ -334,6 +377,7 @@ def governance_wave(
         saga_step_state=step_state,
         merkle_root=roots,
         chain=chain,
-        fsm_error=err_a | err_t | err_z,
+        fsm_error=fsm_err,
         released=released,
+        metrics=metrics,
     )

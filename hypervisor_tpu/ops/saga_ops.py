@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from hypervisor_tpu.observability.profiling import stage_scope
 from hypervisor_tpu.ops.bits import matrix_bits_valid, pack_matrix_bits
 from hypervisor_tpu.saga.state_machine import (
     SAGA_TRANSITION_MATRIX,
@@ -128,6 +129,7 @@ def settle_sagas(step_state: jnp.ndarray, saga_state: jnp.ndarray) -> jnp.ndarra
     return out.astype(saga_state.dtype)
 
 
+@stage_scope("saga_round")
 def saga_table_tick(
     step_state: jnp.ndarray,    # i8[G, M]
     retries_left: jnp.ndarray,  # i8[G, M]
@@ -139,6 +141,7 @@ def saga_table_tick(
     undo_success: jnp.ndarray,  # bool[G] outcome for the compensation target
     exec_attempted: jnp.ndarray | None = None,  # bool[G] cursor step dispatched
     undo_attempted: jnp.ndarray | None = None,  # bool[G] undo target dispatched
+    metrics=None,  # MetricsTable riding the tick (None -> None returned)
 ):
     """Advance EVERY saga in the table by one scheduling round.
 
@@ -160,7 +163,10 @@ def saga_table_tick(
     failed compensation ("Joint Liability slashing triggered"), else
     COMPLETED. RUNNING sagas whose cursor passed the last step COMPLETE.
 
-    Returns (step_state, retries_left, saga_state, cursor) updated.
+    Returns (step_state, retries_left, saga_state, cursor, metrics)
+    updated — the fifth element is the updated MetricsTable when one
+    rode in (step commit/fail tallies accumulate in-tick, pure scatter
+    adds with no host transfer), else None.
     """
     g, m = step_state.shape
     rows = jnp.arange(g, dtype=jnp.int32)
@@ -230,7 +236,22 @@ def saga_table_tick(
         jnp.where(settled, SAGA_COMPLETED, saga_state),
     ).astype(saga_state.dtype)
 
-    return step_state, retries_left, saga_state, cursor
+    if metrics is None:
+        return step_state, retries_left, saga_state, cursor, None
+    from hypervisor_tpu.observability import metrics as metrics_schema
+    from hypervisor_tpu.tables import metrics as metrics_ops
+
+    metrics = metrics_ops.counter_inc(
+        metrics,
+        metrics_schema.SAGA_STEPS_COMMITTED.index,
+        jnp.sum(committed.astype(jnp.int32)),
+    )
+    metrics = metrics_ops.counter_inc(
+        metrics,
+        metrics_schema.SAGA_STEPS_FAILED.index,
+        jnp.sum(exhausted.astype(jnp.int32)),
+    )
+    return step_state, retries_left, saga_state, cursor, metrics
 
 
 def saga_table_done(saga_state: jnp.ndarray, session: jnp.ndarray) -> jnp.ndarray:

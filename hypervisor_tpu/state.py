@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.models import SessionConfig, SessionState
 from hypervisor_tpu.observability import profiling
+from hypervisor_tpu.observability import metrics as metrics_plane
 from hypervisor_tpu.ops import admission, rate_limit, saga_ops, security_ops
 from hypervisor_tpu.ops import gateway as gateway_ops
 from hypervisor_tpu.ops import liability as liability_ops
@@ -61,7 +62,8 @@ _WAVE = jax.jit(
     pipeline_ops.governance_wave,
     static_argnames=("use_pallas", "unique_sessions"),
 )
-# Donated twin: the three table arguments alias into the outputs, so
+# Donated twin: the three table arguments (and the metrics table, which
+# rides the wave like any other table) alias into the outputs, so
 # XLA updates them in place instead of materialising a second copy of
 # every column in HBM. RE-STAGING CONTRACT: after a donated wave the
 # PRE-wave table pytrees are dead buffers — HypervisorState holds the
@@ -75,7 +77,7 @@ _WAVE = jax.jit(
 _WAVE_DONATED = jax.jit(
     pipeline_ops.governance_wave,
     static_argnames=("use_pallas", "unique_sessions"),
-    donate_argnums=(0, 1, 2),
+    donate_argnames=("agents", "sessions", "vouches", "metrics"),
 )
 _RECORD_CALLS = jax.jit(
     security_ops.record_calls, static_argnames=("config",)
@@ -94,6 +96,19 @@ _GATEWAY = jax.jit(
     gateway_ops.check_actions,
     static_argnames=("breach", "rate_limit", "trust"),
 )
+_UPDATE_GAUGES = jax.jit(metrics_plane.update_gauges)
+
+
+@jax.jit
+def _MERGE_WAVE_SESSION_STATES(owned, state, sessions_state, k_idx):
+    """[k] post-wave session states for the mesh-path metrics tally:
+    EVENTUAL lanes' masked partials overwrites where owned, else the
+    replicated table's STRONG-folded column — fused into ONE cached
+    program so the tally costs a single small device->host sync."""
+    owned_e = jnp.sum(owned[:, k_idx], axis=0) > 0
+    state_e = jnp.sum(state[:, k_idx], axis=0)
+    state_s = jnp.take(sessions_state, k_idx).astype(jnp.int32)
+    return jnp.where(owned_e, state_e, state_s)
 
 
 def _isolation_refusal_from(
@@ -167,6 +182,11 @@ class HypervisorState:
         self.elevations = ElevationTable.create(cap.max_elevations)
         self.delta_log = DeltaLog.create(cap.delta_log_capacity)
         self.event_log = EventLog.create(cap.event_log_capacity)
+        # Device-resident metrics plane (counters/gauges/histograms the
+        # jitted waves scatter into) + its host drain. Waves thread
+        # `self.metrics.table` through and commit the returned update;
+        # `metrics_snapshot()` is the ONE device_get, outside every wave.
+        self.metrics = metrics_plane.Metrics()
 
         self.agent_ids = InternTable()
         self.session_ids = InternTable()
@@ -574,15 +594,18 @@ class HypervisorState:
                 flat, valid, device_args = self._gateway_shard_args(
                     act, mesh.devices.size
                 )
-                with profiling.span("hv.governance_wave_sharded"):
+                with self.metrics.stage("governance_wave_sharded"):
                     result, lanes, partials = wave_fn(
                         *wave_args, *range_args, self.elevations, *device_args
                     )
                 gw_result = self._scatter_gateway_lanes(
                     lanes, flat, valid, len(act["slots"]), result.agents
                 )
+                metrics_plane.tally_gateway_host(
+                    self.metrics, gw_result.verdict, len(act["slots"])
+                )
             else:
-                with profiling.span("hv.governance_wave_sharded"):
+                with self.metrics.stage("governance_wave_sharded"):
                     result, partials = wave_fn(*wave_args, *range_args)
             if b_wave != b or k_wave != k:
                 # Drop the internal padding lanes before any host
@@ -602,14 +625,16 @@ class HypervisorState:
                 if os.environ.get("HV_DONATE_TABLES") == "1"
                 else _WAVE
             )
-            with profiling.span("hv.governance_wave"):
+            with self.metrics.stage("governance_wave"):
                 result = wave(
                     *wave_args,
                     use_pallas=use_pallas,
                     ring_bursts=self._ring_bursts,
                     wave_range=wave_range,
                     unique_sessions=unique_sessions,
+                    metrics=self.metrics.table,
                 )
+            self.metrics.commit(result.metrics)
         self.agents = result.agents
         self.sessions = result.sessions
         self.vouches = result.vouches
@@ -622,7 +647,7 @@ class HypervisorState:
                 # exercised on every wave, not just mixed-mode runs).
                 # The partials stay on device: no host round-trip on
                 # the hot bridge path.
-                with profiling.span("hv.reconcile_wave_sessions"):
+                with self.metrics.stage("reconcile_wave_sessions"):
                     self.sessions = self._reconcile_fn(mesh)(
                         self.sessions, partials.counts, partials.owned,
                         partials.state, partials.terminated,
@@ -631,6 +656,32 @@ class HypervisorState:
         ok = np.asarray(result.status) == admission.ADMIT_OK
         # result.status was trimmed to [:b] above on the padded mesh
         # branch, so ok is exactly wave_keys-length on every path.
+        if mesh is not None:
+            # The sharded program doesn't carry the metrics table (its
+            # shard layout is unresolved); mirror EVERY wave series the
+            # single-device path counts in-wave on the host plane of
+            # the same metric rows (`tally_wave_host` holds the one
+            # shared rule set — docs/OPERATIONS.md promises this
+            # parity). The extra syncs are small (i8[B], bool[K], i8[K])
+            # next to the status sync already happening here. Post-wave
+            # session states: STRONG lanes folded into the replicated
+            # table in-wave; EVENTUAL lanes' masked overwrites ride the
+            # partials — merge both, gather the k real wave sessions.
+            sess_state = _MERGE_WAVE_SESSION_STATES(
+                partials.owned, partials.state,
+                result.sessions.state, jnp.asarray(wave_sessions[:k]),
+            )
+            metrics_plane.tally_wave_host(
+                self.metrics,
+                status=result.status,
+                step_state=result.saga_step_state,
+                fsm_err=result.fsm_error,
+                sess_state=np.asarray(sess_state),
+                released=int(result.released),
+                # In-wave observes the traced lane width per wave; the
+                # width dispatched here is the padded b_wave.
+                lane_width=b_wave,
+            )
         self._members.update(wave_keys[ok].tolist())
         # Every wave row is dead after the wave: rejected rows were
         # never admitted, admitted rows belong to sessions this same
@@ -791,7 +842,7 @@ class HypervisorState:
             dids = np.array([r[1] for r in rows], np.int32)
             duplicate = np.array([r[3] for r in rows], bool)
 
-            with profiling.span("hv.admission_wave"):
+            with self.metrics.stage("admission_wave"):
                 result = self._admit(
                     self.agents,
                     self.sessions,
@@ -803,7 +854,9 @@ class HypervisorState:
                     jnp.asarray(duplicate),
                     now,
                     ring_bursts=self._ring_bursts,
+                    metrics=self.metrics.table,
                 )
+            self.metrics.commit(result.metrics)
             self.agents = result.agents
             self.sessions = result.sessions
             status = np.asarray(result.status)
@@ -970,7 +1023,7 @@ class HypervisorState:
 
         n = self.agents.sigma_eff.shape[0]
         seeds = jnp.zeros((n,), bool).at[vouchee_slot].set(True)
-        with profiling.span("hv.slash_cascade"):
+        with self.metrics.stage("slash_cascade"):
             result = _SLASH(
                 self.vouches,
                 self.agents.sigma_eff,
@@ -978,7 +1031,9 @@ class HypervisorState:
                 session_slot,
                 risk_weight,
                 now,
+                metrics=self.metrics.table,
             )
+        self.metrics.commit(result.metrics)
         touched = result.slashed | result.clipped
         new_rings = ring_ops.compute_rings(result.sigma, False)
         self.agents = replace(
@@ -1264,19 +1319,23 @@ class HypervisorState:
         for slot, ok in (undo_outcomes or {}).items():
             undo_success[slot] = ok
             undo_attempted[slot] = True
-        with profiling.span("hv.saga_round"):
-            step_state, retries_left, saga_state, cursor = self._saga_tick(
-                self.sagas.step_state,
-                self.sagas.retries_left,
-                self.sagas.has_undo,
-                self.sagas.saga_state,
-                self.sagas.n_steps,
-                self.sagas.cursor,
-                jnp.asarray(exec_success),
-                jnp.asarray(undo_success),
-                jnp.asarray(exec_attempted),
-                jnp.asarray(undo_attempted),
+        with self.metrics.stage("saga_round"):
+            step_state, retries_left, saga_state, cursor, m_table = (
+                self._saga_tick(
+                    self.sagas.step_state,
+                    self.sagas.retries_left,
+                    self.sagas.has_undo,
+                    self.sagas.saga_state,
+                    self.sagas.n_steps,
+                    self.sagas.cursor,
+                    jnp.asarray(exec_success),
+                    jnp.asarray(undo_success),
+                    jnp.asarray(exec_attempted),
+                    jnp.asarray(undo_attempted),
+                    metrics=self.metrics.table,
+                )
             )
+        self.metrics.commit(m_table)
         self.sagas = replace(
             self.sagas,
             step_state=step_state,
@@ -1435,7 +1494,7 @@ class HypervisorState:
 
         valid = np.zeros((padded,), bool)
         valid[:b] = True
-        with profiling.span("hv.gateway_wave"):
+        with self.metrics.stage("gateway_wave"):
             result = _GATEWAY(
                 self.agents,
                 self.elevations,
@@ -1450,7 +1509,9 @@ class HypervisorState:
                 breach=self.config.breach,
                 rate_limit=self.config.rate_limit,
                 trust=self.config.trust,
+                metrics=self.metrics.table,
             )
+        self.metrics.commit(result.metrics)
         self.agents = result.agents
         return gateway_ops.GatewayResult(
             agents=result.agents,
@@ -1513,7 +1574,7 @@ class HypervisorState:
         n = len(self._pending_partials)
         fn = self._reconcile_fn(mesh)
         pending, self._pending_partials = self._pending_partials, []
-        with profiling.span("hv.reconcile_wave_sessions"):
+        with self.metrics.stage("reconcile_wave_sessions"):
             # One fold per wave, in wave order: masked overwrites from
             # different waves may target the SAME recycled session lane,
             # and summing two overwrites would corrupt both.
@@ -1657,16 +1718,18 @@ class HypervisorState:
                 trust=self.config.trust,
             )
             self._sharded_waves[("gateway", mesh)] = fn
-        with profiling.span("hv.gateway_wave_sharded"):
+        with self.metrics.stage("gateway_wave_sharded"):
             agents_out, lanes = fn(
                 self.agents, self.elevations, *device_args, now
             )
         self.agents = agents_out
-        return self._scatter_gateway_lanes(lanes, flat, valid, b, agents_out)
+        out = self._scatter_gateway_lanes(lanes, flat, valid, b, agents_out)
+        metrics_plane.tally_gateway_host(self.metrics, out.verdict, b)
+        return out
 
     def breach_sweep_tick(self, now: float) -> tuple[np.ndarray, np.ndarray]:
         """Run the batched breach analysis; returns (severity, tripped)."""
-        with profiling.span("hv.breach_sweep"):
+        with self.metrics.stage("breach_sweep"):
             result = _BREACH_SWEEP(self.agents, now, config=self.config.breach)
         self.agents = result.agents
         return np.asarray(result.severity), np.asarray(result.tripped)
@@ -1940,7 +2003,7 @@ class HypervisorState:
         bodies = np.zeros((t_max, lanes, merkle_ops.BODY_WORDS), np.uint32)
         bodies[t_pos, lane_idx] = packed
 
-        with profiling.span("hv.delta_chain"):
+        with self.metrics.stage("delta_chain"):
             digests = np.array(
                 merkle_ops.chain_digests(
                     jnp.asarray(bodies), jnp.asarray(seeds), use_pallas
@@ -2065,7 +2128,7 @@ class HypervisorState:
         # gathers, no [S_cap] mask scatter (ops/terminate.py wave_range).
         slot_arr = np.array(slots, np.int32)
         wave_range = _contiguous_range(slot_arr)
-        with profiling.span("hv.terminate_wave"):
+        with self.metrics.stage("terminate_wave"):
             result = self._terminate(
                 self.agents,
                 self.sessions,
@@ -2115,6 +2178,33 @@ class HypervisorState:
                 self._scrubbed_edges.extend(int(r) for r in rows)
             self._scrub_elevations_for_rows(reclaim)
         return np.asarray(result.roots)
+
+    # ── metrics drain ────────────────────────────────────────────────
+
+    def metrics_snapshot(self) -> "metrics_plane.MetricsSnapshot":
+        """Refresh occupancy gauges on device, then drain the plane.
+
+        The gauge refresh is one jitted program over whole table
+        columns; the drain is the metrics plane's single `device_get`.
+        Both happen here — between waves, never inside one. The
+        refreshed table is drained WITHOUT being committed: the
+        snapshot path stays read-only on `Metrics.table`, so a scrape
+        from another thread can never clobber a wave's
+        read-dispatch-commit with a stale table. (Exception: under
+        HV_DONATE_TABLES=1 the wave donates the metrics table buffer,
+        so a scrape truly concurrent with a wave dispatch can read a
+        deleted buffer — like every table read under donation, scrapes
+        must then be serialized with the wave driver.)
+        """
+        return self.metrics.snapshot(
+            refresh=lambda table: _UPDATE_GAUGES(
+                table, self.agents, self.sessions, self.vouches
+            )
+        )
+
+    def metrics_prometheus(self) -> str:
+        """Prometheus text exposition of the merged metrics plane."""
+        return self.metrics_snapshot().to_prometheus()
 
     # ── views ────────────────────────────────────────────────────────
 

@@ -1,6 +1,7 @@
 """HBM-resident state tables: the SoA substrate of the TPU-native runtime."""
 
 from hypervisor_tpu.tables.intern import InternTable
+from hypervisor_tpu.tables.metrics import MetricsTable
 from hypervisor_tpu.tables.struct import replace, table
 from hypervisor_tpu.tables.state import (
     AgentTable,
@@ -15,6 +16,7 @@ from hypervisor_tpu.tables.state import (
 
 __all__ = [
     "InternTable",
+    "MetricsTable",
     "replace",
     "table",
     "AgentTable",

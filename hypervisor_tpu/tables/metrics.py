@@ -1,0 +1,124 @@
+"""Device-resident metrics plane: counters, gauges, log-bucket histograms.
+
+The other tables hold governance *state*; this one holds *telemetry* the
+jitted waves write as they run. One row per metric, fixed capacities, so
+recording a sample inside a wave is pure array arithmetic — a scatter-add
+into HBM columns, no callback, no host sync, no data-dependent shapes.
+The host drains it with ONE `jax.device_get` outside the wave
+(`observability.metrics.Metrics.snapshot`), never inside.
+
+Layout (sized by the registry in `observability.metrics`):
+
+  counters u32[C]      monotonic event counts; wrap at 2^32 is handled
+                       by the host drain (delta-mod accumulation), so
+                       exposition stays monotonic past the wrap
+  gauges   f32[G]      last-write-wins level values (occupancy etc.)
+  hist     u32[H, NB]  per-histogram bucket counts; bucket b counts
+                       samples with value <= bounds[b] (Prometheus `le`
+                       semantics); the last bucket is +Inf overflow
+  hist_sum f32[H]      running sum of observed values (for `_sum`).
+                       KNOWN LIMIT: f32 accumulation saturates once the
+                       running sum's ulp exceeds the per-wave increment
+                       (~2^24 × typical sample; ~16M waves of 64-lane
+                       samples). Bucket counts (u32, wrap-accounted by
+                       the drain) and the quantiles derived from them
+                       are unaffected; only `_sum`-based averages drift
+                       low on very long-lived deployments. Restart the
+                       deployment or rely on bucket quantiles there.
+  bounds   f32[NB-1]   shared log-spaced upper bounds (one layout for
+                       every histogram keeps the table rectangular)
+
+Like the governance tables, the metrics table is a jit-carried pytree the
+wave threads through: ops take it as an argument and return the updated
+table, and the donated wave variant donates it alongside the state tables
+so the update is in-place in HBM.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hypervisor_tpu.tables.struct import replace, table
+
+
+@table
+class MetricsTable:
+    """[C]/[G]/[H, NB] telemetry columns; row index == metric handle."""
+
+    counters: jnp.ndarray  # u32[C]
+    gauges: jnp.ndarray    # f32[G]
+    hist: jnp.ndarray      # u32[H, NB] bucket counts (last = +Inf)
+    hist_sum: jnp.ndarray  # f32[H]
+    bounds: jnp.ndarray    # f32[NB-1] shared upper bounds, ascending
+
+    @staticmethod
+    def create(
+        n_counters: int, n_gauges: int, n_hists: int, bounds
+    ) -> "MetricsTable":
+        bounds = jnp.asarray(bounds, jnp.float32)
+        nb = bounds.shape[0] + 1
+        return MetricsTable(
+            counters=jnp.zeros((max(n_counters, 1),), jnp.uint32),
+            gauges=jnp.zeros((max(n_gauges, 1),), jnp.float32),
+            hist=jnp.zeros((max(n_hists, 1), nb), jnp.uint32),
+            hist_sum=jnp.zeros((max(n_hists, 1),), jnp.float32),
+            bounds=bounds,
+        )
+
+
+def bucket_of(bounds: jnp.ndarray, values: jnp.ndarray) -> jnp.ndarray:
+    """i32 bucket index per value under Prometheus `le` semantics.
+
+    A value lands in the first bucket whose upper bound covers it
+    (value <= bounds[b]); values above every bound land in the +Inf
+    overflow bucket (index len(bounds)). Pure `searchsorted`, so the
+    same math serves jit traces and the host-plane mirror
+    (`observability.metrics` uses numpy's searchsorted identically).
+    """
+    return jnp.searchsorted(bounds, values, side="left").astype(jnp.int32)
+
+
+def counter_inc(
+    m: MetricsTable, idx, n: jnp.ndarray | int = 1
+) -> MetricsTable:
+    """Add `n` to counter row `idx` (scalar or i32[] traced count)."""
+    if isinstance(n, int):
+        n = jnp.uint32(n % (1 << 32))
+    return replace(
+        m,
+        counters=m.counters.at[idx].add(jnp.asarray(n).astype(jnp.uint32)),
+    )
+
+
+def gauge_set(m: MetricsTable, idx, value) -> MetricsTable:
+    """Set gauge row `idx` (last write wins)."""
+    return replace(
+        m, gauges=m.gauges.at[idx].set(jnp.asarray(value, jnp.float32))
+    )
+
+
+def observe(
+    m: MetricsTable,
+    hist_idx: int,
+    values: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+) -> MetricsTable:
+    """Record a batch of samples into histogram row `hist_idx`.
+
+    Masked-out lanes scatter out of bounds and are dropped by XLA — the
+    same reject idiom as the admission wave, so a ragged wave records
+    exactly its live lanes with no data-dependent shapes.
+    """
+    values = jnp.asarray(values, jnp.float32)
+    nb = m.hist.shape[1]
+    bucket = bucket_of(m.bounds, values)
+    if mask is not None:
+        bucket = jnp.where(mask, bucket, nb)  # OOB -> dropped
+        total = jnp.sum(jnp.where(mask, values, 0.0))
+    else:
+        total = jnp.sum(values)
+    return replace(
+        m,
+        hist=m.hist.at[hist_idx, bucket].add(1, mode="drop"),
+        hist_sum=m.hist_sum.at[hist_idx].add(total),
+    )

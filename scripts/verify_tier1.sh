@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Tier-1 gate, one invocation for builder and CI alike:
+#   1. the ROADMAP.md tier-1 pytest command (hermetic: CPU platform,
+#      no accelerator tunnel touched),
+#   2. a metrics-plane smoke check — drive one governance wave and
+#      assert the device counters moved and /metrics-style exposition
+#      renders.
+# Exits non-zero if either fails; prints DOTS_PASSED for trend tracking.
+
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+
+echo "── metrics-plane smoke check ──"
+JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.observability import metrics as mp
+from hypervisor_tpu.state import HypervisorState
+
+st = HypervisorState()
+slots = st.create_sessions_batch(["smoke:a", "smoke:b"],
+                                 SessionConfig(min_sigma_eff=0.0))
+st.run_governance_wave(
+    slots, ["did:smoke:0", "did:smoke:1"], slots.copy(),
+    np.full(2, 0.8, np.float32), np.zeros((1, 2, 16), np.uint32),
+)
+snap = st.metrics_snapshot()
+assert snap.counter(mp.WAVE_TICKS) == 1, snap.counter(mp.WAVE_TICKS)
+assert snap.counter(mp.ADMITTED) == 2, snap.counter(mp.ADMITTED)
+text = snap.to_prometheus()
+assert "# TYPE hv_governance_wave_ticks_total counter" in text
+assert "hv_stage_latency_us_bucket" in text
+print("metrics plane OK: wave ticked, counters drained, exposition renders")
+PY
+smoke_rc=$?
+
+if [ "$rc" -ne 0 ]; then
+    echo "tier-1 pytest FAILED (rc=$rc)" >&2
+    exit "$rc"
+fi
+if [ "$smoke_rc" -ne 0 ]; then
+    echo "metrics smoke check FAILED (rc=$smoke_rc)" >&2
+    exit "$smoke_rc"
+fi
+echo "tier-1 gate PASSED"

@@ -256,6 +256,19 @@ class TestFusedWaveWithGateway:
                 np.asarray(getattr(gw2, name)),
                 err_msg=name,
             )
+        # The metrics plane must agree too: the fused mesh path tallies
+        # gateway verdicts on the host plane of the same series the
+        # single-device path counts in-wave.
+        from hypervisor_tpu.observability import metrics as mp
+
+        snap1, snap2 = st1.metrics_snapshot(), st2.metrics_snapshot()
+        for handle in (mp.GATEWAY_ALLOWED, mp.GATEWAY_DENIED):
+            assert snap1.counter(handle) == snap2.counter(handle), handle
+        assert (
+            snap1.counter(mp.GATEWAY_ALLOWED)
+            + snap1.counter(mp.GATEWAY_DENIED)
+            == len(slots)
+        )
         # Standing rows live at the same slots on both paths, so their
         # gateway columns agree bit-for-bit.
         for st in (st1, st2):

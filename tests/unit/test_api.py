@@ -181,13 +181,15 @@ class TestHTTPTransport:
         # The reference's 21 endpoints plus /api/v1/device/stats (the
         # device-plane occupancy view the reference has no analog for),
         # the two quarantine views, the per-membership agent view, the
-        # leave/sweep pair, the per-action gateway, and its wave
-        # sibling (/actions/check-wave): 30 routes.
-        assert len(ROUTES) == 30
+        # leave/sweep pair, the per-action gateway, its wave
+        # sibling (/actions/check-wave), and the Prometheus scrape
+        # (/metrics): 31 routes.
+        assert len(ROUTES) == 31
         assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
         assert any(
             path == "/api/v1/security/quarantines" for _, path, _, _ in ROUTES
         )
+        assert any(path == "/metrics" for _, path, _, _ in ROUTES)
 
     def test_end_to_end_over_http(self):
         server = HypervisorHTTPServer().start()
@@ -232,6 +234,45 @@ class TestHTTPTransport:
 
             status, events = call("GET", "/api/v1/events?limit=2")
             assert status == 200 and len(events) == 2
+        finally:
+            server.stop()
+
+    def test_metrics_endpoint_serves_prometheus_text(self):
+        server = HypervisorHTTPServer().start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            # Drive some traffic so counters move.
+            def post(path, body=None):
+                data = json.dumps(body or {}).encode()
+                req = urllib.request.Request(
+                    base + path, data=data, method="POST",
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req) as resp:
+                    return json.loads(resp.read())
+
+            created = post("/api/v1/sessions", {"creator_did": "did:admin"})
+            post(
+                f"/api/v1/sessions/{created['session_id']}/join",
+                {"agent_did": "did:prom", "sigma_raw": 0.8},
+            )
+
+            with urllib.request.urlopen(base + "/metrics") as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                body = resp.read().decode()
+            lines = body.splitlines()
+            assert "# TYPE hv_governance_wave_ticks_total counter" in lines
+            assert "# TYPE hv_stage_latency_us histogram" in lines
+            # Every sample line is `name{labels} value` with a numeric value.
+            for line in lines:
+                if line.startswith("#"):
+                    continue
+                float(line.rsplit(" ", 1)[1])  # must parse
+            # The facade join runs the admission wave: counters moved.
+            assert any(
+                line.startswith("hv_agent_rows_active 1") for line in lines
+            )
         finally:
             server.stop()
 

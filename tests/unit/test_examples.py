@@ -22,3 +22,23 @@ def test_multichip_example_runs():
     finally:
         sys.argv = argv
         sys.path[:] = path_snapshot
+
+
+def test_metrics_watch_example_runs(capsys):
+    """examples/metrics_watch.py drives a wave and renders one frame."""
+    argv, sys.argv = sys.argv, ["metrics_watch", "--sessions", "8"]
+    path_snapshot = list(sys.path)
+    try:
+        try:
+            runpy.run_path(
+                os.path.join(_EXAMPLES, "metrics_watch.py"),
+                run_name="__main__",
+            )
+        except SystemExit as e:
+            assert e.code == 0
+    finally:
+        sys.argv = argv
+        sys.path[:] = path_snapshot
+    out = capsys.readouterr().out
+    assert "hv_governance_wave_ticks_total" in out
+    assert "stage latency" in out

@@ -1,0 +1,416 @@
+"""The device-resident metrics plane (tables/metrics + observability/metrics).
+
+Pins the four contracts the plane is built on:
+
+  * bucket math — Prometheus `le` semantics on the shared log-spaced
+    bounds, identical between the jit path (`tables.metrics.bucket_of`)
+    and the host mirror (numpy searchsorted),
+  * in-jit accumulation — counters/histograms update under `jax.jit`
+    with NO host transfer in the lowered program (the traced governance
+    wave contains no callback/infeed/outfeed primitive),
+  * drain — `snapshot()` is idempotent, monotonic across u32 wrap, and
+    merges the host and device planes,
+  * exposition — valid Prometheus text (cumulative buckets, +Inf ==
+    count, one TYPE per series) — plus the event-bus parity guard:
+    the device EventLog row count and the metrics-plane mirror counter
+    agree for the same traffic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.observability import metrics as mp
+from hypervisor_tpu.tables import metrics as mt
+
+
+def fresh_metrics() -> mp.Metrics:
+    return mp.Metrics()
+
+
+class TestBucketMath:
+    def test_le_semantics(self):
+        bounds = jnp.asarray([1.0, 2.0, 4.0, 8.0], jnp.float32)
+        vals = jnp.asarray([0.5, 1.0, 1.5, 2.0, 8.0, 9.0], jnp.float32)
+        idx = np.asarray(mt.bucket_of(bounds, vals))
+        # value == bound lands in that bound's bucket (le semantics);
+        # values above every bound land in the overflow bucket.
+        assert idx.tolist() == [0, 0, 1, 1, 3, 4]
+
+    def test_host_and_device_bucketing_agree(self):
+        bounds = np.asarray(mp.DEFAULT_BUCKET_BOUNDS_US)
+        rng = np.random.RandomState(7)
+        vals = rng.uniform(0.1, 4e7, 256).astype(np.float32)
+        dev = np.asarray(mt.bucket_of(jnp.asarray(bounds, jnp.float32),
+                                      jnp.asarray(vals)))
+        host = np.searchsorted(bounds, vals, side="left")
+        assert (dev == host).all()
+
+    def test_default_bounds_are_log_spaced_and_ascending(self):
+        b = np.asarray(mp.DEFAULT_BUCKET_BOUNDS_US)
+        assert (np.diff(b) > 0).all()
+        assert np.allclose(b[1:] / b[:-1], 2.0)
+
+
+class TestInJitAccumulate:
+    def test_counter_inc_under_jit(self):
+        table = mp.REGISTRY.create_table()
+
+        @jax.jit
+        def tick(m):
+            m = mt.counter_inc(m, mp.WAVE_TICKS.index)
+            return mt.counter_inc(m, mp.ADMITTED.index, 7)
+
+        out = tick(tick(table))
+        assert int(out.counters[mp.WAVE_TICKS.index]) == 2
+        assert int(out.counters[mp.ADMITTED.index]) == 14
+
+    def test_observe_under_jit_with_mask(self):
+        table = mp.REGISTRY.create_table()
+        h = mp.WAVE_LANES.index
+
+        @jax.jit
+        def record(m, vals, mask):
+            return mt.observe(m, h, vals, mask)
+
+        vals = jnp.asarray([1.0, 3.0, 1e9], jnp.float32)
+        mask = jnp.asarray([True, True, False])
+        out = record(table, vals, mask)
+        row = np.asarray(out.hist[h])
+        assert row.sum() == 2  # masked lane dropped
+        assert float(out.hist_sum[h]) == pytest.approx(4.0)
+
+    def test_observe_overflow_bucket(self):
+        table = mp.REGISTRY.create_table()
+        h = mp.WAVE_LANES.index
+        out = mt.observe(table, h, jnp.asarray([1e12], jnp.float32))
+        assert int(out.hist[h, -1]) == 1
+
+    def test_counter_wraps_as_uint32(self):
+        table = mp.REGISTRY.create_table()
+        near = mt.counter_inc(table, 0, 2**32 - 2)
+        wrapped = mt.counter_inc(near, 0, 5)
+        assert int(wrapped.counters[0]) == 3  # (2^32-2+5) mod 2^32
+
+
+class TestNoHostTransferInWave:
+    def test_governance_wave_with_metrics_lowers_clean(self):
+        """The acceptance gate: recording metrics inside the jitted wave
+        must introduce no host transfer — no callback, infeed, or
+        outfeed primitive anywhere in the traced program."""
+        from hypervisor_tpu.ops.pipeline import governance_wave
+        from hypervisor_tpu.tables.state import (
+            AgentTable, SessionTable, VouchTable,
+        )
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        b = 4
+        agents = AgentTable.create(16)
+        sessions = SessionTable.create(16)
+        sessions = t_replace(
+            sessions, state=sessions.state.at[:b].set(1)
+        )
+        vouches = VouchTable.create(8)
+        bodies = jnp.zeros((2, b, 16), jnp.uint32)
+        args = (
+            agents, sessions, vouches,
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.full((b,), 0.8, jnp.float32),
+            jnp.ones((b,), bool),
+            jnp.zeros((b,), bool),
+            jnp.arange(b, dtype=jnp.int32),
+            bodies,
+            0.0,
+        )
+        table = mp.REGISTRY.create_table()
+        jaxpr = jax.make_jaxpr(
+            lambda *a: governance_wave(*a, metrics=table, use_pallas=False)
+        )(*args)
+        text = str(jaxpr)
+        for forbidden in ("callback", "infeed", "outfeed"):
+            assert forbidden not in text, (
+                f"metrics recording pulled a {forbidden} into the wave"
+            )
+
+    def test_wave_records_expected_counts(self):
+        from hypervisor_tpu.models import SessionConfig
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        slots = st.create_sessions_batch(
+            ["m:a", "m:b"], SessionConfig(min_sigma_eff=0.0)
+        )
+        bodies = np.zeros((1, 2, 16), np.uint32)
+        st.run_governance_wave(
+            slots, ["did:m0", "did:m1"], slots.copy(),
+            np.full(2, 0.8, np.float32), bodies,
+        )
+        snap = st.metrics_snapshot()
+        assert snap.counter(mp.WAVE_TICKS) == 1
+        assert snap.counter(mp.ADMITTED) == 2
+        assert snap.counter(mp.REFUSED) == 0
+        assert snap.counter(mp.SESSIONS_ARCHIVED) == 2
+        assert snap.hist_count(mp.WAVE_LANES) == 1
+        # Host-plane stage latency recorded for the dispatched wave.
+        stage = mp.STAGE_LATENCY["governance_wave"]
+        assert snap.hist_count(stage) == 1
+
+    def test_stage_scope_names_survive_lowering(self):
+        """The saga/slash programs carry their histogram stage names
+        (`hv.<stage>` via `profiling.stage_scope`) into the compiled
+        program's op metadata, so profiler captures and `/metrics`
+        share one vocabulary."""
+        from hypervisor_tpu.ops import saga_ops
+
+        g, m = 2, 2
+        hlo = (
+            jax.jit(saga_ops.saga_table_tick)
+            .lower(
+                jnp.zeros((g, m), jnp.int8),
+                jnp.zeros((g, m), jnp.int8),
+                jnp.zeros((g, m), bool),
+                jnp.zeros((g,), jnp.int8),
+                jnp.full((g,), m, jnp.int32),
+                jnp.zeros((g,), jnp.int32),
+                jnp.zeros((g,), bool),
+                jnp.zeros((g,), bool),
+            )
+            .compile()
+            .as_text()
+        )
+        assert "hv.saga_round" in hlo
+
+    def test_saga_tick_metrics(self):
+        from hypervisor_tpu.ops import saga_ops
+
+        g, m = 4, 3
+        step_state = jnp.zeros((g, m), jnp.int8)
+        retries = jnp.zeros((g, m), jnp.int8)
+        has_undo = jnp.zeros((g, m), bool)
+        saga_state = jnp.full((g,), saga_ops.SAGA_RUNNING, jnp.int8)
+        n_steps = jnp.full((g,), m, jnp.int32)
+        cursor = jnp.zeros((g,), jnp.int32)
+        success = jnp.asarray([True, True, False, True])
+        table = mp.REGISTRY.create_table()
+        out = saga_ops.saga_table_tick(
+            step_state, retries, has_undo, saga_state, n_steps, cursor,
+            success, jnp.zeros((g,), bool), metrics=table,
+        )
+        assert len(out) == 5
+        table = out[4]
+        assert int(table.counters[mp.SAGA_STEPS_COMMITTED.index]) == 3
+        assert int(table.counters[mp.SAGA_STEPS_FAILED.index]) == 1
+
+    def test_slash_cascade_metrics_via_state(self):
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        st.add_vouch(
+            voucher_slot=1, vouchee_slot=0, session_slot=0, bond=0.3
+        )
+        st.apply_slash(session_slot=0, vouchee_slot=0, risk_weight=0.9)
+        snap = st.metrics_snapshot()
+        assert snap.counter(mp.SLASHED) >= 1
+        assert snap.counter(mp.CLIPPED) >= 1
+
+
+class TestDrain:
+    def test_snapshot_idempotent(self):
+        m = fresh_metrics()
+        m.commit(mt.counter_inc(m.table, mp.ADMITTED.index, 11))
+        m.observe_us(mp.STAGE_LATENCY["saga_round"], 130.0)
+        s1 = m.snapshot()
+        s2 = m.snapshot()
+        assert s1.counter(mp.ADMITTED) == s2.counter(mp.ADMITTED) == 11
+        h = mp.STAGE_LATENCY["saga_round"]
+        assert s1.hist_count(h) == s2.hist_count(h) == 1
+
+    def test_drain_monotonic_across_u32_wrap(self):
+        m = fresh_metrics()
+        m.commit(mt.counter_inc(m.table, 0, 2**32 - 3))
+        before = m.snapshot().counters[0]
+        m.commit(mt.counter_inc(m.table, 0, 10))  # wraps the raw u32
+        after = m.snapshot().counters[0]
+        assert after - before == 10
+        assert after == 2**32 + 7
+
+    def test_host_and_device_planes_merge(self):
+        m = fresh_metrics()
+        m.commit(mt.counter_inc(m.table, mp.REFUSED.index, 3))
+        m.inc(mp.REFUSED, 2)  # host plane, same series
+        assert m.snapshot().counter(mp.REFUSED) == 5
+
+    def test_quantiles_from_buckets(self):
+        m = fresh_metrics()
+        h = mp.STAGE_LATENCY["governance_wave"]
+        for us in (100.0, 200.0, 400.0, 800.0):
+            m.observe_us(h, us)
+        snap = m.snapshot()
+        p50 = snap.quantile(h, 0.5)
+        p95 = snap.quantile(h, 0.95)
+        assert 64.0 <= p50 <= 256.0
+        assert 512.0 <= p95 <= 1024.0
+        assert p50 <= p95
+
+    def test_quantile_empty_histogram(self):
+        snap = fresh_metrics().snapshot()
+        assert snap.quantile(mp.WAVE_LANES, 0.5) == 0.0
+
+
+class TestPrometheusExposition:
+    def test_text_format(self):
+        m = fresh_metrics()
+        m.commit(mt.counter_inc(m.table, mp.ADMITTED.index, 5))
+        m.observe_us(mp.STAGE_LATENCY["gateway_wave"], 33.0)
+        text = m.to_prometheus()
+        lines = text.splitlines()
+        assert text.endswith("\n")
+        assert "# TYPE hv_admission_admitted_total counter" in lines
+        assert "hv_admission_admitted_total 5" in lines
+        assert "# TYPE hv_stage_latency_us histogram" in lines
+        # Gauge with labels renders each series.
+        assert any(
+            line.startswith('hv_agents_in_ring{ring="3"}') for line in lines
+        )
+
+    def test_histogram_buckets_cumulative_and_inf(self):
+        m = fresh_metrics()
+        h = mp.STAGE_LATENCY["gateway_wave"]
+        for us in (1.0, 3.0, 1e9):
+            m.observe_us(h, us)
+        text = m.to_prometheus()
+        bucket_lines = [
+            line
+            for line in text.splitlines()
+            if line.startswith('hv_stage_latency_us_bucket{stage="gateway_wave"')
+        ]
+        counts = [int(line.rsplit(" ", 1)[1]) for line in bucket_lines]
+        assert counts == sorted(counts), "buckets must be cumulative"
+        assert 'le="+Inf"} 3' in bucket_lines[-1]
+        assert (
+            'hv_stage_latency_us_count{stage="gateway_wave"} 3'
+            in text.splitlines()
+        )
+
+    def test_one_type_line_per_series(self):
+        text = fresh_metrics().to_prometheus()
+        type_lines = [
+            line for line in text.splitlines() if line.startswith("# TYPE ")
+        ]
+        names = [line.split()[2] for line in type_lines]
+        assert len(names) == len(set(names))
+
+    def test_registry_rejects_kind_clash(self):
+        reg = mp.MetricsRegistry()
+        reg.counter("x_total")
+        with pytest.raises(ValueError):
+            reg.gauge("x_total")
+
+
+class TestGaugesAndParity:
+    def test_occupancy_gauges_from_state(self):
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        slot = st.create_session("g:s", _session_config())
+        st.enqueue_join(slot, "did:g0", 0.8)
+        st.enqueue_join(slot, "did:g1", 0.1)
+        st.flush_joins()
+        snap = st.metrics_snapshot()
+        assert snap.gauge(mp.AGENTS_ACTIVE) == 2
+        assert snap.gauge(mp.RING_AGENTS[2]) == 1  # sigma 0.8 -> ring 2
+        assert snap.gauge(mp.RING_AGENTS[3]) == 1  # sigma 0.1 -> sandbox
+        assert snap.gauge(mp.SESSIONS_LIVE) == 1
+        assert snap.counter(mp.ADMITTED) == 2
+
+    async def test_event_bus_parity_with_device_counter(self):
+        """The two observability planes must not drift: device EventLog
+        rows appended == metrics-plane mirror counter, for the same
+        traffic, across multiple syncs."""
+        from hypervisor_tpu.api import HypervisorService
+        from hypervisor_tpu.api import models as M
+
+        svc = HypervisorService()
+        resp = await svc.create_session(
+            M.CreateSessionRequest(creator_did="did:admin")
+        )
+        await svc.join_session(
+            resp.session_id,
+            M.JoinSessionRequest(agent_did="did:p", sigma_raw=0.8),
+        )
+        svc.hv.sync_events_to_device()
+        await svc.activate_session(resp.session_id)
+        svc.hv.sync_events_to_device()
+        svc.hv.sync_events_to_device()  # no-op sync must not double count
+        state = svc.hv.state
+        rows_appended = int(np.asarray(state.event_log.cursor))
+        codes, *_ = svc.hv.event_bus.device_rows(0)
+        snap = state.metrics_snapshot()
+        assert snap.counter(mp.EVENTS_MIRRORED) == rows_appended == len(codes)
+
+
+class TestShardedTallyParity:
+    def test_mesh_wave_counts_match_single_device(self):
+        """The sharded path's host-plane tallies must equal the
+        single-device path's in-wave counts for the same staged traffic —
+        including a memberless session (its only lane refused on sigma),
+        which never reaches ARCHIVED and must not be counted archived,
+        and the hv_wave_lanes histogram sample."""
+        from hypervisor_tpu.models import SessionConfig
+        from hypervisor_tpu.parallel import make_mesh
+        from hypervisor_tpu.state import HypervisorState
+
+        n_dev, b = 4, 8
+
+        def run(mesh):
+            st = HypervisorState()
+            slots = st.create_sessions_batch(
+                [f"sp:{'m' if mesh else 's'}{i}" for i in range(b)],
+                SessionConfig(min_sigma_eff=0.7),
+            )
+            sigma = np.full(b, 0.8, np.float32)
+            # Ring 2 but below the session floor -> ADMIT_SIGMA_LOW
+            # (sandbox ring 3 is exempt from the floor), so this lane's
+            # session stays memberless.
+            sigma[-1] = 0.65
+            st.run_governance_wave(
+                slots,
+                [f"did:sp:{'m' if mesh else 's'}{i}" for i in range(b)],
+                slots.copy(),
+                sigma,
+                np.zeros((1, b, 16), np.uint32),
+                mesh=mesh,
+            )
+            return st.metrics_snapshot()
+
+        single = run(None)
+        mesh = run(make_mesh(n_dev, platform="cpu"))
+        for handle in (
+            mp.WAVE_TICKS, mp.ADMITTED, mp.REFUSED,
+            mp.SESSIONS_ARCHIVED, mp.BONDS_RELEASED,
+            mp.SAGA_STEPS_COMMITTED, mp.SAGA_STEPS_FAILED,
+        ):
+            assert mesh.counter(handle) == single.counter(handle), handle
+        assert single.counter(mp.ADMITTED) == b - 1
+        assert single.counter(mp.SESSIONS_ARCHIVED) == b - 1
+        # Both paths record one lane-width sample per dispatched wave
+        # (the mesh path's width is the padded b_wave; b here is already
+        # a multiple of n_dev, so the sample values agree too).
+        assert single.hist_count(mp.WAVE_LANES) == 1
+        assert mesh.hist_count(mp.WAVE_LANES) == 1
+        assert (
+            mesh.hist[mp.WAVE_LANES.index].tolist()
+            == single.hist[mp.WAVE_LANES.index].tolist()
+        )
+
+
+def _session_config():
+    from hypervisor_tpu.models import SessionConfig
+
+    return SessionConfig(min_sigma_eff=0.0)

@@ -336,6 +336,7 @@ def run_bench() -> None:
     # itself, not a side list — the bench exercises the machinery it
     # reports through.
     from hypervisor_tpu.observability import metrics as metrics_plane
+    from hypervisor_tpu.observability.causal_trace import CausalTraceId
 
     metrics = state.metrics
     m_table = metrics.table
@@ -392,6 +393,16 @@ def run_bench() -> None:
     metrics.commit(m_table)
     base_snap = state.metrics_snapshot()
 
+    # Flight-recorder roots: one causal trace per timed wave (siblings
+    # of one bench root), registered on the HOST plane after the loop —
+    # stamping inside the timed region would tax the samples, and the
+    # timed program must stay byte-identical to prior BENCH artifacts.
+    # The ids land in the JSON payload so a bench run is replayable
+    # through `GET /trace/{session_id}` / `GET /debug/flight` on a
+    # service mounted over this state.
+    trace_root = CausalTraceId()
+    wave_traces: list[CausalTraceId] = []
+
     samples = []
     for _ in range(ITERS):
         # Clock inside the stage bracket: the legacy headline samples
@@ -401,9 +412,22 @@ def run_bench() -> None:
             result = execute()
             jax.block_until_ready(result)
             samples.append(time.perf_counter_ns() - t0)
+        wave_traces.append(trace_root.child())
     if wave_fn is not None:
         tally_sharded(result, ITERS)
     metrics.commit(m_table)
+
+    # Register the timed waves with the state's flight recorder (host
+    # plane, same rule set as the sharded bridge path).
+    wave_seq_range = [state.tracer._next_wave, state.tracer._next_wave]
+    for wt in wave_traces:
+        th = state.tracer.begin_wave(
+            stage_name, sessions=session_slots[: min(8, len(session_slots))],
+            lanes=b, root=wt, device=False,
+        )
+        state.tracer.stamp_wave_host(th)
+        state.tracer.end_wave(th)
+    wave_seq_range[1] = state.tracer._next_wave
 
     # ── correctness gates ────────────────────────────────────────────
     status = np.asarray(result.status)
@@ -439,7 +463,14 @@ def run_bench() -> None:
         # e.g. admitted/iters is exact, not inflated by warmup waves.
         return snap.counter(handle) - base_snap.counter(handle)
 
+    trace_info = {
+        "root": trace_root.full_id,
+        "wave_trace_ids": [wt.full_id for wt in wave_traces[:4]]
+        + (["..."] if len(wave_traces) > 4 else []),
+        "wave_seqs": wave_seq_range,
+    }
     plane = {
+        "trace": trace_info,
         "wave_ticks": delta(metrics_plane.WAVE_TICKS),
         "admitted": delta(metrics_plane.ADMITTED),
         "bonds_released": delta(metrics_plane.BONDS_RELEASED),

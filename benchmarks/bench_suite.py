@@ -396,8 +396,16 @@ def metrics_plane_report(results: list[dict]) -> dict:
     suite reports through the same bucket math production scrapes use.
     Quantiles are therefore bucket-resolved (log-2 bounds), alongside
     the exact percentiles the suite already prints.
+
+    Each benchmark also registers one wave on a flight-recorder tracer
+    (host plane) under a fresh root trace id, and the id + wave_seq
+    land in the report — the replay key that correlates a BENCH_*.json
+    entry with `GET /trace/...` / `GET /debug/flight` output when the
+    same harness runs mounted behind the API.
     """
+    from hypervisor_tpu.observability.causal_trace import CausalTraceId
     from hypervisor_tpu.observability.metrics import Metrics, MetricsRegistry
+    from hypervisor_tpu.observability.tracing import Tracer
 
     reg = MetricsRegistry()
     handles = {
@@ -408,19 +416,34 @@ def metrics_plane_report(results: list[dict]) -> dict:
         for r in results
     }
     metrics = Metrics(reg)
+    tracer = Tracer(capacity=256)
+    traces: dict[str, tuple[str, int]] = {}
     for r in results:
         for ns in r["_samples_ns"]:
             metrics.observe_us(handles[r["name"]], ns / 1e3)
+        root = CausalTraceId()
+        th = tracer.begin_wave(
+            "governance_wave", lanes=r["batch"], root=root, device=False
+        )
+        tracer.stamp_wave_host(th)
+        tracer.end_wave(th)
+        traces[r["name"]] = (
+            root.full_id,
+            th.record.wave_seq if th is not None else -1,
+        )
     snap = metrics.snapshot()
     report = {}
     for r in results:
         h = handles[r["name"]]
+        trace_id, wave_seq = traces[r["name"]]
         report[r["name"]] = {
             "samples": snap.hist_count(h),
             "batch_p50_us": round(snap.quantile(h, 0.5), 1),
             "batch_p95_us": round(snap.quantile(h, 0.95), 1),
             "per_op_p50_us": round(snap.quantile(h, 0.5) / r["batch"], 4),
             "per_op_p95_us": round(snap.quantile(h, 0.95) / r["batch"], 4),
+            "trace_root": trace_id,
+            "trace_wave_seq": wave_seq,
         }
     return report
 

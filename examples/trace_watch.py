@@ -1,0 +1,105 @@
+"""Flight-recorder demo: drive waves, reconstruct spans, export a trace.
+
+Drives governance traffic through a `HypervisorState` with the trace
+plane on, then drains the flight recorder the way an operator would:
+prints the reconstructed span trees (`hv.<stage>` nesting per wave) and
+writes a Chrome `trace_event` JSON file you can load in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing.
+
+Usage::
+
+    python examples/trace_watch.py                      # 1 round, tree + file
+    python examples/trace_watch.py --rounds 3 --sessions 64
+    python examples/trace_watch.py --out /tmp/hv_trace.json
+    python examples/trace_watch.py --otlp               # OTLP-lite JSON form
+    python examples/trace_watch.py --sample 0.25        # head-based sampling
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def drive_round(state, n_sessions: int, rnd: int) -> None:
+    from hypervisor_tpu.models import SessionConfig
+    from hypervisor_tpu.ops.merkle import BODY_WORDS
+
+    slots = state.create_sessions_batch(
+        [f"trace:r{rnd}:s{i}" for i in range(n_sessions)],
+        SessionConfig(min_sigma_eff=0.0),
+    )
+    state.run_governance_wave(
+        slots,
+        [f"did:trace:r{rnd}:{i}" for i in range(n_sessions)],
+        slots.copy(),
+        np.full(n_sessions, 0.8, np.float32),
+        np.zeros((2, n_sessions, BODY_WORDS), np.uint32),
+    )
+
+
+def print_tree(span, depth: int = 0) -> None:
+    dur = span.end_us - span.start_us
+    print(
+        "  " * depth
+        + f"{span.name}  span={span.span_word:08x}  {dur / 1e3:.3f} ms"
+    )
+    for child in span.children:
+        print_tree(child, depth + 1)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=1)
+    ap.add_argument("--sessions", type=int, default=16)
+    ap.add_argument("--out", type=str, default="/tmp/hv_trace.json")
+    ap.add_argument("--otlp", action="store_true")
+    ap.add_argument("--sample", type=float, default=None,
+                    help="head-based per-session sample rate (0..1)")
+    args = ap.parse_args()
+    if args.sample is not None:
+        os.environ["HV_TRACE_SAMPLE"] = str(args.sample)
+
+    from hypervisor_tpu.observability import tracing
+    from hypervisor_tpu.state import HypervisorState
+
+    state = HypervisorState()
+    for rnd in range(args.rounds):
+        drive_round(state, args.sessions, rnd)
+
+    spans = state.tracer.drain()
+    print(f"flight recorder: {len(spans)} reconstructed wave(s)\n")
+    for root in spans:
+        print(f"wave {root.wave_seq}  trace={root.trace_id}")
+        print_tree(root)
+        print()
+
+    doc = (
+        tracing.to_otlp(spans, state.tracer)
+        if args.otlp
+        else tracing.to_chrome_trace(spans, state.tracer)
+    )
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    kind = "OTLP-lite" if args.otlp else "Chrome trace_event"
+    print(f"wrote {kind} JSON to {args.out}")
+    if not args.otlp:
+        print("load it at https://ui.perfetto.dev or chrome://tracing")
+
+    summary = state.flight_summary()
+    print(
+        f"ring: {summary['ring_cursor']}/{summary['ring_capacity']} rows, "
+        f"{summary['waves_indexed']} waves indexed, "
+        f"sample_rate={summary['sample_rate']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,9 +1,10 @@
 """REST transports for the Hypervisor API.
 
-Two transports over the same `HypervisorService` (30 routes: the
+Two transports over the same `HypervisorService` (33 routes: the
 reference's 21, `api/server.py`, plus device stats, quarantine views,
-the per-membership agent view, leave, the operator sweep, and the
-per-action gateway with its wave sibling):
+the per-membership agent view, leave, the operator sweep, the
+per-action gateway with its wave sibling, and the flight recorder —
+`GET /trace/{session_id}` Chrome/OTLP export + `GET /debug/flight`):
 
  - `create_app()` — a FastAPI application with CORS-open middleware and
    OpenAPI docs, when fastapi is installed.
@@ -31,6 +32,8 @@ from hypervisor_tpu.observability.metrics import PROMETHEUS_CONTENT_TYPE
 ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/health", "health", None),
     ("GET", "/metrics", "metrics", None),
+    ("GET", "/trace/{session_id}", "trace_session", None),
+    ("GET", "/debug/flight", "debug_flight", None),
     ("GET", "/api/v1/stats", "stats", None),
     ("GET", "/api/v1/device/stats", "device_stats", None),
     ("POST", "/api/v1/sessions", "create_session", M.CreateSessionRequest),
@@ -74,6 +77,7 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
 _QUERY_PARAMS = {
     "list_sessions": ("state",),
     "query_events": ("event_type", "session_id", "agent_did", "limit"),
+    "trace_session": ("format",),
 }
 
 

@@ -70,6 +70,50 @@ class HypervisorService:
         """
         return PrometheusText(self.hv.state.metrics_prometheus())
 
+    async def trace_session(
+        self, session_id: str, format: Optional[str] = None
+    ) -> dict:
+        """`GET /trace/{session_id}`: the session's flight-recorder trace.
+
+        Drains the trace plane (ONE device_get, outside every wave),
+        reconstructs the waves that touched this session, joins host
+        event-bus rows onto the spans via the shared device-key words,
+        and exports Chrome `trace_event` JSON (default — load it in
+        Perfetto / chrome://tracing) or OTLP-lite JSON (`?format=otlp`).
+        """
+        from hypervisor_tpu.observability import tracing
+
+        state = self.hv.state
+        if not state.tracer.enabled:
+            raise ApiError(503, "trace plane disabled (HV_TRACE=0)")
+        slot = None
+        managed = self.hv.get_session(session_id)
+        if managed is not None:
+            slot = managed.slot
+        else:
+            slot = state.session_slot_of(session_id)
+        if slot is None:
+            raise ApiError(404, f"Session {session_id} not found")
+        spans = state.session_trace(slot)
+        if not spans:
+            raise ApiError(
+                404,
+                f"no recorded waves for session {session_id} (ring "
+                "wrapped, wave unsampled, or no traffic yet)",
+            )
+        tracing.attach_bus_events(spans, self.bus, session_id=session_id)
+        if format == "otlp":
+            return tracing.to_otlp(spans, state.tracer)
+        if format not in (None, "", "chrome"):
+            raise ApiError(400, f"unknown trace format {format!r}")
+        return tracing.to_chrome_trace(spans, state.tracer)
+
+    async def debug_flight(self) -> dict:
+        """`GET /debug/flight`: flight-recorder status — ring occupancy,
+        sampling knobs, and the most recent wave brackets with their
+        causal trace ids (the replay keys for /trace/{session_id})."""
+        return self.hv.state.flight_summary()
+
     async def device_stats(self) -> M.DeviceStatsResponse:
         """Device-plane occupancy: the tables every facade call updates."""
         import jax

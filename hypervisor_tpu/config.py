@@ -122,6 +122,7 @@ class TableCapacity:
     max_elevations: int = 4_096
     delta_log_capacity: int = 65_536
     event_log_capacity: int = 65_536
+    trace_log_capacity: int = 8_192
     max_participants_per_session: int = 64
 
 

@@ -1533,8 +1533,8 @@ class Hypervisor:
         """
         if self.event_bus is None:
             return 0
-        codes, sess, agents, traces, stamps = self.event_bus.device_rows(
-            self._events_mirrored
+        codes, sess, agents, traces, stamps, spans = (
+            self.event_bus.device_rows(self._events_mirrored)
         )
         if not len(codes):
             return 0
@@ -1546,6 +1546,7 @@ class Hypervisor:
             jnp.asarray(agents),
             jnp.asarray(traces),
             jnp.asarray(stamps),
+            jnp.asarray(spans),
         )
         # The metrics-plane twin of the EventLog cursor: every mirrored
         # row counts once, so the two planes can be cross-checked

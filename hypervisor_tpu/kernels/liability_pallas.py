@@ -41,6 +41,11 @@ try:  # pragma: no cover - import guard
     from jax.experimental.pallas import tpu as pltpu
 
     _PALLAS_IMPORTED = True
+    # jax >= 0.7 renamed TPUCompilerParams -> CompilerParams; accept
+    # whichever this jax ships so the kernels build on both.
+    _TPU_COMPILER_PARAMS = getattr(
+        pltpu, "CompilerParams", None
+    ) or getattr(pltpu, "TPUCompilerParams")
 except Exception:  # pragma: no cover
     _PALLAS_IMPORTED = False
 
@@ -114,7 +119,7 @@ def _gather_pallas(wave, vee, e, n):
         ],
         out_specs=pl.BlockSpec((1, E_CHUNK), lambda te, ta: (0, te)),
         out_shape=jax.ShapeDtypeStruct((1, e), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_TPU_COMPILER_PARAMS(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
     )(vee, wave)
@@ -139,7 +144,7 @@ def _scatter_pallas(vchr, vee, hit, nothit, e, n):
             jax.ShapeDtypeStruct((1, n), jnp.float32),
             jax.ShapeDtypeStruct((1, n), jnp.float32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_TPU_COMPILER_PARAMS(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
     )(vchr, vee, hit, nothit)

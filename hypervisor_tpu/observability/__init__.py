@@ -1,11 +1,19 @@
 """Observability: columnar event store, pub/sub taps, causal trace spans.
 
 Host-side views of the device EventLog ring buffer (`tables/logs.py`);
-`fnv1a32` is the shared string->u32 fold both planes use for trace ids.
+`fnv1a32` is the shared string->u32 fold both planes use for trace ids,
+and `device_key_of` the shared (trace, span) word rule the event bus,
+the device logs, and the flight-recorder stamps all join on. `tracing`
+is the flight recorder: in-jit trace ring, host span reconstruction,
+Chrome/OTLP export.
 """
 
-from hypervisor_tpu.observability import metrics, profiling
-from hypervisor_tpu.observability.causal_trace import CausalTraceId, fnv1a32
+from hypervisor_tpu.observability import metrics, profiling, tracing
+from hypervisor_tpu.observability.causal_trace import (
+    CausalTraceId,
+    device_key_of,
+    fnv1a32,
+)
 from hypervisor_tpu.observability.event_bus import (
     EventHandler,
     EventType,
@@ -19,7 +27,9 @@ __all__ = [
     "EventType",
     "HypervisorEvent",
     "HypervisorEventBus",
+    "device_key_of",
     "fnv1a32",
     "metrics",
     "profiling",
+    "tracing",
 ]

@@ -33,6 +33,25 @@ def fnv1a32(text: str) -> int:
     return acc
 
 
+def device_key_of(causal_trace_id: str | None) -> tuple[int, int]:
+    """(u32 trace, u32 span) device-join words for any trace-id string.
+
+    The one rule every plane shares (host event bus, device `EventLog`,
+    `TraceLog` stamps): a full `trace/span[/parent]` id keys as
+    `CausalTraceId.device_key()`; a bare opaque id hashes whole as the
+    trace word with span 0; absent ids key as (0, 0). Rows fed from the
+    same traffic therefore join on identical word pairs by construction.
+    """
+    if not causal_trace_id:
+        return 0, 0
+    if "/" in causal_trace_id:
+        try:
+            return CausalTraceId.from_string(causal_trace_id).device_key()
+        except ValueError:
+            pass
+    return fnv1a32(causal_trace_id), 0
+
+
 class CausalTraceId:
     """One span in a causal trace tree, backed by its known lineage path.
 

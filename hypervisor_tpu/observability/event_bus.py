@@ -22,7 +22,7 @@ from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Any, Callable, Optional
 
-from hypervisor_tpu.observability.causal_trace import fnv1a32
+from hypervisor_tpu.observability.causal_trace import device_key_of
 from hypervisor_tpu.tables.intern import InternTable
 from hypervisor_tpu.utils.clock import utc_now
 
@@ -135,6 +135,7 @@ class HypervisorEventBus:
         self._sessions = array("i")
         self._agents = array("i")
         self._traces = array("L")
+        self._spans = array("L")
         self._stamps = array("d")
         self._rows: list[HypervisorEvent] = []
         self._session_ids = InternTable()
@@ -156,12 +157,16 @@ class HypervisorEventBus:
         )
         agent = self._agent_ids.intern(event.agent_did) if event.agent_did else -1
 
+        # The (trace, span) device-key word pair — `causal_trace.
+        # device_key_of` is the ONE hashing rule all planes share, so
+        # bus rows, device EventLog rows, and TraceLog stamps fed from
+        # the same traffic join on identical u32 pairs.
+        trace_w, span_w = device_key_of(event.causal_trace_id)
         self._codes.append(code)
         self._sessions.append(session)
         self._agents.append(agent)
-        self._traces.append(
-            fnv1a32(event.causal_trace_id) if event.causal_trace_id else 0
-        )
+        self._traces.append(trace_w)
+        self._spans.append(span_w)
         self._stamps.append(event.timestamp.timestamp())
         self._rows.append(event)
 
@@ -286,7 +291,7 @@ class HypervisorEventBus:
         """Int columns for rows >= since_row, shaped for EventLog.append_batch.
 
         Returns (codes i32[B], sessions i32[B], agents i32[B], traces u32[B],
-        stamps f32[B]) as numpy arrays; pass them straight to
+        stamps f32[B], spans u32[B]) as numpy arrays; pass them straight to
         `tables.logs.EventLog.append_batch` to mirror host traffic on device.
         """
         import numpy as np
@@ -298,4 +303,5 @@ class HypervisorEventBus:
             np.asarray(self._agents[sl], np.int32),
             np.asarray(self._traces[sl], np.uint32),
             np.asarray(self._stamps[sl], np.float32),
+            np.asarray(self._spans[sl], np.uint32),
         )

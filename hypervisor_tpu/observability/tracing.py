@@ -1,0 +1,756 @@
+"""Flight recorder: in-jit trace ring + host span reconstruction + export.
+
+The metrics plane (PR 1) answers "how is the fleet doing"; this module
+answers "what happened to THIS wave". Three layers:
+
+  * **Device ring** — `tables.logs.TraceLog`: the jitted waves stamp
+    stage begin/end rows as pure ring-buffer scatters. A stamp carries
+    the wave's `causal_trace.device_key()` words, a stage id from
+    `TRACE_STAGES` (the SAME `hv.<stage>` vocabulary the metrics
+    histograms and profiler spans use), and a monotonic `seq` word.
+    There is no readable clock inside a lowered program, so `seq` is a
+    LOGICAL clock: it orders a wave's stamps so begin/end nesting
+    reconstructs; wall-clock comes from the host bracket.
+  * **Host plane** — `Tracer`: allocates one `CausalTraceId` + wave
+    sequence number per dispatched wave, resolves the head-based sample
+    bit, brackets the dispatch with wall-clock, and (for sharded/mesh
+    programs, which do not carry the table) mirrors the SAME stamp rows
+    on a host ring through one shared rule set (`WAVE_CHILD_STAGES`) —
+    the same pattern PR 1 used for `tally_wave_host`, pinned by a
+    mode-parity test.
+  * **Reconstruction + export** — `drain()` pulls the device ring with
+    ONE `jax.device_get`, merges both planes, joins rows to the host
+    wave index, and rebuilds parent/child spans (stack walk over the
+    seq order; stamp times interpolate linearly inside the host-measured
+    dispatch window — logical placement, documented as such). Exporters
+    render Chrome `trace_event` JSON (loadable in Perfetto) and an
+    OTLP-lite JSON form; `attach_bus_events` joins host event-bus rows
+    onto spans via the shared device-key words.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Iterable, NamedTuple, Optional
+
+import numpy as np
+
+from hypervisor_tpu.observability.causal_trace import CausalTraceId, fnv1a32
+from hypervisor_tpu.tables.logs import TraceLog
+
+#: Stage vocabulary for trace stamps. Shares names with
+#: `observability.metrics.STAGES` / the `hv.<stage>` profiler spans so a
+#: trace, a /metrics scrape, and a Perfetto capture correlate by
+#: construction. Order is the wire format (stage ids in TraceLog rows):
+#: APPEND ONLY.
+TRACE_STAGES: tuple[str, ...] = (
+    "governance_wave",
+    "admission_wave",
+    "session_fsm",
+    "delta_chain",
+    "saga_round",
+    "terminate_wave",
+    "gateway_wave",
+    "slash_cascade",
+    "governance_wave_sharded",
+    "gateway_wave_sharded",
+    "breach_sweep",
+    "reconcile_wave_sessions",
+)
+STAGE_ID: dict[str, int] = {name: i for i, name in enumerate(TRACE_STAGES)}
+
+KIND_BEGIN, KIND_END = 0, 1
+
+#: The one rule set naming each root stage's in-wave child stamps. The
+#: in-jit stamp points in `ops/*` follow this sequence, and the host
+#: mirror for sharded/mesh dispatches (`Tracer.stamp_wave_host`) replays
+#: it — one place, or the two planes drift (the mode-parity test pins
+#: them equal).
+WAVE_CHILD_STAGES: dict[str, tuple[str, ...]] = {
+    "governance_wave": (
+        "admission_wave",
+        "session_fsm",
+        "delta_chain",
+        "saga_round",
+        "terminate_wave",
+    ),
+    "governance_wave_sharded": (
+        "admission_wave",
+        "session_fsm",
+        "delta_chain",
+        "saga_round",
+        "terminate_wave",
+    ),
+}
+
+_SPAN_PRIME = 0x01000193  # FNV-32 prime: cheap u32 mixing on both planes
+
+
+def child_span_word(parent_span, stage_id):
+    """Derive a child stage's span word from its parent's, u32 math.
+
+    The SAME formula runs inside the jitted wave (u32 arithmetic wraps
+    naturally) and on host (masked int math), so the reconstruction can
+    recompute every child word from the root `device_key()` span word —
+    no per-stage ids need to cross the host/device boundary.
+    """
+    if isinstance(parent_span, (int, np.integer)):
+        return (
+            (int(parent_span) ^ (int(stage_id) + 1)) * _SPAN_PRIME
+        ) & 0xFFFFFFFF
+    import jax.numpy as jnp
+
+    return (
+        (parent_span.astype(jnp.uint32) ^ jnp.uint32(int(stage_id) + 1))
+        * jnp.uint32(_SPAN_PRIME)
+    ).astype(jnp.uint32)
+
+
+class TraceContext(NamedTuple):
+    """Traced scalars a stamped wave carries (a jit-friendly pytree).
+
+    `span` is the word the op's OWN begin/end rows use; internal phases
+    stamp `child_span_word(span, phase)`. `sampled` is the head-based
+    decision resolved on host — traced, not static, so sampled and
+    unsampled waves share one compiled program.
+    """
+
+    trace: object    # u32[] trace word
+    span: object     # u32[] root span word of this dispatch
+    wave_seq: object  # i32[] host wave sequence number
+    sampled: object  # bool[] head-based sample bit (wave mask)
+
+    def child(self, stage_name: str) -> "TraceContext":
+        """Context for a nested op: same wave, span re-rooted at the
+        stage's derived word (the nested op then stamps uniformly)."""
+        return self._replace(
+            span=child_span_word(self.span, STAGE_ID[stage_name])
+        )
+
+
+class WaveStamps:
+    """Trace-time stamp builder for one op's rows.
+
+    `begin`/`end` record structural stamps while the op traces; `commit`
+    lands them as ONE batched ring scatter (`TraceLog.stamp_batch`), so
+    a fully-stamped governance wave costs two fused scatters per column
+    (its own rows + the nested admission op's), not one dispatch per
+    stamp. Stage ids and kinds are trace-time constants; only the
+    trace/span/seq words are traced values.
+    """
+
+    def __init__(self, ctx: TraceContext, root_stage: str) -> None:
+        self._ctx = ctx
+        self._root = STAGE_ID[root_stage]
+        self._rows: list[tuple[int, int, object]] = []  # (stage, kind, lane)
+
+    def begin(self, stage_name: str, lane=-1) -> None:
+        self._rows.append((STAGE_ID[stage_name], KIND_BEGIN, lane))
+
+    def end(self, stage_name: str, lane=-1) -> None:
+        self._rows.append((STAGE_ID[stage_name], KIND_END, lane))
+
+    def commit(self, log: TraceLog) -> TraceLog:
+        import jax.numpy as jnp
+
+        if not self._rows:
+            return log
+        b = len(self._rows)
+        ctx = self._ctx
+        spans = jnp.stack(
+            [
+                ctx.span
+                if stage == self._root
+                else child_span_word(ctx.span, stage)
+                for stage, _, _ in self._rows
+            ]
+        )
+        lanes = jnp.stack(
+            [jnp.asarray(lane, jnp.int32) for _, _, lane in self._rows]
+        )
+        return log.stamp_batch(
+            traces=jnp.broadcast_to(jnp.asarray(ctx.trace, jnp.uint32), (b,)),
+            spans=spans,
+            stages=jnp.asarray([s for s, _, _ in self._rows], jnp.int32),
+            kinds=jnp.asarray([k for _, k, _ in self._rows], jnp.int32),
+            lanes=lanes,
+            wave_seqs=jnp.broadcast_to(
+                jnp.asarray(ctx.wave_seq, jnp.int32), (b,)
+            ),
+            sampled=ctx.sampled,
+        )
+
+
+# ── host plane ───────────────────────────────────────────────────────
+
+
+@dataclasses.dataclass
+class WaveRecord:
+    """Host-side record of one dispatched wave (the reconstruction key).
+
+    `sessions` is an i32 ndarray (not Python ints): a bench-scale wave
+    names 10k slots, and the record index holds up to `max_waves`
+    records — compact storage and O(1)-per-element membership tests
+    keep the tracer off the dispatch hot path's back.
+    """
+
+    wave_seq: int
+    trace: CausalTraceId
+    stage: str
+    sessions: np.ndarray
+    t0_us: float
+    t1_us: float = 0.0
+    sampled: bool = True
+    lanes: int = 0
+    mode: str = "device"  # "device" (in-jit stamps) | "host" (mirrored)
+
+
+@dataclasses.dataclass
+class WaveHandle:
+    """What `begin_wave` hands the dispatch site: the host record plus
+    the traced context to thread into the jitted program (None when the
+    dispatch runs a program that cannot carry the table)."""
+
+    record: WaveRecord
+    ctx: Optional[TraceContext]
+
+
+@dataclasses.dataclass
+class Span:
+    """One reconstructed span. Times are µs on the tracer's clock."""
+
+    name: str
+    stage: str
+    trace_id: str
+    span_word: int
+    parent_span_word: Optional[int]
+    start_us: float
+    end_us: float
+    wave_seq: int
+    children: list["Span"] = dataclasses.field(default_factory=list)
+    events: list[dict] = dataclasses.field(default_factory=list)
+
+    def walk(self) -> Iterable["Span"]:
+        yield self
+        for child in self.children:
+            yield from child.walk()
+
+
+def _sample_bit(key: str, rate: float) -> bool:
+    """Deterministic head-based decision: same key, same verdict, on
+    every host — fnv1a32 over the key against the rate threshold."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return (fnv1a32(key) % (1 << 16)) < rate * (1 << 16)
+
+
+class Tracer:
+    """One deployment's trace plane: device ring + host wave index.
+
+    Owns the device `TraceLog` (thread `.table` into waves via
+    `begin_wave().ctx`, rebind via `end_wave(handle, result.trace)`) and
+    the host side: wave records (trace ids, wall-clock brackets, session
+    scopes), the host-mirror stamp rows for sharded dispatches, and the
+    drain. Thread-safety mirrors `Metrics`: host mutations under a lock,
+    device accumulation functional.
+
+    Knobs: `HV_TRACE=0` disables the plane entirely (waves compile
+    without the table — the pre-trace program); `HV_TRACE_SAMPLE=<0..1>`
+    sets the head-based sample rate (per-session, deterministic).
+    """
+
+    def __init__(
+        self,
+        capacity: int = 4096,
+        sample_rate: Optional[float] = None,
+        enabled: Optional[bool] = None,
+        max_waves: int = 4096,
+    ) -> None:
+        if enabled is None:
+            enabled = os.environ.get("HV_TRACE", "1") != "0"
+        if sample_rate is None:
+            sample_rate = float(os.environ.get("HV_TRACE_SAMPLE", "1.0"))
+        self.enabled = bool(enabled)
+        self.sample_rate = float(sample_rate)
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._next_wave = 0
+        self._waves: dict[int, WaveRecord] = {}
+        self._max_waves = int(max_waves)
+        # Host-plane stamp rows (sharded dispatches): same tuple schema
+        # as the device columns — (wave_seq, seq, trace, span, stage,
+        # kind, lane).
+        self._host_rows: list[tuple[int, int, int, int, int, int, int]] = []
+        # µs clock: monotonic for brackets, unix anchor for OTLP export.
+        self._perf0 = time.perf_counter()
+        self._unix0 = time.time()
+        self.table: Optional[TraceLog] = (
+            TraceLog.create(self.capacity) if self.enabled else None
+        )
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._perf0) * 1e6
+
+    def unix_us(self, us: float) -> float:
+        """Tracer-clock µs -> unix µs (the OTLP export anchor)."""
+        return self._unix0 * 1e6 + us
+
+    # ── wave bracket ─────────────────────────────────────────────────
+
+    def begin_wave(
+        self,
+        stage: str,
+        sessions: Iterable[int] = (),
+        lanes: int = 0,
+        root: Optional[CausalTraceId] = None,
+        sample_keys: Optional[Iterable[str]] = None,
+        device: bool = True,
+    ) -> Optional[WaveHandle]:
+        """Open one dispatched wave; None when the plane is disabled.
+
+        The sample bit resolves HERE, per session key (deterministic
+        fnv over `sample_keys`, default the session slots), and rides
+        the context as a traced bool — unsampled waves run the same
+        compiled program and their stamps drop at the scatter.
+        `device=False` marks a dispatch whose program cannot carry the
+        table (sharded/mesh): the handle carries no ctx and the caller
+        mirrors stamps with `stamp_wave_host`.
+        """
+        if not self.enabled:
+            return None
+        sessions = np.asarray(
+            sessions if not isinstance(sessions, (int, np.integer))
+            else [sessions],
+            np.int32,
+        ).ravel()
+        trace = root if root is not None else CausalTraceId()
+        # Hot-path cost control: at rate 1.0/0.0 the verdict needs no
+        # keys at all, and at partial rates the any() short-circuits on
+        # the first sampled key — a 10k-session wave must not pay an
+        # O(K) Python pass per dispatch just to learn "True".
+        if self.sample_rate >= 1.0:
+            sampled = True
+        elif self.sample_rate <= 0.0:
+            sampled = False
+        else:
+            keys = (
+                iter(sample_keys)
+                if sample_keys is not None
+                else (f"slot:{s}" for s in sessions.tolist())
+                if sessions.size
+                else iter((trace.trace_id,))
+            )
+            sampled = any(
+                _sample_bit(k, self.sample_rate) for k in keys
+            )
+        with self._lock:
+            wave_seq = self._next_wave
+            self._next_wave += 1
+        record = WaveRecord(
+            wave_seq=wave_seq,
+            trace=trace,
+            stage=stage,
+            sessions=sessions,
+            t0_us=self._now_us(),
+            sampled=sampled,
+            lanes=int(lanes),
+            mode="device" if device else "host",
+        )
+        ctx = None
+        if device:
+            import jax.numpy as jnp
+
+            t_word, s_word = trace.device_key()
+            ctx = TraceContext(
+                trace=jnp.asarray(t_word, jnp.uint32),
+                span=jnp.asarray(s_word, jnp.uint32),
+                wave_seq=jnp.asarray(wave_seq, jnp.int32),
+                sampled=jnp.asarray(sampled, bool),
+            )
+        return WaveHandle(record=record, ctx=ctx)
+
+    def end_wave(
+        self, handle: Optional[WaveHandle], table: Optional[TraceLog] = None
+    ) -> None:
+        """Close the bracket; commit the updated device ring if one rode
+        the wave. Records are kept in a bounded index (oldest evicted),
+        matching the ring's own wrap semantics."""
+        if handle is None:
+            return
+        handle.record.t1_us = self._now_us()
+        with self._lock:
+            if table is not None:
+                self.table = table
+            self._waves[handle.record.wave_seq] = handle.record
+            # O(1) eviction: records land in insertion order (dicts
+            # preserve it), so the first key is the oldest — a
+            # min()-scan here would cost O(max_waves) under the lock on
+            # EVERY dispatch once the index fills.
+            while len(self._waves) > self._max_waves:
+                del self._waves[next(iter(self._waves))]
+
+    def stamp_wave_host(self, handle: Optional[WaveHandle]) -> None:
+        """Mirror one dispatch's stamp rows on the host plane.
+
+        The sharded/mesh programs don't carry the TraceLog (their shard
+        layout is unresolved — same constraint as the metrics table), so
+        the bridge mirrors the SAME rows the in-jit stamps would write,
+        from the one shared `WAVE_CHILD_STAGES` rule set. Unsampled
+        waves mirror nothing, matching the device plane's predicated
+        drop.
+        """
+        if handle is None or not handle.record.sampled:
+            return
+        rec = handle.record
+        t_word, s_word = rec.trace.device_key()
+        root_id = STAGE_ID[rec.stage]
+        rows: list[tuple[int, int, int]] = [(root_id, KIND_BEGIN, -1)]
+        for child in WAVE_CHILD_STAGES.get(rec.stage, ()):
+            rows.append((STAGE_ID[child], KIND_BEGIN, -1))
+            rows.append((STAGE_ID[child], KIND_END, -1))
+        rows.append((root_id, KIND_END, -1))
+        with self._lock:
+            for seq, (stage, kind, lane) in enumerate(rows):
+                span = (
+                    s_word
+                    if stage == root_id
+                    else child_span_word(s_word, stage)
+                )
+                self._host_rows.append(
+                    (rec.wave_seq, seq, t_word, span, stage, kind, lane)
+                )
+            # Bound like the device ring: keep the newest rows.
+            if len(self._host_rows) > self.capacity:
+                self._host_rows = self._host_rows[-self.capacity:]
+
+    # ── drain + reconstruction ───────────────────────────────────────
+
+    def _device_rows(self) -> list[tuple[int, int, int, int, int, int, int]]:
+        """Live ring rows as (wave_seq, seq, trace, span, stage, kind,
+        lane) — ONE `jax.device_get` of the whole table, outside every
+        wave (the only device round-trip in the trace plane)."""
+        if self.table is None:
+            return []
+        import jax
+
+        host = jax.device_get(self.table)
+        wave_seq = np.asarray(host.wave_seq)
+        live = wave_seq >= 0
+        if not live.any():
+            return []
+        seq = np.asarray(host.seq).astype(np.int64)
+        trace = np.asarray(host.trace)
+        span = np.asarray(host.span)
+        stage = np.asarray(host.stage)
+        kind = np.asarray(host.kind)
+        lane = np.asarray(host.lane)
+        rows = [
+            (
+                int(wave_seq[i]),
+                int(seq[i]),
+                int(trace[i]),
+                int(span[i]),
+                int(stage[i]),
+                int(kind[i]),
+                int(lane[i]),
+            )
+            for i in np.nonzero(live)[0]
+        ]
+        rows.sort(key=lambda r: r[1])
+        return rows
+
+    def drain(self) -> list[Span]:
+        """Reconstruct every wave both planes currently hold.
+
+        Stamps group by wave_seq, join the host wave index (trace ids,
+        wall-clock brackets), and rebuild nesting with a stack walk over
+        seq order. Stamp times interpolate linearly inside the host
+        bracket — logical placement (XLA schedules the real phases as it
+        pleases inside one program); the bracket endpoints are real.
+        """
+        with self._lock:
+            host_rows = list(self._host_rows)
+            waves = dict(self._waves)
+        rows = self._device_rows() + host_rows
+        by_wave: dict[int, list[tuple]] = {}
+        for row in rows:
+            by_wave.setdefault(row[0], []).append(row)
+        spans: list[Span] = []
+        for wave_seq in sorted(by_wave):
+            record = waves.get(wave_seq)
+            if record is None:
+                continue  # record evicted: ring rows alone can't be timed
+            root = self._reconstruct(record, by_wave[wave_seq])
+            if root is not None:
+                spans.append(root)
+        return spans
+
+    def _reconstruct(
+        self, record: WaveRecord, rows: list[tuple]
+    ) -> Optional[Span]:
+        rows = sorted(rows, key=lambda r: r[1])
+        n = len(rows)
+        if n == 0:
+            return None
+        t0, t1 = record.t0_us, max(record.t1_us, record.t0_us)
+        width = (t1 - t0) / (n + 1)
+
+        def vtime(i: int) -> float:
+            return t0 + (i + 1) * width
+
+        root: Optional[Span] = None
+        stack: list[Span] = []
+        for i, (_w, _seq, trace_w, span_w, stage, kind, _lane) in enumerate(
+            rows
+        ):
+            stage_name = (
+                TRACE_STAGES[stage]
+                if 0 <= stage < len(TRACE_STAGES)
+                else f"stage_{stage}"
+            )
+            if kind == KIND_BEGIN:
+                span = Span(
+                    name=f"hv.{stage_name}",
+                    stage=stage_name,
+                    trace_id=record.trace.trace_id,
+                    span_word=span_w,
+                    parent_span_word=(
+                        stack[-1].span_word if stack else None
+                    ),
+                    start_us=t0 if not stack else vtime(i),
+                    end_us=t1,
+                    wave_seq=record.wave_seq,
+                )
+                if stack:
+                    stack[-1].children.append(span)
+                elif root is None:
+                    root = span
+                stack.append(span)
+            else:
+                # Close the innermost open span with this word (stamps
+                # are well-nested by construction; tolerate strays).
+                while stack:
+                    top = stack.pop()
+                    top.end_us = t1 if not stack else vtime(i)
+                    if top.span_word == span_w:
+                        break
+        while stack:
+            stack.pop().end_us = t1
+        if root is not None:
+            root.start_us, root.end_us = t0, t1
+        return root
+
+    # ── queries ──────────────────────────────────────────────────────
+
+    def session_spans(self, session_slot: int) -> list[Span]:
+        """Reconstructed waves that touched this session slot."""
+        out = []
+        for span in self.drain():
+            record = self._waves.get(span.wave_seq)
+            if record is not None and session_slot in record.sessions:
+                out.append(span)
+        return out
+
+    def flight_summary(self, last: int = 32) -> dict:
+        """The /debug/flight payload: recorder state + recent waves."""
+        with self._lock:
+            records = [
+                self._waves[k] for k in sorted(self._waves)[-last:]
+            ]
+            cursor = (
+                int(np.asarray(self.table.cursor))
+                if self.table is not None
+                else 0
+            )
+        return {
+            "enabled": self.enabled,
+            "sample_rate": self.sample_rate,
+            "ring_capacity": self.capacity,
+            "ring_cursor": cursor,
+            "waves_indexed": len(self._waves),
+            "next_wave_seq": self._next_wave,
+            "recent_waves": [
+                {
+                    "wave_seq": r.wave_seq,
+                    "trace_id": r.trace.full_id,
+                    "stage": f"hv.{r.stage}",
+                    # Bounded payload: a bench wave names 10k slots.
+                    "sessions": [int(s) for s in r.sessions[:16]],
+                    "n_sessions": int(r.sessions.size),
+                    "lanes": r.lanes,
+                    "sampled": r.sampled,
+                    "mode": r.mode,
+                    "duration_us": round(max(r.t1_us - r.t0_us, 0.0), 1),
+                }
+                for r in records
+            ],
+        }
+
+
+# ── joins ────────────────────────────────────────────────────────────
+
+
+def attach_bus_events(spans: list[Span], bus, session_id=None) -> int:
+    """Join host event-bus rows onto spans via the device-key words.
+
+    An event whose `causal_trace_id` keys to a span's (trace, span)
+    word pair lands on that span; a trace-word-only match lands on the
+    wave's root span. Returns the number of events attached.
+    """
+    from hypervisor_tpu.observability.causal_trace import device_key_of
+
+    by_word: dict[tuple[int, int], Span] = {}
+    roots_by_trace: dict[int, Span] = {}
+    for root in spans:
+        root_trace_w = fnv1a32(root.trace_id)
+        roots_by_trace.setdefault(root_trace_w, root)
+        for span in root.walk():
+            by_word[(root_trace_w, span.span_word)] = span
+    attached = 0
+    events = bus.query(session_id=session_id) if session_id else bus.all_events
+    for event in events:
+        t_w, s_w = device_key_of(event.causal_trace_id)
+        target = by_word.get((t_w, s_w)) or roots_by_trace.get(t_w)
+        if target is None:
+            continue
+        target.events.append(
+            {
+                "name": event.event_type.value,
+                "ts_us": event.timestamp.timestamp() * 1e6,
+                "session_id": event.session_id,
+                "agent_did": event.agent_did,
+            }
+        )
+        attached += 1
+    return attached
+
+
+# ── exporters ────────────────────────────────────────────────────────
+
+
+def to_chrome_trace(spans: list[Span], tracer: Optional[Tracer] = None) -> dict:
+    """Chrome `trace_event` JSON (the Perfetto/about:tracing format).
+
+    Complete "X" duration events, one track (tid) per wave; span events
+    become "i" instant events on the same track.
+    """
+    events: list[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "args": {"name": "hypervisor_tpu"},
+        }
+    ]
+    for root in spans:
+        for span in root.walk():
+            events.append(
+                {
+                    "name": span.name,
+                    "cat": "hv",
+                    "ph": "X",
+                    "ts": round(span.start_us, 3),
+                    "dur": round(max(span.end_us - span.start_us, 0.0), 3),
+                    "pid": 1,
+                    "tid": span.wave_seq,
+                    "args": {
+                        "trace_id": span.trace_id,
+                        "span": f"{span.span_word:08x}",
+                        "parent_span": (
+                            f"{span.parent_span_word:08x}"
+                            if span.parent_span_word is not None
+                            else None
+                        ),
+                    },
+                }
+            )
+            for ev in span.events:
+                events.append(
+                    {
+                        "name": ev["name"],
+                        "cat": "hv.event",
+                        "ph": "i",
+                        "s": "t",
+                        "ts": round(span.start_us, 3),
+                        "pid": 1,
+                        "tid": span.wave_seq,
+                        "args": {
+                            k: v for k, v in ev.items() if k != "name"
+                        },
+                    }
+                )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def to_otlp(spans: list[Span], tracer: Optional[Tracer] = None) -> dict:
+    """OTLP-lite JSON: the `resourceSpans` shape OTLP/HTTP JSON uses,
+    ids hex-padded to OTLP widths, times in unix nanoseconds (anchored
+    to the tracer's unix clock when one is supplied)."""
+
+    def unix_ns(us: float) -> int:
+        if tracer is not None:
+            return int(tracer.unix_us(us) * 1e3)
+        return int(us * 1e3)
+
+    otlp_spans: list[dict] = []
+    for root in spans:
+        trace_hex = root.trace_id.rjust(32, "0")[:32]
+        for span in root.walk():
+            otlp_spans.append(
+                {
+                    "traceId": trace_hex,
+                    "spanId": f"{span.span_word:016x}",
+                    "parentSpanId": (
+                        f"{span.parent_span_word:016x}"
+                        if span.parent_span_word is not None
+                        else ""
+                    ),
+                    "name": span.name,
+                    "kind": 1,  # SPAN_KIND_INTERNAL
+                    "startTimeUnixNano": unix_ns(span.start_us),
+                    "endTimeUnixNano": unix_ns(span.end_us),
+                    "attributes": [
+                        {
+                            "key": "hv.wave_seq",
+                            "value": {"intValue": span.wave_seq},
+                        },
+                        {
+                            "key": "hv.stage",
+                            "value": {"stringValue": span.stage},
+                        },
+                    ],
+                    "events": [
+                        {
+                            "name": ev["name"],
+                            "timeUnixNano": unix_ns(span.start_us),
+                        }
+                        for ev in span.events
+                    ],
+                    "status": {},
+                }
+            )
+    return {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": [
+                        {
+                            "key": "service.name",
+                            "value": {"stringValue": "hypervisor_tpu"},
+                        }
+                    ]
+                },
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "hypervisor_tpu.tracing"},
+                        "spans": otlp_spans,
+                    }
+                ],
+            }
+        ]
+    }

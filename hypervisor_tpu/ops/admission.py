@@ -143,6 +143,7 @@ class AdmissionResult(NamedTuple):
     ring: jnp.ndarray       # i8[B]
     sigma_eff: jnp.ndarray  # f32[B]
     metrics: MetricsTable | None = None  # updated when a table rode in
+    trace: object = None    # TraceLog, updated when the ring rode in
 
 
 def admit_batch(
@@ -161,6 +162,8 @@ def admit_batch(
     ring_bursts: jnp.ndarray | None = None,   # f32[4] configured bucket bursts
     unique_sessions: bool = False,
     metrics: MetricsTable | None = None,
+    trace=None,       # TraceLog riding the wave (flight recorder)
+    trace_ctx=None,   # observability.tracing.TraceContext scalars
 ) -> AdmissionResult:
     """Admit a wave of B agents; rejected elements leave no trace.
 
@@ -180,6 +183,12 @@ def admit_batch(
     refused lane counts plus the wave-size histogram accumulate
     in-wave — pure scatter adds on the metrics columns, no host
     transfer — and the updated table returns on the result.
+
+    With `trace` (a TraceLog ring riding the wave) the op stamps its
+    `hv.admission_wave` begin/end rows — one fused ring scatter, no
+    host transfer, predicated on the context's sample bit. The span
+    word is `trace_ctx.span`: the caller roots it (`TraceContext.child`
+    when this op nests inside the fused pipeline wave).
     """
     # One row gather per packed block instead of one per column
     # (tables/state.py SessionTable packing): the [B, 5] i32 rows carry
@@ -281,6 +290,13 @@ def admit_batch(
             metrics_schema.WAVE_LANES.index,
             jnp.full((1,), b, jnp.float32),
         )
+    if trace is not None:
+        from hypervisor_tpu.observability import tracing
+
+        stamps = tracing.WaveStamps(trace_ctx, "admission_wave")
+        stamps.begin("admission_wave", lane=b)
+        stamps.end("admission_wave", lane=b)
+        trace = stamps.commit(trace)
     return AdmissionResult(
         agents=new_agents,
         sessions=new_sessions,
@@ -288,4 +304,5 @@ def admit_batch(
         ring=ring,
         sigma_eff=sigma_eff,
         metrics=metrics,
+        trace=trace,
     )

@@ -113,6 +113,7 @@ class GatewayResult(NamedTuple):
     window_calls: jnp.ndarray  # i32[B] window total at this record
     tripped: jnp.ndarray       # bool[B] records that tripped the breaker
     metrics: "MetricsTable | None" = None  # updated when a table rode in
+    trace: object = None       # TraceLog, updated when the ring rode in
 
 
 def check_actions(
@@ -131,6 +132,8 @@ def check_actions(
     rate_limit: RateLimitConfig = DEFAULT_CONFIG.rate_limit,
     trust: TrustConfig = DEFAULT_CONFIG.trust,
     metrics: MetricsTable | None = None,
+    trace=None,       # TraceLog riding the wave (flight recorder)
+    trace_ctx=None,   # observability.tracing.TraceContext scalars
 ) -> GatewayResult:
     """Run B actions through every per-action gate in one program.
 
@@ -316,6 +319,13 @@ def check_actions(
             metrics_schema.GATEWAY_DENIED.index,
             jnp.sum(valid.astype(jnp.int32)) - n_allowed,
         )
+    if trace is not None:
+        from hypervisor_tpu.observability import tracing
+
+        stamps = tracing.WaveStamps(trace_ctx, "gateway_wave")
+        stamps.begin("gateway_wave", lane=b)
+        stamps.end("gateway_wave", lane=b)
+        trace = stamps.commit(trace)
     return GatewayResult(
         agents=new_agents,
         verdict=verdict,
@@ -327,4 +337,5 @@ def check_actions(
         window_calls=total_i.astype(jnp.int32),
         tripped=trip_action,
         metrics=metrics,
+        trace=trace,
     )

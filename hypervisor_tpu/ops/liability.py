@@ -127,6 +127,7 @@ class SlashWaveResult(NamedTuple):
     clipped: jnp.ndarray      # bool[N] all agents clipped in any wave
     wave_of: jnp.ndarray      # i8[N] cascade depth an agent was slashed at (-1 none)
     metrics: "MetricsTable | None" = None  # updated when a table rode in
+    trace: object = None      # TraceLog, updated when the ring rode in
 
 
 @stage_scope("slash_cascade")
@@ -140,6 +141,8 @@ def slash_cascade(
     trust: TrustConfig = DEFAULT_CONFIG.trust,
     allreduce=None,
     metrics: "MetricsTable | None" = None,
+    trace=None,       # TraceLog riding the cascade (flight recorder)
+    trace_ctx=None,   # observability.tracing.TraceContext scalars
 ) -> SlashWaveResult:
     """Batched slash with depth-bounded cascade (`slashing.py:63-143`).
 
@@ -242,6 +245,13 @@ def slash_cascade(
             metrics_schema.CLIPPED.index,
             jnp.sum(clipped_any.astype(jnp.int32)),
         )
+    if trace is not None:
+        from hypervisor_tpu.observability import tracing
+
+        stamps = tracing.WaveStamps(trace_ctx, "slash_cascade")
+        stamps.begin("slash_cascade", lane=n)
+        stamps.end("slash_cascade", lane=n)
+        trace = stamps.commit(trace)
     return SlashWaveResult(
         sigma=sigma,
         vouch=replace(vouch, active=active),
@@ -249,4 +259,5 @@ def slash_cascade(
         clipped=clipped_any,
         wave_of=wave_of,
         metrics=metrics,
+        trace=trace,
     )

@@ -185,6 +185,7 @@ class WaveResult(NamedTuple):
     fsm_error: jnp.ndarray      # bool[K] illegal session walks (none expected)
     released: jnp.ndarray       # i32 bonds released at terminate
     metrics: MetricsTable | None = None  # updated when a table rode in
+    trace: object = None        # TraceLog, updated when the ring rode in
 
 
 def governance_wave(
@@ -207,6 +208,8 @@ def governance_wave(
     wave_range: tuple[jnp.ndarray, jnp.ndarray] | None = None,
     unique_sessions: bool = False,
     metrics: MetricsTable | None = None,
+    trace=None,       # TraceLog riding the wave (flight recorder)
+    trace_ctx=None,   # observability.tracing.TraceContext scalars
 ) -> WaveResult:
     """The full governance pipeline AS ONE PROGRAM over the state tables.
 
@@ -240,10 +243,30 @@ def governance_wave(
     (pinned by `tests/unit/test_metrics.py`); the updated table returns
     on the result and is donated alongside the state tables in the
     donated wave variant.
+
+    With `trace` (a TraceLog ring), the wave stamps its flight-recorder
+    rows: an `hv.governance_wave` root begin/end pair plus begin/end
+    stamps around every phase — the `observability.tracing.
+    WAVE_CHILD_STAGES["governance_wave"]` sequence, which the host
+    mirror for sharded dispatches replays identically (mode parity).
+    Stamps are ring scatters predicated on the context's sample bit; no
+    host transfer enters the program (same lowering gate as metrics,
+    `tests/unit/test_tracing.py`). The seq words record PROGRAM
+    structure — XLA schedules the real phases freely inside the one
+    program; wall-clock truth is the host bracket around the dispatch.
     """
     from hypervisor_tpu.ops import liability as liability_ops
     from hypervisor_tpu.ops import terminate as terminate_ops
 
+    if trace is not None:
+        from hypervisor_tpu.observability import tracing
+
+        root_stamp = tracing.WaveStamps(trace_ctx, "governance_wave")
+        root_stamp.begin("governance_wave", lane=slot.shape[0])
+        trace = root_stamp.commit(trace)
+
+        def _phase_stamps():
+            return tracing.WaveStamps(trace_ctx, "governance_wave")
     n_cap = agents.did.shape[0]
     now_f = jnp.asarray(now, jnp.float32)
 
@@ -256,6 +279,8 @@ def governance_wave(
     )[slot]
 
     # ── 2. admission onto the tables ─────────────────────────────────
+    # The nested op stamps its own hv.admission_wave rows under a
+    # re-rooted child context, so its span nests under this wave's root.
     admitted = admission_ops.admit_batch(
         agents,
         sessions,
@@ -272,9 +297,14 @@ def governance_wave(
         ring_bursts=ring_bursts,
         unique_sessions=unique_sessions,
         metrics=metrics,
+        trace=trace,
+        trace_ctx=(
+            trace_ctx.child("admission_wave") if trace is not None else None
+        ),
     )
     agents, sessions = admitted.agents, admitted.sessions
     metrics = admitted.metrics
+    trace = admitted.trace
     ok = admitted.status == admission_ops.ADMIT_OK
 
     # ── 3. session FSM: HANDSHAKING -> ACTIVE where populated ────────
@@ -367,6 +397,21 @@ def governance_wave(
         metrics = metrics_ops.counter_inc(
             metrics, metrics_schema.BONDS_RELEASED.index, released
         )
+    if trace is not None:
+        # The remaining phase stamps + the root end, ONE fused ring
+        # scatter. Phase order must match WAVE_CHILD_STAGES (the host
+        # mirror replays that sequence; mode-parity-tested).
+        stamps = _phase_stamps()
+        stamps.begin("session_fsm", lane=k)
+        stamps.end("session_fsm", lane=k)
+        stamps.begin("delta_chain", lane=t)
+        stamps.end("delta_chain", lane=t)
+        stamps.begin("saga_round", lane=slot.shape[0])
+        stamps.end("saga_round", lane=slot.shape[0])
+        stamps.begin("terminate_wave", lane=k)
+        stamps.end("terminate_wave", lane=k)
+        stamps.end("governance_wave", lane=slot.shape[0])
+        trace = stamps.commit(trace)
     return WaveResult(
         agents=agents,
         sessions=sessions,
@@ -380,4 +425,5 @@ def governance_wave(
         fsm_error=fsm_err,
         released=released,
         metrics=metrics,
+        trace=trace,
     )

@@ -142,6 +142,8 @@ def saga_table_tick(
     exec_attempted: jnp.ndarray | None = None,  # bool[G] cursor step dispatched
     undo_attempted: jnp.ndarray | None = None,  # bool[G] undo target dispatched
     metrics=None,  # MetricsTable riding the tick (None -> None returned)
+    trace=None,       # TraceLog riding the tick (flight recorder)
+    trace_ctx=None,   # observability.tracing.TraceContext scalars
 ):
     """Advance EVERY saga in the table by one scheduling round.
 
@@ -163,10 +165,12 @@ def saga_table_tick(
     failed compensation ("Joint Liability slashing triggered"), else
     COMPLETED. RUNNING sagas whose cursor passed the last step COMPLETE.
 
-    Returns (step_state, retries_left, saga_state, cursor, metrics)
-    updated — the fifth element is the updated MetricsTable when one
-    rode in (step commit/fail tallies accumulate in-tick, pure scatter
-    adds with no host transfer), else None.
+    Returns (step_state, retries_left, saga_state, cursor, metrics,
+    trace) updated — the fifth element is the updated MetricsTable when
+    one rode in (step commit/fail tallies accumulate in-tick, pure
+    scatter adds with no host transfer), the sixth the updated TraceLog
+    when the flight-recorder ring rode in (hv.saga_round begin/end
+    stamps, same no-host-transfer contract); else None each.
     """
     g, m = step_state.shape
     rows = jnp.arange(g, dtype=jnp.int32)
@@ -236,8 +240,15 @@ def saga_table_tick(
         jnp.where(settled, SAGA_COMPLETED, saga_state),
     ).astype(saga_state.dtype)
 
+    if trace is not None:
+        from hypervisor_tpu.observability import tracing
+
+        stamps = tracing.WaveStamps(trace_ctx, "saga_round")
+        stamps.begin("saga_round", lane=g)
+        stamps.end("saga_round", lane=g)
+        trace = stamps.commit(trace)
     if metrics is None:
-        return step_state, retries_left, saga_state, cursor, None
+        return step_state, retries_left, saga_state, cursor, None, trace
     from hypervisor_tpu.observability import metrics as metrics_schema
     from hypervisor_tpu.tables import metrics as metrics_ops
 
@@ -251,7 +262,7 @@ def saga_table_tick(
         metrics_schema.SAGA_STEPS_FAILED.index,
         jnp.sum(exhausted.astype(jnp.int32)),
     )
-    return step_state, retries_left, saga_state, cursor, metrics
+    return step_state, retries_left, saga_state, cursor, metrics, trace
 
 
 def saga_table_done(saga_state: jnp.ndarray, session: jnp.ndarray) -> jnp.ndarray:

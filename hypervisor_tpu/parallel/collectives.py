@@ -42,6 +42,33 @@ from hypervisor_tpu.tables.state import (
 from hypervisor_tpu.tables.struct import replace as t_replace
 
 
+def _axis_size(axis_name):
+    """Traced size of a mesh axis inside shard_map.
+
+    `jax.lax.axis_size` only exists on newer jax; the psum-of-ones form
+    is the portable identity (same value, one tiny collective the
+    partitioner folds into the surrounding program).
+    """
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def _pcast_varying(x, axis_name):
+    """Mark `x` device-varying over `axis_name` where this jax tracks it.
+
+    Newer shard_map tracks varying-axes in loop-carry types, and a
+    replicated value mixed with ppermute outputs must be cast first
+    (`jax.lax.pcast`). Older jax has no such tracking — and no pcast —
+    so the value is usable as-is.
+    """
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is not None:
+        return fn(x, axis_name, to="varying")
+    return x
+
+
 def _linear_shard_index(multislice: bool):
     """This shard's index into the GLOBAL slice-major row layout.
 
@@ -54,7 +81,7 @@ def _linear_shard_index(multislice: bool):
     writes."""
     if multislice:
         return (
-            jax.lax.axis_index(DCN_AXIS) * jax.lax.axis_size(AGENT_AXIS)
+            jax.lax.axis_index(DCN_AXIS) * _axis_size(AGENT_AXIS)
             + jax.lax.axis_index(AGENT_AXIS)
         )
     return jax.lax.axis_index(AGENT_AXIS)
@@ -476,8 +503,9 @@ def sharded_chain(mesh: Mesh):
         my = jax.lax.axis_index(AGENT_AXIS)
         # The replicated seed must become device-varying before it feeds
         # loop carries that mix with ppermute outputs (shard_map tracks
-        # varying-axes in carry types).
-        seed = jax.lax.pcast(seed, AGENT_AXIS, to="varying")
+        # varying-axes in carry types on jax that has pcast; a no-op on
+        # older jax, which has no such tracking).
+        seed = _pcast_varying(seed, AGENT_AXIS)
 
         # Stage my's incoming carry: shards process in ring order; the
         # carry visits shard d at step d.

@@ -33,6 +33,7 @@ from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.models import SessionConfig, SessionState
 from hypervisor_tpu.observability import profiling
 from hypervisor_tpu.observability import metrics as metrics_plane
+from hypervisor_tpu.observability import tracing as trace_plane
 from hypervisor_tpu.ops import admission, rate_limit, saga_ops, security_ops
 from hypervisor_tpu.ops import gateway as gateway_ops
 from hypervisor_tpu.ops import liability as liability_ops
@@ -77,7 +78,7 @@ _WAVE = jax.jit(
 _WAVE_DONATED = jax.jit(
     pipeline_ops.governance_wave,
     static_argnames=("use_pallas", "unique_sessions"),
-    donate_argnames=("agents", "sessions", "vouches", "metrics"),
+    donate_argnames=("agents", "sessions", "vouches", "metrics", "trace"),
 )
 _RECORD_CALLS = jax.jit(
     security_ops.record_calls, static_argnames=("config",)
@@ -187,6 +188,13 @@ class HypervisorState:
         # `self.metrics.table` through and commit the returned update;
         # `metrics_snapshot()` is the ONE device_get, outside every wave.
         self.metrics = metrics_plane.Metrics()
+        # Flight recorder (trace plane): the TraceLog ring rides the
+        # jitted waves exactly like the metrics table (stamp scatters,
+        # no host transfer), the host side brackets every dispatch with
+        # wall-clock + a CausalTraceId, and `tracer.drain()` is the ONE
+        # device_get — outside every wave. HV_TRACE=0 disables;
+        # HV_TRACE_SAMPLE sets the head-based per-session sample rate.
+        self.tracer = trace_plane.Tracer(capacity=cap.trace_log_capacity)
 
         self.agent_ids = InternTable()
         self.session_ids = InternTable()
@@ -541,6 +549,17 @@ class HypervisorState:
             omega,
         )
         gw_result = None
+        # Flight-recorder bracket: one wave record + CausalTraceId per
+        # dispatch. Single-device programs carry the TraceLog and stamp
+        # in-jit; sharded programs (no table — unresolved shard layout)
+        # mirror the same rows on the host plane below.
+        th = self.tracer.begin_wave(
+            "governance_wave_sharded" if mesh is not None
+            else "governance_wave",
+            sessions=wave_sessions[:k],
+            lanes=b,
+            device=mesh is None,
+        )
         if mesh is not None:
             with_gateway = actions is not None
             multislice = _is_multislice(mesh)
@@ -633,8 +652,11 @@ class HypervisorState:
                     wave_range=wave_range,
                     unique_sessions=unique_sessions,
                     metrics=self.metrics.table,
+                    trace=self.tracer.table,
+                    trace_ctx=th.ctx if th is not None else None,
                 )
             self.metrics.commit(result.metrics)
+            self.tracer.end_wave(th, result.trace)
         self.agents = result.agents
         self.sessions = result.sessions
         self.vouches = result.vouches
@@ -667,6 +689,11 @@ class HypervisorState:
             # session states: STRONG lanes folded into the replicated
             # table in-wave; EVENTUAL lanes' masked overwrites ride the
             # partials — merge both, gather the k real wave sessions.
+            # Host-plane mirror of the in-wave trace stamps (the shared
+            # WAVE_CHILD_STAGES rule set — same pattern as
+            # tally_wave_host below; mode-parity-tested).
+            self.tracer.stamp_wave_host(th)
+            self.tracer.end_wave(th)
             sess_state = _MERGE_WAVE_SESSION_STATES(
                 partials.owned, partials.state,
                 result.sessions.state, jnp.asarray(wave_sessions[:k]),
@@ -842,6 +869,11 @@ class HypervisorState:
             dids = np.array([r[1] for r in rows], np.int32)
             duplicate = np.array([r[3] for r in rows], bool)
 
+            th = self.tracer.begin_wave(
+                "admission_wave",
+                sessions=np.unique(np.asarray(session_slots, np.int64)),
+                lanes=n,
+            )
             with self.metrics.stage("admission_wave"):
                 result = self._admit(
                     self.agents,
@@ -855,8 +887,11 @@ class HypervisorState:
                     now,
                     ring_bursts=self._ring_bursts,
                     metrics=self.metrics.table,
+                    trace=self.tracer.table,
+                    trace_ctx=th.ctx if th is not None else None,
                 )
             self.metrics.commit(result.metrics)
+            self.tracer.end_wave(th, result.trace)
             self.agents = result.agents
             self.sessions = result.sessions
             status = np.asarray(result.status)
@@ -1023,6 +1058,9 @@ class HypervisorState:
 
         n = self.agents.sigma_eff.shape[0]
         seeds = jnp.zeros((n,), bool).at[vouchee_slot].set(True)
+        th = self.tracer.begin_wave(
+            "slash_cascade", sessions=(session_slot,), lanes=n
+        )
         with self.metrics.stage("slash_cascade"):
             result = _SLASH(
                 self.vouches,
@@ -1032,8 +1070,11 @@ class HypervisorState:
                 risk_weight,
                 now,
                 metrics=self.metrics.table,
+                trace=self.tracer.table,
+                trace_ctx=th.ctx if th is not None else None,
             )
         self.metrics.commit(result.metrics)
+        self.tracer.end_wave(th, result.trace)
         touched = result.slashed | result.clipped
         new_rings = ring_ops.compute_rings(result.sigma, False)
         self.agents = replace(
@@ -1319,8 +1360,9 @@ class HypervisorState:
         for slot, ok in (undo_outcomes or {}).items():
             undo_success[slot] = ok
             undo_attempted[slot] = True
+        th = self.tracer.begin_wave("saga_round", lanes=g_cap)
         with self.metrics.stage("saga_round"):
-            step_state, retries_left, saga_state, cursor, m_table = (
+            step_state, retries_left, saga_state, cursor, m_table, t_table = (
                 self._saga_tick(
                     self.sagas.step_state,
                     self.sagas.retries_left,
@@ -1333,9 +1375,12 @@ class HypervisorState:
                     jnp.asarray(exec_attempted),
                     jnp.asarray(undo_attempted),
                     metrics=self.metrics.table,
+                    trace=self.tracer.table,
+                    trace_ctx=th.ctx if th is not None else None,
                 )
             )
         self.metrics.commit(m_table)
+        self.tracer.end_wave(th, t_table)
         self.sagas = replace(
             self.sagas,
             step_state=step_state,
@@ -1494,6 +1539,7 @@ class HypervisorState:
 
         valid = np.zeros((padded,), bool)
         valid[:b] = True
+        th = self.tracer.begin_wave("gateway_wave", lanes=b)
         with self.metrics.stage("gateway_wave"):
             result = _GATEWAY(
                 self.agents,
@@ -1510,8 +1556,11 @@ class HypervisorState:
                 rate_limit=self.config.rate_limit,
                 trust=self.config.trust,
                 metrics=self.metrics.table,
+                trace=self.tracer.table,
+                trace_ctx=th.ctx if th is not None else None,
             )
         self.metrics.commit(result.metrics)
+        self.tracer.end_wave(th, result.trace)
         self.agents = result.agents
         return gateway_ops.GatewayResult(
             agents=result.agents,
@@ -1718,10 +1767,15 @@ class HypervisorState:
                 trust=self.config.trust,
             )
             self._sharded_waves[("gateway", mesh)] = fn
+        th = self.tracer.begin_wave(
+            "gateway_wave_sharded", lanes=b, device=False
+        )
         with self.metrics.stage("gateway_wave_sharded"):
             agents_out, lanes = fn(
                 self.agents, self.elevations, *device_args, now
             )
+        self.tracer.stamp_wave_host(th)
+        self.tracer.end_wave(th)
         self.agents = agents_out
         out = self._scatter_gateway_lanes(lanes, flat, valid, b, agents_out)
         metrics_plane.tally_gateway_host(self.metrics, out.verdict, b)
@@ -2003,12 +2057,20 @@ class HypervisorState:
         bodies = np.zeros((t_max, lanes, merkle_ops.BODY_WORDS), np.uint32)
         bodies[t_pos, lane_idx] = packed
 
+        th = self.tracer.begin_wave(
+            "delta_chain",
+            sessions=np.unique(sess_arr),
+            lanes=b,
+            device=False,
+        )
         with self.metrics.stage("delta_chain"):
             digests = np.array(
                 merkle_ops.chain_digests(
                     jnp.asarray(bodies), jnp.asarray(seeds), use_pallas
                 )
             )  # [T, L, 8] (copy: explicit leaves overwrite below)
+        self.tracer.stamp_wave_host(th)
+        self.tracer.end_wave(th)
 
         # Explicit leaf digests (facade mode) override the chain digest.
         for i, (_s, _a, _c, _t, digest) in enumerate(staged):
@@ -2128,6 +2190,12 @@ class HypervisorState:
         # gathers, no [S_cap] mask scatter (ops/terminate.py wave_range).
         slot_arr = np.array(slots, np.int32)
         wave_range = _contiguous_range(slot_arr)
+        # Terminate dispatches stamp on the host plane (the program
+        # does not carry the ring; its in-wave twin is the pipeline's
+        # terminate phase stamp).
+        th = self.tracer.begin_wave(
+            "terminate_wave", sessions=slots, lanes=k, device=False
+        )
         with self.metrics.stage("terminate_wave"):
             result = self._terminate(
                 self.agents,
@@ -2140,6 +2208,8 @@ class HypervisorState:
                 use_pallas=use_pallas,
                 wave_range=wave_range,
             )
+        self.tracer.stamp_wave_host(th)
+        self.tracer.end_wave(th)
         self.agents = result.agents
         self.sessions = result.sessions
         self.vouches = result.vouches
@@ -2205,6 +2275,57 @@ class HypervisorState:
     def metrics_prometheus(self) -> str:
         """Prometheus text exposition of the merged metrics plane."""
         return self.metrics_snapshot().to_prometheus()
+
+    # ── trace drain ──────────────────────────────────────────────────
+
+    def session_slot_of(self, session_id: str) -> Optional[int]:
+        """Resolve a session id to its table slot (None if unknown).
+
+        Interning gives the sid handle; the slot is wherever the sid
+        column holds it — an O(S) scan acceptable for the debug/trace
+        endpoints that use it (the facade's hot paths carry slots).
+        """
+        sid = self.session_ids.lookup(session_id)
+        if sid < 0:
+            return None
+        hits = np.nonzero(np.asarray(self.sessions.sid) == sid)[0]
+        return int(hits[-1]) if len(hits) else None
+
+    def session_trace(self, session_slot: int) -> list:
+        """Reconstructed flight-recorder spans of every wave that
+        touched this session slot (`observability.tracing.Tracer`) —
+        one device_get, outside every wave.
+
+        The newest wave's `delta_chain` span (or its root, when the
+        wave has no such phase) is annotated with the session's DeltaLog
+        audit records — turn numbers and chain-digest heads from the
+        audit index — so the trace shows the session's current audit
+        tail next to the wave that last touched it.
+        """
+        spans = self.tracer.session_spans(session_slot)
+        rows = self._audit_rows.get(session_slot, [])
+        if spans and rows:
+            digest_host = np.asarray(self.delta_log.digest)
+            turn_host = np.asarray(self.delta_log.turn)
+            root = spans[-1]
+            target = next(
+                (s for s in root.walk() if s.stage == "delta_chain"), root
+            )
+            target.events.extend(
+                {
+                    "name": "audit.delta_recorded",
+                    "session_slot": session_slot,
+                    "log_row": int(r),
+                    "turn": int(turn_host[r]),
+                    "digest_head": f"{int(digest_host[r][0]):08x}",
+                }
+                for r in rows[-16:]  # newest records; keep payloads small
+            )
+        return spans
+
+    def flight_summary(self) -> dict:
+        """The /debug/flight payload: recorder state + recent waves."""
+        return self.tracer.flight_summary()
 
     # ── views ────────────────────────────────────────────────────────
 

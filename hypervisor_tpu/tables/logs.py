@@ -58,12 +58,18 @@ class DeltaLog:
 
 @table
 class EventLog:
-    """[C] ring buffer of typed events (EventType.code / slots / trace ids)."""
+    """[C] ring buffer of typed events (EventType.code / slots / trace ids).
+
+    `trace`/`span` hold the `causal_trace.device_key()` word pair, so
+    device event rows, host bus rows, and `TraceLog` stamps all join on
+    the same (trace, span) u32 keys.
+    """
 
     event_type: jnp.ndarray  # i32[C] EventType.code (-1 = empty)
     session: jnp.ndarray     # i32[C] session slot
     agent: jnp.ndarray       # i32[C] agent slot
-    trace: jnp.ndarray       # u32[C] causal trace hash
+    trace: jnp.ndarray       # u32[C] causal trace word (device_key()[0])
+    span: jnp.ndarray        # u32[C] causal span word (device_key()[1])
     timestamp: jnp.ndarray   # f32[C]
     cursor: jnp.ndarray      # i32[]
 
@@ -74,6 +80,7 @@ class EventLog:
             session=jnp.full((capacity,), -1, jnp.int32),
             agent=jnp.full((capacity,), -1, jnp.int32),
             trace=jnp.zeros((capacity,), jnp.uint32),
+            span=jnp.zeros((capacity,), jnp.uint32),
             timestamp=jnp.zeros((capacity,), jnp.float32),
             cursor=jnp.zeros((), jnp.int32),
         )
@@ -85,15 +92,19 @@ class EventLog:
         agents: jnp.ndarray,
         traces: jnp.ndarray,
         timestamps: jnp.ndarray,
+        spans: jnp.ndarray | None = None,
     ) -> "EventLog":
         capacity = self.event_type.shape[0]
         b = event_types.shape[0]
         idx = (self.cursor + jnp.arange(b, dtype=jnp.int32)) % capacity
+        if spans is None:
+            spans = jnp.zeros((b,), jnp.uint32)
         return EventLog(
             event_type=self.event_type.at[idx].set(event_types),
             session=self.session.at[idx].set(sessions),
             agent=self.agent.at[idx].set(agents),
             trace=self.trace.at[idx].set(traces),
+            span=self.span.at[idx].set(spans),
             timestamp=self.timestamp.at[idx].set(timestamps),
             cursor=self.cursor + b,
         )
@@ -104,3 +115,85 @@ class EventLog:
         return jnp.zeros((n_types,), jnp.int32).at[
             jnp.clip(self.event_type, 0)
         ].add(jnp.where(live, 1, 0))
+
+
+@table
+class TraceLog:
+    """[C] in-jit flight-recorder ring: stage begin/end stamps per wave.
+
+    The jitted waves append rows as pure ring-buffer scatters (the same
+    `dynamic_update_slice`-at-cursor idiom as the other logs — no
+    callback, no infeed, pinned by a lowering test). Each row is one
+    structural stamp: `(trace, span)` are the wave's
+    `causal_trace.device_key()` words (children derive via
+    `observability.tracing.child_span_word`, recomputable on host),
+    `stage` indexes `observability.tracing.TRACE_STAGES`, `kind` is
+    begin/end, `seq` is the pre-wrap cursor position — the device
+    "timestamp word". There is no readable wall clock inside a lowered
+    program, so `seq` is a LOGICAL clock: it totals-orders the stamps
+    of a wave (begin/end nesting reconstructs from it); real times come
+    from the host bracket around the dispatch
+    (`observability.tracing.Tracer`).
+
+    Head-based sampling costs one predicated store: an unsampled wave's
+    rows scatter to the out-of-bounds index and XLA drops them, and the
+    cursor does not advance.
+    """
+
+    trace: jnp.ndarray     # u32[C] trace word (device_key()[0])
+    span: jnp.ndarray      # u32[C] span word of the stamped span
+    stage: jnp.ndarray     # i32[C] tracing.TRACE_STAGES index
+    kind: jnp.ndarray      # i32[C] 0 = begin, 1 = end
+    lane: jnp.ndarray      # i32[C] lane/session scope (-1 = wave scope)
+    wave_seq: jnp.ndarray  # i32[C] host wave sequence number (-1 = empty)
+    seq: jnp.ndarray       # u32[C] pre-wrap cursor ordinal (logical clock)
+    cursor: jnp.ndarray    # i32[] next write position (monotonic)
+
+    @staticmethod
+    def create(capacity: int) -> "TraceLog":
+        return TraceLog(
+            trace=jnp.zeros((capacity,), jnp.uint32),
+            span=jnp.zeros((capacity,), jnp.uint32),
+            stage=jnp.zeros((capacity,), jnp.int32),
+            kind=jnp.zeros((capacity,), jnp.int32),
+            lane=jnp.full((capacity,), -1, jnp.int32),
+            wave_seq=jnp.full((capacity,), -1, jnp.int32),
+            seq=jnp.zeros((capacity,), jnp.uint32),
+            cursor=jnp.zeros((), jnp.int32),
+        )
+
+    def stamp_batch(
+        self,
+        traces: jnp.ndarray,    # u32[B]
+        spans: jnp.ndarray,     # u32[B]
+        stages: jnp.ndarray,    # i32[B]
+        kinds: jnp.ndarray,     # i32[B]
+        lanes: jnp.ndarray,     # i32[B]
+        wave_seqs: jnp.ndarray,  # i32[B]
+        sampled: jnp.ndarray | bool = True,  # bool[] wave sample bit
+    ) -> "TraceLog":
+        """Append B stamps at the cursor; unsampled waves drop all rows.
+
+        `sampled` is a traced scalar (the head-based decision resolved
+        on host and carried into the wave), so sampled and unsampled
+        waves share one compiled program — masking only redirects the
+        scatter out of bounds (`mode="drop"`).
+        """
+        capacity = self.trace.shape[0]
+        b = traces.shape[0]
+        sampled = jnp.asarray(sampled, bool)
+        pos = self.cursor + jnp.arange(b, dtype=jnp.int32)
+        idx = jnp.where(sampled, pos % capacity, capacity)  # OOB -> dropped
+        drop = dict(mode="drop", unique_indices=True)
+        return TraceLog(
+            trace=self.trace.at[idx].set(traces.astype(jnp.uint32), **drop),
+            span=self.span.at[idx].set(spans.astype(jnp.uint32), **drop),
+            stage=self.stage.at[idx].set(stages.astype(jnp.int32), **drop),
+            kind=self.kind.at[idx].set(kinds.astype(jnp.int32), **drop),
+            lane=self.lane.at[idx].set(lanes.astype(jnp.int32), **drop),
+            wave_seq=self.wave_seq.at[idx].set(
+                wave_seqs.astype(jnp.int32), **drop
+            ),
+            seq=self.seq.at[idx].set(pos.astype(jnp.uint32), **drop),
+            cursor=self.cursor + jnp.where(sampled, b, 0),
+        )

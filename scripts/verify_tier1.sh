@@ -4,8 +4,14 @@
 #      no accelerator tunnel touched),
 #   2. a metrics-plane smoke check — drive one governance wave and
 #      assert the device counters moved and /metrics-style exposition
-#      renders.
-# Exits non-zero if either fails; prints DOTS_PASSED for trend tracking.
+#      renders,
+#   3. a trace-plane smoke check — the same wave must yield a
+#      reconstructed flight-recorder trace (>= 5 nested hv.<stage>
+#      spans) exporting as well-formed Chrome trace JSON, and the
+#      stamped wave's lowering must contain NO host transfer
+#      (callback/infeed/outfeed) — the gate fails on any lowering that
+#      pulls one into a stamped program.
+# Exits non-zero if any fails; prints DOTS_PASSED for trend tracking.
 
 set -u -o pipefail
 
@@ -45,6 +51,70 @@ print("metrics plane OK: wave ticked, counters drained, exposition renders")
 PY
 smoke_rc=$?
 
+echo "── trace-plane smoke check ──"
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.observability import tracing
+from hypervisor_tpu.state import HypervisorState
+
+st = HypervisorState()
+slots = st.create_sessions_batch(["tsmoke:a", "tsmoke:b"],
+                                 SessionConfig(min_sigma_eff=0.0))
+st.run_governance_wave(
+    slots, ["did:tsmoke:0", "did:tsmoke:1"], slots.copy(),
+    np.full(2, 0.8, np.float32), np.zeros((1, 2, 16), np.uint32),
+)
+spans = st.tracer.drain()
+roots = [s for s in spans if s.stage == "governance_wave"]
+assert roots, "no governance_wave trace reconstructed"
+children = [c.stage for c in roots[0].children]
+assert len(children) >= 5, children
+assert children == list(tracing.WAVE_CHILD_STAGES["governance_wave"]), children
+doc = json.loads(json.dumps(tracing.to_chrome_trace(spans, st.tracer)))
+names = [e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"]
+assert "hv.governance_wave" in names and "hv.admission_wave" in names, names
+
+# Lowering gate: the stamped wave must introduce NO host transfer.
+from hypervisor_tpu.observability import metrics as mp
+from hypervisor_tpu.ops.pipeline import governance_wave
+from hypervisor_tpu.tables.logs import TraceLog
+from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+b = 4
+agents, sessions, vouches = (
+    AgentTable.create(16), SessionTable.create(16), VouchTable.create(8))
+sessions = t_replace(sessions, state=sessions.state.at[:b].set(1))
+ctx = tracing.TraceContext(
+    trace=jnp.uint32(1), span=jnp.uint32(2),
+    wave_seq=jnp.int32(0), sampled=jnp.asarray(True),
+)
+jaxpr = str(jax.make_jaxpr(
+    lambda *a: governance_wave(
+        *a, use_pallas=False, metrics=mp.REGISTRY.create_table(),
+        trace=TraceLog.create(64), trace_ctx=ctx,
+    )
+)(
+    agents, sessions, vouches,
+    jnp.arange(b, dtype=jnp.int32), jnp.arange(b, dtype=jnp.int32),
+    jnp.arange(b, dtype=jnp.int32), jnp.full((b,), 0.8, jnp.float32),
+    jnp.ones((b,), bool), jnp.zeros((b,), bool),
+    jnp.arange(b, dtype=jnp.int32), jnp.zeros((2, b, 16), jnp.uint32), 0.0,
+))
+for forbidden in ("callback", "infeed", "outfeed"):
+    assert forbidden not in jaxpr, f"{forbidden} in stamped wave lowering"
+print("trace plane OK: wave reconstructed (root + "
+      f"{len(children)} nested spans), Chrome export well-formed, "
+      "stamped lowering host-transfer-free")
+PY
+trace_rc=$?
+
 if [ "$rc" -ne 0 ]; then
     echo "tier-1 pytest FAILED (rc=$rc)" >&2
     exit "$rc"
@@ -52,5 +122,9 @@ fi
 if [ "$smoke_rc" -ne 0 ]; then
     echo "metrics smoke check FAILED (rc=$smoke_rc)" >&2
     exit "$smoke_rc"
+fi
+if [ "$trace_rc" -ne 0 ]; then
+    echo "trace smoke check FAILED (rc=$trace_rc)" >&2
+    exit "$trace_rc"
 fi
 echo "tier-1 gate PASSED"

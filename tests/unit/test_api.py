@@ -182,14 +182,17 @@ class TestHTTPTransport:
         # device-plane occupancy view the reference has no analog for),
         # the two quarantine views, the per-membership agent view, the
         # leave/sweep pair, the per-action gateway, its wave
-        # sibling (/actions/check-wave), and the Prometheus scrape
-        # (/metrics): 31 routes.
-        assert len(ROUTES) == 31
+        # sibling (/actions/check-wave), the Prometheus scrape
+        # (/metrics), and the flight recorder (/trace/{session_id} +
+        # /debug/flight): 33 routes.
+        assert len(ROUTES) == 33
         assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
         assert any(
             path == "/api/v1/security/quarantines" for _, path, _, _ in ROUTES
         )
         assert any(path == "/metrics" for _, path, _, _ in ROUTES)
+        assert any(path == "/trace/{session_id}" for _, path, _, _ in ROUTES)
+        assert any(path == "/debug/flight" for _, path, _, _ in ROUTES)
 
     def test_end_to_end_over_http(self):
         server = HypervisorHTTPServer().start()

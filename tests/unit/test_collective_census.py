@@ -46,6 +46,44 @@ def _census(compiled, op: str) -> int:
     return len(re.findall(re.escape(op) + r"[-.\"( ]", txt))
 
 
+def _lowering_is_census_faithful() -> bool:
+    """Capability probe: does ONE psum lower to ONE all-reduce here?
+
+    The census pins the fused wave's structural collective count, which
+    only means anything when the shard_map lowering is 1:1 — older jax
+    (observed on 0.4.37: a single psum compiles to 2 all-reduce ops,
+    two psums to 6) multiplies collectives in the compiled text, so the
+    structural floor is unreachable REGARDLESS of program structure.
+    Probing the actual lowering is honest where a version pin would
+    guess: any jax that lowers 1:1 runs the census.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(N_DEV, platform="cpu")
+    probe = jax.jit(
+        shard_map(
+            lambda x: jax.lax.psum(x, "agents"),
+            mesh=mesh, in_specs=P("agents"), out_specs=P(),
+        )
+    )
+    compiled = probe.lower(jnp.zeros((2 * N_DEV,), jnp.float32)).compile()
+    return _census(compiled, "all-reduce") == 1
+
+
+_census_faithful = pytest.mark.skipif(
+    not _lowering_is_census_faithful(),
+    reason=(
+        "this jax's shard_map lowering emits >1 all-reduce per psum "
+        "(capability probe); the structural census floor is "
+        "unreachable here regardless of program structure"
+    ),
+)
+
+
 def _wave_world(one_join_per_session: bool):
     b = 2 * N_DEV
     k = b if one_join_per_session else N_DEV
@@ -84,6 +122,7 @@ def _wave_world(one_join_per_session: bool):
 
 
 class TestFusedWaveCensus:
+    @_census_faithful
     def test_fastpath_wave_is_four_allreduces_zero_gathers(self):
         mesh = make_mesh(N_DEV, platform="cpu")
         args, b, k = _wave_world(one_join_per_session=True)
@@ -97,6 +136,7 @@ class TestFusedWaveCensus:
         assert _census(compiled, "all-gather") == 0
         assert _census(compiled, "all-to-all") == 0
 
+    @_census_faithful
     def test_mask_terminate_wave_adds_no_extra_allreduce(self):
         """The non-contiguous path's terminate membership mask must ride
         the admission count psum (fold_extra), not its own collective."""

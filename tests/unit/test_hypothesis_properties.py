@@ -708,3 +708,90 @@ class TestBreachDualPlaneProperties:
         assert int(severity[0]) == ladder(anom, len(calls)), (
             calls, int(severity[0]),
         )
+
+
+class TestCausalTraceDeviceKeyProperties:
+    """Flight-recorder join contract: the (trace, span) device-key words
+    are stable under every derivation and string round-trip, and the
+    host bus + device EventLog agree row-for-row for the same traffic."""
+
+    @settings(max_examples=60, deadline=None)
+    @given(st.lists(st.sampled_from(["child", "sibling"]), max_size=12))
+    def test_device_key_round_trips_through_string_form(self, walk):
+        from hypervisor_tpu.observability.causal_trace import (
+            CausalTraceId,
+            device_key_of,
+        )
+
+        span = CausalTraceId()
+        for step in walk:
+            span = span.child() if step == "child" else span.sibling()
+            parsed = CausalTraceId.from_string(span.full_id)
+            assert parsed.device_key() == span.device_key()
+            assert device_key_of(span.full_id) == span.device_key()
+            assert device_key_of(str(span)) == span.device_key()
+
+    @settings(max_examples=20, deadline=None)
+    @given(
+        st.lists(
+            st.tuples(
+                st.sampled_from(["child", "sibling", "stay"]),
+                st.integers(min_value=0, max_value=2),
+            ),
+            min_size=1,
+            max_size=16,
+        )
+    )
+    def test_bus_and_event_log_rows_join_on_identical_words(self, ops):
+        """Host-bus rows and device EventLog rows fed from the same
+        traffic carry identical (trace, span) word pairs — the whole
+        join the host span reconstruction relies on."""
+        from datetime import datetime, timezone
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from hypervisor_tpu.observability.causal_trace import CausalTraceId
+        from hypervisor_tpu.observability.event_bus import (
+            EventType,
+            HypervisorEvent,
+            HypervisorEventBus,
+        )
+        from hypervisor_tpu.tables.logs import EventLog
+
+        bus = HypervisorEventBus()
+        span = CausalTraceId()
+        expected = []
+        types = list(EventType)
+        for step, type_idx in ops:
+            if step == "child":
+                span = span.child()
+            elif step == "sibling":
+                span = span.sibling()
+            bus.emit(
+                HypervisorEvent(
+                    event_type=types[type_idx],
+                    session_id="prop:s",
+                    causal_trace_id=span.full_id,
+                    timestamp=datetime.now(timezone.utc),
+                )
+            )
+            expected.append(span.device_key())
+        codes, sess, agents, traces, stamps, spans = bus.device_rows(0)
+        assert list(zip(traces.tolist(), spans.tolist())) == expected
+        log = EventLog.create(32).append_batch(
+            jnp.asarray(codes),
+            jnp.asarray(sess),
+            jnp.asarray(agents),
+            jnp.asarray(traces),
+            jnp.asarray(stamps),
+            jnp.asarray(spans),
+        )
+        n = len(expected)
+        got = list(
+            zip(
+                np.asarray(log.trace)[:n].tolist(),
+                np.asarray(log.span)[:n].tolist(),
+            )
+        )
+        assert got == expected

@@ -202,8 +202,9 @@ class TestNoHostTransferInWave:
             step_state, retries, has_undo, saga_state, n_steps, cursor,
             success, jnp.zeros((g,), bool), metrics=table,
         )
-        assert len(out) == 5
+        assert len(out) == 6  # (..., metrics, trace)
         table = out[4]
+        assert out[5] is None  # no TraceLog rode this tick
         assert int(table.counters[mp.SAGA_STEPS_COMMITTED.index]) == 3
         assert int(table.counters[mp.SAGA_STEPS_FAILED.index]) == 1
 

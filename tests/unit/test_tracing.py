@@ -1,0 +1,550 @@
+"""Flight recorder: in-jit trace ring, span reconstruction, exporters.
+
+Pins the trace plane's contracts:
+
+  * ring math — `TraceLog.stamp_batch` appends at the cursor, wraps,
+    and drops every row of an unsampled wave (one predicated store:
+    the cursor does not move),
+  * in-jit stamping — the stamped governance wave lowers with NO host
+    transfer (no callback/infeed/outfeed primitive), same gate as the
+    metrics plane,
+  * span words — the device child-span derivation and the host
+    recomputation agree bit-for-bit, and `device_key_of` round-trips
+    through the `trace/span[/parent]` string form,
+  * reconstruction — one pipeline wave on the CPU backend yields a
+    root `hv.governance_wave` span with the five phase children of
+    `WAVE_CHILD_STAGES`, correctly nested (the acceptance criterion),
+  * mode parity — the sharded bridge's host-mirrored stamps reconstruct
+    the same child structure as the single-device in-jit stamps,
+  * exporters — valid Chrome `trace_event` JSON and OTLP-lite JSON,
+  * endpoints — `GET /trace/{session_id}` and `GET /debug/flight`
+    through the service layer,
+  * plane joins — host bus rows and device EventLog rows fed from the
+    same traffic carry identical (trace, span) device-key words.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.observability import tracing
+from hypervisor_tpu.observability.causal_trace import (
+    CausalTraceId,
+    device_key_of,
+    fnv1a32,
+)
+from hypervisor_tpu.tables.logs import TraceLog
+
+
+def _ctx(trace=7, span=9, wave_seq=0, sampled=True) -> tracing.TraceContext:
+    return tracing.TraceContext(
+        trace=jnp.asarray(trace, jnp.uint32),
+        span=jnp.asarray(span, jnp.uint32),
+        wave_seq=jnp.asarray(wave_seq, jnp.int32),
+        sampled=jnp.asarray(sampled, bool),
+    )
+
+
+def _session_config():
+    from hypervisor_tpu.models import SessionConfig
+
+    return SessionConfig(min_sigma_eff=0.0)
+
+
+def _drive_wave(state, tag: str, n: int = 2):
+    slots = state.create_sessions_batch(
+        [f"{tag}:{i}" for i in range(n)], _session_config()
+    )
+    state.run_governance_wave(
+        slots,
+        [f"did:{tag}:{i}" for i in range(n)],
+        slots.copy(),
+        np.full(n, 0.8, np.float32),
+        np.zeros((1, n, 16), np.uint32),
+    )
+    return slots
+
+
+class TestTraceRing:
+    def test_stamp_batch_appends(self):
+        log = TraceLog.create(8)
+        ctx = _ctx()
+        st = tracing.WaveStamps(ctx, "governance_wave")
+        st.begin("governance_wave")
+        st.begin("admission_wave")
+        st.end("admission_wave")
+        st.end("governance_wave")
+        out = st.commit(log)
+        assert int(out.cursor) == 4
+        assert np.asarray(out.wave_seq)[:4].tolist() == [0, 0, 0, 0]
+        assert np.asarray(out.kind)[:4].tolist() == [0, 0, 1, 1]
+        assert np.asarray(out.seq)[:4].tolist() == [0, 1, 2, 3]
+        # Root rows carry the context span; phase rows the derived word.
+        adm = tracing.child_span_word(9, tracing.STAGE_ID["admission_wave"])
+        assert np.asarray(out.span)[:4].tolist() == [9, adm, adm, 9]
+
+    def test_ring_wraps(self):
+        log = TraceLog.create(4)
+        for wave in range(3):
+            st = tracing.WaveStamps(_ctx(wave_seq=wave), "saga_round")
+            st.begin("saga_round")
+            st.end("saga_round")
+            log = st.commit(log)
+        assert int(log.cursor) == 6
+        # seq words survive the wrap: live rows are the 4 newest stamps.
+        assert sorted(np.asarray(log.seq).tolist()) == [2, 3, 4, 5]
+
+    def test_unsampled_wave_drops_rows(self):
+        log = TraceLog.create(8)
+        st = tracing.WaveStamps(_ctx(sampled=False), "gateway_wave")
+        st.begin("gateway_wave")
+        st.end("gateway_wave")
+        out = st.commit(log)
+        assert int(out.cursor) == 0
+        assert (np.asarray(out.wave_seq) == -1).all()
+
+    def test_sampled_flag_is_traced_not_static(self):
+        """One compiled program serves sampled and unsampled waves."""
+        log = TraceLog.create(8)
+
+        @jax.jit
+        def stamp(log, sampled):
+            ctx = _ctx(sampled=sampled)
+            st = tracing.WaveStamps(ctx, "saga_round")
+            st.begin("saga_round")
+            st.end("saga_round")
+            return st.commit(log)
+
+        on = stamp(log, jnp.asarray(True))
+        off = stamp(log, jnp.asarray(False))
+        assert int(on.cursor) == 2 and int(off.cursor) == 0
+        assert stamp._cache_size() == 1
+
+
+class TestSpanWords:
+    def test_child_word_host_device_agree(self):
+        for parent in (0, 9, 0xDEADBEEF, 0xFFFFFFFF):
+            for stage in range(len(tracing.TRACE_STAGES)):
+                host = tracing.child_span_word(parent, stage)
+                dev = int(
+                    tracing.child_span_word(
+                        jnp.asarray(parent, jnp.uint32), stage
+                    )
+                )
+                assert host == dev, (parent, stage)
+
+    def test_device_key_of_round_trips_full_ids(self):
+        """Seeded sweep twin of the hypothesis property: any span built
+        by child/sibling derivations keys identically after a string
+        round-trip — the join contract between bus, EventLog, and
+        TraceLog rows."""
+        rng = np.random.RandomState(11)
+        span = CausalTraceId()
+        for _ in range(64):
+            span = span.child() if rng.rand() < 0.5 else span.sibling()
+            parsed = CausalTraceId.from_string(span.full_id)
+            assert parsed.device_key() == span.device_key()
+            assert device_key_of(span.full_id) == span.device_key()
+
+    def test_device_key_of_bare_and_absent(self):
+        assert device_key_of(None) == (0, 0)
+        assert device_key_of("") == (0, 0)
+        assert device_key_of("opaque-id") == (fnv1a32("opaque-id"), 0)
+
+
+class TestLoweringGate:
+    def _wave_args(self, b=4):
+        from hypervisor_tpu.tables.state import (
+            AgentTable, SessionTable, VouchTable,
+        )
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        agents = AgentTable.create(16)
+        sessions = SessionTable.create(16)
+        sessions = t_replace(sessions, state=sessions.state.at[:b].set(1))
+        vouches = VouchTable.create(8)
+        return (
+            agents, sessions, vouches,
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.full((b,), 0.8, jnp.float32),
+            jnp.ones((b,), bool),
+            jnp.zeros((b,), bool),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.zeros((2, b, 16), jnp.uint32),
+            0.0,
+        )
+
+    def test_stamped_governance_wave_lowers_clean(self):
+        """The acceptance gate: flight-recorder stamps inside the jitted
+        wave must introduce no host transfer — no callback, infeed, or
+        outfeed primitive anywhere in the traced program (with the
+        metrics table riding too, the production configuration)."""
+        from hypervisor_tpu.observability import metrics as mp
+        from hypervisor_tpu.ops.pipeline import governance_wave
+
+        table = mp.REGISTRY.create_table()
+        log = TraceLog.create(64)
+        ctx = _ctx()
+        jaxpr = jax.make_jaxpr(
+            lambda *a: governance_wave(
+                *a, metrics=table, use_pallas=False,
+                trace=log, trace_ctx=ctx,
+            )
+        )(*self._wave_args())
+        text = str(jaxpr)
+        for forbidden in ("callback", "infeed", "outfeed"):
+            assert forbidden not in text, (
+                f"trace stamping pulled a {forbidden} into the wave"
+            )
+
+    def test_stamped_gateway_and_slash_lower_clean(self):
+        from hypervisor_tpu.ops import gateway as gateway_ops
+        from hypervisor_tpu.ops import liability as liability_ops
+        from hypervisor_tpu.tables.state import (
+            AgentTable, ElevationTable, VouchTable,
+        )
+
+        log = TraceLog.create(64)
+        ctx = _ctx()
+        b, n = 4, 16
+        agents = AgentTable.create(n)
+        false = jnp.zeros((b,), bool)
+        jaxpr = jax.make_jaxpr(
+            lambda *a: gateway_ops.check_actions(
+                *a, trace=log, trace_ctx=ctx
+            )
+        )(
+            agents, ElevationTable.create(4),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.full((b,), 2, jnp.int8),
+            false, false, false, false, 0.0,
+        )
+        text = str(jaxpr)
+        jaxpr2 = jax.make_jaxpr(
+            lambda *a: liability_ops.slash_cascade(
+                *a, trace=log, trace_ctx=ctx
+            )
+        )(
+            VouchTable.create(8),
+            jnp.full((n,), 0.8, jnp.float32),
+            jnp.zeros((n,), bool),
+            0, 0.9, 0.0,
+        )
+        text += str(jaxpr2)
+        for forbidden in ("callback", "infeed", "outfeed"):
+            assert forbidden not in text
+
+
+class TestReconstruction:
+    def test_pipeline_wave_yields_nested_stage_spans(self):
+        """Acceptance criterion: a single pipeline wave on the CPU
+        backend reconstructs to >= 5 correctly nested hv.<stage> spans."""
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        _drive_wave(st, "rec")
+        spans = st.tracer.drain()
+        roots = [s for s in spans if s.stage == "governance_wave"]
+        assert len(roots) == 1
+        root = roots[0]
+        assert root.name == "hv.governance_wave"
+        children = [c.stage for c in root.children]
+        assert children == list(
+            tracing.WAVE_CHILD_STAGES["governance_wave"]
+        )
+        assert len(children) >= 5
+        # Correct nesting: every child inside the root bracket, children
+        # sequential in stamp order, parent words correct.
+        prev_end = root.start_us
+        for child in root.children:
+            assert root.start_us <= child.start_us <= child.end_us
+            assert child.end_us <= root.end_us
+            assert child.start_us >= prev_end
+            prev_end = child.end_us
+            assert child.parent_span_word == root.span_word
+            assert child.span_word == tracing.child_span_word(
+                root.span_word, tracing.STAGE_ID[child.stage]
+            )
+
+    def test_admission_flush_traces_too(self):
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        slot = st.create_session("fl:s", _session_config())
+        st.enqueue_join(slot, "did:fl0", 0.8)
+        st.flush_joins()
+        spans = st.tracer.drain()
+        assert any(s.stage == "admission_wave" for s in spans)
+        assert spans == sorted(spans, key=lambda s: s.wave_seq)
+
+    def test_session_trace_filters_by_slot(self):
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        slots_a = _drive_wave(st, "fa")
+        slots_b = _drive_wave(st, "fb")
+        only_b = st.session_trace(int(slots_b[0]))
+        assert only_b and all(
+            int(slots_a[0])
+            not in st.tracer._waves[s.wave_seq].sessions
+            for s in only_b
+        )
+
+
+class TestSampling:
+    def test_sample_rate_zero_records_nothing_on_device(self):
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        st.tracer.sample_rate = 0.0
+        _drive_wave(st, "s0")
+        assert int(np.asarray(st.tracer.table.cursor)) == 0
+        assert st.tracer.drain() == []  # unsampled: no rows, no spans
+
+    def test_sample_bit_deterministic(self):
+        for key in ("a", "b", "slot:7"):
+            assert tracing._sample_bit(key, 0.5) == tracing._sample_bit(
+                key, 0.5
+            )
+        assert tracing._sample_bit("x", 1.0)
+        assert not tracing._sample_bit("x", 0.0)
+
+    def test_partial_rate_splits_sessions(self):
+        hits = sum(
+            tracing._sample_bit(f"slot:{i}", 0.5) for i in range(256)
+        )
+        assert 64 < hits < 192  # deterministic, roughly the rate
+
+
+class TestModeParity:
+    def test_mesh_wave_reconstructs_same_child_structure(self):
+        """The sharded bridge mirrors stamps on the host plane through
+        the same WAVE_CHILD_STAGES rule set the in-jit stamps follow —
+        both deployment modes reconstruct one structure."""
+        from hypervisor_tpu.parallel import make_mesh
+        from hypervisor_tpu.state import HypervisorState
+
+        n_dev, b = 4, 8
+
+        def run(mesh):
+            st = HypervisorState()
+            slots = st.create_sessions_batch(
+                [f"mp:{'m' if mesh else 's'}{i}" for i in range(b)],
+                _session_config(),
+            )
+            st.run_governance_wave(
+                slots,
+                [f"did:mp:{'m' if mesh else 's'}{i}" for i in range(b)],
+                slots.copy(),
+                np.full(b, 0.8, np.float32),
+                np.zeros((1, b, 16), np.uint32),
+                mesh=mesh,
+            )
+            return st.tracer.drain()
+
+        single = run(None)
+        mesh = run(make_mesh(n_dev, platform="cpu"))
+        s_root = [s for s in single if s.stage == "governance_wave"][0]
+        m_root = [
+            s for s in mesh if s.stage == "governance_wave_sharded"
+        ][0]
+        assert [c.stage for c in s_root.children] == [
+            c.stage for c in m_root.children
+        ]
+        assert [c.kind if hasattr(c, "kind") else 0 for c in s_root.children]
+        for s_child, m_child in zip(s_root.children, m_root.children):
+            assert s_child.parent_span_word == s_root.span_word
+            assert m_child.parent_span_word == m_root.span_word
+
+
+class TestExporters:
+    def _spans(self):
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        _drive_wave(st, "ex")
+        return st, st.tracer.drain()
+
+    def test_chrome_trace_event_json(self):
+        st, spans = self._spans()
+        doc = json.loads(json.dumps(tracing.to_chrome_trace(spans)))
+        assert isinstance(doc["traceEvents"], list)
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert len(xs) >= 6  # root + 5 phases
+        for e in xs:
+            assert e["name"].startswith("hv.")
+            assert isinstance(e["ts"], (int, float))
+            assert e["dur"] >= 0
+            assert e["pid"] == 1
+        names = {e["name"] for e in xs}
+        assert "hv.governance_wave" in names
+        assert "hv.admission_wave" in names
+
+    def test_otlp_lite_json(self):
+        st, spans = self._spans()
+        doc = json.loads(json.dumps(tracing.to_otlp(spans, st.tracer)))
+        otlp_spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert len(otlp_spans) >= 6
+        root = [s for s in otlp_spans if s["parentSpanId"] == ""][0]
+        assert len(root["traceId"]) == 32
+        assert len(root["spanId"]) == 16
+        children = [
+            s for s in otlp_spans if s["parentSpanId"] == root["spanId"]
+        ]
+        assert len(children) == 5
+        for s in otlp_spans:
+            assert s["endTimeUnixNano"] >= s["startTimeUnixNano"] > 0
+
+
+class TestEndpoints:
+    async def test_trace_endpoint_serves_chrome_json(self):
+        from hypervisor_tpu.api import models as M
+        from hypervisor_tpu.api.service import HypervisorService
+
+        svc = HypervisorService()
+        resp = await svc.create_session(
+            M.CreateSessionRequest(creator_did="did:admin")
+        )
+        await svc.join_session(
+            resp.session_id,
+            M.JoinSessionRequest(agent_did="did:tp", sigma_raw=0.8),
+        )
+        doc = await svc.trace_session(resp.session_id)
+        assert json.loads(json.dumps(doc))["traceEvents"]
+        assert any(
+            e["name"] == "hv.admission_wave"
+            for e in doc["traceEvents"]
+            if e.get("ph") == "X"
+        )
+        otlp = await svc.trace_session(resp.session_id, format="otlp")
+        assert otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+
+    async def test_pipeline_wave_served_with_nested_spans(self):
+        """The acceptance criterion end to end: a single pipeline wave
+        on the CPU backend, served via GET /trace/{session_id}, exports
+        valid Chrome trace JSON whose governance root carries the five
+        correctly nested hv.<stage> phase spans."""
+        from hypervisor_tpu.api.service import HypervisorService
+
+        svc = HypervisorService()
+        _drive_wave(svc.hv.state, "pipe")
+        doc = json.loads(json.dumps(await svc.trace_session("pipe:0")))
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        by_name = {e["name"]: e for e in xs}
+        root = by_name["hv.governance_wave"]
+        phases = [
+            e for e in xs
+            if e["args"]["parent_span"] == root["args"]["span"]
+        ]
+        assert len(phases) == 5
+        for e in phases:
+            assert root["ts"] <= e["ts"]
+            assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 1e-6
+        # The session's DeltaLog audit records ride the delta_chain span.
+        assert any(
+            e.get("name") == "audit.delta_recorded"
+            for e in doc["traceEvents"]
+            if e.get("ph") == "i"
+        )
+
+    async def test_trace_endpoint_errors(self):
+        from hypervisor_tpu.api.service import ApiError, HypervisorService
+
+        svc = HypervisorService()
+        with pytest.raises(ApiError) as err:
+            await svc.trace_session("nope")
+        assert err.value.status == 404
+
+    async def test_debug_flight(self):
+        from hypervisor_tpu.api import models as M
+        from hypervisor_tpu.api.service import HypervisorService
+
+        svc = HypervisorService()
+        resp = await svc.create_session(
+            M.CreateSessionRequest(creator_did="did:admin")
+        )
+        await svc.join_session(
+            resp.session_id,
+            M.JoinSessionRequest(agent_did="did:fl", sigma_raw=0.8),
+        )
+        flight = await svc.debug_flight()
+        assert flight["enabled"] is True
+        assert flight["waves_indexed"] >= 1
+        assert flight["recent_waves"][-1]["stage"].startswith("hv.")
+        assert "/" in flight["recent_waves"][-1]["trace_id"]
+
+
+class TestPlaneJoins:
+    def test_bus_and_event_log_share_device_key_words(self):
+        """Host bus rows and device EventLog rows fed from the same
+        traffic join on identical (trace, span) word pairs — seeded
+        sweep twin of the hypothesis property."""
+        from datetime import datetime, timezone
+
+        from hypervisor_tpu.observability.event_bus import (
+            EventType, HypervisorEvent, HypervisorEventBus,
+        )
+        from hypervisor_tpu.tables.logs import EventLog
+
+        rng = np.random.RandomState(3)
+        bus = HypervisorEventBus()
+        expected = []
+        span = CausalTraceId()
+        types = list(EventType)
+        for i in range(40):
+            span = span.child() if rng.rand() < 0.5 else span.sibling()
+            bus.emit(
+                HypervisorEvent(
+                    event_type=types[int(rng.randint(len(types)))],
+                    session_id=f"s{i % 3}",
+                    causal_trace_id=span.full_id,
+                    timestamp=datetime.now(timezone.utc),
+                )
+            )
+            expected.append(span.device_key())
+        codes, sess, agents, traces, stamps, spans = bus.device_rows(0)
+        log = EventLog.create(64).append_batch(
+            jnp.asarray(codes), jnp.asarray(sess), jnp.asarray(agents),
+            jnp.asarray(traces), jnp.asarray(stamps), jnp.asarray(spans),
+        )
+        got = list(
+            zip(
+                np.asarray(log.trace)[:40].tolist(),
+                np.asarray(log.span)[:40].tolist(),
+            )
+        )
+        assert got == expected
+
+    def test_attach_bus_events_joins_on_words(self):
+        from datetime import datetime, timezone
+
+        from hypervisor_tpu.observability.event_bus import (
+            EventType, HypervisorEvent, HypervisorEventBus,
+        )
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        _drive_wave(st, "bj")
+        spans = st.tracer.drain()
+        root = spans[0]
+        bus = HypervisorEventBus()
+        record = st.tracer._waves[root.wave_seq]
+        bus.emit(
+            HypervisorEvent(
+                event_type=EventType.SESSION_CREATED,
+                session_id="bj:0",
+                causal_trace_id=record.trace.full_id,
+                timestamp=datetime.now(timezone.utc),
+            )
+        )
+        attached = tracing.attach_bus_events(spans, bus)
+        assert attached == 1
+        assert root.events and root.events[0]["name"] == "session.created"

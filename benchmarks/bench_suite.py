@@ -448,6 +448,26 @@ def metrics_plane_report(results: list[dict]) -> dict:
     return report
 
 
+def _git_commit() -> str | None:
+    """Current commit hash, stamped into bench reports so a trajectory
+    row names the code it measured; None outside a git checkout."""
+    import subprocess
+
+    try:
+        return (
+            subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=Path(__file__).resolve().parent.parent,
+                capture_output=True,
+                text=True,
+                timeout=10,
+            ).stdout.strip()
+            or None
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=30)
@@ -459,7 +479,10 @@ def main() -> None:
         default=None,
         help=(
             "write a metrics-plane report (p50/p95 drawn from the "
-            "plane's histograms) to this path, e.g. BENCH_r06.json"
+            "plane's histograms) to this path, e.g. BENCH_r06.json — or "
+            "'auto' to land the next BENCH_r<NN>.json at the repo root "
+            "and refresh BENCH_trajectory.json (the perf-regression "
+            "gate's input, benchmarks/regression.py)"
         ),
     )
     ap.add_argument(
@@ -490,20 +513,34 @@ def main() -> None:
             )
 
     if args.metrics_out:
+        from benchmarks import regression
+
+        if args.metrics_out == "auto":
+            out_path = regression.next_round_path()
+        else:
+            out_path = Path(args.metrics_out)
         plane = metrics_plane_report(results)
         report = {
             "source": "benchmarks/bench_suite.py metrics plane",
             "device": str(device.device_kind),
             "backend": jax.default_backend(),
+            "git_commit": _git_commit(),
             "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "iterations": args.iters,
             "quick": args.quick,
             "pipeline_latency_us": plane.get("full_governance_pipeline"),
             "benchmarks": plane,
         }
-        Path(args.metrics_out).write_text(json.dumps(report, indent=2) + "\n")
+        out_path.write_text(json.dumps(report, indent=2) + "\n")
         if not args.json_only:
-            print(f"wrote metrics-plane report to {args.metrics_out}")
+            print(f"wrote metrics-plane report to {out_path}")
+        # A BENCH_r<NN>.json landing at the repo root is a new
+        # trajectory row: rebuild the cumulative file regression.py
+        # gates and hv_top.py renders.
+        if regression._ROUND_RE.search(out_path.name):
+            traj = regression.refresh_trajectory(out_path.parent)
+            if not args.json_only:
+                print(f"refreshed {traj}")
 
     results = [
         {k: v for k, v in r.items() if k != "_samples_ns"} for r in results

@@ -1,0 +1,317 @@
+"""Perf-regression harness over the committed `BENCH_r*.json` history.
+
+The repo has been publishing one `BENCH_r<NN>.json` per growth round
+but nothing consumed them — a regression on the headline envelope
+(≤268 µs p50 full-pipeline, BASELINE.md) would land unnoticed. This
+module closes the loop:
+
+  * **Trajectory** — `load_history()` parses every committed round.
+    Two formats exist: the *wrapper* form (r01–r05: the bench driver's
+    `{"n", "cmd", "rc", "tail", "parsed": {...}}` capture; failed runs
+    carry `rc != 0` and no parse) and the *suite* form (r06+:
+    `bench_suite.py --metrics-out` metrics-plane reports).
+    `write_trajectory()` folds them into one cumulative
+    `BENCH_trajectory.json` — the file `hv_top.py` renders and this
+    gate reads.
+  * **Gate** — `compare()` checks the NEWEST round against the median
+    of its *comparable* priors and fails on any per-bench p50 above
+    `baseline * (1 + tolerance)`. Rounds are comparable only when
+    format, backend, AND quick-flag match: cpu smoke numbers must
+    never gate tpu envelopes (or vice versa), and `--quick` batches
+    are a different workload than full-scale ones. Historical
+    fluctuation between OLD rounds never fails the gate — only the
+    tip is judged.
+
+Tolerance defaults are per-backend (`HV_BENCH_TOL` overrides): tpu
+runs are stable enough for 0.5 (fail at 1.5× the baseline); cpu runs
+on shared CI hosts get 3.0 (fail at 4×) so the tier-1 gate is
+non-flaky while still catching order-of-magnitude cliffs.
+
+CLI::
+
+    python benchmarks/regression.py                  # gate the newest round
+    python benchmarks/regression.py --check F.json   # gate a fresh report
+    python benchmarks/regression.py --tolerance 0.5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import statistics
+import sys
+from pathlib import Path
+from typing import Optional
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+#: Backend -> default tolerance band (fraction above baseline allowed).
+DEFAULT_TOLERANCE = {"tpu": 0.5, "cpu": 3.0}
+
+
+def _backend_of(device: str) -> str:
+    return "tpu" if "tpu" in (device or "").lower() else "cpu"
+
+
+def parse_round_file(path: Path) -> Optional[dict]:
+    """One trajectory row from one BENCH_r*.json, or None when the
+    round recorded a failed run (wrapper rc != 0) or an unknown shape."""
+    m = _ROUND_RE.search(path.name)
+    if not m:
+        return None
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    row = {
+        "round": int(m.group(1)),
+        "file": path.name,
+    }
+    if "benchmarks" in doc and isinstance(doc["benchmarks"], dict):
+        # Suite form: bench_suite.py metrics-plane report.
+        benches = {
+            name: rec["per_op_p50_us"]
+            for name, rec in doc["benchmarks"].items()
+            if isinstance(rec, dict) and "per_op_p50_us" in rec
+        }
+        headline = (doc.get("pipeline_latency_us") or {}).get(
+            "per_op_p50_us"
+        )
+        row.update(
+            format="suite",
+            backend=doc.get("backend", "cpu"),
+            device=doc.get("device", ""),
+            quick=bool(doc.get("quick", False)),
+            timestamp=doc.get("timestamp"),
+            git_commit=doc.get("git_commit"),
+            headline_per_op_us=headline,
+            benches=benches,
+        )
+        return row
+    if "parsed" in doc or "rc" in doc:
+        # Wrapper form: the bench driver capture. Failed runs (rc != 0)
+        # carry no numbers — kept out of the trajectory, never gated.
+        parsed = doc.get("parsed")
+        if doc.get("rc", 1) != 0 or not isinstance(parsed, dict):
+            return None
+        value = parsed.get("value")
+        if value is None:
+            return None
+        device = parsed.get("device", "")
+        row.update(
+            format="wrapper",
+            backend=_backend_of(device),
+            device=device,
+            quick=False,
+            timestamp=None,
+            git_commit=None,
+            headline_per_op_us=float(value),
+            benches={"full_governance_pipeline": float(value)},
+        )
+        return row
+    return None
+
+
+def load_history(root: Path = REPO_ROOT) -> list[dict]:
+    """Every parseable committed round, sorted by round number."""
+    rows = []
+    for path in sorted(root.glob("BENCH_r*.json")):
+        row = parse_round_file(path)
+        if row is not None:
+            rows.append(row)
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+def _comparable_key(row: dict) -> tuple:
+    return (row["format"], row["backend"], row["quick"])
+
+
+def build_trajectory(rows: list[dict]) -> dict:
+    return {
+        "source": "benchmarks/regression.py",
+        "rounds": rows,
+    }
+
+
+def write_trajectory(
+    rows: list[dict], path: Optional[Path] = None, root: Path = REPO_ROOT
+) -> Path:
+    """Write the cumulative trajectory (rebuilt from the round files —
+    append-by-rebuild keeps it consistent even if a round is amended)."""
+    path = path or (root / "BENCH_trajectory.json")
+    path.write_text(json.dumps(build_trajectory(rows), indent=2) + "\n")
+    return path
+
+
+def refresh_trajectory(root: Path = REPO_ROOT) -> Path:
+    """Re-scan the round files and rewrite BENCH_trajectory.json —
+    called by `bench_suite.py` right after it lands a new round."""
+    return write_trajectory(load_history(root), root=root)
+
+
+def baseline_for(current: dict, rows: list[dict]) -> tuple[dict, int]:
+    """Per-bench median over the comparable rounds BEFORE `current`."""
+    key = _comparable_key(current)
+    priors = [
+        r
+        for r in rows
+        if r["round"] < current["round"] and _comparable_key(r) == key
+    ]
+    per_bench: dict[str, list[float]] = {}
+    for r in priors:
+        for name, value in r["benches"].items():
+            if value is not None and value > 0:
+                per_bench.setdefault(name, []).append(float(value))
+    return (
+        {name: statistics.median(vs) for name, vs in per_bench.items()},
+        len(priors),
+    )
+
+
+def compare(
+    current: dict, rows: list[dict], tolerance: Optional[float] = None
+) -> dict:
+    """Gate `current` against its comparable baseline.
+
+    Returns {"ok", "tolerance", "baseline_rounds", "checked",
+    "regressions", "improvements", "skipped"} — `ok` is False iff any
+    bench's p50 exceeds `baseline_median * (1 + tolerance)`.
+    """
+    if tolerance is None:
+        env = os.environ.get("HV_BENCH_TOL")
+        tolerance = (
+            float(env)
+            if env
+            else DEFAULT_TOLERANCE.get(current["backend"], 3.0)
+        )
+    baseline, n_priors = baseline_for(current, rows)
+    regressions, improvements, checked = [], [], []
+    for name, value in sorted(current["benches"].items()):
+        base = baseline.get(name)
+        if base is None or value is None or value <= 0:
+            continue
+        ratio = value / base
+        entry = {
+            "bench": name,
+            "current_per_op_us": round(float(value), 4),
+            "baseline_per_op_us": round(base, 4),
+            "ratio": round(ratio, 3),
+        }
+        checked.append(entry)
+        if ratio > 1.0 + tolerance:
+            regressions.append(entry)
+        elif ratio < 1.0 / (1.0 + tolerance):
+            improvements.append(entry)
+    return {
+        "ok": not regressions,
+        "round": current["round"],
+        "file": current["file"],
+        "backend": current["backend"],
+        "quick": current["quick"],
+        "tolerance": tolerance,
+        "baseline_rounds": n_priors,
+        "checked": checked,
+        "regressions": regressions,
+        "improvements": improvements,
+        "skipped": sorted(set(current["benches"]) - set(baseline)),
+    }
+
+
+def next_round_path(root: Path = REPO_ROOT) -> Path:
+    """The next BENCH_r<NN>.json slot (bench_suite `--metrics-out auto`)."""
+    taken = [
+        int(m.group(1))
+        for p in root.glob("BENCH_r*.json")
+        if (m := _ROUND_RE.search(p.name))
+    ]
+    return root / f"BENCH_r{(max(taken, default=0) + 1):02d}.json"
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument(
+        "--root", type=Path, default=REPO_ROOT,
+        help="directory holding BENCH_r*.json (default: repo root)",
+    )
+    ap.add_argument(
+        "--check", type=Path, default=None,
+        help="gate this report instead of the newest committed round",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=None,
+        help="allowed fraction above baseline (default per backend: "
+        f"{DEFAULT_TOLERANCE}; env HV_BENCH_TOL overrides)",
+    )
+    ap.add_argument(
+        "--trajectory-out", type=Path, default=None,
+        help="trajectory path (default <root>/BENCH_trajectory.json)",
+    )
+    ap.add_argument(
+        "--no-write", action="store_true",
+        help="do not (re)write the trajectory file",
+    )
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    rows = load_history(args.root)
+    if not args.no_write:
+        path = write_trajectory(rows, args.trajectory_out, args.root)
+        if not args.quiet:
+            print(f"trajectory: {len(rows)} round(s) -> {path}")
+
+    if args.check is not None:
+        current = parse_round_file(args.check)
+        if current is None:
+            print(f"unparseable report: {args.check}", file=sys.stderr)
+            return 2
+    elif rows:
+        current = rows[-1]
+    else:
+        if not args.quiet:
+            print("no bench history — nothing to gate")
+        return 0
+
+    report = compare(current, rows, args.tolerance)
+    if not args.quiet:
+        print(
+            f"gate round r{report['round']:02d} ({report['backend']}"
+            f"{', quick' if report['quick'] else ''}) vs median of "
+            f"{report['baseline_rounds']} comparable prior round(s), "
+            f"tolerance +{report['tolerance'] * 100:.0f}%"
+        )
+        for entry in report["checked"]:
+            flag = (
+                "REGRESSION"
+                if entry in report["regressions"]
+                else "improved"
+                if entry in report["improvements"]
+                else "ok"
+            )
+            print(
+                f"  {entry['bench']:36s} {entry['current_per_op_us']:>12.4f} "
+                f"vs {entry['baseline_per_op_us']:>12.4f} µs/op "
+                f"(x{entry['ratio']:.2f}) {flag}"
+            )
+        if not report["checked"]:
+            print(
+                "  no comparable baseline (first round of its "
+                "format/backend/quick group) — gate passes vacuously"
+            )
+    if not report["ok"]:
+        print(
+            f"PERF REGRESSION: {len(report['regressions'])} bench(es) "
+            f"above tolerance in {report['file']}",
+            file=sys.stderr,
+        )
+        return 1
+    if not args.quiet:
+        print("perf-regression gate PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,240 @@
+"""hv_top: one-screen live view of the runtime health plane.
+
+The operator's glance: table occupancy (live/capacity/high-water/HBM
+bytes), compile telemetry (compiles, recompiles with the argument that
+forced them, donation failures), per-stage latency p50/p99, watchdog
+stragglers, and the bench trajectory (`BENCH_trajectory.json`) — built
+from ONE `/debug/health` + `/metrics` poll per refresh.
+
+Two modes::
+
+    python examples/hv_top.py                       # in-process demo:
+        # drives governance waves through a local HypervisorState and
+        # renders its health plane (add --watch to refresh until ^C)
+    python examples/hv_top.py --url http://host:8000 --watch
+        # poll a running deployment's /debug/health + /metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+# Runnable via `python examples/hv_top.py` AND runpy (the smoke
+# tests): runpy does not put the script dir on sys.path.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _watch_common import (  # noqa: E402
+    build_state,
+    drive_round,
+    fmt_table,
+    watch_loop,
+)
+
+#: Counter series the /metrics poll surfaces in the header.
+HEADER_COUNTERS = (
+    "hv_governance_wave_ticks_total",
+    "hv_admission_admitted_total",
+    "hv_sessions_archived_total",
+)
+
+
+def parse_prometheus_counters(text: str) -> dict[str, float]:
+    """name{labels} -> value for every sample line (counters/gauges)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        try:
+            series, value = line.rsplit(" ", 1)
+            out[series] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def poll_url(base: str) -> tuple[dict, dict[str, float]]:
+    """One (/debug/health, /metrics) poll against a live deployment."""
+    from urllib.request import urlopen
+
+    base = base.rstrip("/")
+    with urlopen(f"{base}/debug/health", timeout=10) as resp:
+        health = json.loads(resp.read())
+    with urlopen(f"{base}/metrics", timeout=10) as resp:
+        counters = parse_prometheus_counters(resp.read().decode())
+    return health, counters
+
+
+def poll_state(state) -> tuple[dict, dict[str, float]]:
+    """The in-process twin of `poll_url` (same payload shapes)."""
+    health = state.health_summary()
+    counters = parse_prometheus_counters(state.metrics_prometheus())
+    return health, counters
+
+
+def load_trajectory(root: Path) -> list[dict]:
+    path = root / "BENCH_trajectory.json"
+    if not path.exists():
+        return []
+    try:
+        return json.loads(path.read_text()).get("rounds", [])
+    except (OSError, json.JSONDecodeError):
+        return []
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:,.1f} GiB"
+
+
+def render(
+    health: dict, counters: dict[str, float], trajectory: list[dict]
+) -> str:
+    lines = [
+        f"hv_top @ {time.strftime('%H:%M:%S')}  "
+        f"backend={health.get('backend', '?')}  "
+        f"uptime={health.get('uptime_s', 0):,.0f}s  "
+        + "  ".join(
+            f"{name.removeprefix('hv_').removesuffix('_total')}="
+            f"{int(counters.get(name, 0)):,}"
+            for name in HEADER_COUNTERS
+        ),
+        "",
+    ]
+
+    occ = health.get("occupancy", {})
+    rows = []
+    for name, row in sorted(occ.get("tables", {}).items()):
+        cap = row.get("capacity_rows", 0)
+        live = row.get("live_rows")
+        rows.append(
+            (
+                name,
+                "-" if live is None else f"{live:,}",
+                f"{cap:,}",
+                f"{row.get('occupancy', 0) * 100:.1f}%"
+                if live is not None
+                else "-",
+                "-"
+                if row.get("high_water_rows") is None
+                else f"{row['high_water_rows']:,}",
+                _fmt_bytes(row.get("bytes", 0)),
+            )
+        )
+    lines.append(
+        f"occupancy  (warn at {occ.get('warn_threshold', 0) * 100:.0f}%, "
+        f"{occ.get('warnings_fired', 0)} warning(s) fired)"
+    )
+    lines += fmt_table(
+        rows, header=("table", "live", "capacity", "occ", "hiwater", "hbm")
+    )
+
+    c = health.get("compiles", {})
+    lines.append("")
+    lines.append(
+        f"compiles   total={c.get('compiles', 0)}  "
+        f"recompiles={c.get('recompiles', 0)}  "
+        f"donation_failures={c.get('donation_failures', 0)}  "
+        f"wall={c.get('compile_wall_ms', 0):,.0f} ms  "
+        f"programs={c.get('programs', 0)}"
+    )
+    for event in c.get("recent", [])[-3:]:
+        changed = "; ".join(event.get("changed", [])) or "first trace"
+        lines.append(
+            f"  {event['kind']:9s} {event['program']:28s} "
+            f"{event['wall_ms']:>9.1f} ms  {changed}"
+        )
+
+    lines.append("")
+    lines.append("stage latency (host bracket, µs)")
+    stage_rows = [
+        (stage, f"{row['n']:,}", f"{row['p50_us']:,.1f}",
+         f"{row['p99_us']:,.1f}")
+        for stage, row in sorted(health.get("stages", {}).items())
+    ]
+    lines += fmt_table(stage_rows, header=("stage", "n", "p50", "p99"))
+
+    wd = health.get("watchdog", {})
+    lines.append("")
+    lines.append(
+        f"watchdog   k={wd.get('k')}  floor={wd.get('floor_us', 0):,.0f} µs"
+        f"  stragglers={wd.get('straggler_count', 0)}"
+    )
+    for s in wd.get("recent_stragglers", [])[-3:]:
+        lines.append(
+            f"  {s['stage']:28s} {s['duration_us']:>12,.0f} µs "
+            f"(deadline {s['deadline_us']:,.0f})  trace={s['trace_id']}"
+        )
+
+    if trajectory:
+        lines.append("")
+        lines.append("bench trajectory (headline per-op p50, µs)")
+        traj_rows = [
+            (
+                f"r{row['round']:02d}",
+                row.get("backend", "?")
+                + ("/quick" if row.get("quick") else ""),
+                "-"
+                if row.get("headline_per_op_us") is None
+                else f"{row['headline_per_op_us']:,.4f}",
+                row.get("git_commit") or "-",
+            )
+            for row in trajectory[-6:]
+        ]
+        lines += fmt_table(
+            traj_rows, header=("round", "mode", "per-op", "commit")
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--url", type=str, default=None,
+        help="poll a running deployment instead of the in-process demo",
+    )
+    ap.add_argument("--sessions", type=int, default=64, help="demo lanes/wave")
+    ap.add_argument("--rounds", type=int, default=1, help="demo waves/refresh")
+    ap.add_argument("--watch", action="store_true", help="refresh until ^C")
+    ap.add_argument("--interval", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    root = Path(__file__).resolve().parent.parent
+    trajectory = load_trajectory(root)
+
+    if args.url:
+        def frame() -> str:
+            health, counters = poll_url(args.url)
+            return render(health, counters, trajectory)
+
+        return watch_loop(frame, watch=args.watch, interval=args.interval)
+
+    state = build_state(args.sessions * max(args.rounds, 1) + 64)
+    progress = {"rnd": 0, "driving": True}
+
+    def tick() -> None:
+        for _ in range(args.rounds):
+            if progress["driving"]:
+                progress["driving"] = drive_round(
+                    state, args.sessions, progress["rnd"], prefix="top"
+                )
+            progress["rnd"] += 1
+
+    def frame() -> str:
+        health, counters = poll_state(state)
+        return render(health, counters, trajectory)
+
+    return watch_loop(
+        frame, watch=args.watch, interval=args.interval, tick=tick
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,57 +21,11 @@ import os
 import sys
 import time
 
-import numpy as np
+# Runnable via `python examples/metrics_watch.py` AND runpy (the smoke
+# tests): runpy does not put the script dir on sys.path.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-
-
-def build_state(max_sessions: int):
-    import dataclasses
-
-    from hypervisor_tpu.config import DEFAULT_CONFIG
-    from hypervisor_tpu.state import HypervisorState
-
-    config = dataclasses.replace(
-        DEFAULT_CONFIG,
-        capacity=dataclasses.replace(
-            DEFAULT_CONFIG.capacity,
-            max_sessions=max(max_sessions, DEFAULT_CONFIG.capacity.max_sessions),
-        ),
-    )
-    return HypervisorState(config)
-
-
-def drive_round(state, n_sessions: int, rnd: int) -> bool:
-    """One full-pipeline wave: n_sessions sessions live and die.
-
-    Returns False once the session table has no room left — slot
-    allocation is monotonic (no recycling), so a long `--watch` run
-    eventually exhausts it; the watcher then keeps refreshing the
-    display on the traffic already recorded instead of crashing."""
-    from hypervisor_tpu.models import SessionConfig
-    from hypervisor_tpu.ops.merkle import BODY_WORDS
-
-    try:
-        slots = state.create_sessions_batch(
-            [f"watch:r{rnd}:s{i}" for i in range(n_sessions)],
-            SessionConfig(min_sigma_eff=0.0),
-        )
-    except RuntimeError:
-        return False
-    rng = np.random.RandomState(rnd)
-    bodies = rng.randint(
-        0, 2**32, size=(3, n_sessions, BODY_WORDS), dtype=np.uint64
-    ).astype(np.uint32)
-    state.run_governance_wave(
-        slots,
-        [f"did:watch:r{rnd}:{i}" for i in range(n_sessions)],
-        slots.copy(),
-        rng.uniform(0.3, 0.95, n_sessions).astype(np.float32),
-        bodies,
-        now=state.now(),
-    )
-    return True
+from _watch_common import build_state, drive_round, watch_loop  # noqa: E402
 
 
 def render(snap) -> str:
@@ -116,27 +70,24 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     state = build_state(args.sessions * max(args.rounds, 1) + 64)
-    rnd = 0
-    driving = True
-    try:
-        while True:
-            for _ in range(args.rounds):
-                if driving:
-                    driving = drive_round(state, args.sessions, rnd)
-                rnd += 1
-            if args.prometheus:
-                sys.stdout.write(state.metrics_prometheus())
-            else:
-                snap = state.metrics_snapshot()
-                frame = render(snap)
-                if args.watch:
-                    sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
-                print(frame, flush=True)
-            if not args.watch:
-                return 0
-            time.sleep(args.interval)
-    except KeyboardInterrupt:
-        return 0
+    progress = {"rnd": 0, "driving": True}
+
+    def tick() -> None:
+        for _ in range(args.rounds):
+            if progress["driving"]:
+                progress["driving"] = drive_round(
+                    state, args.sessions, progress["rnd"], prefix="watch"
+                )
+            progress["rnd"] += 1
+
+    def frame() -> str:
+        if args.prometheus:
+            return state.metrics_prometheus().rstrip("\n")
+        return render(state.metrics_snapshot())
+
+    return watch_loop(
+        frame, watch=args.watch, interval=args.interval, tick=tick
+    )
 
 
 if __name__ == "__main__":

@@ -22,26 +22,11 @@ import json
 import os
 import sys
 
-import numpy as np
+# Runnable via `python examples/trace_watch.py` AND runpy (the smoke
+# tests): runpy does not put the script dir on sys.path.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-
-
-def drive_round(state, n_sessions: int, rnd: int) -> None:
-    from hypervisor_tpu.models import SessionConfig
-    from hypervisor_tpu.ops.merkle import BODY_WORDS
-
-    slots = state.create_sessions_batch(
-        [f"trace:r{rnd}:s{i}" for i in range(n_sessions)],
-        SessionConfig(min_sigma_eff=0.0),
-    )
-    state.run_governance_wave(
-        slots,
-        [f"did:trace:r{rnd}:{i}" for i in range(n_sessions)],
-        slots.copy(),
-        np.full(n_sessions, 0.8, np.float32),
-        np.zeros((2, n_sessions, BODY_WORDS), np.uint32),
-    )
+from _watch_common import build_state, drive_round  # noqa: E402
 
 
 def print_tree(span, depth: int = 0) -> None:
@@ -67,11 +52,13 @@ def main() -> int:
         os.environ["HV_TRACE_SAMPLE"] = str(args.sample)
 
     from hypervisor_tpu.observability import tracing
-    from hypervisor_tpu.state import HypervisorState
 
-    state = HypervisorState()
+    state = build_state(args.sessions * max(args.rounds, 1) + 64)
     for rnd in range(args.rounds):
-        drive_round(state, args.sessions, rnd)
+        drive_round(
+            state, args.sessions, rnd, prefix="trace",
+            turns=2, random_sigma=False,
+        )
 
     spans = state.tracer.drain()
     print(f"flight recorder: {len(spans)} reconstructed wave(s)\n")

@@ -102,6 +102,12 @@ class HypervisorService:
                 "wrapped, wave unsampled, or no traffic yet)",
             )
         tracing.attach_bus_events(spans, self.bus, session_id=session_id)
+        # Health events carry no session id (a straggler names only the
+        # wave's trace); join them by trace word — only events matching
+        # THIS session's waves attach.
+        straggler_events = self.bus.query_by_type(EventType.WAVE_STRAGGLER)
+        if straggler_events:
+            tracing.attach_bus_events(spans, self.bus, events=straggler_events)
         if format == "otlp":
             return tracing.to_otlp(spans, state.tracer)
         if format not in (None, "", "chrome"):
@@ -113,6 +119,28 @@ class HypervisorService:
         sampling knobs, and the most recent wave brackets with their
         causal trace ids (the replay keys for /trace/{session_id})."""
         return self.hv.state.flight_summary()
+
+    async def debug_health(self) -> dict:
+        """`GET /debug/health`: the runtime health plane in one poll —
+        watchdog state (per-stage deadlines, recent stragglers), table
+        occupancy with high-water marks, compile telemetry totals, and
+        per-stage latency quantiles. One metrics drain (its single
+        `device_get`), outside every wave."""
+        return self.hv.state.health_summary()
+
+    async def debug_memory(self) -> dict:
+        """`GET /debug/memory`: HBM occupancy accounting — per-table
+        bytes, capacities, live rows, high-water marks, occupancy, and
+        any capacity warnings fired (`footprint()` protocol +
+        drained live-row gauges)."""
+        return self.hv.state.memory_summary()
+
+    async def debug_compiles(self) -> dict:
+        """`GET /debug/compiles`: compile telemetry for the watched
+        jitted wave entry points — compile/recompile/donation-failure
+        totals, per-program stats, and recent compile events naming
+        the argument whose signature forced each recompile."""
+        return self.hv.state.compile_summary()
 
     async def device_stats(self) -> M.DeviceStatsResponse:
         """Device-plane occupancy: the tables every facade call updates."""

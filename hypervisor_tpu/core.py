@@ -199,6 +199,12 @@ class Hypervisor:
         # Optional structured event emission (facade-wired, unlike reference).
         self.event_bus = event_bus
         self._events_mirrored = 0
+        # Health-plane events (stragglers, capacity warnings,
+        # recompiles) bridge onto the same bus: the straggler payload
+        # carries the wave's CausalTraceId, so `GET /trace/{session}`
+        # joins the event onto the stalled wave's spans.
+        if self.event_bus is not None:
+            self.state.health.add_listener(self._on_health_event)
 
         self._sessions: dict[str, ManagedSession] = {}
         # Keyed by Mesh (hashable): same mesh -> same runtime instance.
@@ -1579,6 +1585,25 @@ class Hypervisor:
         if managed is None:
             raise ValueError(f"Session {session_id} not found")
         return managed
+
+    def _on_health_event(self, kind: str, payload: dict) -> None:
+        """Health-monitor listener -> structured bus events. Runs on
+        the dispatch path (watchdog fires inside `Tracer.end_wave`), so
+        it only appends one bus row — no device work, no raises."""
+        event_type = {
+            "straggler": EventType.WAVE_STRAGGLER,
+            "capacity": EventType.CAPACITY_WARNING,
+            "recompile": EventType.RECOMPILE,
+        }.get(kind)
+        if event_type is None or self.event_bus is None:
+            return
+        self.event_bus.emit(
+            HypervisorEvent(
+                event_type=event_type,
+                causal_trace_id=payload.get("trace_id"),
+                payload=payload,
+            )
+        )
 
     def _emit(
         self,

@@ -5,10 +5,14 @@ Host-side views of the device EventLog ring buffer (`tables/logs.py`);
 and `device_key_of` the shared (trace, span) word rule the event bus,
 the device logs, and the flight-recorder stamps all join on. `tracing`
 is the flight recorder: in-jit trace ring, host span reconstruction,
-Chrome/OTLP export.
+Chrome/OTLP export. `health` is the runtime health plane: compile
+telemetry around the jitted wave entry points, HBM occupancy
+accounting over the shared `footprint()` protocol, and the wave
+watchdog that flags stragglers against each stage's own latency
+distribution.
 """
 
-from hypervisor_tpu.observability import metrics, profiling, tracing
+from hypervisor_tpu.observability import health, metrics, profiling, tracing
 from hypervisor_tpu.observability.causal_trace import (
     CausalTraceId,
     device_key_of,
@@ -29,6 +33,7 @@ __all__ = [
     "HypervisorEventBus",
     "device_key_of",
     "fnv1a32",
+    "health",
     "metrics",
     "profiling",
     "tracing",

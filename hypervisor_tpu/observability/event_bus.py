@@ -76,6 +76,10 @@ class EventType(str, enum.Enum):
     # Verification
     BEHAVIOR_DRIFT = "verification.behavior_drift"
     HISTORY_VERIFIED = "verification.history_verified"
+    # Health plane (APPEND ONLY: codes are the device-log wire format)
+    WAVE_STRAGGLER = "health.wave_straggler"
+    CAPACITY_WARNING = "health.capacity_warning"
+    RECOMPILE = "health.recompile"
 
     @property
     def code(self) -> int:

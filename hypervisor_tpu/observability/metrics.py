@@ -234,6 +234,82 @@ WAVE_LANES = REGISTRY.histogram(
     "hv_wave_lanes", "join lanes per dispatched admission/governance wave"
 )
 
+# ── health plane (compile telemetry / occupancy / watchdog) ──────────
+# Compile counters are HOST-MIRRORED ABSOLUTE TOTALS: the compile watch
+# (`observability.health`) owns the authoritative count — it is
+# process-global, like the module-level jit caches it watches — and the
+# drain publishes it via `Metrics.counter_set` so exposition stays
+# monotonic without double counting across deployments in one process.
+COMPILES = REGISTRY.counter(
+    "hv_compiles_total", "XLA compiles of watched wave entry points"
+)
+RECOMPILES = REGISTRY.counter(
+    "hv_recompiles_total",
+    "unplanned recompiles (a watched program re-traced after first use)",
+)
+DONATION_FAILURES = REGISTRY.counter(
+    "hv_donation_failures_total",
+    "compiles whose donated buffers were not usable (donation fell back "
+    "to copies)",
+)
+COMPILE_WALL_MS = REGISTRY.counter(
+    "hv_compile_wall_ms_total",
+    "cumulative wall-clock spent compiling watched programs, ms",
+)
+WAVE_STRAGGLERS = REGISTRY.counter(
+    "hv_wave_stragglers_total",
+    "dispatched waves that overran their watchdog deadline (p99 x k)",
+)
+CAPACITY_WARNINGS = REGISTRY.counter(
+    "hv_capacity_warnings_total",
+    "table/ring occupancy crossings above the configured warn threshold",
+)
+
+#: Tables the occupancy accounting names. `metrics` is excluded from the
+#: warn set (its layout is static — always "full"); rings (the three
+#: logs) warn once as they approach their first wrap.
+HEALTH_TABLES: tuple[str, ...] = (
+    "agents",
+    "sessions",
+    "vouches",
+    "sagas",
+    "elevations",
+    "delta_log",
+    "event_log",
+    "trace_log",
+)
+#: Live rows are DEVICE gauges (recomputed by `update_gauges` in the one
+#: drain program); capacity/bytes are static array metadata published as
+#: HOST gauges; high-water is host-tracked from drained live values.
+TABLE_LIVE_ROWS = {
+    t: REGISTRY.gauge(
+        "hv_table_live_rows", "live rows per device table/ring", table=t
+    )
+    for t in HEALTH_TABLES
+}
+TABLE_CAPACITY_ROWS = {
+    t: REGISTRY.gauge(
+        "hv_table_capacity_rows", "row capacity per device table/ring",
+        table=t,
+    )
+    for t in HEALTH_TABLES
+}
+TABLE_HBM_BYTES = {
+    t: REGISTRY.gauge(
+        "hv_table_hbm_bytes", "HBM bytes held per device table/ring",
+        table=t,
+    )
+    for t in HEALTH_TABLES
+}
+TABLE_HIGH_WATER_ROWS = {
+    t: REGISTRY.gauge(
+        "hv_table_high_water_rows",
+        "high-water live rows per device table/ring (since process start)",
+        table=t,
+    )
+    for t in HEALTH_TABLES
+}
+
 
 # ── host object: device table + host mirror + drain ──────────────────
 
@@ -263,14 +339,18 @@ class Metrics:
         self._drain_lock = threading.Lock()
         self.table = registry.create_table()
         self._bounds = np.asarray(registry.bounds, np.float64)
-        # Host plane (int64: no wrap handling needed here). Gauges have
-        # no host plane: every registered gauge is device-recomputed by
-        # `update_gauges` at snapshot, and a summed merge would double-
-        # count a level value (unlike the disjoint counter/histogram
-        # sources).
+        # Host plane (int64: no wrap handling needed here). Gauges are
+        # last-write-wins LEVELS, so the two planes never sum: a gauge
+        # row is either device-recomputed by `update_gauges` at snapshot
+        # or host-OWNED (`gauge_set` flips its bit in `_h_gauge_owned`)
+        # — the host value then overrides the device column at merge
+        # (static table metadata like capacities/bytes never rides a
+        # device program just to be re-read).
         self._h_counters = np.zeros(max(c, 1), np.int64)
         self._h_hist = np.zeros((max(h, 1), nb), np.int64)
         self._h_sum = np.zeros(max(h, 1), np.float64)
+        self._h_gauges = np.zeros(max(g, 1), np.float64)
+        self._h_gauge_owned = np.zeros(max(g, 1), bool)
         # Device-plane wrap accounting: last raw u32 seen + cumulative.
         self._d_counters_raw = np.zeros(max(c, 1), np.uint32)
         self._d_counters_cum = np.zeros(max(c, 1), np.int64)
@@ -290,12 +370,43 @@ class Metrics:
         with self._lock:
             self._h_counters[handle.index] += n
 
+    def counter_set(self, handle: MetricHandle, total: int) -> None:
+        """Publish an ABSOLUTE monotonic total on the host plane.
+
+        For counters whose authoritative count lives elsewhere (the
+        process-global compile watch): the owner republishes the running
+        total at each drain instead of risking double `inc`s. Never mix
+        with `inc` on the same handle.
+        """
+        with self._lock:
+            self._h_counters[handle.index] = max(
+                int(total), int(self._h_counters[handle.index])
+            )
+
+    def gauge_set(self, handle: MetricHandle, value: float) -> None:
+        """Set a HOST-owned gauge level; overrides the device column at
+        merge (see `_h_gauge_owned`)."""
+        with self._lock:
+            self._h_gauges[handle.index] = float(value)
+            self._h_gauge_owned[handle.index] = True
+
     def observe_us(self, handle: MetricHandle, us: float) -> None:
         """Record one host-plane histogram sample (microseconds)."""
         b = int(np.searchsorted(self._bounds, us, side="left"))
         with self._lock:
             self._h_hist[handle.index, b] += 1
             self._h_sum[handle.index] += us
+
+    def host_quantile(
+        self, handle: MetricHandle, q: float
+    ) -> tuple[int, float]:
+        """(sample_count, quantile_us) from the HOST plane only — no
+        device round-trip, so the wave watchdog can derive per-stage
+        deadlines on the dispatch path (stage latencies are host-plane
+        samples to begin with: there is no device clock to read)."""
+        with self._lock:
+            counts = self._h_hist[handle.index].copy()
+        return int(counts.sum()), _bucket_quantile(counts, self._bounds, q)
 
     def stage(self, name: str) -> "_StageTimer":
         """Bracket one dispatched wave: profiler span + latency sample.
@@ -342,6 +453,8 @@ class Metrics:
                 h_counters = self._h_counters.copy()
                 h_hist = self._h_hist.copy()
                 h_sum = self._h_sum.copy()
+                h_gauges = self._h_gauges.copy()
+                h_gauge_owned = self._h_gauge_owned.copy()
             if refresh is not None:
                 table = refresh(table)
             host = jax.device_get(table)
@@ -357,7 +470,9 @@ class Metrics:
                 self._d_hist_raw = raw_h
                 counters = self._d_counters_cum + h_counters
                 hist = self._d_hist_cum + h_hist
-        gauges = np.asarray(host.gauges, np.float64)
+        gauges = np.where(
+            h_gauge_owned, h_gauges, np.asarray(host.gauges, np.float64)
+        )
         hist_sum = np.asarray(host.hist_sum, np.float64) + h_sum
         return MetricsSnapshot(
             registry=self.registry,
@@ -428,20 +543,7 @@ class MetricsSnapshot:
         overflow bucket resolve to the highest finite bound (the same
         clamp `histogram_quantile` applies).
         """
-        counts = self.hist[handle.index]
-        total = counts.sum()
-        if total == 0:
-            return 0.0
-        target = q * total
-        cum = np.cumsum(counts)
-        b = int(np.searchsorted(cum, target, side="left"))
-        if b >= len(self.bounds):
-            return float(self.bounds[-1])
-        lo = 0.0 if b == 0 else float(self.bounds[b - 1])
-        hi = float(self.bounds[b])
-        prev = 0 if b == 0 else int(cum[b - 1])
-        frac = (target - prev) / max(int(counts[b]), 1)
-        return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        return _bucket_quantile(self.hist[handle.index], self.bounds, q)
 
     def to_prometheus(self) -> str:
         """Prometheus/OpenMetrics text exposition (version 0.0.4)."""
@@ -486,6 +588,24 @@ class MetricsSnapshot:
                 )
                 lines.append(f"{h.name}_count{_labels(base)} {cum}")
         return "\n".join(lines) + "\n"
+
+
+def _bucket_quantile(counts: np.ndarray, bounds: np.ndarray, q: float) -> float:
+    """Prometheus-style bucket quantile (linear within the bucket),
+    shared by snapshot quantiles and the host-plane watchdog path."""
+    total = counts.sum()
+    if total == 0:
+        return 0.0
+    target = q * total
+    cum = np.cumsum(counts)
+    b = int(np.searchsorted(cum, target, side="left"))
+    if b >= len(bounds):
+        return float(bounds[-1])
+    lo = 0.0 if b == 0 else float(bounds[b - 1])
+    hi = float(bounds[b])
+    prev = 0 if b == 0 else int(cum[b - 1])
+    frac = (target - prev) / max(int(counts[b]), 1)
+    return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
 
 
 def _fmt(v: float) -> str:
@@ -565,12 +685,26 @@ def tally_gateway_host(m: Metrics, verdict, n_lanes: int) -> None:
 # ── device-side gauge refresh (dispatched by the drain path) ─────────
 
 
-def update_gauges(metrics: MetricsTable, agents, sessions, vouches):
+def update_gauges(
+    metrics: MetricsTable,
+    agents,
+    sessions,
+    vouches,
+    sagas=None,
+    elevations=None,
+    delta_log=None,
+    event_log=None,
+    trace_log=None,
+):
     """Recompute occupancy gauges from the state tables, on device.
 
     One jitted program over whole columns — dispatched by
     `HypervisorState.metrics_snapshot()` right before the drain, never
-    inside a wave.
+    inside a wave. The optional tables feed the health plane's
+    per-table live-row gauges (`TABLE_LIVE_ROWS`) in the SAME program,
+    so occupancy accounting adds nothing to the drain's single
+    `device_get`; callers that omit them (legacy refreshes) simply
+    leave those gauge rows at their last value.
     """
     import jax.numpy as jnp
 
@@ -612,6 +746,32 @@ def update_gauges(metrics: MetricsTable, agents, sessions, vouches):
         m, VOUCH_EDGES_ACTIVE.index,
         jnp.sum(vouches.active.astype(jnp.int32)),
     )
+
+    # Health-plane live-row gauges: allocated rows per table, ring
+    # cursors clamped to capacity (a wrapped ring stays "full").
+    def live_rows(name, value):
+        return gauge_set(m, TABLE_LIVE_ROWS[name].index, value)
+
+    m = live_rows("agents", jnp.sum((agents.did >= 0).astype(jnp.int32)))
+    m = live_rows("sessions", jnp.sum((sessions.sid >= 0).astype(jnp.int32)))
+    m = live_rows("vouches", jnp.sum(vouches.active.astype(jnp.int32)))
+    if sagas is not None:
+        m = live_rows("sagas", jnp.sum((sagas.session >= 0).astype(jnp.int32)))
+    if elevations is not None:
+        m = live_rows(
+            "elevations", jnp.sum(elevations.active.astype(jnp.int32))
+        )
+    for name, log in (
+        ("delta_log", delta_log),
+        ("event_log", event_log),
+        ("trace_log", trace_log),
+    ):
+        if log is not None:
+            # Each log names its own capacity column (`capacity_rows`
+            # backs footprint() too), so the clamp and the published
+            # capacity gauge cannot disagree.
+            cap = log.cursor.dtype.type(log.capacity_rows)
+            m = live_rows(name, jnp.minimum(log.cursor, cap))
     return m
 
 

@@ -292,6 +292,12 @@ class Tracer:
         self.table: Optional[TraceLog] = (
             TraceLog.create(self.capacity) if self.enabled else None
         )
+        # Optional wave watchdog (`observability.health.HealthMonitor`):
+        # every closed bracket is offered to it, so straggler detection
+        # rides the same host bracket that stamps CausalTraceIds. With
+        # the trace plane disabled (HV_TRACE=0) no brackets open and
+        # the watchdog is off too — documented in docs/OPERATIONS.md.
+        self.health = None
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._perf0) * 1e6
@@ -393,6 +399,11 @@ class Tracer:
             # EVERY dispatch once the index fills.
             while len(self._waves) > self._max_waves:
                 del self._waves[next(iter(self._waves))]
+        # Watchdog check OUTSIDE the tracer lock: the monitor takes its
+        # own locks and fans out to listeners (event bus emits).
+        health = self.health
+        if health is not None:
+            health.observe_wave(handle.record)
 
     def stamp_wave_host(self, handle: Optional[WaveHandle]) -> None:
         """Mirror one dispatch's stamp rows on the host plane.
@@ -595,12 +606,15 @@ class Tracer:
 # ── joins ────────────────────────────────────────────────────────────
 
 
-def attach_bus_events(spans: list[Span], bus, session_id=None) -> int:
+def attach_bus_events(spans: list[Span], bus, session_id=None, events=None) -> int:
     """Join host event-bus rows onto spans via the device-key words.
 
     An event whose `causal_trace_id` keys to a span's (trace, span)
     word pair lands on that span; a trace-word-only match lands on the
-    wave's root span. Returns the number of events attached.
+    wave's root span. Returns the number of events attached. `events`
+    overrides the bus query — the trace endpoint uses it to join
+    session-less health events (stragglers carry only the wave's trace
+    id) onto the session's waves.
     """
     from hypervisor_tpu.observability.causal_trace import device_key_of
 
@@ -612,7 +626,10 @@ def attach_bus_events(spans: list[Span], bus, session_id=None) -> int:
         for span in root.walk():
             by_word[(root_trace_w, span.span_word)] = span
     attached = 0
-    events = bus.query(session_id=session_id) if session_id else bus.all_events
+    if events is None:
+        events = (
+            bus.query(session_id=session_id) if session_id else bus.all_events
+        )
     for event in events:
         t_w, s_w = device_key_of(event.causal_trace_id)
         target = by_word.get((t_w, s_w)) or roots_by_trace.get(t_w)

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.models import SessionConfig, SessionState
 from hypervisor_tpu.observability import profiling
+from hypervisor_tpu.observability import health as health_plane
 from hypervisor_tpu.observability import metrics as metrics_plane
 from hypervisor_tpu.observability import tracing as trace_plane
 from hypervisor_tpu.ops import admission, rate_limit, saga_ops, security_ops
@@ -56,11 +57,29 @@ from hypervisor_tpu.tables.struct import replace
 from hypervisor_tpu.runtime import StagingQueue
 
 
-_ADMIT = jax.jit(admission.admit_batch)
-_SAGA_TICK = jax.jit(saga_ops.saga_table_tick)
-_TERMINATE = jax.jit(terminate_ops.terminate_batch, static_argnames=("use_pallas",))
-_WAVE = jax.jit(
-    pipeline_ops.governance_wave,
+# Every module-level jit entry point is wrapped in compile telemetry
+# (`observability.health.instrument`): the watch counts cache misses,
+# times compiles, names the argument whose abstract signature forced a
+# recompile, and captures donation-failure warnings — all HOST-side;
+# the traced programs are byte-identical with or without the wrapper
+# (pinned by the lowering guard in tests/unit/test_health.py).
+_ADMIT = health_plane.instrument(
+    "admit_batch", jax.jit(admission.admit_batch)
+)
+_SAGA_TICK = health_plane.instrument(
+    "saga_table_tick", jax.jit(saga_ops.saga_table_tick)
+)
+_TERMINATE = health_plane.instrument(
+    "terminate_batch",
+    jax.jit(terminate_ops.terminate_batch, static_argnames=("use_pallas",)),
+    static_argnames=("use_pallas",),
+)
+_WAVE = health_plane.instrument(
+    "governance_wave",
+    jax.jit(
+        pipeline_ops.governance_wave,
+        static_argnames=("use_pallas", "unique_sessions"),
+    ),
     static_argnames=("use_pallas", "unique_sessions"),
 )
 # Donated twin: the three table arguments (and the metrics table, which
@@ -75,33 +94,63 @@ _WAVE = jax.jit(
 # next wave overwrite). Opt-in via
 # HV_DONATE_TABLES=1 until the on-chip before/after is captured
 # (benchmarks/bench_donation.py).
-_WAVE_DONATED = jax.jit(
-    pipeline_ops.governance_wave,
+_WAVE_DONATED = health_plane.instrument(
+    "governance_wave_donated",
+    jax.jit(
+        pipeline_ops.governance_wave,
+        static_argnames=("use_pallas", "unique_sessions"),
+        donate_argnames=("agents", "sessions", "vouches", "metrics", "trace"),
+    ),
     static_argnames=("use_pallas", "unique_sessions"),
-    donate_argnames=("agents", "sessions", "vouches", "metrics", "trace"),
 )
-_RECORD_CALLS = jax.jit(
-    security_ops.record_calls, static_argnames=("config",)
+_RECORD_CALLS = health_plane.instrument(
+    "record_calls",
+    jax.jit(security_ops.record_calls, static_argnames=("config",)),
+    static_argnames=("config",),
 )
-_SLASH = jax.jit(liability_ops.slash_cascade)
-_BREACH_SWEEP = jax.jit(
-    security_ops.breach_sweep, static_argnames=("config",)
+_SLASH = health_plane.instrument(
+    "slash_cascade", jax.jit(liability_ops.slash_cascade)
 )
-_ELEV_EXPIRY = jax.jit(security_ops.elevation_expiry)
-_QUAR_ENTER = jax.jit(security_ops.quarantine_enter)
-_RATE_CONSUME = jax.jit(rate_limit.consume, static_argnames=("config",))
-_QUAR_SWEEP = jax.jit(security_ops.quarantine_sweep)
-_FANOUT_ROUND = jax.jit(saga_ops.fanout_round)
-_EFF_RINGS = jax.jit(security_ops.effective_rings)
-_GATEWAY = jax.jit(
-    gateway_ops.check_actions,
+_BREACH_SWEEP = health_plane.instrument(
+    "breach_sweep",
+    jax.jit(security_ops.breach_sweep, static_argnames=("config",)),
+    static_argnames=("config",),
+)
+_ELEV_EXPIRY = health_plane.instrument(
+    "elevation_expiry", jax.jit(security_ops.elevation_expiry)
+)
+_QUAR_ENTER = health_plane.instrument(
+    "quarantine_enter", jax.jit(security_ops.quarantine_enter)
+)
+_RATE_CONSUME = health_plane.instrument(
+    "rate_consume",
+    jax.jit(rate_limit.consume, static_argnames=("config",)),
+    static_argnames=("config",),
+)
+_QUAR_SWEEP = health_plane.instrument(
+    "quarantine_sweep", jax.jit(security_ops.quarantine_sweep)
+)
+_FANOUT_ROUND = health_plane.instrument(
+    "fanout_round", jax.jit(saga_ops.fanout_round)
+)
+_EFF_RINGS = health_plane.instrument(
+    "effective_rings", jax.jit(security_ops.effective_rings)
+)
+_GATEWAY = health_plane.instrument(
+    "gateway_check_actions",
+    jax.jit(
+        gateway_ops.check_actions,
+        static_argnames=("breach", "rate_limit", "trust"),
+    ),
     static_argnames=("breach", "rate_limit", "trust"),
 )
-_UPDATE_GAUGES = jax.jit(metrics_plane.update_gauges)
+_UPDATE_GAUGES = health_plane.instrument(
+    "update_gauges", jax.jit(metrics_plane.update_gauges)
+)
 
 
 @jax.jit
-def _MERGE_WAVE_SESSION_STATES(owned, state, sessions_state, k_idx):
+def _MERGE_WAVE_SESSION_STATES_JIT(owned, state, sessions_state, k_idx):
     """[k] post-wave session states for the mesh-path metrics tally:
     EVENTUAL lanes' masked partials overwrites where owned, else the
     replicated table's STRONG-folded column — fused into ONE cached
@@ -110,6 +159,11 @@ def _MERGE_WAVE_SESSION_STATES(owned, state, sessions_state, k_idx):
     state_e = jnp.sum(state[:, k_idx], axis=0)
     state_s = jnp.take(sessions_state, k_idx).astype(jnp.int32)
     return jnp.where(owned_e, state_e, state_s)
+
+
+_MERGE_WAVE_SESSION_STATES = health_plane.instrument(
+    "merge_wave_session_states", _MERGE_WAVE_SESSION_STATES_JIT
+)
 
 
 def _isolation_refusal_from(
@@ -195,6 +249,13 @@ class HypervisorState:
         # device_get — outside every wave. HV_TRACE=0 disables;
         # HV_TRACE_SAMPLE sets the head-based per-session sample rate.
         self.tracer = trace_plane.Tracer(capacity=cap.trace_log_capacity)
+        # Health plane: wave watchdog (deadlines from the stages' own
+        # host-plane latency histograms), occupancy high-water/warn
+        # accounting, and the event fan-out the facade bridges onto the
+        # event bus. Hooked into the tracer so straggler detection
+        # rides the same bracket that stamps CausalTraceIds.
+        self.health = health_plane.HealthMonitor(self.metrics)
+        self.tracer.health = self.health
 
         self.agent_ids = InternTable()
         self.session_ids = InternTable()
@@ -2266,15 +2327,100 @@ class HypervisorState:
         deleted buffer — like every table read under donation, scrapes
         must then be serialized with the wave driver.)
         """
-        return self.metrics.snapshot(
+        # Health-plane publishes ride the same drain: compile totals
+        # (process-global watch -> absolute host counters), static
+        # bytes/capacity gauges (pure array metadata), then — after the
+        # one device_get — high-water marks and capacity-warn events
+        # from the freshly drained live-row gauges.
+        health_plane.publish_compile_counters(self.metrics)
+        self.health.publish_footprints(self.health_tables())
+        snap = self.metrics.snapshot(
             refresh=lambda table: _UPDATE_GAUGES(
-                table, self.agents, self.sessions, self.vouches
+                table,
+                self.agents,
+                self.sessions,
+                self.vouches,
+                self.sagas,
+                self.elevations,
+                self.delta_log,
+                self.event_log,
+                self.tracer.table,
             )
         )
+        self.health.update_occupancy(snap)
+        return snap
 
     def metrics_prometheus(self) -> str:
         """Prometheus text exposition of the merged metrics plane."""
         return self.metrics_snapshot().to_prometheus()
+
+    # ── health plane ─────────────────────────────────────────────────
+
+    def health_tables(self) -> dict:
+        """Named tables for the footprint protocol (the occupancy set
+        plus the static metrics/trace rings)."""
+        tables = {
+            "agents": self.agents,
+            "sessions": self.sessions,
+            "vouches": self.vouches,
+            "sagas": self.sagas,
+            "elevations": self.elevations,
+            "delta_log": self.delta_log,
+            "event_log": self.event_log,
+            "metrics": self.metrics.table,
+        }
+        if self.tracer.table is not None:
+            tables["trace_log"] = self.tracer.table
+        return tables
+
+    def health_summary(self) -> dict:
+        """The `GET /debug/health` payload: one drain's worth of
+        watchdog state, occupancy, compile totals, and per-stage
+        latency quantiles — everything `examples/hv_top.py` renders
+        from a single poll."""
+        snap = self.metrics_snapshot()
+        stages = {
+            stage: {
+                "n": n,
+                "p50_us": round(p50, 1),
+                "p99_us": round(p99, 1),
+            }
+            for stage, n, (p50, p99) in metrics_plane.iter_stage_quantiles(
+                snap, (0.5, 0.99)
+            )
+        }
+        monitor = self.health.summary(snap)
+        return {
+            "status": "ok",
+            "backend": jax.default_backend(),
+            "uptime_s": monitor["uptime_s"],
+            "watchdog": monitor["watchdog"],
+            "occupancy": monitor["occupancy"],
+            "compiles": health_plane.compile_summary(last=8),
+            "stages": stages,
+        }
+
+    def memory_summary(self) -> dict:
+        """The `GET /debug/memory` payload: per-table HBM bytes,
+        capacities, live rows, high-water marks, and occupancy."""
+        snap = self.metrics_snapshot()
+        occupancy = self.health.occupancy_summary(snap)
+        return {
+            "hbm_total_bytes": health_plane.hbm_total_bytes(
+                {
+                    name: t.footprint()
+                    for name, t in self.health_tables().items()
+                }
+            ),
+            "warn_threshold": occupancy["warn_threshold"],
+            "warnings_fired": occupancy["warnings_fired"],
+            "recent_warnings": occupancy["recent_warnings"],
+            "tables": occupancy["tables"],
+        }
+
+    def compile_summary(self) -> dict:
+        """The `GET /debug/compiles` payload (process-global watch)."""
+        return health_plane.compile_summary()
 
     # ── trace drain ──────────────────────────────────────────────────
 

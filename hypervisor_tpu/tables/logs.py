@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from hypervisor_tpu.tables.struct import table
+from hypervisor_tpu.tables.struct import footprint, table
 from hypervisor_tpu.ops.merkle import BODY_WORDS
 
 
@@ -54,6 +54,16 @@ class DeltaLog:
             turn=self.turn.at[idx].set(turns),
             cursor=self.cursor + b,
         )
+
+    @property
+    def capacity_rows(self) -> int:
+        """Ring row capacity — THE capacity rule for this log, shared
+        by `footprint()` and the drain's live-row gauge clamp."""
+        return int(self.body.shape[0])
+
+    def footprint(self) -> dict:
+        """Health-plane bytes/capacity (`tables.struct.footprint`)."""
+        return footprint(self, self.capacity_rows)
 
 
 @table
@@ -109,6 +119,16 @@ class EventLog:
             cursor=self.cursor + b,
         )
 
+    @property
+    def capacity_rows(self) -> int:
+        """Ring row capacity — THE capacity rule for this log, shared
+        by `footprint()` and the drain's live-row gauge clamp."""
+        return int(self.event_type.shape[0])
+
+    def footprint(self) -> dict:
+        """Health-plane bytes/capacity (`tables.struct.footprint`)."""
+        return footprint(self, self.capacity_rows)
+
     def count_by_type(self, n_types: int) -> jnp.ndarray:
         """i32[n_types] histogram over live entries (type_counts twin)."""
         live = self.event_type >= 0
@@ -161,6 +181,16 @@ class TraceLog:
             seq=jnp.zeros((capacity,), jnp.uint32),
             cursor=jnp.zeros((), jnp.int32),
         )
+
+    @property
+    def capacity_rows(self) -> int:
+        """Ring row capacity — THE capacity rule for this log, shared
+        by `footprint()` and the drain's live-row gauge clamp."""
+        return int(self.trace.shape[0])
+
+    def footprint(self) -> dict:
+        """Health-plane bytes/capacity (`tables.struct.footprint`)."""
+        return footprint(self, self.capacity_rows)
 
     def stamp_batch(
         self,

@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from hypervisor_tpu.tables.struct import replace, table
+from hypervisor_tpu.tables.struct import footprint, replace, table
 
 
 @table
@@ -63,6 +63,21 @@ class MetricsTable:
             hist=jnp.zeros((max(n_hists, 1), nb), jnp.uint32),
             hist_sum=jnp.zeros((max(n_hists, 1),), jnp.float32),
             bounds=bounds,
+        )
+
+    def footprint(self) -> dict:
+        """Health-plane bytes/capacity (`tables.struct.footprint`).
+
+        "Rows" for this table are registered metric rows across the
+        three kinds; the layout is static, so it never saturates — the
+        health plane reports its bytes but excludes it from the
+        occupancy warn set.
+        """
+        return footprint(
+            self,
+            self.counters.shape[0]
+            + self.gauges.shape[0]
+            + self.hist.shape[0],
         )
 
 

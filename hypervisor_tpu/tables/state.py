@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from hypervisor_tpu.tables.struct import table
+from hypervisor_tpu.tables.struct import footprint, table
 
 # Agent-table flag bits (int32 bitmask column).
 FLAG_ACTIVE = 1 << 0
@@ -108,6 +108,10 @@ class AgentTable:
             ring=jnp.full((capacity,), 3, jnp.int8),
         )
 
+    def footprint(self) -> dict:
+        """Health-plane bytes/capacity (`tables.struct.footprint`)."""
+        return footprint(self, self.ring.shape[0])
+
 
 # SessionTable packed-block column indices (see struct.table "packed").
 SI32_SID = 0
@@ -185,6 +189,10 @@ class SessionTable:
             has_nonreversible=jnp.zeros((capacity,), bool),
         )
 
+    def footprint(self) -> dict:
+        """Health-plane bytes/capacity (`tables.struct.footprint`)."""
+        return footprint(self, self.enable_audit.shape[0])
+
 
 @table
 class ElevationTable:
@@ -209,6 +217,10 @@ class ElevationTable:
             expires_at=jnp.zeros((capacity,), jnp.float32),
             active=jnp.zeros((capacity,), bool),
         )
+
+    def footprint(self) -> dict:
+        """Health-plane bytes/capacity (`tables.struct.footprint`)."""
+        return footprint(self, self.agent.shape[0])
 
 
 @table
@@ -244,6 +256,10 @@ class SagaTable:
             cursor=jnp.zeros((capacity,), jnp.int32),
         )
 
+    def footprint(self) -> dict:
+        """Health-plane bytes/capacity (`tables.struct.footprint`)."""
+        return footprint(self, self.saga_state.shape[0])
+
 
 @table
 class VouchTable:
@@ -273,3 +289,7 @@ class VouchTable:
             active=jnp.zeros((capacity,), bool),
             expiry=jnp.full((capacity,), jnp.inf, jnp.float32),
         )
+
+    def footprint(self) -> dict:
+        """Health-plane bytes/capacity (`tables.struct.footprint`)."""
+        return footprint(self, self.voucher.shape[0])

@@ -169,3 +169,22 @@ def replace(obj: T, **changes) -> T:
         real.update(blocks)
         changes = real
     return dataclasses.replace(obj, **changes)
+
+
+def footprint(obj: T, capacity_rows: int) -> dict:
+    """The shared health-plane `footprint()` protocol, one rule for
+    every table/ring: HBM bytes summed over the pytree's array leaves
+    plus the caller-named row capacity. PURE METADATA — `nbytes` and
+    shapes never touch device memory, so the health plane can account
+    occupancy without a transfer (live rows ride the metrics drain's
+    own gauge refresh instead, `observability.metrics.update_gauges`).
+    """
+    return {
+        "bytes": int(
+            sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree_util.tree_leaves(obj)
+            )
+        ),
+        "capacity_rows": int(capacity_rows),
+    }

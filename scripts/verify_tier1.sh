@@ -10,7 +10,16 @@
 #      spans) exporting as well-formed Chrome trace JSON, and the
 #      stamped wave's lowering must contain NO host transfer
 #      (callback/infeed/outfeed) — the gate fails on any lowering that
-#      pulls one into a stamped program.
+#      pulls one into a stamped program,
+#   4. a health-plane smoke check — /debug/health, /debug/memory, and
+#      /debug/compiles return well-formed payloads; compile counters
+#      are nonzero after one wave; two IDENTICAL dispatches report
+#      exactly zero recompiles while a batch-shape change reports
+#      exactly one and names the changed argument,
+#   5. the perf-regression gate — benchmarks/regression.py rebuilds
+#      BENCH_trajectory.json from the committed BENCH_r*.json history
+#      and fails on any per-bench p50 above its comparable baseline's
+#      tolerance band (cpu tolerance is wide on purpose: non-flaky).
 # Exits non-zero if any fails; prints DOTS_PASSED for trend tracking.
 
 set -u -o pipefail
@@ -115,6 +124,72 @@ print("trace plane OK: wave reconstructed (root + "
 PY
 trace_rc=$?
 
+echo "── health-plane smoke check ──"
+JAX_PLATFORMS=cpu python - <<'PY'
+import asyncio
+import json
+
+import numpy as np
+
+from hypervisor_tpu.api import HypervisorService
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.observability import metrics as mp
+
+svc = HypervisorService()
+st = svc.hv.state
+
+
+def wave(tag, n):
+    slots = st.create_sessions_batch(
+        [f"{tag}:{i}" for i in range(n)], SessionConfig(min_sigma_eff=0.0)
+    )
+    st.run_governance_wave(
+        slots, [f"did:{tag}:{i}" for i in range(n)], slots.copy(),
+        np.full(n, 0.8, np.float32), np.zeros((1, n, 16), np.uint32),
+    )
+
+
+def wave_stats(payload):
+    return next(
+        r for r in payload["by_program"] if r["program"] == "governance_wave"
+    )
+
+
+run = asyncio.run
+wave("hsmoke:a", 2)
+health = run(svc.debug_health())
+json.dumps(health)
+assert health["status"] == "ok", health
+assert health["compiles"]["compiles"] >= 1, "no compiles counted after a wave"
+assert set(health["occupancy"]["tables"]) >= set(mp.HEALTH_TABLES)
+memory = run(svc.debug_memory())
+json.dumps(memory)
+assert memory["hbm_total_bytes"] > 0
+assert memory["tables"]["sessions"]["live_rows"] >= 2, memory["tables"]
+
+base = wave_stats(run(svc.debug_compiles()))
+wave("hsmoke:b", 2)   # identical signature: zero recompiles
+mid = wave_stats(run(svc.debug_compiles()))
+assert mid["compiles"] == base["compiles"], (base, mid)
+assert mid["recompiles"] == base["recompiles"], (base, mid)
+wave("hsmoke:c", 3)   # batch-shape change: exactly one, named
+after = wave_stats(run(svc.debug_compiles()))
+assert after["recompiles"] == mid["recompiles"] + 1, (mid, after)
+assert after["last"]["changed"], "recompile did not name its argument"
+snap = st.metrics_snapshot()
+assert snap.counter(mp.COMPILES) >= 1
+print(
+    "health plane OK: endpoints well-formed, zero recompiles across "
+    "identical dispatches, shape change named "
+    f"({after['last']['changed'][0].split(':')[0]})"
+)
+PY
+health_rc=$?
+
+echo "── perf-regression gate ──"
+JAX_PLATFORMS=cpu python benchmarks/regression.py
+regression_rc=$?
+
 if [ "$rc" -ne 0 ]; then
     echo "tier-1 pytest FAILED (rc=$rc)" >&2
     exit "$rc"
@@ -126,5 +201,13 @@ fi
 if [ "$trace_rc" -ne 0 ]; then
     echo "trace smoke check FAILED (rc=$trace_rc)" >&2
     exit "$trace_rc"
+fi
+if [ "$health_rc" -ne 0 ]; then
+    echo "health smoke check FAILED (rc=$health_rc)" >&2
+    exit "$health_rc"
+fi
+if [ "$regression_rc" -ne 0 ]; then
+    echo "perf-regression gate FAILED (rc=$regression_rc)" >&2
+    exit "$regression_rc"
 fi
 echo "tier-1 gate PASSED"

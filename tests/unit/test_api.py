@@ -183,9 +183,10 @@ class TestHTTPTransport:
         # the two quarantine views, the per-membership agent view, the
         # leave/sweep pair, the per-action gateway, its wave
         # sibling (/actions/check-wave), the Prometheus scrape
-        # (/metrics), and the flight recorder (/trace/{session_id} +
-        # /debug/flight): 33 routes.
-        assert len(ROUTES) == 33
+        # (/metrics), the flight recorder (/trace/{session_id} +
+        # /debug/flight), and the health plane (/debug/health,
+        # /debug/memory, /debug/compiles): 36 routes.
+        assert len(ROUTES) == 36
         assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
         assert any(
             path == "/api/v1/security/quarantines" for _, path, _, _ in ROUTES

@@ -1,0 +1,772 @@
+"""Runtime health plane: compile telemetry, occupancy, watchdog, gate.
+
+Pins the health plane's contracts:
+
+  * compile telemetry — a watched program's first dispatch counts ONE
+    compile; an identical second dispatch counts nothing; a changed
+    abstract signature counts exactly one recompile and NAMES the
+    argument that forced it (the acceptance criterion, checked both on
+    a bare jit and end-to-end through `GET /debug/compiles`),
+  * lowering guard — the watch is host-side only: the wrapped entry
+    point IS the bare jit (same traced program, byte-identical jaxpr)
+    and the extended gauge refresh lowers with no host transfer,
+  * footprint protocol — every table/ring answers `footprint()` with
+    pure array metadata; live rows/capacities/high-water surface as
+    gauges through the normal drain; crossing the warn threshold fires
+    a capacity event exactly once per crossing,
+  * watchdog — deadlines derive from the stage's own host-plane
+    latency histogram (p99 × k, floored, armed after min_samples) and
+    overruns emit straggler events carrying the causal trace id,
+    bridged onto the event bus by the facade,
+  * drain edge cases the plane depends on — u32 histogram-bucket wrap
+    across a drain boundary and idempotent double-drain,
+  * perf-regression harness — trajectory building over both committed
+    BENCH formats, comparability grouping, tolerance bands, and exit
+    codes.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.observability import health
+from hypervisor_tpu.observability import metrics as mp
+from hypervisor_tpu.tables import metrics as mt
+
+
+def _session_config():
+    from hypervisor_tpu.models import SessionConfig
+
+    return SessionConfig(min_sigma_eff=0.0)
+
+
+def _drive_wave(state, tag: str, n: int = 2):
+    slots = state.create_sessions_batch(
+        [f"{tag}:{i}" for i in range(n)], _session_config()
+    )
+    state.run_governance_wave(
+        slots,
+        [f"did:{tag}:{i}" for i in range(n)],
+        slots.copy(),
+        np.full(n, 0.8, np.float32),
+        np.zeros((1, n, 16), np.uint32),
+    )
+    return slots
+
+
+class TestCompileWatch:
+    def test_first_dispatch_counts_one_compile(self):
+        watch = health.CompileWatch("w1", jax.jit(lambda x: x * 2))
+        out = watch(jnp.arange(4.0))
+        assert float(out[1]) == 2.0
+        s = watch.stats()
+        assert s["compiles"] == 1
+        assert s["recompiles"] == 0
+        assert s["last"]["kind"] == "compile"
+
+    def test_identical_dispatch_is_free_of_recompiles(self):
+        watch = health.CompileWatch("w2", jax.jit(lambda x: x + 1))
+        watch(jnp.arange(4.0))
+        watch(jnp.arange(4.0))
+        watch(jnp.arange(4.0) + 7.0)  # same shape/dtype, new values
+        s = watch.stats()
+        assert s["compiles"] == 1
+        assert s["signatures"] == 1
+
+    def test_shape_change_names_the_argument(self):
+        watch = health.CompileWatch(
+            "w3", jax.jit(lambda lanes, sigma: lanes * sigma)
+        )
+        watch(jnp.arange(4.0), jnp.float32(2.0))
+        watch(jnp.arange(8.0), jnp.float32(2.0))
+        s = watch.stats()
+        assert s["compiles"] == 2
+        assert s["recompiles"] == 1
+        changed = s["last"]["changed"]
+        assert any(c.startswith("lanes:") for c in changed), changed
+        assert not any(c.startswith("sigma:") for c in changed), changed
+
+    def test_dtype_change_names_the_argument(self):
+        watch = health.CompileWatch("w4", jax.jit(lambda x: x + 1))
+        watch(jnp.arange(4, dtype=jnp.int32))
+        watch(jnp.arange(4, dtype=jnp.float32))
+        changed = watch.stats()["last"]["changed"]
+        assert any("int32" in c and "float32" in c for c in changed), changed
+
+    def test_static_argument_change_names_it(self):
+        watch = health.CompileWatch(
+            "w5",
+            jax.jit(lambda x, flag: x + 1, static_argnames=("flag",)),
+            static_argnames=("flag",),
+        )
+        watch(jnp.arange(4.0), flag=True)
+        watch(jnp.arange(4.0), flag=False)
+        s = watch.stats()
+        assert s["recompiles"] == 1
+        assert any("flag" in c for c in s["last"]["changed"])
+
+    def test_scalar_value_change_is_not_a_signature(self):
+        """`now` changes every dispatch; jit does not re-trace on a
+        traced scalar's value, so neither may the watch."""
+        watch = health.CompileWatch("w6", jax.jit(lambda x, now: x + now))
+        watch(jnp.arange(4.0), 1.5)
+        watch(jnp.arange(4.0), 99.25)
+        s = watch.stats()
+        assert s["compiles"] == 1
+        assert s["signatures"] == 1
+
+    def test_donation_warning_is_captured(self):
+        def fake_fn(x):
+            warnings.warn("Some donated buffers were not usable: f32[4]")
+            return x
+
+        watch = health.CompileWatch("w7", fake_fn)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # must NOT leak the warning
+            watch(jnp.arange(4.0))
+        s = watch.stats()
+        assert s["donation_failures"] == 1
+        assert s["last"]["donation_failed"] is True
+
+    def test_unrelated_warnings_are_replayed(self):
+        def fake_fn(x):
+            warnings.warn("something unrelated happened")
+            return x
+
+        watch = health.CompileWatch("w8", fake_fn)
+        with pytest.warns(UserWarning, match="unrelated"):
+            watch(jnp.arange(4.0))
+
+    def test_compile_wall_time_recorded(self):
+        watch = health.CompileWatch("w9", jax.jit(lambda x: x @ x.T))
+        watch(jnp.ones((16, 16)))
+        assert watch.stats()["compile_wall_ms"] > 0
+
+    def test_delegates_jit_attributes(self):
+        jitted = jax.jit(lambda x: x + 1)
+        watch = health.CompileWatch("w10", jitted)
+        watch(jnp.arange(3.0))
+        assert watch._cache_size() == 1
+        lowered = watch.lower(jnp.arange(3.0))
+        assert lowered is not None
+
+
+class TestLoweringGuard:
+    def test_watched_wave_is_the_bare_jit_program(self):
+        """The health plane must add NOTHING to the traced programs:
+        compile telemetry wraps on host, so the watched `_WAVE`'s
+        jaxpr is byte-identical to a bare `jax.jit(governance_wave)`."""
+        from hypervisor_tpu import state as state_mod
+        from hypervisor_tpu.ops.pipeline import governance_wave
+        from hypervisor_tpu.tables.state import (
+            AgentTable,
+            SessionTable,
+            VouchTable,
+        )
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        b = 4
+        agents = AgentTable.create(16)
+        sessions = t_replace(
+            SessionTable.create(16),
+            state=SessionTable.create(16).state.at[:b].set(1),
+        )
+        vouches = VouchTable.create(8)
+        args = (
+            agents, sessions, vouches,
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.full((b,), 0.8, jnp.float32),
+            jnp.ones((b,), bool),
+            jnp.zeros((b,), bool),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.zeros((2, b, 16), jnp.uint32),
+            0.0,
+        )
+        # The watch wraps the jit OBJECT; the jit wraps the bare op
+        # directly — nothing host-side is interposed in the trace.
+        assert state_mod._WAVE._fn.__wrapped__ is governance_wave
+        watched = str(
+            jax.make_jaxpr(
+                lambda *a: state_mod._WAVE._fn(*a, use_pallas=False)
+            )(*args)
+        )
+        bare = str(
+            jax.make_jaxpr(
+                lambda *a: jax.jit(
+                    governance_wave,
+                    static_argnames=("use_pallas", "unique_sessions"),
+                )(*a, use_pallas=False)
+            )(*args)
+        )
+        assert watched == bare
+        for forbidden in ("callback", "infeed", "outfeed"):
+            assert forbidden not in watched
+
+    def test_every_state_entry_point_is_watched(self):
+        from hypervisor_tpu import state as state_mod
+
+        for name in (
+            "_ADMIT", "_SAGA_TICK", "_TERMINATE", "_WAVE", "_WAVE_DONATED",
+            "_RECORD_CALLS", "_SLASH", "_BREACH_SWEEP", "_ELEV_EXPIRY",
+            "_QUAR_ENTER", "_RATE_CONSUME", "_QUAR_SWEEP", "_FANOUT_ROUND",
+            "_EFF_RINGS", "_GATEWAY", "_UPDATE_GAUGES",
+            "_MERGE_WAVE_SESSION_STATES",
+        ):
+            assert isinstance(
+                getattr(state_mod, name), health.CompileWatch
+            ), name
+
+    def test_extended_gauge_refresh_lowers_clean(self):
+        """Occupancy gauges ride the drain's refresh program — still no
+        host transfer with the health-plane tables threaded through."""
+        from hypervisor_tpu.tables.logs import DeltaLog, EventLog, TraceLog
+        from hypervisor_tpu.tables.state import (
+            AgentTable,
+            ElevationTable,
+            SagaTable,
+            SessionTable,
+            VouchTable,
+        )
+
+        jaxpr = str(
+            jax.make_jaxpr(mp.update_gauges)(
+                mp.REGISTRY.create_table(),
+                AgentTable.create(8),
+                SessionTable.create(8),
+                VouchTable.create(8),
+                SagaTable.create(4, 4),
+                ElevationTable.create(4),
+                DeltaLog.create(16),
+                EventLog.create(16),
+                TraceLog.create(16),
+            )
+        )
+        for forbidden in ("callback", "infeed", "outfeed"):
+            assert forbidden not in jaxpr
+
+
+class TestStateCompileTelemetry:
+    def test_identical_waves_zero_recompiles_then_shape_change_one(self):
+        """The acceptance flow on the real bridge: two identical
+        dispatches add zero compiles; a batch-shape change adds exactly
+        one recompile on the wave program and names an argument."""
+        from hypervisor_tpu import state as state_mod
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        _drive_wave(st, "hc:a", n=2)
+        before = state_mod._WAVE.stats()
+        _drive_wave(st, "hc:b", n=2)  # identical signature
+        mid = state_mod._WAVE.stats()
+        assert mid["compiles"] == before["compiles"]
+        assert mid["recompiles"] == before["recompiles"]
+        _drive_wave(st, "hc:c", n=3)  # batch shape change
+        after = state_mod._WAVE.stats()
+        assert after["compiles"] == mid["compiles"] + 1
+        assert after["recompiles"] == mid["recompiles"] + 1
+        assert after["last"]["kind"] == "recompile"
+        assert after["last"]["changed"], "recompile must name arguments"
+
+    def test_compile_counters_surface_in_metrics(self):
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        _drive_wave(st, "hm:a")
+        snap = st.metrics_snapshot()
+        assert snap.counter(mp.COMPILES) >= 1
+        text = snap.to_prometheus()
+        assert "# TYPE hv_compiles_total counter" in text
+        assert "hv_table_live_rows" in text
+
+
+class TestFootprints:
+    def test_every_table_answers_the_protocol(self):
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        tables = st.health_tables()
+        assert set(mp.HEALTH_TABLES) <= set(tables)
+        for name, table in tables.items():
+            fp = table.footprint()
+            assert fp["bytes"] > 0, name
+            assert fp["capacity_rows"] > 0, name
+
+    def test_live_rows_and_high_water_track_traffic(self):
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        _drive_wave(st, "fp:a", n=3)
+        snap = st.metrics_snapshot()
+        assert snap.gauge(mp.TABLE_LIVE_ROWS["sessions"]) == 3
+        assert snap.gauge(mp.TABLE_CAPACITY_ROWS["sessions"]) == float(
+            st.sessions.enable_audit.shape[0]
+        )
+        assert snap.gauge(mp.TABLE_LIVE_ROWS["delta_log"]) == 3  # 1 turn x 3
+        # Same-drain consistency: the FIRST snapshot after traffic must
+        # already carry the high-water it derived from its own live
+        # gauges (never live > high-water on a scrape).
+        assert snap.gauge(mp.TABLE_HIGH_WATER_ROWS["sessions"]) == 3
+        mem = st.memory_summary()
+        assert mem["tables"]["sessions"]["high_water_rows"] == 3
+        assert mem["hbm_total_bytes"] > 0
+
+    def test_capacity_warning_fires_once_per_crossing(self):
+        import dataclasses
+
+        from hypervisor_tpu.config import DEFAULT_CONFIG
+        from hypervisor_tpu.state import HypervisorState
+
+        config = dataclasses.replace(
+            DEFAULT_CONFIG,
+            capacity=dataclasses.replace(
+                DEFAULT_CONFIG.capacity, max_sessions=4
+            ),
+        )
+        st = HypervisorState(config)
+        fired: list[tuple[str, dict]] = []
+        st.health.add_listener(lambda kind, p: fired.append((kind, p)))
+        _drive_wave(st, "cw:a", n=4)  # sessions table 100% occupied
+        snap = st.metrics_snapshot()
+        # The warning is visible in the SAME snapshot that crossed the
+        # threshold — a one-shot scrape/alert probe must see it.
+        assert snap.counter(mp.CAPACITY_WARNINGS) >= 1
+        st.metrics_snapshot()  # second drain must NOT re-warn
+        warnings_ = [
+            p for kind, p in fired
+            if kind == "capacity" and p["table"] == "sessions"
+        ]
+        assert len(warnings_) == 1
+        assert warnings_[0]["occupancy"] == 1.0
+        assert st.health.capacity_warning_count >= 1
+
+    def test_listener_exceptions_are_swallowed(self):
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+
+        def bad_listener(kind, payload):
+            raise RuntimeError("must not escape")
+
+        st.health.add_listener(bad_listener)
+        st.health._fire("capacity", {"table": "x"})  # no raise
+
+
+class TestWatchdog:
+    def _seed_stage(self, metrics, stage: str, us: float, n: int) -> None:
+        handle = mp.STAGE_LATENCY[stage]
+        for _ in range(n):
+            metrics.observe_us(handle, us)
+
+    def _record(self, stage: str, duration_us: float):
+        from hypervisor_tpu.observability.causal_trace import CausalTraceId
+        from hypervisor_tpu.observability.tracing import WaveRecord
+
+        return WaveRecord(
+            wave_seq=7,
+            trace=CausalTraceId(),
+            stage=stage,
+            sessions=np.zeros(0, np.int32),
+            t0_us=0.0,
+            t1_us=duration_us,
+        )
+
+    def test_no_deadline_until_min_samples(self):
+        m = mp.Metrics()
+        mon = health.HealthMonitor(m, min_samples=8, floor_us=0.0)
+        self._seed_stage(m, "governance_wave", 100.0, 7)
+        assert mon.deadline_us("governance_wave") is None
+        self._seed_stage(m, "governance_wave", 100.0, 1)
+        assert mon.deadline_us("governance_wave") is not None
+
+    def test_deadline_is_p99_times_k_with_floor(self):
+        m = mp.Metrics()
+        mon = health.HealthMonitor(
+            m, k=4.0, floor_us=0.0, min_samples=4
+        )
+        self._seed_stage(m, "saga_round", 100.0, 64)
+        _, p99 = m.host_quantile(mp.STAGE_LATENCY["saga_round"], 0.99)
+        assert mon.deadline_us("saga_round") == pytest.approx(p99 * 4.0)
+        floored = health.HealthMonitor(
+            m, k=4.0, floor_us=1e9, min_samples=4
+        )
+        assert floored.deadline_us("saga_round") == 1e9
+
+    def test_straggler_event_carries_trace_id(self):
+        m = mp.Metrics()
+        mon = health.HealthMonitor(m, k=2.0, floor_us=0.0, min_samples=4)
+        fired = []
+        mon.add_listener(lambda kind, p: fired.append((kind, p)))
+        self._seed_stage(m, "governance_wave", 100.0, 64)
+        fast = mon.observe_wave(self._record("governance_wave", 150.0))
+        assert fast is None
+        slow = mon.observe_wave(self._record("governance_wave", 1e6))
+        assert slow is not None
+        assert slow.deadline_us < 1e6
+        assert [k for k, _ in fired] == ["straggler"]
+        payload = fired[0][1]
+        assert payload["trace_id"] == slow.trace_id
+        assert m.snapshot().counter(mp.WAVE_STRAGGLERS) == 1
+        assert mon.watchdog_summary()["straggler_count"] == 1
+
+    def test_straggler_bridges_onto_event_bus_via_tracer(self):
+        """End-to-end: the facade wires the monitor onto the bus; a
+        dispatch overrunning its deadline lands a WAVE_STRAGGLER bus
+        event whose causal id joins the wave's trace."""
+        from hypervisor_tpu.core import Hypervisor
+        from hypervisor_tpu.observability import (
+            EventType,
+            HypervisorEventBus,
+        )
+
+        bus = HypervisorEventBus()
+        hv = Hypervisor(event_bus=bus)
+        st = hv.state
+        # Arm the watchdog with an impossible deadline: every stage
+        # histogram is saturated with tiny samples, floor 0, k tiny.
+        st.health.k = 1e-6
+        st.health.floor_us = 0.0
+        st.health.min_samples = 1
+        self._seed_stage(st.metrics, "governance_wave", 1.0, 8)
+        _drive_wave(st, "wd:a")
+        events = bus.query_by_type(EventType.WAVE_STRAGGLER)
+        assert events, "no straggler event reached the bus"
+        assert events[-1].payload["stage"] == "governance_wave"
+        assert events[-1].causal_trace_id
+
+    async def test_straggler_joins_the_session_trace_export(self):
+        """The operator's payoff: `GET /trace/{session}` shows the
+        straggler event on the stalled wave's spans, joined by trace
+        word even though the bus event carries no session id."""
+        from hypervisor_tpu.api import HypervisorService
+        from hypervisor_tpu.api import models as M
+
+        svc = HypervisorService()
+        st = svc.hv.state
+        st.health.k = 1e-6
+        st.health.floor_us = 0.0
+        st.health.min_samples = 1
+        self._seed_stage(st.metrics, "governance_wave", 1.0, 8)
+        resp = await svc.create_session(
+            M.CreateSessionRequest(creator_did="did:tr")
+        )
+        slot = svc.hv.get_session(resp.session_id).slot
+        st.run_governance_wave(
+            np.array([slot], np.int32),
+            ["did:tr:0"],
+            np.array([slot], np.int32),
+            np.full(1, 0.8, np.float32),
+            np.zeros((1, 1, 16), np.uint32),
+        )
+        doc = await svc.trace_session(resp.session_id)
+        names = [
+            e["name"]
+            for e in doc["traceEvents"]
+            if e.get("ph") == "i"
+        ]
+        assert "health.wave_straggler" in names
+
+    def test_recompile_event_reaches_monitor_listeners(self):
+        m = mp.Metrics()
+        mon = health.HealthMonitor(m)
+        fired = []
+        mon.add_listener(lambda kind, p: fired.append((kind, p)))
+        watch = health.instrument("w_evt", jax.jit(lambda x: x + 1))
+        watch(jnp.arange(2.0))   # first trace: routine, no event
+        watch(jnp.arange(5.0))   # recompile: event
+        kinds = [k for k, _ in fired]
+        assert kinds == ["recompile"]
+        assert fired[0][1]["program"] == "w_evt"
+
+
+class TestDrainEdgeCases:
+    def test_histogram_bucket_wrap_across_drain_boundary(self):
+        """u32 bucket counts must stay monotonic when the raw column
+        wraps BETWEEN two drains (the delta-mod accounting)."""
+        m = mp.Metrics()
+        idx = mp.WAVE_LANES.index
+        near = 2**32 - 2
+        table = m.table
+        table = mt.replace(
+            table, hist=table.hist.at[idx, 3].set(np.uint32(near))
+        )
+        m.commit(table)
+        before = m.snapshot().hist[idx, 3]
+        assert before == near
+        # +4 samples in bucket 3 wraps the raw u32 (near + 4 > 2^32).
+        for _ in range(4):
+            m.commit(mt.observe(m.table, idx, jnp.float32(5.0)))
+        after = m.snapshot().hist[idx, 3]
+        assert after - before == 4
+        assert after == near + 4
+
+    def test_counter_wrap_with_drain_between_increments(self):
+        m = mp.Metrics()
+        m.commit(mt.counter_inc(m.table, 0, 2**32 - 5))
+        assert m.snapshot().counters[0] == 2**32 - 5
+        m.commit(mt.counter_inc(m.table, 0, 3))
+        assert m.snapshot().counters[0] == 2**32 - 2
+        m.commit(mt.counter_inc(m.table, 0, 7))  # wraps here
+        assert m.snapshot().counters[0] == 2**32 + 5
+
+    def test_double_drain_is_idempotent_through_the_state_path(self):
+        """Two metric drains with no traffic in between must agree on
+        every counter and fire no new capacity events."""
+        from hypervisor_tpu.state import HypervisorState
+
+        st = HypervisorState()
+        _drive_wave(st, "dd:a")
+        s1 = st.metrics_snapshot()
+        warnings_before = st.health.capacity_warning_count
+        s2 = st.metrics_snapshot()
+        assert np.array_equal(s1.counters, s2.counters)
+        assert np.array_equal(s1.hist, s2.hist)
+        assert st.health.capacity_warning_count == warnings_before
+
+
+def _suite_report(
+    round_no: int,
+    benches: dict[str, float],
+    backend: str = "cpu",
+    quick: bool = False,
+) -> dict:
+    return {
+        "source": "benchmarks/bench_suite.py metrics plane",
+        "device": backend,
+        "backend": backend,
+        "quick": quick,
+        "timestamp": "2026-08-04T00:00:00",
+        "pipeline_latency_us": {
+            "per_op_p50_us": benches.get("full_governance_pipeline")
+        },
+        "benchmarks": {
+            name: {"per_op_p50_us": v} for name, v in benches.items()
+        },
+    }
+
+
+class TestRegressionHarness:
+    def _write(self, root, round_no: int, doc: dict) -> None:
+        (root / f"BENCH_r{round_no:02d}.json").write_text(json.dumps(doc))
+
+    def test_parses_both_committed_formats(self, tmp_path):
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 1,
+            {
+                "n": 1, "cmd": "python bench.py", "rc": 0, "tail": "",
+                "parsed": {
+                    "metric": "headline", "value": 0.02, "unit": "us",
+                    "device": "TPU v5 lite0",
+                },
+            },
+        )
+        self._write(
+            tmp_path, 2,
+            {"n": 2, "cmd": "python bench.py", "rc": 17, "tail": "boom"},
+        )
+        self._write(
+            tmp_path, 3,
+            _suite_report(3, {"full_governance_pipeline": 40.0}),
+        )
+        rows = regression.load_history(tmp_path)
+        assert [r["round"] for r in rows] == [1, 3]  # rc!=0 dropped
+        assert rows[0]["format"] == "wrapper"
+        assert rows[0]["backend"] == "tpu"
+        assert rows[1]["format"] == "suite"
+        assert rows[1]["backend"] == "cpu"
+
+    def test_trajectory_written_and_gate_passes_without_baseline(
+        self, tmp_path
+    ):
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 1, _suite_report(1, {"full_governance_pipeline": 40.0})
+        )
+        rc = regression.main(["--root", str(tmp_path), "--quiet"])
+        assert rc == 0
+        traj = json.loads((tmp_path / "BENCH_trajectory.json").read_text())
+        assert len(traj["rounds"]) == 1
+
+    def test_regression_detected_above_tolerance(self, tmp_path):
+        from benchmarks import regression
+
+        for rnd, v in ((1, 10.0), (2, 12.0), (3, 11.0)):
+            self._write(
+                tmp_path, rnd,
+                _suite_report(rnd, {"full_governance_pipeline": v}),
+            )
+        self._write(
+            tmp_path, 4,
+            _suite_report(4, {"full_governance_pipeline": 100.0}),
+        )
+        rc = regression.main(
+            ["--root", str(tmp_path), "--tolerance", "0.5", "--quiet"]
+        )
+        assert rc == 1
+        rows = regression.load_history(tmp_path)
+        report = regression.compare(rows[-1], rows, tolerance=0.5)
+        assert not report["ok"]
+        assert report["regressions"][0]["bench"] == "full_governance_pipeline"
+        # baseline is the median of the priors (10, 12, 11) -> 11
+        assert report["regressions"][0]["baseline_per_op_us"] == 11.0
+
+    def test_within_tolerance_passes(self, tmp_path):
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 1, _suite_report(1, {"full_governance_pipeline": 10.0})
+        )
+        self._write(
+            tmp_path, 2, _suite_report(2, {"full_governance_pipeline": 14.0})
+        )
+        rc = regression.main(
+            ["--root", str(tmp_path), "--tolerance", "0.5", "--quiet"]
+        )
+        assert rc == 0
+
+    def test_incomparable_rounds_never_gate_each_other(self, tmp_path):
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 1,
+            _suite_report(
+                1, {"full_governance_pipeline": 0.01}, backend="tpu"
+            ),
+        )
+        # 1000x "slower" on cpu — a different backend, not a regression.
+        self._write(
+            tmp_path, 2,
+            _suite_report(
+                2, {"full_governance_pipeline": 10.0}, backend="cpu"
+            ),
+        )
+        rc = regression.main(
+            ["--root", str(tmp_path), "--tolerance", "0.1", "--quiet"]
+        )
+        assert rc == 0
+        # Same story for quick vs full batches on one backend.
+        self._write(
+            tmp_path, 3,
+            _suite_report(
+                3, {"full_governance_pipeline": 99.0}, quick=True
+            ),
+        )
+        assert (
+            regression.main(
+                ["--root", str(tmp_path), "--tolerance", "0.1", "--quiet"]
+            )
+            == 0
+        )
+
+    def test_check_flag_gates_a_fresh_report(self, tmp_path):
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 1, _suite_report(1, {"full_governance_pipeline": 10.0})
+        )
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(
+            json.dumps(_suite_report(99, {"full_governance_pipeline": 10.5}))
+        )
+        # --check files are parsed but NOT round-named -> unparseable.
+        bad = tmp_path / "BENCH_r99.json"
+        bad.write_text(
+            json.dumps(_suite_report(99, {"full_governance_pipeline": 10.5}))
+        )
+        rc = regression.main(
+            [
+                "--root", str(tmp_path), "--check", str(bad),
+                "--tolerance", "0.5", "--quiet", "--no-write",
+            ]
+        )
+        assert rc == 0
+
+    def test_next_round_path_advances(self, tmp_path):
+        from benchmarks import regression
+
+        assert regression.next_round_path(tmp_path).name == "BENCH_r01.json"
+        self._write(
+            tmp_path, 7, _suite_report(7, {"full_governance_pipeline": 1.0})
+        )
+        assert regression.next_round_path(tmp_path).name == "BENCH_r08.json"
+
+
+class TestEndpoints:
+    async def _svc_with_traffic(self):
+        from hypervisor_tpu.api import HypervisorService
+        from hypervisor_tpu.api import models as M
+
+        svc = HypervisorService()
+        resp = await svc.create_session(
+            M.CreateSessionRequest(creator_did="did:hadmin")
+        )
+        await svc.join_session(
+            resp.session_id,
+            M.JoinSessionRequest(agent_did="did:hp", sigma_raw=0.8),
+        )
+        return svc
+
+    async def test_debug_health_payload_shape(self):
+        svc = await self._svc_with_traffic()
+        payload = await svc.debug_health()
+        json.dumps(payload)  # JSON-serializable end to end
+        assert payload["status"] == "ok"
+        assert set(payload["occupancy"]["tables"]) >= set(mp.HEALTH_TABLES)
+        assert payload["compiles"]["compiles"] >= 1
+        assert "watchdog" in payload and "stages" in payload
+
+    async def test_debug_memory_payload_shape(self):
+        svc = await self._svc_with_traffic()
+        payload = await svc.debug_memory()
+        json.dumps(payload)
+        assert payload["hbm_total_bytes"] > 0
+        sessions = payload["tables"]["sessions"]
+        assert sessions["live_rows"] >= 1
+        assert sessions["capacity_rows"] > 0
+        assert 0 <= sessions["occupancy"] <= 1
+
+    async def test_debug_compiles_acceptance_flow(self):
+        """The acceptance criterion through the endpoint: identical
+        dispatches report zero new recompiles; a batch-shape change
+        reports exactly one, naming the changed argument."""
+        svc = await self._svc_with_traffic()
+        st = svc.hv.state
+
+        def wave_stats(payload):
+            return next(
+                row
+                for row in payload["by_program"]
+                if row["program"] == "governance_wave"
+            )
+
+        _drive_wave(st, "ep:a", n=2)
+        base = wave_stats(await svc.debug_compiles())
+        _drive_wave(st, "ep:b", n=2)  # identical signature
+        mid = wave_stats(await svc.debug_compiles())
+        assert mid["recompiles"] == base["recompiles"]
+        assert mid["compiles"] == base["compiles"]
+        _drive_wave(st, "ep:c", n=5)  # batch-shape change
+        after = wave_stats(await svc.debug_compiles())
+        assert after["recompiles"] == mid["recompiles"] + 1
+        assert after["last"]["kind"] == "recompile"
+        assert after["last"]["changed"]
+
+    async def test_routes_registered_on_both_transports(self):
+        from hypervisor_tpu.api.server import ROUTES, _Router
+
+        router = _Router()
+        for path in ("/debug/health", "/debug/memory", "/debug/compiles"):
+            assert router.match("GET", path) is not None, path
+        names = {name for _, _, name, _ in ROUTES}
+        assert {"debug_health", "debug_memory", "debug_compiles"} <= names

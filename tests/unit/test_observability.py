@@ -64,9 +64,14 @@ class TestEventBus:
         assert self.bus.query_by_session("s1") == []
 
     def test_event_type_codes_stable(self):
-        # 40 typed events across 8 categories (the reference README says 38
-        # but its enum defines 40 — we match the enum).
-        assert len({t.code for t in EventType}) == len(EventType) == 40
+        # The reference's 40 typed events across 8 categories (its
+        # README says 38 but its enum defines 40 — we match the enum)
+        # plus the 3 health-plane events (append-only: codes are the
+        # device-log wire format, so the first 40 stay stable).
+        assert len({t.code for t in EventType}) == len(EventType) == 43
+        assert EventType.WAVE_STRAGGLER.code == 40
+        assert EventType.CAPACITY_WARNING.code == 41
+        assert EventType.RECOMPILE.code == 42
 
     def test_to_dict(self):
         event = self._emit(EventType.RING_ASSIGNED, "s1", "did:a")

@@ -100,6 +100,40 @@ class TestTraceRing:
         # seq words survive the wrap: live rows are the 4 newest stamps.
         assert sorted(np.asarray(log.seq).tolist()) == [2, 3, 4, 5]
 
+    def test_tracer_overflow_keeps_newest_waves_reconstructable(self):
+        """Stamping past the ring's capacity (health-plane edge case):
+        the cursor keeps counting past capacity, evicted waves drop out
+        of the reconstruction, and the NEWEST waves still rebuild with
+        their full child structure."""
+        tracer = tracing.Tracer(capacity=16, enabled=True, sample_rate=1.0)
+        # Each host-mirrored wave writes 12 rows (root + 5 children x2),
+        # so 5 waves overflow a 16-row host mirror decisively. Device
+        # path: stamp via WaveStamps on the device ring.
+        n_waves = 5
+        for i in range(n_waves):
+            handle = tracer.begin_wave("governance_wave", sessions=(i,))
+            st = tracing.WaveStamps(handle.ctx, "governance_wave")
+            st.begin("governance_wave")
+            for child in tracing.WAVE_CHILD_STAGES["governance_wave"]:
+                st.begin(child)
+                st.end(child)
+            st.end("governance_wave")
+            tracer.end_wave(handle, st.commit(tracer.table))
+        assert int(tracer.table.cursor) == n_waves * 12
+        assert int(tracer.table.cursor) > tracer.capacity  # overflowed
+        spans = tracer.drain()
+        # Only fully-surviving waves reconstruct as roots; the newest
+        # wave always does, with its complete child structure.
+        assert spans, "overflowed ring lost every wave"
+        newest = max(spans, key=lambda s: s.wave_seq)
+        assert newest.wave_seq == n_waves - 1
+        assert [c.stage for c in newest.children] == list(
+            tracing.WAVE_CHILD_STAGES["governance_wave"]
+        )
+        summary = tracer.flight_summary()
+        assert summary["ring_cursor"] == n_waves * 12
+        assert summary["waves_indexed"] == n_waves
+
     def test_unsampled_wave_drops_rows(self):
         log = TraceLog.create(8)
         st = tracing.WaveStamps(_ctx(sampled=False), "gateway_wave")

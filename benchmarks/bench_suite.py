@@ -25,6 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import tempfile
 import time
 from pathlib import Path
 
@@ -448,6 +449,84 @@ def metrics_plane_report(results: list[dict]) -> dict:
     return report
 
 
+def chaos_benchmark(seed: int, quick: bool) -> dict:
+    """`--chaos <seed>`: the standard governance rounds under a FIXED
+    wave-layer fault plan (`testing.chaos.WaveChaosPlan`), dispatched
+    through the resilience supervisor. Reports recovery latency (time
+    from a dispatch's first injected fault to its eventual success) and
+    the completed-wave ratio into the BENCH payload, so the trajectory
+    tracks resilience alongside speed. Seeded: the same seed replays
+    the same fault schedule against the same round structure.
+    """
+    import time as _time
+
+    from hypervisor_tpu.models import SessionConfig
+    from hypervisor_tpu.resilience import Supervisor, WriteAheadLog
+    from hypervisor_tpu.state import HypervisorState
+    from hypervisor_tpu.testing.chaos import WaveChaosInjector, WaveChaosPlan
+
+    rounds = 8 if quick else 24
+    lanes = 16 if quick else 64
+    st = HypervisorState()
+    wal_dir = Path(tempfile.mkdtemp(prefix="hv_bench_chaos_"))
+    st.journal = WriteAheadLog(wal_dir / "wal.log", fsync=False)
+    sup = Supervisor(
+        st, max_retries=4, backoff_base_s=0.001, backoff_cap_s=0.01,
+        degrade_after_failures=2, exit_after_clean=2,
+    )
+    plan = WaveChaosPlan(
+        seed=seed, fail_rate=0.25, hang_rate=0.05, hang_seconds=0.002
+    )
+    st.fault_injector = WaveChaosInjector(plan)
+
+    completed = 0
+    t0 = _time.perf_counter()
+    for r in range(rounds):
+        slots = st.create_sessions_batch(
+            [f"chaos{r}:{i}" for i in range(lanes)],
+            SessionConfig(min_sigma_eff=0.0),
+        )
+        try:
+            sup.dispatch(
+                "governance_wave", st.run_governance_wave, slots,
+                [f"did:chaos{r}:{i}" for i in range(lanes)], slots.copy(),
+                np.full(lanes, 0.8, np.float32),
+                np.zeros((1, lanes, 16), np.uint32), float(r),
+            )
+            completed += 1
+        except Exception:  # noqa: BLE001 — exhausted retries count as lost
+            pass
+    wall_s = _time.perf_counter() - t0
+    latencies = sorted(sup.recovery_latencies_ms)
+    return {
+        "seed": seed,
+        "plan": {
+            "fail_rate": plan.fail_rate,
+            "hang_rate": plan.hang_rate,
+            "hang_seconds": plan.hang_seconds,
+        },
+        "rounds": rounds,
+        "lanes_per_round": lanes,
+        "waves_completed": completed,
+        "completed_wave_ratio": round(completed / rounds, 4),
+        "dispatch_retries": sup.retries,
+        "dispatches_failed": sup.failed_dispatches,
+        "degraded_entries": sup.degraded_entries,
+        "faults_injected": st.fault_injector.report(),
+        "recovery_latency_ms": (
+            {
+                "n": len(latencies),
+                "p50": round(latencies[len(latencies) // 2], 3),
+                "max": round(latencies[-1], 3),
+            }
+            if latencies
+            else {"n": 0}
+        ),
+        "wall_s": round(wall_s, 3),
+        "wal_records": st.journal.records_written,
+    }
+
+
 def _git_commit() -> str | None:
     """Current commit hash, stamped into bench reports so a trajectory
     row names the code it measured; None outside a git checkout."""
@@ -486,6 +565,18 @@ def main() -> None:
         ),
     )
     ap.add_argument(
+        "--chaos",
+        type=int,
+        default=None,
+        metavar="SEED",
+        help=(
+            "also run the standard governance rounds under a fixed "
+            "wave-layer fault plan (seeded, replayable) through the "
+            "resilience supervisor, and report recovery latency + "
+            "completed-wave ratio into the BENCH payload"
+        ),
+    )
+    ap.add_argument(
         "--write-results",
         action="store_true",
         help=(
@@ -512,6 +603,20 @@ def main() -> None:
                 flush=True,
             )
 
+    chaos_rec = None
+    if args.chaos is not None:
+        chaos_rec = chaos_benchmark(args.chaos, args.quick)
+        if not args.json_only:
+            lat = chaos_rec["recovery_latency_ms"]
+            print(
+                f"chaos[seed={args.chaos}]: "
+                f"{chaos_rec['waves_completed']}/{chaos_rec['rounds']} waves "
+                f"(ratio {chaos_rec['completed_wave_ratio']}), "
+                f"{chaos_rec['dispatch_retries']} retries, recovery p50 "
+                f"{lat.get('p50', '—')} ms",
+                flush=True,
+            )
+
     if args.metrics_out:
         from benchmarks import regression
 
@@ -530,6 +635,9 @@ def main() -> None:
             "quick": args.quick,
             "pipeline_latency_us": plane.get("full_governance_pipeline"),
             "benchmarks": plane,
+            # Resilience row (--chaos <seed>): the trajectory tracks
+            # completed-wave ratio + recovery latency alongside speed.
+            "chaos": chaos_rec,
         }
         out_path.write_text(json.dumps(report, indent=2) + "\n")
         if not args.json_only:
@@ -552,6 +660,7 @@ def main() -> None:
         "iterations": args.iters,
         "quick": args.quick,
         "benchmarks": results,
+        "chaos": chaos_rec,
     }
     if jax.default_backend() not in ("tpu",) and not args.write_results:
         print(

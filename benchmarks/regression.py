@@ -80,6 +80,7 @@ def parse_round_file(path: Path) -> Optional[dict]:
         headline = (doc.get("pipeline_latency_us") or {}).get(
             "per_op_p50_us"
         )
+        chaos = doc.get("chaos")
         row.update(
             format="suite",
             backend=doc.get("backend", "cpu"),
@@ -89,6 +90,18 @@ def parse_round_file(path: Path) -> Optional[dict]:
             git_commit=doc.get("git_commit"),
             headline_per_op_us=headline,
             benches=benches,
+            # Resilience row (bench_suite --chaos): completed-wave ratio
+            # + recovery latency land in the trajectory alongside speed.
+            chaos=(
+                {
+                    "seed": chaos.get("seed"),
+                    "completed_wave_ratio": chaos.get("completed_wave_ratio"),
+                    "recovery_latency_ms": chaos.get("recovery_latency_ms"),
+                    "degraded_entries": chaos.get("degraded_entries"),
+                }
+                if isinstance(chaos, dict)
+                else None
+            ),
         )
         return row
     if "parsed" in doc or "rc" in doc:

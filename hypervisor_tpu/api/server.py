@@ -1,13 +1,15 @@
 """REST transports for the Hypervisor API.
 
-Two transports over the same `HypervisorService` (36 routes: the
+Two transports over the same `HypervisorService` (37 routes: the
 reference's 21, `api/server.py`, plus device stats, quarantine views,
 the per-membership agent view, leave, the operator sweep, the
 per-action gateway with its wave sibling, the flight recorder —
 `GET /trace/{session_id}` Chrome/OTLP export + `GET /debug/flight` —
 and the health plane: `GET /debug/health` (watchdog + occupancy +
 compile totals + stage quantiles), `GET /debug/memory` (per-table HBM
-footprints), `GET /debug/compiles` (compile telemetry)):
+footprints), `GET /debug/compiles` (compile telemetry), plus the
+resilience plane: `GET /debug/resilience` (supervisor mode, retry
+accounting, WAL status, last watermarked checkpoint)):
 
  - `create_app()` — a FastAPI application with CORS-open middleware and
    OpenAPI docs, when fastapi is installed.
@@ -40,6 +42,7 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/debug/health", "debug_health", None),
     ("GET", "/debug/memory", "debug_memory", None),
     ("GET", "/debug/compiles", "debug_compiles", None),
+    ("GET", "/debug/resilience", "debug_resilience", None),
     ("GET", "/api/v1/stats", "stats", None),
     ("GET", "/api/v1/device/stats", "device_stats", None),
     ("POST", "/api/v1/sessions", "create_session", M.CreateSessionRequest),

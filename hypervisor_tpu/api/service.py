@@ -135,6 +135,14 @@ class HypervisorService:
         drained live-row gauges)."""
         return self.hv.state.memory_summary()
 
+    async def debug_resilience(self) -> dict:
+        """`GET /debug/resilience`: the resilience plane in one poll —
+        supervisor mode (normal/degraded) with the active shed policy,
+        dispatch/retry/failure accounting, health-event pressure,
+        recovery latency quantiles, WAL status, and the last
+        watermarked checkpoint."""
+        return self.hv.state.resilience_summary()
+
     async def debug_compiles(self) -> dict:
         """`GET /debug/compiles`: compile telemetry for the watched
         jitted wave entry points — compile/recompile/donation-failure

@@ -1594,6 +1594,13 @@ class Hypervisor:
             "straggler": EventType.WAVE_STRAGGLER,
             "capacity": EventType.CAPACITY_WARNING,
             "recompile": EventType.RECOMPILE,
+            # Resilience supervisor transitions ride the same fan-out
+            # (`HealthMonitor.emit_event`), so degraded enter/exit and
+            # retry events land on the bus without a second bridge.
+            "degraded_enter": EventType.DEGRADED_ENTERED,
+            "degraded_exit": EventType.DEGRADED_EXITED,
+            "dispatch_retry": EventType.DISPATCH_RETRY,
+            "wal_replayed": EventType.WAL_REPLAYED,
         }.get(kind)
         if event_type is None or self.event_bus is None:
             return

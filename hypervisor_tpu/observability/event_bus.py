@@ -80,6 +80,11 @@ class EventType(str, enum.Enum):
     WAVE_STRAGGLER = "health.wave_straggler"
     CAPACITY_WARNING = "health.capacity_warning"
     RECOMPILE = "health.recompile"
+    # Resilience plane (APPEND ONLY, same wire-format rule)
+    DEGRADED_ENTERED = "resilience.degraded_entered"
+    DEGRADED_EXITED = "resilience.degraded_exited"
+    DISPATCH_RETRY = "resilience.dispatch_retry"
+    WAL_REPLAYED = "resilience.wal_replayed"
 
     @property
     def code(self) -> int:

@@ -508,6 +508,13 @@ class HealthMonitor:
             except Exception:  # noqa: BLE001 — reporting must not raise
                 pass
 
+    def emit_event(self, kind: str, payload: dict) -> None:
+        """Public fan-out for co-resident planes: the resilience
+        supervisor publishes its degraded-mode transitions and retry
+        events through the SAME listener set the watchdog uses, so the
+        facade's one health->bus bridge covers both planes."""
+        self._fire(kind, payload)
+
     def _on_compile(self, event: CompileEvent) -> None:
         """Compile-log subscription: recompiles and donation failures
         are operator-visible events; first traces are routine."""

@@ -265,6 +265,30 @@ CAPACITY_WARNINGS = REGISTRY.counter(
     "table/ring occupancy crossings above the configured warn threshold",
 )
 
+# ── resilience plane (supervisor / WAL / degraded mode) ──────────────
+# Host-incremented on the supervisor's retry ladder and the state's
+# shed paths (`hypervisor_tpu.resilience`).
+DISPATCH_RETRIES = REGISTRY.counter(
+    "hv_dispatch_retries_total",
+    "wave dispatch attempts retried after a transient fault",
+)
+DISPATCH_FAILURES = REGISTRY.counter(
+    "hv_dispatch_failures_total",
+    "wave dispatches that exhausted their retry budget",
+)
+DEGRADED_ENTRIES = REGISTRY.counter(
+    "hv_degraded_entries_total",
+    "times the supervisor flipped the degraded-mode policy on",
+)
+ADMISSIONS_SHED = REGISTRY.counter(
+    "hv_admissions_shed_total",
+    "join stagings refused by an active degraded-mode policy",
+)
+WAL_REPLAYED_OPS = REGISTRY.counter(
+    "hv_wal_replayed_ops_total",
+    "committed WAL records replayed by crash recovery",
+)
+
 #: Tables the occupancy accounting names. `metrics` is excluded from the
 #: warn set (its layout is static — always "full"); rings (the three
 #: logs) warn once as they approach their first wrap.

@@ -1,0 +1,57 @@
+"""Resilience plane: WAL crash consistency, recovery, degraded mode.
+
+Three pieces (docs/OPERATIONS.md "Recovery & fault domains"):
+
+  * `wal` — the write-ahead intent log journaled around every
+    state-mutating dispatch in `hypervisor_tpu.state`.
+  * `recovery` — restore = newest durable checkpoint + audit-chain
+    verification + deterministic replay of the committed WAL suffix.
+  * `supervisor` — the loop that turns health-plane detection into
+    action: bounded retry with backoff, periodic watermarked
+    checkpoints, and the degraded-mode policy (`policy`) that sheds
+    admissions and pauses fan-out while keeping terminations and audit
+    commits flowing.
+
+`policy` is a leaf module (`state.py` imports it for enforcement);
+everything else resolves lazily to avoid the state <-> recovery import
+cycle, mirroring `hypervisor_tpu.runtime`.
+"""
+
+from hypervisor_tpu.resilience.policy import DegradedModeRefusal, DegradedPolicy
+from hypervisor_tpu.resilience.wal import WalRecord, WriteAheadLog, scan
+
+__all__ = [
+    "DegradedModeRefusal",
+    "DegradedPolicy",
+    "RecoveryError",
+    "Supervisor",
+    "WalRecord",
+    "WriteAheadLog",
+    "checkpoint_with_watermark",
+    "latest_durable_checkpoint",
+    "recover",
+    "replay",
+    "scan",
+    "verify_audit_heads",
+]
+
+
+def __getattr__(name):
+    # recovery/supervisor import HypervisorState (which imports this
+    # package for the policy); resolve lazily to avoid the cycle.
+    if name in (
+        "RecoveryError",
+        "checkpoint_with_watermark",
+        "latest_durable_checkpoint",
+        "recover",
+        "replay",
+        "verify_audit_heads",
+    ):
+        from hypervisor_tpu.resilience import recovery
+
+        return getattr(recovery, name)
+    if name == "Supervisor":
+        from hypervisor_tpu.resilience.supervisor import Supervisor
+
+        return Supervisor
+    raise AttributeError(name)

@@ -1,0 +1,52 @@
+"""Degraded-mode policy: what keeps flowing when the supervisor sheds.
+
+A leaf module on purpose — `hypervisor_tpu.state` imports it to enforce
+the policy at the dispatch sites (admission staging, saga fan-out), so
+nothing here may import back into the state/runtime layers.
+
+The policy table (docs/OPERATIONS.md "Recovery & fault domains"):
+
+    path                       degraded behaviour
+    ─────────────────────────  ──────────────────────────────────────
+    enqueue_join               REFUSED (DegradedModeRefusal) — new
+                               admissions are load the plane sheds
+    fanout_dispatch            PAUSED (empty work list) — saga groups
+                               stay PENDING until the mode exits
+    terminate_sessions         FLOWS — draining live work is exactly
+                               what a degraded plane must keep doing
+    stage_delta / flush_deltas FLOWS — audit commits must never stall
+    saga_round (cursor walk)   FLOWS — in-flight sagas settle
+
+Shedding refuses LOUDLY (an exception, not a silent -1): a caller that
+treats a shed join as "queued" would wait forever on an admission that
+was never staged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class DegradedModeRefusal(RuntimeError):
+    """An operation shed by the active degraded-mode policy."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradedPolicy:
+    """What the supervisor flips on when thresholds trip.
+
+    Frozen: the active policy is shared state read on dispatch paths
+    from any thread — mode changes swap the whole object
+    (`HypervisorState.degraded_policy`), never mutate one in place.
+    """
+
+    shed_admissions: bool = True
+    pause_saga_fanout: bool = True
+    reason: str = ""
+    entered_at: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+__all__ = ["DegradedModeRefusal", "DegradedPolicy"]

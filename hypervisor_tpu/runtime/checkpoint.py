@@ -70,6 +70,21 @@ def _writer_lock(target: Path) -> threading.Lock:
         return _writer_locks.setdefault(key, threading.Lock())
 
 
+def _fsync_dir(path: Path) -> None:
+    """Make the directory's own entries (the os.replace renames and the
+    .done marker) durable; best-effort where the OS refuses dir fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover — platform without dir-open
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover — e.g. network filesystems
+        pass
+    finally:
+        os.close(fd)
+
+
 def _intern_dump(t: InternTable) -> list[str]:
     return [t.string(h) for h in range(len(t))]
 
@@ -123,6 +138,15 @@ def host_metadata(state: HypervisorState) -> dict:
         # the npz while slot allocation uses the live config, so a
         # capacity mismatch must fail loudly, not corrupt silently.
         "capacity": dataclasses.asdict(state.config.capacity),
+        # WAL watermark (resilience plane): the last committed journal
+        # seq this snapshot CONTAINS — captured here, synchronously with
+        # the array fetch, so `resilience.recovery.recover` replays
+        # exactly the suffix past it (None when no journal is attached).
+        "wal_seq": (
+            state.journal.last_seq
+            if getattr(state, "journal", None) is not None
+            else None
+        ),
     }
 
 
@@ -164,22 +188,47 @@ def save_state(
     done = target / ".done"
     done.unlink(missing_ok=True)  # readers must not trust a torn overwrite
 
-    arrays = state_arrays(state)          # device -> host happens here
-    meta = host_metadata(state)
+    # ONE consistent cut for the arrays + the WAL watermark: the staging
+    # lock serializes the concurrent-producer paths (enqueue_join and
+    # friends journal UNDER it), so a join that commits to the WAL while
+    # the arrays are fetching can never land below the watermark yet be
+    # missing from the snapshot — replay would skip it and the admission
+    # would be silently lost. Re-check staged rows under the same lock
+    # (the early check above raced producers by design).
+    with state._enqueue_lock:
+        if state._pending_rows:
+            raise RuntimeError(
+                f"cannot checkpoint with {len(state._pending_rows)} staged "
+                "joins; call flush_joins() first"
+            )
+        arrays = state_arrays(state)      # device -> host happens here
+        meta = host_metadata(state)
 
     def write():
         with _writer_lock(target):
             # A writer queued behind an older save must drop the marker the
             # older writer just published: only the newest data earns .done.
             done.unlink(missing_ok=True)
+            # Crash atomicity is tmp + fsync + os.replace + directory
+            # fsync: the data must be ON DISK before the rename makes it
+            # visible (a rename can survive a crash its data didn't),
+            # and the renames must be durable before `.done` says so —
+            # a torn tables.npz must NEVER be visible to restore_state.
             tmp_npz = target / "tables.npz.tmp"
             with open(tmp_npz, "wb") as f:
                 np.savez(f, **arrays)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp_npz, target / "tables.npz")
             tmp_json = target / "host.json.tmp"
-            tmp_json.write_text(json.dumps(meta))
+            with open(tmp_json, "w") as f:
+                f.write(json.dumps(meta))
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp_json, target / "host.json")
+            _fsync_dir(target)
             done.touch()
+            _fsync_dir(target)
 
     if background:
         threading.Thread(target=write, daemon=True).start()
@@ -373,6 +422,9 @@ def _rebuild(data, meta: dict, config: HypervisorConfig) -> HypervisorState:
         int(r) for r in meta.get("free_elev_slots", [])
     ]
     state._epoch_base = float(meta.get("epoch_base", state._epoch_base))
+    # WAL watermark: recovery replays committed records PAST this seq
+    # (None/0 when the save ran without a journal — replay everything).
+    state._restored_wal_seq = meta.get("wal_seq")
     # Ring-buffer row ownership comes straight from the saved session
     # column — without it a post-restore wrap would skip eviction and
     # leave stale audit rows pointing at recycled digests.

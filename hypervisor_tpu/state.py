@@ -54,7 +54,24 @@ from hypervisor_tpu.tables.state import (
     VouchTable,
 )
 from hypervisor_tpu.tables.struct import replace
+from hypervisor_tpu.resilience.policy import DegradedModeRefusal
 from hypervisor_tpu.runtime import StagingQueue
+
+
+class _NullTxn:
+    """No-journal stand-in for `_journal` (shared, stateless)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def cancel(self) -> None:
+        pass
+
+
+_NULL_TXN = _NullTxn()
 
 
 # Every module-level jit entry point is wrapped in compile telemetry
@@ -224,6 +241,18 @@ def _contiguous_range(slots: np.ndarray) -> tuple | None:
     return (jnp.asarray(lo, jnp.int32), jnp.asarray(lo + slots.size, jnp.int32))
 
 
+def _config_payload(config: SessionConfig) -> dict:
+    """SessionConfig -> WAL-serializable fields (`resilience.recovery.
+    _session_config` is the inverse — one pair, kept adjacent-by-name)."""
+    return {
+        "mode": config.consistency_mode.value,
+        "max_participants": int(config.max_participants),
+        "max_duration_seconds": int(config.max_duration_seconds or 0),
+        "min_sigma_eff": float(config.min_sigma_eff),
+        "enable_audit": bool(config.enable_audit),
+    }
+
+
 class HypervisorState:
     """Authoritative batched state: device tables + host boundary indices."""
 
@@ -319,6 +348,28 @@ class HypervisorState:
             config.rate_limit.ring_bursts, jnp.float32
         )
 
+        # Resilience plane (opt-in, `hypervisor_tpu.resilience`):
+        #   journal         — write-ahead intent log bracketing every
+        #                     state-mutating dispatch (`_journal`); the
+        #                     crash-recovery replay re-executes committed
+        #                     records against a restored checkpoint.
+        #   fault_injector  — seeded dispatch interposer (`testing.chaos.
+        #                     WaveChaosInjector`) consulted by `_chaos`
+        #                     BEFORE any mutation, so an injected raise
+        #                     is always retry-safe.
+        #   degraded_policy — the supervisor flips this on past failure
+        #                     thresholds: admissions shed, fan-out
+        #                     pauses; terminations/audit commits flow.
+        #   resilience      — the attached Supervisor (what
+        #                     `/debug/resilience` serves).
+        self.journal = None
+        self.fault_injector = None
+        self.degraded_policy = None
+        self.resilience = None
+        # WAL watermark carried by a restored checkpoint (`runtime.
+        # checkpoint._rebuild`): recovery replays records PAST this seq.
+        self._restored_wal_seq: Optional[int] = None
+
         # Module-level jit wrappers: every HypervisorState shares one trace
         # cache instead of recompiling per instance.
         self._admit = _ADMIT
@@ -334,37 +385,92 @@ class HypervisorState:
         """Seconds since this state's epoch — the f32-safe device time."""
         return time.time() - self._epoch_base
 
+    # ── resilience hooks ─────────────────────────────────────────────
+
+    def _journal(self, op: str, **payload):
+        """WAL intent/commit bracket for one state-mutating op — a
+        no-op context when no journal is attached. Re-entrant: an op
+        journaled inside another journaled op (the gateway phase inside
+        a governance wave) is suppressed; the outer record replays the
+        composite. Replay handlers live in `resilience.recovery.REPLAY`
+        — every op name used here must have a row there."""
+        if self.journal is None:
+            return _NULL_TXN
+        return self.journal.txn(op, payload)
+
+    def _chaos(self, stage: str) -> None:
+        """Fault-injection gate at a dispatch site: consulted BEFORE
+        any mutation so an injected raise leaves tables, host indices,
+        and the staging queue exactly as they were (the supervisor's
+        retry re-dispatches cleanly)."""
+        inj = self.fault_injector
+        if inj is not None:
+            inj.on_dispatch(stage)
+
+    def _shed_gate(self) -> None:
+        """Degraded-mode admission shedding (`resilience.policy`): new
+        joins are the load a degraded plane refuses LOUDLY while
+        terminations and audit commits keep flowing."""
+        policy = self.degraded_policy
+        if policy is not None and policy.shed_admissions:
+            self.metrics.inc(metrics_plane.ADMISSIONS_SHED)
+            raise DegradedModeRefusal(
+                f"admission shed: degraded mode active ({policy.reason})"
+            )
+
     # ── sessions ─────────────────────────────────────────────────────
 
-    def create_session(self, session_id: str, config: SessionConfig) -> int:
-        """Allocate a session row in HANDSHAKING state; returns the slot."""
+    def create_session(
+        self,
+        session_id: str,
+        config: SessionConfig,
+        now: Optional[float] = None,
+    ) -> int:
+        """Allocate a session row in HANDSHAKING state; returns the slot.
+
+        `now` pins the created_at stamp (epoch-relative); None stamps
+        `self.now()`. The resolved value is journaled, so WAL replay
+        rebuilds the row bit-identically regardless of wall clock.
+        """
         if self._next_session_slot >= self.sessions.sid.shape[0]:
             raise RuntimeError(
                 f"session table full ({self.sessions.sid.shape[0]}); "
                 "raise config.capacity.max_sessions"
             )
-        slot = self._next_session_slot
-        self._next_session_slot += 1
-        sid = self.session_ids.intern(session_id)
-        self.sessions = replace(
-            self.sessions,
-            sid=self.sessions.sid.at[slot].set(sid),
-            state=self.sessions.state.at[slot].set(
-                SessionState.HANDSHAKING.code
-            ),
-            mode=self.sessions.mode.at[slot].set(config.consistency_mode.code),
-            max_participants=self.sessions.max_participants.at[slot].set(
-                config.max_participants
-            ),
-            min_sigma_eff=self.sessions.min_sigma_eff.at[slot].set(
-                config.min_sigma_eff
-            ),
-            enable_audit=self.sessions.enable_audit.at[slot].set(config.enable_audit),
-            created_at=self.sessions.created_at.at[slot].set(self.now()),
-            max_duration=self.sessions.max_duration.at[slot].set(
-                float(config.max_duration_seconds or 0)
-            ),
-        )
+        if now is None:
+            now = self.now()
+        with self._journal(
+            "create_session",
+            sid=session_id,
+            now=float(now),
+            **_config_payload(config),
+        ):
+            slot = self._next_session_slot
+            self._next_session_slot += 1
+            sid = self.session_ids.intern(session_id)
+            self.sessions = replace(
+                self.sessions,
+                sid=self.sessions.sid.at[slot].set(sid),
+                state=self.sessions.state.at[slot].set(
+                    SessionState.HANDSHAKING.code
+                ),
+                mode=self.sessions.mode.at[slot].set(
+                    config.consistency_mode.code
+                ),
+                max_participants=self.sessions.max_participants.at[slot].set(
+                    config.max_participants
+                ),
+                min_sigma_eff=self.sessions.min_sigma_eff.at[slot].set(
+                    config.min_sigma_eff
+                ),
+                enable_audit=self.sessions.enable_audit.at[slot].set(
+                    config.enable_audit
+                ),
+                created_at=self.sessions.created_at.at[slot].set(float(now)),
+                max_duration=self.sessions.max_duration.at[slot].set(
+                    float(config.max_duration_seconds or 0)
+                ),
+            )
         return slot
 
     def create_sessions_batch(
@@ -379,31 +485,36 @@ class HypervisorState:
                 f"{self.sessions.sid.shape[0]}; raise "
                 "config.capacity.max_sessions"
             )
-        self._next_session_slot += k
-        slots = np.arange(base, base + k, dtype=np.int32)
-        sids = np.array(
-            [self.session_ids.intern(s) for s in session_ids], np.int32
-        )
-        sl = jnp.asarray(slots)
-        self.sessions = replace(
-            self.sessions,
-            sid=self.sessions.sid.at[sl].set(jnp.asarray(sids)),
-            state=self.sessions.state.at[sl].set(
-                jnp.int8(SessionState.HANDSHAKING.code)
-            ),
-            mode=self.sessions.mode.at[sl].set(
-                jnp.int8(config.consistency_mode.code)
-            ),
-            max_participants=self.sessions.max_participants.at[sl].set(
-                config.max_participants
-            ),
-            min_sigma_eff=self.sessions.min_sigma_eff.at[sl].set(
-                config.min_sigma_eff
-            ),
-            enable_audit=self.sessions.enable_audit.at[sl].set(
-                config.enable_audit
-            ),
-        )
+        with self._journal(
+            "create_sessions_batch",
+            sids=list(session_ids),
+            **_config_payload(config),
+        ):
+            self._next_session_slot += k
+            slots = np.arange(base, base + k, dtype=np.int32)
+            sids = np.array(
+                [self.session_ids.intern(s) for s in session_ids], np.int32
+            )
+            sl = jnp.asarray(slots)
+            self.sessions = replace(
+                self.sessions,
+                sid=self.sessions.sid.at[sl].set(jnp.asarray(sids)),
+                state=self.sessions.state.at[sl].set(
+                    jnp.int8(SessionState.HANDSHAKING.code)
+                ),
+                mode=self.sessions.mode.at[sl].set(
+                    jnp.int8(config.consistency_mode.code)
+                ),
+                max_participants=self.sessions.max_participants.at[sl].set(
+                    config.max_participants
+                ),
+                min_sigma_eff=self.sessions.min_sigma_eff.at[sl].set(
+                    config.min_sigma_eff
+                ),
+                enable_audit=self.sessions.enable_audit.at[sl].set(
+                    config.enable_audit
+                ),
+            )
         return slots
 
     def _mesh_wave_slots(self, b: int, n_shards: int) -> np.ndarray:
@@ -503,7 +614,64 @@ class HypervisorState:
         separate between-tick program, so the deferred-commit path is
         what always runs); `defer_reconcile=True` accumulates them on
         the state instead, until `reconcile_session_partials(mesh)`.
+
+        Resilience: the fault-injection gate (`_chaos`) runs BEFORE
+        anything mutates, so an injected raise is retry-safe.
+        Single-device waves journal to the WAL (op "governance_wave",
+        with the resolved action columns); mesh waves do not — the WAL
+        replays on a single device, so mesh deployments lean on
+        checkpoint cadence instead (docs/OPERATIONS.md "Recovery &
+        fault domains").
         """
+        self._chaos("governance_wave")
+        if mesh is not None or self.journal is None:
+            return self._governance_wave_impl(
+                session_slots, dids, agent_sessions, sigma_raw,
+                delta_bodies, now=now, omega=omega,
+                trustworthy=trustworthy, use_pallas=use_pallas, mesh=mesh,
+                actions=actions, defer_reconcile=defer_reconcile,
+            )
+        act = None if actions is None else self._normalize_actions(actions)
+        with self._journal(
+            "governance_wave",
+            session_slots=np.asarray(session_slots, np.int32),
+            dids=list(dids),
+            agent_sessions=np.asarray(agent_sessions, np.int32),
+            sigma_raw=np.asarray(sigma_raw, np.float32),
+            delta_bodies=np.asarray(delta_bodies, np.uint32),
+            now=float(now),
+            omega=float(omega),
+            trustworthy=(
+                None if trustworthy is None
+                else np.asarray(trustworthy, bool)
+            ),
+            use_pallas=use_pallas,
+            actions=act,
+        ):
+            return self._governance_wave_impl(
+                session_slots, dids, agent_sessions, sigma_raw,
+                delta_bodies, now=now, omega=omega,
+                trustworthy=trustworthy, use_pallas=use_pallas, mesh=None,
+                actions=act, defer_reconcile=defer_reconcile,
+            )
+
+    def _governance_wave_impl(
+        self,
+        session_slots: np.ndarray,
+        dids: Sequence[str],
+        agent_sessions: np.ndarray,
+        sigma_raw: np.ndarray,
+        delta_bodies: np.ndarray,
+        now: float = 0.0,
+        omega: float = 0.5,
+        trustworthy: Optional[np.ndarray] = None,
+        use_pallas: bool | None = None,
+        mesh=None,
+        actions: Optional[dict] = None,
+        defer_reconcile: bool = False,
+    ):
+        """`run_governance_wave` body (see its docstring); split out so
+        the public entry can bracket it with the WAL txn."""
         b = len(dids)
         k = len(session_slots)
         b_wave, k_wave = b, k
@@ -814,21 +982,31 @@ class HypervisorState:
                 # mesh programs — the gateway sees the post-terminate
                 # table). Every mesh path, 1-D and multislice alike,
                 # fuses the gateway INTO the wave above (round 5).
+                # Direct to the local body: the public entry's chaos
+                # gate and WAL bracket must NOT re-enter here — an
+                # injected fault AFTER the wave half committed would
+                # turn a supervisor retry into a double admission, and
+                # the outer "governance_wave" record already replays
+                # this phase.
                 act = self._normalize_actions(actions)
-                gw_result = self.check_actions_wave(
+                self._check_action_slots(act["slots"])
+                gw_result = self._check_actions_wave_local(
                     act["slots"], act["required_rings"],
                     act["is_read_only"], act["has_consensus"],
                     act["has_sre_witness"], act["host_tripped"],
-                    now=now,
-                    mesh=mesh,
+                    now,
                 )
             return result, gw_result
         return result
 
     def set_session_state(self, slot: int, state: SessionState) -> None:
-        self.sessions = replace(
-            self.sessions, state=self.sessions.state.at[slot].set(state.code)
-        )
+        with self._journal(
+            "set_session_state", slot=int(slot), state=state.value
+        ):
+            self.sessions = replace(
+                self.sessions,
+                state=self.sessions.state.at[slot].set(state.code),
+            )
 
     def session_expiry_sweep(self, now: float) -> list[int]:
         """Live session slots past their max duration (vector compare).
@@ -853,13 +1031,19 @@ class HypervisorState:
         """Rewrite a session row's consistency mode (STRONG forcing when
         non-reversible actions register, `core.py` join pipeline). The
         mode column is what `strong_tick`/`eventual_tick` dispatch on."""
-        self.sessions = replace(
-            self.sessions,
-            mode=self.sessions.mode.at[slot].set(jnp.int8(mode.code)),
-            has_nonreversible=self.sessions.has_nonreversible.at[slot].set(
-                has_nonreversible
-            ),
-        )
+        with self._journal(
+            "force_session_mode",
+            slot=int(slot),
+            mode=mode.value,
+            has_nonreversible=bool(has_nonreversible),
+        ):
+            self.sessions = replace(
+                self.sessions,
+                mode=self.sessions.mode.at[slot].set(jnp.int8(mode.code)),
+                has_nonreversible=self.sessions.has_nonreversible.at[
+                    slot
+                ].set(has_nonreversible),
+            )
 
     # ── join waves ───────────────────────────────────────────────────
 
@@ -875,33 +1059,58 @@ class HypervisorState:
         Thread-safe: any number of producer threads may stage joins
         concurrently (the native queue claims slots atomically; the host
         indices mutate under a short lock) while the tick driver flushes.
+
+        Degraded mode SHEDS here (`DegradedModeRefusal`): new
+        admissions are the load the supervisor's policy refuses while
+        terminations and audit commits keep flowing.
         """
+        self._shed_gate()
+        # Journal INSIDE the staging lock: intent seqs must allocate in
+        # the same order the host indices mutate, or concurrent
+        # producers make replay assign different agent slots than the
+        # live run did (every later slot-addressed record would then
+        # replay against the wrong agent).
         with self._enqueue_lock:
-            if self._free_agent_slots:
-                agent_slot = self._free_agent_slots[-1]
-            elif self._next_agent_slot < self.agents.did.shape[0]:
-                agent_slot = self._next_agent_slot
-            else:
-                raise RuntimeError(
-                    f"agent table full ({self.agents.did.shape[0]}); "
-                    "raise config.capacity.max_agents"
+            with self._journal(
+                "enqueue_join",
+                session_slot=int(session_slot),
+                did=agent_did,
+                sigma_raw=float(sigma_raw),
+                trustworthy=bool(trustworthy),
+            ) as txn:
+                if self._free_agent_slots:
+                    agent_slot = self._free_agent_slots[-1]
+                elif self._next_agent_slot < self.agents.did.shape[0]:
+                    agent_slot = self._next_agent_slot
+                else:
+                    raise RuntimeError(
+                        f"agent table full ({self.agents.did.shape[0]}); "
+                        "raise config.capacity.max_agents"
+                    )
+                did = self.agent_ids.intern(agent_did)
+                # Duplicate against admitted members AND same-wave
+                # stagings: two concurrent joins of one (session, did)
+                # must not both admit when the wave flushes.
+                key = _mkey(session_slot, did)
+                duplicate = (
+                    key in self._members or key in self._staged_members
                 )
-            did = self.agent_ids.intern(agent_did)
-            # Duplicate against admitted members AND same-wave stagings:
-            # two concurrent joins of one (session, did) must not both
-            # admit when the wave flushes.
-            key = _mkey(session_slot, did)
-            duplicate = key in self._members or key in self._staged_members
-            q = self._queue.push(sigma_raw, agent_slot, session_slot, trustworthy)
-            if q < 0:
-                return -1
-            if self._free_agent_slots:
-                self._free_agent_slots.pop()
-            else:
-                self._next_agent_slot += 1
-            if not duplicate:
-                self._staged_members.add(key)
-            self._pending_rows[agent_slot] = (did, session_slot, duplicate)
+                q = self._queue.push(
+                    sigma_raw, agent_slot, session_slot, trustworthy
+                )
+                if q < 0:
+                    # A refused push staged nothing: the record must not
+                    # replay or recovery would admit a join the live run
+                    # never held.
+                    txn.cancel()
+                    return -1
+                if self._free_agent_slots:
+                    self._free_agent_slots.pop()
+                else:
+                    self._next_agent_slot += 1
+                if not duplicate:
+                    self._staged_members.add(key)
+                self._pending_rows[agent_slot] = (did, session_slot, duplicate)
         return q
 
     def flush_joins(self, now: float = 0.0) -> np.ndarray:
@@ -916,8 +1125,13 @@ class HypervisorState:
         read-modify-write plus the membership/free-list bookkeeping must
         not interleave with another flusher (a lost update there would
         diverge host bookkeeping from the device tables).
+
+        The fault-injection gate runs BEFORE the harvest: an injected
+        raise leaves the staging queue intact, so the supervisor's
+        retry flushes the same wave.
         """
-        with self._enqueue_lock:
+        self._chaos("admission_wave")
+        with self._enqueue_lock, self._journal("flush_joins", now=float(now)):
             n, sigma, agent_slots, session_slots, trustworthy = (
                 self._queue.harvest()
             )
@@ -980,39 +1194,53 @@ class HypervisorState:
     ) -> int:
         """Insert one liability edge; returns the edge row (rows released
         via release_vouch / free_edge_rows are recycled)."""
-        if self._free_edge_slots:
-            row = self._free_edge_slots.pop()
-        elif self._next_edge_slot < self.vouches.voucher.shape[0]:
-            row = self._next_edge_slot
-            self._next_edge_slot += 1
-        else:
-            raise RuntimeError(
-                f"vouch table full ({self.vouches.voucher.shape[0]}); "
-                "raise config.capacity.max_vouch_edges"
+        with self._journal(
+            "add_vouch",
+            voucher_slot=int(voucher_slot),
+            vouchee_slot=int(vouchee_slot),
+            session_slot=int(session_slot),
+            bond=float(bond),
+            bond_pct=float(bond_pct),
+            expiry=float(expiry),
+        ):
+            if self._free_edge_slots:
+                row = self._free_edge_slots.pop()
+            elif self._next_edge_slot < self.vouches.voucher.shape[0]:
+                row = self._next_edge_slot
+                self._next_edge_slot += 1
+            else:
+                raise RuntimeError(
+                    f"vouch table full ({self.vouches.voucher.shape[0]}); "
+                    "raise config.capacity.max_vouch_edges"
+                )
+            self.vouches = replace(
+                self.vouches,
+                voucher=self.vouches.voucher.at[row].set(voucher_slot),
+                vouchee=self.vouches.vouchee.at[row].set(vouchee_slot),
+                session=self.vouches.session.at[row].set(session_slot),
+                bond=self.vouches.bond.at[row].set(bond),
+                bond_pct=self.vouches.bond_pct.at[row].set(bond_pct),
+                active=self.vouches.active.at[row].set(True),
+                expiry=self.vouches.expiry.at[row].set(expiry),
             )
-        self.vouches = replace(
-            self.vouches,
-            voucher=self.vouches.voucher.at[row].set(voucher_slot),
-            vouchee=self.vouches.vouchee.at[row].set(vouchee_slot),
-            session=self.vouches.session.at[row].set(session_slot),
-            bond=self.vouches.bond.at[row].set(bond),
-            bond_pct=self.vouches.bond_pct.at[row].set(bond_pct),
-            active=self.vouches.active.at[row].set(True),
-            expiry=self.vouches.expiry.at[row].set(expiry),
-        )
         return row
 
     def release_vouch(self, edge_row: int) -> None:
         """Deactivate one liability edge and recycle its row."""
-        self.vouches = replace(
-            self.vouches, active=self.vouches.active.at[edge_row].set(False)
-        )
-        self._free_edge_slots.append(edge_row)
+        with self._journal("release_vouch", edge_row=int(edge_row)):
+            self.vouches = replace(
+                self.vouches,
+                active=self.vouches.active.at[edge_row].set(False),
+            )
+            self._free_edge_slots.append(edge_row)
 
     def free_edge_rows(self, edge_rows) -> None:
         """Recycle rows a device wave already deactivated (host-only
-        bookkeeping — no device write)."""
-        self._free_edge_slots.extend(int(r) for r in edge_rows)
+        bookkeeping — no device write; journaled so replay recycles the
+        same rows in the same order)."""
+        rows = [int(r) for r in edge_rows]
+        with self._journal("free_edge_rows", rows=rows):
+            self._free_edge_slots.extend(rows)
 
     def pop_scrubbed_edges(self) -> list[int]:
         """Drain the edge rows the GC scrubbed for lost endpoints."""
@@ -1033,7 +1261,9 @@ class HypervisorState:
         # The whole mutation holds the staging lock, matching flush_joins:
         # an interleaved table read-modify-write from a concurrent flusher
         # would lose the deactivation while the slot is already freed.
-        with self._enqueue_lock:
+        with self._enqueue_lock, self._journal(
+            "leave_agent", session_slot=int(session_slot), did=agent_did
+        ):
             row = self.agent_row(agent_did, session_slot)
             if row is None:
                 raise ValueError(
@@ -1114,6 +1344,25 @@ class HypervisorState:
         in the VouchTable, and recomputes rings from the post-slash
         sigma. Returns {"slashed": [...], "clipped": [...]} agent slots.
         """
+        self._chaos("slash_cascade")
+        with self._journal(
+            "apply_slash",
+            session_slot=int(session_slot),
+            vouchee_slot=int(vouchee_slot),
+            risk_weight=float(risk_weight),
+            now=float(now),
+        ):
+            return self._apply_slash_impl(
+                session_slot, vouchee_slot, risk_weight, now
+            )
+
+    def _apply_slash_impl(
+        self,
+        session_slot: int,
+        vouchee_slot: int,
+        risk_weight: float,
+        now: float,
+    ) -> dict:
         from hypervisor_tpu.ops import rings as ring_ops
         from hypervisor_tpu.tables.state import FLAG_BLACKLISTED
 
@@ -1170,22 +1419,27 @@ class HypervisorState:
         from hypervisor_tpu.ops import rings as ring_ops
         from hypervisor_tpu.tables.state import FLAG_BLACKLISTED
 
-        idx = jnp.asarray(np.asarray(rows, np.int32))
-        sigma = self.agents.sigma_eff.at[idx].set(0.0)
-        rings = ring_ops.compute_rings(sigma, False)
-        touched = jnp.zeros(
-            (self.agents.did.shape[0],), bool
-        ).at[idx].set(True)
-        self.agents = replace(
-            self.agents,
-            sigma_eff=sigma,
-            ring=jnp.where(touched, rings, self.agents.ring).astype(jnp.int8),
-            flags=jnp.where(
-                touched,
-                self.agents.flags | FLAG_BLACKLISTED,
-                self.agents.flags,
-            ).astype(self.agents.flags.dtype),
-        )
+        with self._journal(
+            "blacklist_rows", rows=[int(r) for r in rows]
+        ):
+            idx = jnp.asarray(np.asarray(rows, np.int32))
+            sigma = self.agents.sigma_eff.at[idx].set(0.0)
+            rings = ring_ops.compute_rings(sigma, False)
+            touched = jnp.zeros(
+                (self.agents.did.shape[0],), bool
+            ).at[idx].set(True)
+            self.agents = replace(
+                self.agents,
+                sigma_eff=sigma,
+                ring=jnp.where(
+                    touched, rings, self.agents.ring
+                ).astype(jnp.int8),
+                flags=jnp.where(
+                    touched,
+                    self.agents.flags | FLAG_BLACKLISTED,
+                    self.agents.flags,
+                ).astype(self.agents.flags.dtype),
+            )
 
     # ── sagas ────────────────────────────────────────────────────────
 
@@ -1208,30 +1462,49 @@ class HypervisorState:
                 f"saga table full ({self.sagas.saga_state.shape[0]}); "
                 "raise config.capacity.max_sagas"
             )
-        slot = self._next_saga_slot
-        self._next_saga_slot += 1
-        self.saga_ids.intern(saga_id)
-        n = len(steps)
-        retries = np.zeros(max_steps, np.int8)
-        has_undo = np.zeros(max_steps, bool)
-        timeout = np.full(max_steps, 300.0, np.float32)
-        for i, st in enumerate(steps):
-            retries[i] = st.get("retries", 0)
-            has_undo[i] = st.get("has_undo", False)
-            timeout[i] = st.get("timeout", 300.0)
-        self.sagas = replace(
-            self.sagas,
-            step_state=self.sagas.step_state.at[slot].set(
-                jnp.zeros(max_steps, jnp.int8)
-            ),
-            retries_left=self.sagas.retries_left.at[slot].set(jnp.asarray(retries)),
-            has_undo=self.sagas.has_undo.at[slot].set(jnp.asarray(has_undo)),
-            timeout=self.sagas.timeout.at[slot].set(jnp.asarray(timeout)),
-            saga_state=self.sagas.saga_state.at[slot].set(saga_ops.SAGA_RUNNING),
-            session=self.sagas.session.at[slot].set(session_slot),
-            n_steps=self.sagas.n_steps.at[slot].set(n),
-            cursor=self.sagas.cursor.at[slot].set(0),
-        )
+        with self._journal(
+            "create_saga",
+            saga_id=saga_id,
+            session_slot=int(session_slot),
+            steps=[
+                {
+                    "retries": int(st.get("retries", 0)),
+                    "has_undo": bool(st.get("has_undo", False)),
+                    "timeout": float(st.get("timeout", 300.0)),
+                }
+                for st in steps
+            ],
+        ):
+            slot = self._next_saga_slot
+            self._next_saga_slot += 1
+            self.saga_ids.intern(saga_id)
+            n = len(steps)
+            retries = np.zeros(max_steps, np.int8)
+            has_undo = np.zeros(max_steps, bool)
+            timeout = np.full(max_steps, 300.0, np.float32)
+            for i, st in enumerate(steps):
+                retries[i] = st.get("retries", 0)
+                has_undo[i] = st.get("has_undo", False)
+                timeout[i] = st.get("timeout", 300.0)
+            self.sagas = replace(
+                self.sagas,
+                step_state=self.sagas.step_state.at[slot].set(
+                    jnp.zeros(max_steps, jnp.int8)
+                ),
+                retries_left=self.sagas.retries_left.at[slot].set(
+                    jnp.asarray(retries)
+                ),
+                has_undo=self.sagas.has_undo.at[slot].set(
+                    jnp.asarray(has_undo)
+                ),
+                timeout=self.sagas.timeout.at[slot].set(jnp.asarray(timeout)),
+                saga_state=self.sagas.saga_state.at[slot].set(
+                    saga_ops.SAGA_RUNNING
+                ),
+                session=self.sagas.session.at[slot].set(session_slot),
+                n_steps=self.sagas.n_steps.at[slot].set(n),
+                cursor=self.sagas.cursor.at[slot].set(0),
+            )
         return slot
 
     def create_saga_from_dsl(self, definition, session_slot: int) -> int:
@@ -1275,7 +1548,16 @@ class HypervisorState:
                     "constraint)."
                 )
         if groups:
-            self._fanout_groups[slot] = sorted(groups, key=lambda g: g[1][0])
+            ordered = sorted(groups, key=lambda g: g[1][0])
+            # Journaled as its own op: `create_saga` above replays the
+            # table row, but the fan-out group index is host-only state
+            # replay must rebuild too.
+            with self._journal(
+                "register_fanout_groups",
+                slot=int(slot),
+                groups=[[policy, list(idxs)] for policy, idxs in ordered],
+            ):
+                self._fanout_groups[slot] = ordered
         return slot
 
     # ── fan-out groups (device-scheduled) ────────────────────────────
@@ -1309,7 +1591,15 @@ class HypervisorState:
 
     def fanout_dispatch(self) -> list[tuple[int, int]]:
         """(saga_slot, step_idx) pairs for every group front: the whole
-        group's PENDING branches dispatch concurrently."""
+        group's PENDING branches dispatch concurrently.
+
+        Degraded mode PAUSES fan-out (empty work list): branches stay
+        PENDING and dispatch when the supervisor exits the mode —
+        in-flight cursor steps and compensations keep settling through
+        `saga_round` meanwhile."""
+        policy = self.degraded_policy
+        if policy is not None and policy.pause_saga_fanout:
+            return []
         if not self._fanout_groups:
             return []
         out = []
@@ -1332,6 +1622,18 @@ class HypervisorState:
         """Book a round of fan-out branch outcomes in one jitted program."""
         if not outcomes:
             return
+        with self._journal(
+            "fanout_settle",
+            outcomes=[
+                [int(s), int(i), bool(ok)]
+                for (s, i), ok in outcomes.items()
+            ],
+        ):
+            self._fanout_settle_impl(outcomes)
+
+    def _fanout_settle_impl(
+        self, outcomes: dict[tuple[int, int], bool]
+    ) -> None:
         g_cap, m = self.sagas.step_state.shape
         group = np.zeros((g_cap, m), bool)
         active = np.zeros(g_cap, bool)
@@ -1410,6 +1712,19 @@ class HypervisorState:
         (e.g. fan-out group fronts settled by `fanout_settle` in the
         same round) are left untouched by the tick.
         """
+        self._chaos("saga_round")
+        with self._journal(
+            "saga_round",
+            exec={int(k): bool(v) for k, v in (exec_outcomes or {}).items()},
+            undo={int(k): bool(v) for k, v in (undo_outcomes or {}).items()},
+        ):
+            self._saga_round_impl(exec_outcomes, undo_outcomes)
+
+    def _saga_round_impl(
+        self,
+        exec_outcomes: Optional[dict[int, bool]] = None,
+        undo_outcomes: Optional[dict[int, bool]] = None,
+    ) -> None:
         g_cap = self.sagas.saga_state.shape[0]
         exec_success = np.zeros(g_cap, bool)
         undo_success = np.zeros(g_cap, bool)
@@ -1468,13 +1783,20 @@ class HypervisorState:
         now: Optional[float] = None,
     ) -> None:
         """Record one action wave into the breach sliding window."""
-        self.agents = _RECORD_CALLS(
-            self.agents,
-            jnp.asarray(np.asarray(agent_slots, np.int32)),
-            jnp.asarray(np.asarray(called_rings, np.int8)),
-            self.now() if now is None else now,
-            config=self.config.breach,
-        )
+        now = self.now() if now is None else now
+        with self._journal(
+            "record_calls",
+            agent_slots=np.asarray(agent_slots, np.int32),
+            called_rings=np.asarray(called_rings, np.int8),
+            now=float(now),
+        ):
+            self.agents = _RECORD_CALLS(
+                self.agents,
+                jnp.asarray(np.asarray(agent_slots, np.int32)),
+                jnp.asarray(np.asarray(called_rings, np.int8)),
+                now,
+                config=self.config.breach,
+            )
 
     def consume_rate(
         self,
@@ -1493,6 +1815,20 @@ class HypervisorState:
         overrides the rows' base rings (e.g. a live sudo grant rates the
         call at the ELEVATED ring's budget).
         """
+        with self._journal(
+            "consume_rate",
+            slots=np.asarray(slots, np.int32),
+            now=float(now),
+            rings=None if rings is None else np.asarray(rings, np.int8),
+        ):
+            return self._consume_rate_impl(slots, now, rings)
+
+    def _consume_rate_impl(
+        self,
+        slots: Sequence[int],
+        now: float,
+        rings: Optional[Sequence[int]] = None,
+    ) -> np.ndarray:
         slots_arr = np.asarray(slots, np.int32)
         cfg = self.config.rate_limit
         ring_vec = self.agents.ring
@@ -1584,12 +1920,32 @@ class HypervisorState:
         group to one power-of-two block length with `valid=False`
         lanes, and scatter the lanes back to request order.
         """
+        self._chaos("gateway_wave")
         self._check_action_slots(slots)
         if mesh is not None:
             return self._check_actions_wave_sharded(
                 slots, required_rings, is_read_only, has_consensus,
                 has_sre_witness, host_tripped, now, mesh,
             )
+        with self._journal(
+            "gateway_wave",
+            slots=np.asarray(slots, np.int32),
+            required_rings=np.asarray(required_rings, np.int8),
+            is_read_only=np.asarray(is_read_only, bool),
+            has_consensus=np.asarray(has_consensus, bool),
+            has_sre_witness=np.asarray(has_sre_witness, bool),
+            host_tripped=np.asarray(host_tripped, bool),
+            now=float(now),
+        ):
+            return self._check_actions_wave_local(
+                slots, required_rings, is_read_only, has_consensus,
+                has_sre_witness, host_tripped, now,
+            )
+
+    def _check_actions_wave_local(
+        self, slots, required_rings, is_read_only, has_consensus,
+        has_sre_witness, host_tripped, now,
+    ) -> gateway_ops.GatewayResult:
         b = len(np.asarray(slots, np.int32))
         padded = max(1, 1 << max(0, (b - 1).bit_length()))
 
@@ -1844,9 +2200,12 @@ class HypervisorState:
 
     def breach_sweep_tick(self, now: float) -> tuple[np.ndarray, np.ndarray]:
         """Run the batched breach analysis; returns (severity, tripped)."""
-        with self.metrics.stage("breach_sweep"):
-            result = _BREACH_SWEEP(self.agents, now, config=self.config.breach)
-        self.agents = result.agents
+        with self._journal("breach_sweep_tick", now=float(now)):
+            with self.metrics.stage("breach_sweep"):
+                result = _BREACH_SWEEP(
+                    self.agents, now, config=self.config.breach
+                )
+            self.agents = result.agents
         return np.asarray(result.severity), np.asarray(result.tripped)
 
     def grant_elevation(
@@ -1875,20 +2234,29 @@ class HypervisorState:
             ttl_seconds if ttl_seconds is not None else cfg.default_ttl_seconds,
             cfg.max_ttl_seconds,
         )
-        if self._free_elev_slots:
-            row = self._free_elev_slots.pop()
-        elif self._next_elev_slot < self.elevations.agent.shape[0]:
-            row = self._next_elev_slot
-            self._next_elev_slot += 1
-        else:
-            raise RuntimeError("elevation table full")
-        self.elevations = replace(
-            self.elevations,
-            agent=self.elevations.agent.at[row].set(agent_slot),
-            granted_ring=self.elevations.granted_ring.at[row].set(granted_ring),
-            expires_at=self.elevations.expires_at.at[row].set(now + ttl),
-            active=self.elevations.active.at[row].set(True),
-        )
+        with self._journal(
+            "grant_elevation",
+            agent_slot=int(agent_slot),
+            granted_ring=int(granted_ring),
+            now=float(now),
+            ttl_seconds=None if ttl_seconds is None else float(ttl_seconds),
+        ):
+            if self._free_elev_slots:
+                row = self._free_elev_slots.pop()
+            elif self._next_elev_slot < self.elevations.agent.shape[0]:
+                row = self._next_elev_slot
+                self._next_elev_slot += 1
+            else:
+                raise RuntimeError("elevation table full")
+            self.elevations = replace(
+                self.elevations,
+                agent=self.elevations.agent.at[row].set(agent_slot),
+                granted_ring=self.elevations.granted_ring.at[row].set(
+                    granted_ring
+                ),
+                expires_at=self.elevations.expires_at.at[row].set(now + ttl),
+                active=self.elevations.active.at[row].set(True),
+            )
         return row
 
     def revoke_elevation(
@@ -1910,12 +2278,19 @@ class HypervisorState:
             )
         if not bool(np.asarray(self.elevations.active)[row]):
             return  # already expired/revoked: idempotent like the host tick
-        self.elevations = replace(
-            self.elevations,
-            active=self.elevations.active.at[row].set(False),
-            agent=self.elevations.agent.at[row].set(-1),
-        )
-        self._free_elev_slots.append(int(row))
+        with self._journal(
+            "revoke_elevation",
+            row=int(row),
+            expected_agent=(
+                None if expected_agent is None else int(expected_agent)
+            ),
+        ):
+            self.elevations = replace(
+                self.elevations,
+                active=self.elevations.active.at[row].set(False),
+                agent=self.elevations.agent.at[row].set(-1),
+            )
+            self._free_elev_slots.append(int(row))
 
     def elevation_tick(self, now: float) -> int:
         """Expire every lapsed grant; returns how many expired.
@@ -1923,14 +2298,15 @@ class HypervisorState:
         Expired rows are freed (agent = -1) and reclaimed by later
         grants, so the table never fills with dead grants.
         """
-        self.elevations, expired = _ELEV_EXPIRY(self.elevations, now)
-        rows = np.nonzero(np.asarray(expired))[0]
-        if len(rows):
-            self.elevations = replace(
-                self.elevations,
-                agent=self.elevations.agent.at[jnp.asarray(rows)].set(-1),
-            )
-            self._free_elev_slots.extend(int(r) for r in rows)
+        with self._journal("elevation_tick", now=float(now)):
+            self.elevations, expired = _ELEV_EXPIRY(self.elevations, now)
+            rows = np.nonzero(np.asarray(expired))[0]
+            if len(rows):
+                self.elevations = replace(
+                    self.elevations,
+                    agent=self.elevations.agent.at[jnp.asarray(rows)].set(-1),
+                )
+                self._free_elev_slots.extend(int(r) for r in rows)
         return len(rows)
 
     def effective_rings(self, now: float) -> np.ndarray:
@@ -1952,15 +2328,22 @@ class HypervisorState:
         """
         if duration is None:
             duration = self.config.quarantine.default_duration_seconds
-        enter = jnp.zeros((self.agents.did.shape[0],), bool).at[
-            jnp.asarray(np.asarray(rows, np.int32))
-        ].set(True)
-        self.agents = _QUAR_ENTER(self.agents, enter, now, float(duration))
+        with self._journal(
+            "quarantine_rows",
+            rows=[int(r) for r in np.asarray(rows, np.int32)],
+            now=float(now),
+            duration=float(duration),
+        ):
+            enter = jnp.zeros((self.agents.did.shape[0],), bool).at[
+                jnp.asarray(np.asarray(rows, np.int32))
+            ].set(True)
+            self.agents = _QUAR_ENTER(self.agents, enter, now, float(duration))
 
     def quarantine_tick(self, now: float) -> list[int]:
         """Auto-release lapsed quarantines; returns released rows."""
-        sweep = _QUAR_SWEEP(self.agents, now)
-        self.agents = sweep.agents
+        with self._journal("quarantine_tick", now=float(now)):
+            sweep = _QUAR_SWEEP(self.agents, now)
+            self.agents = sweep.agents
         return [int(r) for r in np.nonzero(np.asarray(sweep.released))[0]]
 
     def isolation_refusal(
@@ -2007,10 +2390,13 @@ class HypervisorState:
     def set_agent_risk(self, slot: int, risk: float) -> None:
         """Write a membership row's liability-ledger risk score (the
         facade stamps it at join; admission resets the column to 0)."""
-        self.agents = replace(
-            self.agents,
-            risk_score=self.agents.risk_score.at[slot].set(float(risk)),
-        )
+        with self._journal(
+            "set_agent_risk", slot=int(slot), risk=float(risk)
+        ):
+            self.agents = replace(
+                self.agents,
+                risk_score=self.agents.risk_score.at[slot].set(float(risk)),
+            )
 
     def set_agent_ring(self, slot: int, ring: int, now: float) -> None:
         """Reassign a device row's ring (demotion/promotion).
@@ -2021,7 +2407,9 @@ class HypervisorState:
         with the smaller ring's budget rather than its old surplus.
         """
         burst = float(self.config.rate_limit.ring_bursts[int(ring)])
-        with self._enqueue_lock:
+        with self._enqueue_lock, self._journal(
+            "set_agent_ring", slot=int(slot), ring=int(ring), now=float(now)
+        ):
             self.agents = replace(
                 self.agents,
                 ring=self.agents.ring.at[slot].set(jnp.int8(ring)),
@@ -2047,21 +2435,37 @@ class HypervisorState:
         the host DeltaEngine's canonical-JSON hash so device and host
         Merkle trees share leaves bit-for-bit).
         """
-        turn = self._turns.get(session_slot, 0)
-        self._turns[session_slot] = turn + 1
-        change = np.zeros(8, np.uint32)
-        if change_words is not None:
-            w = np.asarray(change_words, np.uint32).ravel()[:8]
-            change[: len(w)] = w
-        self._pending_deltas.append(
-            (
-                session_slot,
-                agent_slot,
-                change,
-                float(ts),
-                None if digest_words is None else np.asarray(digest_words, np.uint32),
+        with self._journal(
+            "stage_delta",
+            session_slot=int(session_slot),
+            agent_slot=int(agent_slot),
+            ts=float(ts),
+            change_words=(
+                None if change_words is None
+                else np.asarray(change_words, np.uint32)
+            ),
+            digest_words=(
+                None if digest_words is None
+                else np.asarray(digest_words, np.uint32)
+            ),
+        ):
+            turn = self._turns.get(session_slot, 0)
+            self._turns[session_slot] = turn + 1
+            change = np.zeros(8, np.uint32)
+            if change_words is not None:
+                w = np.asarray(change_words, np.uint32).ravel()[:8]
+                change[: len(w)] = w
+            self._pending_deltas.append(
+                (
+                    session_slot,
+                    agent_slot,
+                    change,
+                    float(ts),
+                    None
+                    if digest_words is None
+                    else np.asarray(digest_words, np.uint32),
+                )
             )
-        )
         return turn
 
     def flush_deltas(self, use_pallas: bool | None = None) -> int:
@@ -2076,6 +2480,11 @@ class HypervisorState:
         staged = self._pending_deltas
         if not staged:
             return 0
+        with self._journal("flush_deltas", use_pallas=use_pallas):
+            return self._flush_deltas_impl(use_pallas)
+
+    def _flush_deltas_impl(self, use_pallas: bool | None = None) -> int:
+        staged = self._pending_deltas
         self._pending_deltas = []
 
         b = len(staged)
@@ -2223,11 +2632,31 @@ class HypervisorState:
         GC) so a long-running state never exhausts the agent table; the
         rows' final values stay readable until reused (forensics), and
         the audit index keeps the sessions' Merkle leaves.
+
+        Terminations are NEVER shed: a degraded plane keeps draining
+        live work (`resilience.policy`). The fault-injection gate runs
+        before any mutation; the wave journals as "terminate_sessions".
         """
         slots = list(session_slots)
         k = len(slots)
         if k == 0:
             return np.zeros((0, 8), np.uint32)
+        self._chaos("terminate_wave")
+        with self._journal(
+            "terminate_sessions",
+            session_slots=[int(s) for s in slots],
+            now=float(now),
+            use_pallas=use_pallas,
+        ):
+            return self._terminate_sessions_impl(slots, now, use_pallas)
+
+    def _terminate_sessions_impl(
+        self,
+        slots: list,
+        now: float,
+        use_pallas: bool | None,
+    ) -> np.ndarray:
+        k = len(slots)
         # Participants to reclaim, captured before the wave deactivates.
         # The active-flag guard prevents double-freeing rows that were
         # already reclaimed (their session column keeps its last value).
@@ -2327,6 +2756,13 @@ class HypervisorState:
         deleted buffer — like every table read under donation, scrapes
         must then be serialized with the wave driver.)
         """
+        # Fault-injection drain gate: a corrupt drain is device loss
+        # from the host's point of view (`testing.chaos`) — raising
+        # HERE, before the device_get, exercises the checkpoint+WAL
+        # restore path without ever handing garbage to the mirrors.
+        inj = self.fault_injector
+        if inj is not None:
+            inj.on_drain("metrics_drain")
         # Health-plane publishes ride the same drain: compile totals
         # (process-global watch -> absolute host counters), static
         # bytes/capacity gauges (pure array metadata), then — after the
@@ -2421,6 +2857,28 @@ class HypervisorState:
     def compile_summary(self) -> dict:
         """The `GET /debug/compiles` payload (process-global watch)."""
         return health_plane.compile_summary()
+
+    def resilience_summary(self) -> dict:
+        """The `GET /debug/resilience` payload: supervisor mode +
+        dispatch/retry accounting when a `resilience.Supervisor` is
+        attached; otherwise the bare plane state (journal status and
+        any manually-set degraded policy)."""
+        if self.resilience is not None:
+            return self.resilience.summary()
+        return {
+            "enabled": False,
+            "mode": "degraded" if self.degraded_policy is not None else "normal",
+            "degraded": {
+                "active_policy": (
+                    self.degraded_policy.to_dict()
+                    if self.degraded_policy is not None
+                    else None
+                ),
+            },
+            "journal": (
+                self.journal.status() if self.journal is not None else None
+            ),
+        }
 
     # ── trace drain ──────────────────────────────────────────────────
 

@@ -1,5 +1,21 @@
 """Test utilities shipped with the framework (chaos injection)."""
 
-from hypervisor_tpu.testing.chaos import ChaosExecutorFactory, ChaosPlan
+from hypervisor_tpu.testing.chaos import (
+    ChaosExecutorFactory,
+    ChaosFailure,
+    ChaosPlan,
+    InjectedDeviceLoss,
+    InjectedWaveFault,
+    WaveChaosInjector,
+    WaveChaosPlan,
+)
 
-__all__ = ["ChaosExecutorFactory", "ChaosPlan"]
+__all__ = [
+    "ChaosExecutorFactory",
+    "ChaosFailure",
+    "ChaosPlan",
+    "InjectedDeviceLoss",
+    "InjectedWaveFault",
+    "WaveChaosInjector",
+    "WaveChaosPlan",
+]

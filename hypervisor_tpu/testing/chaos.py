@@ -1,35 +1,59 @@
-"""Chaos injection for saga executors: seeded, reproducible fault plans.
+"""Chaos injection: seeded, reproducible fault plans for BOTH layers.
 
 The reference's fault injection is ad-hoc per test (flaky lambdas,
 injected drift scores — SURVEY §5 "no chaos framework"). This module is
-the framework-level version: a deterministic fault plan derived from a
-seed, wrapping any executor with configurable failure, timeout-hang, and
-latency behavior. Because the plan is seeded, a chaos run that surfaces
-a bug replays exactly.
+the framework-level version, covering:
+
+  * **Saga executors** (`ChaosExecutorFactory`) — wraps any async
+    executor with configurable failure, timeout-hang, and latency
+    behavior drawn from one seeded stream.
+  * **The wave layer** (`WaveChaosInjector`) — a dispatch interposer
+    `hypervisor_tpu.state` consults at every wave dispatch and drain
+    site (`HypervisorState.fault_injector`). It can raise a transient
+    `InjectedWaveFault` (the supervisor's retry ladder exercises),
+    stall the dispatch (`hang_seconds` of host sleep — the watchdog's
+    straggler path exercises), or raise `InjectedDeviceLoss` on a
+    drain (simulated preemption/device loss — the checkpoint+WAL
+    restore path exercises).
+
+Because every plan is seeded, a chaos run that surfaces a bug replays
+exactly. Faults are injected per CALL (retries roll fresh outcomes), so
+retry ladders and compensation paths genuinely exercise.
 
 Usage::
 
     chaos = ChaosExecutorFactory(ChaosPlan(seed=7, fail_rate=0.3))
     sched.register(slot, idx, chaos.wrap(real_executor, key="step-3"))
     ...
-    chaos.report()   # {'calls': N, 'failures': k, 'hangs': h}
+    chaos.report()        # {'calls': N, 'failures': k, 'hangs': h}
+    chaos.cancel_hangs()  # teardown: no pending tasks leak past the loop
 
-Faults are injected per CALL (retries roll fresh outcomes), so retry
-ladders and compensation paths genuinely exercise.
+    state.fault_injector = WaveChaosInjector(WaveChaosPlan(seed=7,
+                                                           fail_rate=0.2))
 """
 
 from __future__ import annotations
 
 import asyncio
 import random
+import time
 from dataclasses import dataclass, field
-from typing import Any, Awaitable, Callable
+from typing import Any, Awaitable, Callable, Optional
 
 Executor = Callable[[], Awaitable[Any]]
 
 
 class ChaosFailure(RuntimeError):
     """Injected executor failure."""
+
+
+class InjectedWaveFault(RuntimeError):
+    """Injected transient wave-dispatch failure (retryable)."""
+
+
+class InjectedDeviceLoss(RuntimeError):
+    """Injected device loss / preemption: NOT retryable — the recovery
+    path (checkpoint restore + WAL replay) is the only way forward."""
 
 
 @dataclass(frozen=True)
@@ -52,12 +76,19 @@ class ChaosStats:
 
 
 class ChaosExecutorFactory:
-    """Wraps executors with a shared, seeded fault stream."""
+    """Wraps executors with a shared, seeded fault stream.
+
+    Hang injection is CANCELLABLE: every hanging call registers its
+    task so `cancel_hangs()` (teardown) cancels whatever is still
+    sleeping — chaos tests must not leak pending asyncio tasks past the
+    event loop they ran in.
+    """
 
     def __init__(self, plan: ChaosPlan) -> None:
         self.plan = plan
         self._rng = random.Random(plan.seed)
         self.stats = ChaosStats()
+        self._hanging: set[asyncio.Task] = set()
 
     def wrap(self, executor: Executor, key: str = "?") -> Executor:
         async def chaotic() -> Any:
@@ -74,12 +105,36 @@ class ChaosExecutorFactory:
             if roll < self.plan.fail_rate + self.plan.hang_rate:
                 self.stats.hangs += 1
                 per["hangs"] += 1
-                await asyncio.sleep(self.plan.hang_seconds)
+                task = asyncio.current_task()
+                if task is not None:
+                    self._hanging.add(task)
+                try:
+                    await asyncio.sleep(self.plan.hang_seconds)
+                finally:
+                    if task is not None:
+                        self._hanging.discard(task)
             if self.plan.latency_seconds:
                 await asyncio.sleep(self.plan.latency_seconds)
             return await executor()
 
         return chaotic
+
+    @property
+    def hanging_tasks(self) -> int:
+        """Tasks currently parked in an injected hang."""
+        return len(self._hanging)
+
+    def cancel_hangs(self) -> int:
+        """Cancel every task still parked in an injected hang; returns
+        how many were cancelled. Call on teardown (must run inside the
+        event loop that owns the tasks)."""
+        cancelled = 0
+        for task in list(self._hanging):
+            if not task.done():
+                task.cancel()
+                cancelled += 1
+        self._hanging.clear()
+        return cancelled
 
     def report(self) -> dict:
         return {
@@ -87,4 +142,104 @@ class ChaosExecutorFactory:
             "failures": self.stats.failures,
             "hangs": self.stats.hangs,
             "by_key": dict(self.stats.by_key),
+        }
+
+
+# ── wave-layer fault injection ───────────────────────────────────────
+
+
+@dataclass(frozen=True)
+class WaveChaosPlan:
+    """Dispatch-interposer fault mix; rates are per-dispatch
+    probabilities in [0, 1], drawn from one seeded stream in dispatch
+    order (same workload + same seed -> same fault schedule).
+
+    `stages` narrows injection to named dispatch sites (the stage
+    vocabulary of `observability.metrics.STAGES` plus
+    `"metrics_drain"`); None hits every site. `corrupt_rate` fires only
+    on drain sites — a corrupt drain IS device loss from the host's
+    point of view, so it raises `InjectedDeviceLoss`.
+    """
+
+    seed: int = 0
+    fail_rate: float = 0.0
+    hang_rate: float = 0.0
+    corrupt_rate: float = 0.0
+    hang_seconds: float = 0.05    # host stall simulating a wedged wave
+    stages: Optional[tuple[str, ...]] = None
+
+
+class WaveChaosInjector:
+    """The dispatch interposer `HypervisorState.fault_injector` holds.
+
+    `on_dispatch(stage)` runs before a wave mutates anything — an
+    injected raise leaves the tables untouched, so the supervisor's
+    retry re-dispatches cleanly and the WAL bracket records an abort
+    (or nothing), never a phantom commit.
+    """
+
+    def __init__(self, plan: WaveChaosPlan, sleep=time.sleep) -> None:
+        self.plan = plan
+        self._rng = random.Random(plan.seed)
+        self._sleep = sleep
+        self.dispatches = 0
+        self.faults = 0
+        self.hangs = 0
+        self.losses = 0
+        self.by_stage: dict[str, dict] = {}
+
+    def _armed(self, stage: str) -> bool:
+        return self.plan.stages is None or stage in self.plan.stages
+
+    def _per(self, stage: str) -> dict:
+        return self.by_stage.setdefault(
+            stage, {"dispatches": 0, "faults": 0, "hangs": 0, "losses": 0}
+        )
+
+    def on_dispatch(self, stage: str) -> None:
+        """Consult the plan before one wave dispatch; may raise
+        `InjectedWaveFault`, stall, or pass through."""
+        if not self._armed(stage):
+            return
+        self.dispatches += 1
+        per = self._per(stage)
+        per["dispatches"] += 1
+        roll = self._rng.random()
+        if roll < self.plan.fail_rate:
+            self.faults += 1
+            per["faults"] += 1
+            raise InjectedWaveFault(
+                f"injected {stage} dispatch fault #{self.faults} "
+                f"(seed {self.plan.seed})"
+            )
+        if roll < self.plan.fail_rate + self.plan.hang_rate:
+            self.hangs += 1
+            per["hangs"] += 1
+            self._sleep(self.plan.hang_seconds)
+
+    def on_drain(self, stage: str = "metrics_drain") -> None:
+        """Consult the plan before a host drain (`device_get` site); a
+        corrupt drain surfaces as device loss."""
+        if not self._armed(stage):
+            return
+        self.dispatches += 1
+        per = self._per(stage)
+        per["dispatches"] += 1
+        roll = self._rng.random()
+        if roll < self.plan.corrupt_rate:
+            self.losses += 1
+            per["losses"] += 1
+            raise InjectedDeviceLoss(
+                f"injected corrupt {stage} (simulated preemption, seed "
+                f"{self.plan.seed})"
+            )
+
+    def report(self) -> dict:
+        return {
+            "seed": self.plan.seed,
+            "dispatches": self.dispatches,
+            "faults": self.faults,
+            "hangs": self.hangs,
+            "losses": self.losses,
+            "by_stage": dict(self.by_stage),
         }

@@ -16,7 +16,12 @@
 #      are nonzero after one wave; two IDENTICAL dispatches report
 #      exactly zero recompiles while a batch-shape change reports
 #      exactly one and names the changed argument,
-#   5. the perf-regression gate — benchmarks/regression.py rebuilds
+#   5. a crash-recovery smoke gate — drive real traffic in a child
+#      process with a WAL + watermarked checkpoint, SIGKILL it
+#      mid-flight, recover from checkpoint + WAL replay, and assert
+#      the Merkle chain heads and /metrics session counts match the
+#      pre-kill host mirror (scripts/crash_recovery_smoke.py),
+#   6. the perf-regression gate — benchmarks/regression.py rebuilds
 #      BENCH_trajectory.json from the committed BENCH_r*.json history
 #      and fails on any per-bench p50 above its comparable baseline's
 #      tolerance band (cpu tolerance is wide on purpose: non-flaky).
@@ -186,6 +191,10 @@ print(
 PY
 health_rc=$?
 
+echo "── crash-recovery smoke gate ──"
+JAX_PLATFORMS=cpu python scripts/crash_recovery_smoke.py
+crash_rc=$?
+
 echo "── perf-regression gate ──"
 JAX_PLATFORMS=cpu python benchmarks/regression.py
 regression_rc=$?
@@ -205,6 +214,10 @@ fi
 if [ "$health_rc" -ne 0 ]; then
     echo "health smoke check FAILED (rc=$health_rc)" >&2
     exit "$health_rc"
+fi
+if [ "$crash_rc" -ne 0 ]; then
+    echo "crash-recovery smoke gate FAILED (rc=$crash_rc)" >&2
+    exit "$crash_rc"
 fi
 if [ "$regression_rc" -ne 0 ]; then
     echo "perf-regression gate FAILED (rc=$regression_rc)" >&2

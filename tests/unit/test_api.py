@@ -184,9 +184,11 @@ class TestHTTPTransport:
         # leave/sweep pair, the per-action gateway, its wave
         # sibling (/actions/check-wave), the Prometheus scrape
         # (/metrics), the flight recorder (/trace/{session_id} +
-        # /debug/flight), and the health plane (/debug/health,
-        # /debug/memory, /debug/compiles): 36 routes.
-        assert len(ROUTES) == 36
+        # /debug/flight), the health plane (/debug/health,
+        # /debug/memory, /debug/compiles), and the resilience plane
+        # (/debug/resilience): 37 routes.
+        assert len(ROUTES) == 37
+        assert any(path == "/debug/resilience" for _, path, _, _ in ROUTES)
         assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
         assert any(
             path == "/api/v1/security/quarantines" for _, path, _, _ in ROUTES

@@ -197,6 +197,95 @@ class TestOrbaxBackend:
         mgr.close()
 
 
+def test_crash_mid_write_never_exposes_torn_tables(tmp_path, monkeypatch):
+    """A crash while the npz is being written (simulated: the writer
+    dies after emitting partial bytes to the temp file) must leave the
+    PREVIOUS checkpoint fully readable: the torn data only ever exists
+    under a temp name, `.done` is already retracted, and the durable
+    scan skips the target."""
+    from hypervisor_tpu.resilience.recovery import latest_durable_checkpoint
+    from hypervisor_tpu.runtime import checkpoint as ckpt_mod
+
+    st = _populated_state()
+    target = save_state(st, tmp_path, step=1)
+    assert (target / ".done").exists()
+    before = np.asarray(st.agents.sigma_eff).copy()
+
+    # Mutate, then crash the overwrite mid-npz.
+    slot = int(np.asarray(st.agents.session)[0])
+    st.enqueue_join(slot, "did:late", sigma_raw=0.9)
+    st.flush_joins()
+
+    real_savez = ckpt_mod.np.savez
+
+    def torn_savez(f, **arrays):
+        f.write(b"PK\x03\x04 torn")  # a few plausible zip bytes, then die
+        raise OSError("simulated crash mid-write")
+
+    monkeypatch.setattr(ckpt_mod.np, "savez", torn_savez)
+    try:
+        save_state(st, tmp_path, step=1)
+    except OSError:
+        pass
+    monkeypatch.setattr(ckpt_mod.np, "savez", real_savez)
+
+    # The visible tables.npz is still the COMPLETE previous save...
+    back = restore_state(target)
+    np.testing.assert_array_equal(np.asarray(back.agents.sigma_eff), before)
+    assert back.agent_row("did:late") is None
+    # ...but the target no longer claims durability (marker retracted
+    # before the write started), so recovery won't trust it.
+    assert not (target / ".done").exists()
+    assert latest_durable_checkpoint(tmp_path) is None
+
+
+def test_capacity_mismatch_refuses_restore(tmp_path):
+    from hypervisor_tpu.config import HypervisorConfig, TableCapacity
+
+    import pytest
+
+    st = _populated_state()
+    target = save_state(st, tmp_path, step=1)
+    shrunk = HypervisorConfig(
+        capacity=TableCapacity(max_agents=64, max_sessions=32)
+    )
+    with pytest.raises(ValueError, match="capacity mismatch"):
+        restore_state(target, shrunk)
+
+
+def test_restore_then_dispatch_zero_recompiles(tmp_path):
+    """A restored state's tables carry the SAME abstract signatures
+    (capacity-checked), so its first dispatch must hit the process-wide
+    jit cache: zero compiles beyond the pre-save first trace."""
+    from hypervisor_tpu.models import SessionConfig
+    from hypervisor_tpu.observability import health as health_plane
+
+    def totals():
+        t = health_plane.compile_summary(last=0)
+        return t["compiles"], t["recompiles"]
+
+    def wave(st, tag):
+        slots = st.create_sessions_batch(
+            [f"{tag}:0", f"{tag}:1"], SessionConfig(min_sigma_eff=0.0)
+        )
+        st.run_governance_wave(
+            slots, [f"did:{tag}:0", f"did:{tag}:1"], slots.copy(),
+            np.full(2, 0.8, np.float32), np.zeros((1, 2, 16), np.uint32),
+        )
+
+    st = HypervisorState()
+    wave(st, "pre")          # the expected first trace happens HERE
+    target = save_state(st, tmp_path, step=1)
+    baseline = totals()
+
+    back = restore_state(target)
+    wave(back, "post")       # same shapes -> cache hit, nothing compiles
+    assert totals() == baseline, (
+        "restore-then-dispatch forced a recompile: "
+        f"{health_plane.compile_summary(last=4)['recent']}"
+    )
+
+
 def test_restore_legacy_percolumn_checkpoint(tmp_path):
     """A checkpoint from before the AgentTable column packing (one array
     per column, possibly missing columns that postdate the save, e.g.

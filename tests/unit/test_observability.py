@@ -66,12 +66,17 @@ class TestEventBus:
     def test_event_type_codes_stable(self):
         # The reference's 40 typed events across 8 categories (its
         # README says 38 but its enum defines 40 — we match the enum)
-        # plus the 3 health-plane events (append-only: codes are the
-        # device-log wire format, so the first 40 stay stable).
-        assert len({t.code for t in EventType}) == len(EventType) == 43
+        # plus the 3 health-plane events and the 4 resilience-plane
+        # events (append-only: codes are the device-log wire format,
+        # so every earlier code stays stable).
+        assert len({t.code for t in EventType}) == len(EventType) == 47
         assert EventType.WAVE_STRAGGLER.code == 40
         assert EventType.CAPACITY_WARNING.code == 41
         assert EventType.RECOMPILE.code == 42
+        assert EventType.DEGRADED_ENTERED.code == 43
+        assert EventType.DEGRADED_EXITED.code == 44
+        assert EventType.DISPATCH_RETRY.code == 45
+        assert EventType.WAL_REPLAYED.code == 46
 
     def test_to_dict(self):
         event = self._emit(EventType.RING_ASSIGNED, "s1", "did:a")

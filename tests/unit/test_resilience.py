@@ -1,0 +1,633 @@
+"""Resilience plane: WAL crash consistency, recovery, supervisor.
+
+The headline test is the kill-at-arbitrary-WAL-offset property: a
+scripted workload snapshots the full device-table state after EVERY
+journaled op, then the WAL is truncated at every record boundary (and
+mid-record) to simulate a crash at that byte; recover() from the
+mid-workload checkpoint + the truncated WAL must land bit-identically
+on the snapshot of the last committed op — no committed transition
+lost, none doubled.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu.config import HypervisorConfig, TableCapacity
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.observability import EventType
+from hypervisor_tpu.resilience import (
+    DegradedModeRefusal,
+    Supervisor,
+    WriteAheadLog,
+    recover,
+    scan,
+)
+from hypervisor_tpu.resilience.recovery import (
+    REPLAY,
+    RecoveryError,
+    checkpoint_with_watermark,
+    latest_durable_checkpoint,
+    verify_audit_heads,
+)
+from hypervisor_tpu.runtime.checkpoint import state_arrays
+from hypervisor_tpu.state import HypervisorState
+from hypervisor_tpu.testing.chaos import (
+    ChaosExecutorFactory,
+    ChaosPlan,
+    InjectedDeviceLoss,
+    InjectedWaveFault,
+    WaveChaosInjector,
+    WaveChaosPlan,
+)
+
+SMALL = HypervisorConfig(
+    capacity=TableCapacity(
+        max_agents=64,
+        max_sessions=32,
+        max_vouch_edges=64,
+        max_sagas=16,
+        max_steps_per_saga=8,
+        max_elevations=16,
+        delta_log_capacity=128,
+        event_log_capacity=128,
+        trace_log_capacity=128,
+    )
+)
+
+
+def _fingerprint(st: HypervisorState) -> dict:
+    """Everything the crash property compares bit-for-bit."""
+    return {
+        "arrays": state_arrays(st),
+        "chain": {s: tuple(int(w) for w in v) for s, v in st._chain_seed.items()},
+        "members": set(st._members),
+        "turns": dict(st._turns),
+    }
+
+
+def _assert_same(a: dict, b: dict, ctx: str = "") -> None:
+    assert a["chain"] == b["chain"], f"chain head diverged {ctx}"
+    assert a["members"] == b["members"], f"membership diverged {ctx}"
+    assert a["turns"] == b["turns"], f"turn counters diverged {ctx}"
+    for key in a["arrays"]:
+        np.testing.assert_array_equal(
+            a["arrays"][key], b["arrays"][key],
+            err_msg=f"column {key} diverged {ctx}",
+        )
+
+
+# ── WAL mechanics ────────────────────────────────────────────────────
+
+
+class TestWal:
+    def test_commit_abort_and_torn_tail(self, tmp_path):
+        wal = WriteAheadLog(tmp_path / "w.log", fsync=False)
+        with wal.txn("op_a", {"x": 1}):
+            pass
+        with pytest.raises(RuntimeError):
+            with wal.txn("op_b", {"x": 2}):
+                raise RuntimeError("dispatch blew up")
+        with wal.txn("op_c", {"x": 3}) as txn:
+            txn.cancel()  # no-effect op must not replay
+        with wal.txn("op_d", {"x": 4}):
+            pass
+        wal.flush()
+        s = scan(wal.path)
+        assert [r.op for r in s.committed] == ["op_a", "op_d"]
+        assert s.aborted == 2
+        # torn tail: any partial final line is ignored and truncated on
+        # resume, and new appends continue the seq numbering
+        raw = wal.path.read_bytes()
+        wal.close()
+        (tmp_path / "w.log").write_bytes(raw + b"deadbeef {garb")
+        s2 = scan(tmp_path / "w.log")
+        assert [r.op for r in s2.committed] == ["op_a", "op_d"]
+        assert s2.torn_bytes > 0
+        resumed = WriteAheadLog(tmp_path / "w.log", fsync=False)
+        assert resumed.last_seq == s2.last_seq
+        with resumed.txn("op_e", {}):
+            pass
+        resumed.flush()
+        assert [r.op for r in scan(tmp_path / "w.log").committed] == [
+            "op_a", "op_d", "op_e",
+        ]
+
+    def test_nested_txn_suppressed(self, tmp_path):
+        wal = WriteAheadLog(tmp_path / "n.log", fsync=False)
+        with wal.txn("outer", {}):
+            with wal.txn("inner", {}):
+                pass
+        wal.flush()
+        assert [r.op for r in scan(wal.path).committed] == ["outer"]
+
+    def test_numpy_payloads_round_trip(self, tmp_path):
+        wal = WriteAheadLog(tmp_path / "np.log", fsync=False)
+        with wal.txn(
+            "op",
+            {
+                "arr": np.arange(3, dtype=np.uint32),
+                "f": np.float32(1.5),
+                "inf": float("inf"),
+            },
+        ):
+            pass
+        (rec,) = wal.committed()
+        assert rec.args["arr"] == [0, 1, 2]
+        assert rec.args["f"] == 1.5
+        assert rec.args["inf"] == float("inf")
+
+    def test_depth_survives_append_failures(self, tmp_path, monkeypatch):
+        """An I/O error inside the intent append must not leave the
+        thread's nesting depth stuck (which would silently suppress
+        every later bracket as 'nested')."""
+        wal = WriteAheadLog(tmp_path / "io.log", fsync=False)
+
+        def boom(op, args):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(wal, "append_intent", boom)
+        with pytest.raises(OSError):
+            with wal.txn("doomed", {}):
+                pass
+        monkeypatch.undo()
+        with wal.txn("after", {}):
+            pass
+        wal.flush()
+        assert [r.op for r in scan(wal.path).committed] == ["after"]
+
+    def test_every_journaled_op_has_a_replay_row(self):
+        """Every `self._journal("<op>", ...)` site in state.py must have
+        a handler in recovery.REPLAY — a journaled op nobody can replay
+        is data loss wearing a seatbelt."""
+        import hypervisor_tpu.state as state_mod
+
+        src = Path(state_mod.__file__).read_text()
+        ops = set(re.findall(r"_journal\(\s*\n?\s*\"(\w+)\"", src))
+        assert ops, "no journal sites found — regex rotted?"
+        missing = ops - set(REPLAY)
+        assert not missing, f"journaled ops without replay handlers: {missing}"
+
+
+# ── the crash property ───────────────────────────────────────────────
+
+
+def _drive_workload(st: HypervisorState, ckpt_dir, snapshots: dict):
+    """Scripted deterministic workload; snapshots[last_seq] records the
+    state after every committed top-level op. Returns the checkpoint
+    watermark seq."""
+
+    def snap():
+        snapshots[st.journal.last_seq] = _fingerprint(st)
+
+    slot = st.create_session("s:crash", SessionConfig(min_sigma_eff=0.0), now=1.0)
+    snap()
+    st.enqueue_join(slot, "did:a", 0.8)
+    snap()
+    st.enqueue_join(slot, "did:b", 0.7)
+    snap()
+    st.flush_joins(now=2.0)
+    snap()
+    a = st.agent_row("did:a")["slot"]
+    b = st.agent_row("did:b")["slot"]
+    st.add_vouch(a, b, slot, bond=0.15)
+    snap()
+    watermark = st.journal.last_seq
+    checkpoint_with_watermark(st, ckpt_dir, step=1)
+
+    # The WAL suffix past the checkpoint.
+    g = st.create_saga("saga:crash", slot, [{"retries": 1}, {}])
+    snap()
+    st.saga_round({g: True})
+    snap()
+    st.stage_delta(slot, a, ts=3.0, change_words=np.arange(4, dtype=np.uint32))
+    snap()
+    st.flush_deltas()
+    snap()
+    st.check_actions_wave(
+        [a, b], [2, 2], [False, False], [False, False], [False, False],
+        [False, False], now=3.5,
+    )
+    snap()
+    slots2 = st.create_sessions_batch(
+        ["s:w0", "s:w1"], SessionConfig(min_sigma_eff=0.0)
+    )
+    snap()
+    st.run_governance_wave(
+        slots2, ["did:c", "did:d"], slots2.copy(),
+        np.full(2, 0.8, np.float32), np.zeros((1, 2, 16), np.uint32),
+        now=4.0,
+    )
+    snap()
+    st.saga_round({g: True})
+    snap()
+    st.terminate_sessions([slot], now=5.0)
+    snap()
+    return watermark
+
+
+class TestKillAtArbitraryWalOffset:
+    def test_no_committed_transition_lost_or_doubled(self, tmp_path):
+        st = HypervisorState(SMALL)
+        st.journal = WriteAheadLog(tmp_path / "wal.log", fsync=False)
+        snapshots: dict[int, dict] = {}
+        watermark = _drive_workload(st, tmp_path / "ckpt", snapshots)
+        st.journal.flush()
+        raw = (tmp_path / "wal.log").read_bytes()
+
+        # Crash points: every record boundary, plus a cut INSIDE every
+        # record (torn write) — the reader must refuse the torn line.
+        boundaries = [0]
+        for line in raw.splitlines(keepends=True):
+            boundaries.append(boundaries[-1] + len(line))
+        offsets = sorted(set(boundaries) | {b - 3 for b in boundaries[1:]})
+
+        for off in offsets:
+            torn = tmp_path / f"torn_{off}.log"
+            torn.write_bytes(raw[:off])
+            committed = scan(torn).committed
+            last = max((r.seq for r in committed), default=0)
+            expected_seq = max(last, watermark)
+            back, report = recover(tmp_path / "ckpt", torn, config=SMALL)
+            assert report["wal_records_replayed"] == len(
+                [r for r in committed if r.seq > watermark]
+            )
+            _assert_same(
+                snapshots[expected_seq],
+                _fingerprint(back),
+                ctx=f"(crash at byte {off}, committed seq {expected_seq})",
+            )
+            torn.unlink()
+
+    def test_full_wal_recovers_tip_state(self, tmp_path):
+        st = HypervisorState(SMALL)
+        st.journal = WriteAheadLog(tmp_path / "wal.log", fsync=False)
+        snapshots: dict[int, dict] = {}
+        _drive_workload(st, tmp_path / "ckpt", snapshots)
+        st.journal.flush()
+        back, report = recover(
+            tmp_path / "ckpt", tmp_path / "wal.log", config=SMALL,
+            attach_journal=True,
+        )
+        _assert_same(_fingerprint(st), _fingerprint(back), ctx="(tip)")
+        # the replay is published on the recovered deployment's planes
+        from hypervisor_tpu.observability import metrics as mp
+
+        assert (
+            back.metrics.snapshot().counter(mp.WAL_REPLAYED_OPS)
+            == report["wal_records_replayed"]
+            > 0
+        )
+        # the reattached journal continues the numbering and the
+        # recovered state keeps ticking + journaling
+        assert back.journal.last_seq == st.journal.last_seq
+        slot2 = back.create_session(
+            "s:post", SessionConfig(min_sigma_eff=0.0), now=9.0
+        )
+        back.enqueue_join(slot2, "did:post", 0.9)
+        assert (back.flush_joins(now=9.5) == 0).all()
+        assert back.journal.last_seq > st.journal.last_seq
+
+
+class TestRecoverySafety:
+    def test_recover_refuses_without_durable_checkpoint(self, tmp_path):
+        with pytest.raises(RecoveryError, match="durable"):
+            recover(tmp_path, None, config=SMALL)
+
+    def test_latest_durable_skips_markerless_saves(self, tmp_path):
+        for name, done in (("step_1", True), ("step_2", False)):
+            d = tmp_path / name
+            d.mkdir()
+            if done:
+                (d / ".done").touch()
+        assert latest_durable_checkpoint(tmp_path).name == "step_1"
+
+    def test_latest_durable_orders_by_completion_time(self, tmp_path):
+        """A fresher bare `latest` save beats an older step_<N> — the
+        scan orders by the .done marker's mtime (when the save became
+        durable), not by directory naming."""
+        import os
+
+        (tmp_path / "step_5").mkdir()
+        (tmp_path / "step_5" / ".done").touch()
+        os.utime(tmp_path / "step_5" / ".done", (1_000, 1_000))
+        (tmp_path / "latest").mkdir()
+        (tmp_path / "latest" / ".done").touch()
+        os.utime(tmp_path / "latest" / ".done", (2_000, 2_000))
+        assert latest_durable_checkpoint(tmp_path).name == "latest"
+
+    def test_audit_head_mismatch_refuses(self, tmp_path):
+        st = HypervisorState(SMALL)
+        slot = st.create_session("s:audit", SessionConfig(min_sigma_eff=0.0))
+        st.enqueue_join(slot, "did:a", 0.8)
+        st.flush_joins()
+        st.stage_delta(slot, 0, ts=1.0, change_words=np.arange(2, dtype=np.uint32))
+        st.flush_deltas()
+        assert verify_audit_heads(st) == 1
+        # corrupt the recorded head: recovery must refuse the state
+        st._chain_seed[slot] = np.zeros(8, np.uint32)
+        with pytest.raises(RecoveryError, match="chain head mismatch"):
+            verify_audit_heads(st)
+
+
+# ── supervisor / degraded mode ───────────────────────────────────────
+
+
+def _wave(st, sup, tag, n=2, now=1.0):
+    slots = st.create_sessions_batch(
+        [f"{tag}:{i}" for i in range(n)], SessionConfig(min_sigma_eff=0.0)
+    )
+    return sup.dispatch(
+        "governance_wave", st.run_governance_wave, slots,
+        [f"did:{tag}:{i}" for i in range(n)], slots.copy(),
+        np.full(n, 0.8, np.float32), np.zeros((1, n, 16), np.uint32),
+        now,
+    )
+
+
+class TestSupervisor:
+    def _rig(self, **kw):
+        st = HypervisorState(SMALL)
+        defaults = dict(
+            max_retries=3, backoff_base_s=0.0, degrade_after_failures=1,
+            exit_after_clean=2, sleep=lambda s: None,
+        )
+        defaults.update(kw)
+        return st, Supervisor(st, **defaults)
+
+    def test_retry_recovers_transient_faults(self):
+        st, sup = self._rig()
+        st.fault_injector = WaveChaosInjector(WaveChaosPlan(seed=3, fail_rate=0.5))
+        for i in range(5):
+            _wave(st, sup, f"r{i}")
+        assert sup.retries > 0
+        assert sup.failed_dispatches == 0
+        assert not sup.degraded
+        assert sup.summary()["recovery_latency_ms"]["n"] > 0
+
+    def test_backoff_is_exponential_and_capped(self):
+        slept = []
+        st, sup = self._rig(
+            max_retries=5, backoff_base_s=0.1, sleep=slept.append
+        )
+        sup.backoff_cap_s = 0.5
+        st.fault_injector = WaveChaosInjector(WaveChaosPlan(seed=0, fail_rate=1.0))
+        with pytest.raises(InjectedWaveFault):
+            _wave(st, sup, "b")
+        assert slept == pytest.approx([0.1, 0.2, 0.4, 0.5, 0.5])
+
+    def test_exhaustion_degrades_sheds_and_recovers(self):
+        st, sup = self._rig()
+        st.fault_injector = WaveChaosInjector(WaveChaosPlan(seed=1, fail_rate=1.0))
+        with pytest.raises(InjectedWaveFault):
+            _wave(st, sup, "x")
+        assert sup.degraded
+        # shed: admissions refuse loudly
+        with pytest.raises(DegradedModeRefusal):
+            st.enqueue_join(0, "did:shed", 0.9)
+        # paused: fan-out returns no work
+        st._fanout_groups[0] = [(0, [0, 1])]
+        assert st.fanout_dispatch() == []
+        del st._fanout_groups[0]
+        # flowing: terminations and audit commits still run
+        slot = st.create_session("s:flow", SessionConfig(min_sigma_eff=0.0))
+        st.fault_injector = None
+        st.stage_delta(slot, -1, ts=1.0)
+        assert st.flush_deltas() == 1
+        st.terminate_sessions([slot], now=2.0)
+        # clean dispatches exit the mode
+        _wave(st, sup, "c0")
+        _wave(st, sup, "c1")
+        assert not sup.degraded
+        assert sup.degraded_exits == 1
+
+    def test_straggler_pressure_degrades(self):
+        st, sup = self._rig(degrade_after_stragglers=2)
+        st.health.emit_event(
+            "straggler", {"stage": "governance_wave", "trace_id": "t"}
+        )
+        assert not sup.degraded
+        sup._on_health_event("straggler", {})
+        assert sup.degraded
+
+    def test_device_loss_is_not_retried(self):
+        st, sup = self._rig(max_retries=10)
+        calls = []
+
+        def drain():
+            calls.append(1)
+            raise InjectedDeviceLoss("corrupt drain")
+
+        with pytest.raises(InjectedDeviceLoss):
+            sup.dispatch("metrics_drain", drain)
+        assert len(calls) == 1  # no retry against dead buffers
+        assert sup.degraded
+        assert sup.device_losses == 1
+
+    def test_debug_resilience_on_both_transports(self):
+        import urllib.request
+
+        from hypervisor_tpu.api import HypervisorService
+        from hypervisor_tpu.api.server import HypervisorHTTPServer
+
+        svc = HypervisorService()
+        sup = Supervisor(svc.hv.state, sleep=lambda s: None)
+        payload = asyncio.run(svc.debug_resilience())
+        json.dumps(payload)  # JSON-serializable contract
+        assert payload["enabled"] is True
+        assert payload["mode"] == "normal"
+        sup.force_degraded("test")
+        server = HypervisorHTTPServer(svc).start()
+        try:
+            doc = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/debug/resilience"
+                ).read()
+            )
+        finally:
+            server.stop()
+        assert doc["mode"] == "degraded"
+        assert doc["degraded"]["active_policy"]["reason"] == "test"
+        sup.force_recovered()
+
+    def test_periodic_checkpoints_use_fresh_steps_and_prune(self, tmp_path):
+        """Each save lands in a new step dir (the previous durable
+        checkpoint's .done is never retracted mid-write) and old steps
+        prune down to checkpoint_keep."""
+        from hypervisor_tpu.resilience.recovery import (
+            latest_durable_checkpoint,
+        )
+
+        st = HypervisorState(SMALL)
+        sup = Supervisor(st, checkpoint_dir=str(tmp_path), sleep=lambda s: None)
+        sup.checkpoint_keep = 2
+        targets = [sup.checkpoint() for _ in range(4)]
+        assert len({t.name for t in targets}) == 4  # all fresh dirs
+        durable = sorted(
+            p.name for p in tmp_path.iterdir() if (p / ".done").exists()
+        )
+        assert durable == ["step_3", "step_4"]
+        assert latest_durable_checkpoint(tmp_path).name == "step_4"
+        # a new supervisor over the same dir resumes the numbering
+        sup2 = Supervisor(
+            HypervisorState(SMALL), checkpoint_dir=str(tmp_path),
+            sleep=lambda s: None,
+        )
+        assert sup2.checkpoint().name == "step_5"
+
+    def test_periodic_checkpoint_skip_does_not_fail_dispatch(self, tmp_path):
+        """Staged joins legitimately refuse a save; the periodic path
+        records the skip instead of failing the healthy dispatch."""
+        st = HypervisorState(SMALL)
+        sup = Supervisor(
+            st, checkpoint_dir=str(tmp_path), checkpoint_every=1,
+            sleep=lambda s: None,
+        )
+        slot = st.create_session("s:skip", SessionConfig(min_sigma_eff=0.0))
+        st.enqueue_join(slot, "did:staged", 0.9)  # staged, unflushed
+        out = sup.dispatch("noop", lambda: "ok")  # triggers _maybe_checkpoint
+        assert out == "ok"
+        assert sup.checkpoints_skipped == 1
+        assert "staged" in sup.last_checkpoint_error
+        assert sup.summary()["checkpoints_skipped"] == 1
+
+    def test_detached_state_reports_disabled(self):
+        st = HypervisorState(SMALL)
+        payload = st.resilience_summary()
+        assert payload == {
+            "enabled": False,
+            "mode": "normal",
+            "degraded": {"active_policy": None},
+            "journal": None,
+        }
+
+    def test_transitions_reach_the_event_bus(self):
+        from hypervisor_tpu.api import HypervisorService
+
+        svc = HypervisorService()
+        st = svc.hv.state
+        sup = Supervisor(st, sleep=lambda s: None)
+        sup.force_degraded("bus test")
+        sup.force_recovered()
+        entered = svc.bus.query_by_type(EventType.DEGRADED_ENTERED)
+        exited = svc.bus.query_by_type(EventType.DEGRADED_EXITED)
+        assert len(entered) == 1 and len(exited) == 1
+        assert entered[0].payload["reason"] == "bus test"
+        assert exited[0].payload["degraded_s"] >= 0
+
+
+# ── seeded end-to-end chaos ──────────────────────────────────────────
+
+
+class TestSeededChaosEndToEnd:
+    def test_chaos_run_loses_no_committed_transition(self, tmp_path):
+        """A chaos run (wave-layer faults + supervisor retries) must end
+        bit-identical to the same workload without chaos, with degraded
+        enter/exit visible on the bus and /debug/resilience."""
+        from hypervisor_tpu.api import HypervisorService
+
+        def drive(st, dispatch):
+            for i in range(8):
+                slots = st.create_sessions_batch(
+                    [f"e2e{i}:{j}" for j in range(2)],
+                    SessionConfig(min_sigma_eff=0.0),
+                )
+                dispatch(
+                    st.run_governance_wave, slots,
+                    [f"did:e2e{i}:{j}" for j in range(2)], slots.copy(),
+                    np.full(2, 0.8, np.float32),
+                    np.zeros((1, 2, 16), np.uint32), float(i),
+                )
+
+        clean = HypervisorState(SMALL)
+        drive(clean, lambda fn, *a: fn(*a))
+
+        svc = HypervisorService()
+        chaotic = HypervisorState(SMALL)
+        svc.hv.state = chaotic  # rebind so bus bridging follows the state
+        svc.hv.state.health.add_listener(svc.hv._on_health_event)
+        chaotic.journal = WriteAheadLog(tmp_path / "e2e.log", fsync=False)
+        sup = Supervisor(
+            chaotic, max_retries=6, backoff_base_s=0.0,
+            degrade_after_failures=1, exit_after_clean=1,
+            sleep=lambda s: None,
+        )
+        chaotic.fault_injector = WaveChaosInjector(
+            WaveChaosPlan(seed=11, fail_rate=0.4)
+        )
+        sup.force_degraded("exercise enter/exit during traffic")
+        sup.force_recovered()
+        drive(chaotic, lambda fn, *a: sup.dispatch("governance_wave", fn, *a))
+
+        for key, col in state_arrays(clean).items():
+            np.testing.assert_array_equal(
+                col, state_arrays(chaotic)[key],
+                err_msg=f"{key} diverged under chaos",
+            )
+        assert sup.retries > 0, "seed 11 injected nothing — plan drifted?"
+        assert svc.bus.query_by_type(EventType.DEGRADED_ENTERED)
+        assert svc.bus.query_by_type(EventType.DEGRADED_EXITED)
+        assert asyncio.run(svc.debug_resilience())["dispatch"]["retries"] > 0
+        # and the journal replays the chaotic history losslessly
+        checkpoint_with_watermark(chaotic, tmp_path / "ck")
+        back, _ = recover(tmp_path / "ck", tmp_path / "e2e.log", config=SMALL)
+        for key, col in state_arrays(chaotic).items():
+            np.testing.assert_array_equal(col, state_arrays(back)[key])
+
+    def test_same_seed_same_fault_schedule(self):
+        def schedule(seed):
+            inj = WaveChaosInjector(
+                WaveChaosPlan(seed=seed, fail_rate=0.3, hang_rate=0.2,
+                              hang_seconds=0.0)
+            )
+            out = []
+            for _ in range(64):
+                try:
+                    inj.on_dispatch("governance_wave")
+                    out.append("ok")
+                except InjectedWaveFault:
+                    out.append("fault")
+            return out, inj.hangs
+
+        assert schedule(7) == schedule(7)
+        assert schedule(7) != schedule(8)
+
+
+# ── chaos executor hang hygiene (satellite) ──────────────────────────
+
+
+class TestChaosHangHygiene:
+    def test_hangs_are_tracked_and_cancellable(self):
+        async def scenario():
+            chaos = ChaosExecutorFactory(
+                ChaosPlan(seed=0, fail_rate=0.0, hang_rate=1.0,
+                          hang_seconds=3600.0)
+            )
+
+            async def step():
+                return "done"
+
+            wrapped = chaos.wrap(step, key="hangy")
+            tasks = [asyncio.ensure_future(wrapped()) for _ in range(3)]
+            await asyncio.sleep(0)  # let them park in the injected hang
+            assert chaos.hanging_tasks == 3
+            assert chaos.cancel_hangs() == 3
+            results = await asyncio.gather(*tasks, return_exceptions=True)
+            assert all(isinstance(r, asyncio.CancelledError) for r in results)
+            assert chaos.hanging_tasks == 0
+            return chaos.report()
+
+        report = asyncio.run(scenario())
+        assert report["hangs"] == 3
+        # nothing left pending: asyncio.run would have warned/leaked
+        # otherwise; a fresh loop sees no stray tasks
+        async def probe():
+            return [t for t in asyncio.all_tasks() if t is not asyncio.current_task()]
+
+        assert asyncio.run(probe()) == []

@@ -527,6 +527,167 @@ def chaos_benchmark(seed: int, quick: bool) -> dict:
     }
 
 
+def corrupt_benchmark(seed: int, quick: bool) -> dict:
+    """`--corrupt <seed>`: the standard governance rounds with seeded
+    REAL corruption (`testing.chaos.InjectedCorruption`) against a
+    deployment running the full integrity plane (sanitizer sampled
+    every dispatch, scrubber paced every dispatch, restore ladder over
+    a WAL + watermarked checkpoint). Reports per-corruption detection
+    latency (waves from injection to detection) p50/max and the
+    sanitizer's clean-path overhead (%) into the BENCH payload, so the
+    trajectory tracks integrity alongside speed and chaos resilience.
+    Seeded: the same seed replays the same corruption schedule.
+    """
+    import time as _time
+
+    from hypervisor_tpu.integrity import IntegrityPlane, StateRestoredError
+    from hypervisor_tpu.models import SessionConfig
+    from hypervisor_tpu.resilience import Supervisor, WriteAheadLog
+    from hypervisor_tpu.state import HypervisorState
+    from hypervisor_tpu.testing.chaos import (
+        InjectedCorruption,
+        WaveChaosInjector,
+        WaveChaosPlan,
+    )
+
+    rounds = 8 if quick else 24
+    lanes = 16 if quick else 64
+    warm = 2  # clean rounds before the first corruption can land
+
+    def wave(st, sup, r):
+        slots = st.create_sessions_batch(
+            [f"corrupt{r}:{i}" for i in range(lanes)],
+            SessionConfig(min_sigma_eff=0.0),
+        )
+        args = (
+            slots, [f"did:corrupt{r}:{i}" for i in range(lanes)],
+            slots.copy(), np.full(lanes, 0.8, np.float32),
+            np.zeros((1, lanes, 16), np.uint32),
+        )
+        t0 = _time.perf_counter()
+        try:
+            st.run_governance_wave(*args, now=float(r))
+        except StateRestoredError:
+            # the gate restored mid-traffic; re-issue on the new state
+            sup.state.run_governance_wave(*args, now=float(r))
+        return _time.perf_counter() - t0
+
+    # One corruption of each class, at seeded dispatch offsets.
+    import random as _random
+
+    rng = _random.Random(seed)
+    classes = ("bit_flip", "row_rewrite", "chain_tamper")
+    tables = {"bit_flip": "agents", "row_rewrite": "agents"}
+    span = max(rounds - warm - 2, len(classes))
+    offsets = sorted(rng.sample(range(span), len(classes)))
+    corruptions = tuple(
+        InjectedCorruption(
+            kind, at_dispatch=warm + off + 1, table=tables.get(kind, "agents")
+        )
+        for kind, off in zip(classes, offsets)
+    )
+
+    work_dir = Path(tempfile.mkdtemp(prefix="hv_bench_corrupt_"))
+    st = HypervisorState()
+    st.journal = WriteAheadLog(work_dir / "wal.log", fsync=False)
+    sup = Supervisor(
+        st, checkpoint_dir=str(work_dir / "ckpt"), sleep=lambda s: None
+    )
+    plane = IntegrityPlane(
+        st, every=1, scrub_every=1, scrub_budget=128, ladder="restore"
+    )
+
+    wave_s: list[float] = []
+    detections: list[int] = []   # detection latency, in waves
+    injected_at: dict[int, int] = {}  # corruption idx -> round injected
+    outstanding: set[int] = set()     # injected rounds not yet restored
+    t_total0 = _time.perf_counter()
+    for r in range(rounds):
+        if r == warm:
+            sup.checkpoint()
+            sup.state.fault_injector = WaveChaosInjector(
+                WaveChaosPlan(seed=seed, corruptions=corruptions)
+            )
+        restores_before = plane.restores
+        wave_s.append(wave(sup.state, sup, r))
+        inj = sup.state.fault_injector
+        if inj is not None:
+            for i, rec in enumerate(inj.corruptions_applied):
+                if injected_at.setdefault(i, r) == r:
+                    outstanding.add(r)
+        sup.state.metrics_snapshot()  # detection closes at the drain
+        if plane.restores > restores_before and outstanding:
+            # A restore wipes EVERY outstanding corruption; latency is
+            # honest against the OLDEST one still waiting.
+            detections.append(r - min(outstanding))
+            outstanding.clear()
+    wall_s = _time.perf_counter() - t_total0
+
+    # Sanitizer overhead: identical clean rounds, sampling at the
+    # production cadence (HV_INTEGRITY_EVERY default) vs no plane. The
+    # envelope is a P50 bar: the sampled check rides 1-in-8 waves, so
+    # the median wave pays only the gate itself.
+    def timed_clean(plane_on: bool) -> list[float]:
+        state = HypervisorState()
+        if plane_on:
+            IntegrityPlane(state, every=8)
+        out = []
+        n = 17 if quick else 33
+        for r in range(n):
+            slots = state.create_sessions_batch(
+                [f"ovh{int(plane_on)}:{r}:{i}" for i in range(lanes)],
+                SessionConfig(min_sigma_eff=0.0),
+            )
+            t0 = _time.perf_counter()
+            state.run_governance_wave(
+                slots, [f"did:ovh{int(plane_on)}:{r}:{i}" for i in range(lanes)],
+                slots.copy(), np.full(lanes, 0.8, np.float32),
+                np.zeros((1, lanes, 16), np.uint32), now=float(r),
+            )
+            out.append(_time.perf_counter() - t0)
+        return sorted(out[1:])  # drop the compile round
+
+    base = timed_clean(False)
+    sampled = timed_clean(True)
+    p50 = lambda xs: xs[len(xs) // 2]  # noqa: E731
+    overhead_pct = (
+        (p50(sampled) - p50(base)) / p50(base) * 100.0 if base else 0.0
+    )
+
+    detections.sort()
+    return {
+        "seed": seed,
+        "rounds": rounds,
+        "lanes_per_round": lanes,
+        "corruptions_injected": [
+            {k: v for k, v in rec.items()}
+            for rec in (
+                sup.state.fault_injector.corruptions_applied
+                if sup.state.fault_injector is not None
+                else []
+            )
+        ],
+        "detection_latency_waves": (
+            {
+                "n": len(detections),
+                "p50": detections[len(detections) // 2],
+                "max": detections[-1],
+            }
+            if detections
+            else {"n": 0}
+        ),
+        "sanitizer_overhead_pct": round(overhead_pct, 2),
+        "restores": plane.restores,
+        "repairs": plane.repairs,
+        "scrub": {
+            "links_verified": plane.scrubber.links_verified,
+            "mismatches_escalated": plane.scrub_mismatches,
+        },
+        "checks": plane.checks,
+        "wall_s": round(wall_s, 3),
+    }
+
+
 def _git_commit() -> str | None:
     """Current commit hash, stamped into bench reports so a trajectory
     row names the code it measured; None outside a git checkout."""
@@ -577,6 +738,19 @@ def main() -> None:
         ),
     )
     ap.add_argument(
+        "--corrupt",
+        type=int,
+        default=None,
+        metavar="SEED",
+        help=(
+            "also run the standard governance rounds with seeded REAL "
+            "table corruption (bit flips / row rewrites / chain "
+            "tampers) against the full integrity plane, and report "
+            "detection-latency p50/max (waves) + sanitizer overhead "
+            "(%%) into the BENCH payload"
+        ),
+    )
+    ap.add_argument(
         "--write-results",
         action="store_true",
         help=(
@@ -617,6 +791,21 @@ def main() -> None:
                 flush=True,
             )
 
+    integrity_rec = None
+    if args.corrupt is not None:
+        integrity_rec = corrupt_benchmark(args.corrupt, args.quick)
+        if not args.json_only:
+            det = integrity_rec["detection_latency_waves"]
+            print(
+                f"corrupt[seed={args.corrupt}]: "
+                f"{len(integrity_rec['corruptions_injected'])} injected, "
+                f"{integrity_rec['restores']} restores, detection p50 "
+                f"{det.get('p50', '—')}/max {det.get('max', '—')} waves, "
+                f"sanitizer overhead "
+                f"{integrity_rec['sanitizer_overhead_pct']}%",
+                flush=True,
+            )
+
     if args.metrics_out:
         from benchmarks import regression
 
@@ -638,6 +827,10 @@ def main() -> None:
             # Resilience row (--chaos <seed>): the trajectory tracks
             # completed-wave ratio + recovery latency alongside speed.
             "chaos": chaos_rec,
+            # Integrity row (--corrupt <seed>): detection latency +
+            # sanitizer overhead land in the trajectory too, and
+            # regression.py gates the overhead.
+            "integrity": integrity_rec,
         }
         out_path.write_text(json.dumps(report, indent=2) + "\n")
         if not args.json_only:
@@ -661,6 +854,7 @@ def main() -> None:
         "quick": args.quick,
         "benchmarks": results,
         "chaos": chaos_rec,
+        "integrity": integrity_rec,
     }
     if jax.default_backend() not in ("tpu",) and not args.write_results:
         print(

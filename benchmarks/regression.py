@@ -51,6 +51,12 @@ _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 #: Backend -> default tolerance band (fraction above baseline allowed).
 DEFAULT_TOLERANCE = {"tpu": 0.5, "cpu": 3.0}
 
+#: Backend -> max sanitizer overhead (%) a `--corrupt` round may report
+#: (`HV_BENCH_INTEGRITY_OVERHEAD` overrides). The acceptance envelope
+#: is ≤2% on TPU; cpu CI hosts get a wide non-flaky band that still
+#: catches a sanitizer accidentally riding the hot path.
+DEFAULT_INTEGRITY_OVERHEAD = {"tpu": 2.0, "cpu": 50.0}
+
 
 def _backend_of(device: str) -> str:
     return "tpu" if "tpu" in (device or "").lower() else "cpu"
@@ -81,6 +87,7 @@ def parse_round_file(path: Path) -> Optional[dict]:
             "per_op_p50_us"
         )
         chaos = doc.get("chaos")
+        integrity = doc.get("integrity")
         row.update(
             format="suite",
             backend=doc.get("backend", "cpu"),
@@ -100,6 +107,24 @@ def parse_round_file(path: Path) -> Optional[dict]:
                     "degraded_entries": chaos.get("degraded_entries"),
                 }
                 if isinstance(chaos, dict)
+                else None
+            ),
+            # Integrity row (bench_suite --corrupt): detection latency
+            # + sanitizer overhead tracked alongside speed, and the
+            # overhead is gated below.
+            integrity=(
+                {
+                    "seed": integrity.get("seed"),
+                    "detection_latency_waves": integrity.get(
+                        "detection_latency_waves"
+                    ),
+                    "sanitizer_overhead_pct": integrity.get(
+                        "sanitizer_overhead_pct"
+                    ),
+                    "restores": integrity.get("restores"),
+                    "repairs": integrity.get("repairs"),
+                }
+                if isinstance(integrity, dict)
                 else None
             ),
         )
@@ -219,6 +244,26 @@ def compare(
             regressions.append(entry)
         elif ratio < 1.0 / (1.0 + tolerance):
             improvements.append(entry)
+    # Integrity gate: a round that ran the corruption drill must keep
+    # the sanitizer's clean-path overhead inside the backend's band.
+    integrity = current.get("integrity")
+    if integrity and integrity.get("sanitizer_overhead_pct") is not None:
+        env_cap = os.environ.get("HV_BENCH_INTEGRITY_OVERHEAD")
+        cap = (
+            float(env_cap)
+            if env_cap
+            else DEFAULT_INTEGRITY_OVERHEAD.get(current["backend"], 50.0)
+        )
+        overhead = float(integrity["sanitizer_overhead_pct"])
+        entry = {
+            "bench": "integrity_sanitizer_overhead",
+            "current_per_op_us": overhead,
+            "baseline_per_op_us": cap,
+            "ratio": round(overhead / cap, 3) if cap else 0.0,
+        }
+        checked.append(entry)
+        if overhead > cap:
+            regressions.append(entry)
     return {
         "ok": not regressions,
         "round": current["round"],

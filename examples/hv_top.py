@@ -173,6 +173,41 @@ def render(
             f"(deadline {s['deadline_us']:,.0f})  trace={s['trace_id']}"
         )
 
+    integ = health.get("integrity", {})
+    lines.append("")
+    if not integ.get("enabled"):
+        lines.append("integrity  (plane not attached)")
+    else:
+        sampling = integ.get("sampling", {})
+        repairs = integ.get("repairs", {})
+        restores = integ.get("restores", {})
+        scrub = integ.get("scrub", {})
+        lines.append(
+            f"integrity  checks={sampling.get('checks', 0):,} "
+            f"(every {sampling.get('every', 0)} dispatches)  "
+            f"violations={integ.get('violations_seen', 0):,}  "
+            f"repaired={repairs.get('rows_repaired', 0):,}  "
+            f"quarantined={repairs.get('rows_quarantined', 0):,}  "
+            f"restores={restores.get('count', 0)}"
+        )
+        size = scrub.get("sweep_size", 0)
+        lines.append(
+            f"  scrub    {scrub.get('position', 0):,}/{size:,} of sweep "
+            f"{scrub.get('sweeps_completed', 0) + 1:,}  "
+            f"links={scrub.get('links_verified', 0):,}  "
+            f"mismatches={scrub.get('mismatches', 0):,}"
+        )
+        for row in integ.get("last_violations", [])[-3:]:
+            lines.append(
+                f"  {row.get('table', '?'):10s} row {row.get('row', -1):>6} "
+                f" {', '.join(row.get('checks', []))}"
+            )
+        last_restore = restores.get("last")
+        if last_restore:
+            lines.append(
+                f"  restored  {last_restore.get('reason', '')[:60]}"
+            )
+
     if trajectory:
         lines.append("")
         lines.append("bench trajectory (headline per-op p50, µs)")
@@ -217,6 +252,11 @@ def main(argv=None) -> int:
         return watch_loop(frame, watch=args.watch, interval=args.interval)
 
     state = build_state(args.sessions * max(args.rounds, 1) + 64)
+    # Live integrity panel for the in-process demo: sampled sanitizer +
+    # paced scrubbing over the demo traffic.
+    from hypervisor_tpu.integrity import IntegrityPlane
+
+    IntegrityPlane(state, every=4, scrub_every=8)
     progress = {"rnd": 0, "driving": True}
 
     def tick() -> None:

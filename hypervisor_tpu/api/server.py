@@ -9,7 +9,9 @@ and the health plane: `GET /debug/health` (watchdog + occupancy +
 compile totals + stage quantiles), `GET /debug/memory` (per-table HBM
 footprints), `GET /debug/compiles` (compile telemetry), plus the
 resilience plane: `GET /debug/resilience` (supervisor mode, retry
-accounting, WAL status, last watermarked checkpoint)):
+accounting, WAL status, last watermarked checkpoint) and the integrity
+plane: `GET /debug/integrity` (sanitizer violations, scrub progress,
+repair/restore ladder accounting)):
 
  - `create_app()` — a FastAPI application with CORS-open middleware and
    OpenAPI docs, when fastapi is installed.
@@ -43,6 +45,7 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/debug/memory", "debug_memory", None),
     ("GET", "/debug/compiles", "debug_compiles", None),
     ("GET", "/debug/resilience", "debug_resilience", None),
+    ("GET", "/debug/integrity", "debug_integrity", None),
     ("GET", "/api/v1/stats", "stats", None),
     ("GET", "/api/v1/device/stats", "device_stats", None),
     ("POST", "/api/v1/sessions", "create_session", M.CreateSessionRequest),

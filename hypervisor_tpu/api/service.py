@@ -143,6 +143,13 @@ class HypervisorService:
         watermarked checkpoint."""
         return self.hv.state.resilience_summary()
 
+    async def debug_integrity(self) -> dict:
+        """`GET /debug/integrity`: the state-integrity plane in one
+        poll — sanitizer cadence and violation counts, last violation
+        detail, repair/containment/restore accounting, Merkle scrub
+        progress, and the invariant catalog."""
+        return self.hv.state.integrity_summary()
+
     async def debug_compiles(self) -> dict:
         """`GET /debug/compiles`: compile telemetry for the watched
         jitted wave entry points — compile/recompile/donation-failure

@@ -1601,6 +1601,12 @@ class Hypervisor:
             "degraded_exit": EventType.DEGRADED_EXITED,
             "dispatch_retry": EventType.DISPATCH_RETRY,
             "wal_replayed": EventType.WAL_REPLAYED,
+            # Integrity-plane detections and escalations ride the same
+            # fan-out (`integrity.plane.IntegrityPlane`).
+            "integrity_violation": EventType.INTEGRITY_VIOLATION,
+            "scrub_mismatch": EventType.SCRUB_MISMATCH,
+            "row_quarantined": EventType.ROW_QUARANTINED,
+            "state_restored": EventType.STATE_RESTORED,
         }.get(kind)
         if event_type is None or self.event_bus is None:
             return

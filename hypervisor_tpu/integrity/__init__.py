@@ -1,0 +1,41 @@
+"""State-integrity plane: detect, repair, or restore silent corruption.
+
+Three pieces (docs/OPERATIONS.md "Integrity & scrubbing"):
+
+  * `invariants` — the in-jit sanitizer: one fused program re-checking
+    every invariant the codebase assumes over all 9 tables/rings/logs,
+    returning per-row violation bitmasks + counts that ride the
+    existing metrics drain (zero extra `device_get` on the clean path),
+    plus the deterministic in-place repairs.
+  * `scrubber` — the paced Merkle scrubber: budgeted strips re-hashing
+    the DeltaLog chain against its recorded digests and committed
+    heads, catching bit-rot the semantic checks can't see.
+  * `plane` — `IntegrityPlane`, the host object wiring sampling into
+    the dispatch sites, detection into the drain, and the escalation
+    ladder (repair → contain → checkpoint restore) into PR 4's
+    Supervisor.
+"""
+
+from hypervisor_tpu.integrity.invariants import (
+    CATALOG,
+    ESCROW_CAP,
+    IntegrityResult,
+    check_invariants,
+)
+from hypervisor_tpu.integrity.plane import (
+    IntegrityError,
+    IntegrityPlane,
+    StateRestoredError,
+)
+from hypervisor_tpu.integrity.scrubber import MerkleScrubber
+
+__all__ = [
+    "CATALOG",
+    "ESCROW_CAP",
+    "IntegrityError",
+    "IntegrityPlane",
+    "IntegrityResult",
+    "MerkleScrubber",
+    "StateRestoredError",
+    "check_invariants",
+]

@@ -1,0 +1,514 @@
+"""In-wave invariant sanitizer: the system's own rules, checked on device.
+
+PR 4 made the plane survive a *crash*; this module is the first half of
+surviving a *lie* — silent data corruption in the HBM-resident tables.
+`check_invariants` is one pure jitted program over every table/ring/log
+that re-derives the invariants the rest of the codebase merely assumes:
+
+  * sigma scores live in [0, 1] and are finite,
+  * rings live in {0..3} and privileged rings are consistent with the
+    sigma thresholds that justify them (`ops.rings.compute_rings`),
+  * rate-limit buckets hold a sane token count for their ring's burst,
+  * agent flag words use only the defined FLAG_* bits,
+  * live memberships reference a real session row,
+  * vouch edges reference real agent rows with non-negative finite
+    bonds, and no voucher's total escrow (sum of active bonds — the
+    sigma it has locked) exceeds the conservation cap (sigma ≤ 1, so
+    more locked than ESCROW_CAP means the ledger lies),
+  * session FSM state/mode codes are valid and participant counts fit,
+  * saga FSM codes, cursors, and step matrices are in range,
+  * elevation grants reference real rows and grantable rings,
+  * ring-buffer cursors are sane and the DeltaLog's per-session turn
+    numbers are distinct and contiguous (the device twin of vector-
+    clock monotonicity: surviving turns are always a contiguous suffix,
+    so a rewritten/duplicated turn breaks the count/min/max/sum pact).
+
+The result is a packed per-row violation bitmask per table plus global
+counts. NOTHING here syncs to host: the counts land in the metrics
+table (`hv_integrity_*` rows) and ride the existing drain, and the
+masks stay device-resident until the repair path explicitly pulls them
+(`integrity.plane.IntegrityPlane`). The clean path costs one small
+fused program every `HV_INTEGRITY_EVERY` dispatches and zero extra
+`device_get`s.
+
+`repair_*` are the deterministic in-place fixes for the repairable
+violation classes (clamp, recompute, mask, deactivate, quarantine-the-
+row through the existing liability quarantine path); the unrepairable
+classes (FSM code damage, conservation break, cursor/turn-chain damage)
+escalate to checkpoint restore (`resilience.recovery.recover`).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
+from hypervisor_tpu.ops import rings as ring_ops
+from hypervisor_tpu.ops import security_ops
+from hypervisor_tpu.tables.metrics import MetricsTable, counter_inc, gauge_set
+from hypervisor_tpu.tables.state import KNOWN_FLAGS_MASK
+from hypervisor_tpu.tables.struct import replace
+
+# ── violation bit catalog ────────────────────────────────────────────
+# Bits are per-table u32 masks; REPAIR class decides the ladder rung:
+#   repair   — deterministic in-place fix (clamp / recompute / mask)
+#   contain  — row/edge/grant neutralized (quarantine / deactivate)
+#   restore  — only a checkpoint + WAL replay can be trusted
+
+A_SIGMA_RANGE = 1 << 0    # repair: clamp to [0, 1]
+A_RING_RANGE = 1 << 1     # repair: recompute from sigma_eff
+A_RING_SIGMA = 1 << 2     # repair: recompute from sigma_eff
+A_RL_TOKENS = 1 << 3      # repair: clamp to [0, burst(ring)]
+A_FLAGS = 1 << 4          # repair: mask to KNOWN_FLAGS_MASK
+A_SESSION_REF = 1 << 5    # contain: quarantine the row
+
+S_STATE_CODE = 1 << 0     # restore
+S_MODE_CODE = 1 << 1      # restore
+S_NPART = 1 << 2          # repair: clamp to [0, max_participants]
+S_TIME = 1 << 3           # restore
+
+V_ENDPOINT = 1 << 0       # contain: deactivate the edge
+V_BOND = 1 << 1           # contain: deactivate the edge
+V_ESCROW = 1 << 2         # restore (conservation break)
+
+G_STATE = 1 << 0          # restore
+G_CURSOR = 1 << 1         # restore
+G_NSTEPS = 1 << 2         # restore
+G_STEP_STATE = 1 << 3     # restore
+
+E_RANGE = 1 << 0          # contain: deactivate the grant
+
+L_CURSOR = 1 << 0         # restore
+L_DELTA_ROW = 1 << 1      # restore (live row session/turn out of range)
+L_TURN_CHAIN = 1 << 2     # restore (per-session turn set not contiguous)
+
+#: Escrow conservation cap: sigma ∈ [0, 1], so one voucher can never
+#: have more than ~1.0 of absolute sigma locked across its active
+#: bonds. Corruption that inflates a bond word breaks this long before
+#: any semantic per-edge check would notice.
+ESCROW_CAP = 1.0 + 1e-4
+
+#: Session FSM / consistency-mode code ranges (models.SessionState /
+#: models.ConsistencyMode — codes are append-only enums).
+N_SESSION_STATES = 5
+N_CONSISTENCY_MODES = 2
+N_SAGA_STATES = 5
+N_STEP_STATES = 7
+
+REPAIRABLE_AGENT_BITS = (
+    A_SIGMA_RANGE | A_RING_RANGE | A_RING_SIGMA | A_RL_TOKENS | A_FLAGS
+)
+CONTAIN_AGENT_BITS = A_SESSION_REF
+REPAIRABLE_SESSION_BITS = S_NPART
+CONTAIN_VOUCH_BITS = V_ENDPOINT | V_BOND
+
+#: Human-readable catalog (docs/OPERATIONS.md table + /debug/integrity).
+CATALOG: tuple[tuple[str, str, str, int], ...] = (
+    ("agents", "sigma_range", "repair", A_SIGMA_RANGE),
+    ("agents", "ring_range", "repair", A_RING_RANGE),
+    ("agents", "ring_sigma", "repair", A_RING_SIGMA),
+    ("agents", "rl_tokens", "repair", A_RL_TOKENS),
+    ("agents", "flags", "repair", A_FLAGS),
+    ("agents", "session_ref", "contain", A_SESSION_REF),
+    ("sessions", "state_code", "restore", S_STATE_CODE),
+    ("sessions", "mode_code", "restore", S_MODE_CODE),
+    ("sessions", "n_participants", "repair", S_NPART),
+    ("sessions", "timestamps", "restore", S_TIME),
+    ("vouches", "endpoint", "contain", V_ENDPOINT),
+    ("vouches", "bond", "contain", V_BOND),
+    ("vouches", "escrow_conservation", "restore", V_ESCROW),
+    ("sagas", "state_code", "restore", G_STATE),
+    ("sagas", "cursor", "restore", G_CURSOR),
+    ("sagas", "n_steps", "restore", G_NSTEPS),
+    ("sagas", "step_state", "restore", G_STEP_STATE),
+    ("elevations", "range", "contain", E_RANGE),
+    ("logs", "cursor", "restore", L_CURSOR),
+    ("logs", "delta_row", "restore", L_DELTA_ROW),
+    ("logs", "turn_chain", "restore", L_TURN_CHAIN),
+)
+
+
+class IntegrityResult(NamedTuple):
+    """One sanitizer pass: per-row violation bitmasks + global counts.
+
+    Everything stays on device; `total` / `unrepairable` also land in
+    the metrics table so detection rides the existing drain.
+    """
+
+    agent_mask: jnp.ndarray    # u32[N]
+    session_mask: jnp.ndarray  # u32[S]
+    vouch_mask: jnp.ndarray    # u32[E]
+    saga_mask: jnp.ndarray     # u32[G]
+    elev_mask: jnp.ndarray     # u32[M]
+    log_mask: jnp.ndarray      # u32[3]: delta_log, event_log, trace_log
+    total: jnp.ndarray         # i32[] violating rows, all tables
+    unrepairable: jnp.ndarray  # i32[] rows needing checkpoint restore
+    metrics: MetricsTable | None
+
+
+def _finite(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.isfinite(x)
+
+
+def _check_agents(agents, n_sessions: int, ring_bursts, trust) -> tuple:
+    """(mask u32[N], unrepairable-row bool[N])."""
+    allocated = agents.did >= 0
+    from hypervisor_tpu.tables.state import FLAG_ACTIVE
+
+    active = allocated & ((agents.flags & FLAG_ACTIVE) != 0)
+    mask = jnp.zeros(agents.did.shape, jnp.uint32)
+
+    sigma_bad = allocated & ~(
+        _finite(agents.sigma_raw)
+        & _finite(agents.sigma_eff)
+        & (agents.sigma_raw >= 0.0)
+        & (agents.sigma_raw <= 1.0)
+        & (agents.sigma_eff >= 0.0)
+        & (agents.sigma_eff <= 1.0)
+    )
+    mask |= jnp.where(sigma_bad, jnp.uint32(A_SIGMA_RANGE), 0)
+
+    ring = agents.ring.astype(jnp.int32)
+    ring_bad = (ring < 0) | (ring > 3)
+    mask |= jnp.where(ring_bad, jnp.uint32(A_RING_RANGE), 0)
+
+    # A privileged ring (0/1) on an ACTIVE row demands at least the
+    # ring-2 sigma bar — below it nothing in the trust math could have
+    # assigned that ring.
+    priv_bad = (
+        active
+        & ~ring_bad
+        & (ring <= 1)
+        & (agents.sigma_eff < trust.ring2_threshold)
+    )
+    mask |= jnp.where(priv_bad, jnp.uint32(A_RING_SIGMA), 0)
+
+    max_burst = jnp.max(ring_bursts)
+    tokens_bad = allocated & ~(
+        _finite(agents.rl_tokens)
+        & (agents.rl_tokens >= 0.0)
+        & (agents.rl_tokens <= max_burst)
+    )
+    mask |= jnp.where(tokens_bad, jnp.uint32(A_RL_TOKENS), 0)
+
+    flags_bad = (agents.flags & ~KNOWN_FLAGS_MASK) != 0
+    mask |= jnp.where(flags_bad, jnp.uint32(A_FLAGS), 0)
+
+    sess_bad = active & (
+        (agents.session < -1) | (agents.session >= n_sessions)
+    )
+    mask |= jnp.where(sess_bad, jnp.uint32(A_SESSION_REF), 0)
+    return mask, jnp.zeros_like(sess_bad)  # nothing restore-class here
+
+
+def _check_sessions(sessions) -> tuple:
+    live = sessions.sid >= 0
+    mask = jnp.zeros(sessions.sid.shape, jnp.uint32)
+    state_bad = live & (
+        (sessions.state < 0) | (sessions.state >= N_SESSION_STATES)
+    )
+    mask |= jnp.where(state_bad, jnp.uint32(S_STATE_CODE), 0)
+    mode_bad = live & (
+        (sessions.mode < 0) | (sessions.mode >= N_CONSISTENCY_MODES)
+    )
+    mask |= jnp.where(mode_bad, jnp.uint32(S_MODE_CODE), 0)
+    npart_bad = live & (
+        (sessions.n_participants < 0)
+        | (sessions.n_participants > sessions.max_participants)
+    )
+    mask |= jnp.where(npart_bad, jnp.uint32(S_NPART), 0)
+    time_bad = live & ~(
+        _finite(sessions.created_at) & (sessions.max_duration >= 0.0)
+    )
+    mask |= jnp.where(time_bad, jnp.uint32(S_TIME), 0)
+    return mask, state_bad | mode_bad | time_bad
+
+
+def _check_vouches(vouches, n_agents: int) -> tuple:
+    active = vouches.active
+    mask = jnp.zeros(vouches.voucher.shape, jnp.uint32)
+    endpoint_bad = active & (
+        (vouches.voucher < 0)
+        | (vouches.voucher >= n_agents)
+        | (vouches.vouchee < 0)
+        | (vouches.vouchee >= n_agents)
+    )
+    mask |= jnp.where(endpoint_bad, jnp.uint32(V_ENDPOINT), 0)
+    bond_bad = active & ~(
+        _finite(vouches.bond)
+        & (vouches.bond >= 0.0)
+        & (vouches.bond_pct >= 0.0)
+        & (vouches.bond_pct <= 1.0)
+    )
+    mask |= jnp.where(bond_bad, jnp.uint32(V_BOND), 0)
+    # Conservation: per-voucher escrow (sum of active bonds) ≤ cap.
+    # Edges with an out-of-range voucher already flagged above scatter
+    # to a clipped row; exclude them so one bad endpoint doesn't also
+    # read as a conservation break on an innocent agent.
+    safe = jnp.clip(vouches.voucher, 0, n_agents - 1)
+    contrib = jnp.where(
+        active & ~endpoint_bad,
+        jnp.nan_to_num(vouches.bond, nan=0.0, posinf=3.4e38, neginf=0.0),
+        0.0,
+    )
+    escrow = jnp.zeros((n_agents,), jnp.float32).at[safe].add(contrib)
+    escrow_bad = active & ~endpoint_bad & (escrow[safe] > ESCROW_CAP)
+    mask |= jnp.where(escrow_bad, jnp.uint32(V_ESCROW), 0)
+    return mask, escrow_bad
+
+
+def _check_sagas(sagas) -> tuple:
+    live = sagas.session >= 0
+    max_steps = sagas.step_state.shape[1]
+    mask = jnp.zeros(sagas.session.shape, jnp.uint32)
+    state_bad = live & (
+        (sagas.saga_state < 0) | (sagas.saga_state >= N_SAGA_STATES)
+    )
+    mask |= jnp.where(state_bad, jnp.uint32(G_STATE), 0)
+    cursor_bad = live & ((sagas.cursor < 0) | (sagas.cursor > max_steps))
+    mask |= jnp.where(cursor_bad, jnp.uint32(G_CURSOR), 0)
+    nsteps_bad = live & (
+        (sagas.n_steps < 0) | (sagas.n_steps > max_steps)
+    )
+    mask |= jnp.where(nsteps_bad, jnp.uint32(G_NSTEPS), 0)
+    step_bad = live & jnp.any(
+        (sagas.step_state < 0) | (sagas.step_state >= N_STEP_STATES),
+        axis=1,
+    )
+    mask |= jnp.where(step_bad, jnp.uint32(G_STEP_STATE), 0)
+    return mask, state_bad | cursor_bad | nsteps_bad | step_bad
+
+
+def _check_elevations(elevations, n_agents: int) -> tuple:
+    active = elevations.active
+    ring = elevations.granted_ring.astype(jnp.int32)
+    bad = active & (
+        (elevations.agent < 0)
+        | (elevations.agent >= n_agents)
+        | (ring < 0)
+        | (ring > 3)
+    )
+    return jnp.where(bad, jnp.uint32(E_RANGE), 0), jnp.zeros_like(bad)
+
+
+def _check_delta_ring(delta_log, n_sessions: int) -> jnp.ndarray:
+    """u32[] violation bits for the DeltaLog ring (L_* bits).
+
+    The turn-chain pact: within the live ring rows, each session's
+    surviving turns are a contiguous, duplicate-free run (appends stamp
+    monotonically increasing turns and a wrap only ever evicts the
+    OLDEST rows). Contiguity over [min, max] with the right count and
+    the exact arithmetic-series sum pins all three at once — a
+    rewritten, duplicated, or vanished turn breaks at least one.
+    """
+    capacity = delta_log.body.shape[0]
+    cursor = delta_log.cursor
+    bits = jnp.where(cursor < 0, jnp.uint32(L_CURSOR), jnp.uint32(0))
+    live = jnp.arange(capacity, dtype=jnp.int32) < jnp.minimum(
+        jnp.maximum(cursor, 0), capacity
+    )
+    sess = delta_log.session
+    tracked = live & (sess >= 0)
+    row_bad = live & (
+        (sess < -1) | (sess >= n_sessions) | (tracked & (delta_log.turn < 0))
+    )
+    bits |= jnp.where(jnp.any(row_bad), jnp.uint32(L_DELTA_ROW), 0)
+
+    safe = jnp.clip(sess, 0, n_sessions - 1)
+    turn = delta_log.turn
+    big = jnp.int32(2**30)
+    count = jnp.zeros((n_sessions,), jnp.int32).at[safe].add(
+        jnp.where(tracked, 1, 0)
+    )
+    tsum = jnp.zeros((n_sessions,), jnp.int32).at[safe].add(
+        jnp.where(tracked, turn, 0)
+    )
+    tmin = jnp.full((n_sessions,), big, jnp.int32).at[safe].min(
+        jnp.where(tracked, turn, big)
+    )
+    tmax = jnp.full((n_sessions,), -big, jnp.int32).at[safe].max(
+        jnp.where(tracked, turn, -big)
+    )
+    present = count > 0
+    contiguous = count == (tmax - tmin + 1)
+    series = 2 * tsum == (tmin + tmax) * count
+    chain_bad = present & ~(contiguous & series)
+    bits |= jnp.where(jnp.any(chain_bad), jnp.uint32(L_TURN_CHAIN), 0)
+    return bits
+
+
+def check_invariants(
+    agents,
+    sessions,
+    vouches,
+    sagas,
+    elevations,
+    delta_log,
+    event_log,
+    trace_log,
+    ring_bursts: jnp.ndarray,
+    metrics: MetricsTable | None = None,
+    config: HypervisorConfig = DEFAULT_CONFIG,
+) -> IntegrityResult:
+    """ONE fused program re-checking every invariant over all 9
+    tables/rings/logs; pure, no host transfer (see module docstring).
+    """
+    n_agents = agents.did.shape[0]
+    n_sessions = sessions.sid.shape[0]
+
+    agent_mask, agent_restore = _check_agents(
+        agents, n_sessions, ring_bursts, config.trust
+    )
+    session_mask, session_restore = _check_sessions(sessions)
+    vouch_mask, vouch_restore = _check_vouches(vouches, n_agents)
+    saga_mask, saga_restore = _check_sagas(sagas)
+    elev_mask, _ = _check_elevations(elevations, n_agents)
+
+    delta_bits = _check_delta_ring(delta_log, n_sessions)
+    event_bits = jnp.where(
+        event_log.cursor < 0, jnp.uint32(L_CURSOR), jnp.uint32(0)
+    )
+    if trace_log is not None:
+        trace_bits = jnp.where(
+            trace_log.cursor < 0, jnp.uint32(L_CURSOR), jnp.uint32(0)
+        )
+    else:
+        trace_bits = jnp.uint32(0)
+    log_mask = jnp.stack([delta_bits, event_bits, trace_bits])
+
+    def rows(mask):
+        return jnp.sum((mask != 0).astype(jnp.int32))
+
+    total = (
+        rows(agent_mask)
+        + rows(session_mask)
+        + rows(vouch_mask)
+        + rows(saga_mask)
+        + rows(elev_mask)
+        + rows(log_mask)
+    )
+    unrepairable = (
+        jnp.sum(agent_restore.astype(jnp.int32))
+        + jnp.sum(session_restore.astype(jnp.int32))
+        + jnp.sum(vouch_restore.astype(jnp.int32))
+        + jnp.sum(saga_restore.astype(jnp.int32))
+        + rows(log_mask)
+    )
+
+    if metrics is not None:
+        from hypervisor_tpu.observability import metrics as mp
+
+        metrics = counter_inc(metrics, mp.INTEGRITY_CHECKS.index, 1)
+        metrics = counter_inc(
+            metrics, mp.INTEGRITY_VIOLATIONS.index, total.astype(jnp.uint32)
+        )
+        metrics = gauge_set(
+            metrics, mp.INTEGRITY_VIOLATION_ROWS.index, total
+        )
+        metrics = gauge_set(
+            metrics, mp.INTEGRITY_UNREPAIRABLE_ROWS.index, unrepairable
+        )
+
+    return IntegrityResult(
+        agent_mask=agent_mask,
+        session_mask=session_mask,
+        vouch_mask=vouch_mask,
+        saga_mask=saga_mask,
+        elev_mask=elev_mask,
+        log_mask=log_mask,
+        total=total,
+        unrepairable=unrepairable,
+        metrics=metrics,
+    )
+
+
+# ── deterministic in-place repairs (the ladder's first rung) ─────────
+
+
+def repair_agents(
+    agents,
+    mask: jnp.ndarray,
+    ring_bursts: jnp.ndarray,
+    now,
+    quarantine_duration,
+    config: HypervisorConfig = DEFAULT_CONFIG,
+):
+    """Fix every repairable agent violation in ONE program.
+
+    Clamp order matters: sigma first (rings recompute FROM the clamped
+    sigma), then ring, then the token clamp against the repaired ring's
+    burst. Containment rows (A_SESSION_REF) enter quarantine through
+    the existing liability path (`security_ops.quarantine_enter`) so a
+    corrupt membership is frozen read-only, not trusted.
+    """
+    sigma_bad = (mask & A_SIGMA_RANGE) != 0
+    clamp = lambda x: jnp.clip(  # noqa: E731 — local shorthand
+        jnp.nan_to_num(x, nan=0.0, posinf=1.0, neginf=0.0), 0.0, 1.0
+    )
+    sigma_raw = jnp.where(sigma_bad, clamp(agents.sigma_raw), agents.sigma_raw)
+    sigma_eff = jnp.where(sigma_bad, clamp(agents.sigma_eff), agents.sigma_eff)
+
+    ring_bad = (mask & (A_RING_RANGE | A_RING_SIGMA)) != 0
+    recomputed = ring_ops.compute_rings(sigma_eff, False, config.trust)
+    ring = jnp.where(ring_bad, recomputed, agents.ring).astype(jnp.int8)
+
+    flags_bad = (mask & A_FLAGS) != 0
+    flags = jnp.where(
+        flags_bad, agents.flags & KNOWN_FLAGS_MASK, agents.flags
+    ).astype(agents.flags.dtype)
+
+    tokens_bad = (mask & A_RL_TOKENS) != 0
+    burst = ring_bursts[jnp.clip(ring.astype(jnp.int32), 0, 3)]
+    tokens = jnp.where(
+        tokens_bad,
+        jnp.clip(
+            jnp.nan_to_num(agents.rl_tokens, nan=0.0, posinf=0.0, neginf=0.0),
+            0.0,
+            burst,
+        ),
+        agents.rl_tokens,
+    )
+
+    repaired = replace(
+        agents,
+        sigma_raw=sigma_raw,
+        sigma_eff=sigma_eff,
+        flags=flags,
+        rl_tokens=tokens,
+        ring=ring,
+    )
+    contain = (mask & A_SESSION_REF) != 0
+    return security_ops.quarantine_enter(
+        repaired, contain, now, quarantine_duration
+    )
+
+
+def repair_sessions(sessions, mask: jnp.ndarray):
+    """Clamp participant counts (the one repairable session class)."""
+    bad = (mask & S_NPART) != 0
+    clamped = jnp.clip(
+        sessions.n_participants, 0, sessions.max_participants
+    )
+    return replace(
+        sessions,
+        n_participants=jnp.where(bad, clamped, sessions.n_participants),
+    )
+
+
+def repair_vouches(vouches, mask: jnp.ndarray):
+    """Deactivate edges with corrupt endpoints/bonds (containment: the
+    bond is forfeit — a lying edge must not keep liability wired)."""
+    bad = (mask & CONTAIN_VOUCH_BITS) != 0
+    return replace(vouches, active=vouches.active & ~bad)
+
+
+def repair_elevations(elevations, mask: jnp.ndarray):
+    """Retire grants whose holder/ring words are corrupt."""
+    bad = (mask & E_RANGE) != 0
+    return replace(
+        elevations,
+        active=elevations.active & ~bad,
+        agent=jnp.where(bad, -1, elevations.agent),
+    )

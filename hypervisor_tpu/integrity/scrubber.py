@@ -1,0 +1,275 @@
+"""Paced background Merkle scrubber over the DeltaLog chain.
+
+The invariant sanitizer (`integrity.invariants`) catches *semantic*
+damage — values the system's own rules forbid. A flipped bit inside a
+delta body or chain digest is semantically silent: every column still
+looks legal, but the audit chain no longer re-hashes to what was
+committed. This scrubber closes that gap the way disk scrubbers do:
+re-hash the chain in budgeted strips, a little per tick, so a full
+sweep of the log completes on a bounded cadence without ever stalling
+the wave path.
+
+Each tick:
+
+  1. snapshots the audit index (session -> ordered DeltaLog rows + the
+     committed chain head `_chain_seed`) if the previous sweep finished,
+  2. takes the next `budget` links off the sweep worklist — link i of a
+     session verifies sha256(body[row_i] || digest[row_{i-1}]) against
+     the recorded digest[row_i]; a chain's FIRST surviving link verifies
+     from the zero seed only when the session still holds its full
+     history (an evicted prefix leaves that link unverifiable, by
+     design), and the LAST row must equal the committed chain head,
+  3. runs ONE jitted batch over the strip (`ops.merkle.verify_chain_
+     links` — Pallas sha256 on TPU, the pure-XLA path elsewhere; lanes
+     are padded to the static budget so the program compiles once),
+  4. reports mismatching rows; the integrity plane escalates them
+     (a chain that does not re-hash is restore-class damage — there is
+     no in-place repair for a lying audit trail).
+
+Pacing knobs (env, read at construction): `HV_SCRUB_BUDGET` links per
+tick (default 64).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.observability import health as health_plane
+from hypervisor_tpu.ops import merkle as merkle_ops
+
+_VERIFY_LINKS = health_plane.instrument(
+    "scrub_links",
+    jax.jit(merkle_ops.verify_chain_links, static_argnames=("use_pallas",)),
+    static_argnames=("use_pallas",),
+)
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    try:
+        return int(raw) if raw is not None else default
+    except ValueError:
+        return default
+
+
+class MerkleScrubber:
+    """One deployment's chain scrubber (owned by the IntegrityPlane)."""
+
+    def __init__(
+        self,
+        state,
+        budget: Optional[int] = None,
+        use_pallas: bool | None = None,
+    ) -> None:
+        self.state = state
+        self.budget = (
+            budget if budget is not None else _env_int("HV_SCRUB_BUDGET", 64)
+        )
+        if self.budget <= 0:
+            raise ValueError("scrub budget must be positive")
+        self.use_pallas = use_pallas
+        # Sweep worklist: [(row, prev_row, use_seed, session)] links
+        # then [(row, session)] head checks, rebuilt per sweep. Items
+        # are RE-VALIDATED against the live audit index at tick time:
+        # a DeltaLog wrap between ticks recycles archived sessions'
+        # rows, and re-hashing a recycled row against its old parent
+        # would read as corruption on a healthy system.
+        self._links: list[tuple[int, int, bool, int]] = []
+        self._heads: list[tuple[int, int]] = []
+        self._pos = 0
+        self.sweeps_completed = 0
+        self.links_verified = 0
+        self.heads_verified = 0
+        self.stale_skipped = 0
+        self.mismatches = 0
+        self.last_mismatch: Optional[dict] = None
+
+    # -- worklist -------------------------------------------------------
+
+    def _rebuild_worklist(self) -> None:
+        st = self.state
+        links: list[tuple[int, int, bool, int]] = []
+        heads: list[tuple[int, int]] = []
+        for sess in sorted(st._audit_rows):
+            rows = st._audit_rows[sess]
+            if not rows:
+                continue
+            full_history = st._turns.get(sess, 0) == len(rows)
+            if full_history:
+                # First link verifies from the zero chain seed.
+                links.append((rows[0], 0, True, sess))
+            links.extend(
+                (rows[i], rows[i - 1], False, sess)
+                for i in range(1, len(rows))
+            )
+            if st._chain_seed.get(sess) is not None:
+                heads.append((rows[-1], sess))
+        self._links = links
+        self._heads = heads
+        self._pos = 0
+
+    def _fresh_links(self, strip) -> list[tuple[int, int, bool, int]]:
+        """Drop strip lanes the live audit index no longer backs.
+
+        A lane is fresh iff its row is still owned by the session it
+        was snapshotted from AND its parent relationship still holds
+        (prev_row is the immediate predecessor; a seed lane is still
+        the full history's first row). Anything else was recycled by a
+        ring wrap — skipping it is correct (its chain prefix is gone by
+        design); flagging it would restore a healthy system.
+        """
+        st = self.state
+        pos_of: dict[int, dict[int, int]] = {}
+        fresh = []
+        for row, prow, use_seed, sess in strip:
+            rows_now = st._audit_rows.get(sess)
+            if not rows_now:
+                self.stale_skipped += 1
+                continue
+            pos = pos_of.get(sess)
+            if pos is None:
+                pos = pos_of[sess] = {r: i for i, r in enumerate(rows_now)}
+            i = pos.get(row)
+            if i is None:
+                self.stale_skipped += 1
+                continue
+            if use_seed:
+                if i != 0 or st._turns.get(sess, 0) != len(rows_now):
+                    self.stale_skipped += 1
+                    continue
+            elif i == 0 or rows_now[i - 1] != prow:
+                self.stale_skipped += 1
+                continue
+            fresh.append((row, prow, use_seed, sess))
+        return fresh
+
+    @property
+    def sweep_size(self) -> int:
+        return len(self._links) + len(self._heads)
+
+    @property
+    def position(self) -> int:
+        return self._pos
+
+    # -- one paced tick -------------------------------------------------
+
+    def tick(self) -> dict:
+        """Verify the next budgeted strip; returns the tick report.
+
+        `mismatches` in the report carry (kind, row, session?) — the
+        plane escalates any non-empty list to the restore rung.
+        """
+        if self._pos >= self.sweep_size:
+            self._rebuild_worklist()
+        strip = []
+        while self._pos < len(self._links) and len(strip) < self.budget:
+            strip.append(self._links[self._pos])
+            self._pos += 1
+        head_strip = []
+        while (
+            self._pos >= len(self._links)
+            and self._pos < self.sweep_size
+            and len(strip) + len(head_strip) < self.budget
+        ):
+            head_strip.append(self._heads[self._pos - len(self._links)])
+            self._pos += 1
+
+        strip = self._fresh_links(strip)
+        mismatches: list[dict] = []
+        if strip:
+            b = self.budget
+            rows = np.zeros(b, np.int32)
+            prev = np.zeros(b, np.int32)
+            seed = np.zeros(b, bool)
+            valid = np.zeros(b, bool)
+            for i, (row, prow, use_seed, _sess) in enumerate(strip):
+                rows[i], prev[i], seed[i], valid[i] = row, prow, use_seed, True
+            ok = np.asarray(
+                _VERIFY_LINKS(
+                    self.state.delta_log.body,
+                    self.state.delta_log.digest,
+                    jnp.asarray(rows),
+                    jnp.asarray(prev),
+                    jnp.asarray(seed),
+                    jnp.asarray(valid),
+                    use_pallas=self.use_pallas,
+                )
+            )
+            self.links_verified += len(strip)
+            for i, (row, prow, use_seed, _sess) in enumerate(strip):
+                if not ok[i]:
+                    mismatches.append(
+                        {
+                            "kind": "link",
+                            "row": int(row),
+                            "parent_row": None if use_seed else int(prow),
+                        }
+                    )
+        if head_strip:
+            # Heads re-derive from the LIVE index: appends since the
+            # snapshot legitimately move a session's tail and head.
+            st = self.state
+            fresh_heads = []
+            for _row, sess in head_strip:
+                rows_now = st._audit_rows.get(sess)
+                expected = st._chain_seed.get(sess)
+                if not rows_now or expected is None:
+                    self.stale_skipped += 1
+                    continue
+                fresh_heads.append(
+                    (rows_now[-1], np.asarray(expected, np.uint32), sess)
+                )
+            head_strip = fresh_heads
+        if head_strip:
+            idx = jnp.asarray(
+                np.array([r for r, _, _ in head_strip], np.int64)
+            )
+            recorded = np.asarray(self.state.delta_log.digest[idx])
+            self.heads_verified += len(head_strip)
+            for i, (row, expected, sess) in enumerate(head_strip):
+                if not np.array_equal(recorded[i], expected):
+                    mismatches.append(
+                        {"kind": "head", "row": int(row), "session": int(sess)}
+                    )
+        sweep_completed = self._pos >= self.sweep_size and self.sweep_size > 0
+        if sweep_completed:
+            self.sweeps_completed += 1
+        if mismatches:
+            self.mismatches += len(mismatches)
+            self.last_mismatch = mismatches[-1]
+        return {
+            "links": len(strip),
+            "heads": len(head_strip),
+            "mismatches": mismatches,
+            "sweep_completed": sweep_completed,
+            "position": self._pos,
+            "sweep_size": self.sweep_size,
+        }
+
+    def adopt_stats(self, other: "MerkleScrubber") -> None:
+        """Carry another scrubber's cumulative counters (the plane's
+        re-attach after a restore: sweep cursors reset, totals don't)."""
+        self.sweeps_completed = other.sweeps_completed
+        self.links_verified = other.links_verified
+        self.heads_verified = other.heads_verified
+        self.stale_skipped = other.stale_skipped
+        self.mismatches = other.mismatches
+        self.last_mismatch = other.last_mismatch
+
+    def summary(self) -> dict:
+        return {
+            "budget": self.budget,
+            "position": self._pos,
+            "sweep_size": self.sweep_size,
+            "sweeps_completed": self.sweeps_completed,
+            "links_verified": self.links_verified,
+            "heads_verified": self.heads_verified,
+            "stale_skipped": self.stale_skipped,
+            "mismatches": self.mismatches,
+            "last_mismatch": self.last_mismatch,
+        }

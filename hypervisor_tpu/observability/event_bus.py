@@ -85,6 +85,11 @@ class EventType(str, enum.Enum):
     DEGRADED_EXITED = "resilience.degraded_exited"
     DISPATCH_RETRY = "resilience.dispatch_retry"
     WAL_REPLAYED = "resilience.wal_replayed"
+    # Integrity plane (APPEND ONLY, same wire-format rule)
+    INTEGRITY_VIOLATION = "integrity.violation"
+    SCRUB_MISMATCH = "integrity.scrub_mismatch"
+    ROW_QUARANTINED = "integrity.row_quarantined"
+    STATE_RESTORED = "integrity.state_restored"
 
     @property
     def code(self) -> int:

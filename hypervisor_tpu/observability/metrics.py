@@ -289,6 +289,48 @@ WAL_REPLAYED_OPS = REGISTRY.counter(
     "committed WAL records replayed by crash recovery",
 )
 
+# ── integrity plane (sanitizer / scrubber / escalation ladder) ───────
+# The first four are DEVICE-written inside the sanitizer program
+# (`integrity.invariants.check_invariants`) so detection rides the
+# existing drain; the rest are host-incremented on the repair/restore
+# paths (`integrity.plane`).
+INTEGRITY_CHECKS = REGISTRY.counter(
+    "hv_integrity_checks_total",
+    "in-jit invariant sanitizer passes dispatched",
+)
+INTEGRITY_VIOLATIONS = REGISTRY.counter(
+    "hv_integrity_violations_total",
+    "violating rows observed by sanitizer passes (cumulative)",
+)
+INTEGRITY_VIOLATION_ROWS = REGISTRY.gauge(
+    "hv_integrity_violation_rows",
+    "rows violating an invariant at the last sanitizer pass",
+)
+INTEGRITY_UNREPAIRABLE_ROWS = REGISTRY.gauge(
+    "hv_integrity_unrepairable_rows",
+    "restore-class violating rows at the last sanitizer pass",
+)
+INTEGRITY_REPAIRS = REGISTRY.counter(
+    "hv_integrity_repairs_total",
+    "rows repaired in place by the integrity ladder",
+)
+INTEGRITY_ROWS_QUARANTINED = REGISTRY.counter(
+    "hv_integrity_rows_quarantined_total",
+    "agent rows quarantined by integrity containment",
+)
+INTEGRITY_SCRUB_LINKS = REGISTRY.counter(
+    "hv_integrity_scrub_links_total",
+    "DeltaLog chain links + heads re-hashed by the Merkle scrubber",
+)
+INTEGRITY_SCRUB_MISMATCHES = REGISTRY.counter(
+    "hv_integrity_scrub_mismatches_total",
+    "chain links whose recomputed digest diverged from the recorded one",
+)
+INTEGRITY_RESTORES = REGISTRY.counter(
+    "hv_integrity_restores_total",
+    "checkpoint-restore escalations triggered by the integrity ladder",
+)
+
 #: Tables the occupancy accounting names. `metrics` is excluded from the
 #: warn set (its layout is static — always "full"); rings (the three
 #: logs) warn once as they approach their first wrap.

@@ -158,6 +158,52 @@ def verify_chain_digests(
     return jnp.all(eq | ~in_range, axis=0)
 
 
+def verify_chain_links(
+    body: jnp.ndarray,
+    digest: jnp.ndarray,
+    rows: jnp.ndarray,
+    prev_rows: jnp.ndarray,
+    use_seed: jnp.ndarray,
+    valid: jnp.ndarray,
+    use_pallas: bool | None = None,
+) -> jnp.ndarray:
+    """Re-hash individual chain links against their recorded digests.
+
+    The scrubber's primitive (`integrity.scrubber.MerkleScrubber`):
+    each lane names one DeltaLog row and its parent — the previous row
+    of the same session's chain, or the zero seed for a chain's first
+    link — and the lane passes iff sha256(body[row] || parent) equals
+    the recorded digest[row]. Unlike `verify_chain_digests` this takes
+    arbitrary (row, parent) pairs, so a budgeted strip can re-verify
+    any slice of any session's chain without walking it from turn 0.
+
+    Args:
+      body: u32[C, BODY_WORDS] the DeltaLog body column.
+      digest: u32[C, 8] the DeltaLog digest column.
+      rows: i32[B] ring rows to verify.
+      prev_rows: i32[B] parent rows (ignored where `use_seed`).
+      use_seed: bool[B] lanes whose parent is the zero chain seed.
+      valid: bool[B] padding mask — invalid lanes always pass.
+
+    Returns:
+      bool[B] — True where the link's digest matches (or lane invalid).
+    """
+    b = rows.shape[0]
+    parent = jnp.where(
+        use_seed[:, None],
+        jnp.zeros((b, 8), jnp.uint32),
+        digest[jnp.clip(prev_rows, 0, digest.shape[0] - 1)],
+    )
+    tail = jnp.broadcast_to(
+        jnp.asarray(_CHAIN_TAIL, jnp.uint32), (b, _CHAIN_TAIL.shape[0])
+    )
+    safe_rows = jnp.clip(rows, 0, body.shape[0] - 1)
+    msg = jnp.concatenate([body[safe_rows], parent, tail], axis=1)
+    recomputed = sha256_blocks_dispatch(msg, 2, use_pallas)
+    ok = jnp.all(recomputed == digest[safe_rows], axis=-1)
+    return ok | ~valid
+
+
 def pack_delta_bodies(
     session: np.ndarray,
     turn: np.ndarray,

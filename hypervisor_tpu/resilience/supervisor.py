@@ -143,6 +143,8 @@ class Supervisor:
         self.last_checkpoint: Optional[dict] = None
         self.checkpoints_skipped = 0
         self.last_checkpoint_error: Optional[str] = None
+        self.state_restores = 0
+        self.last_restore: Optional[dict] = None
         self._since_checkpoint = 0
         # Resume the step counter past whatever an earlier life wrote
         # (markerless dirs included — a torn save's slot is burned, not
@@ -373,6 +375,73 @@ class Supervisor:
         }
         return target
 
+    # -- restore escalation (the integrity ladder's last rung) -----------
+
+    def can_restore(self) -> bool:
+        """True when the restore rung is wired: a checkpoint_dir to
+        recover from and a journal whose committed suffix can replay."""
+        return bool(self.checkpoint_dir) and self.state.journal is not None
+
+    def restore_state(self, reason: str):
+        """Rebuild the state from the newest durable checkpoint + the
+        committed WAL suffix and take over supervising the result.
+
+        The integrity plane escalates here when it finds restore-class
+        corruption (chain mismatch, FSM-code damage, conservation
+        break): the live tables can no longer be trusted, but the
+        checkpoint + committed WAL are exactly the transitions the
+        system promised — recovery lands bit-identical to an
+        uninterrupted history at the same committed prefix.
+
+        The supervisor rebinds itself (and any attached IntegrityPlane)
+        onto the recovered state; the fault injector carries over (a
+        chaos drill keeps its schedule), degraded mode clears (the
+        restored plane starts clean). Callers holding the OLD state
+        object must re-read `supervisor.state`. Returns the new state.
+        """
+        from hypervisor_tpu.resilience.recovery import recover
+
+        if not self.can_restore():
+            raise RuntimeError(
+                "restore_state needs checkpoint_dir and an attached WAL"
+            )
+        old = self.state
+        journal = old.journal
+        wal_path = journal.path
+        journal.flush()
+        journal.close()
+        old.journal = None
+        t0 = time.perf_counter()
+        state, report = recover(
+            self.checkpoint_dir, wal_path, config=old.config,
+            attach_journal=True,
+        )
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        # Take over the new state: supervisor, health listener, chaos
+        # schedule, and the integrity plane all move across.
+        state.resilience = self
+        state.fault_injector = old.fault_injector
+        self.state = state
+        state.health.add_listener(self._on_health_event)
+        plane = getattr(old, "integrity", None)
+        if plane is not None:
+            plane.attach(state)
+        with self._lock:
+            self.state_restores += 1
+            self._fail_streak = 0
+            self._clean_streak = 0
+            self.last_restore = {
+                "reason": reason,
+                "at": time.time(),
+                "wall_ms": round(wall_ms, 3),
+                **report,
+            }
+        state.health.emit_event(
+            "state_restored",
+            {"reason": reason, "wall_ms": round(wall_ms, 3), **report},
+        )
+        return state
+
     def _prune_checkpoints(self, keep: int) -> None:
         """Delete the oldest durable step directories beyond `keep`
         (markerless dirs — in-flight or torn saves — are left for the
@@ -434,6 +503,10 @@ class Supervisor:
                 "checkpoint": self.last_checkpoint,
                 "checkpoints_skipped": self.checkpoints_skipped,
                 "last_checkpoint_error": self.last_checkpoint_error,
+                "restores": {
+                    "count": self.state_restores,
+                    "last": self.last_restore,
+                },
             }
         journal = self.state.journal
         summary["journal"] = journal.status() if journal is not None else None

@@ -366,6 +366,12 @@ class HypervisorState:
         self.fault_injector = None
         self.degraded_policy = None
         self.resilience = None
+        # State-integrity plane (opt-in, `hypervisor_tpu.integrity`):
+        # attaching an IntegrityPlane samples the in-jit invariant
+        # sanitizer at the dispatch gates below, paces the Merkle
+        # scrubber, and walks the repair/containment/restore ladder
+        # when the drain surfaces violations.
+        self.integrity = None
         # WAL watermark carried by a restored checkpoint (`runtime.
         # checkpoint._rebuild`): recovery replays records PAST this seq.
         self._restored_wal_seq: Optional[int] = None
@@ -406,6 +412,21 @@ class HypervisorState:
         inj = self.fault_injector
         if inj is not None:
             inj.on_dispatch(stage)
+
+    def _predispatch(self, stage: str) -> None:
+        """The full dispatch-site gate: chaos raise/stall first (still
+        pre-mutation, retry-safe), then scheduled REAL corruption
+        (`testing.chaos.InjectedCorruption` — silent table damage, the
+        integrity plane's reason to exist), then the integrity plane's
+        cadence hook (sampled sanitizer dispatch + pending-repair
+        settlement, `integrity.plane.IntegrityPlane.on_dispatch`)."""
+        self._chaos(stage)
+        inj = self.fault_injector
+        if inj is not None and getattr(inj, "has_pending_corruptions", False):
+            inj.apply_due_corruptions(self)
+        plane = self.integrity
+        if plane is not None:
+            plane.on_dispatch(stage)
 
     def _shed_gate(self) -> None:
         """Degraded-mode admission shedding (`resilience.policy`): new
@@ -623,7 +644,7 @@ class HypervisorState:
         checkpoint cadence instead (docs/OPERATIONS.md "Recovery &
         fault domains").
         """
-        self._chaos("governance_wave")
+        self._predispatch("governance_wave")
         if mesh is not None or self.journal is None:
             return self._governance_wave_impl(
                 session_slots, dids, agent_sessions, sigma_raw,
@@ -1130,7 +1151,7 @@ class HypervisorState:
         raise leaves the staging queue intact, so the supervisor's
         retry flushes the same wave.
         """
-        self._chaos("admission_wave")
+        self._predispatch("admission_wave")
         with self._enqueue_lock, self._journal("flush_joins", now=float(now)):
             n, sigma, agent_slots, session_slots, trustworthy = (
                 self._queue.harvest()
@@ -1344,7 +1365,7 @@ class HypervisorState:
         in the VouchTable, and recomputes rings from the post-slash
         sigma. Returns {"slashed": [...], "clipped": [...]} agent slots.
         """
-        self._chaos("slash_cascade")
+        self._predispatch("slash_cascade")
         with self._journal(
             "apply_slash",
             session_slot=int(session_slot),
@@ -1712,7 +1733,7 @@ class HypervisorState:
         (e.g. fan-out group fronts settled by `fanout_settle` in the
         same round) are left untouched by the tick.
         """
-        self._chaos("saga_round")
+        self._predispatch("saga_round")
         with self._journal(
             "saga_round",
             exec={int(k): bool(v) for k, v in (exec_outcomes or {}).items()},
@@ -1920,7 +1941,7 @@ class HypervisorState:
         group to one power-of-two block length with `valid=False`
         lanes, and scatter the lanes back to request order.
         """
-        self._chaos("gateway_wave")
+        self._predispatch("gateway_wave")
         self._check_action_slots(slots)
         if mesh is not None:
             return self._check_actions_wave_sharded(
@@ -2641,7 +2662,7 @@ class HypervisorState:
         k = len(slots)
         if k == 0:
             return np.zeros((0, 8), np.uint32)
-        self._chaos("terminate_wave")
+        self._predispatch("terminate_wave")
         with self._journal(
             "terminate_sessions",
             session_slots=[int(s) for s in slots],
@@ -2784,6 +2805,12 @@ class HypervisorState:
             )
         )
         self.health.update_occupancy(snap)
+        # Integrity-plane detection closes here: the sanitizer's counts
+        # rode THIS drain (no extra device_get) — a nonzero violation
+        # gauge marks the plane dirty, and the next dispatch gate (or
+        # an explicit sanitize()) walks the repair/restore ladder.
+        if self.integrity is not None:
+            self.integrity.observe_snapshot(snap)
         return snap
 
     def metrics_prometheus(self) -> str:
@@ -2834,6 +2861,9 @@ class HypervisorState:
             "occupancy": monitor["occupancy"],
             "compiles": health_plane.compile_summary(last=8),
             "stages": stages,
+            # Integrity panel (hv_top renders this block): sanitizer
+            # cadence/violations, scrub progress, last repair/restore.
+            "integrity": self.integrity_summary(),
         }
 
     def memory_summary(self) -> dict:
@@ -2857,6 +2887,15 @@ class HypervisorState:
     def compile_summary(self) -> dict:
         """The `GET /debug/compiles` payload (process-global watch)."""
         return health_plane.compile_summary()
+
+    def integrity_summary(self) -> dict:
+        """The `GET /debug/integrity` payload: sanitizer cadence,
+        violation/repair/restore accounting, scrub progress, and the
+        invariant catalog — the bare plane state when no
+        `integrity.IntegrityPlane` is attached."""
+        if self.integrity is not None:
+            return self.integrity.summary()
+        return {"enabled": False}
 
     def resilience_summary(self) -> dict:
         """The `GET /debug/resilience` payload: supervisor mode +

@@ -21,6 +21,16 @@ FLAG_QUARANTINED = 1 << 1
 FLAG_BREAKER_TRIPPED = 1 << 2
 FLAG_BLACKLISTED = 1 << 3
 FLAG_PROBATIONARY = 1 << 4
+#: Every defined flag bit — the integrity sanitizer flags (and repairs
+#: by masking) any flags word carrying bits outside this set, so keep
+#: it in sync when adding FLAG_* values.
+KNOWN_FLAGS_MASK = (
+    FLAG_ACTIVE
+    | FLAG_QUARANTINED
+    | FLAG_BREAKER_TRIPPED
+    | FLAG_BLACKLISTED
+    | FLAG_PROBATIONARY
+)
 
 
 # AgentTable packed-block column indices (see struct.table "packed").

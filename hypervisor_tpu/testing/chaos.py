@@ -149,6 +149,40 @@ class ChaosExecutorFactory:
 
 
 @dataclass(frozen=True)
+class InjectedCorruption:
+    """One REAL silent-data-corruption event against the device tables.
+
+    Unlike every other fault here, this does not raise or stall: it
+    flips bits / rewrites rows in the HBM-resident state, exactly the
+    damage the integrity plane (`hypervisor_tpu.integrity`) exists to
+    catch. Applied at the dispatch gate once the injector's armed
+    dispatch counter reaches `at_dispatch` (1-based), BEFORE the wave
+    runs, from a dedicated rng stream — adding corruptions to a plan
+    never perturbs the fault/hang/drain-loss schedule of its seed.
+
+    Kinds:
+      * ``bit_flip``   — flip a high/exponent bit of one word in the
+        named `table` ("agents" sigma, "vouches" bond, or a
+        "delta_log" body word), chosen seeded. Detectable bits on
+        purpose: the drill validates the detection machinery; a
+        mantissa flip that stays in-range is invisible to semantic
+        checks by construction (only the scrubber's hash sees those,
+        which is why delta_log targets flip ANY bit).
+      * ``row_rewrite`` — rewrite one row of the named `table` with
+        out-of-band garbage (several violation classes at once).
+      * ``chain_tamper`` — flip one random bit of a recorded DeltaLog
+        chain digest (the Merkle scrubber's restore-class case).
+
+    A corruption whose target table holds no eligible row yet stays
+    pending and retries at the next gate.
+    """
+
+    kind: str                    # bit_flip | row_rewrite | chain_tamper
+    at_dispatch: int = 1
+    table: str = "agents"        # bit_flip / row_rewrite target
+
+
+@dataclass(frozen=True)
 class WaveChaosPlan:
     """Dispatch-interposer fault mix; rates are per-dispatch
     probabilities in [0, 1], drawn from one seeded stream in dispatch
@@ -156,17 +190,30 @@ class WaveChaosPlan:
 
     `stages` narrows injection to named dispatch sites (the stage
     vocabulary of `observability.metrics.STAGES` plus
-    `"metrics_drain"`); None hits every site. `corrupt_rate` fires only
-    on drain sites — a corrupt drain IS device loss from the host's
-    point of view, so it raises `InjectedDeviceLoss`.
+    `"metrics_drain"`); None hits every site. `drain_loss_rate` fires
+    only on drain sites — a corrupt/failed drain IS device loss from
+    the host's point of view, so it raises `InjectedDeviceLoss`.
+    (`corrupt_rate` is the pre-rename alias for the same knob, kept so
+    committed plans and seeds replay identically: it was never table
+    corruption, only drain loss — REAL corruption is the separate
+    seeded `corruptions` schedule, `InjectedCorruption`, drawn from its
+    own rng stream so a seed's fault schedule is reproducible across
+    the rename and across adding/removing corruption events.)
     """
 
     seed: int = 0
     fail_rate: float = 0.0
     hang_rate: float = 0.0
-    corrupt_rate: float = 0.0
+    drain_loss_rate: float = 0.0
+    corrupt_rate: float = 0.0     # deprecated alias for drain_loss_rate
     hang_seconds: float = 0.05    # host stall simulating a wedged wave
     stages: Optional[tuple[str, ...]] = None
+    corruptions: tuple[InjectedCorruption, ...] = ()
+
+    @property
+    def effective_drain_loss_rate(self) -> float:
+        """`drain_loss_rate`, honouring the deprecated alias."""
+        return self.drain_loss_rate or self.corrupt_rate
 
 
 class WaveChaosInjector:
@@ -181,12 +228,20 @@ class WaveChaosInjector:
     def __init__(self, plan: WaveChaosPlan, sleep=time.sleep) -> None:
         self.plan = plan
         self._rng = random.Random(plan.seed)
+        # Dedicated corruption stream: drawing targets here never
+        # advances the fault/hang schedule above, so seed S replays the
+        # same raises with or without a corruption list.
+        self._corrupt_rng = random.Random(plan.seed ^ 0x5DC0FFEE)
         self._sleep = sleep
         self.dispatches = 0
         self.faults = 0
         self.hangs = 0
         self.losses = 0
         self.by_stage: dict[str, dict] = {}
+        self._pending_corruptions = sorted(
+            plan.corruptions, key=lambda c: c.at_dispatch
+        )
+        self.corruptions_applied: list[dict] = []
 
     def _armed(self, stage: str) -> bool:
         return self.plan.stages is None or stage in self.plan.stages
@@ -219,20 +274,173 @@ class WaveChaosInjector:
 
     def on_drain(self, stage: str = "metrics_drain") -> None:
         """Consult the plan before a host drain (`device_get` site); a
-        corrupt drain surfaces as device loss."""
+        failed/corrupt drain surfaces as device loss (the recovery
+        path's problem, not the integrity plane's — real TABLE
+        corruption is `InjectedCorruption`)."""
         if not self._armed(stage):
             return
         self.dispatches += 1
         per = self._per(stage)
         per["dispatches"] += 1
         roll = self._rng.random()
-        if roll < self.plan.corrupt_rate:
+        if roll < self.plan.effective_drain_loss_rate:
             self.losses += 1
             per["losses"] += 1
             raise InjectedDeviceLoss(
                 f"injected corrupt {stage} (simulated preemption, seed "
                 f"{self.plan.seed})"
             )
+
+    # ── real table corruption (silent-data-corruption drills) ────────
+
+    @property
+    def has_pending_corruptions(self) -> bool:
+        return bool(self._pending_corruptions)
+
+    def apply_due_corruptions(self, state) -> list[dict]:
+        """Apply every scheduled corruption whose dispatch has come.
+
+        Called by the state's dispatch gate right after `on_dispatch`
+        (so `self.dispatches` counts this gate). Mutates the device
+        tables IN PLACE — that is the point: the hardware lied, and
+        nothing raised. Returns the records applied this call.
+        """
+        applied: list[dict] = []
+        while (
+            self._pending_corruptions
+            and self.dispatches >= self._pending_corruptions[0].at_dispatch
+        ):
+            c = self._pending_corruptions[0]
+            record = self._apply_one(state, c)
+            if record is None:
+                break  # no eligible target yet; retry at the next gate
+            self._pending_corruptions.pop(0)
+            record.update(
+                kind=c.kind, table=c.table, at_dispatch=c.at_dispatch,
+                applied_at_dispatch=self.dispatches,
+            )
+            self.corruptions_applied.append(record)
+            applied.append(record)
+        return applied
+
+    def _apply_one(self, state, c: InjectedCorruption) -> Optional[dict]:
+        import numpy as np
+        import jax.numpy as jnp
+
+        from hypervisor_tpu.tables.struct import replace
+
+        rng = self._corrupt_rng
+        if c.kind == "bit_flip":
+            if c.table == "agents":
+                rows = np.nonzero(np.asarray(state.agents.did) >= 0)[0]
+                if not len(rows):
+                    return None
+                row = int(rows[rng.randrange(len(rows))])
+                from hypervisor_tpu.tables.state import AF32_SIGMA_EFF
+
+                block = np.array(state.agents.f32, copy=True)
+                word = block[:, AF32_SIGMA_EFF].view(np.uint32)
+                # Exponent bit 30: guaranteed out of [0, 1] for any
+                # stored sigma, so the semantic sanitizer must see it.
+                word[row] ^= np.uint32(1 << 30)
+                state.agents = replace(state.agents, f32=jnp.asarray(block))
+                return {"row": row, "column": "sigma_eff", "bit": 30}
+            if c.table == "vouches":
+                rows = np.nonzero(np.asarray(state.vouches.active))[0]
+                if not len(rows):
+                    return None
+                row = int(rows[rng.randrange(len(rows))])
+                col = np.array(state.vouches.bond, copy=True)
+                col.view(np.uint32)[row] ^= np.uint32(1 << 30)
+                state.vouches = replace(state.vouches, bond=jnp.asarray(col))
+                return {"row": row, "column": "bond", "bit": 30}
+            if c.table == "delta_log":
+                live = int(np.asarray(state.delta_log.cursor))
+                cap = state.delta_log.body.shape[0]
+                if live <= 0:
+                    return None
+                row = rng.randrange(min(live, cap))
+                word = rng.randrange(state.delta_log.body.shape[1])
+                bit = rng.randrange(32)
+                body = np.array(state.delta_log.body, copy=True)
+                body[row, word] ^= np.uint32(1 << bit)
+                state.delta_log = replace(
+                    state.delta_log, body=jnp.asarray(body)
+                )
+                return {"row": row, "column": f"body[{word}]", "bit": bit}
+            raise ValueError(f"bit_flip target {c.table!r} not supported")
+        if c.kind == "row_rewrite":
+            if c.table == "agents":
+                rows = np.nonzero(np.asarray(state.agents.did) >= 0)[0]
+                if not len(rows):
+                    return None
+                row = int(rows[rng.randrange(len(rows))])
+                from hypervisor_tpu.tables.state import (
+                    AF32_RL_TOKENS,
+                    AF32_SIGMA_EFF,
+                    AF32_SIGMA_RAW,
+                    AI32_FLAGS,
+                )
+
+                f32 = np.array(state.agents.f32, copy=True)
+                i32 = np.array(state.agents.i32, copy=True)
+                ring = np.array(state.agents.ring, copy=True)
+                f32[row, AF32_SIGMA_RAW] = -3.5
+                f32[row, AF32_SIGMA_EFF] = 7.25
+                f32[row, AF32_RL_TOKENS] = -50.0
+                i32[row, AI32_FLAGS] |= np.int32(1 << 13)
+                ring[row] = np.int8(101)
+                state.agents = replace(
+                    state.agents,
+                    f32=jnp.asarray(f32),
+                    i32=jnp.asarray(i32),
+                    ring=jnp.asarray(ring),
+                )
+                return {"row": row, "column": "sigma/flags/ring/tokens"}
+            if c.table == "sessions":
+                rows = np.nonzero(np.asarray(state.sessions.sid) >= 0)[0]
+                if not len(rows):
+                    return None
+                row = int(rows[rng.randrange(len(rows))])
+                from hypervisor_tpu.tables.state import SI32_STATE
+
+                i32 = np.array(state.sessions.i32, copy=True)
+                i32[row, SI32_STATE] = np.int32(99)
+                state.sessions = replace(state.sessions, i32=jnp.asarray(i32))
+                return {"row": row, "column": "state"}
+            if c.table == "vouches":
+                rows = np.nonzero(np.asarray(state.vouches.active))[0]
+                if not len(rows):
+                    return None
+                row = int(rows[rng.randrange(len(rows))])
+                voucher = np.array(state.vouches.voucher, copy=True)
+                bond = np.array(state.vouches.bond, copy=True)
+                voucher[row] = np.int32(
+                    state.agents.did.shape[0] + 12345
+                )
+                bond[row] = np.float32(-1.0)
+                state.vouches = replace(
+                    state.vouches,
+                    voucher=jnp.asarray(voucher),
+                    bond=jnp.asarray(bond),
+                )
+                return {"row": row, "column": "voucher/bond"}
+            raise ValueError(f"row_rewrite target {c.table!r} not supported")
+        if c.kind == "chain_tamper":
+            live = int(np.asarray(state.delta_log.cursor))
+            cap = state.delta_log.digest.shape[0]
+            if live <= 0:
+                return None
+            row = rng.randrange(min(live, cap))
+            word = rng.randrange(8)
+            bit = rng.randrange(32)
+            digest = np.array(state.delta_log.digest, copy=True)
+            digest[row, word] ^= np.uint32(1 << bit)
+            state.delta_log = replace(
+                state.delta_log, digest=jnp.asarray(digest)
+            )
+            return {"row": row, "column": f"digest[{word}]", "bit": bit}
+        raise ValueError(f"unknown corruption kind {c.kind!r}")
 
     def report(self) -> dict:
         return {
@@ -241,5 +449,7 @@ class WaveChaosInjector:
             "faults": self.faults,
             "hangs": self.hangs,
             "losses": self.losses,
+            "corruptions_applied": list(self.corruptions_applied),
+            "corruptions_pending": len(self._pending_corruptions),
             "by_stage": dict(self.by_stage),
         }

@@ -16,12 +16,17 @@
 #      are nonzero after one wave; two IDENTICAL dispatches report
 #      exactly zero recompiles while a batch-shape change reports
 #      exactly one and names the changed argument,
-#   5. a crash-recovery smoke gate — drive real traffic in a child
+#   5. an integrity smoke gate — a clean sampled run must report ZERO
+#      invariant violations and zero scrub mismatches (no false
+#      positives), and one injected sigma bit-flip must be detected at
+#      the drain and repaired in place with the Merkle chain heads
+#      untouched,
+#   6. a crash-recovery smoke gate — drive real traffic in a child
 #      process with a WAL + watermarked checkpoint, SIGKILL it
 #      mid-flight, recover from checkpoint + WAL replay, and assert
 #      the Merkle chain heads and /metrics session counts match the
 #      pre-kill host mirror (scripts/crash_recovery_smoke.py),
-#   6. the perf-regression gate — benchmarks/regression.py rebuilds
+#   7. the perf-regression gate — benchmarks/regression.py rebuilds
 #      BENCH_trajectory.json from the committed BENCH_r*.json history
 #      and fails on any per-bench p50 above its comparable baseline's
 #      tolerance band (cpu tolerance is wide on purpose: non-flaky).
@@ -191,6 +196,76 @@ print(
 PY
 health_rc=$?
 
+echo "── integrity smoke gate ──"
+JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+
+from hypervisor_tpu.integrity import IntegrityPlane
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.observability import metrics as mp
+from hypervisor_tpu.state import HypervisorState
+from hypervisor_tpu.testing.chaos import (
+    InjectedCorruption, WaveChaosInjector, WaveChaosPlan,
+)
+
+
+def drive(st, rounds, base=0):
+    for r in range(base, base + rounds):
+        slots = st.create_sessions_batch(
+            [f"ismoke{r}:{i}" for i in range(2)],
+            SessionConfig(min_sigma_eff=0.0),
+        )
+        st.run_governance_wave(
+            slots, [f"did:ismoke{r}:{i}" for i in range(2)], slots.copy(),
+            np.full(2, 0.8, np.float32), np.zeros((1, 2, 16), np.uint32),
+            now=float(r),
+        )
+
+
+# 1. clean run: sampling on at every dispatch + scrubbing, ZERO
+#    violations (the no-false-positives bar).
+st = HypervisorState()
+plane = IntegrityPlane(st, every=1, scrub_every=2, scrub_budget=64)
+drive(st, 8)
+snap = st.metrics_snapshot()
+assert snap.counter(mp.INTEGRITY_CHECKS) >= 8, "sanitizer never sampled"
+assert snap.counter(mp.INTEGRITY_VIOLATIONS) == 0, "clean run flagged rows"
+assert plane.scrubber.mismatches == 0, "clean chain flagged by scrubber"
+assert "hv_integrity_checks_total" in snap.to_prometheus()
+heads_before = {
+    s: tuple(int(w) for w in v) for s, v in st._chain_seed.items()
+}
+
+# 2. one injected bit-flip: detected at the drain, repaired in place at
+#    the next gate, chain heads untouched.
+st.fault_injector = WaveChaosInjector(WaveChaosPlan(
+    seed=5,
+    corruptions=(InjectedCorruption("bit_flip", at_dispatch=1,
+                                    table="agents"),),
+))
+drive(st, 1, base=8)
+assert st.fault_injector.corruptions_applied, "corruption never landed"
+snap = st.metrics_snapshot()
+assert snap.gauge(mp.INTEGRITY_VIOLATION_ROWS) >= 1, "bit flip undetected"
+st.fault_injector = None
+drive(st, 1, base=9)     # the next gate settles the pending damage
+snap = st.metrics_snapshot()
+assert snap.counter(mp.INTEGRITY_REPAIRS) >= 1, "bit flip not repaired"
+assert plane.sanitize()["total"] == 0, "violations survived the repair"
+heads_after = {
+    s: tuple(int(w) for w in v)
+    for s, v in st._chain_seed.items() if s in heads_before
+}
+assert heads_after == heads_before, "repair disturbed the Merkle chains"
+print(
+    "integrity plane OK: clean run zero violations "
+    f"({snap.counter(mp.INTEGRITY_CHECKS)} checks, "
+    f"{plane.scrubber.links_verified} links scrubbed), injected bit-flip "
+    "detected + repaired with matching chain heads"
+)
+PY
+integrity_rc=$?
+
 echo "── crash-recovery smoke gate ──"
 JAX_PLATFORMS=cpu python scripts/crash_recovery_smoke.py
 crash_rc=$?
@@ -214,6 +289,10 @@ fi
 if [ "$health_rc" -ne 0 ]; then
     echo "health smoke check FAILED (rc=$health_rc)" >&2
     exit "$health_rc"
+fi
+if [ "$integrity_rc" -ne 0 ]; then
+    echo "integrity smoke gate FAILED (rc=$integrity_rc)" >&2
+    exit "$integrity_rc"
 fi
 if [ "$crash_rc" -ne 0 ]; then
     echo "crash-recovery smoke gate FAILED (rc=$crash_rc)" >&2

@@ -185,10 +185,12 @@ class TestHTTPTransport:
         # sibling (/actions/check-wave), the Prometheus scrape
         # (/metrics), the flight recorder (/trace/{session_id} +
         # /debug/flight), the health plane (/debug/health,
-        # /debug/memory, /debug/compiles), and the resilience plane
-        # (/debug/resilience): 37 routes.
-        assert len(ROUTES) == 37
+        # /debug/memory, /debug/compiles), the resilience plane
+        # (/debug/resilience), and the integrity plane
+        # (/debug/integrity): 38 routes.
+        assert len(ROUTES) == 38
         assert any(path == "/debug/resilience" for _, path, _, _ in ROUTES)
+        assert any(path == "/debug/integrity" for _, path, _, _ in ROUTES)
         assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
         assert any(
             path == "/api/v1/security/quarantines" for _, path, _, _ in ROUTES

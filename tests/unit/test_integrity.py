@@ -1,0 +1,736 @@
+"""State-integrity plane: sanitizer, scrubber, corruption chaos, ladder.
+
+The headline is the corruption property: every `InjectedCorruption`
+class (bit-flip, row rewrite, chain-link tamper) must be detected
+within K waves, then repaired in place or restored via `recover()`,
+and under the restore ladder the final device tables + Merkle chain
+heads must be bit-identical to an uninterrupted oracle run of the same
+workload. A clean multi-hundred-wave run must report ZERO violations
+(no false positives).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu.config import HypervisorConfig, TableCapacity
+from hypervisor_tpu.integrity import (
+    CATALOG,
+    IntegrityError,
+    IntegrityPlane,
+    MerkleScrubber,
+)
+from hypervisor_tpu.integrity import invariants as inv
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.observability import EventType
+from hypervisor_tpu.observability import metrics as mp
+from hypervisor_tpu.resilience import Supervisor, WriteAheadLog
+from hypervisor_tpu.runtime.checkpoint import state_arrays
+from hypervisor_tpu.state import HypervisorState
+from hypervisor_tpu.tables.state import FLAG_QUARANTINED
+from hypervisor_tpu.testing.chaos import (
+    InjectedCorruption,
+    InjectedWaveFault,
+    WaveChaosInjector,
+    WaveChaosPlan,
+)
+
+SMALL = HypervisorConfig(
+    capacity=TableCapacity(
+        max_agents=512,  # governance waves bump-allocate fresh rows
+        max_sessions=512,
+        max_vouch_edges=64,
+        max_sagas=16,
+        max_steps_per_saga=8,
+        max_elevations=16,
+        delta_log_capacity=2048,
+        event_log_capacity=128,
+        trace_log_capacity=128,
+    )
+)
+
+
+def drive_waves(st, rounds, base=0, lanes=2):
+    for r in range(base, base + rounds):
+        slots = st.create_sessions_batch(
+            [f"w{r}:{i}" for i in range(lanes)],
+            SessionConfig(min_sigma_eff=0.0),
+        )
+        st.run_governance_wave(
+            slots, [f"did:w{r}:{i}" for i in range(lanes)], slots.copy(),
+            np.full(lanes, 0.8, np.float32),
+            np.zeros((1, lanes, 16), np.uint32), now=float(r),
+        )
+
+
+def chain_heads(st):
+    return {s: tuple(int(w) for w in v) for s, v in st._chain_seed.items()}
+
+
+def assert_bit_identical(a, b):
+    for key, col in state_arrays(a).items():
+        np.testing.assert_array_equal(
+            col, state_arrays(b)[key], err_msg=f"column {key} diverged"
+        )
+    assert chain_heads(a) == chain_heads(b), "Merkle chain heads diverged"
+
+
+# ── catalog sanity ───────────────────────────────────────────────────
+
+
+class TestCatalog:
+    def test_bits_unique_per_table_and_classes_valid(self):
+        seen: dict[str, int] = {}
+        for table, name, klass, bit in CATALOG:
+            assert klass in ("repair", "contain", "restore"), (table, name)
+            assert bit & (bit - 1) == 0, "violation bits are single bits"
+            assert not seen.get(table, 0) & bit, f"{table}.{name} bit reused"
+            seen[table] = seen.get(table, 0) | bit
+
+
+# ── clean runs: no false positives ───────────────────────────────────
+
+
+class TestCleanRuns:
+    def test_200_clean_waves_report_zero_violations(self):
+        """Sampling on at every dispatch: a long mixed clean workload
+        must never trip a single invariant (the acceptance bar for
+        false positives)."""
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=1, scrub_every=4, scrub_budget=32)
+        drive_waves(st, 200)
+        snap = st.metrics_snapshot()
+        assert snap.counter(mp.INTEGRITY_CHECKS) >= 200
+        assert snap.counter(mp.INTEGRITY_VIOLATIONS) == 0
+        assert snap.gauge(mp.INTEGRITY_VIOLATION_ROWS) == 0
+        assert plane.scrubber.mismatches == 0
+        assert plane.sanitize()["total"] == 0
+
+    def test_mixed_workload_clean(self):
+        """Joins, deltas, vouches, sagas, gateway, slash, quarantine,
+        elevation, terminate: every legitimate transition satisfies the
+        catalog."""
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=1)
+        slot = st.create_session("s:mix", SessionConfig(min_sigma_eff=0.0))
+        st.enqueue_join(slot, "did:a", 0.8)
+        st.enqueue_join(slot, "did:b", 0.97)
+        st.flush_joins(now=1.0)
+        a = st.agent_row("did:a")["slot"]
+        b = st.agent_row("did:b")["slot"]
+        st.add_vouch(b, a, slot, bond=0.15)
+        st.stage_delta(slot, a, ts=2.0, change_words=np.arange(4, dtype=np.uint32))
+        st.flush_deltas()
+        g = st.create_saga("saga:mix", slot, [{"retries": 1}, {}])
+        st.saga_round({g: True})
+        st.check_actions_wave(
+            [a, b], [2, 2], [False] * 2, [False] * 2, [False] * 2,
+            [False] * 2, now=2.5,
+        )
+        st.grant_elevation(b, 1, now=2.6)
+        st.quarantine_rows([a], now=2.7)
+        st.apply_slash(slot, a, 0.9, now=2.8)
+        st.record_calls([b], [2], now=2.9)
+        st.breach_sweep_tick(3.0)
+        st.terminate_sessions([slot], now=3.0)
+        report = plane.sanitize()
+        assert report["total"] == 0, report
+        # and the scrubber re-hashes the whole history cleanly
+        while True:
+            tick = plane.scrub_tick()
+            assert not tick["mismatches"], tick
+            if tick["sweep_completed"]:
+                break
+
+
+# ── detection + in-place repair ──────────────────────────────────────
+
+
+class TestDetectionAndRepair:
+    def test_bit_flip_detected_within_k_waves_and_repaired(self):
+        """Sampling every 2 dispatches: a sigma bit flip at dispatch d
+        must show on the metrics drain within K=2 further waves, and
+        the next gate repairs it in place."""
+        st = HypervisorState(SMALL)
+        IntegrityPlane(st, every=2)
+        drive_waves(st, 2)
+        inj = WaveChaosInjector(
+            WaveChaosPlan(seed=3, corruptions=(
+                InjectedCorruption("bit_flip", at_dispatch=1, table="agents"),
+            ))
+        )
+        st.fault_injector = inj
+        drive_waves(st, 2, base=2)  # K = 2 waves after the corruption
+        assert len(inj.corruptions_applied) == 1
+        snap = st.metrics_snapshot()
+        assert snap.gauge(mp.INTEGRITY_VIOLATION_ROWS) >= 1, (
+            "bit flip not detected within K waves"
+        )
+        # the drain marked the plane dirty; the next gate settles it
+        st.fault_injector = None
+        drive_waves(st, 1, base=4)
+        snap = st.metrics_snapshot()
+        assert snap.counter(mp.INTEGRITY_REPAIRS) >= 1
+        assert snap.gauge(mp.INTEGRITY_VIOLATION_ROWS) == 0
+        assert st.integrity.sanitize()["total"] == 0
+
+    def test_row_rewrite_repairs_every_class(self):
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=0)
+        drive_waves(st, 1)
+        inj = WaveChaosInjector(
+            WaveChaosPlan(seed=5, corruptions=(
+                InjectedCorruption("row_rewrite", at_dispatch=1, table="agents"),
+            ))
+        )
+        inj.dispatches = 1
+        (record,) = inj.apply_due_corruptions(st)
+        report = plane.sanitize()
+        checks = {
+            c
+            for row in report["violations"]["agents"]
+            for c in row["checks"]
+        }
+        assert {"sigma_range", "ring_range", "rl_tokens", "flags"} <= checks
+        assert report["repaired_rows"] >= 1
+        after = plane.sanitize()
+        assert after["total"] == 0
+        row = record["row"]
+        sigma = float(np.asarray(st.agents.sigma_eff)[row])
+        ring = int(np.asarray(st.agents.ring)[row])
+        assert 0.0 <= sigma <= 1.0 and 0 <= ring <= 3
+
+    def test_corrupt_session_ref_quarantines_the_row(self):
+        from hypervisor_tpu.tables.state import AI32_SESSION
+        from hypervisor_tpu.tables.struct import replace
+        import jax.numpy as jnp
+
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=0)
+        slot = st.create_session("s:q", SessionConfig(min_sigma_eff=0.0))
+        st.enqueue_join(slot, "did:q", 0.8)
+        st.flush_joins(now=1.0)
+        row = st.agent_row("did:q")["slot"]
+        i32 = np.array(st.agents.i32, copy=True)
+        i32[row, AI32_SESSION] = 10_000  # way past the session table
+        st.agents = replace(st.agents, i32=jnp.asarray(i32))
+        report = plane.sanitize(now=2.0)
+        assert report["quarantined_rows"] == 1
+        assert np.asarray(st.agents.flags)[row] & FLAG_QUARANTINED
+        snap = st.metrics_snapshot()
+        assert snap.counter(mp.INTEGRITY_ROWS_QUARANTINED) == 1
+
+    def test_vouch_bond_corruption_contained_and_escrow_escalates(self):
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=0)
+        slot = st.create_session("s:v", SessionConfig(min_sigma_eff=0.0))
+        st.enqueue_join(slot, "did:a", 0.8)
+        st.enqueue_join(slot, "did:b", 0.8)
+        st.flush_joins(now=1.0)
+        a = st.agent_row("did:a")["slot"]
+        b = st.agent_row("did:b")["slot"]
+        edge = st.add_vouch(a, b, slot, bond=0.15)
+        # containment class: negative bond + dangling endpoint
+        inj = WaveChaosInjector(
+            WaveChaosPlan(seed=1, corruptions=(
+                InjectedCorruption("row_rewrite", at_dispatch=1, table="vouches"),
+            ))
+        )
+        inj.dispatches = 1
+        inj.apply_due_corruptions(st)
+        report = plane.sanitize()
+        assert report["total"] >= 1
+        assert not bool(np.asarray(st.vouches.active)[edge])
+        # conservation class: an inflated bond breaks the escrow cap
+        edge2 = st.add_vouch(a, b, slot, bond=0.15)
+        inj2 = WaveChaosInjector(
+            WaveChaosPlan(seed=2, corruptions=(
+                InjectedCorruption("bit_flip", at_dispatch=1, table="vouches"),
+            ))
+        )
+        inj2.dispatches = 1
+        inj2.apply_due_corruptions(st)
+        with pytest.raises(IntegrityError, match="restore"):
+            plane.sanitize()
+        assert plane.last_violations, "escrow break not recorded"
+        del edge2
+
+
+# ── the Merkle scrubber ──────────────────────────────────────────────
+
+
+def _seed_history(st, sessions=3, deltas=4):
+    slots = [
+        st.create_session(f"s:scrub{i}", SessionConfig(min_sigma_eff=0.0))
+        for i in range(sessions)
+    ]
+    for slot in slots:
+        st.enqueue_join(slot, f"did:scrub{slot}", 0.8)
+    st.flush_joins(now=1.0)
+    for t in range(deltas):
+        for slot in slots:
+            st.stage_delta(
+                slot, 0, ts=float(t),
+                change_words=np.full(4, t + 1, np.uint32),
+            )
+        st.flush_deltas()
+    return slots
+
+
+class TestScrubber:
+    def test_clean_sweep_verifies_every_link_and_head(self):
+        st = HypervisorState(SMALL)
+        _seed_history(st)
+        scrub = MerkleScrubber(st, budget=5)
+        ticks = 0
+        while True:
+            report = scrub.tick()
+            ticks += 1
+            assert not report["mismatches"]
+            if report["sweep_completed"]:
+                break
+        # 3 sessions x 4 links (full history => seed link included) + 3 heads
+        assert scrub.links_verified == 12
+        assert scrub.heads_verified == 3
+        assert ticks == -(-scrub.sweep_size // scrub.budget)
+
+    def test_body_bit_rot_caught_within_one_sweep(self):
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=0, scrub_budget=64)
+        _seed_history(st)
+        inj = WaveChaosInjector(
+            WaveChaosPlan(seed=7, corruptions=(
+                InjectedCorruption("bit_flip", at_dispatch=1, table="delta_log"),
+            ))
+        )
+        inj.dispatches = 1
+        (record,) = inj.apply_due_corruptions(st)
+        with pytest.raises(IntegrityError, match="scrub mismatch"):
+            while True:
+                if plane.scrub_tick()["sweep_completed"]:
+                    break
+        assert plane.scrubber.mismatches >= 1
+        assert plane.scrubber.last_mismatch is not None
+        del record
+
+    def test_ring_wrap_mid_sweep_skips_stale_lanes_not_flags_them(self):
+        """A DeltaLog wrap between ticks recycles archived sessions'
+        rows out from under the sweep snapshot; the scrubber must SKIP
+        those lanes (the chain prefix is gone by design), never read
+        recycled bytes as corruption and restore a healthy system."""
+        tiny = HypervisorConfig(
+            capacity=TableCapacity(
+                max_agents=64, max_sessions=32, max_vouch_edges=64,
+                max_sagas=16, max_steps_per_saga=8, max_elevations=16,
+                delta_log_capacity=16, event_log_capacity=64,
+                trace_log_capacity=64,
+            )
+        )
+        st = HypervisorState(tiny)
+        a = st.create_session("s:old", SessionConfig(min_sigma_eff=0.0))
+        for t in range(8):
+            st.stage_delta(a, 0, ts=float(t),
+                           change_words=np.full(2, t + 1, np.uint32))
+            st.flush_deltas()
+        st.terminate_sessions([a], now=9.0)  # archived: rows may recycle
+        scrub = MerkleScrubber(st, budget=2)
+        first = scrub.tick()  # snapshot the sweep, verify a partial strip
+        assert not first["mismatches"]
+        # wrap the ring over s:old's earliest rows
+        b = st.create_session("s:new", SessionConfig(min_sigma_eff=0.0))
+        for t in range(12):
+            st.stage_delta(b, 0, ts=float(t),
+                           change_words=np.full(2, 100 + t, np.uint32))
+            st.flush_deltas()
+        while True:
+            report = scrub.tick()
+            assert not report["mismatches"], (
+                "recycled rows misread as corruption"
+            )
+            if report["sweep_completed"]:
+                break
+        assert scrub.stale_skipped >= 1
+        # the NEXT sweep (fresh snapshot) verifies everything cleanly
+        while True:
+            report = scrub.tick()
+            assert not report["mismatches"]
+            if report["sweep_completed"]:
+                break
+
+    def test_plane_attach_preserves_cumulative_scrub_stats(self):
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=0, scrub_budget=64)
+        _seed_history(st)
+        while not plane.scrub_tick()["sweep_completed"]:
+            pass
+        links_before = plane.scrubber.links_verified
+        assert links_before > 0
+        plane.attach(HypervisorState(SMALL))
+        assert plane.scrubber.links_verified == links_before
+        assert plane.scrubber.sweeps_completed == 1
+
+    def test_chain_tamper_caught_and_counted(self):
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=0, scrub_budget=64)
+        _seed_history(st)
+        inj = WaveChaosInjector(
+            WaveChaosPlan(seed=8, corruptions=(
+                InjectedCorruption("chain_tamper", at_dispatch=1),
+            ))
+        )
+        inj.dispatches = 1
+        inj.apply_due_corruptions(st)
+        with pytest.raises(IntegrityError):
+            while True:
+                if plane.scrub_tick()["sweep_completed"]:
+                    break
+        snap = st.metrics_snapshot()
+        assert snap.counter(mp.INTEGRITY_SCRUB_MISMATCHES) >= 1
+        assert snap.counter(mp.INTEGRITY_SCRUB_LINKS) >= 1
+
+
+# ── the corruption property: oracle bit-identity via restore ─────────
+
+
+class TestCorruptionOracleProperty:
+    """Every corruption class: detected within K waves, escalated to
+    recover(), and the final tables + chain heads are bit-identical to
+    the uninterrupted oracle run of the same workload."""
+
+    CLASSES = (
+        InjectedCorruption("bit_flip", at_dispatch=2, table="agents"),
+        InjectedCorruption("row_rewrite", at_dispatch=2, table="sessions"),
+        InjectedCorruption("chain_tamper", at_dispatch=2),
+    )
+
+    @staticmethod
+    def _wave(st, sup, r, lanes=2):
+        """One production round with restore-retry semantics: the
+        session rows commit (journaled) before the wave, so when the
+        gate restores and refuses the dispatch, the SAME slots are
+        valid on the recovered state (replayed from the WAL) and the
+        wave re-issues there. Returns True when a restore fired."""
+        from hypervisor_tpu.integrity import StateRestoredError
+
+        slots = st.create_sessions_batch(
+            [f"w{r}:{i}" for i in range(lanes)],
+            SessionConfig(min_sigma_eff=0.0),
+        )
+        args = (
+            slots, [f"did:w{r}:{i}" for i in range(lanes)], slots.copy(),
+            np.full(lanes, 0.8, np.float32),
+            np.zeros((1, lanes, 16), np.uint32),
+        )
+        try:
+            st.run_governance_wave(*args, now=float(r))
+        except StateRestoredError:
+            sup.state.run_governance_wave(*args, now=float(r))
+            return True
+        return False
+
+    @pytest.mark.parametrize(
+        "corruption", CLASSES, ids=[c.kind for c in CLASSES]
+    )
+    def test_detect_restore_bit_identical(self, corruption, tmp_path):
+        oracle = HypervisorState(SMALL)
+        drive_waves(oracle, 6)
+
+        st = HypervisorState(SMALL)
+        st.journal = WriteAheadLog(tmp_path / "wal.log", fsync=False)
+        sup = Supervisor(
+            st, checkpoint_dir=str(tmp_path / "ckpt"), sleep=lambda s: None
+        )
+        plane = IntegrityPlane(
+            st, every=1, scrub_every=1, scrub_budget=256, ladder="restore"
+        )
+        drive_waves(st, 3)
+        sup.checkpoint()
+        sup.state.fault_injector = WaveChaosInjector(
+            WaveChaosPlan(seed=13, corruptions=(corruption,))
+        )
+        # The production loop: one wave + one metrics drain per round
+        # (the drain is where sanitizer detection closes). K = 1 round
+        # after detection: the NEXT gate settles the damage, restores,
+        # and refuses the in-flight wave — which re-issues against the
+        # recovered state (its session rows replayed from the WAL, so
+        # the same slots are valid).
+        detected_at = None
+        for r in range(3, 6):
+            if self._wave(sup.state, sup, r) and detected_at is None:
+                detected_at = r
+            sup.state.metrics_snapshot()
+        st = sup.state
+        if plane.restores == 0:
+            # Corruption landed on the LAST gate: settle explicitly.
+            report = plane.sanitize()
+            assert report["restored"], f"{corruption.kind} never detected"
+            st = sup.state
+        assert plane.restores >= 1
+        assert sup.state_restores >= 1
+        if detected_at is not None:
+            # K: the restore fired at most 2 waves after the round the
+            # corruption landed on (round 3 + at_dispatch - 1).
+            corruption_round = 3 + corruption.at_dispatch - 1
+            assert detected_at - corruption_round <= 2
+        assert_bit_identical(oracle, st)
+        # the restored plane keeps serving (and stays journaled)
+        drive_waves(st, 1, base=6)
+        assert st.journal is not None and st.journal.last_seq > 0
+        assert plane.sanitize()["total"] == 0
+
+    def test_repair_ladder_reaches_clean_state_for_repairable_classes(
+        self,
+    ):
+        """Default ladder: a repairable corruption is fixed IN PLACE
+        (post-repair tables satisfy every invariant; governance keeps
+        flowing) — containment, not oracle-identity."""
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=1)
+        drive_waves(st, 2)
+        st.fault_injector = WaveChaosInjector(
+            WaveChaosPlan(seed=21, corruptions=(
+                InjectedCorruption("bit_flip", at_dispatch=1, table="agents"),
+            ))
+        )
+        drive_waves(st, 2, base=2)
+        st.metrics_snapshot()      # detection closes at the drain
+        st.fault_injector = None
+        drive_waves(st, 1, base=4)  # the next gate settles the damage
+        assert plane.repairs >= 1
+        assert plane.sanitize()["total"] == 0
+
+
+# ── escalation without a restore path ────────────────────────────────
+
+
+class TestEscalationSafety:
+    def test_restore_class_without_supervisor_raises(self):
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=0)
+        drive_waves(st, 1)
+        inj = WaveChaosInjector(
+            WaveChaosPlan(seed=4, corruptions=(
+                InjectedCorruption("row_rewrite", at_dispatch=1, table="sessions"),
+            ))
+        )
+        inj.dispatches = 1
+        inj.apply_due_corruptions(st)
+        with pytest.raises(IntegrityError, match="no supervisor restore"):
+            plane.sanitize()
+        snap = st.metrics_snapshot()
+        assert snap.counter(mp.INTEGRITY_RESTORES) == 1
+
+    def test_supervisor_without_checkpoint_cannot_restore(self, tmp_path):
+        st = HypervisorState(SMALL)
+        Supervisor(st, sleep=lambda s: None)  # no checkpoint_dir
+        plane = IntegrityPlane(st, every=0)
+        drive_waves(st, 1)
+        inj = WaveChaosInjector(
+            WaveChaosPlan(seed=4, corruptions=(
+                InjectedCorruption("row_rewrite", at_dispatch=1, table="sessions"),
+            ))
+        )
+        inj.dispatches = 1
+        inj.apply_due_corruptions(st)
+        with pytest.raises(IntegrityError):
+            plane.sanitize()
+
+
+# ── schedule reproducibility across the corrupt-rate rename ──────────
+
+
+class TestChaosScheduleCompat:
+    def _schedule(self, plan):
+        inj = WaveChaosInjector(plan)
+        out = []
+        for _ in range(48):
+            try:
+                inj.on_dispatch("governance_wave")
+                out.append("ok")
+            except InjectedWaveFault:
+                out.append("fault")
+        return out
+
+    def test_corruptions_do_not_perturb_the_fault_schedule(self):
+        base = WaveChaosPlan(seed=7, fail_rate=0.3)
+        with_corrupt = WaveChaosPlan(
+            seed=7, fail_rate=0.3,
+            corruptions=(InjectedCorruption("bit_flip", at_dispatch=3),),
+        )
+        assert self._schedule(base) == self._schedule(with_corrupt)
+
+    def test_corrupt_rate_alias_still_means_drain_loss(self):
+        legacy = WaveChaosPlan(seed=3, corrupt_rate=1.0)
+        renamed = WaveChaosPlan(seed=3, drain_loss_rate=1.0)
+        assert legacy.effective_drain_loss_rate == 1.0
+        from hypervisor_tpu.testing.chaos import InjectedDeviceLoss
+
+        for plan in (legacy, renamed):
+            inj = WaveChaosInjector(plan)
+            with pytest.raises(InjectedDeviceLoss):
+                inj.on_drain("metrics_drain")
+
+
+# ── zero-recompile + unchanged-jaxpr pin (satellite) ─────────────────
+
+
+class TestCompileHygiene:
+    def test_sanitizer_adds_no_recompiles_to_wave_entry_points(self):
+        """The sanitizer is its OWN program: attaching the plane and
+        sampling at every dispatch must not re-trace ANY wrapped wave
+        entry point (CompileWatch recompile counters are the proof),
+        and the sanitizer itself compiles once."""
+        from hypervisor_tpu.observability.health import compile_summary
+
+        st = HypervisorState(SMALL)
+        drive_waves(st, 2, lanes=2)
+
+        def recompiles():
+            return {
+                row["program"]: row["recompiles"]
+                for row in compile_summary(last=0)["by_program"]
+            }
+
+        before = recompiles()
+        plane = IntegrityPlane(st, every=1)
+        drive_waves(st, 4, base=2, lanes=2)
+        plane.sanitize()
+        after = recompiles()
+        for program, count in before.items():
+            if program.startswith("integrity"):
+                continue
+            assert after[program] == count, (
+                f"{program} recompiled after the integrity plane attached"
+            )
+        assert after.get("integrity_check", 0) == 0  # one trace, no re-trace
+
+    def test_clean_path_jaxpr_unchanged_with_sampling_off(self):
+        """The wave program the state dispatches is byte-identical with
+        and without an attached (sampling-off) integrity plane — the
+        sanitizer never rides the wave's lowering."""
+        import jax
+        import jax.numpy as jnp
+
+        from hypervisor_tpu.observability import tracing
+        from hypervisor_tpu.ops.pipeline import governance_wave
+        from hypervisor_tpu.tables.logs import TraceLog
+        from hypervisor_tpu.tables.state import (
+            AgentTable,
+            SessionTable,
+            VouchTable,
+        )
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        def trace_wave():
+            b = 4
+            agents = AgentTable.create(16)
+            sessions = SessionTable.create(16)
+            vouches = VouchTable.create(8)
+            sessions = t_replace(
+                sessions, state=sessions.state.at[:b].set(1)
+            )
+            ctx = tracing.TraceContext(
+                trace=jnp.uint32(1), span=jnp.uint32(2),
+                wave_seq=jnp.int32(0), sampled=jnp.asarray(True),
+            )
+            return str(jax.make_jaxpr(
+                lambda *a: governance_wave(
+                    *a, use_pallas=False,
+                    metrics=mp.REGISTRY.create_table(),
+                    trace=TraceLog.create(64), trace_ctx=ctx,
+                )
+            )(
+                agents, sessions, vouches,
+                jnp.arange(b, dtype=jnp.int32),
+                jnp.arange(b, dtype=jnp.int32),
+                jnp.arange(b, dtype=jnp.int32),
+                jnp.full((b,), 0.8, jnp.float32),
+                jnp.ones((b,), bool), jnp.zeros((b,), bool),
+                jnp.arange(b, dtype=jnp.int32),
+                jnp.zeros((2, b, 16), jnp.uint32), 0.0,
+            ))
+
+        bare = trace_wave()
+        st = HypervisorState(SMALL)
+        IntegrityPlane(st, every=0)  # attached, sampling off
+        with_plane = trace_wave()
+        assert bare == with_plane
+
+
+# ── surfaces: events, endpoints ──────────────────────────────────────
+
+
+class TestSurfaces:
+    def test_violations_reach_the_event_bus(self):
+        from hypervisor_tpu.api import HypervisorService
+
+        svc = HypervisorService()
+        st = svc.hv.state
+        plane = IntegrityPlane(st, every=0)
+        slot = st.create_session("s:bus", SessionConfig(min_sigma_eff=0.0))
+        st.enqueue_join(slot, "did:bus", 0.8)
+        st.flush_joins(now=1.0)
+        inj = WaveChaosInjector(
+            WaveChaosPlan(seed=6, corruptions=(
+                InjectedCorruption("bit_flip", at_dispatch=1, table="agents"),
+            ))
+        )
+        inj.dispatches = 1
+        inj.apply_due_corruptions(st)
+        report = plane.sanitize()
+        assert report["repaired_rows"] == 1
+        events = svc.bus.query_by_type(EventType.INTEGRITY_VIOLATION)
+        assert len(events) == 1
+        assert events[0].payload["total"] == 1
+
+    def test_debug_integrity_on_both_transports(self):
+        import urllib.request
+
+        from hypervisor_tpu.api import HypervisorService
+        from hypervisor_tpu.api.server import HypervisorHTTPServer
+
+        svc = HypervisorService()
+        payload = asyncio.run(svc.debug_integrity())
+        assert payload == {"enabled": False}
+        plane = IntegrityPlane(svc.hv.state, every=4)
+        payload = asyncio.run(svc.debug_integrity())
+        json.dumps(payload)  # JSON-serializable contract
+        assert payload["enabled"] is True
+        assert payload["sampling"]["every"] == 4
+        assert {"table", "check", "action"} <= set(payload["catalog"][0])
+        server = HypervisorHTTPServer(svc).start()
+        try:
+            doc = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/debug/integrity"
+                ).read()
+            )
+        finally:
+            server.stop()
+        assert doc["enabled"] is True
+        assert doc["scrub"]["budget"] == plane.scrubber.budget
+
+    def test_health_summary_carries_the_integrity_panel(self):
+        st = HypervisorState(SMALL)
+        IntegrityPlane(st, every=2)
+        drive_waves(st, 2)
+        health = st.health_summary()
+        json.dumps(health)
+        assert health["integrity"]["enabled"] is True
+        assert health["integrity"]["sampling"]["checks"] >= 1
+
+    def test_repairable_bits_partition_matches_catalog(self):
+        repairable = {
+            (t, n) for t, n, k, _ in CATALOG if k == "repair"
+        }
+        assert ("agents", "sigma_range") in repairable
+        assert ("vouches", "escrow_conservation") not in repairable
+        agent_bits = 0
+        for t, _n, k, bit in CATALOG:
+            if t == "agents" and k == "repair":
+                agent_bits |= bit
+        assert agent_bits == inv.REPAIRABLE_AGENT_BITS

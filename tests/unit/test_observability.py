@@ -66,10 +66,11 @@ class TestEventBus:
     def test_event_type_codes_stable(self):
         # The reference's 40 typed events across 8 categories (its
         # README says 38 but its enum defines 40 — we match the enum)
-        # plus the 3 health-plane events and the 4 resilience-plane
-        # events (append-only: codes are the device-log wire format,
-        # so every earlier code stays stable).
-        assert len({t.code for t in EventType}) == len(EventType) == 47
+        # plus the 3 health-plane events, the 4 resilience-plane
+        # events, and the 4 integrity-plane events (append-only: codes
+        # are the device-log wire format, so every earlier code stays
+        # stable).
+        assert len({t.code for t in EventType}) == len(EventType) == 51
         assert EventType.WAVE_STRAGGLER.code == 40
         assert EventType.CAPACITY_WARNING.code == 41
         assert EventType.RECOMPILE.code == 42
@@ -77,6 +78,10 @@ class TestEventBus:
         assert EventType.DEGRADED_EXITED.code == 44
         assert EventType.DISPATCH_RETRY.code == 45
         assert EventType.WAL_REPLAYED.code == 46
+        assert EventType.INTEGRITY_VIOLATION.code == 47
+        assert EventType.SCRUB_MISMATCH.code == 48
+        assert EventType.ROW_QUARANTINED.code == 49
+        assert EventType.STATE_RESTORED.code == 50
 
     def test_to_dict(self):
         event = self._emit(EventType.RING_ASSIGNED, "s1", "did:a")

@@ -1,0 +1,237 @@
+"""Concurrent-access coverage for the host security engines.
+
+`security/rate_limiter.py` and `security/kill_switch.py` are driven by
+the async facade (`core.Hypervisor`) from arbitrarily interleaved
+coroutines, but until this file neither had a single test exercising
+interleaved callers. These tests pin the invariants that interleaving
+must not break:
+
+  * token conservation — a burst-B bucket admits exactly B calls no
+    matter how many concurrent coroutines race it, and the request /
+    rejection accounting sums exactly,
+  * bucket isolation — interleaved callers on different (agent,
+    session) keys never consume each other's tokens,
+  * ring changes mid-traffic — `update_ring` recreates the bucket at
+    the new ring's burst without corrupting concurrent accounting,
+  * kill-switch handoff sanity — concurrent kills with in-flight steps
+    hand off only to live registered substitutes (never to any killed
+    agent, never to the victim itself), round-robin across the pool,
+    with one history entry per kill,
+  * pool mutation races — register/unregister interleaved with kills
+    keeps the pool a consistent set.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from hypervisor_tpu.models import ExecutionRing
+from hypervisor_tpu.security.kill_switch import (
+    HandoffStatus,
+    KillReason,
+    KillSwitch,
+)
+from hypervisor_tpu.security.rate_limiter import AgentRateLimiter
+
+
+class FrozenClock:
+    """Deterministic clock: no refill unless the test advances it."""
+
+    def __init__(self) -> None:
+        self.now = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+    def __call__(self) -> datetime:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += timedelta(seconds=seconds)
+
+
+async def _interleave(coros):
+    """Run coroutines concurrently with forced interleaving points."""
+    async def wrap(c):
+        await asyncio.sleep(0)
+        return await c
+
+    return await asyncio.gather(*(wrap(c) for c in coros))
+
+
+class TestRateLimiterConcurrency:
+    def test_burst_conserved_across_concurrent_callers(self):
+        clock = FrozenClock()
+        limiter = AgentRateLimiter(clock=clock)
+        burst = 10  # ring 3 default burst
+
+        async def caller(i):
+            # Interleave mid-stream so callers genuinely alternate.
+            out = []
+            for _ in range(4):
+                out.append(
+                    limiter.try_check("did:a", "s:1", ExecutionRing.RING_3_SANDBOX)
+                )
+                await asyncio.sleep(0)
+            return out
+
+        results = asyncio.run(_interleave([caller(i) for i in range(8)]))
+        allowed = sum(sum(r) for r in results)
+        assert allowed == burst  # exactly the burst, no double-spend
+        stats = limiter.get_stats("did:a", "s:1")
+        assert stats.total_requests == 8 * 4
+        assert stats.rejected_requests == 8 * 4 - burst
+        assert stats.tokens_available == pytest.approx(0.0)
+
+    def test_interleaved_keys_do_not_cross_talk(self):
+        clock = FrozenClock()
+        limiter = AgentRateLimiter(clock=clock)
+
+        async def caller(agent):
+            out = 0
+            for _ in range(12):
+                if limiter.try_check(agent, "s:1", ExecutionRing.RING_3_SANDBOX):
+                    out += 1
+                await asyncio.sleep(0)
+            return out
+
+        results = asyncio.run(
+            _interleave([caller(f"did:{i}") for i in range(5)])
+        )
+        # every bucket admits ITS burst — neighbours drained nothing
+        assert results == [10] * 5
+        assert limiter.tracked_agents == 5
+
+    def test_ring_change_mid_traffic_recreates_bucket(self):
+        clock = FrozenClock()
+        limiter = AgentRateLimiter(clock=clock)
+
+        async def drain():
+            for _ in range(12):
+                limiter.try_check("did:x", "s:1", ExecutionRing.RING_3_SANDBOX)
+                await asyncio.sleep(0)
+
+        async def promote():
+            await asyncio.sleep(0)
+            limiter.update_ring("did:x", "s:1", ExecutionRing.RING_1_PRIVILEGED)
+
+        asyncio.run(_interleave([drain(), promote()]))
+        stats = limiter.get_stats("did:x", "s:1")
+        assert stats.ring is ExecutionRing.RING_1_PRIVILEGED
+        assert stats.capacity == 100.0  # ring-1 burst
+        # recreated FULL at the new burst, then drained by the
+        # remaining interleaved calls — never negative, never above
+        assert 0.0 <= stats.tokens_available <= 100.0
+
+    def test_refill_respects_elapsed_time_under_interleaving(self):
+        clock = FrozenClock()
+        limiter = AgentRateLimiter(clock=clock)
+
+        async def scenario():
+            for _ in range(10):  # drain the ring-3 burst
+                assert limiter.try_check("did:r", "s:1", ExecutionRing.RING_3_SANDBOX)
+                await asyncio.sleep(0)
+            assert not limiter.try_check("did:r", "s:1", ExecutionRing.RING_3_SANDBOX)
+            clock.advance(1.0)  # ring 3 refills 5 tokens/s
+            got = [
+                limiter.try_check("did:r", "s:1", ExecutionRing.RING_3_SANDBOX)
+                for _ in range(6)
+            ]
+            assert got == [True] * 5 + [False]
+
+        asyncio.run(scenario())
+
+
+class TestKillSwitchConcurrency:
+    def _rig(self, substitutes=3):
+        switch = KillSwitch()
+        for i in range(substitutes):
+            switch.register_substitute("s:1", f"did:sub{i}")
+        return switch
+
+    def test_concurrent_kills_hand_off_to_live_substitutes_only(self):
+        switch = self._rig(substitutes=3)
+        victims = [f"did:victim{i}" for i in range(4)]
+
+        async def kill(victim, n_steps):
+            await asyncio.sleep(0)
+            return switch.kill(
+                victim, "s:1", KillReason.MANUAL,
+                in_flight_steps=[
+                    {"step_id": f"{victim}:st{j}", "saga_id": "g"}
+                    for j in range(n_steps)
+                ],
+            )
+
+        results = asyncio.run(
+            _interleave([kill(v, 2) for v in victims])
+        )
+        assert switch.total_kills == 4
+        killed = set(victims)
+        for result in results:
+            assert len(result.handoffs) == 2
+            for handoff in result.handoffs:
+                assert handoff.status is HandoffStatus.HANDED_OFF
+                # never a killed agent, never the victim itself
+                assert handoff.to_agent not in killed
+                assert handoff.to_agent != result.agent_did
+                assert handoff.to_agent.startswith("did:sub")
+        # the pool ends as exactly the surviving substitutes
+        assert sorted(switch.substitutes("s:1")) == [
+            "did:sub0", "did:sub1", "did:sub2",
+        ]
+
+    def test_round_robin_spreads_under_interleaving(self):
+        switch = self._rig(substitutes=3)
+
+        async def kill(i):
+            await asyncio.sleep(0)
+            return switch.kill(
+                f"did:v{i}", "s:1", KillReason.RING_BREACH,
+                in_flight_steps=[{"step_id": f"st{i}", "saga_id": "g"}],
+            )
+
+        results = asyncio.run(_interleave([kill(i) for i in range(6)]))
+        targets = [r.handoffs[0].to_agent for r in results]
+        # 6 handoffs over a 3-substitute pool: perfect 2-2-2 rotation
+        assert sorted(targets.count(f"did:sub{i}") for i in range(3)) == [
+            2, 2, 2,
+        ]
+
+    def test_empty_pool_compensates_and_pool_mutations_race_safely(self):
+        switch = KillSwitch()
+        switch.register_substitute("s:1", "did:sub0")
+
+        async def unregister():
+            await asyncio.sleep(0)
+            switch.unregister_substitute("s:1", "did:sub0")
+
+        async def kill():
+            await asyncio.sleep(0)
+            await asyncio.sleep(0)  # let the unregister land first
+            return switch.kill(
+                "did:v", "s:1", KillReason.MANUAL,
+                in_flight_steps=[{"step_id": "st", "saga_id": "g"}],
+            )
+
+        _, result = asyncio.run(_interleave([unregister(), kill()]))
+        assert result.handoffs[0].status is HandoffStatus.COMPENSATED
+        assert result.compensation_triggered
+        assert switch.substitutes("s:1") == []
+
+    def test_malformed_step_aborts_before_pool_mutation(self):
+        switch = self._rig(substitutes=2)
+        before = switch.substitutes("s:1")
+
+        async def bad_kill():
+            await asyncio.sleep(0)
+            switch.kill(
+                "did:sub0", "s:1", KillReason.MANUAL,
+                in_flight_steps=["not-a-dict"],  # type: ignore[list-item]
+            )
+
+        with pytest.raises(TypeError):
+            asyncio.run(_interleave([bad_kill()]))
+        # the failed kill neither rotated nor shrank the pool
+        assert switch.substitutes("s:1") == before
+        assert switch.total_kills == 0

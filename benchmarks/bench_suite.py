@@ -647,12 +647,7 @@ def corrupt_benchmark(seed: int, quick: bool) -> dict:
             out.append(_time.perf_counter() - t0)
         return sorted(out[1:])  # drop the compile round
 
-    base = timed_clean(False)
-    sampled = timed_clean(True)
-    p50 = lambda xs: xs[len(xs) // 2]  # noqa: E731
-    overhead_pct = (
-        (p50(sampled) - p50(base)) / p50(base) * 100.0 if base else 0.0
-    )
+    overhead_pct = _overhead_p50_pct(timed_clean(False), timed_clean(True))
 
     detections.sort()
     return {
@@ -684,6 +679,91 @@ def corrupt_benchmark(seed: int, quick: bool) -> dict:
             "mismatches_escalated": plane.scrub_mismatches,
         },
         "checks": plane.checks,
+        "wall_s": round(wall_s, 3),
+    }
+
+
+def _overhead_p50_pct(base: list[float], hardened: list[float]) -> float:
+    """Shared clean-path-overhead formula: p50(hardened) vs p50(base),
+    as a percentage (one definition for the corrupt and scenario
+    rows so the two gates can't drift apart)."""
+    if not base:
+        return 0.0
+    p50 = lambda xs: xs[len(xs) // 2]  # noqa: E731
+    return (p50(hardened) - p50(base)) / p50(base) * 100.0
+
+
+def scenario_benchmark(seed: int, quick: bool) -> dict:
+    """`--scenarios <seed>`: the seeded adversarial scenario suite
+    (`testing.scenarios`) — sybil flood, collusion ring, slash
+    cascade, compensation storm, byzantine API fuzz — each scored on
+    containment, plus the clean-path overhead of the always-attached
+    governance hardening (admission damper + comp-backlog supervisor)
+    measured against a bare state at production cadence. The row lands
+    in the BENCH payload; `regression.py` gates `min_score` against
+    the containment floor and the overhead against the perf band.
+    Seeded: the same seed replays the same attack traces
+    (`trace_digests` are the replay keys).
+    """
+    import time as _time
+
+    from hypervisor_tpu.models import SessionConfig
+    from hypervisor_tpu.resilience.policy import AdmissionDamper
+    from hypervisor_tpu.resilience.supervisor import Supervisor
+    from hypervisor_tpu.state import HypervisorState
+    from hypervisor_tpu.testing import scenarios
+
+    t0 = _time.perf_counter()
+    results = scenarios.run_all(seed, hardened=True, quick=quick)
+    agg = scenarios.aggregate(results)
+    wall_s = _time.perf_counter() - t0
+
+    # Hardening overhead on the path the hardening actually rides:
+    # identical clean ADMISSION rounds (enqueue_join -> flush_joins,
+    # where the damper's note_join + the shed gate live, with the
+    # supervisor subscribed to health events) against a bare state.
+    # run_governance_wave would bypass enqueue_join entirely and
+    # measure a path the damper never touches.
+    lanes = 16 if quick else 64
+
+    def timed_clean(hardened_on: bool) -> list[float]:
+        state = HypervisorState()
+        if hardened_on:
+            state.admission_damper = AdmissionDamper(
+                rate_threshold=1e9, sigma_floor=0.5
+            )
+            Supervisor(state, sleep=lambda s: None)
+        slot = state.create_session(
+            f"sovh{int(hardened_on)}",
+            SessionConfig(min_sigma_eff=0.0, max_participants=4096),
+            now=0.0,
+        )
+        out = []
+        n = 17 if quick else 33
+        for r in range(n):
+            t1 = _time.perf_counter()
+            for i in range(lanes):
+                state.enqueue_join(
+                    slot, f"did:sovh{int(hardened_on)}:{r}:{i}", 0.8,
+                    now=float(r) + i * 1e-4,
+                )
+            state.flush_joins(now=float(r))
+            out.append(_time.perf_counter() - t1)
+        return sorted(out[1:])  # drop the compile round
+
+    overhead_pct = _overhead_p50_pct(timed_clean(False), timed_clean(True))
+
+    return {
+        "seed": seed,
+        "quick": quick,
+        "scores": agg["scores"],
+        "min_score": agg["min_score"],
+        "attack_events": agg["attack_events"],
+        "trace_digests": agg["trace_digests"],
+        "components": {
+            name: r.components for name, r in results.items()
+        },
+        "hardening_overhead_pct": round(overhead_pct, 2),
         "wall_s": round(wall_s, 3),
     }
 
@@ -751,6 +831,19 @@ def main() -> None:
         ),
     )
     ap.add_argument(
+        "--scenarios",
+        type=int,
+        default=None,
+        metavar="SEED",
+        help=(
+            "also run the seeded adversarial scenario suite (sybil "
+            "flood, collusion ring, slash cascade, compensation storm, "
+            "byzantine API fuzz; testing/scenarios.py) and report "
+            "per-scenario containment scores + hardening clean-path "
+            "overhead (%%) into the BENCH payload"
+        ),
+    )
+    ap.add_argument(
         "--write-results",
         action="store_true",
         help=(
@@ -806,6 +899,22 @@ def main() -> None:
                 flush=True,
             )
 
+    scenario_rec = None
+    if args.scenarios is not None:
+        scenario_rec = scenario_benchmark(args.scenarios, args.quick)
+        if not args.json_only:
+            worst = min(
+                scenario_rec["scores"], key=scenario_rec["scores"].get
+            )
+            print(
+                f"scenarios[seed={args.scenarios}]: min containment "
+                f"{scenario_rec['min_score']} ({worst}), "
+                f"{scenario_rec['attack_events']} attack events, "
+                f"hardening overhead "
+                f"{scenario_rec['hardening_overhead_pct']}%",
+                flush=True,
+            )
+
     if args.metrics_out:
         from benchmarks import regression
 
@@ -831,6 +940,10 @@ def main() -> None:
             # sanitizer overhead land in the trajectory too, and
             # regression.py gates the overhead.
             "integrity": integrity_rec,
+            # Adversarial row (--scenarios <seed>): per-scenario
+            # containment scores + hardening overhead; regression.py
+            # gates min_score against the containment floor.
+            "scenarios": scenario_rec,
         }
         out_path.write_text(json.dumps(report, indent=2) + "\n")
         if not args.json_only:
@@ -855,6 +968,7 @@ def main() -> None:
         "benchmarks": results,
         "chaos": chaos_rec,
         "integrity": integrity_rec,
+        "scenarios": scenario_rec,
     }
     if jax.default_backend() not in ("tpu",) and not args.write_results:
         print(

@@ -57,6 +57,16 @@ DEFAULT_TOLERANCE = {"tpu": 0.5, "cpu": 3.0}
 #: catches a sanitizer accidentally riding the hot path.
 DEFAULT_INTEGRITY_OVERHEAD = {"tpu": 2.0, "cpu": 50.0}
 
+#: Minimum containment score a `--scenarios` round's WORST adversary
+#: class may report (`HV_SCENARIO_FLOOR` overrides). Containment is a
+#: min-over-components conjunction, so one floor gates every scenario.
+DEFAULT_SCENARIO_FLOOR = 0.8
+
+#: Backend -> max hardening clean-path overhead (%) a `--scenarios`
+#: round may report (`HV_BENCH_HARDENING_OVERHEAD` overrides) — the
+#: damper + supervisor must be invisible on the clean path.
+DEFAULT_HARDENING_OVERHEAD = {"tpu": 2.0, "cpu": 50.0}
+
 
 def _backend_of(device: str) -> str:
     return "tpu" if "tpu" in (device or "").lower() else "cpu"
@@ -88,6 +98,7 @@ def parse_round_file(path: Path) -> Optional[dict]:
         )
         chaos = doc.get("chaos")
         integrity = doc.get("integrity")
+        scenarios = doc.get("scenarios")
         row.update(
             format="suite",
             backend=doc.get("backend", "cpu"),
@@ -125,6 +136,21 @@ def parse_round_file(path: Path) -> Optional[dict]:
                     "repairs": integrity.get("repairs"),
                 }
                 if isinstance(integrity, dict)
+                else None
+            ),
+            # Adversarial row (bench_suite --scenarios): per-scenario
+            # containment + hardening overhead, gated below.
+            scenarios=(
+                {
+                    "seed": scenarios.get("seed"),
+                    "scores": scenarios.get("scores"),
+                    "min_score": scenarios.get("min_score"),
+                    "hardening_overhead_pct": scenarios.get(
+                        "hardening_overhead_pct"
+                    ),
+                    "attack_events": scenarios.get("attack_events"),
+                }
+                if isinstance(scenarios, dict)
                 else None
             ),
         )
@@ -257,6 +283,40 @@ def compare(
         overhead = float(integrity["sanitizer_overhead_pct"])
         entry = {
             "bench": "integrity_sanitizer_overhead",
+            "current_per_op_us": overhead,
+            "baseline_per_op_us": cap,
+            "ratio": round(overhead / cap, 3) if cap else 0.0,
+        }
+        checked.append(entry)
+        if overhead > cap:
+            regressions.append(entry)
+    # Scenario gate: a round that ran the adversarial suite must keep
+    # its WORST containment score at/above the floor AND the hardening
+    # mechanisms invisible on the clean path.
+    scenarios = current.get("scenarios")
+    if scenarios and scenarios.get("min_score") is not None:
+        env_floor = os.environ.get("HV_SCENARIO_FLOOR")
+        floor = float(env_floor) if env_floor else DEFAULT_SCENARIO_FLOOR
+        min_score = float(scenarios["min_score"])
+        entry = {
+            "bench": "scenario_containment_min",
+            "current_per_op_us": min_score,
+            "baseline_per_op_us": floor,
+            "ratio": round(min_score / floor, 3) if floor else 0.0,
+        }
+        checked.append(entry)
+        if min_score < floor:
+            regressions.append(entry)
+    if scenarios and scenarios.get("hardening_overhead_pct") is not None:
+        env_cap = os.environ.get("HV_BENCH_HARDENING_OVERHEAD")
+        cap = (
+            float(env_cap)
+            if env_cap
+            else DEFAULT_HARDENING_OVERHEAD.get(current["backend"], 50.0)
+        )
+        overhead = float(scenarios["hardening_overhead_pct"])
+        entry = {
+            "bench": "scenario_hardening_overhead",
             "current_per_op_us": overhead,
             "baseline_per_op_us": cap,
             "ratio": round(overhead / cap, 3) if cap else 0.0,

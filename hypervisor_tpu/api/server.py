@@ -92,6 +92,11 @@ _QUERY_PARAMS = {
     "trace_session": ("format",),
 }
 
+#: Stdlib-transport request-body ceiling: no governance call carries
+#: megabytes, and an attacker-declared huge Content-Length must refuse
+#: (413) instead of committing the handler thread to reading it.
+_MAX_BODY_BYTES = 4 << 20
+
 
 def _to_jsonable(result: Any) -> Any:
     if hasattr(result, "model_dump"):
@@ -133,12 +138,52 @@ def create_app(service: Optional[HypervisorService] = None):
             async def endpoint(request: Request):
                 path_kwargs = dict(request.path_params)
                 if request_model is not None:
-                    body = await request.json()
+                    # Same byzantine containment as the stdlib
+                    # transport: malformed bodies are 400s, not 500s,
+                    # and a declared-huge body refuses (413) before the
+                    # worker commits to buffering it.
+                    declared = request.headers.get("content-length")
+                    if declared is not None:
+                        try:
+                            length = int(declared)
+                        except ValueError:
+                            raise HTTPException(
+                                status_code=400,
+                                detail="bad Content-Length",
+                            )
+                        if length < 0:
+                            raise HTTPException(
+                                status_code=400,
+                                detail="bad Content-Length",
+                            )
+                        if length > _MAX_BODY_BYTES:
+                            raise HTTPException(
+                                status_code=413, detail="body too large"
+                            )
+                    try:
+                        body = await request.json()
+                    except Exception as e:  # noqa: BLE001 — parse error
+                        raise HTTPException(
+                            status_code=400,
+                            detail=f"malformed JSON: {e}",
+                        )
+                    if not isinstance(body, dict):
+                        raise HTTPException(
+                            status_code=422, detail="JSON object required"
+                        )
                     path_kwargs["req"] = request_model(**body)
                 for q in _QUERY_PARAMS.get(name, ()):
                     if q in request.query_params:
                         value = request.query_params[q]
-                        path_kwargs[q] = int(value) if q == "limit" else value
+                        try:
+                            path_kwargs[q] = (
+                                int(value) if q == "limit" else value
+                            )
+                        except ValueError:
+                            raise HTTPException(
+                                status_code=400,
+                                detail=f"bad query param {q!r}",
+                            )
                 try:
                     result = await getattr(svc, name)(**path_kwargs)
                 except ApiError as e:
@@ -215,8 +260,31 @@ class HypervisorHTTPServer:
                     return
                 name, kwargs, request_model = match
                 if request_model is not None:
-                    length = int(self.headers.get("Content-Length") or 0)
-                    body = json.loads(self.rfile.read(length) or b"{}")
+                    # Byzantine-client containment (the API-fuzz
+                    # scenario, `testing.scenarios`): a malformed body
+                    # or garbage Content-Length is a 4xx refusal, never
+                    # an unhandled raise that drops the connection.
+                    try:
+                        length = int(self.headers.get("Content-Length") or 0)
+                    except ValueError:
+                        self._send(400, {"detail": "bad Content-Length"})
+                        return
+                    if length < 0:
+                        # rfile.read(negative) would block until the
+                        # client closes, pinning a handler thread.
+                        self._send(400, {"detail": "bad Content-Length"})
+                        return
+                    if length > _MAX_BODY_BYTES:
+                        self._send(413, {"detail": "body too large"})
+                        return
+                    try:
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                        self._send(400, {"detail": f"malformed JSON: {e}"})
+                        return
+                    if not isinstance(body, dict):
+                        self._send(422, {"detail": "JSON object required"})
+                        return
                     try:
                         kwargs["req"] = request_model(**body)
                     except Exception as e:  # noqa: BLE001 — validation error
@@ -226,7 +294,13 @@ class HypervisorHTTPServer:
                 for q in _QUERY_PARAMS.get(name, ()):
                     if q in query:
                         value = query[q][0]
-                        kwargs[q] = int(value) if q == "limit" else value
+                        try:
+                            kwargs[q] = int(value) if q == "limit" else value
+                        except ValueError:
+                            self._send(
+                                400, {"detail": f"bad query param {q!r}"}
+                            )
+                            return
                 try:
                     result = asyncio.run(getattr(svc, name)(**kwargs))
                 except ApiError as e:

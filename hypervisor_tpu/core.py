@@ -39,6 +39,7 @@ from hypervisor_tpu.models import (
     SessionConfig,
 )
 from hypervisor_tpu.observability import EventType, HypervisorEvent, HypervisorEventBus
+from hypervisor_tpu.observability import metrics as metrics_plane
 from hypervisor_tpu.ops.sha256 import digests_to_hex, hex_to_words
 from hypervisor_tpu.reversibility import ReversibilityRegistry
 from hypervisor_tpu.rings import ActionClassifier, RingEnforcer
@@ -147,6 +148,24 @@ class Hypervisor:
             on_release=self._mirror_release,
         )
         self.slashing = SlashingEngine(self.vouching)
+        # High-water mark of engine dedupes already mirrored into
+        # `hv_slash_cascade_deduped_total` (the facade owns the mirror;
+        # the engine stays metrics-free).
+        self._cascade_dedupes_mirrored = 0
+        # Vouch-collusion clique scanner over the host mirror of the
+        # liability graph (`liability/collusion.py`); run on sweep
+        # cadence via `detect_collusion` — findings charge the ledger
+        # so the admission gate refuses flagged cliques before they
+        # can re-pump.
+        from hypervisor_tpu.liability.collusion import CollusionDetector
+
+        self.collusion = CollusionDetector()
+        # Findings already charged/counted: quarantined members keep
+        # their live edges, so sweep-cadence re-scans re-surface the
+        # SAME component — it must not re-charge the ledger (a single
+        # neutralized incident would ratchet members to deny within a
+        # few ticks) nor re-count hv_collusion_findings_total.
+        self._collusion_charged: set[tuple] = set()
         # Persistent cross-session risk accounting, facade-wired as an
         # ADMISSION GATE (the reference exports the ledger but never
         # consults it): slashes/quarantines recorded by verify_behavior
@@ -249,6 +268,19 @@ class Hypervisor:
         5. Resolve sigma (Nexus or raw) and assign the ring
         """
         managed = self._require(session_id)
+
+        # Byzantine-input gate: a non-finite or out-of-range sigma
+        # would sail through every threshold compare (NaN compares
+        # false) into the device tables, where the integrity sanitizer
+        # flags it as a sigma-range violation — refuse it at the door
+        # instead (the API-fuzz scenario's containment bar).
+        sigma_f = float(sigma_raw)
+        if not np.isfinite(sigma_f) or not 0.0 <= sigma_f <= 1.0:
+            from hypervisor_tpu.session import SessionParticipantError
+
+            raise SessionParticipantError(
+                f"sigma_raw must be finite in [0, 1]; got {sigma_raw!r}"
+            )
 
         # Liability-ledger gate FIRST: a denied agent must not mutate
         # the session on its way out (manifest registration would force
@@ -918,6 +950,117 @@ class Hypervisor:
         )
         return result
 
+    # ── collusion detection -> ledger ────────────────────────────────
+
+    def detect_collusion(
+        self,
+        session_id: Optional[str] = None,
+        charge: bool = True,
+        quarantine: bool = True,
+    ):
+        """Scan the live vouch graph for sigma-pump cliques
+        (`liability.collusion.CollusionDetector`) and make the findings
+        BITE. With `quarantine` every flagged member's membership in
+        the finding's session goes read-only on BOTH planes (host
+        QuarantineManager + FLAG_QUARANTINED on the device row — the
+        same isolation verify_behavior applies to a slashed rogue), so
+        a pumped clique is neutralized BEFORE its defection step. With
+        `charge` every member also takes a FAULT_ATTRIBUTED ledger
+        charge at the finding's score (persistent risk the admission
+        gate consults — repeat findings ratchet toward probation/deny)
+        and is marked penalized so terminate's clean-session credit
+        skips it. Run on the sweep cadence (`docs/OPERATIONS.md`
+        "Ticks the operator owns"); returns the findings.
+        """
+        findings = self.collusion.scan(self.vouching, session_id)
+        fresh_keys = {
+            (f.session_id, f.members)
+            for f in findings
+            if (f.session_id, f.members) not in self._collusion_charged
+        }
+        if fresh_keys:
+            self.state.metrics.inc(
+                metrics_plane.COLLUSION_FINDINGS, len(fresh_keys)
+            )
+        for finding in findings:
+            key = (finding.session_id, finding.members)
+            is_fresh = key in fresh_keys
+            self._collusion_charged.add(key)
+            managed = self._sessions.get(finding.session_id)
+            session_live = managed is not None and (
+                managed.sso.state.value not in ("archived", "terminating")
+            )
+            detail = (
+                f"collusion clique of {len(finding.members)} "
+                f"(density {finding.density:.2f}, dual-role "
+                f"{finding.dual_role_fraction:.2f}, internal bonds "
+                f"{finding.internal_bond_fraction:.2f})"
+            )
+            for member in finding.members:
+                # Ledger charges only once per distinct finding —
+                # sweep-cadence re-scans of a persisting (already
+                # neutralized) component must not ratchet risk.
+                if charge and is_fresh:
+                    if session_live:
+                        self._penalized_in.setdefault(
+                            finding.session_id, set()
+                        ).add(member)
+                    self.ledger.record(
+                        member,
+                        LedgerEntryType.FAULT_ATTRIBUTED,
+                        session_id=finding.session_id,
+                        severity=finding.score,
+                        details=detail,
+                    )
+                if quarantine and session_live:
+                    row = self.state.agent_row(member, managed.slot)
+                    if row is not None:
+                        self.state.quarantine_rows(
+                            [row["slot"]], now=self.state.now()
+                        )
+                    if (
+                        self.quarantine.get_active_quarantine(
+                            member, finding.session_id
+                        )
+                        is None
+                    ):
+                        self.quarantine.quarantine(
+                            member,
+                            finding.session_id,
+                            QuarantineReason.LIABILITY_VIOLATION,
+                            details=detail,
+                            duration_seconds=int(
+                                self.state.config.quarantine
+                                .default_duration_seconds
+                            ),
+                            forensic_data=finding.to_dict(),
+                        )
+                        if charge:
+                            self.ledger.record(
+                                member,
+                                LedgerEntryType.QUARANTINE_ENTERED,
+                                session_id=finding.session_id,
+                                severity=finding.score,
+                            )
+                        self._emit(
+                            EventType.QUARANTINE_ENTERED,
+                            session_id=finding.session_id,
+                            agent_did=member,
+                            payload={
+                                "reason": (
+                                    QuarantineReason
+                                    .LIABILITY_VIOLATION.value
+                                )
+                            },
+                        )
+            if is_fresh:
+                self._emit(
+                    EventType.COLLUSION_DETECTED,
+                    session_id=finding.session_id,
+                    payload=finding.to_dict(),
+                )
+        return findings
+
     # ── kill switch (graceful termination, both planes) ──────────────
 
     async def kill_agent(
@@ -1276,6 +1419,19 @@ class Hypervisor:
                 reason=f"CMVK drift: {result.drift_score:.3f} ({result.severity.value})",
                 agent_scores=agent_scores,
             )
+            # Mirror cascade dedupes (duplicate per-agent settlements
+            # the visited-set guard suppressed) into the metrics plane.
+            new_dedupes = (
+                self.slashing.cascade_dedupes
+                - self._cascade_dedupes_mirrored
+            )
+            if new_dedupes > 0:
+                self.state.metrics.inc(
+                    metrics_plane.CASCADE_DEDUPED, new_dedupes
+                )
+                self._cascade_dedupes_mirrored = (
+                    self.slashing.cascade_dedupes
+                )
             # Persistent risk accounting (facade-wired ledger): the
             # rogue is charged for the slash AND the quarantine; every
             # clipped voucher is charged the cascade. All of them are
@@ -1607,6 +1763,10 @@ class Hypervisor:
             "scrub_mismatch": EventType.SCRUB_MISMATCH,
             "row_quarantined": EventType.ROW_QUARANTINED,
             "state_restored": EventType.STATE_RESTORED,
+            # Adversarial-plane detections (sybil damper trips) ride
+            # the same fan-out; collusion findings emit directly from
+            # `detect_collusion` (they carry session context).
+            "sybil_damped": EventType.SYBIL_DAMPED,
         }.get(kind)
         if event_type is None or self.event_bus is None:
             return

@@ -1,5 +1,9 @@
 """Joint Liability subsystem: vouching, slashing, attribution, quarantine, ledger."""
 
+from hypervisor_tpu.liability.collusion import (
+    CollusionDetector,
+    CollusionFinding,
+)
 from hypervisor_tpu.liability.matrix import LiabilityEdge, LiabilityMatrix
 from hypervisor_tpu.liability.vouching import VouchingEngine, VouchingError, VouchRecord
 from hypervisor_tpu.liability.slashing import SlashingEngine, SlashResult, VoucherClip
@@ -22,6 +26,8 @@ from hypervisor_tpu.liability.ledger import (
 )
 
 __all__ = [
+    "CollusionDetector",
+    "CollusionFinding",
     "LiabilityEdge",
     "LiabilityMatrix",
     "VouchingEngine",

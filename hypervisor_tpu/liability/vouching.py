@@ -109,6 +109,16 @@ class VouchingEngine:
         """Create a bond; raises VouchingError on any protocol violation."""
         if voucher_did == vouchee_did:
             raise VouchingError("Cannot vouch for yourself")
+        # Byzantine-input gate: NaN sigma/pct compare false against
+        # every threshold below and would land a NaN bond in the edge
+        # table (an escrow-conservation violation the sanitizer then
+        # flags) — refuse non-finite inputs at the protocol boundary.
+        if not np.isfinite(voucher_sigma):
+            raise VouchingError(
+                f"Voucher σ must be finite; got {voucher_sigma!r}"
+            )
+        if bond_pct is not None and not np.isfinite(bond_pct):
+            raise VouchingError(f"bond_pct must be finite; got {bond_pct!r}")
         if voucher_sigma < self.MIN_VOUCHER_SCORE:
             raise VouchingError(
                 f"Voucher σ ({voucher_sigma:.2f}) below minimum "

@@ -91,6 +91,13 @@ class EventType(str, enum.Enum):
     ROW_QUARANTINED = "integrity.row_quarantined"
     STATE_RESTORED = "integrity.state_restored"
 
+    # Adversarial governance plane (append-only, like every block above):
+    # seeded scenario lifecycle + the hardening detections it drives.
+    SCENARIO_STARTED = "adversarial.scenario_started"
+    SCENARIO_SCORED = "adversarial.scenario_scored"
+    SYBIL_DAMPED = "adversarial.sybil_damped"
+    COLLUSION_DETECTED = "adversarial.collusion_detected"
+
     @property
     def code(self) -> int:
         """int32 column code for the device event log."""

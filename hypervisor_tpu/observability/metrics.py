@@ -289,6 +289,41 @@ WAL_REPLAYED_OPS = REGISTRY.counter(
     "committed WAL records replayed by crash recovery",
 )
 
+# ── adversarial governance plane (scenario harness + hardening) ──────
+# Host-incremented by the targeted shed gate, the collusion detector,
+# the deduped slash cascade, and the scenario harness
+# (`hypervisor_tpu.adversarial`, `testing.scenarios`).
+ADMISSIONS_DAMPED = REGISTRY.counter(
+    "hv_admissions_damped_total",
+    "low-sigma joins shed by the admission-rate sybil damper "
+    "(subset of hv_admissions_shed_total)",
+)
+COLLUSION_FINDINGS = REGISTRY.counter(
+    "hv_collusion_findings_total",
+    "vouch-graph cliques flagged by the collusion detector",
+)
+CASCADE_DEDUPED = REGISTRY.counter(
+    "hv_slash_cascade_deduped_total",
+    "duplicate per-agent slash/clip events suppressed by the "
+    "visited-set cascade guard",
+)
+SCENARIO_RUNS = REGISTRY.counter(
+    "hv_scenario_runs_total",
+    "seeded adversarial scenarios executed by the harness",
+)
+SCENARIO_ATTACK_EVENTS = REGISTRY.counter(
+    "hv_scenario_attack_events_total",
+    "individual adversary actions driven against the live state",
+)
+SCENARIO_UNCONTAINED = REGISTRY.counter(
+    "hv_scenario_uncontained_total",
+    "scenario runs whose containment score fell below the floor",
+)
+SCENARIO_CONTAINMENT = REGISTRY.gauge(
+    "hv_scenario_containment_score",
+    "containment score [0, 1] of the most recent scenario run",
+)
+
 # ── integrity plane (sanitizer / scrubber / escalation ladder) ───────
 # The first four are DEVICE-written inside the sanitizer program
 # (`integrity.invariants.check_invariants`) so detection rides the

@@ -94,14 +94,27 @@ def window_commit(
     outside every window, and keeping them untouched makes a
     zero-activity wave a true no-op on the table (pinned by the
     empty-wave tests).
+
+    Non-monotonic `now` guard: `record_calls` is public API and its
+    `now=` may arrive out of order (a replayed wave, a skewed caller).
+    A stale epoch must not fail the `fresh` check and then OVERWRITE a
+    bucket already stamped with a NEWER epoch — that would erase newer
+    counts and regress the stamp, silently shrinking the window. When
+    the addressed bucket holds a newer stamp, the late calls accumulate
+    into it without touching the stamp (conservative-high counting, the
+    safe direction for a breach detector); the stamp is monotone per
+    bucket by construction.
     """
     k = BD_BUCKETS
     cur = window_epoch(now, config)
     j0 = jnp.mod(cur, k)
     touched = calls_add > 0
-    fresh = bd_window[:, 2 * k + j0] == cur
-    new_calls = jnp.where(fresh, bd_window[:, j0], 0) + calls_add
-    new_priv = jnp.where(fresh, bd_window[:, k + j0], 0) + priv_add
+    stamp = bd_window[:, 2 * k + j0]
+    stale = stamp > cur  # bucket already carries a NEWER epoch
+    keep = (stamp == cur) | stale
+    new_calls = jnp.where(keep, bd_window[:, j0], 0) + calls_add
+    new_priv = jnp.where(keep, bd_window[:, k + j0], 0) + priv_add
+    new_stamp = jnp.where(stale, stamp, cur)
     return (
         bd_window.at[:, j0]
         .set(jnp.where(touched, new_calls, bd_window[:, j0]).astype(jnp.int32))
@@ -112,7 +125,7 @@ def window_commit(
             )
         )
         .at[:, 2 * k + j0]
-        .set(jnp.where(touched, cur, bd_window[:, 2 * k + j0]))
+        .set(jnp.where(touched, new_stamp, bd_window[:, 2 * k + j0]))
     )
 
 

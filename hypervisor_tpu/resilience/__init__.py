@@ -17,12 +17,19 @@ everything else resolves lazily to avoid the state <-> recovery import
 cycle, mirroring `hypervisor_tpu.runtime`.
 """
 
-from hypervisor_tpu.resilience.policy import DegradedModeRefusal, DegradedPolicy
+from hypervisor_tpu.resilience.policy import (
+    AdmissionDamper,
+    DegradedModeRefusal,
+    DegradedPolicy,
+    SybilShedRefusal,
+)
 from hypervisor_tpu.resilience.wal import WalRecord, WriteAheadLog, scan
 
 __all__ = [
+    "AdmissionDamper",
     "DegradedModeRefusal",
     "DegradedPolicy",
+    "SybilShedRefusal",
     "RecoveryError",
     "Supervisor",
     "WalRecord",

@@ -387,15 +387,24 @@ REPLAY: dict[str, Callable[[HypervisorState, dict], None]] = {
 def replay(state: HypervisorState, records) -> int:
     """Re-execute committed WAL records against a restored state.
 
-    Journaling, fault injection, and degraded-mode policy are disabled
-    for the duration: the records already exist, chaos must not corrupt
-    a replay, and a shed policy must not refuse transitions that
-    already committed. Returns ops replayed.
+    Journaling, fault injection, degraded-mode policy, and the
+    admission damper are disabled for the duration: the records
+    already exist, chaos must not corrupt a replay, and neither a shed
+    policy nor a freshly-tripped damper (a journaled join burst all
+    lands at replay wall-clock, trivially exceeding any arrival-rate
+    threshold) may refuse transitions that already committed. Returns
+    ops replayed.
     """
-    saved = (state.journal, state.fault_injector, state.degraded_policy)
+    saved = (
+        state.journal,
+        state.fault_injector,
+        state.degraded_policy,
+        getattr(state, "admission_damper", None),
+    )
     state.journal = None
     state.fault_injector = None
     state.degraded_policy = None
+    state.admission_damper = None
     n = 0
     try:
         for rec in records:
@@ -408,7 +417,12 @@ def replay(state: HypervisorState, records) -> int:
             handler(state, rec.args)
             n += 1
     finally:
-        state.journal, state.fault_injector, state.degraded_policy = saved
+        (
+            state.journal,
+            state.fault_injector,
+            state.degraded_policy,
+            state.admission_damper,
+        ) = saved
     return n
 
 

@@ -81,6 +81,7 @@ class Supervisor:
         degrade_after_failures: Optional[int] = None,
         degrade_after_stragglers: Optional[int] = None,
         degrade_after_capacity: Optional[int] = None,
+        degrade_after_comp_backlog: Optional[int] = None,
         exit_after_clean: Optional[int] = None,
         policy: Optional[DegradedPolicy] = None,
         checkpoint_dir: Optional[str] = None,
@@ -115,6 +116,16 @@ class Supervisor:
             if degrade_after_capacity is not None
             else int(_env_float("HV_SUP_DEGRADE_CAPACITY", 2))
         )
+        # Compensation-storm backpressure: `state.saga_work` emits a
+        # `comp_backlog` health event when the COMPENSATING backlog
+        # crosses its warn line; at/above this threshold the supervisor
+        # flips degraded mode (fan-out pauses, admissions shed) so the
+        # backlog drains before new load piles on.
+        self.degrade_after_comp_backlog = (
+            degrade_after_comp_backlog
+            if degrade_after_comp_backlog is not None
+            else int(_env_float("HV_SUP_DEGRADE_COMP", 64))
+        )
         self.exit_after_clean = (
             exit_after_clean
             if exit_after_clean is not None
@@ -138,6 +149,8 @@ class Supervisor:
         self._clean_streak = 0
         self._straggler_pressure = 0
         self._capacity_pressure = 0
+        self._comp_backlog = 0
+        self.comp_backpressure_entries = 0
         self.last_error: Optional[str] = None
         self.recovery_latencies_ms: deque[float] = deque(maxlen=256)
         self.last_checkpoint: Optional[dict] = None
@@ -267,6 +280,19 @@ class Supervisor:
                         f"{self._capacity_pressure} capacity warnings since "
                         "last recovery"
                     )
+            elif kind == "comp_backlog":
+                # Absolute, not cumulative: the event carries the LIVE
+                # compensation backlog, so the pressure reading tracks
+                # it (a draining storm de-pressurizes by itself).
+                self._comp_backlog = int(payload.get("backlog", 0))
+                if self._comp_backlog >= self.degrade_after_comp_backlog:
+                    entering = self.state.degraded_policy is None
+                    reason = (
+                        f"compensation storm: {self._comp_backlog} sagas "
+                        "compensating concurrently"
+                    )
+                    if entering:
+                        self.comp_backpressure_entries += 1
         if reason is not None:
             self._enter_degraded(reason)
 
@@ -276,10 +302,28 @@ class Supervisor:
     def degraded(self) -> bool:
         return self.state.degraded_policy is not None
 
+    def _policy_lock(self):
+        """The STATE's policy-swap lock — shared with the admission
+        damper so check-and-swap on `degraded_policy` is atomic across
+        both writers. States without one share the damper module's
+        fallback (a per-call fresh Lock would serialize nothing)."""
+        from hypervisor_tpu.resilience.policy import _FALLBACK_POLICY_LOCK
+
+        lock = getattr(self.state, "_policy_lock", None)
+        return lock if lock is not None else _FALLBACK_POLICY_LOCK
+
     def _enter_degraded(self, reason: str) -> None:
-        with self._lock:
-            if self.state.degraded_policy is not None:
-                return  # already degraded; first reason stands
+        with self._lock, self._policy_lock():
+            existing = self.state.degraded_policy
+            if existing is not None and (
+                existing.shed_admissions or existing.pause_saga_fanout
+            ):
+                return  # already fully degraded; first reason stands
+            # A TARGETED policy (the sybil damper's sigma-floor shed —
+            # neither full shed nor fanout pause) must not suppress
+            # supervisor escalation: a comp-backlog storm or failure
+            # streak outranks it, so the full policy replaces it (the
+            # damper notices the swap and forgets its handle).
             policy = DegradedPolicy(
                 shed_admissions=self._policy_template.shed_admissions,
                 pause_saga_fanout=self._policy_template.pause_saga_fanout,
@@ -293,14 +337,21 @@ class Supervisor:
         self.state.health.emit_event("degraded_enter", policy.to_dict())
 
     def _exit_degraded(self) -> None:
-        with self._lock:
+        with self._lock, self._policy_lock():
             policy = self.state.degraded_policy
             if policy is None:
+                return
+            if not (policy.shed_admissions or policy.pause_saga_fanout):
+                # A TARGETED policy (the sybil damper's sigma-floor
+                # shed) is not ours to clear: the damper uninstalls it
+                # when ITS window cools. Clean dispatches during a
+                # damped flood must not leak sybils one join at a time.
                 return
             self.state.degraded_policy = None
             self.degraded_exits += 1
             self._straggler_pressure = 0
             self._capacity_pressure = 0
+            self._comp_backlog = 0
         self.state.health.emit_event(
             "degraded_exit",
             {
@@ -421,6 +472,16 @@ class Supervisor:
         # schedule, and the integrity plane all move across.
         state.resilience = self
         state.fault_injector = old.fault_injector
+        # The sybil damper is host-side hardening, not table state: it
+        # must survive a restore or a flood mid-restore resumes
+        # admitting unchecked. Its installed policy handle does NOT
+        # carry over (the fresh state starts with no degraded policy;
+        # the damper re-trips from its own window if the flood is
+        # still live).
+        damper = getattr(old, "admission_damper", None)
+        if damper is not None:
+            damper.forget_installed()
+        state.admission_damper = damper
         self.state = state
         state.health.add_listener(self._on_health_event)
         plane = getattr(old, "integrity", None)
@@ -482,6 +543,10 @@ class Supervisor:
                 "pressure": {
                     "stragglers": self._straggler_pressure,
                     "capacity_warnings": self._capacity_pressure,
+                    "comp_backlog": self._comp_backlog,
+                    "comp_backpressure_entries": (
+                        self.comp_backpressure_entries
+                    ),
                 },
                 "thresholds": {
                     "max_retries": self.max_retries,
@@ -489,6 +554,9 @@ class Supervisor:
                     "degrade_after_failures": self.degrade_after_failures,
                     "degrade_after_stragglers": self.degrade_after_stragglers,
                     "degrade_after_capacity": self.degrade_after_capacity,
+                    "degrade_after_comp_backlog": (
+                        self.degrade_after_comp_backlog
+                    ),
                     "exit_after_clean": self.exit_after_clean,
                 },
                 "recovery_latency_ms": (

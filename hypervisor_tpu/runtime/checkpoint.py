@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import threading
 from pathlib import Path
@@ -46,6 +47,8 @@ from hypervisor_tpu.tables.state import (
     SessionTable,
     VouchTable,
 )
+
+logger = logging.getLogger(__name__)
 
 _TABLE_TYPES = {
     "agents": AgentTable,
@@ -348,6 +351,24 @@ def _rebuild(data, meta: dict, config: HypervisorConfig) -> HypervisorState:
         legacy_i32 = np.asarray(data["agents.i32"])
         if legacy_i32.ndim == 2 and legacy_i32.shape[1] != AI32_WIDTH:
             n_rows = legacy_i32.shape[0]
+            if legacy_window is None:
+                # Width-5 (round-4) saves: the tumbling breach counters
+                # beyond the identity columns are dropped and the window
+                # restarts at zero. Usually harmless (the window is 60 s
+                # of transient state), but a FAST save->restore cycle —
+                # crash recovery well under window_seconds — blinds the
+                # breach detector to an agent mid-probe. Never silent:
+                # name the rows whose in-flight counters were discarded.
+                dropped = legacy_i32[:, AI32_BD_WIN_START:]
+                if dropped.size and np.any(dropped != 0):
+                    logger.warning(
+                        "legacy checkpoint migration dropped nonzero "
+                        "breach-window counters on %d agent row(s); the "
+                        "sliding window restarts empty — breach analysis "
+                        "is blind to pre-save probing until it refills "
+                        "(~window_seconds)",
+                        int(np.count_nonzero(np.any(dropped != 0, axis=1))),
+                    )
             window = (
                 np.asarray(legacy_window, np.int32)
                 if legacy_window is not None

@@ -17,12 +17,15 @@ from __future__ import annotations
 
 import ctypes
 import hashlib
+import logging
 import subprocess
 import tempfile
 from pathlib import Path
 from typing import Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 _SRC = Path(__file__).resolve().parent.parent.parent / "native" / "hv_runtime.cpp"
 _LIB_DIR = Path(tempfile.gettempdir()) / "hv_runtime_build"
@@ -315,8 +318,22 @@ class StagingQueue:
                 # AFTER the swap (supported producer/driver overlap)
                 # belong to the new epoch and keep their count. Every
                 # entry in n was counted BEFORE its push (see push()),
-                # so the subtraction is exact — no clamp needed.
+                # so the subtraction is exact — floored at 0 so the
+                # invariant is CHECKED rather than assumed: a foreign-
+                # bind race can land an entry in the other queue's
+                # buffers uncounted here, and letting the counter go
+                # negative would silently absorb (mask) a later genuine
+                # one-entry loss from the 'staged join(s) lost' detector.
                 self._staged_since_harvest -= n
+                if self._staged_since_harvest < 0:
+                    logger.warning(
+                        "staging harvest drained %d more entr%s than were "
+                        "counted as staged (foreign-bind race?); flooring "
+                        "the loss detector at 0",
+                        -self._staged_since_harvest,
+                        "y" if self._staged_since_harvest == -1 else "ies",
+                    )
+                    self._staged_since_harvest = 0
         else:
             n = self._py_cursor
             self._py_cursor = 0

@@ -54,7 +54,20 @@ from hypervisor_tpu.tables.state import (
     VouchTable,
 )
 from hypervisor_tpu.tables.struct import replace
-from hypervisor_tpu.resilience.policy import DegradedModeRefusal
+from hypervisor_tpu.resilience.policy import (
+    DegradedModeRefusal,
+    SybilShedRefusal,
+)
+
+def _comp_backlog_warn() -> int:
+    """Compensation backlog at/above which `saga_work` emits the
+    `comp_backlog` health event (the Supervisor's storm-pressure
+    signal). Read per call — like the Supervisor's `HV_SUP_*` knobs it
+    must honour an env set after import, so drills can arm it low."""
+    try:
+        return int(os.environ.get("HV_COMP_BACKLOG_WARN", "16"))
+    except ValueError:
+        return 16
 from hypervisor_tpu.runtime import StagingQueue
 
 
@@ -365,7 +378,19 @@ class HypervisorState:
         self.journal = None
         self.fault_injector = None
         self.degraded_policy = None
+        # ONE lock for swapping `degraded_policy`: the supervisor's
+        # escalation and the admission damper's install/uninstall each
+        # hold their own instance locks, so without a shared policy
+        # lock a damper uninstall could clobber a supervisor policy
+        # swapped in between its check and its write.
+        self._policy_lock = threading.Lock()
         self.resilience = None
+        # Admission-rate sybil damper (opt-in, `resilience.policy.
+        # AdmissionDamper`): consulted by `enqueue_join` on every
+        # staging attempt; trips a TARGETED degraded policy
+        # (admission_sigma_floor) so a low-sigma flood sheds at the
+        # gate while honest joins keep flowing.
+        self.admission_damper = None
         # State-integrity plane (opt-in, `hypervisor_tpu.integrity`):
         # attaching an IntegrityPlane samples the in-jit invariant
         # sanitizer at the dispatch gates below, paces the Merkle
@@ -428,15 +453,36 @@ class HypervisorState:
         if plane is not None:
             plane.on_dispatch(stage)
 
-    def _shed_gate(self) -> None:
+    def _shed_gate(self, sigma_raw: Optional[float] = None) -> None:
         """Degraded-mode admission shedding (`resilience.policy`): new
         joins are the load a degraded plane refuses LOUDLY while
-        terminations and audit commits keep flowing."""
+        terminations and audit commits keep flowing.
+
+        Two postures: `shed_admissions` refuses EVERY join (the
+        supervisor's full shed); `admission_sigma_floor` > 0 refuses
+        only joins below the floor (the sybil damper's targeted shed —
+        honest traffic flows while a low-trust flood damps)."""
         policy = self.degraded_policy
-        if policy is not None and policy.shed_admissions:
+        if policy is None:
+            return
+        if policy.shed_admissions:
             self.metrics.inc(metrics_plane.ADMISSIONS_SHED)
             raise DegradedModeRefusal(
                 f"admission shed: degraded mode active ({policy.reason})"
+            )
+        if (
+            policy.admission_sigma_floor > 0.0
+            and sigma_raw is not None
+            and sigma_raw < policy.admission_sigma_floor
+        ):
+            self.metrics.inc(metrics_plane.ADMISSIONS_SHED)
+            self.metrics.inc(metrics_plane.ADMISSIONS_DAMPED)
+            if self.admission_damper is not None:
+                self.admission_damper.note_damped()
+            raise SybilShedRefusal(
+                f"admission damped: sigma {sigma_raw:.3f} below the "
+                f"active floor {policy.admission_sigma_floor:.2f} "
+                f"({policy.reason})"
             )
 
     # ── sessions ─────────────────────────────────────────────────────
@@ -1074,6 +1120,7 @@ class HypervisorState:
         agent_did: str,
         sigma_raw: float,
         trustworthy: bool = True,
+        now: Optional[float] = None,
     ) -> int:
         """Stage one join; returns the queue slot (-1 when the wave is full).
 
@@ -1083,9 +1130,21 @@ class HypervisorState:
 
         Degraded mode SHEDS here (`DegradedModeRefusal`): new
         admissions are the load the supervisor's policy refuses while
-        terminations and audit commits keep flowing.
+        terminations and audit commits keep flowing. With a targeted
+        policy (the sybil damper's `admission_sigma_floor`) only joins
+        below the floor shed (`SybilShedRefusal`).
+
+        `now` feeds ONLY the admission damper's arrival-rate window
+        (defaults to `self.now()`); it never touches table state, so
+        WAL replay is unaffected. Seeded scenarios pass synthetic time
+        so a replay sees the identical damper trip schedule.
         """
-        self._shed_gate()
+        damper = self.admission_damper
+        if damper is not None:
+            damper.note_join(
+                self, float(sigma_raw), self.now() if now is None else now
+            )
+        self._shed_gate(float(sigma_raw))
         # Journal INSIDE the staging lock: intent seqs must allocate in
         # the same order the host indices mutate, or concurrent
         # producers make replay assign different agent slots than the
@@ -1688,12 +1747,24 @@ class HypervisorState:
             cursor=cursor,
         )
 
-    def saga_work(self) -> tuple[list[tuple[int, int]], list[tuple[int, int]]]:
+    def saga_work(
+        self, comp_budget: Optional[int] = None
+    ) -> tuple[list[tuple[int, int]], list[tuple[int, int]]]:
         """(execute, compensate) work lists for the host executor shim.
 
         execute: (saga_slot, step_idx) cursor steps of RUNNING sagas.
         compensate: (saga_slot, step_idx) reverse-order targets of
         COMPENSATING sagas.
+
+        `comp_budget` bounds the compensation list per round — the
+        backpressure valve for compensation storms (mass concurrent
+        failures flipping many sagas to COMPENSATING at once). The
+        bounded batch is DETERMINISTIC: slots settle in ascending
+        order, and each saga's reverse step order is preserved, so a
+        seeded storm drains identically on every replay. When the
+        full backlog exceeds `HV_COMP_BACKLOG_WARN` (default 16) a
+        `comp_backlog` health event fires — the Supervisor counts it
+        as degraded-mode pressure (`HV_SUP_DEGRADE_COMP`).
         """
         g = self._next_saga_slot
         if g == 0:
@@ -1720,6 +1791,16 @@ class HypervisorState:
             )[0]
             if len(committed):
                 compensate.append((int(s), int(committed[-1])))
+        backlog = len(compensate)
+        if backlog >= _comp_backlog_warn():
+            # Storm signal: the supervisor subscribes and flips degraded
+            # mode (pause fan-out, shed admissions) so the backlog
+            # drains before new load piles on.
+            self.health.emit_event(
+                "comp_backlog", {"backlog": backlog, "budget": comp_budget}
+            )
+        if comp_budget is not None and backlog > comp_budget:
+            compensate = compensate[: max(int(comp_budget), 0)]
         return execute, compensate
 
     def saga_round(

@@ -1,4 +1,5 @@
-"""Test utilities shipped with the framework (chaos injection)."""
+"""Test utilities shipped with the framework (chaos injection + the
+seeded adversarial scenario harness, `testing.scenarios`)."""
 
 from hypervisor_tpu.testing.chaos import (
     ChaosExecutorFactory,

@@ -21,15 +21,22 @@
 #      positives), and one injected sigma bit-flip must be detected at
 #      the drain and repaired in place with the Merkle chain heads
 #      untouched,
-#   6. a crash-recovery smoke gate — drive real traffic in a child
+#   6. an adversarial scenario smoke gate — one short seeded sybil
+#      flood + collusion drill against the hardened governance plane
+#      must CONTAIN (score at/above the floor, zero invariant
+#      violations, seed-replayable trace digest) while the unhardened
+#      twin must score strictly lower (defenses are load-bearing),
+#   7. a crash-recovery smoke gate — drive real traffic in a child
 #      process with a WAL + watermarked checkpoint, SIGKILL it
 #      mid-flight, recover from checkpoint + WAL replay, and assert
 #      the Merkle chain heads and /metrics session counts match the
 #      pre-kill host mirror (scripts/crash_recovery_smoke.py),
-#   7. the perf-regression gate — benchmarks/regression.py rebuilds
+#   8. the perf-regression gate — benchmarks/regression.py rebuilds
 #      BENCH_trajectory.json from the committed BENCH_r*.json history
 #      and fails on any per-bench p50 above its comparable baseline's
-#      tolerance band (cpu tolerance is wide on purpose: non-flaky).
+#      tolerance band (cpu tolerance is wide on purpose: non-flaky),
+#      plus the scenario containment floor + hardening overhead bands
+#      for rounds that ran `--scenarios`.
 # Exits non-zero if any fails; prints DOTS_PASSED for trend tracking.
 
 set -u -o pipefail
@@ -266,6 +273,40 @@ print(
 PY
 integrity_rc=$?
 
+echo "── adversarial scenario smoke gate ──"
+JAX_PLATFORMS=cpu python - <<'PY'
+from hypervisor_tpu.testing import scenarios
+
+# One short seeded sybil drill + one collusion drill: the hardened
+# defenses must CONTAIN (damper sheds the flood pre-queue, detector
+# quarantines the clique before defection), every containment
+# component — including the zero-invariant-violations clean path —
+# must hold, and the same seed must replay to the same trace digest.
+SEED = 11
+sybil = scenarios.run_scenario("sybil_flood", SEED, hardened=True)
+assert sybil.score >= scenarios.DEFAULT_CONTAINMENT_FLOOR, sybil.components
+assert sybil.components["invariants_clean"] == 1.0, sybil.components
+assert sybil.trace_digest == scenarios.run_scenario(
+    "sybil_flood", SEED, hardened=True
+).trace_digest, "sybil drill not seed-replayable"
+
+ring = scenarios.run_scenario("collusion_ring", SEED, hardened=True)
+assert ring.score >= scenarios.DEFAULT_CONTAINMENT_FLOOR, ring.components
+assert ring.components["escrow_conservation"] == 1.0, ring.components
+assert ring.components["detector_precision"] == 1.0, ring.components
+
+# The defenses must also be PROVABLY load-bearing: the unhardened twin
+# of the sybil drill fails containment.
+bare = scenarios.run_scenario("sybil_flood", SEED, hardened=False)
+assert bare.score < sybil.score, (bare.score, sybil.score)
+print(
+    "adversarial scenarios OK: sybil contained "
+    f"({sybil.score} vs {bare.score} unhardened), collusion contained "
+    f"({ring.score}), drills seed-replayable"
+)
+PY
+scenario_rc=$?
+
 echo "── crash-recovery smoke gate ──"
 JAX_PLATFORMS=cpu python scripts/crash_recovery_smoke.py
 crash_rc=$?
@@ -293,6 +334,10 @@ fi
 if [ "$integrity_rc" -ne 0 ]; then
     echo "integrity smoke gate FAILED (rc=$integrity_rc)" >&2
     exit "$integrity_rc"
+fi
+if [ "$scenario_rc" -ne 0 ]; then
+    echo "adversarial scenario smoke gate FAILED (rc=$scenario_rc)" >&2
+    exit "$scenario_rc"
 fi
 if [ "$crash_rc" -ne 0 ]; then
     echo "crash-recovery smoke gate FAILED (rc=$crash_rc)" >&2

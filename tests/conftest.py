@@ -106,7 +106,10 @@ def pytest_ignore_collect(collection_path, config):
     if collection_path.suffix == ".py" and collection_path.name.startswith(
         "test_"
     ):
-        return not _is_host_plane_file(collection_path)
+        # True ignores; None (NOT False) defers for curated files so
+        # another plugin/conftest can still ignore them — returning
+        # False would hard-override every other ignore decision.
+        return True if not _is_host_plane_file(collection_path) else None
     return None
 
 

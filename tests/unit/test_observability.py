@@ -67,10 +67,10 @@ class TestEventBus:
         # The reference's 40 typed events across 8 categories (its
         # README says 38 but its enum defines 40 — we match the enum)
         # plus the 3 health-plane events, the 4 resilience-plane
-        # events, and the 4 integrity-plane events (append-only: codes
-        # are the device-log wire format, so every earlier code stays
-        # stable).
-        assert len({t.code for t in EventType}) == len(EventType) == 51
+        # events, the 4 integrity-plane events, and the 4
+        # adversarial-plane events (append-only: codes are the
+        # device-log wire format, so every earlier code stays stable).
+        assert len({t.code for t in EventType}) == len(EventType) == 55
         assert EventType.WAVE_STRAGGLER.code == 40
         assert EventType.CAPACITY_WARNING.code == 41
         assert EventType.RECOMPILE.code == 42
@@ -82,6 +82,10 @@ class TestEventBus:
         assert EventType.SCRUB_MISMATCH.code == 48
         assert EventType.ROW_QUARANTINED.code == 49
         assert EventType.STATE_RESTORED.code == 50
+        assert EventType.SCENARIO_STARTED.code == 51
+        assert EventType.SCENARIO_SCORED.code == 52
+        assert EventType.SYBIL_DAMPED.code == 53
+        assert EventType.COLLUSION_DETECTED.code == 54
 
     def test_to_dict(self):
         event = self._emit(EventType.RING_ASSIGNED, "s1", "did:a")

@@ -147,30 +147,78 @@ def build_benchmarks(quick: bool):
     yield "delta_capture", jax.jit(merkle_ops.chain_digests), (bodies1,), S
 
     # ── merkle roots at 10 / 100 / 1000 deltas ─────────────────────────
+    # Measured through the tree unit's HOST dispatch — the path the
+    # audit plane actually takes for bulk recompute: one Mosaic MTU
+    # launch on TPU, the native C++ tree builder on CPU backends, the
+    # jitted XLA loop only where neither exists (the fallback matrix in
+    # docs/OPERATIONS.md "Audit hashing & the tree unit").
     def leaves_of(p, lanes):
-        return jnp.asarray(
-            rng.randint(0, 2**32, (lanes, p, 8), dtype=np.uint64).astype(np.uint32)
+        return rng.randint(0, 2**32, (lanes, p, 8), dtype=np.uint64).astype(
+            np.uint32
         )
 
-    mr = jax.jit(merkle_ops.merkle_root_lanes, static_argnames=())
+    mr = merkle_ops.tree_roots_host
     lanes10 = 256 if quick else 1024
-    yield "merkle_root_10_deltas", mr, (leaves_of(16, lanes10), jnp.int32(10)), lanes10
+    yield "merkle_root_10_deltas", mr, (
+        leaves_of(16, lanes10), np.full(lanes10, 10, np.int32),
+    ), lanes10
     lanes100 = 64 if quick else 256
-    yield "merkle_root_100_deltas", mr, (leaves_of(128, lanes100), jnp.int32(100)), lanes100
+    yield "merkle_root_100_deltas", mr, (
+        leaves_of(128, lanes100), np.full(lanes100, 100, np.int32),
+    ), lanes100
     lanes1k = 16 if quick else 64
-    yield "merkle_root_1000_deltas", mr, (leaves_of(1024, lanes1k), jnp.int32(1000)), lanes1k
+    yield "merkle_root_1000_deltas", mr, (
+        leaves_of(1024, lanes1k), np.full(lanes1k, 1000, np.int32),
+    ), lanes1k
 
     # ── chain_verify_50_deltas over parallel lanes ─────────────────────
     lanes_v = 128 if quick else 512
-    bodies50 = jnp.asarray(
-        rng.randint(0, 2**32, (50, lanes_v, merkle_ops.BODY_WORDS),
-                    dtype=np.uint64).astype(np.uint32)
-    )
-    recorded = merkle_ops.chain_digests(bodies50)
-    counts = jnp.full((lanes_v,), 50, jnp.int32)
-    yield "chain_verify_50_deltas", jax.jit(merkle_ops.verify_chain_digests), (
-        bodies50, recorded, counts,
+    bodies50 = rng.randint(
+        0, 2**32, (50, lanes_v, merkle_ops.BODY_WORDS), dtype=np.uint64
+    ).astype(np.uint32)
+    recorded = np.asarray(merkle_ops.chain_digests(jnp.asarray(bodies50)))
+    counts50 = np.full(lanes_v, 50, np.int32)
+    yield "chain_verify_50_deltas", merkle_ops.verify_chain_digests_host, (
+        bodies50, recorded, counts50,
     ), lanes_v
+
+    # ── scrub_sweep: one full-history Merkle sweep, budgeted strips ────
+    # The integrity plane's steady-state consumer of hash throughput:
+    # a seeded multi-session DeltaLog history fully re-verified by the
+    # scrubber (seed links, interior links, committed heads) through
+    # the same tree unit. per-op = one verified link/head.
+    from hypervisor_tpu.integrity.scrubber import MerkleScrubber
+    from hypervisor_tpu.models import SessionConfig
+    from hypervisor_tpu.state import HypervisorState
+
+    st_scrub = HypervisorState()
+    s_sess, s_turns = (4, 64) if quick else (8, 128)
+    scrub_slots = st_scrub.create_sessions_batch(
+        [f"scrub:{i}" for i in range(s_sess)],
+        SessionConfig(min_sigma_eff=0.0),
+    )
+    for t in range(s_turns):
+        for s in scrub_slots:
+            st_scrub.stage_delta(
+                int(s), 0, ts=float(t),
+                change_words=rng.randint(
+                    0, 2**32, 8, dtype=np.uint64
+                ).astype(np.uint32),
+            )
+    st_scrub.flush_deltas()
+    scrubber = MerkleScrubber(st_scrub, budget=256)
+
+    def scrub_sweep():
+        scrubber._pos = scrubber.sweep_size  # force a fresh sweep
+        verified = 0
+        while True:
+            rep = scrubber.tick()
+            verified += rep["links"] + rep["heads"]
+            if rep["sweep_completed"]:
+                return np.int64(verified)
+
+    sweep_batch = int(scrub_sweep())
+    yield "scrub_sweep", scrub_sweep, (), sweep_batch
 
     # ── session_lifecycle: admit a wave of S agents into S sessions ────
     agents = AgentTable.create(1 << (S - 1).bit_length())

@@ -67,6 +67,18 @@ DEFAULT_SCENARIO_FLOOR = 0.8
 #: damper + supervisor must be invisible on the clean path.
 DEFAULT_HARDENING_OVERHEAD = {"tpu": 2.0, "cpu": 50.0}
 
+#: Audit-plane rows every suite round must carry — the tree unit's
+#: bench coverage (ISSUE 7) must not silently vanish from the payload.
+REQUIRED_SUITE_BENCHES = (
+    "merkle_root_10_deltas",
+    "merkle_root_100_deltas",
+    "merkle_root_1000_deltas",
+    "chain_verify_50_deltas",
+)
+#: `scrub_sweep` joined the standard payload in round 9; earlier
+#: committed rounds are exempt.
+SCRUB_ROW_SINCE = 9
+
 
 def _backend_of(device: str) -> str:
     return "tpu" if "tpu" in (device or "").lower() else "cpu"
@@ -270,6 +282,22 @@ def compare(
             regressions.append(entry)
         elif ratio < 1.0 / (1.0 + tolerance):
             improvements.append(entry)
+    # Audit-row presence gate: a suite round missing the tree unit's
+    # rows regresses COVERAGE even if every present number is fine.
+    if current.get("format") == "suite":
+        required = list(REQUIRED_SUITE_BENCHES)
+        if current["round"] >= SCRUB_ROW_SINCE:
+            required.append("scrub_sweep")
+        for name in required:
+            if name not in current["benches"]:
+                entry = {
+                    "bench": f"missing:{name}",
+                    "current_per_op_us": 0.0,
+                    "baseline_per_op_us": 0.0,
+                    "ratio": 0.0,
+                }
+                checked.append(entry)
+                regressions.append(entry)
     # Integrity gate: a round that ran the corruption drill must keep
     # the sanitizer's clean-path overhead inside the backend's band.
     integrity = current.get("integrity")

@@ -8,6 +8,7 @@ from hypervisor_tpu.audit.delta import (
     merkle_root_host,
 )
 from hypervisor_tpu.audit.commitment import CommitmentEngine, CommitmentRecord
+from hypervisor_tpu.audit.frontier import MerkleFrontier
 from hypervisor_tpu.audit.gc import EphemeralGC, GCResult, RetentionPolicy
 
 __all__ = [
@@ -18,6 +19,7 @@ __all__ = [
     "merkle_root_device",
     "CommitmentEngine",
     "CommitmentRecord",
+    "MerkleFrontier",
     "EphemeralGC",
     "GCResult",
     "RetentionPolicy",

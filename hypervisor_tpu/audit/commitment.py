@@ -78,6 +78,25 @@ class CommitmentEngine:
             session_id, words_to_hex(root_words), participant_dids, delta_count
         )
 
+    def commit_frontier(
+        self,
+        session_id: str,
+        frontier,
+        participant_dids: list[str],
+    ) -> CommitmentRecord:
+        """Commit straight from a session's incremental Merkle frontier
+        (`audit.frontier.MerkleFrontier`): the root folds in O(log n)
+        hashes and the delta count is the frontier's leaf count — no
+        history re-hash at session end."""
+        root = frontier.root_hex()
+        if root is None:
+            raise ValueError(f"empty frontier for {session_id}: nothing to commit")
+        return self.commit(session_id, root, participant_dids, frontier.count)
+
+    def verify_frontier(self, session_id: str, frontier) -> bool:
+        root = frontier.root_hex()
+        return root is not None and self.verify(session_id, root)
+
     def verify(self, session_id: str, expected_root: str) -> bool:
         """Does the latest commitment for the session carry this root?"""
         latest = self.get_commitment(session_id)

@@ -536,8 +536,9 @@ class Hypervisor:
         """Terminate, commit the audit trail, release bonds, GC, archive.
 
         The device wave is authoritative: staged deltas flush to the
-        DeltaLog and `terminate_sessions` computes the Merkle root on
-        device (bit-identical leaves to the host chain), releases
+        DeltaLog and `terminate_sessions` folds the Merkle root from the
+        session's incremental frontier (O(log n) hashes over leaves
+        bit-identical to the host chain — `audit/frontier.py`), releases
         session-scoped bonds in the VouchTable, deactivates participants,
         and archives the session row. Returns the Merkle-root summary
         hash (None when audit is disabled).

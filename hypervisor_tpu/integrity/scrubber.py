@@ -19,15 +19,20 @@ Each tick:
      from the zero seed only when the session still holds its full
      history (an evicted prefix leaves that link unverifiable, by
      design), and the LAST row must equal the committed chain head,
-  3. runs ONE jitted batch over the strip (`ops.merkle.verify_chain_
-     links` — Pallas sha256 on TPU, the pure-XLA path elsewhere; lanes
-     are padded to the static budget so the program compiles once),
+  3. runs ONE batch over the strip through the tree unit — the Pallas
+     MTU/sha256 kernels on TPU (`ops.merkle.verify_chain_links`, lanes
+     padded to the static budget so the program compiles once), or the
+     native C++ hash unit on CPU backends (`ops.merkle.
+     verify_chain_links_host`: one `sha256_batch` sweep, no XLA
+     dispatch at all),
   4. reports mismatching rows; the integrity plane escalates them
      (a chain that does not re-hash is restore-class damage — there is
      no in-place repair for a lying audit trail).
 
-Pacing knobs (env, read at construction): `HV_SCRUB_BUDGET` links per
-tick (default 64).
+Pacing knobs (env): `HV_SCRUB_BUDGET` links per tick (default 64, read
+at construction); `HV_SCRUB_NATIVE` 1/0 forces the host/native strip
+path on or off (read per tick; default auto — native whenever the
+Pallas unit isn't the active hash backend and the C++ library built).
 """
 
 from __future__ import annotations
@@ -156,6 +161,28 @@ class MerkleScrubber:
     def position(self) -> int:
         return self._pos
 
+    def _native_strip(self) -> bool:
+        """Route this tick's strip through the host/native hash unit?
+
+        `HV_SCRUB_NATIVE` (read per tick, post-import arming) forces 1/0;
+        auto routes native whenever the Pallas unit is NOT the active
+        hash backend (so the jitted XLA fallback would run instead) and
+        the C++ library built — one `sha256_batch` sweep beats the XLA
+        strip program on CPU hosts by an order of magnitude.
+        """
+        env = os.environ.get("HV_SCRUB_NATIVE")
+        if env is not None and env != "":
+            return env not in ("0", "false", "no", "off")
+        from hypervisor_tpu.ops import sha256 as sha_ops
+        from hypervisor_tpu.runtime import native
+
+        pallas = (
+            self.use_pallas
+            if self.use_pallas is not None
+            else sha_ops._pallas_enabled()
+        )
+        return not pallas and native.HAVE_NATIVE
+
     # -- one paced tick -------------------------------------------------
 
     def tick(self) -> dict:
@@ -189,17 +216,24 @@ class MerkleScrubber:
             valid = np.zeros(b, bool)
             for i, (row, prow, use_seed, _sess) in enumerate(strip):
                 rows[i], prev[i], seed[i], valid[i] = row, prow, use_seed, True
-            ok = np.asarray(
-                _VERIFY_LINKS(
-                    self.state.delta_log.body,
-                    self.state.delta_log.digest,
-                    jnp.asarray(rows),
-                    jnp.asarray(prev),
-                    jnp.asarray(seed),
-                    jnp.asarray(valid),
-                    use_pallas=self.use_pallas,
+            if self._native_strip():
+                ok = merkle_ops.verify_chain_links_host(
+                    np.asarray(self.state.delta_log.body),
+                    np.asarray(self.state.delta_log.digest),
+                    rows, prev, seed, valid,
                 )
-            )
+            else:
+                ok = np.asarray(
+                    _VERIFY_LINKS(
+                        self.state.delta_log.body,
+                        self.state.delta_log.digest,
+                        jnp.asarray(rows),
+                        jnp.asarray(prev),
+                        jnp.asarray(seed),
+                        jnp.asarray(valid),
+                        use_pallas=self.use_pallas,
+                    )
+                )
             self.links_verified += len(strip)
             for i, (row, prow, use_seed, _sess) in enumerate(strip):
                 if not ok[i]:

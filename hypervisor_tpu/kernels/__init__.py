@@ -10,6 +10,12 @@ Kernels:
  - `sha256_pallas.sha256_words`: batched FIPS 180-4 digests, fully unrolled
    64-round compression on [8, 128] u32 register tiles (1024 messages per
    grid step).
+ - `mtu_pallas.tree_roots`: the Merkle Tree Unit — a whole forest's
+   layer-merged reduction in one launch (bit-reversed half-split layout,
+   every level's digests staying in VMEM).
+ - `mtu_pallas.chain_digests_mtu`: multi-chain sequential hashing — a
+   whole [T, L] chain wave in one launch, the parent carry held in
+   kernel scratch across the sequential grid.
 """
 
 from hypervisor_tpu.kernels.sha256_pallas import (
@@ -18,10 +24,22 @@ from hypervisor_tpu.kernels.sha256_pallas import (
     sha256_words_reference,
     sha256_words_unrolled_np,
 )
+from hypervisor_tpu.kernels.mtu_pallas import (
+    chain_digests_mtu,
+    chain_digests_np,
+    mtu_available,
+    tree_roots,
+    tree_roots_np,
+)
 
 __all__ = [
     "pallas_available",
     "sha256_words",
     "sha256_words_reference",
     "sha256_words_unrolled_np",
+    "mtu_available",
+    "tree_roots",
+    "tree_roots_np",
+    "chain_digests_mtu",
+    "chain_digests_np",
 ]

@@ -1,0 +1,361 @@
+"""Pallas TPU kernels: the Merkle Tree Unit (MTU).
+
+Batched tree hashing as a first-class pipeline (after "MTU: The
+Multifunction Tree Unit for Accelerating Zero-Knowledge Proofs" —
+PAPERS.md): instead of launching one SHA-256 program per tree level
+(`ops/merkle.py`'s per-level `sha256_hex_pair` calls) or one per chain
+link (`ops/sha256.py`'s `lax.scan` step), ONE kernel launch hashes many
+chains / many tree levels, keeping every intermediate digest in VMEM.
+
+Two programs:
+
+* **`tree_roots`** — layer-merged Merkle reduction. Leaves are
+  pre-permuted (in XLA, once) into *bit-reversed* node order, which
+  turns every level's sibling pairing into a contiguous half-split:
+  level k's left children are the block's first half and its right
+  children the second half, so the whole log2(P)-level reduction is
+  straight-line vector code with static slices — no gathers, no
+  inter-level HBM round trips. Odd-tail duplication (reference
+  semantics: `right := left` past the leaf count) becomes a compare of
+  the dynamic count against a per-level constant natural-index iota.
+  Grid = one session per step; digest words live as `[1, m]` vector
+  rows across the node axis.
+
+* **`chain_digests_mtu`** — multi-chain sequential hashing. The grid is
+  `(lane_tiles, T)` with T innermost; a VMEM scratch carries the running
+  parent digests across the T grid steps (TPU grids execute
+  sequentially), so the entire `[T, L]` chain wave is one launch: the
+  lane-packed message schedule (each SHA word an `[8, 128]` tile over
+  1024 lanes, as in `kernels/sha256_pallas.py`) with the scan carry
+  folded into the kernel instead of returning to XLA per turn.
+
+Both kernels share `_compress_unrolled` with `sha256_pallas` — the same
+fully unrolled, register-window compression — and both have numpy twins
+(`tree_roots_np`, `chain_digests_np`) that execute the identical Python
+math on plain numpy arrays for CPU parity testing (XLA:CPU cannot
+compile the unrolled form in reasonable time; see
+`sha256_pallas.sha256_words_unrolled_np`). The compiled `pallas_call`
+path is exercised on the real chip. The production CPU fallback for
+bulk tree work is the native C++ unit (`runtime/native.py`), dispatched
+by `ops.merkle.tree_roots_host`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.kernels.sha256_pallas import (
+    LANE,
+    SUB,
+    TILE,
+    _compress_unrolled,
+    pallas_available,
+)
+from hypervisor_tpu.ops.sha256 import _H0, pad_tail_words
+
+try:  # pragma: no cover - import guard (mirrors sha256_pallas)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_IMPORTED = True
+except Exception:  # pragma: no cover
+    _PALLAS_IMPORTED = False
+
+# Padding words shared with ops/merkle.py's message formats:
+#   hex-pair combine: 128-byte ASCII message -> 3 blocks, 16 tail words.
+#   chain link:        96-byte binary message -> 2 blocks,  8 tail words.
+_PAIR_TAIL = pad_tail_words(128, 3)
+_CHAIN_TAIL = pad_tail_words(96, 2)
+
+# VMEM envelope: a P-leaf tree holds one level (8 u32 words x P nodes)
+# plus the 48-word message expansion of the widest level in flight;
+# P = 4096 stays ~1.7 MB — far under budget, but cap it so a grown
+# DeltaLog capacity can't silently compile an over-VMEM kernel.
+TREE_MAX_LEAVES = 4096
+
+
+def mtu_available() -> bool:
+    """True when the Mosaic tree unit can run on the default backend."""
+    return _PALLAS_IMPORTED and pallas_available()
+
+
+# ── shared backend-agnostic math (jnp tiles in-kernel, numpy in twins) ──
+
+
+def _zeros_like_word(w):
+    return w & np.uint32(0)
+
+
+def _hex_words(word):
+    """u32 array -> (hi, lo): the two big-endian u32 words of its
+    8-char ASCII hex expansion (branch-free nibble arithmetic — the
+    same trick as `ops.sha256._words_to_hex_words`)."""
+    out = []
+    for half_shift in (16, 0):
+        h = (word >> np.uint32(half_shift)) & np.uint32(0xFFFF)
+        chars = []
+        for s in (12, 8, 4, 0):
+            n = (h >> np.uint32(s)) & np.uint32(0xF)
+            chars.append(
+                n
+                + np.uint32(0x30)
+                + (n > 9).astype(word.dtype) * np.uint32(0x27)
+            )
+        out.append(
+            (chars[0] << np.uint32(24))
+            | (chars[1] << np.uint32(16))
+            | (chars[2] << np.uint32(8))
+            | chars[3]
+        )
+    return out[0], out[1]
+
+
+def _iv_state(z):
+    return [z + np.uint32(int(_H0[j])) for j in range(8)]
+
+
+def _hash_pair(left8, right8):
+    """Batched sha256(hex(left)+hex(right)) over digest word lists.
+
+    left8/right8: 8 same-shaped u32 arrays each (digest words). Returns
+    8 arrays. Bit-compatible with `ops.sha256.sha256_hex_pair`.
+    """
+    z = _zeros_like_word(left8[0])
+    block1 = [w for l in left8 for w in _hex_words(l)]
+    block2 = [w for r in right8 for w in _hex_words(r)]
+    block3 = [z + np.uint32(int(t)) for t in _PAIR_TAIL]
+    state = _iv_state(z)
+    for blk in (block1, block2, block3):
+        state = _compress_unrolled(state, blk)
+    return state
+
+
+def _hash_chain_link(body16, parent8):
+    """Batched sha256(body_bytes || parent_bytes): 16 body words + 8
+    parent words + constant padding -> 2 blocks. Bit-compatible with
+    `ops.merkle.chain_digests`' per-step message."""
+    z = _zeros_like_word(body16[0])
+    tail = [z + np.uint32(int(t)) for t in _CHAIN_TAIL]
+    state = _iv_state(z)
+    state = _compress_unrolled(state, list(body16))
+    state = _compress_unrolled(state, list(parent8) + tail)
+    return state
+
+
+def _bitrev_indices(n: int) -> np.ndarray:
+    """Bit-reversal permutation over n (a power of two) indices."""
+    bits = (n - 1).bit_length() if n > 1 else 0
+    idx = np.arange(n, dtype=np.int64)
+    rev = np.zeros(n, np.int64)
+    for b in range(bits):
+        rev |= ((idx >> b) & 1) << (bits - 1 - b)
+    return rev
+
+
+@functools.lru_cache(maxsize=None)
+def _natural_pair_index(half: int) -> np.ndarray:
+    """i32[1, half]: the NATURAL pair index at each stored (bit-reversed)
+    position of a level's combine output — the constant the dynamic
+    leaf count compares against for odd-tail duplication."""
+    return _bitrev_indices(half).astype(np.int32)[None, :]
+
+
+def _reduce_tree(level, cnt, where):
+    """Layer-merged Merkle reduction over bit-reversed-ordered nodes.
+
+    Args:
+      level: 8 u32 arrays shaped [..., P] (digest words; node axis last,
+        nodes in bit-reversed order). P a power of two.
+      cnt: i32 array broadcastable against [..., half] (dynamic leaf
+        count; scalar in-kernel, [S, 1] in the numpy twin).
+      where: jnp.where in-kernel, np.where in the twin.
+
+    Returns:
+      8 arrays [..., 1] — the root (natural node 0 is stored position 0).
+    """
+    m = level[0].shape[-1]
+    while m > 1:
+        half = m // 2
+        left = [w[..., :half] for w in level]
+        right = [w[..., half:m] for w in level]
+        nat = _natural_pair_index(half)
+        dup = (2 * nat + 1) >= cnt  # odd tail: right := left
+        right = [where(dup, l, r) for l, r in zip(left, right)]
+        combined = _hash_pair(left, right)
+        descend = cnt > 1
+        level = [where(descend, c, l) for c, l in zip(combined, left)]
+        cnt = where(descend, (cnt + 1) // 2, cnt)
+        m = half
+    return level
+
+
+# ── tree kernel ──────────────────────────────────────────────────────
+
+
+def _tree_kernel(p: int, leaves_ref, cnt_ref, out_ref):
+    # leaves_ref: [1, 8, P] VMEM (word-major, bit-reversed node order);
+    # cnt_ref: [1, 1] SMEM; out_ref: [1, 8, LANE] VMEM.
+    level = [leaves_ref[0, j : j + 1, :] for j in range(8)]  # 8 x [1, P]
+    cnt = cnt_ref[0, 0]
+    root = _reduce_tree(level, cnt, jnp.where)
+    for j in range(8):
+        out_ref[0, j : j + 1, :] = jnp.broadcast_to(root[j], (1, LANE))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def tree_roots(
+    leaves: jnp.ndarray, counts: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Per-session Merkle roots in ONE kernel launch.
+
+    Args:
+      leaves: u32[S, P, 8] leaf digests in natural order, P a static
+        power of two (<= TREE_MAX_LEAVES).
+      counts: i32[S] (or scalar) dynamic leaf counts, 0 <= count <= P.
+      interpret: run under the Pallas interpreter (CPU testing).
+
+    Returns:
+      u32[S, 8] roots, bit-identical to `ops.merkle.merkle_root_lanes`.
+    """
+    s, p, _ = leaves.shape
+    assert p & (p - 1) == 0, "leaf capacity must be a power of two"
+    assert p <= TREE_MAX_LEAVES, f"tree unit caps at {TREE_MAX_LEAVES} leaves"
+    p_pad = max(p, LANE)
+    if p_pad != p:
+        leaves = jnp.pad(leaves, ((0, 0), (0, p_pad - p), (0, 0)))
+    # Bit-reversal permute ONCE in XLA; in-kernel pairing then degrades
+    # to contiguous half-splits at every level.
+    perm = jnp.asarray(_bitrev_indices(p_pad))
+    lv = leaves[:, perm, :].transpose(0, 2, 1)  # [S, 8, P'] word-major
+    cnt = jnp.broadcast_to(
+        jnp.asarray(counts, jnp.int32), (s,)
+    ).reshape(s, 1)
+    out = pl.pallas_call(
+        functools.partial(_tree_kernel, p_pad),
+        grid=(s,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 8, p_pad), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 8, LANE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((s, 8, LANE), jnp.uint32),
+        interpret=interpret,
+    )(lv, cnt)
+    return out[:, :, 0]
+
+
+def tree_roots_np(leaves: np.ndarray, counts) -> np.ndarray:
+    """The tree kernel's exact math and layout, run in numpy.
+
+    CPU parity harness for `tree_roots` (same bit-reversed layout, same
+    `_reduce_tree`, same `_compress_unrolled`) — no XLA involved, so it
+    verifies the kernel's hashing against `ops.merkle.merkle_root_lanes`
+    where the Mosaic path itself cannot compile.
+    """
+    leaves = np.asarray(leaves, np.uint32)
+    s, p, _ = leaves.shape
+    assert p & (p - 1) == 0
+    p_pad = max(p, LANE)
+    if p_pad != p:
+        leaves = np.pad(leaves, ((0, 0), (0, p_pad - p), (0, 0)))
+    lv = leaves[:, _bitrev_indices(p_pad), :]
+    level = [np.ascontiguousarray(lv[:, :, j]) for j in range(8)]  # [S, P']
+    cnt = np.broadcast_to(np.asarray(counts, np.int32), (s,)).reshape(s, 1)
+    root = _reduce_tree(level, cnt, np.where)
+    return np.stack([w[:, 0] for w in root], axis=1).astype(np.uint32)
+
+
+# ── multi-chain kernel ───────────────────────────────────────────────
+
+
+def _chain_kernel(body_ref, seed_ref, out_ref, carry):
+    # grid = (lane_tiles, T), T innermost: `carry` persists the running
+    # parent digests across the sequential T steps of one lane tile.
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        carry[...] = seed_ref[0]
+
+    parent = [carry[j] for j in range(8)]
+    block1 = [body_ref[0, 0, j] for j in range(16)]
+    state = _hash_chain_link(block1, parent)
+    for j in range(8):
+        out_ref[0, 0, j] = state[j]
+        carry[j] = state[j]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def chain_digests_mtu(
+    bodies: jnp.ndarray, seeds: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Sequential chain hashing over parallel lanes, ONE kernel launch.
+
+    Args:
+      bodies: u32[T, L, 16] — T sequential turns over L parallel chains.
+      seeds: u32[L, 8] per-lane chain seeds (zeros = genesis).
+      interpret: run under the Pallas interpreter (CPU testing).
+
+    Returns:
+      u32[T, L, 8] per-turn digests, bit-identical to
+      `ops.merkle.chain_digests`' lax.scan formulation.
+    """
+    t, l, _ = bodies.shape
+    lt = max(1, -(-l // TILE))
+    pad = lt * TILE - l
+    bodies_p = jnp.pad(bodies, ((0, 0), (0, pad), (0, 0)))
+    seeds_p = jnp.pad(seeds, ((0, pad), (0, 0)))
+    # [T, L', 16] -> [LT, T, 16, SUB, LANE]: each message word one tile.
+    tiled = bodies_p.reshape(t, lt, SUB, LANE, 16).transpose(1, 0, 4, 2, 3)
+    seeds_t = seeds_p.reshape(lt, SUB, LANE, 8).transpose(0, 3, 1, 2)
+    out = pl.pallas_call(
+        _chain_kernel,
+        grid=(lt, t),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, 16, SUB, LANE),
+                lambda i, j: (i, j, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 8, SUB, LANE),
+                lambda i, j: (i, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, 8, SUB, LANE),
+            lambda i, j: (i, j, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((lt, t, 8, SUB, LANE), jnp.uint32),
+        scratch_shapes=[pltpu.VMEM((8, SUB, LANE), jnp.uint32)],
+        interpret=interpret,
+    )(tiled, seeds_t)
+    # [LT, T, 8, SUB, LANE] -> [T, L, 8]
+    res = out.transpose(1, 0, 3, 4, 2).reshape(t, lt * TILE, 8)
+    return res[:, :l]
+
+
+def chain_digests_np(bodies: np.ndarray, seeds: np.ndarray) -> np.ndarray:
+    """The chain kernel's exact per-step math, run in numpy (CPU parity
+    harness for `chain_digests_mtu`; same caveats as `tree_roots_np`)."""
+    bodies = np.asarray(bodies, np.uint32)
+    t, l, _ = bodies.shape
+    parent = [np.ascontiguousarray(np.asarray(seeds, np.uint32)[:, j]) for j in range(8)]
+    out = np.zeros((t, l, 8), np.uint32)
+    for turn in range(t):
+        block1 = [bodies[turn, :, j] for j in range(16)]
+        state = _hash_chain_link(block1, parent)
+        for j in range(8):
+            out[turn, :, j] = state[j]
+        parent = state
+    return out

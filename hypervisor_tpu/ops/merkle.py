@@ -6,13 +6,17 @@ Reference semantics (`audit/delta.py`):
  - each delta's hash covers its parent's hash (chain, `:102,111-113`)
 
 Device design: leaves live as u32[P,8] digest words (P = static pow2
-capacity, count dynamic). The tree is an unrolled log2(P) sequence of
-batched hex-pair hashes; per-level odd-duplication is a masked select, so a
-root over `count` leaves is bit-identical to the reference's Python loop.
-The chain is the one genuinely sequential structure: a `lax.scan` whose
-carry is the parent digest, hashing fixed-width binary delta bodies — bodies
-are hashed with their parent folded in, batched across independent session
-lanes so the VPU stays full.
+capacity, count dynamic). On TPU the whole tree reduces in ONE Mosaic
+launch (`kernels/mtu_pallas.tree_roots` — layer-merged, level k+1
+consumes level k in VMEM) and the chain wave is one launch too
+(`chain_digests_mtu`, carry held in kernel scratch across the grid).
+The pure-XLA formulations below are the CPU/compat fallback: an
+unrolled log2(P) sequence of batched hex-pair hashes with masked
+odd-duplication, and a `lax.scan` whose carry is the parent digest —
+all three paths bit-identical (parity-tested). Host callers with
+concrete arrays should use `tree_roots_host` / `verify_chain_*_host`,
+which additionally route bulk work through the native C++ hash unit
+(`runtime/native.py`) on CPU backends.
 """
 
 from __future__ import annotations
@@ -21,6 +25,7 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
+from hypervisor_tpu.ops import sha256 as sha_ops
 from hypervisor_tpu.ops.sha256 import (
     pad_tail_words,
     sha256_blocks_dispatch,
@@ -49,22 +54,7 @@ def merkle_root(
       u32[8] root digest. For count == 1 the root is the single leaf
       (matching the reference's while-loop which never combines a lone node).
     """
-    p = digests.shape[0]
-    assert p & (p - 1) == 0, "leaf capacity must be a power of two"
-    arr = digests
-    cnt = jnp.asarray(count, jnp.int32)
-    while arr.shape[0] > 1:
-        half = arr.shape[0] // 2
-        left = arr[0::2]
-        right = arr[1::2]
-        j = jnp.arange(half, dtype=jnp.int32)
-        dup = (2 * j + 1) >= cnt  # odd tail: right := left
-        right = jnp.where(dup[:, None], left, right)
-        combined = sha256_hex_pair(left, right, use_pallas)
-        descend = cnt > 1
-        arr = jnp.where(descend, combined, left)
-        cnt = jnp.where(descend, (cnt + 1) // 2, cnt)
-    return arr[0]
+    return merkle_root_lanes(digests[None, :, :], count, use_pallas)[0]
 
 
 def merkle_root_lanes(
@@ -74,12 +64,23 @@ def merkle_root_lanes(
 ) -> jnp.ndarray:
     """Per-lane Merkle roots: u32[S, P, 8] leaves -> u32[S, 8] roots.
 
-    Same odd-duplication semantics as `merkle_root`, with the S session
-    lanes flattened into the hash batch at every level so the VPU sees one
+    Same odd-duplication semantics as `merkle_root`. On the Pallas path
+    the whole [S, P] forest reduces in ONE MTU launch (layer-merged: no
+    per-level program returns); the XLA fallback flattens the S session
+    lanes into the hash batch at every level so the VPU sees one
     [S * P/2] wave per level instead of S tiny trees.
     """
     s, p, _ = digests.shape
     assert p & (p - 1) == 0
+    if use_pallas is None:
+        use_pallas = sha_ops._pallas_enabled()
+    if use_pallas and p > 1:
+        from hypervisor_tpu.kernels import mtu_pallas
+
+        if p <= mtu_pallas.TREE_MAX_LEAVES:
+            return mtu_pallas.tree_roots(
+                digests, jnp.broadcast_to(jnp.asarray(count, jnp.int32), (s,))
+            )
     arr = digests
     cnt = jnp.broadcast_to(jnp.asarray(count, jnp.int32), (s,))
     while arr.shape[1] > 1:
@@ -122,6 +123,15 @@ def chain_digests(
         # Varying zeros (derived from bodies) so the scan carry type is
         # consistent under shard_map.
         seed = bodies[0, :, :8] & jnp.uint32(0)
+    if use_pallas is None:
+        use_pallas = sha_ops._pallas_enabled()
+    if use_pallas:
+        # MTU multi-chain kernel: the whole [N, L] chain wave in one
+        # launch, the scan carry held in kernel scratch across grid
+        # steps instead of returning to XLA per turn.
+        from hypervisor_tpu.kernels import mtu_pallas
+
+        return mtu_pallas.chain_digests_mtu(bodies, seed)
     tail = jnp.broadcast_to(
         jnp.asarray(_CHAIN_TAIL, jnp.uint32), (lanes, _CHAIN_TAIL.shape[0])
     )
@@ -202,6 +212,174 @@ def verify_chain_links(
     recomputed = sha256_blocks_dispatch(msg, 2, use_pallas)
     ok = jnp.all(recomputed == digest[safe_rows], axis=-1)
     return ok | ~valid
+
+
+# ── host entries: the tree unit's dispatch for concrete arrays ───────
+#
+# Fallback matrix (docs/OPERATIONS.md "Audit hashing & the tree unit"):
+#   TPU backend      -> one Mosaic MTU launch (kernels/mtu_pallas)
+#   CPU + native lib -> the C++ hash unit (runtime/native.py)
+#   otherwise        -> the jitted pure-XLA formulations above
+# All three are bit-identical; dispatch never changes results.
+
+_TREE_JIT = None
+_VERIFY_JIT = None
+
+
+def _tree_jit():
+    global _TREE_JIT
+    if _TREE_JIT is None:
+        import jax
+
+        _TREE_JIT = jax.jit(
+            merkle_root_lanes, static_argnames=("use_pallas",)
+        )
+    return _TREE_JIT
+
+
+def tree_roots_host(
+    leaves: np.ndarray,
+    counts: np.ndarray,
+    use_pallas: bool | None = None,
+) -> np.ndarray:
+    """Per-session Merkle roots over concrete (host) leaf arrays.
+
+    Args:
+      leaves: u32[S, P, 8] leaf digests, P a power of two.
+      counts: i32[S] (or scalar) valid leaves per lane.
+
+    Returns:
+      u32[S, 8] roots (count <= 1 lanes return their first leaf, the
+      device semantics).
+    """
+    leaves = np.asarray(leaves, np.uint32)
+    s, p, _ = leaves.shape
+    counts = np.broadcast_to(np.asarray(counts, np.int32), (s,))
+    if use_pallas is None:
+        use_pallas = sha_ops._pallas_enabled()
+    if use_pallas:
+        return np.asarray(
+            _tree_jit()(jnp.asarray(leaves), jnp.asarray(counts), use_pallas=True)
+        )
+    from hypervisor_tpu.runtime import native
+
+    if native.HAVE_NATIVE:
+        roots = np.zeros((s, 8), np.uint32)
+        for i in range(s):
+            c = int(counts[i])
+            if c <= 1:
+                roots[i] = leaves[i, 0]
+                continue
+            leaf_bytes = (
+                np.ascontiguousarray(leaves[i, :c].astype(">u4"))
+                .view(np.uint8)
+                .reshape(c, 32)
+            )
+            roots[i] = sha_ops.hex_to_words(
+                [native.merkle_root_hex_host(leaf_bytes)]
+            )[0]
+        return roots
+    return np.asarray(
+        _tree_jit()(jnp.asarray(leaves), jnp.asarray(counts), use_pallas=False)
+    )
+
+
+def verify_chain_digests_host(
+    bodies: np.ndarray,
+    recorded: np.ndarray,
+    counts: np.ndarray,
+    use_pallas: bool | None = None,
+) -> np.ndarray:
+    """`verify_chain_digests` for concrete arrays, through the unit's
+    host dispatch (native C++ chains on CPU). Zero-seed chains only —
+    the DeltaLog's full-history format."""
+    bodies = np.asarray(bodies, np.uint32)
+    recorded = np.asarray(recorded, np.uint32)
+    n, lanes, _ = bodies.shape
+    counts = np.broadcast_to(np.asarray(counts, np.int32), (lanes,))
+    if use_pallas is None:
+        use_pallas = sha_ops._pallas_enabled()
+    if use_pallas:
+        global _VERIFY_JIT
+        if _VERIFY_JIT is None:
+            import jax
+
+            _VERIFY_JIT = jax.jit(
+                verify_chain_digests, static_argnames=("use_pallas",)
+            )
+        return np.asarray(
+            _VERIFY_JIT(
+                jnp.asarray(bodies),
+                jnp.asarray(recorded),
+                jnp.asarray(counts),
+                use_pallas=True,
+            )
+        )
+    from hypervisor_tpu.runtime import native
+
+    ok = np.zeros((lanes,), bool)
+    rec_bytes = (
+        np.ascontiguousarray(recorded.astype(">u4"))
+        .view(np.uint8)
+        .reshape(n, lanes, 32)
+    )
+    for lane in range(lanes):
+        c = int(counts[lane])
+        if c <= 0:
+            ok[lane] = True
+            continue
+        ok[lane] = (
+            native.verify_chain_host(
+                np.ascontiguousarray(bodies[:c, lane]),
+                np.ascontiguousarray(rec_bytes[:c, lane]),
+            )
+            == -1
+        )
+    return ok
+
+
+def verify_chain_links_host(
+    body_col: np.ndarray,
+    digest_col: np.ndarray,
+    rows: np.ndarray,
+    prev_rows: np.ndarray,
+    use_seed: np.ndarray,
+    valid: np.ndarray,
+) -> np.ndarray:
+    """`verify_chain_links` for concrete arrays: one batched native (or
+    hashlib) sha256 sweep over the strip's 96-byte link messages —
+    the scrubber's CPU fast path, no XLA dispatch at all."""
+    from hypervisor_tpu.runtime import native
+
+    body_col = np.asarray(body_col, np.uint32)
+    digest_col = np.asarray(digest_col, np.uint32)
+    rows = np.asarray(rows, np.int64)
+    prev_rows = np.asarray(prev_rows, np.int64)
+    b = rows.shape[0]
+    safe_rows = np.clip(rows, 0, body_col.shape[0] - 1)
+    safe_prev = np.clip(prev_rows, 0, digest_col.shape[0] - 1)
+    parent = np.where(
+        np.asarray(use_seed)[:, None],
+        np.zeros((b, 8), np.uint32),
+        digest_col[safe_prev],
+    )
+    msg = np.zeros((b, 96), np.uint8)
+    msg[:, :64] = (
+        np.ascontiguousarray(body_col[safe_rows].astype(">u4"))
+        .view(np.uint8)
+        .reshape(b, 64)
+    )
+    msg[:, 64:] = (
+        np.ascontiguousarray(parent.astype(">u4")).view(np.uint8).reshape(b, 32)
+    )
+    got = native.sha256_batch_host(msg)
+    want = (
+        np.ascontiguousarray(digest_col[safe_rows].astype(">u4"))
+        .view(np.uint8)
+        .reshape(b, 32)
+    )
+    ok = (got == want).all(axis=1)
+    return ok | ~np.asarray(valid, bool)
 
 
 def pack_delta_bodies(

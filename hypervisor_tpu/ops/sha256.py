@@ -83,9 +83,10 @@ def _compress_block(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
     return state + out
 
 
-# Pallas dispatch: None = auto (Mosaic kernel on TPU backends, XLA loop
-# elsewhere); True/False force. The kernel is bit-identical (see
-# tests/parity/test_pallas_sha256.py) so dispatch never changes results.
+# Pallas dispatch: None = auto (HV_SHA256_PALLAS env if set, else the
+# Mosaic kernel on TPU backends, XLA loop elsewhere); True/False force.
+# The kernel is bit-identical (see tests/parity/test_pallas_sha256.py)
+# so dispatch never changes results.
 _USE_PALLAS: bool | None = None
 
 
@@ -94,7 +95,8 @@ def set_pallas(enabled: bool | None) -> None:
 
     Dispatch is baked in at trace time, so already-compiled jitted callers
     would ignore a later override; clear jax's compilation caches to make
-    the new setting take effect everywhere.
+    the new setting take effect everywhere. An explicit True/False here
+    outranks the `HV_SHA256_PALLAS` environment override.
     """
     global _USE_PALLAS
     if enabled != _USE_PALLAS:
@@ -105,8 +107,18 @@ def set_pallas(enabled: bool | None) -> None:
 
 
 def _pallas_enabled() -> bool:
+    # Precedence: set_pallas() override > HV_SHA256_PALLAS env > backend
+    # auto-detect. The env var is read PER CALL (post-import arming, the
+    # HV_SUP_* / HV_COMP_BACKLOG_WARN convention) — but like set_pallas,
+    # it binds at trace time: already-compiled jitted callers keep the
+    # dispatch they traced until jax's caches are cleared.
     if _USE_PALLAS is not None:
         return _USE_PALLAS
+    import os
+
+    env = os.environ.get("HV_SHA256_PALLAS")
+    if env is not None and env != "":
+        return env not in ("0", "false", "no", "off")
     from hypervisor_tpu.kernels.sha256_pallas import pallas_available
 
     return pallas_available()

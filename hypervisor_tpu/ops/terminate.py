@@ -5,8 +5,11 @@ The reference terminates one session at a time through Python
 release -> GC -> archive). Here a wave of K sessions terminates in one
 jitted op over the device tables:
 
-  * per-session Merkle roots over the sessions' audit leaves
-    (`ops.merkle.merkle_root_lanes` — bit-identical to the host tree),
+  * per-session Merkle roots arrive PRECOMPUTED from each session's
+    incremental frontier (`audit/frontier.py` — O(log n) hashes per
+    session, bit-identical to the tree; `state.py` recomputes through
+    the tree unit's host dispatch for pre-frontier restores), replacing
+    the old in-program [K, P, 8] leaf gather + full tree reduction,
   * vouch bonds scoped to the wave's sessions released in one mask
     (`liability/vouching.py:176-184` semantics),
   * participants deactivated and session rows walked
@@ -20,7 +23,6 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from hypervisor_tpu.models import SessionState
-from hypervisor_tpu.ops import merkle as merkle_ops
 from hypervisor_tpu.tables.state import (
     AgentTable,
     FLAG_ACTIVE,
@@ -107,13 +109,16 @@ def terminate_batch(
     sessions: SessionTable,
     vouches: VouchTable,
     session_slots: jnp.ndarray,  # i32[K] wave of sessions to terminate
-    leaves: jnp.ndarray,         # u32[K, P, 8] audit leaf digests per session
-    leaf_counts: jnp.ndarray,    # i32[K] valid leaves per session
+    roots: jnp.ndarray,          # u32[K, 8] precomputed Merkle roots
     now: jnp.ndarray | float,
-    use_pallas: bool | None = None,
     wave_range: tuple[jnp.ndarray, jnp.ndarray] | None = None,
 ) -> TerminateResult:
     """Terminate a wave of K sessions in one device program.
+
+    roots: the sessions' Merkle roots, already computed by the audit
+    plane (frontier fold or tree-unit recompute; zeros where a session
+    recorded no deltas) — passed through to the result so the wave's
+    shape no longer depends on the longest session's history.
 
     wave_range: optional (lo, hi) contiguity assertion for
     `session_slots` (see `release_session_scope`); turns the session
@@ -121,10 +126,6 @@ def terminate_batch(
     """
     s_cap = sessions.sid.shape[0]
     now_f = jnp.asarray(now, jnp.float32)
-
-    # ── audit: per-session Merkle roots (zeros where no deltas) ─────────
-    roots = merkle_ops.merkle_root_lanes(leaves, leaf_counts, use_pallas)
-    roots = jnp.where((leaf_counts > 0)[:, None], roots, jnp.uint32(0))
 
     # ── wave membership mask over the session axis ──────────────────────
     if wave_range is not None:

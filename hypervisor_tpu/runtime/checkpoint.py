@@ -29,6 +29,7 @@ from typing import Optional
 import numpy as np
 import jax.numpy as jnp
 
+from hypervisor_tpu.audit.frontier import MerkleFrontier
 from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.state import HypervisorState
 from hypervisor_tpu.tables.intern import InternTable
@@ -133,6 +134,12 @@ def host_metadata(state: HypervisorState) -> dict:
             str(k): [int(w) for w in v] for k, v in state._chain_seed.items()
         },
         "turns": {str(k): v for k, v in state._turns.items()},
+        # Incremental Merkle frontiers (audit/frontier.py): O(log n)
+        # node stacks, so a restore resumes session roots without
+        # re-hashing history.
+        "frontier": {
+            str(k): fr.to_meta() for k, fr in state._frontier.items()
+        },
         "fanout_groups": {
             str(slot): [[policy, idxs] for policy, idxs in groups]
             for slot, groups in state._fanout_groups.items()
@@ -429,6 +436,24 @@ def _rebuild(data, meta: dict, config: HypervisorConfig) -> HypervisorState:
         for k, v in meta.get("chain_seed", {}).items()
     }
     state._turns = {int(k): int(v) for k, v in meta.get("turns", {}).items()}
+    frontier_meta = meta.get("frontier")
+    if frontier_meta is not None:
+        state._frontier = {
+            int(k): MerkleFrontier.from_meta(v)
+            for k, v in frontier_meta.items()
+        }
+    else:
+        # Legacy save (pre-frontier): rebuild each session's frontier
+        # from its recorded leaf digests — one-time O(n) hashes here,
+        # O(log n) root updates thereafter.
+        digest_host = np.asarray(data["delta_log.digest"])
+        state._frontier = {
+            int(sess): MerkleFrontier.from_leaf_digests(
+                digest_host[np.asarray(rows)]
+            )
+            for sess, rows in state._audit_rows.items()
+            if rows
+        }
     state._fanout_groups = {
         int(slot): [(int(policy), [int(i) for i in idxs]) for policy, idxs in groups]
         for slot, groups in meta.get("fanout_groups", {}).items()

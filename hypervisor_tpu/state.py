@@ -29,6 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hypervisor_tpu.audit.frontier import MerkleFrontier
 from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.models import SessionConfig, SessionState
 from hypervisor_tpu.observability import profiling
@@ -101,8 +102,7 @@ _SAGA_TICK = health_plane.instrument(
 )
 _TERMINATE = health_plane.instrument(
     "terminate_batch",
-    jax.jit(terminate_ops.terminate_batch, static_argnames=("use_pallas",)),
-    static_argnames=("use_pallas",),
+    jax.jit(terminate_ops.terminate_batch),
 )
 _WAVE = health_plane.instrument(
     "governance_wave",
@@ -351,6 +351,15 @@ class HypervisorState:
         self._audit_rows: dict[int, list[int]] = {}
         self._chain_seed: dict[int, np.ndarray] = {}
         self._turns: dict[int, int] = {}
+        # Incremental audit plane (tree unit): per-session Merkle
+        # frontier (O(log n) node stack — session roots update in
+        # O(log n) hashes instead of re-hashing history) and the
+        # packed-body cache per (session, turn-range) so commit- and
+        # scrub-time recomputes of the same history skip the host-side
+        # re-pack. Both are invalidated when the DeltaLog wraps over a
+        # session (`_claim_rows`).
+        self._frontier: dict[int, MerkleFrontier] = {}
+        self._packed_bodies: dict[int, tuple[int, int, np.ndarray]] = {}
         # Ring-buffer row ownership: when the DeltaLog wraps, the sessions
         # whose rows get recycled must drop them from their audit index.
         self._row_session = np.full(cap.delta_log_capacity, -1, np.int32)
@@ -1040,8 +1049,14 @@ class HypervisorState:
                 self._audit_rows.setdefault(s, []).extend(
                     rows[i * t : (i + 1) * t].tolist()
                 )
-                self._turns[s] = self._turns.get(s, 0) + t
+                base_turn = self._turns.get(s, 0)
+                self._turns[s] = base_turn + t
                 self._chain_seed[s] = chain[t - 1, i]
+                # The frontier rides the wave's audit commit exactly as
+                # it rides flush_deltas.
+                self._frontier.setdefault(s, MerkleFrontier()).extend(
+                    digests_flat[i * t : (i + 1) * t]
+                )
         if actions is not None:
             if gw_result is None:
                 # Single device: compose the gateway wave behind the
@@ -2652,6 +2667,7 @@ class HypervisorState:
         # Flatten valid records lane-major and append in one op.
         flat = np.argsort(lane_idx * (t_max + 1) + t_pos, kind="stable")
         flat_digests = digests[t_pos[flat], lane_idx[flat]]
+        packed_flat = packed[flat]
         base_row = int(np.asarray(self.delta_log.cursor))
         capacity = self.delta_log.body.shape[0]
         rows = ((base_row + np.arange(b)) % capacity).astype(np.int64)
@@ -2663,11 +2679,17 @@ class HypervisorState:
             self._audit_rows.setdefault(sess, []).extend(
                 rows[offset : offset + n_rows].tolist()
             )
+            # Incremental audit plane: the session's Merkle frontier
+            # advances with the same recorded leaves (O(log n) amortized
+            # hashes; the packed-body cache fills lazily on first read).
+            self._frontier.setdefault(sess, MerkleFrontier()).extend(
+                flat_digests[offset : offset + n_rows]
+            )
             offset += n_rows
             self._chain_seed[sess] = digests[n_rows - 1, lane]
 
         self.delta_log = self.delta_log.append_batch(
-            jnp.asarray(packed[flat]),
+            jnp.asarray(packed_flat),
             jnp.asarray(flat_digests),
             jnp.asarray(sess_arr[flat]),
             jnp.asarray(turn_arr[flat]),
@@ -2708,6 +2730,13 @@ class HypervisorState:
                     self._audit_rows[int(sess)] = [
                         r for r in kept if r not in doomed
                     ]
+                # A wrap truncates the session's leaf set: its frontier
+                # (append-only) and packed-body cache no longer describe
+                # the surviving history — drop both. Only archived
+                # sessions reach here (live ones refused above), so the
+                # committed root was already taken.
+                self._frontier.pop(int(sess), None)
+                self._packed_bodies.pop(int(sess), None)
         self._row_session[rows] = owners
 
     def session_leaf_digests(self, session_slot: int) -> np.ndarray:
@@ -2716,6 +2745,73 @@ class HypervisorState:
         if not rows:
             return np.zeros((0, 8), np.uint32)
         return np.asarray(self.delta_log.digest)[np.array(rows)]
+
+    def session_packed_bodies(self, session_slot: int) -> np.ndarray:
+        """u32[T, BODY_WORDS] packed bodies for the session's live
+        history (turn order), through the per-(session, turn-range)
+        cache. The cache fills LAZILY on first read (the flush hot path
+        never pays for it): a hit requires the cached turn range to
+        still match the live history exactly; a miss — first read,
+        post-restore, or any range drift — rebuilds from the DeltaLog
+        body column and re-primes, so repeated commit-/scrub-side
+        recomputes of the same history pack at most once. Entries drop
+        when the DeltaLog wraps over the session (`_claim_rows`)."""
+        rows = self._audit_rows.get(session_slot, [])
+        if not rows:
+            return np.zeros((0, merkle_ops.BODY_WORDS), np.uint32)
+        turns = self._turns.get(session_slot, 0)
+        lo = turns - len(rows)
+        entry = self._packed_bodies.get(session_slot)
+        if (
+            entry is not None
+            and entry[0] == lo
+            and entry[1] == turns
+            and entry[2].shape[0] == len(rows)
+        ):
+            return entry[2]
+        bodies = np.asarray(self.delta_log.body)[np.asarray(rows)]
+        self._packed_bodies[session_slot] = (lo, turns, bodies)
+        return bodies
+
+    def verify_session_chain(
+        self, session_slot: int, use_pallas: bool | None = None
+    ) -> bool:
+        """Re-hash one session's full surviving chain against its
+        recorded digests through the tree unit's host dispatch (native
+        C++ on CPU backends). Full histories verify from the zero seed
+        in one sequential sweep over the CACHED packed bodies; a
+        wrap-evicted prefix leaves the first surviving link
+        unverifiable (by design — same rule as the scrubber)."""
+        rows = self._audit_rows.get(session_slot, [])
+        if not rows:
+            return True
+        full = self._turns.get(session_slot, 0) == len(rows)
+        if full:
+            bodies = self.session_packed_bodies(session_slot)
+            digests = self.session_leaf_digests(session_slot)
+            ok = merkle_ops.verify_chain_digests_host(
+                bodies[:, None, :],
+                digests[:, None, :],
+                np.array([len(rows)], np.int32),
+                use_pallas,
+            )
+            return bool(ok[0])
+        rows_arr = np.asarray(rows, np.int64)
+        prev = np.concatenate([rows_arr[:1], rows_arr[:-1]])
+        use_seed = np.zeros(len(rows), bool)
+        valid = np.ones(len(rows), bool)
+        valid[0] = False  # evicted parent: first surviving link unverifiable
+        ok = merkle_ops.verify_chain_links_host(
+            np.asarray(self.delta_log.body),
+            np.asarray(self.delta_log.digest),
+            rows_arr, prev, use_seed, valid,
+        )
+        return bool(ok.all())
+
+    def session_frontier(self, session_slot: int) -> MerkleFrontier | None:
+        """The session's live Merkle frontier (None when it has no
+        recorded deltas or its history was recycled by a ring wrap)."""
+        return self._frontier.get(session_slot)
 
     # ── termination wave ─────────────────────────────────────────────
 
@@ -2727,8 +2823,9 @@ class HypervisorState:
     ) -> np.ndarray:
         """Terminate a wave of sessions; returns u32[K, 8] Merkle roots.
 
-        One jitted program: per-session Merkle roots over the recorded
-        leaf digests, session-scoped bond release, participant
+        Per-session Merkle roots fold from each session's incremental
+        frontier (O(log n) hashes — `audit/frontier.py`) and ride one
+        jitted program doing session-scoped bond release, participant
         deactivation, and the TERMINATING -> ARCHIVED walk. Deactivated
         participants' agent rows return to the free list (device-table
         GC) so a long-running state never exhausts the agent table; the
@@ -2765,17 +2862,40 @@ class HypervisorState:
         in_wave = np.isin(np.asarray(self.agents.session), np.array(slots))
         live = (np.asarray(self.agents.flags) & FLAG_ACTIVE) != 0
         reclaim = np.nonzero(in_wave & live)[0]
-        counts = np.array(
-            [len(self._audit_rows.get(s, ())) for s in slots], np.int32
-        )
-        p = 1 << max(0, int(counts.max()) - 1).bit_length() if counts.max() else 1
-        p = max(p, 1)
-        leaves = np.zeros((k, p, 8), np.uint32)
-        digest_host = np.asarray(self.delta_log.digest)
+        # Session-end Merkle roots come from the incremental frontier:
+        # O(log n) hashes per session instead of re-hashing its whole
+        # history through the tree (the old [K, P, 8] leaf gather +
+        # in-program reduction). Sessions without a live frontier
+        # (restored from a pre-frontier checkpoint) fall back to one
+        # bulk recompute through the tree unit's host dispatch, which
+        # also re-primes their frontier.
+        roots_host = np.zeros((k, 8), np.uint32)
+        missing: list[int] = []
         for i, s in enumerate(slots):
             rows = self._audit_rows.get(s, [])
-            if rows:
-                leaves[i, : len(rows)] = digest_host[np.array(rows)]
+            if not rows:
+                continue
+            fr = self._frontier.get(s)
+            if fr is not None and fr.count == len(rows):
+                roots_host[i] = fr.root_words()
+            else:
+                missing.append(i)
+        if missing:
+            digest_host = np.asarray(self.delta_log.digest)
+            counts = np.array(
+                [len(self._audit_rows[slots[i]]) for i in missing], np.int32
+            )
+            p = 1 << max(0, int(counts.max()) - 1).bit_length()
+            leaves = np.zeros((len(missing), max(p, 1), 8), np.uint32)
+            for j, i in enumerate(missing):
+                rows = self._audit_rows[slots[i]]
+                leaves[j, : len(rows)] = digest_host[np.array(rows)]
+                self._frontier[slots[i]] = MerkleFrontier.from_leaf_digests(
+                    leaves[j, : len(rows)]
+                )
+            recomputed = merkle_ops.tree_roots_host(leaves, counts, use_pallas)
+            for j, i in enumerate(missing):
+                roots_host[i] = recomputed[j]
 
         # Contiguous terminate waves (the create_sessions_batch layout)
         # take the range-compare fast path: no [E]/[N] membership
@@ -2794,10 +2914,8 @@ class HypervisorState:
                 self.sessions,
                 self.vouches,
                 jnp.asarray(slot_arr),
-                jnp.asarray(leaves),
-                jnp.asarray(counts),
+                jnp.asarray(roots_host),
                 now,
-                use_pallas=use_pallas,
                 wave_range=wave_range,
             )
         self.tracer.stamp_wave_host(th)

@@ -21,6 +21,11 @@
 #      positives), and one injected sigma bit-flip must be detected at
 #      the drain and repaired in place with the Merkle chain heads
 #      untouched,
+#   5b. an MTU / tree-unit smoke gate — on a seeded multi-session
+#      history the tree unit's host dispatch, the incremental Merkle
+#      frontier, and the reference host loop must agree on every
+#      session root; the frontier must survive save/restore and update
+#      in O(log n) hashes (counted, not timed),
 #   6. an adversarial scenario smoke gate — one short seeded sybil
 #      flood + collusion drill against the hardened governance plane
 #      must CONTAIN (score at/above the floor, zero invariant
@@ -273,6 +278,71 @@ print(
 PY
 integrity_rc=$?
 
+echo "── MTU / tree-unit smoke gate ──"
+JAX_PLATFORMS=cpu python - <<'PY'
+import math
+import tempfile
+
+import numpy as np
+
+from hypervisor_tpu.audit.delta import merkle_root_host
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.ops.sha256 import digests_to_hex
+from hypervisor_tpu.runtime.checkpoint import restore_state, save_state
+from hypervisor_tpu.state import HypervisorState
+
+# Seeded multi-session history: the tree unit's host dispatch, the
+# incremental frontier, and the reference host loop must all agree on
+# every session root.
+st = HypervisorState()
+rng = np.random.RandomState(7)
+slots = [st.create_session(f"mtu:{i}", SessionConfig(), now=0.0) for i in range(3)]
+for t in range(9):
+    for s in slots:
+        st.stage_delta(
+            s, 0, ts=float(t),
+            change_words=rng.randint(0, 2**32, 8, dtype=np.uint64).astype(np.uint32),
+        )
+st.flush_deltas()
+for s in slots:
+    leaves = st.session_leaf_digests(s)
+    ref = merkle_root_host(digests_to_hex(leaves))
+    fr = st.session_frontier(s)
+    assert fr is not None and fr.root_hex() == ref, f"frontier root != reference ({s})"
+    p = 1 << max(0, len(leaves) - 1).bit_length()
+    lv = np.zeros((1, p, 8), np.uint32)
+    lv[0, : len(leaves)] = leaves
+    tu = merkle_ops.tree_roots_host(lv, np.array([len(leaves)], np.int32))
+    assert digests_to_hex(tu)[0] == ref, f"tree-unit root != reference ({s})"
+    assert st.verify_session_chain(s), f"chain verify failed on clean history ({s})"
+
+# Frontier survives save/restore and stays O(log n) incremental.
+work = tempfile.mkdtemp(prefix="hv_mtu_smoke_")
+target = save_state(st, work)
+st2 = restore_state(target)
+for s in slots:
+    a, b = st.session_frontier(s), st2.session_frontier(s)
+    assert b is not None and a.root_hex() == b.root_hex(), "frontier lost in restore"
+fr = st2.session_frontier(slots[0])
+before = fr.hash_count
+st2.stage_delta(slots[0], 0, ts=9.0, change_words=np.arange(8, dtype=np.uint32))
+st2.flush_deltas()
+root = fr.root_hex()
+spent = fr.hash_count - before
+bound = 3 * math.ceil(math.log2(fr.count + 1)) + 2
+assert spent <= bound, f"incremental update spent {spent} hashes (> {bound})"
+assert root == merkle_root_host(
+    digests_to_hex(st2.session_leaf_digests(slots[0]))
+), "post-restore incremental root diverged"
+print(
+    "MTU smoke OK: tree-unit == frontier == reference roots on a seeded "
+    f"history, frontier survived save/restore ({spent} hashes for the "
+    "incremental update)"
+)
+PY
+mtu_rc=$?
+
 echo "── adversarial scenario smoke gate ──"
 JAX_PLATFORMS=cpu python - <<'PY'
 from hypervisor_tpu.testing import scenarios
@@ -334,6 +404,10 @@ fi
 if [ "$integrity_rc" -ne 0 ]; then
     echo "integrity smoke gate FAILED (rc=$integrity_rc)" >&2
     exit "$integrity_rc"
+fi
+if [ "$mtu_rc" -ne 0 ]; then
+    echo "MTU / tree-unit smoke gate FAILED (rc=$mtu_rc)" >&2
+    exit "$mtu_rc"
 fi
 if [ "$scenario_rc" -ne 0 ]; then
     echo "adversarial scenario smoke gate FAILED (rc=$scenario_rc)" >&2

@@ -140,8 +140,10 @@ def test_range_path_excludes_free_rows_at_lo_zero():
 
 
 def test_terminate_batch_range_matches_mask():
-    # The full terminate wave (Merkle + bonds + FSM stamps) with
-    # wave_range must equal the default path on a contiguous wave.
+    # The full terminate wave (root passthrough + bonds + FSM stamps)
+    # with wave_range must equal the default path on a contiguous wave.
+    # Roots arrive precomputed from the audit plane's frontier now
+    # (ISSUE 7) — the wave passes them through untouched on both paths.
     from hypervisor_tpu.ops.terminate import terminate_batch
     from hypervisor_tpu.tables.state import SessionTable
 
@@ -150,22 +152,18 @@ def test_terminate_batch_range_matches_mask():
     sessions = SessionTable.create(S_CAP)
     lo, k = 2, 6
     slots = jnp.asarray(np.arange(lo, lo + k, dtype=np.int32))
-    leaves = jnp.asarray(
-        rng.randint(0, 2**32, size=(k, 4, 8), dtype=np.uint64).astype(
-            np.uint32
-        )
+    roots = jnp.asarray(
+        rng.randint(0, 2**32, size=(k, 8), dtype=np.uint64).astype(np.uint32)
     )
-    counts = jnp.asarray(np.array([3, 4, 0, 1, 2, 4], np.int32))
 
     plain = terminate_batch(
-        agents, sessions, vouches, slots, leaves, counts, 9.0,
-        use_pallas=False,
+        agents, sessions, vouches, slots, roots, 9.0,
     )
     ranged = terminate_batch(
-        agents, sessions, vouches, slots, leaves, counts, 9.0,
-        use_pallas=False,
+        agents, sessions, vouches, slots, roots, 9.0,
         wave_range=(jnp.asarray(lo, jnp.int32), jnp.asarray(lo + k, jnp.int32)),
     )
+    np.testing.assert_array_equal(np.asarray(plain.roots), np.asarray(roots))
     np.testing.assert_array_equal(
         np.asarray(ranged.roots), np.asarray(plain.roots)
     )

@@ -538,6 +538,17 @@ def _suite_report(
     backend: str = "cpu",
     quick: bool = False,
 ) -> dict:
+    # Every real suite payload carries the audit-plane rows (the tree
+    # unit's coverage, gated by regression.REQUIRED_SUITE_BENCHES) —
+    # mirror that here so synthetic rounds parse like committed ones.
+    full = {
+        "merkle_root_10_deltas": 25.0,
+        "merkle_root_100_deltas": 95.0,
+        "merkle_root_1000_deltas": 700.0,
+        "chain_verify_50_deltas": 40.0,
+        "scrub_sweep": 4.0,
+        **benches,
+    }
     return {
         "source": "benchmarks/bench_suite.py metrics plane",
         "device": backend,
@@ -548,7 +559,7 @@ def _suite_report(
             "per_op_p50_us": benches.get("full_governance_pipeline")
         },
         "benchmarks": {
-            name: {"per_op_p50_us": v} for name, v in benches.items()
+            name: {"per_op_p50_us": v} for name, v in full.items()
         },
     }
 
@@ -691,6 +702,21 @@ class TestRegressionHarness:
             ]
         )
         assert rc == 0
+
+    def test_missing_audit_rows_fail_the_gate(self, tmp_path):
+        # ISSUE 7: a suite round that silently drops the tree unit's
+        # rows (merkle_root_* / chain_verify_* / scrub_sweep) is a
+        # coverage regression even when every present number is fine.
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 9, _suite_report(9, {"full_governance_pipeline": 10.0})
+        )
+        doc = _suite_report(10, {"full_governance_pipeline": 10.0})
+        del doc["benchmarks"]["scrub_sweep"]
+        self._write(tmp_path, 10, doc)
+        rc = regression.main(["--root", str(tmp_path), "--quiet"])
+        assert rc == 1
 
     def test_next_round_path_advances(self, tmp_path):
         from benchmarks import regression

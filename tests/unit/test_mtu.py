@@ -1,0 +1,505 @@
+"""Merkle Tree Unit + incremental frontier (ISSUE 7).
+
+Pins, in one place:
+
+* MTU-vs-reference sha256 bit-identity — the Pallas kernels' exact
+  math (numpy twins `tree_roots_np` / `chain_digests_np`, same code
+  the Mosaic kernel compiles) against the pure-XLA formulations and
+  the reference host loop, across lane counts and odd tail sizes;
+* the tree unit's HOST dispatch (native C++ on CPU) against the same
+  references, including tamper detection;
+* frontier == batch-recompute root equivalence as a hypothesis
+  property over random append / wrap / restore sequences, including a
+  checkpoint/restore of the frontier mid-stream;
+* the O(log n) incremental-update acceptance bound as a HASH-COUNT
+  assertion (never wall clock);
+* the `HV_SHA256_PALLAS` per-call env arming (satellite);
+* the packed-body cache per (session, turn-range) + wrap invalidation
+  (satellite);
+* the scrubber's native strip path vs its jitted path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+try:  # hypothesis drives the property walks where available (CI
+    # image); the seeded twins below keep the same properties pinned
+    # in environments without it.
+    from hypothesis import given, settings, strategies as st
+
+    HAS_HYPOTHESIS = True
+except ImportError:  # pragma: no cover - environment-dependent
+    HAS_HYPOTHESIS = False
+
+from hypervisor_tpu.audit.commitment import CommitmentEngine
+from hypervisor_tpu.audit.delta import merkle_root_host
+from hypervisor_tpu.audit.frontier import MerkleFrontier
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.kernels import mtu_pallas as mtu
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.ops import sha256 as sha_ops
+from hypervisor_tpu.state import HypervisorState
+
+
+def _leaves(rng, s, p):
+    return rng.randint(0, 2**32, (s, p, 8), dtype=np.uint64).astype(np.uint32)
+
+
+def _ref_roots(leaves, counts):
+    """Reference roots via the host hex loop (the semantics anchor)."""
+    s = leaves.shape[0]
+    counts = np.broadcast_to(np.asarray(counts), (s,))
+    out = np.zeros((s, 8), np.uint32)
+    for i in range(s):
+        c = int(counts[i])
+        if c == 0:
+            out[i] = leaves[i, 0]
+            continue
+        hexes = sha_ops.digests_to_hex(leaves[i, :c])
+        out[i] = sha_ops.hex_to_words([merkle_root_host(hexes)])[0]
+    return out
+
+
+class TestMTUBitIdentity:
+    """The kernel math (numpy twins) and every dispatch tier agree."""
+
+    @pytest.mark.parametrize("p", [2, 4, 16, 64])
+    def test_tree_twin_matches_reference_across_odd_tails(self, p):
+        rng = np.random.RandomState(p)
+        s = 3
+        leaves = _leaves(rng, s, p)
+        # Odd tails on purpose: 1, a mid odd count, p-1, p.
+        for c in sorted({1, max(1, p // 2 - 1), p - 1, p}):
+            ref = _ref_roots(leaves, c)
+            xla = np.asarray(
+                merkle_ops.merkle_root_lanes(
+                    jnp.asarray(leaves), jnp.int32(c), use_pallas=False
+                )
+            )
+            twin = mtu.tree_roots_np(leaves, c)
+            np.testing.assert_array_equal(xla, ref)
+            np.testing.assert_array_equal(twin, ref)
+
+    @pytest.mark.parametrize("s", [1, 2, 5])
+    def test_tree_twin_across_lane_counts(self, s):
+        rng = np.random.RandomState(40 + s)
+        p = 16
+        leaves = _leaves(rng, s, p)
+        counts = rng.randint(1, p + 1, s).astype(np.int32)
+        np.testing.assert_array_equal(
+            mtu.tree_roots_np(leaves, counts), _ref_roots(leaves, counts)
+        )
+
+    def test_tree_host_dispatch_matches_reference(self):
+        rng = np.random.RandomState(7)
+        leaves = _leaves(rng, 4, 32)
+        counts = np.array([1, 9, 31, 32], np.int32)
+        ref = _ref_roots(leaves, counts)
+        host = merkle_ops.tree_roots_host(leaves, counts, use_pallas=False)
+        np.testing.assert_array_equal(host, ref)
+        # merkle_root (single-tree wrapper) folds through the same path.
+        one = np.asarray(
+            merkle_ops.merkle_root(
+                jnp.asarray(leaves[1]), jnp.int32(9), use_pallas=False
+            )
+        )
+        np.testing.assert_array_equal(one, ref[1])
+
+    @pytest.mark.parametrize("t,l", [(1, 1), (3, 2), (7, 5)])
+    def test_chain_twin_matches_scan(self, t, l):
+        rng = np.random.RandomState(t * 10 + l)
+        bodies = rng.randint(
+            0, 2**32, (t, l, merkle_ops.BODY_WORDS), dtype=np.uint64
+        ).astype(np.uint32)
+        seeds = rng.randint(0, 2**32, (l, 8), dtype=np.uint64).astype(np.uint32)
+        ref = np.asarray(
+            merkle_ops.chain_digests(
+                jnp.asarray(bodies), jnp.asarray(seeds), use_pallas=False
+            )
+        )
+        np.testing.assert_array_equal(mtu.chain_digests_np(bodies, seeds), ref)
+
+    def test_verify_chain_digests_host_counts_and_tamper(self):
+        rng = np.random.RandomState(3)
+        t, l = 6, 4
+        bodies = rng.randint(
+            0, 2**32, (t, l, merkle_ops.BODY_WORDS), dtype=np.uint64
+        ).astype(np.uint32)
+        recorded = np.asarray(
+            merkle_ops.chain_digests(jnp.asarray(bodies), use_pallas=False)
+        )
+        counts = np.array([6, 3, 1, 0], np.int32)
+        assert merkle_ops.verify_chain_digests_host(
+            bodies, recorded, counts, use_pallas=False
+        ).all()
+        bad = recorded.copy()
+        bad[4, 0, 2] ^= 1  # beyond lane 1's count, inside lane 0's
+        got = merkle_ops.verify_chain_digests_host(
+            bodies, bad, counts, use_pallas=False
+        )
+        assert list(got) == [False, True, True, True]
+
+    def test_verify_chain_links_host_matches_jitted(self):
+        rng = np.random.RandomState(9)
+        c = 12
+        bodies = rng.randint(
+            0, 2**32, (c, 1, merkle_ops.BODY_WORDS), dtype=np.uint64
+        ).astype(np.uint32)
+        digests = np.asarray(
+            merkle_ops.chain_digests(jnp.asarray(bodies), use_pallas=False)
+        )[:, 0]
+        body_col, digest_col = bodies[:, 0], digests.copy()
+        digest_col[7] ^= 2  # tamper one interior digest
+        rows = np.arange(c, dtype=np.int64)
+        prev = np.concatenate([[0], rows[:-1]])
+        use_seed = rows == 0
+        valid = np.ones(c, bool)
+        valid[5] = False
+        host = merkle_ops.verify_chain_links_host(
+            body_col, digest_col, rows, prev, use_seed, valid
+        )
+        jitted = np.asarray(
+            merkle_ops.verify_chain_links(
+                jnp.asarray(body_col),
+                jnp.asarray(digest_col),
+                jnp.asarray(rows, jnp.int32),
+                jnp.asarray(prev, jnp.int32),
+                jnp.asarray(use_seed),
+                jnp.asarray(valid),
+                use_pallas=False,
+            )
+        )
+        np.testing.assert_array_equal(host, jitted)
+        assert not host[7] and not host[8]  # link 8's parent is tampered too
+        assert host[5]  # invalid lanes always pass
+
+
+def _check_prefix_property(seed: int, n: int) -> None:
+    rng = np.random.RandomState(seed)
+    leaves = rng.randint(0, 2**32, (n, 8), dtype=np.uint64).astype(np.uint32)
+    fr = MerkleFrontier()
+    for i in range(n):
+        fr.append(leaves[i])
+        # Mid-stream serialization round-trip must be lossless.
+        if i == n // 2:
+            fr = MerkleFrontier.from_meta(json.loads(json.dumps(fr.to_meta())))
+        assert fr.root_hex() == merkle_root_host(
+            sha_ops.digests_to_hex(leaves[: i + 1])
+        )
+    assert fr.count == n
+
+
+class TestFrontier:
+    @pytest.mark.parametrize("seed,n", [(0, 1), (1, 17), (2, 64), (3, 97)])
+    def test_root_equals_batch_recompute_at_every_prefix(self, seed, n):
+        _check_prefix_property(seed, n)
+
+    if HAS_HYPOTHESIS:
+
+        @given(st.integers(0, 2**31 - 1), st.integers(1, 200))
+        @settings(max_examples=30, deadline=None)
+        def test_prefix_property_hypothesis(self, seed, n):
+            _check_prefix_property(seed, n)
+
+    def test_incremental_update_is_olog_n_hashes(self):
+        """The acceptance bound: append + root <= O(log n) HASHES,
+        pinned by the frontier's own combine counter."""
+        rng = np.random.RandomState(0)
+        fr = MerkleFrontier()
+        for n in range(1, 1100):
+            before = fr.hash_count
+            fr.append(
+                rng.randint(0, 2**32, 8, dtype=np.uint64).astype(np.uint32)
+            )
+            assert fr.root_hex() is not None
+            spent = fr.hash_count - before
+            bound = 3 * math.ceil(math.log2(n + 1)) + 2
+            assert spent <= bound, (n, spent, bound)
+        # And cumulatively nowhere near the O(n^2)/O(n log n) of
+        # re-hashing history per append.
+        assert fr.hash_count < 1100 * (3 * 11 + 2)
+
+    def test_commit_and_verify_frontier(self):
+        rng = np.random.RandomState(5)
+        leaves = rng.randint(0, 2**32, (9, 8), dtype=np.uint64).astype(np.uint32)
+        fr = MerkleFrontier.from_leaf_digests(leaves)
+        eng = CommitmentEngine()
+        rec = eng.commit_frontier("s:x", fr, ["did:a"])
+        assert rec.delta_count == 9
+        assert rec.merkle_root == merkle_root_host(
+            sha_ops.digests_to_hex(leaves)
+        )
+        assert eng.verify_frontier("s:x", fr)
+        assert eng.verify_device_root("s:x", fr.root_words())
+        with pytest.raises(ValueError):
+            eng.commit_frontier("s:y", MerkleFrontier(), [])
+
+
+def _small_log_state(log_cap=16):
+    cfg = dataclasses.replace(
+        DEFAULT_CONFIG,
+        capacity=dataclasses.replace(
+            DEFAULT_CONFIG.capacity, delta_log_capacity=log_cap
+        ),
+    )
+    return HypervisorState(cfg), cfg
+
+
+def _stage_one(state, slot, rng, t):
+    state.stage_delta(
+        slot, 0, ts=float(t),
+        change_words=rng.randint(0, 2**32, 8, dtype=np.uint64).astype(np.uint32),
+    )
+    state.flush_deltas()
+
+
+def _assert_frontiers_match(state, must=()):
+    """Every surviving frontier equals the batch recompute over its
+    session's recorded leaves; sessions in `must` (live ones) are
+    required to still HAVE a frontier. Archived sessions recycled by a
+    ring wrap legitimately lose theirs."""
+    for sess in must:
+        assert state.session_frontier(sess) is not None, sess
+    for sess, fr in state._frontier.items():
+        rows = state._audit_rows.get(sess, [])
+        assert fr.count == len(rows), sess
+        if not rows:
+            continue
+        ref = merkle_root_host(
+            sha_ops.digests_to_hex(state.session_leaf_digests(sess))
+        )
+        assert fr.root_hex() == ref, sess
+
+
+def _run_state_walk(ops: list[str], seed: int, work) -> None:
+    """Frontier == batch recompute under a random append / wrap /
+    checkpoint-restore walk of the live state (one delta per flush
+    keeps program shapes constant)."""
+    from hypervisor_tpu.runtime.checkpoint import restore_state, save_state
+
+    st_live, cfg = _small_log_state(log_cap=16)
+    rng = np.random.RandomState(seed)
+    live = st_live.create_session("fp:0", SessionConfig(), now=0.0)
+    n_created, t = 1, 0
+    for i, op in enumerate(ops):
+        # Keep the live chain shorter than the 16-row log so wraps
+        # only ever recycle ARCHIVED rows (live recycling refuses
+        # loudly, by design).
+        if op == "append" and len(st_live._audit_rows.get(live, [])) >= 10:
+            op = "rotate"
+        if op == "append":
+            _stage_one(st_live, live, rng, t)
+            t += 1
+        elif op == "rotate":
+            # Retire the live session (archived rows become wrappable)
+            # and start a fresh chain; later appends wrap the 16-row
+            # log over the retired history.
+            st_live.terminate_sessions([live], now=float(t))
+            live = st_live.create_session(
+                f"fp:{n_created}", SessionConfig(), now=float(t)
+            )
+            n_created += 1
+            for _ in range(3):
+                _stage_one(st_live, live, rng, t)
+                t += 1
+        else:  # restore: checkpoint/restore of the frontier mid-stream
+            target = work / f"ck{i}"
+            save_state(st_live, target)
+            st_live = restore_state(target / "latest", cfg)
+        must = (live,) if st_live._audit_rows.get(live) else ()
+        _assert_frontiers_match(st_live, must=must)
+
+
+class TestFrontierStatePlane:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_append_wrap_restore_sequences(self, seed, tmp_path):
+        rng = np.random.RandomState(1000 + seed)
+        ops = [
+            ["append", "rotate", "restore"][k]
+            for k in rng.randint(0, 3, 10)
+        ]
+        _run_state_walk(ops, seed, tmp_path)
+
+    if HAS_HYPOTHESIS:
+
+        @given(
+            st.lists(
+                st.sampled_from(["append", "rotate", "restore"]),
+                min_size=4, max_size=10,
+            ),
+            st.integers(0, 2**16),
+        )
+        @settings(max_examples=6, deadline=None)
+        def test_random_sequences_hypothesis(self, ops, seed, tmp_path_factory):
+            _run_state_walk(ops, seed, tmp_path_factory.mktemp("frontier_prop"))
+
+    def test_wrap_drops_archived_frontier_and_cache(self):
+        st_live, _ = _small_log_state(log_cap=8)
+        rng = np.random.RandomState(1)
+        a = st_live.create_session("wr:a", SessionConfig(), now=0.0)
+        b = st_live.create_session("wr:b", SessionConfig(), now=0.0)
+        for t in range(3):
+            _stage_one(st_live, a, rng, t)
+        st_live.terminate_sessions([a], now=3.0)
+        assert st_live.session_frontier(a) is not None
+        for t in range(8):  # wraps over a's rows
+            _stage_one(st_live, b, rng, 10 + t)
+        assert st_live.session_frontier(a) is None
+        assert a not in st_live._packed_bodies
+        _assert_frontiers_match(st_live)
+
+    def test_legacy_checkpoint_restore_rebuilds_frontier(self):
+        from hypervisor_tpu.runtime.checkpoint import restore_state, save_state
+
+        st_live, cfg = _small_log_state(log_cap=64)
+        rng = np.random.RandomState(2)
+        s = st_live.create_session("lg:a", SessionConfig(), now=0.0)
+        for t in range(5):
+            _stage_one(st_live, s, rng, t)
+        import tempfile
+        from pathlib import Path
+
+        work = Path(tempfile.mkdtemp(prefix="hv_legacy_fr_"))
+        target = save_state(st_live, work)
+        host = json.loads((target / "host.json").read_text())
+        assert "frontier" in host
+        del host["frontier"]  # simulate a pre-frontier save
+        (target / "host.json").write_text(json.dumps(host))
+        restored = restore_state(target, cfg)
+        _assert_frontiers_match(restored)
+
+    def test_terminate_falls_back_without_frontier(self):
+        """A session whose frontier is missing (pre-frontier restore)
+        still terminates with the correct root via the tree unit's
+        host dispatch, and the frontier re-primes."""
+        st_live, _ = _small_log_state(log_cap=64)
+        rng = np.random.RandomState(3)
+        s = st_live.create_session("tf:a", SessionConfig(), now=0.0)
+        for t in range(6):
+            _stage_one(st_live, s, rng, t)
+        ref = merkle_root_host(
+            sha_ops.digests_to_hex(st_live.session_leaf_digests(s))
+        )
+        st_live._frontier.pop(s)
+        roots = st_live.terminate_sessions([s], now=9.0)
+        assert sha_ops.digests_to_hex(roots[:1])[0] == ref
+        assert st_live.session_frontier(s).root_hex() == ref
+
+
+class TestPackedBodyCache:
+    def test_lazy_prime_and_repeat_reads_hit(self):
+        st_live, _ = _small_log_state(log_cap=64)
+        rng = np.random.RandomState(4)
+        s = st_live.create_session("pc:a", SessionConfig(), now=0.0)
+        for t in range(3):
+            _stage_one(st_live, s, rng, t)
+        # The flush hot path never fills the cache — the first READ does.
+        assert s not in st_live._packed_bodies
+        first = st_live.session_packed_bodies(s)
+        np.testing.assert_array_equal(
+            first, np.asarray(st_live.delta_log.body)[np.asarray(st_live._audit_rows[s])]
+        )
+        # Same object on a second read: no host-side re-pack.
+        assert st_live.session_packed_bodies(s) is first
+        # New history invalidates the range; the next read re-primes.
+        _stage_one(st_live, s, rng, 3)
+        again = st_live.session_packed_bodies(s)
+        lo, hi, arr = st_live._packed_bodies[s]
+        assert (lo, hi) == (0, 4) and again.shape[0] == 4
+        assert st_live.verify_session_chain(s)
+        assert st_live.session_packed_bodies(s) is again
+
+    def test_cache_miss_rebuilds_after_restore(self):
+        from hypervisor_tpu.runtime.checkpoint import restore_state, save_state
+        import tempfile
+
+        st_live, cfg = _small_log_state(log_cap=64)
+        rng = np.random.RandomState(6)
+        s = st_live.create_session("pc:b", SessionConfig(), now=0.0)
+        for t in range(4):
+            _stage_one(st_live, s, rng, t)
+        target = save_state(st_live, tempfile.mkdtemp(prefix="hv_pc_"))
+        restored = restore_state(target, cfg)
+        assert restored._packed_bodies == {}  # cold after restore
+        bodies = restored.session_packed_bodies(s)
+        np.testing.assert_array_equal(
+            bodies, st_live.session_packed_bodies(s)
+        )
+        assert s in restored._packed_bodies  # re-primed
+        assert restored.verify_session_chain(s)
+
+
+class TestEnvArming:
+    def test_hv_sha256_pallas_read_per_call(self, monkeypatch):
+        # Post-import arming: the env var is consulted at CALL time.
+        monkeypatch.delenv("HV_SHA256_PALLAS", raising=False)
+        sha_ops.set_pallas(None)
+        try:
+            auto = sha_ops._pallas_enabled()
+            monkeypatch.setenv("HV_SHA256_PALLAS", "0")
+            assert sha_ops._pallas_enabled() is False
+            monkeypatch.setenv("HV_SHA256_PALLAS", "1")
+            assert sha_ops._pallas_enabled() is True
+            # set_pallas() override outranks the env...
+            sha_ops.set_pallas(False)
+            assert sha_ops._pallas_enabled() is False
+            # ...and clearing it restores env-driven dispatch.
+            sha_ops.set_pallas(None)
+            assert sha_ops._pallas_enabled() is True
+            monkeypatch.delenv("HV_SHA256_PALLAS")
+            assert sha_ops._pallas_enabled() is auto
+        finally:
+            sha_ops.set_pallas(None)
+
+
+class TestScrubberNativePath:
+    def _seeded_state(self):
+        st_live, _ = _small_log_state(log_cap=64)
+        rng = np.random.RandomState(8)
+        slots = [
+            st_live.create_session(f"sn:{i}", SessionConfig(), now=0.0)
+            for i in range(2)
+        ]
+        for t in range(4):
+            for s in slots:
+                st_live.stage_delta(
+                    s, 0, ts=float(t),
+                    change_words=rng.randint(
+                        0, 2**32, 8, dtype=np.uint64
+                    ).astype(np.uint32),
+                )
+        st_live.flush_deltas()
+        return st_live
+
+    @pytest.mark.parametrize("native", ["1", "0"])
+    def test_clean_sweep_and_tamper_agree(self, native, monkeypatch):
+        from hypervisor_tpu.integrity.scrubber import MerkleScrubber
+        from hypervisor_tpu.tables.struct import replace as t_replace
+
+        monkeypatch.setenv("HV_SCRUB_NATIVE", native)
+        st_live = self._seeded_state()
+        scrub = MerkleScrubber(st_live, budget=32)
+        rep = scrub.tick()
+        assert rep["sweep_completed"] and not rep["mismatches"]
+        # Tamper one recorded digest on device: the next sweep flags
+        # the link (and its child, whose parent no longer matches).
+        row = st_live._audit_rows[0][1]
+        st_live.delta_log = t_replace(
+            st_live.delta_log,
+            digest=st_live.delta_log.digest.at[row, 0].add(jnp.uint32(1)),
+        )
+        rep = scrub.tick()
+        assert rep["sweep_completed"]
+        flagged = {m["row"] for m in rep["mismatches"]}
+        assert row in flagged
+        assert scrub.mismatches >= 1

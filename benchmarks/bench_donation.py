@@ -2,22 +2,34 @@
 
 The fused governance wave reads AND rewrites the whole Agent/Session/
 Vouch tables each dispatch; without donation XLA materialises a second
-copy of every column per wave. `donate_argnums=(0, 1, 2)` lets the
-outputs alias the input buffers (in-place HBM update) under the
-re-staging contract documented at `state._WAVE_DONATED`.
+copy of every column per wave. `donate_argnums` lets the outputs alias
+the input buffers (in-place HBM update) under the re-staging contract
+documented at `state._WAVE_DONATED`. Donation is the DEFAULT since
+round 9 (`HV_DONATE_TABLES=0` opts out); this harness measures the
+before/after that decision rests on, plus the round-9 fused-epilogue
+configuration (gateway + audit append + gauge/sanitizer tail in the
+same program — the production facade path).
 
-Both loops CHAIN the tables through iterations (each wave's outputs are
-the next wave's inputs) — exactly the state bridge's usage, and the
-only legal usage once buffers are donated.
+Three arms, all CHAINING the tables through iterations (each wave's
+outputs are the next wave's inputs — the state bridge's usage, and the
+only legal usage once buffers are donated):
+
+  * no-donate   — the plain wave, copy-on-write outputs
+  * donate      — same program, donated tables
+  * fused       — the round-9 fused program (donated, epilogue riding)
 
 Run on the real chip for the committed number; the CPU run is the
-methodology check (CPU donation support varies by jax version, so a
-null CPU result does not reject the optimisation).
+methodology check (XLA:CPU reuses host buffers aggressively, so a null
+CPU delta does not reject the optimisation). `--metrics-out auto` folds
+the result into the newest committed `BENCH_r<NN>.json` as its
+`donation` row and refreshes `BENCH_trajectory.json` — so the chip
+number lands in the trajectory the day the accelerator tunnel unwedges.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 from pathlib import Path
@@ -33,6 +45,15 @@ def main() -> None:
         "--cpu", action="store_true",
         help="force the hermetic CPU platform (skip the accelerator)",
     )
+    ap.add_argument(
+        "--metrics-out", type=str, default=None,
+        help=(
+            "'auto' folds the result into the newest committed "
+            "BENCH_r<NN>.json as its 'donation' row and refreshes "
+            "BENCH_trajectory.json; any other value is a path for a "
+            "standalone JSON report"
+        ),
+    )
     args = ap.parse_args()
     if args.cpu:
         from _jax_platform import force_cpu_platform
@@ -46,7 +67,16 @@ def main() -> None:
     from hypervisor_tpu.models import SessionState
     from hypervisor_tpu.ops import merkle as merkle_ops
     from hypervisor_tpu.ops.pipeline import governance_wave
-    from hypervisor_tpu.tables.state import AgentTable, SessionTable, VouchTable
+    from hypervisor_tpu.observability import metrics as mp
+    from hypervisor_tpu.observability import tracing
+    from hypervisor_tpu.tables.logs import DeltaLog, EventLog, TraceLog
+    from hypervisor_tpu.tables.state import (
+        AgentTable,
+        ElevationTable,
+        SagaTable,
+        SessionTable,
+        VouchTable,
+    )
     from hypervisor_tpu.tables.struct import replace as t_replace
 
     n = args.agents
@@ -88,7 +118,7 @@ def main() -> None:
     # path the bridge/bench take in production, in BOTH arms.
     wave_range = (jnp.asarray(0, jnp.int32), jnp.asarray(k, jnp.int32))
 
-    def run(donate: bool) -> float:
+    def run_plain(donate: bool) -> float:
         fn = jax.jit(
             governance_wave,
             static_argnames=("use_pallas",),
@@ -110,23 +140,96 @@ def main() -> None:
         times.sort()
         return times[len(times) // 2] / 1e6
 
-    base = run(donate=False)
-    donated = run(donate=True)
+    def run_fused() -> float:
+        """The round-9 production configuration: donated tables + ring
+        + gauge epilogue in ONE program (no gateway lanes — the bench
+        wave carries no actions, like bench.py's)."""
+        fn = jax.jit(
+            governance_wave,
+            static_argnames=("use_pallas",),
+            donate_argnames=(
+                "agents", "sessions", "vouches", "metrics", "trace",
+                "delta_log",
+            ),
+        )
+        agents, sessions, vouches = fresh_tables()
+        sagas = SagaTable.create(256, 8)
+        elevations = ElevationTable.create(256)
+        delta_log = DeltaLog.create(1 << 16)
+        event_log = EventLog.create(1 << 12)
+        trace = TraceLog.create(1 << 12)
+        metrics = mp.REGISTRY.create_table()
+        ctx = tracing.TraceContext(
+            trace=jnp.uint32(1), span=jnp.uint32(2),
+            wave_seq=jnp.int32(0), sampled=jnp.asarray(False),
+        )
+
+        def step(agents, sessions, vouches, metrics, trace, delta_log):
+            return fn(
+                agents, sessions, vouches, *cols, use_pallas=use_pallas,
+                wave_range=wave_range, metrics=metrics, trace=trace,
+                trace_ctx=ctx, elevations=elevations, delta_log=delta_log,
+                epilogue_tables=(sagas, event_log),
+            )
+
+        out = step(agents, sessions, vouches, metrics, trace, delta_log)
+        jax.block_until_ready(out.status)
+        state = (out.agents, out.sessions, out.vouches, out.metrics,
+                 out.trace, out.delta_log)
+        times = []
+        for _ in range(args.iters):
+            t0 = time.perf_counter_ns()
+            out = step(*state)
+            jax.block_until_ready(out.status)
+            times.append(time.perf_counter_ns() - t0)
+            state = (out.agents, out.sessions, out.vouches, out.metrics,
+                     out.trace, out.delta_log)
+        times.sort()
+        return times[len(times) // 2] / 1e6
+
+    base = run_plain(donate=False)
+    donated = run_plain(donate=True)
+    fused = run_fused()
     backend = jax.default_backend()
     print(
         f"governance_wave {n} agents / {b} joins ({backend}): "
-        f"p50 no-donate={base:.3f} ms, donate={donated:.3f} ms, "
-        f"delta={100 * (base - donated) / base:+.1f}%"
+        f"p50 no-donate={base:.3f} ms, donate={donated:.3f} ms "
+        f"({100 * (base - donated) / base:+.1f}%), fused-epilogue "
+        f"(donated, all planes)={fused:.3f} ms"
     )
-    import json
-
-    print(json.dumps({
+    row = {
         "metric": "wave_table_donation",
         "backend": backend,
         "p50_ms_no_donate": round(base, 4),
         "p50_ms_donate": round(donated, 4),
+        "p50_ms_fused_epilogue": round(fused, 4),
         "delta_pct": round(100 * (base - donated) / base, 2),
-    }))
+        "fused_vs_no_donate_pct": round(100 * (base - fused) / base, 2),
+        "iters": args.iters,
+        "agents": n,
+    }
+    print(json.dumps(row))
+
+    if args.metrics_out == "auto":
+        # Fold into the newest committed round file so the trajectory
+        # carries the donation evidence next to the census row.
+        from benchmarks import regression
+
+        rounds = sorted(
+            regression.REPO_ROOT.glob("BENCH_r*.json"),
+            key=lambda p: p.name,
+        )
+        if not rounds:
+            print("no BENCH_r*.json to fold into; skipped")
+            return
+        target = rounds[-1]
+        doc = json.loads(target.read_text())
+        doc["donation"] = row
+        target.write_text(json.dumps(doc, indent=2) + "\n")
+        traj = regression.refresh_trajectory()
+        print(f"folded donation row into {target.name}; refreshed {traj}")
+    elif args.metrics_out:
+        Path(args.metrics_out).write_text(json.dumps(row, indent=2) + "\n")
 
 
 if __name__ == "__main__":

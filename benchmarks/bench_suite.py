@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 import tempfile
 import time
@@ -816,6 +817,52 @@ def scenario_benchmark(seed: int, quick: bool) -> dict:
     }
 
 
+def dispatch_census_row(timeout_s: float = 900.0) -> dict | None:
+    """Run `tpu_aot_census.py --json` in a SUBPROCESS and distill the
+    trajectory row (`BENCH_r<NN>.json` "dispatch_census").
+
+    Subprocess, not import: the census pins its own platform config
+    (deviceless v5e AOT when the PJRT plugin answers, hermetic 8-device
+    CPU otherwise), so its ENTRY-step numbers are reproducible
+    regardless of how this bench process configured jax. Exit 75 =
+    plugin absent/wedged with --backend tpu — here the tool auto-falls
+    back to cpu, so None means the census itself failed.
+    """
+    tool = Path(__file__).resolve().parent / "tpu_aot_census.py"
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(tool), "--json"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode != 0:
+        return None
+    try:
+        report = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        return None
+    fused = report["programs"]["fused_wave_sanitized"]
+    nodonate = report["programs"]["fused_wave_sanitized_nodonate"]
+    return {
+        "backend": report["backend"],
+        "entry_steps": fused["entry"],
+        "dispatch_steps": fused["dispatch"],
+        "entry_steps_no_donate": nodonate["entry"],
+        "dispatch_steps_no_donate": nodonate["dispatch"],
+        "copy_steps": fused["top"].get("copy", 0),
+        "donation_delta_steps": report["donation_delta_steps"],
+        "unfused_total_dispatch": report["unfused_total"]["dispatch"],
+        "self_fusion_ratio": report["self_fusion_ratio"],
+        "fusion_ratio": report["fusion_ratio"],
+        "r09_baseline_dispatch": (
+            (report.get("r09_baseline") or {}).get("dispatch_total")
+        ),
+    }
+
+
 def _git_commit() -> str | None:
     """Current commit hash, stamped into bench reports so a trajectory
     row names the code it measured; None outside a git checkout."""
@@ -892,6 +939,17 @@ def main() -> None:
         ),
     )
     ap.add_argument(
+        "--no-census",
+        action="store_true",
+        help=(
+            "skip the dispatch census row (tpu_aot_census.py --json in a "
+            "subprocess). The census is on by default whenever "
+            "--metrics-out is set: committed BENCH rounds must carry the "
+            "ENTRY-step counts regression.py gates (a step-count "
+            "regression fails CI even with no chip attached)"
+        ),
+    )
+    ap.add_argument(
         "--write-results",
         action="store_true",
         help=(
@@ -963,6 +1021,22 @@ def main() -> None:
                 flush=True,
             )
 
+    census_rec = None
+    if args.metrics_out and not args.no_census:
+        census_rec = dispatch_census_row()
+        if not args.json_only:
+            if census_rec is None:
+                print("dispatch census FAILED (row omitted)", flush=True)
+            else:
+                print(
+                    f"dispatch census [{census_rec['backend']}]: fused "
+                    f"{census_rec['dispatch_steps']} dispatch steps "
+                    f"({census_rec['entry_steps']} entry), donation saves "
+                    f"{census_rec['donation_delta_steps']}, fusion ratio "
+                    f"vs r09 {census_rec['fusion_ratio']}",
+                    flush=True,
+                )
+
     if args.metrics_out:
         from benchmarks import regression
 
@@ -992,6 +1066,11 @@ def main() -> None:
             # containment scores + hardening overhead; regression.py
             # gates min_score against the containment floor.
             "scenarios": scenario_rec,
+            # Dispatch-census row (round 9): ENTRY/dispatch-bearing step
+            # counts of the fused donated wave from tpu_aot_census.py —
+            # regression.py gates the step count and the fusion ratio,
+            # so a de-fusing refactor fails CI devicelessly.
+            "dispatch_census": census_rec,
         }
         out_path.write_text(json.dumps(report, indent=2) + "\n")
         if not args.json_only:

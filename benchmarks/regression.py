@@ -79,6 +79,23 @@ REQUIRED_SUITE_BENCHES = (
 #: committed rounds are exempt.
 SCRUB_ROW_SINCE = 9
 
+#: The dispatch-census row joined the standard payload in round 10
+#: (the dispatch-floor mega-fusion PR); earlier rounds are exempt.
+CENSUS_ROW_SINCE = 10
+
+#: Minimum r09-anchored fusion ratio a census row may report
+#: (`HV_CENSUS_FUSION_FLOOR` overrides): the round-10 acceptance bar —
+#: the donated fused wave must stay at least 2x below the r09 five-
+#: program dispatch total. A de-fusing refactor (or a phase silently
+#: falling out of the fused program) lands here even with no chip.
+DEFAULT_CENSUS_FUSION_FLOOR = 2.0
+
+#: Allowed fractional growth of the fused wave's dispatch-bearing step
+#: count vs the median of comparable prior rounds
+#: (`HV_BENCH_CENSUS_TOL` overrides). Step counts are deterministic per
+#: jax/XLA version; the band absorbs compiler upgrades, not refactors.
+DEFAULT_CENSUS_TOL = 0.15
+
 
 def _backend_of(device: str) -> str:
     return "tpu" if "tpu" in (device or "").lower() else "cpu"
@@ -111,6 +128,8 @@ def parse_round_file(path: Path) -> Optional[dict]:
         chaos = doc.get("chaos")
         integrity = doc.get("integrity")
         scenarios = doc.get("scenarios")
+        census = doc.get("dispatch_census")
+        donation = doc.get("donation")
         row.update(
             format="suite",
             backend=doc.get("backend", "cpu"),
@@ -165,6 +184,14 @@ def parse_round_file(path: Path) -> Optional[dict]:
                 if isinstance(scenarios, dict)
                 else None
             ),
+            # Dispatch-census row (round 10): the fused wave's ENTRY /
+            # dispatch-bearing step counts + donated-vs-not diff, gated
+            # below — the tunnel-wedge-proof perf metric.
+            census=census if isinstance(census, dict) else None,
+            # Donation chip row (bench_donation.py --metrics-out):
+            # informational until the tunnel unwedges — the trajectory
+            # carries it so the chip number lands the day it measures.
+            donation=donation if isinstance(donation, dict) else None,
         )
         return row
     if "parsed" in doc or "rc" in doc:
@@ -335,6 +362,62 @@ def compare(
         checked.append(entry)
         if min_score < floor:
             regressions.append(entry)
+    # Dispatch-census gates (round 10): the fused wave's step count is
+    # the dispatch-floor metric — deviceless, deterministic, chip-free.
+    census = current.get("census")
+    if current.get("format") == "suite" and current["round"] >= CENSUS_ROW_SINCE:
+        if not census:
+            entry = {
+                "bench": "missing:dispatch_census",
+                "current_per_op_us": 0.0,
+                "baseline_per_op_us": 0.0,
+                "ratio": 0.0,
+            }
+            checked.append(entry)
+            regressions.append(entry)
+    if census and census.get("dispatch_steps") is not None:
+        # (a) r09-anchored fusion ratio floor: the mega-fusion must hold.
+        ratio_val = census.get("fusion_ratio")
+        if ratio_val is not None:
+            env_floor = os.environ.get("HV_CENSUS_FUSION_FLOOR")
+            floor = (
+                float(env_floor) if env_floor
+                else DEFAULT_CENSUS_FUSION_FLOOR
+            )
+            entry = {
+                "bench": "census_fusion_ratio",
+                "current_per_op_us": float(ratio_val),
+                "baseline_per_op_us": floor,
+                "ratio": round(float(ratio_val) / floor, 3) if floor else 0.0,
+            }
+            checked.append(entry)
+            if float(ratio_val) < floor:
+                regressions.append(entry)
+        # (b) step-count creep vs the median of comparable prior rounds
+        # that censused the SAME backend.
+        priors = [
+            r["census"]["dispatch_steps"]
+            for r in rows
+            if r["round"] < current["round"]
+            and _comparable_key(r) == _comparable_key(current)
+            and r.get("census")
+            and r["census"].get("backend") == census.get("backend")
+            and r["census"].get("dispatch_steps")
+        ]
+        if priors:
+            env_tol = os.environ.get("HV_BENCH_CENSUS_TOL")
+            ctol = float(env_tol) if env_tol else DEFAULT_CENSUS_TOL
+            base = statistics.median(priors)
+            steps = float(census["dispatch_steps"])
+            entry = {
+                "bench": "census_dispatch_steps",
+                "current_per_op_us": steps,
+                "baseline_per_op_us": base,
+                "ratio": round(steps / base, 3) if base else 0.0,
+            }
+            checked.append(entry)
+            if steps > base * (1.0 + ctol):
+                regressions.append(entry)
     if scenarios and scenarios.get("hardening_overhead_pct") is not None:
         env_cap = os.environ.get("HV_BENCH_HARDENING_OVERHEAD")
         cap = (

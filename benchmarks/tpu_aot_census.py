@@ -1,41 +1,64 @@
-"""Deviceless-AOT census of the real v5e executables (no chip needed).
+"""Deviceless dispatch census of the governance-wave executables.
 
 The round-5 discovery that powers ROOFLINE.md's TPU-true numbers:
 `jax.experimental.topologies.get_topology_desc("tpu", "v5e:2x4")`
 builds a PJRT topology for the BASELINE target with no device attached
-— even while the accelerator tunnel is wedged — and compiling against
-it runs the real XLA:TPU + Mosaic compiler. This script extracts, from
-the actual v5e executables:
+— compiling against it runs the real XLA:TPU + Mosaic compiler. Round 9
+made the tool **tunnel-wedge-proof**: the TPU plugin probe is
+subprocess-bounded (`HV_AOT_PROBE_TIMEOUT`, the same guard as
+tests/parity/test_mosaic_aot.py — the wedged accelerator tunnel can
+hang `get_topology_desc` forever), and when the plugin is absent or
+wedged the census falls back to the hermetic CPU backend, whose
+ENTRY-step structure gates the same fusion/donation regressions with no
+chip attached.
 
-  * the bench-shaped 10k wave's ENTRY instruction census (the dispatch
-    structure that dominates wave latency — ROOFLINE.md §4),
-  * the donated-wave diff (how many copy steps donation removes),
-  * a per-phase dispatch attribution (the mega-fusion priority list),
-  * live HBM buffer sizes (temp/args/outputs).
+What it measures (the round-9 mega-fusion metric):
 
-Run: python benchmarks/tpu_aot_census.py   (requires the TPU PJRT
-plugin; skips with a message where it is absent, e.g. GitHub CI).
+  * the FUSED bench-shaped wave — governance + gateway + audit append +
+    gauge/sanitizer epilogue as ONE program (`ops.pipeline.
+    governance_wave` with every round-9 plane riding), donated and not,
+  * the UNFUSED equivalents — the five standalone programs a pre-r10
+    runtime dispatched per wave step (wave, DeltaLog append, gateway,
+    update_gauges, check_invariants),
+  * `fusion_ratio` — r09-anchored dispatch-step cut (see R09_BASELINE),
+  * live HBM buffer sizes where the backend exposes them.
+
+Dispatch-bearing ENTRY steps = fusions + custom calls + array copies +
+dynamic-update-slices + sorts + reduce-windows + gathers + scatters.
+Rank-0 (scalar) copies are prologue plumbing on every backend and are
+excluded.
+
+CLI::
+
+    python benchmarks/tpu_aot_census.py                # auto: tpu -> cpu
+    python benchmarks/tpu_aot_census.py --json         # machine-readable
+    python benchmarks/tpu_aot_census.py --backend cpu  # hermetic, always works
+
+Exit codes: 0 = census ran; **75** (EX_TEMPFAIL) = TPU plugin absent or
+wedged AND --backend tpu was explicitly requested — callers
+(scripts/verify_tier1.sh, CI) treat that as "skip", distinct from 1 =
+census failed/regressed.
 """
 
 from __future__ import annotations
 
+import argparse
+import json
+import os
 import re
+import subprocess
 import sys
 from collections import Counter
-from functools import partial
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from _jax_platform import force_cpu_platform  # noqa: E402
-
-force_cpu_platform(8)
-
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-
-S, T, N, SC, E = 10_000, 3, 16_384, 16_384, 65_536
+#: Bench shape (BASELINE 10k wave) + gateway lane block.
+S, T, N, SC, E, A = 10_000, 3, 16_384, 16_384, 65_536, 1_024
 TOPOLOGY = "v5e:2x4"
+
+EXIT_OK = 0
+EXIT_TPU_UNAVAILABLE = 75  # EX_TEMPFAIL: plugin absent/wedged, not a failure
 
 # Dispatch-bearing instruction kinds (parameters/bitcasts/tuples are
 # metadata; copy-done is the completion half of an async copy).
@@ -44,8 +67,23 @@ DISPATCH_OPS = (
     "reduce-window", "gather", "scatter",
 )
 
+#: r09-HEAD anchor (commit 4e1ca24, measured on this census's refined
+#: metric): the five programs one fully-loaded bench wave step
+#: dispatched before the round-9 mega-fusion, on the hermetic CPU
+#: backend — governance_wave (metrics+trace, no donation: the r09
+#: default) 101, DeltaLog.append_batch 5, gateway (metrics+trace) 96,
+#: update_gauges 59, check_invariants 61. `fusion_ratio` in the report
+#: is r09_total / fused_dispatch. The v5e anchor is the wave alone
+#: (DONATION.md: 244 ENTRY instructions); the remaining v5e plane
+#: programs await an unwedged tunnel, so no tpu total is anchored yet.
+R09_BASELINE = {
+    "cpu": {"dispatch_total": 322, "entry_total": 573, "programs": 5},
+    "tpu": None,
+}
+
 
 def entry_census(compiled) -> tuple[int, int, dict]:
+    """(entry_total, dispatch_ish, top_kinds) for a compiled program."""
     txt = compiled.as_text()
     entry = txt[txt.index("ENTRY "):]
     body = entry[entry.index("{") + 1:]
@@ -58,133 +96,364 @@ def entry_census(compiled) -> tuple[int, int, dict]:
             if depth == 0:
                 end = i
                 break
-    insts = re.findall(
-        r"^\s*(?:ROOT\s+)?[%\w.-]+ = \S+ ([a-z-]+)\(", body[:end], re.M
-    )
-    c = Counter(insts)
+    c: Counter = Counter()
+    for line in body[:end].splitlines():
+        m = re.match(
+            r"\s*(?:ROOT\s+)?[%\w.-]+ = (\S+) ([a-z-]+)\(", line.strip()
+        )
+        if not m:
+            continue
+        shape, kind = m.groups()
+        if kind == "copy" and "[]" in shape:
+            continue  # rank-0 scalar copy: prologue plumbing, not a step
+        c[kind] += 1
     return sum(c.values()), sum(c[k] for k in DISPATCH_OPS), dict(
         c.most_common(10)
     )
 
 
-def main() -> int:
+def _probe_timeout() -> float:
     try:
-        from jax.experimental import topologies
+        return float(os.environ.get("HV_AOT_PROBE_TIMEOUT", "45"))
+    except ValueError:
+        return 45.0
 
-        td = topologies.get_topology_desc(
-            platform="tpu", topology_name=TOPOLOGY
-        )
-    except Exception as exc:
-        print(f"TPU PJRT topology unavailable ({exc!r}); nothing to census.")
-        return 0
-    from jax.sharding import SingleDeviceSharding
 
-    dev = td.devices[0]
-    print(f"target: {dev.device_kind} x{len(td.devices)} ({TOPOLOGY})")
-    s = SingleDeviceSharding(dev)
-    jax.config.update("jax_compilation_cache_dir", None)
-
-    from hypervisor_tpu.config import DEFAULT_CONFIG
-    from hypervisor_tpu.ops import admission as admission_ops
-    from hypervisor_tpu.ops import gateway as gateway_ops
-    from hypervisor_tpu.ops import liability as liability_ops
-    from hypervisor_tpu.ops import merkle as merkle_ops
-    from hypervisor_tpu.ops import saga_ops, terminate as terminate_ops
-    from hypervisor_tpu.ops.pipeline import governance_wave
-    from hypervisor_tpu.tables.state import (
-        AgentTable,
-        ElevationTable,
-        SessionTable,
-        VouchTable,
+def probe_tpu_topology() -> bool:
+    """Subprocess-bounded check that the TPU PJRT plugin can build the
+    deviceless topology — the wedged accelerator tunnel can HANG
+    `get_topology_desc` inside initialize_pjrt_plugin (observed live;
+    same guard as tests/parity/test_mosaic_aot.py)."""
+    code = (
+        "from jax.experimental import topologies;"
+        f"topologies.get_topology_desc(platform='tpu', topology_name={TOPOLOGY!r})"
     )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=_probe_timeout(),
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    except OSError:
+        return False
+    return proc.returncode == 0
+
+
+def _shapes(jax, jnp, merkle_ops, mp, tables_state, logs_mod):
+    """ShapeDtypeStructs for every program the census compiles."""
 
     def sds(tree):
         return jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree
         )
 
-    at, st, vt, et = (
-        sds(AgentTable.create(N)),
-        sds(SessionTable.create(SC)),
-        sds(VouchTable.create(E)),
-        sds(ElevationTable.create(4096)),
+    return {
+        "agents": sds(tables_state.AgentTable.create(N)),
+        "sessions": sds(tables_state.SessionTable.create(SC)),
+        "vouches": sds(tables_state.VouchTable.create(E)),
+        "sagas": sds(tables_state.SagaTable.create(1024, 8)),
+        "elevations": sds(tables_state.ElevationTable.create(4096)),
+        "delta_log": sds(logs_mod.DeltaLog.create(65536)),
+        "event_log": sds(logs_mod.EventLog.create(65536)),
+        "trace_log": sds(logs_mod.TraceLog.create(65536)),
+        "metrics": sds(mp.REGISTRY.create_table()),
+        "li": jax.ShapeDtypeStruct((S,), jnp.int32),
+        "lb": jax.ShapeDtypeStruct((S,), jnp.bool_),
+        "lf": jax.ShapeDtypeStruct((S,), jnp.float32),
+        "li8": jax.ShapeDtypeStruct((S,), jnp.int8),
+        "sf": jax.ShapeDtypeStruct((), jnp.float32),
+        "si": jax.ShapeDtypeStruct((), jnp.int32),
+        "su": jax.ShapeDtypeStruct((), jnp.uint32),
+        "sb": jax.ShapeDtypeStruct((), jnp.bool_),
+        "bodies": jax.ShapeDtypeStruct(
+            (T, S, merkle_ops.BODY_WORDS), jnp.uint32
+        ),
+        "rb": jax.ShapeDtypeStruct((4,), jnp.float32),
+        "ai": jax.ShapeDtypeStruct((A,), jnp.int32),
+        "ai8": jax.ShapeDtypeStruct((A,), jnp.int8),
+        "ab": jax.ShapeDtypeStruct((A,), jnp.bool_),
+    }
+
+
+def census_report(backend: str, sharding=None) -> dict:
+    """Compile every program and assemble the machine-readable report.
+
+    `backend` is "tpu" (deviceless v5e AOT or a live chip) or "cpu".
+    `sharding` pins in/out shardings for the deviceless-AOT path.
+    """
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from hypervisor_tpu.config import DEFAULT_CONFIG
+    from hypervisor_tpu.integrity import invariants as inv
+    from hypervisor_tpu.observability import metrics as mp
+    from hypervisor_tpu.observability import tracing
+    from hypervisor_tpu.ops import gateway as gateway_ops
+    from hypervisor_tpu.ops import merkle as merkle_ops
+    from hypervisor_tpu.ops.pipeline import governance_wave
+    from hypervisor_tpu.tables import logs as logs_mod
+    from hypervisor_tpu.tables import state as tables_state
+
+    use_pallas = backend == "tpu"
+    sh = _shapes(jax, jnp, merkle_ops, mp, tables_state, logs_mod)
+    jit_kw = {}
+    if sharding is not None:
+        jit_kw = {"in_shardings": sharding, "out_shardings": sharding}
+
+    wave_args = (
+        sh["agents"], sh["sessions"], sh["vouches"],
+        sh["li"], sh["li"], sh["li"], sh["lf"], sh["lb"], sh["lb"],
+        sh["li"], sh["bodies"], sh["sf"], sh["sf"],
     )
-    li = jax.ShapeDtypeStruct((S,), jnp.int32)
-    lb = jax.ShapeDtypeStruct((S,), jnp.bool_)
-    lf = jax.ShapeDtypeStruct((S,), jnp.float32)
-    li8 = jax.ShapeDtypeStruct((S,), jnp.int8)
-    sf = jax.ShapeDtypeStruct((), jnp.float32)
-    si = jax.ShapeDtypeStruct((), jnp.int32)
-    bodies = jax.ShapeDtypeStruct((T, S, merkle_ops.BODY_WORDS), jnp.uint32)
-    wave_args = (at, st, vt, li, li, li, lf, lb, lb, li, bodies, sf, sf)
+    ctx_args = (sh["su"], sh["su"], sh["si"], sh["sb"])
+    gw_cols = (sh["ai"], sh["ai8"], sh["ab"], sh["ab"], sh["ab"],
+               sh["ab"], sh["ab"])
 
-    def wave_fastpath(*a):
-        *w, lo, hi = a
-        return governance_wave(
-            *w, use_pallas=True, unique_sessions=True, wave_range=(lo, hi)
-        )
-
-    # ── the bench wave, plain and donated ────────────────────────────
-    for label, extra in (("wave", {}), ("wave+donate",
-                                       {"donate_argnums": (0, 1, 2)})):
-        compiled = (
-            jax.jit(wave_fastpath, in_shardings=s, out_shardings=s, **extra)
-            .lower(*wave_args, si, si)
-            .compile()
-        )
-        total, heavy, top = entry_census(compiled)
-        print(f"{label:14s} entry={total:4d} dispatch-ish={heavy:4d}  {top}")
-        if not extra:
-            mm = compiled.memory_analysis()
-            print(
-                "               HBM MB: temp"
-                f" {mm.temp_size_in_bytes / 1e6:.2f} args"
-                f" {mm.argument_size_in_bytes / 1e6:.2f} out"
-                f" {mm.output_size_in_bytes / 1e6:.2f}"
+    def fused_fn(sanitize):
+        def fn(*a):
+            (*w, lo, hi, m, tr, ct, cs, cw, cb, elev,
+             g0, g1, g2, g3, g4, g5, g6, d, sg, ev, bursts) = a
+            return governance_wave(
+                *w, use_pallas=use_pallas, unique_sessions=True,
+                wave_range=(lo, hi), ring_bursts=bursts, metrics=m,
+                trace=tr,
+                trace_ctx=tracing.TraceContext(
+                    trace=ct, span=cs, wave_seq=cw, sampled=cb
+                ),
+                elevations=elev,
+                gateway_args=(g0, g1, g2, g3, g4, g5, g6),
+                delta_log=d, epilogue_tables=(sg, ev), sanitize=sanitize,
             )
 
-    # ── per-phase attribution ────────────────────────────────────────
-    def audit(b):
-        chain = merkle_ops.chain_digests(b, use_pallas=True)
-        p = 1 << max(0, (T - 1).bit_length())
-        leaves = jnp.zeros((S, p, 8), jnp.uint32)
-        leaves = leaves.at[:, :T].set(jnp.transpose(chain, (1, 0, 2)))
-        return merkle_ops.merkle_root_lanes(
-            leaves, jnp.int32(T), use_pallas=True
-        )
+        return fn
 
-    phases = [
-        ("contribution",
-         lambda v, ts, now: liability_ops.contribution_toward(v, ts, now),
-         (vt, jax.ShapeDtypeStruct((N,), jnp.int32), sf)),
-        ("admission",
-         partial(admission_ops.admit_batch, trust=DEFAULT_CONFIG.trust,
-                 unique_sessions=True),
-         (at, st, li, li, li, lf, lb, lb, sf)),
-        ("audit(hash)", audit, (bodies,)),
-        ("saga step",
-         lambda q, ok: saga_ops.execute_attempt(
-             q, success=ok, retries_left=jnp.zeros((S,), jnp.int8)),
-         (li8, lb)),
-        ("terminate",
-         lambda a, v, lo, hi: terminate_ops.release_session_scope(
-             a, v, None, wave_range=(lo, hi)),
-         (at, vt, si, si)),
-        ("gateway",
-         partial(gateway_ops.check_actions, breach=DEFAULT_CONFIG.breach,
-                 rate_limit=DEFAULT_CONFIG.rate_limit,
-                 trust=DEFAULT_CONFIG.trust),
-         (at, et, li, li8, lb, lb, lb, lb, sf)),
-    ]
-    for name, fn, args in phases:
+    fused_args = (
+        wave_args + (sh["si"], sh["si"], sh["metrics"], sh["trace_log"])
+        + ctx_args + (sh["elevations"],) + gw_cols
+        + (sh["delta_log"], sh["sagas"], sh["event_log"], sh["rb"])
+    )
+    # Donation frontier: agents(0) sessions(1) vouches(2) metrics(15)
+    # trace(16) delta_log(29) — positions in fused_args, mirroring
+    # `state._WAVE_DONATED`. No cache salt here: this process never
+    # configures a persistent compilation cache and never EXECUTES the
+    # programs (compile + census only), so the donated-reload hazard
+    # the salt defends against (see state._DONATION_CACHE_SALT) cannot
+    # bite.
+    donate = (0, 1, 2, 15, 16, 29)
+
+    programs: dict[str, dict] = {}
+    hbm = None
+
+    def compile_and_census(name, fn, args, donate_argnums=()):
         compiled = (
-            jax.jit(fn, in_shardings=s, out_shardings=s)
+            jax.jit(fn, donate_argnums=donate_argnums, **jit_kw)
             .lower(*args)
             .compile()
         )
         total, heavy, top = entry_census(compiled)
-        print(f"{name:14s} entry={total:4d} dispatch-ish={heavy:4d}  {top}")
-    return 0
+        programs[name] = {"entry": total, "dispatch": heavy, "top": top}
+        return compiled
+
+    compiled_fused = compile_and_census(
+        "fused_wave_sanitized", fused_fn(True), fused_args, donate
+    )
+    compile_and_census("fused_wave", fused_fn(False), fused_args, donate)
+    compile_and_census(
+        "fused_wave_sanitized_nodonate", fused_fn(True), fused_args
+    )
+    try:
+        mm = compiled_fused.memory_analysis()
+        hbm = {
+            "temp_mb": round(mm.temp_size_in_bytes / 1e6, 2),
+            "args_mb": round(mm.argument_size_in_bytes / 1e6, 2),
+            "out_mb": round(mm.output_size_in_bytes / 1e6, 2),
+        }
+    except Exception:  # pragma: no cover — backend without the API
+        hbm = None
+
+    # ── the unfused equivalents (what a de-fused runtime re-pays) ────
+    def wave_plain(*a):
+        *w, lo, hi, m, tr, ct, cs, cw, cb, bursts = a
+        return governance_wave(
+            *w, use_pallas=use_pallas, unique_sessions=True,
+            wave_range=(lo, hi), ring_bursts=bursts, metrics=m, trace=tr,
+            trace_ctx=tracing.TraceContext(
+                trace=ct, span=cs, wave_seq=cw, sampled=cb
+            ),
+        )
+
+    compile_and_census(
+        "unfused:governance_wave", wave_plain,
+        wave_args + (sh["si"], sh["si"], sh["metrics"], sh["trace_log"])
+        + ctx_args + (sh["rb"],),
+    )
+    compile_and_census(
+        "unfused:delta_append",
+        lambda d, b_, dg, s_, t_: d.append_batch(b_, dg, s_, t_),
+        (
+            sh["delta_log"],
+            jax.ShapeDtypeStruct((S * T, merkle_ops.BODY_WORDS), jnp.uint32),
+            jax.ShapeDtypeStruct((S * T, 8), jnp.uint32),
+            jax.ShapeDtypeStruct((S * T,), jnp.int32),
+            jax.ShapeDtypeStruct((S * T,), jnp.int32),
+        ),
+    )
+
+    def gw_fn(a, e, s_, r_, ro, co, wi, ht, now, valid, m, tr, ct, cs,
+              cw, cb):
+        return gateway_ops.check_actions(
+            a, e, s_, r_, ro, co, wi, ht, now, valid=valid,
+            breach=DEFAULT_CONFIG.breach,
+            rate_limit=DEFAULT_CONFIG.rate_limit,
+            trust=DEFAULT_CONFIG.trust, metrics=m, trace=tr,
+            trace_ctx=tracing.TraceContext(
+                trace=ct, span=cs, wave_seq=cw, sampled=cb
+            ),
+        )
+
+    compile_and_census(
+        "unfused:gateway", gw_fn,
+        (sh["agents"], sh["elevations"], *gw_cols[:6], sh["sf"],
+         gw_cols[6], sh["metrics"], sh["trace_log"], *ctx_args),
+    )
+    compile_and_census(
+        "unfused:update_gauges", mp.update_gauges,
+        (sh["metrics"], sh["agents"], sh["sessions"], sh["vouches"],
+         sh["sagas"], sh["elevations"], sh["delta_log"], sh["event_log"],
+         sh["trace_log"]),
+    )
+    compile_and_census(
+        "unfused:check_invariants",
+        partial(inv.check_invariants, config=DEFAULT_CONFIG),
+        (sh["agents"], sh["sessions"], sh["vouches"], sh["sagas"],
+         sh["elevations"], sh["delta_log"], sh["event_log"],
+         sh["trace_log"], sh["rb"], sh["metrics"]),
+    )
+
+    unfused = [v for k, v in programs.items() if k.startswith("unfused:")]
+    unfused_total = {
+        "entry": sum(p["entry"] for p in unfused),
+        "dispatch": sum(p["dispatch"] for p in unfused),
+        "programs": len(unfused),
+    }
+    fused = programs["fused_wave_sanitized"]
+    anchor = R09_BASELINE.get(backend)
+    report = {
+        "source": "benchmarks/tpu_aot_census.py",
+        "backend": backend,
+        "topology": TOPOLOGY if sharding is not None else None,
+        "shape": {"S": S, "T": T, "N": N, "SC": SC, "E": E, "A": A},
+        "metric": (
+            "ENTRY instructions; dispatch = fusion+custom-call+array-copy"
+            "+dus+sort+reduce-window+gather+scatter (rank-0 copies"
+            " excluded)"
+        ),
+        "programs": programs,
+        "unfused_total": unfused_total,
+        # Self-contained de-fusion guard: the five standalone programs
+        # AT THIS COMMIT vs the one fused program.
+        "self_fusion_ratio": round(
+            unfused_total["dispatch"] / max(fused["dispatch"], 1), 3
+        ),
+        # The acceptance headline: the r09-HEAD five-program total
+        # (anchored constant, see R09_BASELINE) vs today's fused
+        # program.
+        "r09_baseline": anchor,
+        "fusion_ratio": (
+            round(anchor["dispatch_total"] / max(fused["dispatch"], 1), 3)
+            if anchor
+            else None
+        ),
+        "donation_delta_steps": (
+            programs["fused_wave_sanitized_nodonate"]["dispatch"]
+            - fused["dispatch"]
+        ),
+        "hbm": hbm,
+    }
+    return report
+
+
+def _print_text(report: dict) -> None:
+    print(
+        f"backend: {report['backend']}"
+        + (f" ({report['topology']})" if report["topology"] else "")
+    )
+    for name, p in report["programs"].items():
+        print(
+            f"{name:32s} entry={p['entry']:4d} dispatch={p['dispatch']:4d}"
+            f"  {p['top']}"
+        )
+    ut = report["unfused_total"]
+    print(
+        f"{'UNFUSED total':32s} entry={ut['entry']:4d} "
+        f"dispatch={ut['dispatch']:4d}  ({ut['programs']} programs)"
+    )
+    print(
+        f"fusion ratio vs r09: {report['fusion_ratio']}  "
+        f"(self: {report['self_fusion_ratio']}x, donation saves "
+        f"{report['donation_delta_steps']} steps)"
+    )
+    if report["hbm"]:
+        print(f"HBM MB (fused): {report['hbm']}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument(
+        "--backend", choices=("auto", "tpu", "cpu"), default="auto",
+        help="tpu = deviceless v5e AOT (needs the PJRT plugin; probe is "
+        "subprocess-bounded); cpu = hermetic XLA:CPU census; auto = tpu "
+        "with cpu fallback",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    ap.add_argument(
+        "--out", type=Path, default=None, help="also write the JSON here"
+    )
+    args = ap.parse_args(argv)
+
+    from _jax_platform import force_cpu_platform
+
+    backend = args.backend
+    sharding = None
+    if backend in ("auto", "tpu"):
+        if probe_tpu_topology():
+            import jax
+
+            from jax.experimental import topologies
+            from jax.sharding import SingleDeviceSharding
+
+            td = topologies.get_topology_desc(
+                platform="tpu", topology_name=TOPOLOGY
+            )
+            sharding = SingleDeviceSharding(td.devices[0])
+            jax.config.update("jax_compilation_cache_dir", None)
+            backend = "tpu"
+        elif args.backend == "tpu":
+            print(
+                "TPU PJRT topology unavailable (plugin absent or tunnel "
+                f"wedged past {_probe_timeout():.0f}s) — nothing to "
+                "census. Exit 75 = skip, not failure."
+            )
+            return EXIT_TPU_UNAVAILABLE
+        else:
+            backend = "cpu"
+    if backend == "cpu":
+        force_cpu_platform(8)
+
+    report = census_report(backend, sharding)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        _print_text(report)
+    if args.out is not None:
+        args.out.write_text(json.dumps(report, indent=2) + "\n")
+    return EXIT_OK
 
 
 if __name__ == "__main__":

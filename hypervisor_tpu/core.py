@@ -1703,6 +1703,9 @@ class Hypervisor:
             return 0
         import jax.numpy as jnp
 
+        # Device-ring mutation outside the journal gate: staleness-mark
+        # the fused-epilogue gauges so the next drain refreshes.
+        self.state._gauges_fresh = False
         self.state.event_log = self.state.event_log.append_batch(
             jnp.asarray(codes),
             jnp.asarray(sess),

@@ -47,7 +47,8 @@ import jax.numpy as jnp
 from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.ops import rings as ring_ops
 from hypervisor_tpu.ops import security_ops
-from hypervisor_tpu.tables.metrics import MetricsTable, counter_inc, gauge_set
+from hypervisor_tpu.ops import tally
+from hypervisor_tpu.tables.metrics import MetricsTable
 from hypervisor_tpu.tables.state import KNOWN_FLAGS_MASK
 from hypervisor_tpu.tables.struct import replace
 
@@ -273,9 +274,14 @@ def _check_sagas(sagas) -> tuple:
         (sagas.n_steps < 0) | (sagas.n_steps > max_steps)
     )
     mask |= jnp.where(nsteps_bad, jnp.uint32(G_NSTEPS), 0)
-    step_bad = live & jnp.any(
-        (sagas.step_state < 0) | (sagas.step_state >= N_STEP_STATES),
-        axis=1,
+    # Row-wise any() as one matvec over the step axis (`ops.tally`
+    # discipline): nonzero row-sum == some step code out of range.
+    step_code_bad = (
+        (sagas.step_state < 0) | (sagas.step_state >= N_STEP_STATES)
+    ).astype(jnp.float32)
+    step_bad = live & (
+        (step_code_bad @ jnp.ones((step_code_bad.shape[1],), jnp.float32))
+        > 0.0
     )
     mask |= jnp.where(step_bad, jnp.uint32(G_STEP_STATE), 0)
     return mask, state_bad | cursor_bad | nsteps_bad | step_bad
@@ -314,28 +320,40 @@ def _check_delta_ring(delta_log, n_sessions: int) -> jnp.ndarray:
     row_bad = live & (
         (sess < -1) | (sess >= n_sessions) | (tracked & (delta_log.turn < 0))
     )
-    bits |= jnp.where(jnp.any(row_bad), jnp.uint32(L_DELTA_ROW), 0)
+    bits |= jnp.where(
+        tally.count_true_1d(row_bad) > 0, jnp.uint32(L_DELTA_ROW), 0
+    )
 
     safe = jnp.clip(sess, 0, n_sessions - 1)
     turn = delta_log.turn
     big = jnp.int32(2**30)
-    count = jnp.zeros((n_sessions,), jnp.int32).at[safe].add(
-        jnp.where(tracked, 1, 0)
+    # count + turn-sum ride ONE [C, 2] scatter-add (round-9 dispatch
+    # discipline); min/max need their own combiners.
+    sums = jnp.zeros((n_sessions, 2), jnp.int32).at[safe].add(
+        jnp.stack(
+            [jnp.where(tracked, 1, 0), jnp.where(tracked, turn, 0)],
+            axis=1,
+        )
     )
-    tsum = jnp.zeros((n_sessions,), jnp.int32).at[safe].add(
-        jnp.where(tracked, turn, 0)
+    count, tsum = sums[:, 0], sums[:, 1]
+    # min and max share ONE scatter-max: min(x) == -max(-x).
+    exts = jnp.full((n_sessions, 2), -big, jnp.int32).at[safe].max(
+        jnp.stack(
+            [
+                jnp.where(tracked, turn, -big),
+                jnp.where(tracked, -turn, -big),
+            ],
+            axis=1,
+        )
     )
-    tmin = jnp.full((n_sessions,), big, jnp.int32).at[safe].min(
-        jnp.where(tracked, turn, big)
-    )
-    tmax = jnp.full((n_sessions,), -big, jnp.int32).at[safe].max(
-        jnp.where(tracked, turn, -big)
-    )
+    tmax, tmin = exts[:, 0], -exts[:, 1]
     present = count > 0
     contiguous = count == (tmax - tmin + 1)
     series = 2 * tsum == (tmin + tmax) * count
     chain_bad = present & ~(contiguous & series)
-    bits |= jnp.where(jnp.any(chain_bad), jnp.uint32(L_TURN_CHAIN), 0)
+    bits |= jnp.where(
+        tally.count_true_1d(chain_bad) > 0, jnp.uint32(L_TURN_CHAIN), 0
+    )
     return bits
 
 
@@ -378,37 +396,49 @@ def check_invariants(
         trace_bits = jnp.uint32(0)
     log_mask = jnp.stack([delta_bits, event_bits, trace_bits])
 
-    def rows(mask):
-        return jnp.sum((mask != 0).astype(jnp.int32))
-
-    total = (
-        rows(agent_mask)
-        + rows(session_mask)
-        + rows(vouch_mask)
-        + rows(saga_mask)
-        + rows(elev_mask)
-        + rows(log_mask)
-    )
-    unrepairable = (
-        jnp.sum(agent_restore.astype(jnp.int32))
-        + jnp.sum(session_restore.astype(jnp.int32))
-        + jnp.sum(vouch_restore.astype(jnp.int32))
-        + jnp.sum(saga_restore.astype(jnp.int32))
-        + rows(log_mask)
-    )
+    # Dispatch discipline (benchmarks/tpu_aot_census.py): the ten
+    # per-table reductions collapse to TWO — violation flags and
+    # restore flags each concatenate across every table axis and reduce
+    # once. Each standalone jnp.sum lowered to its own serialized
+    # reduce chain; the sanitizer is a fused-wave epilogue now, so its
+    # step count rides the wave's dispatch budget.
+    violation_flags = jnp.concatenate([
+        (agent_mask != 0),
+        (session_mask != 0),
+        (vouch_mask != 0),
+        (saga_mask != 0),
+        (elev_mask != 0),
+        (log_mask != 0),
+    ])
+    total = tally.count_true_1d(violation_flags)
+    restore_flags = jnp.concatenate([
+        agent_restore,
+        session_restore,
+        vouch_restore,
+        saga_restore,
+        (log_mask != 0),
+    ])
+    unrepairable = tally.count_true_1d(restore_flags)
 
     if metrics is not None:
         from hypervisor_tpu.observability import metrics as mp
+        from hypervisor_tpu.tables.metrics import (
+            counter_add_many,
+            gauge_set_many,
+        )
 
-        metrics = counter_inc(metrics, mp.INTEGRITY_CHECKS.index, 1)
-        metrics = counter_inc(
-            metrics, mp.INTEGRITY_VIOLATIONS.index, total.astype(jnp.uint32)
+        metrics = counter_add_many(
+            metrics,
+            (mp.INTEGRITY_CHECKS.index, mp.INTEGRITY_VIOLATIONS.index),
+            (jnp.uint32(1), total.astype(jnp.uint32)),
         )
-        metrics = gauge_set(
-            metrics, mp.INTEGRITY_VIOLATION_ROWS.index, total
-        )
-        metrics = gauge_set(
-            metrics, mp.INTEGRITY_UNREPAIRABLE_ROWS.index, unrepairable
+        metrics = gauge_set_many(
+            metrics,
+            (
+                mp.INTEGRITY_VIOLATION_ROWS.index,
+                mp.INTEGRITY_UNREPAIRABLE_ROWS.index,
+            ),
+            (total, unrepairable),
         )
 
     return IntegrityResult(

@@ -153,6 +153,7 @@ class IntegrityPlane:
         self._lock = threading.Lock()
         self._dispatches = 0
         self._pending = False           # drain saw a nonzero gauge
+        self._fused_due = False         # cadence armed a fused-wave check
         self._last_result = None        # device-resident IntegrityResult
         self._last_check_dispatch = 0
         self.checks = 0
@@ -168,10 +169,18 @@ class IntegrityPlane:
 
     # -- the dispatch-site gate -----------------------------------------
 
-    def on_dispatch(self, stage: str) -> None:
+    def on_dispatch(self, stage: str, fused: bool = False) -> None:
         """Cadence hook at every wave dispatch site (host-side, before
         the wave): settle any drain-flagged damage first — a known-dirty
         table must not serve one more wave — then maybe sample.
+
+        `fused`: the upcoming dispatch is a fused governance wave that
+        can fold the sanitizer into its own program — a cadence hit
+        arms `_fused_due` (the bridge consumes it via `take_fused_due`
+        and dispatches the sanitize=True wave variant, then hands the
+        masks back through `absorb_fused`) instead of dispatching
+        `check_invariants` separately. Same cadence, same masks, zero
+        extra dispatch steps.
 
         If settling (or a paced scrub) escalates to a restore, the
         in-flight dispatch is refused with `StateRestoredError` BEFORE
@@ -190,7 +199,11 @@ class IntegrityPlane:
                     "tables replaced) — re-issue against supervisor.state"
                 )
         if self.every > 0 and n % self.every == 0:
-            self._run_check()
+            if fused:
+                with self._lock:
+                    self._fused_due = True
+            else:
+                self._run_check()
         if self.scrub_every > 0 and n % self.scrub_every == 0:
             report = self.scrub_tick()
             if report.get("restored"):
@@ -223,6 +236,29 @@ class IntegrityPlane:
             self._last_check_dispatch = self._dispatches
         return result
 
+    # -- the fused-wave variant (round 9) --------------------------------
+
+    def take_fused_due(self) -> bool:
+        """Consume the fused-sanitizer arming (`on_dispatch(fused=True)`
+        set it): True exactly once per cadence hit — the bridge then
+        dispatches the wave's sanitize=True variant."""
+        with self._lock:
+            due, self._fused_due = self._fused_due, False
+        return due
+
+    def absorb_fused(self, result) -> None:
+        """Book a sanitizer pass that rode the fused wave: `result` is
+        `WaveResult.sanitizer` (an IntegrityResult, metrics=None — the
+        counts already rode the wave's metrics table, which the bridge
+        committed). Masks stay device-resident exactly as `_run_check`
+        leaves them; detection still closes at the drain."""
+        if result is None:
+            return
+        with self._lock:
+            self.checks += 1
+            self._last_result = result
+            self._last_check_dispatch = self._dispatches
+
     # -- drain-side detection -------------------------------------------
 
     def observe_snapshot(self, snap) -> None:
@@ -243,6 +279,9 @@ class IntegrityPlane:
         (violations by table, repairs applied, restore escalation).
         """
         st = self.state
+        # Repairs rebind tables outside the journal/dispatch gates: the
+        # fused-epilogue gauge rows may go stale here.
+        st._gauges_fresh = False
         result = self._run_check()
         host = jax.device_get(
             (

@@ -559,8 +559,13 @@ class Metrics:
             if refresh is not None:
                 table = refresh(table)
             host = jax.device_get(table)
-            raw_c = np.asarray(host.counters, np.uint32)
-            raw_h = np.asarray(host.hist, np.uint32)
+            # COPIES, not views: `_d_*_raw` persist across drains, and
+            # device_get of a CPU jax.Array is zero-copy — under the
+            # round-9 donation default the metrics buffer is rewritten
+            # in place by the next wave, which would silently mutate a
+            # retained view and wreck the mod-2^32 wrap accounting.
+            raw_c = np.array(host.counters, np.uint32, copy=True)
+            raw_h = np.array(host.hist, np.uint32, copy=True)
             with self._lock:
                 # delta = (raw - last) mod 2^32: monotonic past u32 wrap.
                 self._d_counters_cum += (
@@ -799,18 +804,27 @@ def update_gauges(
 ):
     """Recompute occupancy gauges from the state tables, on device.
 
-    One jitted program over whole columns — dispatched by
-    `HypervisorState.metrics_snapshot()` right before the drain, never
-    inside a wave. The optional tables feed the health plane's
-    per-table live-row gauges (`TABLE_LIVE_ROWS`) in the SAME program,
-    so occupancy accounting adds nothing to the drain's single
-    `device_get`; callers that omit them (legacy refreshes) simply
-    leave those gauge rows at their last value.
+    One pure jittable pass over whole columns — dispatched by
+    `HypervisorState.metrics_snapshot()` right before the drain, and
+    ALSO folded as the epilogue tail of the fused governance wave
+    (`ops.pipeline.governance_wave(epilogue_tables=...)`), so on the
+    wave path the gauge refresh costs zero extra dispatches. The
+    optional tables feed the health plane's per-table live-row gauges
+    (`TABLE_LIVE_ROWS`) in the SAME program; callers that omit them
+    (legacy refreshes) simply leave those gauge rows at their last
+    value.
+
+    Dispatch discipline (benchmarks/tpu_aot_census.py): every count
+    over one table axis stacks into ONE masked reduction per axis, and
+    all gauge rows land in ONE scatter (`gauge_set_many`) — the chained
+    per-gauge sum + set form cost ~26 serialized reduce steps per
+    refresh.
     """
     import jax.numpy as jnp
 
     from hypervisor_tpu.models import SessionState
-    from hypervisor_tpu.tables.metrics import gauge_set
+    from hypervisor_tpu.ops import tally
+    from hypervisor_tpu.tables.metrics import gauge_set_many
     from hypervisor_tpu.tables.state import (
         FLAG_ACTIVE,
         FLAG_BREAKER_TRIPPED,
@@ -819,49 +833,52 @@ def update_gauges(
 
     flags = agents.flags
     active = (flags & FLAG_ACTIVE) != 0
-    m = metrics
-    for r, handle in enumerate(RING_AGENTS):
-        m = gauge_set(
-            m, handle.index,
-            jnp.sum((active & (agents.ring == r)).astype(jnp.int32)),
-        )
-    m = gauge_set(
-        m, AGENTS_ACTIVE.index, jnp.sum(active.astype(jnp.int32))
+
+    # ── agent-axis counts: ONE [8, N] matvec (`ops.tally`) ───────────
+    agent_counts = tally.count_true(
+        *(active & (agents.ring == r) for r in range(4)),
+        active,
+        active & ((flags & FLAG_QUARANTINED) != 0),
+        active & ((flags & FLAG_BREAKER_TRIPPED) != 0),
+        agents.did >= 0,
     )
-    m = gauge_set(
-        m, QUARANTINED.index,
-        jnp.sum((active & ((flags & FLAG_QUARANTINED) != 0)).astype(jnp.int32)),
-    )
-    m = gauge_set(
-        m, BREAKER_TRIPPED.index,
-        jnp.sum(
-            (active & ((flags & FLAG_BREAKER_TRIPPED) != 0)).astype(jnp.int32)
-        ),
-    )
-    live = (sessions.sid >= 0) & (
+
+    # ── session-axis counts: ONE [2, S] matvec ───────────────────────
+    sess_live = (sessions.sid >= 0) & (
         (sessions.state == SessionState.HANDSHAKING.code)
         | (sessions.state == SessionState.ACTIVE.code)
     )
-    m = gauge_set(m, SESSIONS_LIVE.index, jnp.sum(live.astype(jnp.int32)))
-    m = gauge_set(
-        m, VOUCH_EDGES_ACTIVE.index,
-        jnp.sum(vouches.active.astype(jnp.int32)),
-    )
+    sess_counts = tally.count_true(sess_live, sessions.sid >= 0)
 
-    # Health-plane live-row gauges: allocated rows per table, ring
-    # cursors clamped to capacity (a wrapped ring stays "full").
-    def live_rows(name, value):
-        return gauge_set(m, TABLE_LIVE_ROWS[name].index, value)
+    vouch_active = tally.count_true_1d(vouches.active)
 
-    m = live_rows("agents", jnp.sum((agents.did >= 0).astype(jnp.int32)))
-    m = live_rows("sessions", jnp.sum((sessions.sid >= 0).astype(jnp.int32)))
-    m = live_rows("vouches", jnp.sum(vouches.active.astype(jnp.int32)))
+    indices = [h.index for h in RING_AGENTS] + [
+        AGENTS_ACTIVE.index,
+        QUARANTINED.index,
+        BREAKER_TRIPPED.index,
+        SESSIONS_LIVE.index,
+        VOUCH_EDGES_ACTIVE.index,
+        TABLE_LIVE_ROWS["agents"].index,
+        TABLE_LIVE_ROWS["sessions"].index,
+        TABLE_LIVE_ROWS["vouches"].index,
+    ]
+    values = [
+        agent_counts[0], agent_counts[1], agent_counts[2], agent_counts[3],
+        agent_counts[4],            # AGENTS_ACTIVE
+        agent_counts[5],            # QUARANTINED
+        agent_counts[6],            # BREAKER_TRIPPED
+        sess_counts[0],             # SESSIONS_LIVE
+        vouch_active,               # VOUCH_EDGES_ACTIVE
+        agent_counts[7],            # live_rows: agents (allocated)
+        sess_counts[1],             # live_rows: sessions (allocated)
+        vouch_active,               # live_rows: vouches
+    ]
     if sagas is not None:
-        m = live_rows("sagas", jnp.sum((sagas.session >= 0).astype(jnp.int32)))
+        indices.append(TABLE_LIVE_ROWS["sagas"].index)
+        values.append(tally.count_true_1d(sagas.session >= 0))
     if elevations is not None:
-        m = live_rows(
-            "elevations", jnp.sum(elevations.active.astype(jnp.int32))
-        )
+        indices.append(TABLE_LIVE_ROWS["elevations"].index)
+        values.append(tally.count_true_1d(elevations.active))
     for name, log in (
         ("delta_log", delta_log),
         ("event_log", event_log),
@@ -872,8 +889,10 @@ def update_gauges(
             # backs footprint() too), so the clamp and the published
             # capacity gauge cannot disagree.
             cap = log.cursor.dtype.type(log.capacity_rows)
-            m = live_rows(name, jnp.minimum(log.cursor, cap))
-    return m
+            indices.append(TABLE_LIVE_ROWS[name].index)
+            values.append(jnp.minimum(log.cursor, cap))
+    # ── every gauge row in ONE scatter ───────────────────────────────
+    return gauge_set_many(metrics, indices, values)
 
 
 def iter_stage_quantiles(

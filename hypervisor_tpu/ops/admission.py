@@ -164,6 +164,7 @@ def admit_batch(
     metrics: MetricsTable | None = None,
     trace=None,       # TraceLog riding the wave (flight recorder)
     trace_ctx=None,   # observability.tracing.TraceContext scalars
+    cache_salt: float = 0.0,  # static: see state._DONATION_CACHE_SALT
 ) -> AdmissionResult:
     """Admit a wave of B agents; rejected elements leave no trace.
 
@@ -195,6 +196,13 @@ def admit_batch(
     # state+count+capacity (state merged into the i32 block in round 5
     # — one fewer gather), min-sigma rides the f32 rows. Two gathers
     # where the unpacked layout took four.
+    if cache_salt:
+        # Persistent-cache poison pill for the DONATED twin (see
+        # `ops.pipeline.governance_wave` — reloaded donated executables
+        # mis-apply aliasing); the zero-multiply folds away in XLA.
+        now = jnp.asarray(now, jnp.float32) + jnp.float32(
+            cache_salt
+        ) * jnp.float32(0.0)
     sess_i32 = sessions.i32[session_slot]      # [B, 5]
     sess_state = sess_i32[:, SI32_STATE]
     sess_count = sess_i32[:, SI32_NPART]
@@ -278,12 +286,13 @@ def admit_batch(
         from hypervisor_tpu.observability import metrics as metrics_schema
         from hypervisor_tpu.tables import metrics as metrics_ops
 
-        n_ok = jnp.sum(ok.astype(jnp.int32))
-        metrics = metrics_ops.counter_inc(
-            metrics, metrics_schema.ADMITTED.index, n_ok
-        )
-        metrics = metrics_ops.counter_inc(
-            metrics, metrics_schema.REFUSED.index, b - n_ok
+        from hypervisor_tpu.ops import tally
+
+        n_ok = tally.count_true_1d(ok)
+        metrics = metrics_ops.counter_add_many(
+            metrics,
+            (metrics_schema.ADMITTED.index, metrics_schema.REFUSED.index),
+            (n_ok, b - n_ok),
         )
         metrics = metrics_ops.observe(
             metrics,

@@ -70,34 +70,69 @@ GATE_RATE = 4
 GATE_INVALID = 5   # masked-out lane (ragged wave padding)
 
 
+class _SegmentLayout(NamedTuple):
+    """One wave's slot-grouping, computed ONCE and shared by every
+    segment prefix the gateway needs.
+
+    The four in-wave sequencing rules (call count, privileged count,
+    breaker-trip order, rate settle) all group by the same `slot`
+    column; before round 9 each paid its own stable argsort + cummax +
+    inverse scatter — 4 sorts where one suffices (the r5 census named
+    the gateway's serialized sort/cumsum chains as a top dispatch
+    cost). Only the cumsums themselves are data-dependent."""
+
+    order: jnp.ndarray      # i32[B] stable sort permutation by slot
+    inv: jnp.ndarray        # i32[B] inverse permutation
+    start_pos: jnp.ndarray  # i32[B] group-start index per SORTED position
+
+
+def _segment_layout(slot: jnp.ndarray) -> _SegmentLayout:
+    b = slot.shape[0]
+    order = jnp.argsort(slot, stable=True)
+    s_sorted = slot[order]
+    idx = jnp.arange(b, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), s_sorted[1:] != s_sorted[:-1]]
+    )
+    start_pos = jax.lax.cummax(jnp.where(is_start, idx, 0))
+    inv = jnp.zeros((b,), jnp.int32).at[order].set(idx)
+    return _SegmentLayout(order=order, inv=inv, start_pos=start_pos)
+
+
+def _segment_prefix_many(
+    layout: _SegmentLayout, cols: tuple[jnp.ndarray, ...]
+) -> tuple[tuple[jnp.ndarray, jnp.ndarray], ...]:
+    """(inclusive, exclusive) per-slot-group prefix sums for M columns
+    that share one layout, respecting wave order.
+
+    The columns stack to [M, B] so ALL their cumsums lower as one
+    scan chain instead of M — the structural payoff of sharing the
+    layout. Returns a tuple of (incl, excl) pairs in `cols` order.
+    """
+    m = len(cols)
+    stacked = jnp.stack(cols)                       # [M, B]
+    v_sorted = stacked[:, layout.order]
+    c = jnp.cumsum(v_sorted, axis=1)
+    c_before = jnp.concatenate(
+        [jnp.zeros((m, 1), c.dtype), c[:, :-1]], axis=1
+    )
+    base = c_before[:, layout.start_pos]
+    incl_sorted = c - base
+    excl_sorted = incl_sorted - v_sorted
+    incl = incl_sorted[:, layout.inv]
+    excl = excl_sorted[:, layout.inv]
+    return tuple((incl[i], excl[i]) for i in range(m))
+
+
 def _segment_prefix(
     slot: jnp.ndarray, vals: jnp.ndarray
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(inclusive, exclusive) prefix sums of `vals` within equal-slot
-    groups, respecting wave order.
-
-    One stable sort by slot (ties keep wave order), one cumsum, and a
-    segment-base subtraction — O(B log B), no host loop, no [B, B] mask.
-    """
-    b = slot.shape[0]
-    order = jnp.argsort(slot, stable=True)
-    s_sorted = slot[order]
-    v_sorted = vals[order]
-    c = jnp.cumsum(v_sorted)
-    is_start = jnp.concatenate(
-        [jnp.ones((1,), bool), s_sorted[1:] != s_sorted[:-1]]
-    )
-    start_pos = jax.lax.cummax(
-        jnp.where(is_start, jnp.arange(b, dtype=jnp.int32), 0)
-    )
-    c_before = jnp.concatenate([jnp.zeros((1,), c.dtype), c[:-1]])
-    base = c_before[start_pos]
-    incl_sorted = c - base
-    excl_sorted = incl_sorted - v_sorted
-    inv = jnp.zeros((b,), jnp.int32).at[order].set(
-        jnp.arange(b, dtype=jnp.int32)
-    )
-    return incl_sorted[inv], excl_sorted[inv]
+    groups, respecting wave order — the single-column convenience form
+    (tests and external callers); `check_actions` shares one layout
+    across its four prefixes instead."""
+    ((incl, excl),) = _segment_prefix_many(_segment_layout(slot), (vals,))
+    return incl, excl
 
 
 class GatewayResult(NamedTuple):
@@ -182,10 +217,14 @@ def check_actions(
     base_calls, base_priv = security_ops.window_totals(
         agents.bd_window, now_f, breach
     )
+    # ONE slot-grouping layout (sort + group starts + inverse) shared
+    # by all four in-wave prefixes; the first two cumsums stack.
+    layout = _segment_layout(slot)
     ones = valid.astype(jnp.int32)
-    k_incl, _ = _segment_prefix(slot, ones)
     privileged = (required_ring < eff) & valid
-    p_incl, _ = _segment_prefix(slot, privileged.astype(jnp.int32))
+    (k_incl, _), (p_incl, _) = _segment_prefix_many(
+        layout, (ones, privileged.astype(jnp.int32))
+    )
     total_i = base_calls[slot] + k_incl
     priv_i = base_priv[slot] + p_incl
     analyzable = total_i >= breach.min_calls_for_analysis
@@ -198,7 +237,7 @@ def check_actions(
     cond = (analyzable & (rate_i >= breach.high_threshold) & valid).astype(
         jnp.int32
     )
-    _, cond_before = _segment_prefix(slot, cond)
+    ((_, cond_before),) = _segment_prefix_many(layout, (cond,))
     live = (pre_dev_live | host_tripped | (cond_before > 0)) & valid
 
     # The record that trips is the FIRST condition-true record of an
@@ -241,7 +280,9 @@ def check_actions(
         agents.rl_tokens, agents.rl_stamp, ring_for_rate, now_f,
         config=rate_limit,
     )
-    r_incl, _ = _segment_prefix(slot, reaching.astype(jnp.int32))
+    ((r_incl, _),) = _segment_prefix_many(
+        layout, (reaching.astype(jnp.int32),)
+    )
     rate_ok = r_incl.astype(jnp.float32) <= refilled[slot]
     allowed = reaching & rate_ok
 
@@ -266,11 +307,25 @@ def check_actions(
     )
 
     # ── post-state: counters, breaker flags, buckets ─────────────────
-    calls_add = jnp.zeros((n,), jnp.int32).at[slot].add(ones)
-    priv_add = jnp.zeros((n,), jnp.int32).at[slot].add(
-        privileged.astype(jnp.int32)
+    # The four per-row accumulations (call count, privileged count,
+    # breaker trips, granted tokens) land as ONE [A, 4] scatter-add
+    # instead of four serialized scatters (round-9 dispatch discipline;
+    # f32 accumulation is exact for wave-sized counts, and a bool max
+    # equals a count > 0).
+    row_adds = jnp.zeros((n, 4), jnp.float32).at[slot].add(
+        jnp.stack(
+            [
+                ones.astype(jnp.float32),
+                privileged.astype(jnp.float32),
+                trip_action.astype(jnp.float32),
+                allowed.astype(jnp.float32),
+            ],
+            axis=1,
+        )
     )
-    tripped_rows = jnp.zeros((n,), bool).at[slot].max(trip_action)
+    calls_add = row_adds[:, 0].astype(jnp.int32)
+    priv_add = row_adds[:, 1].astype(jnp.int32)
+    tripped_rows = row_adds[:, 2] > 0.0
     # Release breakers whose cooldown lapsed (host boundary: released at
     # now >= cooldown end, `breach_detector.py:171-178`), unless this
     # very wave re-tripped them.
@@ -291,9 +346,7 @@ def check_actions(
     # Whole-table refill + restamp, exactly like `consume_rate` (refill
     # is time-shift idempotent, so rolling every bucket forward is
     # semantics-preserving); only granted tokens leave buckets.
-    grants = jnp.zeros((n,), jnp.float32).at[slot].add(
-        allowed.astype(jnp.float32)
-    )
+    grants = row_adds[:, 3]
     new_agents = replace(
         agents,
         bd_window=security_ops.window_commit(
@@ -310,14 +363,16 @@ def check_actions(
         from hypervisor_tpu.observability import metrics as metrics_schema
         from hypervisor_tpu.tables import metrics as metrics_ops
 
-        n_allowed = jnp.sum(allowed.astype(jnp.int32))
-        metrics = metrics_ops.counter_inc(
-            metrics, metrics_schema.GATEWAY_ALLOWED.index, n_allowed
-        )
-        metrics = metrics_ops.counter_inc(
+        from hypervisor_tpu.ops import tally
+
+        counts = tally.count_true(allowed, valid)
+        metrics = metrics_ops.counter_add_many(
             metrics,
-            metrics_schema.GATEWAY_DENIED.index,
-            jnp.sum(valid.astype(jnp.int32)) - n_allowed,
+            (
+                metrics_schema.GATEWAY_ALLOWED.index,
+                metrics_schema.GATEWAY_DENIED.index,
+            ),
+            (counts[0], counts[1] - counts[0]),
         )
     if trace is not None:
         from hypervisor_tpu.observability import tracing

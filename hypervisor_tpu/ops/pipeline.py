@@ -186,6 +186,16 @@ class WaveResult(NamedTuple):
     released: jnp.ndarray       # i32 bonds released at terminate
     metrics: MetricsTable | None = None  # updated when a table rode in
     trace: object = None        # TraceLog, updated when the ring rode in
+    # Fused control planes (round 9 mega-fusion): the gateway phase's
+    # per-action lanes (a GatewayResult with agents=None — the wave's
+    # own `agents` IS the post-gateway table), the folded invariant
+    # sanitizer's masks (an IntegrityResult with metrics=None — the
+    # wave's `metrics` already carries the sanitizer counters), and the
+    # DeltaLog ring with this wave's audit records appended in-program
+    # (None when the ring did not ride).
+    gateway: object = None
+    sanitizer: object = None
+    delta_log: object = None
 
 
 def governance_wave(
@@ -210,6 +220,17 @@ def governance_wave(
     metrics: MetricsTable | None = None,
     trace=None,       # TraceLog riding the wave (flight recorder)
     trace_ctx=None,   # observability.tracing.TraceContext scalars
+    elevations=None,            # ElevationTable (gateway phase + epilogue)
+    gateway_args=None,          # 7-tuple: (slot, required_ring, is_read_only,
+                                #   has_consensus, has_sre_witness,
+                                #   host_tripped, valid) — padded [A] columns
+    breach=DEFAULT_CONFIG.breach,          # static (gateway phase)
+    rate_limit=DEFAULT_CONFIG.rate_limit,  # static (gateway phase)
+    delta_log=None,             # DeltaLog ring: audit append fuses in-program
+    epilogue_tables=None,       # (sagas, event_log) read-only
+    sanitize: bool = False,     # static: fold the invariant sanitizer tail
+    config=DEFAULT_CONFIG,      # static (sanitizer thresholds)
+    cache_salt: float = 0.0,    # static: see state._DONATION_CACHE_SALT
 ) -> WaveResult:
     """The full governance pipeline AS ONE PROGRAM over the state tables.
 
@@ -254,21 +275,62 @@ def governance_wave(
     `tests/unit/test_tracing.py`). The seq words record PROGRAM
     structure — XLA schedules the real phases freely inside the one
     program; wall-clock truth is the host bracket around the dispatch.
+
+    Round-9 mega-fusion (ISSUE 9) — three optional fused phases, all
+    inside this same program so a full facade wave step is ONE dispatch
+    with ONE donation frontier:
+
+      * `gateway_args` (+ `elevations`, `breach`, `rate_limit`): the
+        per-action gateway runs as phase 7 on the post-terminate table
+        — the single-device twin of the mesh `with_gateway` fusion.
+        Lanes arrive pre-padded (power-of-two + valid mask); the
+        verdict columns return on `WaveResult.gateway` (agents=None —
+        this result's `agents` IS the post-gateway table).
+      * `delta_log`: the wave's audit records (lane-major bodies +
+        chain digests, turns 0..T-1 — wave sessions are born this
+        wave) append onto the ring IN-PROGRAM, replacing the separate
+        post-wave `append_batch` dispatch; the updated ring returns on
+        `WaveResult.delta_log` and is donated alongside the tables.
+      * `epilogue_tables` = (sagas, event_log), read-only: the
+        occupancy-gauge refresh (`observability.metrics.update_gauges`)
+        folds in as the program's tail — over the post-append ring —
+        so the drain needs no separate refresh dispatch after a fused
+        wave. Requires `metrics`; pass `elevations` for its gauge row.
+      * `sanitize` (static, requires `epilogue_tables` + `metrics`):
+        the invariant sanitizer (`integrity.invariants.
+        check_invariants`) folds into the same tail — masks return on
+        `WaveResult.sanitizer`, counts ride `metrics` — so a sampled
+        integrity check costs zero extra dispatches
+        (`integrity.plane.IntegrityPlane` cadence picks this variant).
     """
     from hypervisor_tpu.ops import liability as liability_ops
     from hypervisor_tpu.ops import terminate as terminate_ops
 
+    wave_stamps = None
     if trace is not None:
         from hypervisor_tpu.observability import tracing
 
-        root_stamp = tracing.WaveStamps(trace_ctx, "governance_wave")
-        root_stamp.begin("governance_wave", lane=slot.shape[0])
-        trace = root_stamp.commit(trace)
-
-        def _phase_stamps():
-            return tracing.WaveStamps(trace_ctx, "governance_wave")
+        # ONE stamp builder for the whole program (round 9): the root
+        # bracket, the admission phase's rows (span words identical to
+        # the nested op's own child-ctx stamps — `child_span_word` is
+        # the one derivation), and every later phase accumulate and
+        # land as ONE batched ring scatter per column instead of three.
+        wave_stamps = tracing.WaveStamps(trace_ctx, "governance_wave")
+        wave_stamps.begin("governance_wave", lane=slot.shape[0])
     n_cap = agents.did.shape[0]
     now_f = jnp.asarray(now, jnp.float32)
+    if cache_salt:
+        # Process-unique constant folded into the module (XLA optimizes
+        # the zero-multiply away): the donated twins must never be
+        # RELOADED from the persistent compilation cache — jax 0.4.37
+        # reload of a donated executable mis-applies the input/output
+        # aliasing and writes through buffers other live arrays still
+        # reference (observed as heap garbage in untouched table
+        # columns on warm-cache runs; cold compiles are correct). The
+        # salt makes each process's donated key unique, so in-memory
+        # jit caching works as usual and the on-disk reload path never
+        # serves a donated program.
+        now_f = now_f + jnp.float32(cache_salt) * jnp.float32(0.0)
 
     # ── 1. vouched contributions toward each joining agent ───────────
     # Wave agents are not in the tables yet: scope each live edge to the
@@ -279,8 +341,12 @@ def governance_wave(
     )[slot]
 
     # ── 2. admission onto the tables ─────────────────────────────────
-    # The nested op stamps its own hv.admission_wave rows under a
-    # re-rooted child context, so its span nests under this wave's root.
+    # The admission phase's hv.admission_wave rows ride the wave's ONE
+    # stamp batch (identical span words to the nested op's own
+    # child-ctx stamps), so the op itself traces stamp-free here.
+    if wave_stamps is not None:
+        wave_stamps.begin("admission_wave", lane=slot.shape[0])
+        wave_stamps.end("admission_wave", lane=slot.shape[0])
     admitted = admission_ops.admit_batch(
         agents,
         sessions,
@@ -297,14 +363,9 @@ def governance_wave(
         ring_bursts=ring_bursts,
         unique_sessions=unique_sessions,
         metrics=metrics,
-        trace=trace,
-        trace_ctx=(
-            trace_ctx.child("admission_wave") if trace is not None else None
-        ),
     )
     agents, sessions = admitted.agents, admitted.sessions
     metrics = admitted.metrics
-    trace = admitted.trace
     ok = admitted.status == admission_ops.ADMIT_OK
 
     # ── 3. session FSM: HANDSHAKING -> ACTIVE where populated ────────
@@ -368,50 +429,165 @@ def governance_wave(
     )
 
     fsm_err = err_a | err_t | err_z
+
+    # ── audit append onto the DeltaLog ring, in-program ──────────────
+    # The same lane-major layout the bridge staged host-side before
+    # round 9 (`state._governance_wave_impl`): rows s0t0..s0t{T-1},
+    # s1t0, … — one fewer dispatch per wave, and the ring rides the
+    # donation frontier like every other table.
+    if delta_log is not None and t > 0:
+        bodies_flat = jnp.transpose(delta_bodies, (1, 0, 2)).reshape(
+            k * t, delta_bodies.shape[2]
+        )
+        digests_flat = jnp.transpose(chain, (1, 0, 2)).reshape(k * t, 8)
+        delta_log = delta_log.append_batch(
+            bodies_flat,
+            digests_flat,
+            jnp.repeat(k_sessions, t),
+            jnp.tile(jnp.arange(t, dtype=jnp.int32), k),
+        )
+
+    # ── 7. fused action gateway (single-device twin of the mesh's
+    #    with_gateway phase): runs on the POST-terminate table inside
+    #    the same program, exactly the order the composed two-dispatch
+    #    path produced — but as one dispatch with one donation
+    #    frontier. Lanes arrive pre-padded (power-of-two + valid mask,
+    #    `HypervisorState._governance_wave_impl`). ──────────────────────
+    gw_lanes = None
+    if gateway_args is not None:
+        from hypervisor_tpu.ops import gateway as gateway_ops
+
+        (act_slot, act_required, act_ro, act_cons, act_wit, act_host,
+         act_valid) = gateway_args
+        gw = gateway_ops.check_actions(
+            agents,
+            elevations,
+            act_slot,
+            act_required,
+            act_ro,
+            act_cons,
+            act_wit,
+            act_host,
+            now_f,
+            valid=act_valid,
+            breach=breach,
+            rate_limit=rate_limit,
+            trust=trust,
+            metrics=metrics,
+        )
+        agents = gw.agents
+        metrics = gw.metrics if metrics is not None else metrics
+        gw_lanes = gw._replace(agents=None, metrics=None)
+
     if metrics is not None:
         from hypervisor_tpu.observability import metrics as metrics_schema
         from hypervisor_tpu.tables import metrics as metrics_ops
 
-        metrics = metrics_ops.counter_inc(
-            metrics, metrics_schema.WAVE_TICKS.index
-        )
-        metrics = metrics_ops.counter_inc(
+        # The [B]/[K]-axis tallies batch into one matvec each axis
+        # (`ops.tally`); all five counter rows land in ONE scatter-add
+        # (dispatch discipline — chained counter_inc calls and
+        # standalone sums each lowered to their own serialized step).
+        from hypervisor_tpu.ops import tally
+
+        archived_col = (wave_state == SessionState.ARCHIVED.code) & ~fsm_err
+        if step_state.shape == archived_col.shape:
+            # Bench/facade waves have B == K: all three lane tallies
+            # ride ONE matvec.
+            wave_counts = tally.count_true(
+                step_state == saga_ops.STEP_COMMITTED,
+                step_state == saga_ops.STEP_FAILED,
+                archived_col,
+            )
+        else:
+            saga_counts = tally.count_true(
+                step_state == saga_ops.STEP_COMMITTED,
+                step_state == saga_ops.STEP_FAILED,
+            )
+            wave_counts = (
+                saga_counts[0],
+                saga_counts[1],
+                tally.count_true_1d(archived_col),
+            )
+        metrics = metrics_ops.counter_add_many(
             metrics,
-            metrics_schema.SAGA_STEPS_COMMITTED.index,
-            jnp.sum((step_state == saga_ops.STEP_COMMITTED).astype(jnp.int32)),
-        )
-        metrics = metrics_ops.counter_inc(
-            metrics,
-            metrics_schema.SAGA_STEPS_FAILED.index,
-            jnp.sum((step_state == saga_ops.STEP_FAILED).astype(jnp.int32)),
-        )
-        metrics = metrics_ops.counter_inc(
-            metrics,
-            metrics_schema.SESSIONS_ARCHIVED.index,
-            jnp.sum(
-                (
-                    (wave_state == SessionState.ARCHIVED.code) & ~fsm_err
-                ).astype(jnp.int32)
+            (
+                metrics_schema.WAVE_TICKS.index,
+                metrics_schema.SAGA_STEPS_COMMITTED.index,
+                metrics_schema.SAGA_STEPS_FAILED.index,
+                metrics_schema.SESSIONS_ARCHIVED.index,
+                metrics_schema.BONDS_RELEASED.index,
+            ),
+            (
+                jnp.uint32(1),
+                wave_counts[0],
+                wave_counts[1],
+                wave_counts[2],
+                released,
             ),
         )
-        metrics = metrics_ops.counter_inc(
-            metrics, metrics_schema.BONDS_RELEASED.index, released
+    if wave_stamps is not None:
+        # The remaining phase stamps + the root end join the SAME
+        # accumulated batch — the whole wave's stamps land as ONE fused
+        # ring scatter per column. Phase order must match
+        # WAVE_CHILD_STAGES (the host mirror replays that sequence;
+        # mode-parity-tested).
+        wave_stamps.begin("session_fsm", lane=k)
+        wave_stamps.end("session_fsm", lane=k)
+        wave_stamps.begin("delta_chain", lane=t)
+        wave_stamps.end("delta_chain", lane=t)
+        wave_stamps.begin("saga_round", lane=slot.shape[0])
+        wave_stamps.end("saga_round", lane=slot.shape[0])
+        wave_stamps.begin("terminate_wave", lane=k)
+        wave_stamps.end("terminate_wave", lane=k)
+        wave_stamps.end("governance_wave", lane=slot.shape[0])
+        trace = wave_stamps.commit(trace)
+
+    # ── fused control-plane epilogue (round 9): the gauge refresh and
+    #    the invariant sanitizer fold into the SAME program, reading
+    #    the post-wave tables this program already holds — the five
+    #    planes cost one fused tail instead of separate dispatches.
+    #    `epilogue_tables` carries the tables the wave does not mutate
+    #    (read-only args: no donation needed, no copies emitted). ───────
+    sanitizer_result = None
+    if epilogue_tables is not None and metrics is not None:
+        from hypervisor_tpu.observability import metrics as metrics_schema
+
+        ep_sagas, ep_event_log = epilogue_tables
+        metrics = metrics_schema.update_gauges(
+            metrics,
+            agents,
+            sessions,
+            vouches,
+            ep_sagas,
+            elevations,
+            delta_log,
+            ep_event_log,
+            trace,
         )
-    if trace is not None:
-        # The remaining phase stamps + the root end, ONE fused ring
-        # scatter. Phase order must match WAVE_CHILD_STAGES (the host
-        # mirror replays that sequence; mode-parity-tested).
-        stamps = _phase_stamps()
-        stamps.begin("session_fsm", lane=k)
-        stamps.end("session_fsm", lane=k)
-        stamps.begin("delta_chain", lane=t)
-        stamps.end("delta_chain", lane=t)
-        stamps.begin("saga_round", lane=slot.shape[0])
-        stamps.end("saga_round", lane=slot.shape[0])
-        stamps.begin("terminate_wave", lane=k)
-        stamps.end("terminate_wave", lane=k)
-        stamps.end("governance_wave", lane=slot.shape[0])
-        trace = stamps.commit(trace)
+        if sanitize:
+            from hypervisor_tpu.integrity import invariants as inv
+
+            bursts = (
+                jnp.asarray(DEFAULT_CONFIG.rate_limit.ring_bursts,
+                            jnp.float32)
+                if ring_bursts is None
+                else jnp.asarray(ring_bursts, jnp.float32)
+            )
+            sres = inv.check_invariants(
+                agents,
+                sessions,
+                vouches,
+                ep_sagas,
+                elevations,
+                delta_log,
+                ep_event_log,
+                trace,
+                bursts,
+                metrics=metrics,
+                config=config,
+            )
+            metrics = sres.metrics
+            sanitizer_result = sres._replace(metrics=None)
     return WaveResult(
         agents=agents,
         sessions=sessions,
@@ -426,4 +602,7 @@ def governance_wave(
         released=released,
         metrics=metrics,
         trace=trace,
+        gateway=gw_lanes,
+        sanitizer=sanitizer_result,
+        delta_log=delta_log,
     )

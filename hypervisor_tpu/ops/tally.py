@@ -1,0 +1,45 @@
+"""Batched lane counting for in-program tallies — reductions as matvecs.
+
+Every control-plane tail (metric counters, occupancy gauges, the
+invariant sanitizer's violation totals) needs "how many lanes satisfy
+P?" over whole table columns. A plain `jnp.sum` lowers to a serialized
+reduce chain per predicate (XLA:CPU: 2-3 reduce-window steps each; the
+round-9 dispatch census counted ~30 such chains per fused wave), while
+the SAME counts expressed as one f32 matvec against a ones-vector lower
+to a single `dot`:
+
+  * on TPU the dot lands on the MXU — which ROOFLINE.md shows is 100%
+    idle in this workload — so the tallies ride a unit the wave wasn't
+    using at all, instead of serializing on the VPU,
+  * on CPU it is one fused GEMV instead of a ladder of reduce-windows.
+
+f32 accumulation counts exactly up to 2^24 rows; every table axis here
+is ≤ 2^17, with headroom to spare (guarded below).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: f32 counts are exact below this many rows (24-bit mantissa).
+_EXACT_ROWS = 1 << 24
+
+
+def count_true(*cols: jnp.ndarray) -> jnp.ndarray:
+    """i32[len(cols)] — per-column count of true lanes.
+
+    All columns must share one length; they stack to [M, N] and reduce
+    as ONE matvec. Bool or integer masks accepted (nonzero counts).
+    """
+    stacked = jnp.stack(cols)
+    n = stacked.shape[1]
+    if n >= _EXACT_ROWS:  # pragma: no cover — no table axis is near 2^24
+        return jnp.sum((stacked != 0).astype(jnp.int32), axis=1)
+    return (
+        (stacked != 0).astype(jnp.float32) @ jnp.ones((n,), jnp.float32)
+    ).astype(jnp.int32)
+
+
+def count_true_1d(col: jnp.ndarray) -> jnp.ndarray:
+    """i32[] — count of true lanes in one column (dot, not reduce)."""
+    return count_true(col)[0]

@@ -93,7 +93,9 @@ def release_session_scope(
             agent_hit, agents.flags & ~FLAG_ACTIVE, agents.flags
         ).astype(agents.flags.dtype),
     )
-    return agents, vouches, jnp.sum(edge_hit.astype(jnp.int32))
+    from hypervisor_tpu.ops import tally
+
+    return agents, vouches, tally.count_true_1d(edge_hit)
 
 
 class TerminateResult(NamedTuple):

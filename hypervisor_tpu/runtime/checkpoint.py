@@ -101,12 +101,20 @@ def _intern_load(strings: list[str]) -> InternTable:
 
 
 def state_arrays(state: HypervisorState) -> dict[str, np.ndarray]:
-    """Flatten every device table column to host numpy, keyed table.column."""
+    """Flatten every device table column to host numpy, keyed table.column.
+
+    COPIES, not views: the snapshot is captured as one consistent cut
+    but may be serialized (or compared, in tests) after later waves —
+    and under the round-9 donation default those waves rewrite the
+    table buffers in place, so a zero-copy view would silently mutate.
+    """
     out: dict[str, np.ndarray] = {}
     for tname in _TABLE_TYPES:
         tbl = getattr(state, tname)
         for f in dataclasses.fields(tbl):
-            out[f"{tname}.{f.name}"] = np.asarray(getattr(tbl, f.name))
+            out[f"{tname}.{f.name}"] = np.array(
+                getattr(tbl, f.name), copy=True
+            )
     return out
 
 

@@ -69,6 +69,37 @@ def _comp_backlog_warn() -> int:
         return int(os.environ.get("HV_COMP_BACKLOG_WARN", "16"))
     except ValueError:
         return 16
+
+
+def _donate_tables() -> bool:
+    """Buffer donation for the wave-table dispatches — **default ON**
+    since round 9 (the deviceless v5e census pins donation removing 15
+    dispatch-bearing ENTRY steps from the 10k wave; DONATION.md).
+    `HV_DONATE_TABLES=0` opts out — the opt-out path stays bit-identical
+    (chain heads + metrics mirrors), gated by scripts/verify_tier1.sh.
+    Read per call so tests can flip it after import."""
+    return os.environ.get("HV_DONATE_TABLES", "1") != "0"
+
+
+def _donate_debug() -> bool:
+    """Use-after-donate poison guard (`HV_DONATE_DEBUG=1`): after a
+    donated dispatch commits, the PRE-wave table buffers are explicitly
+    deleted, so a retained alias fails loudly with "Array has been
+    deleted" even on backends where XLA declined the donation (where
+    the stale buffer would otherwise still read, silently)."""
+    return os.environ.get("HV_DONATE_DEBUG") == "1"
+
+
+def _poison_donated(*trees) -> None:
+    """Delete every live jax buffer in the given pytrees (see
+    `_donate_debug`). Buffers the runtime already invalidated through
+    real donation are skipped — delete() on them is redundant."""
+    for tree in trees:
+        if tree is None:
+            continue
+        for leaf in jax.tree.leaves(tree):
+            if isinstance(leaf, jax.Array) and not leaf.is_deleted():
+                leaf.delete()
 from hypervisor_tpu.runtime import StagingQueue
 
 
@@ -97,6 +128,34 @@ _NULL_TXN = _NullTxn()
 _ADMIT = health_plane.instrument(
     "admit_batch", jax.jit(admission.admit_batch)
 )
+# Process-unique compilation-cache salt for the DONATED twins: jax
+# 0.4.37's persistent compilation cache RELOADS a donated executable
+# with broken input/output aliasing — the reloaded program writes
+# through buffers other live arrays still reference (observed
+# deterministically on warm-cache runs as heap garbage in untouched
+# table columns; cold compiles are always correct). The salt is a
+# trace-time constant folded into the donated programs (an optimized-
+# away zero-multiply), so their cache keys are unique per process: the
+# in-memory jit cache works exactly as before, and the on-disk reload
+# path can never serve a donated program. Non-donated programs keep
+# full persistent-cache reuse.
+_DONATION_CACHE_SALT = float(
+    (os.getpid() << 16) ^ (int(time.time() * 1000) & 0xFFFF) or 1
+)
+
+# Donated twin of the admission wave (the round-9 default): the agent/
+# session tables plus the metrics/TraceLog rings alias into the outputs
+# — the same re-staging contract as the governance wave's donated twin
+# below. `_donate_tables()` picks between them per dispatch.
+_ADMIT_DONATED = health_plane.instrument(
+    "admit_batch_donated",
+    jax.jit(
+        admission.admit_batch,
+        static_argnames=("cache_salt",),
+        donate_argnames=("agents", "sessions", "metrics", "trace"),
+    ),
+    static_argnames=("cache_salt",),
+)
 _SAGA_TICK = health_plane.instrument(
     "saga_table_tick", jax.jit(saga_ops.saga_table_tick)
 )
@@ -104,34 +163,51 @@ _TERMINATE = health_plane.instrument(
     "terminate_batch",
     jax.jit(terminate_ops.terminate_batch),
 )
+# Static surface of the fused wave (round 9): the config dataclasses
+# (hashable frozen structs, same idiom as _GATEWAY below) plus the
+# sanitize flag that folds the invariant sanitizer into the program's
+# epilogue tail. One tuple shared by both wave twins so they can never
+# drift.
+_WAVE_STATICS = (
+    "use_pallas", "unique_sessions", "trust", "breach", "rate_limit",
+    "sanitize", "config", "cache_salt",
+)
 _WAVE = health_plane.instrument(
     "governance_wave",
     jax.jit(
         pipeline_ops.governance_wave,
-        static_argnames=("use_pallas", "unique_sessions"),
+        static_argnames=_WAVE_STATICS,
     ),
-    static_argnames=("use_pallas", "unique_sessions"),
+    static_argnames=_WAVE_STATICS,
 )
-# Donated twin: the three table arguments (and the metrics table, which
-# rides the wave like any other table) alias into the outputs, so
-# XLA updates them in place instead of materialising a second copy of
-# every column in HBM. RE-STAGING CONTRACT: after a donated wave the
-# PRE-wave table pytrees are dead buffers — HypervisorState holds the
-# only live reference (it immediately rebinds self.agents/... to the
-# results), and callers must never retain raw table aliases across a
-# wave (snapshot with `np.array(..., copy=True)` — np.asarray on a CPU
-# jax.Array is a zero-copy VIEW of the very buffer donation lets the
-# next wave overwrite). Opt-in via
-# HV_DONATE_TABLES=1 until the on-chip before/after is captured
-# (benchmarks/bench_donation.py).
+# Donated twin: the three table arguments (and the metrics table plus
+# TraceLog ring, which ride the wave like any other table) alias into
+# the outputs, so XLA updates them in place instead of materialising a
+# second copy of every column in HBM. RE-STAGING CONTRACT: after a
+# donated wave the PRE-wave table pytrees are dead buffers —
+# HypervisorState holds the only live reference (it immediately rebinds
+# self.agents/... to the results), and callers must never retain raw
+# table aliases across a wave (snapshot with `np.array(..., copy=True)`
+# — np.asarray on a CPU jax.Array is a zero-copy VIEW of the very
+# buffer donation lets the next wave overwrite). DEFAULT since round 9
+# (`_donate_tables`): `HV_DONATE_TABLES=0` opts out, and
+# `HV_DONATE_DEBUG=1` arms the use-after-donate poison guard
+# (`_poison_donated`). The read-only epilogue tables (sagas, EventLog,
+# elevations) are NOT donated — they flow through unchanged and
+# donation would buy nothing. Every donated call passes
+# `cache_salt=_DONATION_CACHE_SALT` (see above): a donated executable
+# must be compiled fresh per process, never reloaded from the
+# persistent cache.
 _WAVE_DONATED = health_plane.instrument(
     "governance_wave_donated",
     jax.jit(
         pipeline_ops.governance_wave,
-        static_argnames=("use_pallas", "unique_sessions"),
-        donate_argnames=("agents", "sessions", "vouches", "metrics", "trace"),
+        static_argnames=_WAVE_STATICS,
+        donate_argnames=(
+            "agents", "sessions", "vouches", "metrics", "trace", "delta_log",
+        ),
     ),
-    static_argnames=("use_pallas", "unique_sessions"),
+    static_argnames=_WAVE_STATICS,
 )
 _RECORD_CALLS = health_plane.instrument(
     "record_calls",
@@ -194,6 +270,14 @@ def _MERGE_WAVE_SESSION_STATES_JIT(owned, state, sessions_state, k_idx):
 _MERGE_WAVE_SESSION_STATES = health_plane.instrument(
     "merge_wave_session_states", _MERGE_WAVE_SESSION_STATES_JIT
 )
+
+
+def _active_wave_watch():
+    """The CompileWatch the single-device bridge dispatches RIGHT NOW —
+    the donated twin by default, `_WAVE` under the `HV_DONATE_TABLES=0`
+    opt-out. Telemetry consumers (tests, the verify gate's health
+    smoke) resolve the live program through this one rule."""
+    return _WAVE_DONATED if _donate_tables() else _WAVE
 
 
 def _isolation_refusal_from(
@@ -409,6 +493,13 @@ class HypervisorState:
         # WAL watermark carried by a restored checkpoint (`runtime.
         # checkpoint._rebuild`): recovery replays records PAST this seq.
         self._restored_wal_seq: Optional[int] = None
+        # Fused-epilogue gauge freshness (round 9): True only between a
+        # fused governance wave's commit (its in-program tail ran
+        # `update_gauges` over every table) and the NEXT mutation —
+        # `metrics_snapshot` then skips the separate refresh dispatch.
+        # Cleared conservatively at `_journal` / `_predispatch` /
+        # `sync_events_to_device` / integrity-repair entry.
+        self._gauges_fresh = False
 
         # Module-level jit wrappers: every HypervisorState shares one trace
         # cache instead of recompiling per instance.
@@ -434,6 +525,10 @@ class HypervisorState:
         a governance wave) is suppressed; the outer record replays the
         composite. Replay handlers live in `resilience.recovery.REPLAY`
         — every op name used here must have a row there."""
+        # Any journaled mutation staleness-marks the fused-epilogue
+        # gauges (cheap, unconditional — correctness beats the saved
+        # drain dispatch).
+        self._gauges_fresh = False
         if self.journal is None:
             return _NULL_TXN
         return self.journal.txn(op, payload)
@@ -447,20 +542,26 @@ class HypervisorState:
         if inj is not None:
             inj.on_dispatch(stage)
 
-    def _predispatch(self, stage: str) -> None:
+    def _predispatch(self, stage: str, fused_sanitizer: bool = False) -> None:
         """The full dispatch-site gate: chaos raise/stall first (still
         pre-mutation, retry-safe), then scheduled REAL corruption
         (`testing.chaos.InjectedCorruption` — silent table damage, the
         integrity plane's reason to exist), then the integrity plane's
         cadence hook (sampled sanitizer dispatch + pending-repair
-        settlement, `integrity.plane.IntegrityPlane.on_dispatch`)."""
+        settlement, `integrity.plane.IntegrityPlane.on_dispatch`).
+
+        `fused_sanitizer`: the upcoming dispatch can fold the sanitizer
+        into its own program (the fused governance wave) — a cadence
+        hit then defers to the wave's `sanitize` variant instead of
+        dispatching `check_invariants` separately (zero extra steps)."""
+        self._gauges_fresh = False
         self._chaos(stage)
         inj = self.fault_injector
         if inj is not None and getattr(inj, "has_pending_corruptions", False):
             inj.apply_due_corruptions(self)
         plane = self.integrity
         if plane is not None:
-            plane.on_dispatch(stage)
+            plane.on_dispatch(stage, fused=fused_sanitizer)
 
     def _shed_gate(self, sigma_raw: Optional[float] = None) -> None:
         """Degraded-mode admission shedding (`resilience.policy`): new
@@ -699,7 +800,7 @@ class HypervisorState:
         checkpoint cadence instead (docs/OPERATIONS.md "Recovery &
         fault domains").
         """
-        self._predispatch("governance_wave")
+        self._predispatch("governance_wave", fused_sanitizer=mesh is None)
         if mesh is not None or self.journal is None:
             return self._governance_wave_impl(
                 session_slots, dids, agent_sessions, sigma_raw,
@@ -944,11 +1045,35 @@ class HypervisorState:
                     fsm_error=result.fsm_error[:k],
                 )
         else:
-            wave = (
-                _WAVE_DONATED
-                if os.environ.get("HV_DONATE_TABLES") == "1"
-                else _WAVE
+            # ── the fused single-device program (round 9): governance
+            # + gateway + control-plane epilogue as ONE dispatch with
+            # ONE donation frontier. Donation is the default
+            # (`_donate_tables`); HV_DONATE_TABLES=0 opts out.
+            donated = _donate_tables()
+            wave = _WAVE_DONATED if donated else _WAVE
+            act = None
+            fused_gateway_args = None
+            if actions is not None:
+                act = self._normalize_actions(actions)
+                self._check_action_slots(act["slots"])
+                fused_gateway_args = self._pad_gateway_lanes(act)
+            # A sampled integrity check folds into this very program
+            # (the plane's cadence armed it at `_predispatch`): the
+            # sanitize=True variant is a SECOND cached signature of the
+            # same jit — compiled once, zero extra dispatches after.
+            plane = self.integrity
+            sanitize = plane is not None and plane.take_fused_due()
+            poison = (
+                (self.agents, self.sessions, self.vouches,
+                 self.metrics.table, self.tracer.table, self.delta_log)
+                if donated and _donate_debug()
+                else None
             )
+            # The audit append fuses INTO the program (the ring is one
+            # more donated argument); the host bookkeeping below needs
+            # the pre-append cursor — a scalar sync the pre-fusion
+            # append path already paid.
+            audit_base_row = int(np.asarray(self.delta_log.cursor))
             with self.metrics.stage("governance_wave"):
                 result = wave(
                     *wave_args,
@@ -959,9 +1084,31 @@ class HypervisorState:
                     metrics=self.metrics.table,
                     trace=self.tracer.table,
                     trace_ctx=th.ctx if th is not None else None,
+                    elevations=self.elevations,
+                    gateway_args=fused_gateway_args,
+                    trust=self.config.trust,
+                    breach=self.config.breach,
+                    rate_limit=self.config.rate_limit,
+                    delta_log=self.delta_log,
+                    epilogue_tables=(self.sagas, self.event_log),
+                    sanitize=sanitize,
+                    config=self.config,
+                    cache_salt=_DONATION_CACHE_SALT if donated else 0.0,
                 )
             self.metrics.commit(result.metrics)
             self.tracer.end_wave(th, result.trace)
+            self.delta_log = result.delta_log
+            if poison is not None:
+                _poison_donated(*poison)
+            if sanitize:
+                plane.absorb_fused(result.sanitizer)
+            if act is not None:
+                # Verdict lanes come back on the SAME dispatch; the
+                # gateway metrics already tallied in-wave (check_actions
+                # rode the metrics table), so no host-side tally here.
+                gw_result = self._gateway_result_from_lanes(
+                    result.gateway, result.agents, len(act["slots"])
+                )
         self.agents = result.agents
         self.sessions = result.sessions
         self.vouches = result.vouches
@@ -1025,23 +1172,33 @@ class HypervisorState:
             self._free_agent_slots.extend(np.asarray(agent_slots).tolist())
 
         # Record the wave's audit chain in the DeltaLog (lane-major).
-        chain = np.asarray(result.chain)  # [T, K, 8]
+        # COPY, not view: slices of this array outlive the wave
+        # (`_chain_seed`, the frontier) and under default-on donation
+        # the output buffer may alias table memory the NEXT wave
+        # overwrites in place (the `_WAVE_DONATED` re-staging contract).
+        chain = np.array(result.chain, copy=True)  # [T, K, 8]
         t, k = chain.shape[:2]
         if t:
             sess_rep = np.repeat(np.asarray(session_slots, np.int32), t)
-            turns_rep = np.tile(np.arange(t, dtype=np.int32), k)
-            bodies_flat = np.transpose(delta_bodies, (1, 0, 2)).reshape(
-                k * t, -1
-            )
             digests_flat = np.transpose(chain, (1, 0, 2)).reshape(k * t, 8)
-            base_row = int(np.asarray(self.delta_log.cursor))
             capacity = self.delta_log.body.shape[0]
-            self.delta_log = self.delta_log.append_batch(
-                jnp.asarray(bodies_flat),
-                jnp.asarray(digests_flat),
-                jnp.asarray(sess_rep),
-                jnp.asarray(turns_rep),
-            )
+            if mesh is None:
+                # The ring append rode the fused program (the committed
+                # `result.delta_log` above); only the host-side audit
+                # index remains to book, against the pre-dispatch cursor.
+                base_row = audit_base_row
+            else:
+                turns_rep = np.tile(np.arange(t, dtype=np.int32), k)
+                bodies_flat = np.transpose(delta_bodies, (1, 0, 2)).reshape(
+                    k * t, -1
+                )
+                base_row = int(np.asarray(self.delta_log.cursor))
+                self.delta_log = self.delta_log.append_batch(
+                    jnp.asarray(bodies_flat),
+                    jnp.asarray(digests_flat),
+                    jnp.asarray(sess_rep),
+                    jnp.asarray(turns_rep),
+                )
             rows = (base_row + np.arange(k * t)) % capacity
             self._claim_rows(rows, sess_rep)
             for i, s in enumerate(np.asarray(session_slots)):
@@ -1057,29 +1214,65 @@ class HypervisorState:
                 self._frontier.setdefault(s, MerkleFrontier()).extend(
                     digests_flat[i * t : (i + 1) * t]
                 )
+        if mesh is None:
+            # The fused tail refreshed every occupancy gauge in-program
+            # over the post-append tables, and everything since the
+            # dispatch was host-only bookkeeping: until the next
+            # mutation the drain can skip its separate refresh.
+            self._gauges_fresh = True
         if actions is not None:
-            if gw_result is None:
-                # Single device: compose the gateway wave behind the
-                # committed governance wave (same order as the fused
-                # mesh programs — the gateway sees the post-terminate
-                # table). Every mesh path, 1-D and multislice alike,
-                # fuses the gateway INTO the wave above (round 5).
-                # Direct to the local body: the public entry's chaos
-                # gate and WAL bracket must NOT re-enter here — an
-                # injected fault AFTER the wave half committed would
-                # turn a supervisor retry into a double admission, and
-                # the outer "governance_wave" record already replays
-                # this phase.
-                act = self._normalize_actions(actions)
-                self._check_action_slots(act["slots"])
-                gw_result = self._check_actions_wave_local(
-                    act["slots"], act["required_rings"],
-                    act["is_read_only"], act["has_consensus"],
-                    act["has_sre_witness"], act["host_tripped"],
-                    now,
-                )
+            # Both paths fuse the gateway INTO the wave program now:
+            # the mesh paths since round 5 (`with_gateway`), the
+            # single-device path since round 9 (phase 7 of the fused
+            # program above) — one dispatch, gateway on the
+            # post-terminate table, identical phase order everywhere.
             return result, gw_result
         return result
+
+    def _pad_gateway_lanes(self, act: dict) -> tuple:
+        """Pad normalized action columns to the gateway's power-of-two
+        lane block (`valid=False` padding lanes touch nothing) — the
+        fused wave's `gateway_args`, the same layout
+        `_check_actions_wave_local` dispatches standalone."""
+        b = len(act["slots"])
+        padded = max(1, 1 << max(0, (b - 1).bit_length()))
+
+        def pad(seq, dtype, fill=0):
+            arr = np.full((padded,), fill, dtype)
+            arr[:b] = np.asarray(seq, dtype)
+            return jnp.asarray(arr)
+
+        valid = np.zeros((padded,), bool)
+        valid[:b] = True
+        return (
+            pad(act["slots"], np.int32),
+            pad(act["required_rings"], np.int8),
+            pad(act["is_read_only"], bool),
+            pad(act["has_consensus"], bool),
+            pad(act["has_sre_witness"], bool),
+            pad(act["host_tripped"], bool),
+            jnp.asarray(valid),
+        )
+
+    @staticmethod
+    def _gateway_result_from_lanes(
+        lanes, agents, b: int
+    ) -> gateway_ops.GatewayResult:
+        """Trim the fused wave's padded gateway lanes back to the
+        caller's request shape (the fused twin of
+        `_scatter_gateway_lanes` — lanes are already in request order,
+        only the power-of-two padding drops)."""
+        return gateway_ops.GatewayResult(
+            agents=agents,
+            verdict=lanes.verdict[:b],
+            ring_status=lanes.ring_status[:b],
+            eff_ring=lanes.eff_ring[:b],
+            sigma_eff=lanes.sigma_eff[:b],
+            severity=lanes.severity[:b],
+            anomaly_rate=lanes.anomaly_rate[:b],
+            window_calls=lanes.window_calls[:b],
+            tripped=lanes.tripped[:b],
+        )
 
     def set_session_state(self, slot: int, state: SessionState) -> None:
         with self._journal(
@@ -1244,8 +1437,16 @@ class HypervisorState:
                 sessions=np.unique(np.asarray(session_slots, np.int64)),
                 lanes=n,
             )
+            donated = _donate_tables()
+            admit = _ADMIT_DONATED if donated else self._admit
+            poison = (
+                (self.agents, self.sessions,
+                 self.metrics.table, self.tracer.table)
+                if donated and _donate_debug()
+                else None
+            )
             with self.metrics.stage("admission_wave"):
-                result = self._admit(
+                result = admit(
                     self.agents,
                     self.sessions,
                     jnp.asarray(agent_slots),
@@ -1259,9 +1460,19 @@ class HypervisorState:
                     metrics=self.metrics.table,
                     trace=self.tracer.table,
                     trace_ctx=th.ctx if th is not None else None,
+                    # The plain twin has no cache_salt static (it keeps
+                    # full persistent-cache reuse); only the donated
+                    # twin takes the poison-pill constant.
+                    **(
+                        {"cache_salt": _DONATION_CACHE_SALT}
+                        if donated
+                        else {}
+                    ),
                 )
             self.metrics.commit(result.metrics)
             self.tracer.end_wave(th, result.trace)
+            if poison is not None:
+                _poison_donated(*poison)
             self.agents = result.agents
             self.sessions = result.sessions
             status = np.asarray(result.status)
@@ -2957,7 +3168,9 @@ class HypervisorState:
                 self.free_edge_rows(rows)
                 self._scrubbed_edges.extend(int(r) for r in rows)
             self._scrub_elevations_for_rows(reclaim)
-        return np.asarray(result.roots)
+        # COPY: callers retain the roots (commitments, audits) past
+        # later donated waves.
+        return np.array(result.roots, copy=True)
 
     # ── metrics drain ────────────────────────────────────────────────
 
@@ -2990,8 +3203,14 @@ class HypervisorState:
         # from the freshly drained live-row gauges.
         health_plane.publish_compile_counters(self.metrics)
         self.health.publish_footprints(self.health_tables())
-        snap = self.metrics.snapshot(
-            refresh=lambda table: _UPDATE_GAUGES(
+        # Fused-epilogue fast path (round 9): when the LAST dispatch was
+        # a fused governance wave and nothing mutated since, the gauge
+        # rows in the committed table are already current (the wave's
+        # in-program tail ran `update_gauges` over every table) — the
+        # drain skips its separate refresh dispatch entirely.
+        refresh = None
+        if not self._gauges_fresh:
+            refresh = lambda table: _UPDATE_GAUGES(  # noqa: E731
                 table,
                 self.agents,
                 self.sessions,
@@ -3002,7 +3221,7 @@ class HypervisorState:
                 self.event_log,
                 self.tracer.table,
             )
-        )
+        snap = self.metrics.snapshot(refresh=refresh)
         self.health.update_occupancy(snap)
         # Integrity-plane detection closes here: the sanitizer's counts
         # rode THIS drain (no extra device_get) — a nonzero violation

@@ -160,33 +160,78 @@ class TraceLog:
     cursor does not advance.
     """
 
-    trace: jnp.ndarray     # u32[C] trace word (device_key()[0])
-    span: jnp.ndarray      # u32[C] span word of the stamped span
-    stage: jnp.ndarray     # i32[C] tracing.TRACE_STAGES index
-    kind: jnp.ndarray      # i32[C] 0 = begin, 1 = end
-    lane: jnp.ndarray      # i32[C] lane/session scope (-1 = wave scope)
-    wave_seq: jnp.ndarray  # i32[C] host wave sequence number (-1 = empty)
-    seq: jnp.ndarray       # u32[C] pre-wrap cursor ordinal (logical clock)
+    # Round-9 packing: the seven logical per-row columns live in ONE
+    # u32[C, 7] block, so a whole wave's stamp batch lands as ONE ring
+    # scatter instead of seven serialized per-column updates
+    # (benchmarks/tpu_aot_census.py counted the stamp tail at 7 steps
+    # per commit). Signed columns (lane, wave_seq) store two's-
+    # complement u32 and bitcast back through the column properties, so
+    # every reader — the host drain included — sees the historical
+    # column views unchanged. Not a checkpoint format: the TraceLog is
+    # a volatile flight ring (`runtime.checkpoint._TABLE_TYPES` never
+    # serializes it), so the packing has no legacy-restore shim.
+    words: jnp.ndarray     # u32[C, 7] packed rows (column order below)
     cursor: jnp.ndarray    # i32[] next write position (monotonic)
+
+    # Packed column order.
+    COL_TRACE = 0      # u32 trace word (device_key()[0])
+    COL_SPAN = 1       # u32 span word of the stamped span
+    COL_STAGE = 2      # i32 tracing.TRACE_STAGES index
+    COL_KIND = 3       # i32 0 = begin, 1 = end
+    COL_LANE = 4       # i32 lane/session scope (-1 = wave scope)
+    COL_WAVE_SEQ = 5   # i32 host wave sequence number (-1 = empty)
+    COL_SEQ = 6        # u32 pre-wrap cursor ordinal (logical clock)
 
     @staticmethod
     def create(capacity: int) -> "TraceLog":
-        return TraceLog(
-            trace=jnp.zeros((capacity,), jnp.uint32),
-            span=jnp.zeros((capacity,), jnp.uint32),
-            stage=jnp.zeros((capacity,), jnp.int32),
-            kind=jnp.zeros((capacity,), jnp.int32),
-            lane=jnp.full((capacity,), -1, jnp.int32),
-            wave_seq=jnp.full((capacity,), -1, jnp.int32),
-            seq=jnp.zeros((capacity,), jnp.uint32),
-            cursor=jnp.zeros((), jnp.int32),
+        words = jnp.zeros((capacity, 7), jnp.uint32)
+        # lane / wave_seq start at -1 (two's complement in u32).
+        words = words.at[:, TraceLog.COL_LANE].set(jnp.uint32(0xFFFFFFFF))
+        words = words.at[:, TraceLog.COL_WAVE_SEQ].set(
+            jnp.uint32(0xFFFFFFFF)
         )
+        return TraceLog(words=words, cursor=jnp.zeros((), jnp.int32))
+
+    def _i32(self, col: int) -> jnp.ndarray:
+        import jax
+
+        return jax.lax.bitcast_convert_type(
+            self.words[:, col], jnp.int32
+        )
+
+    @property
+    def trace(self) -> jnp.ndarray:
+        return self.words[:, self.COL_TRACE]
+
+    @property
+    def span(self) -> jnp.ndarray:
+        return self.words[:, self.COL_SPAN]
+
+    @property
+    def stage(self) -> jnp.ndarray:
+        return self._i32(self.COL_STAGE)
+
+    @property
+    def kind(self) -> jnp.ndarray:
+        return self._i32(self.COL_KIND)
+
+    @property
+    def lane(self) -> jnp.ndarray:
+        return self._i32(self.COL_LANE)
+
+    @property
+    def wave_seq(self) -> jnp.ndarray:
+        return self._i32(self.COL_WAVE_SEQ)
+
+    @property
+    def seq(self) -> jnp.ndarray:
+        return self.words[:, self.COL_SEQ]
 
     @property
     def capacity_rows(self) -> int:
         """Ring row capacity — THE capacity rule for this log, shared
         by `footprint()` and the drain's live-row gauge clamp."""
-        return int(self.trace.shape[0])
+        return int(self.words.shape[0])
 
     def footprint(self) -> dict:
         """Health-plane bytes/capacity (`tables.struct.footprint`)."""
@@ -209,21 +254,36 @@ class TraceLog:
         waves share one compiled program — masking only redirects the
         scatter out of bounds (`mode="drop"`).
         """
-        capacity = self.trace.shape[0]
+        import jax
+
+        capacity = self.capacity_rows
         b = traces.shape[0]
         sampled = jnp.asarray(sampled, bool)
         pos = self.cursor + jnp.arange(b, dtype=jnp.int32)
         idx = jnp.where(sampled, pos % capacity, capacity)  # OOB -> dropped
-        drop = dict(mode="drop", unique_indices=True)
+
+        def u32(x):
+            return jax.lax.bitcast_convert_type(
+                jnp.asarray(x, jnp.int32), jnp.uint32
+            )
+
+        # One [B, 7] row block -> ONE ring scatter (see the packing
+        # note on the class).
+        rows = jnp.stack(
+            [
+                traces.astype(jnp.uint32),
+                spans.astype(jnp.uint32),
+                u32(stages),
+                u32(kinds),
+                u32(lanes),
+                u32(wave_seqs),
+                pos.astype(jnp.uint32),
+            ],
+            axis=1,
+        )
         return TraceLog(
-            trace=self.trace.at[idx].set(traces.astype(jnp.uint32), **drop),
-            span=self.span.at[idx].set(spans.astype(jnp.uint32), **drop),
-            stage=self.stage.at[idx].set(stages.astype(jnp.int32), **drop),
-            kind=self.kind.at[idx].set(kinds.astype(jnp.int32), **drop),
-            lane=self.lane.at[idx].set(lanes.astype(jnp.int32), **drop),
-            wave_seq=self.wave_seq.at[idx].set(
-                wave_seqs.astype(jnp.int32), **drop
+            words=self.words.at[idx].set(
+                rows, mode="drop", unique_indices=True
             ),
-            seq=self.seq.at[idx].set(pos.astype(jnp.uint32), **drop),
             cursor=self.cursor + jnp.where(sampled, b, 0),
         )

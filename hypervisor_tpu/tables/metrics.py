@@ -112,6 +112,56 @@ def gauge_set(m: MetricsTable, idx, value) -> MetricsTable:
     )
 
 
+import numpy as _np
+
+
+def _one_hot_rows(indices, n_rows: int) -> _np.ndarray:
+    """f32[len(indices), n_rows] constant selection matrix (static)."""
+    m = _np.zeros((len(indices), n_rows), _np.float32)
+    for i, idx in enumerate(indices):
+        m[i, int(idx)] += 1.0
+    return m
+
+
+def counter_add_many(m: MetricsTable, indices, values) -> MetricsTable:
+    """Add to many counter rows with ZERO scatters.
+
+    `indices` is a static row list (duplicates allowed — the one-hot
+    matrix accumulates); `values` are scalar u32/i32 traced counts. The
+    update lowers as one tiny matvec against a constant selection
+    matrix plus an elementwise add — each chained `counter_inc` used to
+    lower to its own serialized scatter step, and a fused wave tallies
+    ~10 counters per dispatch (benchmarks/tpu_aot_census.py). f32 is
+    exact for per-dispatch deltas (< 2^24); the u32 column itself still
+    accumulates and wraps exactly as before.
+    """
+    indices = list(indices)
+    sel = jnp.asarray(_one_hot_rows(indices, int(m.counters.shape[0])))
+    vals = jnp.stack(
+        [jnp.asarray(v).astype(jnp.float32) for v in values]
+    )
+    delta = (vals @ sel).astype(jnp.uint32)
+    return replace(m, counters=m.counters + delta)
+
+
+def gauge_set_many(m: MetricsTable, indices, values) -> MetricsTable:
+    """Set many gauge rows with ZERO scatters (last write wins).
+
+    `indices` is a static row list; `values` stacks to f32[len]. The
+    write lowers as one matvec against a constant one-hot matrix plus
+    an elementwise select — chained `gauge_set` calls each lowered to
+    their own update step, and the gauge-refresh epilogue writes ~20
+    rows per pass (benchmarks/tpu_aot_census.py).
+    """
+    indices = list(indices)
+    n = int(m.gauges.shape[0])
+    sel_np = _one_hot_rows(indices, n)
+    written = jnp.asarray(sel_np.any(axis=0))
+    vals = jnp.stack([jnp.asarray(v, jnp.float32) for v in values])
+    projected = vals @ jnp.asarray(sel_np)
+    return replace(m, gauges=jnp.where(written, projected, m.gauges))
+
+
 def observe(
     m: MetricsTable,
     hist_idx: int,

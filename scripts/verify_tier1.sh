@@ -31,6 +31,15 @@
 #      must CONTAIN (score at/above the floor, zero invariant
 #      violations, seed-replayable trace digest) while the unhardened
 #      twin must score strictly lower (defenses are load-bearing),
+#   6b. a donated-path parity smoke gate — the round-9 donation default
+#      must be bit-identical (Merkle chain heads + metrics mirrors) to
+#      the HV_DONATE_TABLES=0 opt-out, with zero recompiles across
+#      identical drills,
+#   6c. the dispatch-census gate — re-census the fused wave
+#      (benchmarks/tpu_aot_census.py, deviceless; CPU fallback when the
+#      TPU plugin is absent/wedged — exit 75 = skip, never a failure)
+#      and hold its dispatch-bearing ENTRY steps to the committed
+#      trajectory row + the >=2x r09 fusion-ratio floor,
 #   7. a crash-recovery smoke gate — drive real traffic in a child
 #      process with a WAL + watermarked checkpoint, SIGKILL it
 #      mid-flight, recover from checkpoint + WAL replay, and assert
@@ -171,9 +180,14 @@ def wave(tag, n):
     )
 
 
+from hypervisor_tpu import state as state_mod
+
+_WAVE_PROGRAM = state_mod._active_wave_watch().name  # donated twin by default
+
+
 def wave_stats(payload):
     return next(
-        r for r in payload["by_program"] if r["program"] == "governance_wave"
+        r for r in payload["by_program"] if r["program"] == _WAVE_PROGRAM
     )
 
 
@@ -377,6 +391,123 @@ print(
 PY
 scenario_rc=$?
 
+echo "── donated-path parity smoke gate ──"
+JAX_PLATFORMS=cpu python - <<'PY'
+# Round-9 acceptance: donation default-ON must be BIT-IDENTICAL to the
+# HV_DONATE_TABLES=0 opt-out — same traffic, same Merkle chain heads,
+# same metrics mirrors — and neither path may recompile across
+# identical dispatches.
+import os
+
+import numpy as np
+
+from hypervisor_tpu import state as state_mod
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.observability import metrics as mp
+from hypervisor_tpu.state import HypervisorState
+
+
+def drive(st):
+    for r in range(4):
+        slots = st.create_sessions_batch(
+            [f"dsmoke{r}:{i}" for i in range(3)],
+            SessionConfig(min_sigma_eff=0.0),
+        )
+        st.run_governance_wave(
+            slots, [f"did:dsmoke{r}:{i}" for i in range(3)], slots.copy(),
+            np.full(3, 0.8, np.float32),
+            np.arange(3 * 16, dtype=np.uint32).reshape(1, 3, 16),
+            now=float(r),
+            actions={"slots": [0, 1]} if r >= 2 else None,
+        )
+    snap = st.metrics_snapshot()
+    heads = {s: tuple(int(w) for w in v) for s, v in st._chain_seed.items()}
+    mirrors = {
+        "ticks": snap.counter(mp.WAVE_TICKS),
+        "admitted": snap.counter(mp.ADMITTED),
+        "gw_allowed": snap.counter(mp.GATEWAY_ALLOWED),
+        "sessions_live_rows": snap.gauge(mp.TABLE_LIVE_ROWS["sessions"]),
+        "delta_rows": snap.gauge(mp.TABLE_LIVE_ROWS["delta_log"]),
+    }
+    return heads, mirrors
+
+
+assert os.environ.get("HV_DONATE_TABLES") is None
+watch = state_mod._active_wave_watch()
+assert watch is state_mod._WAVE_DONATED, "donation no longer the default?"
+donated = drive(HypervisorState())
+before = watch.stats()["recompiles"]
+donated2 = drive(HypervisorState())
+assert watch.stats()["recompiles"] == before, "identical drill recompiled"
+assert donated == donated2, "donated path not deterministic"
+
+os.environ["HV_DONATE_TABLES"] = "0"
+try:
+    assert state_mod._active_wave_watch() is state_mod._WAVE
+    optout = drive(HypervisorState())
+finally:
+    del os.environ["HV_DONATE_TABLES"]
+assert donated[0] == optout[0], "chain heads diverge between donation paths"
+assert donated[1] == optout[1], (
+    f"metrics mirrors diverge: {donated[1]} vs {optout[1]}"
+)
+print(
+    "donated-path parity OK: default-on vs HV_DONATE_TABLES=0 "
+    f"bit-identical ({len(donated[0])} chain heads, "
+    f"{len(donated[1])} mirrors), zero recompiles across repeats"
+)
+PY
+donation_rc=$?
+
+echo "── dispatch-census gate ──"
+# The tunnel-wedge-proof perf gate: re-census the fused wave and hold it
+# to the committed BENCH trajectory. Exit 75 from the census tool means
+# the TPU plugin is absent/wedged — on the auto path the tool falls back
+# to the CPU backend, so a hard failure here is a real regression signal,
+# never a missing chip.
+HV_AOT_PROBE_TIMEOUT=10 JAX_PLATFORMS=cpu \
+    python benchmarks/tpu_aot_census.py --json --out /tmp/_census_gate.json \
+    > /dev/null 2>&1
+census_rc=$?
+if [ "$census_rc" -eq 75 ]; then
+    echo "census SKIPPED: TPU plugin absent/wedged (exit 75 — distinct from a regression)"
+    census_rc=0
+elif [ "$census_rc" -eq 0 ]; then
+    JAX_PLATFORMS=cpu python - <<'PY'
+import json
+from pathlib import Path
+
+from benchmarks import regression
+
+fresh = json.loads(Path("/tmp/_census_gate.json").read_text())
+fused = fresh["programs"]["fused_wave_sanitized"]
+rows = [
+    r for r in regression.load_history()
+    if r.get("census") and r["census"].get("backend") == fresh["backend"]
+]
+assert rows, "no committed census row to gate against"
+committed = rows[-1]["census"]
+tol = 1.0 + regression.DEFAULT_CENSUS_TOL
+assert fused["dispatch"] <= committed["dispatch_steps"] * tol, (
+    f"fused wave dispatch steps regressed: {fused['dispatch']} vs "
+    f"committed {committed['dispatch_steps']} (+{(tol - 1) * 100:.0f}% band)"
+)
+if fresh.get("fusion_ratio") is not None:
+    assert fresh["fusion_ratio"] >= regression.DEFAULT_CENSUS_FUSION_FLOOR, (
+        f"fusion ratio fell below the floor: {fresh['fusion_ratio']}"
+    )
+print(
+    f"dispatch census OK [{fresh['backend']}]: fused "
+    f"{fused['dispatch']} dispatch-bearing steps "
+    f"(committed {committed['dispatch_steps']}), fusion ratio "
+    f"{fresh['fusion_ratio']} vs r09's {committed['r09_baseline_dispatch']}"
+)
+PY
+    census_rc=$?
+else
+    echo "dispatch census FAILED to run (rc=$census_rc)" >&2
+fi
+
 echo "── crash-recovery smoke gate ──"
 JAX_PLATFORMS=cpu python scripts/crash_recovery_smoke.py
 crash_rc=$?
@@ -412,6 +543,14 @@ fi
 if [ "$scenario_rc" -ne 0 ]; then
     echo "adversarial scenario smoke gate FAILED (rc=$scenario_rc)" >&2
     exit "$scenario_rc"
+fi
+if [ "$donation_rc" -ne 0 ]; then
+    echo "donated-path parity smoke gate FAILED (rc=$donation_rc)" >&2
+    exit "$donation_rc"
+fi
+if [ "$census_rc" -ne 0 ]; then
+    echo "dispatch-census gate FAILED (rc=$census_rc)" >&2
+    exit "$census_rc"
 fi
 if [ "$crash_rc" -ne 0 ]; then
     echo "crash-recovery smoke gate FAILED (rc=$crash_rc)" >&2

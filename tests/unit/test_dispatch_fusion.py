@@ -1,0 +1,286 @@
+"""Round-9 dispatch-floor mega-fusion: structural + parity pins.
+
+The tentpole contract this file guards:
+
+  * ONE program — a facade governance wave step (admission, FSM, audit
+    chain + in-program DeltaLog append, saga step, terminate, gateway
+    phase, gauge refresh, sampled sanitizer) dispatches exactly one
+    fused XLA program; the standalone gateway / sanitizer / append
+    programs never compile on that path. A later refactor that silently
+    de-fuses a phase back into its own dispatch fails here loudly.
+  * the fused program's lowering stays dispatch-bounded — the census
+    metric (`benchmarks.tpu_aot_census.entry_census`) pins the small-
+    shape program under a fixed step budget,
+  * donation default-on (`HV_DONATE_TABLES` unset) is bit-identical to
+    the opt-out path — chain heads, metrics mirrors, table bytes,
+  * the `HV_DONATE_DEBUG=1` poison guard makes use-after-donate fail
+    loudly even where XLA declined the aliasing.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu import state as state_mod
+from hypervisor_tpu.config import HypervisorConfig, TableCapacity
+from hypervisor_tpu.integrity import IntegrityPlane
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.observability import metrics as mp
+from hypervisor_tpu.state import HypervisorState
+
+SMALL = HypervisorConfig(
+    capacity=TableCapacity(
+        max_agents=64,
+        max_sessions=32,
+        max_vouch_edges=64,
+        max_sagas=16,
+        max_steps_per_saga=4,
+        max_elevations=16,
+        delta_log_capacity=256,
+        event_log_capacity=64,
+        trace_log_capacity=128,
+    )
+)
+
+#: Small-shape (SMALL config, 3 lanes) dispatch-bearing ENTRY-step
+#: budget for the fully-loaded fused program. Census at PR time: ~150
+#: on XLA:CPU; the band absorbs compiler-version drift, not refactors —
+#: a de-fused phase re-entering as its own program shows up in the
+#: one-program pin below instead.
+FUSED_SMALL_DISPATCH_BUDGET = 230
+
+
+def drive(st, rounds=2, actions=True, base=0):
+    for r in range(base, base + rounds):
+        slots = st.create_sessions_batch(
+            [f"df{r}:{i}" for i in range(3)],
+            SessionConfig(min_sigma_eff=0.0),
+        )
+        st.run_governance_wave(
+            slots, [f"did:df{r}:{i}" for i in range(3)], slots.copy(),
+            np.full(3, 0.8, np.float32),
+            np.arange(3 * 16, dtype=np.uint32).reshape(1, 3, 16),
+            now=float(r),
+            actions={"slots": [0, 1]} if actions else None,
+        )
+
+
+def _collect(st):
+    snap = st.metrics_snapshot()
+    heads = {s: tuple(int(w) for w in v) for s, v in st._chain_seed.items()}
+    mirrors = {
+        "ticks": snap.counter(mp.WAVE_TICKS),
+        "admitted": snap.counter(mp.ADMITTED),
+        "gw_allowed": snap.counter(mp.GATEWAY_ALLOWED),
+        "gw_denied": snap.counter(mp.GATEWAY_DENIED),
+        "delta_rows": snap.gauge(mp.TABLE_LIVE_ROWS["delta_log"]),
+    }
+    tables = jax.tree.map(np.asarray, st.agents)
+    return heads, mirrors, tables
+
+
+class TestOneProgram:
+    def test_facade_wave_step_dispatches_one_fused_program(self):
+        """A full facade wave step — actions riding, sanitizer due —
+        must not touch the standalone gateway/sanitizer programs, and
+        the DeltaLog append must ride the wave (no separate dispatch).
+        Compile counters are the proof: the fused path can only use
+        programs it compiled."""
+        from hypervisor_tpu.integrity import plane as plane_mod
+
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=1, scrub_every=0)
+        gw_before = state_mod._GATEWAY.stats()["compiles"]
+        inv_before = plane_mod._CHECK_INVARIANTS.stats()["compiles"]
+        checks_before = plane.checks
+
+        drive(st, rounds=2, actions=True)
+
+        assert state_mod._GATEWAY.stats()["compiles"] == gw_before, (
+            "standalone gateway program compiled — the gateway phase "
+            "fell out of the fused wave"
+        )
+        assert (
+            plane_mod._CHECK_INVARIANTS.stats()["compiles"] == inv_before
+        ), (
+            "standalone sanitizer program compiled — the sampled check "
+            "fell out of the fused wave"
+        )
+        # The sanitizer DID run (fused): the plane absorbed each pass.
+        assert plane.checks >= checks_before + 2
+        # And the audit append rode the program: rows + gauges agree.
+        snap = st.metrics_snapshot()
+        assert snap.gauge(mp.TABLE_LIVE_ROWS["delta_log"]) == 6  # 2x3 rows
+        assert snap.counter(mp.INTEGRITY_CHECKS) >= 2
+        assert snap.counter(mp.INTEGRITY_VIOLATIONS) == 0
+
+    def test_gateway_verdicts_match_standalone_wave(self):
+        """The fused gateway phase must decide exactly like the
+        standalone `check_actions_wave` on the same post-wave state."""
+        st = HypervisorState(SMALL)
+        drive(st, rounds=1, actions=False)
+        # Twin states: one asks the fused wave, one the standalone op.
+        slots = st.create_sessions_batch(
+            ["gwp:a", "gwp:b"], SessionConfig(min_sigma_eff=0.0)
+        )
+        result, gw_fused = st.run_governance_wave(
+            slots, ["did:gwp:0", "did:gwp:1"], slots.copy(),
+            np.full(2, 0.8, np.float32),
+            np.zeros((1, 2, 16), np.uint32),
+            now=5.0,
+            actions={"slots": [0, 1, 2]},
+        )
+        gw_standalone = st.check_actions_wave(
+            [0, 1, 2], [2, 2, 2], [False] * 3, [False] * 3, [False] * 3,
+            [False] * 3, now=5.0,
+        )
+        # Same verdicts and ring decisions (the standalone call runs on
+        # the post-wave table, one recorded call later — the verdict
+        # and eff-ring columns must still agree for this quiet load).
+        np.testing.assert_array_equal(
+            np.asarray(gw_fused.verdict), np.asarray(gw_standalone.verdict)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(gw_fused.eff_ring),
+            np.asarray(gw_standalone.eff_ring),
+        )
+        assert gw_fused.verdict.shape == (3,)
+
+    def test_sanitizer_cadence_rides_fused_variant(self):
+        """every=N: exactly every N-th governance dispatch runs the
+        fused sanitize variant; the plane books each pass."""
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=2, scrub_every=0)
+        drive(st, rounds=4, actions=False)
+        assert plane.checks == 2  # dispatches 1..4, cadence 2 -> 2 passes
+        assert plane._last_result is not None
+        assert int(plane._last_result.total) == 0
+
+
+class TestLoweringBudget:
+    def test_fused_small_shape_dispatch_bound(self):
+        """The fully-loaded fused program (gateway + append + gauges +
+        sanitizer, donated) lowers under the pinned dispatch budget at
+        the SMALL shape — de-fusion or a scatter/copy explosion fails
+        this before any chip sees it."""
+        from benchmarks.tpu_aot_census import entry_census
+        from hypervisor_tpu.observability import tracing
+        from hypervisor_tpu.ops.pipeline import governance_wave
+
+        st = HypervisorState(SMALL)
+        b = 3
+        slots = jnp.arange(b, dtype=jnp.int32)
+        ctx = tracing.TraceContext(
+            trace=jnp.uint32(1), span=jnp.uint32(2),
+            wave_seq=jnp.int32(0), sampled=jnp.asarray(True),
+        )
+        act = (
+            jnp.zeros((4,), jnp.int32),
+            jnp.full((4,), 2, jnp.int8),
+            jnp.zeros((4,), bool),
+            jnp.zeros((4,), bool),
+            jnp.zeros((4,), bool),
+            jnp.zeros((4,), bool),
+            jnp.asarray([True, True, False, False]),
+        )
+
+        def fused(agents, sessions, vouches, metrics, trace, delta_log,
+                  sagas, event_log, elevations, bursts):
+            return governance_wave(
+                agents, sessions, vouches,
+                slots, slots, slots,
+                jnp.full((b,), 0.8, jnp.float32),
+                jnp.ones((b,), bool),
+                jnp.zeros((b,), bool),
+                slots,
+                jnp.zeros((1, b, 16), jnp.uint32),
+                0.0,
+                use_pallas=False,
+                ring_bursts=bursts,
+                metrics=metrics, trace=trace, trace_ctx=ctx,
+                elevations=elevations, gateway_args=act,
+                delta_log=delta_log, epilogue_tables=(sagas, event_log),
+                sanitize=True, config=SMALL,
+            )
+
+        # Compile-and-census only (never executed): the donated-reload
+        # hazard `state._DONATION_CACHE_SALT` defends against needs an
+        # execution, so no salt here.
+        compiled = (
+            jax.jit(fused, donate_argnums=(0, 1, 2, 3, 4, 5))
+            .lower(
+                st.agents, st.sessions, st.vouches, st.metrics.table,
+                st.tracer.table, st.delta_log, st.sagas, st.event_log,
+                st.elevations, st._ring_bursts,
+            )
+            .compile()
+        )
+        total, heavy, top = entry_census(compiled)
+        assert heavy <= FUSED_SMALL_DISPATCH_BUDGET, (
+            f"fused wave lowered to {heavy} dispatch-bearing steps "
+            f"(budget {FUSED_SMALL_DISPATCH_BUDGET}): {top}"
+        )
+
+    def test_census_metric_excludes_scalar_copies(self):
+        """The census metric counts array copies but not rank-0 copies
+        (prologue plumbing)."""
+        from benchmarks.tpu_aot_census import entry_census
+
+        compiled = jax.jit(lambda x: x * 2 + 1).lower(
+            jax.ShapeDtypeStruct((128,), jnp.float32)
+        ).compile()
+        total, heavy, top = entry_census(compiled)
+        assert total >= 1
+        assert heavy <= total
+
+
+class TestDonationParity:
+    def test_optout_bit_identical(self, monkeypatch):
+        """HV_DONATE_TABLES=0 must replay the identical history —
+        chain heads, metrics mirrors, and the full agent table."""
+        monkeypatch.delenv("HV_DONATE_TABLES", raising=False)
+        assert state_mod._donate_tables()
+        st_on = HypervisorState(SMALL)
+        drive(st_on, rounds=3)
+        on = _collect(st_on)
+
+        monkeypatch.setenv("HV_DONATE_TABLES", "0")
+        assert not state_mod._donate_tables()
+        st_off = HypervisorState(SMALL)
+        drive(st_off, rounds=3)
+        off = _collect(st_off)
+
+        assert on[0] == off[0], "chain heads diverge"
+        assert on[1] == off[1], "metrics mirrors diverge"
+        for name in ("f32", "i32", "ring", "sigma_eff"):
+            np.testing.assert_array_equal(
+                getattr(on[2], name), getattr(off[2], name), err_msg=name
+            )
+
+    def test_poison_guard_fails_retained_aliases_loudly(self, monkeypatch):
+        """HV_DONATE_DEBUG=1: a raw table alias retained across a
+        donated wave must raise on use, not silently read stale (or
+        freshly-overwritten) memory."""
+        monkeypatch.delenv("HV_DONATE_TABLES", raising=False)
+        monkeypatch.setenv("HV_DONATE_DEBUG", "1")
+        st = HypervisorState(SMALL)
+        drive(st, rounds=1)
+        retained = st.agents.f32  # ILLEGAL: raw buffer alias across a wave
+        drive(st, rounds=1, base=1)
+        with pytest.raises(RuntimeError, match="deleted"):
+            np.asarray(retained)
+
+    def test_active_watch_follows_the_env(self, monkeypatch):
+        monkeypatch.delenv("HV_DONATE_TABLES", raising=False)
+        assert state_mod._active_wave_watch() is state_mod._WAVE_DONATED
+        monkeypatch.setenv("HV_DONATE_TABLES", "0")
+        assert state_mod._active_wave_watch() is state_mod._WAVE

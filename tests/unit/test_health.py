@@ -263,14 +263,15 @@ class TestStateCompileTelemetry:
         from hypervisor_tpu.state import HypervisorState
 
         st = HypervisorState()
+        watch = state_mod._active_wave_watch()  # donated twin by default
         _drive_wave(st, "hc:a", n=2)
-        before = state_mod._WAVE.stats()
+        before = watch.stats()
         _drive_wave(st, "hc:b", n=2)  # identical signature
-        mid = state_mod._WAVE.stats()
+        mid = watch.stats()
         assert mid["compiles"] == before["compiles"]
         assert mid["recompiles"] == before["recompiles"]
         _drive_wave(st, "hc:c", n=3)  # batch shape change
-        after = state_mod._WAVE.stats()
+        after = watch.stats()
         assert after["compiles"] == mid["compiles"] + 1
         assert after["recompiles"] == mid["recompiles"] + 1
         assert after["last"]["kind"] == "recompile"
@@ -561,6 +562,22 @@ def _suite_report(
         "benchmarks": {
             name: {"per_op_p50_us": v} for name, v in full.items()
         },
+        # Rounds >= regression.CENSUS_ROW_SINCE must carry the
+        # dispatch-census row (round-10 presence gate) — synthetic
+        # rounds mirror a committed payload's shape.
+        "dispatch_census": {
+            "backend": backend,
+            "entry_steps": 310,
+            "dispatch_steps": 148,
+            "entry_steps_no_donate": 328,
+            "dispatch_steps_no_donate": 166,
+            "copy_steps": 7,
+            "donation_delta_steps": 18,
+            "unfused_total_dispatch": 176,
+            "self_fusion_ratio": 1.19,
+            "fusion_ratio": 2.18,
+            "r09_baseline_dispatch": 322,
+        },
     }
 
 
@@ -768,12 +785,15 @@ class TestEndpoints:
         reports exactly one, naming the changed argument."""
         svc = await self._svc_with_traffic()
         st = svc.hv.state
+        from hypervisor_tpu import state as state_mod
+
+        program = state_mod._active_wave_watch().name
 
         def wave_stats(payload):
             return next(
                 row
                 for row in payload["by_program"]
-                if row["program"] == "governance_wave"
+                if row["program"] == program
             )
 
         _drive_wave(st, "ep:a", n=2)

@@ -817,6 +817,56 @@ def scenario_benchmark(seed: int, quick: bool) -> dict:
     }
 
 
+def soak_benchmark(seed: int, quick: bool) -> dict:
+    """`--soak <seed>`: a sustained open-workload soak through the
+    serving front door (`hypervisor_tpu.serving`) — seeded Poisson
+    session arrivals split between ephemeral one-wave lifecycles and
+    long-lived heavy-tailed sessions (joins, gateway actions, sagas,
+    terminations), coalesced into shape-bucketed deadline-paced waves.
+    Reports goodput, p50/p99 latency against a stated SLO, shed rate by
+    refusal kind, deadline misses, and the compile-telemetry recompile
+    count after warmup (the zero-recompile contract: the bucket set is
+    closed, so a warmed scheduler never recompiles). Seeded: the same
+    seed replays the same trace with identical admission/shed decisions
+    and chain heads (`decisions_digest` / `chain_heads_digest` are the
+    replay keys). `regression.py` gates the row (HV_BENCH_SOAK_*).
+    """
+    from hypervisor_tpu.serving import ServingConfig, WorkloadSpec, run_soak
+
+    spec = WorkloadSpec(
+        seed=seed,
+        rate_hz=150.0 if quick else 400.0,
+        duration_s=0.8 if quick else 3.0,
+    )
+    # CPU wave walls run ~100-300 ms; the cpu soak states cpu-shaped
+    # deadlines and SLO (a TPU round would state its own, tighter row —
+    # comparability is per backend, like every other gate).
+    import jax
+
+    cpu = jax.default_backend() != "tpu"
+    config = ServingConfig(
+        join_deadline_s=0.25 if cpu else 0.02,
+        action_deadline_s=0.25 if cpu else 0.02,
+        lifecycle_deadline_s=0.4 if cpu else 0.05,
+        terminate_deadline_s=0.5 if cpu else 0.1,
+        saga_deadline_s=0.25 if cpu else 0.05,
+    )
+    # The stated cpu SLO is non-flaky by design (deadline pacing tops
+    # out ~500 ms + cpu wave walls + the drain tail, and shared CI
+    # hosts add contention); it still catches the failure modes that
+    # matter — a recompile storm or a de-bucketed scheduler adds whole
+    # seconds to the tail.
+    report = run_soak(
+        spec,
+        serving_config=config,
+        tick_s=0.02,
+        slo_p99_ms=1500.0 if cpu else 100.0,
+    )
+    report["seed"] = seed
+    report["quick"] = quick
+    return report
+
+
 def dispatch_census_row(timeout_s: float = 900.0) -> dict | None:
     """Run `tpu_aot_census.py --json` in a SUBPROCESS and distill the
     trajectory row (`BENCH_r<NN>.json` "dispatch_census").
@@ -939,6 +989,20 @@ def main() -> None:
         ),
     )
     ap.add_argument(
+        "--soak",
+        type=int,
+        default=None,
+        metavar="SEED",
+        help=(
+            "also run the sustained open-workload soak through the "
+            "serving front door (seeded Poisson arrivals, heavy-tailed "
+            "lifetimes, deadline-paced bucketed waves; "
+            "hypervisor_tpu/serving/loadgen.py) and report goodput + "
+            "p50/p99 latency vs a stated SLO + shed rate + post-warmup "
+            "recompile count into the BENCH payload"
+        ),
+    )
+    ap.add_argument(
         "--no-census",
         action="store_true",
         help=(
@@ -1021,6 +1085,23 @@ def main() -> None:
                 flush=True,
             )
 
+    soak_rec = None
+    if args.soak is not None:
+        soak_rec = soak_benchmark(args.soak, args.quick)
+        if not args.json_only:
+            lat = soak_rec["latency_ms"]
+            print(
+                f"soak[seed={args.soak}]: {soak_rec['served']} served of "
+                f"{soak_rec['offered']['total']} offered at "
+                f"{soak_rec['arrival_rate_hz']:.0f} Hz "
+                f"(goodput {soak_rec['goodput_ops_s']} ops/s), p99 "
+                f"{lat['p99']} ms vs SLO {soak_rec['slo_p99_ms']} ms, "
+                f"shed rate {soak_rec['shed_rate']}, "
+                f"{soak_rec['recompiles_after_warmup']} recompiles after "
+                "warmup",
+                flush=True,
+            )
+
     census_rec = None
     if args.metrics_out and not args.no_census:
         census_rec = dispatch_census_row()
@@ -1071,6 +1152,11 @@ def main() -> None:
             # regression.py gates the step count and the fusion ratio,
             # so a de-fusing refactor fails CI devicelessly.
             "dispatch_census": census_rec,
+            # Serving-soak row (round 11, bench_suite --soak): goodput +
+            # tail latency vs the stated SLO + shed rate + post-warmup
+            # recompiles; regression.py gates the SLO, the goodput
+            # floor, and the zero-recompile contract.
+            "soak": soak_rec,
         }
         out_path.write_text(json.dumps(report, indent=2) + "\n")
         if not args.json_only:
@@ -1096,6 +1182,7 @@ def main() -> None:
         "chaos": chaos_rec,
         "integrity": integrity_rec,
         "scenarios": scenario_rec,
+        "soak": soak_rec,
     }
     if jax.default_backend() not in ("tpu",) and not args.write_results:
         print(

@@ -83,6 +83,22 @@ SCRUB_ROW_SINCE = 9
 #: (the dispatch-floor mega-fusion PR); earlier rounds are exempt.
 CENSUS_ROW_SINCE = 10
 
+#: The serving-soak row (bench_suite --soak) joined the standard
+#: payload in round 11 (the serving front door PR); earlier rounds are
+#: exempt. A suite round from 11 on that drops the row regresses
+#: serving coverage even if every other number is fine.
+SOAK_ROW_SINCE = 11
+
+#: Minimum goodput ratio (served / offered) a soak row may report
+#: (`HV_BENCH_SOAK_GOODPUT` overrides): the front door must actually
+#: serve an open workload, not shed its way to a fast p99.
+DEFAULT_SOAK_GOODPUT = 0.7
+
+#: Multiplier on the soak row's own stated SLO the measured p99 must
+#: stay under (`HV_BENCH_SOAK_SLO_FACTOR` overrides; 1.0 = the row
+#: passes exactly when it met its stated SLO).
+DEFAULT_SOAK_SLO_FACTOR = 1.0
+
 #: Minimum r09-anchored fusion ratio a census row may report
 #: (`HV_CENSUS_FUSION_FLOOR` overrides): the round-10 acceptance bar —
 #: the donated fused wave must stay at least 2x below the r09 five-
@@ -130,6 +146,7 @@ def parse_round_file(path: Path) -> Optional[dict]:
         scenarios = doc.get("scenarios")
         census = doc.get("dispatch_census")
         donation = doc.get("donation")
+        soak = doc.get("soak")
         row.update(
             format="suite",
             backend=doc.get("backend", "cpu"),
@@ -192,6 +209,30 @@ def parse_round_file(path: Path) -> Optional[dict]:
             # informational until the tunnel unwedges — the trajectory
             # carries it so the chip number lands the day it measures.
             donation=donation if isinstance(donation, dict) else None,
+            # Serving-soak row (bench_suite --soak, round 11): goodput,
+            # tail latency vs the stated SLO, shed rate, post-warmup
+            # recompiles — gated below.
+            soak=(
+                {
+                    "seed": soak.get("seed"),
+                    "arrival_rate_hz": soak.get("arrival_rate_hz"),
+                    "served": soak.get("served"),
+                    "offered": (soak.get("offered") or {}).get("total"),
+                    "goodput_ops_s": soak.get("goodput_ops_s"),
+                    "goodput_ratio": soak.get("goodput_ratio"),
+                    "shed_rate": soak.get("shed_rate"),
+                    "latency_p50_ms": (soak.get("latency_ms") or {}).get("p50"),
+                    "latency_p99_ms": (soak.get("latency_ms") or {}).get("p99"),
+                    "slo_p99_ms": soak.get("slo_p99_ms"),
+                    "deadline_misses": soak.get("deadline_misses"),
+                    "recompiles_after_warmup": soak.get(
+                        "recompiles_after_warmup"
+                    ),
+                    "invariant_violations": soak.get("invariant_violations"),
+                }
+                if isinstance(soak, dict)
+                else None
+            ),
         )
         return row
     if "parsed" in doc or "rc" in doc:
@@ -417,6 +458,65 @@ def compare(
             }
             checked.append(entry)
             if steps > base * (1.0 + ctol):
+                regressions.append(entry)
+    # Serving-soak gates (round 11): presence from SOAK_ROW_SINCE, then
+    # the row's own stated SLO, a goodput floor (no shedding your way
+    # to a fast p99), and the zero-recompile + zero-violation contract.
+    soak = current.get("soak")
+    if (
+        current.get("format") == "suite"
+        and current["round"] >= SOAK_ROW_SINCE
+        and not soak
+    ):
+        entry = {
+            "bench": "missing:soak",
+            "current_per_op_us": 0.0,
+            "baseline_per_op_us": 0.0,
+            "ratio": 0.0,
+        }
+        checked.append(entry)
+        regressions.append(entry)
+    if soak:
+        p99 = soak.get("latency_p99_ms")
+        slo = soak.get("slo_p99_ms")
+        if p99 is not None and slo:
+            env_f = os.environ.get("HV_BENCH_SOAK_SLO_FACTOR")
+            factor = float(env_f) if env_f else DEFAULT_SOAK_SLO_FACTOR
+            cap = float(slo) * factor
+            entry = {
+                "bench": "soak_latency_p99_ms",
+                "current_per_op_us": float(p99),
+                "baseline_per_op_us": cap,
+                "ratio": round(float(p99) / cap, 3) if cap else 0.0,
+            }
+            checked.append(entry)
+            if float(p99) > cap:
+                regressions.append(entry)
+        ratio_val = soak.get("goodput_ratio")
+        if ratio_val is not None:
+            env_g = os.environ.get("HV_BENCH_SOAK_GOODPUT")
+            floor = float(env_g) if env_g else DEFAULT_SOAK_GOODPUT
+            entry = {
+                "bench": "soak_goodput_ratio",
+                "current_per_op_us": float(ratio_val),
+                "baseline_per_op_us": floor,
+                "ratio": round(float(ratio_val) / floor, 3) if floor else 0.0,
+            }
+            checked.append(entry)
+            if float(ratio_val) < floor:
+                regressions.append(entry)
+        for hard_zero in ("recompiles_after_warmup", "invariant_violations"):
+            value = soak.get(hard_zero)
+            if value is None:
+                continue
+            entry = {
+                "bench": f"soak_{hard_zero}",
+                "current_per_op_us": float(value),
+                "baseline_per_op_us": 0.0,
+                "ratio": float(value),
+            }
+            checked.append(entry)
+            if value != 0:
                 regressions.append(entry)
     if scenarios and scenarios.get("hardening_overhead_pct") is not None:
         env_cap = os.environ.get("HV_BENCH_HARDENING_OVERHEAD")

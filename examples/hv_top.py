@@ -208,6 +208,44 @@ def render(
                 f"  restored  {last_restore.get('reason', '')[:60]}"
             )
 
+    serving = health.get("serving", {})
+    lines.append("")
+    if not serving.get("enabled"):
+        lines.append("serving    (front door not attached)")
+    else:
+        shed = serving.get("shed", {})
+        shed_str = "  ".join(
+            f"{reason}={count}" for reason, count in sorted(shed.items())
+        )
+        lines.append(
+            f"serving    buckets={','.join(str(b) for b in serving.get('buckets', []))}  "
+            f"shed_rate={serving.get('shed_rate', 0) * 100:.2f}%  "
+            f"deadline_misses={serving.get('deadline_misses', 0):,}  "
+            f"pad_lanes={serving.get('padded_lanes', 0):,}"
+        )
+        lines.append(f"  sheds    {shed_str}")
+        q_rows = []
+        for name, q in sorted(serving.get("queues", {}).items()):
+            last = q.get("last_wave") or {}
+            q_rows.append(
+                (
+                    name,
+                    f"{q.get('depth', 0):,}/{q.get('capacity', 0):,}",
+                    f"{q.get('enqueued', 0):,}",
+                    f"{q.get('served', 0):,}",
+                    f"{q.get('waves', 0):,}",
+                    f"{q.get('deadline_s', 0) * 1e3:,.0f}ms",
+                    "-" if not last else f"{last.get('fill_pct', 0):.0f}%",
+                )
+            )
+        lines += fmt_table(
+            q_rows,
+            header=(
+                "queue", "depth", "enq", "served", "waves", "deadline",
+                "fill",
+            ),
+        )
+
     if trajectory:
         lines.append("")
         lines.append("bench trajectory (headline per-op p50, µs)")
@@ -255,8 +293,14 @@ def main(argv=None) -> int:
     # Live integrity panel for the in-process demo: sampled sanitizer +
     # paced scrubbing over the demo traffic.
     from hypervisor_tpu.integrity import IntegrityPlane
+    from hypervisor_tpu.serving import FrontDoor, WaveScheduler
 
     IntegrityPlane(state, every=4, scrub_every=8)
+    # Live serving panel: a small front-door stream rides alongside the
+    # direct demo waves (lifecycles through the scheduler's bucketed
+    # drain, so queue depth / fill / cadence move on screen).
+    front = FrontDoor(state)
+    scheduler = WaveScheduler(front)
     progress = {"rnd": 0, "driving": True}
 
     def tick() -> None:
@@ -265,6 +309,16 @@ def main(argv=None) -> int:
                 progress["driving"] = drive_round(
                     state, args.sessions, progress["rnd"], prefix="top"
                 )
+            rnd = progress["rnd"]
+            now = state.now()
+            for i in range(3):
+                front.submit_lifecycle(
+                    f"top:serve:r{rnd}:{i}",
+                    f"did:top:serve:r{rnd}:{i}",
+                    0.8,
+                    now=now,
+                )
+            scheduler.tick(now=now + front.config.lifecycle_deadline_s)
             progress["rnd"] += 1
 
     def frame() -> str:

@@ -371,3 +371,32 @@ class SweepResponse(BaseModel):
     elevations_expired: int = 0
     quarantines_released: int = 0
     sessions_expired: list = []
+
+
+# ── Serving front door ───────────────────────────────────────────────
+
+
+class JoinWaveRequest(BaseModel):
+    """A batch of joins for one session, served as bucketed waves.
+
+    Each lane is {"agent_did": ..., "sigma_raw": ...}; per-lane sheds
+    come back as typed refusals in the response (never a 429 for the
+    whole wave — backpressure is per lane)."""
+
+    joins: list
+
+
+class JoinWaveLane(BaseModel):
+    agent_did: str
+    admitted: bool = False
+    status: Optional[int] = None
+    ring: Optional[int] = None
+    refusal: Optional[dict] = None
+    retry_after_s: Optional[float] = None
+    latency_ms: Optional[float] = None
+
+
+class JoinWaveResponse(BaseModel):
+    session_id: str
+    lanes: list
+    wave: Optional[dict] = None

@@ -1,6 +1,6 @@
 """REST transports for the Hypervisor API.
 
-Two transports over the same `HypervisorService` (37 routes: the
+Two transports over the same `HypervisorService` (41 routes: the
 reference's 21, `api/server.py`, plus device stats, quarantine views,
 the per-membership agent view, leave, the operator sweep, the
 per-action gateway with its wave sibling, the flight recorder —
@@ -9,9 +9,13 @@ and the health plane: `GET /debug/health` (watchdog + occupancy +
 compile totals + stage quantiles), `GET /debug/memory` (per-table HBM
 footprints), `GET /debug/compiles` (compile telemetry), plus the
 resilience plane: `GET /debug/resilience` (supervisor mode, retry
-accounting, WAL status, last watermarked checkpoint) and the integrity
+accounting, WAL status, last watermarked checkpoint), the integrity
 plane: `GET /debug/integrity` (sanitizer violations, scrub progress,
-repair/restore ladder accounting)):
+repair/restore ladder accounting), and the serving front door:
+`GET /debug/serving` (queue depths, shed rates, deadline misses, wave
+cadence), `POST .../join-wave` (batched joins with per-lane typed
+refusals), and `GET /api/v1/serving/stream` (NDJSON watch feed);
+overload sheds map to HTTP 429 + Retry-After on BOTH transports):
 
  - `create_app()` — a FastAPI application with CORS-open middleware and
    OpenAPI docs, when fastapi is installed.
@@ -30,8 +34,22 @@ from urllib.parse import parse_qs, urlparse
 
 from hypervisor_tpu import __version__
 from hypervisor_tpu.api import models as M
-from hypervisor_tpu.api.service import ApiError, HypervisorService, PrometheusText
+from hypervisor_tpu.api.service import (
+    ApiError,
+    HypervisorService,
+    NdjsonStream,
+    PrometheusText,
+)
 from hypervisor_tpu.observability.metrics import PROMETHEUS_CONTENT_TYPE
+from hypervisor_tpu.resilience.policy import DegradedModeRefusal
+
+
+def _retry_after_headers(retry_after_s: Optional[float]) -> dict:
+    """Retry-After header for a 429 (whole seconds, rounded up)."""
+    import math
+
+    seconds = max(1, math.ceil(retry_after_s or 1.0))
+    return {"Retry-After": str(seconds)}
 
 # ── Route table: (method, pattern, handler_name, request_model) ──────
 # {name} segments become handler kwargs; query params pass through for GET.
@@ -46,12 +64,16 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/debug/compiles", "debug_compiles", None),
     ("GET", "/debug/resilience", "debug_resilience", None),
     ("GET", "/debug/integrity", "debug_integrity", None),
+    ("GET", "/debug/serving", "debug_serving", None),
     ("GET", "/api/v1/stats", "stats", None),
     ("GET", "/api/v1/device/stats", "device_stats", None),
     ("POST", "/api/v1/sessions", "create_session", M.CreateSessionRequest),
     ("GET", "/api/v1/sessions", "list_sessions", None),
     ("GET", "/api/v1/sessions/{session_id}", "get_session", None),
     ("POST", "/api/v1/sessions/{session_id}/join", "join_session", M.JoinSessionRequest),
+    ("POST", "/api/v1/sessions/{session_id}/join-wave", "join_wave",
+     M.JoinWaveRequest),
+    ("GET", "/api/v1/serving/stream", "serving_stream", None),
     ("POST", "/api/v1/sessions/{session_id}/activate", "activate_session", None),
     ("POST", "/api/v1/sessions/{session_id}/terminate", "terminate_session", None),
     ("GET", "/api/v1/sessions/{session_id}/rings", "ring_distribution", None),
@@ -90,7 +112,15 @@ _QUERY_PARAMS = {
     "list_sessions": ("state",),
     "query_events": ("event_type", "session_id", "agent_did", "limit"),
     "trace_session": ("format",),
+    "serving_stream": ("frames", "interval"),
 }
+
+#: Typed query params (everything else passes through as a string).
+_QUERY_COERCE = {"limit": int, "frames": int, "interval": float}
+
+
+def _coerce_query(name: str, value: str):
+    return _QUERY_COERCE.get(name, str)(value)
 
 #: Stdlib-transport request-body ceiling: no governance call carries
 #: megabytes, and an attacker-declared huge Content-Length must refuse
@@ -176,9 +206,7 @@ def create_app(service: Optional[HypervisorService] = None):
                     if q in request.query_params:
                         value = request.query_params[q]
                         try:
-                            path_kwargs[q] = (
-                                int(value) if q == "limit" else value
-                            )
+                            path_kwargs[q] = _coerce_query(q, value)
                         except ValueError:
                             raise HTTPException(
                                 status_code=400,
@@ -187,12 +215,35 @@ def create_app(service: Optional[HypervisorService] = None):
                 try:
                     result = await getattr(svc, name)(**path_kwargs)
                 except ApiError as e:
-                    raise HTTPException(status_code=e.status, detail=e.detail)
+                    raise HTTPException(
+                        status_code=e.status,
+                        detail=e.detail,
+                        headers=(
+                            _retry_after_headers(e.retry_after_s)
+                            if e.status == 429
+                            else None
+                        ),
+                    )
+                except DegradedModeRefusal as e:
+                    # An overload shed surfacing anywhere in a handler
+                    # is backpressure: 429 + Retry-After, never a 500.
+                    raise HTTPException(
+                        status_code=429,
+                        detail=str(e),
+                        headers=_retry_after_headers(None),
+                    )
                 if isinstance(result, PrometheusText):
                     from fastapi.responses import PlainTextResponse
 
                     return PlainTextResponse(
                         str(result), media_type=PROMETHEUS_CONTENT_TYPE
+                    )
+                if isinstance(result, NdjsonStream):
+                    from fastapi.responses import StreamingResponse
+
+                    return StreamingResponse(
+                        (json.dumps(f) + "\n" for f in result.frames),
+                        media_type=NdjsonStream.content_type,
                     )
                 return _to_jsonable(result)
 
@@ -295,7 +346,7 @@ class HypervisorHTTPServer:
                     if q in query:
                         value = query[q][0]
                         try:
-                            kwargs[q] = int(value) if q == "limit" else value
+                            kwargs[q] = _coerce_query(q, value)
                         except ValueError:
                             self._send(
                                 400, {"detail": f"bad query param {q!r}"}
@@ -304,7 +355,24 @@ class HypervisorHTTPServer:
                 try:
                     result = asyncio.run(getattr(svc, name)(**kwargs))
                 except ApiError as e:
-                    self._send(e.status, {"detail": e.detail})
+                    self._send(
+                        e.status,
+                        {"detail": e.detail},
+                        headers=(
+                            _retry_after_headers(e.retry_after_s)
+                            if e.status == 429
+                            else None
+                        ),
+                    )
+                    return
+                except DegradedModeRefusal as e:
+                    # Overload shed in a handler = backpressure: 429 +
+                    # Retry-After, never an unhandled raise (500/drop).
+                    self._send(
+                        429,
+                        {"detail": str(e)},
+                        headers=_retry_after_headers(None),
+                    )
                     return
                 status = 201 if ("POST", name) in _CREATED else 200
                 if isinstance(result, PrometheusText):
@@ -312,20 +380,56 @@ class HypervisorHTTPServer:
                         status, str(result).encode(), PROMETHEUS_CONTENT_TYPE
                     )
                     return
+                if isinstance(result, NdjsonStream):
+                    self._stream_ndjson(result)
+                    return
                 self._send(status, _to_jsonable(result))
 
-            def _send(self, status: int, payload: Any) -> None:
+            def _stream_ndjson(self, stream: NdjsonStream) -> None:
+                """Chunked newline-delimited JSON (the serving watch
+                feed): frames flush as they are produced."""
+                self.send_response(200)
+                self.send_header("Content-Type", NdjsonStream.content_type)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.end_headers()
+                try:
+                    for frame in stream.frames:
+                        data = (json.dumps(frame) + "\n").encode()
+                        self.wfile.write(
+                            f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                        )
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away mid-stream
+
+            def _send(
+                self,
+                status: int,
+                payload: Any,
+                headers: Optional[dict] = None,
+            ) -> None:
                 self._send_raw(
-                    status, json.dumps(payload).encode(), "application/json"
+                    status,
+                    json.dumps(payload).encode(),
+                    "application/json",
+                    headers=headers,
                 )
 
             def _send_raw(
-                self, status: int, data: bytes, content_type: str
+                self,
+                status: int,
+                data: bytes,
+                content_type: str,
+                headers: Optional[dict] = None,
             ) -> None:
                 self.send_response(status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("Access-Control-Allow-Origin", "*")
+                for key, value in (headers or {}).items():
+                    self.send_header(key, value)
                 self.end_headers()
                 self.wfile.write(data)
 

@@ -21,16 +21,37 @@ from hypervisor_tpu.api import models as M
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, detail: str) -> None:
+    def __init__(
+        self,
+        status: int,
+        detail: str,
+        retry_after_s: Optional[float] = None,
+    ) -> None:
         super().__init__(detail)
         self.status = status
         self.detail = detail
+        # Backpressure hint: transports surface this as the HTTP
+        # Retry-After header (whole seconds, rounded up) on 429s.
+        self.retry_after_s = retry_after_s
 
 
 class PrometheusText(str):
     """Marker type: serve this handler result as Prometheus text
     exposition (`observability.metrics.PROMETHEUS_CONTENT_TYPE`), not
     JSON. Both transports special-case it."""
+
+
+class NdjsonStream:
+    """Marker type: stream these frames as newline-delimited JSON.
+
+    `frames` is an iterable of JSON-serializable dicts; both transports
+    write each frame as one line and flush between frames (the serving
+    watch stream, `GET /api/v1/serving/stream`)."""
+
+    content_type = "application/x-ndjson"
+
+    def __init__(self, frames) -> None:
+        self.frames = frames
 
 
 class HypervisorService:
@@ -240,6 +261,8 @@ class HypervisorService:
     async def join_session(
         self, session_id: str, req: M.JoinSessionRequest
     ) -> M.JoinSessionResponse:
+        from hypervisor_tpu.resilience.policy import DegradedModeRefusal
+
         actions = [ActionDescriptor(**a) for a in req.actions] if req.actions else None
         try:
             ring = await self.hv.join_session(
@@ -250,6 +273,13 @@ class HypervisorService:
             )
         except ValueError as e:
             raise ApiError(404, str(e)) from e
+        except DegradedModeRefusal as e:
+            # Overload shedding (full degraded shed or the sybil
+            # damper's targeted floor) is backpressure, not a caller
+            # error: 429 + Retry-After, never a 500/400.
+            raise ApiError(
+                429, str(e), retry_after_s=self._retry_after_s()
+            ) from e
         except Exception as e:
             raise ApiError(400, str(e)) from e
         return M.JoinSessionResponse(
@@ -654,6 +684,138 @@ class HypervisorService:
             )
             for r in self.hv.quarantine.active_quarantines
         ]
+
+    # ── serving front door ───────────────────────────────────────────
+
+    def _retry_after_s(self) -> float:
+        serving = self.hv.state.serving
+        if serving is not None:
+            return serving.config.retry_after_s
+        return 1.0
+
+    async def debug_serving(self) -> dict:
+        """`GET /debug/serving`: the serving plane in one poll —
+        per-queue depth/backpressure, shed accounting by refusal kind,
+        deadline misses, wave cadence and bucket fill."""
+        return self.hv.state.serving_summary()
+
+    async def join_wave(
+        self, session_id: str, req: M.JoinWaveRequest
+    ) -> M.JoinWaveResponse:
+        """`POST /api/v1/sessions/{session_id}/join-wave`: a BATCH of
+        joins through the serving front door, drained as shape-bucketed
+        admission waves. Per-lane sheds come back as typed refusals
+        with Retry-After hints (the whole wave never 429s — only the
+        lanes the valve refused), and admitted lanes mirror onto the
+        host SSO exactly like the single-join facade path.
+        """
+        import numpy as np
+
+        managed = self._managed(session_id)
+        if not isinstance(req.joins, list) or not req.joins:
+            raise ApiError(422, "joins must be a non-empty list")
+        fd = self.hv.attach_front_door()
+        sched = self.hv.serving_scheduler
+        state = self.hv.state
+        now = state.now()
+        staged: list[tuple[dict, object]] = []
+        for lane in req.joins:
+            if not isinstance(lane, dict) or "agent_did" not in lane:
+                raise ApiError(422, "each join lane needs agent_did")
+            sigma = float(lane.get("sigma_raw", 0.0))
+            if not np.isfinite(sigma) or not 0.0 <= sigma <= 1.0:
+                raise ApiError(
+                    422,
+                    f"sigma_raw must be finite in [0, 1]; got "
+                    f"{lane.get('sigma_raw')!r}",
+                )
+            out = fd.submit_join(
+                managed.slot, str(lane["agent_did"]), sigma, now=now
+            )
+            staged.append((lane, out))
+        sched.drain(now=now)
+        lanes = []
+        for lane, out in staged:
+            did = str(lane["agent_did"])
+            if out.refused:
+                lanes.append(
+                    M.JoinWaveLane(
+                        agent_did=did,
+                        admitted=False,
+                        refusal=out.to_dict(),
+                        retry_after_s=out.retry_after_s,
+                    )
+                )
+                continue
+            ring_val = None
+            if out.ok:
+                row = state.agent_row(did, managed.slot)
+                if row is not None:
+                    ring_val = int(row["ring"])
+                    # Mirror the host plane (the facade contract:
+                    # device tables and SSO share one truth).
+                    try:
+                        managed.sso.join(
+                            agent_did=did,
+                            sigma_raw=float(lane.get("sigma_raw", 0.0)),
+                            sigma_eff=float(row["sigma_eff"]),
+                            ring=ExecutionRing(ring_val),
+                        )
+                    except Exception:  # pragma: no cover — device won
+                        pass
+                    self.hv._emit(
+                        EventType.SESSION_JOINED,
+                        session_id=session_id,
+                        agent_did=did,
+                        payload={
+                            "ring": ring_val,
+                            "sigma_eff": float(row["sigma_eff"]),
+                            "via": "join_wave",
+                        },
+                    )
+            lanes.append(
+                M.JoinWaveLane(
+                    agent_did=did,
+                    admitted=bool(out.ok),
+                    status=out.status,
+                    ring=ring_val,
+                    latency_ms=(
+                        None if out.latency_s is None
+                        else round(out.latency_s * 1e3, 3)
+                    ),
+                )
+            )
+        return M.JoinWaveResponse(
+            session_id=session_id,
+            lanes=[lane.model_dump() for lane in lanes],
+            wave=fd.last_wave.get("join"),
+        )
+
+    async def serving_stream(
+        self,
+        frames: Optional[int] = None,
+        interval: Optional[float] = None,
+    ) -> NdjsonStream:
+        """`GET /api/v1/serving/stream?frames=N&interval=S`: newline-
+        delimited JSON frames of the serving panel — a poll-free watch
+        feed for dashboards (both transports stream it)."""
+        n = 5 if frames is None else max(1, min(int(frames), 10_000))
+        pause = 0.0 if interval is None else max(0.0, float(interval))
+        state = self.hv.state
+
+        def gen():
+            import time as _time
+
+            for i in range(n):
+                yield {
+                    "frame": i,
+                    "now_s": round(state.now(), 3),
+                    "serving": state.serving_summary(),
+                }
+                if pause and i < n - 1:
+                    _time.sleep(pause)
+
+        return NdjsonStream(gen())
 
     # ── internals ────────────────────────────────────────────────────
 

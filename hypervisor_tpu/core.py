@@ -228,6 +228,27 @@ class Hypervisor:
         self._sessions: dict[str, ManagedSession] = {}
         # Keyed by Mesh (hashable): same mesh -> same runtime instance.
         self._consistency_runtimes: dict[Any, Any] = {}
+        # Serving front door (lazy, `attach_front_door`): the batched
+        # API endpoints route through it; None until first use.
+        self.front_door = None
+        self._serving_scheduler = None
+
+    def attach_front_door(self, config=None):
+        """Attach (or return) the serving front door + wave scheduler
+        (`hypervisor_tpu.serving`): bounded ingestion queues with the
+        degraded-mode valve, draining into shape-bucketed waves. The
+        batched/streaming API endpoints call this lazily."""
+        if self.front_door is None:
+            from hypervisor_tpu.serving import FrontDoor, WaveScheduler
+
+            self.front_door = FrontDoor(self.state, config)
+            self._serving_scheduler = WaveScheduler(self.front_door)
+        return self.front_door
+
+    @property
+    def serving_scheduler(self):
+        self.attach_front_door()
+        return self._serving_scheduler
 
     # ── lifecycle ────────────────────────────────────────────────────
 

@@ -324,6 +324,81 @@ SCENARIO_CONTAINMENT = REGISTRY.gauge(
     "containment score [0, 1] of the most recent scenario run",
 )
 
+# ── serving front door (ingestion queues + wave scheduler) ───────────
+# Host-incremented by `hypervisor_tpu.serving` (FrontDoor submit paths
+# and WaveScheduler dispatches). Queue names are the serving request
+# classes; shed reasons are the typed-refusal kinds.
+SERVING_QUEUES: tuple[str, ...] = (
+    "join", "action", "lifecycle", "terminate", "saga",
+)
+SERVING_SHED_REASONS: tuple[str, ...] = (
+    "queue_full", "degraded", "sybil_damped", "duplicate",
+)
+SERVING_ENQUEUED = {
+    q: REGISTRY.counter(
+        "hv_serving_enqueued_total",
+        "requests accepted into a serving ingestion queue",
+        queue=q,
+    )
+    for q in SERVING_QUEUES
+}
+SERVING_SERVED = {
+    q: REGISTRY.counter(
+        "hv_serving_served_total",
+        "requests resolved by a dispatched serving wave",
+        queue=q,
+    )
+    for q in SERVING_QUEUES
+}
+SERVING_SHED = {
+    r: REGISTRY.counter(
+        "hv_serving_shed_total",
+        "requests refused at the front door (typed refusals)",
+        reason=r,
+    )
+    for r in SERVING_SHED_REASONS
+}
+SERVING_WAVES = {
+    q: REGISTRY.counter(
+        "hv_serving_waves_total",
+        "shape-bucketed waves dispatched by the scheduler",
+        queue=q,
+    )
+    for q in SERVING_QUEUES
+}
+SERVING_QUEUE_DEPTH = {
+    q: REGISTRY.gauge(
+        "hv_serving_queue_depth",
+        "requests currently pending in a serving queue",
+        queue=q,
+    )
+    for q in SERVING_QUEUES
+}
+SERVING_WAVE_FILL = {
+    q: REGISTRY.gauge(
+        "hv_serving_wave_fill_pct",
+        "real-lane fill percentage of the most recent bucketed wave",
+        queue=q,
+    )
+    for q in SERVING_QUEUES
+}
+SERVING_LATENCY = {
+    q: REGISTRY.histogram(
+        "hv_serving_latency_us",
+        "submit-to-served latency (queue wait + wave dispatch)",
+        queue=q,
+    )
+    for q in SERVING_QUEUES
+}
+SERVING_DEADLINE_MISSES = REGISTRY.counter(
+    "hv_serving_deadline_misses_total",
+    "served requests whose latency exceeded their class deadline",
+)
+SERVING_PADDED_LANES = REGISTRY.counter(
+    "hv_serving_padded_lanes_total",
+    "no-op pad lanes dispatched to hold the closed bucket shapes",
+)
+
 # ── integrity plane (sanitizer / scrubber / escalation ladder) ───────
 # The first four are DEVICE-written inside the sanitizer program
 # (`integrity.invariants.check_invariants`) so detection rides the

@@ -165,6 +165,7 @@ def admit_batch(
     trace=None,       # TraceLog riding the wave (flight recorder)
     trace_ctx=None,   # observability.tracing.TraceContext scalars
     cache_salt: float = 0.0,  # static: see state._DONATION_CACHE_SALT
+    valid: jnp.ndarray | None = None,  # bool[B] serving-pad lane mask
 ) -> AdmissionResult:
     """Admit a wave of B agents; rejected elements leave no trace.
 
@@ -190,6 +191,15 @@ def admit_batch(
     host transfer, predicated on the context's sample bit. The span
     word is `trace_ctx.span`: the caller roots it (`TraceContext.child`
     when this op nests inside the fused pipeline wave).
+
+    `valid` (bool[B]) marks the REAL lanes of a shape-bucketed serving
+    wave (`serving.WaveScheduler` pads a partial bucket with
+    duplicate=True no-op lanes so the jit cache stays closed over the
+    bucket set). Pad lanes are refused like any duplicate and write
+    nothing; the mask only keeps them OUT of the admitted/refused
+    counters and the wave-size histogram, so shed-rate metrics stay
+    honest. None (the default) leaves the traced program byte-identical
+    to the pre-serving form.
     """
     # One row gather per packed block instead of one per column
     # (tables/state.py SessionTable packing): the [B, 5] i32 rows carry
@@ -288,16 +298,26 @@ def admit_batch(
 
         from hypervisor_tpu.ops import tally
 
-        n_ok = tally.count_true_1d(ok)
+        if valid is None:
+            n_ok = tally.count_true_1d(ok)
+            n_refused = b - n_ok
+            lanes_observed = jnp.full((1,), b, jnp.float32)
+        else:
+            # Bucket-padded serving wave: pad lanes (valid=False) are
+            # refused by construction but must not count as refusals —
+            # one matvec tallies both masked counts.
+            n_ok, n_valid = tally.count_true(ok & valid, valid)
+            n_refused = n_valid - n_ok
+            lanes_observed = n_valid.astype(jnp.float32)[None]
         metrics = metrics_ops.counter_add_many(
             metrics,
             (metrics_schema.ADMITTED.index, metrics_schema.REFUSED.index),
-            (n_ok, b - n_ok),
+            (n_ok, n_refused),
         )
         metrics = metrics_ops.observe(
             metrics,
             metrics_schema.WAVE_LANES.index,
-            jnp.full((1,), b, jnp.float32),
+            lanes_observed,
         )
     if trace is not None:
         from hypervisor_tpu.observability import tracing

@@ -231,6 +231,8 @@ def governance_wave(
     sanitize: bool = False,     # static: fold the invariant sanitizer tail
     config=DEFAULT_CONFIG,      # static (sanitizer thresholds)
     cache_salt: float = 0.0,    # static: see state._DONATION_CACHE_SALT
+    lanes_valid=None,           # bool[B]: real (non-bucket-pad) join lanes
+    n_sessions_valid=None,      # i32[]: real session lanes (prefix count)
 ) -> WaveResult:
     """The full governance pipeline AS ONE PROGRAM over the state tables.
 
@@ -363,6 +365,7 @@ def governance_wave(
         ring_bursts=ring_bursts,
         unique_sessions=unique_sessions,
         metrics=metrics,
+        valid=lanes_valid,
     )
     agents, sessions = admitted.agents, admitted.sessions
     metrics = admitted.metrics
@@ -440,12 +443,26 @@ def governance_wave(
             k * t, delta_bodies.shape[2]
         )
         digests_flat = jnp.transpose(chain, (1, 0, 2)).reshape(k * t, 8)
-        delta_log = delta_log.append_batch(
-            bodies_flat,
-            digests_flat,
-            jnp.repeat(k_sessions, t),
-            jnp.tile(jnp.arange(t, dtype=jnp.int32), k),
-        )
+        if n_sessions_valid is None:
+            delta_log = delta_log.append_batch(
+                bodies_flat,
+                digests_flat,
+                jnp.repeat(k_sessions, t),
+                jnp.tile(jnp.arange(t, dtype=jnp.int32), k),
+            )
+        else:
+            # Bucket-padded serving wave: pad session lanes are a
+            # SUFFIX, so the live records are exactly the flat prefix
+            # of the lane-major layout — append only those (the ring
+            # stays bit-identical to an unpadded wave; parked sessions
+            # never enter the audit plane).
+            delta_log = delta_log.append_batch_prefix(
+                bodies_flat,
+                digests_flat,
+                jnp.repeat(k_sessions, t),
+                jnp.tile(jnp.arange(t, dtype=jnp.int32), k),
+                jnp.asarray(n_sessions_valid, jnp.int32) * t,
+            )
 
     # ── 7. fused action gateway (single-device twin of the mesh's
     #    with_gateway phase): runs on the POST-terminate table inside
@@ -490,18 +507,25 @@ def governance_wave(
         from hypervisor_tpu.ops import tally
 
         archived_col = (wave_state == SessionState.ARCHIVED.code) & ~fsm_err
+        committed_col = step_state == saga_ops.STEP_COMMITTED
+        failed_col = step_state == saga_ops.STEP_FAILED
+        if lanes_valid is not None:
+            # Bucket-pad lanes are refused joins whose synthetic saga
+            # step would otherwise count as failed — keep them out.
+            committed_col = committed_col & lanes_valid
+            failed_col = failed_col & lanes_valid
         if step_state.shape == archived_col.shape:
             # Bench/facade waves have B == K: all three lane tallies
             # ride ONE matvec.
             wave_counts = tally.count_true(
-                step_state == saga_ops.STEP_COMMITTED,
-                step_state == saga_ops.STEP_FAILED,
+                committed_col,
+                failed_col,
                 archived_col,
             )
         else:
             saga_counts = tally.count_true(
-                step_state == saga_ops.STEP_COMMITTED,
-                step_state == saga_ops.STEP_FAILED,
+                committed_col,
+                failed_col,
             )
             wave_counts = (
                 saga_counts[0],

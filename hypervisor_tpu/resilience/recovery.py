@@ -176,7 +176,11 @@ def _r_enqueue_join(st: HypervisorState, a: dict) -> None:
 
 
 def _r_flush_joins(st: HypervisorState, a: dict) -> None:
-    st.flush_joins(now=float(a["now"]))
+    pad_to = a.get("pad_to")
+    st.flush_joins(
+        now=float(a["now"]),
+        pad_to=None if pad_to is None else int(pad_to),
+    )
 
 
 def _r_governance_wave(st: HypervisorState, a: dict) -> None:
@@ -194,6 +198,13 @@ def _r_governance_wave(st: HypervisorState, a: dict) -> None:
             None
             if a.get("actions") is None
             else {k: np.asarray(v) for k, v in a["actions"].items()}
+        ),
+        # Bucket padding must replay identically: the padded program
+        # advanced the slot allocator by the padded width.
+        pad_to=(
+            None
+            if a.get("pad_to") is None
+            else (int(a["pad_to"][0]), int(a["pad_to"][1]))
         ),
     )
 

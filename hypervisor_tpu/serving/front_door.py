@@ -1,0 +1,536 @@
+"""Serving front door: continuous admission over the wave machinery.
+
+Production traffic is a continuous open stream; the device plane wants
+warm, shape-stable waves. This module is the boundary between the two:
+
+  * **Bounded ingestion queues** — one per request class (joins into
+    live sessions, gateway actions, full ephemeral lifecycles,
+    terminations, saga-step outcomes), each with a hard depth. A full
+    queue is backpressure, not an error: the submit returns a typed
+    `Refusal` (never raises), carrying a Retry-After hint the API
+    transports surface as HTTP 429.
+  * **The overload valve** — the PR 4 degraded-mode shedding and the
+    sybil damper's targeted floor apply at SUBMIT time (join and
+    lifecycle classes only; terminations and saga settles always flow,
+    per the `resilience.policy` table). A shed surfaces as a
+    `Refusal(kind="degraded"|"sybil_damped")`, counted on
+    `hv_serving_shed_total{reason=...}` alongside the resilience
+    plane's own counters.
+  * **Tickets** — an accepted submit returns a `Ticket` resolved by the
+    wave that serves it (`serving.scheduler.WaveScheduler`), carrying
+    the admission status / gateway verdict / Merkle root and the
+    measured latency (virtual queue wait + wall wave time).
+
+All decision inputs are clock-explicit (`now` flows in from the caller,
+defaulting to `state.now()`), so a seeded trace replay makes identical
+admission/shed decisions — the determinism contract the soak harness
+(`serving.loadgen`) pins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from collections import deque
+from typing import Any, Optional
+
+import numpy as np
+
+from hypervisor_tpu.observability import metrics as metrics_plane
+from hypervisor_tpu.resilience.policy import (
+    DegradedModeRefusal,
+    SybilShedRefusal,
+)
+
+
+def _env_buckets() -> tuple[int, ...]:
+    raw = os.environ.get("HV_SERVE_BUCKETS")
+    if not raw:
+        return (4, 8, 16, 32)
+    return tuple(sorted(int(x) for x in raw.split(",") if x.strip()))
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Knobs for the front door + scheduler (docs/OPERATIONS.md
+    "Serving front door").
+
+    `buckets` is the CLOSED set of padded wave shapes — the jit cache
+    holds one entry per (program, bucket) and nothing else, so a warmed
+    scheduler never recompiles (compile-telemetry-pinned). Deadlines
+    are per-class latency budgets: a bucket dispatches when it fills OR
+    when its oldest request is within `dispatch_margin_s` of missing
+    its deadline.
+    """
+
+    buckets: tuple[int, ...] = dataclasses.field(
+        default_factory=_env_buckets
+    )
+    join_deadline_s: float = float(
+        os.environ.get("HV_SERVE_JOIN_DEADLINE_S", 0.05)
+    )
+    action_deadline_s: float = float(
+        os.environ.get("HV_SERVE_ACTION_DEADLINE_S", 0.05)
+    )
+    lifecycle_deadline_s: float = float(
+        os.environ.get("HV_SERVE_LIFECYCLE_DEADLINE_S", 0.1)
+    )
+    terminate_deadline_s: float = float(
+        os.environ.get("HV_SERVE_TERMINATE_DEADLINE_S", 0.2)
+    )
+    saga_deadline_s: float = float(
+        os.environ.get("HV_SERVE_SAGA_DEADLINE_S", 0.1)
+    )
+    dispatch_margin_s: float = 0.0
+    #: Queue depths. The join queue is capped at the largest bucket
+    #: because `flush_joins` harvests the WHOLE staging queue in one
+    #: wave — more than a bucket of staged joins would force an
+    #: off-bucket shape. The other classes chunk, so their depths are
+    #: backpressure policy, not a shape constraint.
+    action_queue_depth: int = 256
+    lifecycle_queue_depth: int = 256
+    terminate_queue_depth: int = 256
+    saga_queue_depth: int = 256
+    #: Retry-After hint (seconds) stamped on refusals; API transports
+    #: surface it as the HTTP Retry-After header on 429s.
+    retry_after_s: float = float(os.environ.get("HV_SERVE_RETRY_AFTER_S", 1.0))
+    #: Audit turns per ephemeral lifecycle (the T axis of the fused
+    #: wave's delta bodies; fixed per deployment so the program shape
+    #: closes over the bucket set).
+    lifecycle_turns: int = 1
+
+    @property
+    def max_bucket(self) -> int:
+        return max(self.buckets)
+
+    @property
+    def join_queue_depth(self) -> int:
+        return self.max_bucket
+
+    def deadline_for(self, kind: str) -> float:
+        return {
+            "join": self.join_deadline_s,
+            "action": self.action_deadline_s,
+            "lifecycle": self.lifecycle_deadline_s,
+            "terminate": self.terminate_deadline_s,
+            "saga": self.saga_deadline_s,
+        }[kind]
+
+
+@dataclasses.dataclass(frozen=True)
+class Refusal:
+    """A typed shed: the front door's answer when it will NOT serve.
+
+    Refusals are return values, not exceptions — a caller that treats
+    backpressure as an error path retries blindly; one that reads the
+    kind and the Retry-After hint backs off correctly. The API maps
+    refusals to HTTP 429 with a Retry-After header.
+    """
+
+    kind: str          # queue_full | degraded | sybil_damped | duplicate
+    detail: str
+    retry_after_s: float
+
+    refused = True
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "detail": self.detail,
+            "retry_after_s": self.retry_after_s,
+        }
+
+
+@dataclasses.dataclass
+class Ticket:
+    """One accepted request, resolved by the wave that serves it."""
+
+    kind: str
+    submitted_at: float          # virtual (caller-clock) submit time
+    deadline_s: float
+    payload: dict
+    refused = False
+    done: bool = False
+    ok: bool = False             # admitted / allowed / terminated
+    status: Optional[int] = None  # class-specific code (admission status,
+                                  # gateway verdict, ...)
+    result: Any = None           # class-specific extra (root hex, ring, ...)
+    served_at: Optional[float] = None
+    latency_s: Optional[float] = None
+    deadline_missed: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "done": self.done,
+            "ok": self.ok,
+            "status": self.status,
+            "latency_ms": (
+                None if self.latency_s is None
+                else round(self.latency_s * 1e3, 3)
+            ),
+            "deadline_missed": self.deadline_missed,
+        }
+
+
+class FrontDoor:
+    """The ingestion layer over one `HypervisorState`.
+
+    Attach once per state (`state.serving = self` happens here); the
+    companion `WaveScheduler` drains the queues through the fused wave
+    programs. Submits are synchronous and cheap — queue admission plus
+    the overload valve — and never dispatch a wave themselves.
+    """
+
+    def __init__(self, state, config: Optional[ServingConfig] = None) -> None:
+        self.state = state
+        self.config = config or ServingConfig()
+        if not self.config.buckets:
+            raise ValueError("ServingConfig.buckets must be non-empty")
+        self.joins: deque[Ticket] = deque()
+        self.actions: deque[Ticket] = deque()
+        self.lifecycles: deque[Ticket] = deque()
+        self.terminations: deque[Ticket] = deque()
+        self.saga_steps: deque[Ticket] = deque()
+        self._queues = {
+            "join": self.joins,
+            "action": self.actions,
+            "lifecycle": self.lifecycles,
+            "terminate": self.terminations,
+            "saga": self.saga_steps,
+        }
+        self._depths = {
+            "join": self.config.join_queue_depth,
+            "action": self.config.action_queue_depth,
+            "lifecycle": self.config.lifecycle_queue_depth,
+            "terminate": self.config.terminate_queue_depth,
+            "saga": self.config.saga_queue_depth,
+        }
+        # Submits may come from many transport threads; the scheduler
+        # drains under the same lock.
+        self._lock = threading.RLock()
+        # Park session for terminate-wave padding, allocated lazily (a
+        # memberless session whose re-archival is an idempotent no-op).
+        self._park_slot: Optional[int] = None
+        # Accounting (mirrored onto the metrics plane).
+        self.enqueued = {q: 0 for q in self._queues}
+        self.served = {q: 0 for q in self._queues}
+        self.shed = {r: 0 for r in metrics_plane.SERVING_SHED_REASONS}
+        self.deadline_misses = 0
+        self.waves = {q: 0 for q in self._queues}
+        self.padded_lanes = 0
+        self.last_wave: dict[str, dict] = {}
+        state.serving = self
+
+    # ── submit paths ─────────────────────────────────────────────────
+
+    def _now(self, now: Optional[float]) -> float:
+        return self.state.now() if now is None else float(now)
+
+    def _refuse(self, kind: str, detail: str) -> Refusal:
+        self.shed[kind] += 1
+        self.state.metrics.inc(metrics_plane.SERVING_SHED[kind])
+        return Refusal(
+            kind=kind,
+            detail=detail,
+            retry_after_s=self.config.retry_after_s,
+        )
+
+    def _accept(self, queue: str, ticket: Ticket) -> Ticket:
+        self._queues[queue].append(ticket)
+        self.enqueued[queue] += 1
+        self.state.metrics.inc(metrics_plane.SERVING_ENQUEUED[queue])
+        return ticket
+
+    def _depth_refusal(self, queue: str) -> Optional[Refusal]:
+        if len(self._queues[queue]) >= self._depths[queue]:
+            return self._refuse(
+                "queue_full",
+                f"{queue} queue at depth {self._depths[queue]}",
+            )
+        return None
+
+    def submit_join(
+        self,
+        session_slot: int,
+        agent_did: str,
+        sigma_raw: float,
+        trustworthy: bool = True,
+        now: Optional[float] = None,
+    ) -> Ticket | Refusal:
+        """Stage a join into a live session.
+
+        The overload valve fires HERE (the damper's window sees the
+        attempt, then the shed gate decides), so a refused join never
+        consumes a staging slot or an agent row. Accepted joins ride
+        the state's native staging queue until the scheduler's next
+        padded admission wave.
+        """
+        now = self._now(now)
+        with self._lock:
+            full = self._depth_refusal("join")
+            if full is not None:
+                return full
+            from hypervisor_tpu.state import _mkey
+
+            did = self.state.agent_ids.intern(agent_did)
+            key = _mkey(int(session_slot), did)
+            if key in self.state._members or key in self.state._staged_members:
+                return self._refuse(
+                    "duplicate",
+                    f"{agent_did} already member/staged in session "
+                    f"{session_slot}",
+                )
+            try:
+                q = self.state.enqueue_join(
+                    int(session_slot), agent_did, float(sigma_raw),
+                    trustworthy=trustworthy, now=now,
+                )
+            except SybilShedRefusal as e:
+                return self._refuse("sybil_damped", str(e))
+            except DegradedModeRefusal as e:
+                return self._refuse("degraded", str(e))
+            if q < 0:
+                return self._refuse("queue_full", "staging queue full")
+            ticket = Ticket(
+                kind="join",
+                submitted_at=now,
+                deadline_s=self.config.join_deadline_s,
+                payload={
+                    "session_slot": int(session_slot),
+                    "agent_did": agent_did,
+                    "did": did,
+                    "sigma_raw": float(sigma_raw),
+                },
+            )
+            return self._accept("join", ticket)
+
+    def submit_action(
+        self,
+        agent_slot: int,
+        required_ring: int = 2,
+        is_read_only: bool = False,
+        has_consensus: bool = False,
+        has_sre_witness: bool = False,
+        now: Optional[float] = None,
+    ) -> Ticket | Refusal:
+        """Queue one gateway action for a STANDING membership row."""
+        now = self._now(now)
+        with self._lock:
+            full = self._depth_refusal("action")
+            if full is not None:
+                return full
+            ticket = Ticket(
+                kind="action",
+                submitted_at=now,
+                deadline_s=self.config.action_deadline_s,
+                payload={
+                    "slot": int(agent_slot),
+                    "required_ring": int(required_ring),
+                    "is_read_only": bool(is_read_only),
+                    "has_consensus": bool(has_consensus),
+                    "has_sre_witness": bool(has_sre_witness),
+                },
+            )
+            return self._accept("action", ticket)
+
+    def submit_lifecycle(
+        self,
+        session_id: str,
+        agent_did: str,
+        sigma_raw: float,
+        delta_bodies: Optional[np.ndarray] = None,  # u32[T, BODY_WORDS]
+        trustworthy: bool = True,
+        now: Optional[float] = None,
+    ) -> Ticket | Refusal:
+        """Queue one ephemeral full lifecycle (create + join + audit +
+        terminate in ONE fused wave step — the PR 9 one-program path).
+
+        Admission load, so the overload valve applies exactly as for
+        joins: the damper window sees the attempt, then the shed gate
+        decides with the same targeted/full-shed postures.
+        """
+        now = self._now(now)
+        with self._lock:
+            full = self._depth_refusal("lifecycle")
+            if full is not None:
+                return full
+            damper = self.state.admission_damper
+            if damper is not None:
+                damper.note_join(self.state, float(sigma_raw), now)
+            try:
+                self.state._shed_gate(float(sigma_raw))
+            except SybilShedRefusal as e:
+                return self._refuse("sybil_damped", str(e))
+            except DegradedModeRefusal as e:
+                return self._refuse("degraded", str(e))
+            t = self.config.lifecycle_turns
+            from hypervisor_tpu.ops.merkle import BODY_WORDS
+
+            if delta_bodies is None:
+                bodies = np.zeros((t, BODY_WORDS), np.uint32)
+            else:
+                bodies = np.asarray(delta_bodies, np.uint32)
+                if bodies.shape != (t, BODY_WORDS):
+                    return self._refuse(
+                        "queue_full",
+                        f"lifecycle bodies must be [{t}, {BODY_WORDS}] "
+                        f"(got {bodies.shape}); lifecycle_turns is fixed "
+                        "per deployment",
+                    )
+            ticket = Ticket(
+                kind="lifecycle",
+                submitted_at=now,
+                deadline_s=self.config.lifecycle_deadline_s,
+                payload={
+                    "session_id": session_id,
+                    "agent_did": agent_did,
+                    "sigma_raw": float(sigma_raw),
+                    "trustworthy": bool(trustworthy),
+                    "bodies": bodies,
+                },
+            )
+            return self._accept("lifecycle", ticket)
+
+    def submit_terminate(
+        self, session_slot: int, now: Optional[float] = None
+    ) -> Ticket | Refusal:
+        """Queue a session termination. NEVER shed by the valve —
+        draining live work is what a degraded plane keeps doing — only
+        bounded-queue backpressure applies."""
+        now = self._now(now)
+        with self._lock:
+            full = self._depth_refusal("terminate")
+            if full is not None:
+                return full
+            ticket = Ticket(
+                kind="terminate",
+                submitted_at=now,
+                deadline_s=self.config.terminate_deadline_s,
+                payload={"session_slot": int(session_slot)},
+            )
+            return self._accept("terminate", ticket)
+
+    def submit_saga_step(
+        self, saga_slot: int, ok: bool, now: Optional[float] = None
+    ) -> Ticket | Refusal:
+        """Queue one saga-step outcome for the next saga round. Like
+        terminations, saga settles always flow (in-flight work)."""
+        now = self._now(now)
+        with self._lock:
+            full = self._depth_refusal("saga")
+            if full is not None:
+                return full
+            ticket = Ticket(
+                kind="saga",
+                submitted_at=now,
+                deadline_s=self.config.saga_deadline_s,
+                payload={"saga_slot": int(saga_slot), "ok": bool(ok)},
+            )
+            return self._accept("saga", ticket)
+
+    # ── scheduler hooks ──────────────────────────────────────────────
+
+    def park_slot(self, now: float) -> int:
+        """The terminate-wave pad session (allocated on first use)."""
+        if self._park_slot is None:
+            from hypervisor_tpu.models import SessionConfig
+
+            self._park_slot = self.state.create_session(
+                "serving:park",
+                SessionConfig(max_participants=1),
+                now=now,
+            )
+        return self._park_slot
+
+    def resolve(
+        self,
+        ticket: Ticket,
+        *,
+        ok: bool,
+        now: float,
+        wall_s: float,
+        status: Optional[int] = None,
+        result: Any = None,
+    ) -> None:
+        """Close a ticket against the wave that served it: latency is
+        the virtual queue wait plus the measured wall dispatch time."""
+        ticket.done = True
+        ticket.ok = ok
+        ticket.status = status
+        ticket.result = result
+        ticket.served_at = now
+        ticket.latency_s = max(0.0, now - ticket.submitted_at) + wall_s
+        ticket.deadline_missed = ticket.latency_s > ticket.deadline_s
+        self.served[ticket.kind] += 1
+        m = self.state.metrics
+        m.inc(metrics_plane.SERVING_SERVED[ticket.kind])
+        m.observe_us(
+            metrics_plane.SERVING_LATENCY[ticket.kind],
+            ticket.latency_s * 1e6,
+        )
+        if ticket.deadline_missed:
+            self.deadline_misses += 1
+            m.inc(metrics_plane.SERVING_DEADLINE_MISSES)
+
+    def note_wave(self, queue: str, lanes: int, bucket: int) -> None:
+        """Book one dispatched wave's shape accounting."""
+        self.waves[queue] += 1
+        pads = max(0, bucket - lanes)
+        self.padded_lanes += pads
+        m = self.state.metrics
+        m.inc(metrics_plane.SERVING_WAVES[queue])
+        if pads:
+            m.inc(metrics_plane.SERVING_PADDED_LANES, pads)
+        fill = 100.0 * lanes / bucket if bucket else 100.0
+        m.gauge_set(metrics_plane.SERVING_WAVE_FILL[queue], fill)
+        self.last_wave[queue] = {
+            "lanes": lanes,
+            "bucket": bucket,
+            "fill_pct": round(fill, 1),
+        }
+
+    def refresh_depth_gauges(self) -> None:
+        m = self.state.metrics
+        for q, dq in self._queues.items():
+            m.gauge_set(metrics_plane.SERVING_QUEUE_DEPTH[q], len(dq))
+
+    # ── observability ────────────────────────────────────────────────
+
+    def queue_depths(self) -> dict[str, int]:
+        with self._lock:
+            return {q: len(dq) for q, dq in self._queues.items()}
+
+    def summary(self) -> dict:
+        """The serving panel: `/debug/serving` + `health_summary`'s
+        serving block (what `examples/hv_top.py` renders)."""
+        with self._lock:
+            offered = sum(self.enqueued.values()) + sum(self.shed.values())
+            shed_total = sum(self.shed.values())
+            return {
+                "enabled": True,
+                "buckets": list(self.config.buckets),
+                "queues": {
+                    q: {
+                        "depth": len(dq),
+                        "capacity": self._depths[q],
+                        "enqueued": self.enqueued[q],
+                        "served": self.served[q],
+                        "waves": self.waves[q],
+                        "deadline_s": self.config.deadline_for(q),
+                        "last_wave": self.last_wave.get(q),
+                    }
+                    for q, dq in self._queues.items()
+                },
+                "shed": dict(self.shed),
+                "shed_rate": (
+                    round(shed_total / offered, 4) if offered else 0.0
+                ),
+                "deadline_misses": self.deadline_misses,
+                "padded_lanes": self.padded_lanes,
+                "retry_after_s": self.config.retry_after_s,
+            }
+
+
+__all__ = ["FrontDoor", "Refusal", "ServingConfig", "Ticket"]

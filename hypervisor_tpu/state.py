@@ -490,6 +490,15 @@ class HypervisorState:
         # scrubber, and walks the repair/containment/restore ladder
         # when the drain surfaces violations.
         self.integrity = None
+        # Serving front door (opt-in, `hypervisor_tpu.serving`): the
+        # continuous-admission ingestion layer + deadline-aware wave
+        # scheduler. Attaching a FrontDoor sets this; `health_summary`
+        # carries its queue/shed/deadline panel for hv_top.
+        self.serving = None
+        # Per-flush admission statuses keyed by membership key
+        # ((session << 32) | did, `_mkey`): the serving front door's
+        # ticket-resolution hook (overwritten by every flush_joins).
+        self.last_join_results: dict[int, int] = {}
         # WAL watermark carried by a restored checkpoint (`runtime.
         # checkpoint._rebuild`): recovery replays records PAST this seq.
         self._restored_wal_seq: Optional[int] = None
@@ -745,6 +754,7 @@ class HypervisorState:
         mesh=None,
         actions: Optional[dict] = None,
         defer_reconcile: bool = False,
+        pad_to: Optional[tuple[int, int]] = None,
     ):
         """Run the fused full-pipeline wave ON the state tables.
 
@@ -792,6 +802,18 @@ class HypervisorState:
         what always runs); `defer_reconcile=True` accumulates them on
         the state instead, until `reconcile_session_partials(mesh)`.
 
+        `pad_to` — a `(lanes_bucket, sessions_bucket)` pair — pads a
+        SINGLE-DEVICE wave to a fixed bucket shape, extending the mesh
+        path's ragged contract to the serving scheduler's closed bucket
+        set (docs/OPERATIONS.md "Serving front door"): padded join
+        lanes ride `duplicate=True` (refused, rows untouched, excluded
+        from the wave tallies via their refusal class), padded session
+        lanes point at unallocated rows whose no-member walk is a
+        masked no-op, and the result trims back to the caller's shape.
+        The allocated pad agent rows recycle with the rest of the wave
+        (every wave row is dead after the wave). Journaled, so WAL
+        replay re-dispatches the identical padded program.
+
         Resilience: the fault-injection gate (`_chaos`) runs BEFORE
         anything mutates, so an injected raise is retry-safe.
         Single-device waves journal to the WAL (op "governance_wave",
@@ -800,6 +822,17 @@ class HypervisorState:
         checkpoint cadence instead (docs/OPERATIONS.md "Recovery &
         fault domains").
         """
+        if pad_to is not None:
+            if mesh is not None:
+                raise ValueError(
+                    "pad_to is the single-device bucket contract; mesh "
+                    "waves pad internally to the mesh size"
+                )
+            if pad_to[0] < len(dids) or pad_to[1] < len(session_slots):
+                raise ValueError(
+                    f"pad_to {pad_to} below the wave shape "
+                    f"({len(dids)} lanes, {len(session_slots)} sessions)"
+                )
         self._predispatch("governance_wave", fused_sanitizer=mesh is None)
         if mesh is not None or self.journal is None:
             return self._governance_wave_impl(
@@ -807,6 +840,7 @@ class HypervisorState:
                 delta_bodies, now=now, omega=omega,
                 trustworthy=trustworthy, use_pallas=use_pallas, mesh=mesh,
                 actions=actions, defer_reconcile=defer_reconcile,
+                pad_to=pad_to,
             )
         act = None if actions is None else self._normalize_actions(actions)
         with self._journal(
@@ -824,12 +858,14 @@ class HypervisorState:
             ),
             use_pallas=use_pallas,
             actions=act,
+            pad_to=None if pad_to is None else list(pad_to),
         ):
             return self._governance_wave_impl(
                 session_slots, dids, agent_sessions, sigma_raw,
                 delta_bodies, now=now, omega=omega,
                 trustworthy=trustworthy, use_pallas=use_pallas, mesh=None,
                 actions=act, defer_reconcile=defer_reconcile,
+                pad_to=pad_to,
             )
 
     def _governance_wave_impl(
@@ -846,6 +882,7 @@ class HypervisorState:
         mesh=None,
         actions: Optional[dict] = None,
         defer_reconcile: bool = False,
+        pad_to: Optional[tuple[int, int]] = None,
     ):
         """`run_governance_wave` body (see its docstring); split out so
         the public entry can bracket it with the WAL txn."""
@@ -853,6 +890,8 @@ class HypervisorState:
         k = len(session_slots)
         b_wave, k_wave = b, k
         parked_sessions = np.zeros((0,), np.int32)
+        if pad_to is not None:
+            b_wave, k_wave = int(pad_to[0]), int(pad_to[1])
         if mesh is not None:
             d = mesh.devices.size
             e_cap = self.vouches.voucher.shape[0]
@@ -885,15 +924,56 @@ class HypervisorState:
                     dtype=np.int32,
                 )
         else:
-            if self._next_agent_slot + b > self.agents.did.shape[0]:
-                raise RuntimeError(
-                    f"agent table full: {self._next_agent_slot} + {b} > "
-                    f"{self.agents.did.shape[0]}; raise config.capacity.max_agents"
+            # Bucket padding (serving): pad lanes claim rows like real
+            # ones — all of a single-device wave's rows recycle through
+            # the free list after the wave, so the claim is transient —
+            # and pad sessions park on unallocated rows exactly like
+            # the mesh path's ragged lanes.
+            #
+            # Rows come from the bump allocator while it lasts, then
+            # from the FREE LIST: wave rows are dead after the wave
+            # (their sessions terminate in-program) and recycle below,
+            # so a continuously-serving deployment reuses them instead
+            # of exhausting the table in minutes (the serving soak
+            # found exactly that). Fresh-first keeps short-lived
+            # states on the historical row layout; free-list order is
+            # deterministic per op sequence, so WAL replay allocates
+            # the identical rows. The staging lock guards both cursors
+            # against concurrent producers.
+            with self._enqueue_lock:
+                cap = self.agents.did.shape[0]
+                fresh_n = min(b_wave, cap - self._next_agent_slot)
+                free = self._free_agent_slots
+                need = b_wave - fresh_n
+                if need > len(free):
+                    raise RuntimeError(
+                        f"agent table full: {self._next_agent_slot} + "
+                        f"{b_wave} > {cap} with {len(free)} free rows; "
+                        "raise config.capacity.max_agents"
+                    )
+                fresh = list(
+                    range(
+                        self._next_agent_slot,
+                        self._next_agent_slot + fresh_n,
+                    )
                 )
-            agent_slots = np.arange(
-                self._next_agent_slot, self._next_agent_slot + b, dtype=np.int32
-            )
-            self._next_agent_slot += b
+                self._next_agent_slot += fresh_n
+                recycled = [free.pop() for _ in range(need)]
+            agent_slots = np.array(fresh + recycled, np.int32)
+            if k_wave != k:
+                s_cap = self.sessions.sid.shape[0]
+                n_parked = k_wave - k
+                if self._next_session_slot + n_parked > s_cap:
+                    raise RuntimeError(
+                        f"no spare session rows to park {n_parked} padded "
+                        f"bucket lanes ({self._next_session_slot}+{n_parked}"
+                        f" > {s_cap}); raise config.capacity.max_sessions"
+                    )
+                parked_sessions = np.arange(
+                    self._next_session_slot,
+                    self._next_session_slot + n_parked,
+                    dtype=np.int32,
+                )
         handles = np.array([self.agent_ids.intern(d) for d in dids], np.int32)
         wave_keys = _mkeys(agent_sessions, handles)
         members = self._members
@@ -1032,18 +1112,6 @@ class HypervisorState:
             else:
                 with self.metrics.stage("governance_wave_sharded"):
                     result, partials = wave_fn(*wave_args, *range_args)
-            if b_wave != b or k_wave != k:
-                # Drop the internal padding lanes before any host
-                # bookkeeping: callers see exactly their request shape.
-                result = result._replace(
-                    status=result.status[:b],
-                    ring=result.ring[:b],
-                    sigma_eff=result.sigma_eff[:b],
-                    saga_step_state=result.saga_step_state[:b],
-                    merkle_root=result.merkle_root[:k],
-                    chain=result.chain[:, :k],
-                    fsm_error=result.fsm_error[:k],
-                )
         else:
             # ── the fused single-device program (round 9): governance
             # + gateway + control-plane epilogue as ONE dispatch with
@@ -1094,6 +1162,19 @@ class HypervisorState:
                     sanitize=sanitize,
                     config=self.config,
                     cache_salt=_DONATION_CACHE_SALT if donated else 0.0,
+                    # Bucket padding (serving): the valid operands are
+                    # TRACED (array scalars/masks), so every bucket
+                    # shape compiles once and serves any fill level.
+                    **(
+                        {
+                            "lanes_valid": jnp.asarray(
+                                np.arange(b_wave) < b
+                            ),
+                            "n_sessions_valid": jnp.asarray(k, jnp.int32),
+                        }
+                        if pad_to is not None
+                        else {}
+                    ),
                 )
             self.metrics.commit(result.metrics)
             self.tracer.end_wave(th, result.trace)
@@ -1109,6 +1190,19 @@ class HypervisorState:
                 gw_result = self._gateway_result_from_lanes(
                     result.gateway, result.agents, len(act["slots"])
                 )
+        if b_wave != b or k_wave != k:
+            # Drop the internal padding lanes (mesh raggedness or the
+            # serving scheduler's bucket padding) before any host
+            # bookkeeping: callers see exactly their request shape.
+            result = result._replace(
+                status=result.status[:b],
+                ring=result.ring[:b],
+                sigma_eff=result.sigma_eff[:b],
+                saga_step_state=result.saga_step_state[:b],
+                merkle_root=result.merkle_root[:k],
+                chain=result.chain[:, :k],
+                fsm_error=result.fsm_error[:k],
+            )
         self.agents = result.agents
         self.sessions = result.sessions
         self.vouches = result.vouches
@@ -1169,7 +1263,10 @@ class HypervisorState:
         # through their own deterministic top-region layout instead
         # of the general free list (see _mesh_wave_slots).
         if mesh is None:
-            self._free_agent_slots.extend(np.asarray(agent_slots).tolist())
+            with self._enqueue_lock:
+                self._free_agent_slots.extend(
+                    np.asarray(agent_slots).tolist()
+                )
 
         # Record the wave's audit chain in the DeltaLog (lane-major).
         # COPY, not view: slices of this array outlive the wave
@@ -1401,12 +1498,26 @@ class HypervisorState:
                 self._pending_rows[agent_slot] = (did, session_slot, duplicate)
         return q
 
-    def flush_joins(self, now: float = 0.0) -> np.ndarray:
+    def flush_joins(
+        self, now: float = 0.0, pad_to: Optional[int] = None
+    ) -> np.ndarray:
         """Run the jitted admission wave; returns i8[B] status codes.
 
         Statuses are in HARVEST order (the queue's atomic claim order),
         which under concurrent staging may differ from call order; callers
-        correlate by agent slot or by membership (`is_member`).
+        correlate by agent slot or by membership (`is_member`), or by the
+        per-flush `last_join_results` map ((session<<32)|did membership
+        key -> status code) the serving front door reads to resolve its
+        tickets.
+
+        `pad_to` pads the wave to a FIXED bucket shape (the serving
+        scheduler's closed bucket set, so the jit cache stays warm
+        across an open workload): pad lanes ride `duplicate=True` —
+        refused without touching their rows, exactly the mesh path's
+        ragged-lane contract — and a `valid` mask keeps them out of the
+        admitted/refused counters. Must be >= the staged count; the
+        padded shape is journaled so WAL replay re-dispatches the same
+        program.
 
         The whole flush holds the staging lock: the harvest must not swap
         the epoch under a mid-push producer, and the table
@@ -1419,7 +1530,9 @@ class HypervisorState:
         retry flushes the same wave.
         """
         self._predispatch("admission_wave")
-        with self._enqueue_lock, self._journal("flush_joins", now=float(now)):
+        with self._enqueue_lock, self._journal(
+            "flush_joins", now=float(now), pad_to=pad_to
+        ):
             n, sigma, agent_slots, session_slots, trustworthy = (
                 self._queue.harvest()
             )
@@ -1432,9 +1545,37 @@ class HypervisorState:
             dids = np.array([r[1] for r in rows], np.int32)
             duplicate = np.array([r[3] for r in rows], bool)
 
+            valid = None
+            if pad_to is not None:
+                # Even an exactly-full bucket carries the valid mask:
+                # one program family per bucket, not two.
+                if pad_to < n:
+                    raise ValueError(
+                        f"flush_joins pad_to={pad_to} below the staged "
+                        f"wave size {n}; the serving scheduler must cap "
+                        "staging at the largest bucket"
+                    )
+
+                def pad_arr(arr, dtype, fill):
+                    out = np.full((pad_to,), fill, dtype)
+                    out[:n] = np.asarray(arr, dtype)
+                    return out
+
+                # Pad lanes: duplicate=True refuses them in-wave without
+                # touching any row (rejected lanes scatter out of bounds
+                # and drop); session 0 only feeds masked gathers.
+                sigma = pad_arr(sigma, np.float32, 0.0)
+                agent_slots = pad_arr(agent_slots, np.int32, 0)
+                session_slots = pad_arr(session_slots, np.int32, 0)
+                trustworthy = pad_arr(trustworthy, np.uint8, 0)
+                dids = pad_arr(dids, np.int32, -1)
+                duplicate = pad_arr(duplicate, bool, True)
+                valid = np.zeros((pad_to,), bool)
+                valid[:n] = True
+
             th = self.tracer.begin_wave(
                 "admission_wave",
-                sessions=np.unique(np.asarray(session_slots, np.int64)),
+                sessions=np.unique(np.asarray(session_slots[:n], np.int64)),
                 lanes=n,
             )
             donated = _donate_tables()
@@ -1468,6 +1609,7 @@ class HypervisorState:
                         if donated
                         else {}
                     ),
+                    **({"valid": jnp.asarray(valid)} if valid is not None else {}),
                 )
             self.metrics.commit(result.metrics)
             self.tracer.end_wave(th, result.trace)
@@ -1475,7 +1617,10 @@ class HypervisorState:
                 _poison_donated(*poison)
             self.agents = result.agents
             self.sessions = result.sessions
-            status = np.asarray(result.status)
+            # Pad lanes (bucketed serving waves) drop here: callers see
+            # exactly the harvested wave.
+            status = np.asarray(result.status)[:n]
+            flush_results: dict[int, int] = {}
             for (slot, did, sess, dup), st in zip(rows, status):
                 if not dup:
                     self._staged_members.discard(_mkey(sess, did))
@@ -1485,6 +1630,16 @@ class HypervisorState:
                 else:
                     # A rejected join leaves no trace; its row is reusable.
                     self._free_agent_slots.append(slot)
+                key = _mkey(sess, did)
+                # Best-status wins on a same-wave duplicate pair: the
+                # membership key IS admitted, and the front door refuses
+                # duplicates pre-stage anyway.
+                prev = flush_results.get(key)
+                if prev is None or st < prev:
+                    flush_results[key] = int(st)
+            # Serving correlation hook: the front door resolves its join
+            # tickets from the LAST flush's per-membership statuses.
+            self.last_join_results = flush_results
         return status
 
     # ── vouch edges ──────────────────────────────────────────────────
@@ -3031,6 +3186,8 @@ class HypervisorState:
         session_slots: Sequence[int],
         now: float = 0.0,
         use_pallas: bool | None = None,
+        pad_to: Optional[int] = None,
+        pad_slot: Optional[int] = None,
     ) -> np.ndarray:
         """Terminate a wave of sessions; returns u32[K, 8] Merkle roots.
 
@@ -3043,6 +3200,14 @@ class HypervisorState:
         rows' final values stay readable until reused (forensics), and
         the audit index keeps the sessions' Merkle leaves.
 
+        `pad_to` pads the wave to a fixed bucket shape (the serving
+        scheduler's closed set) by repeating `pad_slot` — a dedicated
+        memberless park session the front door owns. Re-archiving the
+        park row is an idempotent masked write (no members, no edges,
+        no audit rows), and the returned roots trim back to the
+        caller's K. The padded slot list is journaled, so WAL replay
+        re-dispatches the identical program.
+
         Terminations are NEVER shed: a degraded plane keeps draining
         live work (`resilience.policy`). The fault-injection gate runs
         before any mutation; the wave journals as "terminate_sessions".
@@ -3051,6 +3216,17 @@ class HypervisorState:
         k = len(slots)
         if k == 0:
             return np.zeros((0, 8), np.uint32)
+        if pad_to is not None and pad_to != k:
+            if pad_to < k:
+                raise ValueError(
+                    f"terminate pad_to={pad_to} below the wave size {k}"
+                )
+            if pad_slot is None:
+                raise ValueError(
+                    "terminate pad_to requires pad_slot (the serving "
+                    "front door's park session)"
+                )
+            slots = slots + [int(pad_slot)] * (pad_to - k)
         self._predispatch("terminate_wave")
         with self._journal(
             "terminate_sessions",
@@ -3058,7 +3234,7 @@ class HypervisorState:
             now=float(now),
             use_pallas=use_pallas,
         ):
-            return self._terminate_sessions_impl(slots, now, use_pallas)
+            return self._terminate_sessions_impl(slots, now, use_pallas)[:k]
 
     def _terminate_sessions_impl(
         self,
@@ -3282,6 +3458,10 @@ class HypervisorState:
             # Integrity panel (hv_top renders this block): sanitizer
             # cadence/violations, scrub progress, last repair/restore.
             "integrity": self.integrity_summary(),
+            # Serving panel (hv_top renders this block): per-queue
+            # depth/backpressure, shed rates, deadline misses, wave
+            # cadence and bucket fill.
+            "serving": self.serving_summary(),
         }
 
     def memory_summary(self) -> dict:
@@ -3305,6 +3485,15 @@ class HypervisorState:
     def compile_summary(self) -> dict:
         """The `GET /debug/compiles` payload (process-global watch)."""
         return health_plane.compile_summary()
+
+    def serving_summary(self) -> dict:
+        """The `GET /debug/serving` payload: queue depths/backpressure,
+        shed accounting by reason, deadline misses, wave cadence, and
+        the bucket set — the bare plane state when no
+        `serving.FrontDoor` is attached."""
+        if self.serving is not None:
+            return self.serving.summary()
+        return {"enabled": False}
 
     def integrity_summary(self) -> dict:
         """The `GET /debug/integrity` payload: sanitizer cadence,

@@ -55,6 +55,39 @@ class DeltaLog:
             cursor=self.cursor + b,
         )
 
+    def append_batch_prefix(
+        self,
+        bodies: jnp.ndarray,    # u32[B, BODY_WORDS]
+        digests: jnp.ndarray,   # u32[B, 8]
+        sessions: jnp.ndarray,  # i32[B]
+        turns: jnp.ndarray,     # i32[B]
+        n_live: jnp.ndarray,    # i32[] records actually appended (prefix)
+    ) -> "DeltaLog":
+        """Append the first `n_live` of B records at the cursor.
+
+        The serving scheduler's bucket-padded governance wave stages a
+        fixed-shape [B] batch whose tail lanes are padding; appending
+        them would stamp parked-session rows into the ring (churning
+        capacity and breaking the per-session turn-chain invariant on
+        park-row reuse). Rows past `n_live` scatter out of bounds and
+        drop; the cursor advances by exactly `n_live`, so the ring is
+        bit-identical to an unpadded append of the live prefix.
+        """
+        capacity = self.body.shape[0]
+        b = bodies.shape[0]
+        pos = jnp.arange(b, dtype=jnp.int32)
+        idx = jnp.where(
+            pos < n_live, (self.cursor + pos) % capacity, capacity + pos
+        )
+        drop = dict(mode="drop")
+        return DeltaLog(
+            body=self.body.at[idx].set(bodies, **drop),
+            digest=self.digest.at[idx].set(digests, **drop),
+            session=self.session.at[idx].set(sessions, **drop),
+            turn=self.turn.at[idx].set(turns, **drop),
+            cursor=self.cursor + n_live,
+        )
+
     @property
     def capacity_rows(self) -> int:
         """Ring row capacity — THE capacity rule for this log, shared

@@ -40,6 +40,12 @@
 #      TPU plugin is absent/wedged — exit 75 = skip, never a failure)
 #      and hold its dispatch-bearing ENTRY steps to the committed
 #      trajectory row + the >=2x r09 fusion-ratio floor,
+#   6d. a serving soak smoke gate — a short seeded open-workload burst
+#      through the serving front door must hold p99 under the smoke
+#      SLO with zero invariant violations and ZERO post-warmup
+#      recompiles (the closed-bucket contract), and replay the same
+#      trace + seed to identical admission/shed decisions and chain
+#      heads,
 #   7. a crash-recovery smoke gate — drive real traffic in a child
 #      process with a WAL + watermarked checkpoint, SIGKILL it
 #      mid-flight, recover from checkpoint + WAL replay, and assert
@@ -508,6 +514,63 @@ else
     echo "dispatch census FAILED to run (rc=$census_rc)" >&2
 fi
 
+echo "── serving soak smoke gate ──"
+JAX_PLATFORMS=cpu python - <<'PY'
+# Round-11 acceptance, smoke-sized: a short seeded open-workload burst
+# through the serving front door must hold p99 under the smoke SLO with
+# ZERO invariant violations and ZERO recompiles after warmup (the
+# bucket set is closed — an open shape escaping the buckets lands here
+# as a recompile), and the same trace + seed must replay to identical
+# admission/shed decisions and chain heads.
+from hypervisor_tpu.serving import (
+    ServingConfig, WorkloadSpec, generate_trace, run_soak,
+)
+
+SLO_MS = 1500.0  # cpu smoke SLO: deadline pacing + cpu wave walls
+                 # + shared-CI contention headroom (non-flaky; a
+                 # recompile storm or de-bucketed scheduler adds
+                 # whole seconds and still fails)
+spec = WorkloadSpec(seed=11, rate_hz=120.0, duration_s=0.6)
+trace = generate_trace(spec)
+cfg = ServingConfig(
+    join_deadline_s=0.25, action_deadline_s=0.25,
+    lifecycle_deadline_s=0.4, terminate_deadline_s=0.5,
+    saga_deadline_s=0.25,
+)
+rep = run_soak(spec, trace=trace, serving_config=cfg, tick_s=0.02,
+               slo_p99_ms=SLO_MS)
+assert rep["served"] > 0, "soak served nothing"
+assert rep["latency_ms"]["p99"] <= SLO_MS, (
+    f"soak p99 {rep['latency_ms']['p99']} ms over the smoke SLO {SLO_MS}"
+)
+assert rep["recompiles_after_warmup"] == 0, (
+    f"warmed scheduler recompiled {rep['recompiles_after_warmup']}x — "
+    "an open shape escaped the closed bucket set"
+)
+assert rep["compiles_after_warmup"] == 0, (
+    f"warmed scheduler compiled {rep['compiles_after_warmup']} new "
+    "program(s) mid-soak"
+)
+assert rep["invariant_violations"] == 0, (
+    f"{rep['invariant_violations']} invariant violations under soak"
+)
+rep2 = run_soak(spec, trace=trace, serving_config=cfg, tick_s=0.02,
+                slo_p99_ms=SLO_MS)
+assert rep["decisions_digest"] == rep2["decisions_digest"], (
+    "soak admission/shed decisions not seed-replayable"
+)
+assert rep["chain_heads_digest"] == rep2["chain_heads_digest"], (
+    "soak chain heads diverge across a seeded replay"
+)
+print(
+    f"serving soak OK: {rep['served']} served at "
+    f"{spec.rate_hz:.0f} Hz, p99 {rep['latency_ms']['p99']} ms "
+    f"(SLO {SLO_MS:.0f}), shed rate {rep['shed_rate']}, zero "
+    "post-warmup recompiles, zero violations, replay-deterministic"
+)
+PY
+soak_rc=$?
+
 echo "── crash-recovery smoke gate ──"
 JAX_PLATFORMS=cpu python scripts/crash_recovery_smoke.py
 crash_rc=$?
@@ -551,6 +614,10 @@ fi
 if [ "$census_rc" -ne 0 ]; then
     echo "dispatch-census gate FAILED (rc=$census_rc)" >&2
     exit "$census_rc"
+fi
+if [ "$soak_rc" -ne 0 ]; then
+    echo "serving soak smoke gate FAILED (rc=$soak_rc)" >&2
+    exit "$soak_rc"
 fi
 if [ "$crash_rc" -ne 0 ]; then
     echo "crash-recovery smoke gate FAILED (rc=$crash_rc)" >&2

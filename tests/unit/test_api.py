@@ -186,11 +186,21 @@ class TestHTTPTransport:
         # (/metrics), the flight recorder (/trace/{session_id} +
         # /debug/flight), the health plane (/debug/health,
         # /debug/memory, /debug/compiles), the resilience plane
-        # (/debug/resilience), and the integrity plane
-        # (/debug/integrity): 38 routes.
-        assert len(ROUTES) == 38
+        # (/debug/resilience), the integrity plane
+        # (/debug/integrity), and the serving front door
+        # (/debug/serving, the batched join-wave, the NDJSON stream):
+        # 41 routes.
+        assert len(ROUTES) == 41
         assert any(path == "/debug/resilience" for _, path, _, _ in ROUTES)
         assert any(path == "/debug/integrity" for _, path, _, _ in ROUTES)
+        assert any(path == "/debug/serving" for _, path, _, _ in ROUTES)
+        assert any(
+            path == "/api/v1/sessions/{session_id}/join-wave"
+            for _, path, _, _ in ROUTES
+        )
+        assert any(
+            path == "/api/v1/serving/stream" for _, path, _, _ in ROUTES
+        )
         assert any(path == "/api/v1/device/stats" for _, path, _, _ in ROUTES)
         assert any(
             path == "/api/v1/security/quarantines" for _, path, _, _ in ROUTES
@@ -494,3 +504,195 @@ async def test_action_wave_endpoint_settles_in_order(svc):
             "nope", M.ActionWaveRequest(requests=[])
         )
     assert e.value.status == 404
+
+
+# ── Serving front door (round 11) ────────────────────────────────────
+
+
+class TestServingEndpoints:
+    async def test_shed_maps_to_429_with_retry_hint(self, svc):
+        """A DegradedModeRefusal raised during a join is backpressure:
+        429 + a Retry-After hint, never a 400/500."""
+        from hypervisor_tpu.resilience.policy import DegradedPolicy
+
+        sid = await _make_session(svc)
+        svc.hv.state.degraded_policy = DegradedPolicy(reason="drill")
+        try:
+            with pytest.raises(ApiError) as e:
+                await svc.join_session(
+                    sid, M.JoinSessionRequest(agent_did="did:shed", sigma_raw=0.9)
+                )
+            assert e.value.status == 429
+            assert e.value.retry_after_s and e.value.retry_after_s > 0
+        finally:
+            svc.hv.state.degraded_policy = None
+
+    async def test_sybil_shed_maps_to_429(self, svc):
+        from hypervisor_tpu.resilience.policy import DegradedPolicy
+
+        sid = await _make_session(svc)
+        svc.hv.state.degraded_policy = DegradedPolicy(
+            shed_admissions=False,
+            pause_saga_fanout=False,
+            admission_sigma_floor=0.5,
+            reason="damper drill",
+        )
+        try:
+            with pytest.raises(ApiError) as e:
+                await svc.join_session(
+                    sid, M.JoinSessionRequest(agent_did="did:low", sigma_raw=0.2)
+                )
+            assert e.value.status == 429
+            # Honest joins above the floor still flow.
+            out = await svc.join_session(
+                sid, M.JoinSessionRequest(agent_did="did:hi", sigma_raw=0.9)
+            )
+            assert out.assigned_ring in (0, 1, 2, 3)
+        finally:
+            svc.hv.state.degraded_policy = None
+
+    async def test_join_wave_batches_and_returns_typed_refusals(self, svc):
+        from hypervisor_tpu.resilience.policy import DegradedPolicy
+
+        sid = await _make_session(svc, max_participants=32)
+        resp = await svc.join_wave(
+            sid,
+            M.JoinWaveRequest(
+                joins=[
+                    {"agent_did": f"did:jw{i}", "sigma_raw": 0.8}
+                    for i in range(3)
+                ]
+            ),
+        )
+        d = resp.model_dump()
+        assert [lane["admitted"] for lane in d["lanes"]] == [True] * 3
+        assert d["wave"]["lanes"] == 3
+        # Host SSO mirrored (facade coherence).
+        detail = await svc.get_session(sid)
+        assert detail.participant_count == 3
+        # Per-lane refusals under a shed policy, never a raised 429.
+        svc.hv.state.degraded_policy = DegradedPolicy(reason="drill")
+        try:
+            resp = await svc.join_wave(
+                sid,
+                M.JoinWaveRequest(
+                    joins=[{"agent_did": "did:jw-shed", "sigma_raw": 0.8}]
+                ),
+            )
+            lane = resp.model_dump()["lanes"][0]
+            assert not lane["admitted"]
+            assert lane["refusal"]["kind"] == "degraded"
+            assert lane["retry_after_s"] > 0
+        finally:
+            svc.hv.state.degraded_policy = None
+
+    async def test_join_wave_validates_lanes(self, svc):
+        sid = await _make_session(svc)
+        with pytest.raises(ApiError) as e:
+            await svc.join_wave(sid, M.JoinWaveRequest(joins=[]))
+        assert e.value.status == 422
+        with pytest.raises(ApiError) as e:
+            await svc.join_wave(
+                sid,
+                M.JoinWaveRequest(
+                    joins=[{"agent_did": "did:nan", "sigma_raw": float("nan")}]
+                ),
+            )
+        assert e.value.status == 422
+
+    async def test_debug_serving_payload(self, svc):
+        out = await svc.debug_serving()
+        assert out == {"enabled": False}
+        svc.hv.attach_front_door()
+        out = await svc.debug_serving()
+        assert out["enabled"] and set(out["queues"]) == {
+            "join", "action", "lifecycle", "terminate", "saga",
+        }
+
+    def test_http_429_carries_retry_after_header(self):
+        """Stdlib transport: shed -> HTTP 429 + Retry-After header."""
+        from hypervisor_tpu.resilience.policy import DegradedPolicy
+
+        server = HypervisorHTTPServer().start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            data = json.dumps({"creator_did": "did:admin"}).encode()
+            req = urllib.request.Request(
+                f"{base}/api/v1/sessions", data=data, method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                sid = json.loads(resp.read())["session_id"]
+            server.service.hv.state.degraded_policy = DegradedPolicy(
+                reason="http drill"
+            )
+            req = urllib.request.Request(
+                f"{base}/api/v1/sessions/{sid}/join",
+                data=json.dumps(
+                    {"agent_did": "did:x", "sigma_raw": 0.9}
+                ).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req)
+                raise AssertionError("expected HTTP 429")
+            except urllib.error.HTTPError as e:
+                assert e.code == 429
+                assert int(e.headers["Retry-After"]) >= 1
+                assert "shed" in json.loads(e.read())["detail"]
+            server.service.hv.state.degraded_policy = None
+        finally:
+            server.service.hv.state.degraded_policy = None
+            server.stop()
+
+    def test_fastapi_429_carries_retry_after_header(self):
+        """FastAPI transport twin of the stdlib 429 mapping."""
+        fastapi = pytest.importorskip("fastapi")  # noqa: F841
+        from fastapi.testclient import TestClient
+
+        from hypervisor_tpu.api.server import create_app
+        from hypervisor_tpu.resilience.policy import DegradedPolicy
+
+        app = create_app()
+        client = TestClient(app)
+        sid = client.post(
+            "/api/v1/sessions", json={"creator_did": "did:admin"}
+        ).json()["session_id"]
+        app.state.service.hv.state.degraded_policy = DegradedPolicy(
+            reason="fastapi drill"
+        )
+        try:
+            resp = client.post(
+                f"/api/v1/sessions/{sid}/join",
+                json={"agent_did": "did:x", "sigma_raw": 0.9},
+            )
+            assert resp.status_code == 429
+            assert int(resp.headers["retry-after"]) >= 1
+        finally:
+            app.state.service.hv.state.degraded_policy = None
+
+    def test_http_serving_stream_ndjson(self):
+        server = HypervisorHTTPServer().start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with urllib.request.urlopen(
+                f"{base}/api/v1/serving/stream?frames=3"
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"] == "application/x-ndjson"
+                frames = [
+                    json.loads(line)
+                    for line in resp.read().decode().strip().splitlines()
+                ]
+            assert len(frames) == 3
+            assert [f["frame"] for f in frames] == [0, 1, 2]
+            assert "serving" in frames[0]
+            with urllib.request.urlopen(
+                f"{base}/api/v1/serving/stream?frames=bogus"
+            ) as resp:
+                raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        finally:
+            server.stop()

@@ -578,6 +578,22 @@ def _suite_report(
             "fusion_ratio": 2.18,
             "r09_baseline_dispatch": 322,
         },
+        # Rounds >= regression.SOAK_ROW_SINCE must carry the serving
+        # soak row (round-11 presence gate).
+        "soak": {
+            "seed": 11,
+            "arrival_rate_hz": 150.0,
+            "offered": {"total": 300},
+            "served": 290,
+            "goodput_ops_s": 80.0,
+            "goodput_ratio": 0.96,
+            "shed_rate": 0.01,
+            "latency_ms": {"p50": 200.0, "p99": 700.0},
+            "slo_p99_ms": 1000.0,
+            "deadline_misses": 3,
+            "recompiles_after_warmup": 0,
+            "invariant_violations": 0,
+        },
     }
 
 
@@ -734,6 +750,58 @@ class TestRegressionHarness:
         self._write(tmp_path, 10, doc)
         rc = regression.main(["--root", str(tmp_path), "--quiet"])
         assert rc == 1
+
+    def test_missing_soak_row_fails_from_round_11(self, tmp_path):
+        # ISSUE 10: the serving soak row is REQUIRED from round 11 —
+        # dropping it regresses serving coverage.
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 10, _suite_report(10, {"full_governance_pipeline": 10.0})
+        )
+        doc = _suite_report(11, {"full_governance_pipeline": 10.0})
+        del doc["soak"]
+        self._write(tmp_path, 11, doc)
+        rc = regression.main(["--root", str(tmp_path), "--quiet"])
+        assert rc == 1
+
+    def test_soak_gates_slo_goodput_and_hard_zeros(self, tmp_path):
+        # The soak row gates: p99 vs its own stated SLO, the goodput
+        # floor, and the zero-recompile / zero-violation contract.
+        from benchmarks import regression
+
+        def soak_round(round_no, **patch):
+            doc = _suite_report(
+                round_no, {"full_governance_pipeline": 10.0}
+            )
+            doc["soak"].update(patch)
+            return doc
+
+        self._write(tmp_path, 11, soak_round(11))
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
+        # p99 over the stated SLO fails.
+        self._write(
+            tmp_path, 12,
+            soak_round(12, latency_ms={"p50": 200.0, "p99": 1500.0}),
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # goodput collapse fails.
+        self._write(tmp_path, 12, soak_round(12, goodput_ratio=0.2))
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # ONE post-warmup recompile fails (an open shape escaped the
+        # closed bucket set).
+        self._write(
+            tmp_path, 12, soak_round(12, recompiles_after_warmup=1)
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # invariant violations under soak fail.
+        self._write(
+            tmp_path, 12, soak_round(12, invariant_violations=2)
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # A clean round 12 passes again.
+        self._write(tmp_path, 12, soak_round(12))
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
 
     def test_next_round_path_advances(self, tmp_path):
         from benchmarks import regression

@@ -1,0 +1,364 @@
+"""Serving front door: ingestion queues, typed refusals, bucketed
+waves, the zero-recompile contract, and soak replay determinism.
+
+The load-bearing pins (ISSUE 10 acceptance):
+  * a warmed scheduler holds ZERO recompiles across a 1k-wave seeded
+    soak (the bucket set is closed — compile-telemetry-asserted),
+  * the same trace + seed replays to identical admission/shed
+    decisions and identical Merkle chain heads,
+  * overload sheds surface as typed refusals (and HTTP 429 with a
+    Retry-After hint on both transports — `test_api.py` covers the
+    transport side).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.observability import health as health_plane
+from hypervisor_tpu.observability import metrics as mp
+from hypervisor_tpu.resilience.policy import DegradedPolicy
+from hypervisor_tpu.serving import (
+    FrontDoor,
+    Refusal,
+    ServingConfig,
+    Ticket,
+    WaveScheduler,
+    WorkloadSpec,
+    generate_trace,
+    load_trace,
+    run_soak,
+    save_trace,
+)
+from hypervisor_tpu.state import HypervisorState
+
+
+def small_state(**caps) -> HypervisorState:
+    """A HypervisorState with small tables (fast waves, fast compiles)."""
+    defaults = dict(
+        max_agents=512,
+        max_sessions=2048,
+        max_vouch_edges=1024,
+        max_sagas=256,
+        delta_log_capacity=4096,
+        event_log_capacity=1024,
+        trace_log_capacity=1024,
+    )
+    defaults.update(caps)
+    cfg = dataclasses.replace(
+        DEFAULT_CONFIG,
+        capacity=dataclasses.replace(DEFAULT_CONFIG.capacity, **defaults),
+    )
+    return HypervisorState(cfg)
+
+
+@pytest.fixture
+def served():
+    state = small_state()
+    front = FrontDoor(state, ServingConfig(buckets=(4, 8)))
+    return state, front, WaveScheduler(front)
+
+
+class TestFrontDoorQueues:
+    def test_submit_join_returns_ticket_and_wave_resolves_it(self, served):
+        state, front, sched = served
+        slot = state.create_session("s", SessionConfig(min_sigma_eff=0.0), now=0.0)
+        out = front.submit_join(slot, "did:a", 0.8, now=0.0)
+        assert isinstance(out, Ticket) and not out.refused
+        assert front.queue_depths()["join"] == 1
+        # Not due yet (deadline ahead): no wave.
+        report = sched.tick(now=0.0)
+        assert report["join"] == 0 and not out.done
+        # Past the deadline: the wave dispatches padded to a bucket.
+        report = sched.tick(now=0.0 + front.config.join_deadline_s + 0.001)
+        assert report["join"] == 1
+        assert out.done and out.ok and out.status == 0
+        assert out.latency_s is not None and out.latency_s > 0
+        assert state.is_member(slot, "did:a")
+        assert front.last_wave["join"] == {
+            "lanes": 1, "bucket": 4, "fill_pct": 25.0,
+        }
+
+    def test_bucket_fill_dispatches_without_deadline(self, served):
+        state, front, sched = served
+        slot = state.create_session(
+            "s", SessionConfig(min_sigma_eff=0.0, max_participants=64),
+            now=0.0,
+        )
+        for i in range(front.config.max_bucket):
+            front.submit_join(slot, f"did:fill{i}", 0.8, now=0.0)
+        report = sched.tick(now=0.0)  # deadline NOT reached
+        assert report["join"] == 1
+        assert front.last_wave["join"]["fill_pct"] == 100.0
+
+    def test_join_queue_full_is_typed_backpressure(self, served):
+        state, front, sched = served
+        slot = state.create_session(
+            "s", SessionConfig(min_sigma_eff=0.0, max_participants=64),
+            now=0.0,
+        )
+        for i in range(front.config.join_queue_depth):
+            assert not front.submit_join(slot, f"did:q{i}", 0.8, now=0.0).refused
+        out = front.submit_join(slot, "did:overflow", 0.8, now=0.0)
+        assert isinstance(out, Refusal)
+        assert out.kind == "queue_full"
+        assert out.retry_after_s > 0
+        assert front.shed["queue_full"] == 1
+
+    def test_degraded_policy_sheds_joins_but_not_terminations(self, served):
+        state, front, sched = served
+        slot = state.create_session("s", SessionConfig(min_sigma_eff=0.0), now=0.0)
+        state.degraded_policy = DegradedPolicy(reason="drill")
+        out = front.submit_join(slot, "did:shed", 0.8, now=0.0)
+        assert isinstance(out, Refusal) and out.kind == "degraded"
+        lc = front.submit_lifecycle("lc", "did:lc", 0.8, now=0.0)
+        assert isinstance(lc, Refusal) and lc.kind == "degraded"
+        # Terminations and saga settles always flow.
+        term = front.submit_terminate(slot, now=0.0)
+        assert isinstance(term, Ticket)
+        state.degraded_policy = None
+        assert front.shed["degraded"] == 2
+
+    def test_sybil_floor_sheds_low_sigma_only(self, served):
+        state, front, sched = served
+        slot = state.create_session(
+            "s", SessionConfig(min_sigma_eff=0.0, max_participants=64),
+            now=0.0,
+        )
+        state.degraded_policy = DegradedPolicy(
+            shed_admissions=False,
+            pause_saga_fanout=False,
+            admission_sigma_floor=0.5,
+            reason="damper drill",
+        )
+        low = front.submit_lifecycle("lc2", "did:low", 0.2, now=0.0)
+        assert isinstance(low, Refusal) and low.kind == "sybil_damped"
+        high = front.submit_join(slot, "did:high", 0.9, now=0.0)
+        assert isinstance(high, Ticket)
+        state.degraded_policy = None
+
+    def test_duplicate_join_refused_before_staging(self, served):
+        state, front, sched = served
+        slot = state.create_session("s", SessionConfig(min_sigma_eff=0.0), now=0.0)
+        front.submit_join(slot, "did:dup", 0.8, now=0.0)
+        out = front.submit_join(slot, "did:dup", 0.8, now=0.0)
+        assert isinstance(out, Refusal) and out.kind == "duplicate"
+
+    def test_serving_metrics_reach_the_plane(self, served):
+        state, front, sched = served
+        slot = state.create_session("s", SessionConfig(min_sigma_eff=0.0), now=0.0)
+        front.submit_join(slot, "did:m", 0.8, now=0.0)
+        sched.drain(now=1.0)
+        text = state.metrics_prometheus()
+        assert 'hv_serving_enqueued_total{queue="join"} 1' in text
+        assert 'hv_serving_served_total{queue="join"} 1' in text
+        assert 'hv_serving_waves_total{queue="join"} 1' in text
+        assert "hv_serving_latency_us_bucket" in text
+        summary = state.serving_summary()
+        assert summary["enabled"] and summary["queues"]["join"]["served"] == 1
+        # The health payload carries the panel hv_top renders.
+        assert state.health_summary()["serving"]["enabled"]
+
+
+class TestBucketedWaveParity:
+    def test_padded_flush_matches_unpadded_and_metrics_stay_honest(self):
+        def drive(pad_to):
+            st = small_state()
+            slot = st.create_session(
+                "s", SessionConfig(min_sigma_eff=0.0, max_participants=16),
+                now=0.0,
+            )
+            for i in range(3):
+                st.enqueue_join(slot, f"did:p{i}", 0.8, now=0.0)
+            status = st.flush_joins(now=0.0, pad_to=pad_to)
+            snap = st.metrics_snapshot()
+            return (
+                status.tolist(),
+                snap.counter(mp.ADMITTED),
+                snap.counter(mp.REFUSED),
+                np.asarray(st.agents.did).tolist(),
+            )
+
+        assert drive(None) == drive(8)
+
+    def test_padded_governance_wave_bit_identical_to_unpadded(self):
+        def drive(pad_to):
+            st = small_state()
+            slots = st.create_sessions_batch(
+                ["a", "b", "c"], SessionConfig(min_sigma_eff=0.0)
+            )
+            rng = np.random.RandomState(3)
+            bodies = rng.randint(
+                0, 2**32, (2, 3, 16), dtype=np.uint64
+            ).astype(np.uint32)
+            r = st.run_governance_wave(
+                slots, ["did:0", "did:1", "did:2"], slots.copy(),
+                np.full(3, 0.8, np.float32), bodies, now=0.0, pad_to=pad_to,
+            )
+            snap = st.metrics_snapshot()
+            return {
+                "status": np.asarray(r.status).tolist(),
+                "chain": {
+                    s: tuple(int(w) for w in v)
+                    for s, v in st._chain_seed.items()
+                },
+                "cursor": int(np.asarray(st.delta_log.cursor)),
+                "ring_sessions": np.asarray(st.delta_log.session).tolist(),
+                "admitted": snap.counter(mp.ADMITTED),
+                "refused": snap.counter(mp.REFUSED),
+                "archived": snap.counter(mp.SESSIONS_ARCHIVED),
+                "saga_committed": snap.counter(mp.SAGA_STEPS_COMMITTED),
+                "saga_failed": snap.counter(mp.SAGA_STEPS_FAILED),
+            }
+
+        assert drive(None) == drive((8, 8))
+
+    def test_padded_terminate_trims_and_park_is_idempotent(self):
+        st = small_state()
+        front = FrontDoor(st, ServingConfig(buckets=(4,)))
+        slot = st.create_session("s", SessionConfig(min_sigma_eff=0.0), now=0.0)
+        st.enqueue_join(slot, "did:t", 0.8, now=0.0)
+        st.flush_joins(now=0.0)
+        park = front.park_slot(0.0)
+        roots = st.terminate_sessions(
+            [slot], now=1.0, pad_to=4, pad_slot=park
+        )
+        assert roots.shape == (1, 8)
+        from hypervisor_tpu.models import SessionState
+
+        assert int(np.asarray(st.sessions.state)[slot]) == SessionState.ARCHIVED.code
+        # Re-padding with the already-archived park row stays legal.
+        slot2 = st.create_session("s2", SessionConfig(min_sigma_eff=0.0), now=2.0)
+        roots2 = st.terminate_sessions(
+            [slot2], now=3.0, pad_to=4, pad_slot=park
+        )
+        assert roots2.shape == (1, 8)
+
+    def test_pad_below_wave_size_refused(self):
+        st = small_state()
+        slot = st.create_session("s", SessionConfig(min_sigma_eff=0.0), now=0.0)
+        for i in range(5):
+            st.enqueue_join(slot, f"did:b{i}", 0.8, now=0.0)
+        with pytest.raises(ValueError, match="below the staged"):
+            st.flush_joins(now=0.0, pad_to=4)
+        with pytest.raises(ValueError, match="below the wave size"):
+            st.terminate_sessions([slot, slot], now=0.0, pad_to=1, pad_slot=0)
+
+    def test_scheduler_bucket_for(self):
+        front = FrontDoor(small_state(), ServingConfig(buckets=(4, 16)))
+        sched = WaveScheduler(front)
+        assert sched.bucket_for(1) == 4
+        assert sched.bucket_for(4) == 4
+        assert sched.bucket_for(5) == 16
+        with pytest.raises(ValueError):
+            sched.bucket_for(17)
+
+
+class TestZeroRecompileSoak:
+    def test_warmed_scheduler_zero_recompiles_across_1k_waves(self):
+        """The ISSUE 10 compile pin: 1000 seeded open-workload waves
+        after warmup — every dispatch shape is in the closed bucket
+        set, so compile telemetry must count ZERO new compiles."""
+        state = small_state()
+        front = FrontDoor(state, ServingConfig(buckets=(4,)))
+        sched = WaveScheduler(front)
+        baseline = sched.warm(now=0.0)
+        rng = np.random.RandomState(11)
+        live: list[int] = []
+        waves = 0
+        i = 0
+        while waves < 1000:
+            now = float(i) * 0.01
+            kind = rng.randint(0, 5)
+            if kind == 0 or not live:
+                front.submit_lifecycle(f"zr:{i}", f"did:zr:{i}", 0.8, now=now)
+            elif kind == 1:
+                slot = state.create_session(
+                    f"zrs:{i}", SessionConfig(min_sigma_eff=0.0), now=now
+                )
+                live.append(slot)
+                front.submit_join(slot, f"did:zrj:{i}", 0.8, now=now)
+            elif kind == 2 and live:
+                row = None
+                for slot in live:
+                    rows = state.agent_rows(f"did:zrj:{slot}")
+                    if rows:
+                        row = rows[0]["slot"]
+                        break
+                if row is not None:
+                    front.submit_action(row, required_ring=2, now=now)
+            elif kind == 3 and live:
+                front.submit_terminate(live.pop(), now=now)
+            else:
+                saga_slot = state.create_saga(
+                    f"zrg:{i}", live[0] if live else 0, [{"has_undo": False}]
+                )
+                front.submit_saga_step(saga_slot, True, now=now)
+            report = sched.tick(now=now + 1.0)  # every deadline due
+            waves += sum(report.values())
+            i += 1
+        summary = health_plane.compile_summary(last=0)
+        assert summary["recompiles"] == baseline["recompiles"], (
+            "warmed scheduler recompiled during the soak"
+        )
+        assert summary["compiles"] == baseline["compiles"], (
+            "warmed scheduler compiled a new program during the soak"
+        )
+        assert waves >= 1000
+
+
+class TestLoadgen:
+    def test_trace_generation_is_seed_deterministic(self):
+        spec = WorkloadSpec(seed=5, rate_hz=100.0, duration_s=0.5)
+        assert generate_trace(spec) == generate_trace(spec)
+        other = WorkloadSpec(seed=6, rate_hz=100.0, duration_s=0.5)
+        assert generate_trace(spec) != generate_trace(other)
+
+    def test_trace_file_round_trip(self, tmp_path):
+        spec = WorkloadSpec(seed=5, rate_hz=100.0, duration_s=0.3)
+        trace = generate_trace(spec)
+        path = save_trace(tmp_path / "trace.jsonl", spec, trace)
+        spec2, trace2 = load_trace(path)
+        assert spec2 == spec
+        assert trace2 == trace
+
+    def test_trace_covers_every_request_class(self):
+        spec = WorkloadSpec(seed=5, rate_hz=300.0, duration_s=1.0)
+        kinds = {e["kind"] for e in generate_trace(spec)}
+        assert kinds >= {
+            "lifecycle", "create", "join", "action", "terminate", "saga",
+        }
+
+    def test_soak_replay_determinism_and_invariants(self):
+        """Same trace + seed -> identical admission/shed decisions AND
+        identical chain heads; zero invariant violations; zero
+        post-warmup recompiles."""
+        spec = WorkloadSpec(seed=9, rate_hz=80.0, duration_s=0.4)
+        trace = generate_trace(spec)
+        cfg = ServingConfig(
+            buckets=(4,),
+            join_deadline_s=0.2, action_deadline_s=0.2,
+            lifecycle_deadline_s=0.3, terminate_deadline_s=0.4,
+            saga_deadline_s=0.2,
+        )
+
+        def soak():
+            return run_soak(
+                spec, trace=trace, state=small_state(),
+                serving_config=cfg, tick_s=0.02, slo_p99_ms=10_000.0,
+            )
+
+        a, b = soak(), soak()
+        assert a["decisions_digest"] == b["decisions_digest"]
+        assert a["chain_heads_digest"] == b["chain_heads_digest"]
+        assert a["served"] == b["served"] and a["shed"] == b["shed"]
+        assert a["recompiles_after_warmup"] == 0
+        assert a["compiles_after_warmup"] == 0
+        assert a["invariant_violations"] == 0
+        assert a["served"] > 0
+        assert a["latency_ms"]["p99"] > 0

@@ -421,23 +421,37 @@ def build_benchmarks(quick: bool):
         0.5,
     )
     wave_jit = jax.jit(
-        governance_wave, static_argnames=("use_pallas", "unique_sessions")
+        governance_wave,
+        static_argnames=("use_pallas", "unique_sessions", "wave_kernels"),
     )
     # Staged OUTSIDE the timed callables: the fast path must not be
     # charged per-iteration device_puts the general path never pays.
     wave_range = (jnp.asarray(0, jnp.int32), jnp.asarray(S, jnp.int32))
 
     def wave_general(*args):
-        return wave_jit(*args).status
+        return wave_jit(*args, wave_kernels=False).status
 
+    # Round 12: the fast path is RE-MEASURED on the megakernel path
+    # (`wave_kernels=True` — Mosaic launches on chip, the numpy twins
+    # out-of-line on cpu/quick rounds); the `_xla` twin row keeps the
+    # pre-megakernel program measurable so the trajectory shows the
+    # delta on whatever backend runs this suite.
     def wave_fastpath(*args):
         return wave_jit(
-            *args, wave_range=wave_range, unique_sessions=True
+            *args, wave_range=wave_range, unique_sessions=True,
+            wave_kernels=True,
+        ).status
+
+    def wave_fastpath_xla(*args):
+        return wave_jit(
+            *args, wave_range=wave_range, unique_sessions=True,
+            wave_kernels=False,
         ).status
 
     wave_args = (wv_agents, wv_sessions, wv_vouches, *wave_cols)
     yield "state_wave_general", wave_general, wave_args, S
     yield "state_wave_fastpath", wave_fastpath, wave_args, S
+    yield "state_wave_fastpath_xla", wave_fastpath_xla, wave_args, S
 
 
 def metrics_plane_report(results: list[dict]) -> dict:
@@ -867,6 +881,143 @@ def soak_benchmark(seed: int, quick: bool) -> dict:
     return report
 
 
+def wave_megakernel_row(
+    quick: bool, iters: int, census_rec: dict | None,
+    plane: dict | None = None,
+) -> dict:
+    """The round-12 `wave_megakernel` bench row: per-block µs/op for
+    every wave-kernel block on the bench wave shape, the armed-vs-
+    reference whole-wave numbers (from the suite's own
+    `state_wave_fastpath` / `_xla` rows when present), and the armed
+    census step structure (cross-referenced from the dispatch-census
+    row). On cpu/quick rounds the blocks execute their numpy twins
+    out-of-line (`mode: cpu-twin`) — chip numbers stay pending while
+    the accelerator tunnel is wedged (the standing caveat).
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from hypervisor_tpu.config import DEFAULT_CONFIG
+    from hypervisor_tpu.kernels.sha256_pallas import pallas_available
+    from hypervisor_tpu.observability import metrics as mp
+    from hypervisor_tpu.ops import merkle as merkle_ops
+    from hypervisor_tpu.ops import wave_blocks
+    from hypervisor_tpu.tables.logs import DeltaLog, EventLog, TraceLog
+    from hypervisor_tpu.tables.state import (
+        AgentTable,
+        ElevationTable,
+        SagaTable,
+        SessionTable,
+        VouchTable,
+    )
+    from hypervisor_tpu.tables.struct import replace as t_replace
+
+    rng = np.random.RandomState(12)
+    S = 2_048 if quick else 10_000
+    A = 1_024
+    iters = max(3, min(iters, 10))
+
+    agents = AgentTable.create(2 * S)
+    sessions = SessionTable.create(2 * S)
+    wvs = jnp.arange(S, dtype=jnp.int32)
+    sessions = t_replace(
+        sessions,
+        state=sessions.state.at[wvs].set(1),
+        max_participants=sessions.max_participants.at[wvs].set(10),
+        min_sigma_eff=sessions.min_sigma_eff.at[wvs].set(0.0),
+    )
+    vouches = VouchTable.create(4096)
+    sagas = SagaTable.create(1024, 8)
+    elevations = ElevationTable.create(4096)
+    delta_log = DeltaLog.create(1 << 16)
+    event_log = EventLog.create(4096)
+    trace_log = TraceLog.create(4096)
+    metrics_table = mp.REGISTRY.create_table()  # noqa: F841 — shape ref
+    bodies = jnp.asarray(
+        rng.randint(0, 2**32, (3, S, merkle_ops.BODY_WORDS), dtype=np.uint64
+                    ).astype(np.uint32)
+    )
+    bursts = jnp.asarray(DEFAULT_CONFIG.rate_limit.ring_bursts, jnp.float32)
+    trust = DEFAULT_CONFIG.trust
+    wave_range = (jnp.int32(0), jnp.int32(S))
+    zeros_f = jnp.zeros((S,), jnp.float32)
+    ones_b = jnp.ones((S,), bool)
+
+    def adm(a, s):
+        return wave_blocks.admission_block(
+            a, s, wvs, wvs, wvs, jnp.full((S,), 0.8, jnp.float32),
+            zeros_f, jnp.float32(0.5), ones_b, jnp.zeros((S,), bool),
+            jnp.float32(0.0), bursts, trust, True,
+        )
+
+    def fsm(a, s, v):
+        return wave_blocks.fsm_saga_block(
+            a, s, v, wvs, ones_b, jnp.float32(0.0), wave_range
+        )
+
+    def audit(b_, d):
+        return wave_blocks.audit_block(b_, wvs, d, None, pallas_available())
+
+    gw_args = (
+        jnp.asarray(rng.randint(0, 2 * S, A, dtype=np.int64), jnp.int32),
+        jnp.full((A,), 2, jnp.int8),
+        jnp.zeros((A,), bool), jnp.zeros((A,), bool),
+        jnp.zeros((A,), bool), jnp.zeros((A,), bool),
+        jnp.ones((A,), bool),
+    )
+
+    def gw(a, e):
+        return wave_blocks.gateway_block(a, e, gw_args, jnp.float32(1.0))
+
+    def epi(a, s, v):
+        return wave_blocks.epilogue_block(
+            a, s, v, sagas, elevations, delta_log, event_log, trace_log,
+            bursts, True,
+        )
+
+    blocks = {
+        "admission": (jax.jit(adm), (agents, sessions), S),
+        "fsm_saga": (jax.jit(fsm), (agents, sessions, vouches), S),
+        "audit": (jax.jit(audit), (bodies, delta_log), S),
+        "gateway": (jax.jit(gw), (agents, elevations), A),
+        "epilogue": (jax.jit(epi), (agents, sessions, vouches), S),
+    }
+    per_block = {}
+    for name, (fn, args, batch) in blocks.items():
+        rec = bench(fn, args, iters, batch, f"wave_block:{name}")
+        per_block[name] = {
+            "batch": batch,
+            "batch_p50_ms": round(rec["batch_p50_ms"], 4),
+            "per_op_p50_us": round(rec["per_op_us"], 4),
+        }
+
+    def plane_us(name):
+        rec = (plane or {}).get(name)
+        return rec.get("per_op_p50_us") if rec else None
+
+    return {
+        "quick": quick,
+        "lanes": S,
+        "mode": "mosaic" if pallas_available() else "cpu-twin",
+        "blocks": per_block,
+        # Whole-wave delta from the suite's own rows (armed vs the
+        # pre-megakernel XLA program on this backend).
+        "state_wave_fastpath_us": plane_us("state_wave_fastpath"),
+        "state_wave_fastpath_xla_us": plane_us("state_wave_fastpath_xla"),
+        # The armed census structure (the acceptance metric) — cross-
+        # referenced from the dispatch-census row when it ran.
+        "census_dispatch_steps": (
+            (census_rec or {}).get("dispatch_steps")
+        ),
+        "census_phase_breakdown": (
+            (census_rec or {}).get("phase_breakdown")
+        ),
+        "wave_cut_ratio": (census_rec or {}).get("wave_cut_ratio"),
+    }
+
+
 def dispatch_census_row(timeout_s: float = 900.0) -> dict | None:
     """Run `tpu_aot_census.py --json` in a SUBPROCESS and distill the
     trajectory row (`BENCH_r<NN>.json` "dispatch_census").
@@ -896,20 +1047,36 @@ def dispatch_census_row(timeout_s: float = 900.0) -> dict | None:
         return None
     fused = report["programs"]["fused_wave_sanitized"]
     nodonate = report["programs"]["fused_wave_sanitized_nodonate"]
+    mk = report["programs"].get("fused_wave_megakernel")
     return {
         "backend": report["backend"],
-        "entry_steps": fused["entry"],
-        "dispatch_steps": fused["dispatch"],
+        # Round 12: the headline steps are the MEGAKERNEL wave (the
+        # program a production chip dispatches with HV_WAVE_PALLAS
+        # auto-armed); the pre-megakernel fused program stays on the
+        # row as reference_* so the trajectory shows the cut.
+        "entry_steps": (mk or fused)["entry"],
+        "dispatch_steps": (mk or fused)["dispatch"],
+        "reference_entry_steps": fused["entry"],
+        "reference_dispatch_steps": fused["dispatch"],
+        "phase_breakdown": (mk or {}).get("phases"),
+        "reference_phase_breakdown": fused.get("phases"),
+        "wave_kernels_boundary": report.get("wave_kernels_boundary"),
         "entry_steps_no_donate": nodonate["entry"],
         "dispatch_steps_no_donate": nodonate["dispatch"],
-        "copy_steps": fused["top"].get("copy", 0),
+        "copy_steps": (mk or fused)["top"].get("copy", 0),
         "donation_delta_steps": report["donation_delta_steps"],
+        "megakernel_donation_delta_steps": report.get(
+            "megakernel_donation_delta_steps"
+        ),
         "unfused_total_dispatch": report["unfused_total"]["dispatch"],
         "self_fusion_ratio": report["self_fusion_ratio"],
         "fusion_ratio": report["fusion_ratio"],
+        "fusion_ratio_reference": report.get("fusion_ratio_reference"),
         "r09_baseline_dispatch": (
             (report.get("r09_baseline") or {}).get("dispatch_total")
         ),
+        "r10_baseline_dispatch": report.get("r10_baseline"),
+        "wave_cut_ratio": report.get("wave_cut_ratio"),
     }
 
 
@@ -1126,6 +1293,22 @@ def main() -> None:
         else:
             out_path = Path(args.metrics_out)
         plane = metrics_plane_report(results)
+        # Round-12 megakernel row: per-block µs/op + the armed census
+        # structure; regression.py presence-gates it from round 12.
+        wave_rec = wave_megakernel_row(
+            args.quick, args.iters, census_rec, plane
+        )
+        if not args.json_only:
+            blk = ", ".join(
+                f"{k} {v['per_op_p50_us']}" for k, v in
+                wave_rec["blocks"].items()
+            )
+            print(
+                f"wave megakernel [{wave_rec['mode']}]: per-block µs/op "
+                f"{blk}; armed census "
+                f"{wave_rec['census_dispatch_steps']} steps",
+                flush=True,
+            )
         report = {
             "source": "benchmarks/bench_suite.py metrics plane",
             "device": str(device.device_kind),
@@ -1150,8 +1333,12 @@ def main() -> None:
             # Dispatch-census row (round 9): ENTRY/dispatch-bearing step
             # counts of the fused donated wave from tpu_aot_census.py —
             # regression.py gates the step count and the fusion ratio,
-            # so a de-fusing refactor fails CI devicelessly.
+            # so a de-fusing refactor fails CI devicelessly. From round
+            # 12 the headline steps are the MEGAKERNEL wave.
             "dispatch_census": census_rec,
+            # Megakernel row (round 12): per-block µs/op + armed step
+            # structure; presence-gated by regression.py from round 12.
+            "wave_megakernel": wave_rec,
             # Serving-soak row (round 11, bench_suite --soak): goodput +
             # tail latency vs the stated SLO + shed rate + post-warmup
             # recompiles; regression.py gates the SLO, the goodput

@@ -106,6 +106,33 @@ DEFAULT_SOAK_SLO_FACTOR = 1.0
 #: falling out of the fused program) lands here even with no chip.
 DEFAULT_CENSUS_FUSION_FLOOR = 2.0
 
+#: The round-12 floor (whole-wave Mosaic megakernels): from round 12
+#: the census row's `dispatch_steps` is the MEGAKERNEL wave, and the
+#: fusion ratio must reflect the >=4x step cut vs the r10 anchor —
+#: 322 / 37 ≈ 8.7 (148 -> <=37 intra-program steps, ISSUE 11
+#: acceptance). Pre-r12 rounds keep the old floor; the env override
+#: outranks both.
+R12_CENSUS_FUSION_FLOOR = 8.7
+
+#: The census row measures the megakernel wave from this round on, and
+#: the `wave_megakernel` bench row (per-block µs/op + step counts)
+#: becomes a required payload key — dropping it regresses the
+#: megakernel coverage even if every other number is fine.
+WAVE_ROW_SINCE = 12
+
+
+def census_fusion_floor(round_num: int) -> float:
+    """The fusion-ratio floor for a given round: env override, else the
+    r12 megakernel floor from WAVE_ROW_SINCE on, else the r10 floor."""
+    env_floor = os.environ.get("HV_CENSUS_FUSION_FLOOR")
+    if env_floor:
+        return float(env_floor)
+    return (
+        R12_CENSUS_FUSION_FLOOR
+        if round_num >= WAVE_ROW_SINCE
+        else DEFAULT_CENSUS_FUSION_FLOOR
+    )
+
 #: Allowed fractional growth of the fused wave's dispatch-bearing step
 #: count vs the median of comparable prior rounds
 #: (`HV_BENCH_CENSUS_TOL` overrides). Step counts are deterministic per
@@ -203,8 +230,16 @@ def parse_round_file(path: Path) -> Optional[dict]:
             ),
             # Dispatch-census row (round 10): the fused wave's ENTRY /
             # dispatch-bearing step counts + donated-vs-not diff, gated
-            # below — the tunnel-wedge-proof perf metric.
+            # below — the tunnel-wedge-proof perf metric. From round 12
+            # `dispatch_steps` is the MEGAKERNEL wave.
             census=census if isinstance(census, dict) else None,
+            # Megakernel row (round 12): per-block µs/op + the armed
+            # wave's step structure; presence-gated from WAVE_ROW_SINCE.
+            wave_megakernel=(
+                doc.get("wave_megakernel")
+                if isinstance(doc.get("wave_megakernel"), dict)
+                else None
+            ),
             # Donation chip row (bench_donation.py --metrics-out):
             # informational until the tunnel unwedges — the trajectory
             # carries it so the chip number lands the day it measures.
@@ -417,14 +452,12 @@ def compare(
             checked.append(entry)
             regressions.append(entry)
     if census and census.get("dispatch_steps") is not None:
-        # (a) r09-anchored fusion ratio floor: the mega-fusion must hold.
+        # (a) r09-anchored fusion ratio floor: the mega-fusion must
+        # hold — and from round 12 the bumped megakernel floor (the
+        # >=4x whole-wave step cut vs the r10 anchor, ISSUE 11).
         ratio_val = census.get("fusion_ratio")
         if ratio_val is not None:
-            env_floor = os.environ.get("HV_CENSUS_FUSION_FLOOR")
-            floor = (
-                float(env_floor) if env_floor
-                else DEFAULT_CENSUS_FUSION_FLOOR
-            )
+            floor = census_fusion_floor(current["round"])
             entry = {
                 "bench": "census_fusion_ratio",
                 "current_per_op_us": float(ratio_val),
@@ -459,6 +492,23 @@ def compare(
             checked.append(entry)
             if steps > base * (1.0 + ctol):
                 regressions.append(entry)
+    # Megakernel-row presence gate (round 12): a suite round from 12 on
+    # must carry the `wave_megakernel` bench row (per-block µs/op +
+    # armed step structure) — dropping it regresses the whole-wave
+    # kernel coverage even if every other number is fine.
+    if (
+        current.get("format") == "suite"
+        and current["round"] >= WAVE_ROW_SINCE
+        and not current.get("wave_megakernel")
+    ):
+        entry = {
+            "bench": "missing:wave_megakernel",
+            "current_per_op_us": 0.0,
+            "baseline_per_op_us": 0.0,
+            "ratio": 0.0,
+        }
+        checked.append(entry)
+        regressions.append(entry)
     # Serving-soak gates (round 11): presence from SOAK_ROW_SINCE, then
     # the row's own stated SLO, a goodput floor (no shedding your way
     # to a fast p99), and the zero-recompile + zero-violation contract.

@@ -12,21 +12,36 @@ wedged the census falls back to the hermetic CPU backend, whose
 ENTRY-step structure gates the same fusion/donation regressions with no
 chip attached.
 
-What it measures (the round-9 mega-fusion metric):
+What it measures (the round-9 mega-fusion metric, extended in round 12
+for the whole-wave Mosaic megakernels):
 
   * the FUSED bench-shaped wave — governance + gateway + audit append +
     gauge/sanitizer epilogue as ONE program (`ops.pipeline.
     governance_wave` with every round-9 plane riding), donated and not,
+  * the MEGAKERNEL wave — the same program with `HV_WAVE_PALLAS` armed
+    (`wave_kernels=True`): the serialized phase chains collapse into
+    the wave-block boundaries (`ops.wave_blocks` — Mosaic launches on
+    chip, the numpy twins out-of-line on this hermetic backend; either
+    way ONE custom call per block). This is the round-12 headline:
+    `fusion_ratio` gates IT from round 12 on,
   * the UNFUSED equivalents — the five standalone programs a pre-r10
     runtime dispatched per wave step (wave, DeltaLog append, gateway,
     update_gauges, check_invariants),
-  * `fusion_ratio` — r09-anchored dispatch-step cut (see R09_BASELINE),
+  * per-PHASE attribution — every dispatch-bearing step bucketed by
+    the `hv_phase.*` named scope its fusion root carries (admission /
+    fsm_saga / audit / gateway / epilogue; un-scoped steps are glue),
+    so the census shows WHERE the megakernels cut,
+  * `fusion_ratio` — r09-anchored dispatch-step cut (see R09_BASELINE);
+    `wave_cut_ratio` — the r10 fused anchor vs the megakernel wave,
   * live HBM buffer sizes where the backend exposes them.
 
 Dispatch-bearing ENTRY steps = fusions + custom calls + array copies +
 dynamic-update-slices + sorts + reduce-windows + gathers + scatters.
 Rank-0 (scalar) copies are prologue plumbing on every backend and are
-excluded.
+excluded. Round-12 metric note: tuple-result custom calls (the
+megakernel block boundaries lower to exactly these) are counted —
+other tuple-result instructions keep the historical (single-result)
+parse so the committed r09/r10 anchors stay comparable.
 
 CLI::
 
@@ -81,9 +96,20 @@ R09_BASELINE = {
     "tpu": None,
 }
 
+#: r10-HEAD anchor (commit 194ea9b): the ONE fused donated+sanitized
+#: program's dispatch-bearing step count on the hermetic CPU census —
+#: the number the round-12 megakernels must cut >=4x (ISSUE 11
+#: acceptance: 148 -> <=37).
+R10_FUSED_BASELINE = {"cpu": 148, "tpu": None}
 
-def entry_census(compiled) -> tuple[int, int, dict]:
-    """(entry_total, dispatch_ish, top_kinds) for a compiled program."""
+#: Wave phases the megakernels carve the program into (`hv_phase.*`
+#: named scopes in ops/pipeline.py); un-scoped steps bucket as "glue".
+WAVE_PHASES = ("admission", "fsm_saga", "audit", "gateway", "epilogue")
+
+_PHASE_RE = re.compile(r'op_name="[^"]*hv_phase\.([a-z_]+)')
+
+
+def _entry_body(compiled) -> str:
     txt = compiled.as_text()
     entry = txt[txt.index("ENTRY "):]
     body = entry[entry.index("{") + 1:]
@@ -96,20 +122,96 @@ def entry_census(compiled) -> tuple[int, int, dict]:
             if depth == 0:
                 end = i
                 break
-    c: Counter = Counter()
-    for line in body[:end].splitlines():
-        m = re.match(
-            r"\s*(?:ROOT\s+)?[%\w.-]+ = (\S+) ([a-z-]+)\(", line.strip()
-        )
-        if not m:
+    return body[:end]
+
+
+def _iter_entry_steps(body: str):
+    """Yield (kind, shape, line) for every countable ENTRY instruction.
+
+    Single-result instructions parse as always; tuple-result lines are
+    counted ONLY for custom-call (the megakernel block boundary — see
+    the round-12 metric note in the module docstring)."""
+    for line in body.splitlines():
+        stripped = line.strip()
+        m = re.match(r"\s*(?:ROOT\s+)?[%\w.-]+ = (\S+) ([a-z-]+)\(", stripped)
+        if m:
+            yield m.group(2), m.group(1), stripped
             continue
-        shape, kind = m.groups()
+        m = re.match(
+            r"\s*(?:ROOT\s+)?[%\w.-]+ = (\([^)]*\)) (custom-call)\(",
+            stripped,
+        )
+        if m:
+            yield m.group(2), m.group(1), stripped
+
+
+def entry_census(compiled) -> tuple[int, int, dict]:
+    """(entry_total, dispatch_ish, top_kinds) for a compiled program."""
+    c: Counter = Counter()
+    for kind, shape, _ in _iter_entry_steps(_entry_body(compiled)):
         if kind == "copy" and "[]" in shape:
             continue  # rank-0 scalar copy: prologue plumbing, not a step
         c[kind] += 1
     return sum(c.values()), sum(c[k] for k in DISPATCH_OPS), dict(
         c.most_common(10)
     )
+
+
+_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
+_COMP_RE = re.compile(r"^(?:ENTRY )?%?([\w.\-]+) \(")
+
+
+def _computation_phases(txt: str) -> dict:
+    """computation name -> Counter of `hv_phase.*` tags in its body.
+
+    XLA:CPU's parallel-task rewrite strips the root metadata off large
+    fusions at bench shapes, so line-level attribution alone loses
+    them; the ops INSIDE the called fused computation keep their
+    scoped op_names — majority vote over the body recovers the phase.
+    """
+    comp: dict[str, Counter] = {}
+    cur = None
+    for line in txt.splitlines():
+        if line and not line.startswith(" "):
+            m = _COMP_RE.match(line)
+            if m:
+                cur = m.group(1)
+                continue
+        m = _PHASE_RE.search(line)
+        if m and cur is not None:
+            comp.setdefault(cur, Counter())[m.group(1)] += 1
+    return comp
+
+
+def phase_census(compiled) -> dict:
+    """Dispatch-bearing ENTRY steps bucketed by originating wave phase.
+
+    Attribution rides the `hv_phase.*` named scopes `ops.pipeline.
+    governance_wave` wraps its phases in: a step lands on the phase its
+    own `op_name` carries, else on the majority phase of the fused
+    computation it calls (see `_computation_phases` — the CPU
+    parallel-fusion rewrite strips root metadata at bench shapes).
+    Steps with no phase provenance at all (staging copies, donation
+    plumbing, lane padding) bucket as "glue". Approximate only where
+    XLA fused across a phase boundary — the majority decides.
+    """
+    txt = compiled.as_text()
+    comp_phases = _computation_phases(txt)
+    phases = {name: 0 for name in WAVE_PHASES}
+    phases["glue"] = 0
+    for kind, shape, line in _iter_entry_steps(_entry_body(compiled)):
+        if kind not in DISPATCH_OPS:
+            continue
+        if kind == "copy" and "[]" in shape:
+            continue
+        m = _PHASE_RE.search(line)
+        key = m.group(1) if m else None
+        if key is None:
+            cm = _CALLS_RE.search(line)
+            if cm and cm.group(1) in comp_phases:
+                key = comp_phases[cm.group(1)].most_common(1)[0][0]
+        phases[key if key in phases else "glue"] += 1
+    return phases
 
 
 def _probe_timeout() -> float:
@@ -141,8 +243,21 @@ def probe_tpu_topology() -> bool:
     return proc.returncode == 0
 
 
-def _shapes(jax, jnp, merkle_ops, mp, tables_state, logs_mod):
+#: The attribution shape: XLA:CPU's parallel-task rewrite rebuilds the
+#: bench-shape program's fused computations WITHOUT their op metadata
+#: (measured: zero `hv_phase` tags survive in the 10k module), so the
+#: REFERENCE program's per-phase breakdown is measured on this smaller
+#: twin of the same program, where the metadata survives. Step TOTALS
+#: always come from the bench shape.
+ATTR_SHAPE = {"S": 256, "T": 3, "N": 1_024, "SC": 1_024, "E": 2_048, "A": 64}
+
+
+def _shapes(jax, jnp, merkle_ops, mp, tables_state, logs_mod, shape=None):
     """ShapeDtypeStructs for every program the census compiles."""
+    d = shape or {"S": S, "T": T, "N": N, "SC": SC, "E": E, "A": A}
+    s_, t_, n_, sc_, e_, a_ = (
+        d["S"], d["T"], d["N"], d["SC"], d["E"], d["A"]
+    )
 
     def sds(tree):
         return jax.tree.map(
@@ -150,30 +265,30 @@ def _shapes(jax, jnp, merkle_ops, mp, tables_state, logs_mod):
         )
 
     return {
-        "agents": sds(tables_state.AgentTable.create(N)),
-        "sessions": sds(tables_state.SessionTable.create(SC)),
-        "vouches": sds(tables_state.VouchTable.create(E)),
+        "agents": sds(tables_state.AgentTable.create(n_)),
+        "sessions": sds(tables_state.SessionTable.create(sc_)),
+        "vouches": sds(tables_state.VouchTable.create(e_)),
         "sagas": sds(tables_state.SagaTable.create(1024, 8)),
         "elevations": sds(tables_state.ElevationTable.create(4096)),
         "delta_log": sds(logs_mod.DeltaLog.create(65536)),
         "event_log": sds(logs_mod.EventLog.create(65536)),
         "trace_log": sds(logs_mod.TraceLog.create(65536)),
         "metrics": sds(mp.REGISTRY.create_table()),
-        "li": jax.ShapeDtypeStruct((S,), jnp.int32),
-        "lb": jax.ShapeDtypeStruct((S,), jnp.bool_),
-        "lf": jax.ShapeDtypeStruct((S,), jnp.float32),
-        "li8": jax.ShapeDtypeStruct((S,), jnp.int8),
+        "li": jax.ShapeDtypeStruct((s_,), jnp.int32),
+        "lb": jax.ShapeDtypeStruct((s_,), jnp.bool_),
+        "lf": jax.ShapeDtypeStruct((s_,), jnp.float32),
+        "li8": jax.ShapeDtypeStruct((s_,), jnp.int8),
         "sf": jax.ShapeDtypeStruct((), jnp.float32),
         "si": jax.ShapeDtypeStruct((), jnp.int32),
         "su": jax.ShapeDtypeStruct((), jnp.uint32),
         "sb": jax.ShapeDtypeStruct((), jnp.bool_),
         "bodies": jax.ShapeDtypeStruct(
-            (T, S, merkle_ops.BODY_WORDS), jnp.uint32
+            (t_, s_, merkle_ops.BODY_WORDS), jnp.uint32
         ),
         "rb": jax.ShapeDtypeStruct((4,), jnp.float32),
-        "ai": jax.ShapeDtypeStruct((A,), jnp.int32),
-        "ai8": jax.ShapeDtypeStruct((A,), jnp.int8),
-        "ab": jax.ShapeDtypeStruct((A,), jnp.bool_),
+        "ai": jax.ShapeDtypeStruct((a_,), jnp.int32),
+        "ai8": jax.ShapeDtypeStruct((a_,), jnp.int8),
+        "ab": jax.ShapeDtypeStruct((a_,), jnp.bool_),
     }
 
 
@@ -213,7 +328,7 @@ def census_report(backend: str, sharding=None) -> dict:
     gw_cols = (sh["ai"], sh["ai8"], sh["ab"], sh["ab"], sh["ab"],
                sh["ab"], sh["ab"])
 
-    def fused_fn(sanitize):
+    def fused_fn(sanitize, wave_kernels=False):
         def fn(*a):
             (*w, lo, hi, m, tr, ct, cs, cw, cb, elev,
              g0, g1, g2, g3, g4, g5, g6, d, sg, ev, bursts) = a
@@ -227,15 +342,27 @@ def census_report(backend: str, sharding=None) -> dict:
                 elevations=elev,
                 gateway_args=(g0, g1, g2, g3, g4, g5, g6),
                 delta_log=d, epilogue_tables=(sg, ev), sanitize=sanitize,
+                wave_kernels=wave_kernels,
             )
 
         return fn
 
-    fused_args = (
-        wave_args + (sh["si"], sh["si"], sh["metrics"], sh["trace_log"])
-        + ctx_args + (sh["elevations"],) + gw_cols
-        + (sh["delta_log"], sh["sagas"], sh["event_log"], sh["rb"])
-    )
+    def _fused_args_of(shd):
+        wa = (
+            shd["agents"], shd["sessions"], shd["vouches"],
+            shd["li"], shd["li"], shd["li"], shd["lf"], shd["lb"],
+            shd["lb"], shd["li"], shd["bodies"], shd["sf"], shd["sf"],
+        )
+        gw = (shd["ai"], shd["ai8"], shd["ab"], shd["ab"], shd["ab"],
+              shd["ab"], shd["ab"])
+        return (
+            wa + (shd["si"], shd["si"], shd["metrics"], shd["trace_log"])
+            + (shd["su"], shd["su"], shd["si"], shd["sb"])
+            + (shd["elevations"],) + gw
+            + (shd["delta_log"], shd["sagas"], shd["event_log"], shd["rb"])
+        )
+
+    fused_args = _fused_args_of(sh)
     # Donation frontier: agents(0) sessions(1) vouches(2) metrics(15)
     # trace(16) delta_log(29) — positions in fused_args, mirroring
     # `state._WAVE_DONATED`. No cache salt here: this process never
@@ -248,7 +375,7 @@ def census_report(backend: str, sharding=None) -> dict:
     programs: dict[str, dict] = {}
     hbm = None
 
-    def compile_and_census(name, fn, args, donate_argnums=()):
+    def compile_and_census(name, fn, args, donate_argnums=(), phases=False):
         compiled = (
             jax.jit(fn, donate_argnums=donate_argnums, **jit_kw)
             .lower(*args)
@@ -256,15 +383,49 @@ def census_report(backend: str, sharding=None) -> dict:
         )
         total, heavy, top = entry_census(compiled)
         programs[name] = {"entry": total, "dispatch": heavy, "top": top}
+        if phases:
+            programs[name]["phases"] = phase_census(compiled)
         return compiled
 
     compiled_fused = compile_and_census(
-        "fused_wave_sanitized", fused_fn(True), fused_args, donate
+        "fused_wave_sanitized", fused_fn(True), fused_args, donate,
+        phases=True,
     )
     compile_and_census("fused_wave", fused_fn(False), fused_args, donate)
     compile_and_census(
         "fused_wave_sanitized_nodonate", fused_fn(True), fused_args
     )
+    # ── the round-12 megakernel wave: the SAME program with the wave
+    # blocks armed (`wave_kernels=True`). On this hermetic backend each
+    # block is one out-of-line twin custom call; on chip each named
+    # block is a Mosaic launch — either way the census counts the block
+    # boundaries, which is the dispatch structure the chip serializes.
+    compile_and_census(
+        "fused_wave_megakernel", fused_fn(True, wave_kernels=True),
+        fused_args, donate, phases=True,
+    )
+    compile_and_census(
+        "fused_wave_megakernel_nodonate",
+        fused_fn(True, wave_kernels=True), fused_args,
+    )
+    if backend == "cpu":
+        # The reference program's per-phase breakdown, measured at the
+        # attribution shape (ATTR_SHAPE) where the parallel-task
+        # rewrite hasn't stripped the `hv_phase` metadata: the phase
+        # STRUCTURE is shape-invariant, so this is where the
+        # megakernels' cut is shown — totals stay bench-shaped.
+        sh_attr = _shapes(
+            jax, jnp, merkle_ops, mp, tables_state, logs_mod, ATTR_SHAPE
+        )
+        attr_compiled = (
+            jax.jit(fused_fn(True))
+            .lower(*_fused_args_of(sh_attr))
+            .compile()
+        )
+        programs["fused_wave_sanitized"]["phases"] = phase_census(
+            attr_compiled
+        )
+        programs["fused_wave_sanitized"]["phases_shape"] = ATTR_SHAPE
     try:
         mm = compiled_fused.memory_analysis()
         hbm = {
@@ -341,7 +502,9 @@ def census_report(backend: str, sharding=None) -> dict:
         "programs": len(unfused),
     }
     fused = programs["fused_wave_sanitized"]
+    mk = programs["fused_wave_megakernel"]
     anchor = R09_BASELINE.get(backend)
+    r10 = R10_FUSED_BASELINE.get(backend)
     report = {
         "source": "benchmarks/tpu_aot_census.py",
         "backend": backend,
@@ -350,7 +513,7 @@ def census_report(backend: str, sharding=None) -> dict:
         "metric": (
             "ENTRY instructions; dispatch = fusion+custom-call+array-copy"
             "+dus+sort+reduce-window+gather+scatter (rank-0 copies"
-            " excluded)"
+            " excluded; tuple-result custom calls counted since r12)"
         ),
         "programs": programs,
         "unfused_total": unfused_total,
@@ -359,18 +522,42 @@ def census_report(backend: str, sharding=None) -> dict:
         "self_fusion_ratio": round(
             unfused_total["dispatch"] / max(fused["dispatch"], 1), 3
         ),
-        # The acceptance headline: the r09-HEAD five-program total
-        # (anchored constant, see R09_BASELINE) vs today's fused
-        # program.
+        # The acceptance headline since round 12: the r09-HEAD
+        # five-program total (anchored constant, see R09_BASELINE) vs
+        # today's MEGAKERNEL wave — the program a production chip
+        # dispatches with HV_WAVE_PALLAS auto-armed.
         "r09_baseline": anchor,
         "fusion_ratio": (
+            round(anchor["dispatch_total"] / max(mk["dispatch"], 1), 3)
+            if anchor
+            else None
+        ),
+        # Continuity key: the same ratio for the UNARMED fused wave
+        # (the r10/r11 headline) so the trajectory stays readable.
+        "fusion_ratio_reference": (
             round(anchor["dispatch_total"] / max(fused["dispatch"], 1), 3)
             if anchor
             else None
         ),
+        # ISSUE 11 acceptance: the r10 fused anchor vs the megakernel
+        # wave — the >=4x whole-wave step cut.
+        "r10_baseline": r10,
+        "wave_cut_ratio": (
+            round(r10 / max(mk["dispatch"], 1), 3) if r10 else None
+        ),
+        # How the armed blocks execute on THIS backend (the fallback
+        # matrix): out-of-line numpy twins on the hermetic CPU census,
+        # Mosaic launches + inline gateway/epilogue on chip.
+        "wave_kernels_boundary": (
+            "twin" if backend == "cpu" else "mosaic+inline"
+        ),
         "donation_delta_steps": (
             programs["fused_wave_sanitized_nodonate"]["dispatch"]
             - fused["dispatch"]
+        ),
+        "megakernel_donation_delta_steps": (
+            programs["fused_wave_megakernel_nodonate"]["dispatch"]
+            - mk["dispatch"]
         ),
         "hbm": hbm,
     }
@@ -393,9 +580,17 @@ def _print_text(report: dict) -> None:
         f"dispatch={ut['dispatch']:4d}  ({ut['programs']} programs)"
     )
     print(
-        f"fusion ratio vs r09: {report['fusion_ratio']}  "
-        f"(self: {report['self_fusion_ratio']}x, donation saves "
+        f"fusion ratio vs r09: {report['fusion_ratio']} (megakernel; "
+        f"reference {report['fusion_ratio_reference']}, self: "
+        f"{report['self_fusion_ratio']}x, donation saves "
         f"{report['donation_delta_steps']} steps)"
+    )
+    mk = report["programs"]["fused_wave_megakernel"]
+    print(
+        f"megakernel wave: {mk['dispatch']} dispatch steps vs r10's "
+        f"{report['r10_baseline']} (cut {report['wave_cut_ratio']}x, "
+        f"blocks as {report['wave_kernels_boundary']}); phases: "
+        f"{mk.get('phases')}"
     )
     if report["hbm"]:
         print(f"HBM MB (fused): {report['hbm']}")

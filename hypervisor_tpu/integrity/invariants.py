@@ -421,25 +421,7 @@ def check_invariants(
     unrepairable = tally.count_true_1d(restore_flags)
 
     if metrics is not None:
-        from hypervisor_tpu.observability import metrics as mp
-        from hypervisor_tpu.tables.metrics import (
-            counter_add_many,
-            gauge_set_many,
-        )
-
-        metrics = counter_add_many(
-            metrics,
-            (mp.INTEGRITY_CHECKS.index, mp.INTEGRITY_VIOLATIONS.index),
-            (jnp.uint32(1), total.astype(jnp.uint32)),
-        )
-        metrics = gauge_set_many(
-            metrics,
-            (
-                mp.INTEGRITY_VIOLATION_ROWS.index,
-                mp.INTEGRITY_UNREPAIRABLE_ROWS.index,
-            ),
-            (total, unrepairable),
-        )
+        metrics = book_sanitizer_metrics(metrics, total, unrepairable)
 
     return IntegrityResult(
         agent_mask=agent_mask,
@@ -451,6 +433,29 @@ def check_invariants(
         total=total,
         unrepairable=unrepairable,
         metrics=metrics,
+    )
+
+
+def book_sanitizer_metrics(metrics, total, unrepairable):
+    """Book one sanitizer pass's counters + gauges — THE shared rule
+    (`check_invariants` and the armed megakernel epilogue in
+    `ops.pipeline` both call it, so the two paths' `hv_integrity_*`
+    rows cannot drift)."""
+    from hypervisor_tpu.observability import metrics as mp
+    from hypervisor_tpu.tables.metrics import counter_add_many, gauge_set_many
+
+    metrics = counter_add_many(
+        metrics,
+        (mp.INTEGRITY_CHECKS.index, mp.INTEGRITY_VIOLATIONS.index),
+        (jnp.uint32(1), total.astype(jnp.uint32)),
+    )
+    return gauge_set_many(
+        metrics,
+        (
+            mp.INTEGRITY_VIOLATION_ROWS.index,
+            mp.INTEGRITY_UNREPAIRABLE_ROWS.index,
+        ),
+        (total, unrepairable),
     )
 
 

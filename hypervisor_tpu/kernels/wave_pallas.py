@@ -1,0 +1,1651 @@
+"""Pallas TPU kernels: whole-wave Mosaic megakernels.
+
+ROOFLINE.md puts the 10k-session governance wave's physics at ~15.4 MB
+of live HBM — an 18-30 µs bandwidth floor — yet even after the round-9
+mega-fusion the ONE XLA program still serializes ~148 dispatch-bearing
+intra-program steps at ~20-30 µs of dispatch ceiling each. Dispatch,
+not bytes, is the binding constraint. The MTU (`kernels/mtu_pallas.py`)
+proved the cure for the hash phase: layer-merged multi-stage reductions
+in ONE launch with carries in kernel scratch. This module applies the
+same pattern to the wave itself — a small family of megakernels, one
+per phase block, each collapsing a serialized step chain into a single
+launch with VMEM-resident intermediate state:
+
+* **admission block** — the session-row gathers, sigma/ring derivation,
+  the status ladder, capacity ranking (ONE in-kernel bitonic sort where
+  the wave may hold duplicate sessions; rank 0 on the host-verified
+  unique fast path), the packed agent-row writes (which also reset the
+  breach window), and the participant-count scatter: one launch.
+* **fsm + saga walk block** — the session FSM walk (bit-packed
+  transition-matrix tests), the per-lane saga execute step, and the
+  terminate phase (bond release, participant deactivation, ARCHIVED
+  walk, timestamps) as one [K]-lane launch instead of a chain of masked
+  selects and scatters. The same math family serves the standalone saga
+  round (`saga_tick`): cursor advance, retry bookkeeping, and the
+  reverse-order compensation-target selection.
+* **audit block** — chain compression (riding `sha256_pallas`'s
+  unrolled register-window compression, the MTU chain layout), the
+  Merkle leaf fold + in-VMEM tree reduction, and the DeltaLog ring
+  append in the same launch.
+* **gateway block** — every per-action gate (breaker, quarantine, ring,
+  rate) as one block boundary behind `ops.wave_blocks`; its Mosaic form
+  (the four segment prefixes sharing the admission kernel's bitonic
+  network) is the family's next rung — on chip it rides the inline XLA
+  phase today, on the CPU twin path it is already one block.
+* **epilogue block** — the occupancy-gauge reductions and the sampled
+  invariant sanitizer's per-table mask derivation, whose lane tallies
+  ride MXU matvecs (`ops/tally.py` showed the win) on chip; staged like
+  the gateway block (twin today, Mosaic next).
+
+Every block has a **numpy twin** (`*_np`) executing the identical math
+on plain numpy arrays — the MTU / sha256_pallas pattern: XLA:CPU cannot
+compile the unrolled Mosaic forms, so CPU parity (and the CPU serving
+path when the kernels are armed, via `ops.wave_blocks`) runs the twins,
+and the tier-1 suite pins each twin bit-identical to the pre-megakernel
+XLA phase ops. The compiled `pallas_call` path is exercised on the real
+chip (standing caveat: awaiting a healthy accelerator tunnel, like the
+MTU and the fused-wave census).
+
+Arming: `HV_WAVE_PALLAS` (read per call, the `HV_SHA256_PALLAS`
+convention — auto = on for TPU backends; `set_wave_kernels` overrides
+and clears jax's caches, since dispatch binds at trace time).
+Dispatch never changes results: armed and reference paths are
+bit-identical (chain heads, tables, metrics), gated per verify run.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.kernels.mtu_pallas import (
+    _hash_chain_link,
+    _reduce_tree,
+)
+from hypervisor_tpu.kernels.sha256_pallas import pallas_available
+from hypervisor_tpu.ops.bits import matrix_bits_valid_any
+from hypervisor_tpu.tables import state as ts
+
+try:  # pragma: no cover - import guard (mirrors sha256_pallas)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_IMPORTED = True
+except Exception:  # pragma: no cover
+    _PALLAS_IMPORTED = False
+
+# ── arming knob ──────────────────────────────────────────────────────
+
+_USE_WAVE: bool | None = None
+
+
+def set_wave_kernels(enabled: bool | None) -> None:
+    """Force (True/False) or restore auto (None) wave-kernel dispatch.
+
+    Like `ops.sha256.set_pallas`: dispatch is baked in at trace time,
+    so the override clears jax's compilation caches. An explicit value
+    here outranks the `HV_WAVE_PALLAS` environment override.
+    """
+    global _USE_WAVE
+    if enabled != _USE_WAVE:
+        _USE_WAVE = enabled
+        jax.clear_caches()
+
+
+def wave_kernels_enabled() -> bool:
+    """Per-call arming rule (the `HV_SHA256_PALLAS` precedence):
+    set_wave_kernels() override > `HV_WAVE_PALLAS` env > backend auto
+    (on for TPU backends, off elsewhere — the CPU twins exist for
+    parity and the census, not as the CPU production default)."""
+    if _USE_WAVE is not None:
+        return _USE_WAVE
+    env = os.environ.get("HV_WAVE_PALLAS")
+    if env is not None and env != "":
+        return env not in ("0", "false", "no", "off")
+    return pallas_available()
+
+
+def wave_pallas_ready() -> bool:
+    """True when the Mosaic megakernels themselves can launch (TPU
+    backend with pallas importable). When armed WITHOUT this, dispatch
+    falls back to the numpy twins out-of-line (`ops.wave_blocks`)."""
+    return _PALLAS_IMPORTED and pallas_available()
+
+
+# ── shared backend-agnostic block math ───────────────────────────────
+#
+# Each helper runs unchanged on numpy arrays (the twins) and on jnp
+# tiles inside a Mosaic kernel — the `_compress_unrolled` discipline.
+# Integer/bool arithmetic and elementwise f32 only; reductions and
+# scatters stay in the per-backend entry points.
+
+_S_HANDSHAKING = 1
+_S_ACTIVE = 2
+
+# Admission status codes (must mirror ops.admission.ADMIT_*).
+_ADMIT_OK = 0
+_ADMIT_BAD_STATE = 1
+_ADMIT_DUPLICATE = 2
+_ADMIT_CAPACITY = 3
+_ADMIT_SIGMA_LOW = 4
+
+# Saga step codes (ops.saga_ops.STEP_*).
+_STEP_PENDING = 0
+_STEP_COMMITTED = 2
+_STEP_COMPENSATING = 3
+_STEP_COMPENSATED = 4
+_STEP_COMP_FAILED = 5
+_STEP_FAILED = 6
+_SAGA_RUNNING = 0
+_SAGA_COMPENSATING = 1
+_SAGA_COMPLETED = 2
+_SAGA_ESCALATED = 4
+
+# Gateway verdict codes (ops.gateway.GATE_*).
+_GATE_ALLOWED = 0
+_GATE_BREAKER = 1
+_GATE_QUARANTINED = 2
+_GATE_RING = 3
+_GATE_RATE = 4
+_GATE_INVALID = 5
+
+# Ring-check codes (ops.rings.CHECK_*).
+_CHECK_OK = 0
+_CHECK_NEEDS_SRE_WITNESS = 1
+_CHECK_SIGMA_BELOW_RING1 = 2
+_CHECK_NEEDS_CONSENSUS = 3
+_CHECK_SIGMA_BELOW_RING2 = 4
+_CHECK_RING_INSUFFICIENT = 5
+
+
+def _claim(status, cond, code, where):
+    """The admission/ring status ladder's one rule: first claim wins."""
+    return where((status == _ADMIT_OK) & cond, np.int8(code), status)
+
+
+def _compute_rings(sigma_eff, ring2_threshold, where):
+    """`ops.rings.compute_rings` with consensus=False (the wave form):
+    ring 2 above the threshold, sandbox ring 3 below."""
+    return where(
+        sigma_eff > np.float32(ring2_threshold), np.int8(2), np.int8(3)
+    )
+
+
+def _fsm_walk_math(state0, has_members, transition_bits, archived_codes, where):
+    """The wave's three-legality-gated FSM walks (ACTIVE ->
+    TERMINATING -> ARCHIVED on populated sessions) via the bit-packed
+    transition matrix. Returns (final_state i8, fsm_err bool)."""
+    err = has_members & False
+    state = state0
+    for target in archived_codes:  # (ACTIVE, TERMINATING, ARCHIVED)
+        ok = matrix_bits_valid_any(
+            transition_bits, state, np.int8(target), where=where
+        )
+        apply = has_members & ok
+        state = where(apply, np.int8(target), state).astype(np.int8)
+        err = err | (has_members & ~ok)
+    return state, err
+
+
+def _execute_attempt_math(ok, where):
+    """One saga retry-ladder attempt on fresh PENDING lanes with zero
+    retries (`ops.saga_ops.execute_attempt` on the wave's lanes):
+    COMMITTED on success, FAILED otherwise."""
+    return where(ok, np.int8(_STEP_COMMITTED), np.int8(_STEP_FAILED))
+
+
+def _severity_math(rate, analyzable, suppressed, breach, where):
+    """The breach severity ladder (`ops.security_ops.breach_sweep`
+    thresholds) masked to analyzable, non-suppressed records."""
+    sev = (
+        (rate >= np.float32(breach.low_threshold)).astype(np.int8)
+        + (rate >= np.float32(breach.medium_threshold)).astype(np.int8)
+        + (rate >= np.float32(breach.high_threshold)).astype(np.int8)
+        + (rate >= np.float32(breach.critical_threshold)).astype(np.int8)
+    )
+    return where(analyzable & ~suppressed, sev, np.int8(0)).astype(np.int8)
+
+
+def _ring_check_math(
+    eff, required, sigma, consensus, witness, ring1, ring2, where
+):
+    """`ops.rings.ring_check`'s precedence ladder, shared verbatim."""
+    status = (required & np.int8(0)).astype(np.int8)
+
+    def claim(status, cond, code):
+        return where(
+            (status == _CHECK_OK) & cond, np.int8(code), status
+        ).astype(np.int8)
+
+    status = claim(status, (required == 0) & ~witness, _CHECK_NEEDS_SRE_WITNESS)
+    status = claim(
+        status,
+        (required == 1) & (sigma < np.float32(ring1)),
+        _CHECK_SIGMA_BELOW_RING1,
+    )
+    status = claim(status, (required == 1) & ~consensus, _CHECK_NEEDS_CONSENSUS)
+    status = claim(
+        status,
+        (required == 2) & (sigma < np.float32(ring2)),
+        _CHECK_SIGMA_BELOW_RING2,
+    )
+    status = claim(status, eff > required, _CHECK_RING_INSUFFICIENT)
+    return status
+
+
+def _refill_math(tokens, stamp, rates_at, bursts_at, now, where):
+    """Token-bucket refill (`ops.rate_limit.refill`): burst-capped
+    roll-forward; rates/bursts arrive pre-gathered per row."""
+    maximum = np.maximum if where is np.where else jnp.maximum
+    minimum = np.minimum if where is np.where else jnp.minimum
+    elapsed = maximum(now - stamp, np.float32(0.0))
+    return minimum(bursts_at, tokens + elapsed * rates_at)
+
+
+# ── numpy twins ──────────────────────────────────────────────────────
+
+
+def _rank_within_np(keys: np.ndarray) -> np.ndarray:
+    """i32[B] rank of each lane within its equal-key group, wave order
+    — `ops.admission._rank_within_session`'s exact semantics. The rank
+    is sort-algorithm-independent (count of earlier lanes sharing the
+    key), so the twin's stable argsort and the kernel's bitonic network
+    produce identical values."""
+    b = keys.shape[0]
+    order = np.argsort(keys, kind="stable")
+    s = keys[order]
+    idx = np.arange(b, dtype=np.int32)
+    is_new = np.concatenate([[True], s[1:] != s[:-1]])
+    group_start = np.maximum.accumulate(np.where(is_new, idx, 0))
+    rank = np.zeros((b,), np.int32)
+    rank[order] = idx - group_start
+    return rank
+
+
+def admission_block_np(
+    agents_f32: np.ndarray,   # f32[N, 8]
+    agents_i32: np.ndarray,   # i32[N, AI32_WIDTH]
+    agents_ring: np.ndarray,  # i8[N]
+    sess_i32: np.ndarray,     # i32[SC, 5]
+    sess_f32: np.ndarray,     # f32[SC, 4]
+    slot: np.ndarray,         # i32[B] preallocated agent rows
+    did: np.ndarray,          # i32[B]
+    session_slot: np.ndarray, # i32[B]
+    sigma_raw: np.ndarray,    # f32[B]
+    contribution: np.ndarray, # f32[B]
+    omega: np.ndarray,        # f32[] risk weight
+    trustworthy: np.ndarray,  # bool[B]
+    duplicate: np.ndarray,    # bool[B]
+    now: np.ndarray,          # f32[]
+    bursts: np.ndarray,       # f32[4]
+    ring2_threshold: float,
+    unique_sessions: bool,
+):
+    """The admission megakernel's exact math on numpy arrays.
+
+    Bit-identical to `ops.admission.admit_batch` (gathers, ladder,
+    capacity rank, packed row writes incl. the breach-window reset,
+    participant-count scatter) — pinned by tests/unit/test_wave_kernels.
+    """
+    b = slot.shape[0]
+    agents_f32 = np.array(agents_f32, np.float32, copy=True)
+    agents_i32 = np.array(agents_i32, np.int32, copy=True)
+    agents_ring = np.array(agents_ring, np.int8, copy=True)
+    sess_i32 = np.array(sess_i32, np.int32, copy=True)
+    now = np.float32(now)
+    omega = np.float32(omega)
+
+    rows = sess_i32[session_slot]                      # [B, 5]
+    sess_state = rows[:, ts.SI32_STATE]
+    sess_count = rows[:, ts.SI32_NPART]
+    sess_max = rows[:, ts.SI32_MAX_PARTICIPANTS]
+    sess_min_sigma = sess_f32[session_slot][:, ts.SF32_MIN_SIGMA]
+
+    # Rank among lanes passing every non-capacity check; rejected lanes
+    # get distinct negative keys so they never share a group.
+    sigma_eff = np.minimum(
+        sigma_raw.astype(np.float32) + omega * contribution.astype(np.float32),
+        np.float32(1.0),
+    )
+    ring = np.where(
+        sigma_eff > np.float32(ring2_threshold), np.int8(2), np.int8(3)
+    )
+    ring = np.where(trustworthy, ring, np.int8(3)).astype(np.int8)
+    bad_state = (sess_state != _S_HANDSHAKING) & (sess_state != _S_ACTIVE)
+    sigma_low = (sigma_eff < sess_min_sigma) & (ring != 3)
+    status = np.zeros((b,), np.int8)
+    status = _claim(status, bad_state, _ADMIT_BAD_STATE, np.where)
+    status = _claim(status, duplicate, _ADMIT_DUPLICATE, np.where)
+    status = _claim(status, sigma_low, _ADMIT_SIGMA_LOW, np.where)
+    passed_other = status == _ADMIT_OK
+    if unique_sessions:
+        rank = np.zeros((b,), np.int32)
+    else:
+        rank = _rank_within_np(
+            np.where(
+                passed_other,
+                session_slot.astype(np.int64),
+                -1 - np.arange(b, dtype=np.int64),
+            )
+        )
+    over_capacity = passed_other & ((sess_count + rank) >= sess_max)
+    status = _claim(status, over_capacity, _ADMIT_CAPACITY, np.where)
+    ok = status == _ADMIT_OK
+
+    # Packed row blocks (`ops.admission.admit_row_blocks` layout): the
+    # i32 zeros also reset the previous tenant's breach window.
+    f32_rows = np.zeros((b, 8), np.float32)
+    f32_rows[:, ts.AF32_SIGMA_RAW] = sigma_raw
+    f32_rows[:, ts.AF32_SIGMA_EFF] = sigma_eff
+    f32_rows[:, ts.AF32_JOINED_AT] = now
+    f32_rows[:, ts.AF32_RL_TOKENS] = np.asarray(bursts, np.float32)[
+        np.clip(ring.astype(np.int32), 0, 3)
+    ]
+    f32_rows[:, ts.AF32_RL_STAMP] = now
+    i32_rows = np.zeros((b, ts.AI32_WIDTH), np.int32)
+    i32_rows[:, ts.AI32_DID] = did
+    i32_rows[:, ts.AI32_SESSION] = session_slot
+    i32_rows[:, ts.AI32_FLAGS] = ts.FLAG_ACTIVE
+
+    w = slot[ok]
+    agents_f32[w] = f32_rows[ok]
+    agents_i32[w] = i32_rows[ok]
+    agents_ring[w] = ring[ok]
+    np.add.at(sess_i32[:, ts.SI32_NPART], session_slot[ok], 1)
+    return (
+        agents_f32, agents_i32, agents_ring, sess_i32,
+        status, ring, sigma_eff.astype(np.float32),
+    )
+
+
+def fsm_saga_block_np(
+    agents_i32: np.ndarray,    # i32[N, W] (flags column written)
+    sess_i32: np.ndarray,      # i32[SC, 5]
+    sess_f32: np.ndarray,      # f32[SC, 4]
+    vouch_session: np.ndarray, # i32[E]
+    vouch_active: np.ndarray,  # bool[E]
+    k_sessions: np.ndarray,    # i32[K]
+    ok: np.ndarray,            # bool[B] admission outcomes
+    now: np.ndarray,           # f32[]
+    lo: np.ndarray,            # i32[] wave-range low (ignored w/o range)
+    hi: np.ndarray,            # i32[] wave-range high
+    has_range: bool,
+    transition_bits,
+    active_code: int,
+    terminating_code: int,
+    archived_code: int,
+):
+    """The FSM+saga+terminate megakernel's exact math on numpy arrays.
+
+    Mirrors `ops.pipeline.governance_wave` phases 3/5/6: the
+    legality-gated session walk, the per-lane saga execute step, and
+    `ops.terminate.release_session_scope` (range compares on the fast
+    path, membership tests otherwise).
+    """
+    agents_i32 = np.array(agents_i32, np.int32, copy=True)
+    sess_i32 = np.array(sess_i32, np.int32, copy=True)
+    sess_f32 = np.array(sess_f32, np.float32, copy=True)
+    vouch_active = np.array(vouch_active, bool, copy=True)
+    now = np.float32(now)
+
+    rows_i32 = sess_i32[k_sessions]
+    rows_f32 = sess_f32[k_sessions]
+    wave_state = rows_i32[:, ts.SI32_STATE].astype(np.int8)
+    has_members = rows_i32[:, ts.SI32_NPART] > 0
+
+    wave_state, err = _fsm_walk_math(
+        wave_state, has_members, transition_bits,
+        (active_code,), np.where,
+    )
+    step_state = _execute_attempt_math(ok, np.where)
+
+    # terminate: bonds + participants (release_session_scope semantics).
+    agents_session = agents_i32[:, ts.AI32_SESSION]
+    if has_range:
+        edge_in = (vouch_session >= lo) & (vouch_session < hi)
+        agent_hit = (agents_session >= lo) & (agents_session < hi)
+    else:
+        in_wave = np.isin(vouch_session, k_sessions[k_sessions >= 0])
+        edge_in = in_wave
+        agent_hit = np.isin(agents_session, k_sessions[k_sessions >= 0])
+    edge_hit = vouch_active & edge_in
+    vouch_active &= ~edge_hit
+    released = np.int32(np.count_nonzero(edge_hit))
+    hit = agent_hit
+    agents_i32[hit, ts.AI32_FLAGS] &= ~ts.FLAG_ACTIVE
+
+    wave_state, err_t = _fsm_walk_math(
+        wave_state, has_members, transition_bits,
+        (terminating_code, archived_code), np.where,
+    )
+    fsm_err = err | err_t
+    sess_i32[k_sessions, ts.SI32_STATE] = wave_state
+    sess_f32[k_sessions, ts.SF32_TERMINATED_AT] = np.where(
+        has_members, now, rows_f32[:, ts.SF32_TERMINATED_AT]
+    )
+    return (
+        agents_i32, sess_i32, sess_f32, vouch_active,
+        step_state.astype(np.int8), wave_state.astype(np.int8),
+        fsm_err, released,
+    )
+
+
+def audit_block_np(
+    bodies: np.ndarray,       # u32[T, K, 16]
+    k_sessions: np.ndarray,   # i32[K]
+    ring_body: np.ndarray,    # u32[C, 16]
+    ring_digest: np.ndarray,  # u32[C, 8]
+    ring_session: np.ndarray, # i32[C]
+    ring_turn: np.ndarray,    # i32[C]
+    cursor: np.ndarray,       # i32[]
+    n_valid: np.ndarray,      # i32[] live session lanes (prefix)
+    token: np.ndarray = None,  # ignored: sequencing operand (see
+                               # `ops.wave_blocks.audit_block`)
+    has_ring: bool = False,
+):
+    """The audit megakernel's exact math on numpy arrays: the chain
+    compression (`mtu_pallas._hash_chain_link`, seeds = zeros — wave
+    sessions are born this wave), the Merkle leaf fold + layer-merged
+    tree reduction (`mtu_pallas._reduce_tree`), and the DeltaLog ring
+    append (lane-major live prefix, `DeltaLog.append_batch_prefix`
+    semantics). Bit-identical to the XLA audit phase + append."""
+    bodies = np.asarray(bodies, np.uint32)
+    t, k, _ = bodies.shape
+
+    # chain: T sequential compressions over K parallel lanes.
+    parent = [np.zeros((k,), np.uint32) for _ in range(8)]
+    chain = np.zeros((t, k, 8), np.uint32)
+    for turn in range(t):
+        block = [bodies[turn, :, j] for j in range(16)]
+        state = _hash_chain_link(block, parent)
+        for j in range(8):
+            chain[turn, :, j] = state[j]
+        parent = state
+
+    # roots: leaf fold + layer-merged tree reduction (odd-tail
+    # duplication), `ops.merkle.merkle_root_lanes` semantics. Same
+    # bit-reversed layout + `_reduce_tree` as the MTU twin, at the
+    # NATURAL wave width p (the Mosaic kernel pads p to its 128-lane
+    # tile; the root is count-gated, so padding never changes it — the
+    # twin skips the dead columns).
+    from hypervisor_tpu.kernels.mtu_pallas import _bitrev_indices
+
+    p = 1 << max(0, (t - 1).bit_length())
+    leaves = np.zeros((k, p, 8), np.uint32)
+    if t:
+        leaves[:, :t] = np.transpose(chain, (1, 0, 2))
+    lv = leaves[:, _bitrev_indices(p), :]
+    level = [np.ascontiguousarray(lv[:, :, j]) for j in range(8)]
+    cnt = np.full((k, 1), t, np.int32)
+    root = _reduce_tree(level, cnt, np.where)
+    roots = np.stack([w[:, 0] for w in root], axis=1).astype(np.uint32)
+
+    if not has_ring or t == 0:
+        return (
+            chain, roots, np.asarray(ring_body, np.uint32),
+            np.asarray(ring_digest, np.uint32),
+            np.asarray(ring_session, np.int32),
+            np.asarray(ring_turn, np.int32), np.asarray(cursor, np.int32),
+        )
+
+    ring_body = np.array(ring_body, np.uint32, copy=True)
+    ring_digest = np.array(ring_digest, np.uint32, copy=True)
+    ring_session = np.array(ring_session, np.int32, copy=True)
+    ring_turn = np.array(ring_turn, np.int32, copy=True)
+    cursor = np.int32(cursor)
+    capacity = ring_body.shape[0]
+    n_live = np.int32(n_valid) * np.int32(t)
+    bodies_flat = np.transpose(bodies, (1, 0, 2)).reshape(k * t, 16)
+    digests_flat = np.transpose(chain, (1, 0, 2)).reshape(k * t, 8)
+    sess_flat = np.repeat(np.asarray(k_sessions, np.int32), t)
+    turn_flat = np.tile(np.arange(t, dtype=np.int32), k)
+    pos = np.arange(k * t, dtype=np.int32)
+    live = pos < n_live
+    idx = (cursor + pos[live]) % capacity
+    ring_body[idx] = bodies_flat[live]
+    ring_digest[idx] = digests_flat[live]
+    ring_session[idx] = sess_flat[live]
+    ring_turn[idx] = turn_flat[live]
+    return (
+        chain, roots, ring_body, ring_digest, ring_session, ring_turn,
+        np.int32(cursor + n_live),
+    )
+
+
+def _segment_prefix_np(
+    order: np.ndarray, inv: np.ndarray, start_pos: np.ndarray,
+    cols: tuple[np.ndarray, ...],
+) -> tuple[tuple[np.ndarray, np.ndarray], ...]:
+    """`ops.gateway._segment_prefix_many` on numpy: (incl, excl) group
+    prefix sums sharing one sort layout. Integer columns only — exact."""
+    m = len(cols)
+    stacked = np.stack([c.astype(np.int32) for c in cols])
+    v_sorted = stacked[:, order]
+    c = np.cumsum(v_sorted, axis=1, dtype=np.int32)
+    c_before = np.concatenate(
+        [np.zeros((m, 1), np.int32), c[:, :-1]], axis=1
+    )
+    base = c_before[:, start_pos]
+    incl_sorted = c - base
+    excl_sorted = incl_sorted - v_sorted
+    incl = incl_sorted[:, inv]
+    excl = excl_sorted[:, inv]
+    return tuple((incl[i], excl[i]) for i in range(m))
+
+
+def gateway_block_np(
+    agents_f32: np.ndarray,   # f32[N, 8]
+    agents_i32: np.ndarray,   # i32[N, W]
+    agents_ring: np.ndarray,  # i8[N]
+    elev_agent: np.ndarray,   # i32[M]
+    elev_ring: np.ndarray,    # i8[M]
+    elev_expires: np.ndarray, # f32[M]
+    elev_active: np.ndarray,  # bool[M]
+    slot: np.ndarray,         # i32[B]
+    required_ring: np.ndarray,  # i8[B]
+    is_read_only: np.ndarray,   # bool[B]
+    has_consensus: np.ndarray,  # bool[B]
+    has_sre_witness: np.ndarray,  # bool[B]
+    host_tripped: np.ndarray,   # bool[B]
+    valid: np.ndarray,          # bool[B]
+    now: np.ndarray,            # f32[]
+    breach,                     # BreachConfig (static)
+    rate,                       # RateLimitConfig (static)
+    trust,                      # TrustConfig (static)
+):
+    """The gateway megakernel's exact math on numpy arrays — the full
+    `ops.gateway.check_actions` walk (breaker, quarantine, ring, rate,
+    breach-window recording) with its four segment prefixes riding ONE
+    stable sort, minus the metrics/trace tallies (those stay in the
+    enclosing program). Bit-identical, pinned by test_wave_kernels."""
+    b = slot.shape[0]
+    n = agents_ring.shape[0]
+    k = ts.BD_BUCKETS
+    agents_f32 = np.array(agents_f32, np.float32, copy=True)
+    agents_i32 = np.array(agents_i32, np.int32, copy=True)
+    now = np.float32(now)
+    slot = np.clip(np.asarray(slot, np.int32), 0, n - 1)
+    required_ring = np.asarray(required_ring, np.int8)
+    valid = np.asarray(valid, bool)
+
+    flags = agents_i32[:, ts.AI32_FLAGS]
+    bd_window = agents_i32[:, ts.AI32_BD_WIN_START:ts.AI32_BD_WIN_STOP]
+    sigma_eff_col = agents_f32[:, ts.AF32_SIGMA_EFF]
+    rl_tokens = agents_f32[:, ts.AF32_RL_TOKENS]
+    rl_stamp = agents_f32[:, ts.AF32_RL_STAMP]
+    bd_breaker_until = agents_f32[:, ts.AF32_BD_BREAKER_UNTIL]
+
+    # effective rings: scatter-min of live grants onto base rings.
+    live_g = elev_active & (now <= elev_expires)
+    on = elev_agent >= 0
+    best = np.full((n,), 3, np.int8)
+    idx = np.clip(elev_agent, 0, n - 1)
+    np.minimum.at(
+        best, idx[on],
+        np.where(live_g[on], np.asarray(elev_ring, np.int8)[on], np.int8(3)),
+    )
+    eff_all = np.minimum(agents_ring.astype(np.int8), best)
+    eff = eff_all[slot]
+    sigma = sigma_eff_col[slot]
+    flags_at = flags[slot]
+
+    # gate 1: breaker (both planes + in-wave prefix trips).
+    pre_dev_live = ((flags_at & ts.FLAG_BREAKER_TRIPPED) != 0) & (
+        now < bd_breaker_until[slot]
+    )
+    sub = np.float32(breach.window_seconds / ts.BD_BUCKETS)
+    cur = np.int32(np.floor(now / sub))
+    epochs = bd_window[:, 2 * k:]
+    live_b = epochs > cur - k
+    base_calls = np.sum(np.where(live_b, bd_window[:, :k], 0), axis=1)
+    base_priv = np.sum(np.where(live_b, bd_window[:, k:2 * k], 0), axis=1)
+
+    order = np.argsort(slot, kind="stable")
+    s_sorted = slot[order]
+    idxs = np.arange(b, dtype=np.int32)
+    is_start = np.concatenate([[True], s_sorted[1:] != s_sorted[:-1]])
+    start_pos = np.maximum.accumulate(np.where(is_start, idxs, 0))
+    inv = np.zeros((b,), np.int32)
+    inv[order] = idxs
+
+    ones = valid.astype(np.int32)
+    privileged = (required_ring < eff) & valid
+    (k_incl, _), (p_incl, _) = _segment_prefix_np(
+        order, inv, start_pos, (ones, privileged.astype(np.int32))
+    )
+    total_i = base_calls[slot] + k_incl
+    priv_i = base_priv[slot] + p_incl
+    analyzable = total_i >= breach.min_calls_for_analysis
+    rate_i = np.where(
+        analyzable,
+        priv_i.astype(np.float32) / np.maximum(total_i, 1).astype(np.float32),
+        np.float32(0.0),
+    ).astype(np.float32)
+    cond = (
+        analyzable & (rate_i >= np.float32(breach.high_threshold)) & valid
+    ).astype(np.int32)
+    ((_, cond_before),) = _segment_prefix_np(order, inv, start_pos, (cond,))
+    live = (pre_dev_live | host_tripped | (cond_before > 0)) & valid
+
+    trip_action = (cond != 0) & ~live & valid
+    severity = _severity_math(rate_i, analyzable, live | ~valid, breach, np.where)
+    anomaly_rate = np.where(severity > 0, rate_i, np.float32(0.0)).astype(
+        np.float32
+    )
+
+    quarantined = (flags_at & ts.FLAG_QUARANTINED) != 0
+    refused_quar = ~live & quarantined & ~is_read_only & valid
+    ring_status = _ring_check_math(
+        eff, required_ring, sigma, has_consensus, has_sre_witness,
+        trust.ring1_threshold, trust.ring2_threshold, np.where,
+    )
+    refused_ring = ~live & ~refused_quar & (ring_status != _CHECK_OK) & valid
+
+    reaching = valid & ~(live | refused_quar | refused_ring)
+    ring_for_rate = np.array(agents_ring, np.int8, copy=True)
+    ring_for_rate[slot[valid]] = eff[valid]
+    rates = np.asarray(rate.ring_rates, np.float32)
+    bursts = np.asarray(rate.ring_bursts, np.float32)
+    rr = np.clip(ring_for_rate.astype(np.int32), 0, 3)
+    refilled = _refill_math(
+        rl_tokens, rl_stamp, rates[rr], bursts[rr], now, np.where
+    ).astype(np.float32)
+    ((r_incl, _),) = _segment_prefix_np(
+        order, inv, start_pos, (reaching.astype(np.int32),)
+    )
+    rate_ok = r_incl.astype(np.float32) <= refilled[slot]
+    allowed = reaching & rate_ok
+
+    verdict = np.where(
+        ~valid, _GATE_INVALID,
+        np.where(
+            live, _GATE_BREAKER,
+            np.where(
+                refused_quar, _GATE_QUARANTINED,
+                np.where(
+                    refused_ring, _GATE_RING,
+                    np.where(allowed, _GATE_ALLOWED, _GATE_RATE),
+                ),
+            ),
+        ),
+    ).astype(np.int8)
+
+    # post-state: the [N, 4] accumulations, breaker flags, buckets.
+    row_adds = np.zeros((n, 4), np.float32)
+    np.add.at(
+        row_adds, slot,
+        np.stack(
+            [
+                ones.astype(np.float32),
+                privileged.astype(np.float32),
+                trip_action.astype(np.float32),
+                allowed.astype(np.float32),
+            ],
+            axis=1,
+        ),
+    )
+    calls_add = row_adds[:, 0].astype(np.int32)
+    priv_add = row_adds[:, 1].astype(np.int32)
+    tripped_rows = row_adds[:, 2] > 0.0
+    expired = (
+        ((flags & ts.FLAG_BREAKER_TRIPPED) != 0)
+        & (now >= bd_breaker_until)
+        & ~tripped_rows
+    )
+    new_flags = np.where(expired, flags & ~ts.FLAG_BREAKER_TRIPPED, flags)
+    new_flags = np.where(
+        tripped_rows, new_flags | ts.FLAG_BREAKER_TRIPPED, new_flags
+    )
+    new_until = np.where(
+        tripped_rows,
+        now + np.float32(breach.circuit_breaker_cooldown_seconds),
+        bd_breaker_until,
+    ).astype(np.float32)
+
+    # window_commit (`ops.security_ops`): epoch-mod-K bucket fold.
+    j0 = int(cur % k)
+    touched = calls_add > 0
+    stamp = bd_window[:, 2 * k + j0]
+    stale = stamp > cur
+    keep = (stamp == cur) | stale
+    new_calls = np.where(keep, bd_window[:, j0], 0) + calls_add
+    new_priv = np.where(keep, bd_window[:, k + j0], 0) + priv_add
+    new_stamp = np.where(stale, stamp, cur)
+    bd_window = np.array(bd_window, np.int32, copy=True)
+    bd_window[:, j0] = np.where(touched, new_calls, bd_window[:, j0])
+    bd_window[:, k + j0] = np.where(touched, new_priv, bd_window[:, k + j0])
+    bd_window[:, 2 * k + j0] = np.where(
+        touched, new_stamp, bd_window[:, 2 * k + j0]
+    )
+
+    grants = row_adds[:, 3]
+    agents_f32[:, ts.AF32_RL_TOKENS] = refilled - grants
+    agents_f32[:, ts.AF32_RL_STAMP] = now
+    agents_f32[:, ts.AF32_BD_BREAKER_UNTIL] = new_until
+    agents_i32[:, ts.AI32_FLAGS] = new_flags
+    agents_i32[:, ts.AI32_BD_WIN_START:ts.AI32_BD_WIN_STOP] = bd_window
+    return (
+        agents_f32, agents_i32, verdict,
+        ring_status.astype(np.int8), eff.astype(np.int8),
+        sigma.astype(np.float32), severity, anomaly_rate,
+        total_i.astype(np.int32), trip_action,
+    )
+
+
+#: Fixed gauge-slot order of the epilogue block's occupancy vector —
+#: must mirror `observability.metrics.occupancy_gauge_layout`.
+EPILOGUE_GAUGES = 17
+
+
+def epilogue_block_np(
+    agents_f32, agents_i32, agents_ring,
+    sess_i32, sess_f32,
+    vouch_voucher, vouch_vouchee, vouch_bond, vouch_bond_pct, vouch_active,
+    saga_step_state, saga_state, saga_session, saga_n_steps, saga_cursor,
+    elev_agent, elev_ring, elev_active,
+    delta_session, delta_turn, delta_cursor,
+    event_cursor, trace_cursor,
+    ring_bursts,
+    sanitize: bool,
+    has_elevs: bool,
+    has_delta: bool,
+    has_trace: bool,
+    ring2_threshold: float,
+    event_capacity: int = 1,
+    trace_capacity: int = 1,
+    session_states: int = 5,
+    consistency_modes: int = 2,
+    saga_states: int = 5,
+    step_states: int = 7,
+    escrow_cap: float = 1.0 + 1e-4,
+):
+    """The epilogue megakernel's exact math on numpy arrays: the
+    occupancy-gauge reductions (`observability.metrics.update_gauges`'s
+    count set, fixed slot order) and — when `sanitize` — the invariant
+    sanitizer's per-table violation masks + totals
+    (`integrity.invariants.check_invariants`). Counts are integer-exact
+    by construction (the `ops.tally` matvec counts the same values).
+    """
+    agents_f32 = np.asarray(agents_f32, np.float32)
+    agents_i32 = np.asarray(agents_i32, np.int32)
+    agents_ring = np.asarray(agents_ring, np.int8)
+    sess_i32 = np.asarray(sess_i32, np.int32)
+    sess_f32 = np.asarray(sess_f32, np.float32)
+    n = agents_ring.shape[0]
+    sc = sess_i32.shape[0]
+
+    flags = agents_i32[:, ts.AI32_FLAGS]
+    active = (flags & ts.FLAG_ACTIVE) != 0
+    did = agents_i32[:, ts.AI32_DID]
+    sid = sess_i32[:, ts.SI32_SID]
+    sess_state = sess_i32[:, ts.SI32_STATE]
+
+    cnt = lambda m: np.int32(np.count_nonzero(m))  # noqa: E731
+    gauges = np.zeros((EPILOGUE_GAUGES,), np.int32)
+    for r in range(4):
+        gauges[r] = cnt(active & (agents_ring == r))
+    gauges[4] = cnt(active)
+    gauges[5] = cnt(active & ((flags & ts.FLAG_QUARANTINED) != 0))
+    gauges[6] = cnt(active & ((flags & ts.FLAG_BREAKER_TRIPPED) != 0))
+    sess_live = (sid >= 0) & (
+        (sess_state == _S_HANDSHAKING) | (sess_state == _S_ACTIVE)
+    )
+    gauges[7] = cnt(sess_live)
+    gauges[8] = cnt(vouch_active)
+    gauges[9] = cnt(did >= 0)
+    gauges[10] = cnt(sid >= 0)
+    gauges[11] = gauges[8]
+    gauges[12] = cnt(np.asarray(saga_session, np.int32) >= 0)
+    gauges[13] = cnt(elev_active) if has_elevs else 0
+    c_delta = np.asarray(delta_session, np.int32).shape[0]
+    gauges[14] = (
+        np.int32(min(int(delta_cursor), c_delta)) if has_delta else 0
+    )
+    gauges[15] = np.int32(min(int(event_cursor), event_capacity))
+    gauges[16] = (
+        np.int32(min(int(trace_cursor), trace_capacity)) if has_trace else 0
+    )
+
+    e = np.asarray(vouch_voucher, np.int32).shape[0]
+    g = np.asarray(saga_session, np.int32).shape[0]
+    m = np.asarray(elev_agent, np.int32).shape[0] if has_elevs else 0
+    zero = np.int32(0)
+    if not sanitize:
+        return (
+            gauges,
+            np.zeros((n,), np.uint32), np.zeros((sc,), np.uint32),
+            np.zeros((e,), np.uint32), np.zeros((g,), np.uint32),
+            np.zeros((max(m, 1),), np.uint32), np.zeros((3,), np.uint32),
+            zero, zero,
+        )
+
+    # ── the invariant sanitizer (integrity.invariants) ───────────────
+    from hypervisor_tpu.integrity import invariants as inv
+
+    finite = np.isfinite
+    sigma_raw = agents_f32[:, ts.AF32_SIGMA_RAW]
+    sigma_eff = agents_f32[:, ts.AF32_SIGMA_EFF]
+    rl_tokens = agents_f32[:, ts.AF32_RL_TOKENS]
+    allocated = did >= 0
+    amask = np.zeros((n,), np.uint32)
+    sigma_bad = allocated & ~(
+        finite(sigma_raw) & finite(sigma_eff)
+        & (sigma_raw >= 0.0) & (sigma_raw <= 1.0)
+        & (sigma_eff >= 0.0) & (sigma_eff <= 1.0)
+    )
+    amask |= np.where(sigma_bad, np.uint32(inv.A_SIGMA_RANGE), 0)
+    ring_i = agents_ring.astype(np.int32)
+    ring_bad = (ring_i < 0) | (ring_i > 3)
+    amask |= np.where(ring_bad, np.uint32(inv.A_RING_RANGE), 0)
+    priv_bad = (
+        active & ~ring_bad & (ring_i <= 1)
+        & (sigma_eff < np.float32(ring2_threshold))
+    )
+    amask |= np.where(priv_bad, np.uint32(inv.A_RING_SIGMA), 0)
+    max_burst = np.max(np.asarray(ring_bursts, np.float32))
+    tokens_bad = allocated & ~(
+        finite(rl_tokens) & (rl_tokens >= 0.0) & (rl_tokens <= max_burst)
+    )
+    amask |= np.where(tokens_bad, np.uint32(inv.A_RL_TOKENS), 0)
+    flags_bad = (flags & ~ts.KNOWN_FLAGS_MASK) != 0
+    amask |= np.where(flags_bad, np.uint32(inv.A_FLAGS), 0)
+    agents_session = agents_i32[:, ts.AI32_SESSION]
+    sess_bad = active & ((agents_session < -1) | (agents_session >= sc))
+    amask |= np.where(sess_bad, np.uint32(inv.A_SESSION_REF), 0)
+
+    smask = np.zeros((sc,), np.uint32)
+    s_live = sid >= 0
+    state_bad = s_live & ((sess_state < 0) | (sess_state >= session_states))
+    smask |= np.where(state_bad, np.uint32(inv.S_STATE_CODE), 0)
+    mode = sess_i32[:, ts.SI32_MODE]
+    mode_bad = s_live & ((mode < 0) | (mode >= consistency_modes))
+    smask |= np.where(mode_bad, np.uint32(inv.S_MODE_CODE), 0)
+    npart = sess_i32[:, ts.SI32_NPART]
+    npart_bad = s_live & (
+        (npart < 0) | (npart > sess_i32[:, ts.SI32_MAX_PARTICIPANTS])
+    )
+    smask |= np.where(npart_bad, np.uint32(inv.S_NPART), 0)
+    time_bad = s_live & ~(
+        finite(sess_f32[:, ts.SF32_CREATED_AT])
+        & (sess_f32[:, ts.SF32_MAX_DURATION] >= 0.0)
+    )
+    smask |= np.where(time_bad, np.uint32(inv.S_TIME), 0)
+    session_restore = state_bad | mode_bad | time_bad
+
+    vouch_voucher = np.asarray(vouch_voucher, np.int32)
+    vouch_vouchee = np.asarray(vouch_vouchee, np.int32)
+    vouch_bond = np.asarray(vouch_bond, np.float32)
+    vouch_active = np.asarray(vouch_active, bool)
+    vmask = np.zeros((e,), np.uint32)
+    endpoint_bad = vouch_active & (
+        (vouch_voucher < 0) | (vouch_voucher >= n)
+        | (vouch_vouchee < 0) | (vouch_vouchee >= n)
+    )
+    vmask |= np.where(endpoint_bad, np.uint32(inv.V_ENDPOINT), 0)
+    bond_bad = vouch_active & ~(
+        finite(vouch_bond) & (vouch_bond >= 0.0)
+        & (np.asarray(vouch_bond_pct, np.float32) >= 0.0)
+        & (np.asarray(vouch_bond_pct, np.float32) <= 1.0)
+    )
+    vmask |= np.where(bond_bad, np.uint32(inv.V_BOND), 0)
+    safe = np.clip(vouch_voucher, 0, n - 1)
+    contrib = np.where(
+        vouch_active & ~endpoint_bad,
+        np.nan_to_num(vouch_bond, nan=0.0, posinf=3.4e38, neginf=0.0),
+        np.float32(0.0),
+    ).astype(np.float32)
+    escrow = np.zeros((n,), np.float32)
+    np.add.at(escrow, safe, contrib)
+    escrow_bad = vouch_active & ~endpoint_bad & (
+        escrow[safe] > np.float32(escrow_cap)
+    )
+    vmask |= np.where(escrow_bad, np.uint32(inv.V_ESCROW), 0)
+
+    saga_state = np.asarray(saga_state, np.int8)
+    saga_session = np.asarray(saga_session, np.int32)
+    saga_cursor = np.asarray(saga_cursor, np.int32)
+    saga_n_steps = np.asarray(saga_n_steps, np.int32)
+    saga_step_state = np.asarray(saga_step_state, np.int8)
+    max_steps = saga_step_state.shape[1]
+    g_live = saga_session >= 0
+    gmask = np.zeros((g,), np.uint32)
+    g_state_bad = g_live & ((saga_state < 0) | (saga_state >= saga_states))
+    gmask |= np.where(g_state_bad, np.uint32(inv.G_STATE), 0)
+    cursor_bad = g_live & ((saga_cursor < 0) | (saga_cursor > max_steps))
+    gmask |= np.where(cursor_bad, np.uint32(inv.G_CURSOR), 0)
+    nsteps_bad = g_live & ((saga_n_steps < 0) | (saga_n_steps > max_steps))
+    gmask |= np.where(nsteps_bad, np.uint32(inv.G_NSTEPS), 0)
+    step_bad = g_live & np.any(
+        (saga_step_state < 0) | (saga_step_state >= step_states), axis=1
+    )
+    gmask |= np.where(step_bad, np.uint32(inv.G_STEP_STATE), 0)
+    saga_restore = g_state_bad | cursor_bad | nsteps_bad | step_bad
+
+    if has_elevs:
+        elev_agent = np.asarray(elev_agent, np.int32)
+        er = np.asarray(elev_ring, np.int8).astype(np.int32)
+        ebad = np.asarray(elev_active, bool) & (
+            (elev_agent < 0) | (elev_agent >= n) | (er < 0) | (er > 3)
+        )
+        emask = np.where(ebad, np.uint32(inv.E_RANGE), np.uint32(0))
+    else:
+        emask = np.zeros((1,), np.uint32)
+
+    # DeltaLog ring bits (turn-chain contiguity pact).
+    delta_bits = np.uint32(0)
+    if has_delta:
+        cur = np.int32(delta_cursor)
+        if cur < 0:
+            delta_bits |= np.uint32(inv.L_CURSOR)
+        live_rows = np.arange(c_delta, dtype=np.int32) < min(
+            max(int(cur), 0), c_delta
+        )
+        d_sess = np.asarray(delta_session, np.int32)
+        d_turn = np.asarray(delta_turn, np.int32)
+        tracked = live_rows & (d_sess >= 0)
+        row_bad = live_rows & (
+            (d_sess < -1) | (d_sess >= sc) | (tracked & (d_turn < 0))
+        )
+        if np.count_nonzero(row_bad) > 0:
+            delta_bits |= np.uint32(inv.L_DELTA_ROW)
+        safe_s = np.clip(d_sess, 0, sc - 1)
+        big = np.int32(2**30)
+        count = np.zeros((sc,), np.int32)
+        tsum = np.zeros((sc,), np.int32)
+        tmax = np.full((sc,), -big, np.int32)
+        tmin_neg = np.full((sc,), -big, np.int32)
+        np.add.at(count, safe_s, np.where(tracked, 1, 0))
+        np.add.at(tsum, safe_s, np.where(tracked, d_turn, 0))
+        np.maximum.at(tmax, safe_s, np.where(tracked, d_turn, -big))
+        np.maximum.at(tmin_neg, safe_s, np.where(tracked, -d_turn, -big))
+        tmin = -tmin_neg
+        present = count > 0
+        contiguous = count == (tmax - tmin + 1)
+        series = 2 * tsum == (tmin + tmax) * count
+        if np.count_nonzero(present & ~(contiguous & series)) > 0:
+            delta_bits |= np.uint32(inv.L_TURN_CHAIN)
+    event_bits = (
+        np.uint32(inv.L_CURSOR) if int(event_cursor) < 0 else np.uint32(0)
+    )
+    trace_bits = (
+        np.uint32(inv.L_CURSOR)
+        if has_trace and int(trace_cursor) < 0
+        else np.uint32(0)
+    )
+    log_mask = np.array([delta_bits, event_bits, trace_bits], np.uint32)
+
+    violation_flags = np.concatenate([
+        amask != 0, smask != 0, vmask != 0, gmask != 0, emask != 0,
+        log_mask != 0,
+    ])
+    total = np.int32(np.count_nonzero(violation_flags))
+    agent_restore = np.zeros((n,), bool)
+    restore_flags = np.concatenate([
+        agent_restore, session_restore, escrow_bad, saga_restore,
+        log_mask != 0,
+    ])
+    unrepairable = np.int32(np.count_nonzero(restore_flags))
+    return (
+        gauges, amask, smask, vmask, gmask, emask, log_mask,
+        total, unrepairable,
+    )
+
+
+def saga_tick_block_np(
+    step_state: np.ndarray,    # i8[G, M]
+    retries_left: np.ndarray,  # i8[G, M]
+    has_undo: np.ndarray,      # bool[G, M]
+    saga_state: np.ndarray,    # i8[G]
+    n_steps: np.ndarray,       # i32[G]
+    cursor: np.ndarray,        # i32[G]
+    exec_success: np.ndarray,  # bool[G]
+    undo_success: np.ndarray,  # bool[G]
+    exec_attempted: np.ndarray,  # bool[G]
+    undo_attempted: np.ndarray,  # bool[G]
+):
+    """The saga-round megakernel's exact math on numpy arrays: the
+    forward cursor booking (retry ladder), the reverse-order
+    compensation-target selection (highest committed column), and the
+    settle pass — `ops.saga_ops.saga_table_tick`'s core, minus the
+    metrics tallies (those stay with the caller)."""
+    step_state = np.array(step_state, np.int8, copy=True)
+    retries_left = np.array(retries_left, np.int8, copy=True)
+    saga_state = np.array(saga_state, np.int8, copy=True)
+    cursor = np.array(cursor, np.int32, copy=True)
+    g, m = step_state.shape
+    rows = np.arange(g, dtype=np.int32)
+    cols = np.arange(m, dtype=np.int32)[None, :]
+
+    running = saga_state == _SAGA_RUNNING
+    compensating = saga_state == _SAGA_COMPENSATING
+    in_range = cursor < n_steps
+
+    cur = np.clip(cursor, 0, m - 1)
+    cur_state = step_state[rows, cur]
+    attempt = running & in_range & (cur_state == _STEP_PENDING) & exec_attempted
+    committed = attempt & exec_success
+    exhausted = attempt & ~exec_success & (retries_left[rows, cur] <= 0)
+    retrying = attempt & ~exec_success & (retries_left[rows, cur] > 0)
+    step_state[rows, cur] = np.where(
+        committed, _STEP_COMMITTED,
+        np.where(exhausted, _STEP_FAILED, cur_state),
+    ).astype(np.int8)
+    retries_left[rows, cur] += np.where(retrying, -1, 0).astype(np.int8)
+    cursor = np.where(committed, cursor + 1, cursor)
+
+    finished = running & (cursor >= n_steps) & (n_steps > 0)
+    saga_state = np.where(
+        exhausted, _SAGA_COMPENSATING,
+        np.where(finished, _SAGA_COMPLETED, saga_state),
+    ).astype(np.int8)
+
+    is_committed = step_state == _STEP_COMMITTED
+    target = np.max(np.where(is_committed, cols, -1), axis=1)
+    has_target = compensating & (target >= 0) & undo_attempted
+    tcol = np.clip(target, 0, m - 1)
+    undo_ok = has_target & has_undo[rows, tcol] & undo_success
+    step_state[rows, tcol] = np.where(
+        undo_ok, _STEP_COMPENSATED,
+        np.where(has_target, _STEP_COMP_FAILED, step_state[rows, tcol]),
+    ).astype(np.int8)
+
+    still_committed = np.any(step_state == _STEP_COMMITTED, axis=1)
+    any_comp_failed = np.any(step_state == _STEP_COMP_FAILED, axis=1)
+    settled = compensating & ~still_committed
+    saga_state = np.where(
+        settled & any_comp_failed, _SAGA_ESCALATED,
+        np.where(settled, _SAGA_COMPLETED, saga_state),
+    ).astype(np.int8)
+    return step_state, retries_left, saga_state, cursor, committed, exhausted
+
+
+# ── Mosaic kernels ───────────────────────────────────────────────────
+#
+# One launch per block. Tables ride VMEM whole (the caps below guard
+# the envelope) and alias in->out (`input_output_aliases`), so row
+# writes land in place and untouched columns cost nothing — the
+# donation contract, inside the kernel. Per-lane dynamic work runs as
+# in-kernel fori loops over `pl.ds` loads/stores; lane vectors live as
+# [1, B] rows. The kernels execute the SAME shared math as the twins
+# above; like the MTU, the compiled path is exercised on the real chip
+# only (standing caveat: the wedged tunnel), and the twins + the XLA
+# reference pin the math everywhere else.
+#
+# Kernel map (docs/OPERATIONS.md "Dispatch & fusion"):
+#   admission_block_pallas  — gathers + ladder + bitonic rank + row
+#                             writes + count scatter, ONE launch
+#   fsm_saga_block_pallas   — FSM walks + saga step + terminate
+#                             release, ONE launch (wave-range layout —
+#                             the contract every bridge wave satisfies)
+#   audit: chain + tree ride the EXISTING MTU launches
+#          (`mtu_pallas.chain_digests_mtu` / `tree_roots`);
+#          ring_append_pallas completes the phase in one more launch
+#   saga_tick_block_pallas  — the standalone saga round's cursor
+#                             advance + compensation selection
+#   gateway / epilogue      — next rung: their Mosaic forms are staged
+#                             behind `ops.wave_blocks` (inline XLA on
+#                             chip today, twin boundary on CPU), so
+#                             landing them later is a dispatch-table
+#                             edit, not a refactor.
+
+#: VMEM envelope caps: an N-agent table is N * (8 + 21) * 4 B plus the
+#: lane blocks; 32k rows ≈ 3.7 MB — comfortably inside a TPU core's
+#: ~16 MB VMEM next to the lane state, but cap it so a grown capacity
+#: can't silently compile an over-VMEM kernel (the TREE_MAX_LEAVES
+#: rule in mtu_pallas).
+WAVE_MAX_AGENTS = 32_768
+WAVE_MAX_SESSIONS = 32_768
+WAVE_MAX_EDGES = 131_072
+WAVE_MAX_LANES = 16_384
+
+
+def wave_shapes_fit(n: int, sc: int, e: int, b: int) -> bool:
+    """True when the whole-wave kernels' VMEM envelope holds the
+    tables; dispatch falls back to the XLA forms otherwise."""
+    return (
+        n <= WAVE_MAX_AGENTS
+        and sc <= WAVE_MAX_SESSIONS
+        and e <= WAVE_MAX_EDGES
+        and b <= WAVE_MAX_LANES
+    )
+
+
+def _row2(x, dt):
+    return jnp.asarray(x, dt).reshape(1, -1)
+
+
+def _scalar2(x, dt):
+    return jnp.asarray(x, dt).reshape(1, 1)
+
+
+def _bitonic_rank(keys):
+    """(orig_lane i32[1, B], rank_sorted i32[1, B]) via a bitonic
+    network on (key, lane) pairs packed into one i32 word —
+    compare-exchange stages expressed as reshapes + wheres (no
+    gathers), so the whole sort lives in vector registers. B must be a
+    power of two; keys must fit above the lane bits (the dispatch caps
+    guarantee both). The rank itself is sort-algorithm-independent, so
+    the numpy twin's stable argsort produces identical values."""
+    b = keys.shape[-1]
+    lane_bits = max(1, (b - 1).bit_length())
+    packed = (keys << np.int32(lane_bits)) | jnp.arange(
+        b, dtype=jnp.int32
+    ).reshape(1, b)
+    size = 2
+    while size <= b:
+        stride = size // 2
+        while stride >= 1:
+            x = packed.reshape(-1, 2 * stride)
+            lo, hi = x[:, :stride], x[:, stride:]
+            mn, mx = jnp.minimum(lo, hi), jnp.maximum(lo, hi)
+            blocks = jnp.arange(x.shape[0], dtype=jnp.int32)[:, None]
+            asc = (blocks * (2 * stride) // size) % 2 == 0
+            packed = jnp.concatenate(
+                [jnp.where(asc, mn, mx), jnp.where(asc, mx, mn)], axis=1
+            ).reshape(1, b)
+            stride //= 2
+        size *= 2
+    lane_mask = np.int32((1 << lane_bits) - 1)
+    sorted_keys = packed >> np.int32(lane_bits)
+    orig_lane = packed & lane_mask
+    idx = jnp.arange(b, dtype=jnp.int32).reshape(1, b)
+    is_new = jnp.concatenate(
+        [jnp.ones((1, 1), bool), sorted_keys[:, 1:] != sorted_keys[:, :-1]],
+        axis=1,
+    )
+    # group-start prefix max by doubling (log B shifted selects).
+    start = jnp.where(is_new, idx, 0)
+    shift = 1
+    while shift < b:
+        shifted = jnp.concatenate(
+            [jnp.zeros((1, shift), jnp.int32), start[:, :-shift]], axis=1
+        )
+        start = jnp.maximum(start, shifted)
+        shift *= 2
+    return orig_lane, idx - start
+
+
+def _admission_kernel(
+    b, unique_sessions, ring2_threshold,
+    # inputs (tables aliased to the first four outputs)
+    af32_in, ai32_in, ring_in, si32_in, sf32_in,
+    slot_ref, did_ref, sess_ref, sigma_ref, contrib_ref, trust_ref,
+    dup_ref, scal_ref, bursts_ref,
+    # outputs
+    af32_out, ai32_out, ring_table_out, si32_out,
+    status_ref, ring_out_ref, sigma_out_ref,
+):
+    omega = scal_ref[0, 0]
+    now = scal_ref[0, 1]
+
+    def gather_i32(ref, idx, col):
+        def body(i, acc):
+            v = pl.load(ref, (pl.ds(idx[0, i], 1), pl.ds(col, 1)))
+            return acc.at[0, i].set(v[0, 0])
+
+        return jax.lax.fori_loop(0, b, body, jnp.zeros((1, b), ref.dtype))
+
+    sess = sess_ref[0:1, :]
+    sess_state = gather_i32(si32_in, sess, ts.SI32_STATE)
+    sess_count = gather_i32(si32_in, sess, ts.SI32_NPART)
+    sess_max = gather_i32(si32_in, sess, ts.SI32_MAX_PARTICIPANTS)
+    sess_min = gather_i32(sf32_in, sess, ts.SF32_MIN_SIGMA)
+
+    sigma_eff = jnp.minimum(sigma_ref[0:1, :] + omega * contrib_ref[0:1, :], 1.0)
+    ring = _compute_rings(sigma_eff, ring2_threshold, jnp.where)
+    ring = jnp.where(trust_ref[0:1, :] != 0, ring, np.int8(3)).astype(jnp.int8)
+    bad_state = (sess_state != _S_HANDSHAKING) & (sess_state != _S_ACTIVE)
+    sigma_low = (sigma_eff < sess_min) & (ring != 3)
+    status = jnp.zeros((1, b), jnp.int8)
+    status = _claim(status, bad_state, _ADMIT_BAD_STATE, jnp.where)
+    status = _claim(status, dup_ref[0:1, :] != 0, _ADMIT_DUPLICATE, jnp.where)
+    status = _claim(status, sigma_low, _ADMIT_SIGMA_LOW, jnp.where)
+    passed = status == _ADMIT_OK
+    if unique_sessions:
+        rank = jnp.zeros((1, b), jnp.int32)
+    else:
+        lanes = jnp.arange(b, dtype=jnp.int32).reshape(1, b)
+        keys = jnp.where(passed, sess, -1 - lanes)
+        orig_lane, rank_sorted = _bitonic_rank(keys)
+
+        def unperm(i, acc):
+            return acc.at[0, orig_lane[0, i]].set(rank_sorted[0, i])
+
+        rank = jax.lax.fori_loop(0, b, unperm, jnp.zeros((1, b), jnp.int32))
+    over = passed & ((sess_count + rank) >= sess_max)
+    status = _claim(status, over, _ADMIT_CAPACITY, jnp.where)
+    ok = status == _ADMIT_OK
+
+    status_ref[0:1, :] = status
+    ring_out_ref[0:1, :] = ring
+    sigma_out_ref[0:1, :] = sigma_eff
+    bursts = bursts_ref[0, :]
+
+    def write(i, _):
+        @pl.when(ok[0, i])
+        def _():
+            row = slot_ref[0, i]
+            s = sess[0, i]
+            r32 = jnp.clip(ring[0, i].astype(jnp.int32), 0, 3)
+            f32_row = (
+                jnp.zeros((1, 8), jnp.float32)
+                .at[0, ts.AF32_SIGMA_RAW].set(sigma_ref[0, i])
+                .at[0, ts.AF32_SIGMA_EFF].set(sigma_eff[0, i])
+                .at[0, ts.AF32_JOINED_AT].set(now)
+                .at[0, ts.AF32_RL_TOKENS].set(bursts[r32])
+                .at[0, ts.AF32_RL_STAMP].set(now)
+            )
+            i32_row = (
+                jnp.zeros((1, ts.AI32_WIDTH), jnp.int32)
+                .at[0, ts.AI32_DID].set(did_ref[0, i])
+                .at[0, ts.AI32_SESSION].set(s)
+                .at[0, ts.AI32_FLAGS].set(ts.FLAG_ACTIVE)
+            )
+            pl.store(af32_out, (pl.ds(row, 1), slice(None)), f32_row)
+            pl.store(ai32_out, (pl.ds(row, 1), slice(None)), i32_row)
+            pl.store(
+                ring_table_out, (pl.ds(row, 1), slice(None)),
+                ring[0:1, i].reshape(1, 1),
+            )
+            cnt = pl.load(si32_out, (pl.ds(s, 1), pl.ds(ts.SI32_NPART, 1)))
+            pl.store(si32_out, (pl.ds(s, 1), pl.ds(ts.SI32_NPART, 1)), cnt + 1)
+        return 0
+
+    jax.lax.fori_loop(0, b, write, 0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("ring2_threshold", "unique_sessions", "interpret"),
+)
+def admission_block_pallas(
+    agents_f32, agents_i32, agents_ring, sess_i32, sess_f32,
+    slot, did, session_slot, sigma_raw, contribution, omega,
+    trustworthy, duplicate, now, bursts,
+    ring2_threshold: float, unique_sessions: bool, interpret: bool = False,
+):
+    """The admission megakernel: ONE `pallas_call`, tables aliased
+    in->out so the packed row writes and the participant-count scatter
+    land in place. Math oracle: `admission_block_np` (bit-identical —
+    the twin-parity tests pin the shared helpers)."""
+    b = slot.shape[0]
+    n = agents_ring.shape[0]
+    sc = sess_i32.shape[0]
+    assert wave_shapes_fit(n, sc, 0, b)
+    assert b & (b - 1) == 0 or unique_sessions, (
+        "the in-kernel bitonic rank needs a power-of-two lane count"
+    )
+    kernel = functools.partial(
+        _admission_kernel, b, unique_sessions, float(ring2_threshold)
+    )
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        kernel,
+        in_specs=[vmem] * 14,
+        out_specs=[vmem] * 7,
+        out_shape=[
+            jax.ShapeDtypeStruct(agents_f32.shape, jnp.float32),
+            jax.ShapeDtypeStruct(agents_i32.shape, jnp.int32),
+            jax.ShapeDtypeStruct((n, 1), jnp.int8),
+            jax.ShapeDtypeStruct(sess_i32.shape, jnp.int32),
+            jax.ShapeDtypeStruct((1, b), jnp.int8),
+            jax.ShapeDtypeStruct((1, b), jnp.int8),
+            jax.ShapeDtypeStruct((1, b), jnp.float32),
+        ],
+        input_output_aliases={0: 0, 1: 1, 2: 2, 3: 3},
+        interpret=interpret,
+    )(
+        agents_f32, agents_i32, agents_ring.reshape(n, 1), sess_i32,
+        sess_f32,
+        _row2(slot, jnp.int32), _row2(did, jnp.int32),
+        _row2(session_slot, jnp.int32), _row2(sigma_raw, jnp.float32),
+        _row2(contribution, jnp.float32), _row2(trustworthy, jnp.int8),
+        _row2(duplicate, jnp.int8),
+        jnp.stack([
+            jnp.asarray(omega, jnp.float32), jnp.asarray(now, jnp.float32)
+        ]).reshape(1, 2),
+        jnp.asarray(bursts, jnp.float32).reshape(1, 4),
+    )
+    af32, ai32, ring_t, si32, status, ring_out, sigma_out = outs
+    return (
+        af32, ai32, ring_t.reshape(n), si32,
+        status[0], ring_out[0], sigma_out[0],
+    )
+
+
+def _fsm_saga_kernel(
+    k, b, bits, active_code, terminating_code, archived_code,
+    ai32_in, si32_in, sf32_in, vsess_ref, vact_in,
+    ksess_ref, ok_ref, scal_ref,
+    ai32_out, si32_out, sf32_out, vact_out,
+    step_ref, wstate_ref, err_ref, released_ref,
+):
+    now = scal_ref[0, 0]
+    lo = scal_ref[0, 1].astype(jnp.int32)
+    hi = scal_ref[0, 2].astype(jnp.int32)
+
+    def gather_i32(ref, idx, col, dtype=jnp.int32):
+        def body(i, acc):
+            v = pl.load(ref, (pl.ds(idx[0, i], 1), pl.ds(col, 1)))
+            return acc.at[0, i].set(v[0, 0])
+
+        return jax.lax.fori_loop(0, k, body, jnp.zeros((1, k), dtype))
+
+    ksess = ksess_ref[0:1, :]
+    state0 = gather_i32(si32_in, ksess, ts.SI32_STATE).astype(jnp.int8)
+    npart = gather_i32(si32_in, ksess, ts.SI32_NPART)
+    old_term = gather_i32(sf32_in, ksess, ts.SF32_TERMINATED_AT, jnp.float32)
+    has_members = npart > 0
+
+    wave_state, err = _fsm_walk_math(
+        state0, has_members, bits, (active_code,), jnp.where
+    )
+    step_ref[0:1, :] = _execute_attempt_math(ok_ref[0:1, :] != 0, jnp.where)
+
+    # terminate: range compares (the wave-range contract — callers
+    # without it keep the XLA form, `ops.wave_blocks` dispatch).
+    vsess = vsess_ref[:, 0:1]
+    edge_hit = (vact_in[:, 0:1] != 0) & (vsess >= lo) & (vsess < hi)
+    vact_out[:, :] = jnp.where(edge_hit, np.int8(0), vact_in[:, :])
+    released_ref[0, 0] = jnp.sum(edge_hit.astype(jnp.int32))
+
+    asess = ai32_in[:, ts.AI32_SESSION:ts.AI32_SESSION + 1]
+    agent_hit = (asess >= lo) & (asess < hi)
+    flags = ai32_in[:, ts.AI32_FLAGS:ts.AI32_FLAGS + 1]
+    ai32_out[:, ts.AI32_FLAGS:ts.AI32_FLAGS + 1] = jnp.where(
+        agent_hit, flags & ~ts.FLAG_ACTIVE, flags
+    )
+
+    wave_state, err_t = _fsm_walk_math(
+        wave_state, has_members, bits,
+        (terminating_code, archived_code), jnp.where,
+    )
+    wstate_ref[0:1, :] = wave_state
+    err_ref[0:1, :] = (err | err_t).astype(jnp.int8)
+    new_term = jnp.where(has_members, now, old_term)
+
+    def write(i, _):
+        s = ksess[0, i]
+        pl.store(
+            si32_out, (pl.ds(s, 1), pl.ds(ts.SI32_STATE, 1)),
+            wave_state[0:1, i].astype(jnp.int32).reshape(1, 1),
+        )
+        pl.store(
+            sf32_out, (pl.ds(s, 1), pl.ds(ts.SF32_TERMINATED_AT, 1)),
+            new_term[0:1, i].reshape(1, 1),
+        )
+        return 0
+
+    jax.lax.fori_loop(0, k, write, 0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "bits", "active_code", "terminating_code", "archived_code",
+        "interpret",
+    ),
+)
+def fsm_saga_block_pallas(
+    agents_i32, sess_i32, sess_f32, vouch_session, vouch_active,
+    k_sessions, ok, now, lo, hi,
+    bits, active_code: int, terminating_code: int, archived_code: int,
+    interpret: bool = False,
+):
+    """The FSM + saga walk megakernel: ONE `pallas_call` on the
+    wave-range layout. Math oracle: `fsm_saga_block_np`."""
+    k = k_sessions.shape[0]
+    b = ok.shape[0]
+    e = vouch_session.shape[0]
+    kernel = functools.partial(
+        _fsm_saga_kernel, k, b, bits, active_code, terminating_code,
+        archived_code,
+    )
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        kernel,
+        in_specs=[vmem] * 8,
+        out_specs=[vmem] * 8,
+        out_shape=[
+            jax.ShapeDtypeStruct(agents_i32.shape, jnp.int32),
+            jax.ShapeDtypeStruct(sess_i32.shape, jnp.int32),
+            jax.ShapeDtypeStruct(sess_f32.shape, jnp.float32),
+            jax.ShapeDtypeStruct((e, 1), jnp.int8),
+            jax.ShapeDtypeStruct((1, b), jnp.int8),
+            jax.ShapeDtypeStruct((1, k), jnp.int8),
+            jax.ShapeDtypeStruct((1, k), jnp.int8),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        input_output_aliases={0: 0, 1: 1, 2: 2, 4: 3},
+        interpret=interpret,
+    )(
+        agents_i32, sess_i32, sess_f32,
+        jnp.asarray(vouch_session, jnp.int32).reshape(e, 1),
+        jnp.asarray(vouch_active, jnp.int8).reshape(e, 1),
+        _row2(k_sessions, jnp.int32), _row2(ok, jnp.int8),
+        jnp.stack([
+            jnp.asarray(now, jnp.float32),
+            jnp.asarray(lo, jnp.int32).astype(jnp.float32),
+            jnp.asarray(hi, jnp.int32).astype(jnp.float32),
+        ]).reshape(1, 3),
+    )
+    ai32, si32, sf32, vact, step, wstate, err, released = outs
+    return (
+        ai32, si32, sf32, vact.reshape(e) != 0,
+        step[0], wstate[0], err[0] != 0, released[0, 0],
+    )
+
+
+def _ring_append_kernel(
+    rows, words,
+    body_in, digest_in, sess_in, turn_in, scal_ref,
+    bodies_ref, digests_ref, rsess_ref, rturn_ref,
+    body_out, digest_out, sess_out, turn_out, cursor_ref,
+):
+    capacity = body_in.shape[0]
+    cursor = scal_ref[0, 0]
+    n_live = scal_ref[0, 1]
+    cursor_ref[0, 0] = cursor + n_live
+
+    def write(i, _):
+        @pl.when(i < n_live)
+        def _():
+            idx = jax.lax.rem(cursor + i, capacity)
+            pl.store(
+                body_out, (pl.ds(idx, 1), slice(None)),
+                pl.load(bodies_ref, (pl.ds(i, 1), slice(None))),
+            )
+            pl.store(
+                digest_out, (pl.ds(idx, 1), slice(None)),
+                pl.load(digests_ref, (pl.ds(i, 1), slice(None))),
+            )
+            pl.store(
+                sess_out, (pl.ds(idx, 1), slice(None)),
+                pl.load(rsess_ref, (pl.ds(i, 1), slice(None))),
+            )
+            pl.store(
+                turn_out, (pl.ds(idx, 1), slice(None)),
+                pl.load(rturn_ref, (pl.ds(i, 1), slice(None))),
+            )
+        return 0
+
+    jax.lax.fori_loop(0, rows, write, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ring_append_pallas(
+    ring_body, ring_digest, ring_session, ring_turn, cursor,
+    bodies_flat, digests_flat, sess_flat, turn_flat, n_live,
+    interpret: bool = False,
+):
+    """The audit phase's completion launch: the DeltaLog live-prefix
+    ring append (`DeltaLog.append_batch_prefix` semantics) as ONE
+    `pallas_call` with the ring aliased in->out. The chain compression
+    and the tree reduction ride the EXISTING MTU launches
+    (`mtu_pallas.chain_digests_mtu` / `tree_roots`) — together the
+    audit phase is three launches instead of its serialized step chain.
+    Math oracle: `audit_block_np`."""
+    rows = bodies_flat.shape[0]
+    c = ring_body.shape[0]
+    kernel = functools.partial(_ring_append_kernel, rows, 16)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        kernel,
+        in_specs=[vmem] * 9,
+        out_specs=[vmem] * 5,
+        out_shape=[
+            jax.ShapeDtypeStruct(ring_body.shape, jnp.uint32),
+            jax.ShapeDtypeStruct(ring_digest.shape, jnp.uint32),
+            jax.ShapeDtypeStruct((c, 1), jnp.int32),
+            jax.ShapeDtypeStruct((c, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        input_output_aliases={0: 0, 1: 1, 2: 2, 3: 3},
+        interpret=interpret,
+    )(
+        ring_body, ring_digest,
+        jnp.asarray(ring_session, jnp.int32).reshape(c, 1),
+        jnp.asarray(ring_turn, jnp.int32).reshape(c, 1),
+        jnp.stack([
+            jnp.asarray(cursor, jnp.int32), jnp.asarray(n_live, jnp.int32)
+        ]).reshape(1, 2),
+        bodies_flat, digests_flat,
+        jnp.asarray(sess_flat, jnp.int32).reshape(rows, 1),
+        jnp.asarray(turn_flat, jnp.int32).reshape(rows, 1),
+    )
+    body, digest, sess, turn, new_cursor = outs
+    return body, digest, sess.reshape(c), turn.reshape(c), new_cursor[0, 0]
+
+
+def _saga_tick_kernel(
+    g, m,
+    step_in, retries_in, undo_ref, sstate_in, nsteps_ref, cursor_in,
+    esucc_ref, usucc_ref, eatt_ref, uatt_ref,
+    step_out, retries_out, sstate_out, cursor_out,
+    committed_ref, exhausted_ref,
+):
+    step = step_in[:, :]
+    retries = retries_in[:, :]
+    sstate = sstate_in[:, 0:1]
+    n_steps = nsteps_ref[:, 0:1]
+    cursor = cursor_in[:, 0:1]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (g, m), 1)
+
+    running = sstate == _SAGA_RUNNING
+    compensating = sstate == _SAGA_COMPENSATING
+    in_range = cursor < n_steps
+    cur = jnp.clip(cursor, 0, m - 1)
+    at_cursor = cols == cur
+    cur_state = jnp.sum(
+        jnp.where(at_cursor, step, np.int8(0)).astype(jnp.int32),
+        axis=1, keepdims=True,
+    ).astype(jnp.int8)
+    cur_retries = jnp.sum(
+        jnp.where(at_cursor, retries, np.int8(0)).astype(jnp.int32),
+        axis=1, keepdims=True,
+    ).astype(jnp.int8)
+    attempt = (
+        running & in_range & (cur_state == _STEP_PENDING)
+        & (eatt_ref[:, 0:1] != 0)
+    )
+    committed = attempt & (esucc_ref[:, 0:1] != 0)
+    exhausted = attempt & ~(esucc_ref[:, 0:1] != 0) & (cur_retries <= 0)
+    retrying = attempt & ~(esucc_ref[:, 0:1] != 0) & (cur_retries > 0)
+    new_cur = jnp.where(
+        committed, np.int8(_STEP_COMMITTED),
+        jnp.where(exhausted, np.int8(_STEP_FAILED), cur_state),
+    )
+    step = jnp.where(at_cursor & attempt, new_cur, step).astype(jnp.int8)
+    retries = (
+        retries
+        + jnp.where(at_cursor & retrying, np.int8(-1), np.int8(0))
+    ).astype(jnp.int8)
+    cursor = jnp.where(committed, cursor + 1, cursor)
+
+    finished = running & (cursor >= n_steps) & (n_steps > 0)
+    sstate = jnp.where(
+        exhausted, np.int8(_SAGA_COMPENSATING),
+        jnp.where(finished, np.int8(_SAGA_COMPLETED), sstate),
+    ).astype(jnp.int8)
+
+    is_committed = step == _STEP_COMMITTED
+    target = jnp.max(
+        jnp.where(is_committed, cols, -1), axis=1, keepdims=True
+    )
+    has_target = compensating & (target >= 0) & (uatt_ref[:, 0:1] != 0)
+    tcol = jnp.clip(target, 0, m - 1)
+    at_target = cols == tcol
+    undo_here = jnp.sum(
+        jnp.where(at_target, undo_ref[:, :], np.int8(0)).astype(jnp.int32),
+        axis=1, keepdims=True,
+    ) > 0
+    undo_ok = has_target & undo_here & (usucc_ref[:, 0:1] != 0)
+    step = jnp.where(
+        at_target & undo_ok, np.int8(_STEP_COMPENSATED),
+        jnp.where(at_target & has_target, np.int8(_STEP_COMP_FAILED), step),
+    ).astype(jnp.int8)
+
+    still_committed = jnp.sum(
+        (step == _STEP_COMMITTED).astype(jnp.int32), axis=1, keepdims=True
+    ) > 0
+    any_comp_failed = jnp.sum(
+        (step == _STEP_COMP_FAILED).astype(jnp.int32), axis=1, keepdims=True
+    ) > 0
+    settled = compensating & ~still_committed
+    sstate = jnp.where(
+        settled & any_comp_failed, np.int8(_SAGA_ESCALATED),
+        jnp.where(settled, np.int8(_SAGA_COMPLETED), sstate),
+    ).astype(jnp.int8)
+
+    step_out[:, :] = step
+    retries_out[:, :] = retries
+    sstate_out[:, :] = sstate
+    cursor_out[:, :] = cursor
+    committed_ref[:, :] = committed.astype(jnp.int8)
+    exhausted_ref[:, :] = exhausted.astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def saga_tick_block_pallas(
+    step_state, retries_left, has_undo, saga_state, n_steps, cursor,
+    exec_success, undo_success, exec_attempted, undo_attempted,
+    interpret: bool = False,
+):
+    """The saga-round megakernel: cursor advance, retry bookkeeping,
+    and reverse-order compensation selection over the whole [G, M]
+    table as ONE launch. Math oracle: `saga_tick_block_np`."""
+    g, m = step_state.shape
+    col = lambda x, dt: jnp.asarray(x, dt).reshape(g, 1)  # noqa: E731
+    kernel = functools.partial(_saga_tick_kernel, g, m)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        kernel,
+        in_specs=[vmem] * 10,
+        out_specs=[vmem] * 6,
+        out_shape=[
+            jax.ShapeDtypeStruct((g, m), jnp.int8),
+            jax.ShapeDtypeStruct((g, m), jnp.int8),
+            jax.ShapeDtypeStruct((g, 1), jnp.int8),
+            jax.ShapeDtypeStruct((g, 1), jnp.int32),
+            jax.ShapeDtypeStruct((g, 1), jnp.int8),
+            jax.ShapeDtypeStruct((g, 1), jnp.int8),
+        ],
+        input_output_aliases={0: 0, 1: 1},
+        interpret=interpret,
+    )(
+        step_state, retries_left,
+        jnp.asarray(has_undo, jnp.int8).reshape(g, m),
+        col(saga_state, jnp.int8), col(n_steps, jnp.int32),
+        col(cursor, jnp.int32), col(exec_success, jnp.int8),
+        col(undo_success, jnp.int8), col(exec_attempted, jnp.int8),
+        col(undo_attempted, jnp.int8),
+    )
+    step, retries, sstate, cur, committed, exhausted = outs
+    return (
+        step, retries, sstate.reshape(g), cur.reshape(g),
+        committed.reshape(g) != 0, exhausted.reshape(g) != 0,
+    )

@@ -970,6 +970,46 @@ def update_gauges(
     return gauge_set_many(metrics, indices, values)
 
 
+def apply_occupancy_gauges(metrics, gauges, has_elevs, has_delta, has_trace):
+    """Write the epilogue megakernel's occupancy vector into the gauge
+    rows `update_gauges` refreshes.
+
+    `gauges` is the fixed-slot i32 vector the wave-kernel epilogue
+    block returns (`kernels.wave_pallas.EPILOGUE_GAUGES` order: ring
+    0-3 agents, active, quarantined, breaker-tripped, sessions live,
+    vouch edges, then live rows for agents/sessions/vouches/sagas/
+    elevations/delta/event/trace). ONE shared index rule between the
+    armed (megakernel) epilogue and the inline `update_gauges` tail, so
+    the two paths cannot drift — all rows land in one scatter, as
+    before."""
+    from hypervisor_tpu.tables.metrics import gauge_set_many
+
+    indices = [h.index for h in RING_AGENTS] + [
+        AGENTS_ACTIVE.index,
+        QUARANTINED.index,
+        BREAKER_TRIPPED.index,
+        SESSIONS_LIVE.index,
+        VOUCH_EDGES_ACTIVE.index,
+        TABLE_LIVE_ROWS["agents"].index,
+        TABLE_LIVE_ROWS["sessions"].index,
+        TABLE_LIVE_ROWS["vouches"].index,
+        TABLE_LIVE_ROWS["sagas"].index,
+    ]
+    values = [gauges[i] for i in range(13)]
+    if has_elevs:
+        indices.append(TABLE_LIVE_ROWS["elevations"].index)
+        values.append(gauges[13])
+    if has_delta:
+        indices.append(TABLE_LIVE_ROWS["delta_log"].index)
+        values.append(gauges[14])
+    indices.append(TABLE_LIVE_ROWS["event_log"].index)
+    values.append(gauges[15])
+    if has_trace:
+        indices.append(TABLE_LIVE_ROWS["trace_log"].index)
+        values.append(gauges[16])
+    return gauge_set_many(metrics, indices, values)
+
+
 def iter_stage_quantiles(
     snap: MetricsSnapshot, qs: tuple[float, ...] = (0.5, 0.95)
 ) -> Iterator[tuple[str, int, tuple[float, ...]]]:

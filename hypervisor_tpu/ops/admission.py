@@ -136,6 +136,39 @@ def _rank_within_session(session_slot: jnp.ndarray) -> jnp.ndarray:
     return jnp.zeros((b,), jnp.int32).at[order].set(rank_sorted)
 
 
+def tally_admission(metrics, ok, b, valid=None):
+    """Book one admission wave's admitted/refused counters + wave-size
+    histogram — THE shared tally rule (`admit_batch` and the armed
+    megakernel path in `ops.pipeline` both call it, so the two paths'
+    metrics cannot drift). Pure scatter adds, no host transfer."""
+    from hypervisor_tpu.observability import metrics as metrics_schema
+    from hypervisor_tpu.tables import metrics as metrics_ops
+
+    from hypervisor_tpu.ops import tally
+
+    if valid is None:
+        n_ok = tally.count_true_1d(ok)
+        n_refused = b - n_ok
+        lanes_observed = jnp.full((1,), b, jnp.float32)
+    else:
+        # Bucket-padded serving wave: pad lanes (valid=False) are
+        # refused by construction but must not count as refusals —
+        # one matvec tallies both masked counts.
+        n_ok, n_valid = tally.count_true(ok & valid, valid)
+        n_refused = n_valid - n_ok
+        lanes_observed = n_valid.astype(jnp.float32)[None]
+    metrics = metrics_ops.counter_add_many(
+        metrics,
+        (metrics_schema.ADMITTED.index, metrics_schema.REFUSED.index),
+        (n_ok, n_refused),
+    )
+    return metrics_ops.observe(
+        metrics,
+        metrics_schema.WAVE_LANES.index,
+        lanes_observed,
+    )
+
+
 class AdmissionResult(NamedTuple):
     agents: AgentTable
     sessions: SessionTable
@@ -293,32 +326,7 @@ def admit_batch(
         ].add(1, mode="drop"),
     )
     if metrics is not None:
-        from hypervisor_tpu.observability import metrics as metrics_schema
-        from hypervisor_tpu.tables import metrics as metrics_ops
-
-        from hypervisor_tpu.ops import tally
-
-        if valid is None:
-            n_ok = tally.count_true_1d(ok)
-            n_refused = b - n_ok
-            lanes_observed = jnp.full((1,), b, jnp.float32)
-        else:
-            # Bucket-padded serving wave: pad lanes (valid=False) are
-            # refused by construction but must not count as refusals —
-            # one matvec tallies both masked counts.
-            n_ok, n_valid = tally.count_true(ok & valid, valid)
-            n_refused = n_valid - n_ok
-            lanes_observed = n_valid.astype(jnp.float32)[None]
-        metrics = metrics_ops.counter_add_many(
-            metrics,
-            (metrics_schema.ADMITTED.index, metrics_schema.REFUSED.index),
-            (n_ok, n_refused),
-        )
-        metrics = metrics_ops.observe(
-            metrics,
-            metrics_schema.WAVE_LANES.index,
-            lanes_observed,
-        )
+        metrics = tally_admission(metrics, ok, b, valid)
     if trace is not None:
         from hypervisor_tpu.observability import tracing
 

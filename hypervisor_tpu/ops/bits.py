@@ -17,7 +17,7 @@ deterministic bounds test folded into the result.
 from __future__ import annotations
 
 import numpy as np
-import jax.numpy as jnp
+import jax.numpy as jnp  # noqa: F401 — default `where` backend below
 
 PackedBits = tuple[np.uint32, np.uint32, int, int]
 
@@ -51,4 +51,25 @@ def matrix_bits_valid(
     )
     word = jnp.where(idx < 32, jnp.uint32(lo), jnp.uint32(hi))
     bit = (word >> (idx & jnp.uint32(31))) & 1 == 1
+    return in_range & bit
+
+
+def matrix_bits_valid_any(packed: PackedBits, frm, to, where=jnp.where):
+    """Backend-agnostic `matrix_bits_valid`: the identical shift-and-
+    mask arithmetic on whatever array module `where` belongs to —
+    jnp tiles inside a Mosaic kernel, plain numpy in the wave-kernel
+    twins (`kernels.wave_pallas`). Integer ops only, so jnp and np
+    agree bit-for-bit."""
+    lo, hi, n_rows, n_cols = packed
+    f = frm.astype(np.int32)
+    t = (frm & 0) + to  # broadcast `to` against frm's shape/backend
+    t = t.astype(np.int32)
+    in_range = (f >= 0) & (f < n_rows) & (t >= 0) & (t < n_cols)
+    clip = np.clip if where is np.where else jnp.clip
+    idx = (
+        clip(f, 0, n_rows - 1).astype(np.uint32) * np.uint32(n_cols)
+        + clip(t, 0, n_cols - 1).astype(np.uint32)
+    )
+    word = where(idx < 32, np.uint32(lo), np.uint32(hi))
+    bit = (word >> (idx & np.uint32(31))) & np.uint32(1) == 1
     return in_range & bit

@@ -135,6 +135,26 @@ def _segment_prefix(
     return incl, excl
 
 
+def tally_gateway(metrics, allowed, valid):
+    """Book one gateway wave's allowed/denied counters — THE shared
+    tally rule (`check_actions` and the armed megakernel path in
+    `ops.pipeline` both call it). One matvec, one scatter-add."""
+    from hypervisor_tpu.observability import metrics as metrics_schema
+    from hypervisor_tpu.tables import metrics as metrics_ops
+
+    from hypervisor_tpu.ops import tally
+
+    counts = tally.count_true(allowed, valid)
+    return metrics_ops.counter_add_many(
+        metrics,
+        (
+            metrics_schema.GATEWAY_ALLOWED.index,
+            metrics_schema.GATEWAY_DENIED.index,
+        ),
+        (counts[0], counts[1] - counts[0]),
+    )
+
+
 class GatewayResult(NamedTuple):
     """One gateway wave's outputs (all action axes are [B])."""
 
@@ -360,20 +380,7 @@ def check_actions(
         ),
     )
     if metrics is not None:
-        from hypervisor_tpu.observability import metrics as metrics_schema
-        from hypervisor_tpu.tables import metrics as metrics_ops
-
-        from hypervisor_tpu.ops import tally
-
-        counts = tally.count_true(allowed, valid)
-        metrics = metrics_ops.counter_add_many(
-            metrics,
-            (
-                metrics_schema.GATEWAY_ALLOWED.index,
-                metrics_schema.GATEWAY_DENIED.index,
-            ),
-            (counts[0], counts[1] - counts[0]),
-        )
+        metrics = tally_gateway(metrics, allowed, valid)
     if trace is not None:
         from hypervisor_tpu.observability import tracing
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from hypervisor_tpu.config import DEFAULT_CONFIG, TrustConfig
@@ -233,6 +234,7 @@ def governance_wave(
     cache_salt: float = 0.0,    # static: see state._DONATION_CACHE_SALT
     lanes_valid=None,           # bool[B]: real (non-bucket-pad) join lanes
     n_sessions_valid=None,      # i32[]: real session lanes (prefix count)
+    wave_kernels: bool | None = None,  # static: Mosaic megakernel routing
 ) -> WaveResult:
     """The full governance pipeline AS ONE PROGRAM over the state tables.
 
@@ -307,6 +309,16 @@ def governance_wave(
     """
     from hypervisor_tpu.ops import liability as liability_ops
     from hypervisor_tpu.ops import terminate as terminate_ops
+    from hypervisor_tpu.ops import wave_blocks
+
+    # Whole-wave Mosaic megakernel routing (round 12): None resolves
+    # the `HV_WAVE_PALLAS` arming per trace (auto = TPU backends only);
+    # state.py threads the per-call env read through the jit statics so
+    # flipping the env never serves a stale cached program. Armed, the
+    # serialized phase chains collapse into the kernel-family blocks
+    # (`ops.wave_blocks`); results are bit-identical either way.
+    if wave_kernels is None:
+        wave_kernels = wave_blocks.wave_kernels_enabled()
 
     wave_stamps = None
     if trace is not None:
@@ -349,120 +361,177 @@ def governance_wave(
     if wave_stamps is not None:
         wave_stamps.begin("admission_wave", lane=slot.shape[0])
         wave_stamps.end("admission_wave", lane=slot.shape[0])
-    admitted = admission_ops.admit_batch(
-        agents,
-        sessions,
-        slot,
-        did,
-        session_slot,
-        sigma_raw,
-        trustworthy,
-        duplicate,
-        now_f,
-        trust,
-        contribution=contribution,
-        omega=omega,
-        ring_bursts=ring_bursts,
-        unique_sessions=unique_sessions,
-        metrics=metrics,
-        valid=lanes_valid,
+    bursts_f32 = (
+        jnp.asarray(DEFAULT_CONFIG.rate_limit.ring_bursts, jnp.float32)
+        if ring_bursts is None
+        else jnp.asarray(ring_bursts, jnp.float32)
     )
-    agents, sessions = admitted.agents, admitted.sessions
-    metrics = admitted.metrics
-    ok = admitted.status == admission_ops.ADMIT_OK
-
-    # ── 3. session FSM: HANDSHAKING -> ACTIVE where populated ────────
-    # One post-admission row gather per block serves state + counts
-    # (i32) and terminated_at (f32, phase 6) — three single-column
-    # gathers collapse to two row gathers (tables/state.py packing).
-    # Safe because nothing between here and the phase-6 write-back
-    # mutates the session table.
-    k_sessions = wave_sessions
-    sess_rows_i32 = sessions.i32[k_sessions]       # [K, 5]
-    sess_rows_f32 = sessions.f32[k_sessions]       # [K, 4]
-    wave_state = sess_rows_i32[:, SI32_STATE].astype(jnp.int8)
-    has_members = sess_rows_i32[:, SI32_NPART] > 0
-    wave_state, err_a = session_fsm.apply_session_transitions(
-        wave_state, jnp.int8(SessionState.ACTIVE.code), has_members
-    )
-
-    # ── 4. audit: chain + per-session Merkle roots ───────────────────
-    t = delta_bodies.shape[0]
-    chain = merkle_ops.chain_digests(delta_bodies, use_pallas=use_pallas)
-    p = 1 << max(0, (t - 1).bit_length())
-    k = k_sessions.shape[0]
-    leaves = jnp.zeros((k, p, 8), jnp.uint32)
-    leaves = leaves.at[:, :t].set(jnp.transpose(chain, (1, 0, 2)))
-    roots = merkle_ops.merkle_root_lanes(
-        leaves, jnp.int32(t), use_pallas=use_pallas
-    )
-
-    # ── 5. one saga step per joining agent ───────────────────────────
-    step_state = jnp.full(slot.shape, saga_ops.STEP_PENDING, jnp.int8)
-    step_state, _ = saga_ops.execute_attempt(
-        step_state, success=ok, retries_left=jnp.zeros(slot.shape, jnp.int8)
-    )
-
-    # ── 6. terminate: bonds, participants, FSM walk ──────────────────
-    if wave_range is not None:
-        in_wave = None  # range compares replace the mask entirely
-    else:
-        in_wave = jnp.zeros((sessions.sid.shape[0],), bool).at[
-            jnp.clip(k_sessions, 0)
-        ].set(True)
-    agents, vouches, released = terminate_ops.release_session_scope(
-        agents, vouches, in_wave, wave_sessions=k_sessions,
-        wave_range=wave_range,
-    )
-
-    wave_state, err_t = session_fsm.apply_session_transitions(
-        wave_state, jnp.int8(SessionState.TERMINATING.code), has_members
-    )
-    wave_state, err_z = session_fsm.apply_session_transitions(
-        wave_state, jnp.int8(SessionState.ARCHIVED.code), has_members
-    )
-    sessions = replace(
-        sessions,
-        state=sessions.state.at[k_sessions].set(wave_state),
-        terminated_at=sessions.terminated_at.at[k_sessions].set(
-            jnp.where(
-                has_members, now_f, sess_rows_f32[:, SF32_TERMINATED_AT]
+    with jax.named_scope("hv_phase.admission"):
+        if wave_kernels:
+            # ── megakernel: the whole gather/sort/scatter block is ONE
+            # launch (`ops.wave_blocks.admission_block`); only the
+            # shared tally rule stays in-program.
+            agents, sessions, adm_status, adm_ring, adm_sigma = (
+                wave_blocks.admission_block(
+                    agents, sessions, slot, did, session_slot, sigma_raw,
+                    contribution, omega, trustworthy, duplicate, now_f,
+                    bursts_f32, trust, unique_sessions,
+                )
             )
-        ),
-    )
-
-    fsm_err = err_a | err_t | err_z
-
-    # ── audit append onto the DeltaLog ring, in-program ──────────────
-    # The same lane-major layout the bridge staged host-side before
-    # round 9 (`state._governance_wave_impl`): rows s0t0..s0t{T-1},
-    # s1t0, … — one fewer dispatch per wave, and the ring rides the
-    # donation frontier like every other table.
-    if delta_log is not None and t > 0:
-        bodies_flat = jnp.transpose(delta_bodies, (1, 0, 2)).reshape(
-            k * t, delta_bodies.shape[2]
-        )
-        digests_flat = jnp.transpose(chain, (1, 0, 2)).reshape(k * t, 8)
-        if n_sessions_valid is None:
-            delta_log = delta_log.append_batch(
-                bodies_flat,
-                digests_flat,
-                jnp.repeat(k_sessions, t),
-                jnp.tile(jnp.arange(t, dtype=jnp.int32), k),
-            )
+            if metrics is not None:
+                metrics = admission_ops.tally_admission(
+                    metrics,
+                    adm_status == admission_ops.ADMIT_OK,
+                    slot.shape[0],
+                    lanes_valid,
+                )
         else:
-            # Bucket-padded serving wave: pad session lanes are a
-            # SUFFIX, so the live records are exactly the flat prefix
-            # of the lane-major layout — append only those (the ring
-            # stays bit-identical to an unpadded wave; parked sessions
-            # never enter the audit plane).
-            delta_log = delta_log.append_batch_prefix(
-                bodies_flat,
-                digests_flat,
-                jnp.repeat(k_sessions, t),
-                jnp.tile(jnp.arange(t, dtype=jnp.int32), k),
-                jnp.asarray(n_sessions_valid, jnp.int32) * t,
+            admitted = admission_ops.admit_batch(
+                agents,
+                sessions,
+                slot,
+                did,
+                session_slot,
+                sigma_raw,
+                trustworthy,
+                duplicate,
+                now_f,
+                trust,
+                contribution=contribution,
+                omega=omega,
+                ring_bursts=ring_bursts,
+                unique_sessions=unique_sessions,
+                metrics=metrics,
+                valid=lanes_valid,
             )
+            agents, sessions = admitted.agents, admitted.sessions
+            metrics = admitted.metrics
+            adm_status = admitted.status
+            adm_ring = admitted.ring
+            adm_sigma = admitted.sigma_eff
+    ok = adm_status == admission_ops.ADMIT_OK
+
+    k_sessions = wave_sessions
+    t = delta_bodies.shape[0]
+    k = k_sessions.shape[0]
+    if wave_kernels:
+        # ── megakernel: phases 3/5/6 are ONE fsm+saga walk block and
+        # phase 4 + the ring append are the audit block's launches —
+        # the serialized select/scatter chains collapse behind
+        # `ops.wave_blocks` (Mosaic on chip, numpy twins out-of-line
+        # on the CPU parity/census path).
+        with jax.named_scope("hv_phase.fsm_saga"):
+            (
+                agents, sessions, vouches, step_state, wave_state,
+                fsm_err, released,
+            ) = wave_blocks.fsm_saga_block(
+                agents, sessions, vouches, k_sessions, ok, now_f,
+                wave_range,
+            )
+        with jax.named_scope("hv_phase.audit"):
+            chain, roots, delta_log = wave_blocks.audit_block(
+                delta_bodies, k_sessions, delta_log, n_sessions_valid,
+                use_pallas,
+                # Sequencing token: the audit block's inputs are data-
+                # independent of the first two blocks, and concurrent
+                # host callbacks deadlock XLA:CPU's servicing — chain
+                # the blocks the way a chip serializes the launches.
+                token=released,
+            )
+    else:
+      # ── 3. session FSM: HANDSHAKING -> ACTIVE where populated ──────
+      # One post-admission row gather per block serves state + counts
+      # (i32) and terminated_at (f32, phase 6) — three single-column
+      # gathers collapse to two row gathers (tables/state.py packing).
+      # Safe because nothing between here and the phase-6 write-back
+      # mutates the session table.
+      with jax.named_scope("hv_phase.fsm_saga"):
+        sess_rows_i32 = sessions.i32[k_sessions]       # [K, 5]
+        sess_rows_f32 = sessions.f32[k_sessions]       # [K, 4]
+        wave_state = sess_rows_i32[:, SI32_STATE].astype(jnp.int8)
+        has_members = sess_rows_i32[:, SI32_NPART] > 0
+        wave_state, err_a = session_fsm.apply_session_transitions(
+            wave_state, jnp.int8(SessionState.ACTIVE.code), has_members
+        )
+
+      # ── 4. audit: chain + per-session Merkle roots ───────────────────
+      with jax.named_scope("hv_phase.audit"):
+        chain = merkle_ops.chain_digests(delta_bodies, use_pallas=use_pallas)
+        p = 1 << max(0, (t - 1).bit_length())
+        leaves = jnp.zeros((k, p, 8), jnp.uint32)
+        leaves = leaves.at[:, :t].set(jnp.transpose(chain, (1, 0, 2)))
+        roots = merkle_ops.merkle_root_lanes(
+            leaves, jnp.int32(t), use_pallas=use_pallas
+        )
+
+      with jax.named_scope("hv_phase.fsm_saga"):
+        # ── 5. one saga step per joining agent ─────────────────────────
+        step_state = jnp.full(slot.shape, saga_ops.STEP_PENDING, jnp.int8)
+        step_state, _ = saga_ops.execute_attempt(
+            step_state, success=ok, retries_left=jnp.zeros(slot.shape, jnp.int8)
+        )
+
+        # ── 6. terminate: bonds, participants, FSM walk ────────────────
+        if wave_range is not None:
+            in_wave = None  # range compares replace the mask entirely
+        else:
+            in_wave = jnp.zeros((sessions.sid.shape[0],), bool).at[
+                jnp.clip(k_sessions, 0)
+            ].set(True)
+        agents, vouches, released = terminate_ops.release_session_scope(
+            agents, vouches, in_wave, wave_sessions=k_sessions,
+            wave_range=wave_range,
+        )
+
+        wave_state, err_t = session_fsm.apply_session_transitions(
+            wave_state, jnp.int8(SessionState.TERMINATING.code), has_members
+        )
+        wave_state, err_z = session_fsm.apply_session_transitions(
+            wave_state, jnp.int8(SessionState.ARCHIVED.code), has_members
+        )
+        sessions = replace(
+            sessions,
+            state=sessions.state.at[k_sessions].set(wave_state),
+            terminated_at=sessions.terminated_at.at[k_sessions].set(
+                jnp.where(
+                    has_members, now_f, sess_rows_f32[:, SF32_TERMINATED_AT]
+                )
+            ),
+        )
+
+        fsm_err = err_a | err_t | err_z
+
+      # ── audit append onto the DeltaLog ring, in-program ──────────────
+      # The same lane-major layout the bridge staged host-side before
+      # round 9 (`state._governance_wave_impl`): rows s0t0..s0t{T-1},
+      # s1t0, … — one fewer dispatch per wave, and the ring rides the
+      # donation frontier like every other table.
+      with jax.named_scope("hv_phase.audit"):
+        if delta_log is not None and t > 0:
+            bodies_flat = jnp.transpose(delta_bodies, (1, 0, 2)).reshape(
+                k * t, delta_bodies.shape[2]
+            )
+            digests_flat = jnp.transpose(chain, (1, 0, 2)).reshape(k * t, 8)
+            if n_sessions_valid is None:
+                delta_log = delta_log.append_batch(
+                    bodies_flat,
+                    digests_flat,
+                    jnp.repeat(k_sessions, t),
+                    jnp.tile(jnp.arange(t, dtype=jnp.int32), k),
+                )
+            else:
+                # Bucket-padded serving wave: pad session lanes are a
+                # SUFFIX, so the live records are exactly the flat prefix
+                # of the lane-major layout — append only those (the ring
+                # stays bit-identical to an unpadded wave; parked sessions
+                # never enter the audit plane).
+                delta_log = delta_log.append_batch_prefix(
+                    bodies_flat,
+                    digests_flat,
+                    jnp.repeat(k_sessions, t),
+                    jnp.tile(jnp.arange(t, dtype=jnp.int32), k),
+                    jnp.asarray(n_sessions_valid, jnp.int32) * t,
+                )
 
     # ── 7. fused action gateway (single-device twin of the mesh's
     #    with_gateway phase): runs on the POST-terminate table inside
@@ -472,29 +541,46 @@ def governance_wave(
     #    `HypervisorState._governance_wave_impl`). ──────────────────────
     gw_lanes = None
     if gateway_args is not None:
+      with jax.named_scope("hv_phase.gateway"):
         from hypervisor_tpu.ops import gateway as gateway_ops
 
         (act_slot, act_required, act_ro, act_cons, act_wit, act_host,
          act_valid) = gateway_args
-        gw = gateway_ops.check_actions(
-            agents,
-            elevations,
-            act_slot,
-            act_required,
-            act_ro,
-            act_cons,
-            act_wit,
-            act_host,
-            now_f,
-            valid=act_valid,
-            breach=breach,
-            rate_limit=rate_limit,
-            trust=trust,
-            metrics=metrics,
-        )
-        agents = gw.agents
-        metrics = gw.metrics if metrics is not None else metrics
-        gw_lanes = gw._replace(agents=None, metrics=None)
+        if wave_kernels and wave_blocks.twin_boundary():
+            # ── megakernel (twin boundary): the whole gate walk is one
+            # block call; the shared tally rule stays in-program. On a
+            # pallas-ready backend the phase keeps its inline XLA form
+            # (the gateway's Mosaic kernel is the family's next rung).
+            agents, gw_lanes = wave_blocks.gateway_block(
+                agents, elevations, gateway_args, now_f,
+                breach=breach, rate_limit=rate_limit, trust=trust,
+            )
+            if metrics is not None:
+                metrics = gateway_ops.tally_gateway(
+                    metrics,
+                    gw_lanes.verdict == gateway_ops.GATE_ALLOWED,
+                    act_valid,
+                )
+        else:
+            gw = gateway_ops.check_actions(
+                agents,
+                elevations,
+                act_slot,
+                act_required,
+                act_ro,
+                act_cons,
+                act_wit,
+                act_host,
+                now_f,
+                valid=act_valid,
+                breach=breach,
+                rate_limit=rate_limit,
+                trust=trust,
+                metrics=metrics,
+            )
+            agents = gw.agents
+            metrics = gw.metrics if metrics is not None else metrics
+            gw_lanes = gw._replace(agents=None, metrics=None)
 
     if metrics is not None:
         from hypervisor_tpu.observability import metrics as metrics_schema
@@ -574,30 +660,38 @@ def governance_wave(
     #    (read-only args: no donation needed, no copies emitted). ───────
     sanitizer_result = None
     if epilogue_tables is not None and metrics is not None:
+      with jax.named_scope("hv_phase.epilogue"):
         from hypervisor_tpu.observability import metrics as metrics_schema
 
         ep_sagas, ep_event_log = epilogue_tables
-        metrics = metrics_schema.update_gauges(
-            metrics,
-            agents,
-            sessions,
-            vouches,
-            ep_sagas,
-            elevations,
-            delta_log,
-            ep_event_log,
-            trace,
-        )
-        if sanitize:
-            from hypervisor_tpu.integrity import invariants as inv
-
-            bursts = (
-                jnp.asarray(DEFAULT_CONFIG.rate_limit.ring_bursts,
-                            jnp.float32)
-                if ring_bursts is None
-                else jnp.asarray(ring_bursts, jnp.float32)
+        if wave_kernels and wave_blocks.twin_boundary():
+            # ── megakernel (twin boundary): gauge values + sanitizer
+            # masks come back from ONE epilogue block; the shared
+            # booking rules (`apply_occupancy_gauges`,
+            # `book_sanitizer_metrics`) land them in-program. On a
+            # pallas-ready backend the tail keeps its inline XLA form
+            # (next rung, like the gateway).
+            gauges, sres = wave_blocks.epilogue_block(
+                agents, sessions, vouches, ep_sagas, elevations,
+                delta_log, ep_event_log, trace, bursts_f32, sanitize,
+                config=config,
             )
-            sres = inv.check_invariants(
+            metrics = metrics_schema.apply_occupancy_gauges(
+                metrics, gauges,
+                has_elevs=elevations is not None,
+                has_delta=delta_log is not None,
+                has_trace=trace is not None,
+            )
+            if sanitize:
+                from hypervisor_tpu.integrity import invariants as inv
+
+                metrics = inv.book_sanitizer_metrics(
+                    metrics, sres.total, sres.unrepairable
+                )
+                sanitizer_result = sres
+        else:
+            metrics = metrics_schema.update_gauges(
+                metrics,
                 agents,
                 sessions,
                 vouches,
@@ -606,19 +700,32 @@ def governance_wave(
                 delta_log,
                 ep_event_log,
                 trace,
-                bursts,
-                metrics=metrics,
-                config=config,
             )
-            metrics = sres.metrics
-            sanitizer_result = sres._replace(metrics=None)
+            if sanitize:
+                from hypervisor_tpu.integrity import invariants as inv
+
+                sres = inv.check_invariants(
+                    agents,
+                    sessions,
+                    vouches,
+                    ep_sagas,
+                    elevations,
+                    delta_log,
+                    ep_event_log,
+                    trace,
+                    bursts_f32,
+                    metrics=metrics,
+                    config=config,
+                )
+                metrics = sres.metrics
+                sanitizer_result = sres._replace(metrics=None)
     return WaveResult(
         agents=agents,
         sessions=sessions,
         vouches=vouches,
-        status=admitted.status,
-        ring=admitted.ring,
-        sigma_eff=admitted.sigma_eff,
+        status=adm_status,
+        ring=adm_ring,
+        sigma_eff=adm_sigma,
         saga_step_state=step_state,
         merkle_root=roots,
         chain=chain,

@@ -144,6 +144,7 @@ def saga_table_tick(
     metrics=None,  # MetricsTable riding the tick (None -> None returned)
     trace=None,       # TraceLog riding the tick (flight recorder)
     trace_ctx=None,   # observability.tracing.TraceContext scalars
+    wave_kernels: bool | None = None,  # static: megakernel routing
 ):
     """Advance EVERY saga in the table by one scheduling round.
 
@@ -180,6 +181,31 @@ def saga_table_tick(
         exec_attempted = jnp.ones((g,), bool)
     if undo_attempted is None:
         undo_attempted = jnp.ones((g,), bool)
+
+    if wave_kernels is None:
+        from hypervisor_tpu.ops import wave_blocks
+
+        wave_kernels = wave_blocks.wave_kernels_enabled()
+    if wave_kernels:
+        # ── megakernel (round 12): the cursor advance, retry
+        # bookkeeping, compensation-target selection, and settle pass
+        # run as ONE saga-tick block (`ops.wave_blocks.saga_tick_block`
+        # — Mosaic on chip, the numpy twin out-of-line elsewhere); the
+        # masked-select/scatter chain below is its XLA reference twin.
+        from hypervisor_tpu.ops import wave_blocks
+
+        (
+            step_state, retries_left, saga_state, cursor, committed,
+            exhausted,
+        ) = wave_blocks.saga_tick_block(
+            step_state, retries_left, has_undo, saga_state, n_steps,
+            cursor, exec_success, undo_success, exec_attempted,
+            undo_attempted,
+        )
+        return _saga_tick_tail(
+            step_state, retries_left, saga_state, cursor, committed,
+            exhausted, g, metrics, trace, trace_ctx,
+        )
 
     running = saga_state == SAGA_RUNNING
     # Compensation acts only on sagas that entered this round already
@@ -240,6 +266,18 @@ def saga_table_tick(
         jnp.where(settled, SAGA_COMPLETED, saga_state),
     ).astype(saga_state.dtype)
 
+    return _saga_tick_tail(
+        step_state, retries_left, saga_state, cursor, committed,
+        exhausted, g, metrics, trace, trace_ctx,
+    )
+
+
+def _saga_tick_tail(
+    step_state, retries_left, saga_state, cursor, committed, exhausted,
+    g, metrics, trace, trace_ctx,
+):
+    """The saga round's shared metrics/trace booking — one rule for the
+    megakernel and XLA forms, so the two paths' tallies cannot drift."""
     if trace is not None:
         from hypervisor_tpu.observability import tracing
 

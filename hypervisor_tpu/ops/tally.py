@@ -19,6 +19,7 @@ is ≤ 2^17, with headroom to spare (guarded below).
 
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
 #: f32 counts are exact below this many rows (24-bit mantissa).
@@ -43,3 +44,15 @@ def count_true(*cols: jnp.ndarray) -> jnp.ndarray:
 def count_true_1d(col: jnp.ndarray) -> jnp.ndarray:
     """i32[] — count of true lanes in one column (dot, not reduce)."""
     return count_true(col)[0]
+
+
+def count_true_np(*cols) -> np.ndarray:
+    """`count_true`'s exact math on numpy — the wave-kernel twins'
+    counting rule (`kernels.wave_pallas`). The f32 matvec counts
+    integers below 2^24 exactly, so the twin's value always equals the
+    device tally bit-for-bit."""
+    stacked = np.stack([np.asarray(c) for c in cols])
+    n = stacked.shape[1]
+    return (
+        (stacked != 0).astype(np.float32) @ np.ones((n,), np.float32)
+    ).astype(np.int32)

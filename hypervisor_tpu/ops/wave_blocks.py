@@ -1,0 +1,586 @@
+"""Whole-wave megakernel dispatch: ops-layer routing for the Mosaic
+wave blocks (`kernels.wave_pallas`), the way `ops.merkle` routes to the
+MTU.
+
+Each block function takes the live tables, decides the execution form,
+and returns updated tables + lane outputs, keeping
+`ops.pipeline.governance_wave`'s armed branch free of backend logic.
+The dispatch (fallback) matrix — docs/OPERATIONS.md "Dispatch &
+fusion":
+
+  TPU backend (pallas ready, shapes inside the VMEM caps)
+      admission / fsm+saga / audit  -> Mosaic megakernel launches
+      gateway / epilogue            -> the round-9 inline XLA phases
+                                       (their Mosaic forms are the
+                                       family's next rung)
+  armed elsewhere (CPU parity runs, the hermetic census, smoke gates)
+      every block                   -> its numpy twin OUT-OF-LINE (one
+                                       `jax.pure_callback` custom call
+                                       per block — the program keeps
+                                       the megakernel step structure
+                                       the census gates, and the twin
+                                       keeps results bit-identical)
+  not armed (`HV_WAVE_PALLAS` off — the CPU production default)
+      everything                    -> the round-9 XLA forms, untouched
+
+Dispatch never changes results: every form is bit-identical (chain
+heads, tables, metrics mirrors), pinned by tests/unit/test_wave_kernels
+and the tier-1 megakernel smoke gate. The out-of-line twin path is the
+ONE deliberate exception to the stamped-program no-host-transfer rule
+(the trace-plane lowering gate): it exists exactly where the Mosaic
+kernel cannot compile, and the chip path stays transfer-free.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.kernels import wave_pallas
+from hypervisor_tpu.models import SessionState
+from hypervisor_tpu.ops import session_fsm
+from hypervisor_tpu.tables.struct import replace
+
+wave_kernels_enabled = wave_pallas.wave_kernels_enabled
+set_wave_kernels = wave_pallas.set_wave_kernels
+
+
+def twin_boundary() -> bool:
+    """True when armed dispatch runs the numpy twins out-of-line (no
+    Mosaic launch possible on this backend) — the census/parity
+    posture. On a pallas-ready backend the named blocks launch Mosaic
+    kernels and gateway/epilogue stay inline XLA."""
+    return not wave_pallas.wave_pallas_ready()
+
+
+# ── the twin boundary primitive ──────────────────────────────────────
+#
+# `jax.pure_callback` / `jax.io_callback` cannot carry the twin
+# boundary on this jax (0.4.37): their impl runs `jax.device_put` +
+# `np.asarray` INSIDE the callback, re-entering the very CPU runtime
+# that is blocked executing the enclosing program — a racy deadlock we
+# hit at every wave shape (observed live: the callback thread frozen
+# syncing an operand while the stream waits on the callback). The thin
+# primitive below lowers through `mlir.emit_python_callback` directly
+# with a NUMPY-level callable: the runtime hands the twin zero-copy
+# ndarray views of the operand buffers and takes ndarrays back — no
+# jax op ever runs inside the boundary. Version-pinned to the baked-in
+# jax the way `parallel/collectives.py` guards `lax.pcast`.
+
+from jax._src import core as _jcore  # noqa: E402
+from jax._src.interpreters import mlir as _jmlir  # noqa: E402
+
+_TWIN_CALL_P = _jcore.Primitive("hv_wave_twin_call")
+_TWIN_CALL_P.multiple_results = True
+
+
+@_TWIN_CALL_P.def_impl
+def _twin_call_impl(*args, twin, result_avals):
+    # Eager path (unjitted callers): plain numpy in, device arrays out.
+    del result_avals
+    outs = twin(*(np.asarray(a) for a in args))
+    return [jnp.asarray(o) for o in outs]
+
+
+@_TWIN_CALL_P.def_abstract_eval
+def _twin_call_abstract(*avals, twin, result_avals):
+    del avals, twin
+    return list(result_avals)
+
+
+def _twin_call_lowering(ctx, *operands, twin, result_avals):
+    del result_avals
+
+    def _np_callback(*flat):
+        # `flat` are the runtime's zero-copy ndarray operand views —
+        # the twins copy before every write (their documented
+        # contract), so the views stay pristine.
+        return tuple(twin(*flat))
+
+    result, _, _ = _jmlir.emit_python_callback(
+        ctx,
+        _np_callback,
+        None,
+        list(operands),
+        ctx.avals_in,
+        ctx.avals_out,
+        has_side_effect=False,
+    )
+    return result
+
+
+_jmlir.register_lowering(_TWIN_CALL_P, _twin_call_lowering)
+
+
+def _cb(twin, shapes, *args):
+    """One block = one custom call: the numpy twin out-of-line."""
+    result_avals = tuple(
+        _jcore.ShapedArray(s.shape, s.dtype) for s in shapes
+    )
+    return _TWIN_CALL_P.bind(
+        *(jnp.asarray(a) for a in args),
+        twin=twin,
+        result_avals=result_avals,
+    )
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ── block 1: admission ───────────────────────────────────────────────
+
+
+def admission_block(
+    agents,
+    sessions,
+    slot,
+    did,
+    session_slot,
+    sigma_raw,
+    contribution,
+    omega,
+    trustworthy,
+    duplicate,
+    now,
+    bursts,
+    trust,
+    unique_sessions: bool,
+):
+    """The admission gather/sort/scatter block as ONE launch/call.
+
+    Returns (agents, sessions, status i8[B], ring i8[B], sigma_eff
+    f32[B]) — `ops.admission.admit_batch`'s exact outputs; the metrics
+    tallies stay with the caller (`admission.tally_admission`).
+    """
+    b = slot.shape[0]
+    n = agents.ring.shape[0]
+    sc = sessions.i32.shape[0]
+    omega_a = jnp.asarray(omega, jnp.float32)
+    now_a = jnp.asarray(now, jnp.float32)
+    bursts_a = jnp.asarray(bursts, jnp.float32)
+    if (
+        not twin_boundary()
+        and wave_pallas.wave_shapes_fit(n, sc, 0, b)
+        and (unique_sessions or b & (b - 1) == 0)
+    ):
+        af32, ai32, ring_t, si32, status, ring, sigma_eff = (
+            wave_pallas.admission_block_pallas(
+                agents.f32, agents.i32, agents.ring, sessions.i32,
+                sessions.f32, slot, did, session_slot, sigma_raw,
+                contribution, omega_a, trustworthy, duplicate, now_a,
+                bursts_a,
+                ring2_threshold=float(trust.ring2_threshold),
+                unique_sessions=unique_sessions,
+            )
+        )
+    else:
+        twin = functools.partial(
+            wave_pallas.admission_block_np,
+            ring2_threshold=float(trust.ring2_threshold),
+            unique_sessions=unique_sessions,
+        )
+        shapes = (
+            _sds(agents.f32.shape, jnp.float32),
+            _sds(agents.i32.shape, jnp.int32),
+            _sds((n,), jnp.int8),
+            _sds(sessions.i32.shape, jnp.int32),
+            _sds((b,), jnp.int8),
+            _sds((b,), jnp.int8),
+            _sds((b,), jnp.float32),
+        )
+        af32, ai32, ring_t, si32, status, ring, sigma_eff = _cb(
+            twin, shapes,
+            agents.f32, agents.i32, agents.ring, sessions.i32,
+            sessions.f32, slot, did, session_slot, sigma_raw,
+            contribution, omega_a, trustworthy, duplicate, now_a,
+            bursts_a,
+        )
+    agents = replace(agents, f32=af32, i32=ai32, ring=ring_t)
+    sessions = replace(sessions, i32=si32)
+    return agents, sessions, status, ring, sigma_eff
+
+
+# ── block 2: fsm + saga walk + terminate ─────────────────────────────
+
+
+def fsm_saga_block(
+    agents,
+    sessions,
+    vouches,
+    k_sessions,
+    ok,
+    now,
+    wave_range,
+):
+    """The session FSM walk + per-lane saga step + terminate release as
+    ONE launch/call — `ops.pipeline.governance_wave` phases 3/5/6.
+
+    Returns (agents, sessions, vouches, step_state i8[B], wave_state
+    i8[K], fsm_err bool[K], released i32[]).
+    """
+    k = k_sessions.shape[0]
+    b = ok.shape[0]
+    e = vouches.session.shape[0]
+    bits = session_fsm._TRANSITION_BITS
+    codes = (
+        SessionState.ACTIVE.code,
+        SessionState.TERMINATING.code,
+        SessionState.ARCHIVED.code,
+    )
+    has_range = wave_range is not None
+    lo, hi = wave_range if has_range else (
+        jnp.int32(0), jnp.int32(0)
+    )
+    now_a = jnp.asarray(now, jnp.float32)
+    if not twin_boundary() and has_range:
+        ai32, si32, sf32, vact, step, wstate, err, released = (
+            wave_pallas.fsm_saga_block_pallas(
+                agents.i32, sessions.i32, sessions.f32, vouches.session,
+                vouches.active, k_sessions, ok, now_a, lo, hi,
+                bits=bits, active_code=codes[0],
+                terminating_code=codes[1], archived_code=codes[2],
+            )
+        )
+    else:
+        twin = functools.partial(
+            wave_pallas.fsm_saga_block_np,
+            has_range=has_range,
+            transition_bits=bits,
+            active_code=codes[0],
+            terminating_code=codes[1],
+            archived_code=codes[2],
+        )
+        shapes = (
+            _sds(agents.i32.shape, jnp.int32),
+            _sds(sessions.i32.shape, jnp.int32),
+            _sds(sessions.f32.shape, jnp.float32),
+            _sds((e,), jnp.bool_),
+            _sds((b,), jnp.int8),
+            _sds((k,), jnp.int8),
+            _sds((k,), jnp.bool_),
+            _sds((), jnp.int32),
+        )
+        ai32, si32, sf32, vact, step, wstate, err, released = _cb(
+            twin, shapes,
+            agents.i32, sessions.i32, sessions.f32, vouches.session,
+            vouches.active, k_sessions, ok, now_a,
+            jnp.asarray(lo, jnp.int32), jnp.asarray(hi, jnp.int32),
+        )
+    agents = replace(agents, i32=ai32)
+    sessions = replace(sessions, i32=si32, f32=sf32)
+    vouches = replace(vouches, active=vact)
+    return agents, sessions, vouches, step, wstate, err, released
+
+
+# ── block 3: audit completion ────────────────────────────────────────
+
+
+def audit_block(
+    delta_bodies,
+    k_sessions,
+    delta_log,
+    n_sessions_valid,
+    use_pallas,
+    token=None,
+):
+    """Chain compression + Merkle leaf fold + DeltaLog ring append as
+    the audit phase's launches — `ops.pipeline.governance_wave` phase 4
+    plus the in-program append.
+
+    `token`: an optional scalar from the PRECEDING block's outputs,
+    threaded as a dummy operand on the twin boundary. The audit inputs
+    are data-independent of admission/fsm, and XLA:CPU will happily
+    start two host callbacks concurrently — which deadlocks the
+    runtime's callback servicing (observed live at every shape). The
+    token makes the block chain strictly sequential, which is also the
+    truthful model of the chip: a TPU serializes the launches anyway —
+    dispatch order IS the resource under test.
+
+    Returns (chain u32[T, K, 8], roots u32[K, 8], delta_log') —
+    delta_log' is the input when no ring rode the wave.
+    """
+    t = delta_bodies.shape[0]
+    k = k_sessions.shape[0]
+    has_ring = delta_log is not None and t > 0
+    n_valid = (
+        jnp.asarray(k, jnp.int32)
+        if n_sessions_valid is None
+        else jnp.asarray(n_sessions_valid, jnp.int32)
+    )
+    if not twin_boundary():
+        # Mosaic path: the audit phase rides the EXISTING MTU launches
+        # (chain + tree in VMEM), plus the ring-append kernel.
+        from hypervisor_tpu.ops import merkle as merkle_ops
+
+        chain = merkle_ops.chain_digests(delta_bodies, use_pallas=True)
+        p = 1 << max(0, (t - 1).bit_length())
+        leaves = jnp.zeros((k, p, 8), jnp.uint32)
+        leaves = leaves.at[:, :t].set(jnp.transpose(chain, (1, 0, 2)))
+        roots = merkle_ops.merkle_root_lanes(
+            leaves, jnp.int32(t), use_pallas=True
+        )
+        if has_ring:
+            bodies_flat = jnp.transpose(delta_bodies, (1, 0, 2)).reshape(
+                k * t, delta_bodies.shape[2]
+            )
+            digests_flat = jnp.transpose(chain, (1, 0, 2)).reshape(k * t, 8)
+            body, digest, sess, turn, cursor = (
+                wave_pallas.ring_append_pallas(
+                    delta_log.body, delta_log.digest, delta_log.session,
+                    delta_log.turn, delta_log.cursor,
+                    bodies_flat, digests_flat,
+                    jnp.repeat(k_sessions, t),
+                    jnp.tile(jnp.arange(t, dtype=jnp.int32), k),
+                    n_valid * t,
+                )
+            )
+            delta_log = type(delta_log)(
+                body=body, digest=digest, session=sess, turn=turn,
+                cursor=cursor,
+            )
+        return chain, roots, delta_log
+
+    twin = functools.partial(wave_pallas.audit_block_np, has_ring=has_ring)
+    c = delta_log.body.shape[0] if has_ring else 1
+    ring_args = (
+        (
+            delta_log.body, delta_log.digest, delta_log.session,
+            delta_log.turn, delta_log.cursor,
+        )
+        if has_ring
+        else (
+            jnp.zeros((1, 16), jnp.uint32), jnp.zeros((1, 8), jnp.uint32),
+            jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+            jnp.zeros((), jnp.int32),
+        )
+    )
+    shapes = (
+        _sds((t, k, 8), jnp.uint32),
+        _sds((k, 8), jnp.uint32),
+        _sds((c, 16), jnp.uint32),
+        _sds((c, 8), jnp.uint32),
+        _sds((c,), jnp.int32),
+        _sds((c,), jnp.int32),
+        _sds((), jnp.int32),
+    )
+    if token is None:
+        token = jnp.int32(0)
+    chain, roots, body, digest, sess, turn, cursor = _cb(
+        twin, shapes, delta_bodies, k_sessions, *ring_args, n_valid,
+        jnp.asarray(token, jnp.int32).reshape(()),
+    )
+    if has_ring:
+        delta_log = type(delta_log)(
+            body=body, digest=digest, session=sess, turn=turn, cursor=cursor
+        )
+    return chain, roots, delta_log
+
+
+# ── block 4: gateway ─────────────────────────────────────────────────
+
+
+def gateway_block(
+    agents,
+    elevations,
+    gateway_args,
+    now,
+    breach=DEFAULT_CONFIG.breach,
+    rate_limit=DEFAULT_CONFIG.rate_limit,
+    trust=DEFAULT_CONFIG.trust,
+):
+    """The per-action gateway walk as ONE out-of-line twin call (the
+    CPU megakernel boundary; on chip the phase stays inline XLA — see
+    `twin_boundary`). Returns (agents, GatewayResult-with-agents=None);
+    metrics/trace tallies stay with the caller."""
+    from hypervisor_tpu.ops.gateway import GatewayResult
+
+    (slot, required, ro, cons, wit, host, valid) = gateway_args
+    b = slot.shape[0]
+    twin = functools.partial(
+        wave_pallas.gateway_block_np,
+        breach=breach, rate=rate_limit, trust=trust,
+    )
+    shapes = (
+        _sds(agents.f32.shape, jnp.float32),
+        _sds(agents.i32.shape, jnp.int32),
+        _sds((b,), jnp.int8),       # verdict
+        _sds((b,), jnp.int8),       # ring_status
+        _sds((b,), jnp.int8),       # eff_ring
+        _sds((b,), jnp.float32),    # sigma_eff
+        _sds((b,), jnp.int8),       # severity
+        _sds((b,), jnp.float32),    # anomaly_rate
+        _sds((b,), jnp.int32),      # window_calls
+        _sds((b,), jnp.bool_),      # tripped
+    )
+    (
+        af32, ai32, verdict, ring_status, eff_ring, sigma_eff,
+        severity, anomaly_rate, window_calls, tripped,
+    ) = _cb(
+        twin, shapes,
+        agents.f32, agents.i32, agents.ring,
+        elevations.agent, elevations.granted_ring, elevations.expires_at,
+        elevations.active,
+        slot, required, ro, cons, wit, host, valid,
+        jnp.asarray(now, jnp.float32),
+    )
+    agents = replace(agents, f32=af32, i32=ai32)
+    lanes = GatewayResult(
+        agents=None,
+        verdict=verdict,
+        ring_status=ring_status,
+        eff_ring=eff_ring,
+        sigma_eff=sigma_eff,
+        severity=severity,
+        anomaly_rate=anomaly_rate,
+        window_calls=window_calls,
+        tripped=tripped,
+        metrics=None,
+        trace=None,
+    )
+    return agents, lanes
+
+
+# ── block 5: epilogue (gauges + sampled sanitizer) ───────────────────
+
+
+def epilogue_block(
+    agents,
+    sessions,
+    vouches,
+    sagas,
+    elevations,
+    delta_log,
+    event_log,
+    trace_log,
+    ring_bursts,
+    sanitize: bool,
+    config=DEFAULT_CONFIG,
+):
+    """The control-plane epilogue as ONE out-of-line twin call (the CPU
+    megakernel boundary — inline XLA on chip, `twin_boundary`): the
+    occupancy-gauge values (fixed slot order,
+    `observability.metrics.apply_occupancy_gauges` writes them) and,
+    when `sanitize`, the invariant sanitizer's masks + totals.
+
+    Returns (gauges i32[EPILOGUE_GAUGES], IntegrityResult | None) —
+    the result carries metrics=None; the caller books the counters.
+    """
+    from hypervisor_tpu.integrity.invariants import IntegrityResult
+
+    has_elevs = elevations is not None
+    has_delta = delta_log is not None
+    has_trace = trace_log is not None
+    n = agents.ring.shape[0]
+    sc = sessions.i32.shape[0]
+    e = vouches.session.shape[0]
+    g = sagas.saga_state.shape[0]
+    m = elevations.agent.shape[0] if has_elevs else 1
+    elev_args = (
+        (elevations.agent, elevations.granted_ring, elevations.active)
+        if has_elevs
+        else (
+            jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int8),
+            jnp.zeros((1,), jnp.bool_),
+        )
+    )
+    delta_args = (
+        (delta_log.session, delta_log.turn, delta_log.cursor)
+        if has_delta
+        else (
+            jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+            jnp.zeros((), jnp.int32),
+        )
+    )
+    trace_cursor = (
+        trace_log.cursor if has_trace else jnp.zeros((), jnp.int32)
+    )
+    d = delta_log.session.shape[0] if has_delta else 1
+    twin = functools.partial(
+        wave_pallas.epilogue_block_np,
+        sanitize=sanitize,
+        has_elevs=has_elevs,
+        has_delta=has_delta,
+        has_trace=has_trace,
+        ring2_threshold=float(config.trust.ring2_threshold),
+        event_capacity=event_log.capacity_rows,
+        trace_capacity=trace_log.capacity_rows if has_trace else 1,
+    )
+    shapes = (
+        _sds((wave_pallas.EPILOGUE_GAUGES,), jnp.int32),
+        _sds((n,), jnp.uint32),
+        _sds((sc,), jnp.uint32),
+        _sds((e,), jnp.uint32),
+        _sds((g,), jnp.uint32),
+        _sds((m,), jnp.uint32),
+        _sds((3,), jnp.uint32),
+        _sds((), jnp.int32),
+        _sds((), jnp.int32),
+    )
+    (
+        gauges, amask, smask, vmask, gmask, emask, log_mask, total,
+        unrepairable,
+    ) = _cb(
+        twin, shapes,
+        agents.f32, agents.i32, agents.ring,
+        sessions.i32, sessions.f32,
+        vouches.voucher, vouches.vouchee, vouches.bond, vouches.bond_pct,
+        vouches.active,
+        sagas.step_state, sagas.saga_state, sagas.session, sagas.n_steps,
+        sagas.cursor,
+        *elev_args,
+        *delta_args,
+        event_log.cursor, trace_cursor,
+        jnp.asarray(ring_bursts, jnp.float32),
+    )
+    result = None
+    if sanitize:
+        result = IntegrityResult(
+            agent_mask=amask,
+            session_mask=smask,
+            vouch_mask=vmask,
+            saga_mask=gmask,
+            elev_mask=emask,
+            log_mask=log_mask,
+            total=total,
+            unrepairable=unrepairable,
+            metrics=None,
+        )
+    return gauges, result
+
+
+# ── the saga round's block (standalone dispatch) ─────────────────────
+
+
+def saga_tick_block(
+    step_state, retries_left, has_undo, saga_state, n_steps, cursor,
+    exec_success, undo_success, exec_attempted, undo_attempted,
+):
+    """The saga-round core (cursor advance + compensation selection +
+    settle) as ONE launch/call — `ops.saga_ops.saga_table_tick`'s armed
+    form. Returns (step_state, retries_left, saga_state, cursor,
+    committed bool[G], exhausted bool[G])."""
+    g, m = step_state.shape
+    if not twin_boundary():
+        return wave_pallas.saga_tick_block_pallas(
+            step_state, retries_left, has_undo, saga_state, n_steps,
+            cursor, exec_success, undo_success, exec_attempted,
+            undo_attempted,
+        )
+    shapes = (
+        _sds((g, m), jnp.int8),
+        _sds((g, m), jnp.int8),
+        _sds((g,), jnp.int8),
+        _sds((g,), jnp.int32),
+        _sds((g,), jnp.bool_),
+        _sds((g,), jnp.bool_),
+    )
+    return _cb(
+        wave_pallas.saga_tick_block_np, shapes,
+        step_state, retries_left, has_undo, saga_state, n_steps, cursor,
+        exec_success, undo_success, exec_attempted, undo_attempted,
+    )

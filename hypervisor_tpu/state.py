@@ -42,6 +42,7 @@ from hypervisor_tpu.ops import liability as liability_ops
 from hypervisor_tpu.ops import merkle as merkle_ops
 from hypervisor_tpu.ops import pipeline as pipeline_ops
 from hypervisor_tpu.ops import terminate as terminate_ops
+from hypervisor_tpu.ops import wave_blocks
 from hypervisor_tpu.tables.intern import InternTable
 from hypervisor_tpu.tables.logs import DeltaLog, EventLog
 from hypervisor_tpu.tables.state import (
@@ -157,7 +158,9 @@ _ADMIT_DONATED = health_plane.instrument(
     static_argnames=("cache_salt",),
 )
 _SAGA_TICK = health_plane.instrument(
-    "saga_table_tick", jax.jit(saga_ops.saga_table_tick)
+    "saga_table_tick",
+    jax.jit(saga_ops.saga_table_tick, static_argnames=("wave_kernels",)),
+    static_argnames=("wave_kernels",),
 )
 _TERMINATE = health_plane.instrument(
     "terminate_batch",
@@ -170,7 +173,7 @@ _TERMINATE = health_plane.instrument(
 # drift.
 _WAVE_STATICS = (
     "use_pallas", "unique_sessions", "trust", "breach", "rate_limit",
-    "sanitize", "config", "cache_salt",
+    "sanitize", "config", "cache_salt", "wave_kernels",
 )
 _WAVE = health_plane.instrument(
     "governance_wave",
@@ -1162,6 +1165,12 @@ class HypervisorState:
                     sanitize=sanitize,
                     config=self.config,
                     cache_salt=_DONATION_CACHE_SALT if donated else 0.0,
+                    # Whole-wave megakernel routing (round 12): the
+                    # `HV_WAVE_PALLAS` arming is read PER CALL and rides
+                    # the jit statics, so flipping the env (tests, the
+                    # megakernel smoke gate) never serves a stale
+                    # cached program — the HV_DONATE_TABLES discipline.
+                    wave_kernels=wave_blocks.wave_kernels_enabled(),
                     # Bucket padding (serving): the valid operands are
                     # TRACED (array scalars/masks), so every bucket
                     # shape compiles once and serves any fill level.
@@ -2236,6 +2245,10 @@ class HypervisorState:
                     metrics=self.metrics.table,
                     trace=self.tracer.table,
                     trace_ctx=th.ctx if th is not None else None,
+                    # Megakernel routing rides the jit statics (per-call
+                    # env read — `HV_WAVE_PALLAS` flips never serve a
+                    # stale cached program).
+                    wave_kernels=wave_blocks.wave_kernels_enabled(),
                 )
             )
         self.metrics.commit(m_table)

@@ -40,6 +40,13 @@
 #      TPU plugin is absent/wedged — exit 75 = skip, never a failure)
 #      and hold its dispatch-bearing ENTRY steps to the committed
 #      trajectory row + the >=2x r09 fusion-ratio floor,
+#   6e. a megakernel smoke gate (round 12) — the whole-wave Mosaic
+#      megakernel path (HV_WAVE_PALLAS=1; CPU twins out-of-line on this
+#      backend) must replay a seeded wave history BIT-IDENTICALLY to
+#      the reference path (chain heads + table bytes + metrics
+#      digests, twice), and a warmed serving scheduler must hold ZERO
+#      new compiles/recompiles on its closed bucket set with the
+#      kernels armed,
 #   6d. a serving soak smoke gate — a short seeded open-workload burst
 #      through the serving front door must hold p99 under the smoke
 #      SLO with zero invariant violations and ZERO post-warmup
@@ -144,7 +151,7 @@ ctx = tracing.TraceContext(
 jaxpr = str(jax.make_jaxpr(
     lambda *a: governance_wave(
         *a, use_pallas=False, metrics=mp.REGISTRY.create_table(),
-        trace=TraceLog.create(64), trace_ctx=ctx,
+        trace=TraceLog.create(64), trace_ctx=ctx, wave_kernels=False,
     )
 )(
     agents, sessions, vouches,
@@ -486,7 +493,12 @@ from pathlib import Path
 from benchmarks import regression
 
 fresh = json.loads(Path("/tmp/_census_gate.json").read_text())
-fused = fresh["programs"]["fused_wave_sanitized"]
+# Round 12: the gated program is the MEGAKERNEL wave (the committed
+# rows' dispatch_steps measure it from r12 on); older trees without the
+# armed program fall back to the reference fused wave.
+fused = fresh["programs"].get(
+    "fused_wave_megakernel", fresh["programs"]["fused_wave_sanitized"]
+)
 rows = [
     r for r in regression.load_history()
     if r.get("census") and r["census"].get("backend") == fresh["backend"]
@@ -499,20 +511,144 @@ assert fused["dispatch"] <= committed["dispatch_steps"] * tol, (
     f"committed {committed['dispatch_steps']} (+{(tol - 1) * 100:.0f}% band)"
 )
 if fresh.get("fusion_ratio") is not None:
-    assert fresh["fusion_ratio"] >= regression.DEFAULT_CENSUS_FUSION_FLOOR, (
-        f"fusion ratio fell below the floor: {fresh['fusion_ratio']}"
+    floor = regression.census_fusion_floor(rows[-1]["round"])
+    assert fresh["fusion_ratio"] >= floor, (
+        f"fusion ratio fell below the floor: {fresh['fusion_ratio']} "
+        f"< {floor}"
+    )
+if fresh.get("wave_cut_ratio") is not None:
+    assert fresh["wave_cut_ratio"] >= 4.0, (
+        "megakernel wave lost the >=4x step cut vs the r10 anchor: "
+        f"{fresh['wave_cut_ratio']}"
     )
 print(
-    f"dispatch census OK [{fresh['backend']}]: fused "
+    f"dispatch census OK [{fresh['backend']}]: megakernel wave "
     f"{fused['dispatch']} dispatch-bearing steps "
     f"(committed {committed['dispatch_steps']}), fusion ratio "
-    f"{fresh['fusion_ratio']} vs r09's {committed['r09_baseline_dispatch']}"
+    f"{fresh['fusion_ratio']} vs r09's {committed['r09_baseline_dispatch']}, "
+    f"r10 cut {fresh.get('wave_cut_ratio')}x"
 )
 PY
     census_rc=$?
 else
     echo "dispatch census FAILED to run (rc=$census_rc)" >&2
 fi
+
+echo "── megakernel parity smoke gate ──"
+JAX_PLATFORMS=cpu python - <<'PY'
+# Round-12 acceptance: the whole-wave megakernel path (HV_WAVE_PALLAS=1
+# — the Mosaic wave blocks, executing as CPU twins out-of-line on this
+# backend) must replay a seeded wave history BIT-IDENTICALLY to the
+# reference XLA path — Merkle chain heads, agent/session table bytes,
+# metrics digests — twice (determinism under arming), and a warmed
+# serving scheduler must hold ZERO new compiles/recompiles on its
+# closed bucket set with the kernels armed (the PR-10 contract
+# survives the megakernel routing).
+import hashlib
+import os
+
+import numpy as np
+
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.observability import metrics as mp
+from hypervisor_tpu.state import HypervisorState
+
+
+def drive():
+    st = HypervisorState()
+    for r in range(4):
+        slots = st.create_sessions_batch(
+            [f"mk{r}:{i}" for i in range(3)],
+            SessionConfig(min_sigma_eff=0.0),
+        )
+        st.run_governance_wave(
+            slots, [f"did:mk{r}:{i}" for i in range(3)], slots.copy(),
+            np.full(3, 0.8, np.float32),
+            np.arange(3 * 32, dtype=np.uint32).reshape(2, 3, 16),
+            now=float(r),
+            actions={"slots": [0, 1]} if r >= 2 else None,
+        )
+    snap = st.metrics_snapshot()
+    heads = sorted(
+        (s, tuple(int(w) for w in v)) for s, v in st._chain_seed.items()
+    )
+    mirrors = (
+        snap.counter(mp.WAVE_TICKS), snap.counter(mp.ADMITTED),
+        snap.counter(mp.GATEWAY_ALLOWED),
+        snap.counter(mp.SESSIONS_ARCHIVED),
+        snap.gauge(mp.TABLE_LIVE_ROWS["delta_log"]),
+    )
+    tables = hashlib.sha256(b"".join(
+        np.ascontiguousarray(np.asarray(x)).tobytes()
+        for x in (st.agents.f32, st.agents.i32, st.agents.ring,
+                  st.sessions.i32, st.sessions.f32)
+    )).hexdigest()
+    return hashlib.sha256(repr(heads).encode()).hexdigest(), mirrors, tables
+
+
+assert os.environ.get("HV_WAVE_PALLAS") is None
+ref = drive()
+os.environ["HV_WAVE_PALLAS"] = "1"
+try:
+    armed = drive()
+    armed2 = drive()
+finally:
+    del os.environ["HV_WAVE_PALLAS"]
+assert armed == armed2, "megakernel path not deterministic across replays"
+assert ref[0] == armed[0], "chain heads diverge on the megakernel path"
+assert ref[1] == armed[1], (
+    f"metrics mirrors diverge: {ref[1]} vs {armed[1]}"
+)
+assert ref[2] == armed[2], "table bytes diverge on the megakernel path"
+print(
+    "megakernel parity OK: armed vs reference bit-identical "
+    f"(chain-head digest {armed[0][:12]}…, {len(ref[1])} mirrors, "
+    "table digest matched), replay-deterministic"
+)
+PY
+megakernel_rc=$?
+
+echo "── megakernel warmed-scheduler recompile gate ──"
+HV_WAVE_PALLAS=1 JAX_PLATFORMS=cpu python - <<'PY'
+# The PR-10 closed-bucket contract under megakernel arming: a warmed
+# WaveScheduler drives a short seeded open workload with the wave
+# kernels armed and must report ZERO compiles/recompiles after warmup —
+# the armed program variants were all precompiled by warm(), so an
+# arming-induced recompile storm (or an armed shape escaping the
+# buckets) lands here.
+from hypervisor_tpu.serving import (
+    ServingConfig, WorkloadSpec, generate_trace, run_soak,
+)
+
+spec = WorkloadSpec(seed=12, rate_hz=100.0, duration_s=0.4)
+trace = generate_trace(spec)
+cfg = ServingConfig(
+    join_deadline_s=0.25, action_deadline_s=0.25,
+    lifecycle_deadline_s=0.4, terminate_deadline_s=0.5,
+    saga_deadline_s=0.25,
+)
+rep = run_soak(spec, trace=trace, serving_config=cfg, tick_s=0.02,
+               slo_p99_ms=5000.0)
+assert rep["served"] > 0, "armed soak served nothing"
+assert rep["compiles_after_warmup"] == 0, (
+    f"warmed scheduler compiled {rep['compiles_after_warmup']} new "
+    "program(s) with the megakernels armed"
+)
+assert rep["recompiles_after_warmup"] == 0, (
+    f"warmed scheduler recompiled {rep['recompiles_after_warmup']}x "
+    "with the megakernels armed"
+)
+assert rep["invariant_violations"] == 0, (
+    f"{rep['invariant_violations']} invariant violations under the "
+    "armed soak"
+)
+print(
+    f"megakernel scheduler OK: {rep['served']} served armed, zero "
+    "post-warmup compiles/recompiles on the closed bucket set, zero "
+    "violations"
+)
+PY
+megakernel_sched_rc=$?
 
 echo "── serving soak smoke gate ──"
 JAX_PLATFORMS=cpu python - <<'PY'
@@ -614,6 +750,14 @@ fi
 if [ "$census_rc" -ne 0 ]; then
     echo "dispatch-census gate FAILED (rc=$census_rc)" >&2
     exit "$census_rc"
+fi
+if [ "$megakernel_rc" -ne 0 ]; then
+    echo "megakernel parity smoke gate FAILED (rc=$megakernel_rc)" >&2
+    exit "$megakernel_rc"
+fi
+if [ "$megakernel_sched_rc" -ne 0 ]; then
+    echo "megakernel warmed-scheduler gate FAILED (rc=$megakernel_sched_rc)" >&2
+    exit "$megakernel_sched_rc"
 fi
 if [ "$soak_rc" -ne 0 ]; then
     echo "serving soak smoke gate FAILED (rc=$soak_rc)" >&2

@@ -243,6 +243,57 @@ class TestLoweringBudget:
         assert heavy <= total
 
 
+class TestMegakernelOneProgram:
+    """Round 12: the one-program contract must survive megakernel
+    arming — a fully-loaded armed facade wave still never touches the
+    standalone gateway/sanitizer programs, and the armed program stays
+    ONE dispatch with the wave blocks as its only out-of-line steps."""
+
+    def test_armed_wave_keeps_the_one_program_contract(self, monkeypatch):
+        from hypervisor_tpu.integrity import plane as plane_mod
+
+        monkeypatch.setenv("HV_WAVE_PALLAS", "1")
+        st = HypervisorState(SMALL)
+        plane = IntegrityPlane(st, every=1, scrub_every=0)
+        gw_before = state_mod._GATEWAY.stats()["compiles"]
+        inv_before = plane_mod._CHECK_INVARIANTS.stats()["compiles"]
+
+        drive(st, rounds=2, actions=True)
+
+        assert state_mod._GATEWAY.stats()["compiles"] == gw_before, (
+            "standalone gateway program compiled under megakernel arming"
+        )
+        assert (
+            plane_mod._CHECK_INVARIANTS.stats()["compiles"] == inv_before
+        ), "standalone sanitizer program compiled under megakernel arming"
+        assert plane.checks >= 2
+        snap = st.metrics_snapshot()
+        assert snap.gauge(mp.TABLE_LIVE_ROWS["delta_log"]) == 6
+        assert snap.counter(mp.INTEGRITY_VIOLATIONS) == 0
+
+    def test_armed_matches_reference_history(self, monkeypatch):
+        """The megakernel path must replay the reference history
+        bit-identically — the facade-level twin of the per-block pins
+        in tests/unit/test_wave_kernels.py."""
+        monkeypatch.delenv("HV_WAVE_PALLAS", raising=False)
+        st_ref = HypervisorState(SMALL)
+        drive(st_ref, rounds=3)
+        ref = _collect(st_ref)
+
+        monkeypatch.setenv("HV_WAVE_PALLAS", "1")
+        st_armed = HypervisorState(SMALL)
+        drive(st_armed, rounds=3)
+        armed = _collect(st_armed)
+
+        assert ref[0] == armed[0], "chain heads diverge"
+        assert ref[1] == armed[1], "metrics mirrors diverge"
+        for name in ("f32", "i32", "ring"):
+            np.testing.assert_array_equal(
+                getattr(ref[2], name), getattr(armed[2], name),
+                err_msg=name,
+            )
+
+
 class TestDonationParity:
     def test_optout_bit_identical(self, monkeypatch):
         """HV_DONATE_TABLES=0 must replay the identical history —

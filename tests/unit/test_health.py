@@ -564,20 +564,63 @@ def _suite_report(
         },
         # Rounds >= regression.CENSUS_ROW_SINCE must carry the
         # dispatch-census row (round-10 presence gate) — synthetic
-        # rounds mirror a committed payload's shape.
-        "dispatch_census": {
-            "backend": backend,
-            "entry_steps": 310,
-            "dispatch_steps": 148,
-            "entry_steps_no_donate": 328,
-            "dispatch_steps_no_donate": 166,
-            "copy_steps": 7,
-            "donation_delta_steps": 18,
-            "unfused_total_dispatch": 176,
-            "self_fusion_ratio": 1.19,
-            "fusion_ratio": 2.18,
-            "r09_baseline_dispatch": 322,
-        },
+        # rounds mirror a committed payload's shape. From round 12 the
+        # headline steps are the MEGAKERNEL wave and the fusion floor
+        # is the bumped r12 bar (regression.R12_CENSUS_FUSION_FLOOR),
+        # so synthetic r12+ rounds carry megakernel-era numbers.
+        "dispatch_census": (
+            {
+                "backend": backend,
+                "entry_steps": 168,
+                "dispatch_steps": 35,
+                "reference_entry_steps": 310,
+                "reference_dispatch_steps": 148,
+                "entry_steps_no_donate": 173,
+                "dispatch_steps_no_donate": 40,
+                "copy_steps": 7,
+                "donation_delta_steps": 18,
+                "unfused_total_dispatch": 176,
+                "self_fusion_ratio": 1.19,
+                "fusion_ratio": 9.2,
+                "fusion_ratio_reference": 2.18,
+                "r09_baseline_dispatch": 322,
+                "r10_baseline_dispatch": 148,
+                "wave_cut_ratio": 4.23,
+            }
+            if round_no >= 12
+            else {
+                "backend": backend,
+                "entry_steps": 310,
+                "dispatch_steps": 148,
+                "entry_steps_no_donate": 328,
+                "dispatch_steps_no_donate": 166,
+                "copy_steps": 7,
+                "donation_delta_steps": 18,
+                "unfused_total_dispatch": 176,
+                "self_fusion_ratio": 1.19,
+                "fusion_ratio": 2.18,
+                "r09_baseline_dispatch": 322,
+            }
+        ),
+        # Rounds >= regression.WAVE_ROW_SINCE must carry the megakernel
+        # bench row (round-12 presence gate).
+        "wave_megakernel": (
+            {
+                "quick": quick,
+                "lanes": 2048,
+                "mode": "cpu-twin",
+                "blocks": {
+                    "admission": {"per_op_p50_us": 0.8},
+                    "fsm_saga": {"per_op_p50_us": 0.8},
+                    "audit": {"per_op_p50_us": 28.0},
+                    "gateway": {"per_op_p50_us": 2.3},
+                    "epilogue": {"per_op_p50_us": 3.5},
+                },
+                "census_dispatch_steps": 35,
+            }
+            if round_no >= 12
+            else None
+        ),
         # Rounds >= regression.SOAK_ROW_SINCE must carry the serving
         # soak row (round-11 presence gate).
         "soak": {

@@ -1,0 +1,608 @@
+"""Whole-wave Mosaic megakernels (round 12): twin/kernel parity pins.
+
+The contract this file guards (ISSUE 11 acceptance):
+
+  * every wave-kernel numpy twin is BIT-IDENTICAL to the pre-megakernel
+    XLA phase op it replaces — admission ladder + capacity ranks + row
+    writes, FSM+saga+terminate walk, audit chain/roots/ring append, the
+    gateway gate walk (f32 token arithmetic included), the epilogue's
+    gauge values + sanitizer masks, and the saga-round tick,
+  * the armed facade path (HV_WAVE_PALLAS=1 — blocks out-of-line on
+    CPU) replays seeded histories bit-identically to the reference
+    path: chain heads, tables, metrics mirrors, padded-vs-unpadded,
+    donated and HV_DONATE_TABLES=0,
+  * arming is per-call env read with the set_wave_kernels override
+    outranking (the HV_SHA256_PALLAS convention),
+  * the armed program's census structure: one custom call per block,
+    dispatch-bearing steps within the ISSUE 11 budget (148 -> <=37),
+  * the kernel-side bitonic rank network computes the identical
+    capacity ranks as the twins' stable argsort.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig, TableCapacity
+from hypervisor_tpu.kernels import wave_pallas
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.observability import metrics as mp
+from hypervisor_tpu.ops import admission as admission_ops
+from hypervisor_tpu.ops import gateway as gateway_ops
+from hypervisor_tpu.ops import merkle as merkle_ops
+from hypervisor_tpu.ops import saga_ops, wave_blocks
+from hypervisor_tpu.state import HypervisorState
+from hypervisor_tpu.tables import state as ts
+from hypervisor_tpu.tables.logs import DeltaLog, EventLog, TraceLog
+from hypervisor_tpu.tables.state import (
+    AgentTable,
+    ElevationTable,
+    SagaTable,
+    SessionTable,
+    VouchTable,
+)
+from hypervisor_tpu.tables.struct import replace as t_replace
+
+SMALL = HypervisorConfig(
+    capacity=TableCapacity(
+        max_agents=64,
+        max_sessions=32,
+        max_vouch_edges=64,
+        max_sagas=16,
+        max_steps_per_saga=4,
+        max_elevations=16,
+        delta_log_capacity=256,
+        event_log_capacity=64,
+        trace_log_capacity=128,
+    )
+)
+
+#: ISSUE 11 acceptance budget for the fully-loaded ARMED fused program
+#: (148 -> <=37 dispatch-bearing steps; the small shape lowers to the
+#: same structure as the bench shape).
+ARMED_DISPATCH_BUDGET = 37
+
+
+def _tree_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ── twin-vs-XLA unit parity ──────────────────────────────────────────
+
+
+class TestAdmissionTwin:
+    def _stage(self, rng, b=24, n=64, sc=32, unique=False):
+        agents = AgentTable.create(n)
+        sessions = SessionTable.create(sc)
+        live = rng.choice(sc, sc // 2, replace=False)
+        sessions = t_replace(
+            sessions,
+            sid=sessions.sid.at[live].set(jnp.asarray(live, jnp.int32)),
+            state=sessions.state.at[live].set(1),
+            max_participants=sessions.max_participants.at[:].set(3),
+            min_sigma_eff=sessions.min_sigma_eff.at[:].set(0.5),
+        )
+        slot = jnp.asarray(rng.choice(n, b, replace=False).astype(np.int32))
+        if unique:
+            session_slot = jnp.asarray(
+                rng.choice(sc, b, replace=False).astype(np.int32)
+            )
+        else:
+            session_slot = jnp.asarray(
+                rng.randint(0, sc, b).astype(np.int32)
+            )
+        args = dict(
+            slot=slot,
+            did=jnp.asarray(rng.randint(0, 1000, b).astype(np.int32)),
+            session_slot=session_slot,
+            sigma_raw=jnp.asarray(rng.uniform(0, 1, b).astype(np.float32)),
+            trustworthy=jnp.asarray(rng.uniform(size=b) > 0.2),
+            duplicate=jnp.asarray(rng.uniform(size=b) > 0.8),
+        )
+        contribution = jnp.asarray(
+            rng.uniform(0, 0.5, b).astype(np.float32)
+        )
+        return agents, sessions, args, contribution
+
+    @pytest.mark.parametrize("unique", [False, True])
+    def test_block_matches_admit_batch(self, unique):
+        rng = np.random.RandomState(7 + unique)
+        agents, sessions, args, contribution = self._stage(
+            rng, unique=unique
+        )
+        ref = admission_ops.admit_batch(
+            agents, sessions, now=3.0, trust=DEFAULT_CONFIG.trust,
+            contribution=contribution, omega=0.5,
+            unique_sessions=unique, **args,
+        )
+        got_agents, got_sessions, status, ring, sigma_eff = (
+            wave_blocks.admission_block(
+                agents, sessions, args["slot"], args["did"],
+                args["session_slot"], args["sigma_raw"], contribution,
+                jnp.float32(0.5), args["trustworthy"], args["duplicate"],
+                jnp.float32(3.0),
+                jnp.asarray(
+                    DEFAULT_CONFIG.rate_limit.ring_bursts, jnp.float32
+                ),
+                DEFAULT_CONFIG.trust, unique,
+            )
+        )
+        np.testing.assert_array_equal(np.asarray(ref.status), np.asarray(status))
+        np.testing.assert_array_equal(np.asarray(ref.ring), np.asarray(ring))
+        np.testing.assert_array_equal(
+            np.asarray(ref.sigma_eff), np.asarray(sigma_eff)
+        )
+        _tree_equal(ref.agents, got_agents)
+        _tree_equal(ref.sessions, got_sessions)
+
+    def test_bitonic_rank_matches_stable_argsort(self):
+        """The Mosaic kernels' shared sort network (plain jnp code —
+        runnable off-chip) must produce the identical capacity ranks as
+        the twins' stable argsort, including duplicate keys."""
+        rng = np.random.RandomState(11)
+        for b in (8, 32, 128):
+            keys = rng.randint(0, 7, b).astype(np.int32)
+            orig_lane, rank_sorted = wave_pallas._bitonic_rank(
+                jnp.asarray(keys).reshape(1, b)
+            )
+            got = np.zeros(b, np.int32)
+            got[np.asarray(orig_lane)[0]] = np.asarray(rank_sorted)[0]
+            expect = wave_pallas._rank_within_np(keys.astype(np.int64))
+            np.testing.assert_array_equal(got, expect)
+
+
+class TestAuditTwin:
+    def test_block_matches_chain_roots_and_append(self):
+        rng = np.random.RandomState(3)
+        t, k, c = 5, 6, 64
+        bodies = jnp.asarray(
+            rng.randint(0, 2**32, (t, k, 16), dtype=np.uint64
+                        ).astype(np.uint32)
+        )
+        k_sessions = jnp.arange(k, dtype=jnp.int32)
+        ring = DeltaLog.create(c)
+        ring = DeltaLog(
+            body=ring.body, digest=ring.digest, session=ring.session,
+            turn=ring.turn, cursor=jnp.int32(c - 7),  # wrap mid-append
+        )
+        chain_ref = merkle_ops.chain_digests(bodies, use_pallas=False)
+        p = 1 << max(0, (t - 1).bit_length())
+        leaves = jnp.zeros((k, p, 8), jnp.uint32)
+        leaves = leaves.at[:, :t].set(jnp.transpose(chain_ref, (1, 0, 2)))
+        roots_ref = merkle_ops.merkle_root_lanes(
+            leaves, jnp.int32(t), use_pallas=False
+        )
+        n_valid = 4  # padded serving wave: two pad session lanes
+        ring_ref = ring.append_batch_prefix(
+            jnp.transpose(bodies, (1, 0, 2)).reshape(k * t, 16),
+            jnp.transpose(chain_ref, (1, 0, 2)).reshape(k * t, 8),
+            jnp.repeat(k_sessions, t),
+            jnp.tile(jnp.arange(t, dtype=jnp.int32), k),
+            jnp.int32(n_valid * t),
+        )
+        chain, roots, ring_got = wave_blocks.audit_block(
+            bodies, k_sessions, ring, jnp.int32(n_valid), False
+        )
+        np.testing.assert_array_equal(np.asarray(chain_ref), np.asarray(chain))
+        np.testing.assert_array_equal(np.asarray(roots_ref), np.asarray(roots))
+        _tree_equal(ring_ref, ring_got)
+
+
+class TestGatewayTwin:
+    def test_block_matches_check_actions(self):
+        rng = np.random.RandomState(5)
+        n, m, b = 64, 16, 32
+        agents = AgentTable.create(n)
+        f32 = np.zeros((n, 8), np.float32)
+        f32[:, ts.AF32_SIGMA_EFF] = rng.uniform(0, 1, n)
+        f32[:, ts.AF32_RL_TOKENS] = rng.uniform(0, 5, n)
+        f32[:, ts.AF32_RL_STAMP] = rng.uniform(0, 2, n)
+        f32[:, ts.AF32_BD_BREAKER_UNTIL] = rng.uniform(0, 8, n)
+        i32 = np.zeros((n, ts.AI32_WIDTH), np.int32)
+        i32[:, ts.AI32_DID] = np.arange(n)
+        i32[:, ts.AI32_FLAGS] = rng.choice(
+            [ts.FLAG_ACTIVE, ts.FLAG_ACTIVE | ts.FLAG_QUARANTINED,
+             ts.FLAG_ACTIVE | ts.FLAG_BREAKER_TRIPPED], n,
+        )
+        # seeded breach windows (bucketed counts + epochs)
+        kb = ts.BD_BUCKETS
+        i32[:, ts.AI32_BD_WIN_START:ts.AI32_BD_WIN_START + kb] = rng.randint(
+            0, 6, (n, kb)
+        )
+        i32[:, ts.AI32_BD_WIN_START + kb:ts.AI32_BD_WIN_START + 2 * kb] = (
+            rng.randint(0, 3, (n, kb))
+        )
+        i32[:, ts.AI32_BD_WIN_START + 2 * kb:ts.AI32_BD_WIN_STOP] = (
+            rng.randint(-2, 2, (n, kb))
+        )
+        agents = AgentTable(
+            f32=jnp.asarray(f32), i32=jnp.asarray(i32),
+            ring=jnp.asarray(rng.randint(0, 4, n).astype(np.int8)),
+        )
+        elevations = ElevationTable(
+            agent=jnp.asarray(rng.randint(-1, n, m).astype(np.int32)),
+            granted_ring=jnp.asarray(rng.randint(0, 4, m).astype(np.int8)),
+            expires_at=jnp.asarray(rng.uniform(0, 20, m).astype(np.float32)),
+            active=jnp.asarray(rng.uniform(size=m) > 0.4),
+        )
+        gw_args = (
+            jnp.asarray(rng.randint(0, n, b).astype(np.int32)),  # dup slots
+            jnp.asarray(rng.randint(0, 4, b).astype(np.int8)),
+            jnp.asarray(rng.uniform(size=b) > 0.5),
+            jnp.asarray(rng.uniform(size=b) > 0.5),
+            jnp.asarray(rng.uniform(size=b) > 0.5),
+            jnp.asarray(rng.uniform(size=b) > 0.9),
+            jnp.asarray(rng.uniform(size=b) > 0.2),  # ragged padding
+        )
+        now = 10.0
+        ref = gateway_ops.check_actions(
+            agents, elevations, *gw_args[:6], now, valid=gw_args[6],
+        )
+        got_agents, lanes = wave_blocks.gateway_block(
+            agents, elevations, gw_args, jnp.float32(now)
+        )
+        for field in (
+            "verdict", "ring_status", "eff_ring", "sigma_eff", "severity",
+            "anomaly_rate", "window_calls", "tripped",
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, field)),
+                np.asarray(getattr(lanes, field)),
+                err_msg=field,
+            )
+        _tree_equal(ref.agents, got_agents)
+        assert lanes.agents is None
+
+
+class TestEpilogueTwin:
+    def _tables(self, rng):
+        st = HypervisorState(SMALL)
+        slots = st.create_sessions_batch(
+            ["ep:a", "ep:b"], SessionConfig(min_sigma_eff=0.0)
+        )
+        st.run_governance_wave(
+            slots, ["did:ep:0", "did:ep:1"], slots.copy(),
+            np.full(2, 0.8, np.float32),
+            np.arange(2 * 16, dtype=np.uint32).reshape(1, 2, 16),
+            now=1.0,
+        )
+        return st
+
+    def test_gauges_and_sanitizer_match_inline(self):
+        rng = np.random.RandomState(9)
+        st = self._tables(rng)
+        bursts = st._ring_bursts
+        gauges, sres = wave_blocks.epilogue_block(
+            st.agents, st.sessions, st.vouches, st.sagas, st.elevations,
+            st.delta_log, st.event_log, st.tracer.table, bursts, True,
+            config=SMALL,
+        )
+        from hypervisor_tpu.integrity import invariants as inv
+
+        ref = inv.check_invariants(
+            st.agents, st.sessions, st.vouches, st.sagas, st.elevations,
+            st.delta_log, st.event_log, st.tracer.table,
+            jnp.asarray(bursts, jnp.float32), config=SMALL,
+        )
+        for field in (
+            "agent_mask", "session_mask", "vouch_mask", "saga_mask",
+            "elev_mask", "log_mask", "total", "unrepairable",
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, field)),
+                np.asarray(getattr(sres, field)),
+                err_msg=field,
+            )
+        # gauge values == what the inline update_gauges writes: apply
+        # both to fresh metrics tables and compare the table bytes.
+        from hypervisor_tpu.observability.metrics import (
+            REGISTRY,
+            apply_occupancy_gauges,
+            update_gauges,
+        )
+
+        m_ref = update_gauges(
+            REGISTRY.create_table(), st.agents, st.sessions, st.vouches,
+            st.sagas, st.elevations, st.delta_log, st.event_log,
+            st.tracer.table,
+        )
+        m_got = apply_occupancy_gauges(
+            REGISTRY.create_table(), gauges,
+            has_elevs=True, has_delta=True, has_trace=True,
+        )
+        _tree_equal(m_ref, m_got)
+
+    def test_sanitizer_flags_injected_violation(self):
+        """The twin must SEE corruption, not just bless clean tables:
+        an out-of-range sigma lands in the agent mask identically on
+        both paths."""
+        st = self._tables(np.random.RandomState(1))
+        bad = t_replace(
+            st.agents,
+            sigma_eff=st.agents.sigma_eff.at[1].set(7.5),
+            did=st.agents.did.at[1].set(42),
+        )
+        from hypervisor_tpu.integrity import invariants as inv
+
+        ref = inv.check_invariants(
+            bad, st.sessions, st.vouches, st.sagas, st.elevations,
+            st.delta_log, st.event_log, st.tracer.table,
+            jnp.asarray(st._ring_bursts, jnp.float32), config=SMALL,
+        )
+        _, sres = wave_blocks.epilogue_block(
+            bad, st.sessions, st.vouches, st.sagas, st.elevations,
+            st.delta_log, st.event_log, st.tracer.table,
+            st._ring_bursts, True, config=SMALL,
+        )
+        assert int(ref.total) >= 1
+        assert int(sres.total) == int(ref.total)
+        np.testing.assert_array_equal(
+            np.asarray(ref.agent_mask), np.asarray(sres.agent_mask)
+        )
+
+
+class TestSagaTickTwin:
+    def test_block_matches_table_tick(self):
+        rng = np.random.RandomState(13)
+        g, m = 16, 4
+        sagas = SagaTable.create(g, m)
+        step = rng.randint(0, 7, (g, m)).astype(np.int8)
+        args = dict(
+            step_state=jnp.asarray(step),
+            retries_left=jnp.asarray(
+                rng.randint(0, 3, (g, m)).astype(np.int8)
+            ),
+            has_undo=jnp.asarray(rng.uniform(size=(g, m)) > 0.3),
+            saga_state=jnp.asarray(rng.randint(0, 5, g).astype(np.int8)),
+            n_steps=jnp.asarray(rng.randint(0, m + 1, g).astype(np.int32)),
+            cursor=jnp.asarray(rng.randint(0, m + 1, g).astype(np.int32)),
+            exec_success=jnp.asarray(rng.uniform(size=g) > 0.4),
+            undo_success=jnp.asarray(rng.uniform(size=g) > 0.4),
+            exec_attempted=jnp.asarray(rng.uniform(size=g) > 0.2),
+            undo_attempted=jnp.asarray(rng.uniform(size=g) > 0.2),
+        )
+        del sagas
+        ref = saga_ops.saga_table_tick(**args, wave_kernels=False)
+        got = saga_ops.saga_table_tick(**args, wave_kernels=True)
+        for i, name in enumerate(
+            ("step_state", "retries_left", "saga_state", "cursor")
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(ref[i]), np.asarray(got[i]), err_msg=name
+            )
+
+
+# ── armed facade parity (end to end) ─────────────────────────────────
+
+
+def drive(st, rounds=3, base=0, actions=True, pad=None):
+    for r in range(base, base + rounds):
+        slots = st.create_sessions_batch(
+            [f"wk{r}:{i}" for i in range(3)],
+            SessionConfig(min_sigma_eff=0.0),
+        )
+        kw = dict(
+            now=float(r),
+            actions={"slots": [0, 1]} if actions and r >= 1 else None,
+        )
+        if pad is not None:
+            kw["pad_to"] = pad
+        st.run_governance_wave(
+            slots, [f"did:wk{r}:{i}" for i in range(3)], slots.copy(),
+            np.full(3, 0.8, np.float32),
+            np.arange(3 * 16, dtype=np.uint32).reshape(1, 3, 16),
+            **kw,
+        )
+
+
+def collect(st):
+    snap = st.metrics_snapshot()
+    heads = {s: tuple(int(w) for w in v) for s, v in st._chain_seed.items()}
+    mirrors = {
+        "ticks": snap.counter(mp.WAVE_TICKS),
+        "admitted": snap.counter(mp.ADMITTED),
+        "gw_allowed": snap.counter(mp.GATEWAY_ALLOWED),
+        "archived": snap.counter(mp.SESSIONS_ARCHIVED),
+        "violations": snap.counter(mp.INTEGRITY_VIOLATIONS),
+        "delta_rows": snap.gauge(mp.TABLE_LIVE_ROWS["delta_log"]),
+    }
+    tables = tuple(
+        np.asarray(x).tobytes()
+        for x in jax.tree.leaves(st.agents) + jax.tree.leaves(st.sessions)
+    )
+    return heads, mirrors, tables
+
+
+class TestArmedFacadeParity:
+    def _run(self, monkeypatch, armed, pad=None, plane=False):
+        if armed:
+            monkeypatch.setenv("HV_WAVE_PALLAS", "1")
+        else:
+            monkeypatch.delenv("HV_WAVE_PALLAS", raising=False)
+        st = HypervisorState(SMALL)
+        if plane:
+            from hypervisor_tpu.integrity import IntegrityPlane
+
+            IntegrityPlane(st, every=1, scrub_every=0)
+        drive(st, pad=pad)
+        return collect(st)
+
+    def test_armed_bit_identical(self, monkeypatch):
+        ref = self._run(monkeypatch, False)
+        armed = self._run(monkeypatch, True)
+        assert ref[0] == armed[0], "chain heads diverge"
+        assert ref[1] == armed[1], "metrics mirrors diverge"
+        assert ref[2] == armed[2], "table bytes diverge"
+
+    def test_armed_sanitized_bit_identical(self, monkeypatch):
+        ref = self._run(monkeypatch, False, plane=True)
+        armed = self._run(monkeypatch, True, plane=True)
+        assert ref == armed
+        assert armed[1]["violations"] == 0
+
+    def test_armed_padded_vs_unpadded(self, monkeypatch):
+        # The serving contract (PR 10): padded and unpadded waves agree
+        # on chain heads + metrics mirrors. Dead refused-row residue in
+        # the tables differs by pad lane count on the REFERENCE path
+        # too, so table bytes are pinned armed-vs-reference (above),
+        # not padded-vs-unpadded.
+        padded = self._run(monkeypatch, True, pad=(4, 4))
+        plain = self._run(monkeypatch, True)
+        assert padded[0] == plain[0], "chain heads diverge"
+        assert padded[1] == plain[1], "metrics mirrors diverge"
+
+    def test_armed_padded_matches_reference_padded(self, monkeypatch):
+        # Bit-identity INCLUDING table bytes holds padded-vs-padded.
+        ref = self._run(monkeypatch, False, pad=(4, 4))
+        armed = self._run(monkeypatch, True, pad=(4, 4))
+        assert ref == armed
+
+    def test_armed_donation_optout_bit_identical(self, monkeypatch):
+        armed = self._run(monkeypatch, True)
+        monkeypatch.setenv("HV_DONATE_TABLES", "0")
+        optout = self._run(monkeypatch, True)
+        assert armed == optout
+
+
+# ── arming surface ───────────────────────────────────────────────────
+
+
+class TestArming:
+    def test_env_read_per_call(self, monkeypatch):
+        monkeypatch.delenv("HV_WAVE_PALLAS", raising=False)
+        assert wave_blocks.wave_kernels_enabled() == (
+            wave_pallas.pallas_available()
+        )
+        monkeypatch.setenv("HV_WAVE_PALLAS", "1")
+        assert wave_blocks.wave_kernels_enabled()
+        monkeypatch.setenv("HV_WAVE_PALLAS", "0")
+        assert not wave_blocks.wave_kernels_enabled()
+
+    def test_set_wave_kernels_outranks_env(self, monkeypatch):
+        monkeypatch.setenv("HV_WAVE_PALLAS", "0")
+        wave_pallas.set_wave_kernels(True)
+        try:
+            assert wave_blocks.wave_kernels_enabled()
+        finally:
+            wave_pallas.set_wave_kernels(None)
+        assert not wave_blocks.wave_kernels_enabled()
+
+    def test_twin_boundary_on_cpu(self):
+        # The hermetic suite runs on XLA:CPU where the Mosaic kernels
+        # cannot launch: armed dispatch must report the twin boundary.
+        if not wave_pallas.wave_pallas_ready():
+            assert wave_blocks.twin_boundary()
+
+
+# ── armed census structure ───────────────────────────────────────────
+
+
+class TestArmedCensus:
+    def _compiled_armed(self):
+        from hypervisor_tpu.observability import tracing
+        from hypervisor_tpu.ops.pipeline import governance_wave
+
+        st = HypervisorState(SMALL)
+        b = 3
+        slots = jnp.arange(b, dtype=jnp.int32)
+        ctx = tracing.TraceContext(
+            trace=jnp.uint32(1), span=jnp.uint32(2),
+            wave_seq=jnp.int32(0), sampled=jnp.asarray(True),
+        )
+        act = (
+            jnp.zeros((4,), jnp.int32), jnp.full((4,), 2, jnp.int8),
+            jnp.zeros((4,), bool), jnp.zeros((4,), bool),
+            jnp.zeros((4,), bool), jnp.zeros((4,), bool),
+            jnp.asarray([True, True, False, False]),
+        )
+
+        def fused(agents, sessions, vouches, metrics, trace, delta_log,
+                  sagas, event_log, elevations, bursts):
+            return governance_wave(
+                agents, sessions, vouches, slots, slots, slots,
+                jnp.full((b,), 0.8, jnp.float32), jnp.ones((b,), bool),
+                jnp.zeros((b,), bool), slots,
+                jnp.zeros((1, b, 16), jnp.uint32), 0.0,
+                use_pallas=False, ring_bursts=bursts, metrics=metrics,
+                trace=trace, trace_ctx=ctx, elevations=elevations,
+                gateway_args=act, delta_log=delta_log,
+                epilogue_tables=(sagas, event_log), sanitize=True,
+                config=SMALL, wave_kernels=True,
+            )
+
+        return (
+            jax.jit(fused, donate_argnums=(0, 1, 2, 3, 4, 5))
+            .lower(
+                st.agents, st.sessions, st.vouches, st.metrics.table,
+                st.tracer.table, st.delta_log, st.sagas, st.event_log,
+                st.elevations, st._ring_bursts,
+            )
+            .compile()
+        )
+
+    def test_armed_program_holds_the_issue_budget(self):
+        """The fully-loaded armed program (gateway + append + gauges +
+        sanitizer, donated) lowers to <= 37 dispatch-bearing steps —
+        the ISSUE 11 bar (148 -> <=37) — with one custom call per
+        wave block."""
+        from benchmarks.tpu_aot_census import entry_census, phase_census
+
+        compiled = self._compiled_armed()
+        total, heavy, top = entry_census(compiled)
+        assert heavy <= ARMED_DISPATCH_BUDGET, (
+            f"armed wave lowered to {heavy} dispatch-bearing steps "
+            f"(budget {ARMED_DISPATCH_BUDGET}): {top}"
+        )
+        assert top.get("custom-call", 0) == 5, (
+            "expected exactly one custom call per wave block "
+            f"(admission/fsm_saga/audit/gateway/epilogue): {top}"
+        )
+        phases = phase_census(compiled)
+        # Every carved phase is down to a handful of steps (the block
+        # boundary + its staging/tally glue).
+        for name in ("admission", "fsm_saga", "audit", "gateway"):
+            assert phases[name] <= 8, (name, phases)
+
+    def test_phase_census_attributes_reference_program(self):
+        """The per-phase attribution must land the REFERENCE program's
+        steps on real phases (the breakdown the megakernels cut)."""
+        from benchmarks.tpu_aot_census import phase_census
+        from hypervisor_tpu.observability import tracing
+        from hypervisor_tpu.ops.pipeline import governance_wave
+
+        st = HypervisorState(SMALL)
+        b = 3
+        slots = jnp.arange(b, dtype=jnp.int32)
+        ctx = tracing.TraceContext(
+            trace=jnp.uint32(1), span=jnp.uint32(2),
+            wave_seq=jnp.int32(0), sampled=jnp.asarray(True),
+        )
+
+        def fused(agents, sessions, vouches, metrics, trace):
+            return governance_wave(
+                agents, sessions, vouches, slots, slots, slots,
+                jnp.full((b,), 0.8, jnp.float32), jnp.ones((b,), bool),
+                jnp.zeros((b,), bool), slots,
+                jnp.zeros((1, b, 16), jnp.uint32), 0.0,
+                use_pallas=False, metrics=metrics, trace=trace,
+                trace_ctx=ctx, wave_kernels=False,
+            )
+
+        compiled = jax.jit(fused).lower(
+            st.agents, st.sessions, st.vouches, st.metrics.table,
+            st.tracer.table,
+        ).compile()
+        phases = phase_census(compiled)
+        assert phases["admission"] >= 3, phases
+        assert phases["fsm_saga"] >= 2, phases
+        assert sum(phases.values()) > 10

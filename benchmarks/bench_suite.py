@@ -1080,6 +1080,50 @@ def dispatch_census_row(timeout_s: float = 900.0) -> dict | None:
     }
 
 
+def static_analysis_row(timeout_s: float = 300.0) -> dict | None:
+    """Run hvlint (both tiers, `--json`) in a SUBPROCESS and distill
+    the trajectory row (`BENCH_r<NN>.json` "static_analysis").
+
+    Subprocess for the census-gate reason: Tier B traces the dispatched
+    programs and must run on the hermetic CPU platform no matter how
+    this bench process configured jax. Exit 1 (findings) still yields a
+    row — regression.py hard-gates `findings == 0`, so a violation
+    shipping in a bench round fails the trajectory, not the bench.
+    """
+    import os
+
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "hypervisor_tpu.analysis",
+                "--tier", "all", "--json",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=Path(__file__).resolve().parent.parent,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode not in (0, 1):
+        return None
+    try:
+        report = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        return None
+    return {
+        "rules": len(report.get("rules", [])),
+        "findings": report["counts"]["findings"],
+        "suppressions": report["counts"]["suppressions_on_file"],
+        "files_analyzed": report.get("files_analyzed"),
+        "tiers": report.get("tiers"),
+        "programs_traced": len(report.get("tier_b_programs") or []),
+        "tier_a_ms": report.get("tier_a_ms"),
+        "tier_b_ms": report.get("tier_b_ms"),
+    }
+
+
 def _git_commit() -> str | None:
     """Current commit hash, stamped into bench reports so a trajectory
     row names the code it measured; None outside a git checkout."""
@@ -1285,6 +1329,22 @@ def main() -> None:
                     flush=True,
                 )
 
+    static_rec = None
+    if args.metrics_out:
+        static_rec = static_analysis_row()
+        if not args.json_only:
+            if static_rec is None:
+                print("static analysis FAILED to run (row omitted)",
+                      flush=True)
+            else:
+                print(
+                    f"static analysis: {static_rec['rules']} rules, "
+                    f"{static_rec['findings']} finding(s), "
+                    f"{static_rec['suppressions']} suppressed, "
+                    f"{static_rec['programs_traced']} programs traced",
+                    flush=True,
+                )
+
     if args.metrics_out:
         from benchmarks import regression
 
@@ -1344,6 +1404,10 @@ def main() -> None:
             # recompiles; regression.py gates the SLO, the goodput
             # floor, and the zero-recompile contract.
             "soak": soak_rec,
+            # Static-analysis row (round 13, ISSUE 12): hvlint rule /
+            # finding / suppression counts — regression.py presence-
+            # gates it from round 13 and hard-gates findings == 0.
+            "static_analysis": static_rec,
         }
         out_path.write_text(json.dumps(report, indent=2) + "\n")
         if not args.json_only:

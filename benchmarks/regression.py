@@ -89,6 +89,13 @@ CENSUS_ROW_SINCE = 10
 #: serving coverage even if every other number is fine.
 SOAK_ROW_SINCE = 11
 
+#: The static-analysis row (hvlint, ISSUE 12) joined the standard
+#: payload in round 13; earlier rounds are exempt. A suite round from
+#: 13 on that drops the row regresses the contract-analysis coverage
+#: even if every number is fine — and a row with findings > 0 means
+#: an unsuppressed contract violation shipped.
+STATIC_ROW_SINCE = 13
+
 #: Minimum goodput ratio (served / offered) a soak row may report
 #: (`HV_BENCH_SOAK_GOODPUT` overrides): the front door must actually
 #: serve an open workload, not shed its way to a fast p99.
@@ -174,6 +181,7 @@ def parse_round_file(path: Path) -> Optional[dict]:
         census = doc.get("dispatch_census")
         donation = doc.get("donation")
         soak = doc.get("soak")
+        static = doc.get("static_analysis")
         row.update(
             format="suite",
             backend=doc.get("backend", "cpu"),
@@ -266,6 +274,21 @@ def parse_round_file(path: Path) -> Optional[dict]:
                     "invariant_violations": soak.get("invariant_violations"),
                 }
                 if isinstance(soak, dict)
+                else None
+            ),
+            # Static-analysis row (round 13, ISSUE 12): hvlint's rule /
+            # finding / suppression counts ride the trajectory so
+            # dropping the gate is itself a regression (presence-gated
+            # below, findings hard-gated to zero).
+            static_analysis=(
+                {
+                    "rules": static.get("rules"),
+                    "findings": static.get("findings"),
+                    "suppressions": static.get("suppressions"),
+                    "files_analyzed": static.get("files_analyzed"),
+                    "programs_traced": static.get("programs_traced"),
+                }
+                if isinstance(static, dict)
                 else None
             ),
         )
@@ -568,6 +591,33 @@ def compare(
             checked.append(entry)
             if value != 0:
                 regressions.append(entry)
+    # Static-analysis gates (round 13): presence from STATIC_ROW_SINCE,
+    # then zero unsuppressed findings — hvlint findings shipping in a
+    # bench round mean a contract violation crossed CI.
+    static = current.get("static_analysis")
+    if (
+        current.get("format") == "suite"
+        and current["round"] >= STATIC_ROW_SINCE
+        and not static
+    ):
+        entry = {
+            "bench": "missing:static_analysis",
+            "current_per_op_us": 0.0,
+            "baseline_per_op_us": 0.0,
+            "ratio": 0.0,
+        }
+        checked.append(entry)
+        regressions.append(entry)
+    if static and static.get("findings") is not None:
+        entry = {
+            "bench": "static_analysis_findings",
+            "current_per_op_us": float(static["findings"]),
+            "baseline_per_op_us": 0.0,
+            "ratio": float(static["findings"]),
+        }
+        checked.append(entry)
+        if static["findings"] != 0:
+            regressions.append(entry)
     if scenarios and scenarios.get("hardening_overhead_pct") is not None:
         env_cap = os.environ.get("HV_BENCH_HARDENING_OVERHEAD")
         cap = (

@@ -1,0 +1,69 @@
+"""hvlint — static contract analyzer for the hypervisor's host planes.
+
+Five PRs' worth of runtime contracts (WAL journaling around every
+state-mutating dispatch, donation-with-poison-guard, per-call `HV_*`
+env arming, the one-program fused-wave contract, the staging/policy
+lock discipline) were enforced only by tests that happen to exercise
+the violating path. hvlint proves them over the whole tree on every
+commit:
+
+  * **Tier A** (`rules_ast`) — pure-AST rules, no jax, no imports of
+    the analyzed modules: WAL coverage (HVA001), env-arming (HVA002),
+    lock discipline (HVA003), append-only registries vs
+    `baseline.json` (HVA004), Pallas/numpy twin parity (HVA005).
+  * **Tier B** (`jaxpr_lint`) — traces the dispatched programs under
+    `JAX_PLATFORMS=cpu` and lints the jaxprs: no host callbacks except
+    `hv_wave_twin_call` (HVB001), no use-after-donate (HVB002), the
+    fused facade wave stays ONE program (HVB003).
+
+CLI: `python -m hypervisor_tpu.analysis` / `scripts/hvlint.sh` /
+the `hvlint` console script. Exceptions live in `suppressions.json`,
+each with a mandatory justification; the registries' append-only
+baseline in `baseline.json`. Catalog + runbooks:
+docs/OPERATIONS.md "Static analysis".
+"""
+
+from hypervisor_tpu.analysis.findings import (
+    Finding,
+    Suppression,
+    apply_suppressions,
+    load_suppressions,
+    unsuppressed,
+)
+from hypervisor_tpu.analysis.rules_ast import (
+    TIER_A_RULES,
+    current_registries,
+    derive_journal_ops,
+    derive_replay_ops,
+    run_tier_a,
+)
+from hypervisor_tpu.analysis.walker import ModuleAst, Project
+
+__all__ = [
+    "Finding",
+    "ModuleAst",
+    "Project",
+    "Suppression",
+    "TIER_A_RULES",
+    "apply_suppressions",
+    "current_registries",
+    "derive_journal_ops",
+    "derive_replay_ops",
+    "derived_wal_ops",
+    "load_suppressions",
+    "run_tier_a",
+    "unsuppressed",
+]
+
+
+def derived_wal_ops() -> set[str]:
+    """The journal-op set hvlint derives from state.py's AST — the
+    static half of the WAL/REPLAY correspondence pin
+    (tests/unit/test_resilience.py asserts it equals the runtime
+    REPLAY registry, so neither can drift from the checker)."""
+    from pathlib import Path
+
+    project = Project.load(Path(__file__).resolve().parent.parent)
+    state_mod = project.module("state.py")
+    assert state_mod is not None
+    return set(derive_journal_ops(state_mod))

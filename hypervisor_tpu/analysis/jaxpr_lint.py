@@ -1,0 +1,321 @@
+"""hvlint Tier B: lowering-aware lints over traced jaxprs.
+
+Where Tier A reads source, Tier B reads what jax will actually
+compile: it traces the module-level entry points `state.py` dispatches
+and lints the jaxprs. Runtime telemetry (compile census, donation
+poison guard) catches these violations only when the violating path
+executes; the trace-time lint proves them absent per commit.
+
+Rule catalog:
+
+  HVB001 host-callback     no callback/infeed/outfeed primitive in any
+                           dispatched program, except the whitelisted
+                           `hv_wave_twin_call` boundary (the PR 11
+                           runtime-reentry-safe twin call).
+  HVB002 use-after-donate  a caller that passes buffers into a donating
+                           pjit must not reference those buffers after
+                           the donating eqn (the static form of the
+                           HV_DONATE_DEBUG poison guard).
+  HVB003 one-program       the fused facade wave lowers as ONE program:
+                           no nested pjit eqn named after a standalone
+                           dispatch entry point (`check_actions`,
+                           `check_invariants`, `update_gauges`, ...)
+                           may escape the fusion.
+
+Run under `JAX_PLATFORMS=cpu` (the verify gate does, in a bounded
+subprocess — the same wedge-proof pattern as the dispatch census).
+jax imports are deferred into the functions so importing this module
+costs nothing for Tier A runs.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, Optional
+
+from hypervisor_tpu.analysis.findings import Finding
+
+#: The one sanctioned host boundary inside dispatched programs: the
+#: megakernel CPU-twin call (`kernels/wave_pallas.py`), which lowers
+#: through `mlir.emit_python_callback` WITHOUT re-entering the device
+#: runtime (the pure_callback deadlock class PR 11 neutralized).
+CALLBACK_WHITELIST = frozenset({"hv_wave_twin_call"})
+
+_CALLBACK_MARKERS = ("callback", "infeed", "outfeed")
+
+
+def _sub_jaxprs(params: dict):
+    for v in params.values():
+        if hasattr(v, "jaxpr"):          # ClosedJaxpr
+            yield v.jaxpr
+        elif hasattr(v, "eqns"):         # raw Jaxpr
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                if hasattr(x, "jaxpr"):
+                    yield x.jaxpr
+                elif hasattr(x, "eqns"):
+                    yield x
+
+
+def _walk_eqns(jaxpr):
+    """Yield (eqn, owning_jaxpr) over a jaxpr and all sub-jaxprs."""
+    stack = [jaxpr]
+    seen: set[int] = set()
+    while stack:
+        jx = stack.pop()
+        if id(jx) in seen:
+            continue
+        seen.add(id(jx))
+        for eqn in jx.eqns:
+            yield eqn, jx
+            stack.extend(_sub_jaxprs(eqn.params))
+
+
+def lint_callbacks(
+    closed_jaxpr,
+    *,
+    where: str,
+    file: str = "hypervisor_tpu/state.py",
+    line: int = 1,
+    whitelist: frozenset[str] = CALLBACK_WHITELIST,
+) -> list[Finding]:
+    """HVB001 over one traced program."""
+    findings = []
+    for eqn, _ in _walk_eqns(closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name in whitelist:
+            continue
+        if any(marker in name for marker in _CALLBACK_MARKERS):
+            findings.append(Finding(
+                rule="HVB001", file=file, line=line, tier="B",
+                anchor=f"{where}:{name}",
+                message=(
+                    f"`{name}` primitive inside the `{where}` lowering — "
+                    "a host round-trip in a dispatched program serializes "
+                    "the wave on the transfer (and pure_callback re-enters "
+                    "the busy runtime: the PR 11 deadlock class)"
+                ),
+                hint=(
+                    "move the host work outside the program, or route it "
+                    "through the hv_wave_twin_call boundary"
+                ),
+            ))
+    return findings
+
+
+def lint_use_after_donate(
+    closed_jaxpr,
+    *,
+    where: str,
+    file: str = "hypervisor_tpu/state.py",
+    line: int = 1,
+) -> list[Finding]:
+    """HVB002: donated invars of any pjit eqn must be dead afterwards.
+
+    Walks every (sub)jaxpr in eqn order; when a pjit eqn donates, the
+    corresponding invars become poisoned for the rest of that jaxpr —
+    any LATER eqn consuming one is a finding (the "referencing a
+    donated buffer post-dispatch" class). This is the static twin of
+    the `HV_DONATE_DEBUG=1` runtime poison guard.
+
+    Deliberately NOT flagged: a donated var the donating program passes
+    through as an identity output. jax prunes those from the call and
+    wires input straight to output (the donation is dropped with a
+    "donation ignored" warning, which the compile watch already
+    captures), so no aliased overwrite can occur; and plain handle
+    retention by host code outside the traced region is the runtime
+    guard's jurisdiction — source can't see it.
+    """
+    findings = []
+
+    def scan(jaxpr):
+        poisoned: dict = {}
+        for eqn in jaxpr.eqns:
+            for v in eqn.invars:
+                src = poisoned.get(id(v))
+                if src is not None:
+                    findings.append(_donate_finding(
+                        where, file, line, src, f"eqn `{eqn.primitive.name}`"
+                    ))
+            donated = eqn.params.get("donated_invars")
+            if eqn.primitive.name == "pjit" and donated is not None:
+                pname = eqn.params.get("name", "pjit")
+                for v, is_donated in zip(eqn.invars, donated):
+                    # Poison proper Vars only (Literals carry .val and
+                    # are unique per use — nothing to alias).
+                    if is_donated and not hasattr(v, "val"):
+                        poisoned[id(v)] = pname
+            for sub in _sub_jaxprs(eqn.params):
+                scan(sub)
+
+    scan(closed_jaxpr.jaxpr)
+    return findings
+
+
+def _donate_finding(where, file, line, pname, used_in) -> Finding:
+    return Finding(
+        rule="HVB002", file=file, line=line, tier="B",
+        anchor=f"{where}:{pname}",
+        message=(
+            f"buffer donated to `{pname}` is referenced afterwards by "
+            f"{used_in} — after donation the buffer is dead memory the "
+            "program may already have overwritten in place"
+        ),
+        hint=(
+            "snapshot with np.array(..., copy=True) BEFORE the donating "
+            "dispatch, or drop the donation (the re-staging contract in "
+            "state.py's _WAVE_DONATED block comment)"
+        ),
+    )
+
+
+def lint_one_program(
+    closed_jaxpr,
+    *,
+    where: str,
+    forbidden: Iterable[str],
+    file: str = "hypervisor_tpu/ops/pipeline.py",
+    line: int = 1,
+) -> list[Finding]:
+    """HVB003: no standalone-entry-point pjit escapes the fused wave."""
+    findings = []
+    forbidden = set(forbidden)
+    for eqn, _ in _walk_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "pjit":
+            continue
+        name = eqn.params.get("name")
+        if name in forbidden:
+            findings.append(Finding(
+                rule="HVB003", file=file, line=line, tier="B",
+                anchor=f"{where}:{name}",
+                message=(
+                    f"standalone entry point `{name}` appears as a nested "
+                    f"pjit inside the `{where}` lowering — the fused wave "
+                    "is no longer ONE program (a closure escaped the "
+                    "fusion; the census would count the extra dispatch "
+                    "only at runtime)"
+                ),
+                hint=(
+                    "call the op's traced function directly inside the "
+                    "fusion instead of its module-level jit wrapper"
+                ),
+            ))
+    return findings
+
+
+# ── the HEAD harness: trace the real entry points and lint them ──────
+
+
+def _trace_targets():
+    """Trace the dispatched programs at tiny shapes.
+
+    Returns (targets, forbidden_names):
+      targets: list of (name, closed_jaxpr, lints) where lints is a
+      subset of {"callbacks", "donation", "one_program"}.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from hypervisor_tpu import state as state_mod
+    from hypervisor_tpu.analysis.rules_ast import derive_jit_entry_points
+    from hypervisor_tpu.analysis.walker import Project
+    from hypervisor_tpu.observability import metrics as mp
+    from hypervisor_tpu.observability import tracing
+    from hypervisor_tpu.ops.pipeline import governance_wave
+    from hypervisor_tpu.tables.logs import DeltaLog, TraceLog
+    from hypervisor_tpu.tables.state import (
+        AgentTable,
+        SessionTable,
+        VouchTable,
+    )
+    from hypervisor_tpu.tables.struct import replace as t_replace
+
+    pkg_dir = Path(state_mod.__file__).resolve().parent
+    project = Project.load(pkg_dir)
+    state_ast = project.module("state.py")
+    entry_points = (
+        derive_jit_entry_points(state_ast) if state_ast is not None else {}
+    )
+    # The fused wave may legitimately nest NOTHING from this set: each
+    # name is a standalone dispatch in its own right.
+    forbidden = set(entry_points) - {"governance_wave"}
+
+    b = 4
+    agents = AgentTable.create(16)
+    sessions = SessionTable.create(16)
+    vouches = VouchTable.create(8)
+    sessions = t_replace(sessions, state=sessions.state.at[:b].set(1))
+    ctx = tracing.TraceContext(
+        trace=jnp.uint32(1), span=jnp.uint32(2),
+        wave_seq=jnp.int32(0), sampled=jnp.asarray(True),
+    )
+    wave_args = (
+        agents, sessions, vouches,
+        jnp.arange(b, dtype=jnp.int32), jnp.arange(b, dtype=jnp.int32),
+        jnp.arange(b, dtype=jnp.int32), jnp.full((b,), 0.8, jnp.float32),
+        jnp.ones((b,), bool), jnp.zeros((b,), bool),
+        jnp.arange(b, dtype=jnp.int32),
+        jnp.zeros((2, b, 16), jnp.uint32), 0.0,
+    )
+
+    def trace_wave(sanitize: bool, wave_kernels: bool):
+        return jax.make_jaxpr(lambda *a: governance_wave(
+            *a, use_pallas=False, metrics=mp.REGISTRY.create_table(),
+            trace=TraceLog.create(64), trace_ctx=ctx,
+            sanitize=sanitize, wave_kernels=wave_kernels,
+        ))(*wave_args)
+
+    targets = [
+        (
+            "governance_wave",
+            trace_wave(False, False),
+            {"callbacks", "one_program", "donation"},
+        ),
+        (
+            "governance_wave_sanitized",
+            trace_wave(True, False),
+            {"callbacks", "one_program", "donation"},
+        ),
+        (
+            "governance_wave_megakernel",
+            trace_wave(True, True),
+            {"callbacks", "one_program", "donation"},
+        ),
+    ]
+
+    # The donated facade dispatch, traced THROUGH the jit wrapper the
+    # way state.py calls it — the pjit eqn carries donated_invars, so
+    # HVB002 checks the caller-side contract.
+    donated_fn = state_mod._WAVE_DONATED._fn
+    targets.append((
+        "governance_wave_donated_call",
+        jax.make_jaxpr(lambda *a: donated_fn(
+            *a, use_pallas=False, metrics=mp.REGISTRY.create_table(),
+            trace=TraceLog.create(64), trace_ctx=ctx,
+            delta_log=DeltaLog.create(64), cache_salt=0.0,
+        ))(*wave_args),
+        {"callbacks", "donation"},
+    ))
+
+    return targets, forbidden
+
+
+def run_tier_b(package_dir: Optional[Path] = None) -> list[Finding]:
+    """Trace the HEAD entry points and lint every program.
+
+    Returns findings; trace coverage is reported via
+    `tier_b_coverage()` on the CLI payload so a silently-shrinking
+    harness is visible.
+    """
+    targets, forbidden = _trace_targets()
+    findings: list[Finding] = []
+    for name, cj, lints in targets:
+        if "callbacks" in lints:
+            findings += lint_callbacks(cj, where=name)
+        if "donation" in lints:
+            findings += lint_use_after_donate(cj, where=name)
+        if "one_program" in lints:
+            findings += lint_one_program(cj, where=name, forbidden=forbidden)
+    run_tier_b.last_programs = [name for name, _, _ in targets]  # type: ignore[attr-defined]
+    return findings

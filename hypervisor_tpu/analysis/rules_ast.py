@@ -1,0 +1,613 @@
+"""hvlint Tier A: AST contract rules over the hypervisor package.
+
+Rule catalog (ids are stable; docs/OPERATIONS.md "Static analysis"):
+
+  HVA001 wal-coverage      every HypervisorState method that rebinds a
+                           device table must run under a `_journal`
+                           bracket (directly or via a journaled
+                           caller), every journaled op must have a
+                           `resilience.recovery.REPLAY` handler, and
+                           every REPLAY handler a live journal site.
+  HVA002 env-arming        `HV_*` environment variables are read
+                           per-call inside function bodies, never at
+                           import time (module level, class bodies /
+                           dataclass field defaults, argument
+                           defaults, decorators).
+  HVA003 lock-discipline   mutations of the join-staging structures
+                           (`_members`, `_slot_of_member`,
+                           `_free_agent_slots`, ...) happen under
+                           `_enqueue_lock`; swaps of `degraded_policy`
+                           happen under `_policy_lock`.
+  HVA004 append-only       EventType codes, metric series registration
+                           order, and WAL record tags only grow,
+                           checked against `analysis/baseline.json`.
+  HVA005 twin-parity       every public `*_pallas` kernel in
+                           `kernels/` has a `*_np` twin in the same
+                           module, and some test references both by
+                           name.
+
+Everything here is pure `ast` over source text — the analyzed modules
+are never imported (Tier A needs no jax and no device).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+from typing import Optional
+
+from hypervisor_tpu.analysis.findings import Finding
+from hypervisor_tpu.analysis.walker import (
+    LockScopeWalker,
+    ModuleAst,
+    Project,
+    class_def,
+    const_str,
+    methods_of,
+    parent_map,
+    runs_at_import_time,
+    self_calls,
+)
+
+# ── contract vocabulary ──────────────────────────────────────────────
+
+#: Device-table attributes on HypervisorState whose rebinds are
+#: state-mutating dispatches (the WAL contract's object set).
+TABLE_ATTRS = frozenset({
+    "agents", "sessions", "vouches", "sagas", "elevations",
+    "delta_log", "event_log",
+})
+
+#: Join-staging host structures guarded by `_enqueue_lock` (the
+#: staging lock; see HypervisorState.__init__). Reads are not checked
+#: — the contract is writer-side (every mutation serialized).
+STAGING_ATTRS = frozenset({
+    "_members", "_slot_of_member", "_staged_members", "_pending_rows",
+    "_free_agent_slots", "_next_agent_slot",
+})
+
+#: Attributes swapped only under `_policy_lock` (the PR 6 damper /
+#: supervisor check-and-swap contract).
+POLICY_ATTRS = frozenset({"degraded_policy"})
+
+STAGING_LOCK = "_enqueue_lock"
+POLICY_LOCK = "_policy_lock"
+
+#: Container methods that mutate their receiver.
+_MUTATORS = frozenset({
+    "append", "extend", "pop", "popitem", "add", "discard", "remove",
+    "clear", "update", "setdefault", "insert",
+})
+
+#: Methods exempt from HVA001/HVA003: constructors run on an object no
+#: other thread can see yet.
+_CONSTRUCTORS = frozenset({"__init__", "__post_init__"})
+
+
+# ── derivations (shared with tests and the resilience registry pin) ──
+
+
+def derive_journal_ops(state_mod: ModuleAst) -> dict[str, int]:
+    """op name -> first lineno for every `*._journal("op", ...)` site."""
+    ops: dict[str, int] = {}
+    for node in ast.walk(state_mod.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "_journal" and node.args:
+            name = const_str(node.args[0])
+            if name is not None:
+                ops.setdefault(name, node.lineno)
+    return ops
+
+
+def derive_replay_ops(recovery_mod: ModuleAst) -> dict[str, int]:
+    """op name -> lineno for every key of the REPLAY handler table."""
+    ops: dict[str, int] = {}
+    for node in ast.walk(recovery_mod.tree):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        if not (isinstance(target, ast.Name) and target.id == "REPLAY"):
+            continue
+        value = node.value
+        if isinstance(value, ast.Dict):
+            for key in value.keys:
+                name = const_str(key) if key is not None else None
+                if name is not None:
+                    ops.setdefault(name, key.lineno)
+    return ops
+
+
+def derive_event_types(event_bus_mod: ModuleAst) -> list[tuple[str, str]]:
+    """Ordered (NAME, value) pairs of the EventType enum — order IS the
+    device-log wire format (codes are enumeration order)."""
+    cls = class_def(event_bus_mod.tree, "EventType")
+    out: list[tuple[str, str]] = []
+    if cls is None:
+        return out
+    for node in cls.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            value = const_str(node.value)
+            if value is not None:
+                out.append((node.targets[0].id, value))
+    return out
+
+
+def derive_metric_series(metrics_mod: ModuleAst) -> list[tuple[str, str]]:
+    """Ordered (kind, series-name) per REGISTRY.{counter,gauge,
+    histogram} call site, in source order — registration order is the
+    device-table row layout, so reordering IS renumbering."""
+    calls: list[tuple[int, str, str]] = []
+    for node in ast.walk(metrics_mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("counter", "gauge", "histogram")):
+            continue
+        recv = node.func.value
+        if not (isinstance(recv, ast.Name) and recv.id == "REGISTRY"):
+            continue
+        name = const_str(node.args[0]) if node.args else None
+        if name is not None:
+            calls.append((node.lineno, node.func.attr, name))
+    calls.sort()
+    return [(kind, name) for _, kind, name in calls]
+
+
+def derive_jit_entry_points(state_mod: ModuleAst) -> dict[str, int]:
+    """Wrapped-function name -> lineno for every module-level
+    `health_plane.instrument("label", jax.jit(<mod>.<fn>, ...))` entry
+    point in state.py. Tier B's one-program rule forbids these names
+    from appearing as nested pjit eqns inside the fused wave."""
+    out: dict[str, int] = {}
+    for node in ast.walk(state_mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "instrument"):
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Attribute) \
+                    and arg.func.attr == "jit" and arg.args:
+                inner = arg.args[0]
+                name = inner.attr if isinstance(inner, ast.Attribute) else (
+                    inner.id if isinstance(inner, ast.Name) else None
+                )
+                if name is not None:
+                    out.setdefault(name, node.lineno)
+    return out
+
+
+def derive_pallas_kernels(
+    project: Project,
+) -> list[tuple[ModuleAst, str, int]]:
+    """(module, name, lineno) for public top-level `*_pallas` defs."""
+    out = []
+    for mod in project.modules_under("kernels"):
+        for node in mod.tree.body:
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name.endswith("_pallas") \
+                    and not node.name.startswith("_"):
+                out.append((mod, node.name, node.lineno))
+    return out
+
+
+# ── HVA001: WAL coverage ─────────────────────────────────────────────
+
+
+def rule_wal_coverage(project: Project) -> list[Finding]:
+    state_mod = project.module("state.py")
+    if state_mod is None:
+        return []
+    findings: list[Finding] = []
+    journal_ops = derive_journal_ops(state_mod)
+
+    cls = class_def(state_mod.tree, "HypervisorState")
+    if cls is not None:
+        methods = {m.name: m for m in methods_of(cls)}
+        journaled = {
+            name for name, m in methods.items()
+            if any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "_journal"
+                for n in ast.walk(m)
+            )
+        }
+        mutating: dict[str, tuple[int, set[str]]] = {}
+        for name, m in methods.items():
+            tables: set[str] = set()
+            first_line: Optional[int] = None
+            for n in ast.walk(m):
+                targets = []
+                if isinstance(n, ast.Assign):
+                    targets = n.targets
+                elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [n.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self" \
+                            and t.attr in TABLE_ATTRS:
+                        tables.add(t.attr)
+                        if first_line is None or n.lineno < first_line:
+                            first_line = n.lineno
+            if tables and name not in _CONSTRUCTORS:
+                mutating[name] = (first_line or m.lineno, tables)
+
+        callers: dict[str, set[str]] = {name: set() for name in methods}
+        for name, m in methods.items():
+            for callee in self_calls(m):
+                if callee in callers:
+                    callers[callee].add(name)
+
+        # Fixpoint: covered = journals itself, or every intra-class
+        # caller is covered (helpers running inside the outer bracket).
+        covered = set(journaled)
+        changed = True
+        while changed:
+            changed = False
+            for name in methods:
+                if name in covered:
+                    continue
+                cs = callers[name]
+                if cs and cs <= covered:
+                    covered.add(name)
+                    changed = True
+
+        for name, (line, tables) in sorted(mutating.items()):
+            if name not in covered:
+                findings.append(Finding(
+                    rule="HVA001", file=state_mod.rel, line=line,
+                    anchor=f"HypervisorState.{name}",
+                    message=(
+                        f"method rebinds device table(s) "
+                        f"{sorted(tables)} with no `_journal` bracket on "
+                        "any path (crash between dispatch and the next "
+                        "checkpoint loses the transition)"
+                    ),
+                    hint=(
+                        "wrap the mutation in `with self._journal(\"<op>\","
+                        " ...)` and add the op's replay handler to "
+                        "resilience.recovery.REPLAY"
+                    ),
+                ))
+
+    recovery_mod = project.module("resilience/recovery.py")
+    if recovery_mod is not None:
+        replay_ops = derive_replay_ops(recovery_mod)
+        for op, line in sorted(journal_ops.items()):
+            if op not in replay_ops:
+                findings.append(Finding(
+                    rule="HVA001", file=state_mod.rel, line=line,
+                    anchor=f"journal:{op}",
+                    message=(
+                        f'journaled op "{op}" has no handler in '
+                        "resilience.recovery.REPLAY — a WAL carrying it "
+                        "cannot be replayed"
+                    ),
+                    hint="add a REPLAY row (or remove the dead bracket)",
+                ))
+        for op, line in sorted(replay_ops.items()):
+            if op not in journal_ops:
+                findings.append(Finding(
+                    rule="HVA001", file=recovery_mod.rel, line=line,
+                    anchor=f"replay:{op}",
+                    message=(
+                        f'REPLAY handler "{op}" matches no journal site in '
+                        "state.py — the registry drifted from the checker"
+                    ),
+                    hint=(
+                        "dead handlers hide renames: either re-journal the "
+                        "op or delete the row (append-only WAL tags: keep "
+                        "the baseline entry, see HVA004)"
+                    ),
+                ))
+    return findings
+
+
+# ── HVA002: env-arming discipline ────────────────────────────────────
+
+
+def _env_reads(node: ast.AST) -> list[tuple[ast.AST, int, str]]:
+    out = []
+    for n in ast.walk(node):
+        name = None
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in ("get", "getenv") and n.args:
+            v = const_str(n.args[0])
+            if v is not None and v.startswith("HV_"):
+                name = v
+        elif isinstance(n, ast.Subscript) \
+                and isinstance(n.value, ast.Attribute) \
+                and n.value.attr == "environ":
+            v = const_str(n.slice)
+            if v is not None and v.startswith("HV_"):
+                name = v
+        if name is not None:
+            out.append((n, n.lineno, name))
+    return out
+
+
+def rule_env_arming(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in project.modules.values():
+        parents = parent_map(mod.tree)
+        seen: set[int] = set()
+        for node, line, name in _env_reads(mod.tree):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            if runs_at_import_time(node, parents):
+                findings.append(Finding(
+                    rule="HVA002", file=mod.rel, line=line,
+                    anchor=f"env:{name}",
+                    message=(
+                        f"`{name}` is read at import time — the value "
+                        "freezes at first import and per-call arming "
+                        "(the HV_SHA256_PALLAS / HV_SUP_* contract) "
+                        "silently stops working"
+                    ),
+                    hint=(
+                        "move the read inside the function that uses it "
+                        "(or a default_factory); module/class bodies and "
+                        "argument defaults all execute at import"
+                    ),
+                ))
+    return findings
+
+
+# ── HVA003: lock discipline ──────────────────────────────────────────
+
+
+def _guarded_mutation(stmt: ast.stmt) -> list[tuple[int, str, str]]:
+    """(line, attr, lock) mutations of guarded attrs in ONE statement
+    (not recursing into compound bodies — the scope walker does that)."""
+    hits: list[tuple[int, str, str]] = []
+
+    def check_attr(t: ast.AST) -> Optional[str]:
+        if isinstance(t, ast.Attribute) and t.attr in (
+            STAGING_ATTRS | POLICY_ATTRS
+        ):
+            return t.attr
+        return None
+
+    def lock_for(attr: str) -> str:
+        return POLICY_LOCK if attr in POLICY_ATTRS else STAGING_LOCK
+
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = list(stmt.targets)
+    for t in targets:
+        if isinstance(t, ast.Tuple):
+            targets.extend(t.elts)
+    for t in targets:
+        attr = check_attr(t)
+        if attr is None and isinstance(t, ast.Subscript):
+            attr = check_attr(t.value)
+        if attr is not None:
+            hits.append((stmt.lineno, attr, lock_for(attr)))
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        f = stmt.value.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            attr = check_attr(f.value)
+            if attr is not None and attr not in POLICY_ATTRS:
+                hits.append((stmt.lineno, attr, lock_for(attr)))
+    return hits
+
+
+def rule_lock_discipline(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    walker = LockScopeWalker((STAGING_LOCK, POLICY_LOCK))
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in _CONSTRUCTORS:
+                continue
+            qual = node.name
+            for stmt, held in walker.walk(node):
+                for line, attr, lock in _guarded_mutation(stmt):
+                    if lock not in held:
+                        plane = (
+                            "policy swap" if attr in POLICY_ATTRS
+                            else "join-staging structure"
+                        )
+                        findings.append(Finding(
+                            rule="HVA003", file=mod.rel, line=line,
+                            anchor=f"{qual}.{attr}",
+                            message=(
+                                f"`{attr}` ({plane}) mutated outside "
+                                f"`{lock}` — racing a concurrent holder "
+                                "corrupts the staging/policy plane (the "
+                                "PR 6 damper/supervisor clobber class)"
+                            ),
+                            hint=f"wrap the mutation in `with <state>.{lock}:`",
+                        ))
+    # One finding per (anchor, file): the same method touching the same
+    # attr on several lines is one violation to fix, not five.
+    seen: set[tuple[str, str]] = set()
+    unique: list[Finding] = []
+    for f in findings:
+        key = (f.file, f.anchor)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique
+
+
+# ── HVA004: append-only registries ───────────────────────────────────
+
+
+def current_registries(project: Project) -> dict:
+    """The three append-only registries, AST-derived (no imports)."""
+    reg: dict = {"event_types": [], "metric_series": [], "wal_ops": []}
+    ev = project.module("observability/event_bus.py")
+    if ev is not None:
+        reg["event_types"] = [list(p) for p in derive_event_types(ev)]
+    mx = project.module("observability/metrics.py")
+    if mx is not None:
+        reg["metric_series"] = [list(p) for p in derive_metric_series(mx)]
+    st = project.module("state.py")
+    if st is not None:
+        reg["wal_ops"] = sorted(derive_journal_ops(st))
+    return reg
+
+
+def rule_append_only(
+    project: Project, baseline_path: Optional[Path]
+) -> list[Finding]:
+    if baseline_path is None or not baseline_path.exists():
+        return [Finding(
+            rule="HVA004", file="analysis/baseline.json", line=1,
+            anchor="baseline", tier="A",
+            message="append-only baseline missing — registries unpinned",
+            hint="run `python -m hypervisor_tpu.analysis --write-baseline`",
+        )]
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, json.JSONDecodeError) as exc:
+        return [Finding(
+            rule="HVA004", file="analysis/baseline.json", line=1,
+            anchor="baseline", message=f"baseline unreadable: {exc}",
+            hint="regenerate with --write-baseline after review",
+        )]
+    cur = current_registries(project)
+    findings: list[Finding] = []
+
+    def prefix_check(key: str, mod: Optional[ModuleAst], what: str) -> None:
+        if mod is None:
+            return
+        b = [tuple(x) for x in base.get(key, [])]
+        c = [tuple(x) for x in cur.get(key, [])]
+        if c[: len(b)] == b:
+            return
+        # Name the FIRST divergence: that's the renumber/removal point.
+        i = next(
+            (i for i, pair in enumerate(b) if i >= len(c) or c[i] != pair),
+            len(b),
+        )
+        missing = b[i]
+        got = c[i] if i < len(c) else None
+        findings.append(Finding(
+            rule="HVA004", file=mod.rel, line=1,
+            anchor=f"{key}:{missing[-1]}",
+            message=(
+                f"{what} is not append-only: baseline position {i} is "
+                f"{missing} but the source now has "
+                f"{got if got is not None else 'nothing'} — renumbering "
+                "breaks replay of committed logs and every dashboard "
+                "keyed on the old index"
+            ),
+            hint=(
+                "append new entries at the end; if the removal is an "
+                "intentional wire-format break, refresh the baseline "
+                "(`--write-baseline`) in the same reviewed change"
+            ),
+        ))
+
+    prefix_check(
+        "event_types", project.module("observability/event_bus.py"),
+        "EventType code order (device-log wire format)",
+    )
+    prefix_check(
+        "metric_series", project.module("observability/metrics.py"),
+        "metric registration order (device-table row layout)",
+    )
+    st = project.module("state.py")
+    if st is not None:
+        removed = set(base.get("wal_ops", [])) - set(cur.get("wal_ops", []))
+        for op in sorted(removed):
+            findings.append(Finding(
+                rule="HVA004", file=st.rel, line=1, anchor=f"wal_ops:{op}",
+                message=(
+                    f'WAL record tag "{op}" disappeared from state.py — '
+                    "committed WALs carrying it can no longer replay"
+                ),
+                hint=(
+                    "keep a REPLAY handler for retired tags (or refresh "
+                    "the baseline in a reviewed wire-format break)"
+                ),
+            ))
+    return findings
+
+
+# ── HVA005: twin parity ──────────────────────────────────────────────
+
+
+def rule_twin_parity(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    kernels = derive_pallas_kernels(project)
+    if not kernels:
+        return findings
+    tests = list(project.test_sources())
+    for mod, name, line in kernels:
+        base = name[: -len("_pallas")]
+        twin = f"{base}_np"
+        module_defs = {
+            n.name for n in mod.tree.body if isinstance(n, ast.FunctionDef)
+        }
+        if twin not in module_defs:
+            findings.append(Finding(
+                rule="HVA005", file=mod.rel, line=line, anchor=name,
+                message=(
+                    f"Mosaic kernel `{name}` has no `{twin}` twin in the "
+                    "same module — without the executable math oracle the "
+                    "kernel is only testable on a healthy TPU tunnel"
+                ),
+                hint=(
+                    "add the numpy twin executing identical math (the "
+                    "MTU/sha256 pattern), or suppress with the named "
+                    "oracle if one exists under a legacy name"
+                ),
+            ))
+            continue
+        if tests and not any(
+            name in src and twin in src for _, src in tests
+        ):
+            findings.append(Finding(
+                rule="HVA005", file=mod.rel, line=line,
+                anchor=f"{name}:test",
+                message=(
+                    f"no test references both `{name}` and `{twin}` by "
+                    "name — twin drift would go unnoticed until a chip "
+                    "run disagrees with CI"
+                ),
+                hint=(
+                    "add a parity/surface test naming the pair (see "
+                    "tests/unit/test_wave_kernels.py twin-surface test)"
+                ),
+            ))
+    return findings
+
+
+# ── tier driver ──────────────────────────────────────────────────────
+
+TIER_A_RULES = ("HVA001", "HVA002", "HVA003", "HVA004", "HVA005")
+
+
+def run_tier_a(
+    package_dir: Path,
+    tests_dir: Optional[Path] = None,
+    baseline_path: Optional[Path] = None,
+) -> list[Finding]:
+    """All Tier A findings over one package tree (unsuppressed, raw —
+    the CLI applies the suppressions file on top)."""
+    project = Project.load(package_dir, tests_dir=tests_dir)
+    findings: list[Finding] = []
+    for rel, err in project.parse_errors:  # pragma: no cover
+        findings.append(Finding(
+            rule="HVA000", file=rel, line=1, anchor="parse",
+            message=f"unparseable module: {err}", hint="fix the syntax",
+        ))
+    findings += rule_wal_coverage(project)
+    findings += rule_env_arming(project)
+    findings += rule_lock_discipline(project)
+    findings += rule_append_only(project, baseline_path)
+    findings += rule_twin_parity(project)
+    return findings

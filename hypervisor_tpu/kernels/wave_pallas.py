@@ -492,26 +492,58 @@ def audit_block_np(
             np.asarray(ring_turn, np.int32), np.asarray(cursor, np.int32),
         )
 
-    ring_body = np.array(ring_body, np.uint32, copy=True)
-    ring_digest = np.array(ring_digest, np.uint32, copy=True)
-    ring_session = np.array(ring_session, np.int32, copy=True)
-    ring_turn = np.array(ring_turn, np.int32, copy=True)
-    cursor = np.int32(cursor)
-    capacity = ring_body.shape[0]
     n_live = np.int32(n_valid) * np.int32(t)
     bodies_flat = np.transpose(bodies, (1, 0, 2)).reshape(k * t, 16)
     digests_flat = np.transpose(chain, (1, 0, 2)).reshape(k * t, 8)
     sess_flat = np.repeat(np.asarray(k_sessions, np.int32), t)
     turn_flat = np.tile(np.arange(t, dtype=np.int32), k)
-    pos = np.arange(k * t, dtype=np.int32)
-    live = pos < n_live
-    idx = (cursor + pos[live]) % capacity
-    ring_body[idx] = bodies_flat[live]
-    ring_digest[idx] = digests_flat[live]
-    ring_session[idx] = sess_flat[live]
-    ring_turn[idx] = turn_flat[live]
+    ring_body, ring_digest, ring_session, ring_turn, new_cursor = (
+        ring_append_np(
+            ring_body, ring_digest, ring_session, ring_turn, cursor,
+            bodies_flat, digests_flat, sess_flat, turn_flat, n_live,
+        )
+    )
     return (
         chain, roots, ring_body, ring_digest, ring_session, ring_turn,
+        new_cursor,
+    )
+
+
+def ring_append_np(
+    ring_body: np.ndarray,     # u32[C, 16]
+    ring_digest: np.ndarray,   # u32[C, 8]
+    ring_session: np.ndarray,  # i32[C]
+    ring_turn: np.ndarray,     # i32[C]
+    cursor,                    # i32[]
+    bodies_flat: np.ndarray,   # u32[R, 16] lane-major
+    digests_flat: np.ndarray,  # u32[R, 8]
+    sess_flat: np.ndarray,     # i32[R]
+    turn_flat: np.ndarray,     # i32[R]
+    n_live,                    # i32[] live prefix length (<= R)
+):
+    """`ring_append_pallas`'s exact math on numpy arrays: the DeltaLog
+    live-prefix ring append (`DeltaLog.append_batch_prefix` semantics)
+    — row i of the first `n_live` scatters at `(cursor + i) % C`, the
+    cursor advances by exactly `n_live`, pad rows never land. The
+    executable math oracle of the audit phase's completion launch
+    (twin-parity contract, hvlint HVA005)."""
+    ring_body = np.array(ring_body, np.uint32, copy=True)
+    ring_digest = np.array(ring_digest, np.uint32, copy=True)
+    ring_session = np.array(ring_session, np.int32, copy=True)
+    ring_turn = np.array(ring_turn, np.int32, copy=True)
+    cursor = np.int32(cursor)
+    n_live = np.int32(n_live)
+    capacity = ring_body.shape[0]
+    rows = np.asarray(bodies_flat).shape[0]
+    pos = np.arange(rows, dtype=np.int32)
+    live = pos < n_live
+    idx = (cursor + pos[live]) % capacity
+    ring_body[idx] = np.asarray(bodies_flat, np.uint32)[live]
+    ring_digest[idx] = np.asarray(digests_flat, np.uint32)[live]
+    ring_session[idx] = np.asarray(sess_flat, np.int32)[live]
+    ring_turn[idx] = np.asarray(turn_flat, np.int32)[live]
+    return (
+        ring_body, ring_digest, ring_session, ring_turn,
         np.int32(cursor + n_live),
     )
 

@@ -29,6 +29,7 @@ uninterrupted run at the same committed prefix.
 from __future__ import annotations
 
 import re
+from contextlib import nullcontext
 from pathlib import Path
 from typing import Callable, Optional
 
@@ -406,6 +407,12 @@ def replay(state: HypervisorState, records) -> int:
     threshold) may refuse transitions that already committed. Returns
     ops replayed.
     """
+    # The degraded-policy swap honours the state's policy lock even
+    # here: recovery usually runs exclusive, but a supervisor restore
+    # re-enters replay on a LIVE process where the damper / escalation
+    # paths may race the swap (hvlint HVA003 — the check-and-swap
+    # contract is lock-guarded everywhere or nowhere).
+    policy_lock = getattr(state, "_policy_lock", None) or nullcontext()
     saved = (
         state.journal,
         state.fault_injector,
@@ -414,7 +421,8 @@ def replay(state: HypervisorState, records) -> int:
     )
     state.journal = None
     state.fault_injector = None
-    state.degraded_policy = None
+    with policy_lock:
+        state.degraded_policy = None
     state.admission_damper = None
     n = 0
     try:
@@ -428,12 +436,11 @@ def replay(state: HypervisorState, records) -> int:
             handler(state, rec.args)
             n += 1
     finally:
-        (
-            state.journal,
-            state.fault_injector,
-            state.degraded_policy,
-            state.admission_damper,
-        ) = saved
+        state.journal = saved[0]
+        state.fault_injector = saved[1]
+        with policy_lock:
+            state.degraded_policy = saved[2]
+        state.admission_damper = saved[3]
     return n
 
 

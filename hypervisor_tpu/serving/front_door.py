@@ -64,23 +64,39 @@ class ServingConfig:
     its deadline.
     """
 
+    # Env-tunable knobs read through default_factory so the variable is
+    # consulted PER INSTANTIATION, not frozen at first import — the
+    # per-call env-arming contract (hvlint HVA002; the
+    # HV_SHA256_PALLAS / HV_SUP_* bug class). A bare
+    # `float(os.environ.get(...))` here executes when the class body
+    # does, i.e. at import time.
     buckets: tuple[int, ...] = dataclasses.field(
         default_factory=_env_buckets
     )
-    join_deadline_s: float = float(
-        os.environ.get("HV_SERVE_JOIN_DEADLINE_S", 0.05)
+    join_deadline_s: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("HV_SERVE_JOIN_DEADLINE_S", 0.05)
+        )
     )
-    action_deadline_s: float = float(
-        os.environ.get("HV_SERVE_ACTION_DEADLINE_S", 0.05)
+    action_deadline_s: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("HV_SERVE_ACTION_DEADLINE_S", 0.05)
+        )
     )
-    lifecycle_deadline_s: float = float(
-        os.environ.get("HV_SERVE_LIFECYCLE_DEADLINE_S", 0.1)
+    lifecycle_deadline_s: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("HV_SERVE_LIFECYCLE_DEADLINE_S", 0.1)
+        )
     )
-    terminate_deadline_s: float = float(
-        os.environ.get("HV_SERVE_TERMINATE_DEADLINE_S", 0.2)
+    terminate_deadline_s: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("HV_SERVE_TERMINATE_DEADLINE_S", 0.2)
+        )
     )
-    saga_deadline_s: float = float(
-        os.environ.get("HV_SERVE_SAGA_DEADLINE_S", 0.1)
+    saga_deadline_s: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("HV_SERVE_SAGA_DEADLINE_S", 0.1)
+        )
     )
     dispatch_margin_s: float = 0.0
     #: Queue depths. The join queue is capped at the largest bucket
@@ -94,7 +110,11 @@ class ServingConfig:
     saga_queue_depth: int = 256
     #: Retry-After hint (seconds) stamped on refusals; API transports
     #: surface it as the HTTP Retry-After header on 429s.
-    retry_after_s: float = float(os.environ.get("HV_SERVE_RETRY_AFTER_S", 1.0))
+    retry_after_s: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("HV_SERVE_RETRY_AFTER_S", 1.0)
+        )
+    )
     #: Audit turns per ephemeral lifecycle (the T axis of the fused
     #: wave's delta bodies; fixed per deployment so the program shape
     #: closes over the bucket set).

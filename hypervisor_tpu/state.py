@@ -428,7 +428,12 @@ class HypervisorState:
         # pushes may claim queue slots in a different order than Python
         # observes.
         self._queue = StagingQueue(capacity=cap.max_agents)
-        self._enqueue_lock = threading.Lock()
+        # RLock, not Lock: lock-holding paths (leave_agent) resolve
+        # membership rows via agent_row, whose slow-path cache fill
+        # takes the lock itself (hvlint HVA003 — every `_members` /
+        # `_slot_of_member` / free-list / cursor mutation serializes
+        # here).
+        self._enqueue_lock = threading.RLock()
         self._pending_rows: dict[int, tuple[int, int, bool]] = {}  # slot -> did, sess, dup
         self._staged_members: set[int] = set()  # in-wave dedup (_mkey keys)
 
@@ -1264,15 +1269,20 @@ class HypervisorState:
                 # width dispatched here is the padded b_wave.
                 lane_width=b_wave,
             )
-        self._members.update(wave_keys[ok].tolist())
+        # Membership bookkeeping under the staging lock: enqueue_join's
+        # duplicate check reads `_members` under `_enqueue_lock`, so a
+        # concurrent wave publishing its admissions outside the lock
+        # races that read (hvlint HVA003 — the same class as the PR 10
+        # free-list fix below).
         # Every wave row is dead after the wave: rejected rows were
         # never admitted, admitted rows belong to sessions this same
         # program terminated — all reclaim (device-table GC), and
         # none are cached in _slot_of_member. Mesh-wave rows recycle
         # through their own deterministic top-region layout instead
         # of the general free list (see _mesh_wave_slots).
-        if mesh is None:
-            with self._enqueue_lock:
+        with self._enqueue_lock:
+            self._members.update(wave_keys[ok].tolist())
+            if mesh is None:
                 self._free_agent_slots.extend(
                     np.asarray(agent_slots).tolist()
                 )
@@ -3631,7 +3641,14 @@ class HypervisorState:
                 if len(hits) == 0:
                     return None
                 i = int(hits[-1])
-                self._slot_of_member[(did, session_slot)] = i
+                # Cache fill under the staging lock: flush_joins and
+                # leave_agent rewrite this dict under `_enqueue_lock`,
+                # and an unlocked insert could resurrect a row a
+                # concurrent flush just recycled (hvlint HVA003). The
+                # lock is reentrant, so leave_agent's locked lookup
+                # path nests safely.
+                with self._enqueue_lock:
+                    self._slot_of_member[(did, session_slot)] = i
         else:
             live = (np.asarray(self.agents.flags) & FLAG_ACTIVE) != 0
             hits = np.nonzero((np.asarray(self.agents.did) == did) & live)[0]

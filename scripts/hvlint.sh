@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# hvlint — the static contract analyzer, both tiers, gate-shaped:
+#   Tier A (pure AST, no device, no jax tracing): WAL coverage,
+#     env-arming, lock discipline, append-only registries, twin parity.
+#   Tier B (lowering-aware): traces the dispatched programs under the
+#     hermetic CPU platform and lints the jaxprs (host callbacks,
+#     use-after-donate, the one-program fused-wave contract) — bounded
+#     by the same subprocess-timeout pattern as the dispatch-census
+#     gate, so a wedged accelerator tunnel can never hang CI (the
+#     platform is pinned to cpu regardless).
+# Exit: 0 clean, 1 findings, 124 tier-B timeout. Extra args pass
+# through (e.g. --json).
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu python -m hypervisor_tpu.analysis --tier a "$@"
+tier_a_rc=$?
+if [ "$tier_a_rc" -ne 0 ]; then
+    echo "hvlint tier A FAILED (rc=$tier_a_rc)" >&2
+    exit "$tier_a_rc"
+fi
+
+timeout -k 10 "${HVLINT_TIERB_TIMEOUT:-300}" \
+    env JAX_PLATFORMS=cpu python -m hypervisor_tpu.analysis --tier b "$@"
+tier_b_rc=$?
+if [ "$tier_b_rc" -eq 124 ]; then
+    echo "hvlint tier B TIMED OUT (${HVLINT_TIERB_TIMEOUT:-300}s)" >&2
+elif [ "$tier_b_rc" -ne 0 ]; then
+    echo "hvlint tier B FAILED (rc=$tier_b_rc)" >&2
+fi
+exit "$tier_b_rc"

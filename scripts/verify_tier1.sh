@@ -53,6 +53,15 @@
 #      recompiles (the closed-bucket contract), and replay the same
 #      trace + seed to identical admission/shed decisions and chain
 #      heads,
+#   6f. the hvlint static-analysis gate — both analyzer tiers
+#      (scripts/hvlint.sh): Tier A pure-AST contract rules (WAL
+#      coverage + REPLAY correspondence, per-call HV_* env arming,
+#      staging/policy lock discipline, append-only EventType/metric/
+#      WAL-tag registries vs analysis/baseline.json, Pallas/numpy twin
+#      parity) and Tier B lowering lints over the traced entry points
+#      (no host callbacks beyond hv_wave_twin_call, no use-after-
+#      donate, fused wave stays ONE program) — zero unsuppressed
+#      findings, every suppression justified,
 #   7. a crash-recovery smoke gate — drive real traffic in a child
 #      process with a WAL + watermarked checkpoint, SIGKILL it
 #      mid-flight, recover from checkpoint + WAL replay, and assert
@@ -707,6 +716,16 @@ print(
 PY
 soak_rc=$?
 
+echo "── hvlint static-analysis gate ──"
+# The contract analyzer (ISSUE 12): Tier A pure-AST rules (WAL
+# coverage, env arming, lock discipline, append-only registries, twin
+# parity) + Tier B lowering lints (host callbacks, use-after-donate,
+# one-program fused wave) — zero unsuppressed findings, every
+# suppression justified. Tier B runs under JAX_PLATFORMS=cpu with a
+# hard timeout inside hvlint.sh (census-gate pattern).
+bash scripts/hvlint.sh
+hvlint_rc=$?
+
 echo "── crash-recovery smoke gate ──"
 JAX_PLATFORMS=cpu python scripts/crash_recovery_smoke.py
 crash_rc=$?
@@ -762,6 +781,10 @@ fi
 if [ "$soak_rc" -ne 0 ]; then
     echo "serving soak smoke gate FAILED (rc=$soak_rc)" >&2
     exit "$soak_rc"
+fi
+if [ "$hvlint_rc" -ne 0 ]; then
+    echo "hvlint static-analysis gate FAILED (rc=$hvlint_rc)" >&2
+    exit "$hvlint_rc"
 fi
 if [ "$crash_rc" -ne 0 ]; then
     echo "crash-recovery smoke gate FAILED (rc=$crash_rc)" >&2

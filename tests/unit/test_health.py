@@ -637,6 +637,20 @@ def _suite_report(
             "recompiles_after_warmup": 0,
             "invariant_violations": 0,
         },
+        # Rounds >= regression.STATIC_ROW_SINCE must carry the hvlint
+        # static-analysis row (round-13 presence gate, ISSUE 12).
+        "static_analysis": (
+            {
+                "rules": 8,
+                "findings": 0,
+                "suppressions": 5,
+                "files_analyzed": 122,
+                "tiers": ["A", "B"],
+                "programs_traced": 4,
+            }
+            if round_no >= 13
+            else None
+        ),
     }
 
 
@@ -845,6 +859,30 @@ class TestRegressionHarness:
         # A clean round 12 passes again.
         self._write(tmp_path, 12, soak_round(12))
         assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
+
+    def test_missing_static_analysis_row_fails_from_round_13(self, tmp_path):
+        # ISSUE 12: the hvlint row is REQUIRED from round 13 — dropping
+        # the static-analysis gate is itself a regression.
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 12, _suite_report(12, {"full_governance_pipeline": 10.0})
+        )
+        doc = _suite_report(13, {"full_governance_pipeline": 10.0})
+        doc["static_analysis"] = None
+        self._write(tmp_path, 13, doc)
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # A round carrying the row passes...
+        self._write(
+            tmp_path, 13,
+            _suite_report(13, {"full_governance_pipeline": 10.0}),
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
+        # ...but unsuppressed findings shipping in the round fail hard.
+        doc = _suite_report(13, {"full_governance_pipeline": 10.0})
+        doc["static_analysis"]["findings"] = 2
+        self._write(tmp_path, 13, doc)
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
 
     def test_next_round_path_advances(self, tmp_path):
         from benchmarks import regression

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import asyncio
 import json
-import re
 from pathlib import Path
 
 import numpy as np
@@ -162,17 +161,21 @@ class TestWal:
         wal.flush()
         assert [r.op for r in scan(wal.path).committed] == ["after"]
 
-    def test_every_journaled_op_has_a_replay_row(self):
-        """Every `self._journal("<op>", ...)` site in state.py must have
-        a handler in recovery.REPLAY — a journaled op nobody can replay
-        is data loss wearing a seatbelt."""
-        import hypervisor_tpu.state as state_mod
+    def test_journal_sites_match_replay_registry_exactly(self):
+        """hvlint's AST-derived journal-site set must EQUAL the runtime
+        REPLAY registry — both directions. The old hand-maintained
+        regex pin could drift from what the checker actually derives;
+        now the static analyzer's own derivation IS the pin (rule
+        HVA001 enforces it per commit, this test proves the derivation
+        and the live registry agree at runtime import)."""
+        from hypervisor_tpu.analysis import derived_wal_ops
 
-        src = Path(state_mod.__file__).read_text()
-        ops = set(re.findall(r"_journal\(\s*\n?\s*\"(\w+)\"", src))
-        assert ops, "no journal sites found — regex rotted?"
+        ops = derived_wal_ops()
+        assert ops, "hvlint derived no journal sites — walker rotted?"
         missing = ops - set(REPLAY)
         assert not missing, f"journaled ops without replay handlers: {missing}"
+        dead = set(REPLAY) - ops
+        assert not dead, f"REPLAY handlers with no journal site: {dead}"
 
 
 # ── the crash property ───────────────────────────────────────────────

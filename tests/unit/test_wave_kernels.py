@@ -606,3 +606,58 @@ class TestArmedCensus:
         assert phases["admission"] >= 3, phases
         assert phases["fsm_saga"] >= 2, phases
         assert sum(phases.values()) > 10
+
+
+class TestTwinSurface:
+    """The Mosaic/numpy twin pairing, pinned BY NAME (the hvlint HVA005
+    contract: every public `*_pallas` kernel has a `*_np` oracle and a
+    test that references both — this one)."""
+
+    TWINS = [
+        ("admission_block_pallas", "admission_block_np"),
+        ("fsm_saga_block_pallas", "fsm_saga_block_np"),
+        ("ring_append_pallas", "ring_append_np"),
+        ("saga_tick_block_pallas", "saga_tick_block_np"),
+    ]
+
+    @pytest.mark.parametrize("pallas_name,np_name", TWINS)
+    def test_every_mosaic_kernel_has_a_named_numpy_oracle(
+        self, pallas_name, np_name
+    ):
+        kernel = getattr(wave_pallas, pallas_name)
+        twin = getattr(wave_pallas, np_name)
+        assert callable(kernel) and callable(twin)
+        # The oracle must be executable WITHOUT a chip: pure numpy, no
+        # jax tracing in its signature contract.
+        assert twin.__module__ == wave_pallas.__name__
+
+    def test_ring_append_np_matches_delta_log_semantics(self):
+        """`ring_append_np` (the `ring_append_pallas` oracle) must be
+        bit-identical to `DeltaLog.append_batch_prefix` — same wrap,
+        same live-prefix gating, same cursor advance."""
+        rng = np.random.RandomState(23)
+        c, rows, n_live = 32, 12, 9   # wraps: cursor starts near the top
+        ring = DeltaLog.create(c)
+        ring = DeltaLog(
+            body=ring.body, digest=ring.digest, session=ring.session,
+            turn=ring.turn, cursor=jnp.int32(c - 5),
+        )
+        bodies = rng.randint(0, 2**32, (rows, 16), dtype=np.uint64).astype(np.uint32)
+        digests = rng.randint(0, 2**32, (rows, 8), dtype=np.uint64).astype(np.uint32)
+        sess = rng.randint(0, 6, rows).astype(np.int32)
+        turn = np.arange(rows, dtype=np.int32)
+        ref = ring.append_batch_prefix(
+            jnp.asarray(bodies), jnp.asarray(digests),
+            jnp.asarray(sess), jnp.asarray(turn), jnp.int32(n_live),
+        )
+        body, digest, session, turn_out, cursor = wave_pallas.ring_append_np(
+            np.asarray(ring.body), np.asarray(ring.digest),
+            np.asarray(ring.session), np.asarray(ring.turn),
+            np.asarray(ring.cursor), bodies, digests, sess, turn,
+            np.int32(n_live),
+        )
+        np.testing.assert_array_equal(np.asarray(ref.body), body)
+        np.testing.assert_array_equal(np.asarray(ref.digest), digest)
+        np.testing.assert_array_equal(np.asarray(ref.session), session)
+        np.testing.assert_array_equal(np.asarray(ref.turn), turn_out)
+        assert int(ref.cursor) == int(cursor)

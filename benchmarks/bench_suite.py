@@ -1312,6 +1312,19 @@ def main() -> None:
                 "warmup",
                 flush=True,
             )
+            # Latency observatory (round 14): per-class decomposition +
+            # burn-rate plane summary next to the aggregate numbers.
+            attr = soak_rec.get("latency_attribution") or {}
+            slo_block = soak_rec.get("slo") or {}
+            print(
+                "  attribution: "
+                f"{attr.get('tickets', 0)} tickets, sum err "
+                f"{attr.get('max_sum_error_ms', 0)} ms, exemplar "
+                f"coverage {attr.get('exemplar_coverage', 0)}, phase "
+                f"shares {attr.get('phase_shares')}; slo alerts "
+                f"{slo_block.get('alerts')}",
+                flush=True,
+            )
 
     census_rec = None
     if args.metrics_out and not args.no_census:

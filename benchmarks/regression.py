@@ -96,6 +96,22 @@ SOAK_ROW_SINCE = 11
 #: an unsuppressed contract violation shipped.
 STATIC_ROW_SINCE = 13
 
+#: The latency observatory joined the soak row in round 14 (ISSUE 13):
+#: per-class p50/p99 latency (`latency_ms_by_kind`) and the
+#: critical-path attribution block (`latency_attribution`: per-class
+#: queue_wait/pad_wait/wave_wall decomposition, the attribution-sum
+#: invariant's worst error, exemplar coverage, wave-phase shares). A
+#: soak row from 14 on missing either regresses the observability
+#: coverage even if every latency number is fine.
+ATTR_ROW_SINCE = 14
+
+#: Hard cap on the soak row's reported `max_sum_error_ms`: the
+#: decomposition must PARTITION the measured ticket latency — it is
+#: arithmetic on the same floats, so anything above rounding noise
+#: means a component was dropped or double-counted
+#: (`HV_BENCH_ATTR_SUM_TOL_MS` overrides).
+DEFAULT_ATTR_SUM_TOL_MS = 0.01
+
 #: Minimum goodput ratio (served / offered) a soak row may report
 #: (`HV_BENCH_SOAK_GOODPUT` overrides): the front door must actually
 #: serve an open workload, not shed its way to a fast p99.
@@ -272,6 +288,31 @@ def parse_round_file(path: Path) -> Optional[dict]:
                         "recompiles_after_warmup"
                     ),
                     "invariant_violations": soak.get("invariant_violations"),
+                    # Latency observatory (round 14): per-class spread +
+                    # the critical-path attribution summary — presence-
+                    # gated below so the trajectory keeps showing
+                    # class-level drift and decomposition health.
+                    "latency_ms_by_kind": soak.get("latency_ms_by_kind"),
+                    "latency_attribution": (
+                        {
+                            "tickets": attr.get("tickets"),
+                            "max_sum_error_ms": attr.get("max_sum_error_ms"),
+                            "exemplar_coverage": attr.get(
+                                "exemplar_coverage"
+                            ),
+                            "phase_shares": attr.get("phase_shares"),
+                            "classes": attr.get("classes"),
+                        }
+                        if isinstance(
+                            attr := soak.get("latency_attribution"), dict
+                        )
+                        else None
+                    ),
+                    "slo_alerts": (
+                        (soak.get("slo") or {}).get("alerts")
+                        if isinstance(soak.get("slo"), dict)
+                        else None
+                    ),
                 }
                 if isinstance(soak, dict)
                 else None
@@ -590,6 +631,36 @@ def compare(
             }
             checked.append(entry)
             if value != 0:
+                regressions.append(entry)
+        # Latency-observatory gates (round 14): the soak row must carry
+        # the per-class latency spread and the attribution block, and
+        # the decomposition must sum to the measured ticket latency
+        # within tolerance (a drifting sum means a component fell out
+        # of the partition — broken attribution, not slow serving).
+        if current["round"] >= ATTR_ROW_SINCE:
+            for field in ("latency_ms_by_kind", "latency_attribution"):
+                if not soak.get(field):
+                    entry = {
+                        "bench": f"missing:soak.{field}",
+                        "current_per_op_us": 0.0,
+                        "baseline_per_op_us": 0.0,
+                        "ratio": 0.0,
+                    }
+                    checked.append(entry)
+                    regressions.append(entry)
+        attr = soak.get("latency_attribution")
+        if attr and attr.get("max_sum_error_ms") is not None:
+            env_tol = os.environ.get("HV_BENCH_ATTR_SUM_TOL_MS")
+            tol = float(env_tol) if env_tol else DEFAULT_ATTR_SUM_TOL_MS
+            err = float(attr["max_sum_error_ms"])
+            entry = {
+                "bench": "soak_attr_sum_error_ms",
+                "current_per_op_us": err,
+                "baseline_per_op_us": tol,
+                "ratio": round(err / tol, 3) if tol else 0.0,
+            }
+            checked.append(entry)
+            if err > tol:
                 regressions.append(entry)
     # Static-analysis gates (round 13): presence from STATIC_ROW_SINCE,
     # then zero unsuppressed findings — hvlint findings shipping in a

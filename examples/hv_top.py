@@ -246,6 +246,53 @@ def render(
             ),
         )
 
+    slo = health.get("slo", {})
+    lines.append("")
+    if not slo.get("enabled"):
+        lines.append("slo        (latency observatory not armed)")
+    else:
+        alerts = slo.get("alerts", {})
+        attribution = slo.get("attribution", {})
+        lines.append(
+            "slo        alerts: "
+            f"warn={alerts.get('warning', 0)} "
+            f"crit={alerts.get('critical', 0)} "
+            f"recovered={alerts.get('recovered', 0)}  "
+            f"tickets={attribution.get('tickets', 0):,}  "
+            f"exemplar_cov={attribution.get('exemplar_coverage', 0) * 100:.0f}%  "
+            f"sum_err={attribution.get('max_sum_error_ms', 0):.3f} ms"
+        )
+        slo_rows = []
+        attr_classes = attribution.get("classes", {})
+        for name, row in sorted(slo.get("classes", {}).items()):
+            comp = attr_classes.get(name, {})
+
+            def _pc(c):
+                cell = comp.get(c)
+                return "-" if not cell else (
+                    f"{cell['p50_ms']:.0f}/{cell['p99_ms']:.0f}"
+                )
+
+            slo_rows.append(
+                (
+                    name,
+                    row.get("state", "?"),
+                    f"{row.get('burn_fast', 0):.1f}",
+                    f"{row.get('burn_slow', 0):.1f}",
+                    f"{row.get('good', 0):,}/{row.get('bad', 0):,}",
+                    _pc("queue_wait"),
+                    _pc("pad_wait"),
+                    _pc("wave_wall"),
+                )
+            )
+        lines += fmt_table(
+            slo_rows,
+            header=(
+                "class", "state", "burn5m", "burn1h", "good/bad",
+                "queue p50/99", "pad p50/99", "wave p50/99",
+            ),
+        )
+
     if trajectory:
         lines.append("")
         lines.append("bench trajectory (headline per-op p50, µs)")

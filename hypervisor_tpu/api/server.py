@@ -1,6 +1,6 @@
 """REST transports for the Hypervisor API.
 
-Two transports over the same `HypervisorService` (41 routes: the
+Two transports over the same `HypervisorService` (42 routes: the
 reference's 21, `api/server.py`, plus device stats, quarantine views,
 the per-membership agent view, leave, the operator sweep, the
 per-action gateway with its wave sibling, the flight recorder —
@@ -15,7 +15,11 @@ repair/restore ladder accounting), and the serving front door:
 `GET /debug/serving` (queue depths, shed rates, deadline misses, wave
 cadence), `POST .../join-wave` (batched joins with per-lane typed
 refusals), and `GET /api/v1/serving/stream` (NDJSON watch feed);
-overload sheds map to HTTP 429 + Retry-After on BOTH transports):
+overload sheds map to HTTP 429 + Retry-After on BOTH transports — the
+Retry-After hint is LIVE: queue depth x observed drain rate, scaled by
+the class's SLO burn state — plus the latency observatory:
+`GET /debug/slo` (per-class burn rates, critical-path decomposition,
+exemplars, phase shares)):
 
  - `create_app()` — a FastAPI application with CORS-open middleware and
    OpenAPI docs, when fastapi is installed.
@@ -65,6 +69,7 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/debug/resilience", "debug_resilience", None),
     ("GET", "/debug/integrity", "debug_integrity", None),
     ("GET", "/debug/serving", "debug_serving", None),
+    ("GET", "/debug/slo", "debug_slo", None),
     ("GET", "/api/v1/stats", "stats", None),
     ("GET", "/api/v1/device/stats", "device_stats", None),
     ("POST", "/api/v1/sessions", "create_session", M.CreateSessionRequest),

@@ -690,7 +690,10 @@ class HypervisorService:
     def _retry_after_s(self) -> float:
         serving = self.hv.state.serving
         if serving is not None:
-            return serving.config.retry_after_s
+            # LIVE hint (depth x observed drain rate, SLO-burn scaled),
+            # not the static config constant — the class a facade join
+            # rides is the join queue.
+            return serving.retry_after_for("join")
         return 1.0
 
     async def debug_serving(self) -> dict:
@@ -698,6 +701,25 @@ class HypervisorService:
         per-queue depth/backpressure, shed accounting by refusal kind,
         deadline misses, wave cadence and bucket fill."""
         return self.hv.state.serving_summary()
+
+    async def debug_slo(self) -> dict:
+        """`GET /debug/slo`: the latency observatory in one poll —
+        per-class burn-rate states and objectives, the alert log (with
+        its replay digest), the critical-path decomposition quantiles
+        with exemplar coverage, live Retry-After hints, and the
+        trace-joined wave-phase shares + recent ticket critical paths
+        (the phase join drains the trace ring — one device_get, the
+        same cost /trace pays)."""
+        state = self.hv.state
+        out = state.slo_summary()
+        if out.get("enabled"):
+            serving = state.serving
+            out["phase_shares"] = serving.attribution.phase_shares(
+                state.tracer
+            )
+            out["recent_paths"] = serving.attribution.recent_paths(16)
+            out["exemplar_rows"] = serving.attribution.exemplars()[-16:]
+        return out
 
     async def join_wave(
         self, session_id: str, req: M.JoinWaveRequest

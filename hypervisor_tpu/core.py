@@ -1792,6 +1792,12 @@ class Hypervisor:
             # the same fan-out; collusion findings emit directly from
             # `detect_collusion` (they carry session context).
             "sybil_damped": EventType.SYBIL_DAMPED,
+            # SLO burn-rate alerts (the latency observatory,
+            # `observability.slo`) ride the same fan-out — the engine's
+            # emit hook is `HealthMonitor.emit_event`.
+            "slo_burn_warning": EventType.SLO_BURN_RATE_WARNING,
+            "slo_burn_critical": EventType.SLO_BURN_RATE_CRITICAL,
+            "slo_recovered": EventType.SLO_RECOVERED,
         }.get(kind)
         if event_type is None or self.event_bus is None:
             return

@@ -9,10 +9,20 @@ Chrome/OTLP export. `health` is the runtime health plane: compile
 telemetry around the jitted wave entry points, HBM occupancy
 accounting over the shared `footprint()` protocol, and the wave
 watchdog that flags stragglers against each stage's own latency
-distribution.
+distribution. `attribution` + `slo` are the latency observatory:
+per-ticket critical-path decomposition (queue_wait / pad_wait /
+wave_wall / per-phase) with /metrics exemplars, and the per-class
+multi-window burn-rate engine whose alerts the supervisor can act on.
 """
 
-from hypervisor_tpu.observability import health, metrics, profiling, tracing
+from hypervisor_tpu.observability import (
+    attribution,
+    health,
+    metrics,
+    profiling,
+    slo,
+    tracing,
+)
 from hypervisor_tpu.observability.causal_trace import (
     CausalTraceId,
     device_key_of,
@@ -31,10 +41,12 @@ __all__ = [
     "EventType",
     "HypervisorEvent",
     "HypervisorEventBus",
+    "attribution",
     "device_key_of",
     "fnv1a32",
     "health",
     "metrics",
     "profiling",
+    "slo",
     "tracing",
 ]

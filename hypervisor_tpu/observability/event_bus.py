@@ -98,6 +98,13 @@ class EventType(str, enum.Enum):
     SYBIL_DAMPED = "adversarial.sybil_damped"
     COLLUSION_DETECTED = "adversarial.collusion_detected"
 
+    # SLO burn-rate plane (append-only, like every block above): the
+    # latency observatory's multi-window alerts (`observability.slo`),
+    # facade-bridged from the health fan-out like the resilience plane.
+    SLO_BURN_RATE_WARNING = "slo.burn_rate_warning"
+    SLO_BURN_RATE_CRITICAL = "slo.burn_rate_critical"
+    SLO_RECOVERED = "slo.recovered"
+
     @property
     def code(self) -> int:
         """int32 column code for the device event log."""

@@ -486,6 +486,71 @@ TABLE_HIGH_WATER_ROWS = {
     for t in HEALTH_TABLES
 }
 
+# ── latency observatory (critical-path attribution + SLO burn rate) ──
+# Host-incremented by `observability.attribution.CriticalPathAggregator`
+# (ticket resolve) and `observability.slo.SLOEngine` (note/evaluate) —
+# all host-plane rows riding the existing drain: ZERO extra device
+# transfers on the serving clean path. APPENDED at the registry tail
+# (hvlint HVA004: registration order is the device-table row layout).
+ATTR_COMPONENTS: tuple[str, ...] = ("queue_wait", "pad_wait", "wave_wall")
+SERVING_ATTR_LATENCY = {
+    (q, c): REGISTRY.histogram(
+        "hv_serving_attr_latency_us",
+        "per-ticket critical-path component latency (decomposition of "
+        "hv_serving_latency_us: queue_wait + pad_wait + wave_wall)",
+        queue=q,
+        component=c,
+    )
+    for q in SERVING_QUEUES
+    for c in ATTR_COMPONENTS
+}
+SERVING_ATTR_TICKETS = {
+    q: REGISTRY.counter(
+        "hv_serving_attr_tickets_total",
+        "resolved tickets folded into the critical-path attribution",
+        queue=q,
+    )
+    for q in SERVING_QUEUES
+}
+SLO_GOOD = {
+    q: REGISTRY.counter(
+        "hv_slo_good_total",
+        "requests that met their class objective (served inside the "
+        "deadline)",
+        queue=q,
+    )
+    for q in SERVING_QUEUES
+}
+SLO_BAD = {
+    q: REGISTRY.counter(
+        "hv_slo_bad_total",
+        "requests that burned error budget (deadline miss or overload "
+        "shed)",
+        queue=q,
+    )
+    for q in SERVING_QUEUES
+}
+SLO_WINDOWS: tuple[str, ...] = ("fast", "slow", "long")
+SLO_BURN_RATE = {
+    (q, w): REGISTRY.gauge(
+        "hv_slo_burn_rate",
+        "error-budget burn rate per class and evaluation window "
+        "(1.0 = spending exactly the budget)",
+        queue=q,
+        window=w,
+    )
+    for q in SERVING_QUEUES
+    for w in SLO_WINDOWS
+}
+SLO_ALERTS = {
+    s: REGISTRY.counter(
+        "hv_slo_alerts_total",
+        "burn-rate alert transitions fired by the SLO engine",
+        severity=s,
+    )
+    for s in ("warning", "critical", "recovered")
+}
+
 
 # ── host object: device table + host mirror + drain ──────────────────
 

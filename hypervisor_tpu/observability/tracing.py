@@ -292,6 +292,8 @@ class Tracer:
         self.table: Optional[TraceLog] = (
             TraceLog.create(self.capacity) if self.enabled else None
         )
+        #: Most recently closed wave bracket (serving ticket joins).
+        self.last_closed: Optional[WaveRecord] = None
         # Optional wave watchdog (`observability.health.HealthMonitor`):
         # every closed bracket is offered to it, so straggler detection
         # rides the same host bracket that stamps CausalTraceIds. With
@@ -393,6 +395,10 @@ class Tracer:
             if table is not None:
                 self.table = table
             self._waves[handle.record.wave_seq] = handle.record
+            # The newest closed bracket: the serving scheduler joins
+            # each ticket to the wave that served it through this
+            # (dispatches are synchronous under the front-door lock).
+            self.last_closed = handle.record
             # O(1) eviction: records land in insertion order (dicts
             # preserve it), so the first key is the oldest — a
             # min()-scan here would cost O(max_waves) under the lock on

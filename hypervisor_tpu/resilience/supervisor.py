@@ -26,7 +26,10 @@ Knobs (env, read at construction): `HV_SUP_MAX_RETRIES` (default 4),
 (consecutive exhausted dispatches before degrading, default 2),
 `HV_SUP_DEGRADE_STRAGGLERS` / `HV_SUP_DEGRADE_CAPACITY` (health-event
 pressure thresholds, defaults 4 / 2), `HV_SUP_EXIT_CLEAN` (clean
-dispatches to exit degraded mode, default 8).
+dispatches to exit degraded mode, default 8), `HV_SUP_DEGRADE_SLO`
+(flip degraded mode on a CRITICAL SLO burn-rate alert from the latency
+observatory — `observability.slo` fans `slo_burn_critical` through the
+same listener set — default 1; 0 leaves the SLO plane observe-only).
 """
 
 from __future__ import annotations
@@ -82,6 +85,7 @@ class Supervisor:
         degrade_after_stragglers: Optional[int] = None,
         degrade_after_capacity: Optional[int] = None,
         degrade_after_comp_backlog: Optional[int] = None,
+        degrade_on_slo_critical: Optional[bool] = None,
         exit_after_clean: Optional[int] = None,
         policy: Optional[DegradedPolicy] = None,
         checkpoint_dir: Optional[str] = None,
@@ -126,6 +130,16 @@ class Supervisor:
             if degrade_after_comp_backlog is not None
             else int(_env_float("HV_SUP_DEGRADE_COMP", 64))
         )
+        # SLO burn-rate escalation (ISSUE 13): a CRITICAL multi-window
+        # burn alert means the error budget is being spent 14x+ faster
+        # than sustainable on BOTH confirmation windows — degrading NOW
+        # sheds new load before any ingestion queue hard-fills, instead
+        # of discovering the overload at the next bench round.
+        self.degrade_on_slo_critical = (
+            degrade_on_slo_critical
+            if degrade_on_slo_critical is not None
+            else _env_float("HV_SUP_DEGRADE_SLO", 1.0) != 0.0
+        )
         self.exit_after_clean = (
             exit_after_clean
             if exit_after_clean is not None
@@ -151,6 +165,9 @@ class Supervisor:
         self._capacity_pressure = 0
         self._comp_backlog = 0
         self.comp_backpressure_entries = 0
+        self.slo_critical_alerts = 0
+        self.slo_degraded_entries = 0
+        self.last_slo_alert: Optional[dict] = None
         self.last_error: Optional[str] = None
         self.recovery_latencies_ms: deque[float] = deque(maxlen=256)
         self.last_checkpoint: Optional[dict] = None
@@ -280,6 +297,23 @@ class Supervisor:
                         f"{self._capacity_pressure} capacity warnings since "
                         "last recovery"
                     )
+            elif kind == "slo_burn_critical":
+                # The latency observatory's page-severity alert: the
+                # class is burning budget 14x+ faster than sustainable
+                # on both confirmation windows. Degrade BEFORE the
+                # ingestion queues hard-fill (the whole point of
+                # watching burn rate instead of queue depth).
+                self.slo_critical_alerts += 1
+                self.last_slo_alert = dict(payload)
+                if self.degrade_on_slo_critical:
+                    entering = self.state.degraded_policy is None
+                    reason = (
+                        f"SLO burn-rate critical on {payload.get('queue')}: "
+                        f"fast {payload.get('burn_fast')}x / slow "
+                        f"{payload.get('burn_slow')}x the error budget"
+                    )
+                    if entering:
+                        self.slo_degraded_entries += 1
             elif kind == "comp_backlog":
                 # Absolute, not cumulative: the event carries the LIVE
                 # compensation backlog, so the pressure reading tracks
@@ -547,6 +581,9 @@ class Supervisor:
                     "comp_backpressure_entries": (
                         self.comp_backpressure_entries
                     ),
+                    "slo_critical_alerts": self.slo_critical_alerts,
+                    "slo_degraded_entries": self.slo_degraded_entries,
+                    "last_slo_alert": self.last_slo_alert,
                 },
                 "thresholds": {
                     "max_retries": self.max_retries,
@@ -557,6 +594,7 @@ class Supervisor:
                     "degrade_after_comp_backlog": (
                         self.degrade_after_comp_backlog
                     ),
+                    "degrade_on_slo_critical": self.degrade_on_slo_critical,
                     "exit_after_clean": self.exit_after_clean,
                 },
                 "recovery_latency_ms": (

@@ -15,6 +15,16 @@ system (ROADMAP item 4):
   * `loadgen` — seeded Poisson arrivals, heavy-tailed lifetimes,
     replayable trace files, and `run_soak` (the `bench_suite --soak`
     row gated by `benchmarks/regression.py`).
+
+Round 14 armed the latency observatory over this plane
+(`observability.attribution` + `observability.slo`): every `Ticket`
+carries a CausalTraceId from submit and resolves with a critical-path
+decomposition (queue_wait + pad_wait + wave_wall, partitioning the
+measured latency exactly), the front door aggregates per-class
+decomposition histograms with `/metrics` exemplars, a per-class
+multi-window burn-rate engine alerts onto the event bus (the
+supervisor can flip degraded mode on a critical burn), and
+`Refusal.retry_after_s` derives from live depth x observed drain rate.
 """
 
 from hypervisor_tpu.serving.front_door import (

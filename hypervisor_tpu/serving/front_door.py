@@ -38,6 +38,15 @@ from typing import Any, Optional
 import numpy as np
 
 from hypervisor_tpu.observability import metrics as metrics_plane
+from hypervisor_tpu.observability.attribution import (
+    CriticalPathAggregator,
+    TicketPath,
+)
+from hypervisor_tpu.observability.causal_trace import CausalTraceId
+from hypervisor_tpu.observability.slo import (
+    SLOEngine,
+    objectives_from_serving_config,
+)
 from hypervisor_tpu.resilience.policy import (
     DegradedModeRefusal,
     SybilShedRefusal,
@@ -108,11 +117,54 @@ class ServingConfig:
     lifecycle_queue_depth: int = 256
     terminate_queue_depth: int = 256
     saga_queue_depth: int = 256
-    #: Retry-After hint (seconds) stamped on refusals; API transports
-    #: surface it as the HTTP Retry-After header on 429s.
+    #: Retry-After FALLBACK (seconds) stamped on refusals while the
+    #: per-class drain rate is unwarmed; once a class has drained a few
+    #: waves the hint derives from live depth × observed drain rate
+    #: (`FrontDoor.retry_after_for`), scaled by the class's SLO burn
+    #: state. API transports surface it as the HTTP Retry-After header.
     retry_after_s: float = dataclasses.field(
         default_factory=lambda: float(
             os.environ.get("HV_SERVE_RETRY_AFTER_S", 1.0)
+        )
+    )
+    #: SLO plane (observability/slo.py): per-class objective target —
+    #: the fraction of requests that must resolve inside the class
+    #: deadline (sheds burn budget too). Windows/thresholds follow the
+    #: SRE multi-window multi-burn-rate shape; all env knobs read via
+    #: default_factory (the HVA002 per-instantiation arming contract).
+    slo_target: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("HV_SERVE_SLO_TARGET", 0.99)
+        )
+    )
+    slo_fast_window_s: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("HV_SERVE_SLO_FAST_S", 300.0)
+        )
+    )
+    slo_slow_window_s: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("HV_SERVE_SLO_SLOW_S", 3600.0)
+        )
+    )
+    slo_long_window_s: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("HV_SERVE_SLO_LONG_S", 21600.0)
+        )
+    )
+    slo_critical_burn: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("HV_SERVE_SLO_CRIT_BURN", 14.4)
+        )
+    )
+    slo_warning_burn: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("HV_SERVE_SLO_WARN_BURN", 6.0)
+        )
+    )
+    slo_min_events: int = dataclasses.field(
+        default_factory=lambda: int(
+            float(os.environ.get("HV_SERVE_SLO_MIN_EVENTS", 24))
         )
     )
     #: Audit turns per ephemeral lifecycle (the T axis of the fused
@@ -164,7 +216,15 @@ class Refusal:
 
 @dataclasses.dataclass
 class Ticket:
-    """One accepted request, resolved by the wave that serves it."""
+    """One accepted request, resolved by the wave that serves it.
+
+    Carries a `CausalTraceId` from submit (the attribution plane's join
+    key: `/metrics` exemplars and `/debug/slo` paths name it) and, once
+    resolved, the critical-path decomposition — queue_wait + pad_wait +
+    wave_wall partition `latency_s` exactly (the attribution-sum
+    invariant, test-pinned) — plus the serving wave's own trace id so
+    the ticket links to the wave's `/trace` span tree.
+    """
 
     kind: str
     submitted_at: float          # virtual (caller-clock) submit time
@@ -179,6 +239,12 @@ class Ticket:
     served_at: Optional[float] = None
     latency_s: Optional[float] = None
     deadline_missed: bool = False
+    trace: Optional[CausalTraceId] = None   # assigned at submit
+    queue_wait_s: Optional[float] = None    # critical-path decomposition
+    pad_wait_s: Optional[float] = None
+    wave_wall_s: Optional[float] = None
+    wave_seq: Optional[int] = None          # the serving wave's host index
+    wave_trace_id: Optional[str] = None     # ... and its CausalTraceId
 
     def to_dict(self) -> dict:
         return {
@@ -191,6 +257,8 @@ class Ticket:
                 else round(self.latency_s * 1e3, 3)
             ),
             "deadline_missed": self.deadline_missed,
+            "trace_id": self.trace.full_id if self.trace else None,
+            "wave_trace_id": self.wave_trace_id,
         }
 
 
@@ -241,6 +309,32 @@ class FrontDoor:
         self.waves = {q: 0 for q in self._queues}
         self.padded_lanes = 0
         self.last_wave: dict[str, dict] = {}
+        # ── latency observatory (ISSUE 13) ──────────────────────────
+        # Critical-path aggregator: per-ticket decomposition histograms
+        # + exemplars, host-plane only (rides the existing drain).
+        self.attribution = CriticalPathAggregator(state.metrics)
+        # SLO burn-rate engine: alerts fan through the health monitor's
+        # listener set, so the supervisor and the facade's bus bridge
+        # both see slo_burn_{warning,critical}/slo_recovered.
+        self.slo = SLOEngine(
+            objectives_from_serving_config(self.config),
+            fast_window_s=self.config.slo_fast_window_s,
+            slow_window_s=self.config.slo_slow_window_s,
+            long_window_s=self.config.slo_long_window_s,
+            critical_burn=self.config.slo_critical_burn,
+            warning_burn=self.config.slo_warning_burn,
+            min_events=self.config.slo_min_events,
+            metrics=state.metrics,
+            emit=state.health.emit_event,
+        )
+        # Observed drain rate per class (requests/virtual-second, EWMA
+        # over dispatched waves): the live Retry-After derivation.
+        self._drain_rate = {q: 0.0 for q in self._queues}
+        self._drain_waves = {q: 0 for q in self._queues}
+        self._drain_last_t: dict[str, Optional[float]] = {
+            q: None for q in self._queues
+        }
+        self._drain_pending = {q: 0 for q in self._queues}
         state.serving = self
 
     # ── submit paths ─────────────────────────────────────────────────
@@ -248,26 +342,68 @@ class FrontDoor:
     def _now(self, now: Optional[float]) -> float:
         return self.state.now() if now is None else float(now)
 
-    def _refuse(self, kind: str, detail: str) -> Refusal:
+    def retry_after_for(
+        self, queue: Optional[str] = None, now: Optional[float] = None
+    ) -> float:
+        """The LIVE Retry-After hint for one class.
+
+        depth × observed drain rate — "come back when the backlog ahead
+        of you has drained" — scaled by the class's SLO burn state
+        (a burning class tells clients to back off 2–4× harder), and
+        falling back to the static `config.retry_after_s` while the
+        drain rate is unwarmed (< 3 dispatched waves). The PR 10 bug
+        this replaces: the static constant was returned even when the
+        queue was draining in milliseconds.
+        """
+        base = self.config.retry_after_s
+        if queue is None or queue not in self._queues:
+            return base
+        mult = self.slo.backoff_multiplier(queue)
+        rate = self._drain_rate[queue]
+        if self._drain_waves[queue] < 3 or rate <= 0.0:
+            return round(base * mult, 3)
+        depth = len(self._queues[queue])
+        estimate = (depth + 1) / rate
+        # Clamp: never promise sub-50 ms (a tick must elapse), never
+        # exceed 8× the configured fallback (a stalled drain is the
+        # supervisor's problem, not an hour-long client backoff).
+        return round(
+            min(max(estimate, 0.05), base * 8.0) * mult, 3
+        )
+
+    def _refuse(
+        self, kind: str, detail: str, queue: Optional[str] = None,
+        now: Optional[float] = None,
+    ) -> Refusal:
         self.shed[kind] += 1
         self.state.metrics.inc(metrics_plane.SERVING_SHED[kind])
+        # Overload sheds burn the class's error budget (a duplicate is
+        # a caller mistake, not an SLO event).
+        if queue is not None and kind != "duplicate" and now is not None:
+            self.slo.note(queue, now, good=False)
         return Refusal(
             kind=kind,
             detail=detail,
-            retry_after_s=self.config.retry_after_s,
+            retry_after_s=self.retry_after_for(queue, now),
         )
 
     def _accept(self, queue: str, ticket: Ticket) -> Ticket:
+        if ticket.trace is None:
+            ticket.trace = CausalTraceId()
         self._queues[queue].append(ticket)
         self.enqueued[queue] += 1
         self.state.metrics.inc(metrics_plane.SERVING_ENQUEUED[queue])
         return ticket
 
-    def _depth_refusal(self, queue: str) -> Optional[Refusal]:
+    def _depth_refusal(
+        self, queue: str, now: Optional[float] = None
+    ) -> Optional[Refusal]:
         if len(self._queues[queue]) >= self._depths[queue]:
             return self._refuse(
                 "queue_full",
                 f"{queue} queue at depth {self._depths[queue]}",
+                queue=queue,
+                now=now,
             )
         return None
 
@@ -289,7 +425,7 @@ class FrontDoor:
         """
         now = self._now(now)
         with self._lock:
-            full = self._depth_refusal("join")
+            full = self._depth_refusal("join", now)
             if full is not None:
                 return full
             from hypervisor_tpu.state import _mkey
@@ -301,6 +437,8 @@ class FrontDoor:
                     "duplicate",
                     f"{agent_did} already member/staged in session "
                     f"{session_slot}",
+                    queue="join",
+                    now=now,
                 )
             try:
                 q = self.state.enqueue_join(
@@ -308,11 +446,13 @@ class FrontDoor:
                     trustworthy=trustworthy, now=now,
                 )
             except SybilShedRefusal as e:
-                return self._refuse("sybil_damped", str(e))
+                return self._refuse("sybil_damped", str(e), "join", now)
             except DegradedModeRefusal as e:
-                return self._refuse("degraded", str(e))
+                return self._refuse("degraded", str(e), "join", now)
             if q < 0:
-                return self._refuse("queue_full", "staging queue full")
+                return self._refuse(
+                    "queue_full", "staging queue full", "join", now
+                )
             ticket = Ticket(
                 kind="join",
                 submitted_at=now,
@@ -338,7 +478,7 @@ class FrontDoor:
         """Queue one gateway action for a STANDING membership row."""
         now = self._now(now)
         with self._lock:
-            full = self._depth_refusal("action")
+            full = self._depth_refusal("action", now)
             if full is not None:
                 return full
             ticket = Ticket(
@@ -373,7 +513,7 @@ class FrontDoor:
         """
         now = self._now(now)
         with self._lock:
-            full = self._depth_refusal("lifecycle")
+            full = self._depth_refusal("lifecycle", now)
             if full is not None:
                 return full
             damper = self.state.admission_damper
@@ -382,9 +522,11 @@ class FrontDoor:
             try:
                 self.state._shed_gate(float(sigma_raw))
             except SybilShedRefusal as e:
-                return self._refuse("sybil_damped", str(e))
+                return self._refuse(
+                    "sybil_damped", str(e), "lifecycle", now
+                )
             except DegradedModeRefusal as e:
-                return self._refuse("degraded", str(e))
+                return self._refuse("degraded", str(e), "lifecycle", now)
             t = self.config.lifecycle_turns
             from hypervisor_tpu.ops.merkle import BODY_WORDS
 
@@ -421,7 +563,7 @@ class FrontDoor:
         bounded-queue backpressure applies."""
         now = self._now(now)
         with self._lock:
-            full = self._depth_refusal("terminate")
+            full = self._depth_refusal("terminate", now)
             if full is not None:
                 return full
             ticket = Ticket(
@@ -439,7 +581,7 @@ class FrontDoor:
         terminations, saga settles always flow (in-flight work)."""
         now = self._now(now)
         with self._lock:
-            full = self._depth_refusal("saga")
+            full = self._depth_refusal("saga", now)
             if full is not None:
                 return full
             ticket = Ticket(
@@ -473,16 +615,47 @@ class FrontDoor:
         wall_s: float,
         status: Optional[int] = None,
         result: Any = None,
+        newest_submit: Optional[float] = None,
+        wave_record=None,
     ) -> None:
         """Close a ticket against the wave that served it: latency is
-        the virtual queue wait plus the measured wall dispatch time."""
+        the virtual queue wait plus the measured wall dispatch time.
+
+        With `newest_submit` (the latest submit time in the dispatched
+        wave), the latency decomposes into the critical path the
+        attribution plane aggregates:
+
+          pad_wait   = now − newest_submit   (the whole wave's tail
+                       wait for a bucket fill that never came; 0 when
+                       the bucket filled — dispatch fires on fill)
+          queue_wait = (now − submitted) − pad_wait
+          wave_wall  = wall_s
+
+        which PARTITIONS `latency_s` exactly (the attribution-sum
+        invariant). `wave_record` is the serving wave's host
+        `tracing.WaveRecord` — its trace id joins the ticket to the
+        wave's `/trace` span tree.
+        """
         ticket.done = True
+        # Lane statuses arrive as numpy bools off the wave result; the
+        # ticket/TicketPath records are host-plane (JSON-clean) values.
+        ok = bool(ok)
         ticket.ok = ok
         ticket.status = status
         ticket.result = result
         ticket.served_at = now
-        ticket.latency_s = max(0.0, now - ticket.submitted_at) + wall_s
+        queue_total = max(0.0, now - ticket.submitted_at)
+        ticket.latency_s = queue_total + wall_s
         ticket.deadline_missed = ticket.latency_s > ticket.deadline_s
+        pad = 0.0
+        if newest_submit is not None:
+            pad = min(max(0.0, now - newest_submit), queue_total)
+        ticket.queue_wait_s = queue_total - pad
+        ticket.pad_wait_s = pad
+        ticket.wave_wall_s = wall_s
+        if wave_record is not None:
+            ticket.wave_seq = wave_record.wave_seq
+            ticket.wave_trace_id = wave_record.trace.full_id
         self.served[ticket.kind] += 1
         m = self.state.metrics
         m.inc(metrics_plane.SERVING_SERVED[ticket.kind])
@@ -493,9 +666,32 @@ class FrontDoor:
         if ticket.deadline_missed:
             self.deadline_misses += 1
             m.inc(metrics_plane.SERVING_DEADLINE_MISSES)
+        self.attribution.observe(
+            TicketPath(
+                kind=ticket.kind,
+                trace_id=ticket.trace.full_id if ticket.trace else None,
+                wave_seq=ticket.wave_seq,
+                wave_trace_id=ticket.wave_trace_id,
+                submitted_at=ticket.submitted_at,
+                resolved_at=now,
+                queue_wait_s=ticket.queue_wait_s,
+                pad_wait_s=ticket.pad_wait_s,
+                wave_wall_s=wall_s,
+                latency_s=ticket.latency_s,
+                deadline_s=ticket.deadline_s,
+                deadline_missed=ticket.deadline_missed,
+                ok=ok,
+            )
+        )
+        self.slo.note(ticket.kind, now, good=not ticket.deadline_missed)
 
-    def note_wave(self, queue: str, lanes: int, bucket: int) -> None:
-        """Book one dispatched wave's shape accounting."""
+    def note_wave(
+        self, queue: str, lanes: int, bucket: int,
+        now: Optional[float] = None,
+    ) -> None:
+        """Book one dispatched wave's shape accounting (+ the observed
+        drain rate when the scheduler supplies its virtual `now` — the
+        live Retry-After input)."""
         self.waves[queue] += 1
         pads = max(0, bucket - lanes)
         self.padded_lanes += pads
@@ -510,6 +706,32 @@ class FrontDoor:
             "bucket": bucket,
             "fill_pct": round(fill, 1),
         }
+        if now is not None:
+            self._note_drain(queue, lanes, now)
+
+    def _note_drain(self, queue: str, lanes: int, now: float) -> None:
+        """EWMA drain rate (requests / virtual second) per class.
+
+        A `drain()` burst dispatches several waves at one `now`; their
+        lanes accumulate and fold into the next sample with dt > 0
+        (rate math on dt == 0 would divide by zero, and dropping the
+        lanes would under-report the drain)."""
+        last = self._drain_last_t[queue]
+        self._drain_pending[queue] += lanes
+        if last is None:
+            self._drain_last_t[queue] = now
+            return
+        dt = now - last
+        if dt <= 0.0:
+            return
+        sample = self._drain_pending[queue] / dt
+        self._drain_pending[queue] = 0
+        self._drain_last_t[queue] = now
+        self._drain_waves[queue] += 1
+        prev = self._drain_rate[queue]
+        self._drain_rate[queue] = (
+            sample if prev <= 0.0 else 0.7 * prev + 0.3 * sample
+        )
 
     def refresh_depth_gauges(self) -> None:
         m = self.state.metrics
@@ -550,6 +772,14 @@ class FrontDoor:
                 "deadline_misses": self.deadline_misses,
                 "padded_lanes": self.padded_lanes,
                 "retry_after_s": self.config.retry_after_s,
+                # Live backpressure hints + burn states (the latency
+                # observatory's glance row; full detail on /debug/slo).
+                "retry_after_live_s": {
+                    q: self.retry_after_for(q) for q in self._queues
+                },
+                "slo_states": {
+                    q: self.slo.state_of(q) for q in self._queues
+                },
             }
 
 

@@ -338,6 +338,8 @@ def run_soak(
     for t in tickets:
         if t.latency_s is not None:
             per_kind.setdefault(t.kind, []).append(t.latency_s * 1e3)
+    for v in per_kind.values():
+        v.sort()
     served = sum(front.served.values())
     offered_total = sum(offered.values())
     shed_total = sum(front.shed.values())
@@ -379,9 +381,30 @@ def run_soak(
             "max": round(latencies[-1], 3) if latencies else 0.0,
         },
         "latency_p99_ms_by_kind": {
-            k: round(_quantile(sorted(v), 0.99), 3)
+            k: round(_quantile(v, 0.99), 3)
             for k, v in sorted(per_kind.items())
         },
+        # Per-class latency spread (round 14): the trajectory's
+        # class-level drift signal — presence-gated by regression.py.
+        "latency_ms_by_kind": {
+            k: {
+                "n": len(v),
+                "p50": round(_quantile(v, 0.5), 3),
+                "p99": round(_quantile(v, 0.99), 3),
+            }
+            for k, v in sorted(per_kind.items())
+        },
+        # Critical-path attribution (round 14): per-class decomposition
+        # quantiles, the attribution-sum invariant's worst error, the
+        # wave-phase shares (one trace drain, post-soak), and exemplar
+        # coverage — presence-gated by regression.py.
+        "latency_attribution": {
+            **front.attribution.summary(),
+            "phase_shares": front.attribution.phase_shares(state.tracer),
+        },
+        # Burn-rate plane: per-class final burn state + the replayable
+        # alert log digest (same trace + seed => identical alerts).
+        "slo": front.slo.summary(),
         "slo_p99_ms": slo_p99_ms,
         "slo_ok": bool(p99 <= slo_p99_ms),
         "deadline_misses": front.deadline_misses,

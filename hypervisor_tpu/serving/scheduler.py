@@ -119,6 +119,10 @@ class WaveScheduler:
                 self._dispatch_sagas(now)
                 report["saga"] += 1
             fd.refresh_depth_gauges()
+            # One burn-rate evaluation per scheduling pass: cheap host
+            # window math on the virtual clock (alerts fan through the
+            # health monitor -> supervisor + event bus).
+            fd.slo.evaluate(now)
         return report
 
     def drain(self, now: Optional[float] = None, max_ticks: int = 64) -> int:
@@ -154,6 +158,7 @@ class WaveScheduler:
                     self._dispatch_sagas(now)
                     waves += 1
                 fd.refresh_depth_gauges()
+                fd.slo.evaluate(now)
         return waves
 
     # ── per-class dispatches ─────────────────────────────────────────
@@ -164,9 +169,11 @@ class WaveScheduler:
         fd.joins.clear()
         n = len(tickets)
         bucket = self.bucket_for(n)
+        newest = max(t.submitted_at for t in tickets) if tickets else now
         t0 = time.perf_counter()
         self.state.flush_joins(now=now, pad_to=bucket)
         wall = time.perf_counter() - t0
+        rec = self.state.tracer.last_closed
         results = self.state.last_join_results
         from hypervisor_tpu.state import _mkey
 
@@ -189,8 +196,10 @@ class WaveScheduler:
                 now=now,
                 wall_s=wall,
                 status=int(status),
+                newest_submit=newest,
+                wave_record=rec,
             )
-        fd.note_wave("join", n, bucket)
+        fd.note_wave("join", n, bucket, now=now)
 
     def _dispatch_lifecycles(self, tickets: list[Ticket], now: float) -> None:
         if not tickets:
@@ -202,6 +211,7 @@ class WaveScheduler:
         bodies = np.zeros((turns, k, BODY_WORDS), np.uint32)
         for i, t in enumerate(tickets):
             bodies[:, i, :] = t.payload["bodies"]
+        newest = max(t.submitted_at for t in tickets)
         t0 = time.perf_counter()
         slots = self.state.create_sessions_batch(
             [t.payload["session_id"] for t in tickets],
@@ -222,6 +232,7 @@ class WaveScheduler:
             pad_to=(bucket, bucket),
         )
         wall = time.perf_counter() - t0
+        rec = self.state.tracer.last_closed
         status = np.asarray(result.status)
         roots = np.asarray(result.merkle_root)
         for i, t in enumerate(tickets):
@@ -232,8 +243,10 @@ class WaveScheduler:
                 wall_s=wall,
                 status=int(status[i]),
                 result={"merkle_root": roots[i].tolist()},
+                newest_submit=newest,
+                wave_record=rec,
             )
-        fd.note_wave("lifecycle", k, bucket)
+        fd.note_wave("lifecycle", k, bucket, now=now)
 
     def _lifecycle_config(self):
         from hypervisor_tpu.models import SessionConfig
@@ -245,6 +258,7 @@ class WaveScheduler:
             return
         fd = self.front_door
         n = len(tickets)
+        newest = max(t.submitted_at for t in tickets)
         t0 = time.perf_counter()
         result = self.state.check_actions_wave(
             [t.payload["slot"] for t in tickets],
@@ -256,6 +270,7 @@ class WaveScheduler:
             now=now,
         )
         wall = time.perf_counter() - t0
+        rec = self.state.tracer.last_closed
         verdict = np.asarray(result.verdict)
         for i, t in enumerate(tickets):
             fd.resolve(
@@ -264,10 +279,12 @@ class WaveScheduler:
                 now=now,
                 wall_s=wall,
                 status=int(np.asarray(result.ring_status)[i]),
+                newest_submit=newest,
+                wave_record=rec,
             )
         # The gateway pads itself to the next power of two.
         bucket = max(1, 1 << max(0, (n - 1).bit_length()))
-        fd.note_wave("action", n, bucket)
+        fd.note_wave("action", n, bucket, now=now)
 
     def _dispatch_terminations(self, tickets: list[Ticket], now: float) -> None:
         if not tickets:
@@ -281,11 +298,13 @@ class WaveScheduler:
         slots = list(seen)
         k = len(slots)
         bucket = self.bucket_for(k)
+        newest = max(t.submitted_at for t in tickets)
         t0 = time.perf_counter()
         roots = self.state.terminate_sessions(
             slots, now=now, pad_to=bucket, pad_slot=fd.park_slot(now)
         )
         wall = time.perf_counter() - t0
+        rec = self.state.tracer.last_closed
         for i, slot in enumerate(slots):
             for t in seen[slot]:
                 fd.resolve(
@@ -294,8 +313,10 @@ class WaveScheduler:
                     now=now,
                     wall_s=wall,
                     result={"merkle_root": roots[i].tolist()},
+                    newest_submit=newest,
+                    wave_record=rec,
                 )
-        fd.note_wave("terminate", k, bucket)
+        fd.note_wave("terminate", k, bucket, now=now)
 
     def _dispatch_sagas(self, now: float) -> None:
         fd = self.front_door
@@ -313,12 +334,17 @@ class WaveScheduler:
         fd.saga_steps.extend(remaining)
         if not taken:
             return
+        newest = max(t.submitted_at for t in taken)
         t0 = time.perf_counter()
         self.state.saga_round(exec_outcomes=outcomes)
         wall = time.perf_counter() - t0
+        rec = self.state.tracer.last_closed
         for t in taken:
-            fd.resolve(t, ok=True, now=now, wall_s=wall)
-        fd.note_wave("saga", len(taken), len(taken))
+            fd.resolve(
+                t, ok=True, now=now, wall_s=wall,
+                newest_submit=newest, wave_record=rec,
+            )
+        fd.note_wave("saga", len(taken), len(taken), now=now)
 
     # ── warmup ───────────────────────────────────────────────────────
 

@@ -3431,8 +3431,20 @@ class HypervisorState:
         return snap
 
     def metrics_prometheus(self) -> str:
-        """Prometheus text exposition of the merged metrics plane."""
-        return self.metrics_snapshot().to_prometheus()
+        """Prometheus text exposition of the merged metrics plane.
+
+        With a serving front door attached, the attribution plane's
+        exemplar COMMENT lines ride along (`# EXEMPLAR ...` — 0.0.4
+        parsers skip comments): each populated latency bucket names the
+        most recent ticket's CausalTraceId and its wave's trace id, the
+        `/metrics` -> `/trace/{session}` join."""
+        text = self.metrics_snapshot().to_prometheus()
+        serving = self.serving
+        if serving is not None and getattr(serving, "attribution", None):
+            lines = serving.attribution.exemplar_lines()
+            if lines:
+                text += "\n".join(lines) + "\n"
+        return text
 
     # ── health plane ─────────────────────────────────────────────────
 
@@ -3485,6 +3497,10 @@ class HypervisorState:
             # depth/backpressure, shed rates, deadline misses, wave
             # cadence and bucket fill.
             "serving": self.serving_summary(),
+            # SLO/attribution panel (hv_top renders this block): burn
+            # states per class + critical-path decomposition quantiles
+            # — host-plane only, no extra device work in this drain.
+            "slo": self.slo_summary(),
         }
 
     def memory_summary(self) -> dict:
@@ -3517,6 +3533,24 @@ class HypervisorState:
         if self.serving is not None:
             return self.serving.summary()
         return {"enabled": False}
+
+    def slo_summary(self) -> dict:
+        """The `GET /debug/slo` core payload: per-class burn-rate
+        states, objectives, alert log, critical-path decomposition
+        quantiles, and the live Retry-After hints — all host-plane
+        (no device round-trip; the trace-joined phase shares are the
+        endpoint's one optional drain, added by the API handler)."""
+        serving = self.serving
+        if serving is None or getattr(serving, "slo", None) is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            **serving.slo.summary(),
+            "attribution": serving.attribution.summary(),
+            "retry_after_live_s": {
+                q: serving.retry_after_for(q) for q in serving._queues
+            },
+        }
 
     def integrity_summary(self) -> dict:
         """The `GET /debug/integrity` payload: sanitizer cadence,

@@ -53,6 +53,15 @@
 #      recompiles (the closed-bucket contract), and replay the same
 #      trace + seed to identical admission/shed decisions and chain
 #      heads,
+#   6g. a latency-observatory gate (round 14) — a seeded short soak
+#      with attribution armed: every resolved ticket's critical-path
+#      decomposition (queue_wait + pad_wait + wave_wall) must SUM to
+#      its measured end-to-end latency within tolerance, the warmed
+#      scheduler must hold ZERO post-warmup compiles/recompiles (the
+#      closed-bucket contract survives the observatory), and an
+#      injected deadline-griefing burst must trip an
+#      slo.burn_rate_warning-or-worse alert whose alert log replays
+#      deterministically (same trace + seed => same alert digest),
 #   6f. the hvlint static-analysis gate — both analyzer tiers
 #      (scripts/hvlint.sh): Tier A pure-AST contract rules (WAL
 #      coverage + REPLAY correspondence, per-call HV_* env arming,
@@ -716,6 +725,78 @@ print(
 PY
 soak_rc=$?
 
+echo "── latency-observatory gate (attribution + burn rate) ──"
+JAX_PLATFORMS=cpu python - <<'PY'
+# ISSUE-13 acceptance, smoke-sized: (1) the per-ticket critical-path
+# decomposition partitions the measured latency (sum invariant), with
+# the observatory armed the warmed scheduler still holds ZERO
+# post-warmup compiles/recompiles, and /metrics exemplars link tail
+# buckets to CausalTraceIds; (2) a deadline-griefing burst (deadlines
+# the cpu wave walls cannot meet) trips a burn-rate alert, and the
+# alert log replays to an identical digest on the same trace + seed.
+from hypervisor_tpu.serving import (
+    ServingConfig, WorkloadSpec, generate_trace, run_soak,
+)
+
+spec = WorkloadSpec(seed=14, rate_hz=100.0, duration_s=0.5)
+trace = generate_trace(spec)
+cfg = ServingConfig(
+    join_deadline_s=0.25, action_deadline_s=0.25,
+    lifecycle_deadline_s=0.4, terminate_deadline_s=0.5,
+    saga_deadline_s=0.25,
+)
+rep = run_soak(spec, trace=trace, serving_config=cfg, tick_s=0.02,
+               slo_p99_ms=5000.0)
+attr = rep["latency_attribution"]
+assert rep["served"] > 0, "observatory soak served nothing"
+assert attr["tickets"] == rep["served"], (
+    f"attribution folded {attr['tickets']} tickets of "
+    f"{rep['served']} served"
+)
+assert attr["max_sum_error_ms"] <= 0.01, (
+    f"decomposition sum error {attr['max_sum_error_ms']} ms: "
+    "queue_wait + pad_wait + wave_wall must partition the latency"
+)
+shares = attr["phase_shares"]
+assert shares is not None and abs(sum(shares.values()) - 1.0) < 1e-6, (
+    f"wave-phase shares do not partition the wall: {shares}"
+)
+assert attr["exemplar_coverage"] > 0.0, "no /metrics exemplars retained"
+assert rep["compiles_after_warmup"] == 0, (
+    f"attribution armed: {rep['compiles_after_warmup']} new programs"
+)
+assert rep["recompiles_after_warmup"] == 0, (
+    f"attribution armed: {rep['recompiles_after_warmup']} recompiles"
+)
+
+# Deadline-griefing burst: deadlines far below the cpu wave walls force
+# budget burn; the engine must alert (warning or critical — the drill
+# only pins that the plane FIRES and replays).
+grief = ServingConfig(
+    join_deadline_s=0.001, action_deadline_s=0.001,
+    lifecycle_deadline_s=0.001, terminate_deadline_s=0.001,
+    saga_deadline_s=0.001, slo_min_events=8,
+)
+g1 = run_soak(spec, trace=trace, serving_config=grief, tick_s=0.02,
+              slo_p99_ms=5000.0)
+alerts1 = g1["slo"]["alerts"]
+assert alerts1.get("warning", 0) + alerts1.get("critical", 0) > 0, (
+    f"deadline-griefing burst tripped no burn-rate alert: {alerts1}"
+)
+g2 = run_soak(spec, trace=trace, serving_config=grief, tick_s=0.02,
+              slo_p99_ms=5000.0)
+assert g1["slo"]["alert_digest"] == g2["slo"]["alert_digest"], (
+    "burn-rate alert log not replay-deterministic"
+)
+print(
+    f"latency observatory OK: {attr['tickets']} tickets decomposed "
+    f"(max sum err {attr['max_sum_error_ms']} ms), exemplar coverage "
+    f"{attr['exemplar_coverage']}, zero post-warmup recompiles armed; "
+    f"griefing burst tripped {alerts1} (digest replayed)"
+)
+PY
+observatory_rc=$?
+
 echo "── hvlint static-analysis gate ──"
 # The contract analyzer (ISSUE 12): Tier A pure-AST rules (WAL
 # coverage, env arming, lock discipline, append-only registries, twin
@@ -781,6 +862,10 @@ fi
 if [ "$soak_rc" -ne 0 ]; then
     echo "serving soak smoke gate FAILED (rc=$soak_rc)" >&2
     exit "$soak_rc"
+fi
+if [ "$observatory_rc" -ne 0 ]; then
+    echo "latency-observatory gate FAILED (rc=$observatory_rc)" >&2
+    exit "$observatory_rc"
 fi
 if [ "$hvlint_rc" -ne 0 ]; then
     echo "hvlint static-analysis gate FAILED (rc=$hvlint_rc)" >&2

@@ -188,12 +188,13 @@ class TestHTTPTransport:
         # /debug/memory, /debug/compiles), the resilience plane
         # (/debug/resilience), the integrity plane
         # (/debug/integrity), and the serving front door
-        # (/debug/serving, the batched join-wave, the NDJSON stream):
-        # 41 routes.
-        assert len(ROUTES) == 41
+        # (/debug/serving, the batched join-wave, the NDJSON stream),
+        # and the latency observatory (/debug/slo): 42 routes.
+        assert len(ROUTES) == 42
         assert any(path == "/debug/resilience" for _, path, _, _ in ROUTES)
         assert any(path == "/debug/integrity" for _, path, _, _ in ROUTES)
         assert any(path == "/debug/serving" for _, path, _, _ in ROUTES)
+        assert any(path == "/debug/slo" for _, path, _, _ in ROUTES)
         assert any(
             path == "/api/v1/sessions/{session_id}/join-wave"
             for _, path, _, _ in ROUTES
@@ -695,4 +696,162 @@ class TestServingEndpoints:
         except urllib.error.HTTPError as e:
             assert e.code == 400
         finally:
+            server.stop()
+
+    def test_http_stream_edge_query_values(self):
+        """frames=0 clamps to one frame (never an empty/endless body)
+        and a negative interval clamps to no pause — neither hangs nor
+        errors, on the stdlib transport."""
+        server = HypervisorHTTPServer().start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with urllib.request.urlopen(
+                f"{base}/api/v1/serving/stream?frames=0&interval=-5",
+                timeout=10,
+            ) as resp:
+                assert resp.status == 200
+                frames = [
+                    json.loads(line)
+                    for line in resp.read().decode().strip().splitlines()
+                ]
+            assert [f["frame"] for f in frames] == [0]
+            with urllib.request.urlopen(
+                f"{base}/api/v1/serving/stream?frames=-3", timeout=10
+            ) as resp:
+                body = resp.read().decode().strip()
+            assert len(body.splitlines()) == 1
+        finally:
+            server.stop()
+
+    async def test_service_stream_edge_query_values(self, svc):
+        """Service-level twin of the edge-value clamps (the path the
+        fastapi transport shares)."""
+        out = await svc.serving_stream(frames=0, interval=-1.0)
+        frames = list(out.frames)
+        assert len(frames) == 1 and frames[0]["frame"] == 0
+        out = await svc.serving_stream(frames=20_000, interval=None)
+        # Upper clamp holds too (no unbounded stream request).
+        n = sum(1 for _ in out.frames)
+        assert n == 10_000
+
+    def test_http_stream_client_disconnect_mid_frame(self):
+        """A client that drops the connection mid-stream must not kill
+        the handler thread or wedge the server: the next request on a
+        fresh connection succeeds."""
+        import socket
+
+        server = HypervisorHTTPServer().start()
+        try:
+            raw = socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            )
+            raw.sendall(
+                b"GET /api/v1/serving/stream?frames=50&interval=0.05 "
+                b"HTTP/1.1\r\nHost: x\r\n\r\n"
+            )
+            # Read just the first chunk, then hang up mid-stream.
+            raw.recv(512)
+            raw.close()
+            # The server must still serve (BrokenPipe swallowed).
+            import time as _time
+
+            _time.sleep(0.2)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/health", timeout=10
+            ) as resp:
+                assert json.loads(resp.read())["status"] == "ok"
+        finally:
+            server.stop()
+
+    def test_fastapi_stream_edge_query_values(self):
+        fastapi = pytest.importorskip("fastapi")  # noqa: F841
+        from fastapi.testclient import TestClient
+
+        from hypervisor_tpu.api.server import create_app
+
+        client = TestClient(create_app())
+        resp = client.get("/api/v1/serving/stream?frames=0&interval=-2")
+        assert resp.status_code == 200
+        lines = resp.text.strip().splitlines()
+        assert len(lines) == 1
+        assert json.loads(lines[0])["frame"] == 0
+        resp = client.get("/api/v1/serving/stream?frames=bogus")
+        assert resp.status_code == 400
+
+    async def test_debug_slo_payload(self, svc):
+        out = await svc.debug_slo()
+        assert out == {"enabled": False}
+        svc.hv.attach_front_door()
+        fd = svc.hv.front_door
+        fd.submit_lifecycle("slo:api", "did:slo:api", 0.8, now=0.0)
+        svc.hv.serving_scheduler.drain(now=0.5)
+        out = await svc.debug_slo()
+        assert out["enabled"]
+        assert set(out["classes"]) == {
+            "join", "action", "lifecycle", "terminate", "saga",
+        }
+        assert out["attribution"]["tickets"] >= 1
+        assert out["attribution"]["max_sum_error_ms"] < 1e-6
+        assert out["phase_shares"] is not None
+        assert out["recent_paths"] and out["recent_paths"][-1]["trace_id"]
+        assert "alert_digest" in out
+
+    def test_http_debug_slo_route(self):
+        server = HypervisorHTTPServer().start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with urllib.request.urlopen(f"{base}/debug/slo") as resp:
+                assert resp.status == 200
+                assert json.loads(resp.read()) == {"enabled": False}
+        finally:
+            server.stop()
+
+    def test_http_429_retry_after_uses_live_drain_rate(self):
+        """The Retry-After header reflects the LIVE hint (depth x
+        observed drain rate), not the static constant — the round-14
+        bugfix regression pin (stdlib transport)."""
+        from hypervisor_tpu.resilience.policy import DegradedPolicy
+
+        server = HypervisorHTTPServer().start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            data = json.dumps({"creator_did": "did:admin"}).encode()
+            req = urllib.request.Request(
+                f"{base}/api/v1/sessions", data=data, method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                sid = json.loads(resp.read())["session_id"]
+            fd = server.service.hv.attach_front_door()
+            # Static fallback says 30 s; the warmed drain rate says the
+            # (empty) join queue clears in well under a second.
+            object.__setattr__(fd.config, "retry_after_s", 30.0)
+            for i in range(1, 6):
+                fd._note_drain("join", lanes=8, now=float(i) * 0.1)
+            live = fd.retry_after_for("join")
+            assert live < 30.0
+            server.service.hv.state.degraded_policy = DegradedPolicy(
+                reason="live drill"
+            )
+            req = urllib.request.Request(
+                f"{base}/api/v1/sessions/{sid}/join",
+                data=json.dumps(
+                    {"agent_did": "did:x", "sigma_raw": 0.9}
+                ).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req)
+                raise AssertionError("expected HTTP 429")
+            except urllib.error.HTTPError as e:
+                assert e.code == 429
+                import math
+
+                assert int(e.headers["Retry-After"]) == max(
+                    1, math.ceil(live)
+                )
+                assert int(e.headers["Retry-After"]) < 30
+        finally:
+            server.service.hv.state.degraded_policy = None
             server.stop()

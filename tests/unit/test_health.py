@@ -622,7 +622,9 @@ def _suite_report(
             else None
         ),
         # Rounds >= regression.SOAK_ROW_SINCE must carry the serving
-        # soak row (round-11 presence gate).
+        # soak row (round-11 presence gate); from ATTR_ROW_SINCE (14)
+        # the row must also carry the latency-observatory fields
+        # (per-class spread + attribution block, ISSUE 13).
         "soak": {
             "seed": 11,
             "arrival_rate_hz": 150.0,
@@ -636,6 +638,35 @@ def _suite_report(
             "deadline_misses": 3,
             "recompiles_after_warmup": 0,
             "invariant_violations": 0,
+            **(
+                {
+                    "latency_ms_by_kind": {
+                        "join": {"n": 80, "p50": 150.0, "p99": 400.0},
+                        "action": {"n": 90, "p50": 180.0, "p99": 450.0},
+                        "lifecycle": {"n": 60, "p50": 500.0, "p99": 700.0},
+                        "terminate": {"n": 40, "p50": 300.0, "p99": 500.0},
+                        "saga": {"n": 20, "p50": 200.0, "p99": 350.0},
+                    },
+                    "latency_attribution": {
+                        "tickets": 290,
+                        "max_sum_error_ms": 0.0,
+                        "exemplar_coverage": 1.0,
+                        "phase_shares": {
+                            "admission": 0.05, "fsm_saga": 0.14,
+                            "audit": 0.05, "gateway": 0.0,
+                            "epilogue": 0.76,
+                        },
+                        "classes": {},
+                    },
+                    "slo": {
+                        "alerts": {
+                            "warning": 0, "critical": 0, "recovered": 0,
+                        },
+                    },
+                }
+                if round_no >= 14
+                else {}
+            ),
         },
         # Rounds >= regression.STATIC_ROW_SINCE must carry the hvlint
         # static-analysis row (round-13 presence gate, ISSUE 12).
@@ -821,6 +852,43 @@ class TestRegressionHarness:
         self._write(tmp_path, 11, doc)
         rc = regression.main(["--root", str(tmp_path), "--quiet"])
         assert rc == 1
+
+    def test_attribution_fields_required_from_round_14(self, tmp_path):
+        # ISSUE 13: from round 14 the soak row must carry the per-class
+        # latency spread AND the critical-path attribution block —
+        # dropping either regresses the observability coverage.
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 13, _suite_report(13, {"full_governance_pipeline": 10.0})
+        )
+        clean = _suite_report(14, {"full_governance_pipeline": 10.0})
+        self._write(tmp_path, 14, clean)
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
+        for field in ("latency_ms_by_kind", "latency_attribution"):
+            doc = _suite_report(14, {"full_governance_pipeline": 10.0})
+            del doc["soak"][field]
+            self._write(tmp_path, 14, doc)
+            assert (
+                regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+            ), f"missing soak.{field} must fail the gate"
+        # A round-13 row WITHOUT the fields stays exempt.
+        (tmp_path / "BENCH_r14.json").unlink()
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
+
+    def test_attribution_sum_error_gated(self, tmp_path):
+        # The decomposition must PARTITION the measured latency: a sum
+        # error above tolerance means a component was dropped or
+        # double-counted — broken attribution fails the round.
+        from benchmarks import regression
+
+        doc = _suite_report(14, {"full_governance_pipeline": 10.0})
+        doc["soak"]["latency_attribution"]["max_sum_error_ms"] = 5.0
+        self._write(tmp_path, 14, doc)
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        doc["soak"]["latency_attribution"]["max_sum_error_ms"] = 0.001
+        self._write(tmp_path, 14, doc)
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
 
     def test_soak_gates_slo_goodput_and_hard_zeros(self, tmp_path):
         # The soak row gates: p99 vs its own stated SLO, the goodput

@@ -1,0 +1,517 @@
+"""The latency observatory: critical-path attribution + SLO burn rate.
+
+The load-bearing pins (ISSUE 13 acceptance):
+  * every resolved ticket's decomposition (queue_wait + pad_wait +
+    wave_wall) SUMS to its measured end-to-end latency (a partition,
+    not an estimate) and the wave-phase shares partition the wall,
+  * a warmed scheduler with attribution armed holds ZERO post-warmup
+    recompiles (the closed-bucket contract survives the observatory),
+  * a deadline-griefing burst trips the burn-rate ladder and the
+    supervisor enters degraded mode from the SLO signal BEFORE any
+    ingestion queue hard-fills,
+  * the alert log replays deterministically on the virtual clock,
+  * `Refusal.retry_after_s` derives from live depth x observed drain
+    rate (falling back to the constant when unwarmed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.observability import metrics as mp
+from hypervisor_tpu.observability.attribution import (
+    HV_PHASES,
+    CriticalPathAggregator,
+    TicketPath,
+)
+from hypervisor_tpu.observability.event_bus import EventType
+from hypervisor_tpu.observability.slo import (
+    CRITICAL,
+    OK,
+    WARNING,
+    SLOEngine,
+    SLOObjective,
+)
+from hypervisor_tpu.serving import FrontDoor, ServingConfig, WaveScheduler
+from hypervisor_tpu.state import HypervisorState
+
+
+def small_state(**caps) -> HypervisorState:
+    defaults = dict(
+        max_agents=512,
+        max_sessions=2048,
+        max_vouch_edges=1024,
+        max_sagas=256,
+        delta_log_capacity=4096,
+        event_log_capacity=1024,
+        trace_log_capacity=1024,
+    )
+    defaults.update(caps)
+    cfg = dataclasses.replace(
+        DEFAULT_CONFIG,
+        capacity=dataclasses.replace(DEFAULT_CONFIG.capacity, **defaults),
+    )
+    return HypervisorState(cfg)
+
+
+def _objectives(target=0.99, deadline=0.1):
+    return {
+        q: SLOObjective(queue=q, target=target, deadline_s=deadline)
+        for q in ("join", "lifecycle")
+    }
+
+
+# ── the burn-rate engine (pure host math, no jax) ────────────────────
+
+
+class TestSLOEngine:
+    def test_burn_rate_is_bad_fraction_over_budget(self):
+        eng = SLOEngine(_objectives(target=0.9), min_events=1)
+        for i in range(10):
+            eng.note("join", t=float(i), good=i >= 5)  # 5 bad of 10
+        fast, slow, long_ = eng.burn_rates("join", now=10.0)
+        # bad fraction 0.5 over a 0.1 budget = burn rate 5 on every
+        # window (all events inside all windows).
+        assert fast == pytest.approx(5.0)
+        assert slow == pytest.approx(5.0)
+        assert long_ == pytest.approx(5.0)
+
+    def test_windows_evict_old_events(self):
+        eng = SLOEngine(
+            _objectives(target=0.9),
+            fast_window_s=10.0, slow_window_s=100.0, long_window_s=1000.0,
+            min_events=1,
+        )
+        for i in range(10):
+            eng.note("join", t=float(i), good=False)  # old burst
+        for i in range(10):
+            eng.note("join", t=500.0 + i, good=True)  # clean recent
+        fast, slow, long_ = eng.burn_rates("join", now=510.0)
+        assert fast == 0.0  # the burst left the fast window
+        assert slow == 0.0
+        assert long_ == pytest.approx(5.0)  # still visible long-term
+
+    def test_transitions_warning_critical_recovered(self):
+        fired_kinds = []
+        eng = SLOEngine(
+            _objectives(target=0.9),
+            fast_window_s=10.0, slow_window_s=20.0, long_window_s=40.0,
+            critical_burn=8.0, warning_burn=4.0, min_events=4,
+            emit=lambda kind, payload: fired_kinds.append(kind),
+        )
+        # Warning-level burn: bad fraction 0.5 -> burn 5 (>=4, <8).
+        for i in range(8):
+            eng.note("join", t=float(i) * 0.1, good=i % 2 == 0)
+        alerts = eng.evaluate(now=1.0)
+        assert [a.severity for a in alerts] == [WARNING]
+        assert eng.state_of("join") == WARNING
+        # Escalate: all-bad burst -> burn 10 on both windows.
+        for i in range(30):
+            eng.note("join", t=1.0 + i * 0.1, good=False)
+        alerts = eng.evaluate(now=4.0)
+        assert [a.severity for a in alerts] == [CRITICAL]
+        # No re-alert while the state holds.
+        assert eng.evaluate(now=4.5) == []
+        # Recovery: the windows drain past the burst.
+        for i in range(20):
+            eng.note("join", t=100.0 + i * 0.1, good=True)
+        alerts = eng.evaluate(now=103.0)
+        assert [a.severity for a in alerts] == ["recovered"]
+        assert eng.state_of("join") == OK
+        assert fired_kinds == [
+            "slo_burn_warning", "slo_burn_critical", "slo_recovered",
+        ]
+        assert eng.alert_counts == {
+            "warning": 1, "critical": 1, "recovered": 1,
+        }
+
+    def test_min_events_guard_keeps_cold_classes_quiet(self):
+        eng = SLOEngine(_objectives(target=0.99), min_events=24)
+        for i in range(10):
+            eng.note("join", t=float(i), good=False)  # 100% bad, but cold
+        assert eng.evaluate(now=10.0) == []
+        assert eng.state_of("join") == OK
+
+    def test_alert_log_replays_deterministically(self):
+        def drive():
+            eng = SLOEngine(
+                _objectives(target=0.9),
+                fast_window_s=10.0, slow_window_s=20.0, long_window_s=40.0,
+                critical_burn=8.0, warning_burn=4.0, min_events=4,
+            )
+            for i in range(40):
+                eng.note("join", t=i * 0.25, good=i % 3 == 0)
+                if i % 5 == 0:
+                    eng.evaluate(now=i * 0.25)
+            eng.evaluate(now=10.0)
+            return eng.alert_digest(), eng.recent_alerts()
+
+        d1, a1 = drive()
+        d2, a2 = drive()
+        assert d1 == d2
+        assert a1 == a2
+        assert a1, "the drive must actually alert for the pin to bite"
+
+    def test_backoff_multiplier_follows_state(self):
+        eng = SLOEngine(_objectives(), min_events=1)
+        assert eng.backoff_multiplier("join") == 1.0
+        eng._classes["join"].state = WARNING
+        assert eng.backoff_multiplier("join") == 2.0
+        eng._classes["join"].state = CRITICAL
+        assert eng.backoff_multiplier("join") == 4.0
+
+    def test_slo_event_types_are_appended_at_the_tail(self):
+        # Wire-format discipline: the new codes extend the enum, they
+        # never renumber existing device-log rows (hvlint HVA004 pins
+        # the committed baseline; this pins the tail order).
+        tail = list(EventType)[-3:]
+        assert tail == [
+            EventType.SLO_BURN_RATE_WARNING,
+            EventType.SLO_BURN_RATE_CRITICAL,
+            EventType.SLO_RECOVERED,
+        ]
+
+
+# ── the attribution aggregator (host math; device only via serving) ──
+
+
+def _path(kind="join", q=0.1, p=0.02, w=0.05, trace_id="t/s") -> TicketPath:
+    return TicketPath(
+        kind=kind,
+        trace_id=trace_id,
+        wave_seq=7,
+        wave_trace_id="w/s",
+        submitted_at=0.0,
+        resolved_at=q + p,
+        queue_wait_s=q,
+        pad_wait_s=p,
+        wave_wall_s=w,
+        latency_s=q + p + w,
+        deadline_s=0.25,
+        deadline_missed=False,
+        ok=True,
+    )
+
+
+class TestAggregator:
+    def test_observe_feeds_histograms_and_exemplars(self):
+        from hypervisor_tpu.observability.metrics import Metrics
+
+        metrics = Metrics()
+        agg = CriticalPathAggregator(metrics)
+        agg.observe(_path())
+        agg.observe(_path(q=0.2, trace_id="t2/s2"))
+        summary = agg.summary()
+        assert summary["tickets"] == 2
+        assert summary["classes"]["join"]["queue_wait"]["n"] == 2
+        assert summary["max_sum_error_ms"] == 0.0
+        assert summary["exemplar_coverage"] == 1.0
+        lines = agg.exemplar_lines()
+        assert lines and all(line.startswith("# EXEMPLAR") for line in lines)
+        assert any('trace_id="t2/s2"' in line for line in lines)
+
+    def test_sum_error_is_tracked(self):
+        from hypervisor_tpu.observability.metrics import Metrics
+
+        agg = CriticalPathAggregator(Metrics())
+        bad = dataclasses.replace(_path(), latency_s=1.0)  # broken partition
+        agg.observe(bad)
+        assert agg.summary()["max_sum_error_ms"] > 100.0
+
+
+# ── serving integration: decomposition on real waves ─────────────────
+
+
+@pytest.fixture
+def observatory():
+    state = small_state()
+    front = FrontDoor(
+        state,
+        ServingConfig(buckets=(2, 4), slo_min_events=4),
+    )
+    return state, front, WaveScheduler(front)
+
+
+class TestCriticalPathOnWaves:
+    def test_decomposition_partitions_measured_latency(self, observatory):
+        state, front, sched = observatory
+        tickets = []
+        for i in range(4):
+            out = front.submit_lifecycle(
+                f"slo:lc{i}", f"did:slo:lc{i}", 0.8, now=0.01 * i
+            )
+            assert not out.refused
+            tickets.append(out)
+        sched.drain(now=1.0)
+        assert all(t.done for t in tickets)
+        for t in tickets:
+            total = t.queue_wait_s + t.pad_wait_s + t.wave_wall_s
+            assert total == pytest.approx(t.latency_s, abs=1e-9)
+            assert t.trace is not None
+            assert t.wave_trace_id is not None
+            assert t.wave_seq is not None
+        # pad_wait is the dispatch tail past the NEWEST submit — every
+        # ticket in the wave shares it, and the newest ticket's whole
+        # queue time IS pad (arrivals stopped at its submit).
+        newest = max(t.submitted_at for t in tickets[:2])
+        in_first_wave = [t for t in tickets if t.submitted_at <= newest]
+        pads = {round(t.pad_wait_s, 9) for t in in_first_wave[:2]}
+        assert len(pads) == 1
+        # Aggregator folded every resolved ticket.
+        assert front.attribution.summary()["tickets"] == len(tickets)
+        assert front.attribution.summary()["max_sum_error_ms"] < 1e-6
+
+    def test_ticket_joins_the_wave_trace(self, observatory):
+        state, front, sched = observatory
+        out = front.submit_lifecycle("slo:join", "did:slo:join", 0.8, now=0.0)
+        sched.drain(now=0.5)
+        record = state.tracer._waves.get(out.wave_seq)
+        assert record is not None
+        assert record.trace.full_id == out.wave_trace_id
+        assert record.stage == "governance_wave"
+
+    def test_phase_shares_partition_the_wall(self, observatory):
+        state, front, sched = observatory
+        for i in range(3):
+            front.submit_lifecycle(f"slo:ph{i}", f"did:slo:ph{i}", 0.8,
+                                   now=0.0)
+        sched.drain(now=0.5)
+        shares = front.attribution.phase_shares(state.tracer)
+        assert shares is not None
+        assert set(shares) == set(HV_PHASES)
+        assert sum(shares.values()) == pytest.approx(1.0, abs=1e-9)
+        # Per-ticket phase decomposition sums to the wall exactly.
+        path = front.attribution._recent[-1]
+        phases = front.attribution.phase_decomposition(path, shares)
+        # Each phase rounds to 6 decimals for the payload, so the sum
+        # carries up to len(HV_PHASES)/2 µs of rounding dust.
+        assert sum(phases.values()) == pytest.approx(
+            path.wave_wall_s * 1e3, abs=1e-3
+        )
+
+    def test_exemplars_ride_the_prometheus_exposition(self, observatory):
+        state, front, sched = observatory
+        front.submit_lifecycle("slo:ex", "did:slo:ex", 0.8, now=0.0)
+        sched.drain(now=0.5)
+        text = state.metrics_prometheus()
+        assert "# EXEMPLAR hv_serving_latency_us_bucket" in text
+        assert "hv_serving_attr_latency_us" in text
+        # Comment lines stay format-0.0.4 parseable: every non-comment
+        # line still splits name-value.
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                assert " " in line
+
+    def test_slo_summary_and_debug_payload_shape(self, observatory):
+        state, front, sched = observatory
+        bare = small_state()
+        assert bare.slo_summary() == {"enabled": False}
+        front.submit_lifecycle("slo:sum", "did:slo:sum", 0.8, now=0.0)
+        sched.drain(now=0.5)
+        out = state.slo_summary()
+        assert out["enabled"]
+        assert set(out["classes"]) == set(mp.SERVING_QUEUES)
+        assert "attribution" in out and "alert_digest" in out
+        assert set(out["retry_after_live_s"]) == set(mp.SERVING_QUEUES)
+        health = state.health_summary()
+        assert health["slo"]["enabled"]
+
+    def test_debug_payload_is_host_plane_clean(self, observatory):
+        """The observatory's debug payloads serialize with stdlib json:
+        lane statuses arrive as numpy bools and host_quantile hands back
+        numpy scalars — both must be coerced at the source (the stdlib
+        transport's json.dumps rejects np.bool_, observed live)."""
+        import json
+
+        state, front, sched = observatory
+        for i in range(3):
+            front.submit_lifecycle(f"slo:js{i}", f"did:slo:js{i}", 0.8,
+                                   now=0.0)
+        sched.drain(now=0.5)
+        payload = {
+            **state.slo_summary(),
+            "phase_shares": front.attribution.phase_shares(state.tracer),
+            "recent_paths": front.attribution.recent_paths(16),
+            "exemplar_rows": front.attribution.exemplars(),
+        }
+        json.dumps(payload)  # raises on any numpy leak
+        for path in payload["recent_paths"]:
+            assert type(path["ok"]) is bool
+            assert type(path["deadline_missed"]) is bool
+
+
+# ── dynamic Retry-After (the PR 10 bugfix) ───────────────────────────
+
+
+class TestDynamicRetryAfter:
+    def test_unwarmed_falls_back_to_the_constant(self, observatory):
+        state, front, sched = observatory
+        assert front.retry_after_for("join") == front.config.retry_after_s
+
+    def test_draining_queue_beats_the_static_constant(self):
+        state = small_state()
+        front = FrontDoor(state, ServingConfig(buckets=(2, 4)))
+        # Constant says 4 s; the observed drain rate says the queue
+        # clears in well under a second.
+        object.__setattr__(front.config, "retry_after_s", 4.0)
+        for i in range(1, 6):
+            front._note_drain("join", lanes=4, now=float(i) * 0.1)
+        assert front._drain_waves["join"] >= 3
+        shallow = front.retry_after_for("join")
+        assert shallow < front.config.retry_after_s
+        # Depth scales the hint: a deeper queue promises a longer wait.
+        from hypervisor_tpu.serving.front_door import Ticket
+
+        for i in range(2):
+            front.joins.append(
+                Ticket(kind="join", submitted_at=0.0, deadline_s=1.0,
+                       payload={})
+            )
+        assert front.retry_after_for("join") > shallow
+
+    def test_burning_class_scales_the_hint(self):
+        state = small_state()
+        front = FrontDoor(state, ServingConfig(buckets=(2, 4)))
+        base = front.retry_after_for("join")
+        front.slo._classes["join"].state = CRITICAL
+        assert front.retry_after_for("join") == pytest.approx(base * 4.0)
+
+    def test_refusals_carry_the_live_hint(self):
+        state = small_state()
+        front = FrontDoor(state, ServingConfig(buckets=(2,)))
+        # Fill the join queue (depth == max bucket == 2).
+        from hypervisor_tpu.models import SessionConfig
+
+        sid = state.create_session(
+            "slo:rq", SessionConfig(min_sigma_eff=0.0), now=0.0
+        )
+        for i in range(2):
+            out = front.submit_join(sid, f"did:rq{i}", 0.8, now=0.0)
+            assert not out.refused
+        refusal = front.submit_join(sid, "did:rq-full", 0.8, now=0.0)
+        assert refusal.refused and refusal.kind == "queue_full"
+        assert refusal.retry_after_s == front.config.retry_after_s  # unwarmed
+        # Overload sheds burn SLO budget; duplicates do not.
+        assert front.slo._classes["join"].bad_total == 1
+
+
+# ── the supervisor acts on the burn signal ───────────────────────────
+
+
+class TestSupervisorSLODegrade:
+    def _griefed_front(self, state, min_events=4):
+        # Deadline-griefing posture: deadlines no cpu wave can meet, a
+        # tiny min-events guard so the drill trips fast.
+        return FrontDoor(
+            state,
+            ServingConfig(
+                buckets=(2,),
+                join_deadline_s=1e-6,
+                action_deadline_s=1e-6,
+                lifecycle_deadline_s=1e-6,
+                terminate_deadline_s=1e-6,
+                saga_deadline_s=1e-6,
+                slo_min_events=min_events,
+            ),
+        )
+
+    def test_critical_burn_flips_degraded_before_queue_fills(self):
+        from hypervisor_tpu.resilience.supervisor import Supervisor
+
+        state = small_state()
+        sup = Supervisor(state, degrade_on_slo_critical=True)
+        front = self._griefed_front(state)
+        sched = WaveScheduler(front)
+        assert state.degraded_policy is None
+        tick = 0
+        while state.degraded_policy is None and tick < 12:
+            out = front.submit_lifecycle(
+                f"slo:grief{tick}", f"did:grief{tick}", 0.8, now=float(tick)
+            )
+            if out.refused:
+                break
+            sched.tick(now=float(tick) + 0.5)
+            tick += 1
+        assert state.degraded_policy is not None, (
+            "critical burn rate never flipped degraded mode"
+        )
+        # The point of the burn signal: the valve closed while the
+        # ingestion queues still had headroom (no hard-fill shed yet).
+        assert front.shed["queue_full"] == 0
+        assert all(
+            len(dq) < front._depths[q] for q, dq in front._queues.items()
+        )
+        assert sup.slo_critical_alerts >= 1
+        assert sup.slo_degraded_entries >= 1
+        summary = sup.summary()
+        assert summary["pressure"]["slo_critical_alerts"] >= 1
+        assert summary["thresholds"]["degrade_on_slo_critical"] is True
+        # ... and the NEXT admission-class submit sheds loudly.
+        refusal = front.submit_lifecycle(
+            "slo:after", "did:after", 0.8, now=99.0
+        )
+        assert refusal.refused and refusal.kind == "degraded"
+
+    def test_observe_only_posture_never_degrades(self):
+        from hypervisor_tpu.resilience.supervisor import Supervisor
+
+        state = small_state()
+        sup = Supervisor(state, degrade_on_slo_critical=False)
+        front = self._griefed_front(state)
+        sched = WaveScheduler(front)
+        for tick in range(6):
+            out = front.submit_lifecycle(
+                f"slo:obs{tick}", f"did:obs{tick}", 0.8, now=float(tick)
+            )
+            assert not out.refused
+            sched.tick(now=float(tick) + 0.5)
+        assert state.degraded_policy is None
+        assert sup.slo_critical_alerts >= 1  # seen, not acted on
+
+    def test_alerts_bridge_to_the_event_bus(self):
+        from hypervisor_tpu.core import Hypervisor
+        from hypervisor_tpu.observability import HypervisorEventBus
+
+        hv = Hypervisor(event_bus=HypervisorEventBus())
+        hv.state.health.emit_event(
+            "slo_burn_warning",
+            {"queue": "join", "burn_fast": 20.0, "burn_slow": 18.0},
+        )
+        hv.state.health.emit_event("slo_burn_critical", {"queue": "join"})
+        hv.state.health.emit_event("slo_recovered", {"queue": "join"})
+        for et in (
+            EventType.SLO_BURN_RATE_WARNING,
+            EventType.SLO_BURN_RATE_CRITICAL,
+            EventType.SLO_RECOVERED,
+        ):
+            events = hv.event_bus.query_by_type(et)
+            assert len(events) == 1, et
+        assert events[0].payload["queue"] == "join"
+
+
+# ── zero-recompile contract with the observatory armed ───────────────
+
+
+@pytest.mark.slow
+class TestZeroRecompileArmed:
+    def test_warmed_scheduler_holds_zero_recompiles_with_attribution(self):
+        from hypervisor_tpu.observability import health as health_plane
+
+        state = small_state()
+        front = FrontDoor(state, ServingConfig(buckets=(2, 4)))
+        sched = WaveScheduler(front)
+        sched.warm(now=0.0)
+        baseline = health_plane.compile_summary(last=0)
+        for i in range(24):
+            front.submit_lifecycle(f"slo:z{i}", f"did:z{i}", 0.8,
+                                   now=float(i))
+            sched.tick(now=float(i) + 0.5)
+        sched.drain(now=99.0)
+        after = health_plane.compile_summary(last=0)
+        assert after["compiles"] == baseline["compiles"]
+        assert after["recompiles"] == baseline["recompiles"]
+        assert front.attribution.summary()["tickets"] >= 24

@@ -1124,6 +1124,103 @@ def static_analysis_row(timeout_s: float = 300.0) -> dict | None:
     }
 
 
+def roofline_row(quick: bool) -> dict | None:
+    """Round-15 roofline row (`BENCH_r<NN>.json` "roofline").
+
+    Drives a short DETERMINISTIC-shaped workload on a fresh
+    HypervisorState so the process-global roofline registry
+    (`observability.roofline`) captures THIS process's wave programs at
+    fixed bucket shapes, then distills the modeled-vs-measured join:
+    modeled HBM bytes + FLOPs per program (shape-deterministic — the
+    numbers `regression.py` band-gates from round 15: an accidental
+    de-fusion or donation miss inflates modeled traffic on cpu, no
+    chip needed), achieved-bandwidth fraction and MFU against the
+    measured stage walls, the per-phase byte model with measured wall
+    shares, and the distance-to-the-floor block.
+    """
+    try:
+        from hypervisor_tpu.models import SessionConfig
+        from hypervisor_tpu.observability import roofline
+        from hypervisor_tpu.state import HypervisorState
+
+        rounds = 6 if quick else 16
+        lanes = 16 if quick else 64
+        st = HypervisorState()
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            slots = st.create_sessions_batch(
+                [f"roofline{r}:{i}" for i in range(lanes)],
+                SessionConfig(min_sigma_eff=0.0),
+            )
+            st.run_governance_wave(
+                slots,
+                [f"did:roofline{r}:{i}" for i in range(lanes)],
+                slots.copy(),
+                np.full(lanes, 0.8, np.float32),
+                np.zeros((1, lanes, 16), np.uint32),
+                float(r),
+            )
+            # Standalone entry points so the catalog covers more than
+            # the fused wave: admission (enqueue+flush), the per-action
+            # gateway, and a terminate wave.
+            keep = st.create_sessions_batch(
+                [f"roofline{r}:keep{i}" for i in range(4)],
+                SessionConfig(min_sigma_eff=0.0),
+            )
+            for i, slot in enumerate(keep):
+                st.enqueue_join(
+                    int(slot), f"did:roofline{r}:k{i}", 0.8, now=float(r)
+                )
+            st.flush_joins(now=float(r))
+            st.check_actions_wave(
+                keep, [0] * len(keep), [True] * len(keep),
+                [False] * len(keep), [False] * len(keep),
+                [False] * len(keep), float(r),
+            )
+            st.terminate_sessions(keep, now=float(r) + 0.5)
+            st.metrics_snapshot()  # publish cadence: resolve + join
+        summary = st.roofline_summary()
+        wall_s = time.perf_counter() - t0
+        if not summary.get("enabled"):
+            return None
+        programs = {}
+        for name, row in sorted(summary["programs"].items()):
+            model = row["model"]
+            programs[name] = {
+                "modeled_bytes": model["bytes_accessed"],
+                "modeled_flops": model["flops"],
+                "peak_bytes": model["peak_bytes"],
+                "wall_p50_us": row["wall_p50_us"],
+                "achieved_bw_frac": row["achieved_bw_frac"],
+                "mfu": row["mfu"],
+                "distance": row["distance"],
+                "buckets": len(row["buckets"]),
+            }
+        phases = None
+        if summary.get("phases"):
+            phases = {
+                "program": summary["phases"]["program"],
+                "modeled_bytes": summary["phases"]["modeled_bytes"],
+                "wall_shares": summary["phases"]["wall_shares"],
+            }
+        return {
+            "quick": quick,
+            "rounds": rounds,
+            "lanes_per_round": lanes,
+            "peak_bw_gbs": summary["peaks"]["peak_bw_gbs"],
+            "peak_flops_g": summary["peaks"]["peak_flops_g"],
+            "programs": programs,
+            "phases": phases,
+            "floor": summary["floor"],
+            "worst_program": summary["worst_program"],
+            "captures": summary["captures"],
+            "capture_failures": summary["capture_failures"],
+            "wall_s": round(wall_s, 3),
+        }
+    except Exception:  # noqa: BLE001 — a failed row is omitted, gated
+        return None
+
+
 def _git_commit() -> str | None:
     """Current commit hash, stamped into bench reports so a trajectory
     row names the code it measured; None outside a git checkout."""
@@ -1342,6 +1439,24 @@ def main() -> None:
                     flush=True,
                 )
 
+    roofline_rec = None
+    if args.metrics_out:
+        roofline_rec = roofline_row(args.quick)
+        if not args.json_only:
+            if roofline_rec is None:
+                print("roofline row FAILED (row omitted)", flush=True)
+            else:
+                fl = roofline_rec.get("floor") or {}
+                print(
+                    f"roofline: {len(roofline_rec['programs'])} programs "
+                    f"modeled ({roofline_rec['captures']} captures), wave "
+                    f"floor {fl.get('modeled_floor_us')} µs, measured "
+                    f"{fl.get('measured_p50_us')} µs, distance "
+                    f"{fl.get('distance')}x, worst program "
+                    f"{roofline_rec['worst_program']}",
+                    flush=True,
+                )
+
     static_rec = None
     if args.metrics_out:
         static_rec = static_analysis_row()
@@ -1421,6 +1536,14 @@ def main() -> None:
             # finding / suppression counts — regression.py presence-
             # gates it from round 13 and hard-gates findings == 0.
             "static_analysis": static_rec,
+            # Roofline row (round 15, ISSUE 14): per-program modeled
+            # HBM bytes + FLOPs from the live observatory joined with
+            # measured walls — regression.py presence-gates it from
+            # round 15 and band-gates modeled bytes per program
+            # (HV_BENCH_ROOFLINE_BYTES_TOL): a fusion regression or
+            # donation miss fails the gate on the MODEL, on cpu,
+            # without waiting for the tunnel to heal.
+            "roofline": roofline_rec,
         }
         out_path.write_text(json.dumps(report, indent=2) + "\n")
         if not args.json_only:

@@ -143,6 +143,24 @@ R12_CENSUS_FUSION_FLOOR = 8.7
 #: megakernel coverage even if every other number is fine.
 WAVE_ROW_SINCE = 12
 
+#: The roofline observatory joined the trajectory in round 15
+#: (ISSUE 14): per-program modeled HBM bytes + FLOPs from the live
+#: cost registry (`observability.roofline`), achieved-bandwidth
+#: fraction / MFU against the measured stage walls, the per-phase byte
+#: model, and the distance-to-the-floor block. A suite round from 15
+#: on missing the row regresses the observability coverage.
+ROOFLINE_ROW_SINCE = 15
+
+#: Allowed fractional drift of each program's MODELED HBM bytes vs the
+#: median of comparable prior rounds (`HV_BENCH_ROOFLINE_BYTES_TOL`
+#: overrides). Modeled bytes are deterministic per jax/XLA version and
+#: bucket shape — the band absorbs compiler upgrades, not fusion
+#: regressions or donation misses, which inflate modeled traffic and
+#: fail HERE, on cpu, without waiting for the accelerator tunnel to
+#: heal. Gated both directions: silently SHRINKING traffic is a model
+#: break worth a look too.
+DEFAULT_ROOFLINE_BYTES_TOL = 0.25
+
 
 def census_fusion_floor(round_num: int) -> float:
     """The fusion-ratio floor for a given round: env override, else the
@@ -198,6 +216,7 @@ def parse_round_file(path: Path) -> Optional[dict]:
         donation = doc.get("donation")
         soak = doc.get("soak")
         static = doc.get("static_analysis")
+        roofline = doc.get("roofline")
         row.update(
             format="suite",
             backend=doc.get("backend", "cpu"),
@@ -330,6 +349,32 @@ def parse_round_file(path: Path) -> Optional[dict]:
                     "programs_traced": static.get("programs_traced"),
                 }
                 if isinstance(static, dict)
+                else None
+            ),
+            # Roofline row (round 15, ISSUE 14): per-program modeled
+            # bytes/FLOPs + achieved fractions from the live cost
+            # registry — presence-gated from ROOFLINE_ROW_SINCE and
+            # bytes band-gated per program below.
+            roofline=(
+                {
+                    "quick": roofline.get("quick"),
+                    "programs": {
+                        name: {
+                            "modeled_bytes": p.get("modeled_bytes"),
+                            "modeled_flops": p.get("modeled_flops"),
+                            "achieved_bw_frac": p.get("achieved_bw_frac"),
+                            "mfu": p.get("mfu"),
+                            "wall_p50_us": p.get("wall_p50_us"),
+                        }
+                        for name, p in (
+                            roofline.get("programs") or {}
+                        ).items()
+                    },
+                    "phases": roofline.get("phases"),
+                    "floor": roofline.get("floor"),
+                    "worst_program": roofline.get("worst_program"),
+                }
+                if isinstance(roofline, dict)
                 else None
             ),
         )
@@ -689,6 +734,58 @@ def compare(
         checked.append(entry)
         if static["findings"] != 0:
             regressions.append(entry)
+    # Roofline gates (round 15, ISSUE 14): presence from
+    # ROOFLINE_ROW_SINCE, then each program's MODELED HBM bytes held
+    # to a band around the median of comparable prior rounds — the
+    # model is deterministic per shape, so an accidental de-fusion or
+    # donation miss that inflates traffic fails on the model alone,
+    # on cpu, with no chip attached.
+    roofline = current.get("roofline")
+    if (
+        current.get("format") == "suite"
+        and current["round"] >= ROOFLINE_ROW_SINCE
+        and not roofline
+    ):
+        entry = {
+            "bench": "missing:roofline",
+            "current_per_op_us": 0.0,
+            "baseline_per_op_us": 0.0,
+            "ratio": 0.0,
+        }
+        checked.append(entry)
+        regressions.append(entry)
+    if roofline and roofline.get("programs"):
+        env_tol = os.environ.get("HV_BENCH_ROOFLINE_BYTES_TOL")
+        rtol = float(env_tol) if env_tol else DEFAULT_ROOFLINE_BYTES_TOL
+        # Per-program medians over comparable priors that carried the
+        # row (same backend/quick/format, like every other band here).
+        prior_bytes: dict[str, list[float]] = {}
+        for r in rows:
+            if (
+                r["round"] >= current["round"]
+                or _comparable_key(r) != _comparable_key(current)
+                or not r.get("roofline")
+            ):
+                continue
+            for name, p in (r["roofline"].get("programs") or {}).items():
+                value = p.get("modeled_bytes")
+                if value:
+                    prior_bytes.setdefault(name, []).append(float(value))
+        for name, p in sorted(roofline["programs"].items()):
+            value = p.get("modeled_bytes")
+            priors = prior_bytes.get(name)
+            if not value or not priors:
+                continue
+            base = statistics.median(priors)
+            entry = {
+                "bench": f"roofline_bytes:{name}",
+                "current_per_op_us": float(value),
+                "baseline_per_op_us": base,
+                "ratio": round(float(value) / base, 3) if base else 0.0,
+            }
+            checked.append(entry)
+            if base and abs(float(value) / base - 1.0) > rtol:
+                regressions.append(entry)
     if scenarios and scenarios.get("hardening_overhead_pct") is not None:
         env_cap = os.environ.get("HV_BENCH_HARDENING_OVERHEAD")
         cap = (

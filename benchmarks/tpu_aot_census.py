@@ -75,11 +75,20 @@ TOPOLOGY = "v5e:2x4"
 EXIT_OK = 0
 EXIT_TPU_UNAVAILABLE = 75  # EX_TEMPFAIL: plugin absent/wedged, not a failure
 
-# Dispatch-bearing instruction kinds (parameters/bitcasts/tuples are
-# metadata; copy-done is the completion half of an async copy).
-DISPATCH_OPS = (
-    "fusion", "custom-call", "copy", "dynamic-update-slice", "sort",
-    "reduce-window", "gather", "scatter",
+# The compiled-program scan (ENTRY-step iteration, dispatch-bearing
+# kinds, phase attribution) and the cost/memory-analysis extraction
+# moved to `hypervisor_tpu.observability.roofline` in round 15: the
+# live observatory and this offline census MUST count with one rule
+# set or their numbers drift. Re-exported here so the committed
+# anchors, tests, and downstream tooling keep their import paths.
+from hypervisor_tpu.observability.roofline import (  # noqa: E402
+    DISPATCH_OPS,
+    _computation_phases,
+    _entry_body,
+    _iter_entry_steps,
+    compiled_cost,
+    entry_census,
+    phase_census,
 )
 
 #: r09-HEAD anchor (commit 4e1ca24, measured on this census's refined
@@ -105,113 +114,6 @@ R10_FUSED_BASELINE = {"cpu": 148, "tpu": None}
 #: Wave phases the megakernels carve the program into (`hv_phase.*`
 #: named scopes in ops/pipeline.py); un-scoped steps bucket as "glue".
 WAVE_PHASES = ("admission", "fsm_saga", "audit", "gateway", "epilogue")
-
-_PHASE_RE = re.compile(r'op_name="[^"]*hv_phase\.([a-z_]+)')
-
-
-def _entry_body(compiled) -> str:
-    txt = compiled.as_text()
-    entry = txt[txt.index("ENTRY "):]
-    body = entry[entry.index("{") + 1:]
-    depth, end = 1, 0
-    for i, ch in enumerate(body):
-        if ch == "{":
-            depth += 1
-        elif ch == "}":
-            depth -= 1
-            if depth == 0:
-                end = i
-                break
-    return body[:end]
-
-
-def _iter_entry_steps(body: str):
-    """Yield (kind, shape, line) for every countable ENTRY instruction.
-
-    Single-result instructions parse as always; tuple-result lines are
-    counted ONLY for custom-call (the megakernel block boundary — see
-    the round-12 metric note in the module docstring)."""
-    for line in body.splitlines():
-        stripped = line.strip()
-        m = re.match(r"\s*(?:ROOT\s+)?[%\w.-]+ = (\S+) ([a-z-]+)\(", stripped)
-        if m:
-            yield m.group(2), m.group(1), stripped
-            continue
-        m = re.match(
-            r"\s*(?:ROOT\s+)?[%\w.-]+ = (\([^)]*\)) (custom-call)\(",
-            stripped,
-        )
-        if m:
-            yield m.group(2), m.group(1), stripped
-
-
-def entry_census(compiled) -> tuple[int, int, dict]:
-    """(entry_total, dispatch_ish, top_kinds) for a compiled program."""
-    c: Counter = Counter()
-    for kind, shape, _ in _iter_entry_steps(_entry_body(compiled)):
-        if kind == "copy" and "[]" in shape:
-            continue  # rank-0 scalar copy: prologue plumbing, not a step
-        c[kind] += 1
-    return sum(c.values()), sum(c[k] for k in DISPATCH_OPS), dict(
-        c.most_common(10)
-    )
-
-
-_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
-_COMP_RE = re.compile(r"^(?:ENTRY )?%?([\w.\-]+) \(")
-
-
-def _computation_phases(txt: str) -> dict:
-    """computation name -> Counter of `hv_phase.*` tags in its body.
-
-    XLA:CPU's parallel-task rewrite strips the root metadata off large
-    fusions at bench shapes, so line-level attribution alone loses
-    them; the ops INSIDE the called fused computation keep their
-    scoped op_names — majority vote over the body recovers the phase.
-    """
-    comp: dict[str, Counter] = {}
-    cur = None
-    for line in txt.splitlines():
-        if line and not line.startswith(" "):
-            m = _COMP_RE.match(line)
-            if m:
-                cur = m.group(1)
-                continue
-        m = _PHASE_RE.search(line)
-        if m and cur is not None:
-            comp.setdefault(cur, Counter())[m.group(1)] += 1
-    return comp
-
-
-def phase_census(compiled) -> dict:
-    """Dispatch-bearing ENTRY steps bucketed by originating wave phase.
-
-    Attribution rides the `hv_phase.*` named scopes `ops.pipeline.
-    governance_wave` wraps its phases in: a step lands on the phase its
-    own `op_name` carries, else on the majority phase of the fused
-    computation it calls (see `_computation_phases` — the CPU
-    parallel-fusion rewrite strips root metadata at bench shapes).
-    Steps with no phase provenance at all (staging copies, donation
-    plumbing, lane padding) bucket as "glue". Approximate only where
-    XLA fused across a phase boundary — the majority decides.
-    """
-    txt = compiled.as_text()
-    comp_phases = _computation_phases(txt)
-    phases = {name: 0 for name in WAVE_PHASES}
-    phases["glue"] = 0
-    for kind, shape, line in _iter_entry_steps(_entry_body(compiled)):
-        if kind not in DISPATCH_OPS:
-            continue
-        if kind == "copy" and "[]" in shape:
-            continue
-        m = _PHASE_RE.search(line)
-        key = m.group(1) if m else None
-        if key is None:
-            cm = _CALLS_RE.search(line)
-            if cm and cm.group(1) in comp_phases:
-                key = comp_phases[cm.group(1)].most_common(1)[0][0]
-        phases[key if key in phases else "glue"] += 1
-    return phases
 
 
 def _probe_timeout() -> float:
@@ -426,15 +328,19 @@ def census_report(backend: str, sharding=None) -> dict:
             attr_compiled
         )
         programs["fused_wave_sanitized"]["phases_shape"] = ATTR_SHAPE
-    try:
-        mm = compiled_fused.memory_analysis()
+    # ONE extraction rule with the live observatory
+    # (`roofline.compiled_cost`): the census's HBM block and the
+    # runtime registry must read the same analysis the same way.
+    cost = compiled_cost(compiled_fused)
+    hbm = None
+    if cost is not None and cost.get("temp_bytes") is not None:
         hbm = {
-            "temp_mb": round(mm.temp_size_in_bytes / 1e6, 2),
-            "args_mb": round(mm.argument_size_in_bytes / 1e6, 2),
-            "out_mb": round(mm.output_size_in_bytes / 1e6, 2),
+            "temp_mb": round(cost["temp_bytes"] / 1e6, 2),
+            "args_mb": round(cost["argument_bytes"] / 1e6, 2),
+            "out_mb": round(cost["output_bytes"] / 1e6, 2),
         }
-    except Exception:  # pragma: no cover — backend without the API
-        hbm = None
+    if cost is not None:
+        programs["fused_wave_sanitized"]["cost"] = cost
 
     # ── the unfused equivalents (what a de-fused runtime re-pays) ────
     def wave_plain(*a):

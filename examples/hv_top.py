@@ -57,8 +57,12 @@ def parse_prometheus_counters(text: str) -> dict[str, float]:
     return out
 
 
-def poll_url(base: str) -> tuple[dict, dict[str, float]]:
-    """One (/debug/health, /metrics) poll against a live deployment."""
+def poll_url(base: str) -> tuple[dict, dict[str, float], dict | None]:
+    """One (/debug/health, /metrics, /debug/roofline) poll against a
+    live deployment. The roofline poll degrades gracefully: an older
+    server without the endpoint (404) — or any fetch error — renders
+    the panel as "n/a" instead of crashing the watch loop."""
+    from urllib.error import HTTPError, URLError
     from urllib.request import urlopen
 
     base = base.rstrip("/")
@@ -66,14 +70,24 @@ def poll_url(base: str) -> tuple[dict, dict[str, float]]:
         health = json.loads(resp.read())
     with urlopen(f"{base}/metrics", timeout=10) as resp:
         counters = parse_prometheus_counters(resp.read().decode())
-    return health, counters
+    roofline = None
+    try:
+        with urlopen(f"{base}/debug/roofline", timeout=10) as resp:
+            roofline = json.loads(resp.read())
+    except (HTTPError, URLError, OSError, json.JSONDecodeError):
+        roofline = None  # pre-r15 server or transient fetch failure
+    return health, counters, roofline
 
 
-def poll_state(state) -> tuple[dict, dict[str, float]]:
+def poll_state(state) -> tuple[dict, dict[str, float], dict | None]:
     """The in-process twin of `poll_url` (same payload shapes)."""
     health = state.health_summary()
     counters = parse_prometheus_counters(state.metrics_prometheus())
-    return health, counters
+    try:
+        roofline = state.roofline_summary()
+    except Exception:  # noqa: BLE001 — panel shows n/a, never crashes
+        roofline = None
+    return health, counters, roofline
 
 
 def load_trajectory(root: Path) -> list[dict]:
@@ -95,7 +109,10 @@ def _fmt_bytes(n: float) -> str:
 
 
 def render(
-    health: dict, counters: dict[str, float], trajectory: list[dict]
+    health: dict,
+    counters: dict[str, float],
+    trajectory: list[dict],
+    roofline: dict | None = None,
 ) -> str:
     lines = [
         f"hv_top @ {time.strftime('%H:%M:%S')}  "
@@ -293,6 +310,44 @@ def render(
             ),
         )
 
+    lines.append("")
+    if not roofline or not roofline.get("enabled"):
+        lines.append("roofline   n/a (endpoint absent or observatory off)")
+    else:
+        floor = roofline.get("floor") or {}
+        peaks = roofline.get("peaks") or {}
+        lines.append(
+            f"roofline   peak={peaks.get('peak_bw_gbs', 0):,.0f} GB/s  "
+            f"wave floor={floor.get('modeled_floor_us') or '-'} µs  "
+            f"measured={floor.get('measured_p50_us') or '-'} µs  "
+            f"distance={floor.get('distance') or '-'}x  "
+            f"worst={roofline.get('worst_program') or '-'}"
+        )
+        rl_rows = []
+        for name, row in sorted((roofline.get("programs") or {}).items()):
+            model = row.get("model") or {}
+            mb = model.get("bytes_accessed")
+            fl = model.get("flops")
+            frac = row.get("achieved_bw_frac")
+            rl_rows.append(
+                (
+                    name,
+                    "-" if mb is None else f"{mb / 1e6:,.2f} MB",
+                    "-" if fl is None else f"{fl / 1e6:,.1f} M",
+                    "-"
+                    if row.get("wall_p50_us") is None
+                    else f"{row['wall_p50_us']:,.0f}",
+                    "-" if frac is None else f"{frac * 100:.2f}%",
+                    "-"
+                    if row.get("distance") is None
+                    else f"{row['distance']:,.0f}x",
+                )
+            )
+        lines += fmt_table(
+            rl_rows,
+            header=("program", "bytes", "flops", "p50 µs", "bw", "dist"),
+        )
+
     if trajectory:
         lines.append("")
         lines.append("bench trajectory (headline per-op p50, µs)")
@@ -331,8 +386,8 @@ def main(argv=None) -> int:
 
     if args.url:
         def frame() -> str:
-            health, counters = poll_url(args.url)
-            return render(health, counters, trajectory)
+            health, counters, roofline = poll_url(args.url)
+            return render(health, counters, trajectory, roofline)
 
         return watch_loop(frame, watch=args.watch, interval=args.interval)
 
@@ -369,8 +424,8 @@ def main(argv=None) -> int:
             progress["rnd"] += 1
 
     def frame() -> str:
-        health, counters = poll_state(state)
-        return render(health, counters, trajectory)
+        health, counters, roofline = poll_state(state)
+        return render(health, counters, trajectory, roofline)
 
     return watch_loop(
         frame, watch=args.watch, interval=args.interval, tick=tick

@@ -400,3 +400,16 @@ class JoinWaveResponse(BaseModel):
     session_id: str
     lanes: list
     wave: Optional[dict] = None
+
+
+# ── Roofline observatory ─────────────────────────────────────────────
+
+
+class ProfileRequest(BaseModel):
+    """`POST /debug/profile`: one bounded jax.profiler capture window.
+
+    `duration_s` is clamped to [0.001, 10] server-side; `log_dir`
+    defaults to a fresh temp directory (returned in the response)."""
+
+    duration_s: float = 0.05
+    log_dir: Optional[str] = None

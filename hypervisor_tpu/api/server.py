@@ -1,6 +1,6 @@
 """REST transports for the Hypervisor API.
 
-Two transports over the same `HypervisorService` (42 routes: the
+Two transports over the same `HypervisorService` (44 routes: the
 reference's 21, `api/server.py`, plus device stats, quarantine views,
 the per-membership agent view, leave, the operator sweep, the
 per-action gateway with its wave sibling, the flight recorder —
@@ -19,7 +19,10 @@ overload sheds map to HTTP 429 + Retry-After on BOTH transports — the
 Retry-After hint is LIVE: queue depth x observed drain rate, scaled by
 the class's SLO burn state — plus the latency observatory:
 `GET /debug/slo` (per-class burn rates, critical-path decomposition,
-exemplars, phase shares)):
+exemplars, phase shares), and the roofline observatory:
+`GET /debug/roofline` (per-program cost models, achieved-bandwidth
+fractions, headroom ranking, distance to the floor) +
+`POST /debug/profile` (on-demand wedge-proof jax.profiler window)):
 
  - `create_app()` — a FastAPI application with CORS-open middleware and
    OpenAPI docs, when fastapi is installed.
@@ -70,6 +73,8 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/debug/integrity", "debug_integrity", None),
     ("GET", "/debug/serving", "debug_serving", None),
     ("GET", "/debug/slo", "debug_slo", None),
+    ("GET", "/debug/roofline", "debug_roofline", None),
+    ("POST", "/debug/profile", "debug_profile", M.ProfileRequest),
     ("GET", "/api/v1/stats", "stats", None),
     ("GET", "/api/v1/device/stats", "device_stats", None),
     ("POST", "/api/v1/sessions", "create_session", M.CreateSessionRequest),

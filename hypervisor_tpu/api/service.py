@@ -721,6 +721,42 @@ class HypervisorService:
             out["exemplar_rows"] = serving.attribution.exemplars()[-16:]
         return out
 
+    async def debug_roofline(self) -> dict:
+        """`GET /debug/roofline`: the roofline observatory in one poll
+        — per-program modeled bytes/FLOPs (every captured bucket), the
+        modeled-vs-measured table with achieved-bandwidth fractions and
+        MFU, the per-phase byte model joined with measured wave-phase
+        shares (the phase join drains the trace ring — one device_get,
+        the same cost /debug/slo pays), peak-HBM occupancy vs the
+        footprint protocol, the headroom ranking naming the worst
+        program, and the live distance-to-the-floor block."""
+        return self.hv.state.roofline_summary()
+
+    async def debug_profile(self, req: M.ProfileRequest) -> dict:
+        """`POST /debug/profile`: an on-demand bounded `jax.profiler`
+        capture window (TensorBoard/Perfetto trace into `log_dir`).
+
+        Wedge-proof by construction (`observability.profiling.
+        capture_window`): the device plane is probed in a subprocess
+        with a hard timeout first (the census's exit-75 pattern), and
+        the window itself runs on a bounded worker thread — a wedged
+        accelerator tunnel degrades to a typed refusal (503/409),
+        never a hung serving thread."""
+        import tempfile
+
+        from hypervisor_tpu.observability import profiling
+
+        log_dir = req.log_dir or tempfile.mkdtemp(prefix="hv_profile_")
+        result = profiling.capture_window(log_dir, req.duration_s)
+        if result["status"] == "refused":
+            status = 409 if result["reason"] in ("busy", "active") else 503
+            raise ApiError(
+                status,
+                f"profile capture refused ({result['reason']}): "
+                f"{result['detail']}",
+            )
+        return result
+
     async def join_wave(
         self, session_id: str, req: M.JoinWaveRequest
     ) -> M.JoinWaveResponse:

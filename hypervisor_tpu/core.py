@@ -1798,6 +1798,11 @@ class Hypervisor:
             "slo_burn_warning": EventType.SLO_BURN_RATE_WARNING,
             "slo_burn_critical": EventType.SLO_BURN_RATE_CRITICAL,
             "slo_recovered": EventType.SLO_RECOVERED,
+            # Roofline observatory: a same-signature recapture whose
+            # modeled bytes drifted past tolerance rides the same
+            # fan-out (`observability.roofline`, drained at the
+            # metrics drain).
+            "roofline_shift": EventType.ROOFLINE_BYTES_SHIFT,
         }.get(kind)
         if event_type is None or self.event_bus is None:
             return

@@ -13,6 +13,10 @@ distribution. `attribution` + `slo` are the latency observatory:
 per-ticket critical-path decomposition (queue_wait / pad_wait /
 wave_wall / per-phase) with /metrics exemplars, and the per-class
 multi-window burn-rate engine whose alerts the supervisor can act on.
+`roofline` is the roofline observatory: a process-global registry of
+XLA cost/memory models captured at every confirmed compile, joined
+with the measured stage walls into live achieved-bandwidth / MFU /
+distance-to-the-floor series.
 """
 
 from hypervisor_tpu.observability import (
@@ -20,6 +24,7 @@ from hypervisor_tpu.observability import (
     health,
     metrics,
     profiling,
+    roofline,
     slo,
     tracing,
 )
@@ -47,6 +52,7 @@ __all__ = [
     "health",
     "metrics",
     "profiling",
+    "roofline",
     "slo",
     "tracing",
 ]

@@ -270,44 +270,10 @@ class CriticalPathAggregator:
 
     def phase_shares(self, tracer, last: int = 64) -> Optional[dict]:
         """Mean per-phase share of the wave wall over the most recent
-        reconstructed waves, normalized to sum to 1.0 exactly.
-
-        Joins the host wave index with the in-wave TraceLog stamps
-        (`tracer.drain()` — one device_get; call from debug endpoints /
-        the soak report, never the resolve path). Stamped stages map
-        through `WAVE_PHASE_OF`; the root-bracket residual the stamps
-        do not cover lands on `epilogue`. Returns None with no
-        reconstructable waves (plane disabled, ring wrapped).
-        """
-        spans = tracer.drain()
-        if not spans:
-            return None
-        totals = {phase: 0.0 for phase in HV_PHASES}
-        weight = 0.0
-        for root in spans[-last:]:
-            root_us = max(root.end_us - root.start_us, 0.0)
-            if root_us <= 0.0:
-                continue
-            covered = 0.0
-            for child in root.children:
-                phase = WAVE_PHASE_OF.get(child.stage)
-                dur = max(child.end_us - child.start_us, 0.0)
-                if phase is None:
-                    phase = "epilogue"
-                totals[phase] += dur
-                covered += dur
-            totals["epilogue"] += max(root_us - covered, 0.0)
-            weight += root_us
-        if weight <= 0.0:
-            return None
-        # Round FIRST, then fold the residual onto the largest share:
-        # per-share rounding after an exact normalization reintroduces
-        # up to len(HV_PHASES)/2 ulps of 1e-6 drift, breaking the
-        # phase-sum invariant the callers pin.
-        shares = {p: round(totals[p] / weight, 6) for p in HV_PHASES}
-        top = max(shares, key=shares.get)
-        shares[top] += 1.0 - sum(shares.values())
-        return shares
+        reconstructed waves — see `wave_phase_shares` (the module-level
+        rule this delegates to; the roofline observatory joins the
+        SAME shares against its per-phase byte model)."""
+        return wave_phase_shares(tracer, last)
 
     def phase_decomposition(
         self, path: TicketPath, shares: Optional[dict]
@@ -320,9 +286,56 @@ class CriticalPathAggregator:
         return {p: round(wall_ms * shares[p], 6) for p in HV_PHASES}
 
 
+def wave_phase_shares(tracer, last: int = 64) -> Optional[dict]:
+    """Mean per-phase share of the wave wall over the most recent
+    reconstructed waves, normalized to sum to 1.0 exactly.
+
+    Joins the host wave index with the in-wave TraceLog stamps
+    (`tracer.drain()` — one device_get; call from debug endpoints /
+    the soak report, never the resolve path). Stamped stages map
+    through `WAVE_PHASE_OF`; the root-bracket residual the stamps
+    do not cover lands on `epilogue`. Returns None with no
+    reconstructable waves (plane disabled, ring wrapped).
+
+    ONE rule shared by the latency observatory (per-ticket wave_wall
+    decomposition) and the roofline observatory (per-phase achieved
+    bandwidth) — the two planes must split the same wall the same way.
+    """
+    spans = tracer.drain()
+    if not spans:
+        return None
+    totals = {phase: 0.0 for phase in HV_PHASES}
+    weight = 0.0
+    for root in spans[-last:]:
+        root_us = max(root.end_us - root.start_us, 0.0)
+        if root_us <= 0.0:
+            continue
+        covered = 0.0
+        for child in root.children:
+            phase = WAVE_PHASE_OF.get(child.stage)
+            dur = max(child.end_us - child.start_us, 0.0)
+            if phase is None:
+                phase = "epilogue"
+            totals[phase] += dur
+            covered += dur
+        totals["epilogue"] += max(root_us - covered, 0.0)
+        weight += root_us
+    if weight <= 0.0:
+        return None
+    # Round FIRST, then fold the residual onto the largest share:
+    # per-share rounding after an exact normalization reintroduces
+    # up to len(HV_PHASES)/2 ulps of 1e-6 drift, breaking the
+    # phase-sum invariant the callers pin.
+    shares = {p: round(totals[p] / weight, 6) for p in HV_PHASES}
+    top = max(shares, key=shares.get)
+    shares[top] += 1.0 - sum(shares.values())
+    return shares
+
+
 __all__ = [
     "HV_PHASES",
     "WAVE_PHASE_OF",
     "CriticalPathAggregator",
     "TicketPath",
+    "wave_phase_shares",
 ]

@@ -105,6 +105,13 @@ class EventType(str, enum.Enum):
     SLO_BURN_RATE_CRITICAL = "slo.burn_rate_critical"
     SLO_RECOVERED = "slo.recovered"
 
+    # Roofline observatory (append-only, like every block above): a
+    # recapture of the SAME (program, signature) whose modeled HBM
+    # bytes moved past HV_ROOFLINE_SHIFT_TOL — the live fusion-
+    # regression / donation-miss canary (`observability.roofline`),
+    # facade-bridged from the health fan-out like the planes above.
+    ROOFLINE_BYTES_SHIFT = "roofline.bytes_shift"
+
     @property
     def code(self) -> int:
         """int32 column code for the device event log."""

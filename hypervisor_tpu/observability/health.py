@@ -289,6 +289,22 @@ class CompileWatch:
             )
             self.last_event = event
         _LOG.record(event)
+        # Roofline observatory intake (`observability.roofline`): every
+        # CONFIRMED compile queues a cost/memory-analysis capture. The
+        # hook only ABSTRACTS the signature here (ShapeDtypeStructs, no
+        # buffer retention — donated inputs are already dead); the
+        # capture itself resolves off the dispatch path at the metrics
+        # drain. Exception-proof: the observatory must never take down
+        # the dispatch that compiled.
+        try:
+            from hypervisor_tpu.observability import roofline
+
+            roofline.note_compile(
+                self.name, self._fn, args, kwargs,
+                detail=detail, static=self._static, wall_ms=wall_ms,
+            )
+        except Exception:  # noqa: BLE001 — observability never raises
+            pass
 
     def stats(self) -> dict:
         signatures = self._cache_size()

@@ -551,6 +551,118 @@ SLO_ALERTS = {
     for s in ("warning", "critical", "recovered")
 }
 
+# ── roofline observatory (compiled-program cost models, round 15) ────
+# HOST-owned gauges set by `observability.roofline.publish` at the
+# existing metrics drain: modeled bytes/FLOPs come from the compile-
+# time cost registry, achieved fractions join them against the host-
+# plane stage walls — ZERO extra device transfers on the clean path.
+# APPENDED at the registry tail (hvlint HVA004: registration order is
+# the device-table row layout).
+
+#: The CLOSED set of watched jit entry points (`state.py` `instrument`
+#: names) the observatory publishes per-program series for — pinned
+#: equal to the live watch set by tests/unit/test_roofline.py.
+ROOFLINE_PROGRAMS: tuple[str, ...] = (
+    "admit_batch",
+    "admit_batch_donated",
+    "saga_table_tick",
+    "terminate_batch",
+    "governance_wave",
+    "governance_wave_donated",
+    "record_calls",
+    "slash_cascade",
+    "breach_sweep",
+    "elevation_expiry",
+    "quarantine_enter",
+    "rate_consume",
+    "quarantine_sweep",
+    "fanout_round",
+    "effective_rings",
+    "gateway_check_actions",
+    "update_gauges",
+    "merge_wave_session_states",
+)
+ROOFLINE_MODELED_BYTES = {
+    p: REGISTRY.gauge(
+        "hv_roofline_modeled_bytes",
+        "XLA cost-analysis bytes accessed per compiled program (latest "
+        "captured bucket)",
+        program=p,
+    )
+    for p in ROOFLINE_PROGRAMS
+}
+ROOFLINE_MODELED_FLOPS = {
+    p: REGISTRY.gauge(
+        "hv_roofline_modeled_flops",
+        "XLA cost-analysis FLOPs per compiled program (latest captured "
+        "bucket)",
+        program=p,
+    )
+    for p in ROOFLINE_PROGRAMS
+}
+ROOFLINE_ACHIEVED_BW_FRAC = {
+    p: REGISTRY.gauge(
+        "hv_roofline_achieved_bw_frac",
+        "modeled bytes / measured stage p50 wall / peak HBM bandwidth "
+        "(1.0 = at the roofline)",
+        program=p,
+    )
+    for p in ROOFLINE_PROGRAMS
+}
+ROOFLINE_MFU = {
+    p: REGISTRY.gauge(
+        "hv_roofline_mfu",
+        "modeled FLOPs / measured stage p50 wall / peak FLOP rate",
+        program=p,
+    )
+    for p in ROOFLINE_PROGRAMS
+}
+#: Per-wave-phase twins (the PR 11/13 `HV_PHASES` vocabulary): bytes
+#: from the HLO per-phase walk, walls from the cached measured shares.
+ROOFLINE_WAVE_PHASES: tuple[str, ...] = (
+    "admission", "fsm_saga", "audit", "gateway", "epilogue",
+)
+ROOFLINE_PHASE_BYTES = {
+    ph: REGISTRY.gauge(
+        "hv_roofline_modeled_bytes",
+        "per-phase HLO output-byte model of the fused wave",
+        phase=ph,
+    )
+    for ph in ROOFLINE_WAVE_PHASES
+}
+ROOFLINE_PHASE_FLOPS = {
+    ph: REGISTRY.gauge(
+        "hv_roofline_modeled_flops",
+        "per-phase modeled FLOPs (attributed by the phase byte model)",
+        phase=ph,
+    )
+    for ph in ROOFLINE_WAVE_PHASES
+}
+ROOFLINE_PHASE_BW_FRAC = {
+    ph: REGISTRY.gauge(
+        "hv_roofline_achieved_bw_frac",
+        "per-phase achieved-bandwidth fraction (phase bytes / measured "
+        "phase wall / peak HBM bandwidth)",
+        phase=ph,
+    )
+    for ph in ROOFLINE_WAVE_PHASES
+}
+ROOFLINE_PHASE_MFU = {
+    ph: REGISTRY.gauge(
+        "hv_roofline_mfu",
+        "per-phase model FLOP utilization (attributed FLOPs / measured "
+        "phase wall / peak FLOP rate)",
+        phase=ph,
+    )
+    for ph in ROOFLINE_WAVE_PHASES
+}
+ROOFLINE_FLOOR_DISTANCE = REGISTRY.gauge(
+    "hv_roofline_floor_distance",
+    "measured fused-wave p50 wall over its modeled bandwidth/dispatch "
+    "floor (1.0 = as fast as the hardware allows) — the live "
+    "replacement for ROOFLINE.md's static distance estimate",
+)
+
 
 # ── host object: device table + host mirror + drain ──────────────────
 

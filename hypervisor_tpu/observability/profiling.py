@@ -26,7 +26,11 @@ unconditionally at negligible cost.
 from __future__ import annotations
 
 import contextlib
+import os
+import subprocess
+import sys
 import threading
+import time
 from typing import Iterator, Optional
 
 import jax
@@ -92,6 +96,147 @@ def span(name: str):
 def step_span(name: str, step: int):
     """Annotation grouping one full governance tick as a profiler step."""
     return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+# ── on-demand capture windows (POST /debug/profile) ──────────────────
+# The runtime endpoint for "give me a jax.profiler trace of the next N
+# milliseconds". The hazard: on a TPU backend with a WEDGED accelerator
+# tunnel, `start_trace` can hang inside the plugin forever — the same
+# failure mode the AOT census guards with its subprocess-bounded probe
+# and exit-75 skip. The capture window borrows that pattern: the device
+# plane is probed in a SUBPROCESS with a hard timeout first, and the
+# capture itself runs on a worker thread with a bounded join, so a
+# wedge degrades to a TYPED refusal — the serving thread never hangs.
+
+#: EX_TEMPFAIL — the census's "plugin absent or wedged, skip" code.
+EXIT_TPU_UNAVAILABLE = 75
+
+_capture_lock = threading.Lock()
+_capture_thread: Optional[threading.Thread] = None
+
+
+def _probe_timeout_s() -> float:
+    try:
+        return float(os.environ.get("HV_PROFILE_PROBE_TIMEOUT", "20"))
+    except ValueError:
+        return 20.0
+
+
+def probe_device_plane(backend: Optional[str] = None) -> tuple[bool, str]:
+    """Subprocess-bounded liveness probe of the device plane.
+
+    On cpu there is no tunnel to wedge — trivially healthy. On an
+    accelerator backend a child process enumerates devices under a hard
+    timeout (`HV_PROFILE_PROBE_TIMEOUT`, default 20 s); a hang or
+    nonzero exit means the tunnel is wedged and the caller must refuse
+    instead of committing this process to the same hang.
+    """
+    backend = backend or jax.default_backend()
+    if backend == "cpu":
+        return True, "cpu backend: no accelerator tunnel to probe"
+    code = "import jax; jax.devices(); raise SystemExit(0)"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=_probe_timeout_s(),
+        )
+    except subprocess.TimeoutExpired:
+        return False, (
+            f"device-plane probe hung past {_probe_timeout_s():.0f}s "
+            f"(wedged tunnel; exit-{EXIT_TPU_UNAVAILABLE} semantics)"
+        )
+    except OSError as e:
+        return False, f"device-plane probe failed to spawn: {e}"
+    if proc.returncode != 0:
+        return False, (
+            f"device-plane probe exited {proc.returncode} "
+            "(plugin absent or unhealthy)"
+        )
+    return True, "device plane healthy"
+
+
+def capture_window(
+    log_dir: str,
+    duration_s: float = 0.05,
+    *,
+    probe: bool = True,
+    grace_s: float = 10.0,
+) -> dict:
+    """Capture one bounded jax.profiler window into `log_dir`.
+
+    Returns a TYPED result dict — never raises, never hangs:
+      {"status": "captured", "dir", "duration_s"}        on success
+      {"status": "refused", "reason": "busy"|"active"|
+       "wedged", "detail"}                               otherwise
+
+    The start/sleep/stop sequence runs on a worker thread joined with
+    `duration_s + grace_s`; if the profiler wedges mid-start the thread
+    is abandoned (daemon) and subsequent captures refuse "busy" until
+    it either finishes or the process restarts — degraded, explicit,
+    and survivable, which is the whole contract.
+    """
+    global _capture_thread
+    duration_s = min(max(float(duration_s), 0.001), 10.0)
+    if probe:
+        ok, detail = probe_device_plane()
+        if not ok:
+            return {"status": "refused", "reason": "wedged",
+                    "detail": detail}
+    with _capture_lock:
+        if _capture_thread is not None and _capture_thread.is_alive():
+            return {
+                "status": "refused",
+                "reason": "busy",
+                "detail": "a previous capture window has not returned "
+                          "(possibly wedged in the profiler)",
+            }
+        if is_active():
+            return {
+                "status": "refused",
+                "reason": "active",
+                "detail": "a manual profiling.start() trace is running",
+            }
+        result: dict = {}
+
+        def _run() -> None:
+            acquired = start(log_dir)
+            if not acquired:
+                result["raced"] = True
+                return
+            try:
+                time.sleep(duration_s)
+            finally:
+                stop()
+            result["done"] = True
+
+        thread = threading.Thread(
+            target=_run, name="hv-profile-capture", daemon=True
+        )
+        _capture_thread = thread
+        thread.start()
+    thread.join(duration_s + max(grace_s, 0.0))
+    if thread.is_alive():
+        return {
+            "status": "refused",
+            "reason": "wedged",
+            "detail": (
+                f"profiler did not close the window within "
+                f"{duration_s + grace_s:.1f}s — capture thread abandoned "
+                "(daemon); further captures refuse busy until it returns"
+            ),
+        }
+    if result.get("raced"):
+        return {
+            "status": "refused",
+            "reason": "active",
+            "detail": "another trace started first",
+        }
+    return {
+        "status": "captured",
+        "dir": log_dir,
+        "duration_s": duration_s,
+    }
 
 
 def stage_scope(name: str):

@@ -1,0 +1,988 @@
+"""The roofline observatory: compiled-program cost models, live.
+
+ROOFLINE.md answers "how fast could this be" ONCE, by hand, offline:
+XLA's `cost_analysis()` / `memory_analysis()` were consulted in
+`benchmarks/tpu_aot_census.py` and the floor was written down as prose.
+Nothing in the runtime related a measured wall clock to the modeled
+bytes it moved — the one signal every perf PR (sharding, the last 10x
+to the dispatch floor, tenant density) needs to be steered by and
+regression-gated on. This module makes that signal always-on:
+
+  * **compiled_cost(compiled)** — the ONE version-guarded rule for
+    extracting XLA's modeled FLOPs / HBM bytes accessed and the
+    executable's argument/output/temp buffer sizes. `cost_analysis` and
+    `memory_analysis` can be absent or raise depending on jax build and
+    backend; every consumer (this registry, the AOT census) shares this
+    helper so their numbers cannot drift.
+  * **the program registry** — `observability.health.CompileWatch`
+    calls `note_compile` on every CONFIRMED compile of a watched jit
+    entry point. The registry abstracts the call's arguments to
+    `ShapeDtypeStruct`s (never retaining device buffers — donated
+    inputs are dead by then) and later resolves the capture through the
+    AOT path: `fn.lower(abstract).compile()` hits jax's in-memory
+    executable cache (the jit call just compiled this exact program, so
+    the XLA compile is ~free; only the re-trace is paid, and only once
+    per (program, signature)). Resolution is DEFERRED off the dispatch
+    path: a bounded batch resolves at each metrics drain, and
+    `resolve_pending()` drains the rest on demand (debug endpoint,
+    bench row, CI gate).
+  * **the join** — `publish()` runs at the existing metrics drain with
+    ZERO extra device transfers: modeled bytes/FLOPs are host values,
+    and the measured walls are the host-plane stage histograms the
+    Tracer already brackets around every dispatch
+    (`STAGE_OF_PROGRAM` maps watch names onto the stage vocabulary).
+    Published series: `hv_roofline_{modeled_bytes,modeled_flops,
+    achieved_bw_frac,mfu}{program=...}`, the per-wave-phase twins
+    (`phase=...`, the PR 11/13 `HV_PHASES` vocabulary), and
+    `hv_roofline_floor_distance` — measured fused-wave p50 over its
+    modeled bandwidth/dispatch floor, the live replacement for
+    ROOFLINE.md's static "how far from 30 µs" estimate.
+  * **per-phase byte model** — `phase_bytes(compiled)` walks the
+    compiled ENTRY computation (the same `hv_phase.*` named-scope
+    attribution the census uses, shared from here) and sums the output
+    bytes of every dispatch-bearing step per phase: a shape-derived
+    HBM write-traffic model of WHERE the fused wave's bytes go. Joined
+    with the measured phase shares (`attribution.wave_phase_shares` —
+    computed on demand, cached here) it yields per-phase achieved
+    bandwidth. Per-phase FLOPs are attributed proportionally to the
+    phase byte model (XLA's aggregate cost analysis has no per-phase
+    hook) — documented approximation, bytes are the honest axis.
+
+Knobs (env, read per call — hvlint HVA002):
+  `HV_ROOFLINE`            observatory on/off (default 1)
+  `HV_ROOFLINE_PHASES`     capture the per-phase byte model (default 1;
+                           one `as_text` walk per wave program)
+  `HV_ROOFLINE_PEAK_BW_GBS`   peak HBM GB/s (default: v5e 819 on tpu,
+                              nominal 64 on cpu hosts)
+  `HV_ROOFLINE_PEAK_FLOPS_G`  peak GFLOP/s (default: v5e bf16 197000 on
+                              tpu, nominal 2000 on cpu)
+  `HV_ROOFLINE_DISPATCH_FLOOR_US`  dispatch floor for the distance
+                                   gauge (default 30)
+  `HV_ROOFLINE_SHIFT_TOL`  relative modeled-bytes drift between two
+                           captures of the SAME (program, signature)
+                           that emits a `roofline.bytes_shift` event
+                           (default 0.1)
+  `HV_ROOFLINE_MIN_SAMPLES`  stage histogram samples before a measured
+                             join publishes (default 2)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import re
+import threading
+import time
+from collections import Counter, OrderedDict, deque
+from typing import Callable, Iterable, Optional
+
+from hypervisor_tpu.observability.attribution import HV_PHASES
+
+# ── shared compiled-program scan (the census imports these) ──────────
+# Moved here from benchmarks/tpu_aot_census.py so the offline census
+# and the live observatory count with ONE rule set.
+
+#: Dispatch-bearing instruction kinds (parameters/bitcasts/tuples are
+#: metadata; copy-done is the completion half of an async copy).
+DISPATCH_OPS = (
+    "fusion", "custom-call", "copy", "dynamic-update-slice", "sort",
+    "reduce-window", "gather", "scatter",
+)
+
+#: Wave phases the megakernels carve the program into (`hv_phase.*`
+#: named scopes in ops/pipeline.py) — the SAME vocabulary the
+#: attribution plane splits measured walls across.
+WAVE_PHASES: tuple[str, ...] = HV_PHASES
+
+_PHASE_RE = re.compile(r'op_name="[^"]*hv_phase\.([a-z_]+)')
+_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
+_COMP_RE = re.compile(r"^(?:ENTRY )?%?([\w.\-]+) \(")
+
+
+def _entry_body(compiled) -> str:
+    txt = compiled.as_text()
+    entry = txt[txt.index("ENTRY "):]
+    body = entry[entry.index("{") + 1:]
+    depth, end = 1, 0
+    for i, ch in enumerate(body):
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                end = i
+                break
+    return body[:end]
+
+
+def _iter_entry_steps(body: str):
+    """Yield (kind, shape, line) for every countable ENTRY instruction.
+
+    Single-result instructions parse as always; tuple-result lines are
+    counted ONLY for custom-call (the megakernel block boundary — see
+    the round-12 metric note in benchmarks/tpu_aot_census.py)."""
+    for line in body.splitlines():
+        stripped = line.strip()
+        m = re.match(r"\s*(?:ROOT\s+)?[%\w.-]+ = (\S+) ([a-z-]+)\(", stripped)
+        if m:
+            yield m.group(2), m.group(1), stripped
+            continue
+        m = re.match(
+            r"\s*(?:ROOT\s+)?[%\w.-]+ = (\([^)]*\)) (custom-call)\(",
+            stripped,
+        )
+        if m:
+            yield m.group(2), m.group(1), stripped
+
+
+def entry_census(compiled) -> tuple[int, int, dict]:
+    """(entry_total, dispatch_ish, top_kinds) for a compiled program."""
+    c: Counter = Counter()
+    for kind, shape, _ in _iter_entry_steps(_entry_body(compiled)):
+        if kind == "copy" and "[]" in shape:
+            continue  # rank-0 scalar copy: prologue plumbing, not a step
+        c[kind] += 1
+    return sum(c.values()), sum(c[k] for k in DISPATCH_OPS), dict(
+        c.most_common(10)
+    )
+
+
+def _computation_phases(txt: str) -> dict:
+    """computation name -> Counter of `hv_phase.*` tags in its body.
+
+    XLA:CPU's parallel-task rewrite strips the root metadata off large
+    fusions at bench shapes, so line-level attribution alone loses
+    them; the ops INSIDE the called fused computation keep their
+    scoped op_names — majority vote over the body recovers the phase.
+    """
+    comp: dict[str, Counter] = {}
+    cur = None
+    for line in txt.splitlines():
+        if line and not line.startswith(" "):
+            m = _COMP_RE.match(line)
+            if m:
+                cur = m.group(1)
+                continue
+        m = _PHASE_RE.search(line)
+        if m and cur is not None:
+            comp.setdefault(cur, Counter())[m.group(1)] += 1
+    return comp
+
+
+def _iter_phase_steps(compiled):
+    """Yield (phase, kind, shape) for every dispatch-bearing ENTRY step,
+    attributed by its own `hv_phase` op_name, else the majority phase
+    of the fused computation it calls, else "glue"."""
+    txt = compiled.as_text()
+    comp_phases = _computation_phases(txt)
+    for kind, shape, line in _iter_entry_steps(_entry_body(compiled)):
+        if kind not in DISPATCH_OPS:
+            continue
+        if kind == "copy" and "[]" in shape:
+            continue
+        m = _PHASE_RE.search(line)
+        key = m.group(1) if m else None
+        if key is None:
+            cm = _CALLS_RE.search(line)
+            if cm and cm.group(1) in comp_phases:
+                key = comp_phases[cm.group(1)].most_common(1)[0][0]
+        yield (key if key in WAVE_PHASES else "glue"), kind, shape
+
+
+def phase_census(compiled) -> dict:
+    """Dispatch-bearing ENTRY steps bucketed by originating wave phase.
+
+    Attribution rides the `hv_phase.*` named scopes `ops.pipeline.
+    governance_wave` wraps its phases in. Steps with no phase
+    provenance at all (staging copies, donation plumbing, lane padding)
+    bucket as "glue". Approximate only where XLA fused across a phase
+    boundary — the majority decides.
+    """
+    phases = {name: 0 for name in WAVE_PHASES}
+    phases["glue"] = 0
+    for phase, _, _ in _iter_phase_steps(compiled):
+        phases[phase] += 1
+    return phases
+
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+_SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
+
+
+def shape_bytes(shape: str) -> int:
+    """Bytes of an HLO result shape string (`f32[10000,3]{1,0}`,
+    tuple shapes sum their elements; token/opaque count zero)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape):
+        width = _DTYPE_BYTES.get(dtype)
+        if width is None:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * width
+    return total
+
+
+def phase_bytes(compiled) -> dict:
+    """Output bytes written by dispatch-bearing ENTRY steps, per phase.
+
+    A shape-derived HBM WRITE-traffic model of where the fused wave's
+    bytes land (reads approximately mirror writes for the wave's
+    elementwise/scatter phases; XLA's aggregate `bytes accessed` has no
+    per-phase hook, so this walk is the per-phase model). Same
+    attribution rule as `phase_census`.
+    """
+    phases = {name: 0 for name in WAVE_PHASES}
+    phases["glue"] = 0
+    for phase, _, shape in _iter_phase_steps(compiled):
+        phases[phase] += shape_bytes(shape)
+    return phases
+
+
+# ── compiled_cost: the one version-guarded analysis rule ─────────────
+
+
+def compiled_cost(compiled) -> Optional[dict]:
+    """Extract XLA's cost + memory analysis from one compiled program.
+
+    Version-guarded: `cost_analysis()` returns a list of dicts on some
+    jax builds and a bare dict on others, and either API can be absent
+    or raise on a given backend. Returns a dict with whatever halves
+    succeeded (None values for the missing half), or None when neither
+    API yielded anything — callers never see a raise.
+
+    Keys: `flops`, `bytes_accessed` (cost analysis — modeled operand
+    traffic, an upper bound that counts temporaries); `argument_bytes`,
+    `output_bytes`, `temp_bytes`, `alias_bytes`,
+    `generated_code_bytes`, `peak_bytes` (memory analysis — the live
+    buffer sizes, `peak` = args + outputs + temps + code, ROOFLINE.md
+    §2's honest bandwidth anchor).
+    """
+    out: dict = {
+        "flops": None,
+        "bytes_accessed": None,
+        "argument_bytes": None,
+        "output_bytes": None,
+        "temp_bytes": None,
+        "alias_bytes": None,
+        "generated_code_bytes": None,
+        "peak_bytes": None,
+    }
+    got = False
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict) and ca:
+            flops = ca.get("flops")
+            by = ca.get("bytes accessed")
+            if flops is not None:
+                out["flops"] = float(flops)
+            if by is not None:
+                out["bytes_accessed"] = float(by)
+            got = out["flops"] is not None or out["bytes_accessed"] is not None
+    except Exception:  # noqa: BLE001 — backend without the API
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        arg = int(getattr(ma, "argument_size_in_bytes"))
+        outb = int(getattr(ma, "output_size_in_bytes"))
+        tmp = int(getattr(ma, "temp_size_in_bytes"))
+        alias = int(getattr(ma, "alias_size_in_bytes", 0))
+        code = int(getattr(ma, "generated_code_size_in_bytes", 0))
+        out.update(
+            argument_bytes=arg,
+            output_bytes=outb,
+            temp_bytes=tmp,
+            alias_bytes=alias,
+            generated_code_bytes=code,
+            peak_bytes=arg + outb + tmp + code,
+        )
+        got = True
+    except Exception:  # noqa: BLE001 — backend without the API
+        pass
+    return out if got else None
+
+
+# ── env knobs (read per call: post-import arming must work) ──────────
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def enabled() -> bool:
+    return os.environ.get("HV_ROOFLINE", "1") not in ("0", "off", "false")
+
+
+def _phases_enabled() -> bool:
+    return os.environ.get("HV_ROOFLINE_PHASES", "1") not in (
+        "0", "off", "false",
+    )
+
+
+def peak_rates(backend: Optional[str] = None) -> dict:
+    """(peak HBM bytes/s, peak FLOP/s) for the roofline denominators.
+
+    TPU defaults are the public v5e spec (819 GB/s HBM, 197 TFLOP/s
+    bf16 — the MXU ceiling; this workload's MFU against it is ~0 by
+    construction, which is exactly what the gauge should say). CPU
+    defaults are NOMINAL host-class figures (64 GB/s, 2 TFLOP/s) so
+    cpu-backend fractions are comparable across rounds, not absolute
+    truth. Override with `HV_ROOFLINE_PEAK_BW_GBS` /
+    `HV_ROOFLINE_PEAK_FLOPS_G` (read per call).
+    """
+    if backend is None:
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:  # noqa: BLE001 — deviceless contexts
+            backend = "cpu"
+    if backend == "tpu":
+        bw_default, flops_default = 819.0, 197_000.0
+    else:
+        bw_default, flops_default = 64.0, 2_000.0
+    bw_gbs = _env_float("HV_ROOFLINE_PEAK_BW_GBS", bw_default)
+    flops_g = _env_float("HV_ROOFLINE_PEAK_FLOPS_G", flops_default)
+    return {
+        "backend": backend,
+        "peak_bw_bytes_s": bw_gbs * 1e9,
+        "peak_flops_s": flops_g * 1e9,
+        "peak_bw_gbs": bw_gbs,
+        "peak_flops_g": flops_g,
+    }
+
+
+#: Watch name -> host stage-latency vocabulary (`metrics.STAGE_LATENCY`):
+#: the join between the registry's cost models and the measured walls
+#: the Tracer already brackets. Programs absent here (gauge refresh,
+#: sweeps) publish model-only rows — there is no host bracket to join.
+STAGE_OF_PROGRAM: dict[str, str] = {
+    "governance_wave": "governance_wave",
+    "governance_wave_donated": "governance_wave",
+    "admit_batch": "admission_wave",
+    "admit_batch_donated": "admission_wave",
+    "saga_table_tick": "saga_round",
+    "fanout_round": "saga_round",
+    "terminate_batch": "terminate_wave",
+    "gateway_check_actions": "gateway_wave",
+    "slash_cascade": "slash_cascade",
+    "breach_sweep": "breach_sweep",
+    "merge_wave_session_states": "reconcile_wave_sessions",
+}
+
+#: Programs whose compiled text is walked for the per-phase byte model
+#: (once per program — shares are shape-stable, the census's
+#: ATTR_SHAPE note; the walk is an `as_text` pass, too heavy per
+#: bucket).
+PHASE_PROGRAMS = ("governance_wave", "governance_wave_donated")
+
+
+# ── the registry ─────────────────────────────────────────────────────
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramCost:
+    """One (program, abstract signature)'s captured cost model."""
+
+    program: str
+    sig_key: str
+    signature: tuple[tuple[str, str], ...]
+    captured_at: float
+    compile_wall_ms: float
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    alias_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    peak_bytes: Optional[int] = None
+    error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "program": self.program,
+            "sig_key": self.sig_key,
+            "signature": [list(kv) for kv in self.signature],
+            "captured_at": self.captured_at,
+            "compile_wall_ms": round(self.compile_wall_ms, 3),
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "alias_bytes": self.alias_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "peak_bytes": self.peak_bytes,
+            "error": self.error,
+        }
+
+
+def _abstract(args: tuple, kwargs: dict, static: frozenset):
+    """Map every array leaf to a ShapeDtypeStruct: the pending queue
+    must never retain device buffers (under the donation default the
+    inputs are already dead), and lowering only needs avals. Static
+    kwargs pass through by VALUE — they are part of the program."""
+    import jax
+
+    def to_sds(leaf):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+        return leaf
+
+    dyn_kwargs = {k: v for k, v in kwargs.items() if k not in static}
+    static_kwargs = {k: v for k, v in kwargs.items() if k in static}
+    a_args, a_dyn = jax.tree_util.tree_map(to_sds, (args, dyn_kwargs))
+    return a_args, {**a_dyn, **static_kwargs}
+
+
+def _sig_digest(detail: Iterable[tuple[str, str]]) -> str:
+    h = hashlib.sha1()
+    for name, summary in detail:
+        h.update(f"{name}={summary};".encode())
+    return h.hexdigest()[:16]
+
+
+class RooflineRegistry:
+    """Process-global cost/memory model per (program, signature).
+
+    Global on purpose, like `health._CompileLog`: the module-level jit
+    caches the models mirror are shared by every HypervisorState in
+    the process — the registry survives `Supervisor.restore_state()`
+    re-attaches for free, exactly like the compile telemetry does.
+    """
+
+    def __init__(self, per_program: int = 16) -> None:
+        self._lock = threading.Lock()
+        self._per_program = per_program
+        self._models: dict[str, OrderedDict[str, ProgramCost]] = {}
+        self._phase_models: dict[str, dict] = {}
+        self._pending: deque = deque(maxlen=64)
+        self._phase_shares: Optional[dict] = None
+        self._events: deque = deque(maxlen=64)
+        self._event_seq = 0
+        self.captures = 0
+        self.capture_failures = 0
+
+    # -- intake (CompileWatch._record hook) -----------------------------
+
+    def note_compile(
+        self,
+        program: str,
+        fn: Callable,
+        args: tuple,
+        kwargs: dict,
+        *,
+        detail: Iterable[tuple[str, str]],
+        static: frozenset = frozenset(),
+        wall_ms: float = 0.0,
+    ) -> None:
+        """Queue one confirmed compile for capture. Cheap and
+        exception-proof: abstracts the arguments NOW (no buffer
+        retention), resolves LATER (`resolve_pending`) so the capture's
+        re-trace never rides the dispatch that compiled."""
+        if not enabled():
+            return
+        if not hasattr(fn, "lower"):
+            return  # test fakes / non-jit callables: nothing to analyze
+        try:
+            a_args, a_kwargs = _abstract(args, kwargs, static)
+        except Exception:  # noqa: BLE001 — never break a dispatch
+            return
+        detail = tuple((str(k), str(v)) for k, v in detail)
+        with self._lock:
+            self._pending.append(
+                (program, fn, a_args, a_kwargs, detail, float(wall_ms))
+            )
+
+    # -- resolution -----------------------------------------------------
+
+    def resolve_pending(self, limit: Optional[int] = None) -> int:
+        """Capture up to `limit` queued compiles (all when None).
+        Returns the number resolved. Runs on the host, touches no
+        device data: `lower()` re-traces with abstract arguments and
+        `compile()` hits the in-memory executable cache jax populated
+        when the jit call compiled."""
+        resolved = 0
+        while limit is None or resolved < limit:
+            with self._lock:
+                if not self._pending:
+                    break
+                item = self._pending.popleft()
+            self._resolve_one(*item)
+            resolved += 1
+        return resolved
+
+    def _resolve_one(
+        self, program, fn, a_args, a_kwargs, detail, wall_ms
+    ) -> None:
+        sig_key = _sig_digest(detail)
+        cost: Optional[dict] = None
+        error: Optional[str] = None
+        compiled = None
+        try:
+            compiled = fn.lower(*a_args, **a_kwargs).compile()
+            cost = compiled_cost(compiled)
+            if cost is None:
+                error = "cost/memory analysis unavailable on this backend"
+        except Exception as e:  # noqa: BLE001 — version/backend guard
+            error = f"{type(e).__name__}: {e}"
+        entry = ProgramCost(
+            program=program,
+            sig_key=sig_key,
+            signature=detail,
+            captured_at=time.time(),
+            compile_wall_ms=wall_ms,
+            error=error,
+            **(cost or {}),
+        )
+        with self._lock:
+            buckets = self._models.setdefault(program, OrderedDict())
+            prev = buckets.get(sig_key)
+            buckets[sig_key] = entry
+            buckets.move_to_end(sig_key)
+            while len(buckets) > self._per_program:
+                buckets.popitem(last=False)
+            if error is None:
+                self.captures += 1
+            else:
+                self.capture_failures += 1
+            shift = self._shift_of(prev, entry)
+            if shift is not None:
+                self._event_seq += 1
+                self._events.append((self._event_seq, shift))
+        if (
+            compiled is not None
+            and error is None
+            and program in PHASE_PROGRAMS
+            and _phases_enabled()
+        ):
+            with self._lock:
+                have = program in self._phase_models
+            if not have:
+                try:
+                    pb = phase_bytes(compiled)
+                except Exception:  # noqa: BLE001 — text-walk guard
+                    pb = None
+                if pb is not None:
+                    with self._lock:
+                        self._phase_models[program] = pb
+
+    @staticmethod
+    def _shift_of(prev, cur) -> Optional[dict]:
+        """A recapture of the SAME signature whose modeled bytes moved
+        more than `HV_ROOFLINE_SHIFT_TOL` (relative) — the live
+        fusion-regression / donation-miss canary."""
+        if prev is None or prev.bytes_accessed is None:
+            return None
+        if cur.bytes_accessed is None or prev.bytes_accessed <= 0:
+            return None
+        tol = _env_float("HV_ROOFLINE_SHIFT_TOL", 0.1)
+        rel = abs(cur.bytes_accessed - prev.bytes_accessed) / (
+            prev.bytes_accessed
+        )
+        if rel <= tol:
+            return None
+        return {
+            "program": cur.program,
+            "sig_key": cur.sig_key,
+            "prev_bytes": prev.bytes_accessed,
+            "bytes": cur.bytes_accessed,
+            "rel_shift": round(rel, 4),
+            "tolerance": tol,
+            "at": cur.captured_at,
+        }
+
+    # -- views ----------------------------------------------------------
+
+    def latest(self, program: str) -> Optional[ProgramCost]:
+        """Most recent successfully-modeled bucket of one program (the
+        newest capture wins; failed captures don't shadow a good one)."""
+        with self._lock:
+            buckets = self._models.get(program)
+            if not buckets:
+                return None
+            for entry in reversed(buckets.values()):
+                if entry.error is None:
+                    return entry
+            return next(reversed(buckets.values()))
+
+    def buckets(self, program: str) -> list[ProgramCost]:
+        with self._lock:
+            return list(self._models.get(program, {}).values())
+
+    def programs(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def phase_model(self, program: str) -> Optional[dict]:
+        with self._lock:
+            pm = self._phase_models.get(program)
+            return dict(pm) if pm else None
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def set_phase_shares(self, shares: Optional[dict]) -> None:
+        """Cache the latest measured wave-phase wall shares
+        (`attribution.wave_phase_shares` — computed by whoever drained
+        the tracer: the debug endpoint, the soak report, hv_top). The
+        drain-time publisher reads this cache so the CLEAN path never
+        pays a trace-ring device_get."""
+        if shares:
+            with self._lock:
+                self._phase_shares = dict(shares)
+
+    def phase_shares(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._phase_shares) if self._phase_shares else None
+
+    def events_since(self, seq: int) -> tuple[int, list[dict]]:
+        """Shift events newer than `seq` (per-deployment cursors: every
+        state drains its own view of the global event ring)."""
+        with self._lock:
+            fresh = [(s, e) for s, e in self._events if s > seq]
+            top = self._event_seq
+        return top, [e for _, e in fresh]
+
+    def reset(self) -> None:
+        """Test hook: drop every model/pending/event."""
+        with self._lock:
+            self._models.clear()
+            self._phase_models.clear()
+            self._pending.clear()
+            self._events.clear()
+            self._phase_shares = None
+            self._event_seq = 0
+            self.captures = 0
+            self.capture_failures = 0
+
+
+_REGISTRY = RooflineRegistry()
+
+
+def registry() -> RooflineRegistry:
+    return _REGISTRY
+
+
+def note_compile(
+    program: str,
+    fn: Callable,
+    args: tuple,
+    kwargs: dict,
+    *,
+    detail: Iterable[tuple[str, str]],
+    static: frozenset = frozenset(),
+    wall_ms: float = 0.0,
+) -> None:
+    """Module-level intake (what `CompileWatch._record` calls)."""
+    _REGISTRY.note_compile(
+        program, fn, args, kwargs, detail=detail, static=static,
+        wall_ms=wall_ms,
+    )
+
+
+def resolve_pending(limit: Optional[int] = None) -> int:
+    return _REGISTRY.resolve_pending(limit)
+
+
+# ── the drain-time join ──────────────────────────────────────────────
+
+
+def _wave_entry() -> Optional[ProgramCost]:
+    return (
+        _REGISTRY.latest("governance_wave_donated")
+        or _REGISTRY.latest("governance_wave")
+    )
+
+
+def _measured_wall_us(metrics, stage: str) -> Optional[float]:
+    from hypervisor_tpu.observability import metrics as mp
+
+    handle = mp.STAGE_LATENCY.get(stage)
+    if handle is None:
+        return None
+    n, p50 = metrics.host_quantile(handle, 0.5)
+    min_samples = int(_env_float("HV_ROOFLINE_MIN_SAMPLES", 2))
+    if n < min_samples or p50 <= 0:
+        return None
+    return float(p50)
+
+
+def floor_model(entry: Optional[ProgramCost] = None) -> Optional[dict]:
+    """The fused wave's modeled floor: live-buffer bytes over peak HBM
+    bandwidth (ROOFLINE.md §2's anchor — cost-analysis `bytes accessed`
+    prices padded layouts and register temporaries, an upper bound),
+    floored by the empirical per-dispatch floor
+    (`HV_ROOFLINE_DISPATCH_FLOOR_US`, default 30 µs)."""
+    entry = entry or _wave_entry()
+    if entry is None:
+        return None
+    floor_bytes = entry.peak_bytes or entry.bytes_accessed
+    if not floor_bytes:
+        return None
+    pk = peak_rates()
+    dispatch_floor = _env_float("HV_ROOFLINE_DISPATCH_FLOOR_US", 30.0)
+    bw_floor_us = float(floor_bytes) / pk["peak_bw_bytes_s"] * 1e6
+    return {
+        "program": entry.program,
+        "floor_bytes": int(floor_bytes),
+        "bw_floor_us": round(bw_floor_us, 3),
+        "dispatch_floor_us": dispatch_floor,
+        "modeled_floor_us": round(max(bw_floor_us, dispatch_floor), 3),
+    }
+
+
+def publish(metrics, *, resolve_limit: Optional[int] = 8) -> None:
+    """Join the registry's models with the measured host-plane walls
+    and publish the `hv_roofline_*` gauges — called from
+    `HypervisorState.metrics_snapshot` alongside the compile-counter
+    republish. HOST-ONLY: resolves a bounded batch of pending captures
+    (re-trace, no device data), reads host histograms, sets host-owned
+    gauges. Zero extra device transfers on the clean path."""
+    if not enabled():
+        return
+    from hypervisor_tpu.observability import metrics as mp
+
+    _REGISTRY.resolve_pending(resolve_limit)
+    pk = peak_rates()
+    wave_wall_us: Optional[float] = None
+    wave_entry = _wave_entry()
+    for program in mp.ROOFLINE_PROGRAMS:
+        entry = _REGISTRY.latest(program)
+        if entry is None or entry.error is not None:
+            continue
+        if entry.bytes_accessed is not None:
+            metrics.gauge_set(
+                mp.ROOFLINE_MODELED_BYTES[program], entry.bytes_accessed
+            )
+        if entry.flops is not None:
+            metrics.gauge_set(
+                mp.ROOFLINE_MODELED_FLOPS[program], entry.flops
+            )
+        stage = STAGE_OF_PROGRAM.get(program)
+        if stage is None:
+            continue
+        wall_us = _measured_wall_us(metrics, stage)
+        if wall_us is None:
+            continue
+        wall_s = wall_us / 1e6
+        if entry.bytes_accessed:
+            metrics.gauge_set(
+                mp.ROOFLINE_ACHIEVED_BW_FRAC[program],
+                entry.bytes_accessed / wall_s / pk["peak_bw_bytes_s"],
+            )
+        if entry.flops is not None:
+            metrics.gauge_set(
+                mp.ROOFLINE_MFU[program],
+                entry.flops / wall_s / pk["peak_flops_s"],
+            )
+        if wave_entry is not None and program == wave_entry.program:
+            wave_wall_us = wall_us
+    # Distance to the floor: the live ROOFLINE.md headline.
+    floor = floor_model(wave_entry)
+    if floor is not None and wave_wall_us is not None:
+        metrics.gauge_set(
+            mp.ROOFLINE_FLOOR_DISTANCE,
+            wave_wall_us / floor["modeled_floor_us"],
+        )
+    # Per-phase series: HLO byte model x cached measured shares. The
+    # shares cache fills wherever the tracer is drained anyway (debug
+    # endpoints, soak report) — never here.
+    if wave_entry is None:
+        return
+    pb = _REGISTRY.phase_model(wave_entry.program)
+    shares = _REGISTRY.phase_shares()
+    if not pb:
+        return
+    phase_total = sum(pb.get(p, 0) for p in HV_PHASES) or 1
+    for phase in HV_PHASES:
+        pbytes = pb.get(phase, 0)
+        metrics.gauge_set(mp.ROOFLINE_PHASE_BYTES[phase], pbytes)
+        if wave_entry.flops is not None:
+            metrics.gauge_set(
+                mp.ROOFLINE_PHASE_FLOPS[phase],
+                wave_entry.flops * pbytes / phase_total,
+            )
+        if shares and wave_wall_us:
+            share = float(shares.get(phase, 0.0))
+            if share > 0:
+                phase_wall_s = wave_wall_us / 1e6 * share
+                metrics.gauge_set(
+                    mp.ROOFLINE_PHASE_BW_FRAC[phase],
+                    pbytes / phase_wall_s / pk["peak_bw_bytes_s"],
+                )
+                if wave_entry.flops is not None:
+                    metrics.gauge_set(
+                        mp.ROOFLINE_PHASE_MFU[phase],
+                        (wave_entry.flops * pbytes / phase_total)
+                        / phase_wall_s
+                        / pk["peak_flops_s"],
+                    )
+
+
+# ── the /debug/roofline payload ──────────────────────────────────────
+
+
+def summary(metrics, *, tracer=None, resolve_all: bool = True) -> dict:
+    """Everything the observatory knows, joined: per-program catalog
+    (every captured bucket), the modeled-vs-measured table, per-phase
+    model + shares, HBM peak occupancy vs the footprint protocol, the
+    headroom ranking, and the floor block. Passing `tracer` refreshes
+    the phase shares (ONE trace-ring device_get — the endpoint's
+    documented drain, same cost `/debug/slo` pays); without it the
+    cached shares serve."""
+    if not enabled():
+        return {"enabled": False}
+    if resolve_all:
+        _REGISTRY.resolve_pending(None)
+    pk = peak_rates()
+    if tracer is not None:
+        from hypervisor_tpu.observability.attribution import (
+            wave_phase_shares,
+        )
+
+        shares = wave_phase_shares(tracer)
+        if shares:
+            _REGISTRY.set_phase_shares(shares)
+    shares = _REGISTRY.phase_shares()
+    programs: dict[str, dict] = {}
+    ranking: list[dict] = []
+    for program in _REGISTRY.programs():
+        entry = _REGISTRY.latest(program)
+        if entry is None:
+            continue
+        stage = STAGE_OF_PROGRAM.get(program)
+        wall_us = (
+            _measured_wall_us(metrics, stage) if stage is not None else None
+        )
+        row = {
+            "model": entry.to_dict(),
+            "buckets": [b.to_dict() for b in _REGISTRY.buckets(program)],
+            "stage": stage,
+            "wall_p50_us": round(wall_us, 1) if wall_us else None,
+            "achieved_bw_frac": None,
+            "mfu": None,
+            "modeled_floor_us": None,
+            "distance": None,
+        }
+        if wall_us and entry.bytes_accessed:
+            wall_s = wall_us / 1e6
+            row["achieved_bw_frac"] = round(
+                entry.bytes_accessed / wall_s / pk["peak_bw_bytes_s"], 6
+            )
+            if entry.flops is not None:
+                row["mfu"] = round(
+                    entry.flops / wall_s / pk["peak_flops_s"], 9
+                )
+            floor_bytes = entry.peak_bytes or entry.bytes_accessed
+            dispatch_floor = _env_float(
+                "HV_ROOFLINE_DISPATCH_FLOOR_US", 30.0
+            )
+            floor_us = max(
+                float(floor_bytes) / pk["peak_bw_bytes_s"] * 1e6,
+                dispatch_floor,
+            )
+            row["modeled_floor_us"] = round(floor_us, 3)
+            row["distance"] = round(wall_us / floor_us, 2)
+            ranking.append(
+                {
+                    "program": program,
+                    "wall_p50_us": round(wall_us, 1),
+                    "modeled_floor_us": round(floor_us, 3),
+                    "distance": row["distance"],
+                }
+            )
+        programs[program] = row
+    ranking.sort(key=lambda r: -r["distance"])
+    wave_entry = _wave_entry()
+    floor = floor_model(wave_entry)
+    if floor is not None and wave_entry is not None:
+        stage = STAGE_OF_PROGRAM.get(wave_entry.program)
+        wall_us = (
+            _measured_wall_us(metrics, stage) if stage is not None else None
+        )
+        floor["measured_p50_us"] = round(wall_us, 1) if wall_us else None
+        floor["distance"] = (
+            round(wall_us / floor["modeled_floor_us"], 2)
+            if wall_us
+            else None
+        )
+    phases_block = None
+    if wave_entry is not None:
+        pb = _REGISTRY.phase_model(wave_entry.program)
+        if pb:
+            phases_block = {
+                "program": wave_entry.program,
+                "modeled_bytes": pb,
+                "wall_shares": shares,
+            }
+    # Peak-HBM occupancy: the registry's live-program buffer peaks vs
+    # the footprint() protocol's table bytes (both are host metadata).
+    peak_program = max(
+        (
+            (e.peak_bytes, p)
+            for p in _REGISTRY.programs()
+            if (e := _REGISTRY.latest(p)) is not None and e.peak_bytes
+        ),
+        default=(0, None),
+    )
+    reg = _REGISTRY
+    return {
+        "enabled": True,
+        "peaks": pk,
+        "captures": reg.captures,
+        "capture_failures": reg.capture_failures,
+        "pending": reg.pending_count(),
+        "programs": programs,
+        "headroom": ranking,
+        "worst_program": ranking[0]["program"] if ranking else None,
+        "floor": floor,
+        "phases": phases_block,
+        "hbm": {
+            "peak_program_bytes": int(peak_program[0]),
+            "peak_program": peak_program[1],
+        },
+    }
+
+
+__all__ = [
+    "DISPATCH_OPS",
+    "WAVE_PHASES",
+    "ProgramCost",
+    "RooflineRegistry",
+    "STAGE_OF_PROGRAM",
+    "compiled_cost",
+    "enabled",
+    "entry_census",
+    "floor_model",
+    "note_compile",
+    "peak_rates",
+    "phase_bytes",
+    "phase_census",
+    "publish",
+    "registry",
+    "resolve_pending",
+    "shape_bytes",
+    "summary",
+]

@@ -35,6 +35,7 @@ from hypervisor_tpu.models import SessionConfig, SessionState
 from hypervisor_tpu.observability import profiling
 from hypervisor_tpu.observability import health as health_plane
 from hypervisor_tpu.observability import metrics as metrics_plane
+from hypervisor_tpu.observability import roofline as roofline_plane
 from hypervisor_tpu.observability import tracing as trace_plane
 from hypervisor_tpu.ops import admission, rate_limit, saga_ops, security_ops
 from hypervisor_tpu.ops import gateway as gateway_ops
@@ -385,6 +386,10 @@ class HypervisorState:
         # rides the same bracket that stamps CausalTraceIds.
         self.health = health_plane.HealthMonitor(self.metrics)
         self.tracer.health = self.health
+        # Roofline-observatory event cursor: the registry is process-
+        # global (like the compile log); each deployment drains its own
+        # view of the shift-event ring at its own metrics drain.
+        self._roofline_event_seq = 0
 
         self.agent_ids = InternTable()
         self.session_ids = InternTable()
@@ -3401,6 +3406,19 @@ class HypervisorState:
         # one device_get — high-water marks and capacity-warn events
         # from the freshly drained live-row gauges.
         health_plane.publish_compile_counters(self.metrics)
+        # Roofline observatory: resolve a bounded batch of pending
+        # compile-time cost captures (host re-trace, in-memory compile
+        # cache hit) and join the models with the host-plane stage
+        # walls into the hv_roofline_* gauges. Host-only — the drain's
+        # single device_get below stays the only transfer. Shift
+        # events (a recapture whose modeled bytes moved past the
+        # tolerance — live fusion-regression canary) fan through the
+        # health plane onto the bus.
+        roofline_plane.publish(self.metrics)
+        self._roofline_event_seq, shifts = roofline_plane.registry(
+        ).events_since(self._roofline_event_seq)
+        for shift in shifts:
+            self.health.emit_event("roofline_shift", shift)
         self.health.publish_footprints(self.health_tables())
         # Fused-epilogue fast path (round 9): when the LAST dispatch was
         # a fused governance wave and nothing mutated since, the gauge
@@ -3524,6 +3542,39 @@ class HypervisorState:
     def compile_summary(self) -> dict:
         """The `GET /debug/compiles` payload (process-global watch)."""
         return health_plane.compile_summary()
+
+    def roofline_summary(self, join_phases: bool = True) -> dict:
+        """The `GET /debug/roofline` payload: the modeled-vs-measured
+        table per program (every captured bucket), the per-phase byte
+        model joined with the measured wave-phase shares, peak-HBM
+        occupancy vs the footprint() protocol, the headroom ranking
+        (worst program named), and the floor block — the live twin of
+        ROOFLINE.md's static tables.
+
+        Resolves every pending compile-time capture (host re-trace,
+        cached compile) and — with `join_phases` — refreshes the phase
+        shares from the trace ring (ONE device_get, the endpoint's
+        documented drain, same cost `/debug/slo` pays). The clean-path
+        drain (`metrics_snapshot`) never pays either.
+        """
+        tracer = (
+            self.tracer
+            if join_phases and self.tracer.enabled
+            else None
+        )
+        out = roofline_plane.summary(self.metrics, tracer=tracer)
+        if not out.get("enabled"):
+            return out
+        out["backend"] = jax.default_backend()
+        # Footprint protocol join: the observatory's per-program live
+        # buffer peaks against the tables' own HBM accounting.
+        footprints = {
+            name: t.footprint() for name, t in self.health_tables().items()
+        }
+        out["hbm"]["tables_total_bytes"] = health_plane.hbm_total_bytes(
+            footprints
+        )
+        return out
 
     def serving_summary(self) -> dict:
         """The `GET /debug/serving` payload: queue depths/backpressure,

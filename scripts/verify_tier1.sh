@@ -62,6 +62,13 @@
 #      injected deadline-griefing burst must trip an
 #      slo.burn_rate_warning-or-worse alert whose alert log replays
 #      deterministically (same trace + seed => same alert digest),
+#   6h. a roofline-observatory gate (round 15) — seeded traffic with
+#      the observatory attached must yield a well-formed
+#      /debug/roofline payload (host-plane-clean JSON), a modeled
+#      HBM-bytes entry for EVERY entry point the run dispatched, every
+#      published achieved-bandwidth fraction finite and in (0, 1.5],
+#      and ZERO post-warmup recompiles with the observatory capturing
+#      (the registry's AOT re-trace must never touch the jit caches),
 #   6f. the hvlint static-analysis gate — both analyzer tiers
 #      (scripts/hvlint.sh): Tier A pure-AST contract rules (WAL
 #      coverage + REPLAY correspondence, per-call HV_* env arming,
@@ -797,6 +804,88 @@ print(
 PY
 observatory_rc=$?
 
+echo "── roofline-observatory gate ──"
+JAX_PLATFORMS=cpu python - <<'PY'
+# ISSUE-14 acceptance, smoke-sized: seeded traffic with the roofline
+# observatory attached (it always is — the CompileWatch hook feeds the
+# process-global registry) must (1) serve a well-formed, host-plane-
+# clean /debug/roofline payload, (2) hold a modeled-bytes entry for
+# EVERY entry point this run dispatched, (3) publish only finite
+# achieved-bandwidth fractions in (0, 1.5], and (4) add ZERO compiles/
+# recompiles after warmup — the registry's AOT captures must never
+# touch the jit caches the closed-bucket contract pins.
+import json
+import sys
+
+sys.path.insert(0, "examples")
+from _watch_common import build_state, drive_round
+
+from hypervisor_tpu.observability import health as health_plane
+from hypervisor_tpu.observability import roofline
+
+state = build_state(512)
+for rnd in range(3):
+    assert drive_round(state, 16, rnd, prefix="roofgate")
+    state.metrics_snapshot()
+
+# Post-warmup pin: identical-shape traffic with the observatory live.
+totals0 = health_plane._LOG.totals()
+for rnd in range(3, 6):
+    assert drive_round(state, 16, rnd, prefix="roofgate")
+    state.metrics_snapshot()
+payload = state.roofline_summary()
+totals1 = health_plane._LOG.totals()
+assert totals1["compiles"] == totals0["compiles"], (
+    f"observatory added compiles: {totals0} -> {totals1}"
+)
+assert totals1["recompiles"] == totals0["recompiles"], (
+    f"observatory added recompiles: {totals0} -> {totals1}"
+)
+
+# Well-formed + host-plane-clean (the PR 13 np.bool_ lesson): the
+# payload must round-trip stdlib json with no numpy scalars inside.
+encoded = json.dumps(payload)
+assert json.loads(encoded)["enabled"] is True
+
+# Every program THIS run dispatched-and-compiled must carry a model.
+watch_stats = health_plane.compile_summary()["by_program"]
+dispatched = {w["program"] for w in watch_stats if w["compiles"] > 0}
+missing = [
+    p
+    for p in dispatched
+    if (payload["programs"].get(p) or {}).get("model", {}).get(
+        "bytes_accessed"
+    ) in (None, 0)
+]
+assert not missing, f"dispatched programs missing modeled bytes: {missing}"
+
+# Achieved fractions: finite, in (0, 1.5].
+import math
+
+fracs = {
+    name: row["achieved_bw_frac"]
+    for name, row in payload["programs"].items()
+    if row.get("achieved_bw_frac") is not None
+}
+assert fracs, "no program joined a measured wall (no achieved fractions)"
+for name, frac in fracs.items():
+    assert math.isfinite(frac) and 0.0 < frac <= 1.5, (
+        f"{name}: achieved_bw_frac {frac} outside (0, 1.5]"
+    )
+
+# The floor block is live (the ROOFLINE.md replacement headline).
+floor = payload["floor"]
+assert floor and floor["modeled_floor_us"] > 0, floor
+assert floor["distance"] is None or floor["distance"] > 0
+print(
+    f"roofline observatory OK: {len(dispatched)} dispatched programs all "
+    f"modeled, fractions {min(fracs.values()):.6f}.."
+    f"{max(fracs.values()):.6f}, floor {floor['modeled_floor_us']} µs "
+    f"(distance {floor.get('distance')}x), zero post-warmup recompiles"
+)
+PY
+roofline_rc=$?
+
 echo "── hvlint static-analysis gate ──"
 # The contract analyzer (ISSUE 12): Tier A pure-AST rules (WAL
 # coverage, env arming, lock discipline, append-only registries, twin
@@ -866,6 +955,10 @@ fi
 if [ "$observatory_rc" -ne 0 ]; then
     echo "latency-observatory gate FAILED (rc=$observatory_rc)" >&2
     exit "$observatory_rc"
+fi
+if [ "$roofline_rc" -ne 0 ]; then
+    echo "roofline-observatory gate FAILED (rc=$roofline_rc)" >&2
+    exit "$roofline_rc"
 fi
 if [ "$hvlint_rc" -ne 0 ]; then
     echo "hvlint static-analysis gate FAILED (rc=$hvlint_rc)" >&2

@@ -538,6 +538,7 @@ def _suite_report(
     benches: dict[str, float],
     backend: str = "cpu",
     quick: bool = False,
+    roofline_wave_bytes: float = 7.5e6,
 ) -> dict:
     # Every real suite payload carries the audit-plane rows (the tree
     # unit's coverage, gated by regression.REQUIRED_SUITE_BENCHES) —
@@ -680,6 +681,56 @@ def _suite_report(
                 "programs_traced": 4,
             }
             if round_no >= 13
+            else None
+        ),
+        # Rounds >= regression.ROOFLINE_ROW_SINCE must carry the
+        # roofline row (round-15 presence gate, ISSUE 14); per-program
+        # modeled bytes are band-gated vs the comparable-prior median.
+        "roofline": (
+            {
+                "quick": quick,
+                "peak_bw_gbs": 64.0,
+                "peak_flops_g": 2000.0,
+                "programs": {
+                    "governance_wave_donated": {
+                        "modeled_bytes": roofline_wave_bytes,
+                        "modeled_flops": 3.1e6,
+                        "peak_bytes": 2.2e7,
+                        "wall_p50_us": 2048.0,
+                        "achieved_bw_frac": 0.057,
+                        "mfu": 7.5e-4,
+                    },
+                    "terminate_batch": {
+                        "modeled_bytes": 9.5e6,
+                        "modeled_flops": 1.0e5,
+                        "peak_bytes": 1.9e7,
+                        "wall_p50_us": 1365.0,
+                        "achieved_bw_frac": 0.108,
+                        "mfu": 3.6e-5,
+                    },
+                },
+                "phases": {
+                    "program": "governance_wave_donated",
+                    "modeled_bytes": {
+                        "admission": 104168, "fsm_saga": 1884736,
+                        "audit": 2112, "gateway": 0, "epilogue": 868420,
+                        "glue": 590864,
+                    },
+                    "wall_shares": {
+                        "admission": 0.08, "fsm_saga": 0.23,
+                        "audit": 0.08, "gateway": 0.0, "epilogue": 0.61,
+                    },
+                },
+                "floor": {
+                    "program": "governance_wave_donated",
+                    "floor_bytes": 22168517,
+                    "modeled_floor_us": 346.4,
+                    "measured_p50_us": 2048.0,
+                    "distance": 5.9,
+                },
+                "worst_program": "governance_wave_donated",
+            }
+            if round_no >= 15
             else None
         ),
     }
@@ -951,6 +1002,76 @@ class TestRegressionHarness:
         doc["static_analysis"]["findings"] = 2
         self._write(tmp_path, 13, doc)
         assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+
+    def test_missing_roofline_row_fails_from_round_15(self, tmp_path):
+        # ISSUE 14: the roofline row is REQUIRED from round 15 —
+        # dropping the observatory's bench coverage is a regression.
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 14, _suite_report(14, {"full_governance_pipeline": 10.0})
+        )
+        doc = _suite_report(15, {"full_governance_pipeline": 10.0})
+        doc["roofline"] = None
+        self._write(tmp_path, 15, doc)
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # A round carrying the row passes.
+        self._write(
+            tmp_path, 15,
+            _suite_report(15, {"full_governance_pipeline": 10.0}),
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
+
+    def test_roofline_modeled_bytes_band_gated(self, tmp_path):
+        # ISSUE 14 acceptance: a program's MODELED HBM bytes drifting
+        # past HV_BENCH_ROOFLINE_BYTES_TOL vs the comparable-prior
+        # median fails the gate — on the model alone, cpu-only (a
+        # fusion regression / donation miss inflates traffic without
+        # any chip measurement). Both directions gate.
+        from benchmarks import regression
+
+        for rnd in (15, 16):
+            self._write(
+                tmp_path, rnd,
+                _suite_report(rnd, {"full_governance_pipeline": 10.0}),
+            )
+        # Within the band: +10% passes at the default 25% tolerance.
+        self._write(
+            tmp_path, 17,
+            _suite_report(
+                17, {"full_governance_pipeline": 10.0},
+                roofline_wave_bytes=7.5e6 * 1.10,
+            ),
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
+        # Past the band: +60% modeled traffic fails.
+        self._write(
+            tmp_path, 17,
+            _suite_report(
+                17, {"full_governance_pipeline": 10.0},
+                roofline_wave_bytes=7.5e6 * 1.60,
+            ),
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # Shrinking traffic past the band fails too (model break).
+        self._write(
+            tmp_path, 17,
+            _suite_report(
+                17, {"full_governance_pipeline": 10.0},
+                roofline_wave_bytes=7.5e6 * 0.40,
+            ),
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # The env knob widens the band (read per gate run, HVA002).
+        import os
+
+        os.environ["HV_BENCH_ROOFLINE_BYTES_TOL"] = "0.7"
+        try:
+            assert regression.main(
+                ["--root", str(tmp_path), "--quiet"]
+            ) == 0
+        finally:
+            del os.environ["HV_BENCH_ROOFLINE_BYTES_TOL"]
 
     def test_next_round_path_advances(self, tmp_path):
         from benchmarks import regression

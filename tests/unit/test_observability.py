@@ -68,10 +68,11 @@ class TestEventBus:
         # README says 38 but its enum defines 40 — we match the enum)
         # plus the 3 health-plane events, the 4 resilience-plane
         # events, the 4 integrity-plane events, and the 4
-        # adversarial-plane events, and the 3 SLO burn-rate events
+        # adversarial-plane events, and the 3 SLO burn-rate events,
+        # and the roofline observatory's bytes-shift event
         # (append-only: codes are the device-log wire format, so every
         # earlier code stays stable).
-        assert len({t.code for t in EventType}) == len(EventType) == 58
+        assert len({t.code for t in EventType}) == len(EventType) == 59
         assert EventType.WAVE_STRAGGLER.code == 40
         assert EventType.CAPACITY_WARNING.code == 41
         assert EventType.RECOMPILE.code == 42

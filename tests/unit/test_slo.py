@@ -168,11 +168,14 @@ class TestSLOEngine:
         # Wire-format discipline: the new codes extend the enum, they
         # never renumber existing device-log rows (hvlint HVA004 pins
         # the committed baseline; this pins the tail order).
-        tail = list(EventType)[-3:]
+        tail = list(EventType)[-4:]
         assert tail == [
             EventType.SLO_BURN_RATE_WARNING,
             EventType.SLO_BURN_RATE_CRITICAL,
             EventType.SLO_RECOVERED,
+            # Round 15 appended the roofline observatory's shift
+            # canary BEHIND the slo triple — append-only holds.
+            EventType.ROOFLINE_BYTES_SHIFT,
         ]
 
 

@@ -161,6 +161,28 @@ ROOFLINE_ROW_SINCE = 15
 #: break worth a look too.
 DEFAULT_ROOFLINE_BYTES_TOL = 0.25
 
+#: The tenant-dense row joined the trajectory in round 16 (ISSUE 15):
+#: >=100 logical hypervisors behind one TenantArena — per-tenant p99
+#: vs the row's stated SLO, the T-tenant wave's dispatch-step census
+#: vs T separate single-tenant dispatches, amortized µs/op, and the
+#: zero-recompile contract over the warmed (bucket, T) tile set. A
+#: suite round from 16 on missing the row regresses the coverage.
+TENANT_ROW_SINCE = 16
+
+#: Amortization floor for the tenant wave (`HV_BENCH_TENANT_AMORT`
+#: overrides): dispatch-bearing steps for T separate single-tenant
+#: megakernel dispatches over the ONE T-tenant program's steps must
+#: stay >= this — the ISSUE 15 acceptance bar (>=50x at T=100, i.e.
+#: the batched wave holds <= 2x the solo census). Deterministic per
+#: jax/XLA version, devicelessly measured, so a de-vmapped or
+#: per-tenant-looped regression fails HERE with no chip attached.
+DEFAULT_TENANT_AMORT_FLOOR = 50.0
+
+#: Minimum tenant count the row must serve (`HV_BENCH_TENANT_MIN`
+#: overrides) — the acceptance criterion's ">=100 tenants from one
+#: process".
+DEFAULT_TENANT_MIN = 100
+
 
 def census_fusion_floor(round_num: int) -> float:
     """The fusion-ratio floor for a given round: env override, else the
@@ -349,6 +371,36 @@ def parse_round_file(path: Path) -> Optional[dict]:
                     "programs_traced": static.get("programs_traced"),
                 }
                 if isinstance(static, dict)
+                else None
+            ),
+            # Tenant-dense row (round 16, ISSUE 15): per-tenant p99 vs
+            # SLO, the T-tenant wave's amortization census, amortized
+            # µs/op, zero post-warmup recompiles — gated below.
+            tenant_dense=(
+                {
+                    "seed": tenant.get("seed"),
+                    "tenants": tenant.get("tenants"),
+                    "served": tenant.get("served"),
+                    "waves": tenant.get("waves"),
+                    "per_tenant_p99_ms": tenant.get("per_tenant_p99_ms"),
+                    "slo_p99_ms": tenant.get("slo_p99_ms"),
+                    "amortized_us_per_op": tenant.get(
+                        "amortized_us_per_op"
+                    ),
+                    "census": tenant.get("census"),
+                    "amortization_ratio": tenant.get(
+                        "amortization_ratio"
+                    ),
+                    "recompiles_after_warmup": tenant.get(
+                        "recompiles_after_warmup"
+                    ),
+                    "compiles_after_warmup": tenant.get(
+                        "compiles_after_warmup"
+                    ),
+                }
+                if isinstance(
+                    tenant := doc.get("tenant_dense"), dict
+                )
                 else None
             ),
             # Roofline row (round 15, ISSUE 14): per-program modeled
@@ -706,6 +758,79 @@ def compare(
             }
             checked.append(entry)
             if err > tol:
+                regressions.append(entry)
+    # Tenant-dense gates (round 16, ISSUE 15): presence from
+    # TENANT_ROW_SINCE, the tenant-count floor, the row's own stated
+    # per-tenant SLO, the amortization floor (the ONE-dispatch-for-T
+    # acceptance bar, devicelessly measured), and the zero-recompile
+    # contract over the warmed (bucket, T) tiles.
+    tenant = current.get("tenant_dense")
+    if (
+        current.get("format") == "suite"
+        and current["round"] >= TENANT_ROW_SINCE
+        and not tenant
+    ):
+        entry = {
+            "bench": "missing:tenant_dense",
+            "current_per_op_us": 0.0,
+            "baseline_per_op_us": 0.0,
+            "ratio": 0.0,
+        }
+        checked.append(entry)
+        regressions.append(entry)
+    if tenant:
+        n_tenants = tenant.get("tenants") or 0
+        env_min = os.environ.get("HV_BENCH_TENANT_MIN")
+        t_floor = float(env_min) if env_min else DEFAULT_TENANT_MIN
+        entry = {
+            "bench": "tenant_dense_tenants",
+            "current_per_op_us": float(n_tenants),
+            "baseline_per_op_us": t_floor,
+            "ratio": (
+                round(float(n_tenants) / t_floor, 3) if t_floor else 0.0
+            ),
+        }
+        checked.append(entry)
+        if float(n_tenants) < t_floor:
+            regressions.append(entry)
+        p99 = tenant.get("per_tenant_p99_ms")
+        slo = tenant.get("slo_p99_ms")
+        if p99 is not None and slo:
+            entry = {
+                "bench": "tenant_dense_p99_ms",
+                "current_per_op_us": float(p99),
+                "baseline_per_op_us": float(slo),
+                "ratio": round(float(p99) / float(slo), 3),
+            }
+            checked.append(entry)
+            if float(p99) > float(slo):
+                regressions.append(entry)
+        amort = tenant.get("amortization_ratio")
+        env_a = os.environ.get("HV_BENCH_TENANT_AMORT")
+        a_floor = float(env_a) if env_a else DEFAULT_TENANT_AMORT_FLOOR
+        entry = {
+            "bench": "tenant_dense_amortization",
+            "current_per_op_us": float(amort or 0.0),
+            "baseline_per_op_us": a_floor,
+            "ratio": (
+                round(float(amort or 0.0) / a_floor, 3)
+                if a_floor
+                else 0.0
+            ),
+        }
+        checked.append(entry)
+        if float(amort or 0.0) < a_floor:
+            regressions.append(entry)
+        recomp = tenant.get("recompiles_after_warmup")
+        if recomp is not None:
+            entry = {
+                "bench": "tenant_dense_recompiles_after_warmup",
+                "current_per_op_us": float(recomp),
+                "baseline_per_op_us": 0.0,
+                "ratio": float(recomp),
+            }
+            checked.append(entry)
+            if recomp != 0:
                 regressions.append(entry)
     # Static-analysis gates (round 13): presence from STATIC_ROW_SINCE,
     # then zero unsuppressed findings — hvlint findings shipping in a

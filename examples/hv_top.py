@@ -76,18 +76,41 @@ def poll_url(base: str) -> tuple[dict, dict[str, float], dict | None]:
             roofline = json.loads(resp.read())
     except (HTTPError, URLError, OSError, json.JSONDecodeError):
         roofline = None  # pre-r15 server or transient fetch failure
-    return health, counters, roofline
+    tenants = None
+    try:
+        with urlopen(f"{base}/debug/tenants", timeout=10) as resp:
+            tenants = json.loads(resp.read())
+    except (HTTPError, URLError, OSError, json.JSONDecodeError):
+        tenants = None  # pre-r16 server or transient fetch failure
+    return health, counters, roofline, tenants
 
 
-def poll_state(state) -> tuple[dict, dict[str, float], dict | None]:
-    """The in-process twin of `poll_url` (same payload shapes)."""
+def poll_state(
+    state, tenant_front=None
+) -> tuple[dict, dict[str, float], dict | None, dict | None]:
+    """The in-process twin of `poll_url` (same payload shapes).
+    `tenant_front` (a `tenancy.TenantFrontDoor`) supplies the tenants
+    panel; a solo state whose tables live in an arena reports that
+    arena's panel automatically."""
     health = state.health_summary()
     counters = parse_prometheus_counters(state.metrics_prometheus())
     try:
         roofline = state.roofline_summary()
     except Exception:  # noqa: BLE001 — panel shows n/a, never crashes
         roofline = None
-    return health, counters, roofline
+    tenants = None
+    try:
+        if tenant_front is not None:
+            tenants = tenant_front.summary()
+            tenants["enabled"] = True
+        else:
+            arena = getattr(state, "_tenant_arena", None)
+            if arena is not None:
+                tenants = arena.summary()
+                tenants["enabled"] = True
+    except Exception:  # noqa: BLE001 — panel shows n/a, never crashes
+        tenants = None
+    return health, counters, roofline, tenants
 
 
 def load_trajectory(root: Path) -> list[dict]:
@@ -113,6 +136,7 @@ def render(
     counters: dict[str, float],
     trajectory: list[dict],
     roofline: dict | None = None,
+    tenants: dict | None = None,
 ) -> str:
     lines = [
         f"hv_top @ {time.strftime('%H:%M:%S')}  "
@@ -263,6 +287,42 @@ def render(
             ),
         )
 
+    lines.append("")
+    if not tenants or not tenants.get("enabled"):
+        lines.append("tenants    (single-tenant deployment)")
+    else:
+        last = tenants.get("last_wave") or {}
+        lines.append(
+            f"tenants    T={tenants.get('num_tenants', 0):,}  "
+            f"batched_waves={tenants.get('waves', 0):,}  "
+            f"last: {last.get('tenants_served', 0)} tenants @ "
+            f"bucket {last.get('bucket', '-')}"
+        )
+        t_rows = []
+        for row in tenants.get("top_k", []):
+            burn = row.get("slo_states") or {}
+            burning = ",".join(
+                f"{q}:{s}" for q, s in sorted(burn.items()) if s != "ok"
+            )
+            t_rows.append(
+                (
+                    f"t{row.get('tenant')}",
+                    f"{row.get('sessions_live', 0):,}",
+                    f"{row.get('members', 0):,}",
+                    f"{row.get('queue_depth', 0):,}",
+                    f"{row.get('shed_rate', 0) * 100:.2f}%",
+                    burning or "ok",
+                    f"{row.get('pressure', 0):,}",
+                )
+            )
+        lines += fmt_table(
+            t_rows,
+            header=(
+                "tenant", "sessions", "members", "depth", "shed",
+                "burn", "pressure",
+            ),
+        )
+
     slo = health.get("slo", {})
     lines.append("")
     if not slo.get("enabled"):
@@ -386,8 +446,8 @@ def main(argv=None) -> int:
 
     if args.url:
         def frame() -> str:
-            health, counters, roofline = poll_url(args.url)
-            return render(health, counters, trajectory, roofline)
+            health, counters, roofline, tenants = poll_url(args.url)
+            return render(health, counters, trajectory, roofline, tenants)
 
         return watch_loop(frame, watch=args.watch, interval=args.interval)
 
@@ -424,8 +484,8 @@ def main(argv=None) -> int:
             progress["rnd"] += 1
 
     def frame() -> str:
-        health, counters, roofline = poll_state(state)
-        return render(health, counters, trajectory, roofline)
+        health, counters, roofline, tenants = poll_state(state)
+        return render(health, counters, trajectory, roofline, tenants)
 
     return watch_loop(
         frame, watch=args.watch, interval=args.interval, tick=tick

@@ -742,12 +742,20 @@ def byzantine_fuzz(
     return report
 
 
+from hypervisor_tpu.adversarial.noisy_neighbor import (  # noqa: E402
+    noisy_neighbor,
+)
+
 ADVERSARIES = {
     "sybil_flood": sybil_flood,
     "collusion_ring": collusion_ring,
     "slash_cascade": slash_cascade,
     "compensation_storm": compensation_storm,
     "byzantine_fuzz": byzantine_fuzz,
+    # Round 16 (tenant-dense serving): one byzantine tenant at full
+    # rate — containment scored on its NEIGHBORS (goodput, zero
+    # cross-tenant sheds, chain heads bit-identical to a solo oracle).
+    "noisy_neighbor": noisy_neighbor,
 }
 
 __all__ = [
@@ -755,6 +763,7 @@ __all__ = [
     "byzantine_fuzz",
     "collusion_ring",
     "compensation_storm",
+    "noisy_neighbor",
     "slash_cascade",
     "sybil_flood",
 ]

@@ -298,6 +298,52 @@ def _trace_targets():
         {"callbacks", "donation"},
     ))
 
+    # The tenant-arena donated dispatch (round 16): the `[T, …]`
+    # stacked wave traced THROUGH its jit wrapper, so HVB002's
+    # use-after-donate check covers the whole T-tenant donation
+    # frontier (agents/sessions/vouches/metrics/delta_log stacks).
+    from hypervisor_tpu.config import DEFAULT_CONFIG as _cfg
+    from hypervisor_tpu.tables.logs import EventLog
+    from hypervisor_tpu.tables.state import (
+        ElevationTable,
+        SagaTable,
+    )
+
+    t_axis = 2
+
+    def stack2(tree):
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (t_axis,) + x.shape), tree
+        )
+
+    tenant_fn = state_mod._TENANT_WAVE_DONATED._fn
+    tenant_args = (
+        stack2(agents), stack2(sessions), stack2(vouches),
+        stack2(mp.REGISTRY.create_table()),
+        stack2(DeltaLog.create(64)),
+        stack2(SagaTable.create(8, 4)), stack2(EventLog.create(16)),
+        stack2(ElevationTable.create(8)),
+        *(
+            jnp.broadcast_to(a, (t_axis,) + jnp.shape(a))
+            for a in wave_args[3:11]
+        ),
+        jnp.zeros((t_axis,), jnp.int32),          # range_lo
+        jnp.full((t_axis,), b, jnp.int32),        # range_hi
+        jnp.ones((t_axis, b), bool),              # lanes_valid
+        jnp.full((t_axis,), b, jnp.int32),        # n_sessions_valid
+        jnp.float32(0.0), jnp.float32(0.5),       # now, omega
+        jnp.asarray(_cfg.rate_limit.ring_bursts, jnp.float32),
+    )
+    targets.append((
+        "tenant_governance_wave_donated_call",
+        jax.make_jaxpr(lambda *a: tenant_fn(
+            *a, trust=_cfg.trust, breach=_cfg.breach,
+            rate_limit=_cfg.rate_limit, sanitize=True, config=_cfg,
+            cache_salt=0.0, wave_kernels=False,
+        ))(*tenant_args),
+        {"callbacks", "donation"},
+    ))
+
     return targets, forbidden
 
 

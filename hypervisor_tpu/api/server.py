@@ -74,6 +74,7 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/debug/serving", "debug_serving", None),
     ("GET", "/debug/slo", "debug_slo", None),
     ("GET", "/debug/roofline", "debug_roofline", None),
+    ("GET", "/debug/tenants", "debug_tenants", None),
     ("POST", "/debug/profile", "debug_profile", M.ProfileRequest),
     ("GET", "/api/v1/stats", "stats", None),
     ("GET", "/api/v1/device/stats", "device_stats", None),

@@ -721,6 +721,31 @@ class HypervisorService:
             out["exemplar_rows"] = serving.attribution.exemplars()[-16:]
         return out
 
+    async def debug_tenants(self) -> dict:
+        """`GET /debug/tenants`: the tenant-dense panel in one poll —
+        per-tenant live rows / queue depth / shed rate / SLO burn
+        state, pressure-ranked top-K, batched-wave cadence
+        (`tenancy.TenantArena.summary`, joined with each tenant door's
+        serving glance when a `TenantFrontDoor` is attached via
+        `service.tenancy = front`). A non-tenant deployment answers
+        `{"enabled": false}` — but a service whose OWN state is one
+        tenant of an arena reports that arena's panel, so any tenant's
+        transport doubles as the fleet view."""
+        front = getattr(self, "tenancy", None)
+        if front is not None:
+            out = front.summary()
+            out["enabled"] = True
+            return out
+        arena = getattr(self.hv.state, "_tenant_arena", None)
+        if arena is not None:
+            out = arena.summary()
+            out["enabled"] = True
+            out["via_tenant"] = getattr(
+                self.hv.state, "_tenant_idx", None
+            )
+            return out
+        return {"enabled": False}
+
     async def debug_roofline(self) -> dict:
         """`GET /debug/roofline`: the roofline observatory in one poll
         — per-program modeled bytes/FLOPs (every captured bucket), the

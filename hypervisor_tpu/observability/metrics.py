@@ -27,7 +27,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Iterator, Mapping
+from typing import Iterator, Mapping, Optional
 
 import numpy as np
 
@@ -220,6 +220,13 @@ STAGES: tuple[str, ...] = (
     "delta_chain",
     "terminate_wave",
     "reconcile_wave_sessions",
+    # Tenant-dense serving (round 16): the arena's ONE-dispatch-for-T
+    # batched programs, bracketed on the ARENA's host metrics plane
+    # (per-tenant planes carry the per-tenant series; a T-tenant wall
+    # is not any one tenant's latency). Appended — STAGES is an
+    # append-only registry like the EventType codes (hvlint HVA004).
+    "tenant_governance_wave",
+    "tenant_sessions_create",
 )
 STAGE_LATENCY: dict[str, MetricHandle] = {
     stage: REGISTRY.histogram(
@@ -581,6 +588,15 @@ ROOFLINE_PROGRAMS: tuple[str, ...] = (
     "gateway_check_actions",
     "update_gauges",
     "merge_wave_session_states",
+    # Tenant-dense serving (round 16): the arena's batched programs —
+    # the roofline observatory models the `[T, …]` dispatch like any
+    # other watched entry point (per-tenant bytes scale ~linearly with
+    # T; the dispatch cost does not — that gap IS the amortization the
+    # tenant_dense bench row pins). Appended (HVA004).
+    "tenant_governance_wave",
+    "tenant_governance_wave_donated",
+    "tenant_sessions_create",
+    "tenant_update_gauges",
 )
 ROOFLINE_MODELED_BYTES = {
     p: REGISTRY.gauge(
@@ -774,8 +790,16 @@ class Metrics:
 
     # ── drain ────────────────────────────────────────────────────────
 
-    def snapshot(self, refresh=None) -> "MetricsSnapshot":
+    def snapshot(self, refresh=None, host_table=None) -> "MetricsSnapshot":
         """Merge both planes into an immutable snapshot.
+
+        `host_table` — an ALREADY-FETCHED host copy of this plane's
+        device table (numpy leaves, same MetricsTable structure) —
+        skips the device_get entirely: the tenant arena drains T
+        planes out of ONE `jax.device_get` of its stacked `[T, …]`
+        table and feeds each tenant's wrap accounting its slice here.
+        Mutually exclusive with `refresh` (the arena refreshes the
+        stacked table before the one fetch).
 
         ONE `jax.device_get` of the whole table — the only device
         round-trip in the metrics plane, and it happens here, outside
@@ -800,17 +824,28 @@ class Metrics:
         """
         import jax
 
+        if host_table is not None and refresh is not None:
+            raise ValueError(
+                "snapshot(host_table=...) is the pre-fetched drain; "
+                "refresh the table before the one device_get instead"
+            )
         with self._drain_lock:
             with self._lock:
-                table = self.table
+                # Pre-fetched drains never read `self.table` — for a
+                # tenant plane that read would dispatch a [T, …] slice
+                # the arena's one stacked fetch already covers.
+                table = None if host_table is not None else self.table
                 h_counters = self._h_counters.copy()
                 h_hist = self._h_hist.copy()
                 h_sum = self._h_sum.copy()
                 h_gauges = self._h_gauges.copy()
                 h_gauge_owned = self._h_gauge_owned.copy()
-            if refresh is not None:
-                table = refresh(table)
-            host = jax.device_get(table)
+            if host_table is not None:
+                host = host_table
+            else:
+                if refresh is not None:
+                    table = refresh(table)
+                host = jax.device_get(table)
             # COPIES, not views: `_d_*_raw` persist across drains, and
             # device_get of a CPU jax.Array is zero-copy — under the
             # round-9 donation default the metrics buffer is rewritten
@@ -903,33 +938,53 @@ class MetricsSnapshot:
         """
         return _bucket_quantile(self.hist[handle.index], self.bounds, q)
 
-    def to_prometheus(self) -> str:
-        """Prometheus/OpenMetrics text exposition (version 0.0.4)."""
+    def to_prometheus(
+        self, extra_labels: Optional[Mapping[str, str]] = None,
+        emit_headers: bool = True,
+    ) -> str:
+        """Prometheus/OpenMetrics text exposition (version 0.0.4).
+
+        `extra_labels` is injected into EVERY series (the tenant-arena
+        drain stamps `tenant="<id>"` so per-class serving latency, SLO
+        burn, shed, and occupancy series stay per-tenant in one merged
+        exposition — the ISSUE 15 latency-label fix); `emit_headers`
+        off suppresses the HELP/TYPE block so T per-tenant renderings
+        concatenate into one valid exposition (headers once, from the
+        first tenant)."""
         lines: list[str] = []
         seen_header: set[str] = set()
+        extra = dict(extra_labels or {})
 
         def header(name: str, kind: str, help: str) -> None:
-            if name in seen_header:
+            if not emit_headers or name in seen_header:
                 return
             seen_header.add(name)
             if help:
                 lines.append(f"# HELP {name} {help}")
             lines.append(f"# TYPE {name} {kind}")
 
+        def label_str(h: MetricHandle) -> str:
+            if not extra:
+                return h.label_str()
+            merged = dict(h.labels)
+            merged.update(extra)
+            return _labels(merged)
+
         for h in self.registry.handles:
             if h.kind == COUNTER:
                 header(h.name, COUNTER, h.help)
                 lines.append(
-                    f"{h.name}{h.label_str()} {int(self.counters[h.index])}"
+                    f"{h.name}{label_str(h)} {int(self.counters[h.index])}"
                 )
             elif h.kind == GAUGE:
                 header(h.name, GAUGE, h.help)
                 lines.append(
-                    f"{h.name}{h.label_str()} {_fmt(self.gauges[h.index])}"
+                    f"{h.name}{label_str(h)} {_fmt(self.gauges[h.index])}"
                 )
             else:
                 header(h.name, HISTOGRAM, h.help)
                 base = dict(h.labels)
+                base.update(extra)
                 cum = 0
                 for b, bound in enumerate(self.bounds):
                     cum += int(self.hist[h.index, b])

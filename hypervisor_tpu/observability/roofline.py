@@ -385,6 +385,12 @@ STAGE_OF_PROGRAM: dict[str, str] = {
     "slash_cascade": "slash_cascade",
     "breach_sweep": "breach_sweep",
     "merge_wave_session_states": "reconcile_wave_sessions",
+    # Tenant-dense serving (round 16): the arena brackets its batched
+    # dispatches on its OWN host metrics plane under these stages, so
+    # the observatory joins the [T, …] model with the arena's walls.
+    "tenant_governance_wave": "tenant_governance_wave",
+    "tenant_governance_wave_donated": "tenant_governance_wave",
+    "tenant_sessions_create": "tenant_sessions_create",
 }
 
 #: Programs whose compiled text is walked for the per-phase byte model

@@ -116,6 +116,60 @@ def _twin_call_lowering(ctx, *operands, twin, result_avals):
 _jmlir.register_lowering(_TWIN_CALL_P, _twin_call_lowering)
 
 
+def _twin_call_batcher(args, dims, *, twin, result_avals):
+    """vmap rule for the twin boundary: ONE custom call for the whole
+    batch (the tenant arena's `[T, …]` wave, ISSUE 15). The wrapped
+    twin walks the leading tenant axis in a host loop — the CPU-twin
+    analog of batching a Mosaic block via a leading grid axis (what a
+    pallas_call's native batching rule does on chip) — so a T-tenant
+    megakernel wave keeps the solo wave's block-boundary dispatch
+    census instead of multiplying it by T. Unbatched operands (shared
+    scalars/configs) pass through to every slice unchanged."""
+    from jax.interpreters import batching as _jbatching
+
+    size = next(
+        a.shape[d]
+        for a, d in zip(args, dims)
+        if d is not _jbatching.not_mapped
+    )
+    moved = [
+        a
+        if d is _jbatching.not_mapped
+        else _jbatching.moveaxis(a, d, 0)
+        for a, d in zip(args, dims)
+    ]
+    is_batched = [d is not _jbatching.not_mapped for d in dims]
+
+    def batched_twin(*flat):
+        outs = [
+            twin(
+                *(
+                    f[i] if b else f
+                    for f, b in zip(flat, is_batched)
+                )
+            )
+            for i in range(size)
+        ]
+        return tuple(
+            np.stack([o[j] for o in outs])
+            for j in range(len(result_avals))
+        )
+
+    new_avals = tuple(
+        _jcore.ShapedArray((size,) + a.shape, a.dtype)
+        for a in result_avals
+    )
+    out = _TWIN_CALL_P.bind(
+        *moved, twin=batched_twin, result_avals=new_avals
+    )
+    return out, (0,) * len(out)
+
+
+from jax.interpreters import batching as _jbatching_reg  # noqa: E402
+
+_jbatching_reg.primitive_batchers[_TWIN_CALL_P] = _twin_call_batcher
+
+
 def _cb(twin, shapes, *args):
     """One block = one custom call: the numpy twin out-of-line."""
     result_avals = tuple(

@@ -73,9 +73,18 @@ class WaveScheduler:
 
     # ── the tick ─────────────────────────────────────────────────────
 
-    def tick(self, now: Optional[float] = None) -> dict:
+    def tick(
+        self,
+        now: Optional[float] = None,
+        classes: Optional[tuple] = None,
+    ) -> dict:
         """One scheduling pass; dispatches every due class. Returns a
-        report of dispatched waves per class."""
+        report of dispatched waves per class.
+
+        `classes` restricts the pass to a subset of request classes —
+        the tenant scheduler (`tenancy.front_door.TenantWaveScheduler`)
+        drains lifecycles itself through the batched tenant wave and
+        runs each tenant's solo pass for the rest."""
         fd = self.front_door
         now = self.state.now() if now is None else float(now)
         report = {q: 0 for q in fd._queues}
@@ -83,39 +92,53 @@ class WaveScheduler:
             self.ticks += 1
             # Lifecycles first: full buckets drain in exact quanta, a
             # deadline flush pads the remainder.
-            while len(fd.lifecycles) >= self.config.max_bucket:
-                self._dispatch_lifecycles(
-                    self._take(fd.lifecycles, self.config.max_bucket), now
-                )
-                report["lifecycle"] += 1
-            if self._due(fd.lifecycles, self.config.lifecycle_deadline_s, now):
-                self._dispatch_lifecycles(
-                    self._take(fd.lifecycles, self.config.max_bucket), now
-                )
-                report["lifecycle"] += 1
+            if classes is None or "lifecycle" in classes:
+                while len(fd.lifecycles) >= self.config.max_bucket:
+                    self._dispatch_lifecycles(
+                        self._take(fd.lifecycles, self.config.max_bucket),
+                        now,
+                    )
+                    report["lifecycle"] += 1
+                if self._due(
+                    fd.lifecycles, self.config.lifecycle_deadline_s, now
+                ):
+                    self._dispatch_lifecycles(
+                        self._take(fd.lifecycles, self.config.max_bucket),
+                        now,
+                    )
+                    report["lifecycle"] += 1
             # Joins: the staging queue IS the wave; one padded flush
             # serves everything pending.
-            if self._due(fd.joins, self.config.join_deadline_s, now):
+            if (classes is None or "join" in classes) and self._due(
+                fd.joins, self.config.join_deadline_s, now
+            ):
                 self._dispatch_joins(now)
                 report["join"] += 1
             # Actions: chunk to the largest bucket (the gateway pads
             # each chunk to a power of two itself).
-            while self._due(fd.actions, self.config.action_deadline_s, now):
-                self._dispatch_actions(
-                    self._take(fd.actions, self.config.max_bucket), now
-                )
-                report["action"] += 1
+            if classes is None or "action" in classes:
+                while self._due(
+                    fd.actions, self.config.action_deadline_s, now
+                ):
+                    self._dispatch_actions(
+                        self._take(fd.actions, self.config.max_bucket), now
+                    )
+                    report["action"] += 1
             # Terminations: park-padded buckets.
-            while self._due(
-                fd.terminations, self.config.terminate_deadline_s, now
-            ):
-                self._dispatch_terminations(
-                    self._take(fd.terminations, self.config.max_bucket), now
-                )
-                report["terminate"] += 1
+            if classes is None or "terminate" in classes:
+                while self._due(
+                    fd.terminations, self.config.terminate_deadline_s, now
+                ):
+                    self._dispatch_terminations(
+                        self._take(fd.terminations, self.config.max_bucket),
+                        now,
+                    )
+                    report["terminate"] += 1
             # Saga settles: one whole-table round, outcomes deduped by
             # slot (later outcomes for the same saga wait a round).
-            if self._due(fd.saga_steps, self.config.saga_deadline_s, now):
+            if (classes is None or "saga" in classes) and self._due(
+                fd.saga_steps, self.config.saga_deadline_s, now
+            ):
                 self._dispatch_sagas(now)
                 report["saga"] += 1
             fd.refresh_depth_gauges()

@@ -276,6 +276,159 @@ _MERGE_WAVE_SESSION_STATES = health_plane.instrument(
 )
 
 
+# ── tenant-dense entry points (round 16) ─────────────────────────────
+# T logical hypervisors, ONE donated XLA program: the fused governance
+# wave vmapped over a leading tenant axis. Every per-tenant table/ring
+# arrives stacked `[T, …]` (`tenancy.arena.TenantArena` owns the
+# stacks); lane inputs are `[T, B]`/`[T, K]`. The per-tenant body is
+# BIT-IDENTICAL to the single-device fused wave (pinned by
+# tests/unit/test_tenancy.py — the isolation contract's foundation), so
+# WAL replay of a tenant's lanes through the solo program converges on
+# the same tables. Statics are UNIFORM across the arena (one config per
+# arena); the two per-wave layout statics the solo path toggles are
+# pinned to the general values (`unique_sessions=False` — the sort path
+# is correct for every lane layout — and the mask-free `wave_range`
+# rides as traced per-tenant scalars), so the jit cache holds exactly
+# one entry per (bucket, T) tile. The Mosaic megakernel blocks batch
+# through the twin boundary's vmap rule (`ops.wave_blocks.
+# _twin_call_batcher` — one custom call walks the leading tenant axis;
+# on chip a pallas_call's native batching rule prepends the same axis
+# to the grid), so the armed T-tenant wave keeps the solo megakernel's
+# block-boundary dispatch census instead of multiplying it by T. The
+# Pallas sha256 hashers stay off under vmap (`use_pallas=False` — the
+# jnp path is the vmap-proven one; chip-side follow-up in
+# docs/OPERATIONS.md "Tenant-dense serving").
+_TENANT_WAVE_STATICS = (
+    "trust", "breach", "rate_limit", "sanitize", "config", "cache_salt",
+    "wave_kernels",
+)
+
+
+def _tenant_wave_fn(
+    agents, sessions, vouches, metrics, delta_log, sagas, event_log,
+    elevations, slot, did, session_slot, sigma_raw, trustworthy,
+    duplicate, wave_sessions, delta_bodies, range_lo, range_hi,
+    lanes_valid, n_sessions_valid, now, omega, ring_bursts,
+    *, trust, breach, rate_limit, sanitize, config, cache_salt,
+    wave_kernels,
+):
+    def per_tenant(
+        agents, sessions, vouches, metrics, delta_log, sagas, event_log,
+        elevations, slot, did, session_slot, sigma_raw, trustworthy,
+        duplicate, wave_sessions, delta_bodies, lo, hi, lanes_valid,
+        n_sessions_valid,
+    ):
+        return pipeline_ops.governance_wave(
+            agents, sessions, vouches, slot, did, session_slot,
+            sigma_raw, trustworthy, duplicate, wave_sessions,
+            delta_bodies, now, omega,
+            trust=trust, use_pallas=False, ring_bursts=ring_bursts,
+            wave_range=(lo, hi), unique_sessions=False, metrics=metrics,
+            trace=None, trace_ctx=None, elevations=elevations,
+            gateway_args=None, breach=breach, rate_limit=rate_limit,
+            delta_log=delta_log, epilogue_tables=(sagas, event_log),
+            sanitize=sanitize, config=config, cache_salt=cache_salt,
+            lanes_valid=lanes_valid, n_sessions_valid=n_sessions_valid,
+            wave_kernels=wave_kernels,
+        )
+
+    return jax.vmap(per_tenant)(
+        agents, sessions, vouches, metrics, delta_log, sagas, event_log,
+        elevations, slot, did, session_slot, sigma_raw, trustworthy,
+        duplicate, wave_sessions, delta_bodies, range_lo, range_hi,
+        lanes_valid, n_sessions_valid,
+    )
+
+
+# Plain/donated twins mirror `_WAVE`/`_WAVE_DONATED`: the donated twin
+# is the default (ONE donation frontier covers all T tenants'
+# tables/rings — the stacked buffers alias into the outputs, the arena
+# holds the only live reference) and every donated dispatch passes the
+# process-unique `cache_salt` so a donated executable can never be
+# reloaded from the persistent cache; `HV_DONATE_TABLES=0` opts out
+# bit-identically through the plain twin. The read-only epilogue stacks
+# (sagas, EventLog, elevations) flow through undonated on both.
+_TENANT_WAVE = health_plane.instrument(
+    "tenant_governance_wave",
+    jax.jit(_tenant_wave_fn, static_argnames=_TENANT_WAVE_STATICS),
+    static_argnames=_TENANT_WAVE_STATICS,
+)
+_TENANT_WAVE_DONATED = health_plane.instrument(
+    "tenant_governance_wave_donated",
+    jax.jit(
+        _tenant_wave_fn,
+        static_argnames=_TENANT_WAVE_STATICS,
+        donate_argnames=(
+            "agents", "sessions", "vouches", "metrics", "delta_log",
+        ),
+    ),
+    static_argnames=_TENANT_WAVE_STATICS,
+)
+
+
+def _tenant_sessions_create_fn(
+    sessions, rows, sids, valid, state_code, mode_code, max_participants,
+    min_sigma_eff, enable_audit,
+):
+    """Initialise each tenant's freshly allocated session rows — the
+    vmapped twin of `create_sessions_batch`'s device write, so a
+    T-tenant serving round pays ONE dispatch for all its session
+    creates instead of T. `valid=False` lanes scatter out of bounds and
+    drop (tenants create ragged counts under one [T, K] shape); the
+    session config scalars are UNIFORM across the arena round (mixed
+    configs go through the per-tenant solo path)."""
+
+    def per_tenant(sessions, rows, sids, valid):
+        cap = sessions.i32.shape[0]
+        safe = jnp.where(valid, rows, cap)
+        return replace(
+            sessions,
+            sid=sessions.sid.at[safe].set(sids, mode="drop"),
+            state=sessions.state.at[safe].set(state_code, mode="drop"),
+            mode=sessions.mode.at[safe].set(mode_code, mode="drop"),
+            max_participants=sessions.max_participants.at[safe].set(
+                max_participants, mode="drop"
+            ),
+            min_sigma_eff=sessions.min_sigma_eff.at[safe].set(
+                min_sigma_eff, mode="drop"
+            ),
+            enable_audit=sessions.enable_audit.at[safe].set(
+                enable_audit, mode="drop"
+            ),
+        )
+
+    return jax.vmap(per_tenant, in_axes=(0, 0, 0, 0))(
+        sessions, rows, sids, valid
+    )
+
+
+_TENANT_SESSIONS_CREATE = health_plane.instrument(
+    "tenant_sessions_create",
+    jax.jit(
+        _tenant_sessions_create_fn, donate_argnames=("sessions",)
+    ),
+)
+
+
+def _tenant_update_gauges_fn(
+    table, agents, sessions, vouches, sagas, elevations, delta_log,
+    event_log, trace,
+):
+    """Occupancy-gauge refresh over every tenant's tables at once — the
+    arena drain's stale-gauge fallback (the fused tenant wave refreshes
+    in-program, so this only dispatches after out-of-wave mutations)."""
+    in_axes = (0,) * 8 + ((0 if trace is not None else None),)
+    return jax.vmap(metrics_plane.update_gauges, in_axes=in_axes)(
+        table, agents, sessions, vouches, sagas, elevations, delta_log,
+        event_log, trace,
+    )
+
+
+_TENANT_UPDATE_GAUGES = health_plane.instrument(
+    "tenant_update_gauges", jax.jit(_tenant_update_gauges_fn)
+)
+
+
 def _active_wave_watch():
     """The CompileWatch the single-device bridge dispatches RIGHT NOW —
     the donated twin by default, `_WAVE` under the `HV_DONATE_TABLES=0`
@@ -321,15 +474,17 @@ def _mkeys(sessions: np.ndarray, dids: np.ndarray) -> np.ndarray:
     ) | (np.asarray(dids, np.int64) & 0xFFFFFFFF)
 
 
-def _contiguous_range(slots: np.ndarray) -> tuple | None:
-    """(lo, hi) i32 scalars if `slots` is exactly arange(lo, lo+len).
+def _contiguous_range_host(slots: np.ndarray) -> tuple[int, int] | None:
+    """(lo, hi) plain ints if `slots` is exactly arange(lo, lo+len).
 
     The qualification gate for terminate's range-compare fast path
     (`ops.terminate.release_session_scope` wave_range): the ONE place
-    the invariant is spelled out, shared by governance-wave staging and
+    the invariant is spelled out, shared by governance-wave staging
+    (single-device, mesh, AND the tenant arena's batched staging) and
     `terminate_sessions`. Returns None for anything else — empty,
     negative first slot, gaps, duplicates, or non-ascending order —
-    which keeps callers on the mask path.
+    which keeps callers on the mask path. Host ints so tenant staging
+    can stack T ranges into one [T] device put (`tenancy.arena`).
     """
     slots = np.asarray(slots)
     if slots.size == 0 or int(slots[0]) < 0:
@@ -339,7 +494,16 @@ def _contiguous_range(slots: np.ndarray) -> tuple | None:
         slots, np.arange(lo, lo + slots.size, dtype=slots.dtype)
     ):
         return None
-    return (jnp.asarray(lo, jnp.int32), jnp.asarray(lo + slots.size, jnp.int32))
+    return (lo, lo + slots.size)
+
+
+def _contiguous_range(slots: np.ndarray) -> tuple | None:
+    """`_contiguous_range_host` as traced i32 scalars (the form the
+    single-device/mesh dispatch sites thread into the programs)."""
+    r = _contiguous_range_host(slots)
+    if r is None:
+        return None
+    return (jnp.asarray(r[0], jnp.int32), jnp.asarray(r[1], jnp.int32))
 
 
 def _config_payload(config: SessionConfig) -> dict:
@@ -371,14 +535,17 @@ class HypervisorState:
         # jitted waves scatter into) + its host drain. Waves thread
         # `self.metrics.table` through and commit the returned update;
         # `metrics_snapshot()` is the ONE device_get, outside every wave.
-        self.metrics = metrics_plane.Metrics()
+        # Built through a factory hook so `tenancy.arena.TenantState`
+        # can route the device table into the arena's stacked pytree.
+        self.metrics = self._make_metrics()
         # Flight recorder (trace plane): the TraceLog ring rides the
         # jitted waves exactly like the metrics table (stamp scatters,
         # no host transfer), the host side brackets every dispatch with
         # wall-clock + a CausalTraceId, and `tracer.drain()` is the ONE
         # device_get — outside every wave. HV_TRACE=0 disables;
         # HV_TRACE_SAMPLE sets the head-based per-session sample rate.
-        self.tracer = trace_plane.Tracer(capacity=cap.trace_log_capacity)
+        # Factory hook, same reason as the metrics plane above.
+        self.tracer = self._make_tracer(cap.trace_log_capacity)
         # Health plane: wave watchdog (deadlines from the stages' own
         # host-plane latency histograms), occupancy high-water/warn
         # accounting, and the event fan-out the facade bridges onto the
@@ -534,6 +701,16 @@ class HypervisorState:
         # (list of EventualPartials, D rows per wave).
         self._pending_partials: list = []
 
+    def _make_metrics(self) -> "metrics_plane.Metrics":
+        """Metrics-plane factory (overridden by `tenancy.arena.
+        TenantState` to route the device table through the arena's
+        stacked `[T, …]` pytree)."""
+        return metrics_plane.Metrics()
+
+    def _make_tracer(self, capacity: int) -> "trace_plane.Tracer":
+        """Trace-plane factory (same override hook as `_make_metrics`)."""
+        return trace_plane.Tracer(capacity=capacity)
+
     def now(self) -> float:
         """Seconds since this state's epoch — the f32-safe device time."""
         return time.time() - self._epoch_base
@@ -672,10 +849,15 @@ class HypervisorState:
             )
         return slot
 
-    def create_sessions_batch(
+    def _stage_sessions_batch(
         self, session_ids: Sequence[str], config: SessionConfig
     ) -> np.ndarray:
-        """Allocate K session rows in HANDSHAKING in one device op."""
+        """HOST half of `create_sessions_batch`: slot allocation + the
+        WAL record, NO device write. The tenant arena stages T tenants'
+        batches through this and initialises all their rows in ONE
+        vmapped program (`_TENANT_SESSIONS_CREATE`); WAL replay
+        re-executes the full `create_sessions_batch`, whose solo device
+        write is bit-identical to the vmapped one's slice."""
         k = len(session_ids)
         base = self._next_session_slot
         if base + k > self.sessions.sid.shape[0]:
@@ -690,9 +872,24 @@ class HypervisorState:
             **_config_payload(config),
         ):
             self._next_session_slot += k
-            slots = np.arange(base, base + k, dtype=np.int32)
+        return np.arange(base, base + k, dtype=np.int32)
+
+    def create_sessions_batch(
+        self, session_ids: Sequence[str], config: SessionConfig
+    ) -> np.ndarray:
+        """Allocate K session rows in HANDSHAKING in one device op."""
+        with self._journal(
+            "create_sessions_batch",
+            sids=list(session_ids),
+            **_config_payload(config),
+        ):
+            # Re-entrant journal: the inner staging record suppresses
+            # under this bracket, so the op journals exactly once on
+            # either path (solo here, per tenant in the arena).
+            slots = self._stage_sessions_batch(session_ids, config)
             sids = np.array(
-                [self.session_ids.intern(s) for s in session_ids], np.int32
+                [self.session_ids.intern(s) for s in session_ids],
+                np.int32,
             )
             sl = jnp.asarray(slots)
             self.sessions = replace(
@@ -704,9 +901,9 @@ class HypervisorState:
                 mode=self.sessions.mode.at[sl].set(
                     jnp.int8(config.consistency_mode.code)
                 ),
-                max_participants=self.sessions.max_participants.at[sl].set(
-                    config.max_participants
-                ),
+                max_participants=self.sessions.max_participants.at[
+                    sl
+                ].set(config.max_participants),
                 min_sigma_eff=self.sessions.min_sigma_eff.at[sl].set(
                     config.min_sigma_eff
                 ),
@@ -752,6 +949,148 @@ class HypervisorState:
             ],
             np.int32,
         )
+
+    def _claim_wave_rows(self, b_wave: int) -> np.ndarray:
+        """Claim `b_wave` agent rows for one single-device wave.
+
+        Bucket padding (serving): pad lanes claim rows like real ones —
+        all of a single-device wave's rows recycle through the free
+        list after the wave, so the claim is transient.
+
+        Rows come from the bump allocator while it lasts, then from the
+        FREE LIST: wave rows are dead after the wave (their sessions
+        terminate in-program) and recycle in `_publish_wave_members`,
+        so a continuously-serving deployment reuses them instead of
+        exhausting the table in minutes (the serving soak found exactly
+        that). Fresh-first keeps short-lived states on the historical
+        row layout; free-list order is deterministic per op sequence,
+        so WAL replay allocates the identical rows. The staging lock
+        guards both cursors against concurrent producers.
+        """
+        with self._enqueue_lock:
+            cap = self.agents.did.shape[0]
+            fresh_n = min(b_wave, cap - self._next_agent_slot)
+            free = self._free_agent_slots
+            need = b_wave - fresh_n
+            if need > len(free):
+                raise RuntimeError(
+                    f"agent table full: {self._next_agent_slot} + "
+                    f"{b_wave} > {cap} with {len(free)} free rows; "
+                    "raise config.capacity.max_agents"
+                )
+            fresh = list(
+                range(
+                    self._next_agent_slot,
+                    self._next_agent_slot + fresh_n,
+                )
+            )
+            self._next_agent_slot += fresh_n
+            recycled = [free.pop() for _ in range(need)]
+        return np.array(fresh + recycled, np.int32)
+
+    def _park_sessions(self, n_parked: int, kind: str) -> np.ndarray:
+        """Park `n_parked` wave-session lanes on UNALLOCATED rows past
+        the bump cursor (no allocation — a parked row's no-member walk
+        is a masked no-op). Shared by the mesh path's ragged rounding,
+        the serving scheduler's bucket padding, and the tenant arena's
+        fixed-shape staging."""
+        if n_parked <= 0:
+            return np.zeros((0,), np.int32)
+        s_cap = self.sessions.sid.shape[0]
+        if self._next_session_slot + n_parked > s_cap:
+            raise RuntimeError(
+                f"no spare session rows to park {n_parked} {kind} "
+                f"lanes ({self._next_session_slot}+{n_parked} "
+                f"> {s_cap}); raise config.capacity.max_sessions"
+            )
+        return np.arange(
+            self._next_session_slot,
+            self._next_session_slot + n_parked,
+            dtype=np.int32,
+        )
+
+    def _stage_wave_lanes(
+        self,
+        session_slots,
+        dids: Sequence[str],
+        agent_sessions,
+        sigma_raw,
+        trustworthy,
+        delta_bodies,
+        b_wave: int,
+        k_wave: int,
+        parked_sessions: np.ndarray,
+    ) -> dict:
+        """Host-side lane staging for one governance wave — interning,
+        duplicate detection, bucket padding, layout-contract checks —
+        as PLAIN NUMPY (no device puts): the single-device and mesh
+        dispatch sites convert per wave, and the tenant arena stacks T
+        staged waves into ONE `[T, …]` device transfer.
+        """
+        b = len(dids)
+        k = len(session_slots)
+        handles = np.array(
+            [self.agent_ids.intern(d) for d in dids], np.int32
+        )
+        wave_keys = _mkeys(agent_sessions, handles)
+        members = self._members
+        duplicate = np.fromiter(
+            (key in members for key in wave_keys.tolist()),
+            bool,
+            count=len(handles),
+        )
+        if trustworthy is None:
+            trustworthy = np.ones(b, bool)
+
+        def pad_b(arr, dtype, fill):
+            out = np.full((b_wave,), fill, dtype)
+            out[:b] = np.asarray(arr, dtype)
+            return out
+
+        wave_sessions = np.concatenate(
+            [np.asarray(session_slots, np.int32), parked_sessions]
+        )
+        # Contiguity check (host, cheap): fresh waves allocate
+        # arange(base, base+k) and ragged parking extends the same
+        # block, so the common layout qualifies for terminate's
+        # range-compare fast path (no [E]/[N] membership gathers).
+        # Arbitrary caller-supplied slots fall back to the mask path.
+        range_host = _contiguous_range_host(wave_sessions)
+        # Second host-verified layout contract: when no two seat-
+        # consuming lanes (duplicate lanes are refused before the seat
+        # check; padded ragged lanes ride the duplicate flag) target
+        # the same session, admission needs no capacity-rank sort —
+        # and, sharded, neither of its two all_gathers.
+        seat_sessions = np.asarray(agent_sessions, np.int32)[
+            ~np.asarray(duplicate, bool)
+        ]
+        unique_sessions = bool(
+            np.unique(seat_sessions).size == seat_sessions.size
+        )
+        bodies = np.asarray(delta_bodies)
+        if k_wave != k:
+            padded_bodies = np.zeros(
+                (bodies.shape[0], k_wave) + bodies.shape[2:], bodies.dtype
+            )
+            padded_bodies[:, :k] = bodies
+            bodies = padded_bodies
+        return {
+            "b": b,
+            "k": k,
+            "b_wave": b_wave,
+            "k_wave": k_wave,
+            "handles": handles,
+            "wave_keys": wave_keys,
+            "did": pad_b(handles, np.int32, -1),
+            "agent_sessions": pad_b(agent_sessions, np.int32, 0),
+            "sigma_raw": pad_b(sigma_raw, np.float32, 0.0),
+            "trustworthy": pad_b(trustworthy, bool, True),
+            "duplicate": pad_b(duplicate, bool, True),
+            "wave_sessions": wave_sessions,
+            "range_host": range_host,
+            "unique_sessions": unique_sessions,
+            "bodies": bodies,
+        }
 
     def run_governance_wave(
         self,
@@ -902,7 +1241,6 @@ class HypervisorState:
         b = len(dids)
         k = len(session_slots)
         b_wave, k_wave = b, k
-        parked_sessions = np.zeros((0,), np.int32)
         if pad_to is not None:
             b_wave, k_wave = int(pad_to[0]), int(pad_to[1])
         if mesh is not None:
@@ -922,128 +1260,42 @@ class HypervisorState:
             b_wave = -(-b // d) * d
             k_wave = -(-k // d) * d
             agent_slots = self._mesh_wave_slots(b_wave, d)
-            if k_wave != k:
-                s_cap = self.sessions.sid.shape[0]
-                n_parked = k_wave - k
-                if self._next_session_slot + n_parked > s_cap:
-                    raise RuntimeError(
-                        f"no spare session rows to park {n_parked} ragged "
-                        f"wave lanes ({self._next_session_slot}+{n_parked} "
-                        f"> {s_cap}); raise config.capacity.max_sessions"
-                    )
-                parked_sessions = np.arange(
-                    self._next_session_slot,
-                    self._next_session_slot + n_parked,
-                    dtype=np.int32,
-                )
+            parked_sessions = self._park_sessions(k_wave - k, "ragged wave")
         else:
-            # Bucket padding (serving): pad lanes claim rows like real
-            # ones — all of a single-device wave's rows recycle through
-            # the free list after the wave, so the claim is transient —
-            # and pad sessions park on unallocated rows exactly like
-            # the mesh path's ragged lanes.
-            #
-            # Rows come from the bump allocator while it lasts, then
-            # from the FREE LIST: wave rows are dead after the wave
-            # (their sessions terminate in-program) and recycle below,
-            # so a continuously-serving deployment reuses them instead
-            # of exhausting the table in minutes (the serving soak
-            # found exactly that). Fresh-first keeps short-lived
-            # states on the historical row layout; free-list order is
-            # deterministic per op sequence, so WAL replay allocates
-            # the identical rows. The staging lock guards both cursors
-            # against concurrent producers.
-            with self._enqueue_lock:
-                cap = self.agents.did.shape[0]
-                fresh_n = min(b_wave, cap - self._next_agent_slot)
-                free = self._free_agent_slots
-                need = b_wave - fresh_n
-                if need > len(free):
-                    raise RuntimeError(
-                        f"agent table full: {self._next_agent_slot} + "
-                        f"{b_wave} > {cap} with {len(free)} free rows; "
-                        "raise config.capacity.max_agents"
-                    )
-                fresh = list(
-                    range(
-                        self._next_agent_slot,
-                        self._next_agent_slot + fresh_n,
-                    )
-                )
-                self._next_agent_slot += fresh_n
-                recycled = [free.pop() for _ in range(need)]
-            agent_slots = np.array(fresh + recycled, np.int32)
-            if k_wave != k:
-                s_cap = self.sessions.sid.shape[0]
-                n_parked = k_wave - k
-                if self._next_session_slot + n_parked > s_cap:
-                    raise RuntimeError(
-                        f"no spare session rows to park {n_parked} padded "
-                        f"bucket lanes ({self._next_session_slot}+{n_parked}"
-                        f" > {s_cap}); raise config.capacity.max_sessions"
-                    )
-                parked_sessions = np.arange(
-                    self._next_session_slot,
-                    self._next_session_slot + n_parked,
-                    dtype=np.int32,
-                )
-        handles = np.array([self.agent_ids.intern(d) for d in dids], np.int32)
-        wave_keys = _mkeys(agent_sessions, handles)
-        members = self._members
-        duplicate = np.fromiter(
-            (k in members for k in wave_keys.tolist()),
-            bool,
-            count=len(handles),
-        )
-        if trustworthy is None:
-            trustworthy = np.ones(b, bool)
-
-        def pad_b(arr, dtype, fill):
-            out = np.full((b_wave,), fill, dtype)
-            out[:b] = np.asarray(arr, dtype)
-            return out
-
-        wave_sessions = np.concatenate(
-            [np.asarray(session_slots, np.int32), parked_sessions]
-        )
-        # Contiguity check (host, cheap): fresh waves allocate
-        # arange(base, base+k) and ragged parking extends the same
-        # block, so the common layout qualifies for terminate's
-        # range-compare fast path (no [E]/[N] membership gathers).
-        # Arbitrary caller-supplied slots fall back to the mask path.
-        wave_range = _contiguous_range(wave_sessions)
-        wave_contiguous = wave_range is not None
-        # Second host-verified layout contract: when no two seat-
-        # consuming lanes (duplicate lanes are refused before the seat
-        # check; padded ragged lanes ride the duplicate flag) target
-        # the same session, admission needs no capacity-rank sort —
-        # and, sharded, neither of its two all_gathers.
-        seat_sessions = np.asarray(agent_sessions, np.int32)[
-            ~np.asarray(duplicate, bool)
-        ]
-        unique_sessions = bool(
-            np.unique(seat_sessions).size == seat_sessions.size
-        )
-        bodies = np.asarray(delta_bodies)
-        if k_wave != k:
-            padded_bodies = np.zeros(
-                (bodies.shape[0], k_wave) + bodies.shape[2:], bodies.dtype
+            agent_slots = self._claim_wave_rows(b_wave)
+            parked_sessions = self._park_sessions(
+                k_wave - k, "padded bucket"
             )
-            padded_bodies[:, :k] = bodies
-            bodies = padded_bodies
+        staged = self._stage_wave_lanes(
+            session_slots, dids, agent_sessions, sigma_raw, trustworthy,
+            delta_bodies, b_wave, k_wave, parked_sessions,
+        )
+        wave_keys = staged["wave_keys"]
+        wave_sessions = staged["wave_sessions"]
+        range_host = staged["range_host"]
+        wave_range = (
+            None
+            if range_host is None
+            else (
+                jnp.asarray(range_host[0], jnp.int32),
+                jnp.asarray(range_host[1], jnp.int32),
+            )
+        )
+        wave_contiguous = wave_range is not None
+        unique_sessions = staged["unique_sessions"]
 
         wave_args = (
             self.agents,
             self.sessions,
             self.vouches,
             jnp.asarray(agent_slots),
-            jnp.asarray(pad_b(handles, np.int32, -1)),
-            jnp.asarray(pad_b(agent_sessions, np.int32, 0)),
-            jnp.asarray(pad_b(sigma_raw, np.float32, 0.0)),
-            jnp.asarray(pad_b(trustworthy, bool, True)),
-            jnp.asarray(pad_b(duplicate, bool, True)),
+            jnp.asarray(staged["did"]),
+            jnp.asarray(staged["agent_sessions"]),
+            jnp.asarray(staged["sigma_raw"]),
+            jnp.asarray(staged["trustworthy"]),
+            jnp.asarray(staged["duplicate"]),
             jnp.asarray(wave_sessions),
-            jnp.asarray(bodies),
+            jnp.asarray(staged["bodies"]),
             now,
             omega,
         )
@@ -1274,23 +1526,12 @@ class HypervisorState:
                 # width dispatched here is the padded b_wave.
                 lane_width=b_wave,
             )
-        # Membership bookkeeping under the staging lock: enqueue_join's
-        # duplicate check reads `_members` under `_enqueue_lock`, so a
-        # concurrent wave publishing its admissions outside the lock
-        # races that read (hvlint HVA003 — the same class as the PR 10
-        # free-list fix below).
-        # Every wave row is dead after the wave: rejected rows were
-        # never admitted, admitted rows belong to sessions this same
-        # program terminated — all reclaim (device-table GC), and
-        # none are cached in _slot_of_member. Mesh-wave rows recycle
-        # through their own deterministic top-region layout instead
-        # of the general free list (see _mesh_wave_slots).
-        with self._enqueue_lock:
-            self._members.update(wave_keys[ok].tolist())
-            if mesh is None:
-                self._free_agent_slots.extend(
-                    np.asarray(agent_slots).tolist()
-                )
+        self._publish_wave_members(
+            wave_keys[ok].tolist(),
+            recycle_rows=(
+                np.asarray(agent_slots).tolist() if mesh is None else None
+            ),
+        )
 
         # Record the wave's audit chain in the DeltaLog (lane-major).
         # COPY, not view: slices of this array outlive the wave
@@ -1300,15 +1541,16 @@ class HypervisorState:
         chain = np.array(result.chain, copy=True)  # [T, K, 8]
         t, k = chain.shape[:2]
         if t:
-            sess_rep = np.repeat(np.asarray(session_slots, np.int32), t)
-            digests_flat = np.transpose(chain, (1, 0, 2)).reshape(k * t, 8)
-            capacity = self.delta_log.body.shape[0]
             if mesh is None:
                 # The ring append rode the fused program (the committed
                 # `result.delta_log` above); only the host-side audit
                 # index remains to book, against the pre-dispatch cursor.
                 base_row = audit_base_row
             else:
+                sess_rep = np.repeat(np.asarray(session_slots, np.int32), t)
+                digests_flat = np.transpose(chain, (1, 0, 2)).reshape(
+                    k * t, 8
+                )
                 turns_rep = np.tile(np.arange(t, dtype=np.int32), k)
                 bodies_flat = np.transpose(delta_bodies, (1, 0, 2)).reshape(
                     k * t, -1
@@ -1320,21 +1562,7 @@ class HypervisorState:
                     jnp.asarray(sess_rep),
                     jnp.asarray(turns_rep),
                 )
-            rows = (base_row + np.arange(k * t)) % capacity
-            self._claim_rows(rows, sess_rep)
-            for i, s in enumerate(np.asarray(session_slots)):
-                s = int(s)
-                self._audit_rows.setdefault(s, []).extend(
-                    rows[i * t : (i + 1) * t].tolist()
-                )
-                base_turn = self._turns.get(s, 0)
-                self._turns[s] = base_turn + t
-                self._chain_seed[s] = chain[t - 1, i]
-                # The frontier rides the wave's audit commit exactly as
-                # it rides flush_deltas.
-                self._frontier.setdefault(s, MerkleFrontier()).extend(
-                    digests_flat[i * t : (i + 1) * t]
-                )
+            self._book_wave_audit(session_slots, chain, base_row)
         if mesh is None:
             # The fused tail refreshed every occupancy gauge in-program
             # over the post-append tables, and everything since the
@@ -1349,6 +1577,62 @@ class HypervisorState:
             # post-terminate table, identical phase order everywhere.
             return result, gw_result
         return result
+
+    def _publish_wave_members(
+        self, admitted_keys: list, recycle_rows=None
+    ) -> None:
+        """Membership bookkeeping under the staging lock: enqueue_join's
+        duplicate check reads `_members` under `_enqueue_lock`, so a
+        concurrent wave publishing its admissions outside the lock
+        races that read (hvlint HVA003 — the same class as the PR 10
+        free-list fix).
+
+        Every wave row is dead after the wave: rejected rows were never
+        admitted, admitted rows belong to sessions this same program
+        terminated — all reclaim through `recycle_rows` (device-table
+        GC), and none are cached in _slot_of_member. Mesh-wave rows
+        recycle through their own deterministic top-region layout
+        instead of the general free list (see _mesh_wave_slots), so
+        mesh callers pass None."""
+        with self._enqueue_lock:
+            self._members.update(admitted_keys)
+            if recycle_rows is not None:
+                self._free_agent_slots.extend(recycle_rows)
+
+    def _book_wave_audit(
+        self, session_slots, chain: np.ndarray, base_row: int
+    ) -> None:
+        """Book one wave's audit chain into the host-side audit index:
+        ring-row claims, per-session row lists, turn counters, chain
+        seeds, and the incremental Merkle frontier. `chain` is a host
+        COPY (u32[T, K, 8], lane-major); the ring append itself already
+        happened (in-program for fused waves, `append_batch` for mesh).
+        Shared by the single-device fused wave, the mesh path, and the
+        tenant arena's per-tenant absorb."""
+        t, k = chain.shape[:2]
+        if not t:
+            return
+        sess_rep = np.repeat(np.asarray(session_slots, np.int32), t)
+        digests_flat = np.transpose(chain, (1, 0, 2)).reshape(k * t, 8)
+        # Static per config — NOT read off the live ring: the tenant
+        # arena's absorb books against the stacked ring without
+        # materialising a per-tenant slice just for its shape.
+        capacity = self.config.capacity.delta_log_capacity
+        rows = (base_row + np.arange(k * t)) % capacity
+        self._claim_rows(rows, sess_rep)
+        for i, s in enumerate(np.asarray(session_slots)):
+            s = int(s)
+            self._audit_rows.setdefault(s, []).extend(
+                rows[i * t : (i + 1) * t].tolist()
+            )
+            base_turn = self._turns.get(s, 0)
+            self._turns[s] = base_turn + t
+            self._chain_seed[s] = chain[t - 1, i]
+            # The frontier rides the wave's audit commit exactly as
+            # it rides flush_deltas.
+            self._frontier.setdefault(s, MerkleFrontier()).extend(
+                digests_flat[i * t : (i + 1) * t]
+            )
 
     def _pad_gateway_lanes(self, act: dict) -> tuple:
         """Pad normalized action columns to the gateway's power-of-two

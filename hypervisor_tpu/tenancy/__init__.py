@@ -1,0 +1,38 @@
+"""Tenant-dense serving: T logical hypervisors, one donated dispatch.
+
+ROOFLINE.md shows a full 10k-agent instance occupies ~15.4 MB of a
+16 GB HBM — three orders of magnitude of headroom — while every wave
+dispatch serves exactly ONE logical hypervisor. This package makes
+tenancy a leading ARRAY AXIS instead of a deployment:
+
+  * `TenantArena` — stacks every per-tenant table/ring into one
+    `[T, …]` pytree and dispatches the PR 9 fused governance wave
+    vmapped across tenants: ONE donated XLA program, one donation
+    frontier, one drain `device_get` for all T tenants
+    (`state._TENANT_WAVE_DONATED`).
+  * `TenantState` — a `HypervisorState` whose device tables live in
+    the arena's stacks (lend/commit component protocol): every host
+    op, WAL record, checkpoint, and integrity hook works unchanged,
+    per tenant.
+  * `TenantFrontDoor` / `TenantWaveScheduler` — per-tenant admission
+    quotas (a flooding tenant sheds against its OWN queues) and
+    deficit-round-robin fair-share bucket filling across tenants.
+  * `noisy_neighbor` (in `hypervisor_tpu.testing.scenarios` wiring) —
+    the isolation drill: a byzantine tenant at full rate must leave
+    every neighbor's chain heads bit-identical to a solo run.
+
+docs/OPERATIONS.md "Tenant-dense serving" is the operator runbook.
+"""
+
+from hypervisor_tpu.tenancy.arena import TenantArena, TenantState
+from hypervisor_tpu.tenancy.front_door import (
+    TenantFrontDoor,
+    TenantWaveScheduler,
+)
+
+__all__ = [
+    "TenantArena",
+    "TenantFrontDoor",
+    "TenantState",
+    "TenantWaveScheduler",
+]

@@ -1,0 +1,862 @@
+"""TenantArena: the `[T, …]` state layer — one donated dispatch for T.
+
+The arena OWNS the device state: every tenant's AgentTable /
+SessionTable / VouchTable / SagaTable / ElevationTable, DeltaLog /
+EventLog / TraceLog rings, and metrics columns live STACKED along a
+leading tenant axis in `_stacked`. Tenants are full `HypervisorState`s
+(`TenantState`) whose table attributes route through the arena's
+lend/commit component protocol:
+
+  * **lend** — reading `tenant.agents` materialises that tenant's
+    slice of the stack on demand and caches it (`_tenant_local`), so
+    every existing host op — joins, vouches, sagas, WAL records,
+    checkpoints, integrity repairs — works unchanged, per tenant.
+  * **commit** — writing any table attribute marks the tenant dirty;
+    `sync()` writes dirty slices back into the stack (`.at[t].set`)
+    before the next batched dispatch.
+  * **invalidate** — a batched wave rebinds the stacks (its outputs
+    alias the donated inputs) and drops every tenant's cached slices.
+
+The hot path never materialises per-tenant state: a serving round is
+ONE `_TENANT_SESSIONS_CREATE` dispatch (all tenants' session creates),
+ONE `_TENANT_WAVE_DONATED` dispatch (the fused governance wave vmapped
+across tenants — bit-identical per tenant to the solo program, pinned
+by tests/unit/test_tenancy.py), and the drain is ONE `device_get` of
+the stacked metrics table fanned into per-tenant mirrors with
+`tenant="<id>"` labels. Isolation is structural: a tenant's rows live
+in its own slice of every stack, its refusals ride its own FrontDoor
+queues, and the noisy-neighbor drill pins neighbors' chain heads
+bit-identical to a solo oracle run.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import ExitStack
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
+from hypervisor_tpu.models import SessionConfig, SessionState
+from hypervisor_tpu.observability import health as health_plane
+from hypervisor_tpu.observability import metrics as metrics_plane
+from hypervisor_tpu.observability import roofline as roofline_plane
+from hypervisor_tpu.observability import tracing as trace_plane
+from hypervisor_tpu.ops import admission, wave_blocks
+from hypervisor_tpu.ops.merkle import BODY_WORDS
+from hypervisor_tpu.state import (
+    HypervisorState,
+    _DONATION_CACHE_SALT,
+    _TENANT_SESSIONS_CREATE,
+    _TENANT_UPDATE_GAUGES,
+    _TENANT_WAVE,
+    _TENANT_WAVE_DONATED,
+    _donate_debug,
+    _donate_tables,
+    _poison_donated,
+)
+
+#: The stacked components, in seal order. Direct state attributes plus
+#: the two device planes routed through the factory hooks
+#: (`_make_metrics` / `_make_tracer`).
+COMPONENTS: tuple[str, ...] = (
+    "agents",
+    "sessions",
+    "vouches",
+    "sagas",
+    "elevations",
+    "delta_log",
+    "event_log",
+    "metrics_table",
+    "trace_table",
+)
+#: Components the batched wave writes (and, donated, consumes).
+_WAVE_WRITES = (
+    "agents", "sessions", "vouches", "metrics_table", "delta_log",
+)
+
+_MISSING = object()
+
+
+def _component_property(name: str):
+    def _get(self):
+        return self._comp_get(name)
+
+    def _set(self, value):
+        self._comp_set(name, value)
+
+    return property(_get, _set)
+
+
+class TenantState(HypervisorState):
+    """One tenant's `HypervisorState`, tables lent from the arena.
+
+    Before the arena seals (during `__init__`), components live in
+    `_tenant_local` like any solo state. After `TenantArena._seal`,
+    the stacked copy is authoritative: reads materialise + cache a
+    slice, writes mark the tenant dirty for the next `sync()`.
+    """
+
+    def __init__(
+        self, config: HypervisorConfig = DEFAULT_CONFIG
+    ) -> None:
+        self._tenant_local: dict = {}
+        self._tenant_arena: Optional["TenantArena"] = None
+        self._tenant_idx: int = -1
+        super().__init__(config)
+
+    # Direct table attributes route through the component protocol.
+    agents = _component_property("agents")
+    sessions = _component_property("sessions")
+    vouches = _component_property("vouches")
+    sagas = _component_property("sagas")
+    elevations = _component_property("elevations")
+    delta_log = _component_property("delta_log")
+    event_log = _component_property("event_log")
+
+    def _make_metrics(self) -> "metrics_plane.Metrics":
+        return _TenantMetrics(self)
+
+    def _make_tracer(self, capacity: int) -> "trace_plane.Tracer":
+        return _TenantTracer(self, capacity)
+
+    def _comp_get(self, name: str):
+        local = self._tenant_local.get(name, _MISSING)
+        if local is not _MISSING:
+            return local
+        arena = self._tenant_arena
+        if arena is None:
+            raise AttributeError(
+                f"tenant component {name!r} unset before first write"
+            )
+        value = arena.materialize(self._tenant_idx, name)
+        self._tenant_local[name] = value
+        return value
+
+    def _comp_set(self, name: str, value) -> None:
+        self._tenant_local[name] = value
+        arena = self._tenant_arena
+        if arena is not None:
+            arena.note_dirty(self._tenant_idx, name)
+
+
+class _TenantMetrics(metrics_plane.Metrics):
+    """Metrics plane whose device table lives in the arena stack."""
+
+    def __init__(self, owner: TenantState) -> None:
+        self._owner = owner
+        super().__init__()
+
+    @property
+    def table(self):
+        return self._owner._comp_get("metrics_table")
+
+    @table.setter
+    def table(self, value) -> None:
+        self._owner._comp_set("metrics_table", value)
+
+
+class _TenantTracer(trace_plane.Tracer):
+    """Tracer whose device ring lives in the arena stack."""
+
+    def __init__(self, owner: TenantState, capacity: int) -> None:
+        self._owner = owner
+        super().__init__(capacity=capacity)
+
+    @property
+    def table(self):
+        return self._owner._comp_get("trace_table")
+
+    @table.setter
+    def table(self, value) -> None:
+        self._owner._comp_set("trace_table", value)
+
+
+class _StaticFootprint:
+    """Cached `footprint()` carrier for the health plane: per-tenant
+    table footprints are pure config-derived metadata, computed once at
+    seal — publishing them must not materialise T slices per drain."""
+
+    def __init__(self, fp: dict) -> None:
+        self._fp = fp
+
+    def footprint(self) -> dict:
+        return self._fp
+
+
+class TenantWaveOut:
+    """One tenant's view of a batched wave's results (host numpy,
+    trimmed to the tenant's real lane/session counts)."""
+
+    __slots__ = ("tenant", "status", "merkle_root", "fsm_error")
+
+    def __init__(self, tenant, status, merkle_root, fsm_error):
+        self.tenant = tenant
+        self.status = status
+        self.merkle_root = merkle_root
+        self.fsm_error = fsm_error
+
+
+class TenantArena:
+    """T logical hypervisors behind one donated dispatch.
+
+    Concurrency discipline: SUBMITS are free-threaded (they are
+    host-only — per-door queues, staging queues, shed gates), but
+    DISPATCHES — the batched waves here and any per-tenant solo wave —
+    must come from one drain thread (the `TenantWaveScheduler`), the
+    same serialized-driver contract the solo FrontDoor documents for
+    donation. A solo dispatch reads tenant tables (materialising
+    slices under the arena lock) while holding the tenant's staging
+    lock; a concurrent batched dispatch takes the locks in the
+    opposite order, so two concurrent dispatch threads could deadlock
+    — one drain thread makes the ordering moot, exactly as today's
+    scheduler does.
+    """
+
+    def __init__(
+        self,
+        num_tenants: int,
+        config: HypervisorConfig = DEFAULT_CONFIG,
+    ) -> None:
+        if num_tenants < 1:
+            raise ValueError("num_tenants must be >= 1")
+        self.config = config
+        self.num_tenants = num_tenants
+        # One lock for stack mutation (sync/dispatch/drain). Per-tenant
+        # host ops take their own tenant locks as always.
+        self._lock = threading.RLock()
+        self.tenants: list[TenantState] = [
+            TenantState(config) for _ in range(num_tenants)
+        ]
+        # Arena-level host metrics plane: stage brackets for the
+        # batched dispatches (a T-tenant wall is not any one tenant's
+        # latency) and the roofline observatory's measured-walls join.
+        self.metrics = metrics_plane.Metrics()
+        self._stacked: dict = {}
+        self._dirty: dict[str, set] = {name: set() for name in COMPONENTS}
+        self._footprints: dict[str, dict] = {}
+        self.waves = 0            # batched governance waves dispatched
+        self.last_wave: dict = {}
+        self._seal()
+
+    # ── the component protocol ───────────────────────────────────────
+
+    def _get_component(self, state: TenantState, name: str):
+        if name == "metrics_table":
+            return state.metrics.table
+        if name == "trace_table":
+            return state.tracer.table
+        return getattr(state, name)
+
+    def _seal(self) -> None:
+        """Stack every tenant's components into the `[T, …]` pytrees
+        and flip the tenants to arena-backed reads."""
+        cap = self.config.capacity
+        for name in COMPONENTS:
+            vals = [self._get_component(st, name) for st in self.tenants]
+            if all(v is None for v in vals):
+                self._stacked[name] = None
+            else:
+                self._stacked[name] = jax.tree.map(
+                    lambda *xs: jnp.stack(xs), *vals
+                )
+        # Static per-tenant footprints (pure metadata), from tenant 0's
+        # pre-seal locals — identical across tenants by construction.
+        st0 = self.tenants[0]
+        rows = {
+            "agents": cap.max_agents,
+            "sessions": cap.max_sessions,
+            "vouches": cap.max_vouch_edges,
+            "sagas": cap.max_sagas,
+            "elevations": cap.max_elevations,
+            "delta_log": cap.delta_log_capacity,
+            "event_log": cap.event_log_capacity,
+        }
+        for name in COMPONENTS:
+            val = self._get_component(st0, name)
+            if val is None:
+                continue
+            bytes_ = sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree.leaves(val)
+            )
+            key = {
+                "metrics_table": "metrics", "trace_table": "trace_log",
+            }.get(name, name)
+            self._footprints[key] = {
+                "bytes": bytes_,
+                "capacity_rows": rows.get(name, 0),
+            }
+        for t, st in enumerate(self.tenants):
+            st._tenant_arena = self
+            st._tenant_idx = t
+            st._tenant_local.clear()
+
+    def materialize(self, tenant: int, name: str):
+        stacked = self._stacked[name]
+        if stacked is None:
+            return None
+        with self._lock:
+            return jax.tree.map(lambda x: x[tenant], stacked)
+
+    def note_dirty(self, tenant: int, name: str) -> None:
+        with self._lock:
+            self._dirty[name].add(tenant)
+
+    def sync(self) -> int:
+        """Write every dirty tenant slice back into the stacks; returns
+        the number of (tenant, component) writebacks. Dispatched before
+        every batched program so slow-path host ops (vouching, saga
+        creation, integrity repairs, per-tenant solo waves) and the
+        batched hot path see one coherent state."""
+        wrote = 0
+        with self._lock:
+            for name in COMPONENTS:
+                dirty = self._dirty[name]
+                if not dirty:
+                    continue
+                for t in sorted(dirty):
+                    local = self.tenants[t]._tenant_local.get(
+                        name, _MISSING
+                    )
+                    if local is _MISSING:
+                        continue
+                    if self._stacked[name] is None:
+                        continue
+                    self._stacked[name] = jax.tree.map(
+                        lambda s, l: s.at[t].set(l),
+                        self._stacked[name],
+                        local,
+                    )
+                    wrote += 1
+                dirty.clear()
+        return wrote
+
+    def _invalidate(self, names: Sequence[str]) -> None:
+        """Drop every tenant's cached slices for `names` (the stack is
+        authoritative again — e.g. right after a batched wave rebound
+        it). Dirty slices must have been synced first."""
+        for name in names:
+            assert not self._dirty[name], (
+                f"invalidate of {name} would drop unsynced tenant "
+                f"writes {sorted(self._dirty[name])}"
+            )
+            for st in self.tenants:
+                st._tenant_local.pop(name, None)
+
+    # ── batched session creation ─────────────────────────────────────
+
+    def create_sessions_batch(
+        self,
+        ids_per_tenant: dict[int, list[str]],
+        config: SessionConfig,
+        pad_to: Optional[int] = None,
+    ) -> dict[int, np.ndarray]:
+        """Allocate each tenant's session rows in HANDSHAKING — ONE
+        vmapped donated dispatch for every tenant's creates (the
+        batched twin of `HypervisorState.create_sessions_batch`; the
+        session config is uniform across the round, mixed configs go
+        through the per-tenant solo path). Returns tenant -> slots.
+
+        `pad_to` pins the [T, K] lane shape to a serving bucket so the
+        program family stays CLOSED (the scheduler always passes its
+        round's bucket; an unpadded call compiles per distinct K)."""
+        with self._lock:
+            self.sync()
+            k_max = max(
+                (len(v) for v in ids_per_tenant.values()), default=0
+            )
+            if k_max == 0:
+                return {}
+            if pad_to is not None:
+                if pad_to < k_max:
+                    raise ValueError(
+                        f"pad_to {pad_to} below the widest tenant "
+                        f"batch {k_max}"
+                    )
+                k_max = int(pad_to)
+            t_count = self.num_tenants
+            rows = np.zeros((t_count, k_max), np.int32)
+            sids = np.zeros((t_count, k_max), np.int32)
+            valid = np.zeros((t_count, k_max), bool)
+            slots_out: dict[int, np.ndarray] = {}
+            for t, ids in sorted(ids_per_tenant.items()):
+                if not ids:
+                    continue
+                st = self.tenants[t]
+                slots = st._stage_sessions_batch(ids, config)
+                slots_out[t] = slots
+                rows[t, : len(ids)] = slots
+                sids[t, : len(ids)] = [
+                    st.session_ids.intern(s) for s in ids
+                ]
+                valid[t, : len(ids)] = True
+            with self.metrics.stage("tenant_sessions_create"):
+                self._stacked["sessions"] = _TENANT_SESSIONS_CREATE(
+                    self._stacked["sessions"],
+                    jnp.asarray(rows),
+                    jnp.asarray(sids),
+                    jnp.asarray(valid),
+                    jnp.int32(SessionState.HANDSHAKING.code),
+                    jnp.int32(config.consistency_mode.code),
+                    jnp.int32(config.max_participants),
+                    jnp.float32(config.min_sigma_eff),
+                    jnp.asarray(bool(config.enable_audit)),
+                )
+            self._invalidate(("sessions",))
+        return slots_out
+
+    # ── the batched governance wave ──────────────────────────────────
+
+    def governance_wave_batch(
+        self,
+        lanes_per_tenant: dict[int, dict],
+        bucket: int,
+        now: float,
+        omega: float = 0.5,
+    ) -> dict[int, TenantWaveOut]:
+        """The tenant-dense hot path: every participating tenant's
+        fused governance wave as ONE donated XLA program.
+
+        `lanes_per_tenant[t]` carries that tenant's wave inputs —
+        `session_slots` (freshly created, contiguous), `dids`,
+        `agent_sessions`, `sigma_raw`, `delta_bodies`
+        (u32[turns, k, BODY_WORDS]) and optional `trustworthy` — each
+        at most `bucket` lanes. Tenants absent from the dict idle
+        through the wave as all-padding lanes (their rows untouched;
+        the [T] program shape is closed per (bucket, T) tile, so a
+        warmed arena never recompiles — the solo scheduler's
+        closed-bucket contract, extended with the tenant axis).
+
+        Per-tenant semantics are EXACTLY `run_governance_wave(...,
+        pad_to=(bucket, bucket))`: same staging, same WAL record, same
+        membership/audit/frontier bookkeeping, bit-identical tables
+        (test-pinned) — which is what makes WAL replay through the
+        solo program, and the noisy-neighbor drill's solo oracle
+        comparison, sound.
+        """
+        turns = None
+        for spec in lanes_per_tenant.values():
+            t_this = np.asarray(spec["delta_bodies"]).shape[0]
+            if turns is None:
+                turns = t_this
+            elif turns != t_this:
+                raise ValueError(
+                    "every tenant's delta_bodies must share one turn "
+                    f"count (got {turns} and {t_this})"
+                )
+        if turns is None:
+            turns = 1
+        with self._lock:
+            # Pre-dispatch gates per participating tenant (chaos,
+            # scheduled corruption, integrity cadence) BEFORE sync so
+            # injected table damage rides the writeback.
+            sanitize = False
+            armed: list[TenantState] = []
+            for t in sorted(lanes_per_tenant):
+                st = self.tenants[t]
+                st._predispatch("governance_wave", fused_sanitizer=True)
+                plane = st.integrity
+                if plane is not None and plane.take_fused_due():
+                    sanitize = True
+                    armed.append(st)
+            self.sync()
+
+            # Per-tenant host staging (numpy only), then ONE stack.
+            staged: dict[int, dict] = {}
+            handles: dict[int, object] = {}
+            slots_by_t: dict[int, np.ndarray] = {}
+            journals = ExitStack()
+            for t in range(self.num_tenants):
+                st = self.tenants[t]
+                spec = lanes_per_tenant.get(t)
+                if spec is None:
+                    session_slots = np.zeros((0,), np.int32)
+                    dids: list = []
+                    agent_sessions = np.zeros((0,), np.int32)
+                    sigma_raw = np.zeros((0,), np.float32)
+                    bodies = np.zeros((turns, 0, BODY_WORDS), np.uint32)
+                    trustworthy = None
+                else:
+                    session_slots = np.asarray(
+                        spec["session_slots"], np.int32
+                    )
+                    dids = list(spec["dids"])
+                    agent_sessions = np.asarray(
+                        spec["agent_sessions"], np.int32
+                    )
+                    sigma_raw = np.asarray(
+                        spec["sigma_raw"], np.float32
+                    )
+                    bodies = np.asarray(spec["delta_bodies"], np.uint32)
+                    trustworthy = spec.get("trustworthy")
+                    if len(dids) > bucket or len(session_slots) > bucket:
+                        raise ValueError(
+                            f"tenant {t} wave ({len(dids)} lanes, "
+                            f"{len(session_slots)} sessions) exceeds "
+                            f"bucket {bucket}"
+                        )
+                    if st.journal is not None:
+                        journals.enter_context(
+                            st._journal(
+                                "governance_wave",
+                                session_slots=session_slots,
+                                dids=dids,
+                                agent_sessions=agent_sessions,
+                                sigma_raw=sigma_raw,
+                                delta_bodies=bodies,
+                                now=float(now),
+                                omega=float(omega),
+                                trustworthy=(
+                                    None
+                                    if trustworthy is None
+                                    else np.asarray(trustworthy, bool)
+                                ),
+                                use_pallas=False,
+                                actions=None,
+                                pad_to=[bucket, bucket],
+                            )
+                        )
+                slots_by_t[t] = session_slots
+                agent_slots = st._claim_wave_rows(bucket)
+                parked = st._park_sessions(
+                    bucket - len(session_slots), "tenant bucket"
+                )
+                sw = st._stage_wave_lanes(
+                    session_slots, dids, agent_sessions, sigma_raw,
+                    trustworthy, bodies, bucket, bucket, parked,
+                )
+                sw["agent_slots"] = agent_slots
+                if sw["range_host"] is None:
+                    raise RuntimeError(
+                        "tenant wave sessions must be contiguous (fresh "
+                        "arena-created blocks always are)"
+                    )
+                staged[t] = sw
+                handles[t] = st.tracer.begin_wave(
+                    "governance_wave",
+                    sessions=sw["wave_sessions"][: sw["k"]],
+                    lanes=sw["b"],
+                    device=False,
+                )
+            # Pre-wave cursors for the audit bookkeeping: [T] in one
+            # host sync off the stacked ring.
+            base_rows = np.asarray(
+                self._stacked["delta_log"].cursor
+            ).astype(np.int64)
+
+            def col(key, dtype=None):
+                arr = np.stack([staged[t][key] for t in range(
+                    self.num_tenants)])
+                return jnp.asarray(
+                    arr if dtype is None else arr.astype(dtype)
+                )
+
+            lanes_valid = np.zeros((self.num_tenants, bucket), bool)
+            n_sessions_valid = np.zeros((self.num_tenants,), np.int32)
+            los = np.zeros((self.num_tenants,), np.int32)
+            his = np.zeros((self.num_tenants,), np.int32)
+            slot_stack = np.zeros(
+                (self.num_tenants, bucket), np.int32
+            )
+            for t in range(self.num_tenants):
+                sw = staged[t]
+                lanes_valid[t, : sw["b"]] = True
+                n_sessions_valid[t] = sw["k"]
+                los[t], his[t] = sw["range_host"]
+                slot_stack[t] = sw["agent_slots"]
+
+            donated = _donate_tables()
+            wave = _TENANT_WAVE_DONATED if donated else _TENANT_WAVE
+            poison = (
+                tuple(
+                    self._stacked[name] for name in _WAVE_WRITES
+                )
+                if donated and _donate_debug()
+                else None
+            )
+            with journals:
+                with self.metrics.stage("tenant_governance_wave"):
+                    result = wave(
+                        self._stacked["agents"],
+                        self._stacked["sessions"],
+                        self._stacked["vouches"],
+                        self._stacked["metrics_table"],
+                        self._stacked["delta_log"],
+                        self._stacked["sagas"],
+                        self._stacked["event_log"],
+                        self._stacked["elevations"],
+                        jnp.asarray(slot_stack),
+                        col("did"),
+                        col("agent_sessions"),
+                        col("sigma_raw"),
+                        col("trustworthy"),
+                        col("duplicate"),
+                        col("wave_sessions"),
+                        col("bodies"),
+                        jnp.asarray(los),
+                        jnp.asarray(his),
+                        jnp.asarray(lanes_valid),
+                        jnp.asarray(n_sessions_valid),
+                        jnp.float32(now),
+                        jnp.float32(omega),
+                        self.tenants[0]._ring_bursts,
+                        trust=self.config.trust,
+                        breach=self.config.breach,
+                        rate_limit=self.config.rate_limit,
+                        sanitize=sanitize,
+                        config=self.config,
+                        cache_salt=(
+                            _DONATION_CACHE_SALT if donated else 0.0
+                        ),
+                        wave_kernels=wave_blocks.wave_kernels_enabled(),
+                    )
+            # Rebind the stacks to the wave outputs (the donated inputs
+            # are dead buffers now) and drop every cached slice.
+            self._stacked["agents"] = result.agents
+            self._stacked["sessions"] = result.sessions
+            self._stacked["vouches"] = result.vouches
+            self._stacked["metrics_table"] = result.metrics
+            self._stacked["delta_log"] = result.delta_log
+            if poison is not None:
+                _poison_donated(*poison)
+            self._invalidate(_WAVE_WRITES)
+            self.waves += 1
+
+            # Host fan-out: ONE fetch per result field, numpy slices
+            # per tenant for the bookkeeping and the callers' tickets.
+            status = np.asarray(result.status)          # [T, bucket]
+            chain = np.array(result.chain, copy=True)   # [T, turns, bucket, 8]
+            roots = np.array(result.merkle_root, copy=True)
+            fsm_err = np.asarray(result.fsm_error)
+            out: dict[int, TenantWaveOut] = {}
+            sanitizer_by_t = {}
+            if sanitize and armed:
+                for st in armed:
+                    t = st._tenant_idx
+                    sanitizer_by_t[t] = jax.tree.map(
+                        lambda x, _t=t: (
+                            x[_t] if hasattr(x, "shape") else x
+                        ),
+                        result.sanitizer,
+                    )
+            for t in range(self.num_tenants):
+                st = self.tenants[t]
+                sw = staged[t]
+                b, k = sw["b"], sw["k"]
+                ok = status[t, :b] == admission.ADMIT_OK
+                st._publish_wave_members(
+                    sw["wave_keys"][ok].tolist(),
+                    recycle_rows=sw["agent_slots"].tolist(),
+                )
+                if k:
+                    st._book_wave_audit(
+                        slots_by_t[t], chain[t][:, :k], int(base_rows[t])
+                    )
+                st._gauges_fresh = True
+                th = handles[t]
+                if th is not None:
+                    st.tracer.stamp_wave_host(th)
+                    st.tracer.end_wave(th)
+                if t in sanitizer_by_t and st.integrity is not None:
+                    st.integrity.absorb_fused(sanitizer_by_t[t])
+                if t in lanes_per_tenant:
+                    out[t] = TenantWaveOut(
+                        tenant=t,
+                        status=status[t, :b],
+                        merkle_root=roots[t, :k],
+                        fsm_error=fsm_err[t, :k],
+                    )
+            self.last_wave = {
+                "tenants_served": len(lanes_per_tenant),
+                "bucket": bucket,
+                "sanitized": bool(sanitize),
+            }
+        return out
+
+    # ── drain: one device_get for all T tenants ──────────────────────
+
+    def metrics_snapshot(self) -> dict[int, "metrics_plane.MetricsSnapshot"]:
+        """Drain every tenant's metrics plane out of ONE stacked
+        `device_get`. Gauges are fresh when the last dispatch was a
+        fused tenant wave (its in-program tail refreshed all T
+        tenants); otherwise one vmapped `update_gauges` refreshes the
+        stack first (uncommitted, like the solo drain)."""
+        with self._lock:
+            self.sync()
+            table = self._stacked["metrics_table"]
+            if not all(st._gauges_fresh for st in self.tenants):
+                table = _TENANT_UPDATE_GAUGES(
+                    table,
+                    self._stacked["agents"],
+                    self._stacked["sessions"],
+                    self._stacked["vouches"],
+                    self._stacked["sagas"],
+                    self._stacked["elevations"],
+                    self._stacked["delta_log"],
+                    self._stacked["event_log"],
+                    self._stacked["trace_table"],
+                )
+            host = jax.device_get(table)
+        shims = {
+            name: _StaticFootprint(fp)
+            for name, fp in self._footprints.items()
+        }
+        snaps: dict[int, metrics_plane.MetricsSnapshot] = {}
+        for t, st in enumerate(self.tenants):
+            health_plane.publish_compile_counters(st.metrics)
+            roofline_plane.publish(st.metrics)
+            st.health.publish_footprints(shims)
+            host_t = jax.tree.map(lambda x: np.asarray(x)[t], host)
+            snap = st.metrics.snapshot(host_table=host_t)
+            st.health.update_occupancy(snap)
+            if st.integrity is not None:
+                st.integrity.observe_snapshot(snap)
+            snaps[t] = snap
+        # The arena's own host plane (stage walls for the batched
+        # programs) publishes through the same drain pass.
+        health_plane.publish_compile_counters(self.metrics)
+        roofline_plane.publish(self.metrics)
+        return snaps
+
+    def metrics_prometheus(self) -> str:
+        """One merged exposition: every tenant's series stamped with
+        its `tenant="<id>"` label (per-class serving latency, SLO burn,
+        sheds, occupancy — the ISSUE 15 per-tenant histogram fix),
+        headers once, plus the arena's own stage brackets under
+        `tenant="arena"`."""
+        snaps = self.metrics_snapshot()
+        parts = [
+            snaps[t].to_prometheus(
+                extra_labels={"tenant": str(t)}, emit_headers=(t == 0)
+            )
+            for t in sorted(snaps)
+        ]
+        parts.append(
+            self.metrics.snapshot().to_prometheus(
+                extra_labels={"tenant": "arena"}, emit_headers=False
+            )
+        )
+        return "".join(parts)
+
+    # ── summaries (what /debug/tenants and hv_top render) ────────────
+
+    def summary(self, top_k: int = 8) -> dict:
+        """The tenants panel: per-tenant live rows, queue depths, shed
+        rates, SLO burn states — ranked by PRESSURE (deepest queues +
+        burn) so hv_top's top-K row shows the tenants that matter."""
+        rows = []
+        for t, st in enumerate(self.tenants):
+            serving = st.serving
+            depths: dict = {}
+            shed = 0
+            enqueued = 0
+            burn = {}
+            if serving is not None:
+                depths = serving.queue_depths()
+                shed = sum(serving.shed.values())
+                enqueued = sum(serving.enqueued.values())
+                burn = {
+                    q: serving.slo.state_of(q)
+                    for q in serving._queues
+                }
+            offered = enqueued + shed
+            depth_total = sum(depths.values())
+            burning = sum(1 for s in burn.values() if s != "ok")
+            rows.append(
+                {
+                    "tenant": t,
+                    "sessions_live": len(st._audit_rows),
+                    "members": len(st._members),
+                    "queue_depth": depth_total,
+                    "queues": depths,
+                    "shed": shed,
+                    "shed_rate": (
+                        round(shed / offered, 4) if offered else 0.0
+                    ),
+                    "slo_states": burn,
+                    "pressure": depth_total + 64 * burning + shed,
+                }
+            )
+        ranked = sorted(
+            rows, key=lambda r: r["pressure"], reverse=True
+        )
+        return {
+            "num_tenants": self.num_tenants,
+            "waves": self.waves,
+            "last_wave": dict(self.last_wave),
+            "top_k": ranked[: max(1, top_k)],
+            "tenants": rows,
+        }
+
+    # ── warmup ───────────────────────────────────────────────────────
+
+    def warm(
+        self,
+        buckets: Sequence[int],
+        now: float,
+        session_config: Optional[SessionConfig] = None,
+        turns: int = 1,
+    ) -> dict:
+        """Compile the (bucket, T) tenant-wave tile set (+ the sanitize
+        variant when any tenant carries an integrity plane) so a
+        serving soak holds ZERO post-warmup recompiles — the solo
+        scheduler's closed-bucket contract with the tenant axis
+        attached. Returns the compile-telemetry totals afterward."""
+        cfg = session_config or SessionConfig(
+            min_sigma_eff=0.0, max_participants=4
+        )
+        body_words = BODY_WORDS
+        planes = [
+            st.integrity
+            for st in self.tenants
+            if st.integrity is not None
+        ]
+        sanitize_passes = (False, True) if planes else (False,)
+        for bucket in sorted(set(buckets)):
+            for sanitized in sanitize_passes:
+                if sanitized:
+                    for plane in planes:
+                        plane._fused_due = True
+                ids = {
+                    0: [f"tenant:warm:b{bucket}:s{int(sanitized)}"]
+                }
+                slots = self.create_sessions_batch(
+                    ids, cfg, pad_to=bucket
+                )
+                self.governance_wave_batch(
+                    {
+                        0: {
+                            "session_slots": slots[0],
+                            "dids": [
+                                f"did:tenant:warm:b{bucket}"
+                                f":s{int(sanitized)}"
+                            ],
+                            "agent_sessions": slots[0].copy(),
+                            "sigma_raw": np.full(1, 0.8, np.float32),
+                            "delta_bodies": np.zeros(
+                                (turns, 1, body_words), np.uint32
+                            ),
+                        }
+                    },
+                    bucket,
+                    now=now,
+                )
+        # The drain's refresh program (stale-gauge fallback) compiles
+        # here too, so a mid-soak scrape never counts as fresh compile.
+        self.tenants[0]._gauges_fresh = False
+        self.metrics_snapshot()
+        summary = health_plane.compile_summary(last=0)
+        return {
+            k: summary[k]
+            for k in (
+                "programs", "compiles", "recompiles",
+                "donation_failures",
+            )
+        }
+
+
+__all__ = ["TenantArena", "TenantState", "TenantWaveOut", "COMPONENTS"]

@@ -62,6 +62,13 @@
 #      injected deadline-griefing burst must trip an
 #      slo.burn_rate_warning-or-worse alert whose alert log replays
 #      deterministically (same trace + seed => same alert digest),
+#   6i. a tenant-dense isolation gate (round 16) — the seeded
+#       noisy-neighbor drill: one byzantine tenant at full rate must
+#       leave every neighbor's chain heads bit-identical to a solo
+#       oracle run with zero cross-tenant sheds (containment 1.0,
+#       replay-deterministic), the unhardened shared-door twin must
+#       score strictly lower, and a warmed (bucket, T) tile set must
+#       hold zero recompiles across a driven arena round,
 #   6h. a roofline-observatory gate (round 15) — seeded traffic with
 #      the observatory attached must yield a well-formed
 #      /debug/roofline payload (host-plane-clean JSON), a modeled
@@ -886,6 +893,68 @@ print(
 PY
 roofline_rc=$?
 
+echo "── tenant-dense isolation gate (noisy neighbor) ──"
+# Round 16 (ISSUE 15): the seeded noisy-neighbor drill at full rate —
+# one byzantine tenant (sybil flood past its quota + own-slice
+# corruption + ragged-burst deadline griefing) must leave every
+# neighbor's chain heads BIT-IDENTICAL to a solo oracle run, with
+# full neighbor goodput and ZERO cross-tenant sheds (containment 1.0,
+# replay-deterministic digest), while the unhardened shared-door twin
+# scores strictly lower (the quota + DRR machinery is load-bearing).
+# Plus the warm contract: a warmed (bucket, T) tile set holds zero
+# recompiles across a driven arena round.
+JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+
+from hypervisor_tpu.observability import health as health_plane
+from hypervisor_tpu.testing import scenarios
+
+SEED = 16
+r = scenarios.run_scenario("noisy_neighbor", SEED, hardened=True)
+assert r.score >= scenarios.DEFAULT_CONTAINMENT_FLOOR, r.components
+assert r.components["honest_neighbor_chains"] == 1.0, r.components
+assert r.components["honest_neighbor_unshed"] == 1.0, r.components
+assert r.components["honest_neighbor_goodput"] == 1.0, r.components
+r2 = scenarios.run_scenario("noisy_neighbor", SEED, hardened=True)
+assert r2.trace_digest == r.trace_digest, "drill must replay"
+bare = scenarios.run_scenario("noisy_neighbor", SEED, hardened=False)
+assert bare.score < r.score, (bare.score, r.score)
+
+# Warm contract with the tenant axis: zero post-warmup recompiles.
+from hypervisor_tpu.config import DEFAULT_CONFIG, TableCapacity
+from hypervisor_tpu.serving import ServingConfig
+from hypervisor_tpu.tenancy import (
+    TenantArena, TenantFrontDoor, TenantWaveScheduler,
+)
+
+cfg = DEFAULT_CONFIG.replace(capacity=TableCapacity(
+    max_agents=64, max_sessions=64, max_vouch_edges=64, max_sagas=16,
+    max_steps_per_saga=4, max_elevations=16, delta_log_capacity=256,
+    event_log_capacity=64, trace_log_capacity=64,
+))
+arena = TenantArena(3, cfg)
+front = TenantFrontDoor(arena, ServingConfig(buckets=(4, 8)))
+sched = TenantWaveScheduler(front)
+sched.warm(now=0.0)
+base = health_plane.compile_summary(last=0)
+now = 10.0
+for r_ in range(3):
+    for t in range(3):
+        front.submit_lifecycle(
+            t, f"vg:{t}:{r_}", f"did:vg:{t}:{r_}", 0.8, now=now
+        )
+    sched.lifecycle_round(now)
+    now += 0.1
+after = health_plane.compile_summary(last=0)
+assert after["compiles"] - base["compiles"] == 0, "post-warmup compile"
+assert after["recompiles"] - base["recompiles"] == 0, "recompile"
+print(
+    "tenant gate OK: containment", r.score, "vs bare", bare.score,
+    "| zero post-warmup recompiles over the (bucket, T) tiles"
+)
+PY
+tenant_rc=$?
+
 echo "── hvlint static-analysis gate ──"
 # The contract analyzer (ISSUE 12): Tier A pure-AST rules (WAL
 # coverage, env arming, lock discipline, append-only registries, twin
@@ -959,6 +1028,10 @@ fi
 if [ "$roofline_rc" -ne 0 ]; then
     echo "roofline-observatory gate FAILED (rc=$roofline_rc)" >&2
     exit "$roofline_rc"
+fi
+if [ "$tenant_rc" -ne 0 ]; then
+    echo "tenant-dense isolation gate FAILED (rc=$tenant_rc)" >&2
+    exit "$tenant_rc"
 fi
 if [ "$hvlint_rc" -ne 0 ]; then
     echo "hvlint static-analysis gate FAILED (rc=$hvlint_rc)" >&2

@@ -102,6 +102,7 @@ class TestHardeningDeltas:
             "collusion_ring",     # vouch-graph collusion detector
             "slash_cascade",      # deduped canonical cascade
             "compensation_storm", # supervisor comp backpressure
+            "noisy_neighbor",     # per-tenant quotas + DRR fair share
         ],
     )
     def test_unhardened_twin_scores_strictly_lower(self, name):

@@ -190,9 +190,10 @@ class TestHTTPTransport:
         # (/debug/integrity), and the serving front door
         # (/debug/serving, the batched join-wave, the NDJSON stream),
         # and the latency observatory (/debug/slo), and the roofline
-        # observatory (/debug/roofline + POST /debug/profile): 44
-        # routes.
-        assert len(ROUTES) == 44
+        # observatory (/debug/roofline + POST /debug/profile), and the
+        # tenant-dense panel (/debug/tenants): 45 routes.
+        assert len(ROUTES) == 45
+        assert any(path == "/debug/tenants" for _, path, _, _ in ROUTES)
         assert any(path == "/debug/resilience" for _, path, _, _ in ROUTES)
         assert any(path == "/debug/integrity" for _, path, _, _ in ROUTES)
         assert any(path == "/debug/serving" for _, path, _, _ in ROUTES)

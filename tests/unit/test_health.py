@@ -733,6 +733,40 @@ def _suite_report(
             if round_no >= 15
             else None
         ),
+        # Rounds >= regression.TENANT_ROW_SINCE must carry the
+        # tenant-dense row (round-16 presence gate, ISSUE 15); the
+        # amortization ratio and tenant count are floor-gated and the
+        # recompile count hard-gated to zero.
+        "tenant_dense": (
+            {
+                "seed": 17,
+                "quick": quick,
+                "tenants": 100,
+                "rounds": 6,
+                "buckets": [4, 8],
+                "offered": 1200,
+                "served": 1200,
+                "waves": 6,
+                "per_tenant_p99_ms": 1010.0,
+                "slo_p99_ms": 1500.0,
+                "within_slo": True,
+                "amortized_us_per_op": 26.2,
+                "wave_wall_mean_ms": 5.2,
+                "census": {
+                    "tenants": 100,
+                    "bucket": 8,
+                    "tenant_wave_steps": 29,
+                    "single_wave_steps": 31,
+                    "t_times_single_steps": 3100,
+                    "amortization_ratio": 106.9,
+                },
+                "amortization_ratio": 106.9,
+                "compiles_after_warmup": 0,
+                "recompiles_after_warmup": 0,
+            }
+            if round_no >= 16
+            else None
+        ),
     }
 
 

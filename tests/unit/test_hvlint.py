@@ -610,6 +610,9 @@ class TestJaxprLinter:
             "governance_wave_sanitized",
             "governance_wave_megakernel",
             "governance_wave_donated_call",
+            # Round 16: the tenant arena's [T, …] donated dispatch —
+            # HVB002 use-after-donate over the whole tenant frontier.
+            "tenant_governance_wave_donated_call",
         ]
 
 

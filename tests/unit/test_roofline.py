@@ -539,10 +539,12 @@ class TestHvTopDegrade:
         try:
             hv_top = self._hv_top()
             base = f"http://127.0.0.1:{httpd.server_address[1]}"
-            health, counters, roof = hv_top.poll_url(base)
+            health, counters, roof, tenants = hv_top.poll_url(base)
             assert roof is None
-            frame = hv_top.render(health, counters, [], roof)
+            assert tenants is None  # pre-r16 server: panel degrades too
+            frame = hv_top.render(health, counters, [], roof, tenants)
             assert "roofline   n/a" in frame
+            assert "tenants    (single-tenant deployment)" in frame
         finally:
             httpd.shutdown()
 

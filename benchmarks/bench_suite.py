@@ -881,6 +881,35 @@ def soak_benchmark(seed: int, quick: bool) -> dict:
     return report
 
 
+def autopilot_soak_benchmark(seed: int, quick: bool) -> dict:
+    """`--autopilot <seed>`: the autopilot observatory proving-ground
+    row (ISSUE 17) — a seeded three-phase shifting workload mix (calm ->
+    lifecycle-heavy burst -> settle) replayed twice under the autopilot
+    control plane (`hypervisor_tpu.autopilot`) and once against the
+    deliberately narrow static config it is scored against. Reports
+    goodput improvement vs static (the >= 20% floor), p99 vs the stated
+    smoke SLO, decision count + outcome attribution, the decision
+    ledger's digest and its bit-identity across the two replays (the
+    determinism contract, also verify gate 6j), UNPLANNED post-warmup
+    recompiles (raw minus the ledger-bracketed pre-warm compiles —
+    pinned zero), and invariant violations. `regression.py` gates the
+    row from round 17 (HV_BENCH_AUTOPILOT_*).
+    """
+    from hypervisor_tpu.autopilot.soak import run_autopilot_soak
+
+    import jax
+
+    cpu = jax.default_backend() != "tpu"
+    row = run_autopilot_soak(
+        seed=seed,
+        quick=quick,
+        slo_p99_ms=1500.0 if cpu else 100.0,
+        tick_s=0.02,
+        replays=2,
+    )
+    return row
+
+
 def tenant_census_row(tenants: int, bucket: int, turns: int) -> dict | None:
     """Deviceless step census of the `[T, …]` tenant wave vs T separate
     single-tenant megakernel dispatches — the ISSUE 15 amortization
@@ -1094,7 +1123,15 @@ def tenant_dense_benchmark(seed: int, quick: bool, tenants: int) -> dict:
     rounds = 6 if quick else 12
     lanes_per_round = 2
     bucket_set = (4, 8)
-    slo_p99_ms = 1500.0 if cpu else 100.0
+    # The gated number is the WORST per-tenant p99 — with ~12 tickets
+    # per tenant that is the global max ticket latency over T tenants,
+    # a max-statistic whose cpu spread is set by host scheduling jitter
+    # under DRR round alignment, not by the runtime (observed 1.4-2.7 s
+    # across idle-box runs of identical code at T=100 on one core; the
+    # r16-era 1.5 s bound flaked most runs). The cpu smoke bound only
+    # guards against order-of-magnitude breakage; 100 ms on TPU is the
+    # real contract.
+    slo_p99_ms = 3000.0 if cpu else 100.0
     cfg = DEFAULT_CONFIG.replace(
         capacity=TableCapacity(
             max_agents=64,
@@ -1208,6 +1245,50 @@ def tenant_dense_benchmark(seed: int, quick: bool, tenants: int) -> dict:
         "warm_wall_s": round(warm_wall, 3),
         "drive_wall_s": round(drive_wall, 3),
     }
+
+
+def tenant_dense_row_isolated(
+    seed: int, quick: bool, tenants: int, timeout_s: float = 480.0
+) -> dict | None:
+    """Run `tenant_dense_benchmark` in a SUBPROCESS and return its row.
+
+    Subprocess, not in-process: `per_tenant_p99_ms` is the WORST
+    per-tenant tail over T tenants' measured wave walls — a handful of
+    samples per tenant, so the gated number is set by the single worst
+    scheduling hiccup anywhere in the run. By this point the suite
+    process has run the microbenches, scenarios, soak, census and
+    roofline rows; the accumulated jit cache, host metric mirrors and
+    deferred roofline-capture resolution (which re-traces on metrics
+    drains) land exactly in those tails — observed inflating the p99
+    ~1.5-2x over a fresh interpreter on cpu. The census row set the
+    subprocess precedent. Falls back to the in-process run (None →
+    caller decides) only if the child fails outright.
+    """
+    code = (
+        "import json\n"
+        "from benchmarks.bench_suite import tenant_dense_benchmark\n"
+        f"row = tenant_dense_benchmark({seed!r}, {quick!r}, {tenants!r})\n"
+        "print('HV_TENANT_ROW=' + json.dumps(row))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=str(Path(__file__).resolve().parent.parent),
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith("HV_TENANT_ROW="):
+            try:
+                return json.loads(line[len("HV_TENANT_ROW="):])
+            except json.JSONDecodeError:
+                return None
+    return None
 
 
 def wave_megakernel_row(
@@ -1640,6 +1721,22 @@ def main() -> None:
         ),
     )
     ap.add_argument(
+        "--autopilot",
+        type=int,
+        default=None,
+        metavar="SEED",
+        help=(
+            "also run the autopilot shifting-mix soak (ISSUE 17): the "
+            "same seeded three-phase trace replayed twice under the "
+            "autopilot decision plane (hypervisor_tpu/autopilot) and "
+            "once static, and report goodput improvement vs static, "
+            "p99 vs the smoke SLO, decision count + outcomes, the "
+            "decision-ledger digest's bit-identity across replays, and "
+            "the zero-UNPLANNED-recompile contract into the BENCH "
+            "payload"
+        ),
+    )
+    ap.add_argument(
         "--tenants",
         type=int,
         default=None,
@@ -1807,7 +1904,11 @@ def main() -> None:
     # compares across rounds (`registry.latest` — newest capture wins).
     tenant_rec = None
     if args.tenants is not None:
-        tenant_rec = tenant_dense_benchmark(17, args.quick, args.tenants)
+        # Fresh interpreter for the tail-sensitive per-tenant p99 (see
+        # tenant_dense_row_isolated); in-process only as a fallback.
+        tenant_rec = tenant_dense_row_isolated(17, args.quick, args.tenants)
+        if tenant_rec is None:
+            tenant_rec = tenant_dense_benchmark(17, args.quick, args.tenants)
         if not args.json_only:
             c = tenant_rec.get("census") or {}
             print(
@@ -1824,6 +1925,35 @@ def main() -> None:
                 "after warmup",
                 flush=True,
             )
+
+    # The autopilot soak runs LAST among the timed rows: its grown-
+    # bucket tiles (16/32/64) and three full trace replays would
+    # otherwise pressure the process-global jit cache under the
+    # tenant-dense bench's measured walls (and shadow the roofline
+    # registry with small-shape captures).
+    autopilot_rec = None
+    if args.autopilot is not None:
+        autopilot_rec = autopilot_soak_benchmark(args.autopilot, args.quick)
+        if not args.json_only:
+            outcomes = autopilot_rec["decision_outcomes"]
+            print(
+                f"autopilot[seed={args.autopilot}]: "
+                f"{autopilot_rec['decisions']} decisions "
+                f"({outcomes.get('confirmed', 0)} confirmed / "
+                f"{outcomes.get('refuted', 0)} refuted), goodput "
+                f"+{autopilot_rec.get('goodput_improvement', 0.0):.1%} vs "
+                f"static, p99 {autopilot_rec['p99_ms']} ms vs SLO "
+                f"{autopilot_rec['slo_p99_ms']} ms, buckets "
+                f"{autopilot_rec['static']['buckets']} -> "
+                f"{autopilot_rec['buckets_final']}, "
+                f"{autopilot_rec['recompiles_after_warmup']} unplanned "
+                f"recompiles (raw "
+                f"{autopilot_rec['recompiles_after_warmup_raw']}), digest "
+                f"match {autopilot_rec['digest_match']} over "
+                f"{autopilot_rec['replays']} replays",
+                flush=True,
+            )
+
 
     static_rec = None
     if args.metrics_out:
@@ -1919,6 +2049,14 @@ def main() -> None:
             # presence-gates it from round 16 and floors the
             # amortization ratio (HV_BENCH_TENANT_AMORT).
             "tenant_dense": tenant_rec,
+            # Autopilot row (round 17, --autopilot <seed>): the
+            # shifting-mix soak under the deterministic decision plane
+            # — goodput improvement vs static, p99 vs the smoke SLO,
+            # decision count + outcomes, replay digest bit-identity,
+            # zero UNPLANNED recompiles — regression.py presence-gates
+            # it from round 17 and floors the improvement
+            # (HV_BENCH_AUTOPILOT_GAIN).
+            "autopilot_soak": autopilot_rec,
         }
         out_path.write_text(json.dumps(report, indent=2) + "\n")
         if not args.json_only:
@@ -1946,6 +2084,7 @@ def main() -> None:
         "scenarios": scenario_rec,
         "soak": soak_rec,
         "tenant_dense": tenant_rec,
+        "autopilot_soak": autopilot_rec,
     }
     if jax.default_backend() not in ("tpu",) and not args.write_results:
         print(

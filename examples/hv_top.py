@@ -57,11 +57,14 @@ def parse_prometheus_counters(text: str) -> dict[str, float]:
     return out
 
 
-def poll_url(base: str) -> tuple[dict, dict[str, float], dict | None]:
-    """One (/debug/health, /metrics, /debug/roofline) poll against a
-    live deployment. The roofline poll degrades gracefully: an older
-    server without the endpoint (404) — or any fetch error — renders
-    the panel as "n/a" instead of crashing the watch loop."""
+def poll_url(
+    base: str,
+) -> tuple[dict, dict[str, float], dict | None, dict | None, dict | None]:
+    """One (/debug/health, /metrics, /debug/roofline, /debug/tenants,
+    /debug/autopilot) poll against a live deployment. The observatory
+    polls degrade gracefully: an older server without an endpoint
+    (404) — or any fetch error — renders that panel as "n/a" instead
+    of crashing the watch loop."""
     from urllib.error import HTTPError, URLError
     from urllib.request import urlopen
 
@@ -82,12 +85,18 @@ def poll_url(base: str) -> tuple[dict, dict[str, float], dict | None]:
             tenants = json.loads(resp.read())
     except (HTTPError, URLError, OSError, json.JSONDecodeError):
         tenants = None  # pre-r16 server or transient fetch failure
-    return health, counters, roofline, tenants
+    autopilot = None
+    try:
+        with urlopen(f"{base}/debug/autopilot", timeout=10) as resp:
+            autopilot = json.loads(resp.read())
+    except (HTTPError, URLError, OSError, json.JSONDecodeError):
+        autopilot = None  # pre-r17 server or transient fetch failure
+    return health, counters, roofline, tenants, autopilot
 
 
 def poll_state(
     state, tenant_front=None
-) -> tuple[dict, dict[str, float], dict | None, dict | None]:
+) -> tuple[dict, dict[str, float], dict | None, dict | None, dict | None]:
     """The in-process twin of `poll_url` (same payload shapes).
     `tenant_front` (a `tenancy.TenantFrontDoor`) supplies the tenants
     panel; a solo state whose tables live in an arena reports that
@@ -110,7 +119,11 @@ def poll_state(
                 tenants["enabled"] = True
     except Exception:  # noqa: BLE001 — panel shows n/a, never crashes
         tenants = None
-    return health, counters, roofline, tenants
+    try:
+        autopilot = state.autopilot_summary()
+    except Exception:  # noqa: BLE001 — panel shows n/a, never crashes
+        autopilot = None
+    return health, counters, roofline, tenants, autopilot
 
 
 def load_trajectory(root: Path) -> list[dict]:
@@ -137,6 +150,7 @@ def render(
     trajectory: list[dict],
     roofline: dict | None = None,
     tenants: dict | None = None,
+    autopilot: dict | None = None,
 ) -> str:
     lines = [
         f"hv_top @ {time.strftime('%H:%M:%S')}  "
@@ -323,6 +337,53 @@ def render(
             ),
         )
 
+    lines.append("")
+    if not autopilot or not autopilot.get("enabled"):
+        lines.append("autopilot  n/a (endpoint absent or plane off)")
+    else:
+        outcomes = autopilot.get("outcomes") or {}
+        knobs = autopilot.get("knobs") or {}
+        now_k = knobs.get("now") or {}
+        static_k = knobs.get("static") or {}
+        prewarm = autopilot.get("prewarm") or {}
+        digest = autopilot.get("digest") or ""
+        lines.append(
+            f"autopilot  decisions={autopilot.get('decisions', 0):,}  "
+            f"confirmed={outcomes.get('confirmed', 0)}  "
+            f"refuted={outcomes.get('refuted', 0)}  "
+            f"pending={outcomes.get('pending', 0)}  "
+            f"windows={autopilot.get('windows', 0):,}  "
+            f"prewarmed={prewarm.get('events', 0)}  "
+            f"digest={digest[:12] or '-'}"
+        )
+        knob_rows = []
+        for name in sorted(set(now_k) | set(static_k)):
+
+            def _k(d):
+                v = d.get(name)
+                if isinstance(v, (list, tuple)):
+                    return ",".join(str(x) for x in v)
+                return "-" if v is None else str(v)
+
+            cur, base = _k(now_k), _k(static_k)
+            knob_rows.append(
+                (name, base, cur, "tuned" if cur != base else "")
+            )
+        lines += fmt_table(
+            knob_rows, header=("knob", "static", "now", "")
+        )
+        for d in (autopilot.get("last") or [])[-4:]:
+            outcome = d.get("outcome")
+            mark = (
+                "?" if outcome is None
+                else "+" if outcome.get("ok") else "x"
+            )
+            lines.append(
+                f"  [{mark}] #{d.get('seq')} {d.get('rule', ''):18s} "
+                f"{d.get('knob', ''):22s} "
+                f"{d.get('before')} -> {d.get('after')}"
+            )
+
     slo = health.get("slo", {})
     lines.append("")
     if not slo.get("enabled"):
@@ -446,8 +507,12 @@ def main(argv=None) -> int:
 
     if args.url:
         def frame() -> str:
-            health, counters, roofline, tenants = poll_url(args.url)
-            return render(health, counters, trajectory, roofline, tenants)
+            health, counters, roofline, tenants, autopilot = poll_url(
+                args.url
+            )
+            return render(
+                health, counters, trajectory, roofline, tenants, autopilot
+            )
 
         return watch_loop(frame, watch=args.watch, interval=args.interval)
 
@@ -484,8 +549,10 @@ def main(argv=None) -> int:
             progress["rnd"] += 1
 
     def frame() -> str:
-        health, counters, roofline, tenants = poll_state(state)
-        return render(health, counters, trajectory, roofline, tenants)
+        health, counters, roofline, tenants, autopilot = poll_state(state)
+        return render(
+            health, counters, trajectory, roofline, tenants, autopilot
+        )
 
     return watch_loop(
         frame, watch=args.watch, interval=args.interval, tick=tick

@@ -75,6 +75,7 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/debug/slo", "debug_slo", None),
     ("GET", "/debug/roofline", "debug_roofline", None),
     ("GET", "/debug/tenants", "debug_tenants", None),
+    ("GET", "/debug/autopilot", "debug_autopilot", None),
     ("POST", "/debug/profile", "debug_profile", M.ProfileRequest),
     ("GET", "/api/v1/stats", "stats", None),
     ("GET", "/api/v1/device/stats", "device_stats", None),

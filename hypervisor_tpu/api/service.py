@@ -757,6 +757,16 @@ class HypervisorService:
         program, and the live distance-to-the-floor block."""
         return self.hv.state.roofline_summary()
 
+    async def debug_autopilot(self) -> dict:
+        """`GET /debug/autopilot`: the decision plane in one poll —
+        last N ledger decisions (rule, knob delta, input-signal digest,
+        outcome attribution, CausalTraceId), live knob values vs the
+        static defaults, pre-warm compile accounting, and the
+        replayable decisions digest. A deployment with no attached
+        `autopilot.Autopilot` answers `{"enabled": false}` (hv_top's
+        `--url` panel degrades to n/a against such servers)."""
+        return self.hv.state.autopilot_summary()
+
     async def debug_profile(self, req: M.ProfileRequest) -> dict:
         """`POST /debug/profile`: an on-demand bounded `jax.profiler`
         capture window (TensorBoard/Perfetto trace into `log_dir`).

@@ -1803,6 +1803,12 @@ class Hypervisor:
             # fan-out (`observability.roofline`, drained at the
             # metrics drain).
             "roofline_shift": EventType.ROOFLINE_BYTES_SHIFT,
+            # Autopilot decisions + post-hoc outcome attributions ride
+            # the same fan-out (`autopilot.plane.Autopilot`); the
+            # payload's trace_id is the decision's deterministic
+            # CausalTraceId, so the bus row joins the trace plane.
+            "autopilot_decision": EventType.AUTOPILOT_DECISION,
+            "autopilot_outcome": EventType.AUTOPILOT_OUTCOME,
         }.get(kind)
         if event_type is None or self.event_bus is None:
             return

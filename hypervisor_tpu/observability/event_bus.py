@@ -112,6 +112,15 @@ class EventType(str, enum.Enum):
     # facade-bridged from the health fan-out like the planes above.
     ROOFLINE_BYTES_SHIFT = "roofline.bytes_shift"
 
+    # Autopilot decision plane (append-only, like every block above):
+    # each applied knob delta and its post-hoc outcome attribution
+    # (`autopilot.DecisionLedger`), facade-bridged from the health
+    # fan-out like the planes above. Payloads carry the input-signal
+    # digest, the rule that fired, the before->after knob values, and
+    # the decision's deterministic CausalTraceId (the trace-plane join).
+    AUTOPILOT_DECISION = "autopilot.decision"
+    AUTOPILOT_OUTCOME = "autopilot.outcome"
+
     @property
     def code(self) -> int:
         """int32 column code for the device event log."""

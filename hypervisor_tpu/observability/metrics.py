@@ -679,6 +679,38 @@ ROOFLINE_FLOOR_DISTANCE = REGISTRY.gauge(
     "replacement for ROOFLINE.md's static distance estimate",
 )
 
+# ── autopilot observatory (decision plane, round 17) ─────────────────
+# HOST-owned rows bumped by `autopilot.Autopilot` as decisions apply
+# and outcomes attribute — the ledger's metric drain. APPENDED at the
+# registry tail (hvlint HVA004).
+AUTOPILOT_DECISIONS = REGISTRY.counter(
+    "hv_autopilot_decisions_total",
+    "knob deltas applied by the autopilot decision plane",
+)
+AUTOPILOT_OUTCOMES_CONFIRMED = REGISTRY.counter(
+    "hv_autopilot_outcomes_confirmed_total",
+    "post-hoc attributions where the signal moved as the rule predicted",
+)
+AUTOPILOT_OUTCOMES_REFUTED = REGISTRY.counter(
+    "hv_autopilot_outcomes_refuted_total",
+    "post-hoc attributions where the signal did NOT move as predicted",
+)
+AUTOPILOT_PREWARM_COMPILES = REGISTRY.counter(
+    "hv_autopilot_prewarm_compiles_total",
+    "ledger-bracketed PLANNED compiles from bucket-grow pre-warms (the "
+    "zero-UNPLANNED-recompile contract subtracts these)",
+)
+AUTOPILOT_MAX_BUCKET = REGISTRY.gauge(
+    "hv_autopilot_max_bucket",
+    "largest bucket in the live closed serving set (vs the static "
+    "default hv_top renders)",
+)
+AUTOPILOT_SANITIZE_EVERY = REGISTRY.gauge(
+    "hv_autopilot_sanitize_every",
+    "live sanitizer cadence (dispatches between fused sanitize passes) "
+    "after autopilot retunes",
+)
+
 
 # ── host object: device table + host mirror + drain ──────────────────
 

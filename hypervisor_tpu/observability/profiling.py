@@ -161,7 +161,7 @@ def capture_window(
     duration_s: float = 0.05,
     *,
     probe: bool = True,
-    grace_s: float = 10.0,
+    grace_s: float | None = None,
 ) -> dict:
     """Capture one bounded jax.profiler window into `log_dir`.
 
@@ -174,9 +174,15 @@ def capture_window(
     `duration_s + grace_s`; if the profiler wedges mid-start the thread
     is abandoned (daemon) and subsequent captures refuse "busy" until
     it either finishes or the process restarts — degraded, explicit,
-    and survivable, which is the whole contract.
+    and survivable, which is the whole contract. `grace_s` defaults
+    from `HV_PROFILE_GRACE_S` (read per call), 30 s: stop_trace()
+    WRITES the trace, and on a loaded one-core host a healthy write
+    alone has been observed to exceed the old 10 s bound — the grace
+    must bound a wedge, not a slow disk.
     """
     global _capture_thread
+    if grace_s is None:
+        grace_s = float(os.environ.get("HV_PROFILE_GRACE_S", "30"))
     duration_s = min(max(float(duration_s), 0.001), 10.0)
     if probe:
         ok, detail = probe_device_plane()

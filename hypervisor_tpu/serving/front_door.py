@@ -337,6 +337,32 @@ class FrontDoor:
         self._drain_pending = {q: 0 for q in self._queues}
         state.serving = self
 
+    def reconfigure(self, config: ServingConfig) -> None:
+        """Swap the serving config live — the autopilot's knob path.
+
+        Updates the config and the per-queue depth caps under the
+        submit/drain lock; queued tickets and all accounting survive.
+        GROWING `buckets` widens the closed set: the caller MUST
+        pre-warm the new (program, bucket) tiles first
+        (`WaveScheduler.warm_bucket`) or the next dispatch at the new
+        shape pays an UNPLANNED compile — the autopilot's grow rule
+        brackets that pre-warm with compile-telemetry reads so the
+        zero-recompile contract stays auditable. SLO objectives keep
+        their original windows/targets (deadlines are not autopilot
+        knobs in this round).
+        """
+        if not config.buckets:
+            raise ValueError("ServingConfig.buckets must be non-empty")
+        with self._lock:
+            self.config = config
+            self._depths = {
+                "join": config.join_queue_depth,
+                "action": config.action_queue_depth,
+                "lifecycle": config.lifecycle_queue_depth,
+                "terminate": config.terminate_queue_depth,
+                "saga": config.saga_queue_depth,
+            }
+
     # ── submit paths ─────────────────────────────────────────────────
 
     def _now(self, now: Optional[float]) -> float:

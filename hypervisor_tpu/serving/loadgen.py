@@ -191,6 +191,8 @@ def run_soak(
     slo_p99_ms: float = 250.0,
     attach_integrity: bool = True,
     integrity_every: int = 8,
+    autopilot: bool = False,
+    autopilot_config=None,
 ) -> dict:
     """Drive one open-workload trace through a warmed front door.
 
@@ -198,6 +200,14 @@ def run_soak(
     virtual clock drives arrivals and queue-wait latency; wave wall
     time is measured. Decisions digest + chain-heads digest are the
     replay-determinism keys.
+
+    With `autopilot=True` an `autopilot.Autopilot` attaches after
+    warmup and steps once per virtual tick (decision windows pace
+    themselves on the virtual clock, so the decision stream is as
+    replayable as the admission stream). Its grow-rule pre-warms are
+    ledger-bracketed PLANNED compiles: the report's
+    `recompiles_after_warmup` is net of them (the zero-UNPLANNED-
+    recompile contract) with the raw count alongside.
     """
     from hypervisor_tpu.state import HypervisorState
 
@@ -217,6 +227,11 @@ def run_soak(
     warm_t0 = time.perf_counter()
     baseline = sched.warm(now=0.0)
     warm_s = time.perf_counter() - warm_t0
+    pilot = None
+    if autopilot:
+        from hypervisor_tpu.autopilot import Autopilot
+
+        pilot = Autopilot(state, sched, config=autopilot_config)
     wall_t0 = time.perf_counter()
 
     decisions = hashlib.sha256()
@@ -313,9 +328,15 @@ def run_soak(
             submit(idx, trace[idx], trace[idx]["t"])
             idx += 1
         sched.tick(now=now)
+        if pilot is not None:
+            pilot.step(now)
         now += tick_s
     # Drain the tail so every accepted request resolves.
     sched.drain(now=now)
+    if pilot is not None:
+        # One closing window so tail decisions get their outcome
+        # attribution before the report snapshots the ledger.
+        pilot.step(now)
 
     wall_s = time.perf_counter() - wall_t0
     after = {
@@ -330,6 +351,12 @@ def run_soak(
     summary = health_plane.compile_summary(last=0)
     for k in after:
         after[k] = summary[k] - baseline[k]
+    # Planned pre-warm compiles (autopilot grow rule, ledger-bracketed)
+    # net out of the post-warm telemetry: the contract is zero
+    # UNPLANNED recompiles, and the raw counts ride the report so the
+    # subtraction is auditable.
+    planned_compiles = pilot.prewarm["compiles"] if pilot else 0
+    planned_recompiles = pilot.prewarm["recompiles"] if pilot else 0
 
     latencies = sorted(
         t.latency_s * 1e3 for t in tickets if t.latency_s is not None
@@ -359,7 +386,7 @@ def run_soak(
         )
 
     p99 = _quantile(latencies, 0.99)
-    return {
+    report = {
         "spec": spec.to_dict(),
         "events": len(trace),
         "offered": dict(offered, total=offered_total),
@@ -411,14 +438,19 @@ def run_soak(
         "waves": dict(front.waves),
         "padded_lanes": front.padded_lanes,
         "buckets": list(front.config.buckets),
-        "compiles_after_warmup": after["compiles"],
-        "recompiles_after_warmup": after["recompiles"],
+        "compiles_after_warmup": after["compiles"] - planned_compiles,
+        "recompiles_after_warmup": after["recompiles"] - planned_recompiles,
         "invariant_violations": violations,
         "decisions_digest": decisions.hexdigest(),
         "chain_heads_digest": chain_digest.hexdigest(),
         "warm_s": round(warm_s, 3),
         "wall_s": round(wall_s, 3),
     }
+    if pilot is not None:
+        report["compiles_after_warmup_raw"] = after["compiles"]
+        report["recompiles_after_warmup_raw"] = after["recompiles"]
+        report["autopilot"] = pilot.summary(last=16)
+    return report
 
 
 __all__ = [

@@ -44,8 +44,14 @@ class WaveScheduler:
     def __init__(self, front_door: FrontDoor) -> None:
         self.front_door = front_door
         self.state = front_door.state
-        self.config = front_door.config
         self.ticks = 0
+
+    @property
+    def config(self):
+        """Live view of the front door's config — stays current across
+        `FrontDoor.reconfigure` (the autopilot's knob path), so a grown
+        bucket set is dispatchable the tick after it is applied."""
+        return self.front_door.config
 
     # ── bucket arithmetic ────────────────────────────────────────────
 
@@ -457,6 +463,78 @@ class WaveScheduler:
                 "programs", "compiles", "recompiles", "donation_failures",
             )
         }
+
+    def warm_bucket(
+        self, bucket: int, now: Optional[float] = None, tag: str = ""
+    ) -> None:
+        """Compile every per-bucket program at ONE (possibly new)
+        bucket shape — the autopilot grow rule's off-hot-path pre-warm.
+
+        Covers the shapes a dispatch at `bucket` can reach: the fused
+        lifecycle wave at (bucket, bucket) in both sanitizer variants
+        (when an integrity plane is attached), a padded join flush, a
+        park-padded terminate, and — when `bucket` is a power of two —
+        the gateway at that width (action chunks cap at the new max
+        bucket, and the gateway pads to powers of two, so smaller
+        shapes were covered by the initial `warm`). Runs under the
+        front-door lock, BETWEEN scheduling passes — never inside one —
+        so the hot path only ever sees warm tiles.
+        """
+        from hypervisor_tpu.models import SessionConfig
+
+        fd = self.front_door
+        state = self.state
+        now = state.now() if now is None else float(now)
+        stamp = f"b{bucket}" + (f":{tag}" if tag else "")
+        with fd._lock:
+            plane = state.integrity
+            sanitize_passes = (False, True) if plane is not None else (False,)
+            for sanitized in sanitize_passes:
+                slots = state.create_sessions_batch(
+                    [f"serving:prewarm:{stamp}:s{int(sanitized)}"],
+                    self._lifecycle_config(),
+                )
+                if sanitized:
+                    plane._fused_due = True  # arm the fused variant
+                state.run_governance_wave(
+                    slots,
+                    [f"did:serving:prewarm:{stamp}:s{int(sanitized)}"],
+                    slots.copy(),
+                    np.full(1, 0.8, np.float32),
+                    np.zeros(
+                        (self.config.lifecycle_turns, 1, BODY_WORDS),
+                        np.uint32,
+                    ),
+                    now=now,
+                    pad_to=(bucket, bucket),
+                )
+            warm_sess = state.create_session(
+                f"serving:prewarm:join:{stamp}",
+                SessionConfig(min_sigma_eff=0.0),
+                now=now,
+            )
+            state.enqueue_join(
+                warm_sess, f"did:serving:prewarm:join:{stamp}", 0.8,
+                now=now,
+            )
+            state.flush_joins(now=now, pad_to=bucket)
+            row = state.agent_row(
+                f"did:serving:prewarm:join:{stamp}", warm_sess
+            )
+            if row is not None and bucket & (bucket - 1) == 0:
+                state.check_actions_wave(
+                    [row["slot"]] * bucket,
+                    [2] * bucket,
+                    [True] * bucket,
+                    [False] * bucket,
+                    [False] * bucket,
+                    [False] * bucket,
+                    now=now,
+                )
+            state.terminate_sessions(
+                [warm_sess], now=now, pad_to=bucket,
+                pad_slot=fd.park_slot(now),
+            )
 
 
 __all__ = ["WaveScheduler"]

@@ -675,6 +675,10 @@ class HypervisorState:
         # scheduler. Attaching a FrontDoor sets this; `health_summary`
         # carries its queue/shed/deadline panel for hv_top.
         self.serving = None
+        # Autopilot decision plane (opt-in, `hypervisor_tpu.autopilot`):
+        # attaching an Autopilot sets this; its append-only decision
+        # ledger serves `GET /debug/autopilot` via `autopilot_summary`.
+        self.autopilot = None
         # Per-flush admission statuses keyed by membership key
         # ((session << 32) | did, `_mkey`): the serving front door's
         # ticket-resolution hook (overwritten by every flush_joins).
@@ -3886,6 +3890,15 @@ class HypervisorState:
                 q: serving.retry_after_for(q) for q in serving._queues
             },
         }
+
+    def autopilot_summary(self) -> dict:
+        """The `GET /debug/autopilot` payload: last N decisions with
+        outcome attributions, live knob values vs static defaults, the
+        replayable decisions digest, and pre-warm compile accounting —
+        the bare plane state when no `autopilot.Autopilot` is attached."""
+        if self.autopilot is not None:
+            return self.autopilot.summary()
+        return {"enabled": False}
 
     def integrity_summary(self) -> dict:
         """The `GET /debug/integrity` payload: sanitizer cadence,

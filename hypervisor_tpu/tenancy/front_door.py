@@ -119,6 +119,10 @@ class TenantWaveScheduler:
         #: claim to the wave's widest shape each round; a smaller
         #: quantum tightens fairness under sustained contention.
         self.quantum = int(quantum or self.config.max_bucket)
+        #: Per-tenant quantum overrides (autopilot `drr.quantum` rule:
+        #: a tenant burning SLO budget earns boosted credits until it
+        #: recovers). Absent tenants earn the base `quantum`.
+        self.quanta: dict[int, float] = {}
         self.deficit = [0.0] * front.arena.num_tenants
         self._lifecycle_config = lifecycle_config or SessionConfig(
             min_sigma_eff=0.0, max_participants=4
@@ -128,6 +132,22 @@ class TenantWaveScheduler:
         self.solo = [WaveScheduler(d) for d in front.doors]
         self.ticks = 0
         self.lifecycle_rounds = 0
+
+    # ── per-tenant quanta (the autopilot's DRR knob) ─────────────────
+
+    def quantum_of(self, tenant: int) -> float:
+        """The tenant's lane credits per round (base unless boosted)."""
+        return float(self.quanta.get(tenant, self.quantum))
+
+    def set_quantum(self, tenant: int, quantum: float) -> None:
+        """Override one tenant's quantum (reset by passing the base
+        value). Takes effect from the NEXT lifecycle round — banked
+        deficit is untouched, so fairness history survives the retune."""
+        tenant = int(tenant)
+        if float(quantum) == float(self.quantum):
+            self.quanta.pop(tenant, None)
+        else:
+            self.quanta[tenant] = float(quantum)
 
     # ── bucket arithmetic (the solo rule) ────────────────────────────
 
@@ -168,7 +188,7 @@ class TenantWaveScheduler:
                     # past its fair share later.
                     self.deficit[t] = 0.0
                     continue
-                self.deficit[t] += self.quantum
+                self.deficit[t] += self.quantum_of(t)
                 n = min(
                     len(q), int(self.deficit[t]), self.config.max_bucket
                 )

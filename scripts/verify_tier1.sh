@@ -955,6 +955,44 @@ print(
 PY
 tenant_rc=$?
 
+echo "── autopilot decision-plane gate (6j) ──"
+# Round 17 (ISSUE 17): the seeded quick shifting-mix soak under the
+# autopilot — the controller must FIRE (>= 1 decision), hold the p99
+# inside the smoke SLO, keep the zero-UNPLANNED-recompile contract
+# (raw post-warm compiles minus the ledger-bracketed pre-warm set),
+# hold zero invariant violations, and replay bit-identically: two runs
+# of the SAME trace + seed produce IDENTICAL decision-ledger digests
+# (the deterministic replay contract the decision plane is built on).
+JAX_PLATFORMS=cpu python - <<'PY'
+from hypervisor_tpu.autopilot.soak import run_autopilot_soak
+
+row = run_autopilot_soak(seed=17, quick=True, replays=2)
+assert row["decisions"] >= 1, f"controller never fired: {row['decisions']}"
+assert row["p99_ms"] <= row["slo_p99_ms"], (
+    f"p99 {row['p99_ms']} ms over smoke SLO {row['slo_p99_ms']} ms"
+)
+assert row["recompiles_after_warmup"] == 0, (
+    f"UNPLANNED post-warmup recompiles: {row['recompiles_after_warmup']} "
+    f"(raw {row['recompiles_after_warmup_raw']}, prewarm {row['prewarm']})"
+)
+assert row["invariant_violations"] == 0, row["invariant_violations"]
+assert row["digest_match"], (
+    "decision stream NOT replay-deterministic: ledger digests differ "
+    "across replays of the same trace + seed"
+)
+assert row["goodput_improvement"] > 0, (
+    f"autopilot did not beat static: {row['goodput_improvement']}"
+)
+print(
+    f"autopilot gate OK: {row['decisions']} decisions "
+    f"({row['decision_outcomes']}), buckets -> {row['buckets_final']}, "
+    f"goodput +{row['goodput_improvement']:.1%} vs static, p99 "
+    f"{row['p99_ms']} ms <= {row['slo_p99_ms']} ms, zero unplanned "
+    f"recompiles, digest bit-identical over {row['replays']} replays"
+)
+PY
+autopilot_rc=$?
+
 echo "── hvlint static-analysis gate ──"
 # The contract analyzer (ISSUE 12): Tier A pure-AST rules (WAL
 # coverage, env arming, lock discipline, append-only registries, twin
@@ -1032,6 +1070,10 @@ fi
 if [ "$tenant_rc" -ne 0 ]; then
     echo "tenant-dense isolation gate FAILED (rc=$tenant_rc)" >&2
     exit "$tenant_rc"
+fi
+if [ "$autopilot_rc" -ne 0 ]; then
+    echo "autopilot decision-plane gate FAILED (rc=$autopilot_rc)" >&2
+    exit "$autopilot_rc"
 fi
 if [ "$hvlint_rc" -ne 0 ]; then
     echo "hvlint static-analysis gate FAILED (rc=$hvlint_rc)" >&2

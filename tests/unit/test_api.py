@@ -191,9 +191,11 @@ class TestHTTPTransport:
         # (/debug/serving, the batched join-wave, the NDJSON stream),
         # and the latency observatory (/debug/slo), and the roofline
         # observatory (/debug/roofline + POST /debug/profile), and the
-        # tenant-dense panel (/debug/tenants): 45 routes.
-        assert len(ROUTES) == 45
+        # tenant-dense panel (/debug/tenants), and the autopilot
+        # decision plane (/debug/autopilot): 46 routes.
+        assert len(ROUTES) == 46
         assert any(path == "/debug/tenants" for _, path, _, _ in ROUTES)
+        assert any(path == "/debug/autopilot" for _, path, _, _ in ROUTES)
         assert any(path == "/debug/resilience" for _, path, _, _ in ROUTES)
         assert any(path == "/debug/integrity" for _, path, _, _ in ROUTES)
         assert any(path == "/debug/serving" for _, path, _, _ in ROUTES)

@@ -1,0 +1,552 @@
+"""Autopilot observatory (ISSUE 17): the deterministic decision plane.
+
+The load-bearing pins:
+
+  1. **Replay determinism** — the rule engine is a pure fold of the
+     snapshot stream: two engines fed the same synthetic sequence emit
+     identical proposal streams, and two ledgers recording them hold
+     bit-identical digests (the gate-6j contract).
+  2. **Digest discipline** — `SignalSnapshot.digest()` covers every
+     rule input and excludes the advisory wall-contaminated fields
+     (burn states, deadline misses); outcome attributions and trace ids
+     ride the ledger but stay OUT of its digest.
+  3. **Zero UNPLANNED recompiles** — growing the closed bucket set
+     pre-warms the new tiles FIRST, bracketed by compile-telemetry
+     reads, so the hot path never compiles and the planned set is
+     ledger-accounted.
+  4. **Kill switch** — `HV_AUTOPILOT=0` (read per call, HVA002) makes
+     `step` a no-op without rolling applied knobs back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import pytest
+
+from hypervisor_tpu.autopilot import (
+    Autopilot,
+    AutopilotConfig,
+    DecisionLedger,
+    RuleEngine,
+    SignalSnapshot,
+    autopilot_enabled,
+    drain_signals,
+)
+from hypervisor_tpu.autopilot.rules import (
+    RULE_BUCKET_GROW,
+    RULE_BUCKET_SHRINK,
+    RULE_CHECKPOINT_WAL,
+    RULE_DRR_QUANTUM,
+    RULE_INTEGRITY_CADENCE,
+)
+from hypervisor_tpu.config import DEFAULT_CONFIG
+from hypervisor_tpu.observability import health as health_plane
+from hypervisor_tpu.serving import FrontDoor, ServingConfig, WaveScheduler
+from hypervisor_tpu.state import HypervisorState
+
+
+def small_state(**caps) -> HypervisorState:
+    defaults = dict(
+        max_agents=512,
+        max_sessions=2048,
+        max_vouch_edges=1024,
+        max_sagas=256,
+        delta_log_capacity=4096,
+        event_log_capacity=1024,
+        trace_log_capacity=1024,
+    )
+    defaults.update(caps)
+    cfg = dataclasses.replace(
+        DEFAULT_CONFIG,
+        capacity=dataclasses.replace(DEFAULT_CONFIG.capacity, **defaults),
+    )
+    return HypervisorState(cfg)
+
+
+def snap(seq: int, now: float, **kw) -> SignalSnapshot:
+    """A synthetic drained snapshot (canonical tuples pre-built)."""
+    return SignalSnapshot(seq=seq, now=now, **kw)
+
+
+# ── 1. the snapshot digest (what the replay contract hashes) ─────────
+
+
+class TestSignalDigest:
+    def test_identical_snapshots_digest_identically(self):
+        a = snap(0, 1.0, shed=(("queue_full", 3),), buckets=(4, 8))
+        b = snap(0, 1.0, shed=(("queue_full", 3),), buckets=(4, 8))
+        assert a.digest() == b.digest()
+
+    def test_rule_inputs_are_digest_covered(self):
+        base = snap(0, 1.0, shed=(("queue_full", 3),), buckets=(4, 8))
+        for variant in (
+            snap(0, 1.0, shed=(("queue_full", 4),), buckets=(4, 8)),
+            snap(0, 1.0, shed=(("queue_full", 3),), buckets=(4, 8, 16)),
+            snap(1, 1.0, shed=(("queue_full", 3),), buckets=(4, 8)),
+            snap(
+                0, 1.0, shed=(("queue_full", 3),), buckets=(4, 8),
+                wal_backlog=100,
+            ),
+            snap(
+                0, 1.0, shed=(("queue_full", 3),), buckets=(4, 8),
+                integrity_violations=2,
+            ),
+            snap(
+                0, 1.0, shed=(("queue_full", 3),), buckets=(4, 8),
+                tenant_burn=((0, "critical"),),
+            ),
+        ):
+            assert variant.digest() != base.digest()
+
+    def test_advisory_fields_are_digest_excluded(self):
+        # Burn states and deadline misses are contaminated by measured
+        # wave wall clock (ticket latency = virtual queue wait +
+        # measured dispatch wall) and consumed by NO rule — they ride
+        # the snapshot for operators but must not perturb the replay
+        # digest.
+        a = snap(0, 1.0, buckets=(4,))
+        b = snap(
+            0, 1.0, buckets=(4,),
+            burn_states=(("lifecycle", "critical"),),
+            deadline_misses=7,
+        )
+        assert a.digest() == b.digest()
+        assert SignalSnapshot._ADVISORY_FIELDS == (
+            "burn_states", "deadline_misses",
+        )
+
+    def test_floor_distance_is_quantized_before_digesting(self):
+        # Measured-wall jitter below the rounding quantum must not
+        # perturb the digest; a real headroom change must.
+        a = snap(0, 1.0, floor_distance=5.91)
+        b = snap(0, 1.0, floor_distance=5.94)
+        c = snap(0, 1.0, floor_distance=6.3)
+        assert a.digest() == b.digest()
+        assert a.digest() != c.digest()
+
+
+# ── 2. the rule engine (pure fold; determinism property) ─────────────
+
+
+def _synthetic_stream(n: int = 60) -> list[SignalSnapshot]:
+    """A deterministic synthetic sequence exercising every rule family
+    (sheds rise then quiet, violations spike then clean, one tenant
+    burns then recovers, the WAL backlog climbs past budget)."""
+    out = []
+    shed = 0
+    viol = 0
+    buckets = (4, 8)
+    for i in range(n):
+        if 5 <= i < 8:
+            shed += 4                       # burst: grow fires
+        if i == 8:
+            buckets = (4, 8, 16)
+        if i == 20:
+            viol += 3                       # integrity spike: tighten
+        burn = "critical" if 10 <= i < 14 else "ok"
+        out.append(
+            snap(
+                i,
+                round(0.1 * i, 6),
+                queue_depths=(("lifecycle", 2 if i < 30 else 0),),
+                shed=(("queue_full", shed),),
+                buckets=buckets,
+                tenant_burn=((0, burn), (1, "ok")),
+                tenant_quanta=((0, 2.0), (1, 2.0)),
+                base_quantum=2,
+                integrity_violations=viol,
+                sanitize_every=8,
+                wal_backlog=200 * i,
+            )
+        )
+    return out
+
+
+class TestRuleEngineDeterminism:
+    def test_same_stream_same_proposals_and_ledger_digest(self):
+        cfg = AutopilotConfig(
+            decide_every_s=0.1, shrink_after_windows=10,
+            relax_after_windows=4,
+        )
+        stream = _synthetic_stream()
+        runs = []
+        for _ in range(2):
+            engine = RuleEngine(cfg)
+            ledger = DecisionLedger()
+            proposals = []
+            for s in stream:
+                for p in engine.step(s):
+                    proposals.append(p)
+                    ledger.record(
+                        now=s.now, rule=p.rule, knob=p.knob,
+                        before=p.before, after=p.after,
+                        predicted=p.predicted,
+                        signal_digest=s.digest(), detail=p.detail,
+                    )
+            runs.append((proposals, ledger.digest()))
+        assert runs[0][0], "synthetic stream must trigger rules"
+        assert runs[0][0] == runs[1][0]
+        assert runs[0][1] == runs[1][1]
+        rules_fired = {p.rule for p in runs[0][0]}
+        assert rules_fired >= {
+            RULE_BUCKET_GROW, RULE_DRR_QUANTUM,
+            RULE_INTEGRITY_CADENCE, RULE_CHECKPOINT_WAL,
+        }
+
+    def test_first_snapshot_emits_nothing(self):
+        engine = RuleEngine(AutopilotConfig())
+        assert engine.step(
+            snap(0, 0.0, shed=(("queue_full", 99),), buckets=(4,))
+        ) == []
+
+
+class TestBucketRules:
+    def _engine(self, **kw) -> RuleEngine:
+        return RuleEngine(AutopilotConfig(**kw))
+
+    def test_grow_fires_on_shed_delta_and_doubles_max(self):
+        e = self._engine(grow_shed_threshold=2, max_bucket_cap=64)
+        e.step(snap(0, 0.0, shed=(("queue_full", 0),), buckets=(4, 8)))
+        out = e.step(
+            snap(1, 0.1, shed=(("queue_full", 2),), buckets=(4, 8))
+        )
+        assert len(out) == 1 and out[0].rule == RULE_BUCKET_GROW
+        assert out[0].detail["new_bucket"] == 16
+        assert out[0].after == str((4, 8, 16))
+
+    def test_grow_respects_the_closed_set_cap(self):
+        e = self._engine(grow_shed_threshold=1, max_bucket_cap=8)
+        e.step(snap(0, 0.0, shed=(("queue_full", 0),), buckets=(4, 8)))
+        assert e.step(
+            snap(1, 0.1, shed=(("queue_full", 5),), buckets=(4, 8))
+        ) == []
+
+    def test_shrink_after_quiet_streak_drops_largest_grown(self):
+        e = self._engine(shrink_after_windows=3)
+        # First snapshot pins the base set (4, 8).
+        e.step(snap(0, 0.0, buckets=(4, 8)))
+        for i in range(1, 4):
+            out = e.step(
+                snap(
+                    i, 0.1 * i, buckets=(4, 8, 16),
+                    queue_depths=(("lifecycle", 0),),
+                    shed=(("queue_full", 0),),
+                )
+            )
+        assert len(out) == 1 and out[0].rule == RULE_BUCKET_SHRINK
+        assert out[0].after == str((4, 8))
+        assert out[0].detail["dropped_bucket"] == 16
+
+    def test_base_set_never_shrinks(self):
+        e = self._engine(shrink_after_windows=1)
+        e.step(snap(0, 0.0, buckets=(4, 8)))
+        for i in range(1, 6):
+            assert e.step(snap(i, 0.1 * i, buckets=(4, 8))) == []
+
+
+class TestQuantumCadenceCheckpointRules:
+    def test_quantum_boosts_burning_tenant_once_then_resets(self):
+        e = RuleEngine(AutopilotConfig(burn_quantum_boost=2.0))
+        kw = dict(
+            buckets=(4,), base_quantum=2,
+            tenant_quanta=((0, 2.0), (1, 2.0)),
+        )
+        e.step(snap(0, 0.0, tenant_burn=((0, "ok"), (1, "ok")), **kw))
+        out = e.step(
+            snap(1, 0.1, tenant_burn=((0, "critical"), (1, "ok")), **kw)
+        )
+        assert [p.rule for p in out] == [RULE_DRR_QUANTUM]
+        assert out[0].knob == "quantum[0]" and out[0].after == "4.0"
+        # Still burning: no re-boost.
+        assert e.step(
+            snap(2, 0.2, tenant_burn=((0, "warning"), (1, "ok")), **kw)
+        ) == []
+        # Recovered: reset to base.
+        out = e.step(
+            snap(3, 0.3, tenant_burn=((0, "ok"), (1, "ok")), **kw)
+        )
+        assert [p.knob for p in out] == ["quantum[0]"]
+        assert out[0].after == "2.0"
+
+    def test_cadence_tightens_on_violations_and_relaxes_when_clean(self):
+        e = RuleEngine(
+            AutopilotConfig(relax_after_windows=2, sanitize_every_max=32)
+        )
+        kw = dict(buckets=(4,), sanitize_every=8)
+        e.step(snap(0, 0.0, integrity_violations=0, **kw))
+        out = e.step(snap(1, 0.1, integrity_violations=2, **kw))
+        assert [p.rule for p in out] == [RULE_INTEGRITY_CADENCE]
+        assert out[0].after == "4"  # halved
+        # Two clean windows with headroom: relax (doubles).
+        e.step(snap(2, 0.2, integrity_violations=2, sanitize_every=4,
+                    buckets=(4,)))
+        out = e.step(snap(3, 0.3, integrity_violations=2,
+                          sanitize_every=4, buckets=(4,)))
+        assert [p.after for p in out] == ["8"]
+
+    def test_cadence_never_relaxes_without_roofline_headroom(self):
+        e = RuleEngine(
+            AutopilotConfig(relax_after_windows=1, headroom_floor=8.0)
+        )
+        kw = dict(buckets=(4,), sanitize_every=8, integrity_violations=0)
+        e.step(snap(0, 0.0, floor_distance=20.0, **kw))
+        # Busy plane (floor distance above the headroom bar): no relax.
+        assert e.step(snap(1, 0.1, floor_distance=20.0, **kw)) == []
+        # Headroom back (or never published): relax fires.
+        out = e.step(snap(2, 0.2, floor_distance=3.0, **kw))
+        assert [p.rule for p in out] == [RULE_INTEGRITY_CADENCE]
+
+    def test_checkpoint_fires_on_wal_replay_estimate_over_budget(self):
+        e = RuleEngine(
+            AutopilotConfig(
+                wal_replay_budget_s=0.5, wal_cost_per_record_s=1e-3
+            )
+        )
+        e.step(snap(0, 0.0, buckets=(4,), wal_backlog=100))
+        assert e.step(snap(1, 0.1, buckets=(4,), wal_backlog=400)) == []
+        out = e.step(snap(2, 0.2, buckets=(4,), wal_backlog=900))
+        assert [p.rule for p in out] == [RULE_CHECKPOINT_WAL]
+        assert out[0].detail["replay_estimate_s"] == 0.9
+
+
+# ── 3. the decision ledger (append-only; digest discipline) ──────────
+
+
+class TestDecisionLedger:
+    def _record(self, ledger: DecisionLedger):
+        return ledger.record(
+            now=1.0, rule=RULE_BUCKET_GROW, knob="buckets",
+            before="(4, 8)", after="(4, 8, 16)",
+            predicted="queue_full shed rate falls",
+            signal_digest="ab" * 32,
+        )
+
+    def test_trace_id_is_deterministic(self):
+        a, b = DecisionLedger(), DecisionLedger()
+        assert self._record(a).trace_id == self._record(b).trace_id
+
+    def test_digest_excludes_outcome_and_trace_id(self):
+        a, b = DecisionLedger(), DecisionLedger()
+        da = self._record(a)
+        self._record(b)
+        a.attribute(da, ok=True, observed={"queue_full_shed_delta": 0})
+        assert a.digest() == b.digest()
+        assert a.outcomes == {"confirmed": 1, "refuted": 0}
+
+    def test_attribution_is_set_once(self):
+        ledger = DecisionLedger()
+        d = self._record(ledger)
+        ledger.attribute(d, ok=True, observed={})
+        ledger.attribute(d, ok=False, observed={})  # ignored
+        assert d.outcome["ok"] is True
+        assert ledger.outcomes == {"confirmed": 1, "refuted": 0}
+        assert ledger.pending() == []
+
+    def test_summary_shape(self):
+        ledger = DecisionLedger()
+        self._record(ledger)
+        s = ledger.summary()
+        assert s["decisions"] == 1 and len(s["last"]) == 1
+        assert s["outcomes"] == {"confirmed": 0, "refuted": 0, "pending": 1}
+        assert len(s["digest"]) == 64
+
+
+# ── 4. the plane (real serving stack; side effects + contracts) ──────
+
+
+class TestAutopilotPlane:
+    def _stack(self, **cfg_kw):
+        state = small_state()
+        front = FrontDoor(
+            state,
+            ServingConfig(buckets=(4,), lifecycle_queue_depth=8),
+        )
+        sched = WaveScheduler(front)
+        sched.warm(now=0.0)
+        defaults = dict(
+            decide_every_s=0.1, grow_shed_threshold=1, max_bucket_cap=8,
+        )
+        defaults.update(cfg_kw)
+        pilot = Autopilot(
+            state, sched, config=AutopilotConfig(**defaults)
+        )
+        return state, front, sched, pilot
+
+    def test_grow_prewarms_first_and_hot_path_never_compiles(self):
+        state, front, sched, pilot = self._stack()
+        base = health_plane.compile_summary(last=0)
+        pilot.step(1.0)  # baseline snapshot, no proposals
+        # Overflow the shallow lifecycle queue: queue_full sheds.
+        for i in range(front.config.lifecycle_queue_depth + 3):
+            front.submit_lifecycle(f"ap:{i}", f"did:ap:{i}", 0.8, now=1.05)
+        assert front.shed["queue_full"] >= 1
+        decisions = pilot.step(1.2)
+        assert [d.rule for d in decisions] == [RULE_BUCKET_GROW]
+        assert tuple(front.config.buckets) == (4, 8)
+        assert front.config.lifecycle_queue_depth == 16  # doubled
+        assert front.config.join_queue_depth == 8  # max_bucket property
+        # Every compile so far is the bracketed pre-warm set (planned).
+        after = health_plane.compile_summary(last=0)
+        assert pilot.prewarm["events"] == 1
+        assert (
+            after["compiles"] - base["compiles"] == pilot.prewarm["compiles"]
+        )
+        assert (
+            after["recompiles"] - base["recompiles"]
+            == pilot.prewarm["recompiles"]
+        )
+        # The hot path at the GROWN shape: zero unplanned compiles.
+        mark = health_plane.compile_summary(last=0)
+        sched.tick(now=1.2 + front.config.lifecycle_deadline_s + 0.01)
+        sched.drain(now=2.0)
+        post = health_plane.compile_summary(last=0)
+        assert post["compiles"] == mark["compiles"]
+        assert post["recompiles"] == mark["recompiles"]
+        # The ledger carries the decision with its planned accounting.
+        d = pilot.ledger.decisions[0]
+        assert d.detail["prewarm_compiles"] == pilot.prewarm["compiles"]
+        assert d.trace_id and d.signal_digest
+
+    def test_decisions_drain_into_metrics_and_health_events(self):
+        state, front, sched, pilot = self._stack()
+        pilot.step(1.0)
+        for i in range(front.config.lifecycle_queue_depth + 3):
+            front.submit_lifecycle(f"m:{i}", f"did:m:{i}", 0.8, now=1.05)
+        assert pilot.step(1.2)
+        text = state.metrics_prometheus()
+        assert "hv_autopilot_decisions_total 1" in text
+        assert "hv_autopilot_max_bucket 8" in text
+        # One window later the outcome attribution lands (queue grew,
+        # sheds stopped -> confirmed).
+        sched.tick(now=1.2 + front.config.lifecycle_deadline_s + 0.01)
+        pilot.step(1.4)
+        assert pilot.ledger.outcomes["confirmed"] == 1
+        text = state.metrics_prometheus()
+        assert "hv_autopilot_outcomes_confirmed_total 1" in text
+
+    def test_kill_switch_stops_control_without_rollback(self):
+        state, front, sched, pilot = self._stack()
+        pilot.step(1.0)
+        os.environ["HV_AUTOPILOT"] = "0"
+        try:
+            assert not autopilot_enabled()
+            for i in range(front.config.lifecycle_queue_depth + 3):
+                front.submit_lifecycle(
+                    f"k:{i}", f"did:k:{i}", 0.8, now=1.05
+                )
+            assert pilot.step(1.2) == []          # no-op under the switch
+            assert tuple(front.config.buckets) == (4,)  # untouched
+            assert pilot.summary()["enabled"] is False
+        finally:
+            del os.environ["HV_AUTOPILOT"]
+        assert pilot.step(1.2)  # re-armed: same window now decides
+
+    def test_summary_and_state_fallback(self):
+        state, front, sched, pilot = self._stack()
+        s = state.autopilot_summary()
+        assert s["enabled"] is True
+        assert s["knobs"]["static"]["buckets"] == [4]
+        assert s["decisions"] == 0
+        bare = small_state()
+        assert bare.autopilot_summary() == {"enabled": False}
+
+    def test_proposals_needing_absent_planes_are_dropped(self):
+        # A quantum proposal without a tenant scheduler (and a
+        # checkpoint without a supervisor) must drop, not crash.
+        from hypervisor_tpu.autopilot.rules import Proposal
+
+        state, front, sched, pilot = self._stack()
+        s = drain_signals(seq=0, now=1.0, front=front)
+        assert pilot._apply(
+            Proposal(
+                rule=RULE_DRR_QUANTUM, knob="quantum[0]",
+                before="2.0", after="4.0", predicted="recovers",
+                detail={"tenant": 0},
+            ),
+            s, 1.0,
+        ) is None
+
+
+# ── 5. the satellite knobs the plane turns ───────────────────────────
+
+
+class TestFrontDoorReconfigure:
+    def test_reconfigure_swaps_buckets_and_depths(self):
+        state = small_state()
+        front = FrontDoor(state, ServingConfig(buckets=(4,)))
+        cfg = dataclasses.replace(
+            front.config, buckets=(4, 8), action_queue_depth=512
+        )
+        front.reconfigure(cfg)
+        assert front.config.max_bucket == 8
+        assert front._depths["action"] == 512
+        assert front._depths["join"] == 8  # join depth = max bucket
+        with pytest.raises(ValueError):
+            front.reconfigure(
+                dataclasses.replace(front.config, buckets=())
+            )
+
+
+class TestIntegrityRetune:
+    def test_retune_reports_before_after(self):
+        from hypervisor_tpu.integrity import IntegrityPlane
+
+        state = small_state()
+        plane = IntegrityPlane(state, every=8, scrub_every=0)
+        out = plane.retune(every=4)
+        assert out["before"]["every"] == 8
+        assert out["after"]["every"] == 4 and plane.every == 4
+        plane.retune(scrub_every=16)
+        assert plane.scrub_every == 16
+
+
+class TestTenantQuantumKnob:
+    def test_set_quantum_overrides_and_base_restores(self):
+        from hypervisor_tpu.config import HypervisorConfig, TableCapacity
+        from hypervisor_tpu.tenancy import (
+            TenantArena, TenantFrontDoor, TenantWaveScheduler,
+        )
+
+        small = HypervisorConfig(
+            capacity=TableCapacity(
+                max_agents=64, max_sessions=64, max_vouch_edges=64,
+                max_sagas=16, max_steps_per_saga=4, max_elevations=16,
+                delta_log_capacity=256, event_log_capacity=64,
+                trace_log_capacity=64,
+            )
+        )
+        arena = TenantArena(2, small)
+        front = TenantFrontDoor(arena, ServingConfig(buckets=(4,)))
+        sched = TenantWaveScheduler(front)
+        base = sched.quantum
+        assert sched.quantum_of(0) == base
+        sched.set_quantum(0, base * 2.0)
+        assert sched.quantum_of(0) == base * 2.0
+        assert sched.quantum_of(1) == base  # neighbor untouched
+        sched.set_quantum(0, base)  # back to base drops the override
+        assert sched.quanta == {}
+
+
+# ── 6. /debug/autopilot (both transports share the route table) ──────
+
+
+class TestDebugEndpoint:
+    def test_debug_autopilot_serves_summary_and_degrades(self):
+        import asyncio
+
+        from hypervisor_tpu.api import HypervisorService
+
+        svc = HypervisorService()
+        # Bare hypervisor: the plane is not attached.
+        assert asyncio.run(svc.debug_autopilot()) == {"enabled": False}
+        state = svc.hv.state
+        front = FrontDoor(state, ServingConfig(buckets=(4,)))
+        sched = WaveScheduler(front)
+        Autopilot(state, sched)
+        out = asyncio.run(svc.debug_autopilot())
+        assert out["enabled"] is True and out["decisions"] == 0
+        import json
+
+        json.dumps(out)  # JSON-serializable end to end

@@ -767,6 +767,37 @@ def _suite_report(
             if round_no >= 16
             else None
         ),
+        # Rounds >= regression.AUTOPILOT_ROW_SINCE must carry the
+        # autopilot row (round-17 presence gate, ISSUE 17); the
+        # goodput improvement and decision count are floor-gated, the
+        # replay digest identity must hold, and the UNPLANNED-recompile
+        # + invariant-violation counts are hard-gated to zero.
+        "autopilot_soak": (
+            {
+                "seed": 17,
+                "quick": quick,
+                "events": 1600,
+                "p99_ms": 730.0,
+                "slo_p99_ms": 1500.0,
+                "goodput_ratio": 0.92,
+                "goodput_improvement": 0.71,
+                "decisions": 6,
+                "decision_outcomes": {
+                    "confirmed": 5, "refuted": 1, "pending": 0,
+                },
+                "decisions_digest": "ab" * 32,
+                "digest_match": True,
+                "replays": 2,
+                "buckets_final": [4, 8, 16, 32, 64],
+                "recompiles_after_warmup": 0,
+                "recompiles_after_warmup_raw": 15,
+                "prewarm": {"events": 3, "compiles": 15, "recompiles": 15},
+                "invariant_violations": 0,
+                "static": {"goodput_ratio": 0.54, "p99_ms": 900.0},
+            }
+            if round_no >= 17
+            else None
+        ),
     }
 
 
@@ -1106,6 +1137,63 @@ class TestRegressionHarness:
             ) == 0
         finally:
             del os.environ["HV_BENCH_ROOFLINE_BYTES_TOL"]
+
+    def test_missing_autopilot_row_fails_from_round_17(self, tmp_path):
+        # ISSUE 17: the autopilot row is REQUIRED from round 17 —
+        # dropping the decision plane's bench coverage is a regression.
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 16, _suite_report(16, {"full_governance_pipeline": 10.0})
+        )
+        doc = _suite_report(17, {"full_governance_pipeline": 10.0})
+        doc["autopilot_soak"] = None
+        self._write(tmp_path, 17, doc)
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # A round carrying the row passes, and the trajectory keeps it.
+        self._write(
+            tmp_path, 17,
+            _suite_report(17, {"full_governance_pipeline": 10.0}),
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
+        rows = regression.load_history(tmp_path)
+        pilot = rows[-1]["autopilot_soak"]
+        assert pilot["decisions"] == 6
+        assert pilot["digest_match"] is True
+        assert pilot["goodput_improvement"] == 0.71
+
+    def test_autopilot_gates_floor_and_hard_zeros(self, tmp_path):
+        # The ISSUE 17 acceptance bars: >=20% goodput improvement vs
+        # static (HV_BENCH_AUTOPILOT_GAIN overrides), p99 within the
+        # row's own SLO, >=1 decision, replay digest bit-identity, and
+        # hard-zero UNPLANNED recompiles / invariant violations.
+        import os
+
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 16, _suite_report(16, {"full_governance_pipeline": 10.0})
+        )
+
+        def check(**overrides) -> int:
+            doc = _suite_report(17, {"full_governance_pipeline": 10.0})
+            doc["autopilot_soak"].update(overrides)
+            self._write(tmp_path, 17, doc)
+            return regression.main(["--root", str(tmp_path), "--quiet"])
+
+        assert check() == 0
+        assert check(goodput_improvement=0.05) == 1  # below the floor
+        assert check(p99_ms=2000.0) == 1             # over the stated SLO
+        assert check(decisions=0) == 1               # controller never fired
+        assert check(digest_match=False) == 1        # replay contract broken
+        assert check(recompiles_after_warmup=2) == 1  # unplanned recompile
+        assert check(invariant_violations=1) == 1
+        # The env knob relaxes the gain floor (read per gate run).
+        os.environ["HV_BENCH_AUTOPILOT_GAIN"] = "0.01"
+        try:
+            assert check(goodput_improvement=0.05) == 0
+        finally:
+            del os.environ["HV_BENCH_AUTOPILOT_GAIN"]
 
     def test_next_round_path_advances(self, tmp_path):
         from benchmarks import regression

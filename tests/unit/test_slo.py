@@ -168,7 +168,7 @@ class TestSLOEngine:
         # Wire-format discipline: the new codes extend the enum, they
         # never renumber existing device-log rows (hvlint HVA004 pins
         # the committed baseline; this pins the tail order).
-        tail = list(EventType)[-4:]
+        tail = list(EventType)[-6:]
         assert tail == [
             EventType.SLO_BURN_RATE_WARNING,
             EventType.SLO_BURN_RATE_CRITICAL,
@@ -176,6 +176,10 @@ class TestSLOEngine:
             # Round 15 appended the roofline observatory's shift
             # canary BEHIND the slo triple — append-only holds.
             EventType.ROOFLINE_BYTES_SHIFT,
+            # Round 17 appended the autopilot decision plane's pair
+            # BEHIND the roofline canary — append-only holds.
+            EventType.AUTOPILOT_DECISION,
+            EventType.AUTOPILOT_OUTCOME,
         ]
 
 

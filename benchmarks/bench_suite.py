@@ -1651,6 +1651,230 @@ def _git_commit() -> str | None:
         return None
 
 
+def fleet_observatory_benchmark(
+    seed: int, quick: bool, n_workers: int
+) -> dict:
+    """The round-18 fleet row: N REAL worker subprocesses (each the
+    existing API server + a 2-tenant arena), driven over HTTP, then
+    the three fleet contracts measured live:
+
+      1. **Merged drain** — ONE exposition scraping every worker;
+         series conservation (merged count == Σ per-worker counts) and
+         `worker="<id>"` on every sample row (coverage == 1.0), wall
+         clocked per drain.
+      2. **Zero post-warmup recompiles per worker** — the warm
+         contract holds ACROSS process boundaries: identical
+         join-wave shapes after warmup compile nothing, measured from
+         each worker's own `/debug/compiles`.
+      3. **The kill drill** — SIGKILL one worker mid-drill; the lease
+         registry (beats from real `/health` polls, windows on a
+         virtual clock so the journal replays deterministically) must
+         flip it suspected -> dead within the budget (<= 2 heartbeat
+         windows), and the recorded observation journal must replay
+         to a bit-identical transition digest twice.
+    """
+    import urllib.request
+
+    from hypervisor_tpu.fleet import (
+        DEAD,
+        SUSPECTED,
+        FleetObservatory,
+        FleetRegistry,
+        FleetSupervisor,
+        LeaseConfig,
+        WorkerSpec,
+        worker_label_coverage,
+    )
+
+    def _get(url: str) -> dict:
+        with urllib.request.urlopen(url, timeout=15) as r:
+            return json.loads(r.read())
+
+    def _post(url: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    lanes = 4          # constant batch shape: the warm contract's key
+    warm_waves = 2
+    drive_waves = 2 if quick else 4
+    drains = 1 if quick else 3
+    sup = FleetSupervisor([
+        WorkerSpec(worker_id=f"w{i}", tenants=(0, 1))
+        for i in range(n_workers)
+    ])
+    sup.start()
+    try:
+        urls = sup.urls()
+        sessions = {}
+        for w, base in urls.items():
+            doc = _post(base + "/api/v1/sessions", {
+                "creator_did": f"did:fleet:{seed}:{w}",
+            })
+            sessions[w] = doc["session_id"]
+
+        def drive(w: str, base: str, tag: str, waves: int) -> None:
+            for r in range(waves):
+                _post(
+                    base + f"/api/v1/sessions/{sessions[w]}/join-wave",
+                    {"joins": [
+                        {"agent_did": f"did:fleet:{w}:{tag}:{r}:{i}",
+                         "sigma_raw": 0.8}
+                        for i in range(lanes)
+                    ]},
+                )
+
+        for w, base in urls.items():
+            drive(w, base, "warm", warm_waves)
+        base_comp = {
+            w: _get(base + "/debug/compiles")
+            for w, base in urls.items()
+        }
+        for w, base in urls.items():
+            drive(w, base, "drive", drive_waves)
+        per_worker = {}
+        for w, base in urls.items():
+            after = _get(base + "/debug/compiles")
+            per_worker[w] = {
+                "compiles_after_warmup": (
+                    int(after.get("compiles", 0))
+                    - int(base_comp[w].get("compiles", 0))
+                ),
+                "recompiles_after_warmup": (
+                    int(after.get("recompiles", 0))
+                    - int(base_comp[w].get("recompiles", 0))
+                ),
+            }
+
+        cfg = LeaseConfig(heartbeat_interval_s=0.25)
+        reg = FleetRegistry(cfg, seed=seed)
+        for w in urls:
+            reg.register(w, 0.0)
+        obs = FleetObservatory(urls, registry=reg)
+        walls, merged, snap = [], "", None
+        for _ in range(drains):
+            t0 = time.perf_counter()
+            merged, snap = obs.drain(now=0.0)
+            walls.append((time.perf_counter() - t0) * 1e3)
+        series_sum = sum(v for _, v in snap.series)
+        for w, n in snap.series:
+            per_worker[w]["series"] = n
+
+        # The kill drill: beats come from REAL /health polls; windows
+        # advance on a virtual clock so the observation journal is a
+        # pure function of what the fleet did — replayable bit-for-bit.
+        victim = sorted(urls)[0]
+        kill_window = 3
+        detect = {"suspected": None, "dead": None}
+        window = 0
+        while window < kill_window + 8 and detect["dead"] is None:
+            window += 1
+            vnow = window * cfg.heartbeat_interval_s
+            for w, base in urls.items():
+                try:
+                    with urllib.request.urlopen(
+                        base + "/health", timeout=5
+                    ) as r:
+                        ok = r.status == 200
+                except OSError:
+                    ok = False
+                if ok:
+                    reg.heartbeat(w, vnow)
+            states = reg.evaluate(vnow)
+            if states.get(victim) == SUSPECTED and \
+                    detect["suspected"] is None:
+                detect["suspected"] = window - kill_window
+            if states.get(victim) == DEAD and detect["dead"] is None:
+                detect["dead"] = window - kill_window
+            if window == kill_window:
+                sup.kill(victim)  # silence AFTER this window's beat
+
+        digest = reg.transition_digest()
+        replay_digests = [
+            FleetRegistry.replay(
+                reg.observations, cfg, seed=seed
+            ).transition_digest()
+            for _ in range(2)
+        ]
+        walls.sort()
+        return {
+            "seed": seed,
+            "workers": n_workers,
+            "tenants_per_worker": 2,
+            "heartbeat_interval_s": cfg.heartbeat_interval_s,
+            "budget_windows": 2.0,
+            "detection_windows": {
+                "suspected": detect["suspected"],
+                "dead": detect["dead"],
+                "p50": detect["dead"],
+                "max": detect["dead"],
+            },
+            "killed": victim,
+            "transitions": len(reg.transitions),
+            "digest": digest,
+            "digest_match": all(d == digest for d in replay_digests),
+            "replays": len(replay_digests),
+            "merged_drain_wall_ms": round(
+                walls[len(walls) // 2], 3
+            ),
+            "merged_series": snap.merged_series,
+            "series_per_worker_sum": series_sum,
+            "series_conserved": snap.merged_series == series_sum,
+            "worker_label_coverage": worker_label_coverage(merged),
+            "scrape_errors": len(snap.errors),
+            "per_worker": per_worker,
+            "compiles_after_warmup": max(
+                r["compiles_after_warmup"] for r in per_worker.values()
+            ),
+            "recompiles_after_warmup": max(
+                r["recompiles_after_warmup"] for r in per_worker.values()
+            ),
+        }
+    finally:
+        sup.stop()
+
+
+def fleet_observatory_row_isolated(
+    seed: int, quick: bool, n_workers: int, timeout_s: float = 600.0
+) -> dict | None:
+    """Run `fleet_observatory_benchmark` in a SUBPROCESS and return
+    its row. The workers are subprocesses either way; isolating the
+    supervisor too keeps the suite process's jit cache and metric
+    mirrors out of the merged-drain walls (the tenant row's
+    precedent). Returns None if the child fails outright."""
+    code = (
+        "import json\n"
+        "from benchmarks.bench_suite import fleet_observatory_benchmark\n"
+        f"row = fleet_observatory_benchmark("
+        f"{seed!r}, {quick!r}, {n_workers!r})\n"
+        "print('HV_FLEET_ROW=' + json.dumps(row))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=str(Path(__file__).resolve().parent.parent),
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith("HV_FLEET_ROW="):
+            try:
+                return json.loads(line[len("HV_FLEET_ROW="):])
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=30)
@@ -1748,6 +1972,21 @@ def main() -> None:
             "T separate single-tenant dispatches (the amortization "
             "ratio regression.py floors), amortized µs/op, and the "
             "zero-recompile contract over the warmed (bucket, T) tiles"
+        ),
+    )
+    ap.add_argument(
+        "--fleet",
+        type=int,
+        default=None,
+        metavar="N",
+        help=(
+            "also run the fleet observatory drill (ISSUE 18): N real "
+            "worker subprocesses (existing API server + 2-tenant arena "
+            "each) driven over HTTP — merged-drain series conservation "
+            "+ worker-label coverage, per-worker zero post-warmup "
+            "recompiles, and the SIGKILL liveness drill (detection "
+            "latency in heartbeat windows vs the <= 2-window budget, "
+            "lease-journal replay digest bit-identity)"
         ),
     )
     ap.add_argument(
@@ -1955,6 +2194,35 @@ def main() -> None:
             )
 
 
+    # The fleet drill runs after every timed row: its workers are
+    # fresh subprocesses (own jit caches), and the supervisor-side
+    # drill is subprocess-isolated too, so ordering only matters for
+    # machine load — the virtual-window lease clock is load-immune.
+    fleet_rec = None
+    if args.fleet is not None:
+        fleet_rec = fleet_observatory_row_isolated(18, args.quick, args.fleet)
+        if fleet_rec is None:
+            fleet_rec = fleet_observatory_benchmark(
+                18, args.quick, args.fleet
+            )
+        if not args.json_only:
+            det = fleet_rec["detection_windows"]
+            print(
+                f"fleet[N={fleet_rec['workers']}]: killed "
+                f"{fleet_rec['killed']}, detected suspected/dead in "
+                f"{det['suspected']}/{det['dead']} windows (budget "
+                f"{fleet_rec['budget_windows']}), digest match "
+                f"{fleet_rec['digest_match']} over "
+                f"{fleet_rec['replays']} replays, merged drain "
+                f"{fleet_rec['merged_drain_wall_ms']} ms for "
+                f"{fleet_rec['merged_series']} series "
+                f"(conserved={fleet_rec['series_conserved']}, "
+                f"coverage={fleet_rec['worker_label_coverage']}), "
+                f"{fleet_rec['recompiles_after_warmup']} recompiles "
+                "after warmup (worst worker)",
+                flush=True,
+            )
+
     static_rec = None
     if args.metrics_out:
         static_rec = static_analysis_row()
@@ -2057,6 +2325,15 @@ def main() -> None:
             # it from round 17 and floors the improvement
             # (HV_BENCH_AUTOPILOT_GAIN).
             "autopilot_soak": autopilot_rec,
+            # Fleet row (round 18, --fleet <N>): merged-drain series
+            # conservation + worker-label coverage, per-worker zero
+            # post-warmup recompiles across process boundaries, and
+            # the SIGKILL kill drill (detection <= 2 heartbeat
+            # windows, lease-journal digest bit-identical over 2
+            # replays) — regression.py presence-gates it from round
+            # 18 (HV_BENCH_FLEET_MIN workers, HV_BENCH_FLEET_DETECT
+            # windows).
+            "fleet": fleet_rec,
         }
         out_path.write_text(json.dumps(report, indent=2) + "\n")
         if not args.json_only:
@@ -2085,6 +2362,7 @@ def main() -> None:
         "soak": soak_rec,
         "tenant_dense": tenant_rec,
         "autopilot_soak": autopilot_rec,
+        "fleet": fleet_rec,
     }
     if jax.default_backend() not in ("tpu",) and not args.write_results:
         print(

@@ -213,6 +213,27 @@ DEFAULT_AUTOPILOT_SLO_FACTOR = 1.0
 #: decision plane — the shifting mix is built to trigger it.
 DEFAULT_AUTOPILOT_MIN_DECISIONS = 1
 
+#: The fleet row joined the trajectory in round 18 (ISSUE 18,
+#: bench_suite --fleet): N real worker subprocesses behind one merged
+#: drain — series conservation (merged == Σ per-worker) with
+#: `worker="<id>"` on every row, per-worker zero post-warmup
+#: recompiles across process boundaries, the SIGKILL kill drill's
+#: detection latency vs the windowed budget, and the lease journal's
+#: replay digest bit-identity. A suite round from 18 on missing the
+#: row regresses the fleet-observability coverage.
+FLEET_ROW_SINCE = 18
+
+#: Minimum worker count the fleet row must run
+#: (`HV_BENCH_FLEET_MIN` overrides): one worker proves nothing about
+#: a merged cross-process drain.
+DEFAULT_FLEET_MIN_WORKERS = 2
+
+#: Detection budget in heartbeat windows (`HV_BENCH_FLEET_DETECT`
+#: overrides): the kill drill's DEAD verdict must land within this
+#: many windows of the victim's last beat — push0's detect half of
+#: detect-and-reassign, pinned ahead of the shard-out.
+DEFAULT_FLEET_DETECT_WINDOWS = 2.0
+
 
 def census_fusion_floor(round_num: int) -> float:
     """The fusion-ratio floor for a given round: env override, else the
@@ -470,6 +491,42 @@ def parse_round_file(path: Path) -> Optional[dict]:
                 if isinstance(
                     pilot := doc.get("autopilot_soak"), dict
                 )
+                else None
+            ),
+            # Fleet row (round 18, ISSUE 18): merged-drain series
+            # conservation + worker-label coverage, per-worker zero
+            # post-warmup recompiles, kill-drill detection latency vs
+            # the windowed budget, lease-journal replay digest
+            # bit-identity — gated below.
+            fleet=(
+                {
+                    "seed": fleet.get("seed"),
+                    "workers": fleet.get("workers"),
+                    "budget_windows": fleet.get("budget_windows"),
+                    "detection_windows": fleet.get("detection_windows"),
+                    "digest": fleet.get("digest"),
+                    "digest_match": fleet.get("digest_match"),
+                    "replays": fleet.get("replays"),
+                    "merged_drain_wall_ms": fleet.get(
+                        "merged_drain_wall_ms"
+                    ),
+                    "merged_series": fleet.get("merged_series"),
+                    "series_per_worker_sum": fleet.get(
+                        "series_per_worker_sum"
+                    ),
+                    "series_conserved": fleet.get("series_conserved"),
+                    "worker_label_coverage": fleet.get(
+                        "worker_label_coverage"
+                    ),
+                    "recompiles_after_warmup": fleet.get(
+                        "recompiles_after_warmup"
+                    ),
+                    "compiles_after_warmup": fleet.get(
+                        "compiles_after_warmup"
+                    ),
+                    "per_worker": fleet.get("per_worker"),
+                }
+                if isinstance(fleet := doc.get("fleet"), dict)
                 else None
             ),
             # Roofline row (round 15, ISSUE 14): per-program modeled
@@ -996,6 +1053,107 @@ def compare(
                 continue
             entry = {
                 "bench": f"autopilot_{hard_zero}",
+                "current_per_op_us": float(value),
+                "baseline_per_op_us": 0.0,
+                "ratio": float(value),
+            }
+            checked.append(entry)
+            if value != 0:
+                regressions.append(entry)
+    # Fleet gates (round 18, ISSUE 18): presence from FLEET_ROW_SINCE,
+    # a minimum worker count, the kill drill's detection budget, the
+    # lease journal's replay digest bit-identity, series conservation
+    # + full worker-label coverage on the merged drain, and the
+    # hard-zero per-worker post-warmup recompile contract.
+    fleet = current.get("fleet")
+    if (
+        current.get("format") == "suite"
+        and current["round"] >= FLEET_ROW_SINCE
+        and not fleet
+    ):
+        entry = {
+            "bench": "missing:fleet",
+            "current_per_op_us": 0.0,
+            "baseline_per_op_us": 0.0,
+            "ratio": 0.0,
+        }
+        checked.append(entry)
+        regressions.append(entry)
+    if fleet:
+        workers = fleet.get("workers")
+        if workers is not None:
+            env_w = os.environ.get("HV_BENCH_FLEET_MIN")
+            w_floor = (
+                float(env_w) if env_w else DEFAULT_FLEET_MIN_WORKERS
+            )
+            entry = {
+                "bench": "fleet_workers",
+                "current_per_op_us": float(workers),
+                "baseline_per_op_us": w_floor,
+                "ratio": (
+                    round(float(workers) / w_floor, 3) if w_floor else 0.0
+                ),
+            }
+            checked.append(entry)
+            if float(workers) < w_floor:
+                regressions.append(entry)
+        det = fleet.get("detection_windows") or {}
+        dead = det.get("max", det.get("dead"))
+        env_b = os.environ.get("HV_BENCH_FLEET_DETECT")
+        budget = (
+            float(env_b) if env_b else DEFAULT_FLEET_DETECT_WINDOWS
+        )
+        entry = {
+            "bench": "fleet_detection_windows",
+            # A drill that never detected the kill reports None —
+            # recorded as -1 and gated as a regression outright.
+            "current_per_op_us": (
+                float(dead) if dead is not None else -1.0
+            ),
+            "baseline_per_op_us": budget,
+            "ratio": (
+                round(float(dead) / budget, 3)
+                if dead is not None and budget
+                else 0.0
+            ),
+        }
+        checked.append(entry)
+        if dead is None or float(dead) > budget:
+            regressions.append(entry)
+        # Replay determinism: the lease journal must replay to the
+        # SAME transition digest — liveness truth is evidence for the
+        # shard-out's reassignment decisions, so it must be auditable.
+        match = fleet.get("digest_match")
+        if match is not None:
+            entry = {
+                "bench": "fleet_digest_match",
+                "current_per_op_us": 1.0 if match else 0.0,
+                "baseline_per_op_us": 1.0,
+                "ratio": 1.0 if match else 0.0,
+            }
+            checked.append(entry)
+            if not match:
+                regressions.append(entry)
+        # Merged-drain conservation: merged series == Σ per-worker
+        # series AND every sample row carries the worker label — a
+        # dropped worker or an unstamped row breaks attribution.
+        conserved = fleet.get("series_conserved")
+        coverage = fleet.get("worker_label_coverage")
+        if conserved is not None or coverage is not None:
+            ok = bool(conserved) and coverage == 1.0
+            entry = {
+                "bench": "fleet_merge_conservation",
+                "current_per_op_us": 1.0 if ok else 0.0,
+                "baseline_per_op_us": 1.0,
+                "ratio": 1.0 if ok else 0.0,
+            }
+            checked.append(entry)
+            if not ok:
+                regressions.append(entry)
+        value = fleet.get("recompiles_after_warmup")
+        if value is not None:
+            entry = {
+                "bench": "fleet_recompiles_after_warmup",
                 "current_per_op_us": float(value),
                 "baseline_per_op_us": 0.0,
                 "ratio": float(value),

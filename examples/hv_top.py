@@ -57,46 +57,106 @@ def parse_prometheus_counters(text: str) -> dict[str, float]:
     return out
 
 
-def poll_url(
-    base: str,
-) -> tuple[dict, dict[str, float], dict | None, dict | None, dict | None]:
-    """One (/debug/health, /metrics, /debug/roofline, /debug/tenants,
-    /debug/autopilot) poll against a live deployment. The observatory
-    polls degrade gracefully: an older server without an endpoint
-    (404) — or any fetch error — renders that panel as "n/a" instead
-    of crashing the watch loop."""
-    from urllib.error import HTTPError, URLError
-    from urllib.request import urlopen
+class UrlPoller:
+    """One reused HTTP connection for every poll of a frame.
 
-    base = base.rstrip("/")
-    with urlopen(f"{base}/debug/health", timeout=10) as resp:
-        health = json.loads(resp.read())
-    with urlopen(f"{base}/metrics", timeout=10) as resp:
-        counters = parse_prometheus_counters(resp.read().decode())
-    roofline = None
-    try:
-        with urlopen(f"{base}/debug/roofline", timeout=10) as resp:
-            roofline = json.loads(resp.read())
-    except (HTTPError, URLError, OSError, json.JSONDecodeError):
-        roofline = None  # pre-r15 server or transient fetch failure
-    tenants = None
-    try:
-        with urlopen(f"{base}/debug/tenants", timeout=10) as resp:
-            tenants = json.loads(resp.read())
-    except (HTTPError, URLError, OSError, json.JSONDecodeError):
-        tenants = None  # pre-r16 server or transient fetch failure
-    autopilot = None
-    try:
-        with urlopen(f"{base}/debug/autopilot", timeout=10) as resp:
-            autopilot = json.loads(resp.read())
-    except (HTTPError, URLError, OSError, json.JSONDecodeError):
-        autopilot = None  # pre-r17 server or transient fetch failure
-    return health, counters, roofline, tenants, autopilot
+    A refresh reads 7 endpoints; before round 18 each was its own
+    `urlopen` (TCP handshake per endpoint per frame). The poller holds
+    ONE `http.client.HTTPConnection` across requests AND frames —
+    true keep-alive against HTTP/1.1 servers (both transports since
+    r18), and a transparent auto-reconnect against HTTP/1.0 servers
+    (`will_close` responses drop the socket; the next request
+    redials)."""
+
+    def __init__(self, base: str, timeout: float = 10.0) -> None:
+        from urllib.parse import urlsplit
+
+        if "://" not in base:
+            base = "http://" + base
+        u = urlsplit(base.rstrip("/"))
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or (443 if u.scheme == "https" else 80)
+        self.timeout = timeout
+        self._conn = None
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
+
+    def get(self, path: str) -> tuple[int, bytes]:
+        """GET over the reused connection; one reconnect retry covers
+        a server that dropped the idle socket between frames."""
+        import http.client
+
+        for attempt in (0, 1):
+            try:
+                if self._conn is None:
+                    self._conn = http.client.HTTPConnection(
+                        self.host, self.port, timeout=self.timeout
+                    )
+                self._conn.request("GET", path)
+                resp = self._conn.getresponse()
+                body = resp.read()
+                if resp.will_close:
+                    self.close()
+                return resp.status, body
+            except OSError:
+                self.close()
+                if attempt:
+                    raise
+        raise OSError("unreachable")  # pragma: no cover
+
+    def get_json(self, path: str) -> dict | None:
+        """Observatory-panel fetch: 404 (older server), any transport
+        error, or garbage JSON all degrade to None — the panel renders
+        "n/a", the watch loop never crashes."""
+        try:
+            status, body = self.get(path)
+            if status != 200:
+                return None
+            return json.loads(body)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+
+def poll_url(
+    base,
+) -> tuple[
+    dict, dict[str, float], dict | None, dict | None, dict | None,
+    dict | None,
+]:
+    """One (/debug/health, /metrics, /debug/roofline, /debug/tenants,
+    /debug/autopilot, /debug/fleet) poll against a live deployment —
+    all on ONE reused connection (`UrlPoller`; a bare URL string still
+    works and builds a throwaway poller). The observatory polls degrade
+    gracefully: an older server without an endpoint (404) — or any
+    fetch error — renders that panel as "n/a" instead of crashing the
+    watch loop."""
+    poller = base if isinstance(base, UrlPoller) else UrlPoller(base)
+    status, body = poller.get("/debug/health")
+    if status != 200:
+        raise OSError(f"/debug/health -> HTTP {status}")
+    health = json.loads(body)
+    status, body = poller.get("/metrics")
+    if status != 200:
+        raise OSError(f"/metrics -> HTTP {status}")
+    counters = parse_prometheus_counters(body.decode())
+    roofline = poller.get_json("/debug/roofline")   # pre-r15: n/a
+    tenants = poller.get_json("/debug/tenants")     # pre-r16: n/a
+    autopilot = poller.get_json("/debug/autopilot")  # pre-r17: n/a
+    fleet = poller.get_json("/debug/fleet")         # pre-r18: n/a
+    return health, counters, roofline, tenants, autopilot, fleet
 
 
 def poll_state(
     state, tenant_front=None
-) -> tuple[dict, dict[str, float], dict | None, dict | None, dict | None]:
+) -> tuple[
+    dict, dict[str, float], dict | None, dict | None, dict | None,
+    dict | None,
+]:
     """The in-process twin of `poll_url` (same payload shapes).
     `tenant_front` (a `tenancy.TenantFrontDoor`) supplies the tenants
     panel; a solo state whose tables live in an arena reports that
@@ -123,7 +183,9 @@ def poll_state(
         autopilot = state.autopilot_summary()
     except Exception:  # noqa: BLE001 — panel shows n/a, never crashes
         autopilot = None
-    return health, counters, roofline, tenants, autopilot
+    # The fleet plane is supervisor-side only — an in-process state has
+    # no worker fan-out, so the panel reads n/a (same as pre-r18 URLs).
+    return health, counters, roofline, tenants, autopilot, None
 
 
 def load_trajectory(root: Path) -> list[dict]:
@@ -151,6 +213,7 @@ def render(
     roofline: dict | None = None,
     tenants: dict | None = None,
     autopilot: dict | None = None,
+    fleet: dict | None = None,
 ) -> str:
     lines = [
         f"hv_top @ {time.strftime('%H:%M:%S')}  "
@@ -384,6 +447,56 @@ def render(
                 f"{d.get('before')} -> {d.get('after')}"
             )
 
+    lines.append("")
+    if not fleet or not fleet.get("enabled"):
+        lines.append("fleet      n/a (endpoint absent or no fleet attached)")
+    else:
+        counts = fleet.get("counts") or {}
+        totals = fleet.get("totals") or {}
+        worst = fleet.get("worst_burn")
+        lines.append(
+            f"fleet      workers={len(fleet.get('workers') or {})}  "
+            f"alive={counts.get('alive', '-')}  "
+            f"suspected={counts.get('suspected', '-')}  "
+            f"dead={counts.get('dead', '-')}  "
+            f"series={fleet.get('merged_series', 0):,}  "
+            f"worst_burn="
+            + (
+                f"{worst['worker']}/{worst['queue']}:{worst['state']}"
+                if worst else "ok"
+            )
+            + f"  digest={str(fleet.get('snapshot_digest', ''))[:12] or '-'}"
+        )
+        f_rows = []
+        for name, row in sorted((fleet.get("workers") or {}).items()):
+            dist = row.get("floor_distance")
+            f_rows.append(
+                (
+                    name,
+                    row.get("state", "?"),
+                    f"{row.get('occupancy', 0):,}",
+                    f"{row.get('compiles', 0):,}/{row.get('recompiles', 0):,}",
+                    "-" if row.get("series") is None
+                    else f"{row['series']:,}",
+                    "-" if dist is None else f"{dist:,.1f}x",
+                )
+            )
+        f_rows.append(
+            (
+                "Σ",
+                "",
+                f"{totals.get('occupancy', 0):,}",
+                f"{totals.get('compiles', 0):,}/"
+                f"{totals.get('recompiles', 0):,}",
+                f"{totals.get('series', 0):,}",
+                "",
+            )
+        )
+        lines += fmt_table(
+            f_rows,
+            header=("worker", "state", "occ", "comp/rec", "series", "floor"),
+        )
+
     slo = health.get("slo", {})
     lines.append("")
     if not slo.get("enabled"):
@@ -506,15 +619,21 @@ def main(argv=None) -> int:
     trajectory = load_trajectory(root)
 
     if args.url:
+        poller = UrlPoller(args.url)  # ONE connection across frames
+
         def frame() -> str:
-            health, counters, roofline, tenants, autopilot = poll_url(
-                args.url
+            health, counters, roofline, tenants, autopilot, fleet = (
+                poll_url(poller)
             )
             return render(
-                health, counters, trajectory, roofline, tenants, autopilot
+                health, counters, trajectory, roofline, tenants,
+                autopilot, fleet,
             )
 
-        return watch_loop(frame, watch=args.watch, interval=args.interval)
+        try:
+            return watch_loop(frame, watch=args.watch, interval=args.interval)
+        finally:
+            poller.close()
 
     state = build_state(args.sessions * max(args.rounds, 1) + 64)
     # Live integrity panel for the in-process demo: sampled sanitizer +
@@ -549,9 +668,12 @@ def main(argv=None) -> int:
             progress["rnd"] += 1
 
     def frame() -> str:
-        health, counters, roofline, tenants, autopilot = poll_state(state)
+        health, counters, roofline, tenants, autopilot, fleet = (
+            poll_state(state)
+        )
         return render(
-            health, counters, trajectory, roofline, tenants, autopilot
+            health, counters, trajectory, roofline, tenants, autopilot,
+            fleet,
         )
 
     return watch_loop(

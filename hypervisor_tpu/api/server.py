@@ -77,6 +77,11 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/debug/tenants", "debug_tenants", None),
     ("GET", "/debug/autopilot", "debug_autopilot", None),
     ("POST", "/debug/profile", "debug_profile", M.ProfileRequest),
+    ("GET", "/debug/fleet", "debug_fleet", None),
+    ("GET", "/fleet/workers", "fleet_workers", None),
+    ("GET", "/fleet/metrics", "fleet_metrics", None),
+    ("GET", "/fleet/slo", "fleet_slo", None),
+    ("GET", "/fleet/trace/{trace_id}", "fleet_trace", None),
     ("GET", "/api/v1/stats", "stats", None),
     ("GET", "/api/v1/device/stats", "device_stats", None),
     ("POST", "/api/v1/sessions", "create_session", M.CreateSessionRequest),
@@ -124,6 +129,7 @@ _QUERY_PARAMS = {
     "list_sessions": ("state",),
     "query_events": ("event_type", "session_id", "agent_did", "limit"),
     "trace_session": ("format",),
+    "fleet_trace": ("format",),
     "serving_stream": ("frames", "interval"),
 }
 
@@ -312,6 +318,12 @@ class HypervisorHTTPServer:
         svc = self.service
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            # Keep-alive: every response carries Content-Length (or
+            # proper chunked framing, `_stream_ndjson`), so HTTP/1.1 is
+            # safe — and pollers like hv_top ride ONE connection per
+            # refresh instead of a socket per endpoint.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):  # quiet
                 pass
 

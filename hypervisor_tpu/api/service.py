@@ -767,6 +767,91 @@ class HypervisorService:
         `--url` panel degrades to n/a against such servers)."""
         return self.hv.state.autopilot_summary()
 
+    async def debug_fleet(self) -> dict:
+        """`GET /debug/fleet`: the fleet observatory in one poll —
+        per-worker lease state / occupancy / compile totals / series
+        counts / floor distance, fleet rollup totals, the worst burn
+        across workers, the merged-exposition series count, and the
+        `FleetSnapshot` rule-input digest (+ the lease registry's
+        replayable transition log when one is attached). A deployment
+        with no attached fleet (`service.fleet = FleetObservatory(...)`)
+        answers `{"enabled": false}` — hv_top's fleet panel degrades to
+        n/a against such servers, pre-r18 servers 404 instead."""
+        obs = getattr(self, "fleet", None)
+        if obs is None:
+            return {"enabled": False}
+        out = obs.summary()
+        out["enabled"] = True
+        return out
+
+    def _fleet_or_503(self):
+        obs = getattr(self, "fleet", None)
+        if obs is None:
+            raise ApiError(
+                503,
+                "no fleet attached (service.fleet = "
+                "fleet.FleetObservatory(workers, registry))",
+            )
+        return obs
+
+    async def fleet_workers(self) -> dict:
+        """`GET /fleet/workers`: worker id -> URL + lease state (the
+        registry's live view; `unknown` with no registry attached)."""
+        obs = self._fleet_or_503()
+        states = (
+            obs.registry.states() if obs.registry is not None else {}
+        )
+        return {
+            "workers": {
+                w: {"url": url, "state": states.get(w, "unknown")}
+                for w, url in sorted(obs.workers.items())
+            },
+            "counts": (
+                obs.registry.counts() if obs.registry is not None else None
+            ),
+        }
+
+    async def fleet_metrics(self) -> PrometheusText:
+        """`GET /fleet/metrics`: ONE merged Prometheus exposition for
+        the whole fleet — every worker's `/metrics` scraped and
+        re-stamped with `worker="<id>"` on EVERY series (tenant-labeled
+        rows keep their tenant label: two labels, the PR 16 merge
+        lifted one level)."""
+        obs = self._fleet_or_503()
+        merged, _snap = obs.drain()
+        return PrometheusText(merged)
+
+    async def fleet_slo(self) -> dict:
+        """`GET /fleet/slo`: every worker's burn plane + the fleet
+        worst-burn fold (worst tenant across workers rides inside each
+        worker's own /debug/slo payload)."""
+        return self._fleet_or_503().slo_rollup()
+
+    async def fleet_trace(
+        self, trace_id: str, format: Optional[str] = None
+    ) -> dict:
+        """`GET /fleet/trace/{trace_id}`: cross-process trace stitching
+        — every worker's `/trace/{id}` fragment merged into ONE
+        timeline with worker lanes (Chrome: pid per worker; OTLP:
+        resource per worker). Workers without a recorded fragment are
+        listed in `fleet.missing`, not errors."""
+        if format not in (None, "", "chrome", "otlp"):
+            raise ApiError(400, f"unknown trace format {format!r}")
+        from hypervisor_tpu.fleet.trace import stitch_fleet_trace
+
+        obs = self._fleet_or_503()
+        doc = stitch_fleet_trace(
+            obs.workers, trace_id, fmt=format or "chrome",
+            timeout_s=obs.timeout_s,
+        )
+        if not doc["fleet"]["workers"]:
+            raise ApiError(
+                404,
+                f"no worker recorded trace {trace_id!r} "
+                f"(missing: {doc['fleet']['missing']})",
+            )
+        return doc
+
     async def debug_profile(self, req: M.ProfileRequest) -> dict:
         """`POST /debug/profile`: an on-demand bounded `jax.profiler`
         capture window (TensorBoard/Perfetto trace into `log_dir`).

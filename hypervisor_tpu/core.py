@@ -1809,6 +1809,13 @@ class Hypervisor:
             # CausalTraceId, so the bus row joins the trace plane.
             "autopilot_decision": EventType.AUTOPILOT_DECISION,
             "autopilot_outcome": EventType.AUTOPILOT_OUTCOME,
+            # Fleet lease-plane liveness transitions ride the same
+            # fan-out (`fleet.registry.FleetRegistry`); payloads carry
+            # the replayable lease seq + caller-clock timestamp.
+            "fleet_worker_joined": EventType.FLEET_WORKER_JOINED,
+            "fleet_worker_suspected": EventType.FLEET_WORKER_SUSPECTED,
+            "fleet_worker_dead": EventType.FLEET_WORKER_DEAD,
+            "fleet_worker_recovered": EventType.FLEET_WORKER_RECOVERED,
         }.get(kind)
         if event_type is None or self.event_bus is None:
             return

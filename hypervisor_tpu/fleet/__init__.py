@@ -1,0 +1,60 @@
+"""Fleet observatory: merged cross-process drains, stitched traces,
+and a deterministic liveness plane ahead of the shard-out (round 18).
+
+Every plane built through round 17 — metrics, TraceLog, SLO burn,
+roofline, autopilot ledger — is host-singular. Before the arena can
+shard across worker processes (ROADMAP item 1), the fleet needs:
+
+* `worker` — N worker subprocesses, each the EXISTING API server +
+  `TenantArena` behind a `WorkerSpec` (tenant set / port / env pinned);
+  the workers serve the existing routes unchanged.
+* `registry` — the seeded, digest-replayable heartbeat/lease plane:
+  leases evaluated on the caller's clock (the SLO-engine discipline),
+  expiry flips alive -> suspected -> dead with hysteresis, transitions
+  ride the health fan-out as `fleet.*` bus events — push0's detect
+  half of detect-and-reassign.
+* `drain` — ONE merged exposition scraping every worker's `/metrics`
+  + `/debug/{health,slo,roofline,tenants,autopilot}`, stamping
+  `worker="<id>"` on EVERY series (the PR 16 tenant-label merge is the
+  template) and folding fleet rollups into a frozen `FleetSnapshot`
+  whose `digest()` covers exactly the rule-input fields.
+* `trace` — cross-process trace stitching: per-worker Chrome/OTLP
+  fragments for one `CausalTraceId` merged into one timeline with
+  worker lanes.
+"""
+
+from hypervisor_tpu.fleet.drain import (
+    FleetObservatory,
+    FleetSnapshot,
+    merge_expositions,
+    sample_series_count,
+    worker_label_coverage,
+)
+from hypervisor_tpu.fleet.registry import (
+    ALIVE,
+    DEAD,
+    SUSPECTED,
+    FleetRegistry,
+    LeaseConfig,
+    LeaseTransition,
+)
+from hypervisor_tpu.fleet.trace import stitch_chrome, stitch_otlp
+from hypervisor_tpu.fleet.worker import FleetSupervisor, WorkerSpec
+
+__all__ = [
+    "ALIVE",
+    "DEAD",
+    "SUSPECTED",
+    "FleetObservatory",
+    "FleetRegistry",
+    "FleetSnapshot",
+    "FleetSupervisor",
+    "LeaseConfig",
+    "LeaseTransition",
+    "WorkerSpec",
+    "merge_expositions",
+    "sample_series_count",
+    "stitch_chrome",
+    "stitch_otlp",
+    "worker_label_coverage",
+]

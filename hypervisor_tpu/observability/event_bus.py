@@ -121,6 +121,18 @@ class EventType(str, enum.Enum):
     AUTOPILOT_DECISION = "autopilot.decision"
     AUTOPILOT_OUTCOME = "autopilot.outcome"
 
+    # Fleet observatory (append-only, like every block above): the
+    # heartbeat/lease plane's liveness transitions (`fleet.registry.
+    # FleetRegistry`), facade-bridged from the health fan-out like the
+    # planes above. alive -> suspected -> dead with hysteresis; the
+    # payloads carry the lease seq + caller-clock timestamp so the
+    # transition log replays to a bit-identical digest — push0's
+    # detect half of detect-and-reassign.
+    FLEET_WORKER_JOINED = "fleet.worker_joined"
+    FLEET_WORKER_SUSPECTED = "fleet.worker_suspected"
+    FLEET_WORKER_DEAD = "fleet.worker_dead"
+    FLEET_WORKER_RECOVERED = "fleet.worker_recovered"
+
     @property
     def code(self) -> int:
         """int32 column code for the device event log."""

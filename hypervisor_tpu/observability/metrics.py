@@ -47,6 +47,20 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 COUNTER, GAUGE, HISTOGRAM = "counter", "gauge", "histogram"
 
 
+def escape_label_value(value) -> str:
+    """Prometheus exposition-spec label-value escaping — the ONE rule
+    every exposition writer shares (handle labels, the tenant-arena
+    `tenant="<id>"` merge, the fleet drain's `worker="<id>"` merge):
+    backslash, double quote, and newline must escape or a hostile id
+    breaks the scrape line (and can forge neighboring labels)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class MetricHandle:
     """One registered metric: its table row + exposition metadata."""
@@ -60,7 +74,9 @@ class MetricHandle:
     def label_str(self) -> str:
         if not self.labels:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in self.labels)
+        inner = ",".join(
+            f'{k}="{escape_label_value(v)}"' for k, v in self.labels
+        )
         return "{" + inner + "}"
 
 
@@ -711,6 +727,37 @@ AUTOPILOT_SANITIZE_EVERY = REGISTRY.gauge(
     "after autopilot retunes",
 )
 
+# ── fleet observatory (liveness + merged drain, round 18) ────────────
+# HOST-owned rows bumped by `fleet.FleetObservatory` as the lease plane
+# evaluates and the merged cross-worker drain folds — APPENDED at the
+# registry tail (hvlint HVA004).
+FLEET_WORKERS_ALIVE = REGISTRY.gauge(
+    "hv_fleet_workers_alive",
+    "workers the lease plane currently holds alive",
+)
+FLEET_WORKERS_SUSPECTED = REGISTRY.gauge(
+    "hv_fleet_workers_suspected",
+    "workers past the suspect window but not yet declared dead",
+)
+FLEET_WORKERS_DEAD = REGISTRY.gauge(
+    "hv_fleet_workers_dead",
+    "workers the lease plane has declared dead",
+)
+FLEET_LEASE_TRANSITIONS = REGISTRY.counter(
+    "hv_fleet_lease_transitions_total",
+    "lease state transitions recorded by the fleet registry's "
+    "replayable transition log",
+)
+FLEET_SCRAPES = REGISTRY.counter(
+    "hv_fleet_scrapes_total",
+    "merged-drain scrape rounds completed across the fleet",
+)
+FLEET_SCRAPE_ERRORS = REGISTRY.counter(
+    "hv_fleet_scrape_errors_total",
+    "per-worker scrape failures folded into the merged drain "
+    "(a dead worker's series drop out; the fetch error lands here)",
+)
+
 
 # ── host object: device table + host mirror + drain ──────────────────
 
@@ -1062,7 +1109,9 @@ def _labels(base: Mapping[str, str], **extra: str) -> str:
     items = list(base.items()) + list(extra.items())
     if not items:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+    return "{" + ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in items
+    ) + "}"
 
 
 def tally_wave_host(

@@ -85,6 +85,14 @@
 #      (no host callbacks beyond hv_wave_twin_call, no use-after-
 #      donate, fused wave stays ONE program) — zero unsuppressed
 #      findings, every suppression justified,
+#   6k. a fleet-observatory gate (round 18) — a 2-worker fleet smoke:
+#      the merged exposition must carry BOTH workers' series with a
+#      worker label on EVERY row (series conservation: merged count ==
+#      sum of per-worker counts), a SIGKILLed worker must be declared
+#      DEAD within <= 2 heartbeat windows of its last beat, the lease
+#      transition digest must replay bit-identically from the recorded
+#      observation journal, and each worker must hold zero post-warmup
+#      recompiles across the drill,
 #   7. a crash-recovery smoke gate — drive real traffic in a child
 #      process with a WAL + watermarked checkpoint, SIGKILL it
 #      mid-flight, recover from checkpoint + WAL replay, and assert
@@ -993,6 +1001,51 @@ print(
 PY
 autopilot_rc=$?
 
+echo "── fleet-observatory gate (6k) ──"
+# Round 18 (ISSUE 18): the 2-worker fleet smoke — workers are the
+# EXISTING API server in subprocesses; the merged drain must conserve
+# series (merged == sum of per-worker counts) with worker="<id>" on
+# EVERY row, the SIGKILL drill must land DEAD within <= 2 heartbeat
+# windows, the lease transition digest must replay bit-identically
+# from the recorded observation journal, and no worker may recompile
+# after its pre-READY warmup.
+JAX_PLATFORMS=cpu python - <<'PY'
+from benchmarks.bench_suite import fleet_observatory_benchmark
+
+row = fleet_observatory_benchmark(seed=18, quick=True, n_workers=2)
+assert row["workers"] >= 2, row["workers"]
+assert row["killed"], "kill drill never fired"
+dead = row["detection_windows"]["dead"]
+assert dead is not None and dead <= row["budget_windows"], (
+    f"SIGKILL detection took {dead} windows "
+    f"(budget {row['budget_windows']})"
+)
+assert row["digest_match"], (
+    "lease plane NOT replay-deterministic: transition digests differ "
+    "across replays of the same observation journal"
+)
+assert row["series_conserved"], (
+    f"merged drain dropped series: merged {row['merged_series']} != "
+    f"sum {row['series_per_worker_sum']}"
+)
+assert row["worker_label_coverage"] == 1.0, (
+    f"unlabeled rows in the merged exposition: "
+    f"coverage {row['worker_label_coverage']}"
+)
+assert row["recompiles_after_warmup"] == 0, (
+    f"post-warmup recompiles in a worker: {row['per_worker']}"
+)
+assert row["scrape_errors"] == 0, f"scrape errors: {row['scrape_errors']}"
+print(
+    f"fleet gate OK: {row['workers']} workers, DEAD in {dead} windows "
+    f"(budget {row['budget_windows']}), digest bit-identical over "
+    f"{row['replays']} replays, {row['merged_series']} merged series "
+    f"conserved @ coverage {row['worker_label_coverage']:.1f}, zero "
+    f"post-warmup recompiles"
+)
+PY
+fleet_rc=$?
+
 echo "── hvlint static-analysis gate ──"
 # The contract analyzer (ISSUE 12): Tier A pure-AST rules (WAL
 # coverage, env arming, lock discipline, append-only registries, twin
@@ -1074,6 +1127,10 @@ fi
 if [ "$autopilot_rc" -ne 0 ]; then
     echo "autopilot decision-plane gate FAILED (rc=$autopilot_rc)" >&2
     exit "$autopilot_rc"
+fi
+if [ "$fleet_rc" -ne 0 ]; then
+    echo "fleet-observatory gate FAILED (rc=$fleet_rc)" >&2
+    exit "$fleet_rc"
 fi
 if [ "$hvlint_rc" -ne 0 ]; then
     echo "hvlint static-analysis gate FAILED (rc=$hvlint_rc)" >&2

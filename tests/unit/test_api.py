@@ -192,8 +192,15 @@ class TestHTTPTransport:
         # and the latency observatory (/debug/slo), and the roofline
         # observatory (/debug/roofline + POST /debug/profile), and the
         # tenant-dense panel (/debug/tenants), and the autopilot
-        # decision plane (/debug/autopilot): 46 routes.
-        assert len(ROUTES) == 46
+        # decision plane (/debug/autopilot), and the fleet observatory
+        # (/debug/fleet + /fleet/{workers,metrics,slo,trace/{id}}):
+        # 51 routes.
+        assert len(ROUTES) == 51
+        assert any(path == "/debug/fleet" for _, path, _, _ in ROUTES)
+        assert any(path == "/fleet/metrics" for _, path, _, _ in ROUTES)
+        assert any(
+            path == "/fleet/trace/{trace_id}" for _, path, _, _ in ROUTES
+        )
         assert any(path == "/debug/tenants" for _, path, _, _ in ROUTES)
         assert any(path == "/debug/autopilot" for _, path, _, _ in ROUTES)
         assert any(path == "/debug/resilience" for _, path, _, _ in ROUTES)

@@ -1,0 +1,462 @@
+"""Fleet observatory (ISSUE 18): liveness truth + merged drains.
+
+The load-bearing pins:
+
+  1. **Lease chain** — seeded property drills: late/flapping/recovering
+     workers never skip a state (alive <-> suspected <-> dead only
+     steps between neighbors), recovery is hysteretic (`recover_beats`
+     consecutive beats promote ONE step), and the same seed + the same
+     observation journal replay to an identical transition log and a
+     bit-identical digest.
+  2. **Label escaping** — ONE shared helper (`metrics.
+     escape_label_value`) covers `"`/`\\`/newline for BOTH the tenant
+     and the worker label merges: a hostile id cannot break a scrape
+     line or forge a neighboring label.
+  3. **Merge conservation** — the merged exposition carries exactly
+     the sum of the per-worker series, every sample row stamped with
+     `worker="<id>"` (coverage == 1.0), headers emitted once.
+  4. **Snapshot digest discipline** — `FleetSnapshot.digest()` covers
+     exactly the rule-input fields; wall-contaminated advisories
+     (scrape wall, transient errors, worst-burn glance) never shift it.
+  5. **Stitching** — per-worker Chrome/OTLP fragments merge into one
+     timeline with worker lanes (pid per worker / resource per worker).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import urllib.request
+
+import pytest
+
+from hypervisor_tpu.fleet import (
+    ALIVE,
+    DEAD,
+    SUSPECTED,
+    FleetObservatory,
+    FleetRegistry,
+    FleetSnapshot,
+    LeaseConfig,
+    WorkerSpec,
+    merge_expositions,
+    sample_series_count,
+    stitch_chrome,
+    stitch_otlp,
+    worker_label_coverage,
+)
+from hypervisor_tpu.fleet.drain import stamp_worker_label
+from hypervisor_tpu.observability.metrics import (
+    MetricHandle,
+    escape_label_value,
+)
+
+_ORDER = {ALIVE: 0, SUSPECTED: 1, DEAD: 2}
+
+
+# ── 2: the ONE escaping rule ─────────────────────────────────────────
+
+
+class TestLabelEscaping:
+    def test_spec_characters(self):
+        assert escape_label_value('a"b') == 'a\\"b'
+        assert escape_label_value("a\\b") == "a\\\\b"
+        assert escape_label_value("a\nb") == "a\\nb"
+        assert escape_label_value(7) == "7"
+
+    def test_handle_labels_escape(self):
+        h = MetricHandle(
+            "hv_x_total", "counter", 0, labels=(("q", 'jo"in\n'),)
+        )
+        assert h.label_str() == '{q="jo\\"in\\n"}'
+
+    def test_worker_stamp_uses_the_same_rule(self):
+        # A hostile worker id cannot break the scrape line or forge a
+        # neighboring label: the stamp escapes with the SAME helper.
+        hostile = 'w"0",evil="1'
+        text = "hv_up 1\nhv_x{tenant=\"3\"} 2\n"
+        stamped = stamp_worker_label(text, hostile, emit_headers=True)
+        expected = escape_label_value(hostile)
+        assert f'hv_up{{worker="{expected}"}} 1' in stamped
+        assert f'hv_x{{worker="{expected}",tenant="3"}} 2' in stamped
+        # Every sample row parses back to exactly one worker label.
+        assert worker_label_coverage(stamped) == 1.0
+
+
+# ── 3: merge conservation ────────────────────────────────────────────
+
+
+class TestMerge:
+    def test_series_conserved_headers_once(self):
+        per = {
+            "w1": "# HELP hv_up up\n# TYPE hv_up gauge\nhv_up 1\nhv_n 3\n",
+            "w0": "# HELP hv_up up\n# TYPE hv_up gauge\nhv_up 1\nhv_n 2\n",
+        }
+        merged = merge_expositions(per)
+        assert sample_series_count(merged) == sum(
+            sample_series_count(t) for t in per.values()
+        )
+        assert merged.count("# HELP hv_up") == 1  # headers once
+        assert worker_label_coverage(merged) == 1.0
+        # Sorted worker order: w0's samples precede w1's.
+        assert merged.index('worker="w0"') < merged.index('worker="w1"')
+
+    def test_tenant_rows_keep_both_labels(self):
+        text = 'hv_q_depth{tenant="5",queue="join"} 2\n'
+        stamped = stamp_worker_label(text, "w3", emit_headers=False)
+        assert 'worker="w3"' in stamped and 'tenant="5"' in stamped
+
+
+# ── 1: the lease chain (seeded property drills) ──────────────────────
+
+
+def _never_skips(transitions):
+    for t in transitions:
+        if t.old == "joined":
+            assert t.new == ALIVE
+            continue
+        assert abs(_ORDER[t.new] - _ORDER[t.old]) == 1, (t.old, t.new)
+
+
+class TestLeaseChain:
+    CFG = LeaseConfig(
+        heartbeat_interval_s=1.0, suspect_windows=1.0, dead_windows=2.0,
+        recover_beats=2,
+    )
+
+    def test_silence_walks_the_chain(self):
+        reg = FleetRegistry(self.CFG, seed=1)
+        reg.register("w0", 0.0)
+        assert reg.evaluate(0.5) == {"w0": ALIVE}
+        assert reg.evaluate(1.0) == {"w0": SUSPECTED}   # >= 1 window
+        assert reg.evaluate(1.5) == {"w0": SUSPECTED}
+        assert reg.evaluate(2.0) == {"w0": DEAD}        # >= 2 windows
+        _never_skips(reg.transitions)
+
+    def test_dead_within_two_windows_of_last_beat(self):
+        # The kill-drill budget: beat at t, silence after — DEAD lands
+        # at t + 2 windows when evaluate runs once per window.
+        reg = FleetRegistry(self.CFG, seed=2)
+        reg.register("w0", 0.0)
+        for k in range(1, 4):
+            reg.heartbeat("w0", float(k))
+            reg.evaluate(float(k))
+        # killed after the beat at t=3; evals keep the window cadence
+        assert reg.evaluate(4.0) == {"w0": SUSPECTED}
+        assert reg.evaluate(5.0) == {"w0": DEAD}
+        dead = [t for t in reg.transitions if t.new == DEAD]
+        assert dead and dead[0].now - 3.0 <= 2.0 * 1.0
+
+    def test_recovery_is_hysteretic_and_stepwise(self):
+        reg = FleetRegistry(self.CFG, seed=3)
+        reg.register("w0", 0.0)
+        reg.evaluate(1.0)
+        reg.evaluate(2.0)
+        assert reg.state_of("w0") == DEAD
+        # One beat is NOT enough (recover_beats=2)…
+        reg.heartbeat("w0", 3.0)
+        assert reg.state_of("w0") == DEAD
+        # …two consecutive promote ONE step (never dead -> alive).
+        reg.heartbeat("w0", 4.0)
+        assert reg.state_of("w0") == SUSPECTED
+        reg.heartbeat("w0", 5.0)
+        assert reg.state_of("w0") == SUSPECTED
+        reg.heartbeat("w0", 6.0)
+        assert reg.state_of("w0") == ALIVE
+        _never_skips(reg.transitions)
+
+    def test_missed_beat_resets_the_recovery_streak(self):
+        reg = FleetRegistry(self.CFG, seed=4)
+        reg.register("w0", 0.0)
+        reg.evaluate(1.0)
+        assert reg.state_of("w0") == SUSPECTED
+        reg.heartbeat("w0", 1.5)        # streak 1 of 2
+        reg.evaluate(2.5)               # a window of silence…
+        assert reg.state_of("w0") == SUSPECTED
+        # …did not promote; and the eval reset the streak, so the next
+        # single beat still isn't enough.
+        reg.heartbeat("w0", 3.0)
+        assert reg.state_of("w0") == SUSPECTED
+        reg.heartbeat("w0", 3.5)
+        assert reg.state_of("w0") == ALIVE
+
+    @pytest.mark.parametrize("seed", [11, 29, 47])
+    def test_random_schedules_never_skip_and_replay_identically(
+        self, seed
+    ):
+        # Late, flapping, and recovering workers under a seeded random
+        # beat/eval schedule: the chain never skips a state and the
+        # journal replays to a bit-identical log + digest.
+        rng = random.Random(seed)
+        reg = FleetRegistry(self.CFG, seed=seed)
+        workers = [f"w{i}" for i in range(4)]
+        for w in workers:
+            reg.register(w, 0.0)
+        now = 0.0
+        for _ in range(200):
+            now += rng.choice([0.25, 0.5, 1.0, 1.5])
+            for w in workers:
+                if rng.random() < 0.55:  # flappy fleet
+                    reg.heartbeat(w, now)
+            if rng.random() < 0.7:
+                reg.evaluate(now)
+        _never_skips(reg.transitions)
+        assert len(reg.transitions) > 4  # the drill actually moved
+        replayed = FleetRegistry.replay(
+            reg.observations, self.CFG, seed=seed
+        )
+        assert [t.replay_key() for t in replayed.transitions] == [
+            t.replay_key() for t in reg.transitions
+        ]
+        assert replayed.transition_digest() == reg.transition_digest()
+        # A different seed shifts the digest (seed is IN the digest).
+        other = FleetRegistry.replay(
+            reg.observations, self.CFG, seed=seed + 1
+        )
+        assert other.transition_digest() != reg.transition_digest()
+
+    def test_transitions_fan_out_through_emit(self):
+        seen = []
+        reg = FleetRegistry(
+            self.CFG, seed=5, emit=lambda kind, p: seen.append((kind, p))
+        )
+        reg.register("w0", 0.0)
+        reg.evaluate(1.0)
+        reg.evaluate(2.0)
+        kinds = [k for k, _ in seen]
+        assert kinds == [
+            "fleet_worker_joined",
+            "fleet_worker_suspected",
+            "fleet_worker_dead",
+        ]
+        assert seen[-1][1]["worker"] == "w0"
+
+    def test_env_knobs_read_per_call(self, monkeypatch):
+        monkeypatch.setenv("HV_FLEET_HEARTBEAT_S", "0.125")
+        monkeypatch.setenv("HV_FLEET_RECOVER_BEATS", "5")
+        cfg = LeaseConfig.from_env()
+        assert cfg.heartbeat_interval_s == 0.125
+        assert cfg.recover_beats == 5
+        monkeypatch.setenv("HV_FLEET_HEARTBEAT_S", "garbage")
+        assert LeaseConfig.from_env().heartbeat_interval_s == 0.25
+
+
+# ── 4: snapshot digest discipline ────────────────────────────────────
+
+
+class TestSnapshotDigest:
+    def _snap(self, **over):
+        kw = dict(
+            seq=3,
+            now=12.5,
+            workers=("w0", "w1"),
+            states=(("w0", ALIVE), ("w1", SUSPECTED)),
+            occupancy=(("w0", 4), ("w1", 2)),
+            compiles=(("w0", 7), ("w1", 7)),
+            recompiles=(("w0", 0), ("w1", 0)),
+            series=(("w0", 100), ("w1", 100)),
+            merged_series=200,
+            transitions_digest="abc",
+            floor_distance=(("w0", 3.14159), ("w1", None)),
+            worst_burn=(("w1", "join", "warning"),),
+            scrape_wall_ms=17.3,
+            errors=(("w1", "slo"),),
+        )
+        kw.update(over)
+        return FleetSnapshot(**kw)
+
+    def test_advisories_do_not_shift_the_digest(self):
+        a = self._snap()
+        b = self._snap(worst_burn=(), scrape_wall_ms=999.9, errors=())
+        assert a.digest() == b.digest()
+
+    def test_rule_inputs_do_shift_the_digest(self):
+        a = self._snap()
+        assert a.digest() != self._snap(merged_series=201).digest()
+        assert a.digest() != self._snap(
+            states=(("w0", ALIVE), ("w1", DEAD))
+        ).digest()
+        assert a.digest() != self._snap(transitions_digest="xyz").digest()
+
+    def test_float_quantization(self):
+        # Sub-quantum float jitter (now 6 dp, floor distance 1 dp)
+        # cannot shift the digest.
+        a = self._snap()
+        b = self._snap(
+            now=12.5000000001,
+            floor_distance=(("w0", 3.1400001), ("w1", None)),
+        )
+        assert a.digest() == b.digest()
+
+    def test_totals(self):
+        t = self._snap().totals()
+        assert t == {
+            "occupancy": 6, "compiles": 14, "recompiles": 0, "series": 200,
+        }
+
+
+# ── 5: stitching ─────────────────────────────────────────────────────
+
+
+def _chrome_frag(name: str) -> dict:
+    return {
+        "traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 1,
+             "args": {"name": "hypervisor_tpu"}},
+            {"name": f"wave:{name}", "cat": "hv", "ph": "X", "ts": 1.0,
+             "dur": 2.0, "pid": 1, "tid": 7, "args": {}},
+        ],
+        "displayTimeUnit": "ms",
+    }
+
+
+class TestStitch:
+    def test_chrome_worker_lanes(self):
+        doc = stitch_chrome(
+            {"w1": _chrome_frag("b"), "w0": _chrome_frag("a")}
+        )
+        meta = [
+            e for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+        ]
+        # One lane per worker, sorted: w0 -> pid 1, w1 -> pid 2; the
+        # fragments' own process metadata is replaced, not duplicated.
+        assert [(m["pid"], m["args"]["name"]) for m in meta] == [
+            (1, "worker:w0"), (2, "worker:w1"),
+        ]
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert {(e["name"], e["pid"]) for e in spans} == {
+            ("wave:a", 1), ("wave:b", 2),
+        }
+
+    def test_otlp_resource_per_worker(self):
+        frag = {
+            "resourceSpans": [{
+                "resource": {"attributes": [
+                    {"key": "service.name",
+                     "value": {"stringValue": "hypervisor_tpu"}},
+                ]},
+                "scopeSpans": [{"scope": {"name": "s"}, "spans": []}],
+            }]
+        }
+        doc = stitch_otlp({"w0": frag, "w1": json.loads(json.dumps(frag))})
+        names = []
+        for rs in doc["resourceSpans"]:
+            attrs = {
+                a["key"]: a["value"]["stringValue"]
+                for a in rs["resource"]["attributes"]
+            }
+            names.append((attrs["service.name"], attrs["hv.worker"]))
+        assert names == [
+            ("hypervisor_tpu/w0", "w0"), ("hypervisor_tpu/w1", "w1"),
+        ]
+
+
+# ── worker spec + service surface ────────────────────────────────────
+
+
+class TestWorkerSpec:
+    def test_json_round_trip(self):
+        spec = WorkerSpec(
+            worker_id="w0", tenants=(0, 1), port=8123,
+            env=(("HV_TRACE", "1"),),
+        )
+        again = WorkerSpec.from_json(spec.to_json())
+        assert again == spec
+        assert again.wants_arena  # two tenants -> arena auto-attaches
+        assert not WorkerSpec(worker_id="s", tenants=(0,)).wants_arena
+
+    def test_base_url(self):
+        assert WorkerSpec(
+            worker_id="w", port=81
+        ).base_url == "http://127.0.0.1:81"
+
+
+class TestServiceSurface:
+    def _run(self, coro):
+        import asyncio
+
+        return asyncio.run(coro)
+
+    def test_debug_fleet_degrades_without_a_fleet(self, hv_service):
+        assert self._run(hv_service.debug_fleet()) == {"enabled": False}
+
+    def test_fleet_routes_refuse_typed_503(self, hv_service):
+        from hypervisor_tpu.api.service import ApiError
+
+        for call in (
+            hv_service.fleet_workers(),
+            hv_service.fleet_metrics(),
+            hv_service.fleet_slo(),
+            hv_service.fleet_trace("t1"),
+        ):
+            with pytest.raises(ApiError) as ei:
+                self._run(call)
+            assert ei.value.status == 503
+
+    def test_fleet_trace_unknown_format_400(self, hv_service):
+        from hypervisor_tpu.api.service import ApiError
+        from hypervisor_tpu.fleet import FleetObservatory
+
+        hv_service.fleet = FleetObservatory({})
+        with pytest.raises(ApiError) as ei:
+            self._run(hv_service.fleet_trace("t1", format="protobuf"))
+        assert ei.value.status == 400
+
+
+@pytest.fixture(scope="module")
+def hv_service():
+    from hypervisor_tpu.api.service import HypervisorService
+
+    return HypervisorService()
+
+
+# ── end-to-end: one real worker subprocess ───────────────────────────
+
+
+class TestFleetE2E:
+    def test_one_worker_merged_drain_and_lease(self):
+        from hypervisor_tpu.fleet import FleetSupervisor
+
+        sup = FleetSupervisor(
+            [WorkerSpec(worker_id="w0", tenants=(0,))]
+        )
+        try:
+            sup.start()
+            assert sup.alive("w0")
+            reg = FleetRegistry(
+                LeaseConfig(heartbeat_interval_s=1.0), seed=9
+            )
+            reg.register("w0", 0.0)
+            obs = FleetObservatory(sup.urls(), registry=reg)
+            merged, snap = obs.drain(now=0.0)
+            assert snap.merged_series == sum(
+                v for _, v in snap.series
+            ) > 0
+            assert worker_label_coverage(merged) == 1.0
+            assert dict(snap.states)["w0"] == ALIVE
+            # /debug/fleet through a supervisor-side server.
+            from hypervisor_tpu.api.server import HypervisorHTTPServer
+            from hypervisor_tpu.api.service import HypervisorService
+
+            svc = HypervisorService()
+            svc.fleet = obs
+            srv = HypervisorHTTPServer(svc, port=0).start()
+            try:
+                doc = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/fleet",
+                    timeout=10,
+                ).read())
+                assert doc["enabled"] and "w0" in doc["workers"]
+                assert doc["registry"]["transition_count"] >= 1
+            finally:
+                srv.stop()
+            # SIGKILL: the subprocess dies; the lease plane walks the
+            # chain within two evaluated windows of the last beat.
+            sup.kill("w0")
+            assert not sup.alive("w0")
+            reg.evaluate(1.0)
+            reg.evaluate(2.0)
+            assert reg.state_of("w0") == DEAD
+        finally:
+            sup.stop()

@@ -798,6 +798,46 @@ def _suite_report(
             if round_no >= 17
             else None
         ),
+        # Rounds >= regression.FLEET_ROW_SINCE must carry the fleet
+        # observatory row (round-18 presence gate, ISSUE 18); the
+        # worker count is floor-gated, dead-detection latency must sit
+        # inside the windowed budget, the lease-journal replay digest
+        # must be bit-identical, the merged drain must conserve series
+        # at full worker-label coverage, and per-worker post-warmup
+        # recompiles are hard-gated to zero.
+        "fleet": (
+            {
+                "seed": 18,
+                "quick": quick,
+                "workers": 2,
+                "tenants_per_worker": 2,
+                "heartbeat_interval_s": 0.25,
+                "budget_windows": 2.0,
+                "detection_windows": {
+                    "suspected": 1.0, "dead": 2.0,
+                    "p50": 2.0, "max": 2.0,
+                },
+                "killed": "w1",
+                "transitions": 4,
+                "digest": "cd" * 32,
+                "digest_match": True,
+                "replays": 2,
+                "merged_drain_wall_ms": 120.0,
+                "merged_series": 2434,
+                "series_per_worker_sum": 2434,
+                "series_conserved": True,
+                "worker_label_coverage": 1.0,
+                "scrape_errors": 0,
+                "compiles_after_warmup": 0,
+                "recompiles_after_warmup": 0,
+                "per_worker": {
+                    "w0": {"compiles": 0, "recompiles": 0, "series": 1217},
+                    "w1": {"compiles": 0, "recompiles": 0, "series": 1217},
+                },
+            }
+            if round_no >= 18
+            else None
+        ),
     }
 
 
@@ -1194,6 +1234,75 @@ class TestRegressionHarness:
             assert check(goodput_improvement=0.05) == 0
         finally:
             del os.environ["HV_BENCH_AUTOPILOT_GAIN"]
+
+    def test_missing_fleet_row_fails_from_round_18(self, tmp_path):
+        # ISSUE 18: the fleet row is REQUIRED from round 18 — dropping
+        # the fleet drill's bench coverage is a regression.
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 17, _suite_report(17, {"full_governance_pipeline": 10.0})
+        )
+        doc = _suite_report(18, {"full_governance_pipeline": 10.0})
+        doc["fleet"] = None
+        self._write(tmp_path, 18, doc)
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # A round carrying the row passes, and the trajectory keeps it.
+        self._write(
+            tmp_path, 18,
+            _suite_report(18, {"full_governance_pipeline": 10.0}),
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
+        rows = regression.load_history(tmp_path)
+        fleet = rows[-1]["fleet"]
+        assert fleet["workers"] == 2
+        assert fleet["digest_match"] is True
+        assert fleet["detection_windows"]["max"] == 2.0
+
+    def test_fleet_gates_floor_budget_and_hard_zeros(self, tmp_path):
+        # The ISSUE 18 acceptance bars: >= 2 workers
+        # (HV_BENCH_FLEET_MIN overrides), dead-detection <= the
+        # windowed budget (HV_BENCH_FLEET_DETECT), lease-journal replay
+        # digest bit-identity, merged-drain series conservation at
+        # full worker-label coverage, and hard-zero post-warmup
+        # recompiles per worker.
+        import os
+
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 17, _suite_report(17, {"full_governance_pipeline": 10.0})
+        )
+
+        def check(**overrides) -> int:
+            doc = _suite_report(18, {"full_governance_pipeline": 10.0})
+            doc["fleet"].update(overrides)
+            self._write(tmp_path, 18, doc)
+            return regression.main(["--root", str(tmp_path), "--quiet"])
+
+        assert check() == 0
+        assert check(workers=1) == 1                  # below the fleet floor
+        assert check(                                 # over the budget
+            detection_windows={"suspected": 1.0, "dead": 5.0,
+                               "p50": 5.0, "max": 5.0}
+        ) == 1
+        assert check(                                 # kill never detected
+            detection_windows={"suspected": None, "dead": None,
+                               "p50": None, "max": None}
+        ) == 1
+        assert check(digest_match=False) == 1         # replay contract broken
+        assert check(series_conserved=False) == 1     # merge dropped series
+        assert check(worker_label_coverage=0.9) == 1  # unlabeled rows
+        assert check(recompiles_after_warmup=3) == 1  # worker recompiled
+        # The env knobs relax the floors (read per gate run).
+        os.environ["HV_BENCH_FLEET_DETECT"] = "6.0"
+        try:
+            assert check(
+                detection_windows={"suspected": 1.0, "dead": 5.0,
+                                   "p50": 5.0, "max": 5.0}
+            ) == 0
+        finally:
+            del os.environ["HV_BENCH_FLEET_DETECT"]
 
     def test_next_round_path_advances(self, tmp_path):
         from benchmarks import regression

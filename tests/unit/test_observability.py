@@ -70,10 +70,11 @@ class TestEventBus:
         # events, the 4 integrity-plane events, and the 4
         # adversarial-plane events, and the 3 SLO burn-rate events,
         # and the roofline observatory's bytes-shift event, and the
-        # autopilot's decision/outcome pair (round 17)
+        # autopilot's decision/outcome pair (round 17), and the fleet
+        # lease plane's joined/suspected/dead/recovered quad (round 18)
         # (append-only: codes are the device-log wire format, so every
         # earlier code stays stable).
-        assert len({t.code for t in EventType}) == len(EventType) == 61
+        assert len({t.code for t in EventType}) == len(EventType) == 65
         assert EventType.WAVE_STRAGGLER.code == 40
         assert EventType.CAPACITY_WARNING.code == 41
         assert EventType.RECOMPILE.code == 42

@@ -168,7 +168,7 @@ class TestSLOEngine:
         # Wire-format discipline: the new codes extend the enum, they
         # never renumber existing device-log rows (hvlint HVA004 pins
         # the committed baseline; this pins the tail order).
-        tail = list(EventType)[-6:]
+        tail = list(EventType)[-10:]
         assert tail == [
             EventType.SLO_BURN_RATE_WARNING,
             EventType.SLO_BURN_RATE_CRITICAL,
@@ -180,6 +180,12 @@ class TestSLOEngine:
             # BEHIND the roofline canary — append-only holds.
             EventType.AUTOPILOT_DECISION,
             EventType.AUTOPILOT_OUTCOME,
+            # Round 18 appended the fleet lease plane's quad BEHIND
+            # the autopilot pair — append-only holds.
+            EventType.FLEET_WORKER_JOINED,
+            EventType.FLEET_WORKER_SUSPECTED,
+            EventType.FLEET_WORKER_DEAD,
+            EventType.FLEET_WORKER_RECOVERED,
         ]
 
 

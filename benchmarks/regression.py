@@ -234,6 +234,22 @@ DEFAULT_FLEET_MIN_WORKERS = 2
 #: detect-and-reassign, pinned ahead of the shard-out.
 DEFAULT_FLEET_DETECT_WINDOWS = 2.0
 
+#: The incident row joined the trajectory in round 19 (ISSUE 19,
+#: bench_suite --incidents): the hindsight plane — retained telemetry
+#: history + black-box incident recorder measured live. Clean-path
+#: snapshot overhead (history sampler on vs off), capture p50 + bundle
+#: bytes for a seeded taxonomy drill through the real health fan-out,
+#: incident-id and history-digest bit-identity over two replays, zero
+#: post-warmup recompiles. A suite round from 19 on missing the row
+#: regresses the postmortem-evidence coverage.
+INCIDENT_ROW_SINCE = 19
+
+#: Max clean-path overhead (%) the history sampler may add to the
+#: metrics drain (`HV_BENCH_INCIDENT_OVERHEAD` overrides): the tiered
+#: rings fold the snapshot the drain already paid for — host-side
+#: appends only, zero extra device_get — so the band is tight.
+DEFAULT_INCIDENT_OVERHEAD_PCT = 15.0
+
 
 def census_fusion_floor(round_num: int) -> float:
     """The fusion-ratio floor for a given round: env override, else the
@@ -527,6 +543,39 @@ def parse_round_file(path: Path) -> Optional[dict]:
                     "per_worker": fleet.get("per_worker"),
                 }
                 if isinstance(fleet := doc.get("fleet"), dict)
+                else None
+            ),
+            # Incident row (round 19, ISSUE 19): hindsight-plane
+            # clean-path overhead, capture cost + bundle bytes,
+            # incident-id/history-digest replay bit-identity, history
+            # conservation, zero post-warmup recompiles — gated below.
+            incident_capture=(
+                {
+                    "seed": inc.get("seed"),
+                    "quick": inc.get("quick"),
+                    "snapshot_p50_us": inc.get("snapshot_p50_us"),
+                    "clean_path_overhead_pct": inc.get(
+                        "clean_path_overhead_pct"
+                    ),
+                    "triggers_fired": inc.get("triggers_fired"),
+                    "captured": inc.get("captured"),
+                    "capture_wall_us": inc.get("capture_wall_us"),
+                    "bundle_bytes": inc.get("bundle_bytes"),
+                    "replays": inc.get("replays"),
+                    "incident_digest_match": inc.get(
+                        "incident_digest_match"
+                    ),
+                    "history_digest_match": inc.get(
+                        "history_digest_match"
+                    ),
+                    "digest_match": inc.get("digest_match"),
+                    "replay_check_ok": inc.get("replay_check_ok"),
+                    "history": inc.get("history"),
+                    "recompiles_after_warmup": inc.get(
+                        "recompiles_after_warmup"
+                    ),
+                }
+                if isinstance(inc := doc.get("incident_capture"), dict)
                 else None
             ),
             # Roofline row (round 15, ISSUE 14): per-program modeled
@@ -1154,6 +1203,74 @@ def compare(
         if value is not None:
             entry = {
                 "bench": "fleet_recompiles_after_warmup",
+                "current_per_op_us": float(value),
+                "baseline_per_op_us": 0.0,
+                "ratio": float(value),
+            }
+            checked.append(entry)
+            if value != 0:
+                regressions.append(entry)
+    # Incident gates (round 19, ISSUE 19): presence from
+    # INCIDENT_ROW_SINCE, the clean-path overhead band, bit-identical
+    # incident-id + history digests over the seeded replays (postmortem
+    # evidence must be auditable), history conservation across the
+    # tier folds, and the hard-zero post-warmup recompile contract
+    # (the whole plane is host-side).
+    inc = current.get("incident_capture")
+    if (
+        current.get("format") == "suite"
+        and current["round"] >= INCIDENT_ROW_SINCE
+        and not inc
+    ):
+        entry = {
+            "bench": "missing:incident_capture",
+            "current_per_op_us": 0.0,
+            "baseline_per_op_us": 0.0,
+            "ratio": 0.0,
+        }
+        checked.append(entry)
+        regressions.append(entry)
+    if inc:
+        overhead = inc.get("clean_path_overhead_pct")
+        if overhead is not None:
+            env_o = os.environ.get("HV_BENCH_INCIDENT_OVERHEAD")
+            band = float(env_o) if env_o else DEFAULT_INCIDENT_OVERHEAD_PCT
+            entry = {
+                "bench": "incident_clean_path_overhead_pct",
+                "current_per_op_us": float(overhead),
+                "baseline_per_op_us": band,
+                "ratio": round(float(overhead) / band, 3) if band else 0.0,
+            }
+            checked.append(entry)
+            if float(overhead) > band:
+                regressions.append(entry)
+        match = inc.get("digest_match")
+        if match is not None:
+            ok = bool(match) and bool(inc.get("replay_check_ok", True))
+            entry = {
+                "bench": "incident_digest_match",
+                "current_per_op_us": 1.0 if ok else 0.0,
+                "baseline_per_op_us": 1.0,
+                "ratio": 1.0 if ok else 0.0,
+            }
+            checked.append(entry)
+            if not ok:
+                regressions.append(entry)
+        conserved = (inc.get("history") or {}).get("conservation")
+        if conserved is not None:
+            entry = {
+                "bench": "incident_history_conservation",
+                "current_per_op_us": 1.0 if conserved else 0.0,
+                "baseline_per_op_us": 1.0,
+                "ratio": 1.0 if conserved else 0.0,
+            }
+            checked.append(entry)
+            if not conserved:
+                regressions.append(entry)
+        value = inc.get("recompiles_after_warmup")
+        if value is not None:
+            entry = {
+                "bench": "incident_recompiles_after_warmup",
                 "current_per_op_us": float(value),
                 "baseline_per_op_us": 0.0,
                 "ratio": float(value),

@@ -126,15 +126,15 @@ def poll_url(
     base,
 ) -> tuple[
     dict, dict[str, float], dict | None, dict | None, dict | None,
-    dict | None,
+    dict | None, dict | None,
 ]:
     """One (/debug/health, /metrics, /debug/roofline, /debug/tenants,
-    /debug/autopilot, /debug/fleet) poll against a live deployment —
-    all on ONE reused connection (`UrlPoller`; a bare URL string still
-    works and builds a throwaway poller). The observatory polls degrade
-    gracefully: an older server without an endpoint (404) — or any
-    fetch error — renders that panel as "n/a" instead of crashing the
-    watch loop."""
+    /debug/autopilot, /debug/fleet, /debug/incidents) poll against a
+    live deployment — all on ONE reused connection (`UrlPoller`; a bare
+    URL string still works and builds a throwaway poller). The
+    observatory polls degrade gracefully: an older server without an
+    endpoint (404) — or any fetch error — renders that panel as "n/a"
+    instead of crashing the watch loop."""
     poller = base if isinstance(base, UrlPoller) else UrlPoller(base)
     status, body = poller.get("/debug/health")
     if status != 200:
@@ -148,14 +148,15 @@ def poll_url(
     tenants = poller.get_json("/debug/tenants")     # pre-r16: n/a
     autopilot = poller.get_json("/debug/autopilot")  # pre-r17: n/a
     fleet = poller.get_json("/debug/fleet")         # pre-r18: n/a
-    return health, counters, roofline, tenants, autopilot, fleet
+    incidents = poller.get_json("/debug/incidents")  # pre-r19: n/a
+    return health, counters, roofline, tenants, autopilot, fleet, incidents
 
 
 def poll_state(
     state, tenant_front=None
 ) -> tuple[
     dict, dict[str, float], dict | None, dict | None, dict | None,
-    dict | None,
+    dict | None, dict | None,
 ]:
     """The in-process twin of `poll_url` (same payload shapes).
     `tenant_front` (a `tenancy.TenantFrontDoor`) supplies the tenants
@@ -183,9 +184,13 @@ def poll_state(
         autopilot = state.autopilot_summary()
     except Exception:  # noqa: BLE001 — panel shows n/a, never crashes
         autopilot = None
+    try:
+        incidents = state.incidents_summary()
+    except Exception:  # noqa: BLE001 — panel shows n/a, never crashes
+        incidents = None
     # The fleet plane is supervisor-side only — an in-process state has
     # no worker fan-out, so the panel reads n/a (same as pre-r18 URLs).
-    return health, counters, roofline, tenants, autopilot, None
+    return health, counters, roofline, tenants, autopilot, None, incidents
 
 
 def load_trajectory(root: Path) -> list[dict]:
@@ -214,6 +219,7 @@ def render(
     tenants: dict | None = None,
     autopilot: dict | None = None,
     fleet: dict | None = None,
+    incidents: dict | None = None,
 ) -> str:
     lines = [
         f"hv_top @ {time.strftime('%H:%M:%S')}  "
@@ -497,6 +503,32 @@ def render(
             header=("worker", "state", "occ", "comp/rec", "series", "floor"),
         )
 
+    lines.append("")
+    if not incidents or not incidents.get("enabled"):
+        lines.append("incidents  n/a (endpoint absent or pre-r19 server)")
+    else:
+        lines.append(
+            f"incidents  captured={incidents.get('captured', 0):,}  "
+            f"suppressed={incidents.get('suppressed', 0):,}  "
+            f"evicted={incidents.get('evicted', 0):,}  "
+            f"retained={incidents.get('retained', 0)}  "
+            f"classes={','.join(incidents.get('classes') or []) or '-'}"
+        )
+        i_rows = [
+            (
+                f"#{row.get('seq')}",
+                row.get("class", "?"),
+                f"{row.get('now', 0):,.1f}",
+                _fmt_bytes(row.get("bytes", 0)),
+                str(row.get("id", ""))[:12],
+            )
+            for row in (incidents.get("last") or [])[:4]
+        ]
+        if i_rows:
+            lines += fmt_table(
+                i_rows, header=("seq", "class", "now", "bundle", "id")
+            )
+
     slo = health.get("slo", {})
     lines.append("")
     if not slo.get("enabled"):
@@ -622,12 +654,13 @@ def main(argv=None) -> int:
         poller = UrlPoller(args.url)  # ONE connection across frames
 
         def frame() -> str:
-            health, counters, roofline, tenants, autopilot, fleet = (
-                poll_url(poller)
-            )
+            (
+                health, counters, roofline, tenants, autopilot, fleet,
+                incidents,
+            ) = poll_url(poller)
             return render(
                 health, counters, trajectory, roofline, tenants,
-                autopilot, fleet,
+                autopilot, fleet, incidents,
             )
 
         try:
@@ -668,12 +701,13 @@ def main(argv=None) -> int:
             progress["rnd"] += 1
 
     def frame() -> str:
-        health, counters, roofline, tenants, autopilot, fleet = (
-            poll_state(state)
-        )
+        (
+            health, counters, roofline, tenants, autopilot, fleet,
+            incidents,
+        ) = poll_state(state)
         return render(
             health, counters, trajectory, roofline, tenants, autopilot,
-            fleet,
+            fleet, incidents,
         )
 
     return watch_loop(

@@ -82,6 +82,10 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/fleet/metrics", "fleet_metrics", None),
     ("GET", "/fleet/slo", "fleet_slo", None),
     ("GET", "/fleet/trace/{trace_id}", "fleet_trace", None),
+    ("GET", "/fleet/incidents", "fleet_incidents", None),
+    ("GET", "/debug/incidents", "debug_incidents", None),
+    ("GET", "/incidents/{incident_id}", "get_incident", None),
+    ("GET", "/history/query", "history_query", None),
     ("GET", "/api/v1/stats", "stats", None),
     ("GET", "/api/v1/device/stats", "device_stats", None),
     ("POST", "/api/v1/sessions", "create_session", M.CreateSessionRequest),
@@ -131,10 +135,18 @@ _QUERY_PARAMS = {
     "trace_session": ("format",),
     "fleet_trace": ("format",),
     "serving_stream": ("frames", "interval"),
+    "history_query": ("series", "start", "end", "tier"),
 }
 
 #: Typed query params (everything else passes through as a string).
-_QUERY_COERCE = {"limit": int, "frames": int, "interval": float}
+_QUERY_COERCE = {
+    "limit": int,
+    "frames": int,
+    "interval": float,
+    "start": float,
+    "end": float,
+    "tier": int,
+}
 
 
 def _coerce_query(name: str, value: str):

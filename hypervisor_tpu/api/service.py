@@ -852,6 +852,51 @@ class HypervisorService:
             )
         return doc
 
+    async def debug_incidents(self) -> dict:
+        """`GET /debug/incidents`: the black-box recorder's index —
+        capture/suppress/evict totals, the classes currently retained,
+        and the newest bundle ids (identity fields only; the full
+        bundle is one `GET /incidents/{id}` away). Pre-r19 servers 404
+        this route — hv_top's incidents panel degrades to n/a."""
+        return self.hv.state.incidents_summary()
+
+    async def get_incident(self, incident_id: str) -> dict:
+        """`GET /incidents/{incident_id}`: ONE content-addressed
+        bundle — rule-input payload (the id hashes exactly this),
+        trigger, and the context riders (history window, bus slice,
+        trace fragment, ledger slice, WAL watermark + checkpoint id,
+        knob/SLO snapshot). Evicted or unknown ids are 404s."""
+        bundle = self.hv.state.incident_bundle(incident_id)
+        if bundle is None:
+            raise ApiError(404, f"incident {incident_id!r} not found")
+        return bundle
+
+    async def history_query(
+        self,
+        series: Optional[str] = None,
+        start: Optional[float] = None,
+        end: Optional[float] = None,
+        tier: Optional[int] = None,
+    ) -> dict:
+        """`GET /history/query`: the retained-telemetry plane on the
+        caller's clock. With `?series=` returns that series' points
+        for the requested window and tier (0 = raw, 1/2 = 10x/100x
+        downsampled aggregates); without, the plane summary + the
+        live tier-boundary conservation verdict."""
+        return self.hv.state.history_query(
+            series=series, start=start, end=end, tier=int(tier or 0)
+        )
+
+    async def fleet_incidents(self) -> dict:
+        """`GET /fleet/incidents`: every worker's incident index
+        (scraped over the keep-alive pool, worker-labeled) merged with
+        the observatory's own FLEET-scope bundles — the `fleet.
+        worker_dead` captures carrying the dead worker's last scraped
+        exposition + registry journal slice + stitched trace. Workers
+        that cannot answer (dead, or pre-r19) report `unreachable`,
+        not errors."""
+        return self._fleet_or_503().incidents_rollup()
+
     async def debug_profile(self, req: M.ProfileRequest) -> dict:
         """`POST /debug/profile`: an on-demand bounded `jax.profiler`
         capture window (TensorBoard/Perfetto trace into `log_dir`).

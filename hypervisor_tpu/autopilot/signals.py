@@ -19,9 +19,9 @@ timestamps, trace ids, and measured wave walls are deliberately ABSENT.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
-import json
 from typing import Optional
+
+from hypervisor_tpu.observability.snapshot import snapshot_digest
 
 #: Burn-state severity order (worst wins when folding per-tenant).
 _BURN_RANK = {"ok": 0, "warning": 1, "critical": 2}
@@ -75,15 +75,16 @@ class SignalSnapshot:
         """sha256 over the canonical encoding of the rule-input fields
         — the ledger's input-signal key. Identical snapshots =>
         identical digests; advisory wall-contaminated fields are
-        excluded (see `_ADVISORY_FIELDS`)."""
-        payload = dataclasses.asdict(self)
-        for k in self._ADVISORY_FIELDS:
-            payload.pop(k, None)
-        payload["now"] = round(self.now, 6)
-        if self.floor_distance is not None:
-            payload["floor_distance"] = round(self.floor_distance, 1)
-        blob = json.dumps(payload, sort_keys=True, default=list)
-        return hashlib.sha256(blob.encode()).hexdigest()
+        excluded (see `_ADVISORY_FIELDS`). Encoding + advisory pop
+        live in the ONE shared `observability.snapshot` helper; the
+        quantization hook below is this snapshot's own schema."""
+
+        def _quantize(payload: dict) -> None:
+            payload["now"] = round(self.now, 6)
+            if self.floor_distance is not None:
+                payload["floor_distance"] = round(self.floor_distance, 1)
+
+        return snapshot_digest(self, _quantize)
 
     # Convenience counter reads (rules use deltas between snapshots).
 

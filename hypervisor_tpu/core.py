@@ -224,6 +224,12 @@ class Hypervisor:
         # joins the event onto the stalled wave's spans.
         if self.event_bus is not None:
             self.state.health.add_listener(self._on_health_event)
+            # Incident bundles carry an event-bus slice; the bus lives
+            # on the facade (not the state), so its context provider
+            # registers here (`observability.incidents`).
+            self.state.incidents.register_provider(
+                "events", self._incident_events_block
+            )
 
         self._sessions: dict[str, ManagedSession] = {}
         # Keyed by Mesh (hashable): same mesh -> same runtime instance.
@@ -1816,6 +1822,12 @@ class Hypervisor:
             "fleet_worker_suspected": EventType.FLEET_WORKER_SUSPECTED,
             "fleet_worker_dead": EventType.FLEET_WORKER_DEAD,
             "fleet_worker_recovered": EventType.FLEET_WORKER_RECOVERED,
+            # Hindsight-plane lifecycle (`observability.incidents.
+            # IncidentRecorder`) rides the same fan-out; the taxonomy
+            # itself is the recursion guard (incident_* kinds never
+            # trigger a capture).
+            "incident_captured": EventType.INCIDENT_CAPTURED,
+            "incident_evicted": EventType.INCIDENT_EVICTED,
         }.get(kind)
         if event_type is None or self.event_bus is None:
             return
@@ -1826,6 +1838,18 @@ class Hypervisor:
                 payload=payload,
             )
         )
+
+    def _incident_events_block(self, trigger: dict) -> dict:
+        """The incident bundle's event-bus slice: the newest bus rows
+        at capture time (bounded — the bundle stays small)."""
+        if self.event_bus is None:
+            return {"enabled": False}
+        events = self.event_bus.query(limit=64)
+        return {
+            "enabled": True,
+            "count": len(events),
+            "events": [e.to_dict() for e in events],
+        }
 
     def _emit(
         self,

@@ -26,6 +26,7 @@ shard across worker processes (ROADMAP item 1), the fleet needs:
 from hypervisor_tpu.fleet.drain import (
     FleetObservatory,
     FleetSnapshot,
+    WorkerClient,
     merge_expositions,
     sample_series_count,
     worker_label_coverage,
@@ -51,6 +52,7 @@ __all__ = [
     "FleetSupervisor",
     "LeaseConfig",
     "LeaseTransition",
+    "WorkerClient",
     "WorkerSpec",
     "merge_expositions",
     "sample_series_count",

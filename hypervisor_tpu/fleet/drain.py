@@ -20,15 +20,15 @@ fleet-level autopilot later.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
+import http.client
 import json
 import re
 import time
-import urllib.error
-import urllib.request
+import urllib.parse
 from typing import Mapping, Optional
 
 from hypervisor_tpu.observability.metrics import escape_label_value
+from hypervisor_tpu.observability.snapshot import snapshot_digest
 
 #: Debug endpoints the fleet drain scrapes per worker, joined with
 #: `/metrics` into the merged exposition + snapshot rollups.
@@ -140,17 +140,17 @@ class FleetSnapshot:
 
     def digest(self) -> str:
         """sha256 over the canonical encoding of the rule-input fields
-        (sorted keys, quantized floats, advisories popped)."""
-        payload = dataclasses.asdict(self)
-        for k in self._ADVISORY_FIELDS:
-            payload.pop(k, None)
-        payload["now"] = round(self.now, 6)
-        payload["floor_distance"] = [
-            (w, None if d is None else round(float(d), 1))
-            for w, d in self.floor_distance
-        ]
-        blob = json.dumps(payload, sort_keys=True, default=list)
-        return hashlib.sha256(blob.encode()).hexdigest()
+        (sorted keys, quantized floats, advisories popped) — encoding
+        via the ONE shared `observability.snapshot` helper."""
+
+        def _quantize(payload: dict) -> None:
+            payload["now"] = round(self.now, 6)
+            payload["floor_distance"] = [
+                (w, None if d is None else round(float(d), 1))
+                for w, d in self.floor_distance
+            ]
+
+        return snapshot_digest(self, _quantize)
 
     def totals(self) -> dict:
         return {
@@ -161,15 +161,96 @@ class FleetSnapshot:
         }
 
 
-# ── per-worker scraping ──────────────────────────────────────────────
+# ── per-worker scraping (keep-alive) ─────────────────────────────────
+
+
+class WorkerClient:
+    """ONE reused HTTP connection per worker, across scrape planes AND
+    drain rounds — the `hv_top.UrlPoller` precedent lifted into the
+    supervisor's scraper. Before round 19 every plane of every round
+    was its own `urllib.request.urlopen` (TCP handshake per endpoint
+    per cycle: 6 redials per worker per drain). Both transports have
+    served HTTP/1.1 keep-alive since r18; against an HTTP/1.0 server
+    `will_close` drops the socket and the next request transparently
+    redials."""
+
+    def __init__(self, base_url: str, timeout_s: float = 5.0) -> None:
+        if "://" not in base_url:
+            base_url = "http://" + base_url
+        u = urllib.parse.urlsplit(base_url.rstrip("/"))
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or (443 if u.scheme == "https" else 80)
+        self.timeout_s = float(timeout_s)
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
+
+    def get(self, path: str) -> tuple[int, bytes]:
+        """GET over the reused connection; one reconnect retry covers
+        a server that dropped the idle socket between rounds."""
+        for attempt in (0, 1):
+            try:
+                if self._conn is None:
+                    self._conn = http.client.HTTPConnection(
+                        self.host, self.port, timeout=self.timeout_s
+                    )
+                self._conn.request("GET", path)
+                resp = self._conn.getresponse()
+                body = resp.read()
+                if resp.will_close:
+                    self.close()
+                return resp.status, body
+            except (OSError, http.client.HTTPException):
+                self.close()
+                if attempt:
+                    raise
+        raise OSError("unreachable")  # pragma: no cover
+
+    def get_text(self, path: str) -> Optional[str]:
+        try:
+            status, body = self.get(path)
+        except (OSError, http.client.HTTPException):
+            return None
+        if status != 200:
+            return None
+        return body.decode("utf-8", "replace")
+
+    def get_json(self, path: str) -> Optional[dict]:
+        raw = self.get_text(path)
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            return None
+
+
+def _split_url(url: str) -> tuple[str, str]:
+    """(base, path) of one absolute URL — the compat-shim splitter."""
+    if "://" not in url:
+        url = "http://" + url
+    u = urllib.parse.urlsplit(url)
+    base = f"{u.scheme}://{u.netloc}"
+    path = u.path or "/"
+    if u.query:
+        path += "?" + u.query
+    return base, path
 
 
 def fetch_text(url: str, timeout_s: float = 5.0) -> Optional[str]:
+    """One-shot fetch (throwaway connection) — kept for callers
+    outside the observatory's keep-alive pool."""
+    base, path = _split_url(url)
+    client = WorkerClient(base, timeout_s)
     try:
-        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
-            return resp.read().decode("utf-8", "replace")
-    except (urllib.error.URLError, OSError, ValueError):
-        return None
+        return client.get_text(path)
+    finally:
+        client.close()
 
 
 def fetch_json(url: str, timeout_s: float = 5.0) -> Optional[dict]:
@@ -222,6 +303,44 @@ class FleetObservatory:
         self._seq = 0
         self.last_snapshot: Optional[FleetSnapshot] = None
         self.last_merged: Optional[str] = None
+        #: ONE keep-alive connection per worker, reused across scrape
+        #: planes and drain rounds (`WorkerClient`).
+        self._clients: dict[str, WorkerClient] = {}
+        #: Last successfully scraped exposition per worker — retained
+        #: across rounds so a `fleet.worker_dead` incident can bundle
+        #: what the worker looked like BEFORE it stopped answering.
+        self.last_expositions: dict[str, str] = {}
+        #: Supervisor-side black-box recorder (FLEET scope): captures
+        #: on new DEAD lease transitions after each drain. Timestamps
+        #: come from the transition's caller clock, so a seeded kill
+        #: drill replays to a bit-identical incident digest (gate 6l).
+        from hypervisor_tpu.observability.incidents import IncidentRecorder
+
+        self.incidents = IncidentRecorder(metrics=metrics, scope="fleet")
+        self.incidents.register_provider(
+            "exposition", self._incident_exposition_block
+        )
+        self.incidents.register_provider(
+            "registry", self._incident_registry_block
+        )
+        self.incidents.register_provider(
+            "trace", self._incident_trace_block
+        )
+        #: Transition seqs already examined for capture (the DEAD scan
+        #: is incremental; replaying the registry does not re-capture).
+        self._transition_cursor = 0
+
+    def _client(self, worker: str) -> WorkerClient:
+        client = self._clients.get(worker)
+        if client is None:
+            client = WorkerClient(self.workers[worker], self.timeout_s)
+            self._clients[worker] = client
+        return client
+
+    def close(self) -> None:
+        for client in self._clients.values():
+            client.close()
+        self._clients.clear()
 
     # ── the merged drain ─────────────────────────────────────────────
 
@@ -237,15 +356,17 @@ class FleetObservatory:
         expositions: dict[str, str] = {}
         payloads: dict[str, dict] = {}
         errors: list[tuple] = []
-        for worker, base in sorted(self.workers.items()):
-            text = fetch_text(f"{base}/metrics", self.timeout_s)
+        for worker in sorted(self.workers):
+            client = self._client(worker)
+            text = client.get_text("/metrics")
             if text is None:
                 errors.append((worker, "metrics"))
             else:
                 expositions[worker] = text
+                self.last_expositions[worker] = text
             per = {}
             for ep in DEBUG_ENDPOINTS:
-                doc = fetch_json(f"{base}/debug/{ep}", self.timeout_s)
+                doc = client.get_json(f"/debug/{ep}")
                 if doc is None:
                     errors.append((worker, ep))
                 else:
@@ -259,7 +380,72 @@ class FleetObservatory:
         self.last_snapshot = snap
         self.last_merged = merged
         self._publish(snap, errors)
+        self._capture_dead_transitions()
         return merged, snap
+
+    # ── fleet incident capture (the worker_dead black box) ───────────
+
+    def _capture_dead_transitions(self) -> None:
+        """Scan the lease plane's transition log past the cursor and
+        capture ONE fleet-scope incident per new DEAD declaration.
+        Rule inputs (worker, lease seq, transition `now`) all come
+        from the replay-deterministic transition itself, so the same
+        seeded kill drill replays to a bit-identical incident id."""
+        if self.registry is None:
+            return
+        transitions = self.registry.transitions
+        for tr in transitions[self._transition_cursor:]:
+            if tr.new == "dead":
+                self.incidents.observe(
+                    "fleet_worker_dead",
+                    {
+                        "worker": tr.worker,
+                        "lease_seq": tr.seq,
+                        "from": tr.old,
+                        "to": tr.new,
+                        "now": round(float(tr.now), 6),
+                        "replay_key": tr.replay_key(),
+                    },
+                )
+        self._transition_cursor = len(transitions)
+
+    def _incident_exposition_block(self, trigger: dict) -> dict:
+        """The dead worker's LAST successfully scraped exposition —
+        what it looked like before it stopped answering."""
+        worker = trigger.get("worker")
+        text = self.last_expositions.get(worker)
+        return {
+            "worker": worker,
+            "series": (
+                sample_series_count(text) if text is not None else 0
+            ),
+            "metrics": text,
+        }
+
+    def _incident_registry_block(self, trigger: dict) -> dict:
+        """The lease plane's journal slice + replay digest around the
+        transition that triggered capture."""
+        if self.registry is None:
+            return {"enabled": False}
+        out = self.registry.summary(tail=16)
+        out["enabled"] = True
+        out["observations_tail"] = [
+            list(o) for o in self.registry.observations[-32:]
+        ]
+        return out
+
+    def _incident_trace_block(self, trigger: dict) -> dict:
+        """Stitched fleet trace for the trigger's causal trace id (a
+        synthetic per-incident id when the trigger carries none) — the
+        `fleet.missing` block names the dead worker's absent lane."""
+        from hypervisor_tpu.fleet.trace import stitch_fleet_trace
+
+        trace_id = trigger.get("trace_id") or (
+            f"fleet-dead-{trigger.get('worker')}-{trigger.get('lease_seq')}"
+        )
+        return stitch_fleet_trace(
+            self.workers, trace_id, timeout_s=min(self.timeout_s, 2.0)
+        )
 
     def _fold(
         self, now, expositions, payloads, merged, errors, scrape_wall_ms
@@ -376,6 +562,10 @@ class FleetObservatory:
             "snapshot_digest": snap.digest(),
             "scrape_wall_ms": snap.scrape_wall_ms,
             "errors": [list(e) for e in snap.errors],
+            "incidents": {
+                "captured": self.incidents.captured_total,
+                "retained": len(self.incidents._ring),
+            },
         }
         if self.registry is not None:
             out["registry"] = self.registry.summary()
@@ -386,8 +576,8 @@ class FleetObservatory:
         the fleet worst-burn fold."""
         per_worker = {}
         worst = None
-        for worker, base in sorted(self.workers.items()):
-            doc = fetch_json(f"{base}/debug/slo", self.timeout_s)
+        for worker in sorted(self.workers):
+            doc = self._client(worker).get_json("/debug/slo")
             per_worker[worker] = doc if doc is not None else {
                 "enabled": False, "unreachable": True,
             }
@@ -406,11 +596,42 @@ class FleetObservatory:
             ),
         }
 
+    def incidents_rollup(self) -> dict:
+        """The `/fleet/incidents` payload: every worker's own incident
+        index (worker-labeled, over the keep-alive pool) merged with
+        the supervisor's FLEET-scope captures. A pre-r19 worker (404
+        on `/debug/incidents`) reports `enabled: False` — the hv_top
+        degrade discipline, one level down."""
+        per_worker: dict[str, dict] = {}
+        merged: list[dict] = []
+        for worker in sorted(self.workers):
+            doc = self._client(worker).get_json("/debug/incidents")
+            if doc is None:
+                per_worker[worker] = {
+                    "enabled": False, "unreachable": True,
+                }
+                continue
+            per_worker[worker] = doc
+            for row in doc.get("last") or []:
+                merged.append({**row, "worker": worker})
+        fleet_rows = [
+            {**row, "worker": None}
+            for row in self.incidents.index()
+        ]
+        merged.sort(key=lambda r: (-float(r.get("now") or 0.0), r["id"]))
+        return {
+            "fleet": self.incidents.summary(),
+            "fleet_incidents": fleet_rows,
+            "workers": per_worker,
+            "merged": fleet_rows + merged,
+        }
+
 
 __all__ = [
     "DEBUG_ENDPOINTS",
     "FleetObservatory",
     "FleetSnapshot",
+    "WorkerClient",
     "fetch_json",
     "fetch_text",
     "merge_expositions",

@@ -133,6 +133,15 @@ class EventType(str, enum.Enum):
     FLEET_WORKER_DEAD = "fleet.worker_dead"
     FLEET_WORKER_RECOVERED = "fleet.worker_recovered"
 
+    # Hindsight plane (append-only, like every block above): the
+    # black-box recorder's lifecycle (`observability.incidents.
+    # IncidentRecorder`), facade-bridged from the health fan-out like
+    # the planes above. CAPTURED carries the content-addressed incident
+    # id (sha256 over rule-input fields only) + class + trigger kind;
+    # EVICTED is the bounded retention ring counting its losses loudly.
+    INCIDENT_CAPTURED = "incident.captured"
+    INCIDENT_EVICTED = "incident.evicted"
+
     @property
     def code(self) -> int:
         """int32 column code for the device event log."""

@@ -758,6 +758,47 @@ FLEET_SCRAPE_ERRORS = REGISTRY.counter(
     "(a dead worker's series drop out; the fetch error lands here)",
 )
 
+# ── hindsight plane (retained history + incidents, round 19) ─────────
+# HOST-owned rows — APPENDED at the registry tail (hvlint HVA004).
+# The history trio are GAUGES set to the plane's absolute totals: the
+# plane samples the drain ITSELF, so per-drain counter increments here
+# would make a quiet scrape mutate scrape-visible counters (the
+# drain-idempotence contract `test_double_drain_is_idempotent...`
+# pins). The incident rows stay counters — they move on health-plane
+# events, never on a drain.
+HISTORY_SAMPLES = REGISTRY.gauge(
+    "hv_history_samples",
+    "metrics-drain samples appended into the tiered history rings "
+    "(absolute plane total)",
+)
+HISTORY_EVICTIONS = REGISTRY.gauge(
+    "hv_history_evictions",
+    "history points evicted from any tier's retention ring (the fixed "
+    "HV_HISTORY_* memory budget counting its losses loudly; absolute "
+    "plane total)",
+)
+HISTORY_POINTS_RETAINED = REGISTRY.gauge(
+    "hv_history_points_retained",
+    "points currently retained across every series and tier",
+)
+INCIDENTS_CAPTURED = REGISTRY.counter(
+    "hv_incidents_captured_total",
+    "black-box incident bundles captured by the trigger taxonomy",
+)
+INCIDENTS_SUPPRESSED = REGISTRY.counter(
+    "hv_incidents_suppressed_total",
+    "triggers swallowed by per-class cooldown/dedup (the taxonomy "
+    "fired; no new bundle was due)",
+)
+INCIDENTS_EVICTED = REGISTRY.counter(
+    "hv_incidents_evicted_total",
+    "incident bundles evicted from the bounded retention ring",
+)
+INCIDENTS_RETAINED = REGISTRY.gauge(
+    "hv_incidents_retained",
+    "incident bundles currently held in the retention ring",
+)
+
 
 # ── host object: device table + host mirror + drain ──────────────────
 

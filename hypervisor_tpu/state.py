@@ -34,6 +34,8 @@ from hypervisor_tpu.config import DEFAULT_CONFIG, HypervisorConfig
 from hypervisor_tpu.models import SessionConfig, SessionState
 from hypervisor_tpu.observability import profiling
 from hypervisor_tpu.observability import health as health_plane
+from hypervisor_tpu.observability import history as history_plane
+from hypervisor_tpu.observability import incidents as incidents_plane
 from hypervisor_tpu.observability import metrics as metrics_plane
 from hypervisor_tpu.observability import roofline as roofline_plane
 from hypervisor_tpu.observability import tracing as trace_plane
@@ -557,6 +559,35 @@ class HypervisorState:
         # global (like the compile log); each deployment drains its own
         # view of the shift-event ring at its own metrics drain.
         self._roofline_event_seq = 0
+        # Hindsight plane (round 19): tiered retained history fed from
+        # the ONE metrics drain (zero extra device_get) + the black-box
+        # incident recorder listening on the same health fan-out every
+        # plane bridges through. `hindsight_clock` is the caller's-
+        # clock override — a virtual-clock soak sets it (callable ->
+        # float) so history timestamps, incident windows, and their
+        # digests replay bit-identically; None = wall (`self.now`).
+        self.hindsight_clock = None
+        self.history = history_plane.HistoryPlane(metrics=self.metrics)
+        self.incidents = incidents_plane.IncidentRecorder(
+            history=self.history,
+            metrics=self.metrics,
+            clock=self._hindsight_now,
+        )
+        self.incidents.emit = self.health.emit_event
+        self.health.add_listener(self.incidents.observe)
+        # Context providers: each attaches one bundle block lazily (the
+        # planes they read opt in later; a missing plane contributes
+        # its bare `enabled: False` shape, never an error).
+        self.incidents.register_provider("wal", self._incident_wal_block)
+        self.incidents.register_provider(
+            "ledger", lambda trigger: self.autopilot_summary()
+        )
+        self.incidents.register_provider(
+            "slo", lambda trigger: self.slo_summary()
+        )
+        self.incidents.register_provider(
+            "trace", self._incident_trace_block
+        )
 
         self.agent_ids = InternTable()
         self.session_ids = InternTable()
@@ -3734,6 +3765,12 @@ class HypervisorState:
         # an explicit sanitize()) walks the repair/restore ladder.
         if self.integrity is not None:
             self.integrity.observe_snapshot(snap)
+        # Hindsight plane: feed the declared series set out of THIS
+        # drain's snapshot into the tiered history rings — a host-side
+        # dict walk over already-fetched rows, zero extra device_get
+        # on the clean path (the `incident_capture` BENCH row gates
+        # the overhead).
+        self.history.sample_snapshot(snap, now=self._hindsight_now())
         return snap
 
     def metrics_prometheus(self) -> str:
@@ -3807,6 +3844,15 @@ class HypervisorState:
             # states per class + critical-path decomposition quantiles
             # — host-plane only, no extra device work in this drain.
             "slo": self.slo_summary(),
+            # Hindsight panel (hv_top renders this block): black-box
+            # capture/suppress/evict accounting + the retained-history
+            # footprint — host-plane only, like the blocks above.
+            "incidents": self.incidents.summary(),
+            "history": {
+                "samples": self.history.samples_total,
+                "evictions": self.history.evictions_total,
+                "points_retained": self.history.points_retained(),
+            },
         }
 
     def memory_summary(self) -> dict:
@@ -3899,6 +3945,84 @@ class HypervisorState:
         if self.autopilot is not None:
             return self.autopilot.summary()
         return {"enabled": False}
+
+    # ── hindsight plane (retained history + incidents) ───────────────
+
+    def _hindsight_now(self) -> float:
+        """History/incident timestamps: the virtual-clock override
+        when a soak set one, wall (`now()`) otherwise."""
+        if self.hindsight_clock is not None:
+            return float(self.hindsight_clock())
+        return self.now()
+
+    def _incident_wal_block(self, trigger: dict) -> dict:
+        """The bundle's recovery pointer: WAL watermark + the last
+        checkpoint id — what a postmortem replays FROM."""
+        journal = self.journal
+        sup = self.resilience
+        ckpt = (
+            getattr(sup, "last_checkpoint", None)
+            if sup is not None
+            else None
+        )
+        return {
+            "wal_seq": (
+                getattr(journal, "last_seq", None)
+                if journal is not None
+                else None
+            ),
+            "restored_wal_seq": self._restored_wal_seq,
+            "checkpoint": (
+                # "at" is wall clock — advisory, and the bundle's
+                # context rides outside the incident id anyway; keep
+                # the pointer fields postmortems actually replay from.
+                {
+                    "path": ckpt.get("path"),
+                    "step": ckpt.get("step"),
+                    "wal_seq": ckpt.get("wal_seq"),
+                }
+                if ckpt
+                else None
+            ),
+        }
+
+    def _incident_trace_block(self, trigger: dict) -> dict:
+        """The bundle's trace fragment: the trigger's causal trace id
+        plus the flight recorder's recent-wave summary (the stitched
+        fleet timeline joins on the same trace ids supervisor-side)."""
+        return {
+            "trace_id": trigger.get("trace_id"),
+            "flight": self.flight_summary(),
+        }
+
+    def incidents_summary(self) -> dict:
+        """The `GET /debug/incidents` payload."""
+        return self.incidents.summary()
+
+    def incident_bundle(self, incident_id: str) -> Optional[dict]:
+        """One captured bundle by content address (None = unknown)."""
+        return self.incidents.get(incident_id)
+
+    def history_query(
+        self,
+        series: Optional[str] = None,
+        start: Optional[float] = None,
+        end: Optional[float] = None,
+        tier: int = 0,
+    ) -> dict:
+        """The `GET /history/query` payload: without `series`, the
+        plane summary (+ the conservation witness); with one, the
+        retained points of that series/tier clipped to [start, end]
+        on the caller's clock."""
+        if series is None:
+            out = self.history.summary()
+            out["conservation"] = self.history.verify_conservation()["ok"]
+            return out
+        return {
+            "series": series,
+            "tier": int(tier),
+            "points": self.history.query(series, start, end, int(tier)),
+        }
 
     def integrity_summary(self) -> dict:
         """The `GET /debug/integrity` payload: sanitizer cadence,

@@ -1046,6 +1046,111 @@ print(
 PY
 fleet_rc=$?
 
+echo "── hindsight-plane gate (6l) ──"
+# Round 19 (ISSUE 19): the black-box recorder + retained history.
+# A seeded worker-kill drill (lease registry driven on a virtual
+# clock, no subprocesses — 6k already proves the real kill) must
+# capture a FLEET-scope `fleet.worker_dead` incident whose digest
+# replays bit-identically across two runs of the same journal, every
+# id must verify its own content address, and the history plane fed
+# by live governance drains must conserve min/max/count across the
+# tier folds AND agree with the live exposition's counter values.
+JAX_PLATFORMS=cpu python - <<'PY'
+from hypervisor_tpu.fleet import FleetObservatory, FleetRegistry
+
+
+def kill_drill() -> tuple:
+    reg = FleetRegistry(seed=19)
+    obs = FleetObservatory(
+        {"w0": "http://127.0.0.1:1", "w1": "http://127.0.0.1:2"},
+        registry=reg, timeout_s=0.1,
+    )
+    for w in ("w0", "w1"):
+        reg.register(w, now=0.0)
+    for t in (1.0, 2.0, 3.0):
+        for w in ("w0", "w1"):
+            reg.heartbeat(w, now=t)
+    # w1 is killed after t=3; w0 keeps beating through the windows.
+    for t in (4.0, 8.0, 16.0, 32.0, 64.0, 128.0):
+        reg.heartbeat("w0", now=t)
+        reg.evaluate(now=t)
+    obs._capture_dead_transitions()
+    rows = obs.incidents.index()
+    assert any(r["class"] == "fleet.worker_dead" for r in rows), rows
+    assert all(obs.incidents.replay_check(r["id"]) for r in rows), (
+        "an incident id failed its own content-address recompute"
+    )
+    dead = next(r for r in rows if r["class"] == "fleet.worker_dead")
+    bundle = obs.incidents.get(dead["id"])
+    assert bundle["trigger"]["worker"] == "w1", bundle["trigger"]
+    for block in ("exposition", "registry", "trace"):
+        assert block in bundle["context"], sorted(bundle["context"])
+    return tuple(r["id"] for r in rows)
+
+
+ids1 = kill_drill()
+ids2 = kill_drill()
+assert ids1 == ids2, (
+    "fleet incident digests NOT bit-identical across two replays of "
+    f"the same seeded kill drill:\n  {ids1}\n  {ids2}"
+)
+
+# History window conservation against the live exposition: drive real
+# governance drains on a virtual clock, then the retained last sample
+# must equal the counter the exposition reports NOW, and the tier
+# folds must conserve min/max/count/sum.
+import numpy as np
+
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.state import HypervisorState
+
+st = HypervisorState()
+vnow = {"t": 1000.0}
+st.hindsight_clock = lambda: vnow["t"]
+lanes = 8
+for r in range(3):
+    slots = st.create_sessions_batch(
+        [f"6l:{r}:{i}" for i in range(lanes)],
+        SessionConfig(min_sigma_eff=0.0),
+    )
+    st.run_governance_wave(
+        slots, [f"did:6l:{r}:{i}" for i in range(lanes)],
+        slots.copy(), np.full(lanes, 0.8, np.float32),
+        np.zeros((1, lanes, 16), np.uint32), now=float(r),
+    )
+    vnow["t"] += 10.0
+    st.metrics_snapshot()
+cons = st.history.verify_conservation()
+assert cons["ok"], {
+    k: v for k, v in cons["series"].items() if not v["ok"]
+}
+exposition = st.metrics_prometheus()
+live = {}
+for line in exposition.splitlines():
+    if line and not line.startswith("#"):
+        name, _, value = line.partition(" ")
+        live[name.partition("{")[0]] = float(value)
+checked = 0
+for series in st.history.series:
+    pts = st.history.query(series, start=0.0, end=vnow["t"], tier=0)
+    if not pts or series not in live:
+        continue
+    assert pts[-1]["value"] == live[series], (
+        f"{series}: retained last {pts[-1]['value']} != "
+        f"live exposition {live[series]}"
+    )
+    checked += 1
+assert checked >= 4, f"only {checked} series cross-checked"
+win = st.history.window(vnow["t"], before=120.0, after=0.0)
+assert any(w["0"] for w in win["series"].values()), win
+print(
+    f"hindsight gate OK: {len(ids1)} fleet incident(s) bit-identical "
+    f"over 2 drill replays, history conserved across tier folds, "
+    f"{checked} series agree with the live exposition"
+)
+PY
+incident_rc=$?
+
 echo "── hvlint static-analysis gate ──"
 # The contract analyzer (ISSUE 12): Tier A pure-AST rules (WAL
 # coverage, env arming, lock discipline, append-only registries, twin
@@ -1131,6 +1236,10 @@ fi
 if [ "$fleet_rc" -ne 0 ]; then
     echo "fleet-observatory gate FAILED (rc=$fleet_rc)" >&2
     exit "$fleet_rc"
+fi
+if [ "$incident_rc" -ne 0 ]; then
+    echo "hindsight-plane gate FAILED (rc=$incident_rc)" >&2
+    exit "$incident_rc"
 fi
 if [ "$hvlint_rc" -ne 0 ]; then
     echo "hvlint static-analysis gate FAILED (rc=$hvlint_rc)" >&2

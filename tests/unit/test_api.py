@@ -193,9 +193,17 @@ class TestHTTPTransport:
         # observatory (/debug/roofline + POST /debug/profile), and the
         # tenant-dense panel (/debug/tenants), and the autopilot
         # decision plane (/debug/autopilot), and the fleet observatory
-        # (/debug/fleet + /fleet/{workers,metrics,slo,trace/{id}}):
-        # 51 routes.
-        assert len(ROUTES) == 51
+        # (/debug/fleet + /fleet/{workers,metrics,slo,trace/{id}}),
+        # and the hindsight plane (/debug/incidents,
+        # /incidents/{incident_id}, /history/query, /fleet/incidents):
+        # 55 routes.
+        assert len(ROUTES) == 55
+        assert any(path == "/debug/incidents" for _, path, _, _ in ROUTES)
+        assert any(path == "/history/query" for _, path, _, _ in ROUTES)
+        assert any(path == "/fleet/incidents" for _, path, _, _ in ROUTES)
+        assert any(
+            path == "/incidents/{incident_id}" for _, path, _, _ in ROUTES
+        )
         assert any(path == "/debug/fleet" for _, path, _, _ in ROUTES)
         assert any(path == "/fleet/metrics" for _, path, _, _ in ROUTES)
         assert any(

@@ -838,6 +838,41 @@ def _suite_report(
             if round_no >= 18
             else None
         ),
+        # Rounds >= regression.INCIDENT_ROW_SINCE must carry the
+        # hindsight-plane row (round-19 presence gate, ISSUE 19); the
+        # clean-path snapshot overhead is band-gated, the incident-id
+        # and history-digest replays must be bit-identical (AND the
+        # content-address replay_check must hold), tier-fold
+        # conservation is hard-gated, and post-warmup recompiles are
+        # hard-gated to zero.
+        "incident_capture": (
+            {
+                "seed": 19,
+                "quick": quick,
+                "snapshot_p50_us": {
+                    "history_off": 30.0, "history_on": 34.0,
+                },
+                "clean_path_overhead_pct": 4.3,
+                "triggers_fired": 6,
+                "captured": 6,
+                "capture_wall_us": {"n": 6, "p50": 180.0, "max": 400.0},
+                "bundle_bytes": {"p50": 9000, "max": 14000},
+                "replays": 2,
+                "incident_digest_match": True,
+                "history_digest_match": True,
+                "digest_match": True,
+                "replay_check_ok": True,
+                "history": {
+                    "samples": 600,
+                    "evictions": 0,
+                    "points_retained": 1200,
+                    "conservation": True,
+                },
+                "recompiles_after_warmup": 0,
+            }
+            if round_no >= 19
+            else None
+        ),
     }
 
 
@@ -1303,6 +1338,64 @@ class TestRegressionHarness:
             ) == 0
         finally:
             del os.environ["HV_BENCH_FLEET_DETECT"]
+
+    def test_missing_incident_row_fails_from_round_19(self, tmp_path):
+        # ISSUE 19: the incident_capture row is REQUIRED from round 19
+        # — dropping the hindsight plane's bench coverage is a
+        # regression.
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 18, _suite_report(18, {"full_governance_pipeline": 10.0})
+        )
+        doc = _suite_report(19, {"full_governance_pipeline": 10.0})
+        doc["incident_capture"] = None
+        self._write(tmp_path, 19, doc)
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # A round carrying the row passes, and the trajectory keeps it.
+        self._write(
+            tmp_path, 19,
+            _suite_report(19, {"full_governance_pipeline": 10.0}),
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
+        rows = regression.load_history(tmp_path)
+        inc = rows[-1]["incident_capture"]
+        assert inc["captured"] == 6
+        assert inc["digest_match"] is True
+        assert inc["history"]["conservation"] is True
+
+    def test_incident_gates_band_and_hard_contracts(self, tmp_path):
+        # The ISSUE 19 acceptance bars: clean-path snapshot overhead
+        # inside the band (HV_BENCH_INCIDENT_OVERHEAD overrides),
+        # incident-id digest bit-identity AND content-address
+        # replay_check, history tier-fold conservation, and hard-zero
+        # post-warmup recompiles.
+        import os
+
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 18, _suite_report(18, {"full_governance_pipeline": 10.0})
+        )
+
+        def check(**overrides) -> int:
+            doc = _suite_report(19, {"full_governance_pipeline": 10.0})
+            doc["incident_capture"].update(overrides)
+            self._write(tmp_path, 19, doc)
+            return regression.main(["--root", str(tmp_path), "--quiet"])
+
+        assert check() == 0
+        assert check(clean_path_overhead_pct=40.0) == 1  # over the band
+        assert check(digest_match=False) == 1         # replay drifted
+        assert check(replay_check_ok=False) == 1      # address tampered
+        assert check(history={"conservation": False}) == 1  # fold lost mass
+        assert check(recompiles_after_warmup=2) == 1  # host plane compiled
+        # The env knob widens the overhead band (read per gate run).
+        os.environ["HV_BENCH_INCIDENT_OVERHEAD"] = "50.0"
+        try:
+            assert check(clean_path_overhead_pct=40.0) == 0
+        finally:
+            del os.environ["HV_BENCH_INCIDENT_OVERHEAD"]
 
     def test_next_round_path_advances(self, tmp_path):
         from benchmarks import regression

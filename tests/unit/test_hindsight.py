@@ -1,0 +1,566 @@
+"""The hindsight plane (round 19): retained telemetry history +
+black-box incident recorder, local and fleet-wide.
+
+Covers the tiered history rings (fold conservation across tier
+boundaries, eviction accounting, caller's-clock queries, digest
+replay), the incident recorder (trigger taxonomy, cooldown/dedup,
+advisory exclusion, bounded retention, same-seed drill bit-identity),
+the shared snapshot-digest helper's stability against the pre-refactor
+inline algorithms (the satellite-1 fixtures), the state/core wiring
+(health fan-out -> capture -> bus event), both REST transports, and
+the hv_top incidents panel.
+"""
+
+import dataclasses
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu.observability.history import (
+    DEFAULT_SERIES,
+    HistoryConfig,
+    HistoryPlane,
+    _fold_aggs,
+)
+from hypervisor_tpu.observability.incidents import (
+    ADVISORY_PAYLOAD_KEYS,
+    IncidentConfig,
+    IncidentRecorder,
+    TRIGGER_TAXONOMY,
+    incident_rule_payload,
+)
+from hypervisor_tpu.observability.snapshot import (
+    canonical_blob,
+    rule_digest,
+)
+
+
+def feed(plane: HistoryPlane, n: int, seed: int = 7, t0: float = 0.0):
+    """Seeded deterministic sample feed on a virtual clock."""
+    rng = np.random.default_rng(seed)
+    t = t0
+    for _ in range(n):
+        t += 1.0
+        plane.sample(
+            {name: float(rng.integers(0, 1000)) for name in plane.series},
+            now=t,
+        )
+    return t
+
+
+# ── 1. tiered history: fold conservation + eviction accounting ───────
+
+
+class TestHistoryTiers:
+    def test_tier_folds_conserve_min_max_count_sum(self, monkeypatch):
+        # Tight knobs force every ring past its budget, so the
+        # conservation witness covers the eviction path too: each
+        # sample must live in exactly one stratum (acc1 | acc2 |
+        # tier-2 ring | folded-out mass).
+        monkeypatch.setenv("HV_HISTORY_RAW_POINTS", "16")
+        monkeypatch.setenv("HV_HISTORY_TIER_POINTS", "8")
+        monkeypatch.setenv("HV_HISTORY_FOLD", "4")
+        plane = HistoryPlane(series=("a", "b"))
+        feed(plane, 500)
+        assert plane.evictions_total > 0
+        report = plane.verify_conservation()
+        assert report["ok"], report
+        assert report["retained_ok"]
+        for name in ("a", "b"):
+            assert report["series"][name]["count"] == 500
+
+    def test_tier_boundary_aggregates(self, monkeypatch):
+        # Hand-checkable fold: 4 raw points -> one tier-1 point
+        # carrying exact min/max/count/sum/last.
+        monkeypatch.setenv("HV_HISTORY_FOLD", "4")
+        plane = HistoryPlane(series=("x",))
+        for i, v in enumerate((3.0, 9.0, 1.0, 5.0)):
+            plane.sample({"x": v}, now=float(i + 1))
+        [agg] = plane.query("x", tier=1)
+        assert agg["count"] == 4
+        assert agg["min"] == 1.0 and agg["max"] == 9.0
+        assert agg["mean"] == pytest.approx(4.5)
+        assert agg["last"] == 5.0
+        assert (agg["t_start"], agg["t_end"]) == (1.0, 4.0)
+
+    def test_points_retained_counter_matches_recount(self, monkeypatch):
+        monkeypatch.setenv("HV_HISTORY_RAW_POINTS", "10")
+        monkeypatch.setenv("HV_HISTORY_TIER_POINTS", "10")
+        monkeypatch.setenv("HV_HISTORY_FOLD", "3")
+        plane = HistoryPlane(series=("a",))
+        feed(plane, 333)
+        h = plane._hist["a"]
+        recount = len(h.raw) + len(h.tiers[0]) + len(h.tiers[1])
+        assert plane.points_retained() == recount
+        assert plane.verify_conservation()["retained_ok"]
+
+    def test_query_on_callers_clock(self):
+        plane = HistoryPlane(series=("a",))
+        feed(plane, 50, t0=100.0)  # samples at t=101..150
+        pts = plane.query("a", start=120.0, end=130.0, tier=0)
+        assert [p["t"] for p in pts] == [float(t) for t in range(120, 131)]
+        assert plane.query("a", start=9999.0) == []
+        assert plane.query("missing") == []
+        newest = plane.query("a", tier=0, limit=5)
+        assert len(newest) == 5 and newest[-1]["t"] == 150.0
+
+    def test_window_bounded_per_tier(self, monkeypatch):
+        monkeypatch.setenv("HV_HISTORY_FOLD", "2")
+        plane = HistoryPlane(series=("a", "b"))
+        feed(plane, 200)
+        win = plane.window(200.0, before=200.0, after=0.0,
+                           limit_per_tier=8)
+        assert win["start"] == 0.0 and win["end"] == 200.0
+        for name in ("a", "b"):
+            tiers = win["series"][name]
+            assert set(tiers) == {"0", "1", "2"}
+            assert all(len(pts) <= 8 for pts in tiers.values())
+            assert tiers["0"]  # raw points present
+
+    def test_digest_bit_identical_across_same_seed_replays(self):
+        p1, p2 = HistoryPlane(), HistoryPlane()
+        feed(p1, 300, seed=19)
+        feed(p2, 300, seed=19)
+        assert p1.digest() == p2.digest()
+        p3 = HistoryPlane()
+        feed(p3, 300, seed=20)
+        assert p3.digest() != p1.digest()
+
+    def test_budget_knobs_read_per_call(self, monkeypatch):
+        # HVA002: a knob change applies to the NEXT sample, no
+        # restart — the ring shrinks immediately and counts the
+        # evictions it forces.
+        plane = HistoryPlane(series=("a",))
+        feed(plane, 100)
+        assert len(plane._hist["a"].raw) == 100
+        monkeypatch.setenv("HV_HISTORY_RAW_POINTS", "8")
+        plane.sample({"a": 1.0}, now=1000.0)
+        assert len(plane._hist["a"].raw) == 8
+        assert plane.evictions_total >= 93
+        assert plane.verify_conservation()["ok"]
+
+    def test_sample_snapshot_reads_declared_registry_series(self):
+        from hypervisor_tpu.observability.metrics import REGISTRY
+
+        plane = HistoryPlane()
+
+        class _Snap:
+            registry = REGISTRY
+
+            def counter(self, handle):
+                return 5
+
+            def gauge(self, handle):
+                return 2.0
+
+        plane.sample_snapshot(_Snap(), now=10.0)
+        for name in DEFAULT_SERIES:
+            pts = plane.query(name, tier=0)
+            assert len(pts) == 1 and pts[0]["t"] == 10.0
+
+    def test_config_from_env_floors_and_garbage(self, monkeypatch):
+        monkeypatch.setenv("HV_HISTORY_RAW_POINTS", "1")
+        monkeypatch.setenv("HV_HISTORY_FOLD", "garbage")
+        cfg = HistoryConfig.from_env()
+        assert cfg.raw_points == 8  # floor
+        assert cfg.fold == HistoryConfig.fold  # garbage -> default
+
+
+# ── 2. the incident recorder ─────────────────────────────────────────
+
+
+def _recorder(**kw) -> IncidentRecorder:
+    rec = IncidentRecorder(**kw)
+    rec.events = []
+    rec.emit = lambda kind, payload: rec.events.append((kind, payload))
+    return rec
+
+
+class TestIncidentRecorder:
+    def test_kinds_outside_the_taxonomy_never_capture(self):
+        rec = _recorder()
+        assert rec.observe("wave_complete", {"now": 1.0}) is None
+        # The recorder's own emissions are outside the taxonomy — the
+        # recursion guard.
+        assert rec.observe("incident_captured", {"now": 1.0}) is None
+        assert rec.captured_total == 0 and rec.suppressed_total == 0
+
+    def test_capture_bundle_shape(self):
+        rec = _recorder(scope="local")
+        rec.register_provider("knobs", lambda trig: {"fold": 10})
+        iid = rec.observe(
+            "degraded_enter", {"mode": "degraded", "now": 50.0}
+        )
+        bundle = rec.get(iid)
+        assert bundle["scope"] == "local"
+        assert bundle["class"] == "resilience.degraded_entered"
+        assert bundle["kind"] == "degraded_enter"
+        assert bundle["seq"] == 1 and bundle["now"] == 50.0
+        assert bundle["context"]["knobs"] == {"fold": 10}
+        assert bundle["bytes"] > 0
+        [row] = rec.index()
+        assert row["id"] == iid and row["class"] == bundle["class"]
+        captured = [e for e in rec.events if e[0] == "incident_captured"]
+        assert captured and captured[0][1]["id"] == iid
+
+    def test_cooldown_suppresses_within_class(self):
+        rec = _recorder()
+        a = rec.observe("degraded_enter", {"now": 100.0})
+        assert rec.observe("degraded_enter", {"now": 110.0}) is None
+        assert rec.suppressed_total == 1
+        # A different class is NOT suppressed by degraded's cooldown.
+        b = rec.observe("slo_burn_critical", {"now": 111.0})
+        assert a and b and a != b
+        # Past the 30 s default cooldown the class captures again.
+        c = rec.observe("degraded_enter", {"now": 140.0})
+        assert c is not None and c != a
+
+    def test_exact_digest_dedup(self):
+        rec = _recorder()
+        iid = rec.observe("straggler", {"stage": "wave", "now": 1.0})
+        # Rewind the seq so the next capture recomputes the SAME rule
+        # payload — the dedup's only reachable path, since seq is
+        # otherwise monotonic.
+        rec._seq -= 1
+        rec._last_capture.clear()
+        assert rec.observe("straggler", {"stage": "wave", "now": 1.0}) is None
+        assert rec.suppressed_total == 1
+        assert [r["id"] for r in rec.index()] == [iid]
+
+    def test_advisory_payload_keys_ride_but_do_not_shift_the_id(self):
+        base = {"worker": "w1", "lease_seq": 3, "now": 10.0}
+        a = _recorder().observe(
+            "fleet_worker_dead", dict(base, wall_ms=17.3, at=999.0)
+        )
+        b = _recorder().observe(
+            "fleet_worker_dead",
+            dict(base, wall_ms=9999.9, at=1.0, trace_id="t/x"),
+        )
+        assert a == b
+        # ... but a RULE field shift does move the id.
+        c = _recorder().observe(
+            "fleet_worker_dead", dict(base, lease_seq=4)
+        )
+        assert c != a
+        assert "trace_id" in ADVISORY_PAYLOAD_KEYS
+
+    def test_retention_ring_evicts_loudly(self, monkeypatch):
+        monkeypatch.setenv("HV_INCIDENT_RETAINED", "2")
+        monkeypatch.setenv("HV_INCIDENT_COOLDOWN_S", "0")
+        rec = _recorder()
+        ids = [
+            rec.observe("straggler", {"stage": f"s{i}", "now": float(i)})
+            for i in range(4)
+        ]
+        assert rec.captured_total == 4 and rec.evicted_total == 2
+        assert [r["id"] for r in rec.index()] == [ids[3], ids[2]]
+        assert rec.get(ids[0]) is None  # evicted bundles are gone
+        evictions = [e for e in rec.events if e[0] == "incident_evicted"]
+        assert [e[1]["id"] for e in evictions] == [ids[0], ids[1]]
+        assert rec.summary()["retained"] == 2
+
+    def test_replay_check_recomputes_the_content_address(self):
+        rec = _recorder()
+        iid = rec.observe("integrity_violation", {"table": "x", "now": 5.0})
+        assert rec.replay_check(iid)
+        assert not rec.replay_check("deadbeef")
+        rec.get(iid)["rule"]["trigger"]["table"] = "tampered"
+        assert not rec.replay_check(iid)
+
+    def test_provider_errors_survive_the_capture(self):
+        rec = _recorder()
+
+        def boom(trigger):
+            raise RuntimeError("provider down")
+
+        rec.register_provider("flaky", boom)
+        iid = rec.observe("degraded_enter", {"now": 1.0})
+        assert "RuntimeError" in rec.get(iid)["context"]["flaky"]["error"]
+
+    def test_same_seed_drill_bit_identical_ids(self):
+        def drill(rec):
+            base = 1000.0
+            out = []
+            for i, (kind, payload) in enumerate((
+                ("degraded_enter", {"mode": "degraded"}),
+                ("slo_burn_critical", {"queue": "join", "burn": 14.6}),
+                ("fleet_worker_dead", {"worker": "w1", "lease_seq": 2}),
+            )):
+                out.append(rec.observe(
+                    kind, dict(payload, now=base + 40.0 * i)
+                ))
+            return out
+
+        assert drill(_recorder()) == drill(_recorder())
+
+    def test_rule_payload_quantizes_now_and_pops_advisories(self):
+        rule = incident_rule_payload(
+            "c", "k", 3, 1.23456789, {"x": 1, "wall_ms": 9.9}
+        )
+        assert rule["now"] == 1.234568
+        assert rule["trigger"] == {"x": 1}
+        assert rule_digest(rule) == hashlib.sha256(
+            json.dumps(rule, sort_keys=True, default=list).encode()
+        ).hexdigest()
+
+    def test_config_from_env_per_call(self, monkeypatch):
+        assert IncidentConfig.from_env().retained == 32
+        monkeypatch.setenv("HV_INCIDENT_RETAINED", "5")
+        monkeypatch.setenv("HV_INCIDENT_COOLDOWN_S", "garbage")
+        cfg = IncidentConfig.from_env()
+        assert cfg.retained == 5
+        assert cfg.cooldown_s == IncidentConfig.cooldown_s
+
+    def test_taxonomy_covers_the_issue_trigger_set(self):
+        assert set(TRIGGER_TAXONOMY.values()) == {
+            "resilience.degraded_entered",
+            "slo.burn_rate_critical",
+            "integrity.violation",
+            "integrity.state_restored",
+            "fleet.worker_suspected",
+            "fleet.worker_dead",
+            "watchdog.straggler",
+            "adversarial.uncontained",
+        }
+
+
+# ── 3. satellite 1: shared digest helper, pinned to the pre-refactor
+#      inline algorithms (before/after fixtures) ─────────────────────
+
+
+class TestSnapshotDigestStability:
+    def test_signal_snapshot_digest_matches_pre_refactor_algorithm(self):
+        from hypervisor_tpu.autopilot.signals import SignalSnapshot
+
+        snap = SignalSnapshot(
+            seq=4, now=12.3456789,
+            queue_depths=(("join", 3),), served=(("join", 10),),
+            shed=(("overload", 2),), deadline_misses=7,
+            buckets=(8, 16), burn_states=(("join", "warning"),),
+            wal_backlog=5, floor_distance=3.14159,
+        )
+        # The OLD inline algorithm, verbatim from the pre-refactor
+        # `SignalSnapshot.digest` — the re-point must not move ONE bit.
+        payload = dataclasses.asdict(snap)
+        for k in snap._ADVISORY_FIELDS:
+            payload.pop(k, None)
+        payload["now"] = round(snap.now, 6)
+        if snap.floor_distance is not None:
+            payload["floor_distance"] = round(snap.floor_distance, 1)
+        blob = json.dumps(payload, sort_keys=True, default=list)
+        assert snap.digest() == hashlib.sha256(blob.encode()).hexdigest()
+
+    def test_fleet_snapshot_digest_matches_pre_refactor_algorithm(self):
+        from hypervisor_tpu.fleet.drain import FleetSnapshot
+
+        snap = FleetSnapshot(
+            seq=3, now=12.5, workers=("w0", "w1"),
+            states=(("w0", "alive"), ("w1", "suspected")),
+            occupancy=(("w0", 4), ("w1", 2)),
+            compiles=(("w0", 7), ("w1", 7)),
+            recompiles=(("w0", 0), ("w1", 0)),
+            series=(("w0", 100), ("w1", 100)),
+            merged_series=200, transitions_digest="abc",
+            floor_distance=(("w0", 3.14159), ("w1", None)),
+            worst_burn=(("w1", "join", "warning"),),
+            scrape_wall_ms=17.3, errors=(("w1", "slo"),),
+        )
+        payload = dataclasses.asdict(snap)
+        for k in snap._ADVISORY_FIELDS:
+            payload.pop(k, None)
+        payload["now"] = round(snap.now, 6)
+        payload["floor_distance"] = [
+            (w, None if d is None else round(float(d), 1))
+            for w, d in snap.floor_distance
+        ]
+        blob = json.dumps(payload, sort_keys=True, default=list)
+        assert snap.digest() == hashlib.sha256(blob.encode()).hexdigest()
+
+    def test_canonical_blob_is_the_one_encoding(self):
+        assert canonical_blob({"b": 1, "a": (2, 3)}) == json.dumps(
+            {"b": 1, "a": (2, 3)}, sort_keys=True, default=list
+        )
+
+
+# ── 4. state/core wiring: fan-out -> capture -> bus ──────────────────
+
+
+class TestStateWiring:
+    @pytest.fixture
+    def svc(self):
+        from hypervisor_tpu.api.service import HypervisorService
+
+        return HypervisorService()
+
+    def test_health_fanout_captures_and_bridges_to_bus(self, svc):
+        from hypervisor_tpu.observability import EventType
+
+        st = svc.hv.state
+        st.health.emit_event(
+            "degraded_enter", {"mode": "degraded", "now": 77.0}
+        )
+        [row] = st.incidents.index()
+        assert row["class"] == "resilience.degraded_entered"
+        bundle = st.incidents.get(row["id"])
+        # Every wired context block landed: the bus slice (core), the
+        # WAL watermark, the ledger + SLO snapshots, the trace block,
+        # and the history window.
+        assert {"events", "wal", "ledger", "slo", "trace", "history"} <= set(
+            bundle["context"]
+        )
+        kinds = [
+            e.event_type for e in svc.hv.event_bus.query(limit=8)
+        ]
+        assert EventType.INCIDENT_CAPTURED in kinds
+
+    def test_health_summary_carries_hindsight_blocks(self, svc):
+        out = svc.hv.state.health_summary()
+        assert out["incidents"]["enabled"]
+        assert out["history"]["samples"] >= 0
+
+    def test_metrics_snapshot_feeds_history_on_the_hindsight_clock(
+        self, svc
+    ):
+        st = svc.hv.state
+        st.hindsight_clock = lambda: 555.0
+        st.metrics_snapshot()
+        pts = st.history.query("hv_sessions_live", tier=0)
+        assert pts and pts[-1]["t"] == 555.0
+
+    def test_history_query_and_incident_bundle_reads(self, svc):
+        st = svc.hv.state
+        st.metrics_snapshot()
+        summary = st.history_query()
+        assert summary["enabled"] and summary["conservation"]
+        q = st.history_query(series="hv_sessions_live", tier=0)
+        assert q["series"] == "hv_sessions_live" and q["points"]
+        assert st.incident_bundle("nope") is None
+
+
+# ── 5. both transports ───────────────────────────────────────────────
+
+
+class TestHindsightTransports:
+    def test_stdlib_routes(self):
+        import urllib.request
+
+        from hypervisor_tpu.api.server import HypervisorHTTPServer
+
+        server = HypervisorHTTPServer().start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            def get(path):
+                try:
+                    with urllib.request.urlopen(base + path, timeout=10) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            st = server.service.hv.state
+            st.metrics_snapshot()
+            iid = st.incidents.observe(
+                "slo_burn_critical", {"queue": "join", "now": 9.0}
+            )
+            status, body = get("/debug/incidents")
+            assert status == 200 and body["enabled"]
+            assert body["last"][0]["id"] == iid
+            status, body = get(f"/incidents/{iid}")
+            assert status == 200 and body["id"] == iid
+            status, body = get("/incidents/unknown")
+            assert status == 404 and "not found" in body["detail"]
+            status, body = get(
+                "/history/query?series=hv_sessions_live&tier=0"
+            )
+            assert status == 200 and body["points"]
+            status, body = get("/history/query?tier=garbage")
+            assert status == 400
+            status, body = get("/fleet/incidents")
+            assert status == 503  # no fleet attached
+        finally:
+            server.stop()
+
+    def test_fleet_incidents_rollup_over_stdlib(self):
+        import urllib.request
+
+        from hypervisor_tpu.api.server import HypervisorHTTPServer
+        from hypervisor_tpu.fleet import FleetObservatory, FleetRegistry
+
+        server = HypervisorHTTPServer().start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            reg = FleetRegistry(seed=19)
+            obs = FleetObservatory(
+                {"w0": "http://127.0.0.1:1"}, registry=reg,
+                timeout_s=0.2,
+            )
+            server.service.fleet = obs
+            reg.register("w0", now=0.0)
+            reg.heartbeat("w0", now=0.5)
+            for t in (64.0, 128.0, 256.0):
+                reg.evaluate(now=t)
+            obs._capture_dead_transitions()
+            with urllib.request.urlopen(
+                base + "/fleet/incidents", timeout=10
+            ) as r:
+                body = json.loads(r.read())
+            assert body["fleet"]["scope"] == "fleet"
+            [row] = body["fleet_incidents"]
+            assert row["class"] == "fleet.worker_dead"
+            assert row["worker"] is None  # FLEET-scope, not a worker's
+            # The dead (unreachable, pre-r19-shaped) worker degrades.
+            assert body["workers"]["w0"]["unreachable"]
+            assert body["merged"]
+        finally:
+            server.stop()
+
+    def test_fastapi_routes(self):
+        pytest.importorskip("fastapi")
+        from fastapi.testclient import TestClient
+
+        from hypervisor_tpu.api.server import create_app
+
+        client = TestClient(create_app())
+        assert client.get("/debug/incidents").json()["enabled"]
+        assert client.get("/incidents/unknown").status_code == 404
+        assert client.get("/history/query").json()["enabled"]
+        assert client.get("/fleet/incidents").status_code == 503
+
+
+# ── 6. the hv_top incidents panel ────────────────────────────────────
+
+
+class TestHvTopPanel:
+    def _hv_top(self):
+        import importlib
+        import sys
+        from pathlib import Path
+
+        sys.path.insert(
+            0, str(Path(__file__).resolve().parents[2] / "examples")
+        )
+        return importlib.import_module("hv_top")
+
+    def test_renders_na_against_pre_r19_servers(self):
+        hv_top = self._hv_top()
+        frame = hv_top.render({"stages": {}}, {}, [], None, None)
+        assert "incidents  n/a" in frame
+
+    def test_renders_the_panel_from_a_live_summary(self):
+        from hypervisor_tpu.api.service import HypervisorService
+
+        hv_top = self._hv_top()
+        st = HypervisorService().hv.state
+        st.health.emit_event(
+            "degraded_enter", {"mode": "degraded", "now": 42.0}
+        )
+        (health, counters, roofline, tenants, autopilot, fleet,
+         incidents) = hv_top.poll_state(st)
+        assert incidents["enabled"] and incidents["captured"] == 1
+        frame = hv_top.render(
+            health, counters, [], roofline, tenants, autopilot, fleet,
+            incidents,
+        )
+        assert "incidents  captured=1" in frame
+        assert "resilience.degraded_entered" in frame

@@ -71,10 +71,11 @@ class TestEventBus:
         # adversarial-plane events, and the 3 SLO burn-rate events,
         # and the roofline observatory's bytes-shift event, and the
         # autopilot's decision/outcome pair (round 17), and the fleet
-        # lease plane's joined/suspected/dead/recovered quad (round 18)
-        # (append-only: codes are the device-log wire format, so every
-        # earlier code stays stable).
-        assert len({t.code for t in EventType}) == len(EventType) == 65
+        # lease plane's joined/suspected/dead/recovered quad (round
+        # 18), and the incident recorder's captured/evicted pair
+        # (round 19) (append-only: codes are the device-log wire
+        # format, so every earlier code stays stable).
+        assert len({t.code for t in EventType}) == len(EventType) == 67
         assert EventType.WAVE_STRAGGLER.code == 40
         assert EventType.CAPACITY_WARNING.code == 41
         assert EventType.RECOMPILE.code == 42

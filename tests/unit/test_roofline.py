@@ -539,20 +539,23 @@ class TestHvTopDegrade:
         try:
             hv_top = self._hv_top()
             base = f"http://127.0.0.1:{httpd.server_address[1]}"
-            health, counters, roof, tenants, pilot, fleet = (
+            health, counters, roof, tenants, pilot, fleet, incidents = (
                 hv_top.poll_url(base)
             )
             assert roof is None
             assert tenants is None  # pre-r16 server: panel degrades too
             assert pilot is None  # pre-r17 server: panel degrades too
             assert fleet is None  # pre-r18 server: panel degrades too
+            assert incidents is None  # pre-r19 server: panel degrades too
             frame = hv_top.render(
-                health, counters, [], roof, tenants, pilot, fleet
+                health, counters, [], roof, tenants, pilot, fleet,
+                incidents,
             )
             assert "roofline   n/a" in frame
             assert "tenants    (single-tenant deployment)" in frame
             assert "autopilot  n/a" in frame
             assert "fleet      n/a" in frame
+            assert "incidents  n/a" in frame
         finally:
             httpd.shutdown()
 

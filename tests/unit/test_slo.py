@@ -170,8 +170,6 @@ class TestSLOEngine:
         # the committed baseline; this pins the tail order).
         tail = list(EventType)[-10:]
         assert tail == [
-            EventType.SLO_BURN_RATE_WARNING,
-            EventType.SLO_BURN_RATE_CRITICAL,
             EventType.SLO_RECOVERED,
             # Round 15 appended the roofline observatory's shift
             # canary BEHIND the slo triple — append-only holds.
@@ -186,6 +184,10 @@ class TestSLOEngine:
             EventType.FLEET_WORKER_SUSPECTED,
             EventType.FLEET_WORKER_DEAD,
             EventType.FLEET_WORKER_RECOVERED,
+            # Round 19 appended the incident recorder's pair BEHIND
+            # the fleet quad — append-only holds.
+            EventType.INCIDENT_CAPTURED,
+            EventType.INCIDENT_EVICTED,
         ]
 
 

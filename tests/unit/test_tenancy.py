@@ -470,7 +470,7 @@ class TestTenantObservability:
         arena = TenantArena(2, SMALL)
         front = TenantFrontDoor(arena, ServingConfig(buckets=(4,)))
         _drive_arena(arena, rounds=1)
-        health, counters, roofline, tenants, autopilot, fleet = (
+        health, counters, roofline, tenants, autopilot, fleet, _inc = (
             hv_top.poll_state(arena.tenants[0], tenant_front=front)
         )
         frame = hv_top.render(

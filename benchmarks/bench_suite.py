@@ -2071,6 +2071,247 @@ def incident_capture_benchmark(seed: int, quick: bool) -> dict:
     }
 
 
+def failover_benchmark(seed: int, quick: bool) -> dict:
+    """`--failover <seed>`: the round-20 fleet failover row — the full
+    kill-one-worker reassignment drill on an in-process 3-worker fleet
+    with a VIRTUAL clock (subprocess spawn walls would drown the
+    numbers the row exists to track):
+
+    * detection: the seeded chaos plan SIGKILLs one worker mid-drill
+      (it silently stops beating); the lease registry convicts it
+      within its windowed budget — detection latency in heartbeat
+      windows;
+    * reassignment: `FailoverController.failover` recovers the dead
+      worker's tenants from their durable checkpoints + committed-WAL
+      suffixes and splices them into survivors — replayed-ops count and
+      the absorb wall (real seconds, also expressed in heartbeat
+      windows);
+    * the zombie: the dead worker's fenced WAL refuses its resume
+      append with ZERO bytes written — `double_applied_ops` is the
+      on-disk record-count delta across the refusal, hard-gated == 0;
+    * post-splice serving: survivors keep running lifecycle rounds on
+      the absorbed tenants — p50/p99 round wall vs the smoke SLO, and
+      the zero-recompile absorb contract (the `[T, …]` shapes never
+      changed, so the splice compiles NOTHING);
+    * determinism: the ENTIRE drill (traffic, conviction, spread,
+      recovery, ownership journal) replays bit-identically — two full
+      runs must produce the same ownership transition digest.
+
+    `regression.py` presence-gates the row from this round and
+    hard-gates digest match, zero double-applies, and recompiles == 0.
+    """
+    import tempfile
+    import time as _time
+    from pathlib import Path as _Path
+
+    from hypervisor_tpu.fleet import (
+        DEAD,
+        FleetRegistry,
+        LeaseConfig,
+    )
+    from hypervisor_tpu.fleet.failover import (
+        FailoverController,
+        FencingError,
+        ManagedWorker,
+        OwnershipMap,
+        WorkerDurability,
+    )
+    from hypervisor_tpu.fleet.worker import _small_capacity_config
+    from hypervisor_tpu.observability import health as health_plane
+    from hypervisor_tpu.resilience.wal import scan as wal_scan
+    from hypervisor_tpu.serving import ServingConfig
+    from hypervisor_tpu.tenancy import (
+        TenantArena,
+        TenantFrontDoor,
+        TenantWaveScheduler,
+    )
+    from hypervisor_tpu.testing.chaos import (
+        InjectedFleetFault,
+        WaveChaosInjector,
+        WaveChaosPlan,
+    )
+
+    cfg = _small_capacity_config()
+    lease = LeaseConfig(heartbeat_interval_s=0.25)
+    base = 1000.0 + (seed % 997)
+    pre_rounds = 2 if quick else 4
+    suffix_rounds = 2 if quick else 4
+    post_rounds = 4 if quick else 10
+    kill_round = pre_rounds + suffix_rounds  # after the WAL suffix
+
+    plan = WaveChaosPlan(seed=seed, fleet_faults=(
+        InjectedFleetFault(
+            "worker_sigkill", at_round=kill_round, worker="w0"
+        ),
+    ))
+
+    def build(root, wid, tenants, n_slots):
+        arena = TenantArena(n_slots, cfg)
+        front = TenantFrontDoor(arena, ServingConfig(buckets=(4, 8)))
+        sched = TenantWaveScheduler(front)
+        sched.warm(now=0.0)
+        dur = WorkerDurability(
+            root, wid, epoch=0, tenants=tenants, fsync=False
+        ).adopt()
+        slot_of = {}
+        for slot, t in enumerate(tenants):
+            arena.tenants[slot].journal = dur.wal(t)
+            slot_of[t] = slot
+        mw = ManagedWorker(
+            wid, arena, dur, slot_of, list(range(len(tenants), n_slots))
+        )
+        return mw, front, sched
+
+    def lifecycle_round(mw, front, sched, r, now):
+        for t, slot in sorted(mw.slot_of.items()):
+            front.submit_lifecycle(
+                slot, f"{mw.worker_id}:r{r}:{t}",
+                f"did:fo:{seed}:{mw.worker_id}:{r}:{t}", 0.8, now=now,
+            )
+        sched.lifecycle_round(now)
+
+    def run_drill(root) -> dict:
+        inj = WaveChaosInjector(plan)
+        w0, f0, s0 = build(root, "w0", (0, 1), 2)
+        w1, f1, s1 = build(root, "w1", (2,), 3)
+        w2, f2, s2 = build(root, "w2", (3,), 3)
+        fleet = {
+            "w0": (w0, f0, s0), "w1": (w1, f1, s1), "w2": (w2, f2, s2),
+        }
+        reg = FleetRegistry(lease, seed=seed)
+        om = OwnershipMap(seed=seed)
+        ctl = FailoverController(om, config=cfg)
+        now = base
+        for wid in sorted(fleet):
+            reg.register(wid, now)
+            ctl.register(fleet[wid][0], now=now)
+
+        dead_set: set[str] = set()
+        detection = {"killed_round": None, "dead": None}
+        round_no = 0
+        replayed = 0
+        absorb_wall_s = None
+        checkpointed = False
+        while detection["dead"] is None:
+            round_no += 1
+            for fault in inj.take_fleet_faults(round_no):
+                if fault.kind == "worker_sigkill":
+                    dead_set.add(fault.worker)
+                    detection["killed_round"] = round_no
+            for wid, (mw, front, sched) in sorted(fleet.items()):
+                if wid in dead_set:
+                    continue  # a SIGKILLed worker is SILENT
+                if mw.slot_of:
+                    lifecycle_round(mw, front, sched, round_no, now)
+                reg.heartbeat(wid, now)
+            # Evaluate at the SAME instant as the beats (a live worker
+            # is 0 windows stale); the clock then advances one window,
+            # so a silent worker ages exactly 1 window per round.
+            for worker, new in reg.evaluate(now).items():
+                if new == DEAD and worker in dead_set:
+                    detection["dead"] = round_no
+            now += lease.heartbeat_interval_s
+            if round_no == pre_rounds:
+                w0.arena.sync()
+                for t, slot in sorted(w0.slot_of.items()):
+                    w0.durability.checkpoint(
+                        w0.arena.tenants[slot], t, step=1
+                    )
+                checkpointed = True
+            if round_no > 200:  # pragma: no cover — runaway guard
+                raise RuntimeError("lease plane never convicted w0")
+        assert checkpointed
+        w0.arena.sync()
+        for slot in w0.slot_of.values():
+            w0.arena.tenants[slot].journal.flush()
+        detect_windows = detection["dead"] - detection["killed_round"]
+
+        # ── the reassignment ──
+        t0 = _time.perf_counter()
+        report = ctl.failover("w0", now=round(now, 6))
+        absorb_wall_s = _time.perf_counter() - t0
+        replayed = report["replayed_ops"]
+
+        # ── the zombie: resume the dead worker's WAL, refuse with
+        # zero bytes — the on-disk committed count must not move.
+        zombie_wal = w0.durability.tenant_dir(0) / "wal.log"
+        before = len(wal_scan(zombie_wal).committed)
+        fenced = 0
+        try:
+            with w0.durability.wal(0).txn("zombie_resume", {}):
+                pass
+        except FencingError:
+            fenced = 1
+        double_applied = len(wal_scan(zombie_wal).committed) - before
+
+        # ── post-splice serving on the survivors ──
+        recomp_before = health_plane.compile_summary()["recompiles"]
+        walls = []
+        for r in range(post_rounds):
+            round_no += 1
+            for wid in ("w1", "w2"):
+                mw, front, sched = fleet[wid]
+                t0 = _time.perf_counter()
+                lifecycle_round(mw, front, sched, round_no, now)
+                walls.append((_time.perf_counter() - t0) * 1e3)
+            now += lease.heartbeat_interval_s
+        recompiles = (
+            health_plane.compile_summary()["recompiles"] - recomp_before
+        )
+        walls.sort()
+        return {
+            "detect_windows": detect_windows,
+            "absorb_wall_s": absorb_wall_s,
+            "replayed_ops": replayed,
+            "tenants_reassigned": len(report["tenants"]),
+            "survivors": report["survivors"],
+            "ownership_digest": report["ownership_digest"],
+            "fenced": fenced,
+            "double_applied_ops": double_applied,
+            "post_splice_walls_ms": walls,
+            "recompiles_after_splice": recompiles,
+        }
+
+    runs = []
+    with tempfile.TemporaryDirectory() as td:
+        for i in range(2):
+            runs.append(run_drill(_Path(td) / f"run{i}"))
+    a, b = runs
+    walls = a["post_splice_walls_ms"]
+    p = lambda q: walls[min(len(walls) - 1, int(q * len(walls)))]  # noqa: E731
+    slo_p99_ms = 750.0
+    return {
+        "seed": seed,
+        "quick": quick,
+        "workers": 3,
+        "killed": "w0",
+        "detection_windows": a["detect_windows"],
+        "budget_windows": 2,
+        "absorb_wall_s": round(a["absorb_wall_s"], 4),
+        "absorb_windows": round(
+            a["absorb_wall_s"] / lease.heartbeat_interval_s, 2
+        ),
+        "replayed_ops": a["replayed_ops"],
+        "tenants_reassigned": a["tenants_reassigned"],
+        "survivors": a["survivors"],
+        "zombie_fenced": bool(a["fenced"]),
+        "double_applied_ops": a["double_applied_ops"],
+        "post_splice_rounds": len(walls),
+        "post_splice_wall_ms": {
+            "p50": round(p(0.50), 2), "p99": round(p(0.99), 2),
+        },
+        "slo_p99_ms": slo_p99_ms,
+        "slo_ok": p(0.99) <= slo_p99_ms,
+        "recompiles_after_splice": a["recompiles_after_splice"],
+        "replays": 2,
+        "digest_match": float(
+            a["ownership_digest"] == b["ownership_digest"]
+            and bool(a["ownership_digest"])
+        ),
+        "ownership_digest": a["ownership_digest"],
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=30)
@@ -2198,6 +2439,22 @@ def main() -> None:
             "seeded taxonomy drill through the real health fan-out, "
             "incident-id and history-digest bit-identity over 2 "
             "replays, and the zero post-warmup recompile contract"
+        ),
+    )
+    ap.add_argument(
+        "--failover",
+        type=int,
+        default=None,
+        metavar="SEED",
+        help=(
+            "also run the fleet failover drill (ISSUE 19 round 20): "
+            "seeded 3-worker in-process fleet on a virtual clock — "
+            "SIGKILL one worker mid-drill, lease conviction within the "
+            "windowed budget, durable per-tenant recovery + splice into "
+            "survivors, fenced zombie resume (zero double-applied "
+            "ops), post-splice p50/p99 vs SLO on survivors, zero "
+            "recompiles after splice, and ownership-digest bit-identity "
+            "over 2 full drill replays"
         ),
     )
     ap.add_argument(
@@ -2459,6 +2716,35 @@ def main() -> None:
                 flush=True,
             )
 
+    # The failover drill runs after the incident row: it is virtual-
+    # clock in-process (load-immune where it must be deterministic);
+    # only its absorb wall and post-splice round walls are real time.
+    failover_rec = None
+    if args.failover is not None:
+        failover_rec = failover_benchmark(args.failover, args.quick)
+        if not args.json_only:
+            ps = failover_rec["post_splice_wall_ms"]
+            print(
+                f"failover[seed={args.failover}]: killed "
+                f"{failover_rec['killed']}, convicted in "
+                f"{failover_rec['detection_windows']} windows (budget "
+                f"{failover_rec['budget_windows']}), "
+                f"{failover_rec['tenants_reassigned']} tenants absorbed "
+                f"by {failover_rec['survivors']} in "
+                f"{failover_rec['absorb_wall_s']} s "
+                f"({failover_rec['absorb_windows']} windows), "
+                f"{failover_rec['replayed_ops']} WAL ops replayed, "
+                f"zombie fenced={failover_rec['zombie_fenced']} "
+                f"(double-applied {failover_rec['double_applied_ops']}), "
+                f"post-splice p50/p99 {ps['p50']}/{ps['p99']} ms vs SLO "
+                f"{failover_rec['slo_p99_ms']} ms, "
+                f"{failover_rec['recompiles_after_splice']} recompiles "
+                f"after splice, digest match "
+                f"{failover_rec['digest_match']} over "
+                f"{failover_rec['replays']} replays",
+                flush=True,
+            )
+
     static_rec = None
     if args.metrics_out:
         static_rec = static_analysis_row()
@@ -2579,6 +2865,15 @@ def main() -> None:
             # hard-gates overhead (HV_BENCH_INCIDENT_OVERHEAD),
             # digest match, and the recompile count.
             "incident_capture": incident_rec,
+            # Failover row (round 20, --failover <seed>): the kill-one-
+            # worker reassignment drill — detection + absorb latency in
+            # heartbeat windows, replayed-ops count, fenced zombie
+            # (double_applied_ops == 0), post-splice p50/p99 vs SLO on
+            # survivors, zero recompiles after splice, ownership-digest
+            # bit-identity over 2 full drill replays — regression.py
+            # presence-gates it from round 20 and hard-gates digest
+            # match, zero double-applies, and recompiles == 0.
+            "failover": failover_rec,
         }
         out_path.write_text(json.dumps(report, indent=2) + "\n")
         if not args.json_only:
@@ -2609,6 +2904,7 @@ def main() -> None:
         "autopilot_soak": autopilot_rec,
         "fleet": fleet_rec,
         "incident_capture": incident_rec,
+        "failover": failover_rec,
     }
     if jax.default_backend() not in ("tpu",) and not args.write_results:
         print(

@@ -250,6 +250,22 @@ INCIDENT_ROW_SINCE = 19
 #: appends only, zero extra device_get — so the band is tight.
 DEFAULT_INCIDENT_OVERHEAD_PCT = 15.0
 
+#: The failover row joined the trajectory in round 20 (ISSUE 19,
+#: bench_suite --failover): the kill-one-worker reassignment drill —
+#: detection latency vs the windowed budget, durable recovery
+#: (checkpoint + committed-WAL suffix) spliced into survivors, the
+#: fenced zombie's double-applied-op count (hard zero), post-splice
+#: serving latency, zero recompiles on absorb, and bit-identical
+#: ownership transition digests over two full drill replays. A suite
+#: round from 20 on missing the row regresses the reassign half of
+#: detect-and-reassign.
+FAILOVER_ROW_SINCE = 20
+
+#: Detection budget (heartbeat windows) for the failover drill's
+#: conviction (`HV_BENCH_FAILOVER_DETECT` overrides) — same contract
+#: as the fleet row's kill drill: DEAD within this many windows.
+DEFAULT_FAILOVER_DETECT_WINDOWS = 2.0
+
 
 def census_fusion_floor(round_num: int) -> float:
     """The fusion-ratio floor for a given round: env override, else the
@@ -576,6 +592,40 @@ def parse_round_file(path: Path) -> Optional[dict]:
                     ),
                 }
                 if isinstance(inc := doc.get("incident_capture"), dict)
+                else None
+            ),
+            # Failover row (round 20, ISSUE 19): kill-one-worker
+            # reassignment drill — detection windows vs budget, durable
+            # recovery + splice into survivors, fenced-zombie double
+            # applies (hard zero), post-splice serving, zero absorb
+            # recompiles, ownership-digest replay bit-identity — gated
+            # below.
+            failover=(
+                {
+                    "seed": fo.get("seed"),
+                    "quick": fo.get("quick"),
+                    "workers": fo.get("workers"),
+                    "killed": fo.get("killed"),
+                    "detection_windows": fo.get("detection_windows"),
+                    "budget_windows": fo.get("budget_windows"),
+                    "absorb_wall_s": fo.get("absorb_wall_s"),
+                    "absorb_windows": fo.get("absorb_windows"),
+                    "replayed_ops": fo.get("replayed_ops"),
+                    "tenants_reassigned": fo.get("tenants_reassigned"),
+                    "survivors": fo.get("survivors"),
+                    "zombie_fenced": fo.get("zombie_fenced"),
+                    "double_applied_ops": fo.get("double_applied_ops"),
+                    "post_splice_wall_ms": fo.get("post_splice_wall_ms"),
+                    "slo_p99_ms": fo.get("slo_p99_ms"),
+                    "slo_ok": fo.get("slo_ok"),
+                    "recompiles_after_splice": fo.get(
+                        "recompiles_after_splice"
+                    ),
+                    "replays": fo.get("replays"),
+                    "digest_match": fo.get("digest_match"),
+                    "ownership_digest": fo.get("ownership_digest"),
+                }
+                if isinstance(fo := doc.get("failover"), dict)
                 else None
             ),
             # Roofline row (round 15, ISSUE 14): per-program modeled
@@ -1271,6 +1321,93 @@ def compare(
         if value is not None:
             entry = {
                 "bench": "incident_recompiles_after_warmup",
+                "current_per_op_us": float(value),
+                "baseline_per_op_us": 0.0,
+                "ratio": float(value),
+            }
+            checked.append(entry)
+            if value != 0:
+                regressions.append(entry)
+    # Failover gates (round 20, ISSUE 19): presence from
+    # FAILOVER_ROW_SINCE, the kill drill's detection budget, the
+    # ownership journal's replay digest bit-identity, the hard-zero
+    # fenced-zombie double-apply contract (an unfenced zombie
+    # re-committing WAL records is silent state divergence), and the
+    # hard-zero absorb-recompile contract (the splice never changes a
+    # `[T, …]` shape).
+    fo = current.get("failover")
+    if (
+        current.get("format") == "suite"
+        and current["round"] >= FAILOVER_ROW_SINCE
+        and not fo
+    ):
+        entry = {
+            "bench": "missing:failover",
+            "current_per_op_us": 0.0,
+            "baseline_per_op_us": 0.0,
+            "ratio": 0.0,
+        }
+        checked.append(entry)
+        regressions.append(entry)
+    if fo:
+        det = fo.get("detection_windows")
+        env_b = os.environ.get("HV_BENCH_FAILOVER_DETECT")
+        budget = (
+            float(env_b) if env_b else DEFAULT_FAILOVER_DETECT_WINDOWS
+        )
+        entry = {
+            "bench": "failover_detection_windows",
+            # A drill that never convicted the kill reports None —
+            # recorded as -1 and gated as a regression outright.
+            "current_per_op_us": (
+                float(det) if det is not None else -1.0
+            ),
+            "baseline_per_op_us": budget,
+            "ratio": (
+                round(float(det) / budget, 3)
+                if det is not None and budget
+                else 0.0
+            ),
+        }
+        checked.append(entry)
+        if det is None or float(det) > budget:
+            regressions.append(entry)
+        # Replay determinism: two full drills (traffic, conviction,
+        # spread, recovery, journal) must land the SAME ownership
+        # transition digest — reassignment is an auditable decision.
+        match = fo.get("digest_match")
+        if match is not None:
+            entry = {
+                "bench": "failover_digest_match",
+                "current_per_op_us": 1.0 if match else 0.0,
+                "baseline_per_op_us": 1.0,
+                "ratio": 1.0 if match else 0.0,
+            }
+            checked.append(entry)
+            if not match:
+                regressions.append(entry)
+        # The zombie MUST be fenced and MUST NOT double-apply: the
+        # on-disk committed-record count across its refused resume
+        # append is a hard zero delta.
+        fenced = fo.get("zombie_fenced")
+        doubles = fo.get("double_applied_ops")
+        if fenced is not None or doubles is not None:
+            ok = bool(fenced) and (doubles == 0)
+            entry = {
+                "bench": "failover_zombie_fenced_zero_double_applies",
+                "current_per_op_us": (
+                    float(doubles) if doubles is not None else -1.0
+                ),
+                "baseline_per_op_us": 0.0,
+                "ratio": 0.0 if ok else 1.0,
+            }
+            checked.append(entry)
+            if not ok:
+                regressions.append(entry)
+        value = fo.get("recompiles_after_splice")
+        if value is not None:
+            entry = {
+                "bench": "failover_recompiles_after_splice",
                 "current_per_op_us": float(value),
                 "baseline_per_op_us": 0.0,
                 "ratio": float(value),

@@ -83,6 +83,8 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/fleet/slo", "fleet_slo", None),
     ("GET", "/fleet/trace/{trace_id}", "fleet_trace", None),
     ("GET", "/fleet/incidents", "fleet_incidents", None),
+    ("GET", "/fleet/ownership", "fleet_ownership", None),
+    ("GET", "/fleet/failover", "fleet_failover", None),
     ("GET", "/debug/incidents", "debug_incidents", None),
     ("GET", "/incidents/{incident_id}", "get_incident", None),
     ("GET", "/history/query", "history_query", None),

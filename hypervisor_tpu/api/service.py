@@ -897,6 +897,38 @@ class HypervisorService:
         not errors."""
         return self._fleet_or_503().incidents_rollup()
 
+    async def fleet_ownership(self) -> dict:
+        """`GET /fleet/ownership`: the journaled ownership map — which
+        worker owns which tenant set at which fencing epoch, with the
+        transition tail + digest (`fleet.failover.OwnershipMap`).
+        503 until a failover plane is attached
+        (`observatory.ownership = OwnershipMap(...)`)."""
+        obs = self._fleet_or_503()
+        ownership = getattr(obs, "ownership", None)
+        if ownership is None:
+            raise ApiError(
+                503,
+                "no ownership map attached (observatory.ownership = "
+                "fleet.failover.OwnershipMap(seed))",
+            )
+        return ownership.summary()
+
+    async def fleet_failover(self) -> dict:
+        """`GET /fleet/failover`: the reassignment controller's view —
+        managed workers (tenants, spare slots, epochs, fence floors)
+        and the reassignment history
+        (`fleet.failover.FailoverController`). 503 until attached
+        (`observatory.failover = FailoverController(...)`)."""
+        obs = self._fleet_or_503()
+        controller = getattr(obs, "failover", None)
+        if controller is None:
+            raise ApiError(
+                503,
+                "no failover controller attached (observatory.failover "
+                "= fleet.failover.FailoverController(ownership))",
+            )
+        return controller.summary()
+
     async def debug_profile(self, req: M.ProfileRequest) -> dict:
         """`POST /debug/profile`: an on-demand bounded `jax.profiler`
         capture window (TensorBoard/Perfetto trace into `log_dir`).

@@ -1822,6 +1822,14 @@ class Hypervisor:
             "fleet_worker_suspected": EventType.FLEET_WORKER_SUSPECTED,
             "fleet_worker_dead": EventType.FLEET_WORKER_DEAD,
             "fleet_worker_recovered": EventType.FLEET_WORKER_RECOVERED,
+            # Failover plane: ownership assigns, zombie fencings, and
+            # completed reassignments ride the same fan-out
+            # (`fleet.failover.OwnershipMap` / `FailoverController`);
+            # payloads carry the replayable ownership seq + fencing
+            # epoch so the reassignment journal replays bit-identically.
+            "fleet_ownership_changed": EventType.FLEET_OWNERSHIP_CHANGED,
+            "fleet_worker_fenced": EventType.FLEET_WORKER_FENCED,
+            "fleet_tenants_reassigned": EventType.FLEET_TENANTS_REASSIGNED,
             # Hindsight-plane lifecycle (`observability.incidents.
             # IncidentRecorder`) rides the same fan-out; the taxonomy
             # itself is the recursion guard (incident_* kinds never

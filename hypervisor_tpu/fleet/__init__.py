@@ -21,6 +21,13 @@ shard across worker processes (ROADMAP item 1), the fleet needs:
 * `trace` — cross-process trace stitching: per-worker Chrome/OTLP
   fragments for one `CausalTraceId` merged into one timeline with
   worker lanes.
+* `failover` — the REASSIGN half (round 20): per-worker durable
+  ownership namespaces (`WorkerDurability`, fenced WAL + watermarked
+  per-tenant checkpoints under `<root>/<worker>/epoch_<E>/tenant_<t>`),
+  the journaled `OwnershipMap`, and the `FailoverController` that
+  recovers a convicted-dead worker's tenants from durable state,
+  splices them into survivors' arenas with zero recompiles, and fences
+  the zombie at the bumped epoch.
 """
 
 from hypervisor_tpu.fleet.drain import (
@@ -39,6 +46,16 @@ from hypervisor_tpu.fleet.registry import (
     LeaseConfig,
     LeaseTransition,
 )
+from hypervisor_tpu.fleet.failover import (
+    FailoverController,
+    FailoverError,
+    FencedWal,
+    FencingError,
+    ManagedWorker,
+    OwnershipMap,
+    OwnershipTransition,
+    WorkerDurability,
+)
 from hypervisor_tpu.fleet.trace import stitch_chrome, stitch_otlp
 from hypervisor_tpu.fleet.worker import FleetSupervisor, WorkerSpec
 
@@ -46,13 +63,21 @@ __all__ = [
     "ALIVE",
     "DEAD",
     "SUSPECTED",
+    "FailoverController",
+    "FailoverError",
+    "FencedWal",
+    "FencingError",
     "FleetObservatory",
     "FleetRegistry",
     "FleetSnapshot",
     "FleetSupervisor",
     "LeaseConfig",
     "LeaseTransition",
+    "ManagedWorker",
+    "OwnershipMap",
+    "OwnershipTransition",
     "WorkerClient",
+    "WorkerDurability",
     "WorkerSpec",
     "merge_expositions",
     "sample_series_count",

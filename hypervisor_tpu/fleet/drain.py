@@ -329,6 +329,11 @@ class FleetObservatory:
         #: Transition seqs already examined for capture (the DEAD scan
         #: is incremental; replaying the registry does not re-capture).
         self._transition_cursor = 0
+        #: Optional failover plane (`fleet.failover`): attach an
+        #: `OwnershipMap` / `FailoverController` here and the API
+        #: surfaces them at `GET /fleet/ownership` / `/fleet/failover`.
+        self.ownership = None
+        self.failover = None
 
     def _client(self, worker: str) -> WorkerClient:
         client = self._clients.get(worker)

@@ -31,6 +31,7 @@ import urllib.request
 from typing import Mapping, Optional
 
 READY_MARKER = "HV_WORKER_READY="
+DRAINED_MARKER = "HV_WORKER_DRAINED="
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,6 +51,17 @@ class WorkerSpec:
     #: READY line — warmup compiles land pre-readiness, so post-ready
     #: recompile accounting is clean.
     warm_rounds: int = 2
+    #: Durable ownership root (fleet.failover layout). Empty = no
+    #: durability: the round-18 detection-only drill runs unchanged.
+    #: When set, the worker adopts
+    #: `<root>/<worker_id>/epoch_<epoch>/tenant_<t>/` at startup —
+    #: refusing loudly if the directory already carries a newer epoch —
+    #: journals every tenant's waves into its fenced WAL there, and on
+    #: SIGTERM drains gracefully (flush + final checkpoint + DRAINED
+    #: marker + exit 0).
+    durability_root: str = ""
+    #: Fencing epoch this incarnation writes at (see failover.py).
+    epoch: int = 0
 
     @property
     def base_url(self) -> str:
@@ -119,6 +131,8 @@ def run_worker(spec: WorkerSpec) -> None:
     from hypervisor_tpu.api.server import HypervisorHTTPServer
 
     service = _make_service()
+    durability = None
+    arena = None
     if spec.wants_arena:
         from hypervisor_tpu.serving import ServingConfig
         from hypervisor_tpu.tenancy import (
@@ -128,6 +142,17 @@ def run_worker(spec: WorkerSpec) -> None:
         )
 
         arena = TenantArena(len(spec.tenants), _small_capacity_config())
+        if spec.durability_root:
+            from hypervisor_tpu.fleet.failover import WorkerDurability
+
+            # Adopt BEFORE serving anything: a zombie restarting with a
+            # stale spec must die here, not at its first overwrite.
+            durability = WorkerDurability(
+                spec.durability_root, spec.worker_id,
+                epoch=spec.epoch, tenants=spec.tenants,
+            ).adopt()
+            for slot, tenant in enumerate(spec.tenants):
+                arena.tenants[slot].journal = durability.wal(tenant)
         front = TenantFrontDoor(arena, ServingConfig(buckets=(4, 8)))
         sched = TenantWaveScheduler(front)
         sched.warm(now=0.0)
@@ -159,9 +184,12 @@ def run_worker(spec: WorkerSpec) -> None:
     }
     print(READY_MARKER + json.dumps(ready, sort_keys=True), flush=True)
 
-    stop = {"flag": False}
+    stop = {"flag": False, "drain": False}
 
     def _term(signum, frame):  # pragma: no cover — signal path
+        # SIGTERM is the GRACEFUL path: flush + final checkpoint +
+        # DRAINED marker + exit 0. SIGINT remains a plain stop.
+        stop["drain"] = stop["drain"] or signum == signal.SIGTERM
         stop["flag"] = True
 
     signal.signal(signal.SIGTERM, _term)
@@ -169,6 +197,26 @@ def run_worker(spec: WorkerSpec) -> None:
     while not stop["flag"]:
         time.sleep(0.05)
     server.stop()
+    if stop["drain"] and durability is not None:
+        # Graceful handoff: every tenant's WAL flushed, a final
+        # watermarked checkpoint published at the WAL head, so the
+        # adopter's recovery replays ZERO records (satellite 1's pin).
+        arena.sync()
+        drained = {}
+        for slot, tenant in enumerate(spec.tenants):
+            st = arena.tenants[slot]
+            if st.journal is not None:
+                st.journal.flush()
+            durability.checkpoint(st, tenant)
+            drained[str(tenant)] = {
+                "wal_seq": st.journal.last_seq if st.journal else 0,
+            }
+        durability.close()
+        print(DRAINED_MARKER + json.dumps({
+            "worker_id": spec.worker_id,
+            "epoch": spec.epoch,
+            "tenants": drained,
+        }, sort_keys=True), flush=True)
 
 
 class FleetSupervisor:
@@ -299,10 +347,56 @@ class FleetSupervisor:
     def kill(self, worker_id: str, sig: int = signal.SIGKILL) -> None:
         """The drill's failure injection: SIGKILL — no shutdown hooks,
         no goodbye heartbeat, exactly the silence the lease plane must
-        notice."""
+        notice. Non-terminal signals (SIGSTOP — the zombie drill's
+        freeze) are delivered without waiting: the process is paused,
+        not gone, and may resume into the fence later."""
         proc = self.workers[worker_id]["proc"]
         proc.send_signal(sig)
-        proc.wait(timeout=10.0)
+        if sig != signal.SIGSTOP:
+            proc.wait(timeout=10.0)
+
+    def drain(
+        self, worker_id: str, timeout_s: float = 60.0
+    ) -> Optional[dict]:
+        """Graceful handoff: SIGTERM, then read stdout for the DRAINED
+        marker the worker prints after flushing its WALs and publishing
+        final per-tenant checkpoints. Returns the parsed marker (None
+        when the worker had no durability attached), after the process
+        has exited 0.
+        """
+        rec = self.workers[worker_id]
+        proc = rec["proc"]
+        proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + float(timeout_s)
+        marker: Optional[dict] = None
+        fd = proc.stdout
+        while time.monotonic() < deadline:
+            readable, _, _ = select.select([fd], [], [], 0.25)
+            if readable:
+                line = fd.readline()
+                if line and line.strip().startswith(DRAINED_MARKER):
+                    marker = json.loads(
+                        line.strip()[len(DRAINED_MARKER):]
+                    )
+                    break
+                if not line and proc.poll() is not None:
+                    break  # EOF after exit: no marker is coming
+            elif proc.poll() is not None and marker is None:
+                # Exited without a marker in the buffer — one final
+                # non-blocking sweep picks up anything already flushed.
+                tail = fd.read() or ""
+                for ln in tail.splitlines():
+                    if ln.strip().startswith(DRAINED_MARKER):
+                        marker = json.loads(
+                            ln.strip()[len(DRAINED_MARKER):]
+                        )
+                break
+        rc = proc.wait(timeout=10.0)
+        if rc != 0:
+            raise RuntimeError(
+                f"worker {worker_id!r} drain exited {rc}, not 0"
+            )
+        return marker
 
 
 def main(argv=None) -> int:
@@ -320,4 +414,10 @@ if __name__ == "__main__":  # pragma: no cover — subprocess entry
     sys.exit(main())
 
 
-__all__ = ["FleetSupervisor", "WorkerSpec", "run_worker", "READY_MARKER"]
+__all__ = [
+    "DRAINED_MARKER",
+    "FleetSupervisor",
+    "READY_MARKER",
+    "WorkerSpec",
+    "run_worker",
+]

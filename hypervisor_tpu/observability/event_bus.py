@@ -142,6 +142,19 @@ class EventType(str, enum.Enum):
     INCIDENT_CAPTURED = "incident.captured"
     INCIDENT_EVICTED = "incident.evicted"
 
+    # Failover plane (append-only, like every block above): the
+    # reassignment half of detect-and-reassign (`fleet.failover`),
+    # facade-bridged from the health fan-out like the planes above.
+    # OWNERSHIP_CHANGED carries the worker's new tenant set + fencing
+    # epoch (the OwnershipMap's replayable assign); WORKER_FENCED is
+    # the zombie hazard closing — a stale-epoch worker's WAL appends
+    # and checkpoint publications now refuse loudly; TENANTS_REASSIGNED
+    # is one record per completed reassignment state machine, carrying
+    # the dead worker, the tenant -> survivor map, and the new epoch.
+    FLEET_OWNERSHIP_CHANGED = "fleet.ownership_changed"
+    FLEET_WORKER_FENCED = "fleet.worker_fenced"
+    FLEET_TENANTS_REASSIGNED = "fleet.tenants_reassigned"
+
     @property
     def code(self) -> int:
         """int32 column code for the device event log."""

@@ -799,6 +799,37 @@ INCIDENTS_RETAINED = REGISTRY.gauge(
     "incident bundles currently held in the retention ring",
 )
 
+# ── failover plane (durable ownership + reassignment, round 20) ──────
+# HOST-owned rows bumped by `fleet.failover` as the reassignment state
+# machine runs and fenced zombies refuse writes — APPENDED at the
+# registry tail (hvlint HVA004).
+FAILOVER_REASSIGNMENTS = REGISTRY.counter(
+    "hv_failover_reassignments_total",
+    "completed reassignment state machines (one per convicted-dead "
+    "worker whose tenants were absorbed by survivors)",
+)
+FAILOVER_TENANTS_REASSIGNED = REGISTRY.counter(
+    "hv_failover_tenants_reassigned_total",
+    "tenants recovered from a dead worker's durable checkpoint + WAL "
+    "suffix and spliced into a survivor's arena",
+)
+FAILOVER_REPLAYED_OPS = REGISTRY.counter(
+    "hv_failover_replayed_ops_total",
+    "committed WAL records replayed past checkpoint watermarks during "
+    "failover recoveries (graceful drains replay ZERO)",
+)
+FAILOVER_FENCED_APPENDS = REGISTRY.counter(
+    "hv_failover_fenced_appends_total",
+    "WAL appends / checkpoint publications refused because the "
+    "writer's fencing epoch is below the fence floor (the zombie "
+    "hazard refusing loudly — zero bytes reach disk)",
+)
+FAILOVER_EPOCH = REGISTRY.gauge(
+    "hv_failover_epoch",
+    "the ownership map's current fencing epoch (bumped once per "
+    "reassignment; stale-epoch writers are fenced below it)",
+)
+
 
 # ── host object: device table + host mirror + drain ──────────────────
 

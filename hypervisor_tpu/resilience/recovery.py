@@ -508,6 +508,43 @@ def recover(
     return state, report
 
 
+def recover_tenant(
+    bundle_dir: str | Path,
+    tenant: int,
+    config: HypervisorConfig = DEFAULT_CONFIG,
+    attach_journal: bool = False,
+) -> tuple[HypervisorState, dict]:
+    """`recover()` generalized to per-tenant extraction from a
+    multi-tenant durability bundle (`fleet.failover.WorkerDurability`).
+
+    A worker's arena checkpoints each tenant's `TenantState` solo —
+    `TenantState` IS a `HypervisorState`, so `save_state` per tenant
+    yields ordinary checkpoint dirs — and journals each tenant's WAL
+    beside them, under `<bundle>/tenant_<t>/{wal.log, step_<N>/}`.
+    Extraction is therefore the stock restore sequence over that
+    tenant's namespace: newest durable checkpoint, audit-head
+    verification, committed-WAL suffix replay through the solo REPLAY
+    handlers (per-tenant semantics are bit-identical to the batched
+    wave's slice by the arena's journaling contract). The returned solo
+    state is ready to splice into a SURVIVOR's arena
+    (`TenantArena.splice_tenant`).
+    """
+    tdir = Path(bundle_dir) / f"tenant_{int(tenant)}"
+    if not tdir.is_dir():
+        raise RecoveryError(
+            f"no durable namespace for tenant {tenant} under {bundle_dir}"
+        )
+    wal_path = tdir / "wal.log"
+    state, report = recover(
+        tdir,
+        wal_path if wal_path.exists() else None,
+        config=config,
+        attach_journal=attach_journal,
+    )
+    report["tenant"] = int(tenant)
+    return state, report
+
+
 __all__ = [
     "REPLAY",
     "RecoveryError",
@@ -515,6 +552,7 @@ __all__ = [
     "checkpoint_with_watermark",
     "latest_durable_checkpoint",
     "recover",
+    "recover_tenant",
     "replay",
     "step_checkpoints",
     "verify_audit_heads",

@@ -149,6 +149,13 @@ class Supervisor:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
         self.checkpoint_keep = 3
+        # Optional publication gate consulted before EVERY checkpoint
+        # save: raising refuses publication with nothing written. The
+        # failover plane hangs its fencing check here
+        # (`fleet.failover.WorkerDurability.check_fence`) so a
+        # stale-epoch zombie's periodic checkpoint can never earn a
+        # `.done` marker a recovery would trust.
+        self.checkpoint_gate = None
         self.retryable = retryable
         self.sleep = sleep
 
@@ -441,6 +448,9 @@ class Supervisor:
 
         if not self.checkpoint_dir:
             raise RuntimeError("supervisor has no checkpoint_dir configured")
+        gate = self.checkpoint_gate
+        if gate is not None:
+            gate()  # a raise refuses publication; nothing was written
         with self._lock:
             self._ckpt_step += 1
             step = self._ckpt_step

@@ -215,6 +215,12 @@ class WriteAheadLog:
         self._lock = threading.Lock()
         self._local = threading.local()
         self.records_written = 0
+        # Optional write gate consulted BEFORE any byte is framed or
+        # appended: raising here refuses the record with the file
+        # untouched. The failover plane's fencing check hangs off this
+        # hook (`fleet.failover.FencedWal`) — a stale-epoch zombie's
+        # append must refuse loudly with ZERO bytes reaching disk.
+        self.pre_append = None
         self.path.parent.mkdir(parents=True, exist_ok=True)
         seq = 0
         if self.path.exists():
@@ -231,6 +237,9 @@ class WriteAheadLog:
     # -- write side -----------------------------------------------------
 
     def _append(self, doc: dict) -> None:
+        gate = self.pre_append
+        if gate is not None:
+            gate(doc)
         data = _frame(doc)
         with self._lock:
             self._f.write(data)

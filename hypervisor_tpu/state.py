@@ -20,6 +20,7 @@ share one source of truth.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 import time
@@ -520,6 +521,33 @@ def _config_payload(config: SessionConfig) -> dict:
     }
 
 
+#: Host-side bookkeeping `adopt_host_from` moves between states — the
+#: in-memory twin of `runtime.checkpoint.host_metadata`'s field set
+#: (plus `_row_session`, which the checkpoint carries inside the npz).
+_HOST_ADOPT_ATTRS: tuple[str, ...] = (
+    "agent_ids",
+    "session_ids",
+    "saga_ids",
+    "_next_agent_slot",
+    "_next_session_slot",
+    "_next_saga_slot",
+    "_next_edge_slot",
+    "_next_elev_slot",
+    "_members",
+    "_audit_rows",
+    "_chain_seed",
+    "_turns",
+    "_frontier",
+    "_fanout_groups",
+    "_free_agent_slots",
+    "_free_edge_slots",
+    "_free_elev_slots",
+    "_epoch_base",
+    "_restored_wal_seq",
+    "_row_session",
+)
+
+
 class HypervisorState:
     """Authoritative batched state: device tables + host boundary indices."""
 
@@ -749,6 +777,29 @@ class HypervisorState:
     def now(self) -> float:
         """Seconds since this state's epoch — the f32-safe device time."""
         return time.time() - self._epoch_base
+
+    def adopt_host_from(self, other: "HypervisorState") -> None:
+        """Adopt another state's host-side bookkeeping wholesale — the
+        tenant-splice half of failover (`tenancy.arena.TenantArena.
+        splice_tenant`): the device tables move through the arena's
+        component protocol; everything the checkpoint's `host.json`
+        carries (intern tables, slot cursors, membership, audit index,
+        chain seeds, Merkle frontiers, free lists, the WAL watermark)
+        moves here. The attribute list mirrors `runtime.checkpoint.
+        host_metadata` / `_rebuild` — a field added to the checkpoint
+        format must be added to `_HOST_ADOPT_ATTRS` too, or a spliced
+        tenant would silently resume without it."""
+        if dataclasses.asdict(other.config.capacity) != dataclasses.asdict(
+            self.config.capacity
+        ):
+            raise ValueError(
+                "adopt_host_from across capacity configs: the donor's "
+                "table shapes would not fit this state's slices"
+            )
+        for name in _HOST_ADOPT_ATTRS:
+            setattr(self, name, getattr(other, name))
+        # Derived caches anchored to the old tables are stale now.
+        self._packed_bodies = {}
 
     # ── resilience hooks ─────────────────────────────────────────────
 

@@ -347,6 +347,47 @@ class TenantArena:
             for st in self.tenants:
                 st._tenant_local.pop(name, None)
 
+    def splice_tenant(self, tenant: int, recovered) -> None:
+        """Replace one arena slot's ENTIRE state with a recovered solo
+        `HypervisorState` — the absorb half of fleet failover: a dead
+        worker's tenant, restored from its durable checkpoint + WAL
+        suffix (`resilience.recovery.recover_tenant`), lands in a
+        survivor's pre-warmed slot.
+
+        The splice goes through the component protocol (`_comp_set` +
+        `sync`), so the `[T, …]` stacked shapes never change — a warmed
+        survivor absorbs with ZERO recompiles. The recovered state's
+        capacity config must match this arena's (`adopt_host_from`
+        refuses otherwise). Metrics/trace tables are not checkpointed,
+        so the recovered state carries fresh ones — the splice wipes
+        the slot's observability rings cleanly rather than leaking the
+        previous occupant's telemetry into the new tenant's view.
+        """
+        t = int(tenant)
+        if not 0 <= t < self.num_tenants:
+            raise ValueError(
+                f"splice_tenant: slot {t} outside arena of "
+                f"{self.num_tenants}"
+            )
+        with self._lock:
+            self.sync()
+            st = self.tenants[t]
+            # Host bookkeeping first: it validates capacity parity
+            # before any table write lands in the stacks.
+            st.adopt_host_from(recovered)
+            for name in COMPONENTS:
+                if name == "metrics_table":
+                    value = recovered.metrics.table
+                elif name == "trace_table":
+                    value = recovered.tracer.table
+                else:
+                    value = getattr(recovered, name)
+                if value is None:
+                    continue
+                st._comp_set(name, value)
+            st._gauges_fresh = False
+            self.sync()
+
     # ── batched session creation ─────────────────────────────────────
 
     def create_sessions_batch(

@@ -6,6 +6,7 @@ from hypervisor_tpu.testing.chaos import (
     ChaosFailure,
     ChaosPlan,
     InjectedDeviceLoss,
+    InjectedFleetFault,
     InjectedWaveFault,
     WaveChaosInjector,
     WaveChaosPlan,
@@ -16,6 +17,7 @@ __all__ = [
     "ChaosFailure",
     "ChaosPlan",
     "InjectedDeviceLoss",
+    "InjectedFleetFault",
     "InjectedWaveFault",
     "WaveChaosInjector",
     "WaveChaosPlan",

@@ -183,6 +183,31 @@ class InjectedCorruption:
 
 
 @dataclass(frozen=True)
+class InjectedFleetFault:
+    """One FLEET-layer fault, scheduled by drill round (1-based).
+
+    These describe failures ABOVE the dispatch interposer — whole
+    workers and their durable artifacts — so the injector does not
+    apply them itself: the drill harness (gate 6m, `bench_suite
+    --failover`, `FleetSupervisor`-based tests) polls
+    `WaveChaosInjector.take_fleet_faults(round)` at each round boundary
+    and delivers what comes due (signals via the supervisor, torn
+    checkpoints by truncating the named worker's newest checkpoint
+    artifact, partitioned scrapes by skipping the worker in the merged
+    drain). Keeping the schedule in the plan keeps it SEEDED: the same
+    plan replays the same kill at the same round, which is what lets
+    the failover drill pin bit-identical ownership digests.
+
+    Kinds: ``worker_sigkill`` | ``worker_sigstop`` |
+    ``torn_checkpoint`` | ``partitioned_scrape``.
+    """
+
+    kind: str = "worker_sigkill"
+    at_round: int = 1
+    worker: str = "w0"
+
+
+@dataclass(frozen=True)
 class WaveChaosPlan:
     """Dispatch-interposer fault mix; rates are per-dispatch
     probabilities in [0, 1], drawn from one seeded stream in dispatch
@@ -209,6 +234,10 @@ class WaveChaosPlan:
     hang_seconds: float = 0.05    # host stall simulating a wedged wave
     stages: Optional[tuple[str, ...]] = None
     corruptions: tuple[InjectedCorruption, ...] = ()
+    #: Fleet-layer faults (worker kills/stops, torn checkpoints,
+    #: partitioned scrapes) the DRILL HARNESS delivers at round
+    #: boundaries via `take_fleet_faults` — see `InjectedFleetFault`.
+    fleet_faults: tuple = ()
 
     @property
     def effective_drain_loss_rate(self) -> float:
@@ -242,6 +271,10 @@ class WaveChaosInjector:
             plan.corruptions, key=lambda c: c.at_dispatch
         )
         self.corruptions_applied: list[dict] = []
+        self._pending_fleet_faults = sorted(
+            plan.fleet_faults, key=lambda f: f.at_round
+        )
+        self.fleet_faults_taken: list[dict] = []
 
     def _armed(self, stage: str) -> bool:
         return self.plan.stages is None or stage in self.plan.stages
@@ -296,6 +329,28 @@ class WaveChaosInjector:
     @property
     def has_pending_corruptions(self) -> bool:
         return bool(self._pending_corruptions)
+
+    @property
+    def has_pending_fleet_faults(self) -> bool:
+        return bool(self._pending_fleet_faults)
+
+    def take_fleet_faults(self, round_: int) -> list:
+        """Pop every fleet fault due at or before drill round `round_`
+        (1-based). The DRILL HARNESS delivers them — the injector only
+        keeps the seeded schedule and the taken log; each fault is
+        handed out exactly once."""
+        due: list = []
+        while (
+            self._pending_fleet_faults
+            and self._pending_fleet_faults[0].at_round <= round_
+        ):
+            f = self._pending_fleet_faults.pop(0)
+            due.append(f)
+            self.fleet_faults_taken.append({
+                "kind": f.kind, "worker": f.worker,
+                "at_round": f.at_round, "taken_at_round": int(round_),
+            })
+        return due
 
     def apply_due_corruptions(self, state) -> list[dict]:
         """Apply every scheduled corruption whose dispatch has come.
@@ -451,5 +506,7 @@ class WaveChaosInjector:
             "losses": self.losses,
             "corruptions_applied": list(self.corruptions_applied),
             "corruptions_pending": len(self._pending_corruptions),
+            "fleet_faults_taken": list(self.fleet_faults_taken),
+            "fleet_faults_pending": len(self._pending_fleet_faults),
             "by_stage": dict(self.by_stage),
         }

@@ -1151,6 +1151,157 @@ print(
 PY
 incident_rc=$?
 
+echo "── fleet failover gate (6m) ──"
+# Round 20 (ISSUE 19): the REASSIGN half of detect-and-reassign. A
+# seeded 3-worker in-process drill on a VIRTUAL clock (6k already
+# proves the real SIGKILL): one worker goes silent mid-drill, the
+# lease plane convicts it, `FailoverController.failover` recovers its
+# tenants from durable checkpoints + committed-WAL suffixes and
+# splices them into the survivors. The spliced tenants' Merkle chain
+# heads must match the dead worker's pre-kill oracle bit-for-bit, the
+# zombie's fenced WAL must refuse its resume append with ZERO
+# double-applied records on disk, and TWO full drill replays must land
+# the same ownership transition digest.
+JAX_PLATFORMS=cpu python - <<'PY'
+import tempfile
+from pathlib import Path
+
+from hypervisor_tpu.fleet import DEAD, FleetRegistry, LeaseConfig
+from hypervisor_tpu.fleet.failover import (
+    FailoverController,
+    FencingError,
+    ManagedWorker,
+    OwnershipMap,
+    WorkerDurability,
+)
+from hypervisor_tpu.fleet.worker import _small_capacity_config
+from hypervisor_tpu.resilience.wal import scan as wal_scan
+from hypervisor_tpu.serving import ServingConfig
+from hypervisor_tpu.tenancy import (
+    TenantArena,
+    TenantFrontDoor,
+    TenantWaveScheduler,
+)
+
+SEED = 20
+cfg = _small_capacity_config()
+lease = LeaseConfig(heartbeat_interval_s=0.25)
+
+
+def build(root, wid, tenants, n_slots):
+    arena = TenantArena(n_slots, cfg)
+    front = TenantFrontDoor(arena, ServingConfig(buckets=(4, 8)))
+    sched = TenantWaveScheduler(front)
+    sched.warm(now=0.0)
+    dur = WorkerDurability(
+        root, wid, epoch=0, tenants=tenants, fsync=False
+    ).adopt()
+    slot_of = {}
+    for slot, t in enumerate(tenants):
+        arena.tenants[slot].journal = dur.wal(t)
+        slot_of[t] = slot
+    mw = ManagedWorker(
+        wid, arena, dur, slot_of, list(range(len(tenants), n_slots))
+    )
+    return mw, front, sched
+
+
+def chain_heads(st):
+    return {
+        s: tuple(int(w) for w in v) for s, v in st._chain_seed.items()
+    }
+
+
+def run_drill(root: Path) -> dict:
+    w0, f0, s0 = build(root, "w0", (0, 1), 2)
+    w1, f1, s1 = build(root, "w1", (2,), 3)
+    w2, f2, s2 = build(root, "w2", (3,), 3)
+    fleet = {"w0": (w0, f0, s0), "w1": (w1, f1, s1), "w2": (w2, f2, s2)}
+    reg = FleetRegistry(lease, seed=SEED)
+    om = OwnershipMap(seed=SEED)
+    ctl = FailoverController(om, config=cfg)
+    now = 1000.0
+    for wid in sorted(fleet):
+        reg.register(wid, now)
+        ctl.register(fleet[wid][0], now=now)
+    dead_round = None
+    for round_no in range(1, 40):
+        killed = round_no > 3  # w0 goes silent after round 3
+        for wid, (mw, front, sched) in sorted(fleet.items()):
+            if wid == "w0" and killed:
+                continue
+            for t, slot in sorted(mw.slot_of.items()):
+                front.submit_lifecycle(
+                    slot, f"{wid}:r{round_no}:{t}",
+                    f"did:6m:{SEED}:{wid}:{round_no}:{t}", 0.8, now=now,
+                )
+            sched.lifecycle_round(now)
+            reg.heartbeat(wid, now)
+        if round_no == 2:  # durable checkpoint mid-drill: the suffix
+            w0.arena.sync()  # after it replays from the WAL
+            for t, slot in sorted(w0.slot_of.items()):
+                w0.durability.checkpoint(w0.arena.tenants[slot], t, step=1)
+        if DEAD in reg.evaluate(now).values():
+            dead_round = round_no
+            break
+        now += lease.heartbeat_interval_s
+    assert dead_round is not None, "lease plane never convicted w0"
+    # The oracle: w0's per-tenant chain heads at its last durable
+    # instant (everything it flushed before going silent).
+    w0.arena.sync()
+    oracle = {}
+    for t, slot in sorted(w0.slot_of.items()):
+        w0.arena.tenants[slot].journal.flush()
+        oracle[t] = chain_heads(w0.arena.tenants[slot])
+    report = ctl.failover("w0", now=round(now, 6))
+    assert len(report["tenants"]) == 2, report["tenants"]
+    # Chain heads of every spliced tenant match the oracle bit-for-bit.
+    for t, info in report["tenants"].items():
+        mw = fleet[info["survivor"]][0]
+        got = chain_heads(mw.arena.tenants[info["slot"]])
+        assert got == oracle[int(t)], (
+            f"tenant {t} chain head diverged after reassignment to "
+            f"{info['survivor']}: {got} != {oracle[int(t)]}"
+        )
+    # The zombie: fenced resume append leaves ZERO new records on disk.
+    zombie_wal = w0.durability.tenant_dir(0) / "wal.log"
+    before = len(wal_scan(zombie_wal).committed)
+    try:
+        with w0.durability.wal(0).txn("zombie_resume", {}):
+            pass
+        raise AssertionError("zombie WAL append was NOT fenced")
+    except FencingError:
+        pass
+    doubles = len(wal_scan(zombie_wal).committed) - before
+    assert doubles == 0, f"{doubles} double-applied WAL record(s)"
+    return {
+        "digest": report["ownership_digest"],
+        "replayed": report["replayed_ops"],
+        "survivors": report["survivors"],
+        "journal": om.observations,
+    }
+
+
+with tempfile.TemporaryDirectory() as td:
+    a = run_drill(Path(td) / "a")
+    b = run_drill(Path(td) / "b")
+assert a["digest"] == b["digest"] and a["digest"], (
+    "ownership transition digest NOT bit-identical over 2 drill "
+    f"replays:\n  {a['digest']}\n  {b['digest']}"
+)
+again = OwnershipMap.replay(a["journal"], seed=SEED)
+assert again.transition_digest() == a["digest"], (
+    "journal replay diverged from the live ownership digest"
+)
+print(
+    f"failover gate OK: w0 killed + convicted, {a['replayed']} WAL "
+    f"op(s) replayed into survivors {a['survivors']}, chain heads "
+    f"match the pre-kill oracle, zombie fenced with 0 double-applies, "
+    f"digest bit-identical over 2 drill replays + journal replay"
+)
+PY
+failover_rc=$?
+
 echo "── hvlint static-analysis gate ──"
 # The contract analyzer (ISSUE 12): Tier A pure-AST rules (WAL
 # coverage, env arming, lock discipline, append-only registries, twin
@@ -1240,6 +1391,10 @@ fi
 if [ "$incident_rc" -ne 0 ]; then
     echo "hindsight-plane gate FAILED (rc=$incident_rc)" >&2
     exit "$incident_rc"
+fi
+if [ "$failover_rc" -ne 0 ]; then
+    echo "fleet failover gate FAILED (rc=$failover_rc)" >&2
+    exit "$failover_rc"
 fi
 if [ "$hvlint_rc" -ne 0 ]; then
     echo "hvlint static-analysis gate FAILED (rc=$hvlint_rc)" >&2

@@ -195,9 +195,12 @@ class TestHTTPTransport:
         # decision plane (/debug/autopilot), and the fleet observatory
         # (/debug/fleet + /fleet/{workers,metrics,slo,trace/{id}}),
         # and the hindsight plane (/debug/incidents,
-        # /incidents/{incident_id}, /history/query, /fleet/incidents):
-        # 55 routes.
-        assert len(ROUTES) == 55
+        # /incidents/{incident_id}, /history/query, /fleet/incidents),
+        # and the failover plane (/fleet/ownership, /fleet/failover):
+        # 57 routes.
+        assert len(ROUTES) == 57
+        assert any(path == "/fleet/ownership" for _, path, _, _ in ROUTES)
+        assert any(path == "/fleet/failover" for _, path, _, _ in ROUTES)
         assert any(path == "/debug/incidents" for _, path, _, _ in ROUTES)
         assert any(path == "/history/query" for _, path, _, _ in ROUTES)
         assert any(path == "/fleet/incidents" for _, path, _, _ in ROUTES)

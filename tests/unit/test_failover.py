@@ -1,0 +1,603 @@
+"""Fleet failover plane: durable ownership, fencing, reassignment.
+
+The headline test is the multi-tenant kill-at-every-WAL-record-boundary
+property: a two-tenant arena journals an interleaved workload into
+per-tenant fenced WALs under a `WorkerDurability` namespace, with a
+mid-workload per-tenant checkpoint; then tenant 0's WAL is truncated at
+every record boundary (and mid-record) to simulate the worker dying at
+that byte, recovered per-tenant (`recover_tenant`), and SPLICED into a
+DIFFERENT worker's arena — the survivor's materialized tables + Merkle
+chain heads must land bit-identical to the uninterrupted oracle's
+snapshot of the last committed op, per tenant.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+from hypervisor_tpu.config import HypervisorConfig, TableCapacity
+from hypervisor_tpu.fleet.failover import (
+    FailoverController,
+    FailoverError,
+    FencingError,
+    ManagedWorker,
+    OwnershipMap,
+    WorkerDurability,
+)
+from hypervisor_tpu.models import SessionConfig
+from hypervisor_tpu.resilience.recovery import recover_tenant
+from hypervisor_tpu.resilience.wal import scan
+from hypervisor_tpu.runtime.checkpoint import state_arrays
+from hypervisor_tpu.tenancy import TenantArena
+from hypervisor_tpu.testing.chaos import (
+    InjectedFleetFault,
+    WaveChaosInjector,
+    WaveChaosPlan,
+)
+
+SMALL = HypervisorConfig(
+    capacity=TableCapacity(
+        max_agents=64,
+        max_sessions=32,
+        max_vouch_edges=64,
+        max_sagas=16,
+        max_steps_per_saga=8,
+        max_elevations=16,
+        delta_log_capacity=128,
+        event_log_capacity=128,
+        trace_log_capacity=128,
+    )
+)
+
+
+def _fingerprint(st) -> dict:
+    """Everything the reassignment property compares bit-for-bit."""
+    return {
+        "arrays": state_arrays(st),
+        "chain": {
+            s: tuple(int(w) for w in v) for s, v in st._chain_seed.items()
+        },
+        "members": set(st._members),
+        "turns": dict(st._turns),
+    }
+
+
+def _assert_same(a: dict, b: dict, ctx: str = "") -> None:
+    assert a["chain"] == b["chain"], f"chain head diverged {ctx}"
+    assert a["members"] == b["members"], f"membership diverged {ctx}"
+    assert a["turns"] == b["turns"], f"turn counters diverged {ctx}"
+    for key in a["arrays"]:
+        np.testing.assert_array_equal(
+            a["arrays"][key], b["arrays"][key],
+            err_msg=f"column {key} diverged {ctx}",
+        )
+
+
+# ── the journaled ownership map ──────────────────────────────────────
+
+
+class TestOwnershipMap:
+    def test_assign_fence_and_views(self):
+        events = []
+        om = OwnershipMap(seed=3, emit=lambda k, p: events.append(k))
+        om.assign("w0", (0, 1), 0, 1.0)
+        om.assign("w1", (2,), 0, 1.0)
+        assert om.owner_of(1) == ("w0", 0)
+        assert om.owner_of(9) is None
+        assert om.tenants_of("w1") == (2,)
+        assert om.epoch == 0
+        om.fence("w0", 1, 2.0)
+        assert om.is_fenced("w0", 0)
+        assert not om.is_fenced("w0", 1)
+        assert events == [
+            "fleet_ownership_changed", "fleet_ownership_changed",
+            "fleet_worker_fenced",
+        ]
+        doc = om.summary()
+        json.dumps(doc)  # JSON-able contract (the /fleet/ownership body)
+        assert doc["transition_count"] == 3
+
+    def test_stale_epoch_assign_refuses_before_journaling(self):
+        om = OwnershipMap(seed=0)
+        om.assign("w0", (0,), 2, 1.0)
+        n_obs = len(om.observations)
+        with pytest.raises(FencingError):
+            om.assign("w1", (1,), 1, 1.5)  # below the map's epoch
+        om.fence("w2", 5, 2.0)
+        with pytest.raises(FencingError):
+            om.assign("w2", (3,), 3, 2.5)  # below w2's fence floor
+        # refused ops never journaled: replay can't diverge on them
+        assert len(om.observations) == n_obs + 1  # only the fence landed
+
+    def test_replay_is_bit_identical(self):
+        om = OwnershipMap(seed=42)
+        om.assign("w0", (0, 1), 0, 1.0)
+        om.assign("w1", (2, 3), 0, 1.25)
+        om.fence("w0", 1, 2.0)
+        om.assign("w1", (0, 1, 2, 3), 1, 2.5)
+        om.assign("w0", (), 1, 2.5)
+        again = OwnershipMap.replay(om.observations, seed=42)
+        assert again.transition_digest() == om.transition_digest()
+        assert [t.replay_key() for t in again.transitions] == [
+            t.replay_key() for t in om.transitions
+        ]
+        other_seed = OwnershipMap.replay(om.observations, seed=43)
+        assert other_seed.transition_digest() != om.transition_digest()
+
+
+# ── the durability namespace + the fence ─────────────────────────────
+
+
+class TestWorkerDurability:
+    def test_shared_root_never_collides(self, tmp_path):
+        """Satellite 2: two specs on ONE durability root get disjoint
+        (worker id, epoch, tenant) namespaces."""
+        d0 = WorkerDurability(
+            tmp_path, "w0", epoch=0, tenants=(0,), fsync=False
+        ).adopt()
+        d1 = WorkerDurability(
+            tmp_path, "w1", epoch=0, tenants=(0,), fsync=False
+        ).adopt()
+        with d0.wal(0).txn("op", {"who": "w0"}):
+            pass
+        with d1.wal(0).txn("op", {"who": "w1"}):
+            pass
+        p0 = tmp_path / "w0" / "epoch_0" / "tenant_0" / "wal.log"
+        p1 = tmp_path / "w1" / "epoch_0" / "tenant_0" / "wal.log"
+        assert p0 != p1 and p0.exists() and p1.exists()
+        (r0,) = scan(p0).committed
+        (r1,) = scan(p1).committed
+        assert r0.args == {"who": "w0"} and r1.args == {"who": "w1"}
+
+    def test_adopt_refuses_newer_epoch_loudly(self, tmp_path):
+        WorkerDurability(
+            tmp_path, "w0", epoch=4, tenants=(0,), fsync=False
+        ).adopt()
+        with pytest.raises(FencingError, match="epoch 4"):
+            WorkerDurability(
+                tmp_path, "w0", epoch=3, tenants=(0,), fsync=False
+            ).adopt()
+        # equal or newer adopters proceed (restart, then failover bump)
+        WorkerDurability(
+            tmp_path, "w0", epoch=4, tenants=(0,), fsync=False
+        ).adopt()
+        WorkerDurability(
+            tmp_path, "w0", epoch=5, tenants=(0,), fsync=False
+        ).adopt()
+
+    def test_adopt_refuses_below_fence_floor(self, tmp_path):
+        WorkerDurability.write_fence(tmp_path, "w0", 2)
+        with pytest.raises(FencingError, match="fence floor 2"):
+            WorkerDurability(
+                tmp_path, "w0", epoch=1, tenants=(0,), fsync=False
+            ).adopt()
+
+    def test_fenced_append_writes_zero_bytes(self, tmp_path):
+        d = WorkerDurability(
+            tmp_path, "w0", epoch=0, tenants=(0,), fsync=False
+        ).adopt()
+        w = d.wal(0)
+        with w.txn("before", {}):
+            pass
+        before = w.path.read_bytes()
+        WorkerDurability.write_fence(tmp_path, "w0", 1)
+        with pytest.raises(FencingError):
+            with w.txn("zombie", {}):
+                pass
+        assert w.path.read_bytes() == before  # ZERO bytes reached disk
+        assert w.fenced_appends == 1
+        s = scan(w.path)
+        assert [r.op for r in s.committed] == ["before"]
+
+    def test_fenced_checkpoint_never_publishes(self, tmp_path):
+        from hypervisor_tpu.state import HypervisorState
+
+        d = WorkerDurability(
+            tmp_path, "w0", epoch=0, tenants=(0,), fsync=False
+        ).adopt()
+        st = HypervisorState(SMALL)
+        d.checkpoint(st, 0, step=1)
+        WorkerDurability.write_fence(tmp_path, "w0", 1)
+        with pytest.raises(FencingError):
+            d.checkpoint(st, 0, step=2)
+        steps = sorted(
+            p.name for p in d.tenant_dir(0).iterdir()
+            if p.name.startswith("step_")
+        )
+        assert steps == ["step_1"]  # the fenced save left nothing
+
+    def test_fence_floors_only_rise_and_torn_fence_fails_closed(
+        self, tmp_path
+    ):
+        WorkerDurability.write_fence(tmp_path, "w0", 3)
+        WorkerDurability.write_fence(tmp_path, "w0", 1)  # ignored
+        assert WorkerDurability.read_fence(tmp_path, "w0") == 3
+        (tmp_path / "w0" / "FENCE").write_text("{torn garbag")
+        assert WorkerDurability.read_fence(tmp_path, "w0") >= 1 << 62
+
+
+# ── the reassignment property (satellite 3) ──────────────────────────
+
+
+def _drive_tenant(st, tag: str, snap) -> int:
+    """Pre-checkpoint workload for one arena tenant; returns nothing —
+    the caller checkpoints. `snap()` records after every journaled op."""
+    slot = st.create_session(
+        f"s:{tag}", SessionConfig(min_sigma_eff=0.0), now=1.0
+    )
+    snap()
+    st.enqueue_join(slot, f"did:{tag}:a", 0.8)
+    snap()
+    st.enqueue_join(slot, f"did:{tag}:b", 0.7)
+    snap()
+    st.flush_joins(now=2.0)
+    snap()
+    return slot
+
+
+def _drive_tenant_suffix(st, tag: str, slot: int, snap) -> None:
+    """The WAL suffix past the checkpoint."""
+    a = st.agent_row(f"did:{tag}:a")["slot"]
+    st.stage_delta(
+        slot, a, ts=3.0, change_words=np.arange(4, dtype=np.uint32)
+    )
+    snap()
+    st.flush_deltas()
+    snap()
+    st.terminate_sessions([slot], now=5.0)
+    snap()
+
+
+class TestReassignmentBitIdentity:
+    def test_kill_at_every_wal_boundary_then_splice_elsewhere(
+        self, tmp_path
+    ):
+        # ── the doomed worker: a 2-tenant arena, durable namespace ──
+        arena = TenantArena(2, SMALL)
+        dur = WorkerDurability(
+            tmp_path / "root", "w-dead", epoch=0, tenants=(0, 1),
+            fsync=False,
+        ).adopt()
+        snapshots: dict[int, dict] = {}
+
+        def snap0():
+            st = arena.tenants[0]
+            snapshots[st.journal.last_seq] = _fingerprint(st)
+
+        for t in (0, 1):
+            arena.tenants[t].journal = dur.wal(t)
+        slots = {}
+        for t, tag in ((0, "t0"), (1, "t1")):
+            st = arena.tenants[t]
+            slots[t] = _drive_tenant(
+                st, tag, snap0 if t == 0 else (lambda: None)
+            )
+        arena.sync()
+        watermark = arena.tenants[0].journal.last_seq
+        for t in (0, 1):
+            dur.checkpoint(arena.tenants[t], t, step=1)
+        for t, tag in ((0, "t0"), (1, "t1")):
+            _drive_tenant_suffix(
+                arena.tenants[t], tag, slots[t],
+                snap0 if t == 0 else (lambda: None),
+            )
+        arena.sync()
+        snap0()
+        tip1 = _fingerprint(arena.tenants[1])
+        for t in (0, 1):
+            arena.tenants[t].journal.flush()
+
+        # ── a DIFFERENT worker to splice into ──
+        survivor = TenantArena(2, SMALL)
+        raw = dur.tenant_dir(0).joinpath("wal.log").read_bytes()
+
+        # One working copy of the dead worker's bundle whose tenant-0
+        # WAL is rewritten per crash point.
+        bundle = tmp_path / "bundle"
+        shutil.copytree(dur.epoch_dir, bundle)
+        torn_wal = bundle / "tenant_0" / "wal.log"
+
+        boundaries = [0]
+        for line in raw.splitlines(keepends=True):
+            boundaries.append(boundaries[-1] + len(line))
+        offsets = sorted(set(boundaries) | {b - 3 for b in boundaries[1:]})
+
+        for off in offsets:
+            torn_wal.write_bytes(raw[:off])
+            committed = scan(torn_wal).committed
+            expected_seq = max(
+                max((r.seq for r in committed), default=0), watermark
+            )
+            back, report = recover_tenant(bundle, 0, config=SMALL)
+            assert report["tenant"] == 0
+            assert report["wal_records_replayed"] == len(
+                [r for r in committed if r.seq > watermark]
+            )
+            # reassignment: the recovered tenant lands in ANOTHER
+            # worker's arena slot; the comparison reads the SURVIVOR's
+            # materialized view, so the splice itself is under test.
+            survivor.splice_tenant(1, back)
+            _assert_same(
+                snapshots[expected_seq],
+                _fingerprint(survivor.tenants[1]),
+                ctx=f"(crash at byte {off}, seq {expected_seq})",
+            )
+
+        # the OTHER tenant recovers to tip independently — per-tenant
+        # extraction never bleeds across tenant namespaces.
+        back1, report1 = recover_tenant(bundle, 1, config=SMALL)
+        survivor.splice_tenant(0, back1)
+        _assert_same(
+            tip1, _fingerprint(survivor.tenants[0]), ctx="(tenant 1 tip)"
+        )
+        with pytest.raises(Exception):
+            recover_tenant(bundle, 7, config=SMALL)  # no such namespace
+
+    def test_spliced_tenant_keeps_serving(self, tmp_path):
+        """After a splice the survivor slot is a LIVE tenant: host ops
+        and waves keep running on the adopted state."""
+        donor = TenantArena(1, SMALL)
+        dur = WorkerDurability(
+            tmp_path, "w-d", epoch=0, tenants=(0,), fsync=False
+        ).adopt()
+        donor.tenants[0].journal = dur.wal(0)
+        st = donor.tenants[0]
+        slot = _drive_tenant(st, "live", lambda: None)
+        donor.sync()
+        dur.checkpoint(st, 0, step=1)
+        back, _ = recover_tenant(dur.epoch_dir, 0, config=SMALL)
+
+        survivor = TenantArena(2, SMALL)
+        survivor.splice_tenant(1, back)
+        adopted = survivor.tenants[1]
+        assert adopted.agent_row("did:live:a")["slot"] >= 0
+        s2 = adopted.create_session(
+            "s:post-splice", SessionConfig(min_sigma_eff=0.0), now=6.0
+        )
+        adopted.enqueue_join(s2, "did:post", 0.9)
+        assert (adopted.flush_joins(now=6.5) == 0).all()
+        survivor.sync()
+        assert adopted.agent_row("did:post")["slot"] >= 0
+        assert slot != s2 or True  # slots may coincide; liveness is the pin
+
+    def test_splice_refuses_capacity_mismatch(self, tmp_path):
+        from hypervisor_tpu.fleet.worker import _small_capacity_config
+        from hypervisor_tpu.state import HypervisorState
+
+        other = HypervisorState(_small_capacity_config())
+        arena = TenantArena(1, SMALL)
+        with pytest.raises(ValueError, match="capacity"):
+            arena.splice_tenant(0, other)
+        with pytest.raises(ValueError, match="slot"):
+            arena.splice_tenant(5, HypervisorState(SMALL))
+
+
+# ── the failover controller drill ────────────────────────────────────
+
+
+def _managed(tmp_path, wid, tenants, n_slots, config=SMALL, epoch=0):
+    arena = TenantArena(n_slots, config)
+    dur = WorkerDurability(
+        tmp_path, wid, epoch=epoch, tenants=tenants, fsync=False
+    ).adopt()
+    slot_of = {}
+    for slot, t in enumerate(tenants):
+        arena.tenants[slot].journal = dur.wal(t)
+        slot_of[t] = slot
+    return ManagedWorker(
+        wid, arena, dur, slot_of, list(range(len(tenants), n_slots))
+    )
+
+
+def _run_drill(tmp_path, seed=11):
+    w0 = _managed(tmp_path, "w0", (0, 1), 2)
+    w1 = _managed(tmp_path, "w1", (2,), 3)
+    w2 = _managed(tmp_path, "w2", (3,), 3)
+    slots = {}
+    for t, slot in w0.slot_of.items():
+        st = w0.arena.tenants[slot]
+        slots[t] = _drive_tenant(st, f"d{t}", lambda: None)
+    w0.arena.sync()
+    for t, slot in w0.slot_of.items():
+        w0.durability.checkpoint(w0.arena.tenants[slot], t, step=1)
+    for t, slot in w0.slot_of.items():
+        _drive_tenant_suffix(
+            w0.arena.tenants[slot], f"d{t}", slots[t], lambda: None
+        )
+    w0.arena.sync()
+    for slot in w0.slot_of.values():
+        w0.arena.tenants[slot].journal.flush()
+
+    om = OwnershipMap(seed=seed)
+    ctl = FailoverController(om, config=SMALL)
+    for w in (w0, w1, w2):
+        ctl.register(w, now=0.0)
+    report = ctl.failover("w0", now=10.0)
+    return w0, w1, w2, om, ctl, report
+
+
+class TestFailoverController:
+    def test_drill_reassigns_fences_and_is_deterministic(self, tmp_path):
+        w0, w1, w2, om, ctl, report = _run_drill(tmp_path / "a")
+        # deficit-aware spread: the tie breaks to w1 by id, then w1's
+        # load (2) exceeds w2's (1), so the second orphan spreads.
+        assert report["tenants"][0]["survivor"] == "w1"
+        assert report["tenants"][1]["survivor"] == "w2"
+        assert report["replayed_ops"] > 0
+        assert om.tenants_of("w0") == ()
+        assert om.owner_of(0) == ("w1", 1)
+        assert om.owner_of(1) == ("w2", 1)
+        assert om.epoch == 1
+        # survivors now durably own the spliced tenants
+        for t, d in report["tenants"].items():
+            mw = {"w1": w1, "w2": w2}[d["survivor"]]
+            wal = mw.durability.tenant_dir(t) / "wal.log"
+            assert wal.exists()
+            assert (
+                mw.durability.tenant_dir(t) / "latest" / ".done"
+            ).exists()
+        # the zombie is fenced at the durable boundary
+        with pytest.raises(FencingError):
+            with w0.durability.wal(0).txn("zombie", {}):
+                pass
+        # ... and the whole drill replays bit-identically
+        _, _, _, om_b, _, report_b = _run_drill(tmp_path / "b")
+        assert report_b["ownership_digest"] == report["ownership_digest"]
+        assert OwnershipMap.replay(
+            om.observations, seed=11
+        ).transition_digest() == om.transition_digest()
+        json.dumps(ctl.summary())  # the /fleet/failover body
+
+    def test_no_spare_capacity_refuses(self, tmp_path):
+        w0 = _managed(tmp_path, "w0", (0,), 1)
+        w1 = _managed(tmp_path, "w1", (1,), 1)  # zero spare slots
+        st = w0.arena.tenants[0]
+        _drive_tenant(st, "full", lambda: None)
+        w0.arena.sync()
+        w0.durability.checkpoint(st, 0, step=1)
+        om = OwnershipMap(seed=0)
+        ctl = FailoverController(om, config=SMALL)
+        ctl.register(w0, now=0.0)
+        ctl.register(w1, now=0.0)
+        with pytest.raises(FailoverError, match="spare"):
+            ctl.failover("w0", now=1.0)
+
+    def test_unknown_worker_refuses(self):
+        ctl = FailoverController(OwnershipMap(seed=0))
+        with pytest.raises(FailoverError, match="unknown"):
+            ctl.failover("ghost", now=1.0)
+
+
+# ── fleet-layer chaos scheduling ─────────────────────────────────────
+
+
+class TestFleetChaos:
+    def test_take_fleet_faults_is_seeded_and_once_only(self):
+        plan = WaveChaosPlan(seed=5, fleet_faults=(
+            InjectedFleetFault("worker_sigkill", at_round=2, worker="w0"),
+            InjectedFleetFault("torn_checkpoint", at_round=4, worker="w1"),
+            InjectedFleetFault("worker_sigstop", at_round=2, worker="w2"),
+        ))
+        inj = WaveChaosInjector(plan)
+        assert inj.has_pending_fleet_faults
+        assert inj.take_fleet_faults(1) == []
+        due = inj.take_fleet_faults(2)
+        assert sorted(f.kind for f in due) == [
+            "worker_sigkill", "worker_sigstop",
+        ]
+        assert inj.take_fleet_faults(2) == []  # handed out exactly once
+        (late,) = inj.take_fleet_faults(9)     # overdue faults still fire
+        assert late.kind == "torn_checkpoint"
+        assert not inj.has_pending_fleet_faults
+        doc = inj.report()
+        assert doc["fleet_faults_pending"] == 0
+        assert [f["kind"] for f in doc["fleet_faults_taken"]] == [
+            "worker_sigkill", "worker_sigstop", "torn_checkpoint",
+        ]
+        # adding fleet faults never perturbs the wave-layer schedule
+        bare = WaveChaosInjector(WaveChaosPlan(seed=5, fail_rate=0.3))
+        with_faults = WaveChaosInjector(
+            WaveChaosPlan(seed=5, fail_rate=0.3, fleet_faults=(
+                InjectedFleetFault(),
+            ))
+        )
+
+        def sched(i):
+            out = []
+            for _ in range(32):
+                try:
+                    i.on_dispatch("governance_wave")
+                    out.append(0)
+                except Exception:
+                    out.append(1)
+            return out
+
+        assert sched(bare) == sched(with_faults)
+
+
+# ── API surface ──────────────────────────────────────────────────────
+
+
+class TestFailoverApi:
+    def _svc(self):
+        from hypervisor_tpu.api.service import HypervisorService
+
+        return HypervisorService()
+
+    def test_routes_registered_on_the_shared_table(self):
+        from hypervisor_tpu.api.server import ROUTES
+
+        paths = {r[1] for r in ROUTES}
+        assert "/fleet/ownership" in paths
+        assert "/fleet/failover" in paths
+
+    def test_503_without_fleet_then_without_plane(self):
+        from hypervisor_tpu.api.service import ApiError
+        from hypervisor_tpu.fleet import FleetObservatory
+
+        svc = self._svc()
+        for call in (svc.fleet_ownership(), svc.fleet_failover()):
+            with pytest.raises(ApiError) as ei:
+                asyncio.run(call)
+            assert ei.value.status == 503
+        svc.fleet = FleetObservatory({})
+        with pytest.raises(ApiError, match="ownership"):
+            asyncio.run(svc.fleet_ownership())
+        with pytest.raises(ApiError, match="failover"):
+            asyncio.run(svc.fleet_failover())
+
+    def test_attached_planes_serve_their_summaries(self):
+        from hypervisor_tpu.fleet import FleetObservatory
+
+        svc = self._svc()
+        svc.fleet = FleetObservatory({})
+        om = OwnershipMap(seed=9)
+        om.assign("w0", (0,), 0, 1.0)
+        svc.fleet.ownership = om
+        svc.fleet.failover = FailoverController(om)
+        doc = asyncio.run(svc.fleet_ownership())
+        assert doc["owners"]["w0"]["tenants"] == [0]
+        assert doc["transition_digest"] == om.transition_digest()
+        doc2 = asyncio.run(svc.fleet_failover())
+        assert doc2["epoch"] == 0 and doc2["reassignments"] == []
+        json.dumps(doc) and json.dumps(doc2)
+
+
+# ── graceful drain (satellite 1) ─────────────────────────────────────
+
+
+class TestGracefulDrain:
+    def test_sigterm_drain_hands_off_with_zero_replay(self, tmp_path):
+        """SIGTERM → the worker flushes its WALs, publishes final
+        per-tenant checkpoints + `.done`, prints the DRAINED marker, and
+        exits 0; the adopter's recovery replays ZERO WAL records."""
+        from hypervisor_tpu.fleet import FleetSupervisor, WorkerSpec
+        from hypervisor_tpu.fleet.worker import _small_capacity_config
+
+        spec = WorkerSpec(
+            worker_id="w0", tenants=(0, 1),
+            durability_root=str(tmp_path), epoch=0,
+        )
+        sup = FleetSupervisor([spec])
+        sup.start()
+        try:
+            marker = sup.drain("w0")
+        finally:
+            sup.stop()
+        assert marker is not None
+        assert marker["worker_id"] == "w0"
+        assert set(marker["tenants"]) == {"0", "1"}
+        cfg = _small_capacity_config()
+        for t in (0, 1):
+            wal_seq = marker["tenants"][str(t)]["wal_seq"]
+            assert wal_seq > 0  # warm rounds DID journal
+            _, report = recover_tenant(
+                tmp_path / "w0" / "epoch_0", t, config=cfg
+            )
+            assert report["wal_records_replayed"] == 0
+            assert report["wal_watermark_seq"] == wal_seq

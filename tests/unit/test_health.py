@@ -873,6 +873,38 @@ def _suite_report(
             if round_no >= 19
             else None
         ),
+        # Rounds >= regression.FAILOVER_ROW_SINCE must carry the fleet
+        # failover row (round-20 presence gate, ISSUE 19); detection
+        # is budget-gated, the ownership digest must replay
+        # bit-identically, the fenced zombie's double-applied-op count
+        # and the post-splice recompile count are hard-gated to zero.
+        "failover": (
+            {
+                "seed": 20,
+                "quick": quick,
+                "workers": 3,
+                "killed": "w0",
+                "detection_windows": 1,
+                "budget_windows": 2,
+                "absorb_wall_s": 1.1,
+                "absorb_windows": 4.4,
+                "replayed_ops": 4,
+                "tenants_reassigned": 2,
+                "survivors": ["w1", "w2"],
+                "zombie_fenced": True,
+                "double_applied_ops": 0,
+                "post_splice_rounds": 8,
+                "post_splice_wall_ms": {"p50": 10.0, "p99": 14.0},
+                "slo_p99_ms": 750.0,
+                "slo_ok": True,
+                "recompiles_after_splice": 0,
+                "replays": 2,
+                "digest_match": 1.0,
+                "ownership_digest": "ef" * 32,
+            }
+            if round_no >= 20
+            else None
+        ),
     }
 
 
@@ -1396,6 +1428,66 @@ class TestRegressionHarness:
             assert check(clean_path_overhead_pct=40.0) == 0
         finally:
             del os.environ["HV_BENCH_INCIDENT_OVERHEAD"]
+
+    def test_missing_failover_row_fails_from_round_20(self, tmp_path):
+        # ISSUE 19 round 20: the failover row is REQUIRED from round
+        # 20 — dropping the reassign half's bench coverage is a
+        # regression.
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 19, _suite_report(19, {"full_governance_pipeline": 10.0})
+        )
+        doc = _suite_report(20, {"full_governance_pipeline": 10.0})
+        doc["failover"] = None
+        self._write(tmp_path, 20, doc)
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # A round carrying the row passes, and the trajectory keeps it.
+        self._write(
+            tmp_path, 20,
+            _suite_report(20, {"full_governance_pipeline": 10.0}),
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
+        rows = regression.load_history(tmp_path)
+        fo = rows[-1]["failover"]
+        assert fo["tenants_reassigned"] == 2
+        assert fo["digest_match"] == 1.0
+        assert fo["double_applied_ops"] == 0
+
+    def test_failover_gates_budget_and_hard_contracts(self, tmp_path):
+        # The ISSUE 19 round-20 acceptance bars: conviction inside the
+        # windowed detection budget (HV_BENCH_FAILOVER_DETECT
+        # overrides; never-convicted is a regression outright),
+        # ownership-digest bit-identity over 2 drill replays, the
+        # fenced zombie's hard-zero double-applied WAL ops, and
+        # hard-zero post-splice recompiles.
+        import os
+
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 19, _suite_report(19, {"full_governance_pipeline": 10.0})
+        )
+
+        def check(**overrides) -> int:
+            doc = _suite_report(20, {"full_governance_pipeline": 10.0})
+            doc["failover"].update(overrides)
+            self._write(tmp_path, 20, doc)
+            return regression.main(["--root", str(tmp_path), "--quiet"])
+
+        assert check() == 0
+        assert check(detection_windows=5) == 1     # over the budget
+        assert check(detection_windows=None) == 1  # never convicted
+        assert check(digest_match=0.0) == 1        # replay drifted
+        assert check(zombie_fenced=False) == 1     # zombie wrote through
+        assert check(double_applied_ops=3) == 1    # records re-committed
+        assert check(recompiles_after_splice=1) == 1  # splice compiled
+        # The env knob widens the detection budget (read per gate run).
+        os.environ["HV_BENCH_FAILOVER_DETECT"] = "6.0"
+        try:
+            assert check(detection_windows=5) == 0
+        finally:
+            del os.environ["HV_BENCH_FAILOVER_DETECT"]
 
     def test_next_round_path_advances(self, tmp_path):
         from benchmarks import regression

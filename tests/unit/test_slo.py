@@ -168,7 +168,7 @@ class TestSLOEngine:
         # Wire-format discipline: the new codes extend the enum, they
         # never renumber existing device-log rows (hvlint HVA004 pins
         # the committed baseline; this pins the tail order).
-        tail = list(EventType)[-10:]
+        tail = list(EventType)[-13:]
         assert tail == [
             EventType.SLO_RECOVERED,
             # Round 15 appended the roofline observatory's shift
@@ -188,6 +188,11 @@ class TestSLOEngine:
             # the fleet quad — append-only holds.
             EventType.INCIDENT_CAPTURED,
             EventType.INCIDENT_EVICTED,
+            # Round 20 appended the failover plane's triple BEHIND
+            # the incident pair — append-only holds.
+            EventType.FLEET_OWNERSHIP_CHANGED,
+            EventType.FLEET_WORKER_FENCED,
+            EventType.FLEET_TENANTS_REASSIGNED,
         ]
 
 

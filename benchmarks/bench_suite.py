@@ -2312,6 +2312,361 @@ def failover_benchmark(seed: int, quick: bool) -> dict:
     }
 
 
+def fleet_soak_benchmark(seed: int, quick: bool) -> dict:
+    """`--fleet-soak <seed>`: the round-21 rebalancing soak — a long
+    in-process 3-worker fleet run on a VIRTUAL clock that mixes the
+    planned-migration plane with the crash plane at >=10x the failover
+    row's session count:
+
+    * rolling rebalances: every few rounds the deficit-aware planner
+      proposes and EXECUTES zero-loss migrations (seal -> drain ->
+      final checkpoint -> per-tenant fence -> adopt -> commit); the
+      clean path replays ZERO WAL records per move;
+    * a plain SIGKILL failover mid-soak (round 20's drill, now under
+      sustained traffic), and a SECOND kill that lands mid-migration
+      (source dies after `drain_source`, pre-fence) — failover wins
+      the race, the migration aborts in the journal, and the tenant
+      reassigns through the same splice path;
+    * each dead worker's zombie resume refuses with zero bytes —
+      `double_applied_ops` is the on-disk delta, hard-gated == 0;
+    * exactly-one ownership is asserted EVERY round from the journal
+      (`ownership_violations`, hard-gated == 0), and the `[T, ...]`
+      splice contract keeps post-warmup recompiles at 0;
+    * determinism: two full soak replays must produce the same
+      ownership transition digest.
+
+    `regression.py` presence-gates the row from this round and
+    hard-gates the zeros, the session floor (>=10x the failover row),
+    digest match, and p99 round wall within the smoke SLO.
+    """
+    import tempfile
+    import time as _time
+    from pathlib import Path as _Path
+
+    from hypervisor_tpu.fleet import (
+        DEAD,
+        FleetRegistry,
+        LeaseConfig,
+    )
+    from hypervisor_tpu.fleet.failover import (
+        FailoverController,
+        FencingError,
+        ManagedWorker,
+        OwnershipMap,
+        WorkerDurability,
+    )
+    from hypervisor_tpu.fleet.rebalance import RebalanceController
+    from hypervisor_tpu.config import DEFAULT_CONFIG, TableCapacity
+    from hypervisor_tpu.observability import health as health_plane
+    from hypervisor_tpu.resilience.wal import scan as wal_scan
+    from hypervisor_tpu.serving import ServingConfig
+    from hypervisor_tpu.tenancy import (
+        TenantArena,
+        TenantFrontDoor,
+        TenantWaveScheduler,
+    )
+    from hypervisor_tpu.testing.chaos import (
+        InjectedFleetFault,
+        WaveChaosInjector,
+        WaveChaosPlan,
+    )
+
+    lease = LeaseConfig(heartbeat_interval_s=0.25)
+    base = 2000.0 + (seed % 997)
+    rounds = 135 if quick else 220
+    # The gate-6i small-table config, with the session table sized to
+    # the soak: one lifecycle session lands per tenant per round and
+    # parked sessions accrue, so a worker that ends up owning every
+    # tenant needs ~`rounds` rows per tenant slot.
+    cfg = DEFAULT_CONFIG.replace(capacity=TableCapacity(
+        max_agents=64, max_sessions=rounds + 64, max_vouch_edges=64,
+        max_sagas=16, max_steps_per_saga=4, max_elevations=16,
+        delta_log_capacity=1024, event_log_capacity=64,
+        trace_log_capacity=64,
+    ))
+    rebalance_every = 9
+    checkpoint_every = 20
+    kill1_round = rounds // 3       # plain SIGKILL (w0)
+    kill2_round = (2 * rounds) // 3  # SIGKILL mid-migration (w1)
+
+    plan = WaveChaosPlan(seed=seed, fleet_faults=(
+        InjectedFleetFault(
+            "worker_sigkill", at_round=kill1_round, worker="w0"
+        ),
+        InjectedFleetFault(
+            "migration_kill_source", at_round=kill2_round, worker="w1"
+        ),
+    ))
+
+    def build(root, wid, tenants, n_slots):
+        arena = TenantArena(n_slots, cfg)
+        front = TenantFrontDoor(arena, ServingConfig(buckets=(4, 8)))
+        sched = TenantWaveScheduler(front)
+        sched.warm(now=0.0)
+        dur = WorkerDurability(
+            root, wid, epoch=0, tenants=tenants, fsync=False
+        ).adopt()
+        slot_of = {}
+        for slot, t in enumerate(tenants):
+            arena.tenants[slot].journal = dur.wal(t)
+            slot_of[t] = slot
+        mw = ManagedWorker(
+            wid, arena, dur, slot_of, list(range(len(tenants), n_slots))
+        )
+        return mw, front, sched
+
+    def lifecycle_round(mw, front, sched, r, now):
+        for t, slot in sorted(mw.slot_of.items()):
+            front.submit_lifecycle(
+                slot, f"{mw.worker_id}:r{r}:{t}",
+                f"did:soak:{seed}:{mw.worker_id}:{r}:{t}", 0.8, now=now,
+            )
+        sched.lifecycle_round(now)
+        return len(mw.slot_of)
+
+    def flush_worker(mw):
+        mw.arena.sync()
+        for slot in mw.slot_of.values():
+            journal = mw.arena.tenants[slot].journal
+            if journal is not None:
+                journal.flush()
+
+    def run_soak(root) -> dict:
+        inj = WaveChaosInjector(plan)
+        fleet = {
+            "w0": build(root, "w0", (0, 1, 2), 5),
+            "w1": build(root, "w1", (3, 4), 5),
+            "w2": build(root, "w2", (5,), 8),
+        }
+        all_tenants = tuple(range(6))
+        reg = FleetRegistry(lease, seed=seed)
+        om = OwnershipMap(seed=seed)
+        ctl = FailoverController(om, config=cfg)
+        reb = RebalanceController(om, ctl)
+        now = base
+        for wid in sorted(fleet):
+            mw, front, sched = fleet[wid]
+            reg.register(wid, now)
+            ctl.register(mw, now=now)
+            reb.attach_serving(wid, front, sched)
+            # Every tenant durable from round 0: a kill at ANY round
+            # must recover from a checkpoint + committed-WAL suffix.
+            mw.arena.sync()
+            for t, slot in sorted(mw.slot_of.items()):
+                mw.durability.checkpoint(
+                    mw.arena.tenants[slot], t, step=0
+                )
+
+        dead_set: set[str] = set()
+        failed_over: dict[str, dict] = {}
+        dead_tenants: dict[str, list[int]] = {}
+        walls: dict[str, list[float]] = {w: [] for w in fleet}
+        sessions = 0
+        rebalance_runs = 0
+        migration_replayed = 0
+        failover_replayed = 0
+        zombies_fenced = 0
+        double_applied = 0
+        ownership_violations = 0
+        migrations_interrupted = 0
+        replay_compiles = 0
+        recomp_base = None
+
+        def least_loaded_dest(src):
+            cands = [
+                (len(mw.slot_of), wid)
+                for wid, (mw, _f, _s) in fleet.items()
+                if wid != src
+                and wid not in dead_set
+                and mw.spare_slots
+                and not reb._fenced_for(wid, min(fleet[src][0].slot_of))
+            ]
+            return min(cands)[1] if cands else None
+
+        for r in range(1, rounds + 1):
+            for fault in inj.take_fleet_faults(r):
+                if fault.kind == "worker_sigkill":
+                    dead_tenants[fault.worker] = sorted(
+                        fleet[fault.worker][0].slot_of
+                    )
+                    dead_set.add(fault.worker)
+                elif fault.kind == "migration_kill_source":
+                    src = fault.worker
+                    src_mw = fleet[src][0]
+                    if src_mw.slot_of:
+                        t = min(src_mw.slot_of)
+                        dst = least_loaded_dest(src)
+                        if dst is not None:
+                            # Source dies drained-but-unfenced: the
+                            # worst planned/crash interleaving.
+                            reb.migrate(
+                                t, dst, now, stop_after="drain_source"
+                            )
+                            migrations_interrupted += 1
+                    dead_tenants[src] = sorted(src_mw.slot_of)
+                    dead_set.add(src)
+            for wid in sorted(fleet):
+                mw, front, sched = fleet[wid]
+                if wid in dead_set:
+                    continue  # a SIGKILLed worker is SILENT
+                if mw.slot_of:
+                    t0 = _time.perf_counter()
+                    sessions += lifecycle_round(mw, front, sched, r, now)
+                    walls[wid].append(
+                        (_time.perf_counter() - t0) * 1e3
+                    )
+                reg.heartbeat(wid, now)
+            for worker, new in reg.evaluate(now).items():
+                if (
+                    new == DEAD
+                    and worker in dead_set
+                    and worker not in failed_over
+                ):
+                    flush_worker(fleet[worker][0])
+                    # The WAL-replay path compiles its solo programs
+                    # on first use (once per process) — that warmup is
+                    # not a serving recompile, so it is measured apart
+                    # and reported as `failover_replay_compiles`.
+                    rc0 = health_plane.compile_summary()["recompiles"]
+                    report = ctl.failover(worker, now=round(now, 6))
+                    replay_compiles += (
+                        health_plane.compile_summary()["recompiles"]
+                        - rc0
+                    )
+                    failed_over[worker] = report
+                    failover_replayed += report["replayed_ops"]
+                    # The zombie: the dead worker's fenced WAL must
+                    # refuse its resume append with ZERO bytes.
+                    zt = dead_tenants[worker][0]
+                    dur = fleet[worker][0].durability
+                    zwal = dur.tenant_dir(zt) / "wal.log"
+                    before = len(wal_scan(zwal).committed)
+                    try:
+                        with dur.wal(zt).txn("zombie_resume", {}):
+                            pass
+                    except FencingError:
+                        zombies_fenced += 1
+                    double_applied += (
+                        len(wal_scan(zwal).committed) - before
+                    )
+            now += lease.heartbeat_interval_s
+            if (
+                r % rebalance_every == 0
+                and not (dead_set - set(failed_over))
+            ):
+                rebalance_runs += 1
+                res = reb.execute(now)
+                for m in res["results"]:
+                    if m.get("status") == "committed":
+                        migration_replayed += m["replayed_ops"]
+            if r % checkpoint_every == 0:
+                for wid in sorted(fleet):
+                    if wid in dead_set:
+                        continue
+                    mw = fleet[wid][0]
+                    mw.arena.sync()
+                    for t, slot in sorted(mw.slot_of.items()):
+                        mw.durability.checkpoint(
+                            mw.arena.tenants[slot], t, step=r
+                        )
+            # Exactly-one ownership from the journal, EVERY round.
+            owners = om.summary(tail=1)["owners"]
+            for t in all_tenants:
+                holders = [
+                    w for w, rec in owners.items()
+                    if t in rec["tenants"]
+                ]
+                if len(holders) != 1:
+                    ownership_violations += 1
+            if r == 2:
+                recomp_base = health_plane.compile_summary()[
+                    "recompiles"
+                ]
+
+        recompiles = (
+            health_plane.compile_summary()["recompiles"]
+            - (recomp_base or 0)
+            - replay_compiles
+        )
+        reb_sum = reb.summary(tail=1)
+        return {
+            "sessions": sessions,
+            "rebalance_runs": rebalance_runs,
+            "migrations_committed": reb_sum["migration_count"],
+            "migrations_aborted": reb_sum["aborted_count"],
+            "migrations_interrupted": migrations_interrupted,
+            "migration_replayed_ops": migration_replayed,
+            "failover_replayed_ops": failover_replayed,
+            "failovers": len(failed_over),
+            "zombies_fenced": zombies_fenced,
+            "double_applied_ops": double_applied,
+            "ownership_violations": ownership_violations,
+            "recompiles_after_warmup": recompiles,
+            "failover_replay_compiles": replay_compiles,
+            "walls_ms": walls,
+            "ownership_digest": om.transition_digest(),
+        }
+
+    runs = []
+    with tempfile.TemporaryDirectory() as td:
+        for i in range(2):
+            runs.append(run_soak(_Path(td) / f"run{i}"))
+    a, b = runs
+
+    def pct(vals, q):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    merged = [w for ws in a["walls_ms"].values() for w in ws]
+    slo_p99_ms = 750.0
+    return {
+        "seed": seed,
+        "quick": quick,
+        "workers": 3,
+        "tenants": 6,
+        "rounds": rounds,
+        "sessions": a["sessions"],
+        "kills": ["w0", "w1"],
+        "failovers": a["failovers"],
+        "rebalance_runs": a["rebalance_runs"],
+        "migrations": {
+            "planned": (
+                a["migrations_committed"] + a["migrations_aborted"]
+            ),
+            "committed": a["migrations_committed"],
+            "aborted": a["migrations_aborted"],
+            "interrupted_by_kill": a["migrations_interrupted"],
+        },
+        "migration_replayed_ops": a["migration_replayed_ops"],
+        "failover_replayed_ops": a["failover_replayed_ops"],
+        "zombies_fenced": a["zombies_fenced"],
+        "double_applied_ops": a["double_applied_ops"],
+        "ownership_violations": a["ownership_violations"],
+        "recompiles_after_splice": a["recompiles_after_warmup"],
+        "failover_replay_compiles": a["failover_replay_compiles"],
+        "round_wall_ms": {
+            "p50": round(pct(merged, 0.50), 2),
+            "p99": round(pct(merged, 0.99), 2),
+        },
+        "per_worker_round_wall_ms": {
+            wid: {
+                "p50": round(pct(ws, 0.50), 2),
+                "p99": round(pct(ws, 0.99), 2),
+            }
+            for wid, ws in sorted(a["walls_ms"].items())
+            if ws
+        },
+        "slo_p99_ms": slo_p99_ms,
+        "slo_ok": pct(merged, 0.99) <= slo_p99_ms,
+        "replays": 2,
+        "digest_match": float(
+            a["ownership_digest"] == b["ownership_digest"]
+            and bool(a["ownership_digest"])
+        ),
+        "ownership_digest": a["ownership_digest"],
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=30)
@@ -2455,6 +2810,24 @@ def main() -> None:
             "ops), post-splice p50/p99 vs SLO on survivors, zero "
             "recompiles after splice, and ownership-digest bit-identity "
             "over 2 full drill replays"
+        ),
+    )
+    ap.add_argument(
+        "--fleet-soak",
+        type=int,
+        default=None,
+        metavar="SEED",
+        help=(
+            "also run the fleet rebalancing soak (ISSUE 20 round 21): "
+            "seeded 3-worker in-process fleet on a virtual clock at "
+            ">=10x the failover row's session count — rolling planned "
+            "zero-loss migrations, one plain SIGKILL failover plus one "
+            "kill landing mid-migration (failover wins, journaled "
+            "abort), fenced zombie resumes (zero double-applied ops), "
+            "exactly-one ownership asserted every round, zero "
+            "post-warmup recompiles, per-worker round-wall p50/p99 vs "
+            "SLO, and ownership-digest bit-identity over 2 full soak "
+            "replays"
         ),
     )
     ap.add_argument(
@@ -2745,6 +3118,40 @@ def main() -> None:
                 flush=True,
             )
 
+    # The rebalancing soak runs after the failover drill: it reuses
+    # the same virtual-clock fleet harness, so running it last keeps
+    # its (much longer) round-wall series off the other rows' walls.
+    fleet_soak_rec = None
+    if args.fleet_soak is not None:
+        fleet_soak_rec = fleet_soak_benchmark(args.fleet_soak, args.quick)
+        if not args.json_only:
+            rw = fleet_soak_rec["round_wall_ms"]
+            mig = fleet_soak_rec["migrations"]
+            print(
+                f"fleet-soak[seed={args.fleet_soak}]: "
+                f"{fleet_soak_rec['sessions']} sessions over "
+                f"{fleet_soak_rec['rounds']} rounds, migrations "
+                f"planned/committed/aborted "
+                f"{mig['planned']}/{mig['committed']}/{mig['aborted']} "
+                f"({fleet_soak_rec['migration_replayed_ops']} clean-"
+                f"path WAL ops replayed), "
+                f"{fleet_soak_rec['failovers']} failovers "
+                f"(kills {fleet_soak_rec['kills']}, "
+                f"{fleet_soak_rec['failover_replayed_ops']} ops "
+                f"replayed), zombies fenced="
+                f"{fleet_soak_rec['zombies_fenced']} (double-applied "
+                f"{fleet_soak_rec['double_applied_ops']}), "
+                f"{fleet_soak_rec['ownership_violations']} ownership "
+                f"violations, round wall p50/p99 "
+                f"{rw['p50']}/{rw['p99']} ms vs SLO "
+                f"{fleet_soak_rec['slo_p99_ms']} ms, "
+                f"{fleet_soak_rec['recompiles_after_splice']} "
+                f"recompiles after warmup, digest match "
+                f"{fleet_soak_rec['digest_match']} over "
+                f"{fleet_soak_rec['replays']} replays",
+                flush=True,
+            )
+
     static_rec = None
     if args.metrics_out:
         static_rec = static_analysis_row()
@@ -2874,6 +3281,17 @@ def main() -> None:
             # presence-gates it from round 20 and hard-gates digest
             # match, zero double-applies, and recompiles == 0.
             "failover": failover_rec,
+            # Fleet-soak row (round 21, --fleet-soak <seed>): the
+            # rebalancing soak at >=10x the failover row's session
+            # count — rolling planned zero-loss migrations under
+            # sustained traffic, one plain kill plus one kill landing
+            # mid-migration (journaled abort, failover wins), fenced
+            # zombies, exactly-one ownership asserted every round,
+            # per-worker round-wall p50/p99 — regression.py
+            # presence-gates it from round 21 and hard-gates the
+            # session floor, zero double-applies / violations /
+            # recompiles, digest match, and p99 within SLO.
+            "fleet_soak": fleet_soak_rec,
         }
         out_path.write_text(json.dumps(report, indent=2) + "\n")
         if not args.json_only:
@@ -2905,6 +3323,7 @@ def main() -> None:
         "fleet": fleet_rec,
         "incident_capture": incident_rec,
         "failover": failover_rec,
+        "fleet_soak": fleet_soak_rec,
     }
     if jax.default_backend() not in ("tpu",) and not args.write_results:
         print(

@@ -266,6 +266,25 @@ FAILOVER_ROW_SINCE = 20
 #: as the fleet row's kill drill: DEAD within this many windows.
 DEFAULT_FAILOVER_DETECT_WINDOWS = 2.0
 
+#: The fleet-soak row joined the trajectory in round 21 (ISSUE 20,
+#: bench_suite --fleet-soak): the rebalancing soak — rolling planned
+#: zero-loss migrations under sustained traffic at >=10x the failover
+#: row's session count, one plain kill plus one kill landing
+#: mid-migration (journaled abort, failover wins), fenced zombies
+#: (hard-zero double-applies), exactly-one ownership asserted every
+#: round (hard-zero violations), zero post-warmup serving recompiles,
+#: per-worker round-wall percentiles vs the smoke SLO, and
+#: ownership-digest bit-identity over two full soak replays. A suite
+#: round from 21 on missing the row regresses the planned half of the
+#: handoff plane.
+FLEET_SOAK_ROW_SINCE = 21
+
+#: Session floor for the fleet soak (`HV_BENCH_FLEET_SOAK_SESSIONS`
+#: overrides): >=10x the failover drill's ~76-session count — the soak
+#: exists to prove the handoff protocol at sustained scale, so a row
+#: that quietly shrank its traffic is a regression.
+DEFAULT_FLEET_SOAK_SESSIONS = 760
+
 
 def census_fusion_floor(round_num: int) -> float:
     """The fusion-ratio floor for a given round: env override, else the
@@ -626,6 +645,54 @@ def parse_round_file(path: Path) -> Optional[dict]:
                     "ownership_digest": fo.get("ownership_digest"),
                 }
                 if isinstance(fo := doc.get("failover"), dict)
+                else None
+            ),
+            # Fleet-soak row (round 21, ISSUE 20): the rebalancing
+            # soak — planned zero-loss migrations + kills under
+            # sustained traffic at >=10x the failover row's sessions,
+            # hard-zero double-applies / ownership violations /
+            # serving recompiles, per-worker round walls vs SLO,
+            # ownership-digest replay bit-identity — gated below.
+            fleet_soak=(
+                {
+                    "seed": fs.get("seed"),
+                    "quick": fs.get("quick"),
+                    "workers": fs.get("workers"),
+                    "tenants": fs.get("tenants"),
+                    "rounds": fs.get("rounds"),
+                    "sessions": fs.get("sessions"),
+                    "kills": fs.get("kills"),
+                    "failovers": fs.get("failovers"),
+                    "rebalance_runs": fs.get("rebalance_runs"),
+                    "migrations": fs.get("migrations"),
+                    "migration_replayed_ops": fs.get(
+                        "migration_replayed_ops"
+                    ),
+                    "failover_replayed_ops": fs.get(
+                        "failover_replayed_ops"
+                    ),
+                    "zombies_fenced": fs.get("zombies_fenced"),
+                    "double_applied_ops": fs.get("double_applied_ops"),
+                    "ownership_violations": fs.get(
+                        "ownership_violations"
+                    ),
+                    "recompiles_after_splice": fs.get(
+                        "recompiles_after_splice"
+                    ),
+                    "failover_replay_compiles": fs.get(
+                        "failover_replay_compiles"
+                    ),
+                    "round_wall_ms": fs.get("round_wall_ms"),
+                    "per_worker_round_wall_ms": fs.get(
+                        "per_worker_round_wall_ms"
+                    ),
+                    "slo_p99_ms": fs.get("slo_p99_ms"),
+                    "slo_ok": fs.get("slo_ok"),
+                    "replays": fs.get("replays"),
+                    "digest_match": fs.get("digest_match"),
+                    "ownership_digest": fs.get("ownership_digest"),
+                }
+                if isinstance(fs := doc.get("fleet_soak"), dict)
                 else None
             ),
             # Roofline row (round 15, ISSUE 14): per-program modeled
@@ -1414,6 +1481,108 @@ def compare(
             }
             checked.append(entry)
             if value != 0:
+                regressions.append(entry)
+    # Fleet-soak gates (round 21, ISSUE 20): presence from
+    # FLEET_SOAK_ROW_SINCE, the >=10x session floor, ownership-digest
+    # replay bit-identity over two full soaks, the hard-zero contracts
+    # (fenced zombies never double-apply, exactly-one ownership holds
+    # at every round boundary, the splice path never recompiles a
+    # serving shape), and p99 round wall within the smoke SLO.
+    fs = current.get("fleet_soak")
+    if (
+        current.get("format") == "suite"
+        and current["round"] >= FLEET_SOAK_ROW_SINCE
+        and not fs
+    ):
+        entry = {
+            "bench": "missing:fleet_soak",
+            "current_per_op_us": 0.0,
+            "baseline_per_op_us": 0.0,
+            "ratio": 0.0,
+        }
+        checked.append(entry)
+        regressions.append(entry)
+    if fs:
+        sessions = fs.get("sessions")
+        env_s = os.environ.get("HV_BENCH_FLEET_SOAK_SESSIONS")
+        floor = float(env_s) if env_s else DEFAULT_FLEET_SOAK_SESSIONS
+        entry = {
+            "bench": "fleet_soak_sessions_floor",
+            "current_per_op_us": (
+                float(sessions) if sessions is not None else -1.0
+            ),
+            "baseline_per_op_us": floor,
+            "ratio": (
+                round(float(sessions) / floor, 3)
+                if sessions is not None and floor
+                else 0.0
+            ),
+        }
+        checked.append(entry)
+        if sessions is None or float(sessions) < floor:
+            regressions.append(entry)
+        match = fs.get("digest_match")
+        if match is not None:
+            entry = {
+                "bench": "fleet_soak_digest_match",
+                "current_per_op_us": 1.0 if match else 0.0,
+                "baseline_per_op_us": 1.0,
+                "ratio": 1.0 if match else 0.0,
+            }
+            checked.append(entry)
+            if not match:
+                regressions.append(entry)
+        # Every kill's zombie MUST be fenced and MUST NOT double-apply.
+        fenced = fs.get("zombies_fenced")
+        doubles = fs.get("double_applied_ops")
+        if fenced is not None or doubles is not None:
+            ok = (
+                fenced is not None
+                and doubles == 0
+                and int(fenced) == int(fs.get("failovers") or 0)
+                and int(fenced) > 0
+            )
+            entry = {
+                "bench": "fleet_soak_zombies_fenced_zero_double_applies",
+                "current_per_op_us": (
+                    float(doubles) if doubles is not None else -1.0
+                ),
+                "baseline_per_op_us": 0.0,
+                "ratio": 0.0 if ok else 1.0,
+            }
+            checked.append(entry)
+            if not ok:
+                regressions.append(entry)
+        for key, bench in (
+            ("ownership_violations", "fleet_soak_ownership_violations"),
+            (
+                "recompiles_after_splice",
+                "fleet_soak_recompiles_after_splice",
+            ),
+        ):
+            value = fs.get(key)
+            if value is not None:
+                entry = {
+                    "bench": bench,
+                    "current_per_op_us": float(value),
+                    "baseline_per_op_us": 0.0,
+                    "ratio": float(value),
+                }
+                checked.append(entry)
+                if value != 0:
+                    regressions.append(entry)
+        rw = fs.get("round_wall_ms") or {}
+        p99 = rw.get("p99")
+        slo = fs.get("slo_p99_ms")
+        if p99 is not None and slo:
+            entry = {
+                "bench": "fleet_soak_round_wall_p99",
+                "current_per_op_us": float(p99),
+                "baseline_per_op_us": float(slo),
+                "ratio": round(float(p99) / float(slo), 3),
+            }
+            checked.append(entry)
+            if float(p99) > float(slo):
                 regressions.append(entry)
     # Static-analysis gates (round 13): presence from STATIC_ROW_SINCE,
     # then zero unsuppressed findings — hvlint findings shipping in a

@@ -148,6 +148,11 @@ def poll_url(
     tenants = poller.get_json("/debug/tenants")     # pre-r16: n/a
     autopilot = poller.get_json("/debug/autopilot")  # pre-r17: n/a
     fleet = poller.get_json("/debug/fleet")         # pre-r18: n/a
+    if fleet:
+        # failover + rebalance planes (pre-r20/r21 servers — or an
+        # unattached plane's 503 — render those sub-panels as absent)
+        fleet["ownership"] = poller.get_json("/fleet/ownership")
+        fleet["rebalance"] = poller.get_json("/fleet/rebalance")
     incidents = poller.get_json("/debug/incidents")  # pre-r19: n/a
     return health, counters, roofline, tenants, autopilot, fleet, incidents
 
@@ -502,6 +507,75 @@ def render(
             f_rows,
             header=("worker", "state", "occ", "comp/rec", "series", "floor"),
         )
+        own = fleet.get("ownership")
+        if own:
+            lines.append(
+                f"ownership  epoch={own.get('epoch', 0)}  "
+                f"transitions={own.get('transition_count', 0):,}  "
+                f"digest="
+                f"{str(own.get('transition_digest', ''))[:12] or '-'}"
+            )
+            fenced = own.get("fenced") or {}
+            o_rows = []
+            for name, rec in sorted((own.get("owners") or {}).items()):
+                ts = rec.get("tenants") or []
+                o_rows.append(
+                    (
+                        name,
+                        ",".join(str(t) for t in ts) or "-",
+                        f"e{rec.get('epoch', 0)}",
+                        f"{fenced.get(name, 0)}",
+                    )
+                )
+            lines += fmt_table(
+                o_rows,
+                header=("worker", "tenants", "epoch", "fence"),
+            )
+        reb = fleet.get("rebalance")
+        if reb:
+            inflight = reb.get("inflight") or {}
+            plan = reb.get("plan") or {}
+            lines.append(
+                f"rebalance  inflight={len(inflight)}  "
+                f"committed={reb.get('migration_count', 0)}  "
+                f"aborted={reb.get('aborted_count', 0)}  "
+                f"planned={len(plan.get('proposals') or [])}"
+            )
+            m_rows = []
+            for t, rec in sorted(inflight.items()):
+                m_rows.append(
+                    (
+                        f"t{t}",
+                        f"{rec.get('source', '?')}->"
+                        f"{rec.get('dest', '?')}",
+                        f"e{rec.get('epoch', 0)}",
+                        "inflight",
+                    )
+                )
+            for rec in (reb.get("migrations") or [])[-4:]:
+                m_rows.append(
+                    (
+                        f"t{rec.get('tenant')}",
+                        f"{rec.get('source')}->{rec.get('dest')}",
+                        f"e{rec.get('epoch', 0)}",
+                        rec.get("status", "committed"),
+                    )
+                )
+            for rec in (reb.get("aborted") or [])[-4:]:
+                m_rows.append(
+                    (
+                        f"t{rec.get('tenant')}",
+                        f"{rec.get('source')}->{rec.get('dest')}",
+                        f"e{rec.get('epoch', 0)}",
+                        "aborted"
+                        + ("/salvaged" if rec.get("salvaged") else ""),
+                    )
+                )
+            if m_rows:
+                lines += fmt_table(
+                    m_rows,
+                    header=("tenant", "route", "epoch", "status"),
+                )
 
     lines.append("")
     if not incidents or not incidents.get("enabled"):

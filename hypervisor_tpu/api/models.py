@@ -413,3 +413,20 @@ class ProfileRequest(BaseModel):
 
     duration_s: float = 0.05
     log_dir: Optional[str] = None
+
+
+# ── Fleet rebalance plane ────────────────────────────────────────────
+
+
+class FleetRebalanceRequest(BaseModel):
+    """`POST /fleet/rebalance`: dry-run or execute planned migrations.
+
+    With `tenant` + `destination`, one specific migration; with
+    neither, the deterministic deficit-aware plan drives it. `execute`
+    false (the default) returns the plan without moving anything.
+    `now` is the caller's clock (virtual time), defaulting to 0."""
+
+    tenant: Optional[int] = None
+    destination: Optional[str] = None
+    execute: bool = False
+    now: float = 0.0

@@ -85,6 +85,9 @@ ROUTES: list[tuple[str, str, str, Optional[type]]] = [
     ("GET", "/fleet/incidents", "fleet_incidents", None),
     ("GET", "/fleet/ownership", "fleet_ownership", None),
     ("GET", "/fleet/failover", "fleet_failover", None),
+    ("GET", "/fleet/rebalance", "fleet_rebalance", None),
+    ("POST", "/fleet/rebalance", "fleet_rebalance_post",
+     M.FleetRebalanceRequest),
     ("GET", "/debug/incidents", "debug_incidents", None),
     ("GET", "/incidents/{incident_id}", "get_incident", None),
     ("GET", "/history/query", "history_query", None),

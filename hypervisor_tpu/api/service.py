@@ -929,6 +929,74 @@ class HypervisorService:
             )
         return controller.summary()
 
+    def _rebalance_or_503(self):
+        obs = self._fleet_or_503()
+        controller = getattr(obs, "rebalance", None)
+        if controller is None:
+            raise ApiError(
+                503,
+                "no rebalance controller attached "
+                "(observatory.rebalance = fleet.rebalance."
+                "RebalanceController(ownership, failover))",
+            )
+        return controller
+
+    async def fleet_rebalance(self) -> dict:
+        """`GET /fleet/rebalance`: the planned-migration view —
+        in-flight migrations, committed/aborted history, and the
+        current dry-run deficit plan
+        (`fleet.rebalance.RebalanceController`). 503 until attached
+        (`observatory.rebalance = RebalanceController(...)`)."""
+        return self._rebalance_or_503().summary()
+
+    async def fleet_rebalance_post(
+        self, req: M.FleetRebalanceRequest
+    ) -> dict:
+        """`POST /fleet/rebalance`: dry-run (default) or execute. With
+        `tenant` + `destination`, one specific migration; with
+        neither, the deterministic deficit-aware plan drives it. Bad
+        migrations (unknown worker, fenced destination, no spare
+        slot) refuse with 409 and nothing moved."""
+        controller = self._rebalance_or_503()
+        from hypervisor_tpu.fleet.rebalance import MigrationError
+
+        now = float(req.now)
+        specific = req.tenant is not None or req.destination is not None
+        if specific and (
+            req.tenant is None or req.destination is None
+        ):
+            raise ApiError(
+                400,
+                "a specific migration needs BOTH tenant and "
+                "destination (neither = plan-driven)",
+            )
+        try:
+            if not specific:
+                if not req.execute:
+                    return {
+                        "executed": False,
+                        "plan": controller.plan(now),
+                    }
+                return {"executed": True, **controller.execute(now)}
+            if not req.execute:
+                plan = controller.plan(now)
+                return {
+                    "executed": False,
+                    "proposal": {
+                        "tenant": int(req.tenant),
+                        "dest": req.destination,
+                    },
+                    "plan": plan,
+                }
+            return {
+                "executed": True,
+                "result": controller.migrate(
+                    req.tenant, req.destination, now
+                ),
+            }
+        except MigrationError as e:
+            raise ApiError(409, str(e))
+
     async def debug_profile(self, req: M.ProfileRequest) -> dict:
         """`POST /debug/profile`: an on-demand bounded `jax.profiler`
         capture window (TensorBoard/Perfetto trace into `log_dir`).

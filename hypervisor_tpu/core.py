@@ -1830,6 +1830,13 @@ class Hypervisor:
             "fleet_ownership_changed": EventType.FLEET_OWNERSHIP_CHANGED,
             "fleet_worker_fenced": EventType.FLEET_WORKER_FENCED,
             "fleet_tenants_reassigned": EventType.FLEET_TENANTS_REASSIGNED,
+            # Rebalance plane: planned-migration intent / atomic
+            # commit / abort ride the same fan-out
+            # (`fleet.rebalance.RebalanceController` journaling into
+            # the OwnershipMap).
+            "fleet_rebalance_planned": EventType.FLEET_REBALANCE_PLANNED,
+            "fleet_tenant_migrated": EventType.FLEET_TENANT_MIGRATED,
+            "fleet_migration_aborted": EventType.FLEET_MIGRATION_ABORTED,
             # Hindsight-plane lifecycle (`observability.incidents.
             # IncidentRecorder`) rides the same fan-out; the taxonomy
             # itself is the recursion guard (incident_* kinds never

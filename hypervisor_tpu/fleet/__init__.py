@@ -28,6 +28,12 @@ shard across worker processes (ROADMAP item 1), the fleet needs:
   recovers a convicted-dead worker's tenants from durable state,
   splices them into survivors' arenas with zero recompiles, and fences
   the zombie at the bumped epoch.
+* `rebalance` — PLANNED zero-loss migration on the same splice path
+  (round 21): seven durable protocol steps (journaled intent, sealed +
+  drained source, final checkpoint at the WAL tip, per-tenant fence,
+  destination adoption, atomic commit), a deterministic deficit-aware
+  placement policy, and failover-wins race resolution — a crash at any
+  boundary degrades into the proven failover recovery.
 """
 
 from hypervisor_tpu.fleet.drain import (
@@ -56,6 +62,11 @@ from hypervisor_tpu.fleet.failover import (
     OwnershipTransition,
     WorkerDurability,
 )
+from hypervisor_tpu.fleet.rebalance import (
+    PROTOCOL_STEPS,
+    MigrationError,
+    RebalanceController,
+)
 from hypervisor_tpu.fleet.trace import stitch_chrome, stitch_otlp
 from hypervisor_tpu.fleet.worker import FleetSupervisor, WorkerSpec
 
@@ -74,8 +85,11 @@ __all__ = [
     "LeaseConfig",
     "LeaseTransition",
     "ManagedWorker",
+    "MigrationError",
     "OwnershipMap",
     "OwnershipTransition",
+    "PROTOCOL_STEPS",
+    "RebalanceController",
     "WorkerClient",
     "WorkerDurability",
     "WorkerSpec",

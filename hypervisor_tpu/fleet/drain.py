@@ -334,6 +334,10 @@ class FleetObservatory:
         #: surfaces them at `GET /fleet/ownership` / `/fleet/failover`.
         self.ownership = None
         self.failover = None
+        #: Optional rebalance plane (`fleet.rebalance`): attach a
+        #: `RebalanceController` here and the API surfaces it at
+        #: `GET/POST /fleet/rebalance`.
+        self.rebalance = None
 
     def _client(self, worker: str) -> WorkerClient:
         client = self._clients.get(worker)

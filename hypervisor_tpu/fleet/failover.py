@@ -114,6 +114,9 @@ class WorkerDurability:
         self.metrics = metrics
         self.emit = emit
         self._wals: dict[int, "FencedWal"] = {}
+        # Parsed FENCE doc cached keyed on the file's stat identity so
+        # the append hot path pays one `stat` instead of a read+parse.
+        self._fence_cache: Optional[tuple] = None
 
     # ── paths ────────────────────────────────────────────────────────
 
@@ -188,48 +191,120 @@ class WorkerDurability:
 
     def fence_floor(self) -> int:
         """The durable minimum epoch allowed to write (0 = unfenced)."""
-        return self.read_fence(self.root, self.worker_id)
+        return self._fence_doc()["min_epoch"]
+
+    def fence_floor_for(self, tenant: int) -> int:
+        """The effective floor for ONE tenant: max of the worker-level
+        floor and that tenant's own floor (planned migration fences
+        only the migrating tenant, leaving siblings writable)."""
+        doc = self._fence_doc()
+        return max(doc["min_epoch"], doc["tenants"].get(int(tenant), 0))
 
     @staticmethod
     def read_fence(root: str | Path, worker_id: str) -> int:
+        return WorkerDurability.read_fence_doc(root, worker_id)[
+            "min_epoch"
+        ]
+
+    @staticmethod
+    def read_fence_doc(root: str | Path, worker_id: str) -> dict:
+        """The full durable fence doc:
+        ``{"min_epoch": E, "tenants": {t: E_t}}``. Legacy
+        ``{"min_epoch": E}`` files parse with an empty tenant table.
+        An unreadable/torn doc fails CLOSED: worker floor ``1 << 62``
+        rather than letting a zombie write through a torn fence."""
         path = Path(root) / str(worker_id) / FENCE_FILE
         if not path.exists():
-            return 0
+            return {"min_epoch": 0, "tenants": {}}
         try:
-            return int(json.loads(path.read_text())["min_epoch"])
-        except (ValueError, KeyError, json.JSONDecodeError):
-            # An unreadable fence fails CLOSED: treat as maximally
-            # fenced rather than letting a zombie write through a torn
-            # fence file.
-            return 1 << 62
+            doc = json.loads(path.read_text())
+            return {
+                "min_epoch": int(doc["min_epoch"]),
+                "tenants": {
+                    int(t): int(e)
+                    for t, e in doc.get("tenants", {}).items()
+                },
+            }
+        except (ValueError, KeyError, TypeError, AttributeError):
+            return {"min_epoch": 1 << 62, "tenants": {}}
 
     @staticmethod
     def write_fence(
-        root: str | Path, worker_id: str, min_epoch: int
+        root: str | Path,
+        worker_id: str,
+        min_epoch: int,
+        tenant: Optional[int] = None,
     ) -> Path:
-        """Durably raise the worker's fence floor (atomic replace +
-        fsync — the floor must survive the same crash the WAL does).
-        Floors only ever rise: a lower write is ignored."""
+        """Durably raise a fence floor (atomic replace + fsync — the
+        floor must survive the same crash the WAL does). Floors only
+        ever rise: a lower write is ignored. With `tenant`, only THAT
+        tenant's floor rises — a planned migration fences the
+        migrating tenant while the source's other tenants keep
+        serving; without, the worker-level floor rises."""
         wdir = Path(root) / str(worker_id)
         wdir.mkdir(parents=True, exist_ok=True)
         path = wdir / FENCE_FILE
-        current = WorkerDurability.read_fence(root, worker_id)
-        floor = max(int(min_epoch), current)
+        doc = WorkerDurability.read_fence_doc(root, worker_id)
+        if tenant is None:
+            doc["min_epoch"] = max(int(min_epoch), doc["min_epoch"])
+        else:
+            t = int(tenant)
+            doc["tenants"][t] = max(
+                int(min_epoch), doc["tenants"].get(t, 0)
+            )
+        out: dict = {"min_epoch": doc["min_epoch"]}
+        if doc["tenants"]:
+            out["tenants"] = {
+                str(t): e for t, e in sorted(doc["tenants"].items())
+            }
         tmp = wdir / (FENCE_FILE + ".tmp")
         with open(tmp, "w") as f:
-            f.write(json.dumps({"min_epoch": floor}))
+            f.write(json.dumps(out, sort_keys=True))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
         return path
 
-    def check_fence(self) -> None:
+    def _fence_doc(self) -> dict:
+        """The parsed FENCE doc, cached keyed on the file's stat
+        identity ``(st_ino, st_mtime_ns, st_size)`` so the WAL append
+        path pays one `stat` instead of a read+parse per record.
+        `write_fence` publishes via atomic replace — a new inode — so
+        a fence bump is honored before the very next framed record. A
+        torn doc parses to the fail-closed floor and caches exactly
+        like a healthy one (keyed to the torn bytes)."""
+        path = self.worker_dir / FENCE_FILE
+        try:
+            st = os.stat(path)
+        except OSError:
+            self._fence_cache = None
+            return {"min_epoch": 0, "tenants": {}}
+        key = (st.st_ino, st.st_mtime_ns, st.st_size)
+        cached = self._fence_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        doc = self.read_fence_doc(self.root, self.worker_id)
+        self._fence_cache = (key, doc)
+        return doc
+
+    def check_fence(self, tenant: Optional[int] = None) -> None:
         """Raise `FencingError` when this worker's epoch is below the
         durable floor — consulted before EVERY WAL append and EVERY
         checkpoint publication, so refusal happens with zero bytes
-        written. Reads the floor from disk each time: a zombie that was
-        SIGSTOP'd across the fence write wakes into the refusal."""
-        floor = self.fence_floor()
+        written. A zombie that was SIGSTOP'd across the fence write
+        wakes into the refusal: the atomic fence replace invalidates
+        the stat-keyed cache. With `tenant`, the tenant's own floor is
+        honored too (per-tenant migration fence)."""
+        doc = self._fence_doc()
+        floor = doc["min_epoch"]
+        scope = f"worker {self.worker_id!r}"
+        if tenant is not None:
+            tfloor = doc["tenants"].get(int(tenant), 0)
+            if tfloor > floor:
+                floor = tfloor
+                scope = (
+                    f"worker {self.worker_id!r} tenant {int(tenant)}"
+                )
         if self.epoch < floor:
             if self.metrics is not None:
                 from hypervisor_tpu.observability import metrics as mp
@@ -240,9 +315,10 @@ class WorkerDurability:
                     "worker": self.worker_id,
                     "epoch": self.epoch,
                     "fence_floor": floor,
+                    "tenant": None if tenant is None else int(tenant),
                 })
             raise FencingError(
-                f"worker {self.worker_id!r} epoch {self.epoch} fenced "
+                f"{scope} epoch {self.epoch} fenced "
                 f"below floor {floor}: write refused (zero bytes)"
             )
 
@@ -253,11 +329,12 @@ class WorkerDurability:
         t = int(tenant)
         w = self._wals.get(t)
         if w is None:
-            self.check_fence()
+            self.check_fence(t)
             tdir = self.tenant_dir(t)
             tdir.mkdir(parents=True, exist_ok=True)
             w = FencedWal(
-                tdir / "wal.log", fence_check=self.check_fence,
+                tdir / "wal.log",
+                fence_check=lambda t=t: self.check_fence(t),
                 fsync=self.fsync,
             )
             self._wals[t] = w
@@ -272,7 +349,7 @@ class WorkerDurability:
             checkpoint_with_watermark,
         )
 
-        self.check_fence()
+        self.check_fence(int(tenant))
         return checkpoint_with_watermark(
             state, self.tenant_dir(tenant), step=step
         )
@@ -289,6 +366,9 @@ class WorkerDurability:
             "tenants": list(self.tenants),
             "root": str(self.root),
             "fence_floor": self.fence_floor(),
+            "tenant_fences": dict(
+                sorted(self._fence_doc()["tenants"].items())
+            ),
             "fenced_appends": sum(
                 w.fenced_appends for w in self._wals.values()
             ),
@@ -328,8 +408,8 @@ class OwnershipTransition:
     """One ownership change, keyed for replay like `LeaseTransition`."""
 
     seq: int
-    kind: str      # "assign" | "fence"
-    worker: str
+    kind: str      # "assign" | "fence" | "migrate_{intent,commit,abort}"
+    worker: str    # migrate kinds record "source->dest"
     tenants: tuple
     epoch: int
     now: float     # caller's clock
@@ -369,6 +449,7 @@ class OwnershipMap:
         self.metrics = metrics
         self._owners: dict[str, dict] = {}
         self._fenced: dict[str, int] = {}
+        self._inflight: dict[int, dict] = {}
         self.transitions: list[OwnershipTransition] = []
         self._observations: list[tuple] = []
         self._digest = hashlib.sha256(f"ownership:{self.seed}".encode())
@@ -414,6 +495,112 @@ class OwnershipMap:
             min_epoch, self._fenced.get(worker, 0)
         )
         self._record("fence", worker, (), min_epoch, now)
+
+    def migrate_intent(
+        self, tenant: int, source: str, dest: str, epoch: int,
+        now: float,
+    ) -> None:
+        """Journal PLANNED-migration intent: `tenant` will move
+        source -> dest at the bumped `epoch`. Ownership does NOT
+        change here — it moves only at the atomic `migrate_commit`
+        record, so a crash anywhere between the two resolves with
+        exactly-one owner (the source). Validates BEFORE journaling:
+        a refused intent leaves no record."""
+        t = int(tenant)
+        epoch = int(epoch)
+        now = round(float(now), 6)
+        if t in self._inflight:
+            rec = self._inflight[t]
+            raise FailoverError(
+                f"tenant {t} already has an in-flight migration "
+                f"{rec['source']}->{rec['dest']} at epoch "
+                f"{rec['epoch']}"
+            )
+        owner = self.owner_of(t)
+        if owner is None or owner[0] != source:
+            raise FailoverError(
+                f"migrate intent for tenant {t}: source {source!r} is "
+                f"not the owner (owner: {owner!r})"
+            )
+        if dest == source:
+            raise FailoverError(
+                f"migrate intent for tenant {t}: source and "
+                f"destination are both {source!r}"
+            )
+        if epoch <= self.epoch:
+            raise FencingError(
+                f"migrate intent for tenant {t} at stale epoch "
+                f"{epoch} (map is at {self.epoch}; intents must bump)"
+            )
+        self._observations.append(
+            ("migrate_intent", t, source, dest, epoch, now)
+        )
+        self._inflight[t] = {
+            "tenant": t, "source": source, "dest": dest,
+            "epoch": epoch, "since": now,
+        }
+        self._record(
+            "migrate_intent", f"{source}->{dest}", (t,), epoch, now
+        )
+
+    def migrate_commit(self, tenant: int, now: float) -> dict:
+        """The single journal record at which ownership changes hands:
+        the destination adopts the tenant at the intent's bumped
+        epoch; the source's remaining tenants are untouched."""
+        t = int(tenant)
+        now = round(float(now), 6)
+        rec = self._inflight.get(t)
+        if rec is None:
+            raise FailoverError(
+                f"migrate commit for tenant {t}: no in-flight intent"
+            )
+        self._observations.append(("migrate_commit", t, now))
+        del self._inflight[t]
+        src_rec = self._owners.get(rec["source"])
+        if src_rec is not None and t in src_rec["tenants"]:
+            src_rec["tenants"] = tuple(
+                x for x in src_rec["tenants"] if x != t
+            )
+        dst_rec = self._owners.setdefault(
+            rec["dest"], {"tenants": (), "epoch": rec["epoch"]}
+        )
+        dst_rec["tenants"] = tuple(
+            sorted(set(dst_rec["tenants"]) | {t})
+        )
+        dst_rec["epoch"] = max(dst_rec["epoch"], rec["epoch"])
+        self._record(
+            "migrate_commit",
+            f"{rec['source']}->{rec['dest']}", (t,), rec["epoch"], now,
+        )
+        if self.metrics is not None:
+            from hypervisor_tpu.observability import metrics as mp
+
+            self.metrics.gauge_set(mp.FAILOVER_EPOCH, self.epoch)
+        return dict(rec)
+
+    def migrate_abort(
+        self, tenant: int, now: float, reason: str = ""
+    ) -> dict:
+        """Journal that an in-flight migration was abandoned (crash,
+        failover race, operator abort). Ownership never moved, so no
+        ownership mutation — the record exists so replay and the
+        postmortem see WHY the intent has no commit."""
+        t = int(tenant)
+        now = round(float(now), 6)
+        rec = self._inflight.get(t)
+        if rec is None:
+            raise FailoverError(
+                f"migrate abort for tenant {t}: no in-flight intent"
+            )
+        self._observations.append(
+            ("migrate_abort", t, now, str(reason))
+        )
+        del self._inflight[t]
+        self._record(
+            "migrate_abort",
+            f"{rec['source']}->{rec['dest']}", (t,), rec["epoch"], now,
+        )
+        return dict(rec)
 
     # ── transition log + digest (the FleetRegistry discipline) ───────
 
@@ -464,6 +651,12 @@ class OwnershipMap:
         return int(epoch) < self._fenced.get(worker, 0)
 
     @property
+    def inflight(self) -> dict:
+        """tenant -> in-flight migration record (intent journaled,
+        commit/abort not yet)."""
+        return {t: dict(rec) for t, rec in self._inflight.items()}
+
+    @property
     def observations(self) -> tuple:
         return tuple(self._observations)
 
@@ -481,6 +674,10 @@ class OwnershipMap:
                 for w, rec in sorted(self._owners.items())
             },
             "fenced": dict(sorted(self._fenced.items())),
+            "inflight": {
+                t: dict(rec)
+                for t, rec in sorted(self._inflight.items())
+            },
             "transitions": [
                 t.to_dict() for t in self.transitions[-tail:]
             ],
@@ -501,6 +698,14 @@ class OwnershipMap:
                 m.assign(obs[1], obs[2], obs[3], obs[4])
             elif obs[0] == "fence":
                 m.fence(obs[1], obs[2], obs[3])
+            elif obs[0] == "migrate_intent":
+                m.migrate_intent(
+                    obs[1], obs[2], obs[3], obs[4], obs[5]
+                )
+            elif obs[0] == "migrate_commit":
+                m.migrate_commit(obs[1], obs[2])
+            elif obs[0] == "migrate_abort":
+                m.migrate_abort(obs[1], obs[2], obs[3])
             else:  # pragma: no cover — unknown journal rows are a bug
                 raise ValueError(f"unknown observation {obs!r}")
         return m
@@ -509,6 +714,9 @@ class OwnershipMap:
 _EMIT_KIND = {
     "assign": "fleet_ownership_changed",
     "fence": "fleet_worker_fenced",
+    "migrate_intent": "fleet_rebalance_planned",
+    "migrate_commit": "fleet_tenant_migrated",
+    "migrate_abort": "fleet_migration_aborted",
 }
 
 
@@ -561,6 +769,10 @@ class FailoverController:
         self.observatory = observatory
         self.workers: dict[str, ManagedWorker] = {}
         self.reassignments: list[dict] = []
+        # Set by fleet.rebalance.RebalanceController: failover aborts
+        # any in-flight planned migration touching the dead worker
+        # before reassigning (failover wins the race).
+        self.rebalance = None
 
     def register(self, worker: ManagedWorker, now: float = 0.0) -> None:
         """Track a worker and journal its initial ownership at its
@@ -581,8 +793,14 @@ class FailoverController:
         spares = {w.worker_id: len(w.spare_slots) for w in survivors}
         out: dict[int, ManagedWorker] = {}
         for tenant in sorted(int(t) for t in tenants):
+            # A survivor whose per-tenant fence for THIS tenant burned
+            # (it migrated the tenant away earlier) can never write it
+            # again within its current epoch — not a landing spot.
             eligible = [
-                w for w in survivors if spares[w.worker_id] > 0
+                w for w in survivors
+                if spares[w.worker_id] > 0
+                and w.durability.fence_floor_for(tenant)
+                <= w.durability.epoch
             ]
             if not eligible:
                 raise FailoverError(
@@ -598,6 +816,42 @@ class FailoverController:
             loads[target.worker_id] += 1
             spares[target.worker_id] -= 1
         return out
+
+    # ── the shared splice path ───────────────────────────────────────
+
+    def _absorb(
+        self, tenant: int, source_epoch_dir, target: ManagedWorker
+    ) -> tuple[int, dict]:
+        """Recover one tenant from a durable epoch namespace and
+        splice it into `target`'s arena: newest checkpoint +
+        committed-WAL suffix, spare slot (the `[T, …]` shapes are
+        fixed — zero recompiles), re-journal under the target's own
+        durability, checkpoint there immediately. Crash failover and
+        planned rebalancing share THIS path, so a migration crash
+        degrades into the already-proven recovery, not a new mode."""
+        from hypervisor_tpu.resilience.recovery import recover_tenant
+
+        # Recovery config: the target arena's own config unless the
+        # controller was built with an explicit one (capacities must
+        # match the donor's checkpoint — restore validates).
+        cfg = (
+            self.config
+            if self.config is not None
+            else target.arena.config
+        )
+        state, report = recover_tenant(
+            source_epoch_dir, tenant, config=cfg
+        )
+        slot = target.spare_slots.pop(0)
+        target.arena.splice_tenant(slot, state)
+        target.slot_of[tenant] = slot
+        # Re-journal under the TARGET's durability and checkpoint
+        # there immediately: the absorbed tenant is durable on its new
+        # owner before the move is declared complete.
+        spliced = target.arena.tenants[slot]
+        spliced.journal = target.durability.wal(tenant)
+        target.durability.checkpoint(spliced, tenant)
+        return slot, report
 
     # ── the state machine ────────────────────────────────────────────
 
@@ -616,6 +870,15 @@ class FailoverController:
         dead_mw = self.workers.get(dead)
         if dead_mw is None:
             raise FailoverError(f"unknown dead worker {dead!r}")
+        # Failover-vs-rebalance race: failover WINS. Abort (and, when
+        # the source's per-tenant fence is already burned, salvage)
+        # any in-flight planned migration touching the dead worker
+        # FIRST — the abort is journaled, so `new_epoch` below is
+        # computed against the post-abort map.
+        if self.rebalance is not None:
+            self.rebalance.abort_inflight_for(
+                dead, now, reason=f"failover:{dead}"
+            )
         orphans = self.ownership.tenants_of(dead) or dead_mw.owned
         new_epoch = self.ownership.epoch + 1
 
@@ -645,32 +908,14 @@ class FailoverController:
                 "survivors registered"
             )
         assignment = self._spread(orphans, survivors)
-        from hypervisor_tpu.resilience.recovery import recover_tenant
 
         replayed = 0
         verified = 0
         per_tenant: dict[int, dict] = {}
         for tenant, target in assignment.items():
-            # Recovery config: the survivor arena's own config unless
-            # the controller was built with an explicit one (capacities
-            # must match the donor's checkpoint — restore validates).
-            cfg = (
-                self.config
-                if self.config is not None
-                else target.arena.config
+            slot, report = self._absorb(
+                tenant, dead_mw.durability.epoch_dir, target
             )
-            state, report = recover_tenant(
-                dead_mw.durability.epoch_dir, tenant, config=cfg
-            )
-            slot = target.spare_slots.pop(0)
-            target.arena.splice_tenant(slot, state)
-            target.slot_of[tenant] = slot
-            # Re-journal under the SURVIVOR's durability and checkpoint
-            # there immediately: the absorbed tenant is durable on its
-            # new owner before the reassignment is declared complete.
-            spliced = target.arena.tenants[slot]
-            spliced.journal = target.durability.wal(tenant)
-            target.durability.checkpoint(spliced, tenant)
             replayed += report["wal_records_replayed"]
             verified += report["audit_sessions_verified"]
             per_tenant[tenant] = {

@@ -219,6 +219,29 @@ def run_worker(spec: WorkerSpec) -> None:
         }, sort_keys=True), flush=True)
 
 
+def _persistent_cache_dir() -> Optional[str]:
+    """The compilation-cache dir workers should inherit, if any.
+
+    Prefers whatever the supervising process already uses (env or live
+    jax config), falling back to the repo checkout's per-user dir;
+    installed-package contexts without `_jax_platform` just skip the
+    cache rather than fail the spawn.
+    """
+    explicit = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if explicit:
+        return explicit
+    if "jax" in sys.modules:
+        configured = sys.modules["jax"].config.jax_compilation_cache_dir
+        if configured:
+            return str(configured)
+    try:
+        from _jax_platform import cache_dir
+
+        return cache_dir()
+    except ImportError:  # pragma: no cover - installed-package context
+        return None
+
+
 class FleetSupervisor:
     """Spawn, watch, and kill N workers.
 
@@ -251,6 +274,16 @@ class FleetSupervisor:
         for spec in self.specs:
             env = dict(os.environ)
             env.setdefault("JAX_PLATFORMS", "cpu")
+            # Workers share the supervisor's persistent compilation
+            # cache: every worker compiles the same state programs, so
+            # all but the first pay a cache read instead of an XLA
+            # compile. A spec env override still wins.
+            cache = _persistent_cache_dir()
+            if cache:
+                env.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
+                env.setdefault(
+                    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5"
+                )
             env.update(dict(spec.env))
             proc = subprocess.Popen(
                 [self.python, "-m", "hypervisor_tpu.fleet.worker",

@@ -155,6 +155,17 @@ class EventType(str, enum.Enum):
     FLEET_WORKER_FENCED = "fleet.worker_fenced"
     FLEET_TENANTS_REASSIGNED = "fleet.tenants_reassigned"
 
+    # Rebalance plane (append-only, like every block above): PLANNED
+    # zero-loss migration on the failover splice path
+    # (`fleet.rebalance`). REBALANCE_PLANNED is the journaled intent
+    # (tenant, source -> dest, bumped epoch); TENANT_MIGRATED is the
+    # atomic commit at which ownership changes hands; MIGRATION_ABORTED
+    # records an intent abandoned before commit (crash boundary or
+    # failover winning the race) — ownership never moved.
+    FLEET_REBALANCE_PLANNED = "fleet.rebalance_planned"
+    FLEET_TENANT_MIGRATED = "fleet.tenant_migrated"
+    FLEET_MIGRATION_ABORTED = "fleet.migration_aborted"
+
     @property
     def code(self) -> int:
         """int32 column code for the device event log."""

@@ -830,6 +830,30 @@ FAILOVER_EPOCH = REGISTRY.gauge(
     "reassignment; stale-epoch writers are fenced below it)",
 )
 
+# ── rebalance plane (planned zero-loss migration, round 21) ──────────
+# HOST-owned rows bumped by `fleet.rebalance` as planned migrations
+# run on the failover splice path — APPENDED at the registry tail
+# (hvlint HVA004).
+REBALANCE_MIGRATIONS = REGISTRY.counter(
+    "hv_rebalance_migrations_total",
+    "planned tenant migrations committed (journaled intent -> drain "
+    "-> per-tenant fence -> destination adoption -> commit)",
+)
+REBALANCE_ABORTED = REGISTRY.counter(
+    "hv_rebalance_aborted_total",
+    "planned migrations aborted before commit (crash at a protocol "
+    "boundary, failover winning the race, or operator abort)",
+)
+REBALANCE_REPLAYED_OPS = REGISTRY.counter(
+    "hv_rebalance_replayed_ops_total",
+    "committed WAL records replayed during destination adoption (the "
+    "clean drained path replays ZERO)",
+)
+REBALANCE_INFLIGHT = REGISTRY.gauge(
+    "hv_rebalance_inflight",
+    "migrations with a journaled intent and no commit/abort yet",
+)
+
 
 # ── host object: device table + host mirror + drain ──────────────────
 

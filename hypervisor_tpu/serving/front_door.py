@@ -298,6 +298,10 @@ class FrontDoor:
         # Submits may come from many transport threads; the scheduler
         # drains under the same lock.
         self._lock = threading.RLock()
+        # A sealed door refuses every admission (planned migration:
+        # the source stops admitting, drains, then hands off). The
+        # detail string names why; None = open.
+        self._sealed: Optional[str] = None
         # Park session for terminate-wave padding, allocated lazily (a
         # memberless session whose re-archival is an idempotent no-op).
         self._park_slot: Optional[int] = None
@@ -421,9 +425,33 @@ class FrontDoor:
         self.state.metrics.inc(metrics_plane.SERVING_ENQUEUED[queue])
         return ticket
 
+    def seal(self, detail: str = "sealed") -> None:
+        """Stop admitting: every subsequent submit sheds with the
+        standard `queue_full` refusal (clients already back off on
+        it). Queued work still drains — seal + drain is the planned
+        handoff's quiesce step."""
+        with self._lock:
+            self._sealed = str(detail)
+
+    def unseal(self) -> None:
+        """Resume admitting (migration aborted, or door reopened)."""
+        with self._lock:
+            self._sealed = None
+
+    @property
+    def sealed(self) -> Optional[str]:
+        return self._sealed
+
     def _depth_refusal(
         self, queue: str, now: Optional[float] = None
     ) -> Optional[Refusal]:
+        if self._sealed is not None:
+            return self._refuse(
+                "queue_full",
+                f"{queue} sealed: {self._sealed}",
+                queue=queue,
+                now=now,
+            )
         if len(self._queues[queue]) >= self._depths[queue]:
             return self._refuse(
                 "queue_full",

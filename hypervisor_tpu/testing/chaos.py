@@ -200,6 +200,28 @@ class InjectedFleetFault:
 
     Kinds: ``worker_sigkill`` | ``worker_sigstop`` |
     ``torn_checkpoint`` | ``partitioned_scrape``.
+
+    Migration-window kinds (round 21 — faults timed INSIDE a planned
+    rebalance, delivered by the drill harness at the named protocol
+    boundary of the worker's in-flight migration):
+
+    * ``migration_kill_source`` — SIGKILL the migration SOURCE
+      mid-drain (between ``seal_source`` and ``final_checkpoint``);
+      failover must win the race, abort the journaled intent, and
+      recover the tenant from the source's durable state.
+    * ``migration_kill_dest`` — SIGKILL the DESTINATION mid-adopt
+      (after ``fence_source_tenant``); the abort must salvage the
+      drained tenant onto a live worker (the source is per-tenant
+      fenced and can never write it again).
+    * ``torn_ownership_record`` — tear the worker's durable FENCE doc
+      to garbage bytes mid-handoff; the worker must fail CLOSED
+      (floor ``1 << 62``), refusing every write until failed over.
+    * ``handoff_partition`` — the supervisor loses the worker between
+      intent and commit (the migration stalls at its current step);
+      conviction then resolves it through the abort path.
+    * ``zombie_source_resume`` — the fenced source resumes after its
+      per-tenant fence burned and retries an append; the refusal must
+      land with ZERO bytes on disk.
     """
 
     kind: str = "worker_sigkill"

@@ -1302,6 +1302,209 @@ print(
 PY
 failover_rc=$?
 
+echo "── live rebalance + migration-race gate (6n) ──"
+# Round 21 (ISSUE 20): the PLANNED half of the handoff plane, raced
+# against the crash half. A seeded 3-worker in-process drill on a
+# VIRTUAL clock: (1) a clean planned migration moves a live tenant
+# between running workers through the seven-step protocol — the
+# destination's chain heads match the source's pre-move oracle
+# bit-for-bit and the clean path replays ZERO WAL records (the final
+# checkpoint sits at the WAL tip); the source's per-tenant fence then
+# refuses its zombie resume. (2) A second migration is caught
+# mid-protocol (source drained, NOT yet fenced) when its source is
+# SIGKILLed — failover WINS the race: the abort is journaled BEFORE
+# the dead worker's fence, every tenant lands on a survivor with
+# oracle-matching chain heads, and the zombie double-applies nothing.
+# TWO full drill replays must land the same ownership transition
+# digest, and the journal must replay to it.
+JAX_PLATFORMS=cpu python - <<'PY'
+import tempfile
+from pathlib import Path
+
+from hypervisor_tpu.fleet import DEAD, FleetRegistry, LeaseConfig
+from hypervisor_tpu.fleet.failover import (
+    FailoverController,
+    FencingError,
+    ManagedWorker,
+    OwnershipMap,
+    WorkerDurability,
+)
+from hypervisor_tpu.fleet.rebalance import RebalanceController
+from hypervisor_tpu.fleet.worker import _small_capacity_config
+from hypervisor_tpu.resilience.wal import scan as wal_scan
+from hypervisor_tpu.serving import ServingConfig
+from hypervisor_tpu.tenancy import (
+    TenantArena,
+    TenantFrontDoor,
+    TenantWaveScheduler,
+)
+
+SEED = 21
+cfg = _small_capacity_config()
+lease = LeaseConfig(heartbeat_interval_s=0.25)
+
+
+def build(root, wid, tenants, n_slots):
+    arena = TenantArena(n_slots, cfg)
+    front = TenantFrontDoor(arena, ServingConfig(buckets=(4, 8)))
+    sched = TenantWaveScheduler(front)
+    sched.warm(now=0.0)
+    dur = WorkerDurability(
+        root, wid, epoch=0, tenants=tenants, fsync=False
+    ).adopt()
+    slot_of = {}
+    for slot, t in enumerate(tenants):
+        arena.tenants[slot].journal = dur.wal(t)
+        slot_of[t] = slot
+    mw = ManagedWorker(
+        wid, arena, dur, slot_of, list(range(len(tenants), n_slots))
+    )
+    return mw, front, sched
+
+
+def chain_heads(st):
+    return {
+        s: tuple(int(w) for w in v) for s, v in st._chain_seed.items()
+    }
+
+
+def serve(fleet, skip, round_no, now):
+    for wid, (mw, front, sched) in sorted(fleet.items()):
+        if wid in skip:
+            continue
+        for t, slot in sorted(mw.slot_of.items()):
+            front.submit_lifecycle(
+                slot, f"{wid}:r{round_no}:{t}",
+                f"did:6n:{SEED}:{wid}:{round_no}:{t}", 0.8, now=now,
+            )
+        sched.lifecycle_round(now)
+
+
+def run_drill(root: Path) -> dict:
+    w0, f0, s0 = build(root, "w0", (0, 1), 4)
+    w1, f1, s1 = build(root, "w1", (2,), 4)
+    w2, f2, s2 = build(root, "w2", (3,), 4)
+    fleet = {"w0": (w0, f0, s0), "w1": (w1, f1, s1), "w2": (w2, f2, s2)}
+    reg = FleetRegistry(lease, seed=SEED)
+    om = OwnershipMap(seed=SEED)
+    ctl = FailoverController(om, config=cfg)
+    reb = RebalanceController(om, ctl)
+    now = 1000.0
+    for wid in sorted(fleet):
+        mw, front, sched = fleet[wid]
+        reg.register(wid, now)
+        ctl.register(mw, now=now)
+        reb.attach_serving(wid, front, sched)
+        mw.arena.sync()
+        for t, slot in sorted(mw.slot_of.items()):
+            mw.durability.checkpoint(mw.arena.tenants[slot], t, step=0)
+    for round_no in range(1, 4):
+        serve(fleet, set(), round_no, now)
+        for wid in sorted(fleet):
+            reg.heartbeat(wid, now)
+        reg.evaluate(now)
+        now += lease.heartbeat_interval_s
+
+    # ── (1) the clean planned migration: tenant 2, w1 -> w2 ──
+    w1.arena.sync()
+    oracle2 = chain_heads(w1.arena.tenants[w1.slot_of[2]])
+    rep = reb.migrate(2, "w2", now)
+    assert rep["status"] == "committed", rep
+    assert rep["replayed_ops"] == 0, (
+        f"clean migration replayed {rep['replayed_ops']} WAL op(s) — "
+        "the final checkpoint must sit at the WAL tip"
+    )
+    got = chain_heads(w2.arena.tenants[rep["dest_slot"]])
+    assert got == oracle2, (
+        f"tenant 2 chain head diverged across the planned handoff: "
+        f"{got} != {oracle2}"
+    )
+    # The source's per-tenant fence refuses its zombie resume.
+    try:
+        with w1.durability.wal(2).txn("zombie_migrate_resume", {}):
+            pass
+        raise AssertionError("migrated-away tenant WAL NOT fenced")
+    except FencingError:
+        pass
+    serve(fleet, set(), 4, now)  # the dest serves the absorbed tenant
+    for wid in sorted(fleet):
+        reg.heartbeat(wid, now)
+    reg.evaluate(now)
+    now += lease.heartbeat_interval_s
+
+    # ── (2) the race: tenant 0 mid-migration when w0 is SIGKILLed ──
+    reb.migrate(0, "w1", now, stop_after="drain_source")
+    dead_round = None
+    for round_no in range(5, 40):
+        serve(fleet, {"w0"}, round_no, now)
+        for wid in ("w1", "w2"):
+            reg.heartbeat(wid, now)
+        if DEAD in reg.evaluate(now).values():
+            dead_round = round_no
+            break
+        now += lease.heartbeat_interval_s
+    assert dead_round is not None, "lease plane never convicted w0"
+    w0.arena.sync()
+    oracle = {}
+    for t, slot in sorted(w0.slot_of.items()):
+        w0.arena.tenants[slot].journal.flush()
+        oracle[t] = chain_heads(w0.arena.tenants[slot])
+    report = ctl.failover("w0", now=round(now, 6))
+    kinds = [obs[0] for obs in om.observations]
+    assert "migrate_abort" in kinds, "race abort was NOT journaled"
+    fence_idxs = [i for i, k in enumerate(kinds) if k == "fence"]
+    assert kinds.index("migrate_abort") < max(fence_idxs), (
+        "failover fenced the dead source BEFORE journaling the abort"
+    )
+    assert len(report["tenants"]) == 2, report["tenants"]
+    for t, info in report["tenants"].items():
+        mw = fleet[info["survivor"]][0]
+        got = chain_heads(mw.arena.tenants[info["slot"]])
+        assert got == oracle[int(t)], (
+            f"tenant {t} chain head diverged after the raced "
+            f"failover to {info['survivor']}: {got} != {oracle[int(t)]}"
+        )
+    zombie_wal = w0.durability.tenant_dir(0) / "wal.log"
+    before = len(wal_scan(zombie_wal).committed)
+    try:
+        with w0.durability.wal(0).txn("zombie_resume", {}):
+            pass
+        raise AssertionError("zombie WAL append was NOT fenced")
+    except FencingError:
+        pass
+    doubles = len(wal_scan(zombie_wal).committed) - before
+    assert doubles == 0, f"{doubles} double-applied WAL record(s)"
+    assert reb.summary()["inflight"] == {}, "migration left in flight"
+    return {
+        "digest": om.transition_digest(),
+        "replayed": report["replayed_ops"],
+        "survivors": report["survivors"],
+        "journal": om.observations,
+    }
+
+
+with tempfile.TemporaryDirectory() as td:
+    a = run_drill(Path(td) / "a")
+    b = run_drill(Path(td) / "b")
+assert a["digest"] == b["digest"] and a["digest"], (
+    "ownership transition digest NOT bit-identical over 2 drill "
+    f"replays:\n  {a['digest']}\n  {b['digest']}"
+)
+again = OwnershipMap.replay(a["journal"], seed=SEED)
+assert again.transition_digest() == a["digest"], (
+    "journal replay diverged from the live ownership digest"
+)
+print(
+    "rebalance gate OK: clean planned handoff committed with 0 "
+    "replayed ops + oracle-matching chain heads, raced migration "
+    f"aborted in-journal before the fence, {a['replayed']} WAL op(s) "
+    f"replayed into survivors {a['survivors']}, zombies fenced with 0 "
+    "double-applies, digest bit-identical over 2 drill replays + "
+    "journal replay"
+)
+PY
+rebalance_rc=$?
+
 echo "── hvlint static-analysis gate ──"
 # The contract analyzer (ISSUE 12): Tier A pure-AST rules (WAL
 # coverage, env arming, lock discipline, append-only registries, twin
@@ -1395,6 +1598,10 @@ fi
 if [ "$failover_rc" -ne 0 ]; then
     echo "fleet failover gate FAILED (rc=$failover_rc)" >&2
     exit "$failover_rc"
+fi
+if [ "$rebalance_rc" -ne 0 ]; then
+    echo "live rebalance + migration-race gate FAILED (rc=$rebalance_rc)" >&2
+    exit "$rebalance_rc"
 fi
 if [ "$hvlint_rc" -ne 0 ]; then
     echo "hvlint static-analysis gate FAILED (rc=$hvlint_rc)" >&2

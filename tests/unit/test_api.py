@@ -196,11 +196,20 @@ class TestHTTPTransport:
         # (/debug/fleet + /fleet/{workers,metrics,slo,trace/{id}}),
         # and the hindsight plane (/debug/incidents,
         # /incidents/{incident_id}, /history/query, /fleet/incidents),
-        # and the failover plane (/fleet/ownership, /fleet/failover):
-        # 57 routes.
-        assert len(ROUTES) == 57
+        # and the failover plane (/fleet/ownership, /fleet/failover),
+        # and the rebalance plane (GET+POST /fleet/rebalance):
+        # 59 routes.
+        assert len(ROUTES) == 59
         assert any(path == "/fleet/ownership" for _, path, _, _ in ROUTES)
         assert any(path == "/fleet/failover" for _, path, _, _ in ROUTES)
+        assert any(
+            (method, path) == ("GET", "/fleet/rebalance")
+            for method, path, _, _ in ROUTES
+        )
+        assert any(
+            (method, path) == ("POST", "/fleet/rebalance")
+            for method, path, _, _ in ROUTES
+        )
         assert any(path == "/debug/incidents" for _, path, _, _ in ROUTES)
         assert any(path == "/history/query" for _, path, _, _ in ROUTES)
         assert any(path == "/fleet/incidents" for _, path, _, _ in ROUTES)

@@ -905,6 +905,48 @@ def _suite_report(
             if round_no >= 20
             else None
         ),
+        # Rounds >= regression.FLEET_SOAK_ROW_SINCE must carry the
+        # rebalancing soak row (round-21 presence gate, ISSUE 20); the
+        # session floor, digest replay bit-identity, the hard-zero
+        # contracts (double-applies, ownership violations, serving
+        # recompiles), and p99-within-SLO are gated.
+        "fleet_soak": (
+            {
+                "seed": 21,
+                "quick": quick,
+                "workers": 3,
+                "tenants": 6,
+                "rounds": 135,
+                "sessions": 800,
+                "kills": ["w0", "w1"],
+                "failovers": 2,
+                "rebalance_runs": 13,
+                "migrations": {
+                    "planned": 2,
+                    "committed": 1,
+                    "aborted": 1,
+                    "interrupted_by_kill": 1,
+                },
+                "migration_replayed_ops": 0,
+                "failover_replayed_ops": 70,
+                "zombies_fenced": 2,
+                "double_applied_ops": 0,
+                "ownership_violations": 0,
+                "recompiles_after_splice": 0,
+                "failover_replay_compiles": 1,
+                "round_wall_ms": {"p50": 16.0, "p99": 27.0},
+                "per_worker_round_wall_ms": {
+                    "w2": {"p50": 21.0, "p99": 35.0},
+                },
+                "slo_p99_ms": 750.0,
+                "slo_ok": True,
+                "replays": 2,
+                "digest_match": 1.0,
+                "ownership_digest": "ab" * 32,
+            }
+            if round_no >= 21
+            else None
+        ),
     }
 
 
@@ -1488,6 +1530,69 @@ class TestRegressionHarness:
             assert check(detection_windows=5) == 0
         finally:
             del os.environ["HV_BENCH_FAILOVER_DETECT"]
+
+    def test_missing_fleet_soak_row_fails_from_round_21(self, tmp_path):
+        # ISSUE 20 round 21: the rebalancing soak row is REQUIRED from
+        # round 21 — dropping the planned-handoff bench coverage is a
+        # regression.
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 20, _suite_report(20, {"full_governance_pipeline": 10.0})
+        )
+        doc = _suite_report(21, {"full_governance_pipeline": 10.0})
+        doc["fleet_soak"] = None
+        self._write(tmp_path, 21, doc)
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 1
+        # A round carrying the row passes, and the trajectory keeps it.
+        self._write(
+            tmp_path, 21,
+            _suite_report(21, {"full_governance_pipeline": 10.0}),
+        )
+        assert regression.main(["--root", str(tmp_path), "--quiet"]) == 0
+        rows = regression.load_history(tmp_path)
+        fs = rows[-1]["fleet_soak"]
+        assert fs["sessions"] == 800
+        assert fs["migrations"]["committed"] == 1
+        assert fs["ownership_violations"] == 0
+        assert fs["per_worker_round_wall_ms"]["w2"]["p99"] == 35.0
+
+    def test_fleet_soak_gates_floor_and_hard_contracts(self, tmp_path):
+        # The ISSUE 20 round-21 acceptance bars: the >=10x session
+        # floor (HV_BENCH_FLEET_SOAK_SESSIONS overrides),
+        # ownership-digest bit-identity over 2 soak replays, hard-zero
+        # double-applies with every kill's zombie fenced, hard-zero
+        # ownership violations and serving recompiles, and p99 round
+        # wall within the smoke SLO.
+        import os
+
+        from benchmarks import regression
+
+        self._write(
+            tmp_path, 20, _suite_report(20, {"full_governance_pipeline": 10.0})
+        )
+
+        def check(**overrides) -> int:
+            doc = _suite_report(21, {"full_governance_pipeline": 10.0})
+            doc["fleet_soak"].update(overrides)
+            self._write(tmp_path, 21, doc)
+            return regression.main(["--root", str(tmp_path), "--quiet"])
+
+        assert check() == 0
+        assert check(sessions=75) == 1              # below the floor
+        assert check(sessions=None) == 1            # row never counted
+        assert check(digest_match=0.0) == 1         # replay drifted
+        assert check(zombies_fenced=1) == 1         # one zombie wrote
+        assert check(double_applied_ops=2) == 1     # records re-applied
+        assert check(ownership_violations=1) == 1   # two owners at once
+        assert check(recompiles_after_splice=1) == 1  # splice compiled
+        assert check(round_wall_ms={"p50": 16.0, "p99": 900.0}) == 1
+        # The env knob lowers the session floor (read per gate run).
+        os.environ["HV_BENCH_FLEET_SOAK_SESSIONS"] = "50"
+        try:
+            assert check(sessions=75) == 0
+        finally:
+            del os.environ["HV_BENCH_FLEET_SOAK_SESSIONS"]
 
     def test_next_round_path_advances(self, tmp_path):
         from benchmarks import regression

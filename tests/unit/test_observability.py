@@ -74,10 +74,12 @@ class TestEventBus:
         # lease plane's joined/suspected/dead/recovered quad (round
         # 18), and the incident recorder's captured/evicted pair
         # (round 19), and the failover plane's ownership_changed/
-        # worker_fenced/tenants_reassigned triple (round 20)
+        # worker_fenced/tenants_reassigned triple (round 20), and the
+        # rebalance plane's rebalance_planned/tenant_migrated/
+        # migration_aborted triple (round 21)
         # (append-only: codes are the device-log wire format, so every
         # earlier code stays stable).
-        assert len({t.code for t in EventType}) == len(EventType) == 70
+        assert len({t.code for t in EventType}) == len(EventType) == 73
         assert EventType.WAVE_STRAGGLER.code == 40
         assert EventType.CAPACITY_WARNING.code == 41
         assert EventType.RECOMPILE.code == 42

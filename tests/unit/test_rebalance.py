@@ -1,0 +1,644 @@
+"""Planned tenant rebalancing on the failover splice path (round 21).
+
+The headline test is kill-at-every-protocol-step: a live migration is
+stopped at EVERY durable boundary of the seven-step protocol, the
+source or the destination is then convicted dead, and the existing
+`FailoverController` must resolve the wreckage to exactly-one
+ownership with the surviving copy's materialized tables + Merkle chain
+heads bit-identical to the uninterrupted oracle — zero double-applied
+records, no orphaned destination tenant dirs, and a journal that
+replays bit-identically.
+
+Also here: the per-tenant fence + the satellite fence-floor cache (one
+`stat` per append, a bump honored before the very next framed record,
+torn reads still fail CLOSED), the deterministic deficit plan, the
+failover-vs-rebalance race (failover wins; idempotent re-submit is a
+no-op), the migration-window chaos kinds, and the `/fleet/rebalance`
+transport surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from hypervisor_tpu.fleet.failover import (
+    FailoverController,
+    FencingError,
+    ManagedWorker,
+    OwnershipMap,
+    WorkerDurability,
+)
+from hypervisor_tpu.fleet.rebalance import (
+    PROTOCOL_STEPS,
+    MigrationError,
+    RebalanceController,
+)
+from hypervisor_tpu.resilience.wal import scan
+from hypervisor_tpu.tenancy import TenantArena
+from hypervisor_tpu.testing.chaos import (
+    InjectedFleetFault,
+    WaveChaosInjector,
+    WaveChaosPlan,
+)
+
+from tests.unit.test_failover import (
+    SMALL,
+    _assert_same,
+    _drive_tenant,
+    _drive_tenant_suffix,
+    _fingerprint,
+    _managed,
+)
+
+
+def _fleet(tmp_path, seed=11):
+    """3 workers / 4 tenants with spare slots; tenant 0 fully driven
+    (pre-checkpoint workload + mid-workload checkpoint + WAL suffix)
+    so there is real state to move."""
+    w0 = _managed(tmp_path, "w0", (0, 1), 3)
+    w1 = _managed(tmp_path, "w1", (2,), 3)
+    w2 = _managed(tmp_path, "w2", (3,), 3)
+    for w in (w0, w1, w2):
+        # every tenant recoverable from round 0 (failover needs a
+        # durable checkpoint for ALL of a dead worker's tenants)
+        for t, slot in w.slot_of.items():
+            w.durability.checkpoint(w.arena.tenants[slot], t, step=0)
+    st = w0.arena.tenants[w0.slot_of[0]]
+    slot = _drive_tenant(st, "mig", lambda: None)
+    w0.arena.sync()
+    w0.durability.checkpoint(st, 0, step=1)
+    _drive_tenant_suffix(st, "mig", slot, lambda: None)
+    w0.arena.sync()
+    st.journal.flush()
+    om = OwnershipMap(seed=seed)
+    ctl = FailoverController(om, config=SMALL)
+    for w in (w0, w1, w2):
+        ctl.register(w, now=0.0)
+    reb = RebalanceController(om, ctl)
+    return w0, w1, w2, om, ctl, reb
+
+
+def _live_copy(workers, tenant):
+    holders = [w for w in workers if tenant in w.slot_of]
+    assert len(holders) == 1, (
+        f"tenant {tenant} held by "
+        f"{[w.worker_id for w in holders]} — not exactly one"
+    )
+    w = holders[0]
+    return w, w.arena.tenants[w.slot_of[tenant]]
+
+
+# ── the per-tenant fence + the stat-keyed floor cache ────────────────
+
+
+class TestPerTenantFence:
+    def test_tenant_fence_spares_siblings(self, tmp_path):
+        d = WorkerDurability(
+            tmp_path, "w0", epoch=0, tenants=(0, 1), fsync=False
+        ).adopt()
+        with d.wal(0).txn("op", {}):
+            pass
+        with d.wal(1).txn("op", {}):
+            pass
+        WorkerDurability.write_fence(tmp_path, "w0", 1, tenant=0)
+        # tenant 0: appends AND checkpoints refuse...
+        with pytest.raises(FencingError):
+            with d.wal(0).txn("fenced", {}):
+                pass
+        with pytest.raises(FencingError):
+            d.checkpoint(object(), 0)
+        # ...while tenant 1 and the worker floor are untouched.
+        with d.wal(1).txn("sibling", {}):
+            pass
+        assert d.fence_floor() == 0
+        assert d.fence_floor_for(0) == 1
+        assert d.fence_floor_for(1) == 0
+        doc = d.summary()
+        assert doc["tenant_fences"] == {0: 1}
+        json.dumps(doc)
+
+    def test_legacy_fence_doc_still_parses(self, tmp_path):
+        (tmp_path / "w0").mkdir()
+        (tmp_path / "w0" / "FENCE").write_text('{"min_epoch": 3}')
+        doc = WorkerDurability.read_fence_doc(tmp_path, "w0")
+        assert doc == {"min_epoch": 3, "tenants": {}}
+        assert WorkerDurability.read_fence(tmp_path, "w0") == 3
+
+    def test_append_path_pays_one_stat_not_one_parse(
+        self, tmp_path, monkeypatch
+    ):
+        """Satellite fix: the fence doc parses ONCE per fence change,
+        not once per append — the cache is keyed on the FENCE file's
+        stat identity."""
+        d = WorkerDurability(
+            tmp_path, "w0", epoch=0, tenants=(0,), fsync=False
+        ).adopt()
+        WorkerDurability.write_fence(tmp_path, "w0", 0)  # doc exists
+        parses = {"n": 0}
+        real = WorkerDurability.read_fence_doc
+
+        def counting(root, worker_id):
+            parses["n"] += 1
+            return real(root, worker_id)
+
+        monkeypatch.setattr(
+            WorkerDurability, "read_fence_doc", staticmethod(counting)
+        )
+        wal = d.wal(0)
+        for i in range(16):
+            with wal.txn("op", {"i": i}):
+                pass
+        assert parses["n"] == 1  # one parse, sixteen appends
+
+    def test_fence_bump_honored_before_the_next_framed_record(
+        self, tmp_path
+    ):
+        """The cache never delays a fence: `write_fence` replaces the
+        file atomically (new stat identity), so the very NEXT append
+        after a bump refuses with zero new bytes."""
+        d = WorkerDurability(
+            tmp_path, "w0", epoch=0, tenants=(0,), fsync=False
+        ).adopt()
+        wal = d.wal(0)
+        for i in range(4):  # warm the cache on the append path
+            with wal.txn("op", {"i": i}):
+                pass
+        path = d.tenant_dir(0) / "wal.log"
+        committed = len(scan(path).committed)
+        size = path.stat().st_size
+        WorkerDurability.write_fence(tmp_path, "w0", 1, tenant=0)
+        with pytest.raises(FencingError):
+            with wal.txn("late", {}):
+                pass
+        assert wal.fenced_appends == 1
+        assert path.stat().st_size == size  # zero bytes reached disk
+        assert len(scan(path).committed) == committed
+
+    def test_torn_fence_doc_still_fails_closed(self, tmp_path):
+        d = WorkerDurability(
+            tmp_path, "w0", epoch=5, tenants=(0,), fsync=False
+        ).adopt()
+        with d.wal(0).txn("op", {}):
+            pass
+        (tmp_path / "w0" / "FENCE").write_text('{"min_ep')  # torn
+        assert d.fence_floor() == 1 << 62
+        with pytest.raises(FencingError):
+            d.check_fence()
+        with pytest.raises(FencingError):
+            with d.wal(0).txn("torn", {}):
+                pass
+
+
+# ── the migration journal ops on the ownership map ───────────────────
+
+
+class TestOwnershipMapMigration:
+    def test_intent_commit_moves_exactly_once(self):
+        events = []
+        om = OwnershipMap(seed=1, emit=lambda k, p: events.append(k))
+        om.assign("w0", (0, 1), 0, 1.0)
+        om.assign("w1", (2,), 0, 1.0)
+        om.migrate_intent(0, "w0", "w1", 1, 2.0)
+        # intent is NOT a move: the source still owns the tenant.
+        assert om.owner_of(0) == ("w0", 0)
+        assert 0 in om.inflight
+        om.migrate_commit(0, 3.0)
+        assert om.owner_of(0) == ("w1", 1)
+        assert om.tenants_of("w0") == (1,)
+        assert om.epoch == 1
+        assert om.inflight == {}
+        assert events[-2:] == [
+            "fleet_rebalance_planned", "fleet_tenant_migrated",
+        ]
+
+    def test_abort_leaves_ownership_untouched(self):
+        om = OwnershipMap(seed=1)
+        om.assign("w0", (0,), 0, 1.0)
+        om.assign("w1", (), 0, 1.0)
+        om.migrate_intent(0, "w0", "w1", 1, 2.0)
+        rec = om.migrate_abort(0, 2.5, reason="failover:w1")
+        assert rec["dest"] == "w1"
+        assert om.owner_of(0) == ("w0", 0)
+        assert om.inflight == {}
+        assert om.epoch == 0
+        assert om.transitions[-1].kind == "migrate_abort"
+
+    def test_invalid_intents_refuse_before_journaling(self):
+        from hypervisor_tpu.fleet.failover import FailoverError
+
+        om = OwnershipMap(seed=0)
+        om.assign("w0", (0,), 0, 1.0)
+        om.assign("w1", (), 0, 1.0)
+        n = len(om.observations)
+        with pytest.raises(FailoverError):
+            om.migrate_intent(0, "w1", "w0", 1, 2.0)  # wrong source
+        with pytest.raises(FailoverError):
+            om.migrate_intent(0, "w0", "w0", 1, 2.0)  # self-move
+        with pytest.raises(FencingError):
+            om.migrate_intent(0, "w0", "w1", 0, 2.0)  # stale epoch
+        with pytest.raises(FailoverError):
+            om.migrate_commit(7, 2.0)  # no intent
+        with pytest.raises(FailoverError):
+            om.migrate_abort(7, 2.0)  # no intent
+        om.migrate_intent(0, "w0", "w1", 1, 3.0)
+        with pytest.raises(FailoverError):
+            om.migrate_intent(0, "w0", "w1", 2, 3.5)  # already in flight
+        assert len(om.observations) == n + 1
+
+    def test_replay_covers_migration_kinds(self):
+        om = OwnershipMap(seed=21)
+        om.assign("w0", (0, 1), 0, 1.0)
+        om.assign("w1", (), 0, 1.0)
+        om.migrate_intent(0, "w0", "w1", 1, 2.0)
+        om.migrate_commit(0, 3.0)
+        om.migrate_intent(1, "w0", "w1", 2, 4.0)
+        om.migrate_abort(1, 4.5, reason="drill")
+        again = OwnershipMap.replay(om.observations, seed=21)
+        assert again.transition_digest() == om.transition_digest()
+        assert again.owner_of(0) == ("w1", 1)
+        assert again.owner_of(1) == ("w0", 0)
+        doc = om.summary()
+        json.dumps(doc)
+        assert doc["inflight"] == {}
+
+
+# ── the clean planned migration ──────────────────────────────────────
+
+
+class TestCleanMigration:
+    def test_zero_loss_handoff_and_idempotent_resubmit(self, tmp_path):
+        w0, w1, w2, om, ctl, reb = _fleet(tmp_path / "a")
+        oracle = _fingerprint(w0.arena.tenants[w0.slot_of[0]])
+        report = reb.migrate(0, "w2", now=5.0)
+        assert report["status"] == "committed"
+        assert report["steps"] == list(PROTOCOL_STEPS)
+        # drained + checkpointed at the WAL tip: adoption replays ZERO
+        assert report["replayed_ops"] == 0
+        assert om.owner_of(0) == ("w2", 1)
+        holder, st = _live_copy((w0, w1, w2), 0)
+        assert holder is w2
+        _assert_same(_fingerprint(st), oracle, "after clean migration")
+        # the destination is durably the owner
+        assert (w2.durability.tenant_dir(0) / "wal.log").exists()
+        assert (
+            w2.durability.tenant_dir(0) / "latest" / ".done"
+        ).exists()
+        # the source shed its copy: slot back in the spare pool,
+        # per-tenant fence burned, a zombie resume refuses loudly
+        assert 0 not in w0.slot_of
+        assert w0.slot_of[1] is not None  # sibling untouched
+        assert w0.durability.fence_floor_for(0) == 1
+        assert w0.durability.fence_floor() == 0
+        with pytest.raises(FencingError):
+            w0.durability.wal(0)
+        # idempotent re-submit of a completed migration: a no-op
+        again = reb.migrate(0, "w2", now=6.0)
+        assert again["status"] == "noop"
+        assert om.transition_digest() == report["ownership_digest"]
+        # ... and the whole run replays bit-identically, twice
+        _, _, _, om_b, _, reb_b = _fleet(tmp_path / "b")
+        report_b = reb_b.migrate(0, "w2", now=5.0)
+        assert (
+            report_b["ownership_digest"] == report["ownership_digest"]
+        )
+        assert OwnershipMap.replay(
+            om.observations, seed=11
+        ).transition_digest() == om.transition_digest()
+        json.dumps(reb.summary())  # the /fleet/rebalance body
+
+    def test_migration_refusals_move_nothing(self, tmp_path):
+        w0, w1, w2, om, ctl, reb = _fleet(tmp_path)
+        with pytest.raises(MigrationError):
+            reb.migrate(0, "nope", now=1.0)  # unknown destination
+        with pytest.raises(MigrationError):
+            reb.migrate(9, "w1", now=1.0)  # unowned tenant
+        w1.spare_slots.clear()
+        with pytest.raises(MigrationError):
+            reb.migrate(0, "w1", now=1.0)  # no spare slot
+        with pytest.raises(MigrationError):
+            reb.migrate(0, "w2", now=1.0, stop_after="bogus")
+        assert om.owner_of(0) == ("w0", 0)
+        assert om.inflight == {}
+
+    def test_fenced_destination_refuses_the_round_trip(self, tmp_path):
+        w0, w1, w2, om, ctl, reb = _fleet(tmp_path)
+        reb.migrate(0, "w2", now=5.0)
+        # w0 fenced tenant 0 away in this epoch: it can't take it back
+        with pytest.raises(MigrationError, match="fenced"):
+            reb.migrate(0, "w0", now=6.0)
+        assert om.owner_of(0) == ("w2", 1)
+
+
+# ── kill at EVERY protocol step ──────────────────────────────────────
+
+
+class TestKillAtEveryProtocolStep:
+    @pytest.mark.parametrize("step", PROTOCOL_STEPS)
+    @pytest.mark.parametrize("victim", ["source", "dest"])
+    def test_crash_boundary_resolves_to_exactly_one_owner(
+        self, tmp_path, step, victim
+    ):
+        """Stop the migration right after `step`, convict the victim,
+        run the EXISTING failover, and pin: exactly-one owner, the
+        live copy's chain heads bit-identical to the oracle, zero
+        double-applies (a zombie append refuses with zero bytes), no
+        orphaned destination dirs, and a bit-identical journal
+        replay."""
+        w0, w1, w2, om, ctl, reb = _fleet(tmp_path)
+        oracle = _fingerprint(w0.arena.tenants[w0.slot_of[0]])
+        report = reb.migrate(0, "w1", now=5.0, stop_after=step)
+        committed = report["status"] == "committed"
+        assert committed == (step == "journal_commit")
+        dead = "w0" if victim == "source" else "w1"
+        fo = ctl.failover(dead, now=6.0)
+        assert fo["epoch"] == om.epoch
+
+        # exactly-one ownership, in the journal AND in the arenas
+        owner = om.owner_of(0)
+        assert owner is not None
+        holder, st = _live_copy((w0, w1, w2), 0)
+        assert holder.worker_id == owner[0]
+        assert holder.worker_id != dead
+        # zero loss: the surviving copy is bit-identical to the oracle
+        _assert_same(
+            _fingerprint(st), oracle,
+            f"after kill({victim}) at {step}",
+        )
+        # the race resolved through a journaled abort (or the commit)
+        kinds = [t.kind for t in om.transitions]
+        if committed:
+            assert "migrate_commit" in kinds
+        else:
+            assert "migrate_abort" in kinds
+            assert "migrate_commit" not in kinds
+        assert om.inflight == {}
+        # no orphaned destination dirs: a live aborted destination
+        # holds the tenant's dir only if failover re-spliced it there
+        if victim == "source" and not committed:
+            assert w1.durability.tenant_dir(0).exists() == (
+                0 in w1.slot_of
+            )
+        # zero double-applies: the dead worker's durable copy refuses
+        # the very next append (zero bytes land)
+        dead_mw = {"w0": w0, "w1": w1}[dead]
+        with pytest.raises(FencingError):
+            with dead_mw.durability.wal(0).txn("zombie", {}):
+                pass
+        # the whole wreckage replays bit-identically
+        assert OwnershipMap.replay(
+            om.observations, seed=11
+        ).transition_digest() == om.transition_digest()
+        json.dumps(reb.summary()) and json.dumps(ctl.summary())
+
+    def test_dest_death_after_fence_salvages_the_tenant(self, tmp_path):
+        """The nastiest boundary: the destination dies AFTER the
+        source's per-tenant fence burned — the source holds the tenant
+        but can never write it. The abort salvages the drained state
+        onto a live worker through the same splice path."""
+        w0, w1, w2, om, ctl, reb = _fleet(tmp_path)
+        oracle = _fingerprint(w0.arena.tenants[w0.slot_of[0]])
+        reb.migrate(0, "w1", now=5.0, stop_after="fence_source_tenant")
+        assert w0.durability.fence_floor_for(0) == 1
+        ctl.failover("w1", now=6.0)
+        assert len(reb.aborted) == 1
+        assert reb.aborted[0]["salvaged"] is True
+        assert reb.aborted[0]["salvage"] == "w2"
+        assert om.owner_of(0)[0] == "w2"
+        holder, st = _live_copy((w0, w1, w2), 0)
+        assert holder is w2
+        _assert_same(_fingerprint(st), oracle, "after salvage")
+        # the drained final checkpoint made the salvage replay ZERO
+        assert reb.aborted[0]["replayed_ops"] == 0
+
+
+# ── the failover-vs-rebalance race, driven by the chaos plan ─────────
+
+
+class TestFailoverVsRebalanceRace:
+    def test_chaos_plan_schedules_migration_window_faults(self):
+        plan = WaveChaosPlan(
+            seed=7,
+            fleet_faults=(
+                InjectedFleetFault(
+                    kind="migration_kill_source", at_round=2,
+                    worker="w0",
+                ),
+                InjectedFleetFault(
+                    kind="migration_kill_dest", at_round=3,
+                    worker="w1",
+                ),
+                InjectedFleetFault(
+                    kind="torn_ownership_record", at_round=4,
+                    worker="w0",
+                ),
+                InjectedFleetFault(
+                    kind="zombie_source_resume", at_round=5,
+                    worker="w0",
+                ),
+            ),
+        )
+        inj = WaveChaosInjector(plan)
+        assert list(inj.take_fleet_faults(1)) == []
+        due = inj.take_fleet_faults(2)
+        assert [f.kind for f in due] == ["migration_kill_source"]
+        assert list(inj.take_fleet_faults(2)) == []  # once only
+        assert [
+            f.kind for f in inj.take_fleet_faults(3)
+        ] == ["migration_kill_dest"]
+
+    def test_conviction_mid_migration_aborts_and_failover_wins(
+        self, tmp_path
+    ):
+        """Satellite: the SAME tenant is mid-migration when its source
+        is convicted — the migration aborts cleanly (journaled abort
+        record), failover wins, no orphaned epoch directories, and a
+        re-submit of the settled tenant is a no-op."""
+        w0, w1, w2, om, ctl, reb = _fleet(tmp_path)
+        oracle = _fingerprint(w0.arena.tenants[w0.slot_of[0]])
+        # the chaos plan times the kill inside the drain window
+        plan = WaveChaosPlan(
+            seed=7,
+            fleet_faults=(
+                InjectedFleetFault(
+                    kind="migration_kill_source", at_round=1,
+                    worker="w0",
+                ),
+            ),
+        )
+        inj = WaveChaosInjector(plan)
+        (fault,) = inj.take_fleet_faults(1)
+        assert fault.worker == "w0"
+        reb.migrate(0, "w1", now=5.0, stop_after="drain_source")
+        fo = ctl.failover(fault.worker, now=6.0)
+        # the abort was journaled BEFORE the reassignment began
+        kinds = [t.kind for t in om.transitions]
+        assert kinds.index("migrate_abort") < kinds.index("fence")
+        assert len(reb.aborted) == 1
+        assert reb.aborted[0]["reason"] == "failover:w0"
+        # failover won: both of w0's tenants moved at the bumped epoch
+        assert om.tenants_of("w0") == ()
+        assert set(fo["tenants"]) == {0, 1}
+        holder, st = _live_copy((w1, w2), 0)
+        _assert_same(_fingerprint(st), oracle, "after race")
+        # no orphaned epoch directories on the aborted destination
+        assert w1.durability.tenant_dir(0).exists() == (
+            0 in w1.slot_of
+        )
+        # the settled tenant re-submits as a no-op
+        settled = reb.migrate(0, holder.worker_id, now=7.0)
+        assert settled["status"] == "noop"
+
+    def test_torn_ownership_record_fails_the_worker_closed(
+        self, tmp_path
+    ):
+        """`torn_ownership_record` mid-handoff: the source's FENCE doc
+        tears to garbage; EVERY write on that worker fails closed and
+        failover recovers all its tenants."""
+        w0, w1, w2, om, ctl, reb = _fleet(tmp_path)
+        oracle = _fingerprint(w0.arena.tenants[w0.slot_of[0]])
+        reb.migrate(0, "w1", now=5.0, stop_after="seal_source")
+        (tmp_path / "w0" / "FENCE").write_text("\x00garbage")
+        with pytest.raises(FencingError):
+            with w0.arena.tenants[w0.slot_of[1]].journal.txn("op", {}):
+                pass
+        ctl.failover("w0", now=6.0)
+        assert om.tenants_of("w0") == ()
+        holder, st = _live_copy((w1, w2), 0)
+        _assert_same(_fingerprint(st), oracle, "after torn fence")
+
+
+# ── the deterministic deficit plan ───────────────────────────────────
+
+
+class TestPlacementPolicy:
+    def _skewed(self, tmp_path):
+        # two full donors + one empty receiver — every arena is the
+        # T=3 shape the failover tests already compiled
+        w0 = _managed(tmp_path, "w0", (0, 1, 2), 3)
+        w1 = _managed(tmp_path, "w1", (3, 4, 5), 3)
+        w2 = _managed(tmp_path, "w2", (), 3)
+        om = OwnershipMap(seed=5)
+        ctl = FailoverController(om, config=SMALL)
+        for w in (w0, w1, w2):
+            ctl.register(w, now=0.0)
+        return w0, w1, w2, om, ctl, RebalanceController(om, ctl)
+
+    def test_plan_is_deterministic_and_levels_the_fleet(self, tmp_path):
+        w0, w1, w2, om, ctl, reb = self._skewed(tmp_path)
+        plan = reb.plan(now=1.0)
+        again = reb.plan(now=1.0)
+        assert plan["plan_digest"] == again["plan_digest"]
+        assert plan["proposals"] == again["proposals"]
+        # deficit-aware spread: donors are the most-loaded (worker-id
+        # breaks the w0/w1 tie toward the HIGHER id), receivers the
+        # least-loaded — and no proposal moves across a deficit
+        # under 2, so the plan stops at a levelled 2/2/2
+        assert [
+            (p["tenant"], p["source"], p["dest"])
+            for p in plan["proposals"]
+        ] == [(3, "w1", "w2"), (0, "w0", "w2")]
+        out = reb.execute(now=2.0)
+        assert [r["status"] for r in out["results"]] == [
+            "committed", "committed",
+        ]
+        assert om.owner_of(3)[0] == "w2"
+        assert om.owner_of(0)[0] == "w2"
+        # the levelled fleet has nothing left to move
+        assert reb.plan(now=3.0)["proposals"] == []
+
+    def test_plan_skips_fenced_receivers(self, tmp_path):
+        w0, w1, w2, om, ctl, reb = self._skewed(tmp_path)
+        # the sole spare-holding receiver (w2) is fenced for exactly
+        # the two tenants the unfenced plan would send it: the plan
+        # must route AROUND them — the next movable tenant goes
+        # instead, and no proposal ever lands on a fenced pair
+        WorkerDurability.write_fence(tmp_path, "w2", 1, tenant=3)
+        WorkerDurability.write_fence(tmp_path, "w2", 1, tenant=0)
+        plan = reb.plan(now=1.0)
+        moved = [
+            (p["tenant"], p["dest"]) for p in plan["proposals"]
+        ]
+        assert moved == [(4, "w2")]
+        assert all(
+            (t, d) not in ((3, "w2"), (0, "w2")) for t, d in moved
+        )
+
+
+# ── the transport surface ────────────────────────────────────────────
+
+
+class TestRebalanceApi:
+    def _svc(self):
+        from hypervisor_tpu.api.service import HypervisorService
+
+        return HypervisorService()
+
+    def test_routes_registered_on_the_shared_table(self):
+        from hypervisor_tpu.api.server import ROUTES
+
+        assert ("GET", "/fleet/rebalance") in {
+            (m, p) for m, p, _, _ in ROUTES
+        }
+        assert ("POST", "/fleet/rebalance") in {
+            (m, p) for m, p, _, _ in ROUTES
+        }
+
+    def test_503_without_fleet_then_without_plane(self):
+        from hypervisor_tpu.api.service import ApiError
+        from hypervisor_tpu.fleet import FleetObservatory
+
+        svc = self._svc()
+        with pytest.raises(ApiError) as ei:
+            asyncio.run(svc.fleet_rebalance())
+        assert ei.value.status == 503
+        svc.fleet = FleetObservatory({})
+        with pytest.raises(ApiError, match="rebalance"):
+            asyncio.run(svc.fleet_rebalance())
+
+    def test_get_post_dry_run_and_execute(self, tmp_path):
+        from hypervisor_tpu.api import models as M
+        from hypervisor_tpu.api.service import ApiError
+        from hypervisor_tpu.fleet import FleetObservatory
+
+        w0, w1, w2, om, ctl, reb = _fleet(tmp_path)
+        svc = self._svc()
+        svc.fleet = FleetObservatory({})
+        svc.fleet.ownership = om
+        svc.fleet.failover = ctl
+        svc.fleet.rebalance = reb
+        doc = asyncio.run(svc.fleet_rebalance())
+        assert doc["migration_count"] == 0
+        assert doc["protocol_steps"] == list(PROTOCOL_STEPS)
+        json.dumps(doc)
+        # dry-run: nothing moves
+        dry = asyncio.run(svc.fleet_rebalance_post(
+            M.FleetRebalanceRequest(now=1.0)
+        ))
+        assert dry["executed"] is False
+        assert om.owner_of(0) == ("w0", 0)
+        # a specific migration needs BOTH halves
+        with pytest.raises(ApiError) as ei:
+            asyncio.run(svc.fleet_rebalance_post(
+                M.FleetRebalanceRequest(tenant=0, execute=True)
+            ))
+        assert ei.value.status == 400
+        # execute one specific migration
+        out = asyncio.run(svc.fleet_rebalance_post(
+            M.FleetRebalanceRequest(
+                tenant=0, destination="w2", execute=True, now=2.0,
+            )
+        ))
+        assert out["executed"] is True
+        assert out["result"]["status"] == "committed"
+        assert om.owner_of(0) == ("w2", 1)
+        # refusals surface as 409, not 500
+        with pytest.raises(ApiError) as ei:
+            asyncio.run(svc.fleet_rebalance_post(
+                M.FleetRebalanceRequest(
+                    tenant=0, destination="w0", execute=True, now=3.0,
+                )
+            ))
+        assert ei.value.status == 409

@@ -168,7 +168,7 @@ class TestSLOEngine:
         # Wire-format discipline: the new codes extend the enum, they
         # never renumber existing device-log rows (hvlint HVA004 pins
         # the committed baseline; this pins the tail order).
-        tail = list(EventType)[-13:]
+        tail = list(EventType)[-16:]
         assert tail == [
             EventType.SLO_RECOVERED,
             # Round 15 appended the roofline observatory's shift
@@ -193,6 +193,11 @@ class TestSLOEngine:
             EventType.FLEET_OWNERSHIP_CHANGED,
             EventType.FLEET_WORKER_FENCED,
             EventType.FLEET_TENANTS_REASSIGNED,
+            # Round 21 appended the rebalance plane's triple BEHIND
+            # the failover triple — append-only holds.
+            EventType.FLEET_REBALANCE_PLANNED,
+            EventType.FLEET_TENANT_MIGRATED,
+            EventType.FLEET_MIGRATION_ABORTED,
         ]
 
 
